@@ -1,0 +1,25 @@
+"""swarmkit_tpu — a TPU-native cluster-orchestration framework.
+
+A ground-up re-architecture of the capability surface of moby/swarmkit
+(mirrored as thaJeztah/swarmkit): raft-replicated declarative cluster state,
+services reconciled into tasks, constraint-based spread scheduling, a
+dispatch protocol to workers, and an mTLS CA — with the manager-side hot
+loops (constraint evaluation, resource filtering, spread scoring, raft
+log-replay quorum tally) executed as batched JAX/XLA kernels on TPU.
+
+Layering (see SURVEY.md §1):
+    api/          typed object model (L0)
+    store/        transactional in-memory state store + watch (L1)
+    raft/         consensus & replication (L2)
+    scheduler/    constraint/filter/spread scheduler, CPU + TPU backends (L3)
+    orchestrator/ replicated/global/job orchestrators, updater, restart (L3)
+    dispatcher/   manager<->worker assignment plane (L4)
+    agent/        worker runtime + executor framework (L7)
+    ca/           security substrate (X1)
+    ops/          JAX/Pallas kernels (mask/score/water-fill, raft replay)
+    parallel/     device-mesh sharding of the kernels (pjit/shard_map)
+    models/       assembled jittable "models" (flagship scheduling step)
+    utils/        ids, misc
+"""
+
+__version__ = "0.1.0"

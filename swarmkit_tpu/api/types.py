@@ -1,0 +1,113 @@
+"""Core enums and value types of the object model.
+
+TPU-native re-implementation of the reference's protobuf enum surface
+(reference: api/types.proto). Values are kept numerically identical to the
+reference so that state machines, ordering comparisons, and on-disk snapshots
+remain comparable (api/types.proto:510-540 for TaskState).
+"""
+from __future__ import annotations
+
+import enum
+
+
+class TaskState(enum.IntEnum):
+    """Monotonic task lifecycle (reference: api/types.proto:510-540).
+
+    A task's observed state never decreases (agent/exec/controller.go:163-166
+    panics on a backward transition in the reference); the same invariant is
+    enforced in `swarmkit_tpu.agent.exec`.
+    """
+
+    NEW = 0
+    PENDING = 64
+    ASSIGNED = 192
+    ACCEPTED = 256
+    PREPARING = 320
+    READY = 384
+    STARTING = 448
+    RUNNING = 512
+    COMPLETE = 576
+    SHUTDOWN = 640
+    FAILED = 704
+    REJECTED = 768
+    REMOVE = 800
+    ORPHANED = 832
+
+    @property
+    def terminal(self) -> bool:
+        return self >= TaskState.COMPLETE
+
+
+class NodeRole(enum.IntEnum):
+    """reference: api/types.proto NodeRole."""
+
+    WORKER = 0
+    MANAGER = 1
+
+
+class NodeMembership(enum.IntEnum):
+    PENDING = 0
+    ACCEPTED = 1
+
+
+class NodeAvailability(enum.IntEnum):
+    ACTIVE = 0
+    PAUSE = 1
+    DRAIN = 2
+
+
+class NodeStatusState(enum.IntEnum):
+    """reference: api/types.proto NodeStatus.State."""
+
+    UNKNOWN = 0
+    DOWN = 1
+    READY = 2
+    DISCONNECTED = 3
+
+
+class ServiceMode(enum.Enum):
+    REPLICATED = "replicated"
+    GLOBAL = "global"
+    REPLICATED_JOB = "replicated_job"
+    GLOBAL_JOB = "global_job"
+
+
+class RestartCondition(enum.Enum):
+    """reference: api/types.proto RestartPolicy.RestartCondition."""
+
+    NONE = "none"
+    ON_FAILURE = "on_failure"
+    ANY = "any"
+
+
+class UpdateFailureAction(enum.Enum):
+    PAUSE = "pause"
+    CONTINUE = "continue"
+    ROLLBACK = "rollback"
+
+
+class UpdateOrder(enum.Enum):
+    STOP_FIRST = "stop_first"
+    START_FIRST = "start_first"
+
+
+class UpdateStatusState(enum.Enum):
+    UNKNOWN = "unknown"
+    UPDATING = "updating"
+    PAUSED = "paused"
+    COMPLETED = "completed"
+    ROLLBACK_STARTED = "rollback_started"
+    ROLLBACK_PAUSED = "rollback_paused"
+    ROLLBACK_COMPLETED = "rollback_completed"
+
+
+# Platform normalization applied by the platform filter
+# (reference: manager/scheduler/filter.go:254-320).
+ARCH_ALIASES = {
+    "x86_64": "amd64",
+    "aarch64": "arm64",
+}
+
+
+def normalize_arch(arch: str) -> str:
+    return ARCH_ALIASES.get(arch.lower(), arch.lower())

@@ -1,0 +1,413 @@
+"""Transactional in-memory cluster-state store.
+
+Re-derivation of the reference MemoryStore (manager/state/store/memory.go):
+`view` / `update` / `batch` transactions over per-type tables with secondary
+indexes, a changelist turned into events on commit, an optional raft Proposer
+on the write path, and whole-store Save/Restore snapshots.
+
+Where the reference rides hashicorp/go-memdb radix trees, we use plain dict
+tables plus maintained secondary-index dicts — the TPU build's hot queries are
+answered from the scheduler's own dense arrays, so the store optimizes for
+transactional correctness and event fidelity, not pointer-walk speed.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Any, Callable, Iterable
+
+from ..api.objects import (
+    ALL_TABLES,
+    Cluster,
+    Config,
+    EventCommit,
+    EventCreate,
+    EventDelete,
+    EventUpdate,
+    Extension,
+    Meta,
+    Network,
+    Node,
+    Resource,
+    Secret,
+    Service,
+    StoreObject,
+    Task,
+    Version,
+    Volume,
+)
+from . import by as by_mod
+from .watch import Channel, WatchQueue
+
+# Batch limits (reference: manager/state/store/memory.go:47-51).
+MAX_CHANGES_PER_TRANSACTION = 200
+MAX_TRANSACTION_BYTES = 1.5 * 1024 * 1024
+
+# Wedge detection (memory.go:80-81): update lock held longer than this is a bug.
+WEDGE_TIMEOUT = 30.0
+
+
+class SequenceConflict(Exception):
+    """Version-checked update failed (reference ErrSequenceConflict)."""
+
+
+class ExistError(Exception):
+    pass
+
+
+class NotExistError(Exception):
+    pass
+
+
+class StoreAction:
+    """One element of a raft-replicated changelist (api/raft.pb.go StoreAction)."""
+
+    CREATE, UPDATE, DELETE = "create", "update", "delete"
+
+    def __init__(self, kind: str, obj: StoreObject):
+        self.kind = kind
+        self.obj = obj
+
+    def __repr__(self):
+        return f"StoreAction({self.kind}, {self.obj.TABLE}:{self.obj.id})"
+
+
+class ReadTx:
+    """Consistent read view. Objects returned are live references owned by the
+    store — callers must treat them as immutable and `copy()` before mutating
+    (same contract as the reference's returned protos)."""
+
+    def __init__(self, store: "MemoryStore"):
+        self._s = store
+
+    def get(self, cls: type[StoreObject], id: str) -> StoreObject | None:
+        return self._s._tables[cls.TABLE].get(id)
+
+    def find(self, cls: type[StoreObject], *selectors) -> list[StoreObject]:
+        return self._s._find(cls, selectors)
+
+    # Typed convenience accessors (reference: tasks.go, nodes.go, ...).
+    def get_task(self, id): return self.get(Task, id)
+    def get_node(self, id): return self.get(Node, id)
+    def get_service(self, id): return self.get(Service, id)
+    def get_cluster(self, id): return self.get(Cluster, id)
+    def get_secret(self, id): return self.get(Secret, id)
+    def get_config(self, id): return self.get(Config, id)
+    def get_network(self, id): return self.get(Network, id)
+    def get_volume(self, id): return self.get(Volume, id)
+    def get_extension(self, id): return self.get(Extension, id)
+    def get_resource(self, id): return self.get(Resource, id)
+
+    def find_tasks(self, *sel): return self.find(Task, *sel)
+    def find_nodes(self, *sel): return self.find(Node, *sel)
+    def find_services(self, *sel): return self.find(Service, *sel)
+    def find_clusters(self, *sel): return self.find(Cluster, *sel)
+    def find_secrets(self, *sel): return self.find(Secret, *sel)
+    def find_configs(self, *sel): return self.find(Config, *sel)
+    def find_networks(self, *sel): return self.find(Network, *sel)
+    def find_volumes(self, *sel): return self.find(Volume, *sel)
+    def find_extensions(self, *sel): return self.find(Extension, *sel)
+    def find_resources(self, *sel): return self.find(Resource, *sel)
+
+
+class WriteTx(ReadTx):
+    """Buffered write transaction. Reads see the transaction's own writes."""
+
+    def __init__(self, store: "MemoryStore"):
+        super().__init__(store)
+        self._writes: dict[tuple[str, str], StoreObject | None] = {}
+        self._changelist: list[StoreAction] = []
+
+    # -- reads see buffered writes -----------------------------------------
+    def get(self, cls: type[StoreObject], id: str) -> StoreObject | None:
+        key = (cls.TABLE, id)
+        if key in self._writes:
+            return self._writes[key]
+        return super().get(cls, id)
+
+    def find(self, cls: type[StoreObject], *selectors) -> list[StoreObject]:
+        base = {o.id: o for o in super().find(cls, *selectors)}
+        # Overlay buffered writes: re-filter them, drop deletes.
+        for (table, id), obj in self._writes.items():
+            if table != cls.TABLE:
+                continue
+            base.pop(id, None)
+            if obj is not None and by_mod.matches(obj, selectors):
+                base[id] = obj
+        return sorted(base.values(), key=lambda o: o.id)
+
+    # -- mutations ----------------------------------------------------------
+    def create(self, obj: StoreObject) -> None:
+        if self.get(type(obj), obj.id) is not None:
+            raise ExistError(f"{obj.TABLE} {obj.id} already exists")
+        if obj.TABLE == "service" or obj.TABLE == "node":
+            existing = [o for o in self.find(type(obj), by_mod.ByName(_name_of(obj)))
+                        if _name_of(o)] if _name_of(obj) else []
+            if existing:
+                raise ExistError(f"{obj.TABLE} name {_name_of(obj)!r} is in use")
+        obj = obj.copy()
+        self._writes[(obj.TABLE, obj.id)] = obj
+        self._changelist.append(StoreAction(StoreAction.CREATE, obj))
+
+    def update(self, obj: StoreObject) -> None:
+        old = self.get(type(obj), obj.id)
+        if old is None:
+            raise NotExistError(f"{obj.TABLE} {obj.id} does not exist")
+        if obj.meta.version.index != old.meta.version.index:
+            raise SequenceConflict(
+                f"{obj.TABLE} {obj.id}: update at version "
+                f"{obj.meta.version.index}, store at {old.meta.version.index}"
+            )
+        obj = obj.copy()
+        self._writes[(obj.TABLE, obj.id)] = obj
+        self._changelist.append(StoreAction(StoreAction.UPDATE, obj))
+
+    def delete(self, cls: type[StoreObject], id: str) -> None:
+        old = self.get(cls, id)
+        if old is None:
+            raise NotExistError(f"{cls.TABLE} {id} does not exist")
+        self._writes[(cls.TABLE, id)] = None
+        self._changelist.append(StoreAction(StoreAction.DELETE, old))
+
+
+def _name_of(obj: StoreObject) -> str:
+    spec = getattr(obj, "spec", None)
+    ann = getattr(spec, "annotations", None) or getattr(obj, "annotations", None)
+    return getattr(ann, "name", "") if ann is not None else ""
+
+
+class MemoryStore:
+    """reference: manager/state/store/memory.go:150-158."""
+
+    def __init__(self, proposer=None):
+        self._tables: dict[str, dict[str, StoreObject]] = {t: {} for t in ALL_TABLES}
+        # secondary indexes: table -> index name -> key -> set[id]
+        self._indexes: dict[str, dict[str, dict[Any, set[str]]]] = {
+            t: defaultdict(lambda: defaultdict(set)) for t in ALL_TABLES
+        }
+        self._lock = threading.RLock()          # guards table reads
+        self._update_lock = threading.Lock()    # serializes writers (memory.go updateLock)
+        self._update_lock_held_since: float | None = None
+        self.proposer = proposer
+        self.queue = WatchQueue()
+        self._version = Version(0)  # commit version when no proposer drives it
+
+    # ------------------------------------------------------------------ reads
+    def view(self, cb: Callable[[ReadTx], Any] | None = None):
+        tx = ReadTx(self)
+        if cb is None:
+            return tx
+        with self._lock:
+            return cb(tx)
+
+    # ----------------------------------------------------------------- writes
+    def update(self, cb: Callable[[WriteTx], Any]) -> Any:
+        """Run a write transaction; commit through the proposer when present
+        (memory.go:321-388)."""
+        with self._update_lock:
+            self._update_lock_held_since = time.monotonic()
+            try:
+                tx = WriteTx(self)
+                cb(tx)
+                if not tx._changelist:
+                    return None
+                if self.proposer is not None:
+                    actions = list(tx._changelist)
+                    committed = threading.Event()
+
+                    def commit_cb():
+                        self._commit(tx)
+                        committed.set()
+
+                    self.proposer.propose_value(actions, commit_cb)
+                    if not committed.is_set():
+                        # Proposer accepted asynchronously; the commit callback
+                        # must run before propose_value returns in-process
+                        # implementations. Raft returns only after commit.
+                        raise RuntimeError("proposer returned before commit")
+                else:
+                    self._commit(tx)
+                return None
+            finally:
+                self._update_lock_held_since = None
+
+    def _commit(self, tx: WriteTx) -> None:
+        now = time.time()
+        with self._lock:
+            self._version.index += 1
+            version = Version(self._version.index)
+            events: list[Any] = []
+            for action in tx._changelist:
+                obj = action.obj
+                table = obj.TABLE
+                if action.kind == StoreAction.DELETE:
+                    stored = self._tables[table].pop(obj.id, None)
+                    if stored is not None:
+                        self._unindex(table, stored)
+                    events.append(EventDelete(obj))
+                    continue
+                old = self._tables[table].get(obj.id)
+                # touchMeta (memory.go:998-1020): stamp version + timestamps.
+                obj.meta.version = Version(version.index)
+                if action.kind == StoreAction.CREATE:
+                    obj.meta.created_at = now
+                obj.meta.updated_at = now
+                if old is not None:
+                    self._unindex(table, old)
+                self._tables[table][obj.id] = obj
+                self._index(table, obj)
+                if action.kind == StoreAction.CREATE:
+                    events.append(EventCreate(obj))
+                else:
+                    events.append(EventUpdate(obj, old=old))
+            events.append(EventCommit(version))
+        self.queue.publish_all(events)
+
+    def apply_store_actions(self, actions: Iterable[StoreAction]) -> None:
+        """Raft follower/replay apply path (memory.go:280-308): applies a
+        committed changelist without consulting the proposer."""
+        with self._update_lock:
+            tx = WriteTx(self)
+            for a in actions:
+                if a.kind == StoreAction.CREATE:
+                    tx.create(a.obj)
+                elif a.kind == StoreAction.UPDATE:
+                    # Replay trusts the leader's version; bypass conflict check.
+                    cur = tx.get(type(a.obj), a.obj.id)
+                    obj = a.obj.copy()
+                    if cur is not None:
+                        obj.meta.version = Version(cur.meta.version.index)
+                        tx.update(obj)
+                    else:
+                        tx.create(obj)
+                else:
+                    try:
+                        tx.delete(type(a.obj), a.obj.id)
+                    except NotExistError:
+                        pass
+            self._commit(tx)
+
+    def batch(self, cb: Callable[["Batch"], Any]) -> None:
+        """Split a large write into transactions of at most
+        MAX_CHANGES_PER_TRANSACTION changes (memory.go:399-549)."""
+        b = Batch(self)
+        cb(b)
+        b._flush()
+
+    # ----------------------------------------------------------------- events
+    def watch_queue(self) -> WatchQueue:
+        return self.queue
+
+    def view_and_watch(self, cb: Callable[[ReadTx], Any] | None = None,
+                       matcher=None) -> tuple[Any, Channel]:
+        """Atomic snapshot-then-subscribe (memory.go:892-909): no event that
+        post-dates the snapshot is missed, none that pre-dates it is delivered."""
+        with self._lock:
+            result = cb(ReadTx(self)) if cb is not None else None
+            ch = self.queue.watch(matcher)
+        return result, ch
+
+    # -------------------------------------------------------------- snapshots
+    def save(self) -> dict[str, list[StoreObject]]:
+        """Marshal the whole store (memory.go:857-879 / api/snapshot.proto)."""
+        with self._lock:
+            return {t: [o.copy() for o in objs.values()] for t, objs in self._tables.items()}
+
+    def restore(self, snapshot: dict[str, list[StoreObject]]) -> None:
+        with self._update_lock, self._lock:
+            for t in self._tables:
+                self._tables[t].clear()
+                self._indexes[t].clear()
+            max_index = 0
+            for t, objs in snapshot.items():
+                for o in objs:
+                    o = o.copy()
+                    self._tables[t][o.id] = o
+                    self._index(t, o)
+                    max_index = max(max_index, o.meta.version.index)
+            self._version.index = max(self._version.index, max_index)
+
+    @property
+    def version(self) -> Version:
+        return Version(self._version.index)
+
+    def wedged(self) -> bool:
+        """Wedge detector (memory.go:1024-1031)."""
+        since = self._update_lock_held_since
+        return since is not None and time.monotonic() - since > WEDGE_TIMEOUT
+
+    # ---------------------------------------------------------------- indexes
+    def _index_entries(self, obj: StoreObject) -> list[tuple[str, Any]]:
+        entries: list[tuple[str, Any]] = []
+        name = _name_of(obj)
+        if name:
+            entries.append(("name", name.lower()))
+        if isinstance(obj, Task):
+            if obj.service_id:
+                entries.append(("service", obj.service_id))
+            if obj.node_id:
+                entries.append(("node", obj.node_id))
+            entries.append(("slot", (obj.service_id, obj.slot)))
+            entries.append(("desired_state", int(obj.desired_state)))
+            entries.append(("task_state", int(obj.status.state)))
+        elif isinstance(obj, Node):
+            entries.append(("role", int(obj.role)))
+            entries.append(("membership", int(obj.spec.membership)))
+        elif isinstance(obj, Volume):
+            if obj.spec.group:
+                entries.append(("group", obj.spec.group))
+            if obj.spec.driver:
+                entries.append(("driver", obj.spec.driver))
+        elif isinstance(obj, Resource):
+            if obj.kind:
+                entries.append(("kind", obj.kind))
+        return entries
+
+    def _index(self, table: str, obj: StoreObject) -> None:
+        for idx, key in self._index_entries(obj):
+            self._indexes[table][idx][key].add(obj.id)
+
+    def _unindex(self, table: str, obj: StoreObject) -> None:
+        for idx, key in self._index_entries(obj):
+            self._indexes[table][idx][key].discard(obj.id)
+
+    def _find(self, cls: type[StoreObject], selectors) -> list[StoreObject]:
+        with self._lock:
+            table = self._tables[cls.TABLE]
+            ids = by_mod.candidate_ids(self._indexes[cls.TABLE], selectors)
+            objs = table.values() if ids is None else (
+                table[i] for i in ids if i in table)
+            return sorted(
+                (o for o in objs if by_mod.matches(o, selectors)),
+                key=lambda o: o.id,
+            )
+
+
+class Batch:
+    """reference: memory.go Batch — accumulates updates, flushing every
+    MAX_CHANGES_PER_TRANSACTION changes as an independent transaction."""
+
+    def __init__(self, store: MemoryStore):
+        self._store = store
+        self._pending: list[Callable[[WriteTx], Any]] = []
+        self.applied = 0
+        self.committed = 0
+
+    def update(self, cb: Callable[[WriteTx], Any]) -> None:
+        self._pending.append(cb)
+        self.applied += 1
+        if len(self._pending) >= MAX_CHANGES_PER_TRANSACTION:
+            self._flush()
+
+    def _flush(self) -> None:
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, []
+
+        def run_all(tx: WriteTx):
+            for cb in pending:
+                cb(tx)
+
+        self._store.update(run_all)
+        self.committed += len(pending)

@@ -1,0 +1,161 @@
+"""Broadcast watch queue (reference: watch/watch.go:20-186, watch/queue/queue.go).
+
+Components subscribe with an optional matcher; `publish` fans events out to
+per-subscriber unbounded deques guarded by one condition variable. A bounded
+`limit` mirrors the reference's LimitQueue: a slow subscriber whose queue
+exceeds the limit is closed rather than blocking publishers.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Callable, Iterable
+
+Matcher = Callable[[Any], bool]
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class Channel:
+    """One subscriber's event stream."""
+
+    def __init__(self, matcher: Matcher | None, limit: int | None):
+        self._matcher = matcher
+        self._limit = limit
+        self._events: deque[Any] = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+
+    def _offer(self, event: Any) -> None:
+        if self._matcher is not None and not self._matcher(event):
+            return
+        with self._cond:
+            if self._closed:
+                return
+            if self._limit is not None and len(self._events) >= self._limit:
+                # Slow-subscriber protection (watch/queue/queue.go LimitQueue).
+                self._closed = True
+                self._cond.notify_all()
+                return
+            self._events.append(event)
+            self._cond.notify_all()
+
+    def get(self, timeout: float | None = None) -> Any:
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._events or self._closed, timeout):
+                raise TimeoutError("no event within timeout")
+            if self._events:
+                return self._events.popleft()
+            raise ChannelClosed()
+
+    def try_get(self) -> Any | None:
+        with self._cond:
+            if self._events:
+                return self._events.popleft()
+            if self._closed:
+                raise ChannelClosed()
+            return None
+
+    def drain(self) -> list[Any]:
+        with self._cond:
+            out = list(self._events)
+            self._events.clear()
+            return out
+
+    def wait_any(self, timeout: float | None = None) -> bool:
+        """Block until at least one event is queued (or closed). True if events."""
+        with self._cond:
+            self._cond.wait_for(lambda: self._events or self._closed, timeout)
+            if self._events:
+                return True
+            if self._closed:
+                raise ChannelClosed()
+            return False
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def __iter__(self):
+        while True:
+            try:
+                yield self.get()
+            except ChannelClosed:
+                return
+
+
+class WatchQueue:
+    """Fan-out publisher (reference: watch/watch.go Queue)."""
+
+    def __init__(self, default_limit: int | None = 10000):
+        self._subs: list[Channel] = []
+        self._lock = threading.Lock()
+        self._default_limit = default_limit
+        self._closed = False
+
+    def watch(self, matcher: Matcher | None = None, limit: int | None = -1) -> Channel:
+        if limit == -1:
+            limit = self._default_limit
+        ch = Channel(matcher, limit)
+        with self._lock:
+            if self._closed:
+                ch.close()
+            else:
+                self._subs.append(ch)
+        return ch
+
+    def callback_watch(self, cb: Callable[[Any], None], matcher: Matcher | None = None):
+        """Synchronous-callback subscription (watch/watch.go CallbackWatch)."""
+
+        class _CallbackChannel(Channel):
+            def _offer(self, event):
+                if matcher is not None and not matcher(event):
+                    return
+                cb(event)
+
+        ch = _CallbackChannel(None, None)
+        with self._lock:
+            self._subs.append(ch)
+        return ch
+
+    def publish(self, event: Any) -> None:
+        with self._lock:
+            subs = list(self._subs)
+        for ch in subs:
+            ch._offer(event)
+
+    def publish_all(self, events: Iterable[Any]) -> None:
+        for e in events:
+            self.publish(e)
+
+    def stop_watch(self, ch: Channel) -> None:
+        ch.close()
+        with self._lock:
+            try:
+                self._subs.remove(ch)
+            except ValueError:
+                pass
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            subs = list(self._subs)
+            self._subs.clear()
+        for ch in subs:
+            ch.close()
+
+
+def match_events(*predicates: Matcher) -> Matcher:
+    """OR-combination matcher, mirroring state.Matcher(specifiers...)."""
+
+    def matcher(event: Any) -> bool:
+        return any(p(event) for p in predicates)
+
+    return matcher

@@ -1,0 +1,15 @@
+"""Random IDs (reference: identity/randomid.go — crockford base32 of 16 bytes)."""
+from __future__ import annotations
+
+import os
+
+_ALPHABET = "0123456789abcdefghjkmnpqrstvwxyz"  # crockford base32, lowercase
+
+
+def new_id() -> str:
+    raw = int.from_bytes(os.urandom(16), "big")
+    out = []
+    for _ in range(25):
+        out.append(_ALPHABET[raw & 31])
+        raw >>= 5
+    return "".join(reversed(out))

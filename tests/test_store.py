@@ -1,0 +1,248 @@
+"""Store-layer tests, modeled on the reference's memory_test.go scenarios:
+CRUD, version conflicts, find-by-index, batch splitting, snapshot round-trip,
+watch semantics, and view-and-watch atomicity."""
+import threading
+
+import pytest
+
+from swarmkit_tpu.api.objects import (
+    EventCommit,
+    EventCreate,
+    EventDelete,
+    EventUpdate,
+    Node,
+    Service,
+    Task,
+)
+from swarmkit_tpu.api.specs import Annotations, NodeSpec, ServiceSpec, TaskSpec
+from swarmkit_tpu.api.types import NodeRole, TaskState
+from swarmkit_tpu.state.proposer import LocalProposer
+from swarmkit_tpu.store import by
+from swarmkit_tpu.store.memory import (
+    MAX_CHANGES_PER_TRANSACTION,
+    Batch,
+    ExistError,
+    MemoryStore,
+    NotExistError,
+    SequenceConflict,
+)
+
+
+def make_task(id, service_id="svc", slot=0, node_id="", state=TaskState.NEW):
+    t = Task(id=id, service_id=service_id, slot=slot, node_id=node_id)
+    t.status.state = state
+    t.desired_state = TaskState.RUNNING
+    return t
+
+
+def test_create_get_update_delete():
+    s = MemoryStore()
+    t = make_task("t1")
+    s.update(lambda tx: tx.create(t))
+    got = s.view(lambda tx: tx.get_task("t1"))
+    assert got is not None and got.id == "t1"
+    assert got.meta.version.index == 1
+
+    got = got.copy()
+    got.node_id = "n1"
+    s.update(lambda tx: tx.update(got))
+    got2 = s.view(lambda tx: tx.get_task("t1"))
+    assert got2.node_id == "n1"
+    assert got2.meta.version.index == 2
+
+    s.update(lambda tx: tx.delete(Task, "t1"))
+    assert s.view(lambda tx: tx.get_task("t1")) is None
+
+
+def test_version_conflict():
+    s = MemoryStore()
+    t = make_task("t1")
+    s.update(lambda tx: tx.create(t))
+    stale = s.view(lambda tx: tx.get_task("t1")).copy()
+    fresh = stale.copy()
+    s.update(lambda tx: tx.update(fresh))  # bumps to version 2
+    with pytest.raises(SequenceConflict):
+        s.update(lambda tx: tx.update(stale))
+
+
+def test_create_duplicate_and_missing_update():
+    s = MemoryStore()
+    s.update(lambda tx: tx.create(make_task("t1")))
+    with pytest.raises(ExistError):
+        s.update(lambda tx: tx.create(make_task("t1")))
+    with pytest.raises(NotExistError):
+        s.update(lambda tx: tx.update(make_task("nope")))
+    with pytest.raises(NotExistError):
+        s.update(lambda tx: tx.delete(Task, "nope"))
+
+
+def test_duplicate_service_name_rejected():
+    s = MemoryStore()
+    svc = Service(id="s1", spec=ServiceSpec(annotations=Annotations(name="web")))
+    s.update(lambda tx: tx.create(svc))
+    dup = Service(id="s2", spec=ServiceSpec(annotations=Annotations(name="web")))
+    with pytest.raises(ExistError):
+        s.update(lambda tx: tx.create(dup))
+
+
+def test_find_by_indexes():
+    s = MemoryStore()
+
+    def setup(tx):
+        tx.create(make_task("t1", service_id="a", node_id="n1", slot=1))
+        tx.create(make_task("t2", service_id="a", node_id="n2", slot=2))
+        tx.create(make_task("t3", service_id="b", node_id="n1", slot=1,
+                            state=TaskState.RUNNING))
+        tx.create(Node(id="n1", spec=NodeSpec(), role=int(NodeRole.MANAGER)))
+        tx.create(Node(id="n2", spec=NodeSpec(), role=int(NodeRole.WORKER)))
+
+    s.update(setup)
+
+    assert [t.id for t in s.view().find_tasks(by.ByServiceID("a"))] == ["t1", "t2"]
+    assert [t.id for t in s.view().find_tasks(by.ByNodeID("n1"))] == ["t1", "t3"]
+    assert [t.id for t in s.view().find_tasks(by.BySlot("a", 2))] == ["t2"]
+    assert [t.id for t in s.view().find_tasks(by.ByTaskState(TaskState.RUNNING))] == ["t3"]
+    # top-level selectors OR together
+    assert [t.id for t in s.view().find_tasks(
+        by.ByServiceID("a"), by.ByServiceID("b"))] == ["t1", "t2", "t3"]
+    assert [n.id for n in s.view().find_nodes(by.ByRole(NodeRole.MANAGER))] == ["n1"]
+    assert [t.id for t in s.view().find_tasks(by.ByIDPrefix("t"))] == ["t1", "t2", "t3"]
+
+
+def test_write_tx_sees_own_writes_and_rolls_back():
+    s = MemoryStore()
+    s.update(lambda tx: tx.create(make_task("t1", service_id="a")))
+
+    def cb(tx):
+        tx.create(make_task("t2", service_id="a"))
+        assert tx.get_task("t2") is not None
+        found = tx.find_tasks(by.ByServiceID("a"))
+        assert [t.id for t in found] == ["t1", "t2"]
+        tx.delete(Task, "t1")
+        assert tx.get_task("t1") is None
+        raise RuntimeError("abort")
+
+    with pytest.raises(RuntimeError):
+        s.update(cb)
+    # rollback: nothing committed
+    assert [t.id for t in s.view().find_tasks()] == ["t1"]
+
+
+def test_events_and_commit_event():
+    s = MemoryStore()
+    ch = s.watch_queue().watch()
+    s.update(lambda tx: tx.create(make_task("t1")))
+    ev = ch.get(timeout=1)
+    assert isinstance(ev, EventCreate) and ev.obj.id == "t1"
+    ev = ch.get(timeout=1)
+    assert isinstance(ev, EventCommit) and ev.version.index == 1
+
+    t = s.view(lambda tx: tx.get_task("t1")).copy()
+    t.node_id = "n9"
+    s.update(lambda tx: tx.update(t))
+    ev = ch.get(timeout=1)
+    assert isinstance(ev, EventUpdate) and ev.obj.node_id == "n9" and ev.old.node_id == ""
+    ch.get(timeout=1)  # commit
+
+    s.update(lambda tx: tx.delete(Task, "t1"))
+    ev = ch.get(timeout=1)
+    assert isinstance(ev, EventDelete)
+
+
+def test_view_and_watch_atomic():
+    s = MemoryStore()
+    s.update(lambda tx: tx.create(make_task("t1")))
+    snapshot, ch = s.view_and_watch(lambda tx: [t.id for t in tx.find_tasks()])
+    assert snapshot == ["t1"]
+    s.update(lambda tx: tx.create(make_task("t2")))
+    ev = ch.get(timeout=1)
+    assert isinstance(ev, EventCreate) and ev.obj.id == "t2"
+
+
+def test_batch_splits_transactions():
+    s = MemoryStore()
+    ch = s.watch_queue().watch(matcher=lambda e: isinstance(e, EventCommit))
+    n = MAX_CHANGES_PER_TRANSACTION + 50
+
+    def cb(batch: Batch):
+        for i in range(n):
+            batch.update(lambda tx, i=i: tx.create(make_task(f"t{i:05d}")))
+
+    s.batch(cb)
+    assert len(s.view().find_tasks()) == n
+    commits = []
+    while True:
+        try:
+            commits.append(ch.get(timeout=0.1))
+        except TimeoutError:
+            break
+    assert len(commits) == 2  # 200 + 50
+
+
+def test_snapshot_roundtrip():
+    s = MemoryStore()
+    s.update(lambda tx: tx.create(make_task("t1")))
+    s.update(lambda tx: tx.create(Node(id="n1")))
+    snap = s.save()
+    s2 = MemoryStore()
+    s2.restore(snap)
+    assert s2.view(lambda tx: tx.get_task("t1")) is not None
+    assert s2.view(lambda tx: tx.get_node("n1")) is not None
+    assert s2.version.index >= s.view(lambda tx: tx.get_task("t1")).meta.version.index
+
+
+def test_proposer_drives_commit():
+    p = LocalProposer()
+    s = MemoryStore(proposer=p)
+    s.update(lambda tx: tx.create(make_task("t1")))
+    assert s.view(lambda tx: tx.get_task("t1")) is not None
+    assert p.get_version().index == 1
+    changes = p.changes_between(type(p.get_version())(0), p.get_version())
+    assert len(changes) == 1
+
+
+def test_apply_store_actions_replay():
+    """Follower replay path: actions from one store applied to another."""
+    p = LocalProposer()
+    s = MemoryStore(proposer=p)
+    follower = MemoryStore()
+    s.update(lambda tx: tx.create(make_task("t1")))
+    t = s.view(lambda tx: tx.get_task("t1")).copy()
+    t.node_id = "n1"
+    s.update(lambda tx: tx.update(t))
+    for _, actions in p._log:
+        follower.apply_store_actions(actions)
+    got = follower.view(lambda tx: tx.get_task("t1"))
+    assert got is not None and got.node_id == "n1"
+
+
+def test_concurrent_updates():
+    s = MemoryStore()
+    errs = []
+
+    def writer(k):
+        try:
+            for i in range(50):
+                s.update(lambda tx, k=k, i=i: tx.create(make_task(f"t-{k}-{i}")))
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer, args=(k,)) for k in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errs
+    assert len(s.view().find_tasks()) == 200
+
+
+def test_slow_subscriber_closed_not_blocking():
+    s = MemoryStore()
+    ch = s.watch_queue().watch(limit=5)
+    for i in range(10):
+        s.update(lambda tx, i=i: tx.create(make_task(f"t{i}")))
+    # publisher never blocked; channel eventually closed
+    from swarmkit_tpu.store.watch import ChannelClosed
+    with pytest.raises(ChannelClosed):
+        while True:
+            ch.get(timeout=0.1)
