@@ -1,0 +1,205 @@
+"""Batched task×node placement kernel.
+
+This is the TPU execution of the reference's scheduler hot loop
+(manager/scheduler/scheduler.go:694-921 + the filter chain of filter.go),
+re-architected per SURVEY.md §7: instead of per-(task, node) Go string
+compares, one jitted program computes
+
+  1. a dense static eligibility mask[G, N] — ready ∧ constraints ∧ platform ∧
+     plugins ∧ host-corrections — from interned int tables;
+  2. a `lax.scan` over task groups, each step water-filling the group's tasks
+     over eligible nodes with per-node dynamic capacity (resource depletion,
+     max-replicas, host-port exclusivity) under the canonical spread order
+     (penalty, svc_count, total_count, node_idx);
+
+and returns per-(group, node) assignment counts that are bit-identical to the
+greedy CPU oracle (`swarmkit_tpu.scheduler.spread.greedy_fill`) — the proof
+is that greedy with uniform (+1,+1) key increments consumes exactly the
+globally smallest slots of the merged per-node slot sequences, which is what
+the closed-form water level computes.
+
+Sharding: every per-node array is shardable on its N axis; see
+`swarmkit_tpu.parallel.sharded_placement` for the multi-chip wrapper.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..scheduler.spread import PENALTY_BASE
+
+UNLIMITED = 1 << 30  # plain int: keep module import free of backend init
+_LEVEL_BITS = 24  # binary-search range for the water level; see kernel note
+
+
+def build_static_mask(
+    ready,        # bool[N]
+    node_val,     # int32[N, K]
+    node_plat,    # int32[N, 2]
+    node_plugins, # bool[N, PL]
+    constraints,  # int32[G, C, 3]
+    plat_req,     # int32[G, P, 2]
+    req_plugins,  # bool[G, PL]
+    extra_mask,   # bool[G, N]
+):
+    """Fused eligibility mask[G, N]. Pure elementwise/gather work — XLA fuses
+    this into a handful of kernels; the matmul-shaped plugin check rides the
+    MXU when PL is large."""
+    G = constraints.shape[0]
+    N = node_val.shape[0]
+
+    # Constraints: gather each group's key columns from every node.
+    cols = jnp.clip(constraints[:, :, 0], 0)            # [G, C]
+    ops = constraints[:, :, 1]                           # [G, C]
+    vals = constraints[:, :, 2]                          # [G, C]
+    padded = constraints[:, :, 0] < 0                    # [G, C]
+    nv = node_val[:, cols]                               # [N, G, C]
+    hit = nv == vals[None, :, :]                         # [N, G, C]
+    ok = jnp.where(ops[None] == 0, hit, ~hit)            # == vs !=
+    cons_ok = jnp.all(ok | padded[None], axis=2)         # [N, G]
+    cons_ok = cons_ok.T                                  # [G, N]
+
+    # Platforms: any requested row matches; wildcard id 0; pad rows -2.
+    pr = plat_req                                        # [G, P, 2]
+    row_valid = pr[:, :, 0] > -2                         # [G, P]
+    has_plat = jnp.any(row_valid, axis=1)                # [G]
+    os_ok = (pr[:, :, 0][:, :, None] == 0) | (
+        pr[:, :, 0][:, :, None] == node_plat[:, 0][None, None, :])
+    arch_ok = (pr[:, :, 1][:, :, None] == 0) | (
+        pr[:, :, 1][:, :, None] == node_plat[:, 1][None, None, :])
+    plat_hit = jnp.any(os_ok & arch_ok & row_valid[:, :, None], axis=1)  # [G, N]
+    plat_ok = jnp.where(has_plat[:, None], plat_hit, True)
+
+    # Plugins: fail when any required plugin is absent on the node.
+    missing = jnp.einsum(
+        "gp,np->gn", req_plugins.astype(jnp.float32),
+        (~node_plugins).astype(jnp.float32),
+        preferred_element_type=jnp.float32) > 0.5
+    plug_ok = ~missing
+
+    return ready[None, :] & cons_ok & plat_ok & plug_ok & extra_mask
+
+
+def _water_fill(eligible, capacity, penalty, svc, total, n_tasks):
+    """Closed-form canonical spread fill of one group. All inputs per-node.
+
+    Returns int32[N] counts. Level search runs 2*_LEVEL_BITS fixed bisection
+    steps over [0, 2^24): the primary key k = penalty*2^20 + svc stays below
+    2^21 as long as no single node holds >2^20 active tasks of one service,
+    and k + T < 2^24 for T up to ~6M tasks per group.
+    """
+    N = eligible.shape[0]
+    # Clamp per-node capacity by the group's task count: a node can never
+    # receive more than n_tasks, and the clamp keeps sum(cap) (and filled())
+    # inside int32 — the kernel's documented bound is n_tasks × N < 2^31.
+    cap = jnp.minimum(jnp.where(eligible, capacity, 0), n_tasks).astype(jnp.int32)
+    k = (jnp.where(penalty, PENALTY_BASE, 0) + svc).astype(jnp.int32)
+    total_cap = jnp.sum(cap)
+    T = jnp.minimum(n_tasks, total_cap).astype(jnp.int32)
+
+    def filled(L):
+        return jnp.sum(jnp.minimum(cap, jnp.maximum(0, L - k)))
+
+    # largest L with filled(L) <= T
+    def bisect(state, _):
+        lo, hi = state
+        mid = (lo + hi + 1) // 2
+        take = filled(mid) <= T
+        return (jnp.where(take, mid, lo), jnp.where(take, hi, mid - 1)), None
+
+    (L, _), _ = lax.scan(bisect, (jnp.int32(0), jnp.int32(1 << _LEVEL_BITS)),
+                         None, length=_LEVEL_BITS + 1)
+    counts = jnp.minimum(cap, jnp.maximum(0, L - k))
+    rem = T - jnp.sum(counts)
+
+    # boundary slots at primary == L, ordered by (total+counts, node_idx)
+    boundary = eligible & (cap > counts) & (k <= L) & (counts == L - k)
+    sec = jnp.where(boundary, total + counts, UNLIMITED)
+    idx = jnp.arange(N, dtype=jnp.int32)
+    order = jnp.lexsort((idx, sec))
+    rank = jnp.zeros(N, jnp.int32).at[order].set(idx)
+    extra = boundary & (rank < rem)
+    return counts + extra.astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("unroll",))
+def schedule_groups(
+    ready, node_val, node_plat, node_plugins, extra_mask,
+    constraints, plat_req, req_plugins,
+    avail_res,      # int32[N, R]
+    total0,         # int32[N]
+    svc_count0,     # int32[S, N]
+    n_tasks,        # int32[G]
+    svc_idx,        # int32[G]
+    need_res,       # int32[G, R]
+    max_replicas,   # int32[G]
+    penalty,        # bool[G, N]
+    has_ports,      # bool[G]
+    group_ports,    # bool[G, PV]
+    port_used0,     # bool[N, PV]
+    unroll: int = 1,
+):
+    """Schedule every group sequentially (groups interact through node state),
+    each step fully data-parallel over nodes. Returns
+    (counts[G, N], totals[N], svc_counts[S, N])."""
+    static_mask = build_static_mask(
+        ready, node_val, node_plat, node_plugins,
+        constraints, plat_req, req_plugins, extra_mask)
+
+    def step(carry, xs):
+        totals, svc_counts, avail, port_used = carry
+        g_mask, g_need, g_ntasks, g_svc, g_maxrep, g_pen, g_hasports, g_ports = xs
+
+        svc = svc_counts[g_svc]                                    # [N]
+
+        # dynamic capacity: resources
+        need = jnp.maximum(g_need, 1)                              # avoid /0
+        caps = jnp.where(g_need[None, :] > 0, avail // need[None, :], UNLIMITED)
+        cap_res = jnp.min(caps, axis=1)                            # [N]
+        # max replicas
+        cap_mr = jnp.where(g_maxrep > 0, g_maxrep - svc, UNLIMITED)
+        # host ports: at most one task of a port-publishing group per node,
+        # and only when none of its ports are already taken
+        conflict = jnp.any(g_ports[None, :] & port_used, axis=1)   # [N]
+        cap_port = jnp.where(g_hasports,
+                             jnp.where(conflict, 0, 1), UNLIMITED)
+        cap = jnp.clip(jnp.minimum(jnp.minimum(cap_res, cap_mr), cap_port),
+                       0, UNLIMITED)
+
+        counts = _water_fill(g_mask, cap, g_pen, svc, totals, g_ntasks)
+
+        totals = totals + counts
+        svc_counts = svc_counts.at[g_svc].add(counts)
+        avail = avail - counts[:, None] * g_need[None, :]
+        port_used = port_used | (g_ports[None, :] & (counts > 0)[:, None])
+        return (totals, svc_counts, avail, port_used), counts
+
+    (totals, svc_counts, _, _), counts = lax.scan(
+        step,
+        (total0, svc_count0, avail_res, port_used0),
+        (static_mask, need_res, n_tasks, svc_idx, max_replicas,
+         penalty, has_ports, group_ports),
+        unroll=unroll,
+    )
+    return counts, totals, svc_counts
+
+
+def schedule_encoded(p, backend=None):
+    """Run the kernel on an EncodedProblem; returns numpy counts[G, N]."""
+    args = (
+        jnp.asarray(p.ready), jnp.asarray(p.node_val), jnp.asarray(p.node_plat),
+        jnp.asarray(p.node_plugins), jnp.asarray(p.extra_mask),
+        jnp.asarray(p.constraints), jnp.asarray(p.plat_req),
+        jnp.asarray(p.req_plugins), jnp.asarray(p.avail_res),
+        jnp.asarray(p.total0), jnp.asarray(p.svc_count0),
+        jnp.asarray(p.n_tasks), jnp.asarray(p.svc_idx),
+        jnp.asarray(p.need_res), jnp.asarray(p.max_replicas),
+        jnp.asarray(p.penalty), jnp.asarray(p.has_ports),
+        jnp.asarray(p.group_ports), jnp.asarray(p.port_used0),
+    )
+    counts, totals, svc_counts = schedule_groups(*args)
+    import numpy as np
+    return np.asarray(counts)

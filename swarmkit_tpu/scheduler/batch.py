@@ -1,0 +1,127 @@
+"""Batched scheduling backends over an EncodedProblem.
+
+Both backends consume the same encoder output and the same canonical spread
+semantics; they differ only in the fill engine:
+
+  * `cpu_schedule_encoded` — numpy + greedy heap fill (the oracle);
+  * `ops.placement.schedule_encoded` — the jitted TPU water-fill kernel.
+
+Placement parity between them is the judged property (BASELINE.md north
+star). `materialize` turns per-(group, node) counts into the deterministic
+task→node map both backends share: a group's tasks, sorted by id, zip with
+the canonical slot order (spread.slot_order).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import placement as placement_ops
+from .encode import UNLIMITED, EncodedProblem
+from .spread import GroupFill, greedy_fill, slot_order
+
+
+def _group_caps(p: EncodedProblem, gi: int, avail: np.ndarray,
+                svc: np.ndarray, port_used: np.ndarray) -> np.ndarray:
+    """Dynamic per-node capacity for group gi — numpy mirror of the kernel's
+    step() capacity computation."""
+    N = avail.shape[0]
+    need = p.need_res[gi]
+    caps = np.full(N, UNLIMITED, np.int64)
+    for r in range(need.shape[0]):
+        if need[r] > 0:
+            caps = np.minimum(caps, avail[:, r] // need[r])
+    if p.max_replicas[gi] > 0:
+        caps = np.minimum(caps, p.max_replicas[gi] - svc)
+    if p.has_ports[gi]:
+        conflict = (p.group_ports[gi][None, :] & port_used).any(axis=1)
+        caps = np.minimum(caps, np.where(conflict, 0, 1))
+    return np.clip(caps, 0, UNLIMITED)
+
+
+def cpu_static_mask(p: EncodedProblem) -> np.ndarray:
+    """numpy mirror of ops.placement.build_static_mask."""
+    G, N = p.extra_mask.shape
+    cols = np.clip(p.constraints[:, :, 0], 0, None)
+    ops_ = p.constraints[:, :, 1]
+    vals = p.constraints[:, :, 2]
+    padded = p.constraints[:, :, 0] < 0
+    nv = p.node_val[:, cols]                       # [N, G, C]
+    hit = nv == vals[None]
+    ok = np.where(ops_[None] == 0, hit, ~hit)
+    cons_ok = np.all(ok | padded[None], axis=2).T  # [G, N]
+
+    pr = p.plat_req
+    row_valid = pr[:, :, 0] > -2
+    has_plat = row_valid.any(axis=1)
+    os_ok = (pr[:, :, 0][:, :, None] == 0) | (
+        pr[:, :, 0][:, :, None] == p.node_plat[:, 0][None, None, :])
+    arch_ok = (pr[:, :, 1][:, :, None] == 0) | (
+        pr[:, :, 1][:, :, None] == p.node_plat[:, 1][None, None, :])
+    plat_hit = (os_ok & arch_ok & row_valid[:, :, None]).any(axis=1)
+    plat_ok = np.where(has_plat[:, None], plat_hit, True)
+
+    missing = (p.req_plugins[:, None, :] & ~p.node_plugins[None, :, :]).any(axis=2)
+    return p.ready[None, :] & cons_ok & plat_ok & ~missing & p.extra_mask
+
+
+def cpu_schedule_encoded(p: EncodedProblem) -> np.ndarray:
+    """Sequential-groups greedy fill; returns counts int32[G, N]."""
+    G, N = p.extra_mask.shape
+    static_mask = cpu_static_mask(p)
+    totals = p.total0.astype(np.int64).copy()
+    svc_counts = p.svc_count0.astype(np.int64).copy()
+    avail = p.avail_res.astype(np.int64).copy()
+    port_used = p.port_used0.copy()
+    out = np.zeros((G, N), np.int32)
+    for gi in range(G):
+        svc = svc_counts[p.svc_idx[gi]]
+        caps = _group_caps(p, gi, avail, svc, port_used)
+        g = GroupFill(
+            n_tasks=int(p.n_tasks[gi]),
+            eligible=static_mask[gi].tolist(),
+            capacity=caps.tolist(),
+            penalty=p.penalty[gi].tolist(),
+            svc_count=svc.tolist(),
+            total_count=totals.tolist(),
+        )
+        counts = np.array(greedy_fill(g), np.int32)
+        out[gi] = counts
+        totals += counts
+        svc_counts[p.svc_idx[gi]] += counts
+        avail -= counts[:, None].astype(np.int64) * p.need_res[gi][None, :]
+        port_used |= p.group_ports[gi][None, :] & (counts > 0)[:, None]
+    return out
+
+
+def tpu_schedule_encoded(p: EncodedProblem) -> np.ndarray:
+    return placement_ops.schedule_encoded(p)
+
+
+def materialize(p: EncodedProblem, counts: np.ndarray) -> dict[str, str]:
+    """counts[G, N] → {task_id: node_id}, deterministic across backends.
+
+    Reconstructs each group's GroupFill view (penalty/svc/total at its turn in
+    the sequential order) to produce the canonical slot order, then zips with
+    the group's id-sorted tasks. Unplaced tasks (count shortfall) are absent
+    from the result and stay PENDING.
+    """
+    assignments: dict[str, str] = {}
+    totals = p.total0.astype(np.int64).copy()
+    svc_counts = p.svc_count0.astype(np.int64).copy()
+    for gi, group in enumerate(p.groups):
+        c = counts[gi]
+        svc = svc_counts[p.svc_idx[gi]]
+        g = GroupFill(
+            n_tasks=int(p.n_tasks[gi]),
+            eligible=[True] * len(p.node_ids),
+            capacity=c.tolist(),  # capacity unused by slot_order
+            penalty=p.penalty[gi].tolist(),
+            svc_count=svc.tolist(),
+            total_count=totals.tolist(),
+        )
+        order = slot_order(g, c.tolist())
+        for task, node_i in zip(group.tasks, order):
+            assignments[task.id] = p.node_ids[node_i]
+        totals += c
+        svc_counts[p.svc_idx[gi]] += c
+    return assignments

@@ -1,0 +1,132 @@
+"""Placement-constraint engine.
+
+Behavioral re-derivation of the reference's constraint package
+(manager/constraint/constraint.go). Grammar: `key == value` / `key != value`
+with `==`/`!=` the only operators; keys are matched case-insensitively
+(the reference key regex carries `(?i)`, constraint.go:23); values compare
+case-insensitively; label *names* are case-sensitive. A missing attribute
+behaves as the empty string, so `== x` fails and `!= x` succeeds.
+
+The same predicate is what `swarmkit_tpu.scheduler.encode` compiles to
+(key_id, op, value_id) triples for the batched TPU mask kernel.
+"""
+from __future__ import annotations
+
+import ipaddress
+import re
+from dataclasses import dataclass
+
+EQ = 0
+NOTEQ = 1
+
+NODE_LABEL_PREFIX = "node.labels."
+ENGINE_LABEL_PREFIX = "engine.labels."
+
+# reference: constraint.go:22-30 — alphanumeric key with (?i), glob-capable
+# value grammar (globbing is permitted by the grammar but not implemented by
+# the evaluator, matching constraint.go:70's behavior).
+_KEY_RE = re.compile(r"^(?i:[a-z_][a-z0-9\-_.]+)$")
+_VALUE_RE = re.compile(r"^(?i:[a-z0-9:\-_\s\.\*\(\)\?\+\[\]\\\^\$\|\/]+)$")
+
+
+class InvalidConstraint(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class Constraint:
+    key: str
+    operator: int  # EQ | NOTEQ
+    exp: str
+
+    def match(self, *candidates: str) -> bool:
+        """Case-insensitive full-string match (constraint.go:84-104)."""
+        hit = any(self.exp.lower() == c.lower() for c in candidates)
+        return hit if self.operator == EQ else not hit
+
+
+def parse(expressions: list[str]) -> list[Constraint]:
+    """reference: constraint.go:40-81."""
+    out: list[Constraint] = []
+    for expr in expressions:
+        if "==" in expr:
+            op = EQ
+            lhs, _, rhs = expr.partition("==")
+        elif "!=" in expr:
+            op = NOTEQ
+            lhs, _, rhs = expr.partition("!=")
+        else:
+            raise InvalidConstraint(f"invalid expression: {expr!r}")
+        key, value = lhs.strip(), rhs.strip()
+        if not key or not _KEY_RE.match(key):
+            raise InvalidConstraint(f"invalid key {key!r} in {expr!r}")
+        value = value.strip("\"'")
+        if not value or not _VALUE_RE.match(value):
+            raise InvalidConstraint(f"invalid value {value!r} in {expr!r}")
+        out.append(Constraint(key=key, operator=op, exp=value))
+    return out
+
+
+def node_attribute(node, key: str) -> tuple[str | None, list[str]]:
+    """Resolve a constraint key against a node. Returns (kind, candidates)
+    where kind is None for predefined keys, 'ip' for the IP special case.
+    Unknown keys return ('unknown', []) which always fails to match."""
+    lk = key.lower()
+    desc = getattr(node, "description", None)
+    if lk == "node.id":
+        return None, [node.id]
+    if lk == "node.hostname":
+        return None, [desc.hostname if desc else ""]
+    if lk == "node.ip":
+        return "ip", [node.status.addr or ""]
+    if lk == "node.role":
+        from ..api.types import NodeRole
+        return None, [NodeRole(node.role).name]
+    if lk == "node.platform.os":
+        return None, [(desc.platform.os if desc and desc.platform else "")]
+    if lk == "node.platform.arch":
+        return None, [(desc.platform.architecture if desc and desc.platform else "")]
+    if lk.startswith(NODE_LABEL_PREFIX) and len(key) > len(NODE_LABEL_PREFIX):
+        label = key[len(NODE_LABEL_PREFIX):]  # label name case-sensitive
+        labels = node.spec.annotations.labels or {}
+        return None, [labels.get(label, "")]
+    if lk.startswith(ENGINE_LABEL_PREFIX) and len(key) > len(ENGINE_LABEL_PREFIX):
+        label = key[len(ENGINE_LABEL_PREFIX):]
+        labels = (desc.engine_labels if desc else None) or {}
+        return None, [labels.get(label, "")]
+    return "unknown", []
+
+
+def _match_ip(constraint: Constraint, addr: str) -> bool:
+    """IP / CIDR matching (constraint.go:127-146)."""
+    try:
+        node_ip = ipaddress.ip_address(addr)
+    except ValueError:
+        node_ip = None
+    try:
+        ip = ipaddress.ip_address(constraint.exp)
+        eq = node_ip is not None and ip == node_ip
+        return eq if constraint.operator == EQ else not eq
+    except ValueError:
+        pass
+    try:
+        subnet = ipaddress.ip_network(constraint.exp, strict=True)
+        within = node_ip is not None and node_ip in subnet
+        return within if constraint.operator == EQ else not within
+    except ValueError:
+        return False  # malformed address/network rejects the node
+
+
+def node_matches(constraints: list[Constraint], node) -> bool:
+    """reference: constraint.go:107-207."""
+    for c in constraints:
+        kind, candidates = node_attribute(node, c.key)
+        if kind == "unknown":
+            return False
+        if kind == "ip":
+            if not _match_ip(c, candidates[0]):
+                return False
+            continue
+        if not c.match(*candidates):
+            return False
+    return True

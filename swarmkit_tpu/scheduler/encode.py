@@ -1,0 +1,357 @@
+"""Dictionary-encoding of cluster state into dense arrays for the TPU kernel.
+
+The reference's scheduler walks Go maps and compares strings per (task, node)
+pair (manager/scheduler/scheduler.go:694-921, filter.go). The TPU backend
+instead interns every string host-side — constraint keys/values, platforms,
+plugin names, host ports — into integer vocabularies, and ships dense int32
+tables to the device. All O(G×N) work (constraint matching, platform/plugin
+gating, spread water-fill) happens inside the jitted kernel
+(`swarmkit_tpu.ops.placement.schedule_groups`); host work is O(nodes + tasks).
+
+Quantization spec (part of this framework's scheduling semantics, applied to
+BOTH backends so they stay bit-identical):
+  * CPU  reservations → milli-cores, task needs rounded up, node capacity down;
+  * memory            → 4 KiB pages, same rounding;
+which guarantees the batched path never overcommits a node.
+
+Host-only predicates that don't reduce to interned-int equality (node.ip
+IP/CIDR math — reference constraint.go:127-146 — and unparseable constraint
+sets) are folded into a per-group `extra_mask` correction column, per
+SURVEY.md §7's guidance on strings/IP math.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..api.types import normalize_arch
+from . import constraint as constraint_mod
+from .filters import PluginFilter, ReadyFilter
+from .nodeinfo import NodeInfo
+
+UNLIMITED = 1 << 30
+OP_EQ = 0
+OP_NEQ = 1
+
+CPU_QUANTUM = 1_000_000      # nano-cpus per milli-core
+MEM_QUANTUM = 4096           # bytes per page
+
+
+class Vocab:
+    """String interner. id 0 is reserved for the empty string."""
+
+    def __init__(self):
+        self._ids: dict[str, int] = {"": 0}
+
+    def id(self, s: str) -> int:
+        return self._ids.setdefault(s, len(self._ids))
+
+    def lookup(self, s: str) -> int:
+        """-1 when unseen: an unseen node value can never equal a constraint
+        value id, and -1 != every valid id keeps != semantics right."""
+        return self._ids.get(s, -1)
+
+    def __len__(self):
+        return len(self._ids)
+
+
+@dataclass
+class TaskGroup:
+    """One (service_id, spec_version) scheduling group — all tasks identical."""
+
+    service_id: str
+    spec_version: int
+    tasks: list  # api.objects.Task, sorted by id
+
+    @property
+    def key(self) -> tuple[str, int]:
+        return (self.service_id, self.spec_version)
+
+    @property
+    def spec(self):
+        return self.tasks[0].spec
+
+
+@dataclass
+class EncodedProblem:
+    """Device-ready staging arrays (numpy, host)."""
+
+    node_ids: list[str]
+    group_keys: list[tuple[str, int]]
+    service_ids: list[str]
+    groups: list[TaskGroup] = field(repr=False, default_factory=list)
+
+    # node side
+    ready: np.ndarray = None          # bool[N]
+    avail_res: np.ndarray = None      # int32[N, R]
+    total0: np.ndarray = None         # int32[N]
+    svc_count0: np.ndarray = None     # int32[S, N]
+    node_val: np.ndarray = None       # int32[N, K] interned value per key col
+    node_plat: np.ndarray = None      # int32[N, 2] (os_id, arch_id)
+    node_plugins: np.ndarray = None   # bool[N, PL]
+    port_used0: np.ndarray = None     # bool[N, PV]
+
+    # group side
+    n_tasks: np.ndarray = None        # int32[G]
+    svc_idx: np.ndarray = None        # int32[G]
+    need_res: np.ndarray = None       # int32[G, R]
+    max_replicas: np.ndarray = None   # int32[G]; 0 == unlimited
+    constraints: np.ndarray = None    # int32[G, C, 3] (key_col, op, val); col<0 pad
+    plat_req: np.ndarray = None       # int32[G, P, 2]; (-2,-2) pad row; 0 wildcard
+    req_plugins: np.ndarray = None    # bool[G, PL]
+    has_ports: np.ndarray = None      # bool[G]
+    group_ports: np.ndarray = None    # bool[G, PV]
+    penalty: np.ndarray = None        # bool[G, N]
+    extra_mask: np.ndarray = None     # bool[G, N] host-side corrections
+
+
+_INT32_MAX = (1 << 31) - 1
+
+
+def quantize_need(res) -> tuple[int, int]:
+    cpu = -(-res.nano_cpus // CPU_QUANTUM) if res.nano_cpus > 0 else 0
+    mem = -(-res.memory_bytes // MEM_QUANTUM) if res.memory_bytes > 0 else 0
+    return min(cpu, _INT32_MAX), min(mem, _INT32_MAX)
+
+
+def quantize_avail(res) -> tuple[int, int]:
+    cpu = max(res.nano_cpus // CPU_QUANTUM, 0)
+    mem = max(res.memory_bytes // MEM_QUANTUM, 0)
+    return min(cpu, _INT32_MAX), min(mem, _INT32_MAX)
+
+
+def _canon_value(key_lower: str, value: str) -> str:
+    """Comparable form of an attribute value: case-folded (the reference
+    compares case-insensitively, constraint.go:84-104). node.ip never reaches
+    here — IP/CIDR math stays host-side in extra_mask."""
+    return value.lower()
+
+
+_PREDEFINED_KEYS = {
+    "node.id", "node.hostname", "node.role",
+    "node.platform.os", "node.platform.arch",
+}
+
+
+def _canon_key(key: str) -> str | None:
+    """Canonical vocab form of a constraint key: predefined keys case-fold
+    whole; label keys case-fold only the prefix — label *names* stay
+    case-sensitive (reference constraint.go:175 'label itself is case
+    sensitive'). None == unknown key, which matches no node regardless of
+    operator (constraint.go default case)."""
+    lk = key.lower()
+    if lk in _PREDEFINED_KEYS or lk == "node.ip":
+        return lk
+    for prefix in (constraint_mod.NODE_LABEL_PREFIX,
+                   constraint_mod.ENGINE_LABEL_PREFIX):
+        if lk.startswith(prefix) and len(key) > len(prefix):
+            return prefix + key[len(prefix):]
+    return None
+
+
+def encode(
+    node_infos: list[NodeInfo],
+    groups: list[TaskGroup],
+    now: float | None = None,
+    max_constraints: int = 8,
+    max_platforms: int = 4,
+) -> EncodedProblem:
+    node_infos = sorted(node_infos, key=lambda i: i.node.id)
+    groups = sorted(groups, key=lambda g: g.key)
+    N, G = len(node_infos), len(groups)
+
+    p = EncodedProblem(
+        node_ids=[i.node.id for i in node_infos],
+        group_keys=[g.key for g in groups],
+        service_ids=sorted({g.service_id for g in groups}),
+        groups=groups,
+    )
+    svc_row = {s: i for i, s in enumerate(p.service_ids)}
+    S = max(len(p.service_ids), 1)
+
+    # ------------------------------------------------ parse group constraints
+    parsed: list[list[constraint_mod.Constraint] | None] = []
+    for g in groups:
+        exprs = g.spec.placement.constraints
+        if not exprs:
+            parsed.append([])
+            continue
+        try:
+            parsed.append(constraint_mod.parse(exprs))
+        except constraint_mod.InvalidConstraint:
+            parsed.append(None)  # unparseable → group matches nothing
+
+    # ---------------------------------------------------------- vocabularies
+    key_vocab: dict[str, int] = {}     # lowered constraint key -> column
+    val_vocab = Vocab()
+    plugin_vocab = Vocab()
+    port_vocab = Vocab()
+    os_vocab, arch_vocab = Vocab(), Vocab()
+
+    for cs in parsed:
+        for c in cs or []:
+            ck = _canon_key(c.key)
+            if ck is None or ck == "node.ip":
+                continue  # unknown → extra_mask; node.ip → host-side
+            key_vocab.setdefault(ck, len(key_vocab))
+            val_vocab.id(_canon_value(ck, c.exp))
+
+    plugin_filter = PluginFilter()
+    group_plugin_reqs: list[list[int]] = []
+    for g in groups:
+        reqs: list[int] = []
+        if plugin_filter.set_task(g.tasks[0]):
+            for drv in plugin_filter._volume_drivers:
+                reqs.append(plugin_vocab.id(f"Volume/{drv}"))
+            for drv in plugin_filter._network_drivers:
+                reqs.append(plugin_vocab.id(f"Network/{drv}"))
+            if plugin_filter._log_driver:
+                reqs.append(plugin_vocab.id(f"Log/{plugin_filter._log_driver}"))
+        group_plugin_reqs.append(reqs)
+
+    group_port_lists: list[list[int]] = []
+    for g in groups:
+        ports = []
+        endpoint = getattr(g.tasks[0], "endpoint", None)
+        spec_ports = endpoint.ports if endpoint else []
+        for pc in spec_ports:
+            if pc.publish_mode == "host" and pc.published_port != 0:
+                ports.append(port_vocab.id(f"{pc.protocol}:{pc.published_port}"))
+        group_port_lists.append(ports)
+
+    K = max(len(key_vocab), 1)
+    PL = max(len(plugin_vocab), 1)
+    PV = max(len(port_vocab), 1)
+
+    # ------------------------------------------------------- node-side tables
+    p.ready = np.zeros(N, bool)
+    p.total0 = np.zeros(N, np.int32)
+    p.node_val = np.full((N, K), -1, np.int32)
+    p.node_plat = np.zeros((N, 2), np.int32)
+    p.node_plugins = np.zeros((N, PL), bool)
+    p.port_used0 = np.zeros((N, PV), bool)
+
+    kinds = sorted({k for g in groups for k in g.spec.resources.reservations.generic})
+    R = 2 + len(kinds)
+    p.avail_res = np.zeros((N, R), np.int32)
+    p.svc_count0 = np.zeros((S, N), np.int32)
+
+    rf = ReadyFilter()
+    default_plugin_ids = [
+        plugin_vocab.lookup(f"{t}/{n}") for t, n in PluginFilter.DEFAULT_PLUGINS
+    ]
+    for n, info in enumerate(node_infos):
+        p.ready[n] = rf.check(info)
+        p.total0[n] = info.active_tasks_count
+        cpu, mem = quantize_avail(info.available_resources)
+        p.avail_res[n, 0], p.avail_res[n, 1] = cpu, mem
+        for j, kind in enumerate(kinds):
+            have = info.available_resources.generic.get(kind, 0)
+            have += len(info.available_resources.named_generic.get(kind, ()))
+            p.avail_res[n, 2 + j] = have
+        for s, cnt in info.active_tasks_count_by_service.items():
+            row = svc_row.get(s)
+            if row is not None:
+                p.svc_count0[row, n] = cnt
+        for ck, col in key_vocab.items():
+            kind_, candidates = constraint_mod.node_attribute(info.node, ck)
+            if kind_ == "unknown":  # unreachable for canonical keys; guard
+                p.node_val[n, col] = -1
+            else:
+                p.node_val[n, col] = val_vocab.lookup(
+                    _canon_value(ck, candidates[0]))
+        desc = info.node.description
+        if desc and desc.platform:
+            p.node_plat[n, 0] = os_vocab.id(desc.platform.os.lower())
+            p.node_plat[n, 1] = arch_vocab.id(normalize_arch(desc.platform.architecture))
+        for t, name in (desc.plugins if desc else []):
+            pid = plugin_vocab.lookup(f"{t}/{name}")
+            if pid >= 0:
+                p.node_plugins[n, pid] = True
+        for pid in default_plugin_ids:
+            if pid >= 0:
+                p.node_plugins[n, pid] = True
+        for proto, port in info.used_host_ports:
+            pid = port_vocab.lookup(f"{proto}:{port}")
+            if pid >= 0:
+                p.port_used0[n, pid] = True
+
+    # ------------------------------------------------------ group-side tables
+    p.n_tasks = np.array([len(g.tasks) for g in groups] or [], np.int32).reshape(G)
+    p.svc_idx = np.array([svc_row[g.service_id] for g in groups] or [],
+                         np.int32).reshape(G)
+    p.need_res = np.zeros((G, R), np.int32)
+    p.max_replicas = np.zeros(G, np.int32)
+    C = max_constraints
+    p.constraints = np.full((G, C, 3), -1, np.int32)
+    p.plat_req = np.full((G, max_platforms, 2), -2, np.int32)
+    p.req_plugins = np.zeros((G, PL), bool)
+    p.has_ports = np.zeros(G, bool)
+    p.group_ports = np.zeros((G, PV), bool)
+    p.penalty = np.zeros((G, N), bool)
+    p.extra_mask = np.ones((G, N), bool)
+
+    group_row = {g.key: i for i, g in enumerate(groups)}
+
+    for gi, g in enumerate(groups):
+        res = g.spec.resources.reservations
+        cpu, mem = quantize_need(res)
+        p.need_res[gi, 0], p.need_res[gi, 1] = cpu, mem
+        for j, kind in enumerate(kinds):
+            p.need_res[gi, 2 + j] = res.generic.get(kind, 0)
+        p.max_replicas[gi] = g.spec.placement.max_replicas
+
+        cs = parsed[gi]
+        if cs is None:
+            p.extra_mask[gi, :] = False
+        else:
+            ci = 0
+            for c in cs:
+                ck = _canon_key(c.key)
+                if ck is None:
+                    # unknown key matches no node, regardless of operator
+                    # (reference constraint.go default case)
+                    p.extra_mask[gi, :] = False
+                    continue
+                if ck == "node.ip":
+                    for n, info in enumerate(node_infos):
+                        if not constraint_mod._match_ip(
+                                c, info.node.status.addr or ""):
+                            p.extra_mask[gi, n] = False
+                    continue
+                if ci >= C:
+                    # overflow constraints evaluated host-side (rare)
+                    for n, info in enumerate(node_infos):
+                        _, cands = constraint_mod.node_attribute(info.node, ck)
+                        if not c.match(*cands):
+                            p.extra_mask[gi, n] = False
+                    continue
+                p.constraints[gi, ci] = (
+                    key_vocab[ck],
+                    OP_EQ if c.operator == constraint_mod.EQ else OP_NEQ,
+                    val_vocab.lookup(_canon_value(ck, c.exp)),
+                )
+                ci += 1
+
+        platforms = g.spec.placement.platforms
+        for pi, plat in enumerate(platforms[:max_platforms]):
+            wos = plat.os.lower()
+            warch = normalize_arch(plat.architecture) if plat.architecture else ""
+            p.plat_req[gi, pi, 0] = os_vocab.lookup(wos) if wos else 0
+            p.plat_req[gi, pi, 1] = arch_vocab.lookup(warch) if warch else 0
+
+        for pid in group_plugin_reqs[gi]:
+            p.req_plugins[gi, pid] = True
+        for pid in group_port_lists[gi]:
+            p.group_ports[gi, pid] = True
+        p.has_ports[gi] = bool(group_port_lists[gi])
+
+    # penalties: only iterate nodes that actually recorded failures
+    for n, info in enumerate(node_infos):
+        for skey in list(info.recent_failures):
+            gi = group_row.get(skey)
+            if gi is not None and info.penalized(skey, now):
+                p.penalty[gi, n] = True
+
+    return p

@@ -1,0 +1,271 @@
+"""The scheduler's per-node gate: the filter pipeline.
+
+Behavioral re-derivation of manager/scheduler/filter.go + pipeline.go.
+Each filter declares whether it's enabled for a task (`set_task`) and then
+gates candidate nodes (`check`). `Pipeline.process` short-circuits on the
+first failing filter and tallies per-filter failure counts so `explain` can
+produce the reference's "no suitable node" message ordering
+(pipeline.go:84-103 sorts by failure count).
+
+This chain is the exact boolean column set the TPU backend fuses into one
+(task_group × node) mask kernel (swarmkit_tpu/ops/placement.py); the CPU
+implementation here is the parity oracle.
+"""
+from __future__ import annotations
+
+from typing import Protocol
+
+from ..api.types import NodeAvailability, NodeStatusState, normalize_arch
+from . import constraint as constraint_mod
+from .nodeinfo import NodeInfo
+
+
+class Filter(Protocol):
+    def set_task(self, task) -> bool: ...
+    def check(self, node: NodeInfo) -> bool: ...
+    def explain(self, nodes: int) -> str: ...
+
+
+class ReadyFilter:
+    """reference: filter.go:31-51."""
+
+    def set_task(self, task) -> bool:
+        return True
+
+    def check(self, node: NodeInfo) -> bool:
+        n = node.node
+        return (n.status.state == NodeStatusState.READY
+                and n.spec.availability == NodeAvailability.ACTIVE)
+
+    def explain(self, nodes: int) -> str:
+        return "1 node not available for new tasks" if nodes == 1 else (
+            f"{nodes} nodes not available for new tasks")
+
+
+class ResourceFilter:
+    """reference: filter.go:55-101."""
+
+    def set_task(self, task) -> bool:
+        r = task.spec.resources.reservations
+        self._res = r
+        return bool(r.nano_cpus or r.memory_bytes or r.generic)
+
+    def check(self, node: NodeInfo) -> bool:
+        avail = node.available_resources
+        if self._res.nano_cpus > avail.nano_cpus:
+            return False
+        if self._res.memory_bytes > avail.memory_bytes:
+            return False
+        for kind, qty in self._res.generic.items():
+            have = avail.generic.get(kind, 0) + len(avail.named_generic.get(kind, ()))
+            if qty > have:
+                return False
+        return True
+
+    def explain(self, nodes: int) -> str:
+        if nodes == 1:
+            return "insufficient resources on 1 node"
+        return f"insufficient resources on {nodes} nodes"
+
+
+class PluginFilter:
+    """Volume/network/log drivers must exist on the node (filter.go:104-216).
+
+    Node plugins are (type, name) pairs in NodeDescription.plugins; the
+    implicit default engine plugins are always considered present.
+    """
+
+    DEFAULT_PLUGINS = {("Volume", "local"), ("Network", "bridge"),
+                       ("Network", "host"), ("Network", "overlay"),
+                       ("Log", "json-file")}
+
+    def set_task(self, task) -> bool:
+        self._volume_drivers: set[str] = set()
+        self._network_drivers: set[str] = set()
+        self._log_driver: str | None = None
+        runtime = task.spec.runtime
+        if runtime is not None:
+            for m in runtime.mounts:
+                # mounts carry "driver/source" convention; plain sources use
+                # the default local driver
+                if "/" in m.source:
+                    self._volume_drivers.add(m.source.split("/", 1)[0])
+        for net in task.networks or []:
+            drv = getattr(net, "driver", None)
+            if drv:
+                self._network_drivers.add(drv)
+        if task.spec.log_driver:
+            self._log_driver = task.spec.log_driver.get("name")
+        return bool(self._volume_drivers or self._network_drivers or self._log_driver)
+
+    def check(self, node: NodeInfo) -> bool:
+        desc = node.node.description
+        plugins = set(desc.plugins) if desc else set()
+        plugins |= self.DEFAULT_PLUGINS
+        for drv in self._volume_drivers:
+            if ("Volume", drv) not in plugins:
+                return False
+        for drv in self._network_drivers:
+            if ("Network", drv) not in plugins:
+                return False
+        if self._log_driver and ("Log", self._log_driver) not in plugins:
+            return False
+        return True
+
+    def explain(self, nodes: int) -> str:
+        if nodes == 1:
+            return "missing plugin on 1 node"
+        return f"missing plugin on {nodes} nodes"
+
+
+class ConstraintFilter:
+    """reference: filter.go:219-251."""
+
+    def set_task(self, task) -> bool:
+        exprs = task.spec.placement.constraints
+        if not exprs:
+            return False
+        try:
+            self._constraints = constraint_mod.parse(exprs)
+        except constraint_mod.InvalidConstraint:
+            self._constraints = None  # unparseable → filter everything
+        return True
+
+    def check(self, node: NodeInfo) -> bool:
+        if self._constraints is None:
+            return False
+        return constraint_mod.node_matches(self._constraints, node.node)
+
+    def explain(self, nodes: int) -> str:
+        if nodes == 1:
+            return "scheduling constraints not satisfied on 1 node"
+        return f"scheduling constraints not satisfied on {nodes} nodes"
+
+
+class PlatformFilter:
+    """reference: filter.go:254-320 (with x86_64→amd64, aarch64→arm64)."""
+
+    def set_task(self, task) -> bool:
+        self._platforms = task.spec.placement.platforms
+        return bool(self._platforms)
+
+    def check(self, node: NodeInfo) -> bool:
+        desc = node.node.description
+        if desc is None or desc.platform is None:
+            return False
+        node_os = desc.platform.os.lower()
+        node_arch = normalize_arch(desc.platform.architecture)
+        for p in self._platforms:
+            want_os = p.os.lower()
+            want_arch = normalize_arch(p.architecture) if p.architecture else ""
+            # empty fields act as wildcards (reference behavior)
+            if (not want_os or want_os == node_os) and (
+                    not want_arch or want_arch == node_arch):
+                return True
+        return False
+
+    def explain(self, nodes: int) -> str:
+        if nodes == 1:
+            return "unsupported platform on 1 node"
+        return f"unsupported platform on {nodes} nodes"
+
+
+class HostPortFilter:
+    """reference: filter.go:323-361."""
+
+    def set_task(self, task) -> bool:
+        self._ports: list[tuple[str, int]] = []
+        endpoint = getattr(task, "endpoint", None)
+        spec_ports = []
+        if endpoint is not None:
+            spec_ports = endpoint.ports
+        for p in spec_ports:
+            if p.publish_mode == "host" and p.published_port != 0:
+                self._ports.append((p.protocol, p.published_port))
+        return bool(self._ports)
+
+    def check(self, node: NodeInfo) -> bool:
+        return not any(p in node.used_host_ports for p in self._ports)
+
+    def explain(self, nodes: int) -> str:
+        if nodes == 1:
+            return "host-mode port already in use on 1 node"
+        return f"host-mode port already in use on {nodes} nodes"
+
+
+class MaxReplicasFilter:
+    """reference: filter.go:364-386."""
+
+    def set_task(self, task) -> bool:
+        self._task = task
+        return task.spec.placement.max_replicas > 0
+
+    def check(self, node: NodeInfo) -> bool:
+        return (node.active_tasks_count_by_service.get(self._task.service_id, 0)
+                < self._task.spec.placement.max_replicas)
+
+    def explain(self, nodes: int) -> str:
+        if nodes == 1:
+            return "max replicas per node limit exceed on 1 node"
+        return f"max replicas per node limit exceed on {nodes} nodes"
+
+
+class VolumesFilter:
+    """CSI volume availability (filter.go:388-447). Full topology-aware
+    matching lives in scheduler/volumes.py; when no volume set is wired in,
+    tasks that mount CSI ("group/…" prefixed cluster) volumes pass trivially."""
+
+    def __init__(self, volume_set=None):
+        self._vs = volume_set
+
+    def set_task(self, task) -> bool:
+        self._task = task
+        if self._vs is None:
+            return False
+        runtime = task.spec.runtime
+        mounts = runtime.mounts if runtime else []
+        return any(m.source for m in mounts)
+
+    def check(self, node: NodeInfo) -> bool:
+        return self._vs.check_volumes_on_node(node, self._task)
+
+    def explain(self, nodes: int) -> str:
+        if nodes == 1:
+            return "cannot fulfill requested volumes on 1 node"
+        return f"cannot fulfill requested volumes on {nodes} nodes"
+
+
+DEFAULT_FILTERS = (
+    ReadyFilter, ResourceFilter, PluginFilter, ConstraintFilter,
+    PlatformFilter, HostPortFilter, MaxReplicasFilter,
+)
+
+
+class Pipeline:
+    """reference: pipeline.go:9-103."""
+
+    def __init__(self, volume_set=None):
+        self._filters: list[Filter] = [f() for f in DEFAULT_FILTERS]
+        self._filters.append(VolumesFilter(volume_set))
+        self._enabled: list[Filter] = []
+        self._failures: dict[Filter, int] = {}
+
+    def set_task(self, task) -> None:
+        self._enabled = [f for f in self._filters if f.set_task(task)]
+        self._failures = {f: 0 for f in self._enabled}
+
+    def process(self, node: NodeInfo) -> bool:
+        for f in self._enabled:
+            if not f.check(node):
+                self._failures[f] += 1
+                return False
+        return True
+
+    def explain(self) -> str:
+        if not any(self._failures.values()):
+            return ""
+        parts = sorted(
+            ((count, f) for f, count in self._failures.items() if count),
+            key=lambda pair: (-pair[0], type(pair[1]).__name__),
+        )
+        return "; ".join(f.explain(count) for count, f in parts)

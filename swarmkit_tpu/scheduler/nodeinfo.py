@@ -1,0 +1,172 @@
+"""Per-node scheduling bookkeeping.
+
+Behavioral re-derivation of the reference's NodeInfo
+(manager/scheduler/nodeinfo.go): running task maps, active counts used by the
+spread comparator, available-resource accounting, host-port usage, and the
+recent-failure ring that downweights flaky nodes
+(manager/scheduler/scheduler.go:16-24 — ≥5 failures within 5 minutes).
+
+These same quantities are exactly the per-node columns of the dense arrays the
+TPU backend consumes (`swarmkit_tpu.scheduler.encode`).
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from ..api.objects import Node, Task
+from ..api.specs import Resources
+from ..api.types import TaskState
+
+MAX_FAILURES = 5
+FAILURE_WINDOW = 5 * 60.0  # seconds
+
+
+def task_reservations(spec) -> Resources:
+    return spec.resources.reservations
+
+
+@dataclass
+class NodeInfo:
+    node: Node
+    tasks: dict[str, Task] = field(default_factory=dict)
+    active_tasks_count: int = 0
+    active_tasks_count_by_service: dict[str, int] = field(default_factory=dict)
+    available_resources: Resources = field(default_factory=Resources)
+    used_host_ports: set[tuple[str, int]] = field(default_factory=set)
+    # task id -> {kind: (named ids granted, discrete count granted)}
+    generic_assignments: dict[str, dict[str, tuple[frozenset, int]]] = field(
+        default_factory=dict)
+    # (service_id, spec_version_index) -> failure timestamps
+    recent_failures: dict[tuple[str, int], list[float]] = field(default_factory=dict)
+    last_cleanup: float = field(default_factory=time.monotonic)
+
+    @classmethod
+    def new(cls, node: Node, tasks: dict[str, Task], available: Resources) -> "NodeInfo":
+        info = cls(node=node, available_resources=available.copy())
+        for t in tasks.values():
+            info.add_task(t)
+        return info
+
+    # ------------------------------------------------------------- tasks
+    def remove_task(self, t: Task) -> bool:
+        old = self.tasks.pop(t.id, None)
+        if old is None:
+            return False
+        if old.desired_state <= TaskState.COMPLETE:
+            self.active_tasks_count -= 1
+            self._bump_service(old.service_id, -1)
+        for port in self._host_ports(old):
+            self.used_host_ports.discard(port)
+        res = task_reservations(old.spec)
+        self.available_resources.memory_bytes += res.memory_bytes
+        self.available_resources.nano_cpus += res.nano_cpus
+        for kind, (named, count) in self.generic_assignments.pop(t.id, {}).items():
+            if named:
+                self.available_resources.named_generic.setdefault(
+                    kind, set()).update(named)
+            if count:
+                self.available_resources.generic[kind] = (
+                    self.available_resources.generic.get(kind, 0) + count)
+        return True
+
+    def add_task(self, t: Task) -> bool:
+        old = self.tasks.get(t.id)
+        if old is not None:
+            # Only the active-count flip matters on re-add (nodeinfo.go:112-126).
+            if (t.desired_state <= TaskState.COMPLETE
+                    < old.desired_state):
+                self.tasks[t.id] = t
+                self.active_tasks_count += 1
+                self._bump_service(t.service_id, +1)
+                return True
+            if (old.desired_state <= TaskState.COMPLETE
+                    < t.desired_state):
+                self.tasks[t.id] = t
+                self.active_tasks_count -= 1
+                self._bump_service(t.service_id, -1)
+                return True
+            return False
+
+        self.tasks[t.id] = t
+        res = task_reservations(t.spec)
+        self.available_resources.memory_bytes -= res.memory_bytes
+        self.available_resources.nano_cpus -= res.nano_cpus
+        self.generic_assignments[t.id] = self._claim_generic(res)
+        for port in self._host_ports(t):
+            self.used_host_ports.add(port)
+        if t.desired_state <= TaskState.COMPLETE:
+            self.active_tasks_count += 1
+            self._bump_service(t.service_id, +1)
+        return True
+
+    def assigned_generic(self, task_id: str) -> dict[str, tuple[frozenset, int]]:
+        """What a placed task was granted: kind -> (named ids, discrete count).
+        Never written onto the (store-owned) Task object here — the commit
+        path copies it onto the task it writes."""
+        return self.generic_assignments.get(task_id, {})
+
+    def _claim_generic(self, res: Resources) -> dict[str, tuple[frozenset, int]]:
+        assigned: dict[str, tuple[frozenset, int]] = {}
+        for kind, qty in res.generic.items():
+            named_pool = self.available_resources.named_generic.get(kind)
+            taken: set[str] = set()
+            if named_pool:
+                # deterministic: grant lowest ids first
+                for nid in sorted(named_pool)[:qty]:
+                    named_pool.discard(nid)
+                    taken.add(nid)
+            rest = qty - len(taken)
+            if rest > 0:
+                self.available_resources.generic[kind] = (
+                    self.available_resources.generic.get(kind, 0) - rest)
+            if taken or rest:
+                assigned[kind] = (frozenset(taken), rest)
+        return assigned
+
+    def _bump_service(self, service_id: str, delta: int) -> None:
+        self.active_tasks_count_by_service[service_id] = (
+            self.active_tasks_count_by_service.get(service_id, 0) + delta)
+
+    @staticmethod
+    def _host_ports(t: Task) -> list[tuple[str, int]]:
+        endpoint = t.endpoint
+        if endpoint is None:
+            return []
+        return [
+            (p.protocol, p.published_port)
+            for p in endpoint.ports
+            if p.publish_mode == "host" and p.published_port != 0
+        ]
+
+    # ---------------------------------------------------------- failures
+    def task_failed(self, service_key: tuple[str, int], now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        self._maybe_cleanup(now)
+        window = self.recent_failures.setdefault(service_key, [])
+        if len(window) == MAX_FAILURES:
+            # already saturated; drop expired entries instead of growing
+            window[:] = [ts for ts in window if now - ts <= FAILURE_WINDOW]
+        window.append(now)
+
+    def count_recent_failures(self, service_key: tuple[str, int],
+                              now: float | None = None) -> int:
+        now = time.monotonic() if now is None else now
+        window = self.recent_failures.get(service_key, [])
+        return sum(1 for ts in window if now - ts <= FAILURE_WINDOW)
+
+    def penalized(self, service_key: tuple[str, int], now: float | None = None) -> bool:
+        """True when the spread comparator downweights this node
+        (scheduler.go:708-735: ≥ MAX_FAILURES recent failures)."""
+        return self.count_recent_failures(service_key, now) >= MAX_FAILURES
+
+    def _maybe_cleanup(self, now: float) -> None:
+        if now - self.last_cleanup < FAILURE_WINDOW:
+            return
+        for key in list(self.recent_failures):
+            kept = [ts for ts in self.recent_failures[key] if now - ts <= FAILURE_WINDOW]
+            if kept:
+                self.recent_failures[key] = kept
+            else:
+                del self.recent_failures[key]
+        self.last_cleanup = now

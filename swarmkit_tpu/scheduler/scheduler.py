@@ -1,0 +1,384 @@
+"""The scheduler control loop.
+
+Behavioral re-derivation of manager/scheduler/scheduler.go: an event loop over
+store watches that moves PENDING tasks to ASSIGNED. Differences from the
+reference are architectural, per SURVEY.md §7:
+
+  * ticks are *batched*: all dirty groups are encoded into dense arrays and
+    placed by one backend call — the greedy CPU engine for small ticks, the
+    JAX water-fill kernel above `JAX_THRESHOLD` task×node products
+    (backend="auto"), instead of per-task Go heap walks;
+  * placement is canonically deterministic (spread.py) rather than
+    Go-map-iteration dependent.
+
+Matching reference behaviors: 50 ms commit debounce with 1 s cap
+(scheduler.go:149-155), preassigned (global-service) tasks validated against
+the filter pipeline without spread scoring (:398-426), in-transaction
+re-validation of node state when committing decisions (:533-604), failed
+decisions returned to the unassigned pool, and pipeline explanations written
+to task status on failure (:923-968).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import defaultdict
+
+from ..api.objects import (
+    EventCommit,
+    EventCreate,
+    EventDelete,
+    EventUpdate,
+    Node,
+    Task,
+)
+from ..api.types import NodeStatusState, TaskState
+from ..store import by
+from ..store.memory import MemoryStore
+from ..store.watch import ChannelClosed
+from .batch import cpu_schedule_encoded, materialize, tpu_schedule_encoded
+from .encode import TaskGroup, encode
+from .filters import Pipeline
+from .nodeinfo import NodeInfo
+
+log = logging.getLogger("swarmkit_tpu.scheduler")
+
+COMMIT_DEBOUNCE = 0.05   # reference: 50ms
+MAX_LATENCY = 1.0        # reference: 1s
+JAX_THRESHOLD = 200_000  # task×node product above which the TPU kernel wins
+
+
+class Scheduler:
+    def __init__(self, store: MemoryStore, backend: str = "auto"):
+        self.store = store
+        self.backend = backend
+        self.node_infos: dict[str, NodeInfo] = {}
+        self.unassigned: dict[str, Task] = {}
+        self.preassigned: dict[str, Task] = {}
+        self.pending_spec_version: dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.ticks = 0
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self):
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name="scheduler")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # ------------------------------------------------------------------ init
+    def _setup(self):
+        """Snapshot + subscribe (reference setupTasksList, scheduler.go:68-125)."""
+
+        def snap(tx):
+            return tx.find_tasks(), tx.find_nodes()
+
+        # Unbounded subscription: the scheduler is a trusted in-process
+        # consumer and must never be shed as a slow subscriber — a closed
+        # channel would silently stop all scheduling.
+        (tasks, nodes), ch = self.store.view_and_watch(snap, limit=None)
+        tasks_by_node: dict[str, dict[str, Task]] = defaultdict(dict)
+        for t in tasks:
+            if t.status.state < TaskState.PENDING or t.status.state > TaskState.RUNNING:
+                continue
+            # desired_state == COMPLETE covers job-mode tasks; anything past
+            # that (SHUTDOWN/REMOVE/...) is being torn down and must not be
+            # scheduled (reference scheduler.go:96-99)
+            if t.desired_state > TaskState.COMPLETE:
+                continue
+            if t.status.state == TaskState.PENDING and not t.node_id:
+                self.unassigned[t.id] = t
+            elif t.status.state == TaskState.PENDING and t.node_id:
+                self.preassigned[t.id] = t
+            if t.node_id and t.status.state >= TaskState.ASSIGNED:
+                tasks_by_node[t.node_id][t.id] = t
+        for n in nodes:
+            self._add_or_update_node(n, tasks_by_node.get(n.id, {}))
+        return ch
+
+    # ----------------------------------------------------------------- nodes
+    def _add_or_update_node(self, node: Node, tasks: dict[str, Task] | None = None):
+        existing = self.node_infos.get(node.id)
+        if tasks is None:
+            tasks = existing.tasks if existing else {}
+        desc = node.description
+        total = desc.resources.copy() if desc else None
+        from ..api.specs import Resources
+        avail = total if total is not None else Resources()
+        info = NodeInfo.new(node, dict(tasks), avail)
+        if existing:
+            info.recent_failures = existing.recent_failures
+        self.node_infos[node.id] = info
+
+    def _remove_node(self, node_id: str):
+        self.node_infos.pop(node_id, None)
+
+    # ---------------------------------------------------------------- events
+    def _handle(self, ev) -> bool:
+        """Returns True when the event makes a tick necessary."""
+        if isinstance(ev, (EventCreate, EventUpdate)) and isinstance(ev.obj, Task):
+            t = ev.obj
+            if (t.status.state == TaskState.PENDING
+                    and t.desired_state <= TaskState.COMPLETE):
+                if t.node_id:
+                    self.preassigned[t.id] = t
+                else:
+                    self.unassigned[t.id] = t
+                return True
+            # track running tasks on nodes for accurate counts
+            if t.node_id and t.node_id in self.node_infos:
+                info = self.node_infos[t.node_id]
+                if t.status.state > TaskState.RUNNING:
+                    # only an *observed* terminal state releases resources;
+                    # a desired-state change alone still has the container
+                    # running (reference scheduler.go:294 deletes on observed
+                    # state, desired crossings only flip active counts via
+                    # add_task, nodeinfo.go:111-119)
+                    if info.remove_task(t):
+                        if t.status.state == TaskState.FAILED:
+                            key = (t.service_id,
+                                   t.spec_version.index if t.spec_version else 0)
+                            info.task_failed(key)
+                        return True
+                else:
+                    info.add_task(t)
+            if (t.status.state > TaskState.PENDING
+                    or t.desired_state > TaskState.COMPLETE):
+                self.unassigned.pop(t.id, None)
+                self.preassigned.pop(t.id, None)
+            return False
+        if isinstance(ev, EventDelete) and isinstance(ev.obj, Task):
+            t = ev.obj
+            self.unassigned.pop(t.id, None)
+            self.preassigned.pop(t.id, None)
+            if t.node_id and t.node_id in self.node_infos:
+                self.node_infos[t.node_id].remove_task(t)
+            return True
+        if isinstance(ev, (EventCreate, EventUpdate)) and isinstance(ev.obj, Node):
+            self._add_or_update_node(ev.obj)
+            return True
+        if isinstance(ev, EventDelete) and isinstance(ev.obj, Node):
+            self._remove_node(ev.obj.id)
+            return True
+        return False
+
+    # ------------------------------------------------------------------- run
+    def run(self):
+        ch = self._setup()
+        if self.unassigned or self.preassigned:
+            self.tick()
+        dirty_since: float | None = None
+        try:
+            while not self._stop.is_set():
+                timeout = 0.2
+                if dirty_since is not None:
+                    timeout = COMMIT_DEBOUNCE
+                try:
+                    ev = ch.get(timeout=timeout)
+                except TimeoutError:
+                    ev = None
+                except ChannelClosed:
+                    return
+                now = time.monotonic()
+                if ev is not None:
+                    needs = self._handle(ev)
+                    if isinstance(ev, EventCommit):
+                        needs = bool(self.unassigned or self.preassigned)
+                    if needs and dirty_since is None:
+                        dirty_since = now
+                    # drain cheaply before ticking
+                    continue_draining = True
+                    while continue_draining:
+                        try:
+                            nxt = ch.try_get()
+                        except ChannelClosed:
+                            return
+                        if nxt is None:
+                            continue_draining = False
+                        else:
+                            if self._handle(nxt) and dirty_since is None:
+                                dirty_since = now
+                if dirty_since is not None and (
+                        ev is None or now - dirty_since >= MAX_LATENCY):
+                    # debounce elapsed with no new event, or max latency hit
+                    self.tick()
+                    dirty_since = None
+        finally:
+            self.store.queue.stop_watch(ch)
+
+    # ------------------------------------------------------------------ tick
+    def tick(self):
+        self.ticks += 1
+        if self.preassigned:
+            self._process_preassigned()
+        if not self.unassigned:
+            return
+        groups = self._group_unassigned()
+        if not groups:
+            return
+        problem = encode(list(self.node_infos.values()), groups)
+        n_nodes = len(problem.node_ids)
+        total_tasks = int(problem.n_tasks.sum())
+        use_jax = (self.backend == "jax"
+                   or (self.backend == "auto"
+                       and total_tasks * max(n_nodes, 1) >= JAX_THRESHOLD))
+        counts = (tpu_schedule_encoded(problem) if use_jax
+                  else cpu_schedule_encoded(problem))
+        assignments = materialize(problem, counts)
+        self._apply_decisions(problem, assignments, groups)
+
+    def _group_unassigned(self) -> list[TaskGroup]:
+        grouped: dict[tuple[str, int], list[Task]] = defaultdict(list)
+        for t in self.unassigned.values():
+            sv = t.spec_version.index if t.spec_version else 0
+            grouped[(t.service_id or t.id, sv)].append(t)
+        return [
+            TaskGroup(service_id=k[0], spec_version=k[1],
+                      tasks=sorted(ts, key=lambda t: t.id))
+            for k, ts in grouped.items()
+        ]
+
+    # -------------------------------------------------------------- commits
+    def _apply_decisions(self, problem, assignments: dict[str, str],
+                         groups: list[TaskGroup]):
+        """store.Batch with in-tx re-validation (scheduler.go:490-643)."""
+        applied: list[tuple[Task, str]] = []
+        # tasks no longer schedulable (deleted, dead, raced to assigned
+        # elsewhere) — evicted from the unassigned pool after the batch;
+        # conflicted decisions are NOT dropped and retry next tick
+        drop: list[str] = []
+        explain_cache: dict[tuple[str, int], str] = {}
+
+        def explain(group):
+            if group.key not in explain_cache:
+                explain_cache[group.key] = self._explain(group)
+            return explain_cache[group.key]
+
+        def batch_cb(batch):
+            for group in groups:
+                for task in group.tasks:
+                    node_id = assignments.get(task.id)
+
+                    def update_one(tx, task=task, node_id=node_id, group=group):
+                        cur = tx.get_task(task.id)
+                        if cur is None or cur.desired_state > TaskState.COMPLETE:
+                            drop.append(task.id)
+                            return
+                        if cur.status.state != TaskState.PENDING or cur.node_id:
+                            drop.append(task.id)
+                            return
+                        if node_id is None:
+                            # no suitable node: record the explanation, but
+                            # only when it changed — rewriting identical
+                            # status would retrigger ticks forever through
+                            # the commit-event debounce
+                            explanation = explain(group)
+                            if cur.status.err != explanation:
+                                cur = cur.copy()
+                                cur.status.message = "scheduler: no suitable node"
+                                cur.status.err = explanation
+                                cur.status.timestamp = time.time()
+                                tx.update(cur)
+                            return
+                        node = tx.get_node(node_id)
+                        if node is None or node.status.state != NodeStatusState.READY:
+                            return  # conflicted: retry next tick
+                        cur = cur.copy()
+                        cur.node_id = node_id
+                        cur.status.state = TaskState.ASSIGNED
+                        cur.status.message = "scheduler assigned task to node"
+                        cur.status.timestamp = time.time()
+                        tx.update(cur)
+                        applied.append((cur, node_id))
+
+                    batch.update(update_one)
+
+        self.store.batch(batch_cb)
+
+        with_generic: list[tuple[str, str]] = []
+        for task, node_id in applied:
+            self.unassigned.pop(task.id, None)
+            info = self.node_infos.get(node_id)
+            if info:
+                info.add_task(task)
+                if task.spec.resources.reservations.generic:
+                    with_generic.append((task.id, node_id))
+        if with_generic:
+            # persist which named/discrete generic resources were granted
+            # (reference nodeinfo.go:132-137 stamps AssignedGenericResources
+            # on the task before commit; we claim post-commit and follow up)
+            def write_generic(batch):
+                for task_id, node_id in with_generic:
+                    def upd(tx, task_id=task_id, node_id=node_id):
+                        cur = tx.get_task(task_id)
+                        info = self.node_infos.get(node_id)
+                        if cur is None or info is None:
+                            return
+                        cur = cur.copy()
+                        cur.assigned_generic_resources = {
+                            kind: (sorted(named), count)
+                            for kind, (named, count)
+                            in info.assigned_generic(task_id).items()
+                        }
+                        tx.update(cur)
+                    batch.update(upd)
+
+            self.store.batch(write_generic)
+        for task_id in drop:
+            self.unassigned.pop(task_id, None)
+        # everything else (no-suitable-node, conflicted commits) stays in
+        # self.unassigned; node/task events retrigger the tick
+
+    def _explain(self, group: TaskGroup) -> str:
+        pipeline = Pipeline()
+        pipeline.set_task(group.tasks[0])
+        for info in self.node_infos.values():
+            pipeline.process(info)
+        return pipeline.explain() or "no nodes available"
+
+    # --------------------------------------------------------- preassigned
+    def _process_preassigned(self):
+        """Global-service tasks arrive with node_id set; validate fit only
+        (reference processPreassignedTasks/taskFitNode, scheduler.go:398-426)."""
+        tasks = list(self.preassigned.values())
+        decided: list[tuple[Task, bool]] = []
+        pipeline = Pipeline()
+        for t in tasks:
+            info = self.node_infos.get(t.node_id)
+            if info is None:
+                continue  # wait for node
+            pipeline.set_task(t)
+            decided.append((t, pipeline.process(info)))
+
+        def batch_cb(batch):
+            for task, fits in decided:
+                def update_one(tx, task=task, fits=fits):
+                    cur = tx.get_task(task.id)
+                    if cur is None or cur.status.state != TaskState.PENDING:
+                        return
+                    cur = cur.copy()
+                    cur.status.timestamp = time.time()
+                    if fits:
+                        cur.status.state = TaskState.ASSIGNED
+                        cur.status.message = "scheduler confirmed task can run on preassigned node"
+                    else:
+                        cur.status.state = TaskState.REJECTED
+                        cur.status.message = "preassigned node no longer meets constraints"
+                    tx.update(cur)
+
+                batch.update(update_one)
+
+        if decided:
+            self.store.batch(batch_cb)
+        for task, fits in decided:
+            self.preassigned.pop(task.id, None)
+            if fits:
+                info = self.node_infos.get(task.node_id)
+                if info:
+                    info.add_task(task)

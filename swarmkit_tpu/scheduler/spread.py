@@ -1,0 +1,125 @@
+"""Canonical spread-placement semantics (the parity oracle).
+
+The reference's spread strategy (manager/scheduler/scheduler.go:694-921)
+round-robins a task group over nodes ordered by the `nodeLess` comparator —
+recent-failure penalty, then per-service active count, then total active
+count (scheduler.go:708-735) — re-filtering each node after every assignment.
+Go map iteration makes the reference nondeterministic across runs; per
+SURVEY.md §7 we instead define a *canonical deterministic ordering* — ties
+break by node index — and implement it twice:
+
+  * here: greedy heap fill (the oracle, and the default small-tick path);
+  * ops/placement.py: a closed-form water-fill kernel on TPU that provably
+    emits identical placements (greedy with uniform (+1,+1) increments equals
+    taking the globally smallest slots in sorted order).
+
+A node's *capacity* within one group fill folds in the dynamic filters the
+reference re-checks mid-fill (scheduler.go:910): resource depletion,
+max-replicas, and host-port exclusivity.
+"""
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass
+
+PENALTY_BASE = 1 << 20  # lexicographic linearization of (penalty, svc_count)
+
+
+@dataclass
+class GroupFill:
+    """One (service, spec_version) task group's placement problem against a
+    fixed node table. All arrays are parallel over nodes."""
+
+    n_tasks: int
+    eligible: list[bool]     # static mask: ready/constraint/platform/plugin
+    capacity: list[int]      # per-node cap: resources // need, max-replicas, ports
+    penalty: list[bool]      # >=5 recent failures for this service version
+    svc_count: list[int]     # active tasks of this service on the node
+    total_count: list[int]   # total active tasks on the node
+
+
+def greedy_fill(g: GroupFill) -> list[int]:
+    """Assign g.n_tasks over nodes; returns per-node counts.
+
+    Greedy: repeatedly give one task to the smallest node by
+    (penalty*B + svc_count, total_count, node_idx); each assignment increments
+    both svc_count and total_count and consumes one unit of capacity.
+    """
+    n = len(g.eligible)
+    counts = [0] * n
+    heap: list[tuple[int, int, int]] = []
+    key = [0] * n
+    tot = list(g.total_count)
+    for i in range(n):
+        if g.eligible[i] and g.capacity[i] > 0:
+            key[i] = (PENALTY_BASE if g.penalty[i] else 0) + g.svc_count[i]
+            heapq.heappush(heap, (key[i], tot[i], i))
+    remaining = g.n_tasks
+    while remaining > 0 and heap:
+        k, t, i = heapq.heappop(heap)
+        counts[i] += 1
+        remaining -= 1
+        key[i] += 1
+        tot[i] += 1
+        if counts[i] < g.capacity[i]:
+            heapq.heappush(heap, (key[i], tot[i], i))
+    return counts
+
+
+def slot_order(g: GroupFill, counts: list[int]) -> list[int]:
+    """Canonical assignment order of the filled slots: the sequence of node
+    indices in the order greedy filled them — i.e. all slots sorted by
+    (key_at_slot, total_at_slot, node_idx). Used to materialize task→node
+    deterministically (tasks sorted by id zip with this order)."""
+    slots: list[tuple[int, int, int]] = []
+    for i, c in enumerate(counts):
+        base_k = (PENALTY_BASE if g.penalty[i] else 0) + g.svc_count[i]
+        for j in range(c):
+            slots.append((base_k + j, g.total_count[i] + j, i))
+    slots.sort()
+    return [i for _, _, i in slots]
+
+
+def waterfill_reference(g: GroupFill) -> list[int]:
+    """Pure-Python closed-form water-fill — the same math as the TPU kernel,
+    kept host-side for differential testing of the kernel itself.
+
+    Level L = the primary-key value of the first *unfilled* slot layer.
+    c_n(L) = min(cap_n, max(0, L - k_n)); pick the largest L with
+    S(L) = Σ c_n(L) <= T, fill those, then distribute the remaining
+    T - S(L) among boundary slots (primary == L) ordered by
+    (secondary, node_idx).
+    """
+    n = len(g.eligible)
+    cap = [g.capacity[i] if g.eligible[i] else 0 for i in range(n)]
+    k = [(PENALTY_BASE if g.penalty[i] else 0) + g.svc_count[i] for i in range(n)]
+    T = g.n_tasks
+    total_cap = sum(cap)
+    if total_cap == 0 or T == 0:
+        return [0] * n
+    T = min(T, total_cap)
+
+    def filled(L: int) -> int:
+        return sum(min(cap[i], max(0, L - k[i])) for i in range(n))
+
+    lo, hi = 0, max(k) + T + 1  # filled(hi) >= T always
+    # largest L with filled(L) <= T
+    while lo < hi:
+        mid = (lo + hi + 1) // 2
+        if filled(mid) <= T:
+            lo = mid
+        else:
+            hi = mid - 1
+    L = lo
+    counts = [min(cap[i], max(0, L - k[i])) for i in range(n)]
+    rem = T - sum(counts)
+    if rem > 0:
+        boundary = [
+            (g.total_count[i] + counts[i], i)
+            for i in range(n)
+            if cap[i] > counts[i] and k[i] <= L and counts[i] == L - k[i]
+        ]
+        boundary.sort()
+        for _, i in boundary[:rem]:
+            counts[i] += 1
+    return counts

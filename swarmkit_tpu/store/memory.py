@@ -300,12 +300,14 @@ class MemoryStore:
         return self.queue
 
     def view_and_watch(self, cb: Callable[[ReadTx], Any] | None = None,
-                       matcher=None) -> tuple[Any, Channel]:
+                       matcher=None, limit: int | None = -1) -> tuple[Any, Channel]:
         """Atomic snapshot-then-subscribe (memory.go:892-909): no event that
-        post-dates the snapshot is missed, none that pre-dates it is delivered."""
+        post-dates the snapshot is missed, none that pre-dates it is delivered.
+        limit=None subscribes unbounded (for trusted in-process control loops
+        that must never be shed as slow subscribers)."""
         with self._lock:
             result = cb(ReadTx(self)) if cb is not None else None
-            ch = self.queue.watch(matcher)
+            ch = self.queue.watch(matcher, limit=limit)
         return result, ch
 
     # -------------------------------------------------------------- snapshots

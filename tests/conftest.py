@@ -5,6 +5,9 @@ exercised without TPU hardware; bench.py (run separately) uses the real chip.
 Must set XLA flags before jax is imported anywhere.
 """
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))  # cross-test helper imports
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")

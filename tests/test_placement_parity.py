@@ -1,0 +1,187 @@
+"""Placement parity: the JAX water-fill kernel must emit bit-identical
+placements to the CPU greedy oracle over randomized cluster states, and the
+encoded static mask must agree with the string-based filter pipeline."""
+import random
+
+import numpy as np
+import pytest
+
+from swarmkit_tpu.api.objects import Node, Task
+from swarmkit_tpu.api.specs import (
+    Annotations,
+    EndpointSpec,
+    NodeDescription,
+    Placement,
+    Platform,
+    PortConfig,
+    Resources,
+)
+from swarmkit_tpu.api.types import NodeAvailability, NodeStatusState, TaskState
+from swarmkit_tpu.scheduler import batch
+from swarmkit_tpu.scheduler.encode import (
+    CPU_QUANTUM,
+    MEM_QUANTUM,
+    TaskGroup,
+    encode,
+)
+from swarmkit_tpu.scheduler.filters import Pipeline
+from swarmkit_tpu.scheduler.nodeinfo import NodeInfo
+
+LABEL_KEYS = ["zone", "disk", "tier"]
+LABEL_VALS = ["a", "b", "c", "ssd", "hdd"]
+
+
+def random_node(rng, i):
+    n = Node(id=f"node-{i:04d}")
+    n.status.state = (NodeStatusState.READY if rng.random() < 0.9
+                      else NodeStatusState.DOWN)
+    n.status.addr = f"10.0.{i % 256}.{(i * 7) % 256}"
+    n.spec.availability = (NodeAvailability.ACTIVE if rng.random() < 0.9
+                           else NodeAvailability.DRAIN)
+    n.spec.annotations = Annotations(name=f"node-{i}", labels={
+        k: rng.choice(LABEL_VALS) for k in LABEL_KEYS if rng.random() < 0.7
+    })
+    n.description = NodeDescription(
+        hostname=f"host-{i}",
+        platform=Platform(os=rng.choice(["linux", "windows"]),
+                          architecture=rng.choice(["x86_64", "amd64", "arm64"])),
+        resources=Resources(
+            nano_cpus=rng.randint(1, 16) * CPU_QUANTUM * 1000,
+            memory_bytes=rng.randint(1, 64) * MEM_QUANTUM * 1024,
+        ),
+        plugins=[("Volume", "local"), ("Network", "overlay")]
+        + ([("Volume", "nfs")] if rng.random() < 0.5 else []),
+    )
+    return n
+
+
+def random_group(rng, gi, n_tasks):
+    svc = f"svc-{gi:03d}"
+    tasks = []
+    for ti in range(n_tasks):
+        t = Task(id=f"task-{gi:03d}-{ti:05d}", service_id=svc, slot=ti + 1)
+        t.desired_state = TaskState.RUNNING
+        tasks.append(t)
+    spec = tasks[0].spec
+    spec.resources.reservations.nano_cpus = rng.randint(0, 3) * CPU_QUANTUM
+    spec.resources.reservations.memory_bytes = rng.randint(0, 4) * MEM_QUANTUM
+    choices = []
+    if rng.random() < 0.5:
+        choices.append(f"node.labels.{rng.choice(LABEL_KEYS)} "
+                       f"{'==' if rng.random() < 0.7 else '!='} "
+                       f"{rng.choice(LABEL_VALS)}")
+    if rng.random() < 0.2:
+        choices.append("node.platform.os == linux")
+    if rng.random() < 0.1:
+        choices.append("node.ip != 10.0.3.0/24")
+    spec.placement = Placement(constraints=choices)
+    if rng.random() < 0.3:
+        spec.placement.platforms = [Platform(os="linux", architecture="x86_64")]
+    if rng.random() < 0.2:
+        spec.placement.max_replicas = rng.randint(1, 3)
+    if rng.random() < 0.2:
+        for t in tasks:
+            t.endpoint = EndpointSpec(ports=[PortConfig(
+                protocol="tcp", target_port=80,
+                published_port=8000 + gi, publish_mode="host")])
+    for t in tasks[1:]:
+        t.spec = tasks[0].spec
+    return TaskGroup(service_id=svc, spec_version=1, tasks=tasks)
+
+
+def random_cluster(rng, n_nodes=20, n_groups=5, max_tasks=30):
+    infos = []
+    for i in range(n_nodes):
+        node = random_node(rng, i)
+        avail = node.description.resources.copy()
+        info = NodeInfo.new(node, {}, avail)
+        # pre-existing load
+        info.active_tasks_count = rng.randint(0, 5)
+        infos.append(info)
+    groups = [random_group(rng, gi, rng.randint(1, max_tasks))
+              for gi in range(n_groups)]
+    return infos, groups
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_kernel_matches_cpu_oracle(seed):
+    rng = random.Random(seed)
+    infos, groups = random_cluster(rng)
+    p = encode(infos, groups)
+    cpu_counts = batch.cpu_schedule_encoded(p)
+    tpu_counts = batch.tpu_schedule_encoded(p)
+    np.testing.assert_array_equal(cpu_counts, tpu_counts)
+    # per-group totals: every task placed or capacity exhausted
+    for gi in range(len(groups)):
+        assert cpu_counts[gi].sum() <= p.n_tasks[gi]
+
+
+def test_materialize_deterministic_and_consistent():
+    rng = random.Random(123)
+    infos, groups = random_cluster(rng)
+    p = encode(infos, groups)
+    counts = batch.cpu_schedule_encoded(p)
+    a1 = batch.materialize(p, counts)
+    a2 = batch.materialize(p, batch.tpu_schedule_encoded(p))
+    assert a1 == a2
+    # every assigned node was eligible
+    mask = batch.cpu_static_mask(p)
+    node_idx = {nid: i for i, nid in enumerate(p.node_ids)}
+    gi_of = {t.id: gi for gi, g in enumerate(groups) for t in g.tasks}
+    for tid, nid in a1.items():
+        assert mask[gi_of[tid], node_idx[nid]]
+
+
+def test_static_mask_matches_string_pipeline():
+    """The interned-int mask must agree with the reference-style string
+    filter chain (minus the dynamic resource/port/replica filters, which the
+    mask excludes by design)."""
+    rng = random.Random(99)
+    infos, groups = random_cluster(rng, n_nodes=30, n_groups=8)
+    # Give nodes unlimited resources so dynamic filters pass trivially
+    for info in infos:
+        info.available_resources.nano_cpus = 10**15
+        info.available_resources.memory_bytes = 10**18
+    p = encode(infos, groups)
+    mask = batch.cpu_static_mask(p)
+    pipeline = Pipeline()
+    infos_sorted = sorted(infos, key=lambda i: i.node.id)
+    for gi, g in enumerate(sorted(groups, key=lambda g: g.key)):
+        pipeline.set_task(g.tasks[0])
+        for ni, info in enumerate(infos_sorted):
+            expected = pipeline.process(info)
+            assert mask[gi, ni] == expected, (
+                f"group {g.key} node {info.node.id}: mask={mask[gi, ni]} "
+                f"pipeline={expected}")
+
+
+def test_max_replicas_respected():
+    rng = random.Random(5)
+    infos, groups = random_cluster(rng, n_nodes=5, n_groups=1, max_tasks=40)
+    g = groups[0]
+    g.spec.placement.constraints = []
+    g.spec.placement.platforms = []
+    g.spec.placement.max_replicas = 2
+    for t in g.tasks:
+        t.endpoint = None
+    p = encode(infos, groups)
+    counts = batch.tpu_schedule_encoded(p)
+    assert counts.max() <= 2
+    np.testing.assert_array_equal(counts, batch.cpu_schedule_encoded(p))
+
+
+def test_host_ports_exclusive():
+    rng = random.Random(6)
+    infos, groups = random_cluster(rng, n_nodes=6, n_groups=2, max_tasks=10)
+    for g in groups:
+        g.spec.placement = Placement()
+        for t in g.tasks:
+            t.endpoint = EndpointSpec(ports=[PortConfig(
+                protocol="tcp", target_port=80, published_port=8080,
+                publish_mode="host")])
+    p = encode(infos, groups)
+    counts = batch.tpu_schedule_encoded(p)
+    np.testing.assert_array_equal(counts, batch.cpu_schedule_encoded(p))
+    # both groups publish the same port: a node may host at most one task
+    per_node = counts.sum(axis=0)
+    assert per_node.max() <= 1

@@ -1,0 +1,229 @@
+"""Scheduler event-loop tests: store-driven assignment scenarios modeled on
+the reference's scheduler_test.go (event-driven, no real cluster)."""
+import time
+
+import pytest
+
+from swarmkit_tpu.api.objects import Node, Task
+from swarmkit_tpu.api.specs import (
+    Annotations,
+    NodeDescription,
+    Placement,
+    Platform,
+    Resources,
+)
+from swarmkit_tpu.api.types import (
+    NodeAvailability,
+    NodeStatusState,
+    TaskState,
+)
+from swarmkit_tpu.store import by
+from swarmkit_tpu.store.memory import MemoryStore
+from swarmkit_tpu.scheduler.scheduler import Scheduler
+
+
+def ready_node(id, cpus=8, mem_gb=16, labels=None, os="linux", arch="amd64"):
+    n = Node(id=id)
+    n.status.state = NodeStatusState.READY
+    n.spec.availability = NodeAvailability.ACTIVE
+    n.spec.annotations = Annotations(name=id, labels=labels or {})
+    n.description = NodeDescription(
+        hostname=id,
+        platform=Platform(os=os, architecture=arch),
+        resources=Resources(nano_cpus=cpus * 10**9,
+                            memory_bytes=mem_gb * 2**30),
+    )
+    return n
+
+
+def pending_task(id, service_id="svc", slot=1, constraints=None,
+                 cpu=0, mem=0):
+    t = Task(id=id, service_id=service_id, slot=slot)
+    t.status.state = TaskState.PENDING
+    t.desired_state = TaskState.RUNNING
+    if constraints:
+        t.spec.placement = Placement(constraints=constraints)
+    t.spec.resources.reservations.nano_cpus = cpu
+    t.spec.resources.reservations.memory_bytes = mem
+    return t
+
+
+def wait_for(predicate, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def store():
+    return MemoryStore()
+
+
+def all_assigned(store, n):
+    tasks = store.view().find_tasks(by.ByTaskState(TaskState.ASSIGNED))
+    return len(tasks) == n
+
+
+def test_basic_assignment_and_spread(store):
+    def setup(tx):
+        for i in range(4):
+            tx.create(ready_node(f"node-{i}"))
+        for i in range(8):
+            tx.create(pending_task(f"task-{i}", slot=i + 1))
+
+    store.update(setup)
+    s = Scheduler(store)
+    s.start()
+    try:
+        assert wait_for(lambda: all_assigned(store, 8))
+        tasks = store.view().find_tasks()
+        per_node = {}
+        for t in tasks:
+            assert t.status.state == TaskState.ASSIGNED
+            per_node[t.node_id] = per_node.get(t.node_id, 0) + 1
+        assert sorted(per_node.values()) == [2, 2, 2, 2]
+    finally:
+        s.stop()
+
+
+def test_constraint_filtering(store):
+    def setup(tx):
+        tx.create(ready_node("node-ssd", labels={"disk": "ssd"}))
+        tx.create(ready_node("node-hdd", labels={"disk": "hdd"}))
+        for i in range(4):
+            tx.create(pending_task(
+                f"task-{i}", slot=i + 1,
+                constraints=["node.labels.disk == ssd"]))
+
+    store.update(setup)
+    s = Scheduler(store)
+    s.start()
+    try:
+        assert wait_for(lambda: all_assigned(store, 4))
+        for t in store.view().find_tasks():
+            assert t.node_id == "node-ssd"
+    finally:
+        s.stop()
+
+
+def test_no_suitable_node_explained_then_recovers(store):
+    store.update(lambda tx: tx.create(pending_task(
+        "task-0", constraints=["node.labels.gpu == yes"])))
+    s = Scheduler(store)
+    s.start()
+    try:
+        assert wait_for(lambda: (
+            store.view().get_task("task-0").status.err != ""))
+        t = store.view().get_task("task-0")
+        assert t.status.state == TaskState.PENDING
+        assert "constraint" in t.status.err or "no nodes" in t.status.err
+        # add a satisfying node: task must get scheduled
+        store.update(lambda tx: tx.create(
+            ready_node("node-gpu", labels={"gpu": "yes"})))
+        assert wait_for(lambda: (
+            store.view().get_task("task-0").status.state == TaskState.ASSIGNED))
+        assert store.view().get_task("task-0").node_id == "node-gpu"
+    finally:
+        s.stop()
+
+
+def test_resource_exhaustion(store):
+    def setup(tx):
+        tx.create(ready_node("small", cpus=2))
+        for i in range(4):
+            tx.create(pending_task(f"task-{i}", slot=i + 1, cpu=10**9))
+
+    store.update(setup)
+    s = Scheduler(store)
+    s.start()
+    try:
+        assert wait_for(lambda: all_assigned(store, 2))
+        time.sleep(0.3)
+        assigned = store.view().find_tasks(by.ByTaskState(TaskState.ASSIGNED))
+        pending = store.view().find_tasks(by.ByTaskState(TaskState.PENDING))
+        assert len(assigned) == 2 and len(pending) == 2
+        # free capacity: add a node, remaining tasks schedule
+        store.update(lambda tx: tx.create(ready_node("big", cpus=8)))
+        assert wait_for(lambda: all_assigned(store, 4))
+    finally:
+        s.stop()
+
+
+def test_drained_node_excluded(store):
+    def setup(tx):
+        good = ready_node("good")
+        drained = ready_node("drained")
+        drained.spec.availability = NodeAvailability.DRAIN
+        tx.create(good)
+        tx.create(drained)
+        for i in range(4):
+            tx.create(pending_task(f"task-{i}", slot=i + 1))
+
+    store.update(setup)
+    s = Scheduler(store)
+    s.start()
+    try:
+        assert wait_for(lambda: all_assigned(store, 4))
+        for t in store.view().find_tasks():
+            assert t.node_id == "good"
+    finally:
+        s.stop()
+
+
+def test_preassigned_task_validated(store):
+    """Global-orchestrator style: node_id preset, scheduler only confirms."""
+    def setup(tx):
+        tx.create(ready_node("node-a", labels={"ok": "yes"}))
+        t = pending_task("task-global", constraints=["node.labels.ok == yes"])
+        t.node_id = "node-a"
+        tx.create(t)
+        t2 = pending_task("task-bad", constraints=["node.labels.ok == no"])
+        t2.node_id = "node-a"
+        tx.create(t2)
+
+    store.update(setup)
+    s = Scheduler(store)
+    s.start()
+    try:
+        assert wait_for(lambda: (
+            store.view().get_task("task-global").status.state == TaskState.ASSIGNED))
+        assert wait_for(lambda: (
+            store.view().get_task("task-bad").status.state == TaskState.REJECTED))
+    finally:
+        s.stop()
+
+
+def test_jax_backend_matches_cpu_end_to_end(store):
+    """Same store contents scheduled by both backends → identical placement."""
+    def setup(tx):
+        for i in range(10):
+            tx.create(ready_node(f"node-{i:02d}",
+                                 labels={"zone": "a" if i % 2 else "b"}))
+        for i in range(30):
+            tx.create(pending_task(
+                f"task-{i:03d}", service_id=f"svc-{i % 3}", slot=i,
+                constraints=["node.labels.zone == a"] if i % 3 == 0 else None,
+                cpu=10**9 if i % 3 == 1 else 0))
+
+    store.update(setup)
+    s_cpu = Scheduler(store, backend="cpu")
+    s_cpu.start()
+    try:
+        assert wait_for(lambda: all_assigned(store, 30))
+    finally:
+        s_cpu.stop()
+    placement_cpu = {t.id: t.node_id for t in store.view().find_tasks()}
+
+    store2 = MemoryStore()
+    store2.update(setup)
+    s_jax = Scheduler(store2, backend="jax")
+    s_jax.start()
+    try:
+        assert wait_for(lambda: all_assigned(store2, 30), timeout=60)
+    finally:
+        s_jax.stop()
+    placement_jax = {t.id: t.node_id for t in store2.view().find_tasks()}
+    assert placement_cpu == placement_jax

@@ -1,0 +1,184 @@
+"""Regression tests for review findings: job-task scheduling, resource
+release timing, constraint-semantics parity corners, generic-resource claims."""
+import time
+
+import numpy as np
+
+from swarmkit_tpu.api.objects import Node, Task
+from swarmkit_tpu.api.specs import Resources
+from swarmkit_tpu.api.types import TaskState
+from swarmkit_tpu.scheduler import batch
+from swarmkit_tpu.scheduler.encode import TaskGroup, encode
+from swarmkit_tpu.scheduler.nodeinfo import NodeInfo
+from swarmkit_tpu.scheduler.scheduler import Scheduler
+from swarmkit_tpu.store import by
+from swarmkit_tpu.store.memory import MemoryStore
+
+from test_scheduler import pending_task, ready_node, wait_for
+
+
+def test_job_tasks_scheduled():
+    """Job-mode tasks arrive with desired_state=COMPLETE and must schedule."""
+    store = MemoryStore()
+
+    def setup(tx):
+        tx.create(ready_node("n1"))
+        t = pending_task("job-task", service_id="job-svc")
+        t.desired_state = TaskState.COMPLETE
+        tx.create(t)
+
+    store.update(setup)
+    s = Scheduler(store)
+    s.start()
+    try:
+        assert wait_for(lambda: (
+            store.view().get_task("job-task").status.state == TaskState.ASSIGNED))
+    finally:
+        s.stop()
+
+
+def test_shutdown_desired_state_keeps_resources_until_observed_dead():
+    """A desired=SHUTDOWN task still RUNNING must keep its reservation."""
+    node = ready_node("n1", cpus=4)
+    info = NodeInfo.new(node, {}, node.description.resources.copy())
+    t = Task(id="t1", service_id="svc", node_id="n1")
+    t.spec.resources.reservations.nano_cpus = 3 * 10**9
+    t.desired_state = TaskState.RUNNING
+    t.status.state = TaskState.RUNNING
+    info.add_task(t)
+    assert info.available_resources.nano_cpus == 10**9
+
+    # scheduler event handling: desired flips to SHUTDOWN, still running
+    store = MemoryStore()
+    s = Scheduler(store)
+    s.node_infos[node.id] = info
+    t2 = t.copy()
+    t2.desired_state = TaskState.SHUTDOWN
+    from swarmkit_tpu.api.objects import EventUpdate
+    s._handle(EventUpdate(t2))
+    # resources NOT released; active count flipped down
+    assert info.available_resources.nano_cpus == 10**9
+    assert info.active_tasks_count == 0
+    # observed terminal state releases
+    t3 = t2.copy()
+    t3.status.state = TaskState.SHUTDOWN
+    s._handle(EventUpdate(t3))
+    assert info.available_resources.nano_cpus == 4 * 10**9
+
+
+def _one_group_problem(nodes, constraints):
+    infos = []
+    for n in nodes:
+        infos.append(NodeInfo.new(n, {}, n.description.resources.copy()))
+    t = pending_task("t-0", service_id="svc")
+    t.spec.placement.constraints = constraints
+    g = TaskGroup(service_id="svc", spec_version=0, tasks=[t])
+    return encode(infos, [g])
+
+
+def test_unknown_key_neq_rejects_everywhere():
+    """'storage != ssd' has an unknown key: must match NO node in both the
+    batched mask and the string pipeline (reference constraint.go default)."""
+    p = _one_group_problem([ready_node("n1"), ready_node("n2")],
+                           ["storage != ssd"])
+    mask = batch.cpu_static_mask(p)
+    assert not mask.any()
+    counts = batch.tpu_schedule_encoded(p)
+    assert counts.sum() == 0
+
+
+def test_label_name_case_sensitivity_parity():
+    """Label names are case-sensitive; 'node.labels.Region' must not match a
+    node labeled 'region' but must match one labeled 'Region'."""
+    n1 = ready_node("n1", labels={"Region": "east"})
+    n2 = ready_node("n2", labels={"region": "east"})
+    p = _one_group_problem([n1, n2], ["node.labels.Region == east"])
+    mask = batch.cpu_static_mask(p)
+    # node order is sorted by id: n1, n2
+    assert mask[0, 0] and not mask[0, 1]
+    # and the string pipeline agrees
+    from swarmkit_tpu.scheduler.filters import Pipeline
+    pipe = Pipeline()
+    t = pending_task("t-0")
+    t.spec.placement.constraints = ["node.labels.Region == east"]
+    pipe.set_task(t)
+    i1 = NodeInfo.new(n1, {}, n1.description.resources.copy())
+    i2 = NodeInfo.new(n2, {}, n2.description.resources.copy())
+    assert pipe.process(i1) and not pipe.process(i2)
+
+
+def test_generic_resources_claim_and_restore():
+    node = ready_node("n1")
+    node.description.resources.generic = {"gpu": 5}
+    avail = node.description.resources.copy()
+    avail.named_generic = {"gpu": {"gpu-a", "gpu-b"}}
+    avail.generic = {"gpu": 5}
+    info = NodeInfo.new(node, {}, avail)
+
+    t = Task(id="t1", service_id="svc")
+    t.desired_state = TaskState.RUNNING
+    t.spec.resources.reservations.generic = {"gpu": 3}
+    info.add_task(t)
+    granted = info.assigned_generic("t1")
+    named, count = granted["gpu"]
+    assert named == frozenset({"gpu-a", "gpu-b"}) and count == 1
+    assert info.available_resources.generic["gpu"] == 4
+    assert info.available_resources.named_generic["gpu"] == set()
+
+    t_dead = t.copy()
+    t_dead.status.state = TaskState.FAILED
+    info.remove_task(t_dead)
+    assert info.available_resources.generic["gpu"] == 5
+    assert info.available_resources.named_generic["gpu"] == {"gpu-a", "gpu-b"}
+    # store-owned object never mutated
+    assert t.assigned_generic_resources == {}
+
+
+def test_stale_pending_task_evicted_from_pool():
+    """A PENDING task whose desired state moved past COMPLETE must not churn
+    ticks forever."""
+    store = MemoryStore()
+
+    def setup(tx):
+        tx.create(ready_node("n1"))
+        t = pending_task("dead-task")
+        t.desired_state = TaskState.REMOVE
+        tx.create(t)
+        tx.create(pending_task("live-task"))
+
+    store.update(setup)
+    s = Scheduler(store)
+    s.start()
+    try:
+        assert wait_for(lambda: (
+            store.view().get_task("live-task").status.state == TaskState.ASSIGNED))
+        time.sleep(0.3)
+        assert store.view().get_task("dead-task").status.state == TaskState.PENDING
+        assert "dead-task" not in s.unassigned
+    finally:
+        s.stop()
+
+
+def test_assigned_generic_persisted_to_store():
+    store = MemoryStore()
+
+    def setup(tx):
+        n = ready_node("n1")
+        n.description.resources.generic = {"gpu": 4}
+        tx.create(n)
+        t = pending_task("t1")
+        t.spec.resources.reservations.generic = {"gpu": 2}
+        tx.create(t)
+
+    store.update(setup)
+    s = Scheduler(store)
+    s.start()
+    try:
+        assert wait_for(lambda: (
+            store.view().get_task("t1").status.state == TaskState.ASSIGNED))
+        assert wait_for(lambda: bool(
+            store.view().get_task("t1").assigned_generic_resources))
+        granted = store.view().get_task("t1").assigned_generic_resources
+        assert granted["gpu"][1] == 2
+    finally:
+        s.stop()

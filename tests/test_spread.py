@@ -1,0 +1,111 @@
+"""Property tests: greedy heap fill ≡ closed-form water-fill on random
+instances, plus hand-written edge cases."""
+import random
+
+from swarmkit_tpu.scheduler.spread import (
+    GroupFill,
+    greedy_fill,
+    slot_order,
+    waterfill_reference,
+)
+
+
+def random_instance(rng, n_nodes=None, n_tasks=None):
+    n = n_nodes or rng.randint(1, 40)
+    return GroupFill(
+        n_tasks=n_tasks if n_tasks is not None else rng.randint(0, 120),
+        eligible=[rng.random() < 0.8 for _ in range(n)],
+        capacity=[rng.randint(0, 10) for _ in range(n)],
+        penalty=[rng.random() < 0.2 for _ in range(n)],
+        svc_count=[rng.randint(0, 5) for _ in range(n)],
+        total_count=[rng.randint(0, 20) for _ in range(n)],
+    )
+
+
+def test_greedy_equals_waterfill_random():
+    rng = random.Random(42)
+    for trial in range(500):
+        g = random_instance(rng)
+        assert greedy_fill(g) == waterfill_reference(g), f"trial {trial}: {g}"
+
+
+def test_all_tasks_placed_when_capacity_allows():
+    rng = random.Random(7)
+    for _ in range(100):
+        g = random_instance(rng)
+        counts = greedy_fill(g)
+        cap = sum(c for c, e in zip(g.capacity, g.eligible) if e)
+        assert sum(counts) == min(g.n_tasks, cap)
+        for c, e, cp in zip(counts, g.eligible, g.capacity):
+            assert c == 0 or e
+            assert c <= cp
+
+
+def test_even_spread_on_uniform_nodes():
+    g = GroupFill(
+        n_tasks=10,
+        eligible=[True] * 5,
+        capacity=[100] * 5,
+        penalty=[False] * 5,
+        svc_count=[0] * 5,
+        total_count=[0] * 5,
+    )
+    assert greedy_fill(g) == [2, 2, 2, 2, 2]
+
+
+def test_penalized_nodes_last():
+    g = GroupFill(
+        n_tasks=4,
+        eligible=[True] * 4,
+        capacity=[10] * 4,
+        penalty=[True, False, False, False],
+        svc_count=[0] * 4,
+        total_count=[0] * 4,
+    )
+    # 3 tasks spread over healthy nodes first, 4th round-robins back to them
+    counts = greedy_fill(g)
+    assert counts[0] == 0 and sum(counts) == 4
+
+
+def test_busy_nodes_get_fewer():
+    g = GroupFill(
+        n_tasks=6,
+        eligible=[True] * 3,
+        capacity=[100] * 3,
+        penalty=[False] * 3,
+        svc_count=[4, 0, 0],
+        total_count=[4, 0, 0],
+    )
+    # healthy nodes absorb everything: their key never exceeds the busy
+    # node's starting key of 4
+    assert greedy_fill(g) == [0, 3, 3]
+
+
+def test_total_count_breaks_ties():
+    g = GroupFill(
+        n_tasks=1,
+        eligible=[True, True],
+        capacity=[5, 5],
+        penalty=[False, False],
+        svc_count=[0, 0],
+        total_count=[7, 3],
+    )
+    assert greedy_fill(g) == [0, 1]
+
+
+def test_slot_order_is_stable_and_complete():
+    g = GroupFill(
+        n_tasks=5,
+        eligible=[True] * 3,
+        capacity=[10] * 3,
+        penalty=[False] * 3,
+        svc_count=[1, 0, 0],
+        total_count=[1, 0, 2],
+    )
+    counts = greedy_fill(g)
+    order = slot_order(g, counts)
+    assert len(order) == 5
+    assert sorted(order) == sorted(
+        i for i, c in enumerate(counts) for _ in range(c))
+    # first assignment goes to node 1 (svc 0, total 0)
+    assert order[0] == 1
