@@ -1,0 +1,138 @@
+"""Agent: the worker-side session lifecycle.
+
+Behavioral re-derivation of agent/{agent.go, session.go, reporter.go}:
+register with a dispatcher, heartbeat on the returned period, consume the
+assignment stream (COMPLETE → worker.assign, INCREMENTAL → worker.update),
+and batch observed-status updates back upstream with retry. Reconnects with
+exponential backoff when the session dies (session.go:90-118).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from ..api.objects import TaskStatus
+from ..store.watch import ChannelClosed
+from .worker import Worker
+
+log = logging.getLogger("swarmkit_tpu.agent")
+
+REPORT_INTERVAL = 0.05
+BACKOFF_BASE = 0.1
+BACKOFF_MAX = 8.0
+
+
+class Agent:
+    def __init__(self, node_id: str, dispatcher, executor,
+                 state_path: str | None = None):
+        self.node_id = node_id
+        self.dispatcher = dispatcher
+        self.executor = executor
+        self.worker = Worker(executor, self._enqueue_status, state_path)
+        self.session_id: str | None = None
+        self._pending: dict[str, TaskStatus] = {}
+        self._pending_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self):
+        t = threading.Thread(target=self._run, daemon=True,
+                             name=f"agent-{self.node_id[:8]}")
+        t.start()
+        self._threads.append(t)
+
+    def stop(self):
+        self._stop.set()
+        self.worker.stop()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    def leave(self):
+        if self.session_id is not None:
+            try:
+                self.dispatcher.leave(self.node_id, self.session_id)
+            except Exception:
+                pass
+        self.stop()
+
+    # ---------------------------------------------------------------- session
+    def _run(self):
+        backoff = BACKOFF_BASE
+        while not self._stop.is_set():
+            try:
+                self._session()
+                backoff = BACKOFF_BASE
+            except Exception as e:
+                if self._stop.is_set():
+                    return
+                log.debug("agent %s session error: %r; reconnecting in %.2fs",
+                          self.node_id, e, backoff)
+                if self._stop.wait(backoff):
+                    return
+                backoff = min(backoff * 2, BACKOFF_MAX)
+
+    def _session(self):
+        description = self.executor.describe()
+        session_id = self.dispatcher.register(self.node_id, description)
+        self.session_id = session_id
+        period = self.dispatcher.heartbeat(self.node_id, session_id)
+
+        hb_stop = threading.Event()
+
+        def heartbeat_loop():
+            while not (self._stop.is_set() or hb_stop.is_set()):
+                if self._stop.wait(period / 2) or hb_stop.is_set():
+                    return
+                try:
+                    self.dispatcher.heartbeat(self.node_id, session_id)
+                except Exception:
+                    return
+
+        def report_loop():
+            while not (self._stop.is_set() or hb_stop.is_set()):
+                self._flush_statuses(session_id)
+                if self._stop.wait(REPORT_INTERVAL):
+                    return
+
+        hb = threading.Thread(target=heartbeat_loop, daemon=True)
+        rp = threading.Thread(target=report_loop, daemon=True)
+        hb.start()
+        rp.start()
+
+        try:
+            ch = self.dispatcher.assignments(self.node_id, session_id)
+            while not self._stop.is_set():
+                try:
+                    msg = ch.get(timeout=0.2)
+                except TimeoutError:
+                    continue
+                if msg.type == "complete":
+                    self.worker.assign(msg.changes)
+                else:
+                    self.worker.update(msg.changes)
+        except ChannelClosed:
+            raise ConnectionError("assignment stream closed")
+        finally:
+            hb_stop.set()
+            self._flush_statuses(session_id)
+
+    # ------------------------------------------------------------- reporting
+    def _enqueue_status(self, task_id: str, status: TaskStatus):
+        with self._pending_lock:
+            self._pending[task_id] = status
+
+    def _flush_statuses(self, session_id: str):
+        with self._pending_lock:
+            if not self._pending:
+                return
+            updates = list(self._pending.items())
+            self._pending.clear()
+        try:
+            self.dispatcher.update_task_status(self.node_id, session_id, updates)
+        except Exception:
+            # retry later (reference agent/reporter.go retry queue)
+            with self._pending_lock:
+                for tid, st in updates:
+                    self._pending.setdefault(tid, st)

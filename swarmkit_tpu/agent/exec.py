@@ -1,0 +1,119 @@
+"""Executor framework: the pluggable runtime boundary.
+
+Behavioral re-derivation of agent/exec/{executor.go, controller.go}:
+`Executor` describes the node and makes per-task `Controller`s; `do` maps one
+controller step onto the task FSM — desired-state gating, fatal errors before
+start → REJECTED, after start → FAILED, temporary errors retried, exit codes
+captured (controller.go:142-345). Observed state is monotonic: `do` never
+returns a lower state than the task already has (controller.go:163-166).
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Protocol
+
+from ..api.objects import Task, TaskStatus
+from ..api.types import TaskState
+
+
+class TemporaryError(Exception):
+    """Transient failure: retry the same step."""
+
+
+class FatalError(Exception):
+    """Permanent failure: REJECTED before start, FAILED after."""
+
+
+@dataclass
+class ExitStatus:
+    code: int = 0
+    message: str = ""
+
+
+class Controller(Protocol):
+    """Per-task runtime driver (reference agent/exec/controller.go:16-47)."""
+
+    def update(self, task: Task) -> None: ...
+    def prepare(self) -> None: ...
+    def start(self) -> None: ...
+    def wait(self) -> ExitStatus: ...
+    def shutdown(self) -> None: ...
+    def terminate(self) -> None: ...
+    def remove(self) -> None: ...
+    def close(self) -> None: ...
+
+
+class Executor(Protocol):
+    """reference agent/exec/executor.go:10-121."""
+
+    def describe(self): ...
+    def configure(self, node) -> None: ...
+    def controller(self, task: Task) -> Controller: ...
+    def set_network_bootstrap_keys(self, keys) -> None: ...
+
+
+def _status(task: Task, state: TaskState, message: str,
+            err: str = "", exit_code: int | None = None) -> TaskStatus:
+    s = TaskStatus(
+        timestamp=time.time(),
+        state=state,
+        message=message,
+        err=err,
+        exit_code=exit_code,
+    )
+    # monotonic observed state (controller.go:163-166)
+    if state < task.status.state:
+        s.state = task.status.state
+    return s
+
+
+def do(task: Task, controller: Controller) -> TaskStatus:
+    """Advance the task one FSM step. Returns the new status (which may equal
+    the current one when the task is blocked on desired state)."""
+    state = task.status.state
+    desired = task.desired_state
+
+    try:
+        # teardown path wins over progress
+        if desired >= TaskState.SHUTDOWN and state < TaskState.COMPLETE:
+            if state >= TaskState.STARTING:
+                controller.shutdown()
+            return _status(task, TaskState.SHUTDOWN, "shutdown")
+
+        if state == TaskState.ASSIGNED:
+            controller.update(task)
+            return _status(task, TaskState.ACCEPTED, "accepted")
+        if state == TaskState.ACCEPTED:
+            return _status(task, TaskState.PREPARING, "preparing")
+        if state == TaskState.PREPARING:
+            controller.prepare()
+            return _status(task, TaskState.READY, "prepared")
+        if state == TaskState.READY:
+            # gate on desired: restart-delay holds tasks at READY
+            if desired >= TaskState.RUNNING:
+                return _status(task, TaskState.STARTING, "starting")
+            return task.status
+        if state == TaskState.STARTING:
+            controller.start()
+            return _status(task, TaskState.RUNNING, "started")
+        if state == TaskState.RUNNING:
+            exit_status = controller.wait()
+            if exit_status.code == 0:
+                return _status(task, TaskState.COMPLETE, "finished",
+                               exit_code=0)
+            return _status(task, TaskState.FAILED,
+                           exit_status.message or "task failed",
+                           err=f"exit code {exit_status.code}",
+                           exit_code=exit_status.code)
+        return task.status
+    except TemporaryError as e:
+        return _status(task, state, f"retrying: {e}", err=str(e))
+    except FatalError as e:
+        if state < TaskState.STARTING:
+            return _status(task, TaskState.REJECTED, "rejected", err=str(e))
+        return _status(task, TaskState.FAILED, "failed", err=str(e))
+    except Exception as e:  # unexpected errors behave like fatal
+        if state < TaskState.STARTING:
+            return _status(task, TaskState.REJECTED, "rejected", err=repr(e))
+        return _status(task, TaskState.FAILED, "failed", err=repr(e))

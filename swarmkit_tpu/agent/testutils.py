@@ -1,0 +1,90 @@
+"""Fake executor/controller for tests (reference: agent/testutils/fakes.go
+TestExecutor/TestController): tasks transition instantly; behavior knobs let
+scenarios inject failures, slow starts, and long-running tasks."""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..api.objects import Task
+from ..api.specs import NodeDescription, Platform, Resources
+from .exec import ExitStatus, FatalError
+
+
+class FakeController:
+    def __init__(self, task: Task, behavior: dict):
+        self.task = task
+        self.behavior = behavior
+        self._exit = threading.Event()
+        self._exit_status = ExitStatus(0, "")
+        self.closed = False
+
+    # behavior keys: fail_prepare, fail_start, run_forever, run_time, exit_code
+    def update(self, task):
+        self.task = task
+
+    def prepare(self):
+        if self.behavior.get("fail_prepare"):
+            raise FatalError("prepare failed (injected)")
+        time.sleep(self.behavior.get("prepare_time", 0))
+
+    def start(self):
+        if self.behavior.get("fail_start"):
+            raise FatalError("start failed (injected)")
+
+    def wait(self) -> ExitStatus:
+        if self.behavior.get("run_forever"):
+            # block until shutdown/terminate
+            self._exit.wait()
+            return self._exit_status
+        run_time = self.behavior.get("run_time", 0)
+        if run_time:
+            if self._exit.wait(run_time):
+                return self._exit_status
+        code = self.behavior.get("exit_code", 0)
+        return ExitStatus(code, f"exit {code}")
+
+    def shutdown(self):
+        self._exit_status = ExitStatus(0, "shutdown")
+        self._exit.set()
+
+    def terminate(self):
+        self._exit_status = ExitStatus(137, "terminated")
+        self._exit.set()
+
+    def remove(self):
+        pass
+
+    def close(self):
+        self.closed = True
+        self._exit.set()
+
+
+class FakeExecutor:
+    """Configurable fake. `behavior_for` maps service_id -> behavior dict."""
+
+    def __init__(self, behavior_for: dict | None = None, hostname="fake-host"):
+        self.behavior_for = behavior_for or {}
+        self.hostname = hostname
+        self.controllers: list[FakeController] = []
+        self._lock = threading.Lock()
+
+    def describe(self) -> NodeDescription:
+        return NodeDescription(
+            hostname=self.hostname,
+            platform=Platform(os="linux", architecture="amd64"),
+            resources=Resources(nano_cpus=8 * 10**9, memory_bytes=16 * 2**30),
+        )
+
+    def configure(self, node):
+        pass
+
+    def controller(self, task: Task) -> FakeController:
+        behavior = self.behavior_for.get(task.service_id, {})
+        c = FakeController(task, dict(behavior))
+        with self._lock:
+            self.controllers.append(c)
+        return c
+
+    def set_network_bootstrap_keys(self, keys):
+        pass

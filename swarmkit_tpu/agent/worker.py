@@ -1,0 +1,262 @@
+"""Worker: applies assignment sets and runs task managers.
+
+Behavioral re-derivation of agent/worker.go + agent/task.go: full `assign`
+replaces the task set, `update` applies incremental diffs; each task gets a
+manager thread driving its controller through the FSM via exec.do, reporting
+every observed transition to the reporter; secrets/configs land in restricted
+in-memory stores; task state persists to a local JSON file (the reference's
+BoltDB, agent/storage.go) so an agent restart resumes where it left off.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable
+
+from ..api.objects import Task, TaskStatus
+from ..api.types import TaskState
+from . import exec as exec_mod
+
+RUN_PROBE_INTERVAL = 0.05  # task manager poll; reference uses 10s run probe
+
+
+class DependencyStore:
+    """Task-restricted secret/config access (agent/secrets, agent/configs)."""
+
+    def __init__(self):
+        self._secrets: dict[str, object] = {}
+        self._configs: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def update_secret(self, secret):
+        with self._lock:
+            self._secrets[secret.id] = secret
+
+    def remove_secret(self, secret_id: str):
+        with self._lock:
+            self._secrets.pop(secret_id, None)
+
+    def update_config(self, config):
+        with self._lock:
+            self._configs[config.id] = config
+
+    def remove_config(self, config_id: str):
+        with self._lock:
+            self._configs.pop(config_id, None)
+
+    def restricted(self, task: Task):
+        """Only the task's own references are readable (agent/dependency.go)."""
+        runtime = task.spec.runtime
+        allowed_secrets = {r.secret_id for r in runtime.secrets} if runtime else set()
+        allowed_configs = {r.config_id for r in runtime.configs} if runtime else set()
+        with self._lock:
+            return (
+                {k: v for k, v in self._secrets.items() if k in allowed_secrets},
+                {k: v for k, v in self._configs.items() if k in allowed_configs},
+            )
+
+
+class TaskManager(threading.Thread):
+    """Per-task FSM driver (agent/task.go:16-140)."""
+
+    def __init__(self, task: Task, controller, report: Callable[[str, TaskStatus], None]):
+        super().__init__(daemon=True, name=f"taskmgr-{task.id[:8]}")
+        self.task = task
+        self.controller = controller
+        self.report = report
+        self._lock = threading.Lock()
+        self._halt = threading.Event()
+        self._poke = threading.Event()
+        self._shutdown_requested = False
+
+    def update(self, task: Task):
+        with self._lock:
+            prev_desired = self.task.desired_state
+            # desired state changes flow in; observed state stays ours
+            self.task.desired_state = task.desired_state
+            self.task.spec = task.spec
+            want_shutdown = (task.desired_state >= TaskState.SHUTDOWN
+                             and prev_desired < TaskState.SHUTDOWN)
+        if want_shutdown:
+            # the run loop may be blocked inside controller.wait(); signal
+            # the runtime directly so wait() returns (the reference runs
+            # Wait concurrently with desired-state handling, agent/task.go)
+            self._shutdown_requested = True
+            try:
+                self.controller.shutdown()
+            except Exception:
+                pass
+        self._poke.set()
+
+    def stop(self):
+        self._halt.set()
+        try:
+            self.controller.terminate()
+        except Exception:
+            pass
+        self._poke.set()
+
+    def run(self):
+        while not self._halt.is_set():
+            with self._lock:
+                task = self.task
+                before = task.status.state
+            status = exec_mod.do(task, self.controller)
+            if self._shutdown_requested and status.state == TaskState.COMPLETE:
+                # wait() returned because shutdown was requested, not because
+                # the workload finished: the observed terminal state is
+                # SHUTDOWN (reference exec.Do desired-state gating)
+                status = exec_mod._status(task, TaskState.SHUTDOWN, "shutdown")
+            with self._lock:
+                changed = status.state != before or status.err != task.status.err
+                task.status = status
+            if changed:
+                self.report(task.id, status)
+            if status.state >= TaskState.COMPLETE:
+                break
+            if status.state == before:
+                # blocked (e.g. READY awaiting desired RUNNING); wait for poke
+                self._poke.wait(RUN_PROBE_INTERVAL)
+                self._poke.clear()
+        try:
+            self.controller.close()
+        except Exception:
+            pass
+
+
+class Worker:
+    """reference: agent/worker.go."""
+
+    def __init__(self, executor, report: Callable[[str, TaskStatus], None],
+                 state_path: str | None = None):
+        self.executor = executor
+        self.report = report
+        self.state_path = state_path
+        self.deps = DependencyStore()
+        self._managers: dict[str, TaskManager] = {}
+        self._tasks: dict[str, Task] = {}
+        self._lock = threading.Lock()
+        self._load_state()
+
+    # ------------------------------------------------------------ assignment
+    def assign(self, changes):
+        """Full set (reference worker.go:129-166)."""
+        with self._lock:
+            wanted_tasks: dict[str, Task] = {}
+            for ch in changes:
+                if ch.kind == "task" and ch.action == "update":
+                    wanted_tasks[ch.item.id] = ch.item
+            self._apply_deps(changes, full=True)
+            # drop unknown tasks
+            for tid in list(self._managers):
+                if tid not in wanted_tasks:
+                    self._shutdown_manager(tid)
+            for task in wanted_tasks.values():
+                self._start_or_update(task)
+        self._persist()
+
+    def update(self, changes):
+        """Incremental diff (reference worker.go:168-196)."""
+        with self._lock:
+            self._apply_deps(changes, full=False)
+            for ch in changes:
+                if ch.kind != "task":
+                    continue
+                if ch.action == "update":
+                    self._start_or_update(ch.item)
+                else:
+                    self._shutdown_manager(ch.item)
+        self._persist()
+
+    def _apply_deps(self, changes, full: bool):
+        if full:
+            self.deps = DependencyStore()
+        for ch in changes:
+            if ch.kind == "secret":
+                if ch.action == "update":
+                    self.deps.update_secret(ch.item)
+                else:
+                    self.deps.remove_secret(ch.item)
+            elif ch.kind == "config":
+                if ch.action == "update":
+                    self.deps.update_config(ch.item)
+                else:
+                    self.deps.remove_config(ch.item)
+
+    def _start_or_update(self, task: Task):
+        mgr = self._managers.get(task.id)
+        if mgr is not None and mgr.is_alive():
+            mgr.update(task)
+            return
+        known = self._tasks.get(task.id)
+        if known is not None and known.status.state > task.status.state:
+            # we know more than the manager does (restart case)
+            task = task.copy()
+            task.status = known.status
+        if task.status.state >= TaskState.COMPLETE:
+            self._tasks[task.id] = task
+            return
+        task = task.copy()
+        controller = self.executor.controller(task)
+        mgr = TaskManager(task, controller, self._report_and_track)
+        self._managers[task.id] = mgr
+        self._tasks[task.id] = task
+        mgr.start()
+
+    def _shutdown_manager(self, task_id: str):
+        mgr = self._managers.pop(task_id, None)
+        if mgr is not None:
+            mgr.stop()
+        self._tasks.pop(task_id, None)
+
+    def _report_and_track(self, task_id: str, status: TaskStatus):
+        with self._lock:
+            t = self._tasks.get(task_id)
+            if t is not None:
+                t.status = status
+        self._persist()
+        self.report(task_id, status)
+
+    # ----------------------------------------------------------- persistence
+    def _persist(self):
+        if not self.state_path:
+            return
+        with self._lock:
+            data = {
+                tid: {"state": int(t.status.state), "message": t.status.message,
+                      "err": t.status.err}
+                for tid, t in self._tasks.items()
+            }
+        tmp = self.state_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(data, f)
+            os.replace(tmp, self.state_path)
+        except OSError:
+            pass
+
+    def _load_state(self):
+        if not self.state_path or not os.path.exists(self.state_path):
+            return
+        try:
+            with open(self.state_path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return
+        for tid, st in data.items():
+            t = Task(id=tid)
+            t.status = TaskStatus(state=TaskState(st["state"]),
+                                  message=st.get("message", ""),
+                                  err=st.get("err", ""))
+            self._tasks[tid] = t
+
+    def stop(self):
+        with self._lock:
+            managers = list(self._managers.values())
+            self._managers.clear()
+        for m in managers:
+            m.stop()
+        for m in managers:
+            m.join(timeout=1)

@@ -1,0 +1,191 @@
+"""Allocator: advances tasks NEW → PENDING once resources are allocated.
+
+Behavioral re-derivation of manager/allocator/: the in-tree reference ships
+an *inert* network provider (networkallocator/inert.go — the real CNM
+allocator lives in moby) plus a real port allocator; likewise here the
+network backend is a pluggable seam defaulting to an inert provider, while
+service endpoints get published ports resolved (dynamic range 30000-32767,
+reference portallocator.go) and every NEW task is moved to PENDING once its
+service's networks/ports exist (doTaskAlloc, network.go:870).
+"""
+from __future__ import annotations
+
+import threading
+
+from ..api.objects import (
+    EventCreate,
+    EventUpdate,
+    Network,
+    Service,
+    Task,
+)
+from ..api.types import TaskState
+from ..store import by
+from ..orchestrator.base import EventLoopComponent
+
+DYNAMIC_PORT_START = 30000  # reference portallocator.go dynamic range
+DYNAMIC_PORT_END = 32767
+
+
+class InertNetworkProvider:
+    """No-op network backend (reference networkallocator/inert.go:12-40)."""
+
+    def allocate_network(self, network) -> dict:
+        return {}
+
+    def allocate_service(self, service) -> dict:
+        return {}
+
+    def allocate_task(self, task) -> list:
+        return []
+
+    def deallocate(self, obj) -> None:
+        pass
+
+
+class PortAllocator:
+    """Published-port bookkeeping (reference manager/allocator/portallocator.go)."""
+
+    def __init__(self):
+        self._allocated: dict[tuple[str, int], str] = {}  # (proto, port) -> service
+        self._next_dynamic = DYNAMIC_PORT_START
+        self._lock = threading.Lock()
+
+    def allocate(self, service_id: str, ports) -> bool:
+        """Resolve published_port==0 to a dynamic port; refuse conflicts."""
+        with self._lock:
+            for p in ports:
+                if p.published_port:
+                    owner = self._allocated.get((p.protocol, p.published_port))
+                    if owner is not None and owner != service_id:
+                        return False
+            for p in ports:
+                if p.published_port:
+                    self._allocated[(p.protocol, p.published_port)] = service_id
+                elif p.publish_mode == "ingress":
+                    port = self._find_dynamic(p.protocol)
+                    if port is None:
+                        return False
+                    p.published_port = port
+                    self._allocated[(p.protocol, port)] = service_id
+            return True
+
+    def _find_dynamic(self, protocol: str):
+        start = self._next_dynamic
+        port = start
+        while True:
+            if (protocol, port) not in self._allocated:
+                self._next_dynamic = port + 1
+                if self._next_dynamic > DYNAMIC_PORT_END:
+                    self._next_dynamic = DYNAMIC_PORT_START
+                return port
+            port += 1
+            if port > DYNAMIC_PORT_END:
+                port = DYNAMIC_PORT_START
+            if port == start:
+                return None
+
+    def release(self, service_id: str):
+        with self._lock:
+            for key in [k for k, v in self._allocated.items() if v == service_id]:
+                del self._allocated[key]
+
+
+class Allocator(EventLoopComponent):
+    name = "allocator"
+
+    def __init__(self, store, network_provider=None):
+        super().__init__(store)
+        self.network = network_provider or InertNetworkProvider()
+        self.ports = PortAllocator()
+
+    def setup(self, tx):
+        return tx.find_tasks(by.ByTaskState(TaskState.NEW)), tx.find_services()
+
+    def on_start(self, snapshot):
+        tasks, services = snapshot
+        for s in services:
+            self._allocate_service(s.id)
+        self._allocate_tasks([t.id for t in tasks])
+
+    def handle(self, event):
+        obj = getattr(event, "obj", None)
+        if isinstance(event, (EventCreate, EventUpdate)):
+            if isinstance(obj, Task) and obj.status.state == TaskState.NEW:
+                self._allocate_tasks([obj.id])
+            elif isinstance(obj, Service):
+                self._allocate_service(obj.id)
+            elif isinstance(obj, Network):
+                self._allocate_network(obj.id)
+
+    # ------------------------------------------------------------- allocation
+    def _allocate_network(self, network_id: str):
+        def cb(tx):
+            n = tx.get_network(network_id)
+            if n is None or n.driver_state is not None:
+                return
+            n = n.copy()
+            n.driver_state = self.network.allocate_network(n) or {"inert": True}
+            tx.update(n)
+
+        self.store.update(cb)
+
+    def _allocate_service(self, service_id: str):
+        def cb(tx):
+            s = tx.get_service(service_id)
+            if s is None:
+                return
+            ports = s.spec.endpoint.ports
+            if not ports:
+                return
+            if s.endpoint is not None and s.endpoint.get("ports_allocated"):
+                # re-allocate only when the spec's port set changed
+                current = {(p.protocol, p.target_port, p.publish_mode)
+                           for p in ports}
+                if s.endpoint.get("port_set") == sorted(current):
+                    return
+            s = s.copy()
+            ok = self.ports.allocate(s.id, s.spec.endpoint.ports)
+            if not ok:
+                return  # retried when ports free up
+            s.endpoint = {
+                "ports_allocated": True,
+                "port_set": sorted({(p.protocol, p.target_port, p.publish_mode)
+                                    for p in s.spec.endpoint.ports}),
+                "ports": [
+                    (p.protocol, p.target_port, p.published_port, p.publish_mode)
+                    for p in s.spec.endpoint.ports
+                ],
+            }
+            tx.update(s)
+
+        self.store.update(cb)
+
+    def _allocate_tasks(self, task_ids: list[str]):
+        def cb(batch):
+            for tid in task_ids:
+                def move_one(tx, tid=tid):
+                    t = tx.get_task(tid)
+                    if t is None or t.status.state != TaskState.NEW:
+                        return
+                    service = tx.get_service(t.service_id) if t.service_id else None
+                    if service is not None and service.spec.endpoint.ports and (
+                            service.endpoint is None
+                            or not service.endpoint.get("ports_allocated")):
+                        return  # wait for service allocation first
+                    t = t.copy()
+                    t.networks = self.network.allocate_task(t)
+                    if service is not None and service.endpoint:
+                        from ..api.specs import EndpointSpec, PortConfig
+                        t.endpoint = EndpointSpec(ports=[
+                            PortConfig(protocol=proto, target_port=tp,
+                                       published_port=pub, publish_mode=mode)
+                            for proto, tp, pub, mode in service.endpoint["ports"]
+                        ])
+                    t.status.state = TaskState.PENDING
+                    t.status.message = "pending task scheduling"
+                    tx.update(t)
+
+                batch.update(move_one)
+
+        self.store.batch(cb)

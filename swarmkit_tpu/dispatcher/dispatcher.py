@@ -1,0 +1,373 @@
+"""Dispatcher: the manager side of the worker protocol.
+
+Behavioral re-derivation of manager/dispatcher/dispatcher.go: node
+registration issuing session ids, heartbeat liveness (period 5s, grace ×3 —
+dispatcher.go:28-53), assignment streaming (initial COMPLETE snapshot then
+INCREMENTAL diffs batched every 100ms — :1013-1207), task status write-back
+batching (:726-886), and down-node handling (mark DOWN → orchestrators
+reschedule; ORPHANED after 24h).
+
+Transport: sessions expose a watch `Channel` of assignment messages — the
+in-process equivalent of the Dispatcher.Assignments gRPC stream; the wire
+layer (swarmkit_tpu.rpc) carries the same messages across processes.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..api.objects import (
+    Config,
+    EventCommit,
+    EventCreate,
+    EventDelete,
+    EventUpdate,
+    Node,
+    Secret,
+    Task,
+)
+from ..api.types import NodeStatusState, TaskState
+from ..store import by
+from ..store.memory import MemoryStore
+from ..store.watch import Channel, WatchQueue
+from ..utils.identity import new_id
+from .heartbeat import Heartbeat
+
+DEFAULT_HEARTBEAT_PERIOD = 5.0       # reference: dispatcher.go:28-53
+HEARTBEAT_EPSILON = 0.5
+GRACE_MULTIPLIER = 3
+BATCH_INTERVAL = 0.1                 # assignment/status batching, 100ms
+MAX_BATCH_ITEMS = 10000
+
+
+class DispatcherError(Exception):
+    pass
+
+
+class SessionInvalid(DispatcherError):
+    pass
+
+
+@dataclass
+class Assignment:
+    """One element of an assignment message: a task/secret/config the node
+    must run or may drop (reference api/dispatcher.proto Assignment)."""
+
+    action: str   # "update" | "remove"
+    kind: str     # "task" | "secret" | "config" | "volume"
+    item: object
+
+
+@dataclass
+class AssignmentsMessage:
+    type: str     # "complete" | "incremental"
+    app_sequence: int
+    changes: list[Assignment] = field(default_factory=list)
+
+
+@dataclass
+class Session:
+    node_id: str
+    session_id: str
+    channel: Channel
+    heartbeat: Heartbeat
+    sequence: int = 0
+    known_tasks: dict[str, int] = field(default_factory=dict)  # id -> version
+    known_secrets: set[str] = field(default_factory=set)
+    known_configs: set[str] = field(default_factory=set)
+
+
+class Dispatcher:
+    def __init__(self, store: MemoryStore,
+                 heartbeat_period: float = DEFAULT_HEARTBEAT_PERIOD):
+        self.store = store
+        self.heartbeat_period = heartbeat_period
+        self._sessions: dict[str, Session] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._status_queue: list[tuple[str, object]] = []  # (task_id, status)
+        self._status_cond = threading.Condition()
+        self._dirty_nodes: set[str] = set()
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="dispatcher")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        with self._status_cond:
+            self._status_cond.notify_all()
+        if self._thread:
+            self._thread.join(timeout=5)
+        with self._lock:
+            for s in self._sessions.values():
+                s.heartbeat.stop()
+                s.channel.close()
+            self._sessions.clear()
+
+    # ------------------------------------------------------------------- rpc
+    def register(self, node_id: str, description=None) -> str:
+        """reference: dispatcher.go:553 register — issues a session id and
+        marks the node READY."""
+
+        def cb(tx):
+            node = tx.get_node(node_id)
+            if node is None:
+                node = Node(id=node_id)
+                node.status.state = NodeStatusState.READY
+                if description is not None:
+                    node.description = description
+                tx.create(node)
+            else:
+                node = node.copy()
+                node.status.state = NodeStatusState.READY
+                node.status.message = ""
+                if description is not None:
+                    node.description = description
+                tx.update(node)
+
+        self.store.update(cb)
+
+        session_id = new_id()
+        hb = Heartbeat(self.heartbeat_period * GRACE_MULTIPLIER,
+                       lambda: self._node_down(node_id, session_id))
+        session = Session(
+            node_id=node_id,
+            session_id=session_id,
+            channel=Channel(matcher=None, limit=None),
+            heartbeat=hb,
+        )
+        with self._lock:
+            old = self._sessions.pop(node_id, None)
+            if old is not None:
+                old.heartbeat.stop()
+                old.channel.close()
+            self._sessions[node_id] = session
+            self._dirty_nodes.add(node_id)
+        hb.start()
+        return session_id
+
+    def heartbeat(self, node_id: str, session_id: str) -> float:
+        """reference: dispatcher.go:1317-1335."""
+        session = self._session(node_id, session_id)
+        session.heartbeat.beat()
+        return self.heartbeat_period
+
+    def assignments(self, node_id: str, session_id: str) -> Channel:
+        """Subscribe to this node's assignment stream; the initial COMPLETE
+        snapshot is pushed before return (dispatcher.go:1013-1207)."""
+        session = self._session(node_id, session_id)
+        with self._lock:
+            msg = self._full_assignment(session)
+            session.channel._offer(msg)
+        return session.channel
+
+    def update_task_status(self, node_id: str, session_id: str,
+                           updates: list[tuple[str, object]]):
+        """Enqueue observed-state updates; written in batches
+        (dispatcher.go:607, processUpdates :726-886)."""
+        self._session(node_id, session_id)
+        with self._status_cond:
+            self._status_queue.extend(updates)
+            self._status_cond.notify_all()
+
+    def leave(self, node_id: str, session_id: str):
+        """Graceful node departure."""
+        session = self._session(node_id, session_id)
+        session.heartbeat.stop()
+        session.channel.close()
+        with self._lock:
+            self._sessions.pop(node_id, None)
+        self._node_down(node_id, session_id, graceful=True)
+
+    # ------------------------------------------------------------- internals
+    def _session(self, node_id: str, session_id: str) -> Session:
+        with self._lock:
+            s = self._sessions.get(node_id)
+        if s is None or s.session_id != session_id:
+            raise SessionInvalid(f"session {session_id} invalid for {node_id}")
+        return s
+
+    def _node_down(self, node_id: str, session_id: str, graceful=False):
+        with self._lock:
+            s = self._sessions.get(node_id)
+            if s is not None and s.session_id == session_id:
+                s.heartbeat.stop()
+                s.channel.close()
+                self._sessions.pop(node_id, None)
+            elif not graceful:
+                return  # superseded session
+
+        def cb(tx):
+            node = tx.get_node(node_id)
+            if node is None:
+                return
+            node = node.copy()
+            node.status.state = NodeStatusState.DOWN
+            node.status.message = ("node left" if graceful
+                                   else "heartbeat failure")
+            tx.update(node)
+
+        try:
+            self.store.update(cb)
+        except Exception:
+            pass
+
+    # ---------------------------------------------------------- event plane
+    def _run(self):
+        _, ch = self.store.view_and_watch(lambda tx: None, limit=None)
+        last_flush = time.monotonic()
+        try:
+            while not self._stop.is_set():
+                self._flush_statuses()
+                try:
+                    ev = ch.get(timeout=BATCH_INTERVAL / 2)
+                except TimeoutError:
+                    ev = None
+                except Exception:
+                    return
+                if ev is not None:
+                    self._note_event(ev)
+                now = time.monotonic()
+                if now - last_flush >= BATCH_INTERVAL:
+                    self._send_incrementals()
+                    last_flush = now
+        finally:
+            self.store.queue.stop_watch(ch)
+
+    def _note_event(self, ev):
+        obj = getattr(ev, "obj", None)
+        if isinstance(obj, Task):
+            if obj.node_id:
+                with self._lock:
+                    self._dirty_nodes.add(obj.node_id)
+            if isinstance(ev, EventUpdate) and ev.old is not None \
+                    and ev.old.node_id and ev.old.node_id != obj.node_id:
+                with self._lock:
+                    self._dirty_nodes.add(ev.old.node_id)
+        elif isinstance(obj, (Secret, Config)):
+            # conservatively refresh all sessions (reference diffs references)
+            with self._lock:
+                self._dirty_nodes.update(self._sessions.keys())
+
+    # ---------------------------------------------------- assignment building
+    def _relevant_tasks(self, tx, node_id: str) -> list[Task]:
+        return [
+            t for t in tx.find_tasks(by.ByNodeID(node_id))
+            if t.status.state >= TaskState.ASSIGNED
+            and t.desired_state <= TaskState.REMOVE
+        ]
+
+    def _referenced_deps(self, tx, tasks) -> tuple[dict, dict]:
+        secrets, configs = {}, {}
+        for t in tasks:
+            if t.desired_state > TaskState.RUNNING:
+                continue
+            runtime = t.spec.runtime
+            if runtime is None:
+                continue
+            for ref in runtime.secrets:
+                s = tx.get_secret(ref.secret_id)
+                if s is not None:
+                    secrets[s.id] = s
+            for ref in runtime.configs:
+                c = tx.get_config(ref.config_id)
+                if c is not None:
+                    configs[c.id] = c
+        return secrets, configs
+
+    def _full_assignment(self, session: Session) -> AssignmentsMessage:
+        def cb(tx):
+            tasks = self._relevant_tasks(tx, session.node_id)
+            secrets, configs = self._referenced_deps(tx, tasks)
+            return tasks, secrets, configs
+
+        tasks, secrets, configs = self.store.view(cb)
+        session.known_tasks = {t.id: t.meta.version.index for t in tasks}
+        session.known_secrets = set(secrets)
+        session.known_configs = set(configs)
+        session.sequence += 1
+        changes = (
+            [Assignment("update", "task", t.copy()) for t in tasks]
+            + [Assignment("update", "secret", s.copy()) for s in secrets.values()]
+            + [Assignment("update", "config", c.copy()) for c in configs.values()]
+        )
+        return AssignmentsMessage("complete", session.sequence, changes)
+
+    def _send_incrementals(self):
+        with self._lock:
+            dirty = self._dirty_nodes
+            self._dirty_nodes = set()
+            sessions = [self._sessions[n] for n in dirty if n in self._sessions]
+        for session in sessions:
+            msg = self._incremental(session)
+            if msg.changes:
+                session.channel._offer(msg)
+
+    def _incremental(self, session: Session) -> AssignmentsMessage:
+        def cb(tx):
+            tasks = self._relevant_tasks(tx, session.node_id)
+            secrets, configs = self._referenced_deps(tx, tasks)
+            return tasks, secrets, configs
+
+        tasks, secrets, configs = self.store.view(cb)
+        changes: list[Assignment] = []
+        new_known = {t.id: t.meta.version.index for t in tasks}
+        for t in tasks:
+            old_version = session.known_tasks.get(t.id)
+            if old_version is None or old_version != t.meta.version.index:
+                changes.append(Assignment("update", "task", t.copy()))
+        for tid in session.known_tasks:
+            if tid not in new_known:
+                changes.append(Assignment("remove", "task", tid))
+        for sid, s in secrets.items():
+            if sid not in session.known_secrets:
+                changes.append(Assignment("update", "secret", s.copy()))
+        for sid in session.known_secrets - set(secrets):
+            changes.append(Assignment("remove", "secret", sid))
+        for cid, c in configs.items():
+            if cid not in session.known_configs:
+                changes.append(Assignment("update", "config", c.copy()))
+        for cid in session.known_configs - set(configs):
+            changes.append(Assignment("remove", "config", cid))
+        session.known_tasks = new_known
+        session.known_secrets = set(secrets)
+        session.known_configs = set(configs)
+        if changes:
+            session.sequence += 1
+        return AssignmentsMessage("incremental", session.sequence, changes)
+
+    # ------------------------------------------------------- status flushing
+    def _flush_statuses(self):
+        with self._status_cond:
+            if not self._status_queue:
+                return
+            updates, self._status_queue = self._status_queue, []
+
+        # de-dup: last status per task wins within a batch
+        latest: dict[str, object] = {}
+        for task_id, status in updates:
+            latest[task_id] = status
+
+        def cb(batch):
+            for task_id, status in latest.items():
+                def update_one(tx, task_id=task_id, status=status):
+                    cur = tx.get_task(task_id)
+                    if cur is None:
+                        return
+                    # monotonic: never lower observed state
+                    if status.state < cur.status.state:
+                        return
+                    cur = cur.copy()
+                    cur.status = status
+                    tx.update(cur)
+                batch.update(update_one)
+
+        try:
+            self.store.batch(cb)
+        except Exception:
+            pass

@@ -1,0 +1,45 @@
+"""Per-node heartbeat timer (reference: manager/dispatcher/heartbeat/heartbeat.go)."""
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+
+class Heartbeat:
+    """Fires `on_expire` once if `beat()` isn't called within `timeout`."""
+
+    def __init__(self, timeout: float, on_expire: Callable[[], None]):
+        self.timeout = timeout
+        self.on_expire = on_expire
+        self._timer: threading.Timer | None = None
+        self._lock = threading.Lock()
+        self._stopped = False
+
+    def start(self):
+        self.beat()
+
+    def beat(self, timeout: float | None = None):
+        if timeout is not None:
+            self.timeout = timeout
+        with self._lock:
+            if self._stopped:
+                return
+            if self._timer is not None:
+                self._timer.cancel()
+            self._timer = threading.Timer(self.timeout, self._expire)
+            self._timer.daemon = True
+            self._timer.start()
+
+    def _expire(self):
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+        self.on_expire()
+
+    def stop(self):
+        with self._lock:
+            self._stopped = True
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None

@@ -1,0 +1,67 @@
+"""Shared event-loop scaffolding for manager control components.
+
+Every L3 component in the reference is a `Run(ctx)` goroutine over store
+watches started on leadership (manager/manager.go:1093-1146). Here each is a
+thread: snapshot-then-watch, dispatch events, periodic idle callback.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+
+from ..store.memory import MemoryStore
+from ..store.watch import ChannelClosed
+
+log = logging.getLogger("swarmkit_tpu.orchestrator")
+
+
+class EventLoopComponent:
+    name = "component"
+
+    def __init__(self, store: MemoryStore):
+        self.store = store
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=self.name)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # -- subclass hooks ------------------------------------------------------
+    def setup(self, tx):
+        """Runs under the snapshot view; return value is passed to on_start."""
+
+    def on_start(self, snapshot):
+        """Initial reconcile after snapshot, before consuming events."""
+
+    def handle(self, event):
+        raise NotImplementedError
+
+    def idle(self):
+        """Called when no events arrived within the poll interval."""
+
+    # -- loop ----------------------------------------------------------------
+    def _run(self):
+        snapshot, ch = self.store.view_and_watch(self.setup, limit=None)
+        try:
+            self.on_start(snapshot)
+            while not self._stop.is_set():
+                try:
+                    ev = ch.get(timeout=0.2)
+                except TimeoutError:
+                    self.idle()
+                    continue
+                except ChannelClosed:
+                    return
+                try:
+                    self.handle(ev)
+                except Exception:
+                    log.exception("%s: error handling %r", self.name, ev)
+        finally:
+            self.store.queue.stop_watch(ch)

@@ -1,0 +1,169 @@
+"""Global-service orchestrator.
+
+Behavioral re-derivation of manager/orchestrator/global/global.go: one task
+per eligible node per global service. Constraints are pre-filtered here
+(constraint.NodeMatches before creating, global.go:254-487) so tasks are
+created with node_id preset and the scheduler only *validates* fit. Drained,
+paused or down nodes get their tasks shut down; new/recovered nodes get
+tasks created.
+"""
+from __future__ import annotations
+
+from ..api.objects import (
+    EventCreate,
+    EventDelete,
+    EventUpdate,
+    Node,
+    Service,
+    Task,
+)
+from ..api.types import NodeAvailability, NodeStatusState, TaskState
+from ..scheduler import constraint as constraint_mod
+from ..store import by
+from .base import EventLoopComponent
+from .restart import RestartSupervisor
+from .task import is_global, new_task, task_runnable
+
+
+def _node_eligible(node: Node, service: Service) -> bool:
+    if node.status.state != NodeStatusState.READY:
+        return False
+    if node.spec.availability != NodeAvailability.ACTIVE:
+        return False
+    exprs = service.spec.task.placement.constraints
+    if exprs:
+        try:
+            constraints = constraint_mod.parse(exprs)
+        except constraint_mod.InvalidConstraint:
+            return False
+        if not constraint_mod.node_matches(constraints, node):
+            return False
+    return True
+
+
+class GlobalOrchestrator(EventLoopComponent):
+    name = "global-orchestrator"
+
+    def __init__(self, store):
+        super().__init__(store)
+        self.restart = RestartSupervisor(store)
+
+    def stop(self):
+        self.restart.stop()
+        super().stop()
+
+    def setup(self, tx):
+        return [s for s in tx.find_services() if is_global(s)]
+
+    def on_start(self, services):
+        for s in services:
+            self.reconcile_service(s.id)
+
+    def handle(self, event):
+        obj = getattr(event, "obj", None)
+        if isinstance(obj, Service):
+            if isinstance(event, EventDelete):
+                self._delete_service_tasks(obj)
+            elif is_global(obj):
+                self.reconcile_service(obj.id)
+        elif isinstance(obj, Node):
+            if isinstance(event, EventDelete):
+                self._node_removed(obj)
+            else:
+                self.reconcile_node(obj.id)
+        elif isinstance(obj, Task) and isinstance(event, EventUpdate):
+            self._handle_task_change(obj)
+
+    # ------------------------------------------------------------- reconcile
+    def reconcile_service(self, service_id: str):
+        def cb(tx):
+            service = tx.get_service(service_id)
+            if service is None or not is_global(service):
+                return
+            nodes = tx.find_nodes()
+            tasks = tx.find_tasks(by.ByServiceID(service_id))
+            by_node: dict[str, list[Task]] = {}
+            for t in tasks:
+                if t.desired_state <= TaskState.RUNNING:
+                    by_node.setdefault(t.node_id, []).append(t)
+            for node in nodes:
+                eligible = _node_eligible(node, service)
+                existing = [t for t in by_node.get(node.id, [])
+                            if task_runnable(t)]
+                if eligible and not existing:
+                    t = new_task(None, service, 0, node_id=node.id)
+                    tx.create(t)
+                elif not eligible:
+                    for t in by_node.get(node.id, []):
+                        cur = tx.get_task(t.id)
+                        if cur is not None and cur.desired_state < TaskState.SHUTDOWN:
+                            cur = cur.copy()
+                            cur.desired_state = TaskState.SHUTDOWN
+                            tx.update(cur)
+
+        self.store.update(cb)
+
+    def reconcile_node(self, node_id: str):
+        def cb(tx):
+            node = tx.get_node(node_id)
+            if node is None:
+                return
+            services = [s for s in tx.find_services() if is_global(s)]
+            tasks = tx.find_tasks(by.ByNodeID(node_id))
+            by_service: dict[str, list[Task]] = {}
+            for t in tasks:
+                if t.desired_state <= TaskState.RUNNING:
+                    by_service.setdefault(t.service_id, []).append(t)
+            for service in services:
+                eligible = _node_eligible(node, service)
+                existing = [t for t in by_service.get(service.id, [])
+                            if task_runnable(t)]
+                if eligible and not existing:
+                    tx.create(new_task(None, service, 0, node_id=node_id))
+                elif not eligible:
+                    for t in by_service.get(service.id, []):
+                        cur = tx.get_task(t.id)
+                        if cur is not None and cur.desired_state < TaskState.SHUTDOWN:
+                            cur = cur.copy()
+                            cur.desired_state = TaskState.SHUTDOWN
+                            tx.update(cur)
+
+        self.store.update(cb)
+
+    def _node_removed(self, node: Node):
+        def cb(tx):
+            for t in tx.find_tasks(by.ByNodeID(node.id)):
+                service = tx.get_service(t.service_id)
+                if service is not None and is_global(service):
+                    if tx.get_task(t.id) is not None:
+                        tx.delete(Task, t.id)
+
+        self.store.update(cb)
+
+    def _handle_task_change(self, task: Task):
+        if task.status.state <= TaskState.RUNNING:
+            return
+        if task.desired_state > TaskState.RUNNING:
+            return
+
+        def cb(tx):
+            service = tx.get_service(task.service_id)
+            if service is None or not is_global(service):
+                return
+            node = tx.get_node(task.node_id) if task.node_id else None
+            if node is None or not _node_eligible(node, service):
+                return
+            self.restart.restart(tx, None, service, task)
+
+        self.store.update(cb)
+
+    def _delete_service_tasks(self, service: Service):
+        def cb(batch):
+            tasks = self.store.view().find_tasks(by.ByServiceID(service.id))
+            for t in tasks:
+                def delete_one(tx, t=t):
+                    if tx.get_task(t.id) is not None:
+                        tx.delete(Task, t.id)
+                batch.update(delete_one)
+
+        self.store.batch(cb)

@@ -1,0 +1,192 @@
+"""Replicated-service orchestrator.
+
+Behavioral re-derivation of manager/orchestrator/replicated/: reconciles each
+replicated service's slot set against spec.replicas — scale-up creates NEW
+tasks in free slots, scale-down prefers shutting slots on the most-loaded
+nodes and non-running slots first (services.go:95-190) — and closes the
+failure loop by routing dead tasks through the restart supervisor
+(tasks.go:47-149). Node-down rescheduling (restartTasksByNodeID) also lives
+here, shared with the global orchestrator via OrchestratorBase.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+
+from ..api.objects import (
+    EventCommit,
+    EventCreate,
+    EventDelete,
+    EventUpdate,
+    Node,
+    Service,
+    Task,
+)
+from ..api.types import NodeAvailability, NodeStatusState, TaskState
+from ..store import by
+from .base import EventLoopComponent
+from .restart import RestartSupervisor
+from .task import (
+    is_replicated,
+    is_task_dirty,
+    new_task,
+    slot_runnable,
+    slots_by_service,
+    task_runnable,
+)
+from .updater import UpdateSupervisor
+
+
+class ReplicatedOrchestrator(EventLoopComponent):
+    name = "replicated-orchestrator"
+
+    def __init__(self, store):
+        super().__init__(store)
+        self.restart = RestartSupervisor(store)
+        self.updater = UpdateSupervisor(store, self.restart)
+
+    def stop(self):
+        self.updater.stop()
+        self.restart.stop()
+        super().stop()
+
+    # ----------------------------------------------------------------- setup
+    def setup(self, tx):
+        return [s for s in tx.find_services() if is_replicated(s)]
+
+    def on_start(self, services):
+        for s in services:
+            self.reconcile(s.id)
+
+    # ---------------------------------------------------------------- events
+    def handle(self, event):
+        if isinstance(event, (EventCreate, EventUpdate)) and isinstance(
+                event.obj, Service):
+            if is_replicated(event.obj):
+                self.reconcile(event.obj.id)
+        elif isinstance(event, EventDelete) and isinstance(event.obj, Service):
+            self._delete_service_tasks(event.obj)
+        elif isinstance(event, EventUpdate) and isinstance(event.obj, Task):
+            self._handle_task_change(event.obj)
+        elif isinstance(event, EventDelete) and isinstance(event.obj, Task):
+            t = event.obj
+            if t.service_id:
+                self.reconcile(t.service_id)
+        elif isinstance(event, EventUpdate) and isinstance(event.obj, Node):
+            self._handle_node_change(event.obj)
+
+    # ------------------------------------------------------------- reconcile
+    def reconcile(self, service_id: str):
+        """reference: replicated/services.go:95-190."""
+
+        def cb(tx):
+            service = tx.get_service(service_id)
+            if service is None or not is_replicated(service):
+                return
+            tasks = [
+                t for t in tx.find_tasks(by.ByServiceID(service_id))
+                if t.desired_state <= TaskState.RUNNING
+            ]
+            slots = slots_by_service(tasks).get(service_id, {})
+            runnable = {
+                slot: ts for slot, ts in slots.items() if slot_runnable(ts)
+            }
+            specified = service.spec.replicas
+
+            if len(runnable) < specified:
+                # scale up: fill the lowest free slot numbers
+                used = set(slots.keys())
+                slot_num = 1
+                to_create = specified - len(runnable)
+                created = 0
+                while created < to_create:
+                    if slot_num not in used:
+                        t = new_task(None, service, slot_num)
+                        tx.create(t)
+                        used.add(slot_num)
+                        created += 1
+                    slot_num += 1
+            elif len(runnable) > specified:
+                # scale down: keep running slots on least-loaded nodes
+                # (reference sorts by running-state then node balance)
+                node_load: dict[str, int] = defaultdict(int)
+                for ts in runnable.values():
+                    for t in ts:
+                        if t.node_id:
+                            node_load[t.node_id] += 1
+
+                def slot_key(item):
+                    slot, ts = item
+                    running = any(
+                        t.status.state == TaskState.RUNNING for t in ts)
+                    load = max((node_load.get(t.node_id, 0)
+                                for t in ts if t.node_id), default=0)
+                    return (0 if running else 1, -load, -slot)
+
+                ordered = sorted(runnable.items(), key=slot_key)
+                for slot, ts in ordered[specified:]:
+                    for t in ts:
+                        cur = tx.get_task(t.id)
+                        if cur is not None and cur.desired_state < TaskState.REMOVE:
+                            cur = cur.copy()
+                            cur.desired_state = TaskState.REMOVE
+                            tx.update(cur)
+
+            # dirty slots (spec changed) → rolling updater
+            dirty = [
+                ts for ts in runnable.values()
+                if any(is_task_dirty(service, t) for t in ts)
+            ]
+            if dirty:
+                self.updater.update(service, dirty)
+
+        self.store.update(cb)
+
+    # ----------------------------------------------------------- task events
+    def _handle_task_change(self, task: Task):
+        """Dead task whose slot is still desired → restart
+        (reference replicated/tasks.go:47-149)."""
+        if task.status.state <= TaskState.RUNNING:
+            return
+        if task.desired_state > TaskState.RUNNING:
+            return  # shutdown was requested; reaper handles cleanup
+
+        def cb(tx):
+            service = tx.get_service(task.service_id)
+            if service is None or not is_replicated(service):
+                return
+            if task.slot > service.spec.replicas:
+                return
+            self.restart.restart(tx, None, service, task)
+
+        self.store.update(cb)
+
+    # ----------------------------------------------------------- node events
+    def _handle_node_change(self, node: Node):
+        down = (node.status.state == NodeStatusState.DOWN
+                or node.spec.availability == NodeAvailability.DRAIN)
+        if not down:
+            return
+
+        def cb(tx):
+            for task in tx.find_tasks(by.ByNodeID(node.id)):
+                if task.desired_state > TaskState.RUNNING:
+                    continue
+                if task.status.state > TaskState.RUNNING:
+                    continue
+                service = tx.get_service(task.service_id)
+                if service is None or not is_replicated(service):
+                    continue
+                self.restart.restart(tx, None, service, task)
+
+        self.store.update(cb)
+
+    def _delete_service_tasks(self, service: Service):
+        def cb(batch):
+            tasks = self.store.view().find_tasks(by.ByServiceID(service.id))
+            for t in tasks:
+                def delete_one(tx, t=t):
+                    if tx.get_task(t.id) is not None:
+                        tx.delete(Task, t.id)
+                batch.update(delete_one)
+
+        self.store.batch(cb)

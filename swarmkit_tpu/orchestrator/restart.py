@@ -1,0 +1,151 @@
+"""Restart supervisor.
+
+Behavioral re-derivation of manager/orchestrator/restart/restart.go: decides
+whether a dead task restarts (condition any/on-failure/none), enforces
+MaxAttempts within Window via per-slot history, marks the old task
+desired=SHUTDOWN, creates the replacement in the same slot with
+desired=READY, and promotes it to RUNNING after the configured delay
+(DelayStart, restart.go:433-524).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..api.objects import Service, Task
+from ..api.types import RestartCondition, TaskState
+from ..store.memory import MemoryStore
+from .task import is_job, new_task
+
+
+@dataclass
+class RestartedInstance:
+    timestamp: float
+
+
+@dataclass
+class InstanceRestartInfo:
+    total_restarts: int = 0
+    restarted_instances: list[RestartedInstance] = field(default_factory=list)
+
+
+class RestartSupervisor:
+    def __init__(self, store: MemoryStore):
+        self.store = store
+        self._history: dict[tuple[str, int | str], InstanceRestartInfo] = {}
+        self._delays: dict[str, threading.Timer] = {}
+        self._lock = threading.Lock()
+        self._stopped = False
+
+    def stop(self):
+        with self._lock:
+            self._stopped = True
+            timers = list(self._delays.values())
+            self._delays.clear()
+        for t in timers:
+            t.cancel()
+
+    # ------------------------------------------------------------------ api
+    def restart(self, tx, cluster, service: Service, task: Task) -> None:
+        """Called within a store transaction when a task died
+        (reference restart.go:117-213)."""
+        # mark old task for shutdown if not already
+        cur = tx.get_task(task.id)
+        if cur is not None and cur.desired_state < TaskState.SHUTDOWN:
+            cur = cur.copy()
+            cur.desired_state = TaskState.SHUTDOWN
+            tx.update(cur)
+
+        if not self.should_restart(task, service):
+            return
+
+        replacement = new_task(cluster, service, task.slot,
+                               task.node_id if not task.slot else "")
+        replacement.desired_state = TaskState.READY
+        tx.create(replacement)
+
+        self._record(task, service)
+        delay = service.spec.task.restart.delay
+        self._delay_start(replacement.id, delay)
+
+    def should_restart(self, task: Task, service: Service) -> bool:
+        """reference restart.go:215+ shouldRestart."""
+        if is_job(service) and task.status.state == TaskState.COMPLETE:
+            return False
+        condition = service.spec.task.restart.condition
+        if condition == RestartCondition.NONE:
+            return False
+        if condition == RestartCondition.ON_FAILURE and task.status.state in (
+                TaskState.COMPLETE,):
+            return False
+        restart_policy = service.spec.task.restart
+        if restart_policy.max_attempts > 0:
+            key = self._instance_key(task)
+            info = self._history.get(key)
+            if info is not None:
+                if restart_policy.window <= 0:
+                    if info.total_restarts >= restart_policy.max_attempts:
+                        return False
+                else:
+                    now = time.time()
+                    recent = [
+                        r for r in info.restarted_instances
+                        if now - r.timestamp <= restart_policy.window
+                    ]
+                    info.restarted_instances = recent
+                    if len(recent) >= restart_policy.max_attempts:
+                        return False
+        return True
+
+    # ------------------------------------------------------------ internals
+    def _instance_key(self, task: Task):
+        return (task.service_id, task.slot if task.slot else task.node_id)
+
+    def _record(self, task: Task, service: Service) -> None:
+        key = self._instance_key(task)
+        info = self._history.setdefault(key, InstanceRestartInfo())
+        info.total_restarts += 1
+        if service.spec.task.restart.window > 0:
+            info.restarted_instances.append(RestartedInstance(time.time()))
+
+    def _delay_start(self, task_id: str, delay: float) -> None:
+        """Promote READY→RUNNING after the restart delay."""
+
+        def promote():
+            with self._lock:
+                self._delays.pop(task_id, None)
+                if self._stopped:
+                    return
+
+            def cb(tx):
+                cur = tx.get_task(task_id)
+                if cur is None or cur.desired_state != TaskState.READY:
+                    return
+                cur = cur.copy()
+                cur.desired_state = TaskState.RUNNING
+                tx.update(cur)
+
+            try:
+                self.store.update(cb)
+            except Exception:
+                pass
+
+        with self._lock:
+            if self._stopped:
+                return
+            if delay <= 0:
+                # immediate promote still goes through a fresh transaction
+                # (we are called inside one that created the task)
+                timer = threading.Timer(0.0, promote)
+            else:
+                timer = threading.Timer(delay, promote)
+            timer.daemon = True
+            self._delays[task_id] = timer
+            timer.start()
+
+    def cancel_delay(self, task_id: str) -> None:
+        with self._lock:
+            t = self._delays.pop(task_id, None)
+        if t:
+            t.cancel()

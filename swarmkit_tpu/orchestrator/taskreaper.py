@@ -1,0 +1,112 @@
+"""Task reaper: garbage collection of dead and removed tasks.
+
+Behavioral re-derivation of manager/orchestrator/taskreaper/task_reaper.go:
+  * per-slot history retention — keep at most TaskHistoryRetentionLimit dead
+    tasks per (service, slot) / (service, node);
+  * tasks with desired_state == REMOVE are deleted once observed shut down
+    (their service scaled down or was deleted);
+  * ORPHANED tasks are deleted once no longer referenced.
+Runs on commit events, batching deletes (task_reaper.go:68-220, tick :232-387).
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+
+from ..api.objects import EventCommit, EventCreate, EventUpdate, Task
+from ..api.types import TaskState
+from ..store import by
+from .base import EventLoopComponent
+
+
+class TaskReaper(EventLoopComponent):
+    name = "task-reaper"
+
+    def __init__(self, store, retention_limit: int | None = None):
+        super().__init__(store)
+        self._retention_override = retention_limit
+        self._dirty: set[tuple[str, int, str]] = set()
+        self._maybe_remove: set[str] = set()
+
+    def _retention(self, tx) -> int:
+        if self._retention_override is not None:
+            return self._retention_override
+        clusters = tx.find_clusters()
+        if clusters:
+            return clusters[0].spec.task_history_retention_limit
+        return 5
+
+    def setup(self, tx):
+        # initial sweep: anything already eligible
+        for t in tx.find_tasks():
+            self._note(t)
+        return None
+
+    def on_start(self, _):
+        self.tick()
+
+    def _note(self, t: Task):
+        if t.desired_state == TaskState.REMOVE or t.status.state == TaskState.ORPHANED:
+            self._maybe_remove.add(t.id)
+        self._dirty.add((t.service_id, t.slot, t.node_id))
+
+    def handle(self, event):
+        if isinstance(event, (EventCreate, EventUpdate)) and isinstance(
+                event.obj, Task):
+            self._note(event.obj)
+        elif isinstance(event, EventCommit):
+            if self._dirty or self._maybe_remove:
+                self.tick()
+
+    def tick(self):
+        dirty, self._dirty = self._dirty, set()
+        maybe_remove, self._maybe_remove = self._maybe_remove, set()
+        deletes: list[str] = []
+
+        view = self.store.view()
+        retention = self._retention(view)
+
+        for task_id in maybe_remove:
+            t = view.get_task(task_id)
+            if t is None:
+                continue
+            if t.desired_state == TaskState.REMOVE and \
+                    t.status.state >= TaskState.SHUTDOWN:
+                deletes.append(t.id)
+            elif t.status.state == TaskState.ORPHANED:
+                deletes.append(t.id)
+
+        if retention >= 0:
+            by_slot: dict[tuple, list[Task]] = defaultdict(list)
+            for service_id, slot, node_id in dirty:
+                if not service_id:
+                    continue
+                sel = (by.BySlot(service_id, slot) if slot
+                       else by.ByServiceID(service_id))
+                for t in view.find_tasks(sel):
+                    if slot == 0 and t.node_id != node_id:
+                        continue
+                    key = (service_id, slot, node_id if not slot else "")
+                    by_slot[key].append(t)
+            for key, ts in by_slot.items():
+                dead = sorted(
+                    (t for t in ts
+                     if t.desired_state > TaskState.RUNNING
+                     and t.status.state > TaskState.RUNNING
+                     and t.desired_state != TaskState.REMOVE),
+                    key=lambda t: t.status.timestamp,
+                )
+                excess = len(dead) - retention
+                for t in dead[:max(excess, 0)]:
+                    deletes.append(t.id)
+
+        if not deletes:
+            return
+
+        def cb(batch):
+            for tid in deletes:
+                def delete_one(tx, tid=tid):
+                    if tx.get_task(tid) is not None:
+                        tx.delete(Task, tid)
+                batch.update(delete_one)
+
+        self.store.batch(cb)

@@ -110,7 +110,9 @@ def _match_ip(constraint: Constraint, addr: str) -> bool:
     except ValueError:
         pass
     try:
-        subnet = ipaddress.ip_network(constraint.exp, strict=True)
+        # strict=False masks host bits, matching net.ParseCIDR: '10.0.0.5/24'
+        # is the 10.0.0.0/24 subnet
+        subnet = ipaddress.ip_network(constraint.exp, strict=False)
         within = node_ip is not None and node_ip in subnet
         return within if constraint.operator == EQ else not within
     except ValueError:

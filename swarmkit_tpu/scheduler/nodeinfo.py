@@ -144,9 +144,12 @@ class NodeInfo:
         now = time.monotonic() if now is None else now
         self._maybe_cleanup(now)
         window = self.recent_failures.setdefault(service_key, [])
-        if len(window) == MAX_FAILURES:
-            # already saturated; drop expired entries instead of growing
+        if len(window) >= MAX_FAILURES:
+            # hard cap: prune expired entries, then drop the oldest so the
+            # ring never exceeds MAX_FAILURES (reference nodeinfo.go:163-221)
             window[:] = [ts for ts in window if now - ts <= FAILURE_WINDOW]
+            if len(window) >= MAX_FAILURES:
+                del window[:len(window) - MAX_FAILURES + 1]
         window.append(now)
 
     def count_recent_failures(self, service_key: tuple[str, int],

@@ -253,12 +253,7 @@ class Scheduler:
         # elsewhere) — evicted from the unassigned pool after the batch;
         # conflicted decisions are NOT dropped and retry next tick
         drop: list[str] = []
-        explain_cache: dict[tuple[str, int], str] = {}
-
-        def explain(group):
-            if group.key not in explain_cache:
-                explain_cache[group.key] = self._explain(group)
-            return explain_cache[group.key]
+        unplaced: list[tuple[Task, TaskGroup]] = []
 
         def batch_cb(batch):
             for group in groups:
@@ -274,17 +269,11 @@ class Scheduler:
                             drop.append(task.id)
                             return
                         if node_id is None:
-                            # no suitable node: record the explanation, but
-                            # only when it changed — rewriting identical
-                            # status would retrigger ticks forever through
-                            # the commit-event debounce
-                            explanation = explain(group)
-                            if cur.status.err != explanation:
-                                cur = cur.copy()
-                                cur.status.message = "scheduler: no suitable node"
-                                cur.status.err = explanation
-                                cur.status.timestamp = time.time()
-                                tx.update(cur)
+                            # explanation is written in a second pass, after
+                            # node bookkeeping reflects this tick's sibling
+                            # placements — else 'insufficient resources'
+                            # reads as 'all filters passed'
+                            unplaced.append((cur, group))
                             return
                         node = tx.get_node(node_id)
                         if node is None or node.status.state != NodeStatusState.READY:
@@ -332,6 +321,34 @@ class Scheduler:
             self.store.batch(write_generic)
         for task_id in drop:
             self.unassigned.pop(task_id, None)
+
+        if unplaced:
+            # second pass: explanations against bookkeeping that now includes
+            # this tick's placements, written only on change so identical
+            # failures don't retrigger the commit debounce forever
+            explain_cache: dict[tuple[str, int], str] = {}
+
+            def explain_cb(batch):
+                for task, group in unplaced:
+                    if group.key not in explain_cache:
+                        explain_cache[group.key] = self._explain(group)
+                    explanation = explain_cache[group.key]
+
+                    def write_one(tx, task=task, explanation=explanation):
+                        cur = tx.get_task(task.id)
+                        if cur is None or cur.status.state != TaskState.PENDING:
+                            return
+                        if cur.status.err == explanation:
+                            return
+                        cur = cur.copy()
+                        cur.status.message = "scheduler: no suitable node"
+                        cur.status.err = explanation
+                        cur.status.timestamp = time.time()
+                        tx.update(cur)
+
+                    batch.update(write_one)
+
+            self.store.batch(explain_cb)
         # everything else (no-suitable-node, conflicted commits) stays in
         # self.unassigned; node/task events retrigger the tick
 
@@ -362,23 +379,32 @@ class Scheduler:
                     cur = tx.get_task(task.id)
                     if cur is None or cur.status.state != TaskState.PENDING:
                         return
-                    cur = cur.copy()
-                    cur.status.timestamp = time.time()
                     if fits:
+                        cur = cur.copy()
+                        cur.status.timestamp = time.time()
                         cur.status.state = TaskState.ASSIGNED
-                        cur.status.message = "scheduler confirmed task can run on preassigned node"
+                        cur.status.message = (
+                            "scheduler confirmed task can run on preassigned node")
+                        tx.update(cur)
                     else:
-                        cur.status.state = TaskState.REJECTED
-                        cur.status.message = "preassigned node no longer meets constraints"
-                    tx.update(cur)
+                        # keep PENDING and retry later — transient pressure
+                        # (resources, ports) may clear (reference
+                        # scheduler.go:654-661 only records Status.Err)
+                        err = "preassigned node does not satisfy filters"
+                        if cur.status.err != err:
+                            cur = cur.copy()
+                            cur.status.timestamp = time.time()
+                            cur.status.err = err
+                            tx.update(cur)
 
                 batch.update(update_one)
 
         if decided:
             self.store.batch(batch_cb)
         for task, fits in decided:
-            self.preassigned.pop(task.id, None)
             if fits:
+                self.preassigned.pop(task.id, None)
                 info = self.node_infos.get(task.node_id)
                 if info:
                     info.add_task(task)
+            # non-fitting tasks stay in self.preassigned for retry

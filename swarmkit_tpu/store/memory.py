@@ -159,6 +159,15 @@ class WriteTx(ReadTx):
                 f"{obj.TABLE} {obj.id}: update at version "
                 f"{obj.meta.version.index}, store at {old.meta.version.index}"
             )
+        new_name = _name_of(obj)
+        if obj.TABLE in ("service", "node") and new_name \
+                and new_name.lower() != _name_of(old).lower():
+            # renames must keep names unique (reference services.go:98-104
+            # ErrNameConflict)
+            clash = [o for o in self.find(type(obj), by_mod.ByName(new_name))
+                     if o.id != obj.id]
+            if clash:
+                raise ExistError(f"{obj.TABLE} name {new_name!r} is in use")
         obj = obj.copy()
         self._writes[(obj.TABLE, obj.id)] = obj
         self._changelist.append(StoreAction(StoreAction.UPDATE, obj))
@@ -171,10 +180,8 @@ class WriteTx(ReadTx):
         self._changelist.append(StoreAction(StoreAction.DELETE, old))
 
 
-def _name_of(obj: StoreObject) -> str:
-    spec = getattr(obj, "spec", None)
-    ann = getattr(spec, "annotations", None) or getattr(obj, "annotations", None)
-    return getattr(ann, "name", "") if ann is not None else ""
+# single source of truth for object naming lives with the selectors
+_name_of = by_mod._name_of
 
 
 class MemoryStore:

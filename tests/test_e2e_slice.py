@@ -1,0 +1,184 @@
+"""The minimum end-to-end slice (SURVEY.md §7): store → replicated
+orchestrator → scheduler → dispatcher → agent(fake executor), driving
+services NEW→PENDING→ASSIGNED→…→RUNNING with status write-back, plus the
+failure → restart → reschedule loop and node-death rescheduling."""
+import time
+
+import pytest
+
+from swarmkit_tpu.agent.agent import Agent
+from swarmkit_tpu.agent.testutils import FakeExecutor
+from swarmkit_tpu.allocator.allocator import Allocator
+from swarmkit_tpu.api.objects import Service
+from swarmkit_tpu.api.specs import Annotations, ServiceSpec
+from swarmkit_tpu.api.types import (
+    NodeStatusState,
+    RestartCondition,
+    ServiceMode,
+    TaskState,
+)
+from swarmkit_tpu.dispatcher.dispatcher import Dispatcher
+from swarmkit_tpu.orchestrator.global_ import GlobalOrchestrator
+from swarmkit_tpu.orchestrator.replicated import ReplicatedOrchestrator
+from swarmkit_tpu.orchestrator.taskreaper import TaskReaper
+from swarmkit_tpu.scheduler.scheduler import Scheduler
+from swarmkit_tpu.store import by
+from swarmkit_tpu.store.memory import MemoryStore
+
+from test_scheduler import wait_for
+
+
+class MiniCluster:
+    """In-process manager + N agents on fake executors."""
+
+    def __init__(self, n_agents=3, heartbeat=0.5, behaviors=None):
+        self.store = MemoryStore()
+        self.allocator = Allocator(self.store)
+        self.scheduler = Scheduler(self.store)
+        self.replicated = ReplicatedOrchestrator(self.store)
+        self.global_ = GlobalOrchestrator(self.store)
+        self.reaper = TaskReaper(self.store)
+        self.dispatcher = Dispatcher(self.store, heartbeat_period=heartbeat)
+        self.agents: dict[str, Agent] = {}
+        self.executors: dict[str, FakeExecutor] = {}
+        self.behaviors = behaviors or {}
+        for i in range(n_agents):
+            node_id = f"worker-{i}"
+            ex = FakeExecutor(self.behaviors, hostname=node_id)
+            self.executors[node_id] = ex
+            self.agents[node_id] = Agent(node_id, self.dispatcher, ex)
+
+    def start(self):
+        self.dispatcher.start()
+        self.allocator.start()
+        self.scheduler.start()
+        self.replicated.start()
+        self.global_.start()
+        self.reaper.start()
+        for a in self.agents.values():
+            a.start()
+
+    def stop(self):
+        for a in self.agents.values():
+            a.stop()
+        self.reaper.stop()
+        self.global_.stop()
+        self.replicated.stop()
+        self.scheduler.stop()
+        self.allocator.stop()
+        self.dispatcher.stop()
+
+    def create_service(self, name, replicas=3, mode=ServiceMode.REPLICATED,
+                       restart_condition=RestartCondition.ANY,
+                       restart_delay=0.0):
+        svc = Service(id=f"svc-{name}")
+        svc.spec = ServiceSpec(annotations=Annotations(name=name),
+                               replicas=replicas, mode=mode)
+        svc.spec.task.restart.condition = restart_condition
+        svc.spec.task.restart.delay = restart_delay
+        svc.spec_version.index = 1
+        self.store.update(lambda tx: tx.create(svc))
+        return svc
+
+    def running_tasks(self, service_id=None):
+        sel = [by.ByServiceID(service_id)] if service_id else []
+        return [
+            t for t in self.store.view().find_tasks(*sel)
+            if t.status.state == TaskState.RUNNING
+            and t.desired_state <= TaskState.RUNNING
+        ]
+
+
+@pytest.fixture
+def cluster():
+    c = MiniCluster(n_agents=3, behaviors={"svc-web": {"run_forever": True}})
+    c.start()
+    try:
+        yield c
+    finally:
+        c.stop()
+
+
+def test_service_reaches_running(cluster):
+    cluster.create_service("web", replicas=6)
+    assert wait_for(lambda: len(cluster.running_tasks("svc-web")) == 6,
+                    timeout=15)
+    tasks = cluster.running_tasks("svc-web")
+    nodes_used = {t.node_id for t in tasks}
+    assert len(nodes_used) == 3  # spread across all agents
+    # nodes were registered READY by the dispatcher
+    for n in cluster.store.view().find_nodes():
+        assert n.status.state == NodeStatusState.READY
+        assert n.description is not None  # executor Describe propagated
+
+
+def test_failed_task_restarts(cluster):
+    cluster.behaviors["svc-flaky"] = {"run_time": 0.2, "exit_code": 1}
+    cluster.create_service("flaky", replicas=2)
+    # the task fails after 0.2s and must be replaced by a fresh one
+    assert wait_for(lambda: any(
+        t.status.state == TaskState.FAILED
+        for t in cluster.store.view().find_tasks(by.ByServiceID("svc-flaky"))),
+        timeout=15)
+    # restart loop converges back to 2 running (new tasks, same slots)
+    assert wait_for(lambda: len(cluster.running_tasks("svc-flaky")) >= 1,
+                    timeout=15)
+
+
+def test_scale_up_and_down(cluster):
+    svc = cluster.create_service("web", replicas=2)
+    assert wait_for(lambda: len(cluster.running_tasks("svc-web")) == 2,
+                    timeout=15)
+    # scale up
+    cur = cluster.store.view().get_service("svc-web").copy()
+    cur.spec.replicas = 5
+    cluster.store.update(lambda tx: tx.update(cur))
+    assert wait_for(lambda: len(cluster.running_tasks("svc-web")) == 5,
+                    timeout=15)
+    # scale down: excess tasks get desired REMOVE and are reaped
+    cur = cluster.store.view().get_service("svc-web").copy()
+    cur.spec.replicas = 1
+    cluster.store.update(lambda tx: tx.update(cur))
+    assert wait_for(lambda: len(cluster.running_tasks("svc-web")) == 1,
+                    timeout=15)
+    assert wait_for(lambda: len(
+        cluster.store.view().find_tasks(by.ByServiceID("svc-web"))) == 1,
+        timeout=15)
+
+
+def test_node_death_reschedules(cluster):
+    cluster.create_service("web", replicas=3)
+    assert wait_for(lambda: len(cluster.running_tasks("svc-web")) == 3,
+                    timeout=15)
+    victim_id = cluster.running_tasks("svc-web")[0].node_id
+    # kill the agent without leave(): heartbeat must expire -> node DOWN
+    cluster.agents[victim_id].stop()
+    assert wait_for(lambda: (
+        cluster.store.view().get_node(victim_id).status.state
+        == NodeStatusState.DOWN), timeout=15)
+    # tasks rescheduled onto surviving nodes
+    assert wait_for(lambda: (
+        len([t for t in cluster.running_tasks("svc-web")
+             if t.node_id != victim_id]) == 3), timeout=20)
+
+
+def test_global_service_runs_everywhere(cluster):
+    cluster.behaviors["svc-mon"] = {"run_forever": True}
+    cluster.create_service("mon", mode=ServiceMode.GLOBAL)
+    assert wait_for(lambda: len(cluster.running_tasks("svc-mon")) == 3,
+                    timeout=15)
+    nodes = {t.node_id for t in cluster.running_tasks("svc-mon")}
+    assert nodes == {"worker-0", "worker-1", "worker-2"}
+
+
+def test_complete_job_not_restarted(cluster):
+    cluster.behaviors["svc-oneshot"] = {"run_time": 0.1, "exit_code": 0}
+    cluster.create_service("oneshot", replicas=2,
+                           restart_condition=RestartCondition.ON_FAILURE)
+    assert wait_for(lambda: len([
+        t for t in cluster.store.view().find_tasks(by.ByServiceID("svc-oneshot"))
+        if t.status.state == TaskState.COMPLETE]) == 2, timeout=15)
+    time.sleep(0.5)
+    # ON_FAILURE + exit 0: no replacements spawned
+    tasks = cluster.store.view().find_tasks(by.ByServiceID("svc-oneshot"))
+    assert len([t for t in tasks if t.status.state == TaskState.COMPLETE]) == 2

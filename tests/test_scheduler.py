@@ -190,8 +190,17 @@ def test_preassigned_task_validated(store):
     try:
         assert wait_for(lambda: (
             store.view().get_task("task-global").status.state == TaskState.ASSIGNED))
+        # a non-fitting preassigned task stays PENDING with an error recorded
+        # and is retried (reference scheduler.go:654-661)
         assert wait_for(lambda: (
-            store.view().get_task("task-bad").status.state == TaskState.REJECTED))
+            store.view().get_task("task-bad").status.err != ""))
+        assert store.view().get_task("task-bad").status.state == TaskState.PENDING
+        # fix the node so the task fits: retry must assign it
+        n = store.view().get_node("node-a").copy()
+        n.spec.annotations.labels["ok"] = "no"
+        store.update(lambda tx: tx.update(n))
+        assert wait_for(lambda: (
+            store.view().get_task("task-bad").status.state == TaskState.ASSIGNED))
     finally:
         s.stop()
 

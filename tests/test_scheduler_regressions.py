@@ -182,3 +182,41 @@ def test_assigned_generic_persisted_to_store():
         assert granted["gpu"][1] == 2
     finally:
         s.stop()
+
+
+def test_cidr_with_host_bits_masks():
+    """'10.0.3.7/24' must behave as the 10.0.3.0/24 subnet (ParseCIDR masks)."""
+    from swarmkit_tpu.scheduler import constraint as cm
+    c = cm.parse(["node.ip == 10.0.3.7/24"])[0]
+    n = ready_node("n1")
+    n.status.addr = "10.0.3.200"
+    assert cm.node_matches([c], n)
+    n.status.addr = "10.0.4.1"
+    assert not cm.node_matches([c], n)
+
+
+def test_rename_to_existing_name_conflicts():
+    from swarmkit_tpu.api.objects import Service
+    from swarmkit_tpu.api.specs import Annotations, ServiceSpec
+    from swarmkit_tpu.store.memory import ExistError
+    import pytest
+    store = MemoryStore()
+    store.update(lambda tx: tx.create(
+        Service(id="s1", spec=ServiceSpec(annotations=Annotations(name="a")))))
+    store.update(lambda tx: tx.create(
+        Service(id="s2", spec=ServiceSpec(annotations=Annotations(name="b")))))
+    s2 = store.view().get_service("s2").copy()
+    s2.spec.annotations.name = "A"  # names are case-insensitively unique
+    with pytest.raises(ExistError):
+        store.update(lambda tx: tx.update(s2))
+
+
+def test_failure_window_capped():
+    node = ready_node("n1")
+    info = NodeInfo.new(node, {}, node.description.resources.copy())
+    key = ("svc", 1)
+    for i in range(100):
+        info.task_failed(key, now=1000.0 + i)
+    from swarmkit_tpu.scheduler.nodeinfo import MAX_FAILURES
+    assert len(info.recent_failures[key]) <= MAX_FAILURES
+    assert info.penalized(key, now=1100.0)
