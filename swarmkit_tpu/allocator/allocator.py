@@ -14,6 +14,7 @@ import threading
 
 from ..api.objects import (
     EventCreate,
+    EventDelete,
     EventUpdate,
     Network,
     Service,
@@ -98,6 +99,8 @@ class Allocator(EventLoopComponent):
         super().__init__(store)
         self.network = network_provider or InertNetworkProvider()
         self.ports = PortAllocator()
+        # services whose port allocation failed, retried when ports free up
+        self._starved: set[str] = set()
 
     def setup(self, tx):
         return tx.find_tasks(by.ByTaskState(TaskState.NEW)), tx.find_services()
@@ -117,6 +120,24 @@ class Allocator(EventLoopComponent):
                 self._allocate_service(obj.id)
             elif isinstance(obj, Network):
                 self._allocate_network(obj.id)
+        elif isinstance(event, EventDelete):
+            if isinstance(obj, Service):
+                self.ports.release(obj.id)
+                self._retry_starved()
+            elif isinstance(obj, Network):
+                self.network.deallocate(obj)
+
+    def _retry_starved(self):
+        """A freed port may unblock a service whose allocation failed; its
+        NEW tasks were waiting on the service endpoint."""
+        starved, self._starved = self._starved, set()
+        for service_id in starved:
+            self._allocate_service(service_id)
+        if starved:
+            view = self.store.view()
+            pending = [t.id for t in view.find_tasks(by.ByTaskState(TaskState.NEW))]
+            if pending:
+                self._allocate_tasks(pending)
 
     # ------------------------------------------------------------- allocation
     def _allocate_network(self, network_id: str):
@@ -147,7 +168,8 @@ class Allocator(EventLoopComponent):
             s = s.copy()
             ok = self.ports.allocate(s.id, s.spec.endpoint.ports)
             if not ok:
-                return  # retried when ports free up
+                self._starved.add(s.id)
+                return  # retried when a conflicting service releases ports
             s.endpoint = {
                 "ports_allocated": True,
                 "port_set": sorted({(p.protocol, p.target_port, p.publish_mode)

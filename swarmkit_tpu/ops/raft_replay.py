@@ -1,0 +1,93 @@
+"""Raft log-replay fast path: batched quorum tally + commit-index advance.
+
+The reference advances the raft commit index entry-by-entry inside etcd/raft's
+Ready/Advance protocol (SURVEY.md §3.4; vendored raft.MemoryStorage). For
+benchmark-scale replay — BASELINE.md: 1M-entry log, 5-manager quorum — this
+module recomputes the whole commit frontier as one data-parallel program:
+
+    tally[e]    = Σ_m ack[m, e]          (psum over the manager mesh axis)
+    committed[e]= tally[e] >= quorum
+    commit      = length of the True-prefix of committed   (cumprod-sum)
+
+Raft's commit rule is prefix-monotone: an entry is committed only if every
+earlier entry is, hence the prefix reduction. `replay_commit` is the
+single-device jit; `sharded_replay_commit` shards managers across a mesh axis
+with shard_map + lax.psum — the ICI-native analogue of the reference's
+manager↔manager gRPC vote traffic.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@jax.jit
+def replay_commit(acks, quorum):
+    """acks: bool[M, E] (manager × log entry, True = durably appended).
+    Returns (commit_index int32, committed bool[E]).
+
+    commit_index is the number of committed entries (0 == nothing committed).
+    """
+    tally = jnp.sum(acks.astype(jnp.int32), axis=0)          # [E]
+    committed = tally >= quorum
+    prefix = jnp.cumprod(committed.astype(jnp.int32))        # stops at first 0
+    return jnp.sum(prefix).astype(jnp.int32), prefix.astype(bool)
+
+
+def sharded_replay_commit(mesh: Mesh, axis: str = "managers"):
+    """Build a shard_map'd replay where each device holds its managers' ack
+    rows; the tally is a lax.psum over the mesh axis (ICI collective)."""
+
+    def kernel(acks_local, quorum):
+        tally = jnp.sum(acks_local.astype(jnp.int32), axis=0)
+        tally = lax.psum(tally, axis)                         # ICI all-reduce
+        committed = tally >= quorum
+        prefix = jnp.cumprod(committed.astype(jnp.int32))
+        return jnp.sum(prefix).astype(jnp.int32), prefix.astype(bool)
+
+    return jax.jit(
+        jax.shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=(P(axis, None), P()),
+            out_specs=(P(), P()),
+        )
+    )
+
+
+@jax.jit
+def match_index_commit(match_index, quorum):
+    """Commit index from per-manager match indices (the leader-side rule:
+    commit = the quorum'th largest match index). match_index: int32[M]."""
+    sorted_desc = -jnp.sort(-match_index)
+    return sorted_desc[quorum - 1]
+
+
+@functools.partial(jax.jit, static_argnames=("chunk",))
+def replay_log_scan(acks, quorum, chunk: int = 65536):
+    """Streaming variant for logs too large to tally at once: scan over
+    chunks carrying the 'prefix still unbroken' flag. Semantically identical
+    to replay_commit; bounds peak memory to O(M × chunk)."""
+    M, E = acks.shape
+    n_chunks = E // chunk
+
+    def step(alive, acks_chunk):
+        tally = jnp.sum(acks_chunk.astype(jnp.int32), axis=0)
+        committed = tally >= quorum
+        prefix = jnp.cumprod(committed.astype(jnp.int32)) * alive
+        count = jnp.sum(prefix)
+        alive = alive * prefix[-1]
+        return alive, count
+
+    chunks = acks[:, :n_chunks * chunk].reshape(M, n_chunks, chunk)
+    chunks = jnp.moveaxis(chunks, 1, 0)                       # [C, M, chunk]
+    alive, counts = lax.scan(step, jnp.int32(1), chunks)
+    total = jnp.sum(counts)
+    if E % chunk:
+        _, tail_count = step(alive, acks[:, n_chunks * chunk:])
+        total = total + tail_count
+    return total.astype(jnp.int32)

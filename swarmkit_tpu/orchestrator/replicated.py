@@ -120,7 +120,9 @@ class ReplicatedOrchestrator(EventLoopComponent):
                         t.status.state == TaskState.RUNNING for t in ts)
                     load = max((node_load.get(t.node_id, 0)
                                 for t in ts if t.node_id), default=0)
-                    return (0 if running else 1, -load, -slot)
+                    # keep running slots on the LEAST-loaded nodes; the
+                    # removed suffix therefore drains the busiest nodes first
+                    return (0 if running else 1, load, slot)
 
                 ordered = sorted(runnable.items(), key=slot_key)
                 for slot, ts in ordered[specified:]:

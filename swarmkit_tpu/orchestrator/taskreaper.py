@@ -69,8 +69,12 @@ class TaskReaper(EventLoopComponent):
             t = view.get_task(task_id)
             if t is None:
                 continue
-            if t.desired_state == TaskState.REMOVE and \
-                    t.status.state >= TaskState.SHUTDOWN:
+            # reference task_reaper.go:181: REMOVE-desired tasks go once they
+            # were never assigned (slot removed before scheduling) or once the
+            # agent observed them past COMPLETE
+            if t.desired_state == TaskState.REMOVE and (
+                    t.status.state < TaskState.ASSIGNED
+                    or t.status.state >= TaskState.COMPLETE):
                 deletes.append(t.id)
             elif t.status.state == TaskState.ORPHANED:
                 deletes.append(t.id)

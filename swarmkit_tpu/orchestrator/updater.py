@@ -143,25 +143,25 @@ class Updater(threading.Thread):
         return new_task_id[0]
 
     def _monitor(self, new_ids: list[str], window: float) -> int:
-        """Count monitored-task failures within the window."""
+        """Count monitored-task failures over the FULL monitor window: a task
+        that comes up RUNNING and crashes at t < window still counts
+        (reference updater.go:204-260 watches the whole period). Exits early
+        only when every monitored task has already failed, or on cancel."""
         if not new_ids or window <= 0:
             return 0
-        deadline = time.monotonic() + min(window, 5.0)
+        deadline = time.monotonic() + window
         failed: set[str] = set()
         while time.monotonic() < deadline and not self._cancel.is_set():
             view = self.store.view()
-            pending = False
             for tid in new_ids:
                 t = view.get_task(tid)
-                if t is None:
-                    continue
-                if t.status.state in (TaskState.FAILED, TaskState.REJECTED):
+                if t is not None and t.status.state in (
+                        TaskState.FAILED, TaskState.REJECTED):
                     failed.add(tid)
-                elif t.status.state < TaskState.RUNNING:
-                    pending = True
-            if not pending:
+            if len(failed) == len(new_ids):
                 break
-            time.sleep(0.05)
+            if self._cancel.wait(0.05):
+                break
         return len(failed)
 
     def _rollback(self, service):

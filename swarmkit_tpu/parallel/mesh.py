@@ -1,0 +1,104 @@
+"""Device-mesh sharding of the scheduling and raft kernels.
+
+SURVEY.md §5 long-context note: this framework's scale axes are nodes, tasks,
+services and raft-log length, so the mesh maps those — per-node arrays shard
+over the `nodes` axis (the 100k×10k case from BASELINE.md exceeds one core's
+appetite), per-manager ack bitmaps over the `managers` axis. Shardings are
+declared with NamedSharding/PartitionSpec and the kernels run under jit so
+XLA inserts the collectives (psum for quorum tallies and water-level sums,
+gathers for the tiny boundary sort) over ICI — the design recipe of the
+public scaling-book: pick a mesh, annotate, let XLA place collectives.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import placement as placement_ops
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(n_devices: int | None = None, axis: str = NODE_AXIS) -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))
+
+
+def _pad_nodes(arr: np.ndarray, n_pad: int, axis: int, fill):
+    if n_pad == 0:
+        return arr
+    pad_width = [(0, 0)] * arr.ndim
+    pad_width[axis] = (0, n_pad)
+    return np.pad(arr, pad_width, constant_values=fill)
+
+
+def shard_problem(p, mesh: Mesh):
+    """Place an EncodedProblem's arrays onto the mesh: every per-node axis is
+    sharded, group-side tables are replicated. Node count is padded to a
+    multiple of the mesh size with ineligible phantom nodes (ready=False),
+    which the mask kernel excludes, so results are unchanged."""
+    n_dev = mesh.devices.size
+    N = len(p.node_ids)
+    n_pad = (-N) % n_dev
+
+    def put(arr, spec, pad_axis=None, fill=0):
+        arr = np.asarray(arr)
+        if pad_axis is not None:
+            arr = _pad_nodes(arr, n_pad, pad_axis, fill)
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    args = (
+        put(p.ready, P(NODE_AXIS), 0, False),
+        put(p.node_val, P(NODE_AXIS, None), 0, -1),
+        put(p.node_plat, P(NODE_AXIS, None), 0, 0),
+        put(p.node_plugins, P(NODE_AXIS, None), 0, False),
+        put(p.extra_mask, P(None, NODE_AXIS), 1, False),
+        put(p.constraints, P()),
+        put(p.plat_req, P()),
+        put(p.req_plugins, P()),
+        put(p.avail_res, P(NODE_AXIS, None), 0, 0),
+        put(p.total0, P(NODE_AXIS), 0, 0),
+        put(p.svc_count0, P(None, NODE_AXIS), 1, 0),
+        put(p.n_tasks, P()),
+        put(p.svc_idx, P()),
+        put(p.need_res, P()),
+        put(p.max_replicas, P()),
+        put(p.penalty, P(None, NODE_AXIS), 1, False),
+        put(p.has_ports, P()),
+        put(p.group_ports, P()),
+        put(p.port_used0, P(NODE_AXIS, None), 0, False),
+    )
+    return args, N
+
+
+def sharded_schedule(p, mesh: Mesh):
+    """Run the placement kernel with per-node arrays sharded over the mesh.
+    Returns counts[G, N] (numpy, truncated back to the real node count)."""
+    args, N = shard_problem(p, mesh)
+    with jax.sharding.set_mesh(mesh):
+        counts, totals, svc_counts = placement_ops.schedule_groups(*args)
+    return np.asarray(counts)[:, :N]
+
+
+def sharded_cluster_step(mesh: Mesh):
+    """One jittable 'cluster step' over the mesh: batched placement for the
+    scheduler plus a raft quorum tally — the two manager-side hot loops of
+    SURVEY.md §2.4/§2.3 fused into a single compiled program.
+
+    Returns a function suitable for jit-compiling under the mesh; per-node
+    arrays arrive sharded over the node axis, raft acks replicated (the
+    dedicated manager-axis variant lives in ops.raft_replay)."""
+
+    def step(placement_args, acks, quorum):
+        counts, totals, svc_counts = placement_ops.schedule_groups(*placement_args)
+        tally = jnp.sum(acks.astype(jnp.int32), axis=0)
+        committed = tally >= quorum
+        prefix = jnp.cumprod(committed.astype(jnp.int32))
+        commit_index = jnp.sum(prefix).astype(jnp.int32)
+        return counts, totals, commit_index
+
+    return step

@@ -9,9 +9,19 @@ import sys
 
 sys.path.insert(0, os.path.dirname(__file__))  # cross-test helper imports
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the ambient environment selects the TPU platform:
+# on TPU hosts a sitecustomize registers the axon backend at interpreter
+# start and pins jax_platforms, so setting the env var here is too late —
+# jax.config.update after import is the reliable override. The suite must
+# exercise the virtual 8-device mesh deterministically and leave the chip
+# to bench.py.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

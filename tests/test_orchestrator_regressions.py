@@ -1,0 +1,112 @@
+"""Regression tests for orchestrator/allocator review findings."""
+import time
+
+import pytest
+
+from swarmkit_tpu.api.objects import Service
+from swarmkit_tpu.api.specs import (
+    Annotations,
+    PortConfig,
+    ServiceSpec,
+)
+from swarmkit_tpu.api.types import (
+    ServiceMode,
+    TaskState,
+    UpdateFailureAction,
+    UpdateStatusState,
+)
+from swarmkit_tpu.store import by
+
+from test_e2e_slice import MiniCluster
+from test_scheduler import wait_for
+
+
+def test_scale_down_drains_busiest_node():
+    """With replicas unevenly spread, scale-down must remove from the
+    most-loaded node, not concentrate on it."""
+    c = MiniCluster(n_agents=2, behaviors={"svc-w": {"run_forever": True}})
+    c.start()
+    try:
+        c.create_service("w", replicas=4)
+        assert wait_for(lambda: len(c.running_tasks("svc-w")) == 4, timeout=15)
+        # stop one agent so rescheduling piles tasks on the survivor? no —
+        # instead scale to 2 and verify balance stays even (2 nodes, 2 tasks)
+        cur = c.store.view().get_service("svc-w").copy()
+        cur.spec.replicas = 2
+        c.store.update(lambda tx: tx.update(cur))
+        assert wait_for(lambda: len(c.running_tasks("svc-w")) == 2, timeout=15)
+        nodes = [t.node_id for t in c.running_tasks("svc-w")]
+        assert len(set(nodes)) == 2, f"not rebalanced: {nodes}"
+    finally:
+        c.stop()
+
+
+def test_deleted_service_releases_ports():
+    """A successor service can claim a published port freed by deletion."""
+    c = MiniCluster(n_agents=1, behaviors={"svc-a": {"run_forever": True},
+                                           "svc-b": {"run_forever": True}})
+    c.start()
+    try:
+        s1 = Service(id="svc-a", spec=ServiceSpec(
+            annotations=Annotations(name="a"), replicas=1))
+        s1.spec.endpoint.ports = [PortConfig(protocol="tcp", target_port=80,
+                                             published_port=8080)]
+        c.store.update(lambda tx: tx.create(s1))
+        assert wait_for(lambda: len(c.running_tasks("svc-a")) == 1, timeout=15)
+
+        c.store.update(lambda tx: tx.delete(Service, "svc-a"))
+
+        s2 = Service(id="svc-b", spec=ServiceSpec(
+            annotations=Annotations(name="b"), replicas=1))
+        s2.spec.endpoint.ports = [PortConfig(protocol="tcp", target_port=80,
+                                             published_port=8080)]
+        c.store.update(lambda tx: tx.create(s2))
+        assert wait_for(lambda: len(c.running_tasks("svc-b")) == 1, timeout=15)
+    finally:
+        c.stop()
+
+
+def test_unassigned_remove_tasks_reaped():
+    """Scale-down of never-scheduled PENDING tasks must not leak them."""
+    c = MiniCluster(n_agents=0)  # no agents: nothing ever gets assigned...
+    c.start()
+    try:
+        c.create_service("w", replicas=3)
+        assert wait_for(lambda: len([
+            t for t in c.store.view().find_tasks(by.ByServiceID("svc-w"))
+            if t.status.state == TaskState.PENDING]) == 3, timeout=10)
+        cur = c.store.view().get_service("svc-w").copy()
+        cur.spec.replicas = 0
+        c.store.update(lambda tx: tx.update(cur))
+        assert wait_for(lambda: len(
+            c.store.view().find_tasks(by.ByServiceID("svc-w"))) == 0,
+            timeout=10)
+    finally:
+        c.stop()
+
+
+def test_update_failure_after_running_triggers_pause():
+    """A task that starts RUNNING then crashes inside the monitor window must
+    count toward the failure ratio."""
+    c = MiniCluster(n_agents=1, behaviors={
+        "svc-w": {"run_forever": True},
+    })
+    c.start()
+    try:
+        c.create_service("w", replicas=2)
+        assert wait_for(lambda: len(c.running_tasks("svc-w")) == 2, timeout=15)
+        # v2 crashes 0.2s after starting; monitor window 1.5s must catch it
+        c.behaviors["svc-w"].clear()
+        c.behaviors["svc-w"].update({"run_time": 0.2, "exit_code": 1})
+        cur = c.store.view().get_service("svc-w").copy()
+        cur.spec.task.force_update = 1
+        cur.spec.update.monitor = 1.5
+        cur.spec.update.max_failure_ratio = 0.0
+        cur.spec.update.failure_action = UpdateFailureAction.PAUSE
+        cur.spec_version.index = 2
+        c.store.update(lambda tx: tx.update(cur))
+        assert wait_for(lambda: (
+            (c.store.view().get_service("svc-w").update_status or {}).get("state")
+            == UpdateStatusState.PAUSED.value), timeout=20)
+    finally:
+        c.stop()

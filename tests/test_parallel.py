@@ -1,0 +1,73 @@
+"""Multi-device tests on the virtual 8-device CPU mesh: sharded placement
+must equal the single-device kernel (and hence the CPU oracle), and the raft
+replay kernels must agree with a straightforward reference."""
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from swarmkit_tpu.ops import raft_replay
+from swarmkit_tpu.parallel.mesh import make_mesh, sharded_schedule
+from swarmkit_tpu.scheduler import batch
+from swarmkit_tpu.scheduler.encode import encode
+
+from test_placement_parity import random_cluster
+
+
+def test_eight_virtual_devices_present():
+    assert len(jax.devices()) == 8
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_sharded_placement_matches_oracle(seed):
+    rng = random.Random(seed)
+    infos, groups = random_cluster(rng, n_nodes=37, n_groups=4)  # non-divisible N
+    p = encode(infos, groups)
+    cpu_counts = batch.cpu_schedule_encoded(p)
+    mesh = make_mesh(8)
+    sharded_counts = sharded_schedule(p, mesh)
+    np.testing.assert_array_equal(cpu_counts, sharded_counts)
+
+
+def _np_commit(acks, quorum):
+    tally = acks.sum(axis=0)
+    committed = tally >= quorum
+    idx = 0
+    for c in committed:
+        if not c:
+            break
+        idx += 1
+    return idx
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_replay_commit_matches_reference(seed):
+    rng = np.random.RandomState(seed)
+    M, E = 5, 1000
+    acks = rng.rand(M, E) < 0.8
+    # make a committed prefix realistic: leader always has the entry
+    acks[0] = True
+    expected = _np_commit(acks, quorum=3)
+    commit, committed = raft_replay.replay_commit(acks, 3)
+    assert int(commit) == expected
+    chunked = raft_replay.replay_log_scan(acks, 3, chunk=128)
+    assert int(chunked) == expected
+
+
+def test_sharded_replay_commit():
+    rng = np.random.RandomState(42)
+    M, E = 8, 4096  # one manager per device
+    acks = rng.rand(M, E) < 0.7
+    expected = _np_commit(acks, quorum=5)
+    mesh = make_mesh(8, axis="managers")
+    fn = raft_replay.sharded_replay_commit(mesh, "managers")
+    with jax.sharding.set_mesh(mesh):
+        commit, _ = fn(acks, 5)
+    assert int(commit) == expected
+
+
+def test_match_index_commit():
+    mi = np.array([100, 90, 80, 70, 60], np.int32)
+    # quorum of 3: the 3rd largest match index
+    assert int(raft_replay.match_index_commit(mi, 3)) == 80
