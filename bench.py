@@ -79,14 +79,8 @@ def main():
 
     p, encode_s = build_problem()
 
-    args = tuple(
-        jax.numpy.asarray(getattr(p, name)) for name in (
-            "ready", "node_val", "node_plat", "node_plugins", "extra_mask",
-            "constraints", "plat_req", "req_plugins", "avail_res", "total0",
-            "svc_count0", "n_tasks", "svc_idx", "need_res", "max_replicas",
-            "penalty", "has_ports", "group_ports", "port_used0",
-        )
-    )
+    from swarmkit_tpu.scheduler.encode import kernel_args
+    args = tuple(jax.numpy.asarray(a) for a in kernel_args(p))
 
     # compile (excluded from the timed run, like any warmed scheduler cache)
     t0 = time.perf_counter()

@@ -91,6 +91,16 @@ class PortAllocator:
             for key in [k for k, v in self._allocated.items() if v == service_id]:
                 del self._allocated[key]
 
+    def release_except(self, service_id: str, keep: set[tuple[str, int]]) -> bool:
+        """Release the service's ports not in `keep` (spec changed its port
+        set). Returns True when anything was freed."""
+        with self._lock:
+            stale = [k for k, v in self._allocated.items()
+                     if v == service_id and k not in keep]
+            for k in stale:
+                del self._allocated[k]
+            return bool(stale)
+
 
 class Allocator(EventLoopComponent):
     name = "allocator"
@@ -152,27 +162,37 @@ class Allocator(EventLoopComponent):
         self.store.update(cb)
 
     def _allocate_service(self, service_id: str):
+        freed = False
+
         def cb(tx):
+            nonlocal freed
             s = tx.get_service(service_id)
             if s is None:
                 return
             ports = s.spec.endpoint.ports
             if not ports:
+                # spec dropped all ports: free whatever was held
+                freed = self.ports.release_except(service_id, set())
                 return
             if s.endpoint is not None and s.endpoint.get("ports_allocated"):
                 # re-allocate only when the spec's port set changed
-                current = {(p.protocol, p.target_port, p.publish_mode)
-                           for p in ports}
+                current = {(p.protocol, p.target_port, p.published_port,
+                            p.publish_mode) for p in ports}
                 if s.endpoint.get("port_set") == sorted(current):
                     return
             s = s.copy()
+            # free ports the new spec no longer publishes before claiming
+            wanted = {(p.protocol, p.published_port)
+                      for p in ports if p.published_port}
+            freed = self.ports.release_except(s.id, wanted)
             ok = self.ports.allocate(s.id, s.spec.endpoint.ports)
             if not ok:
                 self._starved.add(s.id)
                 return  # retried when a conflicting service releases ports
             s.endpoint = {
                 "ports_allocated": True,
-                "port_set": sorted({(p.protocol, p.target_port, p.publish_mode)
+                "port_set": sorted({(p.protocol, p.target_port,
+                                     p.published_port, p.publish_mode)
                                     for p in s.spec.endpoint.ports}),
                 "ports": [
                     (p.protocol, p.target_port, p.published_port, p.publish_mode)
@@ -182,6 +202,8 @@ class Allocator(EventLoopComponent):
             tx.update(s)
 
         self.store.update(cb)
+        if freed:
+            self._retry_starved()
 
     def _allocate_tasks(self, task_ids: list[str]):
         def cb(batch):

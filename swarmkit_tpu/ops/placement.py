@@ -189,17 +189,9 @@ def schedule_groups(
 
 def schedule_encoded(p, backend=None):
     """Run the kernel on an EncodedProblem; returns numpy counts[G, N]."""
-    args = (
-        jnp.asarray(p.ready), jnp.asarray(p.node_val), jnp.asarray(p.node_plat),
-        jnp.asarray(p.node_plugins), jnp.asarray(p.extra_mask),
-        jnp.asarray(p.constraints), jnp.asarray(p.plat_req),
-        jnp.asarray(p.req_plugins), jnp.asarray(p.avail_res),
-        jnp.asarray(p.total0), jnp.asarray(p.svc_count0),
-        jnp.asarray(p.n_tasks), jnp.asarray(p.svc_idx),
-        jnp.asarray(p.need_res), jnp.asarray(p.max_replicas),
-        jnp.asarray(p.penalty), jnp.asarray(p.has_ports),
-        jnp.asarray(p.group_ports), jnp.asarray(p.port_used0),
-    )
+    from ..scheduler.encode import kernel_args
+
+    args = tuple(jnp.asarray(a) for a in kernel_args(p))
     counts, totals, svc_counts = schedule_groups(*args)
     import numpy as np
     return np.asarray(counts)

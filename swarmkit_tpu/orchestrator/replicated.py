@@ -114,19 +114,28 @@ class ReplicatedOrchestrator(EventLoopComponent):
                         if t.node_id:
                             node_load[t.node_id] += 1
 
-                def slot_key(item):
+                # iterative removal: repeatedly drop a slot from the
+                # currently busiest node (non-running slots first),
+                # recomputing load after each pick so ties rebalance —
+                # a static sort would drain one node completely
+                def removal_key(item):
                     slot, ts = item
                     running = any(
                         t.status.state == TaskState.RUNNING for t in ts)
                     load = max((node_load.get(t.node_id, 0)
                                 for t in ts if t.node_id), default=0)
-                    # keep running slots on the LEAST-loaded nodes; the
-                    # removed suffix therefore drains the busiest nodes first
-                    return (0 if running else 1, load, slot)
+                    # non-running slots go first, then busiest node,
+                    # then highest slot number
+                    return (0 if not running else 1, -load, -slot)
 
-                ordered = sorted(runnable.items(), key=slot_key)
-                for slot, ts in ordered[specified:]:
+                remaining = dict(runnable)
+                for _ in range(len(runnable) - specified):
+                    slot, ts = min(remaining.items(), key=removal_key)
+                    del remaining[slot]
                     for t in ts:
+                        if t.node_id:
+                            node_load[t.node_id] = max(
+                                node_load.get(t.node_id, 1) - 1, 0)
                         cur = tx.get_task(t.id)
                         if cur is not None and cur.desired_state < TaskState.REMOVE:
                             cur = cur.copy()

@@ -51,8 +51,32 @@ class Updater(threading.Thread):
         cfg = service.spec.update
         self._set_update_status(UpdateStatusState.UPDATING, "update in progress")
 
-        failures = 0
+        # monitored: task_id -> monitor deadline; failures accrue
+        # asynchronously so batches are NOT serialized behind the window
+        # (the reference overlaps monitoring with subsequent batches)
+        monitored: dict[str, float] = {}
+        failed: set[str] = set()
         updated = 0
+
+        def poll_failures():
+            if not monitored:
+                return
+            view = self.store.view()
+            now = time.monotonic()
+            for tid in list(monitored):
+                t = view.get_task(tid)
+                if t is not None and t.status.state in (
+                        TaskState.FAILED, TaskState.REJECTED):
+                    failed.add(tid)
+                    del monitored[tid]
+                elif now > monitored[tid]:
+                    del monitored[tid]  # window expired healthy
+
+        def over_threshold() -> bool:
+            total = max(updated, 1)
+            return (cfg.max_failure_ratio >= 0 and failed
+                    and len(failed) / total > cfg.max_failure_ratio)
+
         while not self._cancel.is_set():
             service = self.store.view().get_service(self.service_id)
             if service is None:
@@ -61,29 +85,37 @@ class Updater(threading.Thread):
             if not dirty:
                 break
             parallelism = cfg.parallelism or len(dirty)
-            batch = dirty[:parallelism]
-            new_ids = []
-            for slot_tasks in batch:
+            for slot_tasks in dirty[:parallelism]:
                 nid = self._update_slot(service, slot_tasks, cfg.order)
-                if nid:
-                    new_ids.append(nid)
+                if nid and cfg.monitor > 0:
+                    monitored[nid] = time.monotonic() + cfg.monitor
                 updated += 1
-            failures += self._monitor(new_ids, cfg.monitor)
+            poll_failures()
+            if over_threshold():
+                break
+            if cfg.delay > 0 and self._cancel.wait(cfg.delay):
+                return
+
+        # drain remaining monitor windows (non-blocking batches above mean
+        # only the tail waits here), still reacting to failures promptly
+        while monitored and not self._cancel.is_set() and not over_threshold():
+            if self._cancel.wait(0.05):
+                return
+            poll_failures()
+
+        if over_threshold():
             total = max(updated, 1)
-            if cfg.max_failure_ratio >= 0 and failures / total > cfg.max_failure_ratio \
-                    and failures > 0:
-                if cfg.failure_action == UpdateFailureAction.PAUSE:
-                    self._set_update_status(
-                        UpdateStatusState.PAUSED,
-                        f"update paused due to failure ratio {failures}/{total}")
-                    return
-                if cfg.failure_action == UpdateFailureAction.ROLLBACK:
-                    self._rollback(service)
-                    return
-                # CONTINUE: fall through
-            if cfg.delay > 0:
-                if self._cancel.wait(cfg.delay):
-                    return
+            if cfg.failure_action == UpdateFailureAction.PAUSE:
+                self._set_update_status(
+                    UpdateStatusState.PAUSED,
+                    f"update paused due to failure ratio {len(failed)}/{total}")
+            elif cfg.failure_action == UpdateFailureAction.ROLLBACK:
+                self._rollback(self.store.view().get_service(self.service_id))
+            else:
+                self._set_update_status(
+                    UpdateStatusState.COMPLETED,
+                    f"update completed with {len(failed)} failures")
+            return
         if not self._cancel.is_set():
             self._set_update_status(UpdateStatusState.COMPLETED, "update completed")
 
@@ -141,28 +173,6 @@ class Updater(threading.Thread):
 
             self.store.update(promote)
         return new_task_id[0]
-
-    def _monitor(self, new_ids: list[str], window: float) -> int:
-        """Count monitored-task failures over the FULL monitor window: a task
-        that comes up RUNNING and crashes at t < window still counts
-        (reference updater.go:204-260 watches the whole period). Exits early
-        only when every monitored task has already failed, or on cancel."""
-        if not new_ids or window <= 0:
-            return 0
-        deadline = time.monotonic() + window
-        failed: set[str] = set()
-        while time.monotonic() < deadline and not self._cancel.is_set():
-            view = self.store.view()
-            for tid in new_ids:
-                t = view.get_task(tid)
-                if t is not None and t.status.state in (
-                        TaskState.FAILED, TaskState.REJECTED):
-                    failed.add(tid)
-            if len(failed) == len(new_ids):
-                break
-            if self._cancel.wait(0.05):
-                break
-        return len(failed)
 
     def _rollback(self, service):
         def cb(tx):

@@ -12,13 +12,37 @@ public scaling-book: pick a mesh, annotate, let XLA place collectives.
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import placement as placement_ops
+from ..scheduler.encode import KERNEL_ARG_FIELDS
 
 NODE_AXIS = "nodes"
+
+# Per-field sharding: (node-axis position or None, pad fill value). Order is
+# NOT duplicated here — it comes from KERNEL_ARG_FIELDS.
+_FIELD_SHARDING: dict[str, tuple[int | None, object]] = {
+    "ready": (0, False),
+    "node_val": (0, -1),
+    "node_plat": (0, 0),
+    "node_plugins": (0, False),
+    "extra_mask": (1, False),
+    "constraints": (None, 0),
+    "plat_req": (None, 0),
+    "req_plugins": (None, 0),
+    "avail_res": (0, 0),
+    "total0": (0, 0),
+    "svc_count0": (1, 0),
+    "n_tasks": (None, 0),
+    "svc_idx": (None, 0),
+    "need_res": (None, 0),
+    "max_replicas": (None, 0),
+    "penalty": (1, False),
+    "has_ports": (None, 0),
+    "group_ports": (None, 0),
+    "port_used0": (0, False),
+}
 
 
 def make_mesh(n_devices: int | None = None, axis: str = NODE_AXIS) -> Mesh:
@@ -45,34 +69,19 @@ def shard_problem(p, mesh: Mesh):
     N = len(p.node_ids)
     n_pad = (-N) % n_dev
 
-    def put(arr, spec, pad_axis=None, fill=0):
-        arr = np.asarray(arr)
-        if pad_axis is not None:
-            arr = _pad_nodes(arr, n_pad, pad_axis, fill)
-        return jax.device_put(arr, NamedSharding(mesh, spec))
-
-    args = (
-        put(p.ready, P(NODE_AXIS), 0, False),
-        put(p.node_val, P(NODE_AXIS, None), 0, -1),
-        put(p.node_plat, P(NODE_AXIS, None), 0, 0),
-        put(p.node_plugins, P(NODE_AXIS, None), 0, False),
-        put(p.extra_mask, P(None, NODE_AXIS), 1, False),
-        put(p.constraints, P()),
-        put(p.plat_req, P()),
-        put(p.req_plugins, P()),
-        put(p.avail_res, P(NODE_AXIS, None), 0, 0),
-        put(p.total0, P(NODE_AXIS), 0, 0),
-        put(p.svc_count0, P(None, NODE_AXIS), 1, 0),
-        put(p.n_tasks, P()),
-        put(p.svc_idx, P()),
-        put(p.need_res, P()),
-        put(p.max_replicas, P()),
-        put(p.penalty, P(None, NODE_AXIS), 1, False),
-        put(p.has_ports, P()),
-        put(p.group_ports, P()),
-        put(p.port_used0, P(NODE_AXIS, None), 0, False),
-    )
-    return args, N
+    args = []
+    for field in KERNEL_ARG_FIELDS:
+        node_axis, fill = _FIELD_SHARDING[field]
+        arr = np.asarray(getattr(p, field))
+        if node_axis is None:
+            spec = P()
+        else:
+            arr = _pad_nodes(arr, n_pad, node_axis, fill)
+            parts = [None] * arr.ndim
+            parts[node_axis] = NODE_AXIS
+            spec = P(*parts)
+        args.append(jax.device_put(arr, NamedSharding(mesh, spec)))
+    return tuple(args), N
 
 
 def sharded_schedule(p, mesh: Mesh):
@@ -82,23 +91,3 @@ def sharded_schedule(p, mesh: Mesh):
     with jax.sharding.set_mesh(mesh):
         counts, totals, svc_counts = placement_ops.schedule_groups(*args)
     return np.asarray(counts)[:, :N]
-
-
-def sharded_cluster_step(mesh: Mesh):
-    """One jittable 'cluster step' over the mesh: batched placement for the
-    scheduler plus a raft quorum tally — the two manager-side hot loops of
-    SURVEY.md §2.4/§2.3 fused into a single compiled program.
-
-    Returns a function suitable for jit-compiling under the mesh; per-node
-    arrays arrive sharded over the node axis, raft acks replicated (the
-    dedicated manager-axis variant lives in ops.raft_replay)."""
-
-    def step(placement_args, acks, quorum):
-        counts, totals, svc_counts = placement_ops.schedule_groups(*placement_args)
-        tally = jnp.sum(acks.astype(jnp.int32), axis=0)
-        committed = tally >= quorum
-        prefix = jnp.cumprod(committed.astype(jnp.int32))
-        commit_index = jnp.sum(prefix).astype(jnp.int32)
-        return counts, totals, commit_index
-
-    return step

@@ -1,0 +1,78 @@
+"""Raft message and log-entry types.
+
+Mirrors the semantic content of raftpb messages the reference streams over
+gRPC (api/raft.proto, manager/state/raft/transport/): vote, append, snapshot
+installation, plus configuration-change entries. Entries carry opaque
+`data` — for this framework, a serialized changelist of StoreActions.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+# entry kinds
+ENTRY_NORMAL = 0
+ENTRY_CONF_CHANGE = 1
+
+
+@dataclass
+class Entry:
+    term: int
+    index: int
+    kind: int = ENTRY_NORMAL
+    data: Any = None
+    request_id: str = ""  # correlates proposals with wait callbacks
+
+
+@dataclass
+class ConfChange:
+    action: str        # "add" | "remove"
+    raft_id: int
+    node_id: str = ""  # cluster member identity (cert CN)
+    addr: str = ""
+
+
+@dataclass
+class Message:
+    frm: int = 0
+    to: int = 0
+    term: int = 0
+    kind: str = ""     # vote_req | vote_resp | append | append_resp | snapshot
+
+
+@dataclass
+class VoteRequest(Message):
+    last_log_index: int = 0
+    last_log_term: int = 0
+    kind: str = "vote_req"
+
+
+@dataclass
+class VoteResponse(Message):
+    granted: bool = False
+    kind: str = "vote_resp"
+
+
+@dataclass
+class AppendEntries(Message):
+    prev_log_index: int = 0
+    prev_log_term: int = 0
+    entries: list[Entry] = field(default_factory=list)
+    leader_commit: int = 0
+    kind: str = "append"
+
+
+@dataclass
+class AppendResponse(Message):
+    success: bool = False
+    match_index: int = 0
+    kind: str = "append_resp"
+
+
+@dataclass
+class InstallSnapshot(Message):
+    snapshot_index: int = 0
+    snapshot_term: int = 0
+    members: dict[int, tuple[str, str]] = field(default_factory=dict)
+    data: Any = None
+    kind: str = "snapshot"

@@ -1,0 +1,633 @@
+"""Raft consensus node.
+
+A from-scratch re-derivation of the consensus behavior the reference gets
+from vendored etcd/raft plus its own wrapper (manager/state/raft/raft.go):
+leader election with randomized timeouts, log replication, commit-index
+advancement by quorum match, snapshot install for lagging followers, single
+-step membership changes, a wait registry correlating proposals with commit
+callbacks (wait.go:8-77), and leadership-change notification that the
+manager uses to start/stop leader-only components.
+
+Architecture notes (tpu-first build):
+  * step model: every input — network message, clock tick, proposal — is a
+    queued event processed by one worker thread, so the core is single
+    -threaded and deterministic under the fake-clock test harness
+    (mirroring the reference's NodeOptions.ClockSource tier-2 strategy);
+  * the batched commit math (quorum tally over a simulated manager mesh)
+    also exists as the TPU kernel in ops/raft_replay.py — used for
+    benchmark-scale log replay, while this class owns protocol correctness.
+"""
+from __future__ import annotations
+
+import logging
+import queue
+import random
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from .messages import (
+    ENTRY_CONF_CHANGE,
+    ENTRY_NORMAL,
+    AppendEntries,
+    AppendResponse,
+    ConfChange,
+    Entry,
+    InstallSnapshot,
+    VoteRequest,
+    VoteResponse,
+)
+
+log = logging.getLogger("swarmkit_tpu.raft")
+
+FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+MAX_ENTRIES_PER_APPEND = 64
+
+
+class NotLeader(Exception):
+    def __init__(self, leader_id: int | None, leader_addr: str | None = None):
+        super().__init__(f"not the leader (leader={leader_id})")
+        self.leader_id = leader_id
+        self.leader_addr = leader_addr
+
+
+class ProposalDropped(Exception):
+    pass
+
+
+@dataclass
+class Peer:
+    raft_id: int
+    node_id: str
+    addr: str
+
+
+class RaftNode:
+    def __init__(
+        self,
+        raft_id: int,
+        transport,
+        storage=None,
+        apply_entry: Callable[[Entry], None] | None = None,
+        snapshot_state: Callable[[], Any] | None = None,
+        restore_state: Callable[[Any], None] | None = None,
+        on_leadership: Callable[[bool], None] | None = None,
+        election_tick: int = 10,
+        heartbeat_tick: int = 1,
+        snapshot_interval: int = 1000,
+        rng: random.Random | None = None,
+        auto_recover: bool = True,
+    ):
+        self.id = raft_id
+        self.transport = transport
+        self.storage = storage
+        self.apply_entry = apply_entry or (lambda e: None)
+        self.snapshot_state = snapshot_state or (lambda: None)
+        self.restore_state = restore_state or (lambda s: None)
+        self.on_leadership = on_leadership or (lambda is_leader: None)
+        self.election_tick = election_tick
+        self.heartbeat_tick = heartbeat_tick
+        self.snapshot_interval = snapshot_interval
+        self._rng = rng or random.Random()
+
+        # persistent state
+        self.term = 0
+        self.voted_for: int | None = None
+        self.log: list[Entry] = []
+        self.first_index = 1          # index of log[0] (post-snapshot base)
+        self.snapshot_index = 0
+        self.snapshot_term = 0
+
+        # volatile
+        self.role = FOLLOWER
+        self.leader_id: int | None = None
+        self.commit_index = 0
+        self.last_applied = 0
+        self.members: dict[int, Peer] = {}
+        self.next_index: dict[int, int] = {}
+        self.match_index: dict[int, int] = {}
+        self.votes: set[int] = set()
+        self.election_elapsed = 0
+        self.heartbeat_elapsed = 0
+        self._randomized_timeout = self._next_timeout()
+
+        self._waits: dict[str, Callable[[bool, str], None]] = {}
+        self._inbox: queue.Queue = queue.Queue()
+        self._stopped = threading.Event()
+        self._thread: threading.Thread | None = None
+
+        self._recovered = False
+        if auto_recover:
+            self.recover()
+
+    def recover(self):
+        """Replay persisted state (WAL + snapshot). Callers that swap in
+        apply_entry/restore_state after construction (e.g. RaftProposer)
+        pass auto_recover=False and invoke this once wiring is complete —
+        otherwise recovered entries would be applied into a void."""
+        if self._recovered:
+            return
+        self._recovered = True
+        if self.storage is not None:
+            self._restore_from_storage()
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self):
+        self.recover()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"raft-{self.id}")
+        self._thread.start()
+
+    def stop(self):
+        self._stopped.set()
+        self._inbox.put(("stop",))
+        if self._thread:
+            self._thread.join(timeout=5)
+        was_leader = self.role == LEADER
+        self.role = FOLLOWER
+        if was_leader:
+            self._notify_leadership(False)
+
+    def bootstrap(self, peers: list[Peer]):
+        """Initialize a fresh cluster membership (first node, or test rig)."""
+        for p in peers:
+            self.members[p.raft_id] = p
+        if self.storage is not None:
+            self.storage.save_membership(self.members)
+
+    # -------------------------------------------------------------- external
+    def step(self, msg):
+        """Feed a network message (thread-safe)."""
+        self._inbox.put(("msg", msg))
+
+    def tick(self):
+        self._inbox.put(("tick",))
+
+    def propose(self, data: Any, request_id: str,
+                callback: Callable[[bool, str], None]):
+        """Propose a normal entry; callback(ok, err) fires on commit (from
+        the worker thread) or on drop."""
+        self._inbox.put(("propose", data, request_id, callback))
+
+    def propose_conf_change(self, cc: ConfChange, request_id: str,
+                            callback: Callable[[bool, str], None]):
+        self._inbox.put(("conf", cc, request_id, callback))
+
+    def campaign(self):
+        """Force an immediate election (tests / bootstrap)."""
+        self._inbox.put(("campaign",))
+
+    # ------------------------------------------------------------ event loop
+    def _run(self):
+        while not self._stopped.is_set():
+            try:
+                item = self._inbox.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                self._dispatch(item)
+            except Exception:
+                log.exception("raft-%d: error processing %r", self.id, item[0])
+
+    def process_all(self):
+        """Drain the inbox synchronously (fake-clock tests drive this)."""
+        while True:
+            try:
+                item = self._inbox.get_nowait()
+            except queue.Empty:
+                return
+            self._dispatch(item)
+
+    def _dispatch(self, item):
+        kind = item[0]
+        if kind == "msg":
+            self._step(item[1])
+        elif kind == "tick":
+            self._on_tick()
+        elif kind == "propose":
+            self._on_propose(item[1], item[2], item[3])
+        elif kind == "conf":
+            self._on_conf_change(item[1], item[2], item[3])
+        elif kind == "campaign":
+            self._campaign()
+
+    # ----------------------------------------------------------------- ticks
+    def _next_timeout(self) -> int:
+        return self.election_tick + self._rng.randrange(self.election_tick)
+
+    def _on_tick(self):
+        if self.role == LEADER:
+            self.heartbeat_elapsed += 1
+            if self.heartbeat_elapsed >= self.heartbeat_tick:
+                self.heartbeat_elapsed = 0
+                self._broadcast_append()
+        else:
+            self.election_elapsed += 1
+            if self.election_elapsed >= self._randomized_timeout:
+                self._campaign()
+
+    # -------------------------------------------------------------- election
+    def _campaign(self):
+        if self.id not in self.members and self.members:
+            return  # removed member must not start elections
+        self.role = CANDIDATE
+        self.term += 1
+        self.voted_for = self.id
+        self.votes = {self.id}
+        self.leader_id = None
+        self.election_elapsed = 0
+        self._randomized_timeout = self._next_timeout()
+        self._persist_hard_state()
+        if self._quorum(len(self.votes)):
+            self._become_leader()
+            return
+        for peer_id in self.members:
+            if peer_id == self.id:
+                continue
+            self._send(VoteRequest(
+                frm=self.id, to=peer_id, term=self.term,
+                last_log_index=self._last_index(),
+                last_log_term=self._last_term(),
+            ))
+
+    def _quorum(self, n: int) -> bool:
+        voters = len(self.members) or 1
+        return n >= voters // 2 + 1
+
+    def _become_leader(self):
+        self.role = LEADER
+        self.leader_id = self.id
+        self.heartbeat_elapsed = 0
+        last = self._last_index()
+        self.next_index = {p: last + 1 for p in self.members if p != self.id}
+        self.match_index = {p: 0 for p in self.members if p != self.id}
+        # commit a no-op entry from the new term so earlier-term entries can
+        # commit (raft §5.4.2 safety rule)
+        self._append_local(Entry(term=self.term, index=last + 1,
+                                 kind=ENTRY_NORMAL, data=None))
+        self._broadcast_append()
+        self._maybe_advance_commit()
+        self._notify_leadership(True)
+
+    def _become_follower(self, term: int, leader_id: int | None):
+        was_leader = self.role == LEADER
+        if term > self.term:
+            self.term = term
+            self.voted_for = None
+            self._persist_hard_state()
+        self.role = FOLLOWER
+        self.leader_id = leader_id
+        self.election_elapsed = 0
+        self._randomized_timeout = self._next_timeout()
+        if was_leader:
+            self._drop_waits("leadership lost")
+            self._notify_leadership(False)
+
+    def _notify_leadership(self, is_leader: bool):
+        try:
+            self.on_leadership(is_leader)
+        except Exception:
+            log.exception("raft-%d: leadership callback failed", self.id)
+
+    # ------------------------------------------------------------------ step
+    def _step(self, msg):
+        if msg.term > self.term:
+            self._become_follower(msg.term, getattr(msg, "frm", None)
+                                  if msg.kind == "append" else None)
+        handler = {
+            "vote_req": self._on_vote_request,
+            "vote_resp": self._on_vote_response,
+            "append": self._on_append,
+            "append_resp": self._on_append_response,
+            "snapshot": self._on_install_snapshot,
+        }.get(msg.kind)
+        if handler:
+            handler(msg)
+
+    def _on_vote_request(self, msg: VoteRequest):
+        grant = False
+        if msg.term >= self.term:
+            up_to_date = (msg.last_log_term, msg.last_log_index) >= (
+                self._last_term(), self._last_index())
+            not_voted = self.voted_for in (None, msg.frm)
+            if up_to_date and not_voted and msg.term == self.term:
+                grant = True
+                self.voted_for = msg.frm
+                self.election_elapsed = 0
+                self._persist_hard_state()
+        self._send(VoteResponse(frm=self.id, to=msg.frm, term=self.term,
+                                granted=grant))
+
+    def _on_vote_response(self, msg: VoteResponse):
+        if self.role != CANDIDATE or msg.term != self.term:
+            return
+        if msg.granted:
+            self.votes.add(msg.frm)
+            if self._quorum(len(self.votes)):
+                self._become_leader()
+
+    def _on_append(self, msg: AppendEntries):
+        if msg.term < self.term:
+            self._send(AppendResponse(frm=self.id, to=msg.frm, term=self.term,
+                                      success=False, match_index=0))
+            return
+        self.role = FOLLOWER
+        self.leader_id = msg.frm
+        self.election_elapsed = 0
+
+        # prev entry check
+        if msg.prev_log_index > 0:
+            if msg.prev_log_index < self.snapshot_index:
+                # already compacted; our snapshot covers it
+                pass
+            elif msg.prev_log_index > self._last_index() or (
+                    self._term_at(msg.prev_log_index) != msg.prev_log_term):
+                self._send(AppendResponse(
+                    frm=self.id, to=msg.frm, term=self.term, success=False,
+                    match_index=min(self._last_index(), msg.prev_log_index - 1)))
+                return
+
+        for e in msg.entries:
+            if e.index <= self.snapshot_index:
+                continue
+            if e.index <= self._last_index():
+                if self._term_at(e.index) != e.term:
+                    # conflict: truncate from here
+                    self.log = self.log[: e.index - self.first_index]
+                    self._append_entry_storage_truncate(e.index)
+                    self.log.append(e)
+                    self._persist_entry(e)
+            else:
+                self.log.append(e)
+                self._persist_entry(e)
+
+        if msg.leader_commit > self.commit_index:
+            self.commit_index = min(msg.leader_commit, self._last_index())
+            self._apply_committed()
+
+        self._send(AppendResponse(frm=self.id, to=msg.frm, term=self.term,
+                                  success=True,
+                                  match_index=self._last_index()))
+
+    def _on_append_response(self, msg: AppendResponse):
+        if self.role != LEADER or msg.term != self.term:
+            return
+        if msg.success:
+            self.match_index[msg.frm] = max(
+                self.match_index.get(msg.frm, 0), msg.match_index)
+            self.next_index[msg.frm] = self.match_index[msg.frm] + 1
+            self._maybe_advance_commit()
+        else:
+            # follower hinted how far behind it is
+            self.next_index[msg.frm] = max(1, msg.match_index + 1)
+            self._send_append_to(msg.frm)
+
+    def _on_install_snapshot(self, msg: InstallSnapshot):
+        if msg.term < self.term:
+            return
+        self.role = FOLLOWER
+        self.leader_id = msg.frm
+        self.election_elapsed = 0
+        if msg.snapshot_index <= self.snapshot_index:
+            return
+        self.snapshot_index = msg.snapshot_index
+        self.snapshot_term = msg.snapshot_term
+        self.log = []
+        self.first_index = msg.snapshot_index + 1
+        self.commit_index = max(self.commit_index, msg.snapshot_index)
+        self.last_applied = msg.snapshot_index
+        self.members = {
+            rid: Peer(rid, nid, addr)
+            for rid, (nid, addr) in msg.members.items()
+        }
+        self.restore_state(msg.data)
+        if self.storage is not None:
+            self.storage.save_snapshot(
+                msg.snapshot_index, msg.snapshot_term, msg.data, self.members)
+        self._send(AppendResponse(frm=self.id, to=msg.frm, term=self.term,
+                                  success=True, match_index=msg.snapshot_index))
+
+    # ------------------------------------------------------------- proposing
+    def _on_propose(self, data, request_id, callback):
+        if self.role != LEADER:
+            callback(False, f"not leader; leader is {self.leader_id}")
+            return
+        self._waits[request_id] = callback
+        e = Entry(term=self.term, index=self._last_index() + 1,
+                  kind=ENTRY_NORMAL, data=data, request_id=request_id)
+        self._append_local(e)
+        self._broadcast_append()
+        self._maybe_advance_commit()  # single-node commits immediately
+
+    def _on_conf_change(self, cc: ConfChange, request_id, callback):
+        if self.role != LEADER:
+            callback(False, f"not leader; leader is {self.leader_id}")
+            return
+        if cc.action == "remove" and not self._can_remove(cc.raft_id):
+            callback(False, "removal would break quorum of reachable members")
+            return
+        self._waits[request_id] = callback
+        e = Entry(term=self.term, index=self._last_index() + 1,
+                  kind=ENTRY_CONF_CHANGE, data=cc, request_id=request_id)
+        self._append_local(e)
+        self._broadcast_append()
+        self._maybe_advance_commit()
+
+    def _can_remove(self, raft_id: int) -> bool:
+        """reference raft.go:1170-1193 CanRemoveMember: removal must leave a
+        reachable quorum."""
+        remaining = [p for p in self.members if p != raft_id]
+        if not remaining:
+            return False
+        reachable = sum(
+            1 for p in remaining
+            if p == self.id or self.transport.active(p))
+        return reachable >= len(remaining) // 2 + 1
+
+    def _drop_waits(self, reason: str):
+        waits, self._waits = self._waits, {}
+        for cb in waits.values():
+            try:
+                cb(False, reason)
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------ replication
+    def _append_local(self, e: Entry):
+        self.log.append(e)
+        self._persist_entry(e)
+        if self.role == LEADER:
+            self._maybe_snapshot()
+
+    def _broadcast_append(self):
+        for peer_id in self.members:
+            if peer_id != self.id:
+                self._send_append_to(peer_id)
+
+    def _send_append_to(self, peer_id: int):
+        next_idx = self.next_index.get(peer_id, self._last_index() + 1)
+        if next_idx <= self.snapshot_index:
+            self._send(InstallSnapshot(
+                frm=self.id, to=peer_id, term=self.term,
+                snapshot_index=self.snapshot_index,
+                snapshot_term=self.snapshot_term,
+                members={rid: (p.node_id, p.addr)
+                         for rid, p in self.members.items()},
+                data=self.snapshot_state(),
+            ))
+            self.next_index[peer_id] = self.snapshot_index + 1
+            return
+        prev_index = next_idx - 1
+        prev_term = self._term_at(prev_index) if prev_index > 0 else 0
+        start = next_idx - self.first_index
+        entries = self.log[start:start + MAX_ENTRIES_PER_APPEND]
+        self._send(AppendEntries(
+            frm=self.id, to=peer_id, term=self.term,
+            prev_log_index=prev_index, prev_log_term=prev_term,
+            entries=list(entries), leader_commit=self.commit_index,
+        ))
+
+    def _maybe_advance_commit(self):
+        if self.role != LEADER:
+            return
+        matches = sorted(
+            [self._last_index()]
+            + [self.match_index.get(p, 0) for p in self.members if p != self.id],
+            reverse=True,
+        )
+        voters = len(self.members) or 1
+        quorum_match = matches[voters // 2] if voters > 1 else matches[0]
+        # only commit entries from the current term directly (raft §5.4.2)
+        if quorum_match > self.commit_index and \
+                self._term_at(quorum_match) == self.term:
+            self.commit_index = quorum_match
+            self._apply_committed()
+            self._broadcast_append()  # propagate the new commit index
+
+    def _apply_committed(self):
+        if self.last_applied < self.commit_index:
+            # persist the advanced commit (etcd HardState semantics: term,
+            # vote and commit survive restarts together)
+            self._persist_hard_state()
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            idx = self.last_applied - self.first_index
+            if idx < 0:
+                continue  # covered by snapshot
+            e = self.log[idx]
+            if e.kind == ENTRY_CONF_CHANGE:
+                self._apply_conf_change(e)
+            elif e.data is not None:
+                try:
+                    self.apply_entry(e)
+                except Exception:
+                    log.exception("raft-%d: apply failed at %d", self.id, e.index)
+            cb = self._waits.pop(e.request_id, None) if e.request_id else None
+            if cb is not None:
+                try:
+                    cb(True, "")
+                except Exception:
+                    log.exception("raft-%d: wait callback failed", self.id)
+        self._maybe_snapshot()
+
+    def _apply_conf_change(self, e: Entry):
+        cc: ConfChange = e.data
+        if cc.action == "add":
+            self.members[cc.raft_id] = Peer(cc.raft_id, cc.node_id, cc.addr)
+            if self.role == LEADER and cc.raft_id != self.id:
+                self.next_index.setdefault(cc.raft_id, self._last_index() + 1)
+                self.match_index.setdefault(cc.raft_id, 0)
+        elif cc.action == "remove":
+            self.members.pop(cc.raft_id, None)
+            self.next_index.pop(cc.raft_id, None)
+            self.match_index.pop(cc.raft_id, None)
+            if cc.raft_id == self.id:
+                self._become_follower(self.term, None)
+        if self.storage is not None:
+            self.storage.save_membership(self.members)
+
+    # -------------------------------------------------------------- snapshots
+    def _maybe_snapshot(self):
+        applied_in_log = self.last_applied - self.snapshot_index
+        if applied_in_log < self.snapshot_interval:
+            return
+        data = self.snapshot_state()
+        self.snapshot_term = self._term_at(self.last_applied)
+        self.snapshot_index = self.last_applied
+        keep_from = self.last_applied + 1 - self.first_index
+        self.log = self.log[keep_from:]
+        self.first_index = self.last_applied + 1
+        if self.storage is not None:
+            self.storage.save_snapshot(
+                self.snapshot_index, self.snapshot_term, data, self.members)
+            self.storage.compact(self.first_index)
+
+    # ------------------------------------------------------------ persistence
+    def _persist_hard_state(self):
+        if self.storage is not None:
+            self.storage.save_hard_state(self.term, self.voted_for,
+                                         self.commit_index)
+
+    def _persist_entry(self, e: Entry):
+        if self.storage is not None:
+            self.storage.append_entries([e])
+
+    def _append_entry_storage_truncate(self, from_index: int):
+        if self.storage is not None:
+            self.storage.truncate_from(from_index)
+
+    def _restore_from_storage(self):
+        state = self.storage.load()
+        if state is None:
+            return
+        self.term = state.term
+        self.voted_for = state.voted_for
+        self.snapshot_index = state.snapshot_index
+        self.snapshot_term = state.snapshot_term
+        self.first_index = state.snapshot_index + 1
+        self.log = list(state.entries)
+        self.members = dict(state.members)
+        self.commit_index = max(state.commit_index, state.snapshot_index)
+        self.last_applied = self.snapshot_index
+        if state.snapshot_data is not None:
+            self.restore_state(state.snapshot_data)
+        self._apply_committed()
+
+    # ----------------------------------------------------------------- helpers
+    def _last_index(self) -> int:
+        return self.first_index + len(self.log) - 1 if self.log else self.snapshot_index
+
+    def _last_term(self) -> int:
+        return self.log[-1].term if self.log else self.snapshot_term
+
+    def _term_at(self, index: int) -> int:
+        if index == self.snapshot_index:
+            return self.snapshot_term
+        i = index - self.first_index
+        if 0 <= i < len(self.log):
+            return self.log[i].term
+        return -1
+
+    def _send(self, msg):
+        try:
+            self.transport.send(msg)
+        except Exception:
+            log.debug("raft-%d: send to %d failed", self.id, msg.to)
+
+    # ------------------------------------------------------------- introspect
+    @property
+    def is_leader(self) -> bool:
+        return self.role == LEADER
+
+    def status(self) -> dict:
+        return {
+            "id": self.id,
+            "role": self.role,
+            "term": self.term,
+            "leader": self.leader_id,
+            "commit": self.commit_index,
+            "applied": self.last_applied,
+            "last_index": self._last_index(),
+            "members": {p.raft_id: p.addr for p in self.members.values()},
+        }

@@ -1,0 +1,100 @@
+"""RaftProposer: bridges MemoryStore transactions onto raft consensus.
+
+The reference's write path (SURVEY.md §3.4): store.update collects a
+changelist → proposer.ProposeValue blocks until the entry commits → the
+registered wait triggers the in-memory commit on the leader; followers (and
+restart replay) apply the same actions via ApplyStoreActions. Object
+versions are stamped with the raft entry index on every replica, so
+version-checked updates behave identically cluster-wide.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from ..api.objects import Version
+from ..utils.identity import new_id
+from .messages import Entry
+from .node import RaftNode
+
+PROPOSE_TIMEOUT = 30.0
+
+
+class ProposeError(Exception):
+    pass
+
+
+class RaftProposer:
+    def __init__(self, node: RaftNode, store=None):
+        self.node = node
+        self.store = store
+        self._pending: dict[str, Callable[[int], None]] = {}
+        self._lock = threading.Lock()
+        node.apply_entry = self._apply_entry
+        node.snapshot_state = self._snapshot_state
+        node.restore_state = self._restore_state
+
+    def attach_store(self, store):
+        """Wire the store, then replay any persisted raft state into it —
+        construct the node with auto_recover=False for this to work."""
+        self.store = store
+        self.node.recover()
+
+    def _snapshot_state(self):
+        return self.store.save() if self.store is not None else None
+
+    def _restore_state(self, snap):
+        if self.store is not None and snap is not None:
+            self.store.restore(snap)
+
+    # ------------------------------------------------------ Proposer protocol
+    def propose_value(self, actions, commit_cb: Callable[..., None]) -> None:
+        req_id = new_id()
+        done = threading.Event()
+        outcome: dict = {}
+
+        with self._lock:
+            self._pending[req_id] = commit_cb
+
+        def on_result(ok: bool, err: str):
+            outcome["ok"] = ok
+            outcome["err"] = err
+            done.set()
+
+        self.node.propose(list(actions), req_id, on_result)
+        if not done.wait(PROPOSE_TIMEOUT):
+            with self._lock:
+                self._pending.pop(req_id, None)
+            raise ProposeError("proposal timed out")
+        if not outcome.get("ok"):
+            with self._lock:
+                self._pending.pop(req_id, None)
+            raise ProposeError(outcome.get("err") or "proposal dropped")
+
+    def get_version(self) -> Version:
+        return Version(self.node.commit_index)
+
+    def changes_between(self, from_v: Version, to_v: Version) -> list:
+        out = []
+        node = self.node
+        for e in node.log:
+            if from_v.index < e.index <= to_v.index and e.data is not None \
+                    and e.kind == 0:
+                out.append(e.data)
+        return out
+
+    # --------------------------------------------------------------- applying
+    def _apply_entry(self, entry: Entry) -> None:
+        """Runs on every replica in commit order (raft worker thread)."""
+        cb = None
+        if entry.request_id:
+            with self._lock:
+                cb = self._pending.pop(entry.request_id, None)
+        if cb is not None:
+            # leader fast path: the waiting transaction commits its own
+            # buffered writes, stamped with this entry's index
+            cb(version_index=entry.index)
+        elif self.store is not None and entry.data is not None:
+            # follower / replay path
+            self.store.apply_store_actions(entry.data,
+                                           version_index=entry.index)

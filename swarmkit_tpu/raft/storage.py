@@ -1,0 +1,227 @@
+"""Raft durable storage: WAL + snapshots, encrypted at rest.
+
+Re-derivation of the reference's encrypted raft storage
+(manager/state/raft/storage/: walwrap.go, snapwrap.go, EncryptedRaftLogger):
+every appended entry and every snapshot is sealed with a data-encryption key
+(DEK) before hitting disk; the DEK can be rotated (re-encrypting the current
+snapshot + tail of the WAL). We use Fernet (AES128-CBC + HMAC) from the
+`cryptography` package — the stand-in for the reference's NaCl secretbox /
+fernet encoders (manager/encryption/).
+
+Layout under `dir`:  wal.jsonl (one sealed record per line), snapshot.bin,
+hardstate.json, membership.json.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import os
+import pickle
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+from cryptography.fernet import Fernet, InvalidToken
+
+from .messages import ConfChange, Entry
+from .node import Peer
+
+
+def new_dek() -> bytes:
+    return Fernet.generate_key()
+
+
+class Sealer:
+    """Encrypt/decrypt with a current DEK plus optional pending DEK
+    (MultiDecrypter semantics from manager/encryption/encryption.go)."""
+
+    def __init__(self, dek: bytes | None):
+        self._fernets = [Fernet(dek)] if dek else []
+
+    def add_key(self, dek: bytes):
+        self._fernets.insert(0, Fernet(dek))
+
+    def seal(self, raw: bytes) -> bytes:
+        if not self._fernets:
+            return base64.b64encode(raw)
+        return self._fernets[0].encrypt(raw)
+
+    def unseal(self, blob: bytes) -> bytes:
+        if not self._fernets:
+            return base64.b64decode(blob)
+        for f in self._fernets:
+            try:
+                return f.decrypt(blob)
+            except InvalidToken:
+                continue
+        raise InvalidToken("no DEK decrypts this record")
+
+
+@dataclass
+class LoadedState:
+    term: int = 0
+    voted_for: int | None = None
+    commit_index: int = 0
+    snapshot_index: int = 0
+    snapshot_term: int = 0
+    snapshot_data: Any = None
+    entries: list[Entry] = field(default_factory=list)
+    members: dict[int, Peer] = field(default_factory=dict)
+
+
+class RaftStorage:
+    def __init__(self, dir: str, dek: bytes | None = None):
+        self.dir = dir
+        os.makedirs(dir, exist_ok=True)
+        self.sealer = Sealer(dek)
+        self._lock = threading.Lock()
+        self._wal_path = os.path.join(dir, "wal.jsonl")
+        self._snap_path = os.path.join(dir, "snapshot.bin")
+        self._hs_path = os.path.join(dir, "hardstate.json")
+        self._members_path = os.path.join(dir, "membership.json")
+        self._wal_file = None
+
+    # ----------------------------------------------------------------- write
+    def append_entries(self, entries: list[Entry]):
+        with self._lock:
+            if self._wal_file is None:
+                self._wal_file = open(self._wal_path, "ab")
+            for e in entries:
+                raw = pickle.dumps(e)
+                self._wal_file.write(self.sealer.seal(raw) + b"\n")
+            self._wal_file.flush()
+            os.fsync(self._wal_file.fileno())
+
+    def truncate_from(self, index: int):
+        """Drop WAL entries at or after `index` (conflict truncation)."""
+        with self._lock:
+            self._close_wal()
+            kept = []
+            for e in self._read_wal():
+                if e.index < index:
+                    kept.append(e)
+            self._rewrite_wal(kept)
+
+    def compact(self, first_index: int):
+        """Drop WAL entries below first_index (they live in the snapshot)."""
+        with self._lock:
+            self._close_wal()
+            kept = [e for e in self._read_wal() if e.index >= first_index]
+            self._rewrite_wal(kept)
+
+    def save_hard_state(self, term: int, voted_for: int | None, commit: int):
+        with self._lock:
+            tmp = self._hs_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"term": term, "voted_for": voted_for,
+                           "commit": commit}, f)
+            os.replace(tmp, self._hs_path)
+
+    def save_membership(self, members: dict[int, Peer]):
+        with self._lock:
+            tmp = self._members_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({str(rid): [p.node_id, p.addr]
+                           for rid, p in members.items()}, f)
+            os.replace(tmp, self._members_path)
+
+    def save_snapshot(self, index: int, term: int, data: Any,
+                      members: dict[int, Peer]):
+        with self._lock:
+            payload = pickle.dumps({
+                "index": index, "term": term, "data": data,
+                "members": {rid: (p.node_id, p.addr)
+                            for rid, p in members.items()},
+            })
+            tmp = self._snap_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(self.sealer.seal(payload))
+            os.replace(tmp, self._snap_path)
+
+    # --------------------------------------------------------------- rotation
+    def rotate_dek(self, new_key: bytes):
+        """Re-seal snapshot + WAL under a new DEK (reference DEK rotation
+        handshake, raft.go:730-742)."""
+        with self._lock:
+            self._close_wal()
+            entries = self._read_wal()
+            snap = self._read_snapshot()
+            old = self.sealer
+            self.sealer = Sealer(new_key)
+            self.sealer._fernets.extend(old._fernets)  # still able to read old
+            self._rewrite_wal(entries)
+            if snap is not None:
+                payload = pickle.dumps(snap)
+                tmp = self._snap_path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(self.sealer.seal(payload))
+                os.replace(tmp, self._snap_path)
+
+    # ------------------------------------------------------------------ read
+    def load(self) -> LoadedState | None:
+        with self._lock:
+            if not (os.path.exists(self._wal_path)
+                    or os.path.exists(self._snap_path)
+                    or os.path.exists(self._hs_path)):
+                return None
+            st = LoadedState()
+            snap = self._read_snapshot()
+            if snap is not None:
+                st.snapshot_index = snap["index"]
+                st.snapshot_term = snap["term"]
+                st.snapshot_data = snap["data"]
+                st.members = {rid: Peer(rid, nid, addr)
+                              for rid, (nid, addr) in snap["members"].items()}
+            if os.path.exists(self._hs_path):
+                with open(self._hs_path) as f:
+                    hs = json.load(f)
+                st.term = hs["term"]
+                st.voted_for = hs["voted_for"]
+                st.commit_index = hs["commit"]
+            if os.path.exists(self._members_path):
+                with open(self._members_path) as f:
+                    st.members = {
+                        int(rid): Peer(int(rid), nid, addr)
+                        for rid, (nid, addr) in json.load(f).items()
+                    }
+            st.entries = [e for e in self._read_wal()
+                          if e.index > st.snapshot_index]
+            return st
+
+    # -------------------------------------------------------------- internals
+    def _read_wal(self) -> list[Entry]:
+        if not os.path.exists(self._wal_path):
+            return []
+        out = []
+        with open(self._wal_path, "rb") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(pickle.loads(self.sealer.unseal(line)))
+                except (InvalidToken, pickle.UnpicklingError, EOFError):
+                    break  # torn tail write: stop at first bad record
+        return out
+
+    def _read_snapshot(self):
+        if not os.path.exists(self._snap_path):
+            return None
+        with open(self._snap_path, "rb") as f:
+            blob = f.read()
+        try:
+            return pickle.loads(self.sealer.unseal(blob))
+        except (InvalidToken, pickle.UnpicklingError, EOFError):
+            return None
+
+    def _rewrite_wal(self, entries: list[Entry]):
+        tmp = self._wal_path + ".tmp"
+        with open(tmp, "wb") as f:
+            for e in entries:
+                f.write(self.sealer.seal(pickle.dumps(e)) + b"\n")
+        os.replace(tmp, self._wal_path)
+
+    def _close_wal(self):
+        if self._wal_file is not None:
+            self._wal_file.close()
+            self._wal_file = None

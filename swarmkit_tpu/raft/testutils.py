@@ -1,0 +1,157 @@
+"""Deterministic multi-node raft test harness.
+
+The reference's tier-2 strategy (SURVEY.md §4): fake clock + in-process
+cluster + partitionable transport (manager/state/raft/testutils). Here the
+transport is an in-memory router whose links can be cut to simulate
+partitions; the clock is manual ticks; `settle` pumps messages until
+quiescent so tests are deterministic without sleeps.
+"""
+from __future__ import annotations
+
+import random
+import threading
+from collections import defaultdict
+
+from .node import Peer, RaftNode
+
+
+class MemoryTransport:
+    """Router delivering messages synchronously into peer inboxes; links can
+    be severed per (src, dst) pair (WrappedListener partition analogue)."""
+
+    def __init__(self):
+        self.nodes: dict[int, RaftNode] = {}
+        self.cut: set[tuple[int, int]] = set()
+        self.dropped = 0
+        self._lock = threading.Lock()
+
+    def register(self, node: RaftNode):
+        self.nodes[node.id] = node
+
+    def for_node(self, raft_id: int) -> "TransportHandle":
+        return TransportHandle(self, raft_id)
+
+    def send(self, frm: int, msg):
+        with self._lock:
+            blocked = (frm, msg.to) in self.cut or msg.to not in self.nodes
+        if blocked:
+            self.dropped += 1
+            return
+        self.nodes[msg.to].step(msg)
+
+    def active(self, frm: int, to: int) -> bool:
+        return (frm, to) not in self.cut and to in self.nodes
+
+    # ---- partition control -------------------------------------------------
+    def isolate(self, raft_id: int):
+        with self._lock:
+            for other in self.nodes:
+                if other != raft_id:
+                    self.cut.add((raft_id, other))
+                    self.cut.add((other, raft_id))
+
+    def heal(self, raft_id: int | None = None):
+        with self._lock:
+            if raft_id is None:
+                self.cut.clear()
+            else:
+                self.cut = {
+                    (a, b) for (a, b) in self.cut
+                    if a != raft_id and b != raft_id
+                }
+
+
+class TransportHandle:
+    def __init__(self, router: MemoryTransport, raft_id: int):
+        self.router = router
+        self.raft_id = raft_id
+
+    def send(self, msg):
+        self.router.send(self.raft_id, msg)
+
+    def active(self, peer_id: int) -> bool:
+        return self.router.active(self.raft_id, peer_id)
+
+
+class RaftCluster:
+    """N in-process raft nodes on a memory transport with a manual clock."""
+
+    def __init__(self, n: int, storages: dict[int, object] | None = None,
+                 apply_cbs: dict[int, object] | None = None,
+                 snapshot_interval: int = 1000, seed: int = 7):
+        self.router = MemoryTransport()
+        self.nodes: dict[int, RaftNode] = {}
+        peers = [Peer(i, f"node-{i}", f"mem://{i}") for i in range(1, n + 1)]
+        for i in range(1, n + 1):
+            node = RaftNode(
+                raft_id=i,
+                transport=self.router.for_node(i),
+                storage=(storages or {}).get(i),
+                apply_entry=(apply_cbs or {}).get(i, lambda e: None),
+                snapshot_interval=snapshot_interval,
+                rng=random.Random(seed + i),
+            )
+            node.bootstrap(peers)
+            self.router.register(node)
+            self.nodes[i] = node
+
+    # ---- deterministic pumping --------------------------------------------
+    def settle(self, rounds: int = 50):
+        """Process every queued event until the cluster goes quiet."""
+        for _ in range(rounds):
+            busy = False
+            for node in self.nodes.values():
+                if not node._inbox.empty():
+                    busy = True
+                node.process_all()
+            if not busy:
+                return
+
+    def tick_all(self, n: int = 1):
+        for _ in range(n):
+            for node in self.nodes.values():
+                node.tick()
+            self.settle()
+
+    def elect(self, raft_id: int) -> RaftNode:
+        self.nodes[raft_id].campaign()
+        self.settle()
+        assert self.nodes[raft_id].is_leader, self.status()
+        return self.nodes[raft_id]
+
+    def leader(self) -> RaftNode | None:
+        """The acting leader: highest term wins (an isolated stale leader
+        keeps believing until it observes the newer term)."""
+        leaders = [n for n in self.nodes.values() if n.is_leader]
+        return max(leaders, key=lambda n: n.term) if leaders else None
+
+    def _leader_has_quorum(self, node: RaftNode) -> bool:
+        members = node.members or {node.id: None}
+        reachable = sum(
+            1 for p in members
+            if p == node.id or self.router.active(node.id, p))
+        return reachable >= len(members) // 2 + 1
+
+    def tick_until_leader(self, max_ticks: int = 200) -> RaftNode:
+        """Tick until a leader that can actually reach a quorum exists (a
+        stale isolated leader keeps its role but cannot commit)."""
+        for _ in range(max_ticks):
+            self.tick_all()
+            candidates = [n for n in self.nodes.values()
+                          if n.is_leader and self._leader_has_quorum(n)]
+            if candidates:
+                return max(candidates, key=lambda n: n.term)
+        raise AssertionError(f"no leader after {max_ticks} ticks: {self.status()}")
+
+    def propose(self, data, request_id: str = None) -> bool:
+        from ..utils.identity import new_id
+        leader = self.leader()
+        assert leader is not None
+        result = {}
+        leader.propose(data, request_id or new_id(),
+                       lambda ok, err: result.update(ok=ok, err=err))
+        self.settle()
+        return result.get("ok", False)
+
+    def status(self):
+        return {i: n.status() for i, n in self.nodes.items()}

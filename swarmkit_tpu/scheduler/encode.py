@@ -109,6 +109,22 @@ class EncodedProblem:
 _INT32_MAX = (1 << 31) - 1
 
 
+# Canonical positional order of EncodedProblem arrays as consumed by
+# ops.placement.schedule_groups — the ONE place the 19-arg contract lives;
+# bench, the graft entry, and the mesh sharder all derive from it.
+KERNEL_ARG_FIELDS = (
+    "ready", "node_val", "node_plat", "node_plugins", "extra_mask",
+    "constraints", "plat_req", "req_plugins", "avail_res", "total0",
+    "svc_count0", "n_tasks", "svc_idx", "need_res", "max_replicas",
+    "penalty", "has_ports", "group_ports", "port_used0",
+)
+
+
+def kernel_args(p: "EncodedProblem") -> tuple:
+    """The problem's arrays in schedule_groups' positional order (numpy)."""
+    return tuple(np.asarray(getattr(p, f)) for f in KERNEL_ARG_FIELDS)
+
+
 def quantize_need(res) -> tuple[int, int]:
     cpu = -(-res.nano_cpus // CPU_QUANTUM) if res.nano_cpus > 0 else 0
     mem = -(-res.memory_bytes // MEM_QUANTUM) if res.memory_bytes > 0 else 0

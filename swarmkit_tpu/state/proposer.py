@@ -14,9 +14,12 @@ from ..api.objects import Version
 
 
 class Proposer(Protocol):
-    def propose_value(self, actions, commit_cb: Callable[[], None]) -> None:
-        """Replicate `actions`; call commit_cb once committed. Must not return
-        before commit_cb has run (raft.ProposeValue blocks on quorum)."""
+    def propose_value(self, actions,
+                      commit_cb: Callable[..., None]) -> None:
+        """Replicate `actions`; once committed, invoke
+        commit_cb(version_index=<replicated index>) — the store stamps object
+        versions from it so replicas agree. Must not return before commit_cb
+        has run (raft.ProposeValue blocks on quorum)."""
         ...
 
     def get_version(self) -> Version:
@@ -33,10 +36,10 @@ class LocalProposer:
         self._index = 0
         self._log: list[tuple[int, list]] = []
 
-    def propose_value(self, actions, commit_cb: Callable[[], None]) -> None:
+    def propose_value(self, actions, commit_cb: Callable[..., None]) -> None:
         self._index += 1
         self._log.append((self._index, list(actions)))
-        commit_cb()
+        commit_cb(version_index=self._index)
 
     def get_version(self) -> Version:
         return Version(self._index)

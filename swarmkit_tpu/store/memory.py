@@ -223,8 +223,8 @@ class MemoryStore:
                     actions = list(tx._changelist)
                     committed = threading.Event()
 
-                    def commit_cb():
-                        self._commit(tx)
+                    def commit_cb(version_index: int | None = None):
+                        self._commit(tx, version_index=version_index)
                         committed.set()
 
                     self.proposer.propose_value(actions, commit_cb)
@@ -239,10 +239,15 @@ class MemoryStore:
             finally:
                 self._update_lock_held_since = None
 
-    def _commit(self, tx: WriteTx) -> None:
+    def _commit(self, tx: WriteTx, version_index: int | None = None) -> None:
         now = time.time()
         with self._lock:
-            self._version.index += 1
+            if version_index is not None:
+                # replicated commits carry the raft entry index so object
+                # versions agree on every replica
+                self._version.index = max(self._version.index, version_index)
+            else:
+                self._version.index += 1
             version = Version(self._version.index)
             events: list[Any] = []
             for action in tx._changelist:
@@ -271,7 +276,8 @@ class MemoryStore:
             events.append(EventCommit(version))
         self.queue.publish_all(events)
 
-    def apply_store_actions(self, actions: Iterable[StoreAction]) -> None:
+    def apply_store_actions(self, actions: Iterable[StoreAction],
+                            version_index: int | None = None) -> None:
         """Raft follower/replay apply path (memory.go:280-308): applies a
         committed changelist without consulting the proposer."""
         with self._update_lock:
@@ -293,7 +299,7 @@ class MemoryStore:
                         tx.delete(type(a.obj), a.obj.id)
                     except NotExistError:
                         pass
-            self._commit(tx)
+            self._commit(tx, version_index=version_index)
 
     def batch(self, cb: Callable[["Batch"], Any]) -> None:
         """Split a large write into transactions of at most

@@ -110,3 +110,32 @@ def test_update_failure_after_running_triggers_pause():
             == UpdateStatusState.PAUSED.value), timeout=20)
     finally:
         c.stop()
+
+
+def test_port_freed_when_spec_drops_it():
+    """Updating a service's port set must release the old port so another
+    service can claim it (no deletion involved)."""
+    c = MiniCluster(n_agents=1, behaviors={"svc-a": {"run_forever": True},
+                                           "svc-b": {"run_forever": True}})
+    c.start()
+    try:
+        s1 = Service(id="svc-a", spec=ServiceSpec(
+            annotations=Annotations(name="a"), replicas=1))
+        s1.spec.endpoint.ports = [PortConfig(protocol="tcp", target_port=80,
+                                             published_port=8080)]
+        c.store.update(lambda tx: tx.create(s1))
+        assert wait_for(lambda: len(c.running_tasks("svc-a")) == 1, timeout=15)
+
+        cur = c.store.view().get_service("svc-a").copy()
+        cur.spec.endpoint.ports = [PortConfig(protocol="tcp", target_port=80,
+                                              published_port=9090)]
+        c.store.update(lambda tx: tx.update(cur))
+
+        s2 = Service(id="svc-b", spec=ServiceSpec(
+            annotations=Annotations(name="b"), replicas=1))
+        s2.spec.endpoint.ports = [PortConfig(protocol="tcp", target_port=80,
+                                             published_port=8080)]
+        c.store.update(lambda tx: tx.create(s2))
+        assert wait_for(lambda: len(c.running_tasks("svc-b")) == 1, timeout=15)
+    finally:
+        c.stop()

@@ -1,0 +1,246 @@
+"""Raft protocol tests on the deterministic in-process cluster harness
+(fake clock + partitionable memory transport), mirroring the reference's
+raft_test.go scenarios: election, replication, leader loss, partitions,
+log conflict repair, membership change, snapshot install, restart recovery."""
+import os
+
+import pytest
+
+from swarmkit_tpu.raft.messages import ConfChange
+from swarmkit_tpu.raft.node import Peer
+from swarmkit_tpu.raft.storage import RaftStorage, new_dek
+from swarmkit_tpu.raft.testutils import RaftCluster
+
+
+def collect_applier(log_list):
+    def apply(entry):
+        log_list.append((entry.index, entry.data))
+    return apply
+
+
+def test_single_node_self_elects_and_commits():
+    c = RaftCluster(1)
+    leader = c.tick_until_leader()
+    assert leader.id == 1
+    assert c.propose({"op": 1})
+    assert leader.commit_index >= 2  # no-op + proposal
+
+
+def test_three_node_election_and_replication():
+    applied = {i: [] for i in (1, 2, 3)}
+    c = RaftCluster(3, apply_cbs={i: collect_applier(applied[i]) for i in (1, 2, 3)})
+    leader = c.tick_until_leader()
+    for k in range(5):
+        assert c.propose({"op": k})
+    c.settle()
+    for i in (1, 2, 3):
+        assert [d for _, d in applied[i]] == [{"op": k} for k in range(5)]
+    # all logs agree
+    assert len({n.commit_index for n in c.nodes.values()}) == 1
+
+
+def test_follower_rejects_proposals():
+    c = RaftCluster(3)
+    leader = c.tick_until_leader()
+    follower = next(n for n in c.nodes.values() if not n.is_leader)
+    result = {}
+    follower.propose({"x": 1}, "req-1", lambda ok, err: result.update(ok=ok, err=err))
+    c.settle()
+    assert result["ok"] is False and "not leader" in result["err"]
+
+
+def test_leader_partition_reelection_and_rejoin():
+    applied = {i: [] for i in (1, 2, 3)}
+    c = RaftCluster(3, apply_cbs={i: collect_applier(applied[i]) for i in (1, 2, 3)})
+    leader = c.tick_until_leader()
+    old_leader = leader.id
+    assert c.propose({"op": "before"})
+
+    c.router.isolate(old_leader)
+    new_leader = c.tick_until_leader()
+    assert new_leader.id != old_leader
+    assert c.propose({"op": "after"})
+
+    # old leader cannot commit anything while isolated
+    result = {}
+    c.nodes[old_leader].propose({"op": "stale"}, "stale-req",
+                                lambda ok, err: result.update(ok=ok, err=err))
+    c.tick_all(30)
+    assert result.get("ok") is not True
+
+    # rejoin: old leader steps down, catches up, stale proposal dropped
+    c.router.heal()
+    c.tick_all(10)
+    assert not c.nodes[old_leader].is_leader
+    datas = [d for _, d in applied[old_leader]]
+    assert {"op": "after"} in datas
+    assert {"op": "stale"} not in datas
+    # leadership-loss wait cancellation (raft.go:644-670 analogue)
+    assert result.get("ok") is False
+
+
+def test_quorum_loss_blocks_commit():
+    c = RaftCluster(3)
+    leader = c.tick_until_leader()
+    c.router.isolate(next(i for i in c.nodes if i != leader.id))
+    c.settle()
+    assert c.propose({"op": "two-of-three"})  # quorum of 2 still fine
+    second = next(i for i in c.nodes
+                  if i != leader.id and c.router.active(leader.id, i))
+    c.router.isolate(second)
+    result = {}
+    leader.propose({"op": "alone"}, "r", lambda ok, err: result.update(ok=ok))
+    c.tick_all(5)
+    assert result.get("ok") is None  # cannot commit without quorum
+
+
+def test_membership_add_and_remove():
+    c = RaftCluster(3)
+    leader = c.tick_until_leader()
+    # add a fourth node
+    from swarmkit_tpu.raft.node import RaftNode
+    import random as _r
+    n4 = RaftNode(raft_id=4, transport=c.router.for_node(4),
+                  rng=_r.Random(99))
+    c.router.register(n4)
+    c.nodes[4] = n4
+    result = {}
+    leader.propose_conf_change(
+        ConfChange(action="add", raft_id=4, node_id="node-4", addr="mem://4"),
+        "cc-add", lambda ok, err: result.update(ok=ok, err=err))
+    c.settle()
+    assert result["ok"]
+    c.tick_all(5)
+    assert 4 in leader.members
+    assert 4 in c.nodes[4].members  # learned via snapshot/append
+
+    # remove it again
+    result = {}
+    leader.propose_conf_change(
+        ConfChange(action="remove", raft_id=4),
+        "cc-rm", lambda ok, err: result.update(ok=ok, err=err))
+    c.settle()
+    assert result["ok"]
+    assert 4 not in leader.members
+
+
+def test_remove_blocked_when_quorum_would_break():
+    c = RaftCluster(3)
+    leader = c.tick_until_leader()
+    others = [i for i in c.nodes if i != leader.id]
+    c.router.isolate(others[0])
+    # removing the OTHER healthy member would leave 2 members with 1 reachable
+    result = {}
+    leader.propose_conf_change(
+        ConfChange(action="remove", raft_id=others[1]),
+        "cc-bad", lambda ok, err: result.update(ok=ok, err=err))
+    c.settle()
+    assert result["ok"] is False and "quorum" in result["err"]
+
+
+def test_lagging_follower_gets_snapshot():
+    applied = {i: [] for i in (1, 2, 3)}
+    c = RaftCluster(3, snapshot_interval=10,
+                    apply_cbs={i: collect_applier(applied[i]) for i in (1, 2, 3)},
+                    )
+    # snapshot_state returns the count of applied ops so restore is checkable
+    for i, n in c.nodes.items():
+        n.snapshot_state = (lambda i=i: {"applied": len(applied[i])})
+        n.restore_state = (lambda s, i=i: applied[i].append(("snap", s)))
+    leader = c.tick_until_leader()
+    laggard = next(i for i in c.nodes if i != leader.id)
+    c.router.isolate(laggard)
+    for k in range(30):  # well past snapshot_interval
+        assert c.propose({"op": k})
+    c.router.heal()
+    c.tick_all(10)
+    lag_node = c.nodes[laggard]
+    assert lag_node.snapshot_index > 0
+    assert lag_node.commit_index == leader.commit_index
+    assert any(tag == "snap" for tag, _ in
+               [x for x in applied[laggard] if isinstance(x[0], str)])
+
+
+def test_log_conflict_truncation():
+    c = RaftCluster(3, seed=11)
+    leader = c.tick_until_leader()
+    old = leader.id
+    # leader appends entries that never replicate (full isolation first)
+    c.router.isolate(old)
+    for k in range(3):
+        leader.propose({"op": f"uncommitted-{k}"}, f"u{k}", lambda ok, err: None)
+    c.nodes[old].process_all()
+    new_leader = c.tick_until_leader()
+    assert c.propose({"op": "committed"})
+    c.router.heal()
+    c.tick_all(10)
+    # old leader's conflicting tail was truncated and replaced
+    old_node = c.nodes[old]
+    assert old_node.commit_index == new_leader.commit_index
+    terms = [e.data for e in old_node.log if e.data]
+    assert {"op": "committed"} in [d for d in terms if isinstance(d, dict)]
+
+
+def test_restart_from_storage(tmp_path):
+    dek = new_dek()
+    applied = []
+    storage = RaftStorage(str(tmp_path / "raft"), dek=dek)
+    c = RaftCluster(1, storages={1: storage},
+                    apply_cbs={1: collect_applier(applied)})
+    leader = c.tick_until_leader()
+    for k in range(7):
+        assert c.propose({"op": k})
+    commit = leader.commit_index
+    c.nodes[1].stop()
+
+    # wrong DEK must not decrypt
+    bad = RaftStorage(str(tmp_path / "raft"), dek=new_dek())
+    st = bad.load()
+    assert st is not None and len(st.entries) == 0
+
+    applied2 = []
+    storage2 = RaftStorage(str(tmp_path / "raft"), dek=dek)
+    from swarmkit_tpu.raft.node import RaftNode
+    import random as _r
+    from swarmkit_tpu.raft.testutils import MemoryTransport
+    router = MemoryTransport()
+    n = RaftNode(raft_id=1, transport=router.for_node(1), storage=storage2,
+                 apply_entry=collect_applier(applied2), rng=_r.Random(1))
+    router.register(n)
+    assert n._last_index() >= commit
+    assert [d for _, d in applied2] == [{"op": k} for k in range(7)]
+
+
+def test_snapshot_compaction_with_storage(tmp_path):
+    storage = RaftStorage(str(tmp_path / "raft"))
+    applied = []
+    c = RaftCluster(1, storages={1: storage}, snapshot_interval=5,
+                    apply_cbs={1: collect_applier(applied)})
+    c.nodes[1].snapshot_state = lambda: {"count": len(applied)}
+    restored = []
+    leader = c.tick_until_leader()
+    for k in range(20):
+        assert c.propose({"op": k})
+    assert leader.snapshot_index > 0
+    # restart: snapshot + short WAL tail
+    c.nodes[1].stop()
+    storage2 = RaftStorage(str(tmp_path / "raft"))
+    st = storage2.load()
+    assert st.snapshot_index > 0
+    assert all(e.index > st.snapshot_index for e in st.entries)
+    assert len(st.entries) < 20
+
+
+def test_dek_rotation(tmp_path):
+    dek1 = new_dek()
+    storage = RaftStorage(str(tmp_path / "raft"), dek=dek1)
+    from swarmkit_tpu.raft.messages import Entry
+    storage.append_entries([Entry(term=1, index=1, data={"a": 1})])
+    dek2 = new_dek()
+    storage.rotate_dek(dek2)
+    storage.append_entries([Entry(term=1, index=2, data={"a": 2})])
+    # a reader with only the new key can read everything (old records were
+    # re-sealed during rotation)
+    reader = RaftStorage(str(tmp_path / "raft"), dek=dek2)
+    st = reader.load()
+    assert [e.index for e in st.entries] == [1, 2]

@@ -1,0 +1,111 @@
+"""Raft-replicated store: leader writes replicate to follower stores with
+identical object versions; failover keeps state; follower writes fail."""
+import pytest
+
+from swarmkit_tpu.api.objects import Task
+from swarmkit_tpu.api.types import TaskState
+from swarmkit_tpu.raft.proposer import ProposeError, RaftProposer
+from swarmkit_tpu.raft.testutils import RaftCluster
+from swarmkit_tpu.store.memory import MemoryStore
+
+
+def make_replicated_stores(n=3):
+    c = RaftCluster(n)
+    stores, proposers = {}, {}
+    for i, node in c.nodes.items():
+        proposer = RaftProposer(node)
+        store = MemoryStore(proposer=proposer)
+        proposer.attach_store(store)
+        stores[i] = store
+        proposers[i] = proposer
+    return c, stores
+
+
+def _propose_in_thread(c, fn):
+    """Run a store.update against the replicated store: the raft worker needs
+    to process while update blocks, so pump the cluster from this thread."""
+    import threading
+    err: list = []
+
+    def run():
+        try:
+            fn()
+        except Exception as e:
+            err.append(e)
+
+    t = threading.Thread(target=run)
+    t.start()
+    for _ in range(2000):
+        if not t.is_alive():
+            break
+        c.settle()
+    t.join(timeout=5)
+    if err:
+        raise err[0]
+
+
+def test_leader_write_replicates_to_followers():
+    c, stores = make_replicated_stores(3)
+    leader = c.tick_until_leader()
+    store = stores[leader.id]
+
+    t = Task(id="t1", service_id="svc")
+    t.desired_state = TaskState.RUNNING
+    _propose_in_thread(c, lambda: store.update(lambda tx: tx.create(t)))
+    c.settle()
+
+    for i, s in stores.items():
+        got = s.view(lambda tx: tx.get_task("t1"))
+        assert got is not None, f"store {i} missing task"
+    versions = {s.view(lambda tx: tx.get_task("t1")).meta.version.index
+                for s in stores.values()}
+    assert len(versions) == 1, f"version divergence: {versions}"
+
+
+def test_follower_store_write_fails():
+    c, stores = make_replicated_stores(3)
+    leader = c.tick_until_leader()
+    follower_id = next(i for i in c.nodes if i != leader.id)
+    t = Task(id="t1")
+    with pytest.raises(ProposeError):
+        _propose_in_thread(
+            c, lambda: stores[follower_id].update(lambda tx: tx.create(t)))
+
+
+def test_failover_preserves_replicated_state():
+    c, stores = make_replicated_stores(3)
+    leader = c.tick_until_leader()
+    store = stores[leader.id]
+    for k in range(5):
+        t = Task(id=f"t{k}", service_id="svc")
+        _propose_in_thread(c, lambda t=t: store.update(lambda tx: tx.create(t)))
+    c.settle()
+
+    old_id = leader.id
+    c.router.isolate(old_id)
+    new_leader = c.tick_until_leader()
+    assert new_leader.id != old_id
+    new_store = stores[new_leader.id]
+    # all writes survived failover
+    assert len(new_store.view().find_tasks()) == 5
+    # and the new leader accepts writes
+    t = Task(id="after-failover")
+    _propose_in_thread(c, lambda: new_store.update(lambda tx: tx.create(t)))
+    assert new_store.view(lambda tx: tx.get_task("after-failover")) is not None
+
+
+def test_version_conflicts_replicated():
+    """Optimistic concurrency works identically through raft."""
+    c, stores = make_replicated_stores(3)
+    leader = c.tick_until_leader()
+    store = stores[leader.id]
+    t = Task(id="t1")
+    _propose_in_thread(c, lambda: store.update(lambda tx: tx.create(t)))
+    stale = store.view(lambda tx: tx.get_task("t1")).copy()
+    fresh = stale.copy()
+    fresh.node_id = "n1"
+    _propose_in_thread(c, lambda: store.update(lambda tx: tx.update(fresh)))
+    from swarmkit_tpu.store.memory import SequenceConflict
+    stale.node_id = "n2"
+    with pytest.raises(SequenceConflict):
+        _propose_in_thread(c, lambda: store.update(lambda tx: tx.update(stale)))
