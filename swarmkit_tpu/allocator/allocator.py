@@ -171,8 +171,13 @@ class Allocator(EventLoopComponent):
                 return
             ports = s.spec.endpoint.ports
             if not ports:
-                # spec dropped all ports: free whatever was held
+                # spec dropped all ports: free whatever was held and clear
+                # the endpoint so a later re-add re-claims from scratch
                 freed = self.ports.release_except(service_id, set())
+                if s.endpoint is not None and s.endpoint.get("ports_allocated"):
+                    s = s.copy()
+                    s.endpoint = None
+                    tx.update(s)
                 return
             if s.endpoint is not None and s.endpoint.get("ports_allocated"):
                 # re-allocate only when the spec's port set changed

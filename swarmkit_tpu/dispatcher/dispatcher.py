@@ -265,7 +265,8 @@ class Dispatcher:
     def _referenced_deps(self, tx, tasks) -> tuple[dict, dict]:
         secrets, configs = {}, {}
         for t in tasks:
-            if t.desired_state > TaskState.RUNNING:
+            # desired COMPLETE is a live job task and still needs its deps
+            if t.desired_state > TaskState.COMPLETE:
                 continue
             runtime = t.spec.runtime
             if runtime is None:

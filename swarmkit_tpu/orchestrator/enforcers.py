@@ -1,0 +1,111 @@
+"""Constraint and volume enforcers.
+
+Behavioral re-derivation of
+manager/orchestrator/constraintenforcer/constraint_enforcer.go and
+manager/orchestrator/volumeenforcer/volume_enforcer.go: when a node stops
+satisfying a task's placement constraints (label change, role change) or no
+longer has the resources, running tasks are evicted by raising their
+observed state to REJECTED — the restart machinery then reschedules them
+elsewhere. The volume enforcer does the same for tasks using a volume whose
+availability drops to "drain".
+"""
+from __future__ import annotations
+
+from ..api.objects import EventCreate, EventUpdate, Node, Task, Volume
+from ..api.types import NodeAvailability, TaskState
+from ..scheduler import constraint as constraint_mod
+from ..store import by
+from .base import EventLoopComponent
+
+REJECT_MESSAGE = "assigned node no longer meets constraints"
+VOLUME_REJECT_MESSAGE = "volume is being drained"
+
+
+class ConstraintEnforcer(EventLoopComponent):
+    """reference: constraint_enforcer.go:65-233 rejectNoncompliantTasks."""
+
+    name = "constraint-enforcer"
+
+    def setup(self, tx):
+        return None
+
+    def handle(self, event):
+        obj = getattr(event, "obj", None)
+        if isinstance(obj, Node) and isinstance(event, (EventCreate, EventUpdate)):
+            self.reject_noncompliant_tasks(obj.id)
+
+    def reject_noncompliant_tasks(self, node_id: str):
+        def cb(tx):
+            node = tx.get_node(node_id)
+            if node is None:
+                return
+            tasks = tx.find_tasks(by.ByNodeID(node_id))
+            # resource re-check needs running totals over surviving tasks
+            available_cpu = available_mem = None
+            if node.description is not None:
+                available_cpu = node.description.resources.nano_cpus
+                available_mem = node.description.resources.memory_bytes
+            live = [t for t in tasks
+                    if TaskState.ASSIGNED <= t.status.state <= TaskState.RUNNING
+                    and t.desired_state <= TaskState.RUNNING]
+            for t in live:
+                if available_cpu is not None:
+                    available_cpu -= t.spec.resources.reservations.nano_cpus
+                    available_mem -= t.spec.resources.reservations.memory_bytes
+
+            for t in live:
+                violated = False
+                exprs = t.spec.placement.constraints
+                if exprs:
+                    try:
+                        constraints = constraint_mod.parse(exprs)
+                        if not constraint_mod.node_matches(constraints, node):
+                            violated = True
+                    except constraint_mod.InvalidConstraint:
+                        pass
+                # resource overcommit after a shrink (reference :150-199)
+                if not violated and available_cpu is not None and (
+                        available_cpu < 0 or available_mem < 0):
+                    violated = True
+                    # evicting this task frees its reservation
+                    available_cpu += t.spec.resources.reservations.nano_cpus
+                    available_mem += t.spec.resources.reservations.memory_bytes
+                if violated:
+                    cur = tx.get_task(t.id)
+                    if cur is None:
+                        continue
+                    cur = cur.copy()
+                    cur.status.state = TaskState.REJECTED
+                    cur.status.message = REJECT_MESSAGE
+                    tx.update(cur)
+
+        self.store.update(cb)
+
+
+class VolumeEnforcer(EventLoopComponent):
+    """reference: volume_enforcer.go rejectNoncompliantTasks."""
+
+    name = "volume-enforcer"
+
+    def setup(self, tx):
+        return None
+
+    def handle(self, event):
+        obj = getattr(event, "obj", None)
+        if isinstance(obj, Volume) and isinstance(event, EventUpdate):
+            if obj.spec.availability == "drain":
+                self.reject_tasks_using(obj.id)
+
+    def reject_tasks_using(self, volume_id: str):
+        def cb(tx):
+            for t in tx.find_tasks():
+                if volume_id not in t.volumes:
+                    continue
+                if t.status.state > TaskState.RUNNING:
+                    continue
+                cur = tx.get_task(t.id).copy()
+                cur.status.state = TaskState.REJECTED
+                cur.status.message = VOLUME_REJECT_MESSAGE
+                tx.update(cur)
+
+        self.store.update(cb)

@@ -1,0 +1,186 @@
+"""Jobs orchestrator: replicated-job and global-job services.
+
+Behavioral re-derivation of manager/orchestrator/jobs/{orchestrator.go,
+replicated/reconciler.go, global/reconciler.go}: one-shot task execution
+tracked per JobIteration. Job tasks are created with
+desired_state=COMPLETE and are never restarted after reaching COMPLETE
+(failure restarts still flow through the restart supervisor per policy).
+
+Replicated jobs run `total_completions` tasks overall with at most
+`max_concurrent` in flight; global jobs run one task per eligible node
+per iteration.
+"""
+from __future__ import annotations
+
+from ..api.objects import (
+    EventCreate,
+    EventDelete,
+    EventUpdate,
+    Node,
+    Service,
+    Task,
+)
+from ..api.types import ServiceMode, TaskState
+from ..store import by
+from .base import EventLoopComponent
+from .global_ import _node_eligible
+from .restart import RestartSupervisor
+from .task import is_job, new_task
+
+
+def job_iteration(service: Service) -> int:
+    """Current iteration from the service's JobStatus (0 before first run)."""
+    if isinstance(service.job_status, dict):
+        return int(service.job_status.get("iteration", 0))
+    return 0
+
+
+def _task_in_iteration(task: Task, iteration: int) -> bool:
+    it = task.job_iteration.index if task.job_iteration is not None else 0
+    return it == iteration
+
+
+class JobsOrchestrator(EventLoopComponent):
+    """reference: manager/orchestrator/jobs/orchestrator.go."""
+
+    name = "jobs-orchestrator"
+
+    def __init__(self, store):
+        super().__init__(store)
+        self.restart = RestartSupervisor(store)
+
+    def stop(self):
+        self.restart.stop()
+        super().stop()
+
+    def setup(self, tx):
+        return [s for s in tx.find_services() if is_job(s)]
+
+    def on_start(self, services):
+        for s in services:
+            self.reconcile_service(s.id)
+
+    def handle(self, event):
+        obj = getattr(event, "obj", None)
+        if isinstance(obj, Service):
+            if isinstance(event, EventDelete):
+                self._delete_service_tasks(obj)
+            elif is_job(obj):
+                self.reconcile_service(obj.id)
+        elif isinstance(obj, Node) and not isinstance(event, EventDelete):
+            self._reconcile_node(obj.id)
+        elif isinstance(obj, Task) and isinstance(event, EventUpdate):
+            self._handle_task_change(obj)
+
+    # ------------------------------------------------------------- reconcile
+    def reconcile_service(self, service_id: str):
+        def cb(tx):
+            service = tx.get_service(service_id)
+            if service is None or not is_job(service):
+                return
+            if service.spec.mode == ServiceMode.REPLICATED_JOB:
+                self._reconcile_replicated_job(tx, service)
+            else:
+                self._reconcile_global_job(tx, service)
+
+        self.store.update(cb)
+
+    def _reconcile_replicated_job(self, tx, service: Service):
+        """reference: jobs/replicated/reconciler.go ReconcileService."""
+        iteration = job_iteration(service)
+        total = max(1, service.spec.job.total_completions)
+        max_concurrent = service.spec.job.max_concurrent or total
+
+        tasks = [t for t in tx.find_tasks(by.ByServiceID(service.id))
+                 if _task_in_iteration(t, iteration)]
+        completed = sum(1 for t in tasks
+                        if t.status.state == TaskState.COMPLETE)
+        # in-flight: desired COMPLETE, not yet terminally observed, not
+        # shut down by an update
+        # in flight includes restart replacements held at desired READY
+        active_slots: set[int] = set()
+        for t in tasks:
+            if (t.desired_state <= TaskState.COMPLETE
+                    and t.status.state < TaskState.COMPLETE):
+                active_slots.add(t.slot)
+        active = len(active_slots)
+
+        to_create = min(max_concurrent - active, total - completed - active)
+        if to_create <= 0:
+            return
+        used = {t.slot for t in tasks
+                if t.status.state == TaskState.COMPLETE} | active_slots
+        slot_num = 1
+        created = 0
+        while created < to_create:
+            if slot_num not in used:
+                t = new_task(None, service, slot_num)
+                tx.create(t)
+                used.add(slot_num)
+                created += 1
+            slot_num += 1
+
+    def _reconcile_global_job(self, tx, service: Service):
+        """reference: jobs/global/reconciler.go ReconcileService."""
+        iteration = job_iteration(service)
+        tasks = [t for t in tx.find_tasks(by.ByServiceID(service.id))
+                 if _task_in_iteration(t, iteration)]
+        by_node: dict[str, list[Task]] = {}
+        for t in tasks:
+            by_node.setdefault(t.node_id, []).append(t)
+        for node in tx.find_nodes():
+            if not _node_eligible(node, service):
+                continue
+            existing = by_node.get(node.id, [])
+            # a node is satisfied if any task for this iteration completed
+            # or is still in flight
+            if any(t.status.state == TaskState.COMPLETE
+                   or (t.desired_state <= TaskState.COMPLETE
+                       and t.status.state < TaskState.COMPLETE)
+                   for t in existing):
+                continue
+            t = new_task(None, service, 0, node_id=node.id)
+            tx.create(t)
+
+    def _reconcile_node(self, node_id: str):
+        """A node appearing/recovering may need global-job tasks."""
+        def cb(tx):
+            node = tx.get_node(node_id)
+            if node is None:
+                return
+            for service in tx.find_services():
+                if service.spec.mode == ServiceMode.GLOBAL_JOB:
+                    self._reconcile_global_job(tx, service)
+
+        self.store.update(cb)
+
+    # ----------------------------------------------------------- task events
+    def _handle_task_change(self, task: Task):
+        """Failed job task → restart per policy; completed tasks may
+        unblock the next wave of a replicated job."""
+        if task.status.state == TaskState.COMPLETE:
+            self.reconcile_service(task.service_id)
+            return
+        if task.status.state <= TaskState.RUNNING:
+            return
+        if task.desired_state > TaskState.COMPLETE:
+            return  # shutdown/remove requested
+
+        def cb(tx):
+            service = tx.get_service(task.service_id)
+            if service is None or not is_job(service):
+                return
+            self.restart.restart(tx, None, service, task)
+
+        self.store.update(cb)
+
+    def _delete_service_tasks(self, service: Service):
+        def cb(batch):
+            tasks = self.store.view().find_tasks(by.ByServiceID(service.id))
+            for t in tasks:
+                def delete_one(tx, t=t):
+                    if tx.get_task(t.id) is not None:
+                        tx.delete(Task, t.id)
+                batch.update(delete_one)
+
+        self.store.batch(cb)

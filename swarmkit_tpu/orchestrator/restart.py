@@ -67,7 +67,9 @@ class RestartSupervisor:
 
         self._record(task, service)
         delay = service.spec.task.restart.delay
-        self._delay_start(replacement.id, delay)
+        # job tasks run to completion; service tasks run indefinitely
+        target = TaskState.COMPLETE if is_job(service) else TaskState.RUNNING
+        self._delay_start(replacement.id, delay, target)
 
     def should_restart(self, task: Task, service: Service) -> bool:
         """reference restart.go:215+ shouldRestart."""
@@ -109,8 +111,9 @@ class RestartSupervisor:
         if service.spec.task.restart.window > 0:
             info.restarted_instances.append(RestartedInstance(time.time()))
 
-    def _delay_start(self, task_id: str, delay: float) -> None:
-        """Promote READY→RUNNING after the restart delay."""
+    def _delay_start(self, task_id: str, delay: float,
+                     target: TaskState = TaskState.RUNNING) -> None:
+        """Promote READY→target after the restart delay."""
 
         def promote():
             with self._lock:
@@ -123,7 +126,7 @@ class RestartSupervisor:
                 if cur is None or cur.desired_state != TaskState.READY:
                     return
                 cur = cur.copy()
-                cur.desired_state = TaskState.RUNNING
+                cur.desired_state = target
                 tx.update(cur)
 
             try:

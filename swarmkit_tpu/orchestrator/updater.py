@@ -91,7 +91,9 @@ class Updater(threading.Thread):
                     monitored[nid] = time.monotonic() + cfg.monitor
                 updated += 1
             poll_failures()
-            if over_threshold():
+            # CONTINUE keeps rolling despite failures; PAUSE/ROLLBACK stop
+            if over_threshold() and \
+                    cfg.failure_action != UpdateFailureAction.CONTINUE:
                 break
             if cfg.delay > 0 and self._cancel.wait(cfg.delay):
                 return

@@ -229,8 +229,11 @@ class RaftNode:
 
     # -------------------------------------------------------------- election
     def _campaign(self):
-        if self.id not in self.members and self.members:
-            return  # removed member must not start elections
+        if self.id not in self.members:
+            # removed members must not start elections, and a freshly joined
+            # node that has not yet learned the membership (empty config)
+            # must not self-elect as a quorum-of-one
+            return
         self.role = CANDIDATE
         self.term += 1
         self.voted_for = self.id
@@ -515,6 +518,10 @@ class RaftNode:
             idx = self.last_applied - self.first_index
             if idx < 0:
                 continue  # covered by snapshot
+            if idx >= len(self.log):
+                # commit raced ahead of a truncated log; stop rather than crash
+                self.last_applied -= 1
+                break
             e = self.log[idx]
             if e.kind == ENTRY_CONF_CHANGE:
                 self._apply_conf_change(e)
@@ -588,7 +595,11 @@ class RaftNode:
         self.first_index = state.snapshot_index + 1
         self.log = list(state.entries)
         self.members = dict(state.members)
-        self.commit_index = max(state.commit_index, state.snapshot_index)
+        # a torn WAL tail (or undecryptable entries) can leave the persisted
+        # commit ahead of the recovered log; cap it so replay can't index
+        # past the entries we actually have
+        self.commit_index = min(max(state.commit_index, state.snapshot_index),
+                                self._last_index())
         self.last_applied = self.snapshot_index
         if state.snapshot_data is not None:
             self.restore_state(state.snapshot_data)

@@ -75,9 +75,18 @@ class RaftProposer:
         return Version(self.node.commit_index)
 
     def changes_between(self, from_v: Version, to_v: Version) -> list:
-        out = []
         node = self.node
-        for e in node.log:
+        # snapshot the log list: the raft worker thread may truncate or
+        # compact it concurrently
+        entries = list(node.log)
+        first = entries[0].index if entries else node.first_index
+        if from_v.index + 1 < first:
+            # entries below `first` were compacted into a snapshot; a partial
+            # answer would silently diverge the replaying watcher
+            raise ProposeError(
+                f"changes from {from_v.index} compacted (log starts at {first})")
+        out = []
+        for e in entries:
             if from_v.index < e.index <= to_v.index and e.data is not None \
                     and e.kind == 0:
                 out.append(e.data)

@@ -18,7 +18,12 @@ from swarmkit_tpu.api.types import (
     TaskState,
 )
 from swarmkit_tpu.dispatcher.dispatcher import Dispatcher
+from swarmkit_tpu.orchestrator.enforcers import (
+    ConstraintEnforcer,
+    VolumeEnforcer,
+)
 from swarmkit_tpu.orchestrator.global_ import GlobalOrchestrator
+from swarmkit_tpu.orchestrator.jobs import JobsOrchestrator
 from swarmkit_tpu.orchestrator.replicated import ReplicatedOrchestrator
 from swarmkit_tpu.orchestrator.taskreaper import TaskReaper
 from swarmkit_tpu.scheduler.scheduler import Scheduler
@@ -37,6 +42,9 @@ class MiniCluster:
         self.scheduler = Scheduler(self.store)
         self.replicated = ReplicatedOrchestrator(self.store)
         self.global_ = GlobalOrchestrator(self.store)
+        self.jobs = JobsOrchestrator(self.store)
+        self.constraint_enforcer = ConstraintEnforcer(self.store)
+        self.volume_enforcer = VolumeEnforcer(self.store)
         self.reaper = TaskReaper(self.store)
         self.dispatcher = Dispatcher(self.store, heartbeat_period=heartbeat)
         self.agents: dict[str, Agent] = {}
@@ -54,6 +62,9 @@ class MiniCluster:
         self.scheduler.start()
         self.replicated.start()
         self.global_.start()
+        self.jobs.start()
+        self.constraint_enforcer.start()
+        self.volume_enforcer.start()
         self.reaper.start()
         for a in self.agents.values():
             a.start()
@@ -62,6 +73,9 @@ class MiniCluster:
         for a in self.agents.values():
             a.stop()
         self.reaper.stop()
+        self.volume_enforcer.stop()
+        self.constraint_enforcer.stop()
+        self.jobs.stop()
         self.global_.stop()
         self.replicated.stop()
         self.scheduler.stop()

@@ -1,0 +1,192 @@
+"""Jobs orchestrator, enforcers, and taskinit tests
+(reference behaviors: manager/orchestrator/jobs/**,
+constraintenforcer/constraint_enforcer_test.go, taskinit/init.go)."""
+import time
+
+import pytest
+
+from swarmkit_tpu.api.objects import Node, NodeStatus, Service, Task
+from swarmkit_tpu.api.specs import Annotations, JobSpec, ServiceSpec
+from swarmkit_tpu.api.types import (
+    NodeStatusState,
+    RestartCondition,
+    ServiceMode,
+    TaskState,
+)
+from swarmkit_tpu.orchestrator import taskinit
+from swarmkit_tpu.orchestrator.restart import RestartSupervisor
+from swarmkit_tpu.orchestrator.task import is_job, new_task
+from swarmkit_tpu.store import by
+from swarmkit_tpu.store.memory import MemoryStore
+
+from test_e2e_slice import MiniCluster
+from test_scheduler import wait_for
+
+
+def make_job_service(name, mode=ServiceMode.REPLICATED_JOB,
+                     total=4, max_concurrent=0):
+    svc = Service(id=f"svc-{name}")
+    svc.spec = ServiceSpec(annotations=Annotations(name=name), mode=mode,
+                           job=JobSpec(max_concurrent=max_concurrent,
+                                       total_completions=total))
+    svc.spec.task.restart.condition = RestartCondition.ON_FAILURE
+    svc.spec_version.index = 1
+    svc.job_status = {"iteration": 0}
+    return svc
+
+
+def completed_tasks(store, service_id):
+    return [t for t in store.view().find_tasks(by.ByServiceID(service_id))
+            if t.status.state == TaskState.COMPLETE]
+
+
+def test_replicated_job_runs_to_total_completions():
+    c = MiniCluster(n_agents=2)
+    c.start()
+    try:
+        svc = make_job_service("batch", total=6, max_concurrent=2)
+        c.store.update(lambda tx: tx.create(svc))
+        assert wait_for(
+            lambda: len(completed_tasks(c.store, "svc-batch")) == 6,
+            timeout=20)
+        # no extra tasks beyond the 6 completions
+        time.sleep(0.5)
+        tasks = c.store.view().find_tasks(by.ByServiceID("svc-batch"))
+        assert len([t for t in tasks
+                    if t.status.state == TaskState.COMPLETE]) == 6
+        for t in tasks:
+            assert t.desired_state <= TaskState.COMPLETE
+    finally:
+        c.stop()
+
+
+def test_replicated_job_respects_max_concurrent():
+    c = MiniCluster(n_agents=2,
+                    behaviors={"svc-slow": {"run_time": 0.3}})
+    c.start()
+    try:
+        svc = make_job_service("slow", total=4, max_concurrent=1)
+        c.store.update(lambda tx: tx.create(svc))
+        peak = 0
+        deadline = time.time() + 25
+        while time.time() < deadline:
+            tasks = c.store.view().find_tasks(by.ByServiceID("svc-slow"))
+            live = [t for t in tasks
+                    if t.status.state < TaskState.COMPLETE
+                    and t.desired_state <= TaskState.COMPLETE]
+            peak = max(peak, len(live))
+            if len([t for t in tasks
+                    if t.status.state == TaskState.COMPLETE]) == 4:
+                break
+            time.sleep(0.05)
+        assert len(completed_tasks(c.store, "svc-slow")) == 4
+        assert peak <= 1, f"max_concurrent violated: {peak} in flight"
+    finally:
+        c.stop()
+
+
+def test_global_job_runs_once_per_node():
+    c = MiniCluster(n_agents=3)
+    c.start()
+    try:
+        svc = make_job_service("gjob", mode=ServiceMode.GLOBAL_JOB)
+        c.store.update(lambda tx: tx.create(svc))
+        assert wait_for(
+            lambda: len(completed_tasks(c.store, "svc-gjob")) == 3,
+            timeout=20)
+        nodes = {t.node_id for t in completed_tasks(c.store, "svc-gjob")}
+        assert len(nodes) == 3
+        # completed tasks stay completed; no respawn
+        time.sleep(0.5)
+        assert len(completed_tasks(c.store, "svc-gjob")) == 3
+    finally:
+        c.stop()
+
+
+def test_failed_job_task_restarts_on_failure():
+    c = MiniCluster(n_agents=1,
+                    behaviors={"svc-flaky": {"exit_code": 1}})
+    c.start()
+    try:
+        svc = make_job_service("flaky", total=1)
+        svc.spec.task.restart.max_attempts = 2
+        c.store.update(lambda tx: tx.create(svc))
+        # task fails, gets restarted up to max_attempts, never completes
+        assert wait_for(
+            lambda: len([
+                t for t in c.store.view().find_tasks(
+                    by.ByServiceID("svc-flaky"))
+                if t.status.state == TaskState.FAILED]) >= 2,
+            timeout=20)
+        assert not completed_tasks(c.store, "svc-flaky")
+    finally:
+        c.stop()
+
+
+def test_constraint_enforcer_evicts_on_label_change():
+    c = MiniCluster(n_agents=2, behaviors={"svc-pin": {"run_forever": True}})
+    c.start()
+    try:
+        # wait for nodes to register, label both
+        assert wait_for(
+            lambda: len(c.store.view().find_nodes()) == 2, timeout=10)
+
+        def label_all(tx):
+            for n in tx.find_nodes():
+                n = n.copy()
+                n.spec.annotations.labels["zone"] = "a"
+                tx.update(n)
+        c.store.update(label_all)
+
+        svc = Service(id="svc-pin", spec=ServiceSpec(
+            annotations=Annotations(name="pin"), replicas=2))
+        svc.spec.task.placement.constraints = ["node.labels.zone==a"]
+        svc.spec.task.restart.condition = RestartCondition.ANY
+        svc.spec_version.index = 1
+        c.store.update(lambda tx: tx.create(svc))
+        assert wait_for(lambda: len(c.running_tasks("svc-pin")) == 2,
+                        timeout=15)
+
+        # flip one node's label: its task must be REJECTED and move
+        victim = c.running_tasks("svc-pin")[0].node_id
+
+        def relabel(tx):
+            n = tx.get_node(victim).copy()
+            n.spec.annotations.labels["zone"] = "b"
+            tx.update(n)
+        c.store.update(relabel)
+
+        def settled():
+            running = c.running_tasks("svc-pin")
+            return (len(running) == 2
+                    and all(t.node_id != victim for t in running))
+        assert wait_for(settled, timeout=15)
+    finally:
+        c.stop()
+
+
+def test_taskinit_restarts_stranded_tasks():
+    store = MemoryStore()
+    svc = Service(id="svc-x", spec=ServiceSpec(
+        annotations=Annotations(name="x"), replicas=1))
+    svc.spec.task.restart.condition = RestartCondition.ANY
+    node = Node(id="n1", status=NodeStatus(state=NodeStatusState.DOWN))
+
+    def seed(tx):
+        tx.create(svc)
+        tx.create(node)
+        t = new_task(None, svc, 1)
+        t.node_id = "n1"
+        t.status.state = TaskState.STARTING  # stranded mid-lifecycle
+        tx.create(t)
+    store.update(seed)
+
+    restart = RestartSupervisor(store)
+    fixed = taskinit.check_tasks(store, restart, lambda s: True)
+    assert fixed == 1
+    tasks = store.view().find_tasks(by.ByServiceID("svc-x"))
+    # old task marked for shutdown, replacement created
+    assert any(t.desired_state >= TaskState.SHUTDOWN for t in tasks)
+    assert any(t.desired_state < TaskState.SHUTDOWN
+               and t.status.state == TaskState.NEW for t in tasks)
+    restart.stop()
