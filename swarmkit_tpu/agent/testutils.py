@@ -64,7 +64,9 @@ class FakeExecutor:
     """Configurable fake. `behavior_for` maps service_id -> behavior dict."""
 
     def __init__(self, behavior_for: dict | None = None, hostname="fake-host"):
-        self.behavior_for = behavior_for or {}
+        # keep the caller's dict identity: tests mutate a shared (possibly
+        # still empty) behaviors dict after construction
+        self.behavior_for = behavior_for if behavior_for is not None else {}
         self.hostname = hostname
         self.controllers: list[FakeController] = []
         self._lock = threading.Lock()

@@ -1,0 +1,9 @@
+from .control import ControlAPI, ListFilters  # noqa: F401
+from .errors import (  # noqa: F401
+    AlreadyExists,
+    ControlError,
+    FailedPrecondition,
+    InvalidArgument,
+    NotFound,
+    PermissionDenied,
+)

@@ -1,0 +1,752 @@
+"""Control API: the user-facing CRUD + validation surface.
+
+Behavioral re-derivation of manager/controlapi/ (service.go, node.go,
+cluster.go, secret.go, config.go, network.go, volume.go, extension.go,
+resource.go, task.go): every mutation is validated, version-checked
+(ErrSequenceConflict → FailedPrecondition), and written through the store so
+it replicates via raft. List calls support the reference's filter set
+(names, id prefixes, labels, plus per-type filters).
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+from ..api.objects import (
+    Cluster,
+    Config,
+    Extension,
+    Network,
+    Node,
+    Resource,
+    Secret,
+    Service,
+    Task,
+    Version,
+    Volume,
+)
+from ..api.specs import ClusterSpec, ConfigSpec, NetworkSpec, SecretSpec, \
+    ServiceSpec, VolumeSpec
+from ..api.types import NodeRole, ServiceMode, TaskState
+from ..scheduler import constraint as constraint_mod
+from ..store import by
+from ..store.memory import MemoryStore, SequenceConflict
+from ..utils.identity import new_id, new_secret_token
+from .errors import (
+    AlreadyExists,
+    FailedPrecondition,
+    InvalidArgument,
+    NotFound,
+)
+
+# Docker object-name grammar (reference: controlapi/service.go validateAnnotations
+# via docker/docker restricted name rules).
+_NAME_RE = re.compile(r"^[a-zA-Z0-9]+(?:[a-zA-Z0-9-_.]*[a-zA-Z0-9])?$")
+
+# reference: controlapi/secret.go MaxSecretSize = 500KiB;
+# config.go caps config data at 1000KiB (MaxConfigSize).
+MAX_SECRET_SIZE = 500 * 1024
+MAX_CONFIG_SIZE = 1000 * 1024
+
+VALID_PORT_PROTOCOLS = {"tcp", "udp", "sctp"}
+
+
+@dataclass
+class ListFilters:
+    """reference: api/control.proto List*Request.Filters."""
+
+    names: list[str] = field(default_factory=list)
+    id_prefixes: list[str] = field(default_factory=list)
+    name_prefixes: list[str] = field(default_factory=list)
+    labels: dict[str, str] = field(default_factory=dict)
+    # per-type extras
+    service_ids: list[str] = field(default_factory=list)
+    node_ids: list[str] = field(default_factory=list)
+    desired_states: list[TaskState] = field(default_factory=list)
+    roles: list[NodeRole] = field(default_factory=list)
+    memberships: list[int] = field(default_factory=list)
+    modes: list[ServiceMode] = field(default_factory=list)
+    up_to_date: bool = False
+
+
+def _match_filters(obj, f: ListFilters | None,
+                   annotations=None) -> bool:
+    """Name/prefix matching delegates to the by.py selectors so the
+    case-folding rules stay single-sourced with the store indexes."""
+    if f is None:
+        return True
+    if f.names and not any(by.ByName(n).match(obj) for n in f.names):
+        return False
+    if f.name_prefixes and not any(
+            by.ByNamePrefix(p).match(obj) for p in f.name_prefixes):
+        return False
+    if f.id_prefixes and not any(obj.id.startswith(p)
+                                 for p in f.id_prefixes):
+        return False
+    if f.labels:
+        ann = annotations if annotations is not None else getattr(
+            obj, "spec", obj).annotations
+        for k, v in f.labels.items():
+            if k not in ann.labels:
+                return False
+            if v and ann.labels[k] != v:
+                return False
+    return True
+
+
+class ControlAPI:
+    """The Control service (reference: api/control.proto, ~40 RPCs)."""
+
+    def __init__(self, store: MemoryStore):
+        self.store = store
+
+
+    def _committed(self, obj):
+        """Re-read an object after commit: WriteTx buffers copies, so the
+        reference we appended pre-commit carries a stale meta.version."""
+        return self.store.view().get(type(obj), obj.id)
+
+    # ------------------------------------------------------------ validation
+    @staticmethod
+    def _validate_annotations(annotations) -> None:
+        if not annotations.name:
+            raise InvalidArgument("meta: name must be provided")
+        if not _NAME_RE.match(annotations.name):
+            raise InvalidArgument(
+                f"invalid name {annotations.name!r}: must match "
+                f"{_NAME_RE.pattern}")
+
+    def _validate_service_spec(self, tx, spec: ServiceSpec) -> None:
+        """reference: controlapi/service.go validateServiceSpec."""
+        if spec is None:
+            raise InvalidArgument("spec must be provided")
+        self._validate_annotations(spec.annotations)
+        # placement constraints must parse (service.go validateTaskSpec)
+        exprs = spec.task.placement.constraints
+        if exprs:
+            try:
+                constraint_mod.parse(exprs)
+            except constraint_mod.InvalidConstraint as e:
+                raise InvalidArgument(f"invalid placement constraint: {e}")
+        if spec.mode == ServiceMode.REPLICATED and spec.replicas < 0:
+            raise InvalidArgument("replicas must be non-negative")
+        if spec.mode in (ServiceMode.REPLICATED_JOB, ServiceMode.GLOBAL_JOB):
+            # reference: service.go validateJob — a job task must stay
+            # finished, so restart-on-success is invalid regardless of any
+            # update config
+            if spec.task.restart.condition.value == "any":
+                raise InvalidArgument(
+                    "jobs may not restart on success; use restart-condition "
+                    "none or on-failure")
+        for p in spec.endpoint.ports:
+            if p.protocol and p.protocol not in VALID_PORT_PROTOCOLS:
+                raise InvalidArgument(f"invalid protocol {p.protocol!r}")
+            if not p.target_port:
+                raise InvalidArgument("port config must include target_port")
+        update_cfgs = [spec.update]
+        if spec.rollback is not None:
+            update_cfgs.append(spec.rollback)
+        for cfg in update_cfgs:
+            if cfg is not None and cfg.max_failure_ratio > 1:
+                raise InvalidArgument("max_failure_ratio must be <= 1")
+        # referenced secrets/configs/networks must exist
+        runtime = spec.task.runtime
+        if runtime is not None:
+            for ref in runtime.secrets:
+                if tx.get_secret(ref.secret_id) is None:
+                    raise InvalidArgument(
+                        f"secret {ref.secret_id} not found")
+            for ref in runtime.configs:
+                if tx.get_config(ref.config_id) is None:
+                    raise InvalidArgument(
+                        f"config {ref.config_id} not found")
+        for na in spec.task.networks + spec.networks:
+            if na.target and tx.get_network(na.target) is None:
+                raise InvalidArgument(f"network {na.target} not found")
+
+    # -------------------------------------------------------------- services
+    def create_service(self, spec: ServiceSpec) -> Service:
+        svc = Service(id=new_id(), spec=spec)
+        svc.spec_version = Version(1)
+
+        def cb(tx):
+            self._validate_service_spec(tx, spec)
+            if tx.find_services(by.ByName(spec.annotations.name)):
+                raise AlreadyExists(
+                    f"service {spec.annotations.name} already exists")
+            tx.create(svc)
+
+        self.store.update(cb)
+        return self.store.view().get_service(svc.id)
+
+    def get_service(self, service_id: str) -> Service:
+        s = self.store.view().get_service(service_id)
+        if s is None:
+            raise NotFound(f"service {service_id} not found")
+        return s
+
+    def update_service(self, service_id: str, version: Version,
+                       spec: ServiceSpec, rollback: bool = False) -> Service:
+        """reference: service.go UpdateService — version-gated, saves
+        previous_spec for rollback, forbids renames and mode changes."""
+        out: list[Service] = []
+
+        def cb(tx):
+            cur = tx.get_service(service_id)
+            if cur is None:
+                raise NotFound(f"service {service_id} not found")
+            self._validate_service_spec(tx, spec)
+            if cur.meta.version.index != version.index:
+                raise FailedPrecondition("update out of sequence")
+            if spec.annotations.name != cur.spec.annotations.name:
+                raise InvalidArgument("renaming services is not supported")
+            if spec.mode != cur.spec.mode:
+                raise InvalidArgument("service mode change is not supported")
+            nxt = cur.copy()
+            if rollback:
+                if cur.previous_spec is None:
+                    raise FailedPrecondition("service has no previous spec")
+                nxt.spec = cur.previous_spec
+                nxt.previous_spec = None
+            else:
+                nxt.previous_spec = cur.spec
+                nxt.previous_spec_version = Version(cur.spec_version.index)
+                nxt.spec = spec
+            nxt.spec_version = Version(cur.spec_version.index + 1)
+            tx.update(nxt)
+            out.append(nxt)
+
+        try:
+            self.store.update(cb)
+        except SequenceConflict:
+            raise FailedPrecondition("update out of sequence")
+        return self._committed(out[0])
+
+    def remove_service(self, service_id: str) -> None:
+        def cb(tx):
+            if tx.get_service(service_id) is None:
+                raise NotFound(f"service {service_id} not found")
+            tx.delete(Service, service_id)
+
+        self.store.update(cb)
+
+    def list_services(self, filters: ListFilters | None = None) -> list[Service]:
+        out = []
+        for s in self.store.view().find_services():
+            if not _match_filters(s, filters):
+                continue
+            if filters and filters.modes and s.spec.mode not in filters.modes:
+                continue
+            out.append(s)
+        return out
+
+    # ----------------------------------------------------------------- tasks
+    def get_task(self, task_id: str) -> Task:
+        t = self.store.view().get_task(task_id)
+        if t is None:
+            raise NotFound(f"task {task_id} not found")
+        return t
+
+    def remove_task(self, task_id: str) -> None:
+        def cb(tx):
+            if tx.get_task(task_id) is None:
+                raise NotFound(f"task {task_id} not found")
+            tx.delete(Task, task_id)
+
+        self.store.update(cb)
+
+    def list_tasks(self, filters: ListFilters | None = None) -> list[Task]:
+        out = []
+        for t in self.store.view().find_tasks():
+            if not _match_filters(t, filters, annotations=t.annotations):
+                continue
+            if filters:
+                if filters.service_ids and t.service_id not in filters.service_ids:
+                    continue
+                if filters.node_ids and t.node_id not in filters.node_ids:
+                    continue
+                if filters.desired_states and \
+                        t.desired_state not in filters.desired_states:
+                    continue
+                if filters.up_to_date:
+                    svc = self.store.view().get_service(t.service_id)
+                    if svc is not None and t.spec_version is not None and \
+                            t.spec_version.index != svc.spec_version.index:
+                        continue
+            out.append(t)
+        return out
+
+    # ----------------------------------------------------------------- nodes
+    def get_node(self, node_id: str) -> Node:
+        n = self.store.view().get_node(node_id)
+        if n is None:
+            raise NotFound(f"node {node_id} not found")
+        return n
+
+    def list_nodes(self, filters: ListFilters | None = None) -> list[Node]:
+        out = []
+        for n in self.store.view().find_nodes():
+            if not _match_filters(n, filters):
+                continue
+            if filters:
+                if filters.roles and n.spec.desired_role not in filters.roles:
+                    continue
+                if filters.memberships and \
+                        n.spec.membership not in filters.memberships:
+                    continue
+            out.append(n)
+        return out
+
+    def update_node(self, node_id: str, version: Version, spec) -> Node:
+        """Availability / label / role changes. Demotion safety mirrors
+        controlapi/node.go: the last manager cannot be demoted."""
+        out: list[Node] = []
+
+        def cb(tx):
+            cur = tx.get_node(node_id)
+            if cur is None:
+                raise NotFound(f"node {node_id} not found")
+            if cur.meta.version.index != version.index:
+                raise FailedPrecondition("update out of sequence")
+            if (cur.spec.desired_role == NodeRole.MANAGER
+                    and spec.desired_role == NodeRole.WORKER):
+                managers = [n for n in tx.find_nodes()
+                            if n.spec.desired_role == NodeRole.MANAGER]
+                if len(managers) <= 1:
+                    raise FailedPrecondition(
+                        "attempting to demote the last manager of the swarm")
+            nxt = cur.copy()
+            nxt.spec = spec
+            tx.update(nxt)
+            out.append(nxt)
+
+        try:
+            self.store.update(cb)
+        except SequenceConflict:
+            raise FailedPrecondition("update out of sequence")
+        return self._committed(out[0])
+
+    def remove_node(self, node_id: str, force: bool = False) -> None:
+        """reference: node.go RemoveNode — managers and live nodes need
+        force/demotion first."""
+        def cb(tx):
+            n = tx.get_node(node_id)
+            if n is None:
+                raise NotFound(f"node {node_id} not found")
+            if n.spec.desired_role == NodeRole.MANAGER:
+                raise FailedPrecondition(
+                    "node is a manager; demote it before removal")
+            from ..api.types import NodeStatusState
+            if not force and n.status.state == NodeStatusState.READY:
+                raise FailedPrecondition(
+                    "node is not down and can't be removed; use force")
+            tx.delete(Node, node_id)
+
+        self.store.update(cb)
+
+    # --------------------------------------------------------------- cluster
+    @staticmethod
+    def _redact_cluster(c: Cluster) -> Cluster:
+        """Strip private key material before returning a cluster (reference:
+        controlapi/cluster.go redactClusters — CA signing key and unlock
+        keys never leave the manager; join tokens are part of the API)."""
+        c = c.copy()
+        if isinstance(c.root_ca, dict):
+            c.root_ca.pop("ca_key", None)
+            c.root_ca.pop("unlock_key", None)
+        return c
+
+    def get_cluster(self, cluster_id: str) -> Cluster:
+        c = self.store.view().get_cluster(cluster_id)
+        if c is None:
+            raise NotFound(f"cluster {cluster_id} not found")
+        return self._redact_cluster(c)
+
+    def list_clusters(self, filters: ListFilters | None = None) -> list[Cluster]:
+        return [self._redact_cluster(c)
+                for c in self.store.view().find_clusters()
+                if _match_filters(c, filters)]
+
+    def get_unlock_key(self, cluster_id: str) -> str:
+        """reference: ca.proto GetUnlockKey — the one sanctioned way to read
+        the autolock key after rotation."""
+        c = self.store.view().get_cluster(cluster_id)
+        if c is None:
+            raise NotFound(f"cluster {cluster_id} not found")
+        if isinstance(c.root_ca, dict):
+            return c.root_ca.get("unlock_key", "")
+        return ""
+
+    def update_cluster(self, cluster_id: str, version: Version,
+                       spec: ClusterSpec,
+                       rotate_worker_token: bool = False,
+                       rotate_manager_token: bool = False,
+                       rotate_unlock_key: bool = False) -> Cluster:
+        """reference: cluster.go UpdateCluster — spec swap + token rotation."""
+        out: list[Cluster] = []
+
+        def cb(tx):
+            cur = tx.get_cluster(cluster_id)
+            if cur is None:
+                raise NotFound(f"cluster {cluster_id} not found")
+            if cur.meta.version.index != version.index:
+                raise FailedPrecondition("update out of sequence")
+            nxt = cur.copy()
+            nxt.spec = spec
+            if nxt.root_ca is None:
+                nxt.root_ca = {}
+            tokens = nxt.root_ca.setdefault("join_tokens", {})
+            if rotate_worker_token or "worker" not in tokens:
+                tokens["worker"] = new_secret_token("worker")
+            if rotate_manager_token or "manager" not in tokens:
+                tokens["manager"] = new_secret_token("manager")
+            if rotate_unlock_key:
+                nxt.root_ca["unlock_key"] = new_secret_token("unlock")
+            tx.update(nxt)
+            out.append(nxt)
+
+        try:
+            self.store.update(cb)
+        except SequenceConflict:
+            raise FailedPrecondition("update out of sequence")
+        return self._redact_cluster(self._committed(out[0]))
+
+    # --------------------------------------------------------------- secrets
+    def create_secret(self, spec: SecretSpec) -> Secret:
+        self._validate_annotations(spec.annotations)
+        if spec.driver is None and (
+                not spec.data or len(spec.data) > MAX_SECRET_SIZE):
+            raise InvalidArgument(
+                f"secret data must be 1 - {MAX_SECRET_SIZE} bytes")
+        sec = Secret(id=new_id(), spec=spec)
+
+        def cb(tx):
+            if tx.find_secrets(by.ByName(spec.annotations.name)):
+                raise AlreadyExists(
+                    f"secret {spec.annotations.name} already exists")
+            tx.create(sec)
+
+        self.store.update(cb)
+        return self.store.view().get_secret(sec.id)
+
+    def get_secret(self, secret_id: str, clear_data: bool = True) -> Secret:
+        s = self.store.view().get_secret(secret_id)
+        if s is None:
+            raise NotFound(f"secret {secret_id} not found")
+        if clear_data:
+            # reference: secret.go GetSecret strips data on the read path
+            s = s.copy()
+            s.spec.data = b""
+        return s
+
+    def update_secret(self, secret_id: str, version: Version,
+                      spec: SecretSpec) -> Secret:
+        """Only labels may change (reference: secret.go UpdateSecret)."""
+        out: list[Secret] = []
+
+        def cb(tx):
+            cur = tx.get_secret(secret_id)
+            if cur is None:
+                raise NotFound(f"secret {secret_id} not found")
+            if cur.meta.version.index != version.index:
+                raise FailedPrecondition("update out of sequence")
+            if spec.annotations.name != cur.spec.annotations.name or (
+                    spec.data and spec.data != cur.spec.data):
+                raise InvalidArgument(
+                    "only updates to labels are allowed")
+            nxt = cur.copy()
+            nxt.spec.annotations.labels = dict(spec.annotations.labels)
+            tx.update(nxt)
+            out.append(nxt)
+
+        self.store.update(cb)
+        return self._committed(out[0])
+
+    def remove_secret(self, secret_id: str) -> None:
+        """Fails while any service references the secret."""
+        def cb(tx):
+            s = tx.get_secret(secret_id)
+            if s is None:
+                raise NotFound(f"secret {secret_id} not found")
+            users = tx.find_services(by.ByReferencedSecretID(secret_id))
+            if users:
+                names = ", ".join(sorted(
+                    u.spec.annotations.name for u in users)[:5])
+                raise InvalidArgument(
+                    f"secret is in use by services: {names}")
+            tx.delete(Secret, secret_id)
+
+        self.store.update(cb)
+
+    def list_secrets(self, filters: ListFilters | None = None) -> list[Secret]:
+        out = []
+        for s in self.store.view().find_secrets():
+            if _match_filters(s, filters):
+                s = s.copy()
+                s.spec.data = b""
+                out.append(s)
+        return out
+
+    # --------------------------------------------------------------- configs
+    def create_config(self, spec: ConfigSpec) -> Config:
+        self._validate_annotations(spec.annotations)
+        if not spec.data or len(spec.data) > MAX_CONFIG_SIZE:
+            raise InvalidArgument(
+                f"config data must be 1 - {MAX_CONFIG_SIZE} bytes")
+        cfg = Config(id=new_id(), spec=spec)
+
+        def cb(tx):
+            if tx.find_configs(by.ByName(spec.annotations.name)):
+                raise AlreadyExists(
+                    f"config {spec.annotations.name} already exists")
+            tx.create(cfg)
+
+        self.store.update(cb)
+        return self.store.view().get_config(cfg.id)
+
+    def get_config(self, config_id: str) -> Config:
+        c = self.store.view().get_config(config_id)
+        if c is None:
+            raise NotFound(f"config {config_id} not found")
+        return c
+
+    def update_config(self, config_id: str, version: Version,
+                      spec: ConfigSpec) -> Config:
+        out: list[Config] = []
+
+        def cb(tx):
+            cur = tx.get_config(config_id)
+            if cur is None:
+                raise NotFound(f"config {config_id} not found")
+            if cur.meta.version.index != version.index:
+                raise FailedPrecondition("update out of sequence")
+            if spec.annotations.name != cur.spec.annotations.name or (
+                    spec.data and spec.data != cur.spec.data):
+                raise InvalidArgument("only updates to labels are allowed")
+            nxt = cur.copy()
+            nxt.spec.annotations.labels = dict(spec.annotations.labels)
+            tx.update(nxt)
+            out.append(nxt)
+
+        self.store.update(cb)
+        return self._committed(out[0])
+
+    def remove_config(self, config_id: str) -> None:
+        def cb(tx):
+            c = tx.get_config(config_id)
+            if c is None:
+                raise NotFound(f"config {config_id} not found")
+            users = tx.find_services(by.ByReferencedConfigID(config_id))
+            if users:
+                names = ", ".join(sorted(
+                    u.spec.annotations.name for u in users)[:5])
+                raise InvalidArgument(
+                    f"config is in use by services: {names}")
+            tx.delete(Config, config_id)
+
+        self.store.update(cb)
+
+    def list_configs(self, filters: ListFilters | None = None) -> list[Config]:
+        return [c for c in self.store.view().find_configs()
+                if _match_filters(c, filters)]
+
+    # -------------------------------------------------------------- networks
+    def create_network(self, spec: NetworkSpec) -> Network:
+        self._validate_annotations(spec.annotations)
+        net = Network(id=new_id(), spec=spec)
+
+        def cb(tx):
+            if tx.find_networks(by.ByName(spec.annotations.name)):
+                raise AlreadyExists(
+                    f"network {spec.annotations.name} already exists")
+            if spec.ingress and any(
+                    n.spec.ingress for n in tx.find_networks()):
+                raise AlreadyExists("ingress network already exists")
+            tx.create(net)
+
+        self.store.update(cb)
+        return self.store.view().get_network(net.id)
+
+    def get_network(self, network_id: str) -> Network:
+        n = self.store.view().get_network(network_id)
+        if n is None:
+            raise NotFound(f"network {network_id} not found")
+        return n
+
+    def remove_network(self, network_id: str) -> None:
+        """Fails while in use (reference: network.go RemoveNetwork)."""
+        def cb(tx):
+            n = tx.get_network(network_id)
+            if n is None:
+                raise NotFound(f"network {network_id} not found")
+            for s in tx.find_services():
+                targets = {na.target for na in s.spec.task.networks}
+                targets |= {na.target for na in s.spec.networks}
+                if network_id in targets:
+                    raise FailedPrecondition(
+                        f"network {network_id} is in use by service "
+                        f"{s.spec.annotations.name}")
+            tx.delete(Network, network_id)
+
+        self.store.update(cb)
+
+    def list_networks(self, filters: ListFilters | None = None) -> list[Network]:
+        return [n for n in self.store.view().find_networks()
+                if _match_filters(n, filters)]
+
+    # --------------------------------------------------------------- volumes
+    def create_volume(self, spec: VolumeSpec) -> Volume:
+        self._validate_annotations(spec.annotations)
+        if not spec.driver:
+            raise InvalidArgument("driver must be specified")
+        vol = Volume(id=new_id(), spec=spec)
+
+        def cb(tx):
+            if tx.find_volumes(by.ByName(spec.annotations.name)):
+                raise AlreadyExists(
+                    f"volume {spec.annotations.name} already exists")
+            tx.create(vol)
+
+        self.store.update(cb)
+        return self.store.view().get_volume(vol.id)
+
+    def get_volume(self, volume_id: str) -> Volume:
+        v = self.store.view().get_volume(volume_id)
+        if v is None:
+            raise NotFound(f"volume {volume_id} not found")
+        return v
+
+    def update_volume(self, volume_id: str, version: Version,
+                      spec: VolumeSpec) -> Volume:
+        """Only availability and labels may change
+        (reference: volume.go UpdateVolume)."""
+        out: list[Volume] = []
+
+        def cb(tx):
+            cur = tx.get_volume(volume_id)
+            if cur is None:
+                raise NotFound(f"volume {volume_id} not found")
+            if cur.meta.version.index != version.index:
+                raise FailedPrecondition("update out of sequence")
+            nxt = cur.copy()
+            nxt.spec.availability = spec.availability
+            nxt.spec.annotations.labels = dict(spec.annotations.labels)
+            tx.update(nxt)
+            out.append(nxt)
+
+        self.store.update(cb)
+        return self._committed(out[0])
+
+    def remove_volume(self, volume_id: str, force: bool = False) -> None:
+        def cb(tx):
+            v = tx.get_volume(volume_id)
+            if v is None:
+                raise NotFound(f"volume {volume_id} not found")
+            if not force:
+                for t in tx.find_tasks():
+                    if volume_id in t.volumes and \
+                            t.status.state <= TaskState.RUNNING:
+                        raise FailedPrecondition(
+                            f"volume {volume_id} is in use by task {t.id}")
+            # mark pending_delete; the CSI manager finishes removal once
+            # unpublished everywhere (reference: volume.go RemoveVolume)
+            nxt = v.copy()
+            nxt.pending_delete = True
+            tx.update(nxt)
+
+        self.store.update(cb)
+
+    def list_volumes(self, filters: ListFilters | None = None) -> list[Volume]:
+        return [v for v in self.store.view().find_volumes()
+                if _match_filters(v, filters)]
+
+    # ------------------------------------------------ extensions & resources
+    def create_extension(self, annotations, description: str = "") -> Extension:
+        self._validate_annotations(annotations)
+        ext = Extension(id=new_id(), annotations=annotations,
+                        description=description)
+
+        def cb(tx):
+            if tx.find_extensions(by.ByName(annotations.name)):
+                raise AlreadyExists(
+                    f"extension {annotations.name} already exists")
+            tx.create(ext)
+
+        self.store.update(cb)
+        return self.store.view().get_extension(ext.id)
+
+    def get_extension(self, extension_id: str) -> Extension:
+        e = self.store.view().get_extension(extension_id)
+        if e is None:
+            raise NotFound(f"extension {extension_id} not found")
+        return e
+
+    def remove_extension(self, extension_id: str) -> None:
+        def cb(tx):
+            e = tx.get_extension(extension_id)
+            if e is None:
+                raise NotFound(f"extension {extension_id} not found")
+            ext_name = e.annotations.name
+            for r in tx.find_resources(by.ByKind(ext_name)):
+                raise FailedPrecondition(
+                    f"extension {ext_name} is in use by resource {r.id}")
+            tx.delete(Extension, extension_id)
+
+        self.store.update(cb)
+
+    def create_resource(self, annotations, kind: str,
+                        payload: bytes = b"") -> Resource:
+        self._validate_annotations(annotations)
+        res = Resource(id=new_id(), annotations=annotations, kind=kind,
+                       payload=payload)
+
+        def cb(tx):
+            if not tx.find_extensions(by.ByName(kind)):
+                raise InvalidArgument(f"extension {kind} not registered")
+            for other in tx.find_resources(by.ByKind(kind)):
+                if other.annotations.name == annotations.name:
+                    raise AlreadyExists(
+                        f"resource {annotations.name} already exists")
+            tx.create(res)
+
+        self.store.update(cb)
+        return self.store.view().get_resource(res.id)
+
+    def get_resource(self, resource_id: str) -> Resource:
+        r = self.store.view().get_resource(resource_id)
+        if r is None:
+            raise NotFound(f"resource {resource_id} not found")
+        return r
+
+    def update_resource(self, resource_id: str, version: Version,
+                        annotations, payload: bytes) -> Resource:
+        out: list[Resource] = []
+
+        def cb(tx):
+            cur = tx.get_resource(resource_id)
+            if cur is None:
+                raise NotFound(f"resource {resource_id} not found")
+            if cur.meta.version.index != version.index:
+                raise FailedPrecondition("update out of sequence")
+            nxt = cur.copy()
+            nxt.annotations.labels = dict(annotations.labels)
+            nxt.payload = payload
+            tx.update(nxt)
+            out.append(nxt)
+
+        self.store.update(cb)
+        return self._committed(out[0])
+
+    def remove_resource(self, resource_id: str) -> None:
+        def cb(tx):
+            if tx.get_resource(resource_id) is None:
+                raise NotFound(f"resource {resource_id} not found")
+            tx.delete(Resource, resource_id)
+
+        self.store.update(cb)
+
+    def list_resources(self, kind: str | None = None,
+                       filters: ListFilters | None = None) -> list[Resource]:
+        sel = [by.ByKind(kind)] if kind else []
+        return [r for r in self.store.view().find_resources(*sel)
+                if _match_filters(r, filters, annotations=r.annotations)]

@@ -1,0 +1,40 @@
+"""gRPC-style status errors for the control surface.
+
+The reference returns grpc codes from every Control RPC
+(manager/controlapi/*.go, e.g. service.go's
+`status.Errorf(codes.InvalidArgument, ...)`); a transport layer maps these
+1:1 onto wire status codes.
+"""
+from __future__ import annotations
+
+
+class ControlError(Exception):
+    code = "unknown"
+
+    def __init__(self, message: str = ""):
+        super().__init__(message)
+        self.message = message
+
+
+class InvalidArgument(ControlError):
+    code = "invalid_argument"
+
+
+class NotFound(ControlError):
+    code = "not_found"
+
+
+class AlreadyExists(ControlError):
+    code = "already_exists"
+
+
+class FailedPrecondition(ControlError):
+    code = "failed_precondition"
+
+
+class PermissionDenied(ControlError):
+    code = "permission_denied"
+
+
+class Unimplemented(ControlError):
+    code = "unimplemented"

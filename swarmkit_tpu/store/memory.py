@@ -312,6 +312,43 @@ class MemoryStore:
     def watch_queue(self) -> WatchQueue:
         return self.queue
 
+    def watch_from(self, version_index: int, matcher=None,
+                   limit: int | None = -1) -> Channel:
+        """Subscribe with version replay (memory.go:923-994 WatchFrom):
+        committed changes after `version_index` are re-delivered as events
+        ahead of the live stream. Requires a proposer that retains history
+        (raft log); delivery is at-least-once across the replay/live seam.
+        """
+        with self._lock:
+            cur = self._version.index
+            replay: list[Any] = []
+            if version_index < cur:
+                if self.proposer is None or \
+                        not hasattr(self.proposer, "changes_between"):
+                    raise ValueError(
+                        "watch_from needs a history-retaining proposer")
+                try:
+                    entry_changes = self.proposer.changes_between(
+                        Version(version_index), Version(cur))
+                except Exception as e:
+                    # e.g. the range was compacted into a snapshot — signal
+                    # "full resync required" uniformly, not a raft-internal
+                    # error type
+                    raise ValueError(f"cannot replay from {version_index}: {e}")
+                for actions in entry_changes:
+                    for sa in actions:
+                        if sa.kind == StoreAction.CREATE:
+                            replay.append(EventCreate(sa.obj))
+                        elif sa.kind == StoreAction.UPDATE:
+                            replay.append(EventUpdate(sa.obj))
+                        else:
+                            replay.append(EventDelete(sa.obj))
+                replay.append(EventCommit(Version(cur)))
+            ch = self.queue.watch(matcher, limit=limit)
+            for ev in replay:
+                ch._offer(ev)
+        return ch
+
     def view_and_watch(self, cb: Callable[[ReadTx], Any] | None = None,
                        matcher=None, limit: int | None = -1) -> tuple[Any, Channel]:
         """Atomic snapshot-then-subscribe (memory.go:892-909): no event that

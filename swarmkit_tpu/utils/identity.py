@@ -13,3 +13,10 @@ def new_id() -> str:
         out.append(_ALPHABET[raw & 31])
         raw >>= 5
     return "".join(reversed(out))
+
+
+def new_secret_token(kind: str = "") -> str:
+    """Join/unlock token (reference: ca/config.go GenerateJoinToken —
+    'SWMTKN-1-<ca digest>-<secret>'; here the digest slot carries the kind
+    marker until the CA layer fills in the real root digest)."""
+    return f"SWMTKN-1-{kind or 'token'}-{new_id()}"

@@ -1,0 +1,1 @@
+from .watch import WatchAPI, WatchSelector  # noqa: F401

@@ -1,0 +1,286 @@
+"""Control API and Watch API tests (reference behaviors:
+manager/controlapi/*_test.go, manager/watchapi/watch_test.go)."""
+import pytest
+
+from swarmkit_tpu.api.objects import Cluster, Node, Service, Task
+from swarmkit_tpu.api.specs import (
+    Annotations,
+    ClusterSpec,
+    ConfigSpec,
+    ContainerSpec,
+    NetworkSpec,
+    NodeSpec,
+    PortConfig,
+    SecretReference,
+    SecretSpec,
+    ServiceSpec,
+    VolumeSpec,
+)
+from swarmkit_tpu.api.types import NodeRole, ServiceMode, TaskState
+from swarmkit_tpu.controlapi import (
+    AlreadyExists,
+    ControlAPI,
+    FailedPrecondition,
+    InvalidArgument,
+    ListFilters,
+    NotFound,
+)
+from swarmkit_tpu.store.memory import MemoryStore
+from swarmkit_tpu.watchapi import WatchAPI, WatchSelector
+
+
+@pytest.fixture
+def api():
+    return ControlAPI(MemoryStore())
+
+
+def spec(name="web", **kw):
+    s = ServiceSpec(annotations=Annotations(name=name), **kw)
+    return s
+
+
+def test_create_get_update_remove_service(api):
+    svc = api.create_service(spec())
+    assert api.get_service(svc.id).spec.annotations.name == "web"
+
+    # stale version is rejected
+    new_spec = spec()
+    new_spec.replicas = 5
+    got = api.get_service(svc.id)
+    updated = api.update_service(svc.id, got.meta.version, new_spec)
+    assert updated.spec.replicas == 5
+    assert updated.previous_spec is not None
+    with pytest.raises(FailedPrecondition):
+        api.update_service(svc.id, got.meta.version, new_spec)
+
+    # rollback restores the previous spec
+    cur = api.get_service(svc.id)
+    rolled = api.update_service(svc.id, cur.meta.version, new_spec,
+                                rollback=True)
+    assert rolled.spec.replicas == 1
+
+    api.remove_service(svc.id)
+    with pytest.raises(NotFound):
+        api.get_service(svc.id)
+
+
+def test_service_validation(api):
+    with pytest.raises(InvalidArgument):
+        api.create_service(spec(name=""))
+    with pytest.raises(InvalidArgument):
+        api.create_service(spec(name="-bad-"))
+    bad = spec()
+    bad.task.placement.constraints = ["node.labels.x ~ y"]
+    with pytest.raises(InvalidArgument):
+        api.create_service(bad)
+    badport = spec(name="p")
+    badport.endpoint.ports = [PortConfig(protocol="icmp", target_port=80)]
+    with pytest.raises(InvalidArgument):
+        api.create_service(badport)
+    # duplicate name
+    api.create_service(spec(name="dup"))
+    with pytest.raises(AlreadyExists):
+        api.create_service(spec(name="dup"))
+    # missing secret reference
+    withsec = spec(name="s1")
+    withsec.task.runtime = ContainerSpec(image="img")
+    withsec.task.runtime.secrets = [SecretReference(secret_id="nope")]
+    with pytest.raises(InvalidArgument):
+        api.create_service(withsec)
+    # rename forbidden
+    svc = api.create_service(spec(name="fixed"))
+    renamed = spec(name="other")
+    with pytest.raises(InvalidArgument):
+        api.update_service(svc.id, api.get_service(svc.id).meta.version,
+                           renamed)
+
+
+def test_secret_lifecycle(api):
+    sec = api.create_secret(SecretSpec(
+        annotations=Annotations(name="tls-key"), data=b"shh"))
+    # read path strips data
+    assert api.get_secret(sec.id).spec.data == b""
+    assert api.list_secrets()[0].spec.data == b""
+
+    # only labels may change
+    s2 = SecretSpec(annotations=Annotations(name="tls-key",
+                                            labels={"a": "1"}), data=b"")
+    cur = api.store.view().get_secret(sec.id)
+    api.update_secret(sec.id, cur.meta.version, s2)
+    assert api.store.view().get_secret(sec.id).spec.annotations.labels == \
+        {"a": "1"}
+    # data survives label-only update
+    assert api.store.view().get_secret(sec.id).spec.data == b"shh"
+
+    # removal blocked while referenced
+    s = spec(name="user")
+    s.task.runtime = ContainerSpec(image="img")
+    s.task.runtime.secrets = [SecretReference(secret_id=sec.id)]
+    svc = api.create_service(s)
+    with pytest.raises(InvalidArgument):
+        api.remove_secret(sec.id)
+    api.remove_service(svc.id)
+    api.remove_secret(sec.id)
+    with pytest.raises(NotFound):
+        api.get_secret(sec.id)
+
+    with pytest.raises(InvalidArgument):
+        api.create_secret(SecretSpec(annotations=Annotations(name="big"),
+                                     data=b"x" * (500 * 1024 + 1)))
+
+
+def test_config_and_network(api):
+    cfg = api.create_config(ConfigSpec(
+        annotations=Annotations(name="nginx-conf"), data=b"server {}"))
+    assert api.get_config(cfg.id).spec.data == b"server {}"
+
+    net = api.create_network(NetworkSpec(annotations=Annotations(name="back")))
+    s = spec(name="api")
+    s.networks = []
+    s.task.networks = []
+    from swarmkit_tpu.api.specs import NetworkAttachmentConfig
+    s.task.networks.append(NetworkAttachmentConfig(target=net.id))
+    svc = api.create_service(s)
+    with pytest.raises(FailedPrecondition):
+        api.remove_network(net.id)
+    api.remove_service(svc.id)
+    api.remove_network(net.id)
+    # only one ingress network allowed
+    api.create_network(NetworkSpec(annotations=Annotations(name="ing1"),
+                                   ingress=True))
+    with pytest.raises(AlreadyExists):
+        api.create_network(NetworkSpec(annotations=Annotations(name="ing2"),
+                                       ingress=True))
+
+
+def test_node_update_and_remove(api):
+    store = api.store
+    n1 = Node(id="n1", spec=NodeSpec(annotations=Annotations(name="n1"),
+                                     desired_role=NodeRole.MANAGER))
+    n2 = Node(id="n2", spec=NodeSpec(annotations=Annotations(name="n2")))
+    store.update(lambda tx: (tx.create(n1), tx.create(n2)))
+
+    # demoting the only manager is refused
+    demote = NodeSpec(annotations=Annotations(name="n1"),
+                      desired_role=NodeRole.WORKER)
+    with pytest.raises(FailedPrecondition):
+        api.update_node("n1", api.get_node("n1").meta.version, demote)
+
+    # promote n2, then demote n1 works
+    promote = NodeSpec(annotations=Annotations(name="n2"),
+                       desired_role=NodeRole.MANAGER)
+    api.update_node("n2", api.get_node("n2").meta.version, promote)
+    api.update_node("n1", api.get_node("n1").meta.version, demote)
+
+    # managers can't be removed
+    with pytest.raises(FailedPrecondition):
+        api.remove_node("n2")
+    api.remove_node("n1")
+    with pytest.raises(NotFound):
+        api.get_node("n1")
+
+
+def test_cluster_token_rotation(api):
+    c = Cluster(id="c1", spec=ClusterSpec(annotations=Annotations(name="default")))
+    api.store.update(lambda tx: tx.create(c))
+    got = api.get_cluster("c1")
+    out = api.update_cluster("c1", got.meta.version, got.spec)
+    t1 = out.root_ca["join_tokens"]["worker"]
+    assert t1.startswith("SWMTKN-1-")
+    out2 = api.update_cluster("c1", out.meta.version, out.spec,
+                              rotate_worker_token=True)
+    assert out2.root_ca["join_tokens"]["worker"] != t1
+    # manager token untouched without rotation flag
+    assert out2.root_ca["join_tokens"]["manager"] == \
+        out.root_ca["join_tokens"]["manager"]
+
+
+def test_list_filters(api):
+    api.create_service(spec(name="web-1"))
+    api.create_service(spec(name="web-2"))
+    s3 = spec(name="db", mode=ServiceMode.GLOBAL)
+    api.create_service(s3)
+    assert len(api.list_services()) == 3
+    assert len(api.list_services(ListFilters(name_prefixes=["web-"]))) == 2
+    assert len(api.list_services(ListFilters(names=["db"]))) == 1
+    assert len(api.list_services(
+        ListFilters(modes=[ServiceMode.GLOBAL]))) == 1
+
+
+def test_volume_lifecycle(api):
+    v = api.create_volume(VolumeSpec(annotations=Annotations(name="vol1"),
+                                     driver="csi.example"))
+    with pytest.raises(InvalidArgument):
+        api.create_volume(VolumeSpec(annotations=Annotations(name="vol2")))
+    # in-use volume can't be removed without force
+    t = Task(id="t1", volumes=[v.id])
+    t.status.state = TaskState.RUNNING
+    api.store.update(lambda tx: tx.create(t))
+    with pytest.raises(FailedPrecondition):
+        api.remove_volume(v.id)
+    api.remove_volume(v.id, force=True)
+    assert api.get_volume(v.id).pending_delete
+
+
+def test_extension_resource(api):
+    ext = api.create_extension(Annotations(name="widget"))
+    res = api.create_resource(Annotations(name="w1"), "widget", b"payload")
+    with pytest.raises(FailedPrecondition):
+        api.remove_extension(ext.id)
+    with pytest.raises(InvalidArgument):
+        api.create_resource(Annotations(name="w2"), "nope")
+    assert len(api.list_resources(kind="widget")) == 1
+    api.remove_resource(res.id)
+    api.remove_extension(ext.id)
+
+
+def test_watchapi_filtered_stream(api):
+    w = WatchAPI(api.store)
+    ch = w.watch([WatchSelector(kind="service", name_prefix="web")])
+    api.create_service(spec(name="web-1"))
+    api.create_service(spec(name="db"))
+    ev = ch.get(timeout=2)
+    assert ev.obj.spec.annotations.name == "web-1"
+    # db event filtered out; next event would be an update to web-1
+    svc = api.list_services(ListFilters(names=["web-1"]))[0]
+    ns = spec(name="web-1")
+    ns.replicas = 9
+    api.update_service(svc.id, svc.meta.version, ns)
+    ev2 = ch.get(timeout=2)
+    assert ev2.obj.spec.replicas == 9
+    ch.close()
+
+
+def test_watchapi_resume_replay():
+    """watch_from replays history through a history-retaining proposer."""
+    from swarmkit_tpu.raft.proposer import RaftProposer
+    from swarmkit_tpu.raft.testutils import RaftCluster
+
+    c = RaftCluster(1)
+    node = c.nodes[1]
+    prop = RaftProposer(node)
+    store = MemoryStore(proposer=prop)
+    prop.attach_store(store)
+    leader = c.tick_until_leader()
+    assert leader.id == 1
+
+    api = ControlAPI(store)
+
+    def propose(fn):
+        import threading
+        import time
+        t = threading.Thread(target=fn)
+        t.start()
+        deadline = time.time() + 10
+        while t.is_alive() and time.time() < deadline:
+            c.settle()
+        t.join(timeout=5)
+
+    propose(lambda: api.create_service(spec(name="a")))
+    v = store.version.index
+    propose(lambda: api.create_service(spec(name="b")))
+    w = WatchAPI(store)
+    ch = w.watch([WatchSelector(kind="service")], resume_from=v)
+    ev = ch.get(timeout=2)
+    assert ev.obj.spec.annotations.name == "b"
+    ch.close()
