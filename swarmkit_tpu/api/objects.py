@@ -127,6 +127,33 @@ class ManagerStatus:
 
 
 @dataclass
+class NodeCertificate:
+    """Per-node certificate record replicated in the store
+    (reference: api/types.proto Certificate: role/CSR/status/certificate/CN)."""
+
+    role: int = 0  # NodeRole.WORKER
+    csr_pem: bytes = b""
+    status_state: int = 0  # IssuanceState
+    status_err: str = ""
+    certificate_pem: bytes = b""
+    cn: str = ""
+
+
+@dataclass
+class RootCAObj:
+    """Cluster root CA material held on the Cluster object
+    (reference: api/types.proto RootCA: key/cert/digest/join tokens/rotation)."""
+
+    ca_key_pem: bytes = b""
+    ca_cert_pem: bytes = b""
+    cert_digest: str = ""
+    join_token_worker: str = ""
+    join_token_manager: str = ""
+    root_rotation: Any = None
+    last_forced_rotation: int = 0
+
+
+@dataclass
 class Node(StoreObject):
     TABLE = "node"
 

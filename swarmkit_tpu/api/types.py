@@ -101,6 +101,17 @@ class UpdateStatusState(enum.Enum):
     ROLLBACK_COMPLETED = "rollback_completed"
 
 
+class IssuanceState(enum.IntEnum):
+    """Certificate issuance lifecycle (reference: api/ca.proto IssuanceStatus.State)."""
+
+    UNKNOWN = 0
+    RENEW = 1  # manager forces the node to re-CSR
+    PENDING = 2
+    ISSUED = 3
+    FAILED = 4
+    ROTATE = 5  # cert valid but must be re-issued under a new root
+
+
 # Platform normalization applied by the platform filter
 # (reference: manager/scheduler/filter.go:254-320).
 ARCH_ALIASES = {

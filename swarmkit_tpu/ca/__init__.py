@@ -1,0 +1,46 @@
+"""Security substrate: root CA, node certificates, tokens, authz, renewal.
+
+Re-derivation of the reference `ca/` package (SURVEY.md §2.10)."""
+from .auth import Caller, PermissionDenied, authorize_forwarded, authorize_roles, caller_from_cert
+from .certificates import (
+    CertificateError,
+    CertIdentity,
+    RootCA,
+    cert_expiry,
+    create_csr,
+    parse_cert_identity,
+    renewal_due,
+)
+from .config import (
+    InvalidToken,
+    ParsedToken,
+    SecurityConfig,
+    generate_join_token,
+    parse_join_token,
+)
+from .keyreadwriter import KeyReadWriter
+from .renewer import TLSRenewer
+from .server import CAServer
+
+__all__ = [
+    "Caller",
+    "PermissionDenied",
+    "authorize_forwarded",
+    "authorize_roles",
+    "caller_from_cert",
+    "CertificateError",
+    "CertIdentity",
+    "RootCA",
+    "cert_expiry",
+    "create_csr",
+    "parse_cert_identity",
+    "renewal_due",
+    "InvalidToken",
+    "ParsedToken",
+    "SecurityConfig",
+    "generate_join_token",
+    "parse_join_token",
+    "KeyReadWriter",
+    "TLSRenewer",
+    "CAServer",
+]

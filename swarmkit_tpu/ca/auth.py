@@ -1,0 +1,64 @@
+"""Per-RPC role authorization.
+
+Re-derivation of ca/auth.go: each RPC is gated on the caller's certificate
+OU (role) and O (cluster); leader-proxied calls carry the original caller as
+forwarded metadata which only a manager may assert
+(AuthorizeOrgAndRole / AuthorizeForwardedRoleAndOrg, ca/auth.go:88-196).
+
+The in-process transport passes a `Caller` explicitly where gRPC would derive
+it from the peer TLS state; the wire transport builds a Caller from the peer
+certificate via `caller_from_cert`.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..api.types import NodeRole
+from .certificates import CertIdentity, parse_cert_identity
+
+
+class PermissionDenied(Exception):
+    pass
+
+
+@dataclass
+class Caller:
+    """The authenticated peer of an RPC."""
+
+    node_id: str
+    role: int
+    org: str
+    forwarded_by: "Caller | None" = None  # set when a manager proxies a call
+
+
+def caller_from_cert(cert_pem: bytes) -> Caller:
+    ident: CertIdentity = parse_cert_identity(cert_pem)
+    return Caller(node_id=ident.node_id, role=ident.role, org=ident.org)
+
+
+def authorize_roles(caller: Caller | None, roles: list[int], org: str | None = None) -> Caller:
+    """Gate an RPC on caller role (+ org when pinned). Returns the effective
+    caller for handlers that need the identity (e.g. dispatcher sessions)."""
+    if caller is None:
+        raise PermissionDenied("no peer identity")
+    if org is not None and caller.org != org:
+        raise PermissionDenied(f"certificate from wrong cluster {caller.org!r}")
+    if caller.role not in roles:
+        raise PermissionDenied(
+            f"role {NodeRole(caller.role).name.lower()} not authorized"
+        )
+    return caller
+
+
+def authorize_forwarded(
+    caller: Caller | None, roles: list[int], org: str | None = None
+) -> Caller:
+    """Accept either a direct caller with an allowed role, or a manager
+    forwarding an original caller with an allowed role."""
+    if caller is None:
+        raise PermissionDenied("no peer identity")
+    if caller.forwarded_by is not None:
+        # the direct peer must be a manager to assert forwarded identity
+        authorize_roles(caller.forwarded_by, [NodeRole.MANAGER], org)
+        return authorize_roles(caller, roles, org)
+    return authorize_roles(caller, roles, org)

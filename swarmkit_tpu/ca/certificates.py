@@ -1,0 +1,282 @@
+"""Root CA and certificate issuance.
+
+Re-derivation of the reference's CA core (ca/certificates.go): a self-signed
+ECDSA root, CSR create/sign with the node's identity encoded in the subject
+(CN = node ID, OU = role, O = cluster ID — ca/certificates.go:167-450), cert
+chain validation, and expiry-window math used by the renewer.
+
+The reference shells out to cloudflare/cfssl; we use `cryptography.x509`
+directly. Certificates are real and usable for mTLS between processes; the
+in-process transport carries the same identity objects without TLS.
+"""
+from __future__ import annotations
+
+import datetime
+import hashlib
+import threading
+from dataclasses import dataclass
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+from ..api.types import NodeRole
+
+# Subject OU values by role (reference: ca/certificates.go:56-62).
+MANAGER_ROLE = "swarm-manager"
+WORKER_ROLE = "swarm-worker"
+CA_ROLE = "swarm-ca"
+
+# Expiry knobs (reference: ca/certificates.go:64-80): root 20y, node 90d
+# default / 30min minimum, renewal begins inside the last half of validity.
+ROOT_CA_EXPIRATION = 20 * 365 * 24 * 3600.0
+DEFAULT_NODE_CERT_EXPIRATION = 90 * 24 * 3600.0
+MIN_NODE_CERT_EXPIRATION = 30 * 60.0
+CERT_BACKDATE = 300.0  # issue 5min in the past to tolerate clock skew
+
+
+class CertificateError(Exception):
+    pass
+
+
+def role_to_ou(role: int) -> str:
+    return MANAGER_ROLE if role == NodeRole.MANAGER else WORKER_ROLE
+
+
+def ou_to_role(ou: str) -> int:
+    if ou == MANAGER_ROLE:
+        return NodeRole.MANAGER
+    if ou == WORKER_ROLE:
+        return NodeRole.WORKER
+    raise CertificateError(f"unknown role OU {ou!r}")
+
+
+def _now() -> datetime.datetime:
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+def generate_key() -> ec.EllipticCurvePrivateKey:
+    """ECDSA P-256, matching the reference's default key type
+    (ca/certificates.go RootCA uses ECDSA)."""
+    return ec.generate_private_key(ec.SECP256R1())
+
+
+def key_to_pem(key: ec.EllipticCurvePrivateKey) -> bytes:
+    return key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    )
+
+
+def key_from_pem(pem: bytes) -> ec.EllipticCurvePrivateKey:
+    return serialization.load_pem_private_key(pem, password=None)
+
+
+def create_csr(node_id: str, role: int, org: str) -> tuple[bytes, bytes]:
+    """Create a key + CSR for a node identity (reference:
+    ca/certificates.go GenerateNewCSR + CreateCertificateSigningRequest).
+    Returns (key_pem, csr_pem)."""
+    key = generate_key()
+    csr = (
+        x509.CertificateSigningRequestBuilder()
+        .subject_name(
+            x509.Name(
+                [
+                    x509.NameAttribute(NameOID.COMMON_NAME, node_id),
+                    x509.NameAttribute(NameOID.ORGANIZATIONAL_UNIT_NAME, role_to_ou(role)),
+                    x509.NameAttribute(NameOID.ORGANIZATION_NAME, org),
+                ]
+            )
+        )
+        .sign(key, hashes.SHA256())
+    )
+    return key_to_pem(key), csr.public_bytes(serialization.Encoding.PEM)
+
+
+@dataclass
+class CertIdentity:
+    """Identity parsed out of a node certificate subject."""
+
+    node_id: str
+    role: int
+    org: str
+
+
+def parse_cert_identity(cert_pem: bytes) -> CertIdentity:
+    cert = x509.load_pem_x509_certificate(cert_pem)
+    subj = cert.subject
+
+    def one(oid):
+        attrs = subj.get_attributes_for_oid(oid)
+        return attrs[0].value if attrs else ""
+
+    ou = one(NameOID.ORGANIZATIONAL_UNIT_NAME)
+    return CertIdentity(
+        node_id=one(NameOID.COMMON_NAME),
+        role=ou_to_role(ou),
+        org=one(NameOID.ORGANIZATION_NAME),
+    )
+
+
+class RootCA:
+    """A signing root: cert + (optionally) key.
+
+    Mirrors ca/certificates.go RootCA — a root without the signing key is a
+    trust anchor only (worker-side); with the key it can sign CSRs.
+    """
+
+    def __init__(self, cert_pem: bytes, key_pem: bytes | None = None):
+        self.cert_pem = cert_pem
+        self.key_pem = key_pem
+        self._cert = x509.load_pem_x509_certificate(cert_pem)
+        self._key = key_from_pem(key_pem) if key_pem else None
+        self._lock = threading.Lock()
+        self._serial = 0
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def create(cls, org: str = "swarmkit-tpu") -> "RootCA":
+        """Self-signed root (reference: ca/certificates.go CreateRootCA:768)."""
+        key = generate_key()
+        now = _now()
+        name = x509.Name(
+            [
+                x509.NameAttribute(NameOID.COMMON_NAME, org + " CA"),
+                x509.NameAttribute(NameOID.ORGANIZATIONAL_UNIT_NAME, CA_ROLE),
+                x509.NameAttribute(NameOID.ORGANIZATION_NAME, org),
+            ]
+        )
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(name)
+            .issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(seconds=CERT_BACKDATE))
+            .not_valid_after(now + datetime.timedelta(seconds=ROOT_CA_EXPIRATION))
+            .add_extension(x509.BasicConstraints(ca=True, path_length=None), critical=True)
+            .add_extension(
+                x509.KeyUsage(
+                    digital_signature=True,
+                    key_cert_sign=True,
+                    crl_sign=True,
+                    content_commitment=False,
+                    key_encipherment=False,
+                    data_encipherment=False,
+                    key_agreement=False,
+                    encipher_only=False,
+                    decipher_only=False,
+                ),
+                critical=True,
+            )
+            .sign(key, hashes.SHA256())
+        )
+        return cls(cert.public_bytes(serialization.Encoding.PEM), key_to_pem(key))
+
+    # -- properties --------------------------------------------------------
+
+    @property
+    def can_sign(self) -> bool:
+        return self._key is not None
+
+    def digest(self) -> str:
+        """sha256 digest of the root cert, the token-embedded trust pin
+        (reference: ca/config.go join-token digest)."""
+        return hashlib.sha256(self.cert_pem).hexdigest()
+
+    def without_key(self) -> "RootCA":
+        return RootCA(self.cert_pem)
+
+    # -- signing -----------------------------------------------------------
+
+    def sign_csr(
+        self,
+        csr_pem: bytes,
+        expiry: float = DEFAULT_NODE_CERT_EXPIRATION,
+        subject: tuple[str, int, str] | None = None,
+    ) -> bytes:
+        """Sign a node CSR. By default the CSR's subject is preserved; the CA
+        server passes `subject=(node_id, role, org)` to force the identity it
+        assigned, exactly as the reference overrides the cfssl subject when
+        signing (ca/certificates.go RootCA.ParseValidateAndSignCSR — the CSR
+        only contributes the public key)."""
+        if not self.can_sign:
+            raise CertificateError("root CA has no signing key")
+        expiry = max(expiry, MIN_NODE_CERT_EXPIRATION)
+        csr = x509.load_pem_x509_csr(csr_pem)
+        if not csr.is_signature_valid:
+            raise CertificateError("invalid CSR signature")
+        if subject is not None:
+            node_id, role, org = subject
+            subject_name = x509.Name(
+                [
+                    x509.NameAttribute(NameOID.COMMON_NAME, node_id),
+                    x509.NameAttribute(NameOID.ORGANIZATIONAL_UNIT_NAME, role_to_ou(role)),
+                    x509.NameAttribute(NameOID.ORGANIZATION_NAME, org),
+                ]
+            )
+        else:
+            subject_name = csr.subject
+        now = _now()
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(subject_name)
+            .issuer_name(self._cert.subject)
+            .public_key(csr.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(seconds=CERT_BACKDATE))
+            .not_valid_after(now + datetime.timedelta(seconds=expiry))
+            .add_extension(x509.BasicConstraints(ca=False, path_length=None), critical=True)
+            .add_extension(
+                x509.ExtendedKeyUsage(
+                    [x509.ExtendedKeyUsageOID.SERVER_AUTH, x509.ExtendedKeyUsageOID.CLIENT_AUTH]
+                ),
+                critical=False,
+            )
+            .sign(self._key, hashes.SHA256())
+        )
+        return cert.public_bytes(serialization.Encoding.PEM)
+
+    def issue_and_save_new_certificates(
+        self, node_id: str, role: int, org: str
+    ) -> tuple[bytes, bytes]:
+        """Locally issue a cert without the CSR round-trip — used by the
+        first manager bootstrapping itself (reference:
+        ca/certificates.go IssueAndSaveNewCertificates:234).
+        Returns (key_pem, cert_pem)."""
+        key_pem, csr_pem = create_csr(node_id, role, org)
+        return key_pem, self.sign_csr(csr_pem)
+
+    # -- validation --------------------------------------------------------
+
+    def verify_cert(self, cert_pem: bytes) -> CertIdentity:
+        """Validate signature chain + validity window, return the identity
+        (reference: ca/certificates.go ValidateCertChain)."""
+        cert = x509.load_pem_x509_certificate(cert_pem)
+        now = _now()
+        if now < cert.not_valid_before_utc or now > cert.not_valid_after_utc:
+            raise CertificateError("certificate outside validity window")
+        try:
+            cert.verify_directly_issued_by(self._cert)
+        except Exception as exc:  # signature/issuer mismatch
+            raise CertificateError(f"certificate not issued by this root: {exc}") from exc
+        return parse_cert_identity(cert_pem)
+
+
+def cert_expiry(cert_pem: bytes) -> tuple[float, float]:
+    """(not_before, not_after) as unix seconds."""
+    cert = x509.load_pem_x509_certificate(cert_pem)
+    return (
+        cert.not_valid_before_utc.timestamp(),
+        cert.not_valid_after_utc.timestamp(),
+    )
+
+
+def renewal_due(cert_pem: bytes, now: float) -> bool:
+    """True once inside the renewal window — the last half of validity,
+    mirroring ca/config.go calculateRandomExpiry's midpoint heuristic."""
+    nb, na = cert_expiry(cert_pem)
+    return now >= nb + (na - nb) / 2

@@ -1,0 +1,134 @@
+"""SecurityConfig: a node's live TLS identity, plus join tokens.
+
+Re-derivation of ca/config.go: SecurityConfig bundles the trust root and the
+node's own cert/key, hot-swappable on renewal (watchers are notified so gRPC
+servers can pick up the new cert); join tokens pin the root digest so joining
+nodes can authenticate the cluster before trusting it.
+
+Token format (ca/config.go GenerateJoinToken / ParseJoinToken):
+    SWMTKN-1-<sha256 digest of root cert, hex>-<random secret>
+(the reference encodes the digest crockford-base32; we keep hex — same pin,
+different encoding, tokens are not wire-compatible with Docker Swarm's)
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+from ..utils.identity import new_id
+from .certificates import CertIdentity, RootCA, parse_cert_identity, renewal_due
+
+TOKEN_PREFIX = "SWMTKN"
+TOKEN_VERSION = "1"
+
+
+class InvalidToken(Exception):
+    pass
+
+
+def generate_join_token(root: RootCA, fips: bool = False) -> str:
+    prefix = "FIPS." + TOKEN_PREFIX if fips else TOKEN_PREFIX
+    return f"{prefix}-{TOKEN_VERSION}-{root.digest()}-{new_id()}"
+
+
+@dataclass
+class ParsedToken:
+    version: str
+    root_digest: str
+    secret: str
+    fips: bool
+
+
+def parse_join_token(token: str) -> ParsedToken:
+    fips = False
+    if token.startswith("FIPS."):
+        fips = True
+        token = token[len("FIPS.") :]
+    parts = token.split("-")
+    if len(parts) != 4 or parts[0] != TOKEN_PREFIX:
+        raise InvalidToken("malformed join token")
+    if parts[1] != TOKEN_VERSION:
+        raise InvalidToken(f"unsupported token version {parts[1]}")
+    return ParsedToken(version=parts[1], root_digest=parts[2], secret=parts[3], fips=fips)
+
+
+class SecurityConfig:
+    """Trust root + node identity, renewal-aware (ca/config.go:SecurityConfig)."""
+
+    def __init__(self, root: RootCA, key_pem: bytes, cert_pem: bytes):
+        self._lock = threading.Lock()
+        self._root = root
+        self._key_pem = key_pem
+        self._cert_pem = cert_pem
+        self._identity = root.verify_cert(cert_pem)
+        self._watchers: list = []  # callables fired on cert/root update
+
+    # -- accessors ---------------------------------------------------------
+
+    @property
+    def root_ca(self) -> RootCA:
+        with self._lock:
+            return self._root
+
+    @property
+    def identity(self) -> CertIdentity:
+        with self._lock:
+            return self._identity
+
+    def node_id(self) -> str:
+        return self.identity.node_id
+
+    def role(self) -> int:
+        return self.identity.role
+
+    def key_and_cert(self) -> tuple[bytes, bytes]:
+        with self._lock:
+            return self._key_pem, self._cert_pem
+
+    # -- updates -----------------------------------------------------------
+
+    def watch(self, cb):
+        with self._lock:
+            self._watchers.append(cb)
+
+    def update_tls_credentials(self, key_pem: bytes, cert_pem: bytes):
+        """Swap in a renewed cert (ca/config.go UpdateTLSCredentials)."""
+        with self._lock:
+            identity = self._root.verify_cert(cert_pem)
+            self._key_pem, self._cert_pem = key_pem, cert_pem
+            self._identity = identity
+            watchers = list(self._watchers)
+        for cb in watchers:
+            cb(self)
+
+    def update_root_ca(self, root: RootCA):
+        """Swap the trust root (root rotation — ca/config.go UpdateRootCA)."""
+        with self._lock:
+            self._root = root
+            watchers = list(self._watchers)
+        for cb in watchers:
+            cb(self)
+
+    def renewal_due(self, now: float | None = None) -> bool:
+        with self._lock:
+            return renewal_due(self._cert_pem, now if now is not None else time.time())
+
+    @classmethod
+    def bootstrap_manager(
+        cls, node_id: str | None = None, org: str = "swarmkit-tpu"
+    ) -> "SecurityConfig":
+        """First-manager self-bootstrap: create a root and self-issue a
+        manager cert (node/node.go loadSecurityConfig init path)."""
+        from ..api.types import NodeRole
+
+        node_id = node_id or new_id()
+        root = RootCA.create(org)
+        key_pem, cert_pem = root.issue_and_save_new_certificates(
+            node_id, NodeRole.MANAGER, org
+        )
+        return cls(root, key_pem, cert_pem)
+
+
+def identity_from_cert(cert_pem: bytes) -> CertIdentity:
+    return parse_cert_identity(cert_pem)

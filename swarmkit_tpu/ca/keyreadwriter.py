@@ -1,0 +1,106 @@
+"""At-rest protection of the node's TLS key, with metadata headers.
+
+Re-derivation of ca/keyreadwriter.go: the node's private key PEM lives on
+disk, optionally sealed with a cluster KEK (autolock); PEM headers piggyback
+small metadata — the reference stores the raft DEKs there (manager/deks.go).
+Rotating the KEK re-seals in place via atomic rename (ioutils.AtomicWriteFile).
+"""
+from __future__ import annotations
+
+import base64
+import json
+import os
+import threading
+
+from cryptography.fernet import Fernet
+
+
+def _derive_fernet(kek: bytes) -> Fernet:
+    # Fernet wants a 32-byte urlsafe-b64 key; stretch arbitrary KEK bytes.
+    import hashlib
+
+    return Fernet(base64.urlsafe_b64encode(hashlib.sha256(kek).digest()))
+
+
+class KeyReadWriter:
+    """Read/write `key.pem` (+ headers) under an optional KEK."""
+
+    def __init__(self, path: str, kek: bytes | None = None):
+        self.path = path
+        self._kek = kek
+        self._lock = threading.Lock()
+
+    # file format: JSON {sealed: bool, headers: {..}, key: b64}
+    # (the reference uses PEM headers; JSON keeps the same content model
+    # without a PEM parser round-trip)
+
+    def write(self, key_pem: bytes, headers: dict[str, str] | None = None):
+        with self._lock:
+            self._write_locked(key_pem, headers or self._read_headers())
+
+    def _write_locked(self, key_pem: bytes, headers: dict[str, str]):
+        if self._kek is not None:
+            blob = _derive_fernet(self._kek).encrypt(key_pem)
+            sealed = True
+        else:
+            blob = key_pem
+            sealed = False
+        rec = {
+            "sealed": sealed,
+            "headers": headers,
+            "key": base64.b64encode(blob).decode(),
+        }
+        tmp = self.path + ".tmp"
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)  # atomic (ioutils/ioutils.go AtomicWriteFile)
+        os.chmod(self.path, 0o600)
+
+    def read(self) -> tuple[bytes, dict[str, str]]:
+        with self._lock:
+            return self._read_unlocked()
+
+    def _read_record(self) -> dict:
+        with open(self.path) as f:
+            return json.load(f)
+
+    def _read_headers(self) -> dict[str, str]:
+        try:
+            return self._read_record().get("headers", {})
+        except FileNotFoundError:
+            return {}
+
+    def update_headers(self, update: dict[str, str | None]):
+        """Merge headers (None deletes), re-writing the file — the raft DEK
+        rotation handshake path (manager/deks.go RaftDEKManager)."""
+        with self._lock:
+            key_pem, headers = self._read_unlocked()
+            for k, v in update.items():
+                if v is None:
+                    headers.pop(k, None)
+                else:
+                    headers[k] = v
+            self._write_locked(key_pem, headers)
+
+    def _read_unlocked(self) -> tuple[bytes, dict[str, str]]:
+        rec = self._read_record()
+        blob = base64.b64decode(rec["key"])
+        if rec["sealed"]:
+            if self._kek is None:
+                raise PermissionError("key is locked and no KEK supplied")
+            blob = _derive_fernet(self._kek).decrypt(blob)
+        return blob, rec.get("headers", {})
+
+    def rotate_kek(self, new_kek: bytes | None):
+        """Re-seal the key under a new KEK (ca/keyreadwriter.go ViewAndRotateKEK)."""
+        with self._lock:
+            key_pem, headers = self._read_unlocked()
+            self._kek = new_kek
+            self._write_locked(key_pem, headers)
+
+    @property
+    def kek(self) -> bytes | None:
+        return self._kek

@@ -1,0 +1,80 @@
+"""Timer-driven certificate renewal.
+
+Re-derivation of ca/renewer.go: a loop that waits until the cert enters its
+renewal window (or is told to renew now), requests a fresh cert through the
+CA flow, and hot-swaps it into the SecurityConfig so servers pick it up.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from .certificates import create_csr
+from .config import SecurityConfig
+
+
+class TLSRenewer:
+    """Renews a SecurityConfig's cert against a CAServer-like issuer
+    (ca/renewer.go TLSRenewer; request path ca/certificates.go
+    RequestAndSaveNewCertificates:234)."""
+
+    def __init__(self, security: SecurityConfig, ca_server, check_interval: float = 1.0):
+        self.security = security
+        self.ca_server = ca_server
+        self.check_interval = check_interval
+        self._stop = threading.Event()
+        self._renew_now = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, name="tls-renewer", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._renew_now.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def renew_now(self):
+        self._renew_now.set()
+
+    def renew_once(self) -> bool:
+        """One renewal round-trip: refresh trust root → CSR → issue → poll →
+        swap. Returns True on success.
+
+        The root refresh mirrors the reference's download of the remote root
+        CA cert before requesting certs (ca/certificates.go
+        GetRemoteCA / RequestAndSaveNewCertificates) — without it a rotated
+        root would make every renewed cert fail local verification."""
+        from ..api.types import IssuanceState
+        from .auth import Caller
+        from .certificates import RootCA
+
+        server_root_pem = self.ca_server.get_root_ca_certificate()
+        if server_root_pem != self.security.root_ca.cert_pem:
+            self.security.update_root_ca(RootCA(server_root_pem))
+
+        ident = self.security.identity
+        caller = Caller(node_id=ident.node_id, role=ident.role, org=ident.org)
+        key_pem, csr_pem = create_csr(ident.node_id, ident.role, ident.org)
+        self.ca_server.issue_node_certificate(csr_pem, node_id=ident.node_id, caller=caller)
+        cert = self.ca_server.node_certificate_status(ident.node_id)
+
+        if cert is None or cert.status_state != IssuanceState.ISSUED:
+            return False
+        self.security.update_tls_credentials(key_pem, cert.certificate_pem)
+        return True
+
+    def _run(self):
+        while not self._stop.is_set():
+            triggered = self._renew_now.wait(timeout=self.check_interval)
+            if self._stop.is_set():
+                return
+            if triggered:
+                self._renew_now.clear()
+            if triggered or self.security.renewal_due(time.time()):
+                try:
+                    self.renew_once()
+                except Exception:
+                    pass  # retried next interval (reference retries w/ backoff)

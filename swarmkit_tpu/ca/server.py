@@ -1,0 +1,286 @@
+"""CA server: the manager-side certificate issuance service.
+
+Re-derivation of ca/server.go: `issue_node_certificate` validates the join
+token against the cluster object, records a CSR on a Node object with status
+PENDING (the CSR flow is *store-replicated*, so any manager can answer and
+the signing decision survives failover); a signing loop watches for pending
+certs and signs them (signNodeCert, ca/server.go:764-881);
+`node_certificate_status` long-polls until ISSUED (ca/server.go:148-232).
+Also hosts root rotation entry points (ca/reconciler.go).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..api.objects import (
+    EventCreate,
+    EventUpdate,
+    Node,
+    NodeCertificate,
+    RootCAObj,
+)
+from ..api.specs import NodeSpec
+from ..api.types import IssuanceState, NodeRole
+from ..store import by
+from ..utils.identity import new_id
+from .auth import PermissionDenied
+from .certificates import RootCA
+from .config import InvalidToken, parse_join_token
+
+
+class CAServer:
+    """Signs CSRs recorded on Node objects (reference ca/server.go Server)."""
+
+    def __init__(self, store, root: RootCA, cluster_id: str, org: str = "swarmkit-tpu"):
+        self.store = store
+        self.root = root
+        self.cluster_id = cluster_id
+        self.org = org
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._wake = threading.Event()
+        self._status_cond = threading.Condition()
+
+    # -- service lifecycle -------------------------------------------------
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, name="ca-server", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._wake.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _run(self):
+        """Snapshot-then-watch over nodes with pending certs
+        (ca/server.go Run:356-476)."""
+        queue = self.store.watch_queue()
+        ch = queue.watch()
+        try:
+            from ..store.watch import ChannelClosed
+
+            self._sign_pending()
+            while not self._stop.is_set():
+                try:
+                    ev = ch.get(timeout=0.2)
+                except TimeoutError:
+                    if self._wake.is_set():
+                        self._wake.clear()
+                        self._sign_pending()
+                    continue
+                except ChannelClosed:
+                    # slow-subscriber overflow: resubscribe and resync
+                    queue.stop_watch(ch)
+                    ch = queue.watch()
+                    self._sign_pending()
+                    continue
+                if isinstance(ev, (EventCreate, EventUpdate)) and isinstance(ev.obj, Node):
+                    cert = ev.obj.certificate
+                    if cert is not None and cert.status_state in (
+                        IssuanceState.PENDING,
+                        IssuanceState.RENEW,
+                        IssuanceState.ROTATE,
+                    ):
+                        self._sign_pending()
+        finally:
+            queue.stop_watch(ch)
+
+    # -- RPC surface -------------------------------------------------------
+
+    def get_root_ca_certificate(self) -> bytes:
+        """CA.GetRootCACertificate (api/ca.proto:13-17) — unauthenticated."""
+        return self.root.cert_pem
+
+    def get_unlock_key(self) -> bytes | None:
+        """CA.GetUnlockKey — the current autolock KEK from the cluster object."""
+        cluster = self.store.view(lambda tx: tx.get_cluster(self.cluster_id))
+        if cluster is None or not cluster.unlock_keys:
+            return None
+        return cluster.unlock_keys[0]
+
+    def issue_node_certificate(
+        self,
+        csr_pem: bytes,
+        token: str | None = None,
+        node_id: str | None = None,
+        caller=None,
+    ) -> str:
+        """NodeCA.IssueNodeCertificate (ca/server.go:234-354).
+
+        New nodes present a join token → role is derived from which cluster
+        token matched. Known nodes (renewal) present their node_id with no
+        token; the renewal must be authenticated: the caller's cert CN must
+        match the node being renewed (ca/server.go:278-292 checks the TLS
+        peer identity), or the caller must be a manager. `caller=None` with
+        no token is rejected for existing nodes.
+        """
+        role = None
+        if token is not None:
+            role = self._role_from_token(token)
+        if node_id is None:
+            node_id = new_id()
+        elif role is None:
+            # renewal path: authenticate the claimed identity
+            from ..api.types import NodeRole as _NR
+
+            if caller is None or (
+                caller.node_id != node_id and caller.role != _NR.MANAGER
+            ):
+                raise PermissionDenied(
+                    f"renewal for {node_id} requires the node's own identity"
+                )
+
+        def txn(tx):
+            node = tx.get_node(node_id)
+            if node is None:
+                if role is None:
+                    raise InvalidToken("unknown node and no join token")
+                node = Node(
+                    id=node_id,
+                    spec=NodeSpec(desired_role=role),
+                    role=role,
+                    certificate=NodeCertificate(
+                        role=role,
+                        csr_pem=csr_pem,
+                        status_state=IssuanceState.PENDING,
+                        cn=node_id,
+                    ),
+                )
+                tx.create(node)
+            else:
+                cert_role = role if role is not None else (
+                    node.certificate.role if node.certificate else node.role
+                )
+                node.certificate = NodeCertificate(
+                    role=cert_role,
+                    csr_pem=csr_pem,
+                    status_state=IssuanceState.PENDING,
+                    cn=node_id,
+                )
+                tx.update(node)
+
+        self.store.update(txn)
+        self._wake.set()
+        return node_id
+
+    def node_certificate_status(
+        self, node_id: str, timeout: float = 10.0
+    ) -> NodeCertificate:
+        """NodeCA.NodeCertificateStatus long-poll (ca/server.go:148-232)."""
+        end = time.monotonic() + timeout
+        while True:
+            node = self.store.view(lambda tx: tx.get_node(node_id))
+            if node is None:
+                raise KeyError(f"node {node_id} not found")
+            cert = node.certificate
+            if cert is not None and cert.status_state in (
+                IssuanceState.ISSUED,
+                IssuanceState.FAILED,
+            ):
+                return cert
+            remaining = end - time.monotonic()
+            if remaining <= 0:
+                return cert
+            with self._status_cond:
+                self._status_cond.wait(timeout=min(0.1, remaining))
+
+    # -- internals ---------------------------------------------------------
+
+    def _role_from_token(self, token: str) -> int:
+        parsed = parse_join_token(token)
+        if parsed.root_digest != self.root.digest():
+            raise InvalidToken("join token pins a different root CA")
+        cluster = self.store.view(lambda tx: tx.get_cluster(self.cluster_id))
+        if cluster is None or cluster.root_ca is None:
+            raise InvalidToken("cluster has no CA configured")
+        rca: RootCAObj = cluster.root_ca
+        if token == rca.join_token_manager:
+            return NodeRole.MANAGER
+        if token == rca.join_token_worker:
+            return NodeRole.WORKER
+        raise InvalidToken("join token does not match cluster tokens")
+
+    def _sign_pending(self):
+        """Sign every node whose certificate is awaiting issuance
+        (ca/server.go signNodeCert:764-881)."""
+        pending = self.store.view(
+            lambda tx: [
+                n
+                for n in tx.find_nodes(by.All())
+                if n.certificate is not None
+                and n.certificate.status_state
+                in (IssuanceState.PENDING, IssuanceState.RENEW, IssuanceState.ROTATE)
+            ]
+        )
+        for node in pending:
+            signing_root = self.root  # snapshot: rotation may swap self.root
+            observed_state = node.certificate.status_state
+            try:
+                cert_pem = signing_root.sign_csr(
+                    node.certificate.csr_pem,
+                    subject=(node.id, node.certificate.role, self.org),
+                )
+                state, err = IssuanceState.ISSUED, ""
+            except Exception as exc:
+                cert_pem, state, err = b"", IssuanceState.FAILED, str(exc)
+
+            def txn(
+                tx,
+                node_id=node.id,
+                cert_pem=cert_pem,
+                state=state,
+                err=err,
+                observed_state=observed_state,
+                signing_root=signing_root,
+            ):
+                n = tx.get_node(node_id)
+                if n is None or n.certificate is None:
+                    return
+                if n.certificate.status_state != observed_state:
+                    return  # raced: state moved (another signer, or ROTATE marked)
+                if signing_root is not self.root:
+                    return  # raced with root rotation: re-signed next pass
+                n.certificate.certificate_pem = cert_pem
+                n.certificate.status_state = state
+                n.certificate.status_err = err
+                n.role = n.certificate.role  # observed role follows the cert
+                tx.update(n)
+
+            self.store.update(txn)
+        if pending:
+            with self._status_cond:
+                self._status_cond.notify_all()
+
+    # -- root rotation -----------------------------------------------------
+
+    def rotate_root_ca(self) -> RootCA:
+        """Generate a new root and mark all certs ROTATE so the signing loop
+        re-issues under it (condensed ca/reconciler.go rotation: the
+        reference cross-signs and rotates in phases; we swap + re-issue,
+        which preserves the observable end state)."""
+        new_root = RootCA.create(self.org)
+        old_root = self.root
+        self.root = new_root
+
+        def txn(tx):
+            cluster = tx.get_cluster(self.cluster_id)
+            if cluster is not None and cluster.root_ca is not None:
+                from .config import generate_join_token
+
+                cluster.root_ca.ca_cert_pem = new_root.cert_pem
+                cluster.root_ca.ca_key_pem = new_root.key_pem or b""
+                cluster.root_ca.cert_digest = new_root.digest()
+                cluster.root_ca.join_token_worker = generate_join_token(new_root)
+                cluster.root_ca.join_token_manager = generate_join_token(new_root)
+                tx.update(cluster)
+            for n in tx.find_nodes(by.All()):
+                if n.certificate is not None and n.certificate.csr_pem:
+                    n.certificate.status_state = IssuanceState.ROTATE
+                    tx.update(n)
+
+        self.store.update(txn)
+        self._wake.set()
+        return new_root

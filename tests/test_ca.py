@@ -1,0 +1,332 @@
+"""Security substrate tests (reference model: ca/certificates_test.go,
+ca/keyreadwriter_test.go, ca/auth tests, ca/server_test.go)."""
+import time
+
+import pytest
+
+from swarmkit_tpu.api.objects import Cluster, Node, RootCAObj
+from swarmkit_tpu.api.types import IssuanceState, NodeRole
+from swarmkit_tpu.ca import (
+    Caller,
+    CAServer,
+    CertificateError,
+    InvalidToken,
+    KeyReadWriter,
+    PermissionDenied,
+    RootCA,
+    SecurityConfig,
+    TLSRenewer,
+    authorize_forwarded,
+    authorize_roles,
+    caller_from_cert,
+    create_csr,
+    generate_join_token,
+    parse_join_token,
+)
+from swarmkit_tpu.ca.certificates import cert_expiry, renewal_due
+from swarmkit_tpu.store.memory import MemoryStore
+
+
+# -- RootCA / certificates ---------------------------------------------------
+
+
+def test_root_ca_create_and_sign():
+    root = RootCA.create("org1")
+    assert root.can_sign
+    key_pem, csr_pem = create_csr("node-1", NodeRole.WORKER, "org1")
+    cert_pem = root.sign_csr(csr_pem)
+    ident = root.verify_cert(cert_pem)
+    assert ident.node_id == "node-1"
+    assert ident.role == NodeRole.WORKER
+    assert ident.org == "org1"
+
+
+def test_verify_rejects_foreign_cert():
+    root_a, root_b = RootCA.create(), RootCA.create()
+    _, csr = create_csr("n", NodeRole.MANAGER, "org")
+    cert = root_a.sign_csr(csr)
+    with pytest.raises(CertificateError):
+        root_b.verify_cert(cert)
+
+
+def test_root_without_key_cannot_sign():
+    root = RootCA.create().without_key()
+    _, csr = create_csr("n", NodeRole.WORKER, "org")
+    with pytest.raises(CertificateError):
+        root.sign_csr(csr)
+
+
+def test_renewal_window():
+    root = RootCA.create()
+    _, csr = create_csr("n", NodeRole.WORKER, "org")
+    cert = root.sign_csr(csr, expiry=3600)
+    nb, na = cert_expiry(cert)
+    assert not renewal_due(cert, nb + 10)
+    assert renewal_due(cert, nb + (na - nb) * 0.75)
+
+
+# -- join tokens -------------------------------------------------------------
+
+
+def test_join_token_roundtrip():
+    root = RootCA.create()
+    tok = generate_join_token(root)
+    parsed = parse_join_token(tok)
+    assert parsed.root_digest == root.digest()
+    assert not parsed.fips
+    fips_tok = generate_join_token(root, fips=True)
+    assert parse_join_token(fips_tok).fips
+
+
+def test_join_token_malformed():
+    with pytest.raises(InvalidToken):
+        parse_join_token("SWMTKN-9-x-y")
+    with pytest.raises(InvalidToken):
+        parse_join_token("garbage")
+
+
+# -- KeyReadWriter -----------------------------------------------------------
+
+
+def test_keyreadwriter_plain_and_sealed(tmp_path):
+    path = str(tmp_path / "key.pem")
+    krw = KeyReadWriter(path)
+    krw.write(b"SECRET", {"raft-dek": "abc"})
+    key, headers = krw.read()
+    assert key == b"SECRET" and headers["raft-dek"] == "abc"
+
+    krw.rotate_kek(b"kek-1")
+    locked = KeyReadWriter(path)  # no KEK
+    with pytest.raises(PermissionError):
+        locked.read()
+    unlocked = KeyReadWriter(path, b"kek-1")
+    key, headers = unlocked.read()
+    assert key == b"SECRET" and headers["raft-dek"] == "abc"
+
+    unlocked.update_headers({"raft-dek": None, "pending": "p"})
+    _, headers = unlocked.read()
+    assert "raft-dek" not in headers and headers["pending"] == "p"
+
+
+# -- auth --------------------------------------------------------------------
+
+
+def test_authorize_roles():
+    mgr = Caller("m1", NodeRole.MANAGER, "org")
+    wrk = Caller("w1", NodeRole.WORKER, "org")
+    authorize_roles(mgr, [NodeRole.MANAGER])
+    with pytest.raises(PermissionDenied):
+        authorize_roles(wrk, [NodeRole.MANAGER])
+    with pytest.raises(PermissionDenied):
+        authorize_roles(mgr, [NodeRole.MANAGER], org="other")
+    with pytest.raises(PermissionDenied):
+        authorize_roles(None, [NodeRole.MANAGER])
+
+
+def test_authorize_forwarded():
+    mgr = Caller("m1", NodeRole.MANAGER, "org")
+    fwd = Caller("w1", NodeRole.WORKER, "org", forwarded_by=mgr)
+    assert authorize_forwarded(fwd, [NodeRole.WORKER]).node_id == "w1"
+    # a worker cannot assert forwarded identity
+    bad = Caller("w2", NodeRole.WORKER, "org", forwarded_by=Caller("w3", NodeRole.WORKER, "org"))
+    with pytest.raises(PermissionDenied):
+        authorize_forwarded(bad, [NodeRole.WORKER])
+
+
+def test_caller_from_cert():
+    root = RootCA.create("orgx")
+    _, csr = create_csr("node-9", NodeRole.MANAGER, "orgx")
+    cert = root.sign_csr(csr)
+    caller = caller_from_cert(cert)
+    assert caller.node_id == "node-9"
+    assert caller.role == NodeRole.MANAGER
+    assert caller.org == "orgx"
+
+
+# -- SecurityConfig / CAServer flow ------------------------------------------
+
+
+def _cluster_with_ca(store, root):
+    cluster = Cluster(id="cluster-1")
+    cluster.root_ca = RootCAObj(
+        ca_key_pem=root.key_pem or b"",
+        ca_cert_pem=root.cert_pem,
+        cert_digest=root.digest(),
+        join_token_worker=generate_join_token(root),
+        join_token_manager=generate_join_token(root),
+    )
+    store.update(lambda tx: tx.create(cluster))
+    return cluster
+
+
+def test_ca_server_join_flow():
+    store = MemoryStore()
+    root = RootCA.create()
+    cluster = _cluster_with_ca(store, root)
+    server = CAServer(store, root, "cluster-1")
+
+    # worker join: CSR + worker token → pending cert on a new Node
+    key_pem, csr_pem = create_csr("ignored", NodeRole.WORKER, "swarmkit-tpu")
+    node_id = server.issue_node_certificate(
+        csr_pem, token=cluster.root_ca.join_token_worker
+    )
+    server._sign_pending()
+    cert = server.node_certificate_status(node_id, timeout=2)
+    assert cert.status_state == IssuanceState.ISSUED
+    ident = root.verify_cert(cert.certificate_pem)
+    assert ident.role == NodeRole.WORKER
+
+    node = store.view(lambda tx: tx.get_node(node_id))
+    assert node.role == NodeRole.WORKER
+
+
+def test_ca_server_manager_token_and_bad_token():
+    store = MemoryStore()
+    root = RootCA.create()
+    cluster = _cluster_with_ca(store, root)
+    server = CAServer(store, root, "cluster-1")
+
+    _, csr = create_csr("x", NodeRole.MANAGER, "swarmkit-tpu")
+    node_id = server.issue_node_certificate(csr, token=cluster.root_ca.join_token_manager)
+    server._sign_pending()
+    cert = server.node_certificate_status(node_id, timeout=2)
+    assert cert.role == NodeRole.MANAGER
+
+    with pytest.raises(InvalidToken):
+        server.issue_node_certificate(csr, token=generate_join_token(root))
+    with pytest.raises(InvalidToken):
+        server.issue_node_certificate(csr, token=generate_join_token(RootCA.create()))
+
+
+def test_renewal_via_server():
+    store = MemoryStore()
+    root = RootCA.create()
+    _cluster_with_ca(store, root)
+    server = CAServer(store, root, "cluster-1")
+
+    cluster = store.view(lambda tx: tx.get_cluster("cluster-1"))
+    key_pem, csr_pem = create_csr("mgr-1", NodeRole.MANAGER, "swarmkit-tpu")
+    server.issue_node_certificate(
+        csr_pem, token=cluster.root_ca.join_token_manager, node_id="mgr-1"
+    )
+    server._sign_pending()
+    first = server.node_certificate_status("mgr-1", timeout=2)
+    sec2 = SecurityConfig(root, key_pem, first.certificate_pem)
+    renewer = TLSRenewer(sec2, server)
+    old_cert = sec2.key_and_cert()[1]
+    # renewer drives issue → sign → status → swap
+    import threading
+
+    ok_holder = {}
+
+    def renew():
+        ok_holder["ok"] = renewer.renew_once()
+
+    rt = threading.Thread(target=renew)
+    rt.start()
+    time.sleep(0.2)
+    server._sign_pending()
+    rt.join(timeout=5)
+    assert ok_holder.get("ok") is True
+    assert sec2.key_and_cert()[1] != old_cert
+    assert sec2.node_id() == "mgr-1"
+
+
+def test_root_rotation():
+    store = MemoryStore()
+    root = RootCA.create()
+    cluster = _cluster_with_ca(store, root)
+    server = CAServer(store, root, "cluster-1")
+
+    _, csr = create_csr("x", NodeRole.WORKER, "swarmkit-tpu")
+    node_id = server.issue_node_certificate(csr, token=cluster.root_ca.join_token_worker)
+    server._sign_pending()
+    old_digest = root.digest()
+
+    new_root = server.rotate_root_ca()
+    assert new_root.digest() != old_digest
+    server._sign_pending()
+    cert = server.node_certificate_status(node_id, timeout=2)
+    assert cert.status_state == IssuanceState.ISSUED
+    ident = new_root.verify_cert(cert.certificate_pem)
+    assert ident.node_id == node_id
+    # store tokens now pin the new root
+    cl = store.view(lambda tx: tx.get_cluster("cluster-1"))
+    assert parse_join_token(cl.root_ca.join_token_worker).root_digest == new_root.digest()
+
+
+def test_renewal_requires_identity():
+    """Renewal of an existing node without a token must present the node's
+    own identity (or a manager's) — ca/server.go:278-292."""
+    store = MemoryStore()
+    root = RootCA.create()
+    cluster = _cluster_with_ca(store, root)
+    server = CAServer(store, root, "cluster-1")
+
+    _, csr = create_csr("x", NodeRole.WORKER, "swarmkit-tpu")
+    node_id = server.issue_node_certificate(csr, token=cluster.root_ca.join_token_worker)
+    server._sign_pending()
+
+    _, csr2 = create_csr("x", NodeRole.WORKER, "swarmkit-tpu")
+    with pytest.raises(PermissionDenied):
+        server.issue_node_certificate(csr2, node_id=node_id)  # anonymous
+    with pytest.raises(PermissionDenied):
+        server.issue_node_certificate(
+            csr2, node_id=node_id, caller=Caller("other", NodeRole.WORKER, "swarmkit-tpu")
+        )
+    # the node itself and any manager may renew
+    server.issue_node_certificate(
+        csr2, node_id=node_id, caller=Caller(node_id, NodeRole.WORKER, "swarmkit-tpu")
+    )
+    server.issue_node_certificate(
+        csr2, node_id=node_id, caller=Caller("mgr", NodeRole.MANAGER, "swarmkit-tpu")
+    )
+
+
+def test_rotation_then_renewal_recovers_trust():
+    """After root rotation a renewing node must pick up the new root and
+    end with a cert verifiable under it (reference: phased root rotation,
+    ca/reconciler.go + RequestAndSaveNewCertificates root download)."""
+    store = MemoryStore()
+    root = RootCA.create()
+    cluster = _cluster_with_ca(store, root)
+    server = CAServer(store, root, "cluster-1")
+
+    key_pem, csr_pem = create_csr("mgr-1", NodeRole.MANAGER, "swarmkit-tpu")
+    server.issue_node_certificate(
+        csr_pem, token=cluster.root_ca.join_token_manager, node_id="mgr-1"
+    )
+    server._sign_pending()
+    first = server.node_certificate_status("mgr-1", timeout=2)
+    sec = SecurityConfig(root, key_pem, first.certificate_pem)
+
+    new_root = server.rotate_root_ca()
+    server._sign_pending()
+
+    renewer = TLSRenewer(sec, server)
+    import threading
+
+    done = {}
+    rt = threading.Thread(target=lambda: done.update(ok=renewer.renew_once()))
+    rt.start()
+    time.sleep(0.2)
+    server._sign_pending()
+    rt.join(timeout=5)
+    assert done.get("ok") is True
+    assert sec.root_ca.digest() == new_root.digest()
+    new_root.verify_cert(sec.key_and_cert()[1])
+
+
+def test_ca_server_watch_loop_signs():
+    store = MemoryStore()
+    root = RootCA.create()
+    cluster = _cluster_with_ca(store, root)
+    server = CAServer(store, root, "cluster-1")
+    server.start()
+    try:
+        _, csr = create_csr("x", NodeRole.WORKER, "swarmkit-tpu")
+        node_id = server.issue_node_certificate(csr, token=cluster.root_ca.join_token_worker)
+        cert = server.node_certificate_status(node_id, timeout=5)
+        assert cert.status_state == IssuanceState.ISSUED
+    finally:
+        server.stop()
