@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import datetime
 import hashlib
-import threading
 from dataclasses import dataclass
 
 from cryptography import x509
@@ -132,8 +131,6 @@ class RootCA:
         self.key_pem = key_pem
         self._cert = x509.load_pem_x509_certificate(cert_pem)
         self._key = key_from_pem(key_pem) if key_pem else None
-        self._lock = threading.Lock()
-        self._serial = 0
 
     # -- construction ------------------------------------------------------
 

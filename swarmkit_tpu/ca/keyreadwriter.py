@@ -52,12 +52,14 @@ class KeyReadWriter:
         }
         tmp = self.path + ".tmp"
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-        with open(tmp, "w") as f:
+        # 0600 from birth: the key must never be world-readable, even in the
+        # temp window (ioutils AtomicWriteFile + keyreadwriter.go perms)
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
             json.dump(rec, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)  # atomic (ioutils/ioutils.go AtomicWriteFile)
-        os.chmod(self.path, 0o600)
 
     def read(self) -> tuple[bytes, dict[str, str]]:
         with self._lock:

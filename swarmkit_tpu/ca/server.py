@@ -45,6 +45,7 @@ class CAServer:
     # -- service lifecycle -------------------------------------------------
 
     def start(self):
+        self._stop = threading.Event()  # restartable across leadership cycles
         self._thread = threading.Thread(target=self._run, name="ca-server", daemon=True)
         self._thread.start()
 
@@ -122,16 +123,23 @@ class CAServer:
             role = self._role_from_token(token)
         if node_id is None:
             node_id = new_id()
-        elif role is None:
-            # renewal path: authenticate the claimed identity
+        else:
+            # Targeting an existing node is a renewal regardless of whether a
+            # token was also presented: a join token must never authorize
+            # overwriting another node's cert/role (ca/server.go:278-292 —
+            # the TLS peer CN must match the renewed node).
             from ..api.types import NodeRole as _NR
 
-            if caller is None or (
-                caller.node_id != node_id and caller.role != _NR.MANAGER
+            exists = self.store.view(lambda tx: tx.get_node(node_id)) is not None
+            if exists and (
+                caller is None
+                or (caller.node_id != node_id and caller.role != _NR.MANAGER)
             ):
                 raise PermissionDenied(
                     f"renewal for {node_id} requires the node's own identity"
                 )
+            if not exists and role is None:
+                raise InvalidToken("unknown node and no join token")
 
         def txn(tx):
             node = tx.get_node(node_id)

@@ -93,6 +93,9 @@ class Dispatcher:
 
     # ------------------------------------------------------------- lifecycle
     def start(self):
+        # restartable across leadership cycles (manager.go recreates the
+        # dispatcher per leadership; in-process, agents hold this object)
+        self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="dispatcher")
         self._thread.start()

@@ -1,0 +1,18 @@
+"""Manager assembly + leader-only singletons (SURVEY.md §2.8)."""
+from .health import NOT_SERVING, SERVING, UNKNOWN, HealthServer
+from .keymanager import EncryptionKey, KeyManager
+from .manager import Manager
+from .metrics import MetricsCollector
+from .rolemanager import RoleManager
+
+__all__ = [
+    "NOT_SERVING",
+    "SERVING",
+    "UNKNOWN",
+    "HealthServer",
+    "EncryptionKey",
+    "KeyManager",
+    "Manager",
+    "MetricsCollector",
+    "RoleManager",
+]

@@ -1,0 +1,26 @@
+"""gRPC-health-semantics service (reference: manager/health/health.go:21+).
+
+Components register status by service name; `check` mirrors
+grpc.health.v1.Health/Check responses.
+"""
+from __future__ import annotations
+
+import threading
+
+SERVING = "SERVING"
+NOT_SERVING = "NOT_SERVING"
+UNKNOWN = "SERVICE_UNKNOWN"
+
+
+class HealthServer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._status: dict[str, str] = {"": SERVING}
+
+    def set_serving_status(self, service: str, status: str):
+        with self._lock:
+            self._status[service] = status
+
+    def check(self, service: str = "") -> str:
+        with self._lock:
+            return self._status.get(service, UNKNOWN)

@@ -1,0 +1,97 @@
+"""Cluster network-key rotation.
+
+Re-derivation of manager/keymanager/keymanager.go:47-233: the leader keeps a
+set of encryption keys for the data-plane overlay (gossip + IPSec subsystems)
+on the Cluster object, rotating them on a fixed period under a lamport clock
+so workers can agree on key ordering. Workers receive the keys through the
+dispatcher session (SessionMessage.network_bootstrap_keys).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+
+DEFAULT_KEY_LEN = 16
+DEFAULT_ROTATION_INTERVAL = 12 * 3600.0  # 12h (keymanager.go DefaultKeyRotationInterval)
+SUBSYSTEM_GOSSIP = "networking:gossip"
+SUBSYSTEM_IPSEC = "networking:ipsec"
+
+
+@dataclass
+class EncryptionKey:
+    subsystem: str
+    algorithm: str
+    key: bytes
+    lamport_time: int
+
+
+class KeyManager:
+    """Rotates cluster network bootstrap keys (keymanager.go KeyManager)."""
+
+    def __init__(
+        self,
+        store,
+        cluster_id: str,
+        rotation_interval: float = DEFAULT_ROTATION_INTERVAL,
+        subsystems: tuple[str, ...] = (SUBSYSTEM_GOSSIP, SUBSYSTEM_IPSEC),
+    ):
+        self.store = store
+        self.cluster_id = cluster_id
+        self.rotation_interval = rotation_interval
+        self.subsystems = subsystems
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        self.rotate_if_needed()
+        self._thread = threading.Thread(target=self._run, name="keymanager", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _run(self):
+        while not self._stop.wait(timeout=self.rotation_interval):
+            self.rotate()
+
+    def rotate_if_needed(self):
+        """Seed keys on first leadership if the cluster has none
+        (keymanager.go Run: keys are created lazily)."""
+        cluster = self.store.view(lambda tx: tx.get_cluster(self.cluster_id))
+        if cluster is None:
+            return
+        if not cluster.network_bootstrap_keys:
+            self.rotate()
+
+    def rotate(self):
+        """Generate one fresh key per subsystem; keep the previous key so
+        in-flight traffic still decrypts (keymanager.go rotateKey keeps 2)."""
+
+        def txn(tx):
+            cluster = tx.get_cluster(self.cluster_id)
+            if cluster is None:
+                return
+            clock = cluster.encryption_key_lamport_clock + 1
+            new_keys = [
+                EncryptionKey(
+                    subsystem=s,
+                    algorithm="aes-128-gcm",
+                    key=os.urandom(DEFAULT_KEY_LEN),
+                    lamport_time=clock,
+                )
+                for s in self.subsystems
+            ]
+            # retain at most one previous generation per subsystem
+            prev = [
+                k
+                for k in cluster.network_bootstrap_keys
+                if k.lamport_time == cluster.encryption_key_lamport_clock
+            ]
+            cluster.network_bootstrap_keys = prev + new_keys
+            cluster.encryption_key_lamport_clock = clock
+            tx.update(cluster)
+
+        self.store.update(txn)

@@ -1,0 +1,258 @@
+"""Manager assembly: wire every service, drive the leadership lifecycle.
+
+Re-derivation of manager/manager.go: `Manager` owns the store, the API
+services (control/watch/dispatcher/CA/health/logbroker/resource), and — only
+while raft leader — the control-plane components (scheduler, orchestrators,
+allocator, task reaper, enforcers, key manager, role manager, metrics).
+`become_leader` (manager.go:926-1146) seeds the default cluster + ingress
+network and starts each component; `become_follower` (:1149+) stops them.
+Without a raft node the manager runs standalone and is always the leader
+(the single-manager dev topology).
+"""
+from __future__ import annotations
+
+import threading
+
+from ..allocator.allocator import Allocator
+from ..api.objects import Cluster, Network, RootCAObj
+from ..api.specs import Annotations, ClusterSpec, NetworkSpec
+from ..ca import CAServer, RootCA, SecurityConfig, generate_join_token
+from ..controlapi.control import ControlAPI
+from ..dispatcher.dispatcher import Dispatcher
+from ..logbroker.broker import LogBroker
+from ..orchestrator.enforcers import ConstraintEnforcer, VolumeEnforcer
+from ..orchestrator.global_ import GlobalOrchestrator
+from ..orchestrator.jobs import JobsOrchestrator
+from ..orchestrator.replicated import ReplicatedOrchestrator
+from ..orchestrator.taskreaper import TaskReaper
+from ..resourceapi.allocator import ResourceAllocator
+from ..scheduler.scheduler import Scheduler
+from ..store.memory import MemoryStore
+from ..utils.identity import new_id
+from ..watchapi.watch import WatchAPI
+from .health import NOT_SERVING, SERVING, HealthServer
+from .keymanager import KeyManager
+from .metrics import MetricsCollector
+from .rolemanager import RoleManager
+
+DEFAULT_CLUSTER_NAME = "default"
+INGRESS_NETWORK_NAME = "ingress"
+
+
+class Manager:
+    """One manager process (manager/manager.go Manager)."""
+
+    def __init__(
+        self,
+        store: MemoryStore | None = None,
+        security: SecurityConfig | None = None,
+        raft_node=None,
+        cluster_id: str | None = None,
+        org: str = "swarmkit-tpu",
+        heartbeat_period: float = 5.0,
+        key_rotation_interval: float = 12 * 3600.0,
+    ):
+        self.store = store if store is not None else MemoryStore()
+        self.security = security
+        self.raft = raft_node
+        self.cluster_id = cluster_id or new_id()
+        self.org = org
+        self._lock = threading.Lock()
+        self._is_leader = False
+        self._started = False
+
+        # always-on API surface (served by every manager; writes are
+        # forwarded to the leader by the raft proxy layer in manager.go —
+        # our in-process store+proposer already routes writes through raft)
+        self.control_api = ControlAPI(self.store)
+        self.watch_api = WatchAPI(self.store)
+        self.dispatcher = Dispatcher(self.store, heartbeat_period=heartbeat_period)
+        self.log_broker = LogBroker(self.store)
+        self.resource_api = ResourceAllocator(self.store)
+        self.health = HealthServer()
+
+        # root CA: from the security config's root, or created fresh
+        if security is not None and security.root_ca.can_sign:
+            root = security.root_ca
+        else:
+            root = RootCA.create(org)
+        self.ca_server = CAServer(self.store, root, self.cluster_id, org=org)
+
+        # leader-only components, created on become_leader
+        self._leader_components: list = []
+        self.key_rotation_interval = key_rotation_interval
+
+        if self.raft is not None:
+            self.raft.on_leadership = self._on_leadership
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        """manager.go Run:441-641 — bring up servers; leadership decides the
+        control plane."""
+        with self._lock:
+            if self._started:
+                return
+            self._started = True
+        self.health.set_serving_status("manager", SERVING)
+        if self.raft is None:
+            self._on_leadership(True)
+        elif getattr(self.raft, "role", None) == "leader":
+            self._on_leadership(True)
+
+    def stop(self):
+        self.health.set_serving_status("manager", NOT_SERVING)
+        self._on_leadership(False)
+        with self._lock:
+            self._started = False
+
+    @property
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self._is_leader
+
+    @property
+    def root(self) -> RootCA:
+        """The live signing root — tracks CAServer root rotation; never
+        cache this (stale roots mint tokens no joiner can use)."""
+        return self.ca_server.root
+
+    # -- leadership --------------------------------------------------------
+
+    def _on_leadership(self, is_leader: bool):
+        with self._lock:
+            if is_leader == self._is_leader:
+                return
+            self._is_leader = is_leader
+        if is_leader:
+            self._become_leader()
+        else:
+            self._become_follower()
+
+    def _become_leader(self):
+        """manager.go becomeLeader:926-1146."""
+        self._seed_cluster_objects()
+
+        components = [
+            self.dispatcher,
+            self.ca_server,
+            self.log_broker,
+            Allocator(self.store),
+            Scheduler(self.store),
+            ReplicatedOrchestrator(self.store),
+            GlobalOrchestrator(self.store),
+            JobsOrchestrator(self.store),
+            TaskReaper(self.store),
+            ConstraintEnforcer(self.store),
+            VolumeEnforcer(self.store),
+            KeyManager(
+                self.store, self.cluster_id, rotation_interval=self.key_rotation_interval
+            ),
+            RoleManager(self.store, raft_node=self.raft),
+            MetricsCollector(self.store),
+        ]
+        for c in components:
+            c.start()
+        with self._lock:
+            self._leader_components = components
+        self.health.set_serving_status("leader", SERVING)
+
+    def _become_follower(self):
+        """manager.go becomeFollower — tear down leader-only components."""
+        with self._lock:
+            components, self._leader_components = self._leader_components, []
+        for c in reversed(components):
+            try:
+                c.stop()
+            except Exception:
+                pass
+        self.health.set_serving_status("leader", NOT_SERVING)
+
+    # -- convenience handles for components started per-leadership ---------
+
+    def _component(self, cls):
+        with self._lock:
+            for c in self._leader_components:
+                if isinstance(c, cls):
+                    return c
+        return None
+
+    @property
+    def scheduler(self):
+        return self._component(Scheduler)
+
+    @property
+    def metrics(self):
+        return self._component(MetricsCollector)
+
+    @property
+    def key_manager(self):
+        return self._component(KeyManager)
+
+    @property
+    def role_manager(self):
+        return self._component(RoleManager)
+
+    # -- seeding -----------------------------------------------------------
+
+    def _seed_cluster_objects(self):
+        """Seed the default Cluster (with CA material + join tokens) and the
+        ingress network (manager.go becomeLeader:951-1010,
+        defaultClusterObject:1194+)."""
+
+        def txn(tx):
+            cluster = tx.get_cluster(self.cluster_id)
+            if cluster is None:
+                cluster = Cluster(
+                    id=self.cluster_id,
+                    spec=ClusterSpec(
+                        annotations=Annotations(name=DEFAULT_CLUSTER_NAME)
+                    ),
+                )
+                cluster.root_ca = RootCAObj(
+                    ca_key_pem=self.root.key_pem or b"",
+                    ca_cert_pem=self.root.cert_pem,
+                    cert_digest=self.root.digest(),
+                    join_token_worker=generate_join_token(self.root),
+                    join_token_manager=generate_join_token(self.root),
+                )
+                tx.create(cluster)
+
+            ingress = [
+                n
+                for n in tx.find_networks()
+                if n.spec.ingress or n.spec.annotations.name == INGRESS_NETWORK_NAME
+            ]
+            if not ingress:
+                tx.create(
+                    Network(
+                        id=new_id(),
+                        spec=NetworkSpec(
+                            annotations=Annotations(name=INGRESS_NETWORK_NAME),
+                            ingress=True,
+                        ),
+                    )
+                )
+
+        self.store.update(txn)
+
+    # -- token rotation (controlapi cluster.go UpdateCluster rotation) -----
+
+    def rotate_join_token(self, role: str) -> str:
+        """role ∈ {'worker','manager'}; returns the new token."""
+        token = generate_join_token(self.root)
+
+        def txn(tx):
+            cluster = tx.get_cluster(self.cluster_id)
+            if cluster is None or cluster.root_ca is None:
+                raise KeyError("cluster not seeded")
+            if role == "worker":
+                cluster.root_ca.join_token_worker = token
+            elif role == "manager":
+                cluster.root_ca.join_token_manager = token
+            else:
+                raise ValueError(f"unknown role {role!r}")
+            tx.update(cluster)
+
+        self.store.update(txn)
+        return token

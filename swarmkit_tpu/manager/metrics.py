@@ -1,0 +1,126 @@
+"""Cluster metrics collector.
+
+Re-derivation of manager/metrics/collector.go:28-256: maintains object-count
+and node-state gauges from the store's event stream (snapshot, then
+incremental updates). Exposes a dict snapshot plus Prometheus text
+exposition, the in-process stand-in for the reference's prometheus registry.
+"""
+from __future__ import annotations
+
+import threading
+from collections import Counter
+
+from ..api.objects import (
+    ALL_TABLES,
+    EventCreate,
+    EventDelete,
+    EventUpdate,
+    Node,
+)
+from ..api.types import NodeStatusState
+from ..store import by
+from ..store.watch import ChannelClosed
+
+
+class MetricsCollector:
+    def __init__(self, store):
+        self.store = store
+        self._lock = threading.Lock()
+        self._objects: Counter = Counter()  # table -> count
+        self._node_states: Counter = Counter()  # NodeStatusState name -> count
+        self._node_state_by_id: dict[str, str] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, name="metrics", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # -- readout -----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "objects": dict(self._objects),
+                "node_states": {k: v for k, v in self._node_states.items() if v},
+            }
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition (collector.go gauge names)."""
+        snap = self.snapshot()
+        lines = []
+        for table, n in sorted(snap["objects"].items()):
+            lines.append(f'swarm_manager_{table}s{{}} {n}')
+        for state, n in sorted(snap["node_states"].items()):
+            lines.append(f'swarm_node_info{{state="{state.lower()}"}} {n}')
+        return "\n".join(lines) + "\n"
+
+    # -- internals ---------------------------------------------------------
+
+    def _resync(self):
+        with self._lock:
+            self._objects.clear()
+            self._node_states.clear()
+            self._node_state_by_id.clear()
+
+            def scan(tx):
+                for cls in ALL_TABLES.values():
+                    objs = tx.find(cls, by.All())
+                    self._objects[cls.TABLE] = len(objs)
+                    if cls is Node:
+                        for n in objs:
+                            state = NodeStatusState(n.status.state).name
+                            self._node_state_by_id[n.id] = state
+                            self._node_states[state] += 1
+
+            self.store.view(scan)
+
+    def _run(self):
+        queue = self.store.watch_queue()
+        ch = queue.watch()
+        try:
+            self._resync()
+            while not self._stop.is_set():
+                try:
+                    ev = ch.get(timeout=0.2)
+                except TimeoutError:
+                    continue
+                except ChannelClosed:
+                    queue.stop_watch(ch)
+                    ch = queue.watch()
+                    self._resync()
+                    continue
+                self._apply(ev)
+        finally:
+            queue.stop_watch(ch)
+
+    def _apply(self, ev):
+        obj = getattr(ev, "obj", None)
+        if obj is None:
+            return
+        table = getattr(obj, "TABLE", None)
+        if table is None:
+            return
+        with self._lock:
+            if isinstance(ev, EventCreate):
+                self._objects[table] += 1
+            elif isinstance(ev, EventDelete):
+                self._objects[table] = max(0, self._objects[table] - 1)
+            if isinstance(obj, Node):
+                if isinstance(ev, EventDelete):
+                    old = self._node_state_by_id.pop(obj.id, None)
+                    if old:
+                        self._node_states[old] -= 1
+                else:
+                    new_state = NodeStatusState(obj.status.state).name
+                    old = self._node_state_by_id.get(obj.id)
+                    if old != new_state:
+                        if old:
+                            self._node_states[old] -= 1
+                        self._node_states[new_state] += 1
+                        self._node_state_by_id[obj.id] = new_state

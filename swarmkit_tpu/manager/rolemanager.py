@@ -1,0 +1,101 @@
+"""Role reconciliation: desired role → observed role.
+
+Re-derivation of manager/role_manager.go:26-282: watches nodes whose
+`spec.desired_role` differs from their observed (cert) role. Promotion marks
+the cert for renewal as a manager cert; demotion first removes the node from
+the raft member list — refusing when that would break quorum
+(CanRemoveMember, raft.go:1170-1193) — then demotes the cert.
+"""
+from __future__ import annotations
+
+import threading
+
+from ..api.objects import EventCreate, EventUpdate, Node
+from ..api.types import IssuanceState, NodeRole
+from ..store import by
+from ..store.watch import ChannelClosed
+
+
+class RoleManager:
+    def __init__(self, store, raft_node=None, reconcile_interval: float = 0.2):
+        """`raft_node` (optional) must expose `can_remove_member(node_id)`
+        and `remove_member_by_node_id(node_id)`; without raft (single-manager
+        dev mode) demotion skips the membership step."""
+        self.store = store
+        self.raft = raft_node
+        self.reconcile_interval = reconcile_interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # nodes whose demotion is blocked on quorum; retried each interval
+        self._pending: set[str] = set()
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, name="role-manager", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _run(self):
+        queue = self.store.watch_queue()
+        ch = queue.watch()
+        try:
+            for node in self.store.view(lambda tx: tx.find_nodes(by.All())):
+                self._reconcile(node.id)
+            while not self._stop.is_set():
+                try:
+                    ev = ch.get(timeout=self.reconcile_interval)
+                except TimeoutError:
+                    for node_id in list(self._pending):
+                        self._reconcile(node_id)
+                    continue
+                except ChannelClosed:
+                    queue.stop_watch(ch)
+                    ch = queue.watch()
+                    for node in self.store.view(lambda tx: tx.find_nodes(by.All())):
+                        self._reconcile(node.id)
+                    continue
+                if isinstance(ev, (EventCreate, EventUpdate)) and isinstance(ev.obj, Node):
+                    self._reconcile(ev.obj.id)
+        finally:
+            queue.stop_watch(ch)
+
+    def _reconcile(self, node_id: str):
+        node = self.store.view(lambda tx: tx.get_node(node_id))
+        if node is None:
+            self._pending.discard(node_id)
+            return
+        desired = node.spec.desired_role
+        if node.role == desired:
+            self._pending.discard(node_id)
+            return
+
+        if desired == NodeRole.WORKER:
+            # demotion: clear raft membership first (role_manager.go:154-214);
+            # if the conf change fails (quorum, leadership loss, timeout) the
+            # demotion is retried later — never demote a live raft member
+            if self.raft is not None and self.raft.is_member(node_id):
+                if not self.raft.can_remove_member(node_id):
+                    self._pending.add(node_id)
+                    return
+                if not self.raft.remove_member_by_node_id(node_id):
+                    self._pending.add(node_id)
+                    return
+
+        def txn(tx):
+            n = tx.get_node(node_id)
+            if n is None or n.spec.desired_role == n.role:
+                return
+            n.role = n.spec.desired_role
+            if n.certificate is not None and n.certificate.csr_pem:
+                # force re-issue under the new role's OU
+                n.certificate.role = n.spec.desired_role
+                n.certificate.status_state = IssuanceState.RENEW
+            if n.spec.desired_role == NodeRole.WORKER:
+                n.manager_status = None
+            tx.update(n)
+
+        self.store.update(txn)
+        self._pending.discard(node_id)

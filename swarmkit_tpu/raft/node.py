@@ -178,6 +178,61 @@ class RaftNode:
         """Force an immediate election (tests / bootstrap)."""
         self._inbox.put(("campaign",))
 
+    # -------------------------------------------------- node-id membership
+    # (reference: manager/state/raft/membership/cluster.go keeps the
+    # raft-id ↔ node-id registry; role manager addresses members by node id)
+
+    def member_by_node_id(self, node_id: str) -> Peer | None:
+        members = self.members  # snapshot: membership is copy-on-write
+        for p in members.values():
+            if p.node_id == node_id:
+                return p
+        return None
+
+    def is_member(self, node_id: str) -> bool:
+        return self.member_by_node_id(node_id) is not None
+
+    def can_remove_member(self, node_id: str) -> bool:
+        members = self.members  # snapshot: membership is copy-on-write
+        peer = None
+        for p in members.values():
+            if p.node_id == node_id:
+                peer = p
+                break
+        if peer is None:
+            return True  # nothing to remove
+        remaining = [p for p in members if p != peer.raft_id]
+        if not remaining:
+            return False
+        reachable = sum(
+            1 for p in remaining if p == self.id or self.transport.active(p)
+        )
+        return reachable >= len(remaining) // 2 + 1
+
+    def remove_member_by_node_id(self, node_id: str, timeout: float = 10.0) -> bool:
+        """Propose removal of the member with this node id, blocking until
+        the conf change commits (reference raft.go Leave/RemoveMember)."""
+        peer = self.member_by_node_id(node_id)
+        if peer is None:
+            return True
+        done = threading.Event()
+        result: dict[str, Any] = {}
+
+        def cb(ok, err=""):
+            result["ok"] = ok
+            result["err"] = err
+            done.set()
+
+        from ..utils.identity import new_id as _new_id
+
+        self.propose_conf_change(
+            ConfChange(action="remove", raft_id=peer.raft_id, node_id=node_id),
+            _new_id(),
+            cb,
+        )
+        done.wait(timeout)
+        return bool(result.get("ok"))
+
     # ------------------------------------------------------------ event loop
     def _run(self):
         while not self._stopped.is_set():
@@ -539,14 +594,21 @@ class RaftNode:
         self._maybe_snapshot()
 
     def _apply_conf_change(self, e: Entry):
+        # membership is updated copy-on-write: cross-thread readers (role
+        # manager via member_by_node_id/can_remove_member) snapshot the dict
+        # reference and iterate safely without locks
         cc: ConfChange = e.data
         if cc.action == "add":
-            self.members[cc.raft_id] = Peer(cc.raft_id, cc.node_id, cc.addr)
+            members = dict(self.members)
+            members[cc.raft_id] = Peer(cc.raft_id, cc.node_id, cc.addr)
+            self.members = members
             if self.role == LEADER and cc.raft_id != self.id:
                 self.next_index.setdefault(cc.raft_id, self._last_index() + 1)
                 self.match_index.setdefault(cc.raft_id, 0)
         elif cc.action == "remove":
-            self.members.pop(cc.raft_id, None)
+            members = dict(self.members)
+            members.pop(cc.raft_id, None)
+            self.members = members
             self.next_index.pop(cc.raft_id, None)
             self.match_index.pop(cc.raft_id, None)
             if cc.raft_id == self.id:

@@ -1,0 +1,280 @@
+"""Manager assembly + leader-only singletons (reference model:
+manager/manager.go leadership tests, manager/keymanager, role_manager,
+metrics/collector tests)."""
+import time
+
+import pytest
+
+from swarmkit_tpu.agent.agent import Agent
+from swarmkit_tpu.agent.testutils import FakeExecutor
+from swarmkit_tpu.api.objects import Node, Service
+from swarmkit_tpu.api.specs import Annotations, ServiceSpec
+from swarmkit_tpu.api.types import NodeRole, NodeStatusState, TaskState
+from swarmkit_tpu.manager import (
+    SERVING,
+    HealthServer,
+    KeyManager,
+    Manager,
+    MetricsCollector,
+    RoleManager,
+)
+from swarmkit_tpu.store import by
+from swarmkit_tpu.store.memory import MemoryStore
+
+from test_scheduler import wait_for
+
+
+# -- Manager standalone lifecycle -------------------------------------------
+
+
+def test_manager_standalone_becomes_leader_and_seeds():
+    m = Manager(key_rotation_interval=3600.0)
+    m.start()
+    try:
+        assert m.is_leader
+        cluster = m.store.view(lambda tx: tx.get_cluster(m.cluster_id))
+        assert cluster is not None
+        assert cluster.root_ca.join_token_worker.startswith("SWMTKN-1-")
+        assert cluster.root_ca.cert_digest == m.ca_server.root.digest()
+        # ingress network seeded
+        nets = m.store.view(lambda tx: tx.find_networks())
+        assert any(n.spec.ingress for n in nets)
+        # keymanager seeded network bootstrap keys
+        assert wait_for(
+            lambda: len(
+                m.store.view(lambda tx: tx.get_cluster(m.cluster_id)).network_bootstrap_keys
+            )
+            == 2,
+            timeout=5,
+        )
+        assert m.health.check("manager") == SERVING
+        assert m.health.check("leader") == SERVING
+    finally:
+        m.stop()
+    assert m.health.check("leader") != SERVING
+
+
+def test_manager_runs_full_control_loop():
+    """A service created through the manager's control API reaches RUNNING
+    on agents attached to the manager's dispatcher."""
+    m = Manager(heartbeat_period=0.5, key_rotation_interval=3600.0)
+    m.start()
+    agents = []
+    try:
+        for i in range(2):
+            ex = FakeExecutor({"*": {"run_forever": True}}, hostname=f"w{i}")
+            a = Agent(f"w{i}", m.dispatcher, ex)
+            a.start()
+            agents.append(a)
+
+        svc = Service(id="svc-a")
+        svc.spec = ServiceSpec(annotations=Annotations(name="a"), replicas=4)
+        svc.spec_version.index = 1
+        created = m.control_api.create_service(svc.spec)
+
+        def running():
+            return [
+                t
+                for t in m.store.view().find_tasks(by.ByServiceID(created.id))
+                if t.status.state == TaskState.RUNNING
+            ]
+
+        assert wait_for(lambda: len(running()) == 4, timeout=15)
+    finally:
+        for a in agents:
+            a.stop()
+        m.stop()
+
+
+def test_manager_leadership_cycle_stops_components():
+    m = Manager(key_rotation_interval=3600.0)
+    m.start()
+    try:
+        assert m.scheduler is not None
+        m._on_leadership(False)
+        assert m.scheduler is None
+        assert not m.is_leader
+        m._on_leadership(True)
+        assert m.scheduler is not None
+    finally:
+        m.stop()
+
+
+def test_rotate_join_token():
+    m = Manager(key_rotation_interval=3600.0)
+    m.start()
+    try:
+        old = m.store.view(
+            lambda tx: tx.get_cluster(m.cluster_id)
+        ).root_ca.join_token_worker
+        new = m.rotate_join_token("worker")
+        assert new != old
+        cur = m.store.view(
+            lambda tx: tx.get_cluster(m.cluster_id)
+        ).root_ca.join_token_worker
+        assert cur == new
+        with pytest.raises(ValueError):
+            m.rotate_join_token("bogus")
+    finally:
+        m.stop()
+
+
+# -- KeyManager --------------------------------------------------------------
+
+
+def test_keymanager_rotation_keeps_previous_generation():
+    from swarmkit_tpu.api.objects import Cluster
+
+    store = MemoryStore()
+    store.update(lambda tx: tx.create(Cluster(id="c1")))
+    km = KeyManager(store, "c1", rotation_interval=3600.0)
+    km.rotate_if_needed()
+    c = store.view(lambda tx: tx.get_cluster("c1"))
+    assert len(c.network_bootstrap_keys) == 2
+    assert c.encryption_key_lamport_clock == 1
+
+    km.rotate()
+    c = store.view(lambda tx: tx.get_cluster("c1"))
+    # 2 new + 2 previous-generation keys
+    assert len(c.network_bootstrap_keys) == 4
+    assert c.encryption_key_lamport_clock == 2
+    times = sorted({k.lamport_time for k in c.network_bootstrap_keys})
+    assert times == [1, 2]
+
+    km.rotate()
+    c = store.view(lambda tx: tx.get_cluster("c1"))
+    assert len(c.network_bootstrap_keys) == 4
+    assert sorted({k.lamport_time for k in c.network_bootstrap_keys}) == [2, 3]
+
+
+# -- RoleManager -------------------------------------------------------------
+
+
+def test_rolemanager_promote_demote():
+    store = MemoryStore()
+    n = Node(id="n1")
+    n.role = NodeRole.WORKER
+    n.spec.desired_role = NodeRole.WORKER
+    store.update(lambda tx: tx.create(n))
+
+    rm = RoleManager(store, raft_node=None, reconcile_interval=0.05)
+    rm.start()
+    try:
+        # promote
+        def promote(tx):
+            node = tx.get_node("n1")
+            node.spec.desired_role = NodeRole.MANAGER
+            tx.update(node)
+
+        store.update(promote)
+        assert wait_for(
+            lambda: store.view(lambda tx: tx.get_node("n1")).role == NodeRole.MANAGER,
+            timeout=5,
+        )
+
+        # demote
+        def demote(tx):
+            node = tx.get_node("n1")
+            node.spec.desired_role = NodeRole.WORKER
+            tx.update(node)
+
+        store.update(demote)
+        assert wait_for(
+            lambda: store.view(lambda tx: tx.get_node("n1")).role == NodeRole.WORKER,
+            timeout=5,
+        )
+    finally:
+        rm.stop()
+
+
+class _FakeRaft:
+    def __init__(self, members, removable=True):
+        self._members = set(members)
+        self.removable = removable
+        self.removed = []
+
+    def is_member(self, node_id):
+        return node_id in self._members
+
+    def can_remove_member(self, node_id):
+        return self.removable
+
+    def remove_member_by_node_id(self, node_id):
+        self._members.discard(node_id)
+        self.removed.append(node_id)
+        return True
+
+
+def test_rolemanager_demotion_blocked_then_unblocked():
+    store = MemoryStore()
+    n = Node(id="m1")
+    n.role = NodeRole.MANAGER
+    n.spec.desired_role = NodeRole.WORKER
+    store.update(lambda tx: tx.create(n))
+
+    raft = _FakeRaft({"m1"}, removable=False)
+    rm = RoleManager(store, raft_node=raft, reconcile_interval=0.05)
+    rm.start()
+    try:
+        time.sleep(0.3)
+        # still a manager: quorum would break
+        assert store.view(lambda tx: tx.get_node("m1")).role == NodeRole.MANAGER
+        raft.removable = True
+        assert wait_for(
+            lambda: store.view(lambda tx: tx.get_node("m1")).role == NodeRole.WORKER,
+            timeout=5,
+        )
+        assert raft.removed == ["m1"]
+    finally:
+        rm.stop()
+
+
+# -- MetricsCollector --------------------------------------------------------
+
+
+def test_metrics_collector_counts():
+    store = MemoryStore()
+    mc = MetricsCollector(store)
+    mc.start()
+    try:
+        svc = Service(id="s1")
+        svc.spec = ServiceSpec(annotations=Annotations(name="s"))
+        store.update(lambda tx: tx.create(svc))
+        n = Node(id="n1")
+        n.status.state = NodeStatusState.READY
+        store.update(lambda tx: tx.create(n))
+
+        assert wait_for(
+            lambda: mc.snapshot()["objects"].get("service") == 1
+            and mc.snapshot()["objects"].get("node") == 1,
+            timeout=5,
+        )
+        assert mc.snapshot()["node_states"].get("READY") == 1
+
+        def down(tx):
+            node = tx.get_node("n1")
+            node.status.state = NodeStatusState.DOWN
+            tx.update(node)
+
+        store.update(down)
+        assert wait_for(
+            lambda: mc.snapshot()["node_states"].get("DOWN") == 1, timeout=5
+        )
+        assert not mc.snapshot()["node_states"].get("READY")
+
+        store.update(lambda tx: tx.delete(Node, "n1"))
+        assert wait_for(
+            lambda: mc.snapshot()["objects"].get("node") == 0, timeout=5
+        )
+        text = mc.prometheus_text()
+        assert "swarm_manager_services{} 1" in text
+    finally:
+        mc.stop()
+
+
+def test_health_server():
+    h = HealthServer()
+    assert h.check() == SERVING
+    assert h.check("nope") == "SERVICE_UNKNOWN"
+    h.set_serving_status("x", SERVING)
+    assert h.check("x") == SERVING
