@@ -25,10 +25,11 @@ BACKOFF_MAX = 8.0
 
 class Agent:
     def __init__(self, node_id: str, dispatcher, executor,
-                 state_path: str | None = None):
+                 state_path: str | None = None, log_broker=None):
         self.node_id = node_id
         self.dispatcher = dispatcher
         self.executor = executor
+        self.log_broker = log_broker
         self.worker = Worker(executor, self._enqueue_status, state_path)
         self.session_id: str | None = None
         self._pending: dict[str, TaskStatus] = {}
@@ -42,6 +43,52 @@ class Agent:
                              name=f"agent-{self.node_id[:8]}")
         t.start()
         self._threads.append(t)
+        if self.log_broker is not None:
+            lt = threading.Thread(target=self._listen_subscriptions, daemon=True,
+                                  name=f"agent-logs-{self.node_id[:8]}")
+            lt.start()
+            self._threads.append(lt)
+
+    def _listen_subscriptions(self):
+        """Consume log-subscription messages from the broker and pump
+        matching task logs back (reference agent/agent.go subscriptions +
+        worker.Subscribe). The reference streams continuously; controllers
+        here surface their buffered logs per subscription event."""
+        from ..logbroker.broker import make_log_message
+        from ..store.watch import ChannelClosed
+
+        ch = self.log_broker.listen_subscriptions(self.node_id)
+        active: set[str] = set()
+        while not self._stop.is_set():
+            try:
+                msg = ch.get(timeout=0.2)
+            except TimeoutError:
+                continue
+            except ChannelClosed:
+                # broker restarted (leadership flap) or channel overflow:
+                # re-listen, like the session reconnect loop does
+                if self._stop.wait(timeout=0.2):
+                    return
+                ch = self.log_broker.listen_subscriptions(self.node_id)
+                active.clear()
+                continue
+            if msg.close:
+                active.discard(msg.id)
+                continue
+            if msg.id in active:
+                continue
+            active.add(msg.id)
+            sub_id = msg.id
+
+            def publish(task, stream, data, sub_id=sub_id):
+                self.log_broker.publish_logs(
+                    sub_id, [make_log_message(task, stream, data)]
+                )
+
+            try:
+                self.worker.subscribe_logs(msg.selector, publish)
+            except Exception:
+                pass
 
     def stop(self):
         self._stop.set()

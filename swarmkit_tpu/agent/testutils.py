@@ -55,6 +55,18 @@ class FakeController:
     def remove(self):
         pass
 
+    def logs(self):
+        """Buffered log lines for LogBroker tests; behavior key `logs` is a
+        list of str/bytes (stdout) or (stream, bytes) tuples."""
+        for entry in self.behavior.get("logs", []):
+            if isinstance(entry, tuple):
+                stream, data = entry
+            else:
+                stream, data = "stdout", entry
+            if isinstance(data, str):
+                data = data.encode()
+            yield stream, data
+
     def close(self):
         self.closed = True
         self._exit.set()
@@ -82,7 +94,9 @@ class FakeExecutor:
         pass
 
     def controller(self, task: Task) -> FakeController:
-        behavior = self.behavior_for.get(task.service_id, {})
+        behavior = self.behavior_for.get(
+            task.service_id, self.behavior_for.get("*", {})
+        )
         c = FakeController(task, dict(behavior))
         with self._lock:
             self.controllers.append(c)

@@ -157,6 +157,29 @@ class Worker:
                 self._start_or_update(task)
         self._persist()
 
+    def subscribe_logs(self, selector, publish) -> int:
+        """Pump logs for this worker's tasks matching `selector` through
+        `publish(task, stream, data)` (reference worker.go Subscribe:596 →
+        taskManager log attachment). Returns the number of tasks matched.
+        Controllers opt in by exposing `logs() -> iterable[(stream, bytes)]`."""
+        with self._lock:
+            managers = list(self._managers.values())
+        matched = 0
+        for mgr in managers:
+            t = mgr.task
+            if (
+                t.id in selector.task_ids
+                or t.service_id in selector.service_ids
+                or t.node_id in selector.node_ids
+            ):
+                logs_fn = getattr(mgr.controller, "logs", None)
+                if logs_fn is None:
+                    continue
+                matched += 1
+                for stream, data in logs_fn():
+                    publish(t, stream, data)
+        return matched
+
     def update(self, changes):
         """Incremental diff (reference worker.go:168-196)."""
         with self._lock:

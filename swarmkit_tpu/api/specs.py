@@ -173,10 +173,20 @@ class ContainerSpec:
 
 
 @dataclass
+class NetworkAttachmentSpec:
+    """Attachment-task runtime: bind an existing engine container to a
+    cluster network (reference: api/specs.proto NetworkAttachmentSpec)."""
+
+    container_id: str = ""
+
+
+@dataclass
 class TaskSpec:
-    """reference: api/specs.proto TaskSpec."""
+    """reference: api/specs.proto TaskSpec (oneof runtime:
+    container | attachment)."""
 
     runtime: ContainerSpec | None = None
+    attachment: NetworkAttachmentSpec | None = None
     resources: ResourceRequirements = field(default_factory=ResourceRequirements)
     restart: RestartPolicy = field(default_factory=RestartPolicy)
     placement: Placement = field(default_factory=Placement)

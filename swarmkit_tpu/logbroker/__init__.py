@@ -1,0 +1,18 @@
+"""Brokered task-log streaming (reference: manager/logbroker/, SURVEY.md §2.7)."""
+from .broker import (
+    LogBroker,
+    LogContext,
+    LogMessage,
+    LogSelector,
+    SubscriptionMessage,
+    make_log_message,
+)
+
+__all__ = [
+    "LogBroker",
+    "LogContext",
+    "LogMessage",
+    "LogSelector",
+    "SubscriptionMessage",
+    "make_log_message",
+]

@@ -1,0 +1,225 @@
+"""LogBroker: brokered task-log streaming.
+
+Re-derivation of manager/logbroker/broker.go:104-440: a client
+`subscribe_logs` names targets by service/task/node selector; the broker
+fans a subscription out to the agents that run matching tasks
+(`listen_subscriptions` — the agent-facing LogBroker.ListenSubscriptions
+stream); agents pump task logs back via `publish_logs`, and the broker
+routes them into the client's stream. Subscriptions follow task movement:
+new tasks for a followed service pull newly-involved nodes into the
+subscription (broker.go subscription.Run watchers).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..api.objects import EventCreate, EventUpdate, Task
+from ..store import by
+from ..store.watch import Channel, ChannelClosed
+from ..utils.identity import new_id
+
+
+@dataclass
+class LogSelector:
+    """api/logbroker.proto LogSelector."""
+
+    service_ids: list[str] = field(default_factory=list)
+    node_ids: list[str] = field(default_factory=list)
+    task_ids: list[str] = field(default_factory=list)
+
+    def empty(self) -> bool:
+        return not (self.service_ids or self.node_ids or self.task_ids)
+
+
+@dataclass
+class LogContext:
+    service_id: str = ""
+    node_id: str = ""
+    task_id: str = ""
+
+
+@dataclass
+class LogMessage:
+    """api/logbroker.proto LogMessage: context + timestamped stream data."""
+
+    context: LogContext
+    timestamp: float
+    stream: str  # "stdout" | "stderr"
+    data: bytes
+
+
+@dataclass
+class SubscriptionMessage:
+    """api/logbroker.proto SubscriptionMessage sent to agents."""
+
+    id: str
+    selector: LogSelector
+    follow: bool = True
+    close: bool = False
+
+
+class _Subscription:
+    def __init__(self, sub_id: str, selector: LogSelector, follow: bool):
+        self.id = sub_id
+        self.selector = selector
+        self.follow = follow
+        self.client = Channel(matcher=None, limit=None)
+        self.nodes: set[str] = set()  # nodes the subscription was sent to
+        self.done = False
+
+
+class LogBroker:
+    def __init__(self, store):
+        self.store = store
+        self._lock = threading.Lock()
+        self._subs: dict[str, _Subscription] = {}
+        # node_id -> channel of SubscriptionMessage (agent listeners)
+        self._listeners: dict[str, Channel] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        self._stop = threading.Event()  # restartable across leadership cycles
+        self._thread = threading.Thread(target=self._run, name="logbroker", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        with self._lock:
+            for sub in self._subs.values():
+                sub.client.close()
+            for ch in self._listeners.values():
+                ch.close()
+            self._subs.clear()
+            self._listeners.clear()
+
+    # -- client side (Logs.SubscribeLogs, logbroker.proto:103-125) ---------
+
+    def subscribe_logs(self, selector: LogSelector, follow: bool = True) -> tuple[str, Channel]:
+        """Returns (subscription_id, channel of LogMessage)."""
+        if selector.empty():
+            raise ValueError("empty log selector")
+        sub = _Subscription(new_id(), selector, follow)
+        with self._lock:
+            self._subs[sub.id] = sub
+        self._dispatch_to_nodes(sub)
+        return sub.id, sub.client
+
+    def unsubscribe(self, sub_id: str):
+        with self._lock:
+            sub = self._subs.pop(sub_id, None)
+        if sub is None:
+            return
+        sub.done = True
+        sub.client.close()
+        close_msg = SubscriptionMessage(id=sub.id, selector=sub.selector, close=True)
+        with self._lock:
+            for node_id in sub.nodes:
+                ch = self._listeners.get(node_id)
+                if ch is not None:
+                    ch._offer(close_msg)
+
+    # -- agent side (LogBroker.ListenSubscriptions / PublishLogs) ----------
+
+    def listen_subscriptions(self, node_id: str) -> Channel:
+        """An agent's stream of subscription open/close messages
+        (broker.go:223-307). Re-listening replaces the previous stream."""
+        ch = Channel(matcher=None, limit=None)
+        with self._lock:
+            old = self._listeners.get(node_id)
+            self._listeners[node_id] = ch
+            subs = [s for s in self._subs.values() if node_id in s.nodes and not s.done]
+        if old is not None:
+            old.close()
+        # replay active subscriptions relevant to this node
+        for s in subs:
+            ch._offer(SubscriptionMessage(id=s.id, selector=s.selector, follow=s.follow))
+        return ch
+
+    def stop_listening(self, node_id: str):
+        with self._lock:
+            ch = self._listeners.pop(node_id, None)
+        if ch is not None:
+            ch.close()
+
+    def publish_logs(self, sub_id: str, messages: list[LogMessage]):
+        """Agent publishes task log data upstream (broker.go PublishLogs)."""
+        with self._lock:
+            sub = self._subs.get(sub_id)
+        if sub is None or sub.done:
+            return
+        for m in messages:
+            sub.client._offer(m)
+
+    # -- internals ---------------------------------------------------------
+
+    def _match_tasks(self, tx, selector: LogSelector) -> list[Task]:
+        out: dict[str, Task] = {}
+        for tid in selector.task_ids:
+            t = tx.get_task(tid)
+            if t is not None:
+                out[t.id] = t
+        for sid in selector.service_ids:
+            for t in tx.find_tasks(by.ByServiceID(sid)):
+                out[t.id] = t
+        for nid in selector.node_ids:
+            for t in tx.find_tasks(by.ByNodeID(nid)):
+                out[t.id] = t
+        return list(out.values())
+
+    def _dispatch_to_nodes(self, sub: _Subscription):
+        tasks = self.store.view(lambda tx: self._match_tasks(tx, sub.selector))
+        target_nodes = {t.node_id for t in tasks if t.node_id}
+        msg = SubscriptionMessage(id=sub.id, selector=sub.selector, follow=sub.follow)
+        with self._lock:
+            new_nodes = target_nodes - sub.nodes
+            sub.nodes |= new_nodes
+            offers = [
+                self._listeners[n] for n in new_nodes if n in self._listeners
+            ]
+        for ch in offers:
+            ch._offer(msg)
+
+    def _run(self):
+        """Follow-mode maintenance: tasks appearing on new nodes extend the
+        subscription to those nodes (broker.go subscription task watcher)."""
+        queue = self.store.watch_queue()
+        ch = queue.watch()
+        try:
+            while not self._stop.is_set():
+                try:
+                    ev = ch.get(timeout=0.2)
+                except TimeoutError:
+                    continue
+                except ChannelClosed:
+                    queue.stop_watch(ch)
+                    ch = queue.watch()
+                    with self._lock:
+                        subs = [s for s in self._subs.values() if s.follow and not s.done]
+                    for s in subs:
+                        self._dispatch_to_nodes(s)
+                    continue
+                if isinstance(ev, (EventCreate, EventUpdate)) and isinstance(ev.obj, Task):
+                    with self._lock:
+                        subs = [s for s in self._subs.values() if s.follow and not s.done]
+                    for s in subs:
+                        self._dispatch_to_nodes(s)
+        finally:
+            queue.stop_watch(ch)
+
+
+def make_log_message(task: Task, stream: str, data: bytes) -> LogMessage:
+    return LogMessage(
+        context=LogContext(
+            service_id=task.service_id, node_id=task.node_id, task_id=task.id
+        ),
+        timestamp=time.time(),
+        stream=stream,
+        data=data,
+    )

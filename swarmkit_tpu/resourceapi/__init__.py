@@ -1,0 +1,4 @@
+"""Resource allocator API (reference: manager/resourceapi/, SURVEY.md §2.7)."""
+from .allocator import ResourceAllocator
+
+__all__ = ["ResourceAllocator"]

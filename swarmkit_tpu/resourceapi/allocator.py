@@ -1,0 +1,74 @@
+"""ResourceAllocator API: agent-initiated network attachments.
+
+Re-derivation of manager/resourceapi/allocator.go (124 ln): a worker asks the
+manager to attach one of its engine-level containers to a cluster network —
+the manager records a node-pinned *attachment task* (no service) that flows
+through allocator → dispatcher like any task; detach removes it.
+"""
+from __future__ import annotations
+
+from ..api.objects import Task
+from ..api.specs import (
+    Annotations,
+    NetworkAttachmentConfig,
+    NetworkAttachmentSpec,
+    TaskSpec,
+)
+from ..api.types import TaskState
+from ..utils.identity import new_id
+
+
+class ResourceError(Exception):
+    pass
+
+
+class ResourceAllocator:
+    def __init__(self, store):
+        self.store = store
+
+    def attach_network(
+        self, node_id: str, network_id: str, addresses: list[str] | None = None
+    ) -> str:
+        """ResourceAllocator.AttachNetwork (allocator.go:21-81): creates the
+        attachment task pinned to the calling node; returns the attachment
+        (task) id."""
+        network = self.store.view(lambda tx: tx.get_network(network_id))
+        if network is None:
+            raise ResourceError(f"network {network_id} not found")
+
+        task = Task(
+            id=new_id(),
+            node_id=node_id,
+            desired_state=TaskState.RUNNING,
+            annotations=Annotations(name=f"attachment-{network_id[:8]}"),
+        )
+        task.spec = TaskSpec(
+            attachment=NetworkAttachmentSpec(),
+            networks=[
+                NetworkAttachmentConfig(
+                    target=network_id, addresses=list(addresses or [])
+                )
+            ],
+        )
+        task.status.state = TaskState.NEW
+
+        self.store.update(lambda tx: tx.create(task))
+        return task.id
+
+    def detach_network(self, node_id: str, attachment_id: str):
+        """ResourceAllocator.DetachNetwork (allocator.go:83-124): only the
+        owning node may detach; the task is deleted (the reference sets it
+        to REMOVE for the reaper — deletion through the same path here)."""
+
+        def txn(tx):
+            t = tx.get_task(attachment_id)
+            if t is None:
+                raise ResourceError(f"attachment {attachment_id} not found")
+            if t.node_id != node_id:
+                raise ResourceError("attachment does not belong to this node")
+            if t.spec.attachment is None:
+                raise ResourceError(f"task {attachment_id} is not an attachment")
+            t.desired_state = TaskState.REMOVE
+            tx.update(t)
+
+        self.store.update(txn)

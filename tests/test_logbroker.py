@@ -1,0 +1,153 @@
+"""LogBroker + ResourceAllocator tests (reference model:
+manager/logbroker/broker_test.go, manager/resourceapi)."""
+import time
+
+import pytest
+
+from swarmkit_tpu.agent.agent import Agent
+from swarmkit_tpu.agent.testutils import FakeExecutor
+from swarmkit_tpu.api.objects import Network, Service, Task
+from swarmkit_tpu.api.specs import Annotations, NetworkSpec, ServiceSpec
+from swarmkit_tpu.api.types import TaskState
+from swarmkit_tpu.logbroker import LogBroker, LogSelector
+from swarmkit_tpu.resourceapi import ResourceAllocator
+from swarmkit_tpu.resourceapi.allocator import ResourceError
+from swarmkit_tpu.store import by
+from swarmkit_tpu.store.memory import MemoryStore
+
+from test_scheduler import wait_for
+
+
+def _task(tid, service_id="", node_id=""):
+    t = Task(id=tid, service_id=service_id, node_id=node_id)
+    t.status.state = TaskState.RUNNING
+    t.desired_state = TaskState.RUNNING
+    return t
+
+
+def test_subscription_routing_and_publish():
+    store = MemoryStore()
+    store.update(lambda tx: tx.create(_task("t1", "svc1", "n1")))
+    store.update(lambda tx: tx.create(_task("t2", "svc2", "n2")))
+    broker = LogBroker(store)
+
+    # agent listener on n1 registered before subscription
+    n1_ch = broker.listen_subscriptions("n1")
+    sub_id, client = broker.subscribe_logs(LogSelector(service_ids=["svc1"]))
+    msg = n1_ch.get(timeout=2)
+    assert msg.id == sub_id and not msg.close
+
+    # n2 must NOT receive it
+    n2_ch = broker.listen_subscriptions("n2")
+    with pytest.raises(TimeoutError):
+        n2_ch.get(timeout=0.2)
+
+    from swarmkit_tpu.logbroker import make_log_message
+
+    t1 = store.view(lambda tx: tx.get_task("t1"))
+    broker.publish_logs(sub_id, [make_log_message(t1, "stdout", b"hello")])
+    out = client.get(timeout=2)
+    assert out.data == b"hello" and out.context.task_id == "t1"
+
+    # unsubscribe sends close to involved nodes
+    broker.unsubscribe(sub_id)
+    close = n1_ch.get(timeout=2)
+    assert close.close
+
+
+def test_listener_replay_for_late_agent():
+    store = MemoryStore()
+    store.update(lambda tx: tx.create(_task("t1", "svc1", "n1")))
+    broker = LogBroker(store)
+    sub_id, _client = broker.subscribe_logs(LogSelector(service_ids=["svc1"]))
+    # agent connects after the subscription exists → replayed
+    ch = broker.listen_subscriptions("n1")
+    msg = ch.get(timeout=2)
+    assert msg.id == sub_id
+
+
+def test_follow_extends_to_new_nodes():
+    store = MemoryStore()
+    store.update(lambda tx: tx.create(_task("t1", "svc1", "n1")))
+    broker = LogBroker(store)
+    broker.start()
+    try:
+        broker.listen_subscriptions("n1")
+        sub_id, _client = broker.subscribe_logs(LogSelector(service_ids=["svc1"]))
+        n3_ch = broker.listen_subscriptions("n3")
+        # a new task for svc1 lands on n3 → subscription follows
+        store.update(lambda tx: tx.create(_task("t3", "svc1", "n3")))
+        msg = n3_ch.get(timeout=3)
+        assert msg.id == sub_id
+    finally:
+        broker.stop()
+
+
+def test_end_to_end_agent_log_pump():
+    """Agent consumes the subscription and pumps controller logs back."""
+    from swarmkit_tpu.dispatcher.dispatcher import Dispatcher
+    from swarmkit_tpu.allocator.allocator import Allocator
+    from swarmkit_tpu.orchestrator.replicated import ReplicatedOrchestrator
+    from swarmkit_tpu.scheduler.scheduler import Scheduler
+
+    store = MemoryStore()
+    dispatcher = Dispatcher(store, heartbeat_period=0.5)
+    broker = LogBroker(store)
+    components = [dispatcher, broker, Allocator(store), Scheduler(store),
+                  ReplicatedOrchestrator(store)]
+    for c in components:
+        c.start()
+    ex = FakeExecutor(
+        {"svc-logs": {"run_forever": True, "logs": ["line-1", ("stderr", "line-2")]}},
+        hostname="w0",
+    )
+    agent = Agent("w0", dispatcher, ex, log_broker=broker)
+    agent.start()
+    try:
+        svc = Service(id="svc-logs")
+        svc.spec = ServiceSpec(annotations=Annotations(name="logs"), replicas=1)
+        svc.spec_version.index = 1
+        store.update(lambda tx: tx.create(svc))
+        assert wait_for(
+            lambda: any(
+                t.status.state == TaskState.RUNNING
+                for t in store.view().find_tasks(by.ByServiceID("svc-logs"))
+            ),
+            timeout=15,
+        )
+        _sub, client = broker.subscribe_logs(LogSelector(service_ids=["svc-logs"]))
+        first = client.get(timeout=5)
+        second = client.get(timeout=5)
+        datas = {first.data, second.data}
+        assert datas == {b"line-1", b"line-2"}
+        assert {first.stream, second.stream} == {"stdout", "stderr"}
+    finally:
+        agent.stop()
+        for c in reversed(components):
+            c.stop()
+
+
+# -- ResourceAllocator -------------------------------------------------------
+
+
+def test_attach_detach_network():
+    store = MemoryStore()
+    net = Network(id="net1", spec=NetworkSpec(annotations=Annotations(name="overlay1")))
+    store.update(lambda tx: tx.create(net))
+    ra = ResourceAllocator(store)
+
+    att_id = ra.attach_network("nodeA", "net1", addresses=["10.0.0.9"])
+    t = store.view(lambda tx: tx.get_task(att_id))
+    assert t.node_id == "nodeA"
+    assert t.spec.attachment is not None
+    assert t.spec.networks[0].target == "net1"
+    assert t.desired_state == TaskState.RUNNING
+
+    with pytest.raises(ResourceError):
+        ra.attach_network("nodeA", "missing-net")
+    with pytest.raises(ResourceError):
+        ra.detach_network("other-node", att_id)
+
+    ra.detach_network("nodeA", att_id)
+    t = store.view(lambda tx: tx.get_task(att_id))
+    assert t.desired_state == TaskState.REMOVE
