@@ -1,0 +1,109 @@
+"""Generic (custom) resources: parsing, validation, claim/reclaim.
+
+Re-derivation of api/genericresource/ (SURVEY.md §2.1): operators declare
+per-node custom resources as `kind=quantity` (discrete) or `kind=id1,id2`
+(named); the scheduler claims them onto tasks and the dispatcher tells the
+worker which named ids it got (resource_management.go Claim/Reclaim/
+ConsumeNodeResources/HasEnough; parsing parse.go).
+"""
+from __future__ import annotations
+
+import re
+
+from .specs import Resources
+
+_KIND_RE = re.compile(r"^[a-zA-Z0-9_-]+$")
+
+
+class GenericResourceError(Exception):
+    pass
+
+
+def parse_cmd(arg: str) -> Resources:
+    """Parse swarmd's --generic-node-resources value, e.g.
+    "gpu=4,fpga=f1;f2,ssd=1" (parse.go ParseCmd; the reference separates
+    named ids with commas inside repeated flags — we accept `;` inside one
+    flag for unambiguity and `,` between kinds)."""
+    res = Resources()
+    if not arg.strip():
+        return res
+    for part in arg.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise GenericResourceError(f"invalid generic resource {part!r} (want kind=value)")
+        kind, value = part.split("=", 1)
+        kind, value = kind.strip(), value.strip()
+        if not _KIND_RE.match(kind):
+            raise GenericResourceError(f"invalid resource kind {kind!r}")
+        if not value:
+            raise GenericResourceError(f"empty value for resource {kind!r}")
+        if value.isdigit():
+            res.generic[kind] = res.generic.get(kind, 0) + int(value)
+        else:
+            ids = {v.strip() for v in value.split(";") if v.strip()}
+            if not ids:
+                raise GenericResourceError(f"empty id list for resource {kind!r}")
+            dupes = res.named_generic.get(kind, set()) & ids
+            if dupes:
+                raise GenericResourceError(f"duplicate ids {sorted(dupes)} for {kind!r}")
+            res.named_generic.setdefault(kind, set()).update(ids)
+    for kind in res.generic:
+        if kind in res.named_generic:
+            raise GenericResourceError(
+                f"resource {kind!r} is both discrete and named"
+            )
+    return res
+
+
+def has_enough(node_avail: Resources, want: dict[str, int]) -> bool:
+    """resource_management.go HasEnough: named ids count toward the kind."""
+    for kind, qty in want.items():
+        have = node_avail.generic.get(kind, 0) + len(
+            node_avail.named_generic.get(kind, ())
+        )
+        if have < qty:
+            return False
+    return True
+
+
+def claim(node_avail: Resources, want: dict[str, int]) -> dict[str, tuple[frozenset, int]]:
+    """Claim resources from a node's available pool, preferring named ids
+    (resource_management.go Claim). Returns kind -> (named ids, discrete
+    count) actually taken; mutates node_avail. Raises if short."""
+    if not has_enough(node_avail, want):
+        raise GenericResourceError("insufficient generic resources")
+    taken: dict[str, tuple[frozenset, int]] = {}
+    for kind, qty in want.items():
+        named_pool = node_avail.named_generic.get(kind, set())
+        take_named = frozenset(sorted(named_pool)[:qty])
+        named_pool -= take_named
+        remaining = qty - len(take_named)
+        if remaining:
+            node_avail.generic[kind] = node_avail.generic.get(kind, 0) - remaining
+        taken[kind] = (take_named, remaining)
+    return taken
+
+
+def reclaim(node_avail: Resources, taken: dict[str, tuple[frozenset, int]]):
+    """Return claimed resources to the pool (resource_management.go Reclaim)."""
+    for kind, (named, count) in taken.items():
+        if named:
+            node_avail.named_generic.setdefault(kind, set()).update(named)
+        if count:
+            node_avail.generic[kind] = node_avail.generic.get(kind, 0) + count
+
+
+def consume_node_resources(node_avail: Resources, taken: dict[str, tuple[frozenset, int]]):
+    """Deduct an existing task's claim from a freshly-described node pool
+    (resource_management.go ConsumeNodeResources — used when rebuilding
+    NodeInfo from running tasks)."""
+    for kind, (named, count) in taken.items():
+        if named:
+            pool = node_avail.named_generic.get(kind, set())
+            pool -= set(named)
+        if count:
+            node_avail.generic[kind] = max(
+                0, node_avail.generic.get(kind, 0) - count
+            )

@@ -166,6 +166,9 @@ class ControlAPI:
 
     # -------------------------------------------------------------- services
     def create_service(self, spec: ServiceSpec) -> Service:
+        from ..api.defaults import merge_service_defaults
+
+        merge_service_defaults(spec)
         svc = Service(id=new_id(), spec=spec)
         svc.spec_version = Version(1)
 

@@ -1,0 +1,4 @@
+"""Spec templating (reference: template/, SURVEY.md X2)."""
+from .context import Context, TemplateError, expand_container_spec, expand_payload
+
+__all__ = ["Context", "TemplateError", "expand_container_spec", "expand_payload"]

@@ -1,0 +1,170 @@
+"""Spec templating: expand placeholders in container specs and payloads.
+
+Re-derivation of template/context.go:18-212: a `Context` built from
+(node, service, task) expands Go-template placeholders in env values,
+hostname, mount sources, and secret/config payloads. Supported surface —
+exactly the fields the reference exposes:
+
+  {{.Service.ID}} {{.Service.Name}} {{.Service.Labels}}
+  {{.Node.ID}} {{.Node.Hostname}} {{.Node.Platform.OS}}
+  {{.Node.Platform.Architecture}}
+  {{.Task.ID}} {{.Task.Name}} {{.Task.Slot}} {{.Task.NodeID}}
+  {{env "KEY"}} {{secret "name"}} {{config "name"}}
+
+The reference uses Go text/template; we implement the same placeholder
+grammar directly (no general template programming — the reference's
+templates are restricted to this field set too).
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any
+
+_PLACEHOLDER = re.compile(
+    r"\{\{\s*(?:"
+    r"(?P<path>\.[A-Za-z][A-Za-z0-9.]*)"
+    r"|(?P<func>env|secret|config)\s+\"(?P<arg>[^\"]*)\""
+    r")\s*\}\}"
+)
+
+
+class TemplateError(Exception):
+    pass
+
+
+def _label_index(labels: dict[str, str]) -> str:
+    return ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+
+
+@dataclass
+class Context:
+    """Template context (template/context.go Context / NewContext)."""
+
+    service_id: str = ""
+    service_name: str = ""
+    service_labels: dict[str, str] = field(default_factory=dict)
+    node_id: str = ""
+    node_hostname: str = ""
+    node_os: str = ""
+    node_architecture: str = ""
+    task_id: str = ""
+    task_name: str = ""
+    task_slot: int = 0
+    # dependency getters: name -> payload; task-restricted by the caller
+    secrets: dict[str, bytes] = field(default_factory=dict)
+    configs: dict[str, bytes] = field(default_factory=dict)
+    env: dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def from_task(cls, node, service, task, secrets=None, configs=None) -> "Context":
+        """template/context.go NewContext: task name is
+        <service>.<slot>.<task id> (or <service>.<nodeid>.<id> for global)."""
+        slot_part = str(task.slot) if task.slot else task.node_id
+        svc_name = (
+            service.spec.annotations.name if service is not None else ""
+        ) or task.service_annotations.name
+        task_name = ".".join(p for p in (svc_name, slot_part, task.id) if p)
+        env = {}
+        spec = task.spec.runtime
+        if spec is not None:
+            for e in spec.env:
+                if "=" in e:
+                    k, v = e.split("=", 1)
+                    env[k] = v
+        return cls(
+            service_id=service.id if service is not None else task.service_id,
+            service_name=svc_name,
+            service_labels=dict(service.spec.annotations.labels)
+            if service is not None and service.spec.annotations.labels
+            else {},
+            node_id=node.id if node is not None else task.node_id,
+            node_hostname=(
+                node.description.hostname
+                if node is not None and node.description is not None
+                else ""
+            ),
+            node_os=(
+                node.description.platform.os
+                if node is not None and node.description is not None
+                else ""
+            ),
+            node_architecture=(
+                node.description.platform.architecture
+                if node is not None and node.description is not None
+                else ""
+            ),
+            task_id=task.id,
+            task_name=task_name,
+            task_slot=task.slot,
+            secrets=dict(secrets or {}),
+            configs=dict(configs or {}),
+            env=env,
+        )
+
+    # -- expansion ---------------------------------------------------------
+
+    def _resolve_path(self, path: str) -> str:
+        table = {
+            ".Service.ID": self.service_id,
+            ".Service.Name": self.service_name,
+            ".Service.Labels": _label_index(self.service_labels),
+            ".Node.ID": self.node_id,
+            ".Node.Hostname": self.node_hostname,
+            ".Node.Platform.OS": self.node_os,
+            ".Node.Platform.Architecture": self.node_architecture,
+            ".Task.ID": self.task_id,
+            ".Task.Name": self.task_name,
+            ".Task.Slot": str(self.task_slot),
+            ".Task.NodeID": self.node_id,
+        }
+        # label lookup: {{.Service.Labels.foo}} — index syntax of the map
+        if path.startswith(".Service.Labels."):
+            return self.service_labels.get(path[len(".Service.Labels.") :], "")
+        if path not in table:
+            raise TemplateError(f"unknown template field {path}")
+        return table[path]
+
+    def _resolve_func(self, func: str, arg: str) -> str:
+        if func == "env":
+            return self.env.get(arg, "")
+        if func == "secret":
+            if arg not in self.secrets:
+                raise TemplateError(f"secret {arg!r} not available to this task")
+            return self.secrets[arg].decode("utf-8", "replace")
+        if func == "config":
+            if arg not in self.configs:
+                raise TemplateError(f"config {arg!r} not available to this task")
+            return self.configs[arg].decode("utf-8", "replace")
+        raise TemplateError(f"unknown template function {func}")
+
+    def expand(self, text: str) -> str:
+        """Expand all placeholders (template/context.go Context.Expand)."""
+
+        def sub(m: re.Match) -> str:
+            if m.group("path"):
+                return self._resolve_path(m.group("path"))
+            return self._resolve_func(m.group("func"), m.group("arg") or "")
+
+        return _PLACEHOLDER.sub(sub, text)
+
+
+def expand_payload(ctx: Context, payload: bytes) -> bytes:
+    """Templated secret/config payload expansion
+    (template/expand.go ExpandSecretSpec/ExpandConfigSpec)."""
+    return ctx.expand(payload.decode("utf-8")).encode("utf-8")
+
+
+def expand_container_spec(ctx: Context, spec) -> Any:
+    """Return a copy of a ContainerSpec with env values, hostname (dir/user)
+    and mount sources expanded (template/context.go ExpandContainerSpec)."""
+    import copy
+
+    out = copy.deepcopy(spec)
+    out.env = [ctx.expand(e) for e in out.env]
+    out.dir = ctx.expand(out.dir)
+    out.user = ctx.expand(out.user)
+    for m in out.mounts:
+        if getattr(m, "source", None):
+            m.source = ctx.expand(m.source)
+    return out

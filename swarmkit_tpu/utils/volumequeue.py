@@ -1,0 +1,76 @@
+"""Retry queue with exponential backoff for volume operations.
+
+Re-derivation of volumequeue/queue.go: entries are (id, attempt); each
+enqueue schedules the id after `base * 2^attempt`, capped (100ms → 10min).
+`wait` blocks until the soonest entry is ripe. Used by the CSI manager and
+the agent volume manager to retry plugin calls.
+"""
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+
+BASE_RETRY_INTERVAL = 0.1  # volumequeue/queue.go baseRetryInterval 100ms
+MAX_RETRY_INTERVAL = 600.0  # maxRetryInterval 10min
+
+
+class VolumeQueue:
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._heap: list[tuple[float, str, int]] = []  # (ready_at, id, attempt)
+        self._pending: dict[str, int] = {}  # id -> attempt (dedupe)
+        self._stopped = False
+
+    def enqueue(self, vid: str, attempt: int = 0):
+        """Schedule `vid` after the backoff for `attempt`
+        (queue.go Enqueue; attempt 0 is immediate)."""
+        delay = 0.0
+        if attempt > 0:
+            delay = min(BASE_RETRY_INTERVAL * (2 ** (attempt - 1)), MAX_RETRY_INTERVAL)
+        with self._lock:
+            if self._stopped:
+                return
+            if vid in self._pending:
+                return  # already queued; keep the earlier schedule
+            self._pending[vid] = attempt
+            heapq.heappush(self._heap, (time.monotonic() + delay, vid, attempt))
+            self._lock.notify_all()
+
+    def wait(self, timeout: float | None = None) -> tuple[str, int] | None:
+        """Block until an entry is ripe; returns (id, attempt) or None on
+        stop/timeout (queue.go Wait)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                if self._stopped:
+                    return None
+                now = time.monotonic()
+                if self._heap:
+                    ready_at, vid, attempt = self._heap[0]
+                    if ready_at <= now:
+                        heapq.heappop(self._heap)
+                        if self._pending.get(vid) == attempt:
+                            del self._pending[vid]
+                            return vid, attempt
+                        continue  # stale (outdated/removed); skip
+                    wait_for = ready_at - now
+                else:
+                    wait_for = None
+                if deadline is not None:
+                    remaining = deadline - now
+                    if remaining <= 0:
+                        return None
+                    wait_for = remaining if wait_for is None else min(wait_for, remaining)
+                self._lock.wait(timeout=wait_for)
+
+    def outdated(self, vid: str):
+        """Drop a queued id (queue.go Outdated: the object changed, pending
+        retries are stale)."""
+        with self._lock:
+            self._pending.pop(vid, None)
+
+    def stop(self):
+        with self._lock:
+            self._stopped = True
+            self._lock.notify_all()

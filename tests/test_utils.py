@@ -1,0 +1,173 @@
+"""X2 utility tests: template expansion, volume retry queue, generic
+resources, spec defaults (reference models: template/context_test.go,
+volumequeue/queue_test.go, api/genericresource tests)."""
+import time
+
+import pytest
+
+from swarmkit_tpu.api.genericresource import (
+    GenericResourceError,
+    claim,
+    consume_node_resources,
+    has_enough,
+    parse_cmd,
+    reclaim,
+)
+from swarmkit_tpu.api.defaults import merge_service_defaults
+from swarmkit_tpu.api.objects import Node, Service, Task
+from swarmkit_tpu.api.specs import (
+    Annotations,
+    ContainerSpec,
+    NodeDescription,
+    Platform,
+    Resources,
+    ServiceSpec,
+    TaskSpec,
+    VolumeMount,
+)
+from swarmkit_tpu.template import Context, TemplateError, expand_container_spec, expand_payload
+from swarmkit_tpu.utils.volumequeue import VolumeQueue
+
+
+# -- template ----------------------------------------------------------------
+
+
+def _ctx():
+    node = Node(id="node-1")
+    node.description = NodeDescription(
+        hostname="host-a", platform=Platform(os="linux", architecture="amd64")
+    )
+    svc = Service(id="svc-1")
+    svc.spec = ServiceSpec(
+        annotations=Annotations(name="web", labels={"tier": "frontend"})
+    )
+    task = Task(id="task-1", service_id="svc-1", slot=3, node_id="node-1")
+    task.spec = TaskSpec(runtime=ContainerSpec(env=["FOO=bar"]))
+    return Context.from_task(
+        node, svc, task, secrets={"db-pass": b"hunter2"}, configs={"cfg": b"x=1"}
+    )
+
+
+def test_template_fields():
+    ctx = _ctx()
+    assert ctx.expand("{{.Service.Name}}.{{.Task.Slot}}") == "web.3"
+    assert ctx.expand("{{.Node.Hostname}}") == "host-a"
+    assert ctx.expand("{{.Node.Platform.OS}}/{{.Node.Platform.Architecture}}") == "linux/amd64"
+    assert ctx.expand("{{.Task.Name}}") == "web.3.task-1"
+    assert ctx.expand("{{.Service.Labels.tier}}") == "frontend"
+    assert ctx.expand("{{.Service.Labels.missing}}") == ""
+    assert ctx.expand("no placeholders") == "no placeholders"
+
+
+def test_template_functions():
+    ctx = _ctx()
+    assert ctx.expand('{{env "FOO"}}') == "bar"
+    assert ctx.expand('{{env "NOPE"}}') == ""
+    assert ctx.expand('{{secret "db-pass"}}') == "hunter2"
+    assert ctx.expand('{{config "cfg"}}') == "x=1"
+    with pytest.raises(TemplateError):
+        ctx.expand('{{secret "not-mine"}}')  # task-restricted
+    with pytest.raises(TemplateError):
+        ctx.expand("{{.Bogus.Field}}")
+
+
+def test_template_payload_and_spec():
+    ctx = _ctx()
+    assert expand_payload(ctx, b"host={{.Node.Hostname}}") == b"host=host-a"
+    spec = ContainerSpec(
+        env=["HOST={{.Node.Hostname}}", "PLAIN=1"],
+        mounts=[VolumeMount(source="/data/{{.Task.Slot}}", target="/data")],
+    )
+    out = expand_container_spec(ctx, spec)
+    assert out.env == ["HOST=host-a", "PLAIN=1"]
+    assert out.mounts[0].source == "/data/3"
+    assert spec.env[0] == "HOST={{.Node.Hostname}}"  # original untouched
+
+
+def test_template_global_task_name_uses_node_id():
+    node = Node(id="node-9")
+    svc = Service(id="s")
+    svc.spec = ServiceSpec(annotations=Annotations(name="glob"))
+    task = Task(id="t9", service_id="s", slot=0, node_id="node-9")
+    ctx = Context.from_task(node, svc, task)
+    assert ctx.expand("{{.Task.Name}}") == "glob.node-9.t9"
+
+
+# -- volumequeue -------------------------------------------------------------
+
+
+def test_volumequeue_immediate_and_backoff():
+    q = VolumeQueue()
+    q.enqueue("v1")
+    assert q.wait(timeout=1) == ("v1", 0)
+
+    t0 = time.monotonic()
+    q.enqueue("v2", attempt=2)  # 0.1 * 2^1 = 0.2s
+    got = q.wait(timeout=2)
+    assert got == ("v2", 2)
+    assert time.monotonic() - t0 >= 0.15
+
+
+def test_volumequeue_dedupe_outdated_stop():
+    q = VolumeQueue()
+    q.enqueue("v1", attempt=3)
+    q.enqueue("v1", attempt=5)  # dedupe: keeps first schedule
+    q.outdated("v1")
+    assert q.wait(timeout=0.8) is None  # dropped
+    q.enqueue("v2")
+    q.stop()
+    assert q.wait(timeout=0.2) is None
+
+
+# -- genericresource ---------------------------------------------------------
+
+
+def test_parse_cmd():
+    res = parse_cmd("gpu=4,fpga=f1;f2,ssd=1")
+    assert res.generic == {"gpu": 4, "ssd": 1}
+    assert res.named_generic == {"fpga": {"f1", "f2"}}
+    assert parse_cmd("").generic == {}
+    with pytest.raises(GenericResourceError):
+        parse_cmd("bad resource")
+    with pytest.raises(GenericResourceError):
+        parse_cmd("gpu=")
+    with pytest.raises(GenericResourceError):
+        parse_cmd("gpu=2,gpu=a;b")
+
+
+def test_claim_reclaim_roundtrip():
+    avail = Resources(generic={"gpu": 2}, named_generic={"fpga": {"f1", "f2", "f3"}})
+    assert has_enough(avail, {"gpu": 2, "fpga": 2})
+    assert not has_enough(avail, {"gpu": 3})
+
+    taken = claim(avail, {"gpu": 1, "fpga": 2})
+    assert avail.generic["gpu"] == 1
+    assert len(avail.named_generic["fpga"]) == 1
+    named, count = taken["fpga"]
+    assert len(named) == 2 and count == 0
+
+    reclaim(avail, taken)
+    assert avail.generic["gpu"] == 2
+    assert avail.named_generic["fpga"] == {"f1", "f2", "f3"}
+
+    with pytest.raises(GenericResourceError):
+        claim(avail, {"gpu": 99})
+
+
+def test_consume_node_resources():
+    avail = Resources(generic={"gpu": 4}, named_generic={"fpga": {"f1", "f2"}})
+    consume_node_resources(avail, {"gpu": (frozenset(), 2), "fpga": (frozenset({"f1"}), 0)})
+    assert avail.generic["gpu"] == 2
+    assert avail.named_generic["fpga"] == {"f2"}
+
+
+# -- defaults ----------------------------------------------------------------
+
+
+def test_merge_service_defaults():
+    spec = ServiceSpec()
+    spec.rollback = None
+    merge_service_defaults(spec)
+    assert spec.rollback is not None
+    assert spec.rollback.parallelism == 1
+    assert spec.task.restart.delay == 5.0
