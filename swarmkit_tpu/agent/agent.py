@@ -25,20 +25,34 @@ BACKOFF_MAX = 8.0
 
 class Agent:
     def __init__(self, node_id: str, dispatcher, executor,
-                 state_path: str | None = None, log_broker=None):
+                 state_path: str | None = None, log_broker=None,
+                 csi_plugins=None):
         self.node_id = node_id
         self.dispatcher = dispatcher
         self.executor = executor
         self.log_broker = log_broker
-        self.worker = Worker(executor, self._enqueue_status, state_path)
+        self.volume_manager = None
+        if csi_plugins is not None:
+            from .csi import NodeVolumeManager
+
+            self.volume_manager = NodeVolumeManager(
+                csi_plugins, on_unpublished=self._report_unpublished
+            )
+        self.worker = Worker(executor, self._enqueue_status, state_path,
+                             volume_manager=self.volume_manager)
+        if self.volume_manager is not None:
+            self.volume_manager.on_ready = self.worker.volume_ready
         self.session_id: str | None = None
         self._pending: dict[str, TaskStatus] = {}
+        self._unpublished_pending: set[str] = set()
         self._pending_lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
 
     # ------------------------------------------------------------- lifecycle
     def start(self):
+        if self.volume_manager is not None:
+            self.volume_manager.start()
         t = threading.Thread(target=self._run, daemon=True,
                              name=f"agent-{self.node_id[:8]}")
         t.start()
@@ -58,7 +72,9 @@ class Agent:
         from ..store.watch import ChannelClosed
 
         ch = self.log_broker.listen_subscriptions(self.node_id)
-        active: set[str] = set()
+        # sub id -> task ids already pumped (follow-mode re-offers only
+        # emit tasks that appeared since)
+        pumped: dict[str, set[str]] = {}
         while not self._stop.is_set():
             try:
                 msg = ch.get(timeout=0.2)
@@ -70,14 +86,11 @@ class Agent:
                 if self._stop.wait(timeout=0.2):
                     return
                 ch = self.log_broker.listen_subscriptions(self.node_id)
-                active.clear()
+                pumped.clear()
                 continue
             if msg.close:
-                active.discard(msg.id)
+                pumped.pop(msg.id, None)
                 continue
-            if msg.id in active:
-                continue
-            active.add(msg.id)
             sub_id = msg.id
 
             def publish(task, stream, data, sub_id=sub_id):
@@ -86,15 +99,42 @@ class Agent:
                 )
 
             try:
-                self.worker.subscribe_logs(msg.selector, publish)
+                done = pumped.setdefault(sub_id, set())
+                done |= self.worker.subscribe_logs(
+                    msg.selector, publish, skip_task_ids=done
+                )
             except Exception:
                 pass
 
     def stop(self):
         self._stop.set()
+        if self.volume_manager is not None:
+            self.volume_manager.stop()
         self.worker.stop()
         for t in self._threads:
             t.join(timeout=2)
+
+    def _report_unpublished(self, volume_obj_id: str):
+        """NodeVolumeManager finished node-unpublish → confirm upstream
+        (agent/csi/volumes.go → Dispatcher.UpdateVolumeStatus)."""
+        with self._pending_lock:
+            self._unpublished_pending.add(volume_obj_id)
+        self._flush_unpublished()
+
+    def _flush_unpublished(self):
+        sid = self.session_id
+        if sid is None:
+            return  # flushed again once a session is established
+        with self._pending_lock:
+            pending = list(self._unpublished_pending)
+        if not pending:
+            return
+        try:
+            self.dispatcher.update_volume_status(self.node_id, sid, pending)
+        except Exception:
+            return  # kept pending; next session flush retries
+        with self._pending_lock:
+            self._unpublished_pending.difference_update(pending)
 
     def leave(self):
         if self.session_id is not None:
@@ -122,9 +162,23 @@ class Agent:
 
     def _session(self):
         description = self.executor.describe()
+        if self.volume_manager is not None:
+            # advertise CSI driver support so the scheduler places cluster
+            # volumes here (reference: agent fills NodeDescription.CSIInfo
+            # from its node plugins)
+            from ..api.specs import NodeCSIInfo
+
+            for name in self.volume_manager.plugins.names():
+                description.csi_info.setdefault(
+                    name,
+                    NodeCSIInfo(plugin_name=name, node_id=f"{name}-{self.node_id}"),
+                )
+                if name not in description.csi_plugins:
+                    description.csi_plugins.append(name)
         session_id = self.dispatcher.register(self.node_id, description)
         self.session_id = session_id
         period = self.dispatcher.heartbeat(self.node_id, session_id)
+        self._flush_unpublished()  # confirms lost across reconnects
 
         hb_stop = threading.Event()
 

@@ -1,0 +1,121 @@
+"""Agent-side CSI volume staging/publishing.
+
+Re-derivation of agent/csi/volumes.go:20-240: the worker receives volume
+assignments (volumes published to this node); for each, the node plugin
+stages then publishes the volume, with exponential-backoff retries; when an
+assignment is removed, the volume is node-unpublished/unstaged and the
+manager is told so the controller can detach (UpdateVolumeStatus →
+confirm_node_unpublish).
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from ..csi.plugin import PluginGetter
+from ..utils.volumequeue import VolumeQueue
+
+
+@dataclass
+class VolumeAssignment:
+    """api/objects.proto VolumeAssignment: what the dispatcher ships."""
+
+    id: str  # volume object id
+    volume_id: str  # plugin-scoped id from VolumeInfo
+    driver: str
+    volume_context: dict[str, str] = field(default_factory=dict)
+    publish_context: dict[str, str] = field(default_factory=dict)
+    availability: str = "active"
+
+
+class NodeVolumeManager:
+    """agent/csi/volumes.go volumes: staging state machine + retry queue."""
+
+    def __init__(self, plugins: PluginGetter, on_unpublished=None, on_ready=None):
+        self.plugins = plugins
+        self.on_unpublished = on_unpublished  # callable(volume_obj_id)
+        self.on_ready = on_ready  # callable(volume_obj_id): staged+published
+        self._lock = threading.Lock()
+        self._assignments: dict[str, VolumeAssignment] = {}
+        self._ready: set[str] = set()
+        self._removing: dict[str, VolumeAssignment] = {}
+        self.queue = VolumeQueue()
+        self._attempts: dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, name="agent-csi", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self.queue.stop()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # -- assignment intake (worker.reconcileVolumes) -----------------------
+
+    def add(self, assignment: VolumeAssignment):
+        with self._lock:
+            self._assignments[assignment.id] = assignment
+            self._removing.pop(assignment.id, None)
+        self.queue.enqueue(assignment.id)
+
+    def remove(self, volume_obj_id: str):
+        with self._lock:
+            a = self._assignments.pop(volume_obj_id, None)
+            if a is None:
+                return
+            self._removing[volume_obj_id] = a
+        self.queue.enqueue(volume_obj_id)
+
+    def reconcile(self, wanted_ids: set[str]):
+        """Full-assignment reconcile (worker.go reconcileVolumes): anything
+        held but absent from the complete set was withdrawn while we were
+        disconnected and must be node-unpublished."""
+        with self._lock:
+            stale = [vid for vid in self._assignments if vid not in wanted_ids]
+        for vid in stale:
+            self.remove(vid)
+
+    def is_ready(self, volume_obj_id: str) -> bool:
+        """tasks gate on their volumes being staged (worker waitReady)."""
+        with self._lock:
+            return volume_obj_id in self._ready
+
+    # -- worker loop -------------------------------------------------------
+
+    def _run(self):
+        while not self._stop.is_set():
+            item = self.queue.wait(timeout=0.5)
+            if item is None:
+                continue
+            vid, _ = item
+            with self._lock:
+                adding = self._assignments.get(vid)
+                removing = self._removing.get(vid)
+            try:
+                if adding is not None:
+                    plugin = self.plugins.get(adding.driver)
+                    plugin.node_stage(adding)
+                    plugin.node_publish(adding)
+                    with self._lock:
+                        self._ready.add(vid)
+                    if self.on_ready is not None:
+                        self.on_ready(vid)
+                elif removing is not None:
+                    plugin = self.plugins.get(removing.driver)
+                    plugin.node_unpublish(removing)
+                    plugin.node_unstage(removing)
+                    with self._lock:
+                        self._removing.pop(vid, None)
+                        self._ready.discard(vid)
+                    if self.on_unpublished is not None:
+                        self.on_unpublished(vid)
+                self._attempts.pop(vid, None)
+            except Exception:
+                attempt = self._attempts.get(vid, 0) + 1
+                self._attempts[vid] = attempt
+                self.queue.enqueue(vid, attempt=attempt)

@@ -130,13 +130,16 @@ class Worker:
     """reference: agent/worker.go."""
 
     def __init__(self, executor, report: Callable[[str, TaskStatus], None],
-                 state_path: str | None = None):
+                 state_path: str | None = None, volume_manager=None):
         self.executor = executor
         self.report = report
         self.state_path = state_path
         self.deps = DependencyStore()
+        self.volumes = volume_manager  # NodeVolumeManager (agent/csi.py)
         self._managers: dict[str, TaskManager] = {}
         self._tasks: dict[str, Task] = {}
+        # tasks parked until their CSI volumes are staged (worker waitReady)
+        self._awaiting_volumes: dict[str, Task] = {}
         self._lock = threading.Lock()
         self._load_state()
 
@@ -145,28 +148,40 @@ class Worker:
         """Full set (reference worker.go:129-166)."""
         with self._lock:
             wanted_tasks: dict[str, Task] = {}
+            wanted_volumes: set[str] = set()
             for ch in changes:
                 if ch.kind == "task" and ch.action == "update":
                     wanted_tasks[ch.item.id] = ch.item
+                elif ch.kind == "volume" and ch.action == "update":
+                    wanted_volumes.add(ch.item.id)
             self._apply_deps(changes, full=True)
+            if self.volumes is not None:
+                self.volumes.reconcile(wanted_volumes)
             # drop unknown tasks
             for tid in list(self._managers):
                 if tid not in wanted_tasks:
                     self._shutdown_manager(tid)
+            for tid in list(self._awaiting_volumes):
+                if tid not in wanted_tasks:
+                    del self._awaiting_volumes[tid]
             for task in wanted_tasks.values():
                 self._start_or_update(task)
         self._persist()
 
-    def subscribe_logs(self, selector, publish) -> int:
+    def subscribe_logs(self, selector, publish, skip_task_ids=()) -> set[str]:
         """Pump logs for this worker's tasks matching `selector` through
         `publish(task, stream, data)` (reference worker.go Subscribe:596 →
-        taskManager log attachment). Returns the number of tasks matched.
+        taskManager log attachment). `skip_task_ids` are tasks already
+        pumped for this subscription (the caller's dedupe, so follow-mode
+        re-offers only emit new tasks). Returns the task ids pumped.
         Controllers opt in by exposing `logs() -> iterable[(stream, bytes)]`."""
         with self._lock:
             managers = list(self._managers.values())
-        matched = 0
+        pumped: set[str] = set()
         for mgr in managers:
             t = mgr.task
+            if t.id in skip_task_ids:
+                continue
             if (
                 t.id in selector.task_ids
                 or t.service_id in selector.service_ids
@@ -175,10 +190,10 @@ class Worker:
                 logs_fn = getattr(mgr.controller, "logs", None)
                 if logs_fn is None:
                     continue
-                matched += 1
+                pumped.add(t.id)
                 for stream, data in logs_fn():
                     publish(t, stream, data)
-        return matched
+        return pumped
 
     def update(self, changes):
         """Incremental diff (reference worker.go:168-196)."""
@@ -207,12 +222,39 @@ class Worker:
                     self.deps.update_config(ch.item)
                 else:
                     self.deps.remove_config(ch.item)
+            elif ch.kind == "volume" and self.volumes is not None:
+                if ch.action == "update":
+                    self.volumes.add(ch.item)
+                else:
+                    self.volumes.remove(ch.item)
+
+    def volume_ready(self, volume_obj_id: str):
+        """A CSI volume finished staging: start any parked tasks whose
+        volume set is now fully ready (worker waitReady unblocking)."""
+        with self._lock:
+            ready = [
+                t
+                for t in self._awaiting_volumes.values()
+                if all(self.volumes.is_ready(v) for v in t.volumes)
+            ]
+            for t in ready:
+                del self._awaiting_volumes[t.id]
+                self._start_or_update(t)
 
     def _start_or_update(self, task: Task):
         mgr = self._managers.get(task.id)
         if mgr is not None and mgr.is_alive():
             mgr.update(task)
             return
+        if (
+            self.volumes is not None
+            and task.volumes
+            and not all(self.volumes.is_ready(v) for v in task.volumes)
+        ):
+            # park until node staging completes; resumed by volume_ready
+            self._awaiting_volumes[task.id] = task
+            return
+        self._awaiting_volumes.pop(task.id, None)
         known = self._tasks.get(task.id)
         if known is not None and known.status.state > task.status.state:
             # we know more than the manager does (restart case)

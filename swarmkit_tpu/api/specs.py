@@ -121,9 +121,10 @@ class ConfigReference:
 
 @dataclass
 class VolumeMount:
-    source: str = ""  # volume group or name
+    source: str = ""  # volume name, or "group:<name>" for cluster volumes
     target: str = ""
     readonly: bool = False
+    type: str = "volume"  # "bind" | "volume" | "tmpfs" | "csi"
 
 
 @dataclass
@@ -227,6 +228,19 @@ class NodeDescription:
     plugins: list[tuple[str, str]] = field(default_factory=list)  # (type, name)
     fips: bool = False
     csi_plugins: list[str] = field(default_factory=list)
+    # plugin name -> NodeCSIInfo (csi node id + accessible topology segments)
+    csi_info: dict[str, "NodeCSIInfo"] = field(default_factory=dict)
+
+
+@dataclass
+class NodeCSIInfo:
+    """Per-plugin CSI identity a node reports
+    (reference: api/objects.proto NodeCSIInfo)."""
+
+    plugin_name: str = ""
+    node_id: str = ""  # the *CSI* node id, plugin-scoped
+    max_volumes_per_node: int = 0
+    accessible_topology: dict[str, str] = field(default_factory=dict)
 
 
 @dataclass

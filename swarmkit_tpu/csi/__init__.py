@@ -1,0 +1,32 @@
+"""CSI cluster volumes: manager lifecycle, scheduler feasibility, plugins
+(reference: manager/csi/, manager/scheduler/volumes.go, SURVEY.md §2.8)."""
+from .manager import VolumeManager
+from .plugin import (
+    PENDING_NODE_UNPUBLISH,
+    PENDING_PUBLISH,
+    PENDING_UNPUBLISH,
+    PUBLISHED,
+    CSIPlugin,
+    CSIPluginError,
+    FakeCSIPlugin,
+    PluginGetter,
+    VolumeInfo,
+    VolumePublishStatus,
+)
+from .volumes import VolumeSet, task_csi_mounts
+
+__all__ = [
+    "VolumeManager",
+    "CSIPlugin",
+    "CSIPluginError",
+    "FakeCSIPlugin",
+    "PluginGetter",
+    "VolumeInfo",
+    "VolumePublishStatus",
+    "VolumeSet",
+    "task_csi_mounts",
+    "PENDING_PUBLISH",
+    "PUBLISHED",
+    "PENDING_NODE_UNPUBLISH",
+    "PENDING_UNPUBLISH",
+]

@@ -1,0 +1,265 @@
+"""Manager-side CSI volume lifecycle.
+
+Re-derivation of manager/csi/manager.go:31-465: an event loop over Volume
+and Task events that (1) creates volumes via the controller plugin and
+records VolumeInfo, (2) publishes volumes to nodes whose assigned tasks use
+them (PENDING_PUBLISH → controller_publish → PUBLISHED), (3) unpublishes
+once no tasks on a node need the volume (PENDING_NODE_UNPUBLISH, confirmed
+by the agent → PENDING_UNPUBLISH → controller_unpublish → status removed),
+and (4) deletes pending_delete volumes once fully unpublished. Failures are
+retried through the volumequeue's exponential backoff (100ms → 10min).
+"""
+from __future__ import annotations
+
+import threading
+
+from ..api.objects import EventCreate, EventDelete, EventUpdate, Task, Volume
+from ..api.types import TaskState
+from ..store import by
+from ..store.watch import ChannelClosed
+from ..utils.volumequeue import VolumeQueue
+from .plugin import (
+    PENDING_NODE_UNPUBLISH,
+    PENDING_PUBLISH,
+    PENDING_UNPUBLISH,
+    PUBLISHED,
+    PluginGetter,
+    VolumePublishStatus,
+)
+
+
+class VolumeManager:
+    def __init__(self, store, plugins: PluginGetter):
+        self.store = store
+        self.plugins = plugins
+        self.queue = VolumeQueue()
+        self._attempts: dict[str, int] = {}
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        self._stop = threading.Event()
+        self.queue = VolumeQueue()
+        for target, name in ((self._run_events, "csi-events"), (self._run_queue, "csi-queue")):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        # initial pass over existing volumes (snapshot-then-watch)
+        for v in self.store.view(lambda tx: tx.find_volumes(by.All())):
+            self.queue.enqueue(v.id)
+
+    def stop(self):
+        self._stop.set()
+        self.queue.stop()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()
+
+    # -- event plumbing ----------------------------------------------------
+
+    def _run_events(self):
+        queue = self.store.watch_queue()
+        ch = queue.watch()
+        try:
+            while not self._stop.is_set():
+                try:
+                    ev = ch.get(timeout=0.2)
+                except TimeoutError:
+                    continue
+                except ChannelClosed:
+                    queue.stop_watch(ch)
+                    ch = queue.watch()
+                    for v in self.store.view(lambda tx: tx.find_volumes(by.All())):
+                        self.queue.enqueue(v.id)
+                    continue
+                obj = getattr(ev, "obj", None)
+                if isinstance(obj, Volume) and isinstance(ev, (EventCreate, EventUpdate)):
+                    self._attempts.pop(obj.id, None)
+                    self.queue.outdated(obj.id)
+                    self.queue.enqueue(obj.id)
+                elif isinstance(obj, Task) and isinstance(
+                    ev, (EventCreate, EventUpdate, EventDelete)
+                ):
+                    # task movement can free a node's last use of a volume
+                    for vid in obj.volumes:
+                        self.queue.enqueue(vid)
+        finally:
+            queue.stop_watch(ch)
+
+    def _run_queue(self):
+        while not self._stop.is_set():
+            item = self.queue.wait(timeout=0.5)
+            if item is None:
+                continue
+            vid, _attempt = item
+            try:
+                self._process_volume(vid)
+                self._attempts.pop(vid, None)
+            except Exception:
+                attempt = self._attempts.get(vid, 0) + 1
+                self._attempts[vid] = attempt
+                self.queue.enqueue(vid, attempt=attempt)
+
+    # -- reconciliation ----------------------------------------------------
+
+    def _process_volume(self, volume_id: str):
+        v = self.store.view(lambda tx: tx.get_volume(volume_id))
+        if v is None:
+            return
+        plugin = self.plugins.get(v.spec.driver)
+
+        # 1. creation (manager.go createVolume)
+        if v.volume_info is None and not v.pending_delete:
+            info = plugin.create_volume(v)
+
+            def set_info(tx):
+                cur = tx.get_volume(volume_id)
+                if cur is not None and cur.volume_info is None:
+                    cur.volume_info = info
+                    tx.update(cur)
+
+            self.store.update(set_info)
+            return
+
+        # 2/3. publish & unpublish reconciliation (manager.go handleVolume)
+        def nodes_needing(tx) -> set[str]:
+            need = set()
+            for t in tx.find_tasks(by.All()):
+                if (
+                    volume_id in t.volumes
+                    and t.node_id
+                    and t.desired_state <= TaskState.RUNNING
+                ):
+                    need.add(t.node_id)
+            return need
+
+        needed = self.store.view(nodes_needing)
+        statuses = {s.node_id: s for s in v.publish_status}
+
+        # new nodes → PENDING_PUBLISH entries
+        missing = needed - set(statuses)
+        # nodes no longer needed → start node-unpublish handshake
+        stale = [
+            s for s in v.publish_status
+            if s.node_id not in needed and s.state == PUBLISHED
+        ]
+        if (missing or stale) and not v.pending_delete:
+            def mark(tx):
+                cur = tx.get_volume(volume_id)
+                if cur is None:
+                    return
+                have = {s.node_id for s in cur.publish_status}
+                for n in sorted(missing):
+                    if n not in have:
+                        cur.publish_status.append(VolumePublishStatus(node_id=n))
+                for s in cur.publish_status:
+                    if s.node_id not in needed and s.state == PUBLISHED:
+                        s.state = PENDING_NODE_UNPUBLISH
+                tx.update(cur)
+
+            self.store.update(mark)
+            v = self.store.view(lambda tx: tx.get_volume(volume_id))
+            if v is None:
+                return
+
+        # drive controller calls for pending states
+        changed = False
+        results: dict[str, tuple[str, dict]] = {}
+        errors: list[Exception] = []
+        for s in v.publish_status:
+            if s.state == PENDING_PUBLISH:
+                try:
+                    ctx = plugin.controller_publish(v, s.node_id)
+                    results[s.node_id] = (PUBLISHED, ctx)
+                    changed = True
+                except Exception as exc:
+                    errors.append(exc)
+            elif s.state == PENDING_UNPUBLISH:
+                try:
+                    plugin.controller_unpublish(v, s.node_id)
+                    results[s.node_id] = ("remove", {})
+                    changed = True
+                except Exception as exc:
+                    errors.append(exc)
+
+        if changed:
+            def apply(tx):
+                cur = tx.get_volume(volume_id)
+                if cur is None:
+                    return
+                keep = []
+                for s in cur.publish_status:
+                    res = results.get(s.node_id)
+                    if res is None:
+                        keep.append(s)
+                        continue
+                    state, ctx = res
+                    if state == "remove" and s.state == PENDING_UNPUBLISH:
+                        continue  # fully unpublished
+                    if state == PUBLISHED and s.state == PENDING_PUBLISH:
+                        s.state = PUBLISHED
+                        s.publish_context = ctx
+                    keep.append(s)
+                cur.publish_status = keep
+                tx.update(cur)
+
+            self.store.update(apply)
+            v = self.store.view(lambda tx: tx.get_volume(volume_id))
+            if v is None:
+                return
+
+        # 4. deletion (manager.go handleVolume pending_delete path)
+        if v.pending_delete:
+            if any(s.state == PUBLISHED for s in v.publish_status):
+                def drain(tx):
+                    cur = tx.get_volume(volume_id)
+                    if cur is None:
+                        return
+                    for s in cur.publish_status:
+                        if s.state == PUBLISHED:
+                            s.state = PENDING_NODE_UNPUBLISH
+                    tx.update(cur)
+
+                self.store.update(drain)
+                raise RuntimeError("waiting for unpublish before delete")
+            if v.publish_status:
+                raise RuntimeError("waiting for unpublish before delete")
+            if v.volume_info is not None:
+                plugin.delete_volume(v)
+            self.store.update(lambda tx: tx.delete(Volume, volume_id))
+            return
+
+        if errors:
+            raise errors[0]
+
+    # -- agent confirmation (dispatcher UpdateVolumeStatus path) -----------
+
+    def confirm_node_unpublish(self, volume_id: str, node_id: str):
+        """The agent finished node-side unpublish: advance to
+        PENDING_UNPUBLISH so the controller can detach (manager.go
+        UpdateVolumeStatus handling)."""
+        advance_node_unpublish(self.store, node_id, [volume_id])
+        self.queue.enqueue(volume_id)
+
+
+def advance_node_unpublish(store, node_id: str, volume_ids: list[str]):
+    """Shared PENDING_NODE_UNPUBLISH → PENDING_UNPUBLISH transition — the
+    single implementation behind both Dispatcher.update_volume_status and
+    VolumeManager.confirm_node_unpublish."""
+
+    def txn(tx):
+        for vid in volume_ids:
+            v = tx.get_volume(vid)
+            if v is None:
+                continue
+            changed = False
+            for s in v.publish_status:
+                if s.node_id == node_id and s.state == PENDING_NODE_UNPUBLISH:
+                    s.state = PENDING_UNPUBLISH
+                    changed = True
+            if changed:
+                tx.update(v)
+
+    store.update(txn)

@@ -1,0 +1,146 @@
+"""CSI plugin interface + publish-status model.
+
+Re-derivation of manager/csi/plugin.go + api/objects.proto VolumePublishStatus:
+the manager drives a controller plugin (create/delete/publish/unpublish);
+agents drive the node side (stage/publish). Real deployments speak CSI gRPC
+to plugin sockets; the interface below is that wire surface, and
+`FakeCSIPlugin` is the test double (testutils/fake_plugingetter.go analogue).
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+
+# Publish lifecycle (api/objects.proto VolumePublishStatus.State; the
+# manager moves down, the agent confirms the node-unpublish step)
+PENDING_PUBLISH = "pending_publish"
+PUBLISHED = "published"
+PENDING_NODE_UNPUBLISH = "pending_node_unpublish"
+PENDING_UNPUBLISH = "pending_controller_unpublish"
+
+
+@dataclass
+class VolumePublishStatus:
+    node_id: str
+    state: str = PENDING_PUBLISH
+    publish_context: dict[str, str] = field(default_factory=dict)
+    message: str = ""
+
+
+@dataclass
+class VolumeInfo:
+    """api/objects.proto VolumeInfo: what the plugin reports on creation."""
+
+    volume_id: str = ""
+    capacity_bytes: int = 0
+    volume_context: dict[str, str] = field(default_factory=dict)
+    accessible_topology: list[dict[str, str]] = field(default_factory=list)
+
+
+class CSIPluginError(Exception):
+    pass
+
+
+class CSIPlugin:
+    """Controller + node RPC surface (manager/csi/plugin.go Plugin;
+    agent/csi/plugin/plugin.go NodePlugin)."""
+
+    name = "csi-plugin"
+
+    # controller side (manager)
+    def create_volume(self, volume) -> VolumeInfo:
+        raise NotImplementedError
+
+    def delete_volume(self, volume) -> None:
+        raise NotImplementedError
+
+    def controller_publish(self, volume, node_id: str) -> dict[str, str]:
+        """Returns the publish context for the node."""
+        raise NotImplementedError
+
+    def controller_unpublish(self, volume, node_id: str) -> None:
+        raise NotImplementedError
+
+    # node side (agent)
+    def node_stage(self, volume_assignment) -> None:
+        raise NotImplementedError
+
+    def node_unstage(self, volume_assignment) -> None:
+        raise NotImplementedError
+
+    def node_publish(self, volume_assignment) -> None:
+        raise NotImplementedError
+
+    def node_unpublish(self, volume_assignment) -> None:
+        raise NotImplementedError
+
+
+class PluginGetter:
+    """name -> plugin registry (manager/csi/manager.go newPluginManager)."""
+
+    def __init__(self, plugins: dict[str, CSIPlugin] | None = None):
+        self._plugins = dict(plugins or {})
+
+    def add(self, plugin: CSIPlugin):
+        self._plugins[plugin.name] = plugin
+
+    def get(self, name: str) -> CSIPlugin:
+        if name not in self._plugins:
+            raise CSIPluginError(f"no CSI plugin {name!r}")
+        return self._plugins[name]
+
+    def names(self) -> list[str]:
+        return sorted(self._plugins)
+
+
+class FakeCSIPlugin(CSIPlugin):
+    """Deterministic fake with failure injection and a call log."""
+
+    def __init__(self, name: str = "fake-csi", topology: list[dict[str, str]] | None = None):
+        self.name = name
+        self.topology = topology or []
+        self.calls: list[tuple] = []
+        self.fail_next: set[str] = set()  # op names that fail once
+        self._lock = threading.Lock()
+        self._serial = 0
+
+    def _record(self, op: str, *args):
+        with self._lock:
+            self.calls.append((op, *args))
+            if op in self.fail_next:
+                self.fail_next.discard(op)
+                raise CSIPluginError(f"{op} failed (injected)")
+
+    def create_volume(self, volume) -> VolumeInfo:
+        self._record("create_volume", volume.id)
+        with self._lock:
+            self._serial += 1
+            serial = self._serial
+        return VolumeInfo(
+            volume_id=f"{self.name}-vol-{serial}",
+            capacity_bytes=1 << 30,
+            accessible_topology=list(self.topology),
+        )
+
+    def delete_volume(self, volume) -> None:
+        self._record("delete_volume", volume.id)
+
+    def controller_publish(self, volume, node_id: str) -> dict[str, str]:
+        self._record("controller_publish", volume.id, node_id)
+        return {"device": f"/dev/{volume.id[:8]}"}
+
+    def controller_unpublish(self, volume, node_id: str) -> None:
+        self._record("controller_unpublish", volume.id, node_id)
+
+    def node_stage(self, volume_assignment) -> None:
+        self._record("node_stage", volume_assignment.volume_id)
+
+    def node_unstage(self, volume_assignment) -> None:
+        self._record("node_unstage", volume_assignment.volume_id)
+
+    def node_publish(self, volume_assignment) -> None:
+        self._record("node_publish", volume_assignment.volume_id)
+
+    def node_unpublish(self, volume_assignment) -> None:
+        self._record("node_unpublish", volume_assignment.volume_id)

@@ -1,0 +1,196 @@
+"""Scheduler-side cluster-volume feasibility and reservation.
+
+Re-derivation of manager/scheduler/volumes.go:45-327 (`volumeSet`) and
+topology.go: for each CSI mount of a task, pick a live volume matching the
+mount source (name, or `group:<name>`), honoring availability, access-mode
+scope/sharing, node topology, and single-scope in-use reservations;
+`check_volumes_on_node` is the VolumesFilter predicate and
+`choose_task_volumes` the commit-time selection (reservation recorded so
+parallel groups in one tick don't oversubscribe single-scope volumes).
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from ..api.objects import Volume
+from ..csi.plugin import PENDING_NODE_UNPUBLISH, PENDING_UNPUBLISH
+
+
+GROUP_PREFIX = "group:"
+
+
+@dataclass
+class _VolumeUsage:
+    pub_nodes: set[str] = field(default_factory=set)  # store publish_status
+    task_nodes: dict[str, str] = field(default_factory=dict)  # task -> node
+
+    @property
+    def nodes(self) -> set[str]:
+        """Nodes currently tied to the volume: published there, or reserved
+        by a task placed there. Derived, so unpublish/release actually frees
+        single-scope volumes for other nodes."""
+        return self.pub_nodes | set(self.task_nodes.values())
+
+    @property
+    def tasks(self) -> set[str]:
+        return set(self.task_nodes)
+
+
+def task_csi_mounts(task) -> list:
+    runtime = task.spec.runtime
+    if runtime is None:
+        return []
+    return [m for m in runtime.mounts if m.type == "csi"]
+
+
+class VolumeSet:
+    """volumes.go volumeSet: store-shadowed volume state + reservations."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.volumes: dict[str, Volume] = {}
+        self.by_group: dict[str, set[str]] = {}
+        self.by_name: dict[str, str] = {}
+        self.usage: dict[str, _VolumeUsage] = {}
+
+    # -- store shadowing ---------------------------------------------------
+
+    def add_or_update_volume(self, v: Volume):
+        with self._lock:
+            old = self.volumes.get(v.id)
+            if old is not None:
+                self.by_name.pop(old.spec.annotations.name, None)
+                if old.spec.group:
+                    self.by_group.get(old.spec.group, set()).discard(v.id)
+            self.volumes[v.id] = v
+            self.by_name[v.spec.annotations.name] = v.id
+            if v.spec.group:
+                self.by_group.setdefault(v.spec.group, set()).add(v.id)
+            usage = self.usage.setdefault(v.id, _VolumeUsage())
+            # published/pending nodes count as usage (volumes.go restore
+            # path); rebuilt each update so unpublished nodes are released
+            usage.pub_nodes = {
+                st.node_id
+                for st in v.publish_status
+                if st.state not in (PENDING_NODE_UNPUBLISH, PENDING_UNPUBLISH)
+            }
+
+    def remove_volume(self, volume_id: str):
+        with self._lock:
+            v = self.volumes.pop(volume_id, None)
+            if v is None:
+                return
+            self.by_name.pop(v.spec.annotations.name, None)
+            if v.spec.group:
+                self.by_group.get(v.spec.group, set()).discard(volume_id)
+            self.usage.pop(volume_id, None)
+
+    def reserve_task(self, task):
+        """Record a placed task's volumes (setup from store snapshot)."""
+        with self._lock:
+            for vid in task.volumes:
+                u = self.usage.setdefault(vid, _VolumeUsage())
+                u.task_nodes[task.id] = task.node_id or ""
+
+    def release_task(self, task):
+        """volumes.go freeVolumes: a task died — its reservations drop (the
+        node publication itself is undone by the CSI manager)."""
+        with self._lock:
+            for vid in task.volumes:
+                u = self.usage.get(vid)
+                if u is not None:
+                    u.task_nodes.pop(task.id, None)
+
+    # -- feasibility -------------------------------------------------------
+
+    def _candidates(self, source: str) -> list[Volume]:
+        if source.startswith(GROUP_PREFIX):
+            ids = self.by_group.get(source[len(GROUP_PREFIX) :], set())
+            return [self.volumes[i] for i in ids]
+        vid = self.by_name.get(source)
+        return [self.volumes[vid]] if vid else []
+
+    def _usable_on_node(self, v: Volume, node) -> bool:
+        """volumes.go isVolumeAvailableOnNode: availability, scope/sharing,
+        in-use nodes, topology."""
+        if v.spec.availability != "active":
+            return False
+        if v.pending_delete:
+            return False
+        u = self.usage.get(v.id, _VolumeUsage())
+        mode = v.spec.access_mode
+        node_id = node.node.id if hasattr(node, "node") else node.id
+        if mode.scope == "single" and u.nodes and node_id not in u.nodes:
+            return False
+        if mode.sharing == "none" and u.tasks:
+            return False
+        if mode.sharing == "onewriter" and u.tasks:
+            # feasibility only — the writer check needs the mount's readonly
+            # flag, applied in choose(); conservatively allow here
+            pass
+        # the node must run the volume's CSI driver (volumes.go
+        # isVolumeAvailableOnNode: no NodeCSIInfo for the driver → no)
+        desc = node.node.description if hasattr(node, "node") else node.description
+        if desc is None:
+            return False
+        csi_info = desc.csi_info or {}
+        ninfo = csi_info.get(v.spec.driver)
+        if ninfo is None and v.spec.driver not in desc.csi_plugins:
+            return False
+        # topology: node's per-plugin accessible segments must cover one of
+        # the volume's accessible topologies (topology.go IsInTopology)
+        info = v.volume_info
+        topos = info.accessible_topology if info is not None else []
+        if topos:
+            segments = ninfo.accessible_topology if ninfo is not None else {}
+            if not any(
+                all(segments.get(k) == val for k, val in topo.items())
+                for topo in topos
+            ):
+                return False
+        return True
+
+    def check_volumes_on_node(self, node, task) -> bool:
+        """VolumesFilter predicate (filter.go:388-447)."""
+        with self._lock:
+            for m in task_csi_mounts(task):
+                cands = self._candidates(m.source)
+                if not any(self._usable_on_node(v, node) for v in cands):
+                    return False
+        return True
+
+    # -- selection ---------------------------------------------------------
+
+    def choose_task_volumes(self, task, node) -> list[str] | None:
+        """volumes.go chooseTaskVolumes: pick one volume per CSI mount for
+        this node and reserve them; None if any mount is unsatisfiable
+        (the scheduler retries the task next tick)."""
+        chosen: list[str] = []
+        with self._lock:
+            node_id = node.node.id if hasattr(node, "node") else node.id
+            for m in task_csi_mounts(task):
+                pick = None
+                for v in sorted(self._candidates(m.source), key=lambda v: v.id):
+                    if not self._usable_on_node(v, node):
+                        continue
+                    u = self.usage.get(v.id, _VolumeUsage())
+                    if (
+                        v.spec.access_mode.sharing == "onewriter"
+                        and not m.readonly
+                        and any(u.tasks)
+                    ):
+                        continue
+                    pick = v
+                    break
+                if pick is None:
+                    # roll back reservations made for earlier mounts
+                    for vid in chosen:
+                        u = self.usage.get(vid)
+                        if u is not None:
+                            u.task_nodes.pop(task.id, None)
+                    return None
+                chosen.append(pick.id)
+                u = self.usage.setdefault(pick.id, _VolumeUsage())
+                u.task_nodes[task.id] = node_id
+        return chosen

@@ -76,6 +76,7 @@ class Session:
     known_tasks: dict[str, int] = field(default_factory=dict)  # id -> version
     known_secrets: set[str] = field(default_factory=set)
     known_configs: set[str] = field(default_factory=set)
+    known_volumes: set[str] = field(default_factory=set)
 
 
 class Dispatcher:
@@ -178,6 +179,17 @@ class Dispatcher:
             self._status_queue.extend(updates)
             self._status_cond.notify_all()
 
+    def update_volume_status(self, node_id: str, session_id: str,
+                             unpublished: list[str]):
+        """The agent confirms node-side unpublish of volumes
+        (dispatcher.proto UpdateVolumeStatus): advance
+        PENDING_NODE_UNPUBLISH → PENDING_UNPUBLISH so the CSI manager can
+        controller-detach (the store event wakes its reconciler)."""
+        from ..csi.manager import advance_node_unpublish
+
+        self._session(node_id, session_id)
+        advance_node_unpublish(self.store, node_id, unpublished)
+
     def leave(self, node_id: str, session_id: str):
         """Graceful node departure."""
         session = self._session(node_id, session_id)
@@ -256,6 +268,16 @@ class Dispatcher:
             # conservatively refresh all sessions (reference diffs references)
             with self._lock:
                 self._dirty_nodes.update(self._sessions.keys())
+        else:
+            from ..api.objects import Volume
+
+            if isinstance(obj, Volume):
+                # publish-status changes gate volume assignment shipping
+                with self._lock:
+                    self._dirty_nodes.update(
+                        {s.node_id for s in obj.publish_status}
+                        & set(self._sessions.keys())
+                    )
 
     # ---------------------------------------------------- assignment building
     def _relevant_tasks(self, tx, node_id: str) -> list[Task]:
@@ -265,12 +287,35 @@ class Dispatcher:
             and t.desired_state <= TaskState.REMOVE
         ]
 
-    def _referenced_deps(self, tx, tasks) -> tuple[dict, dict]:
-        secrets, configs = {}, {}
+    def _referenced_deps(self, tx, tasks, node_id: str) -> tuple[dict, dict, dict]:
+        """Secrets/configs the node's tasks reference, plus cluster-volume
+        assignments already controller-published to this node
+        (assignments.go:21-81; volumes ship once PUBLISHED so the agent can
+        node-stage them)."""
+        from ..agent.csi import VolumeAssignment
+        from ..csi.plugin import PUBLISHED
+
+        secrets, configs, volumes = {}, {}, {}
         for t in tasks:
             # desired COMPLETE is a live job task and still needs its deps
             if t.desired_state > TaskState.COMPLETE:
                 continue
+            for vid in t.volumes:
+                v = tx.get_volume(vid)
+                if v is None:
+                    continue
+                for st in v.publish_status:
+                    if st.node_id == node_id and st.state == PUBLISHED:
+                        volumes[vid] = VolumeAssignment(
+                            id=v.id,
+                            volume_id=v.volume_info.volume_id if v.volume_info else "",
+                            driver=v.spec.driver,
+                            volume_context=dict(
+                                v.volume_info.volume_context
+                            ) if v.volume_info else {},
+                            publish_context=dict(st.publish_context),
+                            availability=v.spec.availability,
+                        )
             runtime = t.spec.runtime
             if runtime is None:
                 continue
@@ -282,23 +327,26 @@ class Dispatcher:
                 c = tx.get_config(ref.config_id)
                 if c is not None:
                     configs[c.id] = c
-        return secrets, configs
+        return secrets, configs, volumes
 
     def _full_assignment(self, session: Session) -> AssignmentsMessage:
         def cb(tx):
             tasks = self._relevant_tasks(tx, session.node_id)
-            secrets, configs = self._referenced_deps(tx, tasks)
-            return tasks, secrets, configs
+            secrets, configs, volumes = self._referenced_deps(
+                tx, tasks, session.node_id)
+            return tasks, secrets, configs, volumes
 
-        tasks, secrets, configs = self.store.view(cb)
+        tasks, secrets, configs, volumes = self.store.view(cb)
         session.known_tasks = {t.id: t.meta.version.index for t in tasks}
         session.known_secrets = set(secrets)
         session.known_configs = set(configs)
+        session.known_volumes = set(volumes)
         session.sequence += 1
         changes = (
             [Assignment("update", "task", t.copy()) for t in tasks]
             + [Assignment("update", "secret", s.copy()) for s in secrets.values()]
             + [Assignment("update", "config", c.copy()) for c in configs.values()]
+            + [Assignment("update", "volume", v) for v in volumes.values()]
         )
         return AssignmentsMessage("complete", session.sequence, changes)
 
@@ -315,10 +363,11 @@ class Dispatcher:
     def _incremental(self, session: Session) -> AssignmentsMessage:
         def cb(tx):
             tasks = self._relevant_tasks(tx, session.node_id)
-            secrets, configs = self._referenced_deps(tx, tasks)
-            return tasks, secrets, configs
+            secrets, configs, volumes = self._referenced_deps(
+                tx, tasks, session.node_id)
+            return tasks, secrets, configs, volumes
 
-        tasks, secrets, configs = self.store.view(cb)
+        tasks, secrets, configs, volumes = self.store.view(cb)
         changes: list[Assignment] = []
         new_known = {t.id: t.meta.version.index for t in tasks}
         for t in tasks:
@@ -338,9 +387,15 @@ class Dispatcher:
                 changes.append(Assignment("update", "config", c.copy()))
         for cid in session.known_configs - set(configs):
             changes.append(Assignment("remove", "config", cid))
+        for vid, v in volumes.items():
+            if vid not in session.known_volumes:
+                changes.append(Assignment("update", "volume", v))
+        for vid in session.known_volumes - set(volumes):
+            changes.append(Assignment("remove", "volume", vid))
         session.known_tasks = new_known
         session.known_secrets = set(secrets)
         session.known_configs = set(configs)
+        session.known_volumes = set(volumes)
         if changes:
             session.sequence += 1
         return AssignmentsMessage("incremental", session.sequence, changes)

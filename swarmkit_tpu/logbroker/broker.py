@@ -67,6 +67,7 @@ class _Subscription:
         self.follow = follow
         self.client = Channel(matcher=None, limit=None)
         self.nodes: set[str] = set()  # nodes the subscription was sent to
+        self.known_tasks: set[str] = set()  # tasks seen when last dispatched
         self.done = False
 
 
@@ -173,16 +174,25 @@ class LogBroker:
                 out[t.id] = t
         return list(out.values())
 
-    def _dispatch_to_nodes(self, sub: _Subscription):
+    def _dispatch_to_nodes(self, sub: _Subscription, force_nodes: set[str] = frozenset()):
+        """Send the subscription to every node that gained a matching task —
+        whether the node is new to the subscription or already receiving it
+        (broker.go subscription.Run re-runs the match on task events).
+        Re-offers are idempotent: agents dedupe pumped logs per task, not
+        per subscription id, so `force_nodes` (nodes with fresh task events)
+        are always re-notified to close the offer-before-task-start race."""
         tasks = self.store.view(lambda tx: self._match_tasks(tx, sub.selector))
-        target_nodes = {t.node_id for t in tasks if t.node_id}
         msg = SubscriptionMessage(id=sub.id, selector=sub.selector, follow=sub.follow)
         with self._lock:
-            new_nodes = target_nodes - sub.nodes
-            sub.nodes |= new_nodes
-            offers = [
-                self._listeners[n] for n in new_nodes if n in self._listeners
-            ]
+            notify: set[str] = set(force_nodes)
+            for t in tasks:
+                if not t.node_id:
+                    continue
+                if t.node_id not in sub.nodes or t.id not in sub.known_tasks:
+                    notify.add(t.node_id)
+            sub.nodes |= notify
+            sub.known_tasks = {t.id for t in tasks if t.node_id}
+            offers = [self._listeners[n] for n in notify if n in self._listeners]
         for ch in offers:
             ch._offer(msg)
 
@@ -206,10 +216,18 @@ class LogBroker:
                         self._dispatch_to_nodes(s)
                     continue
                 if isinstance(ev, (EventCreate, EventUpdate)) and isinstance(ev.obj, Task):
+                    t = ev.obj
                     with self._lock:
                         subs = [s for s in self._subs.values() if s.follow and not s.done]
                     for s in subs:
-                        self._dispatch_to_nodes(s)
+                        sel = s.selector
+                        matches = (
+                            t.id in sel.task_ids
+                            or t.service_id in sel.service_ids
+                            or t.node_id in sel.node_ids
+                        )
+                        force = {t.node_id} if (matches and t.node_id) else set()
+                        self._dispatch_to_nodes(s, force_nodes=force)
         finally:
             queue.stop_watch(ch)
 

@@ -51,6 +51,7 @@ class Manager:
         org: str = "swarmkit-tpu",
         heartbeat_period: float = 5.0,
         key_rotation_interval: float = 12 * 3600.0,
+        csi_plugins=None,
     ):
         self.store = store if store is not None else MemoryStore()
         self.security = security
@@ -81,6 +82,7 @@ class Manager:
         # leader-only components, created on become_leader
         self._leader_components: list = []
         self.key_rotation_interval = key_rotation_interval
+        self.csi_plugins = csi_plugins
 
         if self.raft is not None:
             self.raft.on_leadership = self._on_leadership
@@ -151,6 +153,10 @@ class Manager:
             RoleManager(self.store, raft_node=self.raft),
             MetricsCollector(self.store),
         ]
+        if self.csi_plugins is not None:
+            from ..csi.manager import VolumeManager
+
+            components.append(VolumeManager(self.store, self.csi_plugins))
         for c in components:
             c.start()
         with self._lock:

@@ -172,6 +172,7 @@ def encode(
     now: float | None = None,
     max_constraints: int = 8,
     max_platforms: int = 4,
+    volume_set=None,
 ) -> EncodedProblem:
     node_infos = sorted(node_infos, key=lambda i: i.node.id)
     groups = sorted(groups, key=lambda g: g.key)
@@ -369,5 +370,21 @@ def encode(
             gi = group_row.get(skey)
             if gi is not None and info.penalized(skey, now):
                 p.penalty[gi, n] = True
+
+    # CSI volume feasibility: host-side extra_mask correction, like node.ip
+    # (scheduler/volumes.go isVolumeAvailableOnNode is string/set logic on
+    # small cardinalities — not worth a kernel column)
+    if volume_set is not None:
+        from ..csi.volumes import task_csi_mounts
+
+        for gi, g in enumerate(groups):
+            probe = g.tasks[0]
+            if not task_csi_mounts(probe):
+                continue
+            for n, info in enumerate(node_infos):
+                if p.extra_mask[gi, n] and not volume_set.check_volumes_on_node(
+                    info, probe
+                ):
+                    p.extra_mask[gi, n] = False
 
     return p

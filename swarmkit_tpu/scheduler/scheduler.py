@@ -57,6 +57,8 @@ class Scheduler:
         self.unassigned: dict[str, Task] = {}
         self.preassigned: dict[str, Task] = {}
         self.pending_spec_version: dict[str, int] = {}
+        from ..csi.volumes import VolumeSet
+        self.volume_set = VolumeSet()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.ticks = 0
@@ -77,14 +79,18 @@ class Scheduler:
         """Snapshot + subscribe (reference setupTasksList, scheduler.go:68-125)."""
 
         def snap(tx):
-            return tx.find_tasks(), tx.find_nodes()
+            return tx.find_tasks(), tx.find_nodes(), tx.find_volumes()
 
         # Unbounded subscription: the scheduler is a trusted in-process
         # consumer and must never be shed as a slow subscriber — a closed
         # channel would silently stop all scheduling.
-        (tasks, nodes), ch = self.store.view_and_watch(snap, limit=None)
+        (tasks, nodes, volumes), ch = self.store.view_and_watch(snap, limit=None)
+        for v in volumes:
+            self.volume_set.add_or_update_volume(v)
         tasks_by_node: dict[str, dict[str, Task]] = defaultdict(dict)
         for t in tasks:
+            if t.volumes and t.desired_state <= TaskState.RUNNING:
+                self.volume_set.reserve_task(t)
             if t.status.state < TaskState.PENDING or t.status.state > TaskState.RUNNING:
                 continue
             # desired_state == COMPLETE covers job-mode tasks; anything past
@@ -141,6 +147,8 @@ class Scheduler:
                     # state, desired crossings only flip active counts via
                     # add_task, nodeinfo.go:111-119)
                     if info.remove_task(t):
+                        if t.volumes:
+                            self.volume_set.release_task(t)
                         if t.status.state == TaskState.FAILED:
                             key = (t.service_id,
                                    t.spec_version.index if t.spec_version else 0)
@@ -157,6 +165,8 @@ class Scheduler:
             t = ev.obj
             self.unassigned.pop(t.id, None)
             self.preassigned.pop(t.id, None)
+            if t.volumes:
+                self.volume_set.release_task(t)
             if t.node_id and t.node_id in self.node_infos:
                 self.node_infos[t.node_id].remove_task(t)
             return True
@@ -165,6 +175,14 @@ class Scheduler:
             return True
         if isinstance(ev, EventDelete) and isinstance(ev.obj, Node):
             self._remove_node(ev.obj.id)
+            return True
+        from ..api.objects import Volume as _Volume
+
+        if isinstance(ev, (EventCreate, EventUpdate)) and isinstance(ev.obj, _Volume):
+            self.volume_set.add_or_update_volume(ev.obj)
+            return True
+        if isinstance(ev, EventDelete) and isinstance(ev.obj, _Volume):
+            self.volume_set.remove_volume(ev.obj.id)
             return True
         return False
 
@@ -222,7 +240,8 @@ class Scheduler:
         groups = self._group_unassigned()
         if not groups:
             return
-        problem = encode(list(self.node_infos.values()), groups)
+        problem = encode(list(self.node_infos.values()), groups,
+                         volume_set=self.volume_set)
         n_nodes = len(problem.node_ids)
         total_tasks = int(problem.n_tasks.sum())
         use_jax = (self.backend == "jax"
@@ -279,6 +298,15 @@ class Scheduler:
                         if node is None or node.status.state != NodeStatusState.READY:
                             return  # conflicted: retry next tick
                         cur = cur.copy()
+                        # CSI volumes chosen at commit time, with the
+                        # reservation re-check the reference does in-tx
+                        # (scheduler.go:533-604 volume availability)
+                        from ..csi.volumes import task_csi_mounts
+                        if task_csi_mounts(cur):
+                            chosen = self.volume_set.choose_task_volumes(cur, node)
+                            if chosen is None:
+                                return  # conflicted: retry next tick
+                            cur.volumes = chosen
                         cur.node_id = node_id
                         cur.status.state = TaskState.ASSIGNED
                         cur.status.message = "scheduler assigned task to node"
@@ -353,7 +381,7 @@ class Scheduler:
         # self.unassigned; node/task events retrigger the tick
 
     def _explain(self, group: TaskGroup) -> str:
-        pipeline = Pipeline()
+        pipeline = Pipeline(self.volume_set)
         pipeline.set_task(group.tasks[0])
         for info in self.node_infos.values():
             pipeline.process(info)
@@ -365,7 +393,7 @@ class Scheduler:
         (reference processPreassignedTasks/taskFitNode, scheduler.go:398-426)."""
         tasks = list(self.preassigned.values())
         decided: list[tuple[Task, bool]] = []
-        pipeline = Pipeline()
+        pipeline = Pipeline(self.volume_set)
         for t in tasks:
             info = self.node_infos.get(t.node_id)
             if info is None:

@@ -1,0 +1,301 @@
+"""CSI volume subsystem tests (reference model: manager/csi/manager_test.go,
+manager/scheduler volume tests, agent/csi tests)."""
+import time
+
+import pytest
+
+from swarmkit_tpu.api.objects import Node, Task, Volume
+from swarmkit_tpu.api.specs import (
+    Annotations,
+    ContainerSpec,
+    NodeCSIInfo,
+    NodeDescription,
+    Platform,
+    Resources,
+    ServiceSpec,
+    TaskSpec,
+    VolumeAccessMode,
+    VolumeMount,
+    VolumeSpec,
+)
+from swarmkit_tpu.api.types import NodeAvailability, NodeStatusState, TaskState
+from swarmkit_tpu.csi import (
+    PENDING_NODE_UNPUBLISH,
+    PENDING_UNPUBLISH,
+    PUBLISHED,
+    FakeCSIPlugin,
+    PluginGetter,
+    VolumeManager,
+    VolumeSet,
+)
+from swarmkit_tpu.store import by
+from swarmkit_tpu.store.memory import MemoryStore
+
+from test_scheduler import wait_for
+
+
+def _volume(vid="v1", name="vol1", driver="fake-csi", group="", scope="multi",
+            sharing="all", availability="active"):
+    v = Volume(id=vid)
+    v.spec = VolumeSpec(
+        annotations=Annotations(name=name),
+        group=group,
+        driver=driver,
+        access_mode=VolumeAccessMode(scope=scope, sharing=sharing),
+        availability=availability,
+    )
+    return v
+
+
+def _node(nid="n1", topo=None, csi=True):
+    n = Node(id=nid)
+    n.description = NodeDescription(
+        hostname=nid, platform=Platform(os="linux", architecture="amd64"),
+        resources=Resources(nano_cpus=8 * 10**9, memory_bytes=16 * 2**30),
+    )
+    if csi:
+        n.description.csi_info["fake-csi"] = NodeCSIInfo(
+            plugin_name="fake-csi", node_id=f"csi-{nid}",
+            accessible_topology=topo or {},
+        )
+    n.status.state = NodeStatusState.READY
+    n.spec.availability = NodeAvailability.ACTIVE
+    return n
+
+
+def _csi_task(tid="t1", source="vol1"):
+    t = Task(id=tid, service_id="svc1")
+    t.spec = TaskSpec(
+        runtime=ContainerSpec(
+            mounts=[VolumeMount(source=source, target="/data", type="csi")]
+        )
+    )
+    t.status.state = TaskState.PENDING
+    t.desired_state = TaskState.RUNNING
+    return t
+
+
+# -- VolumeSet ---------------------------------------------------------------
+
+
+def test_volumeset_name_and_group_matching():
+    vs = VolumeSet()
+    vs.add_or_update_volume(_volume("v1", "vol1"))
+    vs.add_or_update_volume(_volume("v2", "vol2", group="fast"))
+    node = _node()
+
+    assert vs.check_volumes_on_node(node, _csi_task(source="vol1"))
+    assert vs.check_volumes_on_node(node, _csi_task(source="group:fast"))
+    assert not vs.check_volumes_on_node(node, _csi_task(source="missing"))
+    assert not vs.check_volumes_on_node(node, _csi_task(source="group:slow"))
+
+
+def test_volumeset_availability_and_scope():
+    vs = VolumeSet()
+    vs.add_or_update_volume(_volume("v1", "vol1", availability="drain"))
+    assert not vs.check_volumes_on_node(_node(), _csi_task())
+
+    vs = VolumeSet()
+    vs.add_or_update_volume(_volume("v1", "vol1", scope="single", sharing="all"))
+    t1 = _csi_task("t1")
+    chosen = vs.choose_task_volumes(t1, _node("n1"))
+    assert chosen == ["v1"]
+    # single-scope: second node can't use it, same node can
+    assert not vs.check_volumes_on_node(_node("n2"), _csi_task("t2"))
+    assert vs.check_volumes_on_node(_node("n1"), _csi_task("t2"))
+
+
+def test_volumeset_sharing_none_and_onewriter():
+    vs = VolumeSet()
+    vs.add_or_update_volume(_volume("v1", "vol1", sharing="none"))
+    assert vs.choose_task_volumes(_csi_task("t1"), _node()) == ["v1"]
+    assert not vs.check_volumes_on_node(_node(), _csi_task("t2"))
+
+    vs = VolumeSet()
+    vs.add_or_update_volume(_volume("v1", "vol1", sharing="onewriter"))
+    assert vs.choose_task_volumes(_csi_task("t1"), _node()) == ["v1"]
+    # second writer refused, reader allowed
+    t_reader = _csi_task("t3")
+    t_reader.spec.runtime.mounts[0].readonly = True
+    assert vs.choose_task_volumes(_csi_task("t2"), _node()) is None
+    assert vs.choose_task_volumes(t_reader, _node()) == ["v1"]
+
+
+def test_volumeset_topology():
+    from swarmkit_tpu.csi.plugin import VolumeInfo
+
+    vs = VolumeSet()
+    v = _volume("v1", "vol1")
+    v.volume_info = VolumeInfo(
+        volume_id="x", accessible_topology=[{"zone": "us-east-1a"}]
+    )
+    vs.add_or_update_volume(v)
+    good = _node("n1", topo={"zone": "us-east-1a"})
+    bad = _node("n2", topo={"zone": "us-east-1b"})
+    no_driver = _node("n3", csi=False)
+    assert vs.check_volumes_on_node(good, _csi_task())
+    assert not vs.check_volumes_on_node(bad, _csi_task())
+    assert not vs.check_volumes_on_node(no_driver, _csi_task())
+
+
+def test_volumeset_requires_driver_on_node():
+    """Nodes that don't run the volume's CSI driver are infeasible even
+    without topology constraints (volumes.go isVolumeAvailableOnNode)."""
+    vs = VolumeSet()
+    vs.add_or_update_volume(_volume("v1", "vol1"))
+    assert vs.check_volumes_on_node(_node("n1"), _csi_task())
+    assert not vs.check_volumes_on_node(_node("n2", csi=False), _csi_task())
+
+
+def test_volumeset_release():
+    vs = VolumeSet()
+    vs.add_or_update_volume(_volume("v1", "vol1", sharing="none"))
+    t = _csi_task("t1")
+    assert vs.choose_task_volumes(t, _node()) == ["v1"]
+    t.volumes = ["v1"]
+    vs.release_task(t)
+    assert vs.check_volumes_on_node(_node(), _csi_task("t2"))
+
+
+# -- VolumeManager lifecycle -------------------------------------------------
+
+
+def test_volume_manager_create_publish_unpublish_delete():
+    store = MemoryStore()
+    plugin = FakeCSIPlugin()
+    vm = VolumeManager(store, PluginGetter({plugin.name: plugin}))
+    vm.start()
+    try:
+        v = _volume("v1", "vol1")
+        store.update(lambda tx: tx.create(v))
+        # creation: volume_info recorded
+        assert wait_for(
+            lambda: store.view(lambda tx: tx.get_volume("v1")).volume_info is not None,
+            timeout=5,
+        )
+
+        # a task using the volume lands on n1 → published there
+        t = _csi_task("t1")
+        t.node_id = "n1"
+        t.volumes = ["v1"]
+        t.status.state = TaskState.ASSIGNED
+        store.update(lambda tx: tx.create(t))
+        assert wait_for(
+            lambda: any(
+                s.node_id == "n1" and s.state == PUBLISHED
+                for s in store.view(lambda tx: tx.get_volume("v1")).publish_status
+            ),
+            timeout=5,
+        )
+        assert ("controller_publish", "v1", "n1") in plugin.calls
+
+        # task terminates → node unpublish requested
+        def kill(tx):
+            cur = tx.get_task("t1")
+            cur.status.state = TaskState.COMPLETE
+            cur.desired_state = TaskState.SHUTDOWN
+            tx.update(cur)
+
+        store.update(kill)
+        assert wait_for(
+            lambda: any(
+                s.state == PENDING_NODE_UNPUBLISH
+                for s in store.view(lambda tx: tx.get_volume("v1")).publish_status
+            ),
+            timeout=5,
+        )
+        # agent confirms → controller unpublish, status removed
+        vm.confirm_node_unpublish("v1", "n1")
+        assert wait_for(
+            lambda: not store.view(lambda tx: tx.get_volume("v1")).publish_status,
+            timeout=5,
+        )
+        assert ("controller_unpublish", "v1", "n1") in plugin.calls
+
+        # delete
+        def mark_delete(tx):
+            cur = tx.get_volume("v1")
+            cur.pending_delete = True
+            tx.update(cur)
+
+        store.update(mark_delete)
+        assert wait_for(
+            lambda: store.view(lambda tx: tx.get_volume("v1")) is None, timeout=5
+        )
+        assert ("delete_volume", "v1") in plugin.calls
+    finally:
+        vm.stop()
+
+
+def test_volume_manager_retries_on_plugin_failure():
+    store = MemoryStore()
+    plugin = FakeCSIPlugin()
+    plugin.fail_next.add("create_volume")
+    vm = VolumeManager(store, PluginGetter({plugin.name: plugin}))
+    vm.start()
+    try:
+        store.update(lambda tx: tx.create(_volume("v1", "vol1")))
+        # first attempt fails; backoff retry succeeds
+        assert wait_for(
+            lambda: store.view(lambda tx: tx.get_volume("v1")).volume_info is not None,
+            timeout=5,
+        )
+        creates = [c for c in plugin.calls if c[0] == "create_volume"]
+        assert len(creates) >= 2
+    finally:
+        vm.stop()
+
+
+# -- end to end through manager + agent --------------------------------------
+
+
+def test_csi_end_to_end():
+    """Service with a CSI mount: volume created, scheduled to a node with
+    the plugin, controller-published, node-staged by the agent, task runs."""
+    from swarmkit_tpu.agent.agent import Agent
+    from swarmkit_tpu.agent.testutils import FakeExecutor
+    from swarmkit_tpu.manager import Manager
+
+    plugin = FakeCSIPlugin()
+    plugins = PluginGetter({plugin.name: plugin})
+    m = Manager(heartbeat_period=0.5, key_rotation_interval=3600.0,
+                csi_plugins=plugins)
+    m.start()
+    agents = []
+    try:
+        for i in range(2):
+            ex = FakeExecutor({"*": {"run_forever": True}}, hostname=f"w{i}")
+            a = Agent(f"w{i}", m.dispatcher, ex, csi_plugins=plugins)
+            a.start()
+            agents.append(a)
+
+        m.control_api.create_volume(
+            VolumeSpec(
+                annotations=Annotations(name="data"),
+                driver="fake-csi",
+                access_mode=VolumeAccessMode(scope="multi", sharing="all"),
+            )
+        )
+        spec = ServiceSpec(annotations=Annotations(name="db"), replicas=2)
+        spec.task.runtime = ContainerSpec(
+            mounts=[VolumeMount(source="data", target="/data", type="csi")]
+        )
+        svc = m.control_api.create_service(spec)
+
+        def running():
+            return [
+                t
+                for t in m.store.view().find_tasks(by.ByServiceID(svc.id))
+                if t.status.state == TaskState.RUNNING
+            ]
+
+        assert wait_for(lambda: len(running()) == 2, timeout=20)
+        for t in running():
+            assert t.volumes, "task scheduled without volume selection"
+        # agent staged the volume
+        assert any(c[0] == "node_stage" for c in plugin.calls)
+        assert any(c[0] == "node_publish" for c in plugin.calls)
+    finally:
+        for a in agents:
+            a.stop()
+        m.stop()

@@ -127,6 +127,58 @@ def test_end_to_end_agent_log_pump():
             c.stop()
 
 
+def test_follow_covers_new_task_on_subscribed_node():
+    """A new task for a followed service landing on an ALREADY-subscribed
+    node must still get its logs pumped (regression: per-sub dedupe must be
+    per task, not per subscription id)."""
+    from swarmkit_tpu.dispatcher.dispatcher import Dispatcher
+    from swarmkit_tpu.allocator.allocator import Allocator
+    from swarmkit_tpu.orchestrator.replicated import ReplicatedOrchestrator
+    from swarmkit_tpu.scheduler.scheduler import Scheduler
+
+    store = MemoryStore()
+    dispatcher = Dispatcher(store, heartbeat_period=0.5)
+    broker = LogBroker(store)
+    components = [dispatcher, broker, Allocator(store), Scheduler(store),
+                  ReplicatedOrchestrator(store)]
+    for c in components:
+        c.start()
+    ex = FakeExecutor({"svc-f": {"run_forever": True, "logs": ["hello"]}},
+                      hostname="w0")
+    agent = Agent("w0", dispatcher, ex, log_broker=broker)
+    agent.start()
+    try:
+        svc = Service(id="svc-f")
+        svc.spec = ServiceSpec(annotations=Annotations(name="f"), replicas=1)
+        svc.spec_version.index = 1
+        store.update(lambda tx: tx.create(svc))
+        assert wait_for(
+            lambda: sum(
+                1 for t in store.view().find_tasks(by.ByServiceID("svc-f"))
+                if t.status.state == TaskState.RUNNING
+            ) == 1,
+            timeout=15,
+        )
+        _sub, client = broker.subscribe_logs(LogSelector(service_ids=["svc-f"]))
+        first = client.get(timeout=5)
+        assert first.data == b"hello"
+
+        # scale to 2: the new task lands on the same (only) node
+        def scale(tx):
+            s = tx.get_service("svc-f")
+            s.spec.replicas = 2
+            tx.update(s)
+
+        store.update(scale)
+        second = client.get(timeout=10)
+        assert second.data == b"hello"
+        assert second.context.task_id != first.context.task_id
+    finally:
+        agent.stop()
+        for c in reversed(components):
+            c.stop()
+
+
 # -- ResourceAllocator -------------------------------------------------------
 
 
