@@ -1,0 +1,4 @@
+"""Node bootstrap (reference: node/, SURVEY.md §2.9)."""
+from .node import Node, NodeError
+
+__all__ = ["Node", "NodeError"]

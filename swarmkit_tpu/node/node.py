@@ -1,0 +1,270 @@
+"""Node bootstrap: joint worker/manager runtime.
+
+Re-derivation of node/node.go:286-533: a Node loads or obtains its TLS
+identity (local state dir, or CSR against the cluster CA using a join
+token), always runs an agent, runs an embedded manager while its role is
+manager, renews its certificate, and persists identity across restarts so a
+restarted node comes back as itself.
+
+In-process topology: `join` is a handle to an existing Manager (the
+reference dials a remote address; the wire layer rides the same seams).
+The role watcher mirrors node.go's role-change flow (agent session node
+updates → manager start/stop): it observes the node's desired role and
+flips the embedded manager.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from ..agent.agent import Agent
+from ..api.types import IssuanceState, NodeRole, NodeStatusState
+from ..ca import (
+    KeyReadWriter,
+    RootCA,
+    SecurityConfig,
+    TLSRenewer,
+    create_csr,
+)
+from ..ca.auth import Caller
+from ..manager.manager import Manager
+from ..remotes import ConnectionBroker, Remotes
+from ..utils.identity import new_id
+
+STATE_FILE = "state.json"
+CERT_FILE = "cert.pem"
+CA_FILE = "ca.pem"
+KEY_FILE = "key.json"
+
+
+class NodeError(Exception):
+    pass
+
+
+class Node:
+    """node/node.go Node: security bootstrap + agent + optional manager."""
+
+    def __init__(
+        self,
+        state_dir: str,
+        executor,
+        join: Manager | None = None,
+        join_token: str | None = None,
+        org: str = "swarmkit-tpu",
+        kek: bytes | None = None,
+        heartbeat_period: float = 5.0,
+        role_check_interval: float = 0.2,
+    ):
+        self.state_dir = state_dir
+        self.executor = executor
+        self.join = join
+        self.join_token = join_token
+        self.org = org
+        self.kek = kek
+        self.heartbeat_period = heartbeat_period
+        self.role_check_interval = role_check_interval
+
+        self.security: SecurityConfig | None = None
+        self.agent: Agent | None = None
+        self.manager: Manager | None = None
+        self.renewer: TLSRenewer | None = None
+        self.broker = ConnectionBroker(Remotes())
+        self._stop = threading.Event()
+        self._role_thread: threading.Thread | None = None
+        self._manager_lock = threading.Lock()
+
+    # -- identity persistence (node.go:1202-1286 state.json + cert dir) ----
+
+    def _paths(self):
+        return (
+            os.path.join(self.state_dir, STATE_FILE),
+            os.path.join(self.state_dir, CERT_FILE),
+            os.path.join(self.state_dir, CA_FILE),
+            os.path.join(self.state_dir, KEY_FILE),
+        )
+
+    def _save_identity(self):
+        state_path, cert_path, ca_path, key_path = self._paths()
+        os.makedirs(self.state_dir, exist_ok=True)
+        key_pem, cert_pem = self.security.key_and_cert()
+        KeyReadWriter(key_path, self.kek).write(key_pem)
+        with open(cert_path, "wb") as f:
+            f.write(cert_pem)
+        with open(ca_path, "wb") as f:
+            f.write(self.security.root_ca.cert_pem)
+        with open(state_path, "w") as f:
+            json.dump({"node_id": self.security.node_id()}, f)
+
+    def _load_identity(self) -> SecurityConfig | None:
+        """node.go loadSecurityConfig:799-910 — reuse local certs if present."""
+        state_path, cert_path, ca_path, key_path = self._paths()
+        if not (os.path.exists(cert_path) and os.path.exists(key_path)):
+            return None
+        with open(ca_path, "rb") as f:
+            root = RootCA(f.read())
+        with open(cert_path, "rb") as f:
+            cert_pem = f.read()
+        key_pem, _headers = KeyReadWriter(key_path, self.kek).read()
+        return SecurityConfig(root, key_pem, cert_pem)
+
+    # -- bootstrap ---------------------------------------------------------
+
+    def _obtain_identity(self) -> SecurityConfig:
+        loaded = self._load_identity()
+        if loaded is not None:
+            return loaded
+        if self.join is None:
+            # first node: create the cluster (manager, self-signed root)
+            return SecurityConfig.bootstrap_manager(org=self.org)
+        if not self.join_token:
+            raise NodeError("joining an existing cluster requires a join token")
+        # CSR flow against the remote CA (ca/certificates.go
+        # RequestAndSaveNewCertificates → NodeCA.IssueNodeCertificate)
+        node_id = new_id()
+        key_pem, csr_pem = create_csr(node_id, NodeRole.WORKER, self.org)
+        ca = self.join.ca_server
+        node_id = ca.issue_node_certificate(csr_pem, token=self.join_token, node_id=node_id)
+        cert = ca.node_certificate_status(node_id, timeout=30)
+        if cert is None or cert.status_state != IssuanceState.ISSUED:
+            raise NodeError(
+                f"certificate issuance failed: {getattr(cert, 'status_err', 'timeout')}"
+            )
+        root = RootCA(ca.get_root_ca_certificate())  # trust anchor only
+        return SecurityConfig(root, key_pem, cert.certificate_pem)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        self.security = self._obtain_identity()
+        self._save_identity()
+
+        if self.join is None and self.manager is None:
+            self._start_manager_bootstrap()
+
+        target = self.manager if self.join is None else self.join
+        self.broker.remotes.add(target)
+        if self.manager is not None:
+            self.broker.set_local_peer(self.manager)
+
+        self.agent = Agent(
+            self.security.node_id(),
+            target.dispatcher,
+            self.executor,
+            log_broker=target.log_broker,
+        )
+        self.agent.start()
+
+        self.renewer = TLSRenewer(self.security, target.ca_server)
+        self.renewer.start()
+
+        if self.join is not None:
+            self._role_thread = threading.Thread(
+                target=self._watch_role, name="role-watcher", daemon=True
+            )
+            self._role_thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self.renewer is not None:
+            self.renewer.stop()
+        if self.agent is not None:
+            self.agent.stop()
+        with self._manager_lock:
+            mgr, self.manager = self.manager, None
+        if mgr is not None:
+            mgr.stop()
+        if self._role_thread is not None:
+            self._role_thread.join(timeout=5)
+
+    @property
+    def node_id(self) -> str:
+        return self.security.node_id() if self.security else ""
+
+    @property
+    def role(self) -> int:
+        return self.security.role() if self.security else NodeRole.WORKER
+
+    # -- embedded manager --------------------------------------------------
+
+    def _start_manager_bootstrap(self):
+        """First-manager path (node.go runManager:983 on a fresh cluster):
+        embedded manager using this node's root, self registered READY."""
+        mgr = Manager(
+            security=self.security,
+            org=self.org,
+            heartbeat_period=self.heartbeat_period,
+        )
+        mgr.start()
+        # register ourselves in the cluster state
+        from ..api.objects import ManagerStatus, Node as NodeObj, NodeCertificate
+        from ..api.specs import NodeSpec
+
+        node_id = self.security.node_id()
+
+        def txn(tx):
+            if tx.get_node(node_id) is None:
+                n = NodeObj(
+                    id=node_id,
+                    spec=NodeSpec(desired_role=NodeRole.MANAGER),
+                    role=NodeRole.MANAGER,
+                )
+                n.status.state = NodeStatusState.READY
+                n.manager_status = ManagerStatus(leader=True)
+                n.certificate = NodeCertificate(
+                    role=NodeRole.MANAGER,
+                    status_state=IssuanceState.ISSUED,
+                    certificate_pem=self.security.key_and_cert()[1],
+                    cn=node_id,
+                )
+                tx.create(n)
+
+        mgr.store.update(txn)
+        with self._manager_lock:
+            self.manager = mgr
+
+    def _watch_role(self):
+        """Poll the cluster's view of this node's desired role and start or
+        stop the embedded manager (node.go superviseManager:1099-1194; the
+        reference receives role changes via its agent session — the store
+        poll is the in-process analogue of that notification path)."""
+        node_id = self.security.node_id()
+        while not self._stop.wait(timeout=self.role_check_interval):
+            try:
+                obj = self.join.store.view(lambda tx: tx.get_node(node_id))
+            except Exception:
+                continue
+            if obj is None:
+                continue
+            desired = obj.spec.desired_role
+            with self._manager_lock:
+                has_manager = self.manager is not None
+            if desired == NodeRole.MANAGER and not has_manager:
+                # request a manager cert, then run the manager when issued
+                try:
+                    if self.renewer is not None:
+                        self.renewer.renew_once()
+                except Exception:
+                    continue
+                if self.security.role() == NodeRole.MANAGER:
+                    # joined managers share the leader's replicated state
+                    # through raft; the in-process embedded manager rides the
+                    # same store object (the wire/raft deployment gives each
+                    # its own replica — node/README parity note)
+                    mgr = Manager(
+                        store=self.join.store,
+                        security=self.security,
+                        cluster_id=self.join.cluster_id,
+                        org=self.org,
+                        heartbeat_period=self.heartbeat_period,
+                    )
+                    # not the leader: components stay down until elected
+                    with self._manager_lock:
+                        self.manager = mgr
+                    self.broker.set_local_peer(mgr)
+            elif desired == NodeRole.WORKER and has_manager:
+                with self._manager_lock:
+                    mgr, self.manager = self.manager, None
+                self.broker.set_local_peer(None)
+                if mgr is not None and mgr is not self.join:
+                    mgr.stop()

@@ -1,0 +1,5 @@
+"""Manager-peer tracking and selection (reference: remotes/, connectionbroker/)."""
+from .remotes import DEFAULT_OBSERVATION_WEIGHT, Remotes
+from .broker import ConnectionBroker
+
+__all__ = ["Remotes", "ConnectionBroker", "DEFAULT_OBSERVATION_WEIGHT"]

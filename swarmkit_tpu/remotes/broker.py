@@ -1,0 +1,42 @@
+"""Connection broker: pick a manager connection, preferring the local one.
+
+Re-derivation of connectionbroker/broker.go (123 ln): `select_conn` returns
+the local manager when this process runs one (zero network hop), otherwise a
+remote picked through the weighted `Remotes`; callers report the outcome so
+weights track health.
+"""
+from __future__ import annotations
+
+from .remotes import DEFAULT_OBSERVATION_WEIGHT, Remotes
+
+
+class Conn:
+    """A selected peer + the observation plumbing (broker.go Conn)."""
+
+    def __init__(self, broker: "ConnectionBroker", peer, is_local: bool):
+        self._broker = broker
+        self.peer = peer
+        self.is_local = is_local
+
+    def close(self, success: bool = True):
+        """broker.go Conn.Close: feed the health observation back."""
+        if not self.is_local:
+            self._broker.remotes.observe(
+                self.peer,
+                DEFAULT_OBSERVATION_WEIGHT if success else -DEFAULT_OBSERVATION_WEIGHT,
+            )
+
+
+class ConnectionBroker:
+    def __init__(self, remotes: Remotes | None = None, local_peer=None):
+        self.remotes = remotes or Remotes()
+        self._local = local_peer
+
+    def set_local_peer(self, peer):
+        """The embedded manager came up (or went away: None)."""
+        self._local = peer
+
+    def select_conn(self, *excluding) -> Conn:
+        if self._local is not None and self._local not in set(excluding):
+            return Conn(self, self._local, is_local=True)
+        return Conn(self, self.remotes.select(*excluding), is_local=False)

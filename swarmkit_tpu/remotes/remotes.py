@@ -1,0 +1,80 @@
+"""Weighted manager-peer picker.
+
+Re-derivation of remotes/remotes.go (589 ln): workers keep a weight per
+known manager peer, raised/lowered by observations (successful RPC = +,
+failure = −) with EWMA-style decay toward the observation, and `select`
+samples proportionally to the positive part of the weights so traffic
+spreads but prefers healthy managers.
+"""
+from __future__ import annotations
+
+import random
+import threading
+
+# remotes.go: DefaultObservationWeight = 10; weights clamp to [-128, 128]
+DEFAULT_OBSERVATION_WEIGHT = 10
+_WEIGHT_MAX = 128.0
+_WEIGHT_MIN = -128.0
+_EWMA = 0.5  # remoteWeightSmoothingFactor
+
+
+class NoPeersError(Exception):
+    pass
+
+
+class Remotes:
+    """Peers are opaque hashable handles (addresses on the wire transport,
+    Manager objects in-process)."""
+
+    def __init__(self, *peers, rng: random.Random | None = None):
+        self._lock = threading.Lock()
+        self._weights: dict = {}
+        self._rng = rng or random.Random()
+        for p in peers:
+            self._weights[p] = 0.0
+
+    def add(self, *peers):
+        with self._lock:
+            for p in peers:
+                self._weights.setdefault(p, 0.0)
+
+    def remove(self, *peers):
+        with self._lock:
+            for p in peers:
+                self._weights.pop(p, None)
+
+    def weights(self) -> dict:
+        with self._lock:
+            return dict(self._weights)
+
+    def observe(self, peer, weight: int = DEFAULT_OBSERVATION_WEIGHT):
+        """Blend an observation into the peer's weight
+        (remotes.go Observe/ObserveIfExists EWMA)."""
+        with self._lock:
+            if peer not in self._weights:
+                self._weights[peer] = 0.0
+            cur = self._weights[peer]
+            nxt = cur * _EWMA + float(weight) * (1 - _EWMA)
+            self._weights[peer] = max(_WEIGHT_MIN, min(_WEIGHT_MAX, nxt))
+
+    def select(self, *excluding):
+        """Weighted-random pick (remotes.go Select): weights are shifted so
+        the minimum is slightly positive — unhealthy peers stay selectable
+        (they may have recovered) but rarely chosen."""
+        with self._lock:
+            candidates = {
+                p: w for p, w in self._weights.items() if p not in set(excluding)
+            }
+            if not candidates:
+                raise NoPeersError("no manager peers available")
+            lo = min(candidates.values())
+            # shift: minimum weight maps to 1 (remotes.go select index math)
+            shifted = {p: (w - lo) + 1.0 for p, w in candidates.items()}
+            total = sum(shifted.values())
+            pick = self._rng.uniform(0, total)
+            acc = 0.0
+            for p, w in shifted.items():
+                acc += w
+                if pick <= acc:
+                    return p
+            return next(iter(shifted))

@@ -1,0 +1,207 @@
+"""Node bootstrap + remotes tests (reference model: node/node.go flows,
+integration/integration_test.go node scenarios, remotes/remotes_test.go)."""
+import random
+
+import pytest
+
+from swarmkit_tpu.agent.testutils import FakeExecutor
+from swarmkit_tpu.api.types import NodeRole, TaskState
+from swarmkit_tpu.api.specs import Annotations, ServiceSpec
+from swarmkit_tpu.node import Node, NodeError
+from swarmkit_tpu.remotes import ConnectionBroker, Remotes
+from swarmkit_tpu.remotes.remotes import NoPeersError
+from swarmkit_tpu.store import by
+
+from test_scheduler import wait_for
+
+
+# -- Remotes / ConnectionBroker ----------------------------------------------
+
+
+def test_remotes_weighted_selection():
+    r = Remotes("m1", "m2", rng=random.Random(7))
+    for _ in range(20):
+        r.observe("m1", 10)   # healthy
+        r.observe("m2", -10)  # failing
+    counts = {"m1": 0, "m2": 0}
+    for _ in range(300):
+        counts[r.select()] += 1
+    assert counts["m1"] > counts["m2"] * 2
+    # failing peer remains selectable (recovery probe)
+    assert counts["m2"] > 0
+
+    assert r.select("m1") == "m2"  # exclusion
+    r.remove("m2")
+    with pytest.raises(NoPeersError):
+        r.select("m1")
+
+
+def test_connection_broker_prefers_local():
+    broker = ConnectionBroker(Remotes("remote-1"))
+    conn = broker.select_conn()
+    assert conn.peer == "remote-1" and not conn.is_local
+    conn.close(success=False)  # observation recorded, no crash
+
+    broker.set_local_peer("local-mgr")
+    conn = broker.select_conn()
+    assert conn.peer == "local-mgr" and conn.is_local
+
+
+# -- Node bootstrap ----------------------------------------------------------
+
+
+def _first_node(tmp_path, name="boot"):
+    ex = FakeExecutor({"*": {"run_forever": True}}, hostname=name)
+    n = Node(str(tmp_path / name), ex, heartbeat_period=0.5)
+    n.start()
+    return n
+
+
+def test_first_node_bootstraps_cluster(tmp_path):
+    n = _first_node(tmp_path)
+    try:
+        assert n.role == NodeRole.MANAGER
+        assert n.manager is not None and n.manager.is_leader
+        # its own node object is registered and READY
+        obj = n.manager.store.view(lambda tx: tx.get_node(n.node_id))
+        assert obj is not None and obj.role == NodeRole.MANAGER
+
+        # cluster works: a service reaches RUNNING on the bootstrap node
+        svc = n.manager.control_api.create_service(
+            ServiceSpec(annotations=Annotations(name="a"), replicas=2)
+        )
+        assert wait_for(
+            lambda: sum(
+                1
+                for t in n.manager.store.view().find_tasks(by.ByServiceID(svc.id))
+                if t.status.state == TaskState.RUNNING
+            )
+            == 2,
+            timeout=15,
+        )
+    finally:
+        n.stop()
+
+
+def test_worker_join_with_token(tmp_path):
+    boot = _first_node(tmp_path)
+    try:
+        cluster = boot.manager.store.view(
+            lambda tx: tx.get_cluster(boot.manager.cluster_id)
+        )
+        token = cluster.root_ca.join_token_worker
+
+        ex = FakeExecutor({"*": {"run_forever": True}}, hostname="w1")
+        w = Node(str(tmp_path / "w1"), ex, join=boot.manager, join_token=token,
+                 heartbeat_period=0.5)
+        w.start()
+        try:
+            assert w.role == NodeRole.WORKER
+            # the manager sees the worker; dispatcher registration makes it READY
+            assert wait_for(
+                lambda: (
+                    lambda o: o is not None and o.status.state.name == "READY"
+                )(boot.manager.store.view(lambda tx: tx.get_node(w.node_id))),
+                timeout=10,
+            )
+            # tasks land on both nodes
+            svc = boot.manager.control_api.create_service(
+                ServiceSpec(annotations=Annotations(name="b"), replicas=6)
+            )
+            assert wait_for(
+                lambda: sum(
+                    1
+                    for t in boot.manager.store.view().find_tasks(by.ByServiceID(svc.id))
+                    if t.status.state == TaskState.RUNNING
+                )
+                == 6,
+                timeout=20,
+            )
+            nodes_used = {
+                t.node_id
+                for t in boot.manager.store.view().find_tasks(by.ByServiceID(svc.id))
+            }
+            assert w.node_id in nodes_used
+        finally:
+            w.stop()
+    finally:
+        boot.stop()
+
+
+def test_join_requires_token(tmp_path):
+    boot = _first_node(tmp_path)
+    try:
+        ex = FakeExecutor({}, hostname="w1")
+        w = Node(str(tmp_path / "w1"), ex, join=boot.manager)
+        with pytest.raises(NodeError):
+            w.start()
+        bad = Node(str(tmp_path / "w2"), FakeExecutor({}, hostname="w2"),
+                   join=boot.manager, join_token="SWMTKN-1-bogus-bogus")
+        with pytest.raises(Exception):
+            bad.start()
+    finally:
+        boot.stop()
+
+
+def test_node_identity_survives_restart(tmp_path):
+    boot = _first_node(tmp_path)
+    try:
+        cluster = boot.manager.store.view(
+            lambda tx: tx.get_cluster(boot.manager.cluster_id)
+        )
+        token = cluster.root_ca.join_token_worker
+        ex = FakeExecutor({}, hostname="w1")
+        w = Node(str(tmp_path / "w1"), ex, join=boot.manager, join_token=token,
+                 heartbeat_period=0.5)
+        w.start()
+        wid = w.node_id
+        w.stop()
+
+        # restart from the same state dir, no token needed
+        w2 = Node(str(tmp_path / "w1"), FakeExecutor({}, hostname="w1"),
+                  join=boot.manager, heartbeat_period=0.5)
+        w2.start()
+        try:
+            assert w2.node_id == wid
+        finally:
+            w2.stop()
+    finally:
+        boot.stop()
+
+
+def test_promotion_starts_embedded_manager(tmp_path):
+    boot = _first_node(tmp_path)
+    try:
+        cluster = boot.manager.store.view(
+            lambda tx: tx.get_cluster(boot.manager.cluster_id)
+        )
+        token = cluster.root_ca.join_token_worker
+        ex = FakeExecutor({}, hostname="w1")
+        w = Node(str(tmp_path / "w1"), ex, join=boot.manager, join_token=token,
+                 heartbeat_period=0.5, role_check_interval=0.05)
+        w.start()
+        try:
+            assert w.manager is None
+
+            def promote(tx):
+                obj = tx.get_node(w.node_id)
+                obj.spec.desired_role = NodeRole.MANAGER
+                tx.update(obj)
+
+            boot.manager.store.update(promote)
+            # role manager reconciles cert role; node watcher brings up manager
+            assert wait_for(lambda: w.manager is not None, timeout=10)
+            assert wait_for(lambda: w.role == NodeRole.MANAGER, timeout=10)
+
+            # demotion tears it down
+            def demote(tx):
+                obj = tx.get_node(w.node_id)
+                obj.spec.desired_role = NodeRole.WORKER
+                tx.update(obj)
+
+            boot.manager.store.update(demote)
+            assert wait_for(lambda: w.manager is None, timeout=10)
+        finally:
+            w.stop()
+    finally:
+        boot.stop()
