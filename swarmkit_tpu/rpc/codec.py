@@ -1,0 +1,46 @@
+"""Wire codec: restricted pickling of the API dataclasses.
+
+The reference serializes with protobuf; our objects are plain dataclasses,
+so the wire format is pickle restricted to an allowlist — only
+`swarmkit_tpu.*` types, stdlib value types, and builtins can deserialize.
+Combined with mutual TLS (only cluster members reach the port), this closes
+the arbitrary-object-construction hole while keeping one schema source.
+"""
+from __future__ import annotations
+
+import io
+import pickle
+
+_ALLOWED_PREFIXES = ("swarmkit_tpu.",)
+_ALLOWED_MODULES = {
+    "builtins": {
+        "dict", "list", "set", "frozenset", "tuple", "bytes", "str", "int",
+        "float", "bool", "complex", "bytearray", "NoneType", "getattr",
+    },
+    "collections": {"OrderedDict", "defaultdict", "deque", "Counter"},
+    "datetime": {"datetime", "date", "time", "timedelta", "timezone"},
+    "enum": {"EnumType", "EnumMeta"},
+    "copyreg": {"_reconstructor"},
+}
+
+
+class WireDecodeError(Exception):
+    pass
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if any(module.startswith(p) for p in _ALLOWED_PREFIXES):
+            return super().find_class(module, name)
+        allowed = _ALLOWED_MODULES.get(module)
+        if allowed is not None and name in allowed:
+            return super().find_class(module, name)
+        raise WireDecodeError(f"wire payload references forbidden {module}.{name}")
+
+
+def dumps(obj) -> bytes:
+    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def loads(data: bytes):
+    return _RestrictedUnpickler(io.BytesIO(data)).load()
