@@ -63,13 +63,29 @@ class NodeVolumeManager:
             self._removing.pop(assignment.id, None)
         self.queue.enqueue(assignment.id)
 
-    def remove(self, volume_obj_id: str):
+    def remove(self, item: "VolumeAssignment | str"):
+        """Withdraw a volume. `item` may be the bare object id or a full
+        VolumeAssignment (the dispatcher ships the latter for volumes
+        pending node-unpublish, so a restarted agent with no local state
+        can still run the idempotent unpublish and confirm upstream)."""
+        vid = item if isinstance(item, str) else item.id
         with self._lock:
-            a = self._assignments.pop(volume_obj_id, None)
+            a = self._assignments.pop(vid, None)
+            if a is None and not isinstance(item, str):
+                a = item  # no local state: use the shipped assignment
             if a is None:
-                return
-            self._removing[volume_obj_id] = a
-        self.queue.enqueue(volume_obj_id)
+                already_confirming = vid in self._removing
+            else:
+                self._removing[vid] = a
+                already_confirming = False
+        if a is None:
+            # bare id and no state at all: nothing is mounted here (fresh
+            # process, never staged) — confirm so the manager can advance
+            # PENDING_NODE_UNPUBLISH → controller unpublish
+            if not already_confirming and self.on_unpublished is not None:
+                self.on_unpublished(vid)
+            return
+        self.queue.enqueue(vid)
 
     def reconcile(self, wanted_ids: set[str]):
         """Full-assignment reconcile (worker.go reconcileVolumes): anything

@@ -17,7 +17,13 @@ import time
 from dataclasses import dataclass
 
 from ..utils.identity import new_id
-from .certificates import CertIdentity, RootCA, parse_cert_identity, renewal_due
+from .certificates import (
+    CertIdentity,
+    CertificateError,
+    RootCA,
+    parse_cert_identity,
+    renewal_due,
+)
 
 TOKEN_PREFIX = "SWMTKN"
 TOKEN_VERSION = "1"
@@ -93,7 +99,25 @@ class SecurityConfig:
             self._watchers.append(cb)
 
     def update_tls_credentials(self, key_pem: bytes, cert_pem: bytes):
-        """Swap in a renewed cert (ca/config.go UpdateTLSCredentials)."""
+        """Swap in a renewed cert (ca/config.go UpdateTLSCredentials).
+
+        The cert's public key must match the private key: concurrent renewal
+        submissions can otherwise pair a cert issued for an older CSR with a
+        newer key, leaving the node with a broken TLS identity."""
+        from cryptography.hazmat.primitives import serialization as _ser
+        from cryptography import x509 as _x509
+
+        from .certificates import key_from_pem
+
+        def spki(pub):
+            return pub.public_bytes(
+                _ser.Encoding.DER, _ser.PublicFormat.SubjectPublicKeyInfo)
+
+        cert_pub = spki(_x509.load_pem_x509_certificate(cert_pem).public_key())
+        key_pub = spki(key_from_pem(key_pem).public_key())
+        if cert_pub != key_pub:
+            raise CertificateError(
+                "certificate public key does not match the private key")
         with self._lock:
             identity = self._root.verify_cert(cert_pem)
             self._key_pem, self._cert_pem = key_pem, cert_pem

@@ -123,25 +123,13 @@ class CAServer:
             role = self._role_from_token(token)
         if node_id is None:
             node_id = new_id()
-        else:
-            # Targeting an existing node is a renewal regardless of whether a
-            # token was also presented: a join token must never authorize
-            # overwriting another node's cert/role (ca/server.go:278-292 —
-            # the TLS peer CN must match the renewed node).
-            from ..api.types import NodeRole as _NR
-
-            exists = self.store.view(lambda tx: tx.get_node(node_id)) is not None
-            if exists and (
-                caller is None
-                or (caller.node_id != node_id and caller.role != _NR.MANAGER)
-            ):
-                raise PermissionDenied(
-                    f"renewal for {node_id} requires the node's own identity"
-                )
-            if not exists and role is None:
-                raise InvalidToken("unknown node and no join token")
 
         def txn(tx):
+            # Existence and renewal authorization are evaluated inside the
+            # same transaction as the write: a join-token request racing node
+            # creation for the same node_id must not overwrite the existing
+            # node's cert/role (ca/server.go:278-292 — the TLS peer CN must
+            # match the renewed node, or the caller must be a manager).
             node = tx.get_node(node_id)
             if node is None:
                 if role is None:
@@ -159,6 +147,12 @@ class CAServer:
                 )
                 tx.create(node)
             else:
+                if caller is None or (
+                    caller.node_id != node_id and caller.role != NodeRole.MANAGER
+                ):
+                    raise PermissionDenied(
+                        f"renewal for {node_id} requires the node's own identity"
+                    )
                 cert_role = role if role is not None else (
                     node.certificate.role if node.certificate else node.role
                 )
@@ -226,9 +220,10 @@ class CAServer:
         for node in pending:
             signing_root = self.root  # snapshot: rotation may swap self.root
             observed_state = node.certificate.status_state
+            signed_csr = node.certificate.csr_pem
             try:
                 cert_pem = signing_root.sign_csr(
-                    node.certificate.csr_pem,
+                    signed_csr,
                     subject=(node.id, node.certificate.role, self.org),
                 )
                 state, err = IssuanceState.ISSUED, ""
@@ -242,6 +237,7 @@ class CAServer:
                 state=state,
                 err=err,
                 observed_state=observed_state,
+                signed_csr=signed_csr,
                 signing_root=signing_root,
             ):
                 n = tx.get_node(node_id)
@@ -249,6 +245,11 @@ class CAServer:
                     return
                 if n.certificate.status_state != observed_state:
                     return  # raced: state moved (another signer, or ROTATE marked)
+                if n.certificate.csr_pem != signed_csr:
+                    # raced: a newer CSR was submitted while we signed the old
+                    # one — publishing this cert would pair it with a key the
+                    # node no longer holds; the newer CSR is signed next pass
+                    return
                 if signing_root is not self.root:
                     return  # raced with root rotation: re-signed next pass
                 n.certificate.certificate_pem = cert_pem

@@ -287,12 +287,28 @@ class Dispatcher:
             and t.desired_state <= TaskState.REMOVE
         ]
 
+    @staticmethod
+    def _volume_assignment(v, st):
+        """Build the VolumeAssignment shipped to an agent for volume `v`
+        with per-node publish status `st` (assignments.go VolumeAssignment)."""
+        from ..agent.csi import VolumeAssignment
+
+        return VolumeAssignment(
+            id=v.id,
+            volume_id=v.volume_info.volume_id if v.volume_info else "",
+            driver=v.spec.driver,
+            volume_context=dict(
+                v.volume_info.volume_context
+            ) if v.volume_info else {},
+            publish_context=dict(st.publish_context),
+            availability=v.spec.availability,
+        )
+
     def _referenced_deps(self, tx, tasks, node_id: str) -> tuple[dict, dict, dict]:
         """Secrets/configs the node's tasks reference, plus cluster-volume
         assignments already controller-published to this node
         (assignments.go:21-81; volumes ship once PUBLISHED so the agent can
         node-stage them)."""
-        from ..agent.csi import VolumeAssignment
         from ..csi.plugin import PUBLISHED
 
         secrets, configs, volumes = {}, {}, {}
@@ -306,16 +322,7 @@ class Dispatcher:
                     continue
                 for st in v.publish_status:
                     if st.node_id == node_id and st.state == PUBLISHED:
-                        volumes[vid] = VolumeAssignment(
-                            id=v.id,
-                            volume_id=v.volume_info.volume_id if v.volume_info else "",
-                            driver=v.spec.driver,
-                            volume_context=dict(
-                                v.volume_info.volume_context
-                            ) if v.volume_info else {},
-                            publish_context=dict(st.publish_context),
-                            availability=v.spec.availability,
-                        )
+                        volumes[vid] = self._volume_assignment(v, st)
             runtime = t.spec.runtime
             if runtime is None:
                 continue
@@ -329,14 +336,33 @@ class Dispatcher:
                     configs[c.id] = c
         return secrets, configs, volumes
 
+    def _pending_unpublish(self, tx, node_id: str) -> dict:
+        """Volumes awaiting node-side unpublish on this node. The remove
+        assignment is re-sent in every message while the state persists —
+        the node may be restarting and have lost the original remove
+        (reference: dispatcher/assignments.go:364-373). The full
+        VolumeAssignment is shipped (not just the id) so a fresh agent
+        process can still run the idempotent node-unpublish."""
+        from ..csi.plugin import PENDING_NODE_UNPUBLISH
+
+        out = {}
+        for v in tx.find_volumes():
+            if not v.publish_status:
+                continue
+            for st in v.publish_status:
+                if st.node_id == node_id and st.state == PENDING_NODE_UNPUBLISH:
+                    out[v.id] = self._volume_assignment(v, st)
+        return out
+
     def _full_assignment(self, session: Session) -> AssignmentsMessage:
         def cb(tx):
             tasks = self._relevant_tasks(tx, session.node_id)
             secrets, configs, volumes = self._referenced_deps(
                 tx, tasks, session.node_id)
-            return tasks, secrets, configs, volumes
+            return (tasks, secrets, configs, volumes,
+                    self._pending_unpublish(tx, session.node_id))
 
-        tasks, secrets, configs, volumes = self.store.view(cb)
+        tasks, secrets, configs, volumes, unpublish = self.store.view(cb)
         session.known_tasks = {t.id: t.meta.version.index for t in tasks}
         session.known_secrets = set(secrets)
         session.known_configs = set(configs)
@@ -347,6 +373,8 @@ class Dispatcher:
             + [Assignment("update", "secret", s.copy()) for s in secrets.values()]
             + [Assignment("update", "config", c.copy()) for c in configs.values()]
             + [Assignment("update", "volume", v) for v in volumes.values()]
+            + [Assignment("remove", "volume", va)
+               for vid, va in unpublish.items() if vid not in volumes]
         )
         return AssignmentsMessage("complete", session.sequence, changes)
 
@@ -365,9 +393,10 @@ class Dispatcher:
             tasks = self._relevant_tasks(tx, session.node_id)
             secrets, configs, volumes = self._referenced_deps(
                 tx, tasks, session.node_id)
-            return tasks, secrets, configs, volumes
+            return (tasks, secrets, configs, volumes,
+                    self._pending_unpublish(tx, session.node_id))
 
-        tasks, secrets, configs, volumes = self.store.view(cb)
+        tasks, secrets, configs, volumes, unpublish = self.store.view(cb)
         changes: list[Assignment] = []
         new_known = {t.id: t.meta.version.index for t in tasks}
         for t in tasks:
@@ -391,7 +420,15 @@ class Dispatcher:
             if vid not in session.known_volumes:
                 changes.append(Assignment("update", "volume", v))
         for vid in session.known_volumes - set(volumes):
-            changes.append(Assignment("remove", "volume", vid))
+            # prefer the assignment object when the volume is pending
+            # node-unpublish so the agent can act without local state
+            changes.append(Assignment("remove", "volume",
+                                      unpublish.get(vid, vid)))
+        for vid, va in unpublish.items():
+            # re-send while pending, even if the agent was never told about
+            # this volume in this session (agent restart)
+            if vid not in session.known_volumes and vid not in volumes:
+                changes.append(Assignment("remove", "volume", va))
         session.known_tasks = new_known
         session.known_secrets = set(secrets)
         session.known_configs = set(configs)

@@ -72,11 +72,18 @@ class Manager:
         self.resource_api = ResourceAllocator(self.store)
         self.health = HealthServer()
 
-        # root CA: from the security config's root, or created fresh
+        # Root CA resolution order: (1) the security config's root when it
+        # can sign; (2) the cluster's CA material replicated in the store —
+        # this is how a *promoted* manager (whose SecurityConfig holds only
+        # the trust anchor) obtains the signing key, as the reference
+        # distributes root key material to new managers via the replicated
+        # Cluster object; (3) a fresh root, only when bootstrapping a new
+        # cluster. Without (2), a promoted leader would sign certs and mint
+        # join tokens under a root no existing node trusts (split-brain CA).
         if security is not None and security.root_ca.can_sign:
             root = security.root_ca
         else:
-            root = RootCA.create(org)
+            root = self._load_root_from_store() or RootCA.create(org)
         self.ca_server = CAServer(self.store, root, self.cluster_id, org=org)
 
         # leader-only components, created on become_leader
@@ -86,6 +93,26 @@ class Manager:
 
         if self.raft is not None:
             self.raft.on_leadership = self._on_leadership
+
+    def _load_root_from_store(self) -> RootCA | None:
+        """Load the cluster's signing root from the replicated Cluster
+        object (any cluster object with key material qualifies — a promoted
+        manager may not know the seeded cluster id yet)."""
+        try:
+            clusters = self.store.view(lambda tx: tx.find_clusters())
+        except Exception:
+            return None
+        for cluster in clusters:
+            rca = getattr(cluster, "root_ca", None)
+            if rca is None or not rca.ca_cert_pem or not rca.ca_key_pem:
+                continue
+            try:
+                root = RootCA(rca.ca_cert_pem, rca.ca_key_pem)
+            except Exception:
+                continue
+            self.cluster_id = cluster.id
+            return root
+        return None
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -14,17 +14,27 @@ hardstate.json, membership.json.
 from __future__ import annotations
 
 import base64
+import binascii
 import json
+import logging
 import os
-import pickle
 import threading
 from dataclasses import dataclass, field
 from typing import Any
 
 from cryptography.fernet import Fernet, InvalidToken
 
+from ..rpc import codec
 from .messages import ConfChange, Entry
 from .node import Peer
+
+
+log = logging.getLogger("swarmkit_tpu.raft.storage")
+
+
+class RaftStorageError(Exception):
+    """Persisted raft state exists but cannot be decoded (wrong DEK or
+    incompatible on-disk format) — distinct from an empty state dir."""
 
 
 def new_dek() -> bytes:
@@ -87,7 +97,7 @@ class RaftStorage:
             if self._wal_file is None:
                 self._wal_file = open(self._wal_path, "ab")
             for e in entries:
-                raw = pickle.dumps(e)
+                raw = codec.dumps(e)
                 self._wal_file.write(self.sealer.seal(raw) + b"\n")
             self._wal_file.flush()
             os.fsync(self._wal_file.fileno())
@@ -128,7 +138,7 @@ class RaftStorage:
     def save_snapshot(self, index: int, term: int, data: Any,
                       members: dict[int, Peer]):
         with self._lock:
-            payload = pickle.dumps({
+            payload = codec.dumps({
                 "index": index, "term": term, "data": data,
                 "members": {rid: (p.node_id, p.addr)
                             for rid, p in members.items()},
@@ -151,7 +161,7 @@ class RaftStorage:
             self.sealer._fernets.extend(old._fernets)  # still able to read old
             self._rewrite_wal(entries)
             if snap is not None:
-                payload = pickle.dumps(snap)
+                payload = codec.dumps(snap)
                 tmp = self._snap_path + ".tmp"
                 with open(tmp, "wb") as f:
                     f.write(self.sealer.seal(payload))
@@ -199,8 +209,19 @@ class RaftStorage:
                 if not line:
                     continue
                 try:
-                    out.append(pickle.loads(self.sealer.unseal(line)))
-                except (InvalidToken, pickle.UnpicklingError, EOFError):
+                    out.append(codec.loads(self.sealer.unseal(line)))
+                except (InvalidToken, codec.WireDecodeError, EOFError,
+                        binascii.Error) as exc:
+                    if not out:
+                        # the FIRST record failing to decode is not a torn
+                        # tail — it is the wrong DEK or an incompatible WAL
+                        # format; silently returning [] would discard the
+                        # entire persisted raft state
+                        raise RaftStorageError(
+                            f"cannot decode WAL {self._wal_path}: {exc}"
+                        ) from exc
+                    log.warning("raft WAL %s: torn tail after %d records (%s)",
+                                self._wal_path, len(out), exc)
                     break  # torn tail write: stop at first bad record
         return out
 
@@ -210,15 +231,20 @@ class RaftStorage:
         with open(self._snap_path, "rb") as f:
             blob = f.read()
         try:
-            return pickle.loads(self.sealer.unseal(blob))
-        except (InvalidToken, pickle.UnpicklingError, EOFError):
-            return None
+            return codec.loads(self.sealer.unseal(blob))
+        except (InvalidToken, codec.WireDecodeError, EOFError,
+                binascii.Error) as exc:
+            # snapshots are written atomically (tmp + rename), so a decode
+            # failure means wrong DEK or incompatible format, not a torn
+            # write — fail loudly rather than restart from empty state
+            raise RaftStorageError(
+                f"cannot decode snapshot {self._snap_path}: {exc}") from exc
 
     def _rewrite_wal(self, entries: list[Entry]):
         tmp = self._wal_path + ".tmp"
         with open(tmp, "wb") as f:
             for e in entries:
-                f.write(self.sealer.seal(pickle.dumps(e)) + b"\n")
+                f.write(self.sealer.seal(codec.dumps(e)) + b"\n")
         os.replace(tmp, self._wal_path)
 
     def _close_wal(self):

@@ -1,46 +1,229 @@
-"""Wire codec: restricted pickling of the API dataclasses.
+"""Wire codec: schema-registered msgpack encoding of the API dataclasses.
 
-The reference serializes with protobuf; our objects are plain dataclasses,
-so the wire format is pickle restricted to an allowlist — only
-`swarmkit_tpu.*` types, stdlib value types, and builtins can deserialize.
-Combined with mutual TLS (only cluster members reach the port), this closes
-the arbitrary-object-construction hole while keeping one schema source.
+The reference serializes with protobuf — a closed, data-only schema. Our
+equivalent is msgpack plus an explicit type registry: only classes that are
+registered here (the API dataclasses, enums, raft messages, dispatcher
+messages) can cross the wire, and they are reconstructed field-by-field
+through their constructors, never by executing embedded callables. A payload
+referencing an unregistered type fails with WireDecodeError.
+
+This replaces the earlier restricted-pickle codec, whose allowlist was
+bypassable (dotted-name traversal through allowlisted modules reaching
+os.system, getattr gadget chains); pickle is not used anywhere in the
+framework any more.
 """
 from __future__ import annotations
 
-import io
-import pickle
+import dataclasses
+import enum
+import threading
 
-_ALLOWED_PREFIXES = ("swarmkit_tpu.",)
-_ALLOWED_MODULES = {
-    "builtins": {
-        "dict", "list", "set", "frozenset", "tuple", "bytes", "str", "int",
-        "float", "bool", "complex", "bytearray", "NoneType", "getattr",
-    },
-    "collections": {"OrderedDict", "defaultdict", "deque", "Counter"},
-    "datetime": {"datetime", "date", "time", "timedelta", "timezone"},
-    "enum": {"EnumType", "EnumMeta"},
-    "copyreg": {"_reconstructor"},
-}
+import msgpack
+
+# Marker keys. "\x00" cannot appear in our field names, so plain dicts whose
+# keys are ordinary strings can never collide with an encoded object.
+_T = "\x00t"   # registered class: {_T: name, _F: {field: value}}
+_F = "\x00f"
+_E = "\x00e"   # enum: {_E: name, _V: raw value}
+_V = "\x00v"
+_TUP = "\x00u"   # tuple: {_TUP: [items]}
+_SET = "\x00s"   # set
+_FSET = "\x00z"  # frozenset
+_DICT = "\x00d"  # dict with non-primitive keys: {_DICT: [[k, v], ...]}
+
+_PRIM_KEY = (str, int, float, bool, bytes)
+
+
+class WireEncodeError(Exception):
+    pass
 
 
 class WireDecodeError(Exception):
     pass
 
 
-class _RestrictedUnpickler(pickle.Unpickler):
-    def find_class(self, module, name):
-        if any(module.startswith(p) for p in _ALLOWED_PREFIXES):
-            return super().find_class(module, name)
-        allowed = _ALLOWED_MODULES.get(module)
-        if allowed is not None and name in allowed:
-            return super().find_class(module, name)
-        raise WireDecodeError(f"wire payload references forbidden {module}.{name}")
+class _Registry:
+    def __init__(self):
+        self.by_name: dict[str, type] = {}
+        self.by_type: dict[type, str] = {}
+        self.fields: dict[str, tuple[str, ...]] = {}
+        self._lock = threading.Lock()
+        self._populated = False
+
+    def add(self, cls: type, fields: tuple[str, ...] | None = None):
+        name = cls.__name__
+        existing = self.by_name.get(name)
+        if existing is not None and existing is not cls:
+            # disambiguate by module tail (e.g. two `Node` classes)
+            name = cls.__module__.rsplit(".", 1)[-1] + ":" + cls.__name__
+        if fields is None:
+            if dataclasses.is_dataclass(cls):
+                fields = tuple(f.name for f in dataclasses.fields(cls))
+            elif issubclass(cls, enum.Enum):
+                fields = ()
+            else:
+                raise WireEncodeError(
+                    f"{cls} is neither a dataclass nor an Enum; pass fields=")
+        self.by_name[name] = cls
+        self.by_type[cls] = name
+        self.fields[name] = fields
+
+    def add_module(self, mod):
+        for obj in vars(mod).values():
+            if isinstance(obj, type) and obj.__module__ == mod.__name__:
+                if dataclasses.is_dataclass(obj) or (
+                        issubclass(obj, enum.Enum) and obj is not enum.Enum):
+                    self.add(obj)
+
+    def populate(self):
+        """Import and register every module whose types may cross the wire
+        (or land in the encrypted WAL / snapshot files)."""
+        with self._lock:
+            if self._populated:
+                return
+            from ..api import genericresource, objects, specs, types
+            from ..raft import messages as raft_messages
+
+            for mod in (types, specs, objects, genericresource, raft_messages):
+                self.add_module(mod)
+
+            from ..store.memory import StoreAction
+
+            self.add(StoreAction, fields=("kind", "obj"))
+
+            from ..raft.node import Peer
+
+            self.add(Peer)
+
+            # modules below import the store; registered lazily but before
+            # any encode/decode happens, so ordering is safe
+            from ..agent import csi as agent_csi
+            from ..csi import plugin as csi_plugin
+            from ..dispatcher import dispatcher as dispatcher_mod
+            from ..logbroker import broker as broker_mod
+
+            for cls in (agent_csi.VolumeAssignment,):
+                self.add(cls)
+            for cls in (csi_plugin.VolumePublishStatus, csi_plugin.VolumeInfo):
+                self.add(cls)
+            for cls in (dispatcher_mod.Assignment,
+                        dispatcher_mod.AssignmentsMessage):
+                self.add(cls)
+            for cls in (broker_mod.LogSelector, broker_mod.LogContext,
+                        broker_mod.LogMessage, broker_mod.SubscriptionMessage):
+                self.add(cls)
+
+            from ..ca.certificates import CertIdentity
+
+            self.add(CertIdentity)
+
+            # dataclasses that live inside store objects (and therefore in
+            # raft entries / WAL records / snapshots)
+            from ..manager.keymanager import EncryptionKey
+            from ..orchestrator.restart import (
+                InstanceRestartInfo,
+                RestartedInstance,
+            )
+
+            for cls in (EncryptionKey, InstanceRestartInfo, RestartedInstance):
+                self.add(cls)
+
+            # control/watch request types that cross the client wire
+            from ..controlapi.control import ListFilters
+            from ..watchapi.watch import WatchSelector
+
+            for cls in (ListFilters, WatchSelector):
+                self.add(cls)
+            self._populated = True
+
+
+_registry = _Registry()
+register = _registry.add
+register_module = _registry.add_module
+
+
+def _to_wire(obj):
+    # exact type checks: IntEnum/StrEnum instances pass isinstance(int/str)
+    # but must take the enum branch below or they decode as bare scalars
+    t = type(obj)
+    if obj is None or t in (bool, int, float, str, bytes):
+        return obj
+    if t is list:
+        return [_to_wire(x) for x in obj]
+    if t is dict:
+        # A user-data key that looks like one of our markers ("\x00"-prefixed)
+        # must not be emitted in the plain form, or decode would misread the
+        # dict as an encoded object (type confusion); the pair-list form
+        # round-trips such keys literally.
+        if all(type(k) in _PRIM_KEY for k in obj) and not any(
+                isinstance(k, str) and k.startswith("\x00") for k in obj):
+            return {k: _to_wire(v) for k, v in obj.items()}
+        return {_DICT: [[_to_wire(k), _to_wire(v)] for k, v in obj.items()]}
+    if t is tuple:
+        return {_TUP: [_to_wire(x) for x in obj]}
+    if t is set:
+        return {_SET: [_to_wire(x) for x in obj]}
+    if t is frozenset:
+        return {_FSET: [_to_wire(x) for x in obj]}
+    if isinstance(obj, enum.Enum):
+        name = _registry.by_type.get(t)
+        if name is None:
+            raise WireEncodeError(f"unregistered enum {t}")
+        return {_E: name, _V: obj.value}
+    name = _registry.by_type.get(t)
+    if name is not None:
+        fields = _registry.fields[name]
+        return {_T: name,
+                _F: {f: _to_wire(getattr(obj, f)) for f in fields}}
+    raise WireEncodeError(f"cannot encode {t} on the wire (unregistered)")
+
+
+def _from_wire(obj):
+    if isinstance(obj, dict):
+        if _T in obj:
+            name = obj[_T]
+            cls = _registry.by_name.get(name)
+            if cls is None:
+                raise WireDecodeError(f"wire payload references unknown type {name!r}")
+            raw = obj.get(_F) or {}
+            known = set(_registry.fields.get(name, ()))
+            kwargs = {k: _from_wire(v) for k, v in raw.items() if k in known}
+            try:
+                return cls(**kwargs)
+            except TypeError as exc:
+                raise WireDecodeError(f"cannot construct {name}: {exc}") from exc
+        if _E in obj:
+            cls = _registry.by_name.get(obj[_E])
+            if cls is None or not (isinstance(cls, type)
+                                   and issubclass(cls, enum.Enum)):
+                raise WireDecodeError(f"unknown enum {obj.get(_E)!r}")
+            try:
+                return cls(obj.get(_V))
+            except ValueError as exc:
+                raise WireDecodeError(str(exc)) from exc
+        if _TUP in obj:
+            return tuple(_from_wire(x) for x in obj[_TUP])
+        if _SET in obj:
+            return {_from_wire(x) for x in obj[_SET]}
+        if _FSET in obj:
+            return frozenset(_from_wire(x) for x in obj[_FSET])
+        if _DICT in obj:
+            return {_from_wire(k): _from_wire(v) for k, v in obj[_DICT]}
+        return {k: _from_wire(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_from_wire(x) for x in obj]
+    return obj
 
 
 def dumps(obj) -> bytes:
-    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    _registry.populate()
+    return msgpack.packb(_to_wire(obj), use_bin_type=True)
 
 
 def loads(data: bytes):
-    return _RestrictedUnpickler(io.BytesIO(data)).load()
+    _registry.populate()
+    try:
+        raw = msgpack.unpackb(data, raw=False, strict_map_key=False)
+    except Exception as exc:
+        raise WireDecodeError(f"malformed wire payload: {exc}") from exc
+    return _from_wire(raw)

@@ -1,0 +1,226 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+
+1. wire codec is data-only — hostile payloads cannot reach callables
+2. CSI unpublish handshake survives agent restart (remove re-sent, agent
+   confirms without local state)
+3. a promoted manager loads the cluster root CA from the store instead of
+   minting a fresh, untrusted root
+4. issue_node_certificate decides existence/renewal-authz inside the txn
+5. a renewed cert is never paired with a mismatched key
+"""
+import msgpack
+import pytest
+
+from swarmkit_tpu.agent.csi import NodeVolumeManager, VolumeAssignment
+from swarmkit_tpu.api.objects import Cluster, Node, RootCAObj, Task, Volume
+from swarmkit_tpu.api.specs import Annotations, ClusterSpec, VolumeSpec
+from swarmkit_tpu.api.types import NodeRole, TaskState
+from swarmkit_tpu.ca import RootCA, SecurityConfig, generate_join_token
+from swarmkit_tpu.ca.auth import Caller, PermissionDenied
+from swarmkit_tpu.ca.certificates import CertificateError, create_csr
+from swarmkit_tpu.csi.plugin import (
+    PENDING_NODE_UNPUBLISH,
+    FakeCSIPlugin,
+    PluginGetter,
+    VolumePublishStatus,
+)
+from swarmkit_tpu.dispatcher.dispatcher import Dispatcher
+from swarmkit_tpu.manager.manager import Manager
+from swarmkit_tpu.rpc import codec
+from swarmkit_tpu.store.memory import MemoryStore
+
+
+# ---------------------------------------------------------------- 1. codec
+
+
+def test_codec_roundtrips_api_objects():
+    t = Task(id="t1", service_id="s1", slot=3)
+    t.desired_state = TaskState.RUNNING
+    assert codec.loads(codec.dumps(t)) == t
+
+
+def test_codec_rejects_unknown_types():
+    evil = msgpack.packb({"\x00t": "system", "\x00f": {"cmd": "id"}})
+    with pytest.raises(codec.WireDecodeError):
+        codec.loads(evil)
+
+
+def test_codec_refuses_to_encode_arbitrary_objects():
+    class NotRegistered:
+        pass
+
+    with pytest.raises(codec.WireEncodeError):
+        codec.dumps(NotRegistered())
+
+
+def test_codec_preserves_int_enums():
+    # IntEnum instances pass isinstance(int) — they must still decode as
+    # enums, not bare ints (WAL replay depends on it)
+    from swarmkit_tpu.api.objects import TaskStatus
+
+    st = TaskStatus(state=TaskState.RUNNING)
+    out = codec.loads(codec.dumps(st))
+    assert out.state is TaskState.RUNNING
+    assert isinstance(out.state, TaskState)
+
+
+def test_codec_marker_key_collision():
+    d = {"\x00t": "VolumeInfo", "normal": 1}
+    out = codec.loads(codec.dumps(d))
+    assert out == d and isinstance(out, dict)
+
+
+def test_codec_preserves_container_types():
+    payload = {"members": {1: ("n1", "a1")}, "ids": {"a", "b"}}
+    out = codec.loads(codec.dumps(payload))
+    assert out["members"] == {1: ("n1", "a1")}
+    assert isinstance(out["members"][1], tuple)
+    assert out["ids"] == {"a", "b"}
+
+
+# ------------------------------------------- 2. CSI unpublish across restart
+
+
+def _pending_unpublish_volume(store, vid="v1", node_id="n1"):
+    def txn(tx):
+        v = Volume(id=vid)
+        v.spec = VolumeSpec(annotations=Annotations(name="vol1"),
+                            driver="fake-csi")
+        v.publish_status = [
+            VolumePublishStatus(node_id=node_id,
+                                state=PENDING_NODE_UNPUBLISH)
+        ]
+        tx.create(v)
+
+    store.update(txn)
+
+
+def test_dispatcher_ships_remove_for_pending_node_unpublish():
+    store = MemoryStore()
+    _pending_unpublish_volume(store)
+    d = Dispatcher(store, heartbeat_period=60)
+    sid = d.register("n1")
+    try:
+        ch = d.assignments("n1", sid)
+        msg = ch.get(timeout=1)
+        removes = [a for a in msg.changes
+                   if a.kind == "volume" and a.action == "remove"]
+        assert len(removes) == 1
+        # the full assignment object is shipped, not just the id, so a
+        # fresh agent can unpublish without prior state
+        assert isinstance(removes[0].item, VolumeAssignment)
+        assert removes[0].item.id == "v1"
+        assert removes[0].item.driver == "fake-csi"
+    finally:
+        d.stop()
+
+
+def test_node_volume_manager_confirms_unknown_removes():
+    plugin = FakeCSIPlugin()
+    confirmed = []
+    mgr = NodeVolumeManager(PluginGetter({plugin.name: plugin}),
+                            on_unpublished=confirmed.append)
+    mgr.start()
+    try:
+        # full assignment shipped but no local state (fresh process):
+        # unpublish runs through the plugin and is confirmed
+        va = VolumeAssignment(id="v1", volume_id="pv1", driver=plugin.name)
+        mgr.remove(va)
+        deadline_ok = False
+        import time
+
+        for _ in range(100):
+            if "v1" in confirmed:
+                deadline_ok = True
+                break
+            time.sleep(0.02)
+        assert deadline_ok
+        assert ("node_unpublish", "pv1") in plugin.calls
+        # a bare id with no state is confirmed directly (nothing mounted)
+        mgr.remove("v2")
+        assert "v2" in confirmed
+    finally:
+        mgr.stop()
+
+
+# ---------------------------------------------- 3. promoted-manager root CA
+
+
+def test_promoted_manager_uses_cluster_root_from_store():
+    # the original leader seeds the cluster with its CA material
+    boot = SecurityConfig.bootstrap_manager(org="test-org")
+    store = MemoryStore()
+    cluster_id = "c1"
+
+    def seed(tx):
+        c = Cluster(id=cluster_id,
+                    spec=ClusterSpec(annotations=Annotations(name="default")))
+        c.root_ca = RootCAObj(
+            ca_key_pem=boot.root_ca.key_pem,
+            ca_cert_pem=boot.root_ca.cert_pem,
+            cert_digest=boot.root_ca.digest(),
+            join_token_worker=generate_join_token(boot.root_ca),
+            join_token_manager=generate_join_token(boot.root_ca),
+        )
+        tx.create(c)
+
+    store.update(seed)
+
+    # a promoted manager has only the trust anchor (no signing key)
+    key_pem, csr_pem = create_csr("promoted", NodeRole.MANAGER, "test-org")
+    cert_pem = boot.root_ca.sign_csr(csr_pem)
+    promoted_sec = SecurityConfig(boot.root_ca.without_key(), key_pem, cert_pem)
+    assert not promoted_sec.root_ca.can_sign
+
+    mgr = Manager(store=store, security=promoted_sec, cluster_id=cluster_id,
+                  org="test-org")
+    # the manager must sign under the cluster's root, not a fresh one
+    assert mgr.root.digest() == boot.root_ca.digest()
+    assert mgr.root.can_sign
+
+
+def test_bootstrap_manager_still_creates_fresh_root():
+    mgr = Manager(store=MemoryStore(), org="test-org")
+    assert mgr.root.can_sign
+
+
+# ------------------------------------------------------ 4. CA issuance TOCTOU
+
+
+def test_issue_node_certificate_renewal_authz_is_atomic():
+    mgr = Manager(store=MemoryStore(), org="test-org")
+    mgr.start()
+    try:
+        token = mgr.store.view(
+            lambda tx: tx.get_cluster(mgr.cluster_id)).root_ca.join_token_worker
+        # create the node via a first join
+        _, csr1 = create_csr("nX", NodeRole.WORKER, "test-org")
+        mgr.ca_server.issue_node_certificate(csr1, token=token, node_id="nX")
+        # a second join-token request for the same node id with no caller
+        # identity must be rejected (it is a renewal now)
+        _, csr2 = create_csr("nX", NodeRole.WORKER, "test-org")
+        with pytest.raises(PermissionDenied):
+            mgr.ca_server.issue_node_certificate(csr2, token=token,
+                                                 node_id="nX")
+        # the node's own identity may renew
+        caller = Caller(node_id="nX", role=NodeRole.WORKER, org="test-org")
+        mgr.ca_server.issue_node_certificate(csr2, node_id="nX",
+                                             caller=caller)
+    finally:
+        mgr.stop()
+
+
+# ------------------------------------------------- 5. key/cert pairing guard
+
+
+def test_update_tls_credentials_rejects_mismatched_key():
+    sec = SecurityConfig.bootstrap_manager(org="test-org")
+    root = sec.root_ca
+    # cert issued for one key, paired with a different key
+    key_a, csr_a = create_csr(sec.node_id(), NodeRole.MANAGER, "test-org")
+    cert_a = root.sign_csr(csr_a)
+    key_b, _ = create_csr(sec.node_id(), NodeRole.MANAGER, "test-org")
+    with pytest.raises(CertificateError):
+        sec.update_tls_credentials(key_b, cert_a)
+    # matching pair is accepted
+    sec.update_tls_credentials(key_a, cert_a)

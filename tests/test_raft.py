@@ -193,10 +193,13 @@ def test_restart_from_storage(tmp_path):
     commit = leader.commit_index
     c.nodes[1].stop()
 
-    # wrong DEK must not decrypt
+    # wrong DEK must not decrypt — and must fail loudly, not silently
+    # restart from empty state (a node would otherwise discard its log)
+    from swarmkit_tpu.raft.storage import RaftStorageError
+
     bad = RaftStorage(str(tmp_path / "raft"), dek=new_dek())
-    st = bad.load()
-    assert st is not None and len(st.entries) == 0
+    with pytest.raises(RaftStorageError):
+        bad.load()
 
     applied2 = []
     storage2 = RaftStorage(str(tmp_path / "raft"), dek=dek)
