@@ -61,6 +61,9 @@ class Manager:
         self._lock = threading.Lock()
         self._is_leader = False
         self._started = False
+        # leadership observed before start() is deferred, not lost (the
+        # raft node may elect between Manager construction and start)
+        self._pending_leadership: bool | None = None
 
         # always-on API surface (served by every manager; writes are
         # forwarded to the leader by the raft proxy layer in manager.go —
@@ -123,9 +126,12 @@ class Manager:
             if self._started:
                 return
             self._started = True
+            pending, self._pending_leadership = self._pending_leadership, None
         self.health.set_serving_status("manager", SERVING)
         if self.raft is None:
             self._on_leadership(True)
+        elif pending is not None:
+            self._on_leadership(pending)
         elif getattr(self.raft, "role", None) == "leader":
             self._on_leadership(True)
 
@@ -150,6 +156,9 @@ class Manager:
 
     def _on_leadership(self, is_leader: bool):
         with self._lock:
+            if not self._started:
+                self._pending_leadership = is_leader
+                return
             if is_leader == self._is_leader:
                 return
             self._is_leader = is_leader

@@ -1,0 +1,177 @@
+"""Network raft transport: per-peer mTLS RPC with health tracking.
+
+Re-derivation of manager/state/raft/transport/{transport.go:47-402,
+peer.go:26-142}: the raft core hands messages to a transport that owns one
+connection per peer, sends asynchronously (the consensus loop must never
+block on the network), tracks per-peer health for CanRemoveMember quorum
+checks, and resolves peer addresses from the replicated membership (conf
+changes carry addresses; ResolveAddress repairs stale ones).
+
+Wire: unary `raft.step` RPCs over the shared RPC substrate (the reference
+streams raftpb messages over gRPC; our frames are already length-prefixed
+and multiplexed, so a stream adds nothing at this message rate).
+"""
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+
+from ..rpc.client import RPCClient
+
+log = logging.getLogger("swarmkit_tpu.raft.transport")
+
+OUTBOX_LIMIT = 1024          # per-peer; raft retransmits, drops are safe
+HEALTH_WINDOW = 10.0         # seconds: a peer is active if a send succeeded
+SEND_TIMEOUT = 5.0
+RECONNECT_BACKOFF = 1.0
+
+
+class NetworkTransport:
+    """Implements the RaftNode transport seam (send/active) over RPC."""
+
+    def __init__(self, security, local_raft_id: int = 0):
+        self.security = security
+        self.local_raft_id = local_raft_id
+        self.node = None  # RaftNode, attached via set_node
+        self._lock = threading.Lock()
+        self._outboxes: dict[int, queue.Queue] = {}
+        self._threads: dict[int, threading.Thread] = {}
+        self._clients: dict[int, RPCClient] = {}
+        self._addr_overrides: dict[int, str] = {}
+        self._last_ok: dict[int, float] = {}
+        self._last_try: dict[int, float] = {}
+        self._stopped = threading.Event()
+
+    def set_node(self, node):
+        self.node = node
+
+    # -- RaftNode seam -----------------------------------------------------
+    def send(self, msg) -> None:
+        """Queue a message for async delivery; never blocks the raft loop."""
+        if self._stopped.is_set():
+            return
+        box = self._outbox(msg.to)
+        try:
+            box.put_nowait(msg)
+        except queue.Full:
+            # drop-oldest: newer raft state supersedes older messages
+            try:
+                box.get_nowait()
+            except queue.Empty:
+                pass
+            try:
+                box.put_nowait(msg)
+            except queue.Full:
+                pass
+
+    def active(self, peer_id: int) -> bool:
+        """Peer health for quorum-safety checks (transport.go Active)."""
+        with self._lock:
+            last_ok = self._last_ok.get(peer_id)
+            last_try = self._last_try.get(peer_id)
+        if last_ok is not None and time.monotonic() - last_ok < HEALTH_WINDOW:
+            return True
+        # never attempted yet: optimistic (a fresh member hasn't been dialed)
+        return last_try is None
+
+    # -- peer management ---------------------------------------------------
+    def update_peer_addr(self, raft_id: int, addr: str):
+        with self._lock:
+            self._addr_overrides[raft_id] = addr
+            client = self._clients.pop(raft_id, None)
+        if client is not None:
+            client.close()
+
+    def stop(self):
+        self._stopped.set()
+        with self._lock:
+            threads = list(self._threads.values())
+            clients = list(self._clients.values())
+            boxes = list(self._outboxes.values())
+        for b in boxes:
+            try:
+                b.put_nowait(None)  # wake senders
+            except queue.Full:
+                pass
+        for c in clients:
+            c.close()
+        for t in threads:
+            t.join(timeout=2)
+
+    # -- internals ---------------------------------------------------------
+    def _outbox(self, peer_id: int) -> queue.Queue:
+        with self._lock:
+            box = self._outboxes.get(peer_id)
+            if box is None:
+                box = queue.Queue(maxsize=OUTBOX_LIMIT)
+                self._outboxes[peer_id] = box
+                t = threading.Thread(target=self._sender_loop,
+                                     args=(peer_id, box), daemon=True,
+                                     name=f"raft-send-{peer_id}")
+                self._threads[peer_id] = t
+                t.start()
+            return box
+
+    def _peer_addr(self, peer_id: int) -> str | None:
+        with self._lock:
+            override = self._addr_overrides.get(peer_id)
+        if override:
+            return override
+        node = self.node
+        if node is not None:
+            peer = node.members.get(peer_id)
+            if peer is not None and peer.addr and not peer.addr.startswith("mem://"):
+                return peer.addr
+        return None
+
+    def _client(self, peer_id: int) -> RPCClient | None:
+        with self._lock:
+            client = self._clients.get(peer_id)
+        if client is not None and client.alive:
+            return client
+        addr = self._peer_addr(peer_id)
+        if addr is None:
+            return None
+        try:
+            client = RPCClient(addr, security=self.security,
+                               connect_timeout=SEND_TIMEOUT)
+        except OSError as exc:
+            log.debug("raft transport: dial %s failed: %s", addr, exc)
+            return None
+        with self._lock:
+            old = self._clients.get(peer_id)
+            self._clients[peer_id] = client
+        if old is not None:
+            old.close()
+        return client
+
+    def _sender_loop(self, peer_id: int, box: queue.Queue):
+        backoff_until = 0.0
+        while not self._stopped.is_set():
+            try:
+                msg = box.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            if msg is None:
+                return
+            now = time.monotonic()
+            with self._lock:
+                self._last_try[peer_id] = now
+            if now < backoff_until:
+                continue  # drop while the peer is unreachable; raft resends
+            client = self._client(peer_id)
+            if client is None:
+                backoff_until = time.monotonic() + RECONNECT_BACKOFF
+                continue
+            try:
+                client.call("raft.step", msg, timeout=SEND_TIMEOUT)
+                with self._lock:
+                    self._last_ok[peer_id] = time.monotonic()
+                backoff_until = 0.0
+            except Exception as exc:
+                log.debug("raft transport: send to %d failed: %s",
+                          peer_id, exc)
+                client.close()
+                backoff_until = time.monotonic() + RECONNECT_BACKOFF

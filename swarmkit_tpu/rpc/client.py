@@ -1,0 +1,234 @@
+"""RPC client: one multiplexed mTLS connection, unary calls + streams.
+
+The demux thread routes frames by stream id: unary calls block on an event;
+streams feed a watch Channel the caller consumes exactly like an in-process
+subscription (the agent's assignment stream and the raft peer stream both
+ride this). Connection loss fails every pending call and closes every
+stream — reconnect policy belongs to the caller (agent session backoff,
+raft peer retry), as in the reference (agent/session.go:90-118,
+manager/state/raft/transport/peer.go).
+"""
+from __future__ import annotations
+
+import logging
+import ssl
+import threading
+
+from ..store.watch import Channel
+from .wire import (
+    CANCEL,
+    ERR,
+    REQ,
+    RESP,
+    STREAM_END,
+    STREAM_ITEM,
+    ConnectionClosed,
+    RPCError,
+    client_ssl_context,
+    connect_tls,
+    recv_frame,
+    send_frame,
+)
+
+log = logging.getLogger("swarmkit_tpu.rpc.client")
+
+DEFAULT_CALL_TIMEOUT = 30.0
+
+# Exceptions a server may raise that the client re-raises as the local type
+# (everything else surfaces as RPCError). Data-only: name -> constructor
+# taking one message argument.
+_KNOWN_ERRORS: dict[str, type] = {}
+
+
+def _register_errors():
+    if _KNOWN_ERRORS:
+        return
+    from ..ca.auth import PermissionDenied
+    from ..ca.config import InvalidToken
+    from ..ca.certificates import CertificateError
+    from ..controlapi import errors as control_errors
+    from ..dispatcher.dispatcher import DispatcherError, SessionInvalid
+    from ..raft.proposer import ProposeError
+    from ..store.memory import ExistError, NotExistError, SequenceConflict
+
+    for name in dir(control_errors):
+        obj = getattr(control_errors, name)
+        if isinstance(obj, type) and issubclass(obj, Exception):
+            _KNOWN_ERRORS[obj.__name__] = obj
+    # registered after control errors: ca.auth.PermissionDenied wins the
+    # name collision (the authz edge is what the server raises)
+    for cls in (PermissionDenied, InvalidToken, CertificateError,
+                DispatcherError, SessionInvalid, ProposeError,
+                ExistError, NotExistError, SequenceConflict,
+                KeyError, ValueError, TimeoutError):
+        _KNOWN_ERRORS[cls.__name__] = cls
+
+
+def _make_error(name: str, message: str) -> Exception:
+    _register_errors()
+    cls = _KNOWN_ERRORS.get(name)
+    if cls is None:
+        return RPCError(name, message)
+    try:
+        return cls(message)
+    except Exception:
+        return RPCError(name, message)
+
+
+class _PendingCall:
+    __slots__ = ("event", "result", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.result = None
+        self.error: Exception | None = None
+
+
+class RPCClient:
+    """One connection to one server; thread-safe for concurrent calls."""
+
+    def __init__(self, addr: str, security=None,
+                 root_cert_pem: bytes | None = None,
+                 connect_timeout: float = 10.0):
+        self.addr = addr
+        ctx = client_ssl_context(security, root_cert_pem)
+        self._sock = connect_tls(addr, ctx, timeout=connect_timeout)
+        self._wlock = threading.Lock()
+        self._lock = threading.Lock()
+        self._next_id = 1
+        self._calls: dict[int, _PendingCall] = {}
+        self._streams: dict[int, Channel] = {}
+        self._closed = threading.Event()
+        self._demux = threading.Thread(target=self._demux_loop, daemon=True,
+                                       name=f"rpc-demux-{addr}")
+        self._demux.start()
+
+    # -- public ------------------------------------------------------------
+    def call(self, method: str, *args,
+             timeout: float = DEFAULT_CALL_TIMEOUT, **kwargs):
+        if self._closed.is_set():
+            raise ConnectionClosed(f"connection to {self.addr} is closed")
+        pending = _PendingCall()
+        stream_id = self._register(calls=pending)
+        try:
+            send_frame(self._sock, self._wlock,
+                       [REQ, stream_id, method, ((args), kwargs)])
+        except OSError as exc:
+            self._unregister(stream_id)
+            self._fail_all(ConnectionClosed(str(exc)))
+            raise ConnectionClosed(str(exc)) from exc
+        if not pending.event.wait(timeout):
+            self._unregister(stream_id)
+            raise TimeoutError(f"{method} timed out after {timeout}s")
+        if pending.error is not None:
+            raise pending.error
+        return pending.result
+
+    def stream(self, method: str, *args, limit: int | None = None,
+               **kwargs) -> Channel:
+        """Open a server stream; returns a Channel of items. The channel
+        closes on stream end, server error, or connection loss."""
+        if self._closed.is_set():
+            raise ConnectionClosed(f"connection to {self.addr} is closed")
+        ch = Channel(matcher=None, limit=limit)
+        stream_id = self._register(stream=ch)
+        try:
+            send_frame(self._sock, self._wlock,
+                       [REQ, stream_id, method, ((args), kwargs)])
+        except OSError as exc:
+            self._unregister(stream_id)
+            self._fail_all(ConnectionClosed(str(exc)))
+            raise ConnectionClosed(str(exc)) from exc
+        return ch
+
+    def cancel_stream(self, ch: Channel):
+        with self._lock:
+            sid = next((k for k, v in self._streams.items() if v is ch), None)
+        if sid is not None:
+            try:
+                send_frame(self._sock, self._wlock, [CANCEL, sid, "", None])
+            except OSError:
+                pass
+            self._unregister(sid)
+        ch.close()
+
+    @property
+    def alive(self) -> bool:
+        return not self._closed.is_set()
+
+    def close(self):
+        self._closed.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._fail_all(ConnectionClosed("client closed"))
+
+    # -- internals ---------------------------------------------------------
+    def _register(self, calls: _PendingCall | None = None,
+                  stream: Channel | None = None) -> int:
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+            if calls is not None:
+                self._calls[sid] = calls
+            if stream is not None:
+                self._streams[sid] = stream
+            return sid
+
+    def _unregister(self, sid: int):
+        with self._lock:
+            self._calls.pop(sid, None)
+            self._streams.pop(sid, None)
+
+    def _fail_all(self, exc: Exception):
+        with self._lock:
+            calls = list(self._calls.values())
+            streams = list(self._streams.values())
+            self._calls.clear()
+            self._streams.clear()
+        for p in calls:
+            p.error = exc
+            p.event.set()
+        for ch in streams:
+            ch.close()
+
+    def _demux_loop(self):
+        try:
+            while not self._closed.is_set():
+                ftype, sid, head, payload = recv_frame(self._sock)
+                if ftype == RESP:
+                    with self._lock:
+                        pending = self._calls.pop(sid, None)
+                    if pending is not None:
+                        pending.result = payload
+                        pending.event.set()
+                elif ftype == ERR:
+                    exc = _make_error(head, payload)
+                    with self._lock:
+                        pending = self._calls.pop(sid, None)
+                        stream = self._streams.pop(sid, None)
+                    if pending is not None:
+                        pending.error = exc
+                        pending.event.set()
+                    if stream is not None:
+                        stream.close()
+                elif ftype == STREAM_ITEM:
+                    with self._lock:
+                        stream = self._streams.get(sid)
+                    if stream is not None:
+                        stream._offer(payload)
+                elif ftype == STREAM_END:
+                    with self._lock:
+                        stream = self._streams.pop(sid, None)
+                    if stream is not None:
+                        stream.close()
+        except (ConnectionClosed, OSError, ssl.SSLError) as exc:
+            self._closed.set()
+            self._fail_all(ConnectionClosed(str(exc)))
+        finally:
+            self._closed.set()
+            try:
+                self._sock.close()
+            except OSError:
+                pass

@@ -113,9 +113,11 @@ class _Registry:
                         broker_mod.LogMessage, broker_mod.SubscriptionMessage):
                 self.add(cls)
 
+            from ..ca.auth import Caller
             from ..ca.certificates import CertIdentity
 
             self.add(CertIdentity)
+            self.add(Caller)
 
             # dataclasses that live inside store objects (and therefore in
             # raft entries / WAL records / snapshots)

@@ -1,0 +1,271 @@
+"""RPC server: serves registered service methods over mTLS.
+
+One listener carries every plane (raft, dispatcher, CA, control, logs,
+health), mirroring manager.go:441-641 where all gRPC services share the
+remote listener. Each method declares the roles allowed to call it; the
+authenticated Caller is derived from the peer certificate and passed as the
+first handler argument (the reference's authenticatedWrapper +
+ca/auth.go AuthorizeOrgAndRole, generated per service by
+protobuf/plugin/authenticatedwrapper).
+
+Streaming: a handler returning a generator or a watch Channel has its items
+pumped to the client as STREAM_ITEM frames until exhaustion, client CANCEL,
+or connection loss.
+"""
+from __future__ import annotations
+
+import logging
+import socket
+import ssl
+import threading
+from dataclasses import dataclass
+from typing import Callable
+
+from ..ca.auth import Caller, PermissionDenied
+from ..store.watch import Channel, ChannelClosed
+from .wire import (
+    CANCEL,
+    ERR,
+    REQ,
+    RESP,
+    STREAM_END,
+    STREAM_ITEM,
+    ConnectionClosed,
+    caller_from_socket,
+    recv_frame,
+    send_frame,
+    server_ssl_context,
+)
+
+log = logging.getLogger("swarmkit_tpu.rpc.server")
+
+ANON = "anon"  # marker role: method callable without a client certificate
+
+
+@dataclass
+class MethodDef:
+    func: Callable
+    roles: list  # NodeRole ints, or [ANON] for tokenless bootstrap methods
+    streaming: bool = False
+
+
+class ServiceRegistry:
+    """Method table shared by the server and the leader proxy."""
+
+    def __init__(self):
+        self.methods: dict[str, MethodDef] = {}
+
+    def add(self, name: str, func: Callable, roles: list,
+            streaming: bool = False):
+        self.methods[name] = MethodDef(func, roles, streaming)
+
+    def lookup(self, name: str) -> MethodDef | None:
+        return self.methods.get(name)
+
+
+class RPCServer:
+    def __init__(self, listen_addr: str, security, registry: ServiceRegistry,
+                 org: str | None = None):
+        self.security = security
+        self.registry = registry
+        self.org = org if org is not None else security.identity.org
+        host, _, port = listen_addr.rpartition(":")
+        self._bind = (host or "127.0.0.1", int(port))
+        self._sock: socket.socket | None = None
+        self._ctx_lock = threading.Lock()
+        self._ctx = server_ssl_context(security)
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        self.addr: str | None = None  # actual host:port after bind
+        # renewed certs / rotated roots apply to new connections
+        security.watch(self._reload_tls)
+
+    def _reload_tls(self, _security):
+        try:
+            ctx = server_ssl_context(self.security)
+        except Exception:
+            log.exception("rpc-server: TLS reload failed")
+            return
+        with self._ctx_lock:
+            self._ctx = ctx
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind(self._bind)
+        sock.listen(128)
+        self._sock = sock
+        host, port = sock.getsockname()[:2]
+        self.addr = f"{host}:{port}"
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name=f"rpc-accept-{port}")
+        t.start()
+        self._threads.append(t)
+
+    def stop(self):
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=2)
+
+    # -- accept/serve ------------------------------------------------------
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                raw, _peer = self._sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(raw,),
+                                 daemon=True, name="rpc-conn")
+            t.start()
+
+    def _serve_conn(self, raw: socket.socket):
+        try:
+            with self._ctx_lock:
+                ctx = self._ctx
+            conn = ctx.wrap_socket(raw, server_side=True)
+        except (ssl.SSLError, OSError) as exc:
+            log.debug("rpc-server: TLS handshake failed: %s", exc)
+            try:
+                raw.close()
+            except OSError:
+                pass
+            return
+        caller = caller_from_socket(conn)
+        if caller is not None and self.org and caller.org != self.org:
+            conn.close()
+            return
+        with self._conns_lock:
+            self._conns.add(conn)
+        wlock = threading.Lock()
+        cancels: dict[int, threading.Event] = {}
+        try:
+            while not self._stop.is_set():
+                frame = recv_frame(conn)
+                ftype, stream_id, head, payload = frame
+                if ftype == REQ:
+                    t = threading.Thread(
+                        target=self._handle_request,
+                        args=(conn, wlock, caller, stream_id, head, payload,
+                              cancels),
+                        daemon=True, name=f"rpc-call-{head}")
+                    t.start()
+                elif ftype == CANCEL:
+                    ev = cancels.get(stream_id)
+                    if ev is not None:
+                        ev.set()
+        except (ConnectionClosed, OSError, ssl.SSLError):
+            pass
+        finally:
+            for ev in cancels.values():
+                ev.set()
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- dispatch ----------------------------------------------------------
+    def _handle_request(self, conn, wlock, caller: Caller | None,
+                        stream_id: int, method: str, payload, cancels):
+        def reply_err(exc: Exception):
+            name = type(exc).__name__
+            try:
+                send_frame(conn, wlock, [ERR, stream_id, name, str(exc)])
+            except (OSError, ValueError):
+                pass
+
+        mdef = self.registry.lookup(method)
+        if mdef is None:
+            reply_err(PermissionDenied(f"unknown method {method!r}"))
+            return
+        if ANON not in mdef.roles:
+            if caller is None:
+                reply_err(PermissionDenied(
+                    f"{method} requires an authenticated peer"))
+                return
+            if caller.role not in mdef.roles:
+                reply_err(PermissionDenied(
+                    f"{method}: role not authorized"))
+                return
+        args, kwargs = payload if payload else ((), {})
+        forwarded = kwargs.pop("_forwarded_caller", None)
+        if forwarded is not None:
+            # Only a manager may assert a forwarded identity (the leader
+            # proxy path — ca/auth.go AuthorizeForwardedRoleAndOrg); the
+            # effective caller becomes the original, with the proxying
+            # manager recorded.
+            from ..api.types import NodeRole
+
+            if caller is None or caller.role != NodeRole.MANAGER:
+                reply_err(PermissionDenied(
+                    "forwarded identity requires a manager peer"))
+                return
+            forwarded.forwarded_by = caller
+            caller = forwarded
+            if ANON not in mdef.roles and caller.role not in mdef.roles:
+                reply_err(PermissionDenied(f"{method}: role not authorized"))
+                return
+        try:
+            result = mdef.func(caller, *args, **kwargs)
+        except Exception as exc:  # handler error -> wire error
+            reply_err(exc)
+            return
+        if not mdef.streaming:
+            try:
+                send_frame(conn, wlock, [RESP, stream_id, "", result])
+            except ValueError as exc:  # encode failure
+                reply_err(exc)
+            except OSError:
+                pass
+            return
+        # streaming: pump a Channel or generator until done/cancel/dead conn
+        cancel = threading.Event()
+        cancels[stream_id] = cancel
+        try:
+            if isinstance(result, Channel):
+                while not cancel.is_set() and not self._stop.is_set():
+                    try:
+                        item = result.get(timeout=0.2)
+                    except TimeoutError:
+                        continue
+                    except ChannelClosed:
+                        break
+                    send_frame(conn, wlock,
+                               [STREAM_ITEM, stream_id, "", item])
+            else:
+                for item in result:
+                    if cancel.is_set() or self._stop.is_set():
+                        break
+                    send_frame(conn, wlock,
+                               [STREAM_ITEM, stream_id, "", item])
+            send_frame(conn, wlock, [STREAM_END, stream_id, "", None])
+        except (OSError, ValueError, ConnectionClosed):
+            pass
+        except Exception as exc:
+            reply_err(exc)
+        finally:
+            cancels.pop(stream_id, None)
+            if isinstance(result, Channel):
+                result.close()
+            close = getattr(result, "close", None)
+            if close is not None and not isinstance(result, Channel):
+                try:
+                    close()
+                except Exception:
+                    pass

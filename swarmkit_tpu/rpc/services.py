@@ -1,0 +1,440 @@
+"""Service adapters: expose manager components over the RPC substrate.
+
+The reference wires each gRPC service with an authenticatedWrapper (role
+gate from the peer cert) and a raft proxy (non-leader managers transparently
+forward to the leader) — manager/manager.go:480-561,
+protobuf/plugin/{authenticatedwrapper,raftproxy}. Here:
+
+  * `build_manager_registry` declares every method with its allowed roles;
+  * write paths route through `_leader_forward`: served locally on the
+    leader, proxied to the leader's RPC endpoint otherwise, with the
+    original caller carried as forwarded identity (only managers may
+    assert it — enforced in rpc/server.py);
+  * client shims (RemoteDispatcher, RemoteControl, RemoteCA, RemoteLogs)
+    present the same method surface as the in-process objects, so the
+    agent and CLI run unchanged over the wire.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+
+from ..api.types import NodeRole
+from ..ca.auth import Caller, PermissionDenied
+from .client import RPCClient
+from .server import ANON, ServiceRegistry
+
+log = logging.getLogger("swarmkit_tpu.rpc.services")
+
+MANAGER = NodeRole.MANAGER
+WORKER = NodeRole.WORKER
+
+
+class NotLeaderError(Exception):
+    """Raised when a write reaches a non-leader manager that cannot locate
+    (or reach) the current leader."""
+
+
+class LeaderConns:
+    """Cached client connection to the current raft leader
+    (manager/raftselector + raft.go LeaderConn:1512-1541)."""
+
+    def __init__(self, raft_node, security):
+        self.raft = raft_node
+        self.security = security
+        self._lock = threading.Lock()
+        self._client: RPCClient | None = None
+        self._client_addr: str | None = None
+
+    def leader_addr(self) -> str | None:
+        node = self.raft
+        if node is None:
+            return None
+        leader_id = node.leader_id
+        if leader_id is None or leader_id == node.id:
+            return None
+        peer = node.members.get(leader_id)
+        if peer is None or not peer.addr or peer.addr.startswith("mem://"):
+            return None
+        return peer.addr
+
+    def client(self) -> RPCClient:
+        addr = self.leader_addr()
+        if addr is None:
+            raise NotLeaderError("no reachable raft leader")
+        with self._lock:
+            if self._client is not None and self._client.alive \
+                    and self._client_addr == addr:
+                return self._client
+            old, self._client = self._client, None
+        if old is not None:
+            old.close()
+        client = RPCClient(addr, security=self.security)
+        with self._lock:
+            self._client = client
+            self._client_addr = addr
+        return client
+
+    def close(self):
+        with self._lock:
+            client, self._client = self._client, None
+        if client is not None:
+            client.close()
+
+
+def _strip_forward(caller: Caller | None) -> Caller | None:
+    if caller is None:
+        return None
+    return Caller(node_id=caller.node_id, role=caller.role, org=caller.org)
+
+
+def build_manager_registry(manager, raft_node=None,
+                           leader_conns: LeaderConns | None = None,
+                           ) -> ServiceRegistry:
+    """Declare every plane on one registry (manager.go Run:441-641)."""
+    reg = ServiceRegistry()
+    is_leader = (lambda: True) if raft_node is None else \
+        (lambda: raft_node.is_leader)
+
+    def leader_forward(method_name, local_fn):
+        """Serve locally on the leader; otherwise forward to the leader with
+        the original caller as forwarded identity. A call that already
+        carries a forwarded identity is never forwarded again (one hop)."""
+
+        def wrapper(caller, *args, **kwargs):
+            if is_leader() or (caller is not None
+                               and caller.forwarded_by is not None):
+                return local_fn(caller, *args, **kwargs)
+            if leader_conns is None:
+                raise NotLeaderError("not the leader and no forwarding path")
+            client = leader_conns.client()
+            return client.call(method_name, *args,
+                               _forwarded_caller=_strip_forward(caller),
+                               **kwargs)
+
+        return wrapper
+
+    # ---------------------------------------------------------------- raft
+    if raft_node is not None:
+        def raft_step(caller, msg):
+            raft_node.step(msg)
+            return None
+
+        def raft_resolve_address(caller, raft_id):
+            peer = raft_node.members.get(raft_id)
+            return peer.addr if peer is not None else None
+
+        def raft_join(caller, node_id, addr):
+            """RaftMembership.Join (api/raft.proto:39-44, raft.go Join:926):
+            leader allocates a raft id, proposes the conf-change, returns
+            the member list for the joiner's bootstrap."""
+            from ..raft.messages import ConfChange
+            from ..utils.identity import new_id
+
+            if not raft_node.is_leader:
+                raise NotLeaderError("join must be served by the leader")
+            existing = raft_node.member_by_node_id(node_id)
+            if existing is not None:
+                if existing.addr != addr:
+                    raft_node.transport.update_peer_addr(existing.raft_id, addr)
+                return (existing.raft_id, _member_list(raft_node))
+            raft_id = max(raft_node.members, default=0) + 1
+            done = threading.Event()
+            outcome = {}
+
+            def cb(ok, err=""):
+                outcome["ok"] = ok
+                outcome["err"] = err
+                done.set()
+
+            raft_node.propose_conf_change(
+                ConfChange(action="add", raft_id=raft_id, node_id=node_id,
+                           addr=addr), new_id(), cb)
+            if not done.wait(10) or not outcome.get("ok"):
+                raise NotLeaderError(
+                    f"join failed: {outcome.get('err', 'timeout')}")
+            return (raft_id, _member_list(raft_node))
+
+        def raft_leave(caller, node_id):
+            if not raft_node.is_leader:
+                raise NotLeaderError("leave must be served by the leader")
+            if not raft_node.remove_member_by_node_id(node_id):
+                raise NotLeaderError("leave failed (quorum check)")
+            return None
+
+        reg.add("raft.step", raft_step, roles=[MANAGER])
+        reg.add("raft.resolve_address", raft_resolve_address, roles=[MANAGER])
+        reg.add("raft.join", raft_join, roles=[MANAGER])
+        reg.add("raft.leave", raft_leave, roles=[MANAGER])
+
+    # ---------------------------------------------------------- dispatcher
+    d = manager.dispatcher
+
+    def _require_node(caller, node_id):
+        # the authenticated CN is the node identity; a node may only drive
+        # its own session (dispatcher.go register derives from TLS state)
+        if caller is None or (caller.node_id != node_id
+                              and caller.role != MANAGER):
+            raise PermissionDenied("session node id must match certificate")
+
+    def disp_register(caller, node_id, description=None):
+        _require_node(caller, node_id)
+        return d.register(node_id, description)
+
+    def disp_heartbeat(caller, node_id, session_id):
+        _require_node(caller, node_id)
+        return d.heartbeat(node_id, session_id)
+
+    def disp_assignments(caller, node_id, session_id):
+        _require_node(caller, node_id)
+        return d.assignments(node_id, session_id)  # Channel -> stream
+
+    def disp_update_task_status(caller, node_id, session_id, updates):
+        _require_node(caller, node_id)
+        return d.update_task_status(node_id, session_id, updates)
+
+    def disp_update_volume_status(caller, node_id, session_id, unpublished):
+        _require_node(caller, node_id)
+        return d.update_volume_status(node_id, session_id, unpublished)
+
+    def disp_leave(caller, node_id, session_id):
+        _require_node(caller, node_id)
+        return d.leave(node_id, session_id)
+
+    both = [WORKER, MANAGER]
+    reg.add("dispatcher.register",
+            leader_forward("dispatcher.register", disp_register), roles=both)
+    reg.add("dispatcher.heartbeat",
+            leader_forward("dispatcher.heartbeat", disp_heartbeat), roles=both)
+    reg.add("dispatcher.assignments", disp_assignments, roles=both,
+            streaming=True)  # streams cannot hop; agents follow the leader
+    reg.add("dispatcher.update_task_status",
+            leader_forward("dispatcher.update_task_status",
+                           disp_update_task_status), roles=both)
+    reg.add("dispatcher.update_volume_status",
+            leader_forward("dispatcher.update_volume_status",
+                           disp_update_volume_status), roles=both)
+    reg.add("dispatcher.leave",
+            leader_forward("dispatcher.leave", disp_leave), roles=both)
+
+    def disp_leader_addr(caller):
+        """Where the assignment stream lives (agents redirect here)."""
+        if is_leader():
+            return None  # you are talking to the leader
+        if leader_conns is None:
+            raise NotLeaderError("no leader known")
+        addr = leader_conns.leader_addr()
+        if addr is None:
+            raise NotLeaderError("no leader known")
+        return addr
+
+    reg.add("dispatcher.leader_addr", disp_leader_addr, roles=both)
+
+    # ------------------------------------------------------------------ ca
+    ca = manager.ca_server
+
+    def ca_issue(caller, csr_pem, token=None, node_id=None):
+        return ca.issue_node_certificate(csr_pem, token=token,
+                                         node_id=node_id, caller=caller)
+
+    def ca_status(caller, node_id, timeout=10.0):
+        return ca.node_certificate_status(node_id, timeout=min(timeout, 30.0))
+
+    def ca_root(caller):
+        return ca.get_root_ca_certificate()
+
+    reg.add("ca.issue_node_certificate",
+            leader_forward("ca.issue_node_certificate", ca_issue),
+            roles=[ANON])
+    reg.add("ca.node_certificate_status", ca_status, roles=[ANON])
+    reg.add("ca.get_root_ca_certificate", ca_root, roles=[ANON])
+
+    # -------------------------------------------------------------- control
+    control = manager.control_api
+    for name in dir(control):
+        if name.startswith("_"):
+            continue
+        fn = getattr(control, name)
+        if not callable(fn):
+            continue
+
+        def local(caller, *args, _fn=fn, **kwargs):
+            return _fn(*args, **kwargs)
+
+        # the control surface is manager-role only (the CLI authenticates
+        # with the node's manager certificate; workers have no business
+        # mutating cluster state — reference authorizes Control as manager)
+        reg.add(f"control.{name}",
+                leader_forward(f"control.{name}", local), roles=[MANAGER])
+
+    # ---------------------------------------------------------------- logs
+    broker = manager.log_broker
+
+    def logs_subscribe(caller, selector, follow=True):
+        _sub_id, ch = broker.subscribe_logs(selector, follow=follow)
+        return ch
+
+    def logs_listen_subscriptions(caller, node_id):
+        _require_node(caller, node_id)
+        return broker.listen_subscriptions(node_id)
+
+    def logs_publish(caller, sub_id, messages):
+        return broker.publish_logs(sub_id, messages)
+
+    reg.add("logs.subscribe", logs_subscribe, roles=[MANAGER], streaming=True)
+    reg.add("logs.listen_subscriptions", logs_listen_subscriptions,
+            roles=both, streaming=True)
+    reg.add("logs.publish", logs_publish, roles=both)
+
+    # --------------------------------------------------------------- watch
+    watch_api = manager.watch_api
+
+    def watch_events(caller, selectors=None, since_version=None):
+        return watch_api.watch(selectors, since_version)
+
+    reg.add("watch.events", watch_events, roles=[MANAGER], streaming=True)
+
+    # -------------------------------------------------------------- health
+    def health_check(caller, service=""):
+        return manager.health.check(service)
+
+    reg.add("health.check", health_check, roles=[ANON])
+
+    return reg
+
+
+def _member_list(raft_node):
+    return [(p.raft_id, p.node_id, p.addr)
+            for p in raft_node.members.values()]
+
+
+# --------------------------------------------------------------------------
+# Client shims: in-process method surface over the wire.
+# --------------------------------------------------------------------------
+
+
+class RemoteDispatcher:
+    """Drop-in for the Dispatcher object held by an Agent; reconnection is
+    the agent's session loop's job (it already retries register)."""
+
+    def __init__(self, addr: str, security, connect_timeout: float = 10.0):
+        self.addr = addr
+        self.security = security
+        self._connect_timeout = connect_timeout
+        self._lock = threading.Lock()
+        self._client: RPCClient | None = None
+
+    def _conn(self) -> RPCClient:
+        with self._lock:
+            if self._client is not None and self._client.alive:
+                return self._client
+            self._client = RPCClient(self.addr, security=self.security,
+                                     connect_timeout=self._connect_timeout)
+            return self._client
+
+    def register(self, node_id, description=None):
+        # follow the leader: the assignments stream cannot be proxied, so
+        # sessions are opened against the leader's endpoint directly
+        addr = self._conn().call("dispatcher.leader_addr", node_id)
+        if addr is not None and addr != self.addr:
+            self.close()
+            self.addr = addr
+        return self._conn().call("dispatcher.register", node_id, description)
+
+    def heartbeat(self, node_id, session_id):
+        return self._conn().call("dispatcher.heartbeat", node_id, session_id)
+
+    def assignments(self, node_id, session_id):
+        return self._conn().stream("dispatcher.assignments", node_id,
+                                   session_id)
+
+    def update_task_status(self, node_id, session_id, updates):
+        return self._conn().call("dispatcher.update_task_status", node_id,
+                                 session_id, updates)
+
+    def update_volume_status(self, node_id, session_id, unpublished):
+        return self._conn().call("dispatcher.update_volume_status", node_id,
+                                 session_id, unpublished)
+
+    def leave(self, node_id, session_id):
+        return self._conn().call("dispatcher.leave", node_id, session_id)
+
+    def close(self):
+        with self._lock:
+            client, self._client = self._client, None
+        if client is not None:
+            client.close()
+
+
+class RemoteCA:
+    """ca_server surface for node bootstrap + renewal (the TLSRenewer and
+    Node.run use exactly these four methods)."""
+
+    def __init__(self, addr: str, security=None,
+                 root_cert_pem: bytes | None = None):
+        self.addr = addr
+        self.security = security
+        self.root_cert_pem = root_cert_pem
+        self._lock = threading.Lock()
+        self._client: RPCClient | None = None
+
+    def _conn(self) -> RPCClient:
+        with self._lock:
+            if self._client is not None and self._client.alive:
+                return self._client
+            self._client = RPCClient(self.addr, security=self.security,
+                                     root_cert_pem=self.root_cert_pem)
+            return self._client
+
+    def issue_node_certificate(self, csr_pem, token=None, node_id=None,
+                               caller=None):
+        # `caller` is derived server-side from the TLS peer; accepted here
+        # for in-process signature compatibility and ignored
+        return self._conn().call("ca.issue_node_certificate", csr_pem,
+                                 token=token, node_id=node_id)
+
+    def node_certificate_status(self, node_id, timeout: float = 10.0):
+        # the long-poll happens server-side; give the RPC a little headroom
+        return self._conn().call("ca.node_certificate_status", node_id,
+                                 timeout, timeout=timeout + 10.0)
+
+    def get_root_ca_certificate(self):
+        return self._conn().call("ca.get_root_ca_certificate")
+
+    def close(self):
+        with self._lock:
+            client, self._client = self._client, None
+        if client is not None:
+            client.close()
+
+
+class RemoteControl:
+    """controlapi.ControlAPI surface over the wire (for swarmctl)."""
+
+    def __init__(self, addr: str, security):
+        self.addr = addr
+        self.security = security
+        self._lock = threading.Lock()
+        self._client: RPCClient | None = None
+
+    def _conn(self) -> RPCClient:
+        with self._lock:
+            if self._client is not None and self._client.alive:
+                return self._client
+            self._client = RPCClient(self.addr, security=self.security)
+            return self._client
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def call(*args, **kwargs):
+            return self._conn().call(f"control.{name}", *args, **kwargs)
+
+        return call
+
+    def close(self):
+        with self._lock:
+            client, self._client = self._client, None
+        if client is not None:
+            client.close()

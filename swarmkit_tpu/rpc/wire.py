@@ -1,0 +1,194 @@
+"""Stream-RPC wire protocol: length-prefixed msgpack frames over (m)TLS.
+
+The reference's three communication planes all ride gRPC+mTLS
+(manager/state/raft/transport/, api/dispatcher.proto, api/control.proto).
+Our equivalent is a small multiplexed stream protocol over one TLS
+connection per peer pair:
+
+    frame    := uint32_be length ++ msgpack body
+    body     := [type, stream_id, head, payload]
+    type     := REQ | RESP | ERR | STREAM_ITEM | STREAM_END | CANCEL
+    head     := method name (REQ), error name (ERR), "" otherwise
+    payload  := codec-encoded args / result / error message
+
+A REQ opens a stream id chosen by the client (monotonically increasing
+ints). Unary calls answer with one RESP or ERR. Streaming calls answer
+with STREAM_ITEMs terminated by STREAM_END or ERR; the client may abort
+early with CANCEL.
+
+TLS identity: certificates minted by the cluster CA carry CN=node-id,
+OU=role, O=org (ca/certificates.py); both ends verify the peer chain
+against the cluster root, and servers derive the authenticated Caller from
+the client certificate — the analogue of the reference's
+ca/auth.go:88-196 per-RPC authorization.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import ssl
+import struct
+import tempfile
+import threading
+
+from ..ca.auth import Caller
+from ..ca.certificates import CertificateError, ou_to_role
+from . import codec
+
+REQ, RESP, ERR, STREAM_ITEM, STREAM_END, CANCEL = 1, 2, 3, 4, 5, 6
+
+MAX_FRAME = 64 * 1024 * 1024  # large snapshots must fit; DoS-bounded
+_LEN = struct.Struct(">I")
+
+
+class RPCError(Exception):
+    """Server-reported error with no registered local exception type."""
+
+    def __init__(self, name: str, message: str):
+        super().__init__(f"{name}: {message}")
+        self.name = name
+        self.message = message
+
+
+class ConnectionClosed(Exception):
+    pass
+
+
+def send_frame(sock, lock: threading.Lock, body: list) -> None:
+    data = codec.dumps(body)
+    if len(data) > MAX_FRAME:
+        raise ValueError(f"frame of {len(data)} bytes exceeds MAX_FRAME")
+    with lock:
+        sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def recv_frame(sock) -> list:
+    header = _recv_exact(sock, _LEN.size)
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        raise ConnectionClosed(f"oversized frame ({length} bytes)")
+    return codec.loads(_recv_exact(sock, length))
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionClosed("peer closed connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+# --------------------------------------------------------------------- TLS
+
+
+class _PemFiles:
+    """ssl.SSLContext only loads key material from files; stage the PEMs in
+    a private temp dir for the duration of context construction."""
+
+    def __init__(self, *pems: bytes):
+        self.dir = tempfile.mkdtemp(prefix="skt-tls-")
+        os.chmod(self.dir, 0o700)
+        self.paths = []
+        for i, pem in enumerate(pems):
+            p = os.path.join(self.dir, f"{i}.pem")
+            fd = os.open(p, os.O_WRONLY | os.O_CREAT, 0o600)
+            with os.fdopen(fd, "wb") as f:
+                f.write(pem)
+            self.paths.append(p)
+
+    def __enter__(self):
+        return self.paths
+
+    def __exit__(self, *exc):
+        for p in self.paths:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        try:
+            os.rmdir(self.dir)
+        except OSError:
+            pass
+
+
+def server_ssl_context(security, require_client_cert: bool = False) -> ssl.SSLContext:
+    """mTLS server context from a SecurityConfig. Client certs are
+    *requested*; when `require_client_cert` is False an anonymous client is
+    admitted but authenticates as no one (Caller None) — this is how a
+    joining node with only a join token reaches the CA service, mirroring
+    the reference's unauthenticated NodeCA.IssueNodeCertificate."""
+    key_pem, cert_pem = security.key_and_cert()
+    ca_pem = security.root_ca.cert_pem
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+    with _PemFiles(cert_pem, key_pem, ca_pem) as (cert_f, key_f, ca_f):
+        ctx.load_cert_chain(cert_f, key_f)
+        ctx.load_verify_locations(ca_f)
+    ctx.verify_mode = (ssl.CERT_REQUIRED if require_client_cert
+                       else ssl.CERT_OPTIONAL)
+    return ctx
+
+
+def client_ssl_context(security=None, root_cert_pem: bytes | None = None) -> ssl.SSLContext:
+    """mTLS client context. With a SecurityConfig the client presents its
+    node certificate; with only `root_cert_pem` (join-token bootstrap,
+    before any cert exists) the client authenticates the server but not
+    itself."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+    # cluster certs carry identity in the subject (CN=node id), not
+    # hostnames; the chain check against the cluster root is the trust
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    if security is not None:
+        key_pem, cert_pem = security.key_and_cert()
+        with _PemFiles(cert_pem, key_pem, security.root_ca.cert_pem) as (
+                cert_f, key_f, ca_f):
+            ctx.load_cert_chain(cert_f, key_f)
+            ctx.load_verify_locations(ca_f)
+    elif root_cert_pem is not None:
+        with _PemFiles(root_cert_pem) as (ca_f,):
+            ctx.load_verify_locations(ca_f)
+    else:
+        raise ValueError("need a SecurityConfig or a root cert to trust")
+    return ctx
+
+
+def caller_from_socket(ssl_sock) -> Caller | None:
+    """Authenticated identity from the peer certificate (subject CN/OU/O),
+    None for anonymous (no client cert presented)."""
+    cert = ssl_sock.getpeercert()
+    if not cert:
+        return None
+    subject = {}
+    for rdn in cert.get("subject", ()):
+        for key, value in rdn:
+            subject[key] = value
+    cn = subject.get("commonName", "")
+    ou = subject.get("organizationalUnitName", "")
+    org = subject.get("organizationName", "")
+    if not cn or not ou:
+        return None
+    try:
+        role = ou_to_role(ou)
+    except CertificateError:
+        return None
+    return Caller(node_id=cn, role=role, org=org)
+
+
+def connect_tls(addr: str, ctx: ssl.SSLContext, timeout: float = 10.0):
+    host, port = parse_addr(addr)
+    raw = socket.create_connection((host, port), timeout=timeout)
+    raw.settimeout(None)
+    return ctx.wrap_socket(raw, server_hostname=host)
+
+
+def parse_addr(addr: str) -> tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    if not host:
+        raise ValueError(f"address {addr!r} must be host:port")
+    if host.startswith("[") and host.endswith("]"):
+        host = host[1:-1]
+    return host, int(port)

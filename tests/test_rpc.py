@@ -1,0 +1,192 @@
+"""RPC substrate tests: mTLS framing, unary + streaming calls, role authz,
+anonymous bootstrap access, cluster isolation (reference analogues:
+manager/state/raft/transport tests, ca/auth.go authorization tests)."""
+import threading
+import time
+
+import pytest
+
+from swarmkit_tpu.api.objects import Task
+from swarmkit_tpu.api.types import NodeRole, TaskState
+from swarmkit_tpu.ca import RootCA, SecurityConfig
+from swarmkit_tpu.ca.auth import PermissionDenied
+from swarmkit_tpu.ca.certificates import create_csr
+from swarmkit_tpu.rpc.client import RPCClient
+from swarmkit_tpu.rpc.server import ANON, RPCServer, ServiceRegistry
+from swarmkit_tpu.rpc.wire import ConnectionClosed
+from swarmkit_tpu.store.watch import Channel, ChannelClosed
+
+ORG = "rpc-test-org"
+
+
+def make_identity(root: RootCA, node_id: str, role: int) -> SecurityConfig:
+    key_pem, csr_pem = create_csr(node_id, role, ORG)
+    cert_pem = root.sign_csr(csr_pem, subject=(node_id, role, ORG))
+    return SecurityConfig(root, key_pem, cert_pem)
+
+
+@pytest.fixture(scope="module")
+def cluster_ca():
+    return RootCA.create(ORG)
+
+
+@pytest.fixture
+def server(cluster_ca):
+    sec = make_identity(cluster_ca, "server-node", NodeRole.MANAGER)
+    reg = ServiceRegistry()
+
+    def echo(caller, value):
+        return {"value": value, "caller": caller.node_id if caller else None}
+
+    def whoami(caller):
+        return (caller.node_id, caller.role) if caller else None
+
+    def boom(caller):
+        raise KeyError("nope")
+
+    def countdown(caller, n):
+        for i in range(n, 0, -1):
+            yield i
+
+    ch = Channel(matcher=None, limit=None)
+
+    def subscribe(caller):
+        return ch
+
+    def manager_only(caller):
+        return "secret"
+
+    reg.add("test.echo", echo, roles=[NodeRole.WORKER, NodeRole.MANAGER])
+    reg.add("test.whoami", whoami, roles=[ANON])
+    reg.add("test.boom", boom, roles=[NodeRole.WORKER, NodeRole.MANAGER])
+    reg.add("test.countdown", countdown,
+            roles=[NodeRole.WORKER, NodeRole.MANAGER], streaming=True)
+    reg.add("test.subscribe", subscribe,
+            roles=[NodeRole.WORKER, NodeRole.MANAGER], streaming=True)
+    reg.add("test.manager_only", manager_only, roles=[NodeRole.MANAGER])
+
+    srv = RPCServer("127.0.0.1:0", sec, reg, org=ORG)
+    srv.start()
+    srv._test_channel = ch
+    yield srv
+    srv.stop()
+
+
+def worker_client(cluster_ca, server, name="worker-1"):
+    sec = make_identity(cluster_ca, name, NodeRole.WORKER)
+    return RPCClient(server.addr, security=sec)
+
+
+def test_unary_roundtrip_carries_objects_and_identity(cluster_ca, server):
+    c = worker_client(cluster_ca, server)
+    try:
+        t = Task(id="t1", service_id="s1")
+        t.desired_state = TaskState.RUNNING
+        out = c.call("test.echo", t)
+        assert out["value"] == t
+        assert out["value"].desired_state is TaskState.RUNNING
+        assert out["caller"] == "worker-1"
+    finally:
+        c.close()
+
+
+def test_server_errors_map_to_local_exceptions(cluster_ca, server):
+    c = worker_client(cluster_ca, server)
+    try:
+        with pytest.raises(KeyError):
+            c.call("test.boom")
+    finally:
+        c.close()
+
+
+def test_generator_stream(cluster_ca, server):
+    c = worker_client(cluster_ca, server)
+    try:
+        ch = c.stream("test.countdown", 3)
+        assert [ch.get(timeout=2) for _ in range(3)] == [3, 2, 1]
+        with pytest.raises(ChannelClosed):
+            ch.get(timeout=2)
+    finally:
+        c.close()
+
+
+def test_channel_stream_live_publish(cluster_ca, server):
+    c = worker_client(cluster_ca, server)
+    try:
+        ch = c.stream("test.subscribe")
+        time.sleep(0.2)  # let the server-side pump attach
+        server._test_channel._offer({"n": 1})
+        server._test_channel._offer({"n": 2})
+        assert ch.get(timeout=2) == {"n": 1}
+        assert ch.get(timeout=2) == {"n": 2}
+    finally:
+        c.close()
+
+
+def test_role_authorization_enforced(cluster_ca, server):
+    c = worker_client(cluster_ca, server)
+    try:
+        with pytest.raises(PermissionDenied):
+            c.call("test.manager_only")
+    finally:
+        c.close()
+    sec = make_identity(cluster_ca, "mgr-1", NodeRole.MANAGER)
+    m = RPCClient(server.addr, security=sec)
+    try:
+        assert m.call("test.manager_only") == "secret"
+    finally:
+        m.close()
+
+
+def test_anonymous_client_limited_to_anon_methods(cluster_ca, server):
+    # a joining node has no cert yet: it trusts the cluster root and may
+    # only reach ANON methods (the CA bootstrap surface)
+    c = RPCClient(server.addr, root_cert_pem=cluster_ca.cert_pem)
+    try:
+        assert c.call("test.whoami") is None
+        with pytest.raises(PermissionDenied):
+            c.call("test.echo", 1)
+    finally:
+        c.close()
+
+
+def test_foreign_cluster_cert_rejected(server):
+    other_root = RootCA.create(ORG)  # same org string, different root key
+    sec = make_identity(other_root, "intruder", NodeRole.MANAGER)
+    # the server does not trust this root: handshake (or first call) fails
+    with pytest.raises((ConnectionClosed, OSError, TimeoutError)):
+        c = RPCClient(server.addr, security=sec)
+        c.call("test.whoami", timeout=3)
+
+
+def test_concurrent_calls_multiplex(cluster_ca, server):
+    c = worker_client(cluster_ca, server)
+    results = []
+    errs = []
+
+    def one(i):
+        try:
+            results.append(c.call("test.echo", i)["value"])
+        except Exception as exc:  # pragma: no cover
+            errs.append(exc)
+
+    try:
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(20)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errs
+        assert sorted(results) == list(range(20))
+    finally:
+        c.close()
+
+
+def test_connection_loss_fails_pending(cluster_ca, server):
+    c = worker_client(cluster_ca, server)
+    ch = c.stream("test.subscribe")
+    c.close()
+    with pytest.raises(ChannelClosed):
+        ch.get(timeout=2)
+    with pytest.raises(ConnectionClosed):
+        c.call("test.echo", 1)
