@@ -85,7 +85,10 @@ class Agent:
                 # re-listen, like the session reconnect loop does
                 if self._stop.wait(timeout=0.2):
                     return
-                ch = self.log_broker.listen_subscriptions(self.node_id)
+                try:
+                    ch = self.log_broker.listen_subscriptions(self.node_id)
+                except Exception:
+                    continue  # broker unreachable; retry after the wait
                 pumped.clear()
                 continue
             if msg.close:

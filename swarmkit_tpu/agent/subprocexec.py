@@ -1,0 +1,200 @@
+"""Subprocess executor: the real-workload executor for swarmd.
+
+The reference's production executor adapts tasks onto the Docker Engine API
+(swarmd/dockerexec/controller.go:95-256 — Prepare creates the container,
+Start runs it, Wait blocks on exit, Shutdown stops with a grace period,
+Terminate kills). Our runtime substrate is the host itself: a task's
+ContainerSpec.command/args/env run as a child process, which makes swarmd a
+real process orchestrator without a container engine dependency.
+
+FSM mapping (agent/exec.do drives this through the task states):
+    prepare   → validate the spec, resolve the command
+    start     → spawn the child (its own process group)
+    wait      → wait for exit; nonzero exit → task FAILED with the code
+    shutdown  → SIGTERM, then SIGKILL after stop_grace_period
+    terminate → SIGKILL
+Logs: stdout/stderr are captured to per-task files under the state dir and
+served to the LogBroker via `logs()`.
+"""
+from __future__ import annotations
+
+import os
+import shlex
+import signal
+import subprocess
+import threading
+
+from ..api.objects import Task
+from ..api.specs import NodeDescription, Platform, Resources
+from .exec import ExitStatus, FatalError
+
+
+def _platform() -> Platform:
+    u = os.uname()
+    arch = {"x86_64": "amd64", "aarch64": "arm64"}.get(u.machine, u.machine)
+    return Platform(os=u.sysname.lower(), architecture=arch)
+
+
+def _total_memory() -> int:
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 8 * 2**30
+
+
+class SubprocessController:
+    def __init__(self, task: Task, log_dir: str | None):
+        self.task = task
+        self.log_dir = log_dir
+        self._proc: subprocess.Popen | None = None
+        self._cmd: list[str] | None = None
+        self._env: dict[str, str] | None = None
+        self._lock = threading.Lock()
+        self._exited = threading.Event()
+        self._exit_code: int | None = None
+        self._log_path: str | None = None
+
+    # ------------------------------------------------------------------ FSM
+    def update(self, task: Task):
+        self.task = task
+
+    def prepare(self):
+        spec = self.task.spec.runtime
+        if spec is None:
+            raise FatalError("task has no container runtime spec")
+        cmd = list(spec.command) + list(spec.args)
+        if not cmd:
+            # the "image" is the program for a process executor; support
+            # `image: "sh -c '...'"` style one-liners
+            if spec.image:
+                cmd = shlex.split(spec.image)
+        if not cmd:
+            raise FatalError("no command to run")
+        self._cmd = cmd
+        env = dict(os.environ)
+        for kv in spec.env:
+            key, _, value = kv.partition("=")
+            env[key] = value
+        env["SWARMKIT_TASK_ID"] = self.task.id
+        env["SWARMKIT_SERVICE_ID"] = self.task.service_id
+        env["SWARMKIT_NODE_ID"] = self.task.node_id
+        env["SWARMKIT_SLOT"] = str(self.task.slot)
+        self._env = env
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._log_path = os.path.join(self.log_dir,
+                                          f"{self.task.id}.log")
+
+    def start(self):
+        if self._cmd is None:
+            raise FatalError("start before prepare")
+        out = (open(self._log_path, "ab")
+               if self._log_path else subprocess.DEVNULL)
+        try:
+            proc = subprocess.Popen(
+                self._cmd,
+                stdout=out,
+                stderr=subprocess.STDOUT,
+                stdin=subprocess.DEVNULL,
+                env=self._env,
+                cwd=self.task.spec.runtime.dir or None,
+                start_new_session=True,  # own process group: kill the tree
+            )
+        except (OSError, ValueError) as exc:
+            raise FatalError(f"spawn failed: {exc}") from exc
+        finally:
+            if out is not subprocess.DEVNULL:
+                out.close()
+        with self._lock:
+            self._proc = proc
+
+    def wait(self) -> ExitStatus:
+        with self._lock:
+            proc = self._proc
+        if proc is None:
+            raise FatalError("wait before start")
+        code = proc.wait()
+        self._exit_code = code
+        self._exited.set()
+        return ExitStatus(code, f"exit {code}" if code else "")
+
+    def _signal_group(self, sig: int):
+        with self._lock:
+            proc = self._proc
+        if proc is None or proc.poll() is not None:
+            return
+        try:
+            os.killpg(proc.pid, sig)
+        except (ProcessLookupError, PermissionError):
+            try:
+                proc.send_signal(sig)
+            except ProcessLookupError:
+                pass
+
+    def shutdown(self):
+        """Graceful stop: SIGTERM, escalate to SIGKILL after the spec's
+        grace period (dockerexec Shutdown → engine stop semantics)."""
+        spec = self.task.spec.runtime
+        grace = spec.stop_grace_period if spec is not None else 10.0
+        self._signal_group(signal.SIGTERM)
+        with self._lock:
+            proc = self._proc
+        if proc is None:
+            return
+        try:
+            proc.wait(timeout=grace)
+        except subprocess.TimeoutExpired:
+            self._signal_group(signal.SIGKILL)
+
+    def terminate(self):
+        self._signal_group(signal.SIGKILL)
+
+    def remove(self):
+        if self._log_path and os.path.exists(self._log_path):
+            try:
+                os.unlink(self._log_path)
+            except OSError:
+                pass
+
+    def logs(self):
+        """Captured output for the LogBroker (stream, bytes) tuples."""
+        if not self._log_path or not os.path.exists(self._log_path):
+            return
+        with open(self._log_path, "rb") as f:
+            for line in f:
+                yield "stdout", line.rstrip(b"\n")
+
+    def close(self):
+        self.terminate()
+
+
+class SubprocessExecutor:
+    """exec.Executor running tasks as host child processes."""
+
+    def __init__(self, state_dir: str | None = None, hostname: str | None = None):
+        self.log_dir = (os.path.join(state_dir, "task-logs")
+                        if state_dir else None)
+        self.hostname = hostname or os.uname().nodename
+
+    def describe(self) -> NodeDescription:
+        return NodeDescription(
+            hostname=self.hostname,
+            platform=_platform(),
+            resources=Resources(
+                nano_cpus=(os.cpu_count() or 1) * 10**9,
+                memory_bytes=_total_memory(),
+            ),
+        )
+
+    def configure(self, node):
+        pass
+
+    def controller(self, task: Task) -> SubprocessController:
+        return SubprocessController(task, self.log_dir)
+
+    def set_network_bootstrap_keys(self, keys):
+        pass

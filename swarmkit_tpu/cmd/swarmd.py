@@ -1,0 +1,110 @@
+"""swarmd: the cluster node daemon (reference swarmd/cmd/swarmd/main.go).
+
+    # first manager (creates the cluster)
+    python -m swarmkit_tpu.cmd.swarmd --state-dir /tmp/m1 \
+        --listen-addr 127.0.0.1:4242
+
+    # additional manager / worker (token decides the role)
+    python -m swarmkit_tpu.cmd.swarmd --state-dir /tmp/m2 \
+        --listen-addr 127.0.0.1:4243 \
+        --join-addr 127.0.0.1:4242 --join-token SWMTKN-1-…
+
+On startup the first manager prints both join tokens. The daemon runs until
+SIGINT/SIGTERM.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="swarmd", description="swarmkit-tpu cluster node daemon")
+    ap.add_argument("--state-dir", required=True,
+                    help="directory for identity, raft WAL, task state")
+    ap.add_argument("--listen-addr", default="127.0.0.1:0",
+                    help="host:port for the RPC listener (managers)")
+    ap.add_argument("--advertise-addr", default=None,
+                    help="externally dialable address (defaults to listen)")
+    ap.add_argument("--join-addr", default=None,
+                    help="comma-separated manager endpoints to join via")
+    ap.add_argument("--join-token", default=None,
+                    help="cluster join token (role is derived from it)")
+    ap.add_argument("--executor", choices=["subprocess", "fake"],
+                    default="subprocess",
+                    help="task executor: real child processes, or a no-op "
+                         "fake for load/testing")
+    ap.add_argument("--hostname", default=None)
+    ap.add_argument("--heartbeat-period", type=float, default=5.0)
+    ap.add_argument("--tick-interval", type=float, default=0.1,
+                    help="raft logical-clock tick (election ~10-20 ticks)")
+    ap.add_argument("--force-new-cluster", action="store_true",
+                    help="disaster recovery: restart as a single-member "
+                         "quorum keeping replicated state")
+    ap.add_argument("--log-level", default="info",
+                    choices=["debug", "info", "warning", "error"])
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper()),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+    if args.executor == "subprocess":
+        from ..agent.subprocexec import SubprocessExecutor
+
+        executor = SubprocessExecutor(args.state_dir, hostname=args.hostname)
+    else:
+        from ..agent.testutils import FakeExecutor
+
+        executor = FakeExecutor({"*": {"run_forever": True}},
+                                hostname=args.hostname or "fake")
+
+    from ..node.daemon import SwarmNode
+
+    node = SwarmNode(
+        state_dir=args.state_dir,
+        executor=executor,
+        listen_addr=args.listen_addr,
+        advertise_addr=args.advertise_addr,
+        join_addr=args.join_addr,
+        join_token=args.join_token,
+        heartbeat_period=args.heartbeat_period,
+        tick_interval=args.tick_interval,
+        force_new_cluster=args.force_new_cluster,
+    )
+    node.start()
+
+    log = logging.getLogger("swarmd")
+    log.info("node %s up (role=%s, addr=%s)", node.node_id,
+             "manager" if node.manager is not None else "worker", node.addr)
+    if node.manager is not None and node.join_addr is None:
+        # freshly bootstrapped cluster: print tokens for joiners
+        cluster = node.store.view(
+            lambda tx: tx.get_cluster(node.manager.cluster_id))
+        if cluster is not None and cluster.root_ca is not None:
+            print(f"SWARM_MANAGER_TOKEN={cluster.root_ca.join_token_manager}",
+                  flush=True)
+            print(f"SWARM_WORKER_TOKEN={cluster.root_ca.join_token_worker}",
+                  flush=True)
+    print(f"SWARM_NODE_READY addr={node.addr or ''} id={node.node_id}",
+          flush=True)
+
+    stop = threading.Event()
+
+    def on_signal(_sig, _frame):
+        stop.set()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    stop.wait()
+    node.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

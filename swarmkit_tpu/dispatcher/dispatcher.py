@@ -91,12 +91,14 @@ class Dispatcher:
         self._status_queue: list[tuple[str, object]] = []  # (task_id, status)
         self._status_cond = threading.Condition()
         self._dirty_nodes: set[str] = set()
+        self._unknown_timers: dict[str, Heartbeat] = {}
 
     # ------------------------------------------------------------- lifecycle
     def start(self):
         # restartable across leadership cycles (manager.go recreates the
         # dispatcher per leadership; in-process, agents hold this object)
         self._stop = threading.Event()
+        self._mark_nodes_unknown()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="dispatcher")
         self._thread.start()
@@ -112,6 +114,91 @@ class Dispatcher:
                 s.heartbeat.stop()
                 s.channel.close()
             self._sessions.clear()
+            timers, self._unknown_timers = self._unknown_timers, {}
+        for t in timers.values():
+            t.stop()
+
+    def _mark_nodes_unknown(self):
+        """dispatcher.go markNodesUnknown:421-483 — a freshly-elected leader
+        inherits node statuses written by the previous dispatcher but none
+        of its sessions. Every READY node is demoted to UNKNOWN (removing it
+        from scheduling candidacy) with a registration grace timer: nodes
+        that re-register flip back READY; those that don't go DOWN, and the
+        orchestrators reschedule their tasks."""
+        try:
+            nodes = self.store.view(lambda tx: tx.find_nodes())
+        except Exception:
+            return
+        candidates = [n.id for n in nodes
+                      if n.status.state == NodeStatusState.READY]
+        if not candidates:
+            return
+        demoted: list[str] = []
+
+        def cb(tx):
+            demoted.clear()
+            # the live-session check runs INSIDE the txn: a register() that
+            # lands between the snapshot above and this write must keep its
+            # READY (the RPC plane serves register as soon as raft elects,
+            # possibly before the dispatcher start reaches here)
+            with self._lock:
+                live = set(self._sessions)
+            for node_id in candidates:
+                if node_id in live:
+                    continue
+                node = tx.get_node(node_id)
+                if node is None or \
+                        node.status.state != NodeStatusState.READY:
+                    continue
+                node = node.copy()
+                node.status.state = NodeStatusState.UNKNOWN
+                node.status.message = \
+                    "manager leadership changed; awaiting re-registration"
+                tx.update(node)
+                demoted.append(node_id)
+
+        try:
+            self.store.update(cb)
+        except Exception:
+            return
+        grace = self.heartbeat_period * GRACE_MULTIPLIER
+        with self._lock:
+            for node_id in demoted:
+                if node_id in self._sessions:
+                    continue  # registered while the proposal committed
+                timer = Heartbeat(
+                    grace, lambda nid=node_id: self._unknown_expired(nid))
+                self._unknown_timers[node_id] = timer
+                timer.start()
+
+    def _unknown_expired(self, node_id: str):
+        """Grace ran out without a register(): the node is gone
+        (dispatcher.go moveTasksToOrphaned precursor — DOWN first)."""
+        with self._lock:
+            self._unknown_timers.pop(node_id, None)
+            alive = node_id in self._sessions
+
+        def cb(tx):
+            node = tx.get_node(node_id)
+            if node is None or \
+                    node.status.state != NodeStatusState.UNKNOWN:
+                return
+            node = node.copy()
+            if alive:
+                # registered while the grace ran but after the UNKNOWN write
+                # landed: restore candidacy
+                node.status.state = NodeStatusState.READY
+                node.status.message = ""
+            else:
+                node.status.state = NodeStatusState.DOWN
+                node.status.message = \
+                    "did not re-register after leadership change"
+            tx.update(node)
+
+        try:
+            self.store.update(cb)
+        except Exception:
+            pass
 
     # ------------------------------------------------------------------- rpc
     def register(self, node_id: str, description=None) -> str:
@@ -152,6 +239,9 @@ class Dispatcher:
                 old.channel.close()
             self._sessions[node_id] = session
             self._dirty_nodes.add(node_id)
+            pending = self._unknown_timers.pop(node_id, None)
+        if pending is not None:
+            pending.stop()  # re-registered within the leadership grace
         hb.start()
         return session_id
 

@@ -11,6 +11,8 @@ Without a raft node the manager runs standalone and is always the leader
 """
 from __future__ import annotations
 
+import logging
+import queue
 import threading
 
 from ..allocator.allocator import Allocator
@@ -34,6 +36,8 @@ from .health import NOT_SERVING, SERVING, HealthServer
 from .keymanager import KeyManager
 from .metrics import MetricsCollector
 from .rolemanager import RoleManager
+
+log = logging.getLogger("swarmkit_tpu.manager")
 
 DEFAULT_CLUSTER_NAME = "default"
 INGRESS_NETWORK_NAME = "ingress"
@@ -94,6 +98,14 @@ class Manager:
         self.key_rotation_interval = key_rotation_interval
         self.csi_plugins = csi_plugins
 
+        # Raft-driven transitions are applied by a dedicated thread: the
+        # raft worker invokes on_leadership synchronously, and becoming
+        # leader writes to the store, which *proposes through that same raft
+        # worker* — applying inline would deadlock (manager.go runs
+        # handleLeadershipEvents on its own goroutine for the same reason).
+        self._leadership_q: queue.Queue = queue.Queue()
+        self._leadership_thread: threading.Thread | None = None
+
         if self.raft is not None:
             self.raft.on_leadership = self._on_leadership
 
@@ -130,16 +142,27 @@ class Manager:
         self.health.set_serving_status("manager", SERVING)
         if self.raft is None:
             self._on_leadership(True)
-        elif pending is not None:
+            return
+        self._leadership_thread = threading.Thread(
+            target=self._leadership_loop, daemon=True,
+            name="manager-leadership")
+        self._leadership_thread.start()
+        if pending is not None:
             self._on_leadership(pending)
         elif getattr(self.raft, "role", None) == "leader":
             self._on_leadership(True)
 
     def stop(self):
         self.health.set_serving_status("manager", NOT_SERVING)
-        self._on_leadership(False)
         with self._lock:
+            # flip _started first: a raft leadership callback racing this
+            # stop must defer (pending), never apply inline on its thread
             self._started = False
+            thread, self._leadership_thread = self._leadership_thread, None
+        if thread is not None:
+            self._leadership_q.put(None)  # sentinel: drain thread exits
+            thread.join(timeout=10)
+        self._apply_leadership(False)
 
     @property
     def is_leader(self) -> bool:
@@ -155,20 +178,58 @@ class Manager:
     # -- leadership --------------------------------------------------------
 
     def _on_leadership(self, is_leader: bool):
+        """Leadership signal entry point. With raft, the transition is
+        queued and applied off the caller's thread (the raft worker must
+        never block on a store proposal it itself serves); without raft it
+        applies synchronously."""
         with self._lock:
             if not self._started:
                 self._pending_leadership = is_leader
                 return
+            deferred = self._leadership_thread is not None
+        if deferred:
+            self._leadership_q.put(is_leader)
+        else:
+            self._apply_leadership(is_leader)
+
+    def _leadership_loop(self):
+        while True:
+            item = self._leadership_q.get()
+            if item is None:
+                return
+            # collapse bursts: only the latest state matters
+            while True:
+                try:
+                    nxt = self._leadership_q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._apply_leadership(item)
+                    return
+                item = nxt
+            self._apply_leadership(item)
+
+    def _apply_leadership(self, is_leader: bool):
+        with self._lock:
             if is_leader == self._is_leader:
                 return
             self._is_leader = is_leader
         if is_leader:
-            self._become_leader()
+            try:
+                self._become_leader()
+            except Exception:
+                # seeding raced a leadership loss (propose failed): revert so
+                # the next leadership event can retry cleanly
+                log.exception("become_leader failed; reverting to follower")
+                with self._lock:
+                    self._is_leader = False
+                self._become_follower()
         else:
             self._become_follower()
 
     def _become_leader(self):
         """manager.go becomeLeader:926-1146."""
+        self._refresh_root()
         self._seed_cluster_objects()
 
         components = [
@@ -193,11 +254,32 @@ class Manager:
             from ..csi.manager import VolumeManager
 
             components.append(VolumeManager(self.store, self.csi_plugins))
+        # register each component as soon as it starts so a mid-list failure
+        # tears down exactly what came up (the revert path in
+        # _apply_leadership stops _leader_components)
+        with self._lock:
+            self._leader_components = []
         for c in components:
             c.start()
-        with self._lock:
-            self._leader_components = components
+            with self._lock:
+                self._leader_components.append(c)
         self.health.set_serving_status("leader", SERVING)
+
+    def _refresh_root(self):
+        """Adopt the cluster's replicated signing root before acting as CA.
+
+        A manager that joined over raft constructs its CAServer before the
+        replicated state catches up (the store is empty at __init__), so the
+        construction-time fallback root may be a freshly-minted one nobody
+        trusts. By leadership time the store holds the real cluster CA —
+        prefer it whenever it differs from what the CAServer ended up with
+        (the reference distributes root key material via the Cluster object;
+        signing under anything else is a split-brain CA)."""
+        stored = self._load_root_from_store()
+        if stored is not None and (
+                not self.ca_server.root.can_sign
+                or stored.digest() != self.ca_server.root.digest()):
+            self.ca_server.root = stored
 
     def _become_follower(self):
         """manager.go becomeFollower — tear down leader-only components."""

@@ -1,0 +1,575 @@
+"""SwarmNode: the networked daemon assembly — one OS process per node.
+
+This is the process the reference calls swarmd (swarmd/cmd/swarmd/main.go +
+node/node.go): bootstrap a TLS identity (local state dir, or a digest-pinned
+CSR against a remote manager using a join token), then run the role's stack
+over real TCP:
+
+  manager:  RPC server (all planes on one mTLS listener, manager.go:441-641)
+            + raft node on the network transport (joins the quorum via the
+            RaftMembership.Join RPC, raft.go:926) + replicated store +
+            Manager component lifecycle + an agent (managers run workloads
+            too, node/node.go runAgent:576) + cert renewal.
+  worker:   agent with a RemoteDispatcher session against the managers +
+            cert renewal; periodically refreshes the manager list
+            (the Session message manager-list plane).
+
+State dir layout (node/node.go:1202-1286 + manager/deks.go):
+    state.json   node id, raft id, advertise addr
+    cert.pem / ca.pem / key.json     TLS identity (KEK-sealable)
+    raft/        encrypted WAL + snapshots (DEK in key.json headers)
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import random
+import ssl
+import threading
+import time
+
+from ..agent.agent import Agent
+from ..api.types import IssuanceState, NodeRole, NodeStatusState
+from ..ca import (
+    KeyReadWriter,
+    RootCA,
+    SecurityConfig,
+    TLSRenewer,
+    create_csr,
+    parse_join_token,
+)
+from ..manager.manager import Manager
+from ..raft.node import Peer, RaftNode
+from ..raft.proposer import RaftProposer
+from ..raft.storage import RaftStorage, new_dek
+from ..raft.transport import NetworkTransport
+from ..rpc.client import RPCClient
+from ..rpc.server import RPCServer, ServiceRegistry
+from ..rpc.services import (
+    LeaderConns,
+    RemoteCA,
+    RemoteDispatcher,
+    RemoteLogBroker,
+    build_manager_registry,
+)
+from ..rpc.wire import connect_tls, parse_addr
+from ..store.memory import MemoryStore
+from ..utils.identity import new_id
+
+log = logging.getLogger("swarmkit_tpu.daemon")
+
+STATE_FILE = "state.json"
+CERT_FILE = "cert.pem"
+CA_FILE = "ca.pem"
+KEY_FILE = "key.json"
+DEK_HEADER = "raft-dek"
+
+JOIN_RETRY = 0.5
+JOIN_TIMEOUT = 30.0
+ANNOUNCE_RETRY = 0.5
+
+
+class NodeError(Exception):
+    pass
+
+
+def fetch_root_cert(addr: str, expected_digest: str,
+                    timeout: float = 10.0) -> bytes:
+    """Download the cluster root CA over an *unauthenticated* TLS connection
+    and verify it against the digest pinned in the join token — the trust
+    bootstrap of ca/certificates.go GetRemoteCA (connection is untrusted;
+    the token's sha256 pin is the root of trust)."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE  # trust comes from the digest pin below
+    sock = connect_tls(addr, ctx, timeout=timeout)
+    try:
+        from ..rpc.wire import REQ, RESP, recv_frame, send_frame
+
+        lock = threading.Lock()
+        send_frame(sock, lock,
+                   [REQ, 1, "ca.get_root_ca_certificate", ((), {})])
+        ftype, _sid, head, payload = recv_frame(sock)
+        if ftype != RESP:
+            raise NodeError(f"root CA fetch failed: {head}: {payload}")
+    finally:
+        sock.close()
+    cert_pem = payload
+    got = hashlib.sha256(cert_pem).hexdigest()
+    if got != expected_digest:
+        raise NodeError(
+            f"remote root CA digest {got[:16]}… does not match the join "
+            f"token pin {expected_digest[:16]}… — refusing to join")
+    return cert_pem
+
+
+class _Ticker(threading.Thread):
+    """Drives the raft logical clock in real time (the reference's
+    clock.NewClock ticker, raft.go:540 tick arm)."""
+
+    def __init__(self, raft: RaftNode, interval: float):
+        super().__init__(daemon=True, name=f"raft-tick-{raft.id}")
+        self.raft = raft
+        self.interval = interval
+        self._stop = threading.Event()
+
+    def run(self):
+        while not self._stop.wait(self.interval):
+            self.raft.tick()
+
+    def stop(self):
+        self._stop.set()
+
+
+class SwarmNode:
+    """One daemon process: identity + role stack over the network."""
+
+    def __init__(
+        self,
+        state_dir: str,
+        executor,
+        listen_addr: str = "127.0.0.1:0",
+        advertise_addr: str | None = None,
+        join_addr: str | None = None,
+        join_token: str | None = None,
+        org: str = "swarmkit-tpu",
+        kek: bytes | None = None,
+        heartbeat_period: float = 5.0,
+        tick_interval: float = 0.1,
+        election_tick: int = 10,
+        manager_refresh_interval: float = 5.0,
+        force_new_cluster: bool = False,
+    ):
+        self.state_dir = state_dir
+        self.executor = executor
+        self.listen_addr = listen_addr
+        self.advertise_addr = advertise_addr
+        self.join_addr = join_addr
+        self.join_token = join_token
+        self.org = org
+        self.kek = kek
+        self.heartbeat_period = heartbeat_period
+        self.tick_interval = tick_interval
+        self.election_tick = election_tick
+        self.manager_refresh_interval = manager_refresh_interval
+        self.force_new_cluster = force_new_cluster
+
+        self.security: SecurityConfig | None = None
+        self.manager: Manager | None = None
+        self.raft: RaftNode | None = None
+        self.store: MemoryStore | None = None
+        self.server: RPCServer | None = None
+        self.agent: Agent | None = None
+        self.renewer: TLSRenewer | None = None
+        self.raft_id: int | None = None
+
+        self._transport: NetworkTransport | None = None
+        self._ticker: _Ticker | None = None
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._dispatcher_shim: RemoteDispatcher | None = None
+
+    # ------------------------------------------------------------- identity
+
+    def _paths(self):
+        return (os.path.join(self.state_dir, STATE_FILE),
+                os.path.join(self.state_dir, CERT_FILE),
+                os.path.join(self.state_dir, CA_FILE),
+                os.path.join(self.state_dir, KEY_FILE))
+
+    def _load_state(self) -> dict:
+        state_path = self._paths()[0]
+        if not os.path.exists(state_path):
+            return {}
+        with open(state_path) as f:
+            return json.load(f)
+
+    def _save_state(self, **updates):
+        state_path = self._paths()[0]
+        os.makedirs(self.state_dir, exist_ok=True)
+        state = self._load_state()
+        state.update(updates)
+        tmp = state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, state_path)
+
+    def _save_identity(self):
+        _state, cert_path, ca_path, key_path = self._paths()
+        os.makedirs(self.state_dir, exist_ok=True)
+        key_pem, cert_pem = self.security.key_and_cert()
+        KeyReadWriter(key_path, self.kek).write(key_pem)
+        with open(cert_path, "wb") as f:
+            f.write(cert_pem)
+        with open(ca_path, "wb") as f:
+            f.write(self.security.root_ca.cert_pem)
+        self._save_state(node_id=self.security.node_id())
+
+    def _load_identity(self) -> SecurityConfig | None:
+        _state, cert_path, ca_path, key_path = self._paths()
+        if not (os.path.exists(cert_path) and os.path.exists(key_path)):
+            return None
+        with open(ca_path, "rb") as f:
+            root = RootCA(f.read())
+        with open(cert_path, "rb") as f:
+            cert_pem = f.read()
+        key_pem, _headers = KeyReadWriter(key_path, self.kek).read()
+        return SecurityConfig(root, key_pem, cert_pem)
+
+    def _obtain_identity(self) -> SecurityConfig:
+        loaded = self._load_identity()
+        if loaded is not None:
+            return loaded
+        if self.join_addr is None:
+            # first node of a new cluster: self-signed root, manager cert
+            return SecurityConfig.bootstrap_manager(org=self.org)
+        if not self.join_token:
+            raise NodeError("joining an existing cluster requires a join token")
+        parsed = parse_join_token(self.join_token)
+        seed = self.join_addr.split(",")[0].strip()
+        root_pem = fetch_root_cert(seed, parsed.root_digest)
+        node_id = new_id()
+        key_pem, csr_pem = create_csr(node_id, NodeRole.WORKER, self.org)
+        ca = RemoteCA(seed, root_cert_pem=root_pem)
+        try:
+            node_id = ca.issue_node_certificate(
+                csr_pem, token=self.join_token, node_id=node_id)
+            cert = ca.node_certificate_status(node_id, timeout=30)
+        finally:
+            ca.close()
+        if cert is None or cert.status_state != IssuanceState.ISSUED:
+            raise NodeError("certificate issuance failed: "
+                            f"{getattr(cert, 'status_err', 'timeout')}")
+        return SecurityConfig(RootCA(root_pem), key_pem, cert.certificate_pem)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self):
+        self.security = self._obtain_identity()
+        self._save_identity()
+        if self.security.role() == NodeRole.MANAGER:
+            self._start_manager()
+        else:
+            self._start_worker()
+
+    def stop(self):
+        self._stop.set()
+        if self.renewer is not None:
+            self.renewer.stop()
+        if self.agent is not None:
+            self.agent.stop()
+        if self._dispatcher_shim is not None:
+            self._dispatcher_shim.close()
+        if self.manager is not None:
+            self.manager.stop()
+        if self._ticker is not None:
+            self._ticker.stop()
+        if self.raft is not None:
+            self.raft.stop()
+        if self._transport is not None:
+            self._transport.stop()
+        if self.server is not None:
+            self.server.stop()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    @property
+    def node_id(self) -> str:
+        return self.security.node_id() if self.security else ""
+
+    @property
+    def addr(self) -> str | None:
+        return self.server.addr if self.server is not None else None
+
+    @property
+    def is_leader(self) -> bool:
+        return self.raft is not None and self.raft.is_leader
+
+    # ------------------------------------------------------- manager stack
+
+    def _dek(self) -> bytes:
+        """Raft at-rest DEK, persisted in the TLS key file's headers
+        (manager/deks.go keeps DEKs in PEM headers of the node key)."""
+        krw = KeyReadWriter(self._paths()[3], self.kek)
+        key_pem, headers = krw.read()
+        dek_hex = (headers or {}).get(DEK_HEADER)
+        if dek_hex:
+            return dek_hex.encode()
+        dek = new_dek()
+        headers = dict(headers or {})
+        headers[DEK_HEADER] = dek.decode()
+        krw.write(key_pem, headers)
+        return dek
+
+    def _start_manager(self):
+        node_id = self.security.node_id()
+        persisted = self._load_state()
+        prev_advertise = persisted.get("advertise")
+
+        listen = self.listen_addr
+        if self.advertise_addr is None and prev_advertise \
+                and listen.endswith(":0"):
+            # restart with an ephemeral listen port: rebind the previous
+            # port so the quorum's recorded dial address stays valid
+            host = listen.rsplit(":", 1)[0]
+            prev_port = prev_advertise.rsplit(":", 1)[1]
+            try_server = RPCServer(f"{host}:{prev_port}", self.security,
+                                   ServiceRegistry())
+            try:
+                try_server.bind()
+                self.server = try_server
+                listen = try_server.addr
+            except OSError:
+                self.server = None  # port taken; fall through to a new one
+
+        registry = ServiceRegistry()
+        if self.server is not None:
+            self.server.registry = registry
+        else:
+            self.server = RPCServer(listen, self.security, registry)
+        advertise = self.advertise_addr or self.server.bind()
+        # normalize a 0.0.0.0 bind into a dialable advertise address
+        host, port = parse_addr(advertise)
+        if host in ("0.0.0.0", "::"):
+            advertise = f"127.0.0.1:{port}"
+        self.advertise_addr = advertise
+
+        storage = RaftStorage(os.path.join(self.state_dir, "raft"),
+                              dek=self._dek())
+        raft_id = persisted.get("raft_id")
+        fresh = raft_id is None
+
+        members: list[tuple[int, str, str]] = []
+        if fresh:
+            if self.join_addr is None:
+                raft_id = 1
+            else:
+                raft_id, members = self._join_raft(node_id, advertise)
+        self.raft_id = raft_id
+        self._save_state(raft_id=raft_id, advertise=advertise)
+
+        transport = NetworkTransport(self.security, local_raft_id=raft_id)
+        raft = RaftNode(
+            raft_id=raft_id,
+            transport=transport,
+            storage=storage,
+            election_tick=self.election_tick,
+            rng=random.Random(),
+            auto_recover=False,
+        )
+        transport.set_node(raft)
+        self._transport = transport
+        self.raft = raft
+
+        proposer = RaftProposer(raft)
+        self.store = MemoryStore(proposer=proposer)
+        proposer.attach_store(self.store)  # replays WAL/snapshot if any
+
+        if fresh:
+            if self.join_addr is None:
+                raft.bootstrap([Peer(1, node_id, advertise)])
+            else:
+                peers = [Peer(rid, nid, addr) for rid, nid, addr in members]
+                if raft_id not in {p.raft_id for p in peers}:
+                    peers.append(Peer(raft_id, node_id, advertise))
+                raft.bootstrap(peers)
+        elif self.force_new_cluster:
+            # disaster recovery (raft.go ForceNewCluster): collapse the
+            # membership to this node alone, keeping the replicated state
+            raft.members = {raft_id: Peer(raft_id, node_id, advertise)}
+            storage.save_membership(raft.members)
+        elif prev_advertise and prev_advertise != advertise:
+            # restarted on a different address than the quorum recorded:
+            # re-join through any member so the leader replicates the new
+            # dial address (raft_join proposes an idempotent add)
+            peer_addrs = [p.addr for p in raft.members.values()
+                          if p.raft_id != raft_id and p.addr
+                          and not p.addr.startswith("mem://")]
+            if peer_addrs:
+                t = threading.Thread(
+                    target=self._repair_addr_loop,
+                    args=(node_id, advertise, peer_addrs),
+                    daemon=True, name="raft-addr-repair")
+                t.start()
+                self._threads.append(t)
+
+        self.manager = Manager(
+            store=self.store,
+            security=self.security,
+            raft_node=raft,
+            org=self.org,
+            heartbeat_period=self.heartbeat_period,
+        )
+        build_manager_registry(self.manager, raft,
+                               LeaderConns(raft, self.security),
+                               registry=registry)
+
+        self.server.start()
+        raft.start()
+        self._ticker = _Ticker(raft, self.tick_interval)
+        self._ticker.start()
+        self.manager.start()
+
+        if fresh and self.join_addr is None:
+            raft.campaign()  # single node: elect immediately, don't wait out
+            self._register_self_node(leader=True)
+
+        # every manager announces its reachable endpoint (leader-forwarded)
+        t = threading.Thread(target=self._announce_loop, daemon=True,
+                             name=f"announce-{node_id[:8]}")
+        t.start()
+        self._threads.append(t)
+
+        # managers also run an agent against the cluster (runAgent:576);
+        # its session follows the leader via the local endpoint
+        self._start_agent(advertise)
+        self.renewer = TLSRenewer(
+            self.security, RemoteCA(advertise, security=self.security))
+        self.renewer.start()
+
+    def _join_raft(self, node_id: str,
+                   advertise: str) -> tuple[int, list]:
+        """RaftMembership.Join against any live manager (leader-forwarded),
+        retried until the quorum admits us (raft.go JoinAndStart:375)."""
+        deadline = time.monotonic() + JOIN_TIMEOUT
+        last: Exception | None = None
+        seeds = [a.strip() for a in self.join_addr.split(",") if a.strip()]
+        while time.monotonic() < deadline:
+            for seed in seeds:
+                try:
+                    client = RPCClient(seed, security=self.security)
+                except OSError as exc:
+                    last = exc
+                    continue
+                try:
+                    raft_id, members = client.call(
+                        "raft.join", node_id, advertise, timeout=15.0)
+                    return raft_id, members
+                except Exception as exc:  # NotLeaderError, timeouts, …
+                    last = exc
+                finally:
+                    client.close()
+            if self._stop.wait(JOIN_RETRY):
+                break
+        raise NodeError(f"could not join the raft quorum: {last}")
+
+    def _repair_addr_loop(self, node_id: str, advertise: str,
+                          peer_addrs: list[str]):
+        """Tell the quorum this member's address changed (restart on a new
+        ephemeral port): raft.join with the same node_id replicates the
+        repair; retried until a leader accepts it."""
+        while not self._stop.is_set():
+            for addr in peer_addrs:
+                try:
+                    client = RPCClient(addr, security=self.security)
+                except OSError:
+                    continue
+                try:
+                    client.call("raft.join", node_id, advertise, timeout=15.0)
+                    return
+                except Exception:
+                    pass
+                finally:
+                    client.close()
+            if self._stop.wait(JOIN_RETRY * 2):
+                return
+
+    def _register_self_node(self, leader: bool = False):
+        """Create this manager's own Node object in the replicated state
+        (the reference seeds it in becomeLeader / on join via the CA)."""
+        from ..api.objects import ManagerStatus, Node as NodeObj, NodeCertificate
+        from ..api.specs import NodeSpec
+
+        node_id = self.security.node_id()
+        cert_pem = self.security.key_and_cert()[1]
+
+        def txn(tx):
+            if tx.get_node(node_id) is None:
+                n = NodeObj(
+                    id=node_id,
+                    spec=NodeSpec(desired_role=NodeRole.MANAGER),
+                    role=NodeRole.MANAGER,
+                )
+                n.status.state = NodeStatusState.READY
+                n.manager_status = ManagerStatus(
+                    raft_id=self.raft_id or 0, addr=self.advertise_addr or "",
+                    leader=leader, reachability="reachable")
+                n.certificate = NodeCertificate(
+                    role=NodeRole.MANAGER,
+                    status_state=IssuanceState.ISSUED,
+                    certificate_pem=cert_pem,
+                    cn=node_id,
+                )
+                tx.create(n)
+
+        self.store.update(txn)
+
+    def _announce_loop(self):
+        """Publish this manager's endpoint onto its Node object, retrying
+        through leadership churn; re-announce on every leadership change so
+        a recovered cluster re-learns addresses."""
+        node_id = self.security.node_id()
+        announced = False
+        while not self._stop.is_set():
+            if not announced:
+                try:
+                    client = RPCClient(self.advertise_addr,
+                                       security=self.security)
+                    try:
+                        client.call("cluster.announce_manager", node_id,
+                                    self.advertise_addr, self.raft_id,
+                                    timeout=10.0)
+                        announced = True
+                    finally:
+                        client.close()
+                except Exception:
+                    pass
+            if self._stop.wait(ANNOUNCE_RETRY if not announced else
+                               self.manager_refresh_interval):
+                return
+
+    # -------------------------------------------------------- worker stack
+
+    def _start_worker(self):
+        if self.join_addr is None:
+            raise NodeError("a worker node needs a join address")
+        self._start_agent(self.join_addr)
+        # renewal follows the live manager list, not just the join seed
+        # (the original endpoint may die long before the cert expires)
+        self.renewer = TLSRenewer(
+            self.security,
+            RemoteCA(self.join_addr, security=self.security,
+                     seeds_fn=lambda: list(self._dispatcher_shim.seeds)))
+        self.renewer.start()
+
+    def _start_agent(self, addr: str):
+        dispatcher = RemoteDispatcher(addr, self.security)
+        self._dispatcher_shim = dispatcher
+        self.agent = Agent(
+            self.security.node_id(),
+            dispatcher,
+            self.executor,
+            state_path=os.path.join(self.state_dir, "worker.json"),
+            log_broker=RemoteLogBroker(addr.split(",")[0].strip(),
+                                       self.security),
+        )
+        self.agent.start()
+        t = threading.Thread(target=self._refresh_managers_loop,
+                             args=(dispatcher,), daemon=True,
+                             name="manager-refresh")
+        t.start()
+        self._threads.append(t)
+
+    def _refresh_managers_loop(self, dispatcher: RemoteDispatcher):
+        """Keep the agent's manager seed list fresh (the Session message's
+        manager list, dispatcher.go:1359+), so sessions survive the death of
+        the original join endpoint."""
+        while not self._stop.wait(self.manager_refresh_interval):
+            try:
+                managers = dispatcher._conn().call("cluster.managers",
+                                                   timeout=5.0)
+            except Exception:
+                continue
+            dispatcher.update_managers([addr for _nid, addr in managers])

@@ -50,12 +50,20 @@ class EventLoopComponent:
     def _run(self):
         snapshot, ch = self.store.view_and_watch(self.setup, limit=None)
         try:
-            self.on_start(snapshot)
+            try:
+                self.on_start(snapshot)
+            except Exception:
+                # initial reconcile may propose during leadership churn; the
+                # event loop must still come up — events re-drive the state
+                log.exception("%s: initial reconcile failed", self.name)
             while not self._stop.is_set():
                 try:
                     ev = ch.get(timeout=0.2)
                 except TimeoutError:
-                    self.idle()
+                    try:
+                        self.idle()
+                    except Exception:
+                        log.exception("%s: idle pass failed", self.name)
                     continue
                 except ChannelClosed:
                     return

@@ -56,6 +56,13 @@ class GlobalOrchestrator(EventLoopComponent):
         return [s for s in tx.find_services() if is_global(s)]
 
     def on_start(self, services):
+        # taskinit/init.go CheckTasks — see ReplicatedOrchestrator.on_start
+        from .taskinit import check_tasks
+
+        try:
+            check_tasks(self.store, self.restart, is_global)
+        except Exception:
+            pass
         for s in services:
             self.reconcile_service(s.id)
 

@@ -10,6 +10,7 @@ here, shared with the global orchestrator via OrchestratorBase.
 """
 from __future__ import annotations
 
+import logging
 from collections import defaultdict
 
 from ..api.objects import (
@@ -35,6 +36,8 @@ from .task import (
 )
 from .updater import UpdateSupervisor
 
+log = logging.getLogger("swarmkit_tpu.orchestrator")
+
 
 class ReplicatedOrchestrator(EventLoopComponent):
     name = "replicated-orchestrator"
@@ -54,6 +57,16 @@ class ReplicatedOrchestrator(EventLoopComponent):
         return [s for s in tx.find_services() if is_replicated(s)]
 
     def on_start(self, services):
+        # startup fix-up first (taskinit/init.go CheckTasks): a fresh leader
+        # inherits tasks stranded mid-lifecycle — dead-but-unreplaced, in
+        # flight on nodes that went down unwatched, or parked in restart
+        # -delay limbo whose promote timer died with the old leader
+        from .taskinit import check_tasks
+
+        try:
+            check_tasks(self.store, self.restart, is_replicated)
+        except Exception:
+            log.exception("%s: startup task fix-up failed", self.name)
         for s in services:
             self.reconcile(s.id)
 

@@ -111,6 +111,13 @@ class RestartSupervisor:
         if service.spec.task.restart.window > 0:
             info.restarted_instances.append(RestartedInstance(time.time()))
 
+    def resume_delay(self, task: Task, service: Service) -> None:
+        """Re-arm the READY→RUNNING promote timer for a task found in
+        delayed-start limbo at startup (the timer is in-memory state that
+        dies with its leader; taskinit re-creates it on the successor)."""
+        delay = service.spec.task.restart.delay if service is not None else 0.0
+        self._delay_start(task.id, delay)
+
     def _delay_start(self, task_id: str, delay: float,
                      target: TaskState = TaskState.RUNNING) -> None:
         """Promote READY→target after the restart delay."""

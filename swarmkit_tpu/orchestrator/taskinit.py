@@ -39,11 +39,20 @@ def check_tasks(store: MemoryStore, restart: RestartSupervisor,
                 continue
             dead = t.status.state > TaskState.RUNNING
             stranded = (
-                TaskState.ASSIGNED <= t.status.state < TaskState.RUNNING
-                and t.node_id
+                t.node_id != ""
+                and t.status.state >= TaskState.ASSIGNED
                 and node_down.get(t.node_id, True))
             if dead or stranded:
+                # node-down wins over delay-limbo: re-arming a promote
+                # timer for a task on a dead node would strand it forever
                 restart.restart(tx, None, service, t)
+                fixed += 1
+            elif t.desired_state == TaskState.READY \
+                    and t.status.state <= TaskState.READY:
+                # restart-delay limbo: the promote timer lived on the
+                # previous leader and died with it — re-arm the delayed
+                # start (taskinit/init.go:174 restartSupervisor.DelayStart)
+                restart.resume_delay(t, service)
                 fixed += 1
 
     store.update(cb)

@@ -117,6 +117,17 @@ class RaftNode:
         self._stopped = threading.Event()
         self._thread: threading.Thread | None = None
 
+        # Signalled leadership (reference raft.go signalledLeadership +
+        # :644-670 ordering): election alone does not make a usable leader —
+        # the new term's no-op barrier entry must commit AND every earlier
+        # -term entry must be applied first. Only then is leadership
+        # announced and proposals accepted. Without this, leader-side
+        # components start writing (taking the store update lock) while this
+        # worker thread still needs that lock to apply the previous
+        # leader's tail entries — a deadlock until the propose timeout.
+        self._signalled = False
+        self._barrier_index = 0
+
         self._recovered = False
         if auto_recover:
             self.recover()
@@ -321,15 +332,19 @@ class RaftNode:
         self.next_index = {p: last + 1 for p in self.members if p != self.id}
         self.match_index = {p: 0 for p in self.members if p != self.id}
         # commit a no-op entry from the new term so earlier-term entries can
-        # commit (raft §5.4.2 safety rule)
+        # commit (raft §5.4.2 safety rule); leadership is signalled only
+        # once this barrier applies (_apply_committed)
+        self._signalled = False
+        self._barrier_index = last + 1
         self._append_local(Entry(term=self.term, index=last + 1,
                                  kind=ENTRY_NORMAL, data=None))
         self._broadcast_append()
         self._maybe_advance_commit()
-        self._notify_leadership(True)
 
     def _become_follower(self, term: int, leader_id: int | None):
         was_leader = self.role == LEADER
+        was_signalled = self._signalled
+        self._signalled = False
         if term > self.term:
             self.term = term
             self.voted_for = None
@@ -340,7 +355,8 @@ class RaftNode:
         self._randomized_timeout = self._next_timeout()
         if was_leader:
             self._drop_waits("leadership lost")
-            self._notify_leadership(False)
+            if was_signalled:
+                self._notify_leadership(False)
 
     def _notify_leadership(self, is_leader: bool):
         try:
@@ -468,7 +484,11 @@ class RaftNode:
 
     # ------------------------------------------------------------- proposing
     def _on_propose(self, data, request_id, callback):
-        if self.role != LEADER:
+        if self.role != LEADER or not self._signalled:
+            # an unsignalled leader has unapplied earlier-term entries;
+            # accepting a proposal now deadlocks the applier against the
+            # proposer's store lock (raft.go processInternalRaftRequest
+            # fails on !signalledLeadership for the same reason)
             callback(False, f"not leader; leader is {self.leader_id}")
             return
         self._waits[request_id] = callback
@@ -479,7 +499,7 @@ class RaftNode:
         self._maybe_advance_commit()  # single-node commits immediately
 
     def _on_conf_change(self, cc: ConfChange, request_id, callback):
-        if self.role != LEADER:
+        if self.role != LEADER or not self._signalled:
             callback(False, f"not leader; leader is {self.leader_id}")
             return
         if cc.action == "remove" and not self._can_remove(cc.raft_id):
@@ -591,6 +611,12 @@ class RaftNode:
                     cb(True, "")
                 except Exception:
                     log.exception("raft-%d: wait callback failed", self.id)
+        if self.role == LEADER and not self._signalled \
+                and self.last_applied >= self._barrier_index:
+            # the new-term barrier (and everything before it) is applied:
+            # leadership is now usable (raft.go:644-670 ordering)
+            self._signalled = True
+            self._notify_leadership(True)
         self._maybe_snapshot()
 
     def _apply_conf_change(self, e: Entry):
@@ -691,7 +717,9 @@ class RaftNode:
     # ------------------------------------------------------------- introspect
     @property
     def is_leader(self) -> bool:
-        return self.role == LEADER
+        """Usable leadership: elected AND the new-term barrier has applied
+        (proposals before that point are rejected)."""
+        return self.role == LEADER and self._signalled
 
     def status(self) -> dict:
         return {

@@ -92,7 +92,14 @@ class RPCServer:
             self._ctx = ctx
 
     # -- lifecycle ---------------------------------------------------------
-    def start(self):
+    def bind(self) -> str:
+        """Bind the listening socket without serving yet; returns the actual
+        host:port. Lets the assembly learn its advertise address (port 0 →
+        kernel-assigned) before the raft node / registry that reference it
+        are constructed; accepted connections queue in the backlog until
+        start()."""
+        if self._sock is not None:
+            return self.addr
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         sock.bind(self._bind)
@@ -100,8 +107,12 @@ class RPCServer:
         self._sock = sock
         host, port = sock.getsockname()[:2]
         self.addr = f"{host}:{port}"
+        return self.addr
+
+    def start(self):
+        self.bind()
         t = threading.Thread(target=self._accept_loop, daemon=True,
-                             name=f"rpc-accept-{port}")
+                             name=f"rpc-accept-{self.addr}")
         t.start()
         self._threads.append(t)
 

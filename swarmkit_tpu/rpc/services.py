@@ -90,9 +90,14 @@ def _strip_forward(caller: Caller | None) -> Caller | None:
 
 def build_manager_registry(manager, raft_node=None,
                            leader_conns: LeaderConns | None = None,
+                           registry: ServiceRegistry | None = None,
                            ) -> ServiceRegistry:
-    """Declare every plane on one registry (manager.go Run:441-641)."""
-    reg = ServiceRegistry()
+    """Declare every plane on one registry (manager.go Run:441-641).
+
+    Pass `registry` to fill a pre-existing (already-served) registry — the
+    daemon binds its listener before the manager objects exist so the raft
+    advertise address is known first."""
+    reg = registry if registry is not None else ServiceRegistry()
     is_leader = (lambda: True) if raft_node is None else \
         (lambda: raft_node.is_leader)
 
@@ -133,26 +138,37 @@ def build_manager_registry(manager, raft_node=None,
 
             if not raft_node.is_leader:
                 raise NotLeaderError("join must be served by the leader")
+
+            def propose(cc):
+                done = threading.Event()
+                outcome = {}
+
+                def cb(ok, err=""):
+                    outcome["ok"] = ok
+                    outcome["err"] = err
+                    done.set()
+
+                raft_node.propose_conf_change(cc, new_id(), cb)
+                if not done.wait(10) or not outcome.get("ok"):
+                    raise NotLeaderError(
+                        f"join failed: {outcome.get('err', 'timeout')}")
+
             existing = raft_node.member_by_node_id(node_id)
             if existing is not None:
                 if existing.addr != addr:
-                    raft_node.transport.update_peer_addr(existing.raft_id, addr)
+                    # a member came back on a new address (restart with an
+                    # ephemeral port): replicate the repair so EVERY member
+                    # re-learns the dial address, not just this leader
+                    # (transport.go UpdatePeerAddr + ResolveAddress)
+                    raft_node.transport.update_peer_addr(existing.raft_id,
+                                                         addr)
+                    propose(ConfChange(action="add",
+                                       raft_id=existing.raft_id,
+                                       node_id=node_id, addr=addr))
                 return (existing.raft_id, _member_list(raft_node))
             raft_id = max(raft_node.members, default=0) + 1
-            done = threading.Event()
-            outcome = {}
-
-            def cb(ok, err=""):
-                outcome["ok"] = ok
-                outcome["err"] = err
-                done.set()
-
-            raft_node.propose_conf_change(
-                ConfChange(action="add", raft_id=raft_id, node_id=node_id,
-                           addr=addr), new_id(), cb)
-            if not done.wait(10) or not outcome.get("ok"):
-                raise NotLeaderError(
-                    f"join failed: {outcome.get('err', 'timeout')}")
+            propose(ConfChange(action="add", raft_id=raft_id,
+                               node_id=node_id, addr=addr))
             return (raft_id, _member_list(raft_node))
 
         def raft_leave(caller, node_id):
@@ -164,8 +180,59 @@ def build_manager_registry(manager, raft_node=None,
 
         reg.add("raft.step", raft_step, roles=[MANAGER])
         reg.add("raft.resolve_address", raft_resolve_address, roles=[MANAGER])
-        reg.add("raft.join", raft_join, roles=[MANAGER])
-        reg.add("raft.leave", raft_leave, roles=[MANAGER])
+        # join/leave are leader-only operations, but a joiner only knows one
+        # manager address — forward so any manager can serve them
+        # (raftproxy wiring of RaftMembership, manager.go:480-561)
+        reg.add("raft.join", leader_forward("raft.join", raft_join),
+                roles=[MANAGER])
+        reg.add("raft.leave", leader_forward("raft.leave", raft_leave),
+                roles=[MANAGER])
+
+    # --------------------------------------------------------------- cluster
+    def cluster_announce_manager(caller, node_id, addr, raft_id):
+        """A (re)started manager records its reachable RPC address + raft id
+        on its Node object; the dispatcher's session plane serves this
+        manager list to agents (node join flow, manager.go becomeLeader
+        self-registration)."""
+        if caller is not None and caller.node_id != node_id:
+            raise PermissionDenied("managers may only announce themselves")
+
+        def txn(tx):
+            node = tx.get_node(node_id)
+            if node is None:
+                return
+            node = node.copy()  # stored objects are live references
+            if node.manager_status is None:
+                from ..api.objects import ManagerStatus
+
+                node.manager_status = ManagerStatus()
+            node.manager_status.addr = addr
+            node.manager_status.raft_id = raft_id
+            node.manager_status.reachability = "reachable"
+            tx.update(node)
+
+        manager.store.update(txn)
+        return None
+
+    def cluster_managers(caller):
+        """Reachable manager endpoints (the Session message's manager list,
+        api/dispatcher.proto WeightedPeer)."""
+
+        def view(tx):
+            out = []
+            for n in tx.find_nodes():
+                ms = n.manager_status
+                if ms is not None and ms.addr:
+                    out.append((n.id, ms.addr))
+            return out
+
+        return manager.store.view(view)
+
+    reg.add("cluster.announce_manager",
+            leader_forward("cluster.announce_manager",
+                           cluster_announce_manager), roles=[MANAGER])
+    reg.add("cluster.managers", cluster_managers,
+            roles=[NodeRole.WORKER, MANAGER])
 
     # ---------------------------------------------------------- dispatcher
     d = manager.dispatcher
@@ -315,30 +382,59 @@ def _member_list(raft_node):
 
 class RemoteDispatcher:
     """Drop-in for the Dispatcher object held by an Agent; reconnection is
-    the agent's session loop's job (it already retries register)."""
+    the agent's session loop's job (it already retries register).
+
+    `addr` may be a single manager or a comma-separated seed list; the shim
+    follows the leader (assignment streams cannot hop) and falls back to the
+    next seed when the manager it was pinned to dies — the wire analogue of
+    remotes.Remotes weighted re-selection (agent/session.go:90-118)."""
 
     def __init__(self, addr: str, security, connect_timeout: float = 10.0):
-        self.addr = addr
+        self.seeds = [a.strip() for a in addr.split(",") if a.strip()]
+        self.addr = self.seeds[0]
         self.security = security
         self._connect_timeout = connect_timeout
         self._lock = threading.Lock()
         self._client: RPCClient | None = None
 
+    def update_managers(self, addrs: list[str]):
+        """Merge freshly-learned manager endpoints into the seed list (the
+        Session message manager-list plane)."""
+        with self._lock:
+            for a in addrs:
+                if a and a not in self.seeds:
+                    self.seeds.append(a)
+
     def _conn(self) -> RPCClient:
         with self._lock:
             if self._client is not None and self._client.alive:
                 return self._client
-            self._client = RPCClient(self.addr, security=self.security,
-                                     connect_timeout=self._connect_timeout)
-            return self._client
+            self._client = None
+            candidates = [self.addr] + [s for s in self.seeds
+                                        if s != self.addr]
+        last_exc: Exception | None = None
+        for addr in candidates:
+            try:
+                client = RPCClient(addr, security=self.security,
+                                   connect_timeout=self._connect_timeout)
+            except OSError as exc:
+                last_exc = exc
+                continue
+            with self._lock:
+                self._client = client
+                self.addr = addr
+            return client
+        raise ConnectionError(
+            f"no reachable manager among {candidates}: {last_exc}")
 
     def register(self, node_id, description=None):
         # follow the leader: the assignments stream cannot be proxied, so
         # sessions are opened against the leader's endpoint directly
-        addr = self._conn().call("dispatcher.leader_addr", node_id)
+        addr = self._conn().call("dispatcher.leader_addr")
         if addr is not None and addr != self.addr:
             self.close()
-            self.addr = addr
+            with self._lock:
+                self.addr = addr
         return self._conn().call("dispatcher.register", node_id, description)
 
     def heartbeat(self, node_id, session_id):
@@ -368,13 +464,20 @@ class RemoteDispatcher:
 
 class RemoteCA:
     """ca_server surface for node bootstrap + renewal (the TLSRenewer and
-    Node.run use exactly these four methods)."""
+    Node.run use exactly these four methods).
+
+    `addr` may be a comma-separated seed list; `seeds_fn` (optional) supplies
+    a live manager list (e.g. the dispatcher shim's refreshed seeds) so
+    renewal keeps working after the original join endpoint dies."""
 
     def __init__(self, addr: str, security=None,
-                 root_cert_pem: bytes | None = None):
-        self.addr = addr
+                 root_cert_pem: bytes | None = None,
+                 seeds_fn=None):
+        self.seeds = [a.strip() for a in addr.split(",") if a.strip()]
+        self.addr = self.seeds[0]
         self.security = security
         self.root_cert_pem = root_cert_pem
+        self.seeds_fn = seeds_fn
         self._lock = threading.Lock()
         self._client: RPCClient | None = None
 
@@ -382,9 +485,24 @@ class RemoteCA:
         with self._lock:
             if self._client is not None and self._client.alive:
                 return self._client
-            self._client = RPCClient(self.addr, security=self.security,
-                                     root_cert_pem=self.root_cert_pem)
-            return self._client
+            self._client = None
+            candidates = list(dict.fromkeys(
+                [self.addr] + self.seeds
+                + (list(self.seeds_fn()) if self.seeds_fn else [])))
+        last: Exception | None = None
+        for addr in candidates:
+            try:
+                client = RPCClient(addr, security=self.security,
+                                   root_cert_pem=self.root_cert_pem)
+            except OSError as exc:
+                last = exc
+                continue
+            with self._lock:
+                self._client = client
+                self.addr = addr
+            return client
+        raise ConnectionError(
+            f"no reachable manager among {candidates}: {last}")
 
     def issue_node_certificate(self, csr_pem, token=None, node_id=None,
                                caller=None):
@@ -400,6 +518,40 @@ class RemoteCA:
 
     def get_root_ca_certificate(self):
         return self._conn().call("ca.get_root_ca_certificate")
+
+    def close(self):
+        with self._lock:
+            client, self._client = self._client, None
+        if client is not None:
+            client.close()
+
+
+class RemoteLogBroker:
+    """LogBroker surface over the wire: the agent side (listen/publish) and
+    the client side (subscribe) of api/logbroker.proto."""
+
+    def __init__(self, addr: str, security):
+        self.addr = addr
+        self.security = security
+        self._lock = threading.Lock()
+        self._client: RPCClient | None = None
+
+    def _conn(self) -> RPCClient:
+        with self._lock:
+            if self._client is not None and self._client.alive:
+                return self._client
+            self._client = RPCClient(self.addr, security=self.security)
+            return self._client
+
+    def listen_subscriptions(self, node_id):
+        return self._conn().stream("logs.listen_subscriptions", node_id)
+
+    def publish_logs(self, sub_id, messages):
+        return self._conn().call("logs.publish", sub_id, messages)
+
+    def subscribe_logs(self, selector, follow=True):
+        ch = self._conn().stream("logs.subscribe", selector, follow=follow)
+        return None, ch  # (sub_id, channel) — matches LogBroker surface
 
     def close(self):
         with self._lock:

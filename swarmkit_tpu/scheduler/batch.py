@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..ops import placement as placement_ops
 from .encode import UNLIMITED, EncodedProblem
 from .spread import GroupFill, greedy_fill, slot_order
 
@@ -94,6 +93,10 @@ def cpu_schedule_encoded(p: EncodedProblem) -> np.ndarray:
 
 
 def tpu_schedule_encoded(p: EncodedProblem) -> np.ndarray:
+    # deferred: pulling in jax is a multi-second import; daemon processes
+    # that never cross the TPU batching threshold should not pay it
+    from ..ops import placement as placement_ops
+
     return placement_ops.schedule_encoded(p)
 
 

@@ -225,8 +225,16 @@ class Scheduler:
                 if dirty_since is not None and (
                         ev is None or now - dirty_since >= MAX_LATENCY):
                     # debounce elapsed with no new event, or max latency hit
-                    self.tick()
-                    dirty_since = None
+                    try:
+                        self.tick()
+                        dirty_since = None
+                    except Exception:
+                        # a propose can fail transiently (leadership churn,
+                        # quorum loss); the unassigned pool is preserved and
+                        # the max-latency path retries even with no new
+                        # events — the loop must survive
+                        log.exception("scheduler: tick failed; will retry")
+                        dirty_since = time.monotonic()
         finally:
             self.store.queue.stop_watch(ch)
 

@@ -25,3 +25,10 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "daemon: in-process networked daemon cluster tests")
+    config.addinivalue_line(
+        "markers", "multiprocess: real-OS-process swarmd cluster tests")

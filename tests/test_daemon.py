@@ -1,0 +1,256 @@
+"""SwarmNode daemon assembly over real TCP + mTLS (in one process).
+
+The scenarios the VERDICT's item-1 'done' criterion names, at in-process
+scope (the subprocess tier lives in test_multiprocess.py): managers form a
+raft quorum over the network transport, workers join with a token and a
+digest-pinned root fetch, services reach RUNNING through the wire
+dispatcher, and the cluster survives losing its leader.
+"""
+import os
+import time
+
+import pytest
+
+from swarmkit_tpu.agent.testutils import FakeExecutor
+from swarmkit_tpu.api.specs import Annotations, ServiceSpec
+from swarmkit_tpu.api.types import TaskState
+from swarmkit_tpu.node.daemon import SwarmNode
+from swarmkit_tpu.rpc.services import RemoteControl
+from swarmkit_tpu.store import by as by_mod
+
+from test_scheduler import wait_for  # noqa: E402 (tests/ path via conftest)
+
+
+pytestmark = pytest.mark.daemon
+
+
+def _mk_manager(tmp_path, name, join_addr=None, join_token=None):
+    node = SwarmNode(
+        state_dir=str(tmp_path / name),
+        executor=FakeExecutor({"*": {"run_forever": True}}, hostname=name),
+        listen_addr="127.0.0.1:0",
+        join_addr=join_addr,
+        join_token=join_token,
+        heartbeat_period=0.5,
+        tick_interval=0.05,
+        manager_refresh_interval=0.5,
+    )
+    node.start()
+    return node
+
+
+def _mk_worker(tmp_path, name, join_addr, join_token):
+    node = SwarmNode(
+        state_dir=str(tmp_path / name),
+        executor=FakeExecutor({"*": {"run_forever": True}}, hostname=name),
+        join_addr=join_addr,
+        join_token=join_token,
+        heartbeat_period=0.5,
+        manager_refresh_interval=0.5,
+    )
+    node.start()
+    return node
+
+
+def _tokens(manager: SwarmNode):
+    # leadership application (and cluster seeding) is asynchronous with the
+    # raft role flip — wait for the seeded cluster object
+    def seeded():
+        c = manager.store.view(
+            lambda tx: tx.get_cluster(manager.manager.cluster_id))
+        return c is not None and c.root_ca is not None
+    assert wait_for(seeded, timeout=10)
+    cluster = manager.store.view(
+        lambda tx: tx.get_cluster(manager.manager.cluster_id))
+    return (cluster.root_ca.join_token_manager,
+            cluster.root_ca.join_token_worker)
+
+
+def _running_count(store, service_id):
+    from swarmkit_tpu.store import by
+
+    tasks = store.view(lambda tx: tx.find_tasks(by.ByServiceID(service_id)))
+    return sum(1 for t in tasks if t.status.state == TaskState.RUNNING)
+
+
+@pytest.fixture
+def cluster_nodes():
+    nodes = []
+    yield nodes
+    for n in reversed(nodes):
+        try:
+            n.stop()
+        except Exception:
+            pass
+
+
+def test_single_manager_service_over_wire(tmp_path, cluster_nodes):
+    m1 = _mk_manager(tmp_path, "m1")
+    cluster_nodes.append(m1)
+    assert wait_for(lambda: m1.is_leader, timeout=10)
+
+    ctl = RemoteControl(m1.addr, m1.security)
+    try:
+        spec = ServiceSpec(annotations=Annotations(name="web"), replicas=3)
+        svc = ctl.create_service(spec)
+        assert wait_for(lambda: _running_count(m1.store, svc.id) == 3,
+                        timeout=20)
+        # the manager's own agent ran them (managers run workloads too)
+        listed = ctl.list_services()
+        assert [s.id for s in listed] == [svc.id]
+    finally:
+        ctl.close()
+
+
+def test_worker_join_and_schedule(tmp_path, cluster_nodes):
+    m1 = _mk_manager(tmp_path, "m1")
+    cluster_nodes.append(m1)
+    assert wait_for(lambda: m1.is_leader, timeout=10)
+    _mtok, wtok = _tokens(m1)
+
+    w1 = _mk_worker(tmp_path, "w1", m1.addr, wtok)
+    cluster_nodes.append(w1)
+
+    # worker registered over the wire and became READY
+    def worker_ready():
+        n = m1.store.view(lambda tx: tx.get_node(w1.node_id))
+        from swarmkit_tpu.api.types import NodeStatusState
+
+        return n is not None and n.status.state == NodeStatusState.READY
+
+    assert wait_for(worker_ready, timeout=15)
+
+    ctl = RemoteControl(m1.addr, m1.security)
+    try:
+        spec = ServiceSpec(annotations=Annotations(name="spread"), replicas=6)
+        svc = ctl.create_service(spec)
+        assert wait_for(lambda: _running_count(m1.store, svc.id) == 6,
+                        timeout=20)
+        # both nodes actually run tasks (spread over 2 nodes)
+        from swarmkit_tpu.store import by
+
+        tasks = m1.store.view(
+            lambda tx: tx.find_tasks(by.ByServiceID(svc.id)))
+        nodes_used = {t.node_id for t in tasks
+                      if t.status.state == TaskState.RUNNING}
+        assert len(nodes_used) == 2
+    finally:
+        ctl.close()
+
+
+def test_three_manager_quorum_and_leader_failover(tmp_path, cluster_nodes):
+    m1 = _mk_manager(tmp_path, "m1")
+    cluster_nodes.append(m1)
+    assert wait_for(lambda: m1.is_leader, timeout=10)
+    mtok, wtok = _tokens(m1)
+
+    m2 = _mk_manager(tmp_path, "m2", join_addr=m1.addr, join_token=mtok)
+    cluster_nodes.append(m2)
+    m3 = _mk_manager(tmp_path, "m3", join_addr=m1.addr, join_token=mtok)
+    cluster_nodes.append(m3)
+    managers = [m1, m2, m3]
+
+    # all three replicate the member list
+    assert wait_for(
+        lambda: all(len(m.raft.members) == 3 for m in managers), timeout=15)
+
+    w1 = _mk_worker(tmp_path, "w1",
+                    ",".join(m.addr for m in managers), wtok)
+    cluster_nodes.append(w1)
+
+    # a write against a *follower* forwards to the leader transparently
+    follower = next(m for m in managers if not m.is_leader)
+    ctl = RemoteControl(follower.addr, follower.security)
+    try:
+        spec = ServiceSpec(annotations=Annotations(name="ha"), replicas=8)
+        svc = ctl.create_service(spec)
+    finally:
+        ctl.close()
+
+    leader = next(m for m in managers if m.is_leader)
+    assert wait_for(lambda: _running_count(leader.store, svc.id) == 8,
+                    timeout=30)
+
+    # ---- kill the leader process ----------------------------------------
+    cluster_nodes.remove(leader)
+    leader.stop()
+    survivors = [m for m in managers if m is not leader]
+
+    assert wait_for(lambda: any(m.is_leader for m in survivors), timeout=30)
+    new_leader = next(m for m in survivors if m.is_leader)
+
+    # control plane is responsive again and replicas converge back to 8
+    # (tasks that ran on the dead leader's agent get rescheduled once its
+    # heartbeats lapse)
+    def converged():
+        nl = next((m for m in survivors if m.is_leader), new_leader)
+        return _running_count(nl.store, svc.id) == 8
+
+    if not wait_for(converged, timeout=60):
+        import collections
+
+        nl = next((m for m in survivors if m.is_leader), new_leader)
+        tasks = nl.store.view(
+            lambda tx: tx.find_tasks(by_mod.ByServiceID(svc.id)))
+        states = collections.Counter(
+            (int(t.status.state), int(t.desired_state), t.node_id[:6] or "-")
+            for t in tasks)
+        nodes_dump = {n.id[:6]: int(n.status.state)
+                      for n in nl.store.view(lambda tx: tx.find_nodes())}
+        raft_dump = {m.node_id[:6]: m.raft.status() for m in survivors}
+        raise AssertionError(
+            f"no convergence: tasks(state,desired,node)={dict(states)} "
+            f"nodes={nodes_dump} raft={raft_dump} "
+            f"sessions={list(nl.manager.dispatcher._sessions)}")
+
+    # the worker's session survived by following the new leader
+    from swarmkit_tpu.store import by
+
+    tasks = new_leader.store.view(
+        lambda tx: tx.find_tasks(by.ByServiceID(svc.id)))
+    run_nodes = {t.node_id for t in tasks
+                 if t.status.state == TaskState.RUNNING}
+    assert w1.node_id in run_nodes
+
+
+def test_restarted_manager_rejoins_from_state_dir(tmp_path, cluster_nodes):
+    m1 = _mk_manager(tmp_path, "m1")
+    cluster_nodes.append(m1)
+    assert wait_for(lambda: m1.is_leader, timeout=10)
+    mtok, _ = _tokens(m1)
+
+    m2 = _mk_manager(tmp_path, "m2", join_addr=m1.addr, join_token=mtok)
+    cluster_nodes.append(m2)
+    assert wait_for(lambda: len(m1.raft.members) == 2, timeout=15)
+
+    ctl = RemoteControl(m1.addr, m1.security)
+    try:
+        svc = ctl.create_service(
+            ServiceSpec(annotations=Annotations(name="durable"), replicas=2))
+    finally:
+        ctl.close()
+    assert wait_for(lambda: _running_count(m1.store, svc.id) == 2, timeout=20)
+
+    # restart m2 from its state dir: same identity, same raft id, catches up
+    old_id, old_raft_id = m2.node_id, m2.raft_id
+    cluster_nodes.remove(m2)
+    m2.stop()
+    time.sleep(0.5)
+    state_dir = m2.state_dir
+    m2b = SwarmNode(
+        state_dir=state_dir,
+        executor=FakeExecutor({"*": {"run_forever": True}}, hostname="m2"),
+        listen_addr="127.0.0.1:" + m2.advertise_addr.rsplit(":", 1)[1],
+        heartbeat_period=0.5,
+        tick_interval=0.05,
+    )
+    m2b.start()
+    cluster_nodes.append(m2b)
+    assert m2b.node_id == old_id
+    assert m2b.raft_id == old_raft_id
+
+    def caught_up():
+        got = m2b.store.view(lambda tx: tx.get_service(svc.id))
+        return got is not None
+
+    assert wait_for(caught_up, timeout=20)

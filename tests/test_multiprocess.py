@@ -1,0 +1,173 @@
+"""Multi-process cluster: real swarmd OS processes over TCP + mTLS.
+
+The VERDICT item-1 'done' criterion at full fidelity: separate daemon
+processes (3 managers + 1 dedicated worker — every manager also runs an
+agent, so 4 agents total) form a raft quorum, run a service as REAL child
+processes via the subprocess executor, survive a SIGKILL of the leader
+process, and converge again.
+
+Kept to one scenario because each daemon pays the interpreter+jax startup
+tax; the in-process tier (test_daemon.py) covers the scenario matrix.
+"""
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.multiprocess
+
+
+class Swarmd:
+    def __init__(self, base, name, *args):
+        self.name = name
+        self.log_path = os.path.join(base, f"{name}.out")
+        self._log = open(self.log_path, "wb")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
+        # daemons must not inherit the test conftest's virtual-device env
+        env.pop("XLA_FLAGS", None)
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "swarmkit_tpu.cmd.swarmd",
+             "--state-dir", os.path.join(base, name),
+             "--heartbeat-period", "0.5", "--tick-interval", "0.05",
+             *args],
+            stdout=self._log, stderr=subprocess.STDOUT, env=env, cwd=REPO)
+
+    def log(self) -> str:
+        with open(self.log_path, "rb") as f:
+            return f.read().decode(errors="replace")
+
+    def wait_ready(self, timeout=90):
+        end = time.monotonic() + timeout
+        while time.monotonic() < end:
+            m = re.search(r"SWARM_NODE_READY addr=(\S*) id=(\S+)", self.log())
+            if m:
+                self.addr, self.node_id = m.group(1), m.group(2)
+                return self
+            if self.proc.poll() is not None:
+                raise AssertionError(
+                    f"{self.name} died rc={self.proc.returncode}:\n"
+                    + self.log()[-4000:])
+            time.sleep(0.2)
+        raise AssertionError(f"{self.name} not ready:\n" + self.log()[-4000:])
+
+    def kill(self):
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGKILL)
+            self.proc.wait()
+
+    def terminate(self):
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+def _load_identity(base, name):
+    from swarmkit_tpu.ca import KeyReadWriter, RootCA, SecurityConfig
+
+    d = os.path.join(base, name)
+    with open(os.path.join(d, "ca.pem"), "rb") as f:
+        root = RootCA(f.read())
+    key_pem, _ = KeyReadWriter(os.path.join(d, "key.json")).read()
+    with open(os.path.join(d, "cert.pem"), "rb") as f:
+        cert_pem = f.read()
+    return SecurityConfig(root, key_pem, cert_pem)
+
+
+def test_multiprocess_cluster_survives_leader_sigkill(tmp_path):
+    from swarmkit_tpu.api.specs import (
+        Annotations, ContainerSpec, ServiceSpec, TaskSpec)
+    from swarmkit_tpu.api.types import TaskState
+    from swarmkit_tpu.rpc.client import RPCClient
+    from swarmkit_tpu.rpc.services import RemoteControl
+
+    base = str(tmp_path)
+    daemons = []
+    try:
+        m1 = Swarmd(base, "m1", "--listen-addr", "127.0.0.1:0",
+                    "--executor", "subprocess")
+        daemons.append(m1)
+        m1.wait_ready()
+        log1 = m1.log()
+        mtok = re.search(r"SWARM_MANAGER_TOKEN=(\S+)", log1).group(1)
+        wtok = re.search(r"SWARM_WORKER_TOKEN=(\S+)", log1).group(1)
+
+        m2 = Swarmd(base, "m2", "--listen-addr", "127.0.0.1:0",
+                    "--executor", "subprocess",
+                    "--join-addr", m1.addr, "--join-token", mtok)
+        m3 = Swarmd(base, "m3", "--listen-addr", "127.0.0.1:0",
+                    "--executor", "subprocess",
+                    "--join-addr", m1.addr, "--join-token", mtok)
+        daemons += [m2, m3]
+        m2.wait_ready()
+        m3.wait_ready()
+        managers = [m1, m2, m3]
+
+        w1 = Swarmd(base, "w1", "--executor", "subprocess",
+                    "--join-addr",
+                    ",".join(m.addr for m in managers),
+                    "--join-token", wtok)
+        daemons.append(w1)
+        w1.wait_ready()
+
+        sec = _load_identity(base, "m2")
+        ctl = RemoteControl(m2.addr, sec)
+        svc = ctl.create_service(ServiceSpec(
+            annotations=Annotations(name="sleepers"),
+            replicas=6,
+            task=TaskSpec(runtime=ContainerSpec(command=["sleep", "3600"])),
+        ))
+
+        def n_running(control):
+            try:
+                return sum(
+                    1 for t in control.list_tasks()
+                    if t.service_id == svc.id
+                    and t.status.state == TaskState.RUNNING)
+            except Exception:
+                return -1
+
+        end = time.monotonic() + 90
+        while time.monotonic() < end and n_running(ctl) != 6:
+            time.sleep(0.5)
+        assert n_running(ctl) == 6, m1.log()[-3000:]
+
+        # the replicas are real OS child processes
+        sleepers = subprocess.run(
+            ["pgrep", "-fc", "sleep 3600"], capture_output=True, text=True)
+        assert int(sleepers.stdout.strip() or 0) >= 6
+
+        # identify the leader by asking each manager, then SIGKILL it
+        leader = None
+        for m in managers:
+            try:
+                c = RPCClient(m.addr, security=sec)
+                if c.call("dispatcher.leader_addr") is None:
+                    leader = m
+                c.close()
+            except Exception:
+                pass
+        assert leader is not None
+        ctl.close()
+        leader.kill()
+
+        survivor = next(m for m in managers if m is not leader)
+        sec2 = _load_identity(base, survivor.name)
+        ctl2 = RemoteControl(survivor.addr, sec2)
+        end = time.monotonic() + 120
+        while time.monotonic() < end and n_running(ctl2) != 6:
+            time.sleep(0.5)
+        assert n_running(ctl2) == 6, survivor.log()[-3000:]
+        ctl2.close()
+    finally:
+        for d in daemons:
+            d.terminate()
