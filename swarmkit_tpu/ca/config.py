@@ -139,6 +139,25 @@ class SecurityConfig:
             return renewal_due(self._cert_pem, now if now is not None else time.time())
 
     @classmethod
+    def load_from_dir(cls, state_dir: str,
+                      kek: bytes | None = None) -> "SecurityConfig":
+        """Load a node identity from a swarmd state dir (cert.pem /
+        key.json / ca.pem — the layout node/daemon.py persists). The one
+        place the on-disk layout is interpreted; swarmctl/rafttool/tests
+        all go through here."""
+        import os
+
+        from .keyreadwriter import KeyReadWriter
+
+        with open(os.path.join(state_dir, "ca.pem"), "rb") as f:
+            root = RootCA(f.read())
+        key_pem, _headers = KeyReadWriter(
+            os.path.join(state_dir, "key.json"), kek).read()
+        with open(os.path.join(state_dir, "cert.pem"), "rb") as f:
+            cert_pem = f.read()
+        return cls(root, key_pem, cert_pem)
+
+    @classmethod
     def bootstrap_manager(
         cls, node_id: str | None = None, org: str = "swarmkit-tpu"
     ) -> "SecurityConfig":

@@ -1,0 +1,190 @@
+"""swarm-rafttool: offline raft state inspection (reference
+swarmd/cmd/swarm-rafttool/{dump,common}.go).
+
+Decrypts a stopped manager's raft WAL + snapshot using the DEK stored in
+the node key file's headers and dumps them in human/JSON form — the
+disaster-inspection tool you reach for when a manager won't start.
+
+    python -m swarmkit_tpu.cmd.rafttool dump --state-dir /tmp/m1
+    python -m swarmkit_tpu.cmd.rafttool dump-wal --state-dir /tmp/m1
+    python -m swarmkit_tpu.cmd.rafttool dump-snapshot --state-dir /tmp/m1
+    python -m swarmkit_tpu.cmd.rafttool dump-object --state-dir /tmp/m1 \
+        --kind tasks
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import enum
+import json
+import os
+import sys
+
+
+def _die(msg: str):
+    print(f"rafttool: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _jsonable(obj, depth=0):
+    if depth > 12:
+        return "…"
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {f.name: _jsonable(getattr(obj, f.name), depth + 1)
+                for f in dataclasses.fields(obj)}
+    if isinstance(obj, enum.Enum):
+        return obj.name
+    if isinstance(obj, bytes):
+        try:
+            return obj.decode()
+        except UnicodeDecodeError:
+            return f"<{len(obj)} bytes>"
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v, depth + 1) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v, depth + 1) for v in obj]
+    return obj
+
+
+def _open_storage(args):
+    """DEK from the node key file headers → RaftStorage over the state dir
+    (manager/deks.go keeps raft DEKs in the TLS key's headers)."""
+    from ..ca import KeyReadWriter
+    from ..raft.storage import RaftStorage
+
+    key_path = os.path.join(args.state_dir, "key.json")
+    kek = args.kek.encode() if args.kek else None
+    try:
+        _key, headers = KeyReadWriter(key_path, kek).read()
+    except OSError as exc:
+        _die(f"cannot read {key_path}: {exc}")
+    dek_hex = (headers or {}).get("raft-dek")
+    if not dek_hex:
+        _die("no raft DEK in the key file headers (not a manager state dir?)")
+    return RaftStorage(os.path.join(args.state_dir, "raft"),
+                       dek=dek_hex.encode())
+
+
+def _load_storage(args):
+    state = _open_storage(args).load()
+    if state is None:
+        _die("no persisted raft state found")
+    return state
+
+
+def cmd_dump(args):
+    state = _load_storage(args)
+    print(json.dumps({
+        "term": state.term,
+        "voted_for": state.voted_for,
+        "commit_index": state.commit_index,
+        "snapshot_index": state.snapshot_index,
+        "snapshot_term": state.snapshot_term,
+        "wal_entries": len(state.entries),
+        "first_wal_index": state.entries[0].index if state.entries else None,
+        "last_wal_index": state.entries[-1].index if state.entries else None,
+        "members": {rid: {"node_id": p.node_id, "addr": p.addr}
+                    for rid, p in state.members.items()},
+        "has_snapshot": state.snapshot_data is not None,
+    }, indent=2))
+
+
+def cmd_dump_wal(args):
+    state = _load_storage(args)
+    for e in state.entries:
+        kind = "conf-change" if e.kind == 1 else "entry"
+        summary = None
+        if e.kind == 1:
+            summary = _jsonable(e.data)
+        elif e.data is not None:
+            summary = [
+                {"action": getattr(a, "kind", "?"),
+                 "object": type(getattr(a, "obj", None)).__name__,
+                 "id": getattr(getattr(a, "obj", None), "id", None)}
+                for a in e.data
+            ]
+        print(json.dumps({"index": e.index, "term": e.term, "kind": kind,
+                          "request_id": e.request_id or None,
+                          "data": summary}))
+
+
+def cmd_dump_snapshot(args):
+    state = _load_storage(args)
+    if state.snapshot_data is None:
+        _die("no snapshot present")
+    snap = state.snapshot_data
+    out = {"snapshot_index": state.snapshot_index,
+           "snapshot_term": state.snapshot_term}
+    if isinstance(snap, dict):
+        out["tables"] = {k: (len(v) if isinstance(v, (list, dict)) else "?")
+                         for k, v in snap.items()}
+    print(json.dumps(out, indent=2))
+
+
+def cmd_dump_object(args):
+    """Reconstruct the store at the WAL tail and dump one table."""
+    from ..raft.node import RaftNode
+    from ..raft.proposer import RaftProposer
+    from ..store.memory import MemoryStore
+
+    class _NullTransport:
+        def send(self, msg):
+            pass
+
+        def active(self, peer_id):
+            return False
+
+    storage = _open_storage(args)
+    node = RaftNode(raft_id=0, transport=_NullTransport(), storage=storage,
+                    auto_recover=False)
+    proposer = RaftProposer(node)
+    store = MemoryStore(proposer=proposer)
+    proposer.attach_store(store)  # replays snapshot + WAL into the store
+
+    finders = {
+        "tasks": lambda tx: tx.find_tasks(),
+        "services": lambda tx: tx.find_services(),
+        "nodes": lambda tx: tx.find_nodes(),
+        "clusters": lambda tx: tx.find_clusters(),
+        "secrets": lambda tx: tx.find_secrets(),
+        "configs": lambda tx: tx.find_configs(),
+        "networks": lambda tx: tx.find_networks(),
+        "volumes": lambda tx: tx.find_volumes(),
+    }
+    finder = finders.get(args.kind)
+    if finder is None:
+        _die(f"unknown kind {args.kind!r}; one of {sorted(finders)}")
+    objs = store.view(finder)
+    for o in objs:
+        print(json.dumps(_jsonable(o)))
+
+
+def main(argv=None) -> int:
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--state-dir", required=True)
+    common.add_argument("--kek", default=None,
+                        help="key-encryption key if the node key is sealed")
+    ap = argparse.ArgumentParser(prog="swarm-rafttool")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("dump", parents=[common]).set_defaults(func=cmd_dump)
+    sub.add_parser("dump-wal", parents=[common]).set_defaults(
+        func=cmd_dump_wal)
+    sub.add_parser("dump-snapshot", parents=[common]).set_defaults(
+        func=cmd_dump_snapshot)
+    p = sub.add_parser("dump-object", parents=[common])
+    p.add_argument("--kind", required=True)
+    p.set_defaults(func=cmd_dump_object)
+    args = ap.parse_args(argv)
+    try:
+        args.func(args)
+    except BrokenPipeError:
+        # `| head` closed stdout; normal for a dump tool
+        try:
+            sys.stdout.close()
+        except OSError:
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

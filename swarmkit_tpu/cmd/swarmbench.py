@@ -1,0 +1,91 @@
+"""swarm-bench: cluster load generator (reference cmd/swarm-bench).
+
+Creates an N-replica service against a live cluster and measures
+time-to-RUNNING per task, reporting percentiles — the reference has the
+containers phone home over UDP; our tasks' observed RUNNING timestamps in
+the replicated store carry the same signal without instrumenting payloads.
+
+    python -m swarmkit_tpu.cmd.swarmbench --addr 127.0.0.1:4242 \
+        --identity /tmp/m1 --replicas 100
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="swarm-bench")
+    ap.add_argument("--addr", required=True)
+    ap.add_argument("--identity", required=True,
+                    help="manager state dir (cert.pem/key.json/ca.pem)")
+    ap.add_argument("--replicas", type=int, default=100)
+    ap.add_argument("--command", default="sleep 3600")
+    ap.add_argument("--timeout", type=float, default=120.0)
+    ap.add_argument("--keep", action="store_true",
+                    help="leave the service running after the measurement")
+    args = ap.parse_args(argv)
+
+    from .swarmctl import _load_identity
+    from ..api.specs import Annotations, ContainerSpec, ServiceSpec, TaskSpec
+    from ..api.types import TaskState
+    from ..controlapi.control import ListFilters
+    from ..rpc.services import RemoteControl
+
+    import shlex
+
+    sec = _load_identity(args.identity)
+    ctl = RemoteControl(args.addr, sec)
+
+    name = f"bench-{int(time.time())}"
+    t0 = time.monotonic()
+    svc = ctl.create_service(ServiceSpec(
+        annotations=Annotations(name=name),
+        replicas=args.replicas,
+        task=TaskSpec(runtime=ContainerSpec(
+            command=shlex.split(args.command))),
+    ))
+
+    seen: dict[str, float] = {}  # task id -> time-to-RUNNING from t0
+    deadline = time.monotonic() + args.timeout
+    while time.monotonic() < deadline and len(seen) < args.replicas:
+        now = time.monotonic()
+        try:
+            tasks = ctl.list_tasks(ListFilters(service_ids=[svc.id]))
+        except Exception:
+            time.sleep(0.3)
+            continue
+        for t in tasks:
+            if t.id not in seen and t.status.state == TaskState.RUNNING:
+                seen[t.id] = now - t0
+        time.sleep(0.1)
+
+    lat = sorted(seen.values())
+
+    def pct(p):
+        if not lat:
+            return None
+        return round(lat[min(len(lat) - 1, int(p / 100 * len(lat)))], 3)
+
+    print(json.dumps({
+        "service": svc.id,
+        "replicas": args.replicas,
+        "running": len(lat),
+        "time_to_first_s": round(lat[0], 3) if lat else None,
+        "time_to_all_s": round(lat[-1], 3) if len(lat) == args.replicas
+        else None,
+        "p50_s": pct(50), "p90_s": pct(90), "p99_s": pct(99),
+    }))
+    if not args.keep:
+        try:
+            ctl.remove_service(svc.id)
+        except Exception:
+            pass
+    ctl.close()
+    return 0 if len(lat) == args.replicas else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,530 @@
+"""swarmctl: operator CLI over the Control API (reference swarmd/cmd/swarmctl).
+
+    swarmctl --addr 127.0.0.1:4242 --identity /tmp/m1 service create \
+        --name web --command "sleep 3600" --replicas 3
+    swarmctl ... service ls
+    swarmctl ... node ls / node promote <id> / node demote <id>
+    swarmctl ... secret create my-secret --data-stdin < secret.txt
+    swarmctl ... logs <service-name>
+
+Identity: `--identity` points at a node state dir (cert.pem/key.json/ca.pem,
+as written by swarmd); the control surface requires a manager certificate.
+Env fallbacks: SWARMCTL_ADDR, SWARMCTL_IDENTITY.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _die(msg: str) -> "NoReturn":  # noqa: F821
+    print(f"swarmctl: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _load_identity(state_dir: str):
+    from ..ca import SecurityConfig
+
+    try:
+        return SecurityConfig.load_from_dir(state_dir)
+    except OSError as exc:
+        _die(f"cannot load identity from {state_dir}: {exc}")
+
+
+def _control(args):
+    from ..rpc.services import RemoteControl
+
+    return RemoteControl(args.addr, _load_identity(args.identity))
+
+
+def _fmt_table(rows: list[list[str]], header: list[str]) -> str:
+    rows = [header] + rows
+    widths = [max(len(str(r[i])) for r in rows) for i in range(len(header))]
+    out = []
+    for r in rows:
+        out.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(out)
+
+
+def _short(obj_id: str) -> str:
+    return obj_id[:12]
+
+
+def _state_name(state) -> str:
+    return getattr(state, "name", str(state)).lower()
+
+
+def _find_service(ctl, ref: str):
+    from ..controlapi.control import ListFilters
+
+    svcs = ctl.list_services(ListFilters(names=[ref]))
+    if not svcs:
+        svcs = ctl.list_services(ListFilters(id_prefixes=[ref]))
+    if not svcs:
+        _die(f"service {ref!r} not found")
+    if len(svcs) > 1:
+        _die(f"service reference {ref!r} is ambiguous")
+    return svcs[0]
+
+
+def _find_node(ctl, ref: str):
+    nodes = [n for n in ctl.list_nodes() if n.id.startswith(ref)
+             or (n.description and n.description.hostname == ref)]
+    if not nodes:
+        _die(f"node {ref!r} not found")
+    if len(nodes) > 1:
+        _die(f"node reference {ref!r} is ambiguous")
+    return nodes[0]
+
+
+# ------------------------------------------------------------------ service
+
+def cmd_service_create(args):
+    from ..api.specs import (
+        Annotations, ContainerSpec, JobSpec, ServiceSpec, TaskSpec,
+        UpdateConfig)
+    from ..api.types import ServiceMode
+
+    import shlex
+
+    runtime = ContainerSpec(
+        image=args.image or "",
+        command=shlex.split(args.command) if args.command else [],
+        env=[e for e in (args.env or [])],
+    )
+    spec = ServiceSpec(
+        annotations=Annotations(name=args.name,
+                                labels=dict(kv.split("=", 1)
+                                            for kv in (args.label or []))),
+        task=TaskSpec(runtime=runtime),
+        replicas=args.replicas,
+        mode=ServiceMode(args.mode),
+    )
+    spec.task.placement.constraints = list(args.constraint or [])
+    if args.update_parallelism or args.update_delay:
+        spec.update = UpdateConfig(
+            parallelism=args.update_parallelism or 1,
+            delay=args.update_delay or 0.0)
+    if args.mode in ("replicated_job", "global_job"):
+        spec.job = JobSpec(total_completions=args.replicas)
+    ctl = _control(args)
+    svc = ctl.create_service(spec)
+    print(svc.id)
+
+
+def cmd_service_ls(args):
+    ctl = _control(args)
+    from ..api.types import ServiceMode, TaskState
+
+    tasks = ctl.list_tasks()
+    running = {}
+    for t in tasks:
+        if t.status.state == TaskState.RUNNING:
+            running[t.service_id] = running.get(t.service_id, 0) + 1
+    rows = []
+    for s in ctl.list_services():
+        mode = s.spec.mode.value if hasattr(s.spec.mode, "value") else s.spec.mode
+        desired = s.spec.replicas if s.spec.mode == ServiceMode.REPLICATED else "-"
+        rows.append([_short(s.id), s.spec.annotations.name, mode,
+                     f"{running.get(s.id, 0)}/{desired}"])
+    print(_fmt_table(rows, ["ID", "NAME", "MODE", "REPLICAS"]))
+
+
+def cmd_service_inspect(args):
+    import json
+
+    ctl = _control(args)
+    s = _find_service(ctl, args.service)
+    runtime = s.spec.task.runtime
+    print(json.dumps({
+        "id": s.id,
+        "name": s.spec.annotations.name,
+        "mode": str(s.spec.mode),
+        "replicas": s.spec.replicas,
+        "command": runtime.command if runtime else None,
+        "image": runtime.image if runtime else None,
+        "constraints": s.spec.task.placement.constraints,
+        "version": s.meta.version.index,
+    }, indent=2))
+
+
+def cmd_service_update(args):
+    ctl = _control(args)
+    s = _find_service(ctl, args.service)
+    spec = s.spec
+    if args.replicas is not None:
+        spec.replicas = args.replicas
+    if args.command is not None or args.image is not None:
+        if spec.task.runtime is None:
+            from ..api.specs import ContainerSpec
+
+            spec.task.runtime = ContainerSpec()
+        if args.command is not None:
+            import shlex
+
+            spec.task.runtime.command = shlex.split(args.command)
+        if args.image is not None:
+            spec.task.runtime.image = args.image
+    if args.force:
+        spec.task.force_update += 1
+    updated = ctl.update_service(s.id, s.meta.version, spec)
+    print(updated.id)
+
+
+def cmd_service_rm(args):
+    ctl = _control(args)
+    s = _find_service(ctl, args.service)
+    ctl.remove_service(s.id)
+    print(s.id)
+
+
+def cmd_service_scale(args):
+    name, _, n = args.target.partition("=")
+    if not n.isdigit():
+        _die("usage: service scale <name>=<replicas>")
+    ctl = _control(args)
+    s = _find_service(ctl, name)
+    s.spec.replicas = int(n)
+    ctl.update_service(s.id, s.meta.version, s.spec)
+    print(f"{name} scaled to {n}")
+
+
+# --------------------------------------------------------------------- task
+
+def cmd_task_ls(args):
+    from ..controlapi.control import ListFilters
+
+    ctl = _control(args)
+    filters = None
+    if args.service:
+        svc = _find_service(ctl, args.service)
+        filters = ListFilters(service_ids=[svc.id])
+    nodes = {n.id: (n.description.hostname if n.description else n.id[:8])
+             for n in ctl.list_nodes()}
+    rows = []
+    for t in sorted(ctl.list_tasks(filters),
+                    key=lambda t: (t.service_id, t.slot)):
+        rows.append([
+            _short(t.id), t.annotations.name or f"slot.{t.slot}",
+            _state_name(t.status.state), _state_name(t.desired_state),
+            nodes.get(t.node_id, t.node_id[:8] if t.node_id else "-"),
+            t.status.err or "",
+        ])
+    print(_fmt_table(rows, ["ID", "NAME", "STATE", "DESIRED", "NODE", "ERR"]))
+
+
+# --------------------------------------------------------------------- node
+
+def cmd_node_ls(args):
+    ctl = _control(args)
+    rows = []
+    for n in sorted(ctl.list_nodes(), key=lambda n: n.id):
+        ms = n.manager_status
+        rows.append([
+            _short(n.id),
+            n.description.hostname if n.description else "",
+            _state_name(n.status.state),
+            getattr(n.spec.availability, "name", "active").lower(),
+            ("leader" if ms and ms.leader else
+             "reachable" if ms and ms.addr else ""),
+        ])
+    print(_fmt_table(rows,
+                     ["ID", "HOSTNAME", "STATUS", "AVAILABILITY", "MANAGER"]))
+
+
+def cmd_node_inspect(args):
+    import json
+
+    ctl = _control(args)
+    n = _find_node(ctl, args.node)
+    print(json.dumps({
+        "id": n.id,
+        "hostname": n.description.hostname if n.description else None,
+        "role": getattr(n.role, "name", str(n.role)).lower(),
+        "desired_role": getattr(n.spec.desired_role, "name",
+                                str(n.spec.desired_role)).lower(),
+        "status": _state_name(n.status.state),
+        "availability": getattr(n.spec.availability, "name", "active").lower(),
+        "manager": ({"addr": n.manager_status.addr,
+                     "leader": n.manager_status.leader,
+                     "raft_id": n.manager_status.raft_id}
+                    if n.manager_status else None),
+    }, indent=2))
+
+
+def _set_node(args, mutate):
+    ctl = _control(args)
+    n = _find_node(ctl, args.node)
+    mutate(n.spec)
+    ctl.update_node(n.id, n.meta.version, n.spec)
+    print(n.id)
+
+
+def cmd_node_promote(args):
+    from ..api.types import NodeRole
+
+    _set_node(args, lambda spec: setattr(spec, "desired_role",
+                                         NodeRole.MANAGER))
+
+
+def cmd_node_demote(args):
+    from ..api.types import NodeRole
+
+    _set_node(args, lambda spec: setattr(spec, "desired_role",
+                                         NodeRole.WORKER))
+
+
+def cmd_node_drain(args):
+    from ..api.types import NodeAvailability
+
+    _set_node(args, lambda spec: setattr(spec, "availability",
+                                         NodeAvailability.DRAIN))
+
+
+def cmd_node_activate(args):
+    from ..api.types import NodeAvailability
+
+    _set_node(args, lambda spec: setattr(spec, "availability",
+                                         NodeAvailability.ACTIVE))
+
+
+def cmd_node_rm(args):
+    ctl = _control(args)
+    n = _find_node(ctl, args.node)
+    ctl.remove_node(n.id, force=args.force)
+    print(n.id)
+
+
+# ------------------------------------------------------------------ cluster
+
+def cmd_cluster_inspect(args):
+    import json
+
+    ctl = _control(args)
+    clusters = ctl.list_clusters()
+    out = []
+    for c in clusters:
+        out.append({
+            "id": c.id,
+            "name": c.spec.annotations.name,
+            "worker_join_token": (c.root_ca.join_token_worker
+                                  if c.root_ca else None),
+            "manager_join_token": (c.root_ca.join_token_manager
+                                   if c.root_ca else None),
+        })
+    print(json.dumps(out, indent=2))
+
+
+# ------------------------------------------------------------ secret/config
+
+def _read_data(args) -> bytes:
+    if args.data is not None:
+        return args.data.encode()
+    return sys.stdin.buffer.read()
+
+
+def cmd_secret_create(args):
+    from ..api.specs import Annotations, SecretSpec
+
+    ctl = _control(args)
+    s = ctl.create_secret(SecretSpec(annotations=Annotations(name=args.name),
+                                     data=_read_data(args)))
+    print(s.id)
+
+
+def cmd_secret_ls(args):
+    ctl = _control(args)
+    rows = [[_short(s.id), s.spec.annotations.name, len(s.spec.data)]
+            for s in ctl.list_secrets()]
+    print(_fmt_table(rows, ["ID", "NAME", "BYTES"]))
+
+
+def cmd_secret_rm(args):
+    from ..controlapi.control import ListFilters
+
+    ctl = _control(args)
+    secrets = ctl.list_secrets(ListFilters(names=[args.name]))
+    if not secrets:
+        _die(f"secret {args.name!r} not found")
+    ctl.remove_secret(secrets[0].id)
+    print(secrets[0].id)
+
+
+def cmd_config_create(args):
+    from ..api.specs import Annotations, ConfigSpec
+
+    ctl = _control(args)
+    c = ctl.create_config(ConfigSpec(annotations=Annotations(name=args.name),
+                                     data=_read_data(args)))
+    print(c.id)
+
+
+def cmd_config_ls(args):
+    ctl = _control(args)
+    rows = [[_short(c.id), c.spec.annotations.name, len(c.spec.data)]
+            for c in ctl.list_configs()]
+    print(_fmt_table(rows, ["ID", "NAME", "BYTES"]))
+
+
+def cmd_config_rm(args):
+    from ..controlapi.control import ListFilters
+
+    ctl = _control(args)
+    configs = ctl.list_configs(ListFilters(names=[args.name]))
+    if not configs:
+        _die(f"config {args.name!r} not found")
+    ctl.remove_config(configs[0].id)
+    print(configs[0].id)
+
+
+# --------------------------------------------------------------------- logs
+
+def cmd_logs(args):
+    from ..logbroker.broker import LogSelector
+    from ..rpc.client import RPCClient
+    from ..store.watch import ChannelClosed
+
+    ctl = _control(args)
+    svc = _find_service(ctl, args.service)
+    client = RPCClient(args.addr, security=_load_identity(args.identity))
+    ch = client.stream("logs.subscribe",
+                       LogSelector(service_ids=[svc.id]), follow=args.follow)
+    try:
+        while True:
+            try:
+                msg = ch.get(timeout=1.0)
+            except TimeoutError:
+                if not args.follow:
+                    break
+                continue
+            except ChannelClosed:
+                break
+            data = msg.data.decode(errors="replace") if msg.data else ""
+            task = msg.context.task_id[:8] if msg.context else "?"
+            print(f"{task} | {data}")
+    except KeyboardInterrupt:
+        pass
+    finally:
+        client.close()
+
+
+# --------------------------------------------------------------------- main
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="swarmctl")
+    ap.add_argument("--addr", default=os.environ.get("SWARMCTL_ADDR"),
+                    help="manager RPC address (host:port)")
+    ap.add_argument("--identity",
+                    default=os.environ.get("SWARMCTL_IDENTITY"),
+                    help="node state dir holding cert.pem/key.json/ca.pem")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    # service
+    svc = sub.add_parser("service").add_subparsers(dest="sub", required=True)
+    p = svc.add_parser("create")
+    p.add_argument("--name", required=True)
+    p.add_argument("--image", default=None)
+    p.add_argument("--command", default=None,
+                   help="shell-quoted command to run (subprocess executor)")
+    p.add_argument("--replicas", type=int, default=1)
+    p.add_argument("--mode", default="replicated",
+                   choices=["replicated", "global", "replicated_job",
+                            "global_job"])
+    p.add_argument("--constraint", action="append")
+    p.add_argument("--label", action="append")
+    p.add_argument("--env", action="append")
+    p.add_argument("--update-parallelism", type=int, default=None)
+    p.add_argument("--update-delay", type=float, default=None)
+    p.set_defaults(func=cmd_service_create)
+    p = svc.add_parser("ls")
+    p.set_defaults(func=cmd_service_ls)
+    p = svc.add_parser("inspect")
+    p.add_argument("service")
+    p.set_defaults(func=cmd_service_inspect)
+    p = svc.add_parser("update")
+    p.add_argument("service")
+    p.add_argument("--replicas", type=int, default=None)
+    p.add_argument("--command", default=None)
+    p.add_argument("--image", default=None)
+    p.add_argument("--force", action="store_true")
+    p.set_defaults(func=cmd_service_update)
+    p = svc.add_parser("rm")
+    p.add_argument("service")
+    p.set_defaults(func=cmd_service_rm)
+    p = svc.add_parser("scale")
+    p.add_argument("target", help="<service>=<replicas>")
+    p.set_defaults(func=cmd_service_scale)
+
+    # task
+    task = sub.add_parser("task").add_subparsers(dest="sub", required=True)
+    p = task.add_parser("ls")
+    p.add_argument("--service", default=None)
+    p.set_defaults(func=cmd_task_ls)
+
+    # node
+    node = sub.add_parser("node").add_subparsers(dest="sub", required=True)
+    p = node.add_parser("ls")
+    p.set_defaults(func=cmd_node_ls)
+    p = node.add_parser("inspect")
+    p.add_argument("node")
+    p.set_defaults(func=cmd_node_inspect)
+    for name, fn in (("promote", cmd_node_promote),
+                     ("demote", cmd_node_demote),
+                     ("drain", cmd_node_drain),
+                     ("activate", cmd_node_activate)):
+        p = node.add_parser(name)
+        p.add_argument("node")
+        p.set_defaults(func=fn)
+    p = node.add_parser("rm")
+    p.add_argument("node")
+    p.add_argument("--force", action="store_true")
+    p.set_defaults(func=cmd_node_rm)
+
+    # cluster
+    cluster = sub.add_parser("cluster").add_subparsers(dest="sub",
+                                                       required=True)
+    p = cluster.add_parser("inspect")
+    p.set_defaults(func=cmd_cluster_inspect)
+
+    # secret / config
+    sec = sub.add_parser("secret").add_subparsers(dest="sub", required=True)
+    p = sec.add_parser("create")
+    p.add_argument("name")
+    p.add_argument("--data", default=None,
+                   help="literal value (default: read stdin)")
+    p.set_defaults(func=cmd_secret_create)
+    p = sec.add_parser("ls")
+    p.set_defaults(func=cmd_secret_ls)
+    p = sec.add_parser("rm")
+    p.add_argument("name")
+    p.set_defaults(func=cmd_secret_rm)
+
+    cfg = sub.add_parser("config").add_subparsers(dest="sub", required=True)
+    p = cfg.add_parser("create")
+    p.add_argument("name")
+    p.add_argument("--data", default=None)
+    p.set_defaults(func=cmd_config_create)
+    p = cfg.add_parser("ls")
+    p.set_defaults(func=cmd_config_ls)
+    p = cfg.add_parser("rm")
+    p.add_argument("name")
+    p.set_defaults(func=cmd_config_rm)
+
+    # logs
+    p = sub.add_parser("logs")
+    p.add_argument("service")
+    p.add_argument("--follow", "-f", action="store_true")
+    p.set_defaults(func=cmd_logs)
+
+    args = ap.parse_args(argv)
+    if not args.addr:
+        _die("--addr (or SWARMCTL_ADDR) is required")
+    if not args.identity:
+        _die("--identity (or SWARMCTL_IDENTITY) is required")
+    args.func(args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

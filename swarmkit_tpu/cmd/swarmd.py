@@ -83,14 +83,26 @@ def main(argv=None) -> int:
     log.info("node %s up (role=%s, addr=%s)", node.node_id,
              "manager" if node.manager is not None else "worker", node.addr)
     if node.manager is not None and node.join_addr is None:
-        # freshly bootstrapped cluster: print tokens for joiners
-        cluster = node.store.view(
-            lambda tx: tx.get_cluster(node.manager.cluster_id))
+        # freshly bootstrapped cluster: print tokens for joiners. Cluster
+        # seeding runs on the manager leadership thread — wait for it.
+        import time
+
+        cluster = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            cluster = node.store.view(
+                lambda tx: tx.get_cluster(node.manager.cluster_id))
+            if cluster is not None and cluster.root_ca is not None:
+                break
+            time.sleep(0.2)
         if cluster is not None and cluster.root_ca is not None:
             print(f"SWARM_MANAGER_TOKEN={cluster.root_ca.join_token_manager}",
                   flush=True)
             print(f"SWARM_WORKER_TOKEN={cluster.root_ca.join_token_worker}",
                   flush=True)
+        else:
+            log.warning("cluster object not seeded after 30s; "
+                        "join tokens unavailable")
     print(f"SWARM_NODE_READY addr={node.addr or ''} id={node.node_id}",
           flush=True)
 

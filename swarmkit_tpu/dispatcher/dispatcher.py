@@ -131,12 +131,17 @@ class Dispatcher:
             return
         candidates = [n.id for n in nodes
                       if n.status.state == NodeStatusState.READY]
-        if not candidates:
+        # nodes a PREVIOUS leader demoted to UNKNOWN whose grace timer died
+        # with it: they need a timer here too, or they hang UNKNOWN forever
+        inherited = [n.id for n in nodes
+                     if n.status.state == NodeStatusState.UNKNOWN]
+        if not candidates and not inherited:
             return
         demoted: list[str] = []
 
         def cb(tx):
             demoted.clear()
+            demoted.extend(inherited)
             # the live-session check runs INSIDE the txn: a register() that
             # lands between the snapshot above and this write must keep its
             # READY (the RPC plane serves register as soon as raft elects,

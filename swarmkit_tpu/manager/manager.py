@@ -204,7 +204,9 @@ class Manager:
                 except queue.Empty:
                     break
                 if nxt is None:
-                    self._apply_leadership(item)
+                    # shutdown wins over any queued transition: stop() will
+                    # apply False itself; becoming leader mid-shutdown would
+                    # start components nobody stops
                     return
                 item = nxt
             self._apply_leadership(item)
@@ -280,6 +282,10 @@ class Manager:
                 not self.ca_server.root.can_sign
                 or stored.digest() != self.ca_server.root.digest()):
             self.ca_server.root = stored
+        # _load_root_from_store also resolved the real cluster id (a joined
+        # manager constructed with a random one before raft caught up) —
+        # the CAServer must look up join tokens under the same id
+        self.ca_server.cluster_id = self.cluster_id
 
     def _become_follower(self):
         """manager.go becomeFollower — tear down leader-only components."""

@@ -75,6 +75,11 @@ class NodeError(Exception):
     pass
 
 
+class TrustPinMismatch(NodeError):
+    """The fetched root CA does not match the join token's digest pin —
+    never retried (it is an attack or a wrong token, not a flake)."""
+
+
 def fetch_root_cert(addr: str, expected_digest: str,
                     timeout: float = 10.0) -> bytes:
     """Download the cluster root CA over an *unauthenticated* TLS connection
@@ -99,7 +104,7 @@ def fetch_root_cert(addr: str, expected_digest: str,
     cert_pem = payload
     got = hashlib.sha256(cert_pem).hexdigest()
     if got != expected_digest:
-        raise NodeError(
+        raise TrustPinMismatch(
             f"remote root CA digest {got[:16]}… does not match the join "
             f"token pin {expected_digest[:16]}… — refusing to join")
     return cert_pem
@@ -208,15 +213,10 @@ class SwarmNode:
         self._save_state(node_id=self.security.node_id())
 
     def _load_identity(self) -> SecurityConfig | None:
-        _state, cert_path, ca_path, key_path = self._paths()
+        _state, cert_path, _ca_path, key_path = self._paths()
         if not (os.path.exists(cert_path) and os.path.exists(key_path)):
             return None
-        with open(ca_path, "rb") as f:
-            root = RootCA(f.read())
-        with open(cert_path, "rb") as f:
-            cert_pem = f.read()
-        key_pem, _headers = KeyReadWriter(key_path, self.kek).read()
-        return SecurityConfig(root, key_pem, cert_pem)
+        return SecurityConfig.load_from_dir(self.state_dir, self.kek)
 
     def _obtain_identity(self) -> SecurityConfig:
         loaded = self._load_identity()
@@ -228,27 +228,54 @@ class SwarmNode:
         if not self.join_token:
             raise NodeError("joining an existing cluster requires a join token")
         parsed = parse_join_token(self.join_token)
-        seed = self.join_addr.split(",")[0].strip()
-        root_pem = fetch_root_cert(seed, parsed.root_digest)
+        seeds = [a.strip() for a in self.join_addr.split(",") if a.strip()]
+        root_pem = None
         node_id = new_id()
-        key_pem, csr_pem = create_csr(node_id, NodeRole.WORKER, self.org)
-        ca = RemoteCA(seed, root_cert_pem=root_pem)
-        try:
-            node_id = ca.issue_node_certificate(
-                csr_pem, token=self.join_token, node_id=node_id)
-            cert = ca.node_certificate_status(node_id, timeout=30)
-        finally:
-            ca.close()
-        if cert is None or cert.status_state != IssuanceState.ISSUED:
-            raise NodeError("certificate issuance failed: "
-                            f"{getattr(cert, 'status_err', 'timeout')}")
-        return SecurityConfig(RootCA(root_pem), key_pem, cert.certificate_pem)
+        key_pem = csr_pem = None
+        # the CSR flow must survive transient cluster states — an election
+        # in flight, a follower that doesn't know the leader yet
+        # (ca/certificates.go GetRemoteSignedCertificate retries w/ backoff)
+        deadline = time.monotonic() + JOIN_TIMEOUT * 2
+        last: Exception | None = None
+        while time.monotonic() < deadline:
+            for seed in seeds:
+                try:
+                    if root_pem is None:
+                        root_pem = fetch_root_cert(seed, parsed.root_digest)
+                    if csr_pem is None:
+                        key_pem, csr_pem = create_csr(
+                            node_id, NodeRole.WORKER, self.org)
+                    ca = RemoteCA(seed, root_cert_pem=root_pem)
+                    try:
+                        node_id = ca.issue_node_certificate(
+                            csr_pem, token=self.join_token, node_id=node_id)
+                        cert = ca.node_certificate_status(node_id, timeout=30)
+                    finally:
+                        ca.close()
+                    if cert is not None and \
+                            cert.status_state == IssuanceState.ISSUED:
+                        return SecurityConfig(RootCA(root_pem), key_pem,
+                                              cert.certificate_pem)
+                    last = NodeError(
+                        "issuance failed: "
+                        f"{getattr(cert, 'status_err', 'timeout')}")
+                except TrustPinMismatch:
+                    raise  # never retry a trust failure
+                except Exception as exc:
+                    last = exc
+            if self._stop.wait(JOIN_RETRY):
+                break
+        raise NodeError(f"certificate issuance failed: {last}")
 
     # ------------------------------------------------------------ lifecycle
 
     def start(self):
         self.security = self._obtain_identity()
         self._save_identity()
+        # renewed certs / rotated roots must survive a restart: persist on
+        # every credential swap (ca/certificates.go
+        # RequestAndSaveNewCertificates — "AndSave" is load-bearing)
+        self.security.watch(lambda _sec: self._save_identity())
         if self.security.role() == NodeRole.MANAGER:
             self._start_manager()
         else:
@@ -511,9 +538,10 @@ class SwarmNode:
         through leadership churn; re-announce on every leadership change so
         a recovered cluster re-learns addresses."""
         node_id = self.security.node_id()
-        announced = False
+        announced_leader = None  # raft leader id the announce landed under
         while not self._stop.is_set():
-            if not announced:
+            leader = self.raft.leader_id if self.raft is not None else None
+            if leader is not None and leader != announced_leader:
                 try:
                     client = RPCClient(self.advertise_addr,
                                        security=self.security)
@@ -521,13 +549,15 @@ class SwarmNode:
                         client.call("cluster.announce_manager", node_id,
                                     self.advertise_addr, self.raft_id,
                                     timeout=10.0)
-                        announced = True
+                        announced_leader = leader
                     finally:
                         client.close()
                 except Exception:
                     pass
-            if self._stop.wait(ANNOUNCE_RETRY if not announced else
-                               self.manager_refresh_interval):
+            done = announced_leader is not None \
+                and announced_leader == leader
+            if self._stop.wait(self.manager_refresh_interval if done
+                               else ANNOUNCE_RETRY):
                 return
 
     # -------------------------------------------------------- worker stack

@@ -195,9 +195,17 @@ class RPCServer:
     def _handle_request(self, conn, wlock, caller: Caller | None,
                         stream_id: int, method: str, payload, cancels):
         def reply_err(exc: Exception):
-            name = type(exc).__name__
+            from .wire import RPCError
+
+            if isinstance(exc, RPCError):
+                # forwarded-hop error: preserve the ORIGINAL name so the
+                # caller's retry/translation logic sees e.g. NotLeaderError,
+                # not a double-wrapped "RPCError"
+                name, msg = exc.name, exc.message
+            else:
+                name, msg = type(exc).__name__, str(exc)
             try:
-                send_frame(conn, wlock, [ERR, stream_id, name, str(exc)])
+                send_frame(conn, wlock, [ERR, stream_id, name, msg])
             except (OSError, ValueError):
                 pass
 

@@ -210,6 +210,20 @@ def build_manager_registry(manager, raft_node=None,
             node.manager_status.raft_id = raft_id
             node.manager_status.reachability = "reachable"
             tx.update(node)
+            # reconcile every manager's leader flag from raft's view —
+            # announces re-fire on leadership change, so this keeps
+            # `node ls` pointing at the live leader, not the last bootstrap
+            leader_raft_id = raft_node.leader_id if raft_node else None
+            for other in tx.find_nodes():
+                ms = other.manager_status
+                if ms is None or not ms.raft_id:
+                    continue
+                should_lead = (leader_raft_id is not None
+                               and ms.raft_id == leader_raft_id)
+                if ms.leader != should_lead:
+                    other = other.copy()
+                    other.manager_status.leader = should_lead
+                    tx.update(other)
 
         manager.store.update(txn)
         return None
@@ -561,7 +575,14 @@ class RemoteLogBroker:
 
 
 class RemoteControl:
-    """controlapi.ControlAPI surface over the wire (for swarmctl)."""
+    """controlapi.ControlAPI surface over the wire (for swarmctl).
+
+    A call landing on a manager that knows no leader (election in flight)
+    is retried briefly — the reference's connection broker re-selects a
+    manager instead of surfacing transient NotLeader errors to the CLI."""
+
+    RETRY_WINDOW = 15.0
+    RETRY_PAUSE = 0.5
 
     def __init__(self, addr: str, security):
         self.addr = addr
@@ -576,12 +597,29 @@ class RemoteControl:
             self._client = RPCClient(self.addr, security=self.security)
             return self._client
 
+    @staticmethod
+    def _transient(exc: Exception) -> bool:
+        from .wire import RPCError
+
+        return isinstance(exc, RPCError) and exc.name == "NotLeaderError"
+
     def __getattr__(self, name):
         if name.startswith("_"):
             raise AttributeError(name)
 
         def call(*args, **kwargs):
-            return self._conn().call(f"control.{name}", *args, **kwargs)
+            import time as _time
+
+            deadline = _time.monotonic() + self.RETRY_WINDOW
+            while True:
+                try:
+                    return self._conn().call(f"control.{name}", *args,
+                                             **kwargs)
+                except Exception as exc:
+                    if not self._transient(exc) \
+                            or _time.monotonic() >= deadline:
+                        raise
+                    _time.sleep(self.RETRY_PAUSE)
 
         return call
 

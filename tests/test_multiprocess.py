@@ -72,15 +72,9 @@ class Swarmd:
 
 
 def _load_identity(base, name):
-    from swarmkit_tpu.ca import KeyReadWriter, RootCA, SecurityConfig
+    from swarmkit_tpu.ca import SecurityConfig
 
-    d = os.path.join(base, name)
-    with open(os.path.join(d, "ca.pem"), "rb") as f:
-        root = RootCA(f.read())
-    key_pem, _ = KeyReadWriter(os.path.join(d, "key.json")).read()
-    with open(os.path.join(d, "cert.pem"), "rb") as f:
-        cert_pem = f.read()
-    return SecurityConfig(root, key_pem, cert_pem)
+    return SecurityConfig.load_from_dir(os.path.join(base, name))
 
 
 def test_multiprocess_cluster_survives_leader_sigkill(tmp_path):
