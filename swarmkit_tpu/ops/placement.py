@@ -32,7 +32,6 @@ from jax import lax
 from ..scheduler.spread import PENALTY_BASE
 
 UNLIMITED = 1 << 30  # plain int: keep module import free of backend init
-_LEVEL_BITS = 24  # binary-search range for the water level; see kernel note
 
 
 def build_static_mask(
@@ -83,45 +82,129 @@ def build_static_mask(
     return ready[None, :] & cons_ok & plat_ok & plug_ok & extra_mask
 
 
-def _water_fill(eligible, capacity, penalty, svc, total, n_tasks):
-    """Closed-form canonical spread fill of one group. All inputs per-node.
+def _segment_sum(data, seg, n):
+    return jax.ops.segment_sum(data, seg, num_segments=n)
 
-    Returns int32[N] counts. Level search runs 2*_LEVEL_BITS fixed bisection
-    steps over [0, 2^24): the primary key k = penalty*2^20 + svc stays below
-    2^21 as long as no single node holds >2^20 active tasks of one service,
-    and k + T < 2^24 for T up to ~6M tasks per group.
+
+def _segment_min(data, seg, n):
+    return jax.ops.segment_min(data, seg, num_segments=n)
+
+
+_POUR_BITS = 30  # water-level search range for branch totals
+
+
+def _segmented_pour(quota_seg, k_child, cap_child, parent_of, valid, n):
+    """Per-parent water fill over child segments (the branch-level split of
+    scheduler.go:772-822 in closed form).
+
+    All arrays are child-indexed ([n], ids padded to n); `quota_seg` is
+    parent-indexed. Children of one parent occupy a CONTIGUOUS child-id
+    range (the encoder ranks value-path prefixes lexicographically), which
+    makes the remainder rank a cumsum minus a per-parent offset.
+    Returns per-child give, the next level's quotas.
     """
-    N = eligible.shape[0]
-    # Clamp per-node capacity by the group's task count: a node can never
-    # receive more than n_tasks, and the clamp keeps sum(cap) (and filled())
-    # inside int32 — the kernel's documented bound is n_tasks × N < 2^31.
-    cap = jnp.minimum(jnp.where(eligible, capacity, 0), n_tasks).astype(jnp.int32)
-    k = (jnp.where(penalty, PENALTY_BASE, 0) + svc).astype(jnp.int32)
-    total_cap = jnp.sum(cap)
-    T = jnp.minimum(n_tasks, total_cap).astype(jnp.int32)
+    cap = jnp.where(valid, cap_child, 0).astype(jnp.int32)
+    cap_parent = _segment_sum(cap, parent_of, n)
+    q = jnp.minimum(quota_seg, cap_parent)                      # per parent
 
-    def filled(L):
-        return jnp.sum(jnp.minimum(cap, jnp.maximum(0, L - k)))
+    def filled(lp):
+        f = jnp.minimum(cap, jnp.maximum(0, lp[parent_of] - k_child))
+        return _segment_sum(f, parent_of, n)
 
-    # largest L with filled(L) <= T
     def bisect(state, _):
         lo, hi = state
-        mid = (lo + hi + 1) // 2
-        take = filled(mid) <= T
+        mid = lo + (hi - lo + 1) // 2  # overflow-free upper midpoint
+        take = filled(mid) <= q
         return (jnp.where(take, mid, lo), jnp.where(take, hi, mid - 1)), None
 
-    (L, _), _ = lax.scan(bisect, (jnp.int32(0), jnp.int32(1 << _LEVEL_BITS)),
-                         None, length=_LEVEL_BITS + 1)
-    counts = jnp.minimum(cap, jnp.maximum(0, L - k))
-    rem = T - jnp.sum(counts)
+    (level, _), _ = lax.scan(
+        bisect,
+        (jnp.zeros(n, jnp.int32), jnp.full(n, 1 << _POUR_BITS, jnp.int32)),
+        None, length=_POUR_BITS + 1)
+    give = jnp.minimum(cap, jnp.maximum(0, level[parent_of] - k_child))
+    give = jnp.where(valid, give, 0)
+    rem = q - _segment_sum(give, parent_of, n)                  # per parent
+    boundary = valid & (cap > give) & (k_child <= level[parent_of]) \
+        & (give == level[parent_of] - k_child)
+    b32 = boundary.astype(jnp.int32)
+    cum = jnp.cumsum(b32) - b32                                 # exclusive
+    offset = _segment_min(jnp.where(valid, cum, 1 << 30), parent_of, n)
+    rank = cum - offset[parent_of]
+    extra = boundary & (rank < rem[parent_of])
+    return give + extra.astype(jnp.int32)
 
-    # boundary slots at primary == L, ordered by (total+counts, node_idx)
-    boundary = eligible & (cap > counts) & (k <= L) & (counts == L - k)
-    sec = jnp.where(boundary, total + counts, UNLIMITED)
+
+def _tree_water_fill(eligible, capacity, penalty, svc, total, n_tasks,
+                     spread_rank):
+    """Hierarchical canonical spread fill of one group.
+
+    spread_rank: int32[LMAX, N] branch ids per level (prefix ranks). The
+    quota pours down the levels — each a `_segmented_pour` over branch
+    aggregates (existing totals count ALL branch nodes, capacity only
+    eligible ones, nodeset.go:88-104) — and the last pour places nodes
+    within their leaf branch under the flat canonical order
+    (penalty, svc, total, node_idx). LMAX == 0 degenerates to the flat
+    fill (single segment). Bit-identical to spread.tree_fill.
+    """
+    N = eligible.shape[0]
+    lmax = spread_rank.shape[0]
+    cap = jnp.minimum(jnp.where(eligible, capacity, 0), n_tasks) \
+        .astype(jnp.int32)
     idx = jnp.arange(N, dtype=jnp.int32)
-    order = jnp.lexsort((idx, sec))
-    rank = jnp.zeros(N, jnp.int32).at[order].set(idx)
-    extra = boundary & (rank < rem)
+    zeros = jnp.zeros(N, jnp.int32)
+
+    # ---- branch levels: pour the root quota down the prefix tree --------
+    parent_seg = zeros                     # level -1: a single root segment
+    quota_seg = zeros.at[0].set(jnp.minimum(n_tasks, jnp.sum(cap)))
+    for li in range(lmax):
+        seg = spread_rank[li]                                   # [N] per node
+        # child aggregates (child id = segment id at this level)
+        k_child = _segment_sum(svc.astype(jnp.int32), seg, N)
+        cap_child = _segment_sum(cap, seg, N)
+        node_count = _segment_sum(jnp.ones(N, jnp.int32), seg, N)
+        valid = node_count > 0
+        parent_of = _segment_min(parent_seg, seg, N)            # nesting
+        parent_of = jnp.where(valid, parent_of, 0)
+        quota_seg = _segmented_pour(quota_seg, k_child, cap_child,
+                                    parent_of, valid, N)
+        parent_seg = seg
+
+    # ---- node level: fill within each leaf branch -----------------------
+    leaf = parent_seg
+    k_node = (jnp.where(penalty, PENALTY_BASE, 0) + svc).astype(jnp.int32)
+
+    def filled(lp):
+        f = jnp.minimum(cap, jnp.maximum(0, lp[leaf] - k_node))
+        return _segment_sum(f, leaf, N)
+
+    q = jnp.minimum(quota_seg, _segment_sum(cap, leaf, N))
+
+    def bisect(state, _):
+        lo, hi = state
+        mid = lo + (hi - lo + 1) // 2  # overflow-free upper midpoint
+        take = filled(mid) <= q
+        return (jnp.where(take, mid, lo), jnp.where(take, hi, mid - 1)), None
+
+    (level, _), _ = lax.scan(
+        bisect,
+        (jnp.zeros(N, jnp.int32), jnp.full(N, 1 << _POUR_BITS, jnp.int32)),
+        None, length=_POUR_BITS + 1)
+    counts = jnp.minimum(cap, jnp.maximum(0, level[leaf] - k_node))
+    rem = q - _segment_sum(counts, leaf, N)                     # per leaf
+    boundary = (cap > counts) & (k_node <= level[leaf]) \
+        & (counts == level[leaf] - k_node)
+    # remainder rank within leaf by (secondary, node idx): nodes of a leaf
+    # are NOT contiguous — order by (leaf, sec, idx), exclusive-cumsum the
+    # boundary flags, subtract each leaf's offset, scatter back
+    sec = jnp.where(boundary, total + counts, (1 << 30))
+    order = jnp.lexsort((idx, sec, leaf))
+    b_sorted = boundary[order].astype(jnp.int32)
+    cum = jnp.cumsum(b_sorted) - b_sorted
+    leaf_sorted = leaf[order]
+    offset = _segment_min(cum, leaf_sorted, N)
+    rank_sorted = cum - offset[leaf_sorted]
+    rank = jnp.zeros(N, jnp.int32).at[order].set(rank_sorted)
+    extra = boundary & (rank < rem[leaf])
     return counts + extra.astype(jnp.int32)
 
 
@@ -140,6 +223,7 @@ def schedule_groups(
     has_ports,      # bool[G]
     group_ports,    # bool[G, PV]
     port_used0,     # bool[N, PV]
+    spread_rank,    # int32[G, LMAX, N]; LMAX may be 0 (no preferences)
     unroll: int = 1,
 ):
     """Schedule every group sequentially (groups interact through node state),
@@ -151,7 +235,8 @@ def schedule_groups(
 
     def step(carry, xs):
         totals, svc_counts, avail, port_used = carry
-        g_mask, g_need, g_ntasks, g_svc, g_maxrep, g_pen, g_hasports, g_ports = xs
+        (g_mask, g_need, g_ntasks, g_svc, g_maxrep, g_pen, g_hasports,
+         g_ports, g_spread) = xs
 
         svc = svc_counts[g_svc]                                    # [N]
 
@@ -169,7 +254,8 @@ def schedule_groups(
         cap = jnp.clip(jnp.minimum(jnp.minimum(cap_res, cap_mr), cap_port),
                        0, UNLIMITED)
 
-        counts = _water_fill(g_mask, cap, g_pen, svc, totals, g_ntasks)
+        counts = _tree_water_fill(g_mask, cap, g_pen, svc, totals, g_ntasks,
+                                  g_spread)
 
         totals = totals + counts
         svc_counts = svc_counts.at[g_svc].add(counts)
@@ -181,7 +267,7 @@ def schedule_groups(
         step,
         (total0, svc_count0, avail_res, port_used0),
         (static_mask, need_res, n_tasks, svc_idx, max_replicas,
-         penalty, has_ports, group_ports),
+         penalty, has_ports, group_ports, spread_rank),
         unroll=unroll,
     )
     return counts, totals, svc_counts

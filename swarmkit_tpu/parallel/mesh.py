@@ -42,6 +42,9 @@ _FIELD_SHARDING: dict[str, tuple[int | None, object]] = {
     "has_ports": (None, 0),
     "group_ports": (None, 0),
     "port_used0": (0, False),
+    # phantom pad nodes fall into segment 0 with zero capacity and zero
+    # service counts — invisible to every pour
+    "spread_rank": (2, 0),
 }
 
 

@@ -16,7 +16,7 @@ from __future__ import annotations
 import numpy as np
 
 from .encode import UNLIMITED, EncodedProblem
-from .spread import GroupFill, greedy_fill, slot_order
+from .spread import GroupFill, greedy_fill, slot_order, tree_fill
 
 
 def _group_caps(p: EncodedProblem, gi: int, avail: np.ndarray,
@@ -83,7 +83,13 @@ def cpu_schedule_encoded(p: EncodedProblem) -> np.ndarray:
             svc_count=svc.tolist(),
             total_count=totals.tolist(),
         )
-        counts = np.array(greedy_fill(g), np.int32)
+        lmax = 0 if p.spread_rank is None else p.spread_rank.shape[1]
+        if lmax:
+            level_ranks = [p.spread_rank[gi, li].tolist()
+                           for li in range(lmax)]
+            counts = np.array(tree_fill(g, level_ranks), np.int32)
+        else:
+            counts = np.array(greedy_fill(g), np.int32)
         out[gi] = counts
         totals += counts
         svc_counts[p.svc_idx[gi]] += counts

@@ -104,19 +104,25 @@ class EncodedProblem:
     group_ports: np.ndarray = None    # bool[G, PV]
     penalty: np.ndarray = None        # bool[G, N]
     extra_mask: np.ndarray = None     # bool[G, N] host-side corrections
+    # spread preferences (nodeset.go tree): node's branch id per level —
+    # contiguous ranks of the label-value PATH PREFIX, lexicographically
+    # sorted, so children of one parent occupy a contiguous id range;
+    # levels past a group's preference count repeat the last real level
+    # (a self-parented pour is a no-op)
+    spread_rank: np.ndarray = None    # int32[G, LMAX, N]; LMAX may be 0
 
 
 _INT32_MAX = (1 << 31) - 1
 
 
 # Canonical positional order of EncodedProblem arrays as consumed by
-# ops.placement.schedule_groups — the ONE place the 19-arg contract lives;
-# bench, the graft entry, and the mesh sharder all derive from it.
+# ops.placement.schedule_groups — the ONE place the positional-arg contract
+# lives; bench, the graft entry, and the mesh sharder all derive from it.
 KERNEL_ARG_FIELDS = (
     "ready", "node_val", "node_plat", "node_plugins", "extra_mask",
     "constraints", "plat_req", "req_plugins", "avail_res", "total0",
     "svc_count0", "n_tasks", "svc_idx", "need_res", "max_replicas",
-    "penalty", "has_ports", "group_ports", "port_used0",
+    "penalty", "has_ports", "group_ports", "port_used0", "spread_rank",
 )
 
 
@@ -363,6 +369,68 @@ def encode(
         for pid in group_port_lists[gi]:
             p.group_ports[gi, pid] = True
         p.has_ports[gi] = bool(group_port_lists[gi])
+
+    # ------------------------------------------------- spread preferences
+    # (nodeset.go:50-124) resolve each group's spread descriptors to label
+    # lookups; a non-label descriptor is skipped without consuming a level,
+    # and a missing label buckets the node under "" (its own branch)
+    def _spread_labels(g: TaskGroup) -> list[tuple[str, str]]:
+        out = []
+        for pref in g.spec.placement.preferences:
+            d = pref.spread_descriptor
+            dl = d.lower()
+            for prefix, kind in ((constraint_mod.NODE_LABEL_PREFIX, "node"),
+                                 (constraint_mod.ENGINE_LABEL_PREFIX,
+                                  "engine")):
+                if dl.startswith(prefix) and len(d) > len(prefix):
+                    out.append((kind, d[len(prefix):]))
+                    break
+        return out
+
+    group_spread = [_spread_labels(g) for g in groups]
+    LMAX = max((len(s) for s in group_spread), default=0)
+    p.spread_rank = np.zeros((G, LMAX, N), np.int32)
+    if LMAX:
+        # a node's value for a (kind, label) is group-independent: intern
+        # each distinct label column ONCE as an int array, then rank value
+        # paths per (group, level) in numpy — keeps host work O(N) per
+        # distinct label, not O(G × L × N) Python loops
+        label_ids: dict[tuple[str, str], np.ndarray] = {}
+
+        def label_col(kind: str, label: str) -> np.ndarray:
+            col = label_ids.get((kind, label))
+            if col is not None:
+                return col
+            values = []
+            for info in node_infos:
+                node = info.node
+                if kind == "node":
+                    labels = node.spec.annotations.labels or {}
+                else:
+                    desc = node.description
+                    labels = (desc.engine_labels or {}) if desc else {}
+                values.append(labels.get(label, ""))
+            # ids ordered by value string => prefix ranks sort
+            # lexicographically level by level
+            uniq = sorted(set(values))
+            to_id = {v: i for i, v in enumerate(uniq)}
+            col = np.array([to_id[v] for v in values], np.int32)
+            label_ids[(kind, label)] = col
+            return col
+
+        for gi, spread in enumerate(group_spread):
+            if not spread:
+                continue
+            prefix = np.zeros(N, np.int64)
+            for li, (kind, label) in enumerate(spread):
+                col = label_col(kind, label)
+                combo = prefix * (int(col.max()) + 1) + col
+                # contiguous ranks preserving (prefix, value) order
+                _, ranks = np.unique(combo, return_inverse=True)
+                p.spread_rank[gi, li] = ranks.astype(np.int32)
+                prefix = ranks.astype(np.int64)
+            for li in range(len(spread), LMAX):
+                p.spread_rank[gi, li] = p.spread_rank[gi, len(spread) - 1]
 
     # penalties: only iterate nodes that actually recorded failures
     for n, info in enumerate(node_infos):

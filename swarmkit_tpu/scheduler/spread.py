@@ -80,6 +80,126 @@ def slot_order(g: GroupFill, counts: list[int]) -> list[int]:
     return [i for _, _, i in slots]
 
 
+def tree_fill(g: GroupFill, level_ranks: list[list[int]]) -> list[int]:
+    """Canonical spread-preference fill (the oracle for the hierarchical
+    kernel).
+
+    Re-derivation of the reference's preference tree walk
+    (manager/scheduler/nodeset.go:50-124 builds a tree bucketing nodes by
+    each preference's label value; scheduler.go:772-822 splits a task group
+    so per-branch service totals equalize). The reference's split is
+    Go-map-order dependent; our canonical semantics (documented, applied
+    identically on CPU and TPU):
+
+      at each level, branches are filled by the SAME water principle as
+      nodes — pour the level's quota so per-branch totals
+      (existing service tasks + newly assigned) equalize, capped by branch
+      capacity, ties broken by branch rank — then recurse per branch; the
+      leaf level is the flat canonical fill over nodes.
+
+    `level_ranks[l][i]` is node i's branch id at level l; branch ids are
+    contiguous ranks of the value-path PREFIX (so equal rank at level l
+    implies equal rank at every level above). Branch totals count the
+    service tasks of ALL of a branch's nodes — even scheduling-ineligible
+    ones (nodeset.go:88-104).
+    """
+    if not level_ranks:
+        return greedy_fill(g)
+    n = len(g.eligible)
+    branch_svc = g.svc_count
+
+    def fill(level: int, node_idx: list[int], quota: int) -> list[tuple[int, int]]:
+        """Returns [(node, count)] with sum(count) <= quota."""
+        if level == len(level_ranks):
+            sub = GroupFill(
+                n_tasks=quota,
+                eligible=[g.eligible[i] for i in node_idx],
+                capacity=[g.capacity[i] for i in node_idx],
+                penalty=[g.penalty[i] for i in node_idx],
+                svc_count=[g.svc_count[i] for i in node_idx],
+                total_count=[g.total_count[i] for i in node_idx],
+            )
+            counts = greedy_fill(sub)
+            return [(node_idx[j], c) for j, c in enumerate(counts) if c]
+
+        ranks = level_ranks[level]
+        branches: dict[int, list[int]] = {}
+        for i in node_idx:
+            branches.setdefault(ranks[i], []).append(i)
+        border = sorted(branches)
+        # branch aggregates: existing totals over ALL branch nodes;
+        # capacity over eligible nodes only
+        k = {b: sum(branch_svc[i] for i in branches[b]) for b in border}
+        cap = {b: sum(g.capacity[i] for i in branches[b]
+                      if g.eligible[i] and g.capacity[i] > 0)
+               for b in border}
+        # pour `quota` over branches: greedy by (current total, rank)
+        give = _pour(quota, [k[b] for b in border], [cap[b] for b in border])
+        out: list[tuple[int, int]] = []
+        for rank_pos, b in enumerate(border):
+            q = give[rank_pos]
+            if q > 0:
+                out.extend(fill(level + 1, branches[b], q))
+        return out
+
+    pairs = fill(0, list(range(n)), g.n_tasks)
+    counts = [0] * n
+    for i, c in pairs:
+        counts[i] += c
+    return counts
+
+
+def _pour(quota: int, totals: list[int], caps: list[int]) -> list[int]:
+    """Equalize: repeatedly give one unit to the smallest (total, index)
+    entry with remaining cap. Greedy form — the branch-level analogue of
+    greedy_fill, provably equal to the closed-form water level."""
+    m = len(totals)
+    give = [0] * m
+    heap = [(totals[j], j) for j in range(m) if caps[j] > 0]
+    heapq.heapify(heap)
+    left = quota
+    while left > 0 and heap:
+        t, j = heapq.heappop(heap)
+        give[j] += 1
+        left -= 1
+        if give[j] < caps[j]:
+            heapq.heappush(heap, (t + 1, j))
+    return give
+
+
+def pour_waterfill(quota: int, totals: list[int], caps: list[int]) -> list[int]:
+    """Closed-form `_pour` (differential test target for the kernel's
+    segmented level fill): counts = min(cap, max(0, L - k)) at the largest
+    L with sum <= quota, remainder to boundary entries by index order."""
+    m = len(totals)
+    if m == 0:
+        return []
+    quota = min(quota, sum(caps))
+    if quota <= 0:
+        return [0] * m
+
+    def filled(L):
+        return sum(min(caps[j], max(0, L - totals[j])) for j in range(m))
+
+    lo, hi = 0, max(totals) + quota + 1
+    while lo < hi:
+        mid = (lo + hi + 1) // 2
+        if filled(mid) <= quota:
+            lo = mid
+        else:
+            hi = mid - 1
+    L = lo
+    give = [min(caps[j], max(0, L - totals[j])) for j in range(m)]
+    rem = quota - sum(give)
+    for j in range(m):
+        if rem <= 0:
+            break
+        if caps[j] > give[j] and totals[j] <= L and give[j] == L - totals[j]:
+            give[j] += 1
+            rem -= 1
+    return give
+
+
 def waterfill_reference(g: GroupFill) -> list[int]:
     """Pure-Python closed-form water-fill — the same math as the TPU kernel,
     kept host-side for differential testing of the kernel itself.

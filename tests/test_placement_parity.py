@@ -75,6 +75,15 @@ def random_group(rng, gi, n_tasks):
     if rng.random() < 0.1:
         choices.append("node.ip != 10.0.3.0/24")
     spec.placement = Placement(constraints=choices)
+    if rng.random() < 0.5:
+        from swarmkit_tpu.api.specs import PlacementPreference
+
+        prefs = [PlacementPreference(
+            spread_descriptor=f"node.labels.{rng.choice(LABEL_KEYS)}")]
+        if rng.random() < 0.4:
+            prefs.append(PlacementPreference(
+                spread_descriptor=f"node.labels.{rng.choice(LABEL_KEYS)}"))
+        spec.placement.preferences = prefs
     if rng.random() < 0.3:
         spec.placement.platforms = [Platform(os="linux", architecture="x86_64")]
     if rng.random() < 0.2:
@@ -95,8 +104,12 @@ def random_cluster(rng, n_nodes=20, n_groups=5, max_tasks=30):
         node = random_node(rng, i)
         avail = node.description.resources.copy()
         info = NodeInfo.new(node, {}, avail)
-        # pre-existing load
+        # pre-existing load, incl. per-service counts (spread-tree totals)
         info.active_tasks_count = rng.randint(0, 5)
+        for gi in range(n_groups):
+            if rng.random() < 0.3:
+                info.active_tasks_count_by_service[f"svc-{gi:03d}"] = \
+                    rng.randint(1, 4)
         infos.append(info)
     groups = [random_group(rng, gi, rng.randint(1, max_tasks))
               for gi in range(n_groups)]

@@ -236,3 +236,33 @@ def test_jax_backend_matches_cpu_end_to_end(store):
         s_jax.stop()
     placement_jax = {t.id: t.node_id for t in store2.view().find_tasks()}
     assert placement_cpu == placement_jax
+
+
+def test_spread_preferences_respected(store):
+    """A service spreading over node.labels.dc splits evenly per DC even
+    when DCs have unequal node counts (nodeset.go tree +
+    scheduler.go:772-822 proportional branch split)."""
+    from swarmkit_tpu.api.specs import PlacementPreference
+
+    def setup(tx):
+        tx.create(ready_node("n-a1", labels={"dc": "a"}))
+        for i in range(3):
+            tx.create(ready_node(f"n-b{i}", labels={"dc": "b"}))
+        for i in range(8):
+            t = pending_task(f"t{i:02d}", slot=i + 1)
+            t.spec.placement = Placement(preferences=[
+                PlacementPreference(spread_descriptor="node.labels.dc")])
+            tx.create(t)
+
+    store.update(setup)
+    s = Scheduler(store)
+    s.start()
+    try:
+        assert wait_for(lambda: all_assigned(store, 8), timeout=10)
+        tasks = store.view(lambda tx: tx.find_tasks())
+        per_dc = {"a": 0, "b": 0}
+        for t in tasks:
+            per_dc["a" if t.node_id == "n-a1" else "b"] += 1
+        assert per_dc == {"a": 4, "b": 4}, per_dc
+    finally:
+        s.stop()

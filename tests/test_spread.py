@@ -109,3 +109,83 @@ def test_slot_order_is_stable_and_complete():
         i for i, c in enumerate(counts) for _ in range(c))
     # first assignment goes to node 1 (svc 0, total 0)
     assert order[0] == 1
+
+
+# -- spread preferences (the decision tree, nodeset.go:50-124) ---------------
+
+
+from swarmkit_tpu.scheduler.spread import (  # noqa: E402
+    _pour,
+    pour_waterfill,
+    tree_fill,
+)
+
+
+def test_pour_greedy_equals_waterfill_random():
+    rng = random.Random(11)
+    for trial in range(400):
+        m = rng.randint(1, 20)
+        totals = [rng.randint(0, 15) for _ in range(m)]
+        caps = [rng.randint(0, 10) for _ in range(m)]
+        quota = rng.randint(0, 60)
+        assert _pour(quota, totals, caps) == pour_waterfill(
+            quota, totals, caps), f"trial {trial}"
+
+
+def _flat(n, **kw):
+    base = dict(
+        n_tasks=0, eligible=[True] * n, capacity=[100] * n,
+        penalty=[False] * n, svc_count=[0] * n, total_count=[0] * n)
+    base.update(kw)
+    return GroupFill(**base)
+
+
+def test_tree_fill_even_split_uneven_branch_sizes():
+    # dc a has 1 node, dc b has 3 — 8 tasks split 4/4 per DC, not 2/2/2/2
+    g = _flat(4, n_tasks=8)
+    ranks = [[0, 1, 1, 1]]
+    assert tree_fill(g, ranks) == [4, 2, 1, 1]
+
+
+def test_tree_fill_compensates_existing_tasks():
+    # branch a already holds 6 service tasks; all 6 new go to branch b
+    g = _flat(2, n_tasks=6, svc_count=[6, 0], total_count=[6, 0])
+    assert tree_fill(g, [[0, 1]]) == [0, 6]
+
+
+def test_tree_fill_capacity_spills_to_other_branch():
+    # branch a can only hold 1; the rest spill to branch b
+    g = _flat(2, n_tasks=6, capacity=[1, 100])
+    assert tree_fill(g, [[0, 1]]) == [1, 5]
+
+
+def test_tree_fill_two_levels():
+    # 2 DCs × 2 racks, 8 tasks -> 2 per (dc, rack) leaf
+    g = _flat(4, n_tasks=8)
+    ranks = [[0, 0, 1, 1],      # dc level
+             [0, 1, 2, 3]]      # rack level (prefix ranks nest)
+    assert tree_fill(g, ranks) == [2, 2, 2, 2]
+
+
+def test_tree_fill_ineligible_nodes_still_count_branch_totals():
+    # an ineligible node's existing tasks weigh its branch down
+    # (nodeset.go counts every branch node's tasks, eligible or not)
+    g = _flat(3, n_tasks=4, eligible=[False, True, True],
+              svc_count=[4, 0, 0], total_count=[4, 0, 0])
+    # branches: {node0, node1} and {node2}; branch 0 already "has" 4
+    assert tree_fill(g, [[0, 0, 1]]) == [0, 0, 4]
+
+
+def test_tree_fill_no_levels_is_flat_fill():
+    rng = random.Random(5)
+    for _ in range(50):
+        g = random_instance(rng)
+        assert tree_fill(g, []) == greedy_fill(g)
+
+
+def test_tree_fill_trivial_single_branch_matches_flat():
+    rng = random.Random(6)
+    for _ in range(50):
+        g = random_instance(rng)
+        n = len(g.eligible)
+        assert tree_fill(g, [[0] * n]) == greedy_fill(g)
