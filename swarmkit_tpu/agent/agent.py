@@ -43,6 +43,10 @@ class Agent:
         if self.volume_manager is not None:
             self.volume_manager.on_ready = self.worker.volume_ready
         self.session_id: str | None = None
+        # Session-message consumer (manager list, root CA, network keys,
+        # role changes — agent/agent.go handleSessionMessage:416-477). The
+        # daemon sets this to drive seed updates and role flips.
+        self.on_session_message = None
         self._pending: dict[str, TaskStatus] = {}
         self._unpublished_pending: set[str] = set()
         self._pending_lock = threading.Lock()
@@ -186,11 +190,16 @@ class Agent:
         hb_stop = threading.Event()
 
         def heartbeat_loop():
+            # each response carries the CURRENT period so live cluster
+            # reconfig (dispatcher.go:1072-1077) re-paces the beats; a beat
+            # slower than the server's grace window would flap the node DOWN
+            p = period
             while not (self._stop.is_set() or hb_stop.is_set()):
-                if self._stop.wait(period / 2) or hb_stop.is_set():
+                if self._stop.wait(p / 2) or hb_stop.is_set():
                     return
                 try:
-                    self.dispatcher.heartbeat(self.node_id, session_id)
+                    p = self.dispatcher.heartbeat(self.node_id, session_id) \
+                        or p
                 except Exception:
                     return
 
@@ -200,10 +209,35 @@ class Agent:
                 if self._stop.wait(REPORT_INTERVAL):
                     return
 
+        def session_message_loop():
+            """Consume the Session stream when both sides support it; its
+            loss is non-fatal (the main session carries the workload)."""
+            if self.on_session_message is None \
+                    or not hasattr(self.dispatcher, "session"):
+                return
+            try:
+                sch = self.dispatcher.session(self.node_id, session_id)
+            except Exception:
+                return
+            while not (self._stop.is_set() or hb_stop.is_set()):
+                try:
+                    msg = sch.get(timeout=0.2)
+                except TimeoutError:
+                    continue
+                except ChannelClosed:
+                    return
+                try:
+                    self.on_session_message(msg)
+                except Exception:
+                    log.exception("agent %s: session message handler failed",
+                                  self.node_id)
+
         hb = threading.Thread(target=heartbeat_loop, daemon=True)
         rp = threading.Thread(target=report_loop, daemon=True)
+        sm = threading.Thread(target=session_message_loop, daemon=True)
         hb.start()
         rp.start()
+        sm.start()
 
         try:
             ch = self.dispatcher.assignments(self.node_id, session_id)

@@ -37,8 +37,11 @@ from .heartbeat import Heartbeat
 DEFAULT_HEARTBEAT_PERIOD = 5.0       # reference: dispatcher.go:28-53
 HEARTBEAT_EPSILON = 0.5
 GRACE_MULTIPLIER = 3
+RATE_LIMIT_PERIOD = 8.0              # dispatcher.go:34
+RATE_LIMIT_COUNT = 3                 # nodes.go:14 — registrations per period
 BATCH_INTERVAL = 0.1                 # assignment/status batching, 100ms
 MAX_BATCH_ITEMS = 10000
+DEFAULT_NODE_DOWN_PERIOD = 24 * 3600.0  # dispatcher.go:48-52 → ORPHANED
 
 
 class DispatcherError(Exception):
@@ -67,6 +70,20 @@ class AssignmentsMessage:
 
 
 @dataclass
+class SessionMessage:
+    """The Session stream payload (api/dispatcher.proto SessionMessage:
+    manager list for reconnect failover, the cluster root CA so agents
+    track rotations, network bootstrap keys, and the node object's current
+    role/availability so role changes reach the node without polling)."""
+
+    managers: list = field(default_factory=list)     # [(node_id, addr)]
+    root_ca_pem: bytes = b""
+    network_keys: list = field(default_factory=list)
+    node_role: int | None = None                     # observed cert role
+    desired_role: int | None = None                  # spec.desired_role
+
+
+@dataclass
 class Session:
     node_id: str
     session_id: str
@@ -77,13 +94,23 @@ class Session:
     known_secrets: set[str] = field(default_factory=set)
     known_configs: set[str] = field(default_factory=set)
     known_volumes: set[str] = field(default_factory=set)
+    session_channel: Channel | None = None
+    last_session_msg: SessionMessage | None = None
+
+
+class RateLimitExceeded(DispatcherError):
+    pass
 
 
 class Dispatcher:
     def __init__(self, store: MemoryStore,
-                 heartbeat_period: float = DEFAULT_HEARTBEAT_PERIOD):
+                 heartbeat_period: float = DEFAULT_HEARTBEAT_PERIOD,
+                 node_down_period: float = DEFAULT_NODE_DOWN_PERIOD,
+                 rate_limit_period: float = RATE_LIMIT_PERIOD):
         self.store = store
         self.heartbeat_period = heartbeat_period
+        self.node_down_period = node_down_period
+        self.rate_limit_period = rate_limit_period
         self._sessions: dict[str, Session] = {}
         self._lock = threading.RLock()
         self._stop = threading.Event()
@@ -92,6 +119,11 @@ class Dispatcher:
         self._status_cond = threading.Condition()
         self._dirty_nodes: set[str] = set()
         self._unknown_timers: dict[str, Heartbeat] = {}
+        # node id -> (attempts, window start) for registration rate limiting
+        self._reg_attempts: dict[str, tuple[int, float]] = {}
+        # down-node timers driving the 24h → ORPHANED transition
+        self._orphan_timers: dict[str, Heartbeat] = {}
+        self._session_plane_dirty = False
 
     # ------------------------------------------------------------- lifecycle
     def start(self):
@@ -99,6 +131,7 @@ class Dispatcher:
         # dispatcher per leadership; in-process, agents hold this object)
         self._stop = threading.Event()
         self._mark_nodes_unknown()
+        self._arm_orphan_timers()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="dispatcher")
         self._thread.start()
@@ -113,9 +146,14 @@ class Dispatcher:
             for s in self._sessions.values():
                 s.heartbeat.stop()
                 s.channel.close()
+                if s.session_channel is not None:
+                    s.session_channel.close()
             self._sessions.clear()
             timers, self._unknown_timers = self._unknown_timers, {}
+            orphans, self._orphan_timers = self._orphan_timers, {}
         for t in timers.values():
+            t.stop()
+        for t in orphans.values():
             t.stop()
 
     def _mark_nodes_unknown(self):
@@ -204,11 +242,29 @@ class Dispatcher:
             self.store.update(cb)
         except Exception:
             pass
+        else:
+            if not alive:
+                # a node lost across a leadership change starts its orphan
+                # countdown like any heartbeat-failed node
+                self._arm_orphan_timer(node_id)
 
     # ------------------------------------------------------------------- rpc
     def register(self, node_id: str, description=None) -> str:
         """reference: dispatcher.go:553 register — issues a session id and
-        marks the node READY."""
+        marks the node READY. Re-registration is rate limited
+        (nodes.go CheckRateLimit: >3 per 8s window is rejected) so a
+        crash-looping agent cannot grind the control plane."""
+        now = time.monotonic()
+        with self._lock:
+            attempts, window_start = self._reg_attempts.get(node_id, (0, now))
+            if now - window_start > self.rate_limit_period:
+                attempts, window_start = 0, now
+            attempts += 1
+            self._reg_attempts[node_id] = (attempts, window_start)
+            if attempts > RATE_LIMIT_COUNT:
+                raise RateLimitExceeded(
+                    f"node {node_id} exceeded rate limit count of "
+                    "registrations")
 
         def cb(tx):
             node = tx.get_node(node_id)
@@ -242,18 +298,25 @@ class Dispatcher:
             if old is not None:
                 old.heartbeat.stop()
                 old.channel.close()
+                if old.session_channel is not None:
+                    old.session_channel.close()
             self._sessions[node_id] = session
             self._dirty_nodes.add(node_id)
             pending = self._unknown_timers.pop(node_id, None)
+            orphan = self._orphan_timers.pop(node_id, None)
         if pending is not None:
             pending.stop()  # re-registered within the leadership grace
+        if orphan is not None:
+            orphan.stop()   # the node came back before the orphan window
         hb.start()
         return session_id
 
     def heartbeat(self, node_id: str, session_id: str) -> float:
-        """reference: dispatcher.go:1317-1335."""
+        """reference: dispatcher.go:1317-1335. The grace window re-arms
+        from the CURRENT period so live reconfig applies to existing
+        sessions too (nodes.go updatePeriod)."""
         session = self._session(node_id, session_id)
-        session.heartbeat.beat()
+        session.heartbeat.beat(self.heartbeat_period * GRACE_MULTIPLIER)
         return self.heartbeat_period
 
     def assignments(self, node_id: str, session_id: str) -> Channel:
@@ -264,6 +327,69 @@ class Dispatcher:
             msg = self._full_assignment(session)
             session.channel._offer(msg)
         return session.channel
+
+    def session(self, node_id: str, session_id: str) -> Channel:
+        """The Session message stream (dispatcher.go:1359+): an immediate
+        snapshot (manager list, root CA, network keys, this node's roles)
+        then pushes whenever any of those change."""
+        session = self._session(node_id, session_id)
+        with self._lock:
+            if session.session_channel is None:
+                session.session_channel = Channel(matcher=None, limit=256)
+            msg = self._build_session_message(session.node_id)
+            session.last_session_msg = msg
+            session.session_channel._offer(msg)
+        return session.session_channel
+
+    def _session_plane_snapshot(self):
+        """ONE store pass for everything the session plane serves: the
+        shared (managers, root CA, network keys) plus a per-node roles map
+        — per-session messages derive from this without re-scanning."""
+
+        def cb(tx):
+            managers = []
+            roles: dict[str, tuple] = {}
+            for n in tx.find_nodes():
+                ms = n.manager_status
+                if ms is not None and ms.addr:
+                    managers.append((n.id, ms.addr))
+                roles[n.id] = (n.role, n.spec.desired_role)
+            root_pem, keys = b"", []
+            for c in tx.find_clusters():
+                if c.root_ca is not None and c.root_ca.ca_cert_pem:
+                    root_pem = c.root_ca.ca_cert_pem
+                keys = list(c.network_bootstrap_keys or [])
+                break
+            return sorted(managers), root_pem, keys, roles
+
+        return self.store.view(cb)
+
+    def _build_session_message(self, node_id: str) -> SessionMessage:
+        managers, root_pem, keys, roles = self._session_plane_snapshot()
+        role, desired = roles.get(node_id, (None, None))
+        return SessionMessage(managers=managers, root_ca_pem=root_pem,
+                              network_keys=keys, node_role=role,
+                              desired_role=desired)
+
+    def _push_session_updates(self):
+        """Offer a fresh SessionMessage to sessions whose view changed."""
+        with self._lock:
+            listeners = [s for s in self._sessions.values()
+                         if s.session_channel is not None]
+        if not listeners:
+            return
+        managers, root_pem, keys, roles = self._session_plane_snapshot()
+        for s in listeners:
+            role, desired = roles.get(s.node_id, (None, None))
+            msg = SessionMessage(managers=managers, root_ca_pem=root_pem,
+                                 network_keys=keys, node_role=role,
+                                 desired_role=desired)
+            if msg != s.last_session_msg:
+                s.last_session_msg = msg
+                try:
+                    s.session_channel._offer(msg)
+                except Exception:
+                    pass
 
     def update_task_status(self, node_id: str, session_id: str,
                            updates: list[tuple[str, object]]):
@@ -290,6 +416,8 @@ class Dispatcher:
         session = self._session(node_id, session_id)
         session.heartbeat.stop()
         session.channel.close()
+        if session.session_channel is not None:
+            session.session_channel.close()
         with self._lock:
             self._sessions.pop(node_id, None)
         self._node_down(node_id, session_id, graceful=True)
@@ -308,6 +436,8 @@ class Dispatcher:
             if s is not None and s.session_id == session_id:
                 s.heartbeat.stop()
                 s.channel.close()
+                if s.session_channel is not None:
+                    s.session_channel.close()
                 self._sessions.pop(node_id, None)
             elif not graceful:
                 return  # superseded session
@@ -324,6 +454,69 @@ class Dispatcher:
 
         try:
             self.store.update(cb)
+        except Exception:
+            pass
+        else:
+            if not graceful:
+                self._arm_orphan_timer(node_id)
+
+    # ------------------------------------------------- down-node orphaning
+    def _arm_orphan_timers(self):
+        """On (re)start, nodes already DOWN resume their orphan countdown
+        (the previous leader's timers died with it). The full window
+        restarts — the store doesn't record when the node went down, and a
+        conservative restart beats orphaning early."""
+        try:
+            nodes = self.store.view(lambda tx: tx.find_nodes())
+        except Exception:
+            return
+        for n in nodes:
+            if n.status.state == NodeStatusState.DOWN:
+                self._arm_orphan_timer(n.id)
+
+    def _arm_orphan_timer(self, node_id: str):
+        with self._lock:
+            if node_id in self._orphan_timers or self._stop.is_set():
+                return
+            timer = Heartbeat(self.node_down_period,
+                              lambda: self._orphan_expired(node_id))
+            self._orphan_timers[node_id] = timer
+        timer.start()
+
+    def _orphan_expired(self, node_id: str):
+        """dispatcher.go moveTasksToOrphaned:1209 — a node down for the
+        full window: we cannot know whether its tasks still run; mark every
+        task that could have made progress (ASSIGNED..RUNNING) ORPHANED so
+        the reaper can collect them."""
+        with self._lock:
+            self._orphan_timers.pop(node_id, None)
+            if node_id in self._sessions:
+                return  # came back concurrently
+
+        def cb(batch):
+            tasks = self.store.view(
+                lambda tx: tx.find_tasks(by.ByNodeID(node_id)))
+            for t in tasks:
+                if not (TaskState.ASSIGNED <= t.status.state
+                        <= TaskState.RUNNING):
+                    continue
+
+                def update_one(tx, task_id=t.id):
+                    cur = tx.get_task(task_id)
+                    if cur is None or not (
+                            TaskState.ASSIGNED <= cur.status.state
+                            <= TaskState.RUNNING):
+                        return
+                    cur = cur.copy()
+                    cur.status.state = TaskState.ORPHANED
+                    cur.status.message = "node unreachable past the " \
+                        "orphaning window"
+                    tx.update(cur)
+
+                batch.update(update_one)
+
+        try:
+            self.store.batch(cb)
         except Exception:
             pass
 
@@ -345,6 +538,9 @@ class Dispatcher:
                 now = time.monotonic()
                 if now - last_flush >= BATCH_INTERVAL:
                     self._send_incrementals()
+                    if self._session_plane_dirty:
+                        self._session_plane_dirty = False
+                        self._push_session_updates()
                     last_flush = now
         finally:
             self.store.queue.stop_watch(ch)
@@ -359,12 +555,23 @@ class Dispatcher:
                     and ev.old.node_id and ev.old.node_id != obj.node_id:
                 with self._lock:
                     self._dirty_nodes.add(ev.old.node_id)
-        elif isinstance(obj, (Secret, Config)):
-            # conservatively refresh all sessions (reference diffs references)
+        elif isinstance(obj, Secret):
+            # only sessions that were shipped this secret care about its
+            # change; fresh references always arrive via a task event,
+            # which dirties the node anyway (assignments.go keeps per-node
+            # reference sets for the same reason — dirtying every session
+            # per secret event collapses at 10k nodes)
             with self._lock:
-                self._dirty_nodes.update(self._sessions.keys())
+                self._dirty_nodes.update(
+                    nid for nid, s in self._sessions.items()
+                    if obj.id in s.known_secrets)
+        elif isinstance(obj, Config):
+            with self._lock:
+                self._dirty_nodes.update(
+                    nid for nid, s in self._sessions.items()
+                    if obj.id in s.known_configs)
         else:
-            from ..api.objects import Volume
+            from ..api.objects import Cluster, Volume
 
             if isinstance(obj, Volume):
                 # publish-status changes gate volume assignment shipping
@@ -373,6 +580,17 @@ class Dispatcher:
                         {s.node_id for s in obj.publish_status}
                         & set(self._sessions.keys())
                     )
+            elif isinstance(obj, Cluster):
+                # live reconfig from the replicated Cluster object
+                # (dispatcher.go:1072-1077): heartbeat period applies to
+                # future beats and is returned by the next heartbeat RPC
+                period = obj.spec.dispatcher.heartbeat_period
+                if period and period != self.heartbeat_period:
+                    self.heartbeat_period = period
+                self._session_plane_dirty = True
+        if isinstance(obj, Node):
+            # manager list / role changes ride the Session stream
+            self._session_plane_dirty = True
 
     # ---------------------------------------------------- assignment building
     def _relevant_tasks(self, tx, node_id: str) -> list[Task]:

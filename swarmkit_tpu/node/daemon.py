@@ -151,6 +151,7 @@ class SwarmNode:
         self.executor = executor
         self.listen_addr = listen_addr
         self.advertise_addr = advertise_addr
+        self._user_advertise = advertise_addr  # operator-pinned, if any
         self.join_addr = join_addr
         self.join_token = join_token
         self.org = org
@@ -175,6 +176,8 @@ class SwarmNode:
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._dispatcher_shim: RemoteDispatcher | None = None
+        self._manager_addrs: list[str] = []
+        self._role_flip_active = False
 
     # ------------------------------------------------------------- identity
 
@@ -450,11 +453,19 @@ class SwarmNode:
         self._threads.append(t)
 
         # managers also run an agent against the cluster (runAgent:576);
-        # its session follows the leader via the local endpoint
-        self._start_agent(advertise)
-        self.renewer = TLSRenewer(
-            self.security, RemoteCA(advertise, security=self.security))
-        self.renewer.start()
+        # its session follows the leader via the local endpoint. A PROMOTED
+        # manager already has both the agent and the renewer from its
+        # worker phase — just widen their seed lists.
+        if self.agent is None:
+            self._start_agent(advertise)
+        else:
+            self._dispatcher_shim.update_managers([advertise])
+        if self.renewer is None:
+            self.renewer = TLSRenewer(
+                self.security,
+                RemoteCA(advertise, security=self.security,
+                         seeds_fn=self._live_manager_seeds))
+            self.renewer.start()
 
     def _join_raft(self, node_id: str,
                    advertise: str) -> tuple[int, list]:
@@ -562,6 +573,10 @@ class SwarmNode:
 
     # -------------------------------------------------------- worker stack
 
+    def _live_manager_seeds(self) -> list[str]:
+        shim = self._dispatcher_shim
+        return list(shim.seeds) if shim is not None else []
+
     def _start_worker(self):
         if self.join_addr is None:
             raise NodeError("a worker node needs a join address")
@@ -571,7 +586,7 @@ class SwarmNode:
         self.renewer = TLSRenewer(
             self.security,
             RemoteCA(self.join_addr, security=self.security,
-                     seeds_fn=lambda: list(self._dispatcher_shim.seeds)))
+                     seeds_fn=self._live_manager_seeds))
         self.renewer.start()
 
     def _start_agent(self, addr: str):
@@ -585,7 +600,10 @@ class SwarmNode:
             log_broker=RemoteLogBroker(addr.split(",")[0].strip(),
                                        self.security),
         )
+        self.agent.on_session_message = self._on_session_message
         self.agent.start()
+        # fallback for manager-list freshness when no session message has
+        # arrived yet (the stream needs a live session first)
         t = threading.Thread(target=self._refresh_managers_loop,
                              args=(dispatcher,), daemon=True,
                              name="manager-refresh")
@@ -593,9 +611,8 @@ class SwarmNode:
         self._threads.append(t)
 
     def _refresh_managers_loop(self, dispatcher: RemoteDispatcher):
-        """Keep the agent's manager seed list fresh (the Session message's
-        manager list, dispatcher.go:1359+), so sessions survive the death of
-        the original join endpoint."""
+        """Keep the agent's manager seed list fresh even when the session
+        stream is down (the Session message plane is the primary source)."""
         while not self._stop.wait(self.manager_refresh_interval):
             try:
                 managers = dispatcher._conn().call("cluster.managers",
@@ -603,3 +620,127 @@ class SwarmNode:
             except Exception:
                 continue
             dispatcher.update_managers([addr for _nid, addr in managers])
+
+    # ------------------------------------------------- session message plane
+
+    def _on_session_message(self, msg):
+        """agent/agent.go handleSessionMessage:416-477: manager list feeds
+        reconnect failover, network keys reach the executor, and role
+        changes flip the manager stack (node/node.go superviseManager)."""
+        if msg.managers:
+            addrs = [a for _nid, a in msg.managers]
+            self._dispatcher_shim.update_managers(addrs)
+            self._manager_addrs = addrs
+        if msg.network_keys:
+            try:
+                self.executor.set_network_bootstrap_keys(msg.network_keys)
+            except Exception:
+                pass
+        desired = msg.desired_role
+        if desired is None:
+            return
+        if desired == NodeRole.MANAGER and self.manager is None \
+                and not self._role_flip_active:
+            self._role_flip_active = True
+            t = threading.Thread(target=self._promote, daemon=True,
+                                 name="promote")
+            t.start()
+            self._threads.append(t)
+        elif desired == NodeRole.WORKER and self.manager is not None \
+                and msg.node_role == NodeRole.WORKER \
+                and not self._role_flip_active:
+            # the role manager flips node.role only AFTER the raft
+            # membership removal succeeded (role_manager.go:154-214), so
+            # observing role==WORKER means teardown cannot break quorum.
+            # (A removed raft member never hears its own removal — the
+            # leader stops replicating to it — so the signal must come
+            # from the session plane, as in the reference.)
+            self._role_flip_active = True
+            t = threading.Thread(target=self._demote, daemon=True,
+                                 name="demote")
+            t.start()
+            self._threads.append(t)
+
+    def _promote(self):
+        """Worker → manager: renew the certificate until it carries the
+        manager role (the role manager reconciles spec.desired_role into
+        the cert role), then bring up the full manager stack joining the
+        existing quorum."""
+        try:
+            deadline = time.monotonic() + JOIN_TIMEOUT * 2
+            while not self._stop.is_set() and time.monotonic() < deadline:
+                if self.security.role() == NodeRole.MANAGER:
+                    break
+                try:
+                    self.renewer.renew_once()
+                except Exception:
+                    pass
+                if self.security.role() == NodeRole.MANAGER:
+                    break
+                if self._stop.wait(JOIN_RETRY):
+                    return
+            if self.security.role() != NodeRole.MANAGER:
+                log.warning("promotion: manager certificate never issued")
+                return
+            addrs = list(getattr(self, "_manager_addrs", [])) \
+                or list(self._dispatcher_shim.seeds)
+            self.join_addr = ",".join(addrs)
+            self._save_identity()
+            self._start_manager()
+            log.info("promoted to manager (raft id %s)", self.raft_id)
+        except Exception:
+            log.exception("promotion failed")
+        finally:
+            self._role_flip_active = False
+
+    def _demote(self):
+        """Manager → worker: called once the role manager has already
+        removed us from the raft quorum (node.role flipped WORKER); tear
+        the manager stack down and continue as a pure agent."""
+        try:
+            if self.manager is not None:
+                self.manager.stop()
+                self.manager = None
+            if self._ticker is not None:
+                self._ticker.stop()
+                self._ticker = None
+            if self.raft is not None:
+                self.raft.stop()
+                self.raft = None
+            if self._transport is not None:
+                self._transport.stop()
+                self._transport = None
+            if self.server is not None:
+                self.server.stop()
+                self.server = None
+            self.store = None
+            self.raft_id = None
+            # the computed advertise dies with the server; a re-promotion
+            # must advertise its NEW bind, not this stint's port
+            self.advertise_addr = self._user_advertise
+            self._save_state(raft_id=None, advertise=None)
+            # wipe the raft state dir: a later re-promotion joins with a
+            # fresh raft id, and replaying this stint's WAL/hard state/
+            # membership under it would poison the new quorum view
+            # (the reference deletes the raft data dir on demotion)
+            import shutil
+
+            shutil.rmtree(os.path.join(self.state_dir, "raft"),
+                          ignore_errors=True)
+            # pick up the worker certificate from the surviving managers
+            deadline = time.monotonic() + JOIN_TIMEOUT * 2
+            while not self._stop.is_set() and time.monotonic() < deadline:
+                if self.security.role() == NodeRole.WORKER:
+                    break
+                try:
+                    self.renewer.renew_once()
+                except Exception:
+                    pass
+                if self.security.role() == NodeRole.WORKER \
+                        or self._stop.wait(JOIN_RETRY):
+                    break
+            log.info("demoted to worker")
+        except Exception:
+            log.exception("demotion failed")
+        finally:
+            self._role_flip_active = False

@@ -107,7 +107,8 @@ class _Registry:
             for cls in (csi_plugin.VolumePublishStatus, csi_plugin.VolumeInfo):
                 self.add(cls)
             for cls in (dispatcher_mod.Assignment,
-                        dispatcher_mod.AssignmentsMessage):
+                        dispatcher_mod.AssignmentsMessage,
+                        dispatcher_mod.SessionMessage):
                 self.add(cls)
             for cls in (broker_mod.LogSelector, broker_mod.LogContext,
                         broker_mod.LogMessage, broker_mod.SubscriptionMessage):

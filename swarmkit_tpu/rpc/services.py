@@ -287,8 +287,13 @@ def build_manager_registry(manager, raft_node=None,
             leader_forward("dispatcher.register", disp_register), roles=both)
     reg.add("dispatcher.heartbeat",
             leader_forward("dispatcher.heartbeat", disp_heartbeat), roles=both)
+    def disp_session(caller, node_id, session_id):
+        _require_node(caller, node_id)
+        return d.session(node_id, session_id)
+
     reg.add("dispatcher.assignments", disp_assignments, roles=both,
             streaming=True)  # streams cannot hop; agents follow the leader
+    reg.add("dispatcher.session", disp_session, roles=both, streaming=True)
     reg.add("dispatcher.update_task_status",
             leader_forward("dispatcher.update_task_status",
                            disp_update_task_status), roles=both)
@@ -457,6 +462,9 @@ class RemoteDispatcher:
     def assignments(self, node_id, session_id):
         return self._conn().stream("dispatcher.assignments", node_id,
                                    session_id)
+
+    def session(self, node_id, session_id):
+        return self._conn().stream("dispatcher.session", node_id, session_id)
 
     def update_task_status(self, node_id, session_id, updates):
         return self._conn().call("dispatcher.update_task_status", node_id,

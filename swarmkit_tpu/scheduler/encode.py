@@ -424,7 +424,7 @@ def encode(
             prefix = np.zeros(N, np.int64)
             for li, (kind, label) in enumerate(spread):
                 col = label_col(kind, label)
-                combo = prefix * (int(col.max()) + 1) + col
+                combo = prefix * (int(col.max(initial=0)) + 1) + col
                 # contiguous ranks preserving (prefix, value) order
                 _, ranks = np.unique(combo, return_inverse=True)
                 p.spread_rank[gi, li] = ranks.astype(np.int32)

@@ -275,3 +275,79 @@ def test_restarted_manager_rejoins_from_state_dir(tmp_path, cluster_nodes):
         return got is not None
 
     assert wait_for(caught_up, timeout=20)
+
+
+def test_worker_promotion_and_demotion_over_wire(tmp_path, cluster_nodes):
+    """node promote → the worker renews to a manager cert, joins the raft
+    quorum, and serves the control plane; node demote reverses it
+    (node/node.go superviseManager + role_manager.go over the session
+    message plane)."""
+    from swarmkit_tpu.api.types import NodeRole
+
+    m1 = _mk_manager(tmp_path, "m1")
+    cluster_nodes.append(m1)
+    assert wait_for(lambda: m1.is_leader, timeout=10)
+    _mtok, wtok = _tokens(m1)
+
+    w1 = _mk_worker(tmp_path, "w1", m1.addr, wtok)
+    cluster_nodes.append(w1)
+
+    def worker_ready():
+        n = m1.store.view(lambda tx: tx.get_node(w1.node_id))
+        from swarmkit_tpu.api.types import NodeStatusState
+
+        return n is not None and n.status.state == NodeStatusState.READY
+
+    assert wait_for(worker_ready, timeout=15)
+
+    def set_role(node_id, role):
+        """Version-checked update raced by status writers: retry on
+        sequence conflicts like any real client."""
+        ctl = RemoteControl(m1.addr, m1.security)
+        try:
+            for _ in range(20):
+                n = ctl.get_node(node_id)
+                n.spec.desired_role = role
+                try:
+                    ctl.update_node(n.id, n.meta.version, n.spec)
+                    return
+                except Exception as exc:
+                    if "out of sequence" not in str(exc):
+                        raise
+                    time.sleep(0.1)
+            raise AssertionError("could not update node role")
+        finally:
+            ctl.close()
+
+    # promote via the control plane
+    set_role(w1.node_id, NodeRole.MANAGER)
+
+    assert wait_for(lambda: w1.manager is not None and w1.raft is not None,
+                    timeout=40), "worker never became a manager"
+    assert wait_for(lambda: len(m1.raft.members) == 2, timeout=20)
+    assert wait_for(
+        lambda: w1.security.role() == NodeRole.MANAGER, timeout=10)
+
+    # the promoted manager replicates state
+    def replicated():
+        return (w1.store is not None
+                and w1.store.view(lambda tx: tx.find_clusters()))
+
+    assert wait_for(replicated, timeout=20)
+
+    # demote: quorum shrinks back, stack tears down, cert returns to worker
+    set_role(w1.node_id, NodeRole.WORKER)
+
+    assert wait_for(lambda: len(m1.raft.members) == 1, timeout=40)
+    assert wait_for(lambda: w1.manager is None and w1.raft is None,
+                    timeout=40)
+    assert wait_for(
+        lambda: w1.security.role() == NodeRole.WORKER, timeout=20)
+
+    # re-promotion joins cleanly (the raft state dir was wiped on
+    # demotion; a stale WAL would poison the fresh raft id)
+    set_role(w1.node_id, NodeRole.MANAGER)
+    assert wait_for(lambda: w1.manager is not None and w1.raft is not None,
+                    timeout=40)
+    assert wait_for(lambda: len(m1.raft.members) == 2, timeout=20)
+    assert wait_for(lambda: replicated(), timeout=20)

@@ -1,0 +1,199 @@
+"""Dispatcher hardening: rate limiting, down→ORPHANED, targeted dirtying,
+live reconfig, and the Session message plane (VERDICT item 5; reference
+manager/dispatcher/{dispatcher,nodes,assignments}.go)."""
+import time
+
+import pytest
+
+from swarmkit_tpu.api.objects import Cluster, Node, Secret, Task
+from swarmkit_tpu.api.specs import (
+    Annotations,
+    ClusterSpec,
+    SecretSpec,
+)
+from swarmkit_tpu.api.types import NodeRole, NodeStatusState, TaskState
+from swarmkit_tpu.dispatcher.dispatcher import (
+    Dispatcher,
+    RateLimitExceeded,
+)
+from swarmkit_tpu.store import by
+from swarmkit_tpu.store.memory import MemoryStore
+
+from test_scheduler import wait_for  # noqa: E402
+
+
+@pytest.fixture
+def store():
+    return MemoryStore()
+
+
+def _mk_node(store, node_id, state=NodeStatusState.READY):
+    n = Node(id=node_id)
+    n.status.state = state
+    store.update(lambda tx: tx.create(n))
+    return n
+
+
+def _mk_task(store, task_id, node_id, state=TaskState.RUNNING):
+    t = Task(id=task_id, service_id="svc", node_id=node_id)
+    t.status.state = state
+    t.desired_state = TaskState.RUNNING
+    store.update(lambda tx: tx.create(t))
+    return t
+
+
+def test_register_rate_limit(store):
+    d = Dispatcher(store, heartbeat_period=0.2, rate_limit_period=8.0)
+    d.start()
+    try:
+        for _ in range(3):
+            d.register("n1")  # three within the window are fine
+        with pytest.raises(RateLimitExceeded):
+            d.register("n1")
+    finally:
+        d.stop()
+
+
+def test_rate_limit_window_resets(store):
+    d = Dispatcher(store, heartbeat_period=0.2, rate_limit_period=0.3)
+    d.start()
+    try:
+        for _ in range(3):
+            d.register("n1")
+        time.sleep(0.4)
+        d.register("n1")  # new window
+    finally:
+        d.stop()
+
+
+def test_down_node_tasks_orphaned_after_window(store):
+    _mk_node(store, "n1")
+    _mk_task(store, "t-run", "n1", TaskState.RUNNING)
+    _mk_task(store, "t-done", "n1", TaskState.COMPLETE)
+    d = Dispatcher(store, heartbeat_period=0.1, node_down_period=0.5)
+    d.start()
+    try:
+        sid = d.register("n1")
+        # vanish: no heartbeats → DOWN after grace, ORPHANED after window
+        def down():
+            n = store.view(lambda tx: tx.get_node("n1"))
+            return n.status.state == NodeStatusState.DOWN
+
+        assert wait_for(down, timeout=5)
+
+        def orphaned():
+            t = store.view(lambda tx: tx.get_task("t-run"))
+            return t.status.state == TaskState.ORPHANED
+
+        assert wait_for(orphaned, timeout=5)
+        # final-state tasks cannot have made progress — left alone
+        done = store.view(lambda tx: tx.get_task("t-done"))
+        assert done.status.state == TaskState.COMPLETE
+        del sid
+    finally:
+        d.stop()
+
+
+def test_reregister_cancels_orphan_countdown(store):
+    _mk_node(store, "n1")
+    _mk_task(store, "t1", "n1", TaskState.RUNNING)
+    d = Dispatcher(store, heartbeat_period=0.1, node_down_period=0.8)
+    d.start()
+    try:
+        d.register("n1")
+        assert wait_for(
+            lambda: store.view(lambda tx: tx.get_node("n1")).status.state
+            == NodeStatusState.DOWN, timeout=5)
+        # the node comes back before the orphan window elapses and stays
+        # alive (heartbeats) past where the countdown would have fired
+        sid = d.register("n1")
+        end = time.monotonic() + 1.0
+        while time.monotonic() < end:
+            d.heartbeat("n1", sid)
+            time.sleep(0.1)
+        t = store.view(lambda tx: tx.get_task("t1"))
+        assert t.status.state == TaskState.RUNNING
+    finally:
+        d.stop()
+
+
+def test_secret_events_dirty_only_referencing_sessions(store):
+    _mk_node(store, "n1")
+    _mk_node(store, "n2")
+    s = Secret(id="sec1", spec=SecretSpec(annotations=Annotations(name="s"),
+                                          data=b"x"))
+    store.update(lambda tx: tx.create(s))
+    d = Dispatcher(store, heartbeat_period=5.0)
+    d.start()
+    try:
+        sid1 = d.register("n1")
+        sid2 = d.register("n2")
+        ch1 = d.assignments("n1", sid1)
+        ch2 = d.assignments("n2", sid2)
+        ch1.get(timeout=2)  # drain initial COMPLETE
+        ch2.get(timeout=2)
+        # a secret no session references: nobody gets dirtied
+        s2 = store.view(lambda tx: tx.get_secret("sec1")).copy()
+        s2.spec.data = b"y"
+        store.update(lambda tx: tx.update(s2))
+        time.sleep(0.4)
+        with d._lock:
+            assert not d._dirty_nodes
+        for ch in (ch1, ch2):
+            with pytest.raises(TimeoutError):
+                ch.get(timeout=0.1)
+    finally:
+        d.stop()
+
+
+def test_cluster_heartbeat_reconfig_live(store):
+    c = Cluster(id="c1", spec=ClusterSpec(
+        annotations=Annotations(name="default")))
+    store.update(lambda tx: tx.create(c))
+    d = Dispatcher(store, heartbeat_period=5.0)
+    d.start()
+    try:
+        sid = d.register("n1")
+        assert d.heartbeat("n1", sid) == 5.0
+        cc = store.view(lambda tx: tx.get_cluster("c1")).copy()
+        cc.spec.dispatcher.heartbeat_period = 1.5
+        store.update(lambda tx: tx.update(cc))
+        assert wait_for(lambda: d.heartbeat("n1", sid) == 1.5, timeout=5)
+    finally:
+        d.stop()
+
+
+def test_session_message_plane(store):
+    from swarmkit_tpu.api.objects import ManagerStatus
+
+    mgr = Node(id="mgr1")
+    mgr.status.state = NodeStatusState.READY
+    mgr.role = NodeRole.MANAGER
+    mgr.manager_status = ManagerStatus(raft_id=1, addr="127.0.0.1:9999",
+                                       leader=True)
+    store.update(lambda tx: tx.create(mgr))
+    c = Cluster(id="c1", spec=ClusterSpec(
+        annotations=Annotations(name="default")))
+    from swarmkit_tpu.api.objects import RootCAObj
+
+    c.root_ca = RootCAObj(ca_cert_pem=b"CERT")
+    store.update(lambda tx: tx.create(c))
+
+    d = Dispatcher(store, heartbeat_period=5.0)
+    d.start()
+    try:
+        sid = d.register("w1")
+        ch = d.session("w1", sid)
+        first = ch.get(timeout=2)
+        assert ("mgr1", "127.0.0.1:9999") in first.managers
+        assert first.root_ca_pem == b"CERT"
+        assert first.desired_role == NodeRole.WORKER
+
+        # promote: the node sees its desired role flip via the stream
+        w = store.view(lambda tx: tx.get_node("w1")).copy()
+        w.spec.desired_role = NodeRole.MANAGER
+        store.update(lambda tx: tx.update(w))
+        msg = ch.get(timeout=3)
+        assert msg.desired_role == NodeRole.MANAGER
+    finally:
+        d.stop()
