@@ -186,7 +186,7 @@ class ControlAPI:
         s = self.store.view().get_service(service_id)
         if s is None:
             raise NotFound(f"service {service_id} not found")
-        return s
+        return s.copy()
 
     def update_service(self, service_id: str, version: Version,
                        spec: ServiceSpec, rollback: bool = False) -> Service:
@@ -240,7 +240,7 @@ class ControlAPI:
                 continue
             if filters and filters.modes and s.spec.mode not in filters.modes:
                 continue
-            out.append(s)
+            out.append(s.copy())
         return out
 
     # ----------------------------------------------------------------- tasks
@@ -248,7 +248,7 @@ class ControlAPI:
         t = self.store.view().get_task(task_id)
         if t is None:
             raise NotFound(f"task {task_id} not found")
-        return t
+        return t.copy()
 
     def remove_task(self, task_id: str) -> None:
         def cb(tx):
@@ -276,7 +276,7 @@ class ControlAPI:
                     if svc is not None and t.spec_version is not None and \
                             t.spec_version.index != svc.spec_version.index:
                         continue
-            out.append(t)
+            out.append(t.copy())
         return out
 
     # ----------------------------------------------------------------- nodes
@@ -284,7 +284,7 @@ class ControlAPI:
         n = self.store.view().get_node(node_id)
         if n is None:
             raise NotFound(f"node {node_id} not found")
-        return n
+        return n.copy()
 
     def list_nodes(self, filters: ListFilters | None = None) -> list[Node]:
         out = []
@@ -297,7 +297,7 @@ class ControlAPI:
                 if filters.memberships and \
                         n.spec.membership not in filters.memberships:
                     continue
-            out.append(n)
+            out.append(n.copy())
         return out
 
     def update_node(self, node_id: str, version: Version, spec) -> Node:

@@ -32,7 +32,12 @@ from ..store import by
 from ..store.memory import MemoryStore
 from ..store.watch import Channel, WatchQueue
 from ..utils.identity import new_id
+from ..utils.metrics import histogram
 from .heartbeat import Heartbeat
+
+_scheduling_delay = histogram(
+    "swarm_dispatcher_scheduling_delay_seconds",
+    "task creation → observed RUNNING")
 
 DEFAULT_HEARTBEAT_PERIOD = 5.0       # reference: dispatcher.go:28-53
 HEARTBEAT_EPSILON = 0.5
@@ -583,9 +588,16 @@ class Dispatcher:
             elif isinstance(obj, Cluster):
                 # live reconfig from the replicated Cluster object
                 # (dispatcher.go:1072-1077): heartbeat period applies to
-                # future beats and is returned by the next heartbeat RPC
+                # future beats and is returned by the next heartbeat RPC.
+                # Only an actual SPEC change applies — unrelated cluster
+                # writes must not clobber an operator-configured period
+                # with the seeded value.
                 period = obj.spec.dispatcher.heartbeat_period
-                if period and period != self.heartbeat_period:
+                old = getattr(ev, "old", None)
+                old_period = (old.spec.dispatcher.heartbeat_period
+                              if old is not None else None)
+                if period and period != old_period \
+                        and period != self.heartbeat_period:
                     self.heartbeat_period = period
                 self._session_plane_dirty = True
         if isinstance(obj, Node):
@@ -771,6 +783,11 @@ class Dispatcher:
                     # monotonic: never lower observed state
                     if status.state < cur.status.state:
                         return
+                    if cur.status.state < TaskState.RUNNING \
+                            <= status.state and cur.meta.created_at:
+                        # NEW→RUNNING scheduling delay (dispatcher.go:72-77)
+                        _scheduling_delay.observe(
+                            max(0.0, time.time() - cur.meta.created_at))
                     cur = cur.copy()
                     cur.status = status
                     tx.update(cur)

@@ -74,6 +74,7 @@ class Manager:
         # our in-process store+proposer already routes writes through raft)
         self.control_api = ControlAPI(self.store)
         self.watch_api = WatchAPI(self.store)
+        self.heartbeat_period = heartbeat_period
         self.dispatcher = Dispatcher(self.store, heartbeat_period=heartbeat_period)
         self.log_broker = LogBroker(self.store)
         self.resource_api = ResourceAllocator(self.store)
@@ -252,6 +253,10 @@ class Manager:
             RoleManager(self.store, raft_node=self.raft),
             MetricsCollector(self.store),
         ]
+        if self.raft is not None:
+            from .wedge import WedgeMonitor
+
+            components.append(WedgeMonitor(self.store, self.raft))
         if self.csi_plugins is not None:
             from ..csi.manager import VolumeManager
 
@@ -333,12 +338,14 @@ class Manager:
         def txn(tx):
             cluster = tx.get_cluster(self.cluster_id)
             if cluster is None:
-                cluster = Cluster(
-                    id=self.cluster_id,
-                    spec=ClusterSpec(
-                        annotations=Annotations(name=DEFAULT_CLUSTER_NAME)
-                    ),
-                )
+                spec = ClusterSpec(
+                    annotations=Annotations(name=DEFAULT_CLUSTER_NAME))
+                # the replicated config must reflect the configured values:
+                # components live-reconfigure FROM this object, so seeding
+                # defaults here would override operator settings on the
+                # first unrelated cluster write
+                spec.dispatcher.heartbeat_period = self.heartbeat_period
+                cluster = Cluster(id=self.cluster_id, spec=spec)
                 cluster.root_ca = RootCAObj(
                     ca_key_pem=self.root.key_pem or b"",
                     ca_cert_pem=self.root.cert_pem,

@@ -51,13 +51,20 @@ class MetricsCollector:
             }
 
     def prometheus_text(self) -> str:
-        """Prometheus text exposition (collector.go gauge names)."""
+        """Prometheus text exposition: object/node gauges (collector.go)
+        plus every hot-path latency histogram (store tx/lock-hold, raft
+        propose, scheduling delay — memory.go:99-112, raft.go:204-209,
+        dispatcher.go:72-77)."""
+        from ..utils.metrics import all_histograms
+
         snap = self.snapshot()
         lines = []
         for table, n in sorted(snap["objects"].items()):
             lines.append(f'swarm_manager_{table}s{{}} {n}')
         for state, n in sorted(snap["node_states"].items()):
             lines.append(f'swarm_node_info{{state="{state.lower()}"}} {n}')
+        for h in sorted(all_histograms(), key=lambda h: h.name):
+            lines.append(h.prometheus_text())
         return "\n".join(lines) + "\n"
 
     # -- internals ---------------------------------------------------------

@@ -1,0 +1,70 @@
+"""Wedged-store watchdog.
+
+Re-derivation of the reference's self-diagnostic (memory.go:1024-1031 +
+raft.go:589-606): if a store write transaction holds the update lock past
+the wedge timeout, something is deadlocked or stuck — dump every thread's
+stack for the postmortem and transfer raft leadership so another manager
+takes over the control plane while this process is degraded.
+"""
+from __future__ import annotations
+
+import logging
+import sys
+import threading
+import traceback
+
+log = logging.getLogger("swarmkit_tpu.manager.wedge")
+
+
+def dump_all_stacks() -> str:
+    """All live threads' stacks (the Go runtime stack-dump analogue)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in sys._current_frames().items():
+        out.append(f"--- thread {names.get(ident, ident)} ---")
+        out.append("".join(traceback.format_stack(frame)))
+    return "\n".join(out)
+
+
+class WedgeMonitor:
+    def __init__(self, store, raft_node=None, check_interval: float = 5.0):
+        self.store = store
+        self.raft = raft_node
+        self.check_interval = check_interval
+        self.fired = 0  # episodes acted upon (observable for tests)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._in_episode = False
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="wedge-monitor")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _run(self):
+        while not self._stop.wait(self.check_interval):
+            try:
+                wedged = self.store.wedged()
+            except Exception:
+                continue
+            if not wedged:
+                self._in_episode = False
+                continue
+            if self._in_episode:
+                continue  # act once per episode
+            self._in_episode = True
+            log.error("store is wedged (update lock held beyond %.0fs); "
+                      "dumping stacks and transferring leadership\n%s",
+                      getattr(self.store, "wedge_timeout", 30.0),
+                      dump_all_stacks())
+            if self.raft is not None:
+                try:
+                    self.raft.transfer_leadership()
+                except Exception:
+                    log.exception("leadership transfer failed")
+            self.fired += 1  # after acting: observers see completed episodes

@@ -178,6 +178,7 @@ class SwarmNode:
         self._dispatcher_shim: RemoteDispatcher | None = None
         self._manager_addrs: list[str] = []
         self._role_flip_active = False
+        self._last_session_msg = None
 
     # ------------------------------------------------------------- identity
 
@@ -612,8 +613,14 @@ class SwarmNode:
 
     def _refresh_managers_loop(self, dispatcher: RemoteDispatcher):
         """Keep the agent's manager seed list fresh even when the session
-        stream is down (the Session message plane is the primary source)."""
+        stream is down (the Session message plane is the primary source),
+        and re-arm role flips: session messages are change-driven, so a
+        flip attempt that failed (e.g. CA briefly unreachable) would
+        otherwise never retry."""
         while not self._stop.wait(self.manager_refresh_interval):
+            msg = self._last_session_msg
+            if msg is not None:
+                self._maybe_flip_roles(msg)
             try:
                 managers = dispatcher._conn().call("cluster.managers",
                                                    timeout=5.0)
@@ -636,6 +643,10 @@ class SwarmNode:
                 self.executor.set_network_bootstrap_keys(msg.network_keys)
             except Exception:
                 pass
+        self._last_session_msg = msg
+        self._maybe_flip_roles(msg)
+
+    def _maybe_flip_roles(self, msg):
         desired = msg.desired_role
         if desired is None:
             return

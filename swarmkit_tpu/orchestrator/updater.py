@@ -11,6 +11,7 @@ updater.go:49-75).
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 
@@ -24,6 +25,8 @@ from ..api.types import (
 )
 from ..store import by
 from .task import is_task_dirty, new_task
+
+log = logging.getLogger("swarmkit_tpu.orchestrator.updater")
 
 
 class Updater(threading.Thread):
@@ -85,11 +88,38 @@ class Updater(threading.Thread):
             if not dirty:
                 break
             parallelism = cfg.parallelism or len(dirty)
-            for slot_tasks in dirty[:parallelism]:
-                nid = self._update_slot(service, slot_tasks, cfg.order)
-                if nid and cfg.monitor > 0:
+            batch = dirty[:parallelism]
+            # slot flips observe task states (two-phase orders), so the
+            # batch runs them concurrently like the reference's worker
+            # pool (updater.go:190-200)
+            new_ids: list[str | None] = [None] * len(batch)
+
+            def flip(i, slot_tasks):
+                try:
+                    new_ids[i] = self._update_slot(slot_tasks, cfg.order)
+                except Exception:
+                    log.exception("updater %s: slot flip failed",
+                                  self.service_id[:8])
+                    new_ids[i] = None
+
+            workers = [threading.Thread(target=flip, args=(i, st),
+                                        daemon=True)
+                       for i, st in enumerate(batch)]
+            for w in workers:
+                w.start()
+            for w in workers:
+                w.join()
+            for nid in new_ids:
+                if nid is None:
+                    continue  # failed flips don't dilute the failure ratio
+                if cfg.monitor > 0:
                     monitored[nid] = time.monotonic() + cfg.monitor
                 updated += 1
+            if not any(new_ids):
+                # every flip failed (store unavailable during churn): back
+                # off instead of hot-spinning fresh batches
+                if self._cancel.wait(1.0):
+                    return
             poll_failures()
             # CONTINUE keeps rolling despite failures; PAUSE/ROLLBACK stop
             if over_threshold() and \
@@ -135,9 +165,48 @@ class Updater(threading.Thread):
                 dirty.append(live)
         return dirty
 
-    def _update_slot(self, service, slot_tasks: list[Task], order) -> str | None:
-        """Replace one slot's tasks with a fresh-spec task. Returns new id."""
+    # bound for the stop-first old-task drain; the start-first wait for the
+    # replacement is UNbounded (as in the reference) — giving up there
+    # would spawn a duplicate replacement into the still-dirty slot
+    SLOT_PHASE_TIMEOUT = 30.0
+
+    def _update_slot(self, slot_tasks: list[Task], order) -> str | None:
+        """Replace one slot's tasks with a fresh-spec task. Returns new id.
+
+        Both orders are two-phase (update/updater.go:367-451):
+          start-first: create + start the replacement, WAIT until it is
+          observed RUNNING (replica count never dips below desired), then
+          shut the old tasks down; if the replacement dies first, the old
+          tasks are left running and the failure feeds the monitor.
+          stop-first: shut the old tasks down, WAIT until they stopped,
+          then create the replacement.
+        """
         slot = slot_tasks[0].slot
+        if order == UpdateOrder.START_FIRST:
+            new_id = self._create_replacement(slot, TaskState.RUNNING)
+            if new_id is None:
+                return None
+            outcome = self._wait_task_state(new_id, TaskState.RUNNING,
+                                            timeout=None)
+            if outcome == "running":
+                self._shutdown_tasks(slot_tasks)
+            return new_id
+        # stop-first: the replacement is created (desired READY) in the
+        # SAME transaction that brings the old tasks down, so the slot
+        # never looks empty to the orchestrator's reconcile — else it
+        # races in a duplicate replica (updater.go:385-409 does the
+        # create + removeOldTasks in one batch for this exact reason).
+        # The READY→RUNNING promote happens once the old tasks stopped.
+        new_id = self._create_replacement(slot, TaskState.READY,
+                                          shutdown=slot_tasks)
+        if new_id is None:
+            return None
+        self._wait_tasks_stopped(slot_tasks)
+        self._promote(new_id)
+        return new_id
+
+    def _create_replacement(self, slot: int, desired: TaskState,
+                            shutdown: list[Task] = ()) -> str | None:
         new_task_id: list[str | None] = [None]
 
         def cb(tx):
@@ -145,36 +214,71 @@ class Updater(threading.Thread):
             if cur_service is None:
                 return
             replacement = new_task(None, cur_service, slot)
-            if order == UpdateOrder.START_FIRST:
-                replacement.desired_state = TaskState.READY
-                tx.create(replacement)
-                # old tasks shut down once replacement starts; simplified:
-                # shut down now but after creation (start-first semantics are
-                # refined with the task-state watcher in a later layer)
-            else:
-                replacement.desired_state = TaskState.READY
+            replacement.desired_state = desired
+            tx.create(replacement)
+            for t in shutdown:
+                cur = tx.get_task(t.id)
+                if cur is not None and cur.desired_state < TaskState.SHUTDOWN:
+                    cur = cur.copy()
+                    cur.desired_state = TaskState.SHUTDOWN
+                    tx.update(cur)
+            new_task_id[0] = replacement.id
+
+        self.store.update(cb)
+        return new_task_id[0]
+
+    def _shutdown_tasks(self, slot_tasks: list[Task]):
+        def cb(tx):
             for t in slot_tasks:
                 cur = tx.get_task(t.id)
                 if cur is not None and cur.desired_state < TaskState.SHUTDOWN:
                     cur = cur.copy()
                     cur.desired_state = TaskState.SHUTDOWN
                     tx.update(cur)
-            if order != UpdateOrder.START_FIRST:
-                tx.create(replacement)
-            new_task_id[0] = replacement.id
 
         self.store.update(cb)
-        if new_task_id[0]:
-            # promote READY→RUNNING immediately (no restart delay on update)
-            def promote(tx):
-                cur = tx.get_task(new_task_id[0])
-                if cur is not None and cur.desired_state == TaskState.READY:
-                    cur = cur.copy()
-                    cur.desired_state = TaskState.RUNNING
-                    tx.update(cur)
 
-            self.store.update(promote)
-        return new_task_id[0]
+    def _promote(self, task_id: str):
+        def cb(tx):
+            cur = tx.get_task(task_id)
+            if cur is not None and cur.desired_state == TaskState.READY:
+                cur = cur.copy()
+                cur.desired_state = TaskState.RUNNING
+                tx.update(cur)
+
+        self.store.update(cb)
+
+    def _wait_task_state(self, task_id: str, want: TaskState,
+                         timeout: float | None = SLOT_PHASE_TIMEOUT) -> str:
+        """Poll until the task is observed at `want`, dies first, the
+        updater is cancelled, or (when bounded) the phase times out.
+        Returns 'running' | 'failed' | 'timeout'."""
+        deadline = (time.monotonic() + timeout) if timeout is not None \
+            else float("inf")
+        while not self._cancel.is_set() and time.monotonic() < deadline:
+            t = self.store.view().get_task(task_id)
+            if t is None:
+                return "failed"
+            if t.status.state >= TaskState.FAILED:
+                return "failed"
+            if t.status.state >= want:
+                return "running"
+            if self._cancel.wait(0.05):
+                break
+        return "timeout"
+
+    def _wait_tasks_stopped(self, slot_tasks: list[Task]):
+        deadline = time.monotonic() + self.SLOT_PHASE_TIMEOUT
+        ids = [t.id for t in slot_tasks]
+        while not self._cancel.is_set() and time.monotonic() < deadline:
+            view = self.store.view()
+            live = [tid for tid in ids
+                    if (t := view.get_task(tid)) is not None
+                    and t.status.state <= TaskState.RUNNING]
+            if not live:
+                return
+            if self._cancel.wait(0.05):
+                return
 
     def _rollback(self, service):
         def cb(tx):

@@ -76,3 +76,12 @@ class InstallSnapshot(Message):
     members: dict[int, tuple[str, str]] = field(default_factory=dict)
     data: Any = None
     kind: str = "snapshot"
+
+
+@dataclass
+class TimeoutNow(Message):
+    """Leadership transfer (raft §3.10 / etcd MsgTimeoutNow): the leader
+    tells its most caught-up peer to campaign immediately; the new term
+    deposes the sender (used by the wedge monitor, raft.go:589-606)."""
+
+    kind: str = "timeout_now"

@@ -185,6 +185,12 @@ class RaftNode:
                             callback: Callable[[bool, str], None]):
         self._inbox.put(("conf", cc, request_id, callback))
 
+    def transfer_leadership(self):
+        """Hand leadership to the most caught-up peer (wedged-store escape
+        hatch, raft.go:589-606): send it TimeoutNow so it campaigns at once;
+        its higher term deposes us. No-op unless we lead with peers."""
+        self._inbox.put(("transfer",))
+
     def campaign(self):
         """Force an immediate election (tests / bootstrap)."""
         self._inbox.put(("campaign",))
@@ -277,6 +283,8 @@ class RaftNode:
             self._on_conf_change(item[1], item[2], item[3])
         elif kind == "campaign":
             self._campaign()
+        elif kind == "transfer":
+            self._on_transfer()
 
     # ----------------------------------------------------------------- ticks
     def _next_timeout(self) -> int:
@@ -375,9 +383,30 @@ class RaftNode:
             "append": self._on_append,
             "append_resp": self._on_append_response,
             "snapshot": self._on_install_snapshot,
+            "timeout_now": self._on_timeout_now,
         }.get(msg.kind)
         if handler:
             handler(msg)
+
+    def _on_timeout_now(self, msg):
+        """Leadership-transfer target: campaign immediately (raft §3.10).
+        Gated on the CURRENT term's leader — a delayed/replayed transfer
+        from a deposed leader must not disrupt a healthy one (etcd gates
+        MsgTimeoutNow the same way)."""
+        if self.id in self.members and msg.term == self.term \
+                and msg.frm == self.leader_id:
+            self._campaign()
+
+    def _on_transfer(self):
+        from .messages import TimeoutNow
+
+        if self.role != LEADER:
+            return
+        peers = [p for p in self.members if p != self.id]
+        if not peers:
+            return
+        target = max(peers, key=lambda p: self.match_index.get(p, 0))
+        self._send(TimeoutNow(frm=self.id, to=target, term=self.term))
 
     def _on_vote_request(self, msg: VoteRequest):
         grant = False

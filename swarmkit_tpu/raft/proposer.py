@@ -12,12 +12,20 @@ from __future__ import annotations
 import threading
 from typing import Callable
 
+import time
+
 from ..api.objects import Version
 from ..utils.identity import new_id
+from ..utils.metrics import histogram
 from .messages import Entry
 from .node import RaftNode
 
 PROPOSE_TIMEOUT = 30.0
+
+# reference: swarm_raft_transaction_latency (raft.go:204-209)
+_propose_latency = histogram(
+    "swarm_raft_transaction_latency_seconds",
+    "raft proposal submit→commit duration")
 
 
 class ProposeError(Exception):
@@ -61,8 +69,11 @@ class RaftProposer:
             outcome["err"] = err
             done.set()
 
+        start = time.monotonic()
         self.node.propose(list(actions), req_id, on_result)
-        if not done.wait(PROPOSE_TIMEOUT):
+        if done.wait(PROPOSE_TIMEOUT):
+            _propose_latency.observe(time.monotonic() - start)
+        else:
             with self._lock:
                 self._pending.pop(req_id, None)
             raise ProposeError("proposal timed out")

@@ -121,6 +121,12 @@ def build_manager_registry(manager, raft_node=None,
 
     # ---------------------------------------------------------------- raft
     if raft_node is not None:
+        # membership changes must serialize: two concurrent joins would
+        # both read max(members)+1 and claim the SAME raft id, leaving two
+        # processes answering for one quorum seat (the reference guards
+        # Join with the membership lock for exactly this)
+        join_lock = threading.Lock()
+
         def raft_step(caller, msg):
             raft_node.step(msg)
             return None
@@ -132,7 +138,12 @@ def build_manager_registry(manager, raft_node=None,
         def raft_join(caller, node_id, addr):
             """RaftMembership.Join (api/raft.proto:39-44, raft.go Join:926):
             leader allocates a raft id, proposes the conf-change, returns
-            the member list for the joiner's bootstrap."""
+            the member list for the joiner's bootstrap. Serialized: the id
+            allocation reads the membership it is about to extend."""
+            with join_lock:
+                return _raft_join_locked(caller, node_id, addr)
+
+        def _raft_join_locked(caller, node_id, addr):
             from ..raft.messages import ConfChange
             from ..utils.identity import new_id
 
@@ -589,7 +600,7 @@ class RemoteControl:
     is retried briefly — the reference's connection broker re-selects a
     manager instead of surfacing transient NotLeader errors to the CLI."""
 
-    RETRY_WINDOW = 15.0
+    RETRY_WINDOW = 30.0
     RETRY_PAUSE = 0.5
 
     def __init__(self, addr: str, security):

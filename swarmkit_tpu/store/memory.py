@@ -38,7 +38,16 @@ from ..api.objects import (
     Volume,
 )
 from . import by as by_mod
+from ..utils.metrics import histogram
 from .watch import Channel, WatchQueue
+
+# store tx latency + lock-hold timers (memory.go:99-112)
+_read_tx_latency = histogram(
+    "swarm_store_read_tx_latency_seconds", "read transaction duration")
+_write_tx_latency = histogram(
+    "swarm_store_write_tx_latency_seconds", "write transaction duration")
+_lock_hold = histogram(
+    "swarm_store_lock_hold_seconds", "update-lock hold duration")
 
 # Batch limits (reference: manager/state/store/memory.go:47-51).
 MAX_CHANGES_PER_TRANSACTION = 200
@@ -196,6 +205,7 @@ class MemoryStore:
         self._lock = threading.RLock()          # guards table reads
         self._update_lock = threading.Lock()    # serializes writers (memory.go updateLock)
         self._update_lock_held_since: float | None = None
+        self.wedge_timeout = WEDGE_TIMEOUT      # per-store override for tests
         self.proposer = proposer
         self.queue = WatchQueue()
         self._version = Version(0)  # commit version when no proposer drives it
@@ -205,15 +215,20 @@ class MemoryStore:
         tx = ReadTx(self)
         if cb is None:
             return tx
-        with self._lock:
-            return cb(tx)
+        start = time.monotonic()
+        try:
+            with self._lock:
+                return cb(tx)
+        finally:
+            _read_tx_latency.observe(time.monotonic() - start)
 
     # ----------------------------------------------------------------- writes
     def update(self, cb: Callable[[WriteTx], Any]) -> Any:
         """Run a write transaction; commit through the proposer when present
         (memory.go:321-388)."""
+        start = time.monotonic()
         with self._update_lock:
-            self._update_lock_held_since = time.monotonic()
+            self._update_lock_held_since = held = time.monotonic()
             try:
                 tx = WriteTx(self)
                 cb(tx)
@@ -238,6 +253,9 @@ class MemoryStore:
                 return None
             finally:
                 self._update_lock_held_since = None
+                now = time.monotonic()
+                _lock_hold.observe(now - held)
+                _write_tx_latency.observe(now - start)
 
     def _commit(self, tx: WriteTx, version_index: int | None = None) -> None:
         now = time.time()
@@ -387,7 +405,8 @@ class MemoryStore:
     def wedged(self) -> bool:
         """Wedge detector (memory.go:1024-1031)."""
         since = self._update_lock_held_since
-        return since is not None and time.monotonic() - since > WEDGE_TIMEOUT
+        return since is not None and \
+            time.monotonic() - since > self.wedge_timeout
 
     # ---------------------------------------------------------------- indexes
     def _index_entries(self, obj: StoreObject) -> list[tuple[str, Any]]:

@@ -1,0 +1,69 @@
+"""Hot-path latency histograms (the reference's prometheus timers:
+store tx / lock-hold — memory.go:99-112, raft propose — raft.go:204-209,
+dispatcher scheduling delay — dispatcher.go:72-77).
+
+A tiny fixed-bucket histogram with a process-global registry; the metrics
+collector appends these to its Prometheus text exposition. Observation is
+a few dict ops under a lock — cheap enough for every store transaction.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+
+# prometheus-style default buckets, seconds
+DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str = "",
+                 buckets: tuple = DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(buckets)
+        self._counts = [0] * (len(self.buckets) + 1)  # +inf tail
+        self._sum = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float):
+        i = bisect.bisect_left(self.buckets, seconds)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += seconds
+            self._n += 1
+
+    def snapshot(self) -> tuple[list[int], float, int]:
+        with self._lock:
+            return list(self._counts), self._sum, self._n
+
+    def prometheus_text(self) -> str:
+        counts, total, n = self.snapshot()
+        lines = [f"# TYPE {self.name} histogram"]
+        cum = 0
+        for b, c in zip(self.buckets, counts):
+            cum += c
+            lines.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {n}')
+        lines.append(f"{self.name}_sum {total:.6f}")
+        lines.append(f"{self.name}_count {n}")
+        return "\n".join(lines)
+
+
+_registry: dict[str, Histogram] = {}
+_registry_lock = threading.Lock()
+
+
+def histogram(name: str, help_: str = "") -> Histogram:
+    with _registry_lock:
+        h = _registry.get(name)
+        if h is None:
+            h = Histogram(name, help_)
+            _registry[name] = h
+        return h
+
+
+def all_histograms() -> list[Histogram]:
+    with _registry_lock:
+        return list(_registry.values())

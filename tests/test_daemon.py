@@ -258,14 +258,29 @@ def test_restarted_manager_rejoins_from_state_dir(tmp_path, cluster_nodes):
     m2.stop()
     time.sleep(0.5)
     state_dir = m2.state_dir
-    m2b = SwarmNode(
-        state_dir=state_dir,
-        executor=FakeExecutor({"*": {"run_forever": True}}, hostname="m2"),
-        listen_addr="127.0.0.1:" + m2.advertise_addr.rsplit(":", 1)[1],
-        heartbeat_period=0.5,
-        tick_interval=0.05,
-    )
-    m2b.start()
+    def start_m2b():
+        node = SwarmNode(
+            state_dir=state_dir,
+            executor=FakeExecutor({"*": {"run_forever": True}},
+                                  hostname="m2"),
+            listen_addr="127.0.0.1:" + m2.advertise_addr.rsplit(":", 1)[1],
+            heartbeat_period=0.5,
+            tick_interval=0.05,
+        )
+        node.start()
+        return node
+
+    # the OS can hold the old listener briefly after stop; retry like a
+    # process supervisor would
+    end = time.monotonic() + 15
+    while True:
+        try:
+            m2b = start_m2b()
+            break
+        except OSError:
+            if time.monotonic() >= end:
+                raise
+            time.sleep(0.5)
     cluster_nodes.append(m2b)
     assert m2b.node_id == old_id
     assert m2b.raft_id == old_raft_id

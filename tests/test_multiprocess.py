@@ -32,10 +32,14 @@ class Swarmd:
         env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
         # daemons must not inherit the test conftest's virtual-device env
         env.pop("XLA_FLAGS", None)
+        # tick 0.2s → 2-4s election timeouts: four Python processes on a
+        # loaded CI machine can stall past aggressive sub-second timeouts,
+        # churning elections indefinitely (the reference defaults to 1s
+        # ticks / 10s timeouts for the same reason)
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "swarmkit_tpu.cmd.swarmd",
              "--state-dir", os.path.join(base, name),
-             "--heartbeat-period", "0.5", "--tick-interval", "0.05",
+             "--heartbeat-period", "0.5", "--tick-interval", "0.2",
              *args],
             stdout=self._log, stderr=subprocess.STDOUT, env=env, cwd=REPO)
 
@@ -115,11 +119,22 @@ def test_multiprocess_cluster_survives_leader_sigkill(tmp_path):
 
         sec = _load_identity(base, "m2")
         ctl = RemoteControl(m2.addr, sec)
-        svc = ctl.create_service(ServiceSpec(
+        spec = ServiceSpec(
             annotations=Annotations(name="sleepers"),
             replicas=6,
             task=TaskSpec(runtime=ContainerSpec(command=["sleep", "3600"])),
-        ))
+        )
+        # elections right after cluster formation can outlast a single
+        # retry window on a loaded machine — keep trying like an operator
+        svc = None
+        end = time.monotonic() + 90
+        while svc is None:
+            try:
+                svc = ctl.create_service(spec)
+            except Exception:
+                if time.monotonic() >= end:
+                    raise
+                time.sleep(1)
 
         def n_running(control):
             try:

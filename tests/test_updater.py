@@ -1,0 +1,106 @@
+"""Rolling-update orders (update/updater.go:367-451): start-first keeps the
+replica count at or above desired throughout; stop-first drains a slot
+before replacing it."""
+import threading
+import time
+
+import pytest
+
+from swarmkit_tpu.agent.agent import Agent
+from swarmkit_tpu.agent.testutils import FakeExecutor
+from swarmkit_tpu.api.specs import Annotations, ContainerSpec, ServiceSpec, TaskSpec, UpdateConfig
+from swarmkit_tpu.api.types import TaskState, UpdateOrder
+from swarmkit_tpu.manager.manager import Manager
+from swarmkit_tpu.store import by
+
+from test_scheduler import wait_for  # noqa: E402
+
+
+@pytest.fixture
+def cluster():
+    m = Manager(heartbeat_period=0.5, key_rotation_interval=3600.0)
+    m.start()
+    agents = []
+    for i in range(2):
+        ex = FakeExecutor({"*": {"run_forever": True}}, hostname=f"w{i}")
+        a = Agent(f"w{i}", m.dispatcher, ex)
+        a.start()
+        agents.append(a)
+    yield m
+    for a in agents:
+        a.stop()
+    m.stop()
+
+
+def _running(m, svc_id):
+    tasks = m.store.view(lambda tx: tx.find_tasks(by.ByServiceID(svc_id)))
+    return [t for t in tasks if t.status.state == TaskState.RUNNING
+            and t.desired_state <= TaskState.RUNNING]
+
+
+def _make_service(m, name, order, replicas=4):
+    spec = ServiceSpec(
+        annotations=Annotations(name=name),
+        replicas=replicas,
+        task=TaskSpec(runtime=ContainerSpec(image="img:v1")),
+        update=UpdateConfig(parallelism=2, delay=0.0, monitor=0.2,
+                            order=order),
+    )
+    spec.spec_version_bump = True
+    return m.control_api.create_service(spec)
+
+
+def _trigger_update(m, svc):
+    cur = m.control_api.get_service(svc.id)
+    new_spec = cur.spec
+    new_spec.task.runtime.image = "img:v2"
+    return m.control_api.update_service(svc.id, cur.meta.version, new_spec)
+
+
+def test_start_first_never_dips_below_desired(cluster):
+    m = cluster
+    svc = _make_service(m, "sf", UpdateOrder.START_FIRST, replicas=4)
+    assert wait_for(lambda: len(_running(m, svc.id)) == 4, timeout=15)
+
+    # sample the live replica count continuously during the update
+    low_water = [4]
+    stop = threading.Event()
+
+    def sampler():
+        while not stop.is_set():
+            low_water[0] = min(low_water[0], len(_running(m, svc.id)))
+            time.sleep(0.01)
+
+    t = threading.Thread(target=sampler, daemon=True)
+    t.start()
+    _trigger_update(m, svc)
+
+    def updated():
+        tasks = [x for x in m.store.view(
+            lambda tx: tx.find_tasks(by.ByServiceID(svc.id)))
+            if x.desired_state <= TaskState.RUNNING]
+        return (len(tasks) == 4
+                and all(x.spec.runtime.image == "img:v2" for x in tasks)
+                and all(x.status.state == TaskState.RUNNING for x in tasks))
+
+    assert wait_for(updated, timeout=30)
+    stop.set()
+    t.join(timeout=2)
+    assert low_water[0] >= 4, f"replicas dipped to {low_water[0]}"
+
+
+def test_stop_first_replaces_all_slots(cluster):
+    m = cluster
+    svc = _make_service(m, "spf", UpdateOrder.STOP_FIRST, replicas=4)
+    assert wait_for(lambda: len(_running(m, svc.id)) == 4, timeout=15)
+    _trigger_update(m, svc)
+
+    def updated():
+        tasks = [x for x in m.store.view(
+            lambda tx: tx.find_tasks(by.ByServiceID(svc.id)))
+            if x.desired_state <= TaskState.RUNNING]
+        return (len(tasks) == 4
+                and all(x.spec.runtime.image == "img:v2" for x in tasks)
+                and all(x.status.state == TaskState.RUNNING for x in tasks))
+
+    assert wait_for(updated, timeout=30)

@@ -1,0 +1,116 @@
+"""Wedge watchdog + hot-path latency metrics (VERDICT item 9; reference
+memory.go:1024-1031, raft.go:589-606, memory.go:99-112, raft.go:204-209,
+dispatcher.go:72-77)."""
+import threading
+import time
+
+from swarmkit_tpu.api.objects import Node, Task
+from swarmkit_tpu.manager.metrics import MetricsCollector
+from swarmkit_tpu.manager.wedge import WedgeMonitor, dump_all_stacks
+from swarmkit_tpu.raft.testutils import RaftCluster
+from swarmkit_tpu.store.memory import MemoryStore
+from swarmkit_tpu.utils.metrics import histogram
+
+from test_scheduler import wait_for  # noqa: E402
+
+
+def test_wedge_monitor_dumps_and_transfers():
+    store = MemoryStore()
+    store.wedge_timeout = 0.2
+
+    transferred = []
+
+    class FakeRaft:
+        def transfer_leadership(self):
+            transferred.append(1)
+
+    mon = WedgeMonitor(store, FakeRaft(), check_interval=0.05)
+    mon.start()
+    try:
+        release = threading.Event()
+
+        def wedge(tx):
+            release.wait(timeout=5)
+
+        t = threading.Thread(target=lambda: store.update(wedge), daemon=True)
+        t.start()
+        assert wait_for(lambda: mon.fired >= 1, timeout=5)
+        assert transferred
+        fired_during = mon.fired
+        release.set()
+        t.join(timeout=5)
+        # a single wedge episode fires once, not per poll
+        time.sleep(0.3)
+        assert mon.fired == fired_during
+    finally:
+        mon.stop()
+
+
+def test_leadership_transfer_moves_leader():
+    c = RaftCluster(3)
+    leader = c.tick_until_leader()
+    old = leader.id
+    leader.transfer_leadership()
+    c.settle()
+    new_leader = c.leader()
+    assert new_leader is not None
+    assert new_leader.id != old, "leadership did not move"
+
+
+def test_stack_dump_contains_threads():
+    out = dump_all_stacks()
+    assert "thread MainThread" in out
+    assert "test_stack_dump_contains_threads" in out
+
+
+def test_store_latency_histograms_populate():
+    store = MemoryStore()
+    store.update(lambda tx: tx.create(Node(id="n1")))
+    store.view(lambda tx: tx.get_node("n1"))
+    for name in ("swarm_store_write_tx_latency_seconds",
+                 "swarm_store_read_tx_latency_seconds",
+                 "swarm_store_lock_hold_seconds"):
+        _counts, _total, n = histogram(name).snapshot()
+        assert n > 0, name
+
+
+def test_metrics_exposition_includes_histograms():
+    store = MemoryStore()
+    store.update(lambda tx: tx.create(Task(id="t1", service_id="s")))
+    mc = MetricsCollector(store)
+    mc.start()
+    try:
+        assert wait_for(
+            lambda: "swarm_manager_tasks" in mc.prometheus_text(), timeout=5)
+        text = mc.prometheus_text()
+        assert "swarm_store_write_tx_latency_seconds_count" in text
+        assert "# TYPE swarm_store_write_tx_latency_seconds histogram" in text
+    finally:
+        mc.stop()
+
+
+def test_propose_latency_histogram_populates():
+    from swarmkit_tpu.raft.proposer import RaftProposer
+
+    c = RaftCluster(3)
+    stores = {}
+    for i, node in c.nodes.items():
+        proposer = RaftProposer(node)
+        stores[i] = MemoryStore(proposer=proposer)
+        proposer.attach_store(stores[i])
+    leader = c.tick_until_leader()
+
+    before = histogram("swarm_raft_transaction_latency_seconds").snapshot()[2]
+
+    def run():
+        stores[leader.id].update(lambda tx: tx.create(Node(id="n1")))
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    for _ in range(2000):
+        if not t.is_alive():
+            break
+        c.settle()
+    t.join(timeout=5)
+    after = histogram("swarm_raft_transaction_latency_seconds").snapshot()[2]
+    assert after > before
