@@ -1,0 +1,62 @@
+"""External CA client: delegate node-certificate signing to an HTTPS
+service speaking the cfssl sign protocol (reference ca/external.go:228).
+
+    POST <url>  {"certificate_request": "<csr pem>"}
+    → {"success": true, "result": {"certificate": "<cert pem>"}}
+
+The connection authenticates the endpoint against a pinned trust root (the
+operator configures the external CA's certificate, CAConfig.external_cas);
+request bodies carry no cluster secrets beyond the CSR. Signing failures
+raise — the CA server keeps certificates PENDING and retries, identical to
+a transiently unavailable local signer.
+"""
+from __future__ import annotations
+
+import json
+import ssl
+import urllib.error
+import urllib.request
+
+
+class ExternalCAError(Exception):
+    pass
+
+
+class ExternalCA:
+    """ca/external.go ExternalCA: Sign(csr) via a cfssl-compatible URL."""
+
+    def __init__(self, url: str, trust_root_pem: bytes | None = None,
+                 timeout: float = 10.0):
+        self.url = url
+        self.timeout = timeout
+        if trust_root_pem:
+            self._ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            self._ctx.check_hostname = False
+            self._ctx.verify_mode = ssl.CERT_REQUIRED
+            self._ctx.load_verify_locations(
+                cadata=trust_root_pem.decode())
+        elif url.startswith("https://"):
+            self._ctx = ssl.create_default_context()
+        else:
+            self._ctx = None
+
+    def sign(self, csr_pem: bytes) -> bytes:
+        body = json.dumps(
+            {"certificate_request": csr_pem.decode()}).encode()
+        req = urllib.request.Request(
+            self.url, data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout,
+                                        context=self._ctx) as resp:
+                payload = json.loads(resp.read().decode())
+        except (urllib.error.URLError, OSError, ValueError) as exc:
+            raise ExternalCAError(f"external CA request failed: {exc}") \
+                from exc
+        if not payload.get("success"):
+            raise ExternalCAError(
+                f"external CA refused to sign: {payload.get('errors')}")
+        cert = payload.get("result", {}).get("certificate", "")
+        if not cert:
+            raise ExternalCAError("external CA returned no certificate")
+        return cert.encode()

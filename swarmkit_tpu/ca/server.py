@@ -25,18 +25,23 @@ from ..api.types import IssuanceState, NodeRole
 from ..store import by
 from ..utils.identity import new_id
 from .auth import PermissionDenied
-from .certificates import RootCA
+from .certificates import CertificateError, RootCA
 from .config import InvalidToken, parse_join_token
 
 
 class CAServer:
     """Signs CSRs recorded on Node objects (reference ca/server.go Server)."""
 
-    def __init__(self, store, root: RootCA, cluster_id: str, org: str = "swarmkit-tpu"):
+    def __init__(self, store, root: RootCA, cluster_id: str,
+                 org: str = "swarmkit-tpu", external_ca=None):
         self.store = store
         self.root = root
         self.cluster_id = cluster_id
         self.org = org
+        # optional ca.external.ExternalCA: signing delegates to the
+        # operator's CA service; the local root stays the trust anchor
+        # (ca/external.go — the external CA signs under the same root)
+        self.external_ca = external_ca
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._wake = threading.Event()
@@ -222,10 +227,32 @@ class CAServer:
             observed_state = node.certificate.status_state
             signed_csr = node.certificate.csr_pem
             try:
-                cert_pem = signing_root.sign_csr(
-                    signed_csr,
-                    subject=(node.id, node.certificate.role, self.org),
-                )
+                if self.external_ca is not None:
+                    from .certificates import parse_cert_identity
+                    from .external import ExternalCAError
+
+                    try:
+                        cert_pem = self.external_ca.sign(signed_csr)
+                    except ExternalCAError:
+                        continue  # transient: stays PENDING, retried
+                    # the external service signs the CSR's self-asserted
+                    # subject — refuse to PUBLISH a cert whose identity
+                    # differs from what this server assigned (a node
+                    # could otherwise CSR itself into CN=<other node> or
+                    # OU=manager; the local path forces the subject in
+                    # sign_csr, so only this path needs the check)
+                    ident = parse_cert_identity(cert_pem)
+                    if ident.node_id != node.id \
+                            or ident.role != node.certificate.role:
+                        raise CertificateError(
+                            "external CA returned a certificate for "
+                            f"{ident.node_id!r} role {ident.role}, expected "
+                            f"{node.id!r} role {node.certificate.role}")
+                else:
+                    cert_pem = signing_root.sign_csr(
+                        signed_csr,
+                        subject=(node.id, node.certificate.role, self.org),
+                    )
                 state, err = IssuanceState.ISSUED, ""
             except Exception as exc:
                 cert_pem, state, err = b"", IssuanceState.FAILED, str(exc)

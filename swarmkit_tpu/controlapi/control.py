@@ -103,8 +103,10 @@ class ControlAPI:
 
     def _committed(self, obj):
         """Re-read an object after commit: WriteTx buffers copies, so the
-        reference we appended pre-commit carries a stale meta.version."""
-        return self.store.view().get(type(obj), obj.id)
+        reference we appended pre-commit carries a stale meta.version.
+        Returns a COPY — control-surface callers own what they receive."""
+        got = self.store.view().get(type(obj), obj.id)
+        return got.copy() if got is not None else None
 
     # ------------------------------------------------------------ validation
     @staticmethod
@@ -180,11 +182,11 @@ class ControlAPI:
             tx.create(svc)
 
         self.store.update(cb)
-        return self.store.view().get_service(svc.id)
+        return self.store.view().get_service(svc.id).copy()
 
     def get_service(self, service_id: str) -> Service:
         s = self.store.view().get_service(service_id)
-        if s is None:
+        if s is None or s.pending_delete:
             raise NotFound(f"service {service_id} not found")
         return s.copy()
 
@@ -196,7 +198,7 @@ class ControlAPI:
 
         def cb(tx):
             cur = tx.get_service(service_id)
-            if cur is None:
+            if cur is None or cur.pending_delete:
                 raise NotFound(f"service {service_id} not found")
             self._validate_service_spec(tx, spec)
             if cur.meta.version.index != version.index:
@@ -226,16 +228,31 @@ class ControlAPI:
         return self._committed(out[0])
 
     def remove_service(self, service_id: str) -> None:
+        """Removal is deferred while tasks exist: the service is marked
+        pending_delete (hidden from get/list), the orchestrator winds its
+        tasks down, and the deallocator deletes the record once the last
+        task is gone (manager/deallocator/deallocator.go — 'the only place
+        services are ever deleted'). A service with no tasks left is
+        deleted immediately."""
+
         def cb(tx):
-            if tx.get_service(service_id) is None:
+            s = tx.get_service(service_id)
+            if s is None or s.pending_delete:
                 raise NotFound(f"service {service_id} not found")
-            tx.delete(Service, service_id)
+            if not tx.find_tasks(by.ByServiceID(service_id)):
+                tx.delete(Service, service_id)
+                return
+            s = s.copy()
+            s.pending_delete = True
+            tx.update(s)
 
         self.store.update(cb)
 
     def list_services(self, filters: ListFilters | None = None) -> list[Service]:
         out = []
         for s in self.store.view().find_services():
+            if s.pending_delete:
+                continue  # removal in progress: hidden from the surface
             if not _match_filters(s, filters):
                 continue
             if filters and filters.modes and s.spec.mode not in filters.modes:
@@ -430,15 +447,15 @@ class ControlAPI:
             tx.create(sec)
 
         self.store.update(cb)
-        return self.store.view().get_secret(sec.id)
+        return self.store.view().get_secret(sec.id).copy()
 
     def get_secret(self, secret_id: str, clear_data: bool = True) -> Secret:
         s = self.store.view().get_secret(secret_id)
         if s is None:
             raise NotFound(f"secret {secret_id} not found")
+        s = s.copy()
         if clear_data:
             # reference: secret.go GetSecret strips data on the read path
-            s = s.copy()
             s.spec.data = b""
         return s
 
@@ -505,13 +522,13 @@ class ControlAPI:
             tx.create(cfg)
 
         self.store.update(cb)
-        return self.store.view().get_config(cfg.id)
+        return self.store.view().get_config(cfg.id).copy()
 
     def get_config(self, config_id: str) -> Config:
         c = self.store.view().get_config(config_id)
         if c is None:
             raise NotFound(f"config {config_id} not found")
-        return c
+        return c.copy()
 
     def update_config(self, config_id: str, version: Version,
                       spec: ConfigSpec) -> Config:
@@ -550,7 +567,7 @@ class ControlAPI:
         self.store.update(cb)
 
     def list_configs(self, filters: ListFilters | None = None) -> list[Config]:
-        return [c for c in self.store.view().find_configs()
+        return [c.copy() for c in self.store.view().find_configs()
                 if _match_filters(c, filters)]
 
     # -------------------------------------------------------------- networks
@@ -568,13 +585,13 @@ class ControlAPI:
             tx.create(net)
 
         self.store.update(cb)
-        return self.store.view().get_network(net.id)
+        return self.store.view().get_network(net.id).copy()
 
     def get_network(self, network_id: str) -> Network:
         n = self.store.view().get_network(network_id)
         if n is None:
             raise NotFound(f"network {network_id} not found")
-        return n
+        return n.copy()
 
     def remove_network(self, network_id: str) -> None:
         """Fails while in use (reference: network.go RemoveNetwork)."""
@@ -594,7 +611,7 @@ class ControlAPI:
         self.store.update(cb)
 
     def list_networks(self, filters: ListFilters | None = None) -> list[Network]:
-        return [n for n in self.store.view().find_networks()
+        return [n.copy() for n in self.store.view().find_networks()
                 if _match_filters(n, filters)]
 
     # --------------------------------------------------------------- volumes
@@ -611,13 +628,13 @@ class ControlAPI:
             tx.create(vol)
 
         self.store.update(cb)
-        return self.store.view().get_volume(vol.id)
+        return self.store.view().get_volume(vol.id).copy()
 
     def get_volume(self, volume_id: str) -> Volume:
         v = self.store.view().get_volume(volume_id)
         if v is None:
             raise NotFound(f"volume {volume_id} not found")
-        return v
+        return v.copy()
 
     def update_volume(self, volume_id: str, version: Version,
                       spec: VolumeSpec) -> Volume:
@@ -660,7 +677,7 @@ class ControlAPI:
         self.store.update(cb)
 
     def list_volumes(self, filters: ListFilters | None = None) -> list[Volume]:
-        return [v for v in self.store.view().find_volumes()
+        return [v.copy() for v in self.store.view().find_volumes()
                 if _match_filters(v, filters)]
 
     # ------------------------------------------------ extensions & resources
@@ -676,13 +693,13 @@ class ControlAPI:
             tx.create(ext)
 
         self.store.update(cb)
-        return self.store.view().get_extension(ext.id)
+        return self.store.view().get_extension(ext.id).copy()
 
     def get_extension(self, extension_id: str) -> Extension:
         e = self.store.view().get_extension(extension_id)
         if e is None:
             raise NotFound(f"extension {extension_id} not found")
-        return e
+        return e.copy()
 
     def remove_extension(self, extension_id: str) -> None:
         def cb(tx):
@@ -713,13 +730,13 @@ class ControlAPI:
             tx.create(res)
 
         self.store.update(cb)
-        return self.store.view().get_resource(res.id)
+        return self.store.view().get_resource(res.id).copy()
 
     def get_resource(self, resource_id: str) -> Resource:
         r = self.store.view().get_resource(resource_id)
         if r is None:
             raise NotFound(f"resource {resource_id} not found")
-        return r
+        return r.copy()
 
     def update_resource(self, resource_id: str, version: Version,
                         annotations, payload: bytes) -> Resource:
@@ -751,5 +768,5 @@ class ControlAPI:
     def list_resources(self, kind: str | None = None,
                        filters: ListFilters | None = None) -> list[Resource]:
         sel = [by.ByKind(kind)] if kind else []
-        return [r for r in self.store.view().find_resources(*sel)
+        return [r.copy() for r in self.store.view().find_resources(*sel)
                 if _match_filters(r, filters, annotations=r.annotations)]

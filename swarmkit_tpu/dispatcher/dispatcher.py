@@ -111,8 +111,10 @@ class Dispatcher:
     def __init__(self, store: MemoryStore,
                  heartbeat_period: float = DEFAULT_HEARTBEAT_PERIOD,
                  node_down_period: float = DEFAULT_NODE_DOWN_PERIOD,
-                 rate_limit_period: float = RATE_LIMIT_PERIOD):
+                 rate_limit_period: float = RATE_LIMIT_PERIOD,
+                 secret_drivers=None):
         self.store = store
+        self.secret_drivers = secret_drivers  # DriverRegistry | None
         self.heartbeat_period = heartbeat_period
         self.node_down_period = node_down_period
         self.rate_limit_period = rate_limit_period
@@ -129,6 +131,8 @@ class Dispatcher:
         # down-node timers driving the 24h → ORPHANED transition
         self._orphan_timers: dict[str, Heartbeat] = {}
         self._session_plane_dirty = False
+        # (secret id, secret version, task id) -> materialized clone
+        self._driver_cache: dict[tuple, object] = {}
 
     # ------------------------------------------------------------- lifecycle
     def start(self):
@@ -553,6 +557,11 @@ class Dispatcher:
     def _note_event(self, ev):
         obj = getattr(ev, "obj", None)
         if isinstance(obj, Task):
+            if isinstance(ev, EventDelete):
+                with self._lock:
+                    for key in [k for k in self._driver_cache
+                                if k[2] == obj.id]:
+                        del self._driver_cache[key]
             if obj.node_id:
                 with self._lock:
                     self._dirty_nodes.add(obj.node_id)
@@ -629,11 +638,44 @@ class Dispatcher:
             availability=v.spec.availability,
         )
 
-    def _referenced_deps(self, tx, tasks, node_id: str) -> tuple[dict, dict, dict]:
+    def _materialize_driver_secret(self, secret, task, node_id: str):
+        """Driver-provided secret: per-task clone with the plugin's payload
+        (assignments.go:51-81 task-specific cloning — id is suffixed with
+        the task id so one task can never read another's credentials).
+
+        Runs OUTSIDE any store transaction — drivers do external I/O and
+        must never stall the store lock. Results cache per
+        (secret version, task), so incrementals don't re-fire plugin RPCs.
+        """
+        key = (secret.id, secret.meta.version.index, task.id)
+        with self._lock:
+            cached = self._driver_cache.get(key)
+        if cached is not None:
+            return cached
+        driver_cfg = secret.spec.driver or {}
+        name = driver_cfg.get("name", "")
+        driver = self.secret_drivers.get(name) if self.secret_drivers else None
+        if driver is None:
+            return None
+        try:
+            payload = driver.get(secret, task, node_id)
+        except Exception:
+            return None
+        clone = secret.copy()
+        clone.id = f"{secret.id}.{task.id}"
+        clone.spec.data = payload
+        with self._lock:
+            self._driver_cache[key] = clone
+        return clone
+
+    def _referenced_deps(self, tx, tasks, node_id: str,
+                         driver_refs: list) -> tuple[dict, dict, dict]:
         """Secrets/configs the node's tasks reference, plus cluster-volume
         assignments already controller-published to this node
-        (assignments.go:21-81; volumes ship once PUBLISHED so the agent can
-        node-stage them)."""
+        (assignments.go:21-81; volumes ship once PUBLISHED so the agent
+        can node-stage them). Driver-backed secret references are only
+        COLLECTED here (into `driver_refs`) — their materialization does
+        external I/O and happens after the transaction."""
         from ..csi.plugin import PUBLISHED
 
         secrets, configs, volumes = {}, {}, {}
@@ -653,8 +695,15 @@ class Dispatcher:
                 continue
             for ref in runtime.secrets:
                 s = tx.get_secret(ref.secret_id)
-                if s is not None:
-                    secrets[s.id] = s
+                if s is None:
+                    continue
+                if s.spec.driver:
+                    # defer: the plugin does external I/O and must not run
+                    # under the store lock — collected for the post-view
+                    # materialization pass in _assignment_view
+                    driver_refs.append((s.copy(), t, ref))
+                    continue
+                secrets[s.id] = s
             for ref in runtime.configs:
                 c = tx.get_config(ref.config_id)
                 if c is not None:
@@ -679,22 +728,40 @@ class Dispatcher:
                     out[v.id] = self._volume_assignment(v, st)
         return out
 
-    def _full_assignment(self, session: Session) -> AssignmentsMessage:
+    def _assignment_view(self, session: Session):
+        """One consistent read: WIRE COPIES of the node's tasks, their
+        deps, and pending unpublishes; then (outside the store lock)
+        driver-backed secrets materialize per task and the wire copies'
+        references are rewritten to the per-task clone ids."""
+        driver_refs: list = []
+
         def cb(tx):
-            tasks = self._relevant_tasks(tx, session.node_id)
+            tasks = [t.copy() for t in
+                     self._relevant_tasks(tx, session.node_id)]
             secrets, configs, volumes = self._referenced_deps(
-                tx, tasks, session.node_id)
+                tx, tasks, session.node_id, driver_refs)
             return (tasks, secrets, configs, volumes,
                     self._pending_unpublish(tx, session.node_id))
 
         tasks, secrets, configs, volumes, unpublish = self.store.view(cb)
+        for secret, task, ref in driver_refs:
+            clone = self._materialize_driver_secret(secret, task,
+                                                    session.node_id)
+            if clone is not None:
+                secrets[clone.id] = clone
+                ref.secret_id = clone.id  # ref belongs to the wire copy
+        return tasks, secrets, configs, volumes, unpublish
+
+    def _full_assignment(self, session: Session) -> AssignmentsMessage:
+        tasks, secrets, configs, volumes, unpublish = \
+            self._assignment_view(session)
         session.known_tasks = {t.id: t.meta.version.index for t in tasks}
         session.known_secrets = set(secrets)
         session.known_configs = set(configs)
         session.known_volumes = set(volumes)
         session.sequence += 1
         changes = (
-            [Assignment("update", "task", t.copy()) for t in tasks]
+            [Assignment("update", "task", t) for t in tasks]
             + [Assignment("update", "secret", s.copy()) for s in secrets.values()]
             + [Assignment("update", "config", c.copy()) for c in configs.values()]
             + [Assignment("update", "volume", v) for v in volumes.values()]
@@ -714,20 +781,14 @@ class Dispatcher:
                 session.channel._offer(msg)
 
     def _incremental(self, session: Session) -> AssignmentsMessage:
-        def cb(tx):
-            tasks = self._relevant_tasks(tx, session.node_id)
-            secrets, configs, volumes = self._referenced_deps(
-                tx, tasks, session.node_id)
-            return (tasks, secrets, configs, volumes,
-                    self._pending_unpublish(tx, session.node_id))
-
-        tasks, secrets, configs, volumes, unpublish = self.store.view(cb)
+        tasks, secrets, configs, volumes, unpublish = \
+            self._assignment_view(session)
         changes: list[Assignment] = []
         new_known = {t.id: t.meta.version.index for t in tasks}
         for t in tasks:
             old_version = session.known_tasks.get(t.id)
             if old_version is None or old_version != t.meta.version.index:
-                changes.append(Assignment("update", "task", t.copy()))
+                changes.append(Assignment("update", "task", t))
         for tid in session.known_tasks:
             if tid not in new_known:
                 changes.append(Assignment("remove", "task", tid))

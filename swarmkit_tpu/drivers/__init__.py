@@ -1,0 +1,3 @@
+from .provider import DriverRegistry, SecretDriver
+
+__all__ = ["DriverRegistry", "SecretDriver"]

@@ -1,0 +1,52 @@
+"""Secret-driver plugins: secrets whose VALUE comes from an external
+provider, fetched per task at assignment time.
+
+Re-derivation of manager/drivers/provider.go:11-34 + secrets.go: a secret
+whose spec names a driver carries no payload in the store; when the
+dispatcher builds a node's assignments it asks the driver for the value,
+scoped to the exact task (the driver sees task/service/node identity and
+may mint per-task credentials). The dispatcher clones the secret per task
+— id `<secret-id>.<task-id>` — and rewrites the task copy's references,
+so one task can never read another's materialized value
+(dispatcher/assignments.go:51-81 task-specific cloning).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Protocol
+
+
+class SecretDriver(Protocol):
+    """One plugin: returns the secret payload for a (secret, task, node)."""
+
+    def get(self, secret, task, node_id: str) -> bytes: ...
+
+
+class _CallableDriver:
+    def __init__(self, fn: Callable):
+        self._fn = fn
+
+    def get(self, secret, task, node_id: str) -> bytes:
+        return self._fn(secret, task, node_id)
+
+
+class DriverRegistry:
+    """Named driver lookup (provider.go DriverProvider)."""
+
+    def __init__(self):
+        self._drivers: dict[str, SecretDriver] = {}
+        self._lock = threading.Lock()
+
+    def register(self, name: str, driver) -> None:
+        if callable(driver) and not hasattr(driver, "get"):
+            driver = _CallableDriver(driver)
+        with self._lock:
+            self._drivers[name] = driver
+
+    def get(self, name: str):
+        with self._lock:
+            return self._drivers.get(name)
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._drivers)

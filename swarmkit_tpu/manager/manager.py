@@ -16,6 +16,7 @@ import queue
 import threading
 
 from ..allocator.allocator import Allocator
+from ..allocator.deallocator import Deallocator
 from ..api.objects import Cluster, Network, RootCAObj
 from ..api.specs import Annotations, ClusterSpec, NetworkSpec
 from ..ca import CAServer, RootCA, SecurityConfig, generate_join_token
@@ -56,6 +57,7 @@ class Manager:
         heartbeat_period: float = 5.0,
         key_rotation_interval: float = 12 * 3600.0,
         csi_plugins=None,
+        secret_drivers=None,
     ):
         self.store = store if store is not None else MemoryStore()
         self.security = security
@@ -75,7 +77,9 @@ class Manager:
         self.control_api = ControlAPI(self.store)
         self.watch_api = WatchAPI(self.store)
         self.heartbeat_period = heartbeat_period
-        self.dispatcher = Dispatcher(self.store, heartbeat_period=heartbeat_period)
+        self.dispatcher = Dispatcher(self.store,
+                                     heartbeat_period=heartbeat_period,
+                                     secret_drivers=secret_drivers)
         self.log_broker = LogBroker(self.store)
         self.resource_api = ResourceAllocator(self.store)
         self.health = HealthServer()
@@ -240,6 +244,7 @@ class Manager:
             self.ca_server,
             self.log_broker,
             Allocator(self.store),
+            Deallocator(self.store),
             Scheduler(self.store),
             ReplicatedOrchestrator(self.store),
             GlobalOrchestrator(self.store),

@@ -178,6 +178,7 @@ class SwarmNode:
         self._dispatcher_shim: RemoteDispatcher | None = None
         self._manager_addrs: list[str] = []
         self._role_flip_active = False
+        self._role_flip_lock = threading.Lock()
         self._last_session_msg = None
 
     # ------------------------------------------------------------- identity
@@ -647,30 +648,32 @@ class SwarmNode:
         self._maybe_flip_roles(msg)
 
     def _maybe_flip_roles(self, msg):
+        """Called from BOTH the session-message thread and the periodic
+        refresh loop — the check-then-set of _role_flip_active is under a
+        lock so only one flip thread ever runs."""
         desired = msg.desired_role
         if desired is None:
             return
-        if desired == NodeRole.MANAGER and self.manager is None \
-                and not self._role_flip_active:
+        with self._role_flip_lock:
+            if self._role_flip_active:
+                return
+            if desired == NodeRole.MANAGER and self.manager is None:
+                target, name = self._promote, "promote"
+            elif desired == NodeRole.WORKER and self.manager is not None \
+                    and msg.node_role == NodeRole.WORKER:
+                # the role manager flips node.role only AFTER the raft
+                # membership removal succeeded (role_manager.go:154-214),
+                # so observing role==WORKER means teardown cannot break
+                # quorum. (A removed raft member never hears its own
+                # removal — the leader stops replicating to it — so the
+                # signal must come from the session plane.)
+                target, name = self._demote, "demote"
+            else:
+                return
             self._role_flip_active = True
-            t = threading.Thread(target=self._promote, daemon=True,
-                                 name="promote")
-            t.start()
-            self._threads.append(t)
-        elif desired == NodeRole.WORKER and self.manager is not None \
-                and msg.node_role == NodeRole.WORKER \
-                and not self._role_flip_active:
-            # the role manager flips node.role only AFTER the raft
-            # membership removal succeeded (role_manager.go:154-214), so
-            # observing role==WORKER means teardown cannot break quorum.
-            # (A removed raft member never hears its own removal — the
-            # leader stops replicating to it — so the signal must come
-            # from the session plane, as in the reference.)
-            self._role_flip_active = True
-            t = threading.Thread(target=self._demote, daemon=True,
-                                 name="demote")
-            t.start()
-            self._threads.append(t)
+        t = threading.Thread(target=target, daemon=True, name=name)
+        t.start()
+        self._threads.append(t)
 
     def _promote(self):
         """Worker → manager: renew the certificate until it carries the

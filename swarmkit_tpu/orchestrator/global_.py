@@ -69,7 +69,7 @@ class GlobalOrchestrator(EventLoopComponent):
     def handle(self, event):
         obj = getattr(event, "obj", None)
         if isinstance(obj, Service):
-            if isinstance(event, EventDelete):
+            if isinstance(event, EventDelete) or obj.pending_delete:
                 self._delete_service_tasks(obj)
             elif is_global(obj):
                 self.reconcile_service(obj.id)
@@ -85,7 +85,8 @@ class GlobalOrchestrator(EventLoopComponent):
     def reconcile_service(self, service_id: str):
         def cb(tx):
             service = tx.get_service(service_id)
-            if service is None or not is_global(service):
+            if service is None or not is_global(service) \
+                    or service.pending_delete:
                 return
             nodes = tx.find_nodes()
             tasks = tx.find_tasks(by.ByServiceID(service_id))
@@ -115,7 +116,8 @@ class GlobalOrchestrator(EventLoopComponent):
             node = tx.get_node(node_id)
             if node is None:
                 return
-            services = [s for s in tx.find_services() if is_global(s)]
+            services = [s for s in tx.find_services()
+                        if is_global(s) and not s.pending_delete]
             tasks = tx.find_tasks(by.ByNodeID(node_id))
             by_service: dict[str, list[Task]] = {}
             for t in tasks:
@@ -155,7 +157,8 @@ class GlobalOrchestrator(EventLoopComponent):
 
         def cb(tx):
             service = tx.get_service(task.service_id)
-            if service is None or not is_global(service):
+            if service is None or not is_global(service) \
+                    or service.pending_delete:
                 return
             node = tx.get_node(task.node_id) if task.node_id else None
             if node is None or not _node_eligible(node, service):

@@ -74,7 +74,11 @@ class ReplicatedOrchestrator(EventLoopComponent):
     def handle(self, event):
         if isinstance(event, (EventCreate, EventUpdate)) and isinstance(
                 event.obj, Service):
-            if is_replicated(event.obj):
+            if event.obj.pending_delete:
+                # wind the tasks down so the deallocator can finish the
+                # removal (deallocator.go waits for the last task)
+                self._delete_service_tasks(event.obj)
+            elif is_replicated(event.obj):
                 self.reconcile(event.obj.id)
         elif isinstance(event, EventDelete) and isinstance(event.obj, Service):
             self._delete_service_tasks(event.obj)
@@ -93,7 +97,8 @@ class ReplicatedOrchestrator(EventLoopComponent):
 
         def cb(tx):
             service = tx.get_service(service_id)
-            if service is None or not is_replicated(service):
+            if service is None or not is_replicated(service) \
+                    or service.pending_delete:
                 return
             tasks = [
                 t for t in tx.find_tasks(by.ByServiceID(service_id))
@@ -176,7 +181,8 @@ class ReplicatedOrchestrator(EventLoopComponent):
 
         def cb(tx):
             service = tx.get_service(task.service_id)
-            if service is None or not is_replicated(service):
+            if service is None or not is_replicated(service) \
+                    or service.pending_delete:
                 return
             if task.slot > service.spec.replicas:
                 return

@@ -165,10 +165,12 @@ class Updater(threading.Thread):
                 dirty.append(live)
         return dirty
 
-    # bound for the stop-first old-task drain; the start-first wait for the
-    # replacement is UNbounded (as in the reference) — giving up there
-    # would spawn a duplicate replacement into the still-dirty slot
+    # bound for the stop-first old-task drain
     SLOT_PHASE_TIMEOUT = 30.0
+    # bound for the start-first replacement start: generous (slow prepares
+    # are legitimate), and on expiry the stuck replacement is REMOVED so
+    # the retry can't accumulate duplicates in the slot
+    START_FIRST_TIMEOUT = 600.0
 
     def _update_slot(self, slot_tasks: list[Task], order) -> str | None:
         """Replace one slot's tasks with a fresh-spec task. Returns new id.
@@ -187,9 +189,15 @@ class Updater(threading.Thread):
             if new_id is None:
                 return None
             outcome = self._wait_task_state(new_id, TaskState.RUNNING,
-                                            timeout=None)
+                                            timeout=self.START_FIRST_TIMEOUT)
             if outcome == "running":
                 self._shutdown_tasks(slot_tasks)
+            elif outcome == "timeout":
+                # a replacement that never starts (unschedulable on a full
+                # cluster) must not pile up: remove it, keep the old task,
+                # report failure so the batch backs off and retries
+                self._remove_task(new_id)
+                return None
             return new_id
         # stop-first: the replacement is created (desired READY) in the
         # SAME transaction that brings the old tasks down, so the slot
@@ -235,6 +243,16 @@ class Updater(threading.Thread):
                     cur = cur.copy()
                     cur.desired_state = TaskState.SHUTDOWN
                     tx.update(cur)
+
+        self.store.update(cb)
+
+    def _remove_task(self, task_id: str):
+        def cb(tx):
+            cur = tx.get_task(task_id)
+            if cur is not None and cur.desired_state < TaskState.REMOVE:
+                cur = cur.copy()
+                cur.desired_state = TaskState.REMOVE
+                tx.update(cur)
 
         self.store.update(cb)
 

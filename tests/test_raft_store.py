@@ -23,8 +23,12 @@ def make_replicated_stores(n=3):
 
 def _propose_in_thread(c, fn):
     """Run a store.update against the replicated store: the raft worker needs
-    to process while update blocks, so pump the cluster from this thread."""
+    to process while update blocks, so pump the cluster from this thread
+    until the update completes (a fixed iteration count can spin through
+    before the update thread is even scheduled on a loaded machine)."""
     import threading
+    import time as _time
+
     err: list = []
 
     def run():
@@ -35,11 +39,12 @@ def _propose_in_thread(c, fn):
 
     t = threading.Thread(target=run)
     t.start()
-    for _ in range(2000):
-        if not t.is_alive():
-            break
+    deadline = _time.monotonic() + 30
+    while t.is_alive() and _time.monotonic() < deadline:
         c.settle()
+        _time.sleep(0.001)
     t.join(timeout=5)
+    assert not t.is_alive(), "proposal never completed"
     if err:
         raise err[0]
 
