@@ -134,6 +134,42 @@ def _segmented_pour(quota_seg, k_child, cap_child, parent_of, valid, n):
     return give + extra.astype(jnp.int32)
 
 
+def _flat_water_fill(cap, penalty, svc, total, n_tasks):
+    """Flat canonical fill (no spread preferences): one SCALAR water-level
+    bisect over plain reductions — no segment scatters, no lexsort. This is
+    the hot shape (most services carry no placement preferences), and on TPU
+    it fuses into a handful of reduction kernels; the segmented tree path
+    below costs ~an order of magnitude more in scatter traffic."""
+    N = cap.shape[0]
+    idx = jnp.arange(N, dtype=jnp.int32)
+    k = (jnp.where(penalty, PENALTY_BASE, 0) + svc).astype(jnp.int32)
+    q = jnp.minimum(n_tasks, jnp.sum(cap)).astype(jnp.int32)
+
+    def filled(level):
+        return jnp.sum(jnp.minimum(cap, jnp.maximum(0, level - k)))
+
+    def bisect(state, _):
+        lo, hi = state
+        mid = lo + (hi - lo + 1) // 2
+        take = filled(mid) <= q
+        return (jnp.where(take, mid, lo), jnp.where(take, hi, mid - 1)), None
+
+    (level, _), _ = lax.scan(
+        bisect,
+        (jnp.zeros((), jnp.int32), jnp.full((), 1 << _POUR_BITS, jnp.int32)),
+        None, length=_POUR_BITS + 1)
+    counts = jnp.minimum(cap, jnp.maximum(0, level - k))
+    rem = q - jnp.sum(counts)
+    boundary = (cap > counts) & (k <= level) & (counts == level - k)
+    # remainder rank by (secondary, node idx): jnp.argsort is stable, so
+    # equal secondaries keep index order — exactly the canonical tie-break
+    sec = jnp.where(boundary, total + counts, 1 << 30).astype(jnp.int32)
+    order = jnp.argsort(sec, stable=True)
+    pos = jnp.zeros(N, jnp.int32).at[order].set(idx)
+    extra = boundary & (pos < rem)
+    return counts + extra.astype(jnp.int32)
+
+
 def _tree_water_fill(eligible, capacity, penalty, svc, total, n_tasks,
                      spread_rank):
     """Hierarchical canonical spread fill of one group.
@@ -150,6 +186,8 @@ def _tree_water_fill(eligible, capacity, penalty, svc, total, n_tasks,
     lmax = spread_rank.shape[0]
     cap = jnp.minimum(jnp.where(eligible, capacity, 0), n_tasks) \
         .astype(jnp.int32)
+    if lmax == 0:   # static shape: compiles to the scatter-free flat fill
+        return _flat_water_fill(cap, penalty, svc, total, n_tasks)
     idx = jnp.arange(N, dtype=jnp.int32)
     zeros = jnp.zeros(N, jnp.int32)
 
@@ -273,11 +311,33 @@ def schedule_groups(
     return counts, totals, svc_counts
 
 
-def schedule_encoded(p, backend=None):
-    """Run the kernel on an EncodedProblem; returns numpy counts[G, N]."""
-    from ..scheduler.encode import kernel_args
-
-    args = tuple(jnp.asarray(a) for a in kernel_args(p))
+@functools.partial(jax.jit, static_argnames=("compact",))
+def schedule_groups_compact(*args, compact: bool = True):
+    """schedule_groups + an int16 downcast when counts provably fit — the
+    result crosses the host↔device link (a high-latency tunnel in dev; PCIe
+    in prod), so halving the bytes matters. The real [G, N] window is sliced
+    HOST-side: making it static here would re-trace the whole kernel per
+    exact shape, defeating pad_buckets' bucket-and-pad."""
     counts, totals, svc_counts = schedule_groups(*args)
+    if compact:
+        return counts.astype(jnp.int16)
+    return counts
+
+
+def schedule_encoded(p, backend=None):
+    """Run the kernel on an EncodedProblem; returns numpy counts[G, N].
+
+    The problem is bucket-padded first (encode.pad_buckets) so growth in any
+    dimension recompiles only at power-of-two boundaries. All input arrays
+    ship in ONE batched device_put (per-array transfers each pay a full
+    link round trip), and the result comes back downcast; the slice back to
+    the real window happens after the pull."""
     import numpy as np
-    return np.asarray(counts)
+
+    from ..scheduler.encode import kernel_args, pad_buckets
+
+    G, N = p.extra_mask.shape
+    args = jax.device_put(list(kernel_args(pad_buckets(p))))
+    compact = bool(p.n_tasks.size == 0 or int(p.n_tasks.max()) < (1 << 15))
+    counts = schedule_groups_compact(*args, compact=compact)
+    return np.asarray(counts)[:G, :N].astype(np.int32)

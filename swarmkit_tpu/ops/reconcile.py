@@ -1,0 +1,65 @@
+"""Batched global-service reconciliation: desired-vs-actual set diff.
+
+The reference's global orchestrator walks every (service, node) pair in Go,
+comparing the eligible-node set against the set of nodes that already carry a
+runnable task (manager/orchestrator/global/global.go:254-487,
+reconcileServices/reconcileOneNode). At fleet scale that product is the same
+tasks×nodes shape the scheduler batches, so the decision matrix is computed
+here as one jitted program (BASELINE.md: "Global-service reconciliation:
+50k desired vs actual diff → vmap set-diff"):
+
+    has[s, n]      = any runnable task of service s on node n
+                     (scatter of each service's padded task→node id list)
+    create[s, n]   = eligible[s, n] ∧ ¬has[s, n]     (node missing its task)
+    shutdown[s, n] = ¬eligible[s, n] ∧ has[s, n]     (task must drain)
+
+Eligibility itself is string/constraint work and stays host-side (the same
+split as the scheduler's extra_mask — SURVEY.md §7); this kernel owns the
+O(S×N) set algebra. `swarmkit_tpu.orchestrator.global_.bulk_reconcile` is the
+store-integrated consumer.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# below this S×N product the numpy fallback wins (device round-trip costs
+# more than the diff); mirrors the scheduler's JAX_THRESHOLD idea
+DIFF_THRESHOLD = 1_000_000
+
+
+@jax.jit
+def global_diff(eligible, task_nodes):
+    """eligible: bool[S, N]; task_nodes: int32[S, T] — for each service the
+    node indices of its runnable tasks, padded with -1 (T = max per service).
+    Returns (create bool[S, N], shutdown bool[S, N])."""
+    S, N = eligible.shape
+    rows = jnp.broadcast_to(jnp.arange(S)[:, None], task_nodes.shape)
+    cols = jnp.clip(task_nodes, 0, N - 1)
+    has = jnp.zeros((S, N), bool).at[rows, cols].max(task_nodes >= 0)
+    return eligible & ~has, ~eligible & has
+
+
+def global_diff_np(eligible, task_nodes):
+    """numpy mirror of `global_diff` (small-scale path and parity oracle)."""
+    import numpy as np
+
+    S, N = eligible.shape
+    has = np.zeros((S, N), bool)
+    valid = task_nodes >= 0
+    rows = np.broadcast_to(np.arange(S)[:, None], task_nodes.shape)[valid]
+    has[rows, task_nodes[valid]] = True
+    return eligible & ~has, ~eligible & has
+
+
+def compute_diff(eligible, task_nodes):
+    """Backend-selecting wrapper: TPU kernel above DIFF_THRESHOLD, numpy
+    below. Output is identical either way (both are exact set algebra)."""
+    import numpy as np
+
+    S, N = eligible.shape
+    if S * N >= DIFF_THRESHOLD:
+        create, shutdown = global_diff(jnp.asarray(eligible),
+                                       jnp.asarray(task_nodes))
+        return np.asarray(create), np.asarray(shutdown)
+    return global_diff_np(np.asarray(eligible), np.asarray(task_nodes))

@@ -63,8 +63,110 @@ class GlobalOrchestrator(EventLoopComponent):
             check_tasks(self.store, self.restart, is_global)
         except Exception:
             pass
-        for s in services:
-            self.reconcile_service(s.id)
+        # startup reconciliation of ALL global services in one batched
+        # desired-vs-actual diff (ops/reconcile.py) instead of S separate
+        # (service × node) walks; identical semantics to reconcile_service
+        self.bulk_reconcile([s.id for s in services])
+
+    def bulk_reconcile(self, service_ids: list[str]):
+        """Reconcile many global services at once: host-side eligibility
+        (string/constraint work), then one `ops.reconcile.compute_diff`
+        set-diff for the whole S×N decision matrix, then one store batch
+        applying creates/shutdowns."""
+        if not service_ids:
+            return
+        import numpy as np
+
+        from ..ops.reconcile import compute_diff
+
+        plan: list[tuple[str, str, bool]] = []  # (service, node, create?)
+
+        def scan(tx):
+            nodes = sorted(tx.find_nodes(), key=lambda n: n.id)
+            node_row = {n.id: i for i, n in enumerate(nodes)}
+            svcs = []
+            for sid in service_ids:
+                s = tx.get_service(sid)
+                if s is not None and is_global(s) and not s.pending_delete:
+                    svcs.append(s)
+            if not svcs or not nodes:
+                return
+            S, N = len(svcs), len(nodes)
+            eligible = np.zeros((S, N), bool)
+            for si, s in enumerate(svcs):
+                for ni, n in enumerate(nodes):
+                    eligible[si, ni] = _node_eligible(n, s)
+            # two 'actual' sets, as in reconcile_service: create is gated on
+            # RUNNABLE tasks; shutdown covers any task with desired<=RUNNING
+            runnable_rows: list[list[int]] = []
+            active_rows: list[list[int]] = []
+            for s in svcs:
+                run, act = [], []
+                for t in tx.find_tasks(by.ByServiceID(s.id)):
+                    if t.desired_state > TaskState.RUNNING:
+                        continue
+                    ni = node_row.get(t.node_id)
+                    if ni is None:
+                        continue
+                    act.append(ni)
+                    if task_runnable(t):
+                        run.append(ni)
+                runnable_rows.append(run)
+                active_rows.append(act)
+
+            def pack(rows_list):
+                T = max((len(r) for r in rows_list), default=0) or 1
+                out = np.full((S, T), -1, np.int32)
+                for si, rows in enumerate(rows_list):
+                    out[si, :len(rows)] = rows
+                return out
+
+            create, _ = compute_diff(eligible, pack(runnable_rows))
+            _, shutdown = compute_diff(eligible, pack(active_rows))
+            for si, s in enumerate(svcs):
+                for ni in np.flatnonzero(create[si]):
+                    plan.append((s.id, nodes[ni].id, True))
+                for ni in np.flatnonzero(shutdown[si]):
+                    plan.append((s.id, nodes[ni].id, False))
+
+        with_view = getattr(self.store, "view", None)
+        tx = with_view()
+        scan(tx)
+        if not plan:
+            return
+
+        def apply(batch):
+            for sid, nid, is_create in plan:
+                def one(tx, sid=sid, nid=nid, is_create=is_create):
+                    service = tx.get_service(sid)
+                    if service is None or not is_global(service) \
+                            or service.pending_delete:
+                        return
+                    if is_create:
+                        node = tx.get_node(nid)
+                        # re-validate inside the tx (state may have moved)
+                        if node is None or not _node_eligible(node, service):
+                            return
+                        exists = any(
+                            t.desired_state <= TaskState.RUNNING
+                            and task_runnable(t) and t.node_id == nid
+                            for t in tx.find_tasks(by.ByServiceID(sid)))
+                        if not exists:
+                            tx.create(new_task(None, service, 0, node_id=nid))
+                    else:
+                        for t in tx.find_tasks(by.ByServiceID(sid)):
+                            if t.node_id != nid or \
+                                    t.desired_state > TaskState.RUNNING:
+                                continue
+                            cur = tx.get_task(t.id)
+                            if cur is not None and \
+                                    cur.desired_state < TaskState.SHUTDOWN:
+                                cur = cur.copy()
+                                cur.desired_state = TaskState.SHUTDOWN
+                                tx.update(cur)
+                batch.update(one)
+
+        self.store.batch(apply)
 
     def handle(self, event):
         obj = getattr(event, "obj", None)

@@ -16,7 +16,7 @@ from __future__ import annotations
 import numpy as np
 
 from .encode import UNLIMITED, EncodedProblem
-from .spread import GroupFill, greedy_fill, slot_order, tree_fill
+from .spread import GroupFill, greedy_fill, tree_fill
 
 
 def _group_caps(p: EncodedProblem, gi: int, avail: np.ndarray,
@@ -106,31 +106,48 @@ def tpu_schedule_encoded(p: EncodedProblem) -> np.ndarray:
     return placement_ops.schedule_encoded(p)
 
 
-def materialize(p: EncodedProblem, counts: np.ndarray) -> dict[str, str]:
-    """counts[G, N] → {task_id: node_id}, deterministic across backends.
+def materialize_orders(p: EncodedProblem, counts: np.ndarray) -> list:
+    """counts[G, N] → per-group canonical slot order (node indices),
+    deterministic across backends.
 
-    Reconstructs each group's GroupFill view (penalty/svc/total at its turn in
-    the sequential order) to produce the canonical slot order, then zips with
-    the group's id-sorted tasks. Unplaced tasks (count shortfall) are absent
-    from the result and stay PENDING.
-    """
-    assignments: dict[str, str] = {}
+    Vectorized slot ordering: a group's filled slots sort by
+    (key_at_slot, total_at_slot, node_idx) — the order greedy filled them.
+    All slot tuples are distinct (within a node both key and total strictly
+    increase per slot; across nodes the index differs), so the numpy lexsort
+    reproduces `spread.slot_order` exactly. The group's id-sorted tasks zip
+    with its order; tasks beyond the order length are unplaced and stay
+    PENDING."""
+    from .spread import PENALTY_BASE
+
+    N = len(p.node_ids)
+    node_arange = np.arange(N)
     totals = p.total0.astype(np.int64).copy()
     svc_counts = p.svc_count0.astype(np.int64).copy()
-    for gi, group in enumerate(p.groups):
-        c = counts[gi]
-        svc = svc_counts[p.svc_idx[gi]]
-        g = GroupFill(
-            n_tasks=int(p.n_tasks[gi]),
-            eligible=[True] * len(p.node_ids),
-            capacity=c.tolist(),  # capacity unused by slot_order
-            penalty=p.penalty[gi].tolist(),
-            svc_count=svc.tolist(),
-            total_count=totals.tolist(),
-        )
-        order = slot_order(g, c.tolist())
-        for task, node_i in zip(group.tasks, order):
-            assignments[task.id] = p.node_ids[node_i]
-        totals += c
-        svc_counts[p.svc_idx[gi]] += c
+    orders: list[np.ndarray] = []
+    for gi in range(len(p.groups)):
+        c = counts[gi].astype(np.int64)
+        placed = int(c.sum())
+        if placed:
+            svc = svc_counts[p.svc_idx[gi]]
+            base_k = np.where(p.penalty[gi], PENALTY_BASE, 0) + svc
+            idx = np.repeat(node_arange, c)                       # [placed]
+            j = np.arange(placed) - np.repeat(np.cumsum(c) - c, c)
+            key = base_k[idx] + j
+            tot = totals[idx] + j
+            orders.append(idx[np.lexsort((idx, tot, key))])
+            totals += c
+            svc_counts[p.svc_idx[gi]] += c
+        else:
+            orders.append(node_arange[:0])
+    return orders
+
+
+def materialize(p: EncodedProblem, counts: np.ndarray) -> dict[str, str]:
+    """counts[G, N] → {task_id: node_id} (materialize_orders + id zip)."""
+    assignments: dict[str, str] = {}
+    node_ids_arr = np.array(p.node_ids, dtype=object)
+    for group, order in zip(p.groups, materialize_orders(p, counts)):
+        if len(order):
+            chosen = node_ids_arr[order].tolist()
+            assignments.update(zip((t.id for t in group.tasks), chosen))
     return assignments

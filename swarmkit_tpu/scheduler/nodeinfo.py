@@ -11,6 +11,7 @@ TPU backend consumes (`swarmkit_tpu.scheduler.encode`).
 """
 from __future__ import annotations
 
+import itertools
 import time
 from dataclasses import dataclass, field
 
@@ -20,6 +21,8 @@ from ..api.types import TaskState
 
 MAX_FAILURES = 5
 FAILURE_WINDOW = 5 * 60.0  # seconds
+
+_NODEINFO_SEQ = itertools.count()  # creation stamps for encoder fingerprints
 
 
 def task_reservations(spec) -> Resources:
@@ -40,6 +43,14 @@ class NodeInfo:
     # (service_id, spec_version_index) -> failure timestamps
     recent_failures: dict[tuple[str, int], list[float]] = field(default_factory=dict)
     last_cleanup: float = field(default_factory=time.monotonic)
+    # fingerprint for the incremental encoder: (created_seq, mutations)
+    # changes whenever this info's scheduling-relevant state may have changed
+    created_seq: int = field(default_factory=lambda: next(_NODEINFO_SEQ))
+    mutations: int = 0
+
+    @property
+    def fingerprint(self) -> tuple[int, int]:
+        return (self.created_seq, self.mutations)
 
     @classmethod
     def new(cls, node: Node, tasks: dict[str, Task], available: Resources) -> "NodeInfo":
@@ -53,6 +64,7 @@ class NodeInfo:
         old = self.tasks.pop(t.id, None)
         if old is None:
             return False
+        self.mutations += 1
         if old.desired_state <= TaskState.COMPLETE:
             self.active_tasks_count -= 1
             self._bump_service(old.service_id, -1)
@@ -79,15 +91,18 @@ class NodeInfo:
                 self.tasks[t.id] = t
                 self.active_tasks_count += 1
                 self._bump_service(t.service_id, +1)
+                self.mutations += 1
                 return True
             if (old.desired_state <= TaskState.COMPLETE
                     < t.desired_state):
                 self.tasks[t.id] = t
                 self.active_tasks_count -= 1
                 self._bump_service(t.service_id, -1)
+                self.mutations += 1
                 return True
             return False
 
+        self.mutations += 1
         self.tasks[t.id] = t
         res = task_reservations(t.spec)
         self.available_resources.memory_bytes -= res.memory_bytes
@@ -142,6 +157,7 @@ class NodeInfo:
     # ---------------------------------------------------------- failures
     def task_failed(self, service_key: tuple[str, int], now: float | None = None) -> None:
         now = time.monotonic() if now is None else now
+        self.mutations += 1
         self._maybe_cleanup(now)
         window = self.recent_failures.setdefault(service_key, [])
         if len(window) >= MAX_FAILURES:

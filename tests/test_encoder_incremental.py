@@ -1,0 +1,236 @@
+"""Incremental-encoder equivalence: a persistent IncrementalEncoder driven
+through random cluster mutation traces must yield the same scheduling
+outcomes (static mask, fill counts, materialized assignments) as a fresh
+full encode at every step. Vocab ids may differ between the two — the
+comparison is semantic, not positional."""
+import random
+
+import numpy as np
+import pytest
+
+from swarmkit_tpu.api.objects import Task
+from swarmkit_tpu.api.specs import Placement, PlacementPreference
+from swarmkit_tpu.api.types import NodeAvailability, NodeStatusState, TaskState
+from swarmkit_tpu.scheduler import batch
+from swarmkit_tpu.scheduler.encode import (
+    CPU_QUANTUM,
+    MEM_QUANTUM,
+    IncrementalEncoder,
+    TaskGroup,
+    encode,
+)
+from swarmkit_tpu.scheduler.nodeinfo import NodeInfo
+
+from test_placement_parity import random_group, random_node
+
+NOW = 1000.0
+
+
+def make_info(rng, i):
+    node = random_node(rng, i)
+    return NodeInfo.new(node, {}, node.description.resources.copy())
+
+
+def make_task(rng, svc, ti):
+    t = Task(id=f"run-{svc}-{ti:04d}", service_id=svc, slot=ti + 1)
+    t.desired_state = TaskState.RUNNING
+    t.status.state = TaskState.RUNNING
+    t.spec.resources.reservations.nano_cpus = rng.randint(0, 2) * CPU_QUANTUM
+    t.spec.resources.reservations.memory_bytes = rng.randint(0, 2) * MEM_QUANTUM
+    return t
+
+
+def mutate(rng, infos, next_node_id, step):
+    """Apply a random batch of cluster mutations in place; returns
+    next_node_id."""
+    for _ in range(rng.randint(1, 4)):
+        op = rng.random()
+        if op < 0.2 and len(infos) < 40:
+            infos.append(make_info(rng, next_node_id))
+            next_node_id += 1
+        elif op < 0.3 and len(infos) > 5:
+            infos.pop(rng.randrange(len(infos)))
+        elif op < 0.55:
+            # run a task on a random node (mutates counts/resources/ports)
+            info = rng.choice(infos)
+            svc = f"svc-{rng.randrange(6):03d}"
+            info.add_task(make_task(rng, svc, rng.randrange(10_000)))
+        elif op < 0.7 and any(i.tasks for i in infos):
+            info = rng.choice([i for i in infos if i.tasks])
+            tid = rng.choice(list(info.tasks))
+            info.remove_task(info.tasks[tid])
+        elif op < 0.85:
+            info = rng.choice(infos)
+            for _ in range(rng.randint(1, 6)):
+                info.task_failed((f"svc-{rng.randrange(6):03d}", 1), now=NOW)
+        else:
+            # replace a node wholesale (label churn — new NodeInfo object)
+            i = rng.randrange(len(infos))
+            old = infos[i]
+            node = random_node(rng, step * 1000 + i)
+            node.id = old.node.id  # same identity, new labels/status
+            infos[i] = NodeInfo.new(node, {},
+                                    node.description.resources.copy())
+    return next_node_id
+
+
+def semantic_outputs(p):
+    counts = batch.cpu_schedule_encoded(p)
+    return batch.cpu_static_mask(p), counts, batch.materialize(p, counts)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_incremental_matches_full_over_trace(seed):
+    rng = random.Random(seed)
+    infos = [make_info(rng, i) for i in range(12)]
+    next_node_id = 12
+    enc = IncrementalEncoder()
+    for step in range(8):
+        next_node_id = mutate(rng, infos, next_node_id, step)
+        groups = [random_group(rng, rng.randrange(6), rng.randint(1, 12))
+                  for _ in range(rng.randint(1, 4))]
+        # one group per (service, version): drop dups like the scheduler does
+        seen, uniq = set(), []
+        for g in groups:
+            if g.key not in seen:
+                seen.add(g.key)
+                uniq.append(g)
+        p_inc = enc.encode(infos, uniq, now=NOW)
+        p_full = encode(infos, uniq, now=NOW)
+        mask_i, counts_i, assign_i = semantic_outputs(p_inc)
+        mask_f, counts_f, assign_f = semantic_outputs(p_full)
+        assert p_inc.node_ids == p_full.node_ids
+        np.testing.assert_array_equal(mask_i, mask_f,
+                                      err_msg=f"step {step}: mask diverged")
+        np.testing.assert_array_equal(counts_i, counts_f,
+                                      err_msg=f"step {step}: counts diverged")
+        assert assign_i == assign_f, f"step {step}: assignments diverged"
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_apply_counts_matches_reencode(seed):
+    """Folding a tick's own placements via apply_counts must leave the cache
+    bit-identical to what re-encoding the mutated NodeInfos produces — and
+    the next tick must see zero dirty rows."""
+    rng = random.Random(500 + seed)
+    infos = [make_info(rng, i) for i in range(15)]
+    enc = IncrementalEncoder()
+    groups = [random_group(rng, gi, rng.randint(3, 10)) for gi in range(4)]
+    p = enc.encode(infos, groups, now=NOW)
+    counts = batch.cpu_schedule_encoded(p)
+    assignments = batch.materialize(p, counts)
+
+    # what the scheduler does: one add_task per applied placement
+    by_node = {i.node.id: i for i in infos}
+    task_by_id = {t.id: t for g in groups for t in g.tasks}
+    n_added = 0
+    for tid, nid in assignments.items():
+        if by_node[nid].add_task(task_by_id[tid]):
+            n_added += 1
+    assert n_added == int(counts.sum())
+    assert enc.apply_counts(p, counts)
+
+    # next tick: no dirty rows, and semantics equal a fresh full encode
+    groups2 = [random_group(rng, 10 + gi, rng.randint(3, 10))
+               for gi in range(3)]
+    p_inc = enc.encode(infos, groups2, now=NOW)
+    assert enc.last_dirty == 0
+    p_full = encode(infos, groups2, now=NOW)
+    mask_i, counts_i, assign_i = semantic_outputs(p_inc)
+    mask_f, counts_f, assign_f = semantic_outputs(p_full)
+    np.testing.assert_array_equal(mask_i, mask_f)
+    np.testing.assert_array_equal(counts_i, counts_f)
+    assert assign_i == assign_f
+    # canonical-order tables must agree exactly; vocab-ordered tables
+    # (ports/plugins/values) may differ in column order between a warm and a
+    # fresh encoder — their semantics are covered by the mask/counts checks
+    np.testing.assert_array_equal(p_inc.svc_count0, p_full.svc_count0)
+    np.testing.assert_array_equal(p_inc.total0, p_full.total0)
+    np.testing.assert_array_equal(p_inc.avail_res[:, :2],
+                                  p_full.avail_res[:, :2])
+
+
+def test_incremental_reencodes_only_dirty_rows():
+    rng = random.Random(42)
+    infos = [make_info(rng, i) for i in range(20)]
+    enc = IncrementalEncoder()
+    groups = [random_group(rng, 0, 5)]
+    enc.encode(infos, groups, now=NOW)
+    assert enc.last_dirty == 20  # cold start: everything encodes
+
+    enc.encode(infos, groups, now=NOW)
+    assert enc.last_dirty == 0   # steady state, nothing changed
+
+    infos[3].add_task(make_task(rng, "svc-000", 1))
+    infos[7].task_failed(("svc-000", 1), now=NOW)
+    enc.encode(infos, groups, now=NOW)
+    assert enc.last_dirty == 2   # exactly the touched rows
+
+    infos.append(make_info(rng, 99))
+    enc.encode(infos, groups, now=NOW)
+    assert enc.last_dirty == 1   # just the new node
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_pad_buckets_preserves_placements(seed):
+    """Bucket padding must be invisible to the fill: the CPU oracle over the
+    padded problem, sliced back to the real window, equals the unpadded
+    fill; padded rows/groups place nothing."""
+    from swarmkit_tpu.scheduler.encode import pad_buckets
+
+    rng = random.Random(300 + seed)
+    infos = [make_info(rng, i) for i in range(13)]   # odd sizes on purpose
+    groups = [random_group(rng, gi, rng.randint(1, 9)) for gi in range(3)]
+    p = encode(infos, groups, now=NOW)
+    q = pad_buckets(p)
+    G, N = p.extra_mask.shape
+    assert q.extra_mask.shape[0] >= G and q.extra_mask.shape[1] >= N
+    base = batch.cpu_schedule_encoded(p)
+    padded = batch.cpu_schedule_encoded(q)
+    np.testing.assert_array_equal(padded[:G, :N], base)
+    assert padded[G:].sum() == 0 and padded[:, N:].sum() == 0
+
+
+def test_tpu_path_buckets_match_cpu_oracle():
+    rng = random.Random(11)
+    infos = [make_info(rng, i) for i in range(13)]
+    groups = [random_group(rng, gi, rng.randint(1, 9)) for gi in range(3)]
+    p = encode(infos, groups, now=NOW)
+    np.testing.assert_array_equal(batch.tpu_schedule_encoded(p),
+                                  batch.cpu_schedule_encoded(p))
+
+
+def test_incremental_spread_preferences_after_label_churn():
+    """Cached spread label columns must refresh when a node's labels change
+    via wholesale NodeInfo replacement."""
+    rng = random.Random(7)
+    infos = [make_info(rng, i) for i in range(10)]
+    for info in infos:
+        info.node.status.state = NodeStatusState.READY
+        info.node.spec.availability = NodeAvailability.ACTIVE
+        info.node.spec.annotations.labels = {"zone": "a"}
+
+    def spread_group():
+        g = random_group(rng, 0, 8)
+        g.spec.placement = Placement(preferences=[
+            PlacementPreference(spread_descriptor="node.labels.zone")])
+        for t in g.tasks:
+            t.endpoint = None
+        return g
+
+    enc = IncrementalEncoder()
+    g = spread_group()
+    enc.encode(infos, [g], now=NOW)
+
+    # flip half the nodes to zone b via replacement (new NodeInfo objects)
+    for i in range(5):
+        node = infos[i].node
+        node.spec.annotations.labels = {"zone": "b"}
+        infos[i] = NodeInfo.new(node, {},
+                                node.description.resources.copy())
+
+    p_inc = enc.encode(infos, [spread_group()], now=NOW)
+    p_full = encode(infos, [spread_group()], now=NOW)
+    np.testing.assert_array_equal(p_inc.spread_rank, p_full.spread_rank)
+    np.testing.assert_array_equal(batch.cpu_schedule_encoded(p_inc),
+                                  batch.cpu_schedule_encoded(p_full))

@@ -145,6 +145,39 @@ def test_materialize_deterministic_and_consistent():
         assert mask[gi_of[tid], node_idx[nid]]
 
 
+@pytest.mark.parametrize("seed", range(4))
+def test_materialize_matches_slot_order_oracle(seed):
+    """The vectorized materialize must reproduce the per-slot heap oracle
+    (spread.slot_order) exactly, including sequential svc/total carry-over
+    between groups."""
+    from swarmkit_tpu.scheduler.spread import GroupFill, slot_order
+
+    rng = random.Random(1000 + seed)
+    infos, groups = random_cluster(rng)
+    p = encode(infos, groups)
+    counts = batch.cpu_schedule_encoded(p)
+
+    expected = {}
+    totals = p.total0.astype(np.int64).copy()
+    svc_counts = p.svc_count0.astype(np.int64).copy()
+    for gi, group in enumerate(p.groups):
+        c = counts[gi]
+        g = GroupFill(
+            n_tasks=int(p.n_tasks[gi]),
+            eligible=[True] * len(p.node_ids),
+            capacity=c.tolist(),
+            penalty=p.penalty[gi].tolist(),
+            svc_count=svc_counts[p.svc_idx[gi]].tolist(),
+            total_count=totals.tolist(),
+        )
+        for task, node_i in zip(group.tasks, slot_order(g, c.tolist())):
+            expected[task.id] = p.node_ids[node_i]
+        totals += c
+        svc_counts[p.svc_idx[gi]] += c
+
+    assert batch.materialize(p, counts) == expected
+
+
 def test_static_mask_matches_string_pipeline():
     """The interned-int mask must agree with the reference-style string
     filter chain (minus the dynamic resource/port/replica filters, which the
