@@ -137,6 +137,12 @@ class NodeCertificate:
     status_err: str = ""
     certificate_pem: bytes = b""
     cn: str = ""
+    # cluster root_ca.last_forced_rotation at CSR submission: the rotation
+    # reconciler finishes only when every node re-CSR'd under the current
+    # epoch — i.e. the node itself fetched and swapped to the new cert, not
+    # merely that the server re-signed an old CSR (premature trust-anchor
+    # swap would wedge nodes still presenting old-signed leafs)
+    rotation_epoch: int = 0
 
 
 @dataclass

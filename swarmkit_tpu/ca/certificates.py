@@ -120,28 +120,46 @@ def parse_cert_identity(cert_pem: bytes) -> CertIdentity:
 
 
 class RootCA:
-    """A signing root: cert + (optionally) key.
+    """A signing root: cert (possibly a multi-PEM trust BUNDLE during root
+    rotation) + (optionally) key.
 
     Mirrors ca/certificates.go RootCA — a root without the signing key is a
-    trust anchor only (worker-side); with the key it can sign CSRs.
+    trust anchor only (worker-side); with the key it can sign CSRs. During a
+    phased root rotation `intermediate_pem` carries the cross-signed new
+    root (old key signs the new root's public key): every cert issued then
+    ships `leaf + intermediate`, so nodes still pinned to the old anchor
+    validate it through the cross-signature while nodes on the new anchor
+    validate the leaf directly (ca/certificates.go CrossSignCACertificate).
     """
 
-    def __init__(self, cert_pem: bytes, key_pem: bytes | None = None):
+    def __init__(self, cert_pem: bytes, key_pem: bytes | None = None,
+                 intermediate_pem: bytes | None = None):
         self.cert_pem = cert_pem
         self.key_pem = key_pem
-        self._cert = x509.load_pem_x509_certificate(cert_pem)
+        self.intermediate_pem = intermediate_pem
+        self._certs = x509.load_pem_x509_certificates(cert_pem)
+        self._cert = self._certs[0]
         self._key = key_from_pem(key_pem) if key_pem else None
 
     # -- construction ------------------------------------------------------
 
     @classmethod
     def create(cls, org: str = "swarmkit-tpu") -> "RootCA":
-        """Self-signed root (reference: ca/certificates.go CreateRootCA:768)."""
+        """Self-signed root (reference: ca/certificates.go CreateRootCA:768).
+
+        The CN carries a unique suffix: during a phased root rotation two
+        roots coexist and certs chain through a cross-signed intermediate —
+        identical subjects would make OpenSSL's path building ambiguous
+        (leaf → intermediate → wrong-keyed anchor of the same name)."""
+        import secrets
+
         key = generate_key()
         now = _now()
         name = x509.Name(
             [
-                x509.NameAttribute(NameOID.COMMON_NAME, org + " CA"),
+                x509.NameAttribute(
+                    NameOID.COMMON_NAME,
+                    f"{org} CA {secrets.token_hex(4)}"),
                 x509.NameAttribute(NameOID.ORGANIZATIONAL_UNIT_NAME, CA_ROLE),
                 x509.NameAttribute(NameOID.ORGANIZATION_NAME, org),
             ]
@@ -235,6 +253,46 @@ class RootCA:
             )
             .sign(self._key, hashes.SHA256())
         )
+        leaf = cert.public_bytes(serialization.Encoding.PEM)
+        if self.intermediate_pem:
+            return leaf + self.intermediate_pem
+        return leaf
+
+    def cross_sign(self, new_root: "RootCA") -> bytes:
+        """Sign the NEW root's public key + subject under THIS (old) root,
+        producing the rotation intermediate (ca/certificates.go
+        CrossSignCACertificate). Chains `new-leaf → intermediate → old
+        anchor` keep old-pinned nodes trusting freshly issued certs."""
+        if not self.can_sign:
+            raise CertificateError("root CA has no signing key")
+        target = new_root._cert
+        now = _now()
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(target.subject)
+            .issuer_name(self._cert.subject)
+            .public_key(target.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(seconds=CERT_BACKDATE))
+            .not_valid_after(target.not_valid_after_utc)
+            .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                           critical=True)
+            .add_extension(
+                x509.KeyUsage(
+                    digital_signature=True,
+                    key_cert_sign=True,
+                    crl_sign=True,
+                    content_commitment=False,
+                    key_encipherment=False,
+                    data_encipherment=False,
+                    key_agreement=False,
+                    encipher_only=False,
+                    decipher_only=False,
+                ),
+                critical=True,
+            )
+            .sign(self._key, hashes.SHA256())
+        )
         return cert.public_bytes(serialization.Encoding.PEM)
 
     def issue_and_save_new_certificates(
@@ -251,15 +309,41 @@ class RootCA:
 
     def verify_cert(self, cert_pem: bytes) -> CertIdentity:
         """Validate signature chain + validity window, return the identity
-        (reference: ca/certificates.go ValidateCertChain)."""
-        cert = x509.load_pem_x509_certificate(cert_pem)
+        (reference: ca/certificates.go ValidateCertChain).
+
+        `cert_pem` may be `leaf` or `leaf + intermediates` (rotation
+        chains); this root may hold several anchors (rotation bundle). The
+        leaf is accepted if it chains to ANY anchor, directly or through
+        the supplied intermediates."""
+        chain = x509.load_pem_x509_certificates(cert_pem)
+        leaf, intermediates = chain[0], chain[1:]
         now = _now()
-        if now < cert.not_valid_before_utc or now > cert.not_valid_after_utc:
-            raise CertificateError("certificate outside validity window")
-        try:
-            cert.verify_directly_issued_by(self._cert)
-        except Exception as exc:  # signature/issuer mismatch
-            raise CertificateError(f"certificate not issued by this root: {exc}") from exc
+        for cert in chain:
+            if now < cert.not_valid_before_utc \
+                    or now > cert.not_valid_after_utc:
+                raise CertificateError(
+                    "certificate outside validity window")
+
+        def links_to_anchor(cert, depth=0) -> bool:
+            for anchor in self._certs:
+                try:
+                    cert.verify_directly_issued_by(anchor)
+                    return True
+                except Exception:
+                    continue
+            if depth >= 2:   # node chains are at most leaf+one intermediate
+                return False
+            for inter in intermediates:
+                try:
+                    cert.verify_directly_issued_by(inter)
+                except Exception:
+                    continue
+                if links_to_anchor(inter, depth + 1):
+                    return True
+            return False
+
+        if not links_to_anchor(leaf):
+            raise CertificateError("certificate not issued by this root")
         return parse_cert_identity(cert_pem)
 
 

@@ -30,8 +30,11 @@ class ExternalCA:
         self.url = url
         self.timeout = timeout
         if trust_root_pem:
+            # pinned trust root, but STANDARD hostname verification stays on
+            # (ca/external.go keeps it too): any cert holder under a shared
+            # CA could otherwise MITM the signing endpoint
             self._ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
-            self._ctx.check_hostname = False
+            self._ctx.check_hostname = True
             self._ctx.verify_mode = ssl.CERT_REQUIRED
             self._ctx.load_verify_locations(
                 cadata=trust_root_pem.decode())

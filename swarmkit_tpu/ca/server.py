@@ -76,6 +76,7 @@ class CAServer:
                     if self._wake.is_set():
                         self._wake.clear()
                         self._sign_pending()
+                    self._reconcile_rotation()
                     continue
                 except ChannelClosed:
                     # slow-subscriber overflow: resubscribe and resync
@@ -91,14 +92,16 @@ class CAServer:
                         IssuanceState.ROTATE,
                     ):
                         self._sign_pending()
+                        self._reconcile_rotation()
         finally:
             queue.stop_watch(ch)
 
     # -- RPC surface -------------------------------------------------------
 
     def get_root_ca_certificate(self) -> bytes:
-        """CA.GetRootCACertificate (api/ca.proto:13-17) — unauthenticated."""
-        return self.root.cert_pem
+        """CA.GetRootCACertificate (api/ca.proto:13-17) — unauthenticated.
+        During a rotation this is the two-anchor trust bundle."""
+        return self.trust_bundle_pem()
 
     def get_unlock_key(self) -> bytes | None:
         """CA.GetUnlockKey — the current autolock KEK from the cluster object."""
@@ -135,6 +138,10 @@ class CAServer:
             # creation for the same node_id must not overwrite the existing
             # node's cert/role (ca/server.go:278-292 — the TLS peer CN must
             # match the renewed node, or the caller must be a manager).
+            cluster = tx.get_cluster(self.cluster_id)
+            epoch = (cluster.root_ca.last_forced_rotation
+                     if cluster is not None and cluster.root_ca is not None
+                     else 0)
             node = tx.get_node(node_id)
             if node is None:
                 if role is None:
@@ -148,6 +155,7 @@ class CAServer:
                         csr_pem=csr_pem,
                         status_state=IssuanceState.PENDING,
                         cn=node_id,
+                        rotation_epoch=epoch,
                     ),
                 )
                 tx.create(node)
@@ -166,6 +174,7 @@ class CAServer:
                     csr_pem=csr_pem,
                     status_state=IssuanceState.PENDING,
                     cn=node_id,
+                    rotation_epoch=epoch,
                 )
                 tx.update(node)
 
@@ -222,8 +231,11 @@ class CAServer:
                 in (IssuanceState.PENDING, IssuanceState.RENEW, IssuanceState.ROTATE)
             ]
         )
+        rot0 = self._rotation()
         for node in pending:
-            signing_root = self.root  # snapshot: rotation may swap self.root
+            # during a phased rotation the signer is the NEW root with the
+            # cross-signed intermediate appended (ca/reconciler.go)
+            signing_root = self._signing_root()
             observed_state = node.certificate.status_state
             signed_csr = node.certificate.csr_pem
             try:
@@ -277,8 +289,14 @@ class CAServer:
                     # one — publishing this cert would pair it with a key the
                     # node no longer holds; the newer CSR is signed next pass
                     return
-                if signing_root is not self.root:
-                    return  # raced with root rotation: re-signed next pass
+                cluster = tx.get_cluster(self.cluster_id)
+                rot_now = (cluster.root_ca.root_rotation
+                           if cluster is not None
+                           and cluster.root_ca is not None else None)
+                if (rot_now or None) != (rot0 or None):
+                    return  # raced with rotation start/finish: next pass
+                if rot0 is None and signing_root is not self.root:
+                    return  # raced with a trust swap: re-signed next pass
                 n.certificate.certificate_pem = cert_pem
                 n.certificate.status_state = state
                 n.certificate.status_err = err
@@ -291,32 +309,122 @@ class CAServer:
                 self._status_cond.notify_all()
 
     # -- root rotation -----------------------------------------------------
+    #
+    # Phased, as in ca/reconciler.go: rotation STARTS by recording the new
+    # root (cert+key) and its cross-signed intermediate on the cluster
+    # object; the signing loop immediately issues under the NEW key with
+    # the intermediate appended (old-pinned nodes validate through the
+    # cross-signature), while the published trust bundle carries BOTH
+    # anchors. The reconciler re-marks stragglers and FINISHES — swapping
+    # the trust anchor, digest, and join tokens — only when every node
+    # certificate chains to the new root. No node is ever wedged: at every
+    # instant each node trusts whichever root its peers' certs carry.
+
+    def _rotation(self):
+        cluster = self.store.view(
+            lambda tx: tx.get_cluster(self.cluster_id))
+        if cluster is None or cluster.root_ca is None:
+            return None
+        return cluster.root_ca.root_rotation
+
+    def _signing_root(self) -> RootCA:
+        rot = self._rotation()
+        if rot:
+            return RootCA(rot["new_ca_cert_pem"], rot["new_ca_key_pem"],
+                          intermediate_pem=rot["cross_signed_pem"])
+        return self.root
+
+    def trust_bundle_pem(self) -> bytes:
+        """The PEM anchors nodes should trust right now: both roots plus the
+        cross-signed intermediate while a rotation is in flight (the
+        intermediate lets a joiner whose token pins the OLD root verify that
+        the old root vouches for the new one), else the single current
+        root."""
+        rot = self._rotation()
+        if rot:
+            return (self.root.cert_pem + rot["new_ca_cert_pem"]
+                    + rot["cross_signed_pem"])
+        return self.root.cert_pem
 
     def rotate_root_ca(self) -> RootCA:
-        """Generate a new root and mark all certs ROTATE so the signing loop
-        re-issues under it (condensed ca/reconciler.go rotation: the
-        reference cross-signs and rotates in phases; we swap + re-issue,
-        which preserves the observable end state)."""
+        """Begin a phased root rotation (ca/reconciler.go). Returns the new
+        root. Completion is CLIENT-driven: nodes observe the new trust
+        bundle (session plane / root download), renew with a fresh CSR, and
+        the reconciler finishes only when every node's cert was re-issued
+        from a post-rotation CSR — i.e. the node itself fetched and swapped
+        it. Re-signing old CSRs server-side would let the anchor swap race
+        ahead of what nodes actually present on the wire."""
+        if self.external_ca is not None:
+            # the external service signs under the OLD root's key; certs it
+            # issues can never chain to a locally minted new root, so the
+            # reconciler could never finish — fail fast instead of wedging
+            # (rotate the external CA's own root out-of-band first)
+            raise CertificateError(
+                "root rotation with an external CA configured requires "
+                "rotating the external CA out-of-band, then updating the "
+                "cluster CA config")
         new_root = RootCA.create(self.org)
-        old_root = self.root
-        self.root = new_root
+        cross = self.root.cross_sign(new_root)
 
         def txn(tx):
             cluster = tx.get_cluster(self.cluster_id)
             if cluster is not None and cluster.root_ca is not None:
-                from .config import generate_join_token
-
-                cluster.root_ca.ca_cert_pem = new_root.cert_pem
-                cluster.root_ca.ca_key_pem = new_root.key_pem or b""
-                cluster.root_ca.cert_digest = new_root.digest()
-                cluster.root_ca.join_token_worker = generate_join_token(new_root)
-                cluster.root_ca.join_token_manager = generate_join_token(new_root)
+                cluster.root_ca.root_rotation = {
+                    "new_ca_cert_pem": new_root.cert_pem,
+                    "new_ca_key_pem": new_root.key_pem or b"",
+                    "cross_signed_pem": cross,
+                }
+                cluster.root_ca.last_forced_rotation += 1
                 tx.update(cluster)
-            for n in tx.find_nodes(by.All()):
-                if n.certificate is not None and n.certificate.csr_pem:
-                    n.certificate.status_state = IssuanceState.ROTATE
-                    tx.update(n)
 
         self.store.update(txn)
         self._wake.set()
         return new_root
+
+    def _reconcile_rotation(self):
+        """ca/reconciler.go: finish an in-flight rotation (anchor / digest /
+        token swap) once every node certificate chains to the new root AND
+        was issued for a CSR submitted under the current rotation epoch."""
+        rot = self._rotation()
+        if not rot:
+            return
+        new_root = RootCA(rot["new_ca_cert_pem"])
+        cluster = self.store.view(
+            lambda tx: tx.get_cluster(self.cluster_id))
+        epoch = cluster.root_ca.last_forced_rotation
+        nodes = self.store.view(lambda tx: tx.find_nodes(by.All()))
+        for n in nodes:
+            cert = n.certificate
+            if cert is None or not cert.csr_pem:
+                continue
+            if cert.status_state != IssuanceState.ISSUED:
+                return
+            if getattr(cert, "rotation_epoch", 0) != epoch:
+                return
+            try:
+                new_root.verify_cert(cert.certificate_pem)
+            except Exception:
+                return
+
+        full_new_root = RootCA(rot["new_ca_cert_pem"],
+                               rot["new_ca_key_pem"] or None)
+
+        def finish(tx):
+            cluster = tx.get_cluster(self.cluster_id)
+            if cluster is None or cluster.root_ca is None \
+                    or not cluster.root_ca.root_rotation:
+                return
+            from .config import generate_join_token
+
+            cluster.root_ca.ca_cert_pem = full_new_root.cert_pem
+            cluster.root_ca.ca_key_pem = full_new_root.key_pem or b""
+            cluster.root_ca.cert_digest = full_new_root.digest()
+            cluster.root_ca.join_token_worker = \
+                generate_join_token(full_new_root)
+            cluster.root_ca.join_token_manager = \
+                generate_join_token(full_new_root)
+            cluster.root_ca.root_rotation = None
+            tx.update(cluster)
+
+        self.store.update(finish)
+        self.root = full_new_root

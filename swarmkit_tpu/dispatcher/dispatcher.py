@@ -96,8 +96,11 @@ class Session:
     heartbeat: Heartbeat
     sequence: int = 0
     known_tasks: dict[str, int] = field(default_factory=dict)  # id -> version
-    known_secrets: set[str] = field(default_factory=set)
-    known_configs: set[str] = field(default_factory=set)
+    # id -> version: an UPDATED secret/config (e.g. rotated credential or a
+    # re-materialized driver payload) must re-ship incrementally, so the
+    # diff compares versions, not mere id presence
+    known_secrets: dict[str, int] = field(default_factory=dict)
+    known_configs: dict[str, int] = field(default_factory=dict)
     known_volumes: set[str] = field(default_factory=set)
     session_channel: Channel | None = None
     last_session_msg: SessionMessage | None = None
@@ -367,6 +370,15 @@ class Dispatcher:
             for c in tx.find_clusters():
                 if c.root_ca is not None and c.root_ca.ca_cert_pem:
                     root_pem = c.root_ca.ca_cert_pem
+                    # mid-rotation: nodes must trust BOTH anchors, and the
+                    # cross-signed intermediate ships along so old-pinned
+                    # joiners can verify the old root vouches for the new
+                    # (ca/reconciler.go — old-pinned peers and new-signed
+                    # certs coexist until every cert has moved over)
+                    rot = c.root_ca.root_rotation
+                    if rot:
+                        root_pem = (root_pem + rot["new_ca_cert_pem"]
+                                    + rot["cross_signed_pem"])
                 keys = list(c.network_bootstrap_keys or [])
                 break
             return sorted(managers), root_pem, keys, roles
@@ -575,10 +587,16 @@ class Dispatcher:
             # which dirties the node anyway (assignments.go keeps per-node
             # reference sets for the same reason — dirtying every session
             # per secret event collapses at 10k nodes)
+            prefix = obj.id + "."   # driver clones ship as <sid>.<task id>
             with self._lock:
+                if isinstance(ev, EventDelete):
+                    for key in [k for k in self._driver_cache
+                                if k[0] == obj.id]:
+                        del self._driver_cache[key]
                 self._dirty_nodes.update(
                     nid for nid, s in self._sessions.items()
-                    if obj.id in s.known_secrets)
+                    if obj.id in s.known_secrets
+                    or any(k.startswith(prefix) for k in s.known_secrets))
         elif isinstance(obj, Config):
             with self._lock:
                 self._dirty_nodes.update(
@@ -665,6 +683,11 @@ class Dispatcher:
         clone.id = f"{secret.id}.{task.id}"
         clone.spec.data = payload
         with self._lock:
+            # purge superseded versions for this (secret, task): long-lived
+            # tasks with rotated credentials must not accrete stale payloads
+            for k in [k for k in self._driver_cache
+                      if k[0] == secret.id and k[2] == task.id and k != key]:
+                del self._driver_cache[k]
             self._driver_cache[key] = clone
         return clone
 
@@ -756,8 +779,10 @@ class Dispatcher:
         tasks, secrets, configs, volumes, unpublish = \
             self._assignment_view(session)
         session.known_tasks = {t.id: t.meta.version.index for t in tasks}
-        session.known_secrets = set(secrets)
-        session.known_configs = set(configs)
+        session.known_secrets = {
+            sid: s.meta.version.index for sid, s in secrets.items()}
+        session.known_configs = {
+            cid: c.meta.version.index for cid, c in configs.items()}
         session.known_volumes = set(volumes)
         session.sequence += 1
         changes = (
@@ -793,14 +818,14 @@ class Dispatcher:
             if tid not in new_known:
                 changes.append(Assignment("remove", "task", tid))
         for sid, s in secrets.items():
-            if sid not in session.known_secrets:
+            if session.known_secrets.get(sid) != s.meta.version.index:
                 changes.append(Assignment("update", "secret", s.copy()))
-        for sid in session.known_secrets - set(secrets):
+        for sid in set(session.known_secrets) - set(secrets):
             changes.append(Assignment("remove", "secret", sid))
         for cid, c in configs.items():
-            if cid not in session.known_configs:
+            if session.known_configs.get(cid) != c.meta.version.index:
                 changes.append(Assignment("update", "config", c.copy()))
-        for cid in session.known_configs - set(configs):
+        for cid in set(session.known_configs) - set(configs):
             changes.append(Assignment("remove", "config", cid))
         for vid, v in volumes.items():
             if vid not in session.known_volumes:
@@ -816,8 +841,10 @@ class Dispatcher:
             if vid not in session.known_volumes and vid not in volumes:
                 changes.append(Assignment("remove", "volume", va))
         session.known_tasks = new_known
-        session.known_secrets = set(secrets)
-        session.known_configs = set(configs)
+        session.known_secrets = {
+            sid: s.meta.version.index for sid, s in secrets.items()}
+        session.known_configs = {
+            cid: c.meta.version.index for cid, c in configs.items()}
         session.known_volumes = set(volumes)
         if changes:
             session.sequence += 1

@@ -102,12 +102,69 @@ def fetch_root_cert(addr: str, expected_digest: str,
     finally:
         sock.close()
     cert_pem = payload
-    got = hashlib.sha256(cert_pem).hexdigest()
-    if got != expected_digest:
-        raise TrustPinMismatch(
-            f"remote root CA digest {got[:16]}… does not match the join "
-            f"token pin {expected_digest[:16]}… — refusing to join")
-    return cert_pem
+    if hashlib.sha256(cert_pem).hexdigest() == expected_digest:
+        return cert_pem
+    # Mid-rotation the server publishes a multi-anchor bundle (old root,
+    # new root, cross-signed intermediate); a token minted before the
+    # rotation pins one member. The pin only extends to OTHER members that
+    # the pinned anchor actually vouches for: a member is accepted iff it is
+    # directly issued by an accepted member, or an accepted member issued a
+    # cross-signature for its exact (subject, public key). Anything else in
+    # the bundle (e.g. an attacker-appended root on the join path) rejects
+    # the whole download.
+    try:
+        from cryptography import x509 as _x509
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding, PublicFormat)
+
+        blocks = [b"-----BEGIN CERTIFICATE-----" + part
+                  for part in cert_pem.split(b"-----BEGIN CERTIFICATE-----")
+                  if part.strip()]
+        certs = [_x509.load_pem_x509_certificates(b)[0] for b in blocks]
+
+        def spki(c):
+            return c.public_key().public_bytes(
+                Encoding.DER, PublicFormat.SubjectPublicKeyInfo)
+
+        def issued_by(child, parent) -> bool:
+            try:
+                child.verify_directly_issued_by(parent)
+                return True
+            except Exception:
+                return False
+
+        accepted = {i for i, b in enumerate(blocks)
+                    if hashlib.sha256(b).hexdigest() == expected_digest}
+        changed = bool(accepted)
+        while changed:
+            changed = False
+            for i, c in enumerate(certs):
+                if i in accepted:
+                    continue
+                for j in accepted:
+                    if issued_by(c, certs[j]):
+                        accepted.add(i)
+                        changed = True
+                        break
+                    # cross-signature vouching: an accepted anchor issued a
+                    # cert for this exact subject+key elsewhere in the bundle
+                    if any(issued_by(certs[k], certs[j])
+                           and certs[k].subject == c.subject
+                           and spki(certs[k]) == spki(c)
+                           for k in range(len(certs)) if k != i):
+                        accepted.add(i)
+                        changed = True
+                        break
+        if accepted and len(accepted) == len(certs):
+            return cert_pem
+    except TrustPinMismatch:
+        raise
+    except Exception:
+        pass
+    raise TrustPinMismatch(
+        "remote root CA bundle does not match the join token pin "
+        f"{expected_digest[:16]}… (or contains unvouched anchors) — "
+        "refusing to join")
 
 
 class _Ticker(threading.Thread):
@@ -180,6 +237,11 @@ class SwarmNode:
         self._role_flip_active = False
         self._role_flip_lock = threading.Lock()
         self._last_session_msg = None
+        self._root_renew_active = False
+        # state.json is read-merge-written from several threads (promote
+        # flips, session plane, refresh loop) — serialize the cycle or a
+        # managers write could clobber a just-persisted raft_id
+        self._state_lock = threading.Lock()
 
     # ------------------------------------------------------------- identity
 
@@ -197,14 +259,29 @@ class SwarmNode:
             return json.load(f)
 
     def _save_state(self, **updates):
-        state_path = self._paths()[0]
-        os.makedirs(self.state_dir, exist_ok=True)
-        state = self._load_state()
-        state.update(updates)
-        tmp = state_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(state, f)
-        os.replace(tmp, state_path)
+        with self._state_lock:
+            state_path = self._paths()[0]
+            os.makedirs(self.state_dir, exist_ok=True)
+            state = self._load_state()
+            state.update(updates)
+            tmp = state_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(state, f)
+            os.replace(tmp, state_path)
+
+    def _persist_managers(self, addrs: list[str]) -> None:
+        """persistentRemotes (node/node.go:1202-1286): remember the live
+        manager list so a restarted worker reconnects without a join
+        address. Written only on change."""
+        if not addrs:
+            return
+        addrs = sorted(addrs)
+        if addrs != getattr(self, "_persisted_managers", None):
+            self._persisted_managers = addrs
+            try:
+                self._save_state(managers=addrs)
+            except OSError:
+                pass
 
     def _save_identity(self):
         _state, cert_path, ca_path, key_path = self._paths()
@@ -394,6 +471,11 @@ class SwarmNode:
         transport.set_node(raft)
         self._transport = transport
         self.raft = raft
+        # a member that applies its own removal has been demoted by the
+        # leader's role manager (role_manager.go removes the raft member
+        # first); the removed side cannot learn it from the session plane —
+        # its own dispatcher serves a store that stopped replicating
+        raft.on_removed = self._on_member_removed
 
         proposer = RaftProposer(raft)
         self.store = MemoryStore(proposer=proposer)
@@ -580,14 +662,22 @@ class SwarmNode:
         return list(shim.seeds) if shim is not None else []
 
     def _start_worker(self):
-        if self.join_addr is None:
+        join_addr = self.join_addr
+        if join_addr is None:
+            # restart path: reconnect from the persisted manager list
+            # (reference node/node.go:1202-1286 persistentRemotes — a node
+            # that joined once needs no join address ever again)
+            persisted = self._load_state().get("managers") or []
+            if persisted:
+                join_addr = ",".join(persisted)
+        if join_addr is None:
             raise NodeError("a worker node needs a join address")
-        self._start_agent(self.join_addr)
+        self._start_agent(join_addr)
         # renewal follows the live manager list, not just the join seed
         # (the original endpoint may die long before the cert expires)
         self.renewer = TLSRenewer(
             self.security,
-            RemoteCA(self.join_addr, security=self.security,
+            RemoteCA(join_addr, security=self.security,
                      seeds_fn=self._live_manager_seeds))
         self.renewer.start()
 
@@ -622,12 +712,15 @@ class SwarmNode:
             msg = self._last_session_msg
             if msg is not None:
                 self._maybe_flip_roles(msg)
+            self._ensure_rotation_renewal()
             try:
                 managers = dispatcher._conn().call("cluster.managers",
                                                    timeout=5.0)
             except Exception:
                 continue
-            dispatcher.update_managers([addr for _nid, addr in managers])
+            addrs = [addr for _nid, addr in managers]
+            dispatcher.update_managers(addrs)
+            self._persist_managers(addrs)
 
     # ------------------------------------------------- session message plane
 
@@ -638,14 +731,82 @@ class SwarmNode:
         if msg.managers:
             addrs = [a for _nid, a in msg.managers]
             self._dispatcher_shim.update_managers(addrs)
+            self._persist_managers(addrs)
             self._manager_addrs = addrs
         if msg.network_keys:
             try:
                 self.executor.set_network_bootstrap_keys(msg.network_keys)
             except Exception:
                 pass
+        self._apply_root_update(msg.root_ca_pem)
         self._last_session_msg = msg
         self._maybe_flip_roles(msg)
+
+    def _apply_root_update(self, root_pem: bytes) -> None:
+        """Adopt a changed cluster trust bundle from the session plane and
+        renew this node's certificate onto the new signer (the rotation
+        reconciler marks our server-side cert ROTATE; the renewal CSR picks
+        the fresh cert up). node/node.go handleSessionMessage applies the
+        root the same way; persistence rides the security watch."""
+        if not root_pem or self.security is None \
+                or root_pem == self.security.root_ca.cert_pem:
+            return
+        try:
+            from ..ca import RootCA
+
+            self.security.update_root_ca(RootCA(root_pem))
+        except Exception:
+            log.exception("session plane delivered an unusable root bundle")
+            return
+        self._kick_renew()
+
+    def _kick_renew(self):
+        """Single-flight background certificate renewal (used when the trust
+        root changes and by the rotation straggler check)."""
+        if self.renewer is None or self._root_renew_active:
+            return
+        self._root_renew_active = True
+
+        def renew():
+            try:
+                deadline = time.monotonic() + JOIN_TIMEOUT
+                while not self._stop.is_set() \
+                        and time.monotonic() < deadline:
+                    try:
+                        self.renewer.renew_once()
+                        return
+                    except Exception:
+                        if self._stop.wait(JOIN_RETRY):
+                            return
+            finally:
+                self._root_renew_active = False
+
+        t = threading.Thread(target=renew, daemon=True, name="root-renew")
+        t.start()
+        self._threads.append(t)
+
+    def _ensure_rotation_renewal(self):
+        """Self-healing rotation stragglers (ca/reconciler.go force-renews
+        them server-side; here the node heals itself): while the adopted
+        trust is a multi-anchor rotation bundle but our leaf does not chain
+        to the NEW root (the bundle's second anchor), keep kicking renewals
+        — a single missed 30s window after `_apply_root_update` must not
+        stall the rotation until the natural renewal window."""
+        sec = self.security
+        if sec is None or self._root_renew_active:
+            return
+        try:
+            bundle = sec.root_ca.cert_pem
+            parts = [b"-----BEGIN CERTIFICATE-----" + p
+                     for p in bundle.split(b"-----BEGIN CERTIFICATE-----")
+                     if p.strip()]
+            if len(parts) < 2:
+                return
+            from ..ca import RootCA
+
+            RootCA(parts[1]).verify_cert(sec.key_and_cert()[1])
+        except Exception:
+            self._kick_renew()
 
     def _maybe_flip_roles(self, msg):
         """Called from BOTH the session-message thread and the periodic
@@ -706,6 +867,22 @@ class SwarmNode:
             log.exception("promotion failed")
         finally:
             self._role_flip_active = False
+
+    def _on_member_removed(self):
+        """Raft applied OUR removal from the membership: the leader's role
+        manager demoted this node (the removal commits before node.role
+        flips — role_manager.go:154-214), so manager teardown is safe and
+        cannot break quorum. This is the only demotion signal a LEADER
+        being demoted ever gets — its agent sessions with itself, and its
+        local store stops replicating the moment it is removed."""
+        with self._role_flip_lock:
+            if self._role_flip_active or self.manager is None:
+                return
+            self._role_flip_active = True
+        t = threading.Thread(target=self._demote, daemon=True,
+                             name="demote-removed")
+        t.start()
+        self._threads.append(t)
 
     def _demote(self):
         """Manager → worker: called once the role manager has already

@@ -86,6 +86,10 @@ class RaftNode:
         self.snapshot_state = snapshot_state or (lambda: None)
         self.restore_state = restore_state or (lambda s: None)
         self.on_leadership = on_leadership or (lambda is_leader: None)
+        # fired (from a fresh thread) when this node applies its OWN removal
+        # from the membership — the reference surfaces this as
+        # ErrMemberRemoved to node.superviseManager, which demotes
+        self.on_removed: Callable[[], None] | None = None
         self.election_tick = election_tick
         self.heartbeat_tick = heartbeat_tick
         self.snapshot_interval = snapshot_interval
@@ -668,6 +672,10 @@ class RaftNode:
             self.match_index.pop(cc.raft_id, None)
             if cc.raft_id == self.id:
                 self._become_follower(self.term, None)
+                if self.on_removed is not None:
+                    # off-thread: the apply loop must not run teardown
+                    threading.Thread(target=self.on_removed, daemon=True,
+                                     name="raft-removed").start()
         if self.storage is not None:
             self.storage.save_membership(self.members)
 

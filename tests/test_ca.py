@@ -245,14 +245,37 @@ def test_root_rotation():
 
     new_root = server.rotate_root_ca()
     assert new_root.digest() != old_digest
+    # phase 1: rotation in flight — trust bundle carries BOTH anchors and
+    # the old join tokens still pin a member of it
+    bundle = server.trust_bundle_pem()
+    assert root.cert_pem in bundle and new_root.cert_pem in bundle
+    # rotation completes only when the NODE renews (client-driven): the
+    # reconciler must refuse to finish before that
+    server._reconcile_rotation()
+    cl_mid = store.view(lambda tx: tx.get_cluster("cluster-1"))
+    assert cl_mid.root_ca.root_rotation is not None
+
+    _, csr2 = create_csr("x", NodeRole.WORKER, "swarmkit-tpu")
+    server.issue_node_certificate(
+        csr2, node_id=node_id,
+        caller=Caller(node_id, NodeRole.WORKER, "swarmkit-tpu"))
     server._sign_pending()
     cert = server.node_certificate_status(node_id, timeout=2)
     assert cert.status_state == IssuanceState.ISSUED
+    # the re-issued cert chains to the NEW root directly...
     ident = new_root.verify_cert(cert.certificate_pem)
     assert ident.node_id == node_id
-    # store tokens now pin the new root
+    # ...and to the OLD root through the cross-signed intermediate, so
+    # old-pinned peers keep trusting it mid-rotation
+    ident_old = root.verify_cert(cert.certificate_pem)
+    assert ident_old.node_id == node_id
+
+    # phase 2: every cert moved over → the reconciler finishes the rotation
+    server._reconcile_rotation()
     cl = store.view(lambda tx: tx.get_cluster("cluster-1"))
+    assert cl.root_ca.root_rotation is None
     assert parse_join_token(cl.root_ca.join_token_worker).root_digest == new_root.digest()
+    assert server.trust_bundle_pem() == new_root.cert_pem
 
 
 def test_renewal_requires_identity():
@@ -312,6 +335,23 @@ def test_rotation_then_renewal_recovers_trust():
     time.sleep(0.2)
     server._sign_pending()
     rt.join(timeout=5)
+    assert done.get("ok") is True
+    # mid-rotation the node trusts the two-anchor bundle and its cert is
+    # signed by the new root (cross-signed chain)
+    new_root.verify_cert(sec.key_and_cert()[1])
+
+    # all certs moved → reconciler finishes; the next renewal round trims
+    # the node's trust down to the new root alone
+    server._reconcile_rotation()
+    cl = store.view(lambda tx: tx.get_cluster("cluster-1"))
+    assert cl.root_ca.root_rotation is None
+    done.clear()
+    rt2 = threading.Thread(
+        target=lambda: done.update(ok=renewer.renew_once()))
+    rt2.start()
+    time.sleep(0.2)
+    server._sign_pending()
+    rt2.join(timeout=5)
     assert done.get("ok") is True
     assert sec.root_ca.digest() == new_root.digest()
     new_root.verify_cert(sec.key_and_cert()[1])

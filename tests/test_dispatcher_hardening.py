@@ -146,6 +146,48 @@ def test_secret_events_dirty_only_referencing_sessions(store):
         d.stop()
 
 
+def test_updated_secret_reships_incrementally(store):
+    """A rotated secret (version bump) must reach agents that already hold
+    it via an INCREMENTAL update — id-presence diffing would silently keep
+    the stale credential until a full resync (assignments.go tracks
+    versions for exactly this)."""
+    from swarmkit_tpu.api.specs import ContainerSpec, SecretReference
+
+    _mk_node(store, "n1")
+    s = Secret(id="sec1", spec=SecretSpec(annotations=Annotations(name="s"),
+                                          data=b"v1"))
+    store.update(lambda tx: tx.create(s))
+    t = Task(id="t1", service_id="svc", node_id="n1")
+    t.status.state = TaskState.RUNNING
+    t.desired_state = TaskState.RUNNING
+    t.spec.runtime = ContainerSpec(
+        secrets=[SecretReference(secret_id="sec1", secret_name="s")])
+    store.update(lambda tx: tx.create(t))
+
+    d = Dispatcher(store, heartbeat_period=5.0)
+    d.start()
+    try:
+        sid = d.register("n1")
+        ch = d.assignments("n1", sid)
+        full = ch.get(timeout=2)
+        shipped = [a.item for a in full.changes
+                   if a.kind == "secret" and a.action == "update"]
+        assert [x.spec.data for x in shipped] == [b"v1"]
+
+        s2 = store.view(lambda tx: tx.get_secret("sec1")).copy()
+        s2.spec.data = b"v2"
+        store.update(lambda tx: tx.update(s2))
+
+        def got_update():
+            msg = ch.get(timeout=2)
+            return [a.item.spec.data for a in msg.changes
+                    if a.kind == "secret" and a.action == "update"]
+
+        assert wait_for(lambda: got_update() == [b"v2"], timeout=5)
+    finally:
+        d.stop()
+
+
 def test_cluster_heartbeat_reconfig_live(store):
     c = Cluster(id="c1", spec=ClusterSpec(
         annotations=Annotations(name="default")))

@@ -1,0 +1,318 @@
+"""Integration tier: full-stack cluster scenarios over real TCP + mTLS,
+modeled on the reference's integration harness
+(/root/reference/integration/cluster_test.go:26-36 testCluster with
+AddManager/AddAgent/RemoveNode/SetNodeRole/Leader; scenarios
+integration/integration_test.go:196-965).
+
+Complements test_daemon.py (worker join, follower-write forwarding, leader
+kill, manager state-dir rejoin, promote/demote) with the remaining verdict-7
+scenarios: leader demotion, worker restart/rejoin, node removal →
+reschedule, wrong-cert join rejection, and root rotation under live nodes.
+"""
+import time
+
+import pytest
+
+from swarmkit_tpu.agent.testutils import FakeExecutor
+from swarmkit_tpu.api.specs import Annotations, ServiceSpec
+from swarmkit_tpu.api.types import NodeRole, NodeStatusState, TaskState
+from swarmkit_tpu.node.daemon import SwarmNode
+from swarmkit_tpu.rpc.services import RemoteControl
+from swarmkit_tpu.store import by
+
+from test_scheduler import wait_for  # noqa: E402
+
+pytestmark = pytest.mark.daemon
+
+
+class Cluster:
+    """In-process cluster harness (cluster_test.go testCluster)."""
+
+    def __init__(self, tmp_path):
+        self.base = tmp_path
+        self.nodes: list[SwarmNode] = []
+        self._seq = 0
+
+    # ------------------------------------------------------------ membership
+    def _spawn(self, name, **kw):
+        node = SwarmNode(
+            state_dir=str(self.base / name),
+            executor=FakeExecutor({"*": {"run_forever": True}},
+                                  hostname=name),
+            heartbeat_period=0.5,
+            tick_interval=0.05,
+            manager_refresh_interval=0.5,
+            **kw,
+        )
+        node.start()
+        self.nodes.append(node)
+        return node
+
+    def add_manager(self, name=None):
+        name = name or f"m{self._next()}"
+        if not self.nodes:
+            n = self._spawn(name, listen_addr="127.0.0.1:0")
+            assert wait_for(lambda: n.is_leader, timeout=15)
+            return n
+        mtok, _ = self.tokens()
+        return self._spawn(name, listen_addr="127.0.0.1:0",
+                           join_addr=self.leader().addr, join_token=mtok)
+
+    def add_agent(self, name=None):
+        name = name or f"w{self._next()}"
+        _, wtok = self.tokens()
+        addrs = ",".join(m.addr for m in self.managers())
+        return self._spawn(name, join_addr=addrs, join_token=wtok)
+
+    def _next(self):
+        self._seq += 1
+        return self._seq
+
+    # -------------------------------------------------------------- queries
+    def managers(self):
+        return [n for n in self.nodes if n.manager is not None]
+
+    def leader(self) -> SwarmNode:
+        assert wait_for(lambda: any(n.is_leader for n in self.nodes
+                                    if n.manager is not None), timeout=30)
+        return next(n for n in self.nodes if n.is_leader)
+
+    def tokens(self):
+        m = self.leader()
+
+        def seeded():
+            c = m.store.view(lambda tx: tx.get_cluster(m.manager.cluster_id))
+            return c is not None and c.root_ca is not None
+
+        assert wait_for(seeded, timeout=15)
+        c = m.store.view(lambda tx: tx.get_cluster(m.manager.cluster_id))
+        return c.root_ca.join_token_manager, c.root_ca.join_token_worker
+
+    def control(self, node=None) -> RemoteControl:
+        node = node or self.leader()
+        return RemoteControl(node.addr, node.security)
+
+    def running(self, service_id, node=None) -> list:
+        node = node or self.leader()
+        tasks = node.store.view(
+            lambda tx: tx.find_tasks(by.ByServiceID(service_id)))
+        return [t for t in tasks if t.status.state == TaskState.RUNNING]
+
+    def set_node_role(self, node_id, role):
+        ctl = self.control()
+        try:
+            for _ in range(30):
+                n = ctl.get_node(node_id)
+                n.spec.desired_role = role
+                try:
+                    ctl.update_node(n.id, n.meta.version, n.spec)
+                    return
+                except Exception as exc:
+                    if "out of sequence" not in str(exc):
+                        raise
+                    time.sleep(0.1)
+            raise AssertionError("could not update node role")
+        finally:
+            ctl.close()
+
+    def stop_all(self):
+        for n in reversed(self.nodes):
+            try:
+                n.stop()
+            except Exception:
+                pass
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = Cluster(tmp_path)
+    yield c
+    c.stop_all()
+
+
+def _create_service(cluster, name, replicas):
+    ctl = cluster.control()
+    try:
+        svc = None
+        end = time.monotonic() + 30
+        while svc is None:
+            try:
+                svc = ctl.create_service(ServiceSpec(
+                    annotations=Annotations(name=name), replicas=replicas))
+            except Exception:
+                if time.monotonic() >= end:
+                    raise
+                time.sleep(0.5)
+        return svc
+    finally:
+        ctl.close()
+
+
+def test_leader_demotion_moves_leadership(cluster):
+    """integration_test.go:383-514 — demoting the raft LEADER transfers
+    leadership, shrinks the quorum safely, and the cluster keeps serving."""
+    m1 = cluster.add_manager()
+    m2 = cluster.add_manager()
+    m3 = cluster.add_manager()
+    managers = [m1, m2, m3]
+    assert wait_for(
+        lambda: all(len(m.raft.members) == 3 for m in managers), timeout=30)
+
+    svc = _create_service(cluster, "before-demote", 4)
+    assert wait_for(lambda: len(cluster.running(svc.id)) == 4, timeout=30)
+
+    old_leader = cluster.leader()
+    cluster.set_node_role(old_leader.node_id, NodeRole.WORKER)
+
+    # leadership must land on one of the other two, quorum shrinks to 2
+    others = [m for m in managers if m is not old_leader]
+    assert wait_for(lambda: any(m.is_leader for m in others), timeout=60)
+    assert wait_for(
+        lambda: all(len(m.raft.members) == 2 for m in others), timeout=60)
+    assert wait_for(lambda: old_leader.manager is None, timeout=60)
+
+    # the demoted node keeps working as a worker; the cluster serves writes
+    svc2 = _create_service(cluster, "after-demote", 3)
+    assert wait_for(lambda: len(cluster.running(svc2.id)) == 3, timeout=30)
+
+
+def test_worker_restart_rejoins_same_identity(cluster):
+    """integration_test.go node rejoin: a worker restarted from its state
+    dir comes back with the same node identity and its tasks reconverge."""
+    cluster.add_manager()
+    w1 = cluster.add_agent("w-rejoin")
+    leader = cluster.leader()
+
+    def worker_ready():
+        n = leader.store.view(lambda tx: tx.get_node(w1.node_id))
+        return n is not None and n.status.state == NodeStatusState.READY
+
+    assert wait_for(worker_ready, timeout=20)
+    svc = _create_service(cluster, "steady", 4)
+    assert wait_for(lambda: len(cluster.running(svc.id)) == 4, timeout=30)
+
+    node_id = w1.node_id
+    w1.stop()
+    cluster.nodes.remove(w1)
+
+    # heartbeat expiry marks it DOWN; its tasks reschedule on the manager
+    def down():
+        n = leader.store.view(lambda tx: tx.get_node(node_id))
+        return n is not None and n.status.state == NodeStatusState.DOWN
+
+    assert wait_for(down, timeout=30)
+    assert wait_for(lambda: len(cluster.running(svc.id)) == 4, timeout=60)
+
+    # restart from the same state dir: same identity, no token needed
+    w1b = cluster._spawn("w-rejoin")
+    assert wait_for(lambda: w1b.node_id == node_id, timeout=20)
+    assert wait_for(worker_ready, timeout=30)
+
+
+def test_node_removal_reschedules_tasks(cluster):
+    """remove a worker via the control plane: its tasks move elsewhere and
+    the node object disappears (controlapi node.go RemoveNode)."""
+    cluster.add_manager()
+    w1 = cluster.add_agent()
+    leader = cluster.leader()
+
+    assert wait_for(lambda: leader.store.view(
+        lambda tx: tx.get_node(w1.node_id)) is not None, timeout=20)
+    svc = _create_service(cluster, "spread", 6)
+    assert wait_for(lambda: len(cluster.running(svc.id)) == 6, timeout=30)
+
+    w1.stop()
+    cluster.nodes.remove(w1)
+
+    def node_down():
+        n = leader.store.view(lambda tx: tx.get_node(w1.node_id))
+        return n is not None and n.status.state == NodeStatusState.DOWN
+
+    assert wait_for(node_down, timeout=30)
+
+    ctl = cluster.control()
+    try:
+        ctl.remove_node(w1.node_id, force=True)
+    finally:
+        ctl.close()
+
+    assert wait_for(lambda: leader.store.view(
+        lambda tx: tx.get_node(w1.node_id)) is None, timeout=20)
+    # all replicas land on the remaining node
+    def all_on_manager():
+        running = cluster.running(svc.id)
+        return (len(running) == 6
+                and all(t.node_id == leader.node_id for t in running))
+
+    assert wait_for(all_on_manager, timeout=60)
+
+
+def test_wrong_cert_join_rejected(cluster, tmp_path):
+    """integration_test.go wrong-cert join: an identity minted by a
+    DIFFERENT cluster's CA cannot talk to this cluster — the mTLS handshake
+    (pinned to this cluster's root) refuses it."""
+    cluster.add_manager()
+    leader = cluster.leader()
+
+    # a second, unrelated cluster mints the foreign identity
+    foreign = Cluster(tmp_path / "foreign")
+    try:
+        fm = foreign.add_manager("fm1")
+        from swarmkit_tpu.rpc.client import RPCClient
+
+        with pytest.raises(Exception) as exc_info:
+            c = RPCClient(leader.addr, security=fm.security)
+            try:
+                c.call("health.check")
+            finally:
+                c.close()
+        msg = str(exc_info.value).lower()
+        assert any(s in msg for s in ("ssl", "certificate", "tls",
+                                      "handshake", "connection")), msg
+
+        # and the legitimate identity still works
+        ctl = cluster.control()
+        try:
+            assert ctl.list_services() == []
+        finally:
+            ctl.close()
+    finally:
+        foreign.stop_all()
+
+
+def test_root_rotation_under_live_nodes(cluster):
+    """ca/reconciler.go root rotation with the cluster live: after rotation
+    every node renews onto the new root and the data plane keeps working."""
+    m1 = cluster.add_manager()
+    w1 = cluster.add_agent()
+    leader = cluster.leader()
+
+    def worker_ready():
+        n = leader.store.view(lambda tx: tx.get_node(w1.node_id))
+        return n is not None and n.status.state == NodeStatusState.READY
+
+    assert wait_for(worker_ready, timeout=20)
+    svc = _create_service(cluster, "pre-rotate", 4)
+    assert wait_for(lambda: len(cluster.running(svc.id)) == 4, timeout=30)
+
+    old_root = m1.security.root_ca.cert_pem
+    leader.manager.ca_server.rotate_root_ca()
+
+    # both nodes' TLS identities renew onto the new root
+    def renewed():
+        new_root = leader.manager.ca_server.root.cert_pem
+        return (new_root != old_root
+                and m1.security.root_ca.cert_pem == new_root
+                and w1.security.root_ca.cert_pem == new_root)
+
+    assert wait_for(renewed, timeout=60)
+
+    # the data plane survives rotation: scale the service up over the wire
+    ctl = cluster.control()
+    try:
+        cur = ctl.get_service(svc.id)
+        cur.spec.replicas = 6
+        ctl.update_service(svc.id, cur.meta.version, cur.spec)
+    finally:
+        ctl.close()
+    assert wait_for(lambda: len(cluster.running(svc.id)) == 6, timeout=60)
