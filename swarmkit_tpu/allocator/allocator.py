@@ -4,12 +4,17 @@ Behavioral re-derivation of manager/allocator/: the in-tree reference ships
 an *inert* network provider (networkallocator/inert.go — the real CNM
 allocator lives in moby) plus a real port allocator; likewise here the
 network backend is a pluggable seam defaulting to an inert provider, while
-service endpoints get published ports resolved (dynamic range 30000-32767,
-reference portallocator.go) and every NEW task is moved to PENDING once its
-service's networks/ports exist (doTaskAlloc, network.go:870).
+the address plane is real (allocator/ipam.py): networks get subnets and
+gateways (doNetworkInit), services get per-network virtual IPs
+(network.go allocateVIP), tasks get attachment addresses (doTaskAlloc,
+network.go:870), nodes get ingress attachments (allocateNodes,
+network.go:448), and published ports resolve through the dynamic range
+30000-32767 (portallocator.go). All allocation state is rebuilt
+idempotently from the replicated store on leadership change.
 """
 from __future__ import annotations
 
+import logging
 import threading
 
 from ..api.objects import (
@@ -17,12 +22,16 @@ from ..api.objects import (
     EventDelete,
     EventUpdate,
     Network,
+    Node,
     Service,
     Task,
 )
-from ..api.types import TaskState
+from ..api.types import NodeStatusState, TaskState
 from ..store import by
 from ..orchestrator.base import EventLoopComponent
+from .ipam import IPAM, IPAMError
+
+log = logging.getLogger("swarmkit_tpu.allocator")
 
 DYNAMIC_PORT_START = 30000  # reference portallocator.go dynamic range
 DYNAMIC_PORT_END = 32767
@@ -109,33 +118,128 @@ class Allocator(EventLoopComponent):
         super().__init__(store)
         self.network = network_provider or InertNetworkProvider()
         self.ports = PortAllocator()
+        self.ipam = IPAM()
         # services whose port allocation failed, retried when ports free up
         self._starved: set[str] = set()
+        # tasks whose attachment addresses were already returned — terminal
+        # tasks keep getting status updates, and a double release could free
+        # an address the pool re-assigned in the meantime
+        self._released_tasks: set[str] = set()
+        # services whose VIP allocation hit an exhausted pool; retried when
+        # any address is released (ports have the same mechanism above)
+        self._vip_starved: set[str] = set()
 
     def setup(self, tx):
-        return tx.find_tasks(by.ByTaskState(TaskState.NEW)), tx.find_services()
+        # ONE consistent snapshot: the NEW subset derives from the full task
+        # list instead of a second, later view racing the first
+        return (tx.find_tasks(), tx.find_services(), tx.find_networks(),
+                tx.find_nodes())
 
     def on_start(self, snapshot):
-        tasks, services = snapshot
+        all_tasks, services, networks, nodes = snapshot
+        tasks = [t for t in all_tasks if t.status.state == TaskState.NEW]
+        # ---- idempotent state rebuild (doNetworkInit restore path) -------
+        for n in networks:
+            state = n.driver_state or {}
+            if isinstance(state, dict) and state.get("subnet"):
+                self.ipam.add_network(n.id, state["subnet"])
+        for s in services:
+            if s.endpoint:
+                for net_id, addr in s.endpoint.get("virtual_ips", []):
+                    self.ipam.reserve(net_id, addr)
+        for t in all_tasks:
+            for att in t.networks or []:
+                if isinstance(att, dict):
+                    for addr in att.get("addresses", []):
+                        self.ipam.reserve(att["network_id"], addr)
+        for node in nodes:
+            for att in node.attachments or []:
+                if isinstance(att, dict):
+                    for addr in att.get("addresses", []):
+                        self.ipam.reserve(att["network_id"], addr)
+
+        for n in networks:
+            self._allocate_network(n.id)
         for s in services:
             self._allocate_service(s.id)
+        for node in nodes:
+            self._allocate_node(node.id)
         self._allocate_tasks([t.id for t in tasks])
 
     def handle(self, event):
         obj = getattr(event, "obj", None)
         if isinstance(event, (EventCreate, EventUpdate)):
-            if isinstance(obj, Task) and obj.status.state == TaskState.NEW:
-                self._allocate_tasks([obj.id])
+            if isinstance(obj, Task):
+                if obj.status.state == TaskState.NEW:
+                    self._allocate_tasks([obj.id])
+                elif obj.status.state >= TaskState.COMPLETE:
+                    # dead task: its attachment addresses return to the pool
+                    # (network.go doTaskAlloc handles task death the same way)
+                    self._release_task_attachments(obj)
             elif isinstance(obj, Service):
                 self._allocate_service(obj.id)
             elif isinstance(obj, Network):
                 self._allocate_network(obj.id)
+                self._retry_waiting_tasks()
+            elif isinstance(obj, Node):
+                self._allocate_node(obj.id)
         elif isinstance(event, EventDelete):
             if isinstance(obj, Service):
                 self.ports.release(obj.id)
+                if obj.endpoint:
+                    for net_id, addr in obj.endpoint.get("virtual_ips", []):
+                        self.ipam.release(net_id, addr)
                 self._retry_starved()
             elif isinstance(obj, Network):
                 self.network.deallocate(obj)
+                self.ipam.remove_network(obj.id)
+            elif isinstance(obj, Task):
+                self._release_task_attachments(obj, deleted=True)
+                self._released_tasks.discard(obj.id)
+            elif isinstance(obj, Node):
+                for att in obj.attachments or []:
+                    if isinstance(att, dict):
+                        for addr in att.get("addresses", []):
+                            self.ipam.release(att["network_id"], addr)
+
+    def _release_task_attachments(self, task: Task, deleted: bool = False):
+        """Return a dead task's addresses AND persist the release by
+        clearing task.networks in the store — otherwise a later leader would
+        rebuild its pools with (or re-release) addresses long since
+        recycled. The in-memory guard only dedups same-leader event bursts.
+        """
+        if task.id in self._released_tasks:
+            return
+        self._released_tasks.add(task.id)
+        released = False
+        if not deleted:
+            def clear(tx):
+                nonlocal released
+                cur = tx.get_task(task.id)
+                if cur is None or not cur.networks:
+                    return
+                for att in cur.networks:
+                    if isinstance(att, dict):
+                        for addr in att.get("addresses", []):
+                            self.ipam.release(att["network_id"], addr)
+                released = True
+                cur = cur.copy()
+                cur.networks = []
+                tx.update(cur)
+
+            try:
+                self.store.update(clear)
+            except Exception:
+                self._released_tasks.discard(task.id)  # retried next event
+                return
+        else:
+            for att in task.networks or []:
+                if isinstance(att, dict):
+                    for addr in att.get("addresses", []):
+                        self.ipam.release(att["network_id"], addr)
+                        released = True
+        if released:
+            self._retry_vip_starved()
 
     def _retry_starved(self):
         """A freed port may unblock a service whose allocation failed; its
@@ -144,20 +248,102 @@ class Allocator(EventLoopComponent):
         for service_id in starved:
             self._allocate_service(service_id)
         if starved:
-            view = self.store.view()
-            pending = [t.id for t in view.find_tasks(by.ByTaskState(TaskState.NEW))]
-            if pending:
-                self._allocate_tasks(pending)
+            self._retry_waiting_tasks()
+
+    def _retry_waiting_tasks(self):
+        view = self.store.view()
+        pending = [t.id for t in view.find_tasks(by.ByTaskState(TaskState.NEW))]
+        if pending:
+            self._allocate_tasks(pending)
+
+    def _retry_vip_starved(self):
+        starved, self._vip_starved = self._vip_starved, set()
+        for service_id in starved:
+            self._allocate_service(service_id)
+
+    # -------------------------------------------------------- net resolution
+    def _resolve_network(self, tx, target: str):
+        """A NetworkAttachmentConfig.target is an id or a name."""
+        n = tx.get_network(target)
+        if n is not None:
+            return n
+        for n in tx.find_networks():
+            if n.spec.annotations.name == target:
+                return n
+        return None
+
+    def _service_networks(self, tx, service) -> list | None:
+        """The networks a service's tasks attach to: explicit refs plus the
+        ingress network when it publishes ingress-mode ports
+        (network.go:448-1132). None == a referenced network is missing or
+        not yet allocated (callers defer)."""
+        nets = []
+        for ref in service.spec.task.networks:
+            n = self._resolve_network(tx, ref.target)
+            if n is None or not self.ipam.has_network(n.id):
+                return None
+            nets.append(n)
+        ports = service.spec.endpoint.ports
+        if any(p.publish_mode == "ingress" for p in ports):
+            for n in tx.find_networks():
+                if n.spec.ingress:
+                    if not self.ipam.has_network(n.id):
+                        return None
+                    if n.id not in [x.id for x in nets]:
+                        nets.append(n)
+                    break
+        return nets
 
     # ------------------------------------------------------------- allocation
     def _allocate_network(self, network_id: str):
         def cb(tx):
             n = tx.get_network(network_id)
-            if n is None or n.driver_state is not None:
+            if n is None:
+                return
+            state = n.driver_state if isinstance(n.driver_state, dict) else None
+            if state is not None and state.get("subnet"):
+                self.ipam.add_network(n.id, state["subnet"])  # idempotent
                 return
             n = n.copy()
-            n.driver_state = self.network.allocate_network(n) or {"inert": True}
+            wanted = (n.spec.ipam or {}).get("subnet") if n.spec.ipam else None
+            try:
+                subnet, gateway = self.ipam.add_network(n.id, wanted)
+            except (IPAMError, ValueError) as exc:
+                log.warning("network %s: subnet allocation failed: %s",
+                            network_id, exc)
+                return
+            state = self.network.allocate_network(n) or {}
+            state.update({"subnet": subnet, "gateway": gateway})
+            n.driver_state = state
             tx.update(n)
+
+        self.store.update(cb)
+
+    def _allocate_node(self, node_id: str):
+        """Ingress attachment for READY nodes (network.go allocateNodes —
+        every node carrying ingress-published tasks needs an address on the
+        ingress network)."""
+        def cb(tx):
+            node = tx.get_node(node_id)
+            if node is None or node.status.state != NodeStatusState.READY:
+                return
+            ingress = next(
+                (n for n in tx.find_networks() if n.spec.ingress), None)
+            if ingress is None or not self.ipam.has_network(ingress.id):
+                return
+            existing = [a for a in (node.attachments or [])
+                        if isinstance(a, dict)
+                        and a.get("network_id") == ingress.id]
+            if existing:
+                return
+            try:
+                addr = self.ipam.allocate(ingress.id)
+            except IPAMError:
+                return
+            node = node.copy()
+            node.attachments = list(node.attachments or []) + [
+                {"network_id": ingress.id, "addresses": [addr]}]
+            tx.update(node)
 
         self.store.update(cb)
 
@@ -170,20 +356,53 @@ class Allocator(EventLoopComponent):
             if s is None:
                 return
             ports = s.spec.endpoint.ports
+            nets = self._service_networks(tx, s)
+            endpoint = dict(s.endpoint or {})
+            have_vips = {net_id: addr
+                         for net_id, addr in endpoint.get("virtual_ips", [])}
+            dirty = False
+
+            # ---- virtual IPs: one per attached network (allocateVIP) -----
+            # nets is None == a referenced network isn't allocated yet:
+            # DEFER — releasing existing VIPs on that sentinel would hand
+            # live addresses back to the pool mid-flight
+            if nets is not None:
+                want_vips = [n.id for n in nets]
+                if s.spec.endpoint.mode == "vip" and not s.pending_delete:
+                    for net_id in want_vips:
+                        if net_id not in have_vips:
+                            try:
+                                have_vips[net_id] = self.ipam.allocate(net_id)
+                                dirty = True
+                            except IPAMError:
+                                self._vip_starved.add(s.id)
+                for net_id in [k for k in have_vips if k not in want_vips]:
+                    self.ipam.release(net_id, have_vips.pop(net_id))
+                    dirty = True
+
             if not ports:
-                # spec dropped all ports: free whatever was held and clear
-                # the endpoint so a later re-add re-claims from scratch
+                # spec dropped all ports: free whatever was held and drop
+                # the port fields so a later re-add re-claims from scratch
                 freed = self.ports.release_except(service_id, set())
-                if s.endpoint is not None and s.endpoint.get("ports_allocated"):
+                if endpoint.get("ports_allocated") or dirty:
                     s = s.copy()
-                    s.endpoint = None
+                    endpoint.pop("ports_allocated", None)
+                    endpoint.pop("port_set", None)
+                    endpoint.pop("ports", None)
+                    endpoint["virtual_ips"] = sorted(have_vips.items())
+                    s.endpoint = endpoint or None
                     tx.update(s)
                 return
-            if s.endpoint is not None and s.endpoint.get("ports_allocated"):
+            if endpoint.get("ports_allocated"):
                 # re-allocate only when the spec's port set changed
                 current = {(p.protocol, p.target_port, p.published_port,
                             p.publish_mode) for p in ports}
-                if s.endpoint.get("port_set") == sorted(current):
+                if endpoint.get("port_set") == sorted(current):
+                    if dirty:
+                        s = s.copy()
+                        endpoint["virtual_ips"] = sorted(have_vips.items())
+                        s.endpoint = endpoint
+                        tx.update(s)
                     return
             s = s.copy()
             # free ports the new spec no longer publishes before claiming
@@ -194,7 +413,7 @@ class Allocator(EventLoopComponent):
             if not ok:
                 self._starved.add(s.id)
                 return  # retried when a conflicting service releases ports
-            s.endpoint = {
+            endpoint.update({
                 "ports_allocated": True,
                 "port_set": sorted({(p.protocol, p.target_port,
                                      p.published_port, p.publish_mode)
@@ -203,7 +422,9 @@ class Allocator(EventLoopComponent):
                     (p.protocol, p.target_port, p.published_port, p.publish_mode)
                     for p in s.spec.endpoint.ports
                 ],
-            }
+                "virtual_ips": sorted(have_vips.items()),
+            })
+            s.endpoint = endpoint
             tx.update(s)
 
         self.store.update(cb)
@@ -222,9 +443,28 @@ class Allocator(EventLoopComponent):
                             service.endpoint is None
                             or not service.endpoint.get("ports_allocated")):
                         return  # wait for service allocation first
+                    # attachment addresses: explicit refs + ingress
+                    attachments = []
+                    if service is not None:
+                        nets = self._service_networks(tx, service)
+                        if nets is None:
+                            return  # a referenced network isn't ready yet
+                        for n in nets:
+                            try:
+                                attachments.append({
+                                    "network_id": n.id,
+                                    "addresses": [self.ipam.allocate(n.id)],
+                                })
+                            except IPAMError:
+                                for a in attachments:
+                                    self.ipam.release(a["network_id"],
+                                                      a["addresses"][0])
+                                return  # pool exhausted: stays NEW
                     t = t.copy()
-                    t.networks = self.network.allocate_task(t)
-                    if service is not None and service.endpoint:
+                    t.networks = (self.network.allocate_task(t) or []) \
+                        + attachments
+                    if service is not None and service.endpoint \
+                            and service.endpoint.get("ports"):
                         from ..api.specs import EndpointSpec, PortConfig
                         t.endpoint = EndpointSpec(ports=[
                             PortConfig(protocol=proto, target_port=tp,

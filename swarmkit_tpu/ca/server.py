@@ -232,10 +232,16 @@ class CAServer:
             ]
         )
         rot0 = self._rotation()
+        # during a phased rotation the signer is the NEW root with the
+        # cross-signed intermediate appended (ca/reconciler.go); one
+        # snapshot per pass — per-node store views + key parses would
+        # repeat identical work N times
+        pass_signing_root = (
+            RootCA(rot0["new_ca_cert_pem"], rot0["new_ca_key_pem"],
+                   intermediate_pem=rot0["cross_signed_pem"])
+            if rot0 else self.root)
         for node in pending:
-            # during a phased rotation the signer is the NEW root with the
-            # cross-signed intermediate appended (ca/reconciler.go)
-            signing_root = self._signing_root()
+            signing_root = pass_signing_root
             observed_state = node.certificate.status_state
             signed_csr = node.certificate.csr_pem
             try:
@@ -393,18 +399,33 @@ class CAServer:
             lambda tx: tx.get_cluster(self.cluster_id))
         epoch = cluster.root_ca.last_forced_rotation
         nodes = self.store.view(lambda tx: tx.find_nodes(by.All()))
+        waiting: list[str] = []
         for n in nodes:
             cert = n.certificate
             if cert is None or not cert.csr_pem:
                 continue
-            if cert.status_state != IssuanceState.ISSUED:
-                return
-            if getattr(cert, "rotation_epoch", 0) != epoch:
-                return
+            if cert.status_state != IssuanceState.ISSUED \
+                    or getattr(cert, "rotation_epoch", 0) != epoch:
+                waiting.append(n.id)
+                continue
             try:
                 new_root.verify_cert(cert.certificate_pem)
             except Exception:
-                return
+                waiting.append(n.id)
+        if waiting:
+            # like the reference (and docker swarm ca --rotate), rotation
+            # waits for EVERY node — down nodes must be removed by the
+            # operator; surface who is holding it up instead of stalling
+            # silently
+            now = time.monotonic()
+            if now - getattr(self, "_last_rotation_log", 0) > 30:
+                self._last_rotation_log = now
+                import logging
+
+                logging.getLogger("swarmkit_tpu.ca").warning(
+                    "root rotation waiting on %d node(s): %s",
+                    len(waiting), ", ".join(sorted(waiting)[:5]))
+            return
 
         full_new_root = RootCA(rot["new_ca_cert_pem"],
                                rot["new_ca_key_pem"] or None)

@@ -762,10 +762,15 @@ class SwarmNode:
 
     def _kick_renew(self):
         """Single-flight background certificate renewal (used when the trust
-        root changes and by the rotation straggler check)."""
-        if self.renewer is None or self._root_renew_active:
+        root changes and by the rotation straggler check). The check-then-set
+        is under the role-flip lock: two concurrent renew threads would race
+        their CSRs and could pair one thread's key with the other's cert."""
+        if self.renewer is None:
             return
-        self._root_renew_active = True
+        with self._role_flip_lock:
+            if self._root_renew_active:
+                return
+            self._root_renew_active = True
 
         def renew():
             try:

@@ -1,0 +1,221 @@
+"""Network allocation depth: subnets/gateways, service VIPs, task
+attachment addresses, node ingress attachments, release on death, and
+idempotent rebuild across allocator restarts (reference
+manager/allocator/network.go:448-1132)."""
+import ipaddress
+import time
+
+import pytest
+
+from swarmkit_tpu.allocator.allocator import Allocator
+from swarmkit_tpu.allocator.ipam import IPAM, IPAMError
+from swarmkit_tpu.api.objects import Network, Node, Service, Task
+from swarmkit_tpu.api.specs import (
+    Annotations,
+    NetworkAttachmentConfig,
+    NetworkSpec,
+    PortConfig,
+    ServiceSpec,
+)
+from swarmkit_tpu.api.types import NodeStatusState, TaskState
+from swarmkit_tpu.store import by
+from swarmkit_tpu.store.memory import MemoryStore
+
+from test_scheduler import wait_for  # noqa: E402
+
+
+@pytest.fixture
+def store():
+    return MemoryStore()
+
+
+def _mk_network(store, net_id="net1", name="backend", ingress=False,
+                subnet=None):
+    n = Network(id=net_id, spec=NetworkSpec(
+        annotations=Annotations(name=name), ingress=ingress,
+        ipam={"subnet": subnet} if subnet else None))
+    store.update(lambda tx: tx.create(n))
+    return n
+
+
+def _mk_service(store, svc_id="svc1", networks=(), ports=()):
+    s = Service(id=svc_id, spec=ServiceSpec(
+        annotations=Annotations(name=svc_id), replicas=1))
+    s.spec.task.networks = [NetworkAttachmentConfig(target=t)
+                            for t in networks]
+    s.spec.endpoint.ports = list(ports)
+    store.update(lambda tx: tx.create(s))
+    return s
+
+
+def _mk_task(store, tid, svc_id):
+    t = Task(id=tid, service_id=svc_id)
+    t.status.state = TaskState.NEW
+    t.desired_state = TaskState.RUNNING
+    store.update(lambda tx: tx.create(t))
+    return t
+
+
+def test_ipam_pools_and_exhaustion():
+    ipam = IPAM()
+    subnet, gw = ipam.add_network("n1", "192.168.5.0/30")  # 2 hosts: gw + 1
+    assert gw == "192.168.5.1"
+    a = ipam.allocate("n1")
+    assert a == "192.168.5.2"
+    with pytest.raises(IPAMError):
+        ipam.allocate("n1")
+    ipam.release("n1", a)
+    assert ipam.allocate("n1") == a
+
+    # auto-assigned subnets never overlap
+    s2, _ = ipam.add_network("n2")
+    s3, _ = ipam.add_network("n3")
+    assert not ipaddress.ip_network(s2).overlaps(ipaddress.ip_network(s3))
+
+
+def test_network_gets_subnet_and_gateway(store):
+    _mk_network(store, subnet="172.20.0.0/24")
+    a = Allocator(store)
+    a.start()
+    try:
+        def allocated():
+            n = store.view(lambda tx: tx.get_network("net1"))
+            return (n.driver_state or {}).get("subnet") == "172.20.0.0/24" \
+                and (n.driver_state or {}).get("gateway") == "172.20.0.1"
+        assert wait_for(allocated, timeout=5)
+    finally:
+        a.stop()
+
+
+def test_service_vip_and_task_attachments(store):
+    _mk_network(store)
+    _mk_service(store, networks=["backend"])
+    _mk_task(store, "t1", "svc1")
+    _mk_task(store, "t2", "svc1")
+    a = Allocator(store)
+    a.start()
+    try:
+        def done():
+            s = store.view(lambda tx: tx.get_service("svc1"))
+            ts = store.view(lambda tx: tx.find_tasks(by.ByServiceID("svc1")))
+            return (s.endpoint and s.endpoint.get("virtual_ips")
+                    and all(t.status.state == TaskState.PENDING
+                            and t.networks for t in ts))
+        assert wait_for(done, timeout=5)
+        s = store.view(lambda tx: tx.get_service("svc1"))
+        [(net_id, vip)] = s.endpoint["virtual_ips"]
+        assert net_id == "net1"
+        ts = store.view(lambda tx: tx.find_tasks(by.ByServiceID("svc1")))
+        addrs = [t.networks[-1]["addresses"][0] for t in ts]
+        subnet = ipaddress.ip_network(
+            store.view(lambda tx: tx.get_network("net1"))
+            .driver_state["subnet"])
+        # distinct addresses, all within the subnet, none equal to the VIP
+        assert len(set(addrs + [vip])) == 3
+        for addr in addrs + [vip]:
+            assert ipaddress.ip_address(addr) in subnet
+    finally:
+        a.stop()
+
+
+def test_task_waits_for_network_then_allocates(store):
+    _mk_service(store, networks=["backend"])
+    _mk_task(store, "t1", "svc1")
+    a = Allocator(store)
+    a.start()
+    try:
+        time.sleep(0.5)
+        t = store.view(lambda tx: tx.get_task("t1"))
+        assert t.status.state == TaskState.NEW  # referenced net missing
+        _mk_network(store)
+        assert wait_for(
+            lambda: store.view(lambda tx: tx.get_task("t1")).status.state
+            == TaskState.PENDING, timeout=5)
+    finally:
+        a.stop()
+
+
+def test_dead_task_returns_addresses(store):
+    _mk_network(store, subnet="192.168.9.0/29")  # gw + 5 usable
+    _mk_service(store, networks=["backend"])
+    _mk_task(store, "t1", "svc1")
+    a = Allocator(store)
+    a.start()
+    try:
+        assert wait_for(
+            lambda: store.view(lambda tx: tx.get_task("t1")).status.state
+            == TaskState.PENDING, timeout=5)
+        t = store.view(lambda tx: tx.get_task("t1"))
+        addr = t.networks[-1]["addresses"][0]
+
+        def kill(tx):
+            cur = tx.get_task("t1").copy()
+            cur.status.state = TaskState.FAILED
+            tx.update(cur)
+        store.update(kill)
+        assert wait_for(lambda: addr not in
+                        a.ipam._pools["net1"].allocated, timeout=5)
+    finally:
+        a.stop()
+
+
+def test_ingress_attachment_for_ready_nodes_and_ingress_vip(store):
+    _mk_network(store, net_id="ingress", name="ingress", ingress=True)
+    n = Node(id="node1")
+    n.status.state = NodeStatusState.READY
+    store.update(lambda tx: tx.create(n))
+    _mk_service(store, ports=[PortConfig(protocol="tcp", target_port=80,
+                                         publish_mode="ingress")])
+    a = Allocator(store)
+    a.start()
+    try:
+        def node_attached():
+            node = store.view(lambda tx: tx.get_node("node1"))
+            return any(att.get("network_id") == "ingress"
+                       for att in node.attachments or []
+                       if isinstance(att, dict))
+        assert wait_for(node_attached, timeout=5)
+
+        def svc_has_ingress_vip():
+            s = store.view(lambda tx: tx.get_service("svc1"))
+            return s.endpoint and any(
+                net_id == "ingress"
+                for net_id, _ in s.endpoint.get("virtual_ips", []))
+        assert wait_for(svc_has_ingress_vip, timeout=5)
+    finally:
+        a.stop()
+
+
+def test_restart_rebuilds_without_double_assignment(store):
+    _mk_network(store)
+    _mk_service(store, networks=["backend"])
+    _mk_task(store, "t1", "svc1")
+    a = Allocator(store)
+    a.start()
+    try:
+        assert wait_for(
+            lambda: store.view(lambda tx: tx.get_task("t1")).status.state
+            == TaskState.PENDING, timeout=5)
+    finally:
+        a.stop()
+    s = store.view(lambda tx: tx.get_service("svc1"))
+    vip = dict(s.endpoint["virtual_ips"])["net1"]
+    taken = store.view(
+        lambda tx: tx.get_task("t1")).networks[-1]["addresses"][0]
+
+    # a fresh allocator (leadership change) must not hand out vip/taken again
+    b = Allocator(store)
+    b.start()
+    try:
+        _mk_task(store, "t2", "svc1")
+        assert wait_for(
+            lambda: store.view(lambda tx: tx.get_task("t2")).status.state
+            == TaskState.PENDING, timeout=5)
+        addr2 = store.view(
+            lambda tx: tx.get_task("t2")).networks[-1]["addresses"][0]
+        assert addr2 not in (vip, taken)
+        # service keeps its original VIP
+        s2 = store.view(lambda tx: tx.get_service("svc1"))
+        assert dict(s2.endpoint["virtual_ips"])["net1"] == vip
+    finally:
+        b.stop()
