@@ -26,10 +26,15 @@ BACKOFF_MAX = 8.0
 class Agent:
     def __init__(self, node_id: str, dispatcher, executor,
                  state_path: str | None = None, log_broker=None,
-                 csi_plugins=None):
+                 csi_plugins=None, generic_resources=None):
         self.node_id = node_id
         self.dispatcher = dispatcher
         self.executor = executor
+        # operator-declared generic resources (swarmd
+        # --generic-node-resources, e.g. gpu=4 or gpu=id1;id2) merged into
+        # the advertised NodeDescription (reference swarmd main.go:38-266);
+        # either a {kind: count} dict or an api Resources (parse_cmd output)
+        self.generic_resources = generic_resources
         self.log_broker = log_broker
         self.volume_manager = None
         if csi_plugins is not None:
@@ -169,6 +174,18 @@ class Agent:
 
     def _session(self):
         description = self.executor.describe()
+        gr = self.generic_resources
+        if gr and description is not None \
+                and description.resources is not None:
+            if isinstance(gr, dict):
+                for kind, qty in gr.items():
+                    description.resources.generic[kind] = qty
+            else:  # api Resources from genericresource.parse_cmd
+                for kind, qty in gr.generic.items():
+                    description.resources.generic[kind] = qty
+                for kind, ids in gr.named_generic.items():
+                    description.resources.named_generic.setdefault(
+                        kind, set()).update(ids)
         if self.volume_manager is not None:
             # advertise CSI driver support so the scheduler places cluster
             # volumes here (reference: agent fills NodeDescription.CSIInfo

@@ -180,6 +180,9 @@ class Allocator(EventLoopComponent):
                 self._allocate_service(obj.id)
             elif isinstance(obj, Network):
                 self._allocate_network(obj.id)
+                # services created BEFORE their referenced network deferred
+                # their VIPs; a fresh network unblocks them (and their tasks)
+                self._retry_all_services()
                 self._retry_waiting_tasks()
             elif isinstance(obj, Node):
                 self._allocate_node(obj.id)
@@ -189,7 +192,7 @@ class Allocator(EventLoopComponent):
                 if obj.endpoint:
                     for net_id, addr in obj.endpoint.get("virtual_ips", []):
                         self.ipam.release(net_id, addr)
-                self._retry_starved()
+                self._retry_after_free()
             elif isinstance(obj, Network):
                 self.network.deallocate(obj)
                 self.ipam.remove_network(obj.id)
@@ -197,10 +200,14 @@ class Allocator(EventLoopComponent):
                 self._release_task_attachments(obj, deleted=True)
                 self._released_tasks.discard(obj.id)
             elif isinstance(obj, Node):
+                freed = False
                 for att in obj.attachments or []:
                     if isinstance(att, dict):
                         for addr in att.get("addresses", []):
                             self.ipam.release(att["network_id"], addr)
+                            freed = True
+                if freed:
+                    self._retry_after_free()
 
     def _release_task_attachments(self, task: Task, deleted: bool = False):
         """Return a dead task's addresses AND persist the release by
@@ -239,7 +246,7 @@ class Allocator(EventLoopComponent):
                         self.ipam.release(att["network_id"], addr)
                         released = True
         if released:
-            self._retry_vip_starved()
+            self._retry_after_free()
 
     def _retry_starved(self):
         """A freed port may unblock a service whose allocation failed; its
@@ -260,6 +267,23 @@ class Allocator(EventLoopComponent):
         starved, self._vip_starved = self._vip_starved, set()
         for service_id in starved:
             self._allocate_service(service_id)
+
+    def _retry_after_free(self):
+        """Any released address/port may unblock anything that failed to
+        allocate: port-starved services, VIP-starved services, and NEW
+        tasks stuck on an exhausted pool."""
+        self._retry_starved()
+        self._retry_vip_starved()
+        self._retry_waiting_tasks()
+
+    def _retry_all_services(self):
+        """A new network may complete services whose VIP allocation was
+        DEFERRED (created before the network); deferral has no starvation
+        marker, so sweep every service — _allocate_service is idempotent
+        and cheap when nothing is missing."""
+        view = self.store.view()
+        for s in view.find_services():
+            self._allocate_service(s.id)
 
     # -------------------------------------------------------- net resolution
     def _resolve_network(self, tx, target: str):
@@ -367,8 +391,8 @@ class Allocator(EventLoopComponent):
             # DEFER — releasing existing VIPs on that sentinel would hand
             # live addresses back to the pool mid-flight
             if nets is not None:
-                want_vips = [n.id for n in nets]
                 if s.spec.endpoint.mode == "vip" and not s.pending_delete:
+                    want_vips = [n.id for n in nets]
                     for net_id in want_vips:
                         if net_id not in have_vips:
                             try:
@@ -376,6 +400,10 @@ class Allocator(EventLoopComponent):
                                 dirty = True
                             except IPAMError:
                                 self._vip_starved.add(s.id)
+                else:
+                    # dnsrr (or teardown): no VIPs are wanted — release any
+                    # held ones, the reference deallocates on mode flips
+                    want_vips = []
                 for net_id in [k for k in have_vips if k not in want_vips]:
                     self.ipam.release(net_id, have_vips.pop(net_id))
                     dirty = True

@@ -28,9 +28,11 @@ class _Pool:
 
     def allocate(self) -> str:
         size = self.subnet.num_addresses
-        start = self._cursor
-        offset = start
-        while True:
+        offset = self._cursor
+        # bounded probe: at most one full sweep of the host range — a
+        # wrap-relative termination check can spin forever when the cursor
+        # sits at the wrap target on an exhausted pool
+        for _ in range(size):
             if offset >= size - 1:      # skip broadcast
                 offset = 2
             addr = str(self.subnet.network_address + offset)
@@ -39,8 +41,7 @@ class _Pool:
                 self._cursor = offset + 1
                 return addr
             offset += 1
-            if offset == start:
-                raise IPAMError(f"subnet {self.subnet} exhausted")
+        raise IPAMError(f"subnet {self.subnet} exhausted")
 
     def reserve(self, addr: str) -> None:
         if ipaddress.ip_address(addr) not in self.subnet:

@@ -33,11 +33,15 @@ class CAServer:
     """Signs CSRs recorded on Node objects (reference ca/server.go Server)."""
 
     def __init__(self, store, root: RootCA, cluster_id: str,
-                 org: str = "swarmkit-tpu", external_ca=None):
+                 org: str = "swarmkit-tpu", external_ca=None,
+                 cert_expiry: float | None = None):
         self.store = store
         self.root = root
         self.cluster_id = cluster_id
         self.org = org
+        # node certificate lifetime (swarmd --cert-expiry; reference
+        # CAConfig.NodeCertExpiry); None == the compiled default
+        self.cert_expiry = cert_expiry
         # optional ca.external.ExternalCA: signing delegates to the
         # operator's CA service; the local root stays the trust anchor
         # (ca/external.go — the external CA signs under the same root)
@@ -267,9 +271,13 @@ class CAServer:
                             f"{ident.node_id!r} role {ident.role}, expected "
                             f"{node.id!r} role {node.certificate.role}")
                 else:
+                    kwargs = {}
+                    if self.cert_expiry:
+                        kwargs["expiry"] = self.cert_expiry
                     cert_pem = signing_root.sign_csr(
                         signed_csr,
                         subject=(node.id, node.certificate.role, self.org),
+                        **kwargs,
                     )
                 state, err = IssuanceState.ISSUED, ""
             except Exception as exc:

@@ -35,6 +35,11 @@ def _load_identity(state_dir: str):
 def _control(args):
     from ..rpc.services import RemoteControl
 
+    if getattr(args, "socket", None):
+        # local unix control socket: no TLS identity needed (xnet)
+        return RemoteControl(f"unix://{args.socket}", None)
+    if not args.addr:
+        _die("need --addr (or --socket for a local manager)")
     return RemoteControl(args.addr, _load_identity(args.identity))
 
 
@@ -387,7 +392,11 @@ def cmd_logs(args):
 
     ctl = _control(args)
     svc = _find_service(ctl, args.service)
-    client = RPCClient(args.addr, security=_load_identity(args.identity))
+    if getattr(args, "socket", None):
+        client = RPCClient(f"unix://{args.socket}")
+    else:
+        client = RPCClient(args.addr,
+                           security=_load_identity(args.identity))
     ch = client.stream("logs.subscribe",
                        LogSelector(service_ids=[svc.id]), follow=args.follow)
     try:
@@ -418,6 +427,9 @@ def main(argv=None) -> int:
     ap.add_argument("--identity",
                     default=os.environ.get("SWARMCTL_IDENTITY"),
                     help="node state dir holding cert.pem/key.json/ca.pem")
+    ap.add_argument("--socket", default=os.environ.get("SWARMCTL_SOCKET"),
+                    help="local manager control socket "
+                         "(<state-dir>/swarmd.sock); no TLS identity needed")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
     # service
@@ -518,10 +530,11 @@ def main(argv=None) -> int:
     p.set_defaults(func=cmd_logs)
 
     args = ap.parse_args(argv)
-    if not args.addr:
-        _die("--addr (or SWARMCTL_ADDR) is required")
-    if not args.identity:
-        _die("--identity (or SWARMCTL_IDENTITY) is required")
+    if not args.socket:
+        if not args.addr:
+            _die("--addr (or SWARMCTL_ADDR), or --socket, is required")
+        if not args.identity:
+            _die("--identity (or SWARMCTL_IDENTITY) is required")
     args.func(args)
     return 0
 

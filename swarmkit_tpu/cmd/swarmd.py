@@ -45,6 +45,26 @@ def main(argv=None) -> int:
     ap.add_argument("--force-new-cluster", action="store_true",
                     help="disaster recovery: restart as a single-member "
                          "quorum keeping replicated state")
+    ap.add_argument("--listen-metrics", default=None, metavar="ADDR",
+                    help="serve /metrics /healthz /debug/* on host:port")
+    ap.add_argument("--listen-debug", default=None, metavar="ADDR",
+                    help="alias for --listen-metrics (reference has both)")
+    ap.add_argument("--no-control-socket", action="store_true",
+                    help="do not serve the local unix control socket "
+                         "(<state-dir>/swarmd.sock)")
+    ap.add_argument("--cert-expiry", type=float, default=None,
+                    metavar="SECONDS", help="node certificate lifetime")
+    ap.add_argument("--external-ca", default=None, metavar="URL",
+                    help="cfssl-compatible signing endpoint "
+                         "(protocol=cfssl,url=… also accepted)")
+    ap.add_argument("--autolock", action="store_true",
+                    help="seal the raft DEK under an operator-held key; "
+                         "printed once as SWARM_UNLOCK_KEY")
+    ap.add_argument("--unlock-key", default=None,
+                    help="key to unseal an autolocked state dir")
+    ap.add_argument("--generic-node-resources", default=None,
+                    metavar="SPEC", help="comma list like gpu=4,fpga=1 "
+                    "advertised as generic resources")
     ap.add_argument("--log-level", default="info",
                     choices=["debug", "info", "warning", "error"])
     args = ap.parse_args(argv)
@@ -66,6 +86,27 @@ def main(argv=None) -> int:
 
     from ..node.daemon import SwarmNode
 
+    generic = None
+    if args.generic_node_resources:
+        from ..api.genericresource import GenericResourceError, parse_cmd
+
+        try:
+            generic = parse_cmd(args.generic_node_resources)
+        except GenericResourceError as exc:
+            ap.error(str(exc))
+
+    external_ca = None
+    if args.external_ca:
+        from ..ca.external import ExternalCA
+
+        # reference cli/external_ca.go accepts protocol=cfssl,url=…
+        url = args.external_ca
+        for field in url.split(","):
+            k, _, v = field.partition("=")
+            if k.strip() == "url":
+                url = v.strip()
+        external_ca = ExternalCA(url)
+
     node = SwarmNode(
         state_dir=args.state_dir,
         executor=executor,
@@ -76,8 +117,25 @@ def main(argv=None) -> int:
         heartbeat_period=args.heartbeat_period,
         tick_interval=args.tick_interval,
         force_new_cluster=args.force_new_cluster,
+        control_socket=not args.no_control_socket,
+        cert_expiry=args.cert_expiry,
+        external_ca=external_ca,
+        generic_resources=generic,
+        autolock=args.autolock,
+        kek=args.unlock_key.encode() if args.unlock_key else None,
     )
     node.start()
+
+    debug_server = None
+    debug_addr = args.listen_metrics or args.listen_debug
+    if debug_addr:
+        from ..node.debugserver import DebugServer
+
+        debug_server = DebugServer(debug_addr, node)
+        debug_server.start()
+        print(f"SWARM_METRICS_ADDR={debug_server.addr}", flush=True)
+    if args.autolock and node.kek:
+        print(f"SWARM_UNLOCK_KEY={node.kek.decode()}", flush=True)
 
     log = logging.getLogger("swarmd")
     log.info("node %s up (role=%s, addr=%s)", node.node_id,
@@ -114,6 +172,8 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, on_signal)
     signal.signal(signal.SIGTERM, on_signal)
     stop.wait()
+    if debug_server is not None:
+        debug_server.stop()
     node.stop()
     return 0
 

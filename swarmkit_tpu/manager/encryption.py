@@ -1,0 +1,156 @@
+"""At-rest encryption algorithms for replicated state.
+
+Re-derivation of manager/encryption/ (encryption.go:29-77, nacl.go,
+fernet.go): two independent AEAD backends behind one record framing, a
+MultiDecrypter that accepts records written by either, and FIPS selection.
+
+  * `FernetEncrypter` — AES128-CBC + HMAC-SHA256 (the FIPS-approved
+    primitive set; the reference's fernet.go fills the same role);
+  * `ChaChaEncrypter` — ChaCha20-Poly1305, the stand-in for the
+    reference's NaCl secretbox (XSalsa20-Poly1305; `cryptography` ships
+    the IETF ChaCha variant, same construction family);
+  * `MultiDecrypter` — tries every configured decrypter, so DEK rotation
+    and algorithm migration never strand old records
+    (encryption.go MultiDecrypter);
+  * `defaults(key, fips=…)` — the reference defaults to NaCl and forces
+    fernet under FIPS (encryption.go Defaults); FIPS mode comes from the
+    explicit argument or the SWARMKIT_FIPS environment variable.
+
+Records are framed `skt1:<algo>:<payload>` (the analogue of the
+reference's MaybeEncryptedRecord envelope carrying the algorithm enum);
+bare fernet tokens from older state files still decrypt (legacy path).
+"""
+from __future__ import annotations
+
+import base64
+import os
+
+from cryptography.exceptions import InvalidTag
+from cryptography.fernet import Fernet, InvalidToken
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+_MAGIC = b"skt1"
+
+
+class DecryptError(Exception):
+    pass
+
+
+def generate_key() -> bytes:
+    """A DEK usable by every backend (32 random bytes, urlsafe-b64 — the
+    fernet key format; ChaCha uses the decoded raw bytes)."""
+    return Fernet.generate_key()
+
+
+def _raw32(key: bytes) -> bytes:
+    try:
+        raw = base64.urlsafe_b64decode(key)
+    except Exception:
+        raw = key
+    if len(raw) != 32:
+        raise ValueError("DEK must be 32 bytes (urlsafe-b64 encoded)")
+    return raw
+
+
+class FernetEncrypter:
+    ALGO = b"fernet"
+
+    def __init__(self, key: bytes):
+        self._f = Fernet(key)
+
+    def encrypt(self, raw: bytes) -> bytes:
+        return self._f.encrypt(raw)
+
+    def decrypt(self, payload: bytes) -> bytes:
+        try:
+            return self._f.decrypt(payload)
+        except InvalidToken as exc:
+            raise DecryptError(str(exc)) from exc
+
+
+class ChaChaEncrypter:
+    ALGO = b"chacha20poly1305"
+    _NONCE = 12
+
+    def __init__(self, key: bytes):
+        self._aead = ChaCha20Poly1305(_raw32(key))
+
+    def encrypt(self, raw: bytes) -> bytes:
+        nonce = os.urandom(self._NONCE)
+        return nonce + self._aead.encrypt(nonce, raw, None)
+
+    def decrypt(self, payload: bytes) -> bytes:
+        if len(payload) < self._NONCE:
+            raise DecryptError("short record")
+        try:
+            return self._aead.decrypt(payload[:self._NONCE],
+                                      payload[self._NONCE:], None)
+        except InvalidTag as exc:
+            raise DecryptError(str(exc)) from exc
+
+
+ALGOS = {cls.ALGO: cls for cls in (FernetEncrypter, ChaChaEncrypter)}
+
+
+def seal(encrypter, raw: bytes) -> bytes:
+    # payload is base64: consumers (the raft WAL) frame records by newline,
+    # and AEAD ciphertexts are raw bytes
+    return (_MAGIC + b":" + encrypter.ALGO + b":"
+            + base64.urlsafe_b64encode(encrypter.encrypt(raw)))
+
+
+class MultiDecrypter:
+    """Accepts records from any configured (algo, key) pair
+    (encryption.go MultiDecrypter)."""
+
+    def __init__(self, keys: list[bytes]):
+        self._by_algo: dict[bytes, list] = {}
+        for key in keys:
+            self.add_key(key)
+
+    def add_key(self, key: bytes, first: bool = False):
+        for algo, cls in ALGOS.items():
+            lst = self._by_algo.setdefault(algo, [])
+            try:
+                dec = cls(key)
+            except ValueError:
+                continue
+            if first:
+                lst.insert(0, dec)
+            else:
+                lst.append(dec)
+
+    def unseal(self, blob: bytes) -> bytes:
+        if blob.startswith(_MAGIC + b":"):
+            _, algo, b64 = blob.split(b":", 2)
+            try:
+                payload = base64.urlsafe_b64decode(b64)
+            except Exception as exc:
+                raise DecryptError(f"bad record encoding: {exc}") from exc
+            for dec in self._by_algo.get(algo, []):
+                try:
+                    return dec.decrypt(payload)
+                except DecryptError:
+                    continue
+            raise DecryptError("no key decrypts this record")
+        # legacy framing: a bare fernet token
+        for dec in self._by_algo.get(FernetEncrypter.ALGO, []):
+            try:
+                return dec.decrypt(blob)
+            except DecryptError:
+                continue
+        raise DecryptError("no key decrypts this record")
+
+
+def fips_enabled(flag: bool | None = None) -> bool:
+    if flag is not None:
+        return flag
+    return os.environ.get("SWARMKIT_FIPS", "") not in ("", "0", "false")
+
+
+def defaults(key: bytes, fips: bool | None = None):
+    """(encrypter, MultiDecrypter) for one key: ChaCha by default, fernet
+    under FIPS (AES-based primitives only) — encryption.go Defaults."""
+    if fips_enabled(fips):
+        return FernetEncrypter(key), MultiDecrypter([key])
+    return ChaChaEncrypter(key), MultiDecrypter([key])

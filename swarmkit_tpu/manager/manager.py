@@ -58,6 +58,9 @@ class Manager:
         key_rotation_interval: float = 12 * 3600.0,
         csi_plugins=None,
         secret_drivers=None,
+        external_ca=None,
+        cert_expiry: float | None = None,
+        autolock_key: bytes | None = None,
     ):
         self.store = store if store is not None else MemoryStore()
         self.security = security
@@ -96,7 +99,10 @@ class Manager:
             root = security.root_ca
         else:
             root = self._load_root_from_store() or RootCA.create(org)
-        self.ca_server = CAServer(self.store, root, self.cluster_id, org=org)
+        self.autolock_key = autolock_key
+        self.ca_server = CAServer(self.store, root, self.cluster_id, org=org,
+                                  external_ca=external_ca,
+                                  cert_expiry=cert_expiry)
 
         # leader-only components, created on become_leader
         self._leader_components: list = []
@@ -358,6 +364,12 @@ class Manager:
                     join_token_worker=generate_join_token(self.root),
                     join_token_manager=generate_join_token(self.root),
                 )
+                if self.autolock_key:
+                    # autolock: the raft-DEK KEK is operator-held; the
+                    # cluster records it so managers can serve GetUnlockKey
+                    # (manager.go updateKEK / CA GetUnlockKey)
+                    cluster.unlock_keys = [self.autolock_key]
+                    cluster.spec.encryption.auto_lock_managers = True
                 tx.create(cluster)
 
             ingress = [

@@ -203,6 +203,11 @@ class SwarmNode:
         election_tick: int = 10,
         manager_refresh_interval: float = 5.0,
         force_new_cluster: bool = False,
+        control_socket: bool = True,
+        cert_expiry: float | None = None,
+        external_ca=None,
+        generic_resources=None,  # {kind: count} or api Resources
+        autolock: bool = False,
     ):
         self.state_dir = state_dir
         self.executor = executor
@@ -218,6 +223,13 @@ class SwarmNode:
         self.election_tick = election_tick
         self.manager_refresh_interval = manager_refresh_interval
         self.force_new_cluster = force_new_cluster
+        self.control_socket = control_socket
+        self.control_socket_path: str | None = None
+        self.cert_expiry = cert_expiry
+        self.external_ca = external_ca
+        self.generic_resources = generic_resources
+        self.autolock = autolock
+        self._control_server: RPCServer | None = None
 
         self.security: SecurityConfig | None = None
         self.manager: Manager | None = None
@@ -352,6 +364,12 @@ class SwarmNode:
     # ------------------------------------------------------------ lifecycle
 
     def start(self):
+        if self.autolock and self.kek is None:
+            # autolock without an operator-provided key: mint one; swarmd
+            # prints it as SWARM_UNLOCK_KEY (docker's --autolock UX)
+            import secrets
+
+            self.kek = secrets.token_hex(16).encode()
         self.security = self._obtain_identity()
         self._save_identity()
         # renewed certs / rotated roots must survive a restart: persist on
@@ -371,6 +389,9 @@ class SwarmNode:
             self.agent.stop()
         if self._dispatcher_shim is not None:
             self._dispatcher_shim.close()
+        if self._control_server is not None:
+            self._control_server.stop()
+            self._control_server = None
         if self.manager is not None:
             self.manager.stop()
         if self._ticker is not None:
@@ -515,12 +536,25 @@ class SwarmNode:
             raft_node=raft,
             org=self.org,
             heartbeat_period=self.heartbeat_period,
+            external_ca=self.external_ca,
+            cert_expiry=self.cert_expiry,
+            autolock_key=self.kek if self.autolock else None,
         )
         build_manager_registry(self.manager, raft,
                                LeaderConns(raft, self.security),
                                registry=registry)
 
         self.server.start()
+        if self.control_socket:
+            # local operator socket (xnet unix listener): swarmctl on the
+            # same host needs no TLS material (swarmd/cmd/swarmd control
+            # socket; filesystem perms are the boundary)
+            sock_path = os.path.join(self.state_dir, "swarmd.sock")
+            self._control_server = RPCServer(
+                "", self.security, registry, org=self.org,
+                unix_path=sock_path)
+            self._control_server.start()
+            self.control_socket_path = sock_path
         raft.start()
         self._ticker = _Ticker(raft, self.tick_interval)
         self._ticker.start()
@@ -691,6 +725,7 @@ class SwarmNode:
             state_path=os.path.join(self.state_dir, "worker.json"),
             log_broker=RemoteLogBroker(addr.split(",")[0].strip(),
                                        self.security),
+            generic_resources=self.generic_resources,
         )
         self.agent.on_session_message = self._on_session_message
         self.agent.start()
@@ -894,6 +929,10 @@ class SwarmNode:
         removed us from the raft quorum (node.role flipped WORKER); tear
         the manager stack down and continue as a pure agent."""
         try:
+            if self._control_server is not None:
+                self._control_server.stop()
+                self._control_server = None
+                self.control_socket_path = None
             if self.manager is not None:
                 self.manager.stop()
                 self.manager = None

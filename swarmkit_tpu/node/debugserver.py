@@ -1,0 +1,119 @@
+"""Operator observability listeners: --listen-metrics and --listen-debug.
+
+The reference exposes Prometheus via --listen-metrics and pprof/expvar via
+--listen-debug (swarmd/cmd/swarmd/main.go:5-9, 97-100, 266;
+manager/manager.go:551-562 grpc_prometheus). The Python-native analogue:
+
+  /metrics       Prometheus text — object/node gauges + hot-path histograms
+                 (manager/metrics.py MetricsCollector.prometheus_text)
+  /healthz       liveness probe
+  /debug/stacks  all thread stacks (the pprof goroutine-dump analogue —
+                 the same diagnostic the wedge detector emits)
+  /debug/vars    expvar-style JSON snapshot
+
+Bound to loopback by default; no TLS (match the reference's plaintext debug
+listeners, which are operator-only surfaces).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def dump_stacks() -> str:
+    lines = []
+    frames = sys._current_frames()
+    for t in threading.enumerate():
+        frame = frames.get(t.ident)
+        lines.append(f"--- thread {t.name} (daemon={t.daemon}) ---")
+        if frame is not None:
+            lines.extend(traceback.format_stack(frame))
+    return "\n".join(lines)
+
+
+class DebugServer:
+    """One HTTP listener serving the observability surface for a node."""
+
+    def __init__(self, addr: str, node):
+        host, _, port = addr.rpartition(":")
+        self.node = node
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _reply(self, body: str, ctype="text/plain; charset=utf-8",
+                       code=200):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                try:
+                    if self.path == "/metrics":
+                        self._reply(outer._metrics_text())
+                    elif self.path == "/healthz":
+                        self._reply("ok\n")
+                    elif self.path == "/debug/stacks":
+                        self._reply(dump_stacks())
+                    elif self.path == "/debug/vars":
+                        self._reply(json.dumps(outer._vars(), indent=2),
+                                    ctype="application/json")
+                    else:
+                        self._reply("not found\n", code=404)
+                except Exception as exc:  # surface, don't kill the listener
+                    self._reply(f"error: {exc}\n", code=500)
+
+        self._httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)),
+                                          Handler)
+        self.addr = "%s:%d" % self._httpd.server_address[:2]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="debug-http")
+
+    def _metrics_text(self) -> str:
+        node = self.node
+        mgr = getattr(node, "manager", None)
+        if mgr is not None:
+            for c in getattr(mgr, "_leader_components", []):
+                if hasattr(c, "prometheus_text"):
+                    return c.prometheus_text()
+        # non-leader / worker: hot-path histograms still exist
+        from ..utils.metrics import all_histograms
+
+        return "\n".join(h.prometheus_text() for h in all_histograms())
+
+    def _vars(self) -> dict:
+        node = self.node
+        out = {
+            "node_id": getattr(node, "node_id", None),
+            "addr": getattr(node, "addr", None),
+            "is_leader": bool(getattr(node, "is_leader", False)),
+            "threads": len(threading.enumerate()),
+        }
+        raft = getattr(node, "raft", None)
+        if raft is not None:
+            out["raft"] = {
+                "id": raft.id,
+                "role": str(raft.role),
+                "term": raft.term,
+                "members": len(raft.members),
+                "commit": raft.commit_index,
+            }
+        return out
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass

@@ -43,28 +43,39 @@ def new_dek() -> bytes:
 
 class Sealer:
     """Encrypt/decrypt with a current DEK plus optional pending DEK
-    (MultiDecrypter semantics from manager/encryption/encryption.go)."""
+    (MultiDecrypter semantics from manager/encryption/encryption.go).
+    The cipher comes from manager/encryption.py: ChaCha20-Poly1305 by
+    default, fernet under FIPS; records written by either (or by the
+    pre-framing fernet format) always decrypt."""
 
-    def __init__(self, dek: bytes | None):
-        self._fernets = [Fernet(dek)] if dek else []
+    def __init__(self, dek: bytes | None, fips: bool | None = None):
+        from ..manager import encryption as enc
+
+        self._enc_mod = enc
+        self._fips = fips
+        self._encrypter = None
+        self._decrypter = enc.MultiDecrypter([])
+        if dek:
+            self._encrypter, self._decrypter = enc.defaults(dek, fips)
 
     def add_key(self, dek: bytes):
-        self._fernets.insert(0, Fernet(dek))
+        enc = self._enc_mod
+        encrypter, _ = enc.defaults(dek, self._fips)
+        self._encrypter = encrypter
+        self._decrypter.add_key(dek, first=True)
 
     def seal(self, raw: bytes) -> bytes:
-        if not self._fernets:
+        if self._encrypter is None:
             return base64.b64encode(raw)
-        return self._fernets[0].encrypt(raw)
+        return self._enc_mod.seal(self._encrypter, raw)
 
     def unseal(self, blob: bytes) -> bytes:
-        if not self._fernets:
+        if self._encrypter is None:
             return base64.b64decode(blob)
-        for f in self._fernets:
-            try:
-                return f.decrypt(blob)
-            except InvalidToken:
-                continue
-        raise InvalidToken("no DEK decrypts this record")
+        try:
+            return self._decrypter.unseal(blob)
+        except self._enc_mod.DecryptError as exc:
+            raise InvalidToken(str(exc)) from exc
 
 
 @dataclass
@@ -158,7 +169,10 @@ class RaftStorage:
             snap = self._read_snapshot()
             old = self.sealer
             self.sealer = Sealer(new_key)
-            self.sealer._fernets.extend(old._fernets)  # still able to read old
+            # still able to read records the OLD keys sealed
+            for algo, decs in old._decrypter._by_algo.items():
+                self.sealer._decrypter._by_algo.setdefault(
+                    algo, []).extend(decs)
             self._rewrite_wal(entries)
             if snap is not None:
                 payload = codec.dumps(snap)

@@ -91,8 +91,19 @@ class RPCClient:
                  root_cert_pem: bytes | None = None,
                  connect_timeout: float = 10.0):
         self.addr = addr
-        ctx = client_ssl_context(security, root_cert_pem)
-        self._sock = connect_tls(addr, ctx, timeout=connect_timeout)
+        if addr.startswith("unix://"):
+            # local control socket: plain stream, filesystem perms are the
+            # trust boundary (xnet) — no TLS, no identity needed
+            import socket as _socket
+
+            sock = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+            sock.settimeout(connect_timeout)
+            sock.connect(addr[len("unix://"):])
+            sock.settimeout(None)
+            self._sock = sock
+        else:
+            ctx = client_ssl_context(security, root_cert_pem)
+            self._sock = connect_tls(addr, ctx, timeout=connect_timeout)
         self._wlock = threading.Lock()
         self._lock = threading.Lock()
         self._next_id = 1

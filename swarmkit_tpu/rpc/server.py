@@ -65,24 +65,36 @@ class ServiceRegistry:
 
 class RPCServer:
     def __init__(self, listen_addr: str, security, registry: ServiceRegistry,
-                 org: str | None = None):
+                 org: str | None = None, unix_path: str | None = None):
+        """TCP+mTLS by default; with `unix_path` a LOCAL control listener
+        (the reference's xnet unix socket): no TLS — filesystem permissions
+        are the trust boundary, and every caller authenticates as this
+        node's own identity, exactly like swarmd's control socket serving
+        the local engine."""
         self.security = security
         self.registry = registry
         self.org = org if org is not None else security.identity.org
-        host, _, port = listen_addr.rpartition(":")
-        self._bind = (host or "127.0.0.1", int(port))
+        self.unix_path = unix_path
+        if unix_path is None:
+            host, _, port = listen_addr.rpartition(":")
+            self._bind = (host or "127.0.0.1", int(port))
+        else:
+            self._bind = None
         self._sock: socket.socket | None = None
         self._ctx_lock = threading.Lock()
-        self._ctx = server_ssl_context(security)
+        self._ctx = server_ssl_context(security) if unix_path is None else None
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._conns: set = set()
         self._conns_lock = threading.Lock()
         self.addr: str | None = None  # actual host:port after bind
         # renewed certs / rotated roots apply to new connections
-        security.watch(self._reload_tls)
+        if unix_path is None:
+            security.watch(self._reload_tls)
 
     def _reload_tls(self, _security):
+        if self.unix_path is not None:
+            return
         try:
             ctx = server_ssl_context(self.security)
         except Exception:
@@ -99,6 +111,20 @@ class RPCServer:
         are constructed; accepted connections queue in the backlog until
         start()."""
         if self._sock is not None:
+            return self.addr
+        if self.unix_path is not None:
+            import os
+
+            try:
+                os.unlink(self.unix_path)
+            except FileNotFoundError:
+                pass
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.bind(self.unix_path)
+            os.chmod(self.unix_path, 0o600)
+            sock.listen(128)
+            self._sock = sock
+            self.addr = f"unix://{self.unix_path}"
             return self.addr
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -123,6 +149,13 @@ class RPCServer:
                 self._sock.close()
             except OSError:
                 pass
+        if self.unix_path is not None:
+            import os
+
+            try:
+                os.unlink(self.unix_path)
+            except OSError:
+                pass
         with self._conns_lock:
             conns = list(self._conns)
         for c in conns:
@@ -145,18 +178,24 @@ class RPCServer:
             t.start()
 
     def _serve_conn(self, raw: socket.socket):
-        try:
-            with self._ctx_lock:
-                ctx = self._ctx
-            conn = ctx.wrap_socket(raw, server_side=True)
-        except (ssl.SSLError, OSError) as exc:
-            log.debug("rpc-server: TLS handshake failed: %s", exc)
+        if self.unix_path is not None:
+            # local control socket: the caller IS this node (xnet semantics)
+            conn = raw
+            ident = self.security.identity
+            caller = Caller(ident.node_id, ident.role, ident.org)
+        else:
             try:
-                raw.close()
-            except OSError:
-                pass
-            return
-        caller = caller_from_socket(conn)
+                with self._ctx_lock:
+                    ctx = self._ctx
+                conn = ctx.wrap_socket(raw, server_side=True)
+            except (ssl.SSLError, OSError) as exc:
+                log.debug("rpc-server: TLS handshake failed: %s", exc)
+                try:
+                    raw.close()
+                except OSError:
+                    pass
+                return
+            caller = caller_from_socket(conn)
         if caller is not None and self.org and caller.org != self.org:
             conn.close()
             return

@@ -129,7 +129,8 @@ def test_swarmbench_and_rafttool(daemon):
     import shutil
 
     snap = os.path.join(daemon["base"], "statecopy")
-    shutil.copytree(ident, snap)
+    shutil.copytree(ident, snap,
+                    ignore=shutil.ignore_patterns("*.sock"))
     r = subprocess.run(
         [sys.executable, "-m", "swarmkit_tpu.cmd.rafttool", "dump",
          "--state-dir", snap],

@@ -1,0 +1,68 @@
+"""Encryption package: two AEAD backends, MultiDecrypter, FIPS selection,
+legacy-record compatibility (reference manager/encryption/)."""
+import pytest
+
+from swarmkit_tpu.manager import encryption as enc
+
+
+def test_roundtrip_both_algos():
+    key = enc.generate_key()
+    for cls in (enc.FernetEncrypter, enc.ChaChaEncrypter):
+        e = cls(key)
+        blob = enc.seal(e, b"payload")
+        assert blob.startswith(b"skt1:" + cls.ALGO + b":")
+        assert enc.MultiDecrypter([key]).unseal(blob) == b"payload"
+
+
+def test_multidecrypter_accepts_any_configured_key():
+    k1, k2 = enc.generate_key(), enc.generate_key()
+    blob1 = enc.seal(enc.ChaChaEncrypter(k1), b"one")
+    blob2 = enc.seal(enc.FernetEncrypter(k2), b"two")
+    md = enc.MultiDecrypter([k1, k2])
+    assert md.unseal(blob1) == b"one"
+    assert md.unseal(blob2) == b"two"
+    with pytest.raises(enc.DecryptError):
+        enc.MultiDecrypter([enc.generate_key()]).unseal(blob1)
+
+
+def test_legacy_bare_fernet_records_decrypt():
+    from cryptography.fernet import Fernet
+
+    key = enc.generate_key()
+    legacy = Fernet(key).encrypt(b"old record")
+    assert enc.MultiDecrypter([key]).unseal(legacy) == b"old record"
+
+
+def test_fips_selects_fernet():
+    key = enc.generate_key()
+    e, _ = enc.defaults(key, fips=True)
+    assert isinstance(e, enc.FernetEncrypter)
+    e, _ = enc.defaults(key, fips=False)
+    assert isinstance(e, enc.ChaChaEncrypter)
+
+
+def test_fips_env(monkeypatch):
+    monkeypatch.setenv("SWARMKIT_FIPS", "1")
+    assert enc.fips_enabled() is True
+    monkeypatch.setenv("SWARMKIT_FIPS", "0")
+    assert enc.fips_enabled() is False
+
+
+def test_sealer_dek_rotation_reads_old_records():
+    from swarmkit_tpu.raft.storage import Sealer, new_dek
+
+    dek1, dek2 = new_dek(), new_dek()
+    s = Sealer(dek1)
+    old_blob = s.seal(b"entry-1")
+    s.add_key(dek2)
+    new_blob = s.seal(b"entry-2")
+    assert old_blob != new_blob
+    assert s.unseal(old_blob) == b"entry-1"
+    assert s.unseal(new_blob) == b"entry-2"
+    # a fresh sealer that only knows the NEW key reads only new records
+    s2 = Sealer(dek2)
+    assert s2.unseal(new_blob) == b"entry-2"
+    from cryptography.fernet import InvalidToken
+
+    with pytest.raises(InvalidToken):
+        s2.unseal(old_blob)

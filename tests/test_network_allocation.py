@@ -186,6 +186,60 @@ def test_ingress_attachment_for_ready_nodes_and_ingress_vip(store):
         a.stop()
 
 
+def test_ipam_exhaustion_after_reserve_raises():
+    """Exhaustion must raise even when reserved addresses left the cursor
+    parked at the wrap target (the leader-failover restore path)."""
+    ipam = IPAM()
+    ipam.add_network("n1", "192.168.5.0/30")
+    ipam.reserve("n1", "192.168.5.2")   # fills the only host slot
+    with pytest.raises(IPAMError):
+        ipam.allocate("n1")
+
+
+def test_service_created_before_network_gets_vip_later(store):
+    _mk_service(store, networks=["backend"])
+    a = Allocator(store)
+    a.start()
+    try:
+        import time as _t
+        _t.sleep(0.4)
+        s = store.view(lambda tx: tx.get_service("svc1"))
+        assert not (s.endpoint or {}).get("virtual_ips")
+        _mk_network(store)
+
+        def has_vip():
+            s = store.view(lambda tx: tx.get_service("svc1"))
+            return bool((s.endpoint or {}).get("virtual_ips"))
+        assert wait_for(has_vip, timeout=5)
+    finally:
+        a.stop()
+
+
+def test_dnsrr_mode_releases_vips(store):
+    _mk_network(store)
+    _mk_service(store, networks=["backend"])
+    a = Allocator(store)
+    a.start()
+    try:
+        def has_vip():
+            s = store.view(lambda tx: tx.get_service("svc1"))
+            return bool((s.endpoint or {}).get("virtual_ips"))
+        assert wait_for(has_vip, timeout=5)
+        vip = dict(store.view(lambda tx: tx.get_service("svc1"))
+                   .endpoint["virtual_ips"])["net1"]
+
+        def flip(tx):
+            s = tx.get_service("svc1").copy()
+            s.spec.endpoint.mode = "dnsrr"
+            tx.update(s)
+        store.update(flip)
+
+        assert wait_for(lambda: not has_vip(), timeout=5)
+        assert vip not in a.ipam._pools["net1"].allocated
+    finally:
+        a.stop()
+
+
 def test_restart_rebuilds_without_double_assignment(store):
     _mk_network(store)
     _mk_service(store, networks=["backend"])

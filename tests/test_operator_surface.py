@@ -1,0 +1,168 @@
+"""Operator surface: unix control socket (xnet), metrics/debug listener,
+autolock, cert-expiry, and generic node resources (reference
+swarmd/cmd/swarmd/main.go flags; xnet/)."""
+import json
+import os
+import urllib.request
+
+import pytest
+
+from swarmkit_tpu.agent.testutils import FakeExecutor
+from swarmkit_tpu.api.specs import Annotations, ServiceSpec
+from swarmkit_tpu.api.types import TaskState
+from swarmkit_tpu.node.daemon import SwarmNode
+from swarmkit_tpu.rpc.services import RemoteControl
+
+from test_scheduler import wait_for  # noqa: E402
+
+pytestmark = pytest.mark.daemon
+
+
+def _mk_manager(tmp_path, name="m1", **kw):
+    node = SwarmNode(
+        state_dir=str(tmp_path / name),
+        executor=FakeExecutor({"*": {"run_forever": True}}, hostname=name),
+        listen_addr="127.0.0.1:0",
+        heartbeat_period=0.5,
+        tick_interval=0.05,
+        manager_refresh_interval=0.5,
+        **kw,
+    )
+    node.start()
+    assert wait_for(lambda: node.is_leader, timeout=15)
+    return node
+
+
+def test_unix_control_socket_serves_control_api(tmp_path):
+    m1 = _mk_manager(tmp_path)
+    try:
+        sock = m1.control_socket_path
+        assert sock and os.path.exists(sock)
+        assert oct(os.stat(sock).st_mode & 0o777) == "0o600"
+        ctl = RemoteControl(f"unix://{sock}", None)
+        try:
+            svc = ctl.create_service(ServiceSpec(
+                annotations=Annotations(name="local"), replicas=2))
+            assert wait_for(lambda: sum(
+                1 for t in m1.store.view(lambda tx: tx.find_tasks())
+                if t.service_id == svc.id
+                and t.status.state == TaskState.RUNNING) == 2, timeout=20)
+            assert [s.id for s in ctl.list_services()] == [svc.id]
+        finally:
+            ctl.close()
+    finally:
+        m1.stop()
+
+
+def test_debug_server_metrics_and_stacks(tmp_path):
+    from swarmkit_tpu.node.debugserver import DebugServer
+
+    m1 = _mk_manager(tmp_path)
+    srv = DebugServer("127.0.0.1:0", m1)
+    srv.start()
+    try:
+        base = f"http://{srv.addr}"
+        assert urllib.request.urlopen(f"{base}/healthz").read() == b"ok\n"
+        metrics = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        assert "swarm" in metrics or "# " in metrics
+        stacks = urllib.request.urlopen(f"{base}/debug/stacks").read().decode()
+        assert "thread" in stacks
+        vars_ = json.loads(
+            urllib.request.urlopen(f"{base}/debug/vars").read())
+        assert vars_["is_leader"] is True
+        assert vars_["raft"]["members"] == 1
+    finally:
+        srv.stop()
+        m1.stop()
+
+
+def test_autolocked_state_dir_requires_key(tmp_path):
+    kek = b"supersecretunlock"
+    m1 = _mk_manager(tmp_path, kek=kek, autolock=True)
+    cluster_id = m1.manager.cluster_id
+
+    def unlock_key_stored():
+        c = m1.store.view(lambda tx: tx.get_cluster(cluster_id))
+        return c is not None and c.unlock_keys == [kek] \
+            and c.spec.encryption.auto_lock_managers
+    assert wait_for(unlock_key_stored, timeout=10)
+    m1.stop()
+
+    # restart without the key: the sealed TLS key must refuse to load
+    locked = SwarmNode(
+        state_dir=str(tmp_path / "m1"),
+        executor=FakeExecutor({"*": {"run_forever": True}}, hostname="m1"),
+        listen_addr="127.0.0.1:0", tick_interval=0.05)
+    with pytest.raises(Exception):
+        locked.start()
+
+    # with the key it comes back
+    m2 = SwarmNode(
+        state_dir=str(tmp_path / "m1"),
+        executor=FakeExecutor({"*": {"run_forever": True}}, hostname="m1"),
+        listen_addr="127.0.0.1:0", tick_interval=0.05, kek=kek)
+    m2.start()
+    try:
+        assert wait_for(lambda: m2.is_leader, timeout=20)
+    finally:
+        m2.stop()
+
+
+def test_generic_resources_advertised_and_schedulable(tmp_path):
+    m1 = _mk_manager(tmp_path, generic_resources={"gpu": 2})
+    try:
+        def advertised():
+            n = m1.store.view(lambda tx: tx.get_node(m1.node_id))
+            return (n is not None and n.description is not None
+                    and n.description.resources is not None
+                    and n.description.resources.generic.get("gpu") == 2)
+        assert wait_for(advertised, timeout=15)
+
+        spec = ServiceSpec(annotations=Annotations(name="gpu-job"),
+                           replicas=2)
+        spec.task.resources.reservations.generic = {"gpu": 1}
+        ctl = RemoteControl(m1.addr, m1.security)
+        try:
+            svc = ctl.create_service(spec)
+            assert wait_for(lambda: sum(
+                1 for t in m1.store.view(lambda tx: tx.find_tasks())
+                if t.service_id == svc.id
+                and t.status.state == TaskState.RUNNING) == 2, timeout=20)
+        finally:
+            ctl.close()
+    finally:
+        m1.stop()
+
+
+def test_cert_expiry_applies_to_issued_certs(tmp_path):
+    from swarmkit_tpu.ca.certificates import cert_expiry
+
+    m1 = _mk_manager(tmp_path, cert_expiry=3600.0)
+    try:
+        _, wtok = _tokens(m1)
+        w1 = SwarmNode(
+            state_dir=str(tmp_path / "w1"),
+            executor=FakeExecutor({"*": {"run_forever": True}},
+                                  hostname="w1"),
+            join_addr=m1.addr, join_token=wtok,
+            heartbeat_period=0.5, manager_refresh_interval=0.5)
+        w1.start()
+        try:
+            nb, na = cert_expiry(w1.security.key_and_cert()[1])
+            # lifetime ≈ 3600s (plus the issuance backdate window)
+            assert na - nb < 2 * 3600.0
+        finally:
+            w1.stop()
+    finally:
+        m1.stop()
+
+
+def _tokens(manager: SwarmNode):
+    def seeded():
+        c = manager.store.view(
+            lambda tx: tx.get_cluster(manager.manager.cluster_id))
+        return c is not None and c.root_ca is not None
+    assert wait_for(seeded, timeout=10)
+    c = manager.store.view(
+        lambda tx: tx.get_cluster(manager.manager.cluster_id))
+    return c.root_ca.join_token_manager, c.root_ca.join_token_worker
