@@ -440,6 +440,13 @@ class Allocator(EventLoopComponent):
             ok = self.ports.allocate(s.id, s.spec.endpoint.ports)
             if not ok:
                 self._starved.add(s.id)
+                if dirty:
+                    # VIP pool state already changed above — persist it even
+                    # though ports are starved, or the endpoint would go on
+                    # listing addresses the pool has re-handed out
+                    endpoint["virtual_ips"] = sorted(have_vips.items())
+                    s.endpoint = endpoint
+                    tx.update(s)
                 return  # retried when a conflicting service releases ports
             endpoint.update({
                 "ports_allocated": True,

@@ -42,6 +42,12 @@ class CAServer:
         # node certificate lifetime (swarmd --cert-expiry; reference
         # CAConfig.NodeCertExpiry); None == the compiled default
         self.cert_expiry = cert_expiry
+        if cert_expiry and external_ca is not None:
+            import logging
+
+            logging.getLogger("swarmkit_tpu.ca").warning(
+                "--cert-expiry has no effect with an external CA: the "
+                "external service controls issued certificate lifetimes")
         # optional ca.external.ExternalCA: signing delegates to the
         # operator's CA service; the local root stays the trust anchor
         # (ca/external.go — the external CA signs under the same root)

@@ -120,10 +120,19 @@ class MultiDecrypter:
             else:
                 lst.append(dec)
 
+    def merge(self, other: "MultiDecrypter") -> None:
+        """Adopt another decrypter's keys (appended after ours) — the DEK
+        rotation path keeps reading records the old keys sealed."""
+        for algo, decs in other._by_algo.items():
+            self._by_algo.setdefault(algo, []).extend(decs)
+
     def unseal(self, blob: bytes) -> bytes:
         if blob.startswith(_MAGIC + b":"):
-            _, algo, b64 = blob.split(b":", 2)
             try:
+                # a torn tail may truncate the envelope anywhere — every
+                # malformation must surface as DecryptError so WAL recovery
+                # can truncate at the bad record instead of refusing to load
+                _, algo, b64 = blob.split(b":", 2)
                 payload = base64.urlsafe_b64decode(b64)
             except Exception as exc:
                 raise DecryptError(f"bad record encoding: {exc}") from exc

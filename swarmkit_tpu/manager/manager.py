@@ -348,6 +348,15 @@ class Manager:
 
         def txn(tx):
             cluster = tx.get_cluster(self.cluster_id)
+            if cluster is not None and self.autolock_key \
+                    and self.autolock_key not in (cluster.unlock_keys or []):
+                # --autolock enabled on an EXISTING cluster: the key must
+                # replicate, or other managers serve no unlock key and the
+                # cluster reports autolock off while this node is sealed
+                cluster.unlock_keys = [self.autolock_key] \
+                    + list(cluster.unlock_keys or [])
+                cluster.spec.encryption.auto_lock_managers = True
+                tx.update(cluster)
             if cluster is None:
                 spec = ClusterSpec(
                     annotations=Annotations(name=DEFAULT_CLUSTER_NAME))

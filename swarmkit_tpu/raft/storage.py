@@ -170,9 +170,7 @@ class RaftStorage:
             old = self.sealer
             self.sealer = Sealer(new_key)
             # still able to read records the OLD keys sealed
-            for algo, decs in old._decrypter._by_algo.items():
-                self.sealer._decrypter._by_algo.setdefault(
-                    algo, []).extend(decs)
+            self.sealer._decrypter.merge(old._decrypter)
             self._rewrite_wal(entries)
             if snap is not None:
                 payload = codec.dumps(snap)

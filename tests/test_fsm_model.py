@@ -1,0 +1,270 @@
+"""Model-checking tier: exhaustive small-scope exploration of the task FSM
+and the assignment-stream protocol, asserting the invariants the reference
+verifies with TLC over its TLA+ models (design/tla/{Tasks,WorkerSpec,
+WorkerImpl,EventCounter}.tla — SURVEY.md §4.5).
+
+Instead of a separate spec language, the REAL implementation is driven
+through every reachable (observed state, desired state, controller
+behavior) combination:
+
+  * monotonicity — observed state never decreases (Tasks.tla's central
+    invariant; agent/exec/controller.go:163-166 panics on violation);
+  * teardown priority — desired >= SHUTDOWN preempts progress;
+  * fatal-error split — REJECTED strictly before STARTING, FAILED from
+    STARTING on (controller.go:142-345 exec.Do);
+  * terminal absorption — no transitions out of terminal states;
+  * liveness under fairness — once the controller stops throwing
+    TemporaryError, every trace reaches a terminal state in bounded steps.
+
+The protocol model drives the real Dispatcher diff engine against a
+shadow dict through randomized create/update/delete/reconnect
+interleavings and asserts the worker-visible set always converges to the
+store (WorkerSpec.tla's correspondence invariant).
+"""
+import itertools
+import random
+
+import pytest
+
+from swarmkit_tpu.agent.exec import (
+    ExitStatus,
+    FatalError,
+    TemporaryError,
+    do,
+)
+from swarmkit_tpu.api.objects import Task
+from swarmkit_tpu.api.types import TaskState
+
+TERMINAL = {TaskState.COMPLETE, TaskState.SHUTDOWN, TaskState.FAILED,
+            TaskState.REJECTED, TaskState.ORPHANED, TaskState.REMOVE}
+START_STATES = [TaskState.ASSIGNED, TaskState.ACCEPTED, TaskState.PREPARING,
+                TaskState.READY, TaskState.STARTING, TaskState.RUNNING]
+DESIREDS = [TaskState.READY, TaskState.RUNNING, TaskState.SHUTDOWN,
+            TaskState.REMOVE]
+BEHAVIORS = ["ok", "temp", "fatal", "exit0", "exit1"]
+
+
+class ScriptedController:
+    """One FSM step's controller behavior, chosen by the explorer."""
+
+    def __init__(self, behavior: str):
+        self.behavior = behavior
+
+    def _maybe_raise(self):
+        if self.behavior == "temp":
+            raise TemporaryError("transient")
+        if self.behavior == "fatal":
+            raise FatalError("fatal")
+
+    def update(self, task):
+        self._maybe_raise()
+
+    def prepare(self):
+        self._maybe_raise()
+
+    def start(self):
+        self._maybe_raise()
+
+    def wait(self):
+        self._maybe_raise()
+        if self.behavior == "exit1":
+            return ExitStatus(code=1, message="boom")
+        return ExitStatus(code=0)
+
+    def shutdown(self):
+        self._maybe_raise()
+
+    def terminate(self):
+        pass
+
+    def remove(self):
+        pass
+
+    def close(self):
+        pass
+
+
+def _mk_task(state, desired):
+    t = Task(id="t1", service_id="s1", slot=1)
+    t.status.state = state
+    t.desired_state = desired
+    return t
+
+
+def test_exhaustive_single_steps():
+    """Every (state, desired, behavior) triple: one do() step upholds the
+    step invariants."""
+    for state, desired, behavior in itertools.product(
+            START_STATES, DESIREDS, BEHAVIORS):
+        t = _mk_task(state, desired)
+        status = do(t, ScriptedController(behavior))
+        nxt = status.state
+
+        # monotonicity
+        assert nxt >= state, (state, desired, behavior, nxt)
+
+        # teardown priority: desired shutdown + non-terminal observed must
+        # land on SHUTDOWN unless the step errored fatally mid-teardown
+        if desired >= TaskState.SHUTDOWN and state < TaskState.COMPLETE:
+            if behavior in ("ok", "exit0", "exit1"):
+                assert nxt == TaskState.SHUTDOWN, (state, behavior, nxt)
+
+        # fatal split: REJECTED only before STARTING, FAILED from STARTING.
+        # only steps that actually invoke the controller can observe the
+        # error (ACCEPTED→PREPARING and READY→STARTING are pure moves)
+        invokes_controller = state in (TaskState.ASSIGNED,
+                                       TaskState.PREPARING,
+                                       TaskState.STARTING,
+                                       TaskState.RUNNING)
+        if nxt == TaskState.REJECTED:
+            assert state < TaskState.STARTING
+        if behavior == "fatal" and desired < TaskState.SHUTDOWN \
+                and invokes_controller:
+            if state < TaskState.STARTING:
+                assert nxt == TaskState.REJECTED
+            elif state < TaskState.COMPLETE:
+                assert nxt == TaskState.FAILED
+
+        # temporary errors hold position, never advance past the attempt
+        if behavior == "temp" and desired < TaskState.SHUTDOWN \
+                and invokes_controller:
+            assert nxt == state
+
+
+def test_exhaustive_traces_reach_terminal():
+    """BFS over every trace of up to DEPTH steps where EACH step freely
+    chooses a controller behavior and the manager may flip desired state;
+    invariants hold on every edge, and under fairness (behaviors 'ok'
+    after the exploration horizon) every branch terminates."""
+    DEPTH = 8
+    seen_edges = 0
+    frontier = [(state, TaskState.RUNNING)
+                for state in START_STATES] + [
+                (state, TaskState.READY) for state in START_STATES]
+    for state0, desired0 in frontier:
+        stack = [(state0, desired0, 0)]
+        visited = set()
+        while stack:
+            state, desired, depth = stack.pop()
+            if (state, desired, depth) in visited:
+                continue
+            visited.add((state, desired, depth))
+            if state in TERMINAL:
+                continue  # absorption checked below
+            if depth >= DEPTH:
+                # fairness closure: behaviors turn 'ok' (+ desired RUNNING
+                # promotion for READY-parked tasks) — must terminate
+                t_state, t_desired = state, max(desired, TaskState.RUNNING)
+                for _ in range(12):
+                    t = _mk_task(t_state, t_desired)
+                    t_state = do(t, ScriptedController("ok")).state
+                    if t_state in TERMINAL:
+                        break
+                assert t_state in TERMINAL, (state0, state, t_state)
+                continue
+            for behavior in BEHAVIORS:
+                for next_desired in (desired, TaskState.SHUTDOWN):
+                    t = _mk_task(state, next_desired)
+                    nxt = do(t, ScriptedController(behavior)).state
+                    seen_edges += 1
+                    assert nxt >= state
+                    stack.append((nxt, next_desired, depth + 1))
+    assert seen_edges > 500  # the exploration actually covered the space
+
+
+def test_terminal_states_absorb():
+    for state in TERMINAL:
+        for desired in DESIREDS:
+            for behavior in BEHAVIORS:
+                t = _mk_task(state, desired)
+                status = do(t, ScriptedController(behavior))
+                assert status.state == state, (state, desired, behavior)
+
+
+# --------------------------------------------------------- protocol model
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_assignment_stream_converges(seed):
+    """WorkerSpec.tla correspondence: after any interleaving of task
+    create/update/delete and session reconnects, applying the dispatcher's
+    COMPLETE + INCREMENTAL messages in order leaves the worker-visible task
+    set equal to the store's runnable view for that node."""
+    from swarmkit_tpu.api.objects import Node
+    from swarmkit_tpu.api.types import NodeStatusState
+    from swarmkit_tpu.store.memory import MemoryStore
+
+    from swarmkit_tpu.dispatcher.dispatcher import Dispatcher
+
+    rng = random.Random(seed)
+    store = MemoryStore()
+    n = Node(id="n1")
+    n.status.state = NodeStatusState.READY
+    store.update(lambda tx: tx.create(n))
+
+    # rate limiting off: the model reconnects far faster than a real agent
+    d = Dispatcher(store, heartbeat_period=30.0, rate_limit_period=0.0)
+    d.start()
+    shadow: dict[str, int] = {}   # task id -> version (worker view)
+    try:
+        sid = d.register("n1")
+        session = d._sessions["n1"]
+
+        def apply_msg(msg):
+            if msg.type == "complete":
+                shadow.clear()
+                for a in msg.changes:
+                    if a.kind == "task" and a.action == "update":
+                        shadow[a.item.id] = a.item.meta.version.index
+            else:
+                for a in msg.changes:
+                    if a.kind != "task":
+                        continue
+                    if a.action == "update":
+                        shadow[a.item.id] = a.item.meta.version.index
+                    else:
+                        shadow.pop(a.item, None)
+
+        apply_msg(d._full_assignment(session))
+
+        live = []
+        for step in range(60):
+            op = rng.random()
+            if op < 0.4 or not live:
+                tid = f"t{step}"
+
+                def create(tx, tid=tid):
+                    t = Task(id=tid, service_id="s1", node_id="n1")
+                    t.status.state = TaskState.ASSIGNED
+                    t.desired_state = TaskState.RUNNING
+                    tx.create(t)
+                store.update(create)
+                live.append(tid)
+            elif op < 0.7:
+                tid = rng.choice(live)
+
+                def bump(tx, tid=tid):
+                    t = tx.get_task(tid)
+                    if t is not None:
+                        t = t.copy()
+                        t.status.state = TaskState.RUNNING
+                        tx.update(t)
+                store.update(bump)
+            elif op < 0.9:
+                tid = live.pop(rng.randrange(len(live)))
+                store.update(lambda tx, tid=tid: tx.delete(Task, tid))
+            else:
+                # reconnect: worker re-registers, gets a fresh COMPLETE
+                sid = d.register("n1")
+                session = d._sessions["n1"]
+                apply_msg(d._full_assignment(session))
+            apply_msg(d._incremental(session))
+
+        expected = {
+            t.id: t.meta.version.index
+            for t in store.view(lambda tx: tx.find_tasks())
+            if t.node_id == "n1"
+        }
+        assert shadow == expected
+    finally:
+        d.stop()
