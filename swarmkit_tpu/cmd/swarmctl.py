@@ -329,6 +329,62 @@ def _read_data(args) -> bytes:
     return sys.stdin.buffer.read()
 
 
+def cmd_network_create(args):
+    from ..api.specs import Annotations, NetworkSpec
+
+    ctl = _control(args)
+    spec = NetworkSpec(annotations=Annotations(name=args.name),
+                       ingress=args.ingress)
+    if args.subnet:
+        spec.ipam = {"subnet": args.subnet}
+    n = ctl.create_network(spec)
+    print(n.id)
+
+
+def cmd_network_ls(args):
+    ctl = _control(args)
+    rows = []
+    for n in ctl.list_networks():
+        state = n.driver_state or {}
+        rows.append([_short(n.id), n.spec.annotations.name,
+                     state.get("subnet", ""), state.get("gateway", ""),
+                     "ingress" if n.spec.ingress else ""])
+    print(_fmt_table(rows, ["ID", "NAME", "SUBNET", "GATEWAY", "FLAGS"]))
+
+
+def _find_network(ctl, ref):
+    matches = [n for n in ctl.list_networks()
+               if n.id == ref or n.id.startswith(ref)
+               or n.spec.annotations.name == ref]
+    if not matches:
+        _die(f"network {ref!r} not found")
+    if len(matches) > 1:
+        _die(f"network {ref!r} is ambiguous")
+    return matches[0]
+
+
+def cmd_network_inspect(args):
+    import json as _json
+
+    ctl = _control(args)
+    n = _find_network(ctl, args.network)
+    state = n.driver_state or {}
+    print(_json.dumps({
+        "id": n.id,
+        "name": n.spec.annotations.name,
+        "ingress": n.spec.ingress,
+        "subnet": state.get("subnet"),
+        "gateway": state.get("gateway"),
+        "pending_delete": n.pending_delete,
+    }, indent=2))
+
+
+def cmd_network_rm(args):
+    ctl = _control(args)
+    n = _find_network(ctl, args.network)
+    ctl.remove_network(n.id)
+
+
 def cmd_secret_create(args):
     from ..api.specs import Annotations, SecretSpec
 
@@ -500,6 +556,21 @@ def main(argv=None) -> int:
     p.set_defaults(func=cmd_cluster_inspect)
 
     # secret / config
+    net = sub.add_parser("network").add_subparsers(dest="sub", required=True)
+    p = net.add_parser("create")
+    p.add_argument("name")
+    p.add_argument("--subnet", default=None, help="CIDR, e.g. 10.5.0.0/24")
+    p.add_argument("--ingress", action="store_true")
+    p.set_defaults(func=cmd_network_create)
+    p = net.add_parser("ls")
+    p.set_defaults(func=cmd_network_ls)
+    p = net.add_parser("inspect")
+    p.add_argument("network")
+    p.set_defaults(func=cmd_network_inspect)
+    p = net.add_parser("rm")
+    p.add_argument("network")
+    p.set_defaults(func=cmd_network_rm)
+
     sec = sub.add_parser("secret").add_subparsers(dest="sub", required=True)
     p = sec.add_parser("create")
     p.add_argument("name")

@@ -353,6 +353,7 @@ class Manager:
                 # --autolock enabled on an EXISTING cluster: the key must
                 # replicate, or other managers serve no unlock key and the
                 # cluster reports autolock off while this node is sealed
+                cluster = cluster.copy()  # store objects are immutable
                 cluster.unlock_keys = [self.autolock_key] \
                     + list(cluster.unlock_keys or [])
                 cluster.spec.encryption.auto_lock_managers = True
