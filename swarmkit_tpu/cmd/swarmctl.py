@@ -321,6 +321,82 @@ def cmd_cluster_inspect(args):
     print(json.dumps(out, indent=2))
 
 
+def _update_cluster_retry(ctl, **rotations):
+    """Version-checked update raced by background cluster writers
+    (keymanager etc.): retry on sequence conflicts like any client."""
+    import time as _time
+
+    for _ in range(20):
+        c = ctl.list_clusters()[0]
+        try:
+            return ctl.update_cluster(c.id, c.meta.version, c.spec,
+                                      **rotations)
+        except Exception as exc:
+            if "out of sequence" not in str(exc):
+                raise
+            _time.sleep(0.1)
+    _die("cluster update kept conflicting; try again")
+
+
+def cmd_cluster_update(args):
+    """Token rotation (reference swarmctl/cluster/update.go)."""
+    ctl = _control(args)
+    c = _update_cluster_retry(
+        ctl,
+        rotate_worker_token=args.rotate_worker_token,
+        rotate_manager_token=args.rotate_manager_token,
+        rotate_unlock_key=args.rotate_unlock_key)
+    if args.rotate_worker_token:
+        print(f"SWARM_WORKER_TOKEN={c.root_ca.join_token_worker}")
+    if args.rotate_manager_token:
+        print(f"SWARM_MANAGER_TOKEN={c.root_ca.join_token_manager}")
+
+
+def cmd_cluster_unlockkey(args):
+    """Show (or rotate) the autolock unlock key via the sanctioned
+    GetUnlockKey path — cluster reads redact key material
+    (reference swarmctl/cluster/unlockkey.go; ca.proto GetUnlockKey)."""
+    ctl = _control(args)
+    c = ctl.list_clusters()[0]
+    if args.rotate:
+        c = _update_cluster_retry(ctl, rotate_unlock_key=True)
+    key = ctl.get_unlock_key(c.id)
+    print(key if key else "autolock is not enabled")
+
+
+def _find_task(ctl, ref: str):
+    tasks = ctl.list_tasks()
+    exact = [t for t in tasks if t.id == ref]
+    if exact:
+        return exact[0]
+    matches = [t for t in tasks if t.id.startswith(ref)]
+    if not matches:
+        _die(f"task {ref!r} not found")
+    if len(matches) > 1:
+        _die(f"task {ref!r} is ambiguous")
+    return matches[0]
+
+
+def cmd_task_inspect(args):
+    import json
+
+    ctl = _control(args)
+    t = _find_task(ctl, args.task)
+    from swarmkit_tpu.api.types import TaskState
+
+    print(json.dumps({
+        "id": t.id,
+        "service_id": t.service_id,
+        "slot": t.slot,
+        "node_id": t.node_id,
+        "state": TaskState(t.status.state).name.lower(),
+        "desired_state": TaskState(t.desired_state).name.lower(),
+        "message": t.status.message,
+        "err": t.status.err,
+        "networks": [a for a in (t.networks or []) if isinstance(a, dict)],
+    }, indent=2))
+
+
 # ------------------------------------------------------------ secret/config
 
 def _read_data(args) -> bytes:
@@ -529,6 +605,9 @@ def main(argv=None) -> int:
     p = task.add_parser("ls")
     p.add_argument("--service", default=None)
     p.set_defaults(func=cmd_task_ls)
+    p = task.add_parser("inspect")
+    p.add_argument("task")
+    p.set_defaults(func=cmd_task_inspect)
 
     # node
     node = sub.add_parser("node").add_subparsers(dest="sub", required=True)
@@ -554,6 +633,14 @@ def main(argv=None) -> int:
                                                        required=True)
     p = cluster.add_parser("inspect")
     p.set_defaults(func=cmd_cluster_inspect)
+    p = cluster.add_parser("update")
+    p.add_argument("--rotate-worker-token", action="store_true")
+    p.add_argument("--rotate-manager-token", action="store_true")
+    p.add_argument("--rotate-unlock-key", action="store_true")
+    p.set_defaults(func=cmd_cluster_update)
+    p = cluster.add_parser("unlockkey")
+    p.add_argument("--rotate", action="store_true")
+    p.set_defaults(func=cmd_cluster_unlockkey)
 
     # secret / config
     net = sub.add_parser("network").add_subparsers(dest="sub", required=True)

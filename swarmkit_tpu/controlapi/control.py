@@ -31,7 +31,7 @@ from ..api.types import NodeRole, ServiceMode, TaskState
 from ..scheduler import constraint as constraint_mod
 from ..store import by
 from ..store.memory import MemoryStore, SequenceConflict
-from ..utils.identity import new_id, new_secret_token
+from ..utils.identity import new_id
 from .errors import (
     AlreadyExists,
     FailedPrecondition,
@@ -369,11 +369,19 @@ class ControlAPI:
     def _redact_cluster(c: Cluster) -> Cluster:
         """Strip private key material before returning a cluster (reference:
         controlapi/cluster.go redactClusters — CA signing key and unlock
-        keys never leave the manager; join tokens are part of the API)."""
+        keys never leave the manager; join tokens are part of the API).
+        The sanctioned unlock-key read is `get_unlock_key`."""
         c = c.copy()
+        c.unlock_keys = []
         if isinstance(c.root_ca, dict):
             c.root_ca.pop("ca_key", None)
             c.root_ca.pop("unlock_key", None)
+        elif c.root_ca is not None:
+            c.root_ca.ca_key_pem = b""
+            if c.root_ca.root_rotation:
+                rot = dict(c.root_ca.root_rotation)
+                rot.pop("new_ca_key_pem", None)
+                c.root_ca.root_rotation = rot
         return c
 
     def get_cluster(self, cluster_id: str) -> Cluster:
@@ -393,7 +401,10 @@ class ControlAPI:
         c = self.store.view().get_cluster(cluster_id)
         if c is None:
             raise NotFound(f"cluster {cluster_id} not found")
-        if isinstance(c.root_ca, dict):
+        if c.unlock_keys:
+            key = c.unlock_keys[0]
+            return key.decode() if isinstance(key, bytes) else str(key)
+        if isinstance(c.root_ca, dict):   # legacy shape
             return c.root_ca.get("unlock_key", "")
         return ""
 
@@ -413,15 +424,27 @@ class ControlAPI:
                 raise FailedPrecondition("update out of sequence")
             nxt = cur.copy()
             nxt.spec = spec
-            if nxt.root_ca is None:
-                nxt.root_ca = {}
-            tokens = nxt.root_ca.setdefault("join_tokens", {})
-            if rotate_worker_token or "worker" not in tokens:
-                tokens["worker"] = new_secret_token("worker")
-            if rotate_manager_token or "manager" not in tokens:
-                tokens["manager"] = new_secret_token("manager")
+            # token rotation mints REAL digest-pinned join tokens against
+            # the cluster's root (cluster.go UpdateCluster rotation; a
+            # token that doesn't pin the root digest would be rejected by
+            # the CA's _role_from_token)
+            rca = nxt.root_ca
+            if (rotate_worker_token or rotate_manager_token) \
+                    and (rca is None or not rca.ca_cert_pem):
+                raise FailedPrecondition("cluster has no CA to pin tokens to")
+            if rotate_worker_token or rotate_manager_token:
+                from ..ca import RootCA
+                from ..ca.config import generate_join_token
+
+                root = RootCA(rca.ca_cert_pem)
+                if rotate_worker_token:
+                    rca.join_token_worker = generate_join_token(root)
+                if rotate_manager_token:
+                    rca.join_token_manager = generate_join_token(root)
             if rotate_unlock_key:
-                nxt.root_ca["unlock_key"] = new_secret_token("unlock")
+                import secrets as _secrets
+
+                nxt.unlock_keys = [_secrets.token_hex(16).encode()]
             tx.update(nxt)
             out.append(nxt)
 

@@ -349,10 +349,13 @@ class Manager:
         def txn(tx):
             cluster = tx.get_cluster(self.cluster_id)
             if cluster is not None and self.autolock_key \
-                    and self.autolock_key not in (cluster.unlock_keys or []):
-                # --autolock enabled on an EXISTING cluster: the key must
-                # replicate, or other managers serve no unlock key and the
-                # cluster reports autolock off while this node is sealed
+                    and not cluster.spec.encryption.auto_lock_managers:
+                # --autolock ENABLED on an existing cluster: replicate the
+                # key and flip the flag. Gate on the flag, not key
+                # membership — once autolock is on, the replicated
+                # unlock_keys are owned by KEK rotation
+                # (controlapi rotate_unlock_key) and re-seeding must not
+                # revert a rotation by re-inserting this node's old key
                 cluster = cluster.copy()  # store objects are immutable
                 cluster.unlock_keys = [self.autolock_key] \
                     + list(cluster.unlock_keys or [])

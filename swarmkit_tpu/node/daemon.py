@@ -281,6 +281,50 @@ class SwarmNode:
                 json.dump(state, f)
             os.replace(tmp, state_path)
 
+    def _watch_kek_loop(self) -> None:
+        """manager.go updateKEK (:743): when the replicated unlock key
+        rotates (controlapi rotate_unlock_key) — or autolock is enabled
+        cluster-wide — every manager re-seals its local key material under
+        the new KEK so a restart unlocks with the CURRENT key."""
+        store = self.store
+        if store is None:
+            return
+        from ..api.objects import Cluster as ClusterObj
+        from ..store.watch import ChannelClosed
+
+        queue = store.watch_queue()
+        ch = queue.watch()
+        try:
+            while not self._stop.is_set():
+                try:
+                    ev = ch.get(timeout=0.5)
+                except TimeoutError:
+                    continue
+                except ChannelClosed:
+                    return
+                obj = getattr(ev, "obj", None)
+                if not isinstance(obj, ClusterObj):
+                    continue
+                if not obj.spec.encryption.auto_lock_managers \
+                        or not obj.unlock_keys:
+                    continue
+                new = obj.unlock_keys[0]
+                if isinstance(new, str):
+                    new = new.encode()
+                if new == self.kek:
+                    continue
+                self.kek = new
+                if self.manager is not None:
+                    self.manager.autolock_key = new
+                try:
+                    self._save_identity()
+                    log.info("re-sealed key material under rotated "
+                             "unlock key")
+                except Exception:
+                    log.exception("KEK rotation re-seal failed")
+        finally:
+            queue.stop_watch(ch)
+
     def _persist_managers(self, addrs: list[str]) -> None:
         """persistentRemotes (node/node.go:1202-1286): remember the live
         manager list so a restarted worker reconnects without a join
@@ -545,6 +589,10 @@ class SwarmNode:
                                registry=registry)
 
         self.server.start()
+        t = threading.Thread(target=self._watch_kek_loop, daemon=True,
+                             name="kek-watch")
+        t.start()
+        self._threads.append(t)
         if self.control_socket:
             # local operator socket (xnet unix listener): swarmctl on the
             # same host needs no TLS material (swarmd/cmd/swarmd control

@@ -181,18 +181,49 @@ def test_node_update_and_remove(api):
 
 
 def test_cluster_token_rotation(api):
+    """Rotation mints digest-pinned tokens on the replicated RootCAObj —
+    the exact fields the CA validates joins against
+    (controlapi cluster.go UpdateCluster; ca/server _role_from_token)."""
+    from swarmkit_tpu.api.objects import RootCAObj
+    from swarmkit_tpu.ca import RootCA
+    from swarmkit_tpu.ca.config import generate_join_token, parse_join_token
+
+    root = RootCA.create()
     c = Cluster(id="c1", spec=ClusterSpec(annotations=Annotations(name="default")))
+    c.root_ca = RootCAObj(
+        ca_cert_pem=root.cert_pem,
+        ca_key_pem=root.key_pem or b"",
+        cert_digest=root.digest(),
+        join_token_worker=generate_join_token(root),
+        join_token_manager=generate_join_token(root),
+    )
     api.store.update(lambda tx: tx.create(c))
     got = api.get_cluster("c1")
-    out = api.update_cluster("c1", got.meta.version, got.spec)
-    t1 = out.root_ca["join_tokens"]["worker"]
-    assert t1.startswith("SWMTKN-1-")
+    t1 = got.root_ca.join_token_worker
+    m1 = got.root_ca.join_token_manager
+
+    out = api.update_cluster("c1", got.meta.version, got.spec,
+                             rotate_worker_token=True)
+    assert out.root_ca.join_token_worker != t1
+    assert out.root_ca.join_token_worker.startswith("SWMTKN-1-")
+    # the new token pins THIS cluster's root digest (joins must validate)
+    assert parse_join_token(
+        out.root_ca.join_token_worker).root_digest == root.digest()
+    # manager token untouched without its rotation flag
+    assert out.root_ca.join_token_manager == m1
+
+    # unlock-key rotation replaces the replicated KEK; reads redact it —
+    # get_unlock_key is the sanctioned path
     out2 = api.update_cluster("c1", out.meta.version, out.spec,
-                              rotate_worker_token=True)
-    assert out2.root_ca["join_tokens"]["worker"] != t1
-    # manager token untouched without rotation flag
-    assert out2.root_ca["join_tokens"]["manager"] == \
-        out.root_ca["join_tokens"]["manager"]
+                              rotate_unlock_key=True)
+    assert out2.unlock_keys == []          # redacted on the wire
+    key = api.get_unlock_key("c1")
+    assert key
+    # the stored cluster actually carries it (server-side view)
+    raw = api.store.view().get_cluster("c1")
+    assert raw.unlock_keys and raw.unlock_keys[0].decode() == key
+    # CA signing material never leaves the control surface either
+    assert out2.root_ca.ca_key_pem == b""
 
 
 def test_list_filters(api):

@@ -280,6 +280,49 @@ def test_wrong_cert_join_rejected(cluster, tmp_path):
         foreign.stop_all()
 
 
+def test_join_token_rotation(cluster):
+    """controlapi cluster.go UpdateCluster token rotation: a rotated worker
+    token admits new joiners (digest-pinned against the cluster root) and
+    the pre-rotation token is rejected."""
+    cluster.add_manager()
+    leader = cluster.leader()
+    _, old_wtok = cluster.tokens()
+
+    ctl = cluster.control()
+    try:
+        new_wtok = None
+        for _ in range(20):   # cluster object is written by background
+            c = ctl.list_clusters()[0]   # components; retry on conflicts
+            try:
+                c = ctl.update_cluster(c.id, c.meta.version, c.spec,
+                                       rotate_worker_token=True)
+                new_wtok = c.root_ca.join_token_worker
+                break
+            except Exception as exc:
+                if "out of sequence" not in str(exc):
+                    raise
+                time.sleep(0.1)
+        assert new_wtok is not None
+    finally:
+        ctl.close()
+    assert new_wtok != old_wtok and new_wtok.startswith("SWMTKN-")
+
+    w_new = cluster._spawn("w-newtok", join_addr=leader.addr,
+                           join_token=new_wtok)
+    assert wait_for(lambda: leader.store.view(
+        lambda tx: tx.get_node(w_new.node_id)) is not None, timeout=20)
+
+    stale = SwarmNode(
+        state_dir=str(cluster.base / "w-stale"),
+        executor=FakeExecutor({"*": {"run_forever": True}},
+                              hostname="w-stale"),
+        join_addr=leader.addr, join_token=old_wtok,
+        heartbeat_period=0.5)
+    with pytest.raises(Exception) as exc_info:
+        stale.start()
+    assert "token" in str(exc_info.value).lower()
+
+
 def test_root_rotation_under_live_nodes(cluster):
     """ca/reconciler.go root rotation with the cluster live: after rotation
     every node renews onto the new root and the data plane keeps working."""

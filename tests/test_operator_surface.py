@@ -108,6 +108,58 @@ def test_autolocked_state_dir_requires_key(tmp_path):
         m2.stop()
 
 
+def test_unlock_key_rotation_reseals_manager(tmp_path):
+    """manager.go updateKEK: rotating the unlock key re-seals the manager's
+    local key material, so a restart unlocks with the NEW key and refuses
+    the old one."""
+    old_kek = b"original-unlock-key"
+    m1 = _mk_manager(tmp_path, kek=old_kek, autolock=True)
+    cluster_id = m1.manager.cluster_id
+
+    ctl = RemoteControl(m1.addr, m1.security)
+    try:
+        for _ in range(20):
+            c = ctl.list_clusters()[0]
+            try:
+                ctl.update_cluster(c.id, c.meta.version, c.spec,
+                                   rotate_unlock_key=True)
+                break
+            except Exception as exc:
+                if "out of sequence" not in str(exc):
+                    raise
+                import time
+                time.sleep(0.1)
+        new_key = ctl.get_unlock_key(cluster_id)
+        assert new_key and new_key.encode() != old_kek
+        # unlock_keys are redacted from cluster reads
+        assert ctl.list_clusters()[0].unlock_keys == []
+    finally:
+        ctl.close()
+
+    # the running manager adopts the rotated KEK and re-seals on disk
+    assert wait_for(lambda: m1.kek == new_key.encode(), timeout=15)
+    m1.stop()
+
+    # old key no longer opens the state dir; the rotated one does
+    locked = SwarmNode(
+        state_dir=str(tmp_path / "m1"),
+        executor=FakeExecutor({"*": {"run_forever": True}}, hostname="m1"),
+        listen_addr="127.0.0.1:0", tick_interval=0.05, kek=old_kek)
+    with pytest.raises(Exception):
+        locked.start()
+
+    m2 = SwarmNode(
+        state_dir=str(tmp_path / "m1"),
+        executor=FakeExecutor({"*": {"run_forever": True}}, hostname="m1"),
+        listen_addr="127.0.0.1:0", tick_interval=0.05,
+        kek=new_key.encode())
+    m2.start()
+    try:
+        assert wait_for(lambda: m2.is_leader, timeout=20)
+    finally:
+        m2.stop()
+
+
 def test_generic_resources_advertised_and_schedulable(tmp_path):
     m1 = _mk_manager(tmp_path, generic_resources={"gpu": 2})
     try:
