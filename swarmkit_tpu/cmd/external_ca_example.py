@@ -1,0 +1,90 @@
+"""external-ca-example: a demo cfssl-compatible signing server.
+
+Counterpart of the reference's swarmd/cmd/external-ca-example: an operator
+CA service that holds the cluster root's SIGNING key outside the managers.
+swarmd runs with `--external-ca url=http://…/sign` and a root cert whose
+key lives only here; managers forward CSRs and publish the returned certs.
+
+    # mint a root (or point at an existing one) and serve it
+    python -m swarmkit_tpu.cmd.external_ca_example \
+        --state-dir /tmp/extca --listen 127.0.0.1:8989
+
+    # the manager then bootstraps against the SAME root:
+    #   ca.pem is written into --state-dir for distribution
+
+Protocol (what ca/external.py speaks): POST {"certificate_request": pem}
+→ {"success": true, "result": {"certificate": pem}}.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="external-ca-example")
+    ap.add_argument("--state-dir", required=True,
+                    help="holds rootca.pem / rootca.key (created if absent)")
+    ap.add_argument("--listen", default="127.0.0.1:0", help="host:port")
+    ap.add_argument("--org", default="swarmkit-tpu")
+    args = ap.parse_args(argv)
+
+    from ..ca import RootCA
+
+    os.makedirs(args.state_dir, exist_ok=True)
+    cert_path = os.path.join(args.state_dir, "rootca.pem")
+    key_path = os.path.join(args.state_dir, "rootca.key")
+    if os.path.exists(cert_path) and os.path.exists(key_path):
+        with open(cert_path, "rb") as f:
+            cert_pem = f.read()
+        with open(key_path, "rb") as f:
+            key_pem = f.read()
+        root = RootCA(cert_pem, key_pem)
+    else:
+        root = RootCA.create(args.org)
+        with open(cert_path, "wb") as f:
+            f.write(root.cert_pem)
+        fd = os.open(key_path, os.O_WRONLY | os.O_CREAT, 0o600)
+        with os.fdopen(fd, "wb") as f:
+            f.write(root.key_pem or b"")
+
+    class Signer(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            try:
+                body = json.loads(
+                    self.rfile.read(int(self.headers["Content-Length"])))
+                csr = body["certificate_request"].encode()
+                cert = root.sign_csr(csr)
+                out = {"success": True,
+                       "result": {"certificate": cert.decode()}}
+                code = 200
+            except Exception as exc:
+                out = {"success": False, "errors": [str(exc)]}
+                code = 400
+            payload = json.dumps(out).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+    host, _, port = args.listen.rpartition(":")
+    httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)), Signer)
+    addr = "%s:%d" % httpd.server_address[:2]
+    print(f"EXTERNAL_CA_READY url=http://{addr}/sign ca={cert_path}",
+          flush=True)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -146,3 +146,64 @@ def test_swarmbench_and_rafttool(daemon):
         capture_output=True, text=True, env=_env(), cwd=REPO, timeout=60)
     assert r.returncode == 0, r.stderr
     assert '"default"' in r.stdout
+
+
+def test_external_ca_example_server(tmp_path):
+    """The demo external CA (swarmd/cmd/external-ca-example): mints a root,
+    serves cfssl-style /sign, and the ExternalCA client gets back certs
+    chaining to the published root."""
+    import shutil
+
+    state = str(tmp_path / "extca")
+    logf = open(tmp_path / "extca.out", "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "swarmkit_tpu.cmd.external_ca_example",
+         "--state-dir", state, "--listen", "127.0.0.1:0"],
+        stdout=logf, stderr=subprocess.STDOUT, env=_env(), cwd=REPO)
+    try:
+        url = None
+        end = time.monotonic() + 30
+        while time.monotonic() < end:
+            log = open(tmp_path / "extca.out").read()
+            m = re.search(r"url=(\S+)", log)
+            if m:
+                url = m.group(1)
+                break
+            assert proc.poll() is None, log
+            time.sleep(0.2)
+        assert url
+
+        sys.path.insert(0, REPO)
+        from swarmkit_tpu.api.types import NodeRole
+        from swarmkit_tpu.ca import RootCA, create_csr
+        from swarmkit_tpu.ca.external import ExternalCA
+
+        with open(os.path.join(state, "rootca.pem"), "rb") as f:
+            root = RootCA(f.read())
+        _, csr = create_csr("node-x", NodeRole.WORKER, "swarmkit-tpu")
+        cert = ExternalCA(url).sign(csr)
+        assert root.verify_cert(cert).node_id == "node-x"
+        # restart reuses the SAME root from the state dir
+        proc.terminate()
+        proc.wait(timeout=5)
+        proc2 = subprocess.Popen(
+            [sys.executable, "-m", "swarmkit_tpu.cmd.external_ca_example",
+             "--state-dir", state, "--listen", "127.0.0.1:0"],
+            stdout=logf, stderr=subprocess.STDOUT, env=_env(), cwd=REPO)
+        try:
+            with open(os.path.join(state, "rootca.pem"), "rb") as f:
+                assert f.read() == root.cert_pem
+        finally:
+            proc2.terminate()
+            try:
+                proc2.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc2.kill()
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        shutil.rmtree(state, ignore_errors=True)
