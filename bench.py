@@ -352,6 +352,13 @@ def main():
             constraint_heavy=True),
         "binpack_10k_x_1k": bench_grid_config(
             np, jnp, placement_ops, batch, 1_000, 10_000, 50, binpack=True),
+        # the reference benchScheduler grid (scheduler_test.go:3187-3209)
+        "grid_10k_x_1k": bench_grid_config(
+            np, jnp, placement_ops, batch, 1_000, 10_000, 20),
+        "grid_100k_x_1k": bench_grid_config(
+            np, jnp, placement_ops, batch, 1_000, 100_000, 20),
+        "grid_100k_x_10k": bench_grid_config(
+            np, jnp, placement_ops, batch, 10_000, 100_000, 20),
         "grid_1m_x_10k": bench_grid_config(
             np, jnp, placement_ops, batch, 10_000, 1_000_000, 100),
         "global_diff_50svc_x_10k": bench_global_diff(np, jnp),
