@@ -1,0 +1,113 @@
+"""The flagship assembled model: one jitted cluster step.
+
+This is the TPU program the whole framework funnels into — the analogue of
+a model's forward pass. One `jit` fuses the two batched control-plane
+kernels (SURVEY.md §2.12 / BASELINE.json north star):
+
+  * placement — the scheduler hot loop (manager/scheduler/scheduler.go
+    tick) as the masked canonical water-fill over every pending task group
+    (`ops.placement.schedule_groups`);
+  * consensus replay — the raft quorum tally + commit-frontier advance
+    over the simulated manager mesh (`ops.raft_replay`).
+
+`example_inputs()` builds a self-contained synthetic cluster (no test
+fixtures), so the compile surface is reproducible anywhere the package is
+importable. `__graft_entry__.entry()` exposes exactly this step.
+"""
+from __future__ import annotations
+
+import random
+
+
+def cluster_step(acks, quorum, *placement_args):
+    """One cluster step: place all pending groups, advance the commit
+    frontier. Jittable as a whole; inputs follow
+    `scheduler.encode.KERNEL_ARG_FIELDS` for the placement side."""
+    from ..ops.placement import schedule_groups
+    from ..ops.raft_replay import replay_commit
+
+    counts, totals, svc_counts = schedule_groups(*placement_args)
+    commit_index, _committed = replay_commit(acks, quorum)
+    return counts, totals, commit_index
+
+
+def example_cluster(n_nodes: int = 256, n_groups: int = 4,
+                    tasks_per_group: int = 64, seed: int = 0):
+    """A synthetic (node_infos, task_groups) pair: labeled, resourced READY
+    nodes and constrained task groups — the shapes the encoder feeds the
+    flagship step."""
+    from ..api.objects import Node, Task
+    from ..api.specs import (
+        Annotations,
+        NodeDescription,
+        Placement,
+        Platform,
+        Resources,
+    )
+    from ..api.types import NodeAvailability, NodeStatusState, TaskState
+    from ..scheduler.encode import CPU_QUANTUM, MEM_QUANTUM, TaskGroup
+    from ..scheduler.nodeinfo import NodeInfo
+
+    rng = random.Random(seed)
+    infos = []
+    for i in range(n_nodes):
+        n = Node(id=f"node-{i:05d}")
+        n.status.state = NodeStatusState.READY
+        n.status.addr = f"10.1.{i % 250}.{(i * 7) % 250}"
+        n.spec.availability = NodeAvailability.ACTIVE
+        n.spec.annotations = Annotations(
+            name=f"node-{i}",
+            labels={"zone": "abc"[i % 3], "disk": ("ssd", "hdd")[i % 2]})
+        n.description = NodeDescription(
+            hostname=f"host-{i}",
+            platform=Platform(os="linux", architecture="amd64"),
+            resources=Resources(
+                nano_cpus=rng.randint(4, 16) * CPU_QUANTUM * 1000,
+                memory_bytes=rng.randint(8, 64) * MEM_QUANTUM * 1024,
+            ),
+        )
+        infos.append(NodeInfo.new(n, {}, n.description.resources.copy()))
+
+    groups = []
+    for gi in range(n_groups):
+        svc = f"svc-{gi:03d}"
+        tasks = []
+        spec = None
+        for ti in range(tasks_per_group):
+            t = Task(id=f"task-{gi:03d}-{ti:05d}", service_id=svc,
+                     slot=ti + 1)
+            t.desired_state = TaskState.RUNNING
+            t.status.state = TaskState.PENDING
+            if spec is None:
+                spec = t.spec
+                spec.resources.reservations.nano_cpus = \
+                    (gi % 3) * CPU_QUANTUM
+                spec.resources.reservations.memory_bytes = \
+                    (gi % 4) * MEM_QUANTUM
+                if gi % 2 == 0:
+                    spec.placement = Placement(
+                        constraints=[f"node.labels.zone == {'abc'[gi % 3]}"])
+            else:
+                t.spec = spec
+            tasks.append(t)
+        groups.append(TaskGroup(service_id=svc, spec_version=1, tasks=tasks))
+    return infos, groups
+
+
+def example_inputs(n_nodes: int = 256, n_groups: int = 4,
+                   tasks_per_group: int = 64, n_managers: int = 5,
+                   log_len: int = 1024, seed: int = 0):
+    """(acks, quorum, *placement_args) ready for `cluster_step`."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..scheduler.encode import encode, kernel_args
+
+    infos, groups = example_cluster(n_nodes, n_groups, tasks_per_group, seed)
+    p = encode(infos, groups)
+    placement_args = tuple(jnp.asarray(a) for a in kernel_args(p))
+
+    rng = np.random.RandomState(seed)
+    acks = jnp.asarray(rng.rand(n_managers, log_len) < 0.8)
+    quorum = jnp.asarray(np.int32(n_managers // 2 + 1))
+    return (acks, quorum) + placement_args

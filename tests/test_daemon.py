@@ -94,7 +94,7 @@ def test_single_manager_service_over_wire(tmp_path, cluster_nodes):
         spec = ServiceSpec(annotations=Annotations(name="web"), replicas=3)
         svc = ctl.create_service(spec)
         assert wait_for(lambda: _running_count(m1.store, svc.id) == 3,
-                        timeout=20)
+                        timeout=45)
         # the manager's own agent ran them (managers run workloads too)
         listed = ctl.list_services()
         assert [s.id for s in listed] == [svc.id]
@@ -125,7 +125,7 @@ def test_worker_join_and_schedule(tmp_path, cluster_nodes):
         spec = ServiceSpec(annotations=Annotations(name="spread"), replicas=6)
         svc = ctl.create_service(spec)
         assert wait_for(lambda: _running_count(m1.store, svc.id) == 6,
-                        timeout=20)
+                        timeout=45)
         # both nodes actually run tasks (spread over 2 nodes)
         from swarmkit_tpu.store import by
 
@@ -250,7 +250,7 @@ def test_restarted_manager_rejoins_from_state_dir(tmp_path, cluster_nodes):
             ServiceSpec(annotations=Annotations(name="durable"), replicas=2))
     finally:
         ctl.close()
-    assert wait_for(lambda: _running_count(m1.store, svc.id) == 2, timeout=20)
+    assert wait_for(lambda: _running_count(m1.store, svc.id) == 2, timeout=45)
 
     # restart m2 from its state dir: same identity, same raft id, catches up
     old_id, old_raft_id = m2.node_id, m2.raft_id
@@ -289,7 +289,7 @@ def test_restarted_manager_rejoins_from_state_dir(tmp_path, cluster_nodes):
         got = m2b.store.view(lambda tx: tx.get_service(svc.id))
         return got is not None
 
-    assert wait_for(caught_up, timeout=20)
+    assert wait_for(caught_up, timeout=45)
 
 
 def test_worker_promotion_and_demotion_over_wire(tmp_path, cluster_nodes):
@@ -339,7 +339,7 @@ def test_worker_promotion_and_demotion_over_wire(tmp_path, cluster_nodes):
 
     assert wait_for(lambda: w1.manager is not None and w1.raft is not None,
                     timeout=40), "worker never became a manager"
-    assert wait_for(lambda: len(m1.raft.members) == 2, timeout=20)
+    assert wait_for(lambda: len(m1.raft.members) == 2, timeout=45)
     assert wait_for(
         lambda: w1.security.role() == NodeRole.MANAGER, timeout=10)
 
@@ -348,7 +348,7 @@ def test_worker_promotion_and_demotion_over_wire(tmp_path, cluster_nodes):
         return (w1.store is not None
                 and w1.store.view(lambda tx: tx.find_clusters()))
 
-    assert wait_for(replicated, timeout=20)
+    assert wait_for(replicated, timeout=45)
 
     # demote: quorum shrinks back, stack tears down, cert returns to worker
     set_role(w1.node_id, NodeRole.WORKER)
@@ -357,12 +357,12 @@ def test_worker_promotion_and_demotion_over_wire(tmp_path, cluster_nodes):
     assert wait_for(lambda: w1.manager is None and w1.raft is None,
                     timeout=40)
     assert wait_for(
-        lambda: w1.security.role() == NodeRole.WORKER, timeout=20)
+        lambda: w1.security.role() == NodeRole.WORKER, timeout=45)
 
     # re-promotion joins cleanly (the raft state dir was wiped on
     # demotion; a stale WAL would poison the fresh raft id)
     set_role(w1.node_id, NodeRole.MANAGER)
     assert wait_for(lambda: w1.manager is not None and w1.raft is not None,
                     timeout=40)
-    assert wait_for(lambda: len(m1.raft.members) == 2, timeout=20)
-    assert wait_for(lambda: replicated(), timeout=20)
+    assert wait_for(lambda: len(m1.raft.members) == 2, timeout=45)
+    assert wait_for(lambda: replicated(), timeout=45)
