@@ -146,6 +146,47 @@ def test_secret_events_dirty_only_referencing_sessions(store):
         d.stop()
 
 
+def test_dirtying_stays_targeted_at_scale(store):
+    """Per-node assignment-set maintenance (assignments.go:21-81): with
+    hundreds of live sessions, a task event dirties exactly its node and a
+    secret event dirties exactly the sessions that were shipped it — never
+    the whole session table (the 10k-node design point collapses
+    otherwise)."""
+    N = 300
+    for i in range(N):
+        _mk_node(store, f"n{i:03d}")
+    d = Dispatcher(store, heartbeat_period=60.0, rate_limit_period=0.0)
+    d.start()
+    try:
+        for i in range(N):
+            nid = f"n{i:03d}"
+            sid = d.register(nid)
+            d._full_assignment(d._sessions[nid])
+        with d._lock:
+            d._dirty_nodes.clear()
+
+        # a task event touches exactly one session
+        _mk_task(store, "t-one", "n007")
+        assert wait_for(lambda: "n007" in d._dirty_nodes, timeout=5)
+        with d._lock:
+            assert d._dirty_nodes <= {"n007"}
+            d._dirty_nodes.clear()
+
+        # a secret event touches nobody (no session was shipped it)
+        s = Secret(id="sx", spec=SecretSpec(
+            annotations=Annotations(name="sx"), data=b"v"))
+        store.update(lambda tx: tx.create(s))
+        s2 = store.view(lambda tx: tx.get_secret("sx")).copy()
+        s2.spec.data = b"v2"
+        store.update(lambda tx: tx.update(s2))
+        time.sleep(0.4)
+        with d._lock:
+            dirty = set(d._dirty_nodes)
+        assert dirty <= {"n007"}   # only the task event's node, ever
+    finally:
+        d.stop()
+
+
 def test_updated_secret_reships_incrementally(store):
     """A rotated secret (version bump) must reach agents that already hold
     it via an INCREMENTAL update — id-presence diffing would silently keep
