@@ -107,13 +107,29 @@ def cmd_service_create(args):
         mode=ServiceMode(args.mode),
     )
     spec.task.placement.constraints = list(args.constraint or [])
+    ctl = _control(args)
+    for ref in args.network or []:
+        n = _find_network(ctl, ref)
+        from ..api.specs import NetworkAttachmentConfig
+
+        spec.task.networks.append(NetworkAttachmentConfig(target=n.id))
+    for pub in args.publish or []:
+        # TARGET[:PUBLISHED][/PROTOCOL], docker-style
+        from ..api.specs import PortConfig
+
+        body, _, proto = pub.partition("/")
+        target, _, published = body.partition(":")
+        spec.endpoint.ports.append(PortConfig(
+            protocol=proto or "tcp",
+            target_port=int(target),
+            published_port=int(published) if published else 0,
+            publish_mode=args.publish_mode))
     if args.update_parallelism or args.update_delay:
         spec.update = UpdateConfig(
             parallelism=args.update_parallelism or 1,
             delay=args.update_delay or 0.0)
     if args.mode in ("replicated_job", "global_job"):
         spec.job = JobSpec(total_completions=args.replicas)
-    ctl = _control(args)
     svc = ctl.create_service(spec)
     print(svc.id)
 
@@ -578,6 +594,12 @@ def main(argv=None) -> int:
     p.add_argument("--constraint", action="append")
     p.add_argument("--label", action="append")
     p.add_argument("--env", action="append")
+    p.add_argument("--network", action="append",
+                   help="attach to a network (name or id); repeatable")
+    p.add_argument("--publish", action="append", metavar="TARGET[:PUB][/P]",
+                   help="publish a port, e.g. 80, 80:8080, 53:53/udp")
+    p.add_argument("--publish-mode", default="ingress",
+                   choices=["ingress", "host"])
     p.add_argument("--update-parallelism", type=int, default=None)
     p.add_argument("--update-delay", type=float, default=None)
     p.set_defaults(func=cmd_service_create)
