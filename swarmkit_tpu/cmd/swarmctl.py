@@ -174,6 +174,12 @@ def cmd_service_update(args):
     ctl = _control(args)
     s = _find_service(ctl, args.service)
     spec = s.spec
+    if getattr(args, "rollback", False):
+        # revert to previous_spec (service.go UpdateService rollback)
+        updated = ctl.update_service(s.id, s.meta.version, spec,
+                                     rollback=True)
+        print(updated.id)
+        return
     if args.replicas is not None:
         spec.replicas = args.replicas
     if args.command is not None or args.image is not None:
@@ -187,6 +193,18 @@ def cmd_service_update(args):
             spec.task.runtime.command = shlex.split(args.command)
         if args.image is not None:
             spec.task.runtime.image = args.image
+    if args.update_parallelism is not None or args.update_delay is not None \
+            or args.update_order is not None:
+        from ..api.specs import UpdateConfig, UpdateOrder
+
+        cfg = spec.update or UpdateConfig()
+        if args.update_parallelism is not None:
+            cfg.parallelism = args.update_parallelism
+        if args.update_delay is not None:
+            cfg.delay = args.update_delay
+        if args.update_order is not None:
+            cfg.order = UpdateOrder(args.update_order.replace("-", "_"))
+        spec.update = cfg
     if args.force:
         spec.task.force_update += 1
     updated = ctl.update_service(s.id, s.meta.version, spec)
@@ -614,6 +632,12 @@ def main(argv=None) -> int:
     p.add_argument("--command", default=None)
     p.add_argument("--image", default=None)
     p.add_argument("--force", action="store_true")
+    p.add_argument("--rollback", action="store_true",
+                   help="revert to the previous service spec")
+    p.add_argument("--update-parallelism", type=int, default=None)
+    p.add_argument("--update-delay", type=float, default=None)
+    p.add_argument("--update-order", default=None,
+                   choices=["stop-first", "start-first"])
     p.set_defaults(func=cmd_service_update)
     p = svc.add_parser("rm")
     p.add_argument("service")
