@@ -142,21 +142,40 @@ class Allocator(EventLoopComponent):
         for n in networks:
             state = n.driver_state or {}
             if isinstance(state, dict) and state.get("subnet"):
-                self.ipam.add_network(n.id, state["subnet"])
+                try:
+                    self.ipam.add_network(n.id, state["subnet"])
+                except (IPAMError, ValueError):
+                    # a bad persisted subnet (a /32 accepted before the size
+                    # check existed, or corrupted state) must not abort the
+                    # whole rebuild — every later pool/VIP/attachment
+                    # reservation would be skipped and a fresh leader would
+                    # double-assign
+                    log.warning("skipping unusable persisted subnet %s for "
+                                "network %s", state["subnet"], n.id)
+        def reserve(net_id, addr):
+            # same tolerance as the pool loop above: one corrupted persisted
+            # address (outside its subnet, or garbage) must not abort the
+            # remaining reservations
+            try:
+                self.ipam.reserve(net_id, addr)
+            except (IPAMError, ValueError):
+                log.warning("skipping unusable persisted address %s on "
+                            "network %s", addr, net_id)
+
         for s in services:
             if s.endpoint:
                 for net_id, addr in s.endpoint.get("virtual_ips", []):
-                    self.ipam.reserve(net_id, addr)
+                    reserve(net_id, addr)
         for t in all_tasks:
             for att in t.networks or []:
-                if isinstance(att, dict):
+                if isinstance(att, dict) and att.get("network_id"):
                     for addr in att.get("addresses", []):
-                        self.ipam.reserve(att["network_id"], addr)
+                        reserve(att["network_id"], addr)
         for node in nodes:
             for att in node.attachments or []:
-                if isinstance(att, dict):
+                if isinstance(att, dict) and att.get("network_id"):
                     for addr in att.get("addresses", []):
-                        self.ipam.reserve(att["network_id"], addr)
+                        reserve(att["network_id"], addr)
 
         for n in networks:
             self._allocate_network(n.id)
@@ -326,7 +345,11 @@ class Allocator(EventLoopComponent):
                 return
             state = n.driver_state if isinstance(n.driver_state, dict) else None
             if state is not None and state.get("subnet"):
-                self.ipam.add_network(n.id, state["subnet"])  # idempotent
+                try:
+                    self.ipam.add_network(n.id, state["subnet"])  # idempotent
+                except (IPAMError, ValueError) as exc:
+                    log.warning("network %s: unusable persisted subnet %s: "
+                                "%s", network_id, state["subnet"], exc)
                 return
             n = n.copy()
             wanted = (n.spec.ipam or {}).get("subnet") if n.spec.ipam else None

@@ -19,6 +19,24 @@ class IPAMError(Exception):
     pass
 
 
+def validate_subnet(subnet: str) -> ipaddress.IPv4Network:
+    """Parse and validate an operator-specified subnet. The single source
+    of truth for the minimum size — the control API calls this at network
+    create time so allocation can't later fail on a subnet the API
+    accepted."""
+    try:
+        net = ipaddress.ip_network(subnet, strict=False)
+    except ValueError as exc:
+        raise IPAMError(f"invalid subnet {subnet!r}: {exc}")
+    # gateway is network+1 and hosts start at network+2, so anything
+    # smaller than /30 has no allocatable host address
+    if net.num_addresses < 4:
+        raise IPAMError(
+            f"subnet {net} too small: need at least a /30 "
+            "(gateway + one host address)")
+    return net
+
+
 class _Pool:
     def __init__(self, subnet: ipaddress.IPv4Network):
         self.subnet = subnet
@@ -73,7 +91,7 @@ class IPAM:
             if pool is not None:
                 return str(pool.subnet), pool.gateway
             if subnet:
-                net = ipaddress.ip_network(subnet, strict=False)
+                net = validate_subnet(subnet)
             else:
                 net = self._next_free_subnet()
             pool = _Pool(net)

@@ -179,6 +179,7 @@ class CAServer:
                 cert_role = role if role is not None else (
                     node.certificate.role if node.certificate else node.role
                 )
+                node = node.copy()
                 node.certificate = NodeCertificate(
                     role=cert_role,
                     csr_pem=csr_pem,
@@ -317,6 +318,7 @@ class CAServer:
                     return  # raced with rotation start/finish: next pass
                 if rot0 is None and signing_root is not self.root:
                     return  # raced with a trust swap: re-signed next pass
+                n = n.copy()
                 n.certificate.certificate_pem = cert_pem
                 n.certificate.status_state = state
                 n.certificate.status_err = err
@@ -335,10 +337,18 @@ class CAServer:
     # object; the signing loop immediately issues under the NEW key with
     # the intermediate appended (old-pinned nodes validate through the
     # cross-signature), while the published trust bundle carries BOTH
-    # anchors. The reconciler re-marks stragglers and FINISHES — swapping
-    # the trust anchor, digest, and join tokens — only when every node
-    # certificate chains to the new root. No node is ever wedged: at every
-    # instant each node trusts whichever root its peers' certs carry.
+    # anchors. Unlike the reference reconciler (which force-marks straggler
+    # certs ROTATE server-side), completion here is CLIENT-driven: each node
+    # observes the multi-anchor bundle and re-CSRs itself
+    # (node/daemon.py _ensure_rotation_renewal) — the epoch check below
+    # requires a post-rotation CSR, which a server-side re-sign of a stale
+    # CSR could never satisfy. The reconciler FINISHES — swapping the trust
+    # anchor, digest, and join tokens — only when every node certificate
+    # chains to the new root under the current epoch; down nodes hold the
+    # rotation open (surfaced via rate-limited warnings) until the operator
+    # removes them, matching `docker swarm ca --rotate` semantics. No node
+    # is ever wedged: at every instant each node trusts whichever root its
+    # peers' certs carry.
 
     def _rotation(self):
         cluster = self.store.view(
@@ -389,6 +399,7 @@ class CAServer:
         def txn(tx):
             cluster = tx.get_cluster(self.cluster_id)
             if cluster is not None and cluster.root_ca is not None:
+                cluster = cluster.copy()
                 cluster.root_ca.root_rotation = {
                     "new_ca_cert_pem": new_root.cert_pem,
                     "new_ca_key_pem": new_root.key_pem or b"",
@@ -451,6 +462,7 @@ class CAServer:
                 return
             from .config import generate_join_token
 
+            cluster = cluster.copy()
             cluster.root_ca.ca_cert_pem = full_new_root.cert_pem
             cluster.root_ca.ca_key_pem = full_new_root.key_pem or b""
             cluster.root_ca.cert_digest = full_new_root.digest()

@@ -596,6 +596,17 @@ class ControlAPI:
     # -------------------------------------------------------------- networks
     def create_network(self, spec: NetworkSpec) -> Network:
         self._validate_annotations(spec.annotations)
+        # reject bad operator subnets at the API so the failure is visible
+        # immediately, not a background allocator warning (the reference
+        # validates IPAM pools at create time too)
+        wanted = (spec.ipam or {}).get("subnet") if spec.ipam else None
+        if wanted:
+            from ..allocator.ipam import IPAMError, validate_subnet
+
+            try:
+                validate_subnet(wanted)
+            except IPAMError as exc:
+                raise InvalidArgument(str(exc))
         net = Network(id=new_id(), spec=spec)
 
         def cb(tx):

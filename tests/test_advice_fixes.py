@@ -7,6 +7,12 @@
    minting a fresh, untrusted root
 4. issue_node_certificate decides existence/renewal-authz inside the txn
 5. a renewed cert is never paired with a mismatched key
+
+Round-2 advisor findings:
+
+6. CA server txns copy store objects before mutating (live-reference
+   invariant) — snapshots taken before a write never see the write
+7. IPAM rejects operator subnets too small to hold a host address
 """
 import msgpack
 import pytest
@@ -224,3 +230,91 @@ def test_update_tls_credentials_rejects_mismatched_key():
         sec.update_tls_credentials(key_b, cert_a)
     # matching pair is accepted
     sec.update_tls_credentials(key_a, cert_a)
+
+
+# --------------------------------------- 6. CA txns copy before mutating
+
+
+def test_ca_server_txns_copy_store_objects():
+    mgr = Manager(store=MemoryStore(), org="test-org")
+    mgr.start()
+    try:
+        cluster_before = mgr.store.view(
+            lambda tx: tx.get_cluster(mgr.cluster_id))
+        assert cluster_before.root_ca.root_rotation is None
+        epoch_before = cluster_before.root_ca.last_forced_rotation
+
+        # rotation start must not mutate previously-fetched live references
+        mgr.ca_server.rotate_root_ca()
+        assert cluster_before.root_ca.root_rotation is None
+        assert cluster_before.root_ca.last_forced_rotation == epoch_before
+
+        # renewal CSR recording must not mutate the live node reference
+        token = mgr.store.view(
+            lambda tx: tx.get_cluster(
+                mgr.cluster_id)).root_ca.join_token_worker
+        _, csr1 = create_csr("nC", NodeRole.WORKER, "test-org")
+        mgr.ca_server.issue_node_certificate(csr1, token=token,
+                                             node_id="nC")
+        node_before = mgr.store.view(lambda tx: tx.get_node("nC"))
+        _, csr2 = create_csr("nC", NodeRole.WORKER, "test-org")
+        caller = Caller(node_id="nC", role=NodeRole.WORKER, org="test-org")
+        mgr.ca_server.issue_node_certificate(csr2, node_id="nC",
+                                             caller=caller)
+        assert node_before.certificate.csr_pem == csr1
+    finally:
+        mgr.stop()
+
+
+def test_ca_signer_copies_node_before_publishing_cert():
+    # unstarted CAServer: no background signer thread to race the check
+    from swarmkit_tpu.api.types import IssuanceState
+    from swarmkit_tpu.ca.server import CAServer
+
+    boot = SecurityConfig.bootstrap_manager(org="test-org")
+    store = MemoryStore()
+
+    def seed(tx):
+        c = Cluster(id="c1",
+                    spec=ClusterSpec(annotations=Annotations(name="default")))
+        c.root_ca = RootCAObj(
+            ca_key_pem=boot.root_ca.key_pem,
+            ca_cert_pem=boot.root_ca.cert_pem,
+            cert_digest=boot.root_ca.digest(),
+            join_token_worker=generate_join_token(boot.root_ca),
+            join_token_manager=generate_join_token(boot.root_ca),
+        )
+        tx.create(c)
+
+    store.update(seed)
+    server = CAServer(store, boot.root_ca, "c1", org="test-org")
+    token = store.view(
+        lambda tx: tx.get_cluster("c1")).root_ca.join_token_worker
+    _, csr = create_csr("nS", NodeRole.WORKER, "test-org")
+    server.issue_node_certificate(csr, token=token, node_id="nS")
+    node_pending = store.view(lambda tx: tx.get_node("nS"))
+    assert node_pending.certificate.status_state == IssuanceState.PENDING
+    server._sign_pending()
+    # the pre-sign snapshot must not have been mutated in place
+    assert node_pending.certificate.status_state == IssuanceState.PENDING
+    node_after = store.view(lambda tx: tx.get_node("nS"))
+    assert node_after.certificate.status_state == IssuanceState.ISSUED
+
+
+# ------------------------------------------------- 7. IPAM tiny subnets
+
+
+def test_ipam_rejects_subnets_without_host_room():
+    from swarmkit_tpu.allocator.ipam import IPAM, IPAMError
+
+    ipam = IPAM()
+    for cidr in ("10.9.0.0/31", "10.9.0.1/32"):
+        with pytest.raises(IPAMError):
+            ipam.add_network(f"net-{cidr}", subnet=cidr)
+    # a /30 holds exactly gateway + one host
+    subnet, gw = ipam.add_network("net30", subnet="10.9.0.0/30")
+    assert (subnet, gw) == ("10.9.0.0/30", "10.9.0.1")
+    addr = ipam.allocate("net30")
+    assert addr == "10.9.0.2"
+    with pytest.raises(IPAMError):
+        ipam.allocate("net30")

@@ -151,6 +151,13 @@ def test_config_and_network(api):
     with pytest.raises(AlreadyExists):
         api.create_network(NetworkSpec(annotations=Annotations(name="ing2"),
                                        ingress=True))
+    # operator subnets too small (or malformed) are rejected at the API,
+    # not deferred to a background allocator warning
+    for bad in ("10.5.0.0/31", "10.5.0.1/32", "garbage"):
+        with pytest.raises(InvalidArgument):
+            api.create_network(NetworkSpec(
+                annotations=Annotations(name="tiny"),
+                ipam={"subnet": bad}))
 
 
 def test_node_update_and_remove(api):

@@ -73,6 +73,38 @@ def test_ipam_pools_and_exhaustion():
     assert not ipaddress.ip_network(s2).overlaps(ipaddress.ip_network(s3))
 
 
+def test_restore_tolerates_bad_persisted_subnet(store):
+    # a /32 persisted before the subnet-size check existed must not abort
+    # the whole IPAM rebuild on the next leadership change
+    bad = Network(id="nbad", spec=NetworkSpec(
+        annotations=Annotations(name="bad")))
+    bad.driver_state = {"subnet": "10.8.0.1/32", "gateway": "10.8.0.1"}
+    corrupt = Network(id="ncorrupt", spec=NetworkSpec(
+        annotations=Annotations(name="corrupt")))
+    corrupt.driver_state = {"subnet": "garbage", "gateway": ""}
+    good = Network(id="ngood", spec=NetworkSpec(
+        annotations=Annotations(name="good")))
+    good.driver_state = {"subnet": "172.21.0.0/24", "gateway": "172.21.0.1"}
+    store.update(lambda tx: (tx.create(bad), tx.create(corrupt),
+                             tx.create(good)))
+    # a service on the GOOD network: its VIP must still be allocated even
+    # though earlier networks in the snapshot have unusable subnets
+    _mk_service(store, "svcg", networks=("ngood",))
+    a = Allocator(store)
+    a.start()
+    try:
+        assert wait_for(lambda: a.ipam.has_network("ngood"), timeout=5)
+        assert not a.ipam.has_network("nbad")
+        assert not a.ipam.has_network("ncorrupt")
+
+        def vip_allocated():
+            s = store.view(lambda tx: tx.get_service("svcg"))
+            return s.endpoint and s.endpoint.get("virtual_ips")
+        assert wait_for(vip_allocated, timeout=5)
+    finally:
+        a.stop()
+
+
 def test_network_gets_subnet_and_gateway(store):
     _mk_network(store, subnet="172.20.0.0/24")
     a = Allocator(store)
