@@ -10,6 +10,7 @@ Also hosts root rotation entry points (ca/reconciler.go).
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 
@@ -27,6 +28,9 @@ from ..utils.identity import new_id
 from .auth import PermissionDenied
 from .certificates import CertificateError, RootCA
 from .config import InvalidToken, parse_join_token
+from ..utils.leadership import leadership_lost
+
+log = logging.getLogger("swarmkit_tpu.ca")
 
 
 class CAServer:
@@ -43,9 +47,7 @@ class CAServer:
         # CAConfig.NodeCertExpiry); None == the compiled default
         self.cert_expiry = cert_expiry
         if cert_expiry and external_ca is not None:
-            import logging
-
-            logging.getLogger("swarmkit_tpu.ca").warning(
+            log.warning(
                 "--cert-expiry has no effect with an external CA: the "
                 "external service controls issued certificate lifetimes")
         # optional ca.external.ExternalCA: signing delegates to the
@@ -72,7 +74,9 @@ class CAServer:
 
     def _run(self):
         """Snapshot-then-watch over nodes with pending certs
-        (ca/server.go Run:356-476)."""
+        (ca/server.go Run:356-476). A ProposeError/NotLeader escaping the
+        signing or reconcile pass means this manager was demoted — exit
+        cleanly; the manager's leadership handler stop()s us anyway."""
         queue = self.store.watch_queue()
         ch = queue.watch()
         try:
@@ -103,6 +107,10 @@ class CAServer:
                     ):
                         self._sign_pending()
                         self._reconcile_rotation()
+        except Exception as exc:
+            if not leadership_lost(exc):
+                raise
+            log.info("ca-server: leadership lost; stopping signer loop")
         finally:
             queue.stop_watch(ch)
 
@@ -325,7 +333,15 @@ class CAServer:
                 n.role = n.certificate.role  # observed role follows the cert
                 tx.update(n)
 
-            self.store.update(txn)
+            try:
+                self.store.update(txn)
+            except Exception as exc:
+                if leadership_lost(exc):
+                    raise  # _run treats this as a clean-shutdown signal
+                # transient propose failure: the cert stays PENDING and the
+                # next signing pass retries this node
+                log.warning("publishing cert for %s failed transiently: %s",
+                            node.id, exc)
         if pending:
             with self._status_cond:
                 self._status_cond.notify_all()
@@ -445,9 +461,7 @@ class CAServer:
             now = time.monotonic()
             if now - getattr(self, "_last_rotation_log", 0) > 30:
                 self._last_rotation_log = now
-                import logging
-
-                logging.getLogger("swarmkit_tpu.ca").warning(
+                log.warning(
                     "root rotation waiting on %d node(s): %s",
                     len(waiting), ", ".join(sorted(waiting)[:5]))
             return
@@ -473,5 +487,12 @@ class CAServer:
             cluster.root_ca.root_rotation = None
             tx.update(cluster)
 
-        self.store.update(finish)
+        try:
+            self.store.update(finish)
+        except Exception as exc:
+            if leadership_lost(exc):
+                raise  # _run treats this as a clean-shutdown signal
+            log.warning("rotation finish failed transiently: %s; "
+                        "retried next pass", exc)
+            return
         self.root = full_new_root

@@ -8,9 +8,14 @@ dispatcher session (SessionMessage.network_bootstrap_keys).
 """
 from __future__ import annotations
 
+import logging
 import os
 import threading
 from dataclasses import dataclass, field
+
+from ..utils.leadership import leader_write
+
+log = logging.getLogger("swarmkit_tpu.keymanager")
 
 DEFAULT_KEY_LEN = 16
 DEFAULT_ROTATION_INTERVAL = 12 * 3600.0  # 12h (keymanager.go DefaultKeyRotationInterval)
@@ -55,7 +60,13 @@ class KeyManager:
 
     def _run(self):
         while not self._stop.wait(timeout=self.rotation_interval):
-            self.rotate()
+            try:
+                if not self.rotate():
+                    return  # leadership lost: stop() is on its way
+            except Exception:
+                # transient propose failure: keys rotate on a 12h period,
+                # the next interval retries
+                log.exception("key rotation failed; will retry next interval")
 
     def rotate_if_needed(self):
         """Seed keys on first leadership if the cluster has none
@@ -66,14 +77,16 @@ class KeyManager:
         if not cluster.network_bootstrap_keys:
             self.rotate()
 
-    def rotate(self):
+    def rotate(self) -> bool:
         """Generate one fresh key per subsystem; keep the previous key so
-        in-flight traffic still decrypts (keymanager.go rotateKey keeps 2)."""
+        in-flight traffic still decrypts (keymanager.go rotateKey keeps 2).
+        Returns False when leadership was lost mid-write."""
 
         def txn(tx):
             cluster = tx.get_cluster(self.cluster_id)
             if cluster is None:
                 return
+            cluster = cluster.copy()
             clock = cluster.encryption_key_lamport_clock + 1
             new_keys = [
                 EncryptionKey(
@@ -94,4 +107,4 @@ class KeyManager:
             cluster.encryption_key_lamport_clock = clock
             tx.update(cluster)
 
-        self.store.update(txn)
+        return leader_write(self.store, txn, "keymanager")

@@ -208,7 +208,12 @@ class Manager:
             item = self._leadership_q.get()
             if item is None:
                 return
-            # collapse bursts: only the latest state matters
+            # collapse bursts — but a demote buried inside a burst that ends
+            # leader must still be APPLIED, not elided: component threads
+            # self-terminate on LeadershipLost, so a False→True collapse that
+            # skipped _become_follower/_become_leader would leave a
+            # believing-it-leads manager with dead components
+            saw_demote = item is False
             while True:
                 try:
                     nxt = self._leadership_q.get_nowait()
@@ -219,7 +224,11 @@ class Manager:
                     # apply False itself; becoming leader mid-shutdown would
                     # start components nobody stops
                     return
+                if nxt is False:
+                    saw_demote = True
                 item = nxt
+            if item and saw_demote:
+                self._apply_leadership(False)  # full stop/start cycle
             self._apply_leadership(item)
 
     def _apply_leadership(self, is_leader: bool):

@@ -11,6 +11,7 @@ import threading
 
 from ..store.memory import MemoryStore
 from ..store.watch import ChannelClosed
+from ..utils.leadership import leadership_lost
 
 log = logging.getLogger("swarmkit_tpu.orchestrator")
 
@@ -52,9 +53,14 @@ class EventLoopComponent:
         try:
             try:
                 self.on_start(snapshot)
-            except Exception:
-                # initial reconcile may propose during leadership churn; the
-                # event loop must still come up — events re-drive the state
+            except Exception as exc:
+                if leadership_lost(exc):
+                    # demoted before the initial reconcile committed: stop
+                    # cleanly, the manager's leadership handler stop()s us
+                    log.info("%s: leadership lost; stopping", self.name)
+                    return
+                # initial reconcile may fail transiently; the event loop
+                # must still come up — events re-drive the state
                 log.exception("%s: initial reconcile failed", self.name)
             while not self._stop.is_set():
                 try:
@@ -62,14 +68,21 @@ class EventLoopComponent:
                 except TimeoutError:
                     try:
                         self.idle()
-                    except Exception:
+                    except Exception as exc:
+                        if leadership_lost(exc):
+                            log.info("%s: leadership lost; stopping",
+                                     self.name)
+                            return
                         log.exception("%s: idle pass failed", self.name)
                     continue
                 except ChannelClosed:
                     return
                 try:
                     self.handle(ev)
-                except Exception:
+                except Exception as exc:
+                    if leadership_lost(exc):
+                        log.info("%s: leadership lost; stopping", self.name)
+                        return
                     log.exception("%s: error handling %r", self.name, ev)
         finally:
             self.store.queue.stop_watch(ch)

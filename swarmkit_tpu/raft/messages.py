@@ -14,6 +14,14 @@ from typing import Any
 ENTRY_NORMAL = 0
 ENTRY_CONF_CHANGE = 1
 
+# canonical demotion markers for propose-callback error strings. The
+# callback protocol carries (ok, err_string); RaftNode builds demotion
+# errors FROM these constants and RaftProposer classifies errors BY them
+# (raising LeadershipLost), so rewording one site can't silently break
+# the clean-shutdown signal leader-only components rely on.
+ERR_NOT_LEADER = "not leader"
+ERR_LEADERSHIP_LOST = "leadership lost"
+
 
 @dataclass
 class Entry:

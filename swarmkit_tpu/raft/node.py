@@ -29,6 +29,8 @@ from typing import Any, Callable
 from .messages import (
     ENTRY_CONF_CHANGE,
     ENTRY_NORMAL,
+    ERR_LEADERSHIP_LOST,
+    ERR_NOT_LEADER,
     AppendEntries,
     AppendResponse,
     ConfChange,
@@ -366,7 +368,7 @@ class RaftNode:
         self.election_elapsed = 0
         self._randomized_timeout = self._next_timeout()
         if was_leader:
-            self._drop_waits("leadership lost")
+            self._drop_waits(ERR_LEADERSHIP_LOST)
             if was_signalled:
                 self._notify_leadership(False)
 
@@ -522,7 +524,7 @@ class RaftNode:
             # accepting a proposal now deadlocks the applier against the
             # proposer's store lock (raft.go processInternalRaftRequest
             # fails on !signalledLeadership for the same reason)
-            callback(False, f"not leader; leader is {self.leader_id}")
+            callback(False, f"{ERR_NOT_LEADER}; leader is {self.leader_id}")
             return
         self._waits[request_id] = callback
         e = Entry(term=self.term, index=self._last_index() + 1,
@@ -533,7 +535,7 @@ class RaftNode:
 
     def _on_conf_change(self, cc: ConfChange, request_id, callback):
         if self.role != LEADER or not self._signalled:
-            callback(False, f"not leader; leader is {self.leader_id}")
+            callback(False, f"{ERR_NOT_LEADER}; leader is {self.leader_id}")
             return
         if cc.action == "remove" and not self._can_remove(cc.raft_id):
             callback(False, "removal would break quorum of reachable members")

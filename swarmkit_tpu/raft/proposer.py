@@ -17,7 +17,7 @@ import time
 from ..api.objects import Version
 from ..utils.identity import new_id
 from ..utils.metrics import histogram
-from .messages import Entry
+from .messages import ERR_LEADERSHIP_LOST, ERR_NOT_LEADER, Entry
 from .node import RaftNode
 
 PROPOSE_TIMEOUT = 30.0
@@ -30,6 +30,25 @@ _propose_latency = histogram(
 
 class ProposeError(Exception):
     pass
+
+
+class LeadershipLost(ProposeError):
+    """The proposal failed because this node is not (or stopped being) the
+    raft leader — distinct from transient failures like a quorum-loss
+    timeout, which may resolve while still leading. Leader-only component
+    threads treat this as a clean-shutdown signal
+    (utils/leadership.leadership_lost)."""
+
+
+# the demotion markers RaftNode builds its propose-callback errors from
+# (messages.ERR_*); matched HERE only, so callers get a structured
+# exception and a rewording can't desynchronize producer and classifier
+_NOT_LEADER_MARKERS = (ERR_NOT_LEADER, ERR_LEADERSHIP_LOST)
+
+
+def _classify(err: str) -> type[ProposeError]:
+    return (LeadershipLost
+            if any(m in err for m in _NOT_LEADER_MARKERS) else ProposeError)
 
 
 class RaftProposer:
@@ -80,7 +99,8 @@ class RaftProposer:
         if not outcome.get("ok"):
             with self._lock:
                 self._pending.pop(req_id, None)
-            raise ProposeError(outcome.get("err") or "proposal dropped")
+            err = outcome.get("err") or "proposal dropped"
+            raise _classify(err)(err)
 
     def get_version(self) -> Version:
         return Version(self.node.commit_index)

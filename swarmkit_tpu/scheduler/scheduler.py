@@ -235,11 +235,16 @@ class Scheduler:
                     try:
                         self.tick()
                         dirty_since = None
-                    except Exception:
-                        # a propose can fail transiently (leadership churn,
-                        # quorum loss); the unassigned pool is preserved and
-                        # the max-latency path retries even with no new
-                        # events — the loop must survive
+                    except Exception as exc:
+                        from ..utils.leadership import leadership_lost
+
+                        if leadership_lost(exc):
+                            log.info("scheduler: leadership lost; stopping")
+                            return
+                        # a propose can fail transiently (quorum loss); the
+                        # unassigned pool is preserved and the max-latency
+                        # path retries even with no new events — the loop
+                        # must survive
                         log.exception("scheduler: tick failed; will retry")
                         dirty_since = time.monotonic()
         finally:

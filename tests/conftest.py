@@ -32,3 +32,10 @@ def pytest_configure(config):
         "markers", "daemon: in-process networked daemon cluster tests")
     config.addinivalue_line(
         "markers", "multiprocess: real-OS-process swarmd cluster tests")
+    # Background-thread crashes must FAIL the suite, not pass as warnings:
+    # round-1 shipped a leader-demotion crash (rolemanager ProposeError)
+    # that 292 green tests never surfaced because pytest only warns on
+    # unhandled thread exceptions (VERDICT r1 weak #2).
+    config.addinivalue_line(
+        "filterwarnings",
+        "error::pytest.PytestUnhandledThreadExceptionWarning")
