@@ -87,6 +87,24 @@ class InstallSnapshot(Message):
 
 
 @dataclass
+class SnapshotChunk(Message):
+    """One chunk of a streamed snapshot install (reference streams large
+    raft messages: manager/state/raft/transport/peer.go:26-142). The
+    payload is codec-serialized snapshot state split into fixed-size byte
+    chunks; metadata rides on every chunk so reassembly needs no ordering
+    handshake. The follower applies only when all `total` chunks for this
+    (snapshot_index, term) arrived."""
+
+    snapshot_index: int = 0
+    snapshot_term: int = 0
+    members: dict[int, tuple[str, str]] = field(default_factory=dict)
+    seq: int = 0
+    total: int = 1
+    chunk: bytes = b""
+    kind: str = "snap_chunk"
+
+
+@dataclass
 class TimeoutNow(Message):
     """Leadership transfer (raft §3.10 / etcd MsgTimeoutNow): the leader
     tells its most caught-up peer to campaign immediately; the new term

@@ -36,6 +36,7 @@ from .messages import (
     ConfChange,
     Entry,
     InstallSnapshot,
+    SnapshotChunk,
     VoteRequest,
     VoteResponse,
 )
@@ -45,6 +46,22 @@ log = logging.getLogger("swarmkit_tpu.raft")
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
 
 MAX_ENTRIES_PER_APPEND = 64
+# pipelined replication: optimistic appends may run this many messages
+# ahead of the follower's last ack (reference MaxInflightMsgs: 256,
+# manager/state/raft/raft.go:490); the per-peer entry window is
+# MAX_INFLIGHT_APPENDS * MAX_ENTRIES_PER_APPEND
+MAX_INFLIGHT_APPENDS = 256
+# streamed snapshot installs (reference transport/peer.go:26-142 streams
+# large messages instead of one oversized gRPC frame)
+SNAPSHOT_CHUNK_BYTES = 256 * 1024
+# ticks before an unacked streamed snapshot is re-sent (the follower may
+# have lost chunks; until then the peer is paused, not re-blasted)
+SNAPSHOT_RESEND_TICKS = 50
+# wedge-triggered leadership transfers are rate limited (reference
+# raft.go:569-604 caps transfers at one per minute). Expressed in ticks
+# so the deterministic fake-clock harness can drive expiry; at the
+# daemon's 0.2 s tick this is one minute.
+TRANSFER_MIN_TICKS = 300
 
 
 class NotLeader(Exception):
@@ -122,6 +139,33 @@ class RaftNode:
         self._inbox: queue.Queue = queue.Queue()
         self._stopped = threading.Event()
         self._thread: threading.Thread | None = None
+
+        # CheckQuorum leader lease (reference raft.go:237 CheckQuorum):
+        # a leader that hears from fewer than a quorum of peers within one
+        # election timeout steps down instead of accepting work while
+        # partitioned. _recent_active records peers that responded since
+        # the last lease checkpoint.
+        self.check_quorum = True
+        self._quorum_elapsed = 0
+        self._recent_active: set[int] = set()
+
+        # streamed-snapshot pause state: peer -> (snapshot_index, ttl);
+        # while set, data appends to that peer are withheld (heartbeats
+        # still flow) and stale failure hints ignored (etcd
+        # ProgressStateSnapshot analogue)
+        self._snap_pending: dict[int, tuple[int, int]] = {}
+        # follower-side chunk reassembly: (frm, snapshot_index) -> {seq: bytes}
+        self._snap_chunks: dict[tuple[int, int], dict[int, bytes]] = {}
+        # per-peer count of unacked append messages — the pipelining
+        # window; reset on rewind, decremented per response
+        self._inflight: dict[int, int] = {}
+
+        self.transfer_min_ticks = TRANSFER_MIN_TICKS
+        self._transfer_cooldown = 0
+        # leader-side cache of the serialized snapshot blob: re-streams of
+        # the same snapshot_index must be byte-identical, or a follower
+        # reassembling across two streams installs a state no leader had
+        self._snap_blob: tuple[int, bytes] | None = None
 
         # Signalled leadership (reference raft.go signalledLeadership +
         # :644-670 ordering): election alone does not make a usable leader —
@@ -297,11 +341,35 @@ class RaftNode:
         return self.election_tick + self._rng.randrange(self.election_tick)
 
     def _on_tick(self):
+        if self._transfer_cooldown > 0:
+            self._transfer_cooldown -= 1
         if self.role == LEADER:
             self.heartbeat_elapsed += 1
             if self.heartbeat_elapsed >= self.heartbeat_tick:
                 self.heartbeat_elapsed = 0
                 self._broadcast_append()
+            # expire paused streamed snapshots so lost chunks get re-sent
+            for peer_id, (snap_idx, ttl) in list(self._snap_pending.items()):
+                if ttl <= 1:
+                    self._snap_pending.pop(peer_id, None)
+                else:
+                    self._snap_pending[peer_id] = (snap_idx, ttl - 1)
+            if self.check_quorum:
+                self._quorum_elapsed += 1
+                if self._quorum_elapsed >= self.election_tick:
+                    self._quorum_elapsed = 0
+                    active = {self.id} | (
+                        self._recent_active & set(self.members))
+                    self._recent_active = set()
+                    if not self._quorum(len(active)):
+                        # partitioned leader: step down rather than keep
+                        # accepting work a real quorum will supersede
+                        # (reference raft.go CheckQuorum behavior)
+                        log.info(
+                            "raft-%d: leader lost quorum contact "
+                            "(%d/%d active); stepping down",
+                            self.id, len(active), len(self.members))
+                        self._become_follower(self.term, None)
         else:
             self.election_elapsed += 1
             if self.election_elapsed >= self._randomized_timeout:
@@ -342,6 +410,10 @@ class RaftNode:
         self.role = LEADER
         self.leader_id = self.id
         self.heartbeat_elapsed = 0
+        self._quorum_elapsed = 0
+        self._recent_active = set()
+        self._snap_pending = {}
+        self._inflight = {}
         last = self._last_index()
         self.next_index = {p: last + 1 for p in self.members if p != self.id}
         self.match_index = {p: 0 for p in self.members if p != self.id}
@@ -363,6 +435,9 @@ class RaftNode:
             self.term = term
             self.voted_for = None
             self._persist_hard_state()
+            # a partial snapshot stream from a deposed leader is dead;
+            # drop its reassembly buffers
+            self._snap_chunks.clear()
         self.role = FOLLOWER
         self.leader_id = leader_id
         self.election_elapsed = 0
@@ -389,6 +464,7 @@ class RaftNode:
             "append": self._on_append,
             "append_resp": self._on_append_response,
             "snapshot": self._on_install_snapshot,
+            "snap_chunk": self._on_snapshot_chunk,
             "timeout_now": self._on_timeout_now,
         }.get(msg.kind)
         if handler:
@@ -408,9 +484,18 @@ class RaftNode:
 
         if self.role != LEADER:
             return
+        # rate limit (reference raft.go:569-604: 1/min): the wedge monitor
+        # may fire repeatedly while the store stays stuck, and back-to-back
+        # transfers churn elections instead of letting the new leader
+        # settle. Tick-counted so the fake-clock harness can drive expiry.
+        if self._transfer_cooldown > 0:
+            log.info("raft-%d: leadership transfer suppressed (rate limit)",
+                     self.id)
+            return
         peers = [p for p in self.members if p != self.id]
         if not peers:
             return
+        self._transfer_cooldown = self.transfer_min_ticks
         target = max(peers, key=lambda p: self.match_index.get(p, 0))
         self._send(TimeoutNow(frm=self.id, to=target, term=self.term))
 
@@ -475,6 +560,13 @@ class RaftNode:
             self.commit_index = min(msg.leader_commit, self._last_index())
             self._apply_committed()
 
+        if self._snap_chunks:
+            # appends caught us up past a partially-streamed snapshot
+            # (its sender died mid-stream): the buffers are garbage now
+            last = self._last_index()
+            self._snap_chunks = {
+                k: v for k, v in self._snap_chunks.items() if k[1] > last}
+
         self._send(AppendResponse(frm=self.id, to=msg.frm, term=self.term,
                                   success=True,
                                   match_index=self._last_index()))
@@ -482,15 +574,39 @@ class RaftNode:
     def _on_append_response(self, msg: AppendResponse):
         if self.role != LEADER or msg.term != self.term:
             return
+        self._recent_active.add(msg.frm)  # CheckQuorum lease contact
         if msg.success:
+            # one ack drains one window slot (heartbeat acks merely decay
+            # the counter faster, floored at zero)
+            self._inflight[msg.frm] = max(
+                0, self._inflight.get(msg.frm, 0) - 1)
             self.match_index[msg.frm] = max(
                 self.match_index.get(msg.frm, 0), msg.match_index)
-            self.next_index[msg.frm] = self.match_index[msg.frm] + 1
+            # pipelined sends advanced next_index optimistically past
+            # match+1 — never regress it on an (out-of-order) ack
+            self.next_index[msg.frm] = max(
+                self.next_index.get(msg.frm, 1),
+                self.match_index[msg.frm] + 1)
+            pending = self._snap_pending.get(msg.frm)
+            if pending is not None and msg.match_index >= pending[0]:
+                self._snap_pending.pop(msg.frm, None)  # install acked
             self._maybe_advance_commit()
+            # refill the pipeline window opened by this ack
+            self._send_append_to(msg.frm, allow_empty=False)
         else:
-            # follower hinted how far behind it is
-            self.next_index[msg.frm] = max(1, msg.match_index + 1)
-            self._send_append_to(msg.frm)
+            if msg.frm in self._snap_pending:
+                # mid-install heartbeat mismatch is expected; the streamed
+                # snapshot (or its TTL expiry) resolves it
+                return
+            # follower hinted how far behind it is; with a pipeline in
+            # flight, stale rejections of already-superseded probes carry
+            # hints >= next — only a genuinely lower hint rewinds
+            self._inflight[msg.frm] = 0  # everything in flight is moot
+            new_next = max(1, msg.match_index + 1)
+            if new_next < self.next_index.get(msg.frm,
+                                              self._last_index() + 1):
+                self.next_index[msg.frm] = new_next
+                self._send_append_to(msg.frm, allow_empty=False)
 
     def _on_install_snapshot(self, msg: InstallSnapshot):
         if msg.term < self.term:
@@ -498,24 +614,63 @@ class RaftNode:
         self.role = FOLLOWER
         self.leader_id = msg.frm
         self.election_elapsed = 0
-        if msg.snapshot_index <= self.snapshot_index:
+        self._install_snapshot(msg.frm, msg.snapshot_index,
+                               msg.snapshot_term, msg.members, msg.data)
+
+    def _on_snapshot_chunk(self, msg):
+        """Reassemble a streamed snapshot; apply when complete. Every chunk
+        counts as leader contact (the follower must not campaign while a
+        multi-second install is in flight)."""
+        if msg.term < self.term:
             return
-        self.snapshot_index = msg.snapshot_index
-        self.snapshot_term = msg.snapshot_term
+        self.role = FOLLOWER
+        self.leader_id = msg.frm
+        self.election_elapsed = 0
+        if msg.snapshot_index <= self.snapshot_index:
+            # already have it (dup/late chunks): ack so the leader unpauses
+            self._send(AppendResponse(
+                frm=self.id, to=msg.frm, term=self.term, success=True,
+                match_index=self._last_index()))
+            return
+        key = (msg.frm, msg.snapshot_index)
+        buf = self._snap_chunks.setdefault(key, {})
+        if msg.seq == 0:
+            # start of a (re-)stream: per-peer delivery is ordered, so any
+            # buffered chunks are from an abandoned earlier stream
+            buf.clear()
+        buf[msg.seq] = msg.chunk
+        if len(buf) < msg.total:
+            return
+        from ..rpc import codec
+
+        data = codec.loads(b"".join(buf[i] for i in range(msg.total)))
+        # drop every reassembly buffer for this or older snapshots
+        self._snap_chunks = {
+            k: v for k, v in self._snap_chunks.items()
+            if k[1] > msg.snapshot_index}
+        self._install_snapshot(msg.frm, msg.snapshot_index,
+                               msg.snapshot_term, msg.members, data)
+
+    def _install_snapshot(self, frm: int, snapshot_index: int,
+                          snapshot_term: int, members, data):
+        if snapshot_index <= self.snapshot_index:
+            return
+        self.snapshot_index = snapshot_index
+        self.snapshot_term = snapshot_term
         self.log = []
-        self.first_index = msg.snapshot_index + 1
-        self.commit_index = max(self.commit_index, msg.snapshot_index)
-        self.last_applied = msg.snapshot_index
+        self.first_index = snapshot_index + 1
+        self.commit_index = max(self.commit_index, snapshot_index)
+        self.last_applied = snapshot_index
         self.members = {
             rid: Peer(rid, nid, addr)
-            for rid, (nid, addr) in msg.members.items()
+            for rid, (nid, addr) in members.items()
         }
-        self.restore_state(msg.data)
+        self.restore_state(data)
         if self.storage is not None:
             self.storage.save_snapshot(
-                msg.snapshot_index, msg.snapshot_term, msg.data, self.members)
-        self._send(AppendResponse(frm=self.id, to=msg.frm, term=self.term,
-                                  success=True, match_index=msg.snapshot_index))
+                snapshot_index, snapshot_term, data, self.members)
+        self._send(AppendResponse(frm=self.id, to=frm, term=self.term,
+                                  success=True, match_index=snapshot_index))
 
     # ------------------------------------------------------------- proposing
     def _on_propose(self, data, request_id, callback):
@@ -578,28 +733,85 @@ class RaftNode:
             if peer_id != self.id:
                 self._send_append_to(peer_id)
 
-    def _send_append_to(self, peer_id: int):
+    def _send_append_to(self, peer_id: int, allow_empty: bool = True):
+        """Ship log entries to one peer, pipelined: batches are sent
+        optimistically (next_index advances without waiting for acks) up
+        to an in-flight window of MAX_INFLIGHT_APPENDS unacked messages,
+        so catch-up throughput is window-bound instead of
+        one-batch-per-RTT (reference MaxInflightMsgs). Before the first
+        ack establishes `match`, the peer is in probe mode: one
+        NON-advancing batch at a time (etcd ProgressStateProbe) — blasting
+        optimistic batches at a possibly-mismatched log would bounce
+        entirely."""
         next_idx = self.next_index.get(peer_id, self._last_index() + 1)
-        if next_idx <= self.snapshot_index:
-            self._send(InstallSnapshot(
+        if peer_id not in self._snap_pending and \
+                next_idx <= self.snapshot_index:
+            self._send_snapshot_to(peer_id)
+            return
+        match = self.match_index.get(peer_id, 0)
+        paused = peer_id in self._snap_pending
+        sent = 0
+        while not paused:
+            if self._inflight.get(peer_id, 0) >= MAX_INFLIGHT_APPENDS:
+                break  # window full: heartbeat only until acks drain it
+            next_idx = self.next_index.get(peer_id, self._last_index() + 1)
+            start = next_idx - self.first_index
+            entries = self.log[start:start + MAX_ENTRIES_PER_APPEND]
+            if not entries:
+                break
+            prev_index = next_idx - 1
+            prev_term = self._term_at(prev_index) if prev_index > 0 else 0
+            self._send(AppendEntries(
+                frm=self.id, to=peer_id, term=self.term,
+                prev_log_index=prev_index, prev_log_term=prev_term,
+                entries=list(entries), leader_commit=self.commit_index,
+            ))
+            self._inflight[peer_id] = self._inflight.get(peer_id, 0) + 1
+            if match <= 0:
+                return  # probe mode: do not advance next, await the ack
+            self.next_index[peer_id] = next_idx + len(entries)
+            sent += 1
+        if sent == 0 and allow_empty:
+            # heartbeat / commit-index propagation; also flows to paused
+            # (snapshot-installing) peers so they neither campaign nor
+            # starve the CheckQuorum lease of their responses
+            prev_index = next_idx - 1
+            prev_term = self._term_at(prev_index) if prev_index > 0 else 0
+            self._send(AppendEntries(
+                frm=self.id, to=peer_id, term=self.term,
+                prev_log_index=prev_index, prev_log_term=prev_term,
+                entries=[], leader_commit=self.commit_index,
+            ))
+
+    def _send_snapshot_to(self, peer_id: int):
+        """Stream the current snapshot in chunks and pause the peer until
+        it acks (or the TTL expires and we re-send)."""
+        if peer_id in self._snap_pending:
+            return
+        from ..rpc import codec
+
+        # serialize once per snapshot_index: snapshot_state() reads the
+        # LIVE store, so a re-stream after new commits would otherwise
+        # produce different bytes under the same snapshot_index
+        if self._snap_blob is None or \
+                self._snap_blob[0] != self.snapshot_index:
+            self._snap_blob = (self.snapshot_index,
+                               codec.dumps(self.snapshot_state()))
+        blob = self._snap_blob[1]
+        chunks = [blob[i:i + SNAPSHOT_CHUNK_BYTES]
+                  for i in range(0, len(blob), SNAPSHOT_CHUNK_BYTES)] or [b""]
+        members = {rid: (p.node_id, p.addr)
+                   for rid, p in self.members.items()}
+        for seq, part in enumerate(chunks):
+            self._send(SnapshotChunk(
                 frm=self.id, to=peer_id, term=self.term,
                 snapshot_index=self.snapshot_index,
                 snapshot_term=self.snapshot_term,
-                members={rid: (p.node_id, p.addr)
-                         for rid, p in self.members.items()},
-                data=self.snapshot_state(),
+                members=members, seq=seq, total=len(chunks), chunk=part,
             ))
-            self.next_index[peer_id] = self.snapshot_index + 1
-            return
-        prev_index = next_idx - 1
-        prev_term = self._term_at(prev_index) if prev_index > 0 else 0
-        start = next_idx - self.first_index
-        entries = self.log[start:start + MAX_ENTRIES_PER_APPEND]
-        self._send(AppendEntries(
-            frm=self.id, to=peer_id, term=self.term,
-            prev_log_index=prev_index, prev_log_term=prev_term,
-            entries=list(entries), leader_commit=self.commit_index,
-        ))
+        self._snap_pending[peer_id] = (self.snapshot_index,
+                                       SNAPSHOT_RESEND_TICKS)
+        self.next_index[peer_id] = self.snapshot_index + 1
 
     def _maybe_advance_commit(self):
         if self.role != LEADER:

