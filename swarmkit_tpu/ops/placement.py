@@ -246,8 +246,7 @@ def _tree_water_fill(eligible, capacity, penalty, svc, total, n_tasks,
     return counts + extra.astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("unroll",))
-def schedule_groups(
+def _schedule_core(
     ready, node_val, node_plat, node_plugins, extra_mask,
     constraints, plat_req, req_plugins,
     avail_res,      # int32[N, R]
@@ -264,9 +263,10 @@ def schedule_groups(
     spread_rank,    # int32[G, LMAX, N]; LMAX may be 0 (no preferences)
     unroll: int = 1,
 ):
-    """Schedule every group sequentially (groups interact through node state),
-    each step fully data-parallel over nodes. Returns
-    (counts[G, N], totals[N], svc_counts[S, N])."""
+    """Traced core shared by the one-shot and device-resident entry points.
+    Schedules every group sequentially (groups interact through node
+    state), each step fully data-parallel over nodes. Returns the counts
+    AND the full post-placement node state carry."""
     static_mask = build_static_mask(
         ready, node_val, node_plat, node_plugins,
         constraints, plat_req, req_plugins, extra_mask)
@@ -301,13 +301,20 @@ def schedule_groups(
         port_used = port_used | (g_ports[None, :] & (counts > 0)[:, None])
         return (totals, svc_counts, avail, port_used), counts
 
-    (totals, svc_counts, _, _), counts = lax.scan(
+    (totals, svc_counts, avail, port_used), counts = lax.scan(
         step,
         (total0, svc_count0, avail_res, port_used0),
         (static_mask, need_res, n_tasks, svc_idx, max_replicas,
          penalty, has_ports, group_ports, spread_rank),
         unroll=unroll,
     )
+    return counts, totals, svc_counts, avail, port_used
+
+
+@functools.partial(jax.jit, static_argnames=("unroll",))
+def schedule_groups(*args, unroll: int = 1):
+    """One-shot entry: (counts[G, N], totals[N], svc_counts[S, N])."""
+    counts, totals, svc_counts, _, _ = _schedule_core(*args, unroll=unroll)
     return counts, totals, svc_counts
 
 

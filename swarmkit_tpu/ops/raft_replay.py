@@ -60,6 +60,22 @@ def sharded_replay_commit(mesh: Mesh, axis: str = "managers"):
 
 
 @jax.jit
+def frontier_advance(acks, frontier, quorum):
+    """Device-resident replay step: the ack matrix LIVES on device; each
+    round uploads only the per-manager durable frontiers (int32[M], a few
+    bytes) instead of re-shipping the [M, E] matrix (round-1 verdict: the
+    0.04x speedup_with_upload was pure re-upload cost). Returns the
+    updated ack matrix (the next round's carry) and the commit index."""
+    M, E = acks.shape
+    acks = acks | (jnp.arange(E, dtype=jnp.int32)[None, :]
+                   < frontier[:, None])
+    tally = jnp.sum(acks.astype(jnp.int32), axis=0)
+    committed = tally >= quorum
+    prefix = jnp.cumprod(committed.astype(jnp.int32))
+    return acks, jnp.sum(prefix).astype(jnp.int32)
+
+
+@jax.jit
 def match_index_commit(match_index, quorum):
     """Commit index from per-manager match indices (the leader-side rule:
     commit = the quorum'th largest match index). match_index: int32[M]."""

@@ -20,6 +20,8 @@ store-integrated consumer.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -33,6 +35,136 @@ def global_diff(eligible, task_nodes):
     """eligible: bool[S, N]; task_nodes: int32[S, T] — for each service the
     node indices of its runnable tasks, padded with -1 (T = max per service).
     Returns (create bool[S, N], shutdown bool[S, N])."""
+    S, N = eligible.shape
+    rows = jnp.broadcast_to(jnp.arange(S)[:, None], task_nodes.shape)
+    cols = jnp.clip(task_nodes, 0, N - 1)
+    has = jnp.zeros((S, N), bool).at[rows, cols].max(task_nodes >= 0)
+    return eligible & ~has, ~eligible & has
+
+
+@jax.jit
+def global_diff_update(eligible, task_nodes, upd_rows, upd_cols, upd_vals):
+    """Device-resident diff step: eligibility and the task→node table LIVE
+    on device; a round uploads only the churned slots (task moves/deaths
+    as (service row, slot col, new node) triples) and recomputes the diff.
+    Returns (task_nodes', create, shutdown) — task_nodes' is the next
+    round's carry."""
+    task_nodes = task_nodes.at[upd_rows, upd_cols].set(upd_vals)
+    create, shutdown = _diff(eligible, task_nodes)
+    return task_nodes, create, shutdown
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def global_diff_update_compact(eligible, task_nodes, upd_rows, upd_cols,
+                               upd_vals, cap: int):
+    """global_diff_update, but the decisions come back as COMPACT index
+    lists instead of dense [S, N] matrices: in a converged cluster the
+    diff is churn-sized, and the dense pull (tens of MB) would dominate a
+    high-latency link. Returns (task_nodes', create_idx[cap, 2],
+    shutdown_idx[cap, 2], n_create, n_shutdown); index rows beyond the
+    real count are (-1, -1). If a diff overflows `cap` the counts exceed
+    cap and the caller falls back to a dense pull."""
+    task_nodes = task_nodes.at[upd_rows, upd_cols].set(upd_vals)
+    create, shutdown = _diff(eligible, task_nodes)
+
+    def compact(m):
+        s_idx, n_idx = jnp.nonzero(m, size=cap, fill_value=-1)
+        return jnp.stack([s_idx, n_idx], axis=1).astype(jnp.int32), \
+            jnp.sum(m).astype(jnp.int32)
+
+    c_idx, n_c = compact(create)
+    s_idx, n_s = compact(shutdown)
+    return task_nodes, c_idx, s_idx, n_c, n_s
+
+
+@functools.partial(jax.jit, static_argnames=("n_nodes",))
+def task_count_flat(task_nodes, n_nodes: int):
+    """cnt[s * n_nodes + n] = number of runnable tasks of service s on
+    node n — the resident carry for the O(churn) incremental diff below.
+    Kept FLAT deliberately: this backend's 2D scatter-add lowering is
+    broken above ~512 updates (wrong flat offsets), while 1D scatters are
+    correct at every size probed — see tests/test_reconcile_kernel.py's
+    churn fuzz, which would catch a regression either way."""
+    S, T = task_nodes.shape
+    flat_idx = (jnp.arange(S, dtype=jnp.int32)[:, None] * n_nodes
+                + jnp.clip(task_nodes, 0, n_nodes - 1)).reshape(-1)
+    return jnp.zeros(S * n_nodes, jnp.int32).at[flat_idx].add(
+        (task_nodes >= 0).reshape(-1).astype(jnp.int32))
+
+
+def _churn_step(eligible, task_nodes, cnt_flat, rows, cols, vals):
+    N = eligible.shape[1]
+    old = task_nodes[rows, cols]                               # [U]
+    task_nodes = task_nodes.at[rows, cols].set(vals)
+    old_v = old >= 0
+    new_v = vals >= 0
+    oldc = jnp.clip(old, 0)
+    newc = jnp.clip(vals, 0)
+    cnt_flat = cnt_flat.at[rows * N + oldc].add(jnp.where(old_v, -1, 0))
+    cnt_flat = cnt_flat.at[rows * N + newc].add(jnp.where(new_v, 1, 0))
+
+    pr = jnp.concatenate([rows, rows])
+    pn = jnp.concatenate([oldc, newc])
+    valid = jnp.concatenate([old_v, new_v])
+    elig_p = eligible[pr, pn]
+    cnt_p = cnt_flat[pr * N + pn]
+    create = valid & elig_p & (cnt_p == 0)
+    shutdown = valid & ~elig_p & (cnt_p > 0)
+    pairs = jnp.stack([pr, pn], axis=1).astype(jnp.int32)
+    return task_nodes, cnt_flat, pairs, create, shutdown, valid
+
+
+@jax.jit
+def global_diff_churn_burst(eligible, task_nodes, cnt_flat,
+                            rows_b, cols_b, vals_b):
+    """A debounced reconcile pass: B churn rounds ([B, U] each) applied in
+    one device program (lax.scan). One upload + one dispatch + one pull
+    per burst — on a high-latency link the per-call sync would otherwise
+    dominate the O(churn) work. The global orchestrator's event debounce
+    produces exactly this shape of batch.
+
+    Returns (task_nodes', cnt_flat', codes uint8[B, 2U]): per round, for
+    the touched pair i (< U: the slot's OLD node; >= U: the NEW one),
+    bit0 = create, bit1 = shutdown, bit2 = valid. The PAIR coordinates
+    are deliberately NOT returned — the caller's own events name the
+    moved tasks' old/new nodes, and shipping redundant indices would
+    quadruple the D2H payload."""
+
+    def step(carry, x):
+        tn, cnt = carry
+        r, c, v = x
+        tn, cnt, _pairs, cre, shut, valid = _churn_step(
+            eligible, tn, cnt, r, c, v)
+        codes = (cre.astype(jnp.uint8)
+                 | (shut.astype(jnp.uint8) << 1)
+                 | (valid.astype(jnp.uint8) << 2))
+        return (tn, cnt), codes
+
+    (task_nodes, cnt_flat), codes = jax.lax.scan(
+        step, (task_nodes, cnt_flat), (rows_b, cols_b, vals_b))
+    return task_nodes, cnt_flat, codes
+
+
+@jax.jit
+def global_diff_churn(eligible, task_nodes, cnt_flat, rows, cols, vals):
+    """O(churn) incremental reconcile step. State on device: eligibility,
+    the task→node table, and the FLAT per-(service, node) task-count
+    array (task_count_flat). A round uploads churned slots as (service,
+    slot, new node) triples — slots must be unique within one round (a
+    task moves once) — and returns the new carries plus the decisions at
+    every touched (service, node) pair:
+
+        pairs[2U, 2], create[2U], shutdown[2U], valid[2U]
+
+    (pair i < U is the slot's OLD node, i >= U the NEW one; old/new of -1
+    produce a (s, 0) pair with valid=False — callers drop those).
+    Decisions anywhere else are unchanged from the previous round, which
+    is the point: the consumer updates its view instead of re-reading an
+    [S, N] matrix."""
+    return _churn_step(eligible, task_nodes, cnt_flat, rows, cols, vals)
+
+
+def _diff(eligible, task_nodes):
     S, N = eligible.shape
     rows = jnp.broadcast_to(jnp.arange(S)[:, None], task_nodes.shape)
     cols = jnp.clip(task_nodes, 0, N - 1)

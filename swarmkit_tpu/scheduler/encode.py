@@ -122,6 +122,12 @@ class EncodedProblem:
     # (a self-parented pour is a no-op)
     spread_rank: np.ndarray = None    # int32[G, LMAX, N]; LMAX may be 0
 
+    # device-resident path (ops.resident): group -> PERSISTENT service row
+    # in the encoder's grow-only service matrix, and that matrix's current
+    # row count. svc_idx/svc_count0 above use TICK-LOCAL rows instead.
+    svc_idx_persistent: np.ndarray = None  # int32[G]
+    n_svc_rows: int = 0
+
 
 _INT32_MAX = (1 << 31) - 1
 
@@ -310,6 +316,10 @@ class IncrementalEncoder:
         self._rf = ReadyFilter()
         self.last_dirty = 0   # observability: rows re-encoded by last call
         self.last_full = 0    # ... of which took the full (string) path
+        # device-resident sync (ops.resident): row indices re-encoded by
+        # the last encode() and whether the node-id row mapping changed
+        self.last_dirty_rows: np.ndarray = np.zeros(0, np.int64)
+        self.last_remap = False
         # hot-path id caches: avoid per-row f-string + dict churn
         self._default_plug_ids = [self.plugin_vocab.id(f"{t}/{n}")
                                   for t, n in PluginFilter.DEFAULT_PLUGINS]
@@ -327,6 +337,7 @@ class IncrementalEncoder:
         Removals compact rows."""
         new_ids = [i.node.id for i in infos]
         dirty: set[int] = set()
+        self.last_remap = new_ids != self._ids
         if new_ids != self._ids:
             old_idx = self._idx
             keep_src: list[int] = []
@@ -649,6 +660,9 @@ class IncrementalEncoder:
         # ------------------------------------------------- dirty node rows
         self.last_dirty = len(dirty) + len(numeric_dirty)
         self.last_full = len(dirty)
+        self.last_dirty_rows = np.fromiter(
+            sorted(dirty | numeric_dirty), np.int64,
+            count=len(dirty | numeric_dirty))
         for i in sorted(dirty):
             self._encode_row(i, node_infos[i])
         for i in sorted(numeric_dirty):
@@ -693,6 +707,26 @@ class IncrementalEncoder:
                              np.int32).reshape(G)
         p.svc_idx = np.array([svc_row[g.service_id] for g in groups] or [],
                              np.int32).reshape(G)
+        # persistent service rows for the device-resident path: the device
+        # carries the encoder's grow-only service matrix, so its kernel
+        # indexes by persistent row, not the tick-local svc_idx. Groups
+        # LOOK UP (the encoder contract) — a service with no row yet gets
+        # a HYPOTHETICAL one: the row apply_counts will allocate if this
+        # tick's placements land, numbered in group order exactly like
+        # apply_counts' _svc_row_for loop, so device and host agree.
+        # Until then the row holds zeros on both sides.
+        hypo: dict[str, int] = {}
+        rows = []
+        for g in groups:
+            r = self._svc_row.get(g.service_id)
+            if r is None:
+                r = hypo.get(g.service_id)
+                if r is None:
+                    r = len(self._svc_row) + len(hypo)
+                    hypo[g.service_id] = r
+            rows.append(r)
+        p.svc_idx_persistent = np.array(rows or [], np.int32).reshape(G)
+        p.n_svc_rows = len(self._svc_row) + len(hypo)
         p.need_res = np.zeros((G, R), np.int32)
         p.max_replicas = np.zeros(G, np.int32)
         C = self.max_constraints

@@ -112,3 +112,110 @@ def test_bulk_reconcile_is_idempotent():
     before = _snapshot(store)
     orch.bulk_reconcile(sids)
     assert _snapshot(store) == before
+
+
+# ---------------------------------------------- O(churn) resident variant
+
+
+def test_churn_kernel_matches_numpy_over_trace():
+    """Fuzz the incremental churn step against the full numpy diff: the
+    flat count carry must track exactly, and the touched-pair decision
+    bits must equal the full diff at every round. (Also the regression
+    net for the backend's 2D-scatter-add lowering bug that forced the
+    flat representation — see ops/reconcile.py task_count_flat.)"""
+    import numpy as np
+
+    from swarmkit_tpu.ops.reconcile import (
+        global_diff_churn,
+        global_diff_np,
+        task_count_flat,
+    )
+
+    rng = np.random.default_rng(42)
+    S, N, T, U = 12, 300, 20, 30
+    eligible = rng.random((S, N)) < 0.25
+    task_nodes = rng.integers(-1, N, (S, T)).astype(np.int32)
+    tn = task_nodes.copy()
+    tn_dev = task_nodes
+    cnt = task_count_flat(task_nodes, N)
+
+    for rnd in range(10):
+        flat = rng.choice(S * T, U, replace=False)
+        rows = (flat // T).astype(np.int32)
+        cols = (flat % T).astype(np.int32)
+        vals = rng.integers(-1, N, U).astype(np.int32)
+        tn_dev, cnt, pairs, cre, shut, valid = global_diff_churn(
+            eligible, tn_dev, cnt, rows, cols, vals)
+        tn[rows, cols] = vals
+
+        exp_cnt = np.zeros(S * N, np.int32)
+        for si in range(S):
+            v = tn[si][tn[si] >= 0]
+            np.add.at(exp_cnt, si * N + v, 1)
+        np.testing.assert_array_equal(np.asarray(cnt), exp_cnt,
+                                      err_msg=f"round {rnd}: cnt diverged")
+        np.testing.assert_array_equal(np.asarray(tn_dev), tn)
+
+        c_np, s_np = global_diff_np(eligible, tn)
+        for (s, n), cb, sb, v in zip(np.asarray(pairs).tolist(),
+                                     np.asarray(cre).tolist(),
+                                     np.asarray(shut).tolist(),
+                                     np.asarray(valid).tolist()):
+            if v:
+                assert bool(c_np[s, n]) == cb, (rnd, s, n)
+                assert bool(s_np[s, n]) == sb, (rnd, s, n)
+
+
+def test_churn_burst_equals_sequential_steps():
+    import numpy as np
+    import jax.numpy as jnp
+
+    from swarmkit_tpu.ops.reconcile import (
+        global_diff_churn,
+        global_diff_churn_burst,
+        task_count_flat,
+    )
+
+    rng = np.random.default_rng(5)
+    S, N, T, U, B = 8, 200, 16, 20, 6
+    eligible = rng.random((S, N)) < 0.3
+    task_nodes = rng.integers(-1, N, (S, T)).astype(np.int32)
+    cnt0 = task_count_flat(task_nodes, N)
+    flat = np.stack([rng.choice(S * T, U, replace=False) for _ in range(B)])
+    rows_b = (flat // T).astype(np.int32)
+    cols_b = (flat % T).astype(np.int32)
+    vals_b = rng.integers(-1, N, (B, U)).astype(np.int32)
+
+    tn_b, cnt_b, codes = global_diff_churn_burst(
+        eligible, task_nodes, cnt0, rows_b, cols_b, vals_b)
+
+    tn_s, cnt_s = jnp.asarray(task_nodes), cnt0
+    for b in range(B):
+        tn_s, cnt_s, pairs, cre, shut, valid = global_diff_churn(
+            eligible, tn_s, cnt_s, rows_b[b], cols_b[b], vals_b[b])
+        exp_codes = (np.asarray(cre).astype(np.uint8)
+                     | (np.asarray(shut).astype(np.uint8) << 1)
+                     | (np.asarray(valid).astype(np.uint8) << 2))
+        np.testing.assert_array_equal(np.asarray(codes)[b], exp_codes)
+    np.testing.assert_array_equal(np.asarray(tn_b), np.asarray(tn_s))
+    np.testing.assert_array_equal(np.asarray(cnt_b), np.asarray(cnt_s))
+
+
+def test_frontier_advance_matches_replay():
+    import numpy as np
+
+    from swarmkit_tpu.ops.raft_replay import frontier_advance, replay_commit
+
+    rng = np.random.default_rng(3)
+    M, E = 5, 5_000
+    acks = np.zeros((M, E), bool)
+    dev = acks
+    f = np.zeros(M, np.int32)
+    for _ in range(6):
+        f = np.minimum(f + rng.integers(0, 500, M).astype(np.int32), E - 1)
+        dev, commit = frontier_advance(dev, f, 3)
+        for m in range(M):
+            acks[m, :f[m]] = True
+        exp_commit, _ = replay_commit(acks, 3)
+        assert int(commit) == int(exp_commit)
+        np.testing.assert_array_equal(np.asarray(dev), acks)

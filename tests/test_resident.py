@@ -1,0 +1,309 @@
+"""Device-resident scheduling state (ops.resident): across multi-tick
+traces with cluster churn, the device-carried node tables must produce
+placements bit-identical to the CPU oracle run on the same encoded
+problem, and the carried state must equal the host fold exactly.
+
+The divergence the design must absorb: the kernel folds QUANTIZED needs
+(avail -= counts·ceil(need/Q)) while the host folds RAW reservations and
+re-derives quantized columns — rows where a reservation is not a quantum
+multiple drift by one quantum and must come back as correction uploads
+(ResidentPlacement.after_apply)."""
+import random
+
+import numpy as np
+import pytest
+
+from swarmkit_tpu.api.objects import Task
+from swarmkit_tpu.api.specs import Placement
+from swarmkit_tpu.api.types import TaskState
+from swarmkit_tpu.ops.resident import ResidentPlacement
+from swarmkit_tpu.scheduler import batch
+from swarmkit_tpu.scheduler.encode import (
+    CPU_QUANTUM,
+    MEM_QUANTUM,
+    IncrementalEncoder,
+    TaskGroup,
+)
+
+from test_encoder_incremental import NOW, make_info, mutate
+from test_placement_parity import random_group
+
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnraisableExceptionWarning")
+
+
+def odd_group(rng, gi, n_tasks):
+    """Group whose reservations are NOT quantum multiples — exercises the
+    quantized-vs-raw fold divergence."""
+    g = random_group(rng, gi, n_tasks)
+    spec = g.tasks[0].spec
+    spec.resources.reservations.nano_cpus = rng.randint(0, 3 * CPU_QUANTUM)
+    spec.resources.reservations.memory_bytes = rng.randint(0, 4 * MEM_QUANTUM)
+    return g
+
+
+def expected_device_fold(p, counts):
+    """What the kernel's in-scan updates leave on device for the real
+    [N] window."""
+    total = p.total0 + counts.sum(axis=0).astype(np.int32)
+    avail = (p.avail_res.astype(np.int64)
+             - counts.astype(np.int64).T @ p.need_res.astype(np.int64)
+             ).astype(np.int32)
+    port = p.port_used0.copy()
+    for gi in range(counts.shape[0]):
+        port |= p.group_ports[gi][None, :] & (counts[gi] > 0)[:, None]
+    return total, avail, port
+
+
+def apply_tick(enc, rp, infos, p, counts):
+    """What Scheduler._apply_decisions does on the happy path."""
+    assignments = batch.materialize(p, counts)
+    by_node = {i.node.id: i for i in infos}
+    task_by_id = {t.id: t for g in p.groups for t in g.tasks}
+    n_added = 0
+    for tid, nid in assignments.items():
+        if by_node[nid].add_task(task_by_id[tid]):
+            n_added += 1
+    assert n_added == int(counts.sum())
+    assert enc.apply_counts(p, counts)
+    rp.after_apply(p, counts)
+
+
+def run_trace(seed, steps=7, group_maker=random_group):
+    rng = random.Random(seed)
+    infos = [make_info(rng, i) for i in range(14)]
+    next_node_id = 14
+    enc = IncrementalEncoder()
+    rp = ResidentPlacement(enc)
+    for step in range(steps):
+        if step:
+            next_node_id = mutate(rng, infos, next_node_id, step)
+        groups, seen = [], set()
+        for _ in range(rng.randint(1, 4)):
+            g = group_maker(rng, rng.randrange(8), rng.randint(1, 12))
+            if g.key not in seen:
+                seen.add(g.key)
+                # task ids must be unique ACROSS steps: a reused id would
+                # make add_task a no-op in the apply simulation
+                for t in g.tasks:
+                    t.id = f"s{step}-{t.id}"
+                g.tasks.sort(key=lambda t: t.id)
+                groups.append(g)
+        p = enc.encode(infos, groups, now=NOW)
+        counts = rp.schedule(p)
+        cpu_counts = batch.cpu_schedule_encoded(p)
+        np.testing.assert_array_equal(
+            counts, cpu_counts, err_msg=f"seed {seed} step {step}")
+
+        # the device carry equals the kernel fold of the host problem
+        st = rp.pull_state()
+        N = len(p.node_ids)
+        exp_total, exp_avail, exp_port = expected_device_fold(p, counts)
+        np.testing.assert_array_equal(st["total0"][:N], exp_total)
+        np.testing.assert_array_equal(
+            st["avail_res"][:N, :p.avail_res.shape[1]], exp_avail)
+        np.testing.assert_array_equal(
+            st["port_used"][:N, :p.port_used0.shape[1]], exp_port)
+        np.testing.assert_array_equal(st["ready"][:N], p.ready)
+        np.testing.assert_array_equal(
+            st["node_val"][:N, :p.node_val.shape[1]], p.node_val)
+
+        apply_tick(enc, rp, infos, p, counts)
+    return rp
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_trace_parity_quantum_reservations(seed):
+    run_trace(seed)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_trace_parity_odd_reservations(seed):
+    """Non-quantum reservations force the correction-row path every tick;
+    parity must hold anyway."""
+    run_trace(100 + seed, group_maker=odd_group)
+
+
+def plain_group(svc, version, n_tasks, cpu_quanta=1):
+    """No constraints/prefs/ports, quantum-multiple needs: nothing that
+    grows a vocabulary or forces correction rows."""
+    tasks = []
+    for ti in range(n_tasks):
+        t = Task(id=f"pt-{svc}-v{version}-{ti:04d}", service_id=svc,
+                 slot=ti + 1)
+        t.desired_state = TaskState.RUNNING
+        t.status.state = TaskState.PENDING
+        tasks.append(t)
+    spec = tasks[0].spec
+    spec.resources.reservations.nano_cpus = cpu_quanta * CPU_QUANTUM
+    spec.resources.reservations.memory_bytes = 0
+    for t in tasks[1:]:
+        t.spec = spec
+    return TaskGroup(service_id=svc, spec_version=version, tasks=tasks)
+
+
+def test_steady_state_ships_no_node_data():
+    """After a tick is applied and folded, an unchanged cluster schedules
+    the next wave with ZERO node rows crossing the link."""
+    rng = random.Random(7)
+    infos = [make_info(rng, i) for i in range(16)]
+    enc = IncrementalEncoder()
+    rp = ResidentPlacement(enc)
+
+    p1 = enc.encode(infos, [plain_group("steady", 1, 8)], now=NOW)
+    c1 = rp.schedule(p1)
+    np.testing.assert_array_equal(c1, batch.cpu_schedule_encoded(p1))
+    apply_tick(enc, rp, infos, p1, c1)
+    assert rp.uploads_full == 1
+
+    # same service, new spec version: no vocab/service-row growth and no
+    # correction rows (quantum-multiple needs)
+    p2 = enc.encode(infos, [plain_group("steady", 2, 6)], now=NOW)
+    assert enc.last_dirty == 0
+    c2 = rp.schedule(p2)
+    np.testing.assert_array_equal(c2, batch.cpu_schedule_encoded(p2))
+    assert rp.uploads_full == 1, "steady tick re-uploaded the node tables"
+    assert rp.uploads_delta_rows == 0, \
+        f"steady tick shipped {rp.uploads_delta_rows} node rows"
+
+
+def test_correction_rows_upload_after_odd_fold():
+    """A 1.5-quantum reservation makes the device's quantized fold differ
+    from the host's raw fold on every placed node; those rows (and only
+    those) must ship next tick."""
+    rng = random.Random(8)
+    infos = [make_info(rng, i) for i in range(10)]
+    enc = IncrementalEncoder()
+    rp = ResidentPlacement(enc)
+
+    # 30 tasks on few nodes → per-node counts >= 2, where the quantized
+    # fold (counts*ceil(1.5)=2c) and the raw fold (floor(raw-1.5c)) differ
+    infos = infos[:4]
+    g = random_group(rng, 0, 30)
+    spec = g.tasks[0].spec
+    spec.resources.reservations.nano_cpus = CPU_QUANTUM + CPU_QUANTUM // 2
+    spec.resources.reservations.memory_bytes = 0
+    spec.placement = Placement()
+    for t in g.tasks:
+        t.endpoint = None
+    p1 = enc.encode(infos, [g], now=NOW)
+    c1 = rp.schedule(p1)
+    np.testing.assert_array_equal(c1, batch.cpu_schedule_encoded(p1))
+    apply_tick(enc, rp, infos, p1, c1)
+    placed_rows = set(np.flatnonzero(c1.sum(axis=0)).tolist())
+    assert placed_rows, "nothing placed — test is vacuous"
+    assert set(rp._pending.tolist()) <= placed_rows
+    assert rp._pending.size > 0, "no correction rows queued for an odd need"
+
+    g2 = random_group(rng, 1, 5)
+    p2 = enc.encode(infos, [g2], now=NOW)
+    c2 = rp.schedule(p2)
+    np.testing.assert_array_equal(c2, batch.cpu_schedule_encoded(p2))
+    # after the corrections landed, device state matches the host exactly
+    st = rp.pull_state()
+    N = len(p2.node_ids)
+    exp_total, exp_avail, _ = expected_device_fold(p2, c2)
+    np.testing.assert_array_equal(st["total0"][:N], exp_total)
+    np.testing.assert_array_equal(
+        st["avail_res"][:N, :p2.avail_res.shape[1]], exp_avail)
+
+
+def test_invalidate_recovers_from_external_surgery():
+    """If the host arrays change behind the wrapper's back, invalidate()
+    resyncs with a full upload and parity holds."""
+    rng = random.Random(9)
+    infos = [make_info(rng, i) for i in range(8)]
+    enc = IncrementalEncoder()
+    rp = ResidentPlacement(enc)
+    p1 = enc.encode(infos, [random_group(rng, 0, 4)], now=NOW)
+    c1 = rp.schedule(p1)
+    apply_tick(enc, rp, infos, p1, c1)
+
+    # surgery: the CPU backend handled a tick (scheduler's auto fallback)
+    p_mid = enc.encode(infos, [random_group(rng, 1, 3)], now=NOW)
+    c_mid = batch.cpu_schedule_encoded(p_mid)
+    by_node = {i.node.id: i for i in infos}
+    task_by_id = {t.id: t for g in p_mid.groups for t in g.tasks}
+    for tid, nid in batch.materialize(p_mid, c_mid).items():
+        by_node[nid].add_task(task_by_id[tid])
+    enc.apply_counts(p_mid, c_mid)
+    rp.invalidate()
+
+    p2 = enc.encode(infos, [random_group(rng, 2, 5)], now=NOW)
+    c2 = rp.schedule(p2)
+    np.testing.assert_array_equal(c2, batch.cpu_schedule_encoded(p2))
+    assert rp.uploads_full == 2
+
+
+def test_node_churn_triggers_full_reupload_and_stays_correct():
+    rng = random.Random(10)
+    infos = [make_info(rng, i) for i in range(8)]
+    enc = IncrementalEncoder()
+    rp = ResidentPlacement(enc)
+    p1 = enc.encode(infos, [random_group(rng, 0, 5)], now=NOW)
+    c1 = rp.schedule(p1)
+    apply_tick(enc, rp, infos, p1, c1)
+
+    infos.append(make_info(rng, 99))          # join
+    infos.pop(0)                              # leave
+    p2 = enc.encode(infos, [random_group(rng, 1, 6)], now=NOW)
+    c2 = rp.schedule(p2)
+    np.testing.assert_array_equal(c2, batch.cpu_schedule_encoded(p2))
+    assert rp.uploads_full == 2               # remap → full upload
+
+
+def test_scheduler_uses_resident_path_end_to_end():
+    """Store → Scheduler(backend=jax) → tasks ASSIGNED, across two waves,
+    with the resident wrapper active and folding between waves."""
+    import time
+
+    from swarmkit_tpu.api.objects import Node, Service
+    from swarmkit_tpu.api.types import NodeAvailability, NodeStatusState
+    from swarmkit_tpu.scheduler.scheduler import Scheduler
+    from swarmkit_tpu.store.memory import MemoryStore
+
+    store = MemoryStore()
+
+    def seed(tx):
+        for i in range(6):
+            n = Node(id=f"n{i:02d}")
+            n.status.state = NodeStatusState.READY
+            n.spec.availability = NodeAvailability.ACTIVE
+            tx.create(n)
+        for w in range(8):
+            t = Task(id=f"t0-{w:02d}", service_id="s1", slot=w + 1)
+            t.desired_state = TaskState.RUNNING
+            t.status.state = TaskState.PENDING
+            tx.create(t)
+
+    store.update(seed)
+    sched = Scheduler(store, backend="jax")
+    sched.start()
+    try:
+        def wave_done(prefix, n):
+            tasks = store.view(lambda tx: tx.find_tasks())
+            mine = [t for t in tasks if t.id.startswith(prefix)]
+            return len(mine) == n and all(
+                t.status.state == TaskState.ASSIGNED and t.node_id
+                for t in mine)
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not wave_done("t0-", 8):
+            time.sleep(0.1)
+        assert wave_done("t0-", 8)
+        assert sched._resident is not None
+
+        def wave2(tx):
+            for w in range(5):
+                t = Task(id=f"t1-{w:02d}", service_id="s1", slot=20 + w)
+                t.desired_state = TaskState.RUNNING
+                t.status.state = TaskState.PENDING
+                tx.create(t)
+
+        store.update(wave2)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not wave_done("t1-", 5):
+            time.sleep(0.1)
+        assert wave_done("t1-", 5)
+    finally:
+        sched.stop()
