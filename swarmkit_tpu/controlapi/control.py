@@ -26,6 +26,7 @@ from ..api.objects import (
     Volume,
 )
 from ..api.specs import ClusterSpec, ConfigSpec, NetworkSpec, SecretSpec, \
+    UpdateConfig, \
     ServiceSpec, VolumeSpec
 from ..api.types import NodeRole, ServiceMode, TaskState
 from ..scheduler import constraint as constraint_mod
@@ -37,6 +38,7 @@ from .errors import (
     FailedPrecondition,
     InvalidArgument,
     NotFound,
+    Unimplemented,
 )
 
 # Docker object-name grammar (reference: controlapi/service.go validateAnnotations
@@ -49,6 +51,10 @@ MAX_SECRET_SIZE = 500 * 1024
 MAX_CONFIG_SIZE = 1000 * 1024
 
 VALID_PORT_PROTOCOLS = {"tcp", "udp", "sctp"}
+
+# jobs must not deviate from this (service.go validateJob rejects any
+# update config; the field is non-optional here)
+_DEFAULT_UPDATE_CONFIG = UpdateConfig()
 
 
 @dataclass
@@ -118,8 +124,118 @@ class ControlAPI:
                 f"invalid name {annotations.name!r}: must match "
                 f"{_NAME_RE.pattern}")
 
+    # minimum schedulable quanta (service.go validateResources:34-50)
+    MIN_NANO_CPUS = 1_000_000           # 0.001 of a core
+    MIN_MEMORY_BYTES = 4 * 1024 * 1024  # 4 MiB
+
+    @classmethod
+    def _validate_resources(cls, r, what: str) -> None:
+        """service.go validateResources — a nonzero request below the
+        schedulable quantum can never be satisfied sensibly."""
+        if r is None:
+            return
+        if r.nano_cpus != 0 and r.nano_cpus < cls.MIN_NANO_CPUS:
+            raise InvalidArgument(
+                f"invalid cpu value in {what}: must be at least "
+                f"{cls.MIN_NANO_CPUS / 1e9:g} cores")
+        if r.memory_bytes != 0 and r.memory_bytes < cls.MIN_MEMORY_BYTES:
+            raise InvalidArgument(
+                f"invalid memory value in {what}: must be at least 4MiB")
+        for kind, qty in (r.generic or {}).items():
+            if qty < 0:
+                raise InvalidArgument(
+                    f"invalid generic resource {kind!r} in {what}: "
+                    "quantity must be non-negative")
+
+    @staticmethod
+    def _validate_restart_policy(rp) -> None:
+        """service.go validateRestartPolicy:62-88."""
+        if rp is None:
+            return
+        if rp.delay < 0:
+            raise InvalidArgument("restart-delay cannot be negative")
+        if rp.window < 0:
+            raise InvalidArgument("restart-window cannot be negative")
+        if rp.max_attempts < 0:
+            raise InvalidArgument("restart-max-attempts cannot be negative")
+
+    @staticmethod
+    def _validate_update_config(cfg, what: str) -> None:
+        """service.go validateUpdate:98-122."""
+        if cfg is None:
+            return
+        if cfg.delay < 0:
+            raise InvalidArgument(f"{what}-delay cannot be negative")
+        if cfg.monitor < 0:
+            raise InvalidArgument(f"{what}-monitor cannot be negative")
+        if not 0 <= cfg.max_failure_ratio <= 1:
+            raise InvalidArgument(
+                f"{what}-maxfailureratio cannot be less than 0 or bigger "
+                "than 1")
+        if cfg.parallelism < 0:
+            raise InvalidArgument(f"{what}-parallelism cannot be negative")
+
+    @staticmethod
+    def _validate_endpoint_spec(ep) -> None:
+        """service.go validateEndpointSpec:316-355: DNSRR cannot publish
+        through the routing mesh, and two ports may not claim the same
+        (published port, protocol)."""
+        seen: set[tuple[int, str]] = set()
+        for p in ep.ports:
+            if p.protocol and p.protocol not in VALID_PORT_PROTOCOLS:
+                raise InvalidArgument(f"invalid protocol {p.protocol!r}")
+            if not p.target_port:
+                raise InvalidArgument("port config must include target_port")
+            if p.publish_mode not in ("ingress", "host"):
+                raise InvalidArgument(
+                    f"invalid publish mode {p.publish_mode!r}")
+            if ep.mode == "dnsrr" and p.publish_mode == "ingress":
+                raise InvalidArgument(
+                    "port published with ingress mode can't be used with "
+                    "dnsrr mode")
+            if p.published_port == 0:
+                continue
+            key = (p.published_port, p.protocol or "tcp")
+            if key in seen:
+                raise InvalidArgument(
+                    "duplicate published ports provided")
+            seen.add(key)
+
+    @staticmethod
+    def _validate_refs(refs, kind: str) -> None:
+        """service.go validateSecretRefsSpec/validateConfigRefsSpec: ids,
+        names, and targets are mandatory; file targets must be unique."""
+        targets: dict[str, str] = {}
+        for ref in refs:
+            rid = getattr(ref, f"{kind}_id")
+            rname = getattr(ref, f"{kind}_name")
+            if not rid or not rname:
+                raise InvalidArgument(f"malformed {kind} reference")
+            if not ref.target:
+                raise InvalidArgument(
+                    f"malformed {kind} reference, no target provided")
+            prev = targets.get(ref.target)
+            if prev is not None:
+                raise InvalidArgument(
+                    f"{kind} references {prev!r} and {rname!r} have a "
+                    f"conflicting target: {ref.target!r}")
+            targets[ref.target] = rname
+
+    @staticmethod
+    def _validate_mounts(mounts) -> None:
+        """service.go validateMounts:177-188: targets are mandatory and
+        absolute (the sandbox mount namespace has no working directory)."""
+        for m in mounts:
+            if not m.target:
+                raise InvalidArgument("mount target must be provided")
+            if not m.target.startswith("/"):
+                raise InvalidArgument(
+                    f"mount target {m.target!r} must be an absolute path")
+
     def _validate_service_spec(self, tx, spec: ServiceSpec) -> None:
-        """reference: controlapi/service.go validateServiceSpec."""
+        """The create/update-time catalogue, mirroring
+        controlapi/service.go validateServiceSpec + the Server-side
+        existence/conflict checks (:527-726)."""
         if spec is None:
             raise InvalidArgument("spec must be provided")
         self._validate_annotations(spec.annotations)
@@ -130,8 +246,23 @@ class ControlAPI:
                 constraint_mod.parse(exprs)
             except constraint_mod.InvalidConstraint as e:
                 raise InvalidArgument(f"invalid placement constraint: {e}")
+        if spec.task.placement.max_replicas < 0:
+            raise InvalidArgument("max-replicas cannot be negative")
+        res = spec.task.resources
+        self._validate_resources(res.reservations, "reservations")
+        self._validate_resources(res.limits, "limits")
+        self._validate_restart_policy(spec.task.restart)
         if spec.mode == ServiceMode.REPLICATED and spec.replicas < 0:
             raise InvalidArgument("replicas must be non-negative")
+        if spec.mode == ServiceMode.REPLICATED_JOB:
+            # service.go validateMode: blind int casts must not smuggle
+            # huge values in as negatives
+            if spec.job.max_concurrent < 0:
+                raise InvalidArgument(
+                    "maximum concurrent jobs must not be negative")
+            if spec.job.total_completions < 0:
+                raise InvalidArgument(
+                    "total completed jobs must not be negative")
         if spec.mode in (ServiceMode.REPLICATED_JOB, ServiceMode.GLOBAL_JOB):
             # reference: service.go validateJob — a job task must stay
             # finished, so restart-on-success is invalid regardless of any
@@ -140,20 +271,20 @@ class ControlAPI:
                 raise InvalidArgument(
                     "jobs may not restart on success; use restart-condition "
                     "none or on-failure")
-        for p in spec.endpoint.ports:
-            if p.protocol and p.protocol not in VALID_PORT_PROTOCOLS:
-                raise InvalidArgument(f"invalid protocol {p.protocol!r}")
-            if not p.target_port:
-                raise InvalidArgument("port config must include target_port")
-        update_cfgs = [spec.update]
-        if spec.rollback is not None:
-            update_cfgs.append(spec.rollback)
-        for cfg in update_cfgs:
-            if cfg is not None and cfg.max_failure_ratio > 1:
-                raise InvalidArgument("max_failure_ratio must be <= 1")
-        # referenced secrets/configs/networks must exist
+            # jobs may not carry an update config (service.go validateJob);
+            # UpdateConfig is a non-optional field here, so 'carrying one'
+            # means deviating from the defaults
+            if spec.update != _DEFAULT_UPDATE_CONFIG:
+                raise InvalidArgument("jobs may not have an update config")
+        self._validate_endpoint_spec(spec.endpoint)
+        self._validate_update_config(spec.update, "update")
+        self._validate_update_config(spec.rollback, "rollback")
+        # referenced secrets/configs/networks must exist; refs well-formed
         runtime = spec.task.runtime
         if runtime is not None:
+            self._validate_refs(runtime.secrets, "secret")
+            self._validate_refs(runtime.configs, "config")
+            self._validate_mounts(getattr(runtime, "mounts", []) or [])
             for ref in runtime.secrets:
                 if tx.get_secret(ref.secret_id) is None:
                     raise InvalidArgument(
@@ -163,8 +294,54 @@ class ControlAPI:
                     raise InvalidArgument(
                         f"config {ref.config_id} not found")
         for na in spec.task.networks + spec.networks:
-            if na.target and tx.get_network(na.target) is None:
-                raise InvalidArgument(f"network {na.target} not found")
+            if na.target:
+                net = tx.get_network(na.target)
+                if net is None:
+                    raise InvalidArgument(f"network {na.target} not found")
+                if net.spec.ingress:
+                    # service.go validateNetworks:468-483
+                    raise InvalidArgument(
+                        "service cannot be explicitly attached to the "
+                        f"ingress network {net.spec.annotations.name!r}")
+
+    def _check_port_conflicts(self, tx, spec: ServiceSpec,
+                              service_id: str | None) -> None:
+        """service.go checkPortConflicts:570-664: an ingress-published
+        (port, protocol) must be cluster-unique; host-published ports may
+        collide with each other (the scheduler spreads them) but not with
+        an ingress port."""
+        mine = [(p.published_port, p.protocol or "tcp", p.publish_mode)
+                for p in spec.endpoint.ports if p.published_port != 0]
+        if not mine:
+            return
+        my_ingress = {(pp, pr) for pp, pr, m in mine if m == "ingress"}
+        my_host = {(pp, pr) for pp, pr, m in mine if m == "host"}
+        for svc in tx.find_services():
+            if service_id is not None and svc.id == service_id:
+                continue
+            # both the spec's ports AND the allocator-materialized endpoint
+            # ports count (service.go:644-660): a dynamically assigned
+            # ingress port lives only on svc.endpoint
+            theirs = [(p.published_port, p.protocol or "tcp",
+                       p.publish_mode) for p in svc.spec.endpoint.ports]
+            theirs += [(pp, proto or "tcp", mode)
+                       for (proto, _tp, pp, mode)
+                       in (svc.endpoint or {}).get("ports", [])]
+            for pp, proto, mode in theirs:
+                if pp == 0:
+                    continue
+                key = (pp, proto)
+                if mode == "ingress":
+                    if key in my_ingress or key in my_host:
+                        raise InvalidArgument(
+                            f"port '{key[0]}' is already in use by service "
+                            f"'{svc.spec.annotations.name}' ({svc.id}) as "
+                            "an ingress port")
+                elif key in my_ingress:
+                    raise InvalidArgument(
+                        f"port '{key[0]}' is already in use by service "
+                        f"'{svc.spec.annotations.name}' ({svc.id}) as a "
+                        "host-published port")
 
     # -------------------------------------------------------------- services
     def create_service(self, spec: ServiceSpec) -> Service:
@@ -176,6 +353,7 @@ class ControlAPI:
 
         def cb(tx):
             self._validate_service_spec(tx, spec)
+            self._check_port_conflicts(tx, spec, None)
             if tx.find_services(by.ByName(spec.annotations.name)):
                 raise AlreadyExists(
                     f"service {spec.annotations.name} already exists")
@@ -201,12 +379,27 @@ class ControlAPI:
             if cur is None or cur.pending_delete:
                 raise NotFound(f"service {service_id} not found")
             self._validate_service_spec(tx, spec)
+            # conflicts are checked only when the endpoint spec actually
+            # changes (service.go:837 DeepEqual guard): pre-validation
+            # state restored from an old WAL must stay updatable
+            if spec.endpoint != cur.spec.endpoint:
+                self._check_port_conflicts(tx, spec, service_id)
             if cur.meta.version.index != version.index:
                 raise FailedPrecondition("update out of sequence")
             if spec.annotations.name != cur.spec.annotations.name:
                 raise InvalidArgument("renaming services is not supported")
             if spec.mode != cur.spec.mode:
                 raise InvalidArgument("service mode change is not supported")
+            # service.go UpdateService:849-857: changing the deprecated
+            # spec.networks alone (full attachment configs, not just
+            # targets) is unsupported — unless task.networks is being
+            # updated in the same request (a migration to it)
+            if not rollback \
+                    and (spec.networks or cur.spec.networks) \
+                    and spec.networks != cur.spec.networks \
+                    and spec.task.networks == cur.spec.task.networks:
+                raise Unimplemented(
+                    "changing network in service is not supported")
             nxt = cur.copy()
             if rollback:
                 if cur.previous_spec is None:

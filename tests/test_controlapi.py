@@ -115,7 +115,8 @@ def test_secret_lifecycle(api):
     # removal blocked while referenced
     s = spec(name="user")
     s.task.runtime = ContainerSpec(image="img")
-    s.task.runtime.secrets = [SecretReference(secret_id=sec.id)]
+    s.task.runtime.secrets = [SecretReference(
+        secret_id=sec.id, secret_name="tls-key", target="key.pem")]
     svc = api.create_service(s)
     with pytest.raises(InvalidArgument):
         api.remove_secret(sec.id)
@@ -322,3 +323,331 @@ def test_watchapi_resume_replay():
     ev = ch.get(timeout=2)
     assert ev.obj.spec.annotations.name == "b"
     ch.close()
+
+
+# --------------------------------------------------------------------------
+# Service-spec validation catalogue (table-driven, mirroring the case
+# structure of reference manager/controlapi/service_test.go:
+# TestValidateResources / RestartPolicy / Update / EndpointSpec /
+# SecretRefs / ConfigRefs / Mounts / Mode / Job / checkPortConflicts).
+# --------------------------------------------------------------------------
+
+def _base_spec(name="vsvc"):
+    from swarmkit_tpu.api.specs import TaskSpec
+
+    s = ServiceSpec(annotations=Annotations(name=name),
+                    task=TaskSpec(runtime=ContainerSpec(command=["true"])))
+    return s
+
+
+def _bad_specs():
+    from swarmkit_tpu.api.specs import (
+        ConfigReference,
+        JobSpec,
+        NetworkAttachmentConfig,
+        UpdateConfig,
+        VolumeMount,
+    )
+    from swarmkit_tpu.api.types import RestartCondition
+
+    def case(desc, msg, fn):
+        def build():
+            s = _base_spec()
+            fn(s)
+            return s
+        return pytest.param(build, msg, id=desc)
+
+    def set_(path, value):
+        def fn(s):
+            obj = s
+            *head, last = path.split(".")
+            for part in head:
+                obj = getattr(obj, part)
+            setattr(obj, last, value)
+        return fn
+
+    def job(fn_extra=None):
+        def fn(s):
+            s.mode = ServiceMode.REPLICATED_JOB
+            s.job = JobSpec(max_concurrent=1, total_completions=1)
+            s.task.restart.condition = RestartCondition.NONE
+            if fn_extra:
+                fn_extra(s)
+        return fn
+
+    return [
+        # ---- resources (validateResources) ----
+        case("cpu-below-quantum", "invalid cpu",
+             set_("task.resources.reservations.nano_cpus", 1000)),
+        case("mem-below-4mib", "invalid memory",
+             set_("task.resources.reservations.memory_bytes", 1 << 20)),
+        case("limits-cpu-below-quantum", "invalid cpu",
+             set_("task.resources.limits.nano_cpus", 5)),
+        case("negative-generic", "non-negative",
+             lambda s: s.task.resources.reservations.generic.update(
+                 {"gpu": -1})),
+        # ---- restart policy ----
+        case("restart-delay-negative", "restart-delay",
+             set_("task.restart.delay", -1.0)),
+        case("restart-window-negative", "restart-window",
+             set_("task.restart.window", -0.5)),
+        case("restart-attempts-negative", "restart-max-attempts",
+             set_("task.restart.max_attempts", -2)),
+        # ---- update / rollback config ----
+        case("update-delay-negative", "update-delay",
+             set_("update.delay", -1.0)),
+        case("update-monitor-negative", "update-monitor",
+             set_("update.monitor", -1.0)),
+        case("update-ratio-negative", "maxfailureratio",
+             set_("update.max_failure_ratio", -0.1)),
+        case("update-ratio-above-1", "maxfailureratio",
+             set_("update.max_failure_ratio", 1.5)),
+        case("update-parallelism-negative", "parallelism",
+             set_("update.parallelism", -1)),
+        case("rollback-delay-negative", "rollback-delay",
+             lambda s: setattr(s, "rollback", UpdateConfig(delay=-3.0))),
+        # ---- endpoint spec ----
+        case("dnsrr-with-ingress-port", "dnsrr", lambda s: (
+            setattr(s.endpoint, "mode", "dnsrr"),
+            s.endpoint.ports.append(PortConfig(
+                protocol="tcp", target_port=80, published_port=8080,
+                publish_mode="ingress")))),
+        case("duplicate-published-ports", "duplicate", lambda s: (
+            s.endpoint.ports.extend([
+                PortConfig(protocol="tcp", target_port=80,
+                           published_port=8080),
+                PortConfig(protocol="tcp", target_port=81,
+                           published_port=8080)]))),
+        case("bad-publish-mode", "publish mode", lambda s: (
+            s.endpoint.ports.append(PortConfig(
+                protocol="tcp", target_port=80, publish_mode="weird")))),
+        case("missing-target-port", "target_port", lambda s: (
+            s.endpoint.ports.append(PortConfig(protocol="tcp")))),
+        case("bad-protocol", "protocol", lambda s: (
+            s.endpoint.ports.append(PortConfig(protocol="icmp",
+                                               target_port=80)))),
+        # ---- secret / config refs ----
+        case("secret-ref-no-id", "malformed secret", lambda s: (
+            s.task.runtime.secrets.append(SecretReference(
+                secret_name="x", target="f")))),
+        case("secret-ref-no-name", "malformed secret", lambda s: (
+            s.task.runtime.secrets.append(SecretReference(
+                secret_id="sid", target="f")))),
+        case("secret-ref-no-target", "no target", lambda s: (
+            s.task.runtime.secrets.append(SecretReference(
+                secret_id="sid", secret_name="x")))),
+        case("secret-refs-conflicting-target", "conflicting", lambda s: (
+            s.task.runtime.secrets.extend([
+                SecretReference(secret_id="a", secret_name="na", target="f"),
+                SecretReference(secret_id="b", secret_name="nb",
+                                target="f")]))),
+        case("secret-ref-nonexistent", "not found", lambda s: (
+            s.task.runtime.secrets.append(SecretReference(
+                secret_id="ghost", secret_name="g", target="f")))),
+        case("config-ref-no-id", "malformed config", lambda s: (
+            s.task.runtime.configs.append(ConfigReference(
+                config_name="x", target="f")))),
+        case("config-refs-conflicting-target", "conflicting", lambda s: (
+            s.task.runtime.configs.extend([
+                ConfigReference(config_id="a", config_name="na", target="f"),
+                ConfigReference(config_id="b", config_name="nb",
+                                target="f")]))),
+        # ---- mounts ----
+        case("mount-no-target", "mount target", lambda s: (
+            s.task.runtime.mounts.append(VolumeMount(source="v")))),
+        case("mount-relative-target", "absolute", lambda s: (
+            s.task.runtime.mounts.append(VolumeMount(source="v",
+                                                     target="rel/path")))),
+        # ---- mode / job ----
+        case("negative-replicas", "non-negative",
+             set_("replicas", -1)),
+        case("negative-max-replicas", "max-replicas",
+             set_("task.placement.max_replicas", -1)),
+        case("job-negative-concurrent", "concurrent",
+             job(lambda s: setattr(s.job, "max_concurrent", -1))),
+        case("job-negative-completions", "not be negative",
+             job(lambda s: setattr(s.job, "total_completions", -1))),
+        case("job-with-update-config", "update config",
+             job(lambda s: setattr(s.update, "parallelism", 7))),
+        case("job-restart-any", "restart",
+             job(lambda s: setattr(s.task.restart, "condition",
+                                   __import__("swarmkit_tpu.api.types",
+                                              fromlist=["RestartCondition"])
+                                   .RestartCondition.ANY))),
+        # ---- constraints / networks ----
+        case("bad-constraint", "constraint",
+             lambda s: s.task.placement.constraints.append("node.labels =")),
+        case("nonexistent-network", "not found", lambda s: (
+            s.task.networks.append(NetworkAttachmentConfig(
+                target="no-such-net")))),
+    ]
+
+
+@pytest.mark.parametrize("build,msg", _bad_specs())
+def test_create_service_rejects_invalid_spec(api, build, msg):
+    with pytest.raises(InvalidArgument) as exc:
+        api.create_service(build())
+    assert msg.lower() in str(exc.value).lower(), str(exc.value)
+    # nothing was created
+    assert api.list_services() == []
+
+
+def test_valid_spec_boundaries_accepted(api):
+    """The catalogue must not over-reject: boundary values are legal."""
+    s = _base_spec("boundary")
+    s.task.resources.reservations.nano_cpus = 1_000_000        # exactly min
+    s.task.resources.reservations.memory_bytes = 4 * 1024 * 1024
+    s.update.max_failure_ratio = 1.0
+    s.endpoint.ports.extend([
+        PortConfig(protocol="tcp", target_port=80, published_port=8080),
+        PortConfig(protocol="udp", target_port=80, published_port=8080),
+    ])  # same port, different protocol: legal
+    api.create_service(s)
+
+
+def test_ingress_network_attachment_rejected(api):
+    from swarmkit_tpu.api.specs import NetworkAttachmentConfig
+
+    ing = api.create_network(NetworkSpec(
+        annotations=Annotations(name="ingress"), ingress=True))
+    s = _base_spec("wants-ingress")
+    s.task.networks.append(NetworkAttachmentConfig(target=ing.id))
+    with pytest.raises(InvalidArgument) as exc:
+        api.create_service(s)
+    assert "ingress" in str(exc.value)
+
+
+def test_port_conflict_matrix(api):
+    """service.go checkPortConflicts: ingress ports are cluster-unique;
+    host ports may collide with each other but not with ingress."""
+    def with_port(name, mode, port=8088):
+        s = _base_spec(name)
+        s.endpoint.ports.append(PortConfig(
+            protocol="tcp", target_port=80, published_port=port,
+            publish_mode=mode))
+        return s
+
+    a = api.create_service(with_port("ing-a", "ingress"))
+    with pytest.raises(InvalidArgument) as exc:
+        api.create_service(with_port("ing-b", "ingress"))
+    assert "already in use" in str(exc.value)
+    with pytest.raises(InvalidArgument):
+        api.create_service(with_port("host-b", "host"))
+
+    # distinct port is fine; host+host sharing is fine
+    api.create_service(with_port("host-c", "host", port=8090))
+    api.create_service(with_port("host-d", "host", port=8090))
+    # ...but ingress over an existing host port is not
+    with pytest.raises(InvalidArgument):
+        api.create_service(with_port("ing-e", "ingress", port=8090))
+
+    # updating the SAME service keeps its own ports without conflicting
+    got = api.get_service(a.id)
+    new = with_port("ing-a", "ingress")
+    new.replicas = 2
+    api.update_service(a.id, got.meta.version, new)
+
+
+def test_update_networks_alone_rejected(api):
+    from swarmkit_tpu.api.specs import NetworkAttachmentConfig
+    from swarmkit_tpu.controlapi import Unimplemented
+
+    n1 = api.create_network(NetworkSpec(annotations=Annotations(name="n1")))
+    n2 = api.create_network(NetworkSpec(annotations=Annotations(name="n2")))
+    s = _base_spec("netsvc")
+    s.networks.append(NetworkAttachmentConfig(target=n1.id))
+    svc = api.create_service(s)
+
+    got = api.get_service(svc.id)
+    upd = _base_spec("netsvc")
+    upd.networks.append(NetworkAttachmentConfig(target=n2.id))
+    with pytest.raises(Unimplemented):
+        api.update_service(svc.id, got.meta.version, upd)
+
+    # migrating to task.networks in the same request is allowed
+    upd2 = _base_spec("netsvc")
+    upd2.networks.append(NetworkAttachmentConfig(target=n2.id))
+    upd2.task.networks.append(NetworkAttachmentConfig(target=n2.id))
+    api.update_service(svc.id, got.meta.version, upd2)
+
+
+def test_dynamic_ingress_port_conflicts_at_create(api):
+    """service.go:644-660: a dynamically assigned ingress port lives only
+    on svc.endpoint — explicit publishers of that port must be rejected."""
+    s = _base_spec("dyn")
+    s.endpoint.ports.append(PortConfig(protocol="tcp", target_port=80,
+                                       published_port=0,
+                                       publish_mode="ingress"))
+    svc = api.create_service(s)
+    # simulate the allocator materializing the dynamic port 30000
+    def alloc(tx):
+        cur = tx.get_service(svc.id).copy()
+        cur.endpoint = {"ports_allocated": True,
+                        "ports": [("tcp", 80, 30000, "ingress")],
+                        "virtual_ips": []}
+        tx.update(cur)
+    api.store.update(alloc)
+
+    thief = _base_spec("thief")
+    thief.endpoint.ports.append(PortConfig(protocol="tcp", target_port=81,
+                                           published_port=30000,
+                                           publish_mode="ingress"))
+    with pytest.raises(InvalidArgument) as exc:
+        api.create_service(thief)
+    assert "already in use" in str(exc.value)
+
+
+def test_update_endpoint_unchanged_skips_conflict_check(api):
+    """Grandfathered pre-validation state must stay updatable as long as
+    the endpoint spec is untouched (service.go:837 DeepEqual guard)."""
+    def mk(name):
+        s = _base_spec(name)
+        s.endpoint.ports.append(PortConfig(protocol="tcp", target_port=80,
+                                           published_port=9300,
+                                           publish_mode="ingress"))
+        return s
+
+    # two conflicting services written straight to the store (no API)
+    import swarmkit_tpu.api.objects as objs
+    from swarmkit_tpu.api.objects import Version as V
+
+    def seed(tx):
+        for name in ("old-a", "old-b"):
+            svc = objs.Service(id=f"legacy-{name}", spec=mk(name))
+            svc.spec_version = V(1)
+            tx.create(svc)
+    api.store.update(seed)
+
+    # scaling one of them (endpoint untouched) must work
+    got = api.get_service("legacy-old-a")
+    upd = mk("old-a")
+    upd.replicas = 3
+    api.update_service("legacy-old-a", got.meta.version, upd)
+    # ...but changing its endpoint re-runs the conflict check
+    got = api.get_service("legacy-old-a")
+    upd2 = mk("old-a")
+    upd2.endpoint.ports[0].published_port = 9300
+    upd2.endpoint.ports.append(PortConfig(protocol="udp", target_port=80,
+                                          published_port=9300,
+                                          publish_mode="ingress"))
+    with pytest.raises(InvalidArgument):
+        api.update_service("legacy-old-a", got.meta.version, upd2)
+
+
+def test_update_network_aliases_alone_rejected(api):
+    """Full attachment configs compare (reference DeepEqual), not just
+    targets: an aliases-only change to spec.networks must be refused."""
+    from swarmkit_tpu.api.specs import NetworkAttachmentConfig
+    from swarmkit_tpu.controlapi import Unimplemented
+
+    n1 = api.create_network(NetworkSpec(annotations=Annotations(name="m1")))
+    s = _base_spec("aliassvc")
+    s.networks.append(NetworkAttachmentConfig(target=n1.id))
+    svc = api.create_service(s)
+
+    got = api.get_service(svc.id)
+    upd = _base_spec("aliassvc")
+    upd.networks.append(NetworkAttachmentConfig(target=n1.id,
+                                                aliases=["new-alias"]))
+    with pytest.raises(Unimplemented):
+        api.update_service(svc.id, got.meta.version, upd)
