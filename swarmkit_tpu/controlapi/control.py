@@ -406,10 +406,24 @@ class ControlAPI:
                     raise FailedPrecondition("service has no previous spec")
                 nxt.spec = cur.previous_spec
                 nxt.previous_spec = None
+                # manual rollback both unblocks a paused update and records
+                # why the spec flipped (service.go UpdateService:903-907)
+                import time as _time
+
+                from ..api.types import UpdateStatusState
+
+                nxt.update_status = {
+                    "state": UpdateStatusState.ROLLBACK_STARTED.value,
+                    "message": "manually requested rollback",
+                    "timestamp": _time.time(),
+                }
             else:
                 nxt.previous_spec = cur.spec
                 nxt.previous_spec_version = Version(cur.spec_version.index)
                 nxt.spec = spec
+                # a fresh spec resets any paused/completed update status so
+                # the updater may run again (service.go UpdateService:919)
+                nxt.update_status = None
             nxt.spec_version = Version(cur.spec_version.index + 1)
             tx.update(nxt)
             out.append(nxt)

@@ -12,6 +12,7 @@ updater.go:49-75).
 from __future__ import annotations
 
 import logging
+import queue as queue_mod
 import threading
 import time
 
@@ -37,6 +38,9 @@ class Updater(threading.Thread):
         self.service_id = service_id
         self.supervisor = supervisor
         self._cancel = threading.Event()
+        # failure-policy abort: in-flight slot waits unwind promptly, but
+        # (unlike cancel) the final status still gets written
+        self._abort = threading.Event()
 
     def cancel(self):
         self._cancel.set()
@@ -51,105 +55,202 @@ class Updater(threading.Thread):
         service = self.store.view().get_service(self.service_id)
         if service is None:
             return
-        cfg = service.spec.update
-        self._set_update_status(UpdateStatusState.UPDATING, "update in progress")
+        # a PAUSED update stays paused until the operator acts: the spec
+        # update that resolves it clears update_status (controlapi), and
+        # only then may a fresh updater run (updater.go Run:129-134)
+        state = (service.update_status or {}).get("state")
+        if state in (UpdateStatusState.PAUSED.value,
+                     UpdateStatusState.ROLLBACK_PAUSED.value):
+            return
+        # a rollback in progress keeps the rollback status family and uses
+        # the rollback config (updater.go Run:162-170)
+        rolling_back = state == UpdateStatusState.ROLLBACK_STARTED.value
+        if rolling_back:
+            from ..api.defaults import default_update_config
 
-        # monitored: task_id -> monitor deadline; failures accrue
-        # asynchronously so batches are NOT serialized behind the window
-        # (the reference overlaps monitoring with subsequent batches)
+            cfg = service.spec.rollback or default_update_config()
+        else:
+            cfg = service.spec.update
+            self._set_update_status(UpdateStatusState.UPDATING,
+                                    "update in progress")
+
+        # Worker-pool shape (updater.go:190-260): `parallelism` workers
+        # pull slots from a queue, each flipping independently — a slot
+        # wedged in its per-slot deadline occupies ONE worker while the
+        # others keep rolling; monitor windows overlap everything and
+        # failures accrue asynchronously.
+        lock = threading.Lock()
         monitored: dict[str, float] = {}
         failed: set[str] = set()
-        updated = 0
+        counters = {"updated": 0}
+        in_flight: set[int] = set()          # slot numbers queued/flipping
+        slot_q: queue_mod.Queue = queue_mod.Queue()
+        no_more = threading.Event()
 
         def poll_failures():
-            if not monitored:
+            with lock:
+                pending = list(monitored)
+            if not pending:
                 return
             view = self.store.view()
             now = time.monotonic()
-            for tid in list(monitored):
+            for tid in pending:
                 t = view.get_task(tid)
-                if t is not None and t.status.state in (
-                        TaskState.FAILED, TaskState.REJECTED):
-                    failed.add(tid)
-                    del monitored[tid]
-                elif now > monitored[tid]:
-                    del monitored[tid]  # window expired healthy
+                with lock:
+                    if tid not in monitored:
+                        continue
+                    if t is not None and t.status.state in (
+                            TaskState.FAILED, TaskState.REJECTED):
+                        failed.add(tid)
+                        del monitored[tid]
+                    elif now > monitored[tid]:
+                        del monitored[tid]  # window expired healthy
 
         def over_threshold() -> bool:
-            total = max(updated, 1)
-            return (cfg.max_failure_ratio >= 0 and failed
-                    and len(failed) / total > cfg.max_failure_ratio)
+            with lock:
+                total = max(counters["updated"], 1)
+                return (cfg.max_failure_ratio >= 0 and failed
+                        and len(failed) / total > cfg.max_failure_ratio)
 
-        while not self._cancel.is_set():
-            service = self.store.view().get_service(self.service_id)
-            if service is None:
-                return
-            dirty = self._dirty_slots(service)
-            if not dirty:
-                break
-            parallelism = cfg.parallelism or len(dirty)
-            batch = dirty[:parallelism]
-            # slot flips observe task states (two-phase orders), so the
-            # batch runs them concurrently like the reference's worker
-            # pool (updater.go:190-200)
-            new_ids: list[str | None] = [None] * len(batch)
+        def pacing_wait(seconds: float) -> bool:
+            """Sleep that also wakes on abort / pool drain. True = bail."""
+            deadline = time.monotonic() + seconds
+            while True:
+                if self._abort.is_set() or no_more.is_set():
+                    return False  # no point pacing a finished update
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                if self._cancel.wait(min(0.1, remaining)):
+                    return True
 
-            def flip(i, slot_tasks):
+        def worker():
+            while not (self._cancel.is_set() or self._abort.is_set()):
                 try:
-                    new_ids[i] = self._update_slot(slot_tasks, cfg.order)
+                    slot_tasks = slot_q.get(timeout=0.1)
+                except queue_mod.Empty:
+                    if no_more.is_set():
+                        return
+                    continue
+                outcome, nid = "error", None
+                try:
+                    outcome, nid = self._update_slot(slot_tasks, cfg.order)
                 except Exception:
                     log.exception("updater %s: slot flip failed",
                                   self.service_id[:8])
-                    new_ids[i] = None
-
-            workers = [threading.Thread(target=flip, args=(i, st),
-                                        daemon=True)
-                       for i, st in enumerate(batch)]
-            for w in workers:
-                w.start()
-            for w in workers:
-                w.join()
-            for nid in new_ids:
-                if nid is None:
-                    continue  # failed flips don't dilute the failure ratio
-                if cfg.monitor > 0:
-                    monitored[nid] = time.monotonic() + cfg.monitor
-                updated += 1
-            if not any(new_ids):
-                # every flip failed (store unavailable during churn): back
-                # off instead of hot-spinning fresh batches
-                if self._cancel.wait(1.0):
+                with lock:
+                    in_flight.discard(slot_tasks[0].slot)
+                    if outcome == "ok" and nid is not None:
+                        counters["updated"] += 1
+                        if cfg.monitor > 0:
+                            monitored[nid] = time.monotonic() + cfg.monitor
+                    elif outcome == "failed":
+                        # per-slot deadline expired: the wedged replacement
+                        # was removed; it counts toward the failure ratio
+                        # instead of stalling the update (round-2 verdict #7)
+                        counters["updated"] += 1
+                        failed.add(nid or f"slot-{slot_tasks[0].slot}")
+                if outcome == "error":
+                    # store unavailable during churn: the slot stays dirty
+                    # and re-queues; back off instead of hot-spinning
+                    if pacing_wait(1.0):
+                        return
+                if cfg.delay > 0 and pacing_wait(cfg.delay):
                     return
-            poll_failures()
-            # CONTINUE keeps rolling despite failures; PAUSE/ROLLBACK stop
-            if over_threshold() and \
-                    cfg.failure_action != UpdateFailureAction.CONTINUE:
-                break
-            if cfg.delay > 0 and self._cancel.wait(cfg.delay):
-                return
 
-        # drain remaining monitor windows (non-blocking batches above mean
-        # only the tail waits here), still reacting to failures promptly
-        while monitored and not self._cancel.is_set() and not over_threshold():
+        workers: list[threading.Thread] = []
+
+        def ensure_workers(want: int):
+            while len(workers) < want:
+                w = threading.Thread(target=worker, daemon=True,
+                                     name=f"{self.name}-w{len(workers)}")
+                w.start()
+                workers.append(w)
+
+        aborted = False
+        try:
+            while not self._cancel.is_set():
+                poll_failures()
+                # CONTINUE keeps rolling despite failures; PAUSE/ROLLBACK
+                # stop — checked BEFORE queueing retries, or a failed slot
+                # would start one more doomed flip on its way out
+                if over_threshold() and \
+                        cfg.failure_action != UpdateFailureAction.CONTINUE:
+                    aborted = True
+                    self._abort.set()  # unwind in-flight waits promptly
+                    break
+                service = self.store.view().get_service(self.service_id)
+                if service is None:
+                    self._abort.set()  # flips are moot: unwind and drain
+                    return
+                with lock:
+                    busy = set(in_flight)
+                fresh = [st for st in self._dirty_slots(service)
+                         if st[0].slot not in busy]
+                with lock:
+                    for st in fresh:
+                        in_flight.add(st[0].slot)
+                    backlog = len(in_flight)
+                for st in fresh:
+                    slot_q.put(st)
+                if backlog:
+                    # pool sized by the whole backlog, not just this
+                    # iteration's arrivals: slots dirtied one at a time
+                    # must not queue behind a wedged worker while the
+                    # parallelism budget has headroom
+                    ensure_workers(min(cfg.parallelism or backlog, backlog))
+                with lock:
+                    idle = not in_flight
+                if idle and not fresh:
+                    break
+                if self._cancel.wait(0.1):
+                    return
+        finally:
+            no_more.set()
+        for w in workers:
+            w.join(timeout=5)
+
+        # drain remaining monitor windows (the pool overlapped them with
+        # the flips; only the tail waits here), reacting to failures
+        while not self._cancel.is_set() and not over_threshold():
+            with lock:
+                if not monitored:
+                    break
             if self._cancel.wait(0.05):
                 return
             poll_failures()
 
-        if over_threshold():
-            total = max(updated, 1)
-            if cfg.failure_action == UpdateFailureAction.PAUSE:
-                self._set_update_status(
-                    UpdateStatusState.PAUSED,
-                    f"update paused due to failure ratio {len(failed)}/{total}")
-            elif cfg.failure_action == UpdateFailureAction.ROLLBACK:
+        kind = "rollback" if rolling_back else "update"
+        paused_state = (UpdateStatusState.ROLLBACK_PAUSED if rolling_back
+                        else UpdateStatusState.PAUSED)
+        done_state = (UpdateStatusState.ROLLBACK_COMPLETED if rolling_back
+                      else UpdateStatusState.COMPLETED)
+        if over_threshold() or aborted:
+            with lock:
+                total = max(counters["updated"], 1)
+                n_failed = len(failed)
+            if cfg.failure_action == UpdateFailureAction.ROLLBACK \
+                    and not rolling_back:
                 self._rollback(self.store.view().get_service(self.service_id))
+            elif cfg.failure_action == UpdateFailureAction.ROLLBACK:
+                # a failing rollback cannot roll back again: pause
+                # (updater.go:244 treats this as rollback failure)
+                self._set_update_status(
+                    paused_state,
+                    f"rollback paused due to failure ratio "
+                    f"{n_failed}/{total}")
+            elif cfg.failure_action == UpdateFailureAction.PAUSE:
+                self._set_update_status(
+                    paused_state,
+                    f"{kind} paused due to failure ratio "
+                    f"{n_failed}/{total}")
             else:
                 self._set_update_status(
-                    UpdateStatusState.COMPLETED,
-                    f"update completed with {len(failed)} failures")
+                    done_state,
+                    f"{kind} completed with {n_failed} failures")
             return
         if not self._cancel.is_set():
-            self._set_update_status(UpdateStatusState.COMPLETED, "update completed")
+            self._set_update_status(done_state, f"{kind} completed")
 
     # ------------------------------------------------------------------ steps
     def _dirty_slots(self, service) -> list[list[Task]]:
@@ -172,8 +273,14 @@ class Updater(threading.Thread):
     # the retry can't accumulate duplicates in the slot
     START_FIRST_TIMEOUT = 600.0
 
-    def _update_slot(self, slot_tasks: list[Task], order) -> str | None:
-        """Replace one slot's tasks with a fresh-spec task. Returns new id.
+    def _update_slot(self, slot_tasks: list[Task],
+                     order) -> tuple[str, str | None]:
+        """Replace one slot's tasks with a fresh-spec task. Returns
+        (outcome, new_task_id): 'ok' (flip landed — the monitor window
+        judges it from here), 'failed' (the per-slot deadline expired and
+        the wedged replacement was removed; counts toward the failure
+        ratio), or 'error' (store hiccup / abort; the slot stays dirty
+        and re-queues).
 
         Both orders are two-phase (update/updater.go:367-451):
           start-first: create + start the replacement, WAIT until it is
@@ -187,18 +294,25 @@ class Updater(threading.Thread):
         if order == UpdateOrder.START_FIRST:
             new_id = self._create_replacement(slot, TaskState.RUNNING)
             if new_id is None:
-                return None
+                return "error", None
             outcome = self._wait_task_state(new_id, TaskState.RUNNING,
                                             timeout=self.START_FIRST_TIMEOUT)
             if outcome == "running":
                 self._shutdown_tasks(slot_tasks)
+            elif outcome == "aborted":
+                # the update is over (policy abort / supersession): don't
+                # leave an unstarted replacement behind in the slot
+                self._remove_task(new_id)
+                return "error", None
             elif outcome == "timeout":
                 # a replacement that never starts (unschedulable on a full
                 # cluster) must not pile up: remove it, keep the old task,
-                # report failure so the batch backs off and retries
+                # count the failure so the policy can act
                 self._remove_task(new_id)
-                return None
-            return new_id
+                return "failed", new_id
+            # 'failed' (died before RUNNING) flows through the monitor
+            # window like any young-task death
+            return "ok", new_id
         # stop-first: the replacement is created (desired READY) in the
         # SAME transaction that brings the old tasks down, so the slot
         # never looks empty to the orchestrator's reconcile — else it
@@ -208,10 +322,10 @@ class Updater(threading.Thread):
         new_id = self._create_replacement(slot, TaskState.READY,
                                           shutdown=slot_tasks)
         if new_id is None:
-            return None
+            return "error", None
         self._wait_tasks_stopped(slot_tasks)
         self._promote(new_id)
-        return new_id
+        return "ok", new_id
 
     def _create_replacement(self, slot: int, desired: TaskState,
                             shutdown: list[Task] = ()) -> str | None:
@@ -269,11 +383,13 @@ class Updater(threading.Thread):
     def _wait_task_state(self, task_id: str, want: TaskState,
                          timeout: float | None = SLOT_PHASE_TIMEOUT) -> str:
         """Poll until the task is observed at `want`, dies first, the
-        updater is cancelled, or (when bounded) the phase times out.
-        Returns 'running' | 'failed' | 'timeout'."""
+        updater is cancelled/aborted, or (when bounded) the phase times
+        out. Returns 'running' | 'failed' | 'timeout' | 'aborted'."""
         deadline = (time.monotonic() + timeout) if timeout is not None \
             else float("inf")
         while not self._cancel.is_set() and time.monotonic() < deadline:
+            if self._abort.is_set():
+                return "aborted"
             t = self.store.view().get_task(task_id)
             if t is None:
                 return "failed"
@@ -283,12 +399,14 @@ class Updater(threading.Thread):
                 return "running"
             if self._cancel.wait(0.05):
                 break
-        return "timeout"
+        return "aborted" if (self._cancel.is_set() or self._abort.is_set()) \
+            else "timeout"
 
     def _wait_tasks_stopped(self, slot_tasks: list[Task]):
         deadline = time.monotonic() + self.SLOT_PHASE_TIMEOUT
         ids = [t.id for t in slot_tasks]
-        while not self._cancel.is_set() and time.monotonic() < deadline:
+        while not self._cancel.is_set() and not self._abort.is_set() \
+                and time.monotonic() < deadline:
             view = self.store.view()
             live = [tid for tid in ids
                     if (t := view.get_task(tid)) is not None
@@ -309,6 +427,7 @@ class Updater(threading.Thread):
             cur.update_status = {
                 "state": UpdateStatusState.ROLLBACK_STARTED.value,
                 "message": "update rolled back due to failures",
+                "timestamp": time.time(),
             }
             tx.update(cur)
 

@@ -104,3 +104,157 @@ def test_stop_first_replaces_all_slots(cluster):
                 and all(x.status.state == TaskState.RUNNING for x in tasks))
 
     assert wait_for(updated, timeout=30)
+
+
+class _SlotWedgingExecutor(FakeExecutor):
+    """Wedges ONE slot's v2 replacement in PREPARING forever; everything
+    else runs normally."""
+
+    def __init__(self, wedge_slot: int, hostname="wedge-host"):
+        super().__init__({"*": {"run_forever": True}}, hostname=hostname)
+        self.wedge_slot = wedge_slot
+
+    def controller(self, task):
+        from swarmkit_tpu.agent.testutils import FakeController
+
+        if task.slot == self.wedge_slot and \
+                task.spec.runtime.image == "img:v2":
+            c = FakeController(task, {"prepare_time": 600,
+                                      "run_forever": True})
+            with self._lock:
+                self.controllers.append(c)
+            return c
+        return super().controller(task)
+
+
+def test_wedged_start_first_slot_does_not_stall_update(monkeypatch):
+    """Round-2 verdict #7: one hung start-first replacement must occupy
+    one pool worker — the other slots keep rolling — and when its
+    per-slot deadline expires it counts as a FAILURE, so the configured
+    policy (pause) fires instead of the update blocking on the wedge."""
+    from swarmkit_tpu.api.types import UpdateFailureAction
+    from swarmkit_tpu.orchestrator.updater import Updater
+
+    monkeypatch.setattr(Updater, "START_FIRST_TIMEOUT", 10.0)
+
+    m = Manager(heartbeat_period=0.5, key_rotation_interval=3600.0)
+    m.start()
+    agents = []
+    try:
+        for i in range(2):
+            ex = _SlotWedgingExecutor(wedge_slot=1, hostname=f"ww{i}")
+            a = Agent(f"ww{i}", m.dispatcher, ex)
+            a.start()
+            agents.append(a)
+
+        spec = ServiceSpec(
+            annotations=Annotations(name="wedge"),
+            replicas=4,
+            task=TaskSpec(runtime=ContainerSpec(image="img:v1")),
+            update=UpdateConfig(parallelism=2, delay=0.0, monitor=0.3,
+                                order=UpdateOrder.START_FIRST,
+                                failure_action=UpdateFailureAction.PAUSE,
+                                max_failure_ratio=0.0),
+        )
+        svc = m.control_api.create_service(spec)
+        assert wait_for(lambda: len(_running(m, svc.id)) == 4, timeout=20)
+
+        _trigger_update(m, svc)
+
+        def v2_running():
+            return [t for t in _running(m, svc.id)
+                    if t.spec.runtime.image == "img:v2"]
+
+        # the three healthy slots must flip WELL before the wedged slot's
+        # 10s deadline — with the old batch-join, slots 3/4 could not
+        # flip until the wedged batch joined at >=10s
+        assert wait_for(lambda: len(v2_running()) >= 3, timeout=8), \
+            f"only {len(v2_running())} slots flipped before the wedge " \
+            "deadline: the update stalled behind the wedged slot"
+
+        # the wedged slot's deadline expires -> failure -> policy: PAUSED
+        def paused():
+            s = m.control_api.get_service(svc.id)
+            return (s.update_status or {}).get("state") == "paused"
+        assert wait_for(paused, timeout=45)
+
+        # start-first kept the old v1 task alive in the wedged slot
+        v1 = [t for t in _running(m, svc.id)
+              if t.spec.runtime.image == "img:v1"]
+        assert any(t.slot == 1 for t in v1), \
+            "wedged slot lost its old task"
+        # and the wedged replacement was removed, not left to pile up
+        tasks = m.store.view(lambda tx: tx.find_tasks(by.ByServiceID(svc.id)))
+        wedged_v2 = [t for t in tasks if t.slot == 1
+                     and t.spec.runtime.image == "img:v2"
+                     and t.desired_state < TaskState.SHUTDOWN]
+        assert not wedged_v2, "wedged replacement still desired-running"
+    finally:
+        for a in agents:
+            a.stop()
+        m.stop()
+
+
+def test_failed_update_rolls_back_and_reports_rollback_status():
+    """failure_action=rollback: the spec flips back to v1 and the status
+    walks rollback_started -> rollback_completed (updater.go:566-626)."""
+    from swarmkit_tpu.api.types import UpdateFailureAction
+
+    behaviors = {}
+    m = Manager(heartbeat_period=0.5, key_rotation_interval=3600.0)
+    m.start()
+    agents = []
+    try:
+        for i in range(2):
+            ex = FakeExecutor(behaviors, hostname=f"rb{i}")
+            a = Agent(f"rb{i}", m.dispatcher, ex)
+            a.start()
+            agents.append(a)
+
+        spec = ServiceSpec(
+            annotations=Annotations(name="rollme"),
+            replicas=3,
+            task=TaskSpec(runtime=ContainerSpec(image="img:v1")),
+            update=UpdateConfig(parallelism=1, delay=0.0, monitor=1.0,
+                                order=UpdateOrder.STOP_FIRST,
+                                failure_action=UpdateFailureAction.ROLLBACK,
+                                max_failure_ratio=0.0),
+        )
+        svc = m.control_api.create_service(spec)
+        behaviors[svc.id] = {"run_forever": True}
+        assert wait_for(lambda: len(_running(m, svc.id)) == 3, timeout=20)
+
+        # v2 tasks die instantly: controller exits nonzero
+        def exec_for_task(task):
+            pass
+        # FakeExecutor picks behavior by service id; make v2 fail by
+        # switching the service behavior when the update starts
+        behaviors[svc.id] = {"exit_code": 1, "run_time": 0.05}
+        _trigger_update(m, svc)
+
+        def status():
+            s = m.control_api.get_service(svc.id)
+            return (s.update_status or {}).get("state")
+
+        assert wait_for(lambda: status() in ("rollback_started",
+                                             "rollback_completed"),
+                        timeout=30), status()
+        # the rollback converges back to v1 running everywhere
+        behaviors[svc.id] = {"run_forever": True}
+
+        def rolled_back():
+            s = m.control_api.get_service(svc.id)
+            run = _running(m, svc.id)
+            # convergence of surplus slots is the orchestrator's long
+            # tail; the properties under test: spec flipped back, v2 is
+            # gone, v1 serves, and the status family is rollback_*
+            return (s.spec.task.runtime.image == "img:v1"
+                    and len(run) >= 3
+                    and all(t.spec.runtime.image == "img:v1" for t in run)
+                    and status() == "rollback_completed")
+        assert wait_for(rolled_back, timeout=45), \
+            (status(), len(_running(m, svc.id)))
+    finally:
+        for a in agents:
+            a.stop()
+        m.stop()
