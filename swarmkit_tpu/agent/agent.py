@@ -110,13 +110,27 @@ class Agent:
                     sub_id, [make_log_message(task, stream, data)]
                 )
 
+            err = ""
             try:
                 done = pumped.setdefault(sub_id, set())
                 done |= self.worker.subscribe_logs(
                     msg.selector, publish, skip_task_ids=done
                 )
-            except Exception:
-                pass
+            except Exception as exc:
+                err = f"log pump failed on {self.node_id}: {exc}"
+            if not msg.follow:
+                # publisher EOF: this node pumped everything it has — the
+                # broker's completion accounting ends the client stream
+                # once every publisher closed (broker.go PublishLogs EOF).
+                # The dedupe entry goes with it: the broker never re-offers
+                # a completed non-follow subscription.
+                try:
+                    self.log_broker.publish_logs(
+                        sub_id, [], node_id=self.node_id, close=True,
+                        error=err)
+                except Exception:
+                    pass
+                pumped.pop(sub_id, None)
 
     def stop(self):
         self._stop.set()

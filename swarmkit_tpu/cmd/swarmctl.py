@@ -552,7 +552,7 @@ def cmd_config_rm(args):
 # --------------------------------------------------------------------- logs
 
 def cmd_logs(args):
-    from ..logbroker.broker import LogSelector
+    from ..logbroker.broker import LogSelector, SubscriptionComplete
     from ..rpc.client import RPCClient
     from ..store.watch import ChannelClosed
 
@@ -574,6 +574,11 @@ def cmd_logs(args):
                     break
                 continue
             except ChannelClosed:
+                break
+            if isinstance(msg, SubscriptionComplete):
+                # terminal record: every publisher closed
+                if msg.error:
+                    print(msg.error, file=sys.stderr)
                 break
             data = msg.data.decode(errors="replace") if msg.data else ""
             task = msg.context.task_id[:8] if msg.context else "?"

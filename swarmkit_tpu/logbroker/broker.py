@@ -60,6 +60,16 @@ class SubscriptionMessage:
     close: bool = False
 
 
+@dataclass
+class SubscriptionComplete:
+    """Terminal record of a log stream (broker.go SubscribeLogs's
+    `completed` publish): offered once every publisher finished, carrying
+    the aggregated warning text — unreachable nodes, disconnects,
+    never-scheduled tasks — after which the client channel closes."""
+
+    error: str = ""
+
+
 class _Subscription:
     def __init__(self, sub_id: str, selector: LogSelector, follow: bool):
         self.id = sub_id
@@ -69,6 +79,22 @@ class _Subscription:
         self.nodes: set[str] = set()  # nodes the subscription was sent to
         self.known_tasks: set[str] = set()  # tasks seen when last dispatched
         self.done = False
+        # completion accounting (subscription.go wg/Done — non-follow only):
+        # a node is pending from first dispatch until its publisher closes
+        self.pending_nodes: set[str] = set()
+        self.done_nodes: set[str] = set()
+        self.errors: list[str] = []
+        self.pending_tasks: set[str] = set()  # matched but never scheduled
+
+    def err_text(self) -> str:
+        """subscription.go Err(): aggregate warning, '' when clean."""
+        msgs = list(self.errors)
+        msgs += [f"task {t} has not been scheduled"
+                 for t in sorted(self.pending_tasks)]
+        if not msgs:
+            return ""
+        return ("warning: incomplete log stream. some logs could not be "
+                "retrieved for the following reasons: " + ", ".join(msgs))
 
 
 class LogBroker:
@@ -103,13 +129,18 @@ class LogBroker:
     # -- client side (Logs.SubscribeLogs, logbroker.proto:103-125) ---------
 
     def subscribe_logs(self, selector: LogSelector, follow: bool = True) -> tuple[str, Channel]:
-        """Returns (subscription_id, channel of LogMessage)."""
+        """Returns (subscription_id, channel of LogMessage). A non-follow
+        stream ends with a SubscriptionComplete record once every
+        publisher closed (broker.go SubscribeLogs:255-283)."""
         if selector.empty():
             raise ValueError("empty log selector")
         sub = _Subscription(new_id(), selector, follow)
         with self._lock:
             self._subs[sub.id] = sub
         self._dispatch_to_nodes(sub)
+        if not follow:
+            with self._lock:
+                self._maybe_complete(sub)
         return sub.id, sub.client
 
     def unsubscribe(self, sub_id: str):
@@ -144,19 +175,58 @@ class LogBroker:
         return ch
 
     def stop_listening(self, node_id: str):
+        """Explicit node disconnect (broker.go nodeDisconnected): pending
+        completion accounting must not wait on a node that left."""
         with self._lock:
             ch = self._listeners.pop(node_id, None)
+            for sub in list(self._subs.values()):
+                if node_id in sub.pending_nodes:
+                    self._mark_done(
+                        sub, node_id,
+                        f"node {node_id} disconnected unexpectedly")
         if ch is not None:
             ch.close()
 
-    def publish_logs(self, sub_id: str, messages: list[LogMessage]):
-        """Agent publishes task log data upstream (broker.go PublishLogs)."""
+    def publish_logs(self, sub_id: str, messages: list[LogMessage],
+                     node_id: str = "", close: bool = False,
+                     error: str = ""):
+        """Agent publishes task log data upstream (broker.go PublishLogs).
+        `close=True` is the publisher's EOF for this node — with an
+        optional error when the pump failed — which feeds the non-follow
+        completion accounting (broker.go:379-440 markDone)."""
         with self._lock:
             sub = self._subs.get(sub_id)
-        if sub is None or sub.done:
+            if sub is None or sub.done:
+                return
+            for m in messages:
+                sub.client._offer(m)
+            if close:
+                self._mark_done(sub, node_id, error)
+
+    def _mark_done(self, sub: _Subscription, node_id: str, error: str = ""):
+        """Lock held. subscription.go Done: record the publisher's end;
+        complete the subscription when the last pending node finishes.
+        A node already done is a duplicate close (sweep-then-replay race)
+        and is ignored entirely, error included."""
+        if node_id and node_id in sub.done_nodes:
             return
-        for m in messages:
-            sub.client._offer(m)
+        if error:
+            sub.errors.append(error)
+        if node_id:
+            sub.done_nodes.add(node_id)
+            sub.pending_nodes.discard(node_id)
+        if not sub.follow:
+            self._maybe_complete(sub)
+
+    def _maybe_complete(self, sub: _Subscription):
+        """Lock held. Non-follow only: once no publisher is pending, emit
+        the terminal record and end the client stream."""
+        if sub.follow or sub.done or sub.pending_nodes:
+            return
+        sub.done = True
+        self._subs.pop(sub.id, None)
+        sub.client._offer(SubscriptionComplete(error=sub.err_text()))
+        sub.client.close()
 
     # -- internals ---------------------------------------------------------
 
@@ -192,20 +262,66 @@ class LogBroker:
                     notify.add(t.node_id)
             sub.nodes |= notify
             sub.known_tasks = {t.id for t in tasks if t.node_id}
-            offers = [self._listeners[n] for n in notify if n in self._listeners]
+            # completion accounting (registerSubscription:128-143): a node
+            # without a live listener can never publish — record the error
+            # and mark it done immediately instead of waiting forever
+            sub.pending_tasks = {t.id for t in tasks if not t.node_id}
+            offers = []
+            for n in notify:
+                ch = self._listeners.get(n)
+                alive = ch is not None and not ch.closed
+                if alive:
+                    offers.append(ch)
+                    if not sub.follow and n not in sub.done_nodes:
+                        sub.pending_nodes.add(n)
+                elif not sub.follow and n not in sub.done_nodes:
+                    # record only — completing here would race nodes later
+                    # in the iteration out of their pending registration
+                    # (subscribe_logs runs _maybe_complete after dispatch)
+                    sub.errors.append(f"node {n} is not available")
+                    sub.done_nodes.add(n)
         for ch in offers:
             ch._offer(msg)
 
+    def _sweep(self):
+        """Detect broken streams by their closed channels (the RPC server
+        closes a stream's channel on disconnect):
+
+        * a dead agent listener marks its pending subscriptions done with
+          a disconnect error (broker.go nodeDisconnected:285-293);
+        * a gone log client unsubscribes, telling its publishers to stop.
+        """
+        with self._lock:
+            dead_nodes = [n for n, ch in self._listeners.items()
+                          if ch.closed]
+            for n in dead_nodes:
+                del self._listeners[n]
+                for sub in list(self._subs.values()):
+                    if n in sub.pending_nodes:
+                        self._mark_done(
+                            sub, n, f"node {n} disconnected unexpectedly")
+            gone_clients = [s.id for s in self._subs.values()
+                            if s.client.closed and not s.done]
+        for sid in gone_clients:
+            self.unsubscribe(sid)
+
     def _run(self):
         """Follow-mode maintenance: tasks appearing on new nodes extend the
-        subscription to those nodes (broker.go subscription task watcher)."""
+        subscription to those nodes (broker.go subscription task watcher).
+        Also sweeps for broken client/agent streams."""
         queue = self.store.watch_queue()
         ch = queue.watch()
+        last_sweep = time.monotonic()
         try:
             while not self._stop.is_set():
+                if time.monotonic() - last_sweep > 0.5:
+                    last_sweep = time.monotonic()
+                    self._sweep()
                 try:
                     ev = ch.get(timeout=0.2)
                 except TimeoutError:
+                    self._sweep()
+                    last_sweep = time.monotonic()
                     continue
                 except ChannelClosed:
                     queue.stop_watch(ch)

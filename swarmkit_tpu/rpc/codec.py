@@ -111,7 +111,8 @@ class _Registry:
                         dispatcher_mod.SessionMessage):
                 self.add(cls)
             for cls in (broker_mod.LogSelector, broker_mod.LogContext,
-                        broker_mod.LogMessage, broker_mod.SubscriptionMessage):
+                        broker_mod.LogMessage, broker_mod.SubscriptionMessage,
+                        broker_mod.SubscriptionComplete):
                 self.add(cls)
 
             from ..ca.auth import Caller

@@ -375,8 +375,14 @@ def build_manager_registry(manager, raft_node=None,
         _require_node(caller, node_id)
         return broker.listen_subscriptions(node_id)
 
-    def logs_publish(caller, sub_id, messages):
-        return broker.publish_logs(sub_id, messages)
+    def logs_publish(caller, sub_id, messages, node_id="", close=False,
+                     error=""):
+        # the node identity is the CALLER's, not self-asserted: a
+        # publisher can only close its own accounting slot (the reference
+        # derives it from the TLS peer, broker.go:385)
+        return broker.publish_logs(sub_id, messages,
+                                   node_id=caller.node_id if close else "",
+                                   close=close, error=error)
 
     reg.add("logs.subscribe", logs_subscribe, roles=[MANAGER], streaming=True)
     reg.add("logs.listen_subscriptions", logs_listen_subscriptions,
@@ -579,8 +585,12 @@ class RemoteLogBroker:
     def listen_subscriptions(self, node_id):
         return self._conn().stream("logs.listen_subscriptions", node_id)
 
-    def publish_logs(self, sub_id, messages):
-        return self._conn().call("logs.publish", sub_id, messages)
+    def publish_logs(self, sub_id, messages, node_id="", close=False,
+                     error=""):
+        # node_id rides the TLS identity server-side; passed here only
+        # for signature parity with the in-process broker
+        return self._conn().call("logs.publish", sub_id, messages,
+                                 close=close, error=error)
 
     def subscribe_logs(self, selector, follow=True):
         ch = self._conn().stream("logs.subscribe", selector, follow=follow)
