@@ -127,6 +127,40 @@ class WriteTx(ReadTx):
         super().__init__(store)
         self._writes: dict[tuple[str, str], StoreObject | None] = {}
         self._changelist: list[StoreAction] = []
+        # (table, lower-name) -> id for names claimed by buffered writes:
+        # the uniqueness checks in create/update stay O(1) instead of
+        # rescanning every buffered write per call (a 10k-create tx would
+        # otherwise be O(n^2) — bench_host_micro's store row caught this)
+        self._buffered_names: dict[tuple[str, str], str] = {}
+
+    def _name_in_use(self, cls, name: str, exclude_id: str) -> bool:
+        """Name-uniqueness check: buffered claims via the tx-local map,
+        committed objects via the store's name index — each O(1)."""
+        lower = name.lower()
+        owner = self._buffered_names.get((cls.TABLE, lower))
+        if owner is not None and owner != exclude_id:
+            return True
+        for o in super().find(cls, by_mod.ByName(name)):
+            if o.id == exclude_id:
+                continue
+            key = (cls.TABLE, o.id)
+            if key in self._writes:
+                cur = self._writes[key]
+                if cur is None or (_name_of(cur) or "").lower() != lower:
+                    continue  # deleted or renamed away within this tx
+            return True
+        return False
+
+    def _claim_name(self, obj: StoreObject, old: StoreObject | None) -> None:
+        if old is not None:
+            old_name = (_name_of(old) or "").lower()
+            if old_name:
+                key = (obj.TABLE, old_name)
+                if self._buffered_names.get(key) == obj.id:
+                    del self._buffered_names[key]
+        name = (_name_of(obj) or "").lower()
+        if name:
+            self._buffered_names[(obj.TABLE, name)] = obj.id
 
     # -- reads see buffered writes -----------------------------------------
     def get(self, cls: type[StoreObject], id: str) -> StoreObject | None:
@@ -150,13 +184,13 @@ class WriteTx(ReadTx):
     def create(self, obj: StoreObject) -> None:
         if self.get(type(obj), obj.id) is not None:
             raise ExistError(f"{obj.TABLE} {obj.id} already exists")
-        if obj.TABLE == "service" or obj.TABLE == "node":
-            existing = [o for o in self.find(type(obj), by_mod.ByName(_name_of(obj)))
-                        if _name_of(o)] if _name_of(obj) else []
-            if existing:
-                raise ExistError(f"{obj.TABLE} name {_name_of(obj)!r} is in use")
+        name = _name_of(obj)
+        if obj.TABLE in ("service", "node") and name:
+            if self._name_in_use(type(obj), name, exclude_id=obj.id):
+                raise ExistError(f"{obj.TABLE} name {name!r} is in use")
         obj = obj.copy()
         self._writes[(obj.TABLE, obj.id)] = obj
+        self._claim_name(obj, None)
         self._changelist.append(StoreAction(StoreAction.CREATE, obj))
 
     def update(self, obj: StoreObject) -> None:
@@ -173,12 +207,11 @@ class WriteTx(ReadTx):
                 and new_name.lower() != _name_of(old).lower():
             # renames must keep names unique (reference services.go:98-104
             # ErrNameConflict)
-            clash = [o for o in self.find(type(obj), by_mod.ByName(new_name))
-                     if o.id != obj.id]
-            if clash:
+            if self._name_in_use(type(obj), new_name, exclude_id=obj.id):
                 raise ExistError(f"{obj.TABLE} name {new_name!r} is in use")
         obj = obj.copy()
         self._writes[(obj.TABLE, obj.id)] = obj
+        self._claim_name(obj, old)
         self._changelist.append(StoreAction(StoreAction.UPDATE, obj))
 
     def delete(self, cls: type[StoreObject], id: str) -> None:
@@ -186,6 +219,10 @@ class WriteTx(ReadTx):
         if old is None:
             raise NotExistError(f"{cls.TABLE} {id} does not exist")
         self._writes[(cls.TABLE, id)] = None
+        old_name = (_name_of(old) or "").lower()
+        if old_name and self._buffered_names.get(
+                (cls.TABLE, old_name)) == id:
+            del self._buffered_names[(cls.TABLE, old_name)]
         self._changelist.append(StoreAction(StoreAction.DELETE, old))
 
 

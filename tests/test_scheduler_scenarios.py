@@ -1,0 +1,246 @@
+"""Event-driven scheduler walkthroughs, modeled on the reference's
+scenario tests (manager/scheduler/scheduler_test.go): the plugin-filter
+scenario (:3100-3186), availability changes mid-stream (drain/pause),
+spread-preference rebalancing on node join, and host-port churn.
+
+These complement the parity/property suites: parity proves the two fill
+engines agree; scenarios prove the LIVE event loop converges through
+cluster churn the way the reference's walkthroughs do."""
+import pytest
+
+from swarmkit_tpu.api.objects import Node, Task
+from swarmkit_tpu.api.specs import (
+    Annotations,
+    EndpointSpec,
+    NodeDescription,
+    Placement,
+    PlacementPreference,
+    Platform,
+    PortConfig,
+    Resources,
+    VolumeMount,
+)
+from swarmkit_tpu.api.types import (
+    NodeAvailability,
+    NodeStatusState,
+    TaskState,
+)
+from swarmkit_tpu.scheduler.scheduler import Scheduler
+from swarmkit_tpu.store import by
+from swarmkit_tpu.store.memory import MemoryStore
+
+from test_scheduler import pending_task, ready_node, wait_for
+
+
+@pytest.fixture
+def store():
+    return MemoryStore()
+
+
+@pytest.fixture
+def sched(store):
+    s = Scheduler(store)
+    s.start()
+    yield s
+    s.stop()
+
+
+def assigned(store, pred=lambda t: True):
+    return [t for t in store.view().find_tasks(
+        by.ByTaskState(TaskState.ASSIGNED)) if pred(t)]
+
+
+def node_of(store, task_id):
+    t = store.view().get_task(task_id)
+    return t.node_id if t else None
+
+
+# ----------------------------------------------- plugin filter scenario
+
+
+def plugin_task(tid, slot, driver="nfs"):
+    from swarmkit_tpu.api.specs import ContainerSpec
+
+    t = pending_task(tid, slot=slot)
+    t.spec.runtime = ContainerSpec(
+        command=["true"],
+        mounts=[VolumeMount(source=f"{driver}/data", target="/data")])
+    return t
+
+
+def test_plugin_filter_scenario(store, sched):
+    """scheduler_test.go:3100-3186: tasks needing a volume driver land
+    only on nodes advertising the plugin; a node that GAINS the plugin
+    becomes eligible and unblocks pending work."""
+    def setup(tx):
+        for i in range(6):
+            n = ready_node(f"node-{i}")
+            if i % 3 == 0:   # 1 in 3 nodes carries the plugin
+                n.description.plugins = [("Volume", "nfs")]
+            tx.create(n)
+        for i in range(4):
+            tx.create(plugin_task(f"pt-{i}", slot=i + 1))
+
+    store.update(setup)
+    assert wait_for(lambda: len(assigned(store)) == 4, timeout=10)
+    for t in assigned(store):
+        assert t.node_id in ("node-0", "node-3"), t.node_id
+
+    # a task needing a driver NO node has stays pending, with the filter
+    # explanation written to its status
+    store.update(lambda tx: tx.create(plugin_task("pt-gluster", 10,
+                                                  driver="gluster")))
+
+    def explained():
+        t = store.view().get_task("pt-gluster")
+        return t.status.state == TaskState.PENDING and t.status.message
+    assert wait_for(explained, timeout=10)
+
+    # the plugin arrives on a node (engine upgrade): the task unblocks
+    def upgrade(tx):
+        n = tx.get_node("node-1").copy()
+        n.description.plugins = [("Volume", "gluster")]
+        tx.update(n)
+    store.update(upgrade)
+    assert wait_for(lambda: node_of(store, "pt-gluster") == "node-1",
+                    timeout=10)
+
+
+# --------------------------------------------- drain / pause mid-stream
+
+
+def test_drain_and_pause_mid_stream(store, sched):
+    """Availability flips between waves: DRAIN and PAUSE nodes stop
+    receiving new tasks; reactivation restores them (scheduler_test.go
+    node-availability walkthroughs)."""
+    def setup(tx):
+        for i in range(3):
+            tx.create(ready_node(f"n{i}"))
+        for i in range(6):
+            tx.create(pending_task(f"w1-{i}", slot=i + 1))
+
+    store.update(setup)
+    assert wait_for(lambda: len(assigned(store)) == 6, timeout=10)
+    assert {t.node_id for t in assigned(store)} == {"n0", "n1", "n2"}
+
+    def flip(tx, node_id, avail):
+        n = tx.get_node(node_id).copy()
+        n.spec.availability = avail
+        tx.update(n)
+
+    store.update(lambda tx: flip(tx, "n0", NodeAvailability.DRAIN))
+    store.update(lambda tx: flip(tx, "n1", NodeAvailability.PAUSE))
+
+    def wave2(tx):
+        for i in range(4):
+            tx.create(pending_task(f"w2-{i}", service_id="svc2",
+                                   slot=i + 1))
+    store.update(wave2)
+    assert wait_for(
+        lambda: len(assigned(store, lambda t: t.service_id == "svc2")) == 4,
+        timeout=10)
+    assert {t.node_id for t in
+            assigned(store, lambda t: t.service_id == "svc2")} == {"n2"}
+
+    # reactivate: the next wave uses every node again
+    store.update(lambda tx: flip(tx, "n0", NodeAvailability.ACTIVE))
+    store.update(lambda tx: flip(tx, "n1", NodeAvailability.ACTIVE))
+
+    def wave3(tx):
+        for i in range(6):
+            tx.create(pending_task(f"w3-{i}", service_id="svc3",
+                                   slot=i + 1))
+    store.update(wave3)
+    assert wait_for(
+        lambda: len(assigned(store, lambda t: t.service_id == "svc3")) == 6,
+        timeout=10)
+    assert {t.node_id for t in
+            assigned(store, lambda t: t.service_id == "svc3")} == \
+        {"n0", "n1", "n2"}
+
+
+# ------------------------------------- preference tree on node join
+
+
+def spread_task(tid, slot, svc="spreader"):
+    t = pending_task(tid, service_id=svc, slot=slot)
+    t.spec.placement = Placement(preferences=[
+        PlacementPreference(spread_descriptor="node.labels.zone")])
+    return t
+
+
+def test_preference_tree_rebalances_on_node_join(store, sched):
+    """nodeset.go tree semantics: with one zone, everything lands there;
+    when a second zone joins, NEW tasks flow to the emptier branch until
+    the zones balance (scheduler_test.go preference walkthroughs)."""
+    def setup(tx):
+        for i in range(2):
+            tx.create(ready_node(f"za-{i}", labels={"zone": "a"}))
+        for i in range(6):
+            tx.create(spread_task(f"s1-{i}", slot=i + 1))
+
+    store.update(setup)
+    assert wait_for(lambda: len(assigned(store)) == 6, timeout=10)
+    assert all(t.node_id.startswith("za-") for t in assigned(store))
+
+    # zone b joins, empty
+    store.update(lambda tx: (tx.create(ready_node("zb-0",
+                                                  labels={"zone": "b"})),
+                             tx.create(ready_node("zb-1",
+                                                  labels={"zone": "b"}))))
+
+    def wave2(tx):
+        for i in range(6):
+            tx.create(spread_task(f"s2-{i}", slot=100 + i))
+    store.update(wave2)
+    assert wait_for(
+        lambda: len(assigned(store, lambda t: t.id.startswith("s2-"))) == 6,
+        timeout=10)
+    by_zone = {"a": 0, "b": 0}
+    for t in assigned(store):
+        by_zone["a" if t.node_id.startswith("za-") else "b"] += 1
+    # 12 tasks total must balance 6/6 across the two zones: the whole
+    # second wave flowed to the previously-empty zone b
+    assert by_zone == {"a": 6, "b": 6}, by_zone
+
+
+# ------------------------------------------------- host-port churn
+
+
+def port_task(tid, svc, port=8080):
+    t = pending_task(tid, service_id=svc, slot=1)
+    t.endpoint = EndpointSpec(ports=[PortConfig(
+        protocol="tcp", target_port=80, published_port=port,
+        publish_mode="host")])
+    return t
+
+
+def test_host_port_churn(store, sched):
+    """Host-published ports are node-exclusive: a second service's task
+    waits until the holder dies, then takes the freed port
+    (scheduler_test.go host-port scenarios)."""
+    store.update(lambda tx: (tx.create(ready_node("only")),
+                             tx.create(port_task("holder", "svcA"))))
+    assert wait_for(lambda: node_of(store, "holder") == "only", timeout=10)
+
+    # same port, same node pool: must stay pending
+    store.update(lambda tx: tx.create(port_task("waiter", "svcB")))
+
+    def waiter_pending_with_reason():
+        t = store.view().get_task("waiter")
+        return (t.status.state == TaskState.PENDING
+                and not t.node_id and t.status.message)
+    assert wait_for(waiter_pending_with_reason, timeout=10)
+
+    # the holder dies: its ports free, the waiter schedules
+    def kill(tx):
+        t = tx.get_task("holder").copy()
+        t.status.state = TaskState.FAILED
+        t.desired_state = TaskState.SHUTDOWN
+        tx.update(t)
+    store.update(kill)
+    assert wait_for(lambda: node_of(store, "waiter") == "only", timeout=10)
+
+    # a different port was never blocked
+    store.update(lambda tx: tx.create(port_task("other", "svcC", port=9090)))
+    assert wait_for(lambda: node_of(store, "other") == "only", timeout=10)

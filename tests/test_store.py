@@ -246,3 +246,52 @@ def test_slow_subscriber_closed_not_blocking():
     with pytest.raises(ChannelClosed):
         while True:
             ch.get(timeout=0.1)
+
+
+def test_name_uniqueness_within_one_transaction():
+    """The tx-local name map must preserve uniqueness semantics inside a
+    single transaction: duplicate creates clash, deletes free names,
+    renames free the old name and claim the new one."""
+    from swarmkit_tpu.api.objects import Service
+    from swarmkit_tpu.api.specs import Annotations, ServiceSpec
+    from swarmkit_tpu.store.memory import ExistError, MemoryStore
+
+    store = MemoryStore()
+
+    def svc(sid, name):
+        return Service(id=sid, spec=ServiceSpec(
+            annotations=Annotations(name=name)))
+
+    # duplicate create within one tx
+    def dup(tx):
+        tx.create(svc("s1", "web"))
+        tx.create(svc("s2", "WEB"))  # case-insensitive clash
+    try:
+        store.update(dup)
+        raise AssertionError("duplicate name accepted within one tx")
+    except ExistError:
+        pass
+    assert store.view().get_service("s1") is None  # tx rolled back
+
+    # delete frees the name within the same tx
+    store.update(lambda tx: tx.create(svc("s1", "web")))
+
+    def delete_then_reuse(tx):
+        tx.delete(Service, "s1")
+        tx.create(svc("s3", "web"))
+    store.update(delete_then_reuse)
+    assert store.view().get_service("s3") is not None
+
+    # rename frees the old name and claims the new one within the tx
+    def rename_and_fill(tx):
+        cur = tx.get_service("s3").copy()
+        cur.spec.annotations.name = "api"
+        tx.update(cur)
+        tx.create(svc("s4", "web"))     # old name now free
+        try:
+            tx.create(svc("s5", "api"))  # new name now taken
+            raise AssertionError("renamed-to name was not claimed")
+        except ExistError:
+            pass
+    store.update(rename_and_fill)
+    assert store.view().get_service("s4") is not None
