@@ -79,6 +79,8 @@ def example_cluster(n_nodes: int = 256, n_groups: int = 4,
             t.desired_state = TaskState.RUNNING
             t.status.state = TaskState.PENDING
             if spec is None:
+                from ..api.specs import PlacementPreference
+
                 spec = t.spec
                 spec.resources.reservations.nano_cpus = \
                     (gi % 3) * CPU_QUANTUM
@@ -87,6 +89,16 @@ def example_cluster(n_nodes: int = 256, n_groups: int = 4,
                 if gi % 2 == 0:
                     spec.placement = Placement(
                         constraints=[f"node.labels.zone == {'abc'[gi % 3]}"])
+                if gi % 3 == 1:
+                    # spread-tree groups (LMAX>0): one- and two-level
+                    # preference trees so the segmented pour path is part
+                    # of the flagship compile surface
+                    prefs = [PlacementPreference(
+                        spread_descriptor="node.labels.zone")]
+                    if gi % 2 == 1:
+                        prefs.append(PlacementPreference(
+                            spread_descriptor="node.labels.disk"))
+                    spec.placement.preferences = prefs
             else:
                 t.spec = spec
             tasks.append(t)

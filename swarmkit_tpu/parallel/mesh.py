@@ -94,3 +94,40 @@ def sharded_schedule(p, mesh: Mesh):
     with jax.sharding.set_mesh(mesh):
         counts, totals, svc_counts = placement_ops.schedule_groups(*args)
     return np.asarray(counts)[:, :N]
+
+
+def sharded_cluster_step(p, acks, quorum, mesh: Mesh):
+    """The FUSED flagship step (models.cluster_step) on the mesh: per-node
+    placement arrays shard over the node axis, the raft ack matrix shards
+    its log axis over the same devices (the tally is elementwise along the
+    log; the commit prefix-scan crosses shards, XLA inserting the
+    collectives). Returns (counts[G, N] numpy, commit_index int)."""
+    args, N = shard_problem(p, mesh)
+    n_dev = mesh.devices.size
+    E = acks.shape[1]
+    e_pad = (-E) % n_dev
+    if e_pad:
+        # padding with un-acked entries can only sit past the commit
+        # frontier (the prefix cumprod stops at the first hole)
+        acks = np.pad(np.asarray(acks), ((0, 0), (0, e_pad)),
+                      constant_values=False)
+    acks_dev = jax.device_put(
+        np.asarray(acks), NamedSharding(mesh, P(None, NODE_AXIS)))
+    with jax.sharding.set_mesh(mesh):
+        counts, totals, commit = _fused_step()(acks_dev, quorum, *args)
+    return np.asarray(counts)[:, :N], int(commit)
+
+
+_FUSED_JIT = None
+
+
+def _fused_step():
+    """Module-cached jit of the fused flagship step: rebuilding the jit
+    wrapper per call would recompile the whole fused program every time
+    (10-20 s on the real chip)."""
+    global _FUSED_JIT
+    if _FUSED_JIT is None:
+        from ..models.cluster_step import cluster_step
+
+        _FUSED_JIT = jax.jit(cluster_step)
+    return _FUSED_JIT

@@ -71,3 +71,32 @@ def test_match_index_commit():
     mi = np.array([100, 90, 80, 70, 60], np.int32)
     # quorum of 3: the 3rd largest match index
     assert int(raft_replay.match_index_commit(mi, 3)) == 80
+
+
+def test_fused_cluster_step_sharded_parity():
+    """The FUSED flagship step (placement incl. LMAX=2 spread trees +
+    raft replay) on the 8-device mesh matches the CPU oracle — the same
+    check dryrun_multichip performs, pinned in the suite."""
+    import numpy as np
+
+    from swarmkit_tpu.models.cluster_step import example_cluster
+    from swarmkit_tpu.parallel.mesh import make_mesh, sharded_cluster_step
+    from swarmkit_tpu.scheduler import batch
+    from swarmkit_tpu.scheduler.encode import encode
+
+    infos, groups = example_cluster(n_nodes=8 * 16 + 3, n_groups=9,
+                                    tasks_per_group=24)
+    p = encode(infos, groups)
+    assert p.spread_rank.shape[1] >= 2  # spread trees present
+
+    managers, log_len = 5, 4096
+    acks = np.zeros((managers, log_len), bool)
+    frontier = np.random.RandomState(2).randint(100, log_len, managers)
+    for m in range(managers):
+        acks[m, :frontier[m]] = True
+    quorum = managers // 2 + 1
+
+    mesh = make_mesh(8)
+    counts, commit = sharded_cluster_step(p, acks, np.int32(quorum), mesh)
+    np.testing.assert_array_equal(counts, batch.cpu_schedule_encoded(p))
+    assert commit == int(np.sort(frontier)[managers - quorum])

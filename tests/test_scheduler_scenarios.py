@@ -8,23 +8,14 @@ engines agree; scenarios prove the LIVE event loop converges through
 cluster churn the way the reference's walkthroughs do."""
 import pytest
 
-from swarmkit_tpu.api.objects import Node, Task
 from swarmkit_tpu.api.specs import (
-    Annotations,
     EndpointSpec,
-    NodeDescription,
     Placement,
     PlacementPreference,
-    Platform,
     PortConfig,
-    Resources,
     VolumeMount,
 )
-from swarmkit_tpu.api.types import (
-    NodeAvailability,
-    NodeStatusState,
-    TaskState,
-)
+from swarmkit_tpu.api.types import NodeAvailability, TaskState
 from swarmkit_tpu.scheduler.scheduler import Scheduler
 from swarmkit_tpu.store import by
 from swarmkit_tpu.store.memory import MemoryStore
