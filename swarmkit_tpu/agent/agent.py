@@ -26,7 +26,8 @@ BACKOFF_MAX = 8.0
 class Agent:
     def __init__(self, node_id: str, dispatcher, executor,
                  state_path: str | None = None, log_broker=None,
-                 csi_plugins=None, generic_resources=None):
+                 csi_plugins=None, generic_resources=None,
+                 fips: bool = False):
         self.node_id = node_id
         self.dispatcher = dispatcher
         self.executor = executor
@@ -35,6 +36,9 @@ class Agent:
         # the advertised NodeDescription (reference swarmd main.go:38-266);
         # either a {kind: count} dict or an api Resources (parse_cmd output)
         self.generic_resources = generic_resources
+        # advertised in the NodeDescription: a mandatory-FIPS cluster's
+        # dispatcher refuses registrations that don't carry it
+        self.fips = fips
         self.log_broker = log_broker
         self.volume_manager = None
         if csi_plugins is not None:
@@ -188,6 +192,8 @@ class Agent:
 
     def _session(self):
         description = self.executor.describe()
+        if description is not None and self.fips:
+            description.fips = True
         gr = self.generic_resources
         if gr and description is not None \
                 and description.resources is not None:

@@ -481,9 +481,9 @@ class CAServer:
             cluster.root_ca.ca_key_pem = full_new_root.key_pem or b""
             cluster.root_ca.cert_digest = full_new_root.digest()
             cluster.root_ca.join_token_worker = \
-                generate_join_token(full_new_root)
+                generate_join_token(full_new_root, fips=cluster.fips)
             cluster.root_ca.join_token_manager = \
-                generate_join_token(full_new_root)
+                generate_join_token(full_new_root, fips=cluster.fips)
             cluster.root_ca.root_rotation = None
             tx.update(cluster)
 

@@ -57,6 +57,10 @@ def main(argv=None) -> int:
     ap.add_argument("--external-ca", default=None, metavar="URL",
                     help="cfssl-compatible signing endpoint "
                          "(protocol=cfssl,url=… also accepted)")
+    ap.add_argument("--fips", action="store_true",
+                    help="run in FIPS mode; bootstrapping with this flag "
+                         "creates a mandatory-FIPS cluster that only "
+                         "FIPS-enabled nodes may join")
     ap.add_argument("--autolock", action="store_true",
                     help="seal the raft DEK under an operator-held key; "
                          "printed once as SWARM_UNLOCK_KEY")
@@ -123,8 +127,13 @@ def main(argv=None) -> int:
         generic_resources=generic,
         autolock=args.autolock,
         kek=args.unlock_key.encode() if args.unlock_key else None,
+        fips=args.fips,
     )
-    node.start()
+    try:
+        node.start()
+    except SwarmNode.MandatoryFIPSError as exc:
+        print(f"error: {exc}", file=sys.stderr, flush=True)
+        sys.exit(1)
 
     debug_server = None
     debug_addr = args.listen_metrics or args.listen_debug

@@ -645,9 +645,11 @@ class ControlAPI:
 
                 root = RootCA(rca.ca_cert_pem)
                 if rotate_worker_token:
-                    rca.join_token_worker = generate_join_token(root)
+                    rca.join_token_worker = generate_join_token(
+                        root, fips=nxt.fips)
                 if rotate_manager_token:
-                    rca.join_token_manager = generate_join_token(root)
+                    rca.join_token_manager = generate_join_token(
+                        root, fips=nxt.fips)
             if rotate_unlock_key:
                 import secrets as _secrets
 

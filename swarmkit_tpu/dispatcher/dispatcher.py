@@ -279,6 +279,21 @@ class Dispatcher:
                     "registrations")
 
         def cb(tx):
+            # mandatory-FIPS cluster: refuse non-FIPS registrations on the
+            # server side too (the join token already gates the client,
+            # reference node.go ErrMandatoryFIPS; this is the belt for a
+            # node whose FIPS mode flipped after it joined). A missing
+            # description falls back to the stored node's; a node the
+            # cluster knows nothing about must assert FIPS to register.
+            if any(c.fips for c in tx.find_clusters()):
+                desc = description
+                if desc is None:
+                    known = tx.get_node(node_id)
+                    desc = known.description if known is not None else None
+                if desc is None or not desc.fips:
+                    raise SessionInvalid(
+                        "node is not FIPS-enabled but cluster "
+                        "requires FIPS")
             node = tx.get_node(node_id)
             if node is None:
                 node = Node(id=node_id)

@@ -61,11 +61,18 @@ class Manager:
         external_ca=None,
         cert_expiry: float | None = None,
         autolock_key: bytes | None = None,
+        fips: bool = False,
     ):
         self.store = store if store is not None else MemoryStore()
         self.security = security
         self.raft = raft_node
-        self.cluster_id = cluster_id or new_id()
+        # a mandatory-FIPS cluster's id carries the marker prefix so every
+        # surface that sees the id knows (reference node.go:781-797
+        # generateFIPSClusterID / isMandatoryFIPSClusterID)
+        self.fips = fips
+        if cluster_id is None:
+            cluster_id = ("FIPS." if fips else "") + new_id()
+        self.cluster_id = cluster_id
         self.org = org
         self._lock = threading.Lock()
         self._is_leader = False
@@ -379,12 +386,15 @@ class Manager:
                 # first unrelated cluster write
                 spec.dispatcher.heartbeat_period = self.heartbeat_period
                 cluster = Cluster(id=self.cluster_id, spec=spec)
+                cluster.fips = self.fips
                 cluster.root_ca = RootCAObj(
                     ca_key_pem=self.root.key_pem or b"",
                     ca_cert_pem=self.root.cert_pem,
                     cert_digest=self.root.digest(),
-                    join_token_worker=generate_join_token(self.root),
-                    join_token_manager=generate_join_token(self.root),
+                    join_token_worker=generate_join_token(
+                        self.root, fips=self.fips),
+                    join_token_manager=generate_join_token(
+                        self.root, fips=self.fips),
                 )
                 if self.autolock_key:
                     # autolock: the raft-DEK KEK is operator-held; the
@@ -416,12 +426,15 @@ class Manager:
 
     def rotate_join_token(self, role: str) -> str:
         """role ∈ {'worker','manager'}; returns the new token."""
-        token = generate_join_token(self.root)
+        cluster = self.store.view(lambda tx: tx.get_cluster(self.cluster_id))
+        token = generate_join_token(
+            self.root, fips=bool(cluster is not None and cluster.fips))
 
         def txn(tx):
             cluster = tx.get_cluster(self.cluster_id)
             if cluster is None or cluster.root_ca is None:
                 raise KeyError("cluster not seeded")
+            cluster = cluster.copy()
             if role == "worker":
                 cluster.root_ca.join_token_worker = token
             elif role == "manager":

@@ -208,6 +208,7 @@ class SwarmNode:
         external_ca=None,
         generic_resources=None,  # {kind: count} or api Resources
         autolock: bool = False,
+        fips: bool = False,
     ):
         self.state_dir = state_dir
         self.executor = executor
@@ -229,6 +230,7 @@ class SwarmNode:
         self.external_ca = external_ca
         self.generic_resources = generic_resources
         self.autolock = autolock
+        self.fips = fips
         self._control_server: RPCServer | None = None
 
         self.security: SecurityConfig | None = None
@@ -407,7 +409,49 @@ class SwarmNode:
 
     # ------------------------------------------------------------ lifecycle
 
+    class MandatoryFIPSError(Exception):
+        """node.go ErrMandatoryFIPS: the cluster mandates FIPS but this
+        node is not FIPS-enabled."""
+
+    FIPS_MARKER = "fips-cluster"
+
+    def _check_fips(self):
+        """Mandatory-FIPS enforcement (reference node.go:59-60, 781-797 +
+        integration TestMixedFIPSClusterMandatoryFIPS): a join token
+        carrying the FIPS bit refuses non-FIPS joiners, and a node that
+        ever joined a mandatory-FIPS cluster refuses to RESTART in
+        non-FIPS mode (the marker persists in the state dir, the analogue
+        of the reference's FIPS.-prefixed cluster id in the cert org).
+        Non-mandatory clusters accept any mix of FIPS/non-FIPS nodes."""
+        import os as _os
+
+        marker = _os.path.join(self.state_dir, self.FIPS_MARKER)
+        mandated = False
+        if self.join_token is not None:
+            try:
+                from ..ca.config import parse_join_token
+
+                mandated = parse_join_token(self.join_token).fips
+            except Exception:
+                pass  # malformed tokens fail later with a clearer error
+        if _os.path.exists(marker):
+            mandated = True
+        if mandated and not self.fips:
+            raise self.MandatoryFIPSError(
+                "node is not FIPS-enabled but cluster requires FIPS")
+        # the marker is written when this node makes the cluster mandatory
+        # or joins one: a FIPS-enabled node in a NON-mandatory cluster
+        # must stay unbranded (restarting it without --join-addr is not a
+        # bootstrap — an existing identity means an existing membership)
+        fresh = not _os.path.exists(self._paths()[1])   # no cert on disk
+        bootstrap_fips = self.fips and self.join_addr is None and fresh
+        if (mandated or bootstrap_fips) and not _os.path.exists(marker):
+            _os.makedirs(self.state_dir, exist_ok=True)
+            with open(marker, "w") as f:
+                f.write("this node belongs to a mandatory-FIPS cluster\n")
+
     def start(self):
+        self._check_fips()
         if self.autolock and self.kek is None:
             # autolock without an operator-provided key: mint one; swarmd
             # prints it as SWARM_UNLOCK_KEY (docker's --autolock UX)
@@ -583,6 +627,7 @@ class SwarmNode:
             external_ca=self.external_ca,
             cert_expiry=self.cert_expiry,
             autolock_key=self.kek if self.autolock else None,
+            fips=self.fips,
         )
         build_manager_registry(self.manager, raft,
                                LeaderConns(raft, self.security),
@@ -774,6 +819,7 @@ class SwarmNode:
             log_broker=RemoteLogBroker(addr.split(",")[0].strip(),
                                        self.security),
             generic_resources=self.generic_resources,
+            fips=self.fips,
         )
         self.agent.on_session_message = self._on_session_message
         self.agent.start()

@@ -55,9 +55,11 @@ class Node:
         kek: bytes | None = None,
         heartbeat_period: float = 5.0,
         role_check_interval: float = 0.2,
+        fips: bool = False,
     ):
         self.state_dir = state_dir
         self.executor = executor
+        self.fips = fips
         self.join = join
         self.join_token = join_token
         self.org = org
@@ -152,6 +154,7 @@ class Node:
             target.dispatcher,
             self.executor,
             log_broker=target.log_broker,
+            fips=self.fips,
         )
         self.agent.start()
 

@@ -1,0 +1,185 @@
+"""Mandatory-FIPS semantics (the round-1 verdict's last 'missing' item):
+join tokens carry the cluster's FIPS mandate, non-FIPS nodes can neither
+join nor REJOIN a mandatory cluster, the dispatcher refuses non-FIPS
+registrations server-side, and token rotations preserve the bit. Mixed
+clusters without the mandate accept any combination.
+
+Reference: node.go:59-60 (ErrMandatoryFIPS), :781-797 (FIPS cluster-id
+marker), ca/config.go:107-163 (token FIPS bit), integration_test.go
+TestMixedFIPSCluster{NonMandatoryFIPS,MandatoryFIPS}.
+"""
+import os
+
+import pytest
+
+from swarmkit_tpu.api.specs import NodeDescription
+from swarmkit_tpu.ca import RootCA, generate_join_token
+from swarmkit_tpu.ca.config import parse_join_token
+from swarmkit_tpu.dispatcher.dispatcher import Dispatcher, SessionInvalid
+from swarmkit_tpu.manager.manager import Manager
+from swarmkit_tpu.node.daemon import SwarmNode
+from swarmkit_tpu.store.memory import MemoryStore
+
+
+def test_token_fips_bit_roundtrip():
+    root = RootCA.create()
+    plain = generate_join_token(root)
+    mandated = generate_join_token(root, fips=True)
+    assert not parse_join_token(plain).fips
+    assert parse_join_token(mandated).fips
+    assert parse_join_token(mandated).root_digest == root.digest()
+
+
+def test_fips_manager_seeds_mandatory_cluster():
+    mgr = Manager(store=MemoryStore(), org="test-org", fips=True)
+    mgr.start()
+    try:
+        assert mgr.cluster_id.startswith("FIPS.")
+        cluster = mgr.store.view(lambda tx: tx.get_cluster(mgr.cluster_id))
+        assert cluster.fips
+        assert parse_join_token(cluster.root_ca.join_token_worker).fips
+        assert parse_join_token(cluster.root_ca.join_token_manager).fips
+        # token rotation keeps the mandate
+        rotated = mgr.rotate_join_token("worker")
+        assert parse_join_token(rotated).fips
+    finally:
+        mgr.stop()
+
+
+def test_non_fips_manager_mints_plain_tokens():
+    mgr = Manager(store=MemoryStore(), org="test-org")
+    mgr.start()
+    try:
+        cluster = mgr.store.view(lambda tx: tx.get_cluster(mgr.cluster_id))
+        assert not cluster.fips
+        assert not parse_join_token(cluster.root_ca.join_token_worker).fips
+    finally:
+        mgr.stop()
+
+
+def test_non_fips_node_refuses_mandatory_join_token(tmp_path):
+    root = RootCA.create()
+    token = generate_join_token(root, fips=True)
+    node = SwarmNode(state_dir=str(tmp_path / "n1"), executor=None,
+                     join_addr="127.0.0.1:1", join_token=token)
+    with pytest.raises(SwarmNode.MandatoryFIPSError):
+        node.start()
+    # a FIPS-enabled node passes the gate (and then fails later on the
+    # unreachable join address — not under test here)
+    node2 = SwarmNode(state_dir=str(tmp_path / "n2"), executor=None,
+                      join_addr="127.0.0.1:1", join_token=token, fips=True)
+    node2._check_fips()  # no raise
+    # ...and the membership marker persisted for restart enforcement
+    assert os.path.exists(tmp_path / "n2" / SwarmNode.FIPS_MARKER)
+
+
+def test_restart_in_non_fips_mode_refused(tmp_path):
+    state = tmp_path / "n1"
+    state.mkdir()
+    (state / SwarmNode.FIPS_MARKER).write_text("member\n")
+    node = SwarmNode(state_dir=str(state), executor=None)
+    with pytest.raises(SwarmNode.MandatoryFIPSError):
+        node.start()
+    # restarting in FIPS mode is fine
+    node2 = SwarmNode(state_dir=str(state), executor=None, fips=True)
+    node2._check_fips()  # no raise
+
+
+def test_fips_bootstrap_writes_marker(tmp_path):
+    state = tmp_path / "m1"
+    node = SwarmNode(state_dir=str(state), executor=None, fips=True)
+    node._check_fips()
+    assert os.path.exists(state / SwarmNode.FIPS_MARKER)
+
+
+def test_dispatcher_rejects_non_fips_registration_in_fips_cluster():
+    mgr = Manager(store=MemoryStore(), org="test-org", fips=True)
+    mgr.start()
+    try:
+        d: Dispatcher = mgr.dispatcher
+        with pytest.raises(SessionInvalid):
+            d.register("plain-node", description=NodeDescription(
+                hostname="plain", fips=False))
+        sid = d.register("fips-node", description=NodeDescription(
+            hostname="fipsy", fips=True))
+        assert sid
+    finally:
+        mgr.stop()
+
+
+def test_mixed_cluster_without_mandate_accepts_both():
+    mgr = Manager(store=MemoryStore(), org="test-org")
+    mgr.start()
+    try:
+        d: Dispatcher = mgr.dispatcher
+        assert d.register("plain-node", description=NodeDescription(
+            hostname="plain", fips=False))
+        assert d.register("fips-node", description=NodeDescription(
+            hostname="fipsy", fips=True))
+    finally:
+        mgr.stop()
+
+
+def test_fips_node_in_mixed_cluster_not_branded_on_restart(tmp_path):
+    """A FIPS-enabled node that joined a NON-mandatory cluster restarts
+    without --join-addr (normal restart path); it must NOT be branded as
+    mandatory-FIPS — and must still restart fine without --fips."""
+    state = tmp_path / "n1"
+    state.mkdir()
+    # simulate the joined state: an identity cert exists
+    from swarmkit_tpu.node.daemon import CERT_FILE
+
+    (state / CERT_FILE).write_text("dummy cert\n")
+    node = SwarmNode(state_dir=str(state), executor=None, fips=True)
+    node._check_fips()
+    assert not os.path.exists(state / SwarmNode.FIPS_MARKER)
+    node2 = SwarmNode(state_dir=str(state), executor=None, fips=False)
+    node2._check_fips()  # no raise: the cluster never mandated FIPS
+
+
+def test_dispatcher_rejects_descriptionless_unknown_node_in_fips_cluster():
+    mgr = Manager(store=MemoryStore(), org="test-org", fips=True)
+    mgr.start()
+    try:
+        d: Dispatcher = mgr.dispatcher
+        with pytest.raises(SessionInvalid):
+            d.register("mystery-node", description=None)
+        # a known FIPS node re-registering without a description is fine:
+        # the stored description vouches for it
+        d.register("fips-node", description=NodeDescription(
+            hostname="fipsy", fips=True))
+        assert d.register("fips-node", description=None)
+    finally:
+        mgr.stop()
+
+
+def test_inprocess_node_joins_fips_manager(tmp_path):
+    from swarmkit_tpu.agent.testutils import FakeExecutor
+    from swarmkit_tpu.node.node import Node as InProcNode
+
+    mgr = Manager(store=MemoryStore(), org="test-org", fips=True,
+                  heartbeat_period=0.5)
+    mgr.start()
+    node = None
+    try:
+        cluster = mgr.store.view(lambda tx: tx.get_cluster(mgr.cluster_id))
+        token = cluster.root_ca.join_token_worker
+        node = InProcNode(state_dir=str(tmp_path / "w1"),
+                          executor=FakeExecutor(), join=mgr,
+                          join_token=token, fips=True,
+                          heartbeat_period=0.5)
+        node.start()
+
+        from test_scheduler import wait_for
+
+        def registered():
+            n = mgr.store.view(
+                lambda tx: tx.get_node(node.security.node_id()))
+            from swarmkit_tpu.api.types import NodeStatusState
+            return n is not None and \
+                n.status.state == NodeStatusState.READY
+        assert wait_for(registered, timeout=20)
+    finally:
+        if node is not None:
+            node.stop()
+        mgr.stop()
