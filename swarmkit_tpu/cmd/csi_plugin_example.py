@@ -1,0 +1,130 @@
+"""Runnable CSI plugin: directory-backed volumes over the plugin wire.
+
+The analogue of running an external CSI driver binary next to swarmd:
+this process serves the controller + node method sets on a unix socket
+(swarmkit_tpu.csi.wire protocol) and materializes volumes as directories
+under --data-dir, with node-publish creating a per-target symlink — real
+enough that an agent's workload sees a filesystem path appear and
+disappear with the volume lifecycle.
+
+    python -m swarmkit_tpu.cmd.csi_plugin_example \
+        --socket /run/myplugin.sock --data-dir /var/lib/myplugin \
+        [--name dir-csi] [--no-stage]
+
+Prints `CSI_PLUGIN_READY socket=…` once serving. swarmd attaches with
+`--csi-plugin /run/myplugin.sock`.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import threading
+
+from ..csi.plugin import CSIPlugin, CSIPluginError, VolumeInfo
+from ..csi.wire import CSIPluginServer, PluginCapabilities
+
+
+class DirectoryPlugin(CSIPlugin):
+    """Volumes are directories; publishes are symlinks (a minimal but
+    REAL storage backend — state survives plugin restarts)."""
+
+    def __init__(self, name: str, data_dir: str):
+        self.name = name
+        self.data_dir = data_dir
+        os.makedirs(os.path.join(data_dir, "volumes"), exist_ok=True)
+        os.makedirs(os.path.join(data_dir, "published"), exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _vol_path(self, volume_id: str) -> str:
+        return os.path.join(self.data_dir, "volumes", volume_id)
+
+    # ------------------------------------------------------ controller side
+    def create_volume(self, volume) -> VolumeInfo:
+        vol_id = f"{self.name}-{volume.id}"
+        path = self._vol_path(vol_id)
+        with self._lock:
+            os.makedirs(path, exist_ok=True)
+            with open(os.path.join(path, "meta.json"), "w") as f:
+                json.dump({"swarm_volume": volume.id,
+                           "name": volume.spec.annotations.name}, f)
+        return VolumeInfo(volume_id=vol_id, capacity_bytes=1 << 30,
+                          volume_context={"path": path})
+
+    def delete_volume(self, volume) -> None:
+        info = volume.volume_info
+        vol_id = info.volume_id if info else f"{self.name}-{volume.id}"
+        path = self._vol_path(vol_id)
+        with self._lock:
+            if os.path.isdir(path):
+                import shutil
+
+                shutil.rmtree(path)
+
+    def controller_publish(self, volume, node_id: str) -> dict[str, str]:
+        info = volume.volume_info
+        vol_id = info.volume_id if info else ""
+        if not vol_id or not os.path.isdir(self._vol_path(vol_id)):
+            raise CSIPluginError(f"unknown volume {vol_id!r}")
+        return {"path": self._vol_path(vol_id), "node": node_id}
+
+    def controller_unpublish(self, volume, node_id: str) -> None:
+        pass  # nothing node-specific to tear down controller-side
+
+    # ------------------------------------------------------------ node side
+    def _target(self, volume_assignment) -> str:
+        return os.path.join(self.data_dir, "published",
+                            volume_assignment.id)
+
+    def node_stage(self, volume_assignment) -> None:
+        if not os.path.isdir(self._vol_path(volume_assignment.volume_id)):
+            raise CSIPluginError(
+                f"volume {volume_assignment.volume_id!r} does not exist")
+
+    def node_unstage(self, volume_assignment) -> None:
+        pass
+
+    def node_publish(self, volume_assignment) -> None:
+        src = self._vol_path(volume_assignment.volume_id)
+        if not os.path.isdir(src):
+            raise CSIPluginError(
+                f"volume {volume_assignment.volume_id!r} does not exist")
+        target = self._target(volume_assignment)
+        with self._lock:
+            if not os.path.islink(target):
+                os.symlink(src, target)
+
+    def node_unpublish(self, volume_assignment) -> None:
+        target = self._target(volume_assignment)
+        with self._lock:
+            if os.path.islink(target):
+                os.unlink(target)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="csi-plugin-example")
+    ap.add_argument("--socket", required=True)
+    ap.add_argument("--data-dir", required=True)
+    ap.add_argument("--name", default="dir-csi")
+    ap.add_argument("--no-stage", action="store_true",
+                    help="drop the STAGE_UNSTAGE capability (clients must "
+                         "skip the stage round trips)")
+    args = ap.parse_args(argv)
+
+    plugin = DirectoryPlugin(args.name, args.data_dir)
+    caps = PluginCapabilities(stage_unstage=not args.no_stage)
+    server = CSIPluginServer(plugin, args.socket, capabilities=caps)
+    server.start()
+    print(f"CSI_PLUGIN_READY socket={args.socket} name={args.name}",
+          flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()

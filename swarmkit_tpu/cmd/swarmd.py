@@ -21,6 +21,21 @@ import sys
 import threading
 
 
+def _connect_csi_plugins(sockets):
+    if not sockets:
+        return None
+    from ..csi.plugin import PluginGetter
+    from ..csi.wire import RemoteCSIPlugin
+
+    getter = PluginGetter()
+    for sock in sockets:
+        plugin = RemoteCSIPlugin(sock).connect()
+        getter.add(plugin)
+        print(f"SWARM_CSI_PLUGIN name={plugin.name} socket={sock}",
+              flush=True)
+    return getter
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="swarmd", description="swarmkit-tpu cluster node daemon")
@@ -57,6 +72,12 @@ def main(argv=None) -> int:
     ap.add_argument("--external-ca", default=None, metavar="URL",
                     help="cfssl-compatible signing endpoint "
                          "(protocol=cfssl,url=… also accepted)")
+    ap.add_argument("--csi-plugin", action="append", default=[],
+                    metavar="SOCKET",
+                    help="attach an external CSI plugin by its unix "
+                         "socket (repeatable); the plugin process must "
+                         "speak the swarmkit_tpu.csi.wire protocol "
+                         "(see csi_plugin_example)")
     ap.add_argument("--fips", action="store_true",
                     help="run in FIPS mode; bootstrapping with this flag "
                          "creates a mandatory-FIPS cluster that only "
@@ -111,6 +132,13 @@ def main(argv=None) -> int:
                 url = v.strip()
         external_ca = ExternalCA(url)
 
+    try:
+        csi_plugins = _connect_csi_plugins(args.csi_plugin)
+    except Exception as exc:
+        print(f"error: cannot attach CSI plugin: {exc}", file=sys.stderr,
+              flush=True)
+        return 1
+
     node = SwarmNode(
         state_dir=args.state_dir,
         executor=executor,
@@ -128,6 +156,7 @@ def main(argv=None) -> int:
         autolock=args.autolock,
         kek=args.unlock_key.encode() if args.unlock_key else None,
         fips=args.fips,
+        csi_plugins=csi_plugins,
     )
     try:
         node.start()

@@ -209,6 +209,7 @@ class SwarmNode:
         generic_resources=None,  # {kind: count} or api Resources
         autolock: bool = False,
         fips: bool = False,
+        csi_plugins=None,  # csi.plugin.PluginGetter (e.g. RemoteCSIPlugin)
     ):
         self.state_dir = state_dir
         self.executor = executor
@@ -231,6 +232,7 @@ class SwarmNode:
         self.generic_resources = generic_resources
         self.autolock = autolock
         self.fips = fips
+        self.csi_plugins = csi_plugins
         self._control_server: RPCServer | None = None
 
         self.security: SecurityConfig | None = None
@@ -422,36 +424,44 @@ class SwarmNode:
         ever joined a mandatory-FIPS cluster refuses to RESTART in
         non-FIPS mode (the marker persists in the state dir, the analogue
         of the reference's FIPS.-prefixed cluster id in the cert org).
-        Non-mandatory clusters accept any mix of FIPS/non-FIPS nodes."""
+        Non-mandatory clusters accept any mix of FIPS/non-FIPS nodes.
+        Returns whether MEMBERSHIP in a mandatory cluster should be
+        recorded once this start's identity is actually established —
+        branding a state dir on a join that then fails would poison its
+        reuse (_mark_fips_membership runs post-identity)."""
         import os as _os
 
         marker = _os.path.join(self.state_dir, self.FIPS_MARKER)
-        mandated = False
+        token_mandates = False
         if self.join_token is not None:
             try:
                 from ..ca.config import parse_join_token
 
-                mandated = parse_join_token(self.join_token).fips
+                token_mandates = parse_join_token(self.join_token).fips
             except Exception:
                 pass  # malformed tokens fail later with a clearer error
-        if _os.path.exists(marker):
-            mandated = True
+        mandated = token_mandates or _os.path.exists(marker)
         if mandated and not self.fips:
             raise self.MandatoryFIPSError(
                 "node is not FIPS-enabled but cluster requires FIPS")
-        # the marker is written when this node makes the cluster mandatory
-        # or joins one: a FIPS-enabled node in a NON-mandatory cluster
-        # must stay unbranded (restarting it without --join-addr is not a
-        # bootstrap — an existing identity means an existing membership)
+        # membership gets recorded when this start makes the cluster
+        # mandatory (fresh FIPS bootstrap) or joins one; a FIPS-enabled
+        # node in a NON-mandatory cluster stays unbranded
         fresh = not _os.path.exists(self._paths()[1])   # no cert on disk
         bootstrap_fips = self.fips and self.join_addr is None and fresh
-        if (mandated or bootstrap_fips) and not _os.path.exists(marker):
+        return token_mandates or bootstrap_fips
+
+    def _mark_fips_membership(self):
+        import os as _os
+
+        marker = _os.path.join(self.state_dir, self.FIPS_MARKER)
+        if not _os.path.exists(marker):
             _os.makedirs(self.state_dir, exist_ok=True)
             with open(marker, "w") as f:
                 f.write("this node belongs to a mandatory-FIPS cluster\n")
 
     def start(self):
-        self._check_fips()
+        fips_member = self._check_fips()
         if self.autolock and self.kek is None:
             # autolock without an operator-provided key: mint one; swarmd
             # prints it as SWARM_UNLOCK_KEY (docker's --autolock UX)
@@ -459,6 +469,9 @@ class SwarmNode:
 
             self.kek = secrets.token_hex(16).encode()
         self.security = self._obtain_identity()
+        if fips_member:
+            # identity established: NOW the mandatory membership is real
+            self._mark_fips_membership()
         self._save_identity()
         # renewed certs / rotated roots must survive a restart: persist on
         # every credential swap (ca/certificates.go
@@ -628,6 +641,7 @@ class SwarmNode:
             cert_expiry=self.cert_expiry,
             autolock_key=self.kek if self.autolock else None,
             fips=self.fips,
+            csi_plugins=self.csi_plugins,
         )
         build_manager_registry(self.manager, raft,
                                LeaderConns(raft, self.security),
@@ -820,6 +834,7 @@ class SwarmNode:
                                        self.security),
             generic_resources=self.generic_resources,
             fips=self.fips,
+            csi_plugins=self.csi_plugins,
         )
         self.agent.on_session_message = self._on_session_message
         self.agent.start()

@@ -106,6 +106,11 @@ class _Registry:
                 self.add(cls)
             for cls in (csi_plugin.VolumePublishStatus, csi_plugin.VolumeInfo):
                 self.add(cls)
+
+            from ..csi import wire as csi_wire
+
+            for cls in (csi_wire.PluginCapabilities, csi_wire.PluginInfo):
+                self.add(cls)
             for cls in (dispatcher_mod.Assignment,
                         dispatcher_mod.AssignmentsMessage,
                         dispatcher_mod.SessionMessage):

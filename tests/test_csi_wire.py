@@ -1,0 +1,222 @@
+"""CSI plugin wire protocol: a REAL out-of-process plugin over a unix
+socket (csi/wire.py + cmd/csi_plugin_example.py), driven by the same
+VolumeManager / NodeVolumeManager that drive in-process plugins.
+
+Closes the round-1 inventory's last 'partial': the reference speaks CSI
+gRPC to plugin sockets with capability discovery; this is that boundary
+on the framework's native wire."""
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from swarmkit_tpu.agent.csi import NodeVolumeManager, VolumeAssignment
+from swarmkit_tpu.csi import PUBLISHED, PluginGetter, VolumeManager
+from swarmkit_tpu.csi.wire import RemoteCSIPlugin
+from swarmkit_tpu.store.memory import MemoryStore
+
+from test_csi import _csi_task, _volume
+from test_scheduler import wait_for
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def plugin_proc(tmp_path):
+    """The example plugin as a REAL child process on a unix socket."""
+    sock = str(tmp_path / "plugin.sock")
+    data = str(tmp_path / "data")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "swarmkit_tpu.cmd.csi_plugin_example",
+         "--socket", sock, "--data-dir", data],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, cwd=REPO)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not os.path.exists(sock):
+        assert proc.poll() is None, proc.stdout.read().decode()
+        time.sleep(0.05)
+    assert os.path.exists(sock)
+    yield sock, data
+    proc.kill()
+    proc.wait()
+
+
+def test_handshake_and_capabilities(plugin_proc):
+    sock, _data = plugin_proc
+    plugin = RemoteCSIPlugin(sock).connect()
+    try:
+        assert plugin.name == "dir-csi"
+        assert plugin.info.vendor_version
+        caps = plugin.capabilities
+        assert caps.controller and caps.node
+        assert caps.controller_publish and caps.stage_unstage
+    finally:
+        plugin.close()
+
+
+def test_volume_manager_drives_external_plugin(plugin_proc):
+    """The manager-side VolumeManager runs the full volume lifecycle
+    against the external process; the volume materializes as a real
+    directory and the publish context crosses the wire."""
+    sock, data = plugin_proc
+    plugin = RemoteCSIPlugin(sock).connect()
+    store = MemoryStore()
+    vm = VolumeManager(store, PluginGetter({plugin.name: plugin}))
+    vm.start()
+    try:
+        v = _volume("v1", "vol1", driver="dir-csi")
+        store.update(lambda tx: tx.create(v))
+        assert wait_for(
+            lambda: store.view(
+                lambda tx: tx.get_volume("v1")).volume_info is not None,
+            timeout=10)
+        info = store.view(lambda tx: tx.get_volume("v1")).volume_info
+        assert info.volume_id == "dir-csi-v1"
+        assert os.path.isdir(os.path.join(data, "volumes", "dir-csi-v1"))
+
+        from swarmkit_tpu.api.types import TaskState
+
+        t = _csi_task("t1")
+        t.node_id = "n1"
+        t.volumes = ["v1"]
+        t.status.state = TaskState.ASSIGNED
+        store.update(lambda tx: tx.create(t))
+        assert wait_for(
+            lambda: any(
+                s.node_id == "n1" and s.state == PUBLISHED
+                for s in store.view(
+                    lambda tx: tx.get_volume("v1")).publish_status),
+            timeout=10)
+        status = store.view(lambda tx: tx.get_volume("v1")).publish_status[0]
+        assert status.publish_context.get("path", "").endswith("dir-csi-v1")
+
+        # delete tears the directory down
+        def kill_and_delete(tx):
+            cur = tx.get_task("t1").copy()
+            cur.status.state = TaskState.COMPLETE
+            cur.desired_state = TaskState.SHUTDOWN
+            tx.update(cur)
+        store.update(kill_and_delete)
+        assert wait_for(
+            lambda: any(
+                s.state != PUBLISHED
+                for s in store.view(
+                    lambda tx: tx.get_volume("v1")).publish_status),
+            timeout=10)
+        vm.confirm_node_unpublish("v1", "n1")
+        assert wait_for(
+            lambda: not store.view(
+                lambda tx: tx.get_volume("v1")).publish_status, timeout=10)
+
+        def mark_delete(tx):
+            cur = tx.get_volume("v1").copy()
+            cur.pending_delete = True
+            tx.update(cur)
+        store.update(mark_delete)
+        assert wait_for(
+            lambda: store.view(lambda tx: tx.get_volume("v1")) is None,
+            timeout=10)
+        assert not os.path.isdir(os.path.join(data, "volumes", "dir-csi-v1"))
+    finally:
+        vm.stop()
+        plugin.close()
+
+
+def test_node_side_publish_creates_real_path(plugin_proc):
+    """The agent-side NodeVolumeManager stages/publishes through the wire:
+    node_publish creates the symlink, node_unpublish removes it."""
+    sock, data = plugin_proc
+    plugin = RemoteCSIPlugin(sock).connect()
+    published = []
+    nvm = NodeVolumeManager(PluginGetter({plugin.name: plugin}),
+                            on_unpublished=published.append)
+    nvm.start()
+    try:
+        # materialize the backing volume first (controller side)
+        v = _volume("v9", "vol9", driver="dir-csi")
+        info = plugin.create_volume(v)
+        va = VolumeAssignment(id="v9", volume_id=info.volume_id,
+                              driver="dir-csi")
+        nvm.add(va)
+        link = os.path.join(data, "published", "v9")
+        assert wait_for(lambda: os.path.islink(link), timeout=10)
+        assert os.path.isdir(os.readlink(link))
+
+        nvm.remove(va)
+        assert wait_for(lambda: "v9" in published, timeout=10)
+        assert not os.path.islink(link)
+    finally:
+        nvm.stop()
+        plugin.close()
+
+
+def test_capability_negotiation_no_stage(tmp_path):
+    """A plugin without STAGE_UNSTAGE: the adapter skips the stage round
+    trips (CSI capability semantics) and publish still works."""
+    sock = str(tmp_path / "ns.sock")
+    data = str(tmp_path / "ns-data")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "swarmkit_tpu.cmd.csi_plugin_example",
+         "--socket", sock, "--data-dir", data, "--no-stage"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, cwd=REPO)
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not os.path.exists(sock):
+            assert proc.poll() is None
+            time.sleep(0.05)
+        plugin = RemoteCSIPlugin(sock).connect()
+        assert not plugin.capabilities.stage_unstage
+        # node_stage is a local no-op for an unknown volume: with the
+        # capability present this would raise over the wire
+        plugin.node_stage(VolumeAssignment(id="x", volume_id="ghost",
+                                           driver="dir-csi"))
+        # publish of a real volume still round-trips
+        v = _volume("v2", "vol2", driver="dir-csi")
+        info = plugin.create_volume(v)
+        va = VolumeAssignment(id="v2", volume_id=info.volume_id,
+                              driver="dir-csi")
+        plugin.node_publish(va)
+        assert os.path.islink(os.path.join(data, "published", "v2"))
+        plugin.close()
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_plugin_restart_preserves_volumes(plugin_proc, tmp_path):
+    """Directory-backed state survives a plugin restart: a new process on
+    the same data dir still publishes the old volume."""
+    sock, data = plugin_proc
+    plugin = RemoteCSIPlugin(sock).connect()
+    v = _volume("v5", "vol5", driver="dir-csi")
+    info = plugin.create_volume(v)
+    plugin.close()
+
+    sock2 = str(tmp_path / "plugin2.sock")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    proc2 = subprocess.Popen(
+        [sys.executable, "-m", "swarmkit_tpu.cmd.csi_plugin_example",
+         "--socket", sock2, "--data-dir", data],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, cwd=REPO)
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not os.path.exists(sock2):
+            time.sleep(0.05)
+        plugin2 = RemoteCSIPlugin(sock2).connect()
+        va = VolumeAssignment(id="v5", volume_id=info.volume_id,
+                              driver="dir-csi")
+        plugin2.node_publish(va)
+        assert os.path.islink(os.path.join(data, "published", "v5"))
+        plugin2.close()
+    finally:
+        proc2.kill()
+        proc2.wait()

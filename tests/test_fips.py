@@ -68,8 +68,15 @@ def test_non_fips_node_refuses_mandatory_join_token(tmp_path):
     # unreachable join address — not under test here)
     node2 = SwarmNode(state_dir=str(tmp_path / "n2"), executor=None,
                       join_addr="127.0.0.1:1", join_token=token, fips=True)
-    node2._check_fips()  # no raise
-    # ...and the membership marker persisted for restart enforcement
+    assert node2._check_fips() is True  # membership to record post-join
+    # the marker is NOT written yet: branding happens only once the join
+    # actually establishes an identity (a failed join must not poison
+    # the state dir for non-FIPS reuse)
+    assert not os.path.exists(tmp_path / "n2" / SwarmNode.FIPS_MARKER)
+    node3 = SwarmNode(state_dir=str(tmp_path / "n2"), executor=None)
+    node3._check_fips()  # no raise: unbranded dir reusable without FIPS
+    # after a SUCCESSFUL join the membership is recorded
+    node2._mark_fips_membership()
     assert os.path.exists(tmp_path / "n2" / SwarmNode.FIPS_MARKER)
 
 
@@ -88,7 +95,8 @@ def test_restart_in_non_fips_mode_refused(tmp_path):
 def test_fips_bootstrap_writes_marker(tmp_path):
     state = tmp_path / "m1"
     node = SwarmNode(state_dir=str(state), executor=None, fips=True)
-    node._check_fips()
+    assert node._check_fips() is True
+    node._mark_fips_membership()  # start() does this post-identity
     assert os.path.exists(state / SwarmNode.FIPS_MARKER)
 
 
