@@ -92,8 +92,12 @@ class DirectoryPlugin(CSIPlugin):
                 f"volume {volume_assignment.volume_id!r} does not exist")
         target = self._target(volume_assignment)
         with self._lock:
-            if not os.path.islink(target):
-                os.symlink(src, target)
+            # re-point rather than skip: a stale link from a previous
+            # volume generation (plugin killed mid-unpublish) would
+            # otherwise 'publish' a dangling path
+            if os.path.islink(target) or os.path.exists(target):
+                os.unlink(target)
+            os.symlink(src, target)
 
     def node_unpublish(self, volume_assignment) -> None:
         target = self._target(volume_assignment)

@@ -522,6 +522,50 @@ def cmd_secret_rm(args):
     print(secrets[0].id)
 
 
+def cmd_volume_create(args):
+    from ..api.specs import Annotations, VolumeAccessMode, VolumeSpec
+
+    ctl = _control(args)
+    v = ctl.create_volume(VolumeSpec(
+        annotations=Annotations(name=args.name),
+        driver=args.driver,
+        group=args.group or "",
+        access_mode=VolumeAccessMode(scope=args.scope,
+                                     sharing=args.sharing)))
+    print(v.id)
+
+
+def cmd_volume_ls(args):
+    ctl = _control(args)
+    rows = []
+    for v in ctl.list_volumes():
+        info = v.volume_info
+        published = len(v.publish_status or [])
+        if v.pending_delete:
+            # still reserves its name until the CSI manager finishes the
+            # teardown — hiding it would make the conflict undiagnosable
+            state = "<removing>"
+        elif info:
+            state = info.volume_id
+        else:
+            state = "<creating>"
+        rows.append([_short(v.id), v.spec.annotations.name, v.spec.driver,
+                     v.spec.group or "-", state, published])
+    print(_fmt_table(rows, ["ID", "NAME", "DRIVER", "GROUP", "PLUGIN ID",
+                            "PUBLISHED"]))
+
+
+def cmd_volume_rm(args):
+    from ..controlapi.control import ListFilters
+
+    ctl = _control(args)
+    vols = ctl.list_volumes(ListFilters(names=[args.name]))
+    if not vols:
+        _die(f"volume {args.name!r} not found")
+    ctl.remove_volume(vols[0].id, force=args.force)
+    print(vols[0].id)
+
+
 def cmd_config_create(args):
     from ..api.specs import Annotations, ConfigSpec
 
@@ -731,6 +775,24 @@ def main(argv=None) -> int:
     p = cfg.add_parser("rm")
     p.add_argument("name")
     p.set_defaults(func=cmd_config_rm)
+
+    vol = sub.add_parser("volume").add_subparsers(dest="sub", required=True)
+    p = vol.add_parser("create")
+    p.add_argument("name")
+    p.add_argument("--driver", required=True,
+                   help="CSI plugin name (see swarmd --csi-plugin)")
+    p.add_argument("--group", default=None)
+    p.add_argument("--scope", default="multi", choices=["single", "multi"])
+    p.add_argument("--sharing", default="all",
+                   choices=["none", "readonly", "onewriter", "all"])
+    p.set_defaults(func=cmd_volume_create)
+    p = vol.add_parser("ls")
+    p.set_defaults(func=cmd_volume_ls)
+    p = vol.add_parser("rm")
+    p.add_argument("name")
+    p.add_argument("--force", action="store_true",
+                   help="remove even while published")
+    p.set_defaults(func=cmd_volume_rm)
 
     # logs
     p = sub.add_parser("logs")

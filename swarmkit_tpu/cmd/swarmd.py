@@ -28,8 +28,15 @@ def _connect_csi_plugins(sockets):
     from ..csi.wire import RemoteCSIPlugin
 
     getter = PluginGetter()
+    seen: dict[str, str] = {}
     for sock in sockets:
         plugin = RemoteCSIPlugin(sock).connect()
+        if plugin.name in seen:
+            raise SystemExit(
+                f"error: CSI plugins at {seen[plugin.name]} and {sock} "
+                f"both report the name {plugin.name!r}; give one a "
+                "distinct --name")
+        seen[plugin.name] = sock
         getter.add(plugin)
         print(f"SWARM_CSI_PLUGIN name={plugin.name} socket={sock}",
               flush=True)

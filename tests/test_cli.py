@@ -207,3 +207,16 @@ def test_external_ca_example_server(tmp_path):
             except subprocess.TimeoutExpired:
                 proc.kill()
         shutil.rmtree(state, ignore_errors=True)
+
+
+def test_volume_commands_via_cli(daemon):
+    addr, ident = daemon["addr"], daemon["identity"]
+    _ctl(addr, ident, "volume", "create", "data-vol", "--driver", "dir-csi")
+    out = _ctl(addr, ident, "volume", "ls")
+    assert "data-vol" in out and "dir-csi" in out
+    # no plugin attached to this daemon: the volume sits in <creating>
+    assert "<creating>" in out
+    _ctl(addr, ident, "volume", "rm", "data-vol")
+    # no plugin to finish the teardown: the volume shows as removing
+    # (it still reserves its name, so hiding it would be misleading)
+    assert "<removing>" in _ctl(addr, ident, "volume", "ls")

@@ -23,23 +23,29 @@ from test_scheduler import wait_for
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-@pytest.fixture
-def plugin_proc(tmp_path):
-    """The example plugin as a REAL child process on a unix socket."""
-    sock = str(tmp_path / "plugin.sock")
-    data = str(tmp_path / "data")
+def _spawn_plugin(sock: str, data: str, *extra):
+    """Start the example plugin process and wait for its socket."""
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
     env.pop("XLA_FLAGS", None)
     proc = subprocess.Popen(
         [sys.executable, "-m", "swarmkit_tpu.cmd.csi_plugin_example",
-         "--socket", sock, "--data-dir", data],
+         "--socket", sock, "--data-dir", data, *extra],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, cwd=REPO)
     deadline = time.monotonic() + 30
     while time.monotonic() < deadline and not os.path.exists(sock):
         assert proc.poll() is None, proc.stdout.read().decode()
         time.sleep(0.05)
-    assert os.path.exists(sock)
+    assert os.path.exists(sock), "plugin socket never appeared"
+    return proc
+
+
+@pytest.fixture
+def plugin_proc(tmp_path):
+    """The example plugin as a REAL child process on a unix socket."""
+    sock = str(tmp_path / "plugin.sock")
+    data = str(tmp_path / "data")
+    proc = _spawn_plugin(sock, data)
     yield sock, data
     proc.kill()
     proc.wait()
@@ -159,18 +165,8 @@ def test_capability_negotiation_no_stage(tmp_path):
     trips (CSI capability semantics) and publish still works."""
     sock = str(tmp_path / "ns.sock")
     data = str(tmp_path / "ns-data")
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
-    env.pop("XLA_FLAGS", None)
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "swarmkit_tpu.cmd.csi_plugin_example",
-         "--socket", sock, "--data-dir", data, "--no-stage"],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, cwd=REPO)
+    proc = _spawn_plugin(sock, data, "--no-stage")
     try:
-        deadline = time.monotonic() + 30
-        while time.monotonic() < deadline and not os.path.exists(sock):
-            assert proc.poll() is None
-            time.sleep(0.05)
         plugin = RemoteCSIPlugin(sock).connect()
         assert not plugin.capabilities.stage_unstage
         # node_stage is a local no-op for an unknown volume: with the
@@ -200,17 +196,8 @@ def test_plugin_restart_preserves_volumes(plugin_proc, tmp_path):
     plugin.close()
 
     sock2 = str(tmp_path / "plugin2.sock")
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
-    env.pop("XLA_FLAGS", None)
-    proc2 = subprocess.Popen(
-        [sys.executable, "-m", "swarmkit_tpu.cmd.csi_plugin_example",
-         "--socket", sock2, "--data-dir", data],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, cwd=REPO)
+    proc2 = _spawn_plugin(sock2, data)
     try:
-        deadline = time.monotonic() + 30
-        while time.monotonic() < deadline and not os.path.exists(sock2):
-            time.sleep(0.05)
         plugin2 = RemoteCSIPlugin(sock2).connect()
         va = VolumeAssignment(id="v5", volume_id=info.volume_id,
                               driver="dir-csi")
