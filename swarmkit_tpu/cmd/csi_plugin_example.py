@@ -92,12 +92,17 @@ class DirectoryPlugin(CSIPlugin):
                 f"volume {volume_assignment.volume_id!r} does not exist")
         target = self._target(volume_assignment)
         with self._lock:
-            # re-point rather than skip: a stale link from a previous
-            # volume generation (plugin killed mid-unpublish) would
-            # otherwise 'publish' a dangling path
-            if os.path.islink(target) or os.path.exists(target):
-                os.unlink(target)
-            os.symlink(src, target)
+            if os.path.islink(target) and os.readlink(target) == src \
+                    and os.path.exists(target):
+                return  # already correctly published: leave it untouched
+            # re-point ATOMICALLY (tmp symlink + rename): a stale link
+            # from a previous volume generation must not survive, but a
+            # concurrent reader must never observe a missing target
+            tmp = target + ".tmp"
+            if os.path.islink(tmp):
+                os.unlink(tmp)
+            os.symlink(src, tmp)
+            os.replace(tmp, target)
 
     def node_unpublish(self, volume_assignment) -> None:
         target = self._target(volume_assignment)

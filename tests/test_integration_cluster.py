@@ -336,9 +336,9 @@ def test_root_rotation_under_live_nodes(cluster):
         n = leader.store.view(lambda tx: tx.get_node(w1.node_id))
         return n is not None and n.status.state == NodeStatusState.READY
 
-    assert wait_for(worker_ready, timeout=20)
+    assert wait_for(worker_ready, timeout=40)
     svc = _create_service(cluster, "pre-rotate", 4)
-    assert wait_for(lambda: len(cluster.running(svc.id)) == 4, timeout=30)
+    assert wait_for(lambda: len(cluster.running(svc.id)) == 4, timeout=60)
 
     old_root = m1.security.root_ca.cert_pem
     leader.manager.ca_server.rotate_root_ca()
@@ -350,7 +350,10 @@ def test_root_rotation_under_live_nodes(cluster):
                 and m1.security.root_ca.cert_pem == new_root
                 and w1.security.root_ca.cert_pem == new_root)
 
-    assert wait_for(renewed, timeout=60)
+    # renewal chains: session-plane root update -> node re-CSR -> signer
+    # pass -> credential swap, each on its own timer; loaded CI machines
+    # stretch every hop (wait_for returns early when healthy)
+    assert wait_for(renewed, timeout=120)
 
     # the data plane survives rotation: scale the service up over the wire
     ctl = cluster.control()
