@@ -99,7 +99,7 @@ class DirectoryPlugin(CSIPlugin):
             # from a previous volume generation must not survive, but a
             # concurrent reader must never observe a missing target
             tmp = target + ".tmp"
-            if os.path.islink(tmp):
+            if os.path.lexists(tmp):
                 os.unlink(tmp)
             os.symlink(src, tmp)
             os.replace(tmp, target)

@@ -52,7 +52,7 @@ class Cluster:
         name = name or f"m{self._next()}"
         if not self.nodes:
             n = self._spawn(name, listen_addr="127.0.0.1:0")
-            assert wait_for(lambda: n.is_leader, timeout=15)
+            assert wait_for(lambda: n.is_leader, timeout=30)
             return n
         mtok, _ = self.tokens()
         return self._spawn(name, listen_addr="127.0.0.1:0",
@@ -84,7 +84,7 @@ class Cluster:
             c = m.store.view(lambda tx: tx.get_cluster(m.manager.cluster_id))
             return c is not None and c.root_ca is not None
 
-        assert wait_for(seeded, timeout=15)
+        assert wait_for(seeded, timeout=30)
         c = m.store.view(lambda tx: tx.get_cluster(m.manager.cluster_id))
         return c.root_ca.join_token_manager, c.root_ca.join_token_worker
 
@@ -272,12 +272,24 @@ def test_wrong_cert_join_rejected(cluster, tmp_path):
         assert any(s in msg for s in ("ssl", "certificate", "tls",
                                       "handshake", "connection")), msg
 
-        # and the legitimate identity still works
-        ctl = cluster.control()
-        try:
-            assert ctl.list_services() == []
-        finally:
-            ctl.close()
+        # and the legitimate identity still works (retry-tolerant: a
+        # loaded machine can starve the in-process TLS server past a
+        # single call timeout)
+        last_err = [None]
+
+        def legit_ok():
+            ctl = cluster.control()
+            try:
+                return ctl.list_services() == []
+            except Exception as exc:
+                last_err[0] = exc  # kept for triage: flake vs real bug
+                return False
+            finally:
+                ctl.close()
+
+        assert wait_for(legit_ok, timeout=90), \
+            f"legitimate identity never worked; last error: {last_err[0]!r}"
+
     finally:
         foreign.stop_all()
 
