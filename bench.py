@@ -10,9 +10,12 @@ placement required. Two regimes are measured:
   * cold   — first contact: full dictionary encode of every node row AND a
     full upload of the node tables to the device;
   * steady — the scheduler's real regime (round-2: device-RESIDENT node
-    state, ops/resident.py): wave k's placements are folded on device by
-    the kernel itself and on host by the encoder; wave k+1 ships only
-    dirty-row deltas up and the sliced int16 counts down.
+    state, ops/resident.py; round-3: PIPELINED ticks, ops/pipeline.py):
+    wave k's placements are folded on device by the kernel itself and on
+    host by the encoder; wave k+1 ships only dirty-row deltas up and the
+    sliced int16 counts down — and that counts D2H rides the tunnel in
+    the background while the host commits wave k, so the blocking
+    residual per tick is near zero.
 
 `value`/`vs_baseline` report the steady tick; both appear in detail.
 Also measured (detail.configs): constraint-heavy filtering, resource
@@ -182,10 +185,22 @@ def _probe_resident_kernel(p, placement_ops, runs=5):
 
 
 def bench_scheduler_config(np, placement_ops, batch, n_nodes, n_tasks,
-                           n_services, waves=3, **kw):
-    """Cold tick (fresh encoder + full device upload) then `waves` steady
-    ticks with apply between them; reports the best steady tick (min over
-    waves — tunnel jitter) and the cold tick."""
+                           n_services, waves=4, **kw):
+    """Cold tick (fresh encoder + full device upload), then `waves` steady
+    ticks through the TickPipeline (ops/pipeline.py): wave k's counts D2H
+    rides the tunnel in the background while the host commits wave k-1
+    (slot materialization + one add_task per placement) — the reorder the
+    serial path couldn't do. Groups are PRE-generated so only real
+    scheduler work (never bench scaffolding) hides the transfer.
+
+    Steady metrics:
+      * tpu_tick_s — the classic decomposition (encode + device-blocking
+        + materialize), where device-blocking is now dispatch + the pull
+        RESIDUAL after overlap;
+      * e2e_wave_s — a full pipelined period wall-clock, including the
+        add_task commit loop, vs cpu_e2e_wave_s doing identical work with
+        the CPU fill (both paths commit the same placements — parity)."""
+    from swarmkit_tpu.ops.pipeline import TickPipeline
     from swarmkit_tpu.ops.resident import ResidentPlacement
     from swarmkit_tpu.scheduler.encode import IncrementalEncoder
 
@@ -205,40 +220,114 @@ def bench_scheduler_config(np, placement_ops, batch, n_nodes, n_tasks,
     cold = _tick(enc, rp, infos, _mk_groups(rng, n_tasks, n_services,
                                             wave=1, **kw), batch, np)
     parity = cold["parity"]
-    steadies = []
-    last = cold
-    for w in range(waves):
-        _apply_wave(enc, rp, infos, last["problem"], last["counts"], batch)
-        last = _tick(enc, rp, infos,
-                     _mk_groups(rng, n_tasks, n_services, wave=2 + w, **kw),
-                     batch, np)
-        parity = parity and last["parity"]
-        steadies.append(last)
+    _apply_wave(enc, rp, infos, cold["problem"], cold["counts"], batch)
 
-    best = min(steadies, key=lambda r: r["tpu_tick_s"])
-    kernel_resident_s = _probe_resident_kernel(best["problem"], placement_ops)
+    wave_groups = [_mk_groups(rng, n_tasks, n_services, wave=2 + w, **kw)
+                   for w in range(waves)]
+
+    by_node = {i.node.id: i for i in infos}
+    commit_phases = []                      # per wave: (materialize_s, add_s)
+
+    def commit(p, counts):
+        # the production commit shape (_apply_decisions): slot orders, then
+        # the group's id-sorted tasks zip with them — no task-id dict
+        t0 = time.perf_counter()
+        orders = batch.materialize_orders(p, counts)
+        mat_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        infos_arr = [by_node[nid] for nid in p.node_ids]
+        n_added = 0
+        for g, order in zip(p.groups, orders):
+            for t, ni in zip(g.tasks, order.tolist()):
+                if infos_arr[ni].add_task(t):
+                    n_added += 1
+        assert n_added == int(counts.sum())
+        commit_phases.append((mat_s, time.perf_counter() - t0))
+
+    assert waves >= 3, "steady sampling needs a fully-pipelined wave " \
+        "(wave 0's pull has no commit window under it)"
+    pipe = TickPipeline(enc, rp, commit)
+    delta_rows_mark = None
+    done = []
+    for w in range(waves):
+        prev = pipe.tick(infos, wave_groups[w])
+        if w == 0:
+            delta_rows_mark = rp.uploads_delta_rows
+        if prev is not None:
+            done.append(prev)
+    done.append(pipe.flush())
+    assert len(done) == waves and not any(
+        t["serial_fallback"] for t in pipe.timings)
+
+    # parity: every steady wave bit-identical to the oracle on the same
+    # emitted problem (the snapshot the device scheduled against)
+    for p, counts in done:
+        parity = parity and bool(
+            (counts == batch.cpu_schedule_encoded(p)).all())
+    p_last, c_last = done[-1]
+    orders = batch.materialize_orders(p_last, c_last)
+    cpu_orders = batch.materialize_orders(
+        p_last, batch.cpu_schedule_encoded(p_last))
+    parity = parity and all(
+        np.array_equal(a, b) for a, b in zip(orders, cpu_orders))
+
+    # classic decomposition per steady wave w: encode/dispatch live in
+    # timings[w], its pull residual + fold in timings[w+1] (the next call
+    # completes it), its commit phases in commit_phases[w]
+    T = pipe.timings
+    per_wave = []
+    for w in range(waves):
+        mat_s, add_s = commit_phases[w]
+        dev = T[w]["dispatch_s"] + T[w + 1]["pull_s"]
+        per_wave.append({
+            "tick": T[w]["encode_s"] + dev + mat_s,
+            "encode": T[w]["encode_s"], "device": dev, "mat": mat_s,
+            "add": add_s, "fold": T[w + 1]["fold_s"],
+        })
+    best_w = min(range(waves), key=lambda w: per_wave[w]["tick"])
+    best = per_wave[best_w]
+    cpu_fill_s, cpu_counts = best_of(
+        lambda: batch.cpu_schedule_encoded(done[best_w][0]), 2)
+    cpu_tick_s = best["encode"] + cpu_fill_s + best["mat"]
+
+    # full pipelined periods: calls 2..waves-1 each cover one whole steady
+    # wave (pull+fold+commit of the previous, encode+dispatch of the next).
+    # Call 1 is excluded: its pull is wave 0's, whose transfer had no
+    # commit running under it (pipeline fill-in), so including it would
+    # report a serial period as the pipelined number.
+    e2e = [T[w]["wall_s"] for w in range(2, waves)]
+    e2e_wave_s = min(e2e)
+    cpu_e2e_wave_s = cpu_tick_s + best["add"] + best["fold"]
+
+    kernel_resident_s = _probe_resident_kernel(done[best_w][0],
+                                               placement_ops)
     return {
         "compile_s": round(compile_s, 2),
-        "tpu_tick_s": round(best["tpu_tick_s"], 4),
-        "cpu_tick_s": round(best["cpu_tick_s"], 4),
-        "device_s": round(best["device_s"], 5),
+        "tpu_tick_s": round(best["tick"], 4),
+        "cpu_tick_s": round(cpu_tick_s, 4),
+        "device_s": round(best["device"], 5),
         "kernel_resident_s": round(kernel_resident_s, 6),
-        "cpu_fill_s": round(best["cpu_fill_s"], 4),
-        "encode_s": round(best["encode_s"], 4),
+        "cpu_fill_s": round(cpu_fill_s, 4),
+        "encode_s": round(best["encode"], 4),
+        "materialize_s": round(best["mat"], 4),
+        "e2e_wave_s": round(e2e_wave_s, 4),
+        "cpu_e2e_wave_s": round(cpu_e2e_wave_s, 4),
+        "e2e_speedup": round(cpu_e2e_wave_s / e2e_wave_s, 2),
         "cold_tpu_tick_s": round(cold["tpu_tick_s"], 4),
         "cold_cpu_tick_s": round(cold["cpu_tick_s"], 4),
         "cold_device_s": round(cold["device_s"], 4),
-        "speedup": round(best["cpu_tick_s"] / best["tpu_tick_s"], 2),
+        "speedup": round(cpu_tick_s / best["tick"], 2),
         "cold_speedup": round(cold["cpu_tick_s"] / cold["tpu_tick_s"], 2),
-        "device_vs_kernel_x": round(best["device_s"] / kernel_resident_s, 1),
+        "device_vs_kernel_x": round(best["device"] / kernel_resident_s, 1),
+        # marginal rate across fully-steady ticks: excludes the first
+        # steady dispatch, which ships the cold wave's correction burst
         "delta_rows_per_steady_tick": (
-            steadies[-1]["delta_rows_shipped"]
-            - steadies[0]["delta_rows_shipped"]) // max(1, waves - 1)
-        if waves > 1 else steadies[0]["delta_rows_shipped"],
-        "full_uploads": steadies[-1]["full_uploads"],
+            (rp.uploads_delta_rows - delta_rows_mark) // max(1, waves - 1)),
+        "full_uploads": rp.uploads_full,
         "parity": parity,
-        "placed": best["placed"],
-        "all_steady_tpu_s": [round(s["tpu_tick_s"], 4) for s in steadies],
+        "placed": int(c_last.sum()),
+        "all_steady_tpu_s": [round(pw["tick"], 4) for pw in per_wave],
+        "all_e2e_wave_s": [round(t, 4) for t in e2e],
     }
 
 
@@ -473,6 +562,9 @@ def bench_host_micro(np):
     }
 
     # ---- watch queue: 10k subscribers, 4 publishers ---------------------
+    # two regimes: per-event publish (the reference bench's shape,
+    # watch_test.go:153-216) and batched publish_all — the store's actual
+    # per-commit delivery path (store/memory.py uses publish_all)
     import threading
 
     q = WatchQueue(default_limit=None)
@@ -489,11 +581,28 @@ def bench_host_micro(np):
     fanout_s = time.perf_counter() - t0
     delivered = EVENTS * len(subs)
     drained = sum(len(s.drain()) for s in subs[:10]) * (len(subs) // 10)
+
+    BATCH = 25                      # a store commit's event batch
+    t0 = time.perf_counter()
+    ts = [threading.Thread(
+        target=lambda: [q.publish_all([object()] * BATCH)
+                        for _ in range(EVENTS // PUBS // BATCH)])
+        for _ in range(PUBS)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    batch_s = time.perf_counter() - t0
+    for s in subs[:10]:
+        s.drain()
     q.close()
     out["watch_queue_10k_subs"] = {
         "published": EVENTS, "subscribers": len(subs),
         "deliveries_per_s": round(delivered / fanout_s),
         "publish_s": round(fanout_s, 4),
+        "batch_size": BATCH,
+        "batched_deliveries_per_s": round(delivered / batch_s),
+        "batched_publish_s": round(batch_s, 4),
         "sanity_drained_estimate": drained,
     }
 
@@ -544,7 +653,7 @@ def main():
         "grid_100k_x_1k": bench_scheduler_config(
             np, placement_ops, batch, 1_000, 100_000, 20),
         "grid_1m_x_10k": bench_scheduler_config(
-            np, placement_ops, batch, 10_000, 1_000_000, 100, waves=2),
+            np, placement_ops, batch, 10_000, 1_000_000, 100),
         "global_diff_50svc_x_10k": bench_global_diff(np),
         "raft_replay_1m_x_5": bench_raft_replay(np),
         "host_micro": bench_host_micro(np),
@@ -569,13 +678,19 @@ def main():
             "placement_parity": parity,
             "north_star_under_1s": bool(ns["tpu_tick_s"] < 1.0),
             "note": ("steady ticks run on device-RESIDENT node state "
-                     "(ops/resident.py): deltas up, sliced int16 counts "
-                     "down; cold ticks pay the full encode + upload. "
-                     "device phases still include this dev setup's "
-                     "tunneled TPU link latency per call; "
-                     "kernel_resident_s is the pure device-resident fill "
-                     "a PCIe-attached host would see. Placements are "
-                     "bit-identical to the CPU oracle in every config."),
+                     "(ops/resident.py) through the tick PIPELINE "
+                     "(ops/pipeline.py): deltas up, sliced int16 counts "
+                     "down, with the counts D2H overlapped under the "
+                     "previous wave's commit (one add_task per placement "
+                     "+ slot materialization) — so device_s is the "
+                     "dispatch + pull residual, near zero when the commit "
+                     "window covers the transfer. e2e_wave_s/"
+                     "cpu_e2e_wave_s compare full wave periods including "
+                     "that shared commit work. Cold ticks pay the full "
+                     "encode + upload serially. kernel_resident_s is the "
+                     "pure device-resident fill a PCIe-attached host "
+                     "would see. Placements are bit-identical to the CPU "
+                     "oracle in every config."),
         },
     }
     print(json.dumps(result))

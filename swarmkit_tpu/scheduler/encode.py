@@ -553,7 +553,48 @@ class IncrementalEncoder:
         (created_seq, mutations + placed_on_node) — anything else that moved
         the counters shows up as a mismatch next tick and re-encodes (safe).
         Returns False (caller should skip folding) when node sets diverged.
+
+        Pipelined split (ops/pipeline.py): `fold_counts` is the array fold
+        alone — everything the NEXT encode() needs — and `restamp_counts`
+        is the fingerprint stamp, legal only once the add_task loop ran.
+        Between the two calls the encoder arrays are ahead of the NodeInfo
+        objects; `encode()` is safe in that window ONLY while no node row
+        is dirty (`nodes_clean`), because a dirty row would re-encode from
+        the not-yet-updated info and clobber the fold.
         """
+        if not self.fold_counts(p, counts):
+            return False
+        self.restamp_counts(p, counts)
+        return True
+
+    def nodes_clean(self, infos) -> bool:
+        """Read-only fingerprint scan: True iff `encode(infos, …)` would
+        find zero dirty rows and no remap. The pipelined tick driver uses
+        this to decide whether encode() may run before the deferred
+        add_task/restamp of the previous wave."""
+        infos = sorted(infos, key=lambda i: i.node.id)
+        if [i.node.id for i in infos] != self._ids:
+            return False
+        n = len(infos)
+        seq = np.fromiter((i.created_seq for i in infos), np.int64, n)
+        mut = np.fromiter((i.mutations for i in infos), np.int64, n)
+        return bool(np.array_equal(seq, self._fp_seq)
+                    and np.array_equal(mut, self._fp_mut))
+
+    def restamp_counts(self, p: EncodedProblem, counts: np.ndarray) -> bool:
+        """Fingerprint half of apply_counts: stamp the add_task mutation
+        bumps. Call exactly once per folded tick, after the add_task loop."""
+        if p.node_ids != self._ids:
+            return False
+        placed = counts.astype(np.int64).sum(axis=0)
+        if placed.any():
+            self._fp_mut += placed
+        return True
+
+    def fold_counts(self, p: EncodedProblem, counts: np.ndarray) -> bool:
+        """Array half of apply_counts: fold this tick's placements into the
+        cached node tables (totals, resources, service counts, ports)
+        WITHOUT touching fingerprints — see apply_counts docstring."""
         if p.node_ids != self._ids:
             return False
         counts64 = counts.astype(np.int64)
@@ -562,7 +603,6 @@ class IncrementalEncoder:
             return True
         G = counts.shape[0]
         self.total0 += placed.astype(np.int32)
-        self._fp_mut += placed
 
         raw_need = np.zeros((G, 2), np.int64)
         for gi, g in enumerate(p.groups):

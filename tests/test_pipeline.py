@@ -1,0 +1,191 @@
+"""TickPipeline (ops/pipeline.py): the deferred-commit reorder must keep
+placements bit-identical to the CPU oracle across multi-wave traces —
+including quantization-correction waves (odd reservations), external node
+mutations (serial fallback), and node churn (remap/full re-upload) — and
+the final device carry must equal the host fold.
+
+The property under test is the legality of the reorder itself: encode(k)
+runs BEFORE the add_task loop of wave k-1, so any dependence of encode on
+the deferred half of apply would show up as a parity break here."""
+import random
+
+import numpy as np
+import pytest
+
+from swarmkit_tpu.ops.pipeline import TickPipeline
+from swarmkit_tpu.ops.resident import ResidentPlacement
+from swarmkit_tpu.scheduler import batch
+from swarmkit_tpu.scheduler.encode import IncrementalEncoder
+
+from test_encoder_incremental import NOW, make_info, make_task, mutate
+from test_placement_parity import random_group
+from test_resident import expected_device_fold, odd_group
+
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnraisableExceptionWarning")
+
+
+def make_commit(infos_ref):
+    """The apply_counts contract half the pipeline defers: one add_task per
+    placement. A deferred commit can target a node that churn removed or
+    replaced after dispatch — the registry keeps dispatch-time objects
+    reachable, mirroring how the production scheduler's node_infos map
+    outlives the wave that placed onto it (removed rows are compacted by
+    the next encode, so the skipped restamp is harmless)."""
+    registry: dict[str, object] = {}
+
+    def commit(p, counts):
+        for i in infos_ref:
+            registry[i.node.id] = i
+        assignments = batch.materialize(p, counts)
+        task_by_id = {t.id: t for g in p.groups for t in g.tasks}
+        n_added = 0
+        for tid, nid in assignments.items():
+            if registry[nid].add_task(task_by_id[tid]):
+                n_added += 1
+        assert n_added == int(counts.sum())
+    return commit
+
+
+def make_waves(rng, step, group_maker, max_groups=4):
+    groups, seen = [], set()
+    for _ in range(rng.randint(1, max_groups)):
+        g = group_maker(rng, rng.randrange(8), rng.randint(1, 12))
+        if g.key not in seen:
+            seen.add(g.key)
+            for t in g.tasks:
+                t.id = f"s{step}-{t.id}"
+            g.tasks.sort(key=lambda t: t.id)
+            groups.append(g)
+    return groups
+
+
+def run_pipelined_trace(seed, steps=8, group_maker=random_group,
+                        churn=False):
+    rng = random.Random(seed)
+    infos = [make_info(rng, i) for i in range(14)]
+    next_node_id = 14
+    enc = IncrementalEncoder()
+    rp = ResidentPlacement(enc)
+    pipe = TickPipeline(enc, rp, make_commit(infos))
+
+    expected = {}                       # wave idx -> oracle counts
+    completed = []
+    for step in range(steps):
+        if churn and step and step % 3 == 0:
+            next_node_id = mutate(rng, infos, next_node_id, step)
+        groups = make_waves(rng, step, group_maker)
+        prev = pipe.tick(infos, groups, now=NOW)
+        # oracle runs on the emitted problem AFTER dispatch — the snapshot
+        # the device saw — while the previous wave's commit is deferred
+        p_cur = pipe._inflight[0]
+        expected[step] = batch.cpu_schedule_encoded(p_cur)
+        if prev is not None:
+            completed.append(prev)
+    last = pipe.flush()
+    assert last is not None
+    completed.append(last)
+
+    assert len(completed) == steps
+    for step, (p, counts) in enumerate(completed):
+        np.testing.assert_array_equal(
+            counts, expected[step],
+            err_msg=f"seed {seed} step {step} (pipelined vs oracle)")
+    return enc, rp, pipe, completed
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_pipelined_trace_parity(seed):
+    enc, rp, pipe, completed = run_pipelined_trace(seed)
+    # steady clean-node waves never take the serial fallback
+    assert not any(t["serial_fallback"] for t in pipe.timings)
+    # after flush: device carry equals the host fold of the final wave
+    p, counts = completed[-1]
+    st = rp.pull_state()
+    N = len(p.node_ids)
+    exp_total, exp_avail, exp_port = expected_device_fold(p, counts)
+    np.testing.assert_array_equal(st["total0"][:N], exp_total)
+    np.testing.assert_array_equal(
+        st["avail_res"][:N, :p.avail_res.shape[1]], exp_avail)
+    np.testing.assert_array_equal(
+        st["port_used"][:N, :p.port_used0.shape[1]], exp_port)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_pipelined_trace_parity_odd_reservations(seed):
+    """Quantized-vs-raw fold divergence: correction rows queued by
+    after_apply must reach the device as next-tick deltas exactly like the
+    serial path — bit-parity per wave proves they did."""
+    run_pipelined_trace(seed, group_maker=odd_group)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_pipelined_trace_with_node_churn_falls_back_serial(seed):
+    """External mutations between waves (node add/remove/update) flip
+    nodes_clean to False: the pipeline must commit the deferred wave
+    first, then encode — and parity must hold through the remap."""
+    enc, rp, pipe, _ = run_pipelined_trace(seed, churn=True)
+    assert any(t["serial_fallback"] for t in pipe.timings)
+
+
+def test_fingerprints_clean_after_each_wave():
+    """restamp_counts after the deferred add_task loop must leave zero
+    dirty rows: the steady pipeline ships no node data."""
+    rng = random.Random(99)
+    infos = [make_info(rng, i) for i in range(10)]
+    enc = IncrementalEncoder()
+    rp = ResidentPlacement(enc)
+    pipe = TickPipeline(enc, rp, make_commit(infos))
+    for step in range(5):
+        groups = make_waves(rng, step, random_group)
+        pipe.tick(infos, groups, now=NOW)
+        if step:
+            assert enc.last_dirty == 0, f"step {step} saw dirty rows"
+    pipe.flush()
+    assert enc.nodes_clean(infos)
+
+
+def test_nodes_clean_detects_mutation_and_churn():
+    rng = random.Random(5)
+    infos = [make_info(rng, i) for i in range(6)]
+    enc = IncrementalEncoder()
+    enc.encode(infos, [], now=NOW)
+    assert enc.nodes_clean(infos)
+    infos[2].add_task(make_task(rng, "svc-000", 1))
+    assert not enc.nodes_clean(infos)
+    enc.encode(infos, [], now=NOW)        # re-sync
+    assert enc.nodes_clean(infos)
+    assert not enc.nodes_clean(infos[:-1])          # removal
+    assert not enc.nodes_clean(infos + [make_info(rng, 77)])  # add
+
+
+def test_fold_restamp_split_equals_apply_counts():
+    """fold_counts + restamp_counts == apply_counts, in either interleaving
+    with the add_task loop."""
+    rng = random.Random(11)
+    infos_a = [make_info(rng, i) for i in range(8)]
+    rng2 = random.Random(11)
+    infos_b = [make_info(rng2, i) for i in range(8)]
+
+    def one_wave(enc, infos, split):
+        groups = make_waves(random.Random(42), 0, random_group)
+        p = enc.encode(infos, groups, now=NOW)
+        counts = batch.cpu_schedule_encoded(p)
+        commit = make_commit(infos)
+        if split:
+            assert enc.fold_counts(p, counts)
+            commit(p, counts)
+            assert enc.restamp_counts(p, counts)
+        else:
+            commit(p, counts)
+            assert enc.apply_counts(p, counts)
+        return p, counts
+
+    enc_a, enc_b = IncrementalEncoder(), IncrementalEncoder()
+    one_wave(enc_a, infos_a, split=True)
+    one_wave(enc_b, infos_b, split=False)
+    np.testing.assert_array_equal(enc_a.avail_res, enc_b.avail_res)
+    np.testing.assert_array_equal(enc_a.total0, enc_b.total0)
+    np.testing.assert_array_equal(enc_a._fp_mut, enc_b._fp_mut)
+    np.testing.assert_array_equal(enc_a._svc_mat, enc_b._svc_mat)
+    assert enc_a.nodes_clean(infos_a) and enc_b.nodes_clean(infos_b)
