@@ -42,6 +42,30 @@ class Channel:
             self._events.append(event)
             self._cond.notify_all()
 
+    def _offer_many(self, events: list) -> None:
+        """Batched fan-out: one matcher pass, ONE lock acquisition and ONE
+        notify for the whole batch (the store publishes each commit's
+        events as a batch, so this is the per-commit delivery path).
+        Observable behavior matches per-event _offer calls, including the
+        slow-subscriber close after exactly `limit` queued events."""
+        m = self._matcher
+        if m is not None:
+            events = [e for e in events if m(e)]
+            if not events:
+                return
+        with self._cond:
+            if self._closed:
+                return
+            if self._limit is not None:
+                room = self._limit - len(self._events)
+                if len(events) > room:
+                    self._events.extend(events[:room])
+                    self._closed = True
+                    self._cond.notify_all()
+                    return
+            self._events.extend(events)
+            self._cond.notify_all()
+
     def get(self, timeout: float | None = None) -> Any:
         with self._cond:
             if not self._cond.wait_for(lambda: self._events or self._closed, timeout):
@@ -92,10 +116,16 @@ class Channel:
 
 
 class WatchQueue:
-    """Fan-out publisher (reference: watch/watch.go Queue)."""
+    """Fan-out publisher (reference: watch/watch.go Queue).
+
+    The subscriber list is copy-on-write (a tuple swapped under `_lock`):
+    `publish`/`publish_all` read one immutable snapshot with NO lock or
+    copy on the hot path — at 10k subscribers the old list-copy-per-event
+    dominated publish cost (round-2 bench: 1.4M deliveries/s; the
+    reference benches this exact fan-out, watch/watch_test.go:153-216)."""
 
     def __init__(self, default_limit: int | None = 10000):
-        self._subs: list[Channel] = []
+        self._subs: tuple[Channel, ...] = ()
         self._lock = threading.Lock()
         self._default_limit = default_limit
         self._closed = False
@@ -108,7 +138,7 @@ class WatchQueue:
             if self._closed:
                 ch.close()
             else:
-                self._subs.append(ch)
+                self._subs = self._subs + (ch,)
         return ch
 
     def callback_watch(self, cb: Callable[[Any], None], matcher: Matcher | None = None):
@@ -120,34 +150,39 @@ class WatchQueue:
                     return
                 cb(event)
 
+            def _offer_many(self, events):
+                for event in events:
+                    self._offer(event)
+
         ch = _CallbackChannel(None, None)
         with self._lock:
-            self._subs.append(ch)
+            self._subs = self._subs + (ch,)
         return ch
 
     def publish(self, event: Any) -> None:
-        with self._lock:
-            subs = list(self._subs)
-        for ch in subs:
+        for ch in self._subs:
             ch._offer(event)
 
     def publish_all(self, events: Iterable[Any]) -> None:
-        for e in events:
-            self.publish(e)
+        """Batched publish — what the store uses per commit: each
+        subscriber pays one lock/notify per BATCH, not per event."""
+        events = events if isinstance(events, list) else list(events)
+        if not events:
+            return
+        for ch in self._subs:
+            ch._offer_many(events)
 
     def stop_watch(self, ch: Channel) -> None:
         ch.close()
         with self._lock:
-            try:
-                self._subs.remove(ch)
-            except ValueError:
-                pass
+            if ch in self._subs:
+                self._subs = tuple(c for c in self._subs if c is not ch)
 
     def close(self) -> None:
         with self._lock:
             self._closed = True
-            subs = list(self._subs)
-            self._subs.clear()
+            subs = self._subs
+            self._subs = ()
         for ch in subs:
             ch.close()
 
