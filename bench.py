@@ -38,7 +38,10 @@ N_TASKS = 100_000
 N_SERVICES = 20          # groups; 100k tasks across 20 services
 
 
-def _mk_nodes(rng, n_nodes):
+def _mk_nodes(rng, n_nodes, plugin_every=None):
+    """plugin_every=k: every k-th node advertises the Volume/benchfs plugin
+    (the reference's plugin-constrained grid runs with 1-in-3 eligible,
+    scheduler_test.go:3210-3226)."""
     sys.path.insert(0, "tests")
     from test_placement_parity import random_node
     from swarmkit_tpu.api.types import NodeAvailability, NodeStatusState
@@ -49,14 +52,17 @@ def _mk_nodes(rng, n_nodes):
         node = random_node(rng, i)
         node.status.state = NodeStatusState.READY
         node.spec.availability = NodeAvailability.ACTIVE
+        if plugin_every is not None and i % plugin_every == 0:
+            node.description.plugins = list(node.description.plugins) + [
+                ("Volume", "benchfs")]
         infos.append(NodeInfo.new(node, {}, node.description.resources.copy()))
     return infos
 
 
 def _mk_groups(rng, n_tasks, n_services, wave=0, constraint_heavy=False,
-               binpack=False):
+               binpack=False, plugin_volume=False):
     from swarmkit_tpu.api.objects import Task
-    from swarmkit_tpu.api.specs import Placement
+    from swarmkit_tpu.api.specs import Placement, VolumeMount
     from swarmkit_tpu.api.types import TaskState
     from swarmkit_tpu.scheduler.encode import CPU_QUANTUM, MEM_QUANTUM, TaskGroup
 
@@ -89,6 +95,12 @@ def _mk_groups(rng, n_tasks, n_services, wave=0, constraint_heavy=False,
                         f"node.labels.disk != hdd",
                         "node.platform.os == linux",
                     ])
+                elif plugin_volume:
+                    # "driver/source" mount convention → Volume/benchfs
+                    # required on the node (PluginFilter.set_task)
+                    from swarmkit_tpu.api.specs import ContainerSpec
+                    spec.runtime = ContainerSpec(mounts=[
+                        VolumeMount(source="benchfs/data", target="/data")])
                 elif gi % 3 == 0:
                     spec.placement = Placement(
                         constraints=[f"node.labels.zone == {'ab'[gi % 2]}"])
@@ -185,7 +197,7 @@ def _probe_resident_kernel(p, placement_ops, runs=5):
 
 
 def bench_scheduler_config(np, placement_ops, batch, n_nodes, n_tasks,
-                           n_services, waves=4, **kw):
+                           n_services, waves=4, plugin_every=None, **kw):
     """Cold tick (fresh encoder + full device upload), then `waves` steady
     ticks through the TickPipeline (ops/pipeline.py): wave k's counts D2H
     rides the tunnel in the background while the host commits wave k-1
@@ -205,7 +217,7 @@ def bench_scheduler_config(np, placement_ops, batch, n_nodes, n_tasks,
     from swarmkit_tpu.scheduler.encode import IncrementalEncoder
 
     rng = random.Random(7)
-    infos = _mk_nodes(rng, n_nodes)
+    infos = _mk_nodes(rng, n_nodes, plugin_every=plugin_every)
 
     # compile warm-up on a throwaway encoder/state (cache is process-wide)
     enc_w = IncrementalEncoder()
@@ -561,6 +573,21 @@ def bench_host_micro(np):
         "find_by_name_per_s": round((N // 10) / find_s),
     }
 
+    # bulk-create at the reference grid's 100k-node scale (the round-2
+    # O(n²)→O(1) name-uniqueness fix is what makes this row feasible)
+    store_big = MemoryStore()
+    big = [Node(id=f"bench-bignode-{i:06d}") for i in range(100_000)]
+    for n in big:
+        n.spec.annotations.name = n.id
+    t0 = time.perf_counter()
+
+    def create_big(tx):
+        for n in big:
+            tx.create(n)
+    store_big.update(create_big)
+    out["store_ops_100k"] = {
+        "create_per_s": round(len(big) / (time.perf_counter() - t0))}
+
     # ---- watch queue: 10k subscribers, 4 publishers ---------------------
     # two regimes: per-event publish (the reference bench's shape,
     # watch_test.go:153-216) and batched publish_all — the store's actual
@@ -654,6 +681,28 @@ def main():
             np, placement_ops, batch, 1_000, 100_000, 20),
         "grid_1m_x_10k": bench_scheduler_config(
             np, placement_ops, batch, 10_000, 1_000_000, 100),
+        # the reference grid's 100k-NODE half (scheduler_test.go:3187-3209):
+        # 100k nodes x 1k / 100k / 1M tasks
+        "grid_1k_x_100k": bench_scheduler_config(
+            np, placement_ops, batch, 100_000, 1_000, 20),
+        "grid_100k_x_100k": bench_scheduler_config(
+            np, placement_ops, batch, 100_000, 100_000, 20),
+        "grid_1m_x_100k": bench_scheduler_config(
+            np, placement_ops, batch, 100_000, 1_000_000, 100, waves=3),
+        # the plugin-constrained grid (scheduler_test.go:3210-3226):
+        # 1-in-3 nodes carry the required volume plugin
+        "plugin_1k_x_1k": bench_scheduler_config(
+            np, placement_ops, batch, 1_000, 1_000, 20,
+            plugin_every=3, plugin_volume=True),
+        "plugin_10k_x_1k": bench_scheduler_config(
+            np, placement_ops, batch, 1_000, 10_000, 20,
+            plugin_every=3, plugin_volume=True),
+        "plugin_100k_x_1k": bench_scheduler_config(
+            np, placement_ops, batch, 1_000, 100_000, 20,
+            plugin_every=3, plugin_volume=True),
+        "plugin_100k_x_5k": bench_scheduler_config(
+            np, placement_ops, batch, 5_000, 100_000, 20,
+            plugin_every=3, plugin_volume=True),
         "global_diff_50svc_x_10k": bench_global_diff(np),
         "raft_replay_1m_x_5": bench_raft_replay(np),
         "host_micro": bench_host_micro(np),

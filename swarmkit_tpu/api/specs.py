@@ -26,6 +26,10 @@ from .types import (
 class Annotations:
     name: str = ""
     labels: dict[str, str] = field(default_factory=dict)
+    # custom indexes (reference api/types.proto Annotations.indices,
+    # IndexEntry key/val): application-defined secondary keys that watch
+    # selectors and custom find-by queries match on
+    indices: dict[str, str] = field(default_factory=dict)
 
 
 @dataclass

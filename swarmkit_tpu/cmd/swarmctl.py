@@ -633,6 +633,94 @@ def cmd_logs(args):
         client.close()
 
 
+# --------------------------------------------------------------------- watch
+
+def cmd_watch(args):
+    """Stream matching store events over the Watch API (server-side
+    selectors — watchapi.WatchSelector; reference swarmctl has no watch
+    command, but the API it drives is manager/watchapi/watch.go)."""
+    from ..api.types import NodeRole, TaskState
+    from ..rpc.client import RPCClient
+    from ..store.watch import ChannelClosed
+    from ..watchapi.watch import WatchSelector
+
+    def parse_kv(items):
+        out = {}
+        for it in items or []:
+            k, _, v = it.partition("=")
+            out[k] = v
+        return out
+
+    sel = WatchSelector(
+        kind=args.kind or "",
+        id=args.id or "",
+        id_prefix=args.id_prefix or "",
+        name=args.name or "",
+        name_prefix=args.name_prefix or "",
+        labels=parse_kv(args.label),
+        custom=parse_kv(args.custom),
+    )
+    if args.service:
+        ctl = _control(args)
+        sel.kind = sel.kind or "task"
+        sel.service_id = _find_service(ctl, args.service).id
+    if args.node:
+        sel.kind = sel.kind or "task"
+        sel.node_id = args.node
+    if args.slot is not None:
+        sel.kind = sel.kind or "task"
+        sel.slot = args.slot
+    if args.desired_state:
+        sel.kind = sel.kind or "task"
+        try:
+            sel.desired_state = TaskState[args.desired_state.upper()]
+        except KeyError:
+            _die(f"unknown task state {args.desired_state!r} (one of: "
+                 + ", ".join(s.name.lower() for s in TaskState) + ")")
+    if args.role:
+        sel.kind = sel.kind or "node"
+        try:
+            sel.role = NodeRole[args.role.upper()]
+        except KeyError:
+            _die(f"unknown node role {args.role!r} (worker or manager)")
+    try:
+        sel.validate()                      # fail here, not as a bare
+    except ValueError as exc:               # server-side stream close
+        _die(str(exc))
+
+    if getattr(args, "socket", None):
+        client = RPCClient(f"unix://{args.socket}")
+    else:
+        client = RPCClient(args.addr, security=_load_identity(args.identity))
+    ch = client.stream("watch.events", selectors=[sel],
+                       since_version=args.resume_from)
+    try:
+        while True:
+            try:
+                ev = ch.get(timeout=1.0)
+            except TimeoutError:
+                continue
+            except ChannelClosed as exc:
+                if getattr(exc, "error", None) is not None:
+                    _die(f"watch failed: {exc.error}")
+                break
+            obj = getattr(ev, "obj", None)
+            if obj is None:
+                continue
+            action = type(ev).__name__.removeprefix("Event").lower()
+            extra = ""
+            if obj.TABLE == "task":
+                extra = (f" service={obj.service_id} slot={obj.slot}"
+                         f" node={obj.node_id or '-'}"
+                         f" state={_state_name(obj.status.state)}")
+            print(f"{action} {obj.TABLE} {_short(obj.id)}{extra}",
+                  flush=True)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        client.close()
+
+
 # --------------------------------------------------------------------- main
 
 def main(argv=None) -> int:
@@ -703,6 +791,30 @@ def main(argv=None) -> int:
     p = task.add_parser("inspect")
     p.add_argument("task")
     p.set_defaults(func=cmd_task_inspect)
+
+    # watch
+    p = sub.add_parser("watch")
+    p.add_argument("--kind", default=None,
+                   help="object kind (task/node/service/…); inferred from "
+                        "kind-specific flags when omitted")
+    p.add_argument("--id", default=None)
+    p.add_argument("--id-prefix", default=None)
+    p.add_argument("--name", default=None)
+    p.add_argument("--name-prefix", default=None)
+    p.add_argument("--label", action="append", metavar="K=V")
+    p.add_argument("--custom", action="append", metavar="K=V",
+                   help="custom index (Annotations.indices) equality")
+    p.add_argument("--service", default=None,
+                   help="tasks of this service (name or id)")
+    p.add_argument("--node", default=None, help="tasks on this node id")
+    p.add_argument("--slot", type=int, default=None)
+    p.add_argument("--desired-state", default=None,
+                   help="task desired state name, e.g. running")
+    p.add_argument("--role", default=None,
+                   help="node role name (worker/manager)")
+    p.add_argument("--resume-from", type=int, default=None,
+                   help="replay committed changes after this store version")
+    p.set_defaults(func=cmd_watch)
 
     # node
     node = sub.add_parser("node").add_subparsers(dest="sub", required=True)

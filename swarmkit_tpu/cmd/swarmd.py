@@ -64,6 +64,17 @@ def main(argv=None) -> int:
     ap.add_argument("--heartbeat-period", type=float, default=5.0)
     ap.add_argument("--tick-interval", type=float, default=0.1,
                     help="raft logical-clock tick (election ~10-20 ticks)")
+    ap.add_argument("--scheduler-backend", choices=["auto", "cpu", "jax"],
+                    default="auto",
+                    help="placement backend: auto picks per tick by "
+                         "task-times-node product against --jax-threshold; "
+                         "cpu/jax pin the path (SURVEY §7)")
+    ap.add_argument("--jax-threshold", type=int, default=None,
+                    metavar="PRODUCT",
+                    help="task*node product above which auto uses the "
+                         "accelerator (default 200000; tune ~100x lower "
+                         "for PCIe/on-host devices than for a tunneled "
+                         "dev link — see BASELINE.md)")
     ap.add_argument("--force-new-cluster", action="store_true",
                     help="disaster recovery: restart as a single-member "
                          "quorum keeping replicated state")
@@ -164,6 +175,8 @@ def main(argv=None) -> int:
         kek=args.unlock_key.encode() if args.unlock_key else None,
         fips=args.fips,
         csi_plugins=csi_plugins,
+        scheduler_backend=args.scheduler_backend,
+        jax_threshold=args.jax_threshold,
     )
     try:
         node.start()

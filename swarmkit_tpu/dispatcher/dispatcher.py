@@ -558,7 +558,20 @@ class Dispatcher:
 
     # ---------------------------------------------------------- event plane
     def _run(self):
-        _, ch = self.store.view_and_watch(lambda tx: None, limit=None)
+        # server-side kind filtering (what watchapi selectors do for
+        # remote clients, objects.proto watch_selectors): the event loop
+        # consumes these kinds only — service/network churn never reaches
+        # it. The matcher runs in the committing writer's publish path,
+        # so it is a bare table-name set test, not selector machinery.
+        kinds = frozenset(
+            ("task", "secret", "config", "volume", "cluster", "node"))
+
+        def matcher(ev, _kinds=kinds):
+            obj = getattr(ev, "obj", None)
+            return obj is not None and obj.TABLE in _kinds
+
+        _, ch = self.store.view_and_watch(
+            lambda tx: None, matcher=matcher, limit=None)
         last_flush = time.monotonic()
         try:
             while not self._stop.is_set():

@@ -62,6 +62,8 @@ class Manager:
         cert_expiry: float | None = None,
         autolock_key: bytes | None = None,
         fips: bool = False,
+        scheduler_backend: str = "auto",
+        jax_threshold: int | None = None,
     ):
         self.store = store if store is not None else MemoryStore()
         self.security = security
@@ -74,6 +76,8 @@ class Manager:
             cluster_id = ("FIPS." if fips else "") + new_id()
         self.cluster_id = cluster_id
         self.org = org
+        self.scheduler_backend = scheduler_backend
+        self.jax_threshold = jax_threshold
         self._lock = threading.Lock()
         self._is_leader = False
         self._started = False
@@ -267,7 +271,8 @@ class Manager:
             self.log_broker,
             Allocator(self.store),
             Deallocator(self.store),
-            Scheduler(self.store),
+            Scheduler(self.store, backend=self.scheduler_backend,
+                      jax_threshold=self.jax_threshold),
             ReplicatedOrchestrator(self.store),
             GlobalOrchestrator(self.store),
             JobsOrchestrator(self.store),

@@ -210,6 +210,8 @@ class SwarmNode:
         autolock: bool = False,
         fips: bool = False,
         csi_plugins=None,  # csi.plugin.PluginGetter (e.g. RemoteCSIPlugin)
+        scheduler_backend: str = "auto",
+        jax_threshold: int | None = None,
     ):
         self.state_dir = state_dir
         self.executor = executor
@@ -233,6 +235,8 @@ class SwarmNode:
         self.autolock = autolock
         self.fips = fips
         self.csi_plugins = csi_plugins
+        self.scheduler_backend = scheduler_backend
+        self.jax_threshold = jax_threshold
         self._control_server: RPCServer | None = None
 
         self.security: SecurityConfig | None = None
@@ -642,6 +646,8 @@ class SwarmNode:
             autolock_key=self.kek if self.autolock else None,
             fips=self.fips,
             csi_plugins=self.csi_plugins,
+            scheduler_backend=self.scheduler_backend,
+            jax_threshold=self.jax_threshold,
         )
         build_manager_registry(self.manager, raft,
                                LeaderConns(raft, self.security),

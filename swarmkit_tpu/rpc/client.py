@@ -225,7 +225,7 @@ class RPCClient:
                         pending.error = exc
                         pending.event.set()
                     if stream is not None:
-                        stream.close()
+                        stream.close(error=exc)
                 elif ftype == STREAM_ITEM:
                     with self._lock:
                         stream = self._streams.get(sid)

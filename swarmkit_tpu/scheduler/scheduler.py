@@ -50,9 +50,18 @@ JAX_THRESHOLD = 200_000  # task×node product above which the TPU kernel wins
 
 
 class Scheduler:
-    def __init__(self, store: MemoryStore, backend: str = "auto"):
+    def __init__(self, store: MemoryStore, backend: str = "auto",
+                 jax_threshold: int | None = None):
+        """backend: "auto" picks per tick by task×node product against
+        `jax_threshold` (default JAX_THRESHOLD); "cpu"/"jax" pin the path.
+        The right threshold is deployment-specific — a PCIe-attached or
+        on-host accelerator amortizes ~100× sooner than the dev tunnel
+        (BASELINE.md, operator guidance) — so swarmd exposes both knobs
+        (--scheduler-backend / --jax-threshold, SURVEY §7)."""
         self.store = store
         self.backend = backend
+        self.jax_threshold = (JAX_THRESHOLD if jax_threshold is None
+                              else jax_threshold)
         self.node_infos: dict[str, NodeInfo] = {}
         self.unassigned: dict[str, Task] = {}
         self.preassigned: dict[str, Task] = {}
@@ -269,7 +278,8 @@ class Scheduler:
         total_tasks = int(problem.n_tasks.sum())
         use_jax = (self.backend == "jax"
                    or (self.backend == "auto"
-                       and total_tasks * max(n_nodes, 1) >= JAX_THRESHOLD))
+                       and total_tasks * max(n_nodes, 1)
+                       >= self.jax_threshold))
         if use_jax:
             if self._resident is None:
                 from ..ops.resident import ResidentPlacement

@@ -27,6 +27,12 @@ class Channel:
         self._events: deque[Any] = deque()
         self._cond = threading.Condition()
         self._closed = False
+        self._error: Exception | None = None
+
+    def _raise_closed(self):
+        exc = ChannelClosed(str(self._error) if self._error else "")
+        exc.error = self._error   # cause, when the closer supplied one
+        raise exc
 
     def _offer(self, event: Any) -> None:
         if self._matcher is not None and not self._matcher(event):
@@ -72,14 +78,14 @@ class Channel:
                 raise TimeoutError("no event within timeout")
             if self._events:
                 return self._events.popleft()
-            raise ChannelClosed()
+            self._raise_closed()
 
     def try_get(self) -> Any | None:
         with self._cond:
             if self._events:
                 return self._events.popleft()
             if self._closed:
-                raise ChannelClosed()
+                self._raise_closed()
             return None
 
     def drain(self) -> list[Any]:
@@ -95,11 +101,15 @@ class Channel:
             if self._events:
                 return True
             if self._closed:
-                raise ChannelClosed()
+                self._raise_closed()
             return False
 
-    def close(self) -> None:
+    def close(self, error: Exception | None = None) -> None:
+        """Close the stream; `error` (e.g. a server ERR on an RPC stream)
+        is carried to consumers on the ChannelClosed they receive."""
         with self._cond:
+            if error is not None and self._error is None:
+                self._error = error
             self._closed = True
             self._cond.notify_all()
 

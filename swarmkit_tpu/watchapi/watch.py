@@ -27,7 +27,13 @@ ACTION_ALL = ACTION_CREATE | ACTION_UPDATE | ACTION_DELETE
 
 @dataclass
 class WatchSelector:
-    """One watch entry (reference: api/watch.proto WatchRequest.WatchEntry)."""
+    """One watch entry (reference: api/watch.proto WatchRequest.WatchEntry,
+    field menu per object from api/objects.proto watch_selectors — e.g.
+    Task exposes service_id/node_id/slot/desired_state, Node exposes
+    role/membership, and every annotated object exposes custom-index
+    selectors). Kind-specific fields require `kind` to be set to the one
+    object kind that supports them (validated by WatchAPI.watch, mirroring
+    api/watch.go ConvertWatchArgs rejecting unsupported checks)."""
 
     kind: str = ""  # store table name, e.g. "task"; "" = all kinds
     action: int = ACTION_ALL
@@ -36,6 +42,31 @@ class WatchSelector:
     name: str = ""
     name_prefix: str = ""
     labels: dict[str, str] = field(default_factory=dict)
+    # custom indexes (Annotations.indices); val "" = key presence only
+    custom: dict[str, str] = field(default_factory=dict)
+    custom_prefix: dict[str, str] = field(default_factory=dict)
+    # kind="task" only
+    service_id: str = ""
+    node_id: str = ""
+    slot: int | None = None
+    desired_state: int | None = None
+    # kind="node" only
+    role: int | None = None
+    membership: int | None = None
+
+    # fields legal only for one kind (objects.proto watch_selectors)
+    KIND_FIELDS = {
+        "service_id": "task", "node_id": "task", "slot": "task",
+        "desired_state": "task", "role": "node", "membership": "node",
+    }
+
+    def validate(self) -> None:
+        for fname, kind in self.KIND_FIELDS.items():
+            v = getattr(self, fname)
+            if (v is not None and v != "") and self.kind != kind:
+                raise ValueError(
+                    f"selector field {fname!r} requires kind={kind!r}"
+                    f" (got kind={self.kind!r})")
 
     def matches(self, event) -> bool:
         obj = getattr(event, "obj", None)
@@ -58,7 +89,22 @@ class WatchSelector:
             return False
         if self.id_prefix and not obj.id.startswith(self.id_prefix):
             return False
-        if self.name or self.name_prefix or self.labels:
+        if self.service_id and obj.service_id != self.service_id:
+            return False
+        if self.node_id and obj.node_id != self.node_id:
+            return False
+        if self.slot is not None and obj.slot != self.slot:
+            return False
+        if self.desired_state is not None \
+                and obj.desired_state != self.desired_state:
+            return False
+        if self.role is not None and obj.spec.desired_role != self.role:
+            return False
+        if self.membership is not None \
+                and obj.spec.membership != self.membership:
+            return False
+        if self.name or self.name_prefix or self.labels or self.custom \
+                or self.custom_prefix:
             ann = getattr(getattr(obj, "spec", obj), "annotations", None)
             if ann is None:
                 ann = getattr(obj, "annotations", None)
@@ -72,6 +118,15 @@ class WatchSelector:
                 if k not in ann.labels:
                     return False
                 if v and ann.labels[k] != v:
+                    return False
+            indices = getattr(ann, "indices", None) or {}
+            for k, v in self.custom.items():
+                if k not in indices:
+                    return False
+                if v and indices[k] != v:
+                    return False
+            for k, v in self.custom_prefix.items():
+                if k not in indices or not indices[k].startswith(v):
                     return False
         return True
 
@@ -91,6 +146,7 @@ class WatchAPI:
         for sel in selectors:
             if sel.kind and sel.kind not in ALL_TABLES:
                 raise ValueError(f"unknown object kind {sel.kind!r}")
+            sel.validate()
 
         def matcher(event) -> bool:
             return any(sel.matches(event) for sel in selectors)

@@ -220,3 +220,47 @@ def test_volume_commands_via_cli(daemon):
     # no plugin to finish the teardown: the volume shows as removing
     # (it still reserves its name, so hiding it would be misleading)
     assert "<removing>" in _ctl(addr, ident, "volume", "ls")
+
+
+def test_scheduler_backend_flags(tmp_path):
+    """--scheduler-backend jax --jax-threshold 1 must flow swarmd →
+    SwarmNode → Manager → Scheduler: with the product threshold at 1 the
+    daemon's scheduler takes the accelerator path even for a toy service,
+    and tasks still reach running (SURVEY §7 --scheduler-backend)."""
+    state = str(tmp_path / "m1")
+    logf = open(tmp_path / "m1.out", "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "swarmkit_tpu.cmd.swarmd",
+         "--state-dir", state, "--listen-addr", "127.0.0.1:0",
+         "--heartbeat-period", "0.5", "--tick-interval", "0.05",
+         "--executor", "fake",
+         "--scheduler-backend", "jax", "--jax-threshold", "1"],
+        stdout=logf, stderr=subprocess.STDOUT, env=_env(), cwd=REPO)
+    try:
+        addr = None
+        end = time.monotonic() + 90
+        while time.monotonic() < end:
+            log = open(tmp_path / "m1.out").read()
+            m = re.search(r"SWARM_NODE_READY addr=(\S+)", log)
+            if m:
+                addr = m.group(1)
+                break
+            assert proc.poll() is None, log
+            time.sleep(0.2)
+        assert addr, "daemon never became ready"
+        _ctl(addr, state, "service", "create", "--name", "tiny",
+             "--command", "sleep 600", "--replicas", "2")
+        end = time.monotonic() + 60   # first jax compile happens in-daemon
+        while time.monotonic() < end:
+            if "2/2" in _ctl(addr, state, "service", "ls"):
+                break
+            time.sleep(0.5)
+        assert "2/2" in _ctl(addr, state, "service", "ls")
+        log = open(tmp_path / "m1.out").read()
+        assert "Traceback" not in log
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()

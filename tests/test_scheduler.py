@@ -266,3 +266,51 @@ def test_spread_preferences_respected(store):
         assert per_dc == {"a": 4, "b": 4}, per_dc
     finally:
         s.stop()
+
+
+def test_backend_and_threshold_knobs():
+    """Scheduler backend/threshold knobs (SURVEY §7 --scheduler-backend):
+    cpu pins the oracle (no resident state ever), a tiny jax_threshold
+    flips auto to the accelerator path at toy scale."""
+    from swarmkit_tpu.scheduler.scheduler import JAX_THRESHOLD
+
+    store = MemoryStore()
+    s = Scheduler(store)
+    assert s.backend == "auto" and s.jax_threshold == JAX_THRESHOLD
+    assert Scheduler(store, jax_threshold=7).jax_threshold == 7
+
+    def seed(tx):
+        for i in range(4):
+            n = Node(id=f"bk{i:02d}")
+            n.status.state = NodeStatusState.READY
+            n.spec.availability = NodeAvailability.ACTIVE
+            tx.create(n)
+        for w in range(6):
+            t = Task(id=f"bk-t{w:02d}", service_id="bk-svc", slot=w + 1)
+            t.desired_state = TaskState.RUNNING
+            t.status.state = TaskState.PENDING
+            tx.create(t)
+
+    def run_one(backend, jax_threshold):
+        st = MemoryStore()
+        st.update(seed)
+        sched = Scheduler(st, backend=backend, jax_threshold=jax_threshold)
+        sched.start()
+        try:
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                tasks = st.view(lambda tx: tx.find_tasks())
+                if all(t.status.state == TaskState.ASSIGNED and t.node_id
+                       for t in tasks):
+                    break
+                time.sleep(0.05)
+            tasks = st.view(lambda tx: tx.find_tasks())
+            assert all(t.status.state == TaskState.ASSIGNED for t in tasks)
+            return sched._resident
+        finally:
+            sched.stop()
+
+    # auto + tiny threshold → the accelerator path engages at 6x4
+    assert run_one("auto", 1) is not None
+    # pinned cpu ignores the threshold entirely
+    assert run_one("cpu", 0) is None
