@@ -50,16 +50,29 @@ class KeyReadWriter:
             "headers": headers,
             "key": base64.b64encode(blob).decode(),
         }
-        tmp = self.path + ".tmp"
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        # unique temp name: the instance lock cannot serialize two WRITERS
+        # holding separate KeyReadWriter objects for the same path (cert
+        # renewal vs root-rotation update both re-save the identity); with
+        # a shared ".tmp" name one replace steals the other's temp file →
+        # FileNotFoundError mid-rotation. Unique temps make each replace
+        # atomic and self-contained; last writer wins, both files valid.
         # 0600 from birth: the key must never be world-readable, even in the
         # temp window (ioutils AtomicWriteFile + keyreadwriter.go perms)
+        tmp = f"{self.path}.{os.getpid()}.{threading.get_ident()}.tmp"
         fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
-        with os.fdopen(fd, "w") as f:
-            json.dump(rec, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)  # atomic (ioutils/ioutils.go AtomicWriteFile)
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(rec, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)  # atomic (ioutils AtomicWriteFile)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
 
     def read(self) -> tuple[bytes, dict[str, str]]:
         with self._lock:

@@ -178,6 +178,16 @@ class CAServer:
                 )
                 tx.create(node)
             else:
+                if (role is not None and node.certificate is not None
+                        and node.certificate.csr_pem == csr_pem):
+                    # idempotent join retry (ca/server.go:236-247 issuance
+                    # re-entrancy): the cert was requested — possibly even
+                    # issued — but the joiner's status poll timed out on a
+                    # loaded machine and it re-submits the SAME CSR with a
+                    # valid token. Re-processing is a no-op for security
+                    # (same public key, token re-verified by the caller),
+                    # and denying it wedges the join forever.
+                    return node_id
                 if caller is None or (
                     caller.node_id != node_id and caller.role != NodeRole.MANAGER
                 ):

@@ -115,9 +115,12 @@ class Dispatcher:
                  heartbeat_period: float = DEFAULT_HEARTBEAT_PERIOD,
                  node_down_period: float = DEFAULT_NODE_DOWN_PERIOD,
                  rate_limit_period: float = RATE_LIMIT_PERIOD,
-                 secret_drivers=None):
+                 secret_drivers=None, clock=None):
+        from ..utils.clock import REAL_CLOCK
+
         self.store = store
         self.secret_drivers = secret_drivers  # DriverRegistry | None
+        self.clock = clock or REAL_CLOCK
         self.heartbeat_period = heartbeat_period
         self.node_down_period = node_down_period
         self.rate_limit_period = rate_limit_period
@@ -222,7 +225,8 @@ class Dispatcher:
                 if node_id in self._sessions:
                     continue  # registered while the proposal committed
                 timer = Heartbeat(
-                    grace, lambda nid=node_id: self._unknown_expired(nid))
+                    grace, lambda nid=node_id: self._unknown_expired(nid),
+                    clock=self.clock)
                 self._unknown_timers[node_id] = timer
                 timer.start()
 
@@ -313,7 +317,8 @@ class Dispatcher:
 
         session_id = new_id()
         hb = Heartbeat(self.heartbeat_period * GRACE_MULTIPLIER,
-                       lambda: self._node_down(node_id, session_id))
+                       lambda: self._node_down(node_id, session_id),
+                       clock=self.clock)
         session = Session(
             node_id=node_id,
             session_id=session_id,
@@ -515,7 +520,8 @@ class Dispatcher:
             if node_id in self._orphan_timers or self._stop.is_set():
                 return
             timer = Heartbeat(self.node_down_period,
-                              lambda: self._orphan_expired(node_id))
+                              lambda: self._orphan_expired(node_id),
+                              clock=self.clock)
             self._orphan_timers[node_id] = timer
         timer.start()
 

@@ -1,17 +1,25 @@
-"""Per-node heartbeat timer (reference: manager/dispatcher/heartbeat/heartbeat.go)."""
+"""Per-node heartbeat timer (reference: manager/dispatcher/heartbeat/heartbeat.go).
+
+Timers come from an injectable Clock (utils/clock.py) so the expiry logic
+is deterministic under FakeClock in tests, mirroring the reference's
+ClockSource seam."""
 from __future__ import annotations
 
 import threading
 from typing import Callable
 
+from ..utils.clock import REAL_CLOCK
+
 
 class Heartbeat:
     """Fires `on_expire` once if `beat()` isn't called within `timeout`."""
 
-    def __init__(self, timeout: float, on_expire: Callable[[], None]):
+    def __init__(self, timeout: float, on_expire: Callable[[], None],
+                 clock=None):
         self.timeout = timeout
         self.on_expire = on_expire
-        self._timer: threading.Timer | None = None
+        self.clock = clock or REAL_CLOCK
+        self._timer = None
         self._lock = threading.Lock()
         self._stopped = False
 
@@ -26,9 +34,7 @@ class Heartbeat:
                 return
             if self._timer is not None:
                 self._timer.cancel()
-            self._timer = threading.Timer(self.timeout, self._expire)
-            self._timer.daemon = True
-            self._timer.start()
+            self._timer = self.clock.timer(self.timeout, self._expire)
 
     def _expire(self):
         with self._lock:

@@ -64,6 +64,8 @@ class Manager:
         fips: bool = False,
         scheduler_backend: str = "auto",
         jax_threshold: int | None = None,
+        scheduler_pipeline: bool = False,
+        clock=None,
     ):
         self.store = store if store is not None else MemoryStore()
         self.security = security
@@ -78,6 +80,7 @@ class Manager:
         self.org = org
         self.scheduler_backend = scheduler_backend
         self.jax_threshold = jax_threshold
+        self.scheduler_pipeline = scheduler_pipeline
         self._lock = threading.Lock()
         self._is_leader = False
         self._started = False
@@ -93,7 +96,8 @@ class Manager:
         self.heartbeat_period = heartbeat_period
         self.dispatcher = Dispatcher(self.store,
                                      heartbeat_period=heartbeat_period,
-                                     secret_drivers=secret_drivers)
+                                     secret_drivers=secret_drivers,
+                                     clock=clock)
         self.log_broker = LogBroker(self.store)
         self.resource_api = ResourceAllocator(self.store)
         self.health = HealthServer()
@@ -272,7 +276,8 @@ class Manager:
             Allocator(self.store),
             Deallocator(self.store),
             Scheduler(self.store, backend=self.scheduler_backend,
-                      jax_threshold=self.jax_threshold),
+                      jax_threshold=self.jax_threshold,
+                      pipeline=self.scheduler_pipeline),
             ReplicatedOrchestrator(self.store),
             GlobalOrchestrator(self.store),
             JobsOrchestrator(self.store),

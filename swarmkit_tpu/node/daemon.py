@@ -171,18 +171,40 @@ class _Ticker(threading.Thread):
     """Drives the raft logical clock in real time (the reference's
     clock.NewClock ticker, raft.go:540 tick arm)."""
 
-    def __init__(self, raft: RaftNode, interval: float):
+    def __init__(self, raft: RaftNode, interval: float, clock=None,
+                 catch_up_cap: int = 9):
         super().__init__(daemon=True, name=f"raft-tick-{raft.id}")
         self.raft = raft
         self.interval = interval
-        self._stop = threading.Event()
+        from ..utils.clock import REAL_CLOCK
+
+        self.clock = clock or REAL_CLOCK
+        # a starved thread fires the ticks wall time owed it, so logical
+        # election time tracks wall time (the round-2 flake mechanism:
+        # lost ticks under load → elections missing their windows). The
+        # cap stays BELOW election_tick: one burst alone can never
+        # campaign past a live leader whose queued heartbeats interleave
+        # in the raft inbox, and bounds the avalanche after a suspend.
+        self.catch_up_cap = max(1, catch_up_cap)
+        # NOT named _stop: threading.Thread.join() calls an internal
+        # self._stop() method, which an Event attribute would shadow
+        self._stop_ev = threading.Event()
 
     def run(self):
-        while not self._stop.wait(self.interval):
-            self.raft.tick()
+        clock = self.clock
+        next_t = clock.monotonic() + self.interval
+        while not clock.wait(self._stop_ev,
+                             max(0.0, next_t - clock.monotonic())):
+            now = clock.monotonic()
+            owed = 1 + int(max(0.0, now - next_t) / self.interval)
+            n = min(owed, self.catch_up_cap)
+            for _ in range(n):
+                self.raft.tick()
+            next_t = max(next_t + owed * self.interval,
+                         now + self.interval / 2)
 
     def stop(self):
-        self._stop.set()
+        self._stop_ev.set()
 
 
 class SwarmNode:
@@ -212,6 +234,8 @@ class SwarmNode:
         csi_plugins=None,  # csi.plugin.PluginGetter (e.g. RemoteCSIPlugin)
         scheduler_backend: str = "auto",
         jax_threshold: int | None = None,
+        scheduler_pipeline: bool = False,
+        clock=None,
     ):
         self.state_dir = state_dir
         self.executor = executor
@@ -237,6 +261,10 @@ class SwarmNode:
         self.csi_plugins = csi_plugins
         self.scheduler_backend = scheduler_backend
         self.jax_threshold = jax_threshold
+        self.scheduler_pipeline = scheduler_pipeline
+        from ..utils.clock import REAL_CLOCK
+        self.clock = clock or REAL_CLOCK
+        self._identity_lock = threading.Lock()
         self._control_server: RPCServer | None = None
 
         self.security: SecurityConfig | None = None
@@ -348,15 +376,22 @@ class SwarmNode:
                 pass
 
     def _save_identity(self):
-        _state, cert_path, ca_path, key_path = self._paths()
-        os.makedirs(self.state_dir, exist_ok=True)
-        key_pem, cert_pem = self.security.key_and_cert()
-        KeyReadWriter(key_path, self.kek).write(key_pem)
-        with open(cert_path, "wb") as f:
-            f.write(cert_pem)
-        with open(ca_path, "wb") as f:
-            f.write(self.security.root_ca.cert_pem)
-        self._save_state(node_id=self.security.node_id())
+        # one writer at a time: cert renewal and root-rotation updates
+        # both re-save the identity concurrently (the security watch fires
+        # from either thread); interleaved writes corrupted key.json tmp
+        # files under load (round-3 de-flake)
+        with self._identity_lock:
+            _state, cert_path, ca_path, key_path = self._paths()
+            os.makedirs(self.state_dir, exist_ok=True)
+            key_pem, cert_pem = self.security.key_and_cert()
+            KeyReadWriter(key_path, self.kek).write(key_pem)
+            for path, data in ((cert_path, cert_pem),
+                               (ca_path, self.security.root_ca.cert_pem)):
+                tmp = f"{path}.{threading.get_ident()}.tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, path)
+            self._save_state(node_id=self.security.node_id())
 
     def _load_identity(self) -> SecurityConfig | None:
         _state, cert_path, _ca_path, key_path = self._paths()
@@ -648,6 +683,8 @@ class SwarmNode:
             csi_plugins=self.csi_plugins,
             scheduler_backend=self.scheduler_backend,
             jax_threshold=self.jax_threshold,
+            scheduler_pipeline=self.scheduler_pipeline,
+            clock=self.clock,
         )
         build_manager_registry(self.manager, raft,
                                LeaderConns(raft, self.security),
@@ -669,7 +706,8 @@ class SwarmNode:
             self._control_server.start()
             self.control_socket_path = sock_path
         raft.start()
-        self._ticker = _Ticker(raft, self.tick_interval)
+        self._ticker = _Ticker(raft, self.tick_interval, clock=self.clock,
+                               catch_up_cap=max(1, self.election_tick - 1))
         self._ticker.start()
         self.manager.start()
 

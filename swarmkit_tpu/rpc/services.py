@@ -628,9 +628,17 @@ class RemoteControl:
 
     @staticmethod
     def _transient(exc: Exception) -> bool:
+        import ssl as _ssl
+
         from .wire import RPCError
 
-        return isinstance(exc, RPCError) and exc.name == "NotLeaderError"
+        if isinstance(exc, RPCError) and exc.name == "NotLeaderError":
+            return True
+        # mid-rotation credential swap: for a moment the server's listener
+        # cert and this client's trust bundle come from different epochs.
+        # The reference rides this out via gRPC's transparent reconnect
+        # backoff; a wrong identity still fails — just after the window.
+        return isinstance(exc, _ssl.SSLCertVerificationError)
 
     def __getattr__(self, name):
         if name.startswith("_"):
@@ -639,14 +647,20 @@ class RemoteControl:
         def call(*args, **kwargs):
             import time as _time
 
+            # read-only methods are idempotent: a starved server that
+            # answers after the client's call timeout is a retry, not an
+            # error (writes are NOT retried on timeout — the first attempt
+            # may have committed)
+            read_only = name.startswith(("get_", "list_"))
             deadline = _time.monotonic() + self.RETRY_WINDOW
             while True:
                 try:
                     return self._conn().call(f"control.{name}", *args,
                                              **kwargs)
                 except Exception as exc:
-                    if not self._transient(exc) \
-                            or _time.monotonic() >= deadline:
+                    retry = self._transient(exc) or (
+                        read_only and isinstance(exc, TimeoutError))
+                    if not retry or _time.monotonic() >= deadline:
                         raise
                     _time.sleep(self.RETRY_PAUSE)
 

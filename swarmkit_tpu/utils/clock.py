@@ -1,0 +1,93 @@
+"""Injectable time source (reference: manager/state/raft/raft.go:186-190
+ClockSource + testutils.go:50 AdvanceTicks).
+
+Production code takes a `Clock` and uses it for monotonic reads, timed
+waits, and one-shot timers; tests inject `FakeClock` and drive time with
+`advance()` so timer-dependent logic (raft tickers, heartbeat expiry)
+runs deterministically instead of racing the wall clock on a loaded
+machine — the round-2 verdict's fix for the daemon tier's load flakes.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+
+class Clock:
+    """Real time. Subclass-compatible surface kept deliberately tiny."""
+
+    def monotonic(self) -> float:
+        return time.monotonic()
+
+    def wait(self, event: threading.Event, timeout: float | None) -> bool:
+        """Event.wait under this clock; returns event state like Event.wait."""
+        return event.wait(timeout)
+
+    def timer(self, delay: float, fn: Callable[[], None]):
+        """One-shot timer; returns an object with .cancel()."""
+        t = threading.Timer(delay, fn)
+        t.daemon = True
+        t.start()
+        return t
+
+
+REAL_CLOCK = Clock()
+
+
+class _FakeTimer:
+    __slots__ = ("due", "fn", "cancelled")
+
+    def __init__(self, due: float, fn):
+        self.due = due
+        self.fn = fn
+        self.cancelled = False
+
+    def cancel(self):
+        self.cancelled = True
+
+
+class FakeClock(Clock):
+    """Manually-advanced clock. `advance(dt)` moves time forward, fires
+    due timers (in due order, outside the lock), and wakes `wait`ers so
+    they can re-check their deadlines. Waits on real Events still notice
+    sets promptly via a short real-time poll — threads not driven by the
+    test cannot deadlock it."""
+
+    def __init__(self, start: float = 1000.0, poll: float = 0.01):
+        self._now = start
+        self._poll = poll
+        self._cond = threading.Condition()
+        self._timers: list[_FakeTimer] = []
+
+    def monotonic(self) -> float:
+        with self._cond:
+            return self._now
+
+    def wait(self, event: threading.Event, timeout: float | None) -> bool:
+        if timeout is None:
+            return event.wait(None)
+        with self._cond:
+            deadline = self._now + timeout
+            while not event.is_set() and self._now < deadline:
+                self._cond.wait(self._poll)
+        return event.is_set()
+
+    def timer(self, delay: float, fn):
+        with self._cond:
+            t = _FakeTimer(self._now + delay, fn)
+            self._timers.append(t)
+            return t
+
+    def advance(self, dt: float):
+        with self._cond:
+            self._now += dt
+            now = self._now
+            due = sorted((t for t in self._timers
+                          if not t.cancelled and t.due <= now),
+                         key=lambda t: t.due)
+            self._timers = [t for t in self._timers
+                            if not t.cancelled and t.due > now]
+            self._cond.notify_all()
+        for t in due:
+            t.fn()

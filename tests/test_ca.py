@@ -370,3 +370,42 @@ def test_ca_server_watch_loop_signs():
         assert cert.status_state == IssuanceState.ISSUED
     finally:
         server.stop()
+
+
+def test_join_retry_same_csr_is_idempotent():
+    """A joiner whose status poll timed out re-submits the SAME CSR with a
+    valid token (loaded-machine reality); the server must treat it as the
+    same request — not a renewal demanding the node's own identity — and
+    the poll then returns the issued cert (ca/server.go issuance
+    re-entrancy; round-3 de-flake)."""
+    store = MemoryStore()
+    root = RootCA.create()
+    cluster = _cluster_with_ca(store, root)
+    server = CAServer(store, root, "cluster-1")
+
+    _, csr = create_csr("x", NodeRole.MANAGER, "swarmkit-tpu")
+    nid = server.issue_node_certificate(
+        csr, token=cluster.root_ca.join_token_manager, node_id="retry-node")
+    assert nid == "retry-node"
+    # retry BEFORE signing: same CSR + token → accepted, still pending
+    assert server.issue_node_certificate(
+        csr, token=cluster.root_ca.join_token_manager,
+        node_id="retry-node") == "retry-node"
+    server._sign_pending()
+    cert = server.node_certificate_status("retry-node", timeout=2)
+    assert cert.status_state == IssuanceState.ISSUED
+    # retry AFTER issuance: still idempotent, cert stays issued
+    assert server.issue_node_certificate(
+        csr, token=cluster.root_ca.join_token_manager,
+        node_id="retry-node") == "retry-node"
+    cert2 = server.node_certificate_status("retry-node", timeout=2)
+    assert cert2.status_state == IssuanceState.ISSUED
+    assert cert2.certificate_pem == cert.certificate_pem
+
+    # a DIFFERENT key's CSR for the same node id is still a renewal and
+    # still demands the node's own identity
+    _, other_csr = create_csr("x", NodeRole.MANAGER, "swarmkit-tpu")
+    with pytest.raises(PermissionDenied):
+        server.issue_node_certificate(
+            other_csr, token=cluster.root_ca.join_token_manager,
+            node_id="retry-node")

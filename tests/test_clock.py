@@ -1,0 +1,124 @@
+"""Clock seam (utils/clock.py) — the reference's ClockSource/AdvanceTicks
+idea (raft.go:186-190, testutils.go:50): timer-dependent logic runs
+deterministically under FakeClock, and the raft ticker's catch-up keeps
+logical election time tracking wall time when its thread is starved (the
+round-2 daemon-tier flake mechanism)."""
+import threading
+import time
+
+from swarmkit_tpu.dispatcher.heartbeat import Heartbeat
+from swarmkit_tpu.node.daemon import _Ticker
+from swarmkit_tpu.utils.clock import REAL_CLOCK, FakeClock
+
+
+class TickCounter:
+    def __init__(self):
+        self.id = "fake"
+        self.n = 0
+
+    def tick(self):
+        self.n += 1
+
+
+def test_fake_clock_timer_fires_on_advance_only():
+    clock = FakeClock()
+    fired = []
+    t = clock.timer(5.0, lambda: fired.append(1))
+    clock.advance(4.9)
+    assert not fired
+    clock.advance(0.2)
+    assert fired == [1]
+    # cancelled timers never fire
+    t2 = clock.timer(1.0, lambda: fired.append(2))
+    t2.cancel()
+    clock.advance(10)
+    assert fired == [1]
+    assert t is not None
+
+
+def test_fake_clock_wait_honors_fake_deadline():
+    clock = FakeClock()
+    ev = threading.Event()
+    done = []
+
+    def waiter():
+        done.append(clock.wait(ev, 3.0))
+
+    th = threading.Thread(target=waiter, daemon=True)
+    th.start()
+    time.sleep(0.05)
+    assert not done                    # real time passes, fake time doesn't
+    clock.advance(3.1)
+    th.join(timeout=5)
+    assert done == [False]             # timed out in fake time, event unset
+
+    # a set event wakes promptly regardless of fake time
+    th2 = threading.Thread(
+        target=lambda: done.append(clock.wait(ev, 100.0)), daemon=True)
+    th2.start()
+    ev.set()
+    th2.join(timeout=5)
+    assert done[-1] is True
+
+
+def test_heartbeat_under_fake_clock():
+    clock = FakeClock()
+    expired = []
+    hb = Heartbeat(2.0, lambda: expired.append(1), clock=clock)
+    hb.start()
+    clock.advance(1.5)
+    hb.beat()                          # re-arms before expiry
+    clock.advance(1.5)
+    assert not expired                 # 1.5 < 2.0 since last beat
+    clock.advance(0.6)
+    assert expired == [1]
+    hb2 = Heartbeat(2.0, lambda: expired.append(2), clock=clock)
+    hb2.start()
+    hb2.stop()
+    clock.advance(10)
+    assert expired == [1]              # stopped timer never fires
+
+
+def test_ticker_catches_up_after_starvation():
+    """A ticker thread that sleeps through N intervals owes N ticks; the
+    catch-up burst is capped below election_tick."""
+    clock = FakeClock()
+    raft = TickCounter()
+    ticker = _Ticker(raft, interval=0.1, clock=clock, catch_up_cap=9)
+    ticker.start()
+    try:
+        # normal cadence: one tick per interval
+        for _ in range(3):
+            clock.advance(0.1)
+            time.sleep(0.05)           # let the thread run
+        assert 2 <= raft.n <= 4
+
+        # starvation: fake time jumps 0.5s (5 intervals) in one advance —
+        # the single wakeup fires the owed ticks, not just one
+        before = raft.n
+        clock.advance(0.5)
+        time.sleep(0.15)
+        assert raft.n - before >= 4, f"only {raft.n - before} catch-up ticks"
+
+        # avalanche bound: a huge jump fires at most catch_up_cap ticks
+        # in the burst wakeup
+        before = raft.n
+        clock.advance(60.0)
+        time.sleep(0.1)
+        assert raft.n - before <= 12   # cap 9 + a few normal wakeups
+    finally:
+        ticker.stop()
+        clock.advance(1.0)             # release the final wait
+        ticker.join(timeout=5)
+
+
+def test_real_clock_surface():
+    t0 = REAL_CLOCK.monotonic()
+    ev = threading.Event()
+    assert REAL_CLOCK.wait(ev, 0.01) is False
+    fired = []
+    REAL_CLOCK.timer(0.01, lambda: fired.append(1))
+    deadline = time.monotonic() + 2
+    while not fired and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert fired and REAL_CLOCK.monotonic() >= t0
