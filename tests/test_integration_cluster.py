@@ -376,3 +376,86 @@ def test_root_rotation_under_live_nodes(cluster):
     finally:
         ctl.close()
     assert wait_for(lambda: len(cluster.running(svc.id)) == 6, timeout=60)
+
+
+def test_force_new_cluster_recovers_quorum_loss(cluster):
+    """Disaster recovery (integration_test.go:552 TestForceNewCluster,
+    raft.go ForceNewCluster): a 3-manager cluster loses quorum (2 of 3
+    die), the survivor restarts with force_new_cluster=True and serves
+    again as a single-member raft KEEPING the replicated state; a fresh
+    manager then re-joins and replicates; the worker's tasks keep
+    running throughout."""
+    m1 = cluster.add_manager()
+    m2 = cluster.add_manager()
+    m3 = cluster.add_manager()
+    w = cluster.add_agent()
+    managers = [m1, m2, m3]
+    assert wait_for(
+        lambda: all(len(m.raft.members) == 3 for m in managers), timeout=30)
+
+    svc = _create_service(cluster, "durable", 2)
+    assert wait_for(lambda: len(cluster.running(svc.id)) == 2, timeout=45)
+
+    leader = cluster.leader()
+    followers = [m for m in managers if m is not leader]
+    for f in followers:
+        cluster.nodes.remove(f)
+        f.stop()
+
+    # quorum lost: the survivor cannot commit a write any more
+    ctl = RemoteControl(leader.addr, leader.security)
+    try:
+        with pytest.raises(Exception):
+            ctl.create_service(ServiceSpec(
+                annotations=Annotations(name="no-quorum"), replicas=1))
+    finally:
+        ctl.close()
+
+    state_dir, port = leader.state_dir, leader.advertise_addr.rsplit(":", 1)[1]
+    cluster.nodes.remove(leader)
+    leader.stop()
+    time.sleep(0.5)
+
+    def start_survivor():
+        node = SwarmNode(
+            state_dir=state_dir,
+            executor=FakeExecutor({"*": {"run_forever": True}},
+                                  hostname="m-survivor"),
+            listen_addr="127.0.0.1:" + port,
+            heartbeat_period=0.5,
+            tick_interval=0.05,
+            manager_refresh_interval=0.5,
+            force_new_cluster=True,
+        )
+        node.start()
+        return node
+
+    end = time.monotonic() + 20       # OS may briefly hold the listener
+    while True:
+        try:
+            survivor = start_survivor()
+            break
+        except OSError:
+            if time.monotonic() >= end:
+                raise
+            time.sleep(0.5)
+    cluster.nodes.append(survivor)
+
+    # single-member raft serves with the replicated state intact
+    assert wait_for(lambda: survivor.is_leader, timeout=60)
+    assert len(survivor.raft.members) == 1
+    got = survivor.store.view(lambda tx: tx.get_service(svc.id))
+    assert got is not None and got.spec.annotations.name == "durable"
+
+    # the worker re-registers against the recovered manager and its tasks
+    # stay up (FakeExecutor run_forever); writes commit again
+    assert wait_for(lambda: len(cluster.running(svc.id)) == 2, timeout=90)
+    svc2 = _create_service(cluster, "post-recovery", 1)
+    assert wait_for(lambda: len(cluster.running(svc2.id)) == 1, timeout=45)
+
+    # a fresh manager re-joins the recovered cluster and replicates
+    m_new = cluster.add_manager("m-rejoin")
+    assert wait_for(lambda: len(survivor.raft.members) == 2, timeout=60)
+    assert wait_for(
+        lambda: m_new.store.view(lambda tx: tx.get_service(svc.id))
+        is not None, timeout=60)
