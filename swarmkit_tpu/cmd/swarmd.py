@@ -75,6 +75,10 @@ def main(argv=None) -> int:
                          "accelerator (default 200000; tune ~100x lower "
                          "for PCIe/on-host devices than for a tunneled "
                          "dev link — see BASELINE.md)")
+    ap.add_argument("--scheduler-pipeline", action="store_true",
+                    help="pipeline scheduler ticks on the jax backend: "
+                         "commit wave k under wave k+1's device transfer "
+                         "(sustained-load throughput; +1 debounce latency)")
     ap.add_argument("--force-new-cluster", action="store_true",
                     help="disaster recovery: restart as a single-member "
                          "quorum keeping replicated state")
@@ -177,6 +181,7 @@ def main(argv=None) -> int:
         csi_plugins=csi_plugins,
         scheduler_backend=args.scheduler_backend,
         jax_threshold=args.jax_threshold,
+        scheduler_pipeline=args.scheduler_pipeline,
     )
     try:
         node.start()

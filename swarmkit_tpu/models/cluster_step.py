@@ -64,6 +64,9 @@ def example_cluster(n_nodes: int = 256, n_groups: int = 4,
             resources=Resources(
                 nano_cpus=rng.randint(4, 16) * CPU_QUANTUM * 1000,
                 memory_bytes=rng.randint(8, 64) * MEM_QUANTUM * 1024,
+                # discrete generic pool on a quarter of the fleet so the
+                # generic-resource columns are part of the flagship surface
+                generic={"gpu": 2} if i % 4 == 0 else {},
             ),
         )
         infos.append(NodeInfo.new(n, {}, n.description.resources.copy()))
@@ -99,8 +102,21 @@ def example_cluster(n_nodes: int = 256, n_groups: int = 4,
                         prefs.append(PlacementPreference(
                             spread_descriptor="node.labels.disk"))
                     spec.placement.preferences = prefs
+                if gi % 7 == 3:
+                    # generic-resource consumers (gpu pool nodes only)
+                    spec.resources.reservations.generic = {"gpu": 1}
             else:
                 t.spec = spec
+            if gi % 5 == 2:
+                # host-published ports: within-tick port conflicts between
+                # groups publishing the same port ride the kernel's
+                # port_used ORs
+                from ..api.specs import EndpointSpec, PortConfig
+
+                t.endpoint = EndpointSpec(ports=[PortConfig(
+                    protocol="tcp", target_port=80,
+                    published_port=8000 + (gi % 10),
+                    publish_mode="host")])
             tasks.append(t)
         groups.append(TaskGroup(service_id=svc, spec_version=1, tasks=tasks))
     return infos, groups

@@ -581,6 +581,19 @@ class IncrementalEncoder:
         return bool(np.array_equal(seq, self._fp_seq)
                     and np.array_equal(mut, self._fp_mut))
 
+    def force_numeric_reencode(self, rows: np.ndarray) -> None:
+        """Poison `rows`' numeric fingerprints so the next encode()
+        re-derives their numeric columns from the NodeInfo objects.
+
+        The pipelined unclean-commit heal needs this: an optimistic
+        fold_counts cannot be reverted row-wise, and a node whose decided
+        placements ALL failed to commit never had its mutation counter
+        bumped — its fingerprint still matches, so without poisoning the
+        phantom reservations would persist and break oracle parity."""
+        rows = np.asarray(rows, np.int64)
+        if rows.size:
+            self._fp_mut[rows] -= 1
+
     def restamp_counts(self, p: EncodedProblem, counts: np.ndarray) -> bool:
         """Fingerprint half of apply_counts: stamp the add_task mutation
         bumps. Call exactly once per folded tick, after the add_task loop."""

@@ -51,17 +51,31 @@ JAX_THRESHOLD = 200_000  # task×node product above which the TPU kernel wins
 
 class Scheduler:
     def __init__(self, store: MemoryStore, backend: str = "auto",
-                 jax_threshold: int | None = None):
+                 jax_threshold: int | None = None, pipeline: bool = False):
         """backend: "auto" picks per tick by task×node product against
         `jax_threshold` (default JAX_THRESHOLD); "cpu"/"jax" pin the path.
         The right threshold is deployment-specific — a PCIe-attached or
         on-host accelerator amortizes ~100× sooner than the dev tunnel
         (BASELINE.md, operator guidance) — so swarmd exposes both knobs
-        (--scheduler-backend / --jax-threshold, SURVEY §7)."""
+        (--scheduler-backend / --jax-threshold, SURVEY §7).
+
+        pipeline=True enables sustained-load tick pipelining on the jax
+        path (ops/pipeline.py reorder): a tick dispatches its fill and
+        returns; the NEXT tick pulls the counts — which rode the link in
+        the background through the debounce window — commits them, and
+        dispatches again, with the commit overlapping the new transfer.
+        Placement latency gains one debounce period; steady throughput
+        stops paying the blocking device pull. Commit conflicts (tasks
+        raced/deleted, nodes gone) abandon the optimistic fold: the
+        resident carry invalidates and fingerprint deltas re-encode the
+        touched rows — the same self-healing the serial path uses."""
         self.store = store
         self.backend = backend
         self.jax_threshold = (JAX_THRESHOLD if jax_threshold is None
                               else jax_threshold)
+        self.pipeline = pipeline
+        # (problem, PendingCounts, frozenset of in-flight task ids)
+        self._inflight = None
         self.node_infos: dict[str, NodeInfo] = {}
         self.unassigned: dict[str, Task] = {}
         self.preassigned: dict[str, Task] = {}
@@ -206,7 +220,10 @@ class Scheduler:
         ch = self._setup()
         if self.unassigned or self.preassigned:
             self.tick()
-        dirty_since: float | None = None
+        # a pipelined initial tick leaves a wave in flight: stay dirty so
+        # the completing tick fires after the debounce
+        dirty_since: float | None = (
+            time.monotonic() if self._inflight is not None else None)
         try:
             while not self._stop.is_set():
                 timeout = 0.2
@@ -242,8 +259,14 @@ class Scheduler:
                     # debounce elapsed with no new event, or max latency hit
                     try:
                         self.tick()
-                        dirty_since = None
+                        # an in-flight pipelined wave must complete even if
+                        # no further event arrives: stay dirty so the next
+                        # debounce fires the completing tick
+                        dirty_since = (time.monotonic()
+                                       if self._inflight is not None
+                                       else None)
                     except Exception as exc:
+                        self._inflight = None
                         if self._resident is not None:
                             # the device carry may have folded a tick the
                             # host never applied: resync from host state
@@ -260,11 +283,21 @@ class Scheduler:
                         log.exception("scheduler: tick failed; will retry")
                         dirty_since = time.monotonic()
         finally:
+            try:
+                if self._inflight is not None:
+                    self.flush_pipeline()
+            except Exception:
+                self._inflight = None
+                if self._resident is not None:
+                    self._resident.invalidate()
             self.store.queue.stop_watch(ch)
 
     # ------------------------------------------------------------------ tick
     def tick(self):
         self.ticks += 1
+        if self._inflight is not None:
+            self._tick_pipelined()
+            return
         if self.preassigned:
             self._process_preassigned()
         if not self.unassigned:
@@ -274,17 +307,19 @@ class Scheduler:
             return
         problem = self.encoder.encode(list(self.node_infos.values()), groups,
                                       volume_set=self.volume_set)
-        n_nodes = len(problem.node_ids)
-        total_tasks = int(problem.n_tasks.sum())
-        use_jax = (self.backend == "jax"
-                   or (self.backend == "auto"
-                       and total_tasks * max(n_nodes, 1)
-                       >= self.jax_threshold))
+        use_jax = self._use_jax(problem)
         if use_jax:
             if self._resident is None:
                 from ..ops.resident import ResidentPlacement
 
                 self._resident = ResidentPlacement(self.encoder)
+            if self.pipeline:
+                # dispatch only: the counts D2H rides the link through the
+                # debounce window; the next tick completes the wave
+                h = self._resident.schedule_async(problem)
+                ids = frozenset(t.id for g in groups for t in g.tasks)
+                self._inflight = (problem, h, ids)
+                return
             counts = self._resident.schedule(problem)
         else:
             counts = cpu_schedule_encoded(problem)
@@ -295,9 +330,85 @@ class Scheduler:
         orders = materialize_orders(problem, counts)
         self._apply_decisions(problem, orders, counts)
 
-    def _group_unassigned(self) -> list[TaskGroup]:
+    def _use_jax(self, problem) -> bool:
+        total_tasks = int(problem.n_tasks.sum())
+        return (self.backend == "jax"
+                or (self.backend == "auto"
+                    and total_tasks * max(len(problem.node_ids), 1)
+                    >= self.jax_threshold))
+
+    def _tick_pipelined(self):
+        """Complete the in-flight wave and keep the pipeline primed: pull
+        counts, fold (optimistically), dispatch the NEXT wave, then commit
+        the completed one under the new wave's transfer (ops/pipeline.py
+        order). An unclean commit abandons both the fold and any stale
+        next dispatch — fingerprint deltas re-encode the touched rows."""
+        problem, h, prev_ids = self._inflight
+        self._inflight = None
+        if self.preassigned:
+            # preassigned (global-service) tasks never touch the encoded
+            # problem; under sustained pipelined load this is their only
+            # slot (the serial path's call is short-circuited). Their
+            # add_task bumps flip nodes_clean, which correctly forces the
+            # touched rows to re-encode before the next dispatch.
+            self._process_preassigned()
+        counts = h.get()
+        folded = self.encoder.fold_counts(problem, counts)
+        if folded:
+            self._resident.after_apply(problem, counts)
+        else:
+            self._resident.invalidate()
+
+        # next wave: everything unassigned that is NOT still uncommitted
+        # in the wave being completed (no double placement)
+        if (folded and self.pipeline
+                and self.encoder.nodes_clean(self.node_infos.values())):
+            next_groups = self._group_unassigned(exclude=prev_ids)
+            if next_groups:
+                p_next = self.encoder.encode(
+                    list(self.node_infos.values()), next_groups,
+                    volume_set=self.volume_set)
+                if self._use_jax(p_next):
+                    h_next = self._resident.schedule_async(p_next)
+                    ids = frozenset(
+                        t.id for g in next_groups for t in g.tasks)
+                    self._inflight = (p_next, h_next, ids)
+                # a CPU-shaped wave after a deferred encode is committed
+                # on the NEXT tick's serial path (tasks stay unassigned)
+
+        orders = materialize_orders(problem, counts)
+        clean = self._apply_decisions(problem, orders, counts,
+                                      deferred_fold=True)
+        if clean:
+            self.encoder.restamp_counts(problem, counts)
+        else:
+            # the optimistic fold lied: poison every placed-on row so the
+            # next encode re-derives it from the NodeInfo objects (a node
+            # whose placements ALL dropped never bumped its mutation
+            # counter — without this its phantom reservations persist),
+            # resync the device, and discard any dispatch built on the
+            # bad fold
+            import numpy as _np
+
+            self.encoder.force_numeric_reencode(
+                _np.flatnonzero(counts.sum(axis=0)))
+            self._resident.invalidate()
+            if self._inflight is not None:
+                _p2, h2, _ids2 = self._inflight
+                self._inflight = None
+                h2.get()
+
+    def flush_pipeline(self):
+        """Complete any in-flight wave now (stop/leadership-loss path)."""
+        while self._inflight is not None:
+            self._tick_pipelined()
+
+    def _group_unassigned(self, exclude: frozenset | None = None,
+                          ) -> list[TaskGroup]:
         grouped: dict[tuple[str, int], list[Task]] = defaultdict(list)
         for t in self.unassigned.values():
+            if exclude is not None and t.id in exclude:
+                continue
             sv = t.spec_version.index if t.spec_version else 0
             grouped[(t.service_id or t.id, sv)].append(t)
         return [
@@ -307,13 +418,19 @@ class Scheduler:
         ]
 
     # -------------------------------------------------------------- commits
-    def _apply_decisions(self, problem, orders, counts=None):
+    def _apply_decisions(self, problem, orders, counts=None,
+                         deferred_fold=False) -> bool:
         """store.Batch with in-tx re-validation (scheduler.go:490-643).
 
         `orders` is materialize_orders output: per group (aligned with
         problem.groups) the canonical slot order of node indices; the
         group's id-sorted tasks zip with it, tasks past the end are
-        unplaced."""
+        unplaced.
+
+        deferred_fold=True (pipelined path): the caller already folded the
+        encoder optimistically and owns the restamp/invalidate decision —
+        the return value says whether the commit was clean (exactly one
+        add_task per decided placement)."""
         groups = problem.groups
         applied: list[tuple[Task, str]] = []
         # tasks no longer schedulable (deleted, dead, raced to assigned
@@ -384,7 +501,10 @@ class Scheduler:
         # (vectorized) iff every decided placement landed as exactly one
         # add_task; otherwise let the fingerprint delta re-encode the
         # touched rows next tick (conflicts/drops are rare)
-        if counts is not None and n_added == int(counts.sum()):
+        clean = counts is not None and n_added == int(counts.sum())
+        if deferred_fold:
+            pass    # pipelined caller folded pre-commit and owns the rest
+        elif clean:
             folded = self.encoder.apply_counts(problem, counts)
             if self._resident is not None:
                 if folded:
@@ -449,6 +569,7 @@ class Scheduler:
             self.store.batch(explain_cb)
         # everything else (no-suitable-node, conflicted commits) stays in
         # self.unassigned; node/task events retrigger the tick
+        return clean
 
     def _explain(self, group: TaskGroup) -> str:
         pipeline = Pipeline(self.volume_set)

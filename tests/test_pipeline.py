@@ -189,3 +189,151 @@ def test_fold_restamp_split_equals_apply_counts():
     np.testing.assert_array_equal(enc_a._fp_mut, enc_b._fp_mut)
     np.testing.assert_array_equal(enc_a._svc_mat, enc_b._svc_mat)
     assert enc_a.nodes_clean(infos_a) and enc_b.nodes_clean(infos_b)
+
+
+# --------------------------------------------------------------------------
+# Production Scheduler pipelined mode (Scheduler(pipeline=True)): the
+# run-loop level integration of the deferred-commit reorder.
+# --------------------------------------------------------------------------
+
+def _seed_cluster(tx_nodes=6, waves=(("s1", 8),)):
+    from swarmkit_tpu.api.objects import Node, Task
+    from swarmkit_tpu.api.types import (NodeAvailability, NodeStatusState,
+                                        TaskState)
+    from swarmkit_tpu.store.memory import MemoryStore
+
+    store = MemoryStore()
+
+    def seed(tx):
+        for i in range(tx_nodes):
+            n = Node(id=f"pn{i:02d}")
+            n.status.state = NodeStatusState.READY
+            n.spec.availability = NodeAvailability.ACTIVE
+            tx.create(n)
+        for svc, count in waves:
+            for w in range(count):
+                t = Task(id=f"{svc}-t{w:02d}", service_id=svc, slot=w + 1)
+                t.desired_state = TaskState.RUNNING
+                t.status.state = TaskState.PENDING
+                tx.create(t)
+    store.update(seed)
+    return store
+
+
+def test_scheduler_pipelined_mode_end_to_end():
+    """Sustained waves through Scheduler(pipeline=True): every task lands
+    ASSIGNED, the pipeline actually engages (in-flight wave observed), and
+    no task is double-assigned."""
+    import time as _time
+
+    from swarmkit_tpu.api.objects import Task
+    from swarmkit_tpu.api.types import TaskState
+    from swarmkit_tpu.scheduler.scheduler import Scheduler
+
+    store = _seed_cluster(waves=(("s1", 8),))
+    sched = Scheduler(store, backend="jax", pipeline=True)
+    sched.start()
+    saw_inflight = False
+    try:
+        def all_assigned(prefix, n):
+            tasks = [t for t in store.view(lambda tx: tx.find_tasks())
+                     if t.id.startswith(prefix)]
+            return len(tasks) == n and all(
+                t.status.state == TaskState.ASSIGNED and t.node_id
+                for t in tasks)
+
+        deadline = _time.monotonic() + 90
+        while _time.monotonic() < deadline and not all_assigned("s1-", 8):
+            saw_inflight = saw_inflight or sched._inflight is not None
+            _time.sleep(0.02)
+        assert all_assigned("s1-", 8)
+
+        # second and third waves arrive back-to-back (sustained load)
+        for wi, svc in enumerate(("s2", "s3")):
+            def add(tx, svc=svc):
+                for w in range(6):
+                    t = Task(id=f"{svc}-t{w:02d}", service_id=svc,
+                             slot=w + 1)
+                    t.desired_state = TaskState.RUNNING
+                    t.status.state = TaskState.PENDING
+                    tx.create(t)
+            store.update(add)
+        deadline = _time.monotonic() + 90
+        while _time.monotonic() < deadline and not (
+                all_assigned("s2-", 6) and all_assigned("s3-", 6)):
+            saw_inflight = saw_inflight or sched._inflight is not None
+            _time.sleep(0.02)
+        assert all_assigned("s2-", 6) and all_assigned("s3-", 6)
+        assert saw_inflight, "pipeline never engaged (no in-flight wave)"
+    finally:
+        sched.stop()
+    # stop() drains the pipeline (run loop's finally): nothing in flight
+    assert sched._inflight is None
+
+
+def test_scheduler_pipelined_unclean_commit_heals():
+    """A task deleted between dispatch and completion makes the commit
+    unclean (fold already applied): the scheduler must invalidate the
+    resident carry, skip the restamp, and keep scheduling correctly —
+    driven tick-by-tick, no run loop."""
+    from swarmkit_tpu.api.objects import Task
+    from swarmkit_tpu.api.types import TaskState
+    from swarmkit_tpu.scheduler.scheduler import Scheduler
+
+    store = _seed_cluster(waves=(("s1", 8),))
+    sched = Scheduler(store, backend="jax", pipeline=True)
+    ch = sched._setup()
+    try:
+        assert len(sched.unassigned) == 8
+        sched.tick()                      # dispatch only
+        assert sched._inflight is not None
+
+        def drop(tx):
+            tx.delete(Task, "s1-t03")
+        store.update(drop)
+
+        sched.tick()                      # completes: unclean commit
+        # wave 1's tasks were all in flight, so nothing could re-prime
+        assert sched._inflight is None
+        # deleted task dropped; the rest assigned
+        tasks = store.view(lambda tx: tx.find_tasks())
+        assigned = [t for t in tasks if t.status.state == TaskState.ASSIGNED]
+        assert len(assigned) == 7
+        assert not any(t.id == "s1-t03" for t in tasks)
+        # the resident carry was resynced (invalidate → stale flag)
+        assert sched._resident is not None and sched._resident._stale
+        # the optimistic fold must NOT survive as phantom reservations:
+        # after the next encode, every numeric row equals a from-scratch
+        # encode of the same NodeInfo objects (the force_numeric_reencode
+        # heal — a node whose only placement dropped has an unchanged
+        # mutation counter, so without poisoning it would stay folded)
+        import numpy as np
+        from swarmkit_tpu.scheduler.encode import IncrementalEncoder
+
+        infos = list(sched.node_infos.values())
+        p_after = sched.encoder.encode(infos, [])
+        fresh = IncrementalEncoder()
+        p_fresh = fresh.encode(infos, [])
+        np.testing.assert_array_equal(p_after.avail_res, p_fresh.avail_res)
+        np.testing.assert_array_equal(p_after.total0, p_fresh.total0)
+        np.testing.assert_array_equal(p_after.port_used0, p_fresh.port_used0)
+
+        # scheduling keeps working after the heal
+        def add(tx):
+            for w in range(4):
+                t = Task(id=f"s2-t{w:02d}", service_id="s2", slot=w + 1)
+                t.desired_state = TaskState.RUNNING
+                t.status.state = TaskState.PENDING
+                tx.create(t)
+        store.update(add)
+        for t in store.view(lambda tx: tx.find_tasks()):
+            if t.id.startswith("s2-") and t.status.state == TaskState.PENDING:
+                sched.unassigned[t.id] = t
+        sched.tick()                      # dispatch wave 2
+        sched.flush_pipeline()            # complete it
+        tasks = store.view(lambda tx: tx.find_tasks())
+        s2 = [t for t in tasks if t.id.startswith("s2-")]
+        assert len(s2) == 4 and all(
+            t.status.state == TaskState.ASSIGNED for t in s2)
+    finally:
+        sched.store.queue.stop_watch(ch)
