@@ -300,6 +300,12 @@ class Scheduler:
             return
         if self.preassigned:
             self._process_preassigned()
+        self._schedule_backlog()
+
+    def _schedule_backlog(self):
+        """One scheduling pass over the unassigned pool (the serial tick
+        body). In pipeline mode a jax-shaped wave dispatches and returns
+        with the wave in flight; anything else commits synchronously."""
         if not self.unassigned:
             return
         groups = self._group_unassigned()
@@ -364,7 +370,13 @@ class Scheduler:
         if (folded and self.pipeline
                 and self.encoder.nodes_clean(self.node_infos.values())):
             next_groups = self._group_unassigned(exclude=prev_ids)
-            if next_groups:
+            # CPU-shaped waves skip the prime entirely (the encode would
+            # be discarded and redone by the fallthrough below)
+            total_next = sum(len(g.tasks) for g in next_groups)
+            if next_groups and (
+                    self.backend == "jax"
+                    or total_next * max(len(self.node_infos), 1)
+                    >= self.jax_threshold):
                 p_next = self.encoder.encode(
                     list(self.node_infos.values()), next_groups,
                     volume_set=self.volume_set)
@@ -373,8 +385,6 @@ class Scheduler:
                     ids = frozenset(
                         t.id for g in next_groups for t in g.tasks)
                     self._inflight = (p_next, h_next, ids)
-                # a CPU-shaped wave after a deferred encode is committed
-                # on the NEXT tick's serial path (tasks stay unassigned)
 
         orders = materialize_orders(problem, counts)
         clean = self._apply_decisions(problem, orders, counts,
@@ -397,6 +407,19 @@ class Scheduler:
                 _p2, h2, _ids2 = self._inflight
                 self._inflight = None
                 h2.get()
+        if (self._inflight is None and self.unassigned
+                and frozenset(self.unassigned) != prev_ids):
+            # nothing primed (dirty nodes, CPU-shaped wave, unclean heal,
+            # or the backlog arrived after the prime check): schedule it
+            # NOW — leaving it for a future event would wedge a backlog
+            # that generates no further events (chaos-test regression).
+            # The pool-changed gate stops the degenerate loop: a pool
+            # identical to the wave just attempted is unplaceable-as-is
+            # (explanations written by _apply_decisions) and must go IDLE
+            # until an event, exactly like the serial path — otherwise
+            # flush_pipeline() never terminates and the run loop burns a
+            # device round trip per debounce forever.
+            self._schedule_backlog()
 
     def flush_pipeline(self):
         """Complete any in-flight wave now (stop/leadership-loss path)."""
