@@ -257,7 +257,8 @@ def bench_scheduler_config(np, placement_ops, batch, n_nodes, n_tasks,
 
     def commit(p, counts):
         # the production commit shape (_apply_decisions): slot orders, then
-        # the group's id-sorted tasks zip with them — no task-id dict
+        # the group's id-sorted tasks zip with them, bulked per
+        # (node, shared-spec) cell like the scheduler's commit
         t0 = time.perf_counter()
         orders = batch.materialize_orders(p, counts)
         mat_s = time.perf_counter() - t0
@@ -265,9 +266,11 @@ def bench_scheduler_config(np, placement_ops, batch, n_nodes, n_tasks,
         infos_arr = [by_node[nid] for nid in p.node_ids]
         n_added = 0
         for g, order in zip(p.groups, orders):
+            cells: dict[int, list] = {}
             for t, ni in zip(g.tasks, order.tolist()):
-                if infos_arr[ni].add_task(t):
-                    n_added += 1
+                cells.setdefault(ni, []).append(t)
+            for ni, cell in cells.items():
+                n_added += infos_arr[ni].add_tasks(cell)
         assert n_added == int(counts.sum())
         commit_phases.append((mat_s, time.perf_counter() - t0))
 

@@ -115,6 +115,51 @@ class NodeInfo:
             self._bump_service(t.service_id, +1)
         return True
 
+    def add_tasks(self, tasks: list) -> int:
+        """Bulk add of NEW same-spec tasks — a scheduler wave's (group,
+        node) cell. Returns the number added (== add_task returning True
+        that many times; mutations bumps once per task, preserving the
+        encoder fingerprint contract).
+
+        Fast path: all ids unknown, a shared spec object with no generic
+        reservations, no host-published ports — one resource subtract and
+        one service bump cover the batch. Anything else falls back to
+        per-task add_task (per-task generic claims and port sets need the
+        full path)."""
+        if not tasks:
+            return 0
+        if len(tasks) == 1:              # degenerate cell: skip the scans
+            return 1 if self.add_task(tasks[0]) else 0
+        t0 = tasks[0]
+        res = task_reservations(t0.spec)
+        # reservations compared by VALUE: the commit path deepcopies each
+        # task (store objects), so same-group tasks share spec content,
+        # never spec identity
+        def same_res(t):
+            r = task_reservations(t.spec)
+            return (r.nano_cpus == res.nano_cpus
+                    and r.memory_bytes == res.memory_bytes
+                    and not r.generic)
+        fast = (not res.generic
+                and all(same_res(t) for t in tasks)
+                and all(not self._host_ports(t) for t in tasks)
+                and all(t.id not in self.tasks for t in tasks)
+                and len({t.id for t in tasks}) == len(tasks)
+                and all(t.service_id == t0.service_id for t in tasks)
+                and all(t.desired_state <= TaskState.COMPLETE
+                        for t in tasks))
+        if not fast:
+            return sum(1 for t in tasks if self.add_task(t))
+        n = len(tasks)
+        self.mutations += n
+        self.tasks.update((t.id, t) for t in tasks)
+        self.available_resources.memory_bytes -= res.memory_bytes * n
+        self.available_resources.nano_cpus -= res.nano_cpus * n
+        self.generic_assignments.update((t.id, {}) for t in tasks)
+        self.active_tasks_count += n
+        self._bump_service(t0.service_id, +n)
+        return n
+
     def assigned_generic(self, task_id: str) -> dict[str, tuple[frozenset, int]]:
         """What a placed task was granted: kind -> (named ids, discrete count).
         Never written onto the (store-owned) Task object here — the commit

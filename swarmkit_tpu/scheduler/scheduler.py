@@ -455,7 +455,7 @@ class Scheduler:
         the return value says whether the commit was clean (exactly one
         add_task per decided placement)."""
         groups = problem.groups
-        applied: list[tuple[Task, str]] = []
+        applied: list[tuple[Task, str, int]] = []
         # tasks no longer schedulable (deleted, dead, raced to assigned
         # elsewhere) — evicted from the unassigned pool after the batch;
         # conflicted decisions are NOT dropped and retry next tick
@@ -471,7 +471,7 @@ class Scheduler:
                 for ti, task in enumerate(group.tasks):
                     node_id = node_ids[order[ti]] if ti < n_placed else None
 
-                    def update_one(tx, task=task, node_id=node_id, group=group):
+                    def update_one(tx, task=task, node_id=node_id, group=group, gi=gi):
                         cur = tx.get_task(task.id)
                         if cur is None or cur.desired_state > TaskState.COMPLETE:
                             drop.append(task.id)
@@ -504,7 +504,7 @@ class Scheduler:
                         cur.status.message = "scheduler assigned task to node"
                         cur.status.timestamp = time.time()
                         tx.update(cur)
-                        applied.append((cur, node_id))
+                        applied.append((cur, node_id, gi))
 
                     batch.update(update_one)
 
@@ -512,14 +512,21 @@ class Scheduler:
 
         with_generic: list[tuple[str, str]] = []
         n_added = 0
-        for task, node_id in applied:
+        # bulk the NodeInfo bookkeeping by (node, group) cell — one wave
+        # commonly places many same-group (same reservations) tasks per
+        # node and the per-task add_task loop was the commit's hot spot.
+        # Grouping is by GROUP index, not spec identity: the in-tx commit
+        # deepcopied every task, so spec objects are never shared.
+        cells: dict[tuple[str, int], list[Task]] = {}
+        for task, node_id, gi in applied:
             self.unassigned.pop(task.id, None)
+            if task.spec.resources.reservations.generic:
+                with_generic.append((task.id, node_id))
+            cells.setdefault((node_id, gi), []).append(task)
+        for (node_id, _gi), cell in cells.items():
             info = self.node_infos.get(node_id)
             if info:
-                if info.add_task(task):
-                    n_added += 1
-                if task.spec.resources.reservations.generic:
-                    with_generic.append((task.id, node_id))
+                n_added += info.add_tasks(cell)
         # fold our own placements back into the encoder's cached rows
         # (vectorized) iff every decided placement landed as exactly one
         # add_task; otherwise let the fingerprint delta re-encode the

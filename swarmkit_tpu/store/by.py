@@ -204,6 +204,39 @@ class ByReferencedConfigID(By):
         return any(ref.config_id == self.config_id for ref in runtime.configs)
 
 
+def _indices_of(obj) -> dict:
+    spec = getattr(obj, "spec", None)
+    ann = getattr(spec, "annotations", None) or getattr(obj, "annotations", None)
+    return getattr(ann, "indices", None) or {}
+
+
+class ByCustom(By):
+    """Search a custom index (Annotations.indices) for an exact value
+    (reference: by.go:198-214 ByCustom)."""
+
+    def __init__(self, index: str, value: str):
+        self.index = index
+        self.value = value
+
+    def match(self, obj) -> bool:
+        return _indices_of(obj).get(self.index) == self.value
+
+    def index_key(self):
+        return ("custom", (self.index, self.value))
+
+
+class ByCustomPrefix(By):
+    """Custom-index prefix search (reference: by.go:216-232)."""
+
+    def __init__(self, index: str, prefix: str):
+        self.index = index
+        self.prefix = prefix
+
+    def match(self, obj) -> bool:
+        v = _indices_of(obj).get(self.index)
+        return v is not None and v.startswith(self.prefix)
+
+
 class Or(By):
     def __init__(self, *selectors: By):
         self.selectors = selectors

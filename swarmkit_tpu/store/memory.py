@@ -451,6 +451,12 @@ class MemoryStore:
         name = _name_of(obj)
         if name:
             entries.append(("name", name.lower()))
+        # custom indexes (reference by.go ByCustom: application-defined
+        # secondary keys in Annotations.indices) — the extraction rule is
+        # shared with the ByCustom matchers so index writer and reader
+        # can never diverge
+        for k, v in by_mod._indices_of(obj).items():
+            entries.append(("custom", (k, v)))
         if isinstance(obj, Task):
             if obj.service_id:
                 entries.append(("service", obj.service_id))

@@ -220,3 +220,52 @@ def test_failure_window_capped():
     from swarmkit_tpu.scheduler.nodeinfo import MAX_FAILURES
     assert len(info.recent_failures[key]) <= MAX_FAILURES
     assert info.penalized(key, now=1100.0)
+
+
+def test_add_tasks_bulk_equals_serial_add_task():
+    """NodeInfo.add_tasks must leave state BIT-identical to the per-task
+    add_task sequence — mutations counter included (the encoder
+    fingerprint contract) — across fast-path and every fallback flavor:
+    generic reservations, host ports, re-adds, mixed desired states."""
+    import random
+
+    from test_encoder_incremental import make_info, make_task
+
+    for seed in range(6):
+        rng_a, rng_b = random.Random(seed), random.Random(seed)
+        a, b = make_info(rng_a, 0), make_info(rng_b, 0)
+
+        rng = random.Random(100 + seed)
+        waves = []
+        for w in range(5):
+            svc = f"svc-{rng.randrange(3):03d}"
+            tasks = [make_task(rng, svc, w * 100 + i)
+                     for i in range(rng.randint(1, 6))]
+            shared = tasks[0].spec
+            for t in tasks[1:]:
+                if rng.random() < 0.8:
+                    t.spec = shared          # same-spec cell (fast path)
+            if rng.random() < 0.3:           # force a fallback flavor
+                tasks[0].spec.resources.reservations.generic = {"gpu": 1}
+            if rng.random() < 0.3:
+                from swarmkit_tpu.api.specs import EndpointSpec, PortConfig
+                tasks[0].endpoint = EndpointSpec(ports=[PortConfig(
+                    protocol="tcp", target_port=80,
+                    published_port=9000 + w, publish_mode="host")])
+            if rng.random() < 0.3 and waves:
+                tasks.append(waves[-1][rng.randrange(len(waves[-1]))])  # re-add
+            waves.append(tasks)
+
+        for tasks in waves:
+            n_a = a.add_tasks(tasks)
+            n_b = sum(1 for t in tasks if b.add_task(t))
+            assert n_a == n_b
+        assert a.mutations == b.mutations
+        assert a.active_tasks_count == b.active_tasks_count
+        assert a.active_tasks_count_by_service == b.active_tasks_count_by_service
+        assert a.available_resources.nano_cpus == b.available_resources.nano_cpus
+        assert a.available_resources.memory_bytes == b.available_resources.memory_bytes
+        assert a.available_resources.generic == b.available_resources.generic
+        assert a.used_host_ports == b.used_host_ports
+        assert set(a.tasks) == set(b.tasks)
+        assert a.generic_assignments == b.generic_assignments

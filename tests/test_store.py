@@ -295,3 +295,48 @@ def test_name_uniqueness_within_one_transaction():
             pass
     store.update(rename_and_fill)
     assert store.view().get_service("s4") is not None
+
+
+def test_by_custom_index():
+    """ByCustom/ByCustomPrefix find via the custom secondary index
+    (reference by.go:198-232 + memory_test.go:1141-1152), staying correct
+    through updates that move an object between index keys."""
+    from swarmkit_tpu.api.objects import Service
+    from swarmkit_tpu.api.specs import Annotations, ServiceSpec
+
+    store = MemoryStore()
+
+    def create(tx):
+        for i, tier in enumerate(("gold", "gold", "silver")):
+            tx.create(Service(id=f"cs-{i}", spec=ServiceSpec(
+                annotations=Annotations(name=f"cs-{i}",
+                                        indices={"tier": tier,
+                                                 "region": f"r{i}"}))))
+        tx.create(Service(id="cs-3", spec=ServiceSpec(
+            annotations=Annotations(name="cs-3"))))
+    store.update(create)
+
+    view = store.view()
+    assert [s.id for s in view.find_services(by.ByCustom("tier", "gold"))] \
+        == ["cs-0", "cs-1"]
+    assert [s.id for s in view.find_services(by.ByCustom("tier", "silver"))] \
+        == ["cs-2"]
+    assert [s.id for s in view.find_services(by.ByCustom("tier", "none"))] \
+        == []
+    assert [s.id for s in view.find_services(
+        by.ByCustomPrefix("region", "r"))] == ["cs-0", "cs-1", "cs-2"]
+    # the exact-match selector narrows through the index (no full scan)
+    assert by.candidate_ids(store._indexes["service"],
+                            [by.ByCustom("tier", "gold")]) == {"cs-0", "cs-1"}
+
+    # moving an object between custom keys re-indexes it
+    def move(tx):
+        s = tx.get_service("cs-2").copy()
+        s.spec.annotations.indices = {"tier": "gold", "region": "r2"}
+        tx.update(s)
+    store.update(move)
+    view = store.view()
+    assert [s.id for s in view.find_services(by.ByCustom("tier", "gold"))] \
+        == ["cs-0", "cs-1", "cs-2"]
+    assert [s.id for s in view.find_services(by.ByCustom("tier", "silver"))] \
+        == []
