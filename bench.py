@@ -348,7 +348,10 @@ def bench_scheduler_config(np, placement_ops, batch, n_nodes, n_tasks,
         "cold_device_s": round(cold["device_s"], 4),
         "speedup": round(cpu_tick_s / best["tick"], 2),
         "cold_speedup": round(cold["cpu_tick_s"] / cold["tpu_tick_s"], 2),
-        "device_vs_kernel_x": round(best["device"] / kernel_resident_s, 1),
+        # None when the probe's subtraction bottoms out (sub-jitter kernel
+        # at small shapes: K dispatches cost no more than the sync alone)
+        "device_vs_kernel_x": (round(best["device"] / kernel_resident_s, 1)
+                               if kernel_resident_s > 0 else None),
         # marginal rate across fully-steady ticks: excludes the first
         # steady dispatch, which ships the cold wave's correction burst
         "delta_rows_per_steady_tick": (
@@ -527,6 +530,86 @@ def bench_raft_replay(np):
             "parity": bool(ok)}
 
 
+def bench_e2e_service_start(np):
+    """The swarm-bench scenario (reference cmd/swarm-bench/benchmark.go:
+    38-71 + collector.go): a real in-process cluster — 3 managers over
+    TCP+mTLS raft, 5 workers — runs a 100-replica service; per-task
+    time-to-RUNNING percentiles are read from the replicated store (the
+    reference has containers phone home over UDP; the store's observed
+    RUNNING timestamps carry the same signal). Control-plane wall clock,
+    not kernel math: the auto backend keeps 100×8 ticks on CPU."""
+    import tempfile
+    import pathlib
+    import shlex
+
+    sys.path.insert(0, "tests")
+    from test_integration_cluster import Cluster
+    from test_scheduler import wait_for
+
+    from swarmkit_tpu.api.specs import (Annotations, ContainerSpec,
+                                        ServiceSpec, TaskSpec)
+    from swarmkit_tpu.api.types import TaskState
+    from swarmkit_tpu.store import by
+
+    base = pathlib.Path(tempfile.mkdtemp(prefix="bench-e2e-"))
+    cluster = Cluster(base)
+    try:
+        for _ in range(3):
+            cluster.add_manager()
+        for _ in range(5):
+            cluster.add_agent()
+        leader = cluster.leader()
+        assert wait_for(
+            lambda: len([n for n in leader.store.view(
+                lambda tx: tx.find_nodes())]) == 8, timeout=60)
+
+        REPLICAS = 100
+        ctl = cluster.control()
+        t0_wall = time.time()
+        t0 = time.monotonic()
+        svc = ctl.create_service(ServiceSpec(
+            annotations=Annotations(name="bench-e2e"),
+            replicas=REPLICAS,
+            task=TaskSpec(runtime=ContainerSpec(
+                command=shlex.split("sleep 3600")))))
+        # per-task latency from the task's own observed-RUNNING status
+        # timestamp (written by the status write-back path) — not the poll
+        # clock, whose 50 ms cadence would quantize the percentiles
+        seen: dict[str, float] = {}
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and len(seen) < REPLICAS:
+            tasks = leader.store.view(
+                lambda tx: tx.find_tasks(by.ByServiceID(svc.id)))
+            for t in tasks:
+                if t.id not in seen and t.status.state == TaskState.RUNNING:
+                    seen[t.id] = t.status.timestamp - t0_wall
+            time.sleep(0.05)
+        all_running_s = (time.monotonic() - t0
+                         if len(seen) == REPLICAS else None)
+        ctl.close()
+
+        lat = sorted(seen.values())
+
+        def pct(p):
+            # nearest-rank: ceil(p/100 * n)-th smallest (1-based); the
+            # naive int(p/100*n) index reported p100 as p99 at n=100
+            if not lat:
+                return None
+            import math
+            return round(lat[max(0, math.ceil(p / 100 * len(lat)) - 1)], 3)
+
+        return {
+            "managers": 3, "workers": 5, "replicas": REPLICAS,
+            "running": len(seen),
+            "p50_s": pct(50), "p90_s": pct(90), "p99_s": pct(99),
+            "all_running_s": round(all_running_s, 3)
+            if all_running_s is not None else None,
+            "parity": len(seen) == REPLICAS,
+        }
+    finally:
+        cluster.stop_all()
+
+
 def bench_host_micro(np):
     """The BASELINE.md harness rows the reference ships benchmarks for
     but no numbers (store ops memory_test.go:2028-2120, watch queue at
@@ -684,6 +767,13 @@ def main():
     from swarmkit_tpu.ops import placement as placement_ops
     from swarmkit_tpu.scheduler import batch
 
+    # FIRST, on a clean heap: the live-cluster e2e row spawns an
+    # in-process 3-manager raft + 5 workers; after the grid configs the
+    # process carries multi-GB of wave objects and GC pauses stall raft
+    # writes past their timeouts (observed: create_service timeout when
+    # this ran last)
+    e2e_row = bench_e2e_service_start(np)
+
     ns = bench_scheduler_config(np, placement_ops, batch,
                                 N_NODES, N_TASKS, N_SERVICES, waves=5)
     configs = {
@@ -693,6 +783,8 @@ def main():
         "binpack_10k_x_1k": bench_scheduler_config(
             np, placement_ops, batch, 1_000, 10_000, 50, binpack=True),
         # the reference benchScheduler grid (scheduler_test.go:3187-3209)
+        "grid_1k_x_1k": bench_scheduler_config(
+            np, placement_ops, batch, 1_000, 1_000, 20),
         "grid_10k_x_1k": bench_scheduler_config(
             np, placement_ops, batch, 1_000, 10_000, 20),
         "grid_100k_x_1k": bench_scheduler_config(
@@ -724,6 +816,7 @@ def main():
         "global_diff_50svc_x_10k": bench_global_diff(np),
         "raft_replay_1m_x_5": bench_raft_replay(np),
         "host_micro": bench_host_micro(np),
+        "e2e_service_start_100r_3m_5w": e2e_row,
     }
     configs["grid_100k_x_10k"] = ns   # the north star IS this grid config
 

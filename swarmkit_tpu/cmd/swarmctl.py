@@ -321,6 +321,15 @@ def cmd_node_drain(args):
                                          NodeAvailability.DRAIN))
 
 
+def cmd_node_pause(args):
+    # pause: no NEW placements, existing tasks keep running (the scheduler
+    # filter only admits ACTIVE; drain additionally evicts)
+    from ..api.types import NodeAvailability
+
+    _set_node(args, lambda spec: setattr(spec, "availability",
+                                         NodeAvailability.PAUSE))
+
+
 def cmd_node_activate(args):
     from ..api.types import NodeAvailability
 
@@ -826,6 +835,7 @@ def main(argv=None) -> int:
     for name, fn in (("promote", cmd_node_promote),
                      ("demote", cmd_node_demote),
                      ("drain", cmd_node_drain),
+                     ("pause", cmd_node_pause),
                      ("activate", cmd_node_activate)):
         p = node.add_parser(name)
         p.add_argument("node")

@@ -25,11 +25,7 @@ from .restart import RestartSupervisor
 from .task import is_global, new_task, task_runnable
 
 
-def _node_eligible(node: Node, service: Service) -> bool:
-    if node.status.state != NodeStatusState.READY:
-        return False
-    if node.spec.availability != NodeAvailability.ACTIVE:
-        return False
+def _constraints_met(node: Node, service: Service) -> bool:
     exprs = service.spec.task.placement.constraints
     if exprs:
         try:
@@ -39,6 +35,30 @@ def _node_eligible(node: Node, service: Service) -> bool:
         if not constraint_mod.node_matches(constraints, node):
             return False
     return True
+
+
+def _node_eligible(node: Node, service: Service) -> bool:
+    """May a NEW global task be added (or a failed one restarted) here?
+    Reference global.go:389-392: PAUSE means no add/update."""
+    if node.status.state != NodeStatusState.READY:
+        return False
+    if node.spec.availability != NodeAvailability.ACTIVE:
+        return False
+    return _constraints_met(node, service)
+
+
+def _node_keeps_tasks(node: Node, service: Service) -> bool:
+    """May EXISTING global tasks keep running here? Distinct from
+    eligibility: a PAUSED node keeps its tasks (no add/update only), and
+    so does a transiently-UNKNOWN node (leadership change demotes every
+    node to UNKNOWN until it re-registers — evicting would churn all
+    global services on each election). Shutdown only on DOWN, DRAIN, or
+    constraints no longer met (global.go:383-392 + invalid-node check)."""
+    if node.status.state == NodeStatusState.DOWN:
+        return False
+    if node.spec.availability == NodeAvailability.DRAIN:
+        return False
+    return _constraints_met(node, service)
 
 
 class GlobalOrchestrator(EventLoopComponent):
@@ -92,10 +112,12 @@ class GlobalOrchestrator(EventLoopComponent):
             if not svcs or not nodes:
                 return
             S, N = len(svcs), len(nodes)
-            eligible = np.zeros((S, N), bool)
+            eligible = np.zeros((S, N), bool)   # gates ADDS
+            keeps = np.zeros((S, N), bool)      # gates SHUTDOWNS (pause keeps)
             for si, s in enumerate(svcs):
                 for ni, n in enumerate(nodes):
                     eligible[si, ni] = _node_eligible(n, s)
+                    keeps[si, ni] = _node_keeps_tasks(n, s)
             # two 'actual' sets, as in reconcile_service: create is gated on
             # RUNNABLE tasks; shutdown covers any task with desired<=RUNNING
             runnable_rows: list[list[int]] = []
@@ -122,7 +144,7 @@ class GlobalOrchestrator(EventLoopComponent):
                 return out
 
             create, _ = compute_diff(eligible, pack(runnable_rows))
-            _, shutdown = compute_diff(eligible, pack(active_rows))
+            _, shutdown = compute_diff(keeps, pack(active_rows))
             for si, s in enumerate(svcs):
                 for ni in np.flatnonzero(create[si]):
                     plan.append((s.id, nodes[ni].id, True))
@@ -154,6 +176,10 @@ class GlobalOrchestrator(EventLoopComponent):
                         if not exists:
                             tx.create(new_task(None, service, 0, node_id=nid))
                     else:
+                        node = tx.get_node(nid)
+                        if node is not None and \
+                                _node_keeps_tasks(node, service):
+                            return  # node recovered between scan and apply
                         for t in tx.find_tasks(by.ByServiceID(sid)):
                             if t.node_id != nid or \
                                     t.desired_state > TaskState.RUNNING:
@@ -203,7 +229,7 @@ class GlobalOrchestrator(EventLoopComponent):
                 if eligible and not existing:
                     t = new_task(None, service, 0, node_id=node.id)
                     tx.create(t)
-                elif not eligible:
+                elif not _node_keeps_tasks(node, service):
                     for t in by_node.get(node.id, []):
                         cur = tx.get_task(t.id)
                         if cur is not None and cur.desired_state < TaskState.SHUTDOWN:
@@ -231,7 +257,7 @@ class GlobalOrchestrator(EventLoopComponent):
                             if task_runnable(t)]
                 if eligible and not existing:
                     tx.create(new_task(None, service, 0, node_id=node_id))
-                elif not eligible:
+                elif not _node_keeps_tasks(node, service):
                     for t in by_service.get(service.id, []):
                         cur = tx.get_task(t.id)
                         if cur is not None and cur.desired_state < TaskState.SHUTDOWN:

@@ -264,3 +264,47 @@ def test_scheduler_backend_flags(tmp_path):
             proc.wait(timeout=5)
         except subprocess.TimeoutExpired:
             proc.kill()
+
+
+def test_node_pause_and_activate(daemon):
+    """node pause blocks NEW placements but keeps existing tasks running
+    (drain additionally evicts); activate restores schedulability."""
+    addr, ident = daemon["addr"], daemon["identity"]
+    out = _ctl(addr, ident, "node", "ls")
+    node_ref = out.splitlines()[1].split()[0]
+
+    _ctl(addr, ident, "service", "create", "--name", "pausetest",
+         "--command", "sleep 600", "--replicas", "1")
+    end = time.monotonic() + 30
+    while time.monotonic() < end:
+        if "1/1" in _ctl(addr, ident, "service", "ls"):
+            break
+        time.sleep(0.5)
+    assert "1/1" in _ctl(addr, ident, "service", "ls")
+
+    _ctl(addr, ident, "node", "pause", node_ref)
+    # existing task keeps running on the paused node
+    time.sleep(1.0)
+    assert "1/1" in _ctl(addr, ident, "service", "ls")
+    # new work cannot place (single-node cluster, node paused)
+    _ctl(addr, ident, "service", "create", "--name", "blocked",
+         "--command", "sleep 600", "--replicas", "1")
+    time.sleep(2.0)
+
+    def states(service):
+        out = _ctl(addr, ident, "task", "ls", "--service", service)
+        return [line.split()[2] for line in out.splitlines()[1:] if line]
+
+    assert all(s != "running" for s in states("blocked"))
+    assert "not available" in _ctl(addr, ident, "task", "ls",
+                                   "--service", "blocked")
+
+    _ctl(addr, ident, "node", "activate", node_ref)
+    end = time.monotonic() + 30
+    while time.monotonic() < end:
+        if any(s == "running" for s in states("blocked")):
+            break
+        time.sleep(0.5)
+    assert any(s == "running" for s in states("blocked"))
+    _ctl(addr, ident, "service", "rm", "pausetest")
+    _ctl(addr, ident, "service", "rm", "blocked")

@@ -196,3 +196,56 @@ def test_complete_job_not_restarted(cluster):
     # ON_FAILURE + exit 0: no replacements spawned
     tasks = cluster.store.view().find_tasks(by.ByServiceID("svc-oneshot"))
     assert len([t for t in tasks if t.status.state == TaskState.COMPLETE]) == 2
+
+
+def test_global_service_pause_keeps_tasks_drain_evicts(cluster):
+    """Reference global.go:383-392 availability semantics: PAUSE keeps a
+    node's global task running (no add/update only), DRAIN shuts it down,
+    re-ACTIVATE recreates it. A transiently-UNKNOWN node also keeps its
+    task (leadership changes demote all nodes to UNKNOWN — evicting would
+    churn every global service per election)."""
+    from swarmkit_tpu.api.types import NodeAvailability
+
+    cluster.behaviors["svc-gmon"] = {"run_forever": True}
+    cluster.create_service("gmon", mode=ServiceMode.GLOBAL)
+    assert wait_for(lambda: len(cluster.running_tasks("svc-gmon")) == 3,
+                    timeout=15)
+
+    def set_avail(node_id, avail):
+        def cb(tx):
+            n = tx.get_node(node_id).copy()
+            n.spec.availability = avail
+            tx.update(n)
+        cluster.store.update(cb)
+
+    # PAUSE: the task keeps running
+    set_avail("worker-0", NodeAvailability.PAUSE)
+    time.sleep(1.0)
+    running = cluster.running_tasks("svc-gmon")
+    assert len(running) == 3
+    assert any(t.node_id == "worker-0" for t in running)
+
+    # UNKNOWN status: the task keeps running too
+    def unknown(tx):
+        n = tx.get_node("worker-1").copy()
+        n.status.state = NodeStatusState.UNKNOWN
+        tx.update(n)
+    cluster.store.update(unknown)
+    time.sleep(1.0)
+    tasks = cluster.store.view().find_tasks(by.ByServiceID("svc-gmon"))
+    w1 = [t for t in tasks if t.node_id == "worker-1"
+          and t.desired_state <= TaskState.RUNNING]
+    assert w1, "UNKNOWN node's global task was evicted"
+
+    # DRAIN: the task is shut down
+    set_avail("worker-0", NodeAvailability.DRAIN)
+    assert wait_for(lambda: all(
+        t.desired_state > TaskState.RUNNING
+        for t in cluster.store.view().find_tasks(by.ByServiceID("svc-gmon"))
+        if t.node_id == "worker-0"), timeout=15)
+
+    # back to ACTIVE: a fresh task is created and runs again
+    set_avail("worker-0", NodeAvailability.ACTIVE)
+    assert wait_for(lambda: any(
+        t.node_id == "worker-0"
+        for t in cluster.running_tasks("svc-gmon")), timeout=20)
