@@ -734,6 +734,33 @@ def bench_host_micro(np):
         "sanity_drained_estimate": drained,
     }
 
+    # ---- heartbeat timers at the 10k-node design point ------------------
+    # (survey §7 hard part: per-node timers must ride a shared wheel, not
+    # one thread each — threading.Timer at 10k nodes is 10k threads)
+    import threading as _threading
+
+    from swarmkit_tpu.dispatcher.heartbeat import Heartbeat
+
+    hbs = [Heartbeat(60.0, lambda: None) for _ in range(10_000)]
+    threads_before = _threading.active_count()
+    t0 = time.perf_counter()
+    for hb in hbs:
+        hb.start()
+    arm_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(5):
+        for hb in hbs:
+            hb.beat()
+    beat_s = time.perf_counter() - t0
+    extra_threads = _threading.active_count() - threads_before
+    for hb in hbs:
+        hb.stop()
+    out["heartbeat_10k_nodes"] = {
+        "arm_per_s": round(10_000 / arm_s),
+        "beat_per_s": round(50_000 / beat_s),
+        "extra_threads": extra_threads,
+    }
+
     # ---- remotes Select/Observe at 3..27 peers --------------------------
     rng = _random.Random(3)
     rem = {}
