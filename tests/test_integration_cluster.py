@@ -363,9 +363,11 @@ def test_root_rotation_under_live_nodes(cluster):
                 and w1.security.root_ca.cert_pem == new_root)
 
     # renewal chains: session-plane root update -> node re-CSR -> signer
-    # pass -> credential swap, each on its own timer; loaded CI machines
-    # stretch every hop (wait_for returns early when healthy)
-    assert wait_for(renewed, timeout=120)
+    # pass -> credential swap, each on its own timer (1 s renewer cadence);
+    # a machine starved 5-10x by CPU burners stretches every hop, and 120 s
+    # was observed insufficient under 4 saturating processes (wait_for
+    # returns early when healthy)
+    assert wait_for(renewed, timeout=300)
 
     # the data plane survives rotation: scale the service up over the wire
     ctl = cluster.control()
