@@ -165,15 +165,12 @@ def _tick(enc, rp, infos, groups, batch, np):
 
 
 def _apply_wave(enc, rp, infos, p, counts, batch):
-    """What the scheduler's apply path does after a tick: one add_task per
-    placement, encoder fold, device correction bookkeeping."""
-    assignments = batch.materialize(p, counts)
+    """What the scheduler's apply path does after a tick: wave-bulk
+    NodeInfo bookkeeping, encoder fold, device correction bookkeeping."""
     by_node = {i.node.id: i for i in infos}
-    task_by_id = {t.id: t for g in p.groups for t in g.tasks}
-    n_added = 0
-    for tid, nid in assignments.items():
-        if by_node[nid].add_task(task_by_id[tid]):
-            n_added += 1
+    infos_arr = [by_node[nid] for nid in p.node_ids]
+    orders = batch.materialize_orders(p, counts)
+    n_added = batch.apply_wave(infos_arr, p.groups, orders)
     assert n_added == int(counts.sum())
     assert enc.apply_counts(p, counts)
     rp.after_apply(p, counts)
@@ -264,13 +261,7 @@ def bench_scheduler_config(np, placement_ops, batch, n_nodes, n_tasks,
         mat_s = time.perf_counter() - t0
         t0 = time.perf_counter()
         infos_arr = [by_node[nid] for nid in p.node_ids]
-        n_added = 0
-        for g, order in zip(p.groups, orders):
-            cells: dict[int, list] = {}
-            for t, ni in zip(g.tasks, order.tolist()):
-                cells.setdefault(ni, []).append(t)
-            for ni, cell in cells.items():
-                n_added += infos_arr[ni].add_tasks(cell)
+        n_added = batch.apply_wave(infos_arr, p.groups, orders)
         assert n_added == int(counts.sum())
         commit_phases.append((mat_s, time.perf_counter() - t0))
 

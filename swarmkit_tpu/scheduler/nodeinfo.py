@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import itertools
 import time
+from collections import Counter
 from dataclasses import dataclass, field
 
 from ..api.objects import Node, Task
@@ -34,7 +35,10 @@ class NodeInfo:
     node: Node
     tasks: dict[str, Task] = field(default_factory=dict)
     active_tasks_count: int = 0
-    active_tasks_count_by_service: dict[str, int] = field(default_factory=dict)
+    # Counter, not plain dict: the wave-bulk commit (batch.apply_placements)
+    # folds a node's per-service placements with one C-speed
+    # Counter.update over the segment's service names
+    active_tasks_count_by_service: Counter = field(default_factory=Counter)
     available_resources: Resources = field(default_factory=Resources)
     used_host_ports: set[tuple[str, int]] = field(default_factory=set)
     # task id -> {kind: (named ids granted, discrete count granted)}
@@ -107,58 +111,18 @@ class NodeInfo:
         res = task_reservations(t.spec)
         self.available_resources.memory_bytes -= res.memory_bytes
         self.available_resources.nano_cpus -= res.nano_cpus
-        self.generic_assignments[t.id] = self._claim_generic(res)
+        assigned = self._claim_generic(res)
+        if assigned:
+            # empty claims are not stored: remove_task/assigned_generic
+            # default to {}, and the wave-bulk path (batch.apply_wave)
+            # must land bit-identical state without per-task dict churn
+            self.generic_assignments[t.id] = assigned
         for port in self._host_ports(t):
             self.used_host_ports.add(port)
         if t.desired_state <= TaskState.COMPLETE:
             self.active_tasks_count += 1
             self._bump_service(t.service_id, +1)
         return True
-
-    def add_tasks(self, tasks: list) -> int:
-        """Bulk add of NEW same-spec tasks — a scheduler wave's (group,
-        node) cell. Returns the number added (== add_task returning True
-        that many times; mutations bumps once per task, preserving the
-        encoder fingerprint contract).
-
-        Fast path: all ids unknown, a shared spec object with no generic
-        reservations, no host-published ports — one resource subtract and
-        one service bump cover the batch. Anything else falls back to
-        per-task add_task (per-task generic claims and port sets need the
-        full path)."""
-        if not tasks:
-            return 0
-        if len(tasks) == 1:              # degenerate cell: skip the scans
-            return 1 if self.add_task(tasks[0]) else 0
-        t0 = tasks[0]
-        res = task_reservations(t0.spec)
-        # reservations compared by VALUE: the commit path deepcopies each
-        # task (store objects), so same-group tasks share spec content,
-        # never spec identity
-        def same_res(t):
-            r = task_reservations(t.spec)
-            return (r.nano_cpus == res.nano_cpus
-                    and r.memory_bytes == res.memory_bytes
-                    and not r.generic)
-        fast = (not res.generic
-                and all(same_res(t) for t in tasks)
-                and all(not self._host_ports(t) for t in tasks)
-                and all(t.id not in self.tasks for t in tasks)
-                and len({t.id for t in tasks}) == len(tasks)
-                and all(t.service_id == t0.service_id for t in tasks)
-                and all(t.desired_state <= TaskState.COMPLETE
-                        for t in tasks))
-        if not fast:
-            return sum(1 for t in tasks if self.add_task(t))
-        n = len(tasks)
-        self.mutations += n
-        self.tasks.update((t.id, t) for t in tasks)
-        self.available_resources.memory_bytes -= res.memory_bytes * n
-        self.available_resources.nano_cpus -= res.nano_cpus * n
-        self.generic_assignments.update((t.id, {}) for t in tasks)
-        self.active_tasks_count += n
-        self._bump_service(t0.service_id, +n)
-        return n
 
     def assigned_generic(self, task_id: str) -> dict[str, tuple[frozenset, int]]:
         """What a placed task was granted: kind -> (named ids, discrete count).

@@ -25,6 +25,8 @@ import threading
 import time
 from collections import defaultdict
 
+import numpy as np
+
 from ..api.objects import (
     EventCommit,
     EventCreate,
@@ -37,7 +39,7 @@ from ..api.types import NodeStatusState, TaskState
 from ..store import by
 from ..store.memory import MemoryStore
 from ..store.watch import ChannelClosed
-from .batch import cpu_schedule_encoded, materialize_orders
+from .batch import apply_placements, cpu_schedule_encoded, materialize_orders
 from .encode import IncrementalEncoder, TaskGroup
 from .filters import Pipeline
 from .nodeinfo import NodeInfo
@@ -398,10 +400,8 @@ class Scheduler:
             # counter — without this its phantom reservations persist),
             # resync the device, and discard any dispatch built on the
             # bad fold
-            import numpy as _np
-
             self.encoder.force_numeric_reencode(
-                _np.flatnonzero(counts.sum(axis=0)))
+                np.flatnonzero(counts.sum(axis=0)))
             self._resident.invalidate()
             if self._inflight is not None:
                 _p2, h2, _ids2 = self._inflight
@@ -455,7 +455,8 @@ class Scheduler:
         the return value says whether the commit was clean (exactly one
         add_task per decided placement)."""
         groups = problem.groups
-        applied: list[tuple[Task, str, int]] = []
+        # gi -> [(committed task, node index)] for successful assignments
+        applied_by_group: dict[int, list[tuple[Task, int]]] = {}
         # tasks no longer schedulable (deleted, dead, raced to assigned
         # elsewhere) — evicted from the unassigned pool after the batch;
         # conflicted decisions are NOT dropped and retry next tick
@@ -469,9 +470,11 @@ class Scheduler:
                 order = orders[gi]
                 n_placed = len(order)
                 for ti, task in enumerate(group.tasks):
-                    node_id = node_ids[order[ti]] if ti < n_placed else None
+                    ni = int(order[ti]) if ti < n_placed else -1
+                    node_id = node_ids[ni] if ni >= 0 else None
 
-                    def update_one(tx, task=task, node_id=node_id, group=group, gi=gi):
+                    def update_one(tx, task=task, node_id=node_id, ni=ni,
+                                   group=group, gi=gi):
                         cur = tx.get_task(task.id)
                         if cur is None or cur.desired_state > TaskState.COMPLETE:
                             drop.append(task.id)
@@ -504,29 +507,34 @@ class Scheduler:
                         cur.status.message = "scheduler assigned task to node"
                         cur.status.timestamp = time.time()
                         tx.update(cur)
-                        applied.append((cur, node_id, gi))
+                        applied_by_group.setdefault(gi, []).append((cur, ni))
 
                     batch.update(update_one)
 
         self.store.batch(batch_cb)
 
         with_generic: list[tuple[str, str]] = []
-        n_added = 0
-        # bulk the NodeInfo bookkeeping by (node, group) cell — one wave
-        # commonly places many same-group (same reservations) tasks per
-        # node and the per-task add_task loop was the commit's hot spot.
-        # Grouping is by GROUP index, not spec identity: the in-tx commit
-        # deepcopied every task, so spec objects are never shared.
-        cells: dict[tuple[str, int], list[Task]] = {}
-        for task, node_id, gi in applied:
-            self.unassigned.pop(task.id, None)
-            if task.spec.resources.reservations.generic:
-                with_generic.append((task.id, node_id))
-            cells.setdefault((node_id, gi), []).append(task)
-        for (node_id, _gi), cell in cells.items():
-            info = self.node_infos.get(node_id)
-            if info:
-                n_added += info.add_tasks(cell)
+        # wave-level NodeInfo bookkeeping (batch.apply_placements): the
+        # per-task add_task loop was the commit's hot spot — typical big
+        # waves degenerate to ~1 task per (group, node) cell, so the bulk
+        # path segments per node across the whole wave. Groups with
+        # generic reservations or host ports keep the full per-task path
+        # inside apply_placements.
+        placed_groups = []
+        for gi, placed in applied_by_group.items():
+            group = groups[gi]
+            for task, _ni in placed:
+                self.unassigned.pop(task.id, None)
+            if group.tasks[0].spec.resources.reservations.generic:
+                with_generic.extend(
+                    (task.id, node_ids[ni]) for task, ni in placed)
+            placed_groups.append(
+                (group.tasks[0], [t for t, _ in placed],
+                 np.fromiter((ni for _, ni in placed), np.int64,
+                             len(placed))))
+        n_added = apply_placements(
+            [self.node_infos.get(nid) for nid in node_ids],
+            placed_groups) if placed_groups else 0
         # fold our own placements back into the encoder's cached rows
         # (vectorized) iff every decided placement landed as exactly one
         # add_task; otherwise let the fingerprint delta re-encode the

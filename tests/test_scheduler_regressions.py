@@ -222,50 +222,74 @@ def test_failure_window_capped():
     assert info.penalized(key, now=1100.0)
 
 
-def test_add_tasks_bulk_equals_serial_add_task():
-    """NodeInfo.add_tasks must leave state BIT-identical to the per-task
-    add_task sequence — mutations counter included (the encoder
-    fingerprint contract) — across fast-path and every fallback flavor:
-    generic reservations, host ports, re-adds, mixed desired states."""
+def _assert_info_state_equal(a, b):
+    assert a.mutations == b.mutations
+    assert a.active_tasks_count == b.active_tasks_count
+    assert a.active_tasks_count_by_service == b.active_tasks_count_by_service
+    assert a.available_resources.nano_cpus == b.available_resources.nano_cpus
+    assert a.available_resources.memory_bytes == b.available_resources.memory_bytes
+    assert a.available_resources.generic == b.available_resources.generic
+    assert a.available_resources.named_generic == b.available_resources.named_generic
+    assert a.used_host_ports == b.used_host_ports
+    assert set(a.tasks) == set(b.tasks)
+    assert a.generic_assignments == b.generic_assignments
+
+
+def test_apply_wave_equals_serial_add_task():
+    """batch.apply_wave must leave every NodeInfo BIT-identical to the
+    per-task add_task sequence — mutations counter included (the encoder
+    fingerprint contract) — across the bulk cell path and every per-task
+    flavor: generic reservations, host ports, id-collision fallback, and
+    removed (None) nodes."""
     import random
 
+    import numpy as np
+
+    from swarmkit_tpu.api.specs import EndpointSpec, PortConfig
+    from swarmkit_tpu.scheduler.batch import apply_wave
+    from swarmkit_tpu.scheduler.encode import TaskGroup
     from test_encoder_incremental import make_info, make_task
 
     for seed in range(6):
+        n_nodes = 5
         rng_a, rng_b = random.Random(seed), random.Random(seed)
-        a, b = make_info(rng_a, 0), make_info(rng_b, 0)
+        infos_a = [make_info(rng_a, i) for i in range(n_nodes)]
+        infos_b = [make_info(rng_b, i) for i in range(n_nodes)]
+        if seed % 2:
+            infos_a[3] = infos_b[3] = None   # node gone mid-wave
 
         rng = random.Random(100 + seed)
-        waves = []
-        for w in range(5):
+        groups, orders = [], []
+        for gi in range(4):
             svc = f"svc-{rng.randrange(3):03d}"
-            tasks = [make_task(rng, svc, w * 100 + i)
-                     for i in range(rng.randint(1, 6))]
+            tasks = [make_task(rng, svc, seed * 1000 + gi * 100 + i)
+                     for i in range(rng.randint(1, 12))]
             shared = tasks[0].spec
-            for t in tasks[1:]:
-                if rng.random() < 0.8:
-                    t.spec = shared          # same-spec cell (fast path)
-            if rng.random() < 0.3:           # force a fallback flavor
-                tasks[0].spec.resources.reservations.generic = {"gpu": 1}
-            if rng.random() < 0.3:
-                from swarmkit_tpu.api.specs import EndpointSpec, PortConfig
-                tasks[0].endpoint = EndpointSpec(ports=[PortConfig(
-                    protocol="tcp", target_port=80,
-                    published_port=9000 + w, publish_mode="host")])
-            if rng.random() < 0.3 and waves:
-                tasks.append(waves[-1][rng.randrange(len(waves[-1]))])  # re-add
-            waves.append(tasks)
+            for t in tasks:
+                t.spec = shared              # group = shared spec content
+                t.service_id = svc
+            if rng.random() < 0.25:          # per-task flavor: generic
+                shared.resources.reservations.generic = {"gpu": 1}
+            if rng.random() < 0.25:          # per-task flavor: host port
+                for t in tasks:
+                    t.endpoint = EndpointSpec(ports=[PortConfig(
+                        protocol="tcp", target_port=80,
+                        published_port=9000 + gi, publish_mode="host")])
+            n_placed = rng.randint(0, len(tasks))  # tail stays unplaced
+            order = np.array([rng.randrange(n_nodes)
+                              for _ in range(n_placed)], np.int64)
+            groups.append(TaskGroup(service_id=svc, spec_version=1,
+                                    tasks=tasks))
+            orders.append(order)
 
-        for tasks in waves:
-            n_a = a.add_tasks(tasks)
-            n_b = sum(1 for t in tasks if b.add_task(t))
-            assert n_a == n_b
-        assert a.mutations == b.mutations
-        assert a.active_tasks_count == b.active_tasks_count
-        assert a.active_tasks_count_by_service == b.active_tasks_count_by_service
-        assert a.available_resources.nano_cpus == b.available_resources.nano_cpus
-        assert a.available_resources.memory_bytes == b.available_resources.memory_bytes
-        assert a.available_resources.generic == b.available_resources.generic
-        assert a.used_host_ports == b.used_host_ports
-        assert set(a.tasks) == set(b.tasks)
-        assert a.generic_assignments == b.generic_assignments
+            repeats = 2 if rng.random() < 0.3 else 1
+            for _ in range(repeats):         # repeat = double-commit: every
+                n_b = 0                      # cell collides, per-task heal
+                for t, ni in zip(tasks, order.tolist()):
+                    if infos_b[ni] is not None and infos_b[ni].add_task(t):
+                        n_b += 1
+                n_a = apply_wave(infos_a, [groups[-1]], [order])
+                assert n_a == n_b
+        for a, b in zip(infos_a, infos_b):
+            if a is not None:
+                _assert_info_state_equal(a, b)
