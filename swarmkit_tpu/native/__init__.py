@@ -1,0 +1,91 @@
+"""Native host-runtime components (C, built lazily with the system
+compiler).
+
+The TPU compute path is JAX/XLA (ops/); the host runtime around it is
+Python with C for the measured hot loops, mirroring how the reference
+leans on Go's compiled speed for its per-task bookkeeping walks
+(manager/scheduler/scheduler.go:330-346). Build is a single `cc -O2
+-shared` against the CPython headers — no pip, no setuptools — done
+once on first import and cached next to the source; concurrent
+processes race safely (unique temp + atomic rename). Everything using
+this module falls back to the pure-Python implementation when the
+compiler or headers are unavailable (or SWARMKIT_TPU_NO_NATIVE=1), so
+the framework never *requires* the toolchain.
+"""
+from __future__ import annotations
+
+import importlib.util
+import logging
+import os
+import shutil
+import subprocess
+import sysconfig
+import tempfile
+from importlib.machinery import ExtensionFileLoader
+
+log = logging.getLogger("swarmkit_tpu.native")
+
+_DIR = os.path.dirname(__file__)
+_SRC = os.path.join(_DIR, "_hostops.c")
+_SO = os.path.join(_DIR, "_hostops.so")
+
+
+def _build() -> bool:
+    cc = next((c for c in ("cc", "gcc", "g++") if shutil.which(c)), None)
+    if cc is None:
+        log.info("native: no C compiler; using pure-Python fallbacks")
+        return False
+    include = sysconfig.get_paths()["include"]
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+    os.close(fd)
+    cmd = [cc, "-O2", "-fPIC", "-shared", "-I", include, _SRC, "-o", tmp]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+        if proc.returncode != 0:
+            log.warning("native: build failed; using pure-Python "
+                        "fallbacks\n%s", proc.stderr[-2000:])
+            return False
+        os.replace(tmp, _SO)           # atomic: concurrent builders race
+        return True                    # safely to an identical artifact
+    except Exception as exc:
+        log.warning("native: build error (%s); using fallbacks", exc)
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+def _exec():
+    loader = ExtensionFileLoader("swarmkit_tpu.native._hostops", _SO)
+    spec = importlib.util.spec_from_loader(loader.name, loader, origin=_SO)
+    mod = importlib.util.module_from_spec(spec)
+    loader.exec_module(mod)
+    return mod
+
+
+def _load():
+    if os.environ.get("SWARMKIT_TPU_NO_NATIVE"):
+        return None
+    try:
+        fresh = (os.path.exists(_SO)
+                 and os.path.getmtime(_SO) >= os.path.getmtime(_SRC))
+        if not fresh and not _build():
+            return None
+        try:
+            return _exec()
+        except Exception:
+            # e.g. a stale .so from a previous interpreter ABI: rebuild
+            # once and retry rather than silently losing the native path
+            if not _build():
+                return None
+            return _exec()
+    except Exception as exc:              # never let native break the host
+        log.warning("native: load failed (%s); using fallbacks", exc)
+        return None
+
+
+hostops = _load()

@@ -1,0 +1,406 @@
+/* Native host-runtime hot loops.
+ *
+ * The TPU kernels (ops/) own the placement math; this module owns the
+ * host-side bookkeeping loop that commits a scheduler wave onto the
+ * per-node NodeInfo tables (scheduler/batch.py apply_placements).  At
+ * 1M placements the pure-Python segment walk spends ~1.2 s in
+ * interpreter overhead (attribute chases, per-object dict ops); this C
+ * walk does the same work through the CPython API with each task's id
+ * fetched exactly once and by-service counts bumped once per
+ * (node, group) run.  The Python implementation stays as the reference
+ * oracle and fallback — tests assert bit-identical results
+ * (tests/test_native_hostops.py).
+ *
+ * Reference analogue: the per-task updateNodeInfo walk in
+ * manager/scheduler/scheduler.go:330-346 (Go pays a cheap struct walk;
+ * CPython needs native help to match it).
+ *
+ * Semantics mirrored exactly from batch.apply_placements:
+ *   per node segment [a,b) of the node-major-sorted wave:
+ *     - None info (node removed between encode and commit): skipped,
+ *       uncounted;
+ *     - any id collision with tasks already on the node: the whole
+ *       segment goes through the Python fallback callable (per-task
+ *       NodeInfo.add_task, which does its own bookkeeping);
+ *     - otherwise: tasks dict inserts, mutations/active counters += k,
+ *       exact per-node int64 resource decrements, and by-service
+ *       Counter increments keyed by each task's group service id.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+
+static PyObject *s_tasks, *s_id, *s_mutations, *s_active, *s_avail,
+    *s_svccnt, *s_mem, *s_cpus;
+
+/* obj.<attr> += delta for plain Python-int attributes. */
+static int
+add_int_attr(PyObject *obj, PyObject *attr, long long delta)
+{
+    PyObject *cur, *nv;
+    long long v;
+
+    if (delta == 0)
+        return 0;
+    cur = PyObject_GetAttr(obj, attr);
+    if (cur == NULL)
+        return -1;
+    v = PyLong_AsLongLong(cur);
+    Py_DECREF(cur);
+    if (v == -1 && PyErr_Occurred())
+        return -1;
+    nv = PyLong_FromLongLong(v + delta);
+    if (nv == NULL)
+        return -1;
+    if (PyObject_SetAttr(obj, attr, nv) < 0) {
+        Py_DECREF(nv);
+        return -1;
+    }
+    Py_DECREF(nv);
+    return 0;
+}
+
+/* counter[key] += delta on a dict (Counter is a dict subclass; missing
+ * key counts as 0, matching Counter semantics). */
+static int
+bump_counter(PyObject *counter, PyObject *key, long long delta)
+{
+    PyObject *cur, *nv;
+    long long v = 0;
+
+    cur = PyDict_GetItemWithError(counter, key);    /* borrowed */
+    if (cur == NULL) {
+        if (PyErr_Occurred())
+            return -1;
+    } else {
+        v = PyLong_AsLongLong(cur);
+        if (v == -1 && PyErr_Occurred())
+            return -1;
+    }
+    nv = PyLong_FromLongLong(v + delta);
+    if (nv == NULL)
+        return -1;
+    if (PyDict_SetItem(counter, key, nv) < 0) {
+        Py_DECREF(nv);
+        return -1;
+    }
+    Py_DECREF(nv);
+    return 0;
+}
+
+static void
+decref_ids(PyObject **ids, Py_ssize_t n)
+{
+    Py_ssize_t m;
+
+    for (m = 0; m < n; m++)
+        Py_DECREF(ids[m]);
+}
+
+/* Hand one segment to the Python per-task path (borrowed task
+ * pointers); returns tasks added, or -1 with an exception set. */
+static long long
+fallback_segment(PyObject *fallback, PyObject *info, PyObject **tasks,
+                 Py_ssize_t k)
+{
+    PyObject *seg, *r;
+    Py_ssize_t m;
+    long long added;
+
+    seg = PyTuple_New(k);
+    if (seg == NULL)
+        return -1;
+    for (m = 0; m < k; m++) {
+        Py_INCREF(tasks[m]);
+        PyTuple_SET_ITEM(seg, m, tasks[m]);
+    }
+    r = PyObject_CallFunctionObjArgs(fallback, info, seg, NULL);
+    Py_DECREF(seg);
+    if (r == NULL)
+        return -1;
+    added = PyLong_AsLongLong(r);
+    Py_DECREF(r);
+    if (added == -1 && PyErr_Occurred())
+        return -1;
+    return added;
+}
+
+static PyObject *
+apply_segments(PyObject *self, PyObject *args)
+{
+    PyObject *infos, *tasks_all, *svc_of, *fallback;
+    Py_buffer oi_b, nodes_b, bounds_b, mem_b, cpu_b, gidx_b;
+    const int64_t *oi, *nodes, *bounds, *mem, *cpu, *gidx;
+    Py_ssize_t n_seg, n_infos, n_tasks, n_svc, si;
+    long long n_added = 0;
+    PyObject *ret = NULL;
+    PyObject **ids = NULL;
+
+    if (!PyArg_ParseTuple(args, "O!O!y*y*y*y*y*y*O!O",
+                          &PyList_Type, &infos, &PyList_Type, &tasks_all,
+                          &oi_b, &nodes_b, &bounds_b, &mem_b, &cpu_b,
+                          &gidx_b, &PyList_Type, &svc_of, &fallback))
+        return NULL;
+
+    oi = (const int64_t *)oi_b.buf;
+    nodes = (const int64_t *)nodes_b.buf;
+    bounds = (const int64_t *)bounds_b.buf;
+    mem = (const int64_t *)mem_b.buf;
+    cpu = (const int64_t *)cpu_b.buf;
+    gidx = (const int64_t *)gidx_b.buf;
+    n_seg = (Py_ssize_t)(bounds_b.len / (Py_ssize_t)sizeof(int64_t)) - 1;
+    n_infos = PyList_GET_SIZE(infos);
+    n_tasks = PyList_GET_SIZE(tasks_all);
+    n_svc = PyList_GET_SIZE(svc_of);
+
+    if (oi_b.len != nodes_b.len || gidx_b.len != nodes_b.len
+        || mem_b.len != cpu_b.len
+        || mem_b.len != n_infos * (Py_ssize_t)sizeof(int64_t)) {
+        PyErr_SetString(PyExc_ValueError, "apply_segments: length mismatch");
+        goto done;
+    }
+
+    /* scratch buffers: each task pointer (borrowed, upper half) and id
+     * (owned, lower half) fetched exactly once per segment — the wave
+     * is a random-order gather over millions of heap objects, so every
+     * avoided re-walk is an avoided cache-miss chain */
+    ids = (PyObject **)PyMem_Malloc(
+        (size_t)(n_tasks > 0 ? 2 * n_tasks : 2) * sizeof(PyObject *));
+    if (ids == NULL) {
+        PyErr_NoMemory();
+        goto done;
+    }
+
+    for (si = 0; si < n_seg; si++) {
+        int64_t a = bounds[si], b = bounds[si + 1], node;
+        Py_ssize_t k = (Py_ssize_t)(b - a), m, filled = 0, run;
+        PyObject *info, *tdict, *counter;
+        int collide = 0, err = 0;
+
+        if (a < 0 || b > (int64_t)n_tasks || a >= b) {
+            PyErr_SetString(PyExc_ValueError,
+                            "apply_segments: bad segment bounds");
+            goto done;
+        }
+        node = nodes[a];
+        if (node < 0 || node >= (int64_t)n_infos) {
+            PyErr_SetString(PyExc_IndexError,
+                            "apply_segments: node out of range");
+            goto done;
+        }
+        info = PyList_GET_ITEM(infos, node);            /* borrowed */
+        if (info == Py_None)
+            continue;
+
+        tdict = PyObject_GetAttr(info, s_tasks);
+        if (tdict == NULL)
+            goto done;
+        if (!PyDict_Check(tdict)) {
+            Py_DECREF(tdict);
+            PyErr_SetString(PyExc_TypeError,
+                            "apply_segments: NodeInfo.tasks is not a dict");
+            goto done;
+        }
+
+        /* pass 1: gather task pointers + ids (owned refs) + collision
+         * scan */
+        for (m = 0; m < k; m++) {
+            PyObject *task, *tid;
+            int c;
+
+            if (oi[a + m] < 0 || oi[a + m] >= (int64_t)n_tasks) {
+                PyErr_SetString(PyExc_IndexError,
+                                "apply_segments: oi out of range");
+                err = 1;
+                break;
+            }
+            task = PyList_GET_ITEM(tasks_all, oi[a + m]);   /* borrowed */
+            tid = PyObject_GetAttr(task, s_id);
+            if (tid == NULL) {
+                err = 1;
+                break;
+            }
+            ids[m] = tid;
+            ids[n_tasks + m] = task;
+            filled = m + 1;
+            c = PyDict_Contains(tdict, tid);
+            if (c < 0) {
+                err = 1;
+                break;
+            }
+            if (c) {
+                collide = 1;
+                break;
+            }
+        }
+        if (err) {
+            decref_ids(ids, filled);
+            Py_DECREF(tdict);
+            goto done;
+        }
+
+        if (collide) {
+            /* healed double-commit etc.: hand the whole segment to the
+             * per-task Python path, which does its own bookkeeping */
+            long long added;
+
+            decref_ids(ids, filled);
+            Py_DECREF(tdict);
+            for (m = filled; m < k; m++) {      /* finish the gather */
+                if (oi[a + m] < 0 || oi[a + m] >= (int64_t)n_tasks) {
+                    PyErr_SetString(PyExc_IndexError,
+                                    "apply_segments: oi out of range");
+                    goto done;
+                }
+                ids[n_tasks + m] = PyList_GET_ITEM(tasks_all, oi[a + m]);
+            }
+            added = fallback_segment(fallback, info, ids + n_tasks, k);
+            if (added < 0)
+                goto done;
+            n_added += added;
+            continue;
+        }
+
+        counter = PyObject_GetAttr(info, s_svccnt);
+        if (counter == NULL) {
+            decref_ids(ids, k);
+            Py_DECREF(tdict);
+            goto done;
+        }
+        if (!PyDict_Check(counter)) {   /* Counter is a dict subclass */
+            PyErr_SetString(PyExc_TypeError,
+                            "apply_segments: by-service counts not a dict");
+            err = 1;
+        }
+
+        /* pass 2a: dict inserts, detecting duplicate ids WITHIN the
+         * wave (contract breach): the dict dedups silently, but the
+         * counters below would double-count */
+        {
+            int dup = 0;
+
+            for (m = 0; !err && m < k; m++) {
+                Py_ssize_t sz = PyDict_GET_SIZE(tdict);
+
+                if (PyDict_SetItem(tdict, ids[m], ids[n_tasks + m]) < 0)
+                    err = 1;
+                else if (PyDict_GET_SIZE(tdict) == sz) {
+                    dup = 1;
+                    break;
+                }
+            }
+            if (!err && dup) {
+                /* undo this segment's inserts, heal through the
+                 * per-task path (its re-add logic counts each id once,
+                 * bit-identical to the serial oracle) */
+                for (m = 0; !err && m < k; m++) {
+                    int c = PyDict_Contains(tdict, ids[m]);
+
+                    if (c < 0
+                        || (c && PyDict_DelItem(tdict, ids[m]) < 0))
+                        err = 1;
+                }
+                decref_ids(ids, k);
+                Py_DECREF(tdict);
+                Py_DECREF(counter);
+                if (err)
+                    goto done;
+                {
+                    long long added = fallback_segment(fallback, info,
+                                                       ids + n_tasks, k);
+
+                    if (added < 0)
+                        goto done;
+                    n_added += added;
+                }
+                continue;
+            }
+        }
+
+        /* pass 2b: one counter bump per (node, group) run (the sort is
+         * node-major then group-stable, so equal gidx values are
+         * contiguous within the segment) */
+        run = 0;
+        for (m = 0; !err && m <= k; m++) {
+            if (m == k || gidx[a + m] != gidx[a + run]) {
+                int64_t g = gidx[a + run];
+
+                if (g < 0 || g >= (int64_t)n_svc) {
+                    PyErr_SetString(PyExc_IndexError,
+                                    "apply_segments: gidx out of range");
+                    err = 1;
+                    break;
+                }
+                if (bump_counter(counter, PyList_GET_ITEM(svc_of, g),
+                                 (long long)(m - run)) < 0) {
+                    err = 1;
+                    break;
+                }
+                run = m;
+            }
+        }
+        decref_ids(ids, k);
+        Py_DECREF(tdict);
+        Py_DECREF(counter);
+        if (err)
+            goto done;
+
+        if (add_int_attr(info, s_mutations, (long long)k) < 0
+            || add_int_attr(info, s_active, (long long)k) < 0)
+            goto done;
+        {
+            PyObject *ar = PyObject_GetAttr(info, s_avail);
+
+            if (ar == NULL)
+                goto done;
+            if (add_int_attr(ar, s_mem, -mem[node]) < 0
+                || add_int_attr(ar, s_cpus, -cpu[node]) < 0) {
+                Py_DECREF(ar);
+                goto done;
+            }
+            Py_DECREF(ar);
+        }
+        n_added += (long long)k;
+    }
+    ret = PyLong_FromLongLong(n_added);
+
+done:
+    if (ids != NULL)
+        PyMem_Free(ids);
+    PyBuffer_Release(&oi_b);
+    PyBuffer_Release(&nodes_b);
+    PyBuffer_Release(&bounds_b);
+    PyBuffer_Release(&mem_b);
+    PyBuffer_Release(&cpu_b);
+    PyBuffer_Release(&gidx_b);
+    return ret;
+}
+
+static PyMethodDef methods[] = {
+    {"apply_segments", apply_segments, METH_VARARGS,
+     "apply_segments(infos, tasks_all, oi, nodes_srt, seg_bounds, "
+     "mem_by_node, cpu_by_node, gidx_srt, svc_of, fallback) -> added"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_hostops",
+    "Native host-runtime hot loops for swarmkit_tpu", -1, methods,
+};
+
+PyMODINIT_FUNC
+PyInit__hostops(void)
+{
+    s_tasks = PyUnicode_InternFromString("tasks");
+    s_id = PyUnicode_InternFromString("id");
+    s_mutations = PyUnicode_InternFromString("mutations");
+    s_active = PyUnicode_InternFromString("active_tasks_count");
+    s_avail = PyUnicode_InternFromString("available_resources");
+    s_svccnt = PyUnicode_InternFromString("active_tasks_count_by_service");
+    s_mem = PyUnicode_InternFromString("memory_bytes");
+    s_cpus = PyUnicode_InternFromString("nano_cpus");
+    if (!s_tasks || !s_id || !s_mutations || !s_active || !s_avail
+        || !s_svccnt || !s_mem || !s_cpus)
+        return NULL;
+    return PyModule_Create(&moduledef);
+}

@@ -1,0 +1,144 @@
+"""Native host-ops (swarmkit_tpu/native): build, load, and bit-parity.
+
+The C segment walk must be indistinguishable from the pure-Python walk
+in batch.apply_placements — same NodeInfo end state, same return value —
+across plain cells, collisions (double-commit heal), removed nodes, and
+the per-task port/generic flavors. The Python walk is itself fuzzed
+against serial add_task in test_scheduler_regressions, so transitivity
+covers native == serial too; this file pins native == python directly
+on identical inputs.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from swarmkit_tpu import native
+from swarmkit_tpu.scheduler import batch
+from swarmkit_tpu.scheduler.encode import TaskGroup
+
+from test_encoder_incremental import make_info, make_task
+from test_scheduler_regressions import _assert_info_state_equal
+
+
+def test_native_module_builds_and_loads():
+    """The baked toolchain must produce the extension — a silent
+    fallback to Python in this environment would be a perf regression
+    the suite should catch, not hide."""
+    assert native.hostops is not None, "native _hostops failed to build"
+    assert hasattr(native.hostops, "apply_segments")
+
+
+@pytest.mark.skipif(native.hostops is None, reason="no native build")
+def test_native_matches_python_walk():
+    for seed in range(8):
+        n_nodes = 6
+        rng_a, rng_b = random.Random(seed), random.Random(seed)
+        infos_n = [make_info(rng_a, i) for i in range(n_nodes)]
+        infos_p = [make_info(rng_b, i) for i in range(n_nodes)]
+        if seed % 2:
+            infos_n[2] = infos_p[2] = None
+
+        rng = random.Random(500 + seed)
+        for wave in range(4):
+            placed_n, placed_p = [], []
+            for gi in range(rng.randint(1, 3)):
+                svc = f"svc-{rng.randrange(3):03d}"
+                tasks = [make_task(rng, svc, seed * 10000 + wave * 1000
+                                   + gi * 100 + i)
+                         for i in range(rng.randint(1, 10))]
+                shared = tasks[0].spec
+                for t in tasks:
+                    t.spec = shared
+                    t.service_id = svc
+                order = np.array([rng.randrange(n_nodes) for _ in tasks],
+                                 np.int64)
+                placed_n.append((tasks[0], tasks, order))
+                placed_p.append((tasks[0], tasks, order))
+            repeats = 2 if rng.random() < 0.4 else 1
+            for _ in range(repeats):     # repeat = all-collision heal path
+                saved, batch._hostops = batch._hostops, None
+                try:
+                    n_p = batch.apply_placements(infos_p, placed_p)
+                finally:
+                    batch._hostops = saved
+                n_n = batch.apply_placements(infos_n, placed_n)
+                assert n_n == n_p
+        for a, b in zip(infos_n, infos_p):
+            if a is not None:
+                _assert_info_state_equal(a, b)
+
+
+def _dup_wave(rng_seed):
+    """A wave that repeats one task id within a single segment."""
+    rng = random.Random(rng_seed)
+    tasks = [make_task(rng, "svc-dup", i) for i in range(6)]
+    shared = tasks[0].spec
+    for t in tasks:
+        t.spec = shared
+        t.service_id = "svc-dup"
+    tasks[4] = tasks[1]                  # same id twice in the wave
+    order = np.zeros(len(tasks), np.int64)   # all on node 0
+    return [(tasks[0], tasks, order)], tasks
+
+
+@pytest.mark.parametrize("use_native", [False, True])
+def test_duplicate_id_within_wave_heals_to_oracle(use_native):
+    """A task id repeated inside one wave must count once (the serial
+    add_task oracle's re-add semantics), not double-count the bulk
+    counters — in both the Python and native walks."""
+    if use_native and native.hostops is None:
+        pytest.skip("no native build")
+    rng_a, rng_b = random.Random(1), random.Random(1)
+    info_bulk = [make_info(rng_a, 0)]
+    info_oracle = [make_info(rng_b, 0)]
+    placed, tasks = _dup_wave(7)
+
+    saved = batch._hostops
+    batch._hostops = native.hostops if use_native else None
+    try:
+        n_bulk = batch.apply_placements(info_bulk, placed)
+    finally:
+        batch._hostops = saved
+    n_oracle = sum(1 for t in tasks if info_oracle[0].add_task(t))
+    assert n_bulk == n_oracle == len(tasks) - 1
+    _assert_info_state_equal(info_bulk[0], info_oracle[0])
+
+
+def test_length_mismatch_raises():
+    rng = random.Random(2)
+    info = [make_info(rng, 0)]
+    t = make_task(rng, "svc-x", 0)
+    with pytest.raises(ValueError, match="length mismatch|node indices"):
+        batch.apply_placements(info, [(t, [t], np.zeros(2, np.int64))])
+
+
+@pytest.mark.skipif(native.hostops is None, reason="no native build")
+def test_native_survives_group_scale():
+    """Many tiny cells across many groups (the degenerate big-wave shape
+    that motivated the bulk path) — native vs python on ~6k placements."""
+    rng_a, rng_b = random.Random(9), random.Random(9)
+    n_nodes = 40
+    infos_n = [make_info(rng_a, i) for i in range(n_nodes)]
+    infos_p = [make_info(rng_b, i) for i in range(n_nodes)]
+    rng = random.Random(99)
+    placed = []
+    for gi in range(150):
+        svc = f"svc-{gi:04d}"
+        tasks = [make_task(rng, svc, gi * 100 + i)
+                 for i in range(rng.randint(20, 60))]
+        shared = tasks[0].spec
+        for t in tasks:
+            t.spec = shared
+            t.service_id = svc
+        order = np.array([rng.randrange(n_nodes) for _ in tasks], np.int64)
+        placed.append((tasks[0], tasks, order))
+    saved, batch._hostops = batch._hostops, None
+    try:
+        n_p = batch.apply_placements(infos_p, placed)
+    finally:
+        batch._hostops = saved
+    n_n = batch.apply_placements(infos_n, placed)
+    assert n_n == n_p == sum(len(t) for _, t, _ in placed)
+    for a, b in zip(infos_n, infos_p):
+        _assert_info_state_equal(a, b)
