@@ -654,7 +654,10 @@ class SwarmNode:
             # disaster recovery (raft.go ForceNewCluster): collapse the
             # membership to this node alone, keeping the replicated state
             raft.members = {raft_id: Peer(raft_id, node_id, advertise)}
-            storage.save_membership(raft.members)
+            # keep the removed-member set: a member demoted from the OLD
+            # quorum must still be answered with the removed marker (and
+            # its raft id never reused) after disaster recovery
+            storage.save_membership(raft.members, raft.removed_ids)
         elif prev_advertise and prev_advertise != advertise:
             # restarted on a different address than the quorum recorded:
             # re-join through any member so the leader replicates the new
@@ -722,11 +725,16 @@ class SwarmNode:
         self._threads.append(t)
 
         # managers also run an agent against the cluster (runAgent:576);
-        # its session follows the leader via the local endpoint. A PROMOTED
-        # manager already has both the agent and the renewer from its
-        # worker phase — just widen their seed lists.
+        # its session follows the leader via the local endpoint, WIDENED
+        # by the persisted manager list (node.go persistentRemotes): a
+        # manager demoted while down boots with a dead local endpoint and
+        # must still reach the live quorum to re-register as a worker. A
+        # PROMOTED manager already has both the agent and the renewer
+        # from its worker phase — just widen their seed lists.
         if self.agent is None:
-            self._start_agent(advertise)
+            persisted = self._load_state().get("managers") or []
+            seeds = [advertise] + [a for a in persisted if a != advertise]
+            self._start_agent(",".join(seeds))
         else:
             self._dispatcher_shim.update_managers([advertise])
         if self.renewer is None:

@@ -1,88 +1,121 @@
-"""Sustained-load tick pipeline: hide the counts D2H under the previous
-wave's commit work.
+"""Sustained-load tick pipeline: hide the counts D2H under host work —
+up to `depth` waves deep.
 
-Through the dev tunnel a blocking device→host pull costs ~0.1 s fixed plus
-bandwidth, which made the steady scheduler tick LOSE to the CPU oracle
-(round-2 bench: 0.93× at 100k tasks × 10k nodes) even though the kernel
-itself is 8× faster — ~88 % of the tick was the one synchronous counts
-pull. The fix mirrors what burst framing did for the raft-replay and
-global-diff kernels, applied to the tick structure instead of the kernel:
+Through the dev tunnel a blocking device→host pull costs ~0.1 s fixed
+plus bandwidth, which made the steady scheduler tick LOSE to the CPU
+oracle (round-2 bench: 0.93× at 100k tasks × 10k nodes) even though the
+kernel itself is 8× faster — ~88 % of the tick was the one synchronous
+counts pull. Depth 1 mirrors what burst framing did for the raft-replay
+and global-diff kernels, applied to the tick structure:
 
-  wave k:   pull counts(k-1)            ← transfer already completed in
-                                          the background (near-zero wait)
+  wave k:   pull counts(k-1)            ← transfer rode the link in the
+                                          background (near-zero wait)
             fold_counts(k-1)            ← vectorized encoder fold, ~3 ms;
                                           all the next encode() needs
             encode(k) + dispatch(k)     ← fill + counts copy start riding
                                           the link asynchronously
-            commit(k-1)                 ← the heavy host work (one
-                                          add_task per placement, slot
-                                          materialization, store writes)
-                                          runs WHILE counts(k) transfer
+            commit(k-1)                 ← the heavy host work runs WHILE
+                                          counts(k) transfer
             restamp_counts(k-1)         ← fingerprint stamp after add_task
 
-The reorder is legal because `IncrementalEncoder.fold_counts` updates every
-array the next `encode()` reads, while the deferred half (`add_task` loop +
-`restamp_counts`) only matters for dirty-row detection — so it must merely
-precede the NEXT encode's fingerprint scan, which `tick()` guarantees. When
-external node mutations are pending (`nodes_clean` False — a node joined,
-failed, or was updated between waves), the pipeline completes the deferred
-commit first and falls back to the serial order for that wave; correctness
-never depends on the overlap.
+With the wave-bulk + native commit (round 3) the commit shrank below the
+tunnel's fixed RTT at node-heavy shapes, so one wave period no longer
+covers the transfer — `depth=D` keeps D waves in flight, giving each
+counts copy D full periods to ride the link. The device needs nothing
+from the host between waves (its in-scan carry already folded every
+dispatched wave, quantized); the HOST-side consequences of depth ≥ 2 are
+handled here:
 
-Placements stay bit-identical to the CPU oracle: the device state at
-fill(k) equals the host's post-fold state plus the same quantization-
-correction rows `after_apply` queues on the serial path (exercised at
-scale by bench.py, at feature depth by tests/test_pipeline.py).
+  * encode(k) runs before waves k-D+1..k-1 folded into the encoder —
+    legal because their add_task/restamp didn't run either, so no node
+    row looks dirty and nothing node-sized ships;
+  * the problem emitted for wave k is stale by those pending waves;
+    completion applies `encode.fold_problem` (the kernel's quantized
+    in-scan fold) for each pending predecessor, in order, BEFORE the
+    encoder fold / oracle parity / slot materialization consume it;
+  * anything that would ship node rows mid-pipe would clobber the
+    device's un-pulled folds, so the pipe DRAINS to serial first on:
+    external node mutations (nodes_clean false), queued quantization
+    corrections (resident pending rows), hypothetical service rows
+    (row numbering is only stable once a fold allocates it), or a
+    fold_problem shape mismatch.
+
+Placements stay bit-identical to the CPU oracle at every depth
+(tests/test_pipeline.py fuzzes depth ∈ {1, 2, 3} against the serial
+path; bench.py exercises it at scale).
 
 Reference hot loop this beats: manager/scheduler/scheduler.go:694-921 —
 its commit (`applySchedulingDecisions`) is synchronous with the next
-scheduling pass; here the commit IS the transfer window.
+scheduling pass; here the commit and D-1 further whole waves ARE the
+transfer window.
 """
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Callable
 
 import numpy as np
 
-from ..scheduler.encode import EncodedProblem, IncrementalEncoder
+from ..scheduler.encode import (
+    EncodedProblem,
+    IncrementalEncoder,
+    fold_problem,
+)
 from .resident import PendingCounts, ResidentPlacement
 
 
 class TickPipeline:
-    """Drives ResidentPlacement ticks with the previous wave's commit
-    overlapped under the in-flight counts copy.
+    """Drives ResidentPlacement ticks with up to `depth` waves in flight.
 
     commit_cb(problem, counts) must perform EXACTLY one successful
     NodeInfo.add_task per placed task (the apply_counts contract) plus
-    whatever store writes the caller needs; the pipeline brackets it with
-    fold_counts (before the next encode) and restamp_counts (after).
+    whatever store writes the caller needs; the pipeline brackets it
+    with fold_counts (before the encoder next re-reads those arrays) and
+    restamp_counts (after).
     """
 
     def __init__(self, encoder: IncrementalEncoder,
                  resident: ResidentPlacement,
-                 commit_cb: Callable[[EncodedProblem, np.ndarray], None]):
+                 commit_cb: Callable[[EncodedProblem, np.ndarray], None],
+                 depth: int = 1):
         self.encoder = encoder
         self.resident = resident
         self.commit_cb = commit_cb
-        self._inflight: tuple[EncodedProblem, PendingCounts] | None = None
+        self.depth = max(1, depth)
+        # (problem, handle, n_pending): n_pending = how many dispatched-
+        # but-unfolded waves preceded this one at its encode time
+        self._inflight: deque[tuple] = deque()
+        # completed (problem, counts) pairs still needed as fold sources
+        self._recent: deque[tuple] = deque(maxlen=max(1, self.depth - 1))
         self.timings: list[dict] = []      # per-wave phase seconds (bench)
 
     # ------------------------------------------------------------------ steps
     def _complete(self) -> tuple[EncodedProblem, np.ndarray, dict] | None:
-        """Pull + fold the in-flight wave; commit stays with the caller."""
-        if self._inflight is None:
+        """Pull + problem-fold + encoder-fold the OLDEST in-flight wave;
+        commit stays with the caller."""
+        if not self._inflight:
             return None
-        p, h = self._inflight
-        self._inflight = None
+        p, h, n_pending = self._inflight.popleft()
         t0 = time.perf_counter()
         counts = h.get()
         pull_s = time.perf_counter() - t0
         t0 = time.perf_counter()
+        if n_pending:
+            # bring the emitted problem up to the device's view: fold the
+            # waves that were still in flight when it was encoded
+            assert n_pending <= len(self._recent)
+            for pp, cc in list(self._recent)[-n_pending:]:
+                if not fold_problem(p, pp, cc):
+                    # shapes moved under the pipe (shouldn't happen with
+                    # the drain gates): device carry unusable
+                    self.resident.invalidate()
+                    break
         if not self.encoder.fold_counts(p, counts):
             # node set diverged under us: device carry is unusable
             self.resident.invalidate()
         self.resident.after_apply(p, counts)
+        self._recent.append((p, counts))
         fold_s = time.perf_counter() - t0
         return p, counts, {"pull_s": pull_s, "fold_s": fold_s}
 
@@ -92,37 +125,91 @@ class TickPipeline:
         self.encoder.restamp_counts(p, counts)
         return time.perf_counter() - t0
 
+    def _hazards(self) -> bool:
+        """True when dispatching another wave PAST the current in-flight
+        ones would ship node rows (queued quantization corrections —
+        their row SET would clobber the device's un-pulled in-scan
+        folds) or create ambiguous service-row numbering (hypothetical
+        rows only become stable once a fold allocates them). Irrelevant
+        at depth 1, where the pipe is always empty at dispatch time."""
+        return bool(self.resident.pending_rows
+                    or any(p.has_hypo_rows for p, _h, _n in self._inflight))
+
     # -------------------------------------------------------------------- API
     def tick(self, infos, groups, *, now=None, volume_set=None,
-             ) -> tuple[EncodedProblem, np.ndarray] | None:
-        """Dispatch one wave; completes (commits) the previous wave under
-        the new wave's transfer. Returns the completed previous wave's
-        (problem, counts), or None on the first call."""
+             ) -> list[tuple[EncodedProblem, np.ndarray]]:
+        """Dispatch one wave; completes (commits) the oldest in-flight
+        wave once the pipe is `depth` deep. Returns the waves completed
+        by this call — empty while the pipe is filling, one in steady
+        state, up to `depth` on a drain."""
         t_wave = time.perf_counter()
-        prev = self._complete()
-        timing = prev[2] if prev else {"pull_s": 0.0, "fold_s": 0.0}
+        completed: list[tuple] = []
+        timing = {"pull_s": 0.0, "fold_s": 0.0}
+        # a completed-but-not-yet-committed wave (commits must stay FIFO
+        # and must NEVER be dropped: fold_counts already ran for it)
+        deferred: tuple | None = None
 
-        serial = prev is not None and not self.encoder.nodes_clean(infos)
+        def commit_deferred():
+            nonlocal deferred
+            if deferred is not None:
+                timing["commit_s"] = (timing.get("commit_s", 0.0)
+                                      + self._commit(*deferred))
+                deferred = None
+
+        def drain_serial():
+            # the ONE drain sequence every trigger uses: any deferred
+            # commit first (FIFO), then complete+commit everything left
+            commit_deferred()
+            while self._inflight:
+                done = self._complete()
+                timing["pull_s"] += done[2]["pull_s"]
+                timing["fold_s"] += done[2]["fold_s"]
+                timing["commit_s"] = (timing.get("commit_s", 0.0)
+                                      + self._commit(done[0], done[1]))
+                completed.append((done[0], done[1]))
+
+        # external node mutations: drain fully so dirty rows re-encode
+        # from infos that already include every wave's tasks
+        serial = bool(self._inflight) \
+            and not self.encoder.nodes_clean(infos)
         if serial:
-            # external node changes: dirty rows must re-encode from infos
-            # that already include the previous wave's tasks
-            timing["commit_s"] = self._commit(prev[0], prev[1])
+            drain_serial()
+        else:
+            if len(self._inflight) >= self.depth:
+                done = self._complete()
+                timing.update(done[2])
+                completed.append((done[0], done[1]))
+                deferred = completed[-1]
+            # hazards may have been CREATED by that completion (e.g.
+            # after_apply queued corrections): re-check before dispatching
+            # past anything still in flight
+            if self._inflight and self._hazards():
+                serial = True
+                drain_serial()
 
         t0 = time.perf_counter()
         p = self.encoder.encode(infos, groups, now=now,
                                 volume_set=volume_set)
+        if self._inflight and self.resident.needs_full_upload(p):
+            # bucket/vocab growth (new generic kind, node remap, stale
+            # carry) forces a full re-upload, which would be built from
+            # host arrays missing the in-flight waves' folds: drain,
+            # then re-encode against the folded state
+            serial = True
+            drain_serial()
+            p = self.encoder.encode(infos, groups, now=now,
+                                    volume_set=volume_set)
         timing["encode_s"] = time.perf_counter() - t0
         t0 = time.perf_counter()
         h = self.resident.schedule_async(p)
         timing["dispatch_s"] = time.perf_counter() - t0
-        self._inflight = (p, h)
+        self._inflight.append((p, h, len(self._inflight)))
 
-        if prev is not None and not serial:
-            timing["commit_s"] = self._commit(prev[0], prev[1])
+        commit_deferred()
         timing["serial_fallback"] = serial
         timing["wall_s"] = time.perf_counter() - t_wave
         self._record(timing)
-        return (prev[0], prev[1]) if prev else None
+        return completed
 
     def _record(self, timing: dict) -> None:
         # observability ring: a long-lived production driver must not
@@ -131,16 +218,17 @@ class TickPipeline:
             del self.timings[:2048]
         self.timings.append(timing)
 
-    def flush(self) -> tuple[EncodedProblem, np.ndarray] | None:
-        """Complete and commit the last in-flight wave (pipeline drain)."""
-        prev = self._complete()
-        if prev is None:
-            return None
-        p, counts, timing = prev
-        timing["commit_s"] = self._commit(p, counts)
-        timing["serial_fallback"] = False
-        timing["encode_s"] = timing["dispatch_s"] = 0.0
-        timing["wall_s"] = timing["pull_s"] + timing["fold_s"] \
-            + timing["commit_s"]
-        self._record(timing)
-        return p, counts
+    def flush(self) -> list[tuple[EncodedProblem, np.ndarray]]:
+        """Complete and commit every in-flight wave (pipeline drain),
+        oldest first; one timings entry per completed wave."""
+        out = []
+        while self._inflight:
+            p, counts, timing = self._complete()
+            timing["commit_s"] = self._commit(p, counts)
+            timing["serial_fallback"] = False
+            timing["encode_s"] = timing["dispatch_s"] = 0.0
+            timing["wall_s"] = timing["pull_s"] + timing["fold_s"] \
+                + timing["commit_s"]
+            self._record(timing)
+            out.append((p, counts))
+        return out

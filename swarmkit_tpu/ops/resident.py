@@ -245,6 +245,22 @@ class ResidentPlacement:
         self._stale = False
         self.uploads_full += 1
 
+    def needs_full_upload(self, p: EncodedProblem) -> bool:
+        """Would scheduling `p` force a full state re-upload (stale
+        carry, node remap, or bucket/vocab signature growth)? A deep
+        pipeline drains first — the upload would be built from host
+        arrays that haven't folded the in-flight waves."""
+        return bool(self._stale or self._state is None
+                    or self.enc.last_remap
+                    or self._meta != self._signature(p))
+
+    @property
+    def pending_rows(self) -> bool:
+        """True when quantization-correction rows are queued for the next
+        dispatch — a deep pipeline must drain before shipping them (the
+        row SET would clobber the device's un-pulled in-scan folds)."""
+        return bool(self._pending.size)
+
     # ------------------------------------------------------------------ API
     def invalidate(self):
         """Force a full re-upload next tick (apply fold skipped, external
@@ -268,8 +284,7 @@ class ResidentPlacement:
         enc = self.enc
         G, N = p.extra_mask.shape
 
-        fresh = (self._stale or self._state is None or enc.last_remap
-                 or self._meta != self._signature(p))
+        fresh = self.needs_full_upload(p)
         if fresh:
             self._upload_full(p)
             dirty = np.zeros(0, np.int64)
@@ -380,10 +395,18 @@ class ResidentPlacement:
         if p.node_ids != enc._ids:
             self._stale = True
             return
-        # device carried: p.avail_res (pre-tick) - counts^T @ quantized need
+        # device carried: p.avail_res (pre-tick) - counts^T @ quantized
+        # need. Compare the problem's column width only: a vocab-growth
+        # encode may have widened the encoder arrays after this wave
+        # dispatched — the new kind columns reach the device via the
+        # full re-upload that growth forces, not via correction rows.
+        r = p.avail_res.shape[1]
+        if enc.avail_res.shape[1] < r:
+            self._stale = True
+            return
         dev_avail = p.avail_res.astype(np.int64) - \
             counts.astype(np.int64).T @ p.need_res.astype(np.int64)
-        diff = (dev_avail != enc.avail_res).any(axis=1)
+        diff = (dev_avail != enc.avail_res[:, :r]).any(axis=1)
         self._pending = np.union1d(self._pending, np.flatnonzero(diff)) \
             .astype(np.int64)
 

@@ -105,10 +105,18 @@ class RaftNode:
         self.snapshot_state = snapshot_state or (lambda: None)
         self.restore_state = restore_state or (lambda s: None)
         self.on_leadership = on_leadership or (lambda is_leader: None)
-        # fired (from a fresh thread) when this node applies its OWN removal
-        # from the membership — the reference surfaces this as
-        # ErrMemberRemoved to node.superviseManager, which demotes
+        # fired (from a fresh thread) when this node learns of its OWN
+        # removal from the membership — by applying the conf change, or
+        # from a peer's removed-member reply (notify_removed). The
+        # reference surfaces this as ErrMemberRemoved to
+        # node.superviseManager, which demotes
         self.on_removed: Callable[[], None] | None = None
+        # raft ids of members REMOVED from this cluster: peers answer
+        # their messages with the removed marker so a member demoted
+        # while down learns its fate when it comes back
+        # (reference manager/state/raft/membership ErrMemberRemoved)
+        self.removed_ids: set[int] = set()
+        self._self_removed = False
         self.election_tick = election_tick
         self.heartbeat_tick = heartbeat_tick
         self.snapshot_interval = snapshot_interval
@@ -215,7 +223,7 @@ class RaftNode:
         for p in peers:
             self.members[p.raft_id] = p
         if self.storage is not None:
-            self.storage.save_membership(self.members)
+            self.storage.save_membership(self.members, self.removed_ids)
 
     # -------------------------------------------------------------- external
     def step(self, msg):
@@ -240,6 +248,13 @@ class RaftNode:
         hatch, raft.go:589-606): send it TimeoutNow so it campaigns at once;
         its higher term deposes us. No-op unless we lead with peers."""
         self._inbox.put(("transfer",))
+
+    def notify_removed(self):
+        """The transport learned from a peer that WE were removed from
+        the membership (the peer's removed-member reply) — e.g. this
+        member was demoted while down and restarted with a stale
+        membership. Thread-safe."""
+        self._inbox.put(("removed",))
 
     def campaign(self):
         """Force an immediate election (tests / bootstrap)."""
@@ -335,6 +350,8 @@ class RaftNode:
             self._campaign()
         elif kind == "transfer":
             self._on_transfer()
+        elif kind == "removed":
+            self._handle_self_removed()
 
     # ----------------------------------------------------------------- ticks
     def _next_timeout(self) -> int:
@@ -882,16 +899,36 @@ class RaftNode:
             members = dict(self.members)
             members.pop(cc.raft_id, None)
             self.members = members
+            self.removed_ids.add(cc.raft_id)
             self.next_index.pop(cc.raft_id, None)
             self.match_index.pop(cc.raft_id, None)
-            if cc.raft_id == self.id:
+            if cc.raft_id == self.id and not self._self_removed:
+                self._self_removed = True
                 self._become_follower(self.term, None)
                 if self.on_removed is not None:
                     # off-thread: the apply loop must not run teardown
                     threading.Thread(target=self.on_removed, daemon=True,
                                      name="raft-removed").start()
         if self.storage is not None:
-            self.storage.save_membership(self.members)
+            self.storage.save_membership(self.members, self.removed_ids)
+
+    def _handle_self_removed(self):
+        """Worker-thread handler for notify_removed: same consequences as
+        applying our own removal conf change, minus a log entry we will
+        never receive (peers stopped replicating to us)."""
+        if self._self_removed:
+            return
+        self._self_removed = True
+        members = dict(self.members)
+        members.pop(self.id, None)
+        self.members = members          # also stops further elections
+        self.removed_ids.add(self.id)
+        self._become_follower(self.term, None)
+        if self.storage is not None:
+            self.storage.save_membership(self.members, self.removed_ids)
+        if self.on_removed is not None:
+            threading.Thread(target=self.on_removed, daemon=True,
+                             name="raft-removed").start()
 
     # -------------------------------------------------------------- snapshots
     def _maybe_snapshot(self):
@@ -934,6 +971,7 @@ class RaftNode:
         self.first_index = state.snapshot_index + 1
         self.log = list(state.entries)
         self.members = dict(state.members)
+        self.removed_ids = set(state.removed)
         # a torn WAL tail (or undecryptable entries) can leave the persisted
         # commit ahead of the recovered log; cap it so replay can't index
         # past the entries we actually have

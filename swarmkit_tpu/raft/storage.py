@@ -88,6 +88,7 @@ class LoadedState:
     snapshot_data: Any = None
     entries: list[Entry] = field(default_factory=list)
     members: dict[int, Peer] = field(default_factory=dict)
+    removed: set = field(default_factory=set)
 
 
 class RaftStorage:
@@ -138,12 +139,20 @@ class RaftStorage:
                            "commit": commit}, f)
             os.replace(tmp, self._hs_path)
 
-    def save_membership(self, members: dict[int, Peer]):
+    def save_membership(self, members: dict[int, Peer],
+                        removed: set | None = None):
+        """Persist the member map plus the ids of REMOVED members — peers
+        keep answering a removed member's messages with the removed
+        marker (reference membership.go ErrMemberRemoved), which must
+        survive restarts or a rebooted peer would happily talk to it."""
         with self._lock:
             tmp = self._members_path + ".tmp"
             with open(tmp, "w") as f:
-                json.dump({str(rid): [p.node_id, p.addr]
-                           for rid, p in members.items()}, f)
+                json.dump({
+                    "members": {str(rid): [p.node_id, p.addr]
+                                for rid, p in members.items()},
+                    "removed": sorted(removed or ()),
+                }, f)
             os.replace(tmp, self._members_path)
 
     def save_snapshot(self, index: int, term: int, data: Any,
@@ -202,10 +211,16 @@ class RaftStorage:
                 st.commit_index = hs["commit"]
             if os.path.exists(self._members_path):
                 with open(self._members_path) as f:
-                    st.members = {
-                        int(rid): Peer(int(rid), nid, addr)
-                        for rid, (nid, addr) in json.load(f).items()
-                    }
+                    raw = json.load(f)
+                if "members" in raw:
+                    flat = raw["members"]
+                    st.removed = {int(r) for r in raw.get("removed", ())}
+                else:            # legacy flat format (pre removed-ids)
+                    flat = raw
+                st.members = {
+                    int(rid): Peer(int(rid), nid, addr)
+                    for rid, (nid, addr) in flat.items()
+                }
             st.entries = [e for e in self._read_wal()
                           if e.index > st.snapshot_index]
             return st

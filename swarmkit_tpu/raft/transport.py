@@ -171,6 +171,18 @@ class NetworkTransport:
                     self._last_ok[peer_id] = time.monotonic()
                 backoff_until = 0.0
             except Exception as exc:
+                if "member removed" in str(exc):
+                    # the peer answered with the removed marker: WE are no
+                    # longer part of this cluster (demoted while down —
+                    # reference ErrMemberRemoved handling in node.go)
+                    node = self.node
+                    if node is not None \
+                            and getattr(msg, "frm", None) == node.id:
+                        log.info("raft transport: peer %d says we were "
+                                 "removed from the cluster", peer_id)
+                        node.notify_removed()
+                    backoff_until = time.monotonic() + RECONNECT_BACKOFF
+                    continue
                 log.debug("raft transport: send to %d failed: %s",
                           peer_id, exc)
                 client.close()

@@ -128,6 +128,14 @@ def build_manager_registry(manager, raft_node=None,
         join_lock = threading.Lock()
 
         def raft_step(caller, msg):
+            frm = getattr(msg, "frm", None)
+            if frm is not None and frm in raft_node.removed_ids:
+                # reference membership.go ErrMemberRemoved: a removed
+                # member's messages are answered with the marker so a
+                # member demoted WHILE DOWN learns its fate on restart
+                # (it never applied its own removal — the quorum stopped
+                # replicating to it)
+                raise ValueError("raft: member removed")
             raft_node.step(msg)
             return None
 
@@ -177,7 +185,11 @@ def build_manager_registry(manager, raft_node=None,
                                        raft_id=existing.raft_id,
                                        node_id=node_id, addr=addr))
                 return (existing.raft_id, _member_list(raft_node))
-            raft_id = max(raft_node.members, default=0) + 1
+            # never reuse a REMOVED member's id: peers answer removed ids
+            # with the removed marker forever (raft_step above), which
+            # would instantly eject the new member
+            raft_id = max(max(raft_node.members, default=0),
+                          max(raft_node.removed_ids, default=0)) + 1
             propose(ConfChange(action="add", raft_id=raft_id,
                                node_id=node_id, addr=addr))
             return (raft_id, _member_list(raft_node))

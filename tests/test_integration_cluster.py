@@ -461,3 +461,215 @@ def test_force_new_cluster_recovers_quorum_loss(cluster):
     assert wait_for(
         lambda: m_new.store.view(lambda tx: tx.get_service(svc.id))
         is not None, timeout=60)
+
+
+def test_demote_to_single_manager(cluster):
+    """integration_test.go:408 TestDemoteToSingleManager — demote the
+    LEADER twice in a row: 3 managers -> 2 -> 1. The second demotion is
+    the edge the 3->2 test can't reach: the one remaining member must
+    shrink the quorum to itself and win a single-member election."""
+    m1 = cluster.add_manager()
+    m2 = cluster.add_manager()
+    m3 = cluster.add_manager()
+    managers = [m1, m2, m3]
+    assert wait_for(
+        lambda: all(len(m.raft.members) == 3 for m in managers), timeout=30)
+
+    svc = _create_service(cluster, "survives-demotions", 2)
+    assert wait_for(lambda: len(cluster.running(svc.id)) == 2, timeout=45)
+
+    first = cluster.leader()
+    cluster.set_node_role(first.node_id, NodeRole.WORKER)
+    rest = [m for m in managers if m is not first]
+    assert wait_for(lambda: any(m.is_leader for m in rest), timeout=120)
+    assert wait_for(
+        lambda: all(len(m.raft.members) == 2 for m in rest), timeout=120)
+    assert wait_for(lambda: first.manager is None, timeout=120)
+
+    second = cluster.leader()
+    cluster.set_node_role(second.node_id, NodeRole.WORKER)
+    last = next(m for m in rest if m is not second)
+    assert wait_for(lambda: last.is_leader, timeout=120)
+    assert wait_for(lambda: len(last.raft.members) == 1, timeout=120)
+    assert wait_for(lambda: second.manager is None, timeout=120)
+
+    # the single-manager cluster still serves writes; both demoted nodes
+    # keep working as workers (replicas can land anywhere)
+    svc2 = _create_service(cluster, "single-manager", 3)
+    assert wait_for(lambda: len(cluster.running(svc2.id)) == 3, timeout=60)
+
+
+def test_demote_downed_manager(cluster):
+    """integration_test.go:452 TestDemoteDownedManager — demote a manager
+    WHILE IT IS DOWN (it cannot ack anything), then restart it from its
+    state dir: the membership conf-change must commit against the
+    remaining quorum, and the restarted node must discover it is no
+    longer a manager and come back as a worker."""
+    m1 = cluster.add_manager()
+    m2 = cluster.add_manager()
+    m3 = cluster.add_manager()
+    managers = [m1, m2, m3]
+    assert wait_for(
+        lambda: all(len(m.raft.members) == 3 for m in managers), timeout=30)
+
+    demotee = next(m for m in managers if not m.is_leader)
+    node_id, state_dir = demotee.node_id, demotee.state_dir
+    port = demotee.advertise_addr.rsplit(":", 1)[1]
+    cluster.nodes.remove(demotee)
+    demotee.stop()
+
+    # demote the downed node: the 2-member quorum commits the role flip
+    # and the conf change without the demotee's participation
+    cluster.set_node_role(node_id, NodeRole.WORKER)
+    live = [m for m in managers if m is not demotee]
+    assert wait_for(
+        lambda: all(len(m.raft.members) == 2 for m in live), timeout=120)
+
+    # restart from the same state dir: it must realize it was demoted
+    def start_back():
+        node = SwarmNode(
+            state_dir=state_dir,
+            executor=FakeExecutor({"*": {"run_forever": True}},
+                                  hostname="demoted"),
+            listen_addr="127.0.0.1:" + port,
+            heartbeat_period=0.5,
+            tick_interval=0.05,
+            manager_refresh_interval=0.5,
+        )
+        node.start()
+        return node
+
+    end = time.monotonic() + 20       # OS may briefly hold the listener
+    while True:
+        try:
+            back = start_back()
+            break
+        except OSError:
+            if time.monotonic() >= end:
+                raise
+            time.sleep(0.5)
+    cluster.nodes.append(back)
+    assert back.node_id == node_id
+    assert wait_for(lambda: back.manager is None, timeout=120)
+
+    # it serves as a worker: it re-registers READY and the quorum stays 2
+    leader = cluster.leader()
+
+    def ready_as_worker():
+        n = leader.store.view(lambda tx: tx.get_node(node_id))
+        return (n is not None and n.status.state == NodeStatusState.READY
+                and n.role == NodeRole.WORKER)
+
+    assert wait_for(ready_as_worker, timeout=120)
+    assert all(len(m.raft.members) == 2 for m in live)
+
+
+def test_restart_leader_rejoins(cluster):
+    """integration_test.go:515 TestRestartLeader — stop the raft LEADER,
+    let the others elect, then restart it from its state dir: it must
+    come back as a MEMBER (same raft id), catch up the log, and the
+    cluster serve writes with all three members again."""
+    m1 = cluster.add_manager()
+    m2 = cluster.add_manager()
+    m3 = cluster.add_manager()
+    managers = [m1, m2, m3]
+    assert wait_for(
+        lambda: all(len(m.raft.members) == 3 for m in managers), timeout=30)
+
+    svc = _create_service(cluster, "pre-restart", 2)
+    assert wait_for(lambda: len(cluster.running(svc.id)) == 2, timeout=45)
+
+    leader = cluster.leader()
+    old_raft_id = leader.raft_id
+    state_dir = leader.state_dir
+    port = leader.advertise_addr.rsplit(":", 1)[1]
+    rest = [m for m in managers if m is not leader]
+    cluster.nodes.remove(leader)
+    leader.stop()
+
+    assert wait_for(lambda: any(m.is_leader for m in rest), timeout=120)
+
+    # a write commits while the old leader is down (quorum 2 of 3)
+    svc2 = _create_service(cluster, "while-down", 1)
+    assert wait_for(lambda: len(cluster.running(svc2.id)) == 1, timeout=60)
+
+    def start_back():
+        node = SwarmNode(
+            state_dir=state_dir,
+            executor=FakeExecutor({"*": {"run_forever": True}},
+                                  hostname="old-leader"),
+            listen_addr="127.0.0.1:" + port,
+            heartbeat_period=0.5,
+            tick_interval=0.05,
+            manager_refresh_interval=0.5,
+        )
+        node.start()
+        return node
+
+    end = time.monotonic() + 20
+    while True:
+        try:
+            back = start_back()
+            break
+        except OSError:
+            if time.monotonic() >= end:
+                raise
+            time.sleep(0.5)
+    cluster.nodes.append(back)
+    assert back.raft_id == old_raft_id
+
+    # rejoined as a member and caught up the log written while it was down
+    assert wait_for(
+        lambda: back.manager is not None
+        and len(back.raft.members) == 3, timeout=120)
+    assert wait_for(
+        lambda: back.store.view(lambda tx: tx.get_service(svc2.id))
+        is not None, timeout=60)
+    svc3 = _create_service(cluster, "post-restart", 1)
+    assert wait_for(lambda: len(cluster.running(svc3.id)) == 1, timeout=60)
+
+
+def test_repeated_root_rotation(cluster):
+    """integration_test.go:735 TestRepeatedRootRotation — a SECOND root
+    rotation after the first fully converged: every node must land on
+    the final root (rotation epochs advance, no node stuck trusting a
+    superseded root) and the data plane keep serving."""
+    m1 = cluster.add_manager()
+    w1 = cluster.add_agent()
+    leader = cluster.leader()
+
+    def worker_ready():
+        n = leader.store.view(lambda tx: tx.get_node(w1.node_id))
+        return n is not None and n.status.state == NodeStatusState.READY
+
+    assert wait_for(worker_ready, timeout=40)
+    svc = _create_service(cluster, "pre-rotations", 2)
+    assert wait_for(lambda: len(cluster.running(svc.id)) == 2, timeout=60)
+
+    def rotate_and_converge():
+        old_root = leader.manager.ca_server.root.cert_pem
+        leader.manager.ca_server.rotate_root_ca()
+
+        def renewed():
+            new_root = leader.manager.ca_server.root.cert_pem
+            return (new_root != old_root
+                    and m1.security.root_ca.cert_pem == new_root
+                    and w1.security.root_ca.cert_pem == new_root)
+
+        # same generous window as the single-rotation test: each renewal
+        # chain hop has its own timer and CI load stretches all of them
+        assert wait_for(renewed, timeout=300)
+
+    rotate_and_converge()
+    rotate_and_converge()
+
+    # two full rotations later the data plane still serves (window sized
+    # like the single-rotation sibling: renewal chains stretch under load)
+    ctl = cluster.control()
+    try:
+        cur = ctl.get_service(svc.id)
+        cur.spec.replicas = 4
+        ctl.update_service(svc.id, cur.meta.version, cur.spec)
+    finally:
+        ctl.close()
+    assert wait_for(lambda: len(cluster.running(svc.id)) == 4, timeout=120)

@@ -61,36 +61,33 @@ def make_waves(rng, step, group_maker, max_groups=4):
 
 
 def run_pipelined_trace(seed, steps=8, group_maker=random_group,
-                        churn=False):
+                        churn=False, depth=1):
     rng = random.Random(seed)
     infos = [make_info(rng, i) for i in range(14)]
     next_node_id = 14
     enc = IncrementalEncoder()
     rp = ResidentPlacement(enc)
-    pipe = TickPipeline(enc, rp, make_commit(infos))
+    pipe = TickPipeline(enc, rp, make_commit(infos), depth=depth)
 
-    expected = {}                       # wave idx -> oracle counts
     completed = []
     for step in range(steps):
         if churn and step and step % 3 == 0:
             next_node_id = mutate(rng, infos, next_node_id, step)
         groups = make_waves(rng, step, group_maker)
-        prev = pipe.tick(infos, groups, now=NOW)
-        # oracle runs on the emitted problem AFTER dispatch — the snapshot
-        # the device saw — while the previous wave's commit is deferred
-        p_cur = pipe._inflight[0]
-        expected[step] = batch.cpu_schedule_encoded(p_cur)
-        if prev is not None:
-            completed.append(prev)
-    last = pipe.flush()
-    assert last is not None
-    completed.append(last)
+        completed.extend(pipe.tick(infos, groups, now=NOW))
+    completed.extend(pipe.flush())
 
     assert len(completed) == steps
+    # parity: each wave's device counts bit-match the CPU oracle on the
+    # COMPLETED problem — at depth 1 that is the dispatch-time snapshot;
+    # at depth > 1 completion folded the then-pending waves into it
+    # (encode.fold_problem), reconstructing exactly the state the
+    # device's in-scan carry scheduled against
     for step, (p, counts) in enumerate(completed):
         np.testing.assert_array_equal(
-            counts, expected[step],
-            err_msg=f"seed {seed} step {step} (pipelined vs oracle)")
+            counts, batch.cpu_schedule_encoded(p),
+            err_msg=f"seed {seed} step {step} depth {depth} "
+                    "(pipelined vs oracle)")
     return enc, rp, pipe, completed
 
 
@@ -117,6 +114,140 @@ def test_pipelined_trace_parity_odd_reservations(seed):
     after_apply must reach the device as next-tick deltas exactly like the
     serial path — bit-parity per wave proves they did."""
     run_pipelined_trace(seed, group_maker=odd_group)
+
+
+@pytest.mark.parametrize("depth", [2, 3])
+@pytest.mark.parametrize("seed", range(3))
+def test_deep_pipeline_matches_depth_one(seed, depth):
+    """Pipeline depth must not change placements: the same wave trace at
+    depth D and depth 1 produces bit-identical per-wave counts and the
+    same final encoder state. (Depth-D encodes wave k before waves
+    k-D+1..k-1 folded; fold_problem reconstructs the device view at
+    completion — this is the property that makes that legal.)"""
+    enc1, _rp1, _p1, done1 = run_pipelined_trace(seed, depth=1)
+    encD, rpD, pipeD, doneD = run_pipelined_trace(seed, depth=depth)
+    # (drains MAY legitimately occur: waves introducing a brand-new
+    # service carry hypothetical rows the pipe must not dispatch past)
+    for step, ((_pa, ca), (_pb, cb)) in enumerate(zip(done1, doneD)):
+        np.testing.assert_array_equal(
+            ca, cb, err_msg=f"seed {seed} step {step}: depth {depth} "
+                            "placements diverge from depth 1")
+    np.testing.assert_array_equal(enc1.avail_res, encD.avail_res)
+    np.testing.assert_array_equal(enc1.total0, encD.total0)
+    np.testing.assert_array_equal(enc1._svc_mat, encD._svc_mat)
+
+    # device carry equals the host fold of the final state
+    p, counts = doneD[-1]
+    st = rpD.pull_state()
+    N = len(p.node_ids)
+    exp_total, exp_avail, exp_port = expected_device_fold(p, counts)
+    np.testing.assert_array_equal(st["total0"][:N], exp_total)
+    np.testing.assert_array_equal(
+        st["avail_res"][:N, :p.avail_res.shape[1]], exp_avail)
+    np.testing.assert_array_equal(
+        st["port_used"][:N, :p.port_used0.shape[1]], exp_port)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_deep_pipeline_odd_reservations_drains_and_stays_correct(seed):
+    """Odd (non-quantum) reservations queue correction rows, which a deep
+    pipe may not ship mid-flight — the pipeline must drain (shipping them
+    against a settled device state) and stay bit-correct."""
+    enc1, _rp1, _p1, done1 = run_pipelined_trace(seed, group_maker=odd_group)
+    encD, _rpD, pipeD, doneD = run_pipelined_trace(seed,
+                                                   group_maker=odd_group,
+                                                   depth=3)
+    for step, ((_pa, ca), (_pb, cb)) in enumerate(zip(done1, doneD)):
+        np.testing.assert_array_equal(
+            ca, cb, err_msg=f"seed {seed} step {step} (odd-reservation "
+                            "deep pipeline vs depth 1)")
+    np.testing.assert_array_equal(enc1.avail_res, encD.avail_res)
+
+
+@pytest.mark.parametrize("depth", [2, 3])
+def test_deep_pipeline_with_churn_drains_serial(depth):
+    """External node mutations mid-pipe force a full drain at any depth;
+    parity holds through the remap."""
+    _enc, _rp, pipe, _done = run_pipelined_trace(7, churn=True, depth=depth)
+    assert any(t["serial_fallback"] for t in pipe.timings)
+
+
+@pytest.mark.parametrize("depth", [2, 3])
+def test_deep_pipeline_signature_growth_commits_deferred_wave(depth):
+    """A wave that grows the encoder's generic-kind vocabulary changes
+    the resident signature (full re-upload) — at depth >= 2 the pipe
+    must drain first AND the wave completed earlier in the same tick
+    must still get its commit: a dropped commit leaves NodeInfo
+    bookkeeping diverged from the encoder's fold behind clean-looking
+    fingerprints."""
+    def run(depth):
+        rng = random.Random(21)
+        infos = [make_info(rng, i) for i in range(8)]
+        enc = IncrementalEncoder()
+        rp = ResidentPlacement(enc)
+        commits = []
+        base = make_commit(infos)
+
+        def commit(p, counts):
+            commits.append(int(counts.sum()))
+            base(p, counts)
+
+        pipe = TickPipeline(enc, rp, commit, depth=depth)
+        completed = []
+        for step in range(6):
+            groups = make_waves(rng, step, random_group)
+            for g in groups:        # plain resources; no hypo after step 0
+                g.tasks[0].spec.resources.reservations.generic = {}
+            if step == 3:           # NEW generic kind -> signature growth
+                groups[0].tasks[0].spec.resources.reservations.generic = \
+                    {"fancy": 1}
+            completed.extend(pipe.tick(infos, groups, now=NOW))
+        completed.extend(pipe.flush())
+        assert len(completed) == 6
+        # THE regression: every completed wave was committed exactly once
+        assert len(commits) == 6
+        for p, counts in completed:
+            np.testing.assert_array_equal(
+                counts, batch.cpu_schedule_encoded(p))
+        assert enc.nodes_clean(infos)
+        return completed, infos
+
+    done1, infos1 = run(1)
+    doneD, infosD = run(depth)
+    for (pa, ca), (_pb, cb) in zip(done1, doneD):
+        np.testing.assert_array_equal(ca, cb)
+    from test_scheduler_regressions import _assert_info_state_equal
+    for a, b in zip(infos1, infosD):
+        _assert_info_state_equal(a, b)
+
+
+def test_deep_pipeline_new_service_rows_drain():
+    """A wave whose services have no persistent rows yet (hypothetical
+    numbering) must not be dispatched PAST — the next tick drains first,
+    so two waves can never claim the same persistent row. Steady waves
+    over the same services then pipeline freely."""
+    rng = random.Random(3)
+    infos = [make_info(rng, i) for i in range(10)]
+    enc = IncrementalEncoder()
+    rp = ResidentPlacement(enc)
+    pipe = TickPipeline(enc, rp, make_commit(infos), depth=3)
+    for step in range(6):
+        groups = make_waves(rng, step, random_group)
+        if step % 2 == 0:
+            # every OTHER wave introduces brand-new services (no
+            # persistent svc row yet -> hypothetical numbering)
+            for g in groups:
+                g.service_id = f"fresh{step}-{g.service_id}"
+                for t in g.tasks:
+                    t.service_id = g.service_id
+        for p, counts in pipe.tick(infos, groups, now=NOW):
+            np.testing.assert_array_equal(
+                counts, batch.cpu_schedule_encoded(p),
+                err_msg=f"step {step}")
+    for p, counts in pipe.flush():
+        np.testing.assert_array_equal(counts, batch.cpu_schedule_encoded(p))
+    # the hypo gate actually fired (drained rather than dispatching past)
+    assert any(t["serial_fallback"] for t in pipe.timings)
 
 
 @pytest.mark.parametrize("seed", range(4))
