@@ -11,6 +11,8 @@ import copy as _copy
 from dataclasses import dataclass, field
 from typing import Any
 
+from ..native import hostops as _hostops
+
 from .specs import (
     Annotations,
     ClusterSpec,
@@ -53,6 +55,14 @@ class StoreObject:
     TABLE = ""
 
     def copy(self):
+        # the store's hottest call: 2-3 copies per write transaction.
+        # The replicated object model is tree-shaped (no cycles, no
+        # aliasing between fields), so the native tree copier applies;
+        # unknown subtrees inside `Any` fields fall back to deepcopy
+        # per-subtree, and the whole call falls back without the native
+        # module (tests/test_native_hostops.py pins equivalence)
+        if _hostops is not None:
+            return _hostops.tree_copy(self, _copy.deepcopy)
         return _copy.deepcopy(self)
 
     def get_id(self) -> str:

@@ -355,7 +355,13 @@ class ExtensionSpec:
 
 
 def deepcopy_spec(spec):
-    """Uniform deep-copy, standing in for the reference's generated CopyFrom."""
+    """Uniform deep-copy, standing in for the reference's generated
+    CopyFrom — native tree copier when available (specs are tree-shaped
+    dataclasses; this runs once per task the orchestrators create)."""
+    from ..native import hostops as _hostops
+
+    if _hostops is not None:
+        return _hostops.tree_copy(spec, copy.deepcopy)
     return copy.deepcopy(spec)
 
 

@@ -376,10 +376,162 @@ done:
     return ret;
 }
 
+/* ------------------------------------------------------------------ */
+/* tree_copy: fast deep copy for the store's closed object universe.
+ *
+ * StoreObject.copy() was copy.deepcopy — ~20-40 us per Task (memo dict,
+ * reduce protocol) on a path the store walks two or three times per
+ * write.  The replicated object model is TREE-shaped (no cycles, no
+ * intentional aliasing between fields) and built from: immutables
+ * (None/bool/int incl. IntEnum/float/str/bytes/Enum members/frozenset),
+ * lists, dicts (immutable keys), sets (immutable elements), tuples, and
+ * plain (non-__slots__) dataclasses.  Anything else in an `Any` field
+ * falls through to the caller-provided fallback (copy.deepcopy), so
+ * exotic payloads keep full deepcopy semantics subtree-wise.
+ */
+static PyObject *enum_class;          /* enum.Enum, cached at module init */
+static PyObject *s_dc_fields;         /* "__dataclass_fields__"          */
+static PyObject *empty_tuple;
+
+static PyObject *
+tree_copy_inner(PyObject *obj, PyObject *fallback)
+{
+    PyTypeObject *tp = Py_TYPE(obj);
+    PyObject *result = NULL;
+    int isinst;
+
+    if (obj == Py_None || obj == Py_True || obj == Py_False
+        || PyLong_Check(obj)            /* int + IntEnum members */
+        || PyUnicode_Check(obj) || PyBytes_Check(obj)
+        || PyFloat_Check(obj) || PyFrozenSet_CheckExact(obj)) {
+        Py_INCREF(obj);
+        return obj;
+    }
+    /* a cyclic object (contract breach) must fail as RecursionError,
+     * not blow the C stack; single exit point pairs the Leave */
+    if (Py_EnterRecursiveCall(" in swarmkit_tpu tree_copy"))
+        return NULL;
+
+    if (PyList_CheckExact(obj)) {
+        Py_ssize_t n = PyList_GET_SIZE(obj), i;
+        PyObject *out = PyList_New(n);
+
+        if (out == NULL)
+            goto leave;
+        for (i = 0; i < n; i++) {
+            PyObject *c = tree_copy_inner(PyList_GET_ITEM(obj, i),
+                                          fallback);
+            if (c == NULL) {
+                Py_DECREF(out);
+                goto leave;
+            }
+            PyList_SET_ITEM(out, i, c);
+        }
+        result = out;
+    } else if (PyDict_CheckExact(obj)) {
+        PyObject *out = PyDict_New(), *k, *v;
+        Py_ssize_t pos = 0;
+
+        if (out == NULL)
+            goto leave;
+        while (PyDict_Next(obj, &pos, &k, &v)) {
+            PyObject *c = tree_copy_inner(v, fallback);
+
+            if (c == NULL || PyDict_SetItem(out, k, c) < 0) {
+                Py_XDECREF(c);
+                Py_DECREF(out);
+                goto leave;
+            }
+            Py_DECREF(c);
+        }
+        result = out;
+    } else if (PyTuple_CheckExact(obj)) {
+        Py_ssize_t n = PyTuple_GET_SIZE(obj), i;
+        PyObject *out = PyTuple_New(n);
+
+        if (out == NULL)
+            goto leave;
+        for (i = 0; i < n; i++) {
+            PyObject *c = tree_copy_inner(PyTuple_GET_ITEM(obj, i),
+                                          fallback);
+            if (c == NULL) {
+                Py_DECREF(out);
+                goto leave;
+            }
+            PyTuple_SET_ITEM(out, i, c);
+        }
+        result = out;
+    } else if (PySet_CheckExact(obj)) { /* elements immutable by model;
+                                         * subclasses -> fallback */
+        result = PySet_New(obj);
+    } else if ((isinst = PyObject_IsInstance(obj, enum_class)) != 0) {
+        if (isinst > 0) {
+            Py_INCREF(obj);             /* Enum members are singletons */
+            result = obj;
+        }                               /* isinst < 0: error set, leave */
+    } else if (tp->tp_dictoffset != 0
+               && PyObject_HasAttr((PyObject *)tp, s_dc_fields)) {
+        /* plain dataclass: allocate without __init__, deep-copy the
+         * instance dict */
+        PyObject *inst, *src, *dst, *k, *v;
+        Py_ssize_t pos = 0;
+
+        inst = tp->tp_new(tp, empty_tuple, NULL);
+        if (inst == NULL)
+            goto leave;
+        src = PyObject_GenericGetDict(obj, NULL);
+        dst = PyObject_GenericGetDict(inst, NULL);
+        if (src == NULL || dst == NULL || !PyDict_Check(src)
+            || !PyDict_Check(dst)) {
+            Py_XDECREF(src);
+            Py_XDECREF(dst);
+            Py_DECREF(inst);
+            PyErr_Clear();
+            result = PyObject_CallFunctionObjArgs(fallback, obj, NULL);
+            goto leave;
+        }
+        while (PyDict_Next(src, &pos, &k, &v)) {
+            PyObject *c = tree_copy_inner(v, fallback);
+
+            if (c == NULL || PyDict_SetItem(dst, k, c) < 0) {
+                Py_XDECREF(c);
+                Py_DECREF(src);
+                Py_DECREF(dst);
+                Py_DECREF(inst);
+                goto leave;
+            }
+            Py_DECREF(c);
+        }
+        Py_DECREF(src);
+        Py_DECREF(dst);
+        result = inst;
+    } else {
+        result = PyObject_CallFunctionObjArgs(fallback, obj, NULL);
+    }
+
+leave:
+    Py_LeaveRecursiveCall();
+    return result;
+}
+
+static PyObject *
+tree_copy(PyObject *self, PyObject *args)
+{
+    PyObject *obj, *fallback;
+
+    if (!PyArg_ParseTuple(args, "OO", &obj, &fallback))
+        return NULL;
+    return tree_copy_inner(obj, fallback);
+}
+
 static PyMethodDef methods[] = {
     {"apply_segments", apply_segments, METH_VARARGS,
      "apply_segments(infos, tasks_all, oi, nodes_srt, seg_bounds, "
      "mem_by_node, cpu_by_node, gidx_srt, svc_of, fallback) -> added"},
+    {"tree_copy", tree_copy, METH_VARARGS,
+     "tree_copy(obj, fallback) -> deep copy of a tree-shaped object "
+     "built from immutables/lists/dicts/sets/tuples/plain dataclasses; "
+     "unknown subtrees go through fallback(subtree)"},
     {NULL, NULL, 0, NULL},
 };
 
@@ -391,6 +543,8 @@ static struct PyModuleDef moduledef = {
 PyMODINIT_FUNC
 PyInit__hostops(void)
 {
+    PyObject *enum_mod;
+
     s_tasks = PyUnicode_InternFromString("tasks");
     s_id = PyUnicode_InternFromString("id");
     s_mutations = PyUnicode_InternFromString("mutations");
@@ -399,8 +553,19 @@ PyInit__hostops(void)
     s_svccnt = PyUnicode_InternFromString("active_tasks_count_by_service");
     s_mem = PyUnicode_InternFromString("memory_bytes");
     s_cpus = PyUnicode_InternFromString("nano_cpus");
+    s_dc_fields = PyUnicode_InternFromString("__dataclass_fields__");
     if (!s_tasks || !s_id || !s_mutations || !s_active || !s_avail
-        || !s_svccnt || !s_mem || !s_cpus)
+        || !s_svccnt || !s_mem || !s_cpus || !s_dc_fields)
+        return NULL;
+    empty_tuple = PyTuple_New(0);
+    if (empty_tuple == NULL)
+        return NULL;
+    enum_mod = PyImport_ImportModule("enum");
+    if (enum_mod == NULL)
+        return NULL;
+    enum_class = PyObject_GetAttrString(enum_mod, "Enum");
+    Py_DECREF(enum_mod);
+    if (enum_class == NULL)
         return NULL;
     return PyModule_Create(&moduledef);
 }

@@ -27,6 +27,7 @@ import logging
 import os
 import random
 import ssl
+import tempfile
 import threading
 import time
 
@@ -312,10 +313,22 @@ class SwarmNode:
             os.makedirs(self.state_dir, exist_ok=True)
             state = self._load_state()
             state.update(updates)
-            tmp = state_path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(state, f)
-            os.replace(tmp, state_path)
+            # unique temp + atomic rename, like the identity writes (the
+            # round-3 de-flake): a restarted node briefly overlaps its
+            # predecessor's draining threads on the SAME state dir, and a
+            # shared fixed ".tmp" name let two writers interleave
+            fd, tmp = tempfile.mkstemp(prefix=".state-",
+                                       dir=self.state_dir)
+            try:
+                with os.fdopen(fd, "w") as f:
+                    json.dump(state, f)
+                os.replace(tmp, state_path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
 
     def _watch_kek_loop(self) -> None:
         """manager.go updateKEK (:743): when the replicated unlock key

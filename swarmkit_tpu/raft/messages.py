@@ -82,6 +82,9 @@ class InstallSnapshot(Message):
     snapshot_index: int = 0
     snapshot_term: int = 0
     members: dict[int, tuple[str, str]] = field(default_factory=dict)
+    # ids of REMOVED members ride with the membership so a catcher-upper
+    # learns them even when the conf changes were compacted away
+    removed: list[int] = field(default_factory=list)
     data: Any = None
     kind: str = "snapshot"
 
@@ -98,6 +101,7 @@ class SnapshotChunk(Message):
     snapshot_index: int = 0
     snapshot_term: int = 0
     members: dict[int, tuple[str, str]] = field(default_factory=dict)
+    removed: list[int] = field(default_factory=list)
     seq: int = 0
     total: int = 1
     chunk: bytes = b""

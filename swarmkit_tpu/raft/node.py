@@ -632,7 +632,8 @@ class RaftNode:
         self.leader_id = msg.frm
         self.election_elapsed = 0
         self._install_snapshot(msg.frm, msg.snapshot_index,
-                               msg.snapshot_term, msg.members, msg.data)
+                               msg.snapshot_term, msg.members, msg.data,
+                               removed=msg.removed)
 
     def _on_snapshot_chunk(self, msg):
         """Reassemble a streamed snapshot; apply when complete. Every chunk
@@ -666,10 +667,11 @@ class RaftNode:
             k: v for k, v in self._snap_chunks.items()
             if k[1] > msg.snapshot_index}
         self._install_snapshot(msg.frm, msg.snapshot_index,
-                               msg.snapshot_term, msg.members, data)
+                               msg.snapshot_term, msg.members, data,
+                               removed=msg.removed)
 
     def _install_snapshot(self, frm: int, snapshot_index: int,
-                          snapshot_term: int, members, data):
+                          snapshot_term: int, members, data, removed=()):
         if snapshot_index <= self.snapshot_index:
             return
         self.snapshot_index = snapshot_index
@@ -682,10 +684,18 @@ class RaftNode:
             rid: Peer(rid, nid, addr)
             for rid, (nid, addr) in members.items()
         }
+        # merge, don't replace: removals this node saw that the leader's
+        # snapshot predates must survive too
+        self.removed_ids |= set(removed)
         self.restore_state(data)
         if self.storage is not None:
             self.storage.save_snapshot(
-                snapshot_index, snapshot_term, data, self.members)
+                snapshot_index, snapshot_term, data, self.members,
+                removed=self.removed_ids)
+            # keep membership.json in step: load() prefers it over the
+            # snapshot's member list, so a stale file would resurrect a
+            # pre-snapshot membership on restart
+            self.storage.save_membership(self.members, self.removed_ids)
         self._send(AppendResponse(frm=self.id, to=frm, term=self.term,
                                   success=True, match_index=snapshot_index))
 
@@ -819,12 +829,14 @@ class RaftNode:
                   for i in range(0, len(blob), SNAPSHOT_CHUNK_BYTES)] or [b""]
         members = {rid: (p.node_id, p.addr)
                    for rid, p in self.members.items()}
+        removed = sorted(self.removed_ids)
         for seq, part in enumerate(chunks):
             self._send(SnapshotChunk(
                 frm=self.id, to=peer_id, term=self.term,
                 snapshot_index=self.snapshot_index,
                 snapshot_term=self.snapshot_term,
-                members=members, seq=seq, total=len(chunks), chunk=part,
+                members=members, removed=removed,
+                seq=seq, total=len(chunks), chunk=part,
             ))
         self._snap_pending[peer_id] = (self.snapshot_index,
                                        SNAPSHOT_RESEND_TICKS)
@@ -943,7 +955,8 @@ class RaftNode:
         self.first_index = self.last_applied + 1
         if self.storage is not None:
             self.storage.save_snapshot(
-                self.snapshot_index, self.snapshot_term, data, self.members)
+                self.snapshot_index, self.snapshot_term, data, self.members,
+                removed=self.removed_ids)
             self.storage.compact(self.first_index)
 
     # ------------------------------------------------------------ persistence

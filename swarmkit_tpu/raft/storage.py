@@ -156,12 +156,13 @@ class RaftStorage:
             os.replace(tmp, self._members_path)
 
     def save_snapshot(self, index: int, term: int, data: Any,
-                      members: dict[int, Peer]):
+                      members: dict[int, Peer], removed: set | None = None):
         with self._lock:
             payload = codec.dumps({
                 "index": index, "term": term, "data": data,
                 "members": {rid: (p.node_id, p.addr)
                             for rid, p in members.items()},
+                "removed": sorted(removed or ()),
             })
             tmp = self._snap_path + ".tmp"
             with open(tmp, "wb") as f:
@@ -203,6 +204,7 @@ class RaftStorage:
                 st.snapshot_data = snap["data"]
                 st.members = {rid: Peer(rid, nid, addr)
                               for rid, (nid, addr) in snap["members"].items()}
+                st.removed = {int(r) for r in snap.get("removed", ())}
             if os.path.exists(self._hs_path):
                 with open(self._hs_path) as f:
                     hs = json.load(f)

@@ -142,3 +142,113 @@ def test_native_survives_group_scale():
     assert n_n == n_p == sum(len(t) for _, t, _ in placed)
     for a, b in zip(infos_n, infos_p):
         _assert_info_state_equal(a, b)
+
+
+# ---------------------------------------------------------------- tree_copy
+
+def _rich_task(i=0):
+    from swarmkit_tpu.api.objects import Task, Version
+    from swarmkit_tpu.api.specs import (EndpointSpec, Placement,
+                                        PlacementPreference, PortConfig)
+    from swarmkit_tpu.api.types import TaskState
+
+    t = Task(id=f"copy-task-{i:03d}", service_id="svc-copy", slot=i + 1)
+    t.desired_state = TaskState.RUNNING
+    t.status.state = TaskState.ASSIGNED
+    t.status.message = "assigned"
+    t.spec.resources.reservations.nano_cpus = 2_000_000_000
+    t.spec.resources.reservations.generic = {"gpu": 2}
+    t.spec.resources.reservations.named_generic = {"fpga": {"a", "b"}}
+    t.spec.placement = Placement(
+        constraints=["node.labels.zone == a"],
+        preferences=[PlacementPreference(spread_descriptor="node.labels.r")])
+    t.endpoint = EndpointSpec(ports=[PortConfig(
+        protocol="tcp", target_port=80, published_port=8080,
+        publish_mode="host")])
+    t.spec_version = Version(3)
+    t.networks = [{"id": "netA", "addresses": ["10.0.0.4/24"]}]
+    t.assigned_generic_resources = {"gpu": (["g0", "g1"], 0)}
+    t.volumes = ["vol-1", "vol-2"]
+    return t
+
+
+def _rich_objects():
+    from swarmkit_tpu.api.objects import (Cluster, Node, NodeStatus,
+                                          RootCAObj, Service)
+    from swarmkit_tpu.api.specs import (Annotations, NodeDescription,
+                                        Resources, ServiceSpec)
+    from swarmkit_tpu.api.types import NodeStatusState, ServiceMode
+
+    svc = Service(id="copy-svc", spec=ServiceSpec(
+        annotations=Annotations(name="web", labels={"tier": "edge"}),
+        replicas=7))
+    svc.spec.mode = ServiceMode.REPLICATED
+    n = Node(id="copy-node")
+    n.description = NodeDescription(
+        hostname="h1", resources=Resources(
+            nano_cpus=8_000_000_000, memory_bytes=16 << 30,
+            generic={"gpu": 4}, named_generic={"fpga": {"x"}}),
+        engine_labels={"zone": "a"},
+        plugins=[("Volume", "benchfs")])
+    n.status = NodeStatus(state=NodeStatusState.READY, addr="10.1.2.3")
+    c = Cluster(id="copy-cluster")
+    c.root_ca = RootCAObj(ca_cert_pem=b"PEM", join_token_worker="SWMTKN-x")
+    c.blacklisted_certificates = {"cn1": {"expiry": 1.5}}
+    c.default_address_pool = ["10.0.0.0/8"]
+    return [_rich_task(0), _rich_task(1), svc, n, c]
+
+
+def test_tree_copy_equals_deepcopy_and_isolates():
+    """StoreObject.copy (native tree_copy) must equal deepcopy field-wise
+    and share NO mutable state with the original: mutating every mutable
+    leaf of the copy leaves the original bit-identical."""
+    import copy as _copy
+
+    for obj in _rich_objects():
+        snapshot = _copy.deepcopy(obj)
+        cp = obj.copy()
+        assert cp == snapshot == obj
+        assert cp is not obj
+
+        # mutate the copy everywhere a test can reach
+        def mutate(o, depth=0):
+            import dataclasses
+            if isinstance(o, dict):
+                o["__mut__"] = 1
+            elif isinstance(o, list):
+                o.append("__mut__")
+            elif isinstance(o, set):
+                o.add("__mut__")
+            if dataclasses.is_dataclass(o) and not isinstance(o, type):
+                for f in dataclasses.fields(o):
+                    v = getattr(o, f.name)
+                    if isinstance(v, (dict, list, set)) or (
+                            dataclasses.is_dataclass(v)
+                            and not isinstance(v, type)):
+                        mutate(v, depth + 1)
+
+        mutate(cp)
+        if hasattr(cp, "status"):
+            cp.status.message = "__mut__"
+        assert obj == snapshot, f"copy aliased state of {type(obj).__name__}"
+
+
+@pytest.mark.skipif(native.hostops is None, reason="no native build")
+def test_tree_copy_fallback_for_unknown_subtree():
+    """A subtree outside the closed model (here: a non-dataclass object)
+    must route through the fallback and still deep-copy correctly."""
+    import copy as _copy
+
+    class Odd:                            # not a dataclass
+        def __init__(self):
+            self.payload = [1, 2, 3]
+
+        def __eq__(self, other):
+            return isinstance(other, Odd) and other.payload == self.payload
+
+    t = _rich_task(9)
+    t.log_driver = Odd()
+    cp = native.hostops.tree_copy(t, _copy.deepcopy)
+    assert cp == t
+    cp.log_driver.payload.append(4)
+    assert t.log_driver.payload == [1, 2, 3]

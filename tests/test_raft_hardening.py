@@ -355,3 +355,69 @@ def test_catchup_after_membership_add_uses_snapshot_then_appends():
     c.tick_all(10)
     assert c.nodes[4].commit_index == leader.commit_index
     assert c.nodes[4]._last_index() == leader._last_index()
+
+
+# ------------------------------------------- removed-member bookkeeping
+
+
+def test_removed_ids_survive_snapshot_catchup():
+    """A member that catches up via snapshot must learn the REMOVED ids
+    even though the removal conf-changes were compacted away — otherwise
+    it would neither answer a removed member's messages with the removed
+    marker nor avoid re-allocating a removed raft id
+    (services.py raft_step / raft_join)."""
+    c = RaftCluster(3, snapshot_interval=10)
+    leader = c.tick_until_leader()
+
+    result = {}
+    leader.propose_conf_change(
+        ConfChange(action="remove", raft_id=next(
+            i for i in c.nodes if i != leader.id)),
+        "cc-rm", lambda ok, err: result.update(ok=ok, err=err))
+    c.settle()
+    assert result["ok"]
+    assert {m for m in leader.removed_ids} != set()
+
+    for k in range(25):               # push the removal out of the log
+        assert c.propose({"k": k})
+    assert leader.snapshot_index > 0
+
+    import random as _r
+
+    n9 = RaftNode(raft_id=9, transport=c.router.for_node(9),
+                  rng=_r.Random(7))
+    c.router.register(n9)
+    c.nodes[9] = n9
+    result = {}
+    leader.propose_conf_change(
+        ConfChange(action="add", raft_id=9, node_id="node-9",
+                   addr="mem://9"),
+        "cc-add", lambda ok, err: result.update(ok=ok, err=err))
+    c.settle()
+    assert result["ok"]
+    c.tick_all(10)
+    assert n9.commit_index == leader.commit_index
+    # the compacted removal reached the snapshot-installed member
+    assert leader.removed_ids <= n9.removed_ids
+
+
+def test_removed_ids_persist_across_restart(tmp_path):
+    """save_membership/save_snapshot carry the removed set; a restarted
+    node reloads it (the demoted-while-down marker must survive peer
+    restarts)."""
+    from swarmkit_tpu.raft.storage import RaftStorage
+
+    st = RaftStorage(str(tmp_path / "raft"))
+    c = RaftCluster(2, storages={1: st})
+    leader = c.elect(1)
+    victim = next(i for i in c.nodes if i != leader.id)
+    result = {}
+    leader.propose_conf_change(
+        ConfChange(action="remove", raft_id=victim),
+        "r", lambda ok, err: result.update(ok=ok, err=err))
+    c.settle()
+    assert result["ok"], result
+    assert victim in leader.removed_ids
+
+    loaded = RaftStorage(str(tmp_path / "raft")).load()
+    assert victim in loaded.removed
