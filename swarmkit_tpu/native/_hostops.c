@@ -461,9 +461,36 @@ tree_copy_inner(PyObject *obj, PyObject *fallback)
             PyTuple_SET_ITEM(out, i, c);
         }
         result = out;
-    } else if (PySet_CheckExact(obj)) { /* elements immutable by model;
-                                         * subclasses -> fallback */
-        result = PySet_New(obj);
+    } else if (PySet_CheckExact(obj)) {
+        /* deep-copy elements too: a mutable-but-hashable element in an
+         * Any payload must not alias the original (deepcopy semantics) */
+        PyObject *out = PySet_New(NULL), *it, *e;
+
+        if (out == NULL)
+            goto leave;
+        it = PyObject_GetIter(obj);
+        if (it == NULL) {
+            Py_DECREF(out);
+            goto leave;
+        }
+        while ((e = PyIter_Next(it)) != NULL) {
+            PyObject *c = tree_copy_inner(e, fallback);
+
+            Py_DECREF(e);
+            if (c == NULL || PySet_Add(out, c) < 0) {
+                Py_XDECREF(c);
+                Py_DECREF(it);
+                Py_DECREF(out);
+                goto leave;
+            }
+            Py_DECREF(c);
+        }
+        Py_DECREF(it);
+        if (PyErr_Occurred()) {         /* iterator failure */
+            Py_DECREF(out);
+            goto leave;
+        }
+        result = out;
     } else if ((isinst = PyObject_IsInstance(obj, enum_class)) != 0) {
         if (isinst > 0) {
             Py_INCREF(obj);             /* Enum members are singletons */
@@ -477,8 +504,14 @@ tree_copy_inner(PyObject *obj, PyObject *fallback)
         Py_ssize_t pos = 0;
 
         inst = tp->tp_new(tp, empty_tuple, NULL);
-        if (inst == NULL)
+        if (inst == NULL) {
+            /* a base class whose __new__ needs arguments: outside the
+             * plain-dataclass contract — fall back like every other
+             * unknown shape */
+            PyErr_Clear();
+            result = PyObject_CallFunctionObjArgs(fallback, obj, NULL);
             goto leave;
+        }
         src = PyObject_GenericGetDict(obj, NULL);
         dst = PyObject_GenericGetDict(inst, NULL);
         if (src == NULL || dst == NULL || !PyDict_Check(src)

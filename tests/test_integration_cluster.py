@@ -131,15 +131,26 @@ def cluster(tmp_path):
 
 
 def _create_service(cluster, name, replicas):
+    # window > one RPC call timeout (30 s): a starved host can stall an
+    # election past a single propose, and a 30 s window gave exactly one
+    # attempt — the retry existed but could never run
     ctl = cluster.control()
     try:
         svc = None
-        end = time.monotonic() + 30
+        end = time.monotonic() + 75
         while svc is None:
             try:
                 svc = ctl.create_service(ServiceSpec(
                     annotations=Annotations(name=name), replicas=replicas))
             except Exception:
+                # a timed-out create may still have committed: adopt it
+                try:
+                    hit = [s for s in ctl.list_services()
+                           if s.spec.annotations.name == name]
+                    if hit:
+                        return hit[0]
+                except Exception:
+                    pass
                 if time.monotonic() >= end:
                     raise
                 time.sleep(0.5)
