@@ -12,6 +12,8 @@ import dataclasses
 from dataclasses import dataclass, field
 from typing import Any
 
+from ..native import hostops as _hostops
+
 from .types import (
     NodeAvailability,
     NodeRole,
@@ -273,9 +275,18 @@ class DispatcherConfig:
 
 @dataclass
 class CAConfig:
+    """reference: api/specs.proto CAConfig — the operator's steering wheel
+    for the CA (controlapi/ca_rotation.go validates + applies it)."""
+
     node_cert_expiry: float = 90 * 24 * 3600.0
+    # [{"protocol": "cfssl", "url": "https://...", "ca_cert": pem?}, ...]
     external_cas: list[dict[str, Any]] = field(default_factory=list)
+    # bump to force a root rotation with a freshly generated root
     force_rotate: int = 0
+    # operator-supplied signing material: cert+key rotates to that root;
+    # cert alone requires a matching external CA entry to do the signing
+    signing_ca_cert: bytes = b""
+    signing_ca_key: bytes = b""
 
 
 @dataclass
