@@ -586,6 +586,13 @@ def bench_e2e_service_start(np):
                          if len(seen) == REPLICAS else None)
         ctl.close()
 
+        diagnosis = None
+        if len(seen) < REPLICAS:
+            # progressive-collector spirit (reference cmd/swarm-bench/
+            # collector.go): a stalled run must say WHERE the tasks sit,
+            # not just that 0/N ran
+            diagnosis = _diagnose_e2e_stall(leader, svc.id)
+
         lat = sorted(seen.values())
 
         def pct(p):
@@ -596,7 +603,7 @@ def bench_e2e_service_start(np):
             import math
             return round(lat[max(0, math.ceil(p / 100 * len(lat)) - 1)], 3)
 
-        return {
+        row = {
             "managers": 3, "workers": 5, "replicas": REPLICAS,
             "running": len(seen),
             "p50_s": pct(50), "p90_s": pct(90), "p99_s": pct(99),
@@ -604,8 +611,52 @@ def bench_e2e_service_start(np):
             if all_running_s is not None else None,
             "parity": len(seen) == REPLICAS,
         }
+        if diagnosis is not None:
+            row["diagnosis"] = diagnosis
+        return row
     finally:
         cluster.stop_all()
+
+
+def _diagnose_e2e_stall(leader, service_id):
+    """TaskState census + node states + stuck-task samples for a stalled
+    e2e row, read from the leader's replicated store. Keeps a red row
+    self-explanatory instead of `running: 0` with no trail (the round-3
+    artifact's failure mode)."""
+    from collections import Counter
+
+    from swarmkit_tpu.store import by
+
+    diag = {}
+    try:
+        tasks = leader.store.view(
+            lambda tx: tx.find_tasks(by.ByServiceID(service_id)))
+        census = Counter(t.status.state.name for t in tasks)
+        diag["task_state_census"] = dict(census)
+        diag["task_total"] = len(tasks)
+        # sample the least-advanced tasks: their err/message is where the
+        # pipeline says why it stopped
+        stuck = sorted(tasks, key=lambda t: int(t.status.state))[:5]
+        diag["stuck_samples"] = [{
+            "id": t.id, "state": t.status.state.name,
+            "desired": t.desired_state.name, "node_id": t.node_id,
+            "err": t.status.err, "message": t.status.message,
+        } for t in stuck]
+    except Exception as exc:                       # pragma: no cover
+        diag["task_census_error"] = repr(exc)
+    try:
+        nodes = leader.store.view(lambda tx: tx.find_nodes())
+        diag["node_state_census"] = dict(Counter(
+            n.status.state.name for n in nodes))
+    except Exception as exc:                       # pragma: no cover
+        diag["node_census_error"] = repr(exc)
+    try:
+        import threading
+        diag["live_threads"] = sorted({t.name.split("-")[0]
+                                       for t in threading.enumerate()})[:20]
+    except Exception:                              # pragma: no cover
+        pass
+    return diag
 
 
 def bench_host_micro(np):
@@ -785,6 +836,34 @@ def bench_host_micro(np):
     return out
 
 
+def _run_row(name, thunk):
+    """Per-row fault isolation (VERDICT r03 item 2): one row's crash must
+    not zero the whole artifact. A failed row carries its own exception +
+    traceback tail; the aggregate marks parity false but every other row
+    still reports real numbers. Progress goes to stderr so a wedged run
+    shows how far it got (the reference's swarm-bench collector reports
+    progressively, cmd/swarm-bench/collector.go)."""
+    import traceback
+
+    print(f"bench: running {name} ...", file=sys.stderr, flush=True)
+    t0 = time.perf_counter()
+    try:
+        row = thunk()
+        print(f"bench: {name} done in {time.perf_counter() - t0:.1f}s "
+              f"parity={row.get('parity')}", file=sys.stderr, flush=True)
+        return row
+    except Exception as exc:
+        tb = traceback.format_exc()
+        print(f"bench: {name} FAILED after {time.perf_counter() - t0:.1f}s: "
+              f"{exc!r}\n{tb}", file=sys.stderr, flush=True)
+        return {
+            "parity": False,
+            "error": repr(exc),
+            "traceback_tail": tb.strip().splitlines()[-12:],
+            "elapsed_s": round(time.perf_counter() - t0, 1),
+        }
+
+
 def main():
     import numpy as np
 
@@ -792,65 +871,68 @@ def main():
     from swarmkit_tpu.ops import placement as placement_ops
     from swarmkit_tpu.scheduler import batch
 
-    # FIRST, on a clean heap: the live-cluster e2e row spawns an
+    # e2e FIRST, on a clean heap: the live-cluster row spawns an
     # in-process 3-manager raft + 5 workers; after the grid configs the
     # process carries multi-GB of wave objects and GC pauses stall raft
     # writes past their timeouts (observed: create_service timeout when
     # this ran last)
-    e2e_row = bench_e2e_service_start(np)
-
-    ns = bench_scheduler_config(np, placement_ops, batch,
-                                N_NODES, N_TASKS, N_SERVICES, waves=5)
-    configs = {
-        "constraint_heavy_1k_x_1k": bench_scheduler_config(
+    rows = [
+        ("e2e_service_start_100r_3m_5w", lambda: bench_e2e_service_start(np)),
+        ("grid_100k_x_10k", lambda: bench_scheduler_config(
+            np, placement_ops, batch, N_NODES, N_TASKS, N_SERVICES,
+            waves=5)),
+        ("constraint_heavy_1k_x_1k", lambda: bench_scheduler_config(
             np, placement_ops, batch, 1_000, 1_000, 20,
-            constraint_heavy=True),
-        "binpack_10k_x_1k": bench_scheduler_config(
-            np, placement_ops, batch, 1_000, 10_000, 50, binpack=True),
+            constraint_heavy=True)),
+        ("binpack_10k_x_1k", lambda: bench_scheduler_config(
+            np, placement_ops, batch, 1_000, 10_000, 50, binpack=True)),
         # the reference benchScheduler grid (scheduler_test.go:3187-3209)
-        "grid_1k_x_1k": bench_scheduler_config(
-            np, placement_ops, batch, 1_000, 1_000, 20),
-        "grid_10k_x_1k": bench_scheduler_config(
-            np, placement_ops, batch, 1_000, 10_000, 20),
-        "grid_100k_x_1k": bench_scheduler_config(
-            np, placement_ops, batch, 1_000, 100_000, 20),
-        "grid_1m_x_10k": bench_scheduler_config(
-            np, placement_ops, batch, 10_000, 1_000_000, 100),
+        ("grid_1k_x_1k", lambda: bench_scheduler_config(
+            np, placement_ops, batch, 1_000, 1_000, 20)),
+        ("grid_10k_x_1k", lambda: bench_scheduler_config(
+            np, placement_ops, batch, 1_000, 10_000, 20)),
+        ("grid_100k_x_1k", lambda: bench_scheduler_config(
+            np, placement_ops, batch, 1_000, 100_000, 20)),
+        ("grid_1m_x_10k", lambda: bench_scheduler_config(
+            np, placement_ops, batch, 10_000, 1_000_000, 100)),
         # the reference grid's 100k-NODE half (scheduler_test.go:3187-3209):
         # 100k nodes x 1k / 100k / 1M tasks
-        "grid_1k_x_100k": bench_scheduler_config(
-            np, placement_ops, batch, 100_000, 1_000, 20),
-        "grid_100k_x_100k": bench_scheduler_config(
-            np, placement_ops, batch, 100_000, 100_000, 20),
-        "grid_1m_x_100k": bench_scheduler_config(
+        ("grid_1k_x_100k", lambda: bench_scheduler_config(
+            np, placement_ops, batch, 100_000, 1_000, 20)),
+        ("grid_100k_x_100k", lambda: bench_scheduler_config(
+            np, placement_ops, batch, 100_000, 100_000, 20)),
+        ("grid_1m_x_100k", lambda: bench_scheduler_config(
             np, placement_ops, batch, 100_000, 1_000_000, 100, waves=4,
-            depth=2),
+            depth=2)),
         # the plugin-constrained grid (scheduler_test.go:3210-3226):
         # 1-in-3 nodes carry the required volume plugin
-        "plugin_1k_x_1k": bench_scheduler_config(
+        ("plugin_1k_x_1k", lambda: bench_scheduler_config(
             np, placement_ops, batch, 1_000, 1_000, 20,
-            plugin_every=3, plugin_volume=True),
-        "plugin_10k_x_1k": bench_scheduler_config(
+            plugin_every=3, plugin_volume=True)),
+        ("plugin_10k_x_1k", lambda: bench_scheduler_config(
             np, placement_ops, batch, 1_000, 10_000, 20,
-            plugin_every=3, plugin_volume=True),
-        "plugin_100k_x_1k": bench_scheduler_config(
+            plugin_every=3, plugin_volume=True)),
+        ("plugin_100k_x_1k", lambda: bench_scheduler_config(
             np, placement_ops, batch, 1_000, 100_000, 20,
-            plugin_every=3, plugin_volume=True),
-        "plugin_100k_x_5k": bench_scheduler_config(
+            plugin_every=3, plugin_volume=True)),
+        ("plugin_100k_x_5k", lambda: bench_scheduler_config(
             np, placement_ops, batch, 5_000, 100_000, 20,
-            plugin_every=3, plugin_volume=True),
-        "global_diff_50svc_x_10k": bench_global_diff(np),
-        "raft_replay_1m_x_5": bench_raft_replay(np),
-        "host_micro": bench_host_micro(np),
-        "e2e_service_start_100r_3m_5w": e2e_row,
-    }
-    configs["grid_100k_x_10k"] = ns   # the north star IS this grid config
+            plugin_every=3, plugin_volume=True)),
+        ("global_diff_50svc_x_10k", lambda: bench_global_diff(np)),
+        ("raft_replay_1m_x_5", lambda: bench_raft_replay(np)),
+        ("host_micro", lambda: bench_host_micro(np)),
+    ]
+    configs = {name: _run_row(name, thunk) for name, thunk in rows}
+    ns = configs["grid_100k_x_10k"]   # the north star IS this grid config
 
-    parity = all(c["parity"] for c in configs.values())
+    parity = all(c.get("parity", False) for c in configs.values())
+    failed_rows = sorted(n for n, c in configs.items() if "error" in c)
     # headline: the largest reference-grid config (scheduler_test.go's grid
     # reaches 1M tasks) — end-to-end including encode + all transfers +
     # slot-order materialization, bit-identical placements required
     head = configs["grid_1m_x_10k"]
+    if "error" in head:               # fall back so value/vs_baseline exist
+        head = {"placed": 0, "tpu_tick_s": 1.0, "speedup": 0.0}
     result = {
         "metric": ("tasks scheduled/sec, steady full tick at 1M tasks x "
                    "10k nodes; placement parity vs CPU path"),
@@ -862,7 +944,9 @@ def main():
             "north_star": ns,
             "configs": configs,
             "placement_parity": parity,
-            "north_star_under_1s": bool(ns["tpu_tick_s"] < 1.0),
+            "failed_rows": failed_rows,
+            "north_star_under_1s": bool(
+                "error" not in ns and ns["tpu_tick_s"] < 1.0),
             "note": ("steady ticks run on device-RESIDENT node state "
                      "(ops/resident.py) through the tick PIPELINE "
                      "(ops/pipeline.py): deltas up, sliced int16 counts "
