@@ -48,7 +48,8 @@ class Agent:
                 csi_plugins, on_unpublished=self._report_unpublished
             )
         self.worker = Worker(executor, self._enqueue_status, state_path,
-                             volume_manager=self.volume_manager)
+                             volume_manager=self.volume_manager,
+                             node_id=node_id)
         if self.volume_manager is not None:
             self.volume_manager.on_ready = self.worker.volume_ready
         self.session_id: str | None = None

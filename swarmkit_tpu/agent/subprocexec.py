@@ -47,9 +47,18 @@ def _total_memory() -> int:
 
 
 class SubprocessController:
-    def __init__(self, task: Task, log_dir: str | None):
+    def __init__(self, task: Task, log_dir: str | None,
+                 secrets_dir: str | None = None,
+                 dependencies=None):
         self.task = task
         self.log_dir = log_dir
+        # per-task sandbox root for materialized secret/config files (the
+        # reference mounts them at /run/secrets|/run/configs inside the
+        # container, dockerexec/container.go; a process executor exposes
+        # them as files + SWARMKIT_SECRETS_DIR/SWARMKIT_CONFIGS_DIR)
+        self.secrets_root = (os.path.join(secrets_dir, task.id)
+                             if secrets_dir else None)
+        self.dependencies = dependencies  # (secrets_by_id, configs_by_id)
         self._proc: subprocess.Popen | None = None
         self._cmd: list[str] | None = None
         self._env: dict[str, str] | None = None
@@ -83,11 +92,58 @@ class SubprocessController:
         env["SWARMKIT_SERVICE_ID"] = self.task.service_id
         env["SWARMKIT_NODE_ID"] = self.task.node_id
         env["SWARMKIT_SLOT"] = str(self.task.slot)
+        self._materialize_deps(spec, env)
         self._env = env
         if self.log_dir:
             os.makedirs(self.log_dir, exist_ok=True)
             self._log_path = os.path.join(self.log_dir,
                                           f"{self.task.id}.log")
+
+    def _materialize_deps(self, spec, env: dict[str, str]):
+        """Write the task's secret/config payloads (already templated-
+        expanded by the worker's restricted getter) under the per-task
+        sandbox dir at each reference's target filename — the process-
+        executor analogue of the reference's tmpfs secret mounts
+        (dockerexec/container.go secret/config mount wiring)."""
+        if self.secrets_root is None or self.dependencies is None:
+            return
+        secrets_by_id, configs_by_id = self.dependencies
+        wrote_secret = wrote_config = False
+        for kind, refs, objs, id_attr in (
+                ("secrets", spec.secrets, secrets_by_id, "secret_id"),
+                ("configs", spec.configs, configs_by_id, "config_id")):
+            for ref in refs:
+                obj = objs.get(getattr(ref, id_attr))
+                if obj is None:
+                    raise FatalError(
+                        f"{kind[:-1]} {getattr(ref, id_attr)} not assigned "
+                        "to this node")
+                # the FULL target path relative to the sandbox dir (the
+                # reference mounts each at its target inside the container:
+                # 'db/password' and 'cache/password' are distinct files) —
+                # but never outside it
+                target = (ref.target or obj.spec.annotations.name).lstrip("/")
+                target = os.path.normpath(target)
+                if target.startswith("..") or os.path.isabs(target) \
+                        or not target or target == ".":
+                    raise FatalError(
+                        f"invalid {kind[:-1]} target {ref.target!r}")
+                d = os.path.join(self.secrets_root, kind)
+                path = os.path.join(d, target)
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                with open(path, "wb") as f:
+                    f.write(obj.spec.data)
+                os.chmod(path, 0o600)
+                if kind == "secrets":
+                    wrote_secret = True
+                else:
+                    wrote_config = True
+        if wrote_secret:
+            env["SWARMKIT_SECRETS_DIR"] = os.path.join(self.secrets_root,
+                                                       "secrets")
+        if wrote_config:
+            env["SWARMKIT_CONFIGS_DIR"] = os.path.join(self.secrets_root,
+                                                       "configs")
 
     def start(self):
         if self._cmd is None:
@@ -159,6 +215,10 @@ class SubprocessController:
                 os.unlink(self._log_path)
             except OSError:
                 pass
+        if self.secrets_root and os.path.isdir(self.secrets_root):
+            import shutil
+
+            shutil.rmtree(self.secrets_root, ignore_errors=True)
 
     def logs(self):
         """Captured output for the LogBroker (stream, bytes) tuples."""
@@ -178,6 +238,8 @@ class SubprocessExecutor:
     def __init__(self, state_dir: str | None = None, hostname: str | None = None):
         self.log_dir = (os.path.join(state_dir, "task-logs")
                         if state_dir else None)
+        self.secrets_dir = (os.path.join(state_dir, "task-deps")
+                            if state_dir else None)
         self.hostname = hostname or os.uname().nodename
 
     def describe(self) -> NodeDescription:
@@ -193,8 +255,10 @@ class SubprocessExecutor:
     def configure(self, node):
         pass
 
-    def controller(self, task: Task) -> SubprocessController:
-        return SubprocessController(task, self.log_dir)
+    def controller(self, task: Task, dependencies=None) -> SubprocessController:
+        return SubprocessController(task, self.log_dir,
+                                    secrets_dir=self.secrets_dir,
+                                    dependencies=dependencies)
 
     def set_network_bootstrap_keys(self, keys):
         pass

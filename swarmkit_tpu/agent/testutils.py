@@ -93,11 +93,14 @@ class FakeExecutor:
     def configure(self, node):
         pass
 
-    def controller(self, task: Task) -> FakeController:
+    def controller(self, task: Task, dependencies=None) -> FakeController:
         behavior = self.behavior_for.get(
             task.service_id, self.behavior_for.get("*", {})
         )
         c = FakeController(task, dict(behavior))
+        # the worker hands the task's restricted (and template-expanded)
+        # secret/config maps here; tests observe delivered payloads
+        c.dependencies = dependencies
         with self._lock:
             self.controllers.append(c)
         return c

@@ -16,10 +16,21 @@ import time
 from typing import Callable
 
 from ..api.objects import Task, TaskStatus
+from ..api.specs import deepcopy_spec
 from ..api.types import TaskState
+from ..template.context import TemplateError
 from . import exec as exec_mod
 
 RUN_PROBE_INTERVAL = 0.05  # task manager poll; reference uses 10s run probe
+
+
+def _has_template_markers(runtime) -> bool:
+    """Cheap pre-scan so template-free tasks (the overwhelming majority)
+    skip the per-start deepcopy + full expansion pass."""
+    return (any("{{" in e for e in runtime.env)
+            or "{{" in runtime.dir or "{{" in runtime.user
+            or any("{{" in (getattr(m, "source", "") or "")
+                   for m in runtime.mounts))
 
 
 class DependencyStore:
@@ -46,16 +57,44 @@ class DependencyStore:
         with self._lock:
             self._configs.pop(config_id, None)
 
-    def restricted(self, task: Task):
-        """Only the task's own references are readable (agent/dependency.go)."""
+    def restricted(self, task: Task, node=None):
+        """Only the task's own references are readable (agent/dependency.go),
+        and templated payloads come back EXPANDED — the templated dependency
+        getter (reference template/getter.go:16-121): a secret/config whose
+        spec sets `templating` is returned as a copy with its data expanded
+        against the (node, task) context; the context's secret/config maps
+        are the task's raw sibling dependencies, so a templated secret can
+        splice in another secret. Raises TemplateError on a bad template
+        (the caller maps it to task rejection)."""
         runtime = task.spec.runtime
         allowed_secrets = {r.secret_id for r in runtime.secrets} if runtime else set()
         allowed_configs = {r.config_id for r in runtime.configs} if runtime else set()
         with self._lock:
-            return (
-                {k: v for k, v in self._secrets.items() if k in allowed_secrets},
-                {k: v for k, v in self._configs.items() if k in allowed_configs},
-            )
+            secrets = {k: v for k, v in self._secrets.items()
+                       if k in allowed_secrets}
+            configs = {k: v for k, v in self._configs.items()
+                       if k in allowed_configs}
+        if any(s.spec.templating for s in secrets.values()) or \
+                any(c.spec.templating for c in configs.values()):
+            from ..template.context import Context, expand_payload
+
+            raw_s = {s.spec.annotations.name: s.spec.data
+                     for s in secrets.values()}
+            raw_c = {c.spec.annotations.name: c.spec.data
+                     for c in configs.values()}
+            ctx = Context.from_task(node, None, task,
+                                    secrets=raw_s, configs=raw_c)
+            for sid, s in list(secrets.items()):
+                if s.spec.templating:
+                    s = s.copy()
+                    s.spec.data = expand_payload(ctx, s.spec.data)
+                    secrets[sid] = s
+            for cid, c in list(configs.items()):
+                if c.spec.templating:
+                    c = c.copy()
+                    c.spec.data = expand_payload(ctx, c.spec.data)
+                    configs[cid] = c
+        return secrets, configs
 
 
 class TaskManager(threading.Thread):
@@ -74,9 +113,11 @@ class TaskManager(threading.Thread):
     def update(self, task: Task):
         with self._lock:
             prev_desired = self.task.desired_state
-            # desired state changes flow in; observed state stays ours
+            # desired state changes flow in; observed state stays ours.
+            # The spec is NOT replaced: a task's spec is immutable once
+            # created (service updates make NEW tasks), and our copy is
+            # the template-EXPANDED one — the wire version would regress it
             self.task.desired_state = task.desired_state
-            self.task.spec = task.spec
             want_shutdown = (task.desired_state >= TaskState.SHUTDOWN
                              and prev_desired < TaskState.SHUTDOWN)
         if want_shutdown:
@@ -130,16 +171,25 @@ class Worker:
     """reference: agent/worker.go."""
 
     def __init__(self, executor, report: Callable[[str, TaskStatus], None],
-                 state_path: str | None = None, volume_manager=None):
+                 state_path: str | None = None, volume_manager=None,
+                 node_id: str | None = None):
         self.executor = executor
         self.report = report
         self.state_path = state_path
+        self.node_id = node_id
         self.deps = DependencyStore()
         self.volumes = volume_manager  # NodeVolumeManager (agent/csi.py)
         self._managers: dict[str, TaskManager] = {}
         self._tasks: dict[str, Task] = {}
         # tasks parked until their CSI volumes are staged (worker waitReady)
         self._awaiting_volumes: dict[str, Task] = {}
+        self._node_view = None
+        import inspect
+        try:
+            self._controller_takes_deps = "dependencies" in \
+                inspect.signature(executor.controller).parameters
+        except (TypeError, ValueError):
+            self._controller_takes_deps = False
         self._lock = threading.Lock()
         self._load_state()
 
@@ -264,11 +314,69 @@ class Worker:
             self._tasks[task.id] = task
             return
         task = task.copy()
-        controller = self.executor.controller(task)
+        try:
+            task, secrets, configs = self._expand_task(task)
+        except TemplateError as exc:
+            # pre-start fatal: the reference's exec.Do maps failures before
+            # start to REJECTED (agent/exec/controller.go fatal handling)
+            status = exec_mod._status(task, TaskState.REJECTED, "rejected",
+                                      err=f"template expansion failed: {exc}")
+            task.status = status
+            self._tasks[task.id] = task
+            self.report(task.id, status)
+            return
+        if self._controller_takes_deps:
+            controller = self.executor.controller(
+                task, dependencies=(secrets, configs))
+        else:
+            controller = self.executor.controller(task)
         mgr = TaskManager(task, controller, self._report_and_track)
         self._managers[task.id] = mgr
         self._tasks[task.id] = task
         mgr.start()
+
+    def _node_view_obj(self):
+        """Node identity + description for the template context, built from
+        the executor's own Describe (the same source the dispatcher
+        registration advertises). A failed describe is NOT cached — the
+        next task start retries it rather than pinning every later
+        {{.Node.*}} expansion to empty strings."""
+        if self._node_view is None:
+            from types import SimpleNamespace
+
+            try:
+                desc = self.executor.describe()
+            except Exception:
+                return SimpleNamespace(id=self.node_id or "",
+                                       description=None)
+            self._node_view = SimpleNamespace(id=self.node_id or "",
+                                              description=desc)
+        return self._node_view
+
+    def _expand_task(self, task: Task):
+        """Executor-boundary template expansion (reference dockerexec/
+        container.go:68 ExpandContainerSpec + template/getter.go getters):
+        the container spec's env/dir/user/mount-sources are expanded
+        against the (node, service, task) context — with the task's own
+        restricted secret/config payloads available to `{{secret ...}}` —
+        and templated dependency payloads come back expanded. Raises
+        TemplateError; the caller rejects the task."""
+        node = self._node_view_obj()
+        secrets, configs = self.deps.restricted(task, node=node)
+        runtime = task.spec.runtime
+        if runtime is not None and hasattr(runtime, "env") \
+                and _has_template_markers(runtime):
+            from ..template.context import Context, expand_container_spec
+
+            raw_s = {s.spec.annotations.name: s.spec.data
+                     for s in secrets.values()}
+            raw_c = {c.spec.annotations.name: c.spec.data
+                     for c in configs.values()}
+            ctx = Context.from_task(node, None, task,
+                                    secrets=raw_s, configs=raw_c)
+            task.spec = deepcopy_spec(task.spec)
+            task.spec.runtime = expand_container_spec(ctx, runtime)
+        return task, secrets, configs
 
     def _shutdown_manager(self, task_id: str):
         mgr = self._managers.pop(task_id, None)

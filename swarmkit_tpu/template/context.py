@@ -33,6 +33,47 @@ class TemplateError(Exception):
     pass
 
 
+# The complete field surface (template/context.go Context struct). Paths
+# outside this set are create-time errors, like a Go template parse/exec
+# failure at controlapi/service.go:128 (validateTaskSpec → template checks).
+_KNOWN_PATHS = frozenset({
+    ".Service.ID", ".Service.Name", ".Service.Labels",
+    ".Node.ID", ".Node.Hostname", ".Node.Platform.OS",
+    ".Node.Platform.Architecture",
+    ".Task.ID", ".Task.Name", ".Task.Slot", ".Task.NodeID",
+})
+
+_ANY_BRACES = re.compile(r"\{\{.*?\}\}", re.S)
+
+
+def validate_text(text: str) -> None:
+    """Create-time validation: every `{{...}}` span must match the
+    supported placeholder grammar and name a known field. Secret/config
+    names are NOT resolved here — whether the task can read them is an
+    assignment-time question (same split as the reference: parse errors
+    reject the spec at create, missing deps fail the task)."""
+    for m in _ANY_BRACES.finditer(text):
+        pm = _PLACEHOLDER.fullmatch(m.group(0))
+        if pm is None:
+            raise TemplateError(f"invalid template expression {m.group(0)!r}")
+        path = pm.group("path")
+        if path and path not in _KNOWN_PATHS \
+                and not path.startswith(".Service.Labels."):
+            raise TemplateError(f"unknown template field {path}")
+
+
+def validate_container_spec_templates(spec) -> None:
+    """Validate every templatable ContainerSpec surface (env, dir, user,
+    mount sources — the fields ExpandContainerSpec touches)."""
+    for e in spec.env:
+        validate_text(e)
+    validate_text(spec.dir)
+    validate_text(spec.user)
+    for m in spec.mounts:
+        if getattr(m, "source", None):
+            validate_text(m.source)
+
+
 def _label_index(labels: dict[str, str]) -> str:
     return ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
 

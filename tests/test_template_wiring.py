@@ -1,0 +1,297 @@
+"""Template expansion wired end-to-end (VERDICT r03 item 3).
+
+The reference expands templated container fields and secret/config
+payloads at the executor boundary (template/getter.go:16-121,
+template/expand.go, swarmd/dockerexec/container.go:68) and validates
+templates at service create (controlapi/service.go:128). Round 3 shipped
+the template library with zero call sites; these tests pin the wiring:
+
+  * worker expands env/dir/user/mount-sources at task start;
+  * templated secret/config payloads expand in the restricted getter;
+  * a bad template REJECTS the task (pre-start fatal), a bad template in
+    a spec is refused at create;
+  * live slice: a service whose env references {{.Task.Slot}} and whose
+    templated secret splices {{.Service.Name}} reaches the worker
+    expanded.
+"""
+import time
+
+import pytest
+
+from swarmkit_tpu.agent.testutils import FakeExecutor
+from swarmkit_tpu.agent.worker import DependencyStore, Worker
+from swarmkit_tpu.api.objects import Secret, Task
+from swarmkit_tpu.api.specs import (
+    Annotations,
+    ConfigSpec,
+    ContainerSpec,
+    SecretReference,
+    SecretSpec,
+    ServiceSpec,
+    VolumeMount,
+)
+from swarmkit_tpu.api.objects import Config
+from swarmkit_tpu.api.types import TaskState
+from swarmkit_tpu.template.context import (
+    TemplateError,
+    validate_container_spec_templates,
+    validate_text,
+)
+
+from test_scheduler import wait_for
+
+
+def _mk_task(tid="t1", service="svc-web", slot=3, env=None, secrets=(),
+             configs=()):
+    t = Task(id=tid, service_id=service, slot=slot, node_id="worker-0")
+    t.service_annotations = Annotations(name="web")
+    t.desired_state = TaskState.RUNNING
+    t.status.state = TaskState.ASSIGNED
+    t.spec.runtime = ContainerSpec(
+        command=["true"], env=list(env or []),
+        secrets=list(secrets), configs=list(configs))
+    return t
+
+
+def _statuses():
+    seen = []
+
+    def report(tid, status):
+        seen.append((tid, status))
+
+    return seen, report
+
+
+def test_worker_expands_env_at_task_start():
+    ex = FakeExecutor()
+    seen, report = _statuses()
+    w = Worker(ex, report, node_id="worker-0")
+    task = _mk_task(env=["SLOT={{.Task.Slot}}",
+                        "WHO={{.Service.Name}}.{{.Node.Hostname}}",
+                        "PLAIN=x"])
+    w.update([_change(task)])
+    assert wait_for(lambda: ex.controllers, timeout=5)
+    got = ex.controllers[0].task.spec.runtime.env
+    assert "SLOT=3" in got
+    assert "WHO=web.fake-host" in got
+    assert "PLAIN=x" in got
+
+
+def test_worker_expands_mount_source_dir_user():
+    ex = FakeExecutor()
+    seen, report = _statuses()
+    w = Worker(ex, report, node_id="worker-0")
+    task = _mk_task()
+    task.spec.runtime.dir = "/data/{{.Task.ID}}"
+    task.spec.runtime.user = "{{.Service.Name}}"
+    task.spec.runtime.mounts = [
+        VolumeMount(source="vol-{{.Task.Slot}}", target="/x")]
+    w.update([_change(task)])
+    assert wait_for(lambda: ex.controllers, timeout=5)
+    rt = ex.controllers[0].task.spec.runtime
+    assert rt.dir == "/data/t1"
+    assert rt.user == "web"
+    assert rt.mounts[0].source == "vol-3"
+
+
+def test_env_secret_function_reads_restricted_secret():
+    ex = FakeExecutor()
+    seen, report = _statuses()
+    w = Worker(ex, report, node_id="worker-0")
+    sec = Secret(id="sec1", spec=SecretSpec(
+        annotations=Annotations(name="db-pass"), data=b"hunter2"))
+    w.deps.update_secret(sec)
+    task = _mk_task(env=['PASS={{secret "db-pass"}}'],
+                    secrets=[SecretReference(secret_id="sec1",
+                                             secret_name="db-pass",
+                                             target="db-pass")])
+    w.update([_change(task)])
+    assert wait_for(lambda: ex.controllers, timeout=5)
+    assert "PASS=hunter2" in ex.controllers[0].task.spec.runtime.env
+
+
+def test_templated_secret_payload_expanded_in_restricted_getter():
+    store = DependencyStore()
+    plain = Secret(id="plain", spec=SecretSpec(
+        annotations=Annotations(name="token"), data=b"abc123"))
+    templated = Secret(id="tpl", spec=SecretSpec(
+        annotations=Annotations(name="conn"),
+        data=b'host={{.Node.ID}} svc={{.Service.Name}} tok={{secret "token"}}',
+        templating=True))
+    store.update_secret(plain)
+    store.update_secret(templated)
+    task = _mk_task(secrets=[
+        SecretReference(secret_id="plain", secret_name="token",
+                        target="token"),
+        SecretReference(secret_id="tpl", secret_name="conn", target="conn")])
+
+    class NodeView:
+        id = "node-9"
+        description = None
+
+    secrets, _ = store.restricted(task, node=NodeView())
+    assert secrets["plain"].spec.data == b"abc123"
+    assert secrets["tpl"].spec.data == b"host=node-9 svc=web tok=abc123"
+    # the store's own object must NOT be mutated by expansion
+    assert templated.spec.data.startswith(b"host={{")
+
+
+def test_templated_config_payload_expanded():
+    store = DependencyStore()
+    cfg = Config(id="c1", spec=ConfigSpec(
+        annotations=Annotations(name="app-conf"),
+        data=b"slot={{.Task.Slot}}", templating=True))
+    store.update_config(cfg)
+    from swarmkit_tpu.api.specs import ConfigReference
+    task = _mk_task(configs=[ConfigReference(config_id="c1",
+                                             config_name="app-conf",
+                                             target="app.conf")])
+    _, configs = store.restricted(task)
+    assert configs["c1"].spec.data == b"slot=3"
+
+
+def test_bad_template_rejects_task_pre_start():
+    ex = FakeExecutor()
+    seen, report = _statuses()
+    w = Worker(ex, report, node_id="worker-0")
+    # references a secret the task is NOT assigned -> TemplateError ->
+    # REJECTED (exec.Do pre-start fatal mapping)
+    task = _mk_task(env=['X={{secret "nope"}}'])
+    w.update([_change(task)])
+    assert wait_for(lambda: seen, timeout=5)
+    tid, status = seen[0]
+    assert tid == "t1"
+    assert status.state == TaskState.REJECTED
+    assert "template expansion failed" in status.err
+    assert not ex.controllers          # no controller was ever created
+
+
+def test_materialized_dep_targets_keep_full_paths(tmp_path):
+    """Code-review regression: 'db/password' and 'cache/password' are
+    DISTINCT files under the sandbox (basename collapsing silently
+    overwrote one with the other); traversal escapes are fatal."""
+    from swarmkit_tpu.agent.exec import FatalError
+    from swarmkit_tpu.agent.subprocexec import SubprocessController
+
+    def mk(tid, targets):
+        secrets, refs = {}, []
+        for i, tgt in enumerate(targets):
+            sid = f"s{i}"
+            secrets[sid] = Secret(id=sid, spec=SecretSpec(
+                annotations=Annotations(name=f"name{i}"),
+                data=f"payload-{i}".encode()))
+            refs.append(SecretReference(secret_id=sid,
+                                        secret_name=f"name{i}", target=tgt))
+        t = _mk_task(tid=tid, secrets=refs)
+        return SubprocessController(
+            t, None, secrets_dir=str(tmp_path),
+            dependencies=(secrets, {})), t
+
+    ctrl, t = mk("tA", ["db/password", "cache/password"])
+    env = {}
+    ctrl._materialize_deps(t.spec.runtime, env)
+    base = tmp_path / "tA" / "secrets"
+    assert (base / "db" / "password").read_bytes() == b"payload-0"
+    assert (base / "cache" / "password").read_bytes() == b"payload-1"
+    assert env["SWARMKIT_SECRETS_DIR"] == str(base)
+
+    ctrl2, t2 = mk("tB", ["../escape"])
+    with pytest.raises(FatalError, match="invalid secret target"):
+        ctrl2._materialize_deps(t2.spec.runtime, {})
+
+
+def test_validate_text_catalogue():
+    validate_text("plain")
+    validate_text("{{.Task.Slot}}/{{.Service.Labels.foo}}")
+    validate_text('{{secret "x"}}{{config "y"}}{{env "Z"}}')
+    with pytest.raises(TemplateError):
+        validate_text("{{.Bogus.Field}}")
+    with pytest.raises(TemplateError):
+        validate_text("{{ not a template }}")
+    with pytest.raises(TemplateError):
+        validate_text('{{range .}}{{end}}')
+
+
+def test_validate_container_spec_templates():
+    spec = ContainerSpec(env=["A={{.Task.ID}}"], dir="{{.Node.Hostname}}")
+    validate_container_spec_templates(spec)
+    spec.env.append("B={{.Nope}}")
+    with pytest.raises(TemplateError):
+        validate_container_spec_templates(spec)
+
+
+def test_create_service_rejects_invalid_template():
+    from swarmkit_tpu.controlapi.control import ControlAPI, InvalidArgument
+    from swarmkit_tpu.store.memory import MemoryStore
+
+    ctl = ControlAPI(MemoryStore())
+    spec = ServiceSpec(annotations=Annotations(name="bad"), replicas=1)
+    spec.task.runtime = ContainerSpec(command=["true"],
+                                      env=["X={{.Task.Bogus}}"])
+    with pytest.raises(InvalidArgument):
+        ctl.create_service(spec)
+    # valid templates pass
+    spec2 = ServiceSpec(annotations=Annotations(name="good"), replicas=1)
+    spec2.task.runtime = ContainerSpec(command=["true"],
+                                       env=["X={{.Task.Slot}}"])
+    ctl.create_service(spec2)
+
+
+def _change(task):
+    from swarmkit_tpu.dispatcher.dispatcher import Assignment
+
+    return Assignment(action="update", kind="task", item=task)
+
+
+def test_live_slice_worker_observes_expanded_values():
+    """The VERDICT done-criterion: a live cluster where a task's env
+    references {{.Task.Slot}} and a templated secret, and the worker
+    observes the expanded value."""
+    from test_e2e_slice import MiniCluster
+
+    from swarmkit_tpu.api.objects import Service
+    from swarmkit_tpu.store import by
+
+    c = MiniCluster(n_agents=2,
+                    behaviors={"svc-tpl": {"run_forever": True}})
+    c.start()
+    try:
+        sec = Secret(id="sec-tpl", spec=SecretSpec(
+            annotations=Annotations(name="greeting"),
+            data=b"hello {{.Service.Name}} slot {{.Task.Slot}}",
+            templating=True))
+        c.store.update(lambda tx: tx.create(sec))
+
+        svc = Service(id="svc-tpl")
+        svc.spec = ServiceSpec(annotations=Annotations(name="tpl"),
+                               replicas=2)
+        svc.spec.task.runtime = ContainerSpec(
+            command=["run"],
+            env=["MY_SLOT={{.Task.Slot}}", "MY_NODE={{.Node.ID}}"],
+            secrets=[SecretReference(secret_id="sec-tpl",
+                                     secret_name="greeting",
+                                     target="greeting")])
+        svc.spec_version.index = 1
+        c.store.update(lambda tx: tx.create(svc))
+
+        assert wait_for(lambda: len(c.running_tasks("svc-tpl")) == 2,
+                        timeout=15)
+        # every fake controller observed fully-expanded env + payload
+        ctrls = [ctrl for ex in c.executors.values()
+                 for ctrl in ex.controllers]
+        assert len(ctrls) == 2
+        slots = set()
+        for ctrl in ctrls:
+            env = dict(e.split("=", 1) for e in ctrl.task.spec.runtime.env)
+            assert env["MY_NODE"] == ctrl.task.node_id
+            assert env["MY_SLOT"].isdigit()
+            slots.add(env["MY_SLOT"])
+            secrets_by_id, _ = ctrl.dependencies
+            payload = secrets_by_id["sec-tpl"].spec.data.decode()
+            assert payload == f"hello tpl slot {env['MY_SLOT']}"
+        assert slots == {"1", "2"}
+        # the manager-side store object stays unexpanded
+        stored = c.store.view().get_secret("sec-tpl")
+        assert b"{{.Service.Name}}" in stored.spec.data
+    finally:
+        c.stop()
