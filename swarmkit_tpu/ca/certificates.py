@@ -205,6 +205,20 @@ class RootCA:
     def without_key(self) -> "RootCA":
         return RootCA(self.cert_pem)
 
+    def key_matches_cert(self) -> bool:
+        """True iff the held private key is the one the certificate was
+        issued for (reference ca_rotation.go validateCAConfig rejects a
+        signing cert whose key doesn't match before starting a rotation)."""
+        if self._key is None:
+            return False
+        ours = self._key.public_key().public_bytes(
+            serialization.Encoding.DER,
+            serialization.PublicFormat.SubjectPublicKeyInfo)
+        theirs = self._cert.public_key().public_bytes(
+            serialization.Encoding.DER,
+            serialization.PublicFormat.SubjectPublicKeyInfo)
+        return ours == theirs
+
     # -- signing -----------------------------------------------------------
 
     def sign_csr(

@@ -269,17 +269,23 @@ class CAServer:
             RootCA(rot0["new_ca_cert_pem"], rot0["new_ca_key_pem"],
                    intermediate_pem=rot0["cross_signed_pem"])
             if rot0 else self.root)
+        # external signer for this pass: the constructor-time one, or one
+        # configured live through ClusterSpec.CAConfig.external_cas (the
+        # control-API path; reference watches the cluster object the same
+        # way). A key-less signing root (rotation to an operator cert
+        # whose key an external CA holds) REQUIRES it.
+        pass_external = self._external_signer()
         for node in pending:
             signing_root = pass_signing_root
             observed_state = node.certificate.status_state
             signed_csr = node.certificate.csr_pem
             try:
-                if self.external_ca is not None:
+                if pass_external is not None:
                     from .certificates import parse_cert_identity
                     from .external import ExternalCAError
 
                     try:
-                        cert_pem = self.external_ca.sign(signed_csr)
+                        cert_pem = pass_external.sign(signed_csr)
                     except ExternalCAError:
                         continue  # transient: stays PENDING, retried
                     # the external service signs the CSR's self-asserted
@@ -383,6 +389,39 @@ class CAServer:
             return None
         return cluster.root_ca.root_rotation
 
+    def _external_signer(self):
+        """The active external CA: the constructor-injected one (swarmd
+        --external-ca) wins; otherwise build one from the replicated
+        ClusterSpec.CAConfig.external_cas — the control-API configuration
+        path (reference ca/server.go UpdateRootCA external CA wiring).
+        Cached per (url, pinned cert) so steady passes don't rebuild TLS
+        contexts."""
+        if self.external_ca is not None:
+            return self.external_ca
+        cluster = self.store.view(
+            lambda tx: tx.get_cluster(self.cluster_id))
+        entries = (cluster.spec.ca.external_cas
+                   if cluster is not None else None) or []
+        entry = next((e for e in entries
+                      if isinstance(e, dict)
+                      and (e.get("protocol") or "cfssl") == "cfssl"
+                      and e.get("url")), None)
+        if entry is None:
+            self._spec_external = None
+            return None
+        ca_cert = entry.get("ca_cert") or None
+        if isinstance(ca_cert, str):
+            ca_cert = ca_cert.encode()
+        key = (entry["url"], ca_cert)
+        cached = getattr(self, "_spec_external", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        from .external import ExternalCA
+
+        signer = ExternalCA(entry["url"], trust_root_pem=ca_cert)
+        self._spec_external = (key, signer)
+        return signer
+
     def _signing_root(self) -> RootCA:
         rot = self._rotation()
         if rot:
@@ -410,7 +449,7 @@ class CAServer:
         from a post-rotation CSR — i.e. the node itself fetched and swapped
         it. Re-signing old CSRs server-side would let the anchor swap race
         ahead of what nodes actually present on the wire."""
-        if self.external_ca is not None:
+        if self._external_signer() is not None:
             # the external service signs under the OLD root's key; certs it
             # issues can never chain to a locally minted new root, so the
             # reconciler could never finish — fail fast instead of wedging

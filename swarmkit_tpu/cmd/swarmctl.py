@@ -108,6 +108,27 @@ def cmd_service_create(args):
     )
     spec.task.placement.constraints = list(args.constraint or [])
     ctl = _control(args)
+    # --secret/--config NAME[:TARGET]: resolve name -> id and attach a
+    # reference (reference swarmctl/service/flagparser/secret.go)
+    from ..api.specs import ConfigReference, SecretReference
+    from ..controlapi.control import ListFilters
+
+    for ref in args.secret or []:
+        name, _, target = ref.partition(":")
+        found = ctl.list_secrets(ListFilters(names=[name]))
+        if not found:
+            _die(f"secret {name!r} not found")
+        runtime.secrets.append(SecretReference(
+            secret_id=found[0].id, secret_name=name,
+            target=target or name))
+    for ref in args.config or []:
+        name, _, target = ref.partition(":")
+        found = ctl.list_configs(ListFilters(names=[name]))
+        if not found:
+            _die(f"config {name!r} not found")
+        runtime.configs.append(ConfigReference(
+            config_id=found[0].id, config_name=name,
+            target=target or name))
     for ref in args.network or []:
         n = _find_network(ctl, ref)
         from ..api.specs import NetworkAttachmentConfig
@@ -364,13 +385,17 @@ def cmd_cluster_inspect(args):
     print(json.dumps(out, indent=2))
 
 
-def _update_cluster_retry(ctl, **rotations):
+def _update_cluster_retry(ctl, mutate_spec=None, **rotations):
     """Version-checked update raced by background cluster writers
-    (keymanager etc.): retry on sequence conflicts like any client."""
+    (keymanager etc.): retry on sequence conflicts like any client.
+    `mutate_spec(spec)` re-applies the caller's spec edits on every
+    attempt (each retry starts from a FRESH read)."""
     import time as _time
 
     for _ in range(20):
         c = ctl.list_clusters()[0]
+        if mutate_spec is not None:
+            mutate_spec(c.spec)
         try:
             return ctl.update_cluster(c.id, c.meta.version, c.spec,
                                       **rotations)
@@ -382,10 +407,49 @@ def _update_cluster_retry(ctl, **rotations):
 
 
 def cmd_cluster_update(args):
-    """Token rotation (reference swarmctl/cluster/update.go)."""
+    """Token rotation + CA steering (reference swarmctl/cluster/update.go;
+    CA flags mirror `docker swarm ca --rotate` / update-cluster CAConfig)."""
     ctl = _control(args)
+
+    def mutate_spec(spec):
+        if getattr(args, "rotate_ca", False):
+            spec.ca.force_rotate += 1
+            if not getattr(args, "signing_ca_cert", None):
+                # a fresh-root rotation: clear any stale signing pin so
+                # the API can't read residue as intent to re-target it
+                spec.ca.signing_ca_cert = b""
+                spec.ca.signing_ca_key = b""
+        cert_path = getattr(args, "signing_ca_cert", None)
+        key_path = getattr(args, "signing_ca_key", None)
+        if cert_path:
+            with open(cert_path, "rb") as f:
+                spec.ca.signing_ca_cert = f.read()
+        if key_path:
+            with open(key_path, "rb") as f:
+                spec.ca.signing_ca_key = f.read()
+        if getattr(args, "external_ca", None):
+            entries = []
+            for spec_str in args.external_ca:
+                # url[,ca_cert=<path>] — protocol is always cfssl (the only
+                # one the reference supports in-tree, cli/external_ca.go)
+                parts = spec_str.split(",")
+                entry = {"protocol": "cfssl", "url": parts[0]}
+                for extra in parts[1:]:
+                    k, _, v = extra.partition("=")
+                    if k == "ca_cert":
+                        with open(v, "rb") as f:
+                            entry["ca_cert"] = f.read()
+                    elif k == "protocol":
+                        entry["protocol"] = v
+                    else:
+                        _die(f"unknown external-ca option {k!r}")
+                entries.append(entry)
+            spec.ca.external_cas = entries
+        if getattr(args, "cert_expiry", None):
+            spec.ca.node_cert_expiry = float(args.cert_expiry)
+
     c = _update_cluster_retry(
-        ctl,
+        ctl, mutate_spec=mutate_spec,
         rotate_worker_token=args.rotate_worker_token,
         rotate_manager_token=args.rotate_manager_token,
         rotate_unlock_key=args.rotate_unlock_key)
@@ -393,6 +457,10 @@ def cmd_cluster_update(args):
         print(f"SWARM_WORKER_TOKEN={c.root_ca.join_token_worker}")
     if args.rotate_manager_token:
         print(f"SWARM_MANAGER_TOKEN={c.root_ca.join_token_manager}")
+    if getattr(args, "rotate_ca", False) or getattr(args, "signing_ca_cert",
+                                                    None):
+        rot = c.root_ca.root_rotation if c.root_ca else None
+        print("CA_ROTATION=in-progress" if rot else "CA_ROTATION=complete")
 
 
 def cmd_cluster_unlockkey(args):
@@ -508,8 +576,10 @@ def cmd_secret_create(args):
     from ..api.specs import Annotations, SecretSpec
 
     ctl = _control(args)
-    s = ctl.create_secret(SecretSpec(annotations=Annotations(name=args.name),
-                                     data=_read_data(args)))
+    s = ctl.create_secret(SecretSpec(
+        annotations=Annotations(name=args.name),
+        data=_read_data(args),
+        templating=bool(getattr(args, "templating", False))))
     print(s.id)
 
 
@@ -579,8 +649,10 @@ def cmd_config_create(args):
     from ..api.specs import Annotations, ConfigSpec
 
     ctl = _control(args)
-    c = ctl.create_config(ConfigSpec(annotations=Annotations(name=args.name),
-                                     data=_read_data(args)))
+    c = ctl.create_config(ConfigSpec(
+        annotations=Annotations(name=args.name),
+        data=_read_data(args),
+        templating=bool(getattr(args, "templating", False))))
     print(c.id)
 
 
@@ -764,6 +836,10 @@ def main(argv=None) -> int:
                    help="publish a port, e.g. 80, 80:8080, 53:53/udp")
     p.add_argument("--publish-mode", default="ingress",
                    choices=["ingress", "host"])
+    p.add_argument("--secret", action="append", metavar="NAME[:TARGET]",
+                   help="attach a secret by name; repeatable")
+    p.add_argument("--config", action="append", metavar="NAME[:TARGET]",
+                   help="attach a config by name; repeatable")
     p.add_argument("--update-parallelism", type=int, default=None)
     p.add_argument("--update-delay", type=float, default=None)
     p.set_defaults(func=cmd_service_create)
@@ -854,6 +930,16 @@ def main(argv=None) -> int:
     p.add_argument("--rotate-worker-token", action="store_true")
     p.add_argument("--rotate-manager-token", action="store_true")
     p.add_argument("--rotate-unlock-key", action="store_true")
+    p.add_argument("--rotate-ca", action="store_true",
+                   help="force a root CA rotation to a fresh root")
+    p.add_argument("--signing-ca-cert", metavar="PEM_FILE",
+                   help="rotate to this root certificate")
+    p.add_argument("--signing-ca-key", metavar="PEM_FILE",
+                   help="private key for --signing-ca-cert")
+    p.add_argument("--external-ca", action="append", metavar="URL[,opts]",
+                   help="external cfssl CA: url[,ca_cert=path]; repeatable")
+    p.add_argument("--cert-expiry", type=float, default=None,
+                   help="node certificate lifetime in seconds")
     p.set_defaults(func=cmd_cluster_update)
     p = cluster.add_parser("unlockkey")
     p.add_argument("--rotate", action="store_true")
@@ -880,6 +966,9 @@ def main(argv=None) -> int:
     p.add_argument("name")
     p.add_argument("--data", default=None,
                    help="literal value (default: read stdin)")
+    p.add_argument("--templating", action="store_true",
+                   help="expand template placeholders in the payload at "
+                        "delivery (reference SecretSpec.Templating)")
     p.set_defaults(func=cmd_secret_create)
     p = sec.add_parser("ls")
     p.set_defaults(func=cmd_secret_ls)
@@ -891,6 +980,9 @@ def main(argv=None) -> int:
     p = cfg.add_parser("create")
     p.add_argument("name")
     p.add_argument("--data", default=None)
+    p.add_argument("--templating", action="store_true",
+                   help="expand template placeholders in the payload at "
+                        "delivery (reference ConfigSpec.Templating)")
     p.set_defaults(func=cmd_config_create)
     p = cfg.add_parser("ls")
     p.set_defaults(func=cmd_config_ls)

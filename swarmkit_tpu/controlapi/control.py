@@ -285,6 +285,20 @@ class ControlAPI:
             self._validate_refs(runtime.secrets, "secret")
             self._validate_refs(runtime.configs, "config")
             self._validate_mounts(getattr(runtime, "mounts", []) or [])
+            # templated fields must parse at create time (service.go:128
+            # validateTaskSpec → template errors reject the spec); bad
+            # templates otherwise surface only as per-task REJECTED at the
+            # worker, silently from the operator's seat
+            if hasattr(runtime, "env"):
+                from ..template.context import (
+                    TemplateError,
+                    validate_container_spec_templates,
+                )
+
+                try:
+                    validate_container_spec_templates(runtime)
+                except TemplateError as e:
+                    raise InvalidArgument(f"invalid template: {e}")
             for ref in runtime.secrets:
                 if tx.get_secret(ref.secret_id) is None:
                     raise InvalidArgument(
@@ -589,6 +603,11 @@ class ControlAPI:
                 rot = dict(c.root_ca.root_rotation)
                 rot.pop("new_ca_key_pem", None)
                 c.root_ca.root_rotation = rot
+        if getattr(c.spec.ca, "signing_ca_key", b""):
+            # operator-supplied signing key is as sensitive as the root key;
+            # update_cluster restores it from the stored spec when the same
+            # signing cert comes back key-less (redacted round-trip)
+            c.spec.ca.signing_ca_key = b""
         return c
 
     def get_cluster(self, cluster_id: str) -> Cluster:
@@ -615,12 +634,141 @@ class ControlAPI:
             return c.root_ca.get("unlock_key", "")
         return ""
 
+    @staticmethod
+    def _validate_ca_config(cur, spec: ClusterSpec) -> None:
+        """reference controlapi/ca_rotation.go validateCAConfig:190-302:
+        external-CA URL/protocol validation, signing cert/key pairing and
+        match, cert-without-key must name an external CA for that root."""
+        import urllib.parse
+
+        from ..ca import RootCA
+
+        cfg = spec.ca
+        # tolerate redacted round-trips FIRST (reference validateCAConfig
+        # does the same): an unchanged signing cert arriving key-less —
+        # list/inspect strip the key — reuses the stored key
+        if cfg.signing_ca_cert and not cfg.signing_ca_key \
+                and cfg.signing_ca_cert == cur.spec.ca.signing_ca_cert \
+                and cur.spec.ca.signing_ca_key:
+            cfg.signing_ca_key = cur.spec.ca.signing_ca_key
+        if cfg.signing_ca_key and not cfg.signing_ca_cert:
+            raise InvalidArgument(
+                "if a signing CA key is provided, the signing CA cert must "
+                "also be provided")
+        for ext in cfg.external_cas:
+            proto = (ext.get("protocol") or "cfssl") \
+                if isinstance(ext, dict) else None
+            if proto != "cfssl":
+                raise InvalidArgument(
+                    f"unknown external CA protocol {proto!r}")
+            url = ext.get("url", "")
+            parsed = urllib.parse.urlparse(url)
+            if parsed.scheme != "https" or not parsed.netloc:
+                raise InvalidArgument(
+                    f"invalid HTTPS URL for external CA: {url!r}")
+            ca_cert = ext.get("ca_cert")
+            if ca_cert:
+                try:
+                    RootCA(ca_cert if isinstance(ca_cert, bytes)
+                           else ca_cert.encode())
+                except Exception:
+                    raise InvalidArgument(
+                        "external CA entry carries an unparseable CA "
+                        "certificate")
+        if cfg.signing_ca_cert:
+            try:
+                desired = RootCA(cfg.signing_ca_cert,
+                                 cfg.signing_ca_key or None)
+            except Exception:
+                raise InvalidArgument(
+                    "signing CA cert/key material is not valid PEM")
+            if cfg.signing_ca_key:
+                if not desired.key_matches_cert():
+                    raise InvalidArgument(
+                        "signing CA cert does not match the signing CA key")
+            else:
+                norm = cfg.signing_ca_cert.strip()
+                ext_certs = []
+                for ext in cfg.external_cas:
+                    c = ext.get("ca_cert") or b""
+                    if isinstance(c, str):
+                        c = c.encode()
+                    ext_certs.append(c.strip())
+                if norm not in ext_certs:
+                    raise InvalidArgument(
+                        "a signing CA cert without a key requires an "
+                        "external CA entry for that certificate")
+
+    @staticmethod
+    def _maybe_kick_ca_rotation(cur, nxt) -> None:
+        """Begin a phased root rotation when the CAConfig asks for one
+        (reference ca_rotation.go newRootRotationObject:190-302 via
+        UpdateCluster): a bumped ForceRotate counter rotates to a freshly
+        generated root; a new signing cert(+key) rotates to that root. The
+        record written here is the SAME one `CAServer.rotate_root_ca`
+        writes — the CA server's reconciler drives it to completion
+        (nodes re-CSR under the new epoch) with no further control-API
+        involvement."""
+        from ..ca import RootCA
+        from ..ca.certificates import parse_cert_identity
+
+        cfg = nxt.spec.ca
+        cur_cfg = cur.spec.ca
+        rca = nxt.root_ca
+        force = cfg.force_rotate != cur_cfg.force_rotate
+        in_flight = b""
+        if rca is not None and rca.root_rotation:
+            in_flight = rca.root_rotation.get("new_ca_cert_pem", b"")
+        want_cert = cfg.signing_ca_cert
+        # a rotation is OPERATOR INTENT, not spec residue: the signing cert
+        # only triggers when it CHANGED in this update (or rides a
+        # force-rotate bump). A stale signing_ca_cert left in the spec from
+        # a completed rotation must not silently re-kick one on the next
+        # unrelated update (e.g. token rotation round-tripping the spec).
+        cert_changed = bool(want_cert) \
+            and want_cert.strip() != cur_cfg.signing_ca_cert.strip()
+        cert_is_new = bool(want_cert) and rca is not None \
+            and want_cert.strip() != rca.ca_cert_pem.strip() \
+            and want_cert.strip() != in_flight.strip()
+        cert_rotation = cert_is_new and (cert_changed or force)
+        if not (force or cert_rotation):
+            return
+        if rca is None or not rca.ca_cert_pem:
+            raise FailedPrecondition("cluster has no root CA to rotate")
+        old = RootCA(rca.ca_cert_pem, rca.ca_key_pem or None)
+        if not old.can_sign:
+            raise FailedPrecondition(
+                "current root key is unavailable (externally held); "
+                "cross-signing the new root requires it")
+        if cert_rotation:
+            new_root = RootCA(want_cert, cfg.signing_ca_key or None)
+        else:
+            if force and want_cert and not cert_is_new:
+                # force-rotate with the CURRENT root as signing cert: the
+                # operator asked for fresh material, drop the stale pin so
+                # later updates can't read it as intent
+                cfg.signing_ca_cert = b""
+                cfg.signing_ca_key = b""
+            try:
+                org = parse_cert_identity(rca.ca_cert_pem).org
+            except Exception:
+                org = "swarmkit-tpu"
+            new_root = RootCA.create(org or "swarmkit-tpu")
+        cross = old.cross_sign(new_root)
+        rca.root_rotation = {
+            "new_ca_cert_pem": new_root.cert_pem,
+            "new_ca_key_pem": new_root.key_pem or b"",
+            "cross_signed_pem": cross,
+        }
+        rca.last_forced_rotation += 1
+
     def update_cluster(self, cluster_id: str, version: Version,
                        spec: ClusterSpec,
                        rotate_worker_token: bool = False,
                        rotate_manager_token: bool = False,
                        rotate_unlock_key: bool = False) -> Cluster:
-        """reference: cluster.go UpdateCluster — spec swap + token rotation."""
+        """reference: cluster.go UpdateCluster — spec swap + token rotation
+        + CAConfig-driven root rotation (ca_rotation.go)."""
         out: list[Cluster] = []
 
         def cb(tx):
@@ -629,8 +777,10 @@ class ControlAPI:
                 raise NotFound(f"cluster {cluster_id} not found")
             if cur.meta.version.index != version.index:
                 raise FailedPrecondition("update out of sequence")
+            self._validate_ca_config(cur, spec)
             nxt = cur.copy()
             nxt.spec = spec
+            self._maybe_kick_ca_rotation(cur, nxt)
             # token rotation mints REAL digest-pinned join tokens against
             # the cluster's root (cluster.go UpdateCluster rotation; a
             # token that doesn't pin the root digest would be rejected by

