@@ -10,6 +10,10 @@ disaster-inspection tool you reach for when a manager won't start.
     python -m swarmkit_tpu.cmd.rafttool dump-snapshot --state-dir /tmp/m1
     python -m swarmkit_tpu.cmd.rafttool dump-object --state-dir /tmp/m1 \
         --kind tasks
+    python -m swarmkit_tpu.cmd.rafttool renewcert --state-dir /tmp/m1
+
+renewcert re-issues an expired manager TLS cert offline from the CA
+material in the raft log (reference swarm-rafttool/renewcert.go).
 """
 from __future__ import annotations
 
@@ -121,8 +125,9 @@ def cmd_dump_snapshot(args):
     print(json.dumps(out, indent=2))
 
 
-def cmd_dump_object(args):
-    """Reconstruct the store at the WAL tail and dump one table."""
+def _replay_store(args):
+    """Reconstruct the replicated store at the WAL tail (snapshot + WAL
+    replay through the same proposer seam the live manager uses)."""
     from ..raft.node import RaftNode
     from ..raft.proposer import RaftProposer
     from ..store.memory import MemoryStore
@@ -140,6 +145,12 @@ def cmd_dump_object(args):
     proposer = RaftProposer(node)
     store = MemoryStore(proposer=proposer)
     proposer.attach_store(store)  # replays snapshot + WAL into the store
+    return store
+
+
+def cmd_dump_object(args):
+    """Reconstruct the store at the WAL tail and dump one table."""
+    store = _replay_store(args)
 
     finders = {
         "tasks": lambda tx: tx.find_tasks(),
@@ -159,6 +170,58 @@ def cmd_dump_object(args):
         print(json.dumps(_jsonable(o)))
 
 
+def cmd_renewcert(args):
+    """Offline TLS-certificate renewal from a downed manager's own state
+    dir (reference swarm-rafttool/renewcert.go:16-101): an EXPIRED manager
+    cert can't reach any CA server — nothing will accept the dial — so
+    the cert is re-issued directly from the cluster CA material in the
+    raft log. Preserves the node's CN/OU/O identity and the key file's
+    headers (the raft DEKs live there); refreshes ca.pem in case the
+    trust anchor rotated while the node was down."""
+    from ..ca import KeyReadWriter
+    from ..ca.certificates import RootCA, create_csr, parse_cert_identity
+
+    key_path = os.path.join(args.state_dir, "key.json")
+    cert_path = os.path.join(args.state_dir, "cert.pem")
+    kek = args.kek.encode() if args.kek else None
+    krw = KeyReadWriter(key_path, kek)
+    try:
+        _old_key, headers = krw.read()
+        with open(cert_path, "rb") as f:
+            old_cert = f.read()
+    except OSError as exc:
+        _die(f"cannot load node identity: {exc}")
+    # identity from the (possibly expired) cert — expiry is irrelevant,
+    # only the subject matters; a new cert is issued regardless
+    ident = parse_cert_identity(old_cert)
+
+    store = _replay_store(args)
+    clusters = store.view(lambda tx: tx.find_clusters())
+    if not clusters:
+        _die("no cluster object in the raft log; cannot renew")
+    rca = clusters[0].root_ca
+    if rca is None or not rca.ca_cert_pem or not rca.ca_key_pem:
+        _die("no CA key material in the raft log (external CA?); "
+             "cannot renew offline")
+    expiry = clusters[0].spec.ca.node_cert_expiry
+    root = RootCA(rca.ca_cert_pem, rca.ca_key_pem)
+
+    new_key, csr = create_csr(ident.node_id, ident.role, ident.org)
+    new_cert = root.sign_csr(csr, expiry=expiry,
+                             subject=(ident.node_id, ident.role, ident.org))
+    krw.write(new_key, headers)        # headers (raft DEKs) ride along
+    tmp = cert_path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(new_cert)
+    os.replace(tmp, cert_path)
+    ca_tmp = os.path.join(args.state_dir, "ca.pem.tmp")
+    with open(ca_tmp, "wb") as f:
+        f.write(root.cert_pem)
+    os.replace(ca_tmp, os.path.join(args.state_dir, "ca.pem"))
+    print(json.dumps({"renewed": ident.node_id,
+                      "role": ident.role, "org": ident.org}))
+
+
 def main(argv=None) -> int:
     common = argparse.ArgumentParser(add_help=False)
     common.add_argument("--state-dir", required=True)
@@ -174,6 +237,8 @@ def main(argv=None) -> int:
     p = sub.add_parser("dump-object", parents=[common])
     p.add_argument("--kind", required=True)
     p.set_defaults(func=cmd_dump_object)
+    sub.add_parser("renewcert", parents=[common]).set_defaults(
+        func=cmd_renewcert)
     args = ap.parse_args(argv)
     try:
         args.func(args)
