@@ -46,7 +46,17 @@ class Meta:
 
 @dataclass
 class StoreObject:
-    """Base for everything the store replicates (api/storeobject.go:19-27)."""
+    """Base for everything the store replicates (api/storeobject.go:19-27).
+
+    NO-ALIASING CONTRACT: every StoreObject (and every spec it embeds)
+    must be tree-shaped — no field may share a mutable substructure with
+    another field of the same object. `copy()` uses the native tree
+    copier, which forks aliased subtrees into independent copies (it has
+    no deepcopy memo); a future field that deliberately aliased another
+    would silently change copy semantics versus the deepcopy fallback.
+    tests/test_native_hostops.py::test_tree_copy_matches_deepcopy_catalog
+    pins tree_copy == copy.deepcopy over a representative object of every
+    table; keep it green when adding fields."""
 
     id: str = ""
     meta: Meta = field(default_factory=Meta)

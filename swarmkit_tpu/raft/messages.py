@@ -23,6 +23,14 @@ ERR_NOT_LEADER = "not leader"
 ERR_LEADERSHIP_LOST = "leadership lost"
 
 
+class MemberRemovedError(Exception):
+    """Typed marker a peer answers raft.step with when the SENDER was
+    removed from the cluster (reference membership ErrMemberRemoved).
+    Registered with the RPC error registry, so the sender's transport can
+    match on the TYPE — a coincidental substring in some other peer error
+    must never self-demote a node (ADVICE r03)."""
+
+
 @dataclass
 class Entry:
     term: int

@@ -19,6 +19,7 @@ import threading
 import time
 
 from ..rpc.client import RPCClient
+from .messages import MemberRemovedError
 
 log = logging.getLogger("swarmkit_tpu.raft.transport")
 
@@ -171,10 +172,12 @@ class NetworkTransport:
                     self._last_ok[peer_id] = time.monotonic()
                 backoff_until = 0.0
             except Exception as exc:
-                if "member removed" in str(exc):
-                    # the peer answered with the removed marker: WE are no
-                    # longer part of this cluster (demoted while down —
-                    # reference ErrMemberRemoved handling in node.go)
+                if isinstance(exc, MemberRemovedError):
+                    # the peer answered with the TYPED removed marker: WE
+                    # are no longer part of this cluster (demoted while
+                    # down — reference ErrMemberRemoved in node.go). Typed
+                    # match only (ADVICE r03): a substring in some peer's
+                    # unrelated error text must never self-demote a node.
                     node = self.node
                     if node is not None \
                             and getattr(msg, "frm", None) == node.id:

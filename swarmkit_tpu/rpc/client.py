@@ -49,6 +49,7 @@ def _register_errors():
     from ..controlapi import errors as control_errors
     from ..dispatcher.dispatcher import DispatcherError, SessionInvalid
     from ..csi.plugin import CSIPluginError
+    from ..raft.messages import MemberRemovedError
     from ..raft.proposer import ProposeError
     from ..store.memory import ExistError, NotExistError, SequenceConflict
 
@@ -60,7 +61,7 @@ def _register_errors():
     # name collision (the authz edge is what the server raises)
     for cls in (PermissionDenied, InvalidToken, CertificateError,
                 DispatcherError, SessionInvalid, ProposeError,
-                CSIPluginError,
+                MemberRemovedError, CSIPluginError,
                 ExistError, NotExistError, SequenceConflict,
                 KeyError, ValueError, TimeoutError):
         _KNOWN_ERRORS[cls.__name__] = cls

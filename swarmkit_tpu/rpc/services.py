@@ -131,11 +131,13 @@ def build_manager_registry(manager, raft_node=None,
             frm = getattr(msg, "frm", None)
             if frm is not None and frm in raft_node.removed_ids:
                 # reference membership.go ErrMemberRemoved: a removed
-                # member's messages are answered with the marker so a
+                # member's messages are answered with the TYPED marker so a
                 # member demoted WHILE DOWN learns its fate on restart
                 # (it never applied its own removal — the quorum stopped
                 # replicating to it)
-                raise ValueError("raft: member removed")
+                from ..raft.messages import MemberRemovedError
+
+                raise MemberRemovedError("raft: member removed")
             raft_node.step(msg)
             return None
 

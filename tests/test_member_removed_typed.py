@@ -1,0 +1,87 @@
+"""Typed member-removed signal over the raft transport (ADVICE r03 low
+item 2): self-demotion must key on the MemberRemovedError TYPE crossing
+the wire, never on a substring of arbitrary peer error text.
+"""
+import time
+
+import pytest
+
+from swarmkit_tpu.api.types import NodeRole
+from swarmkit_tpu.raft.messages import MemberRemovedError
+from swarmkit_tpu.raft.transport import NetworkTransport
+from swarmkit_tpu.rpc.server import RPCServer, ServiceRegistry
+
+from test_rpc import ORG, cluster_ca, make_identity  # noqa: F401
+
+from test_scheduler import wait_for
+
+
+def _Msg(frm, to):
+    from swarmkit_tpu.raft.messages import Message
+
+    return Message(frm=frm, to=to)
+
+
+class _FakeNode:
+    def __init__(self, raft_id):
+        self.id = raft_id
+        self.members = {}
+        self.removed = False
+
+    def notify_removed(self):
+        self.removed = True
+
+
+@pytest.fixture
+def harness(cluster_ca):  # noqa: F811
+    """An RPC 'peer' whose raft.step behavior is scriptable, plus a
+    transport wired at a manager identity."""
+    behavior = {"exc": None}
+    reg = ServiceRegistry()
+
+    def raft_step(caller, msg):
+        if behavior["exc"] is not None:
+            raise behavior["exc"]
+        return None
+
+    reg.add("raft.step", raft_step, roles=[NodeRole.MANAGER])
+    srv = RPCServer("127.0.0.1:0", make_identity(cluster_ca, "peer",
+                                                 NodeRole.MANAGER),
+                    reg, org=ORG)
+    srv.start()
+    sec = make_identity(cluster_ca, "sender", NodeRole.MANAGER)
+    tp = NetworkTransport(sec, local_raft_id=1)
+    node = _FakeNode(1)
+    tp.set_node(node)
+    tp.update_peer_addr(2, srv.addr)
+    try:
+        yield behavior, tp, node
+    finally:
+        tp.stop()
+        srv.stop()
+
+
+def test_typed_member_removed_triggers_self_demotion(harness):
+    behavior, tp, node = harness
+    behavior["exc"] = MemberRemovedError("raft: member removed")
+    tp.send(_Msg(frm=1, to=2))
+    assert wait_for(lambda: node.removed, timeout=10)
+
+
+def test_substring_in_peer_error_does_not_self_demote(harness):
+    """The ADVICE scenario: a peer error whose TEXT happens to contain
+    'member removed' (e.g. a forwarded log line) must not demote us."""
+    behavior, tp, node = harness
+    behavior["exc"] = ValueError(
+        "log replay note: member removed event observed downstream")
+    tp.send(_Msg(frm=1, to=2))
+    # give the sender loop ample time to deliver and classify
+    time.sleep(2.0)
+    assert not node.removed
+
+
+def test_healthy_send_does_not_demote(harness):
+    behavior, tp, node = harness
+    tp.send(_Msg(frm=1, to=2))
+    assert wait_for(lambda: tp.active(2), timeout=10)
+    assert not node.removed

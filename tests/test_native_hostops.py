@@ -233,6 +233,48 @@ def test_tree_copy_equals_deepcopy_and_isolates():
         assert obj == snapshot, f"copy aliased state of {type(obj).__name__}"
 
 
+def test_tree_copy_matches_deepcopy_catalog():
+    """The no-aliasing contract's enforcement point (ADVICE r03; see
+    StoreObject docstring): tree_copy and copy.deepcopy must agree on a
+    representative object of EVERY replicated table. A new field that
+    aliased a sibling's substructure would break deepcopy-equivalence
+    here (deepcopy preserves aliasing; tree_copy forks it)."""
+    import copy as _copy
+
+    from swarmkit_tpu.api.objects import (
+        Cluster,
+        Config,
+        Extension,
+        Network,
+        Node,
+        Resource,
+        Secret,
+        Service,
+        Task,
+        Volume,
+    )
+    from swarmkit_tpu.api.specs import Annotations
+
+    reps = []
+    for cls in (Task, Service, Node, Cluster, Secret, Config, Network,
+                Volume, Extension, Resource):
+        o = cls(id=f"cat-{cls.TABLE}")
+        ann = Annotations(name=f"n-{cls.TABLE}", labels={"a": "b"})
+        if hasattr(o, "spec") and hasattr(o.spec, "annotations"):
+            o.spec.annotations = ann
+        elif hasattr(o, "annotations"):     # Extension/Resource: no spec
+            o.annotations = ann
+        reps.append(o)
+    reps.extend(_rich_objects())
+    for obj in reps:
+        via_deepcopy = _copy.deepcopy(obj)
+        via_copy = obj.copy()
+        assert via_copy == via_deepcopy == obj, type(obj).__name__
+        # and the forked copy shares nothing: deep-mutate one leaf
+        via_copy.meta.version.index += 1
+        assert obj.meta.version.index != via_copy.meta.version.index
+
+
 @pytest.mark.skipif(native.hostops is None, reason="no native build")
 def test_tree_copy_fallback_for_unknown_subtree():
     """A subtree outside the closed model (here: a non-dataclass object)
