@@ -878,9 +878,12 @@ def main():
     # this ran last)
     rows = [
         ("e2e_service_start_100r_3m_5w", lambda: bench_e2e_service_start(np)),
+        # waves=7 -> three fully-pipelined periods in the e2e sample
+        # (depth+1..waves-1); with one sample the min-estimator was a
+        # lottery against heap/tunnel noise on the commit-heavy wall
         ("grid_100k_x_10k", lambda: bench_scheduler_config(
             np, placement_ops, batch, N_NODES, N_TASKS, N_SERVICES,
-            waves=5)),
+            waves=7)),
         ("constraint_heavy_1k_x_1k", lambda: bench_scheduler_config(
             np, placement_ops, batch, 1_000, 1_000, 20,
             constraint_heavy=True)),

@@ -88,15 +88,6 @@ bump_counter(PyObject *counter, PyObject *key, long long delta)
     return 0;
 }
 
-static void
-decref_ids(PyObject **ids, Py_ssize_t n)
-{
-    Py_ssize_t m;
-
-    for (m = 0; m < n; m++)
-        Py_DECREF(ids[m]);
-}
-
 /* Hand one segment to the Python per-task path (borrowed task
  * pointers); returns tasks added, or -1 with an exception set. */
 static long long
@@ -160,12 +151,10 @@ apply_segments(PyObject *self, PyObject *args)
         goto done;
     }
 
-    /* scratch buffers: each task pointer (borrowed, upper half) and id
-     * (owned, lower half) fetched exactly once per segment — the wave
-     * is a random-order gather over millions of heap objects, so every
-     * avoided re-walk is an avoided cache-miss chain */
+    /* scratch: borrowed task pointers for the rare fallback gather
+     * only — the happy path never touches it */
     ids = (PyObject **)PyMem_Malloc(
-        (size_t)(n_tasks > 0 ? 2 * n_tasks : 2) * sizeof(PyObject *));
+        (size_t)(n_tasks > 0 ? n_tasks : 1) * sizeof(PyObject *));
     if (ids == NULL) {
         PyErr_NoMemory();
         goto done;
@@ -173,9 +162,9 @@ apply_segments(PyObject *self, PyObject *args)
 
     for (si = 0; si < n_seg; si++) {
         int64_t a = bounds[si], b = bounds[si + 1], node;
-        Py_ssize_t k = (Py_ssize_t)(b - a), m, filled = 0, run;
+        Py_ssize_t k = (Py_ssize_t)(b - a), m, run;
         PyObject *info, *tdict, *counter;
-        int collide = 0, err = 0;
+        int err = 0;
 
         if (a < 0 || b > (int64_t)n_tasks || a >= b) {
             PyErr_SetString(PyExc_ValueError,
@@ -202,68 +191,108 @@ apply_segments(PyObject *self, PyObject *args)
             goto done;
         }
 
-        /* pass 1: gather task pointers + ids (owned refs) + collision
-         * scan */
-        for (m = 0; m < k; m++) {
-            PyObject *task, *tid;
-            int c;
+        /* SINGLE fused pass: fetch id + insert via SetDefault.  One
+         * hash probe per task instead of the old Contains-then-SetItem
+         * pair, and the task object is touched for GetAttr and insert
+         * while still hot in cache (the wave is a random-order gather
+         * over up to millions of heap objects — the second cold walk
+         * was where the old two-pass layout bled).  The id ref is
+         * dropped immediately (the dict now holds one), so the happy
+         * path writes NO scratch at all.  SetDefault never overwrites,
+         * so on ANY anomaly (id already on the node, same id twice
+         * within the wave, same object twice) the pre-existing entry is
+         * intact and the undo is exactly "delete what we inserted" —
+         * ids re-derived from oi, anomalies being rare — then the
+         * per-task Python fallback re-applies the whole segment with
+         * oracle semantics. */
+        {
+            Py_ssize_t inserted = 0;
+            int bad = 0;
 
-            if (oi[a + m] < 0 || oi[a + m] >= (int64_t)n_tasks) {
-                PyErr_SetString(PyExc_IndexError,
-                                "apply_segments: oi out of range");
-                err = 1;
-                break;
-            }
-            task = PyList_GET_ITEM(tasks_all, oi[a + m]);   /* borrowed */
-            tid = PyObject_GetAttr(task, s_id);
-            if (tid == NULL) {
-                err = 1;
-                break;
-            }
-            ids[m] = tid;
-            ids[n_tasks + m] = task;
-            filled = m + 1;
-            c = PyDict_Contains(tdict, tid);
-            if (c < 0) {
-                err = 1;
-                break;
-            }
-            if (c) {
-                collide = 1;
-                break;
-            }
-        }
-        if (err) {
-            decref_ids(ids, filled);
-            Py_DECREF(tdict);
-            goto done;
-        }
+            for (m = 0; m < k; m++) {
+                PyObject *task, *tid, *existing;
+                Py_ssize_t sz;
 
-        if (collide) {
-            /* healed double-commit etc.: hand the whole segment to the
-             * per-task Python path, which does its own bookkeeping */
-            long long added;
-
-            decref_ids(ids, filled);
-            Py_DECREF(tdict);
-            for (m = filled; m < k; m++) {      /* finish the gather */
                 if (oi[a + m] < 0 || oi[a + m] >= (int64_t)n_tasks) {
                     PyErr_SetString(PyExc_IndexError,
                                     "apply_segments: oi out of range");
-                    goto done;
+                    err = 1;
+                    break;
                 }
-                ids[n_tasks + m] = PyList_GET_ITEM(tasks_all, oi[a + m]);
+#if defined(__GNUC__) || defined(__clang__)
+                /* the wave gathers tasks in node-major order — a random
+                 * walk over the creation-ordered tasks_all heap; start
+                 * pulling the object header a few iterations ahead so
+                 * the GetAttr below doesn't eat the full miss chain
+                 * (bounds are re-checked when the slot is consumed) */
+                if (a + m + 8 < b && oi[a + m + 8] >= 0
+                    && oi[a + m + 8] < (int64_t)n_tasks)
+                    __builtin_prefetch(
+                        PyList_GET_ITEM(tasks_all, oi[a + m + 8]), 0, 1);
+#endif
+                task = PyList_GET_ITEM(tasks_all, oi[a + m]); /* borrowed */
+                tid = PyObject_GetAttr(task, s_id);
+                if (tid == NULL) {
+                    err = 1;
+                    break;
+                }
+                sz = PyDict_GET_SIZE(tdict);
+                existing = PyDict_SetDefault(tdict, tid, task); /* borrowed */
+                Py_DECREF(tid);      /* inserted: dict owns a ref now */
+                if (existing == NULL) {
+                    err = 1;
+                    break;
+                }
+                if (existing != task || PyDict_GET_SIZE(tdict) == sz) {
+                    bad = 1;      /* collision or in-wave duplicate */
+                    break;
+                }
+                inserted = m + 1;
             }
-            added = fallback_segment(fallback, info, ids + n_tasks, k);
-            if (added < 0)
+            if (err) {
+                /* our inserts stay: the exception aborts the wave and
+                 * the caller's contract is state-on-error undefined —
+                 * matching the Python walk, which also raises mid-way */
+                Py_DECREF(tdict);
                 goto done;
-            n_added += added;
-            continue;
+            }
+            if (bad) {
+                long long added;
+
+                for (m = 0; m < inserted; m++) {
+                    /* every [0, inserted) key is distinct and ours;
+                     * re-derive the id (rare path, k is small) */
+                    PyObject *task =
+                        PyList_GET_ITEM(tasks_all, oi[a + m]);
+                    PyObject *tid = PyObject_GetAttr(task, s_id);
+
+                    if (tid == NULL
+                        || PyDict_DelItem(tdict, tid) < 0) {
+                        Py_XDECREF(tid);
+                        Py_DECREF(tdict);
+                        goto done;
+                    }
+                    Py_DECREF(tid);
+                }
+                Py_DECREF(tdict);
+                for (m = 0; m < k; m++) {       /* gather for fallback */
+                    if (oi[a + m] < 0 || oi[a + m] >= (int64_t)n_tasks) {
+                        PyErr_SetString(PyExc_IndexError,
+                                        "apply_segments: oi out of range");
+                        goto done;
+                    }
+                    ids[m] = PyList_GET_ITEM(tasks_all, oi[a + m]);
+                }
+                added = fallback_segment(fallback, info, ids, k);
+                if (added < 0)
+                    goto done;
+                n_added += added;
+                continue;
+            }
         }
 
         counter = PyObject_GetAttr(info, s_svccnt);
         if (counter == NULL) {
-            decref_ids(ids, k);
             Py_DECREF(tdict);
             goto done;
         }
@@ -271,50 +300,6 @@ apply_segments(PyObject *self, PyObject *args)
             PyErr_SetString(PyExc_TypeError,
                             "apply_segments: by-service counts not a dict");
             err = 1;
-        }
-
-        /* pass 2a: dict inserts, detecting duplicate ids WITHIN the
-         * wave (contract breach): the dict dedups silently, but the
-         * counters below would double-count */
-        {
-            int dup = 0;
-
-            for (m = 0; !err && m < k; m++) {
-                Py_ssize_t sz = PyDict_GET_SIZE(tdict);
-
-                if (PyDict_SetItem(tdict, ids[m], ids[n_tasks + m]) < 0)
-                    err = 1;
-                else if (PyDict_GET_SIZE(tdict) == sz) {
-                    dup = 1;
-                    break;
-                }
-            }
-            if (!err && dup) {
-                /* undo this segment's inserts, heal through the
-                 * per-task path (its re-add logic counts each id once,
-                 * bit-identical to the serial oracle) */
-                for (m = 0; !err && m < k; m++) {
-                    int c = PyDict_Contains(tdict, ids[m]);
-
-                    if (c < 0
-                        || (c && PyDict_DelItem(tdict, ids[m]) < 0))
-                        err = 1;
-                }
-                decref_ids(ids, k);
-                Py_DECREF(tdict);
-                Py_DECREF(counter);
-                if (err)
-                    goto done;
-                {
-                    long long added = fallback_segment(fallback, info,
-                                                       ids + n_tasks, k);
-
-                    if (added < 0)
-                        goto done;
-                    n_added += added;
-                }
-                continue;
-            }
         }
 
         /* pass 2b: one counter bump per (node, group) run (the sort is
@@ -339,7 +324,6 @@ apply_segments(PyObject *self, PyObject *args)
                 run = m;
             }
         }
-        decref_ids(ids, k);
         Py_DECREF(tdict);
         Py_DECREF(counter);
         if (err)

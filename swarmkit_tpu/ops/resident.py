@@ -227,7 +227,7 @@ class ResidentPlacement:
     def _upload_full(self, p: EncodedProblem):
         np_b, kp, plp, pvp, rp, sp = self._padded_dims(p)
         n = len(p.node_ids)
-        host = (
+        host = [
             self._pad2(p.ready, np_b, fill=False),
             self._pad2(p.node_val, np_b, kp),
             self._pad2(p.node_plat, np_b, 2),
@@ -235,11 +235,37 @@ class ResidentPlacement:
             self._pad2(p.port_used0, np_b, pvp, fill=False),
             self._pad2(p.avail_res, np_b, rp),
             self._pad2(p.total0, np_b),
-            np.ascontiguousarray(
-                np.pad(self._svc_block(slice(None), sp),
-                       ((0, 0), (0, np_b - n)))),
-        )
-        self._state = jax.device_put(list(host))
+        ]
+        state = jax.device_put(host)
+        # the [S, N] per-service count matrix is the cold upload's whale
+        # (at 100k nodes it alone is 17-67 MB through a single-digit-MB/s
+        # tunnel) and on a cold cluster / post-failover first contact it
+        # is all zeros or nearly so: materialize it device-side instead
+        # of shipping zero bytes. Sparse (row, col, val) scatter covers
+        # the nearly-empty case; dense ship only when actually dense.
+        svc = self._svc_block(slice(None), sp)
+        nnz = int(np.count_nonzero(svc))
+        if nnz == 0:
+            svc_dev = jnp.zeros((sp, np_b), np.int32)
+        elif nnz * 3 * 4 < svc.size:
+            # sparse ships 8 bytes/nnz (int32 flat idx + int32 val) vs 4
+            # bytes/cell dense, so breakeven is nnz*2 < cells; the
+            # 12x-margin threshold here is deliberately conservative —
+            # the scatter program has its own device cost, and dense is
+            # only painful when it is 10s of MB through the tunnel
+            # FLAT 1d scatter (CLAUDE.md: the axon backend's 2d scatter
+            # silently corrupts above ~512 updates); reshape afterwards
+            # as a separate eager op, never fused with the scatter
+            r, c = np.nonzero(svc)
+            flat = (r.astype(np.int64) * np_b + c).astype(np.int32)
+            svc_flat = jnp.zeros(sp * np_b, np.int32).at[
+                jax.device_put(flat)].add(jax.device_put(svc[r, c]))
+            svc_dev = svc_flat.reshape(sp, np_b)
+        else:
+            svc_dev = jax.device_put(np.ascontiguousarray(
+                np.pad(svc, ((0, 0), (0, np_b - n)))))
+        state.append(svc_dev)
+        self._state = state
         self._meta = self._signature(p)
         self._pending = np.zeros(0, np.int64)
         self._stale = False
