@@ -57,7 +57,8 @@ def example_cluster(n_nodes: int = 256, n_groups: int = 4,
         n.spec.availability = NodeAvailability.ACTIVE
         n.spec.annotations = Annotations(
             name=f"node-{i}",
-            labels={"zone": "abc"[i % 3], "disk": ("ssd", "hdd")[i % 2]})
+            labels={"zone": "abc"[i % 3], "disk": ("ssd", "hdd")[i % 2],
+                    "rack": f"r{i % 17}"})
         n.description = NodeDescription(
             hostname=f"host-{i}",
             platform=Platform(os="linux", architecture="amd64"),
@@ -93,14 +94,18 @@ def example_cluster(n_nodes: int = 256, n_groups: int = 4,
                     spec.placement = Placement(
                         constraints=[f"node.labels.zone == {'abc'[gi % 3]}"])
                 if gi % 3 == 1:
-                    # spread-tree groups (LMAX>0): one- and two-level
-                    # preference trees so the segmented pour path is part
-                    # of the flagship compile surface
+                    # spread-tree groups (LMAX>0): one-, two- and
+                    # THREE-level preference trees so the segmented pour
+                    # path is part of the flagship compile surface at the
+                    # depth real topologies use (zone > disk > rack)
                     prefs = [PlacementPreference(
                         spread_descriptor="node.labels.zone")]
                     if gi % 2 == 1:
                         prefs.append(PlacementPreference(
                             spread_descriptor="node.labels.disk"))
+                    if gi % 6 == 1:
+                        prefs.append(PlacementPreference(
+                            spread_descriptor="node.labels.rack"))
                     spec.placement.preferences = prefs
                 if gi % 7 == 3:
                     # generic-resource consumers (gpu pool nodes only)
