@@ -48,7 +48,15 @@ log = logging.getLogger("swarmkit_tpu.scheduler")
 
 COMMIT_DEBOUNCE = 0.05   # reference: 50ms
 MAX_LATENCY = 1.0        # reference: 1s
-JAX_THRESHOLD = 200_000  # task×node product above which the TPU kernel wins
+# task×node products above which the TPU kernel wins. Two regimes:
+# blocking ticks pay the full counts round-trip (~0.1s fixed through the
+# dev tunnel), so the bar is high; pipelined ticks hide the pull under
+# the commit/debounce window and their bar is the HOST-side floor only —
+# encode + dispatch, measured 1.5-3 ms/tick after the round-4 group-table
+# device cache (was ~6 ms), crossing CPU fill at ~100-250k products on
+# the dev link and far lower on PCIe (BASELINE.md operator guidance).
+JAX_THRESHOLD = 200_000
+PIPELINED_JAX_THRESHOLD = 100_000
 
 
 class Scheduler:
@@ -73,8 +81,9 @@ class Scheduler:
         touched rows — the same self-healing the serial path uses."""
         self.store = store
         self.backend = backend
-        self.jax_threshold = (JAX_THRESHOLD if jax_threshold is None
-                              else jax_threshold)
+        self.jax_threshold = (
+            (PIPELINED_JAX_THRESHOLD if pipeline else JAX_THRESHOLD)
+            if jax_threshold is None else jax_threshold)
         self.pipeline = pipeline
         # (problem, PendingCounts, frozenset of in-flight task ids)
         self._inflight = None
