@@ -226,6 +226,21 @@ def cmd_service_update(args):
         if args.update_order is not None:
             cfg.order = UpdateOrder(args.update_order.replace("-", "_"))
         spec.update = cfg
+    if args.env is not None or args.constraint is not None:
+        if spec.task.runtime is None:
+            from ..api.specs import ContainerSpec
+
+            spec.task.runtime = ContainerSpec()
+        if args.env is not None:
+            # full replacement, like the reference flagparser's env flag
+            spec.task.runtime.env = list(args.env)
+        if args.constraint is not None:
+            spec.task.placement.constraints = list(args.constraint)
+    for kv in args.label_add or []:
+        k, _, v = kv.partition("=")
+        spec.annotations.labels[k] = v
+    for k in args.label_rm or []:
+        spec.annotations.labels.pop(k, None)
     if args.force:
         spec.task.force_update += 1
     updated = ctl.update_service(s.id, s.meta.version, spec)
@@ -306,6 +321,7 @@ def cmd_node_inspect(args):
                                 str(n.spec.desired_role)).lower(),
         "status": _state_name(n.status.state),
         "availability": getattr(n.spec.availability, "name", "active").lower(),
+        "labels": dict(n.spec.annotations.labels),
         "manager": ({"addr": n.manager_status.addr,
                      "leader": n.manager_status.leader,
                      "raft_id": n.manager_status.raft_id}
@@ -319,6 +335,29 @@ def _set_node(args, mutate):
     mutate(n.spec)
     ctl.update_node(n.id, n.meta.version, n.spec)
     print(n.id)
+
+
+def cmd_node_update(args):
+    """Node spec update: labels (+availability) — reference
+    swarmctl/node/update.go (label flags) + drain/activate semantics."""
+    def mutate(spec):
+        changed = False
+        for kv in args.label_add or []:
+            k, _, v = kv.partition("=")
+            spec.annotations.labels[k] = v
+            changed = True
+        for k in args.label_rm or []:
+            if spec.annotations.labels.pop(k, None) is not None:
+                changed = True
+        if args.availability:
+            from ..api.types import NodeAvailability
+
+            spec.availability = NodeAvailability[args.availability.upper()]
+            changed = True
+        if not changed:
+            _die(f"no change for node {args.node}")
+
+    _set_node(args, mutate)
 
 
 def cmd_node_promote(args):
@@ -853,6 +892,12 @@ def main(argv=None) -> int:
     p.add_argument("--replicas", type=int, default=None)
     p.add_argument("--command", default=None)
     p.add_argument("--image", default=None)
+    p.add_argument("--env", action="append", default=None,
+                   help="replace the env list; repeatable")
+    p.add_argument("--constraint", action="append", default=None,
+                   help="replace placement constraints; repeatable")
+    p.add_argument("--label-add", action="append", metavar="K=V")
+    p.add_argument("--label-rm", action="append", metavar="K")
     p.add_argument("--force", action="store_true")
     p.add_argument("--rollback", action="store_true",
                    help="revert to the previous service spec")
@@ -908,6 +953,13 @@ def main(argv=None) -> int:
     p = node.add_parser("inspect")
     p.add_argument("node")
     p.set_defaults(func=cmd_node_inspect)
+    p = node.add_parser("update")
+    p.add_argument("node")
+    p.add_argument("--label-add", action="append", metavar="K=V")
+    p.add_argument("--label-rm", action="append", metavar="K")
+    p.add_argument("--availability", default=None,
+                   choices=["active", "pause", "drain"])
+    p.set_defaults(func=cmd_node_update)
     for name, fn in (("promote", cmd_node_promote),
                      ("demote", cmd_node_demote),
                      ("drain", cmd_node_drain),

@@ -112,6 +112,40 @@ def test_node_and_cluster_and_secrets_via_cli(daemon):
     assert "apikey" not in _ctl(addr, ident, "secret", "ls")
 
 
+def test_node_update_labels_and_service_update_env(daemon):
+    """reference swarmctl/node/update.go (label flags) and the service
+    update env/constraint/label surface."""
+    addr, ident = daemon["addr"], daemon["identity"]
+    node_id = _ctl(addr, ident, "node", "ls").splitlines()[1].split()[0]
+
+    _ctl(addr, ident, "node", "update", node_id,
+         "--label-add", "tier=gold", "--label-add", "zone=z1")
+    info = json.loads(_ctl(addr, ident, "node", "inspect", node_id))
+    assert info["labels"] == {"tier": "gold", "zone": "z1"}
+    _ctl(addr, ident, "node", "update", node_id, "--label-rm", "zone")
+    info = json.loads(_ctl(addr, ident, "node", "inspect", node_id))
+    assert info["labels"] == {"tier": "gold"}
+    # no-op update is refused (reference errNoChange)
+    r = subprocess.run(
+        [sys.executable, "-m", "swarmkit_tpu.cmd.swarmctl",
+         "--addr", addr, "--identity", ident, "node", "update", node_id],
+        capture_output=True, text=True, env=_env(), cwd=REPO, timeout=60)
+    assert r.returncode != 0 and "no change" in (r.stdout + r.stderr)
+
+    _ctl(addr, ident, "service", "create", "--name", "upenv",
+         "--command", "sleep 600", "--replicas", "1")
+    _ctl(addr, ident, "service", "update", "upenv",
+         "--env", "A=1", "--env", "B=2", "--label-add", "team=core")
+    # constraint replacement goes through create-time validation too
+    r = subprocess.run(
+        [sys.executable, "-m", "swarmkit_tpu.cmd.swarmctl",
+         "--addr", addr, "--identity", ident, "service", "update",
+         "upenv", "--env", "X={{.Bogus}}"],
+        capture_output=True, text=True, env=_env(), cwd=REPO, timeout=60)
+    assert r.returncode != 0
+    _ctl(addr, ident, "service", "rm", "upenv")
+
+
 def test_swarmbench_and_rafttool(daemon):
     addr, ident = daemon["addr"], daemon["identity"]
     r = subprocess.run(
