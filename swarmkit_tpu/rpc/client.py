@@ -27,6 +27,7 @@ from .wire import (
     client_ssl_context,
     connect_tls,
     recv_frame,
+    safe_close,
     send_frame,
 )
 
@@ -172,10 +173,10 @@ class RPCClient:
 
     def close(self):
         self._closed.set()
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        # shutdown-then-close under the write lock: closing the bare fd
+        # while call()/stream() sits in sendall lets the kernel recycle
+        # the fd mid-write (wire.safe_close)
+        safe_close(self._sock, self._wlock)
         self._fail_all(ConnectionClosed("client closed"))
 
     # -- internals ---------------------------------------------------------
@@ -242,7 +243,4 @@ class RPCClient:
             self._fail_all(ConnectionClosed(str(exc)))
         finally:
             self._closed.set()
-            try:
-                self._sock.close()
-            except OSError:
-                pass
+            safe_close(self._sock, self._wlock)

@@ -33,8 +33,10 @@ from .wire import (
     ConnectionClosed,
     caller_from_socket,
     recv_frame,
+    safe_close,
     send_frame,
     server_ssl_context,
+    shutdown_only,
 )
 
 log = logging.getLogger("swarmkit_tpu.rpc.server")
@@ -159,10 +161,10 @@ class RPCServer:
         with self._conns_lock:
             conns = list(self._conns)
         for c in conns:
-            try:
-                c.close()
-            except OSError:
-                pass
+            # wake each conn's serving thread; ITS close path (under the
+            # per-conn write lock) frees the fd — closing from here races
+            # in-flight reply sendalls onto a recycled fd (wire.safe_close)
+            shutdown_only(c)
         for t in self._threads:
             t.join(timeout=2)
 
@@ -225,10 +227,9 @@ class RPCServer:
                 ev.set()
             with self._conns_lock:
                 self._conns.discard(conn)
-            try:
-                conn.close()
-            except OSError:
-                pass
+            # reply threads may still be inside send_frame on this conn:
+            # shutdown, then close under their write lock (wire.safe_close)
+            safe_close(conn, wlock)
 
     # -- dispatch ----------------------------------------------------------
     def _handle_request(self, conn, wlock, caller: Caller | None,

@@ -54,6 +54,48 @@ class ConnectionClosed(Exception):
     pass
 
 
+def safe_close(sock, wlock: threading.Lock | None = None) -> None:
+    """Close a socket other threads may still be WRITING to.
+
+    Closing an fd while a sibling thread sits inside `sendall` frees the
+    fd NUMBER with the write still in flight; the kernel recycles it
+    instantly (an mkstemp, another socket) and the bytes land in the new
+    object — observed in round 4 as a TLS record spliced in front of a
+    daemon's freshly-written state.json. `shutdown()` first: it kills
+    both directions without freeing the fd (the in-flight sendall/recv
+    fail with EPIPE/ECONNRESET), then the fd is released under the
+    connection's write lock so no writer can still be inside sendall."""
+    import socket as _socket
+
+    try:
+        sock.shutdown(_socket.SHUT_RDWR)
+    except (OSError, ValueError):
+        pass
+    if wlock is not None:
+        with wlock:
+            try:
+                sock.close()
+            except OSError:
+                pass
+    else:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+def shutdown_only(sock) -> None:
+    """Wake a connection's owning thread (its recv fails) without freeing
+    the fd — the owner's close path (which holds the write lock) runs the
+    actual close. For closing from OUTSIDE the serving thread."""
+    import socket as _socket
+
+    try:
+        sock.shutdown(_socket.SHUT_RDWR)
+    except (OSError, ValueError):
+        pass
+
+
 def send_frame(sock, lock: threading.Lock, body: list) -> None:
     data = codec.dumps(body)
     if len(data) > MAX_FRAME:
