@@ -29,6 +29,7 @@ from .wire import (
     recv_frame,
     safe_close,
     send_frame,
+    shutdown_only,
 )
 
 log = logging.getLogger("swarmkit_tpu.rpc.client")
@@ -173,10 +174,14 @@ class RPCClient:
 
     def close(self):
         self._closed.set()
-        # shutdown-then-close under the write lock: closing the bare fd
-        # while call()/stream() sits in sendall lets the kernel recycle
-        # the fd mid-write (wire.safe_close)
-        safe_close(self._sock, self._wlock)
+        # wake the demux thread only; the fd is closed by ITS finally
+        # (safe_close under the write lock) once it is out of recv. An
+        # SSL recv can itself WRITE — TLS 1.3 encrypts alerts and
+        # KeyUpdate replies as application-data records — so freeing the
+        # fd from any other thread races that hidden write onto a
+        # recycled fd (observed: close_notify-sized records spliced into
+        # freshly-written state files)
+        shutdown_only(self._sock)
         self._fail_all(ConnectionClosed("client closed"))
 
     # -- internals ---------------------------------------------------------

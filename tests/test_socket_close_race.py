@@ -1,0 +1,100 @@
+"""Socket-close vs file-write fd-recycling race (round-4 regression).
+
+Closing an RPC socket's fd while any thread could still WRITE through it
+(an in-flight sendall, or the hidden writes an SSL *recv* performs —
+TLS 1.3 encrypts alerts/KeyUpdate replies as application-data records)
+frees the fd number mid-write; the kernel recycles it instantly and the
+bytes land in whatever file just opened. Observed twice in full-suite
+runs as `\\x17\\x03\\x03...` records spliced into state.json/key.json.
+
+The fix (rpc/wire.safe_close + shutdown_only): only the connection's
+owning reader thread closes the fd, after shutdown() has killed both
+directions and the write lock has quiesced writers. This test hammers
+client connect/call/close churn against concurrent atomic JSON file
+writes and asserts no file ever carries foreign bytes.
+"""
+import json
+import os
+import tempfile
+import threading
+import time
+
+import pytest
+
+from swarmkit_tpu.api.types import NodeRole
+from swarmkit_tpu.rpc.client import RPCClient
+from swarmkit_tpu.rpc.server import RPCServer, ServiceRegistry
+
+from test_rpc import ORG, cluster_ca, make_identity  # noqa: F401
+
+
+def test_client_close_churn_never_corrupts_concurrent_files(
+        cluster_ca, tmp_path):  # noqa: F811
+    reg = ServiceRegistry()
+    reg.add("t.echo", lambda caller, x: x,
+            roles=[NodeRole.WORKER, NodeRole.MANAGER])
+    srv = RPCServer("127.0.0.1:0", make_identity(cluster_ca, "srv",
+                                                 NodeRole.MANAGER),
+                    reg, org=ORG)
+    srv.start()
+    ident = make_identity(cluster_ca, "cli", NodeRole.MANAGER)
+    stop = threading.Event()
+    errors: list[str] = []
+
+    def churn():
+        # connect, fire a call, and close IMMEDIATELY (often while the
+        # server's reply is still in flight) — the old close() freed the
+        # fd from the caller's thread right here
+        while not stop.is_set():
+            try:
+                c = RPCClient(srv.addr, security=ident)
+                try:
+                    c.call("t.echo", "x", timeout=5)
+                except Exception:
+                    pass
+                c.close()
+            except Exception:
+                pass
+
+    def file_writer(i):
+        # the other half of the race: atomic mkstemp+write+rename JSON
+        # files, re-read and verified — any recycled-fd write shows up
+        # as undecodable/garbage content
+        payload = {"k": "v" * 50, "n": i}
+        path = str(tmp_path / f"state-{i}.json")
+        while not stop.is_set():
+            fd, tmp = tempfile.mkstemp(dir=str(tmp_path))
+            try:
+                with os.fdopen(fd, "w") as f:
+                    json.dump(payload, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+            try:
+                with open(path) as f:
+                    got = json.load(f)
+                if got != payload:
+                    errors.append(f"content mismatch in {path}")
+                    return
+            except (ValueError, UnicodeDecodeError) as exc:
+                errors.append(f"corrupted {path}: {exc!r}")
+                return
+
+    threads = [threading.Thread(target=churn, daemon=True)
+               for _ in range(4)]
+    threads += [threading.Thread(target=file_writer, args=(i,), daemon=True)
+                for i in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(6.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    srv.stop()
+    assert not errors, errors
