@@ -108,7 +108,8 @@ def _mk_groups(rng, n_tasks, n_services, wave=0, constraint_heavy=False,
                 t.spec = spec
             tasks.append(t)
         groups.append(TaskGroup(service_id=svc, spec_version=wave + 1,
-                                tasks=tasks))
+                                tasks=tasks,
+                                ids=[t.id for t in tasks]))
     return groups
 
 
@@ -277,7 +278,14 @@ def bench_scheduler_config(np, placement_ops, batch, n_nodes, n_tasks,
     pipe = TickPipeline(enc, rp, commit, depth=depth)
     delta_rows_mark = None
     done = []
+    import gc
     for w in range(waves):
+        # a production scheduler collects in its idle debounce window
+        # between ticks, not inside the commit: without this, gen-2
+        # pauses from the accumulated wave objects land mid-wall and
+        # randomize the commit phase by 1.5-2x (both backends' commit is
+        # identical, so this only de-noises the comparison)
+        gc.collect()
         done.extend(pipe.tick(infos, wave_groups[w]))
         if w == 0:
             delta_rows_mark = rp.uploads_delta_rows

@@ -123,7 +123,8 @@ def example_cluster(n_nodes: int = 256, n_groups: int = 4,
                     published_port=8000 + (gi % 10),
                     publish_mode="host")])
             tasks.append(t)
-        groups.append(TaskGroup(service_id=svc, spec_version=1, tasks=tasks))
+        groups.append(TaskGroup(service_id=svc, spec_version=1, tasks=tasks,
+                                ids=[t.id for t in tasks]))
     return infos, groups
 
 

@@ -119,7 +119,7 @@ fallback_segment(PyObject *fallback, PyObject *info, PyObject **tasks,
 static PyObject *
 apply_segments(PyObject *self, PyObject *args)
 {
-    PyObject *infos, *tasks_all, *svc_of, *fallback;
+    PyObject *infos, *tasks_all, *ids_all, *svc_of, *fallback;
     Py_buffer oi_b, nodes_b, bounds_b, mem_b, cpu_b, gidx_b;
     const int64_t *oi, *nodes, *bounds, *mem, *cpu, *gidx;
     Py_ssize_t n_seg, n_infos, n_tasks, n_svc, si;
@@ -127,8 +127,9 @@ apply_segments(PyObject *self, PyObject *args)
     PyObject *ret = NULL;
     PyObject **ids = NULL;
 
-    if (!PyArg_ParseTuple(args, "O!O!y*y*y*y*y*y*O!O",
+    if (!PyArg_ParseTuple(args, "O!O!O!y*y*y*y*y*y*O!O",
                           &PyList_Type, &infos, &PyList_Type, &tasks_all,
+                          &PyList_Type, &ids_all,
                           &oi_b, &nodes_b, &bounds_b, &mem_b, &cpu_b,
                           &gidx_b, &PyList_Type, &svc_of, &fallback))
         return NULL;
@@ -146,6 +147,7 @@ apply_segments(PyObject *self, PyObject *args)
 
     if (oi_b.len != nodes_b.len || gidx_b.len != nodes_b.len
         || mem_b.len != cpu_b.len
+        || PyList_GET_SIZE(ids_all) != n_tasks
         || mem_b.len != n_infos * (Py_ssize_t)sizeof(int64_t)) {
         PyErr_SetString(PyExc_ValueError, "apply_segments: length mismatch");
         goto done;
@@ -191,20 +193,17 @@ apply_segments(PyObject *self, PyObject *args)
             goto done;
         }
 
-        /* SINGLE fused pass: fetch id + insert via SetDefault.  One
-         * hash probe per task instead of the old Contains-then-SetItem
-         * pair, and the task object is touched for GetAttr and insert
-         * while still hot in cache (the wave is a random-order gather
-         * over up to millions of heap objects — the second cold walk
-         * was where the old two-pass layout bled).  The id ref is
-         * dropped immediately (the dict now holds one), so the happy
-         * path writes NO scratch at all.  SetDefault never overwrites,
-         * so on ANY anomaly (id already on the node, same id twice
-         * within the wave, same object twice) the pre-existing entry is
-         * intact and the undo is exactly "delete what we inserted" —
-         * ids re-derived from oi, anomalies being rare — then the
-         * per-task Python fallback re-applies the whole segment with
-         * oracle semantics. */
+        /* SINGLE fused pass over the PARALLEL id list: one hash probe
+         * per task (SetDefault) and — because the caller supplies ids
+         * alongside tasks — the happy path never dereferences a task
+         * OBJECT at all: the value pointer is stored into the dict
+         * without being read.  That removes the per-task cold-object
+         * miss chain that dominated the wave at 1M placements.
+         * SetDefault never overwrites, so on ANY anomaly (id already on
+         * the node, same id twice within the wave, same object twice)
+         * the pre-existing entry is intact and the undo is exactly
+         * "delete what we inserted", then the per-task Python fallback
+         * re-applies the whole segment with oracle semantics. */
         {
             Py_ssize_t inserted = 0;
             int bad = 0;
@@ -220,25 +219,20 @@ apply_segments(PyObject *self, PyObject *args)
                     break;
                 }
 #if defined(__GNUC__) || defined(__clang__)
-                /* the wave gathers tasks in node-major order — a random
-                 * walk over the creation-ordered tasks_all heap; start
-                 * pulling the object header a few iterations ahead so
-                 * the GetAttr below doesn't eat the full miss chain
-                 * (bounds are re-checked when the slot is consumed) */
+                /* the wave walks ids in node-major order — a random walk
+                 * over the creation-ordered id strings; start pulling
+                 * the string header (where the cached hash lives) a few
+                 * iterations ahead so SetDefault doesn't eat the full
+                 * miss chain (bounds re-checked when consumed) */
                 if (a + m + 8 < b && oi[a + m + 8] >= 0
                     && oi[a + m + 8] < (int64_t)n_tasks)
                     __builtin_prefetch(
-                        PyList_GET_ITEM(tasks_all, oi[a + m + 8]), 0, 1);
+                        PyList_GET_ITEM(ids_all, oi[a + m + 8]), 0, 1);
 #endif
                 task = PyList_GET_ITEM(tasks_all, oi[a + m]); /* borrowed */
-                tid = PyObject_GetAttr(task, s_id);
-                if (tid == NULL) {
-                    err = 1;
-                    break;
-                }
+                tid = PyList_GET_ITEM(ids_all, oi[a + m]);    /* borrowed */
                 sz = PyDict_GET_SIZE(tdict);
                 existing = PyDict_SetDefault(tdict, tid, task); /* borrowed */
-                Py_DECREF(tid);      /* inserted: dict owns a ref now */
                 if (existing == NULL) {
                     err = 1;
                     break;
@@ -260,19 +254,13 @@ apply_segments(PyObject *self, PyObject *args)
                 long long added;
 
                 for (m = 0; m < inserted; m++) {
-                    /* every [0, inserted) key is distinct and ours;
-                     * re-derive the id (rare path, k is small) */
-                    PyObject *task =
-                        PyList_GET_ITEM(tasks_all, oi[a + m]);
-                    PyObject *tid = PyObject_GetAttr(task, s_id);
-
-                    if (tid == NULL
-                        || PyDict_DelItem(tdict, tid) < 0) {
-                        Py_XDECREF(tid);
+                    /* every [0, inserted) key is distinct and ours */
+                    if (PyDict_DelItem(
+                            tdict,
+                            PyList_GET_ITEM(ids_all, oi[a + m])) < 0) {
                         Py_DECREF(tdict);
                         goto done;
                     }
-                    Py_DECREF(tid);
                 }
                 Py_DECREF(tdict);
                 for (m = 0; m < k; m++) {       /* gather for fallback */

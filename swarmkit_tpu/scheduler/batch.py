@@ -162,9 +162,12 @@ def _add_serial(info, tasks) -> int:
 
 def apply_placements(infos: list, placed_groups: list) -> int:
     """Bulk NodeInfo bookkeeping for one committed scheduler wave.
-    placed_groups: (t0, tasks, node_idx) per group — tasks[i] was placed
-    on infos[node_idx[i]]; t0 is any task carrying the group's shared
-    spec content. State lands bit-identical to calling `add_task` per
+    placed_groups: (t0, tasks, node_idx[, ids]) per group — tasks[i] was
+    placed on infos[node_idx[i]]; t0 is any task carrying the group's
+    shared spec content; optional ids is the parallel id list built while
+    the tasks were cache-hot (TaskGroup.ids) — with it the native walk
+    never dereferences a task object. State lands bit-identical to
+    calling `add_task` per
     task — mutations counter included (the encoder fingerprint contract)
     — at O(nodes + cells) Python cost instead of O(tasks)
     attribute-chasing per placement (the reference pays that walk in
@@ -183,7 +186,9 @@ def apply_placements(infos: list, placed_groups: list) -> int:
     # validate EVERYTHING before mutating anything: a mid-wave raise
     # would leave NodeInfo bookkeeping half-applied with no heal path
     checked: list[tuple] = []
-    for t0, tasks, nidx in placed_groups:
+    for entry in placed_groups:
+        t0, tasks, nidx = entry[0], entry[1], entry[2]
+        ids = entry[3] if len(entry) > 3 else None
         nidx = np.asarray(nidx, np.int64)
         if len(tasks) != len(nidx):
             # a silent zip-truncation here would book the wrong tasks
@@ -198,19 +203,25 @@ def apply_placements(infos: list, placed_groups: list) -> int:
             raise IndexError(
                 f"apply_placements: group {t0.service_id!r} node index "
                 f"out of range for {len(infos)} nodes")
+        if ids is None:
+            ids = [t.id for t in tasks]     # cold-but-correct fallback
+        elif len(ids) != len(tasks):
+            raise ValueError(
+                f"apply_placements: group {t0.service_id!r} ids/tasks "
+                "length mismatch")
         if len(tasks):
-            checked.append((t0, tasks, nidx))
+            checked.append((t0, tasks, nidx, ids))
 
     n_added = 0
     plain: list[tuple] = []
-    for t0, tasks, nidx in checked:
+    for t0, tasks, nidx, ids in checked:
         if group_needs_per_task_add(t0):
             for t, ni in zip(tasks, nidx.tolist()):
                 info = infos[ni]
                 if info is not None and info.add_task(t):
                     n_added += 1
         else:
-            plain.append((t0, tasks, nidx))
+            plain.append((t0, tasks, nidx, ids))
     if not plain:
         return n_added
 
@@ -219,10 +230,11 @@ def apply_placements(infos: list, placed_groups: list) -> int:
     mem_acc = np.zeros(N, np.int64)
     cpu_acc = np.zeros(N, np.int64)
     tasks_all: list = []
+    ids_all: list = []
     nodes_parts: list[np.ndarray] = []
     gi_parts: list[np.ndarray] = []
     svc_of: list[str] = []
-    for gi, (t0, tasks, nidx) in enumerate(plain):
+    for gi, (t0, tasks, nidx, ids) in enumerate(plain):
         res = task_reservations(t0.spec)
         svc_of.append(t0.service_id)
         cg = np.bincount(nidx, minlength=N)
@@ -231,6 +243,7 @@ def apply_placements(infos: list, placed_groups: list) -> int:
         if res.nano_cpus:
             cpu_acc += cg * res.nano_cpus
         tasks_all.extend(tasks)
+        ids_all.extend(ids)
         nodes_parts.append(nidx)
         gi_parts.append(np.full(len(nidx), gi, np.int64))
 
@@ -240,11 +253,12 @@ def apply_placements(infos: list, placed_groups: list) -> int:
 
     if _hostops is not None:
         # native segment walk (native/_hostops.c): same semantics as the
-        # Python walk below, ~6x less interpreter overhead per task
+        # Python walk below; with the parallel id list the happy path
+        # never dereferences a task object at all (ids + dict only)
         starts = np.flatnonzero(np.diff(nodes_srt, prepend=-1))
         i64 = lambda a: np.ascontiguousarray(a, np.int64)  # noqa: E731
         return n_added + _hostops.apply_segments(
-            infos, tasks_all, i64(oi), i64(nodes_srt),
+            infos, tasks_all, ids_all, i64(oi), i64(nodes_srt),
             i64(np.append(starts, len(nodes_srt))), i64(mem_acc),
             i64(cpu_acc), i64(np.concatenate(gi_parts)[oi]), svc_of,
             _add_serial)
@@ -254,7 +268,8 @@ def apply_placements(infos: list, placed_groups: list) -> int:
     oi_l = oi.tolist()
     tasks_srt = (list(itemgetter(*oi_l)(tasks_all)) if len(oi_l) > 1
                  else [tasks_all[oi_l[0]]])
-    ids_srt = [t.id for t in tasks_srt]
+    ids_srt = (list(itemgetter(*oi_l)(ids_all)) if len(oi_l) > 1
+               else [ids_all[oi_l[0]]])
     svc_arr = np.empty(len(plain), object)
     svc_arr[:] = svc_of
     svc_srt = svc_arr[np.concatenate(gi_parts)[oi]].tolist()
@@ -310,9 +325,11 @@ def apply_wave(infos: list, groups: list, orders: list) -> int:
     for g, order in zip(groups, orders):
         k = len(order)
         if k:
+            ids = g.task_ids() if hasattr(g, "task_ids") else None
             placed_groups.append(
                 (g.tasks[0], g.tasks[:k] if k < len(g.tasks) else g.tasks,
-                 order))
+                 order, ids[:k] if ids is not None and k < len(ids)
+                 else ids))
     return apply_placements(infos, placed_groups)
 
 

@@ -74,10 +74,20 @@ class TaskGroup:
     service_id: str
     spec_version: int
     tasks: list  # api.objects.Task, sorted by id
+    # parallel id list (same string objects). Build it WHERE the tasks are
+    # constructed/sorted (they are cache-hot there): the wave-commit walk
+    # keys on ids, and reading N ids off cold task objects is the walk's
+    # dominant miss chain. Lazily derived when absent (correct, just cold).
+    ids: list | None = None
 
     @property
     def key(self) -> tuple[str, int]:
         return (self.service_id, self.spec_version)
+
+    def task_ids(self) -> list:
+        if self.ids is None or len(self.ids) != len(self.tasks):
+            self.ids = [t.id for t in self.tasks]
+        return self.ids
 
     @property
     def spec(self):

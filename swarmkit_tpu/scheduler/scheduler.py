@@ -443,11 +443,14 @@ class Scheduler:
                 continue
             sv = t.spec_version.index if t.spec_version else 0
             grouped[(t.service_id or t.id, sv)].append(t)
-        return [
-            TaskGroup(service_id=k[0], spec_version=k[1],
-                      tasks=sorted(ts, key=lambda t: t.id))
-            for k, ts in grouped.items()
-        ]
+        out = []
+        for k, ts in grouped.items():
+            ts = sorted(ts, key=lambda t: t.id)
+            # ids built here, while the sort has the task objects hot —
+            # the wave-commit walk keys on them (TaskGroup.ids contract)
+            out.append(TaskGroup(service_id=k[0], spec_version=k[1],
+                                 tasks=ts, ids=[t.id for t in ts]))
+        return out
 
     # -------------------------------------------------------------- commits
     def _apply_decisions(self, problem, orders, counts=None,
@@ -537,10 +540,14 @@ class Scheduler:
             if group.tasks[0].spec.resources.reservations.generic:
                 with_generic.extend(
                     (task.id, node_ids[ni]) for task, ni in placed)
+            committed = [t for t, _ in placed]
             placed_groups.append(
-                (group.tasks[0], [t for t, _ in placed],
+                (group.tasks[0], committed,
                  np.fromiter((ni for _, ni in placed), np.int64,
-                             len(placed))))
+                             len(placed)),
+                 # ids built here while the committed copies are hot from
+                 # the store transaction (TaskGroup.ids contract)
+                 [t.id for t in committed]))
         n_added = apply_placements(
             [self.node_infos.get(nid) for nid in node_ids],
             placed_groups) if placed_groups else 0
