@@ -269,12 +269,14 @@ class CAServer:
             RootCA(rot0["new_ca_cert_pem"], rot0["new_ca_key_pem"],
                    intermediate_pem=rot0["cross_signed_pem"])
             if rot0 else self.root)
-        # external signer for this pass: the constructor-time one, or one
-        # configured live through ClusterSpec.CAConfig.external_cas (the
-        # control-API path; reference watches the cluster object the same
-        # way). A key-less signing root (rotation to an operator cert
-        # whose key an external CA holds) REQUIRES it.
-        pass_external = self._external_signer()
+        # external signer for this pass, selected FOR the active signing
+        # root (constructor-time one, or the matching
+        # ClusterSpec.CAConfig.external_cas entry — the control-API
+        # path). A key-less signing root (rotation to an operator cert
+        # whose key an external CA holds) REQUIRES a matching entry;
+        # entries for OTHER roots must not sign (their certs would never
+        # chain to this anchor and the rotation could never finish).
+        pass_external = self._external_signer(pass_signing_root.cert_pem)
         for node in pending:
             signing_root = pass_signing_root
             observed_state = node.certificate.status_state
@@ -389,23 +391,43 @@ class CAServer:
             return None
         return cluster.root_ca.root_rotation
 
-    def _external_signer(self):
-        """The active external CA: the constructor-injected one (swarmd
-        --external-ca) wins; otherwise build one from the replicated
-        ClusterSpec.CAConfig.external_cas — the control-API configuration
-        path (reference ca/server.go UpdateRootCA external CA wiring).
+    def _external_signer(self, signing_cert_pem: bytes | None = None):
+        """The external CA to sign with, FOR A GIVEN signing root: the
+        constructor-injected one (swarmd --external-ca) always wins;
+        otherwise the ClusterSpec.CAConfig.external_cas entry whose
+        ca_cert matches `signing_cert_pem` (an entry without a ca_cert
+        means "the cluster's current root", reference api CAConfig
+        semantics). Per-root selection is what lets a rotation COMPLETE:
+        during a rotation to a locally-keyed new root, the old root's
+        external CA must NOT keep signing (its certs never chain to the
+        new anchor — code-review r04 wedge), and with multiple entries
+        the one for the ACTIVE signing root is the only correct signer.
         Cached per (url, pinned cert) so steady passes don't rebuild TLS
         contexts."""
         if self.external_ca is not None:
             return self.external_ca
         cluster = self.store.view(
             lambda tx: tx.get_cluster(self.cluster_id))
-        entries = (cluster.spec.ca.external_cas
-                   if cluster is not None else None) or []
+        if cluster is None:
+            return None
+        entries = (cluster.spec.ca.external_cas or []
+                   if cluster.spec is not None else [])
+        current_root = (cluster.root_ca.ca_cert_pem
+                        if cluster.root_ca is not None else b"")
+        want = (signing_cert_pem if signing_cert_pem is not None
+                else current_root) or b""
+
+        def entry_cert(e):
+            c = e.get("ca_cert") or b""
+            if isinstance(c, str):
+                c = c.encode()
+            return c.strip() or current_root.strip()
+
         entry = next((e for e in entries
                       if isinstance(e, dict)
                       and (e.get("protocol") or "cfssl") == "cfssl"
-                      and e.get("url")), None)
+                      and e.get("url")
+                      and entry_cert(e) == want.strip()), None)
         if entry is None:
             self._spec_external = None
             return None
@@ -449,11 +471,14 @@ class CAServer:
         from a post-rotation CSR — i.e. the node itself fetched and swapped
         it. Re-signing old CSRs server-side would let the anchor swap race
         ahead of what nodes actually present on the wire."""
-        if self._external_signer() is not None:
-            # the external service signs under the OLD root's key; certs it
-            # issues can never chain to a locally minted new root, so the
-            # reconciler could never finish — fail fast instead of wedging
-            # (rotate the external CA's own root out-of-band first)
+        if self.external_ca is not None:
+            # the OPERATOR-PINNED external service (swarmd --external-ca)
+            # signs everything under the old root's key; certs it issues
+            # can never chain to a locally minted new root, so the
+            # reconciler could never finish — fail fast instead of
+            # wedging. (Spec-configured external CAs are selected
+            # per-root in _external_signer, so a locally-keyed rotation
+            # simply stops using them once the signing root flips.)
             raise CertificateError(
                 "root rotation with an external CA configured requires "
                 "rotating the external CA out-of-band, then updating the "

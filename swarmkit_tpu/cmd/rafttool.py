@@ -209,14 +209,24 @@ def cmd_renewcert(args):
     new_key, csr = create_csr(ident.node_id, ident.role, ident.org)
     new_cert = root.sign_csr(csr, expiry=expiry,
                              subject=(ident.node_id, ident.role, ident.org))
-    krw.write(new_key, headers)        # headers (raft DEKs) ride along
+    # key.json and cert.pem are two files: a crash between their writes
+    # leaves a mismatched identity. Minimize the window to back-to-back
+    # atomic renames by staging EVERYTHING first (the slow IO), and note
+    # that any intermediate state is healed by simply re-running this
+    # command (identity comes from the cert subject, which both old and
+    # new certs share; nothing here validates key/cert pairing).
     tmp = cert_path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(new_cert)
-    os.replace(tmp, cert_path)
+        f.flush()
+        os.fsync(f.fileno())
     ca_tmp = os.path.join(args.state_dir, "ca.pem.tmp")
     with open(ca_tmp, "wb") as f:
         f.write(root.cert_pem)
+        f.flush()
+        os.fsync(f.fileno())
+    krw.write(new_key, headers)        # headers (raft DEKs) ride along
+    os.replace(tmp, cert_path)
     os.replace(ca_tmp, os.path.join(args.state_dir, "ca.pem"))
     print(json.dumps({"renewed": ident.node_id,
                       "role": ident.role, "org": ident.org}))

@@ -113,12 +113,19 @@ class Context:
                 if "=" in e:
                     k, v = e.split("=", 1)
                     env[k] = v
+        svc_labels = (service.spec.annotations.labels
+                      if service is not None else
+                      # every task carries the full service annotations
+                      # (orchestrator/task.py NewTask copies them, like
+                      # the reference's Task.ServiceAnnotations) — the
+                      # worker-side call sites pass service=None and must
+                      # still expand {{.Service.Labels.*}}
+                      task.service_annotations.labels
+                      if task.service_annotations is not None else {})
         return cls(
             service_id=service.id if service is not None else task.service_id,
             service_name=svc_name,
-            service_labels=dict(service.spec.annotations.labels)
-            if service is not None and service.spec.annotations.labels
-            else {},
+            service_labels=dict(svc_labels or {}),
             node_id=node.id if node is not None else task.node_id,
             node_hostname=(
                 node.description.hostname

@@ -259,6 +259,48 @@ def test_rotation_without_root_key_fails_precondition(seeded):
         ctl.update_cluster("cluster-1", cur.meta.version, spec)
 
 
+def test_external_signer_selected_per_root(seeded):
+    """Code-review regression: the spec-configured external CA must be
+    selected by the ACTIVE signing root, not first-entry — and a
+    locally-keyed rotation must stop using the old root's external CA
+    (its certs can never chain to the new anchor)."""
+    from swarmkit_tpu.ca.server import CAServer
+
+    store, ctl, root = seeded
+    other = RootCA.create("other-root")
+    server = CAServer(store, root, "cluster-1", org="test-org")
+
+    cur = _cluster(store)
+    spec = _fresh_spec(ctl)
+    spec.ca.external_cas = [
+        # entry WITHOUT ca_cert = "the current cluster root"
+        {"protocol": "cfssl", "url": "https://old-ca:8888"},
+        {"protocol": "cfssl", "url": "https://other-ca:8888",
+         "ca_cert": other.cert_pem},
+    ]
+    ctl.update_cluster("cluster-1", cur.meta.version, spec)
+
+    # current root -> first entry; other root -> its pinned entry;
+    # an unknown root (a locally-keyed rotation target) -> NO external
+    assert server._external_signer(root.cert_pem).url \
+        == "https://old-ca:8888"
+    assert server._external_signer(other.cert_pem).url \
+        == "https://other-ca:8888"
+    fresh = RootCA.create("fresh")
+    assert server._external_signer(fresh.cert_pem) is None
+
+    # a force rotation (fresh local root) therefore signs locally and
+    # COMPLETES even with external entries configured for the old root
+    cur = _cluster(store)
+    spec = _fresh_spec(ctl)
+    spec.ca.force_rotate += 1
+    ctl.update_cluster("cluster-1", cur.meta.version, spec)
+    new_cert = _cluster(store).root_ca.root_rotation["new_ca_cert_pem"]
+    assert server._external_signer(new_cert) is None      # local key signs
+    server._reconcile_rotation()
+    assert _cluster(store).root_ca.root_rotation is None  # completed
+
+
 def test_ca_server_reconciler_picks_up_api_rotation(seeded):
     """The record written by update_cluster is driven to completion by the
     SAME CAServer reconciler rotate_root_ca feeds — signing root swaps to

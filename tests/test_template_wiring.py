@@ -77,6 +77,24 @@ def test_worker_expands_env_at_task_start():
     assert "PLAIN=x" in got
 
 
+def test_service_labels_expand_from_task_annotations():
+    """Code-review regression: worker call sites pass service=None; the
+    context must read {{.Service.Labels.*}} from task.service_annotations
+    (NewTask copies the full annotations, reference Task.ServiceAnnotations)."""
+    ex = FakeExecutor()
+    seen, report = _statuses()
+    w = Worker(ex, report, node_id="worker-0")
+    task = _mk_task(env=["REGION={{.Service.Labels.region}}",
+                        "ALL={{.Service.Labels}}"])
+    task.service_annotations = Annotations(
+        name="web", labels={"region": "eu-1", "tier": "gold"})
+    w.update([_change(task)])
+    assert wait_for(lambda: ex.controllers, timeout=5)
+    env = ex.controllers[0].task.spec.runtime.env
+    assert "REGION=eu-1" in env
+    assert "ALL=region=eu-1,tier=gold" in env
+
+
 def test_worker_expands_mount_source_dir_user():
     ex = FakeExecutor()
     seen, report = _statuses()
