@@ -7,7 +7,6 @@ CA flow, and hot-swaps it into the SecurityConfig so servers pick it up.
 from __future__ import annotations
 
 import threading
-import time
 
 from .certificates import create_csr
 from .config import SecurityConfig
@@ -18,10 +17,17 @@ class TLSRenewer:
     (ca/renewer.go TLSRenewer; request path ca/certificates.go
     RequestAndSaveNewCertificates:234)."""
 
-    def __init__(self, security: SecurityConfig, ca_server, check_interval: float = 1.0):
+    def __init__(self, security: SecurityConfig, ca_server,
+                 check_interval: float = 1.0, clock=None):
+        from ..utils.clock import REAL_CLOCK
+
         self.security = security
         self.ca_server = ca_server
         self.check_interval = check_interval
+        # injectable time source (utils/clock.py — the reference's
+        # ClockSource seam): tests drive the renewal window with FakeClock
+        # instead of waiting out real certificate lifetimes
+        self.clock = clock or REAL_CLOCK
         self._stop = threading.Event()
         self._renew_now = threading.Event()
         self._thread: threading.Thread | None = None
@@ -68,12 +74,13 @@ class TLSRenewer:
 
     def _run(self):
         while not self._stop.is_set():
-            triggered = self._renew_now.wait(timeout=self.check_interval)
+            triggered = self.clock.wait(self._renew_now,
+                                        self.check_interval)
             if self._stop.is_set():
                 return
             if triggered:
                 self._renew_now.clear()
-            if triggered or self.security.renewal_due(time.time()):
+            if triggered or self.security.renewal_due(self.clock.time()):
                 try:
                     self.renew_once()
                 except Exception:

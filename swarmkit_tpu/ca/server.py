@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 
 from ..api.objects import (
     EventCreate,
@@ -38,7 +37,10 @@ class CAServer:
 
     def __init__(self, store, root: RootCA, cluster_id: str,
                  org: str = "swarmkit-tpu", external_ca=None,
-                 cert_expiry: float | None = None):
+                 cert_expiry: float | None = None, clock=None):
+        from ..utils.clock import REAL_CLOCK
+
+        self.clock = clock or REAL_CLOCK
         self.store = store
         self.root = root
         self.cluster_id = cluster_id
@@ -187,6 +189,18 @@ class CAServer:
                     # valid token. Re-processing is a no-op for security
                     # (same public key, token re-verified by the caller),
                     # and denying it wedges the join forever.
+                    if (node.certificate.status_state
+                            != IssuanceState.ISSUED
+                            and getattr(node.certificate,
+                                        "rotation_epoch", 0) != epoch):
+                        # a rotation started since the original submission:
+                        # the signer skips stale-epoch CSRs (they could
+                        # never complete the rotation), so this retry IS
+                        # the post-rotation re-request — refresh the epoch
+                        # so the same-key CSR becomes signable again.
+                        node = node.copy()
+                        node.certificate.rotation_epoch = epoch
+                        tx.update(node)
                     return node_id
                 if caller is None or (
                     caller.node_id != node_id and caller.role != NodeRole.MANAGER
@@ -215,7 +229,7 @@ class CAServer:
         self, node_id: str, timeout: float = 10.0
     ) -> NodeCertificate:
         """NodeCA.NodeCertificateStatus long-poll (ca/server.go:148-232)."""
-        end = time.monotonic() + timeout
+        end = self.clock.monotonic() + timeout
         while True:
             node = self.store.view(lambda tx: tx.get_node(node_id))
             if node is None:
@@ -226,7 +240,7 @@ class CAServer:
                 IssuanceState.FAILED,
             ):
                 return cert
-            remaining = end - time.monotonic()
+            remaining = end - self.clock.monotonic()
             if remaining <= 0:
                 return cert
             with self._status_cond:
@@ -260,7 +274,14 @@ class CAServer:
                 in (IssuanceState.PENDING, IssuanceState.RENEW, IssuanceState.ROTATE)
             ]
         )
-        rot0 = self._rotation()
+        cluster0 = self.store.view(
+            lambda tx: tx.get_cluster(self.cluster_id))
+        rot0 = (cluster0.root_ca.root_rotation
+                if cluster0 is not None and cluster0.root_ca is not None
+                else None)
+        epoch0 = (cluster0.root_ca.last_forced_rotation
+                  if cluster0 is not None and cluster0.root_ca is not None
+                  else 0)
         # during a phased rotation the signer is the NEW root with the
         # cross-signed intermediate appended (ca/reconciler.go); one
         # snapshot per pass — per-node store views + key parses would
@@ -278,6 +299,21 @@ class CAServer:
         # chain to this anchor and the rotation could never finish).
         pass_external = self._external_signer(pass_signing_root.cert_pem)
         for node in pending:
+            if rot0 and getattr(node.certificate, "rotation_epoch", 0) != epoch0:
+                # The CSR was recorded BEFORE this rotation's epoch bump.
+                # Signing it now — under the NEW root — would hand the node
+                # a cert that satisfies its client-side chain check
+                # (node/daemon.py _ensure_rotation_renewal verifies the leaf
+                # against the bundle's new anchor) while the reconciler
+                # keeps waiting on the stale epoch: the node never re-CSRs
+                # and the rotation wedges (the round-4 load flake — the
+                # window is a renewal CSR in flight when rotate_root_ca
+                # lands, e.g. the bundle-shrink renewal kicked by a PRIOR
+                # rotation finishing). Leave it unsigned: the submitter's
+                # status poll times out and its straggler check submits a
+                # fresh CSR carrying the current epoch; token-join retries
+                # refresh the epoch via the idempotent path below.
+                continue
             signing_root = pass_signing_root
             observed_state = node.certificate.status_state
             signed_csr = node.certificate.csr_pem
@@ -532,7 +568,7 @@ class CAServer:
             # waits for EVERY node — down nodes must be removed by the
             # operator; surface who is holding it up instead of stalling
             # silently
-            now = time.monotonic()
+            now = self.clock.monotonic()
             if now - getattr(self, "_last_rotation_log", 0) > 30:
                 self._last_rotation_log = now
                 log.warning(

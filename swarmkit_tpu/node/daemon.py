@@ -987,11 +987,17 @@ class SwarmNode:
                 while not self._stop.is_set() \
                         and time.monotonic() < deadline:
                     try:
-                        self.renewer.renew_once()
-                        return
-                    except Exception:
-                        if self._stop.wait(JOIN_RETRY):
+                        # False = soft failure (status poll timed out —
+                        # e.g. the CA skipped our CSR because a rotation
+                        # bumped the epoch after we submitted it). Retry:
+                        # each renew_once submits a FRESH CSR, which picks
+                        # up the current epoch.
+                        if self.renewer.renew_once():
                             return
+                    except Exception:
+                        pass
+                    if self._stop.wait(JOIN_RETRY):
+                        return
             finally:
                 self._root_renew_active = False
 

@@ -167,6 +167,11 @@ class Clock:
     def monotonic(self) -> float:
         return time.monotonic()
 
+    def time(self) -> float:
+        """Wall-clock seconds (certificate validity windows are wall time —
+        ca/renewer.go computes the renewal point from NotAfter/NotBefore)."""
+        return time.time()
+
     def wait(self, event: threading.Event, timeout: float | None) -> bool:
         """Event.wait under this clock; returns event state like Event.wait."""
         return event.wait(timeout)
@@ -210,6 +215,12 @@ class FakeClock(Clock):
         self._timers: list[_FakeTimer] = []
 
     def monotonic(self) -> float:
+        with self._cond:
+            return self._now
+
+    def time(self) -> float:
+        # the fake clock's single timeline serves as wall time too; start
+        # it at time.time() in tests that exercise certificate windows
         with self._cond:
             return self._now
 

@@ -409,3 +409,138 @@ def test_join_retry_same_csr_is_idempotent():
         server.issue_node_certificate(
             other_csr, token=cluster.root_ca.join_token_manager,
             node_id="retry-node")
+
+
+def test_rotation_skips_stale_epoch_csr():
+    """The round-4 repeated-rotation wedge, reproduced deterministically:
+    a renewal CSR recorded BEFORE rotate_root_ca bumps the epoch must NOT
+    be signed under the new root — the issued cert would chain to the new
+    anchor (satisfying the node's client-side straggler check,
+    node/daemon.py _ensure_rotation_renewal) while the reconciler waits on
+    the stale epoch forever. The signer skips it; the node's retry submits
+    a fresh CSR at the current epoch and the rotation converges."""
+    store = MemoryStore()
+    root = RootCA.create()
+    cluster = _cluster_with_ca(store, root)
+    server = CAServer(store, root, "cluster-1")
+
+    _, csr = create_csr("x", NodeRole.WORKER, "swarmkit-tpu")
+    node_id = server.issue_node_certificate(
+        csr, token=cluster.root_ca.join_token_worker)
+    server._sign_pending()
+
+    # renewal CSR lands... then the rotation starts (epoch bump wins)
+    _, csr2 = create_csr("x", NodeRole.WORKER, "swarmkit-tpu")
+    server.issue_node_certificate(
+        csr2, node_id=node_id,
+        caller=Caller(node_id, NodeRole.WORKER, "swarmkit-tpu"))
+    new_root = server.rotate_root_ca()
+
+    # the stale-epoch CSR stays unsigned — this is the wedge guard
+    server._sign_pending()
+    node = store.view(lambda tx: tx.get_node(node_id))
+    assert node.certificate.status_state == IssuanceState.PENDING
+    server._reconcile_rotation()
+    cl = store.view(lambda tx: tx.get_cluster("cluster-1"))
+    assert cl.root_ca.root_rotation is not None
+
+    # the node's soft-failure retry submits a FRESH CSR (new key) at the
+    # current epoch → signed under the new root → rotation finishes
+    _, csr3 = create_csr("x", NodeRole.WORKER, "swarmkit-tpu")
+    server.issue_node_certificate(
+        csr3, node_id=node_id,
+        caller=Caller(node_id, NodeRole.WORKER, "swarmkit-tpu"))
+    server._sign_pending()
+    cert = server.node_certificate_status(node_id, timeout=2)
+    assert cert.status_state == IssuanceState.ISSUED
+    new_root.verify_cert(cert.certificate_pem)
+    server._reconcile_rotation()
+    cl = store.view(lambda tx: tx.get_cluster("cluster-1"))
+    assert cl.root_ca.root_rotation is None
+
+
+def test_join_retry_refreshes_rotation_epoch():
+    """A joiner's CSR recorded just before a rotation starts is skipped by
+    the signer (stale epoch); its idempotent same-CSR retry must refresh
+    the stored epoch so the join can complete — otherwise the joiner polls
+    forever against a CSR that can never be signed."""
+    store = MemoryStore()
+    root = RootCA.create()
+    cluster = _cluster_with_ca(store, root)
+    server = CAServer(store, root, "cluster-1")
+
+    _, csr = create_csr("x", NodeRole.WORKER, "swarmkit-tpu")
+    node_id = server.issue_node_certificate(
+        csr, token=cluster.root_ca.join_token_worker)
+    new_root = server.rotate_root_ca()
+
+    server._sign_pending()
+    node = store.view(lambda tx: tx.get_node(node_id))
+    assert node.certificate.status_state == IssuanceState.PENDING  # skipped
+
+    # joiner's poll timed out → it re-submits the SAME CSR (the old-root
+    # token is still valid mid-rotation) → epoch refreshed → signable
+    server.issue_node_certificate(
+        csr, token=cluster.root_ca.join_token_worker, node_id=node_id)
+    server._sign_pending()
+    cert = server.node_certificate_status(node_id, timeout=2)
+    assert cert.status_state == IssuanceState.ISSUED
+    new_root.verify_cert(cert.certificate_pem)
+    server._reconcile_rotation()
+    cl = store.view(lambda tx: tx.get_cluster("cluster-1"))
+    assert cl.root_ca.root_rotation is None
+
+
+def test_renewer_window_on_fake_clock():
+    """The renewal chain rides utils/clock.py (the reference ClockSource
+    seam, ca/renewer.go): a FakeClock drives the cert into its renewal
+    window without waiting out real lifetimes."""
+    import threading
+
+    from swarmkit_tpu.utils.clock import FakeClock
+
+    store = MemoryStore()
+    root = RootCA.create()
+    cluster = _cluster_with_ca(store, root)
+    server = CAServer(store, root, "cluster-1")
+    key_pem, csr_pem = create_csr("mgr-1", NodeRole.MANAGER, "swarmkit-tpu")
+    server.issue_node_certificate(
+        csr_pem, token=cluster.root_ca.join_token_manager, node_id="mgr-1")
+    server._sign_pending()
+    first = server.node_certificate_status("mgr-1", timeout=2)
+    sec = SecurityConfig(root, key_pem, first.certificate_pem)
+
+    clock = FakeClock(start=time.time())
+    renewer = TLSRenewer(sec, server, check_interval=1.0, clock=clock)
+    old_cert = sec.key_and_cert()[1]
+    renewer.start()
+    # background signer stands in for the CA server loop
+    stop = threading.Event()
+
+    def signer():
+        while not stop.wait(0.05):
+            server._sign_pending()
+
+    st = threading.Thread(target=signer, daemon=True)
+    st.start()
+    try:
+        # inside the validity plateau: ticks pass, no renewal happens
+        for _ in range(5):
+            clock.advance(1.0)
+        time.sleep(0.3)
+        assert sec.key_and_cert()[1] == old_cert
+        # jump deep into the renewal window (default expiry is long; 90%
+        # of it is safely past the renewal threshold)
+        _, not_after = cert_expiry(old_cert)
+        clock.advance(max(0.0, (not_after - clock.time()) * 0.9))
+        for _ in range(20):
+            clock.advance(1.0)
+            if sec.key_and_cert()[1] != old_cert:
+                break
+            time.sleep(0.1)
+        assert sec.key_and_cert()[1] != old_cert
+        root.verify_cert(sec.key_and_cert()[1])
+    finally:
+        stop.set()
+        renewer.stop()
+        st.join(timeout=2)
