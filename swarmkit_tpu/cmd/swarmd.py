@@ -64,11 +64,14 @@ def main(argv=None) -> int:
     ap.add_argument("--heartbeat-period", type=float, default=5.0)
     ap.add_argument("--tick-interval", type=float, default=0.1,
                     help="raft logical-clock tick (election ~10-20 ticks)")
-    ap.add_argument("--scheduler-backend", choices=["auto", "cpu", "jax"],
+    ap.add_argument("--scheduler-backend",
+                    choices=["auto", "cpu", "jax", "mesh"],
                     default="auto",
                     help="placement backend: auto picks per tick by "
                          "task-times-node product against --jax-threshold; "
-                         "cpu/jax pin the path (SURVEY §7)")
+                         "cpu/jax pin the path; mesh pins jax AND shards "
+                         "the device-resident node state over every "
+                         "visible device (parallel/mesh.py) (SURVEY §7)")
     ap.add_argument("--jax-threshold", type=int, default=None,
                     metavar="PRODUCT",
                     help="task*node product above which auto uses the "

@@ -99,6 +99,45 @@ _resident_tick_donating = jax.jit(
     donate_argnums=tuple(range(8)))
 _resident_tick_plain = jax.jit(_resident_tick_impl, static_argnames=_STATICS)
 
+# mesh-mode tick jits, cached per Mesh: a fresh jax.jit wrapper per
+# ResidentPlacement instance would discard the compile cache every time a
+# scheduler restarts (leadership churn) or a test builds a new instance
+_MESH_TICKS: dict = {}
+
+
+def _mesh_ticks(mesh, shard):
+    cached = _MESH_TICKS.get(mesh)
+    if cached is None:
+        from ..parallel.mesh import node_axis_sharding
+
+        # pin the carry layout: without out_shardings GSPMD is free to
+        # return replicated state, silently multiplying memory by the
+        # device count and resharding every steady tick
+        outs = (node_axis_sharding(mesh, 2, 1),       # counts [G, N]
+                shard["ready"], shard["node_val"], shard["node_plat"],
+                shard["node_plugins"], shard["port_used"],
+                shard["avail_res"], shard["total0"], shard["svc_mat"])
+        cached = (
+            jax.jit(_resident_tick_impl, static_argnames=_STATICS,
+                    donate_argnums=tuple(range(8)), out_shardings=outs),
+            jax.jit(_resident_tick_impl, static_argnames=_STATICS,
+                    out_shardings=outs),
+        )
+        _MESH_TICKS[mesh] = cached
+    return cached
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_zeros_fn(shape, sharding):
+    return jax.jit(lambda: jnp.zeros(shape, np.int32),
+                   out_shardings=sharding)
+
+
+def _sharded_zeros(shape, sharding):
+    """Device-side sharded zeros; the jitted builder is cached per
+    (shape, sharding) so repeated cold uploads don't re-trace."""
+    return _sharded_zeros_fn(shape, sharding)()
+
 
 @functools.partial(jax.jit, static_argnames=("g", "n"))
 def _slice_counts(counts, g: int, n: int):
@@ -161,8 +200,34 @@ class ResidentPlacement:
         rp.after_apply(problem, counts)        # or rp.invalidate()
     """
 
-    def __init__(self, encoder: IncrementalEncoder):
+    def __init__(self, encoder: IncrementalEncoder, mesh=None):
+        """mesh: a jax.sharding.Mesh with a `nodes` axis — the PRODUCTION
+        multi-device mode (parallel/mesh.py layout): device state shards
+        over the node axis, group tables replicate, and the tick jit runs
+        under GSPMD with XLA-inserted collectives. None = single device."""
         self.enc = encoder
+        self.mesh = mesh
+        if mesh is not None:
+            from ..parallel.mesh import resident_shardings
+
+            n_dev = int(mesh.devices.size)
+            if n_dev & (n_dev - 1):
+                # buckets are powers of two; a non-power-of-two mesh axis
+                # could never divide them (jax.device_put would raise a
+                # cryptic divisibility error on first upload)
+                raise ValueError(
+                    f"mesh node axis must be a power of two, got {n_dev} "
+                    "devices (Scheduler rounds down automatically; "
+                    "pass mesh=<n> to pick explicitly)")
+            self._shard = resident_shardings(mesh)
+            self._mesh_devs = n_dev
+            self._tick_donating, self._tick_plain = _mesh_ticks(
+                mesh, self._shard)
+        else:
+            self._shard = None
+            self._mesh_devs = 1
+            self._tick_donating = _resident_tick_donating
+            self._tick_plain = _resident_tick_plain
         self._state = None          # tuple of device arrays, STATE_FIELDS
         self._meta = None           # bucket/vocab signature of the state
         self._pending = np.zeros(0, np.int64)  # rows to upload next tick
@@ -207,8 +272,11 @@ class ResidentPlacement:
 
     def _padded_dims(self, p: EncodedProblem) -> tuple:
         """Bucketed (N, K, PL, PV, R, S) — must agree with pad_buckets so
-        the node state lines up with the per-tick group tables."""
-        return (_bucket(len(p.node_ids)),
+        the node state lines up with the per-tick group tables. In mesh
+        mode the node bucket floors at the device count so the sharded
+        axis divides evenly (buckets and mesh sizes are both powers of
+        two); phantom pad nodes are never eligible, so results match."""
+        return (_bucket(len(p.node_ids), floor=self._mesh_devs),
                 _bucket(p.node_val.shape[1]),
                 _bucket(p.node_plugins.shape[1]),
                 _bucket(p.port_used0.shape[1]),
@@ -238,7 +306,11 @@ class ResidentPlacement:
             self._pad2(p.avail_res, np_b, rp),
             self._pad2(p.total0, np_b),
         ]
-        state = jax.device_put(host)
+        if self._shard is not None:
+            state = jax.device_put(host, [
+                self._shard[f] for f in STATE_FIELDS[:7]])
+        else:
+            state = jax.device_put(host)
         # the [S, N] per-service count matrix is the cold upload's whale
         # (at 100k nodes it alone is 17-67 MB through a single-digit-MB/s
         # tunnel) and on a cold cluster / post-failover first contact it
@@ -248,7 +320,11 @@ class ResidentPlacement:
         svc = self._svc_block(slice(None), sp)
         nnz = int(np.count_nonzero(svc))
         if nnz == 0:
-            svc_dev = jnp.zeros((sp, np_b), np.int32)
+            if self._shard is not None:
+                svc_dev = _sharded_zeros(
+                    (sp, np_b), self._shard["svc_mat"])
+            else:
+                svc_dev = jnp.zeros((sp, np_b), np.int32)
         elif nnz * 3 * 4 < svc.size:
             # sparse ships 8 bytes/nnz (int32 flat idx + int32 val) vs 4
             # bytes/cell dense, so breakeven is nnz*2 < cells; the
@@ -263,9 +339,13 @@ class ResidentPlacement:
             svc_flat = jnp.zeros(sp * np_b, np.int32).at[
                 jax.device_put(flat)].add(jax.device_put(svc[r, c]))
             svc_dev = svc_flat.reshape(sp, np_b)
+            if self._shard is not None:
+                svc_dev = jax.device_put(svc_dev, self._shard["svc_mat"])
         else:
-            svc_dev = jax.device_put(np.ascontiguousarray(
-                np.pad(svc, ((0, 0), (0, np_b - n)))))
+            pad = np.ascontiguousarray(
+                np.pad(svc, ((0, 0), (0, np_b - n))))
+            svc_dev = (jax.device_put(pad, self._shard["svc_mat"])
+                       if self._shard is not None else jax.device_put(pad))
         state.append(svc_dev)
         self._state = state
         self._meta = self._signature(p)
@@ -404,13 +484,31 @@ class ResidentPlacement:
             else:
                 ship_slots.append(i)
                 to_ship.append(h)
-        dev = jax.device_put(deltas + to_ship)
+        if self._shard is not None:
+            # group-table slots whose trailing axis is the (bucketed) node
+            # axis shard over it; everything else — including the delta
+            # rows, which scatter INTO the sharded state — replicates.
+            # Placeholder (1, 1) penalty/extra tables stay replicated.
+            node_sharded = {7: 1, 10: 2, 11: 1}    # slot -> node axis
+            repl = self._shard[None]
+            shards = [repl] * len(deltas)
+            from ..parallel.mesh import node_axis_sharding
+            for slot, h in zip(ship_slots, to_ship):
+                ax = node_sharded.get(slot)
+                if ax is not None and h.shape[-1] == np_b:
+                    shards.append(
+                        node_axis_sharding(self.mesh, h.ndim, ax))
+                else:
+                    shards.append(repl)
+            dev = jax.device_put(deltas + to_ship, shards)
+        else:
+            dev = jax.device_put(deltas + to_ship)
         for slot, d in zip(ship_slots, dev[9:]):
             group_dev[slot] = d
         self._gcache = [(h, d) for h, d in zip(group_np, group_dev)]
         self.uploads_group_tables += len(ship_slots)
-        tick = (_resident_tick_donating if self._donate
-                else _resident_tick_plain)
+        tick = (self._tick_donating if self._donate
+                else self._tick_plain)
         out = tick(
             *self._state, *dev[:9], *group_dev,
             use_penalty=use_penalty, use_extra=use_extra,

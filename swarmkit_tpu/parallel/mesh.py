@@ -55,6 +55,45 @@ def make_mesh(n_devices: int | None = None, axis: str = NODE_AXIS) -> Mesh:
     return Mesh(np.array(devices), (axis,))
 
 
+# ---------------------------------------------------------------------------
+# Production resident-state shardings (ops/resident.py mesh mode).
+#
+# The device-resident scheduler carries its node tables across ticks; in mesh
+# mode every per-node axis is sharded over `nodes` and the per-tick group
+# tables replicate (they are small) except the [*, N]-shaped ones, which
+# shard their node axis so the fill kernel reads co-resident data. XLA
+# inserts the cross-shard collectives (segment-sum psums, the boundary
+# lexsort gather) exactly as in the one-shot `sharded_schedule` proof path —
+# this dict is what makes that layout the PRODUCTION layout.
+
+RESIDENT_STATE_SPECS = {
+    "ready": P(NODE_AXIS),
+    "node_val": P(NODE_AXIS, None),
+    "node_plat": P(NODE_AXIS, None),
+    "node_plugins": P(NODE_AXIS, None),
+    "port_used": P(NODE_AXIS, None),
+    "avail_res": P(NODE_AXIS, None),
+    "total0": P(NODE_AXIS),
+    "svc_mat": P(None, NODE_AXIS),
+}
+
+
+def resident_shardings(mesh: Mesh) -> dict:
+    """NamedShardings for ResidentPlacement's device state, plus the
+    replicated default under `None`."""
+    out = {k: NamedSharding(mesh, spec)
+           for k, spec in RESIDENT_STATE_SPECS.items()}
+    out[None] = NamedSharding(mesh, P())
+    return out
+
+
+def node_axis_sharding(mesh: Mesh, ndim: int, axis: int) -> NamedSharding:
+    """A NamedSharding placing `axis` of an ndim-array on the node axis."""
+    parts = [None] * ndim
+    parts[axis] = NODE_AXIS
+    return NamedSharding(mesh, P(*parts))
+
+
 def _pad_nodes(arr: np.ndarray, n_pad: int, axis: int, fill):
     if n_pad == 0:
         return arr

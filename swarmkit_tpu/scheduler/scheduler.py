@@ -61,9 +61,14 @@ PIPELINED_JAX_THRESHOLD = 100_000
 
 class Scheduler:
     def __init__(self, store: MemoryStore, backend: str = "auto",
-                 jax_threshold: int | None = None, pipeline: bool = False):
+                 jax_threshold: int | None = None, pipeline: bool = False,
+                 mesh=None):
         """backend: "auto" picks per tick by task×node product against
-        `jax_threshold` (default JAX_THRESHOLD); "cpu"/"jax" pin the path.
+        `jax_threshold` (default JAX_THRESHOLD); "cpu"/"jax" pin the path;
+        "mesh" pins the jax path AND shards the device-resident node state
+        over every visible device's `nodes` mesh axis (parallel/mesh.py
+        layout — the production multi-chip mode). `mesh` narrows it: an
+        int takes the first n devices, a jax.sharding.Mesh is used as-is.
         The right threshold is deployment-specific — a PCIe-attached or
         on-host accelerator amortizes ~100× sooner than the dev tunnel
         (BASELINE.md, operator guidance) — so swarmd exposes both knobs
@@ -81,6 +86,7 @@ class Scheduler:
         touched rows — the same self-healing the serial path uses."""
         self.store = store
         self.backend = backend
+        self.mesh = mesh
         self.jax_threshold = (
             (PIPELINED_JAX_THRESHOLD if pipeline else JAX_THRESHOLD)
             if jax_threshold is None else jax_threshold)
@@ -329,7 +335,8 @@ class Scheduler:
             if self._resident is None:
                 from ..ops.resident import ResidentPlacement
 
-                self._resident = ResidentPlacement(self.encoder)
+                self._resident = ResidentPlacement(
+                    self.encoder, mesh=self._make_mesh())
             if self.pipeline:
                 # dispatch only: the counts D2H rides the link through the
                 # debounce window; the next tick completes the wave
@@ -347,9 +354,28 @@ class Scheduler:
         orders = materialize_orders(problem, counts)
         self._apply_decisions(problem, orders, counts)
 
+    def _make_mesh(self):
+        """Resolve the configured mesh (backend="mesh" / mesh=) to a
+        jax.sharding.Mesh, or None for single-device."""
+        mesh = self.mesh
+        if mesh is None and self.backend != "mesh":
+            return None
+        if mesh is None or isinstance(mesh, int):
+            import jax
+
+            from ..parallel.mesh import make_mesh
+
+            n = mesh if mesh is not None else len(jax.devices())
+            # the resident state's node buckets are powers of two, so the
+            # sharded axis must be one too: round down (a 6-device host
+            # runs a 4-device mesh rather than crashing on upload)
+            n = 1 << (max(n, 1).bit_length() - 1)
+            mesh = make_mesh(n)
+        return mesh
+
     def _use_jax(self, problem) -> bool:
         total_tasks = int(problem.n_tasks.sum())
-        return (self.backend == "jax"
+        return (self.backend in ("jax", "mesh")
                 or (self.backend == "auto"
                     and total_tasks * max(len(problem.node_ids), 1)
                     >= self.jax_threshold))
@@ -385,7 +411,7 @@ class Scheduler:
             # be discarded and redone by the fallthrough below)
             total_next = sum(len(g.tasks) for g in next_groups)
             if next_groups and (
-                    self.backend == "jax"
+                    self.backend in ("jax", "mesh")
                     or total_next * max(len(self.node_infos), 1)
                     >= self.jax_threshold):
                 p_next = self.encoder.encode(

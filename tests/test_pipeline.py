@@ -92,7 +92,7 @@ def run_pipelined_trace(seed, steps=8, group_maker=random_group,
 
 
 @pytest.mark.parametrize("seed", range(4))
-def test_pipelined_trace_parity(seed):
+def test_pipelined_trace_parity(seed, placement_mode):
     enc, rp, pipe, completed = run_pipelined_trace(seed)
     # steady clean-node waves never take the serial fallback
     assert not any(t["serial_fallback"] for t in pipe.timings)
@@ -118,7 +118,7 @@ def test_pipelined_trace_parity_odd_reservations(seed):
 
 @pytest.mark.parametrize("depth", [2, 3])
 @pytest.mark.parametrize("seed", range(3))
-def test_deep_pipeline_matches_depth_one(seed, depth):
+def test_deep_pipeline_matches_depth_one(seed, depth, placement_mode):
     """Pipeline depth must not change placements: the same wave trace at
     depth D and depth 1 produces bit-identical per-wave counts and the
     same final encoder state. (Depth-D encodes wave k before waves
@@ -251,7 +251,7 @@ def test_deep_pipeline_new_service_rows_drain():
 
 
 @pytest.mark.parametrize("seed", range(4))
-def test_pipelined_trace_with_node_churn_falls_back_serial(seed):
+def test_pipelined_trace_with_node_churn_falls_back_serial(seed, placement_mode):
     """External mutations between waves (node add/remove/update) flip
     nodes_clean to False: the pipeline must commit the deferred wave
     first, then encode — and parity must hold through the remap."""
@@ -351,7 +351,7 @@ def _seed_cluster(tx_nodes=6, waves=(("s1", 8),)):
     return store
 
 
-def test_scheduler_pipelined_mode_end_to_end():
+def test_scheduler_pipelined_mode_end_to_end(placement_mode):
     """Sustained waves through Scheduler(pipeline=True): every task lands
     ASSIGNED, the pipeline actually engages (in-flight wave observed), and
     no task is double-assigned."""
@@ -470,7 +470,7 @@ def test_scheduler_pipelined_unclean_commit_heals():
         sched.store.queue.stop_watch(ch)
 
 
-def test_scheduler_pipelined_chaos_never_overcommits():
+def test_scheduler_pipelined_chaos_never_overcommits(placement_mode):
     """Live run-loop chaos: waves of services created while PENDING tasks
     are randomly deleted mid-flight. Invariants at quiescence:
     every surviving RUNNING-desired task is ASSIGNED to an existing READY

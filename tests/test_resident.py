@@ -113,12 +113,12 @@ def run_trace(seed, steps=7, group_maker=random_group):
 
 
 @pytest.mark.parametrize("seed", range(4))
-def test_trace_parity_quantum_reservations(seed):
+def test_trace_parity_quantum_reservations(seed, placement_mode):
     run_trace(seed)
 
 
 @pytest.mark.parametrize("seed", range(4))
-def test_trace_parity_odd_reservations(seed):
+def test_trace_parity_odd_reservations(seed, placement_mode):
     """Non-quantum reservations force the correction-row path every tick;
     parity must hold anyway."""
     run_trace(100 + seed, group_maker=odd_group)
@@ -293,7 +293,7 @@ def test_invalidate_recovers_from_external_surgery():
     assert rp.uploads_full == 2
 
 
-def test_node_churn_triggers_full_reupload_and_stays_correct():
+def test_node_churn_triggers_full_reupload_and_stays_correct(placement_mode):
     rng = random.Random(10)
     infos = [make_info(rng, i) for i in range(8)]
     enc = IncrementalEncoder()
@@ -310,7 +310,7 @@ def test_node_churn_triggers_full_reupload_and_stays_correct():
     assert rp.uploads_full == 2               # remap → full upload
 
 
-def test_scheduler_uses_resident_path_end_to_end():
+def test_scheduler_uses_resident_path_end_to_end(placement_mode):
     """Store → Scheduler(backend=jax) → tasks ASSIGNED, across two waves,
     with the resident wrapper active and folding between waves."""
     import time
