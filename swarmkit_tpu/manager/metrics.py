@@ -55,7 +55,7 @@ class MetricsCollector:
         plus every hot-path latency histogram (store tx/lock-hold, raft
         propose, scheduling delay — memory.go:99-112, raft.go:204-209,
         dispatcher.go:72-77)."""
-        from ..utils.metrics import all_histograms
+        from ..utils.metrics import all_families, all_histograms
 
         snap = self.snapshot()
         lines = []
@@ -65,6 +65,10 @@ class MetricsCollector:
             lines.append(f'swarm_node_info{{state="{state.lower()}"}} {n}')
         for h in sorted(all_histograms(), key=lambda h: h.name):
             lines.append(h.prometheus_text())
+        # per-RPC started/handled/latency families (rpc/server.py — the
+        # grpc_prometheus surface, manager/manager.go:551,562)
+        for f in sorted(all_families(), key=lambda f: f.name):
+            lines.append(f.prometheus_text())
         return "\n".join(lines) + "\n"
 
     # -- internals ---------------------------------------------------------

@@ -84,10 +84,13 @@ class DebugServer:
             for c in getattr(mgr, "_leader_components", []):
                 if hasattr(c, "prometheus_text"):
                     return c.prometheus_text()
-        # non-leader / worker: hot-path histograms still exist
-        from ..utils.metrics import all_histograms
+        # non-leader / worker: hot-path histograms + per-RPC families
+        # still exist
+        from ..utils.metrics import all_families, all_histograms
 
-        return "\n".join(h.prometheus_text() for h in all_histograms())
+        return "\n".join(
+            [h.prometheus_text() for h in all_histograms()]
+            + [f.prometheus_text() for f in all_families()])
 
     def _vars(self) -> dict:
         node = self.node

@@ -43,6 +43,24 @@ log = logging.getLogger("swarmkit_tpu.rpc.server")
 
 ANON = "anon"  # marker role: method callable without a client certificate
 
+# per-RPC server metrics, the grpc_prometheus.Register surface the
+# reference installs on both gRPC servers (manager/manager.go:551,562):
+# every method gets started/handled counters (handled carries the
+# result code) and a handling-latency histogram, all surfaced through
+# /metrics (node/debugserver.py -> utils.metrics exposition)
+from ..utils.metrics import counter_family, histogram_family  # noqa: E402
+
+RPC_STARTED = counter_family(
+    "swarm_rpc_server_started_total",
+    "RPCs begun on the server, per method", ("method",))
+RPC_HANDLED = counter_family(
+    "swarm_rpc_server_handled_total",
+    "RPCs completed on the server, per method and code",
+    ("method", "code"))
+RPC_LATENCY = histogram_family(
+    "swarm_rpc_server_handling_seconds",
+    "Server-side RPC handling latency, per method", ("method",))
+
 
 @dataclass
 class MethodDef:
@@ -234,6 +252,15 @@ class RPCServer:
     # -- dispatch ----------------------------------------------------------
     def _handle_request(self, conn, wlock, caller: Caller | None,
                         stream_id: int, method: str, payload, cancels):
+        import time as _time
+
+        t_start = _time.perf_counter()
+        RPC_STARTED.inc((method,))
+
+        def finish(code: str):
+            RPC_HANDLED.inc((method, code))
+            RPC_LATENCY.observe((method,), _time.perf_counter() - t_start)
+
         def reply_err(exc: Exception):
             from .wire import RPCError
 
@@ -244,6 +271,7 @@ class RPCServer:
                 name, msg = exc.name, exc.message
             else:
                 name, msg = type(exc).__name__, str(exc)
+            finish(name)
             try:
                 send_frame(conn, wlock, [ERR, stream_id, name, msg])
             except (OSError, ValueError):
@@ -288,14 +316,16 @@ class RPCServer:
         if not mdef.streaming:
             try:
                 send_frame(conn, wlock, [RESP, stream_id, "", result])
+                finish("OK")
             except ValueError as exc:  # encode failure
                 reply_err(exc)
             except OSError:
-                pass
+                finish("OK")           # handler succeeded; conn died
             return
         # streaming: pump a Channel or generator until done/cancel/dead conn
         cancel = threading.Event()
         cancels[stream_id] = cancel
+        stream_code = "OK"
         try:
             if isinstance(result, Channel):
                 while not cancel.is_set() and not self._stop.is_set():
@@ -317,8 +347,11 @@ class RPCServer:
         except (OSError, ValueError, ConnectionClosed):
             pass
         except Exception as exc:
+            stream_code = None          # reply_err records the error code
             reply_err(exc)
         finally:
+            if stream_code is not None:
+                finish(stream_code)
             cancels.pop(stream_id, None)
             if isinstance(result, Channel):
                 result.close()

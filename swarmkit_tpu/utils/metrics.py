@@ -18,9 +18,10 @@ DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
 
 class Histogram:
     def __init__(self, name: str, help_: str = "",
-                 buckets: tuple = DEFAULT_BUCKETS):
+                 buckets: tuple = DEFAULT_BUCKETS, labels: str = ""):
         self.name = name
         self.help = help_
+        self.labels = labels          # pre-rendered 'k="v",...' or ""
         self.buckets = tuple(buckets)
         self._counts = [0] * (len(self.buckets) + 1)  # +inf tail
         self._sum = 0.0
@@ -38,20 +39,92 @@ class Histogram:
         with self._lock:
             return list(self._counts), self._sum, self._n
 
-    def prometheus_text(self) -> str:
+    def prometheus_text(self, type_line: bool = True) -> str:
         counts, total, n = self.snapshot()
-        lines = [f"# TYPE {self.name} histogram"]
+        lines = [f"# TYPE {self.name} histogram"] if type_line else []
+        lbl = (self.labels + ",") if self.labels else ""
         cum = 0
         for b, c in zip(self.buckets, counts):
             cum += c
-            lines.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
-        lines.append(f'{self.name}_bucket{{le="+Inf"}} {n}')
-        lines.append(f"{self.name}_sum {total:.6f}")
-        lines.append(f"{self.name}_count {n}")
+            lines.append(f'{self.name}_bucket{{{lbl}le="{b}"}} {cum}')
+        lines.append(f'{self.name}_bucket{{{lbl}le="+Inf"}} {n}')
+        suffix = f"{{{self.labels}}}" if self.labels else ""
+        lines.append(f"{self.name}_sum{suffix} {total:.6f}")
+        lines.append(f"{self.name}_count{suffix} {n}")
+        return "\n".join(lines)
+
+
+def _render_labels(label_names: tuple, values: tuple) -> str:
+    return ",".join(f'{k}="{v}"' for k, v in zip(label_names, values))
+
+
+class CounterFamily:
+    """Labeled monotonic counters (the grpc_prometheus
+    grpc_server_handled_total shape): one family, one series per label
+    tuple, created on first increment."""
+
+    def __init__(self, name: str, help_: str, label_names: tuple):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._series: dict[tuple, int] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, values: tuple, n: int = 1):
+        with self._lock:
+            self._series[values] = self._series.get(values, 0) + n
+
+    def value(self, values: tuple) -> int:
+        with self._lock:
+            return self._series.get(values, 0)
+
+    def prometheus_text(self) -> str:
+        with self._lock:
+            items = sorted(self._series.items())
+        lines = [f"# TYPE {self.name} counter"]
+        for values, n in items:
+            lines.append(
+                f"{self.name}{{{_render_labels(self.label_names, values)}}}"
+                f" {n}")
+        return "\n".join(lines)
+
+
+class HistogramFamily:
+    """Labeled histograms (grpc_server_handling_seconds shape)."""
+
+    def __init__(self, name: str, help_: str, label_names: tuple,
+                 buckets: tuple = DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self.buckets = buckets
+        self._series: dict[tuple, Histogram] = {}
+        self._lock = threading.Lock()
+
+    def child(self, values: tuple) -> Histogram:
+        with self._lock:
+            h = self._series.get(values)
+            if h is None:
+                h = Histogram(self.name, self.help, self.buckets,
+                              labels=_render_labels(self.label_names,
+                                                    values))
+                self._series[values] = h
+            return h
+
+    def observe(self, values: tuple, seconds: float):
+        self.child(values).observe(seconds)
+
+    def prometheus_text(self) -> str:
+        with self._lock:
+            items = sorted(self._series.items())
+        lines = [f"# TYPE {self.name} histogram"]
+        for _values, h in items:
+            lines.append(h.prometheus_text(type_line=False))
         return "\n".join(lines)
 
 
 _registry: dict[str, Histogram] = {}
+_families: dict[str, object] = {}
 _registry_lock = threading.Lock()
 
 
@@ -64,6 +137,31 @@ def histogram(name: str, help_: str = "") -> Histogram:
         return h
 
 
+def counter_family(name: str, help_: str = "",
+                   label_names: tuple = ()) -> CounterFamily:
+    with _registry_lock:
+        f = _families.get(name)
+        if f is None:
+            f = CounterFamily(name, help_, label_names)
+            _families[name] = f
+        return f
+
+
+def histogram_family(name: str, help_: str = "",
+                     label_names: tuple = ()) -> HistogramFamily:
+    with _registry_lock:
+        f = _families.get(name)
+        if f is None:
+            f = HistogramFamily(name, help_, label_names)
+            _families[name] = f
+        return f
+
+
 def all_histograms() -> list[Histogram]:
     with _registry_lock:
         return list(_registry.values())
+
+
+def all_families() -> list:
+    with _registry_lock:
+        return list(_families.values())

@@ -190,3 +190,37 @@ def test_connection_loss_fails_pending(cluster_ca, server):
         ch.get(timeout=2)
     with pytest.raises(ConnectionClosed):
         c.call("test.echo", 1)
+
+
+def test_per_rpc_metrics_series(cluster_ca, server):
+    """Every RPC leaves started/handled counters and a latency histogram
+    series per method (rpc/server.py RPC_* families — the reference's
+    grpc_prometheus.Register surface, manager/manager.go:551,562), and
+    the /metrics exposition carries them."""
+    from swarmkit_tpu.rpc.server import RPC_HANDLED, RPC_LATENCY, RPC_STARTED
+
+    c = worker_client(cluster_ca, server)
+    try:
+        started0 = RPC_STARTED.value(("test.echo",))
+        ok0 = RPC_HANDLED.value(("test.echo", "OK"))
+        err0 = RPC_HANDLED.value(("test.boom", "KeyError"))
+        c.call("test.echo", 1)
+        c.call("test.echo", 2)
+        with pytest.raises(Exception):
+            c.call("test.boom")
+        assert RPC_STARTED.value(("test.echo",)) == started0 + 2
+        assert RPC_HANDLED.value(("test.echo", "OK")) == ok0 + 2
+        assert RPC_HANDLED.value(("test.boom", "KeyError")) == err0 + 1
+        h = RPC_LATENCY.child(("test.echo",))
+        assert h.snapshot()[2] >= 2          # observations recorded
+        text = "\n".join(
+            f.prometheus_text()
+            for f in __import__("swarmkit_tpu.utils.metrics",
+                                fromlist=["all_families"]).all_families())
+        assert 'swarm_rpc_server_handled_total{method="test.echo",code="OK"}' \
+            in text.replace("method=\"test.echo\",code=\"OK\"",
+                            'method="test.echo",code="OK"')
+        assert 'swarm_rpc_server_handling_seconds_bucket' in text
+        assert 'method="test.echo"' in text
+    finally:
+        c.close()
