@@ -349,6 +349,325 @@ done:
 }
 
 /* ------------------------------------------------------------------ */
+/* apply_wave: the whole wave commit in ONE native pass.
+ *
+ * apply_segments (above) still pays three numpy/Python stages before it
+ * runs: concatenating per-group task/id lists (~100k list appends per
+ * wave), a stable argsort to node-major order, and fancy-gathers of the
+ * sorted companions — together roughly half the commit at the north-star
+ * shape.  This entry replaces all of it: it takes the per-group lists
+ * as-is plus each group's node-index vector, counting-sorts (node-major,
+ * group-stable — identical order to np.argsort(..., kind="stable") on
+ * the concatenation) in O(T + N), accumulates the per-node resource
+ * aggregates in the same pass, and then walks segments with the same
+ * fused SetDefault discipline and fallback semantics as apply_segments.
+ *
+ * groups: list of (tasks_list, ids_list, nodes_int64_buffer,
+ *                  mem_per_task, cpu_per_task, service_id_obj)
+ * Only "plain" groups belong here (no generic reservations / host ports
+ * — the Python caller keeps those on the per-task path).
+ */
+static PyObject *
+apply_wave_native(PyObject *self, PyObject *args)
+{
+    PyObject *infos, *groups, *fallback;
+    Py_ssize_t n_infos, n_groups, g, T = 0;
+    long long n_added = 0;
+    PyObject *ret = NULL;
+    /* per-group parsed views */
+    PyObject **g_tasks = NULL, **g_ids = NULL, **g_svc = NULL;
+    Py_buffer *g_bufs = NULL;
+    const int64_t **g_nodes = NULL;
+    Py_ssize_t *g_len = NULL;
+    int64_t *g_mem = NULL, *g_cpu = NULL;
+    int n_bufs = 0;
+    /* wave-sized scratch */
+    int64_t *cnt = NULL, *off = NULL, *mem_acc = NULL, *cpu_acc = NULL;
+    int32_t *slot_g = NULL, *slot_m = NULL;
+    PyObject **fb_tasks = NULL;
+
+    if (!PyArg_ParseTuple(args, "O!O!O", &PyList_Type, &infos,
+                          &PyList_Type, &groups, &fallback))
+        return NULL;
+    n_infos = PyList_GET_SIZE(infos);
+    n_groups = PyList_GET_SIZE(groups);
+
+    g_tasks = PyMem_Calloc((size_t)(n_groups ? n_groups : 1),
+                           sizeof(PyObject *));
+    g_ids = PyMem_Calloc((size_t)(n_groups ? n_groups : 1),
+                         sizeof(PyObject *));
+    g_svc = PyMem_Calloc((size_t)(n_groups ? n_groups : 1),
+                         sizeof(PyObject *));
+    g_bufs = PyMem_Calloc((size_t)(n_groups ? n_groups : 1),
+                          sizeof(Py_buffer));
+    g_nodes = PyMem_Calloc((size_t)(n_groups ? n_groups : 1),
+                           sizeof(int64_t *));
+    g_len = PyMem_Calloc((size_t)(n_groups ? n_groups : 1),
+                         sizeof(Py_ssize_t));
+    g_mem = PyMem_Calloc((size_t)(n_groups ? n_groups : 1), sizeof(int64_t));
+    g_cpu = PyMem_Calloc((size_t)(n_groups ? n_groups : 1), sizeof(int64_t));
+    if (!g_tasks || !g_ids || !g_svc || !g_bufs || !g_nodes || !g_len
+        || !g_mem || !g_cpu) {
+        PyErr_NoMemory();
+        goto done;
+    }
+
+    for (g = 0; g < n_groups; g++) {
+        PyObject *e = PyList_GET_ITEM(groups, g);
+        PyObject *nodes_obj;
+        long long mv, cv;
+
+        if (!PyTuple_Check(e) || PyTuple_GET_SIZE(e) != 6) {
+            PyErr_SetString(PyExc_TypeError,
+                            "apply_wave: group entry must be a 6-tuple");
+            goto done;
+        }
+        g_tasks[g] = PyTuple_GET_ITEM(e, 0);
+        g_ids[g] = PyTuple_GET_ITEM(e, 1);
+        nodes_obj = PyTuple_GET_ITEM(e, 2);
+        g_svc[g] = PyTuple_GET_ITEM(e, 5);
+        if (!PyList_Check(g_tasks[g]) || !PyList_Check(g_ids[g])) {
+            PyErr_SetString(PyExc_TypeError,
+                            "apply_wave: tasks/ids must be lists");
+            goto done;
+        }
+        mv = PyLong_AsLongLong(PyTuple_GET_ITEM(e, 3));
+        cv = PyLong_AsLongLong(PyTuple_GET_ITEM(e, 4));
+        if ((mv == -1 || cv == -1) && PyErr_Occurred())
+            goto done;
+        g_mem[g] = (int64_t)mv;
+        g_cpu[g] = (int64_t)cv;
+        if (PyObject_GetBuffer(nodes_obj, &g_bufs[g],
+                               PyBUF_SIMPLE) < 0)
+            goto done;
+        n_bufs = (int)(g + 1);
+        g_nodes[g] = (const int64_t *)g_bufs[g].buf;
+        g_len[g] = g_bufs[g].len / (Py_ssize_t)sizeof(int64_t);
+        if (g_len[g] != PyList_GET_SIZE(g_tasks[g])
+            || g_len[g] != PyList_GET_SIZE(g_ids[g])) {
+            PyErr_SetString(PyExc_ValueError,
+                            "apply_wave: tasks/ids/nodes length mismatch");
+            goto done;
+        }
+        T += g_len[g];
+    }
+
+    cnt = PyMem_Calloc((size_t)(n_infos ? n_infos : 1), sizeof(int64_t));
+    off = PyMem_Malloc((size_t)(n_infos ? n_infos : 1) * sizeof(int64_t));
+    mem_acc = PyMem_Calloc((size_t)(n_infos ? n_infos : 1),
+                           sizeof(int64_t));
+    cpu_acc = PyMem_Calloc((size_t)(n_infos ? n_infos : 1),
+                           sizeof(int64_t));
+    slot_g = PyMem_Malloc((size_t)(T ? T : 1) * sizeof(int32_t));
+    slot_m = PyMem_Malloc((size_t)(T ? T : 1) * sizeof(int32_t));
+    fb_tasks = PyMem_Malloc((size_t)(T ? T : 1) * sizeof(PyObject *));
+    if (!cnt || !off || !mem_acc || !cpu_acc || !slot_g || !slot_m
+        || !fb_tasks) {
+        PyErr_NoMemory();
+        goto done;
+    }
+
+    /* pass 1: histogram + per-node resource aggregates */
+    for (g = 0; g < n_groups; g++) {
+        const int64_t *nv = g_nodes[g];
+        Py_ssize_t m, len = g_len[g];
+        int64_t gm = g_mem[g], gc = g_cpu[g];
+
+        for (m = 0; m < len; m++) {
+            int64_t node = nv[m];
+
+            if (node < 0 || node >= (int64_t)n_infos) {
+                PyErr_SetString(PyExc_IndexError,
+                                "apply_wave: node index out of range");
+                goto done;
+            }
+            cnt[node]++;
+            mem_acc[node] += gm;
+            cpu_acc[node] += gc;
+        }
+    }
+    /* exclusive prefix: off[n] = start of node n's segment */
+    {
+        int64_t acc = 0;
+        Py_ssize_t n;
+
+        for (n = 0; n < n_infos; n++) {
+            off[n] = acc;
+            acc += cnt[n];
+        }
+    }
+    /* pass 2: stable scatter into node-major slots (group order is the
+     * concatenation order, so equal nodes keep group-stable order —
+     * exactly np.argsort(kind="stable") on the concatenated vector) */
+    for (g = 0; g < n_groups; g++) {
+        const int64_t *nv = g_nodes[g];
+        Py_ssize_t m, len = g_len[g];
+
+        for (m = 0; m < len; m++) {
+            int64_t s = off[nv[m]]++;
+
+            slot_g[s] = (int32_t)g;
+            slot_m[s] = (int32_t)m;
+        }
+    }
+    /* off[n] is now the segment END for node n; start = off[n] - cnt[n] */
+
+    /* pass 3: per-node segment walk (same semantics as apply_segments) */
+    {
+        Py_ssize_t node;
+
+        for (node = 0; node < n_infos; node++) {
+            int64_t k64 = cnt[node];
+            Py_ssize_t a = (Py_ssize_t)(off[node] - k64), k = (Py_ssize_t)k64;
+            Py_ssize_t m, run;
+            PyObject *info, *tdict, *counter;
+            int err = 0;
+
+            if (k == 0)
+                continue;
+            info = PyList_GET_ITEM(infos, node);        /* borrowed */
+            if (info == Py_None)
+                continue;
+            tdict = PyObject_GetAttr(info, s_tasks);
+            if (tdict == NULL)
+                goto done;
+            if (!PyDict_Check(tdict)) {
+                Py_DECREF(tdict);
+                PyErr_SetString(PyExc_TypeError,
+                                "apply_wave: NodeInfo.tasks is not a dict");
+                goto done;
+            }
+            {
+                Py_ssize_t inserted = 0;
+                int bad = 0;
+
+                for (m = 0; m < k; m++) {
+                    PyObject *task, *tid, *existing;
+                    Py_ssize_t sz;
+                    int32_t gg = slot_g[a + m], mm = slot_m[a + m];
+
+#if defined(__GNUC__) || defined(__clang__)
+                    if (m + 8 < k)
+                        __builtin_prefetch(
+                            PyList_GET_ITEM(g_ids[slot_g[a + m + 8]],
+                                            slot_m[a + m + 8]), 0, 1);
+#endif
+                    task = PyList_GET_ITEM(g_tasks[gg], mm); /* borrowed */
+                    tid = PyList_GET_ITEM(g_ids[gg], mm);    /* borrowed */
+                    sz = PyDict_GET_SIZE(tdict);
+                    existing = PyDict_SetDefault(tdict, tid, task);
+                    if (existing == NULL) {
+                        err = 1;
+                        break;
+                    }
+                    if (existing != task || PyDict_GET_SIZE(tdict) == sz) {
+                        bad = 1;
+                        break;
+                    }
+                    inserted = m + 1;
+                }
+                if (err) {
+                    Py_DECREF(tdict);
+                    goto done;
+                }
+                if (bad) {
+                    long long added;
+
+                    for (m = 0; m < inserted; m++) {
+                        if (PyDict_DelItem(
+                                tdict,
+                                PyList_GET_ITEM(g_ids[slot_g[a + m]],
+                                                slot_m[a + m])) < 0) {
+                            Py_DECREF(tdict);
+                            goto done;
+                        }
+                    }
+                    Py_DECREF(tdict);
+                    for (m = 0; m < k; m++)
+                        fb_tasks[m] = PyList_GET_ITEM(
+                            g_tasks[slot_g[a + m]], slot_m[a + m]);
+                    added = fallback_segment(fallback, info, fb_tasks, k);
+                    if (added < 0)
+                        goto done;
+                    n_added += added;
+                    continue;
+                }
+            }
+
+            counter = PyObject_GetAttr(info, s_svccnt);
+            if (counter == NULL) {
+                Py_DECREF(tdict);
+                goto done;
+            }
+            if (!PyDict_Check(counter)) {
+                PyErr_SetString(
+                    PyExc_TypeError,
+                    "apply_wave: by-service counts not a dict");
+                err = 1;
+            }
+            run = 0;
+            for (m = 0; !err && m <= k; m++) {
+                if (m == k || slot_g[a + m] != slot_g[a + run]) {
+                    if (bump_counter(counter,
+                                     g_svc[slot_g[a + run]],
+                                     (long long)(m - run)) < 0) {
+                        err = 1;
+                        break;
+                    }
+                    run = m;
+                }
+            }
+            Py_DECREF(tdict);
+            Py_DECREF(counter);
+            if (err)
+                goto done;
+
+            if (add_int_attr(info, s_mutations, (long long)k) < 0
+                || add_int_attr(info, s_active, (long long)k) < 0)
+                goto done;
+            {
+                PyObject *ar = PyObject_GetAttr(info, s_avail);
+
+                if (ar == NULL)
+                    goto done;
+                if (add_int_attr(ar, s_mem, -mem_acc[node]) < 0
+                    || add_int_attr(ar, s_cpus, -cpu_acc[node]) < 0) {
+                    Py_DECREF(ar);
+                    goto done;
+                }
+                Py_DECREF(ar);
+            }
+            n_added += (long long)k;
+        }
+    }
+    ret = PyLong_FromLongLong(n_added);
+
+done:
+    if (fb_tasks) PyMem_Free(fb_tasks);
+    if (slot_m) PyMem_Free(slot_m);
+    if (slot_g) PyMem_Free(slot_g);
+    if (cpu_acc) PyMem_Free(cpu_acc);
+    if (mem_acc) PyMem_Free(mem_acc);
+    if (off) PyMem_Free(off);
+    if (cnt) PyMem_Free(cnt);
+    {
+        int i;
+
+        for (i = 0; i < n_bufs; i++)
+            PyBuffer_Release(&g_bufs[i]);
+    }
+    if (g_cpu) PyMem_Free(g_cpu);
+    if (g_mem) PyMem_Free(g_mem);
+    if (g_len) PyMem_Free(g_len);
+    if (g_nodes) PyMem_Free(g_nodes);
+    if (g_bufs) PyMem_Free(g_bufs);
+    if (g_svc) PyMem_Free(g_svc);
+    if (g_ids) PyMem_Free(g_ids);
+    if (g_tasks) PyMem_Free(g_tasks);
+    return ret;
+}
+
+/* ------------------------------------------------------------------ */
 /* tree_copy: fast deep copy for the store's closed object universe.
  *
  * StoreObject.copy() was copy.deepcopy — ~20-40 us per Task (memo dict,
@@ -533,6 +852,10 @@ static PyMethodDef methods[] = {
     {"apply_segments", apply_segments, METH_VARARGS,
      "apply_segments(infos, tasks_all, oi, nodes_srt, seg_bounds, "
      "mem_by_node, cpu_by_node, gidx_srt, svc_of, fallback) -> added"},
+    {"apply_wave", apply_wave_native, METH_VARARGS,
+     "apply_wave(infos, groups, fallback) -> added; groups = list of "
+     "(tasks, ids, nodes_int64, mem_per_task, cpu_per_task, service_id) "
+     "— counting-sorts node-major in C and walks segments in one pass"},
     {"tree_copy", tree_copy, METH_VARARGS,
      "tree_copy(obj, fallback) -> deep copy of a tree-shaped object "
      "built from immutables/lists/dicts/sets/tuples/plain dataclasses; "

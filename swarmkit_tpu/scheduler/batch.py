@@ -127,22 +127,53 @@ def materialize_orders(p: EncodedProblem, counts: np.ndarray) -> list:
     node_arange = np.arange(N)
     totals = p.total0.astype(np.int64).copy()
     svc_counts = p.svc_count0.astype(np.int64).copy()
-    orders: list[np.ndarray] = []
-    for gi in range(len(p.groups)):
+    G = len(p.groups)
+    # one GLOBAL lexsort with the group id as the outermost key instead
+    # of one lexsort per group: the slot tuples are computed per group
+    # (they depend on the running totals), but the sort itself batches —
+    # ~20 radix passes collapse into 4, and the keys fit int32 at every
+    # realistic scale (checked; falls back to int64 when they don't)
+    idx_parts: list[np.ndarray] = []
+    key_parts: list[np.ndarray] = []
+    tot_parts: list[np.ndarray] = []
+    placed_per: list[int] = []
+    for gi in range(G):
         c = counts[gi].astype(np.int64)
         placed = int(c.sum())
+        placed_per.append(placed)
         if placed:
             svc = svc_counts[p.svc_idx[gi]]
             base_k = np.where(p.penalty[gi], PENALTY_BASE, 0) + svc
             idx = np.repeat(node_arange, c)                       # [placed]
             j = np.arange(placed) - np.repeat(np.cumsum(c) - c, c)
-            key = base_k[idx] + j
-            tot = totals[idx] + j
-            orders.append(idx[np.lexsort((idx, tot, key))])
+            idx_parts.append(idx)
+            key_parts.append(base_k[idx] + j)
+            tot_parts.append(totals[idx] + j)
             totals += c
             svc_counts[p.svc_idx[gi]] += c
-        else:
-            orders.append(node_arange[:0])
+    if not idx_parts:
+        return [node_arange[:0]] * G
+    idx_all = np.concatenate(idx_parts)
+    key_all = np.concatenate(key_parts)
+    tot_all = np.concatenate(tot_parts)
+    gid_all = np.repeat(np.arange(G, dtype=np.int32),
+                        np.asarray(placed_per, np.int64))
+    if (key_all.max() < (1 << 31) and tot_all.max() < (1 << 31)
+            and N < (1 << 31)):
+        idx_all32 = idx_all.astype(np.int32)
+        order = np.lexsort((idx_all32, tot_all.astype(np.int32),
+                            key_all.astype(np.int32), gid_all))
+    else:
+        order = np.lexsort((idx_all, tot_all, key_all, gid_all))
+    sorted_idx = idx_all[order]
+    # gid values ascend with group index and the sort is stable, so the
+    # sorted vector is the per-group orders laid end to end
+    orders = []
+    pos = 0
+    for placed in placed_per:
+        orders.append(sorted_idx[pos:pos + placed] if placed
+                      else node_arange[:0])
+        pos += placed
     return orders
 
 
@@ -224,6 +255,24 @@ def apply_placements(infos: list, placed_groups: list) -> int:
             plain.append((t0, tasks, nidx, ids))
     if not plain:
         return n_added
+
+    if _hostops is not None and hasattr(_hostops, "apply_wave"):
+        # whole-wave native path: per-group lists go straight in; the C
+        # side counting-sorts node-major (group-stable — identical order
+        # to the argsort concatenation below) and walks segments in one
+        # pass, so the wave never builds concatenated Python lists or
+        # pays an O(T log T) sort (the two stages that bounded the
+        # commit at the north-star shape alongside the walk itself)
+        entries = []
+        for t0, tasks, nidx, ids in plain:
+            res = task_reservations(t0.spec)
+            entries.append((
+                tasks if isinstance(tasks, list) else list(tasks),
+                ids if isinstance(ids, list) else list(ids),
+                np.ascontiguousarray(nidx, np.int64),
+                int(res.memory_bytes or 0), int(res.nano_cpus or 0),
+                t0.service_id))
+        return n_added + _hostops.apply_wave(infos, entries, _add_serial)
 
     # exact int64 per-node aggregates, one vector op per group
     N = len(infos)
