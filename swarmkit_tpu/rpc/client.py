@@ -123,7 +123,14 @@ class RPCClient:
     def call(self, method: str, *args,
              timeout: float = DEFAULT_CALL_TIMEOUT, **kwargs):
         if self._closed.is_set():
-            raise ConnectionClosed(f"connection to {self.addr} is closed")
+            # the request was never sent: callers may retry it on a fresh
+            # connection even for writes (nothing reached the server) —
+            # the post-rotation window where a server reloading its TLS
+            # trust kills a just-opened connection surfaces exactly here
+            exc = ConnectionClosed(
+                f"connection to {self.addr} is closed")
+            exc.unsent = True
+            raise exc
         pending = _PendingCall()
         stream_id = self._register(calls=pending)
         try:
@@ -132,7 +139,11 @@ class RPCClient:
         except OSError as exc:
             self._unregister(stream_id)
             self._fail_all(ConnectionClosed(str(exc)))
-            raise ConnectionClosed(str(exc)) from exc
+            # a partial frame is unparseable — the server cannot have
+            # executed this request; safe to retry on a new connection
+            closed = ConnectionClosed(str(exc))
+            closed.unsent = True
+            raise closed from exc
         if not pending.event.wait(timeout):
             self._unregister(stream_id)
             raise TimeoutError(f"{method} timed out after {timeout}s")

@@ -648,11 +648,24 @@ class RemoteControl:
 
         if isinstance(exc, RPCError) and exc.name == "NotLeaderError":
             return True
+        from .wire import ConnectionClosed
+
+        if isinstance(exc, ConnectionClosed) \
+                and getattr(exc, "unsent", False):
+            # the request never reached the server as a complete frame
+            # (connection died between _conn()'s aliveness check and the
+            # send — e.g. a server reloading its TLS trust right after a
+            # root-rotation finish kills just-opened connections): safe
+            # to retry on a fresh connection even for writes
+            return True
         # mid-rotation credential swap: for a moment the server's listener
         # cert and this client's trust bundle come from different epochs.
         # The reference rides this out via gRPC's transparent reconnect
         # backoff; a wrong identity still fails — just after the window.
-        return isinstance(exc, _ssl.SSLCertVerificationError)
+        # A handshake EOF (server dropped the connection before the
+        # session established) can't have executed anything either.
+        return isinstance(exc, (_ssl.SSLCertVerificationError,
+                                _ssl.SSLEOFError))
 
     def __getattr__(self, name):
         if name.startswith("_"):
