@@ -383,7 +383,9 @@ def bench_global_diff(np):
     from swarmkit_tpu.ops.reconcile import (
         global_diff_churn_burst,
         global_diff_np,
+        pack_eligibility,
         task_count_flat,
+        unpack_eligibility,
     )
 
     rng = np.random.default_rng(0)
@@ -396,11 +398,28 @@ def bench_global_diff(np):
         k = min(T, elig_nodes.size)
         task_nodes[si, :k] = elig_nodes[:k]
 
+    # warm the unpack/count programs on same-shape throwaways: a daemon
+    # compiles once at startup, not per cold contact, and cold_h2d_s is
+    # defined as the state-resident cost (compile is its own metric in
+    # the scheduler rows)
+    import jax.numpy as jnp
+    probe = jax.jit(lambda e, c: e[0, 0].astype(jnp.int32) + c[0])
+    warm = unpack_eligibility(
+        jax.device_put(np.zeros((S, (N + 7) // 8), np.uint8)), N)
+    warm2 = task_count_flat(jax.device_put(np.zeros((S, T), np.int32)), N)
+    int(np.asarray(probe(warm, warm2)))
+
+    # cold contact: the [S, N] bool eligibility ships BIT-PACKED (8x
+    # fewer wire bytes through the single-digit-MB/s tunnel — the same
+    # move as the resident svc-matrix fix) and unpacks device-side; the
+    # sync is a true value pull (block_until_ready lies through the
+    # tunnel)
     t0 = time.perf_counter()
-    elig_dev = jax.device_put(eligible)
+    packed_dev = jax.device_put(pack_eligibility(eligible))
     tn_dev = jax.device_put(task_nodes)
+    elig_dev = unpack_eligibility(packed_dev, N)
     cnt_dev = task_count_flat(tn_dev, N)
-    jax.block_until_ready((elig_dev, tn_dev, cnt_dev))
+    int(np.asarray(probe(elig_dev, cnt_dev)))   # syncs BOTH upload chains
     h2d_s = time.perf_counter() - t0
 
     U = S * T // 100                       # 1% churn per round
@@ -476,7 +495,12 @@ def bench_raft_replay(np):
     batch-wise, exactly like the reference's Ready/Advance batching
     (etcd raft releases appliers once per Ready, not per ack)."""
     import jax
-    from swarmkit_tpu.ops.raft_replay import frontier_advance, replay_commit
+    import jax.numpy as jnp
+    from swarmkit_tpu.ops.raft_replay import (
+        frontier_advance_burst,
+        replay_commit,
+        unpack_acks,
+    )
 
     rng = np.random.default_rng(1)
     M, E = 5, 1_000_000
@@ -486,50 +510,94 @@ def bench_raft_replay(np):
         acks[m, :frontier[m]] = True
     quorum = M // 2 + 1
 
+    # warm the unpack/tally programs (compile is paid once per daemon,
+    # not per cold contact; the scheduler rows report compile separately)
+    warm = unpack_acks(
+        jax.device_put(np.zeros((M, (E + 7) // 8), np.uint8)), E)
+    probe = jax.jit(lambda a: a[0, 0].astype(jnp.int32))
+    int(np.asarray(probe(warm)))
+
+    # cold contact: the [M, E] bool ack matrix ships BIT-PACKED (8x fewer
+    # wire bytes) and unpacks device-side; true value-pull sync
+    # (block_until_ready lies through the tunnel)
     t0 = time.perf_counter()
-    acks_dev = jax.device_put(acks)
-    acks_dev.block_until_ready()
+    packed = np.packbits(acks, axis=1, bitorder="little")
+    acks_dev = unpack_acks(jax.device_put(packed), E)
+    int(np.asarray(probe(acks_dev)))
     h2d_s = time.perf_counter() - t0
 
     commit, _ = replay_commit(acks_dev, quorum)               # compile
-    commit.block_until_ready()
-    acks_dev, commit = frontier_advance(acks_dev, jax.device_put(frontier),
-                                        quorum)               # compile
-    int(commit)
+    int(np.asarray(commit))
 
-    BURST = 16
+    BURST, N_BURSTS, DEPTH = 16, 4, 2
     f = frontier
-    steps = []
-    for _ in range(BURST):
-        f = np.minimum(f + rng.integers(0, 1000, M), E - 1).astype(np.int32)
-        steps.append(f)
+    bursts = []
+    for _ in range(N_BURSTS):
+        rounds = []
+        for _ in range(BURST):
+            f = np.minimum(f + rng.integers(0, 1000, M),
+                           E - 1).astype(np.int32)
+            rounds.append(f)
+        bursts.append(np.stack(rounds))                       # [B, M]
+    # compile on a throwaway output — reassigning acks_dev here would
+    # bake burst 0 into the timing loop's start state and skew the
+    # per-round commit parity below
+    _warm_acks, _warm_commits = frontier_advance_burst(
+        acks_dev, bursts[0], quorum)
+    int(np.asarray(_warm_commits[-1]))
+    del _warm_acks, _warm_commits
+
+    # steady state, Ready/Advance-shaped: each burst is ONE [B, M] upload
+    # + ONE scan dispatch + ONE per-round commit-index pull, and the pull
+    # rides the link under the next DEPTH bursts' dispatches (the applier
+    # consumes commit indices a couple of batches behind the appender,
+    # exactly like the scheduler pipeline hides its counts D2H)
+    from collections import deque
+    all_commits = None
     burst_s = None
     for _ in range(6):
+        a_dev = acks_dev
+        pending: deque = deque()
+        got = []
         t0 = time.perf_counter()
-        for fr in steps:
-            acks_dev, commit = frontier_advance(
-                acks_dev, jax.device_put(fr), quorum)
-        final_commit = int(commit)    # one applier release per burst
+        for fb in bursts:
+            a_dev, commits = frontier_advance_burst(a_dev, fb, quorum)
+            try:
+                commits.copy_to_host_async()
+            except Exception:
+                pass
+            pending.append(commits)
+            if len(pending) > DEPTH:
+                got.append(np.asarray(pending.popleft()))
+        while pending:
+            got.append(np.asarray(pending.popleft()))
         dt = time.perf_counter() - t0
-        burst_s = dt if burst_s is None or dt < burst_s else burst_s
-    round_s = burst_s / BURST
+        if burst_s is None or dt < burst_s:
+            burst_s = dt
+            all_commits = np.concatenate(got)
+    round_s = burst_s / (BURST * N_BURSTS)
 
     # CPU: same advances on the ack-matrix representation, tally per round
     # (its commit must be current after each round too)
     acks_np = acks.copy()
+    cpu_commits = []
     t0 = time.perf_counter()
-    for fr in steps:
-        for m in range(M):
-            acks_np[m, :fr[m]] = True
-        tally = acks_np.sum(axis=0)
-        comm = tally >= quorum
-        cpu_commit = int(np.cumprod(comm).sum())
-    cpu_s = (time.perf_counter() - t0) / BURST
+    for fb in bursts:
+        for fr in fb:
+            for m in range(M):
+                acks_np[m, :fr[m]] = True
+            tally = acks_np.sum(axis=0)
+            comm = tally >= quorum
+            cpu_commits.append(int(np.cumprod(comm).sum()))
+    cpu_s = (time.perf_counter() - t0) / (BURST * N_BURSTS)
 
-    expected = int(np.sort(steps[-1])[M - quorum])
-    ok = final_commit == cpu_commit == expected
+    final_commit = int(all_commits[-1])
+    expected = int(np.sort(bursts[-1][-1])[M - quorum])
+    # parity: EVERY round's commit index, not just the last
+    ok = (all_commits.tolist() == cpu_commits
+          and final_commit == expected)
     return {"entries": E, "managers": M, "commit_index": final_commit,
-            "burst": BURST,
+            "burst": BURST, "bursts_in_flight": DEPTH,
             "tpu_round_s": round(round_s, 6), "cold_h2d_s": round(h2d_s, 4),
             "cpu_s": round(cpu_s, 5),
             "speedup_with_upload": round(cpu_s / round_s, 3),

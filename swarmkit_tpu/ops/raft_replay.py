@@ -75,6 +75,41 @@ def frontier_advance(acks, frontier, quorum):
     return acks, jnp.sum(prefix).astype(jnp.int32)
 
 
+@functools.partial(jax.jit, static_argnames=("n_entries",))
+def unpack_acks(packed, n_entries: int):
+    """Bit-packed ack upload → device bool matrix. The [M, E] bool matrix
+    ships 8× smaller as uint8 words (np.packbits axis=1, bitorder='little')
+    and unpacks device-side — through a single-digit-MB/s tunnel the wire
+    bytes are the whole cold cost (round-4 verdict #6). packed:
+    uint8[M, ceil(E/8)]."""
+    idx = jnp.arange(n_entries, dtype=jnp.int32)
+    words = packed[:, idx // 8]                       # [M, E] uint8
+    return ((words >> (idx % 8).astype(jnp.uint8)) & 1).astype(bool)
+
+
+@jax.jit
+def frontier_advance_burst(acks, frontiers_b, quorum):
+    """A burst of frontier advances in ONE device program: rounds scan over
+    frontiers_b int32[B, M], each OR-ing its round's durable frontiers into
+    the resident ack matrix and recomputing the commit frontier. One
+    upload + one dispatch + one (async-able) pull of the per-round commit
+    indices per burst — the Ready/Advance batching shape, with strictly
+    MORE information returned than the single end-of-burst commit (the
+    applier sees every round's commit index).
+    Returns (acks', commits int32[B])."""
+    M, E = acks.shape
+    entry = jnp.arange(E, dtype=jnp.int32)[None, :]
+
+    def step(a, fr):
+        a = a | (entry < fr[:, None])
+        tally = jnp.sum(a.astype(jnp.int32), axis=0)
+        prefix = jnp.cumprod((tally >= quorum).astype(jnp.int32))
+        return a, jnp.sum(prefix).astype(jnp.int32)
+
+    acks, commits = lax.scan(step, acks, frontiers_b)
+    return acks, commits
+
+
 @jax.jit
 def match_index_commit(match_index, quorum):
     """Commit index from per-manager match indices (the leader-side rule:
