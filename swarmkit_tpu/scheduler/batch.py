@@ -127,53 +127,27 @@ def materialize_orders(p: EncodedProblem, counts: np.ndarray) -> list:
     node_arange = np.arange(N)
     totals = p.total0.astype(np.int64).copy()
     svc_counts = p.svc_count0.astype(np.int64).copy()
-    G = len(p.groups)
-    # one GLOBAL lexsort with the group id as the outermost key instead
-    # of one lexsort per group: the slot tuples are computed per group
-    # (they depend on the running totals), but the sort itself batches —
-    # ~20 radix passes collapse into 4, and the keys fit int32 at every
-    # realistic scale (checked; falls back to int64 when they don't)
-    idx_parts: list[np.ndarray] = []
-    key_parts: list[np.ndarray] = []
-    tot_parts: list[np.ndarray] = []
-    placed_per: list[int] = []
-    for gi in range(G):
+    orders: list[np.ndarray] = []
+    for gi in range(len(p.groups)):
         c = counts[gi].astype(np.int64)
         placed = int(c.sum())
-        placed_per.append(placed)
         if placed:
             svc = svc_counts[p.svc_idx[gi]]
             base_k = np.where(p.penalty[gi], PENALTY_BASE, 0) + svc
             idx = np.repeat(node_arange, c)                       # [placed]
             j = np.arange(placed) - np.repeat(np.cumsum(c) - c, c)
-            idx_parts.append(idx)
-            key_parts.append(base_k[idx] + j)
-            tot_parts.append(totals[idx] + j)
+            key = base_k[idx] + j
+            tot = totals[idx] + j
+            # per-group 3-key lexsorts measured FASTER than one global
+            # batched sort at every probed shape (5 ms vs 19 ms at
+            # 100k x 10k quiet: the ~5k-row per-group sorts stay cache-
+            # resident; a fused [T]-sized 4-key radix does not) — keep
+            # the simple loop
+            orders.append(idx[np.lexsort((idx, tot, key))])
             totals += c
             svc_counts[p.svc_idx[gi]] += c
-    if not idx_parts:
-        return [node_arange[:0]] * G
-    idx_all = np.concatenate(idx_parts)
-    key_all = np.concatenate(key_parts)
-    tot_all = np.concatenate(tot_parts)
-    gid_all = np.repeat(np.arange(G, dtype=np.int32),
-                        np.asarray(placed_per, np.int64))
-    if (key_all.max() < (1 << 31) and tot_all.max() < (1 << 31)
-            and N < (1 << 31)):
-        idx_all32 = idx_all.astype(np.int32)
-        order = np.lexsort((idx_all32, tot_all.astype(np.int32),
-                            key_all.astype(np.int32), gid_all))
-    else:
-        order = np.lexsort((idx_all, tot_all, key_all, gid_all))
-    sorted_idx = idx_all[order]
-    # gid values ascend with group index and the sort is stable, so the
-    # sorted vector is the per-group orders laid end to end
-    orders = []
-    pos = 0
-    for placed in placed_per:
-        orders.append(sorted_idx[pos:pos + placed] if placed
-                      else node_arange[:0])
-        pos += placed
+        else:
+            orders.append(node_arange[:0])
     return orders
 
 

@@ -57,6 +57,13 @@ MAX_LATENCY = 1.0        # reference: 1s
 # the dev link and far lower on PCIe (BASELINE.md operator guidance).
 JAX_THRESHOLD = 200_000
 PIPELINED_JAX_THRESHOLD = 100_000
+# cold-start policy (backend="auto"): with NO device-resident state yet,
+# a jax tick pays a full upload plus a BLOCKING counts round trip
+# (~0.1 s fixed through a tunneled link) while the CPU fill at small
+# node counts costs less than that RTT — the fill is node-bound, so N
+# is the predictor. First wave goes to the CPU oracle below this node
+# count; the device state warms on the next wave's dispatch instead.
+COLD_CPU_NODES = 8_192
 
 
 class Scheduler:
@@ -105,6 +112,9 @@ class Scheduler:
         # device-resident node tables (ops.resident): created on first jax
         # tick; deltas ride the encoder's dirty-row bookkeeping
         self._resident = None
+        # cold-start policy bookkeeping: True after the one CPU wave a
+        # cold period gets at small N; reset whenever a jax tick runs
+        self._cold_cpu_done = False
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.ticks = 0
@@ -331,12 +341,25 @@ class Scheduler:
         problem = self.encoder.encode(list(self.node_infos.values()), groups,
                                       volume_set=self.volume_set)
         use_jax = self._use_jax(problem)
+        if use_jax and self.backend == "auto" \
+                and len(problem.node_ids) <= COLD_CPU_NODES \
+                and not self._cold_cpu_done \
+                and (self._resident is None
+                     or self._resident.needs_full_upload(problem)):
+            # cold-start policy: no usable device state — the first wave
+            # is cheaper on the CPU oracle than behind a blocking cold
+            # upload + counts RTT; the next wave warms the device (the
+            # one-shot flag stops the CPU tick's own invalidate() from
+            # re-triggering this forever)
+            use_jax = False
+            self._cold_cpu_done = True
         if use_jax:
             if self._resident is None:
                 from ..ops.resident import ResidentPlacement
 
                 self._resident = ResidentPlacement(
                     self.encoder, mesh=self._make_mesh())
+            self._cold_cpu_done = False      # device state is warming
             if self.pipeline:
                 # dispatch only: the counts D2H rides the link through the
                 # debounce window; the next tick completes the wave

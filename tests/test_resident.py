@@ -386,3 +386,62 @@ def test_sparse_counts_pull_parity():
     counts = h.get()
     np.testing.assert_array_equal(counts, batch.cpu_schedule_encoded(p))
     assert counts.shape == (1, 600)
+
+
+def test_auto_cold_start_runs_first_wave_on_cpu():
+    """Scheduler(backend="auto") cold-start policy: with no usable device
+    state and few nodes, the first wave takes the CPU oracle (cheaper
+    than a blocking cold upload + counts RTT); the next wave warms the
+    device. One CPU wave per cold period — the CPU tick's own
+    invalidate() must not re-trigger the policy forever."""
+    from swarmkit_tpu.api.objects import Node, Task
+    from swarmkit_tpu.api.types import (
+        NodeAvailability,
+        NodeStatusState,
+        TaskState,
+    )
+    from swarmkit_tpu.scheduler.scheduler import Scheduler
+    from swarmkit_tpu.store.memory import MemoryStore
+
+    store = MemoryStore()
+
+    def seed(tx):
+        for i in range(5):
+            n = Node(id=f"n{i}")
+            n.status.state = NodeStatusState.READY
+            n.spec.availability = NodeAvailability.ACTIVE
+            tx.create(n)
+
+    store.update(seed)
+    sched = Scheduler(store, backend="auto", jax_threshold=1)
+    ch = sched._setup()
+    try:
+        def add_wave(w, k):
+            def txn(tx):
+                for i in range(k):
+                    t = Task(id=f"w{w}-{i:02d}", service_id="s1",
+                             slot=w * 100 + i)
+                    t.desired_state = TaskState.RUNNING
+                    t.status.state = TaskState.PENDING
+                    tx.create(t)
+                    sched.unassigned[t.id] = t
+            store.update(txn)
+
+        add_wave(0, 6)
+        sched._schedule_backlog()
+        # policy fired: CPU path, no resident created, flag set
+        assert sched._resident is None and sched._cold_cpu_done
+        tasks = store.view(lambda tx: tx.find_tasks())
+        assert all(t.status.state == TaskState.ASSIGNED
+                   for t in tasks if t.id.startswith("w0-"))
+
+        add_wave(1, 6)
+        sched._schedule_backlog()
+        # second wave warmed the device: resident exists and is usable
+        assert sched._resident is not None
+        assert not sched._cold_cpu_done          # reset by the jax tick
+        tasks = store.view(lambda tx: tx.find_tasks())
+        assert all(t.status.state == TaskState.ASSIGNED
+                   for t in tasks if t.id.startswith("w1-"))
+    finally:
+        store.queue.stop_watch(ch)

@@ -291,26 +291,41 @@ def test_backend_and_threshold_knobs():
             t.status.state = TaskState.PENDING
             tx.create(t)
 
-    def run_one(backend, jax_threshold):
+    def run_one(backend, jax_threshold, waves=1):
         st = MemoryStore()
         st.update(seed)
         sched = Scheduler(st, backend=backend, jax_threshold=jax_threshold)
         sched.start()
         try:
-            deadline = time.monotonic() + 60
-            while time.monotonic() < deadline:
+            for w in range(waves):
+                if w:
+                    # a SECOND wave: the auto cold-start policy runs the
+                    # first wave on the CPU oracle and warms the device
+                    # on the next (scheduler.py COLD_CPU_NODES)
+                    def more(tx, w=w):
+                        for i in range(6):
+                            t = Task(id=f"bk2-w{w}-t{i:02d}",
+                                     service_id="bk-svc", slot=100 * w + i)
+                            t.desired_state = TaskState.RUNNING
+                            t.status.state = TaskState.PENDING
+                            tx.create(t)
+                    st.update(more)
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    tasks = st.view(lambda tx: tx.find_tasks())
+                    if all(t.status.state == TaskState.ASSIGNED
+                           and t.node_id for t in tasks):
+                        break
+                    time.sleep(0.05)
                 tasks = st.view(lambda tx: tx.find_tasks())
-                if all(t.status.state == TaskState.ASSIGNED and t.node_id
-                       for t in tasks):
-                    break
-                time.sleep(0.05)
-            tasks = st.view(lambda tx: tx.find_tasks())
-            assert all(t.status.state == TaskState.ASSIGNED for t in tasks)
+                assert all(t.status.state == TaskState.ASSIGNED
+                           for t in tasks)
             return sched._resident
         finally:
             sched.stop()
 
-    # auto + tiny threshold → the accelerator path engages at 6x4
-    assert run_one("auto", 1) is not None
+    # auto + tiny threshold: wave 1 takes the cold-start CPU path, wave 2
+    # engages the accelerator at 6x4
+    assert run_one("auto", 1, waves=2) is not None
     # pinned cpu ignores the threshold entirely
-    assert run_one("cpu", 0) is None
+    assert run_one("cpu", 0, waves=2) is None
