@@ -104,6 +104,8 @@ class Session:
     known_volumes: set[str] = field(default_factory=set)
     session_channel: Channel | None = None
     last_session_msg: SessionMessage | None = None
+    # legacy Dispatcher.Tasks stream (pre-Assignments wire surface)
+    tasks_channel: Channel | None = None
 
 
 class RateLimitExceeded(DispatcherError):
@@ -163,6 +165,8 @@ class Dispatcher:
                 s.channel.close()
                 if s.session_channel is not None:
                     s.session_channel.close()
+                if s.tasks_channel is not None:
+                    s.tasks_channel.close()
             self._sessions.clear()
             timers, self._unknown_timers = self._unknown_timers, {}
             orphans, self._orphan_timers = self._orphan_timers, {}
@@ -332,6 +336,8 @@ class Dispatcher:
                 old.channel.close()
                 if old.session_channel is not None:
                     old.session_channel.close()
+                if old.tasks_channel is not None:
+                    old.tasks_channel.close()
             self._sessions[node_id] = session
             self._dirty_nodes.add(node_id)
             pending = self._unknown_timers.pop(node_id, None)
@@ -359,6 +365,24 @@ class Dispatcher:
             msg = self._full_assignment(session)
             session.channel._offer(msg)
         return session.channel
+
+    def tasks(self, node_id: str, session_id: str) -> Channel:
+        """Dispatcher.Tasks — the LEGACY task stream that predates
+        Assignments (api/dispatcher.proto:40-47; agent/session.go:282-368
+        watches Assignments WITH a Tasks fallback for old managers): the
+        full list of this node's runnable tasks, re-sent whenever the
+        node's assignment set changes. Superseded by `assignments` (which
+        also ships secrets/configs/volumes incrementally); served for
+        wire-surface parity."""
+        session = self._session(node_id, session_id)
+        with self._lock:
+            if session.tasks_channel is None:
+                session.tasks_channel = Channel(matcher=None, limit=256)
+            snapshot = self.store.view(
+                lambda tx: [t.copy()
+                            for t in self._relevant_tasks(tx, node_id)])
+            session.tasks_channel._offer(snapshot)
+        return session.tasks_channel
 
     def session(self, node_id: str, session_id: str) -> Channel:
         """The Session message stream (dispatcher.go:1359+): an immediate
@@ -459,6 +483,8 @@ class Dispatcher:
         session.channel.close()
         if session.session_channel is not None:
             session.session_channel.close()
+        if session.tasks_channel is not None:
+            session.tasks_channel.close()
         with self._lock:
             self._sessions.pop(node_id, None)
         self._node_down(node_id, session_id, graceful=True)
@@ -479,6 +505,8 @@ class Dispatcher:
                 s.channel.close()
                 if s.session_channel is not None:
                     s.session_channel.close()
+                if s.tasks_channel is not None:
+                    s.tasks_channel.close()
                 self._sessions.pop(node_id, None)
             elif not graceful:
                 return  # superseded session
@@ -838,6 +866,11 @@ class Dispatcher:
             msg = self._incremental(session)
             if msg.changes:
                 session.channel._offer(msg)
+            if session.tasks_channel is not None:
+                snapshot = self.store.view(
+                    lambda tx, n=session.node_id: [
+                        t.copy() for t in self._relevant_tasks(tx, n)])
+                session.tasks_channel._offer(snapshot)
 
     def _incremental(self, session: Session) -> AssignmentsMessage:
         tasks, secrets, configs, volumes, unpublish = \

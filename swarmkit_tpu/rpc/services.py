@@ -316,9 +316,16 @@ def build_manager_registry(manager, raft_node=None,
         _require_node(caller, node_id)
         return d.session(node_id, session_id)
 
+    def disp_tasks(caller, node_id, session_id):
+        _require_node(caller, node_id)
+        return d.tasks(node_id, session_id)
+
     reg.add("dispatcher.assignments", disp_assignments, roles=both,
             streaming=True)  # streams cannot hop; agents follow the leader
     reg.add("dispatcher.session", disp_session, roles=both, streaming=True)
+    # legacy Tasks fallback stream (api/dispatcher.proto:40-47) — wire
+    # parity for agents that predate Assignments
+    reg.add("dispatcher.tasks", disp_tasks, roles=both, streaming=True)
     reg.add("dispatcher.update_task_status",
             leader_forward("dispatcher.update_task_status",
                            disp_update_task_status), roles=both)
@@ -496,6 +503,11 @@ class RemoteDispatcher:
 
     def session(self, node_id, session_id):
         return self._conn().stream("dispatcher.session", node_id, session_id)
+
+    def tasks(self, node_id, session_id):
+        """Legacy Dispatcher.Tasks stream (full task lists per change);
+        superseded by assignments() — served for wire parity."""
+        return self._conn().stream("dispatcher.tasks", node_id, session_id)
 
     def update_task_status(self, node_id, session_id, updates):
         return self._conn().call("dispatcher.update_task_status", node_id,

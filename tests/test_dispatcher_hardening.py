@@ -280,3 +280,38 @@ def test_session_message_plane(store):
         assert msg.desired_role == NodeRole.MANAGER
     finally:
         d.stop()
+
+
+def test_legacy_tasks_stream(store):
+    """Dispatcher.Tasks — the pre-Assignments fallback stream
+    (api/dispatcher.proto:40-47; agent/session.go:282-368 uses it on old
+    managers): an immediate full snapshot of the node's runnable tasks,
+    then a fresh full list whenever the assignment set changes."""
+    _mk_node(store, "n1")
+    _mk_task(store, "t1", "n1")
+    d = Dispatcher(store, heartbeat_period=30.0)   # session outlives the test
+    d.start()
+    try:
+        sid = d.register("n1")
+        ch = d.tasks("n1", sid)
+        snap = ch.get(timeout=5)
+        assert [t.id for t in snap] == ["t1"]
+
+        _mk_task(store, "t2", "n1")
+        full = ch.get(timeout=5)
+        # full-list semantics: both tasks, not a diff
+        deadline = time.monotonic() + 5
+        while {t.id for t in full} != {"t1", "t2"} \
+                and time.monotonic() < deadline:
+            full = ch.get(timeout=5)
+        assert {t.id for t in full} == {"t1", "t2"}
+
+        # a task leaving the node disappears from the next full list
+        store.update(lambda tx: tx.delete(Task, "t1"))
+        deadline = time.monotonic() + 5
+        ids = {"t1", "t2"}
+        while ids != {"t2"} and time.monotonic() < deadline:
+            ids = {t.id for t in ch.get(timeout=5)}
+        assert ids == {"t2"}
+    finally:
+        d.stop()
