@@ -456,6 +456,22 @@ class SwarmNode:
                 except TrustPinMismatch:
                     raise  # never retry a trust failure
                 except Exception as exc:
+                    from ..ca.auth import PermissionDenied
+                    from ..ca.config import InvalidToken
+                    from ..rpc.wire import RPCError
+
+                    # the wire layer maps known error names back to their
+                    # real classes, so check both forms
+                    rejected = isinstance(
+                        exc, (InvalidToken, PermissionDenied)) or (
+                        isinstance(exc, RPCError) and exc.name in (
+                            "InvalidToken", "PermissionDenied"))
+                    if rejected:
+                        # the server REJECTED the token/identity — that
+                        # verdict is replicated state, not a transient
+                        # condition; retrying just burns the whole join
+                        # window before surfacing the same answer
+                        raise NodeError(f"join rejected: {exc}") from exc
                     last = exc
             if self._stop.wait(JOIN_RETRY):
                 break

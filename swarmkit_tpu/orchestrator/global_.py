@@ -22,7 +22,7 @@ from ..scheduler import constraint as constraint_mod
 from ..store import by
 from .base import EventLoopComponent
 from .restart import RestartSupervisor
-from .task import is_global, new_task, task_runnable
+from .task import mark_shutdown, is_global, new_task, task_runnable
 
 
 def _constraints_met(node: Node, service: Service) -> bool:
@@ -188,7 +188,7 @@ class GlobalOrchestrator(EventLoopComponent):
                             if cur is not None and \
                                     cur.desired_state < TaskState.SHUTDOWN:
                                 cur = cur.copy()
-                                cur.desired_state = TaskState.SHUTDOWN
+                                mark_shutdown(cur)
                                 tx.update(cur)
                 batch.update(one)
 
@@ -234,7 +234,7 @@ class GlobalOrchestrator(EventLoopComponent):
                         cur = tx.get_task(t.id)
                         if cur is not None and cur.desired_state < TaskState.SHUTDOWN:
                             cur = cur.copy()
-                            cur.desired_state = TaskState.SHUTDOWN
+                            mark_shutdown(cur)
                             tx.update(cur)
 
         self.store.update(cb)
@@ -262,7 +262,7 @@ class GlobalOrchestrator(EventLoopComponent):
                         cur = tx.get_task(t.id)
                         if cur is not None and cur.desired_state < TaskState.SHUTDOWN:
                             cur = cur.copy()
-                            cur.desired_state = TaskState.SHUTDOWN
+                            mark_shutdown(cur)
                             tx.update(cur)
 
         self.store.update(cb)

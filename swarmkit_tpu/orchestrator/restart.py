@@ -16,7 +16,7 @@ from dataclasses import dataclass, field
 from ..api.objects import Service, Task
 from ..api.types import RestartCondition, TaskState
 from ..store.memory import MemoryStore
-from .task import is_job, new_task
+from .task import mark_shutdown, is_job, new_task
 
 
 @dataclass
@@ -54,7 +54,7 @@ class RestartSupervisor:
         cur = tx.get_task(task.id)
         if cur is not None and cur.desired_state < TaskState.SHUTDOWN:
             cur = cur.copy()
-            cur.desired_state = TaskState.SHUTDOWN
+            mark_shutdown(cur)
             tx.update(cur)
 
         if not self.should_restart(task, service):

@@ -77,3 +77,20 @@ def slots_by_service(tasks: list[Task]) -> dict[str, dict[int, list[Task]]]:
 
 def slot_runnable(slot_tasks: list[Task]) -> bool:
     return any(task_runnable(t) for t in slot_tasks)
+
+
+def mark_shutdown(cur: Task) -> None:
+    """Raise desired_state to SHUTDOWN on a (copied) task, finalizing the
+    OBSERVED state too when no agent can: a task that was never dispatched
+    to a node (status < ASSIGNED) has nothing running anywhere and nobody
+    who would ever report it stopped — leaving its status PENDING wedges
+    every 'wait until the old tasks stopped' loop for its full timeout
+    (the reference's orchestrators write terminal status directly for
+    unassigned tasks, updater.go removeOldTasks / restart.go)."""
+    import time as _time
+
+    cur.desired_state = TaskState.SHUTDOWN
+    if cur.status.state < TaskState.ASSIGNED:
+        cur.status.state = TaskState.SHUTDOWN
+        cur.status.message = "shut down before assignment"
+        cur.status.timestamp = _time.time()

@@ -25,7 +25,7 @@ from ..api.types import (
     UpdateStatusState,
 )
 from ..store import by
-from .task import is_task_dirty, new_task
+from .task import is_task_dirty, mark_shutdown, new_task
 
 log = logging.getLogger("swarmkit_tpu.orchestrator.updater")
 
@@ -342,7 +342,7 @@ class Updater(threading.Thread):
                 cur = tx.get_task(t.id)
                 if cur is not None and cur.desired_state < TaskState.SHUTDOWN:
                     cur = cur.copy()
-                    cur.desired_state = TaskState.SHUTDOWN
+                    mark_shutdown(cur)
                     tx.update(cur)
             new_task_id[0] = replacement.id
 
@@ -355,7 +355,7 @@ class Updater(threading.Thread):
                 cur = tx.get_task(t.id)
                 if cur is not None and cur.desired_state < TaskState.SHUTDOWN:
                     cur = cur.copy()
-                    cur.desired_state = TaskState.SHUTDOWN
+                    mark_shutdown(cur)
                     tx.update(cur)
 
         self.store.update(cb)

@@ -17,7 +17,15 @@ pytestmark = pytest.mark.multiprocess
 
 def _env():
     env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
+    # strip the axon sitecustomize: it imports jax at interpreter start
+    # (~1.9 s) in EVERY subprocess, and the CLI tier spawns dozens —
+    # these daemons schedule tiny clusters on the CPU path and the
+    # framework defers jax imports until a tick actually crosses the
+    # accelerator threshold
+    pp = [p for p in env.get("PYTHONPATH", "").split(":")
+          if p and "axon_site" not in p]
+    env["PYTHONPATH"] = ":".join([REPO] + pp)
+    env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
     return env
 

@@ -29,7 +29,13 @@ class Swarmd:
         self.log_path = os.path.join(base, f"{name}.out")
         self._log = open(self.log_path, "wb")
         env = dict(os.environ)
-        env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
+        # strip the axon sitecustomize (imports jax at interpreter start,
+        # ~1.9 s per process) — these daemons stay on the CPU path and
+        # the framework defers jax imports past the accelerator threshold
+        pp = [p for p in env.get("PYTHONPATH", "").split(":")
+              if p and "axon_site" not in p]
+        env["PYTHONPATH"] = ":".join([REPO] + pp)
+        env["JAX_PLATFORMS"] = "cpu"
         # daemons must not inherit the test conftest's virtual-device env
         env.pop("XLA_FLAGS", None)
         # tick 0.2s → 2-4s election timeouts: four Python processes on a

@@ -211,10 +211,16 @@ def test_failed_update_rolls_back_and_reports_rollback_status():
             a.start()
             agents.append(a)
 
+        from swarmkit_tpu.api.specs import RestartPolicy
+
         spec = ServiceSpec(
             annotations=Annotations(name="rollme"),
             replicas=3,
-            task=TaskSpec(runtime=ContainerSpec(image="img:v1")),
+            # tiny restart delay: the compiled 5 s default paces every
+            # failed-v2 generation and the post-rollback reconverge,
+            # multiplying the test's wall clock for no extra coverage
+            task=TaskSpec(runtime=ContainerSpec(image="img:v1"),
+                          restart=RestartPolicy(delay=0.05)),
             update=UpdateConfig(parallelism=1, delay=0.0, monitor=1.0,
                                 order=UpdateOrder.STOP_FIRST,
                                 failure_action=UpdateFailureAction.ROLLBACK,
