@@ -61,6 +61,29 @@ def _expired_leaf(root, node_id: str, role: int, org: str) -> bytes:
     return cert.public_bytes(serialization.Encoding.PEM)
 
 
+def _create_with_retry(ctl, spec, timeout=60):
+    """Operator-grade create: a starved host can stretch one RPC past its
+    30 s call timeout while the write actually committed — retry and
+    treat AlreadyExists as success (the name is the idempotency key)."""
+    import time as _time
+
+    from swarmkit_tpu.controlapi.errors import AlreadyExists
+
+    deadline = _time.monotonic() + timeout
+    while True:
+        try:
+            return ctl.create_service(spec)
+        except AlreadyExists:
+            for s in ctl.list_services():
+                if s.spec.annotations.name == spec.annotations.name:
+                    return s
+            raise
+        except Exception:
+            if _time.monotonic() >= deadline:
+                raise
+            _time.sleep(1.0)
+
+
 def test_renewcert_offline_then_rejoin(tmp_path):
     node = SwarmNode(
         state_dir=str(tmp_path / "m1"),
@@ -74,7 +97,7 @@ def test_renewcert_offline_then_rejoin(tmp_path):
         assert wait_for(lambda: node.is_leader, timeout=15)
         ctl = RemoteControl(node.addr, node.security)
         try:
-            svc = ctl.create_service(ServiceSpec(
+            svc = _create_with_retry(ctl, ServiceSpec(
                 annotations=Annotations(name="pre-down"), replicas=1))
         finally:
             ctl.close()
@@ -144,7 +167,7 @@ def test_renewcert_offline_then_rejoin(tmp_path):
         assert wait_for(lambda: back.is_leader, timeout=30)
         ctl = RemoteControl(back.addr, back.security)
         try:
-            svc2 = ctl.create_service(ServiceSpec(
+            svc2 = _create_with_retry(ctl, ServiceSpec(
                 annotations=Annotations(name="post-renew"), replicas=1))
         finally:
             ctl.close()
