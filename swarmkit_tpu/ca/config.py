@@ -59,16 +59,35 @@ def parse_join_token(token: str) -> ParsedToken:
     return ParsedToken(version=parts[1], root_digest=parts[2], secret=parts[3], fips=fips)
 
 
+# how long the PREVIOUS trust anchors stay verifiable after a root swap.
+# A rotation finishes when every node's cert was re-ISSUED under the new
+# root, but issuance and the node's local INSTALL are separate steps: a
+# node whose status poll raced out under load still SERVES its old-root
+# leaf for a few renewal retries. Without a grace, the moment peers trim
+# trust to the new root that node can never authenticate again — not
+# even to renew. The grace bounds the tail: the old root was fully
+# trusted seconds earlier, and it expires on a timer (docker's own
+# rotation has the same anchors coexisting during the phased window).
+ROTATION_TRUST_GRACE = 300.0
+
+
 class SecurityConfig:
     """Trust root + node identity, renewal-aware (ca/config.go:SecurityConfig)."""
 
-    def __init__(self, root: RootCA, key_pem: bytes, cert_pem: bytes):
+    def __init__(self, root: RootCA, key_pem: bytes, cert_pem: bytes,
+                 clock=None):
+        from ..utils.clock import REAL_CLOCK
+
         self._lock = threading.Lock()
+        self._clock = clock or REAL_CLOCK
         self._root = root
         self._key_pem = key_pem
         self._cert_pem = cert_pem
         self._identity = root.verify_cert(cert_pem)
         self._watchers: list = []  # callables fired on cert/root update
+        self._prev_trust_pem: bytes = b""
+        self._prev_trust_until: float = 0.0
+        self._grace_timer = None
 
     # -- accessors ---------------------------------------------------------
 
@@ -127,12 +146,52 @@ class SecurityConfig:
             cb(self)
 
     def update_root_ca(self, root: RootCA):
-        """Swap the trust root (root rotation — ca/config.go UpdateRootCA)."""
+        """Swap the trust root (root rotation — ca/config.go UpdateRootCA).
+        The outgoing anchors stay verifiable for ROTATION_TRUST_GRACE via
+        `trust_anchors_pem` (TLS contexts build from it) so a peer whose
+        cert install raced the rotation finish can still authenticate its
+        renewal."""
+        old_timer = None
         with self._lock:
+            old = self._root
+            if old is not None and old.cert_pem != root.cert_pem:
+                self._prev_trust_pem = old.cert_pem
+                self._prev_trust_until = (self._clock.time()
+                                          + ROTATION_TRUST_GRACE)
+                # long-lived TLS contexts only rebuild on security
+                # events; re-fire the watchers when the grace lapses so
+                # server/client contexts actually DROP the old anchors
+                # at the bound instead of trusting them until the next
+                # renewal happens to rebuild a context
+                old_timer = self._grace_timer
+                self._grace_timer = self._clock.timer(
+                    ROTATION_TRUST_GRACE + 1.0, self._on_grace_expired)
             self._root = root
             watchers = list(self._watchers)
+        if old_timer is not None:
+            old_timer.cancel()
         for cb in watchers:
             cb(self)
+
+    def _on_grace_expired(self):
+        with self._lock:
+            watchers = list(self._watchers)
+        for cb in watchers:
+            try:
+                cb(self)          # contexts rebuild from trimmed anchors
+            except Exception:     # a failed reload must not kill the wheel
+                pass
+
+    def trust_anchors_pem(self) -> bytes:
+        """PEM anchors TLS contexts should trust right now: the current
+        root (bundle) plus the previous anchors while inside the
+        post-swap grace window."""
+        with self._lock:
+            pem = self._root.cert_pem
+            if self._prev_trust_pem \
+                    and self._clock.time() < self._prev_trust_until:
+                pem = pem + self._prev_trust_pem
+            return pem
 
     def renewal_due(self, now: float | None = None) -> bool:
         with self._lock:

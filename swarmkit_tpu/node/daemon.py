@@ -1032,15 +1032,33 @@ class SwarmNode:
         if sec is None or self._root_renew_active:
             return
         try:
+            from ..ca import RootCA
+
             bundle = sec.root_ca.cert_pem
             parts = [b"-----BEGIN CERTIFICATE-----" + p
                      for p in bundle.split(b"-----BEGIN CERTIFICATE-----")
                      if p.strip()]
-            if len(parts) < 2:
+            leaf = sec.key_and_cert()[1]
+            if len(parts) >= 2:
+                # rotation in flight: the leaf must chain to the NEW
+                # anchor (the bundle's second entry) or the rotation
+                # stalls on us
+                RootCA(parts[1]).verify_cert(leaf)
                 return
-            from ..ca import RootCA
-
-            RootCA(parts[1]).verify_cert(sec.key_and_cert()[1])
+            # single anchor: a leaf that doesn't chain to our OWN trust
+            # is always broken — the lost-install window (our cert was
+            # re-ISSUED at the rotation's epoch, the reconciler finished
+            # and trust trimmed to the new root, but the status poll
+            # raced out before we installed it). Peers still accept our
+            # old leaf for the ROTATION_TRUST_GRACE window, so the
+            # renewal kicked here can authenticate and heal.
+            for part in parts:
+                try:
+                    RootCA(part).verify_cert(leaf)
+                    return
+                except Exception:
+                    continue
+            self._kick_renew()
         except Exception:
             self._kick_renew()
 

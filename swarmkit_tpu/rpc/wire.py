@@ -162,7 +162,10 @@ def server_ssl_context(security, require_client_cert: bool = False) -> ssl.SSLCo
     joining node with only a join token reaches the CA service, mirroring
     the reference's unauthenticated NodeCA.IssueNodeCertificate."""
     key_pem, cert_pem = security.key_and_cert()
-    ca_pem = security.root_ca.cert_pem
+    # current anchors + the bounded post-rotation grace tail
+    # (ca/config.py trust_anchors_pem): a peer whose cert install raced
+    # a rotation finish must still be able to authenticate its renewal
+    ca_pem = security.trust_anchors_pem()
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
     ctx.minimum_version = ssl.TLSVersion.TLSv1_2
     with _PemFiles(cert_pem, key_pem, ca_pem) as (cert_f, key_f, ca_f):
@@ -186,7 +189,8 @@ def client_ssl_context(security=None, root_cert_pem: bytes | None = None) -> ssl
     ctx.verify_mode = ssl.CERT_REQUIRED
     if security is not None:
         key_pem, cert_pem = security.key_and_cert()
-        with _PemFiles(cert_pem, key_pem, security.root_ca.cert_pem) as (
+        with _PemFiles(cert_pem, key_pem,
+                       security.trust_anchors_pem()) as (
                 cert_f, key_f, ca_f):
             ctx.load_cert_chain(cert_f, key_f)
             ctx.load_verify_locations(ca_f)

@@ -544,3 +544,133 @@ def test_renewer_window_on_fake_clock():
         stop.set()
         renewer.stop()
         st.join(timeout=2)
+
+
+def test_rotation_trust_grace_accepts_previous_root():
+    """After update_root_ca swaps trust, the OUTGOING anchors stay
+    verifiable for ROTATION_TRUST_GRACE (ca/config.py): a peer whose
+    cert install raced the rotation finish can still authenticate its
+    renewal. The grace expires on the clock seam, and the expiry
+    RE-FIRES the security watchers so long-lived TLS contexts (which
+    only rebuild on security events) actually drop the old anchors at
+    the bound."""
+    import time as _time
+
+    from swarmkit_tpu.ca.config import ROTATION_TRUST_GRACE
+    from swarmkit_tpu.utils.clock import FakeClock
+
+    clock = FakeClock(start=_time.time())
+    old_root = RootCA.create("org-g")
+    new_root = RootCA.create("org-g")
+    key_pem, csr = create_csr("gnode", NodeRole.MANAGER, "org-g")
+    old_cert = old_root.sign_csr(csr)
+    sec = SecurityConfig(old_root, key_pem, old_cert, clock=clock)
+    assert sec.trust_anchors_pem() == old_root.cert_pem
+
+    # swap to the same root: no grace entry
+    key2, csr2 = create_csr("gnode", NodeRole.MANAGER, "org-g")
+    new_cert = new_root.sign_csr(csr2)
+    sec2 = SecurityConfig(new_root, key2, new_cert, clock=clock)
+    sec2.update_root_ca(new_root)
+    assert sec2.trust_anchors_pem() == new_root.cert_pem
+
+    fired = []
+    sec.watch(lambda s: fired.append(s.trust_anchors_pem()))
+    sec.update_root_ca(new_root)           # real swap
+    anchors = sec.trust_anchors_pem()
+    assert new_root.cert_pem in anchors and old_root.cert_pem in anchors
+    assert len(fired) == 1                 # the swap itself notified
+
+    # the grace is time-bounded on the clock seam, and the expiry
+    # notifies watchers again with the TRIMMED anchor set
+    clock.advance(ROTATION_TRUST_GRACE + 2.0)
+    assert sec.trust_anchors_pem() == new_root.cert_pem
+    assert len(fired) == 2
+    assert fired[-1] == new_root.cert_pem
+
+
+def test_rpc_accepts_old_root_client_within_grace():
+    """Live handshake across the grace window: a server whose trust just
+    swapped still admits a client presenting the PREVIOUS root's cert —
+    and an unrelated cluster's cert stays rejected."""
+    import ssl as _ssl
+
+    import pytest as _pytest
+
+    from swarmkit_tpu.rpc.client import RPCClient
+    from swarmkit_tpu.rpc.server import RPCServer, ServiceRegistry
+
+    org = "grace-org"
+    old_root = RootCA.create(org)
+    new_root = RootCA.create(org)
+
+    def ident(root, nid, role):
+        k, c = create_csr(nid, role, org)
+        return SecurityConfig(root, k, root.sign_csr(
+            c, subject=(nid, role, org)))
+
+    server_sec = ident(new_root, "srv", NodeRole.MANAGER)
+    # simulate "trust was old_root until the rotation finished just now"
+    server_sec._prev_trust_pem = old_root.cert_pem
+    import time as _time
+    server_sec._prev_trust_until = _time.time() + 300
+
+    reg = ServiceRegistry()
+    reg.add("g.ping", lambda caller: caller.node_id if caller else None,
+            roles=[NodeRole.MANAGER, NodeRole.WORKER])
+    srv = RPCServer("127.0.0.1:0", server_sec, reg, org=org)
+    srv.start()
+    try:
+        # stale-leaf client: cert under the OLD root, trusts both (its
+        # own grace covers the server's new-root leaf)
+        stale = ident(old_root, "stale-node", NodeRole.WORKER)
+        stale._prev_trust_pem = new_root.cert_pem
+        stale._prev_trust_until = _time.time() + 300
+        c = RPCClient(srv.addr, security=stale)
+        try:
+            assert c.call("g.ping") == "stale-node"
+        finally:
+            c.close()
+
+        # an unrelated cluster's identity is still refused
+        foreign = ident(RootCA.create(org), "intruder", NodeRole.WORKER)
+        foreign._prev_trust_pem = new_root.cert_pem
+        foreign._prev_trust_until = _time.time() + 300
+        with _pytest.raises(Exception):
+            c2 = RPCClient(srv.addr, security=foreign)
+            try:
+                c2.call("g.ping")
+            finally:
+                c2.close()
+    finally:
+        srv.stop()
+
+
+def test_single_anchor_self_heal_kicks_renewal():
+    """node/daemon.py _ensure_rotation_renewal, post-rotation case: a
+    leaf that chains to NO anchor of the node's own (single-root) trust
+    must kick a renewal — the lost-install window leaves exactly this
+    state behind."""
+    from swarmkit_tpu.node.daemon import SwarmNode
+
+    old_root = RootCA.create("org-h")
+    new_root = RootCA.create("org-h")
+    key_pem, csr = create_csr("hnode", NodeRole.WORKER, "org-h")
+    stale_cert = old_root.sign_csr(csr)
+
+    class Stub:
+        security = SecurityConfig(old_root, key_pem, stale_cert)
+        _root_renew_active = False
+        kicked = 0
+
+        def _kick_renew(self):
+            self.kicked += 1
+
+    stub = Stub()
+    # coherent: leaf chains to the single anchor -> no kick
+    SwarmNode._ensure_rotation_renewal(stub)
+    assert stub.kicked == 0
+    # trust trimmed to the new root, leaf still old -> kick
+    stub.security._root = new_root
+    SwarmNode._ensure_rotation_renewal(stub)
+    assert stub.kicked == 1
