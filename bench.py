@@ -560,8 +560,10 @@ def bench_raft_replay(np):
     # cold contact: the [M, E] bool ack matrix ships BIT-PACKED (8x fewer
     # wire bytes) and unpacks device-side; true value-pull sync
     # (block_until_ready lies through the tunnel)
+    from swarmkit_tpu.ops.bitpack import pack_bits
+
     t0 = time.perf_counter()
-    packed = np.packbits(acks, axis=1, bitorder="little")
+    packed = pack_bits(acks)
     acks_dev = unpack_acks(jax.device_put(packed), E)
     int(np.asarray(probe(acks_dev)))
     h2d_s = time.perf_counter() - t0

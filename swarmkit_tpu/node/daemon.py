@@ -432,6 +432,17 @@ class SwarmNode:
         deadline = time.monotonic() + JOIN_TIMEOUT * 2
         last: Exception | None = None
         while time.monotonic() < deadline:
+            # A server token/identity REJECTION is replicated state, not a
+            # transient — retrying the same seed just burns the whole join
+            # window before surfacing the same answer. But one manager's
+            # verdict can be stale (a deposed leader whose cluster object
+            # still holds pre-rotation tokens), so the rejection becomes
+            # final only when no seed gave a NON-rejection response this
+            # pass: unreachable seeds don't vote, any seed that answered
+            # differently (issued, pending, timed out server-side) keeps
+            # the retry loop alive to reach the real leader.
+            rejections = responses = 0
+            reject_err: Exception | None = None
             for seed in seeds:
                 try:
                     if root_pem is None:
@@ -450,6 +461,7 @@ class SwarmNode:
                             cert.status_state == IssuanceState.ISSUED:
                         return SecurityConfig(RootCA(root_pem), key_pem,
                                               cert.certificate_pem)
+                    responses += 1
                     last = NodeError(
                         "issuance failed: "
                         f"{getattr(cert, 'status_err', 'timeout')}")
@@ -467,12 +479,24 @@ class SwarmNode:
                         isinstance(exc, RPCError) and exc.name in (
                             "InvalidToken", "PermissionDenied"))
                     if rejected:
-                        # the server REJECTED the token/identity — that
-                        # verdict is replicated state, not a transient
-                        # condition; retrying just burns the whole join
-                        # window before surfacing the same answer
-                        raise NodeError(f"join rejected: {exc}") from exc
+                        rejections += 1
+                        responses += 1
+                        reject_err = NodeError(f"join rejected: {exc}")
+                        reject_err.__cause__ = exc
+                        continue
+                    if isinstance(exc, RPCError):
+                        # the seed ANSWERED with a non-rejection error
+                        # (e.g. NotLeaderError mid-election) — that vote
+                        # keeps the retry loop alive; only connection-level
+                        # failures and timeouts are non-voting
+                        responses += 1
                     last = exc
+            if rejections and rejections == responses:
+                raise reject_err
+            if reject_err is not None:
+                # keep the actionable verdict visible even if a later
+                # seed's transient error arrived after it
+                last = reject_err
             if self._stop.wait(JOIN_RETRY):
                 break
         raise NodeError(f"certificate issuance failed: {last}")

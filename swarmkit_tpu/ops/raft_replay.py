@@ -75,16 +75,15 @@ def frontier_advance(acks, frontier, quorum):
     return acks, jnp.sum(prefix).astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("n_entries",))
 def unpack_acks(packed, n_entries: int):
     """Bit-packed ack upload → device bool matrix. The [M, E] bool matrix
-    ships 8× smaller as uint8 words (np.packbits axis=1, bitorder='little')
-    and unpacks device-side — through a single-digit-MB/s tunnel the wire
-    bytes are the whole cold cost (round-4 verdict #6). packed:
+    ships 8× smaller as uint8 words (ops/bitpack.py pack_bits) and unpacks
+    device-side — through a single-digit-MB/s tunnel the wire bytes are
+    the whole cold cost (round-4 verdict #6). packed:
     uint8[M, ceil(E/8)]."""
-    idx = jnp.arange(n_entries, dtype=jnp.int32)
-    words = packed[:, idx // 8]                       # [M, E] uint8
-    return ((words >> (idx % 8).astype(jnp.uint8)) & 1).astype(bool)
+    from .bitpack import unpack_bits
+
+    return unpack_bits(packed, n_entries)
 
 
 @jax.jit

@@ -83,18 +83,16 @@ def pack_eligibility(eligible) -> "np.ndarray":
     with `unpack_eligibility` device-side — through the dev tunnel the
     [S, N] bool matrix is the cold upload's whale (round-4 verdict #5,
     the same move as the resident svc-matrix fix)."""
-    import numpy as np
+    from .bitpack import pack_bits
 
-    return np.packbits(np.asarray(eligible, bool), axis=1,
-                       bitorder="little")
+    return pack_bits(eligible)
 
 
-@functools.partial(jax.jit, static_argnames=("n_nodes",))
 def unpack_eligibility(packed, n_nodes: int):
     """uint8[S, ceil(N/8)] → bool[S, N], device-side."""
-    idx = jnp.arange(n_nodes, dtype=jnp.int32)
-    words = packed[:, idx // 8]
-    return ((words >> (idx % 8).astype(jnp.uint8)) & 1).astype(bool)
+    from .bitpack import unpack_bits
+
+    return unpack_bits(packed, n_nodes)
 
 
 @functools.partial(jax.jit, static_argnames=("n_nodes",))

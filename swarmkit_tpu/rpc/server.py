@@ -255,11 +255,18 @@ class RPCServer:
         import time as _time
 
         t_start = _time.perf_counter()
-        RPC_STARTED.inc((method,))
+        # The method string is client-controlled until the registry lookup
+        # succeeds; recording it verbatim would let any connected peer (the
+        # CA listener accepts peers without a client cert) grow the metric
+        # series without bound. Registry methods are a finite set — unknown
+        # names collapse into one "<unknown>" series.
+        mdef = self.registry.lookup(method)
+        mlabel = method if mdef is not None else "<unknown>"
+        RPC_STARTED.inc((mlabel,))
 
         def finish(code: str):
-            RPC_HANDLED.inc((method, code))
-            RPC_LATENCY.observe((method,), _time.perf_counter() - t_start)
+            RPC_HANDLED.inc((mlabel, code))
+            RPC_LATENCY.observe((mlabel,), _time.perf_counter() - t_start)
 
         def reply_err(exc: Exception):
             from .wire import RPCError
@@ -277,7 +284,6 @@ class RPCServer:
             except (OSError, ValueError):
                 pass
 
-        mdef = self.registry.lookup(method)
         if mdef is None:
             reply_err(PermissionDenied(f"unknown method {method!r}"))
             return

@@ -54,8 +54,16 @@ class Histogram:
         return "\n".join(lines)
 
 
+def _escape_label_value(v) -> str:
+    # Prometheus text exposition: backslash, double-quote and newline must
+    # be escaped inside label values or the scrape breaks mid-page.
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _render_labels(label_names: tuple, values: tuple) -> str:
-    return ",".join(f'{k}="{v}"' for k, v in zip(label_names, values))
+    return ",".join(f'{k}="{_escape_label_value(v)}"'
+                    for k, v in zip(label_names, values))
 
 
 class CounterFamily:

@@ -368,3 +368,103 @@ def test_worker_promotion_and_demotion_over_wire(tmp_path, cluster_nodes):
                     timeout=40)
     assert wait_for(lambda: len(m1.raft.members) == 2, timeout=45)
     assert wait_for(lambda: replicated(), timeout=45)
+
+
+def test_join_rejection_policy_mixed_seeds(tmp_path, monkeypatch):
+    """A server-side token rejection fails fast ONLY when no seed gave a
+    non-rejection response that pass: unreachable seeds don't vote (a
+    rejection + a dead seed is still final), but any seed answering
+    differently keeps the retry loop alive — one deposed manager's stale
+    verdict must not permanently fail a join the real leader would accept.
+    And the final error always surfaces the rejection verdict, not a later
+    transient."""
+    from swarmkit_tpu.ca.certificates import RootCA
+    from swarmkit_tpu.ca.config import generate_join_token
+    from swarmkit_tpu.node import daemon as daemon_mod
+    from swarmkit_tpu.node.daemon import NodeError, SwarmNode
+    from swarmkit_tpu.rpc.wire import RPCError
+
+    root = RootCA.create("join-policy-org")
+    token = generate_join_token(root)
+    calls = []
+
+    class FakeRemoteCA:
+        def __init__(self, addr, root_cert_pem=None):
+            self.addr = addr
+
+        def issue_node_certificate(self, csr_pem, token=None, node_id=None):
+            calls.append(self.addr)
+            if self.addr.startswith("reject"):
+                raise RPCError("InvalidToken", "token rejected")
+            raise ConnectionRefusedError("seed down")
+
+        def close(self):
+            pass
+
+    monkeypatch.setattr(daemon_mod, "RemoteCA", FakeRemoteCA)
+    monkeypatch.setattr(daemon_mod, "fetch_root_cert",
+                        lambda addr, digest, **kw: root.cert_pem)
+    monkeypatch.setattr(daemon_mod, "JOIN_TIMEOUT", 1.0)
+    monkeypatch.setattr(daemon_mod, "JOIN_RETRY", 0.05)
+
+    def make_node(seeds):
+        n = SwarmNode(state_dir=str(tmp_path / "n"), executor=None,
+                      join_addr=seeds, join_token=token,
+                      org="join-policy-org")
+        return n
+
+    # rejection + unreachable seed: the rejection is the only RESPONSE,
+    # so it is final on the first pass (fail-fast holds) and names the
+    # verdict
+    calls.clear()
+    n = make_node("reject-a:1,dead-b:2")
+    t0 = time.monotonic()
+    with pytest.raises(NodeError, match="join rejected"):
+        n._obtain_identity()
+    assert time.monotonic() - t0 < 0.5          # no retry-window burn
+    assert calls == ["reject-a:1", "dead-b:2"]  # single pass, both tried
+
+    # all seeds reject: final on the first pass
+    calls.clear()
+    n = make_node("reject-a:1,reject-b:2")
+    with pytest.raises(NodeError, match="join rejected"):
+        n._obtain_identity()
+    assert calls == ["reject-a:1", "reject-b:2"]
+
+    # a rejection plus a seed answering NOT-REJECTED (server-side issuance
+    # timeout) keeps retrying until the window closes — and the final
+    # error still surfaces the rejection, not the other seed's state
+    class PendingRemoteCA(FakeRemoteCA):
+        def issue_node_certificate(self, csr_pem, token=None, node_id=None):
+            calls.append(self.addr)
+            if self.addr.startswith("reject"):
+                raise RPCError("InvalidToken", "token rejected")
+            return "node-id"
+
+        def node_certificate_status(self, node_id, timeout=None):
+            return None                          # never issued
+
+    monkeypatch.setattr(daemon_mod, "RemoteCA", PendingRemoteCA)
+    calls.clear()
+    n = make_node("reject-a:1,pending-b:2")
+    with pytest.raises(NodeError, match="join rejected"):
+        n._obtain_identity()
+    assert len(calls) >= 4                       # multiple passes ran
+
+    # a rejection plus a seed ANSWERING with a transient wire error
+    # (NotLeaderError mid-election surfaces as RPCError) must keep
+    # retrying — one deposed manager's stale verdict is not final while
+    # a live seed is still looking for its leader
+    class ElectionRemoteCA(FakeRemoteCA):
+        def issue_node_certificate(self, csr_pem, token=None, node_id=None):
+            calls.append(self.addr)
+            if self.addr.startswith("reject"):
+                raise RPCError("InvalidToken", "token rejected")
+            raise RPCError("NotLeaderError", "no reachable raft leader")
+
+    monkeypatch.setattr(daemon_mod, "RemoteCA", ElectionRemoteCA)
+    calls.clear()
+    n = make_node("reject-a:1,electing-b:2")
+    with pytest.raises(NodeError, match="join rejected"):
+        n._obtain_identity()
+    assert len(calls) >= 4                       # retried past pass 1

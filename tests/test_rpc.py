@@ -226,6 +226,32 @@ def test_per_rpc_metrics_series(cluster_ca, server):
         c.close()
 
 
+def test_unknown_method_metrics_bounded(cluster_ca, server):
+    """Method names are client-controlled until the registry lookup
+    succeeds; a peer spraying random method strings must NOT mint a metric
+    series per string (unbounded label cardinality = a memory leak on the
+    CA listener, which accepts peers without a client cert). Unknown
+    methods collapse into one "<unknown>" series."""
+    from swarmkit_tpu.rpc.server import RPC_HANDLED, RPC_STARTED
+
+    c = worker_client(cluster_ca, server)
+    try:
+        unk0 = RPC_STARTED.value(("<unknown>",))
+        for i in range(5):
+            with pytest.raises(Exception):
+                c.call(f'nonexistent.method-{i}"\n', i)
+        assert RPC_STARTED.value(("<unknown>",)) == unk0 + 5
+        for i in range(5):
+            assert RPC_STARTED.value((f'nonexistent.method-{i}"\n',)) == 0
+        assert RPC_HANDLED.value(("<unknown>", "PermissionDenied")) >= 5
+        # label values render escaped — a quote/newline in a value must
+        # not break the exposition page
+        from swarmkit_tpu.utils.metrics import _render_labels
+        assert _render_labels(("m",), ('a"b\n',)) == 'm="a\\"b\\n"'
+    finally:
+        c.close()
+
+
 def test_remote_control_retries_unsent_connection_closed(cluster_ca, server):
     """A connection that dies between RemoteControl._conn()'s aliveness
     check and the send (the post-rotation TLS-reload window) raises
