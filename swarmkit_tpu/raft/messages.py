@@ -60,12 +60,20 @@ class Message:
 class VoteRequest(Message):
     last_log_index: int = 0
     last_log_term: int = 0
+    # set on leadership-transfer campaigns (etcd campaignTransfer): the
+    # vote must bypass peers' leader leases, which otherwise ignore
+    # disruptive campaigns while a leader is live (CheckQuorum's lease)
+    transfer: bool = False
+    # pre-vote poll (raft §9.6): term is the PROSPECTIVE term (current+1);
+    # granting changes no persistent state on either side
+    pre: bool = False
     kind: str = "vote_req"
 
 
 @dataclass
 class VoteResponse(Message):
     granted: bool = False
+    pre: bool = False
     kind: str = "vote_resp"
 
 

@@ -157,6 +157,18 @@ class RaftNode:
         self._quorum_elapsed = 0
         self._recent_active: set[int] = set()
 
+        # PreVote (raft §9.6 / etcd PreVote): an election-timeout node
+        # first polls peers with a NON-disruptive pre-vote at term+1 —
+        # only a pre-quorum starts a real campaign and bumps the term.
+        # The reference leaves etcd's PreVote off and eats the inflated-
+        # term disruption when a starved/partitioned node wakes up; under
+        # CPU-starved hosts that wake-up churns elections, so this build
+        # turns it on (deliberate robustness divergence). Leadership
+        # transfers skip straight to a real campaign (etcd
+        # campaignTransfer).
+        self.pre_vote = True
+        self._pre_votes: set[int] | None = None
+
         # streamed-snapshot pause state: peer -> (snapshot_index, ttl);
         # while set, data appends to that peer are withheld (heartbeats
         # still flow) and stale failure hints ignored (etcd
@@ -393,19 +405,48 @@ class RaftNode:
                 self._campaign()
 
     # -------------------------------------------------------------- election
-    def _campaign(self):
+    def _campaign(self, transfer: bool = False):
         if self.id not in self.members:
             # removed members must not start elections, and a freshly joined
             # node that has not yet learned the membership (empty config)
             # must not self-elect as a quorum-of-one
             return
+        if self.pre_vote and not transfer:
+            # poll first; only a pre-quorum bumps the term (_real_campaign)
+            self._pre_campaign()
+            return
+        self._real_campaign(transfer=transfer)
+
+    def _enter_candidacy(self):
         self.role = CANDIDATE
-        self.term += 1
-        self.voted_for = self.id
-        self.votes = {self.id}
         self.leader_id = None
         self.election_elapsed = 0
         self._randomized_timeout = self._next_timeout()
+
+    def _pre_campaign(self):
+        self._enter_candidacy()
+        # NO term bump, NO voted_for, NO persist — a pre-candidate that
+        # cannot reach a quorum leaves no trace (raft §9.6)
+        self._pre_votes = {self.id}
+        if self._quorum(len(self._pre_votes)):
+            self._real_campaign()
+            return
+        for peer_id in self.members:
+            if peer_id == self.id:
+                continue
+            self._send(VoteRequest(
+                frm=self.id, to=peer_id, term=self.term + 1,
+                last_log_index=self._last_index(),
+                last_log_term=self._last_term(),
+                pre=True,
+            ))
+
+    def _real_campaign(self, transfer: bool = False):
+        self._pre_votes = None
+        self._enter_candidacy()
+        self.term += 1
+        self.voted_for = self.id
+        self.votes = {self.id}
         self._persist_hard_state()
         if self._quorum(len(self.votes)):
             self._become_leader()
@@ -417,6 +458,7 @@ class RaftNode:
                 frm=self.id, to=peer_id, term=self.term,
                 last_log_index=self._last_index(),
                 last_log_term=self._last_term(),
+                transfer=transfer,
             ))
 
     def _quorum(self, n: int) -> bool:
@@ -424,6 +466,7 @@ class RaftNode:
         return n >= voters // 2 + 1
 
     def _become_leader(self):
+        self._pre_votes = None
         self.role = LEADER
         self.leader_id = self.id
         self.heartbeat_elapsed = 0
@@ -448,6 +491,7 @@ class RaftNode:
         was_leader = self.role == LEADER
         was_signalled = self._signalled
         self._signalled = False
+        self._pre_votes = None
         if term > self.term:
             self.term = term
             self.voted_for = None
@@ -472,9 +516,36 @@ class RaftNode:
 
     # ------------------------------------------------------------------ step
     def _step(self, msg):
+        if (msg.kind == "vote_req" and self.check_quorum
+                and not getattr(msg, "transfer", False)
+                and self.leader_id is not None
+                and self.election_elapsed < self.election_tick):
+            # Leader lease (the vote-withholding half of etcd CheckQuorum,
+            # which the reference gets from raft.Config CheckQuorum=true —
+            # manager/state/raft/raft.go:492): a node that heard from a
+            # live leader within the minimum election timeout IGNORES
+            # disruptive campaigns entirely — no term bump, no response.
+            # Without this, one starved/partition-returned node waking up
+            # with an inflated term deposes a healthy leader and churns
+            # elections under load. Applies to pre-votes and real votes
+            # alike; leadership transfers bypass the lease via the
+            # transfer flag (etcd campaignTransfer).
+            return
         if msg.term > self.term:
-            self._become_follower(msg.term, getattr(msg, "frm", None)
-                                  if msg.kind == "append" else None)
+            if msg.kind == "vote_req" and getattr(msg, "pre", False):
+                # a pre-vote poll at a PROSPECTIVE term changes no state
+                # here; _on_vote_request answers it without granting a
+                # real vote (etcd: "Never change our term in response to
+                # a PreVote")
+                pass
+            elif msg.kind == "vote_resp" and getattr(msg, "pre", False) \
+                    and msg.granted:
+                # a granted pre-vote echoes OUR prospective term back;
+                # adopting it would double-bump the real campaign's term
+                pass
+            else:
+                self._become_follower(msg.term, getattr(msg, "frm", None)
+                                      if msg.kind == "append" else None)
         handler = {
             "vote_req": self._on_vote_request,
             "vote_resp": self._on_vote_response,
@@ -494,7 +565,7 @@ class RaftNode:
         MsgTimeoutNow the same way)."""
         if self.id in self.members and msg.term == self.term \
                 and msg.frm == self.leader_id:
-            self._campaign()
+            self._campaign(transfer=True)
 
     def _on_transfer(self):
         from .messages import TimeoutNow
@@ -517,10 +588,21 @@ class RaftNode:
         self._send(TimeoutNow(frm=self.id, to=target, term=self.term))
 
     def _on_vote_request(self, msg: VoteRequest):
+        up_to_date = (msg.last_log_term, msg.last_log_index) >= (
+            self._last_term(), self._last_index())
+        if getattr(msg, "pre", False):
+            # pre-vote: would we vote for this log at that future term?
+            # Granting records NOTHING (no voted_for, no timer reset) —
+            # many nodes may grant the same pre-term to different
+            # pre-candidates; only real votes are exclusive
+            grant = msg.term > self.term and up_to_date
+            self._send(VoteResponse(
+                frm=self.id, to=msg.frm,
+                term=msg.term if grant else self.term,
+                granted=grant, pre=True))
+            return
         grant = False
         if msg.term >= self.term:
-            up_to_date = (msg.last_log_term, msg.last_log_index) >= (
-                self._last_term(), self._last_index())
             not_voted = self.voted_for in (None, msg.frm)
             if up_to_date and not_voted and msg.term == self.term:
                 grant = True
@@ -531,6 +613,16 @@ class RaftNode:
                                 granted=grant))
 
     def _on_vote_response(self, msg: VoteResponse):
+        if getattr(msg, "pre", False):
+            if (self.role != CANDIDATE or self._pre_votes is None
+                    or not msg.granted or msg.term != self.term + 1):
+                # rejections with a HIGHER real term already demoted us in
+                # _step; stale or duplicate grants are ignored
+                return
+            self._pre_votes.add(msg.frm)
+            if self._quorum(len(self._pre_votes)):
+                self._real_campaign()
+            return
         if self.role != CANDIDATE or msg.term != self.term:
             return
         if msg.granted:

@@ -421,3 +421,91 @@ def test_removed_ids_persist_across_restart(tmp_path):
 
     loaded = RaftStorage(str(tmp_path / "raft")).load()
     assert victim in loaded.removed
+
+
+# ------------------------------------------- lease vote withholding + PreVote
+
+
+def test_lease_ignores_disruptive_vote_request():
+    """The vote-withholding half of CheckQuorum (etcd lease, which the
+    reference gets from raft.Config CheckQuorum=true): a node that heard
+    from a live leader within the minimum election timeout ignores a
+    higher-term campaign outright — no term bump, no grant. One starved
+    node waking up with an inflated term must not depose a healthy
+    leader."""
+    from swarmkit_tpu.raft.messages import VoteRequest
+
+    c = RaftCluster(3)
+    leader = c.tick_until_leader()
+    c.tick_all(1)                     # fresh append contact on followers
+    follower = next(n for n in c.nodes.values() if not n.is_leader)
+    term0, lead_term0 = follower.term, leader.term
+
+    disruptive = VoteRequest(frm=99, to=follower.id, term=term0 + 7,
+                             last_log_index=10 ** 6, last_log_term=term0 + 7)
+    follower.step(disruptive)
+    follower.process_all()
+    assert follower.term == term0          # not even a term bump
+    assert follower.voted_for != 99
+
+    leader.step(VoteRequest(frm=99, to=leader.id, term=lead_term0 + 7,
+                            last_log_index=10 ** 6,
+                            last_log_term=lead_term0 + 7))
+    leader.process_all()
+    assert leader.is_leader and leader.term == lead_term0
+
+
+def test_lease_admits_leadership_transfer_campaign():
+    """A TimeoutNow-initiated campaign must bypass the lease (etcd
+    campaignTransfer) — otherwise the wedge monitor's transfer could never
+    move leadership off a live-but-stuck leader."""
+    c = RaftCluster(3)
+    leader = c.tick_until_leader()
+    c.tick_all(1)
+    term0 = leader.term
+    leader._on_transfer()
+    c.settle()
+    new_leader = c.leader()
+    assert new_leader is not None and new_leader.id != leader.id
+    assert new_leader.term > term0
+
+
+def test_prevote_isolated_node_never_inflates_term():
+    """PreVote (raft §9.6): an isolated node election-timing-out forever
+    only POLLS — its real term never moves, so on rejoin it slots straight
+    back under the existing leader with zero disruption. (The reference
+    leaves etcd PreVote off and eats one election per rejoin; this build
+    diverges deliberately.)"""
+    c = RaftCluster(3)
+    leader = c.tick_until_leader()
+    follower = next(n for n in c.nodes.values() if not n.is_leader)
+    term0 = leader.term
+
+    c.router.isolate(follower.id)
+    # many election timeouts while cut off: pre-campaigns, no pre-quorum
+    for _ in range(10 * follower.election_tick):
+        follower.tick()
+    follower.process_all()
+    assert follower.term == term0, "pre-vote must not inflate the term"
+
+    c.router.heal()
+    c.tick_all(3)
+    assert leader.is_leader and leader.term == term0, \
+        "rejoin deposed a healthy leader"
+    from swarmkit_tpu.raft.node import FOLLOWER
+    assert follower.role == FOLLOWER                      # back in line
+    # the cluster still commits without an intervening election
+    assert c.propose({"op": "post-rejoin"})
+
+
+def test_prevote_elects_when_leader_actually_dies():
+    """Pre-vote must not cost liveness: leader loss still yields a new
+    leader with exactly one term bump for the winning campaign."""
+    c = RaftCluster(3)
+    leader = c.tick_until_leader()
+    term0 = leader.term
+    c.router.isolate(leader.id)
+    new_leader = c.tick_until_leader()
+    assert new_leader.id != leader.id
+    assert new_leader.term > term0
+    assert c.propose({"op": "after-failover"})
