@@ -996,6 +996,12 @@ def main():
     # this ran last)
     rows = [
         ("e2e_service_start_100r_3m_5w", lambda: bench_e2e_service_start(np)),
+        # burst rows next, still on a small heap: measured at the END of
+        # the grid their per-round host time carries multi-GB-heap GC
+        # pauses (observed: global diff 1.28x / replay 2.6x when last vs
+        # 1.95x / 3.1x standalone) — same clean-heap rationale as e2e
+        ("global_diff_50svc_x_10k", lambda: bench_global_diff(np)),
+        ("raft_replay_1m_x_5", lambda: bench_raft_replay(np)),
         # waves=7 -> three fully-pipelined periods in the e2e sample
         # (depth+1..waves-1); with one sample the min-estimator was a
         # lottery against heap/tunnel noise on the commit-heavy wall
@@ -1039,8 +1045,6 @@ def main():
         ("plugin_100k_x_5k", lambda: bench_scheduler_config(
             np, placement_ops, batch, 5_000, 100_000, 20,
             plugin_every=3, plugin_volume=True)),
-        ("global_diff_50svc_x_10k", lambda: bench_global_diff(np)),
-        ("raft_replay_1m_x_5", lambda: bench_raft_replay(np)),
         ("host_micro", lambda: bench_host_micro(np)),
     ]
     configs = {name: _run_row(name, thunk) for name, thunk in rows}
