@@ -114,3 +114,47 @@ def test_propose_latency_histogram_populates():
     t.join(timeout=5)
     after = histogram("swarm_raft_transaction_latency_seconds").snapshot()[2]
     assert after > before
+
+
+def test_metrics_exposition_every_line_parses():
+    """The whole exposition page must stay machine-parseable even when
+    label values carry exotic characters — one malformed line breaks the
+    entire Prometheus scrape. Exercises histogram families (escaped
+    pre-rendered labels), counter families, and plain histograms
+    together, the way /metrics serves them."""
+    import re
+
+    from swarmkit_tpu.utils.metrics import counter_family, histogram_family
+
+    histogram("swarm_parse_probe_seconds").observe(0.01)
+    counter_family("swarm_parse_probe_total", "", ("method",)).inc(
+        ('we"ird\nname\\x',))
+    histogram_family("swarm_parse_probe_hist", "", ("method",)).observe(
+        ('an"other\n',), 0.02)
+
+    store = MemoryStore()
+    store.update(lambda tx: tx.create(Task(id="t1", service_id="s")))
+    mc = MetricsCollector(store)
+    mc.start()
+    try:
+        assert wait_for(
+            lambda: "swarm_manager_tasks" in mc.prometheus_text(), timeout=5)
+        text = mc.prometheus_text()
+        assert 'method="we\\"ird\\nname\\\\x"' in text
+        # the family-child (pre-rendered label) path must escape too —
+        # the structural regex below cannot tell an unescaped quote from
+        # a label separator
+        assert 'method="an\\"other\\n"' in text
+        # one metric line = name, optional {k="v",...} with properly
+        # QUOTED values (escaped quotes/backslashes inside; braces are
+        # legal in values), then a number
+        label = r'[a-zA-Z_][\w]*="(?:[^"\\]|\\.)*"'
+        line_re = re.compile(
+            rf'^[a-zA-Z_:][\w:]*(\{{({label}(,{label})*)?\}})?'
+            r' -?[0-9eE.+-]+$')
+        for ln in text.splitlines():
+            if not ln or ln.startswith("#"):
+                continue
+            assert line_re.match(ln), f"malformed exposition line: {ln!r}"
+    finally:
+        mc.stop()
