@@ -114,3 +114,47 @@ def test_version_conflicts_replicated():
     stale.node_id = "n2"
     with pytest.raises(SequenceConflict):
         _propose_in_thread(c, lambda: store.update(lambda tx: tx.update(stale)))
+
+
+def test_wal_torn_tail_recovers_prefix_and_never_resurrects(tmp_path):
+    """A crash mid-append leaves a torn record; reload must recover every
+    record BEFORE the tear and stop there (reference ReadRepairWAL,
+    storage/walwrap.go) — not discard the whole log, not crash, and NOT
+    skip past the tear: records after a corrupt one may predate a
+    truncate_from rewrite, and resurrecting them forks raft history."""
+    from swarmkit_tpu.raft.messages import Entry
+    from swarmkit_tpu.raft.storage import RaftStorage, new_dek
+
+    dek = new_dek()
+    s = RaftStorage(str(tmp_path / "r"), dek=dek)
+    s.append_entries([Entry(term=1, index=i, data={"op": i})
+                      for i in range(1, 6)])
+    s.save_hard_state(term=1, voted_for=None, commit=5)
+    s._close_wal()
+
+    wal = tmp_path / "r" / "wal.jsonl"
+    lines = wal.read_bytes().splitlines()
+    assert len(lines) == 5
+    # corrupt record 4 mid-ciphertext, leaving record 5 INTACT after it
+    lines[3] = lines[3][: len(lines[3]) // 2]
+    wal.write_bytes(b"\n".join(lines) + b"\n")
+
+    loaded = RaftStorage(str(tmp_path / "r"), dek=dek).load()
+    assert loaded is not None
+    assert [e.index for e in loaded.entries] == [1, 2, 3]
+    assert loaded.entries[-1].data == {"op": 3}
+
+
+def test_snapshot_wrong_dek_fails_loudly(tmp_path):
+    """Snapshots are written atomically, so a decode failure is never a
+    torn write — restarting from empty state instead of raising would
+    silently fork the cluster history. (The WAL first-record analogue is
+    pinned by test_raft.py::test_restart_from_storage.)"""
+    from swarmkit_tpu.raft.storage import (
+        RaftStorage, RaftStorageError, new_dek)
+
+    s = RaftStorage(str(tmp_path / "r"), dek=new_dek())
+    s.save_snapshot(index=10, term=2, data={"state": "x"}, members={})
+
+    with pytest.raises(RaftStorageError):
+        RaftStorage(str(tmp_path / "r"), dek=new_dek()).load()
