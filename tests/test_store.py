@@ -340,3 +340,93 @@ def test_by_custom_index():
         == ["cs-0", "cs-1", "cs-2"]
     assert [s.id for s in view.find_services(by.ByCustom("tier", "silver"))] \
         == []
+
+
+def test_event_replay_reconstructs_state_under_concurrent_writers():
+    """The event-sourcing contract every control loop builds on
+    (snapshot-then-watch, memory.go ViewAndWatch): a consumer that takes
+    an atomic snapshot and then applies the event stream must arrive at
+    exactly the writers' final state — no lost, duplicated, or reordered
+    events across concurrent version-checked writers."""
+    import random
+
+    s = MemoryStore()
+    for i in range(8):
+        s.update(lambda tx, i=i: tx.create(make_task(f"seed{i}")))
+
+    # unbounded subscription: this consumer buffers every event until the
+    # writers finish, the exact pattern limit=None exists for
+    snapshot, ch = s.view_and_watch(
+        lambda tx: {t.id: t.copy() for t in tx.find_tasks()}, limit=None)
+
+    stop = threading.Event()
+    errors = []
+
+    def writer(wid: int):
+        rng = random.Random(wid)
+        try:
+            for k in range(120):
+                roll = rng.random()
+                if roll < 0.4:
+                    s.update(lambda tx: tx.create(
+                        make_task(f"w{wid}-{k}")))
+                elif roll < 0.8:
+                    # version-checked update with operator-style retry
+                    for _ in range(20):
+                        t = s.view(lambda tx: tx.get_task(
+                            rng.choice(list(
+                                snapshot))))  # a seed task, always present
+                        if t is None:
+                            break
+                        t = t.copy()
+                        t.node_id = f"n{wid}-{k}"
+                        try:
+                            s.update(lambda tx: tx.update(t))
+                            break
+                        except SequenceConflict:
+                            continue
+                else:
+                    tid = f"w{wid}-{rng.randrange(k + 1)}"
+                    try:
+                        s.update(lambda tx: tx.delete(Task, tid))
+                    except NotExistError:
+                        pass
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    threads = [threading.Thread(target=writer, args=(w,)) for w in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive(), "writer still running — drain would race"
+    assert not errors, errors
+
+    # events publish synchronously inside update() (under the update
+    # lock), so after join every event is already queued: one drain gets
+    # them all. Fold them over the snapshot exactly as a control loop
+    # would.
+    replay = dict(snapshot)
+    last_commit = 0
+    for ev in ch.drain():
+        if isinstance(ev, EventCreate):
+            assert ev.obj.id not in replay, f"duplicate create {ev.obj.id}"
+            replay[ev.obj.id] = ev.obj
+        elif isinstance(ev, EventUpdate):
+            assert ev.obj.id in replay, f"update before create {ev.obj.id}"
+            # old must match what the stream already gave us (ordering)
+            assert replay[ev.obj.id].node_id == ev.old.node_id, \
+                f"out-of-order update for {ev.obj.id}"
+            replay[ev.obj.id] = ev.obj
+        elif isinstance(ev, EventDelete):
+            assert ev.obj.id in replay, f"delete before create {ev.obj.id}"
+            del replay[ev.obj.id]
+        elif isinstance(ev, EventCommit):
+            assert ev.version.index >= last_commit, "commit went backwards"
+            last_commit = ev.version.index
+
+    final = {t.id: t for t in s.view(lambda tx: tx.find_tasks())}
+    assert set(replay) == set(final)
+    for tid, t in final.items():
+        assert replay[tid].node_id == t.node_id, tid
+        assert replay[tid].meta.version.index == t.meta.version.index, tid
