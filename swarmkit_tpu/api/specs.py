@@ -384,3 +384,46 @@ def spec_equal(a, b) -> bool:
     if type(a) is type(b) and a == b:
         return True
     return dataclasses.asdict(a) == dataclasses.asdict(b)
+
+
+def normalize_nones(obj):
+    """Fold hand-crafted Nones back to field defaults, recursively and in
+    place (returns `obj` for chaining).
+
+    The reference's wire makes this unrepresentable: a non-pointer proto
+    field cannot be null — a client can only OMIT it, which decodes as
+    the zero value (specs.proto's Task, Placement, Resources, ... are
+    all non-pointer). This framework's msgpack codec rebuilds dataclasses
+    without per-field type checks, so a hand-crafted payload CAN carry
+    None where the dataclass declares a non-None default — and every
+    validator and control loop downstream is written against the proto
+    guarantee. Called at the validation boundary so both the checks and
+    the stored spec see proto-shaped objects.
+
+    Fields DECLARED optional (default None, e.g. ServiceSpec.rollback,
+    TaskSpec.runtime) keep None — those are the proto pointer fields.
+    None ELEMENTS inside lists and None dict values are dropped: proto
+    repeated and map fields cannot carry null entries either (an absent
+    element is simply not sent).
+    """
+    if not dataclasses.is_dataclass(obj) or isinstance(obj, type):
+        return obj
+    for f in dataclasses.fields(obj):
+        v = getattr(obj, f.name)
+        if v is None:
+            if f.default_factory is not dataclasses.MISSING:
+                setattr(obj, f.name, f.default_factory())
+            elif f.default is not dataclasses.MISSING \
+                    and f.default is not None:
+                setattr(obj, f.name, f.default)
+        elif dataclasses.is_dataclass(v) and not isinstance(v, type):
+            normalize_nones(v)
+        elif isinstance(v, list):
+            v[:] = [normalize_nones(item) for item in v if item is not None]
+        elif isinstance(v, dict):
+            drop = [k for k, item in v.items() if item is None]
+            for k in drop:
+                del v[k]
+            for item in v.values():
+                normalize_nones(item)
+    return obj

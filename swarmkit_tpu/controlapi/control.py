@@ -9,6 +9,7 @@ it replicates via raft. List calls support the reference's filter set
 """
 from __future__ import annotations
 
+import math
 import re
 from dataclasses import dataclass, field
 
@@ -27,8 +28,9 @@ from ..api.objects import (
 )
 from ..api.specs import ClusterSpec, ConfigSpec, NetworkSpec, SecretSpec, \
     UpdateConfig, \
-    ServiceSpec, VolumeSpec
-from ..api.types import NodeRole, ServiceMode, TaskState
+    ServiceSpec, VolumeSpec, normalize_nones
+from ..api.types import (NodeRole, RestartCondition, ServiceMode,
+                         TaskState, UpdateFailureAction, UpdateOrder)
 from ..scheduler import constraint as constraint_mod
 from ..store import by
 from ..store.memory import MemoryStore, SequenceConflict
@@ -116,7 +118,21 @@ class ControlAPI:
 
     # ------------------------------------------------------------ validation
     @staticmethod
+    def _normalize(spec):
+        """Shared wire-boundary prelude for every spec/annotations
+        payload: the reference's proto wire cannot carry null in a
+        non-pointer field (only omission, which decodes as the zero
+        value), but this codec rebuilds dataclasses without field
+        checks — fold hand-crafted Nones back to the declared defaults
+        so validators and the stored object see proto-shaped data."""
+        if spec is None:
+            raise InvalidArgument("spec must be provided")
+        return normalize_nones(spec)
+
+    @staticmethod
     def _validate_annotations(annotations) -> None:
+        if annotations is None:
+            raise InvalidArgument("annotations must be provided")
         if not annotations.name:
             raise InvalidArgument("meta: name must be provided")
         if not _NAME_RE.match(annotations.name):
@@ -134,58 +150,103 @@ class ControlAPI:
         schedulable quantum can never be satisfied sensibly."""
         if r is None:
             return
-        if r.nano_cpus != 0 and r.nano_cpus < cls.MIN_NANO_CPUS:
+        nano = cls._num(r.nano_cpus, f"cpu value in {what}")
+        if nano != 0 and nano < cls.MIN_NANO_CPUS:
             raise InvalidArgument(
                 f"invalid cpu value in {what}: must be at least "
                 f"{cls.MIN_NANO_CPUS / 1e9:g} cores")
-        if r.memory_bytes != 0 and r.memory_bytes < cls.MIN_MEMORY_BYTES:
+        mem = cls._num(r.memory_bytes, f"memory value in {what}")
+        if mem != 0 and mem < cls.MIN_MEMORY_BYTES:
             raise InvalidArgument(
                 f"invalid memory value in {what}: must be at least 4MiB")
+        if r.generic is not None and not isinstance(r.generic, dict):
+            raise InvalidArgument(
+                f"generic resources in {what} must be a mapping")
         for kind, qty in (r.generic or {}).items():
-            if qty < 0:
+            if cls._num(qty, f"generic resource {kind!r} in {what}") < 0:
                 raise InvalidArgument(
                     f"invalid generic resource {kind!r} in {what}: "
                     "quantity must be non-negative")
 
     @staticmethod
-    def _validate_restart_policy(rp) -> None:
+    def _num(v, what):
+        """The wire codec rebuilds dataclasses without field type checks,
+        so a hand-crafted payload can put a str (or anything) where a
+        number belongs; comparing it would crash the handler with
+        TypeError instead of rejecting the spec. NaN is rejected too —
+        it compares False against every bound and would smuggle an
+        unreconcilable value into the control loops."""
+        if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                or not math.isfinite(v):
+            raise InvalidArgument(f"{what} must be a number, not {v!r}")
+        return v
+
+    @classmethod
+    def _count(cls, v, what):
+        """Count fields (replicas, parallelism, attempts, ...) are proto
+        uints: integers only — replicas=2.5 silently scaling to 3 tasks
+        is a spec error, not an interpretation choice."""
+        if cls._num(v, what) != int(v):
+            raise InvalidArgument(f"{what} must be an integer, not {v!r}")
+        return int(v)
+
+    @classmethod
+    def _validate_restart_policy(cls, rp) -> None:
         """service.go validateRestartPolicy:62-88."""
         if rp is None:
             return
-        if rp.delay < 0:
+        # same hand-crafted-payload concern for the enum field: later
+        # checks dereference .value and would crash the handler
+        if not isinstance(rp.condition, RestartCondition):
+            raise InvalidArgument(
+                f"invalid restart condition {rp.condition!r}")
+        if cls._num(rp.delay, "restart-delay") < 0:
             raise InvalidArgument("restart-delay cannot be negative")
-        if rp.window < 0:
+        if cls._num(rp.window, "restart-window") < 0:
             raise InvalidArgument("restart-window cannot be negative")
-        if rp.max_attempts < 0:
+        if cls._count(rp.max_attempts, "restart-max-attempts") < 0:
             raise InvalidArgument("restart-max-attempts cannot be negative")
 
-    @staticmethod
-    def _validate_update_config(cfg, what: str) -> None:
+    @classmethod
+    def _validate_update_config(cls, cfg, what: str) -> None:
         """service.go validateUpdate:98-122."""
         if cfg is None:
             return
-        if cfg.delay < 0:
+        if not isinstance(cfg.failure_action, UpdateFailureAction):
+            raise InvalidArgument(
+                f"invalid {what} failure action {cfg.failure_action!r}")
+        if not isinstance(cfg.order, UpdateOrder):
+            raise InvalidArgument(f"invalid {what} order {cfg.order!r}")
+        if cls._num(cfg.delay, f"{what}-delay") < 0:
             raise InvalidArgument(f"{what}-delay cannot be negative")
-        if cfg.monitor < 0:
+        if cls._num(cfg.monitor, f"{what}-monitor") < 0:
             raise InvalidArgument(f"{what}-monitor cannot be negative")
-        if not 0 <= cfg.max_failure_ratio <= 1:
+        if not 0 <= cls._num(cfg.max_failure_ratio,
+                             f"{what}-maxfailureratio") <= 1:
             raise InvalidArgument(
                 f"{what}-maxfailureratio cannot be less than 0 or bigger "
                 "than 1")
-        if cfg.parallelism < 0:
+        if cls._num(cfg.parallelism, f"{what}-parallelism") < 0:
             raise InvalidArgument(f"{what}-parallelism cannot be negative")
 
-    @staticmethod
-    def _validate_endpoint_spec(ep) -> None:
+    @classmethod
+    def _validate_endpoint_spec(cls, ep) -> None:
         """service.go validateEndpointSpec:316-355: DNSRR cannot publish
         through the routing mesh, and two ports may not claim the same
-        (published port, protocol)."""
+        (published port, protocol). Ports are proto uint32s bounded by
+        the TCP port space — range-check them here or garbage flows into
+        the allocator's published-port bookkeeping as 'valid'."""
         seen: set[tuple[int, str]] = set()
         for p in ep.ports:
             if p.protocol and p.protocol not in VALID_PORT_PROTOCOLS:
                 raise InvalidArgument(f"invalid protocol {p.protocol!r}")
-            if not p.target_port:
-                raise InvalidArgument("port config must include target_port")
+            if not 1 <= cls._count(p.target_port, "target_port") <= 65535:
+                raise InvalidArgument(
+                    "port config must include a target_port in 1-65535")
+            if not 0 <= cls._count(p.published_port,
+                                   "published_port") <= 65535:
+                raise InvalidArgument(
+                    "published_port must be in 0-65535 (0 = dynamic)")
             if p.publish_mode not in ("ingress", "host"):
                 raise InvalidArgument(
                     f"invalid publish mode {p.publish_mode!r}")
@@ -246,21 +307,27 @@ class ControlAPI:
                 constraint_mod.parse(exprs)
             except constraint_mod.InvalidConstraint as e:
                 raise InvalidArgument(f"invalid placement constraint: {e}")
-        if spec.task.placement.max_replicas < 0:
+        if self._count(spec.task.placement.max_replicas,
+                       "max-replicas") < 0:
             raise InvalidArgument("max-replicas cannot be negative")
         res = spec.task.resources
         self._validate_resources(res.reservations, "reservations")
         self._validate_resources(res.limits, "limits")
         self._validate_restart_policy(spec.task.restart)
-        if spec.mode == ServiceMode.REPLICATED and spec.replicas < 0:
+        if not isinstance(spec.mode, ServiceMode):
+            raise InvalidArgument(f"invalid service mode {spec.mode!r}")
+        if spec.mode == ServiceMode.REPLICATED \
+                and self._count(spec.replicas, "replicas") < 0:
             raise InvalidArgument("replicas must be non-negative")
         if spec.mode == ServiceMode.REPLICATED_JOB:
             # service.go validateMode: blind int casts must not smuggle
             # huge values in as negatives
-            if spec.job.max_concurrent < 0:
+            if self._count(spec.job.max_concurrent,
+                           "maximum concurrent jobs") < 0:
                 raise InvalidArgument(
                     "maximum concurrent jobs must not be negative")
-            if spec.job.total_completions < 0:
+            if self._count(spec.job.total_completions,
+                           "total completed jobs") < 0:
                 raise InvalidArgument(
                     "total completed jobs must not be negative")
         if spec.mode in (ServiceMode.REPLICATED_JOB, ServiceMode.GLOBAL_JOB):
@@ -361,6 +428,7 @@ class ControlAPI:
     def create_service(self, spec: ServiceSpec) -> Service:
         from ..api.defaults import merge_service_defaults
 
+        spec = self._normalize(spec)
         merge_service_defaults(spec)
         svc = Service(id=new_id(), spec=spec)
         svc.spec_version = Version(1)
@@ -386,6 +454,7 @@ class ControlAPI:
                        spec: ServiceSpec, rollback: bool = False) -> Service:
         """reference: service.go UpdateService — version-gated, saves
         previous_spec for rollback, forbids renames and mode changes."""
+        spec = self._normalize(spec)
         out: list[Service] = []
 
         def cb(tx):
@@ -541,6 +610,7 @@ class ControlAPI:
     def update_node(self, node_id: str, version: Version, spec) -> Node:
         """Availability / label / role changes. Demotion safety mirrors
         controlapi/node.go: the last manager cannot be demoted."""
+        spec = self._normalize(spec)
         out: list[Node] = []
 
         def cb(tx):
@@ -769,6 +839,7 @@ class ControlAPI:
                        rotate_unlock_key: bool = False) -> Cluster:
         """reference: cluster.go UpdateCluster — spec swap + token rotation
         + CAConfig-driven root rotation (ca_rotation.go)."""
+        spec = self._normalize(spec)
         out: list[Cluster] = []
 
         def cb(tx):
@@ -815,6 +886,7 @@ class ControlAPI:
 
     # --------------------------------------------------------------- secrets
     def create_secret(self, spec: SecretSpec) -> Secret:
+        spec = self._normalize(spec)
         self._validate_annotations(spec.annotations)
         if spec.driver is None and (
                 not spec.data or len(spec.data) > MAX_SECRET_SIZE):
@@ -844,6 +916,7 @@ class ControlAPI:
     def update_secret(self, secret_id: str, version: Version,
                       spec: SecretSpec) -> Secret:
         """Only labels may change (reference: secret.go UpdateSecret)."""
+        spec = self._normalize(spec)
         out: list[Secret] = []
 
         def cb(tx):
@@ -891,6 +964,7 @@ class ControlAPI:
 
     # --------------------------------------------------------------- configs
     def create_config(self, spec: ConfigSpec) -> Config:
+        spec = self._normalize(spec)
         self._validate_annotations(spec.annotations)
         if not spec.data or len(spec.data) > MAX_CONFIG_SIZE:
             raise InvalidArgument(
@@ -914,6 +988,7 @@ class ControlAPI:
 
     def update_config(self, config_id: str, version: Version,
                       spec: ConfigSpec) -> Config:
+        spec = self._normalize(spec)
         out: list[Config] = []
 
         def cb(tx):
@@ -954,6 +1029,7 @@ class ControlAPI:
 
     # -------------------------------------------------------------- networks
     def create_network(self, spec: NetworkSpec) -> Network:
+        spec = self._normalize(spec)
         self._validate_annotations(spec.annotations)
         # reject bad operator subnets at the API so the failure is visible
         # immediately, not a background allocator warning (the reference
@@ -1009,6 +1085,7 @@ class ControlAPI:
 
     # --------------------------------------------------------------- volumes
     def create_volume(self, spec: VolumeSpec) -> Volume:
+        spec = self._normalize(spec)
         self._validate_annotations(spec.annotations)
         if not spec.driver:
             raise InvalidArgument("driver must be specified")
@@ -1033,6 +1110,7 @@ class ControlAPI:
                       spec: VolumeSpec) -> Volume:
         """Only availability and labels may change
         (reference: volume.go UpdateVolume)."""
+        spec = self._normalize(spec)
         out: list[Volume] = []
 
         def cb(tx):
@@ -1075,6 +1153,7 @@ class ControlAPI:
 
     # ------------------------------------------------ extensions & resources
     def create_extension(self, annotations, description: str = "") -> Extension:
+        annotations = self._normalize(annotations)
         self._validate_annotations(annotations)
         ext = Extension(id=new_id(), annotations=annotations,
                         description=description)
@@ -1109,6 +1188,7 @@ class ControlAPI:
 
     def create_resource(self, annotations, kind: str,
                         payload: bytes = b"") -> Resource:
+        annotations = self._normalize(annotations)
         self._validate_annotations(annotations)
         res = Resource(id=new_id(), annotations=annotations, kind=kind,
                        payload=payload)

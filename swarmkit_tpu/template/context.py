@@ -64,7 +64,10 @@ def validate_text(text: str) -> None:
 
 def validate_container_spec_templates(spec) -> None:
     """Validate every templatable ContainerSpec surface (env, dir, user,
-    mount sources — the fields ExpandContainerSpec touches)."""
+    mount sources — the fields ExpandContainerSpec touches). Callers
+    pass specs already folded to proto shape (api/specs.py
+    normalize_nones at the control-API boundary), so fields are never
+    None here."""
     for e in spec.env:
         validate_text(e)
     validate_text(spec.dir)

@@ -651,3 +651,96 @@ def test_update_network_aliases_alone_rejected(api):
                                                 aliases=["new-alias"]))
     with pytest.raises(Unimplemented):
         api.update_service(svc.id, got.meta.version, upd)
+
+
+def test_spec_fuzz_never_crashes_validation(api):
+    """Validation robustness: randomized adversarial specs (exotic
+    strings, wrong-typed-but-constructible values, boundary numbers,
+    hostile constraints/ports/resources) must either be accepted or be
+    rejected with a CONTROLLED ControlError — any other exception class
+    escaping create_service is a server crash a malicious or buggy
+    client could trigger at will."""
+    import random
+
+    from swarmkit_tpu.api.specs import (
+        EndpointSpec, Placement, ResourceRequirements, Resources,
+        RestartPolicy, TaskSpec, UpdateConfig)
+    from swarmkit_tpu.controlapi import ControlError
+
+    rng = random.Random(20260801)
+    strings = ["", " ", "a" * 4096, "node.labels.x==", "==", "!=y",
+               "node.role == manager", "node.ip != 10.0.0.0/8",
+               "bad constraint \x00", "名前", "-leading", "UPPER",
+               "has space", "dot.name", "a" * 63, "a" * 64, "💥",
+               "{{.Node.ID}}", "$(rm -rf /)", "\n", "None", "web"]
+    ints = [-2**31, -1, 0, 1, 3, 1 << 15, 30000, 32767, 65535, 65536,
+            1 << 62]
+
+    def maybe(v):
+        return v if rng.random() < 0.7 else None
+
+    accepted = rejected = 0
+    for i in range(300):
+        kw = {}
+        if rng.random() < 0.8:
+            kw["replicas"] = rng.choice(
+                ints + [float("nan"), float("inf"), 2.5, "3", None])
+        if rng.random() < 0.5:
+            kw["mode"] = rng.choice(list(ServiceMode))
+        task_kw = {}
+        if rng.random() < 0.6:
+            task_kw["runtime"] = ContainerSpec(
+                image=maybe(rng.choice(strings)),
+                command=rng.choice([None, [], [rng.choice(strings)]]),
+                env=rng.choice([None, [f"{rng.choice(strings)}="
+                                       f"{rng.choice(strings)}"]]))
+        if rng.random() < 0.5:
+            task_kw["placement"] = Placement(
+                constraints=[rng.choice(strings + [None])
+                             for _ in range(rng.randint(1, 3))],
+                max_replicas=rng.choice(ints + ["x", 2.5]))
+        if rng.random() < 0.4:
+            task_kw["resources"] = ResourceRequirements(
+                reservations=Resources(
+                    nano_cpus=rng.choice(ints),
+                    memory_bytes=rng.choice(ints),
+                    generic=rng.choice([None, {}, {"gpu": -1},
+                                        {"gpu": "four"}, [("gpu", 1)]])))
+        if rng.random() < 0.3:
+            task_kw["restart"] = RestartPolicy(
+                condition=rng.randint(-1, 5),
+                delay=rng.choice([-1.0, 0.0, 1e18]),
+                max_attempts=rng.choice(ints))
+        if rng.random() < 0.3:
+            kw["update"] = UpdateConfig(
+                parallelism=rng.choice(ints),
+                delay=rng.choice([-5.0, 0.0, 1e9]),
+                failure_action=rng.choice(
+                    ["pause", "continue", "rollback", "explode", ""]))
+        if rng.random() < 0.4:
+            kw["endpoint"] = EndpointSpec(ports=rng.choice(
+                [[None]] + [[PortConfig(target_port=rng.choice(ints),
+                                        published_port=rng.choice(ints))]]))
+        name = (f"ok-{i}" if rng.random() < 0.4
+                else rng.choice(strings))
+        s = ServiceSpec(
+            annotations=Annotations(name=name,
+                                    labels={rng.choice(strings):
+                                            rng.choice(strings)}),
+            task=TaskSpec(**task_kw) if task_kw else None,
+            **kw)
+        try:
+            svc = api.create_service(s)
+            accepted += 1
+            # a spec good enough to create must also round-trip
+            assert api.get_service(svc.id) is not None
+            api.remove_service(svc.id)
+        except ControlError:
+            rejected += 1
+        # any other exception propagates and fails the test: that's the
+        # crash this fuzz exists to catch
+
+    # the generator must actually produce both outcomes or the fuzz
+    # got too easy/too hostile to mean anything
+    assert accepted > 5, f"only {accepted} specs accepted"
+    assert rejected > 50, f"only {rejected} specs rejected"
