@@ -13,6 +13,7 @@ layer (swarmkit_tpu.rpc) carries the same messages across processes.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -34,6 +35,8 @@ from ..store.watch import Channel, WatchQueue
 from ..utils.identity import new_id
 from ..utils.metrics import histogram
 from .heartbeat import Heartbeat
+
+log = logging.getLogger("swarmkit_tpu.dispatcher")
 
 _scheduling_delay = histogram(
     "swarm_dispatcher_scheduling_delay_seconds",
@@ -130,7 +133,8 @@ class Dispatcher:
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self._status_queue: list[tuple[str, object]] = []  # (task_id, status)
+        # (task_id, status, reporting node_id)
+        self._status_queue: list[tuple[str, object, str]] = []
         self._status_cond = threading.Condition()
         self._dirty_nodes: set[str] = set()
         self._unknown_timers: dict[str, Heartbeat] = {}
@@ -459,10 +463,28 @@ class Dispatcher:
     def update_task_status(self, node_id: str, session_id: str,
                            updates: list[tuple[str, object]]):
         """Enqueue observed-state updates; written in batches
-        (dispatcher.go:607, processUpdates :726-886)."""
+        (dispatcher.go:607, processUpdates :726-886). A malformed status
+        is rejected here — the wire codec rebuilds payloads without
+        field checks, and one bad entry inside the batch write would
+        abort the whole flush, dropping other nodes' good statuses.
+        Ownership is enforced at flush time against the task's CURRENT
+        node (dispatcher.go:654 'cannot update a task not assigned this
+        node' — a worker must not write observed state for tasks that
+        are not its own)."""
         self._session(node_id, session_id)
+        ok = []
+        for task_id, status in updates:
+            if not isinstance(getattr(status, "state", None), TaskState):
+                # drop per-entry, not per-batch: rejecting the whole list
+                # would bounce through the agent's retry queue forever
+                # (the bad entry re-queues with the good ones), wedging
+                # ALL status reporting from this node
+                log.warning("dropping malformed task status %r for task "
+                            "%s from node %s", status, task_id, node_id)
+                continue
+            ok.append((task_id, status, node_id))
         with self._status_cond:
-            self._status_queue.extend(updates)
+            self._status_queue.extend(ok)
             self._status_cond.notify_all()
 
     def update_volume_status(self, node_id: str, session_id: str,
@@ -588,7 +610,8 @@ class Dispatcher:
         try:
             self.store.batch(cb)
         except Exception:
-            pass
+            log.warning("orphaning batch failed for node %s", node_id,
+                        exc_info=True)
 
     # ---------------------------------------------------------- event plane
     def _run(self):
@@ -924,16 +947,28 @@ class Dispatcher:
                 return
             updates, self._status_queue = self._status_queue, []
 
-        # de-dup: last status per task wins within a batch
-        latest: dict[str, object] = {}
-        for task_id, status in updates:
-            latest[task_id] = status
+        # de-dup: last status per (task, REPORTING node) wins — keying by
+        # task alone would let a non-owner's entry clobber the owner's
+        # legitimate status here, before the ownership check runs
+        latest: dict[tuple[str, str], object] = {}
+        for task_id, status, node_id in updates:
+            latest[(task_id, node_id)] = status
 
         def cb(batch):
-            for task_id, status in latest.items():
-                def update_one(tx, task_id=task_id, status=status):
+            for (task_id, node_id), status in latest.items():
+                def update_one(tx, task_id=task_id, status=status,
+                               node_id=node_id):
                     cur = tx.get_task(task_id)
                     if cur is None:
+                        return
+                    if cur.node_id != node_id:
+                        # dispatcher.go:654: a node may only report tasks
+                        # assigned to it — silently skip rather than let a
+                        # rogue/buggy worker overwrite cluster-wide state
+                        log.warning(
+                            "dropping status for task %s from node %s "
+                            "(assigned to %s)", task_id, node_id,
+                            cur.node_id)
                         return
                     # monotonic: never lower observed state
                     if status.state < cur.status.state:
@@ -951,4 +986,8 @@ class Dispatcher:
         try:
             self.store.batch(cb)
         except Exception:
-            pass
+            # losing leadership mid-flush is routine (agents re-report
+            # from their retry queues) — but LOG it: this bare swallow
+            # once hid a NameError that dropped every status in the batch
+            log.warning("status flush failed; statuses will be re-reported",
+                        exc_info=True)

@@ -315,3 +315,99 @@ def test_legacy_tasks_stream(store):
         assert ids == {"t2"}
     finally:
         d.stop()
+
+
+def test_status_update_rejected_for_unowned_task(store):
+    """dispatcher.go:654 'cannot update a task not assigned this node':
+    a worker with a perfectly valid session must not be able to write
+    observed state for tasks assigned to OTHER nodes — one rogue/buggy
+    agent could otherwise rewrite cluster-wide task state."""
+    from swarmkit_tpu.api.objects import TaskStatus
+
+    d = Dispatcher(store, heartbeat_period=0.2)
+    d.start()
+    try:
+        _mk_node(store, "n1")
+        _mk_node(store, "n2")
+        _mk_task(store, "mine", "n1", state=TaskState.RUNNING)
+        _mk_task(store, "theirs", "n2", state=TaskState.RUNNING)
+        sid = d.register("n1")
+
+        d.update_task_status("n1", sid, [
+            ("mine", TaskStatus(state=TaskState.COMPLETE)),
+            ("theirs", TaskStatus(state=TaskState.FAILED)),
+        ])
+        assert wait_for(lambda: store.view(
+            lambda tx: tx.get_task("mine")).status.state
+            == TaskState.COMPLETE, timeout=10)
+        # the unowned update was dropped, not applied
+        assert store.view(lambda tx: tx.get_task("theirs")).status.state \
+            == TaskState.RUNNING
+    finally:
+        d.stop()
+
+
+def test_status_update_drops_malformed_entries_keeps_good(store):
+    """The wire codec rebuilds payloads without field type checks; a
+    malformed status is dropped PER ENTRY — rejecting the whole batch
+    would bounce through the agent's retry queue forever (the bad entry
+    re-queues alongside the good ones), wedging all status reporting
+    from that node, and inside the batch write it would abort the flush
+    and drop other nodes' good statuses."""
+    from swarmkit_tpu.api.objects import TaskStatus
+
+    class FakeStatus:
+        state = "RUNNING"              # right shape, wrong type
+
+    d = Dispatcher(store, heartbeat_period=0.2)
+    d.start()
+    try:
+        _mk_node(store, "n1")
+        _mk_task(store, "t1", "n1", state=TaskState.RUNNING)
+        _mk_task(store, "t2", "n1", state=TaskState.RUNNING)
+        sid = d.register("n1")
+        # one malformed + one good in the SAME batch: good one lands
+        d.update_task_status("n1", sid, [
+            ("t1", object()),
+            ("t2", TaskStatus(state=TaskState.COMPLETE)),
+            ("t1", FakeStatus()),
+        ])
+        assert wait_for(lambda: store.view(
+            lambda tx: tx.get_task("t2")).status.state
+            == TaskState.COMPLETE, timeout=10)
+        assert store.view(lambda tx: tx.get_task("t1")).status.state \
+            == TaskState.RUNNING
+    finally:
+        d.stop()
+
+
+def test_unowned_status_cannot_clobber_owners_in_same_flush(store):
+    """De-dup is keyed by (task, reporting node): a non-owner's entry
+    arriving later in the same flush window must not displace the
+    owner's legitimate status before the ownership check runs —
+    otherwise a rogue worker could SUPPRESS state instead of rewriting
+    it."""
+    from swarmkit_tpu.api.objects import TaskStatus
+
+    d = Dispatcher(store, heartbeat_period=0.2)
+    d.start()
+    try:
+        _mk_node(store, "n1")
+        _mk_node(store, "n2")
+        _mk_task(store, "t", "n1", state=TaskState.RUNNING)
+        sid1 = d.register("n1")
+        sid2 = d.register("n2")
+        # enqueue back-to-back so both land in one flush window: the
+        # owner's COMPLETE first, then the rogue's FAILED for the same
+        # task
+        d.update_task_status("n1", sid1,
+                             [("t", TaskStatus(state=TaskState.COMPLETE))])
+        d.update_task_status("n2", sid2,
+                             [("t", TaskStatus(state=TaskState.FAILED))])
+        assert wait_for(lambda: store.view(
+            lambda tx: tx.get_task("t")).status.state
+            == TaskState.COMPLETE, timeout=10)
+        assert store.view(lambda tx: tx.get_task("t")).status.state \
+            != TaskState.FAILED
+    finally:
+        d.stop()
