@@ -217,7 +217,7 @@ def _probe_resident_kernel(p, placement_ops, runs=5):
 
 def bench_scheduler_config(np, placement_ops, batch, n_nodes, n_tasks,
                            n_services, waves=8, plugin_every=None,
-                           depth=3, **kw):
+                           depth=3, async_commit=True, **kw):
     """Cold tick (fresh encoder + full device upload), then `waves` steady
     ticks through the TickPipeline (ops/pipeline.py) at pipeline depth
     `depth`: wave k's counts D2H rides the tunnel in the background
@@ -235,7 +235,15 @@ def bench_scheduler_config(np, placement_ops, batch, n_nodes, n_tasks,
         RESIDUAL after overlap;
       * e2e_wave_s — a full pipelined period wall-clock, including the
         add_task commit loop, vs cpu_e2e_wave_s doing identical work with
-        the CPU fill (both paths commit the same placements — parity)."""
+        the CPU fill (both paths commit the same placements — parity).
+
+    async_commit=True (round 6, the default; `--sync-commit` reverts)
+    rides the heavy commit half on the background CommitWorker
+    (ops/commit.py): a steady tick's wall is then pull-residual +
+    commit BARRIER + fold + encode + dispatch — the barrier charges
+    whatever commit time the overlap failed to hide, so e2e_wave_s
+    stays an honest sustained-period measure; commit_overlap_s reports
+    the hidden portion per wave."""
     from swarmkit_tpu.ops.pipeline import TickPipeline
     from swarmkit_tpu.ops.resident import ResidentPlacement
     from swarmkit_tpu.scheduler.encode import IncrementalEncoder
@@ -310,21 +318,25 @@ def bench_scheduler_config(np, placement_ops, batch, n_nodes, n_tasks,
     # (waves was clamped to >= depth + 2 above: steady sampling needs a
     # fully-pipelined wave — the fill-in phase's pulls have no commit
     # window under them)
-    pipe = TickPipeline(enc, rp, commit, depth=depth)
+    pipe = TickPipeline(enc, rp, commit, depth=depth,
+                        async_commit=async_commit)
     delta_rows_mark = None
     done = []
     import gc
-    for w in range(waves):
-        # a production scheduler collects in its idle debounce window
-        # between ticks, not inside the commit: without this, gen-2
-        # pauses from the accumulated wave objects land mid-wall and
-        # randomize the commit phase by 1.5-2x (both backends' commit is
-        # identical, so this only de-noises the comparison)
-        gc.collect()
-        done.extend(pipe.tick(infos, wave_groups[w]))
-        if w == 0:
-            delta_rows_mark = rp.uploads_delta_rows
-    done.extend(pipe.flush())
+    try:
+        for w in range(waves):
+            # a production scheduler collects in its idle debounce window
+            # between ticks, not inside the commit: without this, gen-2
+            # pauses from the accumulated wave objects land mid-wall and
+            # randomize the commit phase by 1.5-2x (both backends' commit
+            # is identical, so this only de-noises the comparison)
+            gc.collect()
+            done.extend(pipe.tick(infos, wave_groups[w]))
+            if w == 0:
+                delta_rows_mark = rp.uploads_delta_rows
+        done.extend(pipe.flush())
+    finally:
+        pipe.close()
     assert len(done) == waves and not any(
         t["serial_fallback"] for t in pipe.timings)
 
@@ -354,6 +366,18 @@ def bench_scheduler_config(np, placement_ops, batch, n_nodes, n_tasks,
             "encode": T[w]["encode_s"], "device": dev, "mat": mat_s,
             "add": add_s, "fold": T[w + depth]["fold_s"],
         })
+
+    # async plane observability: wave w's heavy commit is worker job w
+    # (submitted at tick w+depth; the final `depth` waves commit inline
+    # in flush); the unhidden residual shows as tick w+depth+1's barrier
+    # wait. overlap = heavy − barrier = commit time the plane removed
+    # from the wave period.
+    overlap = []
+    if async_commit and pipe.worker is not None:
+        job_s = pipe.worker.job_s
+        for w in range(min(len(job_s), waves - depth - 1)):
+            barrier = T[w + depth + 1]["barrier_s"]
+            overlap.append(max(0.0, job_s[w] - barrier))
     best_w = min(range(waves), key=lambda w: per_wave[w]["tick"])
     best = per_wave[best_w]
     cpu_fill_s, cpu_counts = best_of(
@@ -384,6 +408,13 @@ def bench_scheduler_config(np, placement_ops, batch, n_nodes, n_tasks,
         "e2e_wave_s": round(e2e_wave_s, 4),
         "cpu_e2e_wave_s": round(cpu_e2e_wave_s, 4),
         "e2e_speedup": round(cpu_e2e_wave_s / e2e_wave_s, 2),
+        "commit_async": bool(async_commit),
+        # commit seconds the async plane hid under the next wave's
+        # dispatch/pull per steady wave (empty list in sync mode)
+        "commit_overlap_s": (round(sum(overlap) / len(overlap), 4)
+                             if overlap else None),
+        "all_commit_overlap_s": [round(o, 4) for o in overlap],
+        "all_barrier_s": [round(t.get("barrier_s", 0.0), 4) for t in T],
         "cold_tpu_tick_s": round(cold["tpu_tick_s"], 4),
         "cold_cpu_tick_s": round(cold["cpu_tick_s"], 4),
         "cold_device_s": round(cold["device_s"], 4),
@@ -777,6 +808,88 @@ def _diagnose_e2e_stall(leader, service_id):
     return diag
 
 
+def bench_dispatcher_fanout(np, n_nodes=10_000):
+    """VERDICT item 7: the assignment-diff plane at 10k registered
+    sessions (reference manager/dispatcher/dispatcher.go:1013-1207).
+    One service-wide update (every task of the service re-written in a
+    single store transaction) dirties all 10k nodes; measured: commit →
+    every session's incremental assignment message enqueued and drained
+    through the existing 100ms/10k-item batching."""
+    from swarmkit_tpu.api.objects import Node, Task
+    from swarmkit_tpu.api.types import NodeStatusState, TaskState
+    from swarmkit_tpu.dispatcher.dispatcher import Dispatcher
+    from swarmkit_tpu.store.memory import MemoryStore
+
+    store = MemoryStore()
+
+    def seed(tx):
+        for i in range(n_nodes):
+            n = Node(id=f"fn{i:05d}")
+            n.status.state = NodeStatusState.READY
+            tx.create(n)
+            t = Task(id=f"ft{i:05d}", service_id="fansvc",
+                     node_id=n.id, slot=i + 1)
+            t.status.state = TaskState.RUNNING
+            t.desired_state = TaskState.RUNNING
+            tx.create(t)
+    store.update(seed)
+
+    d = Dispatcher(store, heartbeat_period=120.0)
+    d.start()
+    try:
+        t0 = time.perf_counter()
+        sessions = [(f"fn{i:05d}", d.register(f"fn{i:05d}"))
+                    for i in range(n_nodes)]
+        register_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        channels = [d.assignments(nid, sid) for nid, sid in sessions]
+        subscribe_s = time.perf_counter() - t0
+        for ch in channels:                      # drain COMPLETE snapshots
+            # registration itself pre-dirties the node (re-registering
+            # agents get fresh state), so a stray incremental may sit
+            # ahead of the COMPLETE — skip to it
+            msg = ch.try_get()
+            while msg is not None and msg.type != "complete":
+                msg = ch.try_get()
+            assert msg is not None and msg.type == "complete"
+
+        # THE measured number: one service update → all incrementals
+        def touch(tx):
+            for i in range(n_nodes):
+                cur = tx.get_task(f"ft{i:05d}").copy()
+                cur.annotations.labels = {"rev": "2"}
+                tx.update(cur)
+        t0 = time.perf_counter()
+        store.update(touch)
+        got = 0
+        deadline = time.monotonic() + 600
+        for ch in channels:
+            # the batch flush serves dirty sessions in SET order, so any
+            # given channel may be served late in the 10k sweep — wait
+            # against the overall deadline, not per channel
+            while time.monotonic() < deadline:
+                try:
+                    msg = ch.get(timeout=2)
+                except TimeoutError:
+                    continue
+                if msg is not None and msg.type == "incremental" \
+                        and msg.changes:
+                    got += 1
+                    break
+        fanout_s = time.perf_counter() - t0
+        return {
+            "sessions": n_nodes,
+            "register_s": round(register_s, 2),
+            "subscribe_s": round(subscribe_s, 2),
+            "fanout_drain_s": round(fanout_s, 3),
+            "msgs_per_s": round(got / fanout_s) if fanout_s else None,
+            "delivered": got,
+            "parity": got == n_nodes,
+        }
+    finally:
+        d.stop()
+
+
 def bench_host_micro(np):
     """The BASELINE.md harness rows the reference ships benchmarks for
     but no numbers (store ops memory_test.go:2028-2120, watch queue at
@@ -922,10 +1035,25 @@ def bench_host_micro(np):
     extra_threads = _threading.active_count() - threads_before
     for hb in hbs:
         hb.stop()
+    # beat-arrival dispersion (VERDICT item 6): the dispatcher returns
+    # period − uniform(0, ε) per beat, so a herd registered in a burst
+    # spreads across the ε window instead of beating in phase forever
+    from swarmkit_tpu.dispatcher.dispatcher import (
+        Dispatcher as _Dispatcher,
+        HEARTBEAT_EPSILON,
+    )
+    from swarmkit_tpu.store.memory import MemoryStore as _MS
+
+    _disp = _Dispatcher(_MS(), heartbeat_period=5.0)
+    jit = np.array([_disp._jittered_period() for _ in range(10_000)])
     out["heartbeat_10k_nodes"] = {
         "arm_per_s": round(10_000 / arm_s),
         "beat_per_s": round(50_000 / beat_s),
         "extra_threads": extra_threads,
+        "beat_dispersion_s": round(float(jit.std()), 4),
+        "beat_window_s": [round(float(jit.min()), 4),
+                          round(float(jit.max()), 4)],
+        "epsilon_s": HEARTBEAT_EPSILON,
     }
 
     # ---- remotes Select/Observe at 3..27 peers --------------------------
@@ -989,6 +1117,15 @@ def main():
     from swarmkit_tpu.ops import placement as placement_ops
     from swarmkit_tpu.scheduler import batch
 
+    # --sync-commit reverts every pipelined row to the round-5
+    # synchronous commit (unchanged numbers); the default rides the
+    # async commit plane (ops/commit.py)
+    ac = "--sync-commit" not in sys.argv[1:]
+
+    def sched(*a, **kw):
+        kw.setdefault("async_commit", ac)
+        return bench_scheduler_config(np, placement_ops, batch, *a, **kw)
+
     # e2e FIRST, on a clean heap: the live-cluster row spawns an
     # in-process 3-manager raft + 5 workers; after the grid configs the
     # process carries multi-GB of wave objects and GC pauses stall raft
@@ -1005,46 +1142,49 @@ def main():
         # waves=7 -> three fully-pipelined periods in the e2e sample
         # (depth+1..waves-1); with one sample the min-estimator was a
         # lottery against heap/tunnel noise on the commit-heavy wall
-        ("grid_100k_x_10k", lambda: bench_scheduler_config(
-            np, placement_ops, batch, N_NODES, N_TASKS, N_SERVICES,
+        ("grid_100k_x_10k", lambda: sched(
+            N_NODES, N_TASKS, N_SERVICES,
             waves=7)),
-        ("constraint_heavy_1k_x_1k", lambda: bench_scheduler_config(
-            np, placement_ops, batch, 1_000, 1_000, 20,
+        ("constraint_heavy_1k_x_1k", lambda: sched(
+            1_000, 1_000, 20,
             constraint_heavy=True)),
-        ("binpack_10k_x_1k", lambda: bench_scheduler_config(
-            np, placement_ops, batch, 1_000, 10_000, 50, binpack=True)),
+        ("binpack_10k_x_1k", lambda: sched(
+            1_000, 10_000, 50, binpack=True)),
         # the reference benchScheduler grid (scheduler_test.go:3187-3209)
-        ("grid_1k_x_1k", lambda: bench_scheduler_config(
-            np, placement_ops, batch, 1_000, 1_000, 20)),
-        ("grid_10k_x_1k", lambda: bench_scheduler_config(
-            np, placement_ops, batch, 1_000, 10_000, 20)),
-        ("grid_100k_x_1k", lambda: bench_scheduler_config(
-            np, placement_ops, batch, 1_000, 100_000, 20)),
-        ("grid_1m_x_10k", lambda: bench_scheduler_config(
-            np, placement_ops, batch, 10_000, 1_000_000, 100)),
+        ("grid_1k_x_1k", lambda: sched(
+            1_000, 1_000, 20)),
+        ("grid_10k_x_1k", lambda: sched(
+            1_000, 10_000, 20)),
+        ("grid_100k_x_1k", lambda: sched(
+            1_000, 100_000, 20)),
+        ("grid_1m_x_10k", lambda: sched(
+            10_000, 1_000_000, 100)),
         # the reference grid's 100k-NODE half (scheduler_test.go:3187-3209):
         # 100k nodes x 1k / 100k / 1M tasks
-        ("grid_1k_x_100k", lambda: bench_scheduler_config(
-            np, placement_ops, batch, 100_000, 1_000, 20)),
-        ("grid_100k_x_100k", lambda: bench_scheduler_config(
-            np, placement_ops, batch, 100_000, 100_000, 20)),
-        ("grid_1m_x_100k", lambda: bench_scheduler_config(
-            np, placement_ops, batch, 100_000, 1_000_000, 100, waves=4,
+        ("grid_1k_x_100k", lambda: sched(
+            100_000, 1_000, 20)),
+        ("grid_100k_x_100k", lambda: sched(
+            100_000, 100_000, 20)),
+        ("grid_1m_x_100k", lambda: sched(
+            100_000, 1_000_000, 100, waves=4,
             depth=2)),
         # the plugin-constrained grid (scheduler_test.go:3210-3226):
         # 1-in-3 nodes carry the required volume plugin
-        ("plugin_1k_x_1k", lambda: bench_scheduler_config(
-            np, placement_ops, batch, 1_000, 1_000, 20,
+        ("plugin_1k_x_1k", lambda: sched(
+            1_000, 1_000, 20,
             plugin_every=3, plugin_volume=True)),
-        ("plugin_10k_x_1k", lambda: bench_scheduler_config(
-            np, placement_ops, batch, 1_000, 10_000, 20,
+        ("plugin_10k_x_1k", lambda: sched(
+            1_000, 10_000, 20,
             plugin_every=3, plugin_volume=True)),
-        ("plugin_100k_x_1k", lambda: bench_scheduler_config(
-            np, placement_ops, batch, 1_000, 100_000, 20,
+        ("plugin_100k_x_1k", lambda: sched(
+            1_000, 100_000, 20,
             plugin_every=3, plugin_volume=True)),
-        ("plugin_100k_x_5k", lambda: bench_scheduler_config(
-            np, placement_ops, batch, 5_000, 100_000, 20,
+        ("plugin_100k_x_5k", lambda: sched(
+            5_000, 100_000, 20,
             plugin_every=3, plugin_volume=True)),
+        # the assignment-diff plane at the 10k-node design point
+        # (VERDICT item 7)
+        ("dispatcher_fanout_10k", lambda: bench_dispatcher_fanout(np)),
         ("host_micro", lambda: bench_host_micro(np)),
     ]
     configs = {name: _run_row(name, thunk) for name, thunk in rows}
@@ -1072,6 +1212,7 @@ def main():
             "failed_rows": failed_rows,
             "north_star_under_1s": bool(
                 "error" not in ns and ns["tpu_tick_s"] < 1.0),
+            "commit_mode": "async" if ac else "sync",
             "note": ("steady ticks run on device-RESIDENT node state "
                      "(ops/resident.py) through the tick PIPELINE "
                      "(ops/pipeline.py): deltas up, sliced int16 counts "
@@ -1079,9 +1220,15 @@ def main():
                      "previous wave's commit (one add_task per placement "
                      "+ slot materialization) — so device_s is the "
                      "dispatch + pull residual, near zero when the commit "
-                     "window covers the transfer. e2e_wave_s/"
-                     "cpu_e2e_wave_s compare full wave periods including "
-                     "that shared commit work. Cold ticks pay the full "
+                     "window covers the transfer. Round 6: the commit's "
+                     "heavy half additionally rides the ASYNC COMMIT "
+                     "PLANE (ops/commit.py; --sync-commit reverts), so a "
+                     "steady period's wall charges only the barrier "
+                     "residual the overlap failed to hide "
+                     "(commit_overlap_s = the hidden seconds). "
+                     "e2e_wave_s/cpu_e2e_wave_s compare full wave "
+                     "periods including that shared commit work. "
+                     "Cold ticks pay the full "
                      "encode + upload serially. kernel_resident_s is the "
                      "pure device-resident fill a PCIe-attached host "
                      "would see. Placements are bit-identical to the CPU "

@@ -82,6 +82,12 @@ def main(argv=None) -> int:
                     help="pipeline scheduler ticks on the jax backend: "
                          "commit wave k under wave k+1's device transfer "
                          "(sustained-load throughput; +1 debounce latency)")
+    ap.add_argument("--scheduler-async-commit", action="store_true",
+                    help="with --scheduler-pipeline: run the commit's "
+                         "heavy half (slot materialization, add_task "
+                         "walk, store write-back) on a background "
+                         "commit plane overlapping the next wave's "
+                         "device dispatch and transfer (ops/commit.py)")
     ap.add_argument("--force-new-cluster", action="store_true",
                     help="disaster recovery: restart as a single-member "
                          "quorum keeping replicated state")
@@ -185,6 +191,7 @@ def main(argv=None) -> int:
         scheduler_backend=args.scheduler_backend,
         jax_threshold=args.jax_threshold,
         scheduler_pipeline=args.scheduler_pipeline,
+        scheduler_async_commit=args.scheduler_async_commit,
     )
     try:
         node.start()

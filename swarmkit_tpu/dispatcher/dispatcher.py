@@ -14,6 +14,7 @@ layer (swarmkit_tpu.rpc) carries the same messages across processes.
 from __future__ import annotations
 
 import logging
+import random
 import threading
 import time
 from dataclasses import dataclass, field
@@ -353,13 +354,26 @@ class Dispatcher:
         hb.start()
         return session_id
 
+    def _jittered_period(self) -> float:
+        """period − uniform(0, ε) per beat (VERDICT item 6; reference
+        DefaultHeartBeatEpsilon, dispatcher.go:29-33): 10k nodes
+        registered in a burst would otherwise beat in phase forever.
+        Jitter only ever SHORTENS the interval, so the grace window
+        (full period × multiplier) keeps its margin; reading
+        self.heartbeat_period per call keeps live reconfig applying.
+        ε is floored to half the period so tiny test periods stay
+        positive."""
+        period = self.heartbeat_period
+        return period - random.uniform(0.0, min(HEARTBEAT_EPSILON,
+                                                period / 2))
+
     def heartbeat(self, node_id: str, session_id: str) -> float:
         """reference: dispatcher.go:1317-1335. The grace window re-arms
         from the CURRENT period so live reconfig applies to existing
         sessions too (nodes.go updatePeriod)."""
         session = self._session(node_id, session_id)
         session.heartbeat.beat(self.heartbeat_period * GRACE_MULTIPLIER)
-        return self.heartbeat_period
+        return self._jittered_period()
 
     def assignments(self, node_id: str, session_id: str) -> Channel:
         """Subscribe to this node's assignment stream; the initial COMPLETE
@@ -492,11 +506,25 @@ class Dispatcher:
         """The agent confirms node-side unpublish of volumes
         (dispatcher.proto UpdateVolumeStatus): advance
         PENDING_NODE_UNPUBLISH → PENDING_UNPUBLISH so the CSI manager can
-        controller-detach (the store event wakes its reconciler)."""
+        controller-detach (the store event wakes its reconciler).
+
+        Same wire-payload threat model as update_task_status: the codec
+        rebuilds payloads without field checks, so malformed entries
+        (non-string / empty ids) are dropped per-entry here — one bad id
+        must neither crash the handler nor void the node's good
+        confirmations (ADVICE r5)."""
         from ..csi.manager import advance_node_unpublish
 
         self._session(node_id, session_id)
-        advance_node_unpublish(self.store, node_id, unpublished)
+        ok = []
+        for vid in unpublished:
+            if not isinstance(vid, str) or not vid:
+                log.warning("dropping malformed volume unpublish entry %r "
+                            "from node %s", vid, node_id)
+                continue
+            ok.append(vid)
+        if ok:
+            advance_node_unpublish(self.store, node_id, ok)
 
     def leave(self, node_id: str, session_id: str):
         """Graceful node departure."""

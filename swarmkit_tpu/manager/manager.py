@@ -65,6 +65,7 @@ class Manager:
         scheduler_backend: str = "auto",
         jax_threshold: int | None = None,
         scheduler_pipeline: bool = False,
+        scheduler_async_commit: bool = False,
         clock=None,
     ):
         self.store = store if store is not None else MemoryStore()
@@ -81,6 +82,7 @@ class Manager:
         self.scheduler_backend = scheduler_backend
         self.jax_threshold = jax_threshold
         self.scheduler_pipeline = scheduler_pipeline
+        self.scheduler_async_commit = scheduler_async_commit
         self._lock = threading.Lock()
         self._is_leader = False
         self._started = False
@@ -277,7 +279,8 @@ class Manager:
             Deallocator(self.store),
             Scheduler(self.store, backend=self.scheduler_backend,
                       jax_threshold=self.jax_threshold,
-                      pipeline=self.scheduler_pipeline),
+                      pipeline=self.scheduler_pipeline,
+                      async_commit=self.scheduler_async_commit),
             ReplicatedOrchestrator(self.store),
             GlobalOrchestrator(self.store),
             JobsOrchestrator(self.store),

@@ -25,6 +25,20 @@
  *     - otherwise: tasks dict inserts, mutations/active counters += k,
  *       exact per-node int64 resource decrements, and by-service
  *       Counter increments keyed by each task's group service id.
+ *
+ * GIL discipline (round 6, the async commit plane): these walks now run
+ * on a background commit worker overlapping the scheduler's next wave
+ * (ops/commit.py), so a single multi-ms GIL-held C call would starve
+ * the wave loop it is supposed to hide under.  Two measures:
+ *   - apply_wave's counting sort + aggregate passes touch only C
+ *     buffers and run with the GIL RELEASED;
+ *   - the object walks drop-and-reacquire the GIL between node
+ *     segments every YIELD_TASKS tasks — legal because the commit
+ *     plane's contract already guarantees nothing else touches the
+ *     wave's NodeInfos/lists until the worker barrier, and each walk
+ *     call is reentrant per call (no module-level mutable state), so
+ *     concurrent walks on DISJOINT info sets are safe
+ *     (tests/test_native_hostops.py pins both).
  */
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
@@ -32,6 +46,11 @@
 
 static PyObject *s_tasks, *s_id, *s_mutations, *s_active, *s_avail,
     *s_svccnt, *s_mem, *s_cpus;
+
+/* between-segment GIL yield cadence for the object walks: ~24 yields
+ * per 200k-task wave — enough for the wave loop to interleave, cheap
+ * enough (~1 us each) to vanish in the walk */
+#define YIELD_TASKS 8192
 
 /* obj.<attr> += delta for plain Python-int attributes. */
 static int
@@ -88,6 +107,184 @@ bump_counter(PyObject *counter, PyObject *key, long long delta)
     return 0;
 }
 
+/* ---------------------------------------------------------------- *
+ * Plain-attribute fast path.
+ *
+ * The per-node tail of the walk (mutations/active counters, the two
+ * resource decrements, the tasks/counter fetches) went through
+ * PyObject_GetAttr/SetAttr — ~11 descriptor-protocol round trips per
+ * node, which at the 100k x 10k north-star shape is HALF the walk
+ * (the per-task inserts are the other half).  NodeInfo and Resources
+ * are plain dataclasses, so the same reads/writes can go straight at
+ * the instance dict — but only when that is provably identical to
+ * attribute access: the type must use the generic tp_getattro/
+ * tp_setattro AND have no descriptor (property, slot, classvar
+ * descriptor) shadowing any touched name.  The check runs once per
+ * distinct type per call; any miss (absent key, non-int value,
+ * exotic type) falls back to the real attribute protocol, so
+ * semantics never change — tests pin bit-parity against the Python
+ * walk either way.                                                   */
+
+static int
+plain_attr(PyTypeObject *tp, PyObject *key)
+{
+    PyObject *c = _PyType_Lookup(tp, key);   /* borrowed */
+
+    return c == NULL || (Py_TYPE(c)->tp_descr_get == NULL
+                         && Py_TYPE(c)->tp_descr_set == NULL);
+}
+
+typedef struct {
+    PyTypeObject *info_tp;      /* last vetted types (1-entry caches:  */
+    int info_ok;                /* every wave's infos share one class) */
+    PyTypeObject *res_tp;
+    int res_ok;
+} FastCheck;
+
+static int
+info_fast_ok(FastCheck *fc, PyObject *info)
+{
+    PyTypeObject *tp = Py_TYPE(info);
+
+    if (fc->info_tp != tp) {
+        fc->info_tp = tp;
+        fc->info_ok = tp->tp_getattro == PyObject_GenericGetAttr
+            && tp->tp_setattro == PyObject_GenericSetAttr
+            && tp->tp_dictoffset != 0
+            && plain_attr(tp, s_tasks) && plain_attr(tp, s_mutations)
+            && plain_attr(tp, s_active) && plain_attr(tp, s_avail)
+            && plain_attr(tp, s_svccnt);
+    }
+    return fc->info_ok;
+}
+
+static int
+res_fast_ok(FastCheck *fc, PyObject *res)
+{
+    PyTypeObject *tp = Py_TYPE(res);
+
+    if (fc->res_tp != tp) {
+        fc->res_tp = tp;
+        fc->res_ok = tp->tp_getattro == PyObject_GenericGetAttr
+            && tp->tp_setattro == PyObject_GenericSetAttr
+            && tp->tp_dictoffset != 0
+            && plain_attr(tp, s_mem) && plain_attr(tp, s_cpus);
+    }
+    return fc->res_ok;
+}
+
+/* d[key] += delta for exact-int entries. 0 = done, 1 = not applicable
+ * (absent / non-int — caller takes the attribute path), -1 = error. */
+static int
+add_int_key(PyObject *d, PyObject *key, long long delta)
+{
+    PyObject *cur, *nv;
+    long long v;
+
+    if (delta == 0)
+        return 0;
+    cur = PyDict_GetItemWithError(d, key);   /* borrowed */
+    if (cur == NULL)
+        return PyErr_Occurred() ? -1 : 1;
+    if (!PyLong_CheckExact(cur))
+        return 1;
+    v = PyLong_AsLongLong(cur);
+    if (v == -1 && PyErr_Occurred())
+        return -1;
+    nv = PyLong_FromLongLong(v + delta);
+    if (nv == NULL)
+        return -1;
+    if (PyDict_SetItem(d, key, nv) < 0) {
+        Py_DECREF(nv);
+        return -1;
+    }
+    Py_DECREF(nv);
+    return 0;
+}
+
+/* info.<key> += delta via the instance dict when legal, else the
+ * attribute protocol. */
+static int
+bump_int_field(PyObject *obj, PyObject *idict, PyObject *key,
+               long long delta)
+{
+    if (idict != NULL) {
+        int rc = add_int_key(idict, key, delta);
+
+        if (rc <= 0)
+            return rc;
+    }
+    return add_int_attr(obj, key, delta);
+}
+
+/* Fetch obj.<key> — borrowed from the instance dict when possible,
+ * else a NEW reference via GetAttr; *owned says which. NULL = error
+ * or genuinely absent (error set by GetAttr). */
+static PyObject *
+fetch_field(PyObject *obj, PyObject *idict, PyObject *key, int *owned)
+{
+    if (idict != NULL) {
+        PyObject *v = PyDict_GetItemWithError(idict, key);
+
+        if (v != NULL) {
+            *owned = 0;
+            return v;
+        }
+        if (PyErr_Occurred())
+            return NULL;
+    }
+    *owned = 1;
+    return PyObject_GetAttr(obj, key);
+}
+
+/* The per-node commit tail shared by both walks: mutations/active
+ * counters += k, exact resource decrements on available_resources.
+ * Returns 0/-1. */
+/* Borrow an object's instance dict: the instance (kept alive by the
+ * caller's argument structures) owns a reference for as long as we
+ * use it — same contract as borrowed dict items. NULL = no dict /
+ * fast path not applicable. */
+static PyObject *
+borrow_instance_dict(PyObject *obj)
+{
+    PyObject *d = PyObject_GenericGetDict(obj, NULL);
+
+    if (d == NULL) {
+        PyErr_Clear();
+        return NULL;
+    }
+    Py_DECREF(d);
+    return d;
+}
+
+static int
+commit_node_counters(PyObject *info, PyObject *idict, FastCheck *fc,
+                     Py_ssize_t k, int64_t mem, int64_t cpu)
+{
+    PyObject *ar, *adict = NULL;
+    int ar_owned = 0, rc = 0;
+
+    if (bump_int_field(info, idict, s_mutations, (long long)k) < 0
+        || bump_int_field(info, idict, s_active, (long long)k) < 0)
+        return -1;
+    if (mem == 0 && cpu == 0)
+        return 0;
+    ar = fetch_field(info, idict, s_avail, &ar_owned);
+    if (ar == NULL)
+        return -1;
+    if (!ar_owned)
+        Py_INCREF(ar);  /* the attr-fallback bumps below can run user
+                         * descriptor code that could rebind the field
+                         * — never hold it borrowed across them */
+    if (res_fast_ok(fc, ar))
+        adict = borrow_instance_dict(ar);
+    if (bump_int_field(ar, adict, s_mem, -(long long)mem) < 0
+        || bump_int_field(ar, adict, s_cpus, -(long long)cpu) < 0)
+        rc = -1;
+    Py_DECREF(ar);
+    return rc;
+}
+
 /* Hand one segment to the Python per-task path (borrowed task
  * pointers); returns tasks added, or -1 with an exception set. */
 static long long
@@ -123,9 +320,11 @@ apply_segments(PyObject *self, PyObject *args)
     Py_buffer oi_b, nodes_b, bounds_b, mem_b, cpu_b, gidx_b;
     const int64_t *oi, *nodes, *bounds, *mem, *cpu, *gidx;
     Py_ssize_t n_seg, n_infos, n_tasks, n_svc, si;
+    Py_ssize_t since_yield = 0;
     long long n_added = 0;
     PyObject *ret = NULL;
     PyObject **ids = NULL;
+    FastCheck fc = {NULL, 0, NULL, 0};
 
     if (!PyArg_ParseTuple(args, "O!O!O!y*y*y*y*y*y*O!O",
                           &PyList_Type, &infos, &PyList_Type, &tasks_all,
@@ -165,8 +364,17 @@ apply_segments(PyObject *self, PyObject *args)
     for (si = 0; si < n_seg; si++) {
         int64_t a = bounds[si], b = bounds[si + 1], node;
         Py_ssize_t k = (Py_ssize_t)(b - a), m, run;
-        PyObject *info, *tdict, *counter;
-        int err = 0;
+        PyObject *info, *tdict, *counter, *idict;
+        int err = 0, owned;
+
+        since_yield += k;
+        if (since_yield >= YIELD_TASKS) {
+            /* between segments no borrowed ref is held: let the wave
+             * loop run (async commit plane overlap) */
+            since_yield = 0;
+            Py_BEGIN_ALLOW_THREADS
+            Py_END_ALLOW_THREADS
+        }
 
         if (a < 0 || b > (int64_t)n_tasks || a >= b) {
             PyErr_SetString(PyExc_ValueError,
@@ -183,9 +391,13 @@ apply_segments(PyObject *self, PyObject *args)
         if (info == Py_None)
             continue;
 
-        tdict = PyObject_GetAttr(info, s_tasks);
+        idict = info_fast_ok(&fc, info)
+            ? borrow_instance_dict(info) : NULL;
+        tdict = fetch_field(info, idict, s_tasks, &owned);
         if (tdict == NULL)
             goto done;
+        if (!owned)
+            Py_INCREF(tdict);       /* uniform DECREF on every exit */
         if (!PyDict_Check(tdict)) {
             Py_DECREF(tdict);
             PyErr_SetString(PyExc_TypeError,
@@ -279,11 +491,13 @@ apply_segments(PyObject *self, PyObject *args)
             }
         }
 
-        counter = PyObject_GetAttr(info, s_svccnt);
+        counter = fetch_field(info, idict, s_svccnt, &owned);
         if (counter == NULL) {
             Py_DECREF(tdict);
             goto done;
         }
+        if (!owned)
+            Py_INCREF(counter);
         if (!PyDict_Check(counter)) {   /* Counter is a dict subclass */
             PyErr_SetString(PyExc_TypeError,
                             "apply_segments: by-service counts not a dict");
@@ -317,21 +531,9 @@ apply_segments(PyObject *self, PyObject *args)
         if (err)
             goto done;
 
-        if (add_int_attr(info, s_mutations, (long long)k) < 0
-            || add_int_attr(info, s_active, (long long)k) < 0)
+        if (commit_node_counters(info, idict, &fc, k,
+                                 mem[node], cpu[node]) < 0)
             goto done;
-        {
-            PyObject *ar = PyObject_GetAttr(info, s_avail);
-
-            if (ar == NULL)
-                goto done;
-            if (add_int_attr(ar, s_mem, -mem[node]) < 0
-                || add_int_attr(ar, s_cpus, -cpu[node]) < 0) {
-                Py_DECREF(ar);
-                goto done;
-            }
-            Py_DECREF(ar);
-        }
         n_added += (long long)k;
     }
     ret = PyLong_FromLongLong(n_added);
@@ -467,47 +669,61 @@ apply_wave_native(PyObject *self, PyObject *args)
         goto done;
     }
 
-    /* pass 1: histogram + per-node resource aggregates */
-    for (g = 0; g < n_groups; g++) {
-        const int64_t *nv = g_nodes[g];
-        Py_ssize_t m, len = g_len[g];
-        int64_t gm = g_mem[g], gc = g_cpu[g];
-
-        for (m = 0; m < len; m++) {
-            int64_t node = nv[m];
-
-            if (node < 0 || node >= (int64_t)n_infos) {
-                PyErr_SetString(PyExc_IndexError,
-                                "apply_wave: node index out of range");
-                goto done;
-            }
-            cnt[node]++;
-            mem_acc[node] += gm;
-            cpu_acc[node] += gc;
-        }
-    }
-    /* exclusive prefix: off[n] = start of node n's segment */
+    /* passes 1+2 touch only C buffers: run them with the GIL RELEASED
+     * so the wave loop (encode/dispatch of the next wave) overlaps the
+     * sort when this call rides the async commit plane */
     {
-        int64_t acc = 0;
-        Py_ssize_t n;
+        int oob = 0;
 
-        for (n = 0; n < n_infos; n++) {
-            off[n] = acc;
-            acc += cnt[n];
+        Py_BEGIN_ALLOW_THREADS
+        /* pass 1: histogram + per-node resource aggregates */
+        for (g = 0; g < n_groups && !oob; g++) {
+            const int64_t *nv = g_nodes[g];
+            Py_ssize_t m, len = g_len[g];
+            int64_t gm = g_mem[g], gc = g_cpu[g];
+
+            for (m = 0; m < len; m++) {
+                int64_t node = nv[m];
+
+                if (node < 0 || node >= (int64_t)n_infos) {
+                    oob = 1;
+                    break;
+                }
+                cnt[node]++;
+                mem_acc[node] += gm;
+                cpu_acc[node] += gc;
+            }
         }
-    }
-    /* pass 2: stable scatter into node-major slots (group order is the
-     * concatenation order, so equal nodes keep group-stable order —
-     * exactly np.argsort(kind="stable") on the concatenated vector) */
-    for (g = 0; g < n_groups; g++) {
-        const int64_t *nv = g_nodes[g];
-        Py_ssize_t m, len = g_len[g];
+        if (!oob) {
+            /* exclusive prefix: off[n] = start of node n's segment */
+            int64_t acc = 0;
+            Py_ssize_t n;
 
-        for (m = 0; m < len; m++) {
-            int64_t s = off[nv[m]]++;
+            for (n = 0; n < n_infos; n++) {
+                off[n] = acc;
+                acc += cnt[n];
+            }
+            /* pass 2: stable scatter into node-major slots (group order
+             * is the concatenation order, so equal nodes keep group-
+             * stable order — exactly np.argsort(kind="stable") on the
+             * concatenated vector) */
+            for (g = 0; g < n_groups; g++) {
+                const int64_t *nv = g_nodes[g];
+                Py_ssize_t m, len = g_len[g];
 
-            slot_g[s] = (int32_t)g;
-            slot_m[s] = (int32_t)m;
+                for (m = 0; m < len; m++) {
+                    int64_t s = off[nv[m]]++;
+
+                    slot_g[s] = (int32_t)g;
+                    slot_m[s] = (int32_t)m;
+                }
+            }
+        }
+        Py_END_ALLOW_THREADS
+        if (oob) {
+            PyErr_SetString(PyExc_IndexError,
+                            "apply_wave: node index out of range");
+            goto done;
         }
     }
     /* off[n] is now the segment END for node n; start = off[n] - cnt[n] */
@@ -515,22 +731,36 @@ apply_wave_native(PyObject *self, PyObject *args)
     /* pass 3: per-node segment walk (same semantics as apply_segments) */
     {
         Py_ssize_t node;
+        Py_ssize_t since_yield = 0;
+        FastCheck fc = {NULL, 0, NULL, 0};
 
         for (node = 0; node < n_infos; node++) {
             int64_t k64 = cnt[node];
             Py_ssize_t a = (Py_ssize_t)(off[node] - k64), k = (Py_ssize_t)k64;
             Py_ssize_t m, run;
-            PyObject *info, *tdict, *counter;
-            int err = 0;
+            PyObject *info, *tdict, *counter, *idict;
+            int err = 0, owned;
 
             if (k == 0)
                 continue;
+            since_yield += k;
+            if (since_yield >= YIELD_TASKS) {
+                /* between segments no borrowed ref is held: let the
+                 * wave loop run (async commit plane overlap) */
+                since_yield = 0;
+                Py_BEGIN_ALLOW_THREADS
+                Py_END_ALLOW_THREADS
+            }
             info = PyList_GET_ITEM(infos, node);        /* borrowed */
             if (info == Py_None)
                 continue;
-            tdict = PyObject_GetAttr(info, s_tasks);
+            idict = info_fast_ok(&fc, info)
+                ? borrow_instance_dict(info) : NULL;
+            tdict = fetch_field(info, idict, s_tasks, &owned);
             if (tdict == NULL)
                 goto done;
+            if (!owned)
+                Py_INCREF(tdict);   /* uniform DECREF on every exit */
             if (!PyDict_Check(tdict)) {
                 Py_DECREF(tdict);
                 PyErr_SetString(PyExc_TypeError,
@@ -594,11 +824,13 @@ apply_wave_native(PyObject *self, PyObject *args)
                 }
             }
 
-            counter = PyObject_GetAttr(info, s_svccnt);
+            counter = fetch_field(info, idict, s_svccnt, &owned);
             if (counter == NULL) {
                 Py_DECREF(tdict);
                 goto done;
             }
+            if (!owned)
+                Py_INCREF(counter);
             if (!PyDict_Check(counter)) {
                 PyErr_SetString(
                     PyExc_TypeError,
@@ -622,21 +854,9 @@ apply_wave_native(PyObject *self, PyObject *args)
             if (err)
                 goto done;
 
-            if (add_int_attr(info, s_mutations, (long long)k) < 0
-                || add_int_attr(info, s_active, (long long)k) < 0)
+            if (commit_node_counters(info, idict, &fc, k,
+                                     mem_acc[node], cpu_acc[node]) < 0)
                 goto done;
-            {
-                PyObject *ar = PyObject_GetAttr(info, s_avail);
-
-                if (ar == NULL)
-                    goto done;
-                if (add_int_attr(ar, s_mem, -mem_acc[node]) < 0
-                    || add_int_attr(ar, s_cpus, -cpu_acc[node]) < 0) {
-                    Py_DECREF(ar);
-                    goto done;
-                }
-                Py_DECREF(ar);
-            }
             n_added += (long long)k;
         }
     }

@@ -236,6 +236,7 @@ class SwarmNode:
         scheduler_backend: str = "auto",
         jax_threshold: int | None = None,
         scheduler_pipeline: bool = False,
+        scheduler_async_commit: bool = False,
         clock=None,
     ):
         self.state_dir = state_dir
@@ -263,6 +264,7 @@ class SwarmNode:
         self.scheduler_backend = scheduler_backend
         self.jax_threshold = jax_threshold
         self.scheduler_pipeline = scheduler_pipeline
+        self.scheduler_async_commit = scheduler_async_commit
         from ..utils.clock import REAL_CLOCK
         self.clock = clock or REAL_CLOCK
         self._identity_lock = threading.Lock()
@@ -740,6 +742,7 @@ class SwarmNode:
             scheduler_backend=self.scheduler_backend,
             jax_threshold=self.jax_threshold,
             scheduler_pipeline=self.scheduler_pipeline,
+            scheduler_async_commit=self.scheduler_async_commit,
             clock=self.clock,
         )
         build_manager_registry(self.manager, raft,

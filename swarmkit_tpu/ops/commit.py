@@ -1,0 +1,183 @@
+"""Asynchronous commit plane: one background worker for the heavy half
+of a scheduler wave commit.
+
+The round-5 verdict's top item: the TPU water-fill tick is ~11x the CPU
+oracle, but the end-to-end wave sits at ~3.4x because the host-side
+commit — slot materialization, the native add_task segment walk, store
+write-back, fingerprint restamp — runs serially inside every wave
+period.  None of that work is needed by the NEXT wave's encode/dispatch;
+it only has to be finished before anything re-READS host scheduling
+state (the encoder's dirty scan, NodeInfo objects, the store's view of
+the unassigned pool).  So the commit splits:
+
+  * synchronous half (stays on the wave loop, ops/pipeline.py):
+    `fold_counts` before the next encode, `after_apply` correction
+    bookkeeping before the next dispatch — the two pieces placement
+    parity depends on;
+  * heavy half (this worker): materialize_orders + the one-add_task-per-
+    placement walk + store transaction + `restamp_counts`, enqueued
+    FIFO and overlapped with the next wave's device dispatch and D2H
+    pull (the pull's blocking transfer wait releases the GIL, which is
+    exactly when this thread runs).
+
+This is the same overlap discipline a training step uses to hide
+optimizer/host work under device dispatch; the reference scheduler pays
+the equivalent walk synchronously in applySchedulingDecisions
+(manager/scheduler/scheduler.go:490-643).
+
+Discipline (the invariant CLAUDE.md records):
+
+  * ONE worker thread, bounded queue, strict FIFO — wave k's heavy
+    commit fully precedes wave k+1's;
+  * every consumer of host scheduling state takes `barrier()` first.
+    In TickPipeline that is the top of every tick (before the dirty
+    scan) and every drain trigger; in the production Scheduler it is
+    additionally the event handler and the stop path;
+  * a worker-side exception NEVER dies with the thread (the test
+    harness turns unhandled thread crashes into failures): it is
+    captured, the queue is poisoned (queued jobs are dropped — they
+    were built on state the failed commit left undefined), and the
+    exception re-raises on the next barrier/submit, i.e. into the next
+    tick, whose caller owns the heal (resident invalidate + re-encode).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+# a commit plane never needs depth beyond the tick pipeline's (the
+# barrier at each tick keeps at most one wave's heavy half in flight
+# per pipeline slot); the bound exists so a driver bug fails loudly
+# instead of queueing unbounded closures
+DEFAULT_MAX_PENDING = 8
+
+
+class CommitWorker:
+    """Single background thread running submitted thunks FIFO.
+
+    submit() enqueues; barrier() blocks until everything submitted so
+    far has retired, then re-raises the first worker exception if one
+    occurred.  Exceptions poison the worker: jobs queued behind the
+    failure are dropped unrun (their input state is undefined), and
+    every subsequent submit()/barrier() re-raises until the owner heals
+    and calls `reset()`.
+    """
+
+    def __init__(self, name: str = "commit-worker",
+                 max_pending: int = DEFAULT_MAX_PENDING):
+        self.name = name
+        self.max_pending = max_pending
+        self._jobs: deque[Callable[[], None]] = deque()
+        self._cond = threading.Condition()
+        self._pending = 0            # submitted, not yet retired
+        self._exc: BaseException | None = None
+        self._thread: threading.Thread | None = None
+        self._closed = False
+        # observability (bench): seconds the worker spent inside jobs,
+        # and per-job durations in retirement (= submission) order
+        self.busy_s = 0.0
+        self.job_s: list[float] = []
+
+    # ---------------------------------------------------------------- thread
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name=self.name, daemon=True)
+            self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while not self._jobs and not self._closed:
+                    self._cond.wait()
+                if self._closed and not self._jobs:
+                    return
+                job = self._jobs.popleft()
+            t0 = time.perf_counter()
+            try:
+                job()
+            except BaseException as exc:  # noqa: BLE001 — must not kill
+                # the thread (the harness fails the suite on unhandled
+                # thread crashes); captured for the next barrier instead
+                with self._cond:
+                    if self._exc is None:
+                        self._exc = exc
+                    # poison: queued jobs were built on state this
+                    # failed commit left undefined — drop, don't run
+                    n = len(self._jobs)
+                    self._jobs.clear()
+                    self._pending -= n
+            finally:
+                dt = time.perf_counter() - t0
+                with self._cond:
+                    self.busy_s += dt
+                    # observability ring, same rationale as
+                    # TickPipeline.timings: a production daemon's worker
+                    # lives for the scheduler's lifetime and must not
+                    # accumulate one float per wave forever (consumers
+                    # indexing job_s by wave — the bench — read it well
+                    # before the first trim)
+                    if len(self.job_s) >= 4096:
+                        del self.job_s[:2048]
+                    self.job_s.append(dt)
+                    self._pending -= 1
+                    self._cond.notify_all()
+
+    # ------------------------------------------------------------------- API
+    @property
+    def failed(self) -> bool:
+        return self._exc is not None
+
+    def _raise_pending(self):
+        exc = self._exc
+        if exc is not None:
+            raise exc
+
+    def submit(self, job: Callable[[], None]):
+        """Enqueue `job` (FIFO). Raises the pending worker exception
+        first, if any — a failed plane refuses new work until reset()."""
+        with self._cond:
+            self._raise_pending()
+            if self._closed:
+                raise RuntimeError(f"{self.name}: submit after close")
+            while self._pending >= self.max_pending and self._exc is None:
+                self._cond.wait()
+            self._raise_pending()
+            self._pending += 1
+            self._jobs.append(job)
+            self._cond.notify_all()
+        self._ensure_thread()
+
+    def barrier(self):
+        """Block until every submitted job retired; re-raise the first
+        worker exception. After an exception the plane stays poisoned
+        (subsequent barriers keep raising) until reset()."""
+        with self._cond:
+            while self._pending > 0:
+                self._cond.wait()
+            self._raise_pending()
+
+    def reset(self):
+        """Clear a captured exception after the owner healed (resident
+        invalidate + re-encode). Any still-queued jobs were already
+        dropped by the poison path."""
+        with self._cond:
+            self._exc = None
+
+    def close(self):
+        """Drain and stop the thread (idempotent). Does NOT raise a
+        pending exception — close runs on teardown paths that must not
+        mask the original failure; call barrier() first if you need it."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=10)
+
+    @property
+    def idle(self) -> bool:
+        with self._cond:
+            return self._pending == 0

@@ -40,17 +40,43 @@ handled here:
     (row numbering is only stable once a fold allocates it), or a
     fold_problem shape mismatch.
 
-Placements stay bit-identical to the CPU oracle at every depth
-(tests/test_pipeline.py fuzzes depth ∈ {1, 2, 3} against the serial
-path; bench.py exercises it at scale).
+Round 6 — the ASYNC COMMIT PLANE (`async_commit=True`): even fully
+pipelined, the commit's heavy half (slot materialization, the native
+add_task segment walk, store write-back, fingerprint restamp) still ran
+serially inside every wave period — round-5 bench: ~3/4 of the e2e wave
+at the north-star shape. None of it is needed by the NEXT wave's
+encode/dispatch, so it moves to ONE background CommitWorker
+(ops/commit.py), strict FIFO, and overlaps the next wave's device
+dispatch and D2H pull (the blocking pull wait releases the GIL — that
+is exactly when the worker runs). What stays synchronous is exactly
+what the invariants above require:
+
+  * `fold_counts` + `after_apply` run on the wave loop at completion,
+    BEFORE the next encode/dispatch (parity depends on the correction
+    rows being known before anything else ships);
+  * every tick takes a worker BARRIER before the dirty scan
+    (`nodes_clean`), so the deferred add_task/restamp of wave k is
+    fully retired before any fingerprint is read — and therefore
+    before every drain trigger (external mutations, pending correction
+    rows, hypo rows, resident signature change), all of which are
+    evaluated post-barrier;
+  * the wave's heavy half is enqueued only AFTER this tick's
+    encode+dispatch returned, so the encoder is never read mid-walk;
+  * a worker exception re-raises out of the NEXT tick's barrier (never
+    dies with the thread); the caller owns the heal.
+
+Placements stay bit-identical to the CPU oracle at every depth and in
+both commit modes (tests/test_pipeline.py fuzzes depth ∈ {1, 2, 3} and
+async against the serial path; bench.py exercises both at scale).
 
 Reference hot loop this beats: manager/scheduler/scheduler.go:694-921 —
 its commit (`applySchedulingDecisions`) is synchronous with the next
 scheduling pass; here the commit and D-1 further whole waves ARE the
-transfer window.
+transfer window, and the commit itself rides a background plane.
 """
 from __future__ import annotations
 
+import functools
 import time
 from collections import deque
 from typing import Callable
@@ -62,6 +88,7 @@ from ..scheduler.encode import (
     IncrementalEncoder,
     fold_problem,
 )
+from .commit import CommitWorker
 from .resident import PendingCounts, ResidentPlacement
 
 
@@ -73,16 +100,29 @@ class TickPipeline:
     whatever store writes the caller needs; the pipeline brackets it
     with fold_counts (before the encoder next re-reads those arrays) and
     restamp_counts (after).
+
+    async_commit=True runs commit_cb + restamp on a single background
+    CommitWorker (FIFO, barriered at the top of every tick and on every
+    drain) — commit_cb must then touch ONLY state that nothing else
+    reads between the enqueue and the next barrier (NodeInfo objects,
+    the store, the encoder's fingerprint stamp). The contract cuts both
+    ways: a CALLER that mutates NodeInfos between ticks (node churn,
+    external add_task) must call barrier() FIRST — tick()'s own barrier
+    runs before its dirty scan, but by then an inter-tick mutation
+    would already have raced the riding walk. The production Scheduler
+    honors this via _drain_commit_plane in its event handler.
     """
 
     def __init__(self, encoder: IncrementalEncoder,
                  resident: ResidentPlacement,
                  commit_cb: Callable[[EncodedProblem, np.ndarray], None],
-                 depth: int = 1):
+                 depth: int = 1, async_commit: bool = False):
         self.encoder = encoder
         self.resident = resident
         self.commit_cb = commit_cb
         self.depth = max(1, depth)
+        self.worker = CommitWorker(name="tick-commit") if async_commit \
+            else None
         # (problem, handle, n_pending): n_pending = how many dispatched-
         # but-unfolded waves preceded this one at its encode time
         self._inflight: deque[tuple] = deque()
@@ -91,15 +131,21 @@ class TickPipeline:
         self.timings: list[dict] = []      # per-wave phase seconds (bench)
 
     # ------------------------------------------------------------------ steps
-    def _complete(self) -> tuple[EncodedProblem, np.ndarray, dict] | None:
-        """Pull + problem-fold + encoder-fold the OLDEST in-flight wave;
-        commit stays with the caller."""
-        if not self._inflight:
-            return None
+    def _pull_oldest(self) -> tuple:
+        """Pop + pull the oldest in-flight wave WITHOUT folding it.
+        In async mode this runs BEFORE the commit barrier: the blocking
+        transfer wait releases the GIL, so the worker's in-flight heavy
+        commit executes under it — the plane's core overlap."""
         p, h, n_pending = self._inflight.popleft()
         t0 = time.perf_counter()
         counts = h.get()
-        pull_s = time.perf_counter() - t0
+        return p, counts, n_pending, time.perf_counter() - t0
+
+    def _fold_pulled(self, p: EncodedProblem, counts: np.ndarray,
+                     n_pending: int) -> float:
+        """The completion's synchronous half: problem-fold (deep pipe),
+        encoder array fold, correction-row bookkeeping. Must precede the
+        next encode(); in async mode it runs post-barrier."""
         t0 = time.perf_counter()
         if n_pending:
             # bring the emitted problem up to the device's view: fold the
@@ -116,14 +162,40 @@ class TickPipeline:
             self.resident.invalidate()
         self.resident.after_apply(p, counts)
         self._recent.append((p, counts))
-        fold_s = time.perf_counter() - t0
+        return time.perf_counter() - t0
+
+    def _complete(self) -> tuple[EncodedProblem, np.ndarray, dict] | None:
+        """Pull + problem-fold + encoder-fold the OLDEST in-flight wave;
+        commit stays with the caller."""
+        if not self._inflight:
+            return None
+        p, counts, n_pending, pull_s = self._pull_oldest()
+        fold_s = self._fold_pulled(p, counts, n_pending)
         return p, counts, {"pull_s": pull_s, "fold_s": fold_s}
+
+    def _heavy(self, p: EncodedProblem, counts: np.ndarray) -> None:
+        """The commit's heavy half: caller's add_task/store work, then
+        the fingerprint restamp. Runs inline (sync mode, drains) or on
+        the CommitWorker (async mode)."""
+        self.commit_cb(p, counts)
+        self.encoder.restamp_counts(p, counts)
 
     def _commit(self, p: EncodedProblem, counts: np.ndarray) -> float:
         t0 = time.perf_counter()
-        self.commit_cb(p, counts)
-        self.encoder.restamp_counts(p, counts)
+        self._heavy(p, counts)
         return time.perf_counter() - t0
+
+    def _barrier(self, timing: dict | None = None) -> None:
+        """Retire every enqueued heavy commit (async mode). Worker
+        exceptions re-raise HERE — i.e. into the next tick."""
+        if self.worker is None or self.worker.idle:
+            if self.worker is not None:
+                self.worker.barrier()     # raises a captured exception
+            return
+        t0 = time.perf_counter()
+        self.worker.barrier()
+        if timing is not None:
+            timing["barrier_s"] += time.perf_counter() - t0
 
     def _hazards(self) -> bool:
         """True when dispatching another wave PAST the current in-flight
@@ -141,25 +213,64 @@ class TickPipeline:
         """Dispatch one wave; completes (commits) the oldest in-flight
         wave once the pipe is `depth` deep. Returns the waves completed
         by this call — empty while the pipe is filling, one in steady
-        state, up to `depth` on a drain."""
+        state, up to `depth` on a drain. In async mode a returned wave's
+        heavy commit may still be riding the worker; it is retired by
+        the next tick's barrier (or flush())."""
         t_wave = time.perf_counter()
         completed: list[tuple] = []
-        timing = {"pull_s": 0.0, "fold_s": 0.0}
+        timing = {"pull_s": 0.0, "fold_s": 0.0, "barrier_s": 0.0}
         # a completed-but-not-yet-committed wave (commits must stay FIFO
         # and must NEVER be dropped: fold_counts already ran for it)
         deferred: tuple | None = None
+        # async mode: pulled-but-not-yet-folded oldest wave
+        pulled: tuple | None = None
 
-        def commit_deferred():
+        if self.worker is not None:
+            if len(self._inflight) >= self.depth:
+                p0, c0, np0, pull_s = self._pull_oldest()
+                timing["pull_s"] += pull_s
+                pulled = (p0, c0, np0)
+            # barrier BEFORE any host-state read: the previous waves'
+            # add_task/restamp must be fully retired before the dirty
+            # scan below (and before every drain trigger). Worker
+            # exceptions propagate into this tick here.
+            self._barrier(timing)
+
+        def finish_pulled():
+            nonlocal pulled
+            if pulled is None:
+                return None
+            p, c, n_p = pulled
+            pulled = None
+            timing["fold_s"] += self._fold_pulled(p, c, n_p)
+            completed.append((p, c))
+            return (p, c)
+
+        def commit_deferred(sync: bool = False):
+            # sync=True (drains, serial fallback): the heavy half must
+            # complete before this tick reads/ships node state again.
+            # sync=False (steady async): enqueue; the NEXT tick's
+            # barrier retires it.
             nonlocal deferred
-            if deferred is not None:
+            if deferred is None:
+                return
+            p, c = deferred
+            deferred = None
+            if self.worker is not None and not sync:
+                self.worker.submit(functools.partial(self._heavy, p, c))
+            else:
                 timing["commit_s"] = (timing.get("commit_s", 0.0)
-                                      + self._commit(*deferred))
-                deferred = None
+                                      + self._commit(p, c))
 
         def drain_serial():
-            # the ONE drain sequence every trigger uses: any deferred
-            # commit first (FIFO), then complete+commit everything left
-            commit_deferred()
+            # the ONE drain sequence every trigger uses, always post-
+            # barrier: any deferred/pulled wave first (FIFO — it is the
+            # oldest), then complete+commit everything left, inline
+            commit_deferred(sync=True)
+            done = finish_pulled()
+            if done is not None:
+                timing["commit_s"] = (timing.get("commit_s", 0.0)
+                                      + self._commit(*done))
             while self._inflight:
                 done = self._complete()
                 timing["pull_s"] += done[2]["pull_s"]
@@ -170,14 +281,17 @@ class TickPipeline:
 
         # external node mutations: drain fully so dirty rows re-encode
         # from infos that already include every wave's tasks
-        serial = bool(self._inflight) \
+        serial = bool(self._inflight or pulled) \
             and not self.encoder.nodes_clean(infos)
         if serial:
             drain_serial()
         else:
-            if len(self._inflight) >= self.depth:
+            if pulled is not None:
+                deferred = finish_pulled()
+            elif len(self._inflight) >= self.depth:
                 done = self._complete()
-                timing.update(done[2])
+                timing["pull_s"] += done[2]["pull_s"]
+                timing["fold_s"] += done[2]["fold_s"]
                 completed.append((done[0], done[1]))
                 deferred = completed[-1]
             # hazards may have been CREATED by that completion (e.g.
@@ -205,6 +319,8 @@ class TickPipeline:
         timing["dispatch_s"] = time.perf_counter() - t0
         self._inflight.append((p, h, len(self._inflight)))
 
+        # steady async: the heavy half goes to the worker ONLY now, after
+        # encode+dispatch stopped reading host state for this tick
         commit_deferred()
         timing["serial_fallback"] = serial
         timing["wall_s"] = time.perf_counter() - t_wave
@@ -220,15 +336,32 @@ class TickPipeline:
 
     def flush(self) -> list[tuple[EncodedProblem, np.ndarray]]:
         """Complete and commit every in-flight wave (pipeline drain),
-        oldest first; one timings entry per completed wave."""
+        oldest first; one timings entry per completed wave. In async
+        mode the worker is barriered first, so on return NOTHING rides
+        the plane (worker exceptions re-raise here)."""
+        self._barrier()
         out = []
         while self._inflight:
             p, counts, timing = self._complete()
             timing["commit_s"] = self._commit(p, counts)
             timing["serial_fallback"] = False
+            timing["barrier_s"] = 0.0
             timing["encode_s"] = timing["dispatch_s"] = 0.0
             timing["wall_s"] = timing["pull_s"] + timing["fold_s"] \
                 + timing["commit_s"]
             self._record(timing)
             out.append((p, counts))
         return out
+
+    def barrier(self) -> None:
+        """Public commit barrier: callers MUST take it before mutating
+        any NodeInfo between ticks in async mode (the riding heavy
+        commit walks those same objects). No-op in sync mode; worker
+        exceptions re-raise here."""
+        self._barrier()
+
+    def close(self) -> None:
+        """Stop the commit worker thread (async mode; idempotent). Does
+        not flush — call flush() first on an orderly shutdown."""
+        if self.worker is not None:
+            self.worker.close()

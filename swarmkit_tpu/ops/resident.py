@@ -198,6 +198,18 @@ class ResidentPlacement:
         counts = rp.schedule(problem)          # problem from enc.encode()
         ... scheduler applies, enc.apply_counts(problem, counts) ...
         rp.after_apply(problem, counts)        # or rp.invalidate()
+
+    Thread discipline under the async commit plane (ops/commit.py):
+    `after_apply` belongs to the commit's SYNCHRONOUS half — it must run
+    on the wave loop at fold time, before the next dispatch, because the
+    correction rows it queues are what keeps the next wave's emitted
+    problem bit-identical to the device's carry (parity would silently
+    break if they trailed a dispatch). The resulting `pending_rows`
+    UPLOAD then rides the worker's completion: every dispatch happens
+    post-barrier, so a queued correction can never ship while the heavy
+    half of the wave that produced it is still in flight. `invalidate`
+    is the one method the worker may call (a bare stale-flag set); all
+    other mutation stays on the wave loop.
     """
 
     def __init__(self, encoder: IncrementalEncoder, mesh=None):

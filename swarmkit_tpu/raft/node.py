@@ -422,6 +422,13 @@ class RaftNode:
         self.leader_id = None
         self.election_elapsed = 0
         self._randomized_timeout = self._next_timeout()
+        # stale real votes from a PRIOR campaign at this term must not
+        # survive into a pre-campaign: a delayed VoteResponse grant
+        # passes the non-pre vote_resp gate (role==CANDIDATE, term
+        # match) and could elect a pre-candidate without any pre-quorum
+        # — leadership is only reachable via _real_campaign, which
+        # re-seeds votes with the self-vote (ADVICE r5)
+        self.votes = set()
 
     def _pre_campaign(self):
         self._enter_candidacy()

@@ -20,6 +20,7 @@ to task status on failure (:923-968).
 """
 from __future__ import annotations
 
+import functools
 import logging
 import threading
 import time
@@ -69,7 +70,7 @@ COLD_CPU_NODES = 8_192
 class Scheduler:
     def __init__(self, store: MemoryStore, backend: str = "auto",
                  jax_threshold: int | None = None, pipeline: bool = False,
-                 mesh=None):
+                 mesh=None, async_commit: bool = False):
         """backend: "auto" picks per tick by task×node product against
         `jax_threshold` (default JAX_THRESHOLD); "cpu"/"jax" pin the path;
         "mesh" pins the jax path AND shards the device-resident node state
@@ -90,7 +91,16 @@ class Scheduler:
         stops paying the blocking device pull. Commit conflicts (tasks
         raced/deleted, nodes gone) abandon the optimistic fold: the
         resident carry invalidates and fingerprint deltas re-encode the
-        touched rows — the same self-healing the serial path uses."""
+        touched rows — the same self-healing the serial path uses.
+
+        async_commit=True (pipelined jax path only) moves the commit's
+        heavy half — slot materialization, the add_task walk, the store
+        transaction, the fingerprint restamp — onto one background
+        CommitWorker (ops/commit.py), overlapping it with the next
+        wave's device dispatch and D2H pull. Every reader of scheduler
+        host state (the event handler, the serial tick path, stop)
+        takes a worker barrier first; a worker exception re-raises into
+        the next tick, whose existing failure handler owns the heal."""
         self.store = store
         self.backend = backend
         self.mesh = mesh
@@ -98,6 +108,32 @@ class Scheduler:
             (PIPELINED_JAX_THRESHOLD if pipeline else JAX_THRESHOLD)
             if jax_threshold is None else jax_threshold)
         self.pipeline = pipeline
+        if async_commit and pipeline:
+            from ..ops.commit import CommitWorker
+
+            self._commit_worker = CommitWorker(name="sched-commit")
+        else:
+            if async_commit:
+                # the commit plane only exists on the pipelined path —
+                # dropping the flag silently would let an operator
+                # believe async commit engaged when it never could
+                log.warning("scheduler: async_commit requires "
+                            "pipeline=True (--scheduler-pipeline); "
+                            "running synchronous commits")
+            self._commit_worker = None
+        # set by the worker when an async commit came back unclean:
+        # (problem, counts) awaiting the main-thread heal at the next
+        # barrier (force_numeric_reencode + resident invalidate +
+        # discard of any dispatch primed on the lying fold)
+        self._worker_unclean = None
+        # conflicted decisions in the LAST commit (in-tx re-validation
+        # rejected a placement: node no longer READY / volume choose
+        # failed). Conflicts rely on "node/task events retrigger the
+        # tick" — but a wave committed BEHIND the async plane may
+        # conflict on an event the run loop consumed while the wave was
+        # in flight, so the completing tick must retry the pool itself
+        # (see _tick_pipelined's gate bypass)
+        self._last_commit_conflicts = 0
         # (problem, PendingCounts, frozenset of in-flight task ids)
         self._inflight = None
         self.node_infos: dict[str, NodeInfo] = {}
@@ -129,6 +165,65 @@ class Scheduler:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5)
+        if self._commit_worker is not None:
+            self._commit_worker.close()
+
+    # ------------------------------------------------------ async commit plane
+    def _drain_commit_plane(self, swallow: bool = False):
+        """Barrier on the async heavy commit before any read/mutation of
+        scheduler host state (node_infos, encoder fingerprints, the
+        unassigned pool, volume_set), then run the pending unclean heal.
+        swallow=True (event-handler path): a worker exception must not
+        crash the run loop here — the worker stays poisoned and the next
+        tick's barrier re-raises it into the guarded tick path."""
+        w = self._commit_worker
+        if w is None:
+            return
+        try:
+            w.barrier()
+        except Exception:
+            if not swallow:
+                raise
+        if self._worker_unclean is not None:
+            self._heal_unclean()
+
+    def _heal_unclean(self):
+        """Main-thread half of the async unclean-commit heal (same
+        semantics as the sync path's inline heal): poison the placed-on
+        rows so the next encode re-derives them from the NodeInfo
+        objects, resync the device, and discard any dispatch primed on
+        the bad fold."""
+        problem, counts = self._worker_unclean
+        self._worker_unclean = None
+        self.encoder.force_numeric_reencode(
+            np.flatnonzero(counts.sum(axis=0)))
+        if self._resident is not None:
+            self._resident.invalidate()
+        if self._inflight is not None:
+            _p2, h2, _ids2 = self._inflight
+            self._inflight = None
+            try:
+                h2.get()
+            except Exception:
+                # the dispatch is being DISCARDED and the resident carry
+                # was just invalidated — a device/tunnel error pulling a
+                # wave we won't use must not escape (this heal also runs
+                # on the event-drain path, which has no retry handler)
+                log.warning("discarding in-flight wave: counts pull "
+                            "failed", exc_info=True)
+
+    def _commit_heavy(self, problem, counts):
+        """The commit's heavy half, run on the CommitWorker: slot
+        materialization, store write-back with in-tx re-validation, the
+        wave-bulk add_task walk, and the fingerprint restamp. An unclean
+        outcome is recorded for the next barrier's main-thread heal."""
+        orders = materialize_orders(problem, counts)
+        clean = self._apply_decisions(problem, orders, counts,
+                                      deferred_fold=True)
+        if clean:
+            self.encoder.restamp_counts(problem, counts)
+        else:
+            self._worker_unclean = (problem, counts)
 
     # ------------------------------------------------------------------ init
     def _setup(self):
@@ -184,6 +279,9 @@ class Scheduler:
     # ---------------------------------------------------------------- events
     def _handle(self, ev) -> bool:
         """Returns True when the event makes a tick necessary."""
+        # event handling mutates node_infos / volume_set / the pools —
+        # the async heavy commit must be fully retired first
+        self._drain_commit_plane(swallow=True)
         if isinstance(ev, (EventCreate, EventUpdate)) and isinstance(ev.obj, Task):
             t = ev.obj
             if (t.status.state == TaskState.PENDING
@@ -298,6 +396,14 @@ class Scheduler:
                             # the device carry may have folded a tick the
                             # host never applied: resync from host state
                             self._resident.invalidate()
+                        if self._commit_worker is not None:
+                            # a worker exception propagated into this
+                            # tick; the invalidate above plus the event-
+                            # plane's ASSIGNED echoes heal the partial
+                            # commit — un-poison the plane for the retry
+                            self._commit_worker.reset()
+                            if self._worker_unclean is not None:
+                                self._heal_unclean()
                         from ..utils.leadership import leadership_lost
 
                         if leadership_lost(exc):
@@ -325,6 +431,10 @@ class Scheduler:
         if self._inflight is not None:
             self._tick_pipelined()
             return
+        # the serial path reads and mutates host state end to end:
+        # retire any heavy commit still riding the async plane first
+        # (worker exceptions re-raise here, into the guarded tick)
+        self._drain_commit_plane()
         if self.preassigned:
             self._process_preassigned()
         self._schedule_backlog()
@@ -403,22 +513,51 @@ class Scheduler:
                     and total_tasks * max(len(problem.node_ids), 1)
                     >= self.jax_threshold))
 
-    def _tick_pipelined(self):
+    def _tick_pipelined(self, allow_retry: bool = True):
         """Complete the in-flight wave and keep the pipeline primed: pull
         counts, fold (optimistically), dispatch the NEXT wave, then commit
         the completed one under the new wave's transfer (ops/pipeline.py
         order). An unclean commit abandons both the fold and any stale
-        next dispatch — fingerprint deltas re-encode the touched rows."""
+        next dispatch — fingerprint deltas re-encode the touched rows.
+
+        allow_retry=False (flush/stop path): a conflicted or discarded
+        wave is NOT re-attempted, so the drain terminates instead of
+        dispatching fresh waves forever."""
         problem, h, prev_ids = self._inflight
         self._inflight = None
-        if self.preassigned:
-            # preassigned (global-service) tasks never touch the encoded
-            # problem; under sustained pipelined load this is their only
-            # slot (the serial path's call is short-circuited). Their
-            # add_task bumps flip nodes_clean, which correctly forces the
-            # touched rows to re-encode before the next dispatch.
-            self._process_preassigned()
-        counts = h.get()
+        worker = self._commit_worker
+        if worker is not None:
+            # async plane: pull FIRST — the blocking transfer wait
+            # releases the GIL, which is when the previous wave's heavy
+            # commit runs — then barrier before any host-state read.
+            counts = h.get()
+            worker.barrier()        # worker exceptions re-raise here
+            if self._worker_unclean is not None:
+                # the PREVIOUS wave's commit was unclean, and THIS wave
+                # was primed on its lying fold: heal (poison + resident
+                # resync) and discard this wave un-folded — its tasks
+                # are still in the unassigned pool, so attempt them
+                # fresh against the healed state (no pool-changed gate:
+                # a discarded wave was never attempted, so going idle
+                # here would wedge it)
+                self._heal_unclean()
+                if self.preassigned:
+                    self._process_preassigned()
+                if allow_retry and self.unassigned:
+                    self._schedule_backlog()
+                return
+            if self.preassigned:
+                self._process_preassigned()
+        else:
+            if self.preassigned:
+                # preassigned (global-service) tasks never touch the
+                # encoded problem; under sustained pipelined load this
+                # is their only slot (the serial path's call is short-
+                # circuited). Their add_task bumps flip nodes_clean,
+                # which correctly forces the touched rows to re-encode
+                # before the next dispatch.
+                self._process_preassigned()
+            counts = h.get()
         folded = self.encoder.fold_counts(problem, counts)
         if folded:
             self._resident.after_apply(problem, counts)
@@ -446,6 +585,35 @@ class Scheduler:
                         t.id for g in next_groups for t in g.tasks)
                     self._inflight = (p_next, h_next, ids)
 
+        if worker is not None and folded:
+            # heavy half rides the commit plane: materialization, store
+            # write-back, the add_task walk, the restamp — retired by
+            # the next barrier; an unclean outcome heals there too.
+            # Enqueued only now, after this tick's encode/dispatch
+            # stopped reading host state.
+            worker.submit(functools.partial(
+                self._commit_heavy, problem, counts))
+            if self._inflight is None and self.unassigned:
+                # nothing primed: the backlog must be attempted NOW
+                # (wedge avoidance, same as the sync path below) — and
+                # that reads the pool the worker is mutating, so retire
+                # the commit first (rare when load is sustained; the
+                # primed case above keeps the overlap)
+                self._drain_commit_plane()
+                if allow_retry and (
+                        frozenset(self.unassigned) != prev_ids
+                        or self._last_commit_conflicts):
+                    # conflict bypass of the pool-changed gate: the
+                    # commit ran BEHIND the plane, so the store write
+                    # that conflicted it may already have been consumed
+                    # by the event loop mid-flight — with no event left
+                    # to retrigger, an identical pool would wedge. One
+                    # immediate retry runs against node_infos that
+                    # already include that write; a repeat conflict
+                    # implies a FRESH store divergence whose event is
+                    # still queued to wake the loop.
+                    self._schedule_backlog()
+            return
         orders = materialize_orders(problem, counts)
         clean = self._apply_decisions(problem, orders, counts,
                                       deferred_fold=True)
@@ -465,8 +633,9 @@ class Scheduler:
                 _p2, h2, _ids2 = self._inflight
                 self._inflight = None
                 h2.get()
-        if (self._inflight is None and self.unassigned
-                and frozenset(self.unassigned) != prev_ids):
+        if (self._inflight is None and self.unassigned and allow_retry
+                and (frozenset(self.unassigned) != prev_ids
+                     or self._last_commit_conflicts)):
             # nothing primed (dirty nodes, CPU-shaped wave, unclean heal,
             # or the backlog arrived after the prime check): schedule it
             # NOW — leaving it for a future event would wedge a backlog
@@ -480,9 +649,11 @@ class Scheduler:
             self._schedule_backlog()
 
     def flush_pipeline(self):
-        """Complete any in-flight wave now (stop/leadership-loss path)."""
+        """Complete any in-flight wave now (stop/leadership-loss path);
+        in async mode also retire the last heavy commit."""
         while self._inflight is not None:
-            self._tick_pipelined()
+            self._tick_pipelined(allow_retry=False)
+        self._drain_commit_plane()
 
     def _group_unassigned(self, exclude: frozenset | None = None,
                           ) -> list[TaskGroup]:
@@ -523,6 +694,7 @@ class Scheduler:
         # conflicted decisions are NOT dropped and retry next tick
         drop: list[str] = []
         unplaced: list[tuple[Task, TaskGroup]] = []
+        conflicts = [0]
 
         node_ids = problem.node_ids
 
@@ -552,7 +724,8 @@ class Scheduler:
                             return
                         node = tx.get_node(node_id)
                         if node is None or node.status.state != NodeStatusState.READY:
-                            return  # conflicted: retry next tick
+                            conflicts[0] += 1
+                            return  # conflicted: retried (see below)
                         cur = cur.copy()
                         # CSI volumes chosen at commit time, with the
                         # reservation re-check the reference does in-tx
@@ -561,7 +734,8 @@ class Scheduler:
                         if task_csi_mounts(cur):
                             chosen = self.volume_set.choose_task_volumes(cur, node)
                             if chosen is None:
-                                return  # conflicted: retry next tick
+                                conflicts[0] += 1
+                                return  # conflicted: retried (see below)
                             cur.volumes = chosen
                         cur.node_id = node_id
                         cur.status.state = TaskState.ASSIGNED
@@ -573,6 +747,12 @@ class Scheduler:
                     batch.update(update_one)
 
         self.store.batch(batch_cb)
+        # conflicted decisions stay in the pool; the serial path relies
+        # on the causing store write's still-queued event to retrigger,
+        # but a pipelined wave may conflict on an event consumed while
+        # it was in flight — record the count so the completing tick can
+        # retry the pool itself (async mode reads this post-barrier)
+        self._last_commit_conflicts = conflicts[0]
 
         with_generic: list[tuple[str, str]] = []
         # wave-level NodeInfo bookkeeping (batch.apply_placements): the

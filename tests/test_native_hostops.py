@@ -144,6 +144,63 @@ def test_native_survives_group_scale():
         _assert_info_state_equal(a, b)
 
 
+@pytest.mark.skipif(native.hostops is None, reason="no native build")
+def test_native_walk_reentrant_across_threads():
+    """The async commit plane runs the C walk on a worker thread while
+    the wave loop runs Python (and the walk now YIELDS the GIL between
+    segments): pin that concurrent apply_wave calls on DISJOINT info
+    sets are reentrant — no module-level mutable state — by running two
+    walks in parallel threads and asserting both end states bit-match a
+    serial run of the same waves."""
+    import threading
+
+    def mk_wave(rng, n_nodes, tag):
+        placed = []
+        for gi in range(20):
+            svc = f"svc-{tag}-{gi:03d}"
+            tasks = [make_task(rng, svc, gi * 1000 + i)
+                     for i in range(rng.randint(30, 80))]
+            shared = tasks[0].spec
+            for t in tasks:
+                t.spec = shared
+                t.service_id = svc
+                t.id = f"{tag}-{t.id}"
+            order = np.array([rng.randrange(n_nodes) for _ in tasks],
+                             np.int64)
+            placed.append((tasks[0], tasks, order))
+        return placed
+
+    n_nodes = 32
+    rng_mk = random.Random(7)
+    waves = [mk_wave(rng_mk, n_nodes, tag) for tag in ("a", "b")]
+    # two independent builds of the same infos: one pair walked
+    # concurrently, one pair walked serially (the oracle)
+    infos_conc = [[make_info(random.Random(4), i) for i in range(n_nodes)]
+                  for _ in range(2)]
+    infos_ser = [[make_info(random.Random(4), i) for i in range(n_nodes)]
+                 for _ in range(2)]
+
+    results = [None, None]
+
+    def run(slot):
+        results[slot] = batch.apply_placements(infos_conc[slot],
+                                               waves[slot])
+
+    for _ in range(3):      # a few rounds to widen interleaving windows
+        ts = [threading.Thread(target=run, args=(slot,))
+              for slot in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        serial = [batch.apply_placements(infos_ser[slot], waves[slot])
+                  for slot in range(2)]
+        assert results == serial
+        for slot in range(2):
+            for a, b in zip(infos_conc[slot], infos_ser[slot]):
+                _assert_info_state_equal(a, b)
+
+
 # ---------------------------------------------------------------- tree_copy
 
 def _rich_task(i=0):

@@ -218,3 +218,40 @@ def _tokens(manager: SwarmNode):
     c = manager.store.view(
         lambda tx: tx.get_cluster(manager.manager.cluster_id))
     return c.root_ca.join_token_manager, c.root_ca.join_token_worker
+
+
+def test_debug_server_cpu_profile_from_live_daemon(tmp_path):
+    """VERDICT item 9: /debug/profile?seconds=N captures a CPU profile
+    from a LIVE daemon — all threads sampled, pstats-formatted — while
+    the daemon keeps serving (ThreadingHTTPServer: the sampler blocks
+    only its own handler thread)."""
+    from swarmkit_tpu.node.debugserver import DebugServer
+
+    m1 = _mk_manager(tmp_path)
+    srv = DebugServer("127.0.0.1:0", m1)
+    srv.start()
+    try:
+        base = f"http://{srv.addr}"
+        # some real scheduling work during the sampling window
+        ctl = RemoteControl(f"unix://{m1.control_socket_path}", None)
+        try:
+            ctl.create_service(ServiceSpec(
+                annotations=Annotations(name="profiled"), replicas=4))
+        finally:
+            ctl.close()
+        prof = urllib.request.urlopen(
+            f"{base}/debug/profile?seconds=0.5").read().decode()
+        # a pstats dump: header + the standard column line, with real
+        # daemon frames in it (the run loops live in these files)
+        assert "CPU profile:" in prof
+        assert "cumulative" in prof and "ncalls" in prof
+        assert "swarmkit_tpu" in prof, "no daemon frames sampled"
+        # liveness: other endpoints answer while nothing is broken
+        assert urllib.request.urlopen(f"{base}/healthz").read() == b"ok\n"
+        # malformed seconds degrades to the default, never a 500
+        prof2 = urllib.request.urlopen(
+            f"{base}/debug/profile?seconds=bogus").read().decode()
+        assert "CPU profile:" in prof2
+    finally:
+        srv.stop()
+        m1.stop()

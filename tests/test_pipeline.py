@@ -61,21 +61,34 @@ def make_waves(rng, step, group_maker, max_groups=4):
 
 
 def run_pipelined_trace(seed, steps=8, group_maker=random_group,
-                        churn=False, depth=1):
+                        churn=False, depth=1, async_commit=False,
+                        commit_wrap=None):
     rng = random.Random(seed)
     infos = [make_info(rng, i) for i in range(14)]
     next_node_id = 14
     enc = IncrementalEncoder()
     rp = ResidentPlacement(enc)
-    pipe = TickPipeline(enc, rp, make_commit(infos), depth=depth)
+    commit = make_commit(infos)
+    if commit_wrap is not None:
+        commit = commit_wrap(commit)
+    pipe = TickPipeline(enc, rp, commit, depth=depth,
+                        async_commit=async_commit)
 
     completed = []
-    for step in range(steps):
-        if churn and step and step % 3 == 0:
-            next_node_id = mutate(rng, infos, next_node_id, step)
-        groups = make_waves(rng, step, group_maker)
-        completed.extend(pipe.tick(infos, groups, now=NOW))
-    completed.extend(pipe.flush())
+    try:
+        for step in range(steps):
+            if churn and step and step % 3 == 0:
+                # external NodeInfo mutations must take the commit
+                # barrier first in async mode (the riding heavy commit
+                # walks the same objects) — the production Scheduler
+                # does this via _drain_commit_plane in its event handler
+                pipe.barrier()
+                next_node_id = mutate(rng, infos, next_node_id, step)
+            groups = make_waves(rng, step, group_maker)
+            completed.extend(pipe.tick(infos, groups, now=NOW))
+        completed.extend(pipe.flush())
+    finally:
+        pipe.close()
 
     assert len(completed) == steps
     # parity: each wave's device counts bit-match the CPU oracle on the
@@ -323,6 +336,207 @@ def test_fold_restamp_split_equals_apply_counts():
 
 
 # --------------------------------------------------------------------------
+# Async commit plane (TickPipeline(async_commit=True), ops/commit.py):
+# the heavy half (commit_cb + restamp) rides one background worker; the
+# sync half (fold/after_apply) and every drain trigger stay barriered.
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("depth", [1, 3])
+@pytest.mark.parametrize("seed", range(3))
+def test_async_commit_matches_sync(seed, depth, placement_mode):
+    """async_commit changes WHEN the heavy half runs, never what it
+    computes: per-wave counts and final encoder state bit-match the
+    depth-1 sync trace."""
+    enc1, _rp1, _p1, done1 = run_pipelined_trace(seed)
+    encA, rpA, pipeA, doneA = run_pipelined_trace(seed, depth=depth,
+                                                  async_commit=True)
+    assert len(done1) == len(doneA)
+    for step, ((_pa, ca), (_pb, cb)) in enumerate(zip(done1, doneA)):
+        np.testing.assert_array_equal(
+            ca, cb, err_msg=f"seed {seed} step {step}: async depth "
+                            f"{depth} diverges from sync depth 1")
+    np.testing.assert_array_equal(enc1.avail_res, encA.avail_res)
+    np.testing.assert_array_equal(enc1.total0, encA.total0)
+    np.testing.assert_array_equal(enc1._fp_mut, encA._fp_mut)
+    np.testing.assert_array_equal(enc1._svc_mat, encA._svc_mat)
+
+    # device carry equals the host fold of the final wave
+    p, counts = doneA[-1]
+    st = rpA.pull_state()
+    N = len(p.node_ids)
+    exp_total, exp_avail, exp_port = expected_device_fold(p, counts)
+    np.testing.assert_array_equal(st["total0"][:N], exp_total)
+    np.testing.assert_array_equal(
+        st["avail_res"][:N, :p.avail_res.shape[1]], exp_avail)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_async_commit_odd_reservations_parity(seed):
+    """Correction rows queued by after_apply (sync half) must still gate
+    dispatches under the async plane — bit-parity per wave proves the
+    upload never trailed a dispatch."""
+    run_pipelined_trace(seed, group_maker=odd_group, depth=3,
+                        async_commit=True)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_async_commit_churn_parity(seed):
+    """External node mutations force serial drains; parity must hold
+    through them with the worker in the loop."""
+    _enc, _rp, pipe, _done = run_pipelined_trace(
+        seed, churn=True, depth=3, async_commit=True)
+    assert any(t["serial_fallback"] for t in pipe.timings)
+
+
+def test_async_drain_triggers_wait_for_inflight_commit():
+    """EVERY drain trigger is evaluated at/after the tick's dirty scan,
+    and the scan must never observe a heavy commit mid-flight: with a
+    deliberately slow commit, no fingerprint scan may interleave between
+    a commit's start and end markers — across external-mutation drains,
+    correction-row hazards (odd reservations), hypothetical-row drains
+    (fresh services), and resident-signature drains (new generic kind).
+    Commits must also retire strictly FIFO, exactly once per wave."""
+    import time as _time
+
+    rng = random.Random(17)
+    infos = [make_info(rng, i) for i in range(10)]
+    next_node_id = 10
+    enc = IncrementalEncoder()
+    rp = ResidentPlacement(enc)
+    events = []
+    base = make_commit(infos)
+
+    def commit(p, counts):
+        key = p.groups[0].tasks[0].id if p.groups else "?"
+        events.append(("start", key))
+        _time.sleep(0.02)       # widen the race window
+        base(p, counts)
+        events.append(("end", key))
+
+    pipe = TickPipeline(enc, rp, commit, depth=3, async_commit=True)
+    orig_clean = enc.nodes_clean
+
+    def clean(infos_, _orig=orig_clean):
+        events.append(("scan", None))
+        return _orig(infos_)
+
+    enc.nodes_clean = clean
+    completed = []
+    try:
+        for step in range(10):
+            if step == 3:
+                pipe.barrier()      # external-mutator contract
+                next_node_id = mutate(rng, infos, next_node_id, step)
+            maker = odd_group if step in (4, 5) else random_group
+            groups = make_waves(rng, step, maker)
+            if step == 6:
+                for g in groups:    # fresh services: hypothetical rows
+                    g.service_id = f"fresh-{g.service_id}"
+                    for t in g.tasks:
+                        t.service_id = g.service_id
+            if step == 8:           # new generic kind: signature growth
+                groups[0].tasks[0].spec.resources.reservations.generic = \
+                    {"exotic": 1}
+            completed.extend(pipe.tick(infos, groups, now=NOW))
+        completed.extend(pipe.flush())
+    finally:
+        pipe.close()
+
+    assert len(completed) == 10
+    for step, (p, counts) in enumerate(completed):
+        np.testing.assert_array_equal(
+            counts, batch.cpu_schedule_encoded(p), err_msg=f"step {step}")
+    assert any(t["serial_fallback"] for t in pipe.timings)
+
+    # THE property: nothing (scan or another commit) interleaves a
+    # running heavy commit — every trigger waited for the plane
+    open_key = None
+    seen_order = []
+    for kind, key in events:
+        if kind == "start":
+            assert open_key is None, \
+                f"commit {key!r} started while {open_key!r} in flight"
+            open_key = key
+        elif kind == "end":
+            assert open_key == key
+            seen_order.append(key)
+            open_key = None
+        else:   # scan
+            assert open_key is None, \
+                f"dirty scan interleaved commit {open_key!r}"
+    assert open_key is None
+    # FIFO, exactly once per wave
+    assert len(seen_order) == len(set(seen_order)) == 10
+
+
+def test_async_worker_exception_surfaces_on_next_tick():
+    """A worker-side commit exception must re-raise out of a LATER tick
+    (the next barrier) — never die with the thread (the conftest turns
+    unhandled thread crashes into suite failures) and never be skipped."""
+    rng = random.Random(5)
+    infos = [make_info(rng, i) for i in range(8)]
+    enc = IncrementalEncoder()
+    rp = ResidentPlacement(enc)
+    base = make_commit(infos)
+    boom = {"armed": False}
+
+    def commit(p, counts):
+        if boom["armed"]:
+            raise RuntimeError("injected commit failure")
+        base(p, counts)
+
+    pipe = TickPipeline(enc, rp, commit, depth=1, async_commit=True)
+    try:
+        pipe.tick(infos, make_waves(rng, 0, random_group), now=NOW)
+        boom["armed"] = True
+        # completes wave 0 and enqueues its (failing) heavy commit
+        pipe.tick(infos, make_waves(rng, 1, random_group), now=NOW)
+        with pytest.raises(RuntimeError, match="injected commit failure"):
+            pipe.tick(infos, make_waves(rng, 2, random_group), now=NOW)
+        # the plane stays poisoned until the owner heals: flush re-raises
+        # rather than silently committing on undefined state
+        with pytest.raises(RuntimeError, match="injected commit failure"):
+            pipe.flush()
+        pipe.worker.reset()
+    finally:
+        pipe.close()
+
+
+def test_commit_worker_poison_drops_queued_jobs():
+    """Jobs queued behind a failed commit were built on state the
+    failure left undefined: they must be dropped unrun, and submit/
+    barrier must re-raise until reset()."""
+    from swarmkit_tpu.ops.commit import CommitWorker
+
+    import threading as _threading
+
+    w = CommitWorker(name="test-commit")
+    gate = _threading.Event()
+    ran = []
+
+    def blocker():
+        gate.wait(5)
+        raise RuntimeError("poisoned")
+
+    try:
+        w.submit(blocker)
+        w.submit(lambda: ran.append(1))     # queued behind the failure
+        gate.set()
+        with pytest.raises(RuntimeError, match="poisoned"):
+            w.barrier()
+        assert ran == []                    # dropped, not run
+        with pytest.raises(RuntimeError, match="poisoned"):
+            w.submit(lambda: ran.append(2))
+        w.reset()
+        w.submit(lambda: ran.append(3))
+        w.barrier()
+        assert ran == [3]
+    finally:
+        w.close()
+
+
+# --------------------------------------------------------------------------
 # Production Scheduler pipelined mode (Scheduler(pipeline=True)): the
 # run-loop level integration of the deferred-commit reorder.
 # --------------------------------------------------------------------------
@@ -351,10 +565,11 @@ def _seed_cluster(tx_nodes=6, waves=(("s1", 8),)):
     return store
 
 
-def test_scheduler_pipelined_mode_end_to_end(placement_mode):
+@pytest.mark.parametrize("async_commit", [False, True])
+def test_scheduler_pipelined_mode_end_to_end(placement_mode, async_commit):
     """Sustained waves through Scheduler(pipeline=True): every task lands
     ASSIGNED, the pipeline actually engages (in-flight wave observed), and
-    no task is double-assigned."""
+    no task is double-assigned — in both commit modes."""
     import time as _time
 
     from swarmkit_tpu.api.objects import Task
@@ -362,7 +577,8 @@ def test_scheduler_pipelined_mode_end_to_end(placement_mode):
     from swarmkit_tpu.scheduler.scheduler import Scheduler
 
     store = _seed_cluster(waves=(("s1", 8),))
-    sched = Scheduler(store, backend="jax", pipeline=True)
+    sched = Scheduler(store, backend="jax", pipeline=True,
+                      async_commit=async_commit)
     sched.start()
     saw_inflight = False
     try:
@@ -470,7 +686,182 @@ def test_scheduler_pipelined_unclean_commit_heals():
         sched.store.queue.stop_watch(ch)
 
 
-def test_scheduler_pipelined_chaos_never_overcommits(placement_mode):
+def test_scheduler_async_unclean_commit_heals_at_barrier():
+    """Async plane version of the unclean heal: the worker discovers the
+    unclean commit, the NEXT barrier heals on the main thread (poisoned
+    rows, resident resync, primed dispatch discarded), and the discarded
+    wave's tasks are re-attempted rather than wedged."""
+    import numpy as np
+
+    from swarmkit_tpu.api.objects import Task
+    from swarmkit_tpu.api.types import TaskState
+    from swarmkit_tpu.scheduler.encode import IncrementalEncoder
+    from swarmkit_tpu.scheduler.scheduler import Scheduler
+
+    store = _seed_cluster(waves=(("s1", 8),))
+    sched = Scheduler(store, backend="jax", pipeline=True,
+                      async_commit=True)
+    ch = sched._setup()
+    try:
+        sched.tick()                      # dispatch wave 1
+        assert sched._inflight is not None
+
+        def drop(tx):
+            tx.delete(Task, "s1-t03")
+        store.update(drop)
+
+        # completes wave 1: fold applied optimistically, heavy commit
+        # submitted to the worker — which discovers the deleted task and
+        # records the unclean outcome for the next barrier
+        sched.tick()
+        sched._drain_commit_plane()
+        # unclean heal ran: resident resynced, poison applied
+        assert sched._resident is not None and sched._resident._stale
+        assert sched._worker_unclean is None
+
+        tasks = store.view(lambda tx: tx.find_tasks())
+        assigned = [t for t in tasks if t.status.state == TaskState.ASSIGNED]
+        assert len(assigned) == 7
+        # phantom reservations must not survive (the poison heal):
+        # post-heal encode equals a from-scratch encode of the same infos
+        infos = list(sched.node_infos.values())
+        p_after = sched.encoder.encode(infos, [])
+        fresh = IncrementalEncoder()
+        p_fresh = fresh.encode(infos, [])
+        np.testing.assert_array_equal(p_after.avail_res, p_fresh.avail_res)
+        np.testing.assert_array_equal(p_after.total0, p_fresh.total0)
+
+        # scheduling keeps working after the heal
+        def add(tx):
+            for w in range(4):
+                t = Task(id=f"s2-t{w:02d}", service_id="s2", slot=w + 1)
+                t.desired_state = TaskState.RUNNING
+                t.status.state = TaskState.PENDING
+                tx.create(t)
+        store.update(add)
+        for t in store.view(lambda tx: tx.find_tasks()):
+            if t.id.startswith("s2-") and t.status.state == TaskState.PENDING:
+                sched.unassigned[t.id] = t
+        sched.tick()
+        sched.flush_pipeline()
+        tasks = store.view(lambda tx: tx.find_tasks())
+        s2 = [t for t in tasks if t.id.startswith("s2-")]
+        assert len(s2) == 4 and all(
+            t.status.state == TaskState.ASSIGNED for t in s2)
+    finally:
+        sched.store.queue.stop_watch(ch)
+        sched._commit_worker.close()
+
+
+def test_scheduler_async_conflicted_commit_retries_not_wedges():
+    """A wave committed BEHIND the async plane can conflict (its nodes
+    went DOWN after dispatch) on events the run loop already consumed
+    mid-flight — with no event left to retrigger a tick, the old gate
+    left the pool PENDING forever (found by the live verify drive). The
+    completing tick must re-attempt the pool itself: against the
+    updated view the tasks either place elsewhere or get explanations;
+    here (every node down) explanations prove the retry ran."""
+    from swarmkit_tpu.api.types import NodeStatusState, TaskState
+    from swarmkit_tpu.scheduler.scheduler import Scheduler
+
+    store = _seed_cluster(tx_nodes=4, waves=(("s1", 6),))
+    sched = Scheduler(store, backend="jax", pipeline=True,
+                      async_commit=True)
+    ch = sched._setup()
+    try:
+        sched.tick()                      # dispatch onto READY nodes
+        assert sched._inflight is not None
+
+        def down(tx):
+            for i in range(4):
+                n = tx.get_node(f"pn{i:02d}").copy()
+                n.status.state = NodeStatusState.DOWN
+                tx.update(n)
+        store.update(down)
+        # the run loop consumed the DOWN events while the wave was in
+        # flight (driven by hand here) — nothing else will retrigger
+        while True:
+            ev = ch.try_get()
+            if ev is None:
+                break
+            sched._handle(ev)
+        sched.tick()                      # completes; commit conflicts
+        sched._drain_commit_plane()
+        assert sched._last_commit_conflicts > 0
+        tasks = store.view(lambda tx: tx.find_tasks())
+        assert all(t.status.state == TaskState.PENDING for t in tasks)
+        # THE regression: the conflicted pool was re-attempted this tick
+        # (explanations written against the DOWN view), not wedged bare
+        assert sched._inflight is not None or all(
+            t.status.err for t in tasks), \
+            "conflicted pool wedged: no retry dispatch, no explanations"
+
+        # recovery: nodes come back READY -> events -> tick -> assigned
+        def up(tx):
+            for i in range(4):
+                n = tx.get_node(f"pn{i:02d}").copy()
+                n.status.state = NodeStatusState.READY
+                tx.update(n)
+        store.update(up)
+        while True:
+            ev = ch.try_get()
+            if ev is None:
+                break
+            sched._handle(ev)
+        sched.tick()
+        sched.flush_pipeline()
+        sched.tick()
+        sched.flush_pipeline()
+        tasks = store.view(lambda tx: tx.find_tasks())
+        assert all(t.status.state == TaskState.ASSIGNED for t in tasks)
+    finally:
+        sched.store.queue.stop_watch(ch)
+        sched._commit_worker.close()
+
+
+def test_scheduler_async_worker_exception_recovers_in_run_loop():
+    """A worker-side exception re-raises into the next tick; the run
+    loop's failure handler must heal (resident invalidate + worker
+    reset) and keep scheduling — the backlog still lands ASSIGNED."""
+    import time as _time
+
+    from swarmkit_tpu.api.objects import Task
+    from swarmkit_tpu.api.types import TaskState
+    from swarmkit_tpu.scheduler.scheduler import Scheduler
+
+    store = _seed_cluster(waves=(("s1", 8),))
+    sched = Scheduler(store, backend="jax", pipeline=True,
+                      async_commit=True)
+    orig_heavy = sched._commit_heavy
+    fired = {"n": 0}
+
+    def heavy(problem, counts):
+        if fired["n"] == 0:
+            fired["n"] += 1
+            raise RuntimeError("injected worker failure")
+        orig_heavy(problem, counts)
+
+    sched._commit_heavy = heavy
+    sched.start()
+    try:
+        def all_assigned():
+            tasks = store.view(lambda tx: tx.find_tasks())
+            return tasks and all(
+                t.status.state == TaskState.ASSIGNED and t.node_id
+                for t in tasks)
+
+        deadline = _time.monotonic() + 90
+        while _time.monotonic() < deadline and not all_assigned():
+            _time.sleep(0.05)
+        assert all_assigned(), "scheduler wedged after worker failure"
+        assert fired["n"] == 1
+    finally:
+        sched.stop()
+
+
+@pytest.mark.parametrize("async_commit", [False, True])
+def test_scheduler_pipelined_chaos_never_overcommits(placement_mode,
+                                                     async_commit):
     """Live run-loop chaos: waves of services created while PENDING tasks
     are randomly deleted mid-flight. Invariants at quiescence:
     every surviving RUNNING-desired task is ASSIGNED to an existing READY
@@ -502,7 +893,8 @@ def test_scheduler_pipelined_chaos_never_overcommits(placement_mode):
             tx.create(n)
     store.update(seed)
 
-    sched = Scheduler(store, backend="jax", pipeline=True)
+    sched = Scheduler(store, backend="jax", pipeline=True,
+                      async_commit=async_commit)
     sched.start()
     created = 0
     deleted: set = set()
@@ -574,7 +966,8 @@ def test_scheduler_pipelined_chaos_never_overcommits(placement_mode):
     assert len(assigned) >= created - len(deleted)
 
 
-def test_scheduler_pipelined_unplaceable_goes_idle():
+@pytest.mark.parametrize("async_commit", [False, True])
+def test_scheduler_pipelined_unplaceable_goes_idle(async_commit):
     """A permanently unplaceable task must NOT busy-loop the pipeline:
     after the attempt, the pool equals the attempted wave, so the
     scheduler writes the explanation and goes idle (flush terminates,
@@ -598,7 +991,8 @@ def test_scheduler_pipelined_unplaceable_goes_idle():
             tx.create(t)
     store.update(add)
 
-    sched = Scheduler(store, backend="jax", pipeline=True)
+    sched = Scheduler(store, backend="jax", pipeline=True,
+                      async_commit=async_commit)
     sched.start()
     try:
         def explained():

@@ -509,3 +509,41 @@ def test_prevote_elects_when_leader_actually_dies():
     assert new_leader.id != leader.id
     assert new_leader.term > term0
     assert c.propose({"op": "after-failover"})
+
+
+def test_pre_candidate_cannot_be_elected_by_stale_real_votes():
+    """ADVICE r5: entering a pre-campaign used to leave self.votes
+    populated from a prior real campaign at the same term — a delayed
+    real VoteResponse grant then passed the non-pre gate
+    (role==CANDIDATE, term match) and could reach _become_leader with
+    NO pre-quorum. _enter_candidacy must clear the vote set so
+    leadership is only reachable via _real_campaign's own self-vote."""
+    from swarmkit_tpu.raft.messages import VoteResponse
+    from swarmkit_tpu.raft.node import CANDIDATE, LEADER
+
+    c = RaftCluster(3)
+    c.tick_until_leader()
+    node = next(n for n in c.nodes.values() if not n.is_leader)
+    c.router.isolate(node.id)
+    peer = next(i for i in node.members if i != node.id)
+
+    # a real campaign that gets no responses (isolated): term bumps,
+    # the self-vote is recorded
+    node._real_campaign()
+    assert node.role == CANDIDATE and node.id in node.votes
+    term = node.term
+
+    # the campaign times out; the next one POLLS first (pre-vote), at
+    # the same real term
+    node._pre_campaign()
+    assert node._pre_votes == {node.id}
+    assert node.votes == set(), \
+        "pre-candidate inherited stale real votes"
+
+    # a delayed grant from the dead real campaign arrives: it must not
+    # combine with the stale self-vote into a quorum
+    node._on_vote_response(VoteResponse(
+        frm=peer, to=node.id, term=term, granted=True))
+    assert node.role != LEADER, \
+        "pre-candidate elected without a pre-quorum"
+    assert node.term == term          # pre-campaign never bumps terms
