@@ -45,6 +45,13 @@ log = logging.getLogger("swarmkit_tpu.raft")
 
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
 
+# group commit: the worker drains up to this many inbox items per loop
+# iteration and performs ONE Ready flush for the whole batch — one WAL
+# append + fsync, one dirty-gated hardstate save, one coalesced
+# AppendEntries per peer, one commit-advance + apply pass (the
+# Ready/Advance batching the reference gets from etcd/raft)
+MAX_READY_BATCH = 256
+
 MAX_ENTRIES_PER_APPEND = 64
 # pipelined replication: optimistic appends may run this many messages
 # ahead of the follower's last ack (reference MaxInflightMsgs: 256,
@@ -198,6 +205,27 @@ class RaftNode:
         self._signalled = False
         self._barrier_index = 0
 
+        # ---- batched Ready plane (group commit) ----
+        # entries appended since the last flush, persisted in ONE
+        # append_entries call (one WAL write + one fsync for the batch)
+        self._ready_entries: list[Entry] = []
+        # term/vote/commit changed since the last flush (dirty-gated
+        # save_hard_state, at most one per flush)
+        self._hs_dirty = False
+        # outgoing messages buffered until AFTER the flush persisted
+        # entries + hard state: nothing leaves this node before the state
+        # it claims is durable (votes/term bumps persist before any
+        # message leaves — the raft durability contract)
+        self._out_msgs: list = []
+        # peers owed an AppendEntries this flush: peer -> allow_empty
+        # (True once any requester allowed a heartbeat); coalesced to ONE
+        # send_append per peer per flush
+        self._append_dirty: dict[int, bool] = {}
+        # flush observability (worker-thread ints; status() exposes them)
+        self.ready_flushes = 0
+        self.ready_items = 0
+        self.commits_applied = 0
+
         self._recovered = False
         if auto_recover:
             self.recover()
@@ -212,6 +240,7 @@ class RaftNode:
         self._recovered = True
         if self.storage is not None:
             self._restore_from_storage()
+            self._flush_ready()   # replay marked hardstate dirty; settle it
 
     # ------------------------------------------------------------- lifecycle
     def start(self):
@@ -329,26 +358,95 @@ class RaftNode:
 
     # ------------------------------------------------------------ event loop
     def _run(self):
+        """Batched Ready loop: drain the inbox (bounded batch), dispatch
+        every item, then perform ONE flush for the whole batch — the
+        group-commit plane. Handlers only mutate volatile state and mark
+        work (entries to persist, peers to append to, messages to send);
+        `_flush_ready` is the single point where durability and the
+        network happen."""
         while not self._stopped.is_set():
             try:
                 item = self._inbox.get(timeout=0.2)
             except queue.Empty:
                 continue
+            batch = [item]
+            while len(batch) < MAX_READY_BATCH:
+                try:
+                    batch.append(self._inbox.get_nowait())
+                except queue.Empty:
+                    break
+            for it in batch:
+                try:
+                    self._dispatch(it)
+                except Exception:
+                    log.exception("raft-%d: error processing %r",
+                                  self.id, it[0])
             try:
-                self._dispatch(item)
+                self._flush_ready()
             except Exception:
-                log.exception("raft-%d: error processing %r", self.id, item[0])
+                # unsent messages may claim durability the failed flush
+                # never provided — drop them; raft retransmits
+                self._out_msgs.clear()
+                log.exception("raft-%d: ready flush failed", self.id)
 
     def process_all(self):
-        """Drain the inbox synchronously (fake-clock tests drive this)."""
+        """Drain the inbox synchronously (fake-clock tests drive this):
+        the same dispatch-all-then-flush-once shape as the live worker."""
+        processed = False
         while True:
             try:
                 item = self._inbox.get_nowait()
             except queue.Empty:
-                return
+                break
+            processed = True
             self._dispatch(item)
+        # flush pending ready state even when the inbox was empty: tests
+        # drive handlers directly (e.g. _on_transfer) and their buffered
+        # output must still reach the wire
+        if processed or self._out_msgs or self._ready_entries \
+                or self._hs_dirty or self._append_dirty:
+            self._flush_ready()
+
+    def _flush_ready(self):
+        """The group-commit flush (etcd Ready/Advance analogue), in strict
+        order: (1) persist the batch's entries — one WAL append, one
+        fsync; (2) advance the commit frontier off the durable state and
+        apply, firing wait callbacks in log order; (3) dirty-gated
+        hardstate save — votes/term bumps/commit persist here, BEFORE any
+        message leaves; (4) one coalesced AppendEntries per dirty peer;
+        (5) release the buffered outgoing messages to the transport."""
+        self.ready_flushes += 1
+        if self._ready_entries:
+            if self.storage is not None:
+                self.storage.append_entries(self._ready_entries)
+            self._ready_entries = []
+        self._maybe_advance_commit()
+        self._apply_committed()
+        if self._hs_dirty:
+            if self.storage is not None:
+                self.storage.save_hard_state(self.term, self.voted_for,
+                                             self.commit_index)
+            # cleared only AFTER a successful save (like _ready_entries):
+            # a failed write must leave the flag set so the next flush
+            # retries before any message claims the state is durable
+            self._hs_dirty = False
+        if self._append_dirty:
+            dirty, self._append_dirty = self._append_dirty, {}
+            if self.role == LEADER:
+                for peer_id, allow_empty in dirty.items():
+                    if peer_id in self.members and peer_id != self.id:
+                        self._send_append_to(peer_id,
+                                             allow_empty=allow_empty)
+        if self._out_msgs:
+            msgs, self._out_msgs = self._out_msgs, []
+            for m in msgs:
+                try:
+                    self.transport.send(m)
+                except Exception:
+                    log.debug("raft-%d: send to %d failed", self.id, m.to)
 
     def _dispatch(self, item):
+        self.ready_items += 1
         kind = item[0]
         if kind == "msg":
             self._step(item[1])
@@ -376,7 +474,7 @@ class RaftNode:
             self.heartbeat_elapsed += 1
             if self.heartbeat_elapsed >= self.heartbeat_tick:
                 self.heartbeat_elapsed = 0
-                self._broadcast_append()
+                self._mark_broadcast()
             # expire paused streamed snapshots so lost chunks get re-sent
             for peer_id, (snap_idx, ttl) in list(self._snap_pending.items()):
                 if ttl <= 1:
@@ -491,8 +589,9 @@ class RaftNode:
         self._barrier_index = last + 1
         self._append_local(Entry(term=self.term, index=last + 1,
                                  kind=ENTRY_NORMAL, data=None))
-        self._broadcast_append()
-        self._maybe_advance_commit()
+        self._mark_broadcast()
+        # commit advance (single-node clusters commit the barrier at once)
+        # happens at this batch's flush, after the entry is durable
 
     def _become_follower(self, term: int, leader_id: int | None):
         was_leader = self.role == LEADER
@@ -673,8 +772,10 @@ class RaftNode:
                 self._persist_entry(e)
 
         if msg.leader_commit > self.commit_index:
+            # the apply (and the hardstate save recording the advance)
+            # happens at this batch's flush, AFTER the entries above are
+            # durably appended
             self.commit_index = min(msg.leader_commit, self._last_index())
-            self._apply_committed()
 
         if self._snap_chunks:
             # appends caught us up past a partially-streamed snapshot
@@ -706,9 +807,10 @@ class RaftNode:
             pending = self._snap_pending.get(msg.frm)
             if pending is not None and msg.match_index >= pending[0]:
                 self._snap_pending.pop(msg.frm, None)  # install acked
-            self._maybe_advance_commit()
-            # refill the pipeline window opened by this ack
-            self._send_append_to(msg.frm, allow_empty=False)
+            # commit advance runs once at the flush, over the whole
+            # batch of acks; refill the pipeline window opened by this
+            # ack with ONE coalesced append per peer per flush
+            self._mark_append(msg.frm, allow_empty=False)
         else:
             if msg.frm in self._snap_pending:
                 # mid-install heartbeat mismatch is expected; the streamed
@@ -722,7 +824,7 @@ class RaftNode:
             if new_next < self.next_index.get(msg.frm,
                                               self._last_index() + 1):
                 self.next_index[msg.frm] = new_next
-                self._send_append_to(msg.frm, allow_empty=False)
+                self._mark_append(msg.frm, allow_empty=False)
 
     def _on_install_snapshot(self, msg: InstallSnapshot):
         if msg.term < self.term:
@@ -777,6 +879,14 @@ class RaftNode:
         self.snapshot_term = snapshot_term
         self.log = []
         self.first_index = snapshot_index + 1
+        # entries staged for this flush are covered (or superseded) by the
+        # snapshot — and so is any divergent persisted tail BEYOND it,
+        # which a later restart would otherwise splice after the snapshot
+        # (the install replaced the whole log, the WAL must follow)
+        self._ready_entries = [e for e in self._ready_entries
+                               if e.index > snapshot_index]
+        if self.storage is not None:
+            self.storage.truncate_from(snapshot_index + 1)
         self.commit_index = max(self.commit_index, snapshot_index)
         self.last_applied = snapshot_index
         self.members = {
@@ -811,8 +921,10 @@ class RaftNode:
         e = Entry(term=self.term, index=self._last_index() + 1,
                   kind=ENTRY_NORMAL, data=data, request_id=request_id)
         self._append_local(e)
-        self._broadcast_append()
-        self._maybe_advance_commit()  # single-node commits immediately
+        self._mark_broadcast()
+        # the batch flush persists (one fsync for ALL proposals in the
+        # batch), replicates (one coalesced append per peer) and advances
+        # the commit (single-node clusters commit right at the flush)
 
     def _on_conf_change(self, cc: ConfChange, request_id, callback):
         if self.role != LEADER or not self._signalled:
@@ -825,8 +937,7 @@ class RaftNode:
         e = Entry(term=self.term, index=self._last_index() + 1,
                   kind=ENTRY_CONF_CHANGE, data=cc, request_id=request_id)
         self._append_local(e)
-        self._broadcast_append()
-        self._maybe_advance_commit()
+        self._mark_broadcast()
 
     def _can_remove(self, raft_id: int) -> bool:
         """reference raft.go:1170-1193 CanRemoveMember: removal must leave a
@@ -854,10 +965,16 @@ class RaftNode:
         if self.role == LEADER:
             self._maybe_snapshot()
 
-    def _broadcast_append(self):
+    def _mark_append(self, peer_id: int, allow_empty: bool = True):
+        """Note that `peer_id` is owed an AppendEntries; the batch flush
+        coalesces every mark into ONE _send_append_to per peer."""
+        self._append_dirty[peer_id] = (self._append_dirty.get(peer_id, False)
+                                       or allow_empty)
+
+    def _mark_broadcast(self):
         for peer_id in self.members:
             if peer_id != self.id:
-                self._send_append_to(peer_id)
+                self._mark_append(peer_id)
 
     def _send_append_to(self, peer_id: int, allow_empty: bool = True):
         """Ship log entries to one peer, pipelined: batches are sent
@@ -955,8 +1072,8 @@ class RaftNode:
         if quorum_match > self.commit_index and \
                 self._term_at(quorum_match) == self.term:
             self.commit_index = quorum_match
-            self._apply_committed()
-            self._broadcast_append()  # propagate the new commit index
+            self._mark_broadcast()  # propagate the new commit index
+            # the flush applies right after this (batched apply pass)
 
     def _apply_committed(self):
         if self.last_applied < self.commit_index:
@@ -973,6 +1090,7 @@ class RaftNode:
                 self.last_applied -= 1
                 break
             e = self.log[idx]
+            self.commits_applied += 1
             if e.kind == ENTRY_CONF_CHANGE:
                 self._apply_conf_change(e)
             elif e.data is not None:
@@ -1060,15 +1178,20 @@ class RaftNode:
 
     # ------------------------------------------------------------ persistence
     def _persist_hard_state(self):
-        if self.storage is not None:
-            self.storage.save_hard_state(self.term, self.voted_for,
-                                         self.commit_index)
+        """Mark term/vote/commit dirty; the batch flush writes hardstate at
+        most once, and always before any buffered message leaves."""
+        self._hs_dirty = True
 
     def _persist_entry(self, e: Entry):
-        if self.storage is not None:
-            self.storage.append_entries([e])
+        """Stage an entry for the batch flush's single group-commit WAL
+        append (one write + one fsync for the whole batch)."""
+        self._ready_entries.append(e)
 
     def _append_entry_storage_truncate(self, from_index: int):
+        # conflict truncation: drop staged-but-unpersisted entries in the
+        # truncated range too, then truncate the durable log
+        self._ready_entries = [e for e in self._ready_entries
+                               if e.index < from_index]
         if self.storage is not None:
             self.storage.truncate_from(from_index)
 
@@ -1110,10 +1233,10 @@ class RaftNode:
         return -1
 
     def _send(self, msg):
-        try:
-            self.transport.send(msg)
-        except Exception:
-            log.debug("raft-%d: send to %d failed", self.id, msg.to)
+        """Buffer an outgoing message; the batch flush releases it to the
+        transport only AFTER the flush's WAL append + hardstate save, so
+        no message ever claims state that is not yet durable."""
+        self._out_msgs.append(msg)
 
     # ------------------------------------------------------------- introspect
     @property
@@ -1132,4 +1255,9 @@ class RaftNode:
             "applied": self.last_applied,
             "last_index": self._last_index(),
             "members": {p.raft_id: p.addr for p in self.members.values()},
+            # group-commit plane observability: amortized cost per commit
+            # is wal_fsyncs / commits_applied when storage is attached
+            "ready_flushes": self.ready_flushes,
+            "ready_items": self.ready_items,
+            "commits_applied": self.commits_applied,
         }

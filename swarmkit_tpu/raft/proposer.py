@@ -9,6 +9,7 @@ version-checked updates behave identically cluster-wide.
 """
 from __future__ import annotations
 
+import bisect
 import threading
 from typing import Callable
 
@@ -51,6 +52,42 @@ def _classify(err: str) -> type[ProposeError]:
             if any(m in err for m in _NOT_LEADER_MARKERS) else ProposeError)
 
 
+class PendingProposal:
+    """Handle for a pipelined proposal (propose_async): the caller may keep
+    up to depth-K of these in flight; raft commits them in log order and
+    resolves each handle from the worker thread. `wait()`/`result()` give
+    the blocking API its exact semantics back."""
+
+    def __init__(self, request_id: str):
+        self.request_id = request_id
+        self._done = threading.Event()
+        self._ok = False
+        self._err = ""
+        self._started = time.monotonic()
+
+    def _resolve(self, ok: bool, err: str):
+        self._ok = ok
+        self._err = err
+        self._done.set()
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._done.wait(timeout)
+
+    def result(self, timeout: float = PROPOSE_TIMEOUT) -> None:
+        """Block until commit; raise the same typed errors propose_value
+        raises (ProposeError / LeadershipLost)."""
+        if not self._done.wait(timeout):
+            raise ProposeError("proposal timed out")
+        _propose_latency.observe(time.monotonic() - self._started)
+        if not self._ok:
+            err = self._err or "proposal dropped"
+            raise _classify(err)(err)
+
+
 class RaftProposer:
     def __init__(self, node: RaftNode, store=None):
         self.node = node
@@ -75,53 +112,59 @@ class RaftProposer:
             self.store.restore(snap)
 
     # ------------------------------------------------------ Proposer protocol
-    def propose_value(self, actions, commit_cb: Callable[..., None]) -> None:
+    def propose_async(self, actions,
+                      commit_cb: Callable[..., None]) -> PendingProposal:
+        """Non-blocking propose: returns a PendingProposal immediately so
+        the store can pipeline transactions at depth K against the raft
+        group-commit plane (K proposals share one WAL fsync + one
+        replication flush instead of paying one each). On commit the
+        registered commit_cb runs on the raft worker thread, in log
+        order; failure resolves the handle without running commit_cb."""
         req_id = new_id()
-        done = threading.Event()
-        outcome: dict = {}
-
+        handle = PendingProposal(req_id)
         with self._lock:
             self._pending[req_id] = commit_cb
 
         def on_result(ok: bool, err: str):
-            outcome["ok"] = ok
-            outcome["err"] = err
-            done.set()
+            if not ok:
+                with self._lock:
+                    self._pending.pop(req_id, None)
+            handle._resolve(ok, err)
 
-        start = time.monotonic()
         self.node.propose(list(actions), req_id, on_result)
-        if done.wait(PROPOSE_TIMEOUT):
-            _propose_latency.observe(time.monotonic() - start)
-        else:
+        return handle
+
+    def propose_value(self, actions, commit_cb: Callable[..., None]) -> None:
+        handle = self.propose_async(actions, commit_cb)
+        try:
+            handle.result(PROPOSE_TIMEOUT)
+        except ProposeError:
             with self._lock:
-                self._pending.pop(req_id, None)
-            raise ProposeError("proposal timed out")
-        if not outcome.get("ok"):
-            with self._lock:
-                self._pending.pop(req_id, None)
-            err = outcome.get("err") or "proposal dropped"
-            raise _classify(err)(err)
+                self._pending.pop(handle.request_id, None)
+            raise
 
     def get_version(self) -> Version:
         return Version(self.node.commit_index)
 
     def changes_between(self, from_v: Version, to_v: Version) -> list:
         node = self.node
-        # snapshot the log list: the raft worker thread may truncate or
-        # compact it concurrently
-        entries = list(node.log)
+        # grab the list reference once: the raft worker thread replaces it
+        # wholesale on truncation/compaction (our reference stays a
+        # consistent prefix) and only ever appends in place
+        entries = node.log
         first = entries[0].index if entries else node.first_index
         if from_v.index + 1 < first:
             # entries below `first` were compacted into a snapshot; a partial
             # answer would silently diverge the replaying watcher
             raise ProposeError(
                 f"changes from {from_v.index} compacted (log starts at {first})")
-        out = []
-        for e in entries:
-            if from_v.index < e.index <= to_v.index and e.data is not None \
-                    and e.kind == 0:
-                out.append(e.data)
-        return out
+        # entry indexes are sorted and dense: bisect to the requested
+        # window instead of scanning the whole log per watcher resync
+        lo = bisect.bisect_right(entries, from_v.index,
+                                 key=lambda e: e.index)
+        hi = bisect.bisect_right(entries, to_v.index, key=lambda e: e.index)
+        return [e.data for e in entries[lo:hi]
+                if e.data is not None and e.kind == 0]
 
     # --------------------------------------------------------------- applying
     def _apply_entry(self, entry: Entry) -> None:

@@ -97,14 +97,18 @@ class RaftCluster:
 
     # ---- deterministic pumping --------------------------------------------
     def settle(self, rounds: int = 50):
-        """Process every queued event until the cluster goes quiet."""
+        """Process every queued event until the cluster goes quiet. A
+        node's batched Ready flush delivers messages AFTER its dispatch
+        pass, so quiescence is only real when every inbox is still empty
+        at the end of a whole round."""
         for _ in range(rounds):
             busy = False
             for node in self.nodes.values():
                 if not node._inbox.empty():
                     busy = True
                 node.process_all()
-            if not busy:
+            if not busy and all(n._inbox.empty()
+                                for n in self.nodes.values()):
                 return
 
     def tick_all(self, n: int = 1):

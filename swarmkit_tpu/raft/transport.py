@@ -27,6 +27,11 @@ OUTBOX_LIMIT = 1024          # per-peer; raft retransmits, drops are safe
 HEALTH_WINDOW = 10.0         # seconds: a peer is active if a send succeeded
 SEND_TIMEOUT = 5.0
 RECONNECT_BACKOFF = 1.0
+# sender-side coalescing: a backlogged outbox drains up to this many
+# messages into ONE raft.step_many RPC instead of one round trip each
+# (the wire half of the group-commit plane; single messages still ride
+# the plain raft.step)
+SEND_BATCH = 64
 
 
 class NetworkTransport:
@@ -150,13 +155,28 @@ class NetworkTransport:
 
     def _sender_loop(self, peer_id: int, box: queue.Queue):
         backoff_until = 0.0
-        while not self._stopped.is_set():
+        stop_after_batch = False
+        while not self._stopped.is_set() and not stop_after_batch:
             try:
                 msg = box.get(timeout=0.5)
             except queue.Empty:
                 continue
             if msg is None:
                 return
+            # coalesce a backlog into one RPC: under the node's batched
+            # Ready flush a whole wave of appends/responses lands in the
+            # outbox at once, and per-message round trips would serialize
+            # it again at one RTT each
+            msgs = [msg]
+            while len(msgs) < SEND_BATCH:
+                try:
+                    nxt = box.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    stop_after_batch = True  # deliver, then exit
+                    break
+                msgs.append(nxt)
             now = time.monotonic()
             with self._lock:
                 self._last_try[peer_id] = now
@@ -167,7 +187,10 @@ class NetworkTransport:
                 backoff_until = time.monotonic() + RECONNECT_BACKOFF
                 continue
             try:
-                client.call("raft.step", msg, timeout=SEND_TIMEOUT)
+                if len(msgs) == 1:
+                    client.call("raft.step", msgs[0], timeout=SEND_TIMEOUT)
+                else:
+                    client.call("raft.step_many", msgs, timeout=SEND_TIMEOUT)
                 with self._lock:
                     self._last_ok[peer_id] = time.monotonic()
                 backoff_until = 0.0

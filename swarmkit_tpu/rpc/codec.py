@@ -120,22 +120,34 @@ class _Registry:
                         broker_mod.SubscriptionComplete):
                 self.add(cls)
 
-            from ..ca.auth import Caller
-            from ..ca.certificates import CertIdentity
-
-            self.add(CertIdentity)
-            self.add(Caller)
+            try:
+                from ..ca.auth import Caller
+                from ..ca.certificates import CertIdentity
+            except ImportError:
+                # environment without the optional `cryptography` wheel:
+                # the CA tier is unusable there anyway, and gating it here
+                # keeps the rest of the wire (raft WAL records, dispatcher
+                # messages, ...) working
+                Caller = CertIdentity = None
+            if Caller is not None:
+                self.add(CertIdentity)
+                self.add(Caller)
 
             # dataclasses that live inside store objects (and therefore in
             # raft entries / WAL records / snapshots)
-            from ..manager.keymanager import EncryptionKey
+            try:
+                from ..manager.keymanager import EncryptionKey
+            except ImportError:
+                EncryptionKey = None   # crypto-less env (see CA gate above)
             from ..orchestrator.restart import (
                 InstanceRestartInfo,
                 RestartedInstance,
             )
 
-            for cls in (EncryptionKey, InstanceRestartInfo, RestartedInstance):
-                self.add(cls)
+            for cls in (EncryptionKey, InstanceRestartInfo,
+                        RestartedInstance):
+                if cls is not None:
+                    self.add(cls)
 
             # control/watch request types that cross the client wire
             from ..controlapi.control import ListFilters

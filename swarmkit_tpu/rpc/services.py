@@ -141,6 +141,22 @@ def build_manager_registry(manager, raft_node=None,
             raft_node.step(msg)
             return None
 
+        def raft_step_many(caller, msgs):
+            """Batched transport path: a backlogged peer outbox coalesces
+            into one RPC (raft/transport.py SEND_BATCH). The removed-member
+            check runs once up front — every message in a batch carries the
+            same sender, and stepping part of a removed member's batch
+            before answering with the marker would be wrong either way."""
+            for msg in msgs:
+                frm = getattr(msg, "frm", None)
+                if frm is not None and frm in raft_node.removed_ids:
+                    from ..raft.messages import MemberRemovedError
+
+                    raise MemberRemovedError("raft: member removed")
+            for msg in msgs:
+                raft_node.step(msg)
+            return None
+
         def raft_resolve_address(caller, raft_id):
             peer = raft_node.members.get(raft_id)
             return peer.addr if peer is not None else None
@@ -204,6 +220,7 @@ def build_manager_registry(manager, raft_node=None,
             return None
 
         reg.add("raft.step", raft_step, roles=[MANAGER])
+        reg.add("raft.step_many", raft_step_many, roles=[MANAGER])
         reg.add("raft.resolve_address", raft_resolve_address, roles=[MANAGER])
         # join/leave are leader-only operations, but a joiner only knows one
         # manager address — forward so any manager can serve them

@@ -28,6 +28,24 @@ class Proposer(Protocol):
     def changes_between(self, from_v: Version, to_v: Version) -> list:
         ...
 
+    # Proposers may additionally offer `propose_async(actions, commit_cb)
+    # -> handle` (handle.wait/result/done) — the non-blocking path the
+    # store's pipelined Batch rides so depth-K transactions share one raft
+    # group-commit flush. Callers feature-test with hasattr; the blocking
+    # propose_value semantics above stay the contract.
+
+
+class _CompletedProposal:
+    """LocalProposer's propose_async handle: commit already happened."""
+
+    done = True
+
+    def wait(self, timeout=None) -> bool:
+        return True
+
+    def result(self, timeout=None) -> None:
+        return None
+
 
 class LocalProposer:
     """Versioning without consensus (MockProposer in the reference tests)."""
@@ -40,6 +58,10 @@ class LocalProposer:
         self._index += 1
         self._log.append((self._index, list(actions)))
         commit_cb(version_index=self._index)
+
+    def propose_async(self, actions, commit_cb: Callable[..., None]):
+        self.propose_value(actions, commit_cb)
+        return _CompletedProposal()
 
     def get_version(self) -> Version:
         return Version(self._index)

@@ -356,12 +356,23 @@ class MemoryStore:
                         pass
             self._commit(tx, version_index=version_index)
 
-    def batch(self, cb: Callable[["Batch"], Any]) -> None:
+    def batch(self, cb: Callable[["Batch"], Any],
+              pipeline_depth: int | None = None) -> None:
         """Split a large write into transactions of at most
-        MAX_CHANGES_PER_TRANSACTION changes (memory.go:399-549)."""
-        b = Batch(self)
+        MAX_CHANGES_PER_TRANSACTION changes (memory.go:399-549).
+
+        With `pipeline_depth` and a proposer that offers propose_async,
+        sub-transactions are PIPELINED: up to depth proposals ride raft
+        concurrently and share the group-commit plane's batched WAL
+        fsync + replication flush, instead of paying one quorum RTT +
+        fsync each. Commit callbacks still run in raft log order. Only
+        safe when the sub-transactions touch disjoint objects (the bulk
+        create/update shape Batch exists for): a later sub-transaction
+        reads store state that does not yet include an in-flight one."""
+        b = Batch(self, pipeline_depth=pipeline_depth)
         cb(b)
         b._flush()
+        b._drain()
 
     # ----------------------------------------------------------------- events
     def watch_queue(self) -> WatchQueue:
@@ -500,11 +511,15 @@ class MemoryStore:
 
 class Batch:
     """reference: memory.go Batch — accumulates updates, flushing every
-    MAX_CHANGES_PER_TRANSACTION changes as an independent transaction."""
+    MAX_CHANGES_PER_TRANSACTION changes as an independent transaction.
+    With pipeline_depth set (and an async-capable proposer), flushed
+    sub-transactions become in-flight raft proposals up to that depth."""
 
-    def __init__(self, store: MemoryStore):
+    def __init__(self, store: MemoryStore, pipeline_depth: int | None = None):
         self._store = store
         self._pending: list[Callable[[WriteTx], Any]] = []
+        self._depth = pipeline_depth
+        self._handles: list = []
         self.applied = 0
         self.committed = 0
 
@@ -513,6 +528,11 @@ class Batch:
         self.applied += 1
         if len(self._pending) >= MAX_CHANGES_PER_TRANSACTION:
             self._flush()
+
+    def _pipelined(self) -> bool:
+        return bool(self._depth and self._depth > 1
+                    and self._store.proposer is not None
+                    and hasattr(self._store.proposer, "propose_async"))
 
     def _flush(self) -> None:
         if not self._pending:
@@ -523,5 +543,45 @@ class Batch:
             for cb in pending:
                 cb(tx)
 
-        self._store.update(run_all)
+        if self._pipelined():
+            self._flush_async(run_all)
+        else:
+            self._store.update(run_all)
         self.committed += len(pending)
+
+    def _flush_async(self, run_all: Callable[[WriteTx], Any]) -> None:
+        """Build the sub-transaction under the update lock, hand the
+        changelist to propose_async, and release the lock WITHOUT waiting
+        for the commit — the raft worker's group-commit flush batches the
+        in-flight window's WAL write + replication. The commit callback
+        (table write-back + events) runs on the raft worker in log order,
+        exactly like a propose_value commit does."""
+        store = self._store
+        with store._update_lock:
+            tx = WriteTx(store)
+            run_all(tx)
+            if not tx._changelist:
+                return
+            actions = list(tx._changelist)
+
+            def commit_cb(version_index: int | None = None):
+                store._commit(tx, version_index=version_index)
+
+            handle = store.proposer.propose_async(actions, commit_cb)
+        self._handles.append(handle)
+        while len(self._handles) >= (self._depth or 1):
+            self._handles.pop(0).result()
+
+    def _drain(self) -> None:
+        """Wait out every in-flight pipelined proposal; raise the first
+        failure (same typed errors a blocking update would raise)."""
+        handles, self._handles = self._handles, []
+        first_err = None
+        for h in handles:
+            try:
+                h.result()
+            except Exception as exc:
+                if first_err is None:
+                    first_err = exc
+        if first_err is not None:
+            raise first_err

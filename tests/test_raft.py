@@ -182,6 +182,8 @@ def test_log_conflict_truncation():
 
 
 def test_restart_from_storage(tmp_path):
+    pytest.importorskip("cryptography",
+                        reason="DEK-sealed storage needs `cryptography`")
     dek = new_dek()
     applied = []
     storage = RaftStorage(str(tmp_path / "raft"), dek=dek)
@@ -235,6 +237,8 @@ def test_snapshot_compaction_with_storage(tmp_path):
 
 
 def test_dek_rotation(tmp_path):
+    pytest.importorskip("cryptography",
+                        reason="DEK-sealed storage needs `cryptography`")
     dek1 = new_dek()
     storage = RaftStorage(str(tmp_path / "raft"), dek=dek1)
     from swarmkit_tpu.raft.messages import Entry

@@ -96,6 +96,8 @@ def test_chaos_with_restarts(tmp_path, seed):
     """Same soak with node restarts from persisted storage mixed in: a node
     that crashes and reloads its WAL must rejoin without losing or forking
     the applied sequence."""
+    pytest.importorskip("cryptography",
+                        reason="DEK-sealed storage needs `cryptography`")
     from swarmkit_tpu.raft.node import RaftNode
     from swarmkit_tpu.raft.storage import RaftStorage, new_dek
 

@@ -1,0 +1,502 @@
+"""Raft group-commit plane: batched Ready flush semantics.
+
+Pins the tentpole contracts: one WAL append + one fsync per worker batch
+(not per proposal), commit callbacks firing in log order across a batch, a
+mid-batch dropped proposal failing only its own callback, crash recovery of
+multi-entry batched WAL appends (segmented + torn-tail repaired), fuzzed
+parity between the live commit-frontier rule and the TPU replay kernel
+(ops/raft_replay), the pipelined propose_async path, and the transport's
+coalesced raft.step_many sends."""
+from __future__ import annotations
+
+import queue
+import random
+import threading
+import time
+
+import pytest
+
+from swarmkit_tpu.raft.messages import ConfChange, Entry
+from swarmkit_tpu.raft.node import LEADER, Peer, RaftNode
+from swarmkit_tpu.raft.proposer import RaftProposer
+from swarmkit_tpu.raft.storage import RaftStorage
+from swarmkit_tpu.raft.testutils import MemoryTransport, RaftCluster
+
+
+def plain_storage(tmp_path, name="r", **kw):
+    return RaftStorage(str(tmp_path / name), dek=None, **kw)
+
+
+# ------------------------------------------------------------ group commit
+
+
+def test_batch_of_proposals_is_one_wal_fsync(tmp_path):
+    s = plain_storage(tmp_path)
+    c = RaftCluster(1, storages={1: s})
+    leader = c.tick_until_leader()
+
+    fsyncs0, batches0 = s.wal_fsyncs, s.append_batches
+    results = []
+    for k in range(100):
+        leader.propose({"op": k}, f"p{k}",
+                       lambda ok, err, k=k: results.append((k, ok, err)))
+    leader.process_all()   # one dispatch pass + ONE Ready flush
+
+    assert s.wal_fsyncs - fsyncs0 == 1, "group commit did not coalesce"
+    assert s.append_batches - batches0 == 1
+    # single-node cluster: the whole batch committed at the flush,
+    # callbacks in proposal (= log) order
+    assert [r[0] for r in results] == list(range(100))
+    assert all(ok for _, ok, _ in results)
+    assert leader.commit_index == leader._last_index()
+
+
+def test_amortized_fsyncs_per_commit_below_one(tmp_path):
+    """The acceptance metric: under load (many proposals per batch) total
+    fsyncs — WAL and metadata — amortize to well under one per commit."""
+    s = plain_storage(tmp_path)
+    c = RaftCluster(1, storages={1: s})
+    leader = c.tick_until_leader()
+
+    base_fsyncs = s.wal_fsyncs + s.meta_fsyncs
+    base_commits = leader.commits_applied
+    for k in range(300):
+        leader.propose({"op": k}, f"p{k}", lambda ok, err: None)
+        if k % 75 == 74:
+            leader.process_all()
+    leader.process_all()
+    commits = leader.commits_applied - base_commits
+    fsyncs = (s.wal_fsyncs + s.meta_fsyncs) - base_fsyncs
+    assert commits == 300
+    assert fsyncs / commits < 1.0, (fsyncs, commits)
+
+
+def test_callback_order_matches_log_order_in_cluster():
+    c = RaftCluster(3)
+    leader = c.tick_until_leader()
+    order = []
+    for k in range(50):
+        leader.propose({"op": k}, f"p{k}",
+                       lambda ok, err, k=k: order.append((k, ok)))
+    c.settle()
+    assert order == [(k, True) for k in range(50)]
+    for n in c.nodes.values():
+        assert n.commit_index == leader.commit_index
+
+
+def test_mid_batch_dropped_proposal_fails_only_its_own_callback():
+    c = RaftCluster(3)
+    leader = c.tick_until_leader()
+    others = sorted(i for i in c.nodes if i != leader.id)
+    f, g = others
+    c.router.isolate(f)   # makes remove(g) fail its quorum-safety check
+
+    results = {}
+
+    def cb(tag):
+        return lambda ok, err: results.setdefault(tag, (ok, err))
+
+    leader.propose({"op": "a"}, "ra", cb("a"))
+    leader.propose_conf_change(
+        ConfChange(action="remove", raft_id=g, node_id=f"node-{g}"),
+        "rc", cb("c"))
+    leader.propose({"op": "b"}, "rb", cb("b"))
+    c.settle()
+
+    assert results["a"][0] is True
+    assert results["b"][0] is True
+    assert results["c"][0] is False      # dropped, with a reason
+    assert results["c"][1]
+    # the surviving proposals committed in order despite the hole
+    datas = [e.data for e in leader.log if isinstance(e.data, dict)]
+    assert datas == [{"op": "a"}, {"op": "b"}]
+
+
+def test_votes_persist_before_any_message_leaves(tmp_path):
+    """The flush discipline: hardstate (term/vote) must hit disk before
+    the buffered VoteResponse reaches the transport."""
+    from swarmkit_tpu.raft.messages import VoteRequest
+
+    s = plain_storage(tmp_path)
+    router = MemoryTransport()
+    n = RaftNode(raft_id=1, transport=router.for_node(1), storage=s,
+                 rng=random.Random(3))
+    router.register(n)
+    n.bootstrap([Peer(1, "n1", "mem://1"), Peer(2, "n2", "mem://2")])
+
+    observed = []
+    orig_send = router.send
+
+    def spy_send(frm, msg):
+        st = RaftStorage(str(tmp_path / "r"), dek=None).load()
+        observed.append((msg.kind, st.term if st else 0,
+                         st.voted_for if st else None))
+        orig_send(frm, msg)
+
+    router.send = spy_send
+    n.step(VoteRequest(frm=2, to=1, term=5, last_log_index=9,
+                       last_log_term=5))
+    n.process_all()
+    grants = [o for o in observed if o[0] == "vote_resp"]
+    assert grants, "no vote response left the node"
+    kind, term_on_disk, voted_on_disk = grants[0]
+    assert term_on_disk == 5 and voted_on_disk == 2
+
+
+# ------------------------------------------------- crash recovery / WAL
+
+
+def collect_applier(sink):
+    def apply(e):
+        sink.append(e.data)
+    return apply
+
+
+def test_crash_recovery_replays_batched_wal_append(tmp_path):
+    s = plain_storage(tmp_path)
+    c = RaftCluster(1, storages={1: s})
+    leader = c.tick_until_leader()
+    for k in range(50):
+        leader.propose({"op": k}, f"p{k}", lambda ok, err: None)
+    leader.process_all()   # one batched append of 50 entries
+    commit = leader.commit_index
+    c.nodes[1].stop()
+
+    st = plain_storage(tmp_path).load()
+    assert [e.index for e in st.entries] == list(
+        range(1, leader._last_index() + 1))
+
+    applied = []
+    router = MemoryTransport()
+    n = RaftNode(raft_id=1, transport=router.for_node(1),
+                 storage=plain_storage(tmp_path),
+                 apply_entry=collect_applier(applied),
+                 rng=random.Random(1))
+    router.register(n)
+    assert n._last_index() >= commit
+    assert applied == [{"op": k} for k in range(50)]
+
+
+def test_crash_recovery_replays_batched_wal_append_encrypted(tmp_path):
+    pytest.importorskip("cryptography")
+    from swarmkit_tpu.raft.storage import new_dek
+
+    dek = new_dek()
+    s = RaftStorage(str(tmp_path / "enc"), dek=dek)
+    c = RaftCluster(1, storages={1: s})
+    leader = c.tick_until_leader()
+    for k in range(20):
+        leader.propose({"op": k}, f"p{k}", lambda ok, err: None)
+    leader.process_all()
+    c.nodes[1].stop()
+
+    applied = []
+    router = MemoryTransport()
+    n = RaftNode(raft_id=1, transport=router.for_node(1),
+                 storage=RaftStorage(str(tmp_path / "enc"), dek=dek),
+                 apply_entry=collect_applier(applied),
+                 rng=random.Random(1))
+    router.register(n)
+    assert applied == [{"op": k} for k in range(20)]
+
+
+def test_torn_tail_is_repaired_so_later_appends_survive(tmp_path):
+    """ReadRepairWAL: the tear is truncated on disk at load, so records
+    appended AFTER recovery can never sit behind a corrupt record and get
+    silently dropped by the next reload."""
+    s = plain_storage(tmp_path)
+    s.append_entries([Entry(term=1, index=i, data={"op": i})
+                      for i in range(1, 6)])
+    s._close_wal()
+
+    seg = sorted((tmp_path / "r").glob("wal-*.jsonl"))[0]
+    lines = seg.read_bytes().splitlines()
+    assert len(lines) == 5
+    lines[3] = lines[3][: len(lines[3]) // 2]    # tear record 4; 5 intact
+    seg.write_bytes(b"\n".join(lines) + b"\n")
+
+    s2 = plain_storage(tmp_path)
+    st = s2.load()
+    assert [e.index for e in st.entries] == [1, 2, 3]
+
+    # post-recovery appends (a healthy leader re-replicates 4 and 5)
+    s2.append_entries([Entry(term=2, index=4, data={"op": "new4"}),
+                       Entry(term=2, index=5, data={"op": "new5"})])
+    s2._close_wal()
+    st2 = plain_storage(tmp_path).load()
+    assert [(e.index, e.data) for e in st2.entries] == [
+        (1, {"op": 1}), (2, {"op": 2}), (3, {"op": 3}),
+        (4, {"op": "new4"}), (5, {"op": "new5"})]
+
+
+def test_segmented_wal_compact_drops_whole_segments(tmp_path):
+    s = plain_storage(tmp_path, segment_bytes=1)   # every batch seals
+    for k in range(5):
+        lo = 2 * k + 1
+        s.append_entries([Entry(term=1, index=lo, data={"op": lo}),
+                          Entry(term=1, index=lo + 1, data={"op": lo + 1})])
+    segs = sorted((tmp_path / "r").glob("wal-*.jsonl"))
+    assert len(segs) == 5
+
+    s.compact(first_index=7)
+    remaining = sorted((tmp_path / "r").glob("wal-*.jsonl"))
+    assert len(remaining) == 2          # (7,8) and (9,10) survive whole
+    entries = s._read_wal()
+    assert [e.index for e in entries] == [7, 8, 9, 10]
+
+    # truncate at a segment boundary: whole segment unlinked
+    s.truncate_from(9)
+    assert [e.index for e in s._read_wal()] == [7, 8]
+    # truncate mid-segment: boundary segment rewritten
+    s.truncate_from(8)
+    assert [e.index for e in s._read_wal()] == [7]
+
+
+def test_hard_state_save_is_fsynced(tmp_path):
+    s = plain_storage(tmp_path)
+    before = s.meta_fsyncs
+    s.save_hard_state(term=4, voted_for=2, commit=17)
+    assert s.meta_fsyncs - before >= 2    # tmp-file fsync + dir fsync
+    st = plain_storage(tmp_path).load()
+    assert (st.term, st.voted_for, st.commit_index) == (4, 2, 17)
+
+
+# ------------------------------------------- commit-frontier replay parity
+
+
+def _live_commit_frontier(frontiers: list[int], term: int = 3) -> int:
+    """Drive the REAL leader commit rule (_maybe_advance_commit) with
+    manager durable frontiers: frontiers[0] is the leader's own log."""
+    router = MemoryTransport()
+    node = RaftNode(raft_id=1, transport=router.for_node(1),
+                    rng=random.Random(0))
+    router.register(node)
+    m = len(frontiers)
+    node.bootstrap([Peer(i, f"n{i}", f"mem://{i}")
+                    for i in range(1, m + 1)])
+    node.term = term
+    node.role = LEADER
+    node.log = [Entry(term=term, index=i)
+                for i in range(1, frontiers[0] + 1)]
+    node.match_index = {i + 2: f for i, f in enumerate(frontiers[1:])}
+    node._maybe_advance_commit()
+    return node.commit_index
+
+
+def test_fuzzed_commit_frontier_parity_with_replay_kernel():
+    """The live quorum-tally/commit-advance rule must stay decision-
+    identical to the TPU replay kernel (ops/raft_replay.replay_commit and
+    match_index_commit) over random ack matrices and quorum sizes."""
+    import numpy as np
+
+    from swarmkit_tpu.ops.raft_replay import match_index_commit, replay_commit
+
+    rng = random.Random(20250803)
+    for case in range(60):
+        m = rng.choice([1, 2, 3, 4, 5, 7])
+        e_max = rng.randrange(1, 32)
+        # the leader's own durable frontier is its whole log — a peer's
+        # match index can never exceed it (replication only ships what
+        # the leader has)
+        frontiers = [e_max] + [rng.randrange(0, e_max + 1)
+                               for _ in range(m - 1)]
+        quorum = m // 2 + 1
+
+        acks = np.zeros((m, e_max), bool)
+        for i, f in enumerate(frontiers):
+            acks[i, :f] = True
+        kernel_commit = int(replay_commit(acks, quorum)[0])
+        mi_commit = int(match_index_commit(
+            np.asarray(frontiers, np.int32), quorum))
+        live_commit = _live_commit_frontier(frontiers)
+
+        assert kernel_commit == live_commit, (case, frontiers)
+        # match_index_commit is the raw quorum'th-largest rule — identical
+        # on prefix-contiguous acks
+        assert mi_commit == kernel_commit, (case, frontiers)
+
+
+# ------------------------------------------------------- pipelined propose
+
+
+def test_propose_async_pipeline_shares_one_flush(tmp_path):
+    s = plain_storage(tmp_path)
+    c = RaftCluster(1, storages={1: s})
+    leader = c.tick_until_leader()
+    proposer = RaftProposer(leader)
+
+    batches0 = s.append_batches
+    order = []
+    handles = [proposer.propose_async(
+        [("op", k)], lambda version_index=None, k=k: order.append(k))
+        for k in range(20)]
+    assert not any(h.done for h in handles)
+    c.settle()
+    assert all(h.done for h in handles)
+    for h in handles:
+        h.result(timeout=0)
+    assert order == list(range(20))            # commit_cbs in log order
+    assert s.append_batches - batches0 == 1    # the whole window, one fsync
+
+
+def test_store_batch_pipelined_replicates_and_converges():
+    from swarmkit_tpu.api.objects import Task
+    from swarmkit_tpu.store.memory import MemoryStore
+
+    c = RaftCluster(3)
+    stores = {}
+    for i, node in c.nodes.items():
+        p = RaftProposer(node)
+        st = MemoryStore(proposer=p)
+        p.attach_store(st)
+        stores[i] = st
+    leader = c.tick_until_leader()
+    store = stores[leader.id]
+
+    def run_batch():
+        def fill(b):
+            for k in range(30):
+                t = Task(id=f"t{k}", service_id="svc")
+                b.update(lambda tx, t=t: tx.create(t))
+                b._flush()            # one sub-transaction per task
+        store.batch(fill, pipeline_depth=8)
+
+    err = []
+
+    def run():
+        try:
+            run_batch()
+        except Exception as exc:      # pragma: no cover - surfaced below
+            err.append(exc)
+
+    t = threading.Thread(target=run)
+    t.start()
+    deadline = time.monotonic() + 30
+    while t.is_alive() and time.monotonic() < deadline:
+        c.settle()
+        time.sleep(0.001)
+    t.join(timeout=5)
+    assert not t.is_alive(), "pipelined batch never completed"
+    assert not err, err
+    c.settle()
+
+    for i, st in stores.items():
+        tasks = st.view().find_tasks()
+        assert len(tasks) == 30, f"store {i} has {len(tasks)}"
+    versions = {tuple(sorted((x.id, x.meta.version.index)
+                             for x in st.view().find_tasks()))
+                for st in stores.values()}
+    assert len(versions) == 1, "replica version divergence"
+
+
+# ------------------------------------------------------ transport batching
+
+
+class _FakeClient:
+    alive = True
+
+    def __init__(self):
+        self.calls = []
+
+    def call(self, method, payload, timeout=None, **kw):
+        self.calls.append((method, payload))
+
+    def close(self):
+        pass
+
+
+def test_transport_sender_coalesces_backlog_into_step_many():
+    pytest.importorskip("swarmkit_tpu.rpc.client",
+                        reason="rpc client tier needs `cryptography`")
+    from swarmkit_tpu.raft.messages import AppendEntries
+    from swarmkit_tpu.raft.transport import NetworkTransport
+
+    tr = NetworkTransport(security=None, local_raft_id=1)
+    fake = _FakeClient()
+    tr._client = lambda peer_id: fake
+
+    box = queue.Queue(maxsize=64)
+    msgs = [AppendEntries(frm=1, to=5, term=2, prev_log_index=k)
+            for k in range(10)]
+    for m in msgs:
+        box.put_nowait(m)
+    box.put_nowait(None)   # stop sentinel rides behind the backlog
+    tr._outboxes[5] = box
+    t = threading.Thread(target=tr._sender_loop, args=(5, box))
+    t.start()
+    t.join(timeout=5)
+    assert not t.is_alive()
+
+    delivered = []
+    for method, payload in fake.calls:
+        if method == "raft.step_many":
+            delivered.extend(payload)
+        else:
+            assert method == "raft.step"
+            delivered.append(payload)
+    assert delivered == msgs, "messages lost or reordered"
+    assert any(m == "raft.step_many" for m, _ in fake.calls), \
+        "backlog was not coalesced"
+
+
+def test_step_many_service_checks_removed_sender():
+    pytest.importorskip("swarmkit_tpu.rpc.services",
+                        reason="rpc service tier needs `cryptography`")
+    from swarmkit_tpu.raft.messages import AppendEntries, MemberRemovedError
+    from swarmkit_tpu.rpc.services import build_registry
+
+    class _Node:
+        removed_ids = {9}
+
+        def __init__(self):
+            self.stepped = []
+
+        def step(self, msg):
+            self.stepped.append(msg)
+
+        is_leader = False
+        members = {}
+
+        def member_by_node_id(self, node_id):
+            return None
+
+    node = _Node()
+    reg = build_registry(raft_node=node)
+    handler = reg.get("raft.step_many")
+    ok_msgs = [AppendEntries(frm=2, to=1, term=1) for _ in range(3)]
+    handler(None, ok_msgs)
+    assert node.stepped == ok_msgs
+
+    node.stepped = []
+    with pytest.raises(MemberRemovedError):
+        handler(None, [AppendEntries(frm=9, to=1, term=1)])
+    assert node.stepped == []
+
+
+# --------------------------------------------------- changes_between window
+
+
+def test_changes_between_bisects_to_window():
+    router = MemoryTransport()
+    node = RaftNode(raft_id=1, transport=router.for_node(1),
+                    rng=random.Random(0))
+    router.register(node)
+    proposer = RaftProposer(node)
+
+    from swarmkit_tpu.api.objects import Version
+
+    node.log = [Entry(term=1, index=i,
+                      data=None if i % 4 == 0 else [("op", i)])
+                for i in range(1, 21)]
+    node.first_index = 1
+    got = proposer.changes_between(Version(5), Version(12))
+    assert got == [[("op", i)] for i in range(6, 13) if i % 4 != 0]
+    assert proposer.changes_between(Version(20), Version(25)) == []
+
+    # compacted window still raises (partial answers fork watchers)
+    node.log = node.log[9:]
+    node.first_index = 10
+    from swarmkit_tpu.raft.proposer import ProposeError
+
+    with pytest.raises(ProposeError):
+        proposer.changes_between(Version(5), Version(12))
