@@ -2,25 +2,6 @@
 
 Re-derivation of the reference `ca/` package (SURVEY.md §2.10)."""
 from .auth import Caller, PermissionDenied, authorize_forwarded, authorize_roles, caller_from_cert
-from .certificates import (
-    CertificateError,
-    CertIdentity,
-    RootCA,
-    cert_expiry,
-    create_csr,
-    parse_cert_identity,
-    renewal_due,
-)
-from .config import (
-    InvalidToken,
-    ParsedToken,
-    SecurityConfig,
-    generate_join_token,
-    parse_join_token,
-)
-from .keyreadwriter import KeyReadWriter
-from .renewer import TLSRenewer
-from .server import CAServer
 
 __all__ = [
     "Caller",
@@ -28,19 +9,56 @@ __all__ = [
     "authorize_forwarded",
     "authorize_roles",
     "caller_from_cert",
-    "CertificateError",
-    "CertIdentity",
-    "RootCA",
-    "cert_expiry",
-    "create_csr",
-    "parse_cert_identity",
-    "renewal_due",
-    "InvalidToken",
-    "ParsedToken",
-    "SecurityConfig",
-    "generate_join_token",
-    "parse_join_token",
-    "KeyReadWriter",
-    "TLSRenewer",
-    "CAServer",
 ]
+
+# gate on the `cryptography` wheel SPECIFICALLY — a genuine import bug in
+# the certificate modules must still fail loudly, not silently strip the
+# CA surface from the package
+try:
+    import cryptography  # noqa: F401
+
+    _HAVE_CRYPTO = True
+except ImportError:
+    # container without the optional wheel: authz (Caller, role gates)
+    # and the unix-socket rpc substrate still work; anything touching
+    # real certificates raises ImportError at its own import
+    _HAVE_CRYPTO = False
+
+if _HAVE_CRYPTO:
+    from .certificates import (
+        CertificateError,
+        CertIdentity,
+        RootCA,
+        cert_expiry,
+        create_csr,
+        parse_cert_identity,
+        renewal_due,
+    )
+    from .config import (
+        InvalidToken,
+        ParsedToken,
+        SecurityConfig,
+        generate_join_token,
+        parse_join_token,
+    )
+    from .keyreadwriter import KeyReadWriter
+    from .renewer import TLSRenewer
+    from .server import CAServer
+
+    __all__ += [
+        "CertificateError",
+        "CertIdentity",
+        "RootCA",
+        "cert_expiry",
+        "create_csr",
+        "parse_cert_identity",
+        "renewal_due",
+        "InvalidToken",
+        "ParsedToken",
+        "SecurityConfig",
+        "generate_join_token",
+        "parse_join_token",
+        "KeyReadWriter",
+        "TLSRenewer",
+        "CAServer",
+    ]

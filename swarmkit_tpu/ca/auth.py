@@ -14,7 +14,6 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from ..api.types import NodeRole
-from .certificates import CertIdentity, parse_cert_identity
 
 
 class PermissionDenied(Exception):
@@ -32,7 +31,12 @@ class Caller:
 
 
 def caller_from_cert(cert_pem: bytes) -> Caller:
-    ident: CertIdentity = parse_cert_identity(cert_pem)
+    # imported lazily: authz logic (and the rpc substrate over unix
+    # sockets) must work without the optional `cryptography` wheel —
+    # only actual certificate parsing needs it
+    from .certificates import parse_cert_identity
+
+    ident = parse_cert_identity(cert_pem)
     return Caller(node_id=ident.node_id, role=ident.role, org=ident.org)
 
 

@@ -18,7 +18,9 @@ class TLSRenewer:
     RequestAndSaveNewCertificates:234)."""
 
     def __init__(self, security: SecurityConfig, ca_server,
-                 check_interval: float = 1.0, clock=None):
+                 check_interval: float = 1.0, clock=None,
+                 retry_policy=None):
+        from ..utils.backoff import Backoff
         from ..utils.clock import REAL_CLOCK
 
         self.security = security
@@ -28,6 +30,15 @@ class TLSRenewer:
         # ClockSource seam): tests drive the renewal window with FakeClock
         # instead of waiting out real certificate lifetimes
         self.clock = clock or REAL_CLOCK
+        # unified retry policy (utils/backoff.py): a failed renewal
+        # round-trip backs off exponentially with jitter instead of
+        # hammering the CA every check_interval (the reference's
+        # renewer backoff, ca/renewer.go expBackoff); each retry is a
+        # FRESH CSR, so it picks up the current rotation_epoch
+        self.retry_policy = retry_policy or Backoff(
+            base=check_interval, factor=2.0,
+            max_delay=30 * check_interval, max_attempts=1 << 30)
+        self._failures = 0
         self._stop = threading.Event()
         self._renew_now = threading.Event()
         self._thread: threading.Thread | None = None
@@ -74,14 +85,25 @@ class TLSRenewer:
 
     def _run(self):
         while not self._stop.is_set():
-            triggered = self.clock.wait(self._renew_now,
-                                        self.check_interval)
+            # after consecutive failures the wait stretches to the
+            # policy's (jittered) delay; renew_now still short-circuits
+            wait = self.check_interval
+            if self._failures:
+                wait = max(wait, self.retry_policy.delay(
+                    self._failures - 1))
+            triggered = self.clock.wait(self._renew_now, wait)
             if self._stop.is_set():
                 return
             if triggered:
                 self._renew_now.clear()
+                self._failures = 0     # an explicit kick retries at once
             if triggered or self.security.renewal_due(self.clock.time()):
                 try:
-                    self.renew_once()
+                    ok = self.renew_once()
                 except Exception:
-                    pass  # retried next interval (reference retries w/ backoff)
+                    ok = False
+                # renew_once()==False is retryable the same way (a cert
+                # still pending under a mid-flight root rotation): the
+                # next attempt issues a FRESH CSR under the current
+                # rotation epoch
+                self._failures = 0 if ok else self._failures + 1

@@ -33,6 +33,7 @@ from ..api.types import NodeStatusState, TaskState
 from ..store import by
 from ..store.memory import MemoryStore
 from ..store.watch import Channel, WatchQueue
+from ..utils import failpoints
 from ..utils.identity import new_id
 from ..utils.metrics import histogram
 from .heartbeat import Heartbeat
@@ -371,6 +372,10 @@ class Dispatcher:
         """reference: dispatcher.go:1317-1335. The grace window re-arms
         from the CURRENT period so live reconfig applies to existing
         sessions too (nodes.go updatePeriod)."""
+        # failpoint `dispatcher.heartbeat`: error = beats lost before
+        # the timer re-arms (a heartbeat-miss storm: sessions expire,
+        # nodes flip DOWN, tasks orphan); delay = a stalled dispatcher
+        failpoints.fp("dispatcher.heartbeat")
         session = self._session(node_id, session_id)
         session.heartbeat.beat(self.heartbeat_period * GRACE_MULTIPLIER)
         return self._jittered_period()

@@ -47,6 +47,8 @@ import time
 from collections import deque
 from typing import Callable
 
+from ..utils import failpoints
+
 # a commit plane never needs depth beyond the tick pipeline's (the
 # barrier at each tick keeps at most one wave's heavy half in flight
 # per pipeline slot); the bound exists so a driver bug fails loudly
@@ -97,6 +99,10 @@ class CommitWorker:
                 job = self._jobs.popleft()
             t0 = time.perf_counter()
             try:
+                # failpoint `commit.worker.job`: a worker-side crash at
+                # the job boundary — exercises the poison/heal contract
+                # without reaching into any particular commit stage
+                failpoints.fp("commit.worker.job")
                 job()
             except BaseException as exc:  # noqa: BLE001 — must not kill
                 # the thread (the harness fails the suite on unhandled

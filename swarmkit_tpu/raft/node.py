@@ -226,6 +226,15 @@ class RaftNode:
         self.ready_items = 0
         self.commits_applied = 0
 
+        # read-only degradation (ISSUE 3): an ENOSPC on the WAL demotes
+        # this node to a follower that keeps serving reads/heartbeats
+        # but REJECTS proposals, instead of crash-looping the worker.
+        # A periodic storage probe (election_tick cadence) lifts the
+        # degradation once the disk accepts durable writes again.
+        self.storage_degraded = False
+        self._degraded_elapsed = 0
+        self.storage_errors = 0
+
         self._recovered = False
         if auto_recover:
             self.recover()
@@ -418,14 +427,39 @@ class RaftNode:
         self.ready_flushes += 1
         if self._ready_entries:
             if self.storage is not None:
-                self.storage.append_entries(self._ready_entries)
+                try:
+                    self.storage.append_entries(self._ready_entries)
+                except OSError as exc:
+                    self._on_append_failure(exc)
+                    return
+                if self.storage_degraded:
+                    # the disk took a durable batch again: leave
+                    # read-only mode (the follower catch-up path heals
+                    # without waiting for the tick-driven probe)
+                    self.storage_degraded = False
+                    log.info("raft-%d: WAL writable again; leaving "
+                             "read-only degradation", self.id)
             self._ready_entries = []
         self._maybe_advance_commit()
         self._apply_committed()
         if self._hs_dirty:
             if self.storage is not None:
-                self.storage.save_hard_state(self.term, self.voted_for,
-                                             self.commit_index)
+                try:
+                    self.storage.save_hard_state(self.term, self.voted_for,
+                                                 self.commit_index)
+                except OSError as exc:
+                    # votes/term bumps are NOT durable: nothing buffered
+                    # may leave (a granted vote without a persisted
+                    # voted_for can elect two leaders across a restart)
+                    self.storage_errors += 1
+                    self._out_msgs.clear()
+                    self._append_dirty.clear()
+                    self._maybe_degrade(exc)
+                    log.warning("raft-%d: hardstate save failed (%s); "
+                                "holding %s", self.id, exc,
+                                "read-only" if self.storage_degraded
+                                else "retry")
+                    return
             # cleared only AFTER a successful save (like _ready_entries):
             # a failed write must leave the flag set so the next flush
             # retries before any message claims the state is durable
@@ -444,6 +478,60 @@ class RaftNode:
                     self.transport.send(m)
                 except Exception:
                     log.debug("raft-%d: send to %d failed", self.id, m.to)
+
+    def _on_append_failure(self, exc: OSError):
+        """A group append failed. The storage rolled the batch back, so
+        the volatile state must follow: the batch ATOMICALLY never
+        happened. Staged entries leave the in-memory log, every staged
+        proposal's wait callback fires with the error (no proposal may
+        hang forever on a dropped batch), and nothing buffered reaches
+        the network — the messages claim durability the flush never
+        provided. A leader steps down (it cannot persist its own log);
+        ENOSPC additionally degrades the node to a read-only follower
+        that keeps serving reads/heartbeats but rejects proposals."""
+        self.storage_errors += 1
+        batch, self._ready_entries = self._ready_entries, []
+        keep = batch[0].index - self.first_index
+        if keep >= 0:
+            self.log = self.log[:keep]
+        self.commit_index = max(self.last_applied,
+                                min(self.commit_index, self._last_index()))
+        err = f"raft storage append failed: {exc}"
+        for e in batch:
+            cb = self._waits.pop(e.request_id, None) if e.request_id else None
+            if cb is not None:
+                try:
+                    cb(False, err)
+                except Exception:
+                    log.exception("raft-%d: wait callback failed", self.id)
+        self._out_msgs.clear()
+        self._append_dirty.clear()
+        log.warning("raft-%d: WAL append of %d entries failed: %s",
+                    self.id, len(batch), exc)
+        self._maybe_degrade(exc)
+        if self.role == LEADER:
+            # a leader that cannot persist its log must not keep
+            # accepting work; let a disk-healthy peer take over
+            self._become_follower(self.term, None)
+
+    def _maybe_degrade(self, exc):
+        import errno as _errno
+
+        # a WEDGED storage (failed batch whose rollback also failed)
+        # must degrade too: probe() is the only un-wedge path and it
+        # only runs from the degradation loop
+        wedged = self.storage is not None \
+            and getattr(self.storage, "_wedged", False)
+        if getattr(exc, "errno", None) != _errno.ENOSPC and not wedged:
+            return
+        if not self.storage_degraded:
+            self.storage_degraded = True
+            self._degraded_elapsed = 0
+            log.warning("raft-%d: WAL %s; degrading to read-only "
+                        "follower", self.id,
+                        "wedged" if wedged else "out of space")
+        if self.role == LEADER:
+            self._become_follower(self.term, None)
 
     def _dispatch(self, item):
         self.ready_items += 1
@@ -470,6 +558,18 @@ class RaftNode:
     def _on_tick(self):
         if self._transfer_cooldown > 0:
             self._transfer_cooldown -= 1
+        if self.storage_degraded:
+            # read-only degradation: probe the disk at election_tick
+            # cadence; a writable disk lifts the degradation (the
+            # follower append path also lifts it on its first durable
+            # batch)
+            self._degraded_elapsed += 1
+            if self._degraded_elapsed >= self.election_tick:
+                self._degraded_elapsed = 0
+                if self.storage is not None and self.storage.probe():
+                    self.storage_degraded = False
+                    log.info("raft-%d: storage probe succeeded; leaving "
+                             "read-only degradation", self.id)
         if self.role == LEADER:
             self.heartbeat_elapsed += 1
             if self.heartbeat_elapsed >= self.heartbeat_tick:
@@ -910,6 +1010,11 @@ class RaftNode:
 
     # ------------------------------------------------------------- proposing
     def _on_propose(self, data, request_id, callback):
+        if self.storage_degraded:
+            # read-only: reads/heartbeats keep flowing, writes bounce
+            callback(False, "storage degraded (read-only): out of disk "
+                            "space; proposal rejected")
+            return
         if self.role != LEADER or not self._signalled:
             # an unsignalled leader has unapplied earlier-term entries;
             # accepting a proposal now deadlocks the applier against the
@@ -927,6 +1032,10 @@ class RaftNode:
         # the commit (single-node clusters commit right at the flush)
 
     def _on_conf_change(self, cc: ConfChange, request_id, callback):
+        if self.storage_degraded:
+            callback(False, "storage degraded (read-only): out of disk "
+                            "space; conf change rejected")
+            return
         if self.role != LEADER or not self._signalled:
             callback(False, f"{ERR_NOT_LEADER}; leader is {self.leader_id}")
             return
@@ -1260,4 +1369,8 @@ class RaftNode:
             "ready_flushes": self.ready_flushes,
             "ready_items": self.ready_items,
             "commits_applied": self.commits_applied,
+            # fault plane: read-only degradation + append/hardstate
+            # failures observed (tests and the operator surface read it)
+            "storage_degraded": self.storage_degraded,
+            "storage_errors": self.storage_errors,
         }

@@ -43,6 +43,7 @@ except ImportError:                      # container without the wheel:
         pass
 
 from ..rpc import codec
+from ..utils import failpoints
 from ..utils.metrics import counter_family
 from .messages import ConfChange, Entry
 from .node import Peer
@@ -171,6 +172,12 @@ class RaftStorage:
         self.meta_fsyncs = 0             # hardstate/membership/snapshot/dir
         self.append_batches = 0
         self.entries_appended = 0
+        # set when a failed batch could not be rolled back: the active
+        # segment may carry a torn tail, so further appends would land
+        # AFTER it and be dropped by the next load's ReadRepair —
+        # refuse them until probe() confirms writability (it repairs)
+        self._wedged = False
+        self._torn_boundary: tuple[str, int] | None = None
 
     # ------------------------------------------------------------- segments
     def _seg_path(self, seq: int) -> str:
@@ -213,16 +220,49 @@ class RaftStorage:
     def append_entries(self, entries: list[Entry]):
         """Group commit: the whole batch is one buffered write + ONE fsync
         (the raft worker's Ready flush calls this once per batch, not once
-        per proposal)."""
+        per proposal).
+
+        Failure contract: the batch is ATOMIC. Any write/fsync error
+        rolls the active segment back to its pre-batch length — so a
+        torn short-write never leaves a tail that load-time ReadRepair
+        would heal by DROPPING later segments (post-failure appends must
+        survive the next reload) — and re-raises to the caller, which
+        owns failing the staged proposals. If even the rollback fails,
+        the storage wedges and refuses appends until `probe()` confirms
+        the disk is writable again."""
         if not entries:
             return
         with self._lock:
+            if self._wedged:
+                raise OSError(
+                    "raft WAL wedged after a failed rollback; "
+                    "probe() must confirm writability first")
             f = self._open_active()
             buf = b"".join(self.sealer.seal(codec.dumps(e)) + b"\n"
                            for e in entries)
-            f.write(buf)
-            f.flush()
-            os.fsync(f.fileno())
+            try:
+                # failpoint `raft.wal.write`: error before any byte lands
+                failpoints.fp("raft.wal.write")
+                # failpoint `raft.wal.torn_write` (value = fraction): a
+                # SHORT write reaches disk, then the device errors — the
+                # torn-tail shape a crash mid-batch leaves behind
+                torn = failpoints.fp_value("raft.wal.torn_write")
+                if torn is not None:
+                    cut = max(1, min(len(buf) - 1,
+                                     int(len(buf) * float(torn))))
+                    f.write(buf[:cut])
+                    f.flush()
+                    os.fsync(f.fileno())
+                    raise OSError("injected torn write")
+                f.write(buf)
+                f.flush()
+                # failpoint `raft.wal.fsync`: fsync error — arm with
+                # failpoints.enospc for the read-only degradation path
+                failpoints.fp("raft.wal.fsync")
+                os.fsync(f.fileno())
+            except OSError:
+                self._rollback_active(f)
+                raise
             self.wal_fsyncs += 1
             self.append_batches += 1
             self.entries_appended += len(entries)
@@ -235,6 +275,61 @@ class RaftStorage:
                                  else (first, last))
             if self._active_bytes >= self._segment_bytes:
                 self._seal_active()
+
+    def _rollback_active(self, f):
+        """Restore the active segment to its pre-batch length after a
+        failed group append (called under self._lock). `_active_bytes`
+        is the last known-good boundary, so truncating back to it makes
+        the failed batch atomic on disk. If the rollback itself fails,
+        the storage wedges: the sealed segment's byte boundary is
+        remembered so probe() can finish the repair once the disk
+        recovers."""
+        try:
+            f.truncate(self._active_bytes)
+            f.flush()
+            os.fsync(f.fileno())
+            self.meta_fsyncs += 1
+            _fsyncs.inc(("meta",))
+        except OSError:
+            log.exception("raft WAL: rollback of a failed batch failed; "
+                          "wedging storage until a successful probe")
+            self._wedged = True
+            self._torn_boundary = (self._seg_path(self._active_seq),
+                                   self._active_bytes)
+            self._seal_active()
+
+    def probe(self) -> bool:
+        """Writability probe for the read-only degradation loop: True
+        when the disk accepts a small durable write again. Goes through
+        the same `raft.wal.fsync` failpoint as the group append, so
+        injected ENOSPC keeps the caller degraded until disarmed. A
+        successful probe also completes the deferred torn-tail repair of
+        a wedged storage (truncate back to the last good boundary)."""
+        path = os.path.join(self.dir, ".probe")
+        with self._lock:
+            try:
+                failpoints.fp("raft.wal.fsync")
+                with open(path, "wb") as f:
+                    f.write(b"ok")
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.unlink(path)
+            except OSError:
+                return False
+            if self._wedged:
+                if self._torn_boundary is not None:
+                    try:
+                        seg_path, good = self._torn_boundary
+                        if os.path.exists(seg_path):
+                            with open(seg_path, "rb+") as f:
+                                f.truncate(good)
+                                f.flush()
+                                os.fsync(f.fileno())
+                    except OSError:
+                        return False
+                self._torn_boundary = None
+                self._wedged = False
+            return True
 
     def truncate_from(self, index: int):
         """Drop WAL entries at or after `index` (conflict truncation).
@@ -317,6 +412,10 @@ class RaftStorage:
         never surface an empty or stale file (the pre-fsync version could —
         the rename could reach disk before the tmp file's data blocks)."""
         tmp = path + ".tmp"
+        # failpoint `raft.meta.write`: hardstate/membership/snapshot
+        # write failures (incl. ENOSPC); atomicity means the old file
+        # survives intact
+        failpoints.fp("raft.meta.write")
         with open(tmp, "wb") as f:
             f.write(data)
             f.flush()

@@ -15,10 +15,12 @@ from __future__ import annotations
 
 import logging
 import queue
+import random
 import threading
-import time
 
 from ..rpc.client import RPCClient
+from ..utils import failpoints
+from ..utils.backoff import Backoff
 from .messages import MemberRemovedError
 
 log = logging.getLogger("swarmkit_tpu.raft.transport")
@@ -26,7 +28,11 @@ log = logging.getLogger("swarmkit_tpu.raft.transport")
 OUTBOX_LIMIT = 1024          # per-peer; raft retransmits, drops are safe
 HEALTH_WINDOW = 10.0         # seconds: a peer is active if a send succeeded
 SEND_TIMEOUT = 5.0
-RECONNECT_BACKOFF = 1.0
+# reconnect pacing: exponential-jitter per peer (utils/backoff.py), reset
+# on the first successful send — replaces the old fixed 1 s pause, which
+# thundered every peer's redial in lockstep after a leader restart
+RECONNECT_POLICY = Backoff(base=0.2, factor=2.0, max_delay=2.0,
+                           max_attempts=1 << 30)
 # sender-side coalescing: a backlogged outbox drains up to this many
 # messages into ONE raft.step_many RPC instead of one round trip each
 # (the wire half of the group-commit plane; single messages still ride
@@ -37,10 +43,16 @@ SEND_BATCH = 64
 class NetworkTransport:
     """Implements the RaftNode transport seam (send/active) over RPC."""
 
-    def __init__(self, security, local_raft_id: int = 0):
+    def __init__(self, security, local_raft_id: int = 0, clock=None,
+                 reconnect_policy: Backoff = RECONNECT_POLICY):
+        from ..utils.clock import REAL_CLOCK
+
         self.security = security
         self.local_raft_id = local_raft_id
         self.node = None  # RaftNode, attached via set_node
+        self.clock = clock or REAL_CLOCK
+        self.reconnect_policy = reconnect_policy
+        self._rng = random.Random()
         self._lock = threading.Lock()
         self._outboxes: dict[int, queue.Queue] = {}
         self._threads: dict[int, threading.Thread] = {}
@@ -77,7 +89,8 @@ class NetworkTransport:
         with self._lock:
             last_ok = self._last_ok.get(peer_id)
             last_try = self._last_try.get(peer_id)
-        if last_ok is not None and time.monotonic() - last_ok < HEALTH_WINDOW:
+        if last_ok is not None and \
+                self.clock.monotonic() - last_ok < HEALTH_WINDOW:
             return True
         # never attempted yet: optimistic (a fresh member hasn't been dialed)
         return last_try is None
@@ -155,7 +168,17 @@ class NetworkTransport:
 
     def _sender_loop(self, peer_id: int, box: queue.Queue):
         backoff_until = 0.0
+        failures = 0    # consecutive failures; indexes the backoff policy
         stop_after_batch = False
+
+        def pace():
+            # exponential-jitter pause before the next attempt at this
+            # peer; failures reset on the first successful send
+            nonlocal backoff_until, failures
+            backoff_until = self.clock.monotonic() + \
+                self.reconnect_policy.delay(failures, self._rng)
+            failures += 1
+
         while not self._stopped.is_set() and not stop_after_batch:
             try:
                 msg = box.get(timeout=0.5)
@@ -177,23 +200,28 @@ class NetworkTransport:
                     stop_after_batch = True  # deliver, then exit
                     break
                 msgs.append(nxt)
-            now = time.monotonic()
+            now = self.clock.monotonic()
             with self._lock:
                 self._last_try[peer_id] = now
             if now < backoff_until:
                 continue  # drop while the peer is unreachable; raft resends
             client = self._client(peer_id)
             if client is None:
-                backoff_until = time.monotonic() + RECONNECT_BACKOFF
+                pace()
                 continue
             try:
+                # failpoint `raft.transport.send`: error = the peer link
+                # drops this batch (raft retransmits); delay = a latency
+                # spike on the peer link
+                failpoints.fp("raft.transport.send")
                 if len(msgs) == 1:
                     client.call("raft.step", msgs[0], timeout=SEND_TIMEOUT)
                 else:
                     client.call("raft.step_many", msgs, timeout=SEND_TIMEOUT)
                 with self._lock:
-                    self._last_ok[peer_id] = time.monotonic()
+                    self._last_ok[peer_id] = self.clock.monotonic()
                 backoff_until = 0.0
+                failures = 0
             except Exception as exc:
                 if isinstance(exc, MemberRemovedError):
                     # the peer answered with the TYPED removed marker: WE
@@ -207,9 +235,9 @@ class NetworkTransport:
                         log.info("raft transport: peer %d says we were "
                                  "removed from the cluster", peer_id)
                         node.notify_removed()
-                    backoff_until = time.monotonic() + RECONNECT_BACKOFF
+                    pace()
                     continue
                 log.debug("raft transport: send to %d failed: %s",
                           peer_id, exc)
                 client.close()
-                backoff_until = time.monotonic() + RECONNECT_BACKOFF
+                pace()

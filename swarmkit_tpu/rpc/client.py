@@ -15,6 +15,7 @@ import ssl
 import threading
 
 from ..store.watch import Channel
+from ..utils import backoff as _backoff
 from .wire import (
     CANCEL,
     ERR,
@@ -46,8 +47,6 @@ def _register_errors():
     if _KNOWN_ERRORS:
         return
     from ..ca.auth import PermissionDenied
-    from ..ca.config import InvalidToken
-    from ..ca.certificates import CertificateError
     from ..controlapi import errors as control_errors
     from ..dispatcher.dispatcher import DispatcherError, SessionInvalid
     from ..csi.plugin import CSIPluginError
@@ -61,12 +60,21 @@ def _register_errors():
             _KNOWN_ERRORS[obj.__name__] = obj
     # registered after control errors: ca.auth.PermissionDenied wins the
     # name collision (the authz edge is what the server raises)
-    for cls in (PermissionDenied, InvalidToken, CertificateError,
-                DispatcherError, SessionInvalid, ProposeError,
-                MemberRemovedError, CSIPluginError,
+    for cls in (PermissionDenied, DispatcherError, SessionInvalid,
+                ProposeError, MemberRemovedError, CSIPluginError,
                 ExistError, NotExistError, SequenceConflict,
                 KeyError, ValueError, TimeoutError):
         _KNOWN_ERRORS[cls.__name__] = cls
+    try:
+        # certificate-flow errors need the optional `cryptography` wheel;
+        # without it they just surface as generic RPCError by name
+        from ..ca.certificates import CertificateError
+        from ..ca.config import InvalidToken
+
+        _KNOWN_ERRORS[CertificateError.__name__] = CertificateError
+        _KNOWN_ERRORS[InvalidToken.__name__] = InvalidToken
+    except ImportError:
+        pass
 
 
 def _make_error(name: str, message: str) -> Exception:
@@ -96,33 +104,120 @@ class RPCClient:
                  root_cert_pem: bytes | None = None,
                  connect_timeout: float = 10.0):
         self.addr = addr
+        self._security = security
+        self._root_cert_pem = root_cert_pem
+        self._connect_timeout = connect_timeout
+        self._wlock = threading.Lock()
+        self._lock = threading.Lock()
+        self._dial_lock = threading.Lock()
+        self._next_id = 1
+        self._calls: dict[int, _PendingCall] = {}
+        self._streams: dict[int, Channel] = {}
+        self._user_closed = False
+        self._sock = self._connect()
+        self._closed = threading.Event()
+        self._demux = threading.Thread(target=self._demux_loop,
+                                       args=(self._sock, self._closed),
+                                       daemon=True,
+                                       name=f"rpc-demux-{addr}")
+        self._demux.start()
+
+    def _connect(self):
+        addr = self.addr
         if addr.startswith("unix://"):
             # local control socket: plain stream, filesystem perms are the
             # trust boundary (xnet) — no TLS, no identity needed
             import socket as _socket
 
             sock = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
-            sock.settimeout(connect_timeout)
+            sock.settimeout(self._connect_timeout)
             sock.connect(addr[len("unix://"):])
             sock.settimeout(None)
+            return sock
+        ctx = client_ssl_context(self._security, self._root_cert_pem)
+        return connect_tls(addr, ctx, timeout=self._connect_timeout)
+
+    def _redial(self):
+        """Replace a dead connection in place (retry_policy path only).
+        The old demux thread closed its own socket and set its own
+        closed-event; pending work on the old connection was already
+        failed, so a fresh socket + demux generation is safe to swap in."""
+        with self._dial_lock:
+            if not self._closed.is_set():
+                return
+            if self._user_closed:
+                raise ConnectionClosed(
+                    f"connection to {self.addr} is closed")
+            # fail anything still pending on the dying generation BEFORE
+            # the swap: the old demux's generation-guarded _fail_all may
+            # lose the race and skip, which would strand those calls for
+            # their full timeout
+            self._fail_all(ConnectionClosed(
+                f"connection to {self.addr} lost (redialing)"))
+            sock = self._connect()
+            closed = threading.Event()
             self._sock = sock
-        else:
-            ctx = client_ssl_context(security, root_cert_pem)
-            self._sock = connect_tls(addr, ctx, timeout=connect_timeout)
-        self._wlock = threading.Lock()
-        self._lock = threading.Lock()
-        self._next_id = 1
-        self._calls: dict[int, _PendingCall] = {}
-        self._streams: dict[int, Channel] = {}
-        self._closed = threading.Event()
-        self._demux = threading.Thread(target=self._demux_loop, daemon=True,
-                                       name=f"rpc-demux-{addr}")
-        self._demux.start()
+            self._closed = closed
+            self._demux = threading.Thread(
+                target=self._demux_loop, args=(sock, closed), daemon=True,
+                name=f"rpc-demux-{self.addr}")
+            self._demux.start()
 
     # -- public ------------------------------------------------------------
+    @staticmethod
+    def _retry_safe(exc: Exception, idempotent: bool) -> bool:
+        """True when retrying `exc` cannot double-execute the request:
+        either the request provably never reached the server (unsent
+        ConnectionClosed, a failed dial), or the caller declared the
+        method idempotent (then maybe-executed transients retry too)."""
+        if getattr(exc, "unsent", False):
+            return True
+        if isinstance(exc, OSError) and not isinstance(
+                exc, (ConnectionClosed, TimeoutError)):
+            # dial failure from _redial: nothing was ever sent. Builtin
+            # TimeoutError IS an OSError subclass and means the request
+            # was sent and may have executed — excluded here, it only
+            # retries under the idempotent opt-in below
+            return True
+        if idempotent:
+            return isinstance(exc, (ConnectionClosed, TimeoutError, OSError))
+        return False
+
     def call(self, method: str, *args,
-             timeout: float = DEFAULT_CALL_TIMEOUT, **kwargs):
-        if self._closed.is_set():
+             timeout: float = DEFAULT_CALL_TIMEOUT,
+             retry_policy: "_backoff.Backoff | None" = None,
+             idempotent: bool = False,
+             retry_clock=None, retry_rng=None, **kwargs):
+        """Unary call. With `retry_policy` (utils/backoff.Backoff) the
+        client retries — redialing a dead connection — but ONLY the
+        provably-unsent failures unless `idempotent=True` opts
+        maybe-executed transients (timeouts, mid-call connection loss)
+        in as well. Sleeps ride `retry_clock` (FakeClock-able) and the
+        jitter `retry_rng` for deterministic tests."""
+        if retry_policy is None:
+            return self._call_once(method, args, kwargs, timeout)
+        attempt = 0
+        while True:
+            try:
+                if self._closed.is_set():
+                    self._redial()
+                return self._call_once(method, args, kwargs, timeout)
+            except Exception as exc:
+                if attempt + 1 >= retry_policy.max_attempts \
+                        or not self._retry_safe(exc, idempotent):
+                    raise
+                log.debug("rpc-client %s: retrying %s after %s",
+                          self.addr, method, exc)
+                _backoff.sleep(retry_clock or _backoff.REAL_CLOCK,
+                               retry_policy.delay(attempt, retry_rng))
+                attempt += 1
+
+    def _call_once(self, method: str, args, kwargs, timeout: float):
+        # generation snapshot: a concurrent _redial may swap sock/closed
+        # mid-call; failures observed on THIS generation must not kill
+        # calls pending on a newer one
+        closed, sock = self._closed, self._sock
+        if closed.is_set():
             # the request was never sent: callers may retry it on a fresh
             # connection even for writes (nothing reached the server) —
             # the post-rotation window where a server reloading its TLS
@@ -134,16 +229,17 @@ class RPCClient:
         pending = _PendingCall()
         stream_id = self._register(calls=pending)
         try:
-            send_frame(self._sock, self._wlock,
+            send_frame(sock, self._wlock,
                        [REQ, stream_id, method, ((args), kwargs)])
         except OSError as exc:
             self._unregister(stream_id)
-            self._fail_all(ConnectionClosed(str(exc)))
+            if self._closed is closed:
+                self._fail_all(ConnectionClosed(str(exc)))
             # a partial frame is unparseable — the server cannot have
             # executed this request; safe to retry on a new connection
-            closed = ConnectionClosed(str(exc))
-            closed.unsent = True
-            raise closed from exc
+            unsent = ConnectionClosed(str(exc))
+            unsent.unsent = True
+            raise unsent from exc
         if not pending.event.wait(timeout):
             self._unregister(stream_id)
             raise TimeoutError(f"{method} timed out after {timeout}s")
@@ -155,16 +251,18 @@ class RPCClient:
                **kwargs) -> Channel:
         """Open a server stream; returns a Channel of items. The channel
         closes on stream end, server error, or connection loss."""
-        if self._closed.is_set():
+        closed, sock = self._closed, self._sock
+        if closed.is_set():
             raise ConnectionClosed(f"connection to {self.addr} is closed")
         ch = Channel(matcher=None, limit=limit)
         stream_id = self._register(stream=ch)
         try:
-            send_frame(self._sock, self._wlock,
+            send_frame(sock, self._wlock,
                        [REQ, stream_id, method, ((args), kwargs)])
         except OSError as exc:
             self._unregister(stream_id)
-            self._fail_all(ConnectionClosed(str(exc)))
+            if self._closed is closed:
+                self._fail_all(ConnectionClosed(str(exc)))
             raise ConnectionClosed(str(exc)) from exc
         return ch
 
@@ -184,6 +282,7 @@ class RPCClient:
         return not self._closed.is_set()
 
     def close(self):
+        self._user_closed = True   # a retry_policy call must not redial
         self._closed.set()
         # wake the demux thread only; the fd is closed by ITS finally
         # (safe_close under the write lock) once it is out of recv. An
@@ -224,10 +323,13 @@ class RPCClient:
         for ch in streams:
             ch.close()
 
-    def _demux_loop(self):
+    def _demux_loop(self, sock, closed):
+        # sock/closed are THIS generation's: after a _redial swaps in a
+        # fresh connection, the old demux's teardown must only touch its
+        # own socket and must not fail calls pending on the new one
         try:
-            while not self._closed.is_set():
-                ftype, sid, head, payload = recv_frame(self._sock)
+            while not closed.is_set():
+                ftype, sid, head, payload = recv_frame(sock)
                 if ftype == RESP:
                     with self._lock:
                         pending = self._calls.pop(sid, None)
@@ -255,8 +357,9 @@ class RPCClient:
                     if stream is not None:
                         stream.close()
         except (ConnectionClosed, OSError, ssl.SSLError) as exc:
-            self._closed.set()
-            self._fail_all(ConnectionClosed(str(exc)))
+            closed.set()
+            if self._closed is closed:
+                self._fail_all(ConnectionClosed(str(exc)))
         finally:
-            self._closed.set()
-            safe_close(self._sock, self._wlock)
+            closed.set()
+            safe_close(sock, self._wlock)

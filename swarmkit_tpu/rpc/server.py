@@ -18,11 +18,13 @@ import logging
 import socket
 import ssl
 import threading
+import time
 from dataclasses import dataclass
 from typing import Callable
 
 from ..ca.auth import Caller, PermissionDenied
 from ..store.watch import Channel, ChannelClosed
+from ..utils import failpoints
 from .wire import (
     CANCEL,
     ERR,
@@ -107,6 +109,13 @@ class RPCServer:
         self._threads: list[threading.Thread] = []
         self._conns: set = set()
         self._conns_lock = threading.Lock()
+        # in-flight request handlers: stop() drains these behind a
+        # deadline BEFORE shutting connections, so a reply that is
+        # already being computed still reaches the caller instead of
+        # dying on a reset mid-frame (the race the reset-mid-frame
+        # failpoint exposes)
+        self._inflight = 0
+        self._inflight_cond = threading.Condition()
         self.addr: str | None = None  # actual host:port after bind
         # renewed certs / rotated roots apply to new connections
         if unix_path is None:
@@ -162,7 +171,11 @@ class RPCServer:
         t.start()
         self._threads.append(t)
 
-    def stop(self):
+    def stop(self, drain_timeout: float = 2.0):
+        """Shut down: listener first (no new connections), then DRAIN
+        in-flight handlers behind `drain_timeout` so computed replies
+        reach their callers, then shut the connections. Streaming pumps
+        observe _stop and wind down on their own within the drain."""
         self._stop.set()
         if self._sock is not None:
             try:
@@ -176,6 +189,16 @@ class RPCServer:
                 os.unlink(self.unix_path)
             except OSError:
                 pass
+        deadline = time.monotonic() + max(0.0, drain_timeout)
+        with self._inflight_cond:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    log.warning("rpc-server %s: %d handler(s) still "
+                                "in flight past the drain deadline",
+                                self.addr, self._inflight)
+                    break
+                self._inflight_cond.wait(remaining)
         with self._conns_lock:
             conns = list(self._conns)
         for c in conns:
@@ -228,8 +251,12 @@ class RPCServer:
                 frame = recv_frame(conn)
                 ftype, stream_id, head, payload = frame
                 if ftype == REQ:
+                    # counted BEFORE the thread starts so stop()'s drain
+                    # cannot observe zero while a handler is being born
+                    with self._inflight_cond:
+                        self._inflight += 1
                     t = threading.Thread(
-                        target=self._handle_request,
+                        target=self._handle_tracked,
                         args=(conn, wlock, caller, stream_id, head, payload,
                               cancels),
                         daemon=True, name=f"rpc-call-{head}")
@@ -250,6 +277,14 @@ class RPCServer:
             safe_close(conn, wlock)
 
     # -- dispatch ----------------------------------------------------------
+    def _handle_tracked(self, *args):
+        try:
+            self._handle_request(*args)
+        finally:
+            with self._inflight_cond:
+                self._inflight -= 1
+                self._inflight_cond.notify_all()
+
     def _handle_request(self, conn, wlock, caller: Caller | None,
                         stream_id: int, method: str, payload, cancels):
         import time as _time
@@ -315,6 +350,10 @@ class RPCServer:
                 reply_err(PermissionDenied(f"{method}: role not authorized"))
                 return
         try:
+            # failpoint `rpc.server.handle`: delay = a slow handler (the
+            # stop-drain path); error = a handler crash, surfaced to the
+            # caller as a wire error like any handler exception
+            failpoints.fp("rpc.server.handle")
             result = mdef.func(caller, *args, **kwargs)
         except Exception as exc:  # handler error -> wire error
             reply_err(exc)

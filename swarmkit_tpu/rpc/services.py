@@ -21,6 +21,7 @@ import threading
 
 from ..api.types import NodeRole
 from ..ca.auth import Caller, PermissionDenied
+from ..utils.backoff import DEFAULT_RPC
 from .client import RPCClient
 from .server import ANON, ServiceRegistry
 
@@ -586,20 +587,30 @@ class RemoteCA:
         raise ConnectionError(
             f"no reachable manager among {candidates}: {last}")
 
+    # all four CA methods are idempotent (CSR joins are retried with
+    # idempotent semantics server-side — round-3 invariant), so
+    # maybe-executed transients may retry under the unified policy too
     def issue_node_certificate(self, csr_pem, token=None, node_id=None,
                                caller=None):
         # `caller` is derived server-side from the TLS peer; accepted here
         # for in-process signature compatibility and ignored
         return self._conn().call("ca.issue_node_certificate", csr_pem,
-                                 token=token, node_id=node_id)
+                                 token=token, node_id=node_id,
+                                 retry_policy=DEFAULT_RPC,
+                                 idempotent=True)
 
     def node_certificate_status(self, node_id, timeout: float = 10.0):
-        # the long-poll happens server-side; give the RPC a little headroom
+        # the long-poll happens server-side; give the RPC a little
+        # headroom. NO retry policy: a timeout here must fail fast so
+        # _conn()'s multi-candidate failover rotates to the next manager
+        # instead of re-polling a dead one for attempts × deadline
         return self._conn().call("ca.node_certificate_status", node_id,
                                  timeout, timeout=timeout + 10.0)
 
     def get_root_ca_certificate(self):
-        return self._conn().call("ca.get_root_ca_certificate")
+        return self._conn().call("ca.get_root_ca_certificate",
+                                 retry_policy=DEFAULT_RPC,
+                                 idempotent=True)
 
     def close(self):
         with self._lock:

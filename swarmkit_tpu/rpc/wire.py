@@ -32,7 +32,7 @@ import tempfile
 import threading
 
 from ..ca.auth import Caller
-from ..ca.certificates import CertificateError, ou_to_role
+from ..utils import failpoints
 from . import codec
 
 REQ, RESP, ERR, STREAM_ITEM, STREAM_END, CANCEL = 1, 2, 3, 4, 5, 6
@@ -97,14 +97,32 @@ def shutdown_only(sock) -> None:
 
 
 def send_frame(sock, lock: threading.Lock, body: list) -> None:
+    # failpoint `rpc.wire.send`: error = connection reset before any byte
+    # leaves (provably unsent); delay = latency spike under the write lock
+    failpoints.fp("rpc.wire.send")
     data = codec.dumps(body)
     if len(data) > MAX_FRAME:
         raise ValueError(f"frame of {len(data)} bytes exceeds MAX_FRAME")
+    frame = _LEN.pack(len(data)) + data
     with lock:
-        sock.sendall(_LEN.pack(len(data)) + data)
+        # failpoint `rpc.wire.send.torn` (value = fraction in (0,1)):
+        # ship a PARTIAL frame then die — the peer sees a reset mid-frame
+        # and must treat the stream as unparseable from here on
+        torn = failpoints.fp_value("rpc.wire.send.torn")
+        if torn is not None:
+            cut = max(1, min(len(frame) - 1, int(len(frame) * float(torn))))
+            try:
+                sock.sendall(frame[:cut])
+            finally:
+                shutdown_only(sock)
+            raise OSError("injected reset mid-frame")
+        sock.sendall(frame)
 
 
 def recv_frame(sock) -> list:
+    # failpoint `rpc.wire.recv`: error = reset while waiting for a frame;
+    # delay = a stalled peer
+    failpoints.fp("rpc.wire.recv")
     header = _recv_exact(sock, _LEN.size)
     (length,) = _LEN.unpack(header)
     if length > MAX_FRAME:
@@ -205,6 +223,10 @@ def client_ssl_context(security=None, root_cert_pem: bytes | None = None) -> ssl
 def caller_from_socket(ssl_sock) -> Caller | None:
     """Authenticated identity from the peer certificate (subject CN/OU/O),
     None for anonymous (no client cert presented)."""
+    # lazy: only the TLS path needs certificate parsing; unix-socket RPC
+    # must work without the optional `cryptography` wheel
+    from ..ca.certificates import CertificateError, ou_to_role
+
     cert = ssl_sock.getpeercert()
     if not cert:
         return None

@@ -18,6 +18,7 @@ from operator import itemgetter
 import numpy as np
 
 from ..native import hostops as _hostops
+from ..utils import failpoints
 from .encode import UNLIMITED, EncodedProblem
 from .nodeinfo import NodeInfo, task_reservations
 from .spread import GroupFill, greedy_fill, tree_fill
@@ -188,6 +189,9 @@ def apply_placements(infos: list, placed_groups: list) -> int:
     incoming ids collide with tasks already on it falls back to per-task
     add_task for its whole segment; a None info (node removed between
     encode and commit) is skipped, uncounted."""
+    # failpoint `commit.walk`: a crash at the native-walk stage boundary
+    # (before any NodeInfo mutates — the all-or-nothing point)
+    failpoints.fp("commit.walk")
     # validate EVERYTHING before mutating anything: a mid-wave raise
     # would leave NodeInfo bookkeeping half-applied with no heal path
     checked: list[tuple] = []

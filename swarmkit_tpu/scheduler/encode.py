@@ -609,6 +609,13 @@ class IncrementalEncoder:
         if rows.size:
             self._fp_mut[rows] -= 1
 
+    def poison_all_numeric(self) -> None:
+        """Crash-path heal: poison EVERY row's numeric fingerprint. The
+        async commit worker can die before it even enters the job (so no
+        wave was recorded for the targeted heal) — any row may then
+        carry an optimistic fold no add_task ever backed."""
+        self._fp_mut -= 1
+
     def restamp_counts(self, p: EncodedProblem, counts: np.ndarray) -> bool:
         """Fingerprint half of apply_counts: stamp the add_task mutation
         bumps. Call exactly once per folded tick, after the add_task loop."""

@@ -40,6 +40,7 @@ from ..api.types import NodeStatusState, TaskState
 from ..store import by
 from ..store.memory import MemoryStore
 from ..store.watch import ChannelClosed
+from ..utils import failpoints
 from .batch import apply_placements, cpu_schedule_encoded, materialize_orders
 from .encode import IncrementalEncoder, TaskGroup
 from .filters import Pipeline
@@ -216,11 +217,32 @@ class Scheduler:
         """The commit's heavy half, run on the CommitWorker: slot
         materialization, store write-back with in-tx re-validation, the
         wave-bulk add_task walk, and the fingerprint restamp. An unclean
-        outcome is recorded for the next barrier's main-thread heal."""
-        orders = materialize_orders(problem, counts)
-        clean = self._apply_decisions(problem, orders, counts,
-                                      deferred_fold=True)
+        outcome is recorded for the next barrier's main-thread heal.
+
+        Failpoints bracket every stage boundary (`commit.materialize`,
+        `commit.writeback` before the store transaction + walk — the
+        walk itself has `commit.walk` in batch.apply_placements — and
+        `commit.restamp`): a crash at ANY of them must poison the plane
+        and heal at the next barrier, not just the boundaries production
+        incidents happen to hit."""
+        try:
+            failpoints.fp("commit.materialize")
+            orders = materialize_orders(problem, counts)
+            failpoints.fp("commit.writeback")
+            clean = self._apply_decisions(problem, orders, counts,
+                                          deferred_fold=True)
+        except BaseException:
+            # a CRASH in the heavy half is an unclean commit too: the
+            # optimistic fold already ran on the tick thread, but the
+            # add_task walk (the thing that bumps mutation counters) may
+            # not have — without recording the wave, the barrier heal
+            # would invalidate the device yet leave the encoder's folded
+            # rows as phantom reservations no fingerprint ever clears
+            # (found by the seeded chaos harness, CHAOS_SEED=0)
+            self._worker_unclean = (problem, counts)
+            raise
         if clean:
+            failpoints.fp("commit.restamp")
             self.encoder.restamp_counts(problem, counts)
         else:
             self._worker_unclean = (problem, counts)
@@ -401,9 +423,20 @@ class Scheduler:
                             # tick; the invalidate above plus the event-
                             # plane's ASSIGNED echoes heal the partial
                             # commit — un-poison the plane for the retry
+                            worker_died = self._commit_worker.failed
                             self._commit_worker.reset()
                             if self._worker_unclean is not None:
                                 self._heal_unclean()
+                            elif worker_died:
+                                # the worker died before recording which
+                                # wave it carried (crash pre-job): any
+                                # row may hold an unbacked optimistic
+                                # fold — poison them all (chaos-harness
+                                # regression). Gated on an ACTUAL worker
+                                # failure: a transient propose error must
+                                # not tax the next tick with a full
+                                # numeric re-encode
+                                self.encoder.poison_all_numeric()
                         from ..utils.leadership import leadership_lost
 
                         if leadership_lost(exc):

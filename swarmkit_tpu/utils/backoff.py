@@ -1,0 +1,108 @@
+"""Unified retry/backoff policy: exponential delay with full jitter.
+
+Before this module, ~20 call sites each hand-rolled their retry loop
+(fixed 1 s raft reconnect pauses, the renewer's "pass, retried next
+interval", RemoteControl's 0.5 s spin). Every caller-side retry now
+states an explicit, bounded policy:
+
+    policy = Backoff(base=0.05, factor=2.0, max_delay=2.0, max_attempts=5)
+    result = retry(dial, policy=policy, retryable=is_transient)
+
+Delays come from `Backoff.delay(attempt, rng)` — full jitter
+(uniform(0, min(max_delay, base*factor^attempt)), the AWS-recommended
+shape: retries from many clients decorrelate instead of thundering in
+lockstep. Sleeps go through an injectable Clock (utils/clock.py), so a
+FakeClock test drives every retry deterministically, and a seeded RNG
+makes the jitter itself reproducible.
+"""
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass
+from typing import Callable, TypeVar
+
+from .clock import REAL_CLOCK, Clock
+
+T = TypeVar("T")
+
+_DEFAULT_RNG = random.Random()
+
+
+@dataclass(frozen=True)
+class Backoff:
+    """Retry policy. Immutable: share one instance across callers.
+
+    max_attempts counts ALL tries including the first; max_attempts=1
+    means "no retry". jitter=False gives the deterministic envelope
+    (tests asserting exact delays)."""
+
+    base: float = 0.05
+    factor: float = 2.0
+    max_delay: float = 2.0
+    max_attempts: int = 5
+    jitter: bool = True
+
+    def envelope(self, attempt: int) -> float:
+        """Upper bound of the delay after failed attempt #`attempt`
+        (0-based). Unbounded policies (raft reconnect, CA renewal) feed
+        a monotonically growing attempt count — float pow overflows near
+        attempt 1024, so saturate to the cap instead of raising (an
+        OverflowError here would kill the retrying thread)."""
+        try:
+            raw = self.base * self.factor ** attempt
+        except OverflowError:
+            return self.max_delay
+        return min(self.max_delay, raw)
+
+    def delay(self, attempt: int, rng: random.Random | None = None) -> float:
+        cap = self.envelope(attempt)
+        if not self.jitter:
+            return cap
+        return (rng or _DEFAULT_RNG).uniform(0.0, cap)
+
+    def delays(self, rng: random.Random | None = None):
+        """The policy's delay sequence (max_attempts - 1 sleeps)."""
+        return [self.delay(i, rng) for i in range(self.max_attempts - 1)]
+
+
+# a shared conservative default for RPC-ish transients; callers with a
+# known failure profile (raft reconnect, CA renewal) declare their own
+DEFAULT_RPC = Backoff(base=0.05, factor=2.0, max_delay=2.0, max_attempts=4)
+
+
+def sleep(clock: Clock, delay: float) -> None:
+    """Clock-driven sleep: real time under Clock, fake-time under
+    FakeClock (advance() wakes it) — the seam that makes retry loops
+    deterministic in tests."""
+    if delay <= 0:
+        return
+    clock.wait(threading.Event(), delay)
+
+
+def retry(fn: Callable[[], T], *,
+          policy: Backoff,
+          retryable: Callable[[Exception], bool] = lambda exc: True,
+          clock: Clock | None = None,
+          rng: random.Random | None = None,
+          on_retry: Callable[[int, Exception, float], None] | None = None,
+          ) -> T:
+    """Run `fn` under `policy`: non-retryable errors and the final
+    attempt's error raise unchanged. `on_retry(attempt, exc, delay)`
+    observes each scheduled retry (logging/metrics)."""
+    clock = clock or REAL_CLOCK
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except Exception as exc:
+            if attempt + 1 >= policy.max_attempts or not retryable(exc):
+                raise
+            d = policy.delay(attempt, rng)
+            if on_retry is not None:
+                try:
+                    on_retry(attempt, exc, d)
+                except Exception:
+                    pass
+            sleep(clock, d)
+            attempt += 1

@@ -1,0 +1,292 @@
+"""Deterministic fault-injection plane: named failpoints.
+
+The reference gets its durability confidence from years of soak testing;
+this build gets it from *deterministic* fault injection instead. A
+failpoint is a named site compiled into production code as
+
+    failpoints.fp("raft.wal.fsync")
+
+which, disarmed, costs ONE module-global truthiness test and a dict miss
+— no allocation, no lock, no branch into policy code. Armed (per-test
+via the `armed()` context manager, or for subprocesses via the
+SWARMKIT_TPU_FAILPOINTS env var), a site can:
+
+  * raise a chosen exception (instance, class, or factory);
+  * inject latency (`delay=` seconds, real time);
+  * substitute a value (`value=` — read by `fp_value` sites, e.g. a
+    torn-write fraction);
+  * transform a payload (`transform=` — `fp_transform` sites);
+  * fire once / the first N times (`times=`), only after K clean passes
+    (`skip=`), every Nth evaluation (`every=`), or probabilistically
+    (`prob=` under a seeded RNG) — the chaos harness's mix, reproducible
+    from one seed.
+
+Site naming convention: `<layer>.<component>.<operation>` —
+`raft.wal.fsync`, `rpc.wire.send`, `commit.worker.job`,
+`dispatcher.heartbeat`. Sites live at DECISION boundaries (where an
+error changes durability, replication, or liveness behavior), never
+inside per-entry hot loops.
+
+Arming is copy-on-write on the registry dict, so firing threads never
+take the registry lock; each armed failpoint serializes its own
+counters under a private lock (sites fire from many threads).
+"""
+from __future__ import annotations
+
+import errno as _errno
+import os
+import random
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable
+
+_REG_LOCK = threading.Lock()
+# name -> _Failpoint; REPLACED wholesale on arm/disarm (copy-on-write):
+# `fp()` reads it without a lock. Empty when nothing is armed — the
+# disarmed fast path is `if not _ARMED: return`.
+_ARMED: dict[str, "_Failpoint"] = {}
+
+
+class FailpointError(Exception):
+    """Default injected error when a site is armed with error=True."""
+
+
+def _make_exc(spec) -> BaseException:
+    """Build a fresh exception per fire (re-raising one instance would
+    chain tracebacks across fires)."""
+    if spec is True:
+        return FailpointError("injected failure")
+    if isinstance(spec, BaseException):
+        # re-build same-type/same-args so every fire gets a clean
+        # traceback; OSError keeps its errno
+        if isinstance(spec, OSError) and spec.errno is not None:
+            return type(spec)(spec.errno, spec.strerror or str(spec))
+        return type(spec)(*spec.args) if spec.args else type(spec)(str(spec))
+    if isinstance(spec, type) and issubclass(spec, BaseException):
+        return spec("injected failure")
+    if callable(spec):
+        return spec()
+    raise TypeError(f"bad error spec for failpoint: {spec!r}")
+
+
+def enospc() -> OSError:
+    """Convenience: the ENOSPC OSError the WAL degradation contract keys
+    on (tests arm `raft.wal.fsync` with `error=failpoints.enospc`)."""
+    return OSError(_errno.ENOSPC, "No space left on device [injected]")
+
+
+class _Failpoint:
+    """One armed site. Counters are serialized under a private lock; the
+    action (raise/sleep/value) runs OUTSIDE it."""
+
+    def __init__(self, name: str, *,
+                 error: Any = None,
+                 delay: float = 0.0,
+                 value: Any = None,
+                 transform: Callable[[Any], Any] | None = None,
+                 prob: float = 1.0,
+                 times: int | None = None,
+                 skip: int = 0,
+                 every: int | None = None,
+                 rng: random.Random | None = None,
+                 on_fire: Callable[[str], None] | None = None):
+        self.name = name
+        self.error = error
+        self.delay = delay
+        self.value = value
+        self.transform = transform
+        self.prob = prob
+        self.times = times
+        self.skip = skip
+        self.every = every
+        self.rng = rng or random.Random(0)
+        self.on_fire = on_fire
+        self.evaluated = 0          # site reached while armed
+        self.fired = 0              # action actually taken
+        self._lock = threading.Lock()
+
+    def _should_fire(self) -> bool:
+        with self._lock:
+            self.evaluated += 1
+            if self.times is not None and self.fired >= self.times:
+                return False
+            if self.evaluated <= self.skip:
+                return False
+            if self.every is not None \
+                    and (self.evaluated - self.skip) % self.every != 0:
+                return False
+            if self.prob < 1.0 and self.rng.random() >= self.prob:
+                return False
+            self.fired += 1
+            return True
+
+    def _fire_common(self):
+        if self.on_fire is not None:
+            try:
+                self.on_fire(self.name)
+            except Exception:
+                pass
+        if self.delay:
+            time.sleep(self.delay)
+
+    def trigger(self):
+        """fp() semantics: sleep and/or raise."""
+        if not self._should_fire():
+            return
+        self._fire_common()
+        if self.error is not None:
+            raise _make_exc(self.error)
+
+    def trigger_value(self, default):
+        """fp_value() semantics: sleep/raise/substitute a value."""
+        if not self._should_fire():
+            return default
+        self._fire_common()
+        if self.error is not None:
+            raise _make_exc(self.error)
+        return self.value if self.value is not None else default
+
+    def trigger_transform(self, payload):
+        """fp_transform() semantics: sleep/raise/transform a payload."""
+        if not self._should_fire():
+            return payload
+        self._fire_common()
+        if self.error is not None:
+            raise _make_exc(self.error)
+        if self.transform is not None:
+            return self.transform(payload)
+        return payload
+
+
+# ------------------------------------------------------------------ sites
+def fp(name: str) -> None:
+    """Injection site: no-op unless `name` is armed; may sleep or raise."""
+    if not _ARMED:
+        return
+    p = _ARMED.get(name)
+    if p is not None:
+        p.trigger()
+
+
+def fp_value(name: str, default=None):
+    """Injection site that can substitute a value (e.g. a torn-write
+    fraction). Returns `default` unless armed and firing."""
+    if not _ARMED:
+        return default
+    p = _ARMED.get(name)
+    if p is None:
+        return default
+    return p.trigger_value(default)
+
+
+def fp_transform(name: str, payload):
+    """Injection site that can corrupt/shorten a payload in flight.
+    Returns `payload` unchanged unless armed and firing."""
+    if not _ARMED:
+        return payload
+    p = _ARMED.get(name)
+    if p is None:
+        return payload
+    return p.trigger_transform(payload)
+
+
+# ----------------------------------------------------------------- arming
+def arm(name: str, **kw) -> _Failpoint:
+    """Arm `name`; returns the failpoint (its .fired/.evaluated counters
+    are test observability). Re-arming replaces the previous config."""
+    p = _Failpoint(name, **kw)
+    with _REG_LOCK:
+        new = dict(_ARMED)
+        new[name] = p
+        _set_registry(new)
+    return p
+
+
+def disarm(name: str) -> None:
+    with _REG_LOCK:
+        if name in _ARMED:
+            new = dict(_ARMED)
+            new.pop(name, None)
+            _set_registry(new)
+
+
+def disarm_all() -> None:
+    with _REG_LOCK:
+        _set_registry({})
+
+
+def active() -> list[str]:
+    return sorted(_ARMED)
+
+
+def _set_registry(new: dict) -> None:
+    global _ARMED
+    _ARMED = new
+
+
+@contextmanager
+def armed(name: str, **kw):
+    """`with failpoints.armed("raft.wal.fsync", error=OSError): ...` —
+    the per-test arming surface; always disarms on exit."""
+    p = arm(name, **kw)
+    try:
+        yield p
+    finally:
+        disarm(name)
+
+
+# ---------------------------------------------------------------- env var
+# SWARMKIT_TPU_FAILPOINTS arms sites in subprocesses (multi-process swarmd
+# tests) where a context manager cannot reach:
+#   name=error:OSError:msg;name2=delay:0.05;name3=error:enospc,times:1
+_ENV_VAR = "SWARMKIT_TPU_FAILPOINTS"
+
+_ENV_ERRORS = {
+    "oserror": OSError,
+    "enospc": enospc,
+    "connectionreset": ConnectionResetError,
+    "timeout": TimeoutError,
+    "valueerror": ValueError,
+    "runtimeerror": RuntimeError,
+    "failpoint": FailpointError,
+}
+
+
+def _parse_env(spec: str) -> None:
+    for item in spec.split(";"):
+        item = item.strip()
+        if not item or "=" not in item:
+            continue
+        name, actions = item.split("=", 1)
+        kw: dict[str, Any] = {}
+        for action in actions.split(","):
+            parts = action.split(":")
+            kind = parts[0].strip().lower()
+            if kind == "error":
+                exc = _ENV_ERRORS.get(
+                    parts[1].strip().lower() if len(parts) > 1 else "",
+                    FailpointError)
+                if len(parts) > 2 and exc is not enospc:
+                    msg = parts[2]
+                    kw["error"] = (lambda e=exc, m=msg: e(m))
+                else:
+                    kw["error"] = exc
+            elif kind == "delay":
+                kw["delay"] = float(parts[1])
+            elif kind == "times":
+                kw["times"] = int(parts[1])
+            elif kind == "skip":
+                kw["skip"] = int(parts[1])
+            elif kind == "every":
+                kw["every"] = int(parts[1])
+            elif kind == "prob":
+                kw["prob"] = float(parts[1])
+            elif kind == "seed":
+                kw["rng"] = random.Random(int(parts[1]))
+        if kw:
+            arm(name.strip(), **kw)
+
+
+if os.environ.get(_ENV_VAR):
+    _parse_env(os.environ[_ENV_VAR])
