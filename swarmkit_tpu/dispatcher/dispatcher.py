@@ -36,7 +36,7 @@ from ..store.watch import Channel, WatchQueue
 from ..utils import failpoints
 from ..utils.identity import new_id
 from ..utils.metrics import histogram
-from .heartbeat import Heartbeat
+from .heartbeat import Heartbeat, HeartbeatWheel
 
 log = logging.getLogger("swarmkit_tpu.dispatcher")
 
@@ -98,7 +98,6 @@ class Session:
     node_id: str
     session_id: str
     channel: Channel
-    heartbeat: Heartbeat
     sequence: int = 0
     known_tasks: dict[str, int] = field(default_factory=dict)  # id -> version
     # id -> version: an UPDATED secret/config (e.g. rotated credential or a
@@ -107,6 +106,11 @@ class Session:
     known_secrets: dict[str, int] = field(default_factory=dict)
     known_configs: dict[str, int] = field(default_factory=dict)
     known_volumes: set[str] = field(default_factory=set)
+    # secret key -> base id AS RECORDED WHEN SHIPPED: removal-side
+    # reverse-map cleanup must not depend on the global _clone_bases
+    # entry still existing (another session retiring the same clone —
+    # task moved nodes — pops it eagerly)
+    known_bases: dict[str, str] = field(default_factory=dict)
     session_channel: Channel | None = None
     last_session_msg: SessionMessage | None = None
     # legacy Dispatcher.Tasks stream (pre-Assignments wire surface)
@@ -132,6 +136,12 @@ class Dispatcher:
         self.node_down_period = node_down_period
         self.rate_limit_period = rate_limit_period
         self._sessions: dict[str, Session] = {}
+        # session liveness rides ONE coarse-bucketed wheel (beat() is a
+        # dict write); the rare timers (leadership grace, orphaning)
+        # keep per-event Heartbeat objects
+        self._hb_wheel = HeartbeatWheel(
+            granularity=self._wheel_granularity(heartbeat_period),
+            clock=self.clock)
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -147,12 +157,62 @@ class Dispatcher:
         self._session_plane_dirty = False
         # (secret id, secret version, task id) -> materialized clone
         self._driver_cache: dict[tuple, object] = {}
+        # driver-clone id -> base secret id (clone ids are opaque to the
+        # known-secret diffing; the reverse reference maps key by base)
+        self._clone_bases: dict[str, str] = {}
+        # --- fan-out plane reverse indexes (assignments.go's reference
+        # sets): built incrementally from the event stream, consulted by
+        # _note_event and the flush instead of per-node table scans.
+        # node id -> volume ids with a PENDING_NODE_UNPUBLISH status for
+        # that node (forward map alongside for O(changed) maintenance).
+        # Values are FROZENSETS replaced wholesale: _pending_unpublish
+        # reads them INSIDE store-view callbacks, where taking the
+        # dispatcher lock would invert the RPC paths' dispatcher→store
+        # lock order (assignments() holds self._lock across store.view)
+        self._vol_pending_unpub: dict[str, frozenset] = {}
+        self._unpub_nodes_by_vol: dict[str, frozenset] = {}
+        self._vol_index_primed = False
+        # secret/config id -> node ids whose session was SHIPPED it
+        self._secret_refs: dict[str, set[str]] = {}
+        self._config_refs: dict[str, set[str]] = {}
+        # single-writer counters (flush thread / RPC threads); the
+        # op-count regression guard and bench storm sub-row read these
+        self.metrics = {"flushes": 0, "flush_tx": 0, "wire_copies": 0,
+                        "ships": 0, "last_flush_s": 0.0}
 
     # ------------------------------------------------------------- lifecycle
+    @staticmethod
+    def _wheel_granularity(period: float) -> float:
+        """Wheel tick width: ≤ ε so wheel lateness stays inside the
+        heartbeat epsilon's design slack, and ≤ period/2 so tiny test
+        periods still get several ticks inside their grace window."""
+        return min(HEARTBEAT_EPSILON, max(period / 2.0, 0.01))
+
     def start(self):
         # restartable across leadership cycles (manager.go recreates the
         # dispatcher per leadership; in-process, agents hold this object)
         self._stop = threading.Event()
+        with self._lock:
+            # retire the previous wheel FIRST: replacing it without
+            # stopping orphans its ticker, which re-arms forever. Swap +
+            # survivor re-arm form ONE critical section so a racing
+            # register() lands wholly before (its session is then in
+            # _sessions and re-armed here) or wholly after (it adds to
+            # the fresh wheel itself).
+            self._hb_wheel.stop()
+            self._hb_wheel = HeartbeatWheel(
+                granularity=self._wheel_granularity(self.heartbeat_period),
+                clock=self.clock)
+            grace = self.heartbeat_period * GRACE_MULTIPLIER
+            for s in self._sessions.values():
+                # sessions that registered before/through the restart
+                # window (the RPC plane serves register as soon as raft
+                # elects) — the old per-session timers survived a
+                # restart implicitly; the wheel must re-arm explicitly
+                self._hb_wheel.add(
+                    s.node_id, grace,
+                    lambda nid=s.node_id, sid=s.session_id:
+                    self._node_down(nid, sid))
         self._mark_nodes_unknown()
         self._arm_orphan_timers()
         self._thread = threading.Thread(target=self._run, daemon=True,
@@ -165,15 +225,21 @@ class Dispatcher:
             self._status_cond.notify_all()
         if self._thread:
             self._thread.join(timeout=5)
+        self._hb_wheel.stop()
         with self._lock:
             for s in self._sessions.values():
-                s.heartbeat.stop()
                 s.channel.close()
                 if s.session_channel is not None:
                     s.session_channel.close()
                 if s.tasks_channel is not None:
                     s.tasks_channel.close()
             self._sessions.clear()
+            self._secret_refs.clear()
+            self._config_refs.clear()
+            self._clone_bases.clear()
+            self._vol_pending_unpub.clear()
+            self._unpub_nodes_by_vol.clear()
+            self._vol_index_primed = False
             timers, self._unknown_timers = self._unknown_timers, {}
             orphans, self._orphan_timers = self._orphan_timers, {}
         for t in timers.values():
@@ -326,19 +392,15 @@ class Dispatcher:
         self.store.update(cb)
 
         session_id = new_id()
-        hb = Heartbeat(self.heartbeat_period * GRACE_MULTIPLIER,
-                       lambda: self._node_down(node_id, session_id),
-                       clock=self.clock)
         session = Session(
             node_id=node_id,
             session_id=session_id,
             channel=Channel(matcher=None, limit=None),
-            heartbeat=hb,
         )
         with self._lock:
             old = self._sessions.pop(node_id, None)
             if old is not None:
-                old.heartbeat.stop()
+                self._drop_session_refs(old)
                 old.channel.close()
                 if old.session_channel is not None:
                     old.session_channel.close()
@@ -348,11 +410,21 @@ class Dispatcher:
             self._dirty_nodes.add(node_id)
             pending = self._unknown_timers.pop(node_id, None)
             orphan = self._orphan_timers.pop(node_id, None)
+            # wheel entry keyed by node, armed INSIDE the session-swap
+            # critical section: racing register() calls must leave the
+            # winning session with the winning callback (outside the
+            # lock, a delayed loser could overwrite it — and a stale
+            # expiry would drop the entry while _node_down discards the
+            # superseded session id, leaving the live session without
+            # liveness). Lock order dispatcher→wheel is safe: wheel
+            # callbacks fire with no wheel lock held.
+            self._hb_wheel.add(node_id,
+                               self.heartbeat_period * GRACE_MULTIPLIER,
+                               lambda: self._node_down(node_id, session_id))
         if pending is not None:
             pending.stop()  # re-registered within the leadership grace
         if orphan is not None:
             orphan.stop()   # the node came back before the orphan window
-        hb.start()
         return session_id
 
     def _jittered_period(self) -> float:
@@ -376,8 +448,20 @@ class Dispatcher:
         # the timer re-arms (a heartbeat-miss storm: sessions expire,
         # nodes flip DOWN, tasks orphan); delay = a stalled dispatcher
         failpoints.fp("dispatcher.heartbeat")
-        session = self._session(node_id, session_id)
-        session.heartbeat.beat(self.heartbeat_period * GRACE_MULTIPLIER)
+        self._session(node_id, session_id)
+        grace = self.heartbeat_period * GRACE_MULTIPLIER
+        if not self._hb_wheel.beat(node_id, grace):
+            # valid session without a wheel entry: it registered through
+            # a leadership stop/start window and missed both the
+            # register-time add and the start() re-arm — self-heal, but
+            # only while still the CURRENT session (a racing register()
+            # owns the entry otherwise)
+            with self._lock:
+                s = self._sessions.get(node_id)
+                if s is not None and s.session_id == session_id:
+                    self._hb_wheel.add(
+                        node_id, grace,
+                        lambda: self._node_down(node_id, session_id))
         return self._jittered_period()
 
     def assignments(self, node_id: str, session_id: str) -> Channel:
@@ -534,14 +618,19 @@ class Dispatcher:
     def leave(self, node_id: str, session_id: str):
         """Graceful node departure."""
         session = self._session(node_id, session_id)
-        session.heartbeat.stop()
+        with self._lock:
+            # pop + wheel removal gated on still being the CURRENT
+            # session, in one critical section: a register() racing this
+            # leave must not have its fresh wheel entry torn down
+            if self._sessions.get(node_id) is session:
+                self._sessions.pop(node_id)
+                self._drop_session_refs(session)
+                self._hb_wheel.remove(node_id)
         session.channel.close()
         if session.session_channel is not None:
             session.session_channel.close()
         if session.tasks_channel is not None:
             session.tasks_channel.close()
-        with self._lock:
-            self._sessions.pop(node_id, None)
         self._node_down(node_id, session_id, graceful=True)
 
     # ------------------------------------------------------------- internals
@@ -556,15 +645,18 @@ class Dispatcher:
         with self._lock:
             s = self._sessions.get(node_id)
             if s is not None and s.session_id == session_id:
-                s.heartbeat.stop()
                 s.channel.close()
                 if s.session_channel is not None:
                     s.session_channel.close()
                 if s.tasks_channel is not None:
                     s.tasks_channel.close()
                 self._sessions.pop(node_id, None)
+                self._drop_session_refs(s)
             elif not graceful:
                 return  # superseded session
+        # no wheel removal here: an expiry already dropped its entry, a
+        # graceful leave removed it before calling, and a superseded
+        # session's entry now belongs to its replacement
 
         def cb(tx):
             node = tx.get_node(node_id)
@@ -660,8 +752,12 @@ class Dispatcher:
             obj = getattr(ev, "obj", None)
             return obj is not None and obj.TABLE in _kinds
 
+        # the reverse-index prime rides the SAME atomic
+        # snapshot-then-subscribe: every event after the snapshot flows
+        # through _note_event's maintenance, so the indexes never miss a
+        # transition between prime and watch
         _, ch = self.store.view_and_watch(
-            lambda tx: None, matcher=matcher, limit=None)
+            self._prime_reverse_indexes, matcher=matcher, limit=None)
         last_flush = time.monotonic()
         try:
             while not self._stop.is_set():
@@ -676,7 +772,15 @@ class Dispatcher:
                     self._note_event(ev)
                 now = time.monotonic()
                 if now - last_flush >= BATCH_INTERVAL:
-                    self._send_incrementals()
+                    try:
+                        self._send_incrementals()
+                    except Exception:
+                        # a crashed flush re-dirtied its unserved nodes
+                        # (see _send_incrementals); the next interval
+                        # retries them — never kill the event loop
+                        log.warning("assignment flush failed; dirty "
+                                    "sessions retained for retry",
+                                    exc_info=True)
                     if self._session_plane_dirty:
                         self._session_plane_dirty = False
                         self._push_session_updates()
@@ -702,34 +806,50 @@ class Dispatcher:
         elif isinstance(obj, Secret):
             # only sessions that were shipped this secret care about its
             # change; fresh references always arrive via a task event,
-            # which dirties the node anyway (assignments.go keeps per-node
-            # reference sets for the same reason — dirtying every session
-            # per secret event collapses at 10k nodes)
-            prefix = obj.id + "."   # driver clones ship as <sid>.<task id>
+            # which dirties the node anyway. The reverse reference map
+            # (maintained by _commit_known, mirroring assignments.go's
+            # per-node reference sets) answers this as one dict lookup —
+            # the old per-event walk over every session's known_secrets
+            # collapsed at 10k nodes
             with self._lock:
                 if isinstance(ev, EventDelete):
                     for key in [k for k in self._driver_cache
                                 if k[0] == obj.id]:
                         del self._driver_cache[key]
                 self._dirty_nodes.update(
-                    nid for nid, s in self._sessions.items()
-                    if obj.id in s.known_secrets
-                    or any(k.startswith(prefix) for k in s.known_secrets))
+                    self._secret_refs.get(obj.id, set())
+                    & self._sessions.keys())
         elif isinstance(obj, Config):
             with self._lock:
                 self._dirty_nodes.update(
-                    nid for nid, s in self._sessions.items()
-                    if obj.id in s.known_configs)
+                    self._config_refs.get(obj.id, set())
+                    & self._sessions.keys())
         else:
             from ..api.objects import Cluster, Volume
 
             if isinstance(obj, Volume):
                 # publish-status changes gate volume assignment shipping
+                from ..csi.plugin import PENDING_NODE_UNPUBLISH
+
+                pending = set()
+                if not isinstance(ev, EventDelete):
+                    pending = {s.node_id for s in obj.publish_status
+                               if s.state == PENDING_NODE_UNPUBLISH}
+                touched = {s.node_id for s in obj.publish_status}
+                old = getattr(ev, "old", None)
+                if old is not None:
+                    # a node whose publish entry VANISHED (vs moving
+                    # through pending_node_unpublish) must still learn
+                    # about the removal
+                    touched |= {s.node_id for s in old.publish_status}
                 with self._lock:
                     self._dirty_nodes.update(
-                        {s.node_id for s in obj.publish_status}
-                        & set(self._sessions.keys())
-                    )
+                        touched & set(self._sessions.keys()))
+                    # the index resyncs from EVERY volume event (new
+                    # pending set replaces the old wholesale), so a
+                    # crashed flush can never leave it diverged past the
+                    # next event touching the volume
+                    self._reindex_volume(obj.id, pending)
             elif isinstance(obj, Cluster):
                 # live reconfig from the replicated Cluster object
                 # (dispatcher.go:1072-1077): heartbeat period applies to
@@ -744,6 +864,10 @@ class Dispatcher:
                 if period and period != old_period \
                         and period != self.heartbeat_period:
                     self.heartbeat_period = period
+                    # keep the wheel's lateness inside the new period's
+                    # epsilon slack; existing deadlines re-bucket
+                    self._hb_wheel.set_granularity(
+                        self._wheel_granularity(period))
                 self._session_plane_dirty = True
         if isinstance(obj, Node):
             # manager list / role changes ride the Session stream
@@ -786,6 +910,11 @@ class Dispatcher:
         key = (secret.id, secret.meta.version.index, task.id)
         with self._lock:
             cached = self._driver_cache.get(key)
+            if cached is not None:
+                # re-register the base mapping: retirement pops it
+                # unconditionally, and a task re-shipping the cached
+                # clone (e.g. after moving nodes) must restore it
+                self._clone_bases[cached.id] = secret.id
         if cached is not None:
             return cached
         driver_cfg = secret.spec.driver or {}
@@ -807,6 +936,9 @@ class Dispatcher:
                       if k[0] == secret.id and k[2] == task.id and k != key]:
                 del self._driver_cache[k]
             self._driver_cache[key] = clone
+            # the reverse reference maps key by BASE id; clone ids map
+            # back through this (ids are opaque — never parsed)
+            self._clone_bases[clone.id] = secret.id
         return clone
 
     def _referenced_deps(self, tx, tasks, node_id: str,
@@ -814,9 +946,13 @@ class Dispatcher:
         """Secrets/configs the node's tasks reference, plus cluster-volume
         assignments already controller-published to this node
         (assignments.go:21-81; volumes ship once PUBLISHED so the agent
-        can node-stage them). Driver-backed secret references are only
-        COLLECTED here (into `driver_refs`) — their materialization does
-        external I/O and happens after the transaction."""
+        can node-stage them). Returns LIVE store references — store
+        objects are immutable by contract and commits swap table entries,
+        so they are stable snapshots; wire copies happen only when the
+        diff actually ships an object. Driver-backed secret references
+        are only COLLECTED here (into `driver_refs` as (secret, task)
+        pairs) — their materialization does external I/O and happens
+        after the transaction."""
         from ..csi.plugin import PUBLISHED
 
         secrets, configs, volumes = {}, {}, {}
@@ -839,10 +975,7 @@ class Dispatcher:
                 if s is None:
                     continue
                 if s.spec.driver:
-                    # defer: the plugin does external I/O and must not run
-                    # under the store lock — collected for the post-view
-                    # materialization pass in _assignment_view
-                    driver_refs.append((s.copy(), t, ref))
+                    driver_refs.append((s, t))
                     continue
                 secrets[s.id] = s
             for ref in runtime.configs:
@@ -857,97 +990,356 @@ class Dispatcher:
         the node may be restarting and have lost the original remove
         (reference: dispatcher/assignments.go:364-373). The full
         VolumeAssignment is shipped (not just the id) so a fresh agent
-        process can still run the idempotent node-unpublish."""
+        process can still run the idempotent node-unpublish.
+
+        Served from the reverse index (node → pending volume ids) once
+        primed: the per-node full `find_volumes()` scan made rollout
+        storms O(nodes × volumes). The index is a HINT — each hit is
+        re-checked against live volume state, and a stale entry lasts
+        only until the next event touching that volume replaces its set
+        — so a diverged index can produce extra lookups, never a wrong
+        assignment."""
         from ..csi.plugin import PENDING_NODE_UNPUBLISH
 
+        # LOCK-FREE index read — this runs inside store-view callbacks
+        # (see the constructor note on lock ordering): `primed` is a
+        # plain attribute and the frozenset value is immutable, so a
+        # concurrent _reindex_volume can only swap in a new value, never
+        # mutate the one being iterated
         out = {}
-        for v in tx.find_volumes():
-            if not v.publish_status:
-                continue
-            for st in v.publish_status:
-                if st.node_id == node_id and st.state == PENDING_NODE_UNPUBLISH:
-                    out[v.id] = self._volume_assignment(v, st)
+        if not self._vol_index_primed:
+            # driven/un-started dispatchers (no event loop maintaining
+            # the index) keep the original scan semantics
+            for v in tx.find_volumes():
+                for st in v.publish_status:
+                    if st.node_id == node_id \
+                            and st.state == PENDING_NODE_UNPUBLISH:
+                        out[v.id] = self._volume_assignment(v, st)
+            return out
+        for vid in sorted(self._vol_pending_unpub.get(node_id, ())):
+            # the index is a HINT: each hit re-checks live volume state,
+            # so a stale entry (possible only until the next event
+            # touching that volume replaces its set wholesale) costs one
+            # lookup, never a wrong assignment
+            v = tx.get_volume(vid)
+            st = next((s for s in (v.publish_status if v is not None else ())
+                       if s.node_id == node_id
+                       and s.state == PENDING_NODE_UNPUBLISH), None)
+            if st is not None:
+                out[vid] = self._volume_assignment(v, st)
         return out
 
-    def _assignment_view(self, session: Session):
-        """One consistent read: WIRE COPIES of the node's tasks, their
-        deps, and pending unpublishes; then (outside the store lock)
-        driver-backed secrets materialize per task and the wire copies'
-        references are rewritten to the per-task clone ids."""
-        driver_refs: list = []
+    # ------------------------------------------------- reverse-index plane
+    def _prime_reverse_indexes(self, tx):
+        """One startup scan building node → pending-unpublish volume ids;
+        runs inside _run's atomic snapshot-then-subscribe so no volume
+        transition can fall between the prime and the event stream.
 
-        def cb(tx):
-            tasks = [t.copy() for t in
-                     self._relevant_tasks(tx, session.node_id)]
-            secrets, configs, volumes = self._referenced_deps(
-                tx, tasks, session.node_id, driver_refs)
-            return (tasks, secrets, configs, volumes,
-                    self._pending_unpublish(tx, session.node_id))
+        Deliberately NOT under self._lock — the callback runs while the
+        store lock is held, and the RPC paths hold the dispatcher lock
+        across store views (AB-BA otherwise). Safe because until
+        `_vol_index_primed` flips, every other thread takes the scan
+        fallback and the only index writer is this (the event) thread."""
+        from ..csi.plugin import PENDING_NODE_UNPUBLISH
 
-        tasks, secrets, configs, volumes, unpublish = self.store.view(cb)
-        for secret, task, ref in driver_refs:
+        self._vol_pending_unpub.clear()
+        self._unpub_nodes_by_vol.clear()
+        for v in tx.find_volumes():
+            pending = {st.node_id for st in v.publish_status
+                       if st.state == PENDING_NODE_UNPUBLISH}
+            if pending:
+                self._reindex_volume(v.id, pending)
+        self._vol_index_primed = True
+
+    def _reindex_volume(self, vid: str, pending_nodes: set):
+        """Replace volume `vid`'s pending-unpublish node set (writers
+        serialize under self._lock; the prime is the documented
+        exception). Values swap wholesale as frozensets — readers
+        (_pending_unpublish, inside store views) never take a lock.
+        Diff-maintained both ways so one volume event costs O(changed
+        nodes), not O(index)."""
+        old = self._unpub_nodes_by_vol.get(vid, frozenset())
+        for nid in old - pending_nodes:
+            s = self._vol_pending_unpub.get(nid, frozenset()) - {vid}
+            if s:
+                self._vol_pending_unpub[nid] = s
+            else:
+                self._vol_pending_unpub.pop(nid, None)
+        for nid in pending_nodes - old:
+            self._vol_pending_unpub[nid] = \
+                self._vol_pending_unpub.get(nid, frozenset()) | {vid}
+        if pending_nodes:
+            self._unpub_nodes_by_vol[vid] = frozenset(pending_nodes)
+        else:
+            self._unpub_nodes_by_vol.pop(vid, None)
+
+    def _commit_known(self, session: Session, new_tasks: dict,
+                      new_secrets: dict, new_configs: dict,
+                      new_volumes: set, sequence: int,
+                      ship_bases: dict | None = None):
+        """Atomically replace the session's known-assignment maps and
+        maintain the secret/config reverse reference maps from the diff.
+        Runs ONLY after the carrying message was delivered (or there was
+        nothing to deliver): known-state may never advance past what the
+        agent actually saw."""
+        with self._lock:
+            node_id = session.node_id
+            current = self._sessions.get(node_id) is session
+            # base ids captured at materialize time win — the global map
+            # can lose an entry to a concurrent retirement mid-flight;
+            # non-clone keys (absent from ship_bases) are their own base
+            ship_bases = ship_bases or {}
+            new_bases = {k: ship_bases.get(k)
+                         or self._clone_bases.get(k, k)
+                         for k in new_secrets}
+            if current:
+                # (a superseded session must not touch the reference
+                # maps — its node's entries belong to the replacement)
+                for old_keys, new_keys, bases, refs in (
+                        (session.known_secrets, new_secrets,
+                         session.known_bases, self._secret_refs),
+                        (session.known_configs, new_configs, {},
+                         self._config_refs)):
+                    for k in old_keys:
+                        if k not in new_keys:
+                            # base as recorded at ship time — immune to
+                            # another session's eager _clone_bases pop
+                            base = bases.get(k, k)
+                            nodes = refs.get(base)
+                            if nodes is not None:
+                                nodes.discard(node_id)
+                                if not nodes:
+                                    refs.pop(base, None)
+                            if base != k:
+                                # clone retired here: collect the global
+                                # mapping in O(1) — a cached re-ship
+                                # re-registers it, and other sessions
+                                # clean up from their OWN recorded base
+                                self._clone_bases.pop(k, None)
+                    for k in new_keys:
+                        if k not in old_keys:
+                            # config keys are never cloned: absent from
+                            # new_bases, so the default k applies
+                            refs.setdefault(new_bases.get(k, k),
+                                            set()).add(node_id)
+            session.known_tasks = new_tasks
+            session.known_secrets = new_secrets
+            session.known_configs = new_configs
+            session.known_volumes = new_volumes
+            session.known_bases = new_bases
+            session.sequence = sequence
+
+    def _drop_session_refs(self, session: Session):
+        """Remove a retiring session's entries from the reverse reference
+        maps (called under self._lock, and only for the session that
+        CURRENTLY owns its node key — a superseded session's references
+        belong to its replacement)."""
+        node_id = session.node_id
+        for keys, bases, refs in (
+                (session.known_secrets, session.known_bases,
+                 self._secret_refs),
+                (session.known_configs, {}, self._config_refs)):
+            for k in keys:
+                base = bases.get(k, k)
+                nodes = refs.get(base)
+                if nodes is not None:
+                    nodes.discard(node_id)
+                    if not nodes:
+                        refs.pop(base, None)
+                if base != k:
+                    # the session dies holding this clone: collect the
+                    # base mapping too (a cached re-ship restores it)
+                    self._clone_bases.pop(k, None)
+
+    # -------------------------------------------------- fan-out shipping
+    def _node_view(self, tx, node_id: str, driver_refs: list):
+        """One node's assignment inputs as live references — the no-copy
+        read half of a flush."""
+        tasks = self._relevant_tasks(tx, node_id)
+        secrets, configs, volumes = self._referenced_deps(
+            tx, tasks, node_id, driver_refs)
+        unpublish = self._pending_unpublish(tx, node_id)
+        return tasks, secrets, configs, volumes, unpublish
+
+    def _materialize_clones(self, session: Session, secrets: dict,
+                            driver_refs: list) -> tuple[dict, dict]:
+        """Outside the store lock: driver-backed secrets materialize per
+        task (cached per (secret version, task)); returns
+        ((base secret id, task id) -> clone id for shipped-task ref
+        rewrites, clone id -> base id captured HERE — the commit must
+        not re-derive bases from the mutable global _clone_bases, which
+        a concurrent retirement can pop mid-flight)."""
+        clone_ids: dict[tuple, str] = {}
+        bases: dict[str, str] = {}
+        for secret, task in driver_refs:
             clone = self._materialize_driver_secret(secret, task,
                                                     session.node_id)
             if clone is not None:
                 secrets[clone.id] = clone
-                ref.secret_id = clone.id  # ref belongs to the wire copy
-        return tasks, secrets, configs, volumes, unpublish
+                clone_ids[(secret.id, task.id)] = clone.id
+                bases[clone.id] = secret.id
+        return clone_ids, bases
+
+    def _ship_task(self, t: Task, clone_ids: dict) -> Task:
+        """Wire copy, made ONLY at ship time; driver-backed secret
+        references rewrite to this task's clone ids (the clone belongs
+        to exactly one task — assignments.go:51-81)."""
+        self.metrics["wire_copies"] += 1
+        c = t.copy()
+        runtime = c.spec.runtime
+        if clone_ids and runtime is not None:
+            for ref in runtime.secrets:
+                new_id_ = clone_ids.get((ref.secret_id, t.id))
+                if new_id_ is not None:
+                    ref.secret_id = new_id_
+        return c
+
+    def _ship(self, obj):
+        self.metrics["wire_copies"] += 1
+        return obj.copy()
 
     def _full_assignment(self, session: Session) -> AssignmentsMessage:
-        tasks, secrets, configs, volumes, unpublish = \
-            self._assignment_view(session)
-        session.known_tasks = {t.id: t.meta.version.index for t in tasks}
-        session.known_secrets = {
-            sid: s.meta.version.index for sid, s in secrets.items()}
-        session.known_configs = {
-            cid: c.meta.version.index for cid, c in configs.items()}
-        session.known_volumes = set(volumes)
-        session.sequence += 1
+        driver_refs: list = []
+        tasks, secrets, configs, volumes, unpublish = self.store.view(
+            lambda tx: self._node_view(tx, session.node_id, driver_refs))
+        clone_ids, ship_bases = self._materialize_clones(
+            session, secrets, driver_refs)
         changes = (
-            [Assignment("update", "task", t) for t in tasks]
-            + [Assignment("update", "secret", s.copy()) for s in secrets.values()]
-            + [Assignment("update", "config", c.copy()) for c in configs.values()]
+            [Assignment("update", "task", self._ship_task(t, clone_ids))
+             for t in tasks]
+            + [Assignment("update", "secret", self._ship(s))
+               for s in secrets.values()]
+            + [Assignment("update", "config", self._ship(c))
+               for c in configs.values()]
             + [Assignment("update", "volume", v) for v in volumes.values()]
             + [Assignment("remove", "volume", va)
                for vid, va in unpublish.items() if vid not in volumes]
         )
+        self.metrics["ships"] += len(changes)
+        self._commit_known(
+            session,
+            {t.id: t.meta.version.index for t in tasks},
+            {sid: s.meta.version.index for sid, s in secrets.items()},
+            {cid: c.meta.version.index for cid, c in configs.items()},
+            set(volumes), session.sequence + 1, ship_bases)
         return AssignmentsMessage("complete", session.sequence, changes)
 
+    def _incremental(self, session: Session) -> AssignmentsMessage:
+        """Single-session diff outside a batched flush (driven tests,
+        the fsm model): its own view, commit-on-build — the caller
+        consumes the returned message synchronously."""
+        driver_refs: list = []
+        view = self.store.view(
+            lambda tx: self._node_view(tx, session.node_id, driver_refs))
+        clone_ids, ship_bases = self._materialize_clones(
+            session, view[1], driver_refs)
+        msg, commit = self._diff(session, *view, clone_ids, ship_bases)
+        commit()
+        return msg
+
     def _send_incrementals(self):
+        """THE fan-out hot path: ONE consistent store snapshot serves
+        every dirty session's incremental diff (and its legacy
+        tasks_channel snapshot) — group-commit applied to the control
+        plane, replacing 2 transactions per dirty node per interval. A
+        crash at any point re-dirties the unserved sessions so the next
+        interval retries; served sessions already committed their
+        known-state and are NOT replayed."""
         with self._lock:
             dirty = self._dirty_nodes
             self._dirty_nodes = set()
-            sessions = [self._sessions[n] for n in dirty if n in self._sessions]
-        for session in sessions:
-            msg = self._incremental(session)
-            if msg.changes:
-                session.channel._offer(msg)
-            if session.tasks_channel is not None:
-                snapshot = self.store.view(
-                    lambda tx, n=session.node_id: [
-                        t.copy() for t in self._relevant_tasks(tx, n)])
-                session.tasks_channel._offer(snapshot)
+            sessions = [self._sessions[n] for n in dirty
+                        if n in self._sessions]
+        if not sessions:
+            return
+        start = time.monotonic()
+        self.metrics["flushes"] += 1
+        views: list[tuple[Session, tuple, list]] = []
 
-    def _incremental(self, session: Session) -> AssignmentsMessage:
-        tasks, secrets, configs, volumes, unpublish = \
-            self._assignment_view(session)
+        def cb(tx):
+            self.metrics["flush_tx"] += 1
+            for session in sessions:
+                # failpoint `dispatcher.assignments.build`: one session's
+                # build crashes the flush snapshot mid-batch (nothing was
+                # offered yet — the whole dirty set retries)
+                failpoints.fp("dispatcher.assignments.build")
+                driver_refs: list = []
+                views.append((session,
+                              self._node_view(tx, session.node_id,
+                                              driver_refs),
+                              driver_refs))
+
+        served: set = set()
+        try:
+            # failpoint `dispatcher.flush`: the flush dies before the
+            # snapshot — the dirty set must survive for the retry
+            failpoints.fp("dispatcher.flush")
+            self.store.view(cb)
+            for session, view, driver_refs in views:
+                self._serve_session(session, view, driver_refs)
+                served.add(session.node_id)
+        except Exception:
+            with self._lock:
+                self._dirty_nodes.update(
+                    s.node_id for s in sessions if s.node_id not in served)
+            raise
+        finally:
+            self.metrics["last_flush_s"] = time.monotonic() - start
+
+    def _serve_session(self, session: Session, view: tuple,
+                       driver_refs: list):
+        tasks, secrets, configs, volumes, unpublish = view
+        clone_ids, ship_bases = self._materialize_clones(
+            session, secrets, driver_refs)
+        msg, commit = self._diff(session, tasks, secrets, configs,
+                                 volumes, unpublish, clone_ids, ship_bases)
+        delivered = True
+        if msg.changes:
+            self.metrics["ships"] += len(msg.changes)
+            delivered = session.channel._offer(msg)
+        if delivered:
+            commit()
+        # a closed channel (slow subscriber shed / racing disconnect)
+        # must NOT advance known-state: the agent never saw this diff,
+        # and a reconnect diffing from advanced state would miss
+        # removals. The replacement session rebuilds from a COMPLETE.
+        if session.tasks_channel is not None \
+                and not session.tasks_channel.closed:
+            # legacy stream: plain wire copies, no clone rewrite (the
+            # pre-Assignments protocol never carried secrets)
+            session.tasks_channel._offer(
+                [self._ship_task(t, {}) for t in tasks])
+
+    def _diff(self, session: Session, tasks, secrets, configs, volumes,
+              unpublish, clone_ids, ship_bases=None):
+        """Pure diff against the session's known maps: wire copies are
+        made only for objects that actually ship (copy-on-ship). Returns
+        the message plus a commit closure that publishes the new known
+        state — run it ONLY once the message was delivered."""
         changes: list[Assignment] = []
-        new_known = {t.id: t.meta.version.index for t in tasks}
+        new_tasks = {t.id: t.meta.version.index for t in tasks}
         for t in tasks:
             old_version = session.known_tasks.get(t.id)
             if old_version is None or old_version != t.meta.version.index:
-                changes.append(Assignment("update", "task", t))
+                changes.append(Assignment("update", "task",
+                                          self._ship_task(t, clone_ids)))
         for tid in session.known_tasks:
-            if tid not in new_known:
+            if tid not in new_tasks:
                 changes.append(Assignment("remove", "task", tid))
+        new_secrets = {sid: s.meta.version.index
+                       for sid, s in secrets.items()}
         for sid, s in secrets.items():
             if session.known_secrets.get(sid) != s.meta.version.index:
-                changes.append(Assignment("update", "secret", s.copy()))
+                changes.append(Assignment("update", "secret",
+                                          self._ship(s)))
         for sid in set(session.known_secrets) - set(secrets):
             changes.append(Assignment("remove", "secret", sid))
+        new_configs = {cid: c.meta.version.index
+                       for cid, c in configs.items()}
         for cid, c in configs.items():
             if session.known_configs.get(cid) != c.meta.version.index:
-                changes.append(Assignment("update", "config", c.copy()))
+                changes.append(Assignment("update", "config",
+                                          self._ship(c)))
         for cid in set(session.known_configs) - set(configs):
             changes.append(Assignment("remove", "config", cid))
         for vid, v in volumes.items():
@@ -963,15 +1355,15 @@ class Dispatcher:
             # this volume in this session (agent restart)
             if vid not in session.known_volumes and vid not in volumes:
                 changes.append(Assignment("remove", "volume", va))
-        session.known_tasks = new_known
-        session.known_secrets = {
-            sid: s.meta.version.index for sid, s in secrets.items()}
-        session.known_configs = {
-            cid: c.meta.version.index for cid, c in configs.items()}
-        session.known_volumes = set(volumes)
-        if changes:
-            session.sequence += 1
-        return AssignmentsMessage("incremental", session.sequence, changes)
+        sequence = session.sequence + (1 if changes else 0)
+        msg = AssignmentsMessage("incremental", sequence, changes)
+
+        def commit():
+            self._commit_known(session, new_tasks, new_secrets,
+                               new_configs, set(volumes), sequence,
+                               ship_bases)
+
+        return msg, commit
 
     # ------------------------------------------------------- status flushing
     def _flush_statuses(self):

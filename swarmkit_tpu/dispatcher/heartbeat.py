@@ -1,4 +1,18 @@
-"""Per-node heartbeat timer (reference: manager/dispatcher/heartbeat/heartbeat.go).
+"""Per-node heartbeat expiry (reference: manager/dispatcher/heartbeat/heartbeat.go).
+
+Two implementations share the contract "fire `on_expire` once if the
+entry isn't beaten within its timeout":
+
+* `Heartbeat` — one timer object per entry, cancel-and-re-arm per beat.
+  The original shape; kept as the ORACLE for the wheel's property tests
+  and for the dispatcher's rare timers (leadership-grace, orphaning),
+  where one object per node-down event is the right cost.
+* `HeartbeatWheel` — the dispatcher's session liveness plane: one
+  coarse-bucketed wheel for every session, driven by a single repeating
+  clock ticker. `beat()` is a few dict/set writes and allocates no timer
+  objects, so 10k sessions beating every ~5s cost the shared TimerWheel
+  nothing (the per-beat cancel/heap-push of `Heartbeat` was the
+  `beat_per_s` ceiling in bench_host_micro).
 
 Timers come from an injectable Clock (utils/clock.py) so the expiry logic
 is deterministic under FakeClock in tests, mirroring the reference's
@@ -6,7 +20,7 @@ ClockSource seam."""
 from __future__ import annotations
 
 import threading
-from typing import Callable
+from typing import Callable, Hashable
 
 from ..utils.clock import REAL_CLOCK
 
@@ -49,3 +63,189 @@ class Heartbeat:
             if self._timer is not None:
                 self._timer.cancel()
                 self._timer = None
+
+
+class HeartbeatWheel:
+    """Coarse-bucketed expiry wheel for many keyed heartbeats.
+
+    Entries are bucketed by deadline quantized UP to the next
+    `granularity` boundary, and one repeating ticker (re-armed every
+    `granularity` while entries exist, stopped when empty) fires every
+    bucket whose boundary has passed. So expirations are never EARLY and
+    at most ~2×granularity late — callers size their grace windows with
+    that slack (the dispatcher keeps granularity ≤ min(ε, period/2)
+    against a period×3 grace, so the margin stays ≥ 2×period).
+
+    `beat()` moves the entry between buckets: dict/set writes only, no
+    timer objects, no heap traffic — the steady-state cost the 10k-node
+    design point demands. Deterministic under FakeClock: the ticker is a
+    plain clock timer, so `advance()` fires it and one tick drains every
+    bucket that came due during the whole advance.
+    """
+
+    def __init__(self, granularity: float = 0.25, clock=None):
+        if granularity <= 0:
+            raise ValueError("granularity must be positive")
+        self.clock = clock or REAL_CLOCK
+        self._granularity = granularity
+        self._lock = threading.Lock()
+        self._timeout: dict[Hashable, float] = {}
+        self._deadline: dict[Hashable, float] = {}
+        self._cb: dict[Hashable, Callable[[], None]] = {}
+        self._bucket_of: dict[Hashable, int] = {}
+        self._buckets: dict[int, set] = {}
+        self._ticker = None
+        # generation guard: a _tick whose arming generation was
+        # superseded (remove-to-empty then add re-armed while the fire
+        # was in flight) must not null/re-arm over the live ticker
+        self._ticker_gen = 0
+        self._stopped = False
+        self.ticks = 0              # observability: ticker fires
+        self.fired = 0              # observability: expirations delivered
+
+    def __len__(self):
+        with self._lock:
+            return len(self._timeout)
+
+    @property
+    def granularity(self) -> float:
+        return self._granularity
+
+    def set_granularity(self, granularity: float) -> None:
+        """Re-bucket every entry under a new tick width (live heartbeat
+        period reconfig). Bucket indexes are granularity-relative, so a
+        change must rebuild placements — never mix indexes across
+        widths."""
+        if granularity <= 0:
+            raise ValueError("granularity must be positive")
+        with self._lock:
+            if granularity == self._granularity:
+                return
+            self._granularity = granularity
+            self._buckets.clear()
+            self._bucket_of.clear()
+            for key, due in self._deadline.items():
+                self._place(key, due)
+
+    # ------------------------------------------------------------ entries
+    def add(self, key: Hashable, timeout: float,
+            on_expire: Callable[[], None]) -> None:
+        """Arm (or replace) `key`. Replacement swaps the callback too —
+        a superseding session takes over its node's liveness entry."""
+        with self._lock:
+            if self._stopped:
+                return             # dispatcher stopped: liveness is off
+            self._timeout[key] = timeout
+            self._cb[key] = on_expire
+            due = self.clock.monotonic() + timeout
+            self._deadline[key] = due
+            self._place(key, due)
+            if self._ticker is None:
+                self._arm_ticker()
+
+    def beat(self, key: Hashable, timeout: float | None = None) -> bool:
+        """Push `key`'s deadline out; returns False if the entry already
+        expired or was removed (the caller's session is gone). THE hot
+        path: dict writes and at most one set move, nothing allocated."""
+        with self._lock:
+            if self._stopped or key not in self._timeout:
+                return False
+            if timeout is not None:
+                self._timeout[key] = timeout
+            due = self.clock.monotonic() + self._timeout[key]
+            self._deadline[key] = due
+            self._place(key, due)
+            return True
+
+    def remove(self, key: Hashable) -> None:
+        with self._lock:
+            if key not in self._timeout:
+                return
+            self._drop(key)
+            if not self._timeout and self._ticker is not None:
+                self._ticker.cancel()
+                self._ticker = None
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            if self._ticker is not None:
+                self._ticker.cancel()
+                self._ticker = None
+            self._timeout.clear()
+            self._deadline.clear()
+            self._cb.clear()
+            self._bucket_of.clear()
+            self._buckets.clear()
+
+    # ------------------------------------------------------------ internals
+    def _place(self, key: Hashable, due: float) -> None:
+        # quantize UP: bucket b fires once now >= b*g, so an entry never
+        # expires before its deadline
+        b = int(due / self._granularity) + 1
+        old = self._bucket_of.get(key)
+        if old == b:
+            return
+        if old is not None:
+            s = self._buckets.get(old)
+            if s is not None:
+                s.discard(key)
+                if not s:
+                    del self._buckets[old]
+        self._buckets.setdefault(b, set()).add(key)
+        self._bucket_of[key] = b
+
+    def _drop(self, key: Hashable) -> None:
+        self._timeout.pop(key, None)
+        self._deadline.pop(key, None)
+        self._cb.pop(key, None)
+        b = self._bucket_of.pop(key, None)
+        if b is not None:
+            s = self._buckets.get(b)
+            if s is not None:
+                s.discard(key)
+                if not s:
+                    del self._buckets[b]
+
+    def _arm_ticker(self) -> None:
+        # under self._lock
+        self._ticker_gen += 1
+        gen = self._ticker_gen
+        self._ticker = self.clock.timer(self._granularity,
+                                        lambda: self._tick(gen))
+
+    def _tick(self, gen: int) -> None:
+        fire: list[tuple[Hashable, Callable[[], None]]] = []
+        with self._lock:
+            if gen != self._ticker_gen or self._stopped:
+                return             # superseded arming — a live ticker owns
+            self._ticker = None
+            self.ticks += 1
+            now = self.clock.monotonic()
+            g = self._granularity
+            for b in [b for b in self._buckets if b * g <= now]:
+                for key in list(self._buckets.get(b, ())):
+                    due = self._deadline.get(key)
+                    if due is None:
+                        continue
+                    if due <= now:
+                        fire.append((key, self._cb[key]))
+                        self._drop(key)
+                    else:
+                        # a beat raced the tick: the entry moved forward
+                        # but its bucket record lagged — re-place it
+                        self._place(key, due)
+            if self._timeout:
+                self._arm_ticker()
+        for _key, cb in fire:
+            self.fired += 1
+            try:
+                cb()
+            except BaseException as exc:   # noqa: BLE001
+                # one crashing expiry handler must not swallow the rest
+                # of the batch (their entries are already dropped);
+                # surface it exactly like a crashing timer thread so the
+                # conftest guard still fails the suite on it
+                threading.excepthook(threading.ExceptHookArgs(
+                    (type(exc), exc, exc.__traceback__,
+                     threading.current_thread())))

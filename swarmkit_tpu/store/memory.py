@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import defaultdict
+from collections import Counter, defaultdict
 from typing import Any, Callable, Iterable
 
 from ..api.objects import (
@@ -246,6 +246,13 @@ class MemoryStore:
         self.proposer = proposer
         self.queue = WatchQueue()
         self._version = Version(0)  # commit version when no proposer drives it
+        # Operation counters (test/bench observability — the dispatcher's
+        # op-count regression guard asserts transactions-per-flush and
+        # table-scan counts here instead of wall-clock timings, which are
+        # meaningless on a contended 1-core host). Keys: "view_tx",
+        # "update_tx", "find_<table>". Maintained under the locks the
+        # counted operations already hold.
+        self.op_counts: Counter = Counter()
 
     # ------------------------------------------------------------------ reads
     def view(self, cb: Callable[[ReadTx], Any] | None = None):
@@ -255,6 +262,7 @@ class MemoryStore:
         start = time.monotonic()
         try:
             with self._lock:
+                self.op_counts["view_tx"] += 1
                 return cb(tx)
         finally:
             _read_tx_latency.observe(time.monotonic() - start)
@@ -266,6 +274,7 @@ class MemoryStore:
         start = time.monotonic()
         with self._update_lock:
             self._update_lock_held_since = held = time.monotonic()
+            self.op_counts["update_tx"] += 1
             try:
                 tx = WriteTx(self)
                 cb(tx)
@@ -499,6 +508,7 @@ class MemoryStore:
 
     def _find(self, cls: type[StoreObject], selectors) -> list[StoreObject]:
         with self._lock:
+            self.op_counts[f"find_{cls.TABLE}"] += 1
             table = self._tables[cls.TABLE]
             ids = by_mod.candidate_ids(self._indexes[cls.TABLE], selectors)
             objs = table.values() if ids is None else (

@@ -34,19 +34,25 @@ class Channel:
         exc.error = self._error   # cause, when the closer supplied one
         raise exc
 
-    def _offer(self, event: Any) -> None:
+    def _offer(self, event: Any) -> bool:
+        """Returns False when the channel could not take the event — it
+        was already closed, or this offer tripped the slow-subscriber
+        limit. Publishers that track per-subscriber delivery state (the
+        dispatcher's known-assignment maps) key off this so a shed
+        subscriber's bookkeeping is never advanced past what it saw."""
         if self._matcher is not None and not self._matcher(event):
-            return
+            return True            # filtered out, not a delivery failure
         with self._cond:
             if self._closed:
-                return
+                return False
             if self._limit is not None and len(self._events) >= self._limit:
                 # Slow-subscriber protection (watch/queue/queue.go LimitQueue).
                 self._closed = True
                 self._cond.notify_all()
-                return
+                return False
             self._events.append(event)
             self._cond.notify_all()
+            return True
 
     def _offer_many(self, events: list) -> None:
         """Batched fan-out: one matcher pass, ONE lock acquisition and ONE
@@ -157,8 +163,9 @@ class WatchQueue:
         class _CallbackChannel(Channel):
             def _offer(self, event):
                 if matcher is not None and not matcher(event):
-                    return
+                    return True
                 cb(event)
+                return True
 
             def _offer_many(self, events):
                 for event in events:

@@ -1,0 +1,154 @@
+"""HeartbeatWheel vs the per-node Heartbeat oracle (ISSUE 4 satellite).
+
+The wheel's contract: same SET of expirations as one Heartbeat object
+per key — never early, at most ~2×granularity late — with `beat()` as a
+dict write (no timer objects on the steady path). All under FakeClock so
+schedules are deterministic on a loaded 1-core host.
+"""
+import random
+
+import pytest
+
+from swarmkit_tpu.dispatcher.heartbeat import Heartbeat, HeartbeatWheel
+from swarmkit_tpu.utils.clock import FakeClock
+
+
+class CountingClock(FakeClock):
+    """FakeClock that counts timer-object creations."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.timer_calls = 0
+
+    def timer(self, delay, fn):
+        self.timer_calls += 1
+        return super().timer(delay, fn)
+
+
+# --------------------------------------------------------------- property
+@pytest.mark.parametrize("seed", range(10))
+def test_wheel_matches_per_node_oracle(seed):
+    """Under a randomized schedule of advances, re-arms with jittered
+    periods, and stops, the wheel fires exactly the same set of keys the
+    per-node Heartbeat oracle fires."""
+    rng = random.Random(seed)
+    clock = FakeClock()
+    g = rng.choice([0.1, 0.25, 0.5])
+    wheel = HeartbeatWheel(granularity=g, clock=clock)
+    wheel_fired, oracle_fired, stopped = set(), set(), set()
+    keys = [f"k{i}" for i in range(rng.randint(5, 20))]
+    oracles = {}
+    timeouts = {}
+    for k in keys:
+        timeouts[k] = rng.uniform(0.4, 3.0)  # jittered per-key periods
+        wheel.add(k, timeouts[k], lambda k=k: wheel_fired.add(k))
+        hb = Heartbeat(timeouts[k], lambda k=k: oracle_fired.add(k),
+                       clock=clock)
+        hb.start()
+        oracles[k] = hb
+    for _ in range(rng.randint(25, 70)):
+        op = rng.random()
+        if op < 0.5:
+            clock.advance(rng.uniform(0.05, 1.3))
+            # the wheel may lag the oracle by up to ~2 ticks, never lead
+            assert wheel_fired <= oracle_fired
+        else:
+            k = rng.choice(keys)
+            # only keys still live in BOTH implementations are beaten or
+            # stopped (a real dispatcher can't beat an expired session
+            # either — the session is gone)
+            if k in wheel_fired or k in oracle_fired or k in stopped:
+                continue
+            if op < 0.85:
+                nt = rng.uniform(0.4, 3.0)
+                assert wheel.beat(k, nt)
+                oracles[k].beat(nt)
+            else:
+                wheel.remove(k)
+                oracles[k].stop()
+                stopped.add(k)
+    # settle: everything still armed comes due in both implementations
+    clock.advance(max(timeouts.values()) + 3 * g + 5.0)
+    assert wheel_fired == oracle_fired, (
+        f"seed {seed}: wheel {sorted(wheel_fired)} vs oracle "
+        f"{sorted(oracle_fired)}")
+    assert wheel_fired.isdisjoint(stopped)
+    assert len(wheel) == 0
+
+
+# ------------------------------------------------------------ unit pins
+def test_wheel_never_early_and_bounded_late():
+    clock = FakeClock(start=1000.0)
+    g = 0.5
+    wheel = HeartbeatWheel(granularity=g, clock=clock)
+    fired_at = []
+    wheel.add("n1", 1.0, lambda: fired_at.append(clock.monotonic()))
+    deadline = 1001.0
+    while not fired_at and clock.monotonic() < 1010:
+        clock.advance(0.05)
+    assert fired_at, "entry never expired"
+    assert deadline <= fired_at[0] <= deadline + 2 * g + 1e-9
+
+def test_beat_allocates_no_timer_objects():
+    clock = CountingClock()
+    wheel = HeartbeatWheel(granularity=0.25, clock=clock)
+    for i in range(50):
+        wheel.add(f"n{i}", 10.0, lambda: None)
+    assert clock.timer_calls == 1          # ONE ticker for all entries
+    before = clock.timer_calls
+    for _ in range(20):
+        for i in range(50):
+            wheel.beat(f"n{i}")
+    assert clock.timer_calls == before, \
+        "beat() must be a dict write, not a timer re-arm"
+
+
+def test_ticker_stops_when_empty_and_rearms():
+    clock = CountingClock()
+    wheel = HeartbeatWheel(granularity=0.25, clock=clock)
+    wheel.add("a", 1.0, lambda: None)
+    wheel.remove("a")
+    # ticker cancelled with the last entry: advancing fires nothing new
+    clock.advance(10.0)
+    ticks_idle = wheel.ticks
+    clock.advance(10.0)
+    assert wheel.ticks == ticks_idle
+    fired = []
+    wheel.add("b", 0.5, lambda: fired.append("b"))
+    clock.advance(2.0)
+    assert fired == ["b"]
+
+
+def test_set_granularity_rebuckets_live_entries():
+    clock = FakeClock(start=0.0)
+    wheel = HeartbeatWheel(granularity=0.5, clock=clock)
+    fired = []
+    wheel.add("a", 3.0, lambda: fired.append("a"))
+    wheel.set_granularity(0.05)
+    clock.advance(2.0)
+    assert fired == []                    # not early after re-bucketing
+    clock.advance(1.2)
+    assert fired == ["a"]
+
+
+def test_replacing_add_swaps_callback():
+    clock = FakeClock()
+    wheel = HeartbeatWheel(granularity=0.25, clock=clock)
+    fired = []
+    wheel.add("n", 1.0, lambda: fired.append("old"))
+    wheel.add("n", 1.0, lambda: fired.append("new"))
+    clock.advance(5.0)
+    assert fired == ["new"]
+
+
+def test_stopped_wheel_is_inert():
+    clock = FakeClock()
+    wheel = HeartbeatWheel(granularity=0.25, clock=clock)
+    fired = []
+    wheel.add("n", 0.5, lambda: fired.append("n"))
+    wheel.stop()
+    clock.advance(5.0)
+    assert fired == []
+    wheel.add("m", 0.1, lambda: fired.append("m"))   # no-op, no crash
+    clock.advance(5.0)
+    assert fired == []
