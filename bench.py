@@ -1087,6 +1087,110 @@ def bench_dispatcher_fanout(np, n_nodes=10_000):
         d.stop()
 
 
+def bench_trace_plane(np):
+    """Trace-plane acceptance row (ISSUE 5): (a) DISARMED overhead — a
+    pipelined steady wave with tracing off must allocate zero spans
+    (the failpoints-style truthiness contract) and cost the same wall as
+    before the plane existed; (b) ARMED, the same waves yield the
+    per-stage breakdown column (mean seconds per span name from the
+    flight recorder) plus the measured armed-vs-disarmed overhead.
+
+    Shapes are deliberately small: this row measures the INSTRUMENTATION,
+    not the kernel — the grid rows above own the kernel numbers."""
+    import gc
+
+    from swarmkit_tpu.ops.pipeline import TickPipeline
+    from swarmkit_tpu.ops.resident import ResidentPlacement
+    from swarmkit_tpu.scheduler import batch
+    from swarmkit_tpu.scheduler.encode import IncrementalEncoder
+    from swarmkit_tpu.utils import trace
+
+    N_NODES_T, N_TASKS_T, N_SVCS_T, WAVES, DEPTH = 512, 2_000, 10, 8, 2
+
+    def run_waves(tag):
+        # fresh encoder + node state per run: the three runs (warm /
+        # disarmed / armed) must do identical work, not accumulate tasks
+        rng = random.Random(11)
+        infos = _mk_nodes(rng, N_NODES_T)
+        by_node = {i.node.id: i for i in infos}
+
+        def commit(p, counts):
+            orders = batch.materialize_orders(p, counts)
+            infos_arr = [by_node[nid] for nid in p.node_ids]
+            batch.apply_wave(infos_arr, p.groups, orders)
+
+        enc = IncrementalEncoder()
+        rp = ResidentPlacement(enc)
+        pipe = TickPipeline(enc, rp, commit, depth=DEPTH,
+                            async_commit=True)
+        waves = [_mk_groups(rng, N_TASKS_T, N_SVCS_T, wave=w)
+                 for w in range(WAVES)]
+        try:
+            for w in range(WAVES):
+                gc.collect()
+                pipe.tick(infos, waves[w])
+            pipe.flush()
+        finally:
+            pipe.close()
+        # steady TICK rows only: tick() records one timing per call
+        # (indices 0..WAVES-1, fill-in ticks included); flush() appends
+        # its per-drained-wave rows strictly AFTER, so [DEPTH+1:WAVES]
+        # can never pick a cheap drain-path wall
+        assert len(pipe.timings) == WAVES + DEPTH
+        return min(t["wall_s"] for t in pipe.timings[DEPTH + 1:WAVES])
+
+    run_waves("warm")                      # compile + device warm-up
+
+    # (a) disarmed: the op-count guard — any Span construction or record
+    # filing on the hot path trips the probe
+    allocs = {"n": 0}
+    orig_init, orig_record = trace.Span.__init__, \
+        trace.FlightRecorder.record
+
+    def spy_init(self, *a, **k):
+        allocs["n"] += 1
+        orig_init(self, *a, **k)
+
+    def spy_record(self, *a, **k):
+        allocs["n"] += 1
+        orig_record(self, *a, **k)
+
+    trace.Span.__init__ = spy_init
+    trace.FlightRecorder.record = spy_record
+    try:
+        disarmed_wave_s = run_waves("off")
+        disarmed_allocs = allocs["n"]
+    finally:
+        trace.Span.__init__ = orig_init
+        trace.FlightRecorder.record = orig_record
+
+    # (b) armed: same shape, recorder on → per-stage breakdown
+    rec = trace.arm(capacity=16384)
+    try:
+        armed_wave_s = run_waves("on")
+        by_stage: dict[str, list[float]] = {}
+        for r in rec.snapshot():
+            by_stage.setdefault(r["name"], []).append(r["dur"])
+    finally:
+        trace.disarm()
+    breakdown = {
+        name: {"n": len(ds),
+               "mean_ms": round(sum(ds) / len(ds) * 1e3, 4),
+               "total_s": round(sum(ds), 4)}
+        for name, ds in sorted(by_stage.items())}
+
+    return {
+        "disarmed_wave_s": round(disarmed_wave_s, 5),
+        "armed_wave_s": round(armed_wave_s, 5),
+        "armed_overhead_x": round(armed_wave_s / disarmed_wave_s, 3),
+        # THE acceptance: tracing off allocates nothing on the hot path
+        "disarmed_span_allocs": disarmed_allocs,
+        "stage_breakdown": breakdown,
+        "spans_recorded": rec.spans_started,
+        "parity": disarmed_allocs == 0 and bool(breakdown),
+    }
+
+
 def bench_host_micro(np):
     """The BASELINE.md harness rows the reference ships benchmarks for
     but no numbers (store ops memory_test.go:2028-2120, watch queue at
@@ -1404,6 +1508,9 @@ def main():
         # (VERDICT item 7)
         ("dispatcher_fanout_10k", lambda: bench_dispatcher_fanout(np)),
         ("host_micro", lambda: bench_host_micro(np)),
+        # ISSUE 5: per-stage breakdown via the trace plane + the
+        # disarmed-overhead acceptance (zero span allocs with tracing off)
+        ("trace_plane", lambda: bench_trace_plane(np)),
     ]
     configs = {name: _run_row(name, thunk) for name, thunk in rows}
     ns = configs["grid_100k_x_10k"]   # the north star IS this grid config

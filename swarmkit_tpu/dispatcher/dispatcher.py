@@ -33,7 +33,7 @@ from ..api.types import NodeStatusState, TaskState
 from ..store import by
 from ..store.memory import MemoryStore
 from ..store.watch import Channel, WatchQueue
-from ..utils import failpoints
+from ..utils import failpoints, trace
 from ..utils.identity import new_id
 from ..utils.metrics import histogram
 from .heartbeat import Heartbeat, HeartbeatWheel
@@ -1254,6 +1254,10 @@ class Dispatcher:
             return
         start = time.monotonic()
         self.metrics["flushes"] += 1
+        # trace plane: one span per fan-out flush with snapshot/serve
+        # sub-stages; None when disarmed (one truthiness test — the
+        # op-count guard in tests/test_dispatcher_fanout.py stays exact)
+        sp = trace.start("dispatcher.flush", sessions=len(sessions))
         views: list[tuple[Session, tuple, list]] = []
 
         def cb(tx):
@@ -1274,17 +1278,32 @@ class Dispatcher:
             # failpoint `dispatcher.flush`: the flush dies before the
             # snapshot — the dirty set must survive for the retry
             failpoints.fp("dispatcher.flush")
+            t0 = time.perf_counter() if sp is not None else 0.0
             self.store.view(cb)
+            if sp is not None:
+                trace.rec("dispatcher.flush.snapshot",
+                          time.perf_counter() - t0, parent=sp)
+                t0 = time.perf_counter()
             for session, view, driver_refs in views:
                 self._serve_session(session, view, driver_refs)
                 served.add(session.node_id)
-        except Exception:
+            if sp is not None:
+                trace.rec("dispatcher.flush.serve",
+                          time.perf_counter() - t0, parent=sp,
+                          served=len(served))
+        except Exception as exc:
             with self._lock:
                 self._dirty_nodes.update(
                     s.node_id for s in sessions if s.node_id not in served)
+            if sp is not None:
+                # the forensics tail must show this flush FAILED, like
+                # every other instrumented plane does on exception
+                sp.attrs.setdefault("error", repr(exc))
             raise
         finally:
             self.metrics["last_flush_s"] = time.monotonic() - start
+            if sp is not None:
+                sp.end(served=len(served))
 
     def _serve_session(self, session: Session, view: tuple,
                        driver_refs: list):

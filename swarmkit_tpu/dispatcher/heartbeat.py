@@ -20,8 +20,10 @@ ClockSource seam."""
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Hashable
 
+from ..utils import trace
 from ..utils.clock import REAL_CLOCK
 
 
@@ -214,7 +216,17 @@ class HeartbeatWheel:
         self._ticker = self.clock.timer(self._granularity,
                                         lambda: self._tick(gen))
 
+    @property
+    def bucket_count(self) -> int:
+        """Live bucket count (the /metrics gauge next to len(self))."""
+        with self._lock:
+            return len(self._buckets)
+
     def _tick(self, gen: int) -> None:
+        # trace plane: one span per ticker fire, never per beat (beat()
+        # stays dict-writes-only); disarmed = one truthiness test
+        traced = trace.enabled()
+        t0 = time.perf_counter() if traced else 0.0
         fire: list[tuple[Hashable, Callable[[], None]]] = []
         with self._lock:
             if gen != self._ticker_gen or self._stopped:
@@ -249,3 +261,6 @@ class HeartbeatWheel:
                 threading.excepthook(threading.ExceptHookArgs(
                     (type(exc), exc, exc.__traceback__,
                      threading.current_thread())))
+        if traced:
+            trace.rec("hb.wheel.tick", time.perf_counter() - t0,
+                      fired=len(fire), entries=len(self))

@@ -60,7 +60,13 @@ class MetricsCollector:
         snap = self.snapshot()
         lines = []
         for table, n in sorted(snap["objects"].items()):
+            lines.append(f'# HELP swarm_manager_{table}s number of '
+                         f'{table} objects in the store')
+            lines.append(f'# TYPE swarm_manager_{table}s gauge')
             lines.append(f'swarm_manager_{table}s{{}} {n}')
+        if snap["node_states"]:
+            lines.append('# HELP swarm_node_info nodes by status state')
+            lines.append('# TYPE swarm_node_info gauge')
         for state, n in sorted(snap["node_states"].items()):
             lines.append(f'swarm_node_info{{state="{state.lower()}"}} {n}')
         for h in sorted(all_histograms(), key=lambda h: h.name):

@@ -13,6 +13,8 @@ import sys
 import threading
 import traceback
 
+from ..utils import trace
+
 log = logging.getLogger("swarmkit_tpu.manager.wedge")
 
 
@@ -32,6 +34,10 @@ class WedgeMonitor:
         self.raft = raft_node
         self.check_interval = check_interval
         self.fired = 0  # episodes acted upon (observable for tests)
+        # the flight-recorder tail captured at the last episode ("" when
+        # tracing was disarmed) — the span-level half of the postmortem
+        # next to the thread stacks (docs/observability.md)
+        self.last_trace_tail = ""
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._in_episode = False
@@ -58,10 +64,20 @@ class WedgeMonitor:
             if self._in_episode:
                 continue  # act once per episode
             self._in_episode = True
+            # stacks say WHERE threads sit; the flight-recorder tail says
+            # WHICH stage of which wave/flush/proposal last retired —
+            # together they are the wedge postmortem
+            self.last_trace_tail = trace.tail_text(48)
             log.error("store is wedged (update lock held beyond %.0fs); "
-                      "dumping stacks and transferring leadership\n%s",
+                      "dumping stacks and transferring leadership\n%s"
+                      "%s",
                       getattr(self.store, "wedge_timeout", 30.0),
-                      dump_all_stacks())
+                      dump_all_stacks(),
+                      ("\n--- flight recorder tail ---\n"
+                       + self.last_trace_tail
+                       if self.last_trace_tail else
+                       "\n(flight recorder disarmed: no span tail; arm "
+                       "utils/trace or SWARMKIT_TPU_TRACE=1)"))
             if self.raft is not None:
                 try:
                     self.raft.transfer_leadership()

@@ -4,17 +4,30 @@ The reference exposes Prometheus via --listen-metrics and pprof/expvar via
 --listen-debug (swarmd/cmd/swarmd/main.go:5-9, 97-100, 266;
 manager/manager.go:551-562 grpc_prometheus). The Python-native analogue:
 
-  /metrics       Prometheus text — object/node gauges + hot-path histograms
+  /metrics       Prometheus text (content type text/plain; version=0.0.4)
+                 — object/node gauges + hot-path histograms
                  (manager/metrics.py MetricsCollector.prometheus_text)
+                 + per-node component counters (WAL fsyncs, store op
+                 counts, commit-worker depth/poison, heartbeat-wheel
+                 entries/buckets) + the trace plane's derived stage
+                 histograms when the tracer is armed
   /healthz       liveness probe
   /debug/stacks  all thread stacks (the pprof goroutine-dump analogue —
                  the same diagnostic the wedge detector emits)
-  /debug/vars    expvar-style JSON snapshot
+  /debug/vars    expvar-style JSON snapshot (+ store op counts, failpoint
+                 arm-state, trace arm-state — a leaked arm is visible
+                 here without reading conftest output)
   /debug/profile?seconds=N
                  CPU profile of the live process (the pprof CPU-profile
                  analogue, VERDICT item 9): all threads sampled at
                  ~100 Hz for N seconds, reported as a pstats dump
                  sorted by cumulative time
+  /debug/trace?seconds=N
+                 collect spans for N seconds (arming the tracer for the
+                 window if it was disarmed) and return JSON span trees
+  /debug/trace/recent
+                 the armed flight recorder's current contents as JSON
+                 span trees (empty when disarmed)
 
 Bound to loopback by default; no TLS (match the reference's plaintext debug
 listeners, which are operator-only surfaces).
@@ -105,12 +118,100 @@ def profile_dump(seconds: float, interval: float = 0.01) -> str:
     return out.getvalue()
 
 
+def _find(node, attr):
+    """Resolve a component off the node or its manager (the two shapes
+    DebugServer is constructed around: SwarmNode and bare test stubs)."""
+    v = getattr(node, attr, None)
+    if v is not None:
+        return v
+    return getattr(getattr(node, "manager", None), attr, None)
+
+
+def component_metrics_text(node) -> str:
+    """Per-node component counters that were bench-only/internal until
+    ISSUE 5: raft storage fsyncs, store op counts, the commit plane's
+    queue depth + poison count, and heartbeat-wheel occupancy. Every
+    lookup is defensive — a worker node (no raft), a stub, or a
+    pre-leadership manager simply contributes fewer families."""
+    lines: list[str] = []
+
+    def fam(name, help_, type_, samples):
+        if not samples:
+            return
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} {type_}")
+        lines.extend(samples)
+
+    storage = getattr(_find(node, "raft"), "storage", None)
+    if storage is not None and hasattr(storage, "wal_fsyncs"):
+        fam("swarm_raft_wal_fsyncs_total",
+            "WAL group-append fsyncs on this node (one per ready-flush "
+            "batch; amortized per commit under load)", "counter",
+            [f"swarm_raft_wal_fsyncs_total {storage.wal_fsyncs}"])
+        fam("swarm_raft_meta_fsyncs_total",
+            "hardstate/membership/snapshot/dir fsyncs on this node",
+            "counter",
+            [f"swarm_raft_meta_fsyncs_total {storage.meta_fsyncs}"])
+    op_counts = getattr(_find(node, "store"), "op_counts", None)
+    if op_counts:
+        from ..utils.metrics import _escape_label_value
+
+        fam("swarm_store_ops_total",
+            "store operations by kind (view/update transactions, "
+            "per-table finds)", "counter",
+            [f'swarm_store_ops_total{{op="{_escape_label_value(op)}"}} {n}'
+             for op, n in sorted(op_counts.items())])
+    wheel = getattr(_find(node, "dispatcher"), "_hb_wheel", None)
+    if wheel is not None:
+        fam("swarm_heartbeat_wheel_entries",
+            "sessions armed on the dispatcher heartbeat wheel", "gauge",
+            [f"swarm_heartbeat_wheel_entries {len(wheel)}"])
+        fam("swarm_heartbeat_wheel_buckets",
+            "live buckets on the dispatcher heartbeat wheel", "gauge",
+            [f"swarm_heartbeat_wheel_buckets {wheel.bucket_count}"])
+        fam("swarm_heartbeat_wheel_ticks_total",
+            "heartbeat-wheel ticker fires", "counter",
+            [f"swarm_heartbeat_wheel_ticks_total {wheel.ticks}"])
+        fam("swarm_heartbeat_wheel_expired_total",
+            "heartbeat expirations delivered by the wheel", "counter",
+            [f"swarm_heartbeat_wheel_expired_total {wheel.fired}"])
+    worker = None
+    mgr = getattr(node, "manager", None)
+    for c in (getattr(mgr, "_leader_components", None) or ()):
+        w = getattr(c, "_commit_worker", None)
+        if w is not None:
+            worker = w
+            break
+    if worker is None:
+        worker = getattr(getattr(node, "scheduler", None),
+                         "_commit_worker", None)
+    if worker is not None:
+        fam("swarm_commit_worker_queue_depth",
+            "async commit plane: heavy commits submitted but not yet "
+            "retired", "gauge",
+            [f"swarm_commit_worker_queue_depth {worker.pending}"])
+        fam("swarm_commit_worker_poisoned",
+            "async commit plane: 1 while the worker holds an unraised "
+            "exception (heals at the next barrier)", "gauge",
+            [f"swarm_commit_worker_poisoned {int(worker.failed)}"])
+        fam("swarm_commit_worker_jobs_total",
+            "async commit plane: heavy commits retired", "counter",
+            [f"swarm_commit_worker_jobs_total {worker.jobs_total}"])
+        fam("swarm_commit_worker_poison_total",
+            "async commit plane: poison episodes (worker-side commit "
+            "crashes)", "counter",
+            [f"swarm_commit_worker_poison_total {worker.poisoned_total}"])
+    return "\n".join(lines)
+
+
 class DebugServer:
     """One HTTP listener serving the observability surface for a node."""
 
     def __init__(self, addr: str, node):
         host, _, port = addr.rpartition(":")
         self.node = node
+        # serializes /debug/trace?seconds=N captures (see _trace)
+        self._trace_window_lock = threading.Lock()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -129,13 +230,21 @@ class DebugServer:
             def do_GET(self):
                 try:
                     if self.path == "/metrics":
-                        self._reply(outer._metrics_text())
+                        # the Prometheus text-format version the scraper
+                        # content-negotiates on (satellite: exposition fix)
+                        self._reply(outer._metrics_text(),
+                                    ctype="text/plain; version=0.0.4; "
+                                          "charset=utf-8")
                     elif self.path == "/healthz":
                         self._reply("ok\n")
                     elif self.path == "/debug/stacks":
                         self._reply(dump_stacks())
                     elif self.path == "/debug/vars":
                         self._reply(json.dumps(outer._vars(), indent=2),
+                                    ctype="application/json")
+                    elif self.path.startswith("/debug/trace"):
+                        self._reply(json.dumps(outer._trace(self.path),
+                                               indent=2),
                                     ctype="application/json")
                     elif self.path.startswith("/debug/profile"):
                         from urllib.parse import parse_qs, urlparse
@@ -163,27 +272,90 @@ class DebugServer:
 
     def _metrics_text(self) -> str:
         node = self.node
+        parts = []
         mgr = getattr(node, "manager", None)
+        collector = None
         if mgr is not None:
             for c in getattr(mgr, "_leader_components", []):
                 if hasattr(c, "prometheus_text"):
-                    return c.prometheus_text()
-        # non-leader / worker: hot-path histograms + per-RPC families
-        # still exist
-        from ..utils.metrics import all_families, all_histograms
+                    collector = c
+                    break
+        if collector is not None:
+            parts.append(collector.prometheus_text())
+        else:
+            # non-leader / worker: hot-path histograms + per-RPC families
+            # still exist
+            from ..utils.metrics import all_families, all_histograms
 
-        return "\n".join(
-            [h.prometheus_text() for h in all_histograms()]
-            + [f.prometheus_text() for f in all_families()])
+            parts.extend(
+                [h.prometheus_text() for h in all_histograms()]
+                + [f.prometheus_text() for f in all_families()])
+        comp = component_metrics_text(node)
+        if comp:
+            parts.append(comp)
+        return "\n".join(p for p in parts if p)
+
+    def _trace(self, path: str) -> dict:
+        """/debug/trace?seconds=N and /debug/trace/recent: JSON span
+        trees from the flight recorder. The windowed form arms the
+        tracer for the window when it was disarmed — an operator gets a
+        trace capture from a live daemon without restarting it."""
+        from urllib.parse import parse_qs, urlparse
+
+        from ..utils import trace
+
+        parsed = urlparse(path)
+        if parsed.path.rstrip("/").endswith("/recent"):
+            r = trace.recorder()
+            return {"armed": r is not None,
+                    "spans": r.spans_started if r is not None else 0,
+                    "traces": r.trees() if r is not None else []}
+        q = parse_qs(parsed.query)
+        try:
+            seconds = float(q.get("seconds", ["1"])[0])
+        except ValueError:
+            seconds = 1.0
+        seconds = max(0.05, min(seconds, 30.0))
+        # windowed captures SERIALIZE (one lock across arm+sleep+disarm):
+        # an overlapping request must not have its window truncated by
+        # the first one's disarm, nor report "armed" for a recorder that
+        # is about to be torn down. Blocks this handler thread only
+        # (ThreadingHTTPServer); /debug/trace/recent stays lock-free.
+        with self._trace_window_lock:
+            r = trace.recorder()
+            temporary = r is None
+            if temporary:
+                r = trace.arm()
+            try:
+                time.sleep(seconds)
+                trees = r.trees(seconds=seconds + 0.05)
+            finally:
+                # never clobber an arm that raced in (an operator's
+                # trace.arm replaces the recorder — then it is theirs)
+                if temporary and trace.recorder() is r:
+                    trace.disarm()
+        return {"armed": not temporary, "window_s": seconds,
+                "spans": r.spans_started, "traces": trees}
 
     def _vars(self) -> dict:
+        from ..utils import failpoints, trace
+
         node = self.node
         out = {
             "node_id": getattr(node, "node_id", None),
             "addr": getattr(node, "addr", None),
             "is_leader": bool(getattr(node, "is_leader", False)),
             "threads": len(threading.enumerate()),
+            # fault/trace plane arm-state: a leaked arm (a test, an
+            # operator session) is visible to operators HERE, not only
+            # in conftest teardown assertions
+            "failpoints_armed": failpoints.active(),
+            "trace_armed": trace.active(),
         }
+        store = _find(node, "store")
+        if store is not None and getattr(store, "op_counts", None) \
+                is not None:
+            out["store_ops"] = dict(store.op_counts)
         raft = getattr(node, "raft", None)
         if raft is not None:
             out["raft"] = {
@@ -200,7 +372,11 @@ class DebugServer:
 
     def stop(self):
         try:
-            self._httpd.shutdown()
+            if self._thread.is_alive():
+                # shutdown() handshakes with serve_forever — calling it
+                # on a never-started server blocks forever on the
+                # is-shut-down event that only serve_forever sets
+                self._httpd.shutdown()
             self._httpd.server_close()
         except Exception:
             pass

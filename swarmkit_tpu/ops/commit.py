@@ -81,6 +81,11 @@ class CommitWorker:
         # and per-job durations in retirement (= submission) order
         self.busy_s = 0.0
         self.job_s: list[float] = []
+        # observability (/metrics): jobs retired and poison episodes —
+        # a rising poison count with the suite green means heals are
+        # eating real commits (the operator signal ISSUE 5 exports)
+        self.jobs_total = 0
+        self.poisoned_total = 0
 
     # ---------------------------------------------------------------- thread
     def _ensure_thread(self):
@@ -110,6 +115,7 @@ class CommitWorker:
                 with self._cond:
                     if self._exc is None:
                         self._exc = exc
+                    self.poisoned_total += 1
                     # poison: queued jobs were built on state this
                     # failed commit left undefined — drop, don't run
                     n = len(self._jobs)
@@ -119,6 +125,7 @@ class CommitWorker:
                 dt = time.perf_counter() - t0
                 with self._cond:
                     self.busy_s += dt
+                    self.jobs_total += 1
                     # observability ring, same rationale as
                     # TickPipeline.timings: a production daemon's worker
                     # lives for the scheduler's lifetime and must not
@@ -187,3 +194,9 @@ class CommitWorker:
     def idle(self) -> bool:
         with self._cond:
             return self._pending == 0
+
+    @property
+    def pending(self) -> int:
+        """Queue depth (submitted, not yet retired) — the /metrics gauge."""
+        with self._cond:
+            return self._pending

@@ -88,8 +88,19 @@ from ..scheduler.encode import (
     IncrementalEncoder,
     fold_problem,
 )
+from ..utils import trace
 from .commit import CommitWorker
 from .resident import PendingCounts, ResidentPlacement
+
+# stage-timing keys -> span names filed into the trace plane per wave
+# (utils/trace.py; pull_s is the real value pull — the tunnel rule's one
+# device_sync span per burst, never one per kernel)
+_STAGE_SPANS = (("barrier_s", "tick.barrier"),
+                ("pull_s", "tick.device_sync"),
+                ("fold_s", "tick.fold"),
+                ("encode_s", "tick.encode"),
+                ("dispatch_s", "tick.dispatch"),
+                ("commit_s", "tick.commit"))
 
 
 class TickPipeline:
@@ -216,9 +227,33 @@ class TickPipeline:
         state, up to `depth` on a drain. In async mode a returned wave's
         heavy commit may still be riding the worker; it is retired by
         the next tick's barrier (or flush())."""
+        # wave root span (trace plane): stage recs file under it; the
+        # async heavy commit links back to it via trace.wrap below.
+        # Off-stack (trace.start) so an exception mid-tick cannot corrupt
+        # the thread's implicit-parent stack; None when disarmed — one
+        # truthiness test, nothing allocated. try/finally so a FAILING
+        # wave's span (error attr + whatever stages it measured) still
+        # reaches the flight recorder — that wave is exactly the
+        # forensics payload, and the mirrored Scheduler path records its
+        # failed sched.tick the same way.
+        _sp = trace.start("tick.wave", inflight=len(self._inflight))
+        timing = {"pull_s": 0.0, "fold_s": 0.0, "barrier_s": 0.0}
+        try:
+            return self._tick_traced(infos, groups, now, volume_set,
+                                     timing, _sp)
+        except BaseException as exc:
+            if _sp is not None:
+                _sp.attrs.setdefault("error", repr(exc))
+            raise
+        finally:
+            if _sp is not None:
+                self._file_stage_spans(timing, _sp)
+                _sp.end(serial=bool(timing.get("serial_fallback")))
+
+    def _tick_traced(self, infos, groups, now, volume_set, timing,
+                     _sp) -> list[tuple[EncodedProblem, np.ndarray]]:
         t_wave = time.perf_counter()
         completed: list[tuple] = []
-        timing = {"pull_s": 0.0, "fold_s": 0.0, "barrier_s": 0.0}
         # a completed-but-not-yet-committed wave (commits must stay FIFO
         # and must NEVER be dropped: fold_counts already ran for it)
         deferred: tuple | None = None
@@ -257,7 +292,11 @@ class TickPipeline:
             p, c = deferred
             deferred = None
             if self.worker is not None and not sync:
-                self.worker.submit(functools.partial(self._heavy, p, c))
+                # the heavy half joins THIS wave's trace (the tick that
+                # pulled + folded it); trace.wrap is identity when disarmed
+                self.worker.submit(trace.wrap(
+                    "tick.commit_heavy",
+                    functools.partial(self._heavy, p, c), parent=_sp))
             else:
                 timing["commit_s"] = (timing.get("commit_s", 0.0)
                                       + self._commit(p, c))
@@ -327,6 +366,15 @@ class TickPipeline:
         self._record(timing)
         return completed
 
+    @staticmethod
+    def _file_stage_spans(timing: dict, parent) -> None:
+        """File one completed span per measured nonzero stage (armed
+        only; the measurements already exist in `timing`)."""
+        for key, name in _STAGE_SPANS:
+            v = timing.get(key)
+            if v:
+                trace.rec(name, v, parent=parent)
+
     def _record(self, timing: dict) -> None:
         # observability ring: a long-lived production driver must not
         # accumulate one dict per tick forever
@@ -339,18 +387,32 @@ class TickPipeline:
         oldest first; one timings entry per completed wave. In async
         mode the worker is barriered first, so on return NOTHING rides
         the plane (worker exceptions re-raise here)."""
-        self._barrier()
         out = []
-        while self._inflight:
-            p, counts, timing = self._complete()
-            timing["commit_s"] = self._commit(p, counts)
-            timing["serial_fallback"] = False
-            timing["barrier_s"] = 0.0
-            timing["encode_s"] = timing["dispatch_s"] = 0.0
-            timing["wall_s"] = timing["pull_s"] + timing["fold_s"] \
-                + timing["commit_s"]
-            self._record(timing)
-            out.append((p, counts))
+        # span opened BEFORE the barrier and ended in a finally: a
+        # poisoned worker re-raising here (or a failing drain commit)
+        # still files the flush span + its error for the forensics tail
+        _sp = trace.start("tick.flush")
+        try:
+            self._barrier()
+            while self._inflight:
+                p, counts, timing = self._complete()
+                timing["commit_s"] = self._commit(p, counts)
+                timing["serial_fallback"] = False
+                timing["barrier_s"] = 0.0
+                timing["encode_s"] = timing["dispatch_s"] = 0.0
+                timing["wall_s"] = timing["pull_s"] + timing["fold_s"] \
+                    + timing["commit_s"]
+                self._record(timing)
+                if _sp is not None:
+                    self._file_stage_spans(timing, _sp)
+                out.append((p, counts))
+        except BaseException as exc:
+            if _sp is not None:
+                _sp.attrs.setdefault("error", repr(exc))
+            raise
+        finally:
+            if _sp is not None:
+                _sp.end(waves=len(out))
         return out
 
     def barrier(self) -> None:

@@ -38,6 +38,13 @@ class Entry:
     kind: int = ENTRY_NORMAL
     data: Any = None
     request_id: str = ""  # correlates proposals with wait callbacks
+    # trace-plane context (utils/trace.py): the (trace_id, span_id) of
+    # the originating proposal's span, or None when tracing was off at
+    # propose time. Rides replication (AppendEntries) and the WAL via
+    # the ordinary codec path, so a follower's fsync/apply spans join
+    # the leader-side trace; pre-trace WAL records decode with the
+    # default. Never interpreted by consensus.
+    trace: Any = None
 
 
 @dataclass

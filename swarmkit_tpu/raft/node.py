@@ -23,8 +23,11 @@ import logging
 import queue
 import random
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Callable
+
+from ..utils import trace
 
 from .messages import (
     ENTRY_CONF_CHANGE,
@@ -284,10 +287,14 @@ class RaftNode:
         self._inbox.put(("tick",))
 
     def propose(self, data: Any, request_id: str,
-                callback: Callable[[bool, str], None]):
+                callback: Callable[[bool, str], None],
+                trace_ctx=None):
         """Propose a normal entry; callback(ok, err) fires on commit (from
-        the worker thread) or on drop."""
-        self._inbox.put(("propose", data, request_id, callback))
+        the worker thread) or on drop. `trace_ctx` (optional) is the
+        proposer's span context — it rides the staged Entry so the WAL
+        fsync / commit / apply spans on every replica join the
+        proposal's trace (utils/trace.py)."""
+        self._inbox.put(("propose", data, request_id, callback, trace_ctx))
 
     def propose_conf_change(self, cc: ConfChange, request_id: str,
                             callback: Callable[[bool, str], None]):
@@ -425,13 +432,29 @@ class RaftNode:
         message leaves; (4) one coalesced AppendEntries per dirty peer;
         (5) release the buffered outgoing messages to the transport."""
         self.ready_flushes += 1
+        # ready-loop trace guard: ONE module-global truthiness test when
+        # disarmed (the bench/test acceptance); per-entry work below only
+        # happens for entries that carry a trace ctx
+        traced = trace.enabled()
         if self._ready_entries:
             if self.storage is not None:
+                if traced:
+                    _t0 = time.perf_counter()
+                    _tctx = next((e.trace for e in self._ready_entries
+                                  if e.trace is not None), None)
+                    _n = len(self._ready_entries)
                 try:
                     self.storage.append_entries(self._ready_entries)
                 except OSError as exc:
                     self._on_append_failure(exc)
                     return
+                if traced:
+                    # one span per GROUP append (one WAL write + fsync),
+                    # parented to the first traced entry so the fsync
+                    # joins the proposal's causal trace; never per-entry
+                    trace.rec("raft.wal_fsync",
+                              time.perf_counter() - _t0, parent=_tctx,
+                              node=self.id, entries=_n)
                 if self.storage_degraded:
                     # the disk took a durable batch again: leave
                     # read-only mode (the follower catch-up path heals
@@ -541,7 +564,8 @@ class RaftNode:
         elif kind == "tick":
             self._on_tick()
         elif kind == "propose":
-            self._on_propose(item[1], item[2], item[3])
+            self._on_propose(item[1], item[2], item[3],
+                             item[4] if len(item) > 4 else None)
         elif kind == "conf":
             self._on_conf_change(item[1], item[2], item[3])
         elif kind == "campaign":
@@ -1009,7 +1033,7 @@ class RaftNode:
                                   success=True, match_index=snapshot_index))
 
     # ------------------------------------------------------------- proposing
-    def _on_propose(self, data, request_id, callback):
+    def _on_propose(self, data, request_id, callback, trace_ctx=None):
         if self.storage_degraded:
             # read-only: reads/heartbeats keep flowing, writes bounce
             callback(False, "storage degraded (read-only): out of disk "
@@ -1024,7 +1048,11 @@ class RaftNode:
             return
         self._waits[request_id] = callback
         e = Entry(term=self.term, index=self._last_index() + 1,
-                  kind=ENTRY_NORMAL, data=data, request_id=request_id)
+                  kind=ENTRY_NORMAL, data=data, request_id=request_id,
+                  trace=trace_ctx)
+        if trace_ctx is not None and trace.enabled():
+            trace.event("raft.stage", parent=trace_ctx,
+                        node=self.id, index=e.index)
         self._append_local(e)
         self._mark_broadcast()
         # the batch flush persists (one fsync for ALL proposals in the
@@ -1185,6 +1213,9 @@ class RaftNode:
             # the flush applies right after this (batched apply pass)
 
     def _apply_committed(self):
+        # disarmed cost on this hot loop: one truthiness test up front,
+        # one `and`-short-circuited attribute read per entry
+        traced = trace.enabled()
         if self.last_applied < self.commit_index:
             # persist the advanced commit (etcd HardState semantics: term,
             # vote and commit survive restarts together)
@@ -1200,6 +1231,15 @@ class RaftNode:
                 break
             e = self.log[idx]
             self.commits_applied += 1
+            _t0 = None
+            if traced and e.trace is not None:
+                # commit event + apply span join the proposal's trace —
+                # on the leader AND on followers (the ctx rode the
+                # replicated entry), which is what makes the causal
+                # propose→fsync→commit→apply chain cross node boundaries
+                trace.event("raft.commit", parent=e.trace,
+                            node=self.id, index=e.index)
+                _t0 = time.perf_counter()
             if e.kind == ENTRY_CONF_CHANGE:
                 self._apply_conf_change(e)
             elif e.data is not None:
@@ -1207,6 +1247,9 @@ class RaftNode:
                     self.apply_entry(e)
                 except Exception:
                     log.exception("raft-%d: apply failed at %d", self.id, e.index)
+            if _t0 is not None:
+                trace.rec("raft.apply", time.perf_counter() - _t0,
+                          parent=e.trace, node=self.id, index=e.index)
             cb = self._waits.pop(e.request_id, None) if e.request_id else None
             if cb is not None:
                 try:

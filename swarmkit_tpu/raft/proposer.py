@@ -16,6 +16,7 @@ from typing import Callable
 import time
 
 from ..api.objects import Version
+from ..utils import trace
 from ..utils.identity import new_id
 from ..utils.metrics import histogram
 from .messages import ERR_LEADERSHIP_LOST, ERR_NOT_LEADER, Entry
@@ -125,13 +126,22 @@ class RaftProposer:
         with self._lock:
             self._pending[req_id] = commit_cb
 
+        # trace plane: the proposal's root span — submit→commit-resolve.
+        # Its ctx rides the staged Entry (and therefore replication and
+        # the WAL), so every replica's fsync/commit/apply spans join this
+        # trace. None when disarmed: zero allocation on the propose path.
+        sp = trace.start("raft.propose")
+
         def on_result(ok: bool, err: str):
             if not ok:
                 with self._lock:
                     self._pending.pop(req_id, None)
+            if sp is not None:
+                sp.end(ok=ok)
             handle._resolve(ok, err)
 
-        self.node.propose(list(actions), req_id, on_result)
+        self.node.propose(list(actions), req_id, on_result,
+                          trace_ctx=sp.ctx() if sp is not None else None)
         return handle
 
     def propose_value(self, actions, commit_cb: Callable[..., None]) -> None:

@@ -16,6 +16,7 @@ import threading
 
 from ..store.watch import Channel
 from ..utils import backoff as _backoff
+from ..utils import trace
 from .wire import (
     CANCEL,
     ERR,
@@ -213,6 +214,24 @@ class RPCClient:
                 attempt += 1
 
     def _call_once(self, method: str, args, kwargs, timeout: float):
+        # trace plane: a client span per unary call; its ctx rides the
+        # frame payload as the reserved `_trace_ctx` kwarg (the server
+        # strips it unconditionally and parents its handler span to it).
+        # Disarmed: one truthiness test, the kwargs dict untouched.
+        sp = trace.start("rpc.client", method=method)
+        if sp is None:
+            return self._call_once_inner(method, args, kwargs, timeout)
+        kwargs = dict(kwargs)          # never mutate the caller's dict
+        kwargs["_trace_ctx"] = sp.ctx()
+        try:
+            result = self._call_once_inner(method, args, kwargs, timeout)
+        except Exception as exc:
+            sp.end(error=type(exc).__name__)
+            raise
+        sp.end(ok=True)
+        return result
+
+    def _call_once_inner(self, method: str, args, kwargs, timeout: float):
         # generation snapshot: a concurrent _redial may swap sock/closed
         # mid-call; failures observed on THIS generation must not kill
         # calls pending on a newer one

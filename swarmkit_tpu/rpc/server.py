@@ -24,7 +24,7 @@ from typing import Callable
 
 from ..ca.auth import Caller, PermissionDenied
 from ..store.watch import Channel, ChannelClosed
-from ..utils import failpoints
+from ..utils import failpoints, trace
 from .wire import (
     CANCEL,
     ERR,
@@ -332,6 +332,10 @@ class RPCServer:
                     f"{method}: role not authorized"))
                 return
         args, kwargs = payload if payload else ((), {})
+        # reserved trace-context key: stripped UNCONDITIONALLY (a traced
+        # client may call an untraced server — the handler must never see
+        # it); parents the server span below when this end is armed too
+        tctx = kwargs.pop("_trace_ctx", None)
         forwarded = kwargs.pop("_forwarded_caller", None)
         if forwarded is not None:
             # Only a manager may assert a forwarded identity (the leader
@@ -354,7 +358,11 @@ class RPCServer:
             # stop-drain path); error = a handler crash, surfaced to the
             # caller as a wire error like any handler exception
             failpoints.fp("rpc.server.handle")
-            result = mdef.func(caller, *args, **kwargs)
+            if trace.enabled():
+                with trace.span("rpc.server", parent=tctx, method=mlabel):
+                    result = mdef.func(caller, *args, **kwargs)
+            else:
+                result = mdef.func(caller, *args, **kwargs)
         except Exception as exc:  # handler error -> wire error
             reply_err(exc)
             return

@@ -40,7 +40,7 @@ from ..api.types import NodeStatusState, TaskState
 from ..store import by
 from ..store.memory import MemoryStore
 from ..store.watch import ChannelClosed
-from ..utils import failpoints
+from ..utils import failpoints, trace
 from .batch import apply_placements, cpu_schedule_encoded, materialize_orders
 from .encode import IncrementalEncoder, TaskGroup
 from .filters import Pipeline
@@ -227,10 +227,12 @@ class Scheduler:
         incidents happen to hit."""
         try:
             failpoints.fp("commit.materialize")
-            orders = materialize_orders(problem, counts)
+            with trace.span("tick.commit.materialize"):
+                orders = materialize_orders(problem, counts)
             failpoints.fp("commit.writeback")
-            clean = self._apply_decisions(problem, orders, counts,
-                                          deferred_fold=True)
+            with trace.span("tick.commit.writeback"):
+                clean = self._apply_decisions(problem, orders, counts,
+                                              deferred_fold=True)
         except BaseException:
             # a CRASH in the heavy half is an unclean commit too: the
             # optimistic fold already ran on the tick thread, but the
@@ -461,16 +463,20 @@ class Scheduler:
     # ------------------------------------------------------------------ tick
     def tick(self):
         self.ticks += 1
-        if self._inflight is not None:
-            self._tick_pipelined()
-            return
-        # the serial path reads and mutates host state end to end:
-        # retire any heavy commit still riding the async plane first
-        # (worker exceptions re-raise here, into the guarded tick)
-        self._drain_commit_plane()
-        if self.preassigned:
-            self._process_preassigned()
-        self._schedule_backlog()
+        # trace-plane root: stage spans (encode/dispatch/device_sync/
+        # barrier/commit) nest under it implicitly; the NOOP singleton
+        # when disarmed — no allocation on the hot path
+        with trace.span("sched.tick", n=self.ticks):
+            if self._inflight is not None:
+                self._tick_pipelined()
+                return
+            # the serial path reads and mutates host state end to end:
+            # retire any heavy commit still riding the async plane first
+            # (worker exceptions re-raise here, into the guarded tick)
+            self._drain_commit_plane()
+            if self.preassigned:
+                self._process_preassigned()
+            self._schedule_backlog()
 
     def _schedule_backlog(self):
         """One scheduling pass over the unassigned pool (the serial tick
@@ -481,8 +487,10 @@ class Scheduler:
         groups = self._group_unassigned()
         if not groups:
             return
-        problem = self.encoder.encode(list(self.node_infos.values()), groups,
-                                      volume_set=self.volume_set)
+        with trace.span("tick.encode", groups=len(groups)):
+            problem = self.encoder.encode(list(self.node_infos.values()),
+                                          groups,
+                                          volume_set=self.volume_set)
         use_jax = self._use_jax(problem)
         if use_jax and self.backend == "auto" \
                 and len(problem.node_ids) <= COLD_CPU_NODES \
@@ -506,19 +514,25 @@ class Scheduler:
             if self.pipeline:
                 # dispatch only: the counts D2H rides the link through the
                 # debounce window; the next tick completes the wave
-                h = self._resident.schedule_async(problem)
+                with trace.span("tick.dispatch"):
+                    h = self._resident.schedule_async(problem)
                 ids = frozenset(t.id for g in groups for t in g.tasks)
                 self._inflight = (problem, h, ids)
                 return
-            counts = self._resident.schedule(problem)
+            # blocking schedule: the counts pull inside is the one real
+            # device sync of this tick (tunnel rule: one span per burst)
+            with trace.span("tick.device_sync"):
+                counts = self._resident.schedule(problem)
         else:
-            counts = cpu_schedule_encoded(problem)
+            with trace.span("tick.cpu_fill"):
+                counts = cpu_schedule_encoded(problem)
             if self._resident is not None:
                 # the device copy missed this tick's fold: resync on the
                 # next jax tick
                 self._resident.invalidate()
-        orders = materialize_orders(problem, counts)
-        self._apply_decisions(problem, orders, counts)
+        with trace.span("tick.commit"):
+            orders = materialize_orders(problem, counts)
+            self._apply_decisions(problem, orders, counts)
 
     def _make_mesh(self):
         """Resolve the configured mesh (backend="mesh" / mesh=) to a
@@ -563,8 +577,10 @@ class Scheduler:
             # async plane: pull FIRST — the blocking transfer wait
             # releases the GIL, which is when the previous wave's heavy
             # commit runs — then barrier before any host-state read.
-            counts = h.get()
-            worker.barrier()        # worker exceptions re-raise here
+            with trace.span("tick.device_sync"):
+                counts = h.get()
+            with trace.span("tick.barrier"):
+                worker.barrier()    # worker exceptions re-raise here
             if self._worker_unclean is not None:
                 # the PREVIOUS wave's commit was unclean, and THIS wave
                 # was primed on its lying fold: heal (poison + resident
@@ -590,12 +606,14 @@ class Scheduler:
                 # which correctly forces the touched rows to re-encode
                 # before the next dispatch.
                 self._process_preassigned()
-            counts = h.get()
-        folded = self.encoder.fold_counts(problem, counts)
-        if folded:
-            self._resident.after_apply(problem, counts)
-        else:
-            self._resident.invalidate()
+            with trace.span("tick.device_sync"):
+                counts = h.get()
+        with trace.span("tick.fold"):
+            folded = self.encoder.fold_counts(problem, counts)
+            if folded:
+                self._resident.after_apply(problem, counts)
+            else:
+                self._resident.invalidate()
 
         # next wave: everything unassigned that is NOT still uncommitted
         # in the wave being completed (no double placement)
@@ -609,11 +627,13 @@ class Scheduler:
                     self.backend in ("jax", "mesh")
                     or total_next * max(len(self.node_infos), 1)
                     >= self.jax_threshold):
-                p_next = self.encoder.encode(
-                    list(self.node_infos.values()), next_groups,
-                    volume_set=self.volume_set)
+                with trace.span("tick.encode", groups=len(next_groups)):
+                    p_next = self.encoder.encode(
+                        list(self.node_infos.values()), next_groups,
+                        volume_set=self.volume_set)
                 if self._use_jax(p_next):
-                    h_next = self._resident.schedule_async(p_next)
+                    with trace.span("tick.dispatch"):
+                        h_next = self._resident.schedule_async(p_next)
                     ids = frozenset(
                         t.id for g in next_groups for t in g.tasks)
                     self._inflight = (p_next, h_next, ids)
@@ -623,9 +643,11 @@ class Scheduler:
             # write-back, the add_task walk, the restamp — retired by
             # the next barrier; an unclean outcome heals there too.
             # Enqueued only now, after this tick's encode/dispatch
-            # stopped reading host state.
-            worker.submit(functools.partial(
-                self._commit_heavy, problem, counts))
+            # stopped reading host state. The job joins this tick's
+            # trace (trace.wrap: identity when disarmed).
+            worker.submit(trace.wrap(
+                "tick.commit_heavy",
+                functools.partial(self._commit_heavy, problem, counts)))
             if self._inflight is None and self.unassigned:
                 # nothing primed: the backlog must be attempted NOW
                 # (wedge avoidance, same as the sync path below) — and
@@ -647,9 +669,10 @@ class Scheduler:
                     # still queued to wake the loop.
                     self._schedule_backlog()
             return
-        orders = materialize_orders(problem, counts)
-        clean = self._apply_decisions(problem, orders, counts,
-                                      deferred_fold=True)
+        with trace.span("tick.commit"):
+            orders = materialize_orders(problem, counts)
+            clean = self._apply_decisions(problem, orders, counts,
+                                          deferred_fold=True)
         if clean:
             self.encoder.restamp_counts(problem, counts)
         else:

@@ -41,7 +41,12 @@ class Histogram:
 
     def prometheus_text(self, type_line: bool = True) -> str:
         counts, total, n = self.snapshot()
-        lines = [f"# TYPE {self.name} histogram"] if type_line else []
+        lines = []
+        if type_line:
+            # HELP precedes TYPE (promtool order); families render their
+            # own header and pass type_line=False per child
+            lines.append(f"# HELP {self.name} {_escape_help(self.help)}")
+            lines.append(f"# TYPE {self.name} histogram")
         lbl = (self.labels + ",") if self.labels else ""
         cum = 0
         for b, c in zip(self.buckets, counts):
@@ -59,6 +64,11 @@ def _escape_label_value(v) -> str:
     # be escaped inside label values or the scrape breaks mid-page.
     return (str(v).replace("\\", "\\\\").replace('"', '\\"')
             .replace("\n", "\\n"))
+
+
+def _escape_help(v) -> str:
+    # HELP text: backslash and newline only (quotes are legal there)
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _render_labels(label_names: tuple, values: tuple) -> str:
@@ -89,7 +99,8 @@ class CounterFamily:
     def prometheus_text(self) -> str:
         with self._lock:
             items = sorted(self._series.items())
-        lines = [f"# TYPE {self.name} counter"]
+        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
+                 f"# TYPE {self.name} counter"]
         for values, n in items:
             lines.append(
                 f"{self.name}{{{_render_labels(self.label_names, values)}}}"
@@ -125,7 +136,8 @@ class HistogramFamily:
     def prometheus_text(self) -> str:
         with self._lock:
             items = sorted(self._series.items())
-        lines = [f"# TYPE {self.name} histogram"]
+        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
+                 f"# TYPE {self.name} histogram"]
         for _values, h in items:
             lines.append(h.prometheus_text(type_line=False))
         return "\n".join(lines)

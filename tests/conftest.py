@@ -64,6 +64,44 @@ def _failpoints_disarmed():
     assert not leaked, f"test leaked armed failpoints: {leaked}"
 
 
+@pytest.fixture(autouse=True)
+def _trace_disarmed():
+    """Mirror of the failpoints leak guard for the trace plane: a leaked
+    armed tracer would silently tax every later test's hot paths with
+    span recording (and mis-attribute their spans to this test's
+    recorder). Fail the leaking test itself and always disarm. Also
+    clears the retired-tail copy, so the chaos report hook below can
+    never attach a PREVIOUS test's spans to this one's failure."""
+    from swarmkit_tpu.utils import trace
+
+    trace.clear_retired_tail()
+    yield
+    leaked = trace.active()
+    trace.disarm()
+    assert not leaked, \
+        "test leaked an armed tracer/flight recorder (trace.disarm())"
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """Chaos forensics: a failing chaos-marked test gets the flight-
+    recorder tail appended to its report, next to the CHAOS_SEED line the
+    harness prints (docs/fault_injection.md). The chaos_seed harness
+    disarms in its finally (inside the test body), so this reads the
+    still-armed recorder OR the tail captured by that disarm
+    (trace.last_tail_text); the autouse fixture clears the retired copy
+    before every test, so a stale predecessor tail can never attach."""
+    outcome = yield
+    rep = outcome.get_result()
+    if rep.when == "call" and rep.failed \
+            and item.get_closest_marker("chaos") is not None:
+        from swarmkit_tpu.utils import trace
+
+        tail = trace.last_tail_text(40)
+        if tail:
+            rep.sections.append(("flight recorder tail", tail))
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "daemon: in-process networked daemon cluster tests")

@@ -39,14 +39,24 @@ SCHED_SOAK = list(range(2, 12))
 
 @contextmanager
 def chaos_seed(seed):
-    """Print the reproduction seed on ANY failure, always disarm."""
+    """Print the reproduction seed on ANY failure — plus the flight-
+    recorder tail (which barrier/flush/commit stage last retired before
+    the failure); always disarm both planes."""
+    from swarmkit_tpu.utils import trace
+
+    rec = trace.arm(capacity=2048)
     try:
         yield
     except BaseException:
         print(f"\nCHAOS_SEED={seed}")
+        tail = rec.tail_text(40)
+        if tail:
+            print("---- flight recorder tail ----")
+            print(tail)
         raise
     finally:
         failpoints.disarm_all()
+        trace.disarm()
 
 
 # ------------------------------------------------------------- raft side

@@ -1,0 +1,743 @@
+"""Trace plane (ISSUE 5): the span tracer + flight recorder, its causal
+propagation across threads / RPC / raft consensus, the disarmed
+zero-allocation contract on the hot paths, the derived stage-latency
+histograms, and the /metrics exposition satellites.
+
+The acceptance pair:
+
+  * a 3-node raft cluster produces ONE causal trace covering
+    propose → WAL-fsync → commit → apply across node boundaries
+    (the context rides the Entry through replication);
+  * a failpoints-style guard pins that tracing OFF allocates no Span
+    and files no record on the tick, dispatcher-flush, and raft
+    ready-loop hot paths.
+"""
+import importlib.util
+import json
+import os
+import random
+import threading
+import time
+import types
+import urllib.request
+
+import pytest
+
+from swarmkit_tpu.utils import failpoints, trace
+from swarmkit_tpu.utils.clock import FakeClock
+
+
+# ------------------------------------------------------------ tracer core
+def test_disarmed_surface_is_inert():
+    assert not trace.active()
+    assert trace.span("never.armed") is trace.NOOP
+    assert trace.start("never.armed") is None
+    assert trace.ctx() is None
+    trace.rec("never.armed", 0.01)          # no-op, no error
+    trace.event("never.armed")
+    fn = object()
+    assert trace.wrap("never.armed", fn) is fn
+    assert trace.tail_text() == ""
+    # NOOP singleton is safely usable everywhere a Span is
+    with trace.span("x") as s:
+        assert s.ctx() is None
+        s.set(a=1).end()
+
+
+def test_span_nesting_and_trees():
+    with trace.armed() as rec:
+        with trace.span("sched.tick", n=1) as root:
+            with trace.span("tick.encode"):
+                pass
+            with trace.span("tick.dispatch"):
+                pass
+            root_ctx = root.ctx()
+        # explicit parenting across threads
+        done = threading.Event()
+
+        def worker():
+            with trace.span("tick.commit_heavy", parent=root_ctx):
+                pass
+            done.set()
+
+        threading.Thread(target=worker, daemon=True).start()
+        assert done.wait(5)
+        trees = rec.trees()
+    assert not trace.active()
+    assert len(trees) == 1
+    root = trees[0]
+    assert root["name"] == "sched.tick" and root["attrs"] == {"n": 1}
+    kids = sorted(c["name"] for c in root["children"])
+    assert kids == ["tick.commit_heavy", "tick.dispatch", "tick.encode"]
+    # every record shares the root's trace id
+    assert {c["trace"] for c in root["children"]} == {root["trace"]}
+
+
+def test_ring_is_bounded_and_counts_drops():
+    with trace.armed(capacity=64) as rec:
+        for i in range(500):
+            trace.rec("tick.encode", 0.001, i=i)
+        snap = rec.snapshot()
+        assert len(snap) <= 64
+        assert rec.dropped == 500 - len(snap)
+        assert rec.spans_started == 500
+        # the TAIL survived — crash forensics wants the newest spans
+        assert snap[-1]["attrs"]["i"] == 499
+
+
+def test_exception_exit_records_error_attr_and_unwinds_stack():
+    with trace.armed() as rec:
+        with pytest.raises(ValueError):
+            with trace.span("tick.encode"):
+                raise ValueError("boom")
+        assert trace.ctx() is None          # stack unwound
+        (r,) = rec.snapshot()
+        assert "ValueError" in r["attrs"]["error"]
+
+
+def test_clock_injection_stamps_fake_time():
+    clock = FakeClock(start=5000.0)
+    with trace.armed(clock=clock) as rec:
+        trace.rec("tick.encode", 0.25)
+        (r,) = rec.snapshot()
+        assert r["t0"] == pytest.approx(5000.0 - 0.25)
+        # window filtering rides the same injected clock
+        clock.advance(100.0)
+        assert rec.snapshot(seconds=10.0) == []
+        assert rec.snapshot(seconds=200.0) == [r]
+        # windows key on RETIRE time: a span longer than the window
+        # (the slow stage an operator hunts) must still show up
+        trace.rec("tick.barrier", 150.0)     # started long ago, just ended
+        assert [x["name"] for x in rec.snapshot(seconds=10.0)] \
+            == ["tick.barrier"]
+
+
+def test_wrap_links_commit_worker_job_to_wave_span():
+    from swarmkit_tpu.ops.commit import CommitWorker
+
+    with trace.armed() as rec:
+        sp = trace.start("tick.wave")
+        ran = {}
+
+        def job():
+            ran["thread"] = threading.current_thread().name
+            # spans the job opens must NEST under the wrap span (the
+            # heavy-commit sub-stages in Scheduler._commit_heavy do
+            # exactly this) — not become orphan roots
+            with trace.span("tick.commit.materialize"):
+                pass
+
+        w = CommitWorker(name="trace-test-worker")
+        try:
+            w.submit(trace.wrap("tick.commit_heavy", job, parent=sp))
+            w.barrier()
+        finally:
+            w.close()
+        sp.end()
+        recs = {r["name"]: r for r in rec.snapshot()}
+    heavy = recs["tick.commit_heavy"]
+    assert ran["thread"] == "trace-test-worker"
+    assert heavy["thread"] == "trace-test-worker"
+    assert heavy["parent"] == recs["tick.wave"]["span"]
+    assert heavy["trace"] == recs["tick.wave"]["trace"]
+    sub = recs["tick.commit.materialize"]
+    assert sub["parent"] == heavy["span"]
+    assert sub["trace"] == recs["tick.wave"]["trace"]
+
+
+def test_malformed_wire_ctx_never_raises():
+    """Entry.trace / the RPC _trace_ctx kwarg arrive off the wire: a
+    version-skewed peer's garbage ctx must degrade to 'no parent', not
+    raise inside the consumer's apply loop (which would wedge commit
+    application on that node while tracing is armed)."""
+    from swarmkit_tpu.raft.messages import Entry
+    from swarmkit_tpu.raft.testutils import RaftCluster
+
+    with trace.armed() as rec:
+        for bad in (5, "just-a-string", ["one"], ("a", "b", "c"),
+                    (1, 2), {"t": "x"}, (None, "y")):
+            trace.rec("raft.apply", 0.001, parent=bad)
+            trace.event("raft.commit", parent=bad)
+            with trace.span("rpc.server", parent=bad):
+                pass
+        assert rec.spans_started == 3 * 7   # all filed, none raised
+        # end-to-end: a committed entry carrying a garbage ctx still
+        # applies (the leader below echoes whatever rides the proposal)
+        cluster = RaftCluster(3, seed=31)
+        leader = cluster.elect(1)
+        res = {}
+        leader.propose({"k": 1}, "bad-ctx",
+                       lambda ok, err: res.update(ok=ok),
+                       trace_ctx=["not", "a", "valid", "ctx"])
+        cluster.settle()
+        assert res.get("ok") is True
+        assert all(n.last_applied == n.commit_index
+                   for n in cluster.nodes.values())
+
+
+def test_retired_tail_survives_disarm_for_report_hooks():
+    """The chaos harness disarms inside the test body; the conftest
+    report hook still needs the tail — disarm() retires it into
+    last_tail_text(), and clear_retired_tail() (run by the autouse
+    fixture before every test) prevents stale carry-over."""
+    with trace.armed():
+        trace.rec("tick.barrier", 0.25, wave=3)
+    assert trace.tail_text() == ""          # disarmed: the strict surface
+    assert "tick.barrier" in trace.last_tail_text()
+    assert "wave=3" in trace.last_tail_text()
+    trace.clear_retired_tail()
+    assert trace.last_tail_text() == ""
+
+
+def test_stage_histograms_derived_from_spans():
+    from swarmkit_tpu.utils.metrics import histogram_family
+
+    tick_fam = histogram_family("tick_stage_seconds")
+    raft_fam = histogram_family("raft_commit_path_seconds")
+    disp_fam = histogram_family("dispatcher_flush_seconds")
+    n_encode = tick_fam.child(("encode",))._n
+    n_fsync = raft_fam.child(("wal_fsync",))._n
+    n_wheel = disp_fam.child(("wheel.tick",))._n
+    n_commit = raft_fam.child(("commit",))._n
+    with trace.armed():
+        trace.rec("tick.encode", 0.002)
+        trace.rec("raft.wal_fsync", 0.001)
+        trace.rec("hb.wheel.tick", 0.0005)
+        # zero-duration point events are markers, never latency samples
+        trace.event("raft.commit", node=1)
+    assert tick_fam.child(("encode",))._n == n_encode + 1
+    assert raft_fam.child(("wal_fsync",))._n == n_fsync + 1
+    assert disp_fam.child(("wheel.tick",))._n == n_wheel + 1
+    assert raft_fam.child(("commit",))._n == n_commit
+
+
+# ------------------------------------------- disarmed-overhead acceptance
+class _SpanAllocGuard:
+    """Failpoints-style op-count guard: with tracing off, NO Span may be
+    constructed and NO record filed anywhere in the exercised paths —
+    the assertion fires at the allocation site, naming the culprit."""
+
+    def __enter__(self):
+        def _boom(*a, **k):
+            raise AssertionError(
+                "disarmed hot path allocated a trace span/record")
+
+        self._span_init = trace.Span.__init__
+        self._rec_record = trace.FlightRecorder.record
+        trace.Span.__init__ = _boom
+        trace.FlightRecorder.record = _boom
+        return self
+
+    def __exit__(self, *exc):
+        trace.Span.__init__ = self._span_init
+        trace.FlightRecorder.record = self._rec_record
+
+
+def test_disarmed_zero_allocation_on_raft_ready_loop():
+    """The raft worker's dispatch + flush + apply path (group-commit
+    plane) with tracing off: proposals, elections, replication — zero
+    span traffic."""
+    from swarmkit_tpu.raft.testutils import RaftCluster
+
+    assert not trace.active()
+    with _SpanAllocGuard():
+        cluster = RaftCluster(3, seed=11)
+        cluster.elect(1)
+        for i in range(5):
+            assert cluster.propose({"k": i})
+        cluster.tick_all(3)
+
+
+def test_disarmed_zero_allocation_on_dispatcher_flush(tmp_path):
+    """The fan-out flush + heartbeat-wheel path with tracing off."""
+    from test_dispatcher_fanout import driven_dispatcher, mk_node, pump
+
+    from swarmkit_tpu.api.objects import Task
+    from swarmkit_tpu.api.types import TaskState
+    from swarmkit_tpu.store.memory import MemoryStore
+
+    assert not trace.active()
+    try:
+        with _SpanAllocGuard():
+            store = MemoryStore()
+            d, ch = driven_dispatcher(store)
+            mk_node(store, "n1")
+            sid = d.register("n1")
+            d.assignments("n1", sid)
+            t = Task(id="t1", node_id="n1")
+            t.status.state = TaskState.ASSIGNED
+            store.update(lambda tx: tx.create(t))
+            pump(d, ch)
+            d._send_incrementals()
+            assert d.heartbeat("n1", sid) > 0
+            # drive the wheel ticker once too
+            d._hb_wheel._tick(d._hb_wheel._ticker_gen)
+    finally:
+        d._hb_wheel.stop()
+
+
+def test_disarmed_zero_allocation_on_pipelined_tick():
+    """The TickPipeline wave loop (encode/dispatch/pull/fold/commit,
+    async commit plane) with tracing off."""
+    from test_pipeline import run_pipelined_trace
+
+    assert not trace.active()
+    with _SpanAllocGuard():
+        run_pipelined_trace(3, steps=4, depth=1, async_commit=True)
+
+
+def test_failing_wave_span_reaches_recorder():
+    """A tick that dies (poisoned commit plane re-raising at the
+    barrier) must still file its tick.wave span WITH the error — the
+    failing wave is exactly the forensics payload the wedge/chaos tail
+    exists to show."""
+    import random as _random
+
+    from test_encoder_incremental import make_info
+    from test_pipeline import make_commit, make_waves
+    from test_placement_parity import random_group
+
+    from swarmkit_tpu.ops.pipeline import TickPipeline
+    from swarmkit_tpu.ops.resident import ResidentPlacement
+    from swarmkit_tpu.scheduler.encode import IncrementalEncoder
+
+    rng = _random.Random(0)
+    infos = [make_info(rng, i) for i in range(6)]
+    enc = IncrementalEncoder()
+    rp = ResidentPlacement(enc)
+    pipe = TickPipeline(enc, rp, make_commit(infos), depth=1,
+                        async_commit=True)
+
+    def boom():
+        raise RuntimeError("injected heavy-commit crash")
+
+    with trace.armed() as rec:
+        try:
+            pipe.tick(infos, make_waves(rng, 0, random_group))
+            pipe.worker.submit(boom)     # poison the plane
+            with pytest.raises(RuntimeError):
+                pipe.tick(infos, make_waves(rng, 1, random_group))
+            waves = [r for r in rec.snapshot()
+                     if r["name"] == "tick.wave"]
+            assert any("RuntimeError" in r["attrs"].get("error", "")
+                       for r in waves), waves
+        finally:
+            pipe.worker.reset()
+            pipe.close()
+
+
+# ------------------------------------------------- raft causal trace (3n)
+def test_raft_3node_causal_trace_propose_fsync_commit_apply(tmp_path):
+    """Acceptance: ONE causal trace covers propose → WAL-fsync → commit
+    → apply, across node boundaries — the context rides the replicated
+    Entry, so the followers' fsync/apply spans share the leader-side
+    proposal's trace id."""
+    from swarmkit_tpu.raft.storage import RaftStorage
+    from swarmkit_tpu.raft.testutils import RaftCluster
+
+    storages = {i: RaftStorage(str(tmp_path / f"n{i}")) for i in (1, 2, 3)}
+    cluster = RaftCluster(3, storages=storages, seed=23)
+    leader = cluster.elect(1)
+
+    with trace.armed() as rec:
+        sp = trace.start("raft.propose")
+        result = {}
+        leader.propose({"op": "traced"}, "req-traced",
+                       lambda ok, err: result.update(ok=ok, err=err),
+                       trace_ctx=sp.ctx())
+        cluster.settle()
+        assert result.get("ok"), result
+        sp.end(ok=True)
+        recs = rec.snapshot()
+
+    mine = [r for r in recs if r["trace"] == sp.trace_id]
+    by_name = {}
+    for r in mine:
+        by_name.setdefault(r["name"], []).append(r)
+    # the full causal chain, in one trace
+    for stage in ("raft.propose", "raft.stage", "raft.wal_fsync",
+                  "raft.commit", "raft.apply"):
+        assert stage in by_name, (stage, sorted(by_name))
+    # across node boundaries: the entry replicated with its ctx, so every
+    # member persisted and applied under THIS trace
+    fsync_nodes = {r["attrs"]["node"] for r in by_name["raft.wal_fsync"]}
+    apply_nodes = {r["attrs"]["node"] for r in by_name["raft.apply"]}
+    commit_nodes = {r["attrs"]["node"] for r in by_name["raft.commit"]}
+    assert fsync_nodes == {1, 2, 3}
+    assert apply_nodes == {1, 2, 3}
+    assert commit_nodes == {1, 2, 3}
+    # parent links: stage/fsync point at the proposal span
+    assert {r["parent"] for r in by_name["raft.stage"]} == {sp.span_id}
+    assert {r["parent"] for r in by_name["raft.wal_fsync"]} == {sp.span_id}
+
+
+def test_entry_trace_ctx_survives_wire_codec():
+    """The ctx crosses REAL node boundaries via codec (AppendEntries and
+    the WAL encode entries field-by-field); pre-trace payloads decode
+    with the default."""
+    from swarmkit_tpu.raft.messages import Entry
+    from swarmkit_tpu.rpc import codec
+
+    e = Entry(term=2, index=7, data={"x": 1}, request_id="r1",
+              trace=("aabbccdd00112233", "deadbeef44556677"))
+    back = codec.loads(codec.dumps(e))
+    assert back.trace == e.trace and isinstance(back.trace, tuple)
+    # an old-format entry (no trace field) still constructs
+    legacy = codec.loads(codec.dumps(Entry(term=1, index=1)))
+    assert legacy.trace is None
+
+
+def test_proposer_opens_propose_root_span(tmp_path):
+    """RaftProposer.propose_async: the store's write path gets its root
+    span for free; resolve closes it."""
+    from swarmkit_tpu.raft.proposer import RaftProposer
+    from swarmkit_tpu.raft.testutils import RaftCluster
+    from swarmkit_tpu.store.memory import StoreAction
+
+    cluster = RaftCluster(1, seed=5)
+    node = cluster.nodes[1]
+    proposer = RaftProposer(node)
+    cluster.elect(1)
+    with trace.armed() as rec:
+        fired = []
+        handle = proposer.propose_async([], lambda **kw: fired.append(kw))
+        cluster.settle()
+        assert handle.done and fired
+        names = [r["name"] for r in rec.snapshot()]
+    assert "raft.propose" in names
+
+
+# ----------------------------------------------------- rpc span propagation
+def _stub_security():
+    from swarmkit_tpu.api.types import NodeRole
+
+    return types.SimpleNamespace(identity=types.SimpleNamespace(
+        node_id="srv", role=NodeRole.MANAGER, org="test-org"))
+
+
+def test_rpc_client_server_spans_share_one_trace(tmp_path):
+    """The client span's ctx rides the reserved `_trace_ctx` kwarg; the
+    server opens its handler span under it — one trace per call. The
+    handler must never see the reserved key."""
+    from swarmkit_tpu.api.types import NodeRole
+    from swarmkit_tpu.rpc.client import RPCClient
+    from swarmkit_tpu.rpc.server import RPCServer, ServiceRegistry
+
+    seen = {}
+
+    def echo(caller, x, **kwargs):
+        seen["kwargs"] = dict(kwargs)
+        return x
+
+    reg = ServiceRegistry()
+    reg.add("t.echo", echo, roles=[NodeRole.MANAGER])
+    srv = RPCServer("", _stub_security(), reg,
+                    unix_path=str(tmp_path / "rpc.sock"))
+    srv.start()
+    client = RPCClient(srv.addr)
+    try:
+        with trace.armed() as rec:
+            assert client.call("t.echo", 42) == 42
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                recs = {r["name"]: r for r in rec.snapshot()}
+                if {"rpc.client", "rpc.server"} <= set(recs):
+                    break
+                time.sleep(0.01)
+        assert seen["kwargs"] == {}         # reserved key stripped
+        assert recs["rpc.server"]["trace"] == recs["rpc.client"]["trace"]
+        assert recs["rpc.server"]["parent"] == recs["rpc.client"]["span"]
+        assert recs["rpc.client"]["attrs"]["method"] == "t.echo"
+    finally:
+        client.close()
+        srv.stop()
+
+
+def test_rpc_traced_client_untraced_server_strips_key(tmp_path):
+    """Arm only around the SEND: the server end must still strip the
+    reserved kwarg even when its own tracer is disarmed (per-process
+    arming is independent)."""
+    from swarmkit_tpu.api.types import NodeRole
+    from swarmkit_tpu.rpc.client import RPCClient
+    from swarmkit_tpu.rpc.server import RPCServer, ServiceRegistry
+
+    seen = {}
+
+    def echo(caller, x, **kwargs):
+        seen["kwargs"] = dict(kwargs)
+        # the server-side handler runs with tracing disarmed in this
+        # process only if disarm raced the call; either way the key is
+        # never visible here
+        return x
+
+    reg = ServiceRegistry()
+    reg.add("t.echo", echo, roles=[NodeRole.MANAGER])
+    srv = RPCServer("", _stub_security(), reg,
+                    unix_path=str(tmp_path / "rpc2.sock"))
+    srv.start()
+    client = RPCClient(srv.addr)
+    try:
+        with trace.armed():
+            assert client.call("t.echo", 1) == 1
+        assert seen["kwargs"] == {}
+    finally:
+        client.close()
+        srv.stop()
+
+
+# ------------------------------------------------ dispatcher + wheel spans
+def test_dispatcher_flush_span_with_substages():
+    from test_dispatcher_fanout import driven_dispatcher, mk_node, pump
+
+    from swarmkit_tpu.api.objects import Task
+    from swarmkit_tpu.api.types import TaskState
+    from swarmkit_tpu.store.memory import MemoryStore
+
+    store = MemoryStore()
+    d, ch = driven_dispatcher(store)
+    mk_node(store, "n1")
+    sid = d.register("n1")
+    d.assignments("n1", sid)
+    t = Task(id="t1", node_id="n1")
+    t.status.state = TaskState.ASSIGNED
+    store.update(lambda tx: tx.create(t))
+    pump(d, ch)
+    try:
+        with trace.armed() as rec:
+            d._send_incrementals()
+            recs = {r["name"]: r for r in rec.snapshot()}
+    finally:
+        d._hb_wheel.stop()
+    flush = recs["dispatcher.flush"]
+    assert flush["attrs"]["sessions"] == 1
+    assert flush["attrs"]["served"] == 1
+    for sub in ("dispatcher.flush.snapshot", "dispatcher.flush.serve"):
+        assert recs[sub]["parent"] == flush["span"]
+        assert recs[sub]["trace"] == flush["trace"]
+
+
+def test_heartbeat_wheel_tick_span_under_fake_clock():
+    from swarmkit_tpu.dispatcher.heartbeat import HeartbeatWheel
+
+    clock = FakeClock()
+    wheel = HeartbeatWheel(granularity=0.5, clock=clock)
+    expired = []
+    wheel.add("k1", 1.0, lambda: expired.append("k1"))
+    with trace.armed() as rec:
+        clock.advance(2.0)
+        assert expired == ["k1"]
+        recs = [r for r in rec.snapshot() if r["name"] == "hb.wheel.tick"]
+    wheel.stop()
+    assert recs and recs[-1]["attrs"]["fired"] == 1
+
+
+# ------------------------------------------------------ wedge trace dump
+def _load_module(relpath, name):
+    """Load a module straight from its file under a dotted name (so its
+    relative imports resolve) WITHOUT importing its package __init__ —
+    the manager/node packages pull in the CA stack, which needs the
+    optional `cryptography` wheel (same trick as test_debug_profile)."""
+    import swarmkit_tpu
+
+    path = os.path.join(os.path.dirname(swarmkit_tpu.__file__), relpath)
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_wedge_monitor_dumps_recorder_tail():
+    WedgeMonitor = _load_module(os.path.join("manager", "wedge.py"),
+                                "swarmkit_tpu.manager.wedge").WedgeMonitor
+
+    store = types.SimpleNamespace(wedged=lambda: True, wedge_timeout=1.0)
+    mon = WedgeMonitor(store, raft_node=None, check_interval=0.01)
+    with trace.armed():
+        trace.rec("tick.barrier", 0.5, wave=7)
+        mon.start()
+        deadline = time.monotonic() + 5
+        while mon.fired == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        mon.stop()
+        assert mon.fired >= 1
+        assert "tick.barrier" in mon.last_trace_tail
+        assert "wave=7" in mon.last_trace_tail
+
+
+# ------------------------------------------------- /metrics satellites
+def test_counter_and_histogram_family_render_under_concurrent_writers():
+    """Satellite: scrape mid-increment must parse — the render takes a
+    consistent snapshot while writer threads hammer the families."""
+    from swarmkit_tpu.utils.metrics import CounterFamily, HistogramFamily
+
+    cf = CounterFamily("fuzz_counter_total", "fuzz", ("op", "code"))
+    hf = HistogramFamily("fuzz_seconds", "fuzz", ("op",))
+    stop = threading.Event()
+
+    def writer(seed):
+        rng = random.Random(seed)
+        while not stop.is_set():
+            cf.inc((f"op{rng.randrange(4)}", f"c{rng.randrange(3)}"))
+            hf.observe((f"op{rng.randrange(4)}",), rng.random() * 0.1)
+
+    threads = [threading.Thread(target=writer, args=(i,), daemon=True)
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(50):
+            for text in (cf.prometheus_text(), hf.prometheus_text()):
+                _assert_prometheus_parses(text)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+    # cumulative-bucket sanity on the final quiescent render
+    _assert_prometheus_parses(hf.prometheus_text(), strict_buckets=True)
+
+
+def _assert_prometheus_parses(text, strict_buckets=False):
+    last_bucket = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            continue
+        assert not line.startswith("#"), line
+        name_part, _, value = line.rpartition(" ")
+        assert name_part, line
+        float(value)                       # parses as a sample value
+        if strict_buckets and "_bucket{" in line:
+            series = name_part.split('le="')[0]
+            cur = float(value)
+            assert cur >= last_bucket.get(series, 0.0), line
+            last_bucket[series] = cur
+
+
+def test_label_value_escaping_is_pinned():
+    from swarmkit_tpu.utils.metrics import CounterFamily
+
+    cf = CounterFamily("esc_total", "escaping pin", ("v",))
+    cf.inc(('quo"te\\back\nline',))
+    text = cf.prometheus_text()
+    assert '# HELP esc_total escaping pin' in text
+    assert 'esc_total{v="quo\\"te\\\\back\\nline"} 1' in text
+
+
+def test_every_family_and_histogram_emits_help():
+    from swarmkit_tpu.utils.metrics import (
+        all_families,
+        all_histograms,
+        histogram,
+    )
+
+    histogram("help_probe_seconds", "probe help")
+    for h in all_histograms():
+        text = h.prometheus_text()
+        assert text.startswith(f"# HELP {h.name} "), h.name
+    for f in all_families():
+        text = f.prometheus_text()
+        assert text.startswith(f"# HELP {f.name} "), f.name
+
+
+# ------------------------------------------------------- debug server
+def _load_debugserver():
+    return _load_module(os.path.join("node", "debugserver.py"),
+                        "swarmkit_tpu.node.debugserver")
+
+
+def _stub_node():
+    from swarmkit_tpu.dispatcher.dispatcher import Dispatcher
+    from swarmkit_tpu.store.memory import MemoryStore
+
+    store = MemoryStore()
+    store.view(lambda tx: tx.find_tasks())     # op_counts non-empty
+    node = types.SimpleNamespace(
+        node_id="stub", addr="127.0.0.1:0", is_leader=False,
+        store=store, raft=None, manager=None,
+        dispatcher=Dispatcher(store, heartbeat_period=300.0),
+    )
+    return node
+
+
+def test_debugserver_binds_loopback_by_default():
+    DebugServer = _load_debugserver().DebugServer
+
+    srv = DebugServer(":0", _stub_node())
+    try:
+        host = srv._httpd.server_address[0]
+        assert host == "127.0.0.1"
+    finally:
+        srv.stop()
+
+
+def test_debugserver_metrics_content_type_help_and_components():
+    DebugServer = _load_debugserver().DebugServer
+
+    srv = DebugServer("127.0.0.1:0", _stub_node())
+    srv.start()
+    try:
+        resp = urllib.request.urlopen(f"http://{srv.addr}/metrics")
+        ctype = resp.headers.get("Content-Type")
+        assert ctype.startswith("text/plain; version=0.0.4")
+        text = resp.read().decode()
+        # exported-through-/metrics satellites
+        assert "swarm_store_ops_total{" in text
+        assert "swarm_heartbeat_wheel_entries" in text
+        # every family carries HELP
+        for line in text.splitlines():
+            if line.startswith("# TYPE "):
+                name = line.split()[2]
+                assert f"# HELP {name} " in text, name
+    finally:
+        srv.stop()
+
+
+def test_debugserver_vars_exposes_opcounts_and_arm_state():
+    DebugServer = _load_debugserver().DebugServer
+
+    srv = DebugServer("127.0.0.1:0", _stub_node())
+    srv.start()
+    try:
+        with failpoints.armed("probe.site"):
+            with trace.armed():
+                v = json.loads(urllib.request.urlopen(
+                    f"http://{srv.addr}/debug/vars").read())
+        assert v["failpoints_armed"] == ["probe.site"]
+        assert v["trace_armed"] is True
+        assert v["store_ops"].get("view_tx", 0) >= 1
+        v2 = json.loads(urllib.request.urlopen(
+            f"http://{srv.addr}/debug/vars").read())
+        assert v2["failpoints_armed"] == [] and v2["trace_armed"] is False
+    finally:
+        srv.stop()
+
+
+def test_debugserver_trace_endpoints():
+    DebugServer = _load_debugserver().DebugServer
+
+    srv = DebugServer("127.0.0.1:0", _stub_node())
+    srv.start()
+    try:
+        with trace.armed():
+            with trace.span("sched.tick", n=1):
+                with trace.span("tick.encode"):
+                    pass
+            recent = json.loads(urllib.request.urlopen(
+                f"http://{srv.addr}/debug/trace/recent").read())
+            assert recent["armed"] is True
+            names = {t["name"] for t in recent["traces"]}
+            assert "sched.tick" in names
+            (tick,) = [t for t in recent["traces"]
+                       if t["name"] == "sched.tick"]
+            assert [c["name"] for c in tick["children"]] == ["tick.encode"]
+        # disarmed: the windowed endpoint arms temporarily and disarms
+        win = json.loads(urllib.request.urlopen(
+            f"http://{srv.addr}/debug/trace?seconds=0.05").read())
+        assert win["armed"] is False and win["traces"] == []
+        assert not trace.active()
+        recent = json.loads(urllib.request.urlopen(
+            f"http://{srv.addr}/debug/trace/recent").read())
+        assert recent["armed"] is False and recent["traces"] == []
+    finally:
+        srv.stop()
