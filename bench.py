@@ -260,7 +260,13 @@ def bench_scheduler_config(np, placement_ops, batch, n_nodes, n_tasks,
                                          **kw), batch, np)
     compile_s = time.perf_counter() - t0
 
-    enc = IncrementalEncoder()
+    # tracked=True (round 6, matching the production Scheduler): steady
+    # waves take the encoder's ZERO-SCAN fast path (no marks pending —
+    # the pipeline's restamp keeps fingerprints reconciled without a
+    # feed) and the O(1) clean gate lets the async plane OVERLAP the
+    # heavy commit with the next wave's encode+dispatch. The cold tick
+    # still pays a full scan (the initial set-changed mark).
+    enc = IncrementalEncoder(tracked=True)
     rp = ResidentPlacement(enc)
     # Scheduler(backend="auto") cold-start policy: below COLD_CPU_NODES
     # the first wave runs on the CPU oracle (cheaper than a blocking
@@ -365,6 +371,7 @@ def bench_scheduler_config(np, placement_ops, batch, n_nodes, n_tasks,
             "tick": T[w]["encode_s"] + dev + mat_s,
             "encode": T[w]["encode_s"], "device": dev, "mat": mat_s,
             "add": add_s, "fold": T[w + depth]["fold_s"],
+            "dirty_scan": T[w].get("dirty_scan_s", 0.0),
         })
 
     # async plane observability: wave w's heavy commit is worker job w
@@ -408,6 +415,17 @@ def bench_scheduler_config(np, placement_ops, batch, n_nodes, n_tasks,
         "e2e_wave_s": round(e2e_wave_s, 4),
         "cpu_e2e_wave_s": round(cpu_e2e_wave_s, 4),
         "e2e_speedup": round(cpu_e2e_wave_s / e2e_wave_s, 2),
+        # per-stage HOST columns (ISSUE 6): where the steady wave's host
+        # tail went — the encoder's dirty scan (~0 on the tracked
+        # zero-scan path) and the write-back half of the commit (the
+        # add_task walk; the store tx in production rides the same walk)
+        "dirty_scan_s": round(best["dirty_scan"], 5),
+        "writeback_s": round(best["add"], 4),
+        # waves whose heavy commit overlapped the next encode+dispatch
+        # (the round-6 encode/commit overlap; 0 in sync mode)
+        "overlapped_waves": sum(
+            1 for t in T if t.get("commit_overlapped")),
+        "zero_scan_encodes": int(waves + 1 - enc.fp_scans),
         "commit_async": bool(async_commit),
         # commit seconds the async plane hid under the next wave's
         # dispatch/pull per steady wave (empty list in sync mode)
@@ -1516,7 +1534,12 @@ def main():
     ns = configs["grid_100k_x_10k"]   # the north star IS this grid config
 
     parity = all(c.get("parity", False) for c in configs.values())
-    failed_rows = sorted(n for n, c in configs.items() if "error" in c)
+    # a row that RAN but regressed parity is a failed row too (ISSUE 6):
+    # recording {"parity": false} deep in the JSON while exiting 0 let a
+    # steady-tick parity regression ride a green bench — failed_rows +
+    # the nonzero exit below make it loud
+    failed_rows = sorted(n for n, c in configs.items()
+                         if "error" in c or not c.get("parity", False))
     # headline: the largest reference-grid config (scheduler_test.go's grid
     # reaches 1M tasks) — end-to-end including encode + all transfers +
     # slot-order materialization, bit-identical placements required

@@ -65,6 +65,19 @@ what the invariants above require:
   * a worker exception re-raises out of the NEXT tick's barrier (never
     dies with the thread); the caller owns the heal.
 
+ENCODE/COMMIT OVERLAP (round 6, tracked encoders only): with
+`IncrementalEncoder(tracked=True)` a steady tick's nodes_clean check and
+its zero-scan encode read neither NodeInfo objects nor fingerprints —
+the only host state the riding heavy commit mutates — so when the O(1)
+tracked-clean gate holds, the top-of-tick barrier is SKIPPED and the
+completed wave's heavy half is submitted BEFORE encode: the encode and
+dispatch of wave k+1 run concurrently with commit(k)'s add_task walk +
+store write-back + restamp. The moment the gate breaks (pending marks,
+node churn, a failed worker, any drain trigger) the tick falls back to
+today's serial order — barrier first, heavy submitted after dispatch.
+drain_serial always barriers as its first step, so inline commits never
+run beside (or ahead of) a riding heavy, and FIFO wave order holds.
+
 Placements stay bit-identical to the CPU oracle at every depth and in
 both commit modes (tests/test_pipeline.py fuzzes depth ∈ {1, 2, 3} and
 async against the serial path; bench.py exercises both at scale).
@@ -94,10 +107,13 @@ from .resident import PendingCounts, ResidentPlacement
 
 # stage-timing keys -> span names filed into the trace plane per wave
 # (utils/trace.py; pull_s is the real value pull — the tunnel rule's one
-# device_sync span per burst, never one per kernel)
+# device_sync span per burst, never one per kernel). dirty_scan_s is the
+# host tail ISSUE 6 hunts: the encoder's sort + fingerprint scan (plus
+# the nodes_clean pre-check), ~0 on the tracked zero-scan path.
 _STAGE_SPANS = (("barrier_s", "tick.barrier"),
                 ("pull_s", "tick.device_sync"),
                 ("fold_s", "tick.fold"),
+                ("dirty_scan_s", "tick.dirty_scan"),
                 ("encode_s", "tick.encode"),
                 ("dispatch_s", "tick.dispatch"),
                 ("commit_s", "tick.commit"))
@@ -237,7 +253,8 @@ class TickPipeline:
         # forensics payload, and the mirrored Scheduler path records its
         # failed sched.tick the same way.
         _sp = trace.start("tick.wave", inflight=len(self._inflight))
-        timing = {"pull_s": 0.0, "fold_s": 0.0, "barrier_s": 0.0}
+        timing = {"pull_s": 0.0, "fold_s": 0.0, "barrier_s": 0.0,
+                  "dirty_scan_s": 0.0}
         try:
             return self._tick_traced(infos, groups, now, volume_set,
                                      timing, _sp)
@@ -260,16 +277,32 @@ class TickPipeline:
         # async mode: pulled-but-not-yet-folded oldest wave
         pulled: tuple | None = None
 
+        # encode/commit overlap gate (round 6): with a TRACKED encoder and
+        # no pending marks, this tick's nodes_clean and encode read NO
+        # NodeInfo and NO fingerprint — exactly the state the riding heavy
+        # commit mutates — so the top-of-tick barrier may be skipped and
+        # the zero-scan encode below runs CONCURRENTLY with the previous
+        # wave's heavy half. The gate is O(1) (mark flags + a length
+        # check) and never reads what the worker writes; a failed worker
+        # closes it so the pending exception re-raises at the barrier.
+        # Every drain trigger still barriers (drain_serial's first step).
+        overlap = False
         if self.worker is not None:
             if len(self._inflight) >= self.depth:
                 p0, c0, np0, pull_s = self._pull_oldest()
                 timing["pull_s"] += pull_s
                 pulled = (p0, c0, np0)
-            # barrier BEFORE any host-state read: the previous waves'
-            # add_task/restamp must be fully retired before the dirty
-            # scan below (and before every drain trigger). Worker
-            # exceptions propagate into this tick here.
-            self._barrier(timing)
+            t0 = time.perf_counter()
+            overlap = (self.encoder.tracked
+                       and self.encoder.nodes_clean(infos)
+                       and not self.worker.failed)
+            timing["dirty_scan_s"] = time.perf_counter() - t0
+            if not overlap:
+                # barrier BEFORE any host-state read: the previous waves'
+                # add_task/restamp must be fully retired before the dirty
+                # scan below (and before every drain trigger). Worker
+                # exceptions propagate into this tick here.
+                self._barrier(timing)
 
         def finish_pulled():
             nonlocal pulled
@@ -294,9 +327,20 @@ class TickPipeline:
             if self.worker is not None and not sync:
                 # the heavy half joins THIS wave's trace (the tick that
                 # pulled + folded it); trace.wrap is identity when disarmed
-                self.worker.submit(trace.wrap(
-                    "tick.commit_heavy",
-                    functools.partial(self._heavy, p, c), parent=_sp))
+                try:
+                    self.worker.submit(trace.wrap(
+                        "tick.commit_heavy",
+                        functools.partial(self._heavy, p, c), parent=_sp))
+                except BaseException:
+                    # overlap window: a riding heavy failed post-gate and
+                    # submit refused this wave, whose fold already ran —
+                    # poison its placed-on rows + the carry so the
+                    # caller's heal (poison_all_numeric / re-encode)
+                    # starts from honest state
+                    self.encoder.force_numeric_reencode(
+                        np.flatnonzero(c.sum(axis=0)))
+                    self.resident.invalidate()
+                    raise
             else:
                 timing["commit_s"] = (timing.get("commit_s", 0.0)
                                       + self._commit(p, c))
@@ -304,7 +348,12 @@ class TickPipeline:
         def drain_serial():
             # the ONE drain sequence every trigger uses, always post-
             # barrier: any deferred/pulled wave first (FIFO — it is the
-            # oldest), then complete+commit everything left, inline
+            # oldest), then complete+commit everything left, inline.
+            # The barrier here is a no-op on the ordinary async path
+            # (taken at tick top) but REQUIRED on the overlap path,
+            # where the top barrier was skipped — an inline commit must
+            # never run concurrently with (or ahead of) a riding heavy.
+            self._barrier(timing)
             commit_deferred(sync=True)
             done = finish_pulled()
             if done is not None:
@@ -320,8 +369,13 @@ class TickPipeline:
 
         # external node mutations: drain fully so dirty rows re-encode
         # from infos that already include every wave's tasks
-        serial = bool(self._inflight or pulled) \
-            and not self.encoder.nodes_clean(infos)
+        if overlap:
+            serial = False      # the gate already proved nodes_clean
+        else:
+            t0 = time.perf_counter()
+            serial = bool(self._inflight or pulled) \
+                and not self.encoder.nodes_clean(infos)
+            timing["dirty_scan_s"] += time.perf_counter() - t0
         if serial:
             drain_serial()
         else:
@@ -339,6 +393,12 @@ class TickPipeline:
             if self._inflight and self._hazards():
                 serial = True
                 drain_serial()
+            elif overlap and deferred is not None:
+                # overlap: the completed wave's heavy half goes to the
+                # worker NOW, so the zero-scan encode below runs under
+                # it — in the barriered order it waits until after
+                # encode+dispatch and only overlaps the NEXT tick's pull
+                commit_deferred()
 
         t0 = time.perf_counter()
         p = self.encoder.encode(infos, groups, now=now,
@@ -353,15 +413,24 @@ class TickPipeline:
             p = self.encoder.encode(infos, groups, now=now,
                                     volume_set=volume_set)
         timing["encode_s"] = time.perf_counter() - t0
+        # the scan component of encode() (sort + fingerprint compare; ~0
+        # on the tracked zero-scan path) files as its own stage so
+        # BENCH_r06 can see where the host tail went
+        timing["dirty_scan_s"] += self.encoder.last_scan_s
+        timing["encode_s"] = max(
+            0.0, timing["encode_s"] - self.encoder.last_scan_s)
         t0 = time.perf_counter()
         h = self.resident.schedule_async(p)
         timing["dispatch_s"] = time.perf_counter() - t0
         self._inflight.append((p, h, len(self._inflight)))
 
-        # steady async: the heavy half goes to the worker ONLY now, after
-        # encode+dispatch stopped reading host state for this tick
+        # steady async (barriered order): the heavy half goes to the
+        # worker only now, after encode+dispatch stopped reading host
+        # state for this tick. On the overlap path it was submitted
+        # before encode (deferred is None here) and this is a no-op.
         commit_deferred()
         timing["serial_fallback"] = serial
+        timing["commit_overlapped"] = overlap
         timing["wall_s"] = time.perf_counter() - t_wave
         self._record(timing)
         return completed

@@ -32,6 +32,7 @@ SURVEY.md §7's guidance on strings/IP math.
 """
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -102,6 +103,16 @@ class EncodedProblem:
     group_keys: list[tuple[str, int]]
     service_ids: list[str]
     groups: list[TaskGroup] = field(repr=False, default_factory=list)
+    # the NodeInfo objects in row order at encode time (a snapshot of the
+    # encoder's row list). Commit paths index it directly instead of
+    # rebuilding a node-id -> info map per wave — at 10k nodes that map
+    # rebuild was a measurable slice of every steady wave. `infos_seq`
+    # stamps the encoder's row-object generation: a commit may trust
+    # row_infos ONLY while it equals the encoder's current infos_seq
+    # (an O(1) check) — any node replacement/remap in between bumps it,
+    # and the commit falls back to resolving live objects by id.
+    row_infos: list = field(repr=False, default=None)
+    infos_seq: int = -1
 
     # node side
     ready: np.ndarray = None          # bool[N]
@@ -200,7 +211,8 @@ def pad_buckets(p: "EncodedProblem") -> "EncodedProblem":
         return out
 
     q = EncodedProblem(node_ids=p.node_ids, group_keys=p.group_keys,
-                       service_ids=p.service_ids, groups=p.groups)
+                       service_ids=p.service_ids, groups=p.groups,
+                       row_infos=p.row_infos, infos_seq=p.infos_seq)
     q.ready = pad(p.ready, (Np,), False)
     q.total0 = pad(p.total0, (Np,))
     q.avail_res = pad(p.avail_res, (Np, Rp))
@@ -290,11 +302,55 @@ class IncrementalEncoder:
     across ticks; `encode()` re-encodes only dirty nodes (fingerprint delta,
     adds, removes) and rebuilds the O(G) group tables. Steady-state host cost
     per tick is O(dirty nodes + groups + N numpy copies), not O(N × K Python).
+
+    ZERO-SCAN fast path (`tracked=True`, round 6): even with zero dirty
+    rows, the fingerprint scan itself — sort the infos by id, compare
+    the id list, read (created_seq, mutations) off every NodeInfo — is
+    an O(N) Python pass per encode() plus another per nodes_clean(),
+    and at 10k nodes it dominates the steady tick's host tail. In
+    tracked mode the caller FEEDS an explicit dirty set instead:
+
+      * `mark_replaced(info)` — the caller swapped in a new NodeInfo
+        object for an existing node id (full string re-encode);
+      * `mark_numeric(info)` — an in-place mutation (add/remove task,
+        failure) on the same object (numeric columns only);
+      * `mark_node_set_changed()` — a node was added or removed (next
+        encode falls back to the full sort + fingerprint scan, which
+        re-syncs rows and clears every mark);
+      * `force_numeric_reencode` / `poison_all_numeric` mark their rows
+        themselves, so the existing heal paths need no extra calls.
+
+    A steady encode with no marks then touches NO NodeInfo at all and
+    performs 0 fingerprint scans (`fp_scans` counts them — the op-count
+    guard's counter); nodes_clean() degrades to a flag check. The
+    contract cuts both ways: in tracked mode EVERY NodeInfo mutation
+    between encodes must arrive via a mark or via the wave-commit path
+    (whose restamp_counts keeps fingerprints reconciled) — an unmarked
+    mutation is invisible until the next full scan. The production
+    Scheduler routes all of its mutation sites through marks;
+    tests/test_steady_fastpath.py fuzzes tracked-vs-scan parity.
     """
 
-    def __init__(self, max_constraints: int = 8, max_platforms: int = 4):
+    def __init__(self, max_constraints: int = 8, max_platforms: int = 4,
+                 tracked: bool = False):
         self.max_constraints = max_constraints
         self.max_platforms = max_platforms
+        self.tracked = tracked
+        # tracked-mode dirty feed: node id -> NodeInfo (the CURRENT
+        # object — a replaced node's mark carries the replacement)
+        self._mark_full: dict[str, NodeInfo] = {}
+        self._mark_numeric: dict[str, NodeInfo] = {}
+        self._mark_set_changed = True       # ids unknown until first sync
+        self._mark_all_numeric = False
+        # observability / op-count guard: O(N) fingerprint scans taken
+        # (encode's sync and nodes_clean both count) and the seconds the
+        # last encode spent in sort + scan (the tick.dirty_scan stage)
+        self.fp_scans = 0
+        self.last_scan_s = 0.0
+        # row-object generation: bumped whenever any row's NodeInfo
+        # object may have been swapped (remap, replaced-object sync,
+        # mark_replaced) — the problem.row_infos currentness stamp
+        self.infos_seq = 0
 
         self.key_cols: dict[str, int] = {}   # canonical constraint key -> col
         self.val_vocab = Vocab()
@@ -405,6 +461,88 @@ class IncrementalEncoder:
                 dirty.add(i)         # replaced object: full re-encode
             elif fp_mut[i] != info.mutations:
                 numeric.add(i)       # same object, counters moved
+        if dirty or self.last_remap:
+            # some row's OBJECT changed (replacement, add/remove): any
+            # older problem's row_infos snapshot may now hold dead
+            # objects — invalidate the commit-side reuse stamp
+            self.infos_seq += 1
+        return dirty, numeric
+
+    # ------------------------------------------------- tracked dirty feed
+    def mark_replaced(self, info: NodeInfo) -> None:
+        """Tracked-mode feed: the caller replaced an EXISTING node's
+        NodeInfo object wholesale (spec/description churn). The next
+        encode re-runs the full string path for that row. No-op when
+        untracked (the fingerprint scan catches it anyway)."""
+        if self.tracked:
+            self._mark_full[info.node.id] = info
+            self.infos_seq += 1     # older row_infos now hold the dead
+            #                         object: commit-side reuse falls back
+
+    def mark_numeric(self, info: NodeInfo) -> None:
+        """Tracked-mode feed: an in-place mutation (add/remove task,
+        recorded failure) on the SAME NodeInfo object — only the numeric
+        columns re-derive. No-op when untracked."""
+        if self.tracked:
+            self._mark_numeric[info.node.id] = info
+
+    def mark_node_set_changed(self) -> None:
+        """Tracked-mode feed: a node was added or removed. The next
+        encode takes the full sort + fingerprint scan (which realigns
+        rows and supersedes every pending mark)."""
+        if self.tracked:
+            self._mark_set_changed = True
+            self.infos_seq += 1
+
+    def _tracked_clean(self) -> bool:
+        return not (self._mark_set_changed or self._mark_all_numeric
+                    or self._mark_full or self._mark_numeric)
+
+    def _clear_marks(self) -> None:
+        self._mark_set_changed = False
+        self._mark_all_numeric = False
+        self._mark_full.clear()
+        self._mark_numeric.clear()
+
+    def _tracked_dirty(self, node_infos) -> tuple[set, set] | None:
+        """Resolve the tracked marks to (full, numeric) row sets against
+        the cached rows — the zero-scan path. Returns None when the fast
+        path is not applicable (set changed, length drifted, or a marked
+        id is unknown) and the caller must fall back to the full scan."""
+        if self._mark_set_changed or len(node_infos) != len(self._ids):
+            return None
+        idx = self._idx
+        dirty: set[int] = set()
+        for nid, info in self._mark_full.items():
+            i = idx.get(nid)
+            if i is None:
+                return None          # marked node unknown: re-sync
+            self._infos[i] = info
+            dirty.add(i)
+        if self._mark_all_numeric:
+            numeric = set(range(len(self._ids))) - dirty
+        else:
+            numeric = set()
+            for nid, info in self._mark_numeric.items():
+                i = idx.get(nid)
+                if i is None:
+                    return None
+                if nid in self._mark_full:
+                    # the row was ALSO replaced this batch: the full mark
+                    # carries the latest object and its string re-encode
+                    # subsumes the numeric one — a numeric mark recorded
+                    # before the replacement holds the dead object, and
+                    # trusting it below would resurrect stale rows
+                    continue
+                if self._infos[i] is not info:
+                    # marked numeric but the object was swapped: treat as
+                    # a replacement (defensive — string columns may have
+                    # moved too)
+                    self._infos[i] = info
+                    self.infos_seq += 1
+                    dirty.add(i)
+                elif i not in dirty:
+                    numeric.add(i)
         return dirty, numeric
 
     # --------------------------------------------------------- column growth
@@ -512,6 +650,63 @@ class IncrementalEncoder:
         self._fp_seq[i] = info.created_seq
         self._fp_mut[i] = info.mutations
 
+    def _encode_rows_numeric_bulk(self, rows: list[int], infos_all) -> None:
+        """Vectorized `_encode_row_numeric` over many rows — the scalar
+        columns (totals, raw + quantized cpu/mem, fingerprints) gather
+        via np.fromiter and quantize in one vector pass; only the
+        irregular pieces (generic kinds, host ports, per-service counts,
+        the failure set) stay per-row Python. Bit-identical to the
+        scalar path (tests/test_steady_fastpath.py pins it); the win is
+        the crash-heal regime, where poison_all_numeric re-derives every
+        row at once."""
+        idx = np.asarray(rows, np.int64)
+        infos = [infos_all[i] for i in rows]
+        n = len(infos)
+        self.total0[idx] = np.fromiter(
+            (i.active_tasks_count for i in infos), np.int64, n
+        ).astype(np.int32)
+        cpus = np.fromiter(
+            (i.available_resources.nano_cpus for i in infos), np.int64, n)
+        mems = np.fromiter(
+            (i.available_resources.memory_bytes for i in infos), np.int64, n)
+        self._raw_avail[idx, 0] = cpus
+        self._raw_avail[idx, 1] = mems
+        self.avail_res[idx, 0] = np.clip(
+            cpus // CPU_QUANTUM, 0, _INT32_MAX).astype(np.int32)
+        self.avail_res[idx, 1] = np.clip(
+            mems // MEM_QUANTUM, 0, _INT32_MAX).astype(np.int32)
+        self.port_used[idx] = False
+        if self._svc_mat.shape[0]:
+            self._svc_mat[:, idx] = 0
+        kinds = self.kinds
+        failure_add = self._failure_ids.add
+        failure_discard = self._failure_ids.discard
+        for i, info in zip(rows, infos):
+            avail = info.available_resources
+            if kinds:
+                row = self.avail_res[i]
+                generic = avail.generic
+                named = avail.named_generic
+                for j, kind in enumerate(kinds):
+                    row[2 + j] = (generic.get(kind, 0)
+                                  + len(named.get(kind, ())))
+            if info.used_host_ports:
+                port_ids = self._port_ids(info.used_host_ports)
+                self._grow_bool_cols()
+                self.port_used[i, port_ids] = True
+            for s, cnt in info.active_tasks_count_by_service.items():
+                if cnt:
+                    row_s = self._svc_row_for(s)
+                    self._svc_mat[row_s, i] = cnt
+            if info.recent_failures:
+                failure_add(info.node.id)
+            else:
+                failure_discard(info.node.id)
+        self._fp_seq[idx] = np.fromiter(
+            (i.created_seq for i in infos), np.int64, n)
+        self._fp_mut[idx] = np.fromiter(
+            (i.mutations for i in infos), np.int64, n)
+
     def _encode_row(self, i: int, info: NodeInfo) -> None:
         node = info.node
         self.ready[i] = self._rf.check(info)
@@ -583,13 +778,25 @@ class IncrementalEncoder:
         return True
 
     def nodes_clean(self, infos) -> bool:
-        """Read-only fingerprint scan: True iff `encode(infos, …)` would
-        find zero dirty rows and no remap. The pipelined tick driver uses
+        """Read-only dirty check: True iff `encode(infos, …)` would find
+        zero dirty rows and no remap. The pipelined tick driver uses
         this to decide whether encode() may run before the deferred
-        add_task/restamp of the previous wave."""
+        add_task/restamp of the previous wave.
+
+        Tracked mode answers from the mark flags alone — O(1), no
+        NodeInfo reads, and therefore legal while a background heavy
+        commit is still bumping mutation counters (the encode/commit
+        overlap's gate). Untracked mode pays the full fingerprint scan.
+        """
+        if self.tracked:
+            if not self._tracked_clean():
+                return False
+            infos = infos if hasattr(infos, "__len__") else list(infos)
+            return len(infos) == len(self._ids)
         infos = sorted(infos, key=lambda i: i.node.id)
         if [i.node.id for i in infos] != self._ids:
             return False
+        self.fp_scans += 1
         n = len(infos)
         seq = np.fromiter((i.created_seq for i in infos), np.int64, n)
         mut = np.fromiter((i.mutations for i in infos), np.int64, n)
@@ -608,6 +815,11 @@ class IncrementalEncoder:
         rows = np.asarray(rows, np.int64)
         if rows.size:
             self._fp_mut[rows] -= 1
+            if self.tracked:
+                # the zero-scan path never reads fingerprints: the heal
+                # must also land in the mark feed
+                for r in rows.tolist():
+                    self._mark_numeric[self._ids[r]] = self._infos[r]
 
     def poison_all_numeric(self) -> None:
         """Crash-path heal: poison EVERY row's numeric fingerprint. The
@@ -615,6 +827,8 @@ class IncrementalEncoder:
         wave was recorded for the targeted heal) — any row may then
         carry an optimistic fold no add_task ever backed."""
         self._fp_mut -= 1
+        if self.tracked:
+            self._mark_all_numeric = True
 
     def restamp_counts(self, p: EncodedProblem, counts: np.ndarray) -> bool:
         """Fingerprint half of apply_counts: stamp the add_task mutation
@@ -678,9 +892,27 @@ class IncrementalEncoder:
         now: float | None = None,
         volume_set=None,
     ) -> EncodedProblem:
-        node_infos = sorted(node_infos, key=lambda i: i.node.id)
         groups = sorted(groups, key=lambda g: g.key)
-        dirty, numeric_dirty = self._sync_nodes(node_infos)
+        t_scan = time.perf_counter()
+        resolved = None
+        if self.tracked:
+            if not hasattr(node_infos, "__len__"):
+                node_infos = list(node_infos)
+            resolved = self._tracked_dirty(node_infos)
+        if resolved is not None:
+            # zero-scan fast path: dirty rows come from the mark feed;
+            # the caller's list is only length-checked (same node set by
+            # the tracked contract) — no sort, no id compare, no
+            # per-node fingerprint reads
+            dirty, numeric_dirty = resolved
+            node_infos = self._infos
+            self.last_remap = False
+        else:
+            node_infos = sorted(node_infos, key=lambda i: i.node.id)
+            dirty, numeric_dirty = self._sync_nodes(node_infos)
+            self.fp_scans += 1
+        self._clear_marks()     # scan or mark resolution consumed them
+        self.last_scan_s = time.perf_counter() - t_scan
         N, G = len(node_infos), len(groups)
 
         # ------------------------------------------------ parse constraints
@@ -743,8 +975,15 @@ class IncrementalEncoder:
             count=len(dirty | numeric_dirty))
         for i in sorted(dirty):
             self._encode_row(i, node_infos[i])
-        for i in sorted(numeric_dirty):
-            self._encode_row_numeric(i, node_infos[i])
+        if len(numeric_dirty) >= 64:
+            # crash heals (poison_all_numeric) and mass churn re-derive
+            # thousands of rows at once: the scalar per-row path pays
+            # ~20 Python ops per row where a fromiter gather pays ~3
+            self._encode_rows_numeric_bulk(sorted(numeric_dirty),
+                                           node_infos)
+        else:
+            for i in sorted(numeric_dirty):
+                self._encode_row_numeric(i, node_infos[i])
 
         # ------------------------------------------------------------ emit
         p = EncodedProblem(
@@ -752,6 +991,8 @@ class IncrementalEncoder:
             group_keys=[g.key for g in groups],
             service_ids=sorted({g.service_id for g in groups}),
             groups=groups,
+            row_infos=list(self._infos),
+            infos_seq=self.infos_seq,
         )
         svc_row = {s: i for i, s in enumerate(p.service_ids)}
         S = max(len(p.service_ids), 1)

@@ -20,11 +20,10 @@ to task status on failure (:923-968).
 """
 from __future__ import annotations
 
-import functools
 import logging
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 
 import numpy as np
 
@@ -38,7 +37,7 @@ from ..api.objects import (
 )
 from ..api.types import NodeStatusState, TaskState
 from ..store import by
-from ..store.memory import MemoryStore
+from ..store.memory import MAX_CHANGES_PER_TRANSACTION, MemoryStore
 from ..store.watch import ChannelClosed
 from ..utils import failpoints, trace
 from .batch import apply_placements, cpu_schedule_encoded, materialize_orders
@@ -59,6 +58,10 @@ MAX_LATENCY = 1.0        # reference: 1s
 # the dev link and far lower on PCIe (BASELINE.md operator guidance).
 JAX_THRESHOLD = 200_000
 PIPELINED_JAX_THRESHOLD = 100_000
+# raft-backed batched write-back: sub-transactions (≤ MAX_CHANGES each)
+# pipelined through propose_async share the group-commit plane's WAL
+# fsync + replication flush (store.batch pipeline_depth semantics)
+WRITEBACK_PIPELINE_DEPTH = 16
 # cold-start policy (backend="auto"): with NO device-resident state yet,
 # a jax tick pays a full upload plus a BLOCKING counts round trip
 # (~0.1 s fixed through a tunneled link) while the CPU fill at small
@@ -135,6 +138,16 @@ class Scheduler:
         # in flight, so the completing tick must retry the pool itself
         # (see _tick_pipelined's gate bypass)
         self._last_commit_conflicts = 0
+        # task-id sets of waves whose heavy commit may still ride the
+        # plane (appended at submit, removed by the job's tail). On the
+        # overlap path the next wave's prime excludes them: their
+        # unassigned-pool pops happen on the worker thread, so without
+        # the exclusion a still-uncommitted task could be re-grouped
+        # into a new wave (double placement). Cleared at every barrier.
+        self._pending_commit_ids: deque = deque()
+        # observability: completed waves whose heavy commit was submitted
+        # BEFORE the next prime (the encode/commit overlap path)
+        self.overlapped_commits = 0
         # (problem, PendingCounts, frozenset of in-flight task ids)
         self._inflight = None
         self.node_infos: dict[str, NodeInfo] = {}
@@ -144,8 +157,13 @@ class Scheduler:
         from ..csi.volumes import VolumeSet
         self.volume_set = VolumeSet()
         # persistent dictionary encoder: node rows and vocabs survive across
-        # ticks; only fingerprint-dirty nodes re-encode (verdict #6)
-        self.encoder = IncrementalEncoder()
+        # ticks; only fingerprint-dirty nodes re-encode (verdict #6).
+        # tracked=True (round 6): the scheduler feeds the dirty set
+        # explicitly (every NodeInfo mutation site below marks), so a
+        # steady tick's encode skips the O(N) fingerprint scan entirely
+        # and nodes_clean degrades to a flag check — the zero-scan fast
+        # path AND the encode/commit overlap's gate.
+        self.encoder = IncrementalEncoder(tracked=True)
         # device-resident node tables (ops.resident): created on first jax
         # tick; deltas ride the encoder's dirty-row bookkeeping
         self._resident = None
@@ -185,6 +203,13 @@ class Scheduler:
         except Exception:
             if not swallow:
                 raise
+        else:
+            # every submitted heavy retired cleanly: nothing can still
+            # pop the unassigned pool, so the prime-time exclusion sets
+            # are stale (poisoned-and-dropped jobs never ran their
+            # removal tail — without this clear their tasks would stay
+            # excluded forever)
+            self._pending_commit_ids.clear()
         if self._worker_unclean is not None:
             self._heal_unclean()
 
@@ -212,6 +237,28 @@ class Scheduler:
                 # on the event-drain path, which has no retry handler)
                 log.warning("discarding in-flight wave: counts pull "
                             "failed", exc_info=True)
+
+    def _submit_heavy(self, problem, counts, ids: frozenset):
+        """Enqueue one wave's heavy commit on the plane, bracketed by the
+        prime-time exclusion bookkeeping: `ids` stays in
+        `_pending_commit_ids` until the job's tail runs (worker thread;
+        deque append/remove are GIL-atomic), so an overlapped prime can
+        never re-group a task whose pool pop is still in flight. Dropped
+        (poisoned) jobs skip the tail; the barrier paths clear the
+        leftovers. The job joins the submitting tick's trace
+        (trace.wrap: identity when disarmed)."""
+        self._pending_commit_ids.append(ids)
+
+        def job():
+            try:
+                self._commit_heavy(problem, counts)
+            finally:
+                try:
+                    self._pending_commit_ids.remove(ids)
+                except ValueError:
+                    pass    # a barrier path already cleared it
+
+        self._commit_worker.submit(trace.wrap("tick.commit_heavy", job))
 
     def _commit_heavy(self, problem, counts):
         """The commit's heavy half, run on the CommitWorker: slot
@@ -296,9 +343,16 @@ class Scheduler:
         if existing:
             info.recent_failures = existing.recent_failures
         self.node_infos[node.id] = info
+        # tracked-encoder dirty feed: a replaced object re-encodes its
+        # row's string columns; a NEW node changes the row set
+        if existing is not None:
+            self.encoder.mark_replaced(info)
+        else:
+            self.encoder.mark_node_set_changed()
 
     def _remove_node(self, node_id: str):
-        self.node_infos.pop(node_id, None)
+        if self.node_infos.pop(node_id, None) is not None:
+            self.encoder.mark_node_set_changed()
 
     # ---------------------------------------------------------------- events
     def _handle(self, ev) -> bool:
@@ -325,6 +379,7 @@ class Scheduler:
                     # state, desired crossings only flip active counts via
                     # add_task, nodeinfo.go:111-119)
                     if info.remove_task(t):
+                        self.encoder.mark_numeric(info)
                         if t.volumes:
                             self.volume_set.release_task(t)
                         if t.status.state == TaskState.FAILED:
@@ -333,7 +388,8 @@ class Scheduler:
                             info.task_failed(key)
                         return True
                 else:
-                    info.add_task(t)
+                    if info.add_task(t):
+                        self.encoder.mark_numeric(info)
             if (t.status.state > TaskState.PENDING
                     or t.desired_state > TaskState.COMPLETE):
                 self.unassigned.pop(t.id, None)
@@ -346,7 +402,9 @@ class Scheduler:
             if t.volumes:
                 self.volume_set.release_task(t)
             if t.node_id and t.node_id in self.node_infos:
-                self.node_infos[t.node_id].remove_task(t)
+                info = self.node_infos[t.node_id]
+                if info.remove_task(t):
+                    self.encoder.mark_numeric(info)
             return True
         if isinstance(ev, (EventCreate, EventUpdate)) and isinstance(ev.obj, Node):
             self._add_or_update_node(ev.obj)
@@ -426,9 +484,29 @@ class Scheduler:
                             # plane's ASSIGNED echoes heal the partial
                             # commit — un-poison the plane for the retry
                             worker_died = self._commit_worker.failed
+                            # overlap can put TWO heavies on the plane: a
+                            # crash in the older one makes the worker
+                            # DROP the queued younger one un-run (its
+                            # fold is then backed by nothing, and its
+                            # finally tail never removed its ids). The
+                            # recorded-unclean heal only covers the wave
+                            # the worker crashed ON — any leftover
+                            # exclusion entry at a died-worker heal means
+                            # a dropped heavy, which needs the blanket
+                            # poison (a crashed job removes its own ids
+                            # in its finally).
+                            dropped_heavy = (worker_died
+                                             and bool(
+                                                 self._pending_commit_ids))
                             self._commit_worker.reset()
+                            # poisoned-and-dropped jobs never ran their
+                            # exclusion-removal tail; the pool they
+                            # guarded is being re-attempted wholesale
+                            self._pending_commit_ids.clear()
                             if self._worker_unclean is not None:
                                 self._heal_unclean()
+                                if dropped_heavy:
+                                    self.encoder.poison_all_numeric()
                             elif worker_died:
                                 # the worker died before recording which
                                 # wave it carried (crash pre-job): any
@@ -491,6 +569,11 @@ class Scheduler:
             problem = self.encoder.encode(list(self.node_infos.values()),
                                           groups,
                                           volume_set=self.volume_set)
+        # the scan component (sort + fingerprint compare; ~0 on the
+        # tracked zero-scan path) files as its own stage for the
+        # tick_stage_seconds histogram (armed only; one truthiness test
+        # disarmed)
+        trace.rec("tick.dirty_scan", self.encoder.last_scan_s)
         use_jax = self._use_jax(problem)
         if use_jax and self.backend == "auto" \
                 and len(problem.node_ids) <= COLD_CPU_NODES \
@@ -573,30 +656,58 @@ class Scheduler:
         problem, h, prev_ids = self._inflight
         self._inflight = None
         worker = self._commit_worker
+        overlap = False
         if worker is not None:
+            # encode/commit overlap gate (round 6) — O(1): with a
+            # TRACKED-clean encoder, no preassigned work, no volumes (the
+            # in-tx volume choose mutates the VolumeSet this tick's
+            # encode would read) and a healthy plane, nothing below reads
+            # state the riding heavy commit writes — the barrier is
+            # skipped and the previous wave's walk/write-back overlaps
+            # this tick's fold + zero-scan encode + dispatch. An unclean
+            # outcome recorded mid-overlap is caught at the NEXT
+            # non-overlap barrier, which discards the wave primed on the
+            # lying fold — the pre-existing one-wave-late heal semantics.
+            overlap = (self.encoder.tracked and not self.preassigned
+                       and self._worker_unclean is None
+                       and not worker.failed
+                       and not self.volume_set.volumes
+                       and self.encoder.nodes_clean(
+                           self.node_infos.values()))
             # async plane: pull FIRST — the blocking transfer wait
             # releases the GIL, which is when the previous wave's heavy
-            # commit runs — then barrier before any host-state read.
+            # commit runs — then (overlap off) barrier before any
+            # host-state read.
             with trace.span("tick.device_sync"):
                 counts = h.get()
-            with trace.span("tick.barrier"):
-                worker.barrier()    # worker exceptions re-raise here
-            if self._worker_unclean is not None:
-                # the PREVIOUS wave's commit was unclean, and THIS wave
-                # was primed on its lying fold: heal (poison + resident
-                # resync) and discard this wave un-folded — its tasks
-                # are still in the unassigned pool, so attempt them
-                # fresh against the healed state (no pool-changed gate:
-                # a discarded wave was never attempted, so going idle
-                # here would wedge it)
-                self._heal_unclean()
+            if overlap and (worker.failed
+                            or self._worker_unclean is not None):
+                overlap = False     # plane turned unhealthy mid-pull
+            if not overlap:
+                with trace.span("tick.barrier"):
+                    worker.barrier()    # worker exceptions re-raise here
+                self._pending_commit_ids.clear()
+                if self._worker_unclean is not None:
+                    # the PREVIOUS wave's commit was unclean, and THIS
+                    # wave was primed on its lying fold: heal (poison +
+                    # resident resync) and discard this wave un-folded —
+                    # its tasks are still in the unassigned pool, so
+                    # attempt them fresh against the healed state (no
+                    # pool-changed gate: a discarded wave was never
+                    # attempted, so going idle here would wedge it)
+                    self._heal_unclean()
+                    if self.preassigned:
+                        self._process_preassigned()
+                    if allow_retry and self.unassigned:
+                        self._schedule_backlog()
+                    return
                 if self.preassigned:
                     self._process_preassigned()
-                if allow_retry and self.unassigned:
-                    self._schedule_backlog()
-                return
-            if self.preassigned:
-                self._process_preassigned()
+            # overlap path: the gate proved no preassigned work and no
+            # recorded unclean wave; a record landing in the remaining
+            # window is healed at the next non-overlap barrier (which
+            # discards the wave primed below) — never concurrently with
+            # a still-riding heavy.
         else:
             if self.preassigned:
                 # preassigned (global-service) tasks never touch the
@@ -615,11 +726,35 @@ class Scheduler:
             else:
                 self._resident.invalidate()
 
+        if worker is not None and folded and overlap:
+            # overlap: the heavy half is submitted BEFORE the prime, so
+            # the zero-scan encode below runs concurrently with the
+            # walk/write-back (the pool race is closed by the exclusion
+            # set _submit_heavy maintains)
+            self.overlapped_commits += 1
+            try:
+                self._submit_heavy(problem, counts, prev_ids)
+            except BaseException:
+                # the riding heavy failed inside the overlap window
+                # (post-gate): submit refused THIS wave, whose fold
+                # already ran and whose add_task walk will never run —
+                # poison its placed-on rows so the run-loop heal
+                # re-derives them (the recorded-unclean heal only
+                # covers the wave the worker crashed on)
+                self.encoder.force_numeric_reencode(
+                    np.flatnonzero(counts.sum(axis=0)))
+                raise
+
         # next wave: everything unassigned that is NOT still uncommitted
-        # in the wave being completed (no double placement)
+        # in the wave being completed (no double placement) NOR in a wave
+        # whose heavy commit may still be riding the plane
         if (folded and self.pipeline
                 and self.encoder.nodes_clean(self.node_infos.values())):
-            next_groups = self._group_unassigned(exclude=prev_ids)
+            exclude = prev_ids
+            pending = tuple(self._pending_commit_ids)
+            if pending:
+                exclude = frozenset().union(prev_ids, *pending)
+            next_groups = self._group_unassigned(exclude=exclude)
             # CPU-shaped waves skip the prime entirely (the encode would
             # be discarded and redone by the fallthrough below)
             total_next = sum(len(g.tasks) for g in next_groups)
@@ -631,6 +766,7 @@ class Scheduler:
                     p_next = self.encoder.encode(
                         list(self.node_infos.values()), next_groups,
                         volume_set=self.volume_set)
+                trace.rec("tick.dirty_scan", self.encoder.last_scan_s)
                 if self._use_jax(p_next):
                     with trace.span("tick.dispatch"):
                         h_next = self._resident.schedule_async(p_next)
@@ -642,12 +778,11 @@ class Scheduler:
             # heavy half rides the commit plane: materialization, store
             # write-back, the add_task walk, the restamp — retired by
             # the next barrier; an unclean outcome heals there too.
-            # Enqueued only now, after this tick's encode/dispatch
-            # stopped reading host state. The job joins this tick's
-            # trace (trace.wrap: identity when disarmed).
-            worker.submit(trace.wrap(
-                "tick.commit_heavy",
-                functools.partial(self._commit_heavy, problem, counts)))
+            # Barriered order: enqueued only now, after this tick's
+            # encode/dispatch stopped reading host state (the overlap
+            # path submitted before the prime instead).
+            if not overlap:
+                self._submit_heavy(problem, counts, prev_ids)
             if self._inflight is None and self.unassigned:
                 # nothing primed: the backlog must be attempted NOW
                 # (wedge avoidance, same as the sync path below) — and
@@ -669,6 +804,12 @@ class Scheduler:
                     # still queued to wake the loop.
                     self._schedule_backlog()
             return
+        if worker is not None and overlap:
+            # the overlap path skipped the top barrier and the fold
+            # failed (node set moved under us — unreachable while the
+            # tracked gate pins it, but defensive): an inline commit
+            # below must never run beside a riding heavy
+            self._drain_commit_plane()
         with trace.span("tick.commit"):
             orders = materialize_orders(problem, counts)
             clean = self._apply_decisions(problem, orders, counts,
@@ -714,7 +855,12 @@ class Scheduler:
     def _group_unassigned(self, exclude: frozenset | None = None,
                           ) -> list[TaskGroup]:
         grouped: dict[tuple[str, int], list[Task]] = defaultdict(list)
-        for t in self.unassigned.values():
+        # list() is one C-level op (GIL-atomic): on the overlap path a
+        # riding heavy commit pops committed tasks from this dict
+        # concurrently — a plain .values() iteration would raise
+        # "dict changed size". A popped task still in the snapshot is in
+        # the exclusion set by construction (_pending_commit_ids).
+        for t in list(self.unassigned.values()):
             if exclude is not None and t.id in exclude:
                 continue
             sv = t.spec_version.index if t.spec_version else 0
@@ -729,6 +875,35 @@ class Scheduler:
         return out
 
     # -------------------------------------------------------------- commits
+    def _batched_writes(self, items: list, write_one) -> None:
+        """ONE grouped store update for `items` (round 6): `write_one(tx,
+        item)` runs for every item inside a single update transaction —
+        one lock hold, one table swap, one event batch — instead of one
+        Batch closure + one sub-transaction per 200 items. Raft-backed
+        stores keep the reference's per-entry bound: items chunk at
+        MAX_CHANGES_PER_TRANSACTION and the sub-transactions pipeline
+        through the group-commit plane (disjoint by construction — a
+        task id appears at most once per wave write-back)."""
+        if not items:
+            return
+        if self.store.proposer is not None:
+            step = MAX_CHANGES_PER_TRANSACTION
+            depth = WRITEBACK_PIPELINE_DEPTH
+        else:
+            step = len(items)
+            depth = None
+        chunks = [items[i:i + step] for i in range(0, len(items), step)]
+
+        def batch_cb(batch):
+            for chunk in chunks:
+                def run_chunk(tx, chunk=chunk):
+                    for item in chunk:
+                        write_one(tx, item)
+
+                batch.update_many(run_chunk, len(chunk))
+
+        self.store.batch(batch_cb, pipeline_depth=depth)
+
     def _apply_decisions(self, problem, orders, counts=None,
                          deferred_fold=False) -> bool:
         """store.Batch with in-tx re-validation (scheduler.go:490-643).
@@ -753,56 +928,59 @@ class Scheduler:
         conflicts = [0]
 
         node_ids = problem.node_ids
+        from ..csi.volumes import task_csi_mounts
 
-        def batch_cb(batch):
-            for gi, group in enumerate(groups):
-                order = orders[gi]
-                n_placed = len(order)
-                for ti, task in enumerate(group.tasks):
-                    ni = int(order[ti]) if ti < n_placed else -1
-                    node_id = node_ids[ni] if ni >= 0 else None
+        # flat decision list in (group, slot) order — the store write-back
+        # runs it as ONE grouped transaction (round 6; _batched_writes)
+        # instead of one closure + one 200-change sub-transaction slice
+        # per task, keeping the exact per-task in-tx re-validation
+        decisions: list[tuple] = []
+        for gi, group in enumerate(groups):
+            order = orders[gi]
+            n_placed = len(order)
+            for ti, task in enumerate(group.tasks):
+                ni = int(order[ti]) if ti < n_placed else -1
+                decisions.append(
+                    (task, node_ids[ni] if ni >= 0 else None, ni, group, gi))
 
-                    def update_one(tx, task=task, node_id=node_id, ni=ni,
-                                   group=group, gi=gi):
-                        cur = tx.get_task(task.id)
-                        if cur is None or cur.desired_state > TaskState.COMPLETE:
-                            drop.append(task.id)
-                            return
-                        if cur.status.state != TaskState.PENDING or cur.node_id:
-                            drop.append(task.id)
-                            return
-                        if node_id is None:
-                            # explanation is written in a second pass, after
-                            # node bookkeeping reflects this tick's sibling
-                            # placements — else 'insufficient resources'
-                            # reads as 'all filters passed'
-                            unplaced.append((cur, group))
-                            return
-                        node = tx.get_node(node_id)
-                        if node is None or node.status.state != NodeStatusState.READY:
-                            conflicts[0] += 1
-                            return  # conflicted: retried (see below)
-                        cur = cur.copy()
-                        # CSI volumes chosen at commit time, with the
-                        # reservation re-check the reference does in-tx
-                        # (scheduler.go:533-604 volume availability)
-                        from ..csi.volumes import task_csi_mounts
-                        if task_csi_mounts(cur):
-                            chosen = self.volume_set.choose_task_volumes(cur, node)
-                            if chosen is None:
-                                conflicts[0] += 1
-                                return  # conflicted: retried (see below)
-                            cur.volumes = chosen
-                        cur.node_id = node_id
-                        cur.status.state = TaskState.ASSIGNED
-                        cur.status.message = "scheduler assigned task to node"
-                        cur.status.timestamp = time.time()
-                        tx.update(cur)
-                        applied_by_group.setdefault(gi, []).append((cur, ni))
+        def write_decision(tx, item):
+            task, node_id, ni, group, gi = item
+            cur = tx.get_task(task.id)
+            if cur is None or cur.desired_state > TaskState.COMPLETE:
+                drop.append(task.id)
+                return
+            if cur.status.state != TaskState.PENDING or cur.node_id:
+                drop.append(task.id)
+                return
+            if node_id is None:
+                # explanation is written in a second pass, after node
+                # bookkeeping reflects this tick's sibling placements —
+                # else 'insufficient resources' reads as 'all filters
+                # passed'
+                unplaced.append((cur, group))
+                return
+            node = tx.get_node(node_id)
+            if node is None or node.status.state != NodeStatusState.READY:
+                conflicts[0] += 1
+                return  # conflicted: retried (see below)
+            cur = cur.copy()
+            # CSI volumes chosen at commit time, with the reservation
+            # re-check the reference does in-tx (scheduler.go:533-604
+            # volume availability)
+            if task_csi_mounts(cur):
+                chosen = self.volume_set.choose_task_volumes(cur, node)
+                if chosen is None:
+                    conflicts[0] += 1
+                    return  # conflicted: retried (see below)
+                cur.volumes = chosen
+            cur.node_id = node_id
+            cur.status.state = TaskState.ASSIGNED
+            cur.status.message = "scheduler assigned task to node"
+            cur.status.timestamp = time.time()
+            tx.update(cur)
+            applied_by_group.setdefault(gi, []).append((cur, ni))
 
-                    batch.update(update_one)
-
-        self.store.batch(batch_cb)
+        self._batched_writes(decisions, write_decision)
         # conflicted decisions stay in the pool; the serial path relies
         # on the causing store write's still-queued event to retrigger,
         # but a pipelined wave may conflict on an event consumed while
@@ -833,9 +1011,22 @@ class Scheduler:
                  # ids built here while the committed copies are hot from
                  # the store transaction (TaskGroup.ids contract)
                  [t.id for t in committed]))
-        n_added = apply_placements(
-            [self.node_infos.get(nid) for nid in node_ids],
-            placed_groups) if placed_groups else 0
+        if placed_groups:
+            # row-order NodeInfo list for the walk: reuse the problem's
+            # encode-time snapshot when it is still current (tracked
+            # encoders bump infos_seq on any row-object swap — replaced
+            # node, set change — so the O(1) stamp check is sound; the
+            # barrier discipline keeps marks out of the commit window).
+            # Stale or untracked: rebuild from the live map, where a
+            # removed node correctly yields None (skipped, uncounted —
+            # the unclean heal covers it).
+            infos = problem.row_infos
+            if (infos is None or not self.encoder.tracked
+                    or problem.infos_seq != self.encoder.infos_seq):
+                infos = [self.node_infos.get(nid) for nid in node_ids]
+            n_added = apply_placements(infos, placed_groups)
+        else:
+            n_added = 0
         # fold our own placements back into the encoder's cached rows
         # (vectorized) iff every decided placement landed as exactly one
         # add_task; otherwise let the fingerprint delta re-encode the
@@ -850,32 +1041,39 @@ class Scheduler:
                     self._resident.after_apply(problem, counts)
                 else:
                     self._resident.invalidate()
-        elif counts is not None and self._resident is not None:
-            # fingerprint deltas will re-encode the touched rows next tick,
-            # but the device carry already folded THIS tick's full counts:
-            # resync from host
-            self._resident.invalidate()
+        elif counts is not None:
+            if self._resident is not None:
+                # fingerprint deltas will re-encode the touched rows next
+                # tick, but the device carry already folded THIS tick's
+                # full counts: resync from host
+                self._resident.invalidate()
+            if self.encoder.tracked:
+                # the zero-scan path never reads those fingerprints: the
+                # placed-on rows must also land in the mark feed, or the
+                # partial add_task walk stays invisible to the next encode
+                for r in np.flatnonzero(counts.sum(axis=0)).tolist():
+                    info = self.node_infos.get(node_ids[r])
+                    if info is not None:
+                        self.encoder.mark_numeric(info)
         if with_generic:
             # persist which named/discrete generic resources were granted
             # (reference nodeinfo.go:132-137 stamps AssignedGenericResources
             # on the task before commit; we claim post-commit and follow up)
-            def write_generic(batch):
-                for task_id, node_id in with_generic:
-                    def upd(tx, task_id=task_id, node_id=node_id):
-                        cur = tx.get_task(task_id)
-                        info = self.node_infos.get(node_id)
-                        if cur is None or info is None:
-                            return
-                        cur = cur.copy()
-                        cur.assigned_generic_resources = {
-                            kind: (sorted(named), count)
-                            for kind, (named, count)
-                            in info.assigned_generic(task_id).items()
-                        }
-                        tx.update(cur)
-                    batch.update(upd)
+            def write_generic(tx, item):
+                task_id, node_id = item
+                cur = tx.get_task(task_id)
+                info = self.node_infos.get(node_id)
+                if cur is None or info is None:
+                    return
+                cur = cur.copy()
+                cur.assigned_generic_resources = {
+                    kind: (sorted(named), count)
+                    for kind, (named, count)
+                    in info.assigned_generic(task_id).items()
+                }
+                tx.update(cur)
 
-            self.store.batch(write_generic)
+            self._batched_writes(with_generic, write_generic)
         for task_id in drop:
             self.unassigned.pop(task_id, None)
 
@@ -883,29 +1081,29 @@ class Scheduler:
             # second pass: explanations against bookkeeping that now includes
             # this tick's placements, written only on change so identical
             # failures don't retrigger the commit debounce forever
+            # explanations computed BEFORE the grouped transaction: the
+            # filter-pipeline walk is O(nodes) per group and must not run
+            # under the store's update lock
             explain_cache: dict[tuple[str, int], str] = {}
+            for _task, group in unplaced:
+                if group.key not in explain_cache:
+                    explain_cache[group.key] = self._explain(group)
 
-            def explain_cb(batch):
-                for task, group in unplaced:
-                    if group.key not in explain_cache:
-                        explain_cache[group.key] = self._explain(group)
-                    explanation = explain_cache[group.key]
+            def write_explanation(tx, item):
+                task, group = item
+                explanation = explain_cache[group.key]
+                cur = tx.get_task(task.id)
+                if cur is None or cur.status.state != TaskState.PENDING:
+                    return
+                if cur.status.err == explanation:
+                    return
+                cur = cur.copy()
+                cur.status.message = "scheduler: no suitable node"
+                cur.status.err = explanation
+                cur.status.timestamp = time.time()
+                tx.update(cur)
 
-                    def write_one(tx, task=task, explanation=explanation):
-                        cur = tx.get_task(task.id)
-                        if cur is None or cur.status.state != TaskState.PENDING:
-                            return
-                        if cur.status.err == explanation:
-                            return
-                        cur = cur.copy()
-                        cur.status.message = "scheduler: no suitable node"
-                        cur.status.err = explanation
-                        cur.status.timestamp = time.time()
-                        tx.update(cur)
-
-                    batch.update(write_one)
-
-            self.store.batch(explain_cb)
+            self._batched_writes(unplaced, write_explanation)
         # everything else (no-suitable-node, conflicted commits) stays in
         # self.unassigned; node/task events retrigger the tick
         return clean
@@ -931,38 +1129,34 @@ class Scheduler:
             pipeline.set_task(t)
             decided.append((t, pipeline.process(info)))
 
-        def batch_cb(batch):
-            for task, fits in decided:
-                def update_one(tx, task=task, fits=fits):
-                    cur = tx.get_task(task.id)
-                    if cur is None or cur.status.state != TaskState.PENDING:
-                        return
-                    if fits:
-                        cur = cur.copy()
-                        cur.status.timestamp = time.time()
-                        cur.status.state = TaskState.ASSIGNED
-                        cur.status.message = (
-                            "scheduler confirmed task can run on preassigned node")
-                        tx.update(cur)
-                    else:
-                        # keep PENDING and retry later — transient pressure
-                        # (resources, ports) may clear (reference
-                        # scheduler.go:654-661 only records Status.Err)
-                        err = "preassigned node does not satisfy filters"
-                        if cur.status.err != err:
-                            cur = cur.copy()
-                            cur.status.timestamp = time.time()
-                            cur.status.err = err
-                            tx.update(cur)
+        def write_preassigned(tx, item):
+            task, fits = item
+            cur = tx.get_task(task.id)
+            if cur is None or cur.status.state != TaskState.PENDING:
+                return
+            if fits:
+                cur = cur.copy()
+                cur.status.timestamp = time.time()
+                cur.status.state = TaskState.ASSIGNED
+                cur.status.message = (
+                    "scheduler confirmed task can run on preassigned node")
+                tx.update(cur)
+            else:
+                # keep PENDING and retry later — transient pressure
+                # (resources, ports) may clear (reference
+                # scheduler.go:654-661 only records Status.Err)
+                err = "preassigned node does not satisfy filters"
+                if cur.status.err != err:
+                    cur = cur.copy()
+                    cur.status.timestamp = time.time()
+                    cur.status.err = err
+                    tx.update(cur)
 
-                batch.update(update_one)
-
-        if decided:
-            self.store.batch(batch_cb)
+        self._batched_writes(decided, write_preassigned)
         for task, fits in decided:
             if fits:
                 self.preassigned.pop(task.id, None)
                 info = self.node_infos.get(task.node_id)
-                if info:
-                    info.add_task(task)
+                if info and info.add_task(task):
+                    self.encoder.mark_numeric(info)
             # non-fitting tasks stay in self.preassigned for retry

@@ -528,6 +528,7 @@ class Batch:
     def __init__(self, store: MemoryStore, pipeline_depth: int | None = None):
         self._store = store
         self._pending: list[Callable[[WriteTx], Any]] = []
+        self._pending_changes = 0
         self._depth = pipeline_depth
         self._handles: list = []
         self.applied = 0
@@ -535,8 +536,35 @@ class Batch:
 
     def update(self, cb: Callable[[WriteTx], Any]) -> None:
         self._pending.append(cb)
+        self._pending_changes += 1
         self.applied += 1
-        if len(self._pending) >= MAX_CHANGES_PER_TRANSACTION:
+        if self._pending_changes >= MAX_CHANGES_PER_TRANSACTION:
+            self._flush()
+
+    def update_many(self, cb: Callable[[WriteTx], Any], changes: int) -> None:
+        """Grouped write: `cb(tx)` performs up to `changes` store writes
+        in ONE callback — the scheduler's batched wave write-back rides
+        this instead of one closure + one Batch entry per task.
+
+        Flush semantics: with NO proposer, grouped callbacks coalesce
+        into a single transaction regardless of size (nothing bounds an
+        in-memory transaction but raft entry limits, and one commit =
+        one table swap + one event batch — the op-count guard asserts
+        exactly one update-tx per wave). With a proposer, flush
+        boundaries respect MAX_CHANGES_PER_TRANSACTION like update(),
+        so no raft entry exceeds the reference's bound — a grouped
+        callback that would push the pending sub-transaction past the
+        limit flushes the accumulated work FIRST (the caller still sizes
+        `cb` chunks at or below the limit; an oversized single chunk is
+        the caller's contract violation and ships alone)."""
+        if self._store.proposer is not None and self._pending and \
+                self._pending_changes + changes > MAX_CHANGES_PER_TRANSACTION:
+            self._flush()
+        self._pending.append(cb)
+        self._pending_changes += changes
+        self.applied += changes
+        if self._store.proposer is not None and \
+                self._pending_changes >= MAX_CHANGES_PER_TRANSACTION:
             self._flush()
 
     def _pipelined(self) -> bool:
@@ -548,6 +576,7 @@ class Batch:
         if not self._pending:
             return
         pending, self._pending = self._pending, []
+        changes, self._pending_changes = self._pending_changes, 0
 
         def run_all(tx: WriteTx):
             for cb in pending:
@@ -557,7 +586,7 @@ class Batch:
             self._flush_async(run_all)
         else:
             self._store.update(run_all)
-        self.committed += len(pending)
+        self.committed += changes
 
     def _flush_async(self, run_all: Callable[[WriteTx], Any]) -> None:
         """Build the sub-transaction under the update lock, hand the

@@ -52,6 +52,25 @@ def placement_mode(request, monkeypatch):
     return request.param
 
 
+@pytest.fixture(params=["native", "pure"])
+def native_walk_mode(request, monkeypatch):
+    """Tier-1 coverage of the pure-Python hostops fallback (ISSUE 6):
+    modules opting in (pytestmark usefixtures — the placement-parity,
+    encoder-incremental and steady-fastpath suites) run twice, once with
+    the lazily-built C extension and once with every consumer's _hostops
+    forced to None — exactly what SWARMKIT_TPU_NO_NATIVE=1 produces at
+    import time, but switchable in-process — so the pure-Python walk
+    and tree_copy stay bit-identical as the C paths grow."""
+    if request.param == "pure":
+        from swarmkit_tpu.api import objects, specs
+        from swarmkit_tpu.scheduler import batch
+
+        monkeypatch.setattr(batch, "_hostops", None)
+        monkeypatch.setattr(specs, "_hostops", None)
+        monkeypatch.setattr(objects, "_hostops", None)
+    return request.param
+
+
 @pytest.fixture(autouse=True)
 def _failpoints_disarmed():
     """A test that arms failpoints and leaks them would fault every test

@@ -23,6 +23,10 @@ from swarmkit_tpu.scheduler.nodeinfo import NodeInfo
 
 from test_placement_parity import random_group, random_node
 
+# tier-1 NO_NATIVE coverage (ISSUE 6): every test runs under both the C
+# hostops and the pure-Python fallback
+pytestmark = pytest.mark.usefixtures("native_walk_mode")
+
 NOW = 1000.0
 
 

@@ -27,6 +27,10 @@ from swarmkit_tpu.scheduler.encode import (
 from swarmkit_tpu.scheduler.filters import Pipeline
 from swarmkit_tpu.scheduler.nodeinfo import NodeInfo
 
+# tier-1 NO_NATIVE coverage (ISSUE 6): every test runs under both the C
+# hostops and the pure-Python fallback
+pytestmark = pytest.mark.usefixtures("native_walk_mode")
+
 LABEL_KEYS = ["zone", "disk", "tier"]
 LABEL_VALS = ["a", "b", "c", "ssd", "hdd"]
 

@@ -1,0 +1,575 @@
+"""Steady-tick host fast path (ISSUE 6).
+
+Three judged properties:
+
+* ZERO-SCAN parity — `IncrementalEncoder(tracked=True)` driven by the
+  explicit mark feed must stay bit-identical to the fingerprint-scan
+  oracle over random mutation traces, and a steady (no-mark) encode must
+  perform 0 fingerprint scans (`fp_scans` is the op-count counter).
+* OP-COUNT guard — a steady pipelined Scheduler tick performs 0
+  full-vocabulary scans and ≤1 store update transaction per wave
+  (store.op_counts["update_tx"] + encoder.fp_scans), in both commit
+  modes, with the batched write-back (`_batched_writes` riding
+  `Batch.update_many`).
+* HEAL interplay — `force_numeric_reencode` and `poison_all_numeric`
+  must reach the zero-scan path through the mark feed (the tracked
+  encoder never reads fingerprints, so a heal that only poisoned
+  fingerprints would be invisible until the next full scan).
+
+The `native_walk_mode` fixture (conftest) runs this module twice: C
+hostops walk and the pure-Python fallback (the SWARMKIT_TPU_NO_NATIVE
+path) — ISSUE 6 satellite: the fallback stays bit-identical as the C
+path grows.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from swarmkit_tpu.api.objects import Task
+from swarmkit_tpu.api.types import TaskState
+from swarmkit_tpu.scheduler import batch
+from swarmkit_tpu.scheduler.encode import (
+    IncrementalEncoder,
+    encode,
+)
+from swarmkit_tpu.scheduler.nodeinfo import NodeInfo
+
+from test_encoder_incremental import NOW, make_info, make_task
+from test_placement_parity import random_group, random_node
+
+pytestmark = pytest.mark.usefixtures("native_walk_mode")
+
+
+def semantic_outputs(p):
+    counts = batch.cpu_schedule_encoded(p)
+    return batch.cpu_static_mask(p), counts, batch.materialize(p, counts)
+
+
+def mutate_marked(rng, infos, enc, next_node_id, step):
+    """The tracked twin of test_encoder_incremental.mutate: the same
+    mutation mix, but every NodeInfo touch is reported through the
+    encoder's mark feed (the production Scheduler's contract — an
+    unmarked mutation is invisible to the zero-scan path)."""
+    for _ in range(rng.randint(1, 4)):
+        op = rng.random()
+        if op < 0.2 and len(infos) < 40:
+            infos.append(make_info(rng, next_node_id))
+            next_node_id += 1
+            enc.mark_node_set_changed()
+        elif op < 0.3 and len(infos) > 5:
+            infos.pop(rng.randrange(len(infos)))
+            enc.mark_node_set_changed()
+        elif op < 0.55:
+            info = rng.choice(infos)
+            svc = f"svc-{rng.randrange(6):03d}"
+            if info.add_task(make_task(rng, svc, rng.randrange(10_000))):
+                enc.mark_numeric(info)
+        elif op < 0.7 and any(i.tasks for i in infos):
+            info = rng.choice([i for i in infos if i.tasks])
+            tid = rng.choice(list(info.tasks))
+            if info.remove_task(info.tasks[tid]):
+                enc.mark_numeric(info)
+        elif op < 0.85:
+            info = rng.choice(infos)
+            for _ in range(rng.randint(1, 6)):
+                info.task_failed((f"svc-{rng.randrange(6):03d}", 1), now=NOW)
+            enc.mark_numeric(info)
+        else:
+            i = rng.randrange(len(infos))
+            old = infos[i]
+            node = random_node(rng, step * 1000 + i)
+            node.id = old.node.id
+            infos[i] = NodeInfo.new(node, {},
+                                    node.description.resources.copy())
+            enc.mark_replaced(infos[i])
+    return next_node_id
+
+
+def make_groups(rng, n=None):
+    groups, seen = [], set()
+    for _ in range(n if n is not None else rng.randint(1, 4)):
+        g = random_group(rng, rng.randrange(6), rng.randint(1, 12))
+        if g.key not in seen:
+            seen.add(g.key)
+            groups.append(g)
+    return groups
+
+
+# ------------------------------------------------------------ zero-scan path
+@pytest.mark.parametrize("seed", range(6))
+def test_tracked_matches_scan_oracle_over_trace(seed):
+    """Tracked (zero-scan) vs always-scan oracle over a random mutation
+    trace — semantics must match at every step, and steps with no
+    mutation must not pay a fingerprint scan."""
+    rng = random.Random(9000 + seed)
+    infos = [make_info(rng, i) for i in range(12)]
+    next_node_id = 12
+    enc_t = IncrementalEncoder(tracked=True)
+    enc_s = IncrementalEncoder()
+    for step in range(10):
+        steady = step and rng.random() < 0.35
+        if not steady:
+            next_node_id = mutate_marked(rng, infos, enc_t,
+                                         next_node_id, step)
+        groups = make_groups(rng)
+        scans0 = enc_t.fp_scans
+        p_t = enc_t.encode(infos, groups, now=NOW)
+        if steady:
+            assert enc_t.fp_scans == scans0, \
+                f"step {step}: steady encode paid a fingerprint scan"
+            assert enc_t.last_dirty == 0
+        p_s = enc_s.encode(infos, groups, now=NOW)
+        assert p_t.node_ids == p_s.node_ids, f"step {step}"
+        mask_t, counts_t, assign_t = semantic_outputs(p_t)
+        mask_s, counts_s, assign_s = semantic_outputs(p_s)
+        np.testing.assert_array_equal(mask_t, mask_s,
+                                      err_msg=f"step {step}: mask diverged")
+        np.testing.assert_array_equal(counts_t, counts_s,
+                                      err_msg=f"step {step}: counts diverged")
+        assert assign_t == assign_s, f"step {step}: assignments diverged"
+        # canonical-order tables bit-match too
+        np.testing.assert_array_equal(p_t.total0, p_s.total0)
+        np.testing.assert_array_equal(p_t.avail_res[:, :2],
+                                      p_s.avail_res[:, :2])
+        np.testing.assert_array_equal(p_t.svc_count0, p_s.svc_count0)
+    # the mark feed missed nothing: a forced full scan finds zero dirty
+    enc_t.mark_node_set_changed()
+    enc_t.encode(infos, make_groups(rng), now=NOW)
+    assert enc_t.last_dirty == 0, \
+        "full scan found rows the mark feed never re-encoded"
+
+
+def test_steady_encode_is_zero_scan_and_clean_is_o1():
+    rng = random.Random(1)
+    infos = [make_info(rng, i) for i in range(20)]
+    enc = IncrementalEncoder(tracked=True)
+    groups = make_groups(rng, 2)
+    enc.encode(infos, groups, now=NOW)
+    cold_scans = enc.fp_scans
+    assert cold_scans >= 1          # cold start must sync via the scan
+    for _ in range(5):
+        enc.encode(infos, groups, now=NOW)
+        assert enc.last_dirty == 0
+    assert enc.nodes_clean(infos)
+    assert enc.fp_scans == cold_scans, \
+        "steady encode/nodes_clean paid a fingerprint scan"
+    # the untracked oracle pays one scan per nodes_clean call
+    enc_s = IncrementalEncoder()
+    enc_s.encode(infos, groups, now=NOW)
+    s0 = enc_s.fp_scans
+    assert enc_s.nodes_clean(infos) and enc_s.fp_scans == s0 + 1
+
+
+def test_marked_rows_reencode_without_scan():
+    rng = random.Random(2)
+    infos = [make_info(rng, i) for i in range(16)]
+    enc = IncrementalEncoder(tracked=True)
+    groups = make_groups(rng, 2)
+    enc.encode(infos, groups, now=NOW)
+    scans0 = enc.fp_scans
+
+    infos[3].add_task(make_task(rng, "svc-000", 1))
+    enc.mark_numeric(infos[3])
+    infos[7].task_failed(("svc-000", 1), now=NOW)
+    enc.mark_numeric(infos[7])
+    assert not enc.nodes_clean(infos)
+    p = enc.encode(infos, groups, now=NOW)
+    assert enc.last_dirty == 2 and enc.fp_scans == scans0
+    # bit-parity against a fresh full encode of the same infos
+    p_full = encode(infos, groups, now=NOW)
+    np.testing.assert_array_equal(p.total0, p_full.total0)
+    np.testing.assert_array_equal(p.avail_res[:, :2], p_full.avail_res[:, :2])
+    np.testing.assert_array_equal(batch.cpu_schedule_encoded(p),
+                                  batch.cpu_schedule_encoded(p_full))
+
+
+def test_mark_replaced_takes_full_string_path():
+    """A replaced NodeInfo (label churn) must re-run the row's string
+    columns off the mark alone — no scan."""
+    rng = random.Random(3)
+    infos = [make_info(rng, i) for i in range(10)]
+    enc = IncrementalEncoder(tracked=True)
+    groups = make_groups(rng, 2)
+    enc.encode(infos, groups, now=NOW)
+    scans0 = enc.fp_scans
+
+    node = random_node(rng, 555)
+    node.id = infos[4].node.id
+    infos[4] = NodeInfo.new(node, {}, node.description.resources.copy())
+    enc.mark_replaced(infos[4])
+    p = enc.encode(infos, groups, now=NOW)
+    assert enc.fp_scans == scans0 and enc.last_full == 1
+    p_full = encode(infos, groups, now=NOW)
+    mask_t, counts_t, assign_t = semantic_outputs(p)
+    mask_f, counts_f, assign_f = semantic_outputs(p_full)
+    np.testing.assert_array_equal(mask_t, mask_f)
+    np.testing.assert_array_equal(counts_t, counts_f)
+    assert assign_t == assign_f
+
+
+def test_numeric_mark_on_swapped_object_defensively_full_encodes():
+    """mark_numeric carrying a DIFFERENT object than the cached row is a
+    mis-marked replacement: the encoder must take the full string path
+    for that row (labels may have moved too), not trust the caller."""
+    rng = random.Random(4)
+    infos = [make_info(rng, i) for i in range(8)]
+    enc = IncrementalEncoder(tracked=True)
+    enc.encode(infos, [], now=NOW)
+
+    node = random_node(rng, 777)
+    node.id = infos[2].node.id
+    infos[2] = NodeInfo.new(node, {}, node.description.resources.copy())
+    enc.mark_numeric(infos[2])          # wrong kind of mark, on purpose
+    p = enc.encode(infos, [], now=NOW)
+    assert enc.last_full == 1
+    p_full = encode(infos, [], now=NOW)
+    np.testing.assert_array_equal(batch.cpu_static_mask(p),
+                                  batch.cpu_static_mask(p_full))
+
+
+def test_node_set_change_falls_back_to_full_scan():
+    rng = random.Random(5)
+    infos = [make_info(rng, i) for i in range(10)]
+    enc = IncrementalEncoder(tracked=True)
+    enc.encode(infos, [], now=NOW)
+    scans0 = enc.fp_scans
+
+    infos.append(make_info(rng, 99))
+    enc.mark_node_set_changed()
+    assert not enc.nodes_clean(infos)
+    p = enc.encode(infos, [], now=NOW)
+    assert enc.fp_scans == scans0 + 1       # re-sync via the scan
+    assert enc.last_dirty == 1              # just the new node
+    assert p.node_ids == sorted(i.node.id for i in infos)
+    # an UNMARKED set change is still caught (length check), tracked or not
+    infos.pop()
+    assert not enc.nodes_clean(infos)
+
+
+# ------------------------------------------------------------- heal interplay
+@pytest.mark.parametrize("poison_all", [False, True])
+def test_unclean_heal_reaches_zero_scan_path(poison_all):
+    """The lying-fold heal in tracked mode: fold_counts ran but the
+    add_task walk never did. force_numeric_reencode (targeted) or
+    poison_all_numeric (crash-before-record) must re-derive the folded
+    rows through the MARK feed — the zero-scan encode never reads the
+    poisoned fingerprints."""
+    rng = random.Random(6)
+    infos = [make_info(rng, i) for i in range(14)]
+    enc = IncrementalEncoder(tracked=True)
+    groups = make_groups(rng, 3)
+    p = enc.encode(infos, groups, now=NOW)
+    counts = batch.cpu_schedule_encoded(p)
+    if not counts.sum():
+        pytest.skip("degenerate seed: nothing placed")
+    # optimistic fold with NO add_task behind it — the lie
+    assert enc.fold_counts(p, counts)
+    if poison_all:
+        enc.poison_all_numeric()
+    else:
+        enc.force_numeric_reencode(np.flatnonzero(counts.sum(axis=0)))
+    assert not enc.nodes_clean(infos), "heal invisible to the clean gate"
+    scans0 = enc.fp_scans
+    p2 = enc.encode(infos, groups, now=NOW)
+    assert enc.fp_scans == scans0, "heal forced a fingerprint scan"
+    # the phantom reservations are gone: bit-parity with a fresh encode
+    p_fresh = encode(infos, groups, now=NOW)
+    np.testing.assert_array_equal(p2.total0, p_fresh.total0)
+    np.testing.assert_array_equal(p2.avail_res[:, :2],
+                                  p_fresh.avail_res[:, :2])
+    np.testing.assert_array_equal(p2.svc_count0, p_fresh.svc_count0)
+    np.testing.assert_array_equal(batch.cpu_schedule_encoded(p2),
+                                  batch.cpu_schedule_encoded(p_fresh))
+
+
+def test_bulk_numeric_reencode_bit_identical():
+    """≥64 numeric-dirty rows take the vectorized fromiter path
+    (_encode_rows_numeric_bulk) — it must be bit-identical to the scalar
+    per-row path across every column family (totals, raw+quantized
+    resources, per-service counts, ports, failures)."""
+    rng = random.Random(7)
+    infos = [make_info(rng, i) for i in range(90)]
+    groups = make_groups(rng, 4)
+    enc = IncrementalEncoder(tracked=True)
+    enc.encode(infos, groups, now=NOW)
+
+    # mutate EVERY node (tasks incl. host-port specs via random groups,
+    # failures) then poison wholesale: 90 numeric rows -> bulk path
+    for info in infos:
+        for _ in range(rng.randint(1, 3)):
+            info.add_task(make_task(rng, f"svc-{rng.randrange(6):03d}",
+                                    rng.randrange(10_000)))
+        if rng.random() < 0.3:
+            info.task_failed((f"svc-{rng.randrange(6):03d}", 1), now=NOW)
+    enc.poison_all_numeric()
+    p_bulk = enc.encode(infos, groups, now=NOW)
+    assert enc.last_dirty == len(infos)
+
+    # oracle 1: the scalar path (untracked encoder, same mutations seen
+    # via the fingerprint scan — well under the bulk threshold per row)
+    enc_scalar = IncrementalEncoder()
+    enc_scalar.encode(infos, groups, now=NOW)
+    p_scalar = enc_scalar.encode(infos, groups, now=NOW)
+    # oracle 2: a from-scratch full encode
+    p_fresh = encode(infos, groups, now=NOW)
+    for p_ref in (p_scalar, p_fresh):
+        np.testing.assert_array_equal(p_bulk.total0, p_ref.total0)
+        np.testing.assert_array_equal(p_bulk.avail_res[:, :2],
+                                      p_ref.avail_res[:, :2])
+        np.testing.assert_array_equal(p_bulk.svc_count0, p_ref.svc_count0)
+        np.testing.assert_array_equal(batch.cpu_static_mask(p_bulk),
+                                      batch.cpu_static_mask(p_ref))
+        np.testing.assert_array_equal(batch.cpu_schedule_encoded(p_bulk),
+                                      batch.cpu_schedule_encoded(p_ref))
+
+
+# --------------------------------------------------------- op-count guards
+def _seed_cluster(n_nodes, svc, n_tasks):
+    from swarmkit_tpu.api.objects import Node
+    from swarmkit_tpu.api.types import NodeAvailability, NodeStatusState
+    from swarmkit_tpu.store.memory import MemoryStore
+
+    store = MemoryStore()
+
+    def seed(tx):
+        for i in range(n_nodes):
+            n = Node(id=f"fp{i:02d}")
+            n.status.state = NodeStatusState.READY
+            n.spec.availability = NodeAvailability.ACTIVE
+            tx.create(n)
+        _add_wave(tx, svc, n_tasks)
+    store.update(seed)
+    return store
+
+
+def _add_wave(tx, svc, n_tasks):
+    for w in range(n_tasks):
+        t = Task(id=f"{svc}-t{w:02d}", service_id=svc, slot=w + 1)
+        t.desired_state = TaskState.RUNNING
+        t.status.state = TaskState.PENDING
+        tx.create(t)
+
+
+@pytest.mark.parametrize("async_commit", [False, True])
+def test_scheduler_steady_tick_opcount_guard(async_commit):
+    """The ISSUE 6 acceptance guard: a steady pipelined wave performs 0
+    full-vocabulary fingerprint scans and ≤1 store update transaction,
+    in both commit modes — counter-based (encoder.fp_scans +
+    store.op_counts), so a regression is a hard failure, not a perf
+    drift."""
+    from swarmkit_tpu.scheduler.scheduler import Scheduler
+
+    store = _seed_cluster(16, "w00", 12)
+    sched = Scheduler(store, backend="jax", pipeline=True,
+                      async_commit=async_commit)
+    ch = sched._setup()
+    try:
+        sched.tick()                        # cold: prime wave 0
+        assert sched._inflight is not None
+        for wave in range(1, 5):
+            store.update(lambda tx, w=wave: _add_wave(tx, f"w{w:02d}", 12))
+            # pump the pool exactly like the run loop's event handler
+            # (which barriers the plane first — the external-mutator
+            # contract), minus the store-event plumbing
+            while True:
+                ev = ch.try_get()
+                if ev is None:
+                    break
+                sched._handle(ev)
+            scans0 = sched.encoder.fp_scans
+            tx0 = store.op_counts["update_tx"]
+            sched.tick()                    # completes w-1, primes w
+            if async_commit:
+                sched._drain_commit_plane()
+            assert store.op_counts["update_tx"] - tx0 <= 1, \
+                f"wave {wave}: write-back took more than one update tx"
+            assert sched.encoder.fp_scans == scans0, \
+                f"wave {wave}: steady tick paid a fingerprint scan"
+        sched.flush_pipeline()
+        tasks = store.view(lambda tx: tx.find_tasks())
+        assert len(tasks) == 5 * 12
+        assert all(t.status.state == TaskState.ASSIGNED and t.node_id
+                   for t in tasks)
+        # the mark feed stayed honest through every wave: a forced full
+        # scan re-encodes nothing
+        sched.encoder.mark_node_set_changed()
+        sched.encoder.encode(list(sched.node_infos.values()), [])
+        assert sched.encoder.last_dirty == 0
+    finally:
+        sched.store.queue.stop_watch(ch)
+        if sched._commit_worker is not None:
+            sched._commit_worker.close()
+
+
+def test_scheduler_async_overlap_engages_and_places_exactly_once():
+    """The encode/commit overlap path: steady tracked-clean async waves
+    submit the heavy half BEFORE the next prime (overlapped_commits) and
+    every task still lands on exactly one node exactly once."""
+    from swarmkit_tpu.scheduler.scheduler import Scheduler
+
+    store = _seed_cluster(16, "w00", 12)
+    sched = Scheduler(store, backend="jax", pipeline=True,
+                      async_commit=True)
+    ch = sched._setup()
+    try:
+        sched.tick()
+        for wave in range(1, 5):
+            store.update(lambda tx, w=wave: _add_wave(tx, f"w{w:02d}", 12))
+            # pump the pool WITHOUT the event handler's plane drain: the
+            # overlap window stays open, the exclusion set closes the
+            # pool race
+            for t in store.view(lambda tx: tx.find_tasks()):
+                if (t.status.state == TaskState.PENDING
+                        and t.id.startswith(f"w{wave:02d}-")):
+                    sched.unassigned[t.id] = t
+            sched.tick()
+        assert sched.overlapped_commits > 0, "overlap path never engaged"
+        sched.flush_pipeline()
+        tasks = store.view(lambda tx: tx.find_tasks())
+        assert len(tasks) == 5 * 12
+        assert all(t.status.state == TaskState.ASSIGNED and t.node_id
+                   for t in tasks)
+        # exactly-once bookkeeping: per-node task counts equal the store
+        per_node = {}
+        for t in tasks:
+            per_node[t.node_id] = per_node.get(t.node_id, 0) + 1
+        for nid, info in sched.node_infos.items():
+            assert len(info.tasks) == per_node.get(nid, 0), \
+                f"{nid}: walked bookkeeping diverged from the store"
+    finally:
+        sched.store.queue.stop_watch(ch)
+        sched._commit_worker.close()
+
+
+def test_batch_update_many_coalesces_without_proposer():
+    """store.Batch.update_many: grouped callbacks coalesce into ONE
+    update transaction on a plain MemoryStore regardless of size, and
+    applied/committed count CHANGES (not closures)."""
+    from swarmkit_tpu.store.memory import MemoryStore
+
+    store = MemoryStore()
+    n = 450                                 # > 2x MAX_CHANGES_PER_TRANSACTION
+
+    def batch_cb(b):
+        def write_all(tx):
+            for i in range(n):
+                t = Task(id=f"bm-{i:04d}", service_id="bm", slot=i + 1)
+                t.status.state = TaskState.PENDING
+                tx.create(t)
+        b.update_many(write_all, n)
+
+    tx0 = store.op_counts["update_tx"]
+    store.batch(batch_cb)
+    assert store.op_counts["update_tx"] - tx0 == 1
+    assert len(store.view(lambda tx: tx.find_tasks())) == n
+
+
+# -------------------------------------------------- TickPipeline overlap
+def run_tracked_pipeline(seed, steps=8, churn=False, depth=1,
+                         async_commit=False):
+    """run_pipelined_trace's tracked twin (test_pipeline.py): marks fed
+    for every external mutation, per-wave oracle parity asserted."""
+    from swarmkit_tpu.ops.pipeline import TickPipeline
+    from swarmkit_tpu.ops.resident import ResidentPlacement
+
+    from test_pipeline import make_commit, make_waves
+
+    rng = random.Random(seed)
+    infos = [make_info(rng, i) for i in range(14)]
+    next_node_id = 14
+    enc = IncrementalEncoder(tracked=True)
+    rp = ResidentPlacement(enc)
+    pipe = TickPipeline(enc, rp, make_commit(infos), depth=depth,
+                        async_commit=async_commit)
+    completed = []
+    try:
+        for step in range(steps):
+            if churn and step and step % 3 == 0:
+                # external mutators: barrier FIRST (async contract),
+                # then feed the mark stream
+                pipe.barrier()
+                next_node_id = mutate_marked(rng, infos, enc,
+                                             next_node_id, step)
+            groups = make_waves(rng, step, random_group)
+            completed.extend(pipe.tick(infos, groups, now=NOW))
+        completed.extend(pipe.flush())
+    finally:
+        pipe.close()
+    assert len(completed) == steps
+    for step, (p, counts) in enumerate(completed):
+        np.testing.assert_array_equal(
+            counts, batch.cpu_schedule_encoded(p),
+            err_msg=f"seed {seed} step {step} (tracked pipeline vs oracle)")
+    return enc, pipe
+
+
+@pytest.mark.parametrize("seed", range(3))
+@pytest.mark.parametrize("async_commit", [False, True])
+def test_tracked_pipeline_parity(seed, async_commit):
+    enc, pipe = run_tracked_pipeline(seed, async_commit=async_commit)
+    # steady tracked waves: zero scans after the cold sync, and in async
+    # mode the encode/commit overlap engages
+    assert enc.fp_scans == 1
+    if async_commit:
+        assert any(t.get("commit_overlapped") for t in pipe.timings), \
+            "tracked-clean async waves never overlapped"
+        assert not any(t["serial_fallback"] for t in pipe.timings)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_tracked_pipeline_churn_parity(seed):
+    """External mutations through the mark feed: the clean gate closes,
+    the pipe falls back to the serial order, parity holds."""
+    enc, pipe = run_tracked_pipeline(seed, churn=True, depth=2,
+                                     async_commit=True)
+    assert any(t["serial_fallback"] for t in pipe.timings)
+
+
+def test_tracked_pipeline_worker_crash_heals_via_marks():
+    """A poisoned commit plane under a TRACKED encoder: the barrier
+    re-raise reaches the driver, and the documented heal
+    (poison_all_numeric) flows through the mark feed so the next
+    zero-scan encode re-derives honest rows."""
+    from swarmkit_tpu.ops.pipeline import TickPipeline
+    from swarmkit_tpu.ops.resident import ResidentPlacement
+
+    from test_pipeline import make_commit, make_waves
+
+    rng = random.Random(11)
+    infos = [make_info(rng, i) for i in range(14)]
+    enc = IncrementalEncoder(tracked=True)
+    rp = ResidentPlacement(enc)
+    commit = make_commit(infos)
+    crash = {"arm": False}
+
+    def flaky_commit(p, counts):
+        if crash["arm"]:
+            crash["arm"] = False
+            raise RuntimeError("injected heavy-commit crash")
+        commit(p, counts)
+
+    pipe = TickPipeline(enc, rp, flaky_commit, depth=1, async_commit=True)
+    try:
+        for step in range(3):
+            pipe.tick(infos, make_waves(rng, step, random_group), now=NOW)
+        crash["arm"] = True
+        # this tick's wave rides the plane and crashes there; the
+        # barrier surfaces it deterministically (a later tick would too,
+        # but WHICH one depends on worker timing — overlap skips the top
+        # barrier while the plane looks healthy)
+        pipe.tick(infos, make_waves(rng, 3, random_group), now=NOW)
+        with pytest.raises(RuntimeError, match="injected"):
+            pipe.barrier()
+        # driver-owned heal (CLAUDE.md failpoint contract)
+        pipe.worker.reset()
+        enc.poison_all_numeric()
+        rp.invalidate()
+        assert not enc.nodes_clean(infos)   # the heal closed the gate
+        done = pipe.tick(infos, make_waves(rng, 5, random_group), now=NOW)
+        done += pipe.flush()
+        for p, counts in done:
+            np.testing.assert_array_equal(
+                counts, batch.cpu_schedule_encoded(p))
+    finally:
+        pipe.close()
