@@ -1105,6 +1105,79 @@ def bench_dispatcher_fanout(np, n_nodes=10_000):
         d.stop()
 
 
+def bench_mesh_cluster_step(np, n_nodes=None, total_tasks=1_000_000):
+    """ISSUE 7: the fused flagship (placement fill + raft quorum tally +
+    commit-frontier advance in ONE jit) sharded over the `nodes` mesh
+    axis at the scale-out grid — ≥131072 nodes × 1M tasks, the shape the
+    Go reference cannot hold in one scheduler pass. Columns: devices,
+    per-shard node count, H2D bytes (chunked shard uploads), fill vs e2e
+    split. Parity at this size rides the sampled-shard oracle +
+    invariant ladder (parallel/shard_parity.py; full-oracle parity for
+    the same kernel is pinned at feasible shapes by the grid rows and
+    tests) — a regression flips parity=False, joins failed_rows, and the
+    bench exits nonzero."""
+    import jax
+    from swarmkit_tpu.models.cluster_step import synth_shard_cluster
+    from swarmkit_tpu.ops.raft_replay import replay_commit
+    from swarmkit_tpu.parallel.mesh import make_mesh, sharded_cluster_step
+    from swarmkit_tpu.parallel.shard_parity import (
+        check_fill_invariants,
+        sampled_shard_parity,
+    )
+
+    n_dev = 1 << (max(len(jax.devices()), 1).bit_length() - 1)
+    mesh = make_mesh(n_dev)
+    if n_nodes is None:
+        n_nodes = max(131_072, 16_384 * n_dev)
+    gps = 2                                   # groups per shard
+    tpg = -(-total_tasks // (gps * n_dev))
+    t0 = time.perf_counter()
+    p, gshard = synth_shard_cluster(n_nodes, n_dev, groups_per_shard=gps,
+                                    tasks_per_group=tpg, lmax=2)
+    synth_s = time.perf_counter() - t0
+    managers, log_len = 5, 1 << 15
+    acks = np.zeros((managers, log_len), bool)
+    frontier = np.random.RandomState(2).randint(
+        log_len // 2, log_len, managers)
+    for m in range(managers):
+        acks[m, :frontier[m]] = True
+    quorum = managers // 2 + 1
+    stats = {}
+    t0 = time.perf_counter()
+    counts, commit = sharded_cluster_step(p, acks, np.int32(quorum), mesh,
+                                          stats=stats)
+    e2e_s = time.perf_counter() - t0
+    parity = True
+    inv, shards = {}, []
+    try:
+        assert commit == int(replay_commit(acks, quorum)[0]), \
+            "fused commit frontier != replay_commit"
+        inv = check_fill_invariants(p, counts)
+        shards = sampled_shard_parity(p, counts, gshard, n_dev,
+                                      min(2, n_dev))
+    except AssertionError as exc:
+        parity = False
+        inv = {"violation": str(exc).splitlines()[0]}
+    return {
+        "parity": parity,
+        "devices": n_dev,
+        "nodes": n_nodes,
+        "per_shard_nodes": n_nodes // n_dev,
+        "tasks": int(p.n_tasks.sum()),
+        "placed": inv.get("placed"),
+        "h2d_bytes": stats.get("h2d_bytes"),
+        "h2d_mb": round(stats.get("h2d_bytes", 0) / 1e6, 1),
+        "d2h_bytes": stats.get("d2h_bytes"),
+        "upload_s": round(stats.get("upload_s", 0.0), 3),
+        "fill_s": round(stats.get("fill_s", 0.0), 3),
+        "pull_s": round(stats.get("pull_s", 0.0), 4),
+        "e2e_s": round(e2e_s, 3),
+        "synth_s": round(synth_s, 3),
+        "sampled_shards": shards,
+        "commit_index": int(commit),
+    }
+
+
 def bench_trace_plane(np):
     """Trace-plane acceptance row (ISSUE 5): (a) DISARMED overhead — a
     pipelined steady wave with tracing off must allocate zero spans
@@ -1479,6 +1552,9 @@ def main():
         # segmented-WAL fsync coalescing + pipelined proposals) on a live
         # in-process 3-manager cluster; still on a small heap
         ("raft_backed_store_1x3", lambda: bench_raft_backed_store(np)),
+        # round 7 (ISSUE 7): the fused flagship on the device mesh at the
+        # scale-out grid — 131k+ nodes × 1M tasks, sampled-shard parity
+        ("mesh_cluster_step", lambda: bench_mesh_cluster_step(np)),
         # waves=7 -> three fully-pipelined periods in the e2e sample
         # (depth+1..waves-1); with one sample the min-estimator was a
         # lottery against heap/tunnel noise on the commit-heavy wall

@@ -24,6 +24,11 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:                                   # jax >= 0.5 top-level export
+    _shard_map = jax.shard_map
+except AttributeError:                 # 0.4.x: experimental module
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 
 @jax.jit
 def replay_commit(acks, quorum):
@@ -50,7 +55,7 @@ def sharded_replay_commit(mesh: Mesh, axis: str = "managers"):
         return jnp.sum(prefix).astype(jnp.int32), prefix.astype(bool)
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             kernel,
             mesh=mesh,
             in_specs=(P(axis, None), P()),

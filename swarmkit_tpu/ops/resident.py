@@ -53,6 +53,16 @@ from . import placement
 STATE_FIELDS = ("ready", "node_val", "node_plat", "node_plugins",
                 "port_used", "avail_res", "total0", "svc_mat")
 
+# donated jit positions: EXACTLY the 8 STATE arrays above — donating any
+# group-table position would hand the kernel invalidated buffers on a
+# group-cache hit (tests/test_mesh_scaleout.py pins this set)
+DONATE_STATE_ARGNUMS = tuple(range(len(STATE_FIELDS)))
+
+# module-singleton placeholders for the disabled penalty/extra group
+# tables: a FRESH (1, 1) array per tick would defeat the group-table
+# cache's identity gate and re-ship two (tiny) arrays every steady tick
+_PLACEHOLDER_FALSE = np.zeros((1, 1), bool)
+
 
 def _resident_tick_impl(
     # ---- device-resident node state (donated: updated in place) --------
@@ -96,7 +106,7 @@ _STATICS = ("use_penalty", "use_extra", "has_deltas", "compact")
 # plain variant
 _resident_tick_donating = jax.jit(
     _resident_tick_impl, static_argnames=_STATICS,
-    donate_argnums=tuple(range(8)))
+    donate_argnums=DONATE_STATE_ARGNUMS)
 _resident_tick_plain = jax.jit(_resident_tick_impl, static_argnames=_STATICS)
 
 # mesh-mode tick jits, cached per Mesh: a fresh jax.jit wrapper per
@@ -119,7 +129,8 @@ def _mesh_ticks(mesh, shard):
                 shard["avail_res"], shard["total0"], shard["svc_mat"])
         cached = (
             jax.jit(_resident_tick_impl, static_argnames=_STATICS,
-                    donate_argnums=tuple(range(8)), out_shardings=outs),
+                    donate_argnums=DONATE_STATE_ARGNUMS,
+                    out_shardings=outs),
             jax.jit(_resident_tick_impl, static_argnames=_STATICS,
                     out_shardings=outs),
         )
@@ -248,6 +259,9 @@ class ResidentPlacement:
         self.uploads_delta_rows = 0
         self.uploads_group_tables = 0
         self._gcache = None         # [(host array, device array)] per slot
+        self._gsrc = None           # per-slot SOURCE object (identity gate)
+        self._gdims = None          # padded dims + N the cache was built at
+        self.uploads_h2d_bytes = 0  # delta + group-table wire bytes shipped
         # buffer donation invalidates the donated arrays; on CPU test
         # meshes jax warns per call — keep it for accelerators only
         self._donate = jax.default_backend() != "cpu"
@@ -323,6 +337,7 @@ class ResidentPlacement:
                 self._shard[f] for f in STATE_FIELDS[:7]])
         else:
             state = jax.device_put(host)
+        self.uploads_h2d_bytes += sum(a.nbytes for a in host)
         # the [S, N] per-service count matrix is the cold upload's whale
         # (at 100k nodes it alone is 17-67 MB through a single-digit-MB/s
         # tunnel) and on a cold cluster / post-failover first contact it
@@ -348,16 +363,19 @@ class ResidentPlacement:
             # as a separate eager op, never fused with the scatter
             r, c = np.nonzero(svc)
             flat = (r.astype(np.int64) * np_b + c).astype(np.int32)
+            vals = svc[r, c]
             svc_flat = jnp.zeros(sp * np_b, np.int32).at[
-                jax.device_put(flat)].add(jax.device_put(svc[r, c]))
+                jax.device_put(flat)].add(jax.device_put(vals))
             svc_dev = svc_flat.reshape(sp, np_b)
             if self._shard is not None:
                 svc_dev = jax.device_put(svc_dev, self._shard["svc_mat"])
+            self.uploads_h2d_bytes += flat.nbytes + vals.nbytes
         else:
             pad = np.ascontiguousarray(
                 np.pad(svc, ((0, 0), (0, np_b - n))))
             svc_dev = (jax.device_put(pad, self._shard["svc_mat"])
                        if self._shard is not None else jax.device_put(pad))
+            self.uploads_h2d_bytes += pad.nbytes
         state.append(svc_dev)
         self._state = state
         self._meta = self._signature(p)
@@ -443,53 +461,92 @@ class ResidentPlacement:
 
         # group tables only — padding the node-side arrays too (the shared
         # pad_buckets) would memcpy tens of MB per tick for arrays the
-        # resident path never ships
-        use_penalty = bool(p.penalty.any())
-        use_extra = not bool(p.extra_mask.all())
+        # resident path never ships. The builder-stamped flags replace the
+        # O(G·N) penalty/extra scans at scale (None = unknown, scan).
+        use_penalty = (bool(p.penalty_nonzero)
+                       if p.penalty_nonzero is not None
+                       else bool(p.penalty.any()))
+        use_extra = ((not p.extra_mask_all)
+                     if p.extra_mask_all is not None
+                     else not bool(p.extra_mask.all()))
         gp = _bucket(G)
         pad2 = self._pad2
         lmax = p.spread_rank.shape[1]
         lp = _bucket(lmax) if lmax else 0
-        spread = np.zeros((gp, lp, np_b), np.int32)
-        if lmax:
-            spread[:G, :lmax, :N] = p.spread_rank
-            if lp > lmax:
-                # replicate each group's deepest real level (self-parented
-                # pours are no-ops), mirroring pad_buckets
-                spread[:G, lmax:, :N] = p.spread_rank[:, lmax - 1:lmax, :]
-        group_np = [
-            pad2(p.constraints, gp, fill=-1),
-            pad2(p.plat_req, gp, fill=-2),
-            pad2(p.req_plugins, gp, plp, fill=False),
-            pad2(p.n_tasks, gp),
-            _pad1(p.svc_idx_persistent, gp),
-            pad2(p.need_res, gp, rp),
-            pad2(p.max_replicas, gp),
-            pad2(p.penalty, gp, np_b, fill=False) if use_penalty
-            else np.zeros((1, 1), bool),
-            pad2(p.has_ports, gp, fill=False),
-            pad2(p.group_ports, gp, pvp, fill=False),
-            spread,
-            pad2(p.extra_mask, gp, np_b, fill=False) if use_extra
-            else np.zeros((1, 1), bool),
-        ]
+        dims = (gp, np_b, kp, plp, pvp, rp, lp, N)
+
+        def build_slot(i):
+            if i == 0:
+                return pad2(p.constraints, gp, fill=-1)
+            if i == 1:
+                return pad2(p.plat_req, gp, fill=-2)
+            if i == 2:
+                return pad2(p.req_plugins, gp, plp, fill=False)
+            if i == 3:
+                return pad2(p.n_tasks, gp)
+            if i == 4:
+                return _pad1(p.svc_idx_persistent, gp)
+            if i == 5:
+                return pad2(p.need_res, gp, rp)
+            if i == 6:
+                return pad2(p.max_replicas, gp)
+            if i == 7:
+                return pad2(p.penalty, gp, np_b, fill=False)
+            if i == 8:
+                return pad2(p.has_ports, gp, fill=False)
+            if i == 9:
+                return pad2(p.group_ports, gp, pvp, fill=False)
+            if i == 10:
+                spread = np.zeros((gp, lp, np_b), np.int32)
+                if lmax:
+                    spread[:G, :lmax, :N] = p.spread_rank
+                    if lp > lmax:
+                        # replicate each group's deepest real level
+                        # (self-parented pours are no-ops), like
+                        # pad_buckets
+                        spread[:G, lmax:, :N] = \
+                            p.spread_rank[:, lmax - 1:lmax, :]
+                return spread
+            return pad2(p.extra_mask, gp, np_b, fill=False)      # 11
+
         compact = bool(p.n_tasks.size == 0 or int(p.n_tasks.max()) < (1 << 15))
 
         # group-table device cache: successive waves of the SAME services
         # re-encode identical constraint/platform/spread/... tables — only
-        # n_tasks (and penalty, when failures decay) actually move. Value
-        # equality against the last-shipped host copy is the bulletproof
-        # gate (a memcmp is ~100x cheaper than the upload it saves); it
-        # cuts the steady dispatch to a couple of small arrays, which is
-        # the per-tick floor that sets the small-shape TPU threshold.
+        # n_tasks (and penalty, when failures decay) actually move. TWO
+        # gates, cheapest first (docs/mesh.md): (1) source IDENTITY — the
+        # encoder re-emits unchanged [·, N]-sized tables as the same
+        # object (spread-table cache; placeholder singletons), an O(1)
+        # hit that skips BOTH the padded rebuild and the memcmp, which at
+        # 100k–1M nodes would themselves be the steady tick's largest
+        # host cost; (2) host value equality on the padded copy (a memcmp
+        # is ~100x cheaper than the upload it saves). In mesh mode the
+        # cached device arrays keep their node-axis NamedShardings, so a
+        # hit reuses SHARD-resident tables — sound only because no
+        # group-table jit position is ever donated (DONATE_STATE_ARGNUMS
+        # covers exactly the 8 STATE arrays).
+        srcs = [p.constraints, p.plat_req, p.req_plugins, p.n_tasks,
+                p.svc_idx_persistent, p.need_res, p.max_replicas,
+                p.penalty if use_penalty else _PLACEHOLDER_FALSE,
+                p.has_ports, p.group_ports, p.spread_rank,
+                p.extra_mask if use_extra else _PLACEHOLDER_FALSE]
+        n_slots = len(srcs)
         cache = self._gcache
-        if cache is None or len(cache) != len(group_np):
-            cache = [None] * len(group_np)
-        group_dev: list = [None] * len(group_np)
+        prev_src = self._gsrc
+        if cache is None or len(cache) != n_slots or self._gdims != dims:
+            cache = [None] * n_slots
+            prev_src = [None] * n_slots
+        group_dev: list = [None] * n_slots
+        group_host: list = [None] * n_slots
         ship_slots: list[int] = []
         to_ship: list[np.ndarray] = []
-        for i, h in enumerate(group_np):
+        for i, src in enumerate(srcs):
             c = cache[i]
+            if c is not None and prev_src[i] is src:
+                group_host[i], group_dev[i] = c          # identity hit
+                continue
+            h = src if src is _PLACEHOLDER_FALSE else build_slot(i)
+            group_host[i] = h
             if c is not None and c[0].shape == h.shape \
                     and c[0].dtype == h.dtype and np.array_equal(c[0], h):
                 group_dev[i] = c[1]
@@ -517,8 +574,14 @@ class ResidentPlacement:
             dev = jax.device_put(deltas + to_ship)
         for slot, d in zip(ship_slots, dev[9:]):
             group_dev[slot] = d
-        self._gcache = [(h, d) for h, d in zip(group_np, group_dev)]
+        self._gcache = [(h, d) for h, d in zip(group_host, group_dev)]
+        self._gsrc = srcs
+        self._gdims = dims
         self.uploads_group_tables += len(ship_slots)
+        # O(delta) H2D accounting (the op-count guard's byte counter):
+        # everything this tick shipped is the delta rows + missed slots
+        self.uploads_h2d_bytes += sum(a.nbytes for a in deltas) \
+            + sum(a.nbytes for a in to_ship)
         tick = (self._tick_donating if self._donate
                 else self._tick_plain)
         out = tick(

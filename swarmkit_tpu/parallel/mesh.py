@@ -20,6 +20,28 @@ from ..scheduler.encode import KERNEL_ARG_FIELDS
 
 NODE_AXIS = "nodes"
 
+
+def mesh_context(mesh: "Mesh"):
+    """Context manager making `mesh` ambient for jitted collectives, across
+    jax versions: `jax.sharding.use_mesh` (always a scoped context manager
+    where present), `jax.sharding.set_mesh` only when it returns one, and
+    the Mesh's own context manager as the 0.4.x fallback (there,
+    NamedSharding-carrying jits need no ambient mesh at all, so entering
+    the Mesh is sufficient). use_mesh is probed FIRST: a set_mesh variant
+    that is a bare global setter would leak the mesh past the with-block.
+    Every `with set_mesh(...)` call site in this repo goes through here;
+    this container's jax has neither helper, which made test_parallel and
+    dryrun_multichip fail at seed."""
+    fn = getattr(jax.sharding, "use_mesh", None)
+    if fn is not None:
+        return fn(mesh)
+    fn = getattr(jax.sharding, "set_mesh", None)
+    if fn is not None:
+        cm = fn(mesh)
+        if hasattr(cm, "__enter__"):
+            return cm
+    return mesh
+
 # Per-field sharding: (node-axis position or None, pad fill value). Order is
 # NOT duplicated here — it comes from KERNEL_ARG_FIELDS.
 _FIELD_SHARDING: dict[str, tuple[int | None, object]] = {
@@ -102,11 +124,64 @@ def _pad_nodes(arr: np.ndarray, n_pad: int, axis: int, fill):
     return np.pad(arr, pad_width, constant_values=fill)
 
 
-def shard_problem(p, mesh: Mesh):
+# node-axis arrays at/above this many bytes upload shard-by-shard via
+# jax.make_array_from_callback instead of one padded whole-array
+# device_put: at the 1M-node grid the [G, LMAX, N] spread table alone is
+# hundreds of MB, and the padded host copy would double peak memory
+CHUNKED_UPLOAD_BYTES = 64 << 20
+
+
+def _put_node_sharded(arr: np.ndarray, mesh: Mesh, node_axis: int,
+                      fill, n_padded: int, stats: dict | None = None):
+    """Ship one node-axis array to the mesh WITHOUT materializing a padded
+    whole-array host copy: each device shard is sliced (and tail-padded)
+    on demand, so peak host staging is one shard. `arr` may be a
+    broadcast view — only shard-sized chunks are ever made contiguous."""
+    shape = (arr.shape[:node_axis] + (n_padded,)
+             + arr.shape[node_axis + 1:])
+    sharding = node_axis_sharding(mesh, len(shape), node_axis)
+    n_real = arr.shape[node_axis]
+
+    def cb(index):
+        sl = index[node_axis]
+        start = sl.start or 0
+        stop = sl.stop if sl.stop is not None else shape[node_axis]
+        idx = list(index)
+        if stop <= n_real:
+            out = np.ascontiguousarray(arr[tuple(idx)])
+        else:
+            out_shape = tuple(
+                (stop - start) if d == node_axis
+                else ((s.stop if s.stop is not None else shape[d])
+                      - (s.start or 0))
+                for d, s in enumerate(idx))
+            out = np.full(out_shape, fill, arr.dtype)
+            take = n_real - start
+            if take > 0:
+                idx[node_axis] = slice(start, n_real)
+                dst = [slice(None)] * len(out_shape)
+                dst[node_axis] = slice(0, take)
+                out[tuple(dst)] = arr[tuple(idx)]
+        if stats is not None:
+            stats["h2d_bytes"] = stats.get("h2d_bytes", 0) + out.nbytes
+        return out
+
+    return jax.make_array_from_callback(shape, sharding, cb)
+
+
+def shard_problem(p, mesh: Mesh, stats: dict | None = None,
+                  chunked: int | None = None):
     """Place an EncodedProblem's arrays onto the mesh: every per-node axis is
     sharded, group-side tables are replicated. Node count is padded to a
     multiple of the mesh size with ineligible phantom nodes (ready=False),
-    which the mask kernel excludes, so results are unchanged."""
+    which the mask kernel excludes, so results are unchanged.
+
+    stats (optional dict) accumulates `h2d_bytes` — the wire bytes this
+    upload cost, the bench's H2D column. Node-axis arrays at/above
+    `chunked` bytes (default CHUNKED_UPLOAD_BYTES) upload shard-by-shard
+    so the padded host copy is never materialized whole."""
+    if chunked is None:
+        chunked = CHUNKED_UPLOAD_BYTES
     n_dev = mesh.devices.size
     N = len(p.node_ids)
     n_pad = (-N) % n_dev
@@ -116,13 +191,19 @@ def shard_problem(p, mesh: Mesh):
         node_axis, fill = _FIELD_SHARDING[field]
         arr = np.asarray(getattr(p, field))
         if node_axis is None:
-            spec = P()
+            dev = jax.device_put(arr, NamedSharding(mesh, P()))
+            if stats is not None:
+                stats["h2d_bytes"] = stats.get("h2d_bytes", 0) + arr.nbytes
+        elif arr.nbytes >= chunked:
+            dev = _put_node_sharded(arr, mesh, node_axis, fill,
+                                    arr.shape[node_axis] + n_pad, stats)
         else:
             arr = _pad_nodes(arr, n_pad, node_axis, fill)
-            parts = [None] * arr.ndim
-            parts[node_axis] = NODE_AXIS
-            spec = P(*parts)
-        args.append(jax.device_put(arr, NamedSharding(mesh, spec)))
+            dev = jax.device_put(
+                arr, node_axis_sharding(mesh, arr.ndim, node_axis))
+            if stats is not None:
+                stats["h2d_bytes"] = stats.get("h2d_bytes", 0) + arr.nbytes
+        args.append(dev)
     return tuple(args), N
 
 
@@ -130,18 +211,26 @@ def sharded_schedule(p, mesh: Mesh):
     """Run the placement kernel with per-node arrays sharded over the mesh.
     Returns counts[G, N] (numpy, truncated back to the real node count)."""
     args, N = shard_problem(p, mesh)
-    with jax.sharding.set_mesh(mesh):
+    with mesh_context(mesh):
         counts, totals, svc_counts = placement_ops.schedule_groups(*args)
     return np.asarray(counts)[:, :N]
 
 
-def sharded_cluster_step(p, acks, quorum, mesh: Mesh):
+def sharded_cluster_step(p, acks, quorum, mesh: Mesh,
+                         stats: dict | None = None):
     """The FUSED flagship step (models.cluster_step) on the mesh: per-node
     placement arrays shard over the node axis, the raft ack matrix shards
     its log axis over the same devices (the tally is elementwise along the
     log; the commit prefix-scan crosses shards, XLA inserting the
-    collectives). Returns (counts[G, N] numpy, commit_index int)."""
-    args, N = shard_problem(p, mesh)
+    collectives). Returns (counts[G, N] numpy, commit_index int).
+
+    stats (optional dict) records the bench's split: h2d_bytes,
+    upload_s, fill_s (dispatch + device compute) and pull_s (the one real
+    value pull — through a tunnel this is the true sync; see CLAUDE.md)."""
+    import time as _time
+
+    t0 = _time.perf_counter()
+    args, N = shard_problem(p, mesh, stats=stats)
     n_dev = mesh.devices.size
     E = acks.shape[1]
     e_pad = (-E) % n_dev
@@ -152,9 +241,26 @@ def sharded_cluster_step(p, acks, quorum, mesh: Mesh):
                       constant_values=False)
     acks_dev = jax.device_put(
         np.asarray(acks), NamedSharding(mesh, P(None, NODE_AXIS)))
-    with jax.sharding.set_mesh(mesh):
+    if stats is not None:
+        stats["h2d_bytes"] = stats.get("h2d_bytes", 0) \
+            + np.asarray(acks).nbytes
+        stats["upload_s"] = _time.perf_counter() - t0
+    t1 = _time.perf_counter()
+    with mesh_context(mesh):
         counts, totals, commit = _fused_step()(acks_dev, quorum, *args)
-    return np.asarray(counts)[:, :N], int(commit)
+    # the scalar commit pull is the TRUE device sync (CLAUDE.md tunnel
+    # rule: block_until_ready lies through the tunnel; only a real value
+    # pull syncs) — it delimits fill_s honestly on the platform the
+    # bench targets, leaving pull_s as the counts D2H alone
+    commit_i = int(commit)
+    if stats is not None:
+        stats["fill_s"] = _time.perf_counter() - t1
+    t2 = _time.perf_counter()
+    counts_np = np.asarray(counts)[:, :N]
+    if stats is not None:
+        stats["pull_s"] = _time.perf_counter() - t2
+        stats["d2h_bytes"] = counts_np.nbytes
+    return counts_np, commit_i
 
 
 _FUSED_JIT = None

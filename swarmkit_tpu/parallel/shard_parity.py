@@ -1,0 +1,167 @@
+"""Parity at oracle-infeasible sizes: sampled-shard oracle + invariants.
+
+The judged property of this framework is BIT-parity between the TPU
+water-fill and the CPU greedy oracle (`tests/test_placement_parity.py`).
+The oracle is Python-per-task, so at the mesh flagship's 100k–1M-node ×
+1M-task grid a full-oracle check cannot run. This module implements the
+scale-out verification ladder (ISSUE 7 / docs/mesh.md):
+
+  1. full oracle at every feasible shape (unchanged — the dryrun and
+     test_parallel keep doing it);
+  2. SAMPLED-SHARD oracle above that: for problems built shard-
+     partitioned (`models.cluster_step.synth_shard_cluster` — every
+     group eligible on exactly one contiguous node slice, spread
+     branches and warm service counts confined to their slice, port ids
+     reused only within a slice), the global sequential-group fill
+     RESTRICTED to a slice is bit-identical to the greedy oracle run on
+     that slice alone: groups of other slices cannot place there (the
+     eligibility mask), their service rows are distinct, and every fold
+     they perform (totals, avail, ports, svc counts) touches only their
+     own slice — so they are no-ops on this slice's state. Slicing
+     preserves the relative node order (the canonical node_idx
+     tie-break) and the relative branch-rank order (the pour's
+     tie-break), so the restricted fill IS the slice's fill.
+  3. invariant checks on the FULL output: non-negativity, per-group task
+     conservation, static-mask eligibility, resource capacity,
+     max-replicas caps, host-port exclusivity — each a vectorized numpy
+     pass, feasible at any size the arrays fit in memory.
+
+A violation raises AssertionError (bench rows translate that into
+parity=False and join failed_rows).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def slice_shard_problem(p, group_idx: np.ndarray, node_lo: int,
+                        node_hi: int):
+    """Restrict an EncodedProblem to `group_idx` (ascending, original
+    order) × the contiguous node slice [node_lo, node_hi). Service rows
+    are kept whole (svc_idx values stay valid); only their node columns
+    are sliced."""
+    from ..scheduler.encode import EncodedProblem
+
+    gsel = np.asarray(group_idx, np.int64)
+    sl = slice(node_lo, node_hi)
+    q = EncodedProblem(
+        node_ids=p.node_ids[node_lo:node_hi],
+        group_keys=[p.group_keys[int(g)] for g in gsel],
+        service_ids=p.service_ids,
+        groups=[],
+    )
+    q.ready = np.ascontiguousarray(p.ready[sl])
+    q.node_val = np.ascontiguousarray(p.node_val[sl])
+    q.node_plat = np.ascontiguousarray(p.node_plat[sl])
+    q.node_plugins = np.ascontiguousarray(p.node_plugins[sl])
+    q.port_used0 = np.ascontiguousarray(p.port_used0[sl])
+    q.avail_res = np.ascontiguousarray(p.avail_res[sl])
+    q.total0 = np.ascontiguousarray(p.total0[sl])
+    q.svc_count0 = np.ascontiguousarray(p.svc_count0[:, sl])
+    q.n_tasks = p.n_tasks[gsel]
+    q.svc_idx = p.svc_idx[gsel]
+    q.need_res = p.need_res[gsel]
+    q.max_replicas = p.max_replicas[gsel]
+    q.constraints = p.constraints[gsel]
+    q.plat_req = p.plat_req[gsel]
+    q.req_plugins = p.req_plugins[gsel]
+    q.has_ports = p.has_ports[gsel]
+    q.group_ports = p.group_ports[gsel]
+    q.penalty = np.ascontiguousarray(p.penalty[gsel][:, sl])
+    q.extra_mask = np.ascontiguousarray(p.extra_mask[gsel][:, sl])
+    q.spread_rank = np.ascontiguousarray(
+        np.asarray(p.spread_rank)[gsel][:, :, sl])
+    return q
+
+
+def sampled_shard_parity(p, counts: np.ndarray, group_shard: np.ndarray,
+                         n_shards: int, sample, log=None) -> list[int]:
+    """Bit-parity of `counts` against the greedy oracle on sampled shards.
+
+    `sample`: iterable of shard indices (or an int — that many shards
+    picked deterministically, spread across the range). For each sampled
+    shard s the oracle re-runs on s's node slice with s's groups, and
+    counts[groups_of_s] must (a) equal the oracle inside the slice and
+    (b) be identically zero outside it. Returns the shards checked."""
+    from ..scheduler.batch import cpu_schedule_encoded
+
+    N = len(p.node_ids)
+    per = N // n_shards
+    group_shard = np.asarray(group_shard)
+    if isinstance(sample, int):
+        k = max(1, min(sample, n_shards))
+        sample = sorted({int(s) for s in
+                         np.linspace(0, n_shards - 1, k).round()})
+    checked = []
+    for s in sample:
+        s = int(s)
+        gsel = np.flatnonzero(group_shard == s)
+        a, b = s * per, (s + 1) * per
+        sub = slice_shard_problem(p, gsel, a, b)
+        expected = cpu_schedule_encoded(sub)
+        got = counts[gsel]
+        outside = got.copy()
+        outside[:, a:b] = 0
+        assert not outside.any(), \
+            f"shard {s}: placements leaked outside the shard's node slice"
+        np.testing.assert_array_equal(
+            got[:, a:b], expected,
+            err_msg=f"shard {s}: kernel fill != greedy oracle on the "
+                    f"shard's node slice [{a}, {b})")
+        checked.append(s)
+        if log is not None:
+            log(f"sampled-shard parity ok: shard {s} "
+                f"({len(gsel)} groups, {per} nodes, "
+                f"{int(expected.sum())} placed)")
+    return checked
+
+
+def check_fill_invariants(p, counts: np.ndarray) -> dict:
+    """Vectorized invariant checks on a full fill output — the guardrail
+    at sizes where even the sampled oracle covers only a fraction.
+    Raises AssertionError on violation; returns summary stats."""
+    from ..scheduler.batch import cpu_static_mask
+
+    c = np.asarray(counts, np.int64)
+    assert (c >= 0).all(), "negative placement count"
+    placed_per_group = c.sum(axis=1)
+    assert (placed_per_group <= p.n_tasks.astype(np.int64)).all(), \
+        "a group placed more tasks than it has"
+
+    mask = cpu_static_mask(p)
+    assert not (c[~mask] > 0).any(), \
+        "placement on a statically-ineligible node"
+
+    used = c.T @ p.need_res.astype(np.int64)              # [N, R]
+    assert (used <= p.avail_res.astype(np.int64)).all(), \
+        "resource capacity overcommitted"
+
+    # max-replicas: final per-service per-node count never exceeds the cap
+    svc_final = p.svc_count0.astype(np.int64).copy()
+    np.add.at(svc_final, p.svc_idx, c)
+    for gi in np.flatnonzero(p.max_replicas > 0):
+        assert (svc_final[p.svc_idx[gi]]
+                <= int(p.max_replicas[gi])).all(), \
+            f"group {gi}: max_replicas cap exceeded"
+
+    # host ports: ≤1 task of a port group per node, never on a node whose
+    # port was already in use, and no two groups sharing a port id on the
+    # same node
+    port_claims = np.zeros(p.port_used0.shape, np.int64)  # [N, PV]
+    for gi in np.flatnonzero(p.has_ports):
+        assert (c[gi] <= 1).all(), \
+            f"port group {gi}: >1 task on one node"
+        pids = np.flatnonzero(p.group_ports[gi])
+        conflict = p.port_used0[:, pids].any(axis=1)
+        assert not (c[gi][conflict] > 0).any(), \
+            f"port group {gi}: placed on a node with the port in use"
+        port_claims[np.ix_(c[gi] > 0, pids)] += 1
+    assert (port_claims <= 1).all(), \
+        "two groups claimed the same host port on one node"
+
+    return {
+        "placed": int(c.sum()),
+        "tasks": int(p.n_tasks.sum()),
+        "groups": int(len(p.n_tasks)),
+        "nodes": len(p.node_ids),
+    }

@@ -153,6 +153,14 @@ class EncodedProblem:
     # a deep pipeline must not dispatch AHEAD of such a wave, because a
     # later wave's hypothetical numbering would clash with it
     has_hypo_rows: bool = False
+    # cheap dispatch gates (ops/resident.py): at 100k–1M nodes the
+    # `penalty.any()` / `extra_mask.all()` scans are O(G·N) per tick, so
+    # builders that KNOW the answer stamp it here. None = unknown, the
+    # consumer scans. Conservative values (nonzero=True / all=False when
+    # actually clean) are SAFE — they only ship the real array instead of
+    # the placeholder, never change results.
+    penalty_nonzero: bool | None = None
+    extra_mask_all: bool | None = None
 
 
 _INT32_MAX = (1 << 31) - 1
@@ -351,6 +359,15 @@ class IncrementalEncoder:
         # object may have been swapped (remap, replaced-object sync,
         # mark_replaced) — the problem.row_infos currentness stamp
         self.infos_seq = 0
+        # spread-table cache: steady ticks re-derive an IDENTICAL
+        # [G, LMAX, N] rank table from unchanged label columns — at scale
+        # that rebuild is the encode's largest allocation. Keyed by the
+        # groups' spread specs + N + a label-column generation stamp
+        # (bumped by any full re-encode/remap — numeric dirt never
+        # touches labels); a hit re-emits the SAME array object, which the
+        # resident group-table cache turns into an O(1) identity hit.
+        self._spread_cache: tuple | None = None
+        self._label_gen = 0
 
         self.key_cols: dict[str, int] = {}   # canonical constraint key -> col
         self.val_vocab = Vocab()
@@ -913,6 +930,10 @@ class IncrementalEncoder:
             self.fp_scans += 1
         self._clear_marks()     # scan or mark resolution consumed them
         self.last_scan_s = time.perf_counter() - t_scan
+        if dirty or self.last_remap:
+            # full re-encodes rewrite label columns: spread ranks derived
+            # from them may no longer match (numeric dirt never can)
+            self._label_gen += 1
         N, G = len(node_infos), len(groups)
 
         # ------------------------------------------------ parse constraints
@@ -1057,6 +1078,10 @@ class IncrementalEncoder:
         p.group_ports = np.zeros((G, PV), bool)
         p.penalty = np.zeros((G, N), bool)
         p.extra_mask = np.ones((G, N), bool)
+        # exact-or-conservative dispatch gates: True/False the moment a
+        # write lands (ops/resident.py skips its O(G·N) scans on these)
+        extra_all = True
+        pen_any = False
 
         group_row = {g.key: i for i, g in enumerate(groups)}
 
@@ -1071,6 +1096,7 @@ class IncrementalEncoder:
             cs = parsed[gi]
             if cs is None:
                 p.extra_mask[gi, :] = False
+                extra_all = False
             else:
                 ci = 0
                 for c in cs:
@@ -1079,8 +1105,10 @@ class IncrementalEncoder:
                         # unknown key matches no node, regardless of operator
                         # (reference constraint.go default case)
                         p.extra_mask[gi, :] = False
+                        extra_all = False
                         continue
                     if ck == "node.ip":
+                        extra_all = False       # conservative: may write
                         for n, info in enumerate(node_infos):
                             if not constraint_mod._match_ip(
                                     c, info.node.status.addr or ""):
@@ -1088,6 +1116,7 @@ class IncrementalEncoder:
                         continue
                     if ci >= C:
                         # overflow constraints evaluated host-side (rare)
+                        extra_all = False       # conservative: may write
                         for n, info in enumerate(node_infos):
                             _, cands = constraint_mod.node_attribute(
                                 info.node, ck)
@@ -1135,26 +1164,39 @@ class IncrementalEncoder:
 
         group_spread = [_spread_labels(g) for g in groups]
         LMAX = max((len(s) for s in group_spread), default=0)
-        p.spread_rank = np.zeros((G, LMAX, N), np.int32)
-        if LMAX:
-            # rank value paths per (group, level) in numpy over the cached
-            # per-label value columns — host work O(N) per distinct label
-            for gi, spread in enumerate(group_spread):
-                if not spread:
-                    continue
-                prefix = np.zeros(N, np.int64)
-                for li, (kind, label) in enumerate(spread):
-                    vals = self._label_col(kind, label)
-                    # ids ordered by value string => prefix ranks sort
-                    # lexicographically level by level
-                    _, col = np.unique(vals, return_inverse=True)
-                    combo = prefix * (int(col.max(initial=0)) + 1) + col
-                    # contiguous ranks preserving (prefix, value) order
-                    _, ranks = np.unique(combo, return_inverse=True)
-                    p.spread_rank[gi, li] = ranks.astype(np.int32)
-                    prefix = ranks.astype(np.int64)
-                for li in range(len(spread), LMAX):
-                    p.spread_rank[gi, li] = p.spread_rank[gi, len(spread) - 1]
+        skey = (tuple(tuple(s) for s in group_spread), N, LMAX,
+                self._label_gen)
+        cached = self._spread_cache
+        if LMAX and cached is not None and cached[0] == skey:
+            # steady tick, unchanged labels: re-emit the SAME array object
+            # — the resident group-table cache gates on identity, so both
+            # the O(G·L·N) rebuild and the device re-upload are skipped.
+            # Consumers treat emitted spread tables as read-only.
+            p.spread_rank = cached[1]
+        else:
+            p.spread_rank = np.zeros((G, LMAX, N), np.int32)
+            if LMAX:
+                # rank value paths per (group, level) in numpy over the
+                # cached per-label value columns — host work O(N) per
+                # distinct label
+                for gi, spread in enumerate(group_spread):
+                    if not spread:
+                        continue
+                    prefix = np.zeros(N, np.int64)
+                    for li, (kind, label) in enumerate(spread):
+                        vals = self._label_col(kind, label)
+                        # ids ordered by value string => prefix ranks sort
+                        # lexicographically level by level
+                        _, col = np.unique(vals, return_inverse=True)
+                        combo = prefix * (int(col.max(initial=0)) + 1) + col
+                        # contiguous ranks preserving (prefix, value) order
+                        _, ranks = np.unique(combo, return_inverse=True)
+                        p.spread_rank[gi, li] = ranks.astype(np.int32)
+                        prefix = ranks.astype(np.int64)
+                    for li in range(len(spread), LMAX):
+                        p.spread_rank[gi, li] = \
+                            p.spread_rank[gi, len(spread) - 1]
+                self._spread_cache = (skey, p.spread_rank)
 
         # penalties: only iterate nodes that actually recorded failures
         for nid in self._failure_ids:
@@ -1162,10 +1204,11 @@ class IncrementalEncoder:
             if i is None:
                 continue
             info = node_infos[i]
-            for skey in list(info.recent_failures):
-                gi = group_row.get(skey)
-                if gi is not None and info.penalized(skey, now):
+            for fkey in list(info.recent_failures):
+                gi = group_row.get(fkey)
+                if gi is not None and info.penalized(fkey, now):
                     p.penalty[gi, i] = True
+                    pen_any = True
 
         # CSI volume feasibility: host-side extra_mask correction, like
         # node.ip (scheduler/volumes.go isVolumeAvailableOnNode is string/set
@@ -1177,11 +1220,14 @@ class IncrementalEncoder:
                 probe = g.tasks[0]
                 if not task_csi_mounts(probe):
                     continue
+                extra_all = False               # conservative: may write
                 for n, info in enumerate(node_infos):
                     if p.extra_mask[gi, n] and \
                             not volume_set.check_volumes_on_node(info, probe):
                         p.extra_mask[gi, n] = False
 
+        p.penalty_nonzero = pen_any
+        p.extra_mask_all = extra_all
         return p
 
 

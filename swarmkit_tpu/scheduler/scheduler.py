@@ -632,8 +632,14 @@ class Scheduler:
             # the resident state's node buckets are powers of two, so the
             # sharded axis must be one too: round down (a 6-device host
             # runs a 4-device mesh rather than crashing on upload)
-            n = 1 << (max(n, 1).bit_length() - 1)
-            mesh = make_mesh(n)
+            chosen = 1 << (max(n, 1).bit_length() - 1)
+            if chosen != n:
+                log.info("scheduler: mesh backend using %d of %d visible "
+                         "devices (node axis must be a power of two)",
+                         chosen, n)
+            else:
+                log.info("scheduler: mesh backend over %d devices", chosen)
+            mesh = make_mesh(chosen)
         return mesh
 
     def _use_jax(self, problem) -> bool:

@@ -8,7 +8,7 @@ import numpy as np
 import pytest
 
 from swarmkit_tpu.ops import raft_replay
-from swarmkit_tpu.parallel.mesh import make_mesh, sharded_schedule
+from swarmkit_tpu.parallel.mesh import make_mesh, mesh_context, sharded_schedule
 from swarmkit_tpu.scheduler import batch
 from swarmkit_tpu.scheduler.encode import encode
 
@@ -62,7 +62,7 @@ def test_sharded_replay_commit():
     expected = _np_commit(acks, quorum=5)
     mesh = make_mesh(8, axis="managers")
     fn = raft_replay.sharded_replay_commit(mesh, "managers")
-    with jax.sharding.set_mesh(mesh):
+    with mesh_context(mesh):
         commit, _ = fn(acks, 5)
     assert int(commit) == expected
 
