@@ -1282,6 +1282,88 @@ def bench_trace_plane(np):
     }
 
 
+def bench_lint_plane(np):
+    """Analysis-plane acceptance row (ISSUE 8), the trace_plane shape:
+    (a) DISARMED, the lockgraph factory hands out the PLAIN threading
+    primitive — acquire stays native C and constructing/acquiring
+    allocates zero tracker objects (the failpoints/trace truthiness
+    contract, spied the same way trace_plane spies Span.__init__);
+    (b) ARMED, the tracked wrapper's acquire overhead is measured
+    (armed-vs-disarmed ratio — per-test cost, never production);
+    (c) the full AST rule set + the mirrored-tick drift check run over
+    the tree and must come back clean (what tier-1's
+    tests/test_lint_clean.py gates, timed here)."""
+    import threading
+    import time as _time
+    from pathlib import Path
+
+    from swarmkit_tpu.analysis import lint, lockgraph, mirror
+
+    N, BATCHES = 20_000, 5
+
+    def acquire_wall(lock) -> float:
+        """min-of-batches seconds for N acquire/release pairs (the
+        host-micro discipline: sub-10ms timings are jitter-bound)."""
+        best = float("inf")
+        for _ in range(BATCHES):
+            t0 = _time.perf_counter()
+            for _ in range(N):
+                with lock:
+                    pass
+            best = min(best, _time.perf_counter() - t0)
+        return best
+
+    # (a) disarmed: the op-count guard — any _TrackedLock construction
+    # or graph record while disarmed trips the probe
+    allocs = {"n": 0}
+    orig_init = lockgraph._TrackedLock.__init__
+
+    def spy_init(self, *a, **k):
+        allocs["n"] += 1
+        orig_init(self, *a, **k)
+
+    lockgraph._TrackedLock.__init__ = spy_init
+    try:
+        lockgraph.disarm()
+        plain = lockgraph.make_lock("bench.lint_plane")
+        plain_is_native = type(plain) is type(threading.Lock())
+        disarmed_s = acquire_wall(plain)
+        disarmed_allocs = allocs["n"]
+    finally:
+        lockgraph._TrackedLock.__init__ = orig_init
+
+    # (b) armed: tracked wrapper overhead + a clean report
+    state = lockgraph.arm()
+    try:
+        tracked = lockgraph.make_lock("bench.lint_plane")
+        armed_s = acquire_wall(tracked)
+        graph_clean = state.report().clean
+    finally:
+        lockgraph.disarm()
+
+    # (c) the static passes over the tree (repo root = bench.py's dir)
+    root = Path(__file__).resolve().parent
+    t0 = _time.perf_counter()
+    findings = lint.lint_tree(root)
+    drift = mirror.check_drift(root)
+    static_s = _time.perf_counter() - t0
+
+    return {
+        "disarmed_acquire_ns": round(disarmed_s / N * 1e9, 1),
+        "armed_acquire_ns": round(armed_s / N * 1e9, 1),
+        "armed_overhead_x": round(armed_s / max(disarmed_s, 1e-12), 2),
+        # THE acceptance: disarmed hands out the native primitive and
+        # allocates nothing
+        "disarmed_tracked_allocs": disarmed_allocs,
+        "disarmed_is_native_lock": plain_is_native,
+        "lint_findings": len(findings),
+        "mirror_drift_clean": drift.clean,
+        "static_pass_s": round(static_s, 3),
+        "parity": (disarmed_allocs == 0 and plain_is_native
+                   and graph_clean and not findings and drift.clean),
+    }
+
+
 def bench_host_micro(np):
     """The BASELINE.md harness rows the reference ships benchmarks for
     but no numbers (store ops memory_test.go:2028-2120, watch queue at
@@ -1605,6 +1687,9 @@ def main():
         # ISSUE 5: per-stage breakdown via the trace plane + the
         # disarmed-overhead acceptance (zero span allocs with tracing off)
         ("trace_plane", lambda: bench_trace_plane(np)),
+        # ISSUE 8: lockgraph disarmed-cost acceptance (plain primitive,
+        # zero wrapper allocs) + the tree-wide lint/mirror clean gate
+        ("lint_plane", lambda: bench_lint_plane(np)),
     ]
     configs = {name: _run_row(name, thunk) for name, thunk in rows}
     ns = configs["grid_100k_x_10k"]   # the north star IS this grid config

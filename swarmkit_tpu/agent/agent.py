@@ -12,6 +12,7 @@ import logging
 import threading
 import time
 
+from ..analysis.lockgraph import make_lock
 from ..api.objects import TaskStatus
 from ..store.watch import ChannelClosed
 from .worker import Worker
@@ -59,7 +60,7 @@ class Agent:
         self.on_session_message = None
         self._pending: dict[str, TaskStatus] = {}
         self._unpublished_pending: set[str] = set()
-        self._pending_lock = threading.Lock()
+        self._pending_lock = make_lock('agent.agent.pending_lock')
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
 

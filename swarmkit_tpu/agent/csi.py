@@ -12,6 +12,7 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass, field
 
+from ..analysis.lockgraph import make_lock
 from ..csi.plugin import PluginGetter
 from ..utils.volumequeue import VolumeQueue
 
@@ -35,7 +36,7 @@ class NodeVolumeManager:
         self.plugins = plugins
         self.on_unpublished = on_unpublished  # callable(volume_obj_id)
         self.on_ready = on_ready  # callable(volume_obj_id): staged+published
-        self._lock = threading.Lock()
+        self._lock = make_lock('agent.csi.lock')
         self._assignments: dict[str, VolumeAssignment] = {}
         self._ready: set[str] = set()
         self._removing: dict[str, VolumeAssignment] = {}

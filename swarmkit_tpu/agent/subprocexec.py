@@ -24,6 +24,7 @@ import signal
 import subprocess
 import threading
 
+from ..analysis.lockgraph import make_lock
 from ..api.objects import Task
 from ..api.specs import NodeDescription, Platform, Resources
 from .exec import ExitStatus, FatalError
@@ -62,7 +63,7 @@ class SubprocessController:
         self._proc: subprocess.Popen | None = None
         self._cmd: list[str] | None = None
         self._env: dict[str, str] | None = None
-        self._lock = threading.Lock()
+        self._lock = make_lock('agent.subprocexec.lock')
         self._exited = threading.Event()
         self._exit_code: int | None = None
         self._log_path: str | None = None

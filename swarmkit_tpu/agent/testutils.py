@@ -6,6 +6,7 @@ from __future__ import annotations
 import threading
 import time
 
+from ..analysis.lockgraph import make_lock
 from ..api.objects import Task
 from ..api.specs import NodeDescription, Platform, Resources
 from .exec import ExitStatus, FatalError
@@ -26,6 +27,8 @@ class FakeController:
     def prepare(self):
         if self.behavior.get("fail_prepare"):
             raise FatalError("prepare failed (injected)")
+        # simulated executor work duration (test harness behavior knob,
+        # not a retry loop)  # lint: allow(ad-hoc-sleep)
         time.sleep(self.behavior.get("prepare_time", 0))
 
     def start(self):
@@ -81,7 +84,7 @@ class FakeExecutor:
         self.behavior_for = behavior_for if behavior_for is not None else {}
         self.hostname = hostname
         self.controllers: list[FakeController] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock('agent.testutils.lock')
 
     def describe(self) -> NodeDescription:
         return NodeDescription(

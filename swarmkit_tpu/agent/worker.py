@@ -15,6 +15,7 @@ import threading
 import time
 from typing import Callable
 
+from ..analysis.lockgraph import make_lock
 from ..api.objects import Task, TaskStatus
 from ..api.specs import deepcopy_spec
 from ..api.types import TaskState
@@ -39,7 +40,7 @@ class DependencyStore:
     def __init__(self):
         self._secrets: dict[str, object] = {}
         self._configs: dict[str, object] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock('agent.worker.dependency_store')
 
     def update_secret(self, secret):
         with self._lock:
@@ -105,7 +106,7 @@ class TaskManager(threading.Thread):
         self.task = task
         self.controller = controller
         self.report = report
-        self._lock = threading.Lock()
+        self._lock = make_lock('agent.worker.taskmanager')
         self._halt = threading.Event()
         self._poke = threading.Event()
         self._shutdown_requested = False
@@ -190,7 +191,7 @@ class Worker:
                 inspect.signature(executor.controller).parameters
         except (TypeError, ValueError):
             self._controller_takes_deps = False
-        self._lock = threading.Lock()
+        self._lock = make_lock('agent.worker.worker')
         self._load_state()
 
     # ------------------------------------------------------------ assignment

@@ -17,6 +17,7 @@ from __future__ import annotations
 import logging
 import threading
 
+from ..analysis.lockgraph import make_lock
 from ..api.objects import (
     EventCreate,
     EventDelete,
@@ -59,7 +60,7 @@ class PortAllocator:
     def __init__(self):
         self._allocated: dict[tuple[str, int], str] = {}  # (proto, port) -> service
         self._next_dynamic = DYNAMIC_PORT_START
-        self._lock = threading.Lock()
+        self._lock = make_lock('allocator.allocator.lock')
 
     def allocate(self, service_id: str, ports) -> bool:
         """Resolve published_port==0 to a dynamic port; refuse conflicts."""

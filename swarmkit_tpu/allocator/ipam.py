@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import ipaddress
 import threading
+from ..analysis.lockgraph import make_lock
 
 
 class IPAMError(Exception):
@@ -79,7 +80,7 @@ class IPAM:
 
     def __init__(self):
         self._pools: dict[str, _Pool] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock('allocator.ipam.lock')
 
     # ------------------------------------------------------------ networks
     def add_network(self, net_id: str,

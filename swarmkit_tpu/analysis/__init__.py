@@ -1,0 +1,18 @@
+"""Static/dynamic analysis plane (ISSUE 8): machine-checked CLAUDE.md
+invariants.
+
+Three parts (docs/static_analysis.md):
+
+  * `lint`      — AST invariant rules over the tree (pragma-suppressable)
+  * `mirror`    — mirrored-tick protocol drift checker (TickPipeline vs
+                  Scheduler._tick_pipelined against a checked-in table)
+  * `lockgraph` — runtime lock-order detector (armable; the factory seam
+                  every threading.Lock/RLock site routes through)
+
+Run standalone over the tree:  python -m swarmkit_tpu.analysis
+Tier-1 entry:                  tests/test_lint_clean.py
+
+Kept import-light on purpose: `lockgraph` is imported at module scope by
+nearly every package in the tree (the lock factory), so this __init__
+must never pull jax-adjacent code.
+"""

@@ -1,0 +1,50 @@
+"""CLI entry: `python -m swarmkit_tpu.analysis [--print-protocol] [ROOT]`.
+
+Exit 0 when the tree is clean (lint findings modulo pragmas == 0 and
+both tick mirrors match the checked-in protocol table); exit 1 with one
+finding per line otherwise. `--print-protocol` prints the freshly
+extracted mirror table in checked-in form (the re-record flow after a
+conscious both-mirror change).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from . import lint, mirror
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m swarmkit_tpu.analysis")
+    ap.add_argument("root", nargs="?", default=None,
+                    help="repo root (default: auto-detect from package)")
+    ap.add_argument("--print-protocol", action="store_true",
+                    help="print the extracted mirror protocol table "
+                         "(paste into analysis/mirror.py EXPECTED)")
+    args = ap.parse_args(argv)
+
+    root = Path(args.root) if args.root else \
+        Path(__file__).resolve().parents[2]
+    if args.print_protocol:
+        print(mirror.record(root))
+        return 0
+
+    failed = False
+    findings = lint.lint_tree(root)
+    for f in findings:
+        print(f.render())
+    if findings:
+        failed = True
+    drift = mirror.check_drift(root)
+    print(drift.render())
+    if not drift.clean:
+        failed = True
+    if not findings:
+        print(f"lint: clean ({len(lint.RULES)} rules over "
+              "swarmkit_tpu/ + tests/)")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,530 @@
+"""AST invariant lint: CLAUDE.md contracts compiled into machine-checked
+rules.
+
+Each rule encodes one convention-only invariant that has already bitten
+(or nearly bitten) a past round — the axon 2D-scatter-add bug, the
+`rpc/services.py` ad-hoc retry loop, the ambient-mesh entry rule, the
+donation-set pin, the failpoint/trace decision-boundary discipline, the
+copy-before-mutate store contract, the no-int64-in-kernels rule, and the
+lock-factory seam the runtime detector (lockgraph.py) depends on.
+
+Suppression is per-line and per-rule:
+
+    x = y.at[rows].add(delta)   # lint: allow(scatter-2d) probed-safe: ...
+
+A pragma on the flagged line or the line directly above it silences
+exactly the named rule(s); `# lint: allow(rule-a, rule-b)` names several.
+Every allow is expected to carry a justification in the same comment —
+the pragma names WHAT is silenced, the prose says WHY it is safe.
+
+Run as a tier-1 test (tests/test_lint_clean.py: the tree must be clean
+modulo pragmas) and standalone:
+
+    python -m swarmkit_tpu.analysis          # lint + mirror drift check
+
+Adding a rule: subclass Rule, set `name` / `invariant` / `applies()`,
+yield Findings from `check()`, append to RULES, add a must-fire and a
+must-not-fire fixture to tests/test_analysis.py, and document it in
+docs/static_analysis.md.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterable, Iterator
+
+PRAGMA_RE = re.compile(r"#\s*lint:\s*allow\(([a-z0-9_\-,\s]+)\)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative posix path
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class Module:
+    """One parsed source file + its pragma map."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path                      # relative posix
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        # line -> frozenset(rule names allowed on that line)
+        self.allows: dict[int, frozenset[str]] = {}
+        # lines that are comment-only: the ONLY form whose pragma also
+        # covers the following line — a trailing pragma on a CODE line
+        # must not spill onto its neighbor
+        self._comment_only: set[int] = set()
+        for i, text in enumerate(source.splitlines(), start=1):
+            m = PRAGMA_RE.search(text)
+            if m:
+                rules = frozenset(
+                    r.strip() for r in m.group(1).split(",") if r.strip())
+                self.allows[i] = rules
+                if text.lstrip().startswith("#"):
+                    self._comment_only.add(i)
+
+    def allowed(self, rule: str, line: int) -> bool:
+        """Pragma on the flagged line, or on a comment-only line
+        directly above it."""
+        if rule in self.allows.get(line, ()):
+            return True
+        return (line - 1 in self._comment_only
+                and rule in self.allows.get(line - 1, ()))
+
+
+class Rule:
+    name: str = ""
+    invariant: str = ""      # the CLAUDE.md contract this rule enforces
+
+    def applies(self, path: str) -> bool:
+        return True
+
+    def check(self, mod: Module) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, mod: Module, node: ast.AST, msg: str) -> Finding:
+        return Finding(self.name, mod.path, getattr(node, "lineno", 0), msg)
+
+
+def _attr_chain(node: ast.AST) -> str:
+    """Dotted name for Name/Attribute chains ('jax.sharding.set_mesh');
+    '' for anything dynamic."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _walk_with_parents(tree: ast.AST) -> Iterator[tuple[ast.AST, list]]:
+    """Yield (node, ancestor_stack) — ancestors outermost-first."""
+    stack: list[ast.AST] = []
+
+    def rec(node):
+        yield node, stack
+        stack.append(node)
+        for child in ast.iter_child_nodes(node):
+            yield from rec(child)
+        stack.pop()
+
+    yield from rec(tree)
+
+
+# --------------------------------------------------------------------- rules
+class Scatter2DRule(Rule):
+    """The axon backend's 2D scatter-add silently corrupts above ~512
+    updates (CLAUDE.md): kernel code must use FLAT 1D index scatters."""
+
+    name = "scatter-2d"
+    invariant = ("x.at[r, c].add(d) is WRONG on the axon backend above "
+                 "~512 updates — use flat.at[r * N + c].add(d) "
+                 "(ops/reconcile.py task_count_flat)")
+
+    def applies(self, path: str) -> bool:
+        return path.startswith(("swarmkit_tpu/ops/", "swarmkit_tpu/models/",
+                                "swarmkit_tpu/parallel/"))
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "add"):
+                continue
+            sub = node.func.value
+            if not (isinstance(sub, ast.Subscript)
+                    and isinstance(sub.value, ast.Attribute)
+                    and sub.value.attr == "at"):
+                continue
+            if isinstance(sub.slice, ast.Tuple):
+                yield self.finding(
+                    mod, node,
+                    "multi-axis .at[...].add(...) scatter-add — flat-1D "
+                    "only (the axon 2D scatter-add bug)")
+
+
+class AdHocSleepRule(Rule):
+    """Caller-side waits are explicit Backoff policies / Clock timers —
+    no new ad-hoc sleep loops (PR 3 contract)."""
+
+    name = "ad-hoc-sleep"
+    invariant = ("retries/waits go through utils/backoff.py Backoff or "
+                 "utils/clock.py Clock (clock-injectable, test-"
+                 "deterministic) — never bare time.sleep")
+
+    ALLOWED = (
+        "swarmkit_tpu/utils/backoff.py",     # the policy seam itself
+        "swarmkit_tpu/utils/clock.py",       # the Clock seam
+        "swarmkit_tpu/utils/failpoints.py",  # armed-only injected latency
+        "swarmkit_tpu/cmd/",                 # CLI entrypoints (human pacing)
+    )
+
+    def applies(self, path: str) -> bool:
+        return (path.startswith("swarmkit_tpu/")
+                and not path.startswith(self.ALLOWED))
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if chain.split(".")[0] in ("backoff", "_backoff"):
+                continue      # the clock-driven seam itself
+            if chain.endswith(".sleep") or chain == "sleep":
+                yield self.finding(
+                    mod, node,
+                    f"bare {chain or 'sleep'}() — use a utils/backoff.py "
+                    "Backoff policy or a utils/clock.py timer")
+
+
+class AmbientMeshRule(Rule):
+    """Ambient-mesh entry is parallel/mesh.py mesh_context() ONLY —
+    jax.sharding.set_mesh/use_mesh vary across jax versions."""
+
+    name = "ambient-mesh"
+    invariant = ("every ambient-mesh entry goes through "
+                 "parallel.mesh.mesh_context (set_mesh -> use_mesh -> "
+                 "Mesh ctx fallback), never jax.sharding directly")
+
+    def applies(self, path: str) -> bool:
+        return (path.startswith("swarmkit_tpu/")
+                and path != "swarmkit_tpu/parallel/mesh.py")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Attribute)
+                    and node.attr in ("set_mesh", "use_mesh")):
+                yield self.finding(
+                    mod, node,
+                    f".{node.attr} outside parallel/mesh.py — use "
+                    "parallel.mesh.mesh_context()")
+
+
+class DonatePinnedRule(Rule):
+    """Donation sets in kernel jits are pinned to the 8 STATE arrays."""
+
+    name = "donate-pinned"
+    invariant = ("every donate_argnums in ops/ must be the "
+                 "DONATE_STATE_ARGNUMS constant — donating a group-table "
+                 "position would hand the kernel invalidated buffers on "
+                 "a _gcache hit")
+
+    def applies(self, path: str) -> bool:
+        return path.startswith(("swarmkit_tpu/ops/", "swarmkit_tpu/models/",
+                                "swarmkit_tpu/parallel/"))
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            for kw in node.keywords:
+                if kw.arg != "donate_argnums":
+                    continue
+                if not (isinstance(kw.value, ast.Name)
+                        and kw.value.id == "DONATE_STATE_ARGNUMS"):
+                    yield self.finding(
+                        mod, kw.value,
+                        "donate_argnums must be DONATE_STATE_ARGNUMS "
+                        "(the 8 STATE arrays; group tables are cached "
+                        "and must never be donated)")
+
+
+class SpanInLoopRule(Rule):
+    """Trace/failpoint sites live at decision boundaries, never inside
+    per-entry hot loops; per-entry span emission must be guarded by the
+    trace.enabled() pattern so the disarmed cost stays one truthiness
+    test (CLAUDE.md trace-plane contract)."""
+
+    name = "span-in-loop"
+    invariant = ("no trace.span/start/rec/event or failpoints.fp* call "
+                 "inside a for/while body in the audited hot modules "
+                 "unless under an `if trace.enabled()` / `if traced:` "
+                 "guard")
+
+    AUDITED = (
+        "swarmkit_tpu/ops/pipeline.py",
+        "swarmkit_tpu/ops/commit.py",
+        "swarmkit_tpu/ops/resident.py",
+        "swarmkit_tpu/scheduler/scheduler.py",
+        "swarmkit_tpu/scheduler/batch.py",
+        "swarmkit_tpu/scheduler/encode.py",
+        "swarmkit_tpu/raft/node.py",
+        "swarmkit_tpu/raft/storage.py",
+        "swarmkit_tpu/dispatcher/dispatcher.py",
+        "swarmkit_tpu/dispatcher/heartbeat.py",
+        "swarmkit_tpu/rpc/wire.py",
+        "swarmkit_tpu/rpc/server.py",
+        "swarmkit_tpu/rpc/client.py",
+    )
+    TRACE_CALLS = frozenset({"span", "start", "rec", "event", "wrap"})
+    FP_CALLS = frozenset({"fp", "fp_value", "fp_transform"})
+
+    def applies(self, path: str) -> bool:
+        return path in self.AUDITED
+
+    @staticmethod
+    def _guarded(ancestors: list, loop_idx: int) -> bool:
+        """True when an If between the innermost loop and the call tests
+        the armed state (`if traced:` / `if trace.enabled():`)."""
+        for anc in ancestors[loop_idx + 1:]:
+            if not isinstance(anc, ast.If):
+                continue
+            for n in ast.walk(anc.test):
+                if isinstance(n, ast.Name) and n.id == "traced":
+                    return True
+                if isinstance(n, ast.Attribute) and n.attr == "enabled":
+                    return True
+        return False
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        for node, ancestors in _walk_with_parents(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            base = node.func.value
+            base_name = base.id if isinstance(base, ast.Name) else ""
+            is_site = (
+                (base_name == "trace"
+                 and node.func.attr in self.TRACE_CALLS)
+                or (base_name == "failpoints"
+                    and node.func.attr in self.FP_CALLS))
+            if not is_site:
+                continue
+            # innermost enclosing loop that is inside the same function
+            # as the call (a call in a nested def is NOT "in" an outer
+            # function's loop — the def body runs elsewhere)
+            loop_idx = None
+            for i in range(len(ancestors) - 1, -1, -1):
+                anc = ancestors[i]
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)):
+                    break
+                if isinstance(anc, (ast.For, ast.While)):
+                    loop_idx = i
+                    break
+            if loop_idx is None:
+                continue
+            if self._guarded(ancestors, loop_idx):
+                continue
+            yield self.finding(
+                mod, node,
+                f"{base_name}.{node.func.attr} inside a loop body — "
+                "hot-path sites live at decision boundaries; per-entry "
+                "emission needs the `if trace.enabled():` guard")
+
+
+class CopyBeforeMutateRule(Rule):
+    """Store objects are live references: mutate a COPY inside write
+    transactions (CLAUDE.md store contract)."""
+
+    name = "copy-before-mutate"
+    invariant = ("a store-getter result (tx.get_*) is a live reference "
+                 "shared with every reader — `.copy()` before mutating "
+                 "in a transaction")
+
+    GETTERS = frozenset({
+        "get_node", "get_task", "get_service", "get_cluster",
+        "get_network", "get_secret", "get_config", "get_volume",
+        "get_extension", "get_resource", "get_member",
+    })
+
+    def applies(self, path: str) -> bool:
+        return path.startswith("swarmkit_tpu/")
+
+    @staticmethod
+    def _base_name(node: ast.AST) -> str:
+        while isinstance(node, ast.Attribute):
+            node = node.value
+        return node.id if isinstance(node, ast.Name) else ""
+
+    def _scan_body(self, mod: Module, fn: ast.AST) -> Iterator[Finding]:
+        """Linear pass over one function body (nested defs handled by
+        their own pass): taint names bound to `tx.get_*(...)`, clear on
+        `v = v.copy()` (any re-binding clears), flag attribute writes
+        through a tainted base."""
+        tainted: set[str] = set()
+
+        def expr_is_getter(value) -> bool:
+            return (isinstance(value, ast.Call)
+                    and isinstance(value.func, ast.Attribute)
+                    and value.func.attr in self.GETTERS
+                    and isinstance(value.func.value, ast.Name)
+                    and value.func.value.id == "tx")
+
+        for node, ancestors in _walk_with_parents(fn):
+            if node is fn:
+                continue
+            # don't descend into nested functions: their bodies get
+            # their own scan with their own taint set
+            if any(isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda))
+                   for a in ancestors[ancestors.index(fn) + 1:]):
+                continue
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        if expr_is_getter(node.value):
+                            tainted.add(tgt.id)
+                        else:
+                            tainted.discard(tgt.id)
+                    elif isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                        base = self._base_name(tgt)
+                        if isinstance(tgt, ast.Attribute) \
+                                and base in tainted:
+                            yield self.finding(
+                                mod, tgt,
+                                f"attribute write on {base!r} (a live "
+                                "tx.get_* result) — .copy() before "
+                                "mutating (store objects are shared "
+                                "references)")
+            elif isinstance(node, ast.AugAssign) \
+                    and isinstance(node.target, ast.Attribute):
+                base = self._base_name(node.target)
+                if base in tainted:
+                    yield self.finding(
+                        mod, node.target,
+                        f"augmented write on {base!r} (a live tx.get_* "
+                        "result) — .copy() before mutating")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._scan_body(mod, node)
+
+
+class Int64InKernelRule(Rule):
+    """int64 is unavailable in kernels (no x64 on the TPU backend)."""
+
+    name = "int64-in-kernel"
+    invariant = ("kernel modules never touch int64 — jnp has no x64 "
+                 "here; host-side staging arrays live outside these "
+                 "modules")
+
+    KERNEL_MODULES = (
+        "swarmkit_tpu/ops/placement.py",
+        "swarmkit_tpu/ops/reconcile.py",
+        "swarmkit_tpu/ops/bitpack.py",
+        "swarmkit_tpu/ops/raft_replay.py",
+        "swarmkit_tpu/models/cluster_step.py",
+    )
+
+    def applies(self, path: str) -> bool:
+        return path in self.KERNEL_MODULES
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Attribute) and node.attr == "int64":
+                yield self.finding(
+                    mod, node,
+                    "int64 in a kernel module — kernels run without x64; "
+                    "use int32 (jnp.argsort is stable for tie-breaks)")
+
+
+class RawLockRule(Rule):
+    """Lock creation routes through the lockgraph factory seam so the
+    armed lock-order detector sees every acquisition."""
+
+    name = "raw-lock"
+    invariant = ("threading.Lock()/RLock() sites go through "
+                 "analysis.lockgraph.make_lock/make_rlock — the factory "
+                 "is what lets the armed detector shim acquisition "
+                 "order; disarmed it returns the plain primitive")
+
+    def applies(self, path: str) -> bool:
+        return (path.startswith("swarmkit_tpu/")
+                and not path.startswith("swarmkit_tpu/analysis/"))
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            # `from threading import Lock` would let a bare Lock() call
+            # bypass the dotted-form check below — flag the import, the
+            # only gateway to that spelling
+            if isinstance(node, ast.ImportFrom) \
+                    and node.module == "threading":
+                for alias in node.names:
+                    if alias.name in ("Lock", "RLock"):
+                        yield self.finding(
+                            mod, node,
+                            f"`from threading import {alias.name}` — a "
+                            "bare call would bypass the lockgraph "
+                            "factory seam; import threading and route "
+                            "through analysis.lockgraph")
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if chain in ("threading.Lock", "threading.RLock"):
+                kind = chain.rsplit(".", 1)[1]
+                factory = "make_lock" if kind == "Lock" else "make_rlock"
+                yield self.finding(
+                    mod, node,
+                    f"bare threading.{kind}() — route through "
+                    f"analysis.lockgraph.{factory}(name) so the armed "
+                    "lock-order detector can track it")
+
+
+RULES: tuple[Rule, ...] = (
+    Scatter2DRule(),
+    AdHocSleepRule(),
+    AmbientMeshRule(),
+    DonatePinnedRule(),
+    SpanInLoopRule(),
+    CopyBeforeMutateRule(),
+    Int64InKernelRule(),
+    RawLockRule(),
+)
+
+
+# -------------------------------------------------------------------- driver
+def lint_source(source: str, path: str,
+                rules: Iterable[Rule] = RULES) -> list[Finding]:
+    """Lint one in-memory source blob (the fixture-test entrypoint)."""
+    mod = Module(path, source)
+    out: list[Finding] = []
+    for rule in rules:
+        if not rule.applies(path):
+            continue
+        for f in rule.check(mod):
+            if not mod.allowed(rule.name, f.line):
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+def iter_py_files(root: Path, subdirs: Iterable[str]) -> Iterator[Path]:
+    for sub in subdirs:
+        base = root / sub
+        if not base.exists():
+            continue
+        for p in sorted(base.rglob("*.py")):
+            if "__pycache__" in p.parts:
+                continue
+            yield p
+
+
+def lint_tree(root: Path, subdirs=("swarmkit_tpu", "tests"),
+              rules: Iterable[Rule] = RULES) -> list[Finding]:
+    """Lint the repo tree. `root` is the repo root; paths in findings
+    are repo-relative posix (what `applies()` matches on)."""
+    findings: list[Finding] = []
+    for p in iter_py_files(root, subdirs):
+        rel = p.relative_to(root).as_posix()
+        try:
+            source = p.read_text()
+        except (OSError, UnicodeDecodeError):   # unreadable: not lintable
+            continue
+        try:
+            findings.extend(lint_source(source, rel, rules))
+        except SyntaxError:
+            findings.append(Finding(
+                "parse-error", rel, 0, "file does not parse"))
+    return findings

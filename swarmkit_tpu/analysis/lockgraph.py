@@ -1,0 +1,321 @@
+"""Runtime lock-order detector: the dynamic third of the analysis plane.
+
+The dispatcher-lock-vs-`store.view` deadlock (PR 4, found live) is the
+motivating bug class: two locks acquired in opposite orders on two
+threads deadlock only under the right interleaving, which no AST rule
+can see. This module turns every lock acquisition in an ARMED test run
+into an edge of a global acquisition-order graph and reports
+
+  * cycles — lock A held while acquiring B on one thread, B held while
+    acquiring A on another: a potential deadlock even if this run never
+    interleaved into one;
+  * the specific "dispatcher lock acquired while a `store.view` callback
+    is open" hazard (the PR 4 inversion: RPC paths hold the dispatcher
+    lock ACROSS store.view, so store->dispatcher is the deadly order).
+
+Discipline mirrors utils/failpoints.py / utils/trace.py exactly:
+
+  * DISARMED cost is one module-global truthiness test. `make_lock()`
+    and `make_rlock()` return a *plain* `threading.Lock`/`RLock` when
+    `_STATE` is None — production acquires stay native C, zero wrapper
+    allocations (bench.py's `lint_plane` row pins this).
+  * Armed per-test via `armed()`/`arm()`/`disarm()`; the conftest arms
+    the daemon/dispatcher/chaos tiers and FAILS tests that leak an
+    armed detector.
+  * Locks created while disarmed stay plain forever (module-global
+    registry locks, import-time singletons): the detector covers locks
+    created inside the armed window, which per-test arming makes the
+    entire object graph under test.
+
+Edges are keyed by lock *instance*, not name — three raft nodes in one
+process each own a storage lock named "raft.storage", and node A's
+storage held while touching node B's transport is a same-name edge that
+is NOT a self-deadlock. A cycle among concrete instances is a genuine
+inversion. Names label the report.
+
+The detector's own bookkeeping takes only a private leaf lock (edge-set
+mutation) and thread-local held-stacks — it can never participate in a
+cycle it would report.
+
+See docs/static_analysis.md for the arming contract and rule table.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+# None = disarmed (the ONE module-global truthiness test on every
+# factory call and view hook); a _GraphState while armed.
+_STATE: "_GraphState | None" = None
+_ARM_LOCK = threading.Lock()
+
+# Lock names whose acquisition inside an open store.view callback is a
+# known deadlock hazard (the PR 4 inversion). Extend via arm(hazard_names=).
+DEFAULT_HAZARD_NAMES = frozenset({"dispatcher.lock"})
+
+
+@dataclass
+class Edge:
+    """held -> acquired, witnessed on `thread` (first witness kept)."""
+
+    held_id: int
+    held_name: str
+    acq_id: int
+    acq_name: str
+    thread: str
+
+
+@dataclass
+class Report:
+    cycles: list = field(default_factory=list)    # [[name, ...], ...]
+    hazards: list = field(default_factory=list)   # [str, ...]
+    edges: int = 0
+    locks: int = 0
+
+    @property
+    def clean(self) -> bool:
+        return not self.cycles and not self.hazards
+
+    def render(self) -> str:
+        if self.clean:
+            return (f"lockgraph: clean ({self.locks} locks, "
+                    f"{self.edges} order edges)")
+        out = []
+        for cyc in self.cycles:
+            out.append("lock-order cycle: " + " -> ".join(cyc))
+        out.extend(self.hazards)
+        return "\n".join(out)
+
+
+class _GraphState:
+    """One armed session: the acquisition-order graph + hazard log."""
+
+    def __init__(self, hazard_names=DEFAULT_HAZARD_NAMES):
+        self.hazard_names = frozenset(hazard_names)
+        self._mu = threading.Lock()             # leaf: guards the sets below
+        self._edges: dict[tuple[int, int], Edge] = {}
+        self._locks: dict[int, str] = {}        # id(tracked) -> name
+        self._keep: list = []                   # strong refs: ids stay unique
+        self._hazards: list[str] = []
+        self._tls = threading.local()
+
+    # ---------------------------------------------------------- per-thread
+    def _held(self) -> list:
+        h = getattr(self._tls, "held", None)
+        if h is None:
+            h = self._tls.held = []
+        return h
+
+    def _view_depth(self) -> int:
+        return getattr(self._tls, "views", 0)
+
+    def view_enter(self) -> None:
+        self._tls.views = self._view_depth() + 1
+
+    def view_exit(self) -> None:
+        self._tls.views = max(0, self._view_depth() - 1)
+
+    # ------------------------------------------------------------- recording
+    def register(self, lock: "_TrackedLock") -> None:
+        with self._mu:
+            self._locks[id(lock)] = lock.name
+            self._keep.append(lock)
+
+    def on_acquired(self, lock: "_TrackedLock") -> None:
+        """Called AFTER the inner lock is held (first acquisition only
+        for RLocks)."""
+        held = self._held()
+        if lock.name in self.hazard_names and self._view_depth() > 0:
+            tname = threading.current_thread().name
+            with self._mu:
+                self._hazards.append(
+                    f"hazard: {lock.name!r} acquired inside an open "
+                    f"store.view callback (thread {tname}) — the PR 4 "
+                    f"dispatcher/store inversion")
+        if held:
+            tname = threading.current_thread().name
+            with self._mu:
+                for h in held:
+                    key = (id(h), id(lock))
+                    if key not in self._edges:
+                        self._edges[key] = Edge(
+                            id(h), h.name, id(lock), lock.name, tname)
+        held.append(lock)
+
+    def on_released(self, lock: "_TrackedLock") -> None:
+        held = self._held()
+        # out-of-order release is legal (hand-over-hand): drop the last
+        # occurrence, not necessarily the top
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+
+    # --------------------------------------------------------------- report
+    def report(self) -> Report:
+        with self._mu:
+            edges = list(self._edges.values())
+            names = dict(self._locks)
+            hazards = list(self._hazards)
+        adj: dict[int, set[int]] = {}
+        for e in edges:
+            adj.setdefault(e.held_id, set()).add(e.acq_id)
+        cycles = []
+        seen_cycles = set()
+        # iterative DFS with color marking; a back edge closes a cycle
+        color: dict[int, int] = {}          # 0 absent/white, 1 grey, 2 black
+        for root in list(adj):
+            if color.get(root):
+                continue
+            stack = [(root, iter(sorted(adj.get(root, ()))))]
+            color[root] = 1
+            path = [root]
+            while stack:
+                node, it = stack[-1]
+                advanced = False
+                for nxt in it:
+                    c = color.get(nxt, 0)
+                    if c == 1:              # back edge: cycle
+                        cyc = path[path.index(nxt):] + [nxt]
+                        key = frozenset(cyc)
+                        if key not in seen_cycles:
+                            seen_cycles.add(key)
+                            cycles.append(
+                                [names.get(i, f"lock@{i:#x}") for i in cyc])
+                    elif c == 0:
+                        color[nxt] = 1
+                        stack.append((nxt, iter(sorted(adj.get(nxt, ())))))
+                        path.append(nxt)
+                        advanced = True
+                        break
+                if not advanced:
+                    color[node] = 2
+                    stack.pop()
+                    path.pop()
+        return Report(cycles=cycles, hazards=hazards,
+                      edges=len(edges), locks=len(names))
+
+
+class _TrackedLock:
+    """Context-manager wrapper around a real Lock/RLock. Only the FIRST
+    acquisition / LAST release of a reentrant lock records (inner depth
+    tracked per thread); the inner primitive still provides the actual
+    mutual exclusion, so tracked and plain locks are interchangeable.
+
+    Known blind spot (documented, like the Condition one in
+    docs/static_analysis.md): a plain Lock used as a CROSS-THREAD gate
+    (acquire on thread A, release on thread B — legal for Lock) would
+    leave the gate on A's held-stack and record phantom order edges.
+    Every site in this tree uses `with`, which cannot split threads; if
+    a gate pattern ever appears, use threading.Event or teach this
+    class owner tracking first."""
+
+    __slots__ = ("_inner", "name", "_state", "_reentrant", "_depth")
+
+    def __init__(self, inner, name: str, state: _GraphState,
+                 reentrant: bool):
+        self._inner = inner
+        self.name = name
+        self._state = state
+        self._reentrant = reentrant
+        self._depth = threading.local()
+        state.register(self)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            d = getattr(self._depth, "n", 0)
+            self._depth.n = d + 1
+            if d == 0:
+                self._state.on_acquired(self)
+        return ok
+
+    def release(self) -> None:
+        d = getattr(self._depth, "n", 1) - 1
+        self._depth.n = d
+        if d == 0:
+            self._state.on_released(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+# ----------------------------------------------------------------- factory
+def make_lock(name: str = "lock"):
+    """The factory seam every `threading.Lock()` site in swarmkit_tpu
+    routes through (lint rule `raw-lock` enforces it). Disarmed: returns
+    the plain primitive — native-speed acquires, zero extra allocation.
+    `_STATE` is snapshotted ONCE: a disarm racing in from another thread
+    (conftest teardown vs a server thread creating a connection lock)
+    must not hand the constructor a None state."""
+    s = _STATE
+    if s is None:
+        return threading.Lock()
+    return _TrackedLock(threading.Lock(), name, s, reentrant=False)
+
+
+def make_rlock(name: str = "rlock"):
+    s = _STATE
+    if s is None:
+        return threading.RLock()
+    return _TrackedLock(threading.RLock(), name, s, reentrant=True)
+
+
+# --------------------------------------------------------------- view hook
+def view_enter() -> None:
+    """store/memory.py calls these around a view callback (guarded by
+    `if lockgraph._STATE is not None` — the disarmed truthiness test)."""
+    s = _STATE
+    if s is not None:
+        s.view_enter()
+
+
+def view_exit() -> None:
+    s = _STATE
+    if s is not None:
+        s.view_exit()
+
+
+# ----------------------------------------------------------------- arming
+def arm(hazard_names=DEFAULT_HAZARD_NAMES) -> _GraphState:
+    global _STATE
+    with _ARM_LOCK:
+        _STATE = _GraphState(hazard_names)
+        return _STATE
+
+
+def disarm() -> None:
+    global _STATE
+    with _ARM_LOCK:
+        _STATE = None
+
+
+def active() -> bool:
+    return _STATE is not None
+
+
+def report() -> Report:
+    """Report for the CURRENT armed session (empty Report if disarmed)."""
+    s = _STATE
+    return s.report() if s is not None else Report()
+
+
+@contextmanager
+def armed(hazard_names=DEFAULT_HAZARD_NAMES):
+    """`with lockgraph.armed() as state: ...` — always disarms on exit;
+    the caller asserts on `state.report()`."""
+    s = arm(hazard_names)
+    try:
+        yield s
+    finally:
+        disarm()

@@ -1,0 +1,352 @@
+"""Mirrored-implementation drift checker.
+
+The pipelined tick protocol lives in TWO implementations that must
+change in lockstep (CLAUDE.md async-commit invariant): the reusable
+`TickPipeline` (ops/pipeline.py) and the production
+`Scheduler._tick_pipelined` (scheduler/scheduler.py). A barrier moved,
+a poison dropped, or a drain trigger added in one mirror and not the
+other is exactly the class of bug convention alone has to catch today.
+
+This module extracts, from each mirror's AST, the lexically-ordered
+sequence of PROTOCOL calls — the barrier/pull/fold/poison/restamp/
+submit/encode/dispatch vocabulary — normalized to a shared canonical
+event language, and diffs it against the checked-in expected table
+below. A change landing in one mirror fails `tests/test_lint_clean.py`
+with a readable unified diff; the author then either updates BOTH
+mirrors or consciously re-records the table (and the diff shows the
+reviewer exactly which protocol step moved).
+
+Lexical order is the contract here, not runtime order: the extraction
+is deterministic, and every protocol-relevant statement in these
+methods executes at most once per trigger, so source order is a
+faithful proxy the test can pin.
+
+Beyond the per-mirror sequences, REQUIRED_COMMON pins the event KINDS
+both mirrors must contain — a one-sided removal of (say) every poison
+call fails even if someone re-records that mirror's table without
+noticing the asymmetry.
+"""
+from __future__ import annotations
+
+import ast
+import difflib
+from dataclasses import dataclass
+from pathlib import Path
+
+# ---------------------------------------------------------------- vocabulary
+# call-name -> canonical event. Keys match either the bare attribute /
+# function name ("fold_counts") or a receiver-qualified form ("h.get")
+# when the bare name is too generic to key on (dict.get, worker.submit).
+_COMMON_VOCAB = {
+    "fold_counts": "fold",
+    "fold_problem": "fold_problem",
+    "after_apply": "after_apply",
+    "invalidate": "invalidate",
+    "needs_full_upload": "needs_full_upload",
+    "restamp_counts": "restamp",
+    "force_numeric_reencode": "poison_rows",
+    "poison_all_numeric": "poison_all",
+    "nodes_clean": "nodes_clean",
+    "encode": "encode",
+    "schedule_async": "dispatch",
+}
+
+PIPELINE_VOCAB = dict(_COMMON_VOCAB, **{
+    "_barrier": "barrier",
+    "_pull_oldest": "pull",
+    "_fold_pulled": "fold_pulled",
+    "_complete": "complete",
+    "_heavy": "commit_heavy",
+    "_commit": "commit_inline",
+    "commit_cb": "commit_cb",
+    "_hazards": "hazard_check",
+    "worker.submit": "submit_heavy",
+    "worker.barrier": "barrier",
+    "finish_pulled": "finish_pulled",
+    "commit_deferred": "commit_deferred",
+    "drain_serial": "drain_serial",
+})
+
+SCHEDULER_VOCAB = dict(_COMMON_VOCAB, **{
+    "worker.barrier": "barrier",
+    "_drain_commit_plane": "barrier",
+    "h.get": "pull",
+    "h2.get": "pull_discard",
+    "_submit_heavy": "submit_heavy",
+    "_commit_heavy": "commit_heavy",
+    "_heal_unclean": "heal_unclean",
+    "_process_preassigned": "preassigned",
+    "_schedule_backlog": "backlog",
+    "materialize_orders": "materialize",
+    "_apply_decisions": "apply_decisions",
+    "_tick_pipelined": "tick_pipelined",
+})
+
+# Event kinds BOTH mirrors must exhibit somewhere in their scope: a
+# one-sided disappearance of any of these is protocol drift even if the
+# per-mirror table is re-recorded to match.
+REQUIRED_COMMON = frozenset({
+    "barrier", "pull", "fold", "after_apply", "invalidate",
+    "poison_rows", "restamp", "submit_heavy", "nodes_clean",
+    "encode", "dispatch",
+})
+
+
+@dataclass(frozen=True)
+class MirrorSpec:
+    key: str
+    path: str                    # repo-relative posix
+    class_name: str
+    methods: tuple               # extraction scope, in this order
+    vocab: dict
+
+
+MIRRORS: tuple[MirrorSpec, ...] = (
+    MirrorSpec(
+        key="tick_pipeline",
+        path="swarmkit_tpu/ops/pipeline.py",
+        class_name="TickPipeline",
+        methods=("tick", "_tick_traced", "_pull_oldest", "_fold_pulled",
+                 "_complete", "_heavy", "_commit", "_barrier", "flush",
+                 "barrier"),
+        vocab=PIPELINE_VOCAB,
+    ),
+    MirrorSpec(
+        key="scheduler_tick",
+        path="swarmkit_tpu/scheduler/scheduler.py",
+        class_name="Scheduler",
+        methods=("_tick_pipelined", "flush_pipeline", "_submit_heavy",
+                 "_commit_heavy", "_drain_commit_plane", "_heal_unclean"),
+        vocab=SCHEDULER_VOCAB,
+    ),
+)
+
+
+def _call_key(node: ast.Call) -> tuple[str, str]:
+    """(qualified, bare) lookup keys for a call node. qualified is
+    'recv.attr' when the receiver is a simple name (possibly through
+    one level of attribute: self.worker.submit -> 'worker.submit')."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id, fn.id
+    if isinstance(fn, ast.Attribute):
+        bare = fn.attr
+        recv = fn.value
+        # self.<x>.attr -> '<x>.attr'; <name>.attr -> '<name>.attr'
+        if isinstance(recv, ast.Attribute) \
+                and isinstance(recv.value, ast.Name) \
+                and recv.value.id == "self":
+            return f"{recv.attr}.{bare}", bare
+        if isinstance(recv, ast.Name):
+            rid = recv.id if recv.id != "self" else ""
+            return (f"{rid}.{bare}" if rid else bare), bare
+        return bare, bare
+    return "", ""
+
+
+def extract_sequence(tree: ast.AST, spec: MirrorSpec) -> list[str]:
+    """['method:event', ...] in lexical order, for spec.methods in the
+    given order. Nested defs inside a method belong to that method
+    (drain_serial & co are part of the tick body's protocol)."""
+    cls = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == spec.class_name:
+            cls = node
+            break
+    if cls is None:
+        raise LookupError(
+            f"{spec.path}: class {spec.class_name} not found")
+    by_name = {n.name: n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    def dfs(node):
+        """Pre-order, source order (ast.walk is BFS — useless for a
+        readable protocol diff)."""
+        yield node
+        for child in ast.iter_child_nodes(node):
+            yield from dfs(child)
+
+    out: list[str] = []
+    for mname in spec.methods:
+        m = by_name.get(mname)
+        if m is None:
+            out.append(f"{mname}:<MISSING METHOD>")
+            continue
+        for node in dfs(m):
+            if not isinstance(node, ast.Call):
+                continue
+            qual, bare = _call_key(node)
+            ev = spec.vocab.get(qual)
+            if ev is None:
+                ev = spec.vocab.get(bare)
+            if ev is not None:
+                out.append(f"{mname}:{ev}")
+    return out
+
+
+def extract_from_source(source: str, spec: MirrorSpec) -> list[str]:
+    return extract_sequence(ast.parse(source, filename=spec.path), spec)
+
+
+# ------------------------------------------------------------ expected table
+# Re-record ONLY together with a conscious review of BOTH mirrors:
+#   python -m swarmkit_tpu.analysis --print-protocol
+# prints the freshly-extracted sequences in checked-in form.
+EXPECTED: dict[str, tuple[str, ...]] = {
+    'tick_pipeline': (
+        '_tick_traced:pull',
+        '_tick_traced:nodes_clean',
+        '_tick_traced:barrier',
+        '_tick_traced:fold_pulled',
+        '_tick_traced:submit_heavy',
+        '_tick_traced:poison_rows',
+        '_tick_traced:invalidate',
+        '_tick_traced:commit_inline',
+        '_tick_traced:barrier',
+        '_tick_traced:commit_deferred',
+        '_tick_traced:finish_pulled',
+        '_tick_traced:commit_inline',
+        '_tick_traced:complete',
+        '_tick_traced:commit_inline',
+        '_tick_traced:nodes_clean',
+        '_tick_traced:drain_serial',
+        '_tick_traced:finish_pulled',
+        '_tick_traced:complete',
+        '_tick_traced:hazard_check',
+        '_tick_traced:drain_serial',
+        '_tick_traced:commit_deferred',
+        '_tick_traced:encode',
+        '_tick_traced:needs_full_upload',
+        '_tick_traced:drain_serial',
+        '_tick_traced:encode',
+        '_tick_traced:dispatch',
+        '_tick_traced:commit_deferred',
+        '_fold_pulled:fold_problem',
+        '_fold_pulled:invalidate',
+        '_fold_pulled:fold',
+        '_fold_pulled:invalidate',
+        '_fold_pulled:after_apply',
+        '_complete:pull',
+        '_complete:fold_pulled',
+        '_heavy:commit_cb',
+        '_heavy:restamp',
+        '_commit:commit_heavy',
+        '_barrier:barrier',
+        '_barrier:barrier',
+        'flush:barrier',
+        'flush:complete',
+        'flush:commit_inline',
+        'barrier:barrier',
+    ),
+    'scheduler_tick': (
+        '_tick_pipelined:nodes_clean',
+        '_tick_pipelined:pull',
+        '_tick_pipelined:barrier',
+        '_tick_pipelined:heal_unclean',
+        '_tick_pipelined:preassigned',
+        '_tick_pipelined:backlog',
+        '_tick_pipelined:preassigned',
+        '_tick_pipelined:preassigned',
+        '_tick_pipelined:pull',
+        '_tick_pipelined:fold',
+        '_tick_pipelined:after_apply',
+        '_tick_pipelined:invalidate',
+        '_tick_pipelined:submit_heavy',
+        '_tick_pipelined:poison_rows',
+        '_tick_pipelined:nodes_clean',
+        '_tick_pipelined:encode',
+        '_tick_pipelined:dispatch',
+        '_tick_pipelined:submit_heavy',
+        '_tick_pipelined:barrier',
+        '_tick_pipelined:backlog',
+        '_tick_pipelined:barrier',
+        '_tick_pipelined:materialize',
+        '_tick_pipelined:apply_decisions',
+        '_tick_pipelined:restamp',
+        '_tick_pipelined:poison_rows',
+        '_tick_pipelined:invalidate',
+        '_tick_pipelined:pull_discard',
+        '_tick_pipelined:backlog',
+        'flush_pipeline:tick_pipelined',
+        'flush_pipeline:barrier',
+        '_submit_heavy:commit_heavy',
+        '_commit_heavy:materialize',
+        '_commit_heavy:apply_decisions',
+        '_commit_heavy:restamp',
+        '_drain_commit_plane:heal_unclean',
+        '_heal_unclean:poison_rows',
+        '_heal_unclean:invalidate',
+        '_heal_unclean:pull_discard',
+    ),
+}
+
+
+@dataclass
+class DriftReport:
+    diffs: dict          # mirror key -> unified diff text (only drifted)
+    missing_common: dict  # mirror key -> sorted missing REQUIRED_COMMON
+
+    @property
+    def clean(self) -> bool:
+        return not self.diffs and not self.missing_common
+
+    def render(self) -> str:
+        if self.clean:
+            return "mirror drift: clean (both tick mirrors match the table)"
+        out = []
+        for key, diff in self.diffs.items():
+            out.append(
+                f"protocol drift in mirror {key!r} — the tick protocol "
+                "lives in TWO implementations (TickPipeline and "
+                "Scheduler._tick_pipelined); land the change in BOTH, "
+                "then re-record with "
+                "`python -m swarmkit_tpu.analysis --print-protocol`:")
+            out.append(diff)
+        for key, missing in self.missing_common.items():
+            out.append(
+                f"mirror {key!r} lost required protocol events: "
+                f"{', '.join(missing)}")
+        return "\n".join(out)
+
+
+def check_drift(root: Path,
+                sources: dict[str, str] | None = None,
+                expected: dict[str, tuple[str, ...]] | None = None,
+                ) -> DriftReport:
+    """Diff each mirror's extracted sequence against the expected table.
+    `sources` overrides file contents per mirror key (fixture tests);
+    `expected` overrides the table (recording flows)."""
+    expected = EXPECTED if expected is None else expected
+    diffs: dict[str, str] = {}
+    missing_common: dict[str, list[str]] = {}
+    for spec in MIRRORS:
+        if sources is not None and spec.key in sources:
+            src = sources[spec.key]
+        else:
+            src = (root / spec.path).read_text()
+        seq = extract_from_source(src, spec)
+        want = list(expected.get(spec.key, ()))
+        if seq != want:
+            diff = "\n".join(difflib.unified_diff(
+                want, seq, fromfile=f"{spec.key} (expected table)",
+                tofile=f"{spec.key} ({spec.path})", lineterm=""))
+            diffs[spec.key] = diff
+        events = {s.split(":", 1)[1] for s in seq}
+        miss = sorted(REQUIRED_COMMON - events)
+        if miss:
+            missing_common[spec.key] = miss
+    return DriftReport(diffs=diffs, missing_common=missing_common)
+
+
+def record(root: Path) -> str:
+    """The checked-in form of the freshly-extracted table (the
+    --print-protocol flow)."""
+    lines = ["EXPECTED: dict[str, tuple[str, ...]] = {"]
+    for spec in MIRRORS:
+        src = (root / spec.path).read_text()
+        seq = extract_from_source(src, spec)
+        lines.append(f"    {spec.key!r}: (")
+        for s in seq:
+            lines.append(f"        {s!r},")
+        lines.append("    ),")
+    lines.append("}")
+    return "\n".join(lines)

@@ -16,6 +16,7 @@ import threading
 import time
 from dataclasses import dataclass
 
+from ..analysis.lockgraph import make_lock
 from ..utils.identity import new_id
 from .certificates import (
     CertIdentity,
@@ -78,7 +79,7 @@ class SecurityConfig:
                  clock=None):
         from ..utils.clock import REAL_CLOCK
 
-        self._lock = threading.Lock()
+        self._lock = make_lock('ca.config.lock')
         self._clock = clock or REAL_CLOCK
         self._root = root
         self._key_pem = key_pem

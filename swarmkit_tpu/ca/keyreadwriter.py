@@ -11,6 +11,7 @@ import base64
 import json
 import os
 import threading
+from ..analysis.lockgraph import make_lock
 
 from cryptography.fernet import Fernet
 
@@ -28,7 +29,7 @@ class KeyReadWriter:
     def __init__(self, path: str, kek: bytes | None = None):
         self.path = path
         self._kek = kek
-        self._lock = threading.Lock()
+        self._lock = make_lock('ca.keyreadwriter.lock')
 
     # file format: JSON {sealed: bool, headers: {..}, key: b64}
     # (the reference uses PEM headers; JSON keeps the same content model

@@ -22,6 +22,7 @@ import os
 import signal
 import threading
 
+from ..analysis.lockgraph import make_lock
 from ..csi.plugin import CSIPlugin, CSIPluginError, VolumeInfo
 from ..csi.wire import CSIPluginServer, PluginCapabilities
 
@@ -35,7 +36,7 @@ class DirectoryPlugin(CSIPlugin):
         self.data_dir = data_dir
         os.makedirs(os.path.join(data_dir, "volumes"), exist_ok=True)
         os.makedirs(os.path.join(data_dir, "published"), exist_ok=True)
-        self._lock = threading.Lock()
+        self._lock = make_lock('cmd.csi_plugin_example.lock')
 
     def _vol_path(self, volume_id: str) -> str:
         return os.path.join(self.data_dir, "volumes", volume_id)

@@ -117,6 +117,7 @@ class VolumeManager:
             def set_info(tx):
                 cur = tx.get_volume(volume_id)
                 if cur is not None and cur.volume_info is None:
+                    cur = cur.copy()
                     cur.volume_info = info
                     tx.update(cur)
 
@@ -150,6 +151,7 @@ class VolumeManager:
                 cur = tx.get_volume(volume_id)
                 if cur is None:
                     return
+                cur = cur.copy()
                 have = {s.node_id for s in cur.publish_status}
                 for n in sorted(missing):
                     if n not in have:
@@ -189,6 +191,7 @@ class VolumeManager:
                 cur = tx.get_volume(volume_id)
                 if cur is None:
                     return
+                cur = cur.copy()
                 keep = []
                 for s in cur.publish_status:
                     res = results.get(s.node_id)

@@ -9,6 +9,7 @@ to plugin sockets; the interface below is that wire surface, and
 from __future__ import annotations
 
 import threading
+from ..analysis.lockgraph import make_lock
 from dataclasses import dataclass, field
 
 
@@ -102,7 +103,7 @@ class FakeCSIPlugin(CSIPlugin):
         self.topology = topology or []
         self.calls: list[tuple] = []
         self.fail_next: set[str] = set()  # op names that fail once
-        self._lock = threading.Lock()
+        self._lock = make_lock('csi.plugin.lock')
         self._serial = 0
 
     def _record(self, op: str, *args):

@@ -13,6 +13,7 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass, field
 
+from ..analysis.lockgraph import make_lock
 from ..api.objects import Volume
 from ..csi.plugin import PENDING_NODE_UNPUBLISH, PENDING_UNPUBLISH
 
@@ -48,7 +49,7 @@ class VolumeSet:
     """volumes.go volumeSet: store-shadowed volume state + reservations."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock('csi.volumes.lock')
         self.volumes: dict[str, Volume] = {}
         self.by_group: dict[str, set[str]] = {}
         self.by_name: dict[str, str] = {}

@@ -28,6 +28,7 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass, field
 
+from ..analysis.lockgraph import make_lock
 from ..api.types import NodeRole
 from .plugin import CSIPlugin, CSIPluginError, VolumeInfo
 
@@ -124,7 +125,7 @@ class RemoteCSIPlugin(CSIPlugin):
         self.info: PluginInfo | None = None
         self.capabilities: PluginCapabilities | None = None
         self._client = None
-        self._lock = threading.Lock()
+        self._lock = make_lock('csi.wire.lock')
 
     # ------------------------------------------------------------ handshake
     def connect(self, timeout: float = 10.0) -> "RemoteCSIPlugin":

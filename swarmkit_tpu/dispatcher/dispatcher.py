@@ -19,6 +19,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from ..analysis.lockgraph import make_rlock
 from ..api.objects import (
     Config,
     EventCommit,
@@ -142,7 +143,7 @@ class Dispatcher:
         self._hb_wheel = HeartbeatWheel(
             granularity=self._wheel_granularity(heartbeat_period),
             clock=self.clock)
-        self._lock = threading.RLock()
+        self._lock = make_rlock('dispatcher.lock')
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         # (task_id, status, reporting node_id)
@@ -1265,7 +1266,9 @@ class Dispatcher:
             for session in sessions:
                 # failpoint `dispatcher.assignments.build`: one session's
                 # build crashes the flush snapshot mid-batch (nothing was
-                # offered yet — the whole dirty set retries)
+                # offered yet — the whole dirty set retries). Per-session
+                # by design: mid-batch is the crash point under test.
+                # lint: allow(span-in-loop)
                 failpoints.fp("dispatcher.assignments.build")
                 driver_refs: list = []
                 views.append((session,

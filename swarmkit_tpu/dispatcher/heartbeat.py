@@ -23,6 +23,7 @@ import threading
 import time
 from typing import Callable, Hashable
 
+from ..analysis.lockgraph import make_lock
 from ..utils import trace
 from ..utils.clock import REAL_CLOCK
 
@@ -36,7 +37,7 @@ class Heartbeat:
         self.on_expire = on_expire
         self.clock = clock or REAL_CLOCK
         self._timer = None
-        self._lock = threading.Lock()
+        self._lock = make_lock('dispatcher.heartbeat.timer')
         self._stopped = False
 
     def start(self):
@@ -90,7 +91,7 @@ class HeartbeatWheel:
             raise ValueError("granularity must be positive")
         self.clock = clock or REAL_CLOCK
         self._granularity = granularity
-        self._lock = threading.Lock()
+        self._lock = make_lock('dispatcher.heartbeat.wheel')
         self._timeout: dict[Hashable, float] = {}
         self._deadline: dict[Hashable, float] = {}
         self._cb: dict[Hashable, Callable[[], None]] = {}

@@ -13,6 +13,7 @@ so one task can never read another's materialized value
 from __future__ import annotations
 
 import threading
+from ..analysis.lockgraph import make_lock
 from typing import Callable, Protocol
 
 
@@ -35,7 +36,7 @@ class DriverRegistry:
 
     def __init__(self):
         self._drivers: dict[str, SecretDriver] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock('drivers.provider.lock')
 
     def register(self, name: str, driver) -> None:
         if callable(driver) and not hasattr(driver, "get"):

@@ -15,6 +15,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from ..analysis.lockgraph import make_lock
 from ..api.objects import EventCreate, EventUpdate, Task
 from ..store import by
 from ..store.watch import Channel, ChannelClosed
@@ -100,7 +101,7 @@ class _Subscription:
 class LogBroker:
     def __init__(self, store):
         self.store = store
-        self._lock = threading.Lock()
+        self._lock = make_lock('logbroker.broker.lock')
         self._subs: dict[str, _Subscription] = {}
         # node_id -> channel of SubscriptionMessage (agent listeners)
         self._listeners: dict[str, Channel] = {}

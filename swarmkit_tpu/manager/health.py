@@ -6,6 +6,7 @@ grpc.health.v1.Health/Check responses.
 from __future__ import annotations
 
 import threading
+from ..analysis.lockgraph import make_lock
 
 SERVING = "SERVING"
 NOT_SERVING = "NOT_SERVING"
@@ -14,7 +15,7 @@ UNKNOWN = "SERVICE_UNKNOWN"
 
 class HealthServer:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock('manager.health.lock')
         self._status: dict[str, str] = {"": SERVING}
 
     def set_serving_status(self, service: str, status: str):

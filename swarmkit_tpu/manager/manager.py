@@ -15,6 +15,7 @@ import logging
 import queue
 import threading
 
+from ..analysis.lockgraph import make_lock
 from ..allocator.allocator import Allocator
 from ..allocator.deallocator import Deallocator
 from ..api.objects import Cluster, Network, RootCAObj
@@ -83,7 +84,7 @@ class Manager:
         self.jax_threshold = jax_threshold
         self.scheduler_pipeline = scheduler_pipeline
         self.scheduler_async_commit = scheduler_async_commit
-        self._lock = threading.Lock()
+        self._lock = make_lock('manager.manager.lock')
         self._is_leader = False
         self._started = False
         # leadership observed before start() is deferred, not lost (the

@@ -10,6 +10,7 @@ from __future__ import annotations
 import threading
 from collections import Counter
 
+from ..analysis.lockgraph import make_lock
 from ..api.objects import (
     ALL_TABLES,
     EventCreate,
@@ -25,7 +26,7 @@ from ..store.watch import ChannelClosed
 class MetricsCollector:
     def __init__(self, store):
         self.store = store
-        self._lock = threading.Lock()
+        self._lock = make_lock('manager.metrics.lock')
         self._objects: Counter = Counter()  # table -> count
         self._node_states: Counter = Counter()  # NodeStatusState name -> count
         self._node_state_by_id: dict[str, str] = {}

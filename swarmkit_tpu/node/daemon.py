@@ -31,6 +31,7 @@ import tempfile
 import threading
 import time
 
+from ..analysis.lockgraph import make_lock
 from ..agent.agent import Agent
 from ..api.types import IssuanceState, NodeRole, NodeStatusState
 from ..ca import (
@@ -94,7 +95,7 @@ def fetch_root_cert(addr: str, expected_digest: str,
     try:
         from ..rpc.wire import REQ, RESP, recv_frame, send_frame
 
-        lock = threading.Lock()
+        lock = make_lock('node.daemon.lock')
         send_frame(sock, lock,
                    [REQ, 1, "ca.get_root_ca_certificate", ((), {})])
         ftype, _sid, head, payload = recv_frame(sock)
@@ -267,7 +268,7 @@ class SwarmNode:
         self.scheduler_async_commit = scheduler_async_commit
         from ..utils.clock import REAL_CLOCK
         self.clock = clock or REAL_CLOCK
-        self._identity_lock = threading.Lock()
+        self._identity_lock = make_lock('node.daemon.identity_lock')
         self._control_server: RPCServer | None = None
 
         self.security: SecurityConfig | None = None
@@ -286,13 +287,13 @@ class SwarmNode:
         self._dispatcher_shim: RemoteDispatcher | None = None
         self._manager_addrs: list[str] = []
         self._role_flip_active = False
-        self._role_flip_lock = threading.Lock()
+        self._role_flip_lock = make_lock('node.daemon.role_flip_lock')
         self._last_session_msg = None
         self._root_renew_active = False
         # state.json is read-merge-written from several threads (promote
         # flips, session plane, refresh loop) — serialize the cycle or a
         # managers write could clobber a just-persisted raft_id
-        self._state_lock = threading.Lock()
+        self._state_lock = make_lock('node.daemon.state_lock')
 
     # ------------------------------------------------------------- identity
 

@@ -37,6 +37,13 @@ from __future__ import annotations
 import json
 import sys
 import threading
+try:
+    from ..analysis.lockgraph import make_lock
+except ImportError:
+    # file-mode load (tests/test_debug_profile.py execs this module
+    # straight from its path so crypto-less environments skip the
+    # package import chain) — the factory is still reachable absolutely
+    from swarmkit_tpu.analysis.lockgraph import make_lock
 import time
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -99,6 +106,8 @@ def profile_dump(seconds: float, interval: float = 0.01) -> str:
         samples += 1
         if time.monotonic() >= deadline:
             break
+        # sampling profiler: the wall-clock pacing IS the sample grid —
+        # not a retry loop  # lint: allow(ad-hoc-sleep)
         time.sleep(interval)
 
     stats = {k: (c, c, leaf.get(k, 0) * interval, c * interval, {})
@@ -211,7 +220,7 @@ class DebugServer:
         host, _, port = addr.rpartition(":")
         self.node = node
         # serializes /debug/trace?seconds=N captures (see _trace)
-        self._trace_window_lock = threading.Lock()
+        self._trace_window_lock = make_lock('node.debugserver.trace_window_lock')
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -327,6 +336,8 @@ class DebugServer:
             if temporary:
                 r = trace.arm()
             try:
+                # operator-requested real-time capture window (not a
+                # retry loop)  # lint: allow(ad-hoc-sleep)
                 time.sleep(seconds)
                 trees = r.trees(seconds=seconds + 0.05)
             finally:

@@ -18,6 +18,7 @@ import json
 import os
 import threading
 
+from ..analysis.lockgraph import make_lock
 from ..agent.agent import Agent
 from ..api.types import IssuanceState, NodeRole, NodeStatusState
 from ..ca import (
@@ -74,7 +75,7 @@ class Node:
         self.broker = ConnectionBroker(Remotes())
         self._stop = threading.Event()
         self._role_thread: threading.Thread | None = None
-        self._manager_lock = threading.Lock()
+        self._manager_lock = make_lock('node.node.manager_lock')
 
     # -- identity persistence (node.go:1202-1286 state.json + cert dir) ----
 

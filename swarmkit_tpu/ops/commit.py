@@ -106,7 +106,10 @@ class CommitWorker:
             try:
                 # failpoint `commit.worker.job`: a worker-side crash at
                 # the job boundary — exercises the poison/heal contract
-                # without reaching into any particular commit stage
+                # without reaching into any particular commit stage. The
+                # run loop iterates per JOB (a whole wave's heavy half),
+                # not per entry — the boundary IS the decision point.
+                # lint: allow(span-in-loop)
                 failpoints.fp("commit.worker.job")
                 job()
             except BaseException as exc:  # noqa: BLE001 — must not kill

@@ -296,6 +296,12 @@ def _schedule_core(
                                   g_spread)
 
         totals = totals + counts
+        # audited vs the axon flat-1D rule (ISSUE 8): g_svc is a SCALAR
+        # per scan step, so this is a single-ROW vector add — row
+        # scatter ops are probed-safe at every size (CLAUDE.md); only
+        # multi-axis .at[r, c].add index scatters corrupt, and the
+        # scatter-2d lint rule fires on exactly that form (no pragma
+        # needed here — adding a tuple index to this line WOULD fire it)
         svc_counts = svc_counts.at[g_svc].add(counts)
         avail = avail - counts[:, None] * g_need[None, :]
         port_used = port_used | (g_ports[None, :] & (counts > 0)[:, None])

@@ -13,6 +13,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from ..analysis.lockgraph import make_lock
 from ..api.objects import Service, Task
 from ..api.types import RestartCondition, TaskState
 from ..store.memory import MemoryStore
@@ -35,7 +36,7 @@ class RestartSupervisor:
         self.store = store
         self._history: dict[tuple[str, int | str], InstanceRestartInfo] = {}
         self._delays: dict[str, threading.Timer] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock('orchestrator.restart.lock')
         self._stopped = False
 
     def stop(self):

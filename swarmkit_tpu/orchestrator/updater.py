@@ -16,6 +16,7 @@ import queue as queue_mod
 import threading
 import time
 
+from ..analysis.lockgraph import make_lock
 from ..api.objects import EventUpdate, Task
 from ..api.specs import deepcopy_spec
 from ..api.types import (
@@ -79,7 +80,7 @@ class Updater(threading.Thread):
         # wedged in its per-slot deadline occupies ONE worker while the
         # others keep rolling; monitor windows overlap everything and
         # failures accrue asynchronously.
-        lock = threading.Lock()
+        lock = make_lock('orchestrator.updater.rollout')
         monitored: dict[str, float] = {}
         failed: set[str] = set()
         counters = {"updated": 0}
@@ -456,7 +457,7 @@ class UpdateSupervisor:
         self.store = store
         self.restart = restart
         self._updaters: dict[str, Updater] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock('orchestrator.updater.supervisor')
 
     def update(self, service, dirty_slots):
         with self._lock:

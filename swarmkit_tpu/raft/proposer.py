@@ -15,6 +15,7 @@ from typing import Callable
 
 import time
 
+from ..analysis.lockgraph import make_lock
 from ..api.objects import Version
 from ..utils import trace
 from ..utils.identity import new_id
@@ -94,7 +95,7 @@ class RaftProposer:
         self.node = node
         self.store = store
         self._pending: dict[str, Callable[[int], None]] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock('raft.proposer.lock')
         node.apply_entry = self._apply_entry
         node.snapshot_state = self._snapshot_state
         node.restore_state = self._restore_state

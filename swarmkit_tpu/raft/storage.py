@@ -42,6 +42,7 @@ except ImportError:                      # container without the wheel:
     class InvalidToken(Exception):       # type: ignore[no-redef]
         pass
 
+from ..analysis.lockgraph import make_lock
 from ..rpc import codec
 from ..utils import failpoints
 from ..utils.metrics import counter_family
@@ -154,7 +155,7 @@ class RaftStorage:
         self.dir = dir
         os.makedirs(dir, exist_ok=True)
         self.sealer = Sealer(dek)
-        self._lock = threading.Lock()
+        self._lock = make_lock('raft.storage.lock')
         self._legacy_wal_path = os.path.join(dir, "wal.jsonl")
         self._snap_path = os.path.join(dir, "snapshot.bin")
         self._hs_path = os.path.join(dir, "hardstate.json")

@@ -12,6 +12,7 @@ import random
 import threading
 from collections import defaultdict
 
+from ..analysis.lockgraph import make_lock
 from .node import Peer, RaftNode
 
 
@@ -23,7 +24,7 @@ class MemoryTransport:
         self.nodes: dict[int, RaftNode] = {}
         self.cut: set[tuple[int, int]] = set()
         self.dropped = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock('raft.testutils.lock')
 
     def register(self, node: RaftNode):
         self.nodes[node.id] = node

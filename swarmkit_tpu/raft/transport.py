@@ -18,6 +18,7 @@ import queue
 import random
 import threading
 
+from ..analysis.lockgraph import make_lock
 from ..rpc.client import RPCClient
 from ..utils import failpoints
 from ..utils.backoff import Backoff
@@ -53,7 +54,7 @@ class NetworkTransport:
         self.clock = clock or REAL_CLOCK
         self.reconnect_policy = reconnect_policy
         self._rng = random.Random()
-        self._lock = threading.Lock()
+        self._lock = make_lock('raft.transport.lock')
         self._outboxes: dict[int, queue.Queue] = {}
         self._threads: dict[int, threading.Thread] = {}
         self._clients: dict[int, RPCClient] = {}

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import random
 import threading
+from ..analysis.lockgraph import make_lock
 
 # remotes.go: DefaultObservationWeight = 10; weights clamp to [-128, 128]
 DEFAULT_OBSERVATION_WEIGHT = 10
@@ -27,7 +28,7 @@ class Remotes:
     Manager objects in-process)."""
 
     def __init__(self, *peers, rng: random.Random | None = None):
-        self._lock = threading.Lock()
+        self._lock = make_lock('remotes.remotes.lock')
         self._weights: dict = {}
         self._rng = rng or random.Random()
         for p in peers:

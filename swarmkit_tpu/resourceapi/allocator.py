@@ -68,6 +68,7 @@ class ResourceAllocator:
                 raise ResourceError("attachment does not belong to this node")
             if t.spec.attachment is None:
                 raise ResourceError(f"task {attachment_id} is not an attachment")
+            t = t.copy()
             t.desired_state = TaskState.REMOVE
             tx.update(t)
 

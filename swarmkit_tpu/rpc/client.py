@@ -14,6 +14,7 @@ import logging
 import ssl
 import threading
 
+from ..analysis.lockgraph import make_lock
 from ..store.watch import Channel
 from ..utils import backoff as _backoff
 from ..utils import trace
@@ -108,9 +109,9 @@ class RPCClient:
         self._security = security
         self._root_cert_pem = root_cert_pem
         self._connect_timeout = connect_timeout
-        self._wlock = threading.Lock()
-        self._lock = threading.Lock()
-        self._dial_lock = threading.Lock()
+        self._wlock = make_lock('rpc.client.wlock')
+        self._lock = make_lock('rpc.client.lock')
+        self._dial_lock = make_lock('rpc.client.dial_lock')
         self._next_id = 1
         self._calls: dict[int, _PendingCall] = {}
         self._streams: dict[int, Channel] = {}

@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import threading
+from ..analysis.lockgraph import make_lock
 
 import msgpack
 
@@ -47,7 +48,7 @@ class _Registry:
         self.by_name: dict[str, type] = {}
         self.by_type: dict[type, str] = {}
         self.fields: dict[str, tuple[str, ...]] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock('rpc.codec.lock')
         self._populated = False
 
     def add(self, cls: type, fields: tuple[str, ...] | None = None):

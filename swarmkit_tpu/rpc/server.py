@@ -22,6 +22,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable
 
+from ..analysis.lockgraph import make_lock
 from ..ca.auth import Caller, PermissionDenied
 from ..store.watch import Channel, ChannelClosed
 from ..utils import failpoints, trace
@@ -103,12 +104,12 @@ class RPCServer:
         else:
             self._bind = None
         self._sock: socket.socket | None = None
-        self._ctx_lock = threading.Lock()
+        self._ctx_lock = make_lock('rpc.server.ctx_lock')
         self._ctx = server_ssl_context(security) if unix_path is None else None
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._conns: set = set()
-        self._conns_lock = threading.Lock()
+        self._conns_lock = make_lock('rpc.server.conns_lock')
         # in-flight request handlers: stop() drains these behind a
         # deadline BEFORE shutting connections, so a reply that is
         # already being computed still reaches the caller instead of
@@ -244,7 +245,7 @@ class RPCServer:
             return
         with self._conns_lock:
             self._conns.add(conn)
-        wlock = threading.Lock()
+        wlock = make_lock('rpc.server.wlock')
         cancels: dict[int, threading.Event] = {}
         try:
             while not self._stop.is_set():

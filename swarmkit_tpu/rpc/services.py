@@ -19,9 +19,11 @@ from __future__ import annotations
 import logging
 import threading
 
+from ..analysis.lockgraph import make_lock
 from ..api.types import NodeRole
 from ..ca.auth import Caller, PermissionDenied
-from ..utils.backoff import DEFAULT_RPC
+from ..utils.backoff import DEFAULT_RPC, Backoff, retry
+from ..utils.clock import REAL_CLOCK, Clock
 from .client import RPCClient
 from .server import ANON, ServiceRegistry
 
@@ -43,7 +45,7 @@ class LeaderConns:
     def __init__(self, raft_node, security):
         self.raft = raft_node
         self.security = security
-        self._lock = threading.Lock()
+        self._lock = make_lock('rpc.services.leader_conns')
         self._client: RPCClient | None = None
         self._client_addr: str | None = None
 
@@ -126,7 +128,7 @@ def build_manager_registry(manager, raft_node=None,
         # both read max(members)+1 and claim the SAME raft id, leaving two
         # processes answering for one quorum seat (the reference guards
         # Join with the membership lock for exactly this)
-        join_lock = threading.Lock()
+        join_lock = make_lock('rpc.services.join_lock')
 
         def raft_step(caller, msg):
             frm = getattr(msg, "frm", None)
@@ -469,7 +471,7 @@ class RemoteDispatcher:
         self.addr = self.seeds[0]
         self.security = security
         self._connect_timeout = connect_timeout
-        self._lock = threading.Lock()
+        self._lock = make_lock('rpc.services.remote_dispatcher')
         self._client: RPCClient | None = None
 
     def update_managers(self, addrs: list[str]):
@@ -561,7 +563,7 @@ class RemoteCA:
         self.security = security
         self.root_cert_pem = root_cert_pem
         self.seeds_fn = seeds_fn
-        self._lock = threading.Lock()
+        self._lock = make_lock('rpc.services.remote_ca')
         self._client: RPCClient | None = None
 
     def _conn(self) -> RPCClient:
@@ -626,7 +628,7 @@ class RemoteLogBroker:
     def __init__(self, addr: str, security):
         self.addr = addr
         self.security = security
-        self._lock = threading.Lock()
+        self._lock = make_lock('rpc.services.remote_logbroker')
         self._client: RPCClient | None = None
 
     def _conn(self) -> RPCClient:
@@ -662,15 +664,30 @@ class RemoteControl:
 
     A call landing on a manager that knows no leader (election in flight)
     is retried briefly — the reference's connection broker re-selects a
-    manager instead of surfacing transient NotLeader errors to the CLI."""
+    manager instead of surfacing transient NotLeader errors to the CLI.
 
+    Retries are an explicit `utils/backoff.py` policy (the PR 3
+    contract: no ad-hoc sleep loops), clock-injectable so tests drive
+    them under FakeClock."""
+
+    # jitter=False: the old loop GUARANTEED a 30 s window (fixed 0.5 s
+    # pauses to a deadline) — a jittered policy's window is a random
+    # sum whose lower tail would surface transients the old client
+    # always rode out. Deterministic delays 0.5+1+2x17 = 35.5 s keep
+    # that guarantee (the old fixed cadence was lockstep too, and CLI
+    # clients are few). The attempt count bounds FAST failures; the
+    # RETRY_WINDOW deadline below bounds SLOW ones (a starved server
+    # eating a full call timeout per read-only attempt must not stretch
+    # 20 attempts to minutes — the old loop's wall-clock bound, kept).
+    RETRY_POLICY = Backoff(base=0.5, factor=2.0, max_delay=2.0,
+                           max_attempts=20, jitter=False)
     RETRY_WINDOW = 30.0
-    RETRY_PAUSE = 0.5
 
-    def __init__(self, addr: str, security):
+    def __init__(self, addr: str, security, clock: Clock | None = None):
         self.addr = addr
         self.security = security
-        self._lock = threading.Lock()
+        self._clock = clock or REAL_CLOCK
+        self._lock = make_lock('rpc.services.remote_control')
         self._client: RPCClient | None = None
 
     def _conn(self) -> RPCClient:
@@ -711,25 +728,26 @@ class RemoteControl:
         if name.startswith("_"):
             raise AttributeError(name)
 
-        def call(*args, **kwargs):
-            import time as _time
+        # read-only methods are idempotent: a starved server that
+        # answers after the client's call timeout is a retry, not an
+        # error (writes are NOT retried on timeout — the first attempt
+        # may have committed)
+        read_only = name.startswith(("get_", "list_"))
 
-            # read-only methods are idempotent: a starved server that
-            # answers after the client's call timeout is a retry, not an
-            # error (writes are NOT retried on timeout — the first attempt
-            # may have committed)
-            read_only = name.startswith(("get_", "list_"))
-            deadline = _time.monotonic() + self.RETRY_WINDOW
-            while True:
-                try:
-                    return self._conn().call(f"control.{name}", *args,
-                                             **kwargs)
-                except Exception as exc:
-                    retry = self._transient(exc) or (
-                        read_only and isinstance(exc, TimeoutError))
-                    if not retry or _time.monotonic() >= deadline:
-                        raise
-                    _time.sleep(self.RETRY_PAUSE)
+        def call(*args, **kwargs):
+            deadline = self._clock.monotonic() + self.RETRY_WINDOW
+
+            def retryable(exc: Exception) -> bool:
+                if self._clock.monotonic() >= deadline:
+                    return False
+                return self._transient(exc) or (
+                    read_only and isinstance(exc, TimeoutError))
+
+            return retry(
+                lambda: self._conn().call(f"control.{name}", *args,
+                                          **kwargs),
+                policy=self.RETRY_POLICY, retryable=retryable,
+                clock=self._clock)
 
         return call
 

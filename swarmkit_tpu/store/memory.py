@@ -17,6 +17,8 @@ import time
 from collections import Counter, defaultdict
 from typing import Any, Callable, Iterable
 
+from ..analysis import lockgraph as _lockgraph
+from ..analysis.lockgraph import make_lock, make_rlock
 from ..api.objects import (
     ALL_TABLES,
     Cluster,
@@ -230,6 +232,22 @@ class WriteTx(ReadTx):
 _name_of = by_mod._name_of
 
 
+def _tracked_view(cb, tx):
+    """Run a view callback inside the lockgraph hazard window: acquiring
+    the dispatcher lock in here is the PR 4 inversion the armed detector
+    reports (docs/static_analysis.md). Disarmed cost: one module-global
+    truthiness test. The ONE bracket both read paths (view,
+    view_and_watch) share — the hazard window must cover every
+    callback-under-store-lock path identically."""
+    if _lockgraph._STATE is None:
+        return cb(tx)
+    _lockgraph.view_enter()
+    try:
+        return cb(tx)
+    finally:
+        _lockgraph.view_exit()
+
+
 class MemoryStore:
     """reference: manager/state/store/memory.go:150-158."""
 
@@ -239,8 +257,8 @@ class MemoryStore:
         self._indexes: dict[str, dict[str, dict[Any, set[str]]]] = {
             t: defaultdict(lambda: defaultdict(set)) for t in ALL_TABLES
         }
-        self._lock = threading.RLock()          # guards table reads
-        self._update_lock = threading.Lock()    # serializes writers (memory.go updateLock)
+        self._lock = make_rlock('store.memory.lock')          # guards table reads
+        self._update_lock = make_lock('store.memory.update_lock')    # serializes writers (memory.go updateLock)
         self._update_lock_held_since: float | None = None
         self.wedge_timeout = WEDGE_TIMEOUT      # per-store override for tests
         self.proposer = proposer
@@ -263,7 +281,7 @@ class MemoryStore:
         try:
             with self._lock:
                 self.op_counts["view_tx"] += 1
-                return cb(tx)
+                return _tracked_view(cb, tx)
         finally:
             _read_tx_latency.observe(time.monotonic() - start)
 
@@ -431,7 +449,8 @@ class MemoryStore:
         limit=None subscribes unbounded (for trusted in-process control loops
         that must never be shed as slow subscribers)."""
         with self._lock:
-            result = cb(ReadTx(self)) if cb is not None else None
+            result = _tracked_view(cb, ReadTx(self)) if cb is not None \
+                else None
             ch = self.queue.watch(matcher, limit=limit)
         return result, ch
 

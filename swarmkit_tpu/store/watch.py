@@ -8,6 +8,7 @@ exceeds the limit is closed rather than blocking publishers.
 from __future__ import annotations
 
 import threading
+from ..analysis.lockgraph import make_lock
 from collections import deque
 from typing import Any, Callable, Iterable
 
@@ -142,7 +143,7 @@ class WatchQueue:
 
     def __init__(self, default_limit: int | None = 10000):
         self._subs: tuple[Channel, ...] = ()
-        self._lock = threading.Lock()
+        self._lock = make_lock('store.watch.lock')
         self._default_limit = default_limit
         self._closed = False
 

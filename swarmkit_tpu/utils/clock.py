@@ -10,6 +10,7 @@ machine — the round-2 verdict's fix for the daemon tier's load flakes.
 from __future__ import annotations
 
 import threading
+from ..analysis.lockgraph import make_lock
 import time
 from typing import Callable
 
@@ -162,7 +163,7 @@ class Clock:
     """Real time. Subclass-compatible surface kept deliberately tiny."""
 
     _wheel: TimerWheel | None = None
-    _wheel_lock = threading.Lock()
+    _wheel_lock = make_lock('utils.clock.wheel_lock')
 
     def monotonic(self) -> float:
         return time.monotonic()

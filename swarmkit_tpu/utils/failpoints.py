@@ -37,11 +37,12 @@ import errno as _errno
 import os
 import random
 import threading
+from ..analysis.lockgraph import make_lock
 import time
 from contextlib import contextmanager
 from typing import Any, Callable
 
-_REG_LOCK = threading.Lock()
+_REG_LOCK = make_lock('utils.failpoints.REG_LOCK')
 # name -> _Failpoint; REPLACED wholesale on arm/disarm (copy-on-write):
 # `fp()` reads it without a lock. Empty when nothing is armed — the
 # disarmed fast path is `if not _ARMED: return`.
@@ -104,7 +105,7 @@ class _Failpoint:
         self.on_fire = on_fire
         self.evaluated = 0          # site reached while armed
         self.fired = 0              # action actually taken
-        self._lock = threading.Lock()
+        self._lock = make_lock('utils.failpoints.counters')
 
     def _should_fire(self) -> bool:
         with self._lock:

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import bisect
 import threading
+from ..analysis.lockgraph import make_lock
 
 # prometheus-style default buckets, seconds
 DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
@@ -26,7 +27,7 @@ class Histogram:
         self._counts = [0] * (len(self.buckets) + 1)  # +inf tail
         self._sum = 0.0
         self._n = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock('utils.metrics.histogram')
 
     def observe(self, seconds: float):
         i = bisect.bisect_left(self.buckets, seconds)
@@ -86,7 +87,7 @@ class CounterFamily:
         self.help = help_
         self.label_names = tuple(label_names)
         self._series: dict[tuple, int] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock('utils.metrics.counter_family')
 
     def inc(self, values: tuple, n: int = 1):
         with self._lock:
@@ -118,7 +119,7 @@ class HistogramFamily:
         self.label_names = tuple(label_names)
         self.buckets = buckets
         self._series: dict[tuple, Histogram] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock('utils.metrics.histogram_family')
 
     def child(self, values: tuple) -> Histogram:
         with self._lock:
@@ -145,7 +146,7 @@ class HistogramFamily:
 
 _registry: dict[str, Histogram] = {}
 _families: dict[str, object] = {}
-_registry_lock = threading.Lock()
+_registry_lock = make_lock('utils.metrics.registry_lock')
 
 
 def histogram(name: str, help_: str = "") -> Histogram:

@@ -48,11 +48,12 @@ from __future__ import annotations
 
 import os
 import threading
+from ..analysis.lockgraph import make_lock
 import time
 from contextlib import contextmanager
 from typing import Any, Callable
 
-_REG_LOCK = threading.Lock()
+_REG_LOCK = make_lock('utils.trace.REG_LOCK')
 # The armed recorder, or None. Replaced wholesale on arm/disarm so hot
 # sites read it without a lock; the disarmed fast path everywhere is
 # `if _REC is None: return` / `rec = _REC; if rec is not None: ...`.
@@ -201,7 +202,7 @@ class FlightRecorder:
     def __init__(self, capacity: int = DEFAULT_CAPACITY, clock=None):
         self.capacity = max(16, int(capacity))
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock('utils.trace.recorder')
         self._ring: list[dict] = []
         self.spans_started = 0       # observability + the disarmed guard
         self.dropped = 0             # records that fell off the ring

@@ -83,6 +83,49 @@ def _failpoints_disarmed():
     assert not leaked, f"test leaked armed failpoints: {leaked}"
 
 
+def _lockgraph_tier(request) -> bool:
+    """The tiers the runtime lock-order detector arms for (ISSUE 8):
+    daemon-marked tests, chaos-marked tests, and the dispatcher suites —
+    the concurrency-heavy paths where a lock-order inversion (the PR 4
+    dispatcher/store.view deadlock class) would actually bite."""
+    item = request.node
+    if item.get_closest_marker("daemon") is not None \
+            or item.get_closest_marker("chaos") is not None:
+        return True
+    mod = item.module.__name__ if item.module else ""
+    return "dispatcher" in mod or "chaos" in mod
+
+
+@pytest.fixture(autouse=True)
+def _lockgraph_guard(request):
+    """Arm the lockgraph detector for the daemon/dispatcher/chaos tiers
+    and FAIL the test on any lock-order cycle or store.view hazard it
+    witnessed; elsewhere, mirror the failpoints/trace leak guards — a
+    test that arms the detector and leaks it would silently shim every
+    later test's locks."""
+    from swarmkit_tpu.analysis import lockgraph
+
+    armed_here = _lockgraph_tier(request)
+    state = lockgraph.arm() if armed_here else None
+    yield
+    if state is not None:
+        # a tier test that re-armed over the fixture's session and did
+        # NOT disarm leaked its own detector — fail IT, not the next
+        # innocent test (disarming to None via lockgraph.armed() is fine)
+        leaked = lockgraph._STATE is not None \
+            and lockgraph._STATE is not state
+        rep = state.report()
+        lockgraph.disarm()
+        assert not leaked, \
+            "test leaked an armed lockgraph detector (lockgraph.disarm())"
+        assert rep.clean, f"lockgraph detected:\n{rep.render()}"
+    else:
+        leaked = lockgraph.active()
+        lockgraph.disarm()
+        assert not leaked, \
+            "test leaked an armed lockgraph detector (lockgraph.disarm())"
+
+
 @pytest.fixture(autouse=True)
 def _trace_disarmed():
     """Mirror of the failpoints leak guard for the trace plane: a leaked

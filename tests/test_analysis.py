@@ -1,0 +1,557 @@
+"""Analysis-plane unit tests (ISSUE 8): one must-fire and one
+must-not-fire fixture per lint rule, pragma handling, mirror-drift
+detection of a synthetic one-sided edit, and the lockgraph detector's
+seeded deadlock regression.
+
+The companion tests/test_lint_clean.py asserts the REAL tree is clean;
+this module pins the rules' semantics on synthetic snippets so a rule
+that silently stops firing is caught even while the tree stays green.
+"""
+from __future__ import annotations
+
+import textwrap
+import threading
+from pathlib import Path
+
+import pytest
+
+from swarmkit_tpu.analysis import lint, lockgraph, mirror
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def findings(src: str, path: str) -> list[str]:
+    return [f.rule for f in lint.lint_source(textwrap.dedent(src), path)]
+
+
+# ------------------------------------------------------------ scatter-2d
+def test_scatter_2d_fires_on_tuple_index():
+    src = """
+    def k(x, r, c, d):
+        return x.at[r, c].add(d)
+    """
+    assert findings(src, "swarmkit_tpu/ops/foo.py") == ["scatter-2d"]
+
+
+def test_scatter_2d_flat_1d_clean():
+    src = """
+    def k(flat, r, c, d, N):
+        return flat.at[r * N + c].add(d)
+    """
+    assert findings(src, "swarmkit_tpu/ops/foo.py") == []
+
+
+def test_scatter_2d_only_in_kernel_packages():
+    src = "y = x.at[r, c].add(d)\n"
+    assert findings(src, "swarmkit_tpu/scheduler/foo.py") == []
+
+
+def test_scatter_2d_pragma_suppresses():
+    src = """
+    y = x.at[r, c].add(d)  # lint: allow(scatter-2d) probed-safe: <=8 rows
+    """
+    assert findings(src, "swarmkit_tpu/ops/foo.py") == []
+
+
+def test_pragma_on_preceding_line_suppresses():
+    src = """
+    # lint: allow(scatter-2d)
+    y = x.at[r, c].add(d)
+    """
+    assert findings(src, "swarmkit_tpu/ops/foo.py") == []
+
+
+def test_trailing_pragma_does_not_spill_to_next_line():
+    # a pragma on a CODE line covers that line only; the comment-only
+    # form is what covers the following line
+    src = """
+    y = x.at[r, c].add(d)  # lint: allow(scatter-2d) probed-safe
+    z = w.at[r, c].add(e)
+    """
+    out = lint.lint_source(textwrap.dedent(src), "swarmkit_tpu/ops/foo.py")
+    assert [f.line for f in out] == [3]
+
+
+def test_pragma_names_only_its_rule():
+    src = """
+    y = x.at[r, c].add(d)  # lint: allow(int64-in-kernel)
+    """
+    assert findings(src, "swarmkit_tpu/ops/foo.py") == ["scatter-2d"]
+
+
+# ---------------------------------------------------------- ad-hoc-sleep
+def test_sleep_fires_outside_seams():
+    src = """
+    import time
+    def retry_loop():
+        time.sleep(0.5)
+    """
+    assert findings(src, "swarmkit_tpu/rpc/foo.py") == ["ad-hoc-sleep"]
+
+
+def test_sleep_allowed_in_backoff_clock_cmd():
+    src = "import time\ntime.sleep(1)\n"
+    for path in ("swarmkit_tpu/utils/backoff.py",
+                 "swarmkit_tpu/utils/clock.py",
+                 "swarmkit_tpu/cmd/swarmfoo.py"):
+        assert findings(src, path) == []
+
+
+def test_backoff_sleep_seam_clean():
+    src = """
+    from ..utils import backoff as _backoff
+    _backoff.sleep(clock, d)
+    """
+    assert findings(src, "swarmkit_tpu/rpc/foo.py") == []
+
+
+# ---------------------------------------------------------- ambient-mesh
+def test_ambient_mesh_fires():
+    src = """
+    import jax
+    def f(mesh):
+        with jax.sharding.set_mesh(mesh):
+            pass
+    """
+    assert findings(src, "swarmkit_tpu/ops/foo.py") == ["ambient-mesh"]
+
+
+def test_ambient_mesh_allowed_in_mesh_py():
+    src = "import jax\njax.sharding.use_mesh(m)\n"
+    assert findings(src, "swarmkit_tpu/parallel/mesh.py") == []
+
+
+# --------------------------------------------------------- donate-pinned
+def test_donate_pinned_fires_on_literal():
+    src = """
+    import jax
+    f = jax.jit(g, donate_argnums=(0, 1, 2))
+    """
+    assert findings(src, "swarmkit_tpu/ops/foo.py") == ["donate-pinned"]
+
+
+def test_donate_pinned_constant_clean():
+    src = """
+    import jax
+    f = jax.jit(g, donate_argnums=DONATE_STATE_ARGNUMS)
+    """
+    assert findings(src, "swarmkit_tpu/ops/foo.py") == []
+
+
+# ---------------------------------------------------------- span-in-loop
+AUDITED = "swarmkit_tpu/ops/pipeline.py"
+
+
+def test_span_in_loop_fires_unguarded():
+    src = """
+    from ..utils import trace
+    def f(entries):
+        for e in entries:
+            trace.rec("x", 1.0)
+    """
+    assert findings(src, AUDITED) == ["span-in-loop"]
+
+
+def test_failpoint_in_loop_fires():
+    src = """
+    from ..utils import failpoints
+    def f(entries):
+        while entries:
+            failpoints.fp("raft.wal.fsync")
+    """
+    assert findings(src, AUDITED) == ["span-in-loop"]
+
+
+def test_span_in_loop_enabled_guard_clean():
+    src = """
+    from ..utils import trace
+    def f(entries):
+        traced = trace.enabled()
+        for e in entries:
+            if traced:
+                trace.rec("x", 1.0)
+    """
+    assert findings(src, AUDITED) == []
+
+
+def test_span_outside_loop_clean():
+    src = """
+    from ..utils import trace
+    def f(entries):
+        with trace.span("wave"):
+            pass
+    """
+    assert findings(src, AUDITED) == []
+
+
+def test_span_in_nested_def_not_in_outer_loop():
+    # the nested def's body does not execute per iteration of the
+    # enclosing loop — defining it there is legal
+    src = """
+    from ..utils import trace
+    def f(entries):
+        for e in entries:
+            def cb():
+                trace.rec("x", 1.0)
+            register(cb)
+    """
+    assert findings(src, AUDITED) == []
+
+
+def test_span_in_loop_only_audited_modules():
+    src = """
+    from ..utils import trace
+    def f(entries):
+        for e in entries:
+            trace.rec("x", 1.0)
+    """
+    assert findings(src, "swarmkit_tpu/orchestrator/foo.py") == []
+
+
+# ---------------------------------------------------- copy-before-mutate
+def test_copy_before_mutate_fires():
+    src = """
+    def txn(tx):
+        t = tx.get_task(tid)
+        t.desired_state = 5
+        tx.update(t)
+    """
+    assert findings(src, "swarmkit_tpu/csi/foo.py") == \
+        ["copy-before-mutate"]
+
+
+def test_copy_before_mutate_nested_attr_fires():
+    src = """
+    def txn(tx):
+        t = tx.get_task(tid)
+        t.status.state = 5
+    """
+    assert findings(src, "swarmkit_tpu/csi/foo.py") == \
+        ["copy-before-mutate"]
+
+
+def test_copy_clears_taint():
+    src = """
+    def txn(tx):
+        t = tx.get_task(tid)
+        t = t.copy()
+        t.desired_state = 5
+        tx.update(t)
+    """
+    assert findings(src, "swarmkit_tpu/csi/foo.py") == []
+
+
+def test_copy_before_mutate_reads_clean():
+    src = """
+    def txn(tx):
+        t = tx.get_task(tid)
+        if t is None or t.node_id:
+            return None
+        return t.desired_state
+    """
+    assert findings(src, "swarmkit_tpu/csi/foo.py") == []
+
+
+def test_copy_before_mutate_other_receiver_clean():
+    src = """
+    def txn(view):
+        t = info.get_task(tid)
+        t.desired_state = 5
+    """
+    assert findings(src, "swarmkit_tpu/csi/foo.py") == []
+
+
+# -------------------------------------------------------- int64-in-kernel
+def test_int64_fires_in_kernel_module():
+    src = "import jax.numpy as jnp\nx = jnp.zeros(4, jnp.int64)\n"
+    assert findings(src, "swarmkit_tpu/ops/placement.py") == \
+        ["int64-in-kernel"]
+
+
+def test_int64_clean_outside_kernel_modules():
+    src = "import numpy as np\nx = np.zeros(4, np.int64)\n"
+    assert findings(src, "swarmkit_tpu/scheduler/encode.py") == []
+
+
+# -------------------------------------------------------------- raw-lock
+def test_raw_lock_fires():
+    src = "import threading\nlock = threading.Lock()\n"
+    assert findings(src, "swarmkit_tpu/foo/bar.py") == ["raw-lock"]
+
+
+def test_raw_rlock_fires():
+    src = "import threading\nlock = threading.RLock()\n"
+    assert findings(src, "swarmkit_tpu/foo/bar.py") == ["raw-lock"]
+
+
+def test_from_threading_import_lock_fires():
+    # the bare-call bypass: `from threading import Lock; Lock()` never
+    # matches the dotted form, so the IMPORT is the flagged gateway
+    src = "from threading import Lock\nlock = Lock()\n"
+    assert findings(src, "swarmkit_tpu/foo/bar.py") == ["raw-lock"]
+
+
+def test_from_threading_other_names_clean():
+    src = "from threading import Event, Thread\n"
+    assert findings(src, "swarmkit_tpu/foo/bar.py") == []
+
+
+def test_factory_lock_clean():
+    src = """
+    from ..analysis.lockgraph import make_lock
+    lock = make_lock("foo.lock")
+    """
+    assert findings(src, "swarmkit_tpu/foo/bar.py") == []
+
+
+def test_raw_lock_allowed_in_lockgraph_itself():
+    src = "import threading\nlock = threading.Lock()\n"
+    assert findings(src, "swarmkit_tpu/analysis/lockgraph.py") == []
+
+
+def test_raw_lock_not_enforced_in_tests():
+    src = "import threading\nlock = threading.Lock()\n"
+    assert findings(src, "tests/test_foo.py") == []
+
+
+# ------------------------------------------------------------ mirror drift
+def test_mirror_clean_on_real_tree():
+    rep = mirror.check_drift(ROOT)
+    assert rep.clean, rep.render()
+
+
+def test_mirror_detects_one_sided_barrier_edit():
+    """The acceptance scenario: a barrier call removed from ONE mirror
+    (TickPipeline.drain_serial loses its first-step barrier) must fail
+    with a diff naming the drift."""
+    spec = next(s for s in mirror.MIRRORS if s.key == "tick_pipeline")
+    src = (ROOT / spec.path).read_text()
+    edited = src.replace(
+        "            self._barrier(timing)\n"
+        "            commit_deferred(sync=True)\n",
+        "            commit_deferred(sync=True)\n")
+    assert edited != src, "edit anchor moved — update this test"
+    rep = mirror.check_drift(ROOT, sources={"tick_pipeline": edited})
+    assert not rep.clean
+    assert "tick_pipeline" in rep.diffs
+    assert "barrier" in rep.diffs["tick_pipeline"]
+    assert "both" in rep.render().lower() or "BOTH" in rep.render()
+
+
+def test_mirror_detects_one_sided_scheduler_edit():
+    spec = next(s for s in mirror.MIRRORS if s.key == "scheduler_tick")
+    src = (ROOT / spec.path).read_text()
+    edited = src.replace("self.encoder.restamp_counts(problem, counts)",
+                         "pass", 1)
+    assert edited != src
+    rep = mirror.check_drift(ROOT, sources={"scheduler_tick": edited})
+    assert not rep.clean and "scheduler_tick" in rep.diffs
+
+
+def test_mirror_required_common_events():
+    """A mirror stripped of its poison/restamp vocabulary is flagged
+    even when the per-mirror table is re-recorded to match (the
+    re-record-without-review hole)."""
+    minimal = textwrap.dedent("""
+    class Scheduler:
+        def _tick_pipelined(self):
+            counts = h.get()
+            self.encoder.fold_counts(p, counts)
+        def flush_pipeline(self): pass
+        def _submit_heavy(self): pass
+        def _commit_heavy(self): pass
+        def _drain_commit_plane(self): pass
+        def _heal_unclean(self): pass
+    """)
+    spec = next(s for s in mirror.MIRRORS if s.key == "scheduler_tick")
+    seq = mirror.extract_from_source(minimal, spec)
+    rep = mirror.check_drift(
+        ROOT, sources={"scheduler_tick": minimal},
+        expected=dict(mirror.EXPECTED, scheduler_tick=tuple(seq)))
+    assert "scheduler_tick" in rep.missing_common
+    assert "poison_rows" in rep.missing_common["scheduler_tick"]
+    assert "restamp" in rep.missing_common["scheduler_tick"]
+
+
+def test_protocol_table_in_sync_with_print_protocol():
+    """`--print-protocol` output must round-trip to the checked-in
+    table (the re-record flow stays copy-pasteable)."""
+    text = mirror.record(ROOT)
+    ns: dict = {}
+    exec(text, ns)  # noqa: S102 — our own generated literal
+    assert ns["EXPECTED"] == mirror.EXPECTED
+
+
+# --------------------------------------------------------------- lockgraph
+def test_lockgraph_disarmed_returns_plain_primitives():
+    assert not lockgraph.active()
+    lk = lockgraph.make_lock("x")
+    rk = lockgraph.make_rlock("x")
+    assert type(lk) is type(threading.Lock())
+    assert type(rk) is type(threading.RLock())
+
+
+def test_lockgraph_seeded_cycle_regression():
+    """The acceptance regression: two locks taken in opposite orders on
+    two threads is a potential deadlock the detector MUST report, even
+    though this interleaving never hangs."""
+    with lockgraph.armed() as st:
+        a = lockgraph.make_lock("seed.a")
+        b = lockgraph.make_lock("seed.b")
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        def ba():
+            with b:
+                with a:
+                    pass
+
+        for fn in (ab, ba):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+        rep = st.report()
+        assert rep.cycles, "opposite-order acquisition must report a cycle"
+        names = set(rep.cycles[0])
+        assert {"seed.a", "seed.b"} <= names
+    assert not lockgraph.active()
+
+
+def test_lockgraph_consistent_order_clean():
+    with lockgraph.armed() as st:
+        a = lockgraph.make_lock("c.a")
+        b = lockgraph.make_lock("c.b")
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        for _ in range(3):
+            t = threading.Thread(target=ab)
+            t.start()
+            t.join()
+        rep = st.report()
+        assert rep.clean, rep.render()
+        assert rep.edges == 1
+
+
+def test_lockgraph_same_name_instances_not_a_cycle():
+    """Three raft nodes each own a 'raft.storage' lock; node A's held
+    while acquiring node B's is NOT a self-deadlock — edges key on
+    instances."""
+    with lockgraph.armed() as st:
+        a = lockgraph.make_lock("raft.storage")
+        b = lockgraph.make_lock("raft.storage")
+        with a:
+            with b:
+                pass
+        rep = st.report()
+        assert rep.clean, rep.render()
+
+
+def test_lockgraph_rlock_reentrancy_no_edge():
+    with lockgraph.armed() as st:
+        r = lockgraph.make_rlock("re.lock")
+        with r:
+            with r:
+                pass
+        rep = st.report()
+        assert rep.clean and rep.edges == 0
+
+
+def test_lockgraph_dispatcher_view_hazard():
+    """The PR 4 inversion, reproduced: dispatcher lock acquired inside
+    an open store.view callback."""
+    from swarmkit_tpu.store.memory import MemoryStore
+
+    with lockgraph.armed() as st:
+        store = MemoryStore()
+        disp = lockgraph.make_rlock("dispatcher.lock")
+
+        def cb(tx):
+            with disp:
+                return None
+
+        store.view(cb)
+        rep = st.report()
+        assert rep.hazards and "dispatcher.lock" in rep.hazards[0]
+
+
+def test_lockgraph_view_scope_closes_on_exception():
+    from swarmkit_tpu.store.memory import MemoryStore
+
+    with lockgraph.armed() as st:
+        store = MemoryStore()
+        disp = lockgraph.make_rlock("dispatcher.lock")
+        with pytest.raises(RuntimeError):
+            store.view(lambda tx: (_ for _ in ()).throw(RuntimeError()))
+        with disp:          # view closed: no hazard
+            pass
+        assert st.report().clean
+
+
+def test_lockgraph_dispatcher_lock_outside_view_clean():
+    with lockgraph.armed() as st:
+        disp = lockgraph.make_rlock("dispatcher.lock")
+        with disp:
+            pass
+        assert st.report().clean
+
+
+def test_lockgraph_hand_over_hand_release():
+    """Out-of-stack-order release (hand-over-hand locking) must not
+    corrupt the held list."""
+    with lockgraph.armed() as st:
+        a = lockgraph.make_lock("h.a")
+        b = lockgraph.make_lock("h.b")
+        a.acquire()
+        b.acquire()
+        a.release()
+        c = lockgraph.make_lock("h.c")
+        with c:      # held: [b] -> edge b->c only
+            pass
+        b.release()
+        rep = st.report()
+        assert rep.clean
+        edge_names = {("h.a", "h.b"), ("h.b", "h.c")}
+        got = {(e.held_name, e.acq_name)
+               for e in st._edges.values()}
+        assert got == edge_names
+
+
+def test_lockgraph_armed_factory_is_tracked_and_functional():
+    with lockgraph.armed():
+        lk = lockgraph.make_lock("t.lock")
+        assert isinstance(lk, lockgraph._TrackedLock)
+        assert lk.acquire(timeout=1.0)
+        assert lk.locked()
+        lk.release()
+        assert not lk.locked()
+
+
+def test_lockgraph_report_disarmed_is_empty_clean():
+    rep = lockgraph.report()
+    assert rep.clean and rep.edges == 0 and rep.locks == 0
+
+
+# ------------------------------------------------------------------- CLI
+def test_cli_clean_tree_exits_zero(capsys):
+    from swarmkit_tpu.analysis.__main__ import main
+
+    rc = main([str(ROOT)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "clean" in out
+
+
+def test_cli_print_protocol(capsys):
+    from swarmkit_tpu.analysis.__main__ import main
+
+    rc = main(["--print-protocol", str(ROOT)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "tick_pipeline" in out and "scheduler_tick" in out
