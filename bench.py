@@ -915,12 +915,13 @@ def bench_e2e_service_start(np):
         lat = sorted(seen.values())
 
         def pct(p):
-            # nearest-rank: ceil(p/100 * n)-th smallest (1-based); the
-            # naive int(p/100*n) index reported p100 as p99 at n=100
-            if not lat:
-                return None
-            import math
-            return round(lat[max(0, math.ceil(p / 100 * len(lat)) - 1)], 3)
+            # the ONE nearest-rank implementation (utils/slo.py, shared
+            # with swarmbench; the naive int(p/100*n) index reported
+            # p100 as p99 at n=100)
+            from swarmkit_tpu.utils.slo import quantile_nearest_rank
+
+            v = quantile_nearest_rank(lat, p)
+            return None if v is None else round(v, 3)
 
         row = {
             "managers": 3, "workers": 5, "replicas": REPLICAS,
@@ -1364,6 +1365,133 @@ def bench_lint_plane(np):
     }
 
 
+def bench_slo_plane(np):
+    """Lifecycle-plane acceptance row (ISSUE 10), the trace_plane shape:
+    (a) DISARMED, an end-to-end task slice — orchestrator task factory,
+    scheduler serial wave commit, dispatcher ship + status flush — files
+    ZERO timeline records (the truthiness contract, spied the way
+    trace_plane spies Span.__init__); (b) ARMED, the same slice produces
+    complete NEW→ASSIGNED→SHIPPED→RUNNING timelines, the scheduler's
+    record site is ONE batched call for the whole wave (never per placed
+    task), and the armed-vs-disarmed overhead is measured."""
+    from swarmkit_tpu.api.objects import Node, Service, TaskStatus
+    from swarmkit_tpu.api.specs import NodeDescription, Resources
+    from swarmkit_tpu.api.types import NodeStatusState, TaskState
+    from swarmkit_tpu.dispatcher.dispatcher import Dispatcher
+    from swarmkit_tpu.orchestrator.task import new_task
+    from swarmkit_tpu.scheduler.scheduler import Scheduler
+    from swarmkit_tpu.store.memory import MemoryStore
+    from swarmkit_tpu.utils import lifecycle, slo
+
+    N_NODES_S, N_TASKS_S = 64, 1_000
+
+    def run_slice():
+        """One store: PENDING tasks -> scheduler wave -> dispatcher ship
+        -> agent-style RUNNING write-back. Returns (wave_s, flush_s)."""
+        store = MemoryStore()
+        svc = Service(id="slosvc")
+        svc.spec.annotations.name = "slosvc"
+
+        def seed(tx):
+            tx.create(svc)
+            for i in range(N_NODES_S):
+                n = Node(id=f"sn{i:04d}")
+                n.status.state = NodeStatusState.READY
+                n.description = NodeDescription(
+                    hostname=n.id,
+                    resources=Resources(nano_cpus=64 * 10**9,
+                                        memory_bytes=256 * 2**30))
+                tx.create(n)
+            for i in range(N_TASKS_S):
+                t = new_task(None, svc, i + 1)     # NEW record site
+                t.status.state = TaskState.PENDING  # allocator shortcut
+                tx.create(t)
+        store.update(seed)
+
+        sched = Scheduler(store, backend="cpu")
+        sched_ch = sched._setup()
+        r = lifecycle.recorder()
+        b0 = r.batches if r is not None else 0
+        t0 = time.perf_counter()
+        sched.tick()                               # one batched ASSIGNED
+        wave_s = time.perf_counter() - t0
+        wave_batches = (r.batches - b0) if r is not None else 0
+        store.queue.stop_watch(sched_ch)
+
+        d = Dispatcher(store, heartbeat_period=300.0)
+        _, ch = store.view_and_watch(d._prime_reverse_indexes,
+                                     matcher=lambda ev: True, limit=None)
+        try:
+            sid = d.register("sn0000")
+            d.assignments("sn0000", sid)           # SHIPPED record site
+            assigned = store.view(
+                lambda tx: [t.id for t in tx.find_tasks()
+                            if t.node_id == "sn0000"])
+            d.update_task_status(
+                "sn0000", sid,
+                [(tid, TaskStatus(state=TaskState.RUNNING))
+                 for tid in assigned])
+            t0 = time.perf_counter()
+            d._flush_statuses()                    # RUNNING record site
+            flush_s = time.perf_counter() - t0
+        finally:
+            store.queue.stop_watch(ch)
+            d._hb_wheel.stop()
+        return wave_s, flush_s, wave_batches
+
+    run_slice()                                    # warm-up
+
+    # (a) disarmed: the op-count guard — ANY recorder method running on
+    # the slice trips the probe (module sites must bail on the
+    # truthiness test before reaching the recorder)
+    allocs = {"n": 0}
+    orig = {name: getattr(lifecycle.LifecycleRecorder, name)
+            for name in ("record", "record_batch", "record_pairs")}
+
+    def spy(name):
+        def wrapper(self, *a, **k):
+            allocs["n"] += 1
+            return orig[name](self, *a, **k)
+        return wrapper
+
+    for name in orig:
+        setattr(lifecycle.LifecycleRecorder, name, spy(name))
+    try:
+        disarmed_wave_s, disarmed_flush_s, _ = run_slice()
+        disarmed_allocs = allocs["n"]
+
+        # (b) armed: full timelines + the one-batched-call-per-wave pin
+        with lifecycle.armed() as rec:
+            armed_wave_s, armed_flush_s, sched_batches = run_slice()
+            samples = rec.startup_samples()
+            transitions = {f"{a}->{b}": n for (a, b), n
+                           in sorted(rec.transition_counts().items())}
+            attribution = slo.attribution(rec)
+    finally:
+        for name, fn in orig.items():
+            setattr(lifecycle.LifecycleRecorder, name, fn)
+
+    return {
+        "nodes": N_NODES_S, "tasks": N_TASKS_S,
+        "disarmed_wave_s": round(disarmed_wave_s, 5),
+        "armed_wave_s": round(armed_wave_s, 5),
+        "disarmed_flush_s": round(disarmed_flush_s, 5),
+        "armed_flush_s": round(armed_flush_s, 5),
+        "armed_overhead_x": round(
+            armed_wave_s / max(disarmed_wave_s, 1e-9), 3),
+        # THE acceptance: the plane off allocates nothing anywhere on
+        # the slice, and armed the wave files ONE batched record
+        "disarmed_record_allocs": disarmed_allocs,
+        "sched_record_batches_per_wave": sched_batches,
+        "startup_samples": len(samples),
+        "startup_p99_s": slo.quantile_nearest_rank(samples, 99),
+        "transitions": transitions,
+        "attribution_reconciled": attribution["reconciled"],
+        "parity": (disarmed_allocs == 0 and sched_batches == 1
+                   and len(samples) > 0 and attribution["reconciled"]),
+    }
+
+
 def bench_host_micro(np):
     """The BASELINE.md harness rows the reference ships benchmarks for
     but no numbers (store ops memory_test.go:2028-2120, watch queue at
@@ -1690,6 +1818,10 @@ def main():
         # ISSUE 8: lockgraph disarmed-cost acceptance (plain primitive,
         # zero wrapper allocs) + the tree-wide lint/mirror clean gate
         ("lint_plane", lambda: bench_lint_plane(np)),
+        # ISSUE 10: lifecycle-plane disarmed-cost acceptance (zero
+        # timeline records on the wave + flush paths; one batched
+        # scheduler record per wave) + armed e2e timeline slice
+        ("slo_plane", lambda: bench_slo_plane(np)),
     ]
     configs = {name: _run_row(name, thunk) for name, thunk in rows}
     ns = configs["grid_100k_x_10k"]   # the north star IS this grid config

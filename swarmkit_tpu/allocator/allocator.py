@@ -30,6 +30,7 @@ from ..api.objects import (
 from ..api.types import NodeStatusState, TaskState
 from ..store import by
 from ..orchestrator.base import EventLoopComponent
+from ..utils import lifecycle
 from .ipam import IPAM, IPAMError
 
 log = logging.getLogger("swarmkit_tpu.allocator")
@@ -491,6 +492,11 @@ class Allocator(EventLoopComponent):
             self._retry_starved()
 
     def _allocate_tasks(self, task_ids: list[str]):
+        # lifecycle plane: collect the ids actually moved NEW->PENDING
+        # and file them as ONE batched record after the store batch (the
+        # decision boundary); disarmed, no list is ever built
+        moved: list[str] | None = [] if lifecycle.enabled() else None
+
         def cb(batch):
             for tid in task_ids:
                 def move_one(tx, tid=tid):
@@ -533,7 +539,11 @@ class Allocator(EventLoopComponent):
                     t.status.state = TaskState.PENDING
                     t.status.message = "pending task scheduling"
                     tx.update(t)
+                    if moved is not None:
+                        moved.append(tid)
 
                 batch.update(move_one)
 
         self.store.batch(cb)
+        if moved:
+            lifecycle.record_batch(TaskState.PENDING, moved)

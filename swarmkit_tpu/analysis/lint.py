@@ -240,16 +240,19 @@ class DonatePinnedRule(Rule):
 
 
 class SpanInLoopRule(Rule):
-    """Trace/failpoint sites live at decision boundaries, never inside
-    per-entry hot loops; per-entry span emission must be guarded by the
-    trace.enabled() pattern so the disarmed cost stays one truthiness
-    test (CLAUDE.md trace-plane contract)."""
+    """Trace/failpoint/lifecycle sites live at decision boundaries,
+    never inside per-entry hot loops; per-entry emission must be guarded
+    by the `.enabled()` pattern so the disarmed cost stays one
+    truthiness test (CLAUDE.md trace-plane + lifecycle-plane
+    contracts — the scheduler records ONE batch per wave, never per
+    placed task in the walk)."""
 
     name = "span-in-loop"
-    invariant = ("no trace.span/start/rec/event or failpoints.fp* call "
-                 "inside a for/while body in the audited hot modules "
-                 "unless under an `if trace.enabled()` / `if traced:` "
-                 "guard")
+    invariant = ("no trace.span/start/rec/event, failpoints.fp*, or "
+                 "lifecycle.record* call inside a for/while body in the "
+                 "audited hot modules unless under an "
+                 "`if trace.enabled()` / `if lifecycle.enabled()` / "
+                 "`if traced:` guard")
 
     AUDITED = (
         "swarmkit_tpu/ops/pipeline.py",
@@ -268,6 +271,7 @@ class SpanInLoopRule(Rule):
     )
     TRACE_CALLS = frozenset({"span", "start", "rec", "event", "wrap"})
     FP_CALLS = frozenset({"fp", "fp_value", "fp_transform"})
+    LIFECYCLE_CALLS = frozenset({"record", "record_batch", "record_pairs"})
 
     def applies(self, path: str) -> bool:
         return path in self.AUDITED
@@ -297,7 +301,9 @@ class SpanInLoopRule(Rule):
                 (base_name == "trace"
                  and node.func.attr in self.TRACE_CALLS)
                 or (base_name == "failpoints"
-                    and node.func.attr in self.FP_CALLS))
+                    and node.func.attr in self.FP_CALLS)
+                or (base_name == "lifecycle"
+                    and node.func.attr in self.LIFECYCLE_CALLS))
             if not is_site:
                 continue
             # innermost enclosing loop that is inside the same function
@@ -320,7 +326,7 @@ class SpanInLoopRule(Rule):
                 mod, node,
                 f"{base_name}.{node.func.attr} inside a loop body — "
                 "hot-path sites live at decision boundaries; per-entry "
-                "emission needs the `if trace.enabled():` guard")
+                f"emission needs the `if {base_name}.enabled():` guard")
 
 
 class CopyBeforeMutateRule(Rule):

@@ -1,21 +1,366 @@
-"""swarm-bench: cluster load generator (reference cmd/swarm-bench).
+"""swarm-bench: cluster load generator + task-startup SLO harness
+(reference cmd/swarm-bench).
 
-Creates an N-replica service against a live cluster and measures
-time-to-RUNNING per task, reporting percentiles — the reference has the
-containers phone home over UDP; our tasks' observed RUNNING timestamps in
-the replicated store carry the same signal without instrumenting payloads.
+The reference creates an N-replica service and has the containers phone
+home over UDP (`collector.go`), reporting time-to-RUNNING percentiles.
+This port reads the same signal from the cluster's own event plane: a
+**watch-API collector** subscribes to task events
+(`watch.events` stream, manager/watchapi) and stamps each task at
+CREATE and at its first observed RUNNING — no store scans, no polling
+bias. `--poll` keeps the original list_tasks scan loop as a fallback
+for clusters without a reachable watch stream.
+
+Two modes:
+
+  * one-shot (default): create a service, measure time-to-RUNNING for
+    every replica, report percentiles (the reference's shape);
+  * `--churn`: a continuous load generator — rollout storms (every task
+    replaced) alternating with scale up/down against one or more
+    services for `--duration` seconds, collecting NEW→RUNNING samples
+    the whole time. With `--slo "p50:0.5,p99:2.0"` the exit code
+    asserts the objectives; the JSON report carries the percentiles,
+    the SLO results, and (when the manager's lifecycle plane is armed —
+    SWARMKIT_TPU_LIFECYCLE=1) the server-side stage-attribution report
+    from `control.get_slo_report`.
+
+Percentile math is the shared nearest-rank helper in utils/slo.py (the
+old local `int(p/100*len(lat))` was biased: p50 of 2 samples returned
+the max).
 
     python -m swarmkit_tpu.cmd.swarmbench --addr 127.0.0.1:4242 \
         --identity /tmp/m1 --replicas 100
+    python -m swarmkit_tpu.cmd.swarmbench --addr ... --identity ... \
+        --churn --duration 30 --replicas 20 --slo p50:1.0,p99:5.0
 """
 from __future__ import annotations
 
 import argparse
 import json
+import random
 import sys
+import threading
 import time
 
 
+# --------------------------------------------------------------- collector
+class StartupCollector:
+    """Accumulates per-task time-to-RUNNING from task events.
+
+    Feed it store events (EventCreate/EventUpdate of Task — from a
+    watch-API channel or an in-process store watch); it stamps each task
+    id at CREATE and computes the latency at the FIRST observed RUNNING.
+    The default clock is WALL time so a task whose CREATE event was
+    missed (subscription race — the stream REQ and the service create
+    ride separate connections) still measures from its store-stamped
+    `meta.created_at`. Tasks with neither stamp are ignored (no
+    partial-window bias). `allow()` restricts collection to the bench's
+    own services — without it a busy cluster's foreign tasks would mix
+    into the percentiles. Thread-safe: the pump thread feeds while the
+    churn loop reads."""
+
+    def __init__(self, clock=time.time, service_filter: bool = False):
+        self._clock = clock
+        from ..analysis.lockgraph import make_lock
+
+        self._lock = make_lock('cmd.swarmbench.collector')
+        self._created: dict[str, float] = {}
+        self.latencies: dict[str, float] = {}    # task id -> seconds
+        self.events = 0
+        # set by pump_channel when the watch stream dies mid-run: the
+        # report must not certify an SLO over silently-truncated data
+        self.stream_error: str | None = None
+        # None = collect everything; a set = only these service ids
+        self._allowed: set | None = set() if service_filter else None
+
+    def allow(self, service_id: str) -> None:
+        """Admit one service's tasks (no-op without service_filter)."""
+        with self._lock:
+            if self._allowed is not None:
+                self._allowed.add(service_id)
+
+    def _admitted(self, obj) -> bool:
+        return self._allowed is None or obj.service_id in self._allowed
+
+    def feed(self, ev, now: float | None = None) -> None:
+        from ..api.objects import EventCreate, EventDelete, Task
+        from ..api.types import TaskState
+
+        obj = getattr(ev, "obj", None)
+        if not isinstance(obj, Task):
+            return
+        if now is None:
+            now = self._clock()
+        with self._lock:
+            self.events += 1
+            if not self._admitted(obj):
+                return
+            if isinstance(ev, EventDelete):
+                self._created.pop(obj.id, None)
+                return
+            if isinstance(ev, EventCreate):
+                self._created.setdefault(obj.id, now)
+            if obj.status.state >= TaskState.RUNNING \
+                    and obj.id not in self.latencies:
+                # the first RUNNING-or-beyond sighting consumes the
+                # CREATE stamp: a task observed straight to a terminal
+                # state (FAILED/REJECTED) never yields a startup sample.
+                # No local stamp (missed CREATE): fall back to the
+                # store's wall-clock created_at — comparable because
+                # the default collector clock is wall time too.
+                t0 = self._created.pop(obj.id, None)
+                if t0 is None:
+                    t0 = getattr(obj.meta, "created_at", 0.0) or None
+                if t0 is not None \
+                        and obj.status.state == TaskState.RUNNING \
+                        and now - t0 >= 0.0:
+                    # a NEGATIVE delta means the fallback stamp came
+                    # from a skewed manager clock — DISCARD it (a
+                    # clamped 0.0 would dilute the percentiles and let
+                    # a failing --slo gate pass)
+                    self.latencies[obj.id] = now - t0
+
+    def feed_poll(self, tasks, now: float | None = None) -> None:
+        """Poll-mode fallback: absorb a list_tasks snapshot. CREATE
+        stamps prefer the store's wall-clock `meta.created_at` (present
+        on every scanned task) over first-sighting — a task created AND
+        running between two polls would otherwise record ~0 latency and
+        understate the percentiles. Negative deltas (skewed manager
+        clock) are discarded like the watch path's."""
+        from ..api.types import TaskState
+
+        if now is None:
+            now = self._clock()
+        with self._lock:
+            for t in tasks:
+                if not self._admitted(t):
+                    continue
+                if t.id not in self._created:
+                    self._created[t.id] = \
+                        getattr(t.meta, "created_at", 0.0) or now
+                if t.status.state == TaskState.RUNNING \
+                        and t.id not in self.latencies \
+                        and now - self._created[t.id] >= 0.0:
+                    self.latencies[t.id] = now - self._created[t.id]
+
+    def samples(self) -> list[float]:
+        with self._lock:
+            return list(self.latencies.values())
+
+    def running(self) -> int:
+        with self._lock:
+            return len(self.latencies)
+
+
+def pump_channel(ch, collector: StartupCollector,
+                 stop: threading.Event) -> None:
+    """Drain a watch channel into the collector until stopped. A stream
+    death mid-run (closed channel, connection loss) is RECORDED on the
+    collector — tasks starting after the drop contribute no sample, so
+    the --slo gate must see the truncation, not a green report."""
+    while not stop.is_set():
+        try:
+            ev = ch.get(timeout=0.2)
+        except TimeoutError:
+            continue
+        except Exception as exc:
+            if not stop.is_set():
+                collector.stream_error = repr(exc)
+            return
+        collector.feed(ev)
+
+
+def start_watch_collector(client, collector, stop,
+                          service_ids=None) -> threading.Thread:
+    """Subscribe to the cluster's task event stream and pump it on a
+    thread. `client` is an RPCClient on a manager; selectors restrict
+    server-side when service ids are known up front."""
+    from ..watchapi.watch import WatchSelector
+
+    if service_ids:
+        selectors = [WatchSelector(kind="task", service_id=sid)
+                     for sid in service_ids]
+    else:
+        selectors = [WatchSelector(kind="task")]
+    ch = client.stream("watch.events", selectors=selectors)
+    t = threading.Thread(target=pump_channel, args=(ch, collector, stop),
+                         name="swarmbench-watch", daemon=True)
+    t.start()
+    return t
+
+
+def start_poll_collector(ctl, svc_ids, collector, stop,
+                         interval: float = 0.1) -> threading.Thread:
+    """The legacy scan-poll fallback (`--poll`): one list_tasks scan per
+    interval. `svc_ids=None` polls every task — churn mode creates its
+    services mid-run, and the collector MUST already be sampling when
+    they appear (a collector started after the churn would stamp
+    created=now for already-running tasks and report ~0 latencies)."""
+    from ..controlapi.control import ListFilters
+
+    def run():
+        while not stop.is_set():
+            try:
+                filters = (ListFilters(service_ids=list(svc_ids))
+                           if svc_ids else None)
+                collector.feed_poll(ctl.list_tasks(filters))
+            except Exception:
+                pass
+            time.sleep(interval)
+
+    t = threading.Thread(target=run, name="swarmbench-poll", daemon=True)
+    t.start()
+    return t
+
+
+# -------------------------------------------------------------- load shapes
+def _service_spec(name: str, replicas: int, command: str):
+    import shlex
+
+    from ..api.specs import (Annotations, ContainerSpec, ServiceSpec,
+                             TaskSpec)
+
+    return ServiceSpec(
+        annotations=Annotations(name=name),
+        replicas=replicas,
+        task=TaskSpec(runtime=ContainerSpec(
+            command=shlex.split(command))),
+    )
+
+
+def _retryable_update_error(exc: Exception) -> bool:
+    """Version conflicts (the cluster's own orchestrators bump versions
+    concurrently under churn) and transient RPC/leadership errors retry;
+    a permanent error (validation, service removed) raises at once."""
+    if isinstance(exc, (TimeoutError, ConnectionError, OSError)):
+        return True
+    msg = str(exc)
+    return ("out of sequence" in msg or "NotLeader" in msg
+            or "not found" not in msg and "conflict" in msg.lower())
+
+
+def _update_with_retry(ctl, svc_id: str, mutate):
+    """update_service under the repo's Backoff policy (CLAUDE.md: no
+    ad-hoc sleep loops), refetching the current version per attempt."""
+    from ..utils.backoff import Backoff, retry
+
+    def attempt():
+        svc = ctl.get_service(svc_id)
+        spec = svc.spec
+        mutate(spec)
+        return ctl.update_service(svc.id, svc.meta.version, spec)
+
+    return retry(attempt,
+                 policy=Backoff(base=0.1, factor=2.0, max_delay=1.0,
+                                max_attempts=8),
+                 retryable=_retryable_update_error)
+
+
+def run_churn(ctl, *, duration: float, replicas: int, rng: random.Random,
+              services: int = 1, scale_step: int = 2,
+              storm_every: int = 3, interval: float = 0.5,
+              command: str = "sleep 3600",
+              name_prefix: str | None = None,
+              progress=None, on_service=None) -> dict:
+    """The continuous-churn load generator: every `interval` one service
+    gets either a ROLLOUT STORM (env bump → every task replaced through
+    the updater) or a scale up/down of `scale_step`. All randomness
+    comes from `rng`, so a seeded run replays the same schedule.
+    Returns {service_ids, rounds, storms, scales}."""
+    name_prefix = name_prefix or f"bench-{int(time.time())}"
+    svcs = []
+    try:
+        for i in range(services):
+            svc = ctl.create_service(
+                _service_spec(f"{name_prefix}-{i}", replicas, command))
+            if on_service is not None:
+                on_service(svc)        # e.g. collector.allow(svc.id)
+            svcs.append(svc)
+    except Exception:
+        # a mid-setup failure must not orphan the services already
+        # created (the caller never learns their ids)
+        for s in svcs:
+            try:
+                ctl.remove_service(s.id)
+            except Exception:
+                pass
+        raise
+    rounds = storms = scales = failed = 0
+    deadline = time.monotonic() + duration
+    while time.monotonic() < deadline:
+        rounds += 1
+        svc = svcs[rng.randrange(len(svcs))]
+        # success-only counters: a report claiming N storms that all
+        # failed would certify a load profile that never materialized
+        try:
+            if storm_every and rounds % storm_every == 0:
+                def storm(spec, n=rounds):
+                    spec.task.runtime.env = [f"BENCH_STORM={n}"]
+
+                _update_with_retry(ctl, svc.id, storm)
+                storms += 1
+            else:
+                delta = rng.choice([-scale_step, scale_step])
+
+                def scale(spec, d=delta):
+                    spec.replicas = max(1, min(replicas * 2,
+                                               spec.replicas + d))
+
+                _update_with_retry(ctl, svc.id, scale)
+                scales += 1
+        except Exception:
+            failed += 1                # churn must outlive a flaky round
+        if progress is not None:
+            progress(rounds)
+        time.sleep(interval)
+    return {"service_ids": [s.id for s in svcs], "rounds": rounds,
+            "storms": storms, "scales": scales, "failed_rounds": failed}
+
+
+# -------------------------------------------------------------------- report
+def build_report(collector: StartupCollector, *, replicas=None,
+                 slo_specs=None, churn_stats=None,
+                 server_report=None) -> dict:
+    from ..utils import slo as slo_mod
+
+    lat = collector.samples()
+    sorted_lat = sorted(lat)
+    qs = slo_mod.quantiles_nearest_rank(sorted_lat, (50, 90, 99))
+    report = {
+        "running": len(lat),
+        "time_to_first_s": (round(sorted_lat[0], 3) if sorted_lat
+                            else None),
+        "p50_s": _r3(qs[50]),
+        "p90_s": _r3(qs[90]),
+        "p99_s": _r3(qs[99]),
+    }
+    if replicas is not None:
+        report["replicas"] = replicas
+        report["time_to_all_s"] = (round(sorted_lat[-1], 3)
+                                   if len(lat) >= replicas else None)
+    if churn_stats:
+        report["churn"] = churn_stats
+    if collector.stream_error:
+        report["stream_error"] = collector.stream_error
+    if slo_specs:
+        out = slo_mod.evaluate_samples(slo_specs, lat).as_dict()
+        # a bench run with ZERO samples did not measure anything, and a
+        # mid-run stream death truncated the data: the vacuous
+        # min_samples pass is for monitoring windows, not for a load
+        # generator certifying an objective — fail the gate loudly
+        out["measured"] = len(lat) > 0
+        out["ok"] = (out["ok"] and out["measured"]
+                     and collector.stream_error is None)
+        report["slo"] = out
+    if server_report:
+        report["server"] = server_report
+    return report
+
+
+def _r3(v):
+    return None if v is None else round(v, 3)
+
+
+# ---------------------------------------------------------------------- main
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="swarm-bench")
     ap.add_argument("--addr", required=True)
@@ -25,66 +370,144 @@ def main(argv=None) -> int:
     ap.add_argument("--command", default="sleep 3600")
     ap.add_argument("--timeout", type=float, default=120.0)
     ap.add_argument("--keep", action="store_true",
-                    help="leave the service running after the measurement")
+                    help="leave the service(s) running after the run")
+    ap.add_argument("--poll", action="store_true",
+                    help="legacy list_tasks scan-poll collector instead "
+                         "of the watch-API stream")
+    ap.add_argument("--churn", action="store_true",
+                    help="continuous-churn mode: rollout storms + scale "
+                         "up/down for --duration seconds")
+    ap.add_argument("--duration", type=float, default=30.0)
+    ap.add_argument("--services", type=int, default=1)
+    ap.add_argument("--scale-step", type=int, default=2)
+    ap.add_argument("--storm-every", type=int, default=3,
+                    help="every Nth churn round is a rollout storm")
+    ap.add_argument("--interval", type=float, default=0.5,
+                    help="churn round interval seconds")
+    ap.add_argument("--settle", type=float, default=15.0,
+                    help="post-churn settle budget: wait (up to this "
+                         "many seconds) for in-flight startups to land "
+                         "before evaluating --slo")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="churn schedule seed (replayable)")
+    ap.add_argument("--slo", default="",
+                    help='startup objectives, e.g. "p50:1.0,p99:5.0" '
+                         "(seconds); violated objectives fail the run")
     args = ap.parse_args(argv)
 
-    from .swarmctl import _load_identity
-    from ..api.specs import Annotations, ContainerSpec, ServiceSpec, TaskSpec
-    from ..api.types import TaskState
-    from ..controlapi.control import ListFilters
+    from ..rpc.client import RPCClient
     from ..rpc.services import RemoteControl
+    from ..utils.slo import parse_slo_arg
+    from .swarmctl import _load_identity
 
-    import shlex
-
+    slo_specs = parse_slo_arg(args.slo) if args.slo else []
     sec = _load_identity(args.identity)
     ctl = RemoteControl(args.addr, sec)
+    # service-filtered: only the services THIS run creates contribute
+    # samples (a busy cluster's foreign tasks must not mix into the
+    # percentiles); allow() admits them as they are created
+    collector = StartupCollector(service_filter=True)
+    stop = threading.Event()
+    watch_client = None
+    created_ids: list[str] = []
+    try:
+        if not args.poll:
+            watch_client = RPCClient(args.addr, security=sec)
+            start_watch_collector(watch_client, collector, stop)
 
-    name = f"bench-{int(time.time())}"
-    t0 = time.monotonic()
-    svc = ctl.create_service(ServiceSpec(
-        annotations=Annotations(name=name),
-        replicas=args.replicas,
-        task=TaskSpec(runtime=ContainerSpec(
-            command=shlex.split(args.command))),
-    ))
+        if args.churn:
+            if args.poll:
+                # the collector must be sampling BEFORE the churn
+                # creates its services: a post-hoc snapshot would stamp
+                # created=now for already-RUNNING tasks and report ~0
+                # latencies, vacuously passing any --slo gate. No
+                # service filter — the ids don't exist yet.
+                start_poll_collector(ctl, None, collector, stop)
+            churn_stats = run_churn(
+                ctl, duration=args.duration, replicas=args.replicas,
+                rng=random.Random(args.seed), services=args.services,
+                scale_step=args.scale_step, storm_every=args.storm_every,
+                interval=args.interval, command=args.command,
+                on_service=lambda s: collector.allow(s.id))
+            created_ids = churn_stats["service_ids"]
+            # SETTLE before evaluating: the churn cutoff right-censors
+            # in-flight startups — without this window, tasks still
+            # starting (or stuck) at the end contribute no sample and
+            # can never fail the gate. Wait until the sample count
+            # stops growing (2s quiet) or the settle budget runs out.
+            deadline = time.monotonic() + args.settle
+            last_n, quiet_since = collector.running(), time.monotonic()
+            while time.monotonic() < deadline:
+                time.sleep(0.25)
+                n = collector.running()
+                if n != last_n:
+                    last_n, quiet_since = n, time.monotonic()
+                elif time.monotonic() - quiet_since >= 2.0:
+                    break
+            # census: tasks of OUR services that should be running but
+            # are not by the settled cutoff are an SLO miss, not a
+            # silently-dropped sample
+            pending, census_error = None, None
+            try:
+                from ..api.types import TaskState
+                from ..controlapi.control import ListFilters
 
-    seen: dict[str, float] = {}  # task id -> time-to-RUNNING from t0
-    deadline = time.monotonic() + args.timeout
-    while time.monotonic() < deadline and len(seen) < args.replicas:
-        now = time.monotonic()
-        try:
-            tasks = ctl.list_tasks(ListFilters(service_ids=[svc.id]))
-        except Exception:
-            time.sleep(0.3)
-            continue
-        for t in tasks:
-            if t.id not in seen and t.status.state == TaskState.RUNNING:
-                seen[t.id] = now - t0
-        time.sleep(0.1)
+                pending = sum(
+                    1 for t in ctl.list_tasks(
+                        ListFilters(service_ids=list(created_ids)))
+                    if t.desired_state == TaskState.RUNNING
+                    and t.status.state < TaskState.RUNNING)
+            except Exception as exc:
+                # a failed census is UNVERIFIED data, not a pass — the
+                # gate below fails loudly, same as stream death
+                census_error = repr(exc)
+            server_report = None
+            try:
+                server_report = ctl.get_slo_report()
+            except Exception:
+                pass                   # pre-SLO manager / plane disarmed
+            report = build_report(collector, slo_specs=slo_specs,
+                                  churn_stats=churn_stats,
+                                  server_report=server_report)
+            report["not_running_at_cutoff"] = pending
+            if census_error is not None:
+                report["census_error"] = census_error
+            if slo_specs and (pending or census_error is not None):
+                report["slo"]["ok"] = False
+        else:
+            svc = ctl.create_service(_service_spec(
+                f"bench-{int(time.time())}", args.replicas, args.command))
+            collector.allow(svc.id)
+            created_ids = [svc.id]
+            if args.poll:
+                start_poll_collector(ctl, created_ids, collector, stop)
+            deadline = time.monotonic() + args.timeout
+            while time.monotonic() < deadline \
+                    and collector.running() < args.replicas:
+                time.sleep(0.1)
+            report = build_report(collector, replicas=args.replicas,
+                                  slo_specs=slo_specs)
+            report["service"] = svc.id
 
-    lat = sorted(seen.values())
-
-    def pct(p):
-        if not lat:
-            return None
-        return round(lat[min(len(lat) - 1, int(p / 100 * len(lat)))], 3)
-
-    print(json.dumps({
-        "service": svc.id,
-        "replicas": args.replicas,
-        "running": len(lat),
-        "time_to_first_s": round(lat[0], 3) if lat else None,
-        "time_to_all_s": round(lat[-1], 3) if len(lat) == args.replicas
-        else None,
-        "p50_s": pct(50), "p90_s": pct(90), "p99_s": pct(99),
-    }))
-    if not args.keep:
-        try:
-            ctl.remove_service(svc.id)
-        except Exception:
-            pass
-    ctl.close()
-    return 0 if len(lat) == args.replicas else 1
+        print(json.dumps(report))
+        ok = report.get("slo", {}).get("ok", True)
+        if not args.churn:
+            ok = ok and report["running"] >= args.replicas
+        return 0 if ok else 1
+    finally:
+        stop.set()
+        if not args.keep:
+            for sid in created_ids:
+                try:
+                    ctl.remove_service(sid)
+                except Exception:
+                    pass
+        if watch_client is not None:
+            try:
+                watch_client.close()
+            except Exception:
+                pass
+        ctl.close()
 
 
 if __name__ == "__main__":

@@ -586,6 +586,29 @@ class ControlAPI:
             out.append(t.copy())
         return out
 
+    # --------------------------------------------------- lifecycle/SLO plane
+    def get_task_timeline(self, task_id: str) -> list:
+        """This task's lifecycle timeline [(stage, t), ...] from the
+        armed recorder; [] when disarmed or untracked. Auto-exposed as
+        `control.get_task_timeline` with leader forwarding — the
+        recorder populates on the leader (where the orchestrator/
+        scheduler/dispatcher write sites run), so a remote client always
+        reads the authoritative copy."""
+        from ..utils import lifecycle
+
+        r = lifecycle.recorder()
+        return r.timeline(task_id) if r is not None else []
+
+    def get_slo_report(self, since: float | None = None) -> dict:
+        """Cluster task-SLO snapshot for remote clients (swarmbench
+        --slo attribution, operator tooling): startup percentiles +
+        stage-attribution from the leader's lifecycle recorder. `since`
+        (wall-clock seconds) restricts to tasks whose RUNNING landed in
+        the trailing window — the recovery-SLO read."""
+        from ..utils import lifecycle, slo
+
+        return slo.report(lifecycle.recorder(), since=since)
+
     # ----------------------------------------------------------------- nodes
     def get_node(self, node_id: str) -> Node:
         n = self.store.view().get_node(node_id)

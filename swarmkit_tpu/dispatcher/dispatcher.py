@@ -34,7 +34,7 @@ from ..api.types import NodeStatusState, TaskState
 from ..store import by
 from ..store.memory import MemoryStore
 from ..store.watch import Channel, WatchQueue
-from ..utils import failpoints, trace
+from ..utils import failpoints, lifecycle, trace
 from ..utils.identity import new_id
 from ..utils.metrics import histogram
 from .heartbeat import Heartbeat, HeartbeatWheel
@@ -1223,6 +1223,14 @@ class Dispatcher:
             {sid: s.meta.version.index for sid, s in secrets.items()},
             {cid: c.meta.version.index for cid, c in configs.items()},
             set(volumes), session.sequence + 1, ship_bases)
+        if lifecycle.enabled():
+            # lifecycle SHIPPED leg for the COMPLETE snapshot (fresh
+            # session: ASSIGNED tasks reach their agent here, not via an
+            # incremental)
+            shipped = [t.id for t in tasks
+                       if t.status.state == TaskState.ASSIGNED]
+            if shipped:
+                lifecycle.record_batch(lifecycle.SHIPPED, shipped)
         return AssignmentsMessage("complete", session.sequence, changes)
 
     def _incremental(self, session: Session) -> AssignmentsMessage:
@@ -1384,6 +1392,19 @@ class Dispatcher:
             self._commit_known(session, new_tasks, new_secrets,
                                new_configs, set(volumes), sequence,
                                ship_bases)
+            if lifecycle.enabled():
+                # lifecycle plane: the SHIPPED leg, one batched record
+                # per delivered diff (commit runs only once the agent
+                # actually received the message). Only the FIRST ship
+                # matters — a task re-ships on every version bump, so
+                # filter to the ASSIGNED-state copy (later re-ships are
+                # also rank-rejected by the recorder; this keeps the
+                # batch small).
+                shipped = [a.item.id for a in changes
+                           if a.kind == "task" and a.action == "update"
+                           and a.item.status.state == TaskState.ASSIGNED]
+                if shipped:
+                    lifecycle.record_batch(lifecycle.SHIPPED, shipped)
 
         return msg, commit
 
@@ -1400,6 +1421,11 @@ class Dispatcher:
         latest: dict[tuple[str, str], object] = {}
         for task_id, status, node_id in updates:
             latest[(task_id, node_id)] = status
+
+        # lifecycle plane: statuses actually WRITTEN (ownership +
+        # monotonicity passed) collect here and file as ONE batched
+        # record after the store batch; disarmed, no list is ever built
+        written: list[tuple] | None = [] if lifecycle.enabled() else None
 
         def cb(batch):
             for (task_id, node_id), status in latest.items():
@@ -1428,6 +1454,8 @@ class Dispatcher:
                     cur = cur.copy()
                     cur.status = status
                     tx.update(cur)
+                    if written is not None:
+                        written.append((task_id, status.state))
                 batch.update(update_one)
 
         try:
@@ -1438,3 +1466,6 @@ class Dispatcher:
             # once hid a NameError that dropped every status in the batch
             log.warning("status flush failed; statuses will be re-reported",
                         exc_info=True)
+        else:
+            if written:
+                lifecycle.record_pairs(written)

@@ -17,8 +17,9 @@ from ..api.objects import (
     EventDelete,
     EventUpdate,
     Node,
+    Task,
 )
-from ..api.types import NodeStatusState
+from ..api.types import NodeStatusState, TaskState
 from ..store import by
 from ..store.watch import ChannelClosed
 
@@ -30,6 +31,11 @@ class MetricsCollector:
         self._objects: Counter = Counter()  # table -> count
         self._node_states: Counter = Counter()  # NodeStatusState name -> count
         self._node_state_by_id: dict[str, str] = {}
+        # task-state gauge family (reference collector.go swarm_tasks
+        # `ns.NewLabeledGauge("tasks", ..., "state")`): maintained from
+        # the SAME event stream as the object/node gauges
+        self._task_states: Counter = Counter()  # TaskState name -> count
+        self._task_state_by_id: dict[str, str] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -49,6 +55,7 @@ class MetricsCollector:
             return {
                 "objects": dict(self._objects),
                 "node_states": {k: v for k, v in self._node_states.items() if v},
+                "task_states": {k: v for k, v in self._task_states.items() if v},
             }
 
     def prometheus_text(self) -> str:
@@ -70,6 +77,11 @@ class MetricsCollector:
             lines.append('# TYPE swarm_node_info gauge')
         for state, n in sorted(snap["node_states"].items()):
             lines.append(f'swarm_node_info{{state="{state.lower()}"}} {n}')
+        if snap["task_states"]:
+            lines.append('# HELP swarm_tasks tasks by observed state')
+            lines.append('# TYPE swarm_tasks gauge')
+        for state, n in sorted(snap["task_states"].items()):
+            lines.append(f'swarm_tasks{{state="{state.lower()}"}} {n}')
         for h in sorted(all_histograms(), key=lambda h: h.name):
             lines.append(h.prometheus_text())
         # per-RPC started/handled/latency families (rpc/server.py — the
@@ -85,6 +97,8 @@ class MetricsCollector:
             self._objects.clear()
             self._node_states.clear()
             self._node_state_by_id.clear()
+            self._task_states.clear()
+            self._task_state_by_id.clear()
 
             def scan(tx):
                 for cls in ALL_TABLES.values():
@@ -95,6 +109,11 @@ class MetricsCollector:
                             state = NodeStatusState(n.status.state).name
                             self._node_state_by_id[n.id] = state
                             self._node_states[state] += 1
+                    elif cls is Task:
+                        for t in objs:
+                            state = TaskState(t.status.state).name
+                            self._task_state_by_id[t.id] = state
+                            self._task_states[state] += 1
 
             self.store.view(scan)
 
@@ -142,3 +161,16 @@ class MetricsCollector:
                             self._node_states[old] -= 1
                         self._node_states[new_state] += 1
                         self._node_state_by_id[obj.id] = new_state
+            elif isinstance(obj, Task):
+                if isinstance(ev, EventDelete):
+                    old = self._task_state_by_id.pop(obj.id, None)
+                    if old:
+                        self._task_states[old] -= 1
+                else:
+                    new_state = TaskState(obj.status.state).name
+                    old = self._task_state_by_id.get(obj.id)
+                    if old != new_state:
+                        if old:
+                            self._task_states[old] -= 1
+                        self._task_states[new_state] += 1
+                        self._task_state_by_id[obj.id] = new_state

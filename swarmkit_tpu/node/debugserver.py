@@ -28,6 +28,13 @@ manager/manager.go:551-562 grpc_prometheus). The Python-native analogue:
   /debug/trace/recent
                  the armed flight recorder's current contents as JSON
                  span trees (empty when disarmed)
+  /debug/slo     task-lifecycle SLO snapshot from the armed lifecycle
+                 recorder (utils/lifecycle.py): NEW→RUNNING percentiles
+                 (exact + histogram-estimate), transition counts, and
+                 the stage-attribution report; ?since= / ?window=N
+                 restrict to the trailing recovery window
+  /debug/tasks   ?id=<task>: that task's state-transition timeline;
+                 without id, tracked tasks with their latest stage
 
 Bound to loopback by default; no TLS (match the reference's plaintext debug
 listeners, which are operator-only surfaces).
@@ -35,6 +42,7 @@ listeners, which are operator-only surfaces).
 from __future__ import annotations
 
 import json
+import math
 import sys
 import threading
 try:
@@ -255,6 +263,14 @@ class DebugServer:
                         self._reply(json.dumps(outer._trace(self.path),
                                                indent=2),
                                     ctype="application/json")
+                    elif self.path.startswith("/debug/slo"):
+                        self._reply(json.dumps(outer._slo(self.path),
+                                               indent=2),
+                                    ctype="application/json")
+                    elif self.path.startswith("/debug/tasks"):
+                        self._reply(json.dumps(outer._tasks(self.path),
+                                               indent=2),
+                                    ctype="application/json")
                     elif self.path.startswith("/debug/profile"):
                         from urllib.parse import parse_qs, urlparse
 
@@ -348,8 +364,73 @@ class DebugServer:
         return {"armed": not temporary, "window_s": seconds,
                 "spans": r.spans_started, "traces": trees}
 
+    def _slo(self, path: str) -> dict:
+        """/debug/slo: startup percentiles (exact recorder samples AND
+        the conservative /metrics-histogram estimates), transition
+        counts, and the stage-attribution report. `?since=<wall-clock
+        seconds>` restricts to tasks that reached RUNNING in the
+        trailing window (`?window=N` is sugar for since=now-N)."""
+        from urllib.parse import parse_qs, urlparse
+
+        from ..utils import lifecycle, slo
+
+        r = lifecycle.recorder()
+        if r is None:
+            return {"armed": False}
+        q = parse_qs(urlparse(path).query)
+        since = None
+        try:
+            if "since" in q:
+                since = float(q["since"][0])
+            elif "window" in q:
+                since = time.time() - float(q["window"][0])
+        except ValueError:
+            since = None
+        # the canonical report (shared with control.get_slo_report),
+        # extended with the debug-only extras
+        out = slo.report(r, since=since)
+        out["batches"] = r.batches
+        # what an alerting pipeline scraping /metrics would see; a rank
+        # in the +Inf tail serializes as null — json.dumps would emit
+        # the non-RFC token `Infinity` and break strict parsers exactly
+        # on the degraded cluster an operator is inspecting
+        est = slo.histogram_quantile(lifecycle.startup_histogram(), 99)
+        out["startup"]["p99_s_histogram"] = (
+            None if est is not None and not math.isfinite(est) else est)
+        out["transitions"] = {f"{a}->{b}": n for (a, b), n
+                              in sorted(r.transition_counts().items())}
+        return out
+
+    def _tasks(self, path: str) -> dict:
+        """/debug/tasks?id=<task>: one task's timeline; without id, the
+        tracked task ids with their latest stage (newest-inserted last,
+        capped at 200)."""
+        from urllib.parse import parse_qs, urlparse
+
+        from ..utils import lifecycle
+
+        r = lifecycle.recorder()
+        if r is None:
+            return {"armed": False}
+        q = parse_qs(urlparse(path).query)
+        task_id = q.get("id", [""])[0]
+        if task_id:
+            tl = r.timeline(task_id)
+            return {"armed": True, "id": task_id,
+                    "events": [{"stage": s, "t": t} for s, t in tl]}
+        # key-list copy + 200 short per-timeline fetches — never a deep
+        # copy of every timeline under the recorder lock (this endpoint
+        # is polled on degraded clusters, exactly when the record sites
+        # contending on that lock are busiest)
+        out = {}
+        for tid in r.task_ids()[-200:]:
+            tl = r.timeline(tid)
+            if tl:
+                out[tid] = tl[-1][0]
+        return {"armed": True, "tasks": len(r), "latest_stage": out}
+
     def _vars(self) -> dict:
-        from ..utils import failpoints, trace
+        from ..utils import failpoints, lifecycle, trace
 
         node = self.node
         out = {
@@ -362,6 +443,7 @@ class DebugServer:
             # in conftest teardown assertions
             "failpoints_armed": failpoints.active(),
             "trace_armed": trace.active(),
+            "lifecycle_armed": lifecycle.active(),
         }
         store = _find(node, "store")
         if store is not None and getattr(store, "op_counts", None) \

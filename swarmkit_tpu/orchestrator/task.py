@@ -12,6 +12,7 @@ from collections import defaultdict
 from ..api.objects import Service, Task, Version
 from ..api.specs import deepcopy_spec, spec_equal
 from ..api.types import ServiceMode, TaskState
+from ..utils import lifecycle
 from ..utils.identity import new_id
 
 
@@ -32,6 +33,12 @@ def new_task(cluster, service: Service, slot: int, node_id: str = "") -> Task:
     t.spec_version = Version(service.spec_version.index)
     if is_job(service) and service.job_status is not None:
         t.job_iteration = Version(service.job_status.get("iteration", 0))
+    # lifecycle plane: the NEW record, stamped with the same timestamp
+    # the status carries (one truthiness test disarmed). The factory is
+    # the one decision boundary every orchestrator's task creation
+    # crosses; a creation whose enclosing tx aborts leaves a timeline
+    # that simply never advances (observability, not bookkeeping).
+    lifecycle.record(t.id, TaskState.NEW, t=t.status.timestamp)
     return t
 
 
@@ -94,3 +101,7 @@ def mark_shutdown(cur: Task) -> None:
         cur.status.state = TaskState.SHUTDOWN
         cur.status.message = "shut down before assignment"
         cur.status.timestamp = _time.time()
+        # the orchestrator is the status writer of record here (no agent
+        # will ever report this task): close its timeline too
+        lifecycle.record(cur.id, TaskState.SHUTDOWN,
+                         t=cur.status.timestamp)

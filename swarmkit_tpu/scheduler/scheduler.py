@@ -39,7 +39,7 @@ from ..api.types import NodeStatusState, TaskState
 from ..store import by
 from ..store.memory import MAX_CHANGES_PER_TRANSACTION, MemoryStore
 from ..store.watch import ChannelClosed
-from ..utils import failpoints, trace
+from ..utils import failpoints, lifecycle, trace
 from .batch import apply_placements, cpu_schedule_encoded, materialize_orders
 from .encode import IncrementalEncoder, TaskGroup
 from .filters import Pipeline
@@ -987,6 +987,15 @@ class Scheduler:
             applied_by_group.setdefault(gi, []).append((cur, ni))
 
         self._batched_writes(decisions, write_decision)
+        if applied_by_group and lifecycle.enabled():
+            # lifecycle plane: ONE batched ASSIGNED record covering every
+            # task this wave placed — never per task inside the commit
+            # walk (the plane's batching contract; id assembly is gated
+            # so the disarmed path allocates nothing)
+            lifecycle.record_batch(
+                TaskState.ASSIGNED,
+                [t.id for placed in applied_by_group.values()
+                 for t, _ in placed])
         # conflicted decisions stay in the pool; the serial path relies
         # on the causing store write's still-queued event to retrigger,
         # but a pipelined wave may conflict on an event consumed while
@@ -1135,6 +1144,12 @@ class Scheduler:
             pipeline.set_task(t)
             decided.append((t, pipeline.process(info)))
 
+        # lifecycle plane: collect ids INSIDE the tx, only for writes
+        # that actually landed (same discipline as the wave path's
+        # applied_by_group — a task deleted mid-decision must not file a
+        # phantom ASSIGNED that then reads as "stuck" forever)
+        applied: list[str] | None = [] if lifecycle.enabled() else None
+
         def write_preassigned(tx, item):
             task, fits = item
             cur = tx.get_task(task.id)
@@ -1147,6 +1162,8 @@ class Scheduler:
                 cur.status.message = (
                     "scheduler confirmed task can run on preassigned node")
                 tx.update(cur)
+                if applied is not None:
+                    applied.append(cur.id)
             else:
                 # keep PENDING and retry later — transient pressure
                 # (resources, ports) may clear (reference
@@ -1159,6 +1176,8 @@ class Scheduler:
                     tx.update(cur)
 
         self._batched_writes(decided, write_preassigned)
+        if applied:
+            lifecycle.record_batch(TaskState.ASSIGNED, applied)
         for task, fits in decided:
             if fits:
                 self.preassigned.pop(task.id, None)

@@ -1,0 +1,393 @@
+"""Task lifecycle SLO plane: per-task state-transition timelines.
+
+The reference measures time-to-RUNNING with an external polling tool
+(cmd/swarm-bench `collector.go`) — containers phone home over UDP and a
+client computes percentiles. That signal is exactly what a production
+SLO needs (p50/p99 NEW→RUNNING, recovery-after-fault), but polling
+cannot attribute WHERE the time went: orchestrator create → allocator
+PENDING → scheduler wave commit → dispatcher ship → agent RUNNING each
+own a slice, and the trace plane (utils/trace.py) only times the stages
+themselves, never a given task's path through them. This module makes
+the task lifecycle a first-class observability plane: a per-task
+timeline of (stage, t) entries recorded at the decision boundaries that
+already write task state, from which
+
+  * `task_transition_seconds{from,to}` — a HistogramFamily of per-leg
+    latencies (every consecutive timeline pair), and
+  * `task_startup_seconds` — the end-to-end NEW→RUNNING histogram
+
+are derived into the /metrics exposition, `/debug/slo` and
+`/debug/tasks?id=` serve timelines from the debugserver, and
+`utils/slo.py` evaluates declarative SLO specs against the data.
+
+Cost contract — identical to utils/failpoints.py and utils/trace.py:
+DISARMED, every record site costs ONE module-global truthiness test
+(`lifecycle._REC is None`) and never constructs a timeline entry, takes
+a lock, or builds a list. Sites that must assemble an id list first
+guard the assembly with `lifecycle.enabled()`. The conftest fails any
+test that leaks an armed recorder; the bench `slo_plane` row pins
+`disarmed_record_allocs == 0` on the steady wave and dispatcher flush
+paths.
+
+Batching discipline: the scheduler's record site is ONE
+`record_batch()` call per wave covering every placed task — never a
+per-task call inside the commit walk; the dispatcher's status flush
+files every written status in ONE `record_pairs()` call; the
+dispatcher's ship site files one batch per served session. The
+span-in-loop lint rule (analysis/lint.py) enforces the guarded pattern
+for any `lifecycle.*` call inside a loop body in the audited hot
+modules.
+
+Timeline taxonomy and SLO spec format are documented in
+docs/observability.md.
+"""
+from __future__ import annotations
+
+import os
+import time
+from collections import OrderedDict
+from contextlib import contextmanager
+from typing import Any, Iterable
+
+from ..analysis.lockgraph import make_lock
+from ..api.types import TaskState
+
+_REG_LOCK = make_lock('utils.lifecycle.REG_LOCK')
+# The armed recorder, or None. Replaced wholesale on arm/disarm so hot
+# sites read it without a lock; the disarmed fast path everywhere is
+# `if _REC is None: return`.
+_REC: "LifecycleRecorder | None" = None
+
+DEFAULT_CAPACITY = 16384
+
+# Synthetic stage: the dispatcher delivered the task's assignment to its
+# node's agent. Not a TaskState — the store never sees it — but it is
+# the decision boundary that splits "scheduler committed" from "agent
+# acted", which is exactly the attribution an SLO burn needs.
+SHIPPED = "SHIPPED"
+
+# Stage ordering: TaskState's monotonic ranks, with SHIPPED slotted
+# between ASSIGNED (the scheduler committed the placement) and ACCEPTED
+# (the agent took it). Timelines reject non-advancing records — a
+# re-ship after a version bump, a repeated RUNNING report, or an
+# out-of-order arrival never pollutes the transition histograms.
+STAGE_RANK: dict[str, int] = {s.name: int(s) for s in TaskState}
+STAGE_RANK[SHIPPED] = int(TaskState.ASSIGNED) + 1
+
+
+def _stage_name(stage) -> str:
+    # accepts TaskState members, their ints, and plain stage strings
+    if isinstance(stage, TaskState):
+        return stage.name
+    if isinstance(stage, int):
+        try:
+            return TaskState(stage).name
+        except ValueError:
+            return str(stage)
+    return str(stage)
+
+
+class LifecycleRecorder:
+    """Bounded map of task id -> timeline (list of (stage, t) pairs).
+
+    `capacity` bounds the number of TASKS tracked; when full, the
+    oldest-inserted timeline is evicted (FIFO — under churn the old
+    tasks are the retired ones; a long-stuck task re-enters the map on
+    its next record, with its NEW lost, and simply stops contributing
+    startup samples). Records arrive from many threads (orchestrator
+    txs, the scheduler's CommitWorker, the dispatcher flush loop), so
+    every mutation serializes under one lock; the timestamp for a batch
+    is taken ONCE.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY, clock=None):
+        self.capacity = max(16, int(capacity))
+        self.clock = clock
+        self._lock = make_lock('utils.lifecycle.recorder')
+        # task id -> list[(stage, t)]; OrderedDict for FIFO eviction
+        self._timelines: "OrderedDict[str, list]" = OrderedDict()
+        self.records = 0          # timeline entries appended
+        self.batches = 0          # record_batch/record_pairs calls filed
+        self.rejected = 0         # non-advancing records dropped
+        self.evicted = 0          # timelines that fell off the map
+
+    # ------------------------------------------------------------- writing
+    def _now(self) -> float:
+        return self.clock.time() if self.clock is not None else time.time()
+
+    def _append(self, task_id: str, stage: str, t: float) -> None:
+        """Append under self._lock (caller holds it). Non-advancing
+        stages (rank <= last rank) are dropped: timelines mirror the
+        task state machine's monotonicity, so re-ships and repeated
+        status reports never create phantom transitions."""
+        tl = self._timelines.get(task_id)
+        if tl is None:
+            if len(self._timelines) >= self.capacity:
+                self._timelines.popitem(last=False)
+                self.evicted += 1
+            tl = []
+            self._timelines[task_id] = tl
+        if tl:
+            last_rank = STAGE_RANK.get(tl[-1][0], -1)
+            if STAGE_RANK.get(stage, last_rank + 1) <= last_rank:
+                self.rejected += 1
+                return
+        tl.append((stage, t))
+        self.records += 1
+        if _REC is self:
+            # a record landing in a RETIRED recorder (site read _REC just
+            # before a disarm) keeps its timeline for forensics but must
+            # not grow the process-global histograms — those populate
+            # only while armed (the trace-plane rule)
+            self._observe(tl, stage, t)
+
+    @staticmethod
+    def _observe(tl: list, stage: str, t: float) -> None:
+        prev_stage, prev_t = tl[-2] if len(tl) >= 2 else (None, 0.0)
+        if prev_stage is not None:
+            _transition_family().observe((prev_stage, stage),
+                                         max(0.0, t - prev_t))
+        if stage == TaskState.RUNNING.name:
+            t0 = next((e[1] for e in tl if e[0] == TaskState.NEW.name),
+                      None)
+            if t0 is not None:
+                _startup_histogram().observe(max(0.0, t - t0))
+
+    def record(self, task_id: str, stage, t: float | None = None) -> None:
+        stage = _stage_name(stage)
+        with self._lock:
+            self._append(task_id, stage, self._now() if t is None else t)
+
+    def record_batch(self, stage, task_ids: Iterable[str],
+                     t: float | None = None) -> None:
+        """One stage for many tasks — the scheduler's one-call-per-wave
+        shape. One lock hold, one timestamp for the whole batch."""
+        stage = _stage_name(stage)
+        with self._lock:
+            now = self._now() if t is None else t
+            self.batches += 1
+            for task_id in task_ids:
+                self._append(task_id, stage, now)
+
+    def record_pairs(self, pairs: Iterable[tuple],
+                     t: float | None = None) -> None:
+        """Mixed (task_id, stage) pairs in one call — the dispatcher's
+        status-flush shape (a flush writes RUNNING for some tasks,
+        FAILED for others)."""
+        with self._lock:
+            now = self._now() if t is None else t
+            self.batches += 1
+            for task_id, stage in pairs:
+                self._append(task_id, _stage_name(stage), now)
+
+    # ------------------------------------------------------------- reading
+    def timeline(self, task_id: str) -> list[tuple[str, float]]:
+        with self._lock:
+            return list(self._timelines.get(task_id, ()))
+
+    def timelines(self) -> dict[str, list]:
+        """Snapshot of every tracked timeline (copies — safe to iterate
+        while records keep landing)."""
+        with self._lock:
+            return {tid: list(tl) for tid, tl in self._timelines.items()}
+
+    def task_ids(self) -> list[str]:
+        with self._lock:
+            return list(self._timelines)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._timelines)
+
+    def startup_samples(self, since: float | None = None) -> list[float]:
+        """NEW→RUNNING seconds for every task whose timeline holds both
+        endpoints; `since` keeps tasks whose RUNNING landed at/after that
+        wall-clock time (recovery-SLO windows)."""
+        out = []
+        with self._lock:
+            for tl in self._timelines.values():
+                t0 = t1 = None
+                for stage, t in tl:
+                    if stage == TaskState.NEW.name:
+                        t0 = t
+                    elif stage == TaskState.RUNNING.name:
+                        t1 = t
+                        break
+                if t0 is not None and t1 is not None \
+                        and (since is None or t1 >= since):
+                    out.append(t1 - t0)
+        return out
+
+    def transition_counts(self) -> dict[tuple[str, str], int]:
+        counts: dict[tuple[str, str], int] = {}
+        with self._lock:
+            for tl in self._timelines.values():
+                for a, b in zip(tl, tl[1:]):
+                    key = (a[0], b[0])
+                    counts[key] = counts.get(key, 0) + 1
+        return counts
+
+    def stuck_tasks(self, older_than: float = 0.0) -> list[tuple]:
+        """(task_id, last_stage, age_s, timeline) for every task whose
+        latest stage is non-terminal and short of RUNNING — the
+        chaos-failure forensics payload (dumped next to CHAOS_SEED)."""
+        now = self._now()
+        out = []
+        with self._lock:
+            for tid, tl in self._timelines.items():
+                if not tl:
+                    continue
+                stage, t = tl[-1]
+                rank = STAGE_RANK.get(stage, 0)
+                if rank >= int(TaskState.RUNNING):
+                    continue
+                age = now - t
+                if age >= older_than:
+                    out.append((tid, stage, age, list(tl)))
+        out.sort(key=lambda r: -r[2])
+        return out
+
+    def stuck_text(self, n: int = 16, older_than: float = 0.0) -> str:
+        """Human-readable stuck-task tails, oldest first — what the
+        chaos harness prints under CHAOS_SEED."""
+        lines = []
+        for tid, stage, age, tl in self.stuck_tasks(older_than)[:n]:
+            path = " -> ".join(
+                f"{s}@{t - tl[0][1]:+.3f}s" for s, t in tl)
+            lines.append(f"task {tid} stuck at {stage} for {age:.3f}s: "
+                         f"{path}")
+        return "\n".join(lines)
+
+
+# ------------------------------------------------- derived metric families
+# resolved lazily at first armed observation so importing this module
+# registers nothing (the trace-plane rule for derived families)
+_FAMILIES: dict[str, Any] = {}
+
+
+def _transition_family():
+    fam = _FAMILIES.get("transition")
+    if fam is None:
+        from . import metrics
+
+        fam = metrics.histogram_family(
+            "task_transition_seconds",
+            "Per-task lifecycle transition latency, derived from the "
+            "lifecycle timeline recorder (armed only)",
+            ("from", "to"))
+        _FAMILIES["transition"] = fam
+    return fam
+
+
+def _startup_histogram():
+    h = _FAMILIES.get("startup")
+    if h is None:
+        from . import metrics
+
+        h = metrics.histogram(
+            "task_startup_seconds",
+            "End-to-end NEW->RUNNING task startup latency, derived from "
+            "the lifecycle timeline recorder (armed only)")
+        _FAMILIES["startup"] = h
+    return h
+
+
+def startup_histogram():
+    """The e2e histogram (creating it if needed) — the read surface for
+    /debug/slo and SLO evaluation against /metrics data."""
+    return _startup_histogram()
+
+
+def transition_family():
+    return _transition_family()
+
+
+# ------------------------------------------------------------------ sites
+def enabled() -> bool:
+    return _REC is not None
+
+
+def record(task_id: str, stage, t: float | None = None) -> None:
+    """Record one task's stage crossing. Disarmed: one truthiness test,
+    nothing else."""
+    r = _REC
+    if r is None:
+        return
+    r.record(task_id, stage, t=t)
+
+
+def record_batch(stage, task_ids, t: float | None = None) -> None:
+    """One stage, many tasks, ONE call — the per-wave shape. Callers
+    that must first assemble `task_ids` guard the assembly with
+    `lifecycle.enabled()` so the disarmed path allocates nothing."""
+    r = _REC
+    if r is None:
+        return
+    r.record_batch(stage, task_ids, t=t)
+
+
+def record_pairs(pairs, t: float | None = None) -> None:
+    """Mixed (task_id, stage) pairs, ONE call — the status-flush shape."""
+    r = _REC
+    if r is None:
+        return
+    r.record_pairs(pairs, t=t)
+
+
+# ----------------------------------------------------------------- arming
+def arm(capacity: int = DEFAULT_CAPACITY, clock=None) -> LifecycleRecorder:
+    """Arm the lifecycle plane (idempotent re-arm replaces the
+    recorder)."""
+    global _REC
+    r = LifecycleRecorder(capacity=capacity, clock=clock)
+    with _REG_LOCK:
+        _REC = r
+    return r
+
+
+def disarm() -> None:
+    global _REC
+    with _REG_LOCK:
+        _REC = None
+
+
+def active() -> bool:
+    return _REC is not None
+
+
+def recorder() -> LifecycleRecorder | None:
+    return _REC
+
+
+@contextmanager
+def armed(capacity: int = DEFAULT_CAPACITY, clock=None):
+    """`with lifecycle.armed() as rec: ...` — the per-test arming
+    surface; always disarms on exit (the conftest guard fails leaks)."""
+    r = arm(capacity=capacity, clock=clock)
+    try:
+        yield r
+    finally:
+        disarm()
+
+
+def stuck_text(n: int = 16, older_than: float = 0.0) -> str:
+    """Forensics helper: stuck-task timeline tails from the armed
+    recorder, or "" when disarmed — the chaos harness prints it next to
+    CHAOS_SEED and the flight-recorder tail without caring whether the
+    plane is on."""
+    r = _REC
+    return r.stuck_text(n, older_than=older_than) if r is not None else ""
+
+
+# ---------------------------------------------------------------- env var
+# SWARMKIT_TPU_LIFECYCLE arms the recorder in subprocesses (multi-process
+# swarmd tests, live-daemon SLO capture): "1" or a task capacity.
+_ENV_VAR = "SWARMKIT_TPU_LIFECYCLE"
+
+_env_val = os.environ.get(_ENV_VAR, "").strip().lower()
+if _env_val and _env_val not in ("0", "false", "off", "no"):
+    try:
+        _cap = int(_env_val)
+    except ValueError:
+        _cap = DEFAULT_CAPACITY
+    arm(capacity=_cap if _cap > 1 else DEFAULT_CAPACITY)

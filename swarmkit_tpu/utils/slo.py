@@ -1,0 +1,307 @@
+"""Declarative SLO specs over the task lifecycle plane.
+
+An SLO here is "percentile P of metric M stays at or under T seconds",
+evaluated from the data the lifecycle recorder (utils/lifecycle.py)
+produces: exact startup/transition samples when a recorder is at hand
+(tests, the chaos soak, /debug/slo), or the derived
+`task_startup_seconds` / `task_transition_seconds{from,to}` histograms
+when only the /metrics exposition is (bucket-upper-bound estimates —
+conservative, never optimistic).
+
+Also home of the shared percentile math: `quantile_nearest_rank` is the
+ONE nearest-rank implementation (swarmbench's old
+`int(p/100*len(lat))` was biased — p50 of 2 samples returned the MAX;
+correct nearest-rank is `ceil(p/100*n) - 1`), reused by
+cmd/swarmbench.py, bench.py and the evaluators below.
+
+The stage-attribution report decomposes end-to-end NEW→RUNNING latency
+into per-leg (from→to) slices from the same timelines. Per task the leg
+durations telescope to the e2e exactly, so the aggregate invariant —
+total per-leg seconds over complete timelines equals total e2e seconds
+within tolerance — is the report's self-check (`reconciled`); a
+violation means a record site double-filed or a timeline was truncated
+mid-analysis.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+from ..api.types import TaskState
+
+
+def quantile_nearest_rank(values, p: float):
+    """Nearest-rank percentile (R-1): the smallest sample at or above
+    rank ceil(p/100 * n). p=0 → min, p=100 → max; None on no samples.
+    `values` need not be sorted."""
+    return quantiles_nearest_rank(values, (p,))[p]
+
+
+def quantiles_nearest_rank(values, ps) -> dict:
+    """Several nearest-rank percentiles over ONE sort (report builders
+    ask for p50/p90/p99 of the same samples — re-sorting per percentile
+    was measurable at recorder capacity). Returns {p: value-or-None}."""
+    for p in ps:
+        if not 0 <= p <= 100:
+            raise ValueError(f"percentile out of range: {p}")
+    if not values:
+        return {p: None for p in ps}
+    vs = sorted(values)
+    n = len(vs)
+    return {p: vs[max(0, min(n, math.ceil(p / 100.0 * n)) - 1)]
+            for p in ps}
+
+
+def histogram_quantile(hist, p: float):
+    """Nearest-rank estimate from a utils.metrics Histogram: the upper
+    bound of the first bucket whose cumulative count reaches the rank —
+    conservative, the estimate only ever rounds UP. A rank landing in
+    the +Inf tail returns math.inf (the sample exceeded every finite
+    bucket; an SLO check against it must FAIL, never pass on the
+    largest finite bound). None on an empty histogram."""
+    counts, _total, n = hist.snapshot()
+    if n == 0:
+        return None
+    if not 0 <= p <= 100:
+        raise ValueError(f"percentile out of range: {p}")
+    rank = max(1, math.ceil(p / 100.0 * n))
+    cum = 0
+    for bound, c in zip(hist.buckets, counts):
+        cum += c
+        if cum >= rank:
+            return bound
+    return math.inf
+
+
+@dataclass(frozen=True)
+class SLOSpec:
+    """One objective: percentile `p` of `metric` ≤ `target_s`.
+
+    metric: "startup" (NEW→RUNNING e2e) or a ("FROM", "TO") stage pair
+    (one timeline leg, e.g. ("ASSIGNED", "SHIPPED")).
+    min_samples: below this the SLO is VACUOUS (ok, n counted) rather
+    than failed — a fresh window with two tasks must not page.
+    """
+
+    name: str
+    p: float
+    target_s: float
+    metric: object = "startup"
+    min_samples: int = 1
+
+
+@dataclass
+class SLOResult:
+    spec: SLOSpec
+    n: int
+    observed_s: float | None
+    ok: bool
+
+    def as_dict(self) -> dict:
+        m = self.spec.metric
+        return {
+            "name": self.spec.name,
+            "metric": (m if isinstance(m, str) else f"{m[0]}->{m[1]}"),
+            "p": self.spec.p,
+            "target_s": self.spec.target_s,
+            "observed_s": (None if self.observed_s is None
+                           else round(self.observed_s, 6)),
+            "n": self.n,
+            "ok": self.ok,
+        }
+
+
+@dataclass
+class SLOReport:
+    results: list[SLOResult] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return all(r.ok for r in self.results)
+
+    def as_dict(self) -> dict:
+        return {"ok": self.ok,
+                "results": [r.as_dict() for r in self.results]}
+
+    def render(self) -> str:
+        lines = []
+        for r in self.results:
+            status = "OK " if r.ok else "FAIL"
+            obs = ("n/a" if r.observed_s is None
+                   else f"{r.observed_s * 1e3:.1f}ms")
+            lines.append(
+                f"[{status}] {r.spec.name}: p{r.spec.p:g} = {obs} "
+                f"(target {r.spec.target_s * 1e3:.1f}ms, n={r.n})")
+        return "\n".join(lines)
+
+
+def _leg_samples(timelines: dict, leg: tuple, since: float | None) -> list:
+    out = []
+    for tl in timelines.values():
+        for a, b in zip(tl, tl[1:]):
+            if a[0] == leg[0] and b[0] == leg[1] \
+                    and (since is None or b[1] >= since):
+                out.append(b[1] - a[1])
+    return out
+
+
+def _eval_one(spec: SLOSpec, samples: list) -> SLOResult:
+    """THE per-spec evaluation semantics (vacuous below min_samples,
+    nearest-rank, ≤ target) — shared by evaluate() and
+    evaluate_samples() so the two can never diverge."""
+    n = len(samples)
+    if n < spec.min_samples:
+        return SLOResult(spec, n, None, True)
+    obs = quantile_nearest_rank(samples, spec.p)
+    return SLOResult(spec, n, obs, obs <= spec.target_s)
+
+
+def evaluate_samples(specs, samples: list) -> SLOReport:
+    """Evaluate specs against one pre-collected sample list (swarmbench's
+    client-side latencies; every spec reads the same samples)."""
+    report = SLOReport()
+    for spec in specs:
+        report.results.append(_eval_one(spec, samples))
+    return report
+
+
+def evaluate(specs, rec, since: float | None = None) -> SLOReport:
+    """Evaluate specs against a LifecycleRecorder's exact samples.
+    `since` restricts to legs/startups whose COMPLETING record landed at
+    or after that wall-clock time — the recovery-SLO window."""
+    timelines = None
+    report = SLOReport()
+    for spec in specs:
+        if spec.metric == "startup":
+            samples = rec.startup_samples(since=since)
+        else:
+            if timelines is None:
+                timelines = rec.timelines()
+            samples = _leg_samples(timelines, tuple(spec.metric), since)
+        report.results.append(_eval_one(spec, samples))
+    return report
+
+
+def evaluate_histograms(specs) -> SLOReport:
+    """Evaluate specs against the derived /metrics histograms (no
+    recorder needed — what an operator's alerting would do; estimates
+    are bucket upper bounds, so only conservative failures)."""
+    from . import lifecycle
+
+    report = SLOReport()
+    for spec in specs:
+        if spec.metric == "startup":
+            hist = lifecycle.startup_histogram()
+        else:
+            hist = lifecycle.transition_family().child(tuple(spec.metric))
+        _counts, _total, n = hist.snapshot()
+        if n < spec.min_samples:
+            report.results.append(SLOResult(spec, n, None, True))
+            continue
+        obs = histogram_quantile(hist, spec.p)
+        report.results.append(
+            SLOResult(spec, n, obs,
+                      obs is not None and obs <= spec.target_s))
+    return report
+
+
+# --------------------------------------------------------- attribution
+RUNNING = TaskState.RUNNING.name
+NEW = TaskState.NEW.name
+
+
+def attribution(rec, since: float | None = None,
+                tolerance: float = 1e-6) -> dict:
+    """Stage-attribution report over COMPLETE timelines (NEW first,
+    RUNNING reached): per-leg {n, total_s, mean_s, p50_s, p99_s, share}
+    plus the reconciliation self-check — summed leg seconds must equal
+    summed e2e seconds within `tolerance` (relative). Legs PAST the
+    RUNNING record (failure/teardown) are excluded: attribution explains
+    startup latency only."""
+    legs: dict[tuple[str, str], list[float]] = {}
+    e2e: list[float] = []
+    for tl in rec.timelines().values():
+        if not tl or tl[0][0] != NEW:
+            continue
+        # the startup prefix: everything through the RUNNING record
+        idx = next((i for i, e in enumerate(tl) if e[0] == RUNNING), None)
+        if idx is None:
+            continue
+        if since is not None and tl[idx][1] < since:
+            continue
+        e2e.append(tl[idx][1] - tl[0][1])
+        for a, b in zip(tl[:idx], tl[1:idx + 1]):
+            legs.setdefault((a[0], b[0]), []).append(b[1] - a[1])
+    total_e2e = sum(e2e)
+    total_legs = sum(sum(ds) for ds in legs.values())
+    reconciled = (abs(total_legs - total_e2e)
+                  <= max(tolerance * max(total_e2e, total_legs), 1e-9))
+
+    def leg_stats(ds):
+        qs = quantiles_nearest_rank(ds, (50, 99))
+        return {
+            "n": len(ds),
+            "total_s": round(sum(ds), 6),
+            "mean_s": round(sum(ds) / len(ds), 6),
+            "p50_s": round(qs[50], 6),
+            "p99_s": round(qs[99], 6),
+            "share": round(sum(ds) / total_e2e, 4) if total_e2e else None,
+        }
+
+    stages = {f"{a}->{b}": leg_stats(ds)
+              for (a, b), ds in sorted(legs.items(),
+                                       key=lambda kv: -sum(kv[1]))}
+    e2e_qs = quantiles_nearest_rank(e2e, (50, 99))
+    return {
+        "tasks": len(e2e),
+        "e2e": {
+            "total_s": round(total_e2e, 6),
+            "mean_s": round(total_e2e / len(e2e), 6) if e2e else None,
+            "p50_s": (round(e2e_qs[50], 6) if e2e else None),
+            "p99_s": (round(e2e_qs[99], 6) if e2e else None),
+        },
+        "stages": stages,
+        "stage_total_s": round(total_legs, 6),
+        "reconciled": reconciled,
+    }
+
+
+def report(rec, since: float | None = None) -> dict:
+    """The canonical SLO snapshot dict over a LifecycleRecorder — the
+    ONE report builder behind `control.get_slo_report` and the
+    debugserver's `/debug/slo` (which extends it with its
+    histogram-estimate/transition extras); `{"armed": False}` when
+    `rec` is None."""
+    if rec is None:
+        return {"armed": False}
+    samples = rec.startup_samples(since=since)
+    qs = quantiles_nearest_rank(samples, (50, 90, 99))
+    return {
+        "armed": True,
+        "tasks": len(rec),
+        "records": rec.records,
+        "startup": {
+            "n": len(samples),
+            "p50_s": qs[50],
+            "p90_s": qs[90],
+            "p99_s": qs[99],
+        },
+        "attribution": attribution(rec, since=since),
+    }
+
+
+def parse_slo_arg(spec: str, metric="startup") -> list[SLOSpec]:
+    """Parse the CLI `--slo "p50:0.5,p99:2.0"` form into specs (seconds
+    targets; swarmbench and the soak share it)."""
+    specs = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" not in part or not part.lower().startswith("p"):
+            raise ValueError(f"bad SLO spec {part!r} (want pNN:seconds)")
+        p_s, target_s = part.split(":", 1)
+        specs.append(SLOSpec(name=f"startup_{p_s.lower()}",
+                             p=float(p_s[1:]), target_s=float(target_s),
+                             metric=metric))
+    return specs

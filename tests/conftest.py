@@ -144,6 +144,34 @@ def _trace_disarmed():
         "test leaked an armed tracer/flight recorder (trace.disarm())"
 
 
+@pytest.fixture(autouse=True)
+def _lifecycle_guard(request):
+    """Lifecycle-plane guard (ISSUE 10), the trace/failpoints shape: a
+    leaked armed recorder would tax every later test's task-write paths
+    and mix their timelines into this test's data — fail the leaking
+    test itself and always disarm. Chaos-marked tests get the plane
+    ARMED (like the lockgraph tiers): the recovery-SLO soak and the
+    chaos report hook read timelines/stuck-task tails from it."""
+    from swarmkit_tpu.utils import lifecycle
+
+    armed_here = request.node.get_closest_marker("chaos") is not None
+    state = lifecycle.arm() if armed_here else None
+    yield
+    if state is not None:
+        # a chaos test that re-armed over the fixture's recorder and
+        # did not disarm leaked its own — fail IT, not the next test
+        leaked = lifecycle.recorder() is not None \
+            and lifecycle.recorder() is not state
+        lifecycle.disarm()
+        assert not leaked, \
+            "test leaked an armed lifecycle recorder (lifecycle.disarm())"
+    else:
+        leaked = lifecycle.active()
+        lifecycle.disarm()
+        assert not leaked, \
+            "test leaked an armed lifecycle recorder (lifecycle.disarm())"
+
+
 @pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_makereport(item, call):
     """Chaos forensics: a failing chaos-marked test gets the flight-
@@ -157,11 +185,18 @@ def pytest_runtest_makereport(item, call):
     rep = outcome.get_result()
     if rep.when == "call" and rep.failed \
             and item.get_closest_marker("chaos") is not None:
-        from swarmkit_tpu.utils import trace
+        from swarmkit_tpu.utils import lifecycle, trace
 
         tail = trace.last_tail_text(40)
         if tail:
             rep.sections.append(("flight recorder tail", tail))
+        # stuck-task timeline tails next to the span tail: which tasks
+        # never reached RUNNING, and which lifecycle leg they died in
+        # (the lifecycle guard arms the plane for every chaos test and
+        # disarms in teardown, AFTER this hook reads it)
+        stuck = lifecycle.stuck_text(12)
+        if stuck:
+            rep.sections.append(("stuck task timelines", stuck))
 
 
 def pytest_configure(config):

@@ -174,6 +174,51 @@ def test_span_in_loop_enabled_guard_clean():
     assert findings(src, AUDITED) == []
 
 
+def test_lifecycle_record_in_loop_fires_unguarded():
+    # ISSUE 10 satellite: lifecycle record sites share the span-in-loop
+    # discipline — the scheduler batches ONE record per wave, never a
+    # per-task record() inside the walk
+    src = """
+    from ..utils import lifecycle
+    def f(tasks):
+        for t in tasks:
+            lifecycle.record(t.id, "ASSIGNED")
+    """
+    assert findings(src, AUDITED) == ["span-in-loop"]
+
+
+def test_lifecycle_record_batch_in_loop_fires_unguarded():
+    src = """
+    from ..utils import lifecycle
+    def f(waves):
+        for w in waves:
+            lifecycle.record_batch("ASSIGNED", w.ids)
+    """
+    assert findings(src, AUDITED) == ["span-in-loop"]
+
+
+def test_lifecycle_enabled_guard_clean():
+    src = """
+    from ..utils import lifecycle
+    def f(tasks):
+        for t in tasks:
+            if lifecycle.enabled():
+                lifecycle.record(t.id, "ASSIGNED")
+    """
+    assert findings(src, AUDITED) == []
+
+
+def test_lifecycle_batch_outside_loop_clean():
+    # the blessed shape: assemble under the enabled() gate, file once
+    src = """
+    from ..utils import lifecycle
+    def f(placed):
+        if lifecycle.enabled():
+            lifecycle.record_batch("ASSIGNED", [t.id for t in placed])
+    """
+    assert findings(src, AUDITED) == []
+
+
 def test_span_outside_loop_clean():
     src = """
     from ..utils import trace

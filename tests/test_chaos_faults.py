@@ -42,7 +42,7 @@ def chaos_seed(seed):
     """Print the reproduction seed on ANY failure — plus the flight-
     recorder tail (which barrier/flush/commit stage last retired before
     the failure); always disarm both planes."""
-    from swarmkit_tpu.utils import trace
+    from swarmkit_tpu.utils import lifecycle, trace
 
     rec = trace.arm(capacity=2048)
     try:
@@ -53,6 +53,13 @@ def chaos_seed(seed):
         if tail:
             print("---- flight recorder tail ----")
             print(tail)
+        # the conftest arms the lifecycle plane for every chaos test:
+        # tasks that never reached RUNNING dump their timeline tails
+        # here, next to the seed (ISSUE 10 forensics contract)
+        stuck = lifecycle.stuck_text(12)
+        if stuck:
+            print("---- stuck task timelines ----")
+            print(stuck)
         raise
     finally:
         failpoints.disarm_all()

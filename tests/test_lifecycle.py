@@ -1,0 +1,649 @@
+"""Task lifecycle SLO plane (ISSUE 10): the per-task state-transition
+recorder, the shared percentile math, SLO evaluation + stage
+attribution, the disarmed-cost op-count guards on the scheduler wave
+and dispatcher flush paths, the swarmbench watch collector's
+zero-scan property, and the /debug/slo + /debug/tasks endpoints.
+"""
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from swarmkit_tpu.api.objects import Node, Service, Task, TaskStatus
+from swarmkit_tpu.api.specs import Annotations, NodeDescription, Resources
+from swarmkit_tpu.api.types import NodeStatusState, TaskState
+from swarmkit_tpu.scheduler.scheduler import Scheduler
+from swarmkit_tpu.store.memory import MemoryStore
+from swarmkit_tpu.utils import lifecycle, slo
+from swarmkit_tpu.utils.clock import FakeClock
+
+
+# ----------------------------------------------------------- percentiles
+def test_quantile_nearest_rank_known_values():
+    # THE satellite pin: the old swarmbench pct() returned lat[1] (the
+    # MAX) for p50 of two samples; correct nearest-rank is the first
+    assert slo.quantile_nearest_rank([1.0, 2.0], 50) == 1.0
+    assert slo.quantile_nearest_rank([2.0, 1.0], 50) == 1.0   # unsorted
+    assert slo.quantile_nearest_rank([1, 2, 3, 4, 5], 50) == 3
+    assert slo.quantile_nearest_rank([1, 2, 3, 4], 50) == 2
+    assert slo.quantile_nearest_rank([1, 2, 3, 4], 75) == 3
+    assert slo.quantile_nearest_rank([1, 2, 3, 4], 100) == 4
+    assert slo.quantile_nearest_rank([1, 2, 3, 4], 0) == 1
+    vals = list(range(1, 101))
+    assert slo.quantile_nearest_rank(vals, 99) == 99
+    assert slo.quantile_nearest_rank(vals, 90) == 90
+    assert slo.quantile_nearest_rank(vals, 1) == 1
+    assert slo.quantile_nearest_rank([], 50) is None
+    with pytest.raises(ValueError):
+        slo.quantile_nearest_rank([1], 101)
+
+
+def test_histogram_quantile_upper_bound_estimate():
+    from swarmkit_tpu.utils.metrics import Histogram
+
+    h = Histogram("q_test")
+    assert slo.histogram_quantile(h, 99) is None
+    for _ in range(99):
+        h.observe(0.004)      # lands in the 0.005 bucket
+    h.observe(4.0)            # lands in the 5.0 bucket
+    assert slo.histogram_quantile(h, 50) == 0.005
+    assert slo.histogram_quantile(h, 99) == 0.005
+    assert slo.histogram_quantile(h, 100) == 5.0
+    # a rank in the +Inf tail must NOT fall back to the largest finite
+    # bound — an SLO check against it must fail, never pass optimistically
+    h.observe(100.0)
+    import math
+    assert slo.histogram_quantile(h, 100) == math.inf
+    rep = slo.evaluate_histograms([])  # smoke: empty spec list
+    assert rep.ok
+
+
+# -------------------------------------------------------------- recorder
+def test_recorder_timeline_monotonic_and_batches():
+    clock = FakeClock(start=100.0)
+    with lifecycle.armed(clock=clock) as rec:
+        lifecycle.record("t1", TaskState.NEW)
+        clock.advance(1.0)
+        lifecycle.record_batch(TaskState.PENDING, ["t1", "t2"])
+        clock.advance(1.0)
+        lifecycle.record_batch(TaskState.ASSIGNED, ["t1", "t2"])
+        clock.advance(0.5)
+        lifecycle.record_batch(lifecycle.SHIPPED, ["t1"])
+        # re-ship and a repeated/backward report: rank-rejected
+        lifecycle.record_batch(lifecycle.SHIPPED, ["t1"])
+        lifecycle.record("t1", TaskState.PENDING)
+        clock.advance(0.5)
+        lifecycle.record_pairs([("t1", TaskState.RUNNING),
+                                ("t2", TaskState.FAILED)])
+
+        assert [s for s, _ in rec.timeline("t1")] == [
+            "NEW", "PENDING", "ASSIGNED", "SHIPPED", "RUNNING"]
+        assert [s for s, _ in rec.timeline("t2")] == [
+            "PENDING", "ASSIGNED", "FAILED"]
+        assert rec.rejected == 2
+        assert rec.batches == 5
+        # one timestamp per batch: both tasks' PENDING stamps identical
+        assert rec.timeline("t1")[1][1] == rec.timeline("t2")[0][1]
+        # e2e sample: NEW@101 (batch t=101 after advance) .. RUNNING@103
+        samples = rec.startup_samples()
+        assert samples == [pytest.approx(3.0)]
+        # t2 never reached RUNNING and has no NEW: no sample, but it IS
+        # terminal so it is not "stuck"
+        stuck = rec.stuck_tasks()
+        assert stuck == []
+    assert not lifecycle.active()
+
+
+def test_recorder_capacity_eviction_and_stuck_report():
+    clock = FakeClock(start=0.0)
+    rec = lifecycle.LifecycleRecorder(capacity=16, clock=clock)
+    for i in range(32):
+        rec.record(f"t{i:02d}", TaskState.NEW)
+    assert len(rec) == 16
+    assert rec.evicted == 16
+    assert rec.timeline("t00") == []          # oldest fell off
+    clock.advance(9.0)
+    rec.record("t31", TaskState.PENDING)
+    stuck = rec.stuck_tasks(older_than=5.0)
+    # t31 advanced at t=9 (not older than 5s ago): excluded; the rest
+    # of the survivors are stuck at NEW since t=0
+    assert all(s[1] == "NEW" for s in stuck)
+    assert len(stuck) == 15
+    text = rec.stuck_text(4)
+    assert "stuck at NEW" in text and "NEW@+0.000s" in text
+
+
+def test_derived_histograms_populate_only_while_armed():
+    fam = lifecycle.transition_family()
+    hist = lifecycle.startup_histogram()
+    n_leg = fam.child(("NEW", "RUNNING"))._n
+    n_e2e = hist.snapshot()[2]
+    with lifecycle.armed() as rec:
+        lifecycle.record("h1", TaskState.NEW, t=10.0)
+        lifecycle.record("h1", TaskState.RUNNING, t=10.5)
+    assert fam.child(("NEW", "RUNNING"))._n == n_leg + 1
+    assert hist.snapshot()[2] == n_e2e + 1
+    # a record into the RETIRED recorder (site grabbed it pre-disarm)
+    # keeps forensics but must not grow the process-global histograms
+    rec.record("h2", TaskState.NEW, t=11.0)
+    rec.record("h2", TaskState.RUNNING, t=11.5)
+    assert rec.timeline("h2") != []
+    assert fam.child(("NEW", "RUNNING"))._n == n_leg + 1
+    assert hist.snapshot()[2] == n_e2e + 1
+
+
+# ------------------------------------------------------------ SLO + attrib
+def _mk_rec_with_timelines():
+    clock = FakeClock(start=0.0)
+    rec = lifecycle.LifecycleRecorder(clock=clock)
+    # task a: NEW@0 -> PENDING@1 -> ASSIGNED@2 -> RUNNING@4   (e2e 4)
+    # task b: NEW@0 -> PENDING@2 -> ASSIGNED@3 -> RUNNING@10  (e2e 10)
+    for tid, stamps in (("a", (0, 1, 2, 4)), ("b", (0, 2, 3, 10))):
+        for stage, t in zip((TaskState.NEW, TaskState.PENDING,
+                             TaskState.ASSIGNED, TaskState.RUNNING),
+                            stamps):
+            rec.record(tid, stage, t=float(t))
+    return rec
+
+
+def test_slo_evaluate_pass_fail_and_vacuous():
+    rec = _mk_rec_with_timelines()
+    report = slo.evaluate([
+        slo.SLOSpec("p50_ok", p=50, target_s=5.0),
+        slo.SLOSpec("p99_fail", p=99, target_s=5.0),
+        slo.SLOSpec("leg_ok", p=99, target_s=2.0,
+                    metric=("PENDING", "ASSIGNED")),
+        slo.SLOSpec("vacuous", p=50, target_s=0.001, min_samples=10),
+    ], rec)
+    by_name = {r.spec.name: r for r in report.results}
+    assert by_name["p50_ok"].ok and by_name["p50_ok"].observed_s == 4.0
+    assert not by_name["p99_fail"].ok
+    assert by_name["p99_fail"].observed_s == 10.0
+    assert by_name["leg_ok"].ok and by_name["leg_ok"].observed_s == 1.0
+    assert by_name["vacuous"].ok and by_name["vacuous"].observed_s is None
+    assert not report.ok
+    assert "FAIL" in report.render() and "p99_fail" in report.render()
+    # the recovery window: only task a's RUNNING (t=4) is < 5; with
+    # since=5 only b (RUNNING@10) remains and p50 is 10
+    windowed = slo.evaluate([slo.SLOSpec("w", p=50, target_s=5.0)],
+                            rec, since=5.0)
+    assert windowed.results[0].observed_s == 10.0
+
+
+def test_attribution_reconciles_and_ranks_stages():
+    rec = _mk_rec_with_timelines()
+    rep = slo.attribution(rec)
+    assert rep["tasks"] == 2
+    assert rep["reconciled"], rep
+    assert rep["e2e"]["total_s"] == pytest.approx(14.0)
+    assert rep["stage_total_s"] == pytest.approx(14.0)
+    # ASSIGNED->RUNNING carries 2+7=9 of the 14s: the top stage
+    top = next(iter(rep["stages"]))
+    assert top == "ASSIGNED->RUNNING"
+    assert rep["stages"][top]["total_s"] == pytest.approx(9.0)
+    assert rep["stages"][top]["share"] == pytest.approx(9 / 14, abs=1e-3)
+    # incomplete timelines (no RUNNING) are excluded, not mis-summed
+    rec.record("c", TaskState.NEW, t=0.0)
+    rec.record("c", TaskState.PENDING, t=1.0)
+    rep2 = slo.attribution(rec)
+    assert rep2["tasks"] == 2 and rep2["reconciled"]
+
+
+def test_parse_slo_arg():
+    specs = slo.parse_slo_arg("p50:0.5, p99:2.0")
+    assert [(s.p, s.target_s) for s in specs] == [(50.0, 0.5), (99.0, 2.0)]
+    with pytest.raises(ValueError):
+        slo.parse_slo_arg("q50:1")
+
+
+# --------------------------------------------- disarmed-cost op-count guard
+class _RecordAllocGuard:
+    """Failpoints/trace-style op-count guard: with the plane off, NO
+    recorder method may run anywhere in the exercised paths."""
+
+    METHODS = ("record", "record_batch", "record_pairs")
+
+    def __enter__(self):
+        self._orig = {m: getattr(lifecycle.LifecycleRecorder, m)
+                      for m in self.METHODS}
+
+        def _boom(*a, **k):
+            raise AssertionError(
+                "disarmed hot path filed a lifecycle record")
+
+        for m in self.METHODS:
+            setattr(lifecycle.LifecycleRecorder, m, _boom)
+        return self
+
+    def __exit__(self, *exc):
+        for m, fn in self._orig.items():
+            setattr(lifecycle.LifecycleRecorder, m, fn)
+
+
+def _seed_wave(store, n_nodes=4, n_tasks=12):
+    svc = Service(id="svc-lc")
+    svc.spec.annotations = Annotations(name="svc-lc")
+
+    def seed(tx):
+        tx.create(svc)
+        for i in range(n_nodes):
+            n = Node(id=f"n{i}")
+            n.status.state = NodeStatusState.READY
+            n.description = NodeDescription(
+                hostname=n.id,
+                resources=Resources(nano_cpus=8 * 10**9,
+                                    memory_bytes=16 * 2**30))
+            tx.create(n)
+        for i in range(n_tasks):
+            t = Task(id=f"t{i:03d}", service_id="svc-lc", slot=i + 1)
+            t.status.state = TaskState.PENDING
+            t.desired_state = TaskState.RUNNING
+            tx.create(t)
+    store.update(seed)
+
+
+def test_disarmed_zero_records_on_scheduler_wave_path():
+    assert not lifecycle.active()
+    store = MemoryStore()
+    _seed_wave(store)
+    with _RecordAllocGuard():
+        s = Scheduler(store, backend="cpu")
+        ch = s._setup()
+        s.tick()
+        store.queue.stop_watch(ch)
+    tasks = store.view().find_tasks()
+    assert all(t.status.state == TaskState.ASSIGNED for t in tasks)
+
+
+def test_disarmed_zero_records_on_dispatcher_flush_path():
+    from test_dispatcher_fanout import driven_dispatcher
+
+    assert not lifecycle.active()
+    store = MemoryStore()
+    _seed_wave(store, n_nodes=1, n_tasks=4)
+
+    def assign(tx):
+        for t in tx.find_tasks():
+            cur = t.copy()
+            cur.node_id = "n0"
+            cur.status.state = TaskState.ASSIGNED
+            tx.update(cur)
+    store.update(assign)
+    d, ch = driven_dispatcher(store)
+    try:
+        with _RecordAllocGuard():
+            sid = d.register("n0")
+            d.assignments("n0", sid)
+            d.update_task_status(
+                "n0", sid, [(f"t{i:03d}",
+                             TaskStatus(state=TaskState.RUNNING))
+                            for i in range(4)])
+            d._flush_statuses()
+            d._send_incrementals()
+    finally:
+        store.queue.stop_watch(ch)
+        d._hb_wheel.stop()
+    assert all(t.status.state == TaskState.RUNNING
+               for t in store.view().find_tasks())
+
+
+def test_scheduler_files_one_batched_record_per_wave():
+    """Armed, a wave's commit files exactly ONE record_batch covering
+    every placed task — never a per-task record() from the walk."""
+    store = MemoryStore()
+    _seed_wave(store, n_nodes=4, n_tasks=20)
+    singles = {"n": 0}
+    orig_record = lifecycle.LifecycleRecorder.record
+
+    def spy_record(self, *a, **k):
+        singles["n"] += 1
+        return orig_record(self, *a, **k)
+
+    lifecycle.LifecycleRecorder.record = spy_record
+    try:
+        with lifecycle.armed() as rec:
+            s = Scheduler(store, backend="cpu")
+            ch = s._setup()
+            s.tick()
+            store.queue.stop_watch(ch)
+            assert rec.batches == 1
+            assert singles["n"] == 0
+            assigned = [tid for tid in rec.task_ids()
+                        if rec.timeline(tid)[-1][0] == "ASSIGNED"]
+            assert len(assigned) == 20
+    finally:
+        lifecycle.LifecycleRecorder.record = orig_record
+
+
+def test_end_to_end_slice_timelines_and_attribution():
+    """The full in-process slice: orchestrator factory -> scheduler wave
+    -> dispatcher ship -> status write-back, all record sites live, the
+    attribution report reconciling against e2e."""
+    from test_dispatcher_fanout import driven_dispatcher
+
+    from swarmkit_tpu.orchestrator.task import new_task
+
+    store = MemoryStore()
+    with lifecycle.armed() as rec:
+        svc = Service(id="svc-e2e")
+        svc.spec.annotations = Annotations(name="svc-e2e")
+
+        def seed(tx):
+            tx.create(svc)
+            n = Node(id="n0")
+            n.status.state = NodeStatusState.READY
+            n.description = NodeDescription(
+                hostname="n0",
+                resources=Resources(nano_cpus=8 * 10**9,
+                                    memory_bytes=16 * 2**30))
+            tx.create(n)
+            for i in range(6):
+                t = new_task(None, svc, i + 1)      # NEW record
+                t.status.state = TaskState.PENDING  # allocator shortcut
+                tx.create(t)
+        store.update(seed)
+
+        s = Scheduler(store, backend="cpu")
+        ch = s._setup()
+        s.tick()                                     # ASSIGNED batch
+        store.queue.stop_watch(ch)
+        d, dch = driven_dispatcher(store)
+        try:
+            sid = d.register("n0")
+            d.assignments("n0", sid)                 # SHIPPED batch
+            ids = [t.id for t in store.view().find_tasks()]
+            d.update_task_status(
+                "n0", sid,
+                [(tid, TaskStatus(state=TaskState.RUNNING))
+                 for tid in ids])
+            d._flush_statuses()                      # RUNNING pairs
+        finally:
+            store.queue.stop_watch(dch)
+            d._hb_wheel.stop()
+
+        samples = rec.startup_samples()
+        assert len(samples) == 6
+        for tid in ids:
+            assert [st for st, _ in rec.timeline(tid)] == [
+                "NEW", "ASSIGNED", "SHIPPED", "RUNNING"]
+        rep = slo.attribution(rec)
+        assert rep["tasks"] == 6 and rep["reconciled"]
+        assert set(rep["stages"]) == {"NEW->ASSIGNED",
+                                      "ASSIGNED->SHIPPED",
+                                      "SHIPPED->RUNNING"}
+        # SLO evaluation over the real slice (generous bound: this is
+        # an in-process store; the objective is the plumbing, not speed)
+        report = slo.evaluate(
+            [slo.SLOSpec("p99", p=99, target_s=30.0)], rec)
+        assert report.ok
+
+
+def test_mark_shutdown_records_terminal_stage():
+    from swarmkit_tpu.orchestrator.task import mark_shutdown, new_task
+
+    svc = Service(id="svc-sd")
+    with lifecycle.armed() as rec:
+        t = new_task(None, svc, 1)
+        mark_shutdown(t)
+        assert [st for st, _ in rec.timeline(t.id)] == ["NEW", "SHUTDOWN"]
+
+
+def test_allocator_records_pending_batch():
+    """The allocator's NEW->PENDING move files one batched record."""
+    from swarmkit_tpu.allocator.allocator import Allocator
+
+    store = MemoryStore()
+    svc = Service(id="svc-al")
+    svc.spec.annotations = Annotations(name="svc-al")
+
+    def seed(tx):
+        tx.create(svc)
+        for i in range(3):
+            t = Task(id=f"al{i}", service_id="svc-al", slot=i + 1)
+            t.status.state = TaskState.NEW
+            tx.create(t)
+    store.update(seed)
+    alloc = Allocator(store)
+    with lifecycle.armed() as rec:
+        alloc._allocate_tasks(["al0", "al1", "al2"])
+        assert rec.batches == 1
+        for i in range(3):
+            assert rec.timeline(f"al{i}") and \
+                rec.timeline(f"al{i}")[-1][0] == "PENDING"
+    assert all(t.status.state == TaskState.PENDING
+               for t in store.view().find_tasks())
+
+
+# ------------------------------------------------------- metrics satellite
+def test_metrics_collector_task_state_gauges():
+    # file-mode load: the manager package __init__ pulls in the CA stack
+    # (optional `cryptography` wheel) — same trick as test_trace
+    import os
+
+    from test_trace import _load_module
+
+    MetricsCollector = _load_module(
+        os.path.join("manager", "metrics.py"),
+        "swarmkit_tpu.manager.metrics").MetricsCollector
+
+    from test_scheduler import wait_for
+
+    store = MemoryStore()
+    mc = MetricsCollector(store)
+    mc.start()
+    try:
+        def seed(tx):
+            for i, state in enumerate((TaskState.NEW, TaskState.RUNNING,
+                                       TaskState.RUNNING)):
+                t = Task(id=f"mt{i}")
+                t.status.state = state
+                tx.create(t)
+        store.update(seed)
+        assert wait_for(
+            lambda: mc.snapshot()["task_states"].get("RUNNING") == 2
+            and mc.snapshot()["task_states"].get("NEW") == 1, timeout=5)
+
+        def advance(tx):
+            cur = tx.get_task("mt0").copy()
+            cur.status.state = TaskState.FAILED
+            tx.update(cur)
+        store.update(advance)
+        assert wait_for(
+            lambda: mc.snapshot()["task_states"].get("FAILED") == 1
+            and not mc.snapshot()["task_states"].get("NEW"), timeout=5)
+        text = mc.prometheus_text()
+        assert '# TYPE swarm_tasks gauge' in text
+        assert 'swarm_tasks{state="running"} 2' in text
+
+        store.update(lambda tx: tx.delete(Task, "mt1"))
+        assert wait_for(
+            lambda: mc.snapshot()["task_states"].get("RUNNING") == 1,
+            timeout=5)
+    finally:
+        mc.stop()
+
+
+# --------------------------------------------------- swarmbench collector
+def test_swarmbench_collector_watch_path_zero_scans():
+    """The satellite pin: the watch-API collector takes zero per-sample
+    find_tasks scans (the old loop scanned every 100ms)."""
+    from swarmkit_tpu.cmd.swarmbench import StartupCollector, pump_channel
+    from swarmkit_tpu.watchapi.watch import WatchAPI, WatchSelector
+
+    store = MemoryStore()
+    api = WatchAPI(store)
+    ch = api.watch([WatchSelector(kind="task")])
+    collector = StartupCollector()
+    stop = threading.Event()
+    pump = threading.Thread(target=pump_channel,
+                            args=(ch, collector, stop), daemon=True)
+    pump.start()
+    try:
+        scans0 = store.op_counts.get("find_task", 0)
+        for i in range(5):
+            t = Task(id=f"wb{i}", service_id="s")
+            t.status.state = TaskState.NEW
+            store.update(lambda tx, t=t: tx.create(t))
+        time.sleep(0.05)
+        for i in range(5):
+            def run(tx, tid=f"wb{i}"):
+                cur = tx.get_task(tid).copy()
+                cur.status.state = TaskState.RUNNING
+                tx.update(cur)
+            store.update(run)
+        from test_scheduler import wait_for
+
+        assert wait_for(lambda: collector.running() == 5, timeout=5)
+        assert store.op_counts.get("find_task", 0) == scans0
+        assert all(lat >= 0.0 for lat in collector.samples())
+    finally:
+        stop.set()
+        ch.close()
+        pump.join(timeout=5)
+
+    # contrast: one poll-mode sample = one find_tasks scan
+    collector.feed_poll(store.view(lambda tx: tx.find_tasks()))
+    assert store.op_counts.get("find_task", 0) == scans0 + 1
+
+
+def test_swarmbench_collector_ignores_preexisting_and_terminal():
+    from swarmkit_tpu.api.objects import EventCreate, EventUpdate
+    from swarmkit_tpu.cmd.swarmbench import StartupCollector
+
+    c = StartupCollector(clock=lambda: 0.0)
+    t = Task(id="x", service_id="s")
+    t.status.state = TaskState.NEW
+    # an update for a task never seen as created: no sample
+    t2 = Task(id="y", service_id="s")
+    t2.status.state = TaskState.RUNNING
+    c.feed(EventUpdate(obj=t2, old=None), now=1.0)
+    assert c.running() == 0
+    c.feed(EventCreate(obj=t), now=1.0)
+    # straight to FAILED: never counts as a startup
+    t_failed = Task(id="x", service_id="s")
+    t_failed.status.state = TaskState.FAILED
+    c.feed(EventUpdate(obj=t_failed, old=None), now=2.0)
+    assert c.running() == 0
+    t_run = Task(id="x", service_id="s")
+    t_run.status.state = TaskState.RUNNING
+    c.feed(EventUpdate(obj=t_run, old=None), now=3.0)
+    # FAILED is >= RUNNING and was seen first: id excluded for good
+    assert c.running() == 0
+
+
+def test_swarmbench_zero_samples_fails_slo_gate():
+    # a dead watch stream (0 samples) must NOT certify the objective
+    from swarmkit_tpu.cmd.swarmbench import StartupCollector, build_report
+
+    c = StartupCollector(clock=lambda: 0.0)
+    report = build_report(c, slo_specs=slo.parse_slo_arg("p99:2.0"))
+    assert not report["slo"]["ok"]
+    assert not report["slo"]["measured"]
+
+
+def test_swarmbench_service_filter_and_created_at_fallback():
+    from swarmkit_tpu.api.objects import EventCreate, EventUpdate
+    from swarmkit_tpu.cmd.swarmbench import StartupCollector
+
+    c = StartupCollector(clock=lambda: 50.0, service_filter=True)
+    c.allow("mine")
+    foreign = Task(id="f1", service_id="theirs")
+    foreign.status.state = TaskState.NEW
+    c.feed(EventCreate(obj=foreign))
+    foreign_run = Task(id="f1", service_id="theirs")
+    foreign_run.status.state = TaskState.RUNNING
+    c.feed(EventUpdate(obj=foreign_run, old=None))
+    assert c.running() == 0            # foreign service never admitted
+    # missed CREATE (subscription race): the store-stamped wall-clock
+    # created_at backstops the measurement
+    mine = Task(id="m1", service_id="mine")
+    mine.status.state = TaskState.RUNNING
+    mine.meta.created_at = 47.5
+    c.feed(EventUpdate(obj=mine, old=None))
+    assert c.samples() == [pytest.approx(2.5)]
+
+
+def test_swarmbench_report_slo_gate():
+    from swarmkit_tpu.cmd.swarmbench import StartupCollector, build_report
+
+    c = StartupCollector(clock=lambda: 0.0)
+    c.latencies.update({f"t{i}": 0.1 * (i + 1) for i in range(10)})
+    report = build_report(
+        c, replicas=10,
+        slo_specs=slo.parse_slo_arg("p50:0.6,p99:0.5"))
+    assert report["running"] == 10
+    assert report["p50_s"] == 0.5
+    assert report["time_to_all_s"] == 1.0
+    assert not report["slo"]["ok"]          # p99 = 1.0 > 0.5
+    by_name = {r["name"]: r for r in report["slo"]["results"]}
+    assert by_name["startup_p50"]["ok"]
+    assert not by_name["startup_p99"]["ok"]
+
+
+# ---------------------------------------------------- debugserver surface
+def _stub_node(store):
+    import types
+
+    return types.SimpleNamespace(
+        node_id="stub", addr="127.0.0.1:0", is_leader=False,
+        store=store, raft=None, manager=None)
+
+
+def _get_json(addr, path):
+    with urllib.request.urlopen(f"http://{addr}{path}") as resp:
+        return json.loads(resp.read().decode())
+
+
+def test_debugserver_slo_and_tasks_endpoints():
+    from test_trace import _load_debugserver
+
+    DebugServer = _load_debugserver().DebugServer
+
+    store = MemoryStore()
+    srv = DebugServer("127.0.0.1:0", _stub_node(store))
+    srv.start()
+    try:
+        assert _get_json(srv.addr, "/debug/slo") == {"armed": False}
+        assert _get_json(srv.addr, "/debug/tasks") == {"armed": False}
+        with lifecycle.armed():
+            lifecycle.record("d1", TaskState.NEW, t=100.0)
+            lifecycle.record("d1", TaskState.ASSIGNED, t=100.5)
+            lifecycle.record("d1", TaskState.RUNNING, t=101.0)
+            out = _get_json(srv.addr, "/debug/slo")
+            assert out["armed"] and out["tasks"] == 1
+            assert out["startup"]["n"] == 1
+            assert out["startup"]["p99_s"] == pytest.approx(1.0)
+            assert out["transitions"]["NEW->ASSIGNED"] == 1
+            assert out["attribution"]["reconciled"]
+            tl = _get_json(srv.addr, "/debug/tasks?id=d1")
+            assert [e["stage"] for e in tl["events"]] == [
+                "NEW", "ASSIGNED", "RUNNING"]
+            listing = _get_json(srv.addr, "/debug/tasks")
+            assert listing["latest_stage"] == {"d1": "RUNNING"}
+            # the arm state is visible in /debug/vars, like the other
+            # planes
+            vars_ = _get_json(srv.addr, "/debug/vars")
+            assert vars_["lifecycle_armed"] is True
+        vars_ = _get_json(srv.addr, "/debug/vars")
+        assert vars_["lifecycle_armed"] is False
+    finally:
+        srv.stop()
+
+
+# ----------------------------------------------------- controlapi surface
+def test_controlapi_slo_report_and_timeline():
+    from swarmkit_tpu.controlapi.control import ControlAPI
+
+    api = ControlAPI(MemoryStore())
+    assert api.get_slo_report() == {"armed": False}
+    assert api.get_task_timeline("nope") == []
+    with lifecycle.armed():
+        lifecycle.record("c1", TaskState.NEW, t=1.0)
+        lifecycle.record("c1", TaskState.RUNNING, t=3.0)
+        rep = api.get_slo_report()
+        assert rep["armed"] and rep["startup"]["n"] == 1
+        assert rep["startup"]["p50_s"] == pytest.approx(2.0)
+        assert api.get_task_timeline("c1") == [("NEW", 1.0),
+                                               ("RUNNING", 3.0)]
