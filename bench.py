@@ -1492,6 +1492,120 @@ def bench_slo_plane(np):
     }
 
 
+def bench_store_plane(np, sizes=(100_000, 1_000_000)):
+    """Columnar store plane acceptance row (ISSUE 11): whole-wave task
+    write-back through the object path (per-task get + two tree copies +
+    full re-index) vs the columnar plane (`store.assign_wave`) at each
+    size — the 1M row is the BENCH_r05 e2e ceiling this plane attacks.
+    Reported per size: ops/s for the object path, the eager columnar
+    path (the production Scheduler's, events included) and the lazy
+    columnar path (array scatter + owed object views; `heal_s` is the
+    deferred materialization paid on first object read). Parity is
+    end-state equality (state/node/version per task) between paths PLUS
+    columns bit-equal to a from-scratch rebuild. Acceptance: lazy
+    columnar write-back >= 10x object ops/s (tier-1 smoke-checks the
+    same fn at a CPU-smoke size — tests/test_bench_diag.py)."""
+    from swarmkit_tpu.api.objects import Node, Task
+    from swarmkit_tpu.api.types import NodeStatusState, TaskState
+    from swarmkit_tpu.store.columnar import ColumnarTasks
+    from swarmkit_tpu.store.memory import MemoryStore
+
+    N_NODES = 64
+
+    def seed_store(n):
+        store = MemoryStore()
+
+        def seed_nodes(tx):
+            for i in range(N_NODES):
+                node = Node(id=f"sp{i:03d}")
+                node.status.state = NodeStatusState.READY
+                tx.create(node)
+        store.update(seed_nodes)
+
+        def seed_tasks(tx):
+            for i in range(n):
+                t = Task(id=f"t{i:07d}", service_id=f"svc{i % 100}",
+                         slot=i + 1)
+                t.status.state = TaskState.PENDING
+                t.desired_state = TaskState.RUNNING
+                tx.create(t)
+        store.update(seed_tasks)
+        return store
+
+    def image(store):
+        return {t.id: (int(t.status.state), t.node_id,
+                       t.meta.version.index)
+                for t in store.view(lambda tx: tx.find_tasks())}
+
+    out_sizes = {}
+    parity_all = True
+    for n in sizes:
+        wave = [(f"t{i:07d}", f"sp{i % N_NODES:03d}") for i in range(n)]
+
+        # -- object path: the pre-ISSUE-11 write-back shape ------------
+        s1 = seed_store(n)
+
+        def write_all(tx):
+            for tid, nid in wave:
+                cur = tx.get_task(tid).copy()
+                cur.node_id = nid
+                cur.status.state = TaskState.ASSIGNED
+                cur.status.message = "scheduler assigned task to node"
+                cur.status.timestamp = time.time()
+                tx.update(cur)
+        t0 = time.perf_counter()
+        s1.update(write_all)
+        object_s = time.perf_counter() - t0
+        img_obj = image(s1)
+        del s1
+
+        # -- eager columnar (the Scheduler's path; events identical) ---
+        s2 = seed_store(n)
+        t0 = time.perf_counter()
+        codes_e, _ = s2.assign_wave(wave)
+        eager_s = time.perf_counter() - t0
+        ok = all(c == 0 for c in codes_e)
+        parity = ok and image(s2) == img_obj
+        del s2
+
+        # -- lazy columnar (array scatter; object views owed) ----------
+        s3 = seed_store(n)
+        t0 = time.perf_counter()
+        codes_l, _ = s3.assign_wave(wave, lazy=True)
+        lazy_s = time.perf_counter() - t0
+        ok = ok and all(c == 0 for c in codes_l)
+        t0 = time.perf_counter()
+        s3._heal_stale_tasks()
+        heal_s = time.perf_counter() - t0
+        parity = parity and ok and image(s3) == img_obj
+        rebuilt = ColumnarTasks.rebuild(
+            s3.view(lambda tx: tx.find_tasks()))
+        parity = parity and ColumnarTasks.snapshots_equal(
+            s3.columnar.snapshot(), rebuilt.snapshot())
+        op_counts = {k: v for k, v in s3.op_counts.items()
+                     if k.startswith("columnar")}
+        del s3, rebuilt
+
+        parity_all = parity_all and parity
+        out_sizes[str(n)] = {
+            "object_ops_s": round(n / max(object_s, 1e-9), 1),
+            "columnar_eager_ops_s": round(n / max(eager_s, 1e-9), 1),
+            "columnar_ops_s": round(n / max(lazy_s, 1e-9), 1),
+            "heal_s": round(heal_s, 4),
+            "speedup_x": round(object_s / max(lazy_s, 1e-9), 2),
+            "speedup_eager_x": round(object_s / max(eager_s, 1e-9), 2),
+            "speedup_with_heal_x": round(
+                object_s / max(lazy_s + heal_s, 1e-9), 2),
+            "op_counts": op_counts,
+            "parity": parity,
+        }
+    return {
+        "sizes": out_sizes,
+        "speedup_min_x": min(v["speedup_x"] for v in out_sizes.values()),
+        "parity": parity_all,
+    }
+
+
 def bench_host_micro(np):
     """The BASELINE.md harness rows the reference ships benchmarks for
     but no numbers (store ops memory_test.go:2028-2120, watch queue at
@@ -1811,6 +1925,9 @@ def main():
         # the assignment-diff plane at the 10k-node design point
         # (VERDICT item 7)
         ("dispatcher_fanout_10k", lambda: bench_dispatcher_fanout(np)),
+        # ISSUE 11: columnar vs object-store wave write-back at
+        # 100k/1M tasks (>=10x acceptance + rebuild bit-equality)
+        ("store_plane", lambda: bench_store_plane(np)),
         ("host_micro", lambda: bench_host_micro(np)),
         # ISSUE 5: per-stage breakdown via the trace plane + the
         # disarmed-overhead acceptance (zero span allocs with tracing off)

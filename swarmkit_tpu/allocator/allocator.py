@@ -15,6 +15,7 @@ idempotently from the replicated store on leadership change.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 
 from ..analysis.lockgraph import make_lock
@@ -29,8 +30,9 @@ from ..api.objects import (
 )
 from ..api.types import NodeStatusState, TaskState
 from ..store import by
+from ..store.memory import MAX_CHANGES_PER_TRANSACTION
 from ..orchestrator.base import EventLoopComponent
-from ..utils import lifecycle
+from ..utils import failpoints, lifecycle
 from .ipam import IPAM, IPAMError
 
 log = logging.getLogger("swarmkit_tpu.allocator")
@@ -116,11 +118,25 @@ class PortAllocator:
 class Allocator(EventLoopComponent):
     name = "allocator"
 
-    def __init__(self, store, network_provider=None):
+    def __init__(self, store, network_provider=None, batched=None):
+        """batched=True (the default; SWARMKIT_TPU_NO_BATCHED_ALLOC=1 or
+        batched=False reverts) swaps the scalar IPAM/PortAllocator for
+        the array-native twins (allocator/batched.py) and moves whole
+        PENDING batches through per-network bulk grants — bit-identical
+        to the scalar oracle (tests/test_batched_alloc.py fuzz)."""
         super().__init__(store)
         self.network = network_provider or InertNetworkProvider()
-        self.ports = PortAllocator()
-        self.ipam = IPAM()
+        if batched is None:
+            batched = not os.environ.get("SWARMKIT_TPU_NO_BATCHED_ALLOC")
+        self.batched = bool(batched)
+        if self.batched:
+            from .batched import BatchedIPAM, BatchedPorts
+
+            self.ports = BatchedPorts()
+            self.ipam = BatchedIPAM()
+        else:
+            self.ports = PortAllocator()
+            self.ipam = IPAM()
         # services whose port allocation failed, retried when ports free up
         self._starved: set[str] = set()
         # tasks whose attachment addresses were already returned — terminal
@@ -130,6 +146,14 @@ class Allocator(EventLoopComponent):
         # services whose VIP allocation hit an exhausted pool; retried when
         # any address is released (ports have the same mechanism above)
         self._vip_starved: set[str] = set()
+        # services whose VIP/attachment wants were DEFERRED because a
+        # referenced network isn't allocated yet (ISSUE 11 satellite):
+        # an explicit marker set in the dispatcher reverse-index-as-hint
+        # style — every hit is re-checked in-tx by _allocate_service, a
+        # stale id heals lazily, and the find_services sweep remains the
+        # un-primed fallback (primed by on_start's full pass)
+        self._deferred_services: set[str] = set()
+        self._deferred_primed = False
 
     def setup(self, tx):
         # ONE consistent snapshot: the NEW subset derives from the full task
@@ -183,6 +207,9 @@ class Allocator(EventLoopComponent):
             self._allocate_network(n.id)
         for s in services:
             self._allocate_service(s.id)
+        # the full sweep above marked every service with unresolved
+        # network refs: the deferred set is primed from here on
+        self._deferred_primed = True
         for node in nodes:
             self._allocate_node(node.id)
         self._allocate_tasks([t.id for t in tasks])
@@ -299,12 +326,31 @@ class Allocator(EventLoopComponent):
 
     def _retry_all_services(self):
         """A new network may complete services whose VIP allocation was
-        DEFERRED (created before the network); deferral has no starvation
-        marker, so sweep every service — _allocate_service is idempotent
-        and cheap when nothing is missing."""
-        view = self.store.view()
-        for s in view.find_services():
-            self._allocate_service(s.id)
+        DEFERRED (created before the network). Deferred services carry
+        an explicit marker (`_deferred_services`, written wherever
+        `_service_networks` returns None), so a network commit retries
+        O(deferred), not O(services) — each hit re-checked in-tx by the
+        idempotent _allocate_service (a still-unresolved service
+        re-marks itself; a deleted one heals out of the set). Before
+        on_start's full sweep primes the set, fall back to the
+        find_services scan."""
+        if not self._deferred_primed:
+            view = self.store.view()
+            for s in view.find_services():
+                self._allocate_service(s.id)
+            return
+        deferred, self._deferred_services = self._deferred_services, set()
+        pending = list(deferred)
+        try:
+            while pending:
+                self._allocate_service(pending[-1])
+                pending.pop()          # only a completed retry leaves
+        except BaseException:
+            # a transient failure (store churn) must not lose the
+            # un-retried remainder — the old full sweep self-healed on
+            # the next network event, so must the marker set
+            self._deferred_services.update(pending)
+            raise
 
     # -------------------------------------------------------- net resolution
     def _resolve_network(self, tx, target: str):
@@ -406,6 +452,10 @@ class Allocator(EventLoopComponent):
                 return
             ports = s.spec.endpoint.ports
             nets = self._service_networks(tx, s)
+            if nets is None:
+                # referenced network not allocated yet: mark so the
+                # network-commit retry is O(deferred) (_retry_all_services)
+                self._deferred_services.add(s.id)
             endpoint = dict(s.endpoint or {})
             have_vips = {net_id: addr
                          for net_id, addr in endpoint.get("virtual_ips", [])}
@@ -492,6 +542,9 @@ class Allocator(EventLoopComponent):
             self._retry_starved()
 
     def _allocate_tasks(self, task_ids: list[str]):
+        if self.batched and len(task_ids) > 1 \
+                and hasattr(self.ipam, "allocate_many"):
+            return self._allocate_tasks_batched(task_ids)
         # lifecycle plane: collect the ids actually moved NEW->PENDING
         # and file them as ONE batched record after the store batch (the
         # decision boundary); disarmed, no list is ever built
@@ -513,6 +566,7 @@ class Allocator(EventLoopComponent):
                     if service is not None:
                         nets = self._service_networks(tx, service)
                         if nets is None:
+                            self._deferred_services.add(service.id)
                             return  # a referenced network isn't ready yet
                         for n in nets:
                             try:
@@ -547,3 +601,111 @@ class Allocator(EventLoopComponent):
         self.store.batch(cb)
         if moved:
             lifecycle.record_batch(TaskState.PENDING, moved)
+
+    # ------------------------------------------------ batched PENDING path
+    def _allocate_tasks_batched(self, task_ids: list[str]):
+        """The allocator's hot half over whole batches (ISSUE 11): per
+        chunk, ONE in-tx validation pass plans the batch, per-network
+        demand grants ride one `allocate_many` mask/scan kernel call
+        each, and the tasks commit in one update transaction. When a
+        pool can't cover its chunk demand the chunk falls back to the
+        per-task probe loop — bit-identical to the scalar path,
+        including its cursor churn on failed tasks. A chunk that crashes
+        mid-flight (failpoint `alloc.batch.commit`, store errors)
+        releases every uncommitted grant before re-raising, so a retry
+        can't leak addresses."""
+        moved: list[str] | None = [] if lifecycle.enabled() else None
+        for off in range(0, len(task_ids), MAX_CHANGES_PER_TRANSACTION):
+            chunk = task_ids[off:off + MAX_CHANGES_PER_TRANSACTION]
+            granted: list[tuple[str, str]] = []
+            try:
+                self.store.update(
+                    lambda tx, chunk=chunk: self._alloc_chunk_in_tx(
+                        tx, chunk, granted, moved))
+            except BaseException:
+                # the transaction never committed: hand every grant of
+                # this chunk back (release is an idempotent discard, so
+                # per-task rollbacks already performed are harmless)
+                for net_id, addr in granted:
+                    self.ipam.release(net_id, addr)
+                raise
+        if moved:
+            lifecycle.record_batch(TaskState.PENDING, moved)
+
+    def _alloc_chunk_in_tx(self, tx, chunk, granted, moved):
+        # pass 1: in-tx validation (same gates as the scalar move_one)
+        # and per-network demand aggregation
+        plans = []
+        demand: dict[str, int] = {}
+        for tid in chunk:
+            t = tx.get_task(tid)
+            if t is None or t.status.state != TaskState.NEW:
+                continue
+            service = tx.get_service(t.service_id) if t.service_id else None
+            if service is not None and service.spec.endpoint.ports and (
+                    service.endpoint is None
+                    or not service.endpoint.get("ports_allocated")):
+                continue  # wait for service allocation first
+            nets = []
+            if service is not None:
+                nets = self._service_networks(tx, service)
+                if nets is None:
+                    self._deferred_services.add(service.id)
+                    continue  # a referenced network isn't ready yet
+                for n in nets:
+                    demand[n.id] = demand.get(n.id, 0) + 1
+            plans.append((t, service, nets))
+        # pass 2: bulk grants when every pool covers its chunk demand —
+        # K grants with no interleaved release == K sequential scalar
+        # grants (ops/alloc.py), so the fallback below is the ONLY other
+        # shape and both are oracle-identical
+        bulk: dict[str, list[str]] | None = None
+        if demand and all(self.ipam.free_count(nid) >= k
+                          for nid, k in demand.items()):
+            bulk = {}
+            for nid, k in demand.items():
+                addrs = self.ipam.allocate_many(nid, k)
+                granted.extend((nid, a) for a in addrs)
+                bulk[nid] = addrs[::-1]  # pop() consumes in grant order
+        failpoints.fp("alloc.batch.commit")
+        # pass 3: distribute in task order and stage the store writes
+        for t, service, nets in plans:
+            attachments = []
+            if bulk is not None:
+                for n in nets:
+                    attachments.append({"network_id": n.id,
+                                        "addresses": [bulk[n.id].pop()]})
+            else:
+                exhausted = False
+                for n in nets:
+                    try:
+                        addr = self.ipam.allocate(n.id)
+                    except IPAMError:
+                        # pool exhausted: this task's partial grants go
+                        # back, the task stays NEW (scalar semantics —
+                        # the failed probes' cursor churn included)
+                        for a in attachments:
+                            self.ipam.release(a["network_id"],
+                                              a["addresses"][0])
+                        exhausted = True
+                        break
+                    granted.append((n.id, addr))
+                    attachments.append({"network_id": n.id,
+                                        "addresses": [addr]})
+                if exhausted:
+                    continue
+            t = t.copy()
+            t.networks = (self.network.allocate_task(t) or []) + attachments
+            if service is not None and service.endpoint \
+                    and service.endpoint.get("ports"):
+                from ..api.specs import EndpointSpec, PortConfig
+                t.endpoint = EndpointSpec(ports=[
+                    PortConfig(protocol=proto, target_port=tp,
+                               published_port=pub, publish_mode=mode)
+                    for proto, tp, pub, mode in service.endpoint["ports"]
+                ])
+            t.status.state = TaskState.PENDING
+            t.status.message = "pending task scheduling"
+            tx.update(t)
+            if moved is not None:
+                moved.append(t.id)

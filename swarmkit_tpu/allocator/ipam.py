@@ -77,6 +77,9 @@ class IPAM:
 
     DEFAULT_SPACE = ipaddress.ip_network("10.0.0.0/8")
     DEFAULT_PREFIX = 24
+    # pool implementation seam: allocator/batched.py BatchedIPAM swaps
+    # in the array-native pool (bit-identical semantics, fuzz-pinned)
+    _POOL_CLS = _Pool
 
     def __init__(self):
         self._pools: dict[str, _Pool] = {}
@@ -95,7 +98,7 @@ class IPAM:
                 net = validate_subnet(subnet)
             else:
                 net = self._next_free_subnet()
-            pool = _Pool(net)
+            pool = self._POOL_CLS(net)
             self._pools[net_id] = pool
             return str(net), pool.gateway
 

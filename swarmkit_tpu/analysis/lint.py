@@ -421,6 +421,7 @@ class Int64InKernelRule(Rule):
         "swarmkit_tpu/ops/reconcile.py",
         "swarmkit_tpu/ops/bitpack.py",
         "swarmkit_tpu/ops/raft_replay.py",
+        "swarmkit_tpu/ops/alloc.py",
         "swarmkit_tpu/models/cluster_step.py",
     )
 
@@ -478,6 +479,79 @@ class RawLockRule(Rule):
                     "lock-order detector can track it")
 
 
+class ColumnarMutateRule(Rule):
+    """The columnar task mirror is derived truth kept in lockstep by the
+    commit path (docs/store.md): a direct array write anywhere else
+    silently diverges the columns from the object table."""
+
+    name = "columnar-mutate"
+    invariant = ("columnar arrays (store.columnar.*) are written ONLY by "
+                 "the columnar plane itself — store/columnar.py, the "
+                 "store commit/wave path in store/memory.py, and the "
+                 "batched allocator (allocator/batched.py, ops/alloc.py); "
+                 "everyone else goes through assign_wave / the commit "
+                 "lockstep or reads")
+
+    ALLOWED = (
+        "swarmkit_tpu/store/columnar.py",
+        "swarmkit_tpu/store/memory.py",
+        "swarmkit_tpu/allocator/batched.py",
+        "swarmkit_tpu/ops/alloc.py",
+    )
+
+    def applies(self, path: str) -> bool:
+        return (path.startswith("swarmkit_tpu/")
+                and path not in self.ALLOWED)
+
+    @staticmethod
+    def _chain_of_target(node: ast.AST) -> str:
+        """Dotted chain of an assignment target, unwrapping subscripts
+        (`store.columnar.state[rows]` -> 'store.columnar.state')."""
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        return _attr_chain(node)
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        tainted: set[str] = set()
+        # SOURCE order, not ast.walk's breadth-first order: an alias
+        # bound inside a nested block (if/with/try) would otherwise be
+        # visited AFTER a shallower write through it and the write
+        # would escape the taint
+        stmts = sorted(
+            (n for n in ast.walk(mod.tree)
+             if isinstance(n, (ast.Assign, ast.AugAssign))),
+            key=lambda n: (n.lineno, n.col_offset))
+        for node in stmts:
+            targets: list[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+                # taint names bound to a .columnar read so writes
+                # through the alias are caught too
+                value_chain = _attr_chain(node.value)
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        if value_chain.split(".")[-1:] == ["columnar"]:
+                            tainted.add(tgt.id)
+                        else:
+                            tainted.discard(tgt.id)
+            elif isinstance(node, ast.AugAssign):
+                targets = [node.target]
+            for tgt in targets:
+                if isinstance(tgt, ast.Name):
+                    continue
+                chain = self._chain_of_target(tgt)
+                parts = chain.split(".") if chain else []
+                hit = "columnar" in parts \
+                    or (parts and parts[0] in tainted and len(parts) > 1)
+                if hit:
+                    yield self.finding(
+                        mod, tgt,
+                        f"direct write through {chain!r} — columnar "
+                        "arrays are commit-path-owned derived truth; "
+                        "use store.assign_wave / the commit lockstep "
+                        "(docs/store.md)")
+
+
 RULES: tuple[Rule, ...] = (
     Scatter2DRule(),
     AdHocSleepRule(),
@@ -487,6 +561,7 @@ RULES: tuple[Rule, ...] = (
     CopyBeforeMutateRule(),
     Int64InKernelRule(),
     RawLockRule(),
+    ColumnarMutateRule(),
 )
 
 

@@ -449,6 +449,16 @@ class DebugServer:
         if store is not None and getattr(store, "op_counts", None) \
                 is not None:
             out["store_ops"] = dict(store.op_counts)
+        col = getattr(store, "columnar", None)
+        if col is not None:
+            # columnar plane counters (ISSUE 11): scatter/materialize/
+            # query volumes next to the op counts they complement
+            out["store_columnar"] = {
+                "tasks": len(col),
+                "node_vocab": len(col.nodes),
+                "service_vocab": len(col.services),
+                **dict(col.stats),
+            }
         raft = getattr(node, "raft", None)
         if raft is not None:
             out["raft"] = {

@@ -1,0 +1,77 @@
+"""Batched IPAM / port grant kernels (ISSUE 11).
+
+One primitive powers both allocators: given an occupancy mask over a
+pool and the scalar allocator's probe cursor, emit every FREE slot in
+the exact circular probe order the scalar oracle
+(`allocator/ipam.py _Pool.allocate` / `allocator/allocator.py
+PortAllocator._find_dynamic`) would visit it — so a batch of K grants is
+bit-identical to K sequential scalar calls (no releases interleave
+inside a batch by construction).
+
+Kernel shape rules (CLAUDE.md): everything is FLAT 1D — the rank key is
+a 1D mask/scan and the order comes from `jnp.argsort`, which is stable
+here and therefore the sanctioned tie-break; no 2D scatters, no int64.
+The numpy twin is both the oracle the kernel fuzz pins against and the
+small-pool fast path (a /24 pool is 256 slots — jit dispatch would
+dominate; the jax path earns its keep on /16+ pools and the port span).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+# pools at or under this size take the numpy path; above it the jitted
+# kernel (cached per (size, lo, hi) static shape) amortizes
+JAX_POOL_THRESHOLD = 4096
+
+
+def grant_order_np(taken: np.ndarray, cursor: int, lo: int,
+                   hi: int) -> np.ndarray:
+    """Free offsets of `taken[lo..hi]` in circular probe order starting
+    at `cursor` (clamped to `lo` when outside the range, matching the
+    scalar wrap reset). Pure numpy — the kernel's oracle."""
+    span = hi - lo + 1
+    start = cursor if lo <= cursor <= hi else lo
+    pos = np.arange(lo, hi + 1, dtype=np.int32)
+    key = (pos - np.int32(start)) % np.int32(span)
+    free = ~taken[lo:hi + 1]
+    order = np.argsort(np.where(free, key, np.int32(span)), kind="stable")
+    n_free = int(free.sum())
+    return pos[order[:n_free]]
+
+
+@functools.lru_cache(maxsize=64)
+def _grant_kernel(size: int, lo: int, hi: int):
+    import jax
+    import jax.numpy as jnp
+
+    span = hi - lo + 1
+
+    @jax.jit
+    def kern(taken, cursor):
+        start = jnp.where((cursor >= lo) & (cursor <= hi), cursor, lo)
+        pos = jnp.arange(lo, hi + 1, dtype=jnp.int32)
+        key = (pos - start.astype(jnp.int32)) % jnp.int32(span)
+        free = ~taken[lo:hi + 1]
+        # stable argsort over the masked scan key: free slots sort to
+        # the front in probe order, taken slots sink behind the span
+        # sentinel — the whole kernel is one flat-1D mask/scan
+        order = jnp.argsort(jnp.where(free, key, jnp.int32(span)))
+        return pos[order], free.sum()
+
+    return kern
+
+
+def grant_order(taken: np.ndarray, cursor: int, lo: int, hi: int,
+                use_jax: bool | None = None) -> np.ndarray:
+    """Dispatch wrapper: numpy under JAX_POOL_THRESHOLD (or use_jax
+    False), the cached jit kernel above it. Output is bit-identical
+    either way (tests/test_batched_alloc.py fuzzes the pair)."""
+    if use_jax is None:
+        use_jax = taken.shape[0] > JAX_POOL_THRESHOLD
+    if not use_jax:
+        return grant_order_np(taken, cursor, lo, hi)
+    kern = _grant_kernel(int(taken.shape[0]), int(lo), int(hi))
+    order, n_free = kern(taken, np.int32(cursor))
+    return np.asarray(order)[:int(n_free)]

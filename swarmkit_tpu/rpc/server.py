@@ -117,6 +117,15 @@ class RPCServer:
         # failpoint exposes)
         self._inflight = 0
         self._inflight_cond = threading.Condition()
+        # set by stop() once the drain window has passed: a serve loop
+        # that exits because _stop was set (it re-checks between frames,
+        # so it can exit BEFORE blocking in recv) must wait for this
+        # before closing its connection, or it yanks the fd out from
+        # under a handler the drain is still waiting for — the reply
+        # dies on EBADF and the caller sees a reset the drain contract
+        # promises it will not see (found by the stop-drain test's rare
+        # between-frames interleaving)
+        self._drained = threading.Event()
         self.addr: str | None = None  # actual host:port after bind
         # renewed certs / rotated roots apply to new connections
         if unix_path is None:
@@ -200,6 +209,9 @@ class RPCServer:
                                 self.addr, self._inflight)
                     break
                 self._inflight_cond.wait(remaining)
+        # drain window over (clean or deadline): serve loops parked on
+        # this event may now close their connections
+        self._drained.set()
         with self._conns_lock:
             conns = list(self._conns)
         for c in conns:
@@ -273,6 +285,13 @@ class RPCServer:
                 ev.set()
             with self._conns_lock:
                 self._conns.discard(conn)
+            if self._stop.is_set():
+                # stopping: honor the drain contract. The loop above
+                # re-checks _stop between frames, so it can get here
+                # BEFORE stop()'s drain has let in-flight handlers send
+                # their replies — closing now would reset them. Bounded:
+                # stop() always sets _drained after its drain window.
+                self._drained.wait(timeout=30)
             # reply threads may still be inside send_frame on this conn:
             # shutdown, then close under their write lock (wire.safe_close)
             safe_close(conn, wlock)

@@ -37,7 +37,12 @@ from ..api.objects import (
 )
 from ..api.types import NodeStatusState, TaskState
 from ..store import by
-from ..store.memory import MAX_CHANGES_PER_TRANSACTION, MemoryStore
+from ..store.memory import (
+    ASSIGN_NODE_NOT_READY,
+    ASSIGN_OK,
+    MAX_CHANGES_PER_TRANSACTION,
+    MemoryStore,
+)
 from ..store.watch import ChannelClosed
 from ..utils import failpoints, lifecycle, trace
 from .batch import apply_placements, cpu_schedule_encoded, materialize_orders
@@ -74,7 +79,8 @@ COLD_CPU_NODES = 8_192
 class Scheduler:
     def __init__(self, store: MemoryStore, backend: str = "auto",
                  jax_threshold: int | None = None, pipeline: bool = False,
-                 mesh=None, async_commit: bool = False):
+                 mesh=None, async_commit: bool = False,
+                 columnar_writeback: bool = True):
         """backend: "auto" picks per tick by task×node product against
         `jax_threshold` (default JAX_THRESHOLD); "cpu"/"jax" pin the path;
         "mesh" pins the jax path AND shards the device-resident node state
@@ -108,6 +114,14 @@ class Scheduler:
         self.store = store
         self.backend = backend
         self.mesh = mesh
+        # wave write-back through the columnar store plane (ISSUE 11):
+        # one store.assign_wave per wave — vectorized in-tx validation
+        # against the column mirror, shallow patches instead of tree
+        # copies. Auto-off when the store runs without the mirror
+        # (SWARMKIT_TPU_NO_COLUMNAR) — the object path is the fallback.
+        self.columnar_writeback = bool(
+            columnar_writeback and getattr(store, "columnar", None)
+            is not None)
         self.jax_threshold = (
             (PIPELINED_JAX_THRESHOLD if pipeline else JAX_THRESHOLD)
             if jax_threshold is None else jax_threshold)
@@ -949,6 +963,35 @@ class Scheduler:
                 decisions.append(
                     (task, node_ids[ni] if ni >= 0 else None, ni, group, gi))
 
+        # columnar bulk path (ISSUE 11): placed decisions without CSI
+        # volume choice commit as ONE store.assign_wave — vectorized
+        # in-tx re-validation against the columnar mirror, one shallow
+        # patch per task instead of two tree copies, same events. CSI
+        # tasks keep the object path (choose_task_volumes is a per-task
+        # in-tx decision); unplaced rows keep it too (explanations).
+        fast: list[tuple] = []
+        slow: list[tuple] = []
+        if self.columnar_writeback:
+            for d in decisions:
+                if d[1] is not None and not task_csi_mounts(d[0]):
+                    fast.append(d)
+                else:
+                    slow.append(d)
+        else:
+            slow = decisions
+        if fast:
+            codes, committed = self.store.assign_wave(
+                [(task.id, node_id) for task, node_id, *_ in fast],
+                pipeline_depth=WRITEBACK_PIPELINE_DEPTH)
+            for (task, node_id, ni, group, gi), code, cur in zip(
+                    fast, codes, committed):
+                if code == ASSIGN_OK:
+                    applied_by_group.setdefault(gi, []).append((cur, ni))
+                elif code == ASSIGN_NODE_NOT_READY:
+                    conflicts[0] += 1
+                else:           # missing / dead / raced: evict from pool
+                    drop.append(task.id)
+
         def write_decision(tx, item):
             task, node_id, ni, group, gi = item
             cur = tx.get_task(task.id)
@@ -986,7 +1029,8 @@ class Scheduler:
             tx.update(cur)
             applied_by_group.setdefault(gi, []).append((cur, ni))
 
-        self._batched_writes(decisions, write_decision)
+        if slow:
+            self._batched_writes(slow, write_decision)
         if applied_by_group and lifecycle.enabled():
             # lifecycle plane: ONE batched ASSIGNED record covering every
             # task this wave placed — never per task inside the commit

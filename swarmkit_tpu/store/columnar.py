@@ -1,0 +1,299 @@
+"""Columnar mirror of the hot task table (ISSUE 11 tentpole).
+
+BENCH_r05 put the ceiling at the Python-object store: at 1M tasks the
+tick is 21x the oracle but e2e only 6x, because every wave write-back
+pays two tree copies plus full re-index per task. This module keeps the
+scheduler-hot half of every Task as dense numpy columns — state /
+desired-state / version / node-idx / service-idx / slot, keyed by an
+interned task-id vocabulary that mirrors `IncrementalEncoder`'s node
+vocab (insert on first sight, rows recycled through a free list on
+delete) — so bulk wave write-back and hot queries become array ops.
+
+Contract (docs/store.md): the OBJECT table remains the replicated
+source of record; the columns are DERIVED TRUTH kept in lockstep by the
+commit path (`MemoryStore._commit` feeds every committed task action
+through `apply_actions`). The one legal divergence window is a LAZY
+wave (`MemoryStore.assign_wave(lazy=True)` on a watcher-free plain
+store): columns advance first and the object views are materialized
+only when the API surface asks for a task the columns can't answer —
+`MemoryStore._heal_stale_tasks` owns that materialization. Nothing
+outside store/columnar.py, store/memory.py, allocator/batched.py and
+ops/alloc.py may write these arrays (lint rule `columnar-mutate`).
+"""
+from __future__ import annotations
+
+from collections import Counter
+
+import numpy as np
+
+from ..api.types import TaskState
+
+
+class IdVocab:
+    """String interner with reverse lookup. id 0 is reserved for the
+    empty string (an unassigned node / service-less task interns to 0),
+    mirroring the encoder Vocab convention."""
+
+    def __init__(self):
+        self.names: list[str] = [""]
+        self._ids: dict[str, int] = {"": 0}
+
+    def intern(self, s: str) -> int:
+        i = self._ids.get(s)
+        if i is None:
+            i = len(self.names)
+            self._ids[s] = i
+            self.names.append(s)
+        return i
+
+    def lookup(self, s: str) -> int:
+        """-1 when unseen (groups LOOK UP, writers INSERT)."""
+        return self._ids.get(s, -1)
+
+    def name(self, i: int) -> str:
+        return self.names[i]
+
+    def __len__(self):
+        return len(self.names)
+
+
+_GROW = 1024
+COLUMNS = ("state", "desired", "version", "node_idx", "service_idx", "slot")
+
+
+class ColumnarTasks:
+    """Dense column mirror of the task table.
+
+    Row lifetime: a task id interns into `_row` on first create; its row
+    index is stable for the task's lifetime and recycled (free list) on
+    delete. `valid[row]` is False only for never-used / freed rows.
+    """
+
+    def __init__(self, cap: int = _GROW):
+        cap = max(cap, 1)
+        self._row: dict[str, int] = {}
+        self.ids: list[str | None] = []        # row -> task id (None = freed)
+        self._free: list[int] = []
+        self.nodes = IdVocab()
+        self.services = IdVocab()
+        self.state = np.zeros(cap, np.int32)
+        self.desired = np.zeros(cap, np.int32)
+        self.version = np.zeros(cap, np.int64)
+        self.node_idx = np.zeros(cap, np.int32)
+        self.service_idx = np.zeros(cap, np.int32)
+        self.slot = np.zeros(cap, np.int64)
+        self.valid = np.zeros(cap, bool)
+        # op counters (merged into store.op_counts views / debug/vars)
+        self.stats: Counter = Counter()
+
+    # ------------------------------------------------------------ capacity
+    def _cap(self) -> int:
+        return self.state.shape[0]
+
+    def _ensure(self, rows_needed: int) -> None:
+        need = len(self.ids) + rows_needed
+        cap = self._cap()
+        if need <= cap:
+            return
+        new_cap = cap
+        while new_cap < need:
+            new_cap = max(new_cap * 2, new_cap + _GROW)
+        for col in COLUMNS:
+            arr = getattr(self, col)
+            grown = np.zeros(new_cap, arr.dtype)
+            grown[:cap] = arr
+            setattr(self, col, grown)
+        grown_valid = np.zeros(new_cap, bool)
+        grown_valid[:cap] = self.valid
+        self.valid = grown_valid
+
+    def _alloc_row(self, task_id: str) -> int:
+        if self._free:
+            row = self._free.pop()
+            self.ids[row] = task_id
+        else:
+            self._ensure(1)
+            row = len(self.ids)
+            self.ids.append(task_id)
+        self._row[task_id] = row
+        return row
+
+    # ----------------------------------------------------- lockstep writes
+    def upsert_many(self, tasks: list) -> None:
+        """Mirror a batch of created/updated task objects. One pass
+        builds the row/value staging lists, then each column takes ONE
+        flat fancy-index scatter — the bulk path the wave write-back
+        rides (one commit = one scatter set, not one write per task)."""
+        n = len(tasks)
+        if not n:
+            return
+        rows = np.empty(n, np.int64)
+        state = np.empty(n, np.int32)
+        desired = np.empty(n, np.int32)
+        version = np.empty(n, np.int64)
+        node_idx = np.empty(n, np.int32)
+        service_idx = np.empty(n, np.int32)
+        slot = np.empty(n, np.int64)
+        row_of = self._row
+        for j, t in enumerate(tasks):
+            row = row_of.get(t.id)
+            if row is None:
+                row = self._alloc_row(t.id)
+            rows[j] = row
+            state[j] = int(t.status.state)
+            desired[j] = int(t.desired_state)
+            version[j] = t.meta.version.index
+            node_idx[j] = self.nodes.intern(t.node_id)
+            service_idx[j] = self.services.intern(t.service_id)
+            slot[j] = t.slot
+        self.state[rows] = state
+        self.desired[rows] = desired
+        self.version[rows] = version
+        self.node_idx[rows] = node_idx
+        self.service_idx[rows] = service_idx
+        self.slot[rows] = slot
+        self.valid[rows] = True
+        self.stats["rows_upserted"] += n
+        self.stats["scatters"] += 1
+
+    def delete(self, task_id: str) -> None:
+        row = self._row.pop(task_id, None)
+        if row is None:
+            return
+        self.ids[row] = None
+        self.valid[row] = False
+        self.node_idx[row] = 0
+        self.service_idx[row] = 0
+        self._free.append(row)
+        self.stats["rows_deleted"] += 1
+
+    def apply_actions(self, actions: list) -> None:
+        """Commit-path lockstep hook: apply one committed changelist's
+        task actions in order, coalescing consecutive creates/updates
+        into one scatter batch."""
+        pending: list = []
+        for action in actions:
+            if action.kind == "delete":
+                if pending:
+                    self.upsert_many(pending)
+                    pending = []
+                self.delete(action.obj.id)
+            else:
+                pending.append(action.obj)
+        if pending:
+            self.upsert_many(pending)
+
+    # --------------------------------------------------- wave fast path
+    def wave_codes(self, task_ids: list) -> tuple[np.ndarray, np.ndarray]:
+        """Vectorized wave-commit validation (the in-tx re-validation the
+        object path did per task): returns (rows, codes) aligned with
+        `task_ids`, codes in the ASSIGN_* space of store.memory — 0 ok,
+        1 missing, 2 not assignable (dead / not PENDING / already has a
+        node). Node readiness is the caller's overlay (it needs the node
+        table)."""
+        n = len(task_ids)
+        rows = np.fromiter((self._row.get(t, -1) for t in task_ids),
+                           np.int64, n)
+        codes = np.zeros(n, np.int8)
+        missing = rows < 0
+        r = np.where(missing, 0, rows)
+        bad = ((self.state[r] != int(TaskState.PENDING))
+               | (self.node_idx[r] != 0)
+               | (self.desired[r] > int(TaskState.COMPLETE))
+               | ~self.valid[r])
+        codes[bad] = 2
+        codes[missing] = 1
+        self.stats["wave_validations"] += 1
+        return rows, codes
+
+    def assign_rows(self, rows: np.ndarray, node_idx_vals: np.ndarray,
+                    state: int, version: int) -> None:
+        """The lazy wave's array write: whole-wave scatter into the hot
+        columns. Object views for these rows are OWED — the caller must
+        track them stale and materialize on first object read."""
+        self.state[rows] = state
+        self.node_idx[rows] = node_idx_vals
+        self.version[rows] = version
+        self.stats["assign_rows"] += int(rows.size)
+        self.stats["assign_waves"] += 1
+
+    # ------------------------------------------------------------ queries
+    def __len__(self):
+        return len(self._row)
+
+    def row_of(self, task_id: str) -> int:
+        return self._row.get(task_id, -1)
+
+    def get(self, task_id: str):
+        """(state, desired, version, node_id, service_id, slot) or None
+        — the objectless hot read."""
+        row = self._row.get(task_id)
+        if row is None:
+            return None
+        self.stats["point_reads"] += 1
+        return (int(self.state[row]), int(self.desired[row]),
+                int(self.version[row]), self.nodes.name(self.node_idx[row]),
+                self.services.name(self.service_idx[row]),
+                int(self.slot[row]))
+
+    def _rows_where(self, mask: np.ndarray) -> list[str]:
+        self.stats["array_queries"] += 1
+        ids = self.ids
+        return [ids[r] for r in np.flatnonzero(mask & self.valid).tolist()]
+
+    def ids_by_state(self, state: int) -> list[str]:
+        return self._rows_where(self.state == int(state))
+
+    def ids_by_node(self, node_id: str) -> list[str]:
+        i = self.nodes.lookup(node_id)
+        if i <= 0:
+            return []
+        return self._rows_where(self.node_idx == i)
+
+    def ids_by_service(self, service_id: str) -> list[str]:
+        i = self.services.lookup(service_id)
+        if i < 0:
+            return []
+        return self._rows_where(self.service_idx == i)
+
+    def count_by_state(self) -> dict[int, int]:
+        self.stats["array_queries"] += 1
+        states = self.state[self.valid]
+        uniq, counts = np.unique(states, return_counts=True)
+        return {int(s): int(c) for s, c in zip(uniq, counts)}
+
+    # ------------------------------------------------- rebuild / parity
+    def snapshot(self) -> dict:
+        """Canonical (row-order-independent) image of the columns: every
+        live task in sorted-id order, node/service indices resolved back
+        to strings — bit-comparable against a from-scratch rebuild no
+        matter how rows and vocab ids were historically assigned."""
+        order = sorted(self._row)
+        rows = np.fromiter((self._row[t] for t in order), np.int64,
+                           len(order))
+        return {
+            "ids": order,
+            "state": self.state[rows].copy(),
+            "desired": self.desired[rows].copy(),
+            "version": self.version[rows].copy(),
+            "slot": self.slot[rows].copy(),
+            "node_ids": [self.nodes.name(i) for i in self.node_idx[rows]],
+            "service_ids": [self.services.name(i)
+                            for i in self.service_idx[rows]],
+        }
+
+    @classmethod
+    def rebuild(cls, tasks: list) -> "ColumnarTasks":
+        """From-scratch mirror of a task list (the bit-equality oracle in
+        tests, and the restore path)."""
+        col = cls(cap=max(len(tasks), 1))
+        col.upsert_many(sorted(tasks, key=lambda t: t.id))
+        return col
+
+    @staticmethod
+    def snapshots_equal(a: dict, b: dict) -> bool:
+        if a["ids"] != b["ids"] or a["node_ids"] != b["node_ids"] \
+                or a["service_ids"] != b["service_ids"]:
+            return False
+        return all(np.array_equal(a[k], b[k])
+                   for k in ("state", "desired", "version", "slot"))

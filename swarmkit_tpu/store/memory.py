@@ -12,10 +12,14 @@ transactional correctness and event fidelity, not pointer-walk speed.
 """
 from __future__ import annotations
 
+import copy as _copy
+import os
 import threading
 import time
 from collections import Counter, defaultdict
 from typing import Any, Callable, Iterable
+
+import numpy as np
 
 from ..analysis import lockgraph as _lockgraph
 from ..analysis.lockgraph import make_lock, make_rlock
@@ -39,8 +43,10 @@ from ..api.objects import (
     Version,
     Volume,
 )
+from ..api.types import NodeStatusState, TaskState
 from . import by as by_mod
 from ..utils.metrics import histogram
+from .columnar import ColumnarTasks
 from .watch import Channel, WatchQueue
 
 # store tx latency + lock-hold timers (memory.go:99-112)
@@ -57,6 +63,45 @@ MAX_TRANSACTION_BYTES = 1.5 * 1024 * 1024
 
 # Wedge detection (memory.go:80-81): update lock held longer than this is a bug.
 WEDGE_TIMEOUT = 30.0
+
+# assign_wave per-task outcome codes (the wave commit's in-tx
+# re-validation verdicts, vectorized against the columnar mirror)
+ASSIGN_OK = 0
+ASSIGN_MISSING = 1          # task gone / dead / already torn down -> drop
+ASSIGN_NOT_PENDING = 2      # raced to assigned elsewhere / not PENDING -> drop
+ASSIGN_NODE_NOT_READY = 3   # in-tx node check failed -> conflict, retry
+
+
+class _LazyWave:
+    """Per-wave record backing lazily-materialized task views: the
+    columns hold state/node/version (the heal reads THOSE — latest
+    value wins across waves); this holds only the wave-constant rest."""
+
+    __slots__ = ("message", "wall")
+
+    def __init__(self, message: str, wall: float):
+        self.message = message
+        self.wall = wall
+
+
+def _patch_assign(old, node_id: str, state, message: str, wall: float):
+    """Cheap wave-commit patch: a SHALLOW task copy with fresh meta and
+    status — spec/annotations/networks stay shared with the previous
+    version, which is legal under the store's immutability contract
+    (objects are never mutated in place; `.copy()` forks shared subtrees
+    — docs/store.md). This replaces the object path's two full tree
+    copies per task."""
+    new = _copy.copy(old)
+    new.meta = Meta(version=Version(old.meta.version.index),
+                    created_at=old.meta.created_at,
+                    updated_at=old.meta.updated_at)
+    st = _copy.copy(old.status)
+    st.state = state
+    st.message = message
+    st.timestamp = wall
+    new.status = st
+    new.node_id = node_id
+    return new
 
 
 class SequenceConflict(Exception):
@@ -93,7 +138,12 @@ class ReadTx:
         self._s = store
 
     def get(self, cls: type[StoreObject], id: str) -> StoreObject | None:
-        return self._s._tables[cls.TABLE].get(id)
+        s = self._s
+        if cls.TABLE == "task" and s._stale_tasks:
+            # a lazy columnar wave deferred these object views: the API
+            # surface is now asking, so materialize (docs/store.md)
+            s._heal_stale_tasks()
+        return s._tables[cls.TABLE].get(id)
 
     def find(self, cls: type[StoreObject], *selectors) -> list[StoreObject]:
         return self._s._find(cls, selectors)
@@ -271,6 +321,18 @@ class MemoryStore:
         # "update_tx", "find_<table>". Maintained under the locks the
         # counted operations already hold.
         self.op_counts: Counter = Counter()
+        # Columnar mirror of the hot task table (store/columnar.py):
+        # kept in lockstep by _commit; the wave write-back's bulk path
+        # (assign_wave) and objectless hot queries ride it.
+        # SWARMKIT_TPU_NO_COLUMNAR=1 disables it (debug escape hatch;
+        # consumers fall back to the object path).
+        self.columnar: ColumnarTasks | None = (
+            None if os.environ.get("SWARMKIT_TPU_NO_COLUMNAR")
+            else ColumnarTasks())
+        # task id -> _LazyWave for rows whose object view is OWED after
+        # a lazy columnar wave; materialized by _heal_stale_tasks on the
+        # first object read (or any write transaction)
+        self._stale_tasks: dict[str, _LazyWave] = {}
 
     # ------------------------------------------------------------------ reads
     def view(self, cb: Callable[[ReadTx], Any] | None = None):
@@ -289,6 +351,8 @@ class MemoryStore:
     def update(self, cb: Callable[[WriteTx], Any]) -> Any:
         """Run a write transaction; commit through the proposer when present
         (memory.go:321-388)."""
+        if self._stale_tasks:
+            self._heal_stale_tasks()
         start = time.monotonic()
         with self._update_lock:
             self._update_lock_held_since = held = time.monotonic()
@@ -324,6 +388,14 @@ class MemoryStore:
     def _commit(self, tx: WriteTx, version_index: int | None = None) -> None:
         now = time.time()
         with self._lock:
+            # the mirror handle is read UNDER the lock: restore() swaps
+            # self.columnar while holding it, and a pipelined commit
+            # callback (raft worker, no update lock) racing a snapshot
+            # install must scatter into the LIVE mirror, not the
+            # discarded one
+            col = self.columnar
+            task_actions: list[StoreAction] | None = \
+                [] if col is not None else None
             if version_index is not None:
                 # replicated commits carry the raft entry index so object
                 # versions agree on every replica
@@ -335,6 +407,11 @@ class MemoryStore:
             for action in tx._changelist:
                 obj = action.obj
                 table = obj.TABLE
+                if task_actions is not None and table == "task":
+                    # columnar lockstep: mirrored AFTER the loop in one
+                    # batched scatter per commit (touchMeta has stamped
+                    # the version by then for creates/updates)
+                    task_actions.append(action)
                 if action.kind == StoreAction.DELETE:
                     stored = self._tables[table].pop(obj.id, None)
                     if stored is not None:
@@ -355,6 +432,8 @@ class MemoryStore:
                     events.append(EventCreate(obj))
                 else:
                     events.append(EventUpdate(obj, old=old))
+            if task_actions:
+                col.apply_actions(task_actions)
             events.append(EventCommit(version))
         self.queue.publish_all(events)
 
@@ -362,6 +441,8 @@ class MemoryStore:
                             version_index: int | None = None) -> None:
         """Raft follower/replay apply path (memory.go:280-308): applies a
         committed changelist without consulting the proposer."""
+        if self._stale_tasks:
+            self._heal_stale_tasks()
         with self._update_lock:
             tx = WriteTx(self)
             for a in actions:
@@ -412,6 +493,8 @@ class MemoryStore:
         ahead of the live stream. Requires a proposer that retains history
         (raft log); delivery is at-least-once across the replay/live seam.
         """
+        if self._stale_tasks:
+            self._heal_stale_tasks()
         with self._lock:
             cur = self._version.index
             replay: list[Any] = []
@@ -448,6 +531,8 @@ class MemoryStore:
         post-dates the snapshot is missed, none that pre-dates it is delivered.
         limit=None subscribes unbounded (for trusted in-process control loops
         that must never be shed as slow subscribers)."""
+        if self._stale_tasks:
+            self._heal_stale_tasks()
         with self._lock:
             result = _tracked_view(cb, ReadTx(self)) if cb is not None \
                 else None
@@ -458,6 +543,12 @@ class MemoryStore:
     def save(self) -> dict[str, list[StoreObject]]:
         """Marshal the whole store (memory.go:857-879 / api/snapshot.proto)."""
         with self._lock:
+            # heal UNDER the lock: save reads the tables directly (no
+            # heal-aware accessor), so a lazy wave landing between an
+            # outside-the-lock check and the marshal would be silently
+            # missing from the snapshot
+            if self._stale_tasks:
+                self._heal_stale_locked(False)
             return {t: [o.copy() for o in objs.values()] for t, objs in self._tables.items()}
 
     def restore(self, snapshot: dict[str, list[StoreObject]]) -> None:
@@ -473,6 +564,231 @@ class MemoryStore:
                     self._index(t, o)
                     max_index = max(max_index, o.meta.version.index)
             self._version.index = max(self._version.index, max_index)
+            self._stale_tasks.clear()
+            if self.columnar is not None:
+                self.columnar = ColumnarTasks.rebuild(
+                    list(self._tables["task"].values()))
+
+    # ------------------------------------------------- columnar wave plane
+    def assign_wave(self, assignments: list[tuple[str, str]], *,
+                    state=TaskState.ASSIGNED,
+                    message: str = "scheduler assigned task to node",
+                    lazy: bool = False,
+                    pipeline_depth: int | None = None,
+                    ) -> tuple[list[int], list[Any]]:
+        """Bulk wave write-back (ISSUE 11): commit a whole scheduler
+        wave of (task_id, node_id) assignments with the in-tx
+        re-validation the object path performed per task — task still
+        PENDING/alive/unassigned (vectorized against the columnar
+        mirror) and node READY (per distinct node) — but with ONE cheap
+        shallow patch per task instead of two tree copies, and ONE
+        update transaction on a plain store (chunked at
+        MAX_CHANGES_PER_TRANSACTION and pipelined through propose_async
+        when raft-backed, exactly like Batch.update_many).
+
+        Returns (codes, tasks): codes[i] is an ASSIGN_* verdict, and
+        tasks[i] the committed object for ASSIGN_OK rows (None on the
+        lazy path, where object views are materialized only on demand).
+
+        lazy=True additionally engages the EVENT-SILENT deferral path
+        when legal (plain store, zero watchers): columns take the wave
+        as one array scatter, object views and index updates are owed
+        until the first object read (docs/store.md lazy-view rules).
+        """
+        col = self.columnar
+        if col is None:
+            raise RuntimeError(
+                "assign_wave needs the columnar plane "
+                "(disabled via SWARMKIT_TPU_NO_COLUMNAR)")
+        n = len(assignments)
+        if not n:
+            return [], []
+        if self._stale_tasks:
+            self._heal_stale_tasks()
+        if lazy and self.proposer is None and not self.queue.has_watchers():
+            out = self._assign_wave_lazy(assignments, state, message)
+            if out is not None:
+                return out
+            # a watcher subscribed between the gate and the locks:
+            # fall through to the eager (event-publishing) path
+        codes: list[int] = [ASSIGN_MISSING] * n
+        tasks: list[Any] = [None] * n
+        step = MAX_CHANGES_PER_TRANSACTION if self.proposer is not None \
+            else n
+        b = Batch(self, pipeline_depth=pipeline_depth)
+        for off in range(0, n, step):
+            chunk = assignments[off:off + step]
+
+            def run_chunk(tx, chunk=chunk, off=off):
+                self._assign_in_tx(tx, chunk, off, codes, tasks, state,
+                                   message)
+
+            b.update_many(run_chunk, len(chunk))
+        b._flush()
+        b._drain()
+        self.op_counts["columnar_wave_tx"] += 1
+        return codes, tasks
+
+    def _wave_verdicts(self, chunk, off: int, codes, on_ok) -> int:
+        """THE wave-commit validation (shared by the eager and lazy
+        paths so the verdict logic cannot drift): vectorized column
+        checks + a per-distinct-node READY overlay; `on_ok(j, tid, nid,
+        row)` fires for each passing item. Returns the OK count."""
+        rows, vcodes = self.columnar.wave_codes([t for t, _ in chunk])
+        ready: dict[str, bool] = {}
+        ntab = self._tables["node"]
+        ok = 0
+        for j, (tid, nid) in enumerate(chunk):
+            c = int(vcodes[j])
+            if c:
+                codes[off + j] = ASSIGN_MISSING if c == 1 \
+                    else ASSIGN_NOT_PENDING
+                continue
+            node_ok = ready.get(nid)
+            if node_ok is None:
+                node = ntab.get(nid)
+                node_ok = ready[nid] = (
+                    node is not None
+                    and node.status.state == NodeStatusState.READY)
+            if not node_ok:
+                codes[off + j] = ASSIGN_NODE_NOT_READY
+                continue
+            codes[off + j] = ASSIGN_OK
+            on_ok(j, tid, nid, int(rows[j]))
+            ok += 1
+        return ok
+
+    def _assign_in_tx(self, tx: WriteTx, chunk, off: int, codes, tasks,
+                      state, message: str) -> None:
+        """One chunk's eager wave commit: validate against the columns
+        (current for everything committed; in-flight pipelined chunks
+        are disjoint by the wave contract), patch shallow copies, and
+        buffer them straight into the transaction — the ordinary commit
+        loop then owns table swap, index delta, events, and the columnar
+        lockstep scatter."""
+        wall = time.time()
+        ttab = self._tables["task"]
+        missed = [0]
+
+        def buffer_patch(j, tid, nid, _row):
+            old = ttab.get(tid)
+            if old is None:
+                # a pipelined delete's commit (held only _lock) landed
+                # between wave_codes and here: drop, like the object
+                # path's `cur is None` gate — never crash the wave
+                codes[off + j] = ASSIGN_MISSING
+                missed[0] += 1
+                return
+            new = _patch_assign(old, nid, state, message, wall)
+            tx._writes[("task", tid)] = new
+            tx._changelist.append(StoreAction(StoreAction.UPDATE, new))
+            tasks[off + j] = new
+
+        ok = self._wave_verdicts(chunk, off, codes, buffer_patch)
+        self.op_counts["columnar_assign_rows"] += ok - missed[0]
+
+    def _assign_wave_lazy(self, assignments, state, message: str,
+                          ) -> tuple[list[int], list[Any]] | None:
+        """The deferral path: with no watcher to observe events and no
+        raft log to feed, the wave is ONE scatter into the columns plus
+        per-row stale marks; object views, secondary-index updates and
+        events are owed to _heal_stale_tasks (events become moot — no
+        subscriber existed at publish time, matching an empty
+        publish_all). Returns None when a watcher subscribed between
+        the caller's gate and the locks (subscription happens under
+        _lock, so the re-check here is race-free) — the caller falls
+        back to the eager path."""
+        wall = time.time()
+        n = len(assignments)
+        codes: list[int] = [ASSIGN_MISSING] * n
+        emit_batch: list[Any] = []
+        with self._update_lock:
+            self._update_lock_held_since = held = time.monotonic()
+            try:
+                with self._lock:
+                    if self.queue.has_watchers():
+                        # raced a view_and_watch/watch_from subscriber
+                        # (those register under this lock): go eager
+                        return None
+                    self.op_counts["update_tx"] += 1
+                    col = self.columnar
+                    ok_rows: list[int] = []
+                    ok_nodes: list[int] = []
+                    wave = _LazyWave(message, wall)
+
+                    def mark_stale(_j, tid, nid, row):
+                        ok_rows.append(row)
+                        ok_nodes.append(col.nodes.intern(nid))
+                        self._stale_tasks[tid] = wave
+
+                    self._wave_verdicts(assignments, 0, codes, mark_stale)
+                    if ok_rows:
+                        self._version.index += 1
+                        col.assign_rows(np.asarray(ok_rows, np.int64),
+                                        np.asarray(ok_nodes, np.int32),
+                                        int(state), self._version.index)
+                        self.op_counts["columnar_lazy_waves"] += 1
+                        self.op_counts["columnar_assign_rows"] += \
+                            len(ok_rows)
+                    if ok_rows and self.queue.has_watchers():
+                        # a RAW queue.watch() registered mid-wave (that
+                        # path takes only the watch lock — the gate
+                        # above can't see it). Its watch() may have
+                        # returned before an eager wave's publish would
+                        # have run, so it is entitled to these events:
+                        # heal NOW, under the same lock hold (a
+                        # concurrent no-event heal can't pre-empt and
+                        # swallow the batch), publish after the locks.
+                        emit_batch = self._heal_stale_locked(True)
+            finally:
+                self._update_lock_held_since = None
+                _lock_hold.observe(time.monotonic() - held)
+        if emit_batch:
+            self.queue.publish_all(emit_batch)
+        return codes, [None] * n
+
+    def _heal_stale_tasks(self, emit_events: bool = False) -> None:
+        """Materialize every owed object view (lock + publish wrapper
+        around _heal_stale_locked)."""
+        with self._lock:
+            events = self._heal_stale_locked(emit_events)
+        if events:
+            self.queue.publish_all(events)
+
+    def _heal_stale_locked(self, emit_events: bool) -> list[Any]:
+        """The heal body — CALLER HOLDS _lock: shallow patch from the
+        columns + wave record, index delta, table swap, at most once
+        per lazy wave regardless of reader count (the dict swap makes
+        concurrent healers idempotent). emit_events=True returns the
+        eager-equivalent EventUpdate batch + EventCommit for the caller
+        to publish AFTER its lock drops (mirroring _commit's publish
+        ordering)."""
+        stale = self._stale_tasks
+        if not stale:
+            return []
+        self._stale_tasks = {}
+        events: list[Any] = []
+        col = self.columnar
+        table = self._tables["task"]
+        for tid, wave in stale.items():
+            old = table.get(tid)
+            row = col.row_of(tid)
+            if old is None or row < 0:
+                continue
+            new = _patch_assign(
+                old, col.nodes.name(int(col.node_idx[row])),
+                TaskState(int(col.state[row])), wave.message, wave.wall)
+            new.meta.version = Version(int(col.version[row]))
+            new.meta.updated_at = wave.wall
+            self._unindex("task", old)
+            table[tid] = new
+            self._index("task", new)
+            if emit_events:
+                events.append(EventUpdate(new, old=old))
+        self.op_counts["columnar_materializations"] += len(stale)
+        if emit_events and events:
+            events.append(EventCommit(Version(self._version.index)))
+        return events
 
     @property
     def version(self) -> Version:
@@ -526,6 +842,8 @@ class MemoryStore:
             self._indexes[table][idx][key].discard(obj.id)
 
     def _find(self, cls: type[StoreObject], selectors) -> list[StoreObject]:
+        if cls.TABLE == "task" and self._stale_tasks:
+            self._heal_stale_tasks()
         with self._lock:
             self.op_counts[f"find_{cls.TABLE}"] += 1
             table = self._tables[cls.TABLE]

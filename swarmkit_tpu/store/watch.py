@@ -177,6 +177,11 @@ class WatchQueue:
             self._subs = self._subs + (ch,)
         return ch
 
+    def has_watchers(self) -> bool:
+        """True when any subscriber would see a published event — the
+        gate for the store's lazy (event-silent) columnar wave path."""
+        return bool(self._subs)
+
     def publish(self, event: Any) -> None:
         for ch in self._subs:
             ch._offer(event)

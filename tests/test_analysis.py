@@ -319,6 +319,94 @@ def test_int64_clean_outside_kernel_modules():
 
 
 # -------------------------------------------------------------- raw-lock
+# -------------------------------------------------------- columnar-mutate
+def test_columnar_mutate_fires_on_direct_write():
+    src = """
+    def f(store, rows, vals):
+        store.columnar.state[rows] = vals
+    """
+    assert findings(src, "swarmkit_tpu/dispatcher/foo.py") \
+        == ["columnar-mutate"]
+
+
+def test_columnar_mutate_fires_on_attr_write_and_alias():
+    src = """
+    def f(store):
+        store.columnar.node_idx = None
+        col = store.columnar
+        col.version[0] = 7
+        col.valid[3] = False
+    """
+    assert findings(src, "swarmkit_tpu/scheduler/foo.py") \
+        == ["columnar-mutate"] * 3
+
+
+def test_columnar_mutate_fires_on_augassign():
+    src = """
+    def f(store, r):
+        store.columnar.slot[r] += 1
+    """
+    assert findings(src, "swarmkit_tpu/orchestrator/foo.py") \
+        == ["columnar-mutate"]
+
+
+def test_columnar_mutate_not_fired_on_reads_or_wave_api():
+    src = """
+    def f(store, wave):
+        ids = store.columnar.ids_by_state(3)
+        n = store.columnar.get(ids[0])
+        codes, tasks = store.assign_wave(wave)
+        col = store.columnar
+        x = col.state[0]
+        return ids, n, codes, tasks, x
+    """
+    assert findings(src, "swarmkit_tpu/controlapi/foo.py") == []
+
+
+def test_columnar_mutate_allowed_in_the_plane_itself():
+    src = """
+    def f(self, rows, vals):
+        self.columnar.state[rows] = vals
+    """
+    for path in ("swarmkit_tpu/store/columnar.py",
+                 "swarmkit_tpu/store/memory.py",
+                 "swarmkit_tpu/allocator/batched.py",
+                 "swarmkit_tpu/ops/alloc.py"):
+        assert findings(src, path) == []
+
+
+def test_columnar_mutate_alias_in_nested_block_fires():
+    """The taint walk runs in SOURCE order: an alias bound inside a
+    nested block (deeper in the AST than the later write) must still
+    taint it."""
+    src = """
+    def f(store, flag):
+        if flag:
+            col = store.columnar
+        col.state[0] = 1
+    """
+    assert findings(src, "swarmkit_tpu/agent/foo.py") == ["columnar-mutate"]
+
+
+def test_columnar_mutate_alias_rebind_clears_taint():
+    src = """
+    def f(store, other):
+        col = store.columnar
+        col = other
+        col.state[0] = 1
+    """
+    assert findings(src, "swarmkit_tpu/node/foo.py") == []
+
+
+def test_columnar_mutate_pragma_silences():
+    src = """
+    def f(store):
+        # lint: allow(columnar-mutate) test harness corrupting on purpose
+        store.columnar.state[0] = 9
+    """
+    assert findings(src, "swarmkit_tpu/models/foo.py") == []
+
+
 def test_raw_lock_fires():
     src = "import threading\nlock = threading.Lock()\n"
     assert findings(src, "swarmkit_tpu/foo/bar.py") == ["raw-lock"]

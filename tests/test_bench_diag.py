@@ -86,3 +86,24 @@ def test_find_tasks_by_service_shape_used_by_diagnosis():
     store.update(lambda tx: tx.create(Task(id="a", service_id="s1", slot=1)))
     got = store.view(lambda tx: tx.find_tasks(by.ByServiceID("s1")))
     assert [t.id for t in got] == ["a"]
+
+
+def test_store_plane_row_cpu_smoke():
+    """ISSUE 11 parity check at a CPU-smoke size: the bench row's own
+    correctness gates hold (object/columnar end-state equality + columns
+    bit-equal to a rebuild) and the columnar path really took the bulk
+    shape (op counts, not wall clock — timings on this contended 1-core
+    host are meaningless per the store's own op_counts rationale; the
+    >=10x ops/s acceptance is judged by the bench `store_plane` row,
+    where bench owns the machine — measured 25x lazy at this size)."""
+    import numpy as np
+
+    row = bench.bench_store_plane(np, sizes=(4000,))
+    assert row["parity"] is True
+    sub = row["sizes"]["4000"]
+    assert sub["parity"] is True
+    # loose sanity bound only: a GC pause inside the ~8ms columnar
+    # window must not fail tier-1 (the real bar lives in the bench row)
+    assert sub["speedup_x"] > 1, sub
+    assert sub["op_counts"]["columnar_assign_rows"] == 4000
+    assert sub["op_counts"]["columnar_lazy_waves"] == 1

@@ -1,0 +1,425 @@
+"""Columnar store plane (ISSUE 11 tentpole).
+
+Judged properties:
+
+* LOCKSTEP — every committed task create/update/delete is mirrored into
+  the columns by the commit path; after any transaction mix the columns
+  are bit-equal to a from-scratch rebuild of the object table.
+* WAVE WRITE-BACK — `assign_wave` commits whole waves with the object
+  path's exact in-tx verdicts (drop / conflict / ok), identical events,
+  one update transaction on a plain store, MAX_CHANGES chunks on a
+  raft-backed one.
+* LAZY VIEWS — the event-silent deferral path advances columns first
+  and materializes object views only when the API surface asks
+  (get/find/save/update), with index integrity preserved.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from swarmkit_tpu.api.objects import EventCommit, EventUpdate, Node, Task
+from swarmkit_tpu.api.types import NodeStatusState, TaskState
+from swarmkit_tpu.state.proposer import LocalProposer
+from swarmkit_tpu.store import by
+from swarmkit_tpu.store.columnar import ColumnarTasks
+from swarmkit_tpu.store.memory import (
+    ASSIGN_MISSING,
+    ASSIGN_NODE_NOT_READY,
+    ASSIGN_NOT_PENDING,
+    ASSIGN_OK,
+    MemoryStore,
+)
+
+
+def _mk_store(n_nodes=4, n_tasks=12, proposer=None, ready=True):
+    store = MemoryStore(proposer=proposer)
+
+    def seed(tx):
+        for i in range(n_nodes):
+            n = Node(id=f"n{i:02d}")
+            n.status.state = (NodeStatusState.READY if ready
+                              else NodeStatusState.DOWN)
+            tx.create(n)
+        for i in range(n_tasks):
+            t = Task(id=f"t{i:03d}", service_id=f"svc{i % 3}", slot=i + 1)
+            t.status.state = TaskState.PENDING
+            t.desired_state = TaskState.RUNNING
+            tx.create(t)
+
+    store.update(seed)
+    return store
+
+
+def _cols_equal_rebuild(store):
+    snap = store.columnar.snapshot()
+    rebuilt = ColumnarTasks.rebuild(
+        store.view(lambda tx: tx.find_tasks()))
+    return ColumnarTasks.snapshots_equal(snap, rebuilt.snapshot())
+
+
+# ----------------------------------------------------------------- lockstep
+def test_lockstep_crud_and_row_reuse():
+    store = _mk_store(n_tasks=6)
+    col = store.columnar
+    assert len(col) == 6
+    # update mirrors
+    def bump(tx):
+        cur = tx.get_task("t000").copy()
+        cur.status.state = TaskState.ASSIGNED
+        cur.node_id = "n00"
+        tx.update(cur)
+    store.update(bump)
+    assert col.get("t000")[0] == int(TaskState.ASSIGNED)
+    assert col.get("t000")[3] == "n00"
+    # delete frees the row; a new create reuses it
+    row = col.row_of("t001")
+    store.update(lambda tx: tx.delete(Task, "t001"))
+    assert col.row_of("t001") == -1
+
+    def recreate(tx):
+        t = Task(id="t900", service_id="svcX", slot=7)
+        t.status.state = TaskState.PENDING
+        tx.create(t)
+    store.update(recreate)
+    assert col.row_of("t900") == row            # free-list reuse
+    assert _cols_equal_rebuild(store)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_lockstep_random_trace_matches_rebuild(seed):
+    rng = random.Random(seed)
+    store = _mk_store(n_tasks=10)
+    next_id = 10
+    for _ in range(30):
+        op = rng.random()
+
+        def step(tx, op=op):
+            nonlocal next_id
+            tasks = tx.find_tasks()
+            if op < 0.35 or not tasks:
+                t = Task(id=f"t{next_id:03d}",
+                         service_id=f"svc{rng.randrange(4)}",
+                         slot=rng.randrange(50))
+                t.status.state = TaskState.PENDING
+                tx.create(t)
+                next_id += 1
+            elif op < 0.75:
+                cur = rng.choice(tasks).copy()
+                cur.status.state = TaskState(rng.choice(
+                    [int(TaskState.PENDING), int(TaskState.ASSIGNED),
+                     int(TaskState.RUNNING), int(TaskState.FAILED)]))
+                cur.node_id = f"n{rng.randrange(4):02d}" \
+                    if rng.random() < 0.5 else cur.node_id
+                tx.update(cur)
+            else:
+                tx.delete(Task, rng.choice(tasks).id)
+
+        store.update(step)
+    assert _cols_equal_rebuild(store), f"seed {seed}: columns diverged"
+
+
+def test_restore_rebuilds_columns():
+    store = _mk_store(n_tasks=8)
+    store.assign_wave([("t000", "n00"), ("t001", "n01")])
+    snap = store.save()
+    fresh = MemoryStore()
+    fresh.restore(snap)
+    assert _cols_equal_rebuild(fresh)
+    assert fresh.columnar.get("t000")[3] == "n00"
+
+
+# ------------------------------------------------------------- eager waves
+def test_assign_wave_verdicts():
+    store = _mk_store(n_nodes=2, n_tasks=4)
+    store.update(lambda tx: tx.delete(Task, "t003"))
+
+    def degrade(tx):
+        cur = tx.get_node("n01").copy()
+        cur.status.state = NodeStatusState.DOWN
+        tx.update(cur)
+    store.update(degrade)
+
+    def kill(tx):
+        cur = tx.get_task("t002").copy()
+        cur.desired_state = TaskState.REMOVE
+        tx.update(cur)
+    store.update(kill)
+
+    codes, tasks = store.assign_wave([
+        ("t000", "n00"),      # ok
+        ("t001", "n01"),      # node DOWN -> conflict
+        ("t002", "n00"),      # desired past COMPLETE -> drop
+        ("t003", "n00"),      # deleted -> drop
+    ])
+    assert codes == [ASSIGN_OK, ASSIGN_NODE_NOT_READY,
+                     ASSIGN_NOT_PENDING, ASSIGN_MISSING]
+    assert tasks[0].node_id == "n00"
+    assert tasks[0].status.state == TaskState.ASSIGNED
+    assert tasks[1] is tasks[2] is tasks[3] is None
+    # already-assigned rejects on retry
+    codes, _ = store.assign_wave([("t000", "n00")])
+    assert codes == [ASSIGN_NOT_PENDING]
+    assert _cols_equal_rebuild(store)
+
+
+def test_assign_wave_event_parity_with_object_path():
+    """With a watcher present the wave is eager and must publish the
+    exact event shape the object path published: one EventUpdate per
+    task (new state ASSIGNED, old state PENDING) + one EventCommit."""
+    store = _mk_store(n_tasks=3)
+    _, ch = store.view_and_watch(lambda tx: None, limit=None)
+    codes, _ = store.assign_wave([(f"t{i:03d}", "n00") for i in range(3)])
+    assert codes == [ASSIGN_OK] * 3
+    events = []
+    while True:
+        ev = ch.try_get()
+        if ev is None:
+            break
+        events.append(ev)
+    store.queue.stop_watch(ch)
+    updates = [e for e in events if isinstance(e, EventUpdate)]
+    commits = [e for e in events if isinstance(e, EventCommit)]
+    assert len(updates) == 3 and len(commits) == 1
+    for ev in updates:
+        assert ev.obj.status.state == TaskState.ASSIGNED
+        assert ev.obj.node_id == "n00"
+        assert ev.old is not None
+        assert ev.old.status.state == TaskState.PENDING
+        assert ev.obj.meta.version.index == commits[0].version.index
+    # versions visible through the ordinary read path too
+    t = store.view(lambda tx: tx.get_task("t000"))
+    assert t.meta.version.index == commits[0].version.index
+
+
+def test_assign_wave_shallow_patch_is_copy_safe():
+    """The wave patch shares spec subtrees between versions; a later
+    `.copy()` + mutate must fork them (the immutability contract the
+    cheap patch leans on)."""
+    store = _mk_store(n_tasks=1)
+    old = store.view(lambda tx: tx.get_task("t000"))
+    store.assign_wave([("t000", "n00")])
+    new = store.view(lambda tx: tx.get_task("t000"))
+    assert new is not old and new.spec is old.spec      # shared, by design
+    forked = new.copy()
+    forked.spec.resources.reservations.nano_cpus = 123
+    assert old.spec.resources.reservations.nano_cpus != 123
+
+
+def test_assign_wave_raft_chunks():
+    store = MemoryStore(proposer=LocalProposer())
+
+    def seed(tx):
+        n = Node(id="n00")
+        n.status.state = NodeStatusState.READY
+        tx.create(n)
+        for i in range(450):                # > 2x MAX_CHANGES
+            t = Task(id=f"r{i:04d}", service_id="svc", slot=i + 1)
+            t.status.state = TaskState.PENDING
+            tx.create(t)
+    store.update(seed)
+
+    tx0 = store.op_counts["update_tx"]
+    codes, tasks = store.assign_wave(
+        [(f"r{i:04d}", "n00") for i in range(450)])
+    assert codes == [ASSIGN_OK] * 450
+    # raft-backed: chunked at MAX_CHANGES (450 -> 3 proposals)
+    assert store.op_counts["update_tx"] - tx0 == 3
+    got = store.view(lambda tx: tx.find_tasks(
+        by.ByTaskState(TaskState.ASSIGNED)))
+    assert len(got) == 450
+    assert _cols_equal_rebuild(store)
+
+
+# --------------------------------------------------------------- lazy views
+def test_lazy_wave_defers_then_heals_on_get():
+    store = _mk_store(n_tasks=6)
+    codes, tasks = store.assign_wave(
+        [(f"t{i:03d}", "n01") for i in range(6)], lazy=True)
+    assert codes == [ASSIGN_OK] * 6 and tasks == [None] * 6
+    assert len(store._stale_tasks) == 6
+    assert store.op_counts["columnar_lazy_waves"] == 1
+    # columns answer without materializing
+    assert store.columnar.get("t003")[0] == int(TaskState.ASSIGNED)
+    assert sorted(store.columnar.ids_by_node("n01")) == \
+        [f"t{i:03d}" for i in range(6)]
+    assert len(store._stale_tasks) == 6          # still deferred
+    # the object read materializes
+    t = store.view(lambda tx: tx.get_task("t003"))
+    assert t.status.state == TaskState.ASSIGNED and t.node_id == "n01"
+    assert t.status.message == "scheduler assigned task to node"
+    assert not store._stale_tasks
+    assert store.op_counts["columnar_materializations"] == 6
+    assert _cols_equal_rebuild(store)
+
+
+def test_lazy_wave_heals_on_find_with_index_integrity():
+    store = _mk_store(n_tasks=5)
+    store.assign_wave([(f"t{i:03d}", "n02") for i in range(5)], lazy=True)
+    got = store.view(lambda tx: tx.find_tasks(
+        by.ByTaskState(TaskState.ASSIGNED)))
+    assert len(got) == 5
+    by_node = store.view(lambda tx: tx.find_tasks(by.ByNodeID("n02")))
+    assert len(by_node) == 5
+    assert not store.view(lambda tx: tx.find_tasks(
+        by.ByTaskState(TaskState.PENDING)))
+
+
+def test_lazy_wave_heals_before_writes_and_snapshots():
+    store = _mk_store(n_tasks=3)
+    store.assign_wave([("t000", "n00")], lazy=True)
+    # a write transaction heals first (copy-before-mutate interplay:
+    # the tx must see the materialized object, not the stale PENDING)
+    def touch(tx):
+        cur = tx.get_task("t000")
+        assert cur.status.state == TaskState.ASSIGNED
+        cur = cur.copy()
+        cur.status.state = TaskState.RUNNING
+        tx.update(cur)
+    store.update(touch)
+    assert store.columnar.get("t000")[0] == int(TaskState.RUNNING)
+
+    store.assign_wave([("t001", "n00")], lazy=True)
+    snap = store.save()                        # save() heals
+    assert not store._stale_tasks
+    healed = [t for t in snap["task"] if t.id == "t001"]
+    assert healed[0].status.state == TaskState.ASSIGNED
+
+
+def test_lazy_gate_recheck_under_lock():
+    """The lazy path re-checks has_watchers UNDER the store lock (a
+    subscriber can land between the caller's gate and the locks —
+    subscription happens under _lock, so the locked re-check is the
+    race-free one): with a watcher present it must bail to eager."""
+    store = _mk_store(n_tasks=1)
+    _, ch = store.view_and_watch(lambda tx: None, limit=None)
+    try:
+        assert store._assign_wave_lazy(
+            [("t000", "n00")], TaskState.ASSIGNED, "m") is None
+        assert not store._stale_tasks
+        # columns untouched by the refused lazy attempt
+        assert store.columnar.get("t000")[0] == int(TaskState.PENDING)
+    finally:
+        store.queue.stop_watch(ch)
+
+
+def test_lazy_wave_delivers_events_to_raced_raw_subscriber():
+    """A raw queue.watch() registers under the WATCH lock only, so it
+    can land after the lazy gate's locked re-check: the wave must then
+    materialize and publish the eager-equivalent event batch (the
+    subscriber's watch() returned before an eager publish would have
+    run, so it is entitled to the events)."""
+    store = _mk_store(n_tasks=3)
+    orig = store.queue.has_watchers
+    ch = [None]
+
+    def racy(_calls=[0]):
+        # first call = the locked gate (report no watcher, then let one
+        # register, as a raw watch() racing the wave would); later
+        # calls = the post-wave check (sees it)
+        if ch[0] is None:
+            ch[0] = store.queue.watch()
+            return False
+        return orig()
+    store.queue.has_watchers = racy
+    try:
+        codes, _ = store.assign_wave(
+            [(f"t{i:03d}", "n00") for i in range(3)], lazy=True)
+        assert codes == [ASSIGN_OK] * 3
+        # the raced subscriber got the eager-equivalent batch
+        events = []
+        while True:
+            ev = ch[0].try_get()
+            if ev is None:
+                break
+            events.append(ev)
+        updates = [e for e in events if isinstance(e, EventUpdate)]
+        assert len(updates) == 3
+        assert all(e.obj.status.state == TaskState.ASSIGNED
+                   and e.old.status.state == TaskState.PENDING
+                   for e in updates)
+        assert any(isinstance(e, EventCommit) for e in events)
+        assert not store._stale_tasks          # materialized eagerly
+        assert _cols_equal_rebuild(store)
+    finally:
+        store.queue.has_watchers = orig
+        if ch[0] is not None:
+            store.queue.stop_watch(ch[0])
+
+
+def test_lazy_refused_with_watchers():
+    """lazy=True is a request, not an order: with a live watcher the
+    wave must stay eager (event-silent deferral would make the watcher
+    miss assignments)."""
+    store = _mk_store(n_tasks=2)
+    _, ch = store.view_and_watch(lambda tx: None, limit=None)
+    try:
+        codes, tasks = store.assign_wave([("t000", "n00")], lazy=True)
+        assert codes == [ASSIGN_OK]
+        assert tasks[0] is not None              # eager path ran
+        assert not store._stale_tasks
+        assert ch.try_get() is not None          # events flowed
+    finally:
+        store.queue.stop_watch(ch)
+
+
+# ----------------------------------------------------------------- queries
+def test_columnar_queries_and_counters():
+    store = _mk_store(n_tasks=9)
+    col = store.columnar
+    assert col.count_by_state() == {int(TaskState.PENDING): 9}
+    assert sorted(col.ids_by_service("svc0")) == ["t000", "t003", "t006"]
+    assert col.ids_by_node("n00") == []
+    store.assign_wave([("t000", "n00")])
+    assert col.ids_by_node("n00") == ["t000"]
+    assert col.count_by_state() == {int(TaskState.PENDING): 8,
+                                    int(TaskState.ASSIGNED): 1}
+    assert col.get("t000") == (int(TaskState.ASSIGNED),
+                               int(TaskState.RUNNING),
+                               store.version.index, "n00", "svc0", 1)
+    stats = col.stats
+    assert stats["rows_upserted"] >= 10 and stats["array_queries"] >= 4
+
+
+def test_no_columnar_env_fallback(monkeypatch):
+    monkeypatch.setenv("SWARMKIT_TPU_NO_COLUMNAR", "1")
+    store = _mk_store(n_tasks=2)
+    assert store.columnar is None
+    with pytest.raises(RuntimeError):
+        store.assign_wave([("t000", "n00")])
+    # the scheduler auto-falls back to the object path
+    from swarmkit_tpu.scheduler.scheduler import Scheduler
+    sched = Scheduler(store, backend="cpu")
+    assert not sched.columnar_writeback
+    ch = sched._setup()
+    try:
+        sched.tick()
+        tasks = store.view(lambda tx: tx.find_tasks())
+        assert all(t.status.state == TaskState.ASSIGNED for t in tasks)
+    finally:
+        store.queue.stop_watch(ch)
+
+
+def test_wave_columns_bit_equal_after_mixed_traffic():
+    """assign_wave interleaved with ordinary transactions: the columns
+    stay a faithful mirror (the lockstep + wave paths compose)."""
+    rng = random.Random(7)
+    store = _mk_store(n_nodes=3, n_tasks=0)
+    nxt = 0
+    for round_ in range(12):
+        def add(tx):
+            nonlocal nxt
+            for _ in range(rng.randint(1, 6)):
+                t = Task(id=f"m{nxt:04d}", service_id="svc", slot=nxt + 1)
+                t.status.state = TaskState.PENDING
+                tx.create(t)
+                nxt += 1
+        store.update(add)
+        pending = store.columnar.ids_by_state(int(TaskState.PENDING))
+        wave = [(tid, f"n{rng.randrange(3):02d}")
+                for tid in sorted(pending)[:rng.randint(1, 4)]]
+        codes, _ = store.assign_wave(wave)
+        assert all(c == ASSIGN_OK for c in codes)
+        if round_ % 3 == 2 and pending:
+            store.update(lambda tx: tx.delete(Task, sorted(pending)[-1]))
+    assert _cols_equal_rebuild(store)

@@ -379,6 +379,7 @@ def test_scheduler_steady_tick_opcount_guard(async_commit):
                 sched._handle(ev)
             scans0 = sched.encoder.fp_scans
             tx0 = store.op_counts["update_tx"]
+            cw0 = store.op_counts["columnar_wave_tx"]
             sched.tick()                    # completes w-1, primes w
             if async_commit:
                 sched._drain_commit_plane()
@@ -386,6 +387,10 @@ def test_scheduler_steady_tick_opcount_guard(async_commit):
                 f"wave {wave}: write-back took more than one update tx"
             assert sched.encoder.fp_scans == scans0, \
                 f"wave {wave}: steady tick paid a fingerprint scan"
+            # ISSUE 11: the wave rode the columnar bulk path (one
+            # assign_wave, zero per-task object closures)
+            assert store.op_counts["columnar_wave_tx"] - cw0 == 1, \
+                f"wave {wave}: write-back skipped the columnar path"
         sched.flush_pipeline()
         tasks = store.view(lambda tx: tx.find_tasks())
         assert len(tasks) == 5 * 12
@@ -440,6 +445,85 @@ def test_scheduler_async_overlap_engages_and_places_exactly_once():
     finally:
         sched.store.queue.stop_watch(ch)
         sched._commit_worker.close()
+
+
+@pytest.mark.parametrize("async_commit", [False, True])
+def test_columnar_bit_equal_after_50_waves_with_unclean_heal(async_commit):
+    """ISSUE 11 satellite: after a 50-wave pipelined run — including one
+    injected unclean commit mid-run and its heal — the columnar mirror
+    is bit-equal to a from-scratch rebuild of the object table, in both
+    commit modes."""
+    from swarmkit_tpu.store.columnar import ColumnarTasks
+    from swarmkit_tpu.scheduler.scheduler import Scheduler
+    from swarmkit_tpu.utils import failpoints
+
+    def heal_like_run_loop(sched):
+        sched.encoder.poison_all_numeric()
+        if sched._resident is not None:
+            sched._resident.invalidate()
+        if sched._commit_worker is not None:
+            worker_died = sched._commit_worker.failed
+            sched._commit_worker.reset()
+            if sched._worker_unclean is not None:
+                sched._heal_unclean()
+            elif worker_died:
+                sched.encoder.poison_all_numeric()
+
+    store = _seed_cluster(16, "w00", 6)
+    sched = Scheduler(store, backend="jax", pipeline=True,
+                      async_commit=async_commit)
+    ch = sched._setup()
+    try:
+        sched.tick()
+        for wave in range(1, 50):
+            store.update(lambda tx, w=wave: _add_wave(tx, f"w{w:02d}", 6))
+            while True:
+                ev = ch.try_get()
+                if ev is None:
+                    break
+                sched._handle(ev)
+            if wave == 25:
+                # one unclean commit: the write-back stage crashes, the
+                # plane poisons, the run-loop-style heal recovers
+                failpoints.arm("commit.writeback",
+                               error=RuntimeError("injected"), times=1)
+            try:
+                sched.tick()
+            except Exception:   # noqa: BLE001 — poison re-raise
+                heal_like_run_loop(sched)
+            finally:
+                if wave == 25:
+                    failpoints.disarm_all()
+        # drive the backlog home (the healed wave's tasks retry)
+        for _ in range(30):
+            while True:
+                ev = ch.try_get()
+                if ev is None:
+                    break
+                sched._handle(ev)
+            tasks = store.view(lambda tx: tx.find_tasks())
+            if all(t.status.state == TaskState.ASSIGNED for t in tasks):
+                break
+            try:
+                sched.tick()
+            except Exception:   # noqa: BLE001
+                heal_like_run_loop(sched)
+        sched.flush_pipeline()
+        tasks = store.view(lambda tx: tx.find_tasks())
+        assert len(tasks) == 50 * 6
+        assert all(t.status.state == TaskState.ASSIGNED and t.node_id
+                   for t in tasks)
+        # THE satellite acceptance: columns bit-equal to a from-scratch
+        # rebuild after the whole run, heal included
+        snap = store.columnar.snapshot()
+        rebuilt = ColumnarTasks.rebuild(tasks)
+        assert ColumnarTasks.snapshots_equal(snap, rebuilt.snapshot()), \
+            "columns diverged from the object table"
+    finally:
+        failpoints.disarm_all()
+        sched.store.queue.stop_watch(ch)
+        if sched._commit_worker is not None:
+            sched._commit_worker.close()
 
 
 def test_batch_update_many_coalesces_without_proposer():

@@ -706,6 +706,9 @@ def test_debugserver_vars_exposes_opcounts_and_arm_state():
         assert v["failpoints_armed"] == ["probe.site"]
         assert v["trace_armed"] is True
         assert v["store_ops"].get("view_tx", 0) >= 1
+        # columnar plane counters ride along (ISSUE 11 satellite)
+        assert "store_columnar" in v
+        assert v["store_columnar"]["tasks"] >= 0
         v2 = json.loads(urllib.request.urlopen(
             f"http://{srv.addr}/debug/vars").read())
         assert v2["failpoints_armed"] == [] and v2["trace_armed"] is False
