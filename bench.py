@@ -1329,6 +1329,11 @@ def bench_lint_plane(np):
         plain = lockgraph.make_lock("bench.lint_plane")
         plain_is_native = type(plain) is type(threading.Lock())
         disarmed_s = acquire_wall(plain)
+        # ISSUE 12 raw-condition routing: a Condition over the factory
+        # primitive must also stay native-backed and alloc-free disarmed
+        cond = threading.Condition(
+            lockgraph.make_rlock("bench.lint_plane.cond"))
+        cond_is_native = type(cond._lock) is type(threading.RLock())
         disarmed_allocs = allocs["n"]
     finally:
         lockgraph._TrackedLock.__init__ = orig_init
@@ -1357,11 +1362,18 @@ def bench_lint_plane(np):
         # allocates nothing
         "disarmed_tracked_allocs": disarmed_allocs,
         "disarmed_is_native_lock": plain_is_native,
+        "disarmed_condition_is_native": cond_is_native,
         "lint_findings": len(findings),
         "mirror_drift_clean": drift.clean,
+        # full pass now includes the ISSUE 12 dataflow rules (CFG +
+        # taint over the whole tree) and every registered mirror pair;
+        # tier-1 pins the same pass under a 10 s wall budget
         "static_pass_s": round(static_s, 3),
+        "static_pass_budget_ok": static_s <= 10.0,
         "parity": (disarmed_allocs == 0 and plain_is_native
-                   and graph_clean and not findings and drift.clean),
+                   and cond_is_native and graph_clean
+                   and not findings and drift.clean
+                   and static_s <= 10.0),
     }
 
 

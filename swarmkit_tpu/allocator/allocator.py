@@ -58,7 +58,11 @@ class InertNetworkProvider:
 
 
 class PortAllocator:
-    """Published-port bookkeeping (reference manager/allocator/portallocator.go)."""
+    """Published-port bookkeeping (reference manager/allocator/portallocator.go).
+
+    Mirror-registry pair "port-alloc" (analysis/mirror.py): the
+    owner-precheck / dynamic-run / partial-failure shapes are pinned
+    against BatchedPorts — land edits in both twins."""
 
     def __init__(self):
         self._allocated: dict[tuple[str, int], str] = {}  # (proto, port) -> service

@@ -37,7 +37,9 @@ _PORT_SPAN = DYNAMIC_PORT_END - DYNAMIC_PORT_START + 1
 
 class _ArrayPool:
     """Array twin of ipam._Pool: occupancy as a flat bool mask, grants
-    via the shared circular-order kernel."""
+    via the shared circular-order kernel. Mirror-registry pair
+    "ipam-pool" (analysis/mirror.py) pins the method shapes against the
+    scalar oracle; the fuzz pins the values."""
 
     def __init__(self, subnet: ipaddress.IPv4Network):
         self.subnet = subnet

@@ -39,6 +39,9 @@ def validate_subnet(subnet: str) -> ipaddress.IPv4Network:
 
 
 class _Pool:
+    # mirror-registry pair "ipam-pool" (analysis/mirror.py): allocate/
+    # reserve/release shapes are pinned against _ArrayPool — a one-sided
+    # edit fails tier-1 until both twins move (and the table re-records)
     def __init__(self, subnet: ipaddress.IPv4Network):
         self.subnet = subnet
         self.gateway = str(subnet.network_address + 1)

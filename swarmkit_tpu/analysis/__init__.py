@@ -1,15 +1,25 @@
-"""Static/dynamic analysis plane (ISSUE 8): machine-checked CLAUDE.md
-invariants.
+"""Static/dynamic analysis plane (ISSUE 8, dataflow engine ISSUE 12):
+machine-checked CLAUDE.md invariants.
 
-Three parts (docs/static_analysis.md):
+Four parts (docs/static_analysis.md):
 
-  * `lint`      — AST invariant rules over the tree (pragma-suppressable)
-  * `mirror`    — mirrored-tick protocol drift checker (TickPipeline vs
-                  Scheduler._tick_pipelined against a checked-in table)
-  * `lockgraph` — runtime lock-order detector (armable; the factory seam
-                  every threading.Lock/RLock site routes through)
+  * `lint`      — syntactic AST invariant rules over the tree
+                  (pragma-suppressable); `lint.all_rules()` is the full
+                  set including the dataflow rules
+  * `dataflow`  — per-function CFG + forward taint engine; the
+                  flow-sensitive contract rules (store-copy-dataflow,
+                  dirty-feed, barrier-before-drain) ride it
+  * `mirror`    — mirrored-pair drift registry (tick protocol,
+                  scalar-vs-batched allocator twins, eager-vs-lazy
+                  assign_wave) against checked-in tables
+  * `lockgraph` — runtime lock-order detector (armable; the factory
+                  seam every threading.Lock/RLock/Condition site
+                  routes through)
 
 Run standalone over the tree:  python -m swarmkit_tpu.analysis
+  (--json machine output, --changed-only git-scoped edit-loop mode,
+   --print-protocol mirror re-record; exit 0 clean / 1 findings /
+   2 internal error)
 Tier-1 entry:                  tests/test_lint_clean.py
 
 Kept import-light on purpose: `lockgraph` is imported at module scope by

@@ -1,18 +1,123 @@
-"""CLI entry: `python -m swarmkit_tpu.analysis [--print-protocol] [ROOT]`.
+"""CLI entry: `python -m swarmkit_tpu.analysis [options] [ROOT]`.
 
-Exit 0 when the tree is clean (lint findings modulo pragmas == 0 and
-both tick mirrors match the checked-in protocol table); exit 1 with one
-finding per line otherwise. `--print-protocol` prints the freshly
-extracted mirror table in checked-in form (the re-record flow after a
-conscious both-mirror change).
+Exit codes (pinned by tests/test_lint_clean.py):
+
+    0   clean — no lint/dataflow findings (modulo pragmas) and every
+        registered mirror pair matches the checked-in protocol table
+    1   findings — one per line (or a JSON document with --json)
+    2   internal error — the analysis itself crashed (traceback on
+        stderr); distinct from "the tree has findings" so CI can tell
+        a broken gate from a dirty tree
+
+Options:
+
+    --print-protocol   print the freshly extracted mirror table in
+                       checked-in form (the re-record flow after a
+                       conscious both-members change)
+    --json             machine-readable findings: {"findings": [...],
+                       "mirror": {...}, "rules": N, "clean": bool}
+    --changed-only     lint only files reported changed by git
+                       (`git status --porcelain`), and check only the
+                       mirror pairs whose member files changed — the
+                       edit-loop mode. Every rule is per-file, so the
+                       scoped pass agrees with the full pass on every
+                       shared file (tier-1's scope-soundness guard
+                       pins it); falls back to the full pass when git
+                       is unavailable.
 """
 from __future__ import annotations
 
 import argparse
+import json
+import subprocess
 import sys
+import traceback
 from pathlib import Path
 
 from . import lint, mirror
+
+EXIT_CLEAN = 0
+EXIT_FINDINGS = 1
+EXIT_INTERNAL = 2
+
+
+def changed_files(root: Path) -> list[str] | None:
+    """ROOT-relative .py paths with uncommitted changes (staged,
+    unstaged, untracked), or None when git is unavailable / not a
+    repo (caller falls back to the full pass). `git status` paths are
+    TOPLEVEL-relative — when `root` sits below the git toplevel they
+    must be re-anchored, or every path fails the scope filter and a
+    dirty tree silently passes."""
+    try:
+        proc = subprocess.run(
+            ["git", "status", "--porcelain", "-uall"],
+            cwd=str(root), capture_output=True, text=True, timeout=30)
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            cwd=str(root), capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0 or top.returncode != 0:
+        return None
+    toplevel = Path(top.stdout.strip())
+    root_res = root.resolve()
+    out: list[str] = []
+    for line in proc.stdout.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:].strip()
+        if " -> " in path:                  # rename: take the new side
+            path = path.split(" -> ", 1)[1]
+        path = path.strip('"')
+        if not path.endswith(".py"):
+            continue
+        try:
+            rel = (toplevel / path).resolve().relative_to(root_res)
+        except ValueError:
+            continue                        # changed, but outside root
+        out.append(rel.as_posix())
+    return out
+
+
+def run(root: Path, changed_only: bool = False) -> dict:
+    """One full (or git-scoped) analysis pass; returns the result
+    document the CLI renders as text or JSON."""
+    scope: list[str] | None = None
+    if changed_only:
+        scope = changed_files(root)
+    if scope is None:
+        findings = lint.lint_tree(root)
+        specs = mirror.MIRRORS
+    else:
+        in_tree = [p for p in scope
+                   if p.startswith(("swarmkit_tpu/", "tests/"))]
+        findings = lint.lint_files(root, in_tree)
+        changed = set(in_tree)
+        # a pair is re-checked when ANY member file changed: a
+        # one-sided edit must fail even though the other member's
+        # file is untouched
+        pairs = {s.pair for s in mirror.MIRRORS if s.path in changed}
+        specs = tuple(s for s in mirror.MIRRORS if s.pair in pairs)
+    if specs:
+        drift = mirror.check_drift(root, specs=specs)
+    else:
+        drift = mirror.DriftReport(diffs={}, missing_common={})
+    return {
+        "clean": not findings and drift.clean,
+        "findings": [
+            {"rule": f.rule, "path": f.path, "line": f.line,
+             "message": f.message} for f in findings],
+        "mirror": {
+            "clean": drift.clean,
+            "diffs": dict(drift.diffs),
+            "missing_common": {k: list(v)
+                               for k, v in drift.missing_common.items()},
+        },
+        "rules": len(lint.all_rules()),
+        "scoped": scope is not None,
+        "scope": sorted(scope) if scope is not None else None,
+        "_render": ([f.render() for f in findings], drift.render()),
+    }
 
 
 def main(argv=None) -> int:
@@ -22,28 +127,37 @@ def main(argv=None) -> int:
     ap.add_argument("--print-protocol", action="store_true",
                     help="print the extracted mirror protocol table "
                          "(paste into analysis/mirror.py EXPECTED)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="lint only git-changed files (+ the mirror "
+                         "pairs they belong to)")
     args = ap.parse_args(argv)
 
     root = Path(args.root) if args.root else \
         Path(__file__).resolve().parents[2]
-    if args.print_protocol:
-        print(mirror.record(root))
-        return 0
+    try:
+        if args.print_protocol:
+            print(mirror.record(root))
+            return EXIT_CLEAN
 
-    failed = False
-    findings = lint.lint_tree(root)
-    for f in findings:
-        print(f.render())
-    if findings:
-        failed = True
-    drift = mirror.check_drift(root)
-    print(drift.render())
-    if not drift.clean:
-        failed = True
-    if not findings:
-        print(f"lint: clean ({len(lint.RULES)} rules over "
-              "swarmkit_tpu/ + tests/)")
-    return 1 if failed else 0
+        doc = run(root, changed_only=args.changed_only)
+        finding_lines, drift_text = doc.pop("_render")
+        if args.as_json:
+            print(json.dumps(doc, indent=2, sort_keys=True))
+        else:
+            for line in finding_lines:
+                print(line)
+            print(drift_text)
+            if not finding_lines:
+                scope_note = " (changed-only scope)" if doc["scoped"] \
+                    else ""
+                print(f"lint: clean ({doc['rules']} rules over "
+                      f"swarmkit_tpu/ + tests/{scope_note})")
+        return EXIT_CLEAN if doc["clean"] else EXIT_FINDINGS
+    except Exception:
+        traceback.print_exc()
+        return EXIT_INTERNAL
 
 
 if __name__ == "__main__":

@@ -1,0 +1,982 @@
+"""Flow-sensitive dataflow contract engine (ISSUE 12).
+
+PR 8's lint rules are per-statement heuristics; the contracts that
+actually guard parity are *flow* properties — "a store object tainted
+here must be copied before a write reaches it THERE", "an unmarked
+NodeInfo mutation is invisible to the tracked encoder unless a mark
+lands on every path through it", "every drain trigger barriers before
+it touches wave state". This module compiles those CLAUDE.md contracts
+into dataflow rules over a per-function control-flow graph:
+
+  * `CFG` — statement-level CFG built from the AST: if/else joins,
+    for/while back edges (break/continue handled), try bodies with
+    conservative edges into their handlers, finally on all paths,
+    with-blocks linear. One synthetic entry and exit per function.
+  * a forward taint engine (worklist fixpoint) whose lattice is
+    per-name tags {OBJ: live store object, CONT: container holding
+    live objects}; merge at joins is set-union (a MAY analysis: taint
+    on any incoming path survives). Aliases propagate through plain
+    assignment, attribute reads off a tainted base, tuple unpacking,
+    container append/element reads, and loop iteration; `.copy()`,
+    `copy.deepcopy` and `dataclasses.replace` sanitize. Call
+    boundaries use the curated summary table below (`CALL_SUMMARIES`)
+    — anything unknown returns clean (the engine under-approximates
+    across calls on purpose; in-function flows are the bug class PR 8
+    documented as blind spots).
+  * path queries for the ordering rules: "does a mark-free path from
+    entry reach this site AND a mark-free path from this site reach
+    exit" (dirty-feed) and "does any barrier-free path from entry
+    reach a wave-state read" (barrier-before-drain).
+
+Three rules ride the engine (registered into the lint driver via
+`lint.all_rules()`):
+
+  `store-copy-dataflow`   flow- and alias-sensitive copy-before-mutate
+                          (supersedes PR 8's linear-scan rule): catches
+                          the append/loop-write shape (collect live
+                          objects into a list, mutate them in a later
+                          loop), tuple unpacking, attribute aliasing
+                          (`st = t.status; st.state = X`), and clears
+                          taint only on real sanitizers — a `.copy()`
+                          on ONE alias does not clean the others.
+  `dirty-feed`            the round-6 tracked-encoder contract: every
+                          NodeInfo mutator call in the Scheduler's
+                          event/tick paths must have a mark-feed call
+                          (`mark_numeric`/`mark_replaced`/
+                          `mark_node_set_changed`/restamp/poison) on
+                          EVERY path through the mutation; the
+                          `if info.add_task(t): mark_numeric(info)`
+                          idiom is recognized (the mutation only
+                          happened on the true branch). The wave-commit
+                          path is whitelisted (restamp reconciles it).
+  `barrier-before-drain`  the async-commit-plane contract, in BOTH
+                          mirrored tick implementations: from each
+                          curated drain-trigger entry point, every CFG
+                          path must take a commit-plane barrier before
+                          its first read of wave state (or, for the
+                          terminal drains, must pass a barrier on every
+                          path to exit). `barrier_coverage()` lets the
+                          tier-1 gate pin that the curated entry points
+                          still exist — a rename must not silently
+                          disable the rule.
+
+Suppression uses the ordinary pragma syntax (`# lint: allow(<rule>)`).
+Adding a dataflow rule: build on `CFG`/`TaintAnalysis`/`path queries`
+here, register in `RULES` at the bottom, add must-fire AND
+must-not-fire fixtures in tests/test_analysis.py, and document it in
+docs/static_analysis.md (the dataflow-engine section).
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterator
+
+from .lint import Finding, Module, Rule, _attr_chain
+
+# =====================================================================
+# CFG
+# =====================================================================
+
+
+@dataclass
+class CFGNode:
+    """One statement (or synthetic entry/exit). `stmt` is the ast
+    statement; branch heads (If/While/For/Try) appear as their own
+    nodes whose successors are the branch arms, and their BODY
+    statements are separate nodes — `stmt` for a branch head covers
+    only the test/iter expression."""
+
+    idx: int
+    stmt: ast.stmt | None
+    kind: str                      # "entry" | "exit" | "stmt" | "head"
+    succs: list[int] = field(default_factory=list)
+    preds: list[int] = field(default_factory=list)
+
+
+class CFG:
+    """Statement-level CFG of ONE function body (nested defs are NOT
+    inlined — each gets its own CFG; a Lambda/def statement is an
+    ordinary statement node here)."""
+
+    def __init__(self, fn: ast.FunctionDef | ast.AsyncFunctionDef):
+        self.fn = fn
+        self.nodes: list[CFGNode] = []
+        self.entry = self._new(None, "entry")
+        self.exit = self._new(None, "exit")
+        # loop stack: (head_idx, break_targets list) for continue/break
+        self._loops: list[tuple[int, list[int]]] = []
+        # enclosing finalbody statement lists (innermost last): an
+        # abrupt exit (Return/Raise) threads INLINE CLONES of these
+        # before reaching exit, so a mark/barrier in a finally is seen
+        # on the abrupt path too (statement nodes are positional — the
+        # clones share the same ast objects; rules dedupe by identity)
+        self._finallies: list[list] = []
+        tails = self._build(fn.body, [self.entry.idx])
+        self._link(tails, self.exit.idx)
+
+    # ------------------------------------------------------------ helpers
+    def _new(self, stmt, kind: str) -> CFGNode:
+        node = CFGNode(len(self.nodes), stmt, kind)
+        self.nodes.append(node)
+        return node
+
+    def _link(self, preds: list[int], succ: int) -> None:
+        for p in preds:
+            if succ not in self.nodes[p].succs:
+                self.nodes[p].succs.append(succ)
+                self.nodes[succ].preds.append(p)
+
+    # ------------------------------------------------------------- builder
+    def _build(self, stmts, preds: list[int]) -> list[int]:
+        """Thread `stmts` after `preds`; returns the fall-through
+        tails (empty when every path returned/raised/broke)."""
+        cur = preds
+        for s in stmts:
+            if not cur:
+                # unreachable code after a return/raise: still give it
+                # nodes (rules may want the sites) but leave it dangling
+                cur = []
+            if isinstance(s, ast.If):
+                head = self._new(s, "head")
+                self._link(cur, head.idx)
+                body_tails = self._build(s.body, [head.idx])
+                if s.orelse:
+                    else_tails = self._build(s.orelse, [head.idx])
+                else:
+                    else_tails = [head.idx]
+                cur = body_tails + else_tails
+            elif isinstance(s, (ast.While, ast.For, ast.AsyncFor)):
+                head = self._new(s, "head")
+                self._link(cur, head.idx)
+                breaks: list[int] = []
+                self._loops.append((head.idx, breaks))
+                body_tails = self._build(s.body, [head.idx])
+                self._loops.pop()
+                self._link(body_tails, head.idx)      # back edge
+                else_tails = (self._build(s.orelse, [head.idx])
+                              if s.orelse else [head.idx])
+                cur = else_tails + breaks
+            elif isinstance(s, (ast.Try, getattr(ast, "TryStar", ast.Try))):
+                body_entry = self._new(s, "head")
+                self._link(cur, body_entry.idx)
+                # abrupt exits inside body/handlers/else must thread
+                # this finalbody (popped again before the normal-flow
+                # finalbody build below — a return IN a finally runs
+                # only the OUTER finallies)
+                self._finallies.append(list(s.finalbody))
+                body_tails = self._build(s.body, [body_entry.idx])
+                # conservative: an exception may fire after ANY body
+                # statement — every body node can jump to each handler
+                body_nodes = [n.idx for n in self.nodes
+                              if n.idx > body_entry.idx
+                              and n.kind != "exit"]
+                handler_tails: list[int] = []
+                for h in s.handlers:
+                    h_entry = self._new(h, "head")
+                    self._link([body_entry.idx], h_entry.idx)
+                    for bn in body_nodes:
+                        if bn < h_entry.idx:
+                            self._link([bn], h_entry.idx)
+                    handler_tails += self._build(h.body, [h_entry.idx])
+                else_tails = (self._build(s.orelse, body_tails)
+                              if s.orelse else body_tails)
+                pre_finally = else_tails + handler_tails
+                self._finallies.pop()
+                if s.finalbody:
+                    cur = self._build(s.finalbody, pre_finally or cur)
+                else:
+                    cur = pre_finally
+            elif isinstance(s, (ast.With, ast.AsyncWith)):
+                head = self._new(s, "head")
+                self._link(cur, head.idx)
+                cur = self._build(s.body, [head.idx])
+            elif isinstance(s, (ast.Return, ast.Raise)):
+                node = self._new(s, "stmt")
+                self._link(cur, node.idx)
+                # thread enclosing finally bodies (innermost first)
+                # before exit: a mark/barrier in a finally IS executed
+                # on this abrupt path. Inline clones — loop/finally
+                # stacks are snapshot-restored so the clone build can't
+                # leak break/continue targets into the outer walk.
+                tails = [node.idx]
+                pending = list(self._finallies)
+                saved_fin, saved_loops = self._finallies, self._loops
+                self._finallies, self._loops = [], []
+                for fb in reversed(pending):
+                    tails = self._build(fb, tails)
+                    if not tails:
+                        break       # the finally itself exits abruptly
+                self._finallies, self._loops = saved_fin, saved_loops
+                self._link(tails, self.exit.idx)
+                cur = []
+            elif isinstance(s, ast.Break):
+                node = self._new(s, "stmt")
+                self._link(cur, node.idx)
+                if self._loops:
+                    self._loops[-1][1].append(node.idx)
+                cur = []
+            elif isinstance(s, ast.Continue):
+                node = self._new(s, "stmt")
+                self._link(cur, node.idx)
+                if self._loops:
+                    self._link([node.idx], self._loops[-1][0])
+                cur = []
+            else:
+                node = self._new(s, "stmt")
+                self._link(cur, node.idx)
+                cur = [node.idx]
+        return cur
+
+    # --------------------------------------------------------- path queries
+    def reaches_without(self, start: int, targets: set[int],
+                        blockers: set[int]) -> bool:
+        """True when some path from `start` reaches any of `targets`
+        without passing THROUGH a blocker node (a blocker that IS a
+        target still counts as reached — callers exclude that case by
+        construction)."""
+        seen = set()
+        stack = [start]
+        while stack:
+            i = stack.pop()
+            if i in seen:
+                continue
+            seen.add(i)
+            if i in targets:
+                return True
+            if i in blockers:
+                continue
+            stack.extend(self.nodes[i].succs)
+        return False
+
+
+def iter_functions(tree: ast.AST) -> Iterator[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _base_name(node: ast.AST) -> str:
+    """Root Name of an attribute/subscript chain ('' if dynamic)."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else ""
+
+
+def _contains_call(stmt: ast.AST, names: frozenset[str]) -> bool:
+    """True when `stmt` (excluding nested defs) contains a call whose
+    attribute/function name is in `names`."""
+    for n in _walk_shallow(stmt):
+        if isinstance(n, ast.Call):
+            fn = n.func
+            if isinstance(fn, ast.Attribute) and fn.attr in names:
+                return True
+            if isinstance(fn, ast.Name) and fn.id in names:
+                return True
+    return False
+
+
+def _walk_shallow(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk that does not descend into nested function bodies."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for c in ast.iter_child_nodes(n):
+            if not isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                stack.append(c)
+
+
+# =====================================================================
+# Taint engine
+# =====================================================================
+
+OBJ = "obj"        # a live store object (tx.get_* result or alias)
+CONT = "cont"      # a container holding live store objects
+
+# store getters/finders: the taint sources. Receiver must be the
+# conventional transaction name — every store callback in this tree
+# names it `tx` (the PR 8 rule pinned the same convention).
+GETTERS = frozenset({
+    "get_node", "get_task", "get_service", "get_cluster",
+    "get_network", "get_secret", "get_config", "get_volume",
+    "get_extension", "get_resource", "get_member",
+})
+FINDERS = frozenset({
+    "find_nodes", "find_tasks", "find_services", "find_clusters",
+    "find_networks", "find_secrets", "find_configs", "find_volumes",
+    "find_extensions", "find_resources", "find_members",
+})
+TX_NAMES = frozenset({"tx"})
+
+# curated call-boundary summaries: dotted chain (or bare attr) -> tag
+# returned. Everything else returns CLEAN (under-approximate across
+# calls; the in-function flows are the contract). `.copy()` /
+# deepcopy / dataclasses.replace are the sanctioned sanitizers.
+CALL_SUMMARIES: dict[str, str | None] = {
+    "copy": None,                 # method: x.copy() -> fresh object
+    "copy.deepcopy": None,
+    "dataclasses.replace": None,
+    "replace": None,              # dataclasses.replace imported bare
+    "sorted": "arg0",             # order-only: sorted(tainted) stays
+    "list": "arg0",               # container/identity pass-throughs
+    "tuple": "arg0",
+    "reversed": "arg0",
+}
+
+# container mutators that POUR a tainted element into the receiver
+_POUR = frozenset({"append", "add", "insert", "appendleft"})
+_POUR_MANY = frozenset({"extend", "update"})
+# mutating methods that, invoked through an ATTRIBUTE of a live store
+# object, mutate shared store state (`cur.volumes.append(v)`)
+MUTATING_METHODS = frozenset({
+    "append", "add", "extend", "insert", "update", "setdefault",
+    "pop", "popitem", "remove", "discard", "clear", "sort", "reverse",
+    "appendleft", "extendleft",
+})
+
+
+class TaintState:
+    """Per-program-point name tags. Tiny immutable-ish wrapper over two
+    frozensets so worklist convergence checks are cheap."""
+
+    __slots__ = ("obj", "cont")
+
+    def __init__(self, obj=frozenset(), cont=frozenset()):
+        self.obj = obj
+        self.cont = cont
+
+    def merge(self, other: "TaintState") -> "TaintState":
+        return TaintState(self.obj | other.obj, self.cont | other.cont)
+
+    def __eq__(self, other):
+        return (isinstance(other, TaintState)
+                and self.obj == other.obj and self.cont == other.cont)
+
+    __hash__ = None
+
+    def tag_of(self, name: str) -> str | None:
+        if name in self.obj:
+            return OBJ
+        if name in self.cont:
+            return CONT
+        return None
+
+    def bind(self, name: str, tag: str | None) -> "TaintState":
+        obj, cont = self.obj, self.cont
+        obj = obj | {name} if tag == OBJ else obj - {name}
+        cont = cont | {name} if tag == CONT else cont - {name}
+        return TaintState(obj, cont)
+
+
+def _expr_tag(expr: ast.AST, st: TaintState) -> str | None:
+    """Abstract value of an expression under `st`."""
+    if isinstance(expr, ast.Name):
+        return st.tag_of(expr.id)
+    if isinstance(expr, ast.Attribute):
+        # attribute read off a live object is itself live shared state
+        # (t.status, t.spec, ...) — the alias shape PR 8 missed
+        base = _expr_tag(expr.value, st)
+        return OBJ if base == OBJ else None
+    if isinstance(expr, ast.Subscript):
+        base = _expr_tag(expr.value, st)
+        if base == CONT:
+            return OBJ if not isinstance(expr.slice, ast.Slice) else CONT
+        return OBJ if base == OBJ else None
+    if isinstance(expr, ast.Call):
+        return _call_tag(expr, st)
+    if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+        tags = [_expr_tag(e, st) for e in expr.elts]
+        return CONT if any(t in (OBJ, CONT) for t in tags) else None
+    if isinstance(expr, ast.BoolOp):
+        tags = [_expr_tag(v, st) for v in expr.values]
+        if OBJ in tags:
+            return OBJ
+        return CONT if CONT in tags else None
+    if isinstance(expr, ast.IfExp):
+        t1, t2 = _expr_tag(expr.body, st), _expr_tag(expr.orelse, st)
+        if OBJ in (t1, t2):
+            return OBJ
+        return CONT if CONT in (t1, t2) else None
+    if isinstance(expr, ast.NamedExpr):
+        return _expr_tag(expr.value, st)
+    if isinstance(expr, (ast.ListComp, ast.SetComp)):
+        # [f(t) for t in tainted_container]: if the element expression
+        # is (an alias of) the iteration var over a tainted source, the
+        # comprehension is a container of live objects
+        gen = expr.generators[0] if expr.generators else None
+        if gen is not None:
+            src = _expr_tag(gen.iter, st)
+            if src in (OBJ, CONT) and isinstance(gen.target, ast.Name) \
+                    and isinstance(expr.elt, ast.Name) \
+                    and expr.elt.id == gen.target.id:
+                return CONT
+        return None
+    return None
+
+
+def _call_tag(call: ast.Call, st: TaintState) -> str | None:
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        recv = fn.value
+        # tx.get_* / tx.find_*
+        if isinstance(recv, ast.Name) and recv.id in TX_NAMES:
+            if fn.attr in GETTERS:
+                return OBJ
+            if fn.attr in FINDERS:
+                return CONT
+        # sanitizer: anything.copy() -> clean fresh object
+        if fn.attr == "copy" and not call.args and not call.keywords:
+            return None
+        # container read-throughs on tainted receivers
+        if fn.attr in ("values",):
+            return CONT if _expr_tag(recv, st) == CONT else None
+        if fn.attr in ("get", "popleft", "popitem"):
+            return OBJ if _expr_tag(recv, st) == CONT else None
+        if fn.attr == "pop":
+            return OBJ if _expr_tag(recv, st) == CONT else None
+        chain = _attr_chain(fn)
+        summ = CALL_SUMMARIES.get(chain, "?")
+        if summ != "?":
+            return _summary_result(summ, call, st)
+    elif isinstance(fn, ast.Name):
+        summ = CALL_SUMMARIES.get(fn.id, "?")
+        if summ != "?":
+            return _summary_result(summ, call, st)
+    return None
+
+
+def _summary_result(summ, call: ast.Call, st: TaintState) -> str | None:
+    if summ == "arg0":
+        return _expr_tag(call.args[0], st) if call.args else None
+    return summ
+
+
+def _iter_tag(iter_expr: ast.AST, st: TaintState) -> str | None:
+    """Tag of the loop variable for `for x in iter_expr`."""
+    src = _expr_tag(iter_expr, st)
+    if src == CONT:
+        return OBJ
+    if isinstance(iter_expr, ast.Call) \
+            and isinstance(iter_expr.func, ast.Attribute):
+        fn = iter_expr.func
+        if fn.attr in ("values", "items") \
+                and _expr_tag(fn.value, st) == CONT:
+            return OBJ
+        if isinstance(fn.value, ast.Name) and fn.value.id in TX_NAMES \
+                and fn.attr in FINDERS:
+            return OBJ
+    return None
+
+
+class TaintAnalysis:
+    """Forward worklist fixpoint of TaintState over one CFG."""
+
+    def __init__(self, cfg: CFG):
+        self.cfg = cfg
+        self.in_states: dict[int, TaintState] = {}
+        self._run()
+
+    # ----------------------------------------------------------- transfer
+    def _transfer(self, node: CFGNode, st: TaintState) -> TaintState:
+        s = node.stmt
+        if s is None:
+            return st
+        if isinstance(s, ast.Assign):
+            tag = _expr_tag(s.value, st)
+            for tgt in s.targets:
+                st = self._bind_target(tgt, s.value, tag, st)
+            return st
+        if isinstance(s, ast.AnnAssign) and s.value is not None:
+            return self._bind_target(
+                s.target, s.value, _expr_tag(s.value, st), st)
+        if isinstance(s, ast.NamedExpr):
+            return st.bind(s.target.id, _expr_tag(s.value, st))
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            tag = _iter_tag(s.iter, st)
+            return self._bind_target(s.target, s.iter, tag, st,
+                                     unpack_tag=tag)
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            for item in s.items:
+                if isinstance(item.optional_vars, ast.Name):
+                    st = st.bind(item.optional_vars.id,
+                                 _expr_tag(item.context_expr, st))
+            return st
+        if isinstance(s, ast.Delete):
+            for t in s.targets:
+                if isinstance(t, ast.Name):
+                    st = st.bind(t.id, None)
+            return st
+        if isinstance(s, ast.Expr) and isinstance(s.value, ast.Call):
+            call = s.value
+            fn = call.func
+            if isinstance(fn, ast.Attribute) \
+                    and isinstance(fn.value, ast.Name):
+                recv = fn.value.id
+                if fn.attr in _POUR and call.args \
+                        and _expr_tag(call.args[0], st) in (OBJ, CONT):
+                    return st.bind(recv, CONT)
+                if fn.attr in _POUR_MANY and call.args \
+                        and _expr_tag(call.args[0], st) == CONT:
+                    return st.bind(recv, CONT)
+            # walrus inside a call statement (rare) — pick up bindings
+            for n in _walk_shallow(s):
+                if isinstance(n, ast.NamedExpr) \
+                        and isinstance(n.target, ast.Name):
+                    st = st.bind(n.target.id, _expr_tag(n.value, st))
+            return st
+        if isinstance(s, ast.If) or isinstance(s, ast.While):
+            # walrus in the test binds for both branches
+            for n in _walk_shallow(s.test):
+                if isinstance(n, ast.NamedExpr) \
+                        and isinstance(n.target, ast.Name):
+                    st = st.bind(n.target.id, _expr_tag(n.value, st))
+            return st
+        return st
+
+    def _bind_target(self, tgt, value, tag, st: TaintState,
+                     unpack_tag=None) -> TaintState:
+        if isinstance(tgt, ast.Name):
+            return st.bind(tgt.id, tag)
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            elts = tgt.elts
+            if isinstance(value, (ast.Tuple, ast.List)) \
+                    and len(value.elts) == len(elts):
+                # a, b = t1, t2 — elementwise (the tuple-unpack shape)
+                for e, v in zip(elts, value.elts):
+                    st = self._bind_target(e, v, _expr_tag(v, st), st)
+                return st
+            # unpack of a tainted aggregate: every name may be live
+            per = unpack_tag if unpack_tag is not None else (
+                OBJ if tag in (OBJ, CONT) else None)
+            for e in elts:
+                if isinstance(e, ast.Name):
+                    st = st.bind(e.id, per)
+                elif isinstance(e, ast.Starred) \
+                        and isinstance(e.value, ast.Name):
+                    st = st.bind(e.value.id,
+                                 CONT if per == OBJ else per)
+            return st
+        if isinstance(tgt, ast.Subscript):
+            # lst[i] = tainted -> lst becomes container-of-tainted
+            if isinstance(tgt.value, ast.Name) and tag in (OBJ, CONT):
+                return st.bind(tgt.value.id, CONT)
+        return st
+
+    # ------------------------------------------------------------ fixpoint
+    def _run(self) -> None:
+        cfg = self.cfg
+        init = TaintState()
+        self.in_states = {cfg.entry.idx: init}
+        work = [cfg.entry.idx]
+        out: dict[int, TaintState] = {}
+        while work:
+            i = work.pop()
+            node = cfg.nodes[i]
+            st = self.in_states.get(i, init)
+            new_out = self._transfer(node, st)
+            if out.get(i) == new_out:
+                continue
+            out[i] = new_out
+            for s in node.succs:
+                merged = self.in_states.get(s)
+                nxt = new_out if merged is None else merged.merge(new_out)
+                if merged is None or nxt != merged:
+                    self.in_states[s] = nxt
+                    work.append(s)
+
+
+# =====================================================================
+# Rule 1: store-copy-dataflow
+# =====================================================================
+
+
+class StoreCopyDataflowRule(Rule):
+    """Flow- and alias-sensitive copy-before-mutate (supersedes the
+    PR 8 linear heuristic). A `tx.get_*` result is a live reference
+    shared with every reader; `tx.find_*` returns a list of them. A
+    write reaching any alias of one — through plain assignment, tuple
+    unpack, attribute aliasing, or a container it was appended to —
+    must be preceded by `.copy()` on THAT object along every path."""
+
+    name = "store-copy-dataflow"
+    invariant = ("store objects are live references: `.copy()` before "
+                 "mutating a tx.get_*/find_* result in a transaction — "
+                 "tracked flow-sensitively through aliases, tuple "
+                 "unpacks, containers, and loop iteration")
+
+    def applies(self, path: str) -> bool:
+        return path.startswith("swarmkit_tpu/")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        for fn in iter_functions(mod.tree):
+            # pre-filter: no taint source in this function's own body
+            # means no findings — skip the CFG+fixpoint entirely (the
+            # whole-tree pass must stay inside the 10 s budget)
+            if not self._has_source(fn):
+                continue
+            cfg = CFG(fn)
+            ta = TaintAnalysis(cfg)
+            for node in cfg.nodes:
+                st = ta.in_states.get(node.idx)
+                if st is None or (not st.obj and not st.cont):
+                    continue
+                yield from self._check_node(mod, node, st)
+
+    @staticmethod
+    def _has_source(fn) -> bool:
+        for n in _walk_shallow(fn):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and isinstance(n.func.value, ast.Name) \
+                    and n.func.value.id in TX_NAMES \
+                    and n.func.attr in (GETTERS | FINDERS):
+                return True
+        return False
+
+    def _check_node(self, mod: Module, node: CFGNode,
+                    st: TaintState) -> Iterator[Finding]:
+        s = node.stmt
+        if s is None or node.kind == "head":
+            return
+        targets: list[ast.AST] = []
+        if isinstance(s, ast.Assign):
+            targets = s.targets
+        elif isinstance(s, ast.AugAssign):
+            targets = [s.target]
+        elif isinstance(s, ast.AnnAssign):
+            targets = [s.target]
+        for tgt in targets:
+            if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                yield from self._check_write(mod, s, tgt, st)
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                for e in tgt.elts:
+                    if isinstance(e, (ast.Attribute, ast.Subscript)):
+                        yield from self._check_write(mod, s, e, st)
+        # mutating method through an attribute of a live object
+        # (`cur.volumes.append(v)` mutates replicated shared state)
+        if isinstance(s, ast.Expr) and isinstance(s.value, ast.Call):
+            call = s.value
+            fn = call.func
+            if isinstance(fn, ast.Attribute) \
+                    and fn.attr in MUTATING_METHODS \
+                    and isinstance(fn.value, (ast.Attribute,
+                                              ast.Subscript)) \
+                    and _expr_tag(fn.value, st) == OBJ:
+                base = _base_name(fn.value) or "<expr>"
+                yield self.finding(
+                    mod, call,
+                    f".{fn.attr}() on an attribute of {base!r}, a "
+                    "live store object — .copy() the object before "
+                    "mutating its containers")
+
+    def _check_write(self, mod: Module, s, tgt,
+                     st: TaintState) -> Iterator[Finding]:
+        """Fire when the object being written into — the target minus
+        its final attribute/index — is (an alias/element of) a live
+        store object. `ts[0].status.state = X` over a find_* list
+        fires; `lst[0] = x` on a plain local container does not."""
+        if _expr_tag(tgt.value, st) != OBJ:
+            return
+        base = _base_name(tgt) or "<expr>"
+        kind = ("augmented write" if isinstance(s, ast.AugAssign)
+                else "write")
+        yield self.finding(
+            mod, tgt,
+            f"{kind} through {base!r}, a live store object "
+            "(tx.get_*/find_* result or alias) — .copy() before "
+            "mutating; a copy of one alias does not clean the others")
+
+
+# =====================================================================
+# Rule 2: dirty-feed
+# =====================================================================
+
+
+class DirtyFeedRule(Rule):
+    """Round-6 tracked-encoder contract: an unmarked NodeInfo mutation
+    is INVISIBLE to the zero-scan encode. Every mutator call in the
+    Scheduler's event/tick paths must have a mark-feed call on every
+    path through the mutation (before OR after — a mark anywhere in
+    the same invocation covers the row until the next encode)."""
+
+    name = "dirty-feed"
+    invariant = ("every NodeInfo mutation on a Scheduler path must "
+                 "reach the tracked-encoder dirty feed (mark_numeric / "
+                 "mark_replaced / mark_node_set_changed / restamp / "
+                 "poison) on EVERY path through the mutation; the "
+                 "wave-commit path is whitelisted (restamp reconciles)")
+
+    AUDITED = ("swarmkit_tpu/scheduler/scheduler.py",)
+    MUTATORS = frozenset({"add_task", "remove_task", "task_failed"})
+    MARKS = frozenset({
+        "mark_numeric", "mark_replaced", "mark_node_set_changed",
+        "restamp_counts", "force_numeric_reencode", "poison_all_numeric",
+        "apply_counts",
+    })
+    # the wave-commit path: apply_placements' bulk walk is reconciled
+    # by restamp_counts / the unclean heal, per the async-commit plane
+    WHITELIST_FUNCS = frozenset({"_apply_decisions", "_commit_heavy"})
+
+    def applies(self, path: str) -> bool:
+        return path in self.AUDITED
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        for fn in iter_functions(mod.tree):
+            if fn.name in self.WHITELIST_FUNCS:
+                continue
+            if not _contains_call(fn, self.MUTATORS):
+                continue
+            cfg = CFG(fn)
+            marks = {n.idx for n in cfg.nodes
+                     if n.stmt is not None
+                     and self._stmt_part_has_mark(n)}
+            for node in cfg.nodes:
+                if node.stmt is None:
+                    continue
+                call = self._mutator_call(node)
+                if call is None:
+                    continue
+                if self._violates(cfg, node, marks):
+                    yield self.finding(
+                        mod, call,
+                        f"NodeInfo .{call.func.attr}() with a "
+                        "mark-free path through it — the tracked "
+                        "encoder never sees an unmarked mutation "
+                        "(mark_numeric/mark_replaced/"
+                        "mark_node_set_changed, or poison the row)")
+
+    def _stmt_part_has_mark(self, node: CFGNode) -> bool:
+        """Mark calls in the node's OWN code: a head node owns only its
+        test/iter expression (its body statements are separate nodes)."""
+        s = node.stmt
+        if node.kind == "head":
+            if isinstance(s, (ast.If, ast.While)):
+                return _contains_call(s.test, self.MARKS)
+            if isinstance(s, (ast.For, ast.AsyncFor)):
+                return _contains_call(s.iter, self.MARKS)
+            return False
+        return _contains_call(s, self.MARKS)
+
+    def _mutator_call(self, node: CFGNode) -> ast.Call | None:
+        s = node.stmt
+        scope: ast.AST
+        if node.kind == "head":
+            if isinstance(s, (ast.If, ast.While)):
+                scope = s.test
+            elif isinstance(s, (ast.For, ast.AsyncFor)):
+                scope = s.iter
+            else:
+                return None
+        else:
+            scope = s
+        for n in _walk_shallow(scope):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in self.MUTATORS:
+                # exclude self-calls on encoder-ish receivers (none of
+                # the mark receivers define these names, but be safe)
+                return n
+        return None
+
+    def _violates(self, cfg: CFG, site: CFGNode, marks: set[int]) -> bool:
+        """Violation iff a mark-free prefix reaches the site AND a
+        mark-free suffix leaves it. The `if info.add_task(t): mark`
+        idiom: when the mutator is an If test, the mutation only
+        happened on the TRUE branch — the suffix query starts there."""
+        if site.idx in marks:
+            return False
+        prefix_free = cfg.reaches_without(
+            cfg.entry.idx, {site.idx}, marks)
+        if not prefix_free:
+            return False
+        if site.kind == "head" and isinstance(site.stmt, ast.If):
+            # successors: true-branch entry is the first successor
+            # linked (builder order); fall-through/else is the rest.
+            # Conservatively use the true-branch entry only.
+            starts = site.succs[:1]
+        else:
+            starts = site.succs
+        for s0 in starts:
+            if s0 in marks:
+                continue
+            if cfg.reaches_without(s0, {cfg.exit.idx}, marks):
+                return True
+        return False
+
+
+# =====================================================================
+# Rule 3: barrier-before-drain
+# =====================================================================
+
+
+@dataclass(frozen=True)
+class DrainEntry:
+    func: str                       # function / nested-def name
+    mode: str                       # "before-reads" | "postdominate"
+    reads: frozenset = frozenset()  # call keys counting as wave reads
+
+
+@dataclass(frozen=True)
+class BarrierFileSpec:
+    path: str
+    barriers: frozenset             # call keys counting as a barrier
+    entries: tuple
+
+
+BARRIER_SPECS: tuple[BarrierFileSpec, ...] = (
+    BarrierFileSpec(
+        path="swarmkit_tpu/ops/pipeline.py",
+        barriers=frozenset({"_barrier", "barrier"}),
+        entries=(
+            # the ONE drain sequence every trigger funnels through:
+            # inline commits / pulls must sit behind the barrier
+            DrainEntry("drain_serial", "before-reads",
+                       frozenset({"commit_deferred", "finish_pulled",
+                                  "_complete", "_commit", "_heavy"})),
+            # full pipeline drain: every completion/commit post-barrier
+            DrainEntry("flush", "before-reads",
+                       frozenset({"_complete", "_commit", "_heavy"})),
+            # the public external-mutation barrier must actually barrier
+            DrainEntry("barrier", "postdominate"),
+        ),
+    ),
+    BarrierFileSpec(
+        path="swarmkit_tpu/scheduler/scheduler.py",
+        barriers=frozenset({"_drain_commit_plane", "barrier"}),
+        entries=(
+            # event handler: mutates node_infos/pools/volume_set — the
+            # external-mutation entry point of the contract
+            DrainEntry("_handle", "before-reads",
+                       frozenset({"add_task", "remove_task",
+                                  "task_failed", "_add_or_update_node",
+                                  "_remove_node", "add_or_update_volume",
+                                  "remove_volume", "release_task",
+                                  "reserve_task"})),
+            # serial tick path: reads+mutates host state end to end
+            # (_tick_pipelined is the mirror body, not a raw read — it
+            # takes its own barrier per the tick protocol)
+            DrainEntry("tick", "before-reads",
+                       frozenset({"_process_preassigned",
+                                  "_schedule_backlog"})),
+            # not-primed backlog fallthrough inside the pipelined tick
+            # is covered by the mirror table; the terminal drains must
+            # END drained on every path:
+            DrainEntry("flush_pipeline", "postdominate"),
+        ),
+    ),
+)
+
+
+class BarrierBeforeDrainRule(Rule):
+    """Async-commit-plane contract, verified in BOTH mirrored tick
+    implementations: from each curated drain-trigger entry point,
+    every path takes a commit-plane barrier before its first read of
+    wave state ("before-reads"), or passes a barrier on every path to
+    exit ("postdominate")."""
+
+    name = "barrier-before-drain"
+    invariant = ("EVERY drain trigger must block on the commit worker "
+                 "first — external mutations, inline commits, pending-"
+                 "row/hypo-row/signature drains, flush paths — in both "
+                 "TickPipeline and Scheduler (the mirrored pair)")
+
+    def applies(self, path: str) -> bool:
+        return any(path == s.path for s in BARRIER_SPECS)
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        spec = next(s for s in BARRIER_SPECS if s.path == mod.path)
+        fns = {fn.name: fn for fn in iter_functions(mod.tree)}
+        for entry in spec.entries:
+            fn = fns.get(entry.func)
+            if fn is None:
+                continue        # coverage pinned by barrier_coverage()
+            cfg = CFG(fn)
+            barrier_nodes = {
+                n.idx for n in cfg.nodes
+                if n.stmt is not None
+                and self._node_has_call(n, spec.barriers)}
+            if entry.mode == "postdominate":
+                if not barrier_nodes or cfg.reaches_without(
+                        cfg.entry.idx, {cfg.exit.idx}, barrier_nodes):
+                    yield self.finding(
+                        mod, fn,
+                        f"{entry.func}: a path reaches exit without "
+                        "taking the commit-plane barrier "
+                        f"({'/'.join(sorted(spec.barriers))}) — every "
+                        "drain trigger must block on the worker")
+                continue
+            read_nodes = {
+                n.idx for n in cfg.nodes
+                if n.stmt is not None
+                and n.idx not in barrier_nodes
+                and self._node_has_call(n, entry.reads)}
+            for r in sorted(read_nodes):
+                if cfg.reaches_without(cfg.entry.idx, {r},
+                                       barrier_nodes):
+                    node = cfg.nodes[r]
+                    yield self.finding(
+                        mod, node.stmt,
+                        f"{entry.func}: wave-state read reachable "
+                        "without a commit-plane barrier "
+                        f"({'/'.join(sorted(spec.barriers))} must "
+                        "precede it on every path)")
+
+    @staticmethod
+    def _node_has_call(node: CFGNode, names: frozenset[str]) -> bool:
+        s = node.stmt
+        if node.kind == "head":
+            if isinstance(s, (ast.If, ast.While)):
+                s = s.test
+            elif isinstance(s, (ast.For, ast.AsyncFor)):
+                s = s.iter
+            elif isinstance(s, (ast.With, ast.AsyncWith)):
+                # with-item context exprs belong to the head
+                for item in s.items:
+                    if _contains_call(item.context_expr, names):
+                        return True
+                return False
+            else:
+                return False
+        return _contains_call(s, names)
+
+
+def barrier_coverage(root) -> dict[str, list[str]]:
+    """{path: [missing names]} — the tier-1 gate pins this empty so a
+    rename cannot silently disable barrier-before-drain. Covers the
+    curated entry-point FUNCTIONS and the rule's whole call VOCABULARY:
+    a renamed read/mutator (e.g. `_schedule_backlog` →
+    `_schedule_backlog_chunked`) would otherwise leave that entry's
+    check vacuously green."""
+    out: dict[str, list[str]] = {}
+    for spec in BARRIER_SPECS:
+        p = root / spec.path
+        try:
+            tree = ast.parse(p.read_text(), filename=spec.path)
+        except (OSError, SyntaxError):
+            out[spec.path] = sorted(
+                {e.func for e in spec.entries} | set(spec.barriers))
+            continue
+        found = {fn.name for fn in iter_functions(tree)}
+        called = {n.func.attr for n in ast.walk(tree)
+                  if isinstance(n, ast.Call)
+                  and isinstance(n.func, ast.Attribute)}
+        called |= {n.func.id for n in ast.walk(tree)
+                   if isinstance(n, ast.Call)
+                   and isinstance(n.func, ast.Name)}
+        missing = sorted(
+            {e.func for e in spec.entries if e.func not in found}
+            | {b for b in spec.barriers if b not in called}
+            | {r for e in spec.entries for r in e.reads
+               if r not in called})
+        if missing:
+            out[spec.path] = missing
+    return out
+
+
+RULES: tuple[Rule, ...] = (
+    StoreCopyDataflowRule(),
+    DirtyFeedRule(),
+    BarrierBeforeDrainRule(),
+)

@@ -5,8 +5,12 @@ Each rule encodes one convention-only invariant that has already bitten
 (or nearly bitten) a past round — the axon 2D-scatter-add bug, the
 `rpc/services.py` ad-hoc retry loop, the ambient-mesh entry rule, the
 donation-set pin, the failpoint/trace decision-boundary discipline, the
-copy-before-mutate store contract, the no-int64-in-kernels rule, and the
-lock-factory seam the runtime detector (lockgraph.py) depends on.
+no-int64-in-kernels rule, and the lock/condition factory seam the
+runtime detector (lockgraph.py) depends on. The FLOW-sensitive
+contracts (copy-before-mutate through aliases/containers, the
+tracked-encoder dirty feed, barrier-before-drain ordering) live in
+analysis/dataflow.py on a real CFG; `all_rules()` is the combined set
+and the default for every driver entry point.
 
 Suppression is per-line and per-rule:
 
@@ -329,83 +333,67 @@ class SpanInLoopRule(Rule):
                 f"emission needs the `if {base_name}.enabled():` guard")
 
 
-class CopyBeforeMutateRule(Rule):
-    """Store objects are live references: mutate a COPY inside write
-    transactions (CLAUDE.md store contract)."""
+class RawConditionRule(Rule):
+    """The lockgraph detector's documented Condition blind spot
+    (ISSUE 12): a bare `threading.Condition()` allocates an internal
+    RLock the armed detector can never see, so an inversion involving
+    only that lock produces no edges. Every Condition must be
+    constructed over a lockgraph factory primitive."""
 
-    name = "copy-before-mutate"
-    invariant = ("a store-getter result (tx.get_*) is a live reference "
-                 "shared with every reader — `.copy()` before mutating "
-                 "in a transaction")
+    name = "raw-condition"
+    invariant = ("threading.Condition() must wrap a "
+                 "lockgraph.make_lock/make_rlock primitive "
+                 "(threading.Condition(make_rlock(name))) so the armed "
+                 "lock-order detector sees its acquisitions; disarmed "
+                 "the factory hands back the plain primitive — one "
+                 "truthiness test, zero tracker allocations")
 
-    GETTERS = frozenset({
-        "get_node", "get_task", "get_service", "get_cluster",
-        "get_network", "get_secret", "get_config", "get_volume",
-        "get_extension", "get_resource", "get_member",
-    })
+    FACTORIES = frozenset({"make_lock", "make_rlock"})
 
     def applies(self, path: str) -> bool:
-        return path.startswith("swarmkit_tpu/")
+        return (path.startswith("swarmkit_tpu/")
+                and not path.startswith("swarmkit_tpu/analysis/"))
 
-    @staticmethod
-    def _base_name(node: ast.AST) -> str:
-        while isinstance(node, ast.Attribute):
-            node = node.value
-        return node.id if isinstance(node, ast.Name) else ""
-
-    def _scan_body(self, mod: Module, fn: ast.AST) -> Iterator[Finding]:
-        """Linear pass over one function body (nested defs handled by
-        their own pass): taint names bound to `tx.get_*(...)`, clear on
-        `v = v.copy()` (any re-binding clears), flag attribute writes
-        through a tainted base."""
-        tainted: set[str] = set()
-
-        def expr_is_getter(value) -> bool:
-            return (isinstance(value, ast.Call)
-                    and isinstance(value.func, ast.Attribute)
-                    and value.func.attr in self.GETTERS
-                    and isinstance(value.func.value, ast.Name)
-                    and value.func.value.id == "tx")
-
-        for node, ancestors in _walk_with_parents(fn):
-            if node is fn:
-                continue
-            # don't descend into nested functions: their bodies get
-            # their own scan with their own taint set
-            if any(isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                  ast.Lambda))
-                   for a in ancestors[ancestors.index(fn) + 1:]):
-                continue
-            if isinstance(node, ast.Assign):
-                for tgt in node.targets:
-                    if isinstance(tgt, ast.Name):
-                        if expr_is_getter(node.value):
-                            tainted.add(tgt.id)
-                        else:
-                            tainted.discard(tgt.id)
-                    elif isinstance(tgt, (ast.Attribute, ast.Subscript)):
-                        base = self._base_name(tgt)
-                        if isinstance(tgt, ast.Attribute) \
-                                and base in tainted:
-                            yield self.finding(
-                                mod, tgt,
-                                f"attribute write on {base!r} (a live "
-                                "tx.get_* result) — .copy() before "
-                                "mutating (store objects are shared "
-                                "references)")
-            elif isinstance(node, ast.AugAssign) \
-                    and isinstance(node.target, ast.Attribute):
-                base = self._base_name(node.target)
-                if base in tainted:
-                    yield self.finding(
-                        mod, node.target,
-                        f"augmented write on {base!r} (a live tx.get_* "
-                        "result) — .copy() before mutating")
+    def _lock_arg_ok(self, node: ast.Call) -> bool:
+        """The lock argument (positional 0 or lock=) must be a direct
+        factory call or a name/attribute (assumed factory-made — the
+        raw-lock rule polices how names get bound)."""
+        arg = None
+        if node.args:
+            arg = node.args[0]
+        for kw in node.keywords:
+            if kw.arg == "lock":
+                arg = kw.value
+        if arg is None:
+            return False
+        if isinstance(arg, ast.Call):
+            fn = arg.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            return name in self.FACTORIES
+        # a pre-built lock passed by name: raw-lock already guarantees
+        # every lock binding routes through the factory
+        return isinstance(arg, (ast.Name, ast.Attribute))
 
     def check(self, mod: Module) -> Iterator[Finding]:
         for node in ast.walk(mod.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                yield from self._scan_body(mod, node)
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if chain == "threading.Condition" \
+                    and not self._lock_arg_ok(node):
+                yield self.finding(
+                    mod, node,
+                    "bare threading.Condition() — its internal RLock "
+                    "is invisible to the lock-order detector; use "
+                    "threading.Condition(lockgraph.make_rlock(name))")
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id == "Condition" \
+                    and not self._lock_arg_ok(node):
+                yield self.finding(
+                    mod, node,
+                    "bare Condition() — wrap a lockgraph factory "
+                    "primitive: threading.Condition(make_rlock(name))")
 
 
 class Int64InKernelRule(Rule):
@@ -558,17 +546,29 @@ RULES: tuple[Rule, ...] = (
     AmbientMeshRule(),
     DonatePinnedRule(),
     SpanInLoopRule(),
-    CopyBeforeMutateRule(),
     Int64InKernelRule(),
     RawLockRule(),
+    RawConditionRule(),
     ColumnarMutateRule(),
 )
 
 
+def all_rules() -> tuple[Rule, ...]:
+    """The full rule set: the syntactic rules above plus the dataflow
+    contract rules (analysis/dataflow.py). Lazy import — dataflow
+    builds on this module, so a top-level import would be circular."""
+    from . import dataflow
+
+    return RULES + dataflow.RULES
+
+
 # -------------------------------------------------------------------- driver
 def lint_source(source: str, path: str,
-                rules: Iterable[Rule] = RULES) -> list[Finding]:
-    """Lint one in-memory source blob (the fixture-test entrypoint)."""
+                rules: Iterable[Rule] | None = None) -> list[Finding]:
+    """Lint one in-memory source blob (the fixture-test entrypoint).
+    Default rule set is `all_rules()` (syntactic + dataflow)."""
+    if rules is None:
+        rules = all_rules()
     mod = Module(path, source)
     out: list[Finding] = []
     for rule in rules:
@@ -577,6 +577,9 @@ def lint_source(source: str, path: str,
         for f in rule.check(mod):
             if not mod.allowed(rule.name, f.line):
                 out.append(f)
+    # dedupe identical findings (a statement can own several CFG nodes
+    # — e.g. a finally body cloned onto an abrupt-exit path)
+    out = list(dict.fromkeys(out))
     out.sort(key=lambda f: (f.path, f.line, f.rule))
     return out
 
@@ -593,19 +596,41 @@ def iter_py_files(root: Path, subdirs: Iterable[str]) -> Iterator[Path]:
 
 
 def lint_tree(root: Path, subdirs=("swarmkit_tpu", "tests"),
-              rules: Iterable[Rule] = RULES) -> list[Finding]:
+              rules: Iterable[Rule] | None = None) -> list[Finding]:
     """Lint the repo tree. `root` is the repo root; paths in findings
     are repo-relative posix (what `applies()` matches on)."""
+    if rules is None:
+        rules = all_rules()
     findings: list[Finding] = []
     for p in iter_py_files(root, subdirs):
         rel = p.relative_to(root).as_posix()
-        try:
-            source = p.read_text()
-        except (OSError, UnicodeDecodeError):   # unreadable: not lintable
+        findings.extend(_lint_path(root, rel, rules))
+    return findings
+
+
+def _lint_path(root: Path, rel: str, rules: Iterable[Rule],
+               ) -> list[Finding]:
+    try:
+        source = (root / rel).read_text()
+    except (OSError, UnicodeDecodeError):       # unreadable: not lintable
+        return []
+    try:
+        return lint_source(source, rel, rules)
+    except SyntaxError:
+        return [Finding("parse-error", rel, 0, "file does not parse")]
+
+
+def lint_files(root: Path, rel_paths: Iterable[str],
+               rules: Iterable[Rule] | None = None) -> list[Finding]:
+    """Lint an explicit repo-relative file list (the `--changed-only`
+    scope). Every rule is per-file, so findings for a file here are
+    IDENTICAL to that file's slice of the full `lint_tree` pass — the
+    scope-soundness guard in tests/test_lint_clean.py pins it."""
+    if rules is None:
+        rules = all_rules()
+    findings: list[Finding] = []
+    for rel in sorted(set(rel_paths)):
+        if not rel.endswith(".py"):
             continue
-        try:
-            findings.extend(lint_source(source, rel, rules))
-        except SyntaxError:
-            findings.append(Finding(
-                "parse-error", rel, 0, "file does not parse"))
+        findings.extend(_lint_path(root, rel, rules))
     return findings

@@ -249,6 +249,42 @@ class _TrackedLock:
         self.release()
         return False
 
+    # ---------------------------------------------- Condition protocol
+    # threading.Condition(lock) snapshots these three methods when they
+    # exist, so a tracked lock can back a Condition (ISSUE 12: the
+    # raw-condition rule routes every Condition over a factory lock).
+    # wait() fully releases the lock (all recursion levels) and
+    # re-acquires on wake — the tracker must mirror that, or the
+    # held-stack would keep phantom edges across the wait.
+    def _release_save(self):
+        d = getattr(self._depth, "n", 0)
+        self._depth.n = 0
+        if d:
+            self._state.on_released(self)
+        if self._reentrant:
+            return (self._inner._release_save(), d)
+        self._inner.release()
+        return (None, d)
+
+    def _acquire_restore(self, saved):
+        inner_saved, d = saved
+        if self._reentrant:
+            self._inner._acquire_restore(inner_saved)
+        else:
+            self._inner.acquire()
+        self._depth.n = d
+        if d:
+            self._state.on_acquired(self)
+
+    def _is_owned(self):
+        if self._reentrant:
+            return self._inner._is_owned()
+        # plain Lock: owned iff held and not re-acquirable by us
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
 
 # ----------------------------------------------------------------- factory
 def make_lock(name: str = "lock"):

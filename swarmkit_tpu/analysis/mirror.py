@@ -1,30 +1,52 @@
-"""Mirrored-implementation drift checker.
+"""Mirrored-pair drift registry (ISSUE 8 tick pair, generalized in
+ISSUE 12).
 
-The pipelined tick protocol lives in TWO implementations that must
-change in lockstep (CLAUDE.md async-commit invariant): the reusable
-`TickPipeline` (ops/pipeline.py) and the production
-`Scheduler._tick_pipelined` (scheduler/scheduler.py). A barrier moved,
-a poison dropped, or a drain trigger added in one mirror and not the
-other is exactly the class of bug convention alone has to catch today.
+Several protocols in this tree live in TWO implementations that must
+change in lockstep:
 
-This module extracts, from each mirror's AST, the lexically-ordered
-sequence of PROTOCOL calls — the barrier/pull/fold/poison/restamp/
-submit/encode/dispatch vocabulary — normalized to a shared canonical
-event language, and diffs it against the checked-in expected table
-below. A change landing in one mirror fails `tests/test_lint_clean.py`
-with a readable unified diff; the author then either updates BOTH
-mirrors or consciously re-records the table (and the diff shows the
-reviewer exactly which protocol step moved).
+  * `tick` — the pipelined tick protocol: `TickPipeline`
+    (ops/pipeline.py) vs `Scheduler._tick_pipelined`
+    (scheduler/scheduler.py). A barrier moved, a poison dropped, or a
+    drain trigger added in one mirror and not the other is exactly the
+    class of bug convention alone has to catch.
+  * `ipam-pool` — the scalar IPAM pool oracle (allocator/ipam.py
+    `_Pool`) vs its array twin (allocator/batched.py `_ArrayPool`):
+    grants, cursor motion, exhaustion and release must stay
+    bit-identical (the ≥20-seed fuzz pins values; this registry pins
+    the code SHAPE so a one-sided edit is caught before the fuzz run).
+  * `port-alloc` — scalar `PortAllocator` vs `BatchedPorts`: the
+    owner-conflict precheck, dynamic-run grants and the partial-grant
+    failure shape.
+  * `assign-wave` — the eager (`_assign_in_tx`) vs lazy
+    (`_assign_wave_lazy` + `_heal_stale_locked`) wave write-back in
+    store/memory.py: both must keep riding the SHARED `_wave_verdicts`
+    and the same patch primitive, or their verdict sequences drift.
+
+The checker extracts, from each member's AST, the lexically-ordered
+sequence of PROTOCOL calls — normalized to a per-pair canonical event
+language (plus `return` events where the return shape IS the protocol,
+e.g. the port allocator's partial-failure returns) — and diffs it
+against the checked-in expected table below. A change landing in one
+member fails `tests/test_lint_clean.py` with a readable unified diff;
+the author then either updates BOTH members or consciously re-records
+the table (and the diff shows the reviewer exactly which step moved).
 
 Lexical order is the contract here, not runtime order: the extraction
 is deterministic, and every protocol-relevant statement in these
 methods executes at most once per trigger, so source order is a
 faithful proxy the test can pin.
 
-Beyond the per-mirror sequences, REQUIRED_COMMON pins the event KINDS
-both mirrors must contain — a one-sided removal of (say) every poison
-call fails even if someone re-records that mirror's table without
-noticing the asymmetry.
+Beyond the per-member sequences, each spec's `required` set pins the
+event KINDS that member must contain — a one-sided removal of (say)
+every poison call fails even if someone re-records that member's table
+without noticing the asymmetry.
+
+Registering a new pair: define a vocab (call name -> canonical event),
+add one MirrorSpec per member (same `pair` key) to MIRRORS with the
+pair's `required` event set, run
+`python -m swarmkit_tpu.analysis --print-protocol` and paste the new
+EXPECTED entries, then add a one-sided-edit drift fixture to
+tests/test_analysis.py and a row to docs/static_analysis.md.
 """
 from __future__ import annotations
 
@@ -82,14 +104,53 @@ SCHEDULER_VOCAB = dict(_COMMON_VOCAB, **{
     "_tick_pipelined": "tick_pipelined",
 })
 
-# Event kinds BOTH mirrors must exhibit somewhere in their scope: a
-# one-sided disappearance of any of these is protocol drift even if the
-# per-mirror table is re-recorded to match.
+# Event kinds BOTH tick mirrors must exhibit somewhere in their scope:
+# a one-sided disappearance of any of these is protocol drift even if
+# the per-mirror table is re-recorded to match.
 REQUIRED_COMMON = frozenset({
     "barrier", "pull", "fold", "after_apply", "invalidate",
     "poison_rows", "restamp", "submit_heavy", "nodes_clean",
     "encode", "dispatch",
 })
+
+# --------------------------------------------------- allocator-twin pairs
+# scalar IPAM pool vs the array twin: grants/exhaustion/release shape
+_POOL_VOCAB = {
+    "IPAMError": "error",            # exhaustion / out-of-subnet raise
+    "ip_address": "parse",
+    "grant_order": "grant_order",    # array twin's kernel call
+    "allocated.add": "mark",
+    "allocated.discard": "unmark",
+}
+REQUIRED_POOL = frozenset({"error", "parse", "return"})
+
+# scalar PortAllocator vs BatchedPorts: owner precheck, dynamic runs,
+# partial-failure returns
+_PORTS_VOCAB = {
+    "_allocated.get": "owner_check",
+    "_find_dynamic": "dynamic",
+    "_grant_dynamic_run": "dynamic",
+    "_claim": "claim",
+    "_unclaim": "unclaim",
+    "grant_order": "grant_order",
+    "_mask": "mask",
+}
+REQUIRED_PORTS = frozenset({"owner_check", "dynamic", "return"})
+
+# eager vs lazy assign_wave (store/memory.py): both ride the SHARED
+# verdict helper and the same patch primitive
+_ASSIGN_VOCAB = {
+    "_wave_verdicts": "verdicts",
+    "wave_codes": "codes",
+    "_patch_assign": "patch",
+    "assign_rows": "scatter",
+    "has_watchers": "watcher_gate",
+    "_heal_stale_locked": "heal",
+    "publish_all": "publish",
+    "row_of": "row_of",
+    "intern": "intern",
+}
+REQUIRED_ASSIGN = frozenset({"verdicts", "codes", "patch"})
 
 
 @dataclass(frozen=True)
@@ -99,6 +160,10 @@ class MirrorSpec:
     class_name: str
     methods: tuple               # extraction scope, in this order
     vocab: dict
+    pair: str = "tick"           # registry group (drift is per-member;
+                                 # `required` is the pair's common floor)
+    required: frozenset = REQUIRED_COMMON
+    capture_returns: bool = False  # emit a 'return' event per Return
 
 
 MIRRORS: tuple[MirrorSpec, ...] = (
@@ -118,6 +183,70 @@ MIRRORS: tuple[MirrorSpec, ...] = (
         methods=("_tick_pipelined", "flush_pipeline", "_submit_heavy",
                  "_commit_heavy", "_drain_commit_plane", "_heal_unclean"),
         vocab=SCHEDULER_VOCAB,
+    ),
+    MirrorSpec(
+        key="ipam_pool_scalar",
+        path="swarmkit_tpu/allocator/ipam.py",
+        class_name="_Pool",
+        methods=("allocate", "reserve", "release"),
+        vocab=_POOL_VOCAB,
+        pair="ipam-pool",
+        required=REQUIRED_POOL,
+        capture_returns=True,
+    ),
+    MirrorSpec(
+        key="ipam_pool_array",
+        path="swarmkit_tpu/allocator/batched.py",
+        class_name="_ArrayPool",
+        methods=("allocate", "allocate_many", "free_count", "reserve",
+                 "release"),
+        vocab=_POOL_VOCAB,
+        pair="ipam-pool",
+        required=REQUIRED_POOL,
+        capture_returns=True,
+    ),
+    MirrorSpec(
+        key="ports_scalar",
+        path="swarmkit_tpu/allocator/allocator.py",
+        class_name="PortAllocator",
+        methods=("allocate", "_find_dynamic", "release",
+                 "release_except"),
+        vocab=_PORTS_VOCAB,
+        pair="port-alloc",
+        required=REQUIRED_PORTS,
+        capture_returns=True,
+    ),
+    MirrorSpec(
+        key="ports_batched",
+        path="swarmkit_tpu/allocator/batched.py",
+        class_name="BatchedPorts",
+        methods=("allocate", "_grant_dynamic_run", "_find_dynamic",
+                 "_claim", "_unclaim", "release", "release_except"),
+        vocab=_PORTS_VOCAB,
+        pair="port-alloc",
+        required=REQUIRED_PORTS,
+        capture_returns=True,
+    ),
+    MirrorSpec(
+        key="assign_wave_eager",
+        path="swarmkit_tpu/store/memory.py",
+        class_name="MemoryStore",
+        methods=("_wave_verdicts", "_assign_in_tx"),
+        vocab=_ASSIGN_VOCAB,
+        pair="assign-wave",
+        required=REQUIRED_ASSIGN,
+        capture_returns=True,
+    ),
+    MirrorSpec(
+        key="assign_wave_lazy",
+        path="swarmkit_tpu/store/memory.py",
+        class_name="MemoryStore",
+        methods=("_wave_verdicts", "_assign_wave_lazy",
+                 "_heal_stale_locked"),
+        vocab=_ASSIGN_VOCAB,
+        pair="assign-wave",
+        required=REQUIRED_ASSIGN,
+        capture_returns=True,
     ),
 )
 
@@ -172,6 +301,9 @@ def extract_sequence(tree: ast.AST, spec: MirrorSpec) -> list[str]:
             out.append(f"{mname}:<MISSING METHOD>")
             continue
         for node in dfs(m):
+            if spec.capture_returns and isinstance(node, ast.Return):
+                out.append(f"{mname}:return")
+                continue
             if not isinstance(node, ast.Call):
                 continue
             qual, bare = _call_key(node)
@@ -277,13 +409,90 @@ EXPECTED: dict[str, tuple[str, ...]] = {
         '_heal_unclean:invalidate',
         '_heal_unclean:pull_discard',
     ),
+    'ipam_pool_scalar': (
+        'allocate:mark',
+        'allocate:return',
+        'allocate:error',
+        'reserve:parse',
+        'reserve:error',
+        'reserve:mark',
+        'release:unmark',
+    ),
+    'ipam_pool_array': (
+        'allocate:return',
+        'allocate:error',
+        'allocate_many:return',
+        'allocate_many:grant_order',
+        'allocate_many:error',
+        'allocate_many:return',
+        'free_count:return',
+        'reserve:parse',
+        'reserve:error',
+        'release:return',
+        'release:parse',
+        'release:return',
+    ),
+    'ports_scalar': (
+        'allocate:owner_check',
+        'allocate:return',
+        'allocate:dynamic',
+        'allocate:return',
+        'allocate:return',
+        '_find_dynamic:return',
+        '_find_dynamic:return',
+        'release_except:return',
+    ),
+    'ports_batched': (
+        'allocate:owner_check',
+        'allocate:return',
+        'allocate:claim',
+        'allocate:dynamic',
+        'allocate:claim',
+        'allocate:return',
+        'allocate:return',
+        '_grant_dynamic_run:grant_order',
+        '_grant_dynamic_run:mask',
+        '_grant_dynamic_run:return',
+        '_find_dynamic:dynamic',
+        '_find_dynamic:return',
+        '_claim:mask',
+        '_unclaim:mask',
+        'release:unclaim',
+        'release_except:unclaim',
+        'release_except:return',
+    ),
+    'assign_wave_eager': (
+        '_wave_verdicts:codes',
+        '_wave_verdicts:return',
+        '_assign_in_tx:return',
+        '_assign_in_tx:patch',
+        '_assign_in_tx:verdicts',
+    ),
+    'assign_wave_lazy': (
+        '_wave_verdicts:codes',
+        '_wave_verdicts:return',
+        '_assign_wave_lazy:watcher_gate',
+        '_assign_wave_lazy:return',
+        '_assign_wave_lazy:intern',
+        '_assign_wave_lazy:verdicts',
+        '_assign_wave_lazy:scatter',
+        '_assign_wave_lazy:watcher_gate',
+        '_assign_wave_lazy:heal',
+        '_assign_wave_lazy:publish',
+        '_assign_wave_lazy:return',
+        '_heal_stale_locked:return',
+        '_heal_stale_locked:row_of',
+        '_heal_stale_locked:patch',
+        '_heal_stale_locked:return',
+    ),
 }
 
 
 @dataclass
 class DriftReport:
     diffs: dict          # mirror key -> unified diff text (only drifted)
-    missing_common: dict  # mirror key -> sorted missing REQUIRED_COMMON
+    missing_common: dict  # mirror key -> sorted missing required events
+    pair_of: dict = None  # mirror key -> pair name (report labels)
 
     @property
     def clean(self) -> bool:
@@ -291,13 +500,16 @@ class DriftReport:
 
     def render(self) -> str:
         if self.clean:
-            return "mirror drift: clean (both tick mirrors match the table)"
+            return ("mirror drift: clean (all registered pairs match "
+                    "the table)")
+        pair_of = self.pair_of or {}
         out = []
         for key, diff in self.diffs.items():
+            pair = pair_of.get(key, "tick")
             out.append(
-                f"protocol drift in mirror {key!r} — the tick protocol "
-                "lives in TWO implementations (TickPipeline and "
-                "Scheduler._tick_pipelined); land the change in BOTH, "
+                f"protocol drift in mirror {key!r} (pair {pair!r}) — "
+                "this protocol lives in TWO implementations that must "
+                "change in lockstep; land the change in BOTH members, "
                 "then re-record with "
                 "`python -m swarmkit_tpu.analysis --print-protocol`:")
             out.append(diff)
@@ -311,14 +523,17 @@ class DriftReport:
 def check_drift(root: Path,
                 sources: dict[str, str] | None = None,
                 expected: dict[str, tuple[str, ...]] | None = None,
+                specs: tuple | None = None,
                 ) -> DriftReport:
-    """Diff each mirror's extracted sequence against the expected table.
-    `sources` overrides file contents per mirror key (fixture tests);
-    `expected` overrides the table (recording flows)."""
+    """Diff each registered mirror's extracted sequence against the
+    expected table. `sources` overrides file contents per mirror key
+    (fixture tests); `expected` overrides the table (recording flows);
+    `specs` narrows the registry (the --changed-only scope — always
+    whole PAIRS, never a single member)."""
     expected = EXPECTED if expected is None else expected
     diffs: dict[str, str] = {}
     missing_common: dict[str, list[str]] = {}
-    for spec in MIRRORS:
+    for spec in (MIRRORS if specs is None else specs):
         if sources is not None and spec.key in sources:
             src = sources[spec.key]
         else:
@@ -331,10 +546,11 @@ def check_drift(root: Path,
                 tofile=f"{spec.key} ({spec.path})", lineterm=""))
             diffs[spec.key] = diff
         events = {s.split(":", 1)[1] for s in seq}
-        miss = sorted(REQUIRED_COMMON - events)
+        miss = sorted(spec.required - events)
         if miss:
             missing_common[spec.key] = miss
-    return DriftReport(diffs=diffs, missing_common=missing_common)
+    return DriftReport(diffs=diffs, missing_common=missing_common,
+                       pair_of={s.key: s.pair for s in MIRRORS})
 
 
 def record(root: Path) -> str:

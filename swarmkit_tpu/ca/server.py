@@ -22,6 +22,7 @@ from ..api.objects import (
 )
 from ..api.specs import NodeSpec
 from ..api.types import IssuanceState, NodeRole
+from ..analysis.lockgraph import make_rlock
 from ..store import by
 from ..utils.identity import new_id
 from .auth import PermissionDenied
@@ -59,7 +60,8 @@ class CAServer:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._wake = threading.Event()
-        self._status_cond = threading.Condition()
+        self._status_cond = threading.Condition(
+            make_rlock("ca.server.status_cond"))
 
     # -- service lifecycle -------------------------------------------------
 

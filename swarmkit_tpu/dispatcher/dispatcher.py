@@ -148,7 +148,8 @@ class Dispatcher:
         self._thread: threading.Thread | None = None
         # (task_id, status, reporting node_id)
         self._status_queue: list[tuple[str, object, str]] = []
-        self._status_cond = threading.Condition()
+        self._status_cond = threading.Condition(
+            make_rlock("dispatcher.status_cond"))
         self._dirty_nodes: set[str] = set()
         self._unknown_timers: dict[str, Heartbeat] = {}
         # node id -> (attempts, window start) for registration rate limiting

@@ -47,6 +47,7 @@ import time
 from collections import deque
 from typing import Callable
 
+from ..analysis.lockgraph import make_rlock
 from ..utils import failpoints
 
 # a commit plane never needs depth beyond the tick pipeline's (the
@@ -72,7 +73,7 @@ class CommitWorker:
         self.name = name
         self.max_pending = max_pending
         self._jobs: deque[Callable[[], None]] = deque()
-        self._cond = threading.Condition()
+        self._cond = threading.Condition(make_rlock("ops.commit.cond"))
         self._pending = 0            # submitted, not yet retired
         self._exc: BaseException | None = None
         self._thread: threading.Thread | None = None

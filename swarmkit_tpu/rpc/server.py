@@ -22,7 +22,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable
 
-from ..analysis.lockgraph import make_lock
+from ..analysis.lockgraph import make_lock, make_rlock
 from ..ca.auth import Caller, PermissionDenied
 from ..store.watch import Channel, ChannelClosed
 from ..utils import failpoints, trace
@@ -116,7 +116,8 @@ class RPCServer:
         # dying on a reset mid-frame (the race the reset-mid-frame
         # failpoint exposes)
         self._inflight = 0
-        self._inflight_cond = threading.Condition()
+        self._inflight_cond = threading.Condition(
+            make_rlock("rpc.server.inflight_cond"))
         # set by stop() once the drain window has passed: a serve loop
         # that exits because _stop was set (it re-checks between frames,
         # so it can exit BEFORE blocking in recv) must wait for this

@@ -633,7 +633,12 @@ class MemoryStore:
         """THE wave-commit validation (shared by the eager and lazy
         paths so the verdict logic cannot drift): vectorized column
         checks + a per-distinct-node READY overlay; `on_ok(j, tid, nid,
-        row)` fires for each passing item. Returns the OK count."""
+        row)` fires for each passing item. Returns the OK count.
+
+        Mirror-registry pair "assign-wave" (analysis/mirror.py): both
+        callers' call sequences around this helper are table-pinned —
+        a path abandoning it (or the shared _patch_assign) fails
+        tier-1 until consciously re-recorded."""
         rows, vcodes = self.columnar.wave_codes([t for t, _ in chunk])
         ready: dict[str, bool] = {}
         ntab = self._tables["node"]

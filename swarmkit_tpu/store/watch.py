@@ -8,7 +8,7 @@ exceeds the limit is closed rather than blocking publishers.
 from __future__ import annotations
 
 import threading
-from ..analysis.lockgraph import make_lock
+from ..analysis.lockgraph import make_lock, make_rlock
 from collections import deque
 from typing import Any, Callable, Iterable
 
@@ -26,7 +26,7 @@ class Channel:
         self._matcher = matcher
         self._limit = limit
         self._events: deque[Any] = deque()
-        self._cond = threading.Condition()
+        self._cond = threading.Condition(make_rlock("store.watch.cond"))
         self._closed = False
         self._error: Exception | None = None
 

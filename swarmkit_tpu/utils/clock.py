@@ -10,7 +10,7 @@ machine — the round-2 verdict's fix for the daemon tier's load flakes.
 from __future__ import annotations
 
 import threading
-from ..analysis.lockgraph import make_lock
+from ..analysis.lockgraph import make_lock, make_rlock
 import time
 from typing import Callable
 
@@ -54,7 +54,7 @@ class TimerWheel:
 
     def __init__(self):
         self._heap: list[_WheelTimer] = []
-        self._cond = threading.Condition()
+        self._cond = threading.Condition(make_rlock("utils.clock.wheel_cond"))
         self._seq = 0
         self._thread: threading.Thread | None = None
         self._pool = None
@@ -212,7 +212,7 @@ class FakeClock(Clock):
     def __init__(self, start: float = 1000.0, poll: float = 0.01):
         self._now = start
         self._poll = poll
-        self._cond = threading.Condition()
+        self._cond = threading.Condition(make_rlock("utils.clock.fake_cond"))
         self._timers: list[_FakeTimer] = []
 
     def monotonic(self) -> float:

@@ -11,13 +11,16 @@ import heapq
 import threading
 import time
 
+from ..analysis.lockgraph import make_rlock
+
 BASE_RETRY_INTERVAL = 0.1  # volumequeue/queue.go baseRetryInterval 100ms
 MAX_RETRY_INTERVAL = 600.0  # maxRetryInterval 10min
 
 
 class VolumeQueue:
     def __init__(self):
-        self._lock = threading.Condition()
+        self._lock = threading.Condition(
+            make_rlock("utils.volumequeue.cond"))
         self._heap: list[tuple[float, str, int]] = []  # (ready_at, id, attempt)
         self._pending: dict[str, int] = {}  # id -> attempt (dedupe)
         self._stopped = False

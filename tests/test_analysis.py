@@ -1,7 +1,12 @@
-"""Analysis-plane unit tests (ISSUE 8): one must-fire and one
-must-not-fire fixture per lint rule, pragma handling, mirror-drift
-detection of a synthetic one-sided edit, and the lockgraph detector's
-seeded deadlock regression.
+"""Analysis-plane unit tests (ISSUE 8; dataflow engine + mirror
+registry + raw-condition ISSUE 12): one must-fire and one
+must-not-fire fixture per rule (syntactic AND dataflow, including the
+alias/append-loop/tuple-unpack shapes the PR 8 heuristic documented as
+blind spots), seeded mutants against the REAL guarded sources
+(unmarked NodeInfo mutation, drain-without-barrier), pragma handling,
+one-sided-edit drift detection for every registered mirror pair, the
+lockgraph detector's seeded deadlock regression, and the tracked
+Condition protocol.
 
 The companion tests/test_lint_clean.py asserts the REAL tree is clean;
 this module pins the rules' semantics on synthetic snippets so a rule
@@ -253,8 +258,10 @@ def test_span_in_loop_only_audited_modules():
     assert findings(src, "swarmkit_tpu/orchestrator/foo.py") == []
 
 
-# ---------------------------------------------------- copy-before-mutate
-def test_copy_before_mutate_fires():
+# --------------------------------------------------- store-copy-dataflow
+# (ISSUE 12: supersedes PR 8's linear copy-before-mutate heuristic —
+# same contract, now flow- and alias-sensitive on a real CFG)
+def test_store_copy_fires():
     src = """
     def txn(tx):
         t = tx.get_task(tid)
@@ -262,20 +269,20 @@ def test_copy_before_mutate_fires():
         tx.update(t)
     """
     assert findings(src, "swarmkit_tpu/csi/foo.py") == \
-        ["copy-before-mutate"]
+        ["store-copy-dataflow"]
 
 
-def test_copy_before_mutate_nested_attr_fires():
+def test_store_copy_nested_attr_fires():
     src = """
     def txn(tx):
         t = tx.get_task(tid)
         t.status.state = 5
     """
     assert findings(src, "swarmkit_tpu/csi/foo.py") == \
-        ["copy-before-mutate"]
+        ["store-copy-dataflow"]
 
 
-def test_copy_clears_taint():
+def test_store_copy_clears_taint():
     src = """
     def txn(tx):
         t = tx.get_task(tid)
@@ -286,7 +293,7 @@ def test_copy_clears_taint():
     assert findings(src, "swarmkit_tpu/csi/foo.py") == []
 
 
-def test_copy_before_mutate_reads_clean():
+def test_store_copy_reads_clean():
     src = """
     def txn(tx):
         t = tx.get_task(tid)
@@ -297,13 +304,430 @@ def test_copy_before_mutate_reads_clean():
     assert findings(src, "swarmkit_tpu/csi/foo.py") == []
 
 
-def test_copy_before_mutate_other_receiver_clean():
+def test_store_copy_other_receiver_clean():
     src = """
     def txn(view):
         t = info.get_task(tid)
         t.desired_state = 5
     """
     assert findings(src, "swarmkit_tpu/csi/foo.py") == []
+
+
+def test_store_copy_alias_fires():
+    """The alias shape PR 8 could not see: copying ONE name does not
+    clean the other alias of the same live object."""
+    src = """
+    def txn(tx):
+        t = tx.get_task(tid)
+        u = t
+        t = t.copy()
+        u.desired_state = 5
+    """
+    assert findings(src, "swarmkit_tpu/csi/foo.py") == \
+        ["store-copy-dataflow"]
+
+
+def test_store_copy_append_loop_write_fires():
+    """The append/loop-write blind spot: live objects collected into a
+    container, mutated in a later loop."""
+    src = """
+    def txn(tx):
+        out = []
+        for t in tx.find_tasks():
+            out.append(t)
+        for u in out:
+            u.status.state = 5
+    """
+    assert findings(src, "swarmkit_tpu/orchestrator/foo.py") == \
+        ["store-copy-dataflow"]
+
+
+def test_store_copy_append_of_copies_clean():
+    src = """
+    def txn(tx):
+        out = []
+        for t in tx.find_tasks():
+            out.append(t.copy())
+        for u in out:
+            u.status.state = 5
+    """
+    assert findings(src, "swarmkit_tpu/orchestrator/foo.py") == []
+
+
+def test_store_copy_tuple_unpack_fires():
+    src = """
+    def txn(tx):
+        a, b = tx.get_task(x), tx.get_node(y)
+        b.spec = None
+    """
+    assert findings(src, "swarmkit_tpu/csi/foo.py") == \
+        ["store-copy-dataflow"]
+
+
+def test_store_copy_attribute_alias_fires():
+    """`st = t.status; st.state = X` — the sub-object is the same
+    shared tree."""
+    src = """
+    def txn(tx):
+        t = tx.get_task(tid)
+        st = t.status
+        st.state = 5
+    """
+    assert findings(src, "swarmkit_tpu/csi/foo.py") == \
+        ["store-copy-dataflow"]
+
+
+def test_store_copy_branch_copy_one_path_fires():
+    """Flow sensitivity: a copy on one branch does not clean the
+    fall-through path."""
+    src = """
+    def txn(tx, cond):
+        t = tx.get_task(tid)
+        if cond:
+            t = t.copy()
+        t.desired_state = 5
+    """
+    assert findings(src, "swarmkit_tpu/csi/foo.py") == \
+        ["store-copy-dataflow"]
+
+
+def test_store_copy_branch_copy_both_paths_clean():
+    src = """
+    def txn(tx, cond):
+        t = tx.get_task(tid)
+        if cond:
+            t = t.copy()
+        else:
+            t = t.copy()
+        t.desired_state = 5
+    """
+    assert findings(src, "swarmkit_tpu/csi/foo.py") == []
+
+
+def test_store_copy_container_mutator_fires():
+    """Mutating a live object's container attribute in place."""
+    src = """
+    def txn(tx):
+        t = tx.get_task(tid)
+        t.volumes.append(v)
+    """
+    assert findings(src, "swarmkit_tpu/csi/foo.py") == \
+        ["store-copy-dataflow"]
+
+
+def test_store_copy_finder_element_fires():
+    src = """
+    def txn(tx):
+        ts = tx.find_tasks()
+        ts[0].status.state = 5
+    """
+    assert findings(src, "swarmkit_tpu/csi/foo.py") == \
+        ["store-copy-dataflow"]
+
+
+def test_store_copy_loop_over_finder_fires():
+    src = """
+    def txn(tx):
+        for t in tx.find_tasks():
+            t.status.state = 5
+    """
+    assert findings(src, "swarmkit_tpu/csi/foo.py") == \
+        ["store-copy-dataflow"]
+
+
+def test_store_copy_local_container_write_clean():
+    """Writing INTO a local container (not through a live element) is
+    not a store mutation."""
+    src = """
+    def txn(tx):
+        t = tx.get_task(tid)
+        lst = [t]
+        lst[0] = None
+    """
+    assert findings(src, "swarmkit_tpu/csi/foo.py") == []
+
+
+def test_store_copy_pragma_suppresses():
+    src = """
+    def txn(tx):
+        t = tx.get_task(tid)
+        # lint: allow(store-copy-dataflow) harness corrupting on purpose
+        t.desired_state = 5
+    """
+    assert findings(src, "swarmkit_tpu/csi/foo.py") == []
+
+
+# ------------------------------------------------------------- dirty-feed
+SCHED = "swarmkit_tpu/scheduler/scheduler.py"
+
+
+def test_dirty_feed_unmarked_mutation_fires():
+    """The seeded unmarked-mutation mutant: an add_task with no mark on
+    any path is invisible to the tracked encoder."""
+    src = """
+    class S:
+        def handle(self, t):
+            info = self.node_infos.get(t.node_id)
+            info.add_task(t)
+    """
+    assert findings(src, SCHED) == ["dirty-feed"]
+
+
+def test_dirty_feed_if_idiom_clean():
+    """`if info.add_task(t): mark_numeric(info)` — the mutation only
+    happened on the true branch, where the mark lands."""
+    src = """
+    class S:
+        def handle(self, t):
+            info = self.node_infos.get(t.node_id)
+            if info.add_task(t):
+                self.encoder.mark_numeric(info)
+    """
+    assert findings(src, SCHED) == []
+
+
+def test_dirty_feed_mark_before_mutation_clean():
+    """A mark earlier on the path covers the row until the next encode
+    — order within one invocation does not matter."""
+    src = """
+    class S:
+        def handle(self, info, key):
+            self.encoder.mark_numeric(info)
+            info.task_failed(key)
+    """
+    assert findings(src, SCHED) == []
+
+
+def test_dirty_feed_mark_free_branch_fires():
+    src = """
+    class S:
+        def handle(self, t, cond):
+            info = self.node_infos.get(t.node_id)
+            if info.remove_task(t):
+                if cond:
+                    self.encoder.mark_numeric(info)
+    """
+    assert findings(src, SCHED) == ["dirty-feed"]
+
+
+def test_dirty_feed_wave_commit_whitelisted():
+    src = """
+    class S:
+        def _apply_decisions(self, info, t):
+            info.add_task(t)
+    """
+    assert findings(src, SCHED) == []
+
+
+def test_dirty_feed_only_scheduler_paths():
+    src = """
+    class S:
+        def handle(self, info, t):
+            info.add_task(t)
+    """
+    assert findings(src, "swarmkit_tpu/scheduler/batch.py") == []
+
+
+def test_dirty_feed_real_scheduler_clean():
+    src = (ROOT / SCHED).read_text()
+    assert [f.rule for f in lint.lint_source(src, SCHED)
+            if f.rule == "dirty-feed"] == []
+
+
+def test_dirty_feed_real_scheduler_mutant_caught():
+    """Deleting a live mark site from the REAL scheduler must fire —
+    the rule guards the production file, not just fixtures."""
+    src = (ROOT / SCHED).read_text()
+    anchor = ("                if info.remove_task(t):\n"
+              "                    self.encoder.mark_numeric(info)\n")
+    mutated = src.replace(
+        anchor,
+        "                if info.remove_task(t):\n"
+        "                    pass\n", 1)
+    assert mutated != src, "edit anchor moved — update this test"
+    assert "dirty-feed" in [
+        f.rule for f in lint.lint_source(mutated, SCHED)]
+
+
+# ---------------------------------------------------- barrier-before-drain
+PIPE = "swarmkit_tpu/ops/pipeline.py"
+
+
+def test_barrier_before_drain_mutant_fires():
+    """The seeded drain-without-barrier mutant: a drain entry reaching
+    an inline commit without blocking on the worker."""
+    src = """
+    class TickPipeline:
+        def drain_serial(self):
+            commit_deferred(sync=True)
+    """
+    assert findings(src, PIPE) == ["barrier-before-drain"]
+
+
+def test_barrier_before_drain_barriered_clean():
+    src = """
+    class TickPipeline:
+        def drain_serial(self):
+            self._barrier(timing)
+            commit_deferred(sync=True)
+    """
+    assert findings(src, PIPE) == []
+
+
+def test_barrier_before_drain_conditional_barrier_fires():
+    """A barrier on ONE branch does not cover the other path to the
+    read."""
+    src = """
+    class TickPipeline:
+        def drain_serial(self, cond):
+            if cond:
+                self._barrier(timing)
+            commit_deferred(sync=True)
+    """
+    assert findings(src, PIPE) == ["barrier-before-drain"]
+
+
+def test_barrier_postdominate_flush_pipeline_fires():
+    src = """
+    class Scheduler:
+        def flush_pipeline(self):
+            while self._inflight is not None:
+                self._tick_pipelined(allow_retry=False)
+    """
+    assert findings(src, SCHED) == ["barrier-before-drain"]
+
+
+def test_barrier_postdominate_flush_pipeline_clean():
+    src = """
+    class Scheduler:
+        def flush_pipeline(self):
+            while self._inflight is not None:
+                self._tick_pipelined(allow_retry=False)
+            self._drain_commit_plane()
+    """
+    assert findings(src, SCHED) == []
+
+
+def test_barrier_real_mirror_mutant_caught():
+    """Removing drain_serial's first-step barrier from the REAL
+    pipeline source fires (the same one-sided edit the mirror table
+    also catches — defense in depth)."""
+    src = (ROOT / PIPE).read_text()
+    edited = src.replace(
+        "            self._barrier(timing)\n"
+        "            commit_deferred(sync=True)\n",
+        "            commit_deferred(sync=True)\n")
+    assert edited != src, "edit anchor moved — update this test"
+    assert "barrier-before-drain" in [
+        f.rule for f in lint.lint_source(edited, PIPE)]
+
+
+def test_barrier_real_handle_mutant_caught():
+    """Removing _handle's top-of-function drain must fire: external
+    mutations are the contract's canonical trigger."""
+    src = (ROOT / SCHED).read_text()
+    edited = src.replace(
+        "        self._drain_commit_plane(swallow=True)\n", "", 1)
+    assert edited != src, "edit anchor moved — update this test"
+    assert "barrier-before-drain" in [
+        f.rule for f in lint.lint_source(edited, SCHED)]
+
+
+def test_barrier_coverage_pins_entry_points():
+    """A rename of a curated drain entry must not silently disable the
+    rule: every configured entry point exists in the real tree."""
+    from swarmkit_tpu.analysis import dataflow
+
+    assert dataflow.barrier_coverage(ROOT) == {}
+
+
+def test_barrier_coverage_catches_read_vocab_rename(tmp_path):
+    """A renamed READ/mutator (not just an entry function) would leave
+    the entry's check vacuously green — coverage pins the whole call
+    vocabulary."""
+    import shutil
+
+    from swarmkit_tpu.analysis import dataflow
+
+    for spec in dataflow.BARRIER_SPECS:
+        dst = tmp_path / spec.path
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(ROOT / spec.path, dst)
+    sched = tmp_path / SCHED
+    sched.write_text(sched.read_text().replace(
+        "_schedule_backlog", "_schedule_backlog_chunked"))
+    cov = dataflow.barrier_coverage(tmp_path)
+    assert "_schedule_backlog" in cov.get(SCHED, [])
+
+
+def test_barrier_in_finally_covers_abrupt_exit():
+    """A barrier in a try/finally runs on the early-return path too —
+    the CFG threads finally bodies onto abrupt exits (review fix)."""
+    src = """
+    class Scheduler:
+        def flush_pipeline(self):
+            try:
+                return self._finish()
+            finally:
+                self._drain_commit_plane()
+    """
+    assert findings(src, SCHED) == []
+
+
+def test_dirty_feed_mark_in_finally_clean():
+    src = """
+    class S:
+        def handle(self, info, t):
+            try:
+                info.add_task(t)
+                return True
+            finally:
+                self.encoder.mark_numeric(info)
+    """
+    assert findings(src, SCHED) == []
+
+
+def test_dirty_feed_markless_finally_still_fires():
+    src = """
+    class S:
+        def handle(self, info, t):
+            try:
+                info.add_task(t)
+                return True
+            finally:
+                self.count += 1
+    """
+    assert findings(src, SCHED) == ["dirty-feed"]
+
+
+# ----------------------------------------------------------- raw-condition
+def test_raw_condition_fires_on_bare():
+    src = "import threading\ncond = threading.Condition()\n"
+    assert findings(src, "swarmkit_tpu/foo/bar.py") == ["raw-condition"]
+
+
+def test_raw_condition_factory_arg_clean():
+    src = """
+    import threading
+    from ..analysis.lockgraph import make_rlock
+    cond = threading.Condition(make_rlock("foo.cond"))
+    """
+    assert findings(src, "swarmkit_tpu/foo/bar.py") == []
+
+
+def test_raw_condition_named_lock_arg_clean():
+    # a pre-built lock passed by name: raw-lock polices how the name
+    # was bound, so the Condition site itself is fine
+    src = """
+    import threading
+    cond = threading.Condition(self._mu)
+    """
+    assert findings(src, "swarmkit_tpu/foo/bar.py") == []
+
+
+def test_raw_condition_allowed_in_analysis():
+    src = "import threading\ncond = threading.Condition()\n"
+    assert findings(src, "swarmkit_tpu/analysis/lockgraph.py") == []
 
 
 # -------------------------------------------------------- int64-in-kernel
@@ -515,6 +939,72 @@ def test_protocol_table_in_sync_with_print_protocol():
     assert ns["EXPECTED"] == mirror.EXPECTED
 
 
+# ------------------------------------------- mirror registry: new pairs
+def _spec(key):
+    return next(s for s in mirror.MIRRORS if s.key == key)
+
+
+def test_registry_every_pair_has_two_members():
+    by_pair: dict = {}
+    for s in mirror.MIRRORS:
+        by_pair.setdefault(s.pair, []).append(s.key)
+    for pair, keys in by_pair.items():
+        assert len(keys) == 2, (pair, keys)
+
+
+def test_ipam_pair_one_sided_edit_caught():
+    """One-sided allocator edit: the scalar pool loses its exhaustion
+    raise — drift AND a lost required event."""
+    spec = _spec("ipam_pool_scalar")
+    src = (ROOT / spec.path).read_text()
+    edited = src.replace(
+        '        raise IPAMError(f"subnet {self.subnet} exhausted")\n',
+        "        return None\n", 1)
+    assert edited != src, "edit anchor moved — update this test"
+    rep = mirror.check_drift(ROOT, sources={"ipam_pool_scalar": edited})
+    assert not rep.clean
+    assert "ipam_pool_scalar" in rep.diffs
+    assert "allocate:error" in rep.diffs["ipam_pool_scalar"]
+
+
+def test_ports_pair_one_sided_edit_caught():
+    """One-sided edit to the batched twin: dropping the partial-grant
+    failure return changes the protocol table."""
+    spec = _spec("ports_batched")
+    src = (ROOT / spec.path).read_text()
+    edited = src.replace(
+        "                if len(grants) < j - i:\n"
+        "                    return False        "
+        "# scalar shape: partial applied\n",
+        "", 1)
+    assert edited != src, "edit anchor moved — update this test"
+    rep = mirror.check_drift(ROOT, sources={"ports_batched": edited})
+    assert not rep.clean and "ports_batched" in rep.diffs
+
+
+def test_assign_wave_pair_one_sided_edit_caught():
+    """The lazy path abandoning the SHARED verdict helper is exactly
+    the drift class the pair exists for."""
+    spec = _spec("assign_wave_lazy")
+    src = (ROOT / spec.path).read_text()
+    edited = src.replace(
+        "self._wave_verdicts(assignments, 0, codes, mark_stale)",
+        "mark_stale(0, None, None, 0)", 1)
+    assert edited != src, "edit anchor moved — update this test"
+    rep = mirror.check_drift(ROOT, sources={"assign_wave_lazy": edited})
+    assert not rep.clean
+    assert "verdicts" in rep.missing_common.get("assign_wave_lazy", [])
+
+
+def test_pair_required_events_present_on_real_tree():
+    for spec in mirror.MIRRORS:
+        seq = mirror.extract_from_source(
+            (ROOT / spec.path).read_text(), spec)
+        events = {s.split(":", 1)[1] for s in seq}
+        assert spec.required <= events, (spec.key,
+                                         sorted(spec.required - events))
+
+
 # --------------------------------------------------------------- lockgraph
 def test_lockgraph_disarmed_returns_plain_primitives():
     assert not lockgraph.active()
@@ -671,6 +1161,64 @@ def test_lockgraph_report_disarmed_is_empty_clean():
     assert rep.clean and rep.edges == 0 and rep.locks == 0
 
 
+# ----------------------------------------- lockgraph: tracked Condition
+def test_condition_over_tracked_rlock_wait_notify():
+    """The raw-condition satellite: a Condition built on make_rlock
+    must keep the full wait/notify protocol while armed — including a
+    reentrant holder fully releasing across wait()."""
+    with lockgraph.armed() as st:
+        cond = threading.Condition(lockgraph.make_rlock("t.cond"))
+        ready: list = []
+
+        def waiter():
+            with cond:
+                with cond:          # reentrant: wait releases BOTH
+                    while not ready:
+                        cond.wait(timeout=5)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        import time
+        time.sleep(0.05)
+        with cond:
+            ready.append(1)
+            cond.notify_all()
+        t.join(5)
+        assert not t.is_alive()
+        assert st.report().clean
+
+
+def test_condition_lock_participates_in_order_graph():
+    """The whole point of closing the blind spot: an inversion through
+    a condition's lock now produces a cycle."""
+    with lockgraph.armed() as st:
+        cond = threading.Condition(lockgraph.make_rlock("c.cond"))
+        other = lockgraph.make_lock("c.other")
+
+        def cond_then_other():
+            with cond:
+                with other:
+                    pass
+
+        def other_then_cond():
+            with other:
+                with cond:
+                    pass
+
+        for fn in (cond_then_other, other_then_cond):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+        rep = st.report()
+        assert rep.cycles, "condition-lock inversion must report a cycle"
+
+
+def test_condition_disarmed_is_native():
+    assert not lockgraph.active()
+    cond = threading.Condition(lockgraph.make_rlock("x"))
+    assert type(cond._lock) is type(threading.RLock())
+
+
 # ------------------------------------------------------------------- CLI
 def test_cli_clean_tree_exits_zero(capsys):
     from swarmkit_tpu.analysis.__main__ import main
@@ -688,3 +1236,47 @@ def test_cli_print_protocol(capsys):
     out = capsys.readouterr().out
     assert rc == 0
     assert "tick_pipeline" in out and "scheduler_tick" in out
+    assert "ipam_pool_scalar" in out and "assign_wave_lazy" in out
+
+
+def test_cli_json_output(capsys):
+    import json
+
+    from swarmkit_tpu.analysis.__main__ import main
+
+    rc = main(["--json", str(ROOT)])
+    out = capsys.readouterr().out
+    doc = json.loads(out)
+    assert rc == 0
+    assert doc["clean"] is True
+    assert doc["findings"] == []
+    assert doc["mirror"]["clean"] is True
+    assert doc["rules"] >= 12
+
+
+def test_cli_json_findings_shape(tmp_path, capsys):
+    """--json on a dirty tree: structured findings, exit 1."""
+    import json
+
+    from swarmkit_tpu.analysis.__main__ import main
+
+    _make_clean_mirror_tree(tmp_path)
+    bad = tmp_path / "swarmkit_tpu" / "foo" / "bar.py"
+    bad.parent.mkdir(parents=True, exist_ok=True)
+    bad.write_text("import threading\nlock = threading.Lock()\n")
+    rc = main(["--json", str(tmp_path)])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    rules = {f["rule"] for f in doc["findings"]}
+    assert "raw-lock" in rules
+    f = next(x for x in doc["findings"] if x["rule"] == "raw-lock")
+    assert f["path"] == "swarmkit_tpu/foo/bar.py" and f["line"] == 2
+
+
+def _make_clean_mirror_tree(tmp_path):
+    """Copy the mirror-registry member files (and nothing else) into a
+    tmp root so check_drift passes there."""
+    for spec in mirror.MIRRORS:
+        dst = tmp_path / spec.path
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text((ROOT / spec.path).read_text())

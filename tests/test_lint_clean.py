@@ -1,22 +1,38 @@
-"""Tier-1 gate (ISSUE 8): the REAL tree passes the full analysis plane.
+"""Tier-1 gate (ISSUE 8, dataflow engine ISSUE 12): the REAL tree
+passes the full analysis plane.
 
-Equivalent to `python -m swarmkit_tpu.analysis` exiting 0 — the AST rule
-set over swarmkit_tpu/ + tests/ finds nothing (modulo explanatory
-pragmas) and both pipelined-tick mirrors match the checked-in protocol
-table. A failure here means a NEW invariant violation landed (fix it or
-pragma it with a justification) or a tick-protocol change landed in one
-mirror only (land it in both, then re-record with
+Equivalent to `python -m swarmkit_tpu.analysis` exiting 0 — the
+syntactic AST rules PLUS the dataflow contract rules over
+swarmkit_tpu/ + tests/ find nothing (modulo explanatory pragmas) and
+every registered mirror pair matches the checked-in protocol table. A
+failure here means a NEW invariant violation landed (fix it or pragma
+it with a justification) or a mirrored-protocol change landed in one
+member only (land it in both, then re-record with
 `python -m swarmkit_tpu.analysis --print-protocol`).
+
+This module also pins the plane's CI/tooling contract (ISSUE 12
+satellites): the full pass fits the 10 s wall-time budget, the
+`--changed-only` scope is SOUND (it agrees with the full pass on every
+shared file — failing tier-1 here is the scope-soundness guard), the
+curated barrier-before-drain entry points still exist, and the CLI
+exit codes stay 0 clean / 1 findings / 2 internal error.
 """
 from __future__ import annotations
 
+import json
 import subprocess
 import sys
+import time
 from pathlib import Path
 
-from swarmkit_tpu.analysis import lint, mirror
+from swarmkit_tpu.analysis import dataflow, lint, mirror
 
 ROOT = Path(__file__).resolve().parents[1]
+
+# full lint (syntactic + dataflow) + every mirror pair, whole tree.
+# Generous vs the ~2 s measured so a slow CI box does not flake, tight
+# enough that an accidentally quadratic rule fails loudly.
+WALL_BUDGET_S = 10.0
 
 
 def test_tree_lint_clean():
@@ -25,10 +41,14 @@ def test_tree_lint_clean():
 
 
 def test_every_rule_has_a_name_and_invariant():
-    names = [r.name for r in lint.RULES]
+    rules = lint.all_rules()
+    names = [r.name for r in rules]
     assert len(names) == len(set(names))
-    for r in lint.RULES:
+    for r in rules:
         assert r.name and r.invariant, r
+    # the dataflow rules ride the same driver as the syntactic ones
+    assert {"store-copy-dataflow", "dirty-feed",
+            "barrier-before-drain"} <= set(names)
 
 
 def test_mirror_protocol_matches_table():
@@ -36,12 +56,109 @@ def test_mirror_protocol_matches_table():
     assert rep.clean, "\n" + rep.render()
 
 
+def test_barrier_rule_entry_points_exist():
+    """A rename of a curated drain entry must fail tier-1 rather than
+    silently disabling barrier-before-drain."""
+    assert dataflow.barrier_coverage(ROOT) == {}
+
+
+def test_full_pass_within_wall_budget():
+    """The ISSUE 12 budget: full lint + dataflow + every mirror pair
+    stays fast enough to live in pre-commit-ish loops."""
+    t0 = time.perf_counter()
+    findings = lint.lint_tree(ROOT)
+    drift = mirror.check_drift(ROOT)
+    elapsed = time.perf_counter() - t0
+    assert not findings and drift.clean
+    assert elapsed <= WALL_BUDGET_S, (
+        f"full analysis pass took {elapsed:.2f}s "
+        f"(budget {WALL_BUDGET_S}s) — a rule went superlinear")
+
+
+def test_changed_only_scope_soundness():
+    """The scope-soundness guard: for EVERY file in the tree, linting
+    it through the --changed-only path (lint_files) must produce
+    exactly the full pass's findings for that file. A rule that peeks
+    outside its file (or a driver that filters differently per mode)
+    would let an edit loop pass while tier-1 fails — disagreement on
+    any shared file fails tier-1 here."""
+    full = lint.lint_tree(ROOT)
+    by_file: dict[str, list] = {}
+    for f in full:
+        by_file.setdefault(f.path, []).append(f)
+    rels = [p.relative_to(ROOT).as_posix()
+            for p in lint.iter_py_files(ROOT, ("swarmkit_tpu", "tests"))]
+    scoped = lint.lint_files(ROOT, rels)
+    assert scoped == full
+    # and per-file slices agree (the mode a real edit loop runs)
+    sample = [r for r in rels if "scheduler" in r or "store" in r]
+    for rel in sample:
+        assert lint.lint_files(ROOT, [rel]) == by_file.get(rel, [])
+
+
+def _run_cli(args, cwd=None):
+    return subprocess.run(
+        [sys.executable, "-m", "swarmkit_tpu.analysis", *args],
+        cwd=str(cwd or ROOT), capture_output=True, text=True,
+        timeout=120)
+
+
 def test_module_entrypoint_exits_zero():
     """The standalone `python -m swarmkit_tpu.analysis` contract (the
     analysis package must stay importable without jax — it runs in
     pre-commit-ish contexts)."""
-    proc = subprocess.run(
-        [sys.executable, "-m", "swarmkit_tpu.analysis", str(ROOT)],
-        cwd=str(ROOT), capture_output=True, text=True, timeout=120)
+    proc = _run_cli([str(ROOT)])
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "clean" in proc.stdout
+
+
+def test_exit_code_one_on_findings(tmp_path):
+    """Exit 1 = the tree has findings (mirror pairs themselves clean:
+    their member files are copied over verbatim)."""
+    for spec in mirror.MIRRORS:
+        dst = tmp_path / spec.path
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text((ROOT / spec.path).read_text())
+    bad = tmp_path / "swarmkit_tpu" / "foo.py"
+    bad.write_text("import threading\nlock = threading.Lock()\n")
+    proc = _run_cli([str(tmp_path)])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "raw-lock" in proc.stdout
+
+
+def test_exit_code_two_on_internal_error(tmp_path):
+    """Exit 2 = the analysis itself broke (here: a root missing the
+    mirror member files entirely) — distinct from a dirty tree."""
+    proc = _run_cli([str(tmp_path)])
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+
+
+def test_cli_json_clean_document():
+    proc = _run_cli(["--json", str(ROOT)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["clean"] and not doc["findings"]
+    assert doc["mirror"]["clean"]
+
+
+def test_changed_only_root_below_git_toplevel(tmp_path):
+    """`git status` paths are toplevel-relative: with the analysis root
+    nested below the toplevel, a dirty file must still be found rather
+    than silently filtered out of scope (review fix)."""
+    sub = tmp_path / "sub"
+    for spec in mirror.MIRRORS:
+        dst = sub / spec.path
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text((ROOT / spec.path).read_text())
+    bad = sub / "swarmkit_tpu" / "foo.py"
+    bad.write_text("import threading\nlock = threading.Lock()\n")
+    env_git = ["git", "-C", str(tmp_path)]
+    for cmd in (["init", "-q", "."],
+                ["config", "user.email", "t@t"],
+                ["config", "user.name", "t"],
+                ["add", "-A"], ["commit", "-qm", "base"]):
+        subprocess.run(env_git + cmd, check=True, capture_output=True)
+    bad.write_text(bad.read_text() + "# dirty\n")
+    proc = _run_cli(["--changed-only", str(sub)])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "raw-lock" in proc.stdout
