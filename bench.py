@@ -1106,6 +1106,168 @@ def bench_dispatcher_fanout(np, n_nodes=10_000):
         d.stop()
 
 
+def bench_dispatcher_fanout_storm(np, n_sessions=100_000,
+                                  shard_counts=(1, 4, 8),
+                                  beats_sample=20_000,
+                                  follower_reads=None):
+    """ISSUE 13: the SHARDED fan-out plane at a 100k-session storm.
+
+    Driven (no dispatcher thread): sessions are injected directly (the
+    row measures the flush plane, not `register`'s store write), every
+    session is primed with a COMPLETE via one sharded flush, then one
+    service-wide update dirties all of them and ONE flush serves the
+    whole storm. Per-shard columns at each P: flush wall time,
+    store-tx-per-flush (the judged 1.0, GLOBAL — the snapshot is shared
+    read-only across shards), dirty-walks-per-shard (≤ 1.0), p50/p99
+    heartbeat beat latency over a sample (the sharded wheel + per-shard
+    jitter rng path), and messages delivered. A follower read-plane
+    slice serves `follower_reads` lease-gated read streams from the
+    same store (stub lease: this is a one-process bench) and reports
+    `follower_read_ratio` = follower-served / total read streams.
+
+    tests/test_bench_diag.py pins a reduced CPU-smoke shape of this
+    row's op-count contracts."""
+    from swarmkit_tpu.api.objects import Node, Task
+    from swarmkit_tpu.api.types import NodeStatusState, TaskState
+    from swarmkit_tpu.dispatcher.dispatcher import Dispatcher, Session
+    from swarmkit_tpu.dispatcher.follower import FollowerReadPlane
+    from swarmkit_tpu.store.memory import MemoryStore
+    from swarmkit_tpu.store.watch import Channel
+    from swarmkit_tpu.utils.slo import quantiles_nearest_rank
+
+    if follower_reads is None:
+        follower_reads = max(1, n_sessions // 10)
+    store = MemoryStore()
+
+    def seed(tx):
+        for i in range(n_sessions):
+            n = Node(id=f"sf{i:06d}")
+            n.status.state = NodeStatusState.READY
+            tx.create(n)
+            t = Task(id=f"st{i:06d}", service_id="stormsvc",
+                     node_id=n.id, slot=i + 1)
+            t.status.state = TaskState.RUNNING
+            t.desired_state = TaskState.RUNNING
+            tx.create(t)
+    store.update(seed)
+    node_ids = [f"sf{i:06d}" for i in range(n_sessions)]
+
+    per_shard = {}
+    rev = 0
+    for P in shard_counts:
+        d = Dispatcher(store, heartbeat_period=120.0,
+                       rate_limit_period=-1.0, shards=P, jitter_seed=13)
+        try:
+            # inject sessions (no store write, no wheel arm: liveness is
+            # not this row; beats below go through the full heartbeat
+            # path against explicitly-armed wheel entries)
+            grace = d.heartbeat_period * 3
+            for nid in node_ids:
+                s = Session(node_id=nid, session_id=f"b.{nid}",
+                            channel=Channel(matcher=None, limit=None))
+                d._sessions[nid] = s
+                d._hb_wheel.add(nid, grace, lambda: None)
+            # prime: one flush serves every session its first diff
+            d._mark_dirty_many(node_ids)
+            t0 = time.perf_counter()
+            d._send_incrementals()
+            prime_s = time.perf_counter() - t0
+            for nid in node_ids:     # drain the prime diffs: the storm
+                ch = d._sessions[nid].channel   # count below must see
+                while ch.try_get() is not None:  # ONLY storm messages
+                    pass
+
+            # beat storm sample: p50/p99 latency of the full heartbeat
+            # path (session check + sharded wheel beat + shard-rng jitter)
+            lat = []
+            for i in range(min(beats_sample, n_sessions)):
+                nid = node_ids[i % n_sessions]
+                sid = f"b.{nid}"
+                b0 = time.perf_counter()
+                d.heartbeat(nid, sid)
+                lat.append(time.perf_counter() - b0)
+            qs = quantiles_nearest_rank(sorted(lat), (50, 99))
+
+            # THE storm: one service-wide update dirties all sessions
+            rev += 1
+
+            def touch(tx, rev=rev):
+                for i in range(n_sessions):
+                    cur = tx.get_task(f"st{i:06d}").copy()
+                    cur.annotations.labels = {"rev": str(rev)}
+                    tx.update(cur)
+            store.update(touch)
+            m0 = dict(d.metrics)
+            d._mark_dirty_many(node_ids)
+            t0 = time.perf_counter()
+            d._send_incrementals()
+            flush_s = time.perf_counter() - t0
+            dm = {k: d.metrics[k] - m0[k]
+                  for k in ("flushes", "flush_tx", "dirty_walks",
+                            "ships", "wire_copies")}
+            delivered = 0
+            for nid in node_ids:
+                ch = d._sessions[nid].channel
+                msg = ch.try_get()
+                while msg is not None:
+                    if msg.type == "incremental" and msg.changes:
+                        delivered += 1
+                        break
+                    msg = ch.try_get()
+            per_shard[str(P)] = {
+                "prime_s": round(prime_s, 3),
+                "flush_s": round(flush_s, 3),
+                "sessions_per_s": round(n_sessions / flush_s)
+                if flush_s else None,
+                "store_tx_per_flush": round(
+                    dm["flush_tx"] / dm["flushes"], 3)
+                if dm["flushes"] else None,
+                "dirty_walks_per_shard": round(
+                    dm["dirty_walks"] / (dm["flushes"] * P), 3)
+                if dm["flushes"] else None,
+                "copies_per_ship": round(
+                    dm["wire_copies"] / dm["ships"], 3)
+                if dm["ships"] else None,
+                "beat_p50_us": round(qs[50] * 1e6, 1),
+                "beat_p99_us": round(qs[99] * 1e6, 1),
+                "delivered": delivered,
+            }
+        finally:
+            d.stop()
+
+    # follower read slice: lease-gated read streams off the same store
+    # (stub lease — single-process bench; the staleness bound itself is
+    # FakeClock-pinned in tests/test_dispatcher_fanout.py)
+    class _LeaseStub:
+        def read_ok(self):
+            return True
+
+    plane = FollowerReadPlane(store, _LeaseStub())
+    t0 = time.perf_counter()
+    for nid in node_ids[:follower_reads]:
+        plane.assignments(nid)
+    follower_s = time.perf_counter() - t0
+    total_reads = follower_reads + n_sessions * len(shard_counts)
+    ok = all(v["delivered"] == n_sessions
+             and v["store_tx_per_flush"] == 1.0
+             and (v["dirty_walks_per_shard"] or 0) <= 1.0
+             for v in per_shard.values())
+    base = per_shard.get(str(shard_counts[0]), {}).get("flush_s")
+    return {
+        "sessions": n_sessions,
+        "shards": per_shard,
+        "scale_p1_to_p4": round(base / per_shard["4"]["flush_s"], 2)
+        if base and "4" in per_shard and per_shard["4"]["flush_s"]
+        else None,
+        "follower_reads": follower_reads,
+        "follower_read_s": round(follower_s, 3),
+        "follower_read_ratio": round(
+            plane.metrics["reads_served"] / total_reads, 4)
+        if total_reads else None,
+        "parity": ok and plane.metrics["reads_served"] == follower_reads,
+    }
+
+
 def bench_mesh_cluster_step(np, n_nodes=None, total_tasks=1_000_000):
     """ISSUE 7: the fused flagship (placement fill + raft quorum tally +
     commit-frontier advance in ONE jit) sharded over the `nodes` mesh
@@ -1937,6 +2099,10 @@ def main():
         # the assignment-diff plane at the 10k-node design point
         # (VERDICT item 7)
         ("dispatcher_fanout_10k", lambda: bench_dispatcher_fanout(np)),
+        # ISSUE 13: the SHARDED flush plane at a 100k-session storm
+        # (per-shard columns at P∈{1,4,8} + follower_read_ratio)
+        ("dispatcher_fanout_storm_100k",
+         lambda: bench_dispatcher_fanout_storm(np)),
         # ISSUE 11: columnar vs object-store wave write-back at
         # 100k/1M tasks (>=10x acceptance + rebuild bit-equality)
         ("store_plane", lambda: bench_store_plane(np)),

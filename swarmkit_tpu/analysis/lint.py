@@ -269,6 +269,7 @@ class SpanInLoopRule(Rule):
         "swarmkit_tpu/raft/storage.py",
         "swarmkit_tpu/dispatcher/dispatcher.py",
         "swarmkit_tpu/dispatcher/heartbeat.py",
+        "swarmkit_tpu/dispatcher/follower.py",
         "swarmkit_tpu/rpc/wire.py",
         "swarmkit_tpu/rpc/server.py",
         "swarmkit_tpu/rpc/client.py",

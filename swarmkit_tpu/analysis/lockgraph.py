@@ -52,7 +52,16 @@ _ARM_LOCK = threading.Lock()
 
 # Lock names whose acquisition inside an open store.view callback is a
 # known deadlock hazard (the PR 4 inversion). Extend via arm(hazard_names=).
-DEFAULT_HAZARD_NAMES = frozenset({"dispatcher.lock"})
+# The follower read plane holds its lock ACROSS store.view exactly like
+# the dispatcher does, so it shares the inversion class (ISSUE 13).
+DEFAULT_HAZARD_NAMES = frozenset({"dispatcher.lock",
+                                  "dispatcher.follower.lock"})
+
+# Name PREFIXES with the same hazard semantics: the sharded fan-out
+# plane's locks are indexed ("dispatcher.shard0.lock", ...), so the
+# detector keys on the prefix instead of enumerating every shard
+# (ISSUE 13). Extend via arm(hazard_prefixes=).
+DEFAULT_HAZARD_PREFIXES = ("dispatcher.shard",)
 
 
 @dataclass
@@ -91,8 +100,10 @@ class Report:
 class _GraphState:
     """One armed session: the acquisition-order graph + hazard log."""
 
-    def __init__(self, hazard_names=DEFAULT_HAZARD_NAMES):
+    def __init__(self, hazard_names=DEFAULT_HAZARD_NAMES,
+                 hazard_prefixes=DEFAULT_HAZARD_PREFIXES):
         self.hazard_names = frozenset(hazard_names)
+        self.hazard_prefixes = tuple(hazard_prefixes)
         self._mu = threading.Lock()             # leaf: guards the sets below
         self._edges: dict[tuple[int, int], Edge] = {}
         self._locks: dict[int, str] = {}        # id(tracked) -> name
@@ -126,7 +137,10 @@ class _GraphState:
         """Called AFTER the inner lock is held (first acquisition only
         for RLocks)."""
         held = self._held()
-        if lock.name in self.hazard_names and self._view_depth() > 0:
+        if (lock.name in self.hazard_names
+                or (self.hazard_prefixes
+                    and lock.name.startswith(self.hazard_prefixes))) \
+                and self._view_depth() > 0:
             tname = threading.current_thread().name
             with self._mu:
                 self._hazards.append(
@@ -323,10 +337,11 @@ def view_exit() -> None:
 
 
 # ----------------------------------------------------------------- arming
-def arm(hazard_names=DEFAULT_HAZARD_NAMES) -> _GraphState:
+def arm(hazard_names=DEFAULT_HAZARD_NAMES,
+        hazard_prefixes=DEFAULT_HAZARD_PREFIXES) -> _GraphState:
     global _STATE
     with _ARM_LOCK:
-        _STATE = _GraphState(hazard_names)
+        _STATE = _GraphState(hazard_names, hazard_prefixes)
         return _STATE
 
 
@@ -347,10 +362,11 @@ def report() -> Report:
 
 
 @contextmanager
-def armed(hazard_names=DEFAULT_HAZARD_NAMES):
+def armed(hazard_names=DEFAULT_HAZARD_NAMES,
+          hazard_prefixes=DEFAULT_HAZARD_PREFIXES):
     """`with lockgraph.armed() as state: ...` — always disarms on exit;
     the caller asserts on `state.report()`."""
-    s = arm(hazard_names)
+    s = arm(hazard_names, hazard_prefixes)
     try:
         yield s
     finally:

@@ -137,6 +137,32 @@ _PORTS_VOCAB = {
 }
 REQUIRED_PORTS = frozenset({"owner_check", "dynamic", "return"})
 
+# leader vs follower assignment serving (ISSUE 13): the Dispatcher's
+# serve path and the FollowerReadPlane's must ride the SHARED
+# snapshot/build vocabulary — one store.view snapshot, _node_view build,
+# clone materialization, _diff, delivery-gated _commit_known. The
+# building blocks are literally shared (the follower aliases the
+# Dispatcher methods); this pair pins the serve PROTOCOL around them,
+# plus the follower's lease gate (its spec's `required` adds
+# `lease_gate` on top of the common floor).
+_SERVE_VOCAB = {
+    "store.view": "snapshot",
+    "_node_view": "build",
+    "_materialize_clones": "materialize",
+    "_diff": "diff",
+    "_offer": "offer",
+    "_commit_known": "commit_known",
+    "commit": "commit_known",     # the diff's delivery-gated closure
+    "_ship_task": "ship",
+    "_ship": "ship",
+    "_serve_session": "serve",
+    "_serve_shard": "serve_shard",
+    "read_ok": "lease_gate",
+    "_require_lease": "lease_gate",
+}
+REQUIRED_SERVE = frozenset({"snapshot", "build", "materialize", "diff",
+                            "offer", "commit_known"})
+
 # eager vs lazy assign_wave (store/memory.py): both ride the SHARED
 # verdict helper and the same patch primitive
 _ASSIGN_VOCAB = {
@@ -226,6 +252,27 @@ MIRRORS: tuple[MirrorSpec, ...] = (
         pair="port-alloc",
         required=REQUIRED_PORTS,
         capture_returns=True,
+    ),
+    MirrorSpec(
+        key="dispatcher_serve_leader",
+        path="swarmkit_tpu/dispatcher/dispatcher.py",
+        class_name="Dispatcher",
+        methods=("assignments", "_full_assignment", "_incremental",
+                 "_send_incrementals", "_serve_shard", "_serve_session"),
+        vocab=_SERVE_VOCAB,
+        pair="dispatcher-serve",
+        required=REQUIRED_SERVE,
+    ),
+    MirrorSpec(
+        key="dispatcher_serve_follower",
+        path="swarmkit_tpu/dispatcher/follower.py",
+        class_name="FollowerReadPlane",
+        methods=("assignments", "_full_assignment",
+                 "_send_incrementals", "_serve_session",
+                 "_require_lease"),
+        vocab=_SERVE_VOCAB,
+        pair="dispatcher-serve",
+        required=REQUIRED_SERVE | {"lease_gate"},
     ),
     MirrorSpec(
         key="assign_wave_eager",
@@ -460,6 +507,53 @@ EXPECTED: dict[str, tuple[str, ...]] = {
         'release:unclaim',
         'release_except:unclaim',
         'release_except:return',
+    ),
+    'dispatcher_serve_leader': (
+        'assignments:offer',
+        '_full_assignment:snapshot',
+        '_full_assignment:build',
+        '_full_assignment:materialize',
+        '_full_assignment:ship',
+        '_full_assignment:ship',
+        '_full_assignment:ship',
+        '_full_assignment:commit_known',
+        '_incremental:snapshot',
+        '_incremental:build',
+        '_incremental:materialize',
+        '_incremental:diff',
+        '_incremental:commit_known',
+        '_send_incrementals:build',
+        '_send_incrementals:snapshot',
+        '_send_incrementals:serve_shard',
+        '_serve_shard:serve',
+        '_serve_shard:commit_known',
+        '_serve_session:materialize',
+        '_serve_session:diff',
+        '_serve_session:offer',
+        '_serve_session:offer',
+        '_serve_session:ship',
+    ),
+    'dispatcher_serve_follower': (
+        'assignments:lease_gate',
+        'assignments:offer',
+        '_full_assignment:snapshot',
+        '_full_assignment:build',
+        '_full_assignment:materialize',
+        '_full_assignment:ship',
+        '_full_assignment:ship',
+        '_full_assignment:ship',
+        '_full_assignment:commit_known',
+        '_send_incrementals:lease_gate',
+        '_send_incrementals:build',
+        '_send_incrementals:snapshot',
+        '_send_incrementals:serve',
+        '_serve_session:materialize',
+        '_serve_session:diff',
+        '_serve_session:offer',
+        '_serve_session:commit_known',
+        '_serve_session:offer',
+        '_serve_session:ship',
+        '_require_lease:lease_gate',
     ),
     'assign_wave_eager': (
         '_wave_verdicts:codes',

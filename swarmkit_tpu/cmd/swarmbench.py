@@ -212,6 +212,121 @@ def start_poll_collector(ctl, svc_ids, collector, stop,
     return t
 
 
+# ----------------------------------------------------------- session storm
+class SessionStorm:
+    """N simulated agent sessions against the manager's sharded
+    dispatcher plane (ISSUE 13): register, subscribe a capped set of
+    assignment streams, heartbeat round-robin until stopped — fan-out
+    load riding alongside the churn, so the `--slo` gate certifies
+    NEW→RUNNING percentiles UNDER a populated session plane.
+
+    Registered simulacra are immediately DRAINED (spec.availability):
+    the scheduler must never place real tasks on agents that will never
+    run them — that would wedge the very startups the gate measures.
+    The manager identity swarmbench already holds may drive any node's
+    session (`_require_node` admits the MANAGER role), so no per-node
+    certs are needed."""
+
+    def __init__(self, client, ctl, n: int, prefix: str | None = None,
+                 streams: int = 32, beat_interval: float = 1.0):
+        self.client = client
+        self.ctl = ctl
+        self.n = n
+        self.prefix = prefix or f"bench-sess-{int(time.time())}"
+        self.streams = streams
+        self.beat_interval = beat_interval
+        self.metrics = {"registered": 0, "register_errors": 0,
+                        "streams": 0, "stream_msgs": 0,
+                        "beats": 0, "beat_errors": 0,
+                        "drain_failures": 0, "register_s": 0.0}
+        self._sessions: list[tuple[str, str]] = []
+        self._chans: list = []
+        self._thread: threading.Thread | None = None
+        self._stop: threading.Event | None = None
+
+    def _drain(self, nid: str, attempts: int = 3) -> bool:
+        """Mark a simulated node DRAIN, re-reading the version per try
+        (the cluster's own reconcilers bump node versions concurrently —
+        one raced update must not leave a schedulable phantom)."""
+        from ..api.types import NodeAvailability
+
+        for _ in range(attempts):
+            try:
+                node = self.ctl.get_node(nid)
+                if node.spec.availability == NodeAvailability.DRAIN:
+                    return True
+                node.spec.availability = NodeAvailability.DRAIN
+                self.ctl.update_node(nid, node.meta.version, node.spec)
+                return True
+            except Exception:
+                continue
+        return False
+
+    def start(self, stop: threading.Event):
+        self._stop = stop
+        t0 = time.monotonic()
+        for i in range(self.n):
+            nid = f"{self.prefix}-{i:05d}"
+            try:
+                sid = self.client.call("dispatcher.register", nid)
+            except Exception:
+                self.metrics["register_errors"] += 1
+                continue
+            if self._drain(nid):
+                self._sessions.append((nid, sid))
+                self.metrics["registered"] += 1
+            else:
+                # a simulacrum that could NOT be drained must not stay
+                # a READY+ACTIVE phantom the scheduler places real
+                # tasks on (that would wedge the very startups the
+                # --slo gate measures): leave it so it goes DOWN
+                self.metrics["drain_failures"] += 1
+                try:
+                    self.client.call("dispatcher.leave", nid, sid)
+                except Exception:
+                    pass
+        self.metrics["register_s"] = round(time.monotonic() - t0, 3)
+        for nid, sid in self._sessions[:self.streams]:
+            try:
+                self._chans.append(
+                    self.client.stream("dispatcher.assignments", nid, sid))
+                self.metrics["streams"] += 1
+            except Exception:
+                pass
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="swarmbench-sessions")
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            for nid, sid in self._sessions:
+                if self._stop.is_set():
+                    return
+                try:
+                    self.client.call("dispatcher.heartbeat", nid, sid)
+                    self.metrics["beats"] += 1
+                except Exception:
+                    self.metrics["beat_errors"] += 1
+            for ch in self._chans:
+                try:
+                    while ch.try_get() is not None:
+                        self.metrics["stream_msgs"] += 1
+                except Exception:
+                    pass
+            self._stop.wait(self.beat_interval)
+
+    def finish(self):
+        """Best-effort graceful leave so the simulated nodes go DOWN
+        cleanly instead of riding heartbeat-expiry timers."""
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        for nid, sid in self._sessions:
+            try:
+                self.client.call("dispatcher.leave", nid, sid)
+            except Exception:
+                pass
+
+
 # -------------------------------------------------------------- load shapes
 def _service_spec(name: str, replicas: int, command: str):
     import shlex
@@ -393,6 +508,17 @@ def main(argv=None) -> int:
     ap.add_argument("--slo", default="",
                     help='startup objectives, e.g. "p50:1.0,p99:5.0" '
                          "(seconds); violated objectives fail the run")
+    ap.add_argument("--sessions", type=int, default=0, metavar="N",
+                    help="drive N simulated agent sessions (register + "
+                         "heartbeats + assignment streams) against the "
+                         "manager's sharded dispatcher plane during the "
+                         "run; simulated nodes are drained so they "
+                         "never receive real placements")
+    ap.add_argument("--shards", type=int, default=None, metavar="P",
+                    help="dispatcher shard count the target manager was "
+                         "started with (swarmd --dispatcher-shards); "
+                         "recorded in the report so a storm run is "
+                         "attributable to its plane configuration")
     args = ap.parse_args(argv)
 
     from ..rpc.client import RPCClient
@@ -409,11 +535,19 @@ def main(argv=None) -> int:
     collector = StartupCollector(service_filter=True)
     stop = threading.Event()
     watch_client = None
+    storm = storm_client = None
     created_ids: list[str] = []
     try:
         if not args.poll:
             watch_client = RPCClient(args.addr, security=sec)
             start_watch_collector(watch_client, collector, stop)
+
+        if args.sessions > 0:
+            # the session storm rides its own connection: stream
+            # back-pressure must not stall the churn driver's RPCs
+            storm_client = RPCClient(args.addr, security=sec)
+            storm = SessionStorm(storm_client, ctl, args.sessions)
+            storm.start(stop)
 
         if args.churn:
             if args.poll:
@@ -489,6 +623,11 @@ def main(argv=None) -> int:
                                   slo_specs=slo_specs)
             report["service"] = svc.id
 
+        if storm is not None:
+            report["session_storm"] = dict(storm.metrics)
+            report["session_storm"]["sessions"] = args.sessions
+            if args.shards is not None:
+                report["session_storm"]["shards"] = args.shards
         print(json.dumps(report))
         ok = report.get("slo", {}).get("ok", True)
         if not args.churn:
@@ -496,6 +635,13 @@ def main(argv=None) -> int:
         return 0 if ok else 1
     finally:
         stop.set()
+        if storm is not None:
+            storm.finish()
+        if storm_client is not None:
+            try:
+                storm_client.close()
+            except Exception:
+                pass
         if not args.keep:
             for sid in created_ids:
                 try:

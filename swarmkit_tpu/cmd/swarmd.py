@@ -88,6 +88,11 @@ def main(argv=None) -> int:
                          "walk, store write-back) on a background "
                          "commit plane overlapping the next wave's "
                          "device dispatch and transfer (ops/commit.py)")
+    ap.add_argument("--dispatcher-shards", type=int, default=None,
+                    metavar="P",
+                    help="dispatcher fan-out shard count (session flush "
+                         "plane + heartbeat wheel slices); default "
+                         "min(4, cores)")
     ap.add_argument("--force-new-cluster", action="store_true",
                     help="disaster recovery: restart as a single-member "
                          "quorum keeping replicated state")
@@ -192,6 +197,7 @@ def main(argv=None) -> int:
         jax_threshold=args.jax_threshold,
         scheduler_pipeline=args.scheduler_pipeline,
         scheduler_async_commit=args.scheduler_async_commit,
+        dispatcher_shards=args.dispatcher_shards,
     )
     try:
         node.start()

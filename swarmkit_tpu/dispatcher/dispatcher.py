@@ -14,12 +14,14 @@ layer (swarmkit_tpu.rpc) carries the same messages across processes.
 from __future__ import annotations
 
 import logging
+import os
 import random
 import threading
 import time
+from collections.abc import Set as _AbstractSet
 from dataclasses import dataclass, field
 
-from ..analysis.lockgraph import make_rlock
+from ..analysis.lockgraph import make_lock, make_rlock
 from ..api.objects import (
     Config,
     EventCommit,
@@ -37,7 +39,7 @@ from ..store.watch import Channel, WatchQueue
 from ..utils import failpoints, lifecycle, trace
 from ..utils.identity import new_id
 from ..utils.metrics import histogram
-from .heartbeat import Heartbeat, HeartbeatWheel
+from .heartbeat import Heartbeat, ShardedHeartbeatWheel, stable_shard
 
 log = logging.getLogger("swarmkit_tpu.dispatcher")
 
@@ -52,7 +54,106 @@ RATE_LIMIT_PERIOD = 8.0              # dispatcher.go:34
 RATE_LIMIT_COUNT = 3                 # nodes.go:14 — registrations per period
 BATCH_INTERVAL = 0.1                 # assignment/status batching, 100ms
 MAX_BATCH_ITEMS = 10000
+# Slow-subscriber bound on the per-session assignments stream (the
+# reference's LimitQueue idea): an agent that stops draining — or, since
+# ISSUE 13, one whose stream moved to a follower read plane while its
+# leader-forwarded heartbeats keep the leader session alive — must shed
+# (Channel closes at the limit; the delivery gate leaves known-state
+# untouched and a reconnect rebuilds from a COMPLETE) instead of growing
+# the leader's queue without bound.
+ASSIGNMENTS_CHANNEL_LIMIT = 4096
 DEFAULT_NODE_DOWN_PERIOD = 24 * 3600.0  # dispatcher.go:48-52 → ORPHANED
+
+
+def default_shard_count() -> int:
+    """Flush-plane shard count when the operator didn't choose one:
+    min(4, cores), floored at 1 (ISSUE 13). Overridable with
+    SWARMKIT_TPU_DISPATCHER_SHARDS (the swarmd --dispatcher-shards
+    plumbing rides the explicit constructor arg instead)."""
+    env = os.environ.get("SWARMKIT_TPU_DISPATCHER_SHARDS", "")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            log.warning("ignoring bad SWARMKIT_TPU_DISPATCHER_SHARDS=%r",
+                        env)
+    return max(1, min(4, os.cpu_count() or 1))
+
+
+@dataclass
+class _Shard:
+    """One slice of the fan-out plane: the shard owns its dirty set (its
+    lock is a leaf — NEVER acquire `dispatcher.lock` while holding it;
+    the global→shard order is the one the lockgraph tier pins) and its
+    heartbeat-jitter RNG stream. The session→shard assignment is
+    `stable_shard(node_id, P)` — identical to the heartbeat wheel's
+    slice assignment, so a shard's sessions, dirt, and liveness ride
+    together."""
+
+    index: int
+    lock: object
+    dirty: set = field(default_factory=set)
+    rng: random.Random = field(default_factory=random.Random)
+
+
+class _DirtyView(_AbstractSet):
+    """Read/write facade presenting the per-shard dirty sets as ONE set
+    (`Dispatcher._dirty_nodes` kept its pre-sharding surface: tests and
+    operators read and occasionally clear it). Mutators route to the
+    owning shard under its lock; set-algebra comes from the Set ABC over
+    a per-call snapshot."""
+
+    __slots__ = ("_disp",)
+
+    def __init__(self, disp: "Dispatcher"):
+        self._disp = disp
+
+    @classmethod
+    def _from_iterable(cls, it):
+        return set(it)
+
+    def _snapshot(self) -> set:
+        out: set = set()
+        for sh in self._disp._shards:
+            with sh.lock:
+                out |= sh.dirty
+        return out
+
+    def __contains__(self, key) -> bool:
+        sh = self._disp._shard_for(key)
+        with sh.lock:
+            return key in sh.dirty
+
+    def __iter__(self):
+        return iter(self._snapshot())
+
+    def __len__(self) -> int:
+        return sum(len(self._snapshot_shard(sh))
+                   for sh in self._disp._shards)
+
+    @staticmethod
+    def _snapshot_shard(sh: _Shard) -> set:
+        with sh.lock:
+            return set(sh.dirty)
+
+    def __repr__(self):
+        return f"_DirtyView({self._snapshot()!r})"
+
+    def add(self, key) -> None:
+        self._disp._mark_dirty(key)
+
+    def update(self, keys) -> None:
+        self._disp._mark_dirty_many(keys)
+
+    def discard(self, key) -> None:
+        sh = self._disp._shard_for(key)
+        with sh.lock:
+            sh.dirty.discard(key)
+
+    def clear(self) -> None:
+        for sh in self._disp._shards:
+            with sh.lock:
+                sh.dirty.clear()
 
 
 class DispatcherError(Exception):
@@ -123,11 +224,18 @@ class RateLimitExceeded(DispatcherError):
 
 
 class Dispatcher:
+    # lifecycle SHIPPED is recorded where delivery is authoritative —
+    # the leader's commit closures; the follower read plane (which
+    # borrows _diff) overrides this to False so a follower-served diff
+    # never double-stamps the SLO leg (docs/dispatcher.md)
+    _record_shipped = True
+
     def __init__(self, store: MemoryStore,
                  heartbeat_period: float = DEFAULT_HEARTBEAT_PERIOD,
                  node_down_period: float = DEFAULT_NODE_DOWN_PERIOD,
                  rate_limit_period: float = RATE_LIMIT_PERIOD,
-                 secret_drivers=None, clock=None):
+                 secret_drivers=None, clock=None,
+                 shards: int | None = None, jitter_seed=None):
         from ..utils.clock import REAL_CLOCK
 
         self.store = store
@@ -137,20 +245,37 @@ class Dispatcher:
         self.node_down_period = node_down_period
         self.rate_limit_period = rate_limit_period
         self._sessions: dict[str, Session] = {}
-        # session liveness rides ONE coarse-bucketed wheel (beat() is a
-        # dict write); the rare timers (leadership grace, orphaning)
-        # keep per-event Heartbeat objects
-        self._hb_wheel = HeartbeatWheel(
+        # --- sharded fan-out plane (ISSUE 13): sessions partition into
+        # P shards by stable_shard(node_id); each shard owns its dirty
+        # set (leaf lock), its heartbeat-wheel slice, and its jitter RNG
+        # stream. shards=None -> min(4, cores) (or the env override).
+        if shards is None:
+            shards = default_shard_count()
+        self.shards = max(1, int(shards))
+        seed_rng = random.Random(jitter_seed)
+        self._shards: list[_Shard] = [
+            _Shard(index=i,
+                   lock=make_lock(f"dispatcher.shard{i}.lock"),
+                   rng=random.Random(seed_rng.getrandbits(64)))
+            for i in range(self.shards)]
+        self._dirty_view = _DirtyView(self)
+        # lazy ThreadPoolExecutor serving multi-shard flushes; None
+        # while single-shard (or before the first parallel flush)
+        self._pool = None
+        # session liveness rides coarse-bucketed wheels, one slice per
+        # shard (beat() is a dict write); the rare timers (leadership
+        # grace, orphaning) keep per-event Heartbeat objects
+        self._hb_wheel = ShardedHeartbeatWheel(
             granularity=self._wheel_granularity(heartbeat_period),
-            clock=self.clock)
+            clock=self.clock, shards=self.shards)
         self._lock = make_rlock('dispatcher.lock')
+        self._metrics_lock = make_lock('dispatcher.metrics')
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         # (task_id, status, reporting node_id)
         self._status_queue: list[tuple[str, object, str]] = []
         self._status_cond = threading.Condition(
             make_rlock("dispatcher.status_cond"))
-        self._dirty_nodes: set[str] = set()
         self._unknown_timers: dict[str, Heartbeat] = {}
         # node id -> (attempts, window start) for registration rate limiting
         self._reg_attempts: dict[str, tuple[int, float]] = {}
@@ -177,10 +302,13 @@ class Dispatcher:
         # secret/config id -> node ids whose session was SHIPPED it
         self._secret_refs: dict[str, set[str]] = {}
         self._config_refs: dict[str, set[str]] = {}
-        # single-writer counters (flush thread / RPC threads); the
-        # op-count regression guard and bench storm sub-row read these
+        # counters the op-count regression guard and bench storm
+        # sub-rows read. flushes/flush_tx/dirty_walks/last_flush_s are
+        # flush-thread-only; ships/wire_copies may be bumped from shard
+        # workers and RPC threads and go through _bump (one leaf lock —
+        # `+=` on a dict value is not atomic across threads)
         self.metrics = {"flushes": 0, "flush_tx": 0, "wire_copies": 0,
-                        "ships": 0, "last_flush_s": 0.0}
+                        "ships": 0, "dirty_walks": 0, "last_flush_s": 0.0}
 
     # ------------------------------------------------------------- lifecycle
     @staticmethod
@@ -189,6 +317,43 @@ class Dispatcher:
         heartbeat epsilon's design slack, and ≤ period/2 so tiny test
         periods still get several ticks inside their grace window."""
         return min(HEARTBEAT_EPSILON, max(period / 2.0, 0.01))
+
+    # --------------------------------------------------------- shard plane
+    def _shard_for(self, node_id: str) -> _Shard:
+        return self._shards[stable_shard(node_id, self.shards)]
+
+    def _mark_dirty(self, node_id: str) -> None:
+        """Route a dirty node to its shard. Shard locks are LEAVES:
+        legal under self._lock (global→shard is the pinned order), never
+        the other way around."""
+        sh = self._shard_for(node_id)
+        with sh.lock:
+            sh.dirty.add(node_id)
+
+    def _mark_dirty_many(self, node_ids) -> None:
+        if self.shards == 1:
+            sh = self._shards[0]
+            with sh.lock:
+                sh.dirty.update(node_ids)
+            return
+        by_shard: dict[int, list] = {}
+        for nid in node_ids:
+            by_shard.setdefault(stable_shard(nid, self.shards),
+                                []).append(nid)
+        for idx, ids in by_shard.items():
+            sh = self._shards[idx]
+            with sh.lock:
+                sh.dirty.update(ids)
+
+    @property
+    def _dirty_nodes(self) -> _DirtyView:
+        """The union of the per-shard dirty sets, as a set-like view
+        (pre-sharding surface: tests/operators read and clear it)."""
+        return self._dirty_view
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._metrics_lock:
+            self.metrics[key] += n
 
     def start(self):
         # restartable across leadership cycles (manager.go recreates the
@@ -202,9 +367,9 @@ class Dispatcher:
             # _sessions and re-armed here) or wholly after (it adds to
             # the fresh wheel itself).
             self._hb_wheel.stop()
-            self._hb_wheel = HeartbeatWheel(
+            self._hb_wheel = ShardedHeartbeatWheel(
                 granularity=self._wheel_granularity(self.heartbeat_period),
-                clock=self.clock)
+                clock=self.clock, shards=self.shards)
             grace = self.heartbeat_period * GRACE_MULTIPLIER
             for s in self._sessions.values():
                 # sessions that registered before/through the restart
@@ -228,6 +393,10 @@ class Dispatcher:
         if self._thread:
             self._thread.join(timeout=5)
         self._hb_wheel.stop()
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            # the flush thread joined above: no serve is in flight
+            pool.shutdown(wait=True)
         with self._lock:
             for s in self._sessions.values():
                 s.channel.close()
@@ -236,6 +405,9 @@ class Dispatcher:
                 if s.tasks_channel is not None:
                     s.tasks_channel.close()
             self._sessions.clear()
+            for sh in self._shards:
+                with sh.lock:
+                    sh.dirty.clear()
             self._secret_refs.clear()
             self._config_refs.clear()
             self._clone_bases.clear()
@@ -397,7 +569,7 @@ class Dispatcher:
         session = Session(
             node_id=node_id,
             session_id=session_id,
-            channel=Channel(matcher=None, limit=None),
+            channel=Channel(matcher=None, limit=ASSIGNMENTS_CHANNEL_LIMIT),
         )
         with self._lock:
             old = self._sessions.pop(node_id, None)
@@ -409,7 +581,7 @@ class Dispatcher:
                 if old.tasks_channel is not None:
                     old.tasks_channel.close()
             self._sessions[node_id] = session
-            self._dirty_nodes.add(node_id)
+            self._mark_dirty(node_id)
             pending = self._unknown_timers.pop(node_id, None)
             orphan = self._orphan_timers.pop(node_id, None)
             # wheel entry keyed by node, armed INSIDE the session-swap
@@ -429,7 +601,7 @@ class Dispatcher:
             orphan.stop()   # the node came back before the orphan window
         return session_id
 
-    def _jittered_period(self) -> float:
+    def _jittered_period(self, node_id: str | None = None) -> float:
         """period − uniform(0, ε) per beat (VERDICT item 6; reference
         DefaultHeartBeatEpsilon, dispatcher.go:29-33): 10k nodes
         registered in a burst would otherwise beat in phase forever.
@@ -437,10 +609,19 @@ class Dispatcher:
         (full period × multiplier) keeps its margin; reading
         self.heartbeat_period per call keeps live reconfig applying.
         ε is floored to half the period so tiny test periods stay
-        positive."""
+        positive.
+
+        ISSUE 13: the draw comes from the node's SHARD rng stream, not
+        the process-global module RNG — each wheel slice disperses its
+        own beats independently, so a shard rebuild (restart or
+        re-register burst) cannot phase-align one shard's sessions into
+        a single wheel bucket, and a seeded dispatcher (jitter_seed)
+        replays deterministic per-shard schedules in tests."""
         period = self.heartbeat_period
-        return period - random.uniform(0.0, min(HEARTBEAT_EPSILON,
-                                                period / 2))
+        rng = (self._shard_for(node_id).rng if node_id is not None
+               else self._shards[0].rng)
+        return period - rng.uniform(0.0, min(HEARTBEAT_EPSILON,
+                                             period / 2))
 
     def heartbeat(self, node_id: str, session_id: str) -> float:
         """reference: dispatcher.go:1317-1335. The grace window re-arms
@@ -464,7 +645,7 @@ class Dispatcher:
                     self._hb_wheel.add(
                         node_id, grace,
                         lambda: self._node_down(node_id, session_id))
-        return self._jittered_period()
+        return self._jittered_period(node_id)
 
     def assignments(self, node_id: str, session_id: str) -> Channel:
         """Subscribe to this node's assignment stream; the initial COMPLETE
@@ -799,12 +980,10 @@ class Dispatcher:
                                 if k[2] == obj.id]:
                         del self._driver_cache[key]
             if obj.node_id:
-                with self._lock:
-                    self._dirty_nodes.add(obj.node_id)
+                self._mark_dirty(obj.node_id)
             if isinstance(ev, EventUpdate) and ev.old is not None \
                     and ev.old.node_id and ev.old.node_id != obj.node_id:
-                with self._lock:
-                    self._dirty_nodes.add(ev.old.node_id)
+                self._mark_dirty(ev.old.node_id)
         elif isinstance(obj, Secret):
             # only sessions that were shipped this secret care about its
             # change; fresh references always arrive via a task event,
@@ -818,12 +997,12 @@ class Dispatcher:
                     for key in [k for k in self._driver_cache
                                 if k[0] == obj.id]:
                         del self._driver_cache[key]
-                self._dirty_nodes.update(
+                self._mark_dirty_many(
                     self._secret_refs.get(obj.id, set())
                     & self._sessions.keys())
         elif isinstance(obj, Config):
             with self._lock:
-                self._dirty_nodes.update(
+                self._mark_dirty_many(
                     self._config_refs.get(obj.id, set())
                     & self._sessions.keys())
         else:
@@ -845,7 +1024,7 @@ class Dispatcher:
                     # about the removal
                     touched |= {s.node_id for s in old.publish_status}
                 with self._lock:
-                    self._dirty_nodes.update(
+                    self._mark_dirty_many(
                         touched & set(self._sessions.keys()))
                     # the index resyncs from EVERY volume event (new
                     # pending set replaces the old wholesale), so a
@@ -1186,7 +1365,7 @@ class Dispatcher:
         """Wire copy, made ONLY at ship time; driver-backed secret
         references rewrite to this task's clone ids (the clone belongs
         to exactly one task — assignments.go:51-81)."""
-        self.metrics["wire_copies"] += 1
+        self._bump("wire_copies")
         c = t.copy()
         runtime = c.spec.runtime
         if clone_ids and runtime is not None:
@@ -1197,7 +1376,7 @@ class Dispatcher:
         return c
 
     def _ship(self, obj):
-        self.metrics["wire_copies"] += 1
+        self._bump("wire_copies")
         return obj.copy()
 
     def _full_assignment(self, session: Session) -> AssignmentsMessage:
@@ -1217,7 +1396,7 @@ class Dispatcher:
             + [Assignment("remove", "volume", va)
                for vid, va in unpublish.items() if vid not in volumes]
         )
-        self.metrics["ships"] += len(changes)
+        self._bump("ships", len(changes))
         self._commit_known(
             session,
             {t.id: t.meta.version.index for t in tasks},
@@ -1251,15 +1430,28 @@ class Dispatcher:
         """THE fan-out hot path: ONE consistent store snapshot serves
         every dirty session's incremental diff (and its legacy
         tasks_channel snapshot) — group-commit applied to the control
-        plane, replacing 2 transactions per dirty node per interval. A
-        crash at any point re-dirties the unserved sessions so the next
-        interval retries; served sessions already committed their
+        plane, replacing 2 transactions per dirty node per interval.
+
+        ISSUE 13 sharding: the snapshot stays GLOBAL (1 view-tx per
+        flush, shared read-only across shards — store objects are
+        immutable), while the serve half runs per shard (≤1 dirty-walk
+        per shard per flush) on a small worker pool when more than one
+        shard has work. Each shard's known-state commits merge under ONE
+        short `dispatcher.lock` hold (_serve_shard), keeping the
+        reverse-index writes serialized without per-session lock churn.
+
+        A crash at any point re-dirties the unserved sessions so the
+        next interval retries; served sessions already committed their
         known-state and are NOT replayed."""
+        shard_batches: list[list[Session]] = []
         with self._lock:
-            dirty = self._dirty_nodes
-            self._dirty_nodes = set()
-            sessions = [self._sessions[n] for n in dirty
-                        if n in self._sessions]
+            for sh in self._shards:
+                with sh.lock:
+                    dirty, sh.dirty = sh.dirty, set()
+                shard_batches.append([self._sessions[n]
+                                      for n in sorted(dirty)
+                                      if n in self._sessions])
+        sessions = [s for batch in shard_batches for s in batch]
         if not sessions:
             return
         start = time.monotonic()
@@ -1285,7 +1477,7 @@ class Dispatcher:
                                               driver_refs),
                               driver_refs))
 
-        served: set = set()
+        out_sets: list[set] = []
         try:
             # failpoint `dispatcher.flush`: the flush dies before the
             # snapshot — the dirty set must survive for the retry
@@ -1296,17 +1488,37 @@ class Dispatcher:
                 trace.rec("dispatcher.flush.snapshot",
                           time.perf_counter() - t0, parent=sp)
                 t0 = time.perf_counter()
-            for session, view, driver_refs in views:
-                self._serve_session(session, view, driver_refs)
-                served.add(session.node_id)
+            # regroup the flat view list back into shard batches (the
+            # view walked sessions in shard order)
+            it = iter(views)
+            work = [batch for batch in
+                    ([next(it) for _ in b] for b in shard_batches)
+                    if batch]
+            self.metrics["dirty_walks"] += len(work)
+            out_sets = [set() for _ in work]
+            if len(work) <= 1:
+                for batch, served in zip(work, out_sets):
+                    self._serve_shard(batch, served)
+            else:
+                futs = [self._serve_pool().submit(self._serve_shard,
+                                                  batch, served)
+                        for batch, served in zip(work, out_sets)]
+                errs = []
+                for f in futs:
+                    try:
+                        f.result()
+                    except Exception as e:       # noqa: PERF203
+                        errs.append(e)
+                if errs:
+                    raise errs[0]
             if sp is not None:
                 trace.rec("dispatcher.flush.serve",
                           time.perf_counter() - t0, parent=sp,
-                          served=len(served))
+                          served=sum(len(s) for s in out_sets))
         except Exception as exc:
-            with self._lock:
-                self._dirty_nodes.update(
-                    s.node_id for s in sessions if s.node_id not in served)
+            served = set().union(*out_sets) if out_sets else set()
+            self._mark_dirty_many(
+                s.node_id for s in sessions if s.node_id not in served)
             if sp is not None:
                 # the forensics tail must show this flush FAILED, like
                 # every other instrumented plane does on exception
@@ -1315,10 +1527,48 @@ class Dispatcher:
         finally:
             self.metrics["last_flush_s"] = time.monotonic() - start
             if sp is not None:
-                sp.end(served=len(served))
+                sp.end(served=sum(len(s) for s in out_sets))
+
+    def _serve_pool(self):
+        """Lazy worker pool for multi-shard serves (only flushes where
+        ≥2 shards have work ever reach it; single-shard dispatchers and
+        single-shard flushes stay inline on the flush thread)."""
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.shards,
+                thread_name_prefix="dispatcher-shard")
+        return self._pool
+
+    def _serve_shard(self, batch: list, served: set):
+        """Serve one shard's slice of the flush: offer every session's
+        diff, then merge the shard's known-state commits under ONE
+        `dispatcher.lock` hold (the reverse reference maps stay global;
+        the per-shard batch keeps the hold short and once-per-shard
+        instead of once-per-session). `served` is an out-param so a
+        mid-shard crash still reports the sessions whose offers landed —
+        their commits run in the finally, because their agents DID see
+        the message."""
+        commits: list = []
+        try:
+            for session, view, driver_refs in batch:
+                commit = self._serve_session(session, view, driver_refs)
+                if commit is not None:
+                    commits.append(commit)
+                served.add(session.node_id)
+        finally:
+            if commits:
+                with self._lock:
+                    for commit in commits:
+                        commit()
 
     def _serve_session(self, session: Session, view: tuple,
                        driver_refs: list):
+        """Build + offer one session's diff; returns the known-state
+        commit closure when the message was delivered (the caller merges
+        a whole shard's commits under one lock hold), None when the
+        channel shed it."""
         tasks, secrets, configs, volumes, unpublish = view
         clone_ids, ship_bases = self._materialize_clones(
             session, secrets, driver_refs)
@@ -1326,10 +1576,8 @@ class Dispatcher:
                                  volumes, unpublish, clone_ids, ship_bases)
         delivered = True
         if msg.changes:
-            self.metrics["ships"] += len(msg.changes)
+            self._bump("ships", len(msg.changes))
             delivered = session.channel._offer(msg)
-        if delivered:
-            commit()
         # a closed channel (slow subscriber shed / racing disconnect)
         # must NOT advance known-state: the agent never saw this diff,
         # and a reconnect diffing from advanced state would miss
@@ -1340,6 +1588,7 @@ class Dispatcher:
             # pre-Assignments protocol never carried secrets)
             session.tasks_channel._offer(
                 [self._ship_task(t, {}) for t in tasks])
+        return commit if delivered else None
 
     def _diff(self, session: Session, tasks, secrets, configs, volumes,
               unpublish, clone_ids, ship_bases=None):
@@ -1393,7 +1642,7 @@ class Dispatcher:
             self._commit_known(session, new_tasks, new_secrets,
                                new_configs, set(volumes), sequence,
                                ship_bases)
-            if lifecycle.enabled():
+            if self._record_shipped and lifecycle.enabled():
                 # lifecycle plane: the SHIPPED leg, one batched record
                 # per delivered diff (commit runs only once the agent
                 # actually received the message). Only the FIRST ship

@@ -1,0 +1,379 @@
+"""Lease-gated follower read plane (ISSUE 13).
+
+Every agent session used to terminate on the raft leader; after the
+scheduler/store ceilings fell (PRs 6/7/11), the one-leader fan-out was
+the remaining serving ceiling. This plane lets a NON-leader manager
+serve the read half of the worker protocol — Assignments/Tasks session
+streams — from its own raft-replicated store, gated by the leader's
+piggybacked read lease (raft/node.py `read_ok`; Raft dissertation §6.4
+lease reads):
+
+  * a follower serves a snapshot **no older than the leader's commit
+    index at lease grant** and **only while the skew-discounted lease is
+    live** — bounded-staleness reads, not linearizability;
+  * the moment the lease dies (partition, leader loss, apply lag) the
+    plane BOUNCES (`FollowerReadUnavailable` → the RPC layer's
+    NotLeaderError redirect) and its incremental flushes hold; it never
+    offers a message while stale past the bound;
+  * status write-back (`update_task_status`) stays leader-only — the
+    per-task node-ownership/de-dup contract in api/specs.py is untouched.
+
+The snapshot/build machinery is LITERALLY the leader Dispatcher's: the
+class aliases `_node_view` / `_diff` / `_commit_known` and their helpers
+(see the class body), so the two serve paths cannot drift in what they
+read or how they diff. The serve PROTOCOL around those shared calls
+(snapshot → build → materialize → diff → offer → commit) lives in two
+implementations — Dispatcher and this class — registered as the
+`dispatcher-serve` mirror pair in analysis/mirror.py, which fails
+tier-1 on a one-sided change.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from ..analysis.lockgraph import make_lock, make_rlock
+from ..api.objects import Config, Secret, Task, Volume
+from ..store.watch import Channel
+from .dispatcher import (
+    ASSIGNMENTS_CHANNEL_LIMIT,
+    BATCH_INTERVAL,
+    Assignment,
+    AssignmentsMessage,
+    Dispatcher,
+    DispatcherError,
+    Session,
+)
+
+log = logging.getLogger("swarmkit_tpu.dispatcher.follower")
+
+
+class FollowerReadUnavailable(DispatcherError):
+    """This manager may not serve reads right now: it is not the leader
+    and holds no live read lease (or has not applied the lease's commit
+    index). The RPC layer translates this into its NotLeaderError so
+    agents redirect to the leader."""
+
+
+class FollowerReadPlane:
+    """Read-only assignment serving on a non-leader manager.
+
+    Per-node read sessions hold the same `Session` known-state the
+    leader keeps, diffed by the same code; there is no registration, no
+    liveness wheel, and no write-back — a read session's identity is the
+    TLS-authenticated node id (the RPC layer enforces it), and a session
+    id is deliberately absent (leader session ids name leader-side
+    liveness state this plane does not have)."""
+
+    # SLO legs are recorded where delivery is authoritative, on the
+    # leader — the borrowed _diff's commit closure checks this flag, so
+    # follower-served diffs never double-stamp SHIPPED (matching
+    # _full_assignment below, which omits the leg for the same reason)
+    _record_shipped = False
+
+    def __init__(self, store, raft_node, secret_drivers=None, clock=None):
+        from ..utils.clock import REAL_CLOCK
+
+        self.store = store
+        self.raft = raft_node
+        self.secret_drivers = secret_drivers
+        self.clock = clock or REAL_CLOCK
+        self._lock = make_rlock("dispatcher.follower.lock")
+        self._metrics_lock = make_lock("dispatcher.follower.metrics")
+        self._sessions: dict[str, Session] = {}
+        self._dirty: set[str] = set()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # state the borrowed Dispatcher helpers read: the driver-clone
+        # cache pair, the reverse reference maps _commit_known maintains,
+        # and the (never-primed here) volume index — _pending_unpublish
+        # takes its scan fallback on this plane
+        self._driver_cache: dict[tuple, object] = {}
+        self._clone_bases: dict[str, str] = {}
+        self._secret_refs: dict[str, set[str]] = {}
+        self._config_refs: dict[str, set[str]] = {}
+        self._vol_index_primed = False
+        self._vol_pending_unpub: dict[str, frozenset] = {}
+        self.metrics = {"reads_served": 0, "reads_bounced": 0,
+                        "flushes": 0, "flush_tx": 0, "held_flushes": 0,
+                        "ships": 0, "wire_copies": 0}
+
+    # ---- the shared snapshot/build vocabulary: the leader's own code.
+    # These CANNOT drift from the Dispatcher — they are the same
+    # function objects; the mirror pair pins the serve protocol AROUND
+    # them (the methods defined below).
+    _relevant_tasks = Dispatcher._relevant_tasks
+    _volume_assignment = staticmethod(Dispatcher._volume_assignment)
+    _referenced_deps = Dispatcher._referenced_deps
+    _pending_unpublish = Dispatcher._pending_unpublish
+    _node_view = Dispatcher._node_view
+    _materialize_driver_secret = Dispatcher._materialize_driver_secret
+    _materialize_clones = Dispatcher._materialize_clones
+    _ship_task = Dispatcher._ship_task
+    _ship = Dispatcher._ship
+    _diff = Dispatcher._diff
+    _commit_known = Dispatcher._commit_known
+    _drop_session_refs = Dispatcher._drop_session_refs
+    _bump = Dispatcher._bump
+
+    # ------------------------------------------------------------ lease gate
+    def read_ok(self) -> bool:
+        """May this manager serve reads right now? Standalone (no raft)
+        managers always may; raft-backed ones defer to the node's
+        leader-or-live-lease verdict."""
+        node = self.raft
+        return node is None or node.read_ok()
+
+    def _require_lease(self):
+        if not self.read_ok():
+            self._bump("reads_bounced")
+            raise FollowerReadUnavailable(
+                "not the leader and no live read lease; redirect to the "
+                "leader")
+
+    # ------------------------------------------------------------------- rpc
+    def assignments(self, node_id: str) -> Channel:
+        """Subscribe this node's lease-gated read stream: an immediate
+        COMPLETE snapshot, then incremental diffs while the lease stays
+        live (the same message shapes the leader serves)."""
+        self._require_lease()
+        session = Session(
+            node_id=node_id, session_id="",
+            channel=Channel(matcher=None,
+                            limit=ASSIGNMENTS_CHANNEL_LIMIT))
+        with self._lock:
+            old = self._sessions.pop(node_id, None)
+            if old is not None:
+                self._drop_session_refs(old)
+                old.channel.close()
+                if old.tasks_channel is not None:
+                    old.tasks_channel.close()
+            self._sessions[node_id] = session
+            msg = self._full_assignment(session)
+            session.channel._offer(msg)
+        self._bump("reads_served")
+        return session.channel
+
+    def tasks(self, node_id: str) -> Channel:
+        """Lease-gated legacy Dispatcher.Tasks stream (wire parity with
+        the leader's `tasks`)."""
+        self._require_lease()
+        with self._lock:
+            session = self._sessions.get(node_id)
+            if session is None:
+                session = Session(
+                    node_id=node_id, session_id="",
+                    channel=Channel(matcher=None,
+                                    limit=ASSIGNMENTS_CHANNEL_LIMIT))
+                self._sessions[node_id] = session
+            if session.tasks_channel is None:
+                session.tasks_channel = Channel(matcher=None, limit=256)
+            snapshot = self.store.view(
+                lambda tx: [t.copy()
+                            for t in self._relevant_tasks(tx, node_id)])
+            session.tasks_channel._offer(snapshot)
+        self._bump("reads_served")
+        return session.tasks_channel
+
+    # --------------------------------------------------------------- serving
+    def _full_assignment(self, session: Session) -> AssignmentsMessage:
+        """COMPLETE snapshot for a fresh read session — the follower
+        mirror of Dispatcher._full_assignment (pair `dispatcher-serve`),
+        minus the lifecycle SHIPPED leg: SLO legs are recorded where
+        delivery is authoritative, on the leader."""
+        driver_refs: list = []
+        tasks, secrets, configs, volumes, unpublish = self.store.view(
+            lambda tx: self._node_view(tx, session.node_id, driver_refs))
+        clone_ids, ship_bases = self._materialize_clones(
+            session, secrets, driver_refs)
+        changes = (
+            [Assignment("update", "task", self._ship_task(t, clone_ids))
+             for t in tasks]
+            + [Assignment("update", "secret", self._ship(s))
+               for s in secrets.values()]
+            + [Assignment("update", "config", self._ship(c))
+               for c in configs.values()]
+            + [Assignment("update", "volume", v) for v in volumes.values()]
+            + [Assignment("remove", "volume", va)
+               for vid, va in unpublish.items() if vid not in volumes]
+        )
+        self._bump("ships", len(changes))
+        self._commit_known(
+            session,
+            {t.id: t.meta.version.index for t in tasks},
+            {sid: s.meta.version.index for sid, s in secrets.items()},
+            {cid: c.meta.version.index for cid, c in configs.items()},
+            set(volumes), session.sequence + 1, ship_bases)
+        return AssignmentsMessage("complete", session.sequence, changes)
+
+    def _send_incrementals(self):
+        """Flush the dirty read sessions — the follower mirror of the
+        leader's flush: the lease gate runs FIRST (a dead lease holds
+        the whole flush: nothing may be offered while the plane could be
+        stale past the bound; dirt is kept for when the lease returns),
+        then ONE store view builds every dirty session's node view, then
+        each session is diffed/offered/committed in turn."""
+        if not self.read_ok():
+            with self._lock:
+                if self._dirty:
+                    self.metrics["held_flushes"] += 1
+            return
+        with self._lock:
+            dirty, self._dirty = self._dirty, set()
+            sessions = [self._sessions[n] for n in sorted(dirty)
+                        if n in self._sessions]
+        if not sessions:
+            return
+        self.metrics["flushes"] += 1
+        views: list[tuple[Session, tuple, list]] = []
+
+        def cb(tx):
+            self.metrics["flush_tx"] += 1
+            for session in sessions:
+                driver_refs: list = []
+                views.append((session,
+                              self._node_view(tx, session.node_id,
+                                              driver_refs),
+                              driver_refs))
+
+        served: set = set()
+        try:
+            self.store.view(cb)
+            for session, view, driver_refs in views:
+                self._serve_session(session, view, driver_refs)
+                served.add(session.node_id)
+        except Exception:
+            with self._lock:
+                self._dirty.update(s.node_id for s in sessions
+                                   if s.node_id not in served)
+            raise
+
+    def _serve_session(self, session: Session, view: tuple,
+                       driver_refs: list):
+        """Diff + offer + commit one read session (the follower mirror
+        of the leader's _serve_session; single-threaded plane, so the
+        commit runs inline). A closed channel retires the session — the
+        agent went away or moved to the leader."""
+        tasks, secrets, configs, volumes, unpublish = view
+        clone_ids, ship_bases = self._materialize_clones(
+            session, secrets, driver_refs)
+        msg, commit = self._diff(session, tasks, secrets, configs,
+                                 volumes, unpublish, clone_ids, ship_bases)
+        delivered = True
+        if msg.changes:
+            self._bump("ships", len(msg.changes))
+            delivered = session.channel._offer(msg)
+        if delivered:
+            commit()
+        elif session.channel.closed:
+            with self._lock:
+                if self._sessions.get(session.node_id) is session:
+                    self._sessions.pop(session.node_id)
+                    self._drop_session_refs(session)
+            # close the session's OTHER stream too: a tasks()-only
+            # subscriber whose (undrained) assignments channel shed must
+            # see its legacy stream CLOSE — a silent stall would never
+            # trigger the agent's resubscribe
+            session.channel.close()
+            if session.tasks_channel is not None:
+                session.tasks_channel.close()
+            return
+        if session.tasks_channel is not None \
+                and not session.tasks_channel.closed:
+            session.tasks_channel._offer(
+                [self._ship_task(t, {}) for t in tasks])
+
+    # ------------------------------------------------------------ event plane
+    def _note_event(self, ev):
+        from ..api.objects import EventDelete
+
+        obj = getattr(ev, "obj", None)
+        with self._lock:
+            live = self._sessions.keys()
+            if isinstance(obj, Task):
+                if isinstance(ev, EventDelete):
+                    # the leader's purge, mirrored: a deleted task's
+                    # driver-secret clones must not accrete (the
+                    # per-version purge in _materialize_driver_secret
+                    # never fires for deleted objects)
+                    for key in [k for k in self._driver_cache
+                                if k[2] == obj.id]:
+                        del self._driver_cache[key]
+                if obj.node_id and obj.node_id in live:
+                    self._dirty.add(obj.node_id)
+                old = getattr(ev, "old", None)
+                if old is not None and old.node_id \
+                        and old.node_id != obj.node_id \
+                        and old.node_id in live:
+                    self._dirty.add(old.node_id)
+            elif isinstance(obj, Secret):
+                if isinstance(ev, EventDelete):
+                    for key in [k for k in self._driver_cache
+                                if k[0] == obj.id]:
+                        del self._driver_cache[key]
+                self._dirty.update(
+                    self._secret_refs.get(obj.id, set()) & live)
+            elif isinstance(obj, Config):
+                self._dirty.update(
+                    self._config_refs.get(obj.id, set()) & live)
+            elif isinstance(obj, Volume):
+                touched = {st.node_id for st in obj.publish_status}
+                old = getattr(ev, "old", None)
+                if old is not None:
+                    touched |= {st.node_id for st in old.publish_status}
+                self._dirty.update(touched & live)
+
+    def start(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="dispatcher-follower")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        with self._lock:
+            for s in self._sessions.values():
+                s.channel.close()
+                if s.tasks_channel is not None:
+                    s.tasks_channel.close()
+            self._sessions.clear()
+            self._secret_refs.clear()
+            self._config_refs.clear()
+            self._clone_bases.clear()
+            self._dirty.clear()
+
+    def _run(self):
+        kinds = frozenset(("task", "secret", "config", "volume"))
+
+        def matcher(ev, _kinds=kinds):
+            obj = getattr(ev, "obj", None)
+            return obj is not None and obj.TABLE in _kinds
+
+        _, ch = self.store.view_and_watch(lambda tx: None,
+                                          matcher=matcher, limit=None)
+        last_flush = time.monotonic()
+        try:
+            while not self._stop.is_set():
+                try:
+                    ev = ch.get(timeout=BATCH_INTERVAL / 2)
+                except TimeoutError:
+                    ev = None
+                except Exception:
+                    return
+                if ev is not None:
+                    self._note_event(ev)
+                now = time.monotonic()
+                if now - last_flush >= BATCH_INTERVAL:
+                    try:
+                        self._send_incrementals()
+                    except Exception:
+                        log.warning("follower read flush failed; dirty "
+                                    "sessions retained for retry",
+                                    exc_info=True)
+                    last_flush = now
+        finally:
+            self.store.queue.stop_watch(ch)

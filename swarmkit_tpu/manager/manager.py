@@ -67,6 +67,7 @@ class Manager:
         jax_threshold: int | None = None,
         scheduler_pipeline: bool = False,
         scheduler_async_commit: bool = False,
+        dispatcher_shards: int | None = None,
         clock=None,
     ):
         self.store = store if store is not None else MemoryStore()
@@ -100,6 +101,7 @@ class Manager:
         self.dispatcher = Dispatcher(self.store,
                                      heartbeat_period=heartbeat_period,
                                      secret_drivers=secret_drivers,
+                                     shards=dispatcher_shards,
                                      clock=clock)
         self.log_broker = LogBroker(self.store)
         self.resource_api = ResourceAllocator(self.store)

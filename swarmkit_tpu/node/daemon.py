@@ -238,6 +238,7 @@ class SwarmNode:
         jax_threshold: int | None = None,
         scheduler_pipeline: bool = False,
         scheduler_async_commit: bool = False,
+        dispatcher_shards: int | None = None,
         clock=None,
     ):
         self.state_dir = state_dir
@@ -266,6 +267,7 @@ class SwarmNode:
         self.jax_threshold = jax_threshold
         self.scheduler_pipeline = scheduler_pipeline
         self.scheduler_async_commit = scheduler_async_commit
+        self.dispatcher_shards = dispatcher_shards
         from ..utils.clock import REAL_CLOCK
         self.clock = clock or REAL_CLOCK
         self._identity_lock = make_lock('node.daemon.identity_lock')
@@ -281,6 +283,7 @@ class SwarmNode:
         self.raft_id: int | None = None
 
         self._transport: NetworkTransport | None = None
+        self._follower_reads = None
         self._ticker: _Ticker | None = None
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -590,6 +593,9 @@ class SwarmNode:
             self._control_server = None
         if self.manager is not None:
             self.manager.stop()
+        if self._follower_reads is not None:
+            self._follower_reads.stop()
+            self._follower_reads = None
         if self._ticker is not None:
             self._ticker.stop()
         if self.raft is not None:
@@ -684,6 +690,12 @@ class SwarmNode:
             election_tick=self.election_tick,
             rng=random.Random(),
             auto_recover=False,
+            # read lease (ISSUE 13): the grant must stay BELOW the
+            # vote-withholding window (election_tick ticks) that makes
+            # it sound; 75% leaves margin for tick-delivery jitter, and
+            # the follower discounts a further skew margin on receipt
+            lease_duration=self.tick_interval * self.election_tick * 0.75,
+            clock=self.clock,
         )
         transport.set_node(raft)
         self._transport = transport
@@ -744,11 +756,21 @@ class SwarmNode:
             jax_threshold=self.jax_threshold,
             scheduler_pipeline=self.scheduler_pipeline,
             scheduler_async_commit=self.scheduler_async_commit,
+            dispatcher_shards=self.dispatcher_shards,
             clock=self.clock,
         )
+        # lease-gated follower read plane (ISSUE 13): this manager can
+        # serve Assignments/Tasks/watch READS from its replicated store
+        # while it holds the leader's read lease; writes still forward
+        from ..dispatcher.follower import FollowerReadPlane
+
+        self._follower_reads = FollowerReadPlane(
+            self.store, raft, clock=self.clock)
+        self._follower_reads.start()
         build_manager_registry(self.manager, raft,
                                LeaderConns(raft, self.security),
-                               registry=registry)
+                               registry=registry,
+                               follower_reads=self._follower_reads)
 
         self.server.start()
         t = threading.Thread(target=self._watch_kek_loop, daemon=True,
@@ -1178,6 +1200,14 @@ class SwarmNode:
             if self.manager is not None:
                 self.manager.stop()
                 self.manager = None
+            if self._follower_reads is not None:
+                # the read plane dies with the manager stack: a demoted
+                # node's store stops replicating, so lease-gated reads
+                # from it would go stale the moment the lease lapses —
+                # and a re-promotion builds a fresh plane on the new
+                # store (_start_manager)
+                self._follower_reads.stop()
+                self._follower_reads = None
             if self._ticker is not None:
                 self._ticker.stop()
                 self._ticker = None

@@ -90,6 +90,15 @@ class AppendEntries(Message):
     prev_log_term: int = 0
     entries: list[Entry] = field(default_factory=list)
     leader_commit: int = 0
+    # read-lease grant (ISSUE 13, Raft dissertation §6.4 lease reads):
+    # seconds of read lease the leader extends with this append. A
+    # follower holding a live lease may serve reads from a snapshot no
+    # older than `leader_commit` (rpc/services.py routes streams there);
+    # 0.0 = no grant (lease disabled, or sender not a signalled leader).
+    # A RELATIVE ttl, never an absolute deadline: clocks are unsynced
+    # across nodes — only bounded drift RATE is assumed, and the
+    # follower additionally subtracts a skew margin (raft/node.py).
+    lease_ttl: float = 0.0
     kind: str = "append"
 
 

@@ -73,6 +73,13 @@ SNAPSHOT_RESEND_TICKS = 50
 # daemon's 0.2 s tick this is one minute.
 TRANSFER_MIN_TICKS = 300
 
+# Read-lease clock-skew margin (ISSUE 13): a follower discounts every
+# lease grant by this fraction before trusting it, so bounded clock-RATE
+# drift between leader and follower cannot stretch a lease past the
+# leader's guarantee window. 10% covers drift orders of magnitude worse
+# than real hardware exhibits over a ~1 s lease.
+READ_LEASE_SKEW = 0.1
+
 
 class NotLeader(Exception):
     def __init__(self, leader_id: int | None, leader_addr: str | None = None):
@@ -107,6 +114,8 @@ class RaftNode:
         snapshot_interval: int = 1000,
         rng: random.Random | None = None,
         auto_recover: bool = True,
+        lease_duration: float = 0.0,
+        clock=None,
     ):
         self.id = raft_id
         self.transport = transport
@@ -166,6 +175,40 @@ class RaftNode:
         self.check_quorum = True
         self._quorum_elapsed = 0
         self._recent_active: set[int] = set()
+
+        # Read lease (ISSUE 13; Raft dissertation §6.4 lease/ReadIndex):
+        # the leader piggybacks `lease_duration` seconds of read lease +
+        # its commit index on every AppendEntries; a follower may serve
+        # BOUNDED-STALENESS reads (snapshot no older than that commit
+        # index) while its discounted lease is live. Soundness rides two
+        # legs: the CheckQuorum vote-withholding half (followers that
+        # heard this leader within election_tick ignore campaigns — the
+        # operator, i.e. the daemon wiring, must keep lease_duration
+        # BELOW election_tick × tick_interval), and QUORUM-ANCHORED
+        # granting (_lease_ttl: grants shrink as the leader's last
+        # observed quorum contact ages, so a minority-partitioned
+        # leader stops extending leases at once instead of until its
+        # CheckQuorum step-down). 0.0 disables granting entirely.
+        # Leader-side state is worker-thread-only; the follower-side
+        # triple below is written by the worker and read lock-free by
+        # RPC threads (plain floats/ints under the GIL). The _on_append
+        # grant site orders the writes — deadline zeroed first on a term
+        # change, written last on a grant, after the index — so a torn
+        # read can only look like an expired lease or an over-strict
+        # index, never a live lease gating on a stale index.
+        from ..utils.clock import REAL_CLOCK
+
+        self.lease_duration = lease_duration
+        self.clock = clock or REAL_CLOCK
+        self._read_lease_until = 0.0     # local monotonic deadline
+        self._read_lease_index = 0       # leader commit at grant
+        self._read_lease_term = -1       # grants die with their term
+        # leader-side grant anchor: the last time THIS leader observed
+        # responses from a quorum (see _lease_ttl — grants SHRINK as
+        # quorum contact ages, so a partitioned leader stops extending
+        # leases long before its CheckQuorum step-down fires)
+        self._lease_quorum_contact = 0.0
+        self._lease_acked: set[int] = set()
 
         # PreVote (raft §9.6 / etcd PreVote): an election-timeout node
         # first polls peers with a NON-disruptive pre-vote at term+1 —
@@ -701,6 +744,9 @@ class RaftNode:
         self.heartbeat_elapsed = 0
         self._quorum_elapsed = 0
         self._recent_active = set()
+        # a quorum just voted for us: that IS quorum contact
+        self._lease_quorum_contact = self.clock.monotonic()
+        self._lease_acked = set()
         self._snap_pending = {}
         self._inflight = {}
         last = self._last_index()
@@ -869,6 +915,30 @@ class RaftNode:
         self.leader_id = msg.frm
         self.election_elapsed = 0
 
+        if getattr(msg, "lease_ttl", 0.0) > 0.0:
+            # read-lease grant from the current-term leader: the
+            # follower trusts it only DISCOUNTED by the skew margin, and
+            # grants never shrink an existing deadline (out-of-order
+            # delivery). A term change invalidates the previous term's
+            # grants wholesale — a deposed leader's lease must not let
+            # this follower serve past the new leader's writes for
+            # longer than the old leader's own guarantee window.
+            # WRITE ORDER is load-bearing for lock-free RPC readers
+            # (read_ok): the deadline is zeroed FIRST on a term change
+            # and written LAST on a grant, AFTER the index it gates —
+            # a torn read can only look like an expired lease or an
+            # over-strict index, never a live lease with a stale index.
+            if self._read_lease_term != self.term:
+                self._read_lease_until = 0.0
+                self._read_lease_index = 0
+                self._read_lease_term = self.term
+            self._read_lease_index = max(self._read_lease_index,
+                                         msg.leader_commit)
+            self._read_lease_until = max(
+                self._read_lease_until,
+                self.clock.monotonic()
+                + msg.lease_ttl * (1.0 - READ_LEASE_SKEW))
+
         # prev entry check
         if msg.prev_log_index > 0:
             if msg.prev_log_index < self.snapshot_index:
@@ -916,6 +986,14 @@ class RaftNode:
         if self.role != LEADER or msg.term != self.term:
             return
         self._recent_active.add(msg.frm)  # CheckQuorum lease contact
+        # read-lease anchor: once responses from a quorum accumulate,
+        # re-anchor the grant window and start collecting afresh (the
+        # set is reset on every quorum so the anchor tracks ROUNDS of
+        # quorum contact, not a window that one chatty peer keeps warm)
+        self._lease_acked.add(msg.frm)
+        if self._quorum(len(self._lease_acked | {self.id})):
+            self._lease_quorum_contact = self.clock.monotonic()
+            self._lease_acked.clear()
         if msg.success:
             # one ack drains one window slot (heartbeat acks merely decay
             # the counter faster, floored at zero)
@@ -1128,6 +1206,7 @@ class RaftNode:
                 next_idx <= self.snapshot_index:
             self._send_snapshot_to(peer_id)
             return
+        lease_ttl = self._lease_ttl()
         match = self.match_index.get(peer_id, 0)
         paused = peer_id in self._snap_pending
         sent = 0
@@ -1145,6 +1224,7 @@ class RaftNode:
                 frm=self.id, to=peer_id, term=self.term,
                 prev_log_index=prev_index, prev_log_term=prev_term,
                 entries=list(entries), leader_commit=self.commit_index,
+                lease_ttl=lease_ttl,
             ))
             self._inflight[peer_id] = self._inflight.get(peer_id, 0) + 1
             if match <= 0:
@@ -1161,6 +1241,7 @@ class RaftNode:
                 frm=self.id, to=peer_id, term=self.term,
                 prev_log_index=prev_index, prev_log_term=prev_term,
                 entries=[], leader_commit=self.commit_index,
+                lease_ttl=lease_ttl,
             ))
 
     def _send_snapshot_to(self, peer_id: int):
@@ -1393,6 +1474,55 @@ class RaftNode:
         no message ever claims state that is not yet durable."""
         self._out_msgs.append(msg)
 
+    # -------------------------------------------------------------- lease
+    def _lease_ttl(self) -> float:
+        """Seconds of read lease this node may grant right now (0.0 =
+        none). Only a SIGNALLED leader running CheckQuorum grants, and
+        the grant is ANCHORED at the last observed quorum contact: it
+        shrinks as that contact ages and hits zero after lease_duration
+        of quorum silence — so a leader partitioned with a minority
+        stops extending follower leases immediately, long before its
+        CheckQuorum step-down, instead of stretching a stale follower's
+        window past a new leader's election. The vote-withholding half
+        (followers ignore campaigns for election_tick after leader
+        contact) is what makes the window itself sound; like etcd's
+        clock-based lease reads, the anchor assumes response delay is
+        small against the window (arbitrarily delayed acks could
+        freshen it — the strict alternative is ReadIndex round-trips)."""
+        if not (self.lease_duration > 0.0 and self.role == LEADER
+                and self._signalled and self.check_quorum):
+            return 0.0
+        remaining = self.lease_duration \
+            - (self.clock.monotonic() - self._lease_quorum_contact)
+        return max(0.0, min(remaining, self.lease_duration))
+
+    def read_ok(self) -> bool:
+        """May this node serve a lease-gated read right now? The leader
+        always may. A follower may only while (a) it holds a live,
+        skew-discounted lease from the CURRENT term's leader and (b) it
+        has APPLIED at least the leader's commit index from the grant —
+        the served snapshot is then no older than the leader's commit
+        frontier at grant time (bounded staleness, not linearizability;
+        writes stay leader-only). Thread-safe for RPC-thread callers."""
+        if self.is_leader:
+            return True
+        if self.role != FOLLOWER or self._read_lease_term != self.term:
+            return False
+        if self.clock.monotonic() >= self._read_lease_until:
+            return False
+        return self.last_applied >= self._read_lease_index
+
+    def read_lease(self) -> dict:
+        """Introspection for status()/tests: the current lease triple
+        plus the live verdict."""
+        return {
+            "ok": self.read_ok(),
+            "until": self._read_lease_until,
+            "index": self._read_lease_index,
+            "term": self._read_lease_term,
+            "applied": self.last_applied,
+        }
+
     # ------------------------------------------------------------- introspect
     @property
     def is_leader(self) -> bool:
@@ -1419,4 +1549,7 @@ class RaftNode:
             # failures observed (tests and the operator surface read it)
             "storage_degraded": self.storage_degraded,
             "storage_errors": self.storage_errors,
+            # read-lease plane (ISSUE 13): may this node serve
+            # lease-gated reads, and under which grant
+            "read_lease": self.read_lease(),
         }

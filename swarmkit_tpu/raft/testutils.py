@@ -79,7 +79,8 @@ class RaftCluster:
 
     def __init__(self, n: int, storages: dict[int, object] | None = None,
                  apply_cbs: dict[int, object] | None = None,
-                 snapshot_interval: int = 1000, seed: int = 7):
+                 snapshot_interval: int = 1000, seed: int = 7,
+                 lease_duration: float = 0.0, clock=None):
         self.router = MemoryTransport()
         self.nodes: dict[int, RaftNode] = {}
         peers = [Peer(i, f"node-{i}", f"mem://{i}") for i in range(1, n + 1)]
@@ -91,6 +92,8 @@ class RaftCluster:
                 apply_entry=(apply_cbs or {}).get(i, lambda e: None),
                 snapshot_interval=snapshot_interval,
                 rng=random.Random(seed + i),
+                lease_duration=lease_duration,
+                clock=clock,
             )
             node.bootstrap(peers)
             self.router.register(node)

@@ -94,12 +94,20 @@ def _strip_forward(caller: Caller | None) -> Caller | None:
 def build_manager_registry(manager, raft_node=None,
                            leader_conns: LeaderConns | None = None,
                            registry: ServiceRegistry | None = None,
+                           follower_reads=None,
                            ) -> ServiceRegistry:
     """Declare every plane on one registry (manager.go Run:441-641).
 
     Pass `registry` to fill a pre-existing (already-served) registry — the
     daemon binds its listener before the manager objects exist so the raft
-    advertise address is known first."""
+    advertise address is known first.
+
+    `follower_reads` (a dispatcher.follower.FollowerReadPlane, ISSUE 13)
+    lets a NON-leader manager serve the read half of the worker protocol
+    — Assignments/Tasks streams and watch-API reads — under the raft
+    read lease; with no plane (or no live lease) those reads bounce with
+    NotLeaderError and clients redirect to the leader as before. Writes
+    (registration, status write-back) always leader-forward."""
     reg = registry if registry is not None else ServiceRegistry()
     is_leader = (lambda: True) if raft_node is None else \
         (lambda: raft_node.is_leader)
@@ -311,8 +319,26 @@ def build_manager_registry(manager, raft_node=None,
         _require_node(caller, node_id)
         return d.heartbeat(node_id, session_id)
 
+    def _follower_read(serve):
+        """Serve a read stream from the follower plane, translating a
+        dead lease into the NotLeaderError clients already redirect
+        on (RemoteDispatcher follows dispatcher.leader_addr)."""
+        from ..dispatcher.follower import FollowerReadUnavailable
+
+        try:
+            return serve()
+        except FollowerReadUnavailable as exc:
+            raise NotLeaderError(str(exc)) from exc
+
     def disp_assignments(caller, node_id, session_id):
         _require_node(caller, node_id)
+        if not is_leader() and follower_reads is not None:
+            # lease-gated follower serving (ISSUE 13): the stream is a
+            # READ — session ids name leader-side liveness state this
+            # manager does not have, so identity is the cert-checked
+            # node id alone. Status write-back stays leader-only.
+            return _follower_read(
+                lambda: follower_reads.assignments(node_id))
         return d.assignments(node_id, session_id)  # Channel -> stream
 
     def disp_update_task_status(caller, node_id, session_id, updates):
@@ -338,6 +364,8 @@ def build_manager_registry(manager, raft_node=None,
 
     def disp_tasks(caller, node_id, session_id):
         _require_node(caller, node_id)
+        if not is_leader() and follower_reads is not None:
+            return _follower_read(lambda: follower_reads.tasks(node_id))
         return d.tasks(node_id, session_id)
 
     reg.add("dispatcher.assignments", disp_assignments, roles=both,
@@ -434,6 +462,15 @@ def build_manager_registry(manager, raft_node=None,
     watch_api = manager.watch_api
 
     def watch_events(caller, selectors=None, since_version=None):
+        # lease-gated on non-leaders (ISSUE 13): a follower with a live
+        # read lease serves its replicated store (bounded staleness); a
+        # partitioned/lagging one bounces instead of silently serving
+        # arbitrarily stale events. Managers without the plane keep the
+        # historical serve-anything behavior.
+        if not is_leader() and follower_reads is not None \
+                and not follower_reads.read_ok():
+            raise NotLeaderError(
+                "watch reads need the leader or a live read lease")
         return watch_api.watch(selectors, since_version)
 
     reg.add("watch.events", watch_events, roles=[MANAGER], streaming=True)

@@ -930,6 +930,84 @@ def test_mirror_required_common_events():
     assert "restamp" in rep.missing_common["scheduler_tick"]
 
 
+def test_mirror_detects_one_sided_follower_serve_edit():
+    """ISSUE 13 dispatcher-serve pair: dropping the follower's _diff
+    call (serving raw snapshots instead of the shared diff protocol) is
+    drift, caught with a readable diff naming the pair."""
+    spec = next(s for s in mirror.MIRRORS
+                if s.key == "dispatcher_serve_follower")
+    src = (ROOT / spec.path).read_text()
+    edited = src.replace(
+        "        msg, commit = self._diff(session, tasks, secrets, "
+        "configs,\n"
+        "                                 volumes, unpublish, clone_ids, "
+        "ship_bases)\n",
+        "        msg, commit = None, lambda: None\n")
+    assert edited != src, "edit anchor moved — update this test"
+    rep = mirror.check_drift(
+        ROOT, sources={"dispatcher_serve_follower": edited})
+    assert not rep.clean
+    assert "dispatcher_serve_follower" in rep.diffs
+    assert "diff" in rep.diffs["dispatcher_serve_follower"]
+
+
+def test_mirror_follower_requires_lease_gate():
+    """The follower member's `required` set includes lease_gate on top
+    of the common serve floor: a follower plane whose table was
+    re-recorded WITHOUT any lease check still fails (the staleness
+    bound is not optional), while the leader member — same pair, no
+    lease in its vocabulary path — stays clean without one."""
+    minimal = textwrap.dedent("""
+    class FollowerReadPlane:
+        def assignments(self, node_id):
+            self.store.view(cb)
+            session.channel._offer(msg)
+        def _full_assignment(self, session):
+            self.store.view(cb)
+            self._node_view(tx, session.node_id, refs)
+            self._materialize_clones(session, secrets, refs)
+            self._commit_known(session)
+        def _send_incrementals(self):
+            self.store.view(cb)
+            self._serve_session(s, v, r)
+        def _serve_session(self, session, view, refs):
+            self._materialize_clones(session, secrets, refs)
+            self._diff(session)
+            session.channel._offer(msg)
+        def _require_lease(self):
+            pass
+    """)
+    spec = next(s for s in mirror.MIRRORS
+                if s.key == "dispatcher_serve_follower")
+    seq = mirror.extract_from_source(minimal, spec)
+    rep = mirror.check_drift(
+        ROOT, sources={"dispatcher_serve_follower": minimal},
+        expected=dict(mirror.EXPECTED,
+                      dispatcher_serve_follower=tuple(seq)))
+    assert "dispatcher_serve_follower" in rep.missing_common
+    assert "lease_gate" in rep.missing_common["dispatcher_serve_follower"]
+
+
+def test_shard_lock_hazard_prefix():
+    """ISSUE 13 hazard-key extension: shard-indexed dispatcher lock
+    names fire the in-view hazard by PREFIX; unrelated dispatcher-domain
+    names do not (must-fire and must-not-fire)."""
+    with lockgraph.armed() as state:
+        bad = lockgraph.make_lock("dispatcher.shard7.lock")
+        benign = lockgraph.make_lock("dispatcher.metrics")
+        lockgraph.view_enter()
+        try:
+            with bad:
+                pass
+            with benign:
+                pass
+        finally:
+            lockgraph.view_exit()
+        rep = state.report()
+    assert len(rep.hazards) == 1, rep.hazards
+    assert "dispatcher.shard7.lock" in rep.hazards[0]
+
+
 def test_protocol_table_in_sync_with_print_protocol():
     """`--print-protocol` output must round-trip to the checked-in
     table (the re-record flow stays copy-pasteable)."""

@@ -88,6 +88,31 @@ def test_find_tasks_by_service_shape_used_by_diagnosis():
     assert [t.id for t in got] == ["a"]
 
 
+def test_dispatcher_fanout_storm_cpu_smoke():
+    """ISSUE 13 op-count contracts of the sharded-flush storm row at a
+    CPU-smoke shape (counters, never wall clock — this is a contended
+    1-core host; the ≥2.5× P=1→P=4 scaling acceptance is judged by the
+    bench `dispatcher_fanout_storm_100k` row, where bench owns a
+    multi-core machine): 1 store view-tx per flush GLOBAL at every P,
+    ≤1 dirty-walk per shard, copy-on-ship 1.0, every session served,
+    and the follower read-plane slice serving its streams."""
+    import numpy as np
+
+    row = bench.bench_dispatcher_fanout_storm(
+        np, n_sessions=300, shard_counts=(1, 4), beats_sample=200,
+        follower_reads=30)
+    assert row["parity"] is True
+    for P in ("1", "4"):
+        sub = row["shards"][P]
+        assert sub["store_tx_per_flush"] == 1.0, (P, sub)
+        assert sub["dirty_walks_per_shard"] <= 1.0, (P, sub)
+        assert sub["copies_per_ship"] == 1.0, (P, sub)
+        assert sub["delivered"] == 300, (P, sub)
+        assert sub["beat_p99_us"] > 0
+    assert row["follower_reads"] == 30
+    assert row["follower_read_ratio"] is not None
+
+
 def test_store_plane_row_cpu_smoke():
     """ISSUE 11 parity check at a CPU-smoke size: the bench row's own
     correctness gates hold (object/columnar end-state equality + columns

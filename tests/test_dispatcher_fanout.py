@@ -701,3 +701,529 @@ def test_flush_crash_resyncs_reverse_indexes_soak(seed):
     except BaseException:
         print(f"\nCHAOS_SEED={seed}")
         raise
+
+
+# ===================================================================
+# ISSUE 13: sharded flush plane + lease-gated follower reads
+# ===================================================================
+def _normalize_msg(msg):
+    """Order-normalized wire content of one AssignmentsMessage: the
+    sharded plane may serve shards in any order, but each session's
+    message must carry the same change set as the single plane's."""
+    out = []
+    for a in msg.changes:
+        ident = a.item if isinstance(a.item, str) else a.item.id
+        ver = (a.item.meta.version.index
+               if a.action == "update" and not isinstance(a.item, str)
+               and hasattr(a.item, "meta") else None)
+        out.append((a.action, a.kind, ident, ver))
+    return (msg.type, tuple(sorted(out, key=repr)))
+
+
+def run_sharded_parity(seed, steps=30):
+    """Oracle-parity fuzz `sharded(P) flush ≡ single-plane flush`: one
+    store, one event schedule, TWO driven dispatchers (P=1 and P=4).
+    After every flush each node's shipped message must be
+    order-normalized-identical across planes, and at quiescence both
+    agents' accumulated state must equal the independent store oracle."""
+    rng = random.Random(seed)
+    store = MemoryStore()
+    d1, ch1 = driven_dispatcher(store, rate_limit_period=-1.0, shards=1)
+    d4, ch4 = driven_dispatcher(store, rate_limit_period=-1.0, shards=4,
+                                jitter_seed=seed)
+    assert d4.shards == 4 and len(d4._shards) == 4
+    nodes = [f"p{i:02d}" for i in range(rng.randint(5, 9))]
+    secret_ids = [f"psec{i}" for i in range(3)]
+    volume_ids = [f"pvol{i}" for i in range(2)]
+    for nid in nodes:
+        mk_node(store, nid)
+    for sid in secret_ids:
+        mk_secret(store, sid)
+    for vid in volume_ids:
+        mk_volume(store, vid)
+
+    chans: dict[str, dict] = {}   # node -> {1: chan, 4: chan}
+    views: dict[str, dict] = {}   # node -> {1: AgentView, 4: AgentView}
+
+    def join(nid):
+        for key, d in (("1", d1), ("4", d4)):
+            sid = d.register(nid)
+            ch_a = d.assignments(nid, sid)
+            chans.setdefault(nid, {})[key] = ch_a
+            views.setdefault(nid, {})[key] = AgentView()
+
+    def flush_and_compare():
+        pump(d1, ch1)
+        pump(d4, ch4)
+        d1._send_incrementals()
+        d4._send_incrementals()
+        for nid in chans:
+            got = {}
+            for key in ("1", "4"):
+                msgs = []
+                while True:
+                    m = chans[nid][key].try_get()
+                    if m is None:
+                        break
+                    views[nid][key].apply(m)
+                    msgs.append(_normalize_msg(m))
+                got[key] = msgs
+            assert got["1"] == got["4"], (
+                f"node {nid}: sharded flush shipped different wire "
+                f"messages\nP=1: {got['1']}\nP=4: {got['4']}")
+
+    try:
+        for nid in nodes[: len(nodes) // 2 + 1]:
+            join(nid)
+        flush_and_compare()
+        tseq = [0]
+        for _ in range(steps):
+            op = rng.random()
+            if op < 0.45:
+                if rng.random() < 0.5 or tseq[0] == 0:
+                    tid = f"pt{tseq[0]:03d}"
+                    tseq[0] += 1
+                    t = Task(id=tid, service_id="svc",
+                             node_id=rng.choice(nodes), slot=tseq[0])
+                    t.status.state = TaskState.RUNNING
+                    t.desired_state = TaskState.RUNNING
+                    runtime = ContainerSpec()
+                    for sid in rng.sample(secret_ids, rng.randint(0, 2)):
+                        runtime.secrets.append(SecretReference(
+                            secret_id=sid, secret_name=sid))
+                    t.spec.runtime = runtime
+                    store.update(lambda tx, t=t: tx.create(t))
+                else:
+                    tasks = store.view(lambda tx: tx.find_tasks())
+                    if tasks:
+                        t = rng.choice(tasks)
+                        r = rng.random()
+                        if r < 0.3:
+                            store.update(lambda tx, tid=t.id:
+                                         tx.delete(Task, tid))
+                        else:
+                            cur = t.copy()
+                            if r < 0.65:
+                                cur.node_id = rng.choice(nodes)
+                            else:
+                                cur.annotations.labels = {
+                                    "rev": str(rng.randint(0, 9))}
+                            store.update(lambda tx, cur=cur:
+                                         tx.update(cur))
+            elif op < 0.65:
+                sid = rng.choice(secret_ids)
+                s = store.view(lambda tx: tx.get_secret(sid))
+                if s is not None:
+                    cur = s.copy()
+                    cur.spec.data = bytes([rng.randint(0, 255)])
+                    store.update(lambda tx, cur=cur: tx.update(cur))
+            elif op < 0.80:
+                vid = rng.choice(volume_ids)
+                v = store.view(lambda tx: tx.get_volume(vid))
+                if v is not None:
+                    cur = v.copy()
+                    cur.publish_status = [
+                        VolumePublishStatus(
+                            node_id=nid,
+                            state=rng.choice(
+                                [PUBLISHED, PENDING_NODE_UNPUBLISH]))
+                        for nid in rng.sample(nodes, rng.randint(0, 3))]
+                    store.update(lambda tx, cur=cur: tx.update(cur))
+            elif op < 0.92:
+                nid = rng.choice(nodes)
+                if nid not in chans:
+                    join(nid)
+            if rng.random() < 0.6:
+                flush_and_compare()
+        flush_and_compare()
+        flush_and_compare()
+        # final parity: both planes match the independent oracle
+        for nid, v in views.items():
+            oracle = (*oracle_rebuild(store, nid),)
+            assert v["1"].state() == oracle, f"P=1 diverged on {nid}"
+            assert v["4"].state() == oracle, f"P=4 diverged on {nid}"
+    finally:
+        d1.stop()
+        d4.stop()
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_sharded_flush_parity_vs_single(seed):
+    try:
+        run_sharded_parity(seed)
+    except BaseException:
+        print(f"\nCHAOS_SEED={seed}")
+        raise
+
+
+def test_sharded_storm_op_counts():
+    """The sharded op-count contract (ISSUE 13): a P=4 rollout storm
+    still takes exactly ONE store view-tx per flush (the snapshot is
+    global, shared read-only across shards), walks each shard's dirty
+    set at most once (dirty_walks ≤ P per flush), and keeps
+    copy-on-ship at 1.0."""
+    N = 120
+    store = MemoryStore()
+
+    def seed_tx(tx):
+        for i in range(N):
+            nid = f"w{i:03d}"
+            n = Node(id=nid)
+            n.status.state = NodeStatusState.READY
+            tx.create(n)
+            t = Task(id=f"wt{i:03d}", service_id="svc", node_id=nid,
+                     slot=i + 1)
+            t.status.state = TaskState.RUNNING
+            t.desired_state = TaskState.RUNNING
+            tx.create(t)
+
+    store.update(seed_tx)
+    d, ch = driven_dispatcher(store, rate_limit_period=-1.0, shards=4)
+    try:
+        chans = {}
+        for i in range(N):
+            nid = f"w{i:03d}"
+            sid = d.register(nid)
+            chans[nid] = d.assignments(nid, sid)
+        pump(d, ch)
+        d._send_incrementals()   # settle registration dirt
+
+        def touch(tx):
+            for i in range(N):
+                cur = tx.get_task(f"wt{i:03d}").copy()
+                cur.annotations.labels = {"rev": "2"}
+                tx.update(cur)
+
+        store.update(touch)
+        pump(d, ch)
+        base = dict(store.op_counts)
+        m0 = dict(d.metrics)
+        d._send_incrementals()
+        assert store.op_counts["view_tx"] - base.get("view_tx", 0) == 1, \
+            "a sharded flush must still take exactly ONE store view-tx"
+        dm = {k: d.metrics[k] - m0[k] for k in
+              ("flushes", "flush_tx", "dirty_walks", "ships",
+               "wire_copies")}
+        assert dm["flushes"] == 1 and dm["flush_tx"] == 1
+        assert 1 <= dm["dirty_walks"] <= d.shards, dm
+        assert dm["ships"] == N and dm["wire_copies"] == N
+        for nid, ch_a in chans.items():
+            msgs = []
+            while True:
+                m = ch_a.try_get()
+                if m is None:
+                    break
+                msgs.append(m)
+            assert any(m.type == "incremental" and m.changes
+                       for m in msgs), f"{nid} missed the storm"
+    finally:
+        d.stop()
+
+
+def test_shard_locks_registered_in_lockgraph():
+    """Every shard lock rides lockgraph.make_lock with a shard-indexed
+    name, the armed graph sees them, and a full sharded serve cycle
+    produces no cycle and no store.view hazard. (The module-wide
+    conftest arming also covers every other test here; this one pins
+    the NAMES so the PR 8/12 guards keep seeing the shard plane.)"""
+    from swarmkit_tpu.analysis import lockgraph
+
+    with lockgraph.armed() as state:
+        store = MemoryStore()
+        d, ch = driven_dispatcher(store, rate_limit_period=-1.0, shards=4)
+        try:
+            for i in range(8):
+                mk_node(store, f"lg{i}")
+                sid = d.register(f"lg{i}")
+                d.assignments(f"lg{i}", sid)
+                d.heartbeat(f"lg{i}", sid)
+            t = Task(id="lgt", service_id="svc", node_id="lg3", slot=1)
+            t.status.state = TaskState.RUNNING
+            t.desired_state = TaskState.RUNNING
+            store.update(lambda tx: tx.create(t))
+            pump(d, ch)
+            d._send_incrementals()
+        finally:
+            d.stop()
+        rep = state.report()
+        names = set(state._locks.values())
+    assert rep.clean, rep.render()
+    for i in range(4):
+        assert f"dispatcher.shard{i}.lock" in names, sorted(names)
+
+
+def test_shard_lock_inside_view_is_a_hazard():
+    """The hazard key extension (ISSUE 13): acquiring a shard-indexed
+    dispatcher lock INSIDE an open store.view callback is flagged like
+    the classic dispatcher.lock inversion; unrelated names stay clean."""
+    from swarmkit_tpu.analysis import lockgraph
+
+    with lockgraph.armed() as state:
+        bad = lockgraph.make_lock("dispatcher.shard2.lock")
+        ok = lockgraph.make_lock("dispatcher.other.lock")
+        lockgraph.view_enter()
+        try:
+            with bad:
+                pass
+            with ok:
+                pass
+        finally:
+            lockgraph.view_exit()
+        rep = state.report()
+    assert len(rep.hazards) == 1, rep.hazards
+    assert "dispatcher.shard2.lock" in rep.hazards[0]
+
+
+def test_jitter_seeded_per_shard():
+    """Heartbeat jitter draws from per-SHARD seeded rng streams: equal
+    seeds replay equal per-node schedules, the draw stays inside
+    [period-ε, period), and one shard's draws never perturb another's
+    stream (a shard rebuild can't phase-align a different shard's
+    beats)."""
+    store = MemoryStore()
+
+    def mk():
+        return Dispatcher(store, heartbeat_period=5.0, shards=4,
+                          jitter_seed=42)
+
+    d_a, d_b, d_c, d_fresh = mk(), mk(), mk(), mk()
+    try:
+        nids = [f"j{i:02d}" for i in range(16)]
+        seq_a = [d_a._jittered_period(n) for n in nids for _ in range(3)]
+        seq_b = [d_b._jittered_period(n) for n in nids for _ in range(3)]
+        assert seq_a == seq_b, "equal seeds must replay equal schedules"
+        assert all(4.5 <= v < 5.0 for v in seq_a), seq_a
+        # stream isolation: burning draws against one shard leaves every
+        # OTHER shard's stream untouched
+        by_shard = {}
+        for n in nids:
+            by_shard.setdefault(d_c._shard_for(n).index, n)
+        assert len(by_shard) >= 2, by_shard   # crc32 spreads 16 ids
+        idxs = sorted(by_shard)
+        a_node, b_node = by_shard[idxs[0]], by_shard[idxs[1]]
+        for _ in range(50):
+            d_c._jittered_period(b_node)
+        assert d_c._jittered_period(a_node) \
+            == d_fresh._jittered_period(a_node), \
+            "draws on one shard perturbed another shard's stream"
+    finally:
+        for d in (d_a, d_b, d_c, d_fresh):
+            d.stop()
+
+
+# ---------------------------------------------- lease-gated follower reads
+def _seed_node_task(store, nid="fr1", tid="frt1"):
+    mk_node(store, nid)
+    t = Task(id=tid, service_id="svc", node_id=nid, slot=1)
+    t.status.state = TaskState.RUNNING
+    t.desired_state = TaskState.RUNNING
+    store.update(lambda tx: tx.create(t))
+
+
+def test_follower_complete_matches_leader_complete():
+    """The dispatcher-serve mirror's judged property: for the same
+    store, a follower read session's COMPLETE carries exactly the
+    change set the leader's COMPLETE carries."""
+    from swarmkit_tpu.dispatcher.follower import FollowerReadPlane
+
+    store = MemoryStore()
+    _seed_node_task(store)
+    mk_secret(store, "frs1")
+    t = store.view(lambda tx: tx.get_task("frt1")).copy()
+    t.spec.runtime = ContainerSpec(secrets=[SecretReference(
+        secret_id="frs1", secret_name="frs1")])
+    store.update(lambda tx: tx.update(t))
+
+    d, _ch = driven_dispatcher(store, rate_limit_period=-1.0)
+    plane = FollowerReadPlane(store, None)   # standalone: always serves
+    try:
+        sid = d.register("fr1")
+        leader_msg = d.assignments("fr1", sid).try_get()
+        follower_msg = plane.assignments("fr1").try_get()
+        assert _normalize_msg(leader_msg) == _normalize_msg(follower_msg)
+    finally:
+        d.stop()
+        plane.stop()
+
+
+def test_follower_never_serves_past_lease_expiry():
+    """THE staleness pin (FakeClock): a follower serves while its
+    skew-discounted lease is live, and NEVER after expiry — new read
+    streams bounce (FollowerReadUnavailable) and the incremental flush
+    holds its dirty sessions without offering a single message until a
+    fresh grant arrives."""
+    from swarmkit_tpu.dispatcher.follower import (
+        FollowerReadPlane,
+        FollowerReadUnavailable,
+    )
+    from swarmkit_tpu.raft.testutils import RaftCluster
+    from swarmkit_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    c = RaftCluster(3, lease_duration=1.0, clock=clock)
+    c.tick_until_leader()
+    follower = next(n for n in c.nodes.values() if not n.is_leader)
+    assert follower.read_ok(), follower.read_lease()
+
+    store = MemoryStore()
+    _seed_node_task(store)
+    plane = FollowerReadPlane(store, follower, clock=clock)
+    try:
+        ch = plane.assignments("fr1")
+        assert ch.try_get().type == "complete"
+
+        # partition the follower: no more grants ride in; advance the
+        # fake clock past the discounted deadline (1.0s × 0.9 skew)
+        c.router.isolate(follower.id)
+        clock.advance(0.91)
+        assert not follower.read_ok(), follower.read_lease()
+
+        with pytest.raises(FollowerReadUnavailable):
+            plane.assignments("fr1")
+
+        # a write lands while the lease is dead: the flush must HOLD —
+        # nothing may be offered to the already-subscribed stream
+        cur = store.view(lambda tx: tx.get_task("frt1")).copy()
+        cur.annotations.labels = {"rev": "2"}
+        store.update(lambda tx: tx.update(cur))
+        with plane._lock:
+            plane._dirty.add("fr1")
+        plane._send_incrementals()
+        assert ch.try_get() is None, \
+            "follower served an incremental past its lease expiry"
+        assert plane.metrics["held_flushes"] >= 1
+
+        # the apply-lag half of the gate: a live deadline alone is not
+        # enough — the follower must have APPLIED the grant's index
+        # (state restored after: same-term re-grants only ratchet it up)
+        saved = (follower._read_lease_until, follower._read_lease_term,
+                 follower._read_lease_index)
+        follower._read_lease_until = clock.monotonic() + 10.0
+        follower._read_lease_term = follower.term
+        follower._read_lease_index = follower.last_applied + 1
+        assert not follower.read_ok(), follower.read_lease()
+        (follower._read_lease_until, follower._read_lease_term,
+         follower._read_lease_index) = saved
+
+        # heal the partition: the next heartbeat re-grants and the held
+        # dirt flushes
+        c.router.heal()
+        c.tick_all(2)
+        assert follower.read_ok(), follower.read_lease()
+        plane._send_incrementals()
+        msg = ch.try_get()
+        assert msg is not None and msg.type == "incremental" \
+            and msg.changes
+    finally:
+        plane.stop()
+
+
+def test_minority_partitioned_leader_stops_granting():
+    """Grant anchoring (review fix): a leader partitioned with a
+    minority must stop EXTENDING follower leases once its last quorum
+    contact ages past lease_duration — well before its CheckQuorum
+    step-down — so a still-connected minority follower cannot keep
+    serving reads while a new majority leader commits."""
+    from swarmkit_tpu.raft.testutils import RaftCluster
+    from swarmkit_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    c = RaftCluster(5, lease_duration=1.0, clock=clock)
+    leader = c.tick_until_leader()
+    assert leader._lease_ttl() == 1.0
+    # cut the leader off from everyone but one follower: no quorum of
+    # acks can reach it anymore, though its minority peer still answers
+    peers = [n for n in c.nodes.values() if n.id != leader.id]
+    keep = peers[0]
+    for n in peers[1:]:
+        c.router.isolate(n.id)
+    clock.advance(0.5)
+    c.tick_all(2)          # heartbeats to `keep` flow; no quorum of acks
+    assert leader.is_leader            # CheckQuorum hasn't fired yet
+    assert leader._lease_ttl() <= 0.5 + 1e-9, leader._lease_ttl()
+    clock.advance(0.6)
+    c.tick_all(2)
+    assert leader.is_leader
+    assert leader._lease_ttl() == 0.0, \
+        "a quorum-silent leader kept granting read leases"
+    # the minority follower's own lease then dies on schedule too
+    clock.advance(1.0)
+    assert not keep.read_ok(), keep.read_lease()
+
+
+def test_follower_read_rpc_routing():
+    """rpc/services.py stream routing: a non-leader manager with a live
+    lease serves the assignments read stream from the follower plane; a
+    dead lease bounces with NotLeaderError (the redirect agents already
+    follow); the leader path is untouched. Driven with stub raft/lease
+    objects — the real-raft lease semantics are pinned above."""
+    from swarmkit_tpu.dispatcher.follower import FollowerReadPlane
+    from swarmkit_tpu.rpc.services import (
+        NotLeaderError,
+        build_manager_registry,
+    )
+
+    store = MemoryStore()
+    _seed_node_task(store)
+
+    class StubRaft:
+        is_leader = False
+        leader_id = 2
+        id = 1
+        members = {}
+
+        def read_ok(self):
+            return self.lease_ok
+
+        lease_ok = True
+
+    class StubManager:
+        def __init__(self, store):
+            from swarmkit_tpu.dispatcher.dispatcher import Dispatcher
+
+            self.store = store
+            self.dispatcher = Dispatcher(store, rate_limit_period=-1.0)
+            self.ca_server = None
+            self.control_api = type("C", (), {})()
+            self.log_broker = type(
+                "B", (), {"subscribe_logs": None,
+                          "listen_subscriptions": None,
+                          "publish_logs": None})()
+            self.watch_api = type("W", (), {"watch": None})()
+            self.health = type("H", (), {"check": None})()
+
+    from swarmkit_tpu.api.types import NodeRole
+    from swarmkit_tpu.ca.auth import Caller
+    from swarmkit_tpu.dispatcher.dispatcher import SessionInvalid
+
+    raft = StubRaft()
+    mgr = StubManager(store)
+    plane = FollowerReadPlane(store, raft)
+    try:
+        caller = Caller(node_id="fr1", role=NodeRole.WORKER, org="o")
+        # raft_node None: is_leader() is always True — the leader path
+        # serves the local dispatcher (its session checks apply)
+        reg = build_manager_registry(mgr, raft_node=None,
+                                     follower_reads=plane)
+        handler = reg.lookup("dispatcher.assignments").func
+        with pytest.raises(SessionInvalid):
+            handler(caller, "fr1", "bogus-session")
+
+        # non-leader + live lease: the follower plane serves the read
+        reg2 = build_manager_registry(mgr, raft_node=raft,
+                                      follower_reads=plane)
+        handler2 = reg2.lookup("dispatcher.assignments").func
+        ch = handler2(caller, "fr1", "ignored")
+        assert ch.try_get().type == "complete"
+
+        # dead lease: bounce with NotLeaderError
+        raft.lease_ok = False
+        with pytest.raises(NotLeaderError):
+            handler2(caller, "fr1", "ignored")
+        # watch-API reads bounce the same way
+        handler_w = reg2.lookup("watch.events").func
+        with pytest.raises(NotLeaderError):
+            handler_w(caller)
+    finally:
+        plane.stop()
+        mgr.dispatcher.stop()

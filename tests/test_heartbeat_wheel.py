@@ -152,3 +152,59 @@ def test_stopped_wheel_is_inert():
     wheel.add("m", 0.1, lambda: fired.append("m"))   # no-op, no crash
     clock.advance(5.0)
     assert fired == []
+
+
+# ------------------------------------------------- sharded wheel (ISSUE 13)
+def test_sharded_wheel_routes_by_stable_hash():
+    """The facade routes every key to the slice `stable_shard` picks,
+    aggregates len/ticks/fired across slices, and keeps the wheel
+    contract per slice: beats keep an entry alive, silence expires it."""
+    from swarmkit_tpu.dispatcher.heartbeat import (
+        ShardedHeartbeatWheel,
+        stable_shard,
+    )
+
+    clock = FakeClock()
+    wheel = ShardedHeartbeatWheel(granularity=0.25, clock=clock, shards=4)
+    fired = []
+    keys = [f"s{i:02d}" for i in range(20)]
+    for k in keys:
+        wheel.add(k, 1.0, lambda k=k: fired.append(k))
+    assert len(wheel) == 20
+    by_slice = [len(w) for w in wheel.wheels]
+    assert sum(by_slice) == 20 and sum(1 for n in by_slice if n) >= 2, \
+        by_slice   # crc32 spreads 20 keys over several slices
+    for k in keys:
+        assert k in wheel.wheels[stable_shard(k, 4)]._timeout
+
+    # beat half the keys forward; the silent half expires, never early
+    beaten = set(keys[::2])
+    clock.advance(0.75)
+    for k in beaten:
+        assert wheel.beat(k)
+    clock.advance(0.6)     # silent keys pass 1.0s; beaten ones don't
+    assert set(fired) == set(keys) - beaten
+    assert wheel.fired == len(fired) and wheel.ticks > 0
+    # removal routes to the owning slice
+    for k in beaten:
+        wheel.remove(k)
+    assert len(wheel) == 0
+    wheel.stop()
+
+
+def test_sharded_wheel_single_slice_is_transparent():
+    """shards=1 keeps the pre-sharding surface, including the debug
+    attributes tests poke (`_tick`, `_ticker_gen` delegate to slice 0)."""
+    from swarmkit_tpu.dispatcher.heartbeat import ShardedHeartbeatWheel
+
+    clock = FakeClock()
+    wheel = ShardedHeartbeatWheel(granularity=0.25, clock=clock, shards=1)
+    fired = []
+    wheel.add("n", 0.5, lambda: fired.append("n"))
+    assert len(wheel) == 1 and wheel.bucket_count == 1
+    wheel._tick(wheel._ticker_gen)      # delegated driving, no crash
+    clock.advance(1.0)
+    assert fired == ["n"]
+    wheel.set_granularity(0.1)
+    assert wheel.granularity == 0.1
+    wheel.stop()
