@@ -29,6 +29,7 @@ Prints ONE JSON line:
 from __future__ import annotations
 
 import json
+import os
 import random
 import sys
 import time
@@ -1780,6 +1781,297 @@ def bench_store_plane(np, sizes=(100_000, 1_000_000)):
     }
 
 
+def bench_orchestrator_storm(np, n_services=100_000, replicas=2,
+                             dirty=200, storm_services=300,
+                             storm_replicas=5, storm_budget_s=180.0):
+    """Batched orchestration plane acceptance row (ISSUE 14): (a) the
+    columnar reconcile pass over n_services replicated services —
+    steady-pass wall vs a scalar decide loop (sampled + extrapolated),
+    with decision parity on a seeded dirty subset and the objectless
+    op-count contract (zero object reads / zero transactions for steady
+    services); (b) a live rolling-update storm (mass v2 push, ~25%
+    poisoned services auto-rolling back) through the real orchestrator
+    + shared wave planner, reporting time-to-converged and the planner
+    thread count (ONE, vs one-per-service scalar updaters); (c) the
+    disarmed-plane contract — with SWARMKIT_TPU_NO_BATCHED_ORCH=1 the
+    plane's module counters stay untouched by event handling (zero
+    per-event allocations on the steady path).
+
+    tests/test_bench_diag.py runs this same fn at a CPU-smoke shape
+    (op counts + parity, never wall clock on the 1-core test host)."""
+    import random
+    import threading
+
+    from swarmkit_tpu.api.objects import Service, Task, Version
+    from swarmkit_tpu.api.specs import (Annotations, ContainerSpec,
+                                        RestartPolicy, ServiceSpec,
+                                        TaskSpec, UpdateConfig)
+    from swarmkit_tpu.api.types import (TaskState, UpdateFailureAction,
+                                        UpdateOrder)
+    from swarmkit_tpu.orchestrator import batched as batched_mod
+    from swarmkit_tpu.orchestrator.batched import BatchedReconciler
+    from swarmkit_tpu.orchestrator.replicated import (
+        ReplicatedOrchestrator, decide_service)
+    from swarmkit_tpu.store import by
+    from swarmkit_tpu.store.memory import MemoryStore
+
+    rng = random.Random(0)
+
+    def mk_service(sid, n_rep, image="v1", version=1, rollback=True):
+        svc = Service(id=sid)
+        svc.spec = ServiceSpec(
+            annotations=Annotations(name=sid), replicas=n_rep,
+            task=TaskSpec(runtime=ContainerSpec(image=image),
+                          restart=RestartPolicy(delay=0.05)),
+            update=UpdateConfig(
+                parallelism=2, delay=0.0, monitor=0.3,
+                order=UpdateOrder.STOP_FIRST,
+                failure_action=(UpdateFailureAction.ROLLBACK if rollback
+                                else UpdateFailureAction.PAUSE),
+                max_failure_ratio=0.0))
+        svc.spec_version = Version(version)
+        return svc
+
+    # ---------------- (a) reconcile pass at n_services ----------------
+    store = MemoryStore()
+
+    def seed(batch):
+        for s in range(n_services):
+            def one(tx, s=s):
+                svc = mk_service(f"os{s:06d}", replicas)
+                tx.create(svc)
+                for slot in range(1, replicas + 1):
+                    t = Task(id=f"ot{s:06d}-{slot}", service_id=svc.id,
+                             slot=slot)
+                    t.spec = svc.spec.task
+                    t.spec_version = Version(1)
+                    t.desired_state = TaskState.RUNNING
+                    t.status.state = TaskState.RUNNING
+                    t.node_id = f"n{(s + slot) % 64:03d}"
+                    tx.create(t)
+            batch.update(one)
+
+    store.batch(seed)
+    ids = [f"os{s:06d}" for s in range(n_services)]
+    br = BatchedReconciler(store)
+
+    br.decide_many(ids[:8])          # warmup: kernel-module import cost
+    br.stats.clear()
+    t0 = time.perf_counter()
+    steady = br.decide_many(ids)
+    steady_pass_s = time.perf_counter() - t0
+    steady_ok = (steady == {}
+                 and br.stats["services_steady"] == n_services
+                 and br.stats["object_reads"] == 0)
+
+    # scalar estimate from a sample (the full scalar loop at 100k is
+    # exactly the cost this plane deletes)
+    sample = ids[:min(len(ids), 3_000)]
+    view = store.view()
+    t0 = time.perf_counter()
+    for sid in sample:
+        svc = view.get_service(sid)
+        tasks = [t for t in view.find_tasks(by.ByServiceID(sid))
+                 if t.desired_state <= TaskState.RUNNING]
+        decide_service(svc, tasks)
+    scalar_sample_s = time.perf_counter() - t0
+    scalar_est_s = scalar_sample_s * (len(ids) / max(len(sample), 1))
+
+    # dirty a seeded subset; decisions must match the scalar oracle
+    dirty_ids = sorted(rng.sample(ids, min(dirty, len(ids))))
+
+    def poke(tx):
+        for sid in dirty_ids:
+            cur = tx.get_service(sid).copy()
+            cur.spec.replicas = replicas + 1      # scale-up decision
+            tx.update(cur)
+
+    store.update(poke)
+    t0 = time.perf_counter()
+    decisions = br.decide_many(ids)
+    dirty_pass_s = time.perf_counter() - t0
+    view = store.view()
+    parity = set(decisions) == set(dirty_ids)
+    for sid in dirty_ids:
+        svc = view.get_service(sid)
+        tasks = [t for t in view.find_tasks(by.ByServiceID(sid))
+                 if t.desired_state <= TaskState.RUNNING]
+        want = decide_service(svc, tasks)
+        got = decisions.get(sid)
+        parity = parity and got is not None \
+            and got.create_slots == want.create_slots \
+            and got.victim_slots == want.victim_slots
+    del store, br, view, steady, decisions
+
+    # ---------------- (b) live update storm ---------------------------
+    storm = {}
+    s_store = MemoryStore()
+    orch = ReplicatedOrchestrator(s_store)
+    storm_ok = orch.batched is not None
+    orch.start()
+    halt = threading.Event()
+
+    def pump():
+        while not halt.is_set():
+            def cb(tx):
+                for t in tx.find_tasks():
+                    if t.desired_state == TaskState.RUNNING \
+                            and t.status.state < TaskState.RUNNING:
+                        c = t.copy()
+                        c.status.state = (
+                            TaskState.FAILED
+                            if t.spec.runtime.image == "v2-poison"
+                            else TaskState.RUNNING)
+                        tx.update(c)
+                    elif t.desired_state >= TaskState.SHUTDOWN \
+                            and t.status.state <= TaskState.RUNNING:
+                        c = t.copy()
+                        c.status.state = TaskState.SHUTDOWN
+                        tx.update(c)
+            try:
+                s_store.update(cb)
+            except Exception:
+                pass
+            halt.wait(0.02)
+
+    pump_t = threading.Thread(target=pump, daemon=True,
+                              name="storm-pump")
+    pump_t.start()
+    sids = [f"st{i:04d}" for i in range(storm_services)]
+    poisoned = {sid for sid in sids if rng.random() < 0.25}
+    try:
+        def seed_storm(batch):
+            for sid in sids:
+                batch.update(lambda tx, sid=sid: tx.create(
+                    mk_service(sid, storm_replicas)))
+
+        s_store.batch(seed_storm)
+
+        def n_running(img=None):
+            return sum(
+                1 for t in s_store.view(lambda tx: tx.find_tasks())
+                if t.status.state == TaskState.RUNNING
+                and t.desired_state <= TaskState.RUNNING
+                and (img is None or t.spec.runtime.image == img))
+
+        deadline = time.monotonic() + storm_budget_s
+        while n_running() < storm_services * storm_replicas:
+            if time.monotonic() > deadline:
+                storm_ok = False
+                break
+            time.sleep(0.05)
+
+        import copy as copy_mod
+        t0 = time.monotonic()
+
+        def push_all(batch):
+            for sid in sids:
+                def one(tx, sid=sid):
+                    cur = tx.get_service(sid)
+                    new = cur.copy()
+                    new.previous_spec = copy_mod.deepcopy(cur.spec)
+                    new.spec = copy_mod.deepcopy(cur.spec)
+                    new.spec.task.runtime.image = (
+                        "v2-poison" if sid in poisoned else "v2")
+                    new.spec_version = Version(
+                        cur.spec_version.index + 1)
+                    tx.update(new)
+                batch.update(one)
+
+        s_store.batch(push_all)
+
+        def converged(sid):
+            svc = s_store.view(lambda tx: tx.get_service(sid))
+            state = (svc.update_status or {}).get("state")
+            want = ("rollback_completed" if sid in poisoned
+                    else "completed")
+            if state != want:
+                return False
+            img = "v1" if sid in poisoned else "v2"
+            run = [t for t in s_store.view(
+                lambda tx, sid=sid: tx.find_tasks(by.ByServiceID(sid)))
+                if t.desired_state <= TaskState.RUNNING
+                and t.status.state == TaskState.RUNNING]
+            # slot-distinct: a restart racing an update flip can leave
+            # a transient duplicate runnable per slot (scalar shares
+            # the window; the reaper/agent path resolves it)
+            return (len({t.slot for t in run}) == storm_replicas
+                    and all(t.spec.runtime.image == img for t in run))
+
+        done: set = set()
+        deadline = time.monotonic() + storm_budget_s
+        while storm_ok and len(done) < len(sids):
+            for sid in sids:
+                if sid not in done and converged(sid):
+                    done.add(sid)
+            if time.monotonic() > deadline:
+                storm_ok = False
+                break
+            time.sleep(0.05)
+        storm_s = time.monotonic() - t0
+        planner_threads = sum(
+            1 for th in threading.enumerate()
+            if th.name == "update-wave-planner")
+        storm = {
+            "services": storm_services,
+            "replicas": storm_replicas,
+            "rolled_back": len(poisoned),
+            "converged": len(done),
+            "time_to_converged_s": round(storm_s, 2),
+            "planner_threads": planner_threads,
+            "planner_stats": dict(orch.updater.planner.stats
+                                  if orch.updater.planner else {}),
+        }
+        storm_ok = storm_ok and planner_threads <= 1
+    finally:
+        halt.set()
+        pump_t.join(timeout=5)
+        orch.stop()
+    del s_store
+
+    # ---------------- (c) disarmed-plane contract ---------------------
+    env_key = "SWARMKIT_TPU_NO_BATCHED_ORCH"
+    prev = os.environ.get(env_key)
+    os.environ[env_key] = "1"
+    try:
+        d_store = MemoryStore()
+        d_orch = ReplicatedOrchestrator(d_store)
+        before = dict(batched_mod.stats)
+        d_store.update(lambda tx: tx.create(mk_service("dis0", 1)))
+        from swarmkit_tpu.api.objects import EventUpdate
+        svc = d_store.view(lambda tx: tx.get_service("dis0"))
+        for _ in range(200):
+            d_orch.handle(EventUpdate(svc))
+            d_orch.flush_events()
+        disarmed_plane_calls = sum(
+            batched_mod.stats.get(k, 0) - before.get(k, 0)
+            for k in set(batched_mod.stats) | set(before))
+        d_orch.updater.stop()
+        d_orch.restart.stop()
+    finally:
+        if prev is None:
+            os.environ.pop(env_key, None)
+        else:
+            os.environ[env_key] = prev
+
+    return {
+        "parity": bool(parity and steady_ok and storm_ok
+                       and disarmed_plane_calls == 0),
+        "reconcile": {
+            "services": n_services,
+            "steady_pass_s": round(steady_pass_s, 4),
+            "dirty_pass_s": round(dirty_pass_s, 4),
+            "scalar_est_s": round(scalar_est_s, 4),
+            "speedup_est_x": round(
+                scalar_est_s / max(steady_pass_s, 1e-9), 1),
+            "steady_objectless": steady_ok,
+            "dirty_services": len(dirty_ids),
+        },
+        "storm": storm,
+        "disarmed_plane_calls": disarmed_plane_calls,
+    }
+
+
 def bench_host_micro(np):
     """The BASELINE.md harness rows the reference ships benchmarks for
     but no numbers (store ops memory_test.go:2028-2120, watch queue at
@@ -2117,6 +2409,12 @@ def main():
         # timeline records on the wave + flush paths; one batched
         # scheduler record per wave) + armed e2e timeline slice
         ("slo_plane", lambda: bench_slo_plane(np)),
+        # ISSUE 14: batched orchestration plane — 100k-service columnar
+        # reconcile pass (objectless steady classification + decision
+        # parity on the dirty subset), the live rolling-update storm on
+        # the shared wave planner (one thread, auto-rollback share),
+        # and the disarmed-plane zero-alloc contract
+        ("orchestrator_storm", lambda: bench_orchestrator_storm(np)),
     ]
     configs = {name: _run_row(name, thunk) for name, thunk in rows}
     ns = configs["grid_100k_x_10k"]   # the north star IS this grid config

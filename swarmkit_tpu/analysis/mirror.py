@@ -163,6 +163,42 @@ _SERVE_VOCAB = {
 REQUIRED_SERVE = frozenset({"snapshot", "build", "materialize", "diff",
                             "offer", "commit_known"})
 
+# scalar vs batched orchestration plane (ISSUE 14): the replicated
+# reconciler (ReplicatedOrchestrator vs BatchedReconciler) and the
+# rolling updater (threaded Updater vs UpdateWavePlanner) each live in
+# two implementations that must keep riding the SHARED slot-diff /
+# verdict vocabulary — decide_service / fill_slots / victim_order on
+# the reconcile side, the updater.py slot-flip helpers + finalize_update
+# on the update side. A store-write path grown privately in one member
+# (bypassing create_replacement/promote_task/finalize_update) is exactly
+# the drift this pair exists to catch.
+_ORCH_VOCAB = {
+    "decide_service": "decide",
+    "fill_slots": "fill",
+    "victim_order": "victims",
+    "compute_slot_state": "census",
+    "updater.update": "feed",
+    "_dirty_slots": "dirty",
+    "dirty_slots": "dirty",
+    "_create_replacement": "create",
+    "create_replacement": "create",
+    "_shutdown_tasks": "shutdown",
+    "shutdown_tasks": "shutdown",
+    "_remove_task": "remove",
+    "remove_task": "remove",
+    "_promote": "promote",
+    "promote_task": "promote",
+    "finalize_update": "verdict",
+    "_set_update_status": "status",
+    "set_update_status": "status",
+    "over_threshold": "threshold",
+    "poll_failures": "monitor",
+}
+REQUIRED_ORCH_RECONCILE = frozenset({"decide"})
+REQUIRED_ORCH_UPDATE = frozenset({
+    "dirty", "create", "shutdown", "remove", "promote", "verdict",
+    "status", "threshold", "monitor"})
+
 # eager vs lazy assign_wave (store/memory.py): both ride the SHARED
 # verdict helper and the same patch primitive
 _ASSIGN_VOCAB = {
@@ -273,6 +309,47 @@ MIRRORS: tuple[MirrorSpec, ...] = (
         vocab=_SERVE_VOCAB,
         pair="dispatcher-serve",
         required=REQUIRED_SERVE | {"lease_gate"},
+    ),
+    MirrorSpec(
+        key="orch_reconcile_scalar",
+        path="swarmkit_tpu/orchestrator/replicated.py",
+        class_name="ReplicatedOrchestrator",
+        methods=("_reconcile_in_tx", "reconcile_many"),
+        vocab=_ORCH_VOCAB,
+        pair="orch-reconcile",
+        required=REQUIRED_ORCH_RECONCILE | {"feed"},
+    ),
+    MirrorSpec(
+        key="orch_reconcile_batched",
+        path="swarmkit_tpu/orchestrator/batched.py",
+        class_name="BatchedReconciler",
+        methods=("decide_many", "_decide_scope", "_dirty_residue",
+                 "_decide_scalar"),
+        vocab=_ORCH_VOCAB,
+        pair="orch-reconcile",
+        required=REQUIRED_ORCH_RECONCILE | {"census", "fill", "victims"},
+    ),
+    MirrorSpec(
+        key="orch_update_scalar",
+        path="swarmkit_tpu/orchestrator/updater.py",
+        class_name="Updater",
+        methods=("_run", "_update_slot", "_dirty_slots",
+                 "_create_replacement", "_shutdown_tasks", "_remove_task",
+                 "_promote"),
+        vocab=_ORCH_VOCAB,
+        pair="orch-update",
+        required=REQUIRED_ORCH_UPDATE,
+    ),
+    MirrorSpec(
+        key="orch_update_planner",
+        path="swarmkit_tpu/orchestrator/batched.py",
+        class_name="UpdateWavePlanner",
+        methods=("_step", "_step_init", "_step_rolling", "_step_drain",
+                 "_start_flip", "_advance_slot", "_finish_slot",
+                 "_abort_in_flight", "_finalize"),
+        vocab=_ORCH_VOCAB,
+        pair="orch-update",
+        required=REQUIRED_ORCH_UPDATE,
     ),
     MirrorSpec(
         key="assign_wave_eager",
@@ -554,6 +631,56 @@ EXPECTED: dict[str, tuple[str, ...]] = {
         '_serve_session:offer',
         '_serve_session:ship',
         '_require_lease:lease_gate',
+    ),
+    'orch_reconcile_scalar': (
+        '_reconcile_in_tx:decide',
+        '_reconcile_in_tx:feed',
+        'reconcile_many:feed',
+    ),
+    'orch_reconcile_batched': (
+        '_decide_scope:census',
+        '_decide_scope:fill',
+        '_decide_scope:victims',
+        '_decide_scalar:decide',
+    ),
+    'orch_update_scalar': (
+        '_run:status',
+        '_run:monitor',
+        '_run:threshold',
+        '_run:dirty',
+        '_run:threshold',
+        '_run:monitor',
+        '_run:threshold',
+        '_run:verdict',
+        '_run:verdict',
+        '_update_slot:create',
+        '_update_slot:shutdown',
+        '_update_slot:remove',
+        '_update_slot:remove',
+        '_update_slot:create',
+        '_update_slot:promote',
+        '_dirty_slots:dirty',
+        '_create_replacement:create',
+        '_shutdown_tasks:shutdown',
+        '_remove_task:remove',
+        '_promote:promote',
+    ),
+    'orch_update_planner': (
+        '_step_init:status',
+        '_step_rolling:monitor',
+        '_step_rolling:threshold',
+        '_step_rolling:dirty',
+        '_step_drain:monitor',
+        '_step_drain:threshold',
+        '_start_flip:create',
+        '_start_flip:create',
+        '_advance_slot:shutdown',
+        '_advance_slot:remove',
+        '_advance_slot:promote',
+        '_abort_in_flight:remove',
+        '_abort_in_flight:promote',
+        '_finalize:verdict',
+        '_finalize:threshold',
     ),
     'assign_wave_eager': (
         '_wave_verdicts:codes',

@@ -328,18 +328,30 @@ class SessionStorm:
 
 
 # -------------------------------------------------------------- load shapes
-def _service_spec(name: str, replicas: int, command: str):
+def _service_spec(name: str, replicas: int, command: str,
+                  auto_rollback: bool = False):
     import shlex
 
     from ..api.specs import (Annotations, ContainerSpec, ServiceSpec,
-                             TaskSpec)
+                             TaskSpec, UpdateConfig)
 
-    return ServiceSpec(
+    spec = ServiceSpec(
         annotations=Annotations(name=name),
         replicas=replicas,
         task=TaskSpec(runtime=ContainerSpec(
             command=shlex.split(command))),
     )
+    if auto_rollback:
+        # fail-storm services must recover WITHOUT operator action: a
+        # broken rollout trips max_failure_ratio and rolls back
+        # (orchestrator wave planner; docs/orchestrator.md)
+        from ..api.types import UpdateFailureAction
+
+        spec.update = UpdateConfig(
+            parallelism=2, monitor=2.0,
+            failure_action=UpdateFailureAction.ROLLBACK,
+            max_failure_ratio=0.0)
+    return spec
 
 
 def _retryable_update_error(exc: Exception) -> bool:
@@ -374,19 +386,27 @@ def run_churn(ctl, *, duration: float, replicas: int, rng: random.Random,
               services: int = 1, scale_step: int = 2,
               storm_every: int = 3, interval: float = 0.5,
               command: str = "sleep 3600",
+              fail_storm_every: int = 0,
               name_prefix: str | None = None,
               progress=None, on_service=None) -> dict:
     """The continuous-churn load generator: every `interval` one service
     gets either a ROLLOUT STORM (env bump → every task replaced through
-    the updater) or a scale up/down of `scale_step`. All randomness
-    comes from `rng`, so a seeded run replays the same schedule.
-    Returns {service_ids, rounds, storms, scales}."""
+    the updater) or a scale up/down of `scale_step`. With
+    `fail_storm_every` = M, every Mth storm pushes a BROKEN rollout (a
+    command that exits immediately) against a service configured with
+    failure_action=rollback — the orchestrator's wave planner must
+    auto-rollback it, and the report counts observed rollbacks (the
+    ISSUE 14 rolling-update-storm scenario against a live cluster).
+    All randomness comes from `rng`, so a seeded run replays the same
+    schedule. Returns {service_ids, rounds, storms, fail_storms,
+    rollbacks_observed, scales}."""
     name_prefix = name_prefix or f"bench-{int(time.time())}"
     svcs = []
     try:
         for i in range(services):
             svc = ctl.create_service(
-                _service_spec(f"{name_prefix}-{i}", replicas, command))
+                _service_spec(f"{name_prefix}-{i}", replicas, command,
+                              auto_rollback=bool(fail_storm_every)))
             if on_service is not None:
                 on_service(svc)        # e.g. collector.allow(svc.id)
             svcs.append(svc)
@@ -399,7 +419,7 @@ def run_churn(ctl, *, duration: float, replicas: int, rng: random.Random,
             except Exception:
                 pass
         raise
-    rounds = storms = scales = failed = 0
+    rounds = storms = scales = failed = fail_storms = 0
     deadline = time.monotonic() + duration
     while time.monotonic() < deadline:
         rounds += 1
@@ -408,11 +428,23 @@ def run_churn(ctl, *, duration: float, replicas: int, rng: random.Random,
         # failed would certify a load profile that never materialized
         try:
             if storm_every and rounds % storm_every == 0:
-                def storm(spec, n=rounds):
+                broken = (fail_storm_every
+                          and storms % fail_storm_every
+                          == fail_storm_every - 1)
+
+                def storm(spec, n=rounds, broken=broken):
                     spec.task.runtime.env = [f"BENCH_STORM={n}"]
+                    if broken:
+                        # a rollout that cannot start: every replacement
+                        # exits at once, the monitor counts the deaths,
+                        # and the rollback policy must recover the
+                        # service without operator action
+                        spec.task.runtime.command = ["false"]
 
                 _update_with_retry(ctl, svc.id, storm)
                 storms += 1
+                if broken:
+                    fail_storms += 1
             else:
                 delta = rng.choice([-scale_step, scale_step])
 
@@ -427,8 +459,23 @@ def run_churn(ctl, *, duration: float, replicas: int, rng: random.Random,
         if progress is not None:
             progress(rounds)
         time.sleep(interval)
+    rollbacks = 0
+    if fail_storms:
+        # census the recoveries: services whose status reached a
+        # rollback_* family during the run (rollback_completed once
+        # reconverged; the --slo settle window gives them time)
+        for s in svcs:
+            try:
+                cur = ctl.get_service(s.id)
+                state = (cur.update_status or {}).get("state", "")
+                if state.startswith("rollback"):
+                    rollbacks += 1
+            except Exception:
+                pass
     return {"service_ids": [s.id for s in svcs], "rounds": rounds,
-            "storms": storms, "scales": scales, "failed_rounds": failed}
+            "storms": storms, "fail_storms": fail_storms,
+            "rollbacks_observed": rollbacks, "scales": scales,
+            "failed_rounds": failed}
 
 
 # -------------------------------------------------------------------- report
@@ -497,6 +544,12 @@ def main(argv=None) -> int:
     ap.add_argument("--scale-step", type=int, default=2)
     ap.add_argument("--storm-every", type=int, default=3,
                     help="every Nth churn round is a rollout storm")
+    ap.add_argument("--fail-storm-every", type=int, default=0,
+                    metavar="M",
+                    help="every Mth storm is a BROKEN rollout (exits "
+                         "immediately) against auto-rollback services; "
+                         "the report counts observed rollbacks "
+                         "(rolling-update storm scenario)")
     ap.add_argument("--interval", type=float, default=0.5,
                     help="churn round interval seconds")
     ap.add_argument("--settle", type=float, default=15.0,
@@ -562,6 +615,7 @@ def main(argv=None) -> int:
                 rng=random.Random(args.seed), services=args.services,
                 scale_step=args.scale_step, storm_every=args.storm_every,
                 interval=args.interval, command=args.command,
+                fail_storm_every=args.fail_storm_every,
                 on_service=lambda s: collector.allow(s.id))
             created_ids = churn_stats["service_ids"]
             # SETTLE before evaluating: the churn cutoff right-censors

@@ -190,6 +190,75 @@ def _diff(eligible, task_nodes):
     return eligible & ~has, ~eligible & has
 
 
+# ------------------------------------------------- replicated slot state
+# ISSUE 14: the REPLICATED orchestrator's per-service slot census — the
+# batched reconciler's one vectorized pass over the columnar task table.
+# A slot is "used" when any desired<=RUNNING task occupies it, "runnable"
+# when any of its tasks is runnable, "running" when any is observed
+# RUNNING. All scatters are FLAT 1D (s * n_slots + slot) per the broken
+# 2D-scatter-add rule (see task_count_flat above).
+
+@functools.partial(jax.jit, static_argnames=("n_services", "n_slots"))
+def replica_slot_state(service_idx, slot, runnable, running,
+                       n_services: int, n_slots: int):
+    """service_idx int32[T], slot int32[T] (already clipped to
+    [0, n_slots)), runnable/running bool[T]. Returns (slot_used,
+    slot_runnable, slot_running) as flat bool[n_services * n_slots]
+    plus runnable_slots int32[n_services]."""
+    key = service_idx * n_slots + slot
+    flat = n_services * n_slots
+    used = jnp.zeros(flat, bool).at[key].max(True)
+    slot_runnable = jnp.zeros(flat, bool).at[key].max(runnable)
+    slot_running = jnp.zeros(flat, bool).at[key].max(running)
+    runnable_slots = slot_runnable.reshape(
+        n_services, n_slots).sum(axis=1).astype(jnp.int32)
+    return used, slot_runnable, slot_running, runnable_slots
+
+
+def replica_slot_state_np(service_idx, slot, runnable, running,
+                          n_services: int, n_slots: int):
+    """numpy mirror of `replica_slot_state` (small-scale path and parity
+    oracle — exact boolean algebra, identical either way)."""
+    import numpy as np
+
+    # HOST numpy only (never traced): 64-bit keys so a 100k-service
+    # census cannot overflow the flat index — the jit twin above stays
+    # int32 under the no-x64 rule  # lint: allow(int64-in-kernel)
+    key = service_idx.astype(np.int64) * n_slots + slot
+    flat = n_services * n_slots
+    used = np.zeros(flat, bool)
+    used[key] = True
+    slot_runnable = np.zeros(flat, bool)
+    np.maximum.at(slot_runnable, key, runnable)
+    slot_running = np.zeros(flat, bool)
+    np.maximum.at(slot_running, key, running)
+    runnable_slots = slot_runnable.reshape(
+        n_services, n_slots).sum(axis=1).astype(np.int32)
+    return used, slot_runnable, slot_running, runnable_slots
+
+
+def compute_slot_state(service_idx, slot, runnable, running,
+                       n_services: int, n_slots: int):
+    """Backend-selecting wrapper (the compute_diff shape): TPU kernel
+    above DIFF_THRESHOLD on the flat census size, numpy below — and
+    numpy AGAIN above 2^31 cells: the kernel's flat key is int32 (no
+    x64 in kernels) and would silently WRAP, the same
+    wrong-results-without-error class as the 2D scatter-add bug; the
+    numpy mirror's int64 keys are exact at any size."""
+    import numpy as np
+
+    flat = n_services * n_slots
+    if DIFF_THRESHOLD <= flat < 2 ** 31:
+        out = replica_slot_state(
+            jnp.asarray(service_idx, jnp.int32), jnp.asarray(slot, jnp.int32),
+            jnp.asarray(runnable), jnp.asarray(running),
+            n_services, n_slots)
+        return tuple(np.asarray(a) for a in out)
+    return replica_slot_state_np(
+        np.asarray(service_idx, np.int32), np.asarray(slot, np.int32),
+        np.asarray(runnable), np.asarray(running), n_services, n_slots)
+
+
 def global_diff_np(eligible, task_nodes):
     """numpy mirror of `global_diff` (small-scale path and parity oracle)."""
     import numpy as np

@@ -18,6 +18,12 @@ log = logging.getLogger("swarmkit_tpu.orchestrator")
 
 class EventLoopComponent:
     name = "component"
+    # burst drain bound: after a blocking get, up to this many queued
+    # events are consumed without sleeping before flush_events() runs —
+    # batching components (the batched replicated orchestrator) coalesce
+    # a mass-update storm into ONE vectorized pass per burst instead of
+    # one store transaction per event
+    MAX_DRAIN = 256
 
     def __init__(self, store: MemoryStore):
         self.store = store
@@ -43,6 +49,11 @@ class EventLoopComponent:
 
     def handle(self, event):
         raise NotImplementedError
+
+    def flush_events(self):
+        """Called after each drained event burst (and before going back
+        to blocking on the channel). Components that coalesce work
+        across events (batched reconcile passes) apply it here."""
 
     def idle(self):
         """Called when no events arrived within the poll interval."""
@@ -77,12 +88,39 @@ class EventLoopComponent:
                     continue
                 except ChannelClosed:
                     return
+                closed = False
+                drained = 1
+                while True:
+                    try:
+                        self.handle(ev)
+                    except Exception as exc:
+                        if leadership_lost(exc):
+                            log.info("%s: leadership lost; stopping",
+                                     self.name)
+                            return
+                        log.exception("%s: error handling %r",
+                                      self.name, ev)
+                    # drain the burst without sleeping so flush_events
+                    # sees the whole storm at once; never pop an event
+                    # this burst won't handle (budget checked BEFORE
+                    # the pop, or the 257th event would be dropped)
+                    if drained >= self.MAX_DRAIN:
+                        break
+                    try:
+                        ev = ch.try_get()
+                    except ChannelClosed:
+                        closed, ev = True, None
+                    if ev is None:
+                        break
+                    drained += 1
                 try:
-                    self.handle(ev)
+                    self.flush_events()
                 except Exception as exc:
                     if leadership_lost(exc):
                         log.info("%s: leadership lost; stopping", self.name)
                         return
-                    log.exception("%s: error handling %r", self.name, ev)
+                    log.exception("%s: flush pass failed", self.name)
+                if closed:
+                    return
         finally:
             self.store.queue.stop_watch(ch)

@@ -11,7 +11,6 @@ here, shared with the global orchestrator via OrchestratorBase.
 from __future__ import annotations
 
 import logging
-from collections import defaultdict
 
 from ..api.objects import (
     EventCommit,
@@ -25,6 +24,13 @@ from ..api.objects import (
 from ..api.types import NodeAvailability, NodeStatusState, TaskState
 from ..store import by
 from .base import EventLoopComponent
+from .batched import (
+    BatchedReconciler,
+    ReconcileDecision,
+    fill_slots,
+    plane_enabled,
+    victim_order,
+)
 from .restart import RestartSupervisor
 from .task import (
     is_replicated,
@@ -32,11 +38,59 @@ from .task import (
     new_task,
     slot_runnable,
     slots_by_service,
-    task_runnable,
 )
 from .updater import UpdateSupervisor
 
 log = logging.getLogger("swarmkit_tpu.orchestrator")
+
+# bursts at or below this size skip the columnar pass (it scans the
+# whole task table; an indexed per-service reconcile is cheaper until
+# the burst amortizes the scan)
+SMALL_RECONCILE_BATCH = 4
+
+
+def decide_service(service, tasks) -> ReconcileDecision:
+    """The scalar reconcile DECISION (replicated/services.go:95-190),
+    separated from application: slot census over the live tasks
+    (desired <= RUNNING), scale-up fills / scale-down victims via the
+    shared primitives in orchestrator/batched.py, and the dirty-slot
+    set for the rolling updater. The batched reconciler's vectorized
+    pass is pinned decision-identical to this function (the ≥20-seed
+    fuzz in tests/test_batched_orch.py)."""
+    d = ReconcileDecision()
+    slots = slots_by_service(tasks).get(service.id, {})
+    runnable = {
+        slot: ts for slot, ts in slots.items() if slot_runnable(ts)
+    }
+    specified = service.spec.replicas
+    if len(runnable) < specified:
+        # scale up: fill the lowest free slot numbers
+        d.create_slots = fill_slots(set(slots.keys()),
+                                    specified - len(runnable))
+    elif len(runnable) > specified:
+        # scale down: keep running slots on least-loaded nodes,
+        # iteratively recomputing load after each pick (victim_order)
+        summaries = {
+            slot: (any(t.status.state == TaskState.RUNNING for t in ts),
+                   [t.node_id for t in ts if t.node_id])
+            for slot, ts in runnable.items()
+        }
+        d.victim_slots = victim_order(summaries,
+                                      len(runnable) - specified)
+    # dirty slots (spec changed) → rolling updater; normalized slot /
+    # task-id order so both deciders emit the identical structure
+    d.dirty_slots = [
+        sorted(runnable[slot], key=lambda t: t.id)
+        for slot in sorted(runnable)
+        if any(is_task_dirty(service, t) for t in runnable[slot])
+    ]
+    # a non-terminal update status with no dirty slot left still needs
+    # its pass kicked (the restart supervisor can converge the slots on
+    # its own; only the update pass writes the terminal status)
+    d.kick_update = not d.dirty_slots and (
+        (service.update_status or {}).get("state")
+        in ("updating", "rollback_started"))
+    return d
 
 
 class ReplicatedOrchestrator(EventLoopComponent):
@@ -46,6 +100,12 @@ class ReplicatedOrchestrator(EventLoopComponent):
         super().__init__(store)
         self.restart = RestartSupervisor(store)
         self.updater = UpdateSupervisor(store, self.restart)
+        # batched orchestration plane (ISSUE 14): vectorized reconcile
+        # passes over the columnar hot columns; scalar per-service path
+        # stays the oracle (SWARMKIT_TPU_NO_BATCHED_ORCH=1 reverts)
+        self.batched: BatchedReconciler | None = (
+            BatchedReconciler(store) if plane_enabled(store) else None)
+        self._pending_reconcile: set[str] = set()
 
     def stop(self):
         self.updater.stop()
@@ -67,8 +127,13 @@ class ReplicatedOrchestrator(EventLoopComponent):
             check_tasks(self.store, self.restart, is_replicated)
         except Exception:
             log.exception("%s: startup task fix-up failed", self.name)
-        for s in services:
-            self.reconcile(s.id)
+        if self.batched is not None:
+            # one vectorized classification pass instead of S serial
+            # find_tasks walks; only actionable services pay a tx
+            self.reconcile_many([s.id for s in services])
+        else:
+            for s in services:
+                self.reconcile(s.id)
 
     # ---------------------------------------------------------------- events
     def handle(self, event):
@@ -79,7 +144,12 @@ class ReplicatedOrchestrator(EventLoopComponent):
                 # removal (deallocator.go waits for the last task)
                 self._delete_service_tasks(event.obj)
             elif is_replicated(event.obj):
-                self.reconcile(event.obj.id)
+                if self.batched is not None:
+                    # coalesce the burst; flush_events applies ONE
+                    # vectorized pass over it
+                    self._pending_reconcile.add(event.obj.id)
+                else:
+                    self.reconcile(event.obj.id)
         elif isinstance(event, EventDelete) and isinstance(event.obj, Service):
             self._delete_service_tasks(event.obj)
         elif isinstance(event, EventUpdate) and isinstance(event.obj, Task):
@@ -87,88 +157,99 @@ class ReplicatedOrchestrator(EventLoopComponent):
         elif isinstance(event, EventDelete) and isinstance(event.obj, Task):
             t = event.obj
             if t.service_id:
-                self.reconcile(t.service_id)
+                if self.batched is not None:
+                    self._pending_reconcile.add(t.service_id)
+                else:
+                    self.reconcile(t.service_id)
         elif isinstance(event, EventUpdate) and isinstance(event.obj, Node):
             self._handle_node_change(event.obj)
 
+    def flush_events(self):
+        if not self._pending_reconcile:
+            return
+        ids = sorted(self._pending_reconcile)
+        self._pending_reconcile.clear()
+        try:
+            self.reconcile_many(ids)
+        except Exception:
+            # a crashed burst must not drop its reconciles (the
+            # dispatcher's crashed-flush re-dirty contract): re-dirty
+            # everything and let idle()/the next burst retry — the
+            # per-service reconcile is idempotent
+            self._pending_reconcile.update(ids)
+            raise
+
+    def idle(self):
+        # retry a re-dirtied burst even when no further event arrives
+        self.flush_events()
+
     # ------------------------------------------------------------- reconcile
     def reconcile(self, service_id: str):
-        """reference: replicated/services.go:95-190."""
+        """reference: replicated/services.go:95-190 (scalar path: decide
+        + apply in one transaction)."""
+        self.store.update(
+            lambda tx: self._reconcile_in_tx(tx, service_id))
 
-        def cb(tx):
-            service = tx.get_service(service_id)
-            if service is None or not is_replicated(service) \
-                    or service.pending_delete:
-                return
-            tasks = [
-                t for t in tx.find_tasks(by.ByServiceID(service_id))
-                if t.desired_state <= TaskState.RUNNING
-            ]
-            slots = slots_by_service(tasks).get(service_id, {})
-            runnable = {
-                slot: ts for slot, ts in slots.items() if slot_runnable(ts)
-            }
-            specified = service.spec.replicas
+    def _reconcile_in_tx(self, tx, service_id: str):
+        service = tx.get_service(service_id)
+        if service is None or not is_replicated(service) \
+                or service.pending_delete:
+            return
+        tasks = [
+            t for t in tx.find_tasks(by.ByServiceID(service_id))
+            if t.desired_state <= TaskState.RUNNING
+        ]
+        decision = decide_service(service, tasks)
+        slots = slots_by_service(tasks).get(service_id, {})
+        for slot_num in decision.create_slots:
+            tx.create(new_task(None, service, slot_num))
+        for slot_num in decision.victim_slots:
+            for t in slots.get(slot_num, ()):
+                cur = tx.get_task(t.id)
+                if cur is not None \
+                        and cur.desired_state < TaskState.REMOVE:
+                    cur = cur.copy()
+                    cur.desired_state = TaskState.REMOVE
+                    tx.update(cur)
+        if decision.dirty_slots or decision.kick_update:
+            self.updater.update(service, decision.dirty_slots)
 
-            if len(runnable) < specified:
-                # scale up: fill the lowest free slot numbers
-                used = set(slots.keys())
-                slot_num = 1
-                to_create = specified - len(runnable)
-                created = 0
-                while created < to_create:
-                    if slot_num not in used:
-                        t = new_task(None, service, slot_num)
-                        tx.create(t)
-                        used.add(slot_num)
-                        created += 1
-                    slot_num += 1
-            elif len(runnable) > specified:
-                # scale down: keep running slots on least-loaded nodes
-                # (reference sorts by running-state then node balance)
-                node_load: dict[str, int] = defaultdict(int)
-                for ts in runnable.values():
-                    for t in ts:
-                        if t.node_id:
-                            node_load[t.node_id] += 1
+    def reconcile_many(self, service_ids: list[str]):
+        """Batched reconcile (ISSUE 14): classify every service in one
+        columnar array pass; steady services cost zero transactions and
+        zero object reads. Actionable services re-validate IN-TX with
+        the scalar decision code (the bulk_reconcile shape — decisions
+        from the snapshot select WHO pays a transaction, the tx decides
+        WHAT it does), batched into one store.batch. Dirty-only
+        services just feed the updater."""
+        if not service_ids:
+            return
+        if self.batched is None or \
+                len(service_ids) <= SMALL_RECONCILE_BATCH:
+            # tiny bursts (a lone task-delete event) keep the indexed
+            # per-service path: the columnar pass scans ALL task rows,
+            # which only pays off when the burst amortizes it (the
+            # compute_slot_state DIFF_THRESHOLD idea, one level up)
+            for sid in service_ids:
+                self.reconcile(sid)
+            return
+        view = self.store.view()
+        decisions = self.batched.decide_many(service_ids, view=view)
+        actionable = {sid for sid, d in decisions.items() if d.actionable}
+        for sid, d in decisions.items():
+            if (d.dirty_slots or d.kick_update) and sid not in actionable:
+                service = view.get_service(sid)
+                if service is not None:
+                    self.updater.update(service, d.dirty_slots)
 
-                # iterative removal: repeatedly drop a slot from the
-                # currently busiest node (non-running slots first),
-                # recomputing load after each pick so ties rebalance —
-                # a static sort would drain one node completely
-                def removal_key(item):
-                    slot, ts = item
-                    running = any(
-                        t.status.state == TaskState.RUNNING for t in ts)
-                    load = max((node_load.get(t.node_id, 0)
-                                for t in ts if t.node_id), default=0)
-                    # non-running slots go first, then busiest node,
-                    # then highest slot number
-                    return (0 if not running else 1, -load, -slot)
+        if actionable:
+            def apply(batch):
+                for sid in sorted(actionable):
+                    def one(tx, sid=sid):
+                        self._reconcile_in_tx(tx, sid)
+                    batch.update(one)
 
-                remaining = dict(runnable)
-                for _ in range(len(runnable) - specified):
-                    slot, ts = min(remaining.items(), key=removal_key)
-                    del remaining[slot]
-                    for t in ts:
-                        if t.node_id:
-                            node_load[t.node_id] = max(
-                                node_load.get(t.node_id, 1) - 1, 0)
-                        cur = tx.get_task(t.id)
-                        if cur is not None and cur.desired_state < TaskState.REMOVE:
-                            cur = cur.copy()
-                            cur.desired_state = TaskState.REMOVE
-                            tx.update(cur)
-
-            # dirty slots (spec changed) → rolling updater
-            dirty = [
-                ts for ts in runnable.values()
-                if any(is_task_dirty(service, t) for t in ts)
-            ]
-            if dirty:
-                self.updater.update(service, dirty)
-
-        self.store.update(cb)
+            self.store.batch(apply)
 
     # ----------------------------------------------------------- task events
     def _handle_task_change(self, task: Task):
@@ -197,7 +278,10 @@ class ReplicatedOrchestrator(EventLoopComponent):
         if not down:
             return
 
+        batched = self.batched is not None
+
         def cb(tx):
+            pairs = []
             for task in tx.find_tasks(by.ByNodeID(node.id)):
                 if task.desired_state > TaskState.RUNNING:
                     continue
@@ -206,7 +290,14 @@ class ReplicatedOrchestrator(EventLoopComponent):
                 service = tx.get_service(task.service_id)
                 if service is None or not is_replicated(service):
                     continue
-                self.restart.restart(tx, None, service, task)
+                if batched:
+                    pairs.append((service, task))
+                else:
+                    self.restart.restart(tx, None, service, task)
+            if pairs:
+                # one vectorized restart gate for the whole node's
+                # victims (bit-identical to the sequential calls)
+                self.restart.restart_many(tx, None, pairs)
 
         self.store.update(cb)
 

@@ -10,7 +10,6 @@ desired=READY, and promotes it to RUNNING after the configured delay
 from __future__ import annotations
 
 import threading
-import time
 from dataclasses import dataclass, field
 
 from ..analysis.lockgraph import make_lock
@@ -32,12 +31,18 @@ class InstanceRestartInfo:
 
 
 class RestartSupervisor:
-    def __init__(self, store: MemoryStore):
+    def __init__(self, store: MemoryStore, clock=None):
+        from ..utils.clock import REAL_CLOCK
+
         self.store = store
         self._history: dict[tuple[str, int | str], InstanceRestartInfo] = {}
         self._delays: dict[str, threading.Timer] = {}
         self._lock = make_lock('orchestrator.restart.lock')
         self._stopped = False
+        # injectable time source: the batched restart gate
+        # (orchestrator/batched.py) and FakeClock window-edge pins read
+        # the same clock the scalar gate does
+        self._clock = clock or REAL_CLOCK
 
     def stop(self):
         with self._lock:
@@ -61,6 +66,29 @@ class RestartSupervisor:
         if not self.should_restart(task, service):
             return
 
+        self._spawn_replacement(tx, cluster, service, task)
+
+    def restart_many(self, tx, cluster, pairs) -> None:
+        """Batch form of `restart` for many dead tasks in ONE
+        transaction (node-down rescheduling): the gate runs VECTORIZED
+        (orchestrator/batched.py batch_should_restart, bit-identical to
+        sequential scalar calls including interleaved history records),
+        then each granted task spawns its replacement exactly like the
+        scalar path."""
+        from .batched import batch_should_restart
+
+        grants = batch_should_restart(self, pairs)
+        for (service, task), granted in zip(pairs, grants):
+            cur = tx.get_task(task.id)
+            if cur is not None and cur.desired_state < TaskState.SHUTDOWN:
+                cur = cur.copy()
+                mark_shutdown(cur)
+                tx.update(cur)
+            if granted:
+                self._spawn_replacement(tx, cluster, service, task)
+
+    def _spawn_replacement(self, tx, cluster, service: Service,
+                           task: Task) -> None:
         replacement = new_task(cluster, service, task.slot,
                                task.node_id if not task.slot else "")
         replacement.desired_state = TaskState.READY
@@ -91,7 +119,7 @@ class RestartSupervisor:
                     if info.total_restarts >= restart_policy.max_attempts:
                         return False
                 else:
-                    now = time.time()
+                    now = self._clock.time()
                     recent = [
                         r for r in info.restarted_instances
                         if now - r.timestamp <= restart_policy.window
@@ -110,7 +138,8 @@ class RestartSupervisor:
         info = self._history.setdefault(key, InstanceRestartInfo())
         info.total_restarts += 1
         if service.spec.task.restart.window > 0:
-            info.restarted_instances.append(RestartedInstance(time.time()))
+            info.restarted_instances.append(
+                RestartedInstance(self._clock.time()))
 
     def resume_delay(self, task: Task, service: Service) -> None:
         """Re-arm the READY→RUNNING promote timer for a task found in
@@ -145,15 +174,14 @@ class RestartSupervisor:
         with self._lock:
             if self._stopped:
                 return
-            if delay <= 0:
-                # immediate promote still goes through a fresh transaction
-                # (we are called inside one that created the task)
-                timer = threading.Timer(0.0, promote)
-            else:
-                timer = threading.Timer(delay, promote)
-            timer.daemon = True
-            self._delays[task_id] = timer
-            timer.start()
+            # served by the injected clock's timer service (the shared
+            # TimerWheel under the real clock — no thread per armed
+            # delay; FakeClock in tests fires on advance()). A zero
+            # delay still goes through the wheel: we are called inside
+            # the transaction that created the task, so the promote
+            # must run on a fresh one
+            self._delays[task_id] = self._clock.timer(max(delay, 0.0),
+                                                      promote)
 
     def cancel_delay(self, task_id: str) -> None:
         with self._lock:

@@ -31,6 +31,163 @@ from .task import is_task_dirty, mark_shutdown, new_task
 log = logging.getLogger("swarmkit_tpu.orchestrator.updater")
 
 
+# --------------------------------------------------------- shared protocol
+# The slot-flip / verdict primitives shared by BOTH rolling-update
+# implementations — the per-service threaded `Updater` (the scalar
+# oracle) and the shared `UpdateWavePlanner` (orchestrator/batched.py).
+# They are the pair's common vocabulary: the mirror registry
+# (analysis/mirror.py, pair "orch-update") pins that both members keep
+# riding these instead of growing private store-write paths.
+
+def dirty_slots(store, service) -> list[list[Task]]:
+    """Runnable slots whose live tasks drifted from the service spec —
+    the unit of rolling-update work (updater.go slotsNeedingUpdate)."""
+    from .task import slot_runnable, slots_by_service
+
+    tasks = store.view().find_tasks(by.ByServiceID(service.id))
+    slots = slots_by_service(tasks).get(service.id, {})
+    dirty = []
+    for slot, ts in sorted(slots.items()):
+        live = [t for t in ts if t.desired_state <= TaskState.RUNNING]
+        if not live or not slot_runnable(live):
+            continue
+        if any(is_task_dirty(service, t) for t in live):
+            dirty.append(live)
+    return dirty
+
+
+def create_replacement(store, service_id: str, slot: int,
+                       desired: TaskState,
+                       shutdown: list[Task] = ()) -> str | None:
+    """Create the fresh-spec replacement for one slot; with `shutdown`
+    the old tasks come down in the SAME transaction (stop-first — the
+    slot must never look empty to the orchestrator's reconcile,
+    updater.go:385-409)."""
+    new_task_id: list[str | None] = [None]
+
+    def cb(tx):
+        cur_service = tx.get_service(service_id)
+        if cur_service is None:
+            return
+        replacement = new_task(None, cur_service, slot)
+        replacement.desired_state = desired
+        tx.create(replacement)
+        for t in shutdown:
+            cur = tx.get_task(t.id)
+            if cur is not None and cur.desired_state < TaskState.SHUTDOWN:
+                cur = cur.copy()
+                mark_shutdown(cur)
+                tx.update(cur)
+        new_task_id[0] = replacement.id
+
+    store.update(cb)
+    return new_task_id[0]
+
+
+def shutdown_tasks(store, slot_tasks: list[Task]) -> None:
+    def cb(tx):
+        for t in slot_tasks:
+            cur = tx.get_task(t.id)
+            if cur is not None and cur.desired_state < TaskState.SHUTDOWN:
+                cur = cur.copy()
+                mark_shutdown(cur)
+                tx.update(cur)
+
+    store.update(cb)
+
+
+def remove_task(store, task_id: str) -> None:
+    def cb(tx):
+        cur = tx.get_task(task_id)
+        if cur is not None and cur.desired_state < TaskState.REMOVE:
+            cur = cur.copy()
+            cur.desired_state = TaskState.REMOVE
+            tx.update(cur)
+
+    store.update(cb)
+
+
+def promote_task(store, task_id: str) -> None:
+    def cb(tx):
+        cur = tx.get_task(task_id)
+        if cur is not None and cur.desired_state == TaskState.READY:
+            cur = cur.copy()
+            cur.desired_state = TaskState.RUNNING
+            tx.update(cur)
+
+    store.update(cb)
+
+
+def rollback_service(store, service_id: str) -> None:
+    """Flip the spec back to previous_spec and mark ROLLBACK_STARTED
+    (updater.go:566-626); the resulting service event re-drives a fresh
+    update pass in rollback mode."""
+
+    def cb(tx):
+        cur = tx.get_service(service_id)
+        if cur is None or cur.previous_spec is None:
+            return
+        cur = cur.copy()
+        cur.spec, cur.previous_spec = cur.previous_spec, None
+        cur.spec_version.index += 1
+        cur.update_status = {
+            "state": UpdateStatusState.ROLLBACK_STARTED.value,
+            "message": "update rolled back due to failures",
+            "timestamp": time.time(),
+        }
+        tx.update(cur)
+
+    store.update(cb)
+
+
+def set_update_status(store, service_id: str, state: UpdateStatusState,
+                      message: str) -> None:
+    def cb(tx):
+        cur = tx.get_service(service_id)
+        if cur is None:
+            return
+        cur = cur.copy()
+        cur.update_status = {"state": state.value, "message": message,
+                             "timestamp": time.time()}
+        tx.update(cur)
+
+    try:
+        store.update(cb)
+    except Exception:
+        pass
+
+
+def finalize_update(store, service_id: str, cfg, rolling_back: bool,
+                    failed_out: bool, n_failed: int, total: int) -> None:
+    """The shared terminal verdict: failure-policy dispatch when the
+    ratio tripped (rollback / pause / continue-with-failures), else the
+    completed status for the running kind (updater.go:204-260). A
+    failing ROLLBACK cannot roll back again: it pauses."""
+    kind = "rollback" if rolling_back else "update"
+    paused_state = (UpdateStatusState.ROLLBACK_PAUSED if rolling_back
+                    else UpdateStatusState.PAUSED)
+    done_state = (UpdateStatusState.ROLLBACK_COMPLETED if rolling_back
+                  else UpdateStatusState.COMPLETED)
+    if failed_out:
+        if cfg.failure_action == UpdateFailureAction.ROLLBACK \
+                and not rolling_back:
+            rollback_service(store, service_id)
+        elif cfg.failure_action == UpdateFailureAction.ROLLBACK:
+            set_update_status(
+                store, service_id, paused_state,
+                f"rollback paused due to failure ratio {n_failed}/{total}")
+        elif cfg.failure_action == UpdateFailureAction.PAUSE:
+            set_update_status(
+                store, service_id, paused_state,
+                f"{kind} paused due to failure ratio {n_failed}/{total}")
+        else:
+            set_update_status(
+                store, service_id, done_state,
+                f"{kind} completed with {n_failed} failures")
+        return
+    set_update_status(store, service_id, done_state, f"{kind} completed")
+
+
 class Updater(threading.Thread):
     def __init__(self, store, restart, service_id: str, supervisor):
         super().__init__(daemon=True, name=f"updater-{service_id[:8]}")
@@ -221,51 +378,20 @@ class Updater(threading.Thread):
                 return
             poll_failures()
 
-        kind = "rollback" if rolling_back else "update"
-        paused_state = (UpdateStatusState.ROLLBACK_PAUSED if rolling_back
-                        else UpdateStatusState.PAUSED)
-        done_state = (UpdateStatusState.ROLLBACK_COMPLETED if rolling_back
-                      else UpdateStatusState.COMPLETED)
         if over_threshold() or aborted:
             with lock:
                 total = max(counters["updated"], 1)
                 n_failed = len(failed)
-            if cfg.failure_action == UpdateFailureAction.ROLLBACK \
-                    and not rolling_back:
-                self._rollback(self.store.view().get_service(self.service_id))
-            elif cfg.failure_action == UpdateFailureAction.ROLLBACK:
-                # a failing rollback cannot roll back again: pause
-                # (updater.go:244 treats this as rollback failure)
-                self._set_update_status(
-                    paused_state,
-                    f"rollback paused due to failure ratio "
-                    f"{n_failed}/{total}")
-            elif cfg.failure_action == UpdateFailureAction.PAUSE:
-                self._set_update_status(
-                    paused_state,
-                    f"{kind} paused due to failure ratio "
-                    f"{n_failed}/{total}")
-            else:
-                self._set_update_status(
-                    done_state,
-                    f"{kind} completed with {n_failed} failures")
+            finalize_update(self.store, self.service_id, cfg, rolling_back,
+                            True, n_failed, total)
             return
         if not self._cancel.is_set():
-            self._set_update_status(done_state, f"{kind} completed")
+            finalize_update(self.store, self.service_id, cfg, rolling_back,
+                            False, 0, 1)
 
     # ------------------------------------------------------------------ steps
     def _dirty_slots(self, service) -> list[list[Task]]:
-        tasks = self.store.view().find_tasks(by.ByServiceID(self.service_id))
-        from .task import slots_by_service, slot_runnable
-        slots = slots_by_service(tasks).get(self.service_id, {})
-        dirty = []
-        for slot, ts in sorted(slots.items()):
-            live = [t for t in ts if t.desired_state <= TaskState.RUNNING]
-            if not live or not slot_runnable(live):
-                continue
-            if any(is_task_dirty(service, t) for t in live):
-                dirty.append(live)
-        return dirty
+        return dirty_slots(self.store, service)
 
     # bound for the stop-first old-task drain
     SLOT_PHASE_TIMEOUT = 30.0
@@ -330,56 +456,17 @@ class Updater(threading.Thread):
 
     def _create_replacement(self, slot: int, desired: TaskState,
                             shutdown: list[Task] = ()) -> str | None:
-        new_task_id: list[str | None] = [None]
-
-        def cb(tx):
-            cur_service = tx.get_service(self.service_id)
-            if cur_service is None:
-                return
-            replacement = new_task(None, cur_service, slot)
-            replacement.desired_state = desired
-            tx.create(replacement)
-            for t in shutdown:
-                cur = tx.get_task(t.id)
-                if cur is not None and cur.desired_state < TaskState.SHUTDOWN:
-                    cur = cur.copy()
-                    mark_shutdown(cur)
-                    tx.update(cur)
-            new_task_id[0] = replacement.id
-
-        self.store.update(cb)
-        return new_task_id[0]
+        return create_replacement(self.store, self.service_id, slot,
+                                  desired, shutdown)
 
     def _shutdown_tasks(self, slot_tasks: list[Task]):
-        def cb(tx):
-            for t in slot_tasks:
-                cur = tx.get_task(t.id)
-                if cur is not None and cur.desired_state < TaskState.SHUTDOWN:
-                    cur = cur.copy()
-                    mark_shutdown(cur)
-                    tx.update(cur)
-
-        self.store.update(cb)
+        shutdown_tasks(self.store, slot_tasks)
 
     def _remove_task(self, task_id: str):
-        def cb(tx):
-            cur = tx.get_task(task_id)
-            if cur is not None and cur.desired_state < TaskState.REMOVE:
-                cur = cur.copy()
-                cur.desired_state = TaskState.REMOVE
-                tx.update(cur)
-
-        self.store.update(cb)
+        remove_task(self.store, task_id)
 
     def _promote(self, task_id: str):
-        def cb(tx):
-            cur = tx.get_task(task_id)
-            if cur is not None and cur.desired_state == TaskState.READY:
-                cur = cur.copy()
-                cur.desired_state = TaskState.RUNNING
-                tx.update(cur)
-
-        self.store.update(cb)
+        promote_task(self.store, task_id)
 
     def _wait_task_state(self, task_id: str, want: TaskState,
                          timeout: float | None = SLOT_PHASE_TIMEOUT) -> str:
@@ -418,48 +505,35 @@ class Updater(threading.Thread):
                 return
 
     def _rollback(self, service):
-        def cb(tx):
-            cur = tx.get_service(self.service_id)
-            if cur is None or cur.previous_spec is None:
-                return
-            cur = cur.copy()
-            cur.spec, cur.previous_spec = cur.previous_spec, None
-            cur.spec_version.index += 1
-            cur.update_status = {
-                "state": UpdateStatusState.ROLLBACK_STARTED.value,
-                "message": "update rolled back due to failures",
-                "timestamp": time.time(),
-            }
-            tx.update(cur)
-
-        self.store.update(cb)
+        rollback_service(self.store, self.service_id)
 
     def _set_update_status(self, state: UpdateStatusState, message: str):
-        def cb(tx):
-            cur = tx.get_service(self.service_id)
-            if cur is None:
-                return
-            cur = cur.copy()
-            cur.update_status = {"state": state.value, "message": message,
-                                 "timestamp": time.time()}
-            tx.update(cur)
-
-        try:
-            self.store.update(cb)
-        except Exception:
-            pass
+        set_update_status(self.store, self.service_id, state, message)
 
 
 class UpdateSupervisor:
-    """reference: update/updater.go Supervisor."""
+    """reference: update/updater.go Supervisor.
 
-    def __init__(self, store, restart):
+    With the batched orchestration plane enabled (the default; ISSUE 14,
+    SWARMKIT_TPU_NO_BATCHED_ORCH=1 reverts) updates run on the SHARED
+    `UpdateWavePlanner` — one thread schedules every service's
+    replacement waves instead of one thread per updating service. The
+    per-service threaded Updater above stays as the scalar oracle."""
+
+    def __init__(self, store, restart, clock=None):
         self.store = store
         self.restart = restart
         self._updaters: dict[str, Updater] = {}
         self._lock = make_lock('orchestrator.updater.supervisor')
+        from .batched import UpdateWavePlanner, plane_enabled
+
+        self.planner = (UpdateWavePlanner(store, restart, clock=clock)
+                        if plane_enabled(store) else None)
 
     def update(self, service, dirty_slots):
+        if self.planner is not None:
+            self.planner.update(service, dirty_slots)
+            return
         with self._lock:
             existing = self._updaters.get(service.id)
             if existing is not None and existing.is_alive():
@@ -474,6 +548,8 @@ class UpdateSupervisor:
                 del self._updaters[service_id]
 
     def stop(self):
+        if self.planner is not None:
+            self.planner.stop()
         with self._lock:
             updaters = list(self._updaters.values())
         for u in updaters:

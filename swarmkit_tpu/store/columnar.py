@@ -58,7 +58,108 @@ class IdVocab:
 
 
 _GROW = 1024
-COLUMNS = ("state", "desired", "version", "node_idx", "service_idx", "slot")
+COLUMNS = ("state", "desired", "version", "node_idx", "service_idx", "slot",
+           "spec_version")
+
+
+def _grow_columns(owner, cols, need: int) -> None:
+    """Shared capacity growth for every column mirror: double (or step
+    by _GROW) until `need` rows fit, zero-filling the tail. One policy
+    for ColumnarTasks and both hot sub-mirrors — change it here only."""
+    cap = getattr(owner, cols[0]).shape[0]
+    if need <= cap:
+        return
+    new_cap = cap
+    while new_cap < need:
+        new_cap = max(new_cap * 2, new_cap + _GROW)
+    for name in cols:
+        arr = getattr(owner, name)
+        grown = np.zeros(new_cap, arr.dtype)
+        grown[:cap] = arr
+        setattr(owner, name, grown)
+
+
+class ColumnarServices:
+    """Hot-column mirror of the SERVICE table (ISSUE 14): replicas /
+    spec-version / replicated-mode / pending-delete, indexed by the
+    SHARED service IdVocab of the task columns — `service_idx` values in
+    the task table are directly usable as row indices here. Row 0 (the
+    reserved empty id) is never valid. Like the task columns these are
+    DERIVED TRUTH kept in lockstep by `MemoryStore._commit`; the batched
+    orchestrator reads them so a steady reconcile pass over 100k
+    services touches zero service objects."""
+
+    def __init__(self, vocab: IdVocab, cap: int = _GROW):
+        self.vocab = vocab
+        cap = max(cap, len(vocab), 1)
+        self.replicas = np.zeros(cap, np.int64)
+        self.spec_version = np.zeros(cap, np.int64)
+        self.replicated = np.zeros(cap, bool)
+        self.pending_delete = np.zeros(cap, bool)
+        # non-terminal update status (updating / rollback_started): the
+        # reconciler must keep kicking the update pass until it writes
+        # a terminal status, even when no slot is dirty any more (the
+        # restart supervisor may converge the slots on its own)
+        self.in_update = np.zeros(cap, bool)
+        self.valid = np.zeros(cap, bool)
+
+    _COLS = ("replicas", "spec_version", "replicated", "pending_delete",
+             "in_update", "valid")
+
+    def upsert(self, service) -> int:
+        from ..api.types import ServiceMode
+
+        row = self.vocab.intern(service.id)
+        _grow_columns(self, self._COLS, row + 1)
+        self.replicas[row] = int(service.spec.replicas)
+        self.spec_version[row] = (service.spec_version.index
+                                  if service.spec_version is not None else -1)
+        self.replicated[row] = service.spec.mode == ServiceMode.REPLICATED
+        self.pending_delete[row] = bool(service.pending_delete)
+        self.in_update[row] = (service.update_status or {}).get(
+            "state") in ("updating", "rollback_started")
+        self.valid[row] = True
+        return row
+
+    def delete(self, service_id: str) -> None:
+        row = self.vocab.lookup(service_id)
+        if row > 0 and row < self.valid.shape[0]:
+            self.valid[row] = False
+
+    def row_of(self, service_id: str) -> int:
+        row = self.vocab.lookup(service_id)
+        if row <= 0 or row >= self.valid.shape[0] or not self.valid[row]:
+            return -1
+        return row
+
+
+class ColumnarNodes:
+    """Hot-column mirror of the NODE table: status state / availability,
+    indexed by the shared node IdVocab (task `node_idx` values are row
+    indices). The batched orchestrator's node-down victim scan reads
+    these instead of walking node objects."""
+
+    def __init__(self, vocab: IdVocab, cap: int = _GROW):
+        self.vocab = vocab
+        cap = max(cap, len(vocab), 1)
+        self.state = np.zeros(cap, np.int8)
+        self.availability = np.zeros(cap, np.int8)
+        self.valid = np.zeros(cap, bool)
+
+    _COLS = ("state", "availability", "valid")
+
+    def upsert(self, node) -> int:
+        row = self.vocab.intern(node.id)
+        _grow_columns(self, self._COLS, row + 1)
+        self.state[row] = int(node.status.state)
+        self.availability[row] = int(node.spec.availability)
+        self.valid[row] = True
+        return row
+
+    def delete(self, node_id: str) -> None:
+        row = self.vocab.lookup(node_id)
+        if row > 0 and row < self.valid.shape[0]:
+            self.valid[row] = False
 
 
 class ColumnarTasks:
@@ -82,7 +183,14 @@ class ColumnarTasks:
         self.node_idx = np.zeros(cap, np.int32)
         self.service_idx = np.zeros(cap, np.int32)
         self.slot = np.zeros(cap, np.int64)
+        # task spec-version index (-1 = None): the batched orchestrator's
+        # dirty-candidate filter (ISSUE 14) — version-mismatch rows are
+        # EXACTLY the set the scalar is_task_dirty would spec-compare
+        self.spec_version = np.zeros(cap, np.int64)
         self.valid = np.zeros(cap, bool)
+        # service / node hot columns over the SHARED vocabs (ISSUE 14)
+        self.service_cols = ColumnarServices(self.services, cap)
+        self.node_cols = ColumnarNodes(self.nodes, cap)
         # op counters (merged into store.op_counts views / debug/vars)
         self.stats: Counter = Counter()
 
@@ -90,22 +198,10 @@ class ColumnarTasks:
     def _cap(self) -> int:
         return self.state.shape[0]
 
+    _COLS = COLUMNS + ("valid",)
+
     def _ensure(self, rows_needed: int) -> None:
-        need = len(self.ids) + rows_needed
-        cap = self._cap()
-        if need <= cap:
-            return
-        new_cap = cap
-        while new_cap < need:
-            new_cap = max(new_cap * 2, new_cap + _GROW)
-        for col in COLUMNS:
-            arr = getattr(self, col)
-            grown = np.zeros(new_cap, arr.dtype)
-            grown[:cap] = arr
-            setattr(self, col, grown)
-        grown_valid = np.zeros(new_cap, bool)
-        grown_valid[:cap] = self.valid
-        self.valid = grown_valid
+        _grow_columns(self, self._COLS, len(self.ids) + rows_needed)
 
     def _alloc_row(self, task_id: str) -> int:
         if self._free:
@@ -134,6 +230,7 @@ class ColumnarTasks:
         node_idx = np.empty(n, np.int32)
         service_idx = np.empty(n, np.int32)
         slot = np.empty(n, np.int64)
+        spec_version = np.empty(n, np.int64)
         row_of = self._row
         for j, t in enumerate(tasks):
             row = row_of.get(t.id)
@@ -146,12 +243,15 @@ class ColumnarTasks:
             node_idx[j] = self.nodes.intern(t.node_id)
             service_idx[j] = self.services.intern(t.service_id)
             slot[j] = t.slot
+            spec_version[j] = (t.spec_version.index
+                               if t.spec_version is not None else -1)
         self.state[rows] = state
         self.desired[rows] = desired
         self.version[rows] = version
         self.node_idx[rows] = node_idx
         self.service_idx[rows] = service_idx
         self.slot[rows] = slot
+        self.spec_version[rows] = spec_version
         self.valid[rows] = True
         self.stats["rows_upserted"] += n
         self.stats["scatters"] += 1
@@ -182,6 +282,24 @@ class ColumnarTasks:
                 pending.append(action.obj)
         if pending:
             self.upsert_many(pending)
+
+    def apply_service_actions(self, actions: list) -> None:
+        """Commit-path lockstep hook for the service hot columns."""
+        for action in actions:
+            if action.kind == "delete":
+                self.service_cols.delete(action.obj.id)
+            else:
+                self.service_cols.upsert(action.obj)
+        self.stats["service_upserts"] += len(actions)
+
+    def apply_node_actions(self, actions: list) -> None:
+        """Commit-path lockstep hook for the node hot columns."""
+        for action in actions:
+            if action.kind == "delete":
+                self.node_cols.delete(action.obj.id)
+            else:
+                self.node_cols.upsert(action.obj)
+        self.stats["node_upserts"] += len(actions)
 
     # --------------------------------------------------- wave fast path
     def wave_codes(self, task_ids: list) -> tuple[np.ndarray, np.ndarray]:
@@ -277,17 +395,25 @@ class ColumnarTasks:
             "desired": self.desired[rows].copy(),
             "version": self.version[rows].copy(),
             "slot": self.slot[rows].copy(),
+            "spec_version": self.spec_version[rows].copy(),
             "node_ids": [self.nodes.name(i) for i in self.node_idx[rows]],
             "service_ids": [self.services.name(i)
                             for i in self.service_idx[rows]],
         }
 
     @classmethod
-    def rebuild(cls, tasks: list) -> "ColumnarTasks":
+    def rebuild(cls, tasks: list, services: list = (),
+                nodes: list = ()) -> "ColumnarTasks":
         """From-scratch mirror of a task list (the bit-equality oracle in
-        tests, and the restore path)."""
+        tests, and the restore path). `services`/`nodes` feed the hot
+        sub-mirrors (the restore path passes them; parity tests that
+        only compare task columns may omit them)."""
         col = cls(cap=max(len(tasks), 1))
         col.upsert_many(sorted(tasks, key=lambda t: t.id))
+        for s in sorted(services, key=lambda s: s.id):
+            col.service_cols.upsert(s)
+        for n in sorted(nodes, key=lambda n: n.id):
+            col.node_cols.upsert(n)
         return col
 
     @staticmethod
@@ -296,4 +422,5 @@ class ColumnarTasks:
                 or a["service_ids"] != b["service_ids"]:
             return False
         return all(np.array_equal(a[k], b[k])
-                   for k in ("state", "desired", "version", "slot"))
+                   for k in ("state", "desired", "version", "slot",
+                             "spec_version"))

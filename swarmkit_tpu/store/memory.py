@@ -396,6 +396,8 @@ class MemoryStore:
             col = self.columnar
             task_actions: list[StoreAction] | None = \
                 [] if col is not None else None
+            service_actions: list[StoreAction] = []
+            node_actions: list[StoreAction] = []
             if version_index is not None:
                 # replicated commits carry the raft entry index so object
                 # versions agree on every replica
@@ -412,6 +414,10 @@ class MemoryStore:
                     # batched scatter per commit (touchMeta has stamped
                     # the version by then for creates/updates)
                     task_actions.append(action)
+                elif task_actions is not None and table == "service":
+                    service_actions.append(action)
+                elif task_actions is not None and table == "node":
+                    node_actions.append(action)
                 if action.kind == StoreAction.DELETE:
                     stored = self._tables[table].pop(obj.id, None)
                     if stored is not None:
@@ -434,6 +440,10 @@ class MemoryStore:
                     events.append(EventUpdate(obj, old=old))
             if task_actions:
                 col.apply_actions(task_actions)
+            if service_actions:
+                col.apply_service_actions(service_actions)
+            if node_actions:
+                col.apply_node_actions(node_actions)
             events.append(EventCommit(version))
         self.queue.publish_all(events)
 
@@ -567,7 +577,9 @@ class MemoryStore:
             self._stale_tasks.clear()
             if self.columnar is not None:
                 self.columnar = ColumnarTasks.rebuild(
-                    list(self._tables["task"].values()))
+                    list(self._tables["task"].values()),
+                    services=list(self._tables["service"].values()),
+                    nodes=list(self._tables["node"].values()))
 
     # ------------------------------------------------- columnar wave plane
     def assign_wave(self, assignments: list[tuple[str, str]], *,

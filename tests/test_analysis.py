@@ -988,6 +988,79 @@ def test_mirror_follower_requires_lease_gate():
     assert "lease_gate" in rep.missing_common["dispatcher_serve_follower"]
 
 
+def test_mirror_detects_one_sided_planner_edit():
+    """ISSUE 14 orch-update pair (must-drift fixture): a planner that
+    stops promoting stop-first replacements through the shared
+    promote_task helper (growing a private store write instead) is
+    drift, caught with a readable diff naming the pair."""
+    spec = next(s for s in mirror.MIRRORS
+                if s.key == "orch_update_planner")
+    src = (ROOT / spec.path).read_text()
+    edited = src.replace(
+        "            if not live or now > flip.deadline:\n"
+        "                promote_task(self.store, flip.new_id)\n",
+        "            if not live or now > flip.deadline:\n"
+        "                pass\n")
+    assert edited != src, "edit anchor moved — update this test"
+    rep = mirror.check_drift(ROOT, sources={"orch_update_planner": edited})
+    assert not rep.clean
+    assert "orch_update_planner" in rep.diffs
+    assert "promote" in rep.diffs["orch_update_planner"]
+
+
+def test_mirror_detects_one_sided_reconciler_edit():
+    """ISSUE 14 orch-reconcile pair: a batched reconciler that drops the
+    shared victim_order pick (inventing its own scale-down order) loses
+    a REQUIRED event — flagged even if its table were re-recorded."""
+    spec = next(s for s in mirror.MIRRORS
+                if s.key == "orch_reconcile_batched")
+    src = (ROOT / spec.path).read_text()
+    edited = src.replace(
+        "                d.victim_slots = victim_order(",
+        "                d.victim_slots = sorted(")
+    assert edited != src, "edit anchor moved — update this test"
+    rep = mirror.check_drift(
+        ROOT, sources={"orch_reconcile_batched": edited})
+    assert "orch_reconcile_batched" in rep.diffs
+    seq = mirror.extract_from_source(edited, spec)
+    rep2 = mirror.check_drift(
+        ROOT, sources={"orch_reconcile_batched": edited},
+        expected=dict(mirror.EXPECTED,
+                      orch_reconcile_batched=tuple(seq)))
+    assert "victims" in rep2.missing_common.get("orch_reconcile_batched",
+                                                [])
+
+
+def test_mirror_orch_pairs_clean_on_real_tree():
+    """Must-NOT-drift: the checked-in orchestrator members match the
+    recorded tables and carry every required event (verdict floor:
+    finalize_update + the slot-flip vocabulary on both update members)."""
+    orch = [s for s in mirror.MIRRORS
+            if s.pair in ("orch-reconcile", "orch-update")]
+    assert len(orch) == 4
+    rep = mirror.check_drift(ROOT, specs=tuple(orch))
+    assert rep.clean, rep.render()
+
+
+def test_mirror_planner_requires_verdict():
+    """A planner member re-recorded WITHOUT the shared finalize_update
+    verdict still fails its `required` floor (terminal statuses must
+    come from the shared failure-policy dispatch, not ad-hoc writes)."""
+    spec = next(s for s in mirror.MIRRORS
+                if s.key == "orch_update_planner")
+    src = (ROOT / spec.path).read_text()
+    edited = src.replace(
+        "        finalize_update(self.store, st.service_id, st.cfg,\n",
+        "        _private_status(self.store, st.service_id, st.cfg,\n")
+    assert edited != src, "edit anchor moved — update this test"
+    seq = mirror.extract_from_source(edited, spec)
+    rep = mirror.check_drift(
+        ROOT, sources={"orch_update_planner": edited},
+        expected=dict(mirror.EXPECTED,
+                      orch_update_planner=tuple(seq)))
+    assert "verdict" in rep.missing_common.get("orch_update_planner", [])
+
+
 def test_shard_lock_hazard_prefix():
     """ISSUE 13 hazard-key extension: shard-indexed dispatcher lock
     names fire the in-view hazard by PREFIX; unrelated dispatcher-domain

@@ -1,0 +1,825 @@
+"""Batched orchestration plane (ISSUE 14): decision parity vs the
+scalar oracles.
+
+Three fuzz families, ≥20 seeds each where randomized:
+
+  1. reconcile — `BatchedReconciler.decide_many` (columnar array pass)
+     vs `decide_service` (the scalar decision the in-tx reconcile
+     applies): create-slot fills, scale-down victim ORDER, dirty-slot
+     sets, all bit-identical per seed.
+  2. restart gate — `batch_should_restart` vs sequential
+     `RestartSupervisor.should_restart` calls with interleaved
+     `_record` bookkeeping (same-key batches included), plus the
+     window-prune side effect.
+  3. update planner — `UpdateWavePlanner` vs the threaded `Updater`
+     driven to convergence on identical seeded clusters: flipped
+     slots, terminal update_status, rollback trigger.
+
+Plus FakeClock pins for the planner's monitor-window and delay edges
+(the planner is stepped directly — no thread — so the edges are exact),
+the steady-pass op-count guard (zero object reads / zero transactions
+for clean services), and the env kill-switch.
+"""
+import copy
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from swarmkit_tpu.api.objects import Service, Task, Version
+from swarmkit_tpu.api.specs import (
+    Annotations,
+    ContainerSpec,
+    RestartPolicy,
+    ServiceSpec,
+    TaskSpec,
+    UpdateConfig,
+)
+from swarmkit_tpu.api.types import (
+    RestartCondition,
+    ServiceMode,
+    TaskState,
+    UpdateFailureAction,
+    UpdateOrder,
+)
+from swarmkit_tpu.orchestrator.batched import (
+    BatchedReconciler,
+    UpdateWavePlanner,
+    _ServiceUpdate,
+    batch_should_restart,
+    fill_slots,
+    plane_enabled,
+    victim_order,
+)
+from swarmkit_tpu.orchestrator.replicated import (
+    ReplicatedOrchestrator,
+    decide_service,
+)
+from swarmkit_tpu.orchestrator.restart import RestartSupervisor
+from swarmkit_tpu.store import by
+from swarmkit_tpu.store.memory import MemoryStore
+from swarmkit_tpu.utils.clock import FakeClock
+
+
+# --------------------------------------------------------------- helpers
+def _service(sid, replicas, image="v1", mode=ServiceMode.REPLICATED,
+             update=None, rollback=None, restart=None, version=1):
+    svc = Service(id=sid)
+    svc.spec = ServiceSpec(
+        annotations=Annotations(name=sid), mode=mode, replicas=replicas,
+        task=TaskSpec(runtime=ContainerSpec(image=image),
+                      restart=restart or RestartPolicy(delay=0.0)))
+    if update is not None:
+        svc.spec.update = update
+    svc.spec.rollback = rollback
+    svc.spec_version = Version(version)
+    return svc
+
+
+def _task(tid, svc, slot, *, desired=TaskState.RUNNING,
+          state=TaskState.RUNNING, node="", spec_version=None,
+          image=None):
+    t = Task(id=tid, service_id=svc.id, slot=slot)
+    t.spec = (copy.deepcopy(svc.spec.task) if image is None
+              else TaskSpec(runtime=ContainerSpec(image=image),
+                            restart=copy.deepcopy(svc.spec.task.restart)))
+    t.spec_version = Version(spec_version if spec_version is not None
+                             else svc.spec_version.index)
+    t.desired_state = desired
+    t.status.state = state
+    t.node_id = node
+    return t
+
+
+def _norm(d):
+    if d is None:
+        return ([], [], [], False)
+    return (list(d.create_slots), list(d.victim_slots),
+            [[t.id for t in ts] for ts in d.dirty_slots],
+            bool(d.kick_update))
+
+
+# ------------------------------------------------------ reconcile parity
+def _seed_cluster(store, rng, n_services=10):
+    ids = []
+    with_store = []
+    for s in range(n_services):
+        sid = f"svc{s:03d}"
+        ids.append(sid)
+        svc = _service(sid, replicas=rng.randrange(0, 7),
+                       version=rng.randrange(1, 4))
+        with_store.append(svc)
+        tasks = []
+        n_slots = rng.randrange(0, 9)
+        for slot in range(1, n_slots + 1):
+            if rng.random() < 0.15:
+                continue            # hole in the slot sequence
+            for dup in range(1 + (rng.random() < 0.25)):
+                sv = rng.randrange(1, 4)
+                # a version-mismatch row that is REALLY dirty only when
+                # the payload differs too (is_task_dirty's spec compare)
+                img = "v1" if rng.random() < 0.5 else f"v{sv}"
+                tasks.append(_task(
+                    f"t-{sid}-{slot}-{dup}", svc, slot,
+                    desired=rng.choice([TaskState.RUNNING, TaskState.READY,
+                                        TaskState.SHUTDOWN,
+                                        TaskState.REMOVE]),
+                    state=rng.choice([TaskState.NEW, TaskState.PENDING,
+                                      TaskState.RUNNING, TaskState.FAILED,
+                                      TaskState.COMPLETE]),
+                    node=rng.choice(["", "n1", "n2", "n3", "n4"]),
+                    spec_version=sv, image=img))
+        with_store.extend(tasks)
+
+    def cb(tx):
+        for obj in with_store:
+            tx.create(obj)
+
+    store.update(cb)
+    return ids
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_reconcile_decision_parity_fuzz(seed):
+    rng = random.Random(seed)
+    store = MemoryStore()
+    ids = _seed_cluster(store, rng)
+    view = store.view()
+    got = BatchedReconciler(store).decide_many(ids, view=view)
+    for sid in ids:
+        svc = view.get_service(sid)
+        tasks = [t for t in view.find_tasks(by.ByServiceID(sid))
+                 if t.desired_state <= TaskState.RUNNING]
+        want = decide_service(svc, tasks)
+        assert _norm(got.get(sid)) == _norm(want), (seed, sid)
+
+
+def test_reconcile_skips_non_replicated_and_pending_delete():
+    store = MemoryStore()
+
+    def cb(tx):
+        tx.create(_service("glob", 3, mode=ServiceMode.GLOBAL))
+        gone = _service("gone", 3)
+        gone.pending_delete = True
+        tx.create(gone)
+        tx.create(_service("live", 2))
+
+    store.update(cb)
+    got = BatchedReconciler(store).decide_many(["glob", "gone", "live",
+                                               "never-created"])
+    assert set(got) == {"live"}
+    assert got["live"].create_slots == [1, 2]
+
+
+def test_reconcile_steady_pass_is_objectless():
+    """The tentpole's perf contract: a steady 100%-converged pass
+    classifies every service with ZERO object reads and ZERO store
+    transactions (op counts, never wall clock on this host)."""
+    store = MemoryStore()
+
+    def cb(tx):
+        for s in range(40):
+            svc = _service(f"s{s}", 3)
+            tx.create(svc)
+            for slot in (1, 2, 3):
+                tx.create(_task(f"t{s}-{slot}", svc, slot))
+
+    store.update(cb)
+    br = BatchedReconciler(store)
+    tx0 = store.op_counts["update_tx"]
+    got = br.decide_many([f"s{s}" for s in range(40)])
+    assert got == {}
+    assert br.stats["services_steady"] == 40
+    assert br.stats["object_reads"] == 0
+    assert store.op_counts["update_tx"] == tx0
+
+
+def test_reconcile_oversized_slot_falls_back_scalar():
+    store = MemoryStore()
+
+    def cb(tx):
+        svc = _service("big", 2)
+        tx.create(svc)
+        tx.create(_task("t-big", svc, 1_000_000))
+
+    store.update(cb)
+    br = BatchedReconciler(store)
+    got = br.decide_many(["big"])
+    assert br.stats["scalar_fallbacks"] >= 1
+    view = store.view()
+    want = decide_service(view.get_service("big"),
+                          [t for t in view.find_tasks(by.ByServiceID("big"))
+                           if t.desired_state <= TaskState.RUNNING])
+    assert _norm(got.get("big")) == _norm(want)
+
+
+def test_shared_primitives():
+    assert fill_slots({2, 4}, 3) == [1, 3, 5]
+    assert fill_slots(set(), 0) == []
+    # non-running first, then busiest node, then highest slot; loads
+    # recompute after each pick
+    summaries = {
+        1: (True, ["a", "a"]),
+        2: (True, ["a"]),
+        3: (False, ["b"]),
+        4: (True, ["b"]),
+    }
+    # slot 3 first (non-running), then slot 2 (busiest node "a", ties
+    # break to the higher slot), then slot 1 after "a"'s load dropped
+    assert victim_order(dict(summaries), 3) == [3, 2, 1]
+
+
+def test_kill_switch_disables_plane(monkeypatch):
+    monkeypatch.setenv("SWARMKIT_TPU_NO_BATCHED_ORCH", "1")
+    store = MemoryStore()
+    assert not plane_enabled(store)
+    orch = ReplicatedOrchestrator(store)
+    assert orch.batched is None
+    assert orch.updater.planner is None
+    monkeypatch.delenv("SWARMKIT_TPU_NO_BATCHED_ORCH")
+    orch2 = ReplicatedOrchestrator(store)
+    assert orch2.batched is not None
+    assert orch2.updater.planner is not None
+    orch2.updater.stop()
+    orch2.restart.stop()
+
+
+# ---------------------------------------------------- restart gate parity
+def _restart_fixture(rng, clock):
+    sup = RestartSupervisor(MemoryStore(), clock=clock)
+    services = []
+    for i in range(4):
+        cond = rng.choice(list(RestartCondition))
+        svc = _service(
+            f"rs{i}", 3,
+            restart=RestartPolicy(
+                condition=cond, delay=0.0,
+                max_attempts=rng.choice([0, 1, 2, 3]),
+                window=rng.choice([0.0, 5.0, 30.0])),
+            mode=rng.choice([ServiceMode.REPLICATED,
+                             ServiceMode.REPLICATED_JOB]))
+        services.append(svc)
+    pairs = []
+    for j in range(rng.randrange(1, 14)):
+        svc = rng.choice(services)
+        slot = rng.randrange(0, 3)      # duplicate keys on purpose
+        t = _task(f"dead{j}", svc, slot,
+                  state=rng.choice([TaskState.FAILED, TaskState.COMPLETE,
+                                    TaskState.REJECTED,
+                                    TaskState.SHUTDOWN]),
+                  node=rng.choice(["", "nA", "nB"]))
+        pairs.append((svc, t))
+    # pre-existing history, some entries aged out of the window
+    from swarmkit_tpu.orchestrator.restart import (
+        InstanceRestartInfo,
+        RestartedInstance,
+    )
+
+    now = clock.time()
+    for svc in services:
+        for slot in range(3):
+            if rng.random() < 0.5:
+                info = InstanceRestartInfo(
+                    total_restarts=rng.randrange(0, 4))
+                info.restarted_instances = [
+                    RestartedInstance(now - rng.uniform(0.0, 40.0))
+                    for _ in range(rng.randrange(0, 4))]
+                sup._history[(svc.id, slot if slot else "")] = info
+    return sup, pairs
+
+
+@pytest.mark.parametrize("seed", range(22))
+def test_restart_gate_parity_fuzz(seed):
+    rng = random.Random(1000 + seed)
+    clock = FakeClock(start=10_000.0)
+
+    sup_a, pairs = _restart_fixture(rng, clock)
+    # oracle: sequential scalar calls with interleaved records
+    sup_b = RestartSupervisor(MemoryStore(), clock=clock)
+    sup_b._history = copy.deepcopy(sup_a._history)
+    want = []
+    for svc, t in pairs:
+        g = sup_b.should_restart(t, svc)
+        want.append(g)
+        if g:
+            sup_b._record(t, svc)
+
+    got = batch_should_restart(sup_a, pairs)
+    assert got.tolist() == want, seed
+    # the caller records the granted batch like the scalar path; after
+    # that, histories must be bit-identical (incl. the window prune)
+    for (svc, t), g in zip(pairs, got):
+        if g:
+            sup_a._record(t, svc)
+
+    def strip(h):
+        return {k: (v.total_restarts,
+                    [r.timestamp for r in v.restarted_instances])
+                for k, v in h.items()}
+
+    assert strip(sup_a._history) == strip(sup_b._history), seed
+    sup_a.stop()
+    sup_b.stop()
+
+
+def test_restart_many_matches_sequential_restarts():
+    """restart_many's store effects == N sequential restart() calls:
+    same shutdown marks, same replacement slots, same history."""
+    clock = FakeClock(start=500.0)
+
+    def build():
+        store = MemoryStore()
+        svc = _service("svc", 4,
+                       restart=RestartPolicy(delay=0.0, max_attempts=2,
+                                             window=10.0))
+        tasks = [_task(f"d{i}", svc, i + 1, state=TaskState.FAILED,
+                       node="down-node") for i in range(4)]
+
+        def cb(tx):
+            tx.create(svc)
+            for t in tasks:
+                tx.create(t)
+
+        store.update(cb)
+        return store, svc, tasks
+
+    store_a, svc_a, tasks_a = build()
+    sup_a = RestartSupervisor(store_a, clock=clock)
+    store_a.update(lambda tx: sup_a.restart_many(
+        tx, None, [(svc_a, t) for t in tasks_a]))
+
+    store_b, svc_b, tasks_b = build()
+    sup_b = RestartSupervisor(store_b, clock=clock)
+
+    def seq(tx):
+        for t in tasks_b:
+            sup_b.restart(tx, None, svc_b, t)
+
+    store_b.update(seq)
+
+    def census(store):
+        out = {}
+        for t in store.view(lambda tx: tx.find_tasks()):
+            out.setdefault((t.slot, int(t.desired_state)), 0)
+            out[(t.slot, int(t.desired_state))] += 1
+        return out
+
+    assert census(store_a) == census(store_b)
+    assert {k: v.total_restarts for k, v in sup_a._history.items()} == \
+        {k: v.total_restarts for k, v in sup_b._history.items()}
+    sup_a.stop()
+    sup_b.stop()
+
+
+# ------------------------------------------------- planner vs updater e2e
+class _Pump(threading.Thread):
+    """Deterministic fake agent: tasks desired RUNNING start (or FAIL,
+    per the seeded fail predicate); shutdowns are observed stopped."""
+
+    def __init__(self, store, fails=lambda t: False):
+        super().__init__(daemon=True, name="orch-pump")
+        self.store = store
+        self.fails = fails
+        self._halt = threading.Event()
+
+    def stop(self):
+        self._halt.set()
+        self.join(timeout=5)
+
+    def run(self):
+        while not self._halt.is_set():
+            def cb(tx):
+                for t in tx.find_tasks():
+                    if t.desired_state == TaskState.RUNNING \
+                            and t.status.state < TaskState.RUNNING:
+                        c = t.copy()
+                        c.status.state = (TaskState.FAILED if self.fails(t)
+                                          else TaskState.RUNNING)
+                        tx.update(c)
+                    elif t.desired_state >= TaskState.SHUTDOWN \
+                            and t.status.state <= TaskState.RUNNING:
+                        c = t.copy()
+                        c.status.state = TaskState.SHUTDOWN
+                        tx.update(c)
+
+            try:
+                self.store.update(cb)
+            except Exception:
+                pass
+            self._halt.wait(0.02)
+
+
+def _spawn_cluster(monkeypatch, batched: bool, replicas, order,
+                   failure_action, fails):
+    if batched:
+        monkeypatch.delenv("SWARMKIT_TPU_NO_BATCHED_ORCH", raising=False)
+    else:
+        monkeypatch.setenv("SWARMKIT_TPU_NO_BATCHED_ORCH", "1")
+    store = MemoryStore()
+    orch = ReplicatedOrchestrator(store)
+    orch.start()
+    pump = _Pump(store, fails=fails)
+    pump.start()
+    svc = _service("svc", replicas,
+                   update=UpdateConfig(parallelism=1, delay=0.0,
+                                       monitor=0.4, order=order,
+                                       failure_action=failure_action,
+                                       max_failure_ratio=0.0))
+    store.update(lambda tx: tx.create(svc))
+    return store, orch, pump
+
+
+def _wait(cond, timeout=25.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _running(store, image=None):
+    out = [t for t in store.view(lambda tx: tx.find_tasks())
+           if t.status.state == TaskState.RUNNING
+           and t.desired_state <= TaskState.RUNNING]
+    if image is not None:
+        out = [t for t in out if t.spec.runtime.image == image]
+    return out
+
+
+def _push_v2(store):
+    cur = store.view(lambda tx: tx.get_service("svc"))
+    new = cur.copy()
+    new.previous_spec = copy.deepcopy(cur.spec)
+    new.spec = copy.deepcopy(cur.spec)
+    new.spec.task.runtime.image = "v2"
+    new.spec_version = Version(cur.spec_version.index + 1)
+    store.update(lambda tx: tx.update(new))
+
+
+def _final_state(store):
+    svc = store.view(lambda tx: tx.get_service("svc"))
+    return (svc.update_status or {}).get("state")
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_planner_matches_threaded_updater_outcomes(monkeypatch, seed):
+    """Converged-outcome parity per seed: same flipped slot set, same
+    terminal status family, same rollback trigger, under a
+    deterministic seeded failure schedule (parallelism=1 keeps the
+    decision sequence serial in both implementations)."""
+    rng = random.Random(40 + seed)
+    replicas = rng.randrange(2, 5)
+    order = rng.choice([UpdateOrder.STOP_FIRST, UpdateOrder.START_FIRST])
+    action = rng.choice(list(UpdateFailureAction))
+    will_fail = rng.random() < 0.4
+    if will_fail:
+        # CONTINUE with an always-failing start-first image never
+        # terminates BY DESIGN (the old task stays, the slot stays
+        # dirty, the policy keeps rolling) — identically in both
+        # implementations; only terminating policies are comparable
+        action = rng.choice([UpdateFailureAction.ROLLBACK,
+                             UpdateFailureAction.PAUSE])
+
+    def fails(t):
+        return will_fail and t.spec.runtime.image == "v2"
+
+    outcomes = {}
+    for batched in (False, True):
+        store, orch, pump = _spawn_cluster(
+            monkeypatch, batched, replicas, order, action, fails)
+        try:
+            assert _wait(lambda: len(_running(store, "v1")) == replicas)
+            _push_v2(store)
+            if not will_fail:
+                assert _wait(lambda: len(_running(store, "v2")) == replicas
+                             and _final_state(store) == "completed"), \
+                    (seed, batched, _final_state(store))
+            elif action == UpdateFailureAction.ROLLBACK:
+                assert _wait(lambda: _final_state(store)
+                             == "rollback_completed"), (seed, batched)
+                assert _wait(lambda: len(_running(store, "v1"))
+                             >= replicas), (seed, batched)
+            elif action == UpdateFailureAction.PAUSE:
+                assert _wait(lambda: _final_state(store) == "paused"), \
+                    (seed, batched)
+            else:   # CONTINUE keeps rolling to completion despite deaths
+                assert _wait(lambda: _final_state(store) == "completed"), \
+                    (seed, batched)
+            tasks = store.view(lambda tx: tx.find_tasks())
+            outcomes[batched] = (
+                _final_state(store),
+                store.view(lambda tx: tx.get_service(
+                    "svc")).spec.task.runtime.image,
+                sorted({t.slot for t in tasks
+                        if t.spec.runtime.image == "v2"
+                        and t.desired_state <= TaskState.RUNNING})
+                if not will_fail else None,
+            )
+        finally:
+            pump.stop()
+            orch.stop()
+    assert outcomes[False] == outcomes[True], (seed, outcomes)
+
+
+# ------------------------------------------------------- FakeClock pins
+def _stepped_planner(store):
+    fc = FakeClock(start=100.0)
+    restart = RestartSupervisor(store, clock=fc)
+    pl = UpdateWavePlanner(store, restart, clock=fc)
+    return fc, pl
+
+
+def _mk_update_target(store, *, monitor=10.0, delay=0.0, parallelism=1):
+    svc = _service("svc", 2,
+                   update=UpdateConfig(parallelism=parallelism, delay=delay,
+                                       monitor=monitor,
+                                       order=UpdateOrder.STOP_FIRST,
+                                       failure_action=UpdateFailureAction.PAUSE,
+                                       max_failure_ratio=0.0))
+
+    def cb(tx):
+        tx.create(svc)
+        for slot in (1, 2):
+            tx.create(_task(f"t{slot}", svc, slot))
+
+    store.update(cb)
+    _push_v2(store)
+
+
+def _observe_stops(store):
+    def cb(tx):
+        for t in tx.find_tasks():
+            if t.desired_state >= TaskState.SHUTDOWN \
+                    and t.status.state <= TaskState.RUNNING:
+                c = t.copy()
+                c.status.state = TaskState.SHUTDOWN
+                tx.update(c)
+
+    store.update(cb)
+
+
+def _start_replacements(store, state=TaskState.RUNNING):
+    started = []
+
+    def cb(tx):
+        for t in tx.find_tasks():
+            if t.desired_state == TaskState.RUNNING \
+                    and t.status.state < TaskState.RUNNING \
+                    and t.spec.runtime.image == "v2":
+                c = t.copy()
+                c.status.state = state
+                tx.update(c)
+                started.append(c.id)
+
+    store.update(cb)
+    return started
+
+
+def test_fakeclock_monitor_window_edge():
+    """A replacement failing INSIDE its monitor window counts (pause);
+    one failing strictly AFTER the window expiry does not (completed).
+    Stepped directly — no planner thread, exact edges."""
+    for fail_at, expect in ((9.9, "paused"), (10.2, "completed")):
+        store = MemoryStore()
+        fc, pl = _stepped_planner(store)
+        _mk_update_target(store, monitor=10.0)
+        st = _ServiceUpdate("svc")
+        pl._states["svc"] = st
+        pl._step(st)                      # init -> rolling: slot 1 flips
+        assert len(st.in_flight) == 1
+        _observe_stops(store)
+        pl._step(st)                      # old stopped -> promote slot 1
+        new_ids = _start_replacements(store)
+        pl._step(st)                      # flip lands; monitor opens
+        # drive the second slot through too
+        for _ in range(6):
+            fc.advance(0.05)
+            _observe_stops(store)
+            _start_replacements(store)
+            pl._step(st)
+            if not st.in_flight and not st.pending:
+                break
+        assert st.monitored, "monitor windows must be open"
+        grant_deadlines = dict(st.monitored)
+        # fail the FIRST replacement at the chosen offset from its grant
+        first = new_ids[0]
+        target = grant_deadlines[first] - 10.0 + fail_at
+        fc.advance(target - fc.monotonic())
+        if fail_at > 10.0:
+            # the poll that EXPIRES the window must run before the
+            # failure lands (the scalar poll_failures ordering: a
+            # failure observed while the entry is still monitored
+            # counts, an expired-healthy entry is gone)
+            pl._step(st)
+            assert first not in st.monitored
+
+        def fail_first(tx):
+            cur = tx.get_task(first)
+            c = cur.copy()
+            c.status.state = TaskState.FAILED
+            tx.update(c)
+
+        store.update(fail_first)
+        for _ in range(300):
+            pl._step(st)
+            if st.done:
+                break
+            fc.advance(0.1)
+        assert st.done
+        assert _final_state(store) == expect, (fail_at, expect)
+
+
+def test_fakeclock_delay_paces_flips():
+    """delay=5 with parallelism=1: the second slot's flip must not start
+    before the cooldown expires — pinned at the edge."""
+    store = MemoryStore()
+    fc, pl = _stepped_planner(store)
+    _mk_update_target(store, monitor=0.0, delay=5.0)
+    st = _ServiceUpdate("svc")
+    pl._states["svc"] = st
+    pl._step(st)                          # flip slot 1
+    assert set(st.in_flight) == {1}
+    _observe_stops(store)
+    pl._step(st)                          # slot 1 promotes; cooldown opens
+    _start_replacements(store)
+    assert not st.in_flight and st.cooldowns
+    fc.advance(4.9)
+    pl._step(st)
+    assert not st.in_flight, "flip started inside the delay cooldown"
+    fc.advance(0.2)                       # past the 5s edge
+    pl._step(st)
+    assert set(st.in_flight) == {2}
+    _observe_stops(store)
+    pl._step(st)
+    _start_replacements(store)
+    for _ in range(200):
+        pl._step(st)
+        if st.done:
+            break
+        fc.advance(0.2)
+    assert st.done and _final_state(store) == "completed"
+
+
+def test_planner_supersede_and_pause_gates():
+    """update() on a live pass is a no-op (supersede-in-place); a PAUSED
+    service never starts a pass (the operator owns resumption)."""
+    store = MemoryStore()
+    fc, pl = _stepped_planner(store)
+    _mk_update_target(store)
+    st = _ServiceUpdate("svc")
+    pl._states["svc"] = st
+    svc = store.view(lambda tx: tx.get_service("svc"))
+    pl.update(svc, [])
+    assert pl._states["svc"] is st, "live pass must not be replaced"
+    # paused gate
+    store2 = MemoryStore()
+    fc2, pl2 = _stepped_planner(store2)
+    _mk_update_target(store2)
+
+    def pause(tx):
+        cur = tx.get_service("svc").copy()
+        cur.update_status = {"state": "paused", "message": "x",
+                             "timestamp": 0.0}
+        tx.update(cur)
+
+    store2.update(pause)
+    st2 = _ServiceUpdate("svc")
+    pl2._states["svc"] = st2
+    pl2._step(st2)
+    assert st2.done and _final_state(store2) == "paused"
+    pl.stop()
+    pl2.stop()
+
+
+def test_columnar_mirror_stays_lockstep_through_orchestration():
+    """After a full reconcile + update storm, the task columns (incl.
+    the new spec_version column) and the service/node hot columns are
+    bit-equal to a from-scratch rebuild."""
+    store = MemoryStore()
+    orch = ReplicatedOrchestrator(store)
+    orch.start()
+    pump = _Pump(store)
+    pump.start()
+    try:
+        svc = _service("svc", 3,
+                       update=UpdateConfig(parallelism=2, delay=0.0,
+                                           monitor=0.1))
+        store.update(lambda tx: tx.create(svc))
+        assert _wait(lambda: len(_running(store, "v1")) == 3)
+        _push_v2(store)
+        assert _wait(lambda: len(_running(store, "v2")) == 3
+                     and _final_state(store) == "completed")
+    finally:
+        pump.stop()
+        orch.stop()
+    from swarmkit_tpu.store.columnar import ColumnarTasks
+
+    tasks = store.view(lambda tx: tx.find_tasks())
+    services = store.view(lambda tx: tx.find_services())
+    rebuilt = ColumnarTasks.rebuild(tasks, services=services)
+    assert ColumnarTasks.snapshots_equal(store.columnar.snapshot(),
+                                         rebuilt.snapshot())
+    scol = store.columnar.service_cols
+    row = scol.row_of("svc")
+    assert row > 0 and scol.replicas[row] == 3 \
+        and scol.spec_version[row] == services[0].spec_version.index
+
+
+def test_kick_completes_restart_converged_rollback():
+    """The storm-found heal: a ROLLBACK_STARTED service whose slots the
+    RESTART SUPERVISOR already converged to v1 (no dirty slot left)
+    must still get a no-op update pass that writes ROLLBACK_COMPLETED —
+    both deciders emit kick_update, and the orchestrator feeds the
+    planner on it."""
+    store = MemoryStore()
+    svc = _service("svc", 2, image="v1", version=3)
+    svc.update_status = {"state": "rollback_started", "message": "x",
+                         "timestamp": 0.0}
+
+    def cb(tx):
+        tx.create(svc)
+        for slot in (1, 2):
+            tx.create(_task(f"t{slot}", svc, slot, spec_version=3))
+
+    store.update(cb)
+    view = store.view()
+    want = decide_service(svc, [t for t in view.find_tasks(
+        by.ByServiceID("svc")) if t.desired_state <= TaskState.RUNNING])
+    assert want.kick_update and not want.dirty_slots
+    got = BatchedReconciler(store).decide_many(["svc"], view=view)
+    assert _norm(got.get("svc")) == _norm(want)
+
+    orch = ReplicatedOrchestrator(store)
+    orch.start()
+    try:
+        orch.reconcile_many(["svc"])
+        assert _wait(lambda: _final_state(store) == "rollback_completed",
+                     timeout=10.0)
+    finally:
+        orch.stop()
+
+
+def test_event_drain_loses_nothing_over_max_drain():
+    """Review-found: a burst longer than MAX_DRAIN must not drop the
+    event popped at the budget boundary — every event reaches handle()
+    and flush_events runs after each burst."""
+    from swarmkit_tpu.api.objects import EventCreate
+    from swarmkit_tpu.orchestrator.base import EventLoopComponent
+
+    class Counter(EventLoopComponent):
+        name = "drain-counter"
+
+        def __init__(self, store):
+            super().__init__(store)
+            self.seen = set()
+            self.flushes = 0
+
+        def handle(self, event):
+            if isinstance(event, EventCreate) and isinstance(event.obj,
+                                                             Task):
+                self.seen.add(event.obj.id)
+
+        def flush_events(self):
+            self.flushes += 1
+
+    store = MemoryStore()
+    comp = Counter(store)
+    comp.start()
+    try:
+        n = comp.MAX_DRAIN * 2 + 50
+
+        def cb(batch):
+            for i in range(n):
+                batch.update(lambda tx, i=i: tx.create(
+                    Task(id=f"burst-{i:04d}", service_id="s", slot=i)))
+
+        store.batch(cb)
+        assert _wait(lambda: len(comp.seen) == n, timeout=10.0), \
+            f"dropped {n - len(comp.seen)} events"
+        assert comp.flushes >= 1
+    finally:
+        comp.stop()
+
+
+def test_slot_state_kernel_parity_fuzz():
+    """numpy mirror vs jit kernel of the slot census (exact algebra)."""
+    from swarmkit_tpu.ops.reconcile import (
+        replica_slot_state,
+        replica_slot_state_np,
+    )
+
+    for seed in range(20):
+        rng = np.random.default_rng(seed)
+        S, M = int(rng.integers(1, 8)), int(rng.integers(1, 10))
+        T = int(rng.integers(1, 60))
+        sidx = rng.integers(0, S, T).astype(np.int32)
+        slot = rng.integers(0, M, T).astype(np.int32)
+        runnable = rng.random(T) < 0.6
+        running = runnable & (rng.random(T) < 0.6)
+        a = replica_slot_state_np(sidx, slot, runnable, running, S, M)
+        b = replica_slot_state(sidx, slot, runnable, running, S, M)
+        for x, y in zip(a, b):
+            assert np.array_equal(np.asarray(x), np.asarray(y)), seed

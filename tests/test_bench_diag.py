@@ -113,6 +113,31 @@ def test_dispatcher_fanout_storm_cpu_smoke():
     assert row["follower_read_ratio"] is not None
 
 
+def test_orchestrator_storm_cpu_smoke():
+    """ISSUE 14 contracts of the orchestrator_storm row at a CPU-smoke
+    shape (op counts + parity, never wall clock — this is a contended
+    1-core host; the 100k-service reconcile-pass latency and the storm
+    time-to-converged are judged by the bench row, where bench owns the
+    machine): steady classification objectless, dirty-subset decisions
+    scalar-identical, the storm fully converged with its rollback share
+    on ONE planner thread, and the disarmed plane untouched by event
+    handling (zero per-event allocations)."""
+    import numpy as np
+
+    row = bench.bench_orchestrator_storm(
+        np, n_services=300, replicas=2, dirty=20, storm_services=10,
+        storm_replicas=3, storm_budget_s=120.0)
+    assert row["parity"] is True, row
+    rec = row["reconcile"]
+    assert rec["steady_objectless"] is True
+    assert rec["dirty_services"] == 20
+    storm = row["storm"]
+    assert storm["converged"] == 10
+    assert storm["planner_threads"] <= 1
+    assert storm["planner_stats"]["updates_finished"] >= 10
+    assert row["disarmed_plane_calls"] == 0
+
+
 def test_store_plane_row_cpu_smoke():
     """ISSUE 11 parity check at a CPU-smoke size: the bench row's own
     correctness gates hold (object/columnar end-state equality + columns
