@@ -1667,6 +1667,162 @@ def bench_slo_plane(np):
     }
 
 
+def bench_telemetry_plane(np, n_nodes=10_000, beat_nodes=256,
+                          beats_per_node=4):
+    """Telemetry-plane acceptance row (ISSUE 15), the slo_plane shape:
+    (a) DISARMED, a driven beat storm over `beat_nodes` sessions builds
+    ZERO snapshots and stores ZERO reports (spies on
+    metrics.registry_snapshot and Dispatcher._record_report — the
+    truthiness contract); (b) ARMED, the piggyback overhead per beat
+    (build + shard store) is measured against the bare beat; (c) the
+    rollup MERGE throughput over `n_nodes` synthetic per-node
+    snapshots; (d) the driven parity gate — merged cluster counters
+    equal the manual sum, and a silent node goes stale (FakeClock)."""
+    from functools import reduce
+
+    from swarmkit_tpu.dispatcher.dispatcher import Dispatcher
+    from swarmkit_tpu.manager.telemetry import TelemetryAggregator
+    from swarmkit_tpu.store.memory import MemoryStore
+    from swarmkit_tpu.utils import metrics, telemetry
+    from swarmkit_tpu.utils.clock import FakeClock
+    from swarmkit_tpu.utils.metrics import (
+        CounterFamily,
+        Histogram,
+        empty_snapshot,
+        merge_snapshot,
+        registry_snapshot,
+        snapshot_counter_value,
+    )
+
+    def node_snap(i):
+        cf = CounterFamily("swarm_rpc_handled_total", "h", ("method",))
+        cf.inc(("tick",), i + 1)
+        cf.inc(("status",), 2 * i + 1)
+        h = Histogram("swarm_store_tx_seconds", "h")
+        h.observe(0.001 * ((i % 7) + 1))
+        return registry_snapshot(families=[cf], histograms=[h],
+                                 gauges={"agent_tasks": i % 5})
+
+    store = MemoryStore()
+    d = Dispatcher(store, heartbeat_period=300.0, shards=4)
+    sids = {}
+    for i in range(beat_nodes):
+        nid = f"tn{i:05d}"
+        sids[nid] = d.register(nid)
+
+    # (a) disarmed beat storm: spy every surface that could build/store
+    builds = {"n": 0}
+    stores = {"n": 0}
+    orig_snap = metrics.registry_snapshot
+    orig_rec = Dispatcher._record_report
+
+    def spy_snap(*a, **k):
+        builds["n"] += 1
+        return orig_snap(*a, **k)
+
+    def spy_rec(self, *a, **k):
+        stores["n"] += 1
+        return orig_rec(self, *a, **k)
+
+    try:
+        metrics.registry_snapshot = spy_snap
+        Dispatcher._record_report = spy_rec
+        t0 = time.perf_counter()
+        for _ in range(beats_per_node):
+            for nid, sid in sids.items():
+                # the agent-loop shape: guard first, bare beat when off
+                if telemetry.enabled():
+                    d.heartbeat(nid, sid,
+                                metrics=telemetry.node_snapshot())
+                else:
+                    d.heartbeat(nid, sid)
+        disarmed_s = time.perf_counter() - t0
+        disarmed_builds = builds["n"]
+        disarmed_stores = stores["n"]
+        n_beats = beats_per_node * len(sids)
+
+        # (b) armed: every beat piggybacks (report_every=1 — the bench
+        # measures the per-piggyback ceiling, not the amortized cadence)
+        with telemetry.armed(report_every=1):
+            t0 = time.perf_counter()
+            for _ in range(beats_per_node):
+                for nid, sid in sids.items():
+                    if telemetry.enabled():
+                        d.heartbeat(nid, sid,
+                                    metrics=telemetry.node_snapshot())
+                    else:
+                        d.heartbeat(nid, sid)
+            armed_s = time.perf_counter() - t0
+            stored = sum(len(r) for r in d.telemetry_reports())
+    finally:
+        metrics.registry_snapshot = orig_snap
+        Dispatcher._record_report = orig_rec
+        d._hb_wheel.stop()
+
+    # (c) rollup merge throughput at n_nodes
+    snaps = [node_snap(i) for i in range(n_nodes)]
+    t0 = time.perf_counter()
+    merged = reduce(merge_snapshot, snaps, empty_snapshot())
+    merge_s = time.perf_counter() - t0
+    merged_ok = (
+        snapshot_counter_value(merged, "swarm_rpc_handled_total",
+                               ("tick",))
+        == sum(i + 1 for i in range(n_nodes)))
+
+    # (d) driven parity + staleness gate under FakeClock
+    clock = FakeClock()
+    d2 = Dispatcher(MemoryStore(), heartbeat_period=5.0, clock=clock,
+                    shards=4)
+    try:
+        with telemetry.armed():
+            parts = {}
+            s2 = {}
+            for i in range(8):
+                nid = f"pn{i}"
+                s2[nid] = d2.register(nid)
+                parts[nid] = node_snap(i)
+                d2.heartbeat(nid, s2[nid], metrics=parts[nid])
+            agg = TelemetryAggregator(MemoryStore(), d2, clock=clock)
+            roll = agg.rollup(include_local=False)
+            want = reduce(merge_snapshot, parts.values(),
+                          empty_snapshot())
+            parity_counters = roll["cluster"]["counters"] \
+                == want["counters"]
+            # pn0 goes silent; the rest re-beat inside the grace
+            # window, then time passes the 3x-period staleness bound
+            clock.advance(10.0)
+            for nid in list(parts)[1:]:
+                d2.heartbeat(nid, s2[nid], metrics=parts[nid])
+            clock.advance(5.5)
+            roll2 = agg.rollup(include_local=False)
+            stale_ok = roll2["nodes"]["stale"] == ["pn0"] \
+                and roll2["nodes"]["fresh"] == 7
+    finally:
+        d2._hb_wheel.stop()
+
+    return {
+        "beat_nodes": beat_nodes,
+        "beats": n_beats,
+        # THE acceptance: the plane off builds/stores nothing on the
+        # beat path
+        "disarmed_beat_allocs": disarmed_builds + disarmed_stores,
+        "disarmed_beat_us": round(disarmed_s / n_beats * 1e6, 2),
+        "armed_beat_us": round(armed_s / n_beats * 1e6, 2),
+        "piggyback_overhead_us": round(
+            (armed_s - disarmed_s) / n_beats * 1e6, 2),
+        "reports_stored": stored,
+        "merge_nodes": n_nodes,
+        "merge_s": round(merge_s, 4),
+        "merge_nodes_per_s": round(n_nodes / max(merge_s, 1e-9), 1),
+        "rollup_counter_exact": merged_ok,
+        "driven_parity": parity_counters,
+        "stale_detection": stale_ok,
+        "parity": (disarmed_builds + disarmed_stores == 0
+                   and stored == beat_nodes and merged_ok
+                   and parity_counters and stale_ok),
+    }
+
+
 def bench_store_plane(np, sizes=(100_000, 1_000_000)):
     """Columnar store plane acceptance row (ISSUE 11): whole-wave task
     write-back through the object path (per-task get + two tree copies +
@@ -2409,6 +2565,11 @@ def main():
         # timeline records on the wave + flush paths; one batched
         # scheduler record per wave) + armed e2e timeline slice
         ("slo_plane", lambda: bench_slo_plane(np)),
+        # ISSUE 15: telemetry-plane disarmed-cost acceptance (zero
+        # snapshot builds/stores on the beat path), armed piggyback
+        # overhead per beat, 10k-node rollup merge throughput, and the
+        # driven parity + staleness gate
+        ("telemetry_plane", lambda: bench_telemetry_plane(np)),
         # ISSUE 14: batched orchestration plane — 100k-service columnar
         # reconcile pass (objectless steady classification + decision
         # parity on the dirty subset), the live rolling-update storm on

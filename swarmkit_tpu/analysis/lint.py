@@ -252,11 +252,12 @@ class SpanInLoopRule(Rule):
     placed task in the walk)."""
 
     name = "span-in-loop"
-    invariant = ("no trace.span/start/rec/event, failpoints.fp*, or "
-                 "lifecycle.record* call inside a for/while body in the "
-                 "audited hot modules unless under an "
-                 "`if trace.enabled()` / `if lifecycle.enabled()` / "
-                 "`if traced:` guard")
+    invariant = ("no trace.span/start/rec/event, failpoints.fp*, "
+                 "lifecycle.record*, or telemetry snapshot-assembly "
+                 "call inside a for/while body in the audited hot "
+                 "modules unless under an `if trace.enabled()` / "
+                 "`if lifecycle.enabled()` / `if telemetry.enabled()` "
+                 "/ `if traced:` guard")
 
     AUDITED = (
         "swarmkit_tpu/ops/pipeline.py",
@@ -273,10 +274,16 @@ class SpanInLoopRule(Rule):
         "swarmkit_tpu/rpc/wire.py",
         "swarmkit_tpu/rpc/server.py",
         "swarmkit_tpu/rpc/client.py",
+        "swarmkit_tpu/agent/agent.py",
     )
     TRACE_CALLS = frozenset({"span", "start", "rec", "event", "wrap"})
     FP_CALLS = frozenset({"fp", "fp_value", "fp_transform"})
     LIFECYCLE_CALLS = frozenset({"record", "record_batch", "record_pairs"})
+    # telemetry snapshot assembly (ISSUE 15): the heartbeat loop builds
+    # a snapshot every Kth beat — the build must sit under the
+    # `if telemetry.enabled():` guard so a disarmed beat allocates
+    # nothing
+    TELEMETRY_CALLS = frozenset({"node_snapshot", "registry_snapshot"})
 
     def applies(self, path: str) -> bool:
         return path in self.AUDITED
@@ -308,7 +315,9 @@ class SpanInLoopRule(Rule):
                 or (base_name == "failpoints"
                     and node.func.attr in self.FP_CALLS)
                 or (base_name == "lifecycle"
-                    and node.func.attr in self.LIFECYCLE_CALLS))
+                    and node.func.attr in self.LIFECYCLE_CALLS)
+                or (base_name == "telemetry"
+                    and node.func.attr in self.TELEMETRY_CALLS))
             if not is_site:
                 continue
             # innermost enclosing loop that is inside the same function
@@ -541,6 +550,80 @@ class ColumnarMutateRule(Rule):
                         "(docs/store.md)")
 
 
+class RawMetricRule(Rule):
+    """Metric families are constructed ONLY through the utils/metrics
+    module factories (ISSUE 15): a directly-constructed
+    Histogram/Counter/CounterFamily/HistogramFamily never enters the
+    process registry, so the per-node /metrics exposition AND the
+    cluster telemetry rollup silently miss it."""
+
+    name = "raw-metric"
+    invariant = ("Histogram/Counter/CounterFamily/HistogramFamily are "
+                 "instantiated only inside utils/metrics.py — every "
+                 "other module uses the factories (histogram(), "
+                 "counter(), counter_family(), histogram_family()) so "
+                 "the family is registry-visible to the exposition and "
+                 "the telemetry rollup")
+
+    CLASSES = frozenset({"Histogram", "Counter", "CounterFamily",
+                         "HistogramFamily"})
+
+    def applies(self, path: str) -> bool:
+        # tests may build standalone families (codec fixtures, per-node
+        # parity registries); product code may not
+        return (path.startswith("swarmkit_tpu/")
+                and path != "swarmkit_tpu/utils/metrics.py")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        # names imported FROM a metrics module: `from ..utils.metrics
+        # import Histogram` (collections.Counter and friends stay
+        # invisible — only the metrics module's classes are policed)
+        imported: dict[str, str] = {}   # bound name -> class name
+        # names the metrics MODULE itself is bound to: `from ..utils
+        # import metrics [as m]`, `import swarmkit_tpu.utils.metrics
+        # as m` — an aliased module must not smuggle m.Histogram(...)
+        mod_aliases: set[str] = {"metrics"}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) and node.module \
+                    and node.module.split(".")[-1] == "metrics":
+                for alias in node.names:
+                    if alias.name in self.CLASSES:
+                        imported[alias.asname or alias.name] = alias.name
+            elif isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    if alias.name == "metrics":
+                        mod_aliases.add(alias.asname or alias.name)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.split(".")[-1] == "metrics" \
+                            and alias.asname:
+                        mod_aliases.add(alias.asname)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = None
+            if isinstance(fn, ast.Name) and fn.id in imported:
+                name = imported[fn.id]
+            elif isinstance(fn, ast.Attribute) \
+                    and fn.attr in self.CLASSES:
+                parts = _attr_chain(fn).split(".")
+                if len(parts) >= 2 and parts[-2] in mod_aliases:
+                    name = fn.attr
+            if name is not None:
+                factory = {
+                    "Histogram": "histogram",
+                    "Counter": "counter",
+                    "CounterFamily": "counter_family",
+                    "HistogramFamily": "histogram_family",
+                }[name]
+                yield self.finding(
+                    mod, node,
+                    f"direct {name}(...) construction — route through "
+                    f"utils.metrics.{factory}(name) so the family is "
+                    "registry-visible (exposition + telemetry rollup)")
+
+
 RULES: tuple[Rule, ...] = (
     Scatter2DRule(),
     AdHocSleepRule(),
@@ -551,6 +634,7 @@ RULES: tuple[Rule, ...] = (
     RawLockRule(),
     RawConditionRule(),
     ColumnarMutateRule(),
+    RawMetricRule(),
 )
 
 

@@ -558,6 +558,12 @@ def main(argv=None) -> int:
                          "before evaluating --slo")
     ap.add_argument("--seed", type=int, default=0,
                     help="churn schedule seed (replayable)")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="embed the cluster telemetry rollup "
+                         "(control.get_cluster_telemetry) in the JSON "
+                         "report — one artifact for SLO gates AND the "
+                         "rollup; the server arms via "
+                         "SWARMKIT_TPU_TELEMETRY")
     ap.add_argument("--slo", default="",
                     help='startup objectives, e.g. "p50:1.0,p99:5.0" '
                          "(seconds); violated objectives fail the run")
@@ -682,6 +688,16 @@ def main(argv=None) -> int:
             report["session_storm"]["sessions"] = args.sessions
             if args.shards is not None:
                 report["session_storm"]["shards"] = args.shards
+        if args.telemetry:
+            # embed the cluster rollup so the SLO gate and the
+            # telemetry artifact come from ONE report (ISSUE 15);
+            # armed-ness is the server's (SWARMKIT_TPU_TELEMETRY on
+            # swarmd arms the plane cluster-wide)
+            try:
+                report["telemetry"] = ctl.get_cluster_telemetry()
+            except Exception as exc:
+                report["telemetry"] = {"armed": False,
+                                       "error": repr(exc)}
         print(json.dumps(report))
         ok = report.get("slo", {}).get("ok", True)
         if not args.churn:

@@ -715,6 +715,99 @@ def cmd_config_rm(args):
 
 # --------------------------------------------------------------------- logs
 
+def _snap_hist_quantile(fam: dict | None, p: float):
+    """Nearest-rank bucket-upper-bound estimate over a snapshot-encoded
+    histogram family (all series summed) — the swarmctl-side mirror of
+    utils/slo.histogram_quantile for codec dicts."""
+    import math
+
+    if not fam:
+        return None
+    buckets = fam.get("buckets", ())
+    agg = [0] * len(buckets)
+    n = 0
+    for series in fam.get("series", ()):
+        counts, cnt = series[1], series[3]
+        n += cnt
+        for i, c in enumerate(counts[:len(buckets)]):
+            agg[i] += c
+    if n == 0:
+        return None
+    rank = max(1, math.ceil(p / 100.0 * n))
+    cum = 0
+    for b, c in zip(buckets, agg):
+        cum += c
+        if cum >= rank:
+            return b
+    return math.inf
+
+
+def cmd_top(args):
+    """One-shot cluster telemetry table (ISSUE 15): node freshness,
+    task-state census, startup percentiles, raft durability and
+    dispatcher flush rates out of `control.get_cluster_telemetry`."""
+    import json
+
+    ctl = _control(args)
+    t = ctl.get_cluster_telemetry(window=args.window)
+    if args.json:
+        print(json.dumps(t, indent=2))
+        return
+    if not t.get("armed"):
+        print("telemetry plane disarmed (start swarmd with "
+              "SWARMKIT_TPU_TELEMETRY=1 and arm the agents)"
+              if t.get("aggregator", True) else
+              "no telemetry aggregator on this manager (not the leader?)")
+        return
+    nodes = t.get("nodes", {})
+    cluster = t.get("cluster", {})
+    manager = t.get("manager", {})
+    rows = [["nodes reported", nodes.get("reported", 0)],
+            ["nodes fresh", nodes.get("fresh", 0)],
+            ["nodes stale", len(nodes.get("stale", ()))]]
+    if nodes.get("stale"):
+        rows.append(["stale", ", ".join(nodes["stale"][:8])
+                     + (" ..." if len(nodes["stale"]) > 8 else "")])
+    flaps = sum(nodes.get("flaps", {}).values())
+    if flaps:
+        rows.append(["node flaps", flaps])
+    census = sorted((k[len("tasks_"):], v)
+                    for k, v in cluster.get("gauges", {}).items()
+                    if k.startswith("tasks_"))
+    if census:
+        rows.append(["task census",
+                     " ".join(f"{s}={n}" for s, n in census)])
+    startup = cluster.get("histograms", {}).get("task_startup_seconds")
+    p50 = _snap_hist_quantile(startup, 50)
+    p99 = _snap_hist_quantile(startup, 99)
+    if p50 is not None:
+        rows.append(["startup p50/p99",
+                     f"<={p50:g}s / <={p99:g}s (bucket bounds)"])
+    raft = manager.get("raft", {})
+    if raft:
+        commit = raft.get("commit_index", 0)
+        fsyncs = raft.get("wal_fsyncs", 0)
+        per = f" ({fsyncs / commit:.3f}/commit)" if commit else ""
+        rows.append(["raft", f"commit={commit} wal_fsyncs={fsyncs}{per}"])
+        lease = raft.get("read_lease", {})
+        if lease.get("lease_duration_s"):
+            rows.append(["read lease",
+                         f"ttl={lease['lease_duration_s']:g}s "
+                         f"quorum_contact_age="
+                         f"{lease.get('quorum_contact_age_s', 0):g}s"])
+    disp = manager.get("dispatcher", {})
+    if disp:
+        rows.append(["dispatcher",
+                     f"flushes={disp.get('flushes', 0)} "
+                     f"ships={disp.get('ships', 0)} "
+                     f"last_flush={disp.get('last_flush_s', 0.0):.4f}s"])
+    for name, qs in sorted(t.get("windows", {}).items()):
+        rows.append([f"window {name}",
+                     " ".join(f"{k}={v:g}" for k, v in qs.items()
+                              if v is not None)])
+    print(_fmt_table(rows, ["metric", "value"]))
+
+
 def cmd_logs(args):
     from ..logbroker.broker import LogSelector, SubscriptionComplete
     from ..rpc.client import RPCClient
@@ -1059,6 +1152,15 @@ def main(argv=None) -> int:
     p.add_argument("--force", action="store_true",
                    help="remove even while published")
     p.set_defaults(func=cmd_volume_rm)
+
+    # top — one-shot cluster telemetry rollup (ISSUE 15)
+    p = sub.add_parser("top")
+    p.add_argument("--window", type=float, default=None,
+                   help="also report ring percentiles over the trailing "
+                        "window (seconds)")
+    p.add_argument("--json", action="store_true",
+                   help="raw rollup JSON instead of the table")
+    p.set_defaults(func=cmd_top)
 
     # logs
     p = sub.add_parser("logs")

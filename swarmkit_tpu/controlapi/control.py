@@ -609,6 +609,24 @@ class ControlAPI:
 
         return slo.report(lifecycle.recorder(), since=since)
 
+    def get_cluster_telemetry(self, window: float | None = None,
+                              include_local: bool = True) -> dict:
+        """Cluster telemetry rollup (ISSUE 15): merged node metric
+        snapshots + manager-local families + per-node freshness from
+        the leader's TelemetryAggregator (the aggregator registers on
+        the LEADER — this method is auto-exposed as
+        `control.get_cluster_telemetry` with leader forwarding, so a
+        remote client always reads the authoritative rollup). `window`
+        adds nearest-rank percentile queries over the trailing window
+        of the time-series ring; `{"armed": False}` when the plane is
+        down or this manager holds no aggregator."""
+        from ..utils import telemetry
+
+        agg = telemetry.aggregator()
+        if agg is None:
+            return {"armed": False, "aggregator": False}
+        return agg.rollup(window_s=window, include_local=include_local)
+
     # ----------------------------------------------------------------- nodes
     def get_node(self, node_id: str) -> Node:
         n = self.store.view().get_node(node_id)

@@ -36,9 +36,14 @@ from ..api.types import NodeStatusState, TaskState
 from ..store import by
 from ..store.memory import MemoryStore
 from ..store.watch import Channel, WatchQueue
-from ..utils import failpoints, lifecycle, trace
+from ..utils import failpoints, lifecycle, telemetry, trace
 from ..utils.identity import new_id
-from ..utils.metrics import histogram
+from ..utils.metrics import (
+    CounterDict,
+    histogram,
+    snapshot_series_count,
+    snapshot_within_budget,
+)
 from .heartbeat import Heartbeat, ShardedHeartbeatWheel, stable_shard
 
 log = logging.getLogger("swarmkit_tpu.dispatcher")
@@ -94,6 +99,11 @@ class _Shard:
     lock: object
     dirty: set = field(default_factory=set)
     rng: random.Random = field(default_factory=random.Random)
+    # ISSUE 15: latest telemetry report per node —
+    # node id -> (snapshot dict, monotonic clock stamp). Owned by the
+    # shard like its dirty set (same leaf lock); the manager aggregator
+    # reads per-shard copies and merges the partials.
+    reports: dict = field(default_factory=dict)
 
 
 class _DirtyView(_AbstractSet):
@@ -269,7 +279,6 @@ class Dispatcher:
             granularity=self._wheel_granularity(heartbeat_period),
             clock=self.clock, shards=self.shards)
         self._lock = make_rlock('dispatcher.lock')
-        self._metrics_lock = make_lock('dispatcher.metrics')
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         # (task_id, status, reporting node_id)
@@ -304,11 +313,14 @@ class Dispatcher:
         self._config_refs: dict[str, set[str]] = {}
         # counters the op-count regression guard and bench storm
         # sub-rows read. flushes/flush_tx/dirty_walks/last_flush_s are
-        # flush-thread-only; ships/wire_copies may be bumped from shard
-        # workers and RPC threads and go through _bump (one leaf lock —
-        # `+=` on a dict value is not atomic across threads)
-        self.metrics = {"flushes": 0, "flush_tx": 0, "wire_copies": 0,
-                        "ships": 0, "dirty_walks": 0, "last_flush_s": 0.0}
+        # flush-thread-only (plain item writes); ships/wire_copies may
+        # be bumped from shard workers and RPC threads and go through
+        # _bump → CounterDict.inc (the metric primitives' internal-lock
+        # contract, ISSUE 15 — `+=` on a dict value is not atomic
+        # across threads)
+        self.metrics = CounterDict(
+            {"flushes": 0, "flush_tx": 0, "wire_copies": 0,
+             "ships": 0, "dirty_walks": 0, "last_flush_s": 0.0})
 
     # ------------------------------------------------------------- lifecycle
     @staticmethod
@@ -352,8 +364,7 @@ class Dispatcher:
         return self._dirty_view
 
     def _bump(self, key: str, n: int = 1) -> None:
-        with self._metrics_lock:
-            self.metrics[key] += n
+        self.metrics.inc(key, n)
 
     def start(self):
         # restartable across leadership cycles (manager.go recreates the
@@ -408,6 +419,7 @@ class Dispatcher:
             for sh in self._shards:
                 with sh.lock:
                     sh.dirty.clear()
+                    sh.reports.clear()
             self._secret_refs.clear()
             self._config_refs.clear()
             self._clone_bases.clear()
@@ -623,15 +635,23 @@ class Dispatcher:
         return period - rng.uniform(0.0, min(HEARTBEAT_EPSILON,
                                              period / 2))
 
-    def heartbeat(self, node_id: str, session_id: str) -> float:
+    def heartbeat(self, node_id: str, session_id: str,
+                  metrics=None) -> float:
         """reference: dispatcher.go:1317-1335. The grace window re-arms
         from the CURRENT period so live reconfig applies to existing
-        sessions too (nodes.go updatePeriod)."""
+        sessions too (nodes.go updatePeriod).
+
+        `metrics` (ISSUE 15): an optional piggybacked telemetry
+        snapshot (utils/telemetry.node_snapshot) stored in the node's
+        owning SHARD. Disarmed agents send None, so the plain beat path
+        pays one `is not None` test and nothing else."""
         # failpoint `dispatcher.heartbeat`: error = beats lost before
         # the timer re-arms (a heartbeat-miss storm: sessions expire,
         # nodes flip DOWN, tasks orphan); delay = a stalled dispatcher
         failpoints.fp("dispatcher.heartbeat")
         self._session(node_id, session_id)
+        if metrics is not None:
+            self._record_report(node_id, metrics)
         grace = self.heartbeat_period * GRACE_MULTIPLIER
         if not self._hb_wheel.beat(node_id, grace):
             # valid session without a wheel entry: it registered through
@@ -646,6 +666,50 @@ class Dispatcher:
                         node_id, grace,
                         lambda: self._node_down(node_id, session_id))
         return self._jittered_period(node_id)
+
+    # -------------------------------------------------- telemetry plane
+    def _record_report(self, node_id: str, snap) -> None:
+        """Store a piggybacked telemetry snapshot in the node's shard
+        (ISSUE 15). Stored only while the manager-side plane is armed
+        (a disarmed manager must not accrete reports a test/operator
+        never asked for) and bounded structurally — the wire codec
+        rebuilds payloads without field checks, so one hostile agent
+        must not balloon a shard's report store: bounded on series
+        count AND on a structural cell budget that bails early, so a
+        single huge counts vector (or a giant blob under an unknown
+        key) is rejected without walking it and without a JSON encode
+        on the beat path. The shard lock is a LEAF (the pinned
+        `dispatcher.lock` → shard order; here we hold nothing above
+        it)."""
+        st = telemetry.state()
+        if st is None:
+            return
+        if not isinstance(snap, dict) \
+                or snapshot_series_count(snap) > telemetry.MAX_REPORT_SERIES \
+                or not snapshot_within_budget(snap):
+            st.bump("reports_rejected")
+            return
+        stamp = self.clock.monotonic()
+        sh = self._shard_for(node_id)
+        with sh.lock:
+            sh.reports[node_id] = (snap, stamp)
+        st.bump("reports_stored")
+
+    def telemetry_reports(self) -> list[dict]:
+        """Per-shard copies of the stored node reports
+        ([{node id: (snapshot, stamp)}, ...]) — the manager aggregator
+        merges each shard's partial, then composes the partials
+        (merge_snapshot is associative/commutative)."""
+        out = []
+        for sh in self._shards:
+            with sh.lock:
+                out.append(dict(sh.reports))
+        return out
+
+    def drop_telemetry_report(self, node_id: str) -> None:
+        sh = self._shard_for(node_id)
+        with sh.lock:
+            sh.reports.pop(node_id, None)
 
     def assignments(self, node_id: str, session_id: str) -> Channel:
         """Subscribe to this node's assignment stream; the initial COMPLETE
@@ -814,6 +878,9 @@ class Dispatcher:
             session.session_channel.close()
         if session.tasks_channel is not None:
             session.tasks_channel.close()
+        # a deliberate departure retires the node's telemetry report too
+        # — only nodes that VANISH should surface as stale in the rollup
+        self.drop_telemetry_report(node_id)
         self._node_down(node_id, session_id, graceful=True)
 
     # ------------------------------------------------------------- internals

@@ -33,9 +33,10 @@ import logging
 import threading
 import time
 
-from ..analysis.lockgraph import make_lock, make_rlock
+from ..analysis.lockgraph import make_rlock
 from ..api.objects import Config, Secret, Task, Volume
 from ..store.watch import Channel
+from ..utils.metrics import CounterDict
 from .dispatcher import (
     ASSIGNMENTS_CHANNEL_LIMIT,
     BATCH_INTERVAL,
@@ -80,7 +81,6 @@ class FollowerReadPlane:
         self.secret_drivers = secret_drivers
         self.clock = clock or REAL_CLOCK
         self._lock = make_rlock("dispatcher.follower.lock")
-        self._metrics_lock = make_lock("dispatcher.follower.metrics")
         self._sessions: dict[str, Session] = {}
         self._dirty: set[str] = set()
         self._stop = threading.Event()
@@ -95,9 +95,12 @@ class FollowerReadPlane:
         self._config_refs: dict[str, set[str]] = {}
         self._vol_index_primed = False
         self._vol_pending_unpub: dict[str, frozenset] = {}
-        self.metrics = {"reads_served": 0, "reads_bounced": 0,
-                        "flushes": 0, "flush_tx": 0, "held_flushes": 0,
-                        "ships": 0, "wire_copies": 0}
+        # CounterDict: internally-locked inc (ISSUE 15 — the metric
+        # primitives own their atomicity; no ad-hoc guard locks here)
+        self.metrics = CounterDict(
+            {"reads_served": 0, "reads_bounced": 0,
+             "flushes": 0, "flush_tx": 0, "held_flushes": 0,
+             "ships": 0, "wire_copies": 0})
 
     # ---- the shared snapshot/build vocabulary: the leader's own code.
     # These CANNOT drift from the Dispatcher — they are the same

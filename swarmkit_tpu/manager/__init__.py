@@ -1,9 +1,9 @@
 """Manager assembly + leader-only singletons (SURVEY.md §2.8)."""
 from .health import NOT_SERVING, SERVING, UNKNOWN, HealthServer
 from .keymanager import EncryptionKey, KeyManager
-from .manager import Manager
 from .metrics import MetricsCollector
 from .rolemanager import RoleManager
+from .telemetry import TelemetryAggregator, TimeSeriesRing
 
 __all__ = [
     "NOT_SERVING",
@@ -12,7 +12,25 @@ __all__ = [
     "HealthServer",
     "EncryptionKey",
     "KeyManager",
-    "Manager",
     "MetricsCollector",
     "RoleManager",
+    "TelemetryAggregator",
+    "TimeSeriesRing",
 ]
+
+# gate on the `cryptography` wheel SPECIFICALLY (the ca package's
+# pattern): the Manager assembly needs real certificates, but the
+# crypto-free singletons above (metrics, telemetry rollup, health)
+# must stay importable on containers without the optional wheel — a
+# genuine import bug in manager.py must still fail loudly
+try:
+    import cryptography  # noqa: F401
+
+    _HAVE_CRYPTO = True
+except ImportError:
+    _HAVE_CRYPTO = False
+
+if _HAVE_CRYPTO:
+    from .manager import Manager
+
+    __all__.append("Manager")

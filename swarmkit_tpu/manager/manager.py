@@ -37,6 +37,7 @@ from ..watchapi.watch import WatchAPI
 from .health import NOT_SERVING, SERVING, HealthServer
 from .keymanager import KeyManager
 from .metrics import MetricsCollector
+from .telemetry import TelemetryAggregator
 from .rolemanager import RoleManager
 
 log = logging.getLogger("swarmkit_tpu.manager")
@@ -295,6 +296,17 @@ class Manager:
             ),
             RoleManager(self.store, raft_node=self.raft),
             MetricsCollector(self.store),
+            # cluster telemetry rollup (ISSUE 15): leader-side merge of
+            # the dispatcher's shard-stored node snapshots; registers
+            # itself with utils/telemetry so control.get_cluster_telemetry
+            # and /debug/cluster find it
+            TelemetryAggregator(
+                self.store, self.dispatcher, raft=self.raft,
+                # the manager's own node id: its co-located agent's
+                # piggybacked report supersedes the local-registry merge
+                # (same process, same registry — see manager/telemetry.py)
+                local_node_id=(self.security.node_id()
+                               if self.security is not None else None)),
         ]
         if self.raft is not None:
             from .wedge import WedgeMonitor
@@ -362,6 +374,10 @@ class Manager:
     @property
     def metrics(self):
         return self._component(MetricsCollector)
+
+    @property
+    def telemetry(self):
+        return self._component(TelemetryAggregator)
 
     @property
     def key_manager(self):

@@ -1,0 +1,338 @@
+"""Manager-side cluster telemetry aggregation (ISSUE 15).
+
+`TelemetryAggregator` is the leader component that turns the
+shard-stored node snapshots (dispatcher/dispatcher.py heartbeat
+piggyback — see docs/dispatcher.md) into one queryable cluster
+artifact:
+
+  * merges the per-shard PARTIAL rollups (merge_snapshot is
+    associative/commutative, so shard partials compose) plus the
+    manager's own local registry into cluster-level `swarm_cluster_*`
+    families rendered into /metrics;
+  * tracks per-node FRESHNESS: a node's report age is judged against
+    the dispatcher's heartbeat period × grace multiplier (the same 3×
+    window that expires its session) — stale nodes are EXCLUDED from
+    the merged families and LISTED, never silently averaged in, and a
+    fresh→stale transition bumps the node's flap counter;
+  * keeps a bounded TIME-SERIES RING of fixed-width windows fed on
+    every rollup/scrape, queryable with nearest-rank percentiles over a
+    trailing `?window=` (utils/slo.quantile_nearest_rank is the one
+    percentile implementation);
+  * folds in the manager-local component counters the per-process
+    /metrics already exposes (raft WAL fsyncs, store op counts,
+    dispatcher flush-plane counters, read-lease health) so the bench
+    and the fault soaks read ONE artifact.
+
+The aggregator registers itself with utils/telemetry.py on start (how
+`control.get_cluster_telemetry` — leader-forwarded — and the
+debugserver's `/debug/cluster` find it) and unregisters on stop; it
+holds no thread of its own — rollups happen on the reader's thread.
+"""
+from __future__ import annotations
+
+from ..analysis.lockgraph import make_lock
+from ..utils import telemetry
+from ..utils.metrics import (
+    _escape_label_value,
+    empty_snapshot,
+    merge_snapshot,
+    registry_snapshot,
+)
+from ..utils.slo import quantiles_nearest_rank
+
+# samples kept per (series, window slot): rollups are scrape-cadence,
+# so this bounds memory without biasing any realistic cadence
+MAX_SLOT_SAMPLES = 256
+
+
+class TimeSeriesRing:
+    """Fixed-width window ring for scalar samples: `observe(name, v)`
+    lands in the current window; old windows are overwritten in place
+    (bounded memory, no compaction thread). `samples(name, window_s)`
+    returns every sample whose window starts inside the trailing
+    `window_s`; percentile queries ride quantiles_nearest_rank over
+    that."""
+
+    def __init__(self, width_s: float = 5.0, slots: int = 240,
+                 clock=None):
+        from ..utils.clock import REAL_CLOCK
+
+        if width_s <= 0 or slots <= 0:
+            raise ValueError("ring needs positive width and slots")
+        self.width_s = float(width_s)
+        self.slots = int(slots)
+        self.clock = clock or REAL_CLOCK
+        self._lock = make_lock('manager.telemetry.ring')
+        # slot index -> (window id, {name: [samples]})
+        self._ring: dict[int, tuple[int, dict]] = {}
+
+    def _window(self) -> int:
+        return int(self.clock.monotonic() / self.width_s)
+
+    def observe(self, name: str, value: float) -> None:
+        win = self._window()
+        slot = win % self.slots
+        with self._lock:
+            cur = self._ring.get(slot)
+            if cur is None or cur[0] != win:
+                cur = (win, {})
+                self._ring[slot] = cur
+            vs = cur[1].setdefault(name, [])
+            if len(vs) < MAX_SLOT_SAMPLES:
+                vs.append(float(value))
+
+    def observe_many(self, name: str, values) -> None:
+        for v in values:
+            self.observe(name, v)
+
+    def samples(self, name: str, window_s: float | None = None) -> list:
+        now_win = self._window()
+        # windows older than the ring's span were overwritten
+        span = self.slots if window_s is None else \
+            max(1, int(window_s / self.width_s) + 1)
+        lo = now_win - span + 1
+        out: list[float] = []
+        with self._lock:
+            for win, series in self._ring.values():
+                if win >= lo:
+                    out.extend(series.get(name, ()))
+        return out
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted({n for _win, series in self._ring.values()
+                           for n in series})
+
+    def quantiles(self, name: str, ps=(50, 99),
+                  window_s: float | None = None) -> dict:
+        return quantiles_nearest_rank(self.samples(name, window_s), ps)
+
+
+def _metric_name(name: str) -> str:
+    """`swarm_cluster_` + the source family name (its own `swarm_`
+    prefix stripped), sanitized to the Prometheus charset."""
+    base = name[len("swarm_"):] if name.startswith("swarm_") else name
+    safe = "".join(c if (c.isalnum() or c in "_:") else "_"
+                   for c in base)
+    return f"swarm_cluster_{safe}"
+
+
+class TelemetryAggregator:
+    """Leader component: cluster rollup over the dispatcher's
+    shard-stored node telemetry reports."""
+
+    def __init__(self, store, dispatcher, raft=None, clock=None,
+                 local_node_id: str | None = None,
+                 ring_width_s: float = 5.0, ring_slots: int = 240):
+        self.store = store
+        self.dispatcher = dispatcher
+        self.raft = raft
+        # the manager's OWN node id (swarmd managers co-run an agent in
+        # this process): when that agent's fresh report is in the shard
+        # store, it already IS this process's registry — merging the
+        # local registry again would double-count every leader-process
+        # family in the cluster sums
+        self.local_node_id = local_node_id
+        self.clock = clock or getattr(dispatcher, "clock", None)
+        if self.clock is None:
+            from ..utils.clock import REAL_CLOCK
+
+            self.clock = REAL_CLOCK
+        self.ring = TimeSeriesRing(width_s=ring_width_s, slots=ring_slots,
+                                   clock=self.clock)
+        self._lock = make_lock('manager.telemetry.aggregator')
+        self._was_stale: set[str] = set()
+        self._flaps: dict[str, int] = {}
+
+    # ----------------------------------------------------------- component
+    def start(self):
+        telemetry.set_aggregator(self)
+
+    def stop(self):
+        telemetry.clear_aggregator(self)
+
+    # ------------------------------------------------------------ freshness
+    def stale_after(self) -> float:
+        """A report older than this is stale: the dispatcher's heartbeat
+        grace window (period × multiplier — the same 3× bound that
+        expires the session), re-read per rollup so live period
+        reconfig applies."""
+        from ..dispatcher.dispatcher import GRACE_MULTIPLIER
+
+        period = getattr(self.dispatcher, "heartbeat_period", 5.0)
+        return period * GRACE_MULTIPLIER
+
+    # -------------------------------------------------------------- rollup
+    def rollup(self, window_s: float | None = None,
+               include_local: bool = True) -> dict:
+        """One cluster rollup pass. Merges each shard's fresh reports
+        into a shard-partial snapshot, composes the partials (+ the
+        local registry when `include_local`), computes freshness/flaps,
+        feeds the time-series ring, and returns the queryable dict."""
+        now = self.clock.monotonic()
+        stale_after = self.stale_after()
+        shard_reports = self.dispatcher.telemetry_reports()
+        merged = empty_snapshot()
+        ages: dict[str, float] = {}
+        stale: list[str] = []
+        reported = 0
+        local_covered = False
+        for shard in shard_reports:
+            partial = empty_snapshot()
+            for node_id, (snap, stamp) in shard.items():
+                reported += 1
+                age = max(0.0, now - stamp)
+                ages[node_id] = age
+                if age > stale_after:
+                    stale.append(node_id)
+                    continue   # never silently averaged in
+                if node_id == self.local_node_id:
+                    local_covered = True
+                partial = merge_snapshot(partial, snap)
+            merged = merge_snapshot(merged, partial)
+        stale.sort()
+        with self._lock:
+            for node_id in stale:
+                if node_id not in self._was_stale:
+                    self._flaps[node_id] = self._flaps.get(node_id, 0) + 1
+            self._was_stale = set(stale)
+            flaps = dict(self._flaps)
+        if include_local and not local_covered:
+            # the co-located agent's fresh report (swarmd managers run
+            # one in-process) already carries this process's registry —
+            # only merge the local registry when no such report landed
+            merged = merge_snapshot(merged, registry_snapshot())
+        fresh = reported - len(stale)
+        # ring feed: one sample set per rollup/scrape
+        self.ring.observe("nodes_fresh", fresh)
+        self.ring.observe("nodes_stale", len(stale))
+        self.ring.observe_many(
+            "report_age_s",
+            (a for nid, a in ages.items() if nid not in stale))
+        manager = self._manager_families()
+        flush_s = manager.get("dispatcher", {}).get("last_flush_s")
+        if flush_s:
+            self.ring.observe("dispatcher_flush_s", flush_s)
+        out = {
+            "armed": telemetry.enabled(),
+            "stale_after_s": stale_after,
+            "nodes": {
+                "reported": reported,
+                "fresh": fresh,
+                "stale": stale,
+                "flaps": {n: c for n, c in sorted(flaps.items()) if c},
+                "report_age_s": {n: round(a, 3)
+                                 for n, a in sorted(ages.items())},
+            },
+            "cluster": merged,
+            "manager": manager,
+        }
+        if window_s is not None:
+            out["window_s"] = window_s
+            out["windows"] = {
+                name: {f"p{p:g}": v for p, v in
+                       self.ring.quantiles(name, (50, 99),
+                                           window_s=window_s).items()}
+                for name in self.ring.names()}
+        return out
+
+    def _manager_families(self) -> dict:
+        """Manager-local component counters (every lookup defensive —
+        a stub, a worker-side aggregator, or a pre-leadership manager
+        contributes fewer keys), the same families the per-process
+        /metrics exposes (node/debugserver.py component_metrics_text)."""
+        out: dict = {}
+        storage = getattr(self.raft, "storage", None)
+        if storage is not None and hasattr(storage, "wal_fsyncs"):
+            out["raft"] = {"wal_fsyncs": storage.wal_fsyncs,
+                           "meta_fsyncs": storage.meta_fsyncs}
+        raft = self.raft
+        if raft is not None:
+            lease = {"lease_duration_s":
+                     getattr(raft, "lease_duration", 0.0)}
+            contact = getattr(raft, "_lease_quorum_contact", None)
+            if contact:
+                lease["quorum_contact_age_s"] = round(
+                    max(0.0, self.clock.monotonic() - contact), 3)
+            out.setdefault("raft", {})["read_lease"] = lease
+            out["raft"]["commit_index"] = getattr(raft, "commit_index", 0)
+        op_counts = getattr(self.store, "op_counts", None)
+        if op_counts:
+            out["store_ops"] = dict(op_counts)
+        metrics = getattr(self.dispatcher, "metrics", None)
+        if metrics is not None:
+            out["dispatcher"] = dict(metrics)
+        return out
+
+    # ------------------------------------------------------------- renders
+    def prometheus_text(self, window_s: float | None = None) -> str:
+        """The `swarm_cluster_*` exposition: merged node families
+        (counters/histograms/gauges) + the freshness surface."""
+        roll = self.rollup(window_s=window_s)
+        snap = roll["cluster"]
+        lines: list[str] = []
+
+        def fam(name, help_, type_, samples):
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} {type_}")
+            lines.extend(samples)
+
+        nodes = roll["nodes"]
+        fam("swarm_cluster_nodes_reported",
+            "nodes with a stored telemetry report", "gauge",
+            [f"swarm_cluster_nodes_reported {nodes['reported']}"])
+        fam("swarm_cluster_nodes_fresh",
+            "nodes whose latest report is inside the staleness window",
+            "gauge", [f"swarm_cluster_nodes_fresh {nodes['fresh']}"])
+        fam("swarm_cluster_nodes_stale",
+            "nodes whose reports went stale (excluded from the merged "
+            "families — never silently averaged in)", "gauge",
+            [f"swarm_cluster_nodes_stale {len(nodes['stale'])}"])
+        if nodes["stale"]:
+            fam("swarm_cluster_stale_node_info",
+                "per-node stale markers (1 per stale node)", "gauge",
+                [f'swarm_cluster_stale_node_info{{node="'
+                 f'{_escape_label_value(n)}"}} 1'
+                 for n in nodes["stale"]])
+        if nodes["flaps"]:
+            fam("swarm_cluster_node_flaps_total",
+                "fresh->stale transitions per node", "counter",
+                [f'swarm_cluster_node_flaps_total{{node="'
+                 f'{_escape_label_value(n)}"}} {c}'
+                 for n, c in nodes["flaps"].items()])
+        for name, f in sorted(snap.get("counters", {}).items()):
+            mname = _metric_name(name)
+            samples = []
+            for values, n in f.get("series", ()):
+                lbl = ",".join(
+                    f'{k}="{_escape_label_value(v)}"'
+                    for k, v in zip(f.get("labels", ()), values))
+                samples.append(f"{mname}{{{lbl}}} {n}" if lbl
+                               else f"{mname} {n}")
+            fam(mname, f"cluster sum of {name} over fresh nodes",
+                "counter", samples)
+        for name, f in sorted(snap.get("histograms", {}).items()):
+            mname = _metric_name(name)
+            buckets = f.get("buckets", ())
+            samples = []
+            for series in f.get("series", ()):
+                values, counts, total, n = series
+                lbl = ",".join(
+                    f'{k}="{_escape_label_value(v)}"'
+                    for k, v in zip(f.get("labels", ()), values))
+                pre = (lbl + ",") if lbl else ""
+                cum = 0
+                for b, c in zip(buckets, counts):
+                    cum += c
+                    samples.append(f'{mname}_bucket{{{pre}le="{b}"}} {cum}')
+                samples.append(f'{mname}_bucket{{{pre}le="+Inf"}} {n}')
+                suffix = f"{{{lbl}}}" if lbl else ""
+                samples.append(f"{mname}_sum{suffix} {total:.6f}")
+                samples.append(f"{mname}_count{suffix} {n}")
+            fam(mname, f"cluster merge of {name} over fresh nodes",
+                "histogram", samples)
+        for name, v in sorted(snap.get("gauges", {}).items()):
+            mname = _metric_name(str(name))
+            fam(mname, f"cluster sum of gauge {name} over fresh nodes",
+                "gauge", [f"{mname} {v}"])
+        return "\n".join(lines)

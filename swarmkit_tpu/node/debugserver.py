@@ -35,6 +35,13 @@ manager/manager.go:551-562 grpc_prometheus). The Python-native analogue:
                  restrict to the trailing recovery window
   /debug/tasks   ?id=<task>: that task's state-transition timeline;
                  without id, tracked tasks with their latest stage
+  /debug/cluster cluster telemetry rollup (utils/telemetry.py +
+                 manager/telemetry.py, leader only): merged node metric
+                 snapshots, per-node freshness (stale nodes listed,
+                 never averaged in), manager-local families;
+                 ?window=N adds ring percentiles over the trailing
+                 window; {"armed": false} when the plane is disarmed
+                 or this node holds no aggregator
 
 Bound to loopback by default; no TLS (match the reference's plaintext debug
 listeners, which are operator-only surfaces).
@@ -150,6 +157,11 @@ def component_metrics_text(node) -> str:
     queue depth + poison count, and heartbeat-wheel occupancy. Every
     lookup is defensive — a worker node (no raft), a stub, or a
     pre-leadership manager simply contributes fewer families."""
+    # absolute import: this module is also loaded straight from its
+    # file in crypto-less environments (see the lockgraph import above),
+    # where relative imports have no package context
+    from swarmkit_tpu.utils.metrics import _escape_label_value
+
     lines: list[str] = []
 
     def fam(name, help_, type_, samples):
@@ -171,14 +183,36 @@ def component_metrics_text(node) -> str:
             [f"swarm_raft_meta_fsyncs_total {storage.meta_fsyncs}"])
     op_counts = getattr(_find(node, "store"), "op_counts", None)
     if op_counts:
-        from ..utils.metrics import _escape_label_value
-
         fam("swarm_store_ops_total",
             "store operations by kind (view/update transactions, "
             "per-table finds)", "counter",
             [f'swarm_store_ops_total{{op="{_escape_label_value(op)}"}} {n}'
              for op, n in sorted(op_counts.items())])
-    wheel = getattr(_find(node, "dispatcher"), "_hb_wheel", None)
+    disp = _find(node, "dispatcher")
+    disp_metrics = getattr(disp, "metrics", None)
+    if disp_metrics:
+        # the flush-plane counter bag, exposed generically so a new key
+        # appears here WITHOUT a hand edit (the exposition drift guard
+        # in tests/test_metrics_exposition.py walks the live dict)
+        ints, floats = [], []
+        for key in sorted(disp_metrics):
+            v = disp_metrics[key]
+            lbl = _escape_label_value(key)
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            if isinstance(v, int):
+                ints.append(f'swarm_dispatcher_plane_total'
+                            f'{{counter="{lbl}"}} {v}')
+            else:
+                floats.append(f'swarm_dispatcher_plane'
+                              f'{{stat="{lbl}"}} {v}')
+        fam("swarm_dispatcher_plane_total",
+            "dispatcher fan-out plane counters (flushes, flush_tx, "
+            "ships, wire_copies, dirty_walks, ...)", "counter", ints)
+        fam("swarm_dispatcher_plane",
+            "dispatcher fan-out plane stats (last_flush_s, ...)",
+            "gauge", floats)
+    wheel = getattr(disp, "_hb_wheel", None)
     if wheel is not None:
         fam("swarm_heartbeat_wheel_entries",
             "sessions armed on the dispatcher heartbeat wheel", "gauge",
@@ -267,6 +301,10 @@ class DebugServer:
                         self._reply(json.dumps(outer._slo(self.path),
                                                indent=2),
                                     ctype="application/json")
+                    elif self.path.startswith("/debug/cluster"):
+                        self._reply(json.dumps(outer._cluster(self.path),
+                                               indent=2),
+                                    ctype="application/json")
                     elif self.path.startswith("/debug/tasks"):
                         self._reply(json.dumps(outer._tasks(self.path),
                                                indent=2),
@@ -309,8 +347,11 @@ class DebugServer:
             parts.append(collector.prometheus_text())
         else:
             # non-leader / worker: hot-path histograms + per-RPC families
-            # still exist
-            from ..utils.metrics import all_families, all_histograms
+            # still exist (absolute import — file-mode load, see above)
+            from swarmkit_tpu.utils.metrics import (
+                all_families,
+                all_histograms,
+            )
 
             parts.extend(
                 [h.prometheus_text() for h in all_histograms()]
@@ -318,7 +359,37 @@ class DebugServer:
         comp = component_metrics_text(node)
         if comp:
             parts.append(comp)
+        # cluster rollup families (ISSUE 15): the leader's aggregator
+        # renders swarm_cluster_* next to the per-process families
+        from swarmkit_tpu.utils import telemetry
+
+        agg = telemetry.aggregator()
+        if agg is not None and telemetry.enabled():
+            try:
+                parts.append(agg.prometheus_text())
+            except Exception:
+                pass  # a degraded rollup must not break the scrape
         return "\n".join(p for p in parts if p)
+
+    def _cluster(self, path: str) -> dict:
+        """/debug/cluster: the telemetry rollup (merged node snapshots,
+        freshness, manager families); ?window=N adds nearest-rank
+        percentiles over the ring's trailing window."""
+        from urllib.parse import parse_qs, urlparse
+
+        from swarmkit_tpu.utils import telemetry
+
+        agg = telemetry.aggregator()
+        if agg is None:
+            return {"armed": telemetry.enabled(), "aggregator": False}
+        q = parse_qs(urlparse(path).query)
+        window = None
+        try:
+            if "window" in q:
+                window = float(q["window"][0])
+        except ValueError:
+            window = None
+        return agg.rollup(window_s=window)
 
     def _trace(self, path: str) -> dict:
         """/debug/trace?seconds=N and /debug/trace/recent: JSON span
@@ -327,7 +398,7 @@ class DebugServer:
         trace capture from a live daemon without restarting it."""
         from urllib.parse import parse_qs, urlparse
 
-        from ..utils import trace
+        from swarmkit_tpu.utils import trace
 
         parsed = urlparse(path)
         if parsed.path.rstrip("/").endswith("/recent"):
@@ -372,7 +443,7 @@ class DebugServer:
         trailing window (`?window=N` is sugar for since=now-N)."""
         from urllib.parse import parse_qs, urlparse
 
-        from ..utils import lifecycle, slo
+        from swarmkit_tpu.utils import lifecycle, slo
 
         r = lifecycle.recorder()
         if r is None:
@@ -407,7 +478,7 @@ class DebugServer:
         capped at 200)."""
         from urllib.parse import parse_qs, urlparse
 
-        from ..utils import lifecycle
+        from swarmkit_tpu.utils import lifecycle
 
         r = lifecycle.recorder()
         if r is None:
@@ -430,7 +501,12 @@ class DebugServer:
         return {"armed": True, "tasks": len(r), "latest_stage": out}
 
     def _vars(self) -> dict:
-        from ..utils import failpoints, lifecycle, trace
+        from swarmkit_tpu.utils import (
+            failpoints,
+            lifecycle,
+            telemetry,
+            trace,
+        )
 
         node = self.node
         out = {
@@ -444,6 +520,7 @@ class DebugServer:
             "failpoints_armed": failpoints.active(),
             "trace_armed": trace.active(),
             "lifecycle_armed": lifecycle.active(),
+            "telemetry_armed": telemetry.active(),
         }
         store = _find(node, "store")
         if store is not None and getattr(store, "op_counts", None) \

@@ -315,9 +315,9 @@ def build_manager_registry(manager, raft_node=None,
         _require_node(caller, node_id)
         return d.register(node_id, description)
 
-    def disp_heartbeat(caller, node_id, session_id):
+    def disp_heartbeat(caller, node_id, session_id, metrics=None):
         _require_node(caller, node_id)
-        return d.heartbeat(node_id, session_id)
+        return d.heartbeat(node_id, session_id, metrics=metrics)
 
     def _follower_read(serve):
         """Serve a read stream from the follower plane, translating a
@@ -551,8 +551,14 @@ class RemoteDispatcher:
                 self.addr = addr
         return self._conn().call("dispatcher.register", node_id, description)
 
-    def heartbeat(self, node_id, session_id):
-        return self._conn().call("dispatcher.heartbeat", node_id, session_id)
+    def heartbeat(self, node_id, session_id, metrics=None):
+        if metrics is None:
+            # keep the wire frame of a plain beat unchanged (and old
+            # servers compatible) when no snapshot rides along
+            return self._conn().call("dispatcher.heartbeat", node_id,
+                                     session_id)
+        return self._conn().call("dispatcher.heartbeat", node_id,
+                                 session_id, metrics=metrics)
 
     def assignments(self, node_id, session_id):
         return self._conn().stream("dispatcher.assignments", node_id,

@@ -218,6 +218,18 @@ class LifecycleRecorder:
                     out.append(t1 - t0)
         return out
 
+    def stage_census(self) -> dict[str, int]:
+        """Latest-stage census over tracked tasks ({stage: count}) —
+        the telemetry plane's task-state gauge set (one lock hold, no
+        timeline copies)."""
+        out: dict[str, int] = {}
+        with self._lock:
+            for tl in self._timelines.values():
+                if tl:
+                    stage = tl[-1][0]
+                    out[stage] = out.get(stage, 0) + 1
+        return out
+
     def transition_counts(self) -> dict[tuple[str, str], int]:
         counts: dict[tuple[str, str], int] = {}
         with self._lock:

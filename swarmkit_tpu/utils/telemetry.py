@@ -1,0 +1,202 @@
+"""Cluster telemetry rollup plane (ISSUE 15): heartbeat-piggybacked
+node metric snapshots, merged manager-side into cluster-level families.
+
+The trace plane (utils/trace.py) gave the system causal DEPTH — where a
+given operation's time went. This plane adds the Monarch/Borg BREADTH
+axis: every agent ships a compact snapshot of its metric registry
+(utils/metrics.py `registry_snapshot`) on every Kth heartbeat; the
+dispatcher stores the latest report in the session's owning SHARD (the
+ISSUE 13 fan-out plane — the rollup scales with the dispatcher instead
+of adding a scrape fan-in); the manager-side aggregator
+(manager/telemetry.py) merges shard-partial rollups with its own local
+families into `swarm_cluster_*` /metrics families, `/debug/cluster`,
+and `control.get_cluster_telemetry` (leader-forwarded), with per-node
+FRESHNESS tracked explicitly — a node whose beats stop goes stale and
+is listed, never silently averaged in.
+
+Cost contract — identical to utils/failpoints.py, utils/trace.py and
+utils/lifecycle.py: DISARMED, the beat path costs ONE module-global
+truthiness test (`telemetry._STATE is None`) and never builds a
+snapshot, takes a lock, or walks the registry. Sites that assemble a
+snapshot guard the assembly with `telemetry.enabled()` (the
+span-in-loop lint rule audits `telemetry.*` calls in the hot modules).
+The conftest fails any test that leaks an armed plane; the bench
+`telemetry_plane` row pins `disarmed_beat_allocs == 0`.
+
+Piggyback cadence and size bounds: every `report_every`-th beat
+(default 6 — ~30 s at the 5 s heartbeat period) builds one snapshot,
+bounded to `max_bytes` JSON-encoded (oversize reports degrade to a
+gauges-only snapshot with `truncated` set — partial data beats a
+dropped node). The dispatcher additionally enforces a structural bound
+(`MAX_REPORT_SERIES`) on arrival: the wire codec rebuilds payloads
+without field checks, and one hostile agent must not balloon a shard's
+report store.
+
+Documented in docs/observability.md (snapshot codec, freshness
+semantics) and docs/dispatcher.md (shard-stored snapshots).
+"""
+from __future__ import annotations
+
+import json
+import os
+from contextlib import contextmanager
+
+from ..analysis.lockgraph import make_lock
+
+_REG_LOCK = make_lock('utils.telemetry.REG_LOCK')
+# The armed plane state, or None. Replaced wholesale on arm/disarm so
+# hot sites read it without a lock; the disarmed fast path everywhere
+# is `if _STATE is None: return`.
+_STATE: "TelemetryState | None" = None
+
+# The live manager-side aggregator (manager/telemetry.py registers on
+# start, clears on stop) — how control.get_cluster_telemetry and the
+# debugserver find it without threading a handle through ControlAPI.
+_AGG = None
+
+DEFAULT_REPORT_EVERY = 6          # beats between piggybacked snapshots
+DEFAULT_MAX_BYTES = 128 * 1024    # JSON-encoded snapshot budget
+MAX_REPORT_SERIES = 4096          # dispatcher-side structural bound
+
+
+class TelemetryState:
+    """Armed-plane config + counters (reports built/truncated/rejected —
+    the observability of the observability plane)."""
+
+    def __init__(self, report_every: int = DEFAULT_REPORT_EVERY,
+                 max_bytes: int = DEFAULT_MAX_BYTES):
+        self.report_every = max(1, int(report_every))
+        self.max_bytes = int(max_bytes)
+        self._lock = make_lock('utils.telemetry.state')
+        self.reports_built = 0
+        self.reports_truncated = 0
+        self.reports_stored = 0
+        self.reports_rejected = 0
+
+    def bump(self, attr: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, attr, getattr(self, attr) + n)
+
+
+# ------------------------------------------------------------------ sites
+def enabled() -> bool:
+    return _STATE is not None
+
+
+def state() -> TelemetryState | None:
+    return _STATE
+
+
+def report_every() -> int:
+    s = _STATE
+    return s.report_every if s is not None else DEFAULT_REPORT_EVERY
+
+
+def node_snapshot(agent=None, gauges: dict | None = None) -> dict | None:
+    """Build one node's piggyback payload: the process metric registry
+    plus the small additive gauge set — lifecycle task-state census
+    (armed recorders only), the agent's status-report queue depth and
+    locally-known task count. Returns None when the plane is disarmed
+    (callers guard with `telemetry.enabled()` anyway so the disarmed
+    beat path never even reaches here)."""
+    s = _STATE
+    if s is None:
+        return None
+    from . import lifecycle, metrics
+
+    g: dict = dict(gauges or ())
+    rec = lifecycle.recorder()
+    if rec is not None:
+        for stage, n in rec.stage_census().items():
+            g[f"tasks_{stage.lower()}"] = n
+    if agent is not None:
+        pending = getattr(agent, "_pending", None)
+        if pending is not None:
+            g["agent_pending_statuses"] = len(pending)
+        worker = getattr(agent, "worker", None)
+        tasks = getattr(worker, "_tasks", None)
+        if tasks is not None:
+            g["agent_tasks"] = len(tasks)
+    snap = metrics.registry_snapshot(gauges=g)
+    s.bump("reports_built")
+    try:
+        if len(json.dumps(snap)) > s.max_bytes:
+            # oversize: degrade to gauges-only rather than dropping the
+            # node from the rollup entirely
+            snap = {"v": 1, "counters": {}, "histograms": {},
+                    "gauges": dict(g), "truncated": True}
+            s.bump("reports_truncated")
+    except (TypeError, ValueError):
+        snap = {"v": 1, "counters": {}, "histograms": {}, "gauges": {},
+                "truncated": True}
+        s.bump("reports_truncated")
+    return snap
+
+
+# ------------------------------------------------------------ aggregator
+def aggregator():
+    """The live manager-side TelemetryAggregator (leader only), or
+    None."""
+    return _AGG
+
+
+def set_aggregator(agg) -> None:
+    global _AGG
+    with _REG_LOCK:
+        _AGG = agg
+
+
+def clear_aggregator(agg) -> None:
+    """Unregister `agg` if it is still the live one (a newer leadership
+    cycle's aggregator must not be clobbered by the old one's stop)."""
+    global _AGG
+    with _REG_LOCK:
+        if _AGG is agg:
+            _AGG = None
+
+
+# ----------------------------------------------------------------- arming
+def arm(report_every: int = DEFAULT_REPORT_EVERY,
+        max_bytes: int = DEFAULT_MAX_BYTES) -> TelemetryState:
+    """Arm the telemetry plane (idempotent re-arm replaces the state)."""
+    global _STATE
+    s = TelemetryState(report_every=report_every, max_bytes=max_bytes)
+    with _REG_LOCK:
+        _STATE = s
+    return s
+
+
+def disarm() -> None:
+    global _STATE
+    with _REG_LOCK:
+        _STATE = None
+
+
+def active() -> bool:
+    return _STATE is not None
+
+
+@contextmanager
+def armed(report_every: int = DEFAULT_REPORT_EVERY,
+          max_bytes: int = DEFAULT_MAX_BYTES):
+    """`with telemetry.armed() as st: ...` — the per-test arming
+    surface; always disarms on exit (the conftest guard fails leaks)."""
+    s = arm(report_every=report_every, max_bytes=max_bytes)
+    try:
+        yield s
+    finally:
+        disarm()
+
+
+# ---------------------------------------------------------------- env var
+# SWARMKIT_TPU_TELEMETRY arms the plane in subprocesses (multi-process
+# swarmd, live-daemon rollup capture): "1" or a report_every cadence.
+_ENV_VAR = "SWARMKIT_TPU_TELEMETRY"
+
+_env_val = os.environ.get(_ENV_VAR, "").strip().lower()
+if _env_val and _env_val not in ("0", "false", "off", "no"):
+    try:
+        _every = int(_env_val)
+    except ValueError:
+        _every = DEFAULT_REPORT_EVERY
+    arm(report_every=_every if _every > 1 else DEFAULT_REPORT_EVERY)

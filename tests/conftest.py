@@ -172,6 +172,24 @@ def _lifecycle_guard(request):
             "test leaked an armed lifecycle recorder (lifecycle.disarm())"
 
 
+@pytest.fixture(autouse=True)
+def _telemetry_guard():
+    """Telemetry-plane guard (ISSUE 15), the trace/failpoints shape: a
+    leaked armed plane would make every later test's agents build and
+    piggyback snapshots (and the dispatcher accrete shard reports) —
+    fail the leaking test itself and always disarm. Also clears a
+    leaked aggregator registration (a Manager whose stop() never ran
+    must not serve the next test's get_cluster_telemetry)."""
+    from swarmkit_tpu.utils import telemetry
+
+    yield
+    leaked = telemetry.active()
+    telemetry.disarm()
+    telemetry.set_aggregator(None)
+    assert not leaked, \
+        "test leaked an armed telemetry plane (telemetry.disarm())"
+
+
 @pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_makereport(item, call):
     """Chaos forensics: a failing chaos-marked test gets the flight-

@@ -871,6 +871,85 @@ def test_raw_lock_not_enforced_in_tests():
     assert findings(src, "tests/test_foo.py") == []
 
 
+# ------------------------------------------------------------- raw-metric
+def test_raw_metric_fires_on_imported_class_construction():
+    # ISSUE 15 satellite: a directly-constructed family never enters
+    # the registry, so /metrics and the telemetry rollup miss it
+    src = """
+    from ..utils.metrics import CounterFamily
+    fam = CounterFamily("swarm_x_total", "help", ("k",))
+    """
+    assert findings(src, "swarmkit_tpu/foo/bar.py") == ["raw-metric"]
+
+
+def test_raw_metric_fires_on_dotted_construction_and_alias():
+    src = """
+    from ..utils import metrics
+    from ..utils.metrics import Histogram as H
+    h1 = metrics.Histogram("swarm_y_seconds")
+    h2 = H("swarm_z_seconds")
+    """
+    assert findings(src, "swarmkit_tpu/foo/bar.py") \
+        == ["raw-metric", "raw-metric"]
+
+
+def test_raw_metric_fires_through_module_alias():
+    # `metrics as m` must not smuggle a construction past the rule
+    src = """
+    from ..utils import metrics as m
+    import swarmkit_tpu.utils.metrics as mx
+    h1 = m.Histogram("swarm_y_seconds")
+    h2 = mx.CounterFamily("swarm_x_total", "h", ("k",))
+    """
+    assert findings(src, "swarmkit_tpu/foo/bar.py") \
+        == ["raw-metric", "raw-metric"]
+
+
+def test_raw_metric_not_fired_on_factories_or_collections_counter():
+    src = """
+    from collections import Counter
+    from ..utils import metrics
+    from ..utils.metrics import histogram
+    c = Counter()                      # collections, not a metric
+    h = histogram("swarm_y_seconds")   # the factory IS the rule
+    f = metrics.counter_family("swarm_x_total", "h", ("k",))
+    """
+    assert findings(src, "swarmkit_tpu/foo/bar.py") == []
+
+
+def test_raw_metric_allowed_in_metrics_module_and_tests():
+    src = """
+    from ..utils.metrics import Histogram
+    h = Histogram("swarm_y_seconds")
+    """
+    assert findings(src, "swarmkit_tpu/utils/metrics.py") == []
+    assert findings(src, "tests/test_foo.py") == []
+
+
+def test_telemetry_snapshot_in_loop_fires_unguarded():
+    # the heartbeat loop's piggyback build must sit under the
+    # `if telemetry.enabled():` guard (agent/agent.py is audited)
+    src = """
+    from ..utils import telemetry
+    def f(self):
+        while True:
+            snap = telemetry.node_snapshot(agent=self)
+    """
+    assert findings(src, "swarmkit_tpu/agent/agent.py") \
+        == ["span-in-loop"]
+
+
+def test_telemetry_snapshot_enabled_guard_clean():
+    src = """
+    from ..utils import telemetry
+    def f(self):
+        while True:
+            if telemetry.enabled():
+                snap = telemetry.node_snapshot(agent=self)
+    """
+    assert findings(src, "swarmkit_tpu/agent/agent.py") == []
+
+
 # ------------------------------------------------------------ mirror drift
 def test_mirror_clean_on_real_tree():
     rep = mirror.check_drift(ROOT)

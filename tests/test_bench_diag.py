@@ -138,6 +138,27 @@ def test_orchestrator_storm_cpu_smoke():
     assert row["disarmed_plane_calls"] == 0
 
 
+def test_telemetry_plane_row_cpu_smoke():
+    """ISSUE 15 contracts of the telemetry_plane row at a CPU-smoke
+    shape (op counts + parity, never wall clock — contended 1-core
+    host; the 10k-node merge throughput and per-beat overheads are
+    judged by the bench row, where bench owns the machine): zero
+    snapshot builds/stores on the disarmed beat path, every armed beat
+    stored, rollup counters exact vs the manual sum, the driven parity
+    gate, and staleness detection."""
+    import numpy as np
+
+    row = bench.bench_telemetry_plane(np, n_nodes=300, beat_nodes=40,
+                                      beats_per_node=3)
+    assert row["parity"] is True, row
+    assert row["disarmed_beat_allocs"] == 0
+    assert row["reports_stored"] == 40
+    assert row["rollup_counter_exact"] is True
+    assert row["driven_parity"] is True
+    assert row["stale_detection"] is True
+    assert row["merge_nodes_per_s"] > 0
+
+
 def test_store_plane_row_cpu_smoke():
     """ISSUE 11 parity check at a CPU-smoke size: the bench row's own
     correctness gates hold (object/columnar end-state equality + columns

@@ -13,6 +13,8 @@ Reference behavior: components exit cleanly on leadership loss
 import threading
 import time
 
+import pytest
+
 from swarmkit_tpu.api.objects import Cluster, Node
 from swarmkit_tpu.api.specs import Annotations, ClusterSpec
 from swarmkit_tpu.api.types import NodeRole
@@ -168,6 +170,10 @@ def test_leadership_burst_demote_reelect_restarts_components():
     self-terminating on LeadershipLost, that left a believing-it-leads
     manager with dead component threads. The buried demote must force a
     full stop/start cycle."""
+    # the full Manager assembly needs real certificates; on crypto-less
+    # containers this module now COLLECTS (manager/__init__ gained the
+    # ca-package crypto gate in ISSUE 15) and only this test skips
+    pytest.importorskip("cryptography")
     from swarmkit_tpu.manager.manager import Manager
 
     mgr = Manager(store=MemoryStore(), org="test-org")

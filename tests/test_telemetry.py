@@ -1,0 +1,473 @@
+"""Cluster telemetry rollup plane (ISSUE 15).
+
+Covers the four layers end to end:
+  * snapshot codec — associative/commutative merge, JSON round-trip,
+    structural size bound;
+  * heartbeat piggyback — shard-stored reports, disarmed beat path
+    builds NOTHING (the `_RecordAllocGuard` shape), cadence, hostile
+    payload bound, graceful-leave retirement;
+  * the acceptance: a FakeClock-driven cluster (leader dispatcher +
+    5 agent sessions across ≥2 shards) whose cluster families equal the
+    SUM of the per-node registries — counters bit-exact, histogram
+    buckets exact — and whose silent node goes STALE within 3× its
+    heartbeat period, excluded from the merge and listed;
+  * the satellite hammer: metric primitives lose zero increments
+    across 8 threads.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from functools import reduce
+
+from swarmkit_tpu.dispatcher.dispatcher import (
+    GRACE_MULTIPLIER,
+    Dispatcher,
+)
+from swarmkit_tpu.dispatcher.heartbeat import stable_shard
+from swarmkit_tpu.manager.telemetry import TelemetryAggregator, TimeSeriesRing
+from swarmkit_tpu.store.memory import MemoryStore
+from swarmkit_tpu.utils import metrics, telemetry
+from swarmkit_tpu.utils.clock import FakeClock
+from swarmkit_tpu.utils.metrics import (
+    Counter,
+    CounterDict,
+    CounterFamily,
+    Histogram,
+    empty_snapshot,
+    merge_snapshot,
+    registry_snapshot,
+    snapshot_counter_value,
+    snapshot_series_count,
+)
+
+
+def _node_registry(i: int):
+    """A standalone per-node registry (families never touch the
+    process-global registry — each fake node gets its own)."""
+    cf = CounterFamily("swarm_rpc_handled_total", "handled", ("method",))
+    cf.inc(("tick",), i + 1)
+    cf.inc(("status",), 2 * i)
+    h = Histogram("swarm_store_tx_seconds", "tx")
+    h.observe(0.001 * (i + 1))
+    h.observe(0.2)
+    return registry_snapshot(families=[cf], histograms=[h],
+                             gauges={"agent_tasks": i,
+                                     "tasks_running": 1})
+
+
+def assert_cluster_equals_sum(merged: dict, parts: list[dict]):
+    """Counters bit-exact, histogram bucket vectors/counts exact, sums
+    within float dust, gauges exact (the acceptance's equality)."""
+    want = reduce(merge_snapshot, parts, empty_snapshot())
+    assert merged["counters"] == want["counters"]
+    assert merged["gauges"] == want["gauges"]
+    assert set(merged["histograms"]) == set(want["histograms"])
+    for name, fam in want["histograms"].items():
+        got = merged["histograms"][name]
+        assert got["buckets"] == fam["buckets"]
+        got_series = {tuple(s[0]): s for s in got["series"]}
+        for values, counts, total, n in fam["series"]:
+            g = got_series[tuple(values)]
+            assert g[1] == counts, (name, values)      # bucket-exact
+            assert g[3] == n
+            assert abs(g[2] - total) < 1e-9
+
+
+# ------------------------------------------------------------------ codec
+def test_merge_snapshot_associative_commutative_and_json_safe():
+    parts = [_node_registry(i) for i in range(4)]
+    # JSON round-trip is identity-compatible with merging
+    parts[1] = json.loads(json.dumps(parts[1]))
+    ab = merge_snapshot(merge_snapshot(parts[0], parts[1]), parts[2])
+    ba = merge_snapshot(parts[0], merge_snapshot(parts[1], parts[2]))
+    assert json.dumps(ab, sort_keys=True) == json.dumps(ba, sort_keys=True)
+    com = merge_snapshot(parts[2], merge_snapshot(parts[1], parts[0]))
+    assert ab["counters"] == com["counters"]
+    assert ab["gauges"] == com["gauges"]
+    total = reduce(merge_snapshot, parts, empty_snapshot())
+    assert snapshot_counter_value(total, "swarm_rpc_handled_total",
+                                  ("tick",)) == sum(i + 1 for i in range(4))
+    # merging the empty snapshot is the identity
+    assert merge_snapshot(total, empty_snapshot())["counters"] \
+        == total["counters"]
+    json.dumps(total)   # the whole artifact stays JSON-safe
+
+
+def test_merge_snapshot_bucket_mismatch_never_mixes_grids():
+    a = {"v": 1, "counters": {}, "gauges": {},
+         "histograms": {"h": {"labels": [], "help": "", "buckets": [1.0],
+                              "series": [[[], [3], 1.5, 3]]}}}
+    b = {"v": 1, "counters": {}, "gauges": {},
+         "histograms": {"h": {"labels": [], "help": "",
+                              "buckets": [1.0, 2.0],
+                              "series": [[[], [1, 1], 2.0, 2]]}}}
+    out = merge_snapshot(a, b)
+    # larger-n series kept, the drop surfaced — never a summed mix of
+    # two bucket spaces
+    assert out["histograms"]["h"]["series"][0][3] == 3
+    assert out["gauges"]["merge_dropped"] == 1
+    # a NEW-key series from a mismatched grid must not land raw under
+    # the family's bucket header either
+    b2 = {"v": 1, "counters": {}, "gauges": {},
+          "histograms": {"h": {"labels": ["k"], "help": "",
+                               "buckets": [1.0, 2.0],
+                               "series": [[["y"], [1, 1], 2.0, 2]]}}}
+    out2 = merge_snapshot(a, b2)
+    assert all(s[0] != ["y"] for s in out2["histograms"]["h"]["series"])
+    assert out2["gauges"]["merge_dropped"] == 1
+
+
+def test_registry_snapshot_covers_plain_counter_and_series_count():
+    c = Counter("swarm_things_total", "things")
+    c.inc(7)
+    snap = registry_snapshot(families=[c], histograms=[],
+                             gauges={"g": 1})
+    assert snapshot_counter_value(snap, "swarm_things_total") == 7
+    assert snapshot_series_count(snap) == 2   # one series + one gauge
+
+
+# ------------------------------------------------- piggyback + dispatcher
+def test_disarmed_beat_builds_nothing_and_stores_nothing():
+    """The disarmed-cost contract: no snapshot construction, no report
+    stored, `node_snapshot` returns None — mirroring the lifecycle
+    plane's _RecordAllocGuard shape by spying the builder."""
+    calls = {"n": 0}
+    orig = metrics.registry_snapshot
+
+    def spy(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    clock = FakeClock()
+    store = MemoryStore()
+    d = Dispatcher(store, heartbeat_period=5.0, clock=clock, shards=2)
+    try:
+        metrics.registry_snapshot = spy
+        assert telemetry.node_snapshot() is None
+        sid = d.register("n1")
+        d.heartbeat("n1", sid)
+        assert calls["n"] == 0
+        assert d.telemetry_reports() == [{}, {}]
+        # armed, the same surfaces produce and store a report
+        with telemetry.armed():
+            snap = telemetry.node_snapshot()
+            assert snap is not None
+            d.heartbeat("n1", sid, metrics=snap)
+            reports = d.telemetry_reports()
+            assert sum(len(r) for r in reports) == 1
+        assert calls["n"] == 1
+    finally:
+        metrics.registry_snapshot = orig
+        d._hb_wheel.stop()
+
+
+def test_report_stored_in_owning_shard_and_bounded():
+    clock = FakeClock()
+    d = Dispatcher(MemoryStore(), heartbeat_period=5.0, clock=clock,
+                   shards=4)
+    try:
+        with telemetry.armed() as st:
+            sid = d.register("nodeA")
+            snap = registry_snapshot(families=[], histograms=[],
+                                     gauges={"x": 1})
+            d.heartbeat("nodeA", sid, metrics=snap)
+            reports = d.telemetry_reports()
+            owner = stable_shard("nodeA", 4)
+            assert set(reports[owner]) == {"nodeA"}
+            assert all(not r for i, r in enumerate(reports)
+                       if i != owner)
+            # hostile payload: structural bound, not a JSON encode
+            huge = {"v": 1, "histograms": {}, "gauges": {
+                f"g{i}": i for i in range(telemetry.MAX_REPORT_SERIES + 1)},
+                "counters": {}}
+            d.heartbeat("nodeA", sid, metrics=huge)
+            assert st.reports_rejected == 1
+            assert d.telemetry_reports()[owner]["nodeA"][0] is snap
+            # non-dict garbage is rejected, never raises
+            d.heartbeat("nodeA", sid, metrics=[1, 2, 3])
+            assert st.reports_rejected == 2
+            # ONE series with a huge counts vector must trip the cell
+            # budget (series count alone would pass)
+            fat = {"v": 1, "counters": {}, "gauges": {},
+                   "histograms": {"x": {"labels": [], "buckets": [1.0],
+                                        "series": [[[], [0] * 500_000,
+                                                    0.0, 0]]}}}
+            d.heartbeat("nodeA", sid, metrics=fat)
+            assert st.reports_rejected == 3
+            assert d.telemetry_reports()[owner]["nodeA"][0] is snap
+            # graceful leave retires the report
+            d.leave("nodeA", sid)
+            assert sum(len(r) for r in d.telemetry_reports()) == 0
+    finally:
+        d._hb_wheel.stop()
+
+
+def test_node_snapshot_gauges_and_truncation():
+    class FakeWorker:
+        _tasks = {"t1": 1, "t2": 2}
+
+    class FakeAgent:
+        _pending = {"t1": object()}
+        worker = FakeWorker()
+
+    from swarmkit_tpu.utils import lifecycle
+
+    with telemetry.armed():
+        with lifecycle.armed() as rec:
+            rec.record("t1", "NEW")
+            rec.record("t2", "NEW")
+            rec.record("t2", "RUNNING")
+            snap = telemetry.node_snapshot(agent=FakeAgent())
+        g = snap["gauges"]
+        assert g["agent_pending_statuses"] == 1
+        assert g["agent_tasks"] == 2
+        assert g["tasks_new"] == 1
+        assert g["tasks_running"] == 1
+    # oversize budget degrades to gauges-only, truncated flagged
+    with telemetry.armed(max_bytes=10) as st:
+        snap = telemetry.node_snapshot(agent=FakeAgent())
+        assert snap["truncated"] is True
+        assert snap["counters"] == {} and snap["histograms"] == {}
+        assert snap["gauges"]["agent_tasks"] == 2
+        assert st.reports_truncated == 1
+
+
+def test_stage_census_shape():
+    from swarmkit_tpu.utils.lifecycle import LifecycleRecorder
+
+    r = LifecycleRecorder()
+    r.record("a", "NEW")
+    r.record("b", "NEW")
+    r.record("b", "ASSIGNED")
+    assert r.stage_census() == {"NEW": 1, "ASSIGNED": 1}
+
+
+# ----------------------------------------------------------- acceptance
+def test_driven_rollup_parity_and_staleness():
+    """THE acceptance: leader dispatcher + 5 agent sessions across ≥2
+    shards under FakeClock — cluster families equal the sum of the
+    per-node registries (counters bit-exact, buckets exact), and a node
+    whose beats stop is STALE within 3× its heartbeat period, listed
+    and excluded (never folded into the aggregate silently)."""
+    clock = FakeClock()
+    store = MemoryStore()
+    period = 5.0
+    d = Dispatcher(store, heartbeat_period=period, clock=clock, shards=4)
+    node_ids = [f"node{i:02d}" for i in range(5)]
+    assert len({stable_shard(n, 4) for n in node_ids}) >= 2
+    try:
+        with telemetry.armed():
+            sids = {n: d.register(n) for n in node_ids}
+            snaps = {}
+            for i, n in enumerate(node_ids):
+                snaps[n] = _node_registry(i)
+                d.heartbeat(n, sids[n], metrics=snaps[n])
+            agg = TelemetryAggregator(store, d, clock=clock)
+            roll = agg.rollup(include_local=False)
+            assert roll["armed"] is True
+            assert roll["nodes"]["reported"] == 5
+            assert roll["nodes"]["fresh"] == 5
+            assert roll["nodes"]["stale"] == []
+            assert_cluster_equals_sum(roll["cluster"],
+                                      list(snaps.values()))
+            # the exposition renders the summed families
+            text = agg.prometheus_text()
+            total = sum(i + 1 for i in range(5))
+            assert (f'swarm_cluster_rpc_handled_total{{method="tick"}} '
+                    f'{total}') in text
+            assert "swarm_cluster_store_tx_seconds_bucket" in text
+            assert "swarm_cluster_nodes_fresh 5" in text
+
+            # node00 goes silent; everyone else keeps beating
+            clock.advance(2 * period)
+            for n in node_ids[1:]:
+                d.heartbeat(n, sids[n], metrics=snaps[n])
+            clock.advance(GRACE_MULTIPLIER * period - 2 * period + 0.5)
+            roll2 = agg.rollup(include_local=False)
+            assert roll2["nodes"]["stale"] == ["node00"]
+            assert roll2["nodes"]["fresh"] == 4
+            assert roll2["nodes"]["flaps"] == {"node00": 1}
+            # stale data EXCLUDED from the aggregate, not averaged in
+            assert_cluster_equals_sum(
+                roll2["cluster"],
+                [snaps[n] for n in node_ids[1:]])
+            text2 = agg.prometheus_text()
+            assert "swarm_cluster_nodes_stale 1" in text2
+            assert 'swarm_cluster_stale_node_info{node="node00"} 1' \
+                in text2
+            # every family in the cluster exposition owns a HELP line
+            # (the exposition-drift convention)
+            assert "# HELP swarm_cluster_stale_node_info" in text2
+    finally:
+        d._hb_wheel.stop()
+
+
+def test_rollup_include_local_merges_process_registry():
+    clock = FakeClock()
+    d = Dispatcher(MemoryStore(), heartbeat_period=5.0, clock=clock,
+                   shards=1)
+    try:
+        with telemetry.armed():
+            # a real registry family this process owns
+            fam = metrics.counter_family(
+                "swarm_telemetry_selftest_total", "selftest", ("k",))
+            fam.inc(("x",), 11)
+            agg = TelemetryAggregator(MemoryStore(), d, clock=clock)
+            roll = agg.rollup(include_local=True)
+            assert snapshot_counter_value(
+                roll["cluster"], "swarm_telemetry_selftest_total",
+                ("x",)) >= 11
+            without = agg.rollup(include_local=False)
+            assert "swarm_telemetry_selftest_total" \
+                not in without["cluster"]["counters"]
+    finally:
+        d._hb_wheel.stop()
+
+
+def test_local_registry_not_double_counted_with_colocated_agent():
+    """swarmd managers co-run an agent in the SAME process — its
+    piggybacked report IS this process's registry, so include_local
+    must not merge the registry a second time while that report is
+    fresh (and must fall back to the local merge once it goes away)."""
+    clock = FakeClock()
+    d = Dispatcher(MemoryStore(), heartbeat_period=5.0, clock=clock,
+                   shards=2)
+    try:
+        with telemetry.armed():
+            fam = metrics.counter_family(
+                "swarm_telemetry_dedupe_total", "dedupe", ("k",))
+            fam.inc(("x",), 5)
+            base = fam.value(("x",))
+            sid = d.register("leader-node")
+            # the co-located agent's report: the process registry
+            d.heartbeat("leader-node", sid,
+                        metrics=metrics.registry_snapshot())
+            agg = TelemetryAggregator(MemoryStore(), d, clock=clock,
+                                      local_node_id="leader-node")
+            roll = agg.rollup(include_local=True)
+            assert snapshot_counter_value(
+                roll["cluster"], "swarm_telemetry_dedupe_total",
+                ("x",)) == base   # once, not twice
+            # report gone (graceful leave) -> local registry merges
+            d.leave("leader-node", sid)
+            roll2 = agg.rollup(include_local=True)
+            assert snapshot_counter_value(
+                roll2["cluster"], "swarm_telemetry_dedupe_total",
+                ("x",)) == base
+    finally:
+        d._hb_wheel.stop()
+
+
+def test_control_api_surface_and_aggregator_registration():
+    from swarmkit_tpu.controlapi.control import ControlAPI
+
+    clock = FakeClock()
+    store = MemoryStore()
+    d = Dispatcher(store, heartbeat_period=5.0, clock=clock, shards=1)
+    ctl = ControlAPI(store)
+    try:
+        assert ctl.get_cluster_telemetry() == {"armed": False,
+                                               "aggregator": False}
+        agg = TelemetryAggregator(store, d, clock=clock)
+        agg.start()
+        try:
+            assert telemetry.aggregator() is agg
+            with telemetry.armed():
+                out = ctl.get_cluster_telemetry(window=30.0,
+                                                include_local=False)
+                assert out["armed"] is True
+                assert out["window_s"] == 30.0
+                assert "windows" in out
+        finally:
+            agg.stop()
+        assert telemetry.aggregator() is None
+        # a stale stop never clobbers a newer registration
+        agg2 = TelemetryAggregator(store, d, clock=clock)
+        agg2.start()
+        agg.stop()
+        assert telemetry.aggregator() is agg2
+        agg2.stop()
+    finally:
+        d._hb_wheel.stop()
+
+
+def test_time_series_ring_windows_and_quantiles():
+    clock = FakeClock()
+    ring = TimeSeriesRing(width_s=1.0, slots=10, clock=clock)
+    for i in range(5):
+        ring.observe("lat", float(i))
+        clock.advance(1.0)
+    qs = ring.quantiles("lat", (50, 100))
+    assert qs[100] == 4.0
+    # trailing-window restriction drops old windows
+    recent = ring.samples("lat", window_s=2.0)
+    assert set(recent) <= {3.0, 4.0} and recent
+    # ring wrap overwrites the oldest windows
+    for i in range(20):
+        ring.observe("lat", 100.0 + i)
+        clock.advance(1.0)
+    assert all(v >= 100.0 for v in ring.samples("lat"))
+
+
+# ------------------------------------------------------ satellite: hammer
+def test_counter_primitives_lose_zero_increments_across_threads():
+    c = Counter("hammer_total")
+    fam = CounterFamily("hammer_family_total", "", ("k",))
+    bag = CounterDict({"x": 0})
+    h = Histogram("hammer_seconds")
+    N, T = 2000, 8
+
+    def worker():
+        for _ in range(N):
+            c.inc()
+            fam.inc(("a",))
+            bag.inc("x")
+            h.observe(0.001)
+
+    threads = [threading.Thread(target=worker) for _ in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == N * T
+    assert fam.value(("a",)) == N * T
+    assert bag["x"] == N * T
+    assert h.snapshot()[2] == N * T
+
+
+def test_agent_piggyback_cadence_in_heartbeat_loop():
+    """Drive a real Agent session against an in-process dispatcher:
+    armed with report_every=2, beats alternate bare/piggybacked; the
+    dispatcher ends up with exactly the piggybacked reports."""
+    import time as _time
+
+    from swarmkit_tpu.agent.agent import Agent
+
+    class FakeExecutor:
+        def describe(self):
+            return None
+
+        def controller(self, task):
+            raise NotImplementedError
+
+    store = MemoryStore()
+    d = Dispatcher(store, heartbeat_period=0.05, shards=2)
+    with telemetry.armed(report_every=2) as st:
+        a = Agent("hb-node", d, FakeExecutor())
+        a.start()
+        try:
+            deadline = _time.monotonic() + 5.0
+            while _time.monotonic() < deadline \
+                    and st.reports_stored == 0:
+                _time.sleep(0.02)
+            assert st.reports_stored >= 1
+            assert st.reports_built == st.reports_stored
+            reports = d.telemetry_reports()
+            assert sum(len(r) for r in reports) == 1
+            (snap, _stamp), = [r["hb-node"] for r in reports
+                               if "hb-node" in r]
+            assert snap["v"] == 1
+        finally:
+            a.leave()
+            d.stop()
