@@ -1110,7 +1110,9 @@ def bench_dispatcher_fanout(np, n_nodes=10_000):
 def bench_dispatcher_fanout_storm(np, n_sessions=100_000,
                                   shard_counts=(1, 4, 8),
                                   beats_sample=20_000,
-                                  follower_reads=None):
+                                  follower_reads=None,
+                                  ceiling_sessions=1_000_000,
+                                  ceiling_shards=(1, 4)):
     """ISSUE 13: the SHARDED fan-out plane at a 100k-session storm.
 
     Driven (no dispatcher thread): sessions are injected directly (the
@@ -1125,6 +1127,15 @@ def bench_dispatcher_fanout_storm(np, n_sessions=100_000,
     slice serves `follower_reads` lease-gated read streams from the
     same store (stub lease: this is a one-process bench) and reports
     `follower_read_ratio` = follower-served / total read streams.
+
+    ISSUE 16 grows two legs: a `diff_plane` block — the columnar
+    zero-delta gate (P=4) against a single-plane dict oracle on the
+    same store, with sampled wire parity on a real storm — and a
+    `serve_ceiling` block: an honest `ceiling_sessions`-session serve
+    storm (capped per-session channel buffers — the 1M OOM was queued
+    wire copies) measuring where the GIL binds: the dict serve walk is
+    pure Python, so shard-pool speedup flattens near 1.0 while the
+    gate's numpy pass keeps scaling by SKIPPING.
 
     tests/test_bench_diag.py pins a reduced CPU-smoke shape of this
     row's op-count contracts."""
@@ -1249,6 +1260,248 @@ def bench_dispatcher_fanout_storm(np, n_sessions=100_000,
         plane.assignments(nid)
     follower_s = time.perf_counter() - t0
     total_reads = follower_reads + n_sessions * len(shard_counts)
+
+    # ---- ISSUE 16 leg 1: columnar diff gate vs the dict oracle ------
+    # Two driven planes on the SAME store: gated P=4 vs a single-plane
+    # dict oracle (pre-16 shape: _diffcols=None). A zero-delta soft
+    # storm times the gate's vectorized skip against the oracle's full
+    # dict walk; a REAL soft storm (service-wide touch) checks sampled
+    # wire parity and that the gate dict-diffs exactly the sessions
+    # with deltas. Both planes get the reverse-index prime — the gate
+    # requires _vol_index_primed (a driven dispatcher never ran _run).
+    def _norm(msg, ver=True):
+        out = []
+        for a in msg.changes:
+            ident = a.item if isinstance(a.item, str) else a.item.id
+            v = (a.item.meta.version.index
+                 if ver and a.action == "update"
+                 and not isinstance(a.item, str)
+                 and hasattr(a.item, "meta") else None)
+            out.append((a.action, a.kind, ident, v))
+        return (msg.type, tuple(sorted(out, key=repr)))
+
+    def _inject(d, ids, limit=None):
+        grace = d.heartbeat_period * 3
+        for nid in ids:
+            s = Session(node_id=nid, session_id=f"b.{nid}",
+                        channel=Channel(matcher=None, limit=limit))
+            d._sessions[nid] = s
+            d._hb_wheel.add(nid, grace, lambda: None)
+
+    def _drain(d, ids, sample=None, ver=True):
+        delivered = 0
+        msgs = {}
+        for nid in ids:
+            ch = d._sessions[nid].channel
+            got = []
+            msg = ch.try_get()
+            while msg is not None:
+                if msg.type == "incremental" and msg.changes:
+                    got.append(_norm(msg, ver=ver)
+                               if sample is not None and nid in sample
+                               else None)
+                msg = ch.try_get()
+            if got:
+                delivered += 1
+            if sample is not None and nid in sample:
+                msgs[nid] = tuple(got)
+        return delivered, msgs
+
+    d_g = Dispatcher(store, heartbeat_period=120.0,
+                     rate_limit_period=-1.0, shards=4, jitter_seed=16)
+    d_o = Dispatcher(store, heartbeat_period=120.0,
+                     rate_limit_period=-1.0, shards=1)
+    d_o._diffcols = None               # single-plane dict oracle
+    try:
+        gate_on = d_g._diffcols is not None
+        for d in (d_g, d_o):
+            store.view(d._prime_reverse_indexes)
+            _inject(d, node_ids)
+            d._mark_dirty_many(node_ids)
+            d._send_incrementals()
+            _drain(d, node_ids)
+
+        # zero-delta soft storm: nothing changed since the prime, every
+        # session soft-marked — the gate must prove + skip them ALL
+        g0, o0 = dict(d_g.metrics), dict(d_o.metrics)
+        for nid in node_ids:
+            d_g._mark_dirty(nid, hard=False)
+            d_o._mark_dirty(nid, hard=False)
+        t0 = time.perf_counter()
+        d_g._send_incrementals()
+        gate_zero_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        d_o._send_incrementals()
+        dict_zero_s = time.perf_counter() - t0
+        gz = {k: d_g.metrics[k] - g0[k]
+              for k in ("dict_diffs", "zero_delta_skips",
+                        "diff_rows_scanned", "ships")}
+        zero_ships = gz["ships"] + (d_o.metrics["ships"] - o0["ships"])
+
+        # the REAL soft storm: every task changes, then soft marks —
+        # every session has a delta, so the gate must dict-diff and
+        # ship the world; sampled wire parity vs the oracle
+        rev += 1
+
+        def touch16(tx, rev=rev):
+            for i in range(n_sessions):
+                cur = tx.get_task(f"st{i:06d}").copy()
+                cur.annotations.labels = {"rev": str(rev)}
+                tx.update(cur)
+        store.update(touch16)
+        sample = set(node_ids[::max(1, n_sessions // 1000)])
+        g1 = dict(d_g.metrics)
+        for nid in node_ids:
+            d_g._mark_dirty(nid, hard=False)
+            d_o._mark_dirty(nid, hard=False)
+        t0 = time.perf_counter()
+        d_g._send_incrementals()
+        gate_real_s = time.perf_counter() - t0
+        d_o._send_incrementals()
+        g_del, g_msgs = _drain(d_g, node_ids, sample=sample)
+        o_del, o_msgs = _drain(d_o, node_ids, sample=sample)
+        gr = {k: d_g.metrics[k] - g1[k] for k in ("dict_diffs", "ships")}
+        diff_plane = {
+            "gate_enabled": gate_on,
+            "zero_delta_flush_s": round(gate_zero_s, 3),
+            "dict_oracle_zero_flush_s": round(dict_zero_s, 3),
+            "zero_delta_speedup": round(dict_zero_s / gate_zero_s, 2)
+            if gate_zero_s else None,
+            "zero_delta_skips": gz["zero_delta_skips"],
+            "diff_rows_scanned": gz["diff_rows_scanned"],
+            "zero_storm_dict_diffs": gz["dict_diffs"],
+            "zero_storm_ships": zero_ships,
+            "real_storm_flush_s": round(gate_real_s, 3),
+            "real_storm_dict_diffs": gr["dict_diffs"],
+            "real_storm_ships": gr["ships"],
+            "parity_sample": len(sample),
+            "wire_parity": (g_msgs == o_msgs and g_del == o_del
+                            and g_del == n_sessions
+                            and zero_ships == 0),
+        }
+    finally:
+        d_g.stop()
+        d_o.stop()
+
+    # ---- ISSUE 16 leg 2: the honest serve-ceiling storm -------------
+    # A fresh store at `ceiling_sessions` (seeded + touched in 100k
+    # chunks; per-session channels CAPPED at 8 — the 1M OOM was queued
+    # wire copies, satellite 2's fix). Planes run SEQUENTIALLY (two
+    # resident 1M-session planes would double peak memory), first shard
+    # count as the dict oracle, so wire parity across planes is
+    # compared with version indexes STRIPPED (each plane serves its own
+    # touch rev). Columns record where the GIL binds: the hard serve is
+    # the pure-Python dict walk, the gate flush is the numpy skip pass.
+    serve_ceiling = {"sessions": ceiling_sessions, "per_shard": {}}
+    cstore = MemoryStore()
+    CHUNK = 100_000
+
+    def _cseed(lo, hi):
+        def seed_chunk(tx):
+            for i in range(lo, hi):
+                n = Node(id=f"cl{i:07d}")
+                n.status.state = NodeStatusState.READY
+                tx.create(n)
+                t = Task(id=f"clt{i:07d}", service_id="ceilsvc",
+                         node_id=n.id, slot=i + 1)
+                t.status.state = TaskState.RUNNING
+                t.desired_state = TaskState.RUNNING
+                tx.create(t)
+        return seed_chunk
+    for lo in range(0, ceiling_sessions, CHUNK):
+        cstore.update(_cseed(lo, min(lo + CHUNK, ceiling_sessions)))
+    cids = [f"cl{i:07d}" for i in range(ceiling_sessions)]
+    csample = set(cids[::max(1, ceiling_sessions // 1000)])
+    cwire = {}
+    crev = 0
+    oracle_P = ceiling_shards[0]
+    for P in ceiling_shards:
+        d = Dispatcher(cstore, heartbeat_period=120.0,
+                       rate_limit_period=-1.0, shards=P, jitter_seed=16)
+        if P == oracle_P:
+            d._diffcols = None         # single-plane dict oracle
+        try:
+            cstore.view(d._prime_reverse_indexes)
+            _inject(d, cids, limit=8)  # capped per-session buffers
+            d._mark_dirty_many(cids)
+            t0 = time.perf_counter()
+            d._send_incrementals()
+            prime_s = time.perf_counter() - t0
+            _drain(d, cids)
+
+            # zero-delta gate flush: all-soft, nothing changed — the
+            # gated plane skips the world, the oracle dict-walks it
+            z0 = dict(d.metrics)
+            for nid in cids:
+                d._mark_dirty(nid, hard=False)
+            t0 = time.perf_counter()
+            d._send_incrementals()
+            gate_flush_s = time.perf_counter() - t0
+            zd = {k: d.metrics[k] - z0[k]
+                  for k in ("dict_diffs", "zero_delta_skips")}
+
+            # the real storm: touch every task (chunked), hard-mark the
+            # world, ONE flush serves it — the pure-Python dict walk
+            crev += 1
+            for lo in range(0, ceiling_sessions, CHUNK):
+                hi = min(lo + CHUNK, ceiling_sessions)
+
+                def ctouch(tx, lo=lo, hi=hi, rev=crev):
+                    for i in range(lo, hi):
+                        cur = tx.get_task(f"clt{i:07d}").copy()
+                        cur.annotations.labels = {"rev": str(rev)}
+                        tx.update(cur)
+                cstore.update(ctouch)
+            s0 = dict(d.metrics)
+            d._mark_dirty_many(cids)
+            t0 = time.perf_counter()
+            d._send_incrementals()
+            serve_flush_s = time.perf_counter() - t0
+            sd = {k: d.metrics[k] - s0[k]
+                  for k in ("flushes", "flush_tx", "dirty_walks")}
+            delivered, msgs = _drain(d, cids, sample=csample, ver=False)
+            cwire[P] = msgs
+            serve_ceiling["per_shard"][str(P)] = {
+                "dict_oracle": P == oracle_P,
+                "prime_s": round(prime_s, 3),
+                "gate_flush_s": round(gate_flush_s, 3),
+                "zero_delta_skips": zd["zero_delta_skips"],
+                "gate_dict_diffs": zd["dict_diffs"],
+                "serve_flush_s": round(serve_flush_s, 3),
+                "sessions_per_s": round(ceiling_sessions / serve_flush_s)
+                if serve_flush_s else None,
+                "store_tx_per_flush": round(
+                    sd["flush_tx"] / sd["flushes"], 3)
+                if sd["flushes"] else None,
+                "dirty_walks_per_shard": round(
+                    sd["dirty_walks"] / (sd["flushes"] * P), 3)
+                if sd["flushes"] else None,
+                "delivered": delivered,
+            }
+        finally:
+            d.stop()
+    del cstore, cids
+    sc0 = serve_ceiling["per_shard"][str(ceiling_shards[0])]
+    scN = serve_ceiling["per_shard"][str(ceiling_shards[-1])]
+    serve_ceiling["serve_speedup_p1_to_pN"] = round(
+        sc0["serve_flush_s"] / scN["serve_flush_s"], 2) \
+        if scN["serve_flush_s"] else None
+    serve_ceiling["gate_speedup_vs_dict_zero"] = round(
+        sc0["gate_flush_s"] / scN["gate_flush_s"], 2) \
+        if scN["gate_flush_s"] else None
+    serve_ceiling["op_counts_ok"] = all(
+        v["store_tx_per_flush"] == 1.0
+        and (v["dirty_walks_per_shard"] or 0) <= 1.0
+        and v["delivered"] == ceiling_sessions
+        for v in serve_ceiling["per_shard"].values())
+    serve_ceiling["wire_parity"] = all(
+        cwire[P] == cwire[oracle_P] for P in ceiling_shards)
+    serve_ceiling["gil_note"] = (
+        "the hard-serve dict walk is pure Python (one GIL for the shard"
+        " pool), so serve speedup flattens near 1.0 as P grows; the"
+        " columnar gate wins by SKIPPING zero-delta sessions in a numpy"
+        " pass, not by parallelizing the walk")
+
     ok = all(v["delivered"] == n_sessions
              and v["store_tx_per_flush"] == 1.0
              and (v["dirty_walks_per_shard"] or 0) <= 1.0
@@ -1265,7 +1518,12 @@ def bench_dispatcher_fanout_storm(np, n_sessions=100_000,
         "follower_read_ratio": round(
             plane.metrics["reads_served"] / total_reads, 4)
         if total_reads else None,
-        "parity": ok and plane.metrics["reads_served"] == follower_reads,
+        "diff_plane": diff_plane,
+        "serve_ceiling": serve_ceiling,
+        "parity": (ok and plane.metrics["reads_served"] == follower_reads
+                   and diff_plane["wire_parity"]
+                   and serve_ceiling["wire_parity"]
+                   and serve_ceiling["op_counts_ok"]),
     }
 
 

@@ -271,6 +271,7 @@ class SpanInLoopRule(Rule):
         "swarmkit_tpu/dispatcher/dispatcher.py",
         "swarmkit_tpu/dispatcher/heartbeat.py",
         "swarmkit_tpu/dispatcher/follower.py",
+        "swarmkit_tpu/dispatcher/columnar_diff.py",
         "swarmkit_tpu/rpc/wire.py",
         "swarmkit_tpu/rpc/server.py",
         "swarmkit_tpu/rpc/client.py",
@@ -495,6 +496,9 @@ class ColumnarMutateRule(Rule):
         "swarmkit_tpu/store/memory.py",
         "swarmkit_tpu/allocator/batched.py",
         "swarmkit_tpu/ops/alloc.py",
+        # ISSUE 16: the diff gate owns per-shard plan columns (reads of
+        # store.columnar plus its own arrays; never writes the mirror)
+        "swarmkit_tpu/dispatcher/columnar_diff.py",
     )
 
     def applies(self, path: str) -> bool:

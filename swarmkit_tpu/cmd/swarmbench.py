@@ -227,6 +227,17 @@ class SessionStorm:
     session (`_require_node` admits the MANAGER role), so no per-node
     certs are needed."""
 
+    # registration batch size (ISSUE 16): one dispatcher.register_many
+    # call per chunk — small enough that a raft-backed store commits it
+    # in a handful of pipelined sub-transactions, large enough that 1M
+    # simulacra register in ~1k RPCs instead of 1M
+    REGISTER_CHUNK = 1024
+    # per-session assignments-channel cap for simulacra whose streams
+    # are never drained: shed at 64 queued messages instead of the
+    # default 4096 (the OOM at 1M sessions was queued wire copies, not
+    # the sessions themselves)
+    CHANNEL_LIMIT = 64
+
     def __init__(self, client, ctl, n: int, prefix: str | None = None,
                  streams: int = 32, beat_interval: float = 1.0):
         self.client = client
@@ -238,7 +249,8 @@ class SessionStorm:
         self.metrics = {"registered": 0, "register_errors": 0,
                         "streams": 0, "stream_msgs": 0,
                         "beats": 0, "beat_errors": 0,
-                        "drain_failures": 0, "register_s": 0.0}
+                        "drain_failures": 0, "register_s": 0.0,
+                        "register_rpcs": 0}
         self._sessions: list[tuple[str, str]] = []
         self._chans: list = []
         self._thread: threading.Thread | None = None
@@ -265,26 +277,54 @@ class SessionStorm:
     def start(self, stop: threading.Event):
         self._stop = stop
         t0 = time.monotonic()
-        for i in range(self.n):
-            nid = f"{self.prefix}-{i:05d}"
-            try:
-                sid = self.client.call("dispatcher.register", nid)
-            except Exception:
-                self.metrics["register_errors"] += 1
-                continue
-            if self._drain(nid):
-                self._sessions.append((nid, sid))
-                self.metrics["registered"] += 1
-            else:
-                # a simulacrum that could NOT be drained must not stay
-                # a READY+ACTIVE phantom the scheduler places real
-                # tasks on (that would wedge the very startups the
-                # --slo gate measures): leave it so it goes DOWN
-                self.metrics["drain_failures"] += 1
+        batched = True
+        for off in range(0, self.n, self.REGISTER_CHUNK):
+            if stop.is_set():
+                break
+            ids = [f"{self.prefix}-{i:07d}"
+                   for i in range(off, min(off + self.REGISTER_CHUNK,
+                                           self.n))]
+            if batched:
                 try:
-                    self.client.call("dispatcher.leave", nid, sid)
+                    # ISSUE 16 batched join: nodes are created
+                    # pre-DRAINed (the scheduler never sees a
+                    # schedulable phantom — no per-node control-API
+                    # round trip) with capped assignment channels
+                    granted = self.client.call(
+                        "dispatcher.register_many", ids,
+                        availability="drain",
+                        channel_limit=self.CHANNEL_LIMIT)
+                    self.metrics["register_rpcs"] += 1
+                    self._sessions.extend(sorted(granted.items()))
+                    self.metrics["registered"] += len(granted)
+                    self.metrics["register_errors"] += \
+                        len(ids) - len(granted)
+                    continue
                 except Exception:
-                    pass
+                    # pre-16 manager (or a forwarding hiccup): fall
+                    # back to the scalar register+drain path for this
+                    # and all remaining chunks
+                    batched = False
+            for nid in ids:
+                try:
+                    sid = self.client.call("dispatcher.register", nid)
+                    self.metrics["register_rpcs"] += 1
+                except Exception:
+                    self.metrics["register_errors"] += 1
+                    continue
+                if self._drain(nid):
+                    self._sessions.append((nid, sid))
+                    self.metrics["registered"] += 1
+                else:
+                    # a simulacrum that could NOT be drained must not
+                    # stay a READY+ACTIVE phantom the scheduler places
+                    # real tasks on (that would wedge the very startups
+                    # the --slo gate measures): leave it so it goes DOWN
+                    self.metrics["drain_failures"] += 1
+                    try:
+                        self.client.call("dispatcher.leave", nid, sid)
+                    except Exception:
+                        pass
         self.metrics["register_s"] = round(time.monotonic() - t0, 3)
         for nid, sid in self._sessions[:self.streams]:
             try:
@@ -688,6 +728,32 @@ def main(argv=None) -> int:
             report["session_storm"]["sessions"] = args.sessions
             if args.shards is not None:
                 report["session_storm"]["shards"] = args.shards
+            # columnar diff-gate effectiveness (ISSUE 16): sessions/s
+            # from the storm's own registration clock, skip ratio and
+            # deltas/flush from the manager's dispatcher metrics (the
+            # telemetry manager block carries them even disarmed)
+            try:
+                disp = ctl.get_cluster_telemetry().get(
+                    "manager", {}).get("dispatcher", {})
+                reg_s = storm.metrics.get("register_s") or 0
+                skips = disp.get("zero_delta_skips", 0)
+                dict_diffs = disp.get("dict_diffs", 0)
+                flushes = disp.get("flushes", 0)
+                report["diff_plane"] = {
+                    "sessions_per_s": round(
+                        storm.metrics["registered"] / reg_s, 1)
+                    if reg_s else None,
+                    "diff_rows_scanned": disp.get("diff_rows_scanned", 0),
+                    "zero_delta_skips": skips,
+                    "dict_diffs": dict_diffs,
+                    "zero_delta_skip_ratio": round(
+                        skips / (skips + dict_diffs), 4)
+                    if (skips + dict_diffs) else None,
+                    "deltas_per_flush": round(dict_diffs / flushes, 2)
+                    if flushes else None,
+                }
+            except Exception as exc:     # pre-16 manager / no telemetry
+                report["diff_plane"] = {"error": repr(exc)}
         if args.telemetry:
             # embed the cluster rollup so the SLO gate and the
             # telemetry artifact come from ONE report (ISSUE 15);

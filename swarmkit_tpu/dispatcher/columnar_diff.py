@@ -1,0 +1,271 @@
+"""Columnar assignment-diff gate (ISSUE 16 tentpole).
+
+The per-session assignment diff (`Dispatcher._diff`) walks Python
+`known_*` dicts — O(sessions x known-entries) dict gets per flush. This
+module makes the steady case array-native without forking the wire
+format: per shard, each session's delivery-committed known state is
+kept as dense numpy columns (store row indices + the versions actually
+shipped, captured INSIDE the flush's store view so they are mutually
+consistent), and every flush runs ONE vectorized pass per shard that
+proves which dirty sessions have a ZERO delta against the live columnar
+task/secret/config tables. Proven-zero sessions skip the node view, the
+dict diff, and the serve entirely; everything else falls through to the
+existing dict `_diff`, which stays the SOLE shipping path — so wire
+parity with the dict oracle holds by construction, and a false
+POSITIVE (gate says maybe-changed, diff finds nothing) costs one dict
+walk while a false NEGATIVE would be a correctness bug (the parity fuzz
+in tests/test_dispatcher_fanout.py hunts those).
+
+Soundness sketch (the exactness argument for the skip verdict):
+
+* task leg — a known entry (row r, version v) is OK iff the row is
+  still a live relevant task (`valid & state>=ASSIGNED &
+  desired<=REMOVE`), its version still equals v, and it still sits on
+  the session's node. Known ids are distinct, so OK entries are
+  DISTINCT current-relevant rows of that node; if additionally the OK
+  count equals the node's current relevant-task count, the known set
+  EQUALS the current set with identical versions — no updates, no
+  additions, no removals. Row recycling is safe because object versions
+  are a store-global monotone counter: a recycled row carries a version
+  strictly newer than any version captured before the delete, so it can
+  only mismatch (dirty), never falsely match.
+* dep leg — task specs are immutable per task, so an unchanged known
+  task set implies unchanged referenced dep IDS; only dep version bumps
+  and deletions matter, and both flip the (never-recycled) dep row's
+  version/valid columns. Referenced-but-ABSENT deps are silently
+  dropped by the build, so each plan records those ids and the gate
+  re-checks `row_of(id)` — a dep created later produces no event for
+  this session, and skipping would hide the resolved reference from the
+  next soft-dirty serve.
+* everything the columns cannot see arrives via the HARD dirty channel
+  (volume events, external test/operator marks, crash re-dirty) or is
+  excluded by the eligibility checks in `Dispatcher._gate_shard`
+  (driver-secret clones, pending node-unpublish re-sends, an open
+  legacy tasks stream, a session whose plan token is stale).
+
+Lockstep rule (the dict contract, columnar): a plan is installed ONLY
+by the delivery-gated `_commit_known` — columns advance exactly when
+the known dicts do, never past what the agent saw. The per-shard plan
+store takes a LEAF lock named `dispatcher.diffcol<i>.lock`:
+deliberately OUTSIDE the lockgraph hazard key set (`dispatcher.lock`,
+`dispatcher.follower.lock`, the `dispatcher.shard` prefix) because the
+gate reads plans INSIDE store-view callbacks where taking any of those
+would recreate the PR 4 inversion. Edges: dispatcher.lock -> diffcol
+(commit installs), store.lock -> diffcol (gate reads in-view); the
+diffcol lock never acquires anything, so no cycle is possible.
+
+SWARMKIT_TPU_NO_COLUMNAR_DIFF=1 disables the plane (the dispatcher
+serves every dirty session through the dict path, exactly as before);
+a store without a columnar mirror (SWARMKIT_TPU_NO_COLUMNAR=1) disables
+it implicitly.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..analysis.lockgraph import make_lock
+from ..api.types import TaskState
+from ..store.columnar import IdVocab
+
+_ASSIGNED = int(TaskState.ASSIGNED)
+_REMOVE = int(TaskState.REMOVE)
+
+
+def plane_enabled() -> bool:
+    return os.environ.get("SWARMKIT_TPU_NO_COLUMNAR_DIFF", "") != "1"
+
+
+class ColumnPlan:
+    """One session's known-state image as store-row columns: what the
+    delivered assignment message implies the agent now knows, expressed
+    as (row, version) pairs against the store's columnar mirrors plus
+    the referenced-but-absent dep ids. Captured inside a store view
+    (`Dispatcher._node_view`), installed only by the delivery-gated
+    commit, and immutable afterwards — the gate may read it without the
+    plan store's lock held."""
+
+    __slots__ = ("col", "token", "node_srow", "task_rows", "task_vers",
+                 "secret_rows", "secret_vers", "config_rows",
+                 "config_vers", "missing_secrets", "missing_configs",
+                 "eligible")
+
+    @classmethod
+    def capture(cls, col, token: str, node_id: str, tasks, secrets,
+                configs, missing, had_driver_refs: bool) -> "ColumnPlan":
+        """Build the plan from one node view's results. `col` is the
+        live ColumnarTasks the view read under the store lock; a plan
+        is only ever compared against the SAME object (identity-gated),
+        so a store restore() that swaps the mirror orphans every
+        outstanding plan instead of comparing against re-assigned
+        rows."""
+        p = cls()
+        p.col = col
+        p.token = token
+        p.eligible = not had_driver_refs
+        p.node_srow = col.nodes.lookup(node_id)
+        if p.node_srow <= 0:
+            p.eligible = False
+        p.task_rows, p.task_vers = _task_entries(col, tasks, p)
+        p.secret_rows, p.secret_vers = _dep_entries(
+            col.secret_cols, secrets, p)
+        p.config_rows, p.config_vers = _dep_entries(
+            col.config_cols, configs, p)
+        p.missing_secrets = tuple(
+            i for kind, i in missing if kind == "secret")
+        p.missing_configs = tuple(
+            i for kind, i in missing if kind == "config")
+        return p
+
+
+def _task_entries(col, tasks, plan: ColumnPlan):
+    n = len(tasks)
+    rows = np.empty(n, np.int64)
+    vers = np.empty(n, np.int64)
+    for j, t in enumerate(tasks):
+        r = col.task_row(t.id)
+        if r < 0:
+            # task not mirrored (shouldn't happen in lockstep, but a
+            # mid-lazy-wave read could race the heal): untrackable
+            plan.eligible = False
+            r = 0
+        rows[j] = r
+        vers[j] = t.meta.version.index
+    return rows, vers
+
+
+def _dep_entries(dep, objs: dict, plan: ColumnPlan):
+    n = len(objs)
+    rows = np.empty(n, np.int64)
+    vers = np.empty(n, np.int64)
+    for j, (oid, o) in enumerate(objs.items()):
+        r = dep.row_of(oid)
+        if r < 0:
+            # a store object the mirror doesn't carry (e.g. a rebuild
+            # that predates the dep mirrors): untrackable, serve dict
+            plan.eligible = False
+            r = 0
+        rows[j] = r
+        vers[j] = o.meta.version.index
+    return rows, vers
+
+
+class GateContext:
+    """Per-flush shared gate state, computed ONCE under the flush's
+    store view: the relevance mask (exactly `_relevant_tasks`'
+    predicate, vectorized) and the per-node relevant-task counts every
+    shard's pass compares against."""
+
+    __slots__ = ("col", "rel", "node_counts")
+
+    def __init__(self, col):
+        self.col = col
+        self.rel = (col.valid
+                    & (col.state >= _ASSIGNED)
+                    & (col.desired <= _REMOVE))
+        self.node_counts = np.bincount(
+            col.node_idx[self.rel], minlength=len(col.nodes))
+
+
+def gate_shard(ctx: GateContext, plans: list) -> tuple[np.ndarray, int]:
+    """THE vectorized pass: one shard's eligible plans against the live
+    columns. Returns (clean, rows_scanned) where clean[j] is True iff
+    session j provably has a zero delta. Every plan must be eligible
+    and identity-bound to ctx.col (the caller's `plan_for` enforces
+    both) — row indices are then in-bounds by construction (vocabs only
+    grow, task rows < len(ids), dep rows never recycle)."""
+    n = len(plans)
+    clean = np.ones(n, bool)
+    col = ctx.col
+    node_srow = np.fromiter((p.node_srow for p in plans), np.int64, n)
+    scanned = 0
+
+    # --- task leg: every known entry must be an unchanged relevant
+    # task still on the session's node, and the per-node relevant count
+    # must match (count equality over distinct rows == set equality)
+    lengths = np.fromiter((p.task_rows.size for p in plans), np.int64, n)
+    total = int(lengths.sum())
+    scanned += total
+    c_ok = np.zeros(n, np.int64)
+    if total:
+        srow = np.concatenate([p.task_rows for p in plans])
+        kver = np.concatenate([p.task_vers for p in plans])
+        esess = np.repeat(np.arange(n), lengths)
+        ok = (ctx.rel[srow]
+              & (col.version[srow] == kver)
+              & (col.node_idx[srow] == np.repeat(node_srow, lengths)))
+        clean &= np.bincount(esess[~ok], minlength=n) == 0
+        c_ok = np.bincount(esess[ok], minlength=n)
+    clean &= c_ok == ctx.node_counts[node_srow]
+
+    # --- dep legs: unchanged task set => unchanged referenced ids
+    # (specs are immutable per task), so only version/liveness of the
+    # captured rows can differ
+    for rows_attr, vers_attr, dep in (
+            ("secret_rows", "secret_vers", col.secret_cols),
+            ("config_rows", "config_vers", col.config_cols)):
+        lengths = np.fromiter(
+            (getattr(p, rows_attr).size for p in plans), np.int64, n)
+        total = int(lengths.sum())
+        scanned += total
+        if not total:
+            continue
+        srow = np.concatenate([getattr(p, rows_attr) for p in plans])
+        kver = np.concatenate([getattr(p, vers_attr) for p in plans])
+        esess = np.repeat(np.arange(n), lengths)
+        ok = dep.valid[srow] & (dep.version[srow] == kver)
+        clean &= np.bincount(esess[~ok], minlength=n) == 0
+
+    # --- missing refs: a dep created AFTER it was referenced produces
+    # no event for this session; re-check resolution per flush.
+    # O(missing) scalar — the set is almost always empty.
+    for j, p in enumerate(plans):
+        if not clean[j]:
+            continue
+        if any(col.secret_cols.row_of(i) >= 0 for i in p.missing_secrets) \
+                or any(col.config_cols.row_of(i) >= 0
+                       for i in p.missing_configs):
+            clean[j] = False
+    return clean, scanned
+
+
+class ShardDiffColumns:
+    """One shard's plan store: session node ids intern into a vocab and
+    map to their delivery-committed ColumnPlan. The lock is a strict
+    LEAF (see the module docstring's lock-order argument); plans are
+    immutable, so `plan_for` hands the object out and drops the lock."""
+
+    def __init__(self, index: int):
+        self.lock = make_lock(f"dispatcher.diffcol{index}.lock")
+        self.vocab = IdVocab()
+        self._plans: dict[str, ColumnPlan] = {}
+
+    def install(self, node_id: str, plan: ColumnPlan) -> None:
+        with self.lock:
+            self.vocab.intern(node_id)
+            self._plans[node_id] = plan
+
+    def invalidate(self, node_id: str) -> None:
+        with self.lock:
+            self._plans.pop(node_id, None)
+
+    def clear(self) -> None:
+        with self.lock:
+            self._plans.clear()
+
+    def plan_for(self, node_id: str, token: str, col) -> ColumnPlan | None:
+        """The session's live plan, or None when untracked: no plan,
+        marked ineligible at capture, a stale session token (the plan
+        belongs to a superseded session), or captured against a
+        columnar mirror that has since been swapped (store restore)."""
+        with self.lock:
+            p = self._plans.get(node_id)
+        if p is None or not p.eligible or p.token != token \
+                or p.col is not col:
+            return None
+        return p
+
+    def __len__(self) -> int:
+        with self.lock:
+            return len(self._plans)

@@ -18,6 +18,7 @@ import os
 import random
 import threading
 import time
+from collections import deque
 from collections.abc import Set as _AbstractSet
 from dataclasses import dataclass, field
 
@@ -44,6 +45,7 @@ from ..utils.metrics import (
     snapshot_series_count,
     snapshot_within_budget,
 )
+from . import columnar_diff
 from .heartbeat import Heartbeat, ShardedHeartbeatWheel, stable_shard
 
 log = logging.getLogger("swarmkit_tpu.dispatcher")
@@ -98,6 +100,19 @@ class _Shard:
     index: int
     lock: object
     dirty: set = field(default_factory=set)
+    # ISSUE 16: the HARD subset of `dirty` — nodes whose dirt came from
+    # a cause the columnar diff gate cannot see (volume events, external
+    # test/operator marks, crash re-dirty). A hard-dirty session always
+    # takes the dict-diff path; soft dirt (task/secret/config events) is
+    # gate-eligible. Owned like `dirty`, under the same leaf lock.
+    hard: set = field(default_factory=set)
+    # ISSUE 16 per-shard event pump: dirty marks append here LOCK-FREE
+    # (deque appends are atomic under the GIL) and apply to dirty/hard
+    # in FIFO order at drain time — ONE shard-lock hold per drain
+    # instead of one per event. Every reader of dirty/hard drains
+    # first (`Dispatcher._drain_pumps`), so the observable sets are
+    # identical to immediate marking (event-order parity).
+    pending: deque = field(default_factory=deque)
     rng: random.Random = field(default_factory=random.Random)
     # ISSUE 15: latest telemetry report per node —
     # node id -> (snapshot dict, monotonic clock stamp). Owned by the
@@ -123,6 +138,7 @@ class _DirtyView(_AbstractSet):
         return set(it)
 
     def _snapshot(self) -> set:
+        self._disp._drain_pumps()
         out: set = set()
         for sh in self._disp._shards:
             with sh.lock:
@@ -130,6 +146,7 @@ class _DirtyView(_AbstractSet):
         return out
 
     def __contains__(self, key) -> bool:
+        self._disp._drain_pumps()
         sh = self._disp._shard_for(key)
         with sh.lock:
             return key in sh.dirty
@@ -138,6 +155,7 @@ class _DirtyView(_AbstractSet):
         return iter(self._snapshot())
 
     def __len__(self) -> int:
+        self._disp._drain_pumps()
         return sum(len(self._snapshot_shard(sh))
                    for sh in self._disp._shards)
 
@@ -156,14 +174,20 @@ class _DirtyView(_AbstractSet):
         self._disp._mark_dirty_many(keys)
 
     def discard(self, key) -> None:
+        # drain first: a pending pump op for `key` applied later would
+        # resurrect what this discard removed (single-pump parity)
+        self._disp._drain_pumps()
         sh = self._disp._shard_for(key)
         with sh.lock:
             sh.dirty.discard(key)
+            sh.hard.discard(key)
 
     def clear(self) -> None:
+        self._disp._drain_pumps()
         for sh in self._disp._shards:
             with sh.lock:
                 sh.dirty.clear()
+                sh.hard.clear()
 
 
 class DispatcherError(Exception):
@@ -239,6 +263,11 @@ class Dispatcher:
     # borrows _diff) overrides this to False so a follower-served diff
     # never double-stamps the SLO leg (docs/dispatcher.md)
     _record_shipped = True
+    # columnar diff gate (ISSUE 16): per-shard plan stores, or None
+    # when the plane is off. Class default None so the borrowed helpers
+    # (_commit_known/_drop_session_refs) no-op on the follower plane,
+    # which never defines it.
+    _diffcols = None
 
     def __init__(self, store: MemoryStore,
                  heartbeat_period: float = DEFAULT_HEARTBEAT_PERIOD,
@@ -320,7 +349,27 @@ class Dispatcher:
         # across threads)
         self.metrics = CounterDict(
             {"flushes": 0, "flush_tx": 0, "wire_copies": 0,
-             "ships": 0, "dirty_walks": 0, "last_flush_s": 0.0})
+             "ships": 0, "dirty_walks": 0, "last_flush_s": 0.0,
+             # ISSUE 16 columnar diff gate: known-state entries the
+             # vectorized pass compared, sessions it proved zero-delta
+             # (skipped before any dict walk), and sessions that DID
+             # take the dict `_diff` (the zero-dict-walk guard's key)
+             "diff_rows_scanned": 0, "zero_delta_skips": 0,
+             "dict_diffs": 0,
+             # ISSUE 16 per-shard event pumps: total ops drained, plus
+             # one backlog-at-drain gauge per shard (set below)
+             "pump_events": 0})
+        for i in range(self.shards):
+            self.metrics[f"pump_depth_shard{i}"] = 0
+        # --- columnar diff gate (ISSUE 16): per-shard plan stores in
+        # delivery-commit lockstep with the known_* dicts. None when
+        # the store carries no columnar mirror or the operator reverted
+        # with SWARMKIT_TPU_NO_COLUMNAR_DIFF=1 — every session then
+        # takes the dict path, exactly the pre-16 plane.
+        if columnar_diff.plane_enabled() \
+                and getattr(store, "columnar", None) is not None:
+            self._diffcols = [columnar_diff.ShardDiffColumns(i)
+                              for i in range(self.shards)]
 
     # ------------------------------------------------------------- lifecycle
     @staticmethod
@@ -334,28 +383,60 @@ class Dispatcher:
     def _shard_for(self, node_id: str) -> _Shard:
         return self._shards[stable_shard(node_id, self.shards)]
 
-    def _mark_dirty(self, node_id: str) -> None:
-        """Route a dirty node to its shard. Shard locks are LEAVES:
-        legal under self._lock (global→shard is the pinned order), never
-        the other way around."""
-        sh = self._shard_for(node_id)
-        with sh.lock:
-            sh.dirty.add(node_id)
+    def _mark_dirty(self, node_id: str, hard: bool = True) -> None:
+        """Route a dirty node to its shard's event pump: ONE lock-free
+        deque append per mark, applied to the dirty/hard sets FIFO at
+        the next drain (ISSUE 16 — one shard-lock hold per drain
+        replaces one per event; every dirty-set reader drains first, so
+        visibility is unchanged). `hard` defaults True (dict-diff always
+        serves it); ONLY the event plane's task/secret/config marks pass
+        False — those are the causes the columnar gate provably sees
+        (ISSUE 16), so any un-audited caller stays on the safe path."""
+        self._shard_for(node_id).pending.append((node_id, hard))
 
-    def _mark_dirty_many(self, node_ids) -> None:
-        if self.shards == 1:
-            sh = self._shards[0]
+    def _drain_pumps(self) -> None:
+        """Apply every shard's pending pump ops under ONE shard-lock
+        hold each. Must run before ANY read of a shard's dirty/hard
+        sets (the flush top, every _DirtyView read/mutate) — drained,
+        the sets are exactly what immediate marking would have built
+        (ops apply in append order; set adds commute and are
+        idempotent, so per-shard FIFO is event-order parity)."""
+        drained = 0
+        for sh in self._shards:
+            if not sh.pending:
+                continue
             with sh.lock:
-                sh.dirty.update(node_ids)
+                depth = 0
+                while True:
+                    try:
+                        node_id, hard = sh.pending.popleft()
+                    except IndexError:
+                        break
+                    sh.dirty.add(node_id)
+                    if hard:
+                        sh.hard.add(node_id)
+                    depth += 1
+                # gauge: backlog this drain retired (sampled pre-apply
+                # depth; concurrent appends land in the next drain)
+                self.metrics[f"pump_depth_shard{sh.index}"] = depth
+                drained += depth
+        if drained:
+            self._bump("pump_events", drained)
+
+    def _mark_dirty_many(self, node_ids, hard: bool = True) -> None:
+        if self.shards == 1:
+            # deque.extend of a LIST is one C-level op (no Python
+            # callbacks interleave); materialize first so a generator
+            # argument can't re-enter mid-extend
+            self._shards[0].pending.extend(
+                [(nid, hard) for nid in node_ids])
             return
         by_shard: dict[int, list] = {}
         for nid in node_ids:
             by_shard.setdefault(stable_shard(nid, self.shards),
-                                []).append(nid)
-        for idx, ids in by_shard.items():
-            sh = self._shards[idx]
-            with sh.lock:
-                sh.dirty.update(ids)
+                                []).append((nid, hard))
+        for idx, ops in by_shard.items():
+            self._shards[idx].pending.extend(ops)
 
     @property
     def _dirty_nodes(self) -> _DirtyView:
@@ -418,8 +499,13 @@ class Dispatcher:
             self._sessions.clear()
             for sh in self._shards:
                 with sh.lock:
+                    sh.pending.clear()
                     sh.dirty.clear()
+                    sh.hard.clear()
                     sh.reports.clear()
+            if self._diffcols is not None:
+                for dcs in self._diffcols:
+                    dcs.clear()
             self._secret_refs.clear()
             self._config_refs.clear()
             self._clone_bases.clear()
@@ -612,6 +698,127 @@ class Dispatcher:
         if orphan is not None:
             orphan.stop()   # the node came back before the orphan window
         return session_id
+
+    def register_many(self, node_ids, description=None,
+                      availability=None,
+                      channel_limit: int | None = None) -> dict:
+        """Batched register for fleet joins and session storms
+        (ISSUE 16): N sessions in O(N / MAX_CHANGES) store transactions
+        instead of N — one rate-limit pass, node writes chunked through
+        `store.batch` (pipelined through propose_async when
+        raft-backed), and session swaps in bounded critical sections so
+        live heartbeats interleave with a large burst.
+
+        `availability` (a NodeAvailability value or its lowercase name)
+        applies to NEWLY CREATED node records only — bench simulacra
+        join pre-DRAINed so the scheduler never places real work on
+        them. `channel_limit` caps each session's assignments Channel
+        below the default: a storm whose streams are never drained
+        sheds at the cap (slow-subscriber rule; the delivery gate keeps
+        known-state honest) instead of holding 4096 queued messages per
+        session. The cluster FIPS gate is evaluated ONCE per batch (the
+        per-register in-tx check is the oracle; a cluster spec flip
+        racing the batch lands at the next register).
+
+        Returns {node_id: session_id}; rate-limited or FIPS-rejected
+        nodes are simply absent."""
+        from ..api.types import NodeAvailability
+
+        if isinstance(availability, str):
+            availability = NodeAvailability[availability.upper()]
+        now = time.monotonic()
+        accepted: list[str] = []
+        with self._lock:
+            for node_id in node_ids:
+                attempts, window_start = self._reg_attempts.get(
+                    node_id, (0, now))
+                if now - window_start > self.rate_limit_period:
+                    attempts, window_start = 0, now
+                attempts += 1
+                self._reg_attempts[node_id] = (attempts, window_start)
+                if attempts <= RATE_LIMIT_COUNT:
+                    accepted.append(node_id)
+        if not accepted:
+            return {}
+
+        def fips_gate(tx):
+            if not any(c.fips for c in tx.find_clusters()):
+                return set(accepted)
+            if description is not None and description.fips:
+                return set(accepted)
+            ok = set()
+            for nid in accepted:
+                known = tx.get_node(nid)
+                if known is not None and known.description is not None \
+                        and known.description.fips:
+                    ok.add(nid)
+            return ok
+
+        accepted = [nid for nid in accepted
+                    if nid in self.store.view(fips_gate)]
+        if not accepted:
+            return {}
+
+        def fill(b):
+            for nid in accepted:
+                def cb(tx, nid=nid):
+                    node = tx.get_node(nid)
+                    if node is None:
+                        node = Node(id=nid)
+                        node.status.state = NodeStatusState.READY
+                        if description is not None:
+                            node.description = description
+                        if availability is not None:
+                            node.spec.availability = availability
+                        tx.create(node)
+                    else:
+                        node = node.copy()
+                        node.status.state = NodeStatusState.READY
+                        node.status.message = ""
+                        if description is not None:
+                            node.description = description
+                        tx.update(node)
+                b.update(cb)
+
+        self.store.batch(fill, pipeline_depth=16)
+
+        out: dict[str, str] = {}
+        grace = self.heartbeat_period * GRACE_MULTIPLIER
+        limit = channel_limit or ASSIGNMENTS_CHANNEL_LIMIT
+        chunk_size = 1024
+        for off in range(0, len(accepted), chunk_size):
+            chunk = accepted[off:off + chunk_size]
+            stopped: list = []
+            with self._lock:
+                for nid in chunk:
+                    session_id = new_id()
+                    session = Session(
+                        node_id=nid, session_id=session_id,
+                        channel=Channel(matcher=None, limit=limit))
+                    old = self._sessions.pop(nid, None)
+                    if old is not None:
+                        self._drop_session_refs(old)
+                        old.channel.close()
+                        if old.session_channel is not None:
+                            old.session_channel.close()
+                        if old.tasks_channel is not None:
+                            old.tasks_channel.close()
+                    self._sessions[nid] = session
+                    self._mark_dirty(nid)
+                    pending = self._unknown_timers.pop(nid, None)
+                    orphan = self._orphan_timers.pop(nid, None)
+                    self._hb_wheel.add(
+                        nid, grace,
+                        lambda nid=nid, sid=session_id:
+                            self._node_down(nid, sid))
+                    if pending is not None:
+                        stopped.append(pending)
+                    if orphan is not None:
+                        stopped.append(orphan)
+                    out[nid] = session_id
+            for timer in stopped:
+                timer.stop()
+        return out
 
     def _jittered_period(self, node_id: str | None = None) -> float:
         """period − uniform(0, ε) per beat (VERDICT item 6; reference
@@ -1046,11 +1253,13 @@ class Dispatcher:
                     for key in [k for k in self._driver_cache
                                 if k[2] == obj.id]:
                         del self._driver_cache[key]
+            # SOFT dirt (hard=False): task churn is exactly what the
+            # columnar gate's task leg compares (ISSUE 16)
             if obj.node_id:
-                self._mark_dirty(obj.node_id)
+                self._mark_dirty(obj.node_id, hard=False)
             if isinstance(ev, EventUpdate) and ev.old is not None \
                     and ev.old.node_id and ev.old.node_id != obj.node_id:
-                self._mark_dirty(ev.old.node_id)
+                self._mark_dirty(ev.old.node_id, hard=False)
         elif isinstance(obj, Secret):
             # only sessions that were shipped this secret care about its
             # change; fresh references always arrive via a task event,
@@ -1066,12 +1275,12 @@ class Dispatcher:
                         del self._driver_cache[key]
                 self._mark_dirty_many(
                     self._secret_refs.get(obj.id, set())
-                    & self._sessions.keys())
+                    & self._sessions.keys(), hard=False)
         elif isinstance(obj, Config):
             with self._lock:
                 self._mark_dirty_many(
                     self._config_refs.get(obj.id, set())
-                    & self._sessions.keys())
+                    & self._sessions.keys(), hard=False)
         else:
             from ..api.objects import Cluster, Volume
 
@@ -1190,7 +1399,9 @@ class Dispatcher:
         return clone
 
     def _referenced_deps(self, tx, tasks, node_id: str,
-                         driver_refs: list) -> tuple[dict, dict, dict]:
+                         driver_refs: list,
+                         missing: list | None = None
+                         ) -> tuple[dict, dict, dict]:
         """Secrets/configs the node's tasks reference, plus cluster-volume
         assignments already controller-published to this node
         (assignments.go:21-81; volumes ship once PUBLISHED so the agent
@@ -1200,7 +1411,10 @@ class Dispatcher:
         diff actually ships an object. Driver-backed secret references
         are only COLLECTED here (into `driver_refs` as (secret, task)
         pairs) — their materialization does external I/O and happens
-        after the transaction."""
+        after the transaction. Referenced-but-ABSENT secrets/configs
+        collect into `missing` as (kind, id) pairs when the caller asks
+        (ISSUE 16): a dep created later never events this session, so
+        the columnar gate must re-check resolution per flush."""
         from ..csi.plugin import PUBLISHED
 
         secrets, configs, volumes = {}, {}, {}
@@ -1221,6 +1435,8 @@ class Dispatcher:
             for ref in runtime.secrets:
                 s = tx.get_secret(ref.secret_id)
                 if s is None:
+                    if missing is not None:
+                        missing.append(("secret", ref.secret_id))
                     continue
                 if s.spec.driver:
                     driver_refs.append((s, t))
@@ -1228,8 +1444,11 @@ class Dispatcher:
                 secrets[s.id] = s
             for ref in runtime.configs:
                 c = tx.get_config(ref.config_id)
-                if c is not None:
-                    configs[c.id] = c
+                if c is None:
+                    if missing is not None:
+                        missing.append(("config", ref.config_id))
+                    continue
+                configs[c.id] = c
         return secrets, configs, volumes
 
     def _pending_unpublish(self, tx, node_id: str) -> dict:
@@ -1324,12 +1543,18 @@ class Dispatcher:
     def _commit_known(self, session: Session, new_tasks: dict,
                       new_secrets: dict, new_configs: dict,
                       new_volumes: set, sequence: int,
-                      ship_bases: dict | None = None):
+                      ship_bases: dict | None = None,
+                      column_plan=None):
         """Atomically replace the session's known-assignment maps and
         maintain the secret/config reverse reference maps from the diff.
         Runs ONLY after the carrying message was delivered (or there was
         nothing to deliver): known-state may never advance past what the
-        agent actually saw."""
+        agent actually saw. `column_plan` (ISSUE 16) is the columnar
+        image of the SAME known state, installed here and only here —
+        the plan columns advance in lockstep with the dicts; a commit
+        without a captured plan invalidates the node's columns (the
+        gate then serves it through the dict path until the next
+        planned commit)."""
         with self._lock:
             node_id = session.node_id
             current = self._sessions.get(node_id) is session
@@ -1376,6 +1601,15 @@ class Dispatcher:
             session.known_volumes = new_volumes
             session.known_bases = new_bases
             session.sequence = sequence
+            if current and self._diffcols is not None:
+                # lock order: dispatcher.lock → diffcol leaf (the gate
+                # reads plans under store.lock → diffcol instead; the
+                # diffcol lock never acquires anything, so no cycle)
+                dcs = self._diffcols[stable_shard(node_id, self.shards)]
+                if column_plan is not None:
+                    dcs.install(node_id, column_plan)
+                else:
+                    dcs.invalidate(node_id)
 
     def _drop_session_refs(self, session: Session):
         """Remove a retiring session's entries from the reverse reference
@@ -1383,6 +1617,11 @@ class Dispatcher:
         CURRENTLY owns its node key — a superseded session's references
         belong to its replacement)."""
         node_id = session.node_id
+        if self._diffcols is not None:
+            # the retiring session's plan must die with it: the next
+            # session rebuilds from a COMPLETE and installs its own
+            self._diffcols[stable_shard(node_id, self.shards)] \
+                .invalidate(node_id)
         for keys, bases, refs in (
                 (session.known_secrets, session.known_bases,
                  self._secret_refs),
@@ -1400,13 +1639,25 @@ class Dispatcher:
                     self._clone_bases.pop(k, None)
 
     # -------------------------------------------------- fan-out shipping
-    def _node_view(self, tx, node_id: str, driver_refs: list):
+    def _node_view(self, tx, node_id: str, driver_refs: list,
+                   plan_sink: list | None = None, token: str = ""):
         """One node's assignment inputs as live references — the no-copy
-        read half of a flush."""
+        read half of a flush. When the caller passes a `plan_sink`, a
+        ColumnPlan is captured HERE, inside the view (row indices and
+        versions read under the store lock are mutually consistent) and
+        appended for the delivery-gated commit to install (ISSUE 16);
+        `token` is the session id the plan is bound to."""
+        missing: list | None = [] if plan_sink is not None else None
         tasks = self._relevant_tasks(tx, node_id)
         secrets, configs, volumes = self._referenced_deps(
-            tx, tasks, node_id, driver_refs)
+            tx, tasks, node_id, driver_refs, missing)
         unpublish = self._pending_unpublish(tx, node_id)
+        if plan_sink is not None:
+            col = getattr(self.store, "columnar", None)
+            if col is not None:
+                plan_sink.append(columnar_diff.ColumnPlan.capture(
+                    col, token, node_id, tasks, secrets, configs,
+                    missing, bool(driver_refs)))
         return tasks, secrets, configs, volumes, unpublish
 
     def _materialize_clones(self, session: Session, secrets: dict,
@@ -1448,8 +1699,11 @@ class Dispatcher:
 
     def _full_assignment(self, session: Session) -> AssignmentsMessage:
         driver_refs: list = []
+        plans: list = []
         tasks, secrets, configs, volumes, unpublish = self.store.view(
-            lambda tx: self._node_view(tx, session.node_id, driver_refs))
+            lambda tx: self._node_view(tx, session.node_id, driver_refs,
+                                       plan_sink=plans,
+                                       token=session.session_id))
         clone_ids, ship_bases = self._materialize_clones(
             session, secrets, driver_refs)
         changes = (
@@ -1469,7 +1723,8 @@ class Dispatcher:
             {t.id: t.meta.version.index for t in tasks},
             {sid: s.meta.version.index for sid, s in secrets.items()},
             {cid: c.meta.version.index for cid, c in configs.items()},
-            set(volumes), session.sequence + 1, ship_bases)
+            set(volumes), session.sequence + 1, ship_bases,
+            column_plan=plans[0] if plans else None)
         if lifecycle.enabled():
             # lifecycle SHIPPED leg for the COMPLETE snapshot (fresh
             # session: ASSIGNED tasks reach their agent here, not via an
@@ -1485,11 +1740,15 @@ class Dispatcher:
         the fsm model): its own view, commit-on-build — the caller
         consumes the returned message synchronously."""
         driver_refs: list = []
+        plans: list = []
         view = self.store.view(
-            lambda tx: self._node_view(tx, session.node_id, driver_refs))
+            lambda tx: self._node_view(tx, session.node_id, driver_refs,
+                                       plan_sink=plans,
+                                       token=session.session_id))
         clone_ids, ship_bases = self._materialize_clones(
             session, view[1], driver_refs)
-        msg, commit = self._diff(session, *view, clone_ids, ship_bases)
+        msg, commit = self._diff(session, *view, clone_ids, ship_bases,
+                                 column_plan=plans[0] if plans else None)
         commit()
         return msg
 
@@ -1509,15 +1768,27 @@ class Dispatcher:
 
         A crash at any point re-dirties the unserved sessions so the
         next interval retries; served sessions already committed their
-        known-state and are NOT replayed."""
+        known-state and are NOT replayed.
+
+        ISSUE 16 columnar gate: inside the same view, one vectorized
+        pass per shard proves which soft-dirty sessions have a ZERO
+        delta against the live columnar tables; proven-zero sessions
+        skip the node view, the dict diff, and the serve entirely
+        (their delivery-committed state is already current, so skipping
+        IS serving them). HARD-dirty sessions — causes the columns
+        can't see — always take the dict path."""
         shard_batches: list[list[Session]] = []
+        shard_hard: list[set] = []
+        self._drain_pumps()
         with self._lock:
             for sh in self._shards:
                 with sh.lock:
                     dirty, sh.dirty = sh.dirty, set()
+                    hard, sh.hard = sh.hard, set()
                 shard_batches.append([self._sessions[n]
                                       for n in sorted(dirty)
                                       if n in self._sessions])
+                shard_hard.append(hard)
         sessions = [s for batch in shard_batches for s in batch]
         if not sessions:
             return
@@ -1527,22 +1798,37 @@ class Dispatcher:
         # sub-stages; None when disarmed (one truthiness test — the
         # op-count guard in tests/test_dispatcher_fanout.py stays exact)
         sp = trace.start("dispatcher.flush", sessions=len(sessions))
-        views: list[tuple[Session, tuple, list]] = []
+        views: list[list[tuple[Session, tuple, list, list]]] = []
+        skipped: set = set()
 
         def cb(tx):
             self.metrics["flush_tx"] += 1
-            for session in sessions:
-                # failpoint `dispatcher.assignments.build`: one session's
-                # build crashes the flush snapshot mid-batch (nothing was
-                # offered yet — the whole dirty set retries). Per-session
-                # by design: mid-batch is the crash point under test.
-                # lint: allow(span-in-loop)
-                failpoints.fp("dispatcher.assignments.build")
-                driver_refs: list = []
-                views.append((session,
-                              self._node_view(tx, session.node_id,
-                                              driver_refs),
-                              driver_refs))
+            views.clear()
+            skipped.clear()
+            gate = self._gate_context()
+            for batch, hard in zip(shard_batches, shard_hard):
+                if gate is not None and batch:
+                    serve = self._gate_shard(gate, batch, hard, skipped)
+                else:
+                    serve = batch
+                built: list = []
+                for session in serve:
+                    # failpoint `dispatcher.assignments.build`: one
+                    # session's build crashes the flush snapshot
+                    # mid-batch (nothing was offered yet — the whole
+                    # dirty set retries). Per-session by design:
+                    # mid-batch is the crash point under test.
+                    # lint: allow(span-in-loop)
+                    failpoints.fp("dispatcher.assignments.build")
+                    driver_refs: list = []
+                    plans: list = []
+                    built.append((session,
+                                  self._node_view(
+                                      tx, session.node_id, driver_refs,
+                                      plan_sink=plans,
+                                      token=session.session_id),
+                                  driver_refs, plans))
+                views.append(built)
 
         out_sets: list[set] = []
         try:
@@ -1555,12 +1841,7 @@ class Dispatcher:
                 trace.rec("dispatcher.flush.snapshot",
                           time.perf_counter() - t0, parent=sp)
                 t0 = time.perf_counter()
-            # regroup the flat view list back into shard batches (the
-            # view walked sessions in shard order)
-            it = iter(views)
-            work = [batch for batch in
-                    ([next(it) for _ in b] for b in shard_batches)
-                    if batch]
+            work = [batch for batch in views if batch]
             self.metrics["dirty_walks"] += len(work)
             out_sets = [set() for _ in work]
             if len(work) <= 1:
@@ -1584,6 +1865,10 @@ class Dispatcher:
                           served=sum(len(s) for s in out_sets))
         except Exception as exc:
             served = set().union(*out_sets) if out_sets else set()
+            # gate-skipped sessions were proven current — they count as
+            # served; everything else re-dirties HARD (conservative:
+            # the retry must not trust a plan from the crashed flush)
+            served |= skipped
             self._mark_dirty_many(
                 s.node_id for s in sessions if s.node_id not in served)
             if sp is not None:
@@ -1595,6 +1880,60 @@ class Dispatcher:
             self.metrics["last_flush_s"] = time.monotonic() - start
             if sp is not None:
                 sp.end(served=sum(len(s) for s in out_sets))
+
+    def _gate_context(self):
+        """Per-flush shared gate state, or None when the columnar-diff
+        plane is off (env-disabled, or the store has no columnar
+        mirror). Built ONCE inside the flush's view callback — the store
+        lock makes the relevance mask and per-node counts commit-
+        consistent with every plan comparison in the same flush."""
+        if self._diffcols is None:
+            return None
+        col = getattr(self.store, "columnar", None)
+        if col is None:
+            return None
+        return columnar_diff.GateContext(col)
+
+    def _gate_shard(self, gate, batch: list, hard: set,
+                    skipped: set) -> list:
+        """One shard's skip gate: collect the sessions whose zero delta
+        the columns can prove, run the vectorized pass, and return the
+        batch minus the proven-clean sessions (serve order preserved).
+        Eligibility is conservative — anything the columns can't see
+        keeps the dict path: hard-dirty causes (volume events, external
+        marks, crash re-dirty), an unprimed volume index, a pending
+        node-unpublish re-send, or an open legacy tasks stream (its
+        snapshot re-sends per flush). Driver-secret clone state needs no
+        check here: a serve with driver refs installs an INELIGIBLE plan
+        (same atomic commit as the known dicts), and refs can only
+        appear with a task-set change the gate already detects."""
+        candidates: list = []
+        plans: list = []
+        for session in batch:
+            nid = session.node_id
+            if nid in hard or not self._vol_index_primed \
+                    or nid in self._vol_pending_unpub:
+                continue
+            ch = session.tasks_channel
+            if ch is not None and not ch.closed:
+                continue
+            plan = self._diffcols[stable_shard(nid, self.shards)] \
+                .plan_for(nid, session.session_id, gate.col)
+            if plan is None:
+                continue
+            candidates.append(session)
+            plans.append(plan)
+        if not plans:
+            return batch
+        clean, scanned = columnar_diff.gate_shard(gate, plans)
+        self.metrics["diff_rows_scanned"] += scanned
+        skip_ids = {s.node_id
+                    for s, ok in zip(candidates, clean) if ok}
+        if not skip_ids:
+            return batch
+        self.metrics["zero_delta_skips"] += len(skip_ids)
+        skipped.update(skip_ids)
+        return [s for s in batch if s.node_id not in skip_ids]
 
     def _serve_pool(self):
         """Lazy worker pool for multi-shard serves (only flushes where
@@ -1619,8 +1958,9 @@ class Dispatcher:
         the message."""
         commits: list = []
         try:
-            for session, view, driver_refs in batch:
-                commit = self._serve_session(session, view, driver_refs)
+            for session, view, driver_refs, plans in batch:
+                commit = self._serve_session(session, view, driver_refs,
+                                             plans)
                 if commit is not None:
                     commits.append(commit)
                 served.add(session.node_id)
@@ -1631,7 +1971,7 @@ class Dispatcher:
                         commit()
 
     def _serve_session(self, session: Session, view: tuple,
-                       driver_refs: list):
+                       driver_refs: list, plans: list | None = None):
         """Build + offer one session's diff; returns the known-state
         commit closure when the message was delivered (the caller merges
         a whole shard's commits under one lock hold), None when the
@@ -1640,7 +1980,8 @@ class Dispatcher:
         clone_ids, ship_bases = self._materialize_clones(
             session, secrets, driver_refs)
         msg, commit = self._diff(session, tasks, secrets, configs,
-                                 volumes, unpublish, clone_ids, ship_bases)
+                                 volumes, unpublish, clone_ids, ship_bases,
+                                 column_plan=plans[0] if plans else None)
         delivered = True
         if msg.changes:
             self._bump("ships", len(msg.changes))
@@ -1658,11 +1999,18 @@ class Dispatcher:
         return commit if delivered else None
 
     def _diff(self, session: Session, tasks, secrets, configs, volumes,
-              unpublish, clone_ids, ship_bases=None):
+              unpublish, clone_ids, ship_bases=None, column_plan=None):
         """Pure diff against the session's known maps: wire copies are
         made only for objects that actually ship (copy-on-ship). Returns
         the message plus a commit closure that publishes the new known
-        state — run it ONLY once the message was delivered."""
+        state — run it ONLY once the message was delivered.
+
+        `column_plan` is the columnar-diff plan captured alongside this
+        view (ISSUE 16); the commit installs it under the same delivery
+        gate that advances the known dicts. `dict_diffs` counts every
+        walk through here — the zero-dict-walk acceptance guard reads
+        it."""
+        self._bump("dict_diffs")
         changes: list[Assignment] = []
         new_tasks = {t.id: t.meta.version.index for t in tasks}
         for t in tasks:
@@ -1679,20 +2027,27 @@ class Dispatcher:
             if session.known_secrets.get(sid) != s.meta.version.index:
                 changes.append(Assignment("update", "secret",
                                           self._ship(s)))
-        for sid in set(session.known_secrets) - set(secrets):
-            changes.append(Assignment("remove", "secret", sid))
+        for sid in session.known_secrets:
+            # single-pass removal detection (ISSUE 16): dict membership
+            # against the fresh view, no throwaway set materialization —
+            # this oracle path stays load-bearing under the parity fuzz
+            if sid not in secrets:
+                changes.append(Assignment("remove", "secret", sid))
         new_configs = {cid: c.meta.version.index
                        for cid, c in configs.items()}
         for cid, c in configs.items():
             if session.known_configs.get(cid) != c.meta.version.index:
                 changes.append(Assignment("update", "config",
                                           self._ship(c)))
-        for cid in set(session.known_configs) - set(configs):
-            changes.append(Assignment("remove", "config", cid))
+        for cid in session.known_configs:
+            if cid not in configs:
+                changes.append(Assignment("remove", "config", cid))
         for vid, v in volumes.items():
             if vid not in session.known_volumes:
                 changes.append(Assignment("update", "volume", v))
-        for vid in session.known_volumes - set(volumes):
+        for vid in session.known_volumes:
+            if vid in volumes:
+                continue
             # prefer the assignment object when the volume is pending
             # node-unpublish so the agent can act without local state
             changes.append(Assignment("remove", "volume",
@@ -1708,7 +2063,7 @@ class Dispatcher:
         def commit():
             self._commit_known(session, new_tasks, new_secrets,
                                new_configs, set(volumes), sequence,
-                               ship_bases)
+                               ship_bases, column_plan=column_plan)
             if self._record_shipped and lifecycle.enabled():
                 # lifecycle plane: the SHIPPED leg, one batched record
                 # per delivered diff (commit runs only once the agent

@@ -73,6 +73,12 @@ class FollowerReadPlane:
     # _full_assignment below, which omits the leg for the same reason)
     _record_shipped = False
 
+    # columnar diff gate (ISSUE 16): the follower plane never builds
+    # plan stores — None makes the borrowed _commit_known /
+    # _drop_session_refs install hooks no-op here (bounded-staleness
+    # reads must not skip on leader-lockstep columns anyway)
+    _diffcols = None
+
     def __init__(self, store, raft_node, secret_drivers=None, clock=None):
         from ..utils.clock import REAL_CLOCK
 
@@ -100,7 +106,9 @@ class FollowerReadPlane:
         self.metrics = CounterDict(
             {"reads_served": 0, "reads_bounced": 0,
              "flushes": 0, "flush_tx": 0, "held_flushes": 0,
-             "ships": 0, "wire_copies": 0})
+             "ships": 0, "wire_copies": 0,
+             # the borrowed _diff bumps this on every walk (ISSUE 16)
+             "dict_diffs": 0})
 
     # ---- the shared snapshot/build vocabulary: the leader's own code.
     # These CANNOT drift from the Dispatcher — they are the same

@@ -315,6 +315,17 @@ def build_manager_registry(manager, raft_node=None,
         _require_node(caller, node_id)
         return d.register(node_id, description)
 
+    def disp_register_many(caller, node_ids, description=None,
+                           availability=None, channel_limit=None):
+        # MANAGER-only (enforced again by roles below): a worker cert
+        # names exactly one node and must not mint sessions for others;
+        # batched joins are an operator/bench surface (ISSUE 16)
+        if caller is None or caller.role != MANAGER:
+            raise PermissionDenied("batched registration is manager-only")
+        return d.register_many(node_ids, description,
+                               availability=availability,
+                               channel_limit=channel_limit)
+
     def disp_heartbeat(caller, node_id, session_id, metrics=None):
         _require_node(caller, node_id)
         return d.heartbeat(node_id, session_id, metrics=metrics)
@@ -356,6 +367,9 @@ def build_manager_registry(manager, raft_node=None,
     both = [WORKER, MANAGER]
     reg.add("dispatcher.register",
             leader_forward("dispatcher.register", disp_register), roles=both)
+    reg.add("dispatcher.register_many",
+            leader_forward("dispatcher.register_many", disp_register_many),
+            roles=[MANAGER])
     reg.add("dispatcher.heartbeat",
             leader_forward("dispatcher.heartbeat", disp_heartbeat), roles=both)
     def disp_session(caller, node_id, session_id):

@@ -162,6 +162,51 @@ class ColumnarNodes:
             self.valid[row] = False
 
 
+class ColumnarDeps:
+    """Hot-column mirror of a dependency table (secrets or configs,
+    ISSUE 16): version / valid over the table's own IdVocab. Unlike task
+    rows, dep rows are NEVER recycled — the vocab only grows — so a row
+    index captured by a consumer (the dispatcher's per-session known
+    columns) stays bound to the same object id forever, and a deleted-
+    then-recreated dep re-lands on its old row with a strictly newer
+    version. Same derived-truth rules as the task columns: the commit
+    path (`MemoryStore._commit`) is the only steady writer."""
+
+    def __init__(self, cap: int = _GROW):
+        self.vocab = IdVocab()
+        cap = max(cap, 1)
+        self.version = np.zeros(cap, np.int64)
+        self.valid = np.zeros(cap, bool)
+
+    _COLS = ("version", "valid")
+
+    def upsert(self, obj) -> int:
+        row = self.vocab.intern(obj.id)
+        _grow_columns(self, self._COLS, row + 1)
+        self.version[row] = obj.meta.version.index
+        self.valid[row] = True
+        return row
+
+    def delete(self, obj_id: str) -> None:
+        row = self.vocab.lookup(obj_id)
+        if row > 0 and row < self.valid.shape[0]:
+            self.valid[row] = False
+
+    def row_of(self, obj_id: str) -> int:
+        """Live row index, -1 when unseen or deleted."""
+        row = self.vocab.lookup(obj_id)
+        if row <= 0 or row >= self.valid.shape[0] or not self.valid[row]:
+            return -1
+        return row
+
+    def apply_actions(self, actions: list) -> None:
+        for action in actions:
+            if action.kind == "delete":
+                self.delete(action.obj.id)
+            else:
+                self.upsert(action.obj)
+
+
 class ColumnarTasks:
     """Dense column mirror of the task table.
 
@@ -191,6 +236,11 @@ class ColumnarTasks:
         # service / node hot columns over the SHARED vocabs (ISSUE 14)
         self.service_cols = ColumnarServices(self.services, cap)
         self.node_cols = ColumnarNodes(self.nodes, cap)
+        # secret / config version mirrors (ISSUE 16): own vocabs, rows
+        # never recycled — the dispatcher's columnar assignment diff
+        # binds per-session known versions to these rows
+        self.secret_cols = ColumnarDeps(cap)
+        self.config_cols = ColumnarDeps(cap)
         # op counters (merged into store.op_counts views / debug/vars)
         self.stats: Counter = Counter()
 
@@ -301,6 +351,22 @@ class ColumnarTasks:
                 self.node_cols.upsert(action.obj)
         self.stats["node_upserts"] += len(actions)
 
+    def apply_secret_actions(self, actions: list) -> None:
+        """Commit-path lockstep hook for the secret version mirror."""
+        self.secret_cols.apply_actions(actions)
+        self.stats["secret_upserts"] += len(actions)
+
+    def apply_config_actions(self, actions: list) -> None:
+        """Commit-path lockstep hook for the config version mirror."""
+        self.config_cols.apply_actions(actions)
+        self.stats["config_upserts"] += len(actions)
+
+    def task_row(self, task_id: str) -> int:
+        """Live row index for a task id, -1 when absent (rows recycle
+        through the free list, so consumers holding a row must also hold
+        the version they saw — see dispatcher/columnar_diff.py)."""
+        return self._row.get(task_id, -1)
+
     # --------------------------------------------------- wave fast path
     def wave_codes(self, task_ids: list) -> tuple[np.ndarray, np.ndarray]:
         """Vectorized wave-commit validation (the in-tx re-validation the
@@ -403,17 +469,23 @@ class ColumnarTasks:
 
     @classmethod
     def rebuild(cls, tasks: list, services: list = (),
-                nodes: list = ()) -> "ColumnarTasks":
+                nodes: list = (), secrets: list = (),
+                configs: list = ()) -> "ColumnarTasks":
         """From-scratch mirror of a task list (the bit-equality oracle in
-        tests, and the restore path). `services`/`nodes` feed the hot
-        sub-mirrors (the restore path passes them; parity tests that
-        only compare task columns may omit them)."""
+        tests, and the restore path). `services`/`nodes`/`secrets`/
+        `configs` feed the hot sub-mirrors (the restore path passes
+        them; parity tests that only compare task columns may omit
+        them)."""
         col = cls(cap=max(len(tasks), 1))
         col.upsert_many(sorted(tasks, key=lambda t: t.id))
         for s in sorted(services, key=lambda s: s.id):
             col.service_cols.upsert(s)
         for n in sorted(nodes, key=lambda n: n.id):
             col.node_cols.upsert(n)
+        for s in sorted(secrets, key=lambda s: s.id):
+            col.secret_cols.upsert(s)
+        for c in sorted(configs, key=lambda c: c.id):
+            col.config_cols.upsert(c)
         return col
 
     @staticmethod

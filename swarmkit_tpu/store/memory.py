@@ -398,6 +398,8 @@ class MemoryStore:
                 [] if col is not None else None
             service_actions: list[StoreAction] = []
             node_actions: list[StoreAction] = []
+            secret_actions: list[StoreAction] = []
+            config_actions: list[StoreAction] = []
             if version_index is not None:
                 # replicated commits carry the raft entry index so object
                 # versions agree on every replica
@@ -418,6 +420,10 @@ class MemoryStore:
                     service_actions.append(action)
                 elif task_actions is not None and table == "node":
                     node_actions.append(action)
+                elif task_actions is not None and table == "secret":
+                    secret_actions.append(action)
+                elif task_actions is not None and table == "config":
+                    config_actions.append(action)
                 if action.kind == StoreAction.DELETE:
                     stored = self._tables[table].pop(obj.id, None)
                     if stored is not None:
@@ -444,6 +450,10 @@ class MemoryStore:
                 col.apply_service_actions(service_actions)
             if node_actions:
                 col.apply_node_actions(node_actions)
+            if secret_actions:
+                col.apply_secret_actions(secret_actions)
+            if config_actions:
+                col.apply_config_actions(config_actions)
             events.append(EventCommit(version))
         self.queue.publish_all(events)
 
@@ -579,7 +589,9 @@ class MemoryStore:
                 self.columnar = ColumnarTasks.rebuild(
                     list(self._tables["task"].values()),
                     services=list(self._tables["service"].values()),
-                    nodes=list(self._tables["node"].values()))
+                    nodes=list(self._tables["node"].values()),
+                    secrets=list(self._tables["secret"].values()),
+                    configs=list(self._tables["config"].values()))
 
     # ------------------------------------------------- columnar wave plane
     def assign_wave(self, assignments: list[tuple[str, str]], *,

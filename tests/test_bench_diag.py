@@ -100,7 +100,7 @@ def test_dispatcher_fanout_storm_cpu_smoke():
 
     row = bench.bench_dispatcher_fanout_storm(
         np, n_sessions=300, shard_counts=(1, 4), beats_sample=200,
-        follower_reads=30)
+        follower_reads=30, ceiling_sessions=600, ceiling_shards=(1, 2))
     assert row["parity"] is True
     for P in ("1", "4"):
         sub = row["shards"][P]
@@ -111,6 +111,42 @@ def test_dispatcher_fanout_storm_cpu_smoke():
         assert sub["beat_p99_us"] > 0
     assert row["follower_reads"] == 30
     assert row["follower_read_ratio"] is not None
+    # ISSUE 16 diff_plane block: gate-vs-dict-oracle on the same store.
+    # A zero-delta soft storm must skip the world (zero dict walks,
+    # zero ships), a real storm must dict-diff + ship the world with
+    # sampled wire parity against the single-plane oracle.
+    dp = row["diff_plane"]
+    assert dp["gate_enabled"] is True, dp
+    assert dp["wire_parity"] is True, dp
+    assert dp["zero_delta_skips"] == 300, dp
+    assert dp["zero_storm_dict_diffs"] == 0, dp
+    assert dp["zero_storm_ships"] == 0, dp
+    assert dp["diff_rows_scanned"] >= 300, dp
+    assert dp["real_storm_dict_diffs"] == 300, dp
+    assert dp["real_storm_ships"] == 300, dp
+    # ISSUE 16 serve_ceiling block: the honest serve storm — first
+    # shard count is the dict oracle (gate off: zero skips, dict-walks
+    # the world on the zero-delta flush), the last is gated (skips the
+    # world); op counts hold at every P and cross-plane wire parity is
+    # version-stripped (sequential planes serve their own touch rev).
+    sc = row["serve_ceiling"]
+    assert sc["sessions"] == 600
+    assert sc["wire_parity"] is True, sc
+    assert sc["op_counts_ok"] is True, sc
+    oracle = sc["per_shard"]["1"]
+    gated = sc["per_shard"]["2"]
+    assert oracle["dict_oracle"] is True
+    assert oracle["zero_delta_skips"] == 0, oracle
+    assert oracle["gate_dict_diffs"] == 600, oracle
+    assert gated["dict_oracle"] is False
+    assert gated["zero_delta_skips"] == 600, gated
+    assert gated["gate_dict_diffs"] == 0, gated
+    for sub in (oracle, gated):
+        assert sub["store_tx_per_flush"] == 1.0, sub
+        assert sub["dirty_walks_per_shard"] <= 1.0, sub
+        assert sub["delivered"] == 600, sub
+    assert sc["serve_speedup_p1_to_pN"] is not None
+    assert "GIL" in sc["gil_note"] or "Python" in sc["gil_note"]
 
 
 def test_orchestrator_storm_cpu_smoke():

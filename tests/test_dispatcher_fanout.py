@@ -1227,3 +1227,455 @@ def test_follower_read_rpc_routing():
     finally:
         plane.stop()
         mgr.dispatcher.stop()
+
+
+# ===================================================================
+# ISSUE 16: columnar assignment-diff gate + per-shard event pumps
+# ===================================================================
+def run_gate_parity(seed, steps=40):
+    """Wire-parity fuzz `columnar-gate plane ≡ dict-oracle plane`: one
+    store, one event schedule, TWO driven dispatchers — the default
+    (gate on) and one with the gate forced off (every dirty session
+    dict-diffs, the pre-16 plane). After every flush each node's
+    shipped messages must be order-normalized-identical, and at
+    quiescence both agents match the independent store oracle. The
+    schedule covers the gate's blind spots on purpose: driver-secret
+    clones (plan ineligible), reconnect/full-assignment rebuild
+    (superseded plan), volume publish/unpublish churn (hard-channel +
+    eligibility exclusion), and spurious soft re-marks (the zero-delta
+    case the gate exists to skip)."""
+    rng = random.Random(seed)
+
+    class FakeDriver:
+        def get(self, secret, task, node_id):
+            return b"pl-" + str(secret.meta.version.index).encode()
+
+    class Registry:
+        def get(self, name):
+            return FakeDriver()
+
+    store = MemoryStore()
+    d_g, ch_g = driven_dispatcher(store, rate_limit_period=-1.0,
+                                  secret_drivers=Registry())
+    d_o, ch_o = driven_dispatcher(store, rate_limit_period=-1.0,
+                                  secret_drivers=Registry())
+    assert d_g._diffcols is not None, \
+        "store carries no columnar mirror — the gate under test is off"
+    d_o._diffcols = None        # the dict-oracle plane
+
+    nodes = [f"g{i:02d}" for i in range(rng.randint(5, 8))]
+    secret_ids = [f"gsec{i}" for i in range(3)]
+    config_ids = [f"gcfg{i}" for i in range(2)]
+    volume_ids = [f"gvol{i}" for i in range(2)]
+    driver_sid = "gdrv"
+    for nid in nodes:
+        mk_node(store, nid)
+    for sid in secret_ids:
+        mk_secret(store, sid)
+    for cid in config_ids:
+        mk_config(store, cid)
+    for vid in volume_ids:
+        mk_volume(store, vid)
+    s = Secret(id=driver_sid, spec=SecretSpec(
+        annotations=Annotations(name=driver_sid), data=b""))
+    s.spec.driver = {"name": "fake"}
+    store.update(lambda tx: tx.create(s))
+    # quiet sentinel: untouched by the schedule (churn draws from
+    # `nodes` only), so its soft re-mark below MUST be gate-skipped —
+    # a deterministic ≥1-skip floor for every seed
+    mk_node(store, "gquiet")
+    qt = Task(id="gqt", service_id="svc", node_id="gquiet", slot=999)
+    qt.status.state = TaskState.RUNNING
+    qt.desired_state = TaskState.RUNNING
+    store.update(lambda tx: tx.create(qt))
+
+    chans: dict[str, dict] = {}
+    views: dict[str, dict] = {}
+
+    def join(nid):
+        # fresh registration — for an already-joined node this is the
+        # RECONNECT path: the new session supersedes, the old plan is
+        # invalidated, and a fresh COMPLETE rebuilds the agent
+        for key, d in (("g", d_g), ("o", d_o)):
+            sid = d.register(nid)
+            chans.setdefault(nid, {})[key] = d.assignments(nid, sid)
+            views.setdefault(nid, {})[key] = AgentView()
+
+    def flush_and_compare():
+        pump(d_g, ch_g)
+        pump(d_o, ch_o)
+        d_g._send_incrementals()
+        d_o._send_incrementals()
+        for nid in chans:
+            got = {}
+            for key in ("g", "o"):
+                msgs = []
+                while True:
+                    m = chans[nid][key].try_get()
+                    if m is None:
+                        break
+                    views[nid][key].apply(m)
+                    msgs.append(_normalize_msg(m))
+                got[key] = msgs
+            assert got["g"] == got["o"], (
+                f"node {nid}: the gated plane shipped different wire "
+                f"messages\ngate:   {got['g']}\noracle: {got['o']}")
+
+    try:
+        join("gquiet")
+        for nid in nodes[: len(nodes) // 2 + 1]:
+            join(nid)
+        flush_and_compare()
+        tseq = [0]
+        for _ in range(steps):
+            op = rng.random()
+            if op < 0.30:
+                if rng.random() < 0.5 or tseq[0] == 0:
+                    tid = f"gt{tseq[0]:03d}"
+                    tseq[0] += 1
+                    t = Task(id=tid, service_id="svc",
+                             node_id=rng.choice(nodes), slot=tseq[0])
+                    t.status.state = TaskState.RUNNING
+                    t.desired_state = TaskState.RUNNING
+                    runtime = ContainerSpec()
+                    for sid in rng.sample(secret_ids, rng.randint(0, 2)):
+                        runtime.secrets.append(SecretReference(
+                            secret_id=sid, secret_name=sid))
+                    if rng.random() < 0.25:
+                        runtime.secrets.append(SecretReference(
+                            secret_id=driver_sid, secret_name=driver_sid))
+                    for cid in rng.sample(config_ids, rng.randint(0, 1)):
+                        runtime.configs.append(ConfigReference(
+                            config_id=cid, config_name=cid))
+                    t.spec.runtime = runtime
+                    if rng.random() < 0.3:
+                        t.volumes = rng.sample(volume_ids,
+                                               rng.randint(1, 2))
+                    store.update(lambda tx, t=t: tx.create(t))
+                else:
+                    tasks = [t for t in
+                             store.view(lambda tx: tx.find_tasks())
+                             if t.id != "gqt"]
+                    if tasks:
+                        t = rng.choice(tasks)
+                        r = rng.random()
+                        if r < 0.3:
+                            store.update(lambda tx, tid=t.id:
+                                         tx.delete(Task, tid))
+                        else:
+                            cur = t.copy()
+                            if r < 0.65:
+                                cur.node_id = rng.choice(nodes)
+                            else:
+                                cur.annotations.labels = {
+                                    "rev": str(rng.randint(0, 9))}
+                            store.update(lambda tx, cur=cur:
+                                         tx.update(cur))
+            elif op < 0.45:
+                sid = rng.choice(secret_ids + [driver_sid])
+                s2 = store.view(lambda tx: tx.get_secret(sid))
+                if s2 is None:
+                    pass
+                elif sid != driver_sid and rng.random() < 0.2:
+                    store.update(lambda tx, sid=sid:
+                                 tx.delete(Secret, sid))
+                else:
+                    cur = s2.copy()
+                    cur.spec.data = bytes([rng.randint(0, 255)])
+                    store.update(lambda tx, cur=cur: tx.update(cur))
+            elif op < 0.58:
+                vid = rng.choice(volume_ids)
+                v = store.view(lambda tx: tx.get_volume(vid))
+                if v is not None:
+                    cur = v.copy()
+                    cur.publish_status = [
+                        VolumePublishStatus(
+                            node_id=nid,
+                            state=rng.choice(
+                                [PUBLISHED, PENDING_NODE_UNPUBLISH]))
+                        for nid in rng.sample(nodes, rng.randint(0, 3))]
+                    store.update(lambda tx, cur=cur: tx.update(cur))
+            elif op < 0.72:
+                nid = rng.choice(nodes)
+                join(nid)       # new join or reconnect-rebuild
+            else:
+                # spurious soft re-mark on BOTH planes: no store change
+                # rode it, so the oracle walks and ships nothing while
+                # the gate may prove the zero delta and skip the walk
+                nid = rng.choice(list(chans))
+                d_g._mark_dirty(nid, hard=False)
+                d_o._mark_dirty(nid, hard=False)
+            if rng.random() < 0.6:
+                flush_and_compare()
+        flush_and_compare()
+        # the deterministic skip floor: the sentinel is quiescent with a
+        # live plan, so its soft re-mark must be proven zero-delta
+        skips0 = d_g.metrics["zero_delta_skips"]
+        d_g._mark_dirty("gquiet", hard=False)
+        d_o._mark_dirty("gquiet", hard=False)
+        flush_and_compare()
+        assert d_g.metrics["zero_delta_skips"] > skips0, \
+            "the gate never skipped the quiescent sentinel"
+        assert d_g.metrics["diff_rows_scanned"] > 0
+        flush_and_compare()
+        for nid, v in views.items():
+            # both planes byte-agree (the wire compare above is per
+            # flush; this is the accumulated-state form of the same)
+            assert v["g"].state() == v["o"].state(), \
+                f"planes diverged on {nid}"
+            # vs the independent store oracle: oracle_rebuild models
+            # plain secrets only, so compare driver CLONES separately —
+            # one f"{driver_sid}.{tid}" per driver-ref task on the node
+            tasks_o, secrets_o, configs_o, volumes_o = \
+                oracle_rebuild(store, nid)
+            got_t, got_s, got_c, got_v = v["g"].state()
+            plain = {k: ver for k, ver in got_s.items() if "." not in k}
+            clones = {k for k in got_s if "." in k}
+            assert (got_t, plain, got_c, got_v) \
+                == (tasks_o, secrets_o, configs_o, volumes_o), \
+                f"gated plane diverged from the store oracle on {nid}"
+            expect_clones = store.view(lambda tx: {
+                f"{driver_sid}.{t.id}"
+                for t in tx.find_tasks(by.ByNodeID(nid))
+                if t.status.state >= TaskState.ASSIGNED
+                and t.desired_state <= TaskState.COMPLETE
+                and t.spec.runtime is not None
+                and any(r.secret_id == driver_sid
+                        for r in t.spec.runtime.secrets)})
+            assert clones == expect_clones, \
+                f"driver clone set diverged on {nid}"
+    finally:
+        d_g.stop()
+        d_o.stop()
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_columnar_gate_parity_vs_dict_oracle(seed):
+    try:
+        run_gate_parity(seed)
+    except BaseException:
+        print(f"\nCHAOS_SEED={seed}")
+        raise
+
+
+def test_steady_flush_zero_dict_walks():
+    """THE acceptance op-count guard (ISSUE 16): with plans committed,
+    (a) a quiescent flush takes zero store transactions, (b) a flush
+    whose dirty sessions are ALL soft and zero-delta performs ZERO
+    per-session Python dict walks — one global view-tx, ≤1 dirty walk
+    per shard, nothing shipped — and (c) hard dirt and real changes
+    still take the dict path."""
+    N = 32
+    store = MemoryStore()
+    mk_secret(store, "zsec")
+
+    def seed_tx(tx):
+        for i in range(N):
+            nid = f"z{i:03d}"
+            n = Node(id=nid)
+            n.status.state = NodeStatusState.READY
+            tx.create(n)
+            t = Task(id=f"zt{i:03d}", service_id="svc", node_id=nid,
+                     slot=i + 1)
+            t.status.state = TaskState.RUNNING
+            t.desired_state = TaskState.RUNNING
+            if i % 2 == 0:
+                t.spec.runtime = ContainerSpec(secrets=[SecretReference(
+                    secret_id="zsec", secret_name="zsec")])
+            tx.create(t)
+
+    store.update(seed_tx)
+    d, ch = driven_dispatcher(store, rate_limit_period=-1.0, shards=4)
+    assert d._diffcols is not None
+    try:
+        chans = {}
+        for i in range(N):
+            nid = f"z{i:03d}"
+            sid = d.register(nid)
+            chans[nid] = d.assignments(nid, sid)
+        for ch_a in chans.values():
+            assert ch_a.try_get().type == "complete"
+        pump(d, ch)
+        d._send_incrementals()      # settle registration dirt
+        for ch_a in chans.values():
+            while ch_a.try_get() is not None:
+                pass
+
+        # (a) quiescent: no dirty sessions, no store transaction at all
+        base = dict(store.op_counts)
+        m0 = dict(d.metrics)
+        d._send_incrementals()
+        assert store.op_counts.get("view_tx", 0) \
+            == base.get("view_tx", 0)
+        assert d.metrics["dict_diffs"] == m0["dict_diffs"]
+
+        # (b) all-soft zero-delta storm: the gate proves every session
+        # clean — zero dict walks, zero ships, one global view-tx
+        for i in range(N):
+            d._mark_dirty(f"z{i:03d}", hard=False)
+        base = dict(store.op_counts)
+        m0 = dict(d.metrics)
+        d._send_incrementals()
+        dm = {k: d.metrics[k] - m0[k] for k in
+              ("dict_diffs", "zero_delta_skips", "diff_rows_scanned",
+               "ships", "dirty_walks", "flushes")}
+        assert dm["flushes"] == 1
+        assert dm["dict_diffs"] == 0, \
+            f"steady soft flush walked dicts: {dm}"
+        assert dm["zero_delta_skips"] == N, dm
+        assert dm["diff_rows_scanned"] >= N, dm
+        assert dm["ships"] == 0
+        # an all-skipped flush may do ZERO serve walks — strictly
+        # better than the ≤1-per-shard ceiling
+        assert dm["dirty_walks"] <= d.shards
+        assert store.op_counts["view_tx"] - base.get("view_tx", 0) == 1
+        for ch_a in chans.values():
+            assert ch_a.try_get() is None
+
+        # (c1) hard dirt never skips, even with zero delta
+        d._mark_dirty("z000")           # default hard=True
+        m0 = dict(d.metrics)
+        d._send_incrementals()
+        assert d.metrics["dict_diffs"] - m0["dict_diffs"] == 1
+        assert d.metrics["ships"] == m0["ships"]
+
+        # (c2) a real change through the soft event channel is detected:
+        # rotating the shared secret dict-diffs exactly its referrers
+        cur = store.view(lambda tx: tx.get_secret("zsec")).copy()
+        cur.spec.data = b"v2"
+        store.update(lambda tx: tx.update(cur))
+        pump(d, ch)
+        m0 = dict(d.metrics)
+        d._send_incrementals()
+        refs = N // 2
+        assert d.metrics["dict_diffs"] - m0["dict_diffs"] == refs
+        assert d.metrics["ships"] - m0["ships"] == refs
+        for i in range(N):
+            msg = chans[f"z{i:03d}"].try_get()
+            if i % 2 == 0:
+                assert msg is not None and any(
+                    a.kind == "secret" for a in msg.changes)
+            else:
+                assert msg is None
+    finally:
+        d.stop()
+
+
+def test_pump_mark_order_parity_and_metrics():
+    """Per-shard event pumps (ISSUE 16): a randomized interleaving of
+    marks, bulk marks, discards, reads and clears through the pump
+    plane leaves the dirty/hard sets exactly where IMMEDIATE (single-
+    pump) application would — reads drain first, so no pending op can
+    resurrect a discard — and every appended op is counted by
+    pump_events with per-shard depth gauges populated."""
+    rng = random.Random(11)
+    store = MemoryStore()
+    d, _ch = driven_dispatcher(store, shards=4)
+    try:
+        nids = [f"pm{i:02d}" for i in range(24)]
+        oracle: set = set()
+        oracle_hard: set = set()
+        appended = 0
+        p0 = d.metrics["pump_events"]
+        for _ in range(400):
+            op = rng.random()
+            nid = rng.choice(nids)
+            if op < 0.45:
+                hard = rng.random() < 0.4
+                d._mark_dirty(nid, hard=hard)
+                oracle.add(nid)
+                if hard:
+                    oracle_hard.add(nid)
+                appended += 1
+            elif op < 0.58:
+                bulk = [rng.choice(nids) for _ in range(3)]
+                d._mark_dirty_many(bulk, hard=False)
+                oracle.update(bulk)
+                appended += 3
+            elif op < 0.70:
+                d._dirty_nodes.discard(nid)
+                oracle.discard(nid)
+                oracle_hard.discard(nid)
+            elif op < 0.82:
+                assert (nid in d._dirty_nodes) == (nid in oracle)
+            elif op < 0.94:
+                assert set(d._dirty_nodes) == oracle
+                hard_now = set()
+                for sh in d._shards:       # post-drain, single-threaded
+                    hard_now |= sh.hard
+                assert hard_now == oracle_hard
+            else:
+                d._dirty_nodes.clear()
+                oracle.clear()
+                oracle_hard.clear()
+        assert set(d._dirty_nodes) == oracle
+        assert d.metrics["pump_events"] - p0 == appended, \
+            "pump_events must count every drained mark exactly once"
+        for i in range(4):
+            assert f"pump_depth_shard{i}" in d.metrics
+    finally:
+        d.stop()
+
+
+def test_diff_removal_walk_allocates_no_sets():
+    """Satellite pin (ISSUE 16): the dict `_diff`'s removal detection is
+    single-pass — building the message allocates NO throwaway set()
+    (the old `set(known) - set(new)` per kind). Counted by shadowing
+    the module-global `set` name, which every set() call inside
+    dispatcher.py resolves through."""
+    import builtins
+
+    import swarmkit_tpu.dispatcher.dispatcher as dmod
+
+    store = MemoryStore()
+    mk_node(store, "sp1")
+    mk_secret(store, "spsec")
+    mk_config(store, "spcfg")
+    mk_volume(store, "spvol")
+    t = Task(id="spt", service_id="svc", node_id="sp1", slot=1)
+    t.status.state = TaskState.RUNNING
+    t.desired_state = TaskState.RUNNING
+    t.spec.runtime = ContainerSpec(
+        secrets=[SecretReference(secret_id="spsec", secret_name="spsec")],
+        configs=[ConfigReference(config_id="spcfg", config_name="spcfg")])
+    t.volumes = ["spvol"]
+    store.update(lambda tx: tx.create(t))
+    v = store.view(lambda tx: tx.get_volume("spvol")).copy()
+    v.publish_status = [VolumePublishStatus(node_id="sp1",
+                                            state=PUBLISHED)]
+    store.update(lambda tx: tx.update(v))
+
+    d, ch = driven_dispatcher(store, rate_limit_period=-1.0)
+    try:
+        sid = d.register("sp1")
+        ch_a = d.assignments("sp1", sid)
+        assert ch_a.try_get().type == "complete"
+        session = d._sessions["sp1"]
+        assert session.known_tasks and session.known_secrets \
+            and session.known_configs and session.known_volumes
+
+        calls = [0]
+
+        def counting_set(*a, **k):
+            calls[0] += 1
+            return builtins.set(*a, **k)
+
+        dmod.set = counting_set
+        try:
+            # everything vanished: the diff is ALL removals, the very
+            # walks the satellite de-allocated
+            msg, commit = d._diff(session, [], {}, {}, {}, {},
+                                  builtins.set())
+            assert calls[0] == 0, (
+                "the removal walk materialized a throwaway set")
+            kinds = {(a.action, a.kind) for a in msg.changes}
+            assert kinds == {("remove", "task"), ("remove", "secret"),
+                             ("remove", "config"), ("remove", "volume")}
+            commit()
+            # the commit's known_volumes snapshot is the one legitimate
+            # O(volumes)-per-delivery allocation left
+            assert calls[0] <= 2, calls
+        finally:
+            del dmod.set
+    finally:
+        d.stop()

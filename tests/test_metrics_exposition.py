@@ -80,6 +80,30 @@ def test_dispatcher_plane_counters_all_exposed_with_help():
         d._hb_wheel.stop()
 
 
+def test_diff_plane_counters_exposed_with_help():
+    """ISSUE 16 exposition pin: the columnar diff-gate and event-pump
+    counters are present in the live bag (so the generic walk above
+    exposes them) — named explicitly so a rename or an accidental drop
+    from the bag fails HERE, not just in the bench report."""
+    mod = _load_debugserver()
+    d = Dispatcher(MemoryStore(), heartbeat_period=300.0, shards=2)
+    try:
+        for key in ("diff_rows_scanned", "zero_delta_skips",
+                    "dict_diffs", "pump_events",
+                    "pump_depth_shard0", "pump_depth_shard1"):
+            assert key in d.metrics, \
+                f"diff-plane counter {key!r} missing from the bag"
+        text = mod.component_metrics_text(_StubNode(dispatcher=d))
+        helps = _help_names(text)
+        assert "swarm_dispatcher_plane_total" in helps
+        for key in ("diff_rows_scanned", "zero_delta_skips",
+                    "dict_diffs", "pump_events", "pump_depth_shard0"):
+            assert f'"{key}"' in text, \
+                f"diff-plane counter {key!r} missing from /metrics"
+    finally:
+        d._hb_wheel.stop()
+
+
 def test_raft_storage_fsync_counters_exposed_with_help(tmp_path):
     mod = _load_debugserver()
     storage = RaftStorage(str(tmp_path))
