@@ -2486,6 +2486,97 @@ def bench_orchestrator_storm(np, n_services=100_000, replicas=2,
     }
 
 
+def bench_recovery_plane(np, n_tasks=100_000):
+    """Recovery-at-scale row (ISSUE 18): restoring a 100k-task snapshot
+    into a fresh store with the versioned columnar section (array
+    ADOPTION) vs the same snapshot stripped of it (the pre-18 shape:
+    object restore + ColumnarTasks.rebuild's O(objects) upsert walk).
+    Also reports the snapshot-stream framing the resumable catch-up
+    plane would ship it with (chunks at SNAPSHOT_CHUNK_BYTES). Parity:
+    the adopted mirror's canonical snapshot is bit-equal to the rebuild
+    oracle's, and the op-count path markers confirm which leg ran."""
+    from swarmkit_tpu.api.objects import Node, Task
+    from swarmkit_tpu.api.types import NodeStatusState, TaskState
+    from swarmkit_tpu.raft.node import SNAPSHOT_CHUNK_BYTES
+    from swarmkit_tpu.rpc import codec
+    from swarmkit_tpu.store.columnar import ColumnarTasks
+    from swarmkit_tpu.store.memory import MemoryStore
+
+    N_NODES = 64
+    store = MemoryStore()
+
+    def seed_nodes(tx):
+        for i in range(N_NODES):
+            node = Node(id=f"rp{i:03d}")
+            node.status.state = NodeStatusState.READY
+            tx.create(node)
+    store.update(seed_nodes)
+
+    def seed_tasks(tx):
+        for i in range(n_tasks):
+            t = Task(id=f"t{i:07d}", service_id=f"svc{i % 100}",
+                     slot=i + 1)
+            t.status.state = TaskState.PENDING
+            t.desired_state = TaskState.RUNNING
+            tx.create(t)
+    store.update(seed_tasks)
+    store.assign_wave([(f"t{i:07d}", f"rp{i % N_NODES:03d}")
+                       for i in range(n_tasks)])
+
+    t0 = time.perf_counter()
+    snap = store.save()
+    save_s = time.perf_counter() - t0
+    blob = codec.dumps(snap)
+    n_chunks = max(1, -(-len(blob) // SNAPSHOT_CHUNK_BYTES))
+
+    t0 = time.perf_counter()
+    adopted = MemoryStore()
+    adopted.restore(snap)
+    adopt_s = time.perf_counter() - t0
+
+    legacy_snap = {k: v for k, v in snap.items() if k != "__columnar__"}
+    t0 = time.perf_counter()
+    rebuilt_store = MemoryStore()
+    rebuilt_store.restore(legacy_snap)
+    rebuild_s = time.perf_counter() - t0
+
+    parity = (adopted.op_counts.get("restore_columnar_adopted") == 1
+              and rebuilt_store.op_counts.get(
+                  "restore_columnar_rebuilt") == 1)
+    # the isolated LEG comparison: the adoption call vs the rebuild walk
+    # it replaces, over the same restored object tables
+    tasks = adopted.view(lambda tx: tx.find_tasks())
+    services = adopted.view(lambda tx: tx.find_services())
+    nodes = adopted.view(lambda tx: tx.find_nodes())
+    t0 = time.perf_counter()
+    oracle = ColumnarTasks.rebuild(tasks, services=services, nodes=nodes)
+    leg_rebuild_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    leg_adopted = ColumnarTasks.adopt(snap["__columnar__"], tasks,
+                                      services=services, nodes=nodes)
+    leg_adopt_s = time.perf_counter() - t0
+    parity = parity and leg_adopted is not None
+    parity = parity and ColumnarTasks.snapshots_equal(
+        adopted.columnar.snapshot(), oracle.snapshot())
+    parity = parity and ColumnarTasks.snapshots_equal(
+        adopted.columnar.snapshot(), rebuilt_store.columnar.snapshot())
+
+    return {
+        "tasks": n_tasks,
+        "save_s": round(save_s, 4),
+        "snapshot_bytes": len(blob),
+        "stream_chunks": n_chunks,
+        "restore_adopt_s": round(adopt_s, 4),
+        "restore_rebuild_s": round(rebuild_s, 4),
+        "restore_speedup_x": round(rebuild_s / max(adopt_s, 1e-9), 2),
+        "leg_rebuild_s": round(leg_rebuild_s, 4),
+        "leg_adopt_s": round(leg_adopt_s, 4),
+        "columnar_leg_speedup_x": round(
+            leg_rebuild_s / max(leg_adopt_s, 1e-9), 2),
+        "parity": parity,
+    }
+
+
 def bench_host_micro(np):
     """The BASELINE.md harness rows the reference ships benchmarks for
     but no numbers (store ops memory_test.go:2028-2120, watch queue at
@@ -2834,6 +2925,10 @@ def main():
         # the shared wave planner (one thread, auto-rollback share),
         # and the disarmed-plane zero-alloc contract
         ("orchestrator_storm", lambda: bench_orchestrator_storm(np)),
+        # ISSUE 18: recovery plane — columnar-adoption restore vs the
+        # object-walk rebuild at 100k tasks, plus the stream framing
+        # the resumable snapshot catch-up ships the same blob with
+        ("recovery_restore_100k", lambda: bench_recovery_plane(np)),
     ]
     configs = {name: _run_row(name, thunk) for name, thunk in rows}
     ns = configs["grid_100k_x_10k"]   # the north star IS this grid config

@@ -764,6 +764,14 @@ def main(argv=None) -> int:
             except Exception as exc:
                 report["telemetry"] = {"armed": False,
                                        "error": repr(exc)}
+            # recovery plane (ISSUE 18): lift the manager's snapshot
+            # catch-up counters out of the rollup so a bench run shows
+            # resume behavior (chunks resent vs sent, installs) at a
+            # glance without digging through the telemetry artifact
+            rec = (report.get("telemetry", {}).get("manager", {})
+                   .get("raft", {}).get("recovery"))
+            if rec:
+                report["recovery_plane"] = rec
         print(json.dumps(report))
         ok = report.get("slo", {}).get("ok", True)
         if not args.churn:

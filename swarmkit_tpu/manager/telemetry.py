@@ -256,6 +256,19 @@ class TelemetryAggregator:
                     max(0.0, self.clock.monotonic() - contact), 3)
             out.setdefault("raft", {})["read_lease"] = lease
             out["raft"]["commit_index"] = getattr(raft, "commit_index", 0)
+            if hasattr(raft, "snap_chunks_sent"):
+                # recovery plane (ISSUE 18): snapshot catch-up counters
+                # join the rollup so swarmbench/swarmctl surface resume
+                # behavior without scraping per-node /metrics
+                out["raft"]["recovery"] = {
+                    "snap_chunks_sent": raft.snap_chunks_sent,
+                    "snap_chunks_resent": raft.snap_chunks_resent,
+                    "snap_resume_suffix": raft.snap_resume_suffix,
+                    "snap_chunks_rejected": raft.snap_chunks_rejected,
+                    "snap_installs": raft.snap_installs,
+                    "snap_install_seconds": round(
+                        raft.snap_install_seconds, 6),
+                }
         op_counts = getattr(self.store, "op_counts", None)
         if op_counts:
             out["store_ops"] = dict(op_counts)

@@ -43,7 +43,7 @@ from ..ca import (
     parse_join_token,
 )
 from ..manager.manager import Manager
-from ..raft.node import Peer, RaftNode
+from ..raft.node import SNAPSHOT_RESEND_TICKS, Peer, RaftNode
 from ..raft.proposer import RaftProposer
 from ..raft.storage import RaftStorage, new_dek
 from ..raft.transport import NetworkTransport
@@ -696,6 +696,12 @@ class SwarmNode:
             # the follower discounts a further skew margin on receipt
             lease_duration=self.tick_interval * self.election_tick * 0.75,
             clock=self.clock,
+            # snapshot resend deadline in the daemon's own tick units
+            # (ISSUE 18): the historical SNAPSHOT_RESEND_TICKS cadence,
+            # clock-based so the deadline rides self.clock (FakeClock in
+            # the deterministic tiers)
+            snapshot_resend_seconds=(self.tick_interval
+                                     * SNAPSHOT_RESEND_TICKS),
         )
         transport.set_node(raft)
         self._transport = transport

@@ -181,6 +181,30 @@ def component_metrics_text(node) -> str:
             "hardstate/membership/snapshot/dir fsyncs on this node",
             "counter",
             [f"swarm_raft_meta_fsyncs_total {storage.meta_fsyncs}"])
+    raft = _find(node, "raft")
+    if raft is not None and hasattr(raft, "snap_chunks_sent"):
+        # recovery plane (ISSUE 18): exposed generically off the live
+        # snap_* counter surface so a new recovery counter appears here
+        # WITHOUT a hand edit (the exposition drift guard walks it)
+        ints, floats = [], []
+        for key in sorted(a for a in vars(raft) if a.startswith("snap_")
+                          and a != "snap_stream_max_bytes"):  # config knob
+            v = getattr(raft, key)
+            lbl = _escape_label_value(key)
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            if isinstance(v, int):
+                ints.append(f'swarm_raft_recovery_total'
+                            f'{{counter="{lbl}"}} {v}')
+            else:
+                floats.append(f'swarm_raft_recovery_seconds'
+                              f'{{stat="{lbl}"}} {v}')
+        fam("swarm_raft_recovery_total",
+            "raft recovery plane counters (snapshot chunks sent/resent/"
+            "rejected, suffix resumes, installs)", "counter", ints)
+        fam("swarm_raft_recovery_seconds",
+            "raft recovery plane timings (cumulative snapshot install "
+            "seconds)", "counter", floats)
     op_counts = getattr(_find(node, "store"), "op_counts", None)
     if op_counts:
         fam("swarm_store_ops_total",

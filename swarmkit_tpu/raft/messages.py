@@ -141,6 +141,20 @@ class SnapshotChunk(Message):
 
 
 @dataclass
+class SnapshotAck(Message):
+    """Follower → leader progress report for a streamed snapshot
+    (recovery plane, ISSUE 18): `acked` is the highest CONTIGUOUS chunk
+    seq the follower holds for `snapshot_index`. The leader re-arms the
+    resend deadline on progress and, on expiry, re-sends ONLY the
+    suffix past `acked` — never the whole blob. Ack loss is harmless:
+    the state is monotone and the next chunk re-acks."""
+
+    snapshot_index: int = 0
+    acked: int = -1
+    kind: str = "snap_ack"
+
+
+@dataclass
 class TimeoutNow(Message):
     """Leadership transfer (raft §3.10 / etcd MsgTimeoutNow): the leader
     tells its most caught-up peer to campaign immediately; the new term

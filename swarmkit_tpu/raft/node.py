@@ -27,7 +27,8 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable
 
-from ..utils import trace
+from ..utils import failpoints, trace
+from ..utils.metrics import counter_family
 
 from .messages import (
     ENTRY_CONF_CHANGE,
@@ -39,6 +40,7 @@ from .messages import (
     ConfChange,
     Entry,
     InstallSnapshot,
+    SnapshotAck,
     SnapshotChunk,
     VoteRequest,
     VoteResponse,
@@ -65,8 +67,27 @@ MAX_INFLIGHT_APPENDS = 256
 # large messages instead of one oversized gRPC frame)
 SNAPSHOT_CHUNK_BYTES = 256 * 1024
 # ticks before an unacked streamed snapshot is re-sent (the follower may
-# have lost chunks; until then the peer is paused, not re-blasted)
+# have lost chunks; until then the peer is paused, not re-blasted).
+# Kept for back-compat derivation; the live deadline is CLOCK-based
+# (SNAPSHOT_RESEND_SECONDS / the snapshot_resend_seconds ctor param) so
+# chunk-loss schedules replay exactly under a FakeClock (ISSUE 18).
 SNAPSHOT_RESEND_TICKS = 50
+# seconds before an unacked streamed snapshot suffix is re-sent — the
+# tick-count constant above at the daemon's 0.2 s tick cadence
+SNAPSHOT_RESEND_SECONDS = SNAPSHOT_RESEND_TICKS * 0.2
+# follower-side reassembly cap: a stream whose DECLARED size
+# (total × chunk bytes) exceeds this is rejected outright — a buggy or
+# deposed leader must not balloon follower memory with orphan chunk maps
+SNAPSHOT_STREAM_MAX_BYTES = 1 << 30
+
+# recovery-plane event counters (ISSUE 18): process-global family so the
+# events ride registry_snapshot() into the PR 15 telemetry rollup; exact
+# per-node assertions use the RaftNode snap_* ints instead
+_snap_events = counter_family(
+    "swarm_raft_snapshot_total",
+    "streamed-snapshot recovery events (chunk sent/resent, suffix "
+    "resume, chunk rejected, install)",
+    ("event",))
 # wedge-triggered leadership transfers are rate limited (reference
 # raft.go:569-604 caps transfers at one per minute). Expressed in ticks
 # so the deterministic fake-clock harness can drive expiry; at the
@@ -99,6 +120,19 @@ class Peer:
     addr: str
 
 
+@dataclass
+class _SnapPending:
+    """Leader-side progress of one streamed snapshot install (etcd
+    ProgressStateSnapshot analogue, resumable): `acked` is the highest
+    CONTIGUOUS chunk seq the follower reported via SnapshotAck, and
+    `deadline` (clock.monotonic seconds) is when an unacked stream gets
+    its missing SUFFIX re-sent — never the whole blob."""
+
+    snap_idx: int
+    deadline: float
+    acked: int = -1
+
+
 class RaftNode:
     def __init__(
         self,
@@ -116,6 +150,8 @@ class RaftNode:
         auto_recover: bool = True,
         lease_duration: float = 0.0,
         clock=None,
+        snapshot_resend_seconds: float = SNAPSHOT_RESEND_SECONDS,
+        snap_stream_max_bytes: int = SNAPSHOT_STREAM_MAX_BYTES,
     ):
         self.id = raft_id
         self.transport = transport
@@ -222,13 +258,27 @@ class RaftNode:
         self.pre_vote = True
         self._pre_votes: set[int] | None = None
 
-        # streamed-snapshot pause state: peer -> (snapshot_index, ttl);
-        # while set, data appends to that peer are withheld (heartbeats
-        # still flow) and stale failure hints ignored (etcd
-        # ProgressStateSnapshot analogue)
-        self._snap_pending: dict[int, tuple[int, int]] = {}
+        # streamed-snapshot pause state: peer -> _SnapPending; while set,
+        # data appends to that peer are withheld (heartbeats still flow)
+        # and stale failure hints ignored (etcd ProgressStateSnapshot
+        # analogue). The resend deadline is CLOCK-based so chunk-loss
+        # schedules replay deterministically under a FakeClock.
+        self.snapshot_resend_seconds = snapshot_resend_seconds
+        self.snap_stream_max_bytes = snap_stream_max_bytes
+        self._snap_pending: dict[int, _SnapPending] = {}
         # follower-side chunk reassembly: (frm, snapshot_index) -> {seq: bytes}
         self._snap_chunks: dict[tuple[int, int], dict[int, bytes]] = {}
+        # highest CONTIGUOUS seq held per reassembly buffer — what the
+        # follower acks; pruned in lockstep with _snap_chunks
+        self._snap_contig: dict[tuple[int, int], int] = {}
+        # recovery-plane observability (worker-thread ints; status() and
+        # the debugserver expose them, tests assert on them exactly)
+        self.snap_chunks_sent = 0
+        self.snap_chunks_resent = 0
+        self.snap_resume_suffix = 0
+        self.snap_chunks_rejected = 0
+        self.snap_installs = 0
+        self.snap_install_seconds = 0.0
         # per-peer count of unacked append messages — the pipelining
         # window; reset on rewind, decremented per response
         self._inflight: dict[int, int] = {}
@@ -642,12 +692,15 @@ class RaftNode:
             if self.heartbeat_elapsed >= self.heartbeat_tick:
                 self.heartbeat_elapsed = 0
                 self._mark_broadcast()
-            # expire paused streamed snapshots so lost chunks get re-sent
-            for peer_id, (snap_idx, ttl) in list(self._snap_pending.items()):
-                if ttl <= 1:
-                    self._snap_pending.pop(peer_id, None)
-                else:
-                    self._snap_pending[peer_id] = (snap_idx, ttl - 1)
+            # expire paused streamed snapshots so lost chunks get
+            # re-sent — clock-deadline based (FakeClock-deterministic),
+            # and a resume re-sends ONLY the suffix past the follower's
+            # acked contiguous prefix, never the whole blob
+            if self._snap_pending:
+                now = self.clock.monotonic()
+                for peer_id, pending in list(self._snap_pending.items()):
+                    if now >= pending.deadline:
+                        self._resend_snapshot_suffix(peer_id, pending, now)
             if self.check_quorum:
                 self._quorum_elapsed += 1
                 if self._quorum_elapsed >= self.election_tick:
@@ -773,8 +826,9 @@ class RaftNode:
             self.voted_for = None
             self._persist_hard_state()
             # a partial snapshot stream from a deposed leader is dead;
-            # drop its reassembly buffers
+            # drop its reassembly buffers (and their ack watermarks)
             self._snap_chunks.clear()
+            self._snap_contig.clear()
         self.role = FOLLOWER
         self.leader_id = leader_id
         self.election_elapsed = 0
@@ -829,6 +883,7 @@ class RaftNode:
             "append_resp": self._on_append_response,
             "snapshot": self._on_install_snapshot,
             "snap_chunk": self._on_snapshot_chunk,
+            "snap_ack": self._on_snapshot_ack,
             "timeout_now": self._on_timeout_now,
         }.get(msg.kind)
         if handler:
@@ -974,9 +1029,7 @@ class RaftNode:
         if self._snap_chunks:
             # appends caught us up past a partially-streamed snapshot
             # (its sender died mid-stream): the buffers are garbage now
-            last = self._last_index()
-            self._snap_chunks = {
-                k: v for k, v in self._snap_chunks.items() if k[1] > last}
+            self._prune_snap_buffers(self._last_index())
 
         self._send(AppendResponse(frm=self.id, to=msg.frm, term=self.term,
                                   success=True,
@@ -1007,7 +1060,7 @@ class RaftNode:
                 self.next_index.get(msg.frm, 1),
                 self.match_index[msg.frm] + 1)
             pending = self._snap_pending.get(msg.frm)
-            if pending is not None and msg.match_index >= pending[0]:
+            if pending is not None and msg.match_index >= pending.snap_idx:
                 self._snap_pending.pop(msg.frm, None)  # install acked
             # commit advance runs once at the flush, over the whole
             # batch of acks; refill the pipeline window opened by this
@@ -1041,8 +1094,17 @@ class RaftNode:
     def _on_snapshot_chunk(self, msg):
         """Reassemble a streamed snapshot; apply when complete. Every chunk
         counts as leader contact (the follower must not campaign while a
-        multi-second install is in flight)."""
+        multi-second install is in flight). Resumable (ISSUE 18): chunks
+        are byte-identical per snapshot_index (leader-side _snap_blob
+        cache), so the buffer is filled idempotently — dup/reorder are
+        no-ops, a suffix resend fills holes without losing the prefix —
+        and every chunk is answered with a SnapshotAck carrying the
+        highest CONTIGUOUS seq held."""
         if msg.term < self.term:
+            return
+        if failpoints.fp_value("raft.snap.chunk_drop", False):
+            # injected chunk loss (docs/fault_injection.md): the chunk
+            # never existed as far as this follower is concerned
             return
         self.role = FOLLOWER
         self.leader_id = msg.frm
@@ -1053,30 +1115,114 @@ class RaftNode:
                 frm=self.id, to=msg.frm, term=self.term, success=True,
                 match_index=self._last_index()))
             return
+        if (msg.total <= 0 or not 0 <= msg.seq < msg.total
+                or len(msg.chunk) > SNAPSHOT_CHUNK_BYTES
+                or msg.total * SNAPSHOT_CHUNK_BYTES
+                > self.snap_stream_max_bytes):
+            # reassembly cap / malformed framing: a buggy or deposed
+            # leader must not balloon this follower's memory
+            self.snap_chunks_rejected += 1
+            _snap_events.inc(("chunk_rejected",))
+            return
         key = (msg.frm, msg.snapshot_index)
+        if key not in self._snap_chunks:
+            for k in [k for k in self._snap_chunks if k[0] == msg.frm]:
+                if k[1] > msg.snapshot_index:
+                    # a late chunk of a stream this sender already
+                    # abandoned for a newer snapshot: ignore it
+                    return
+                # eager orphan eviction: at most ONE live buffer per
+                # sender — the newer stream supersedes the older one
+                self._snap_chunks.pop(k, None)
+                self._snap_contig.pop(k, None)
         buf = self._snap_chunks.setdefault(key, {})
-        if msg.seq == 0:
-            # start of a (re-)stream: per-peer delivery is ordered, so any
-            # buffered chunks are from an abandoned earlier stream
-            buf.clear()
         buf[msg.seq] = msg.chunk
+        c = self._snap_contig.get(key, -1)
+        while c + 1 in buf:
+            c += 1
+        self._snap_contig[key] = c
+        # progress report: the leader re-arms its resend deadline on
+        # advance and, on expiry, re-sends only chunks past `acked`
+        self._send(SnapshotAck(
+            frm=self.id, to=msg.frm, term=self.term,
+            snapshot_index=msg.snapshot_index, acked=c))
         if len(buf) < msg.total:
             return
         from ..rpc import codec
 
         data = codec.loads(b"".join(buf[i] for i in range(msg.total)))
         # drop every reassembly buffer for this or older snapshots
-        self._snap_chunks = {
-            k: v for k, v in self._snap_chunks.items()
-            if k[1] > msg.snapshot_index}
+        self._prune_snap_buffers(msg.snapshot_index)
         self._install_snapshot(msg.frm, msg.snapshot_index,
                                msg.snapshot_term, msg.members, data,
                                removed=msg.removed)
+
+    def _on_snapshot_ack(self, msg):
+        """Leader side of the resumable stream: record the follower's
+        contiguous-prefix watermark and push the resend deadline out —
+        a live, progressing stream is never re-blasted."""
+        if self.role != LEADER or msg.term != self.term:
+            return
+        self._recent_active.add(msg.frm)  # CheckQuorum lease contact
+        pending = self._snap_pending.get(msg.frm)
+        if pending is None or pending.snap_idx != msg.snapshot_index:
+            return
+        if msg.acked > pending.acked:
+            pending.acked = msg.acked
+            pending.deadline = (self.clock.monotonic()
+                                + self.snapshot_resend_seconds)
+
+    def _resend_snapshot_suffix(self, peer_id: int, pending: _SnapPending,
+                                now: float):
+        """Resend deadline expired: re-send ONLY the chunks past the
+        follower's acked contiguous prefix. If the snapshot advanced (or
+        the blob cache no longer covers it) the stream is abandoned and
+        the ordinary append path starts a fresh one."""
+        if pending.snap_idx != self.snapshot_index \
+                or self._snap_blob is None \
+                or self._snap_blob[0] != pending.snap_idx:
+            self._snap_pending.pop(peer_id, None)
+            self._mark_append(peer_id, allow_empty=False)
+            return
+        blob = self._snap_blob[1]
+        chunks = [blob[i:i + SNAPSHOT_CHUNK_BYTES]
+                  for i in range(0, len(blob), SNAPSHOT_CHUNK_BYTES)] or [b""]
+        # the min(..., total-1) floor guarantees at least one chunk goes
+        # out even when every chunk was acked — that re-ack carries the
+        # AppendResponse a lost install-ack deprived us of
+        start = min(pending.acked + 1, len(chunks) - 1)
+        members = {rid: (p.node_id, p.addr)
+                   for rid, p in self.members.items()}
+        removed = sorted(self.removed_ids)
+        for seq in range(start, len(chunks)):
+            self._send(SnapshotChunk(
+                frm=self.id, to=peer_id, term=self.term,
+                snapshot_index=pending.snap_idx,
+                snapshot_term=self.snapshot_term,
+                members=members, removed=removed,
+                seq=seq, total=len(chunks), chunk=chunks[seq],
+            ))
+        resent = len(chunks) - start
+        self.snap_chunks_resent += resent
+        self.snap_resume_suffix += 1
+        _snap_events.inc(("chunk_resent",), resent)
+        _snap_events.inc(("suffix_resume",))
+        pending.deadline = now + self.snapshot_resend_seconds
+
+    def _prune_snap_buffers(self, upto_index: int):
+        """Drop reassembly buffers (and their ack watermarks) for
+        snapshots at or below `upto_index` — they are covered by state
+        this node already holds."""
+        self._snap_chunks = {
+            k: v for k, v in self._snap_chunks.items() if k[1] > upto_index}
+        self._snap_contig = {
+            k: v for k, v in self._snap_contig.items() if k[1] > upto_index}
 
     def _install_snapshot(self, frm: int, snapshot_index: int,
                           snapshot_term: int, members, data, removed=()):
         if snapshot_index <= self.snapshot_index:
             return
+        _t0 = time.perf_counter()
         self.snapshot_index = snapshot_index
         self.snapshot_term = snapshot_term
         self.log = []
@@ -1084,11 +1230,17 @@ class RaftNode:
         # entries staged for this flush are covered (or superseded) by the
         # snapshot — and so is any divergent persisted tail BEYOND it,
         # which a later restart would otherwise splice after the snapshot
-        # (the install replaced the whole log, the WAL must follow)
+        # (the install replaced the whole log, the WAL must follow).
+        # ORDER is crash-safety: the WAL truncate runs BEFORE the new
+        # snapshot is saved, so a crash anywhere in the window leaves
+        # old-snapshot + a (possibly truncated) consistent prefix — never
+        # new-snapshot + a divergent old tail. The failpoint below sits
+        # mid-window so tests can pin exactly that.
         self._ready_entries = [e for e in self._ready_entries
                                if e.index > snapshot_index]
         if self.storage is not None:
             self.storage.truncate_from(snapshot_index + 1)
+        failpoints.fp("raft.snap.install")
         self.commit_index = max(self.commit_index, snapshot_index)
         self.last_applied = snapshot_index
         self.members = {
@@ -1107,6 +1259,9 @@ class RaftNode:
             # snapshot's member list, so a stale file would resurrect a
             # pre-snapshot membership on restart
             self.storage.save_membership(self.members, self.removed_ids)
+        self.snap_installs += 1
+        self.snap_install_seconds += time.perf_counter() - _t0
+        _snap_events.inc(("install",))
         self._send(AppendResponse(frm=self.id, to=frm, term=self.term,
                                   success=True, match_index=snapshot_index))
 
@@ -1272,8 +1427,11 @@ class RaftNode:
                 members=members, removed=removed,
                 seq=seq, total=len(chunks), chunk=part,
             ))
-        self._snap_pending[peer_id] = (self.snapshot_index,
-                                       SNAPSHOT_RESEND_TICKS)
+        self.snap_chunks_sent += len(chunks)
+        _snap_events.inc(("chunk_sent",), len(chunks))
+        self._snap_pending[peer_id] = _SnapPending(
+            snap_idx=self.snapshot_index,
+            deadline=self.clock.monotonic() + self.snapshot_resend_seconds)
         self.next_index[peer_id] = self.snapshot_index + 1
 
     def _maybe_advance_commit(self):
@@ -1552,4 +1710,13 @@ class RaftNode:
             # read-lease plane (ISSUE 13): may this node serve
             # lease-gated reads, and under which grant
             "read_lease": self.read_lease(),
+            # recovery plane (ISSUE 18): streamed-snapshot progress —
+            # resent/resume stay near zero on a healthy network; installs
+            # and their wall time size the catch-up path
+            "snap_chunks_sent": self.snap_chunks_sent,
+            "snap_chunks_resent": self.snap_chunks_resent,
+            "snap_resume_suffix": self.snap_resume_suffix,
+            "snap_chunks_rejected": self.snap_chunks_rejected,
+            "snap_installs": self.snap_installs,
+            "snap_install_seconds": self.snap_install_seconds,
         }

@@ -13,6 +13,7 @@ import threading
 from collections import defaultdict
 
 from ..analysis.lockgraph import make_lock
+from ..utils.clock import FakeClock
 from .node import Peer, RaftNode
 
 
@@ -77,12 +78,21 @@ class TransportHandle:
 class RaftCluster:
     """N in-process raft nodes on a memory transport with a manual clock."""
 
+    # seconds of fake time one tick_all round represents — the daemon's
+    # tick cadence, so clock-deadline behavior (snapshot resend TTLs)
+    # expires after the same tick counts the old tick-counted code did
+    TICK_SECONDS = 0.2
+
     def __init__(self, n: int, storages: dict[int, object] | None = None,
                  apply_cbs: dict[int, object] | None = None,
                  snapshot_interval: int = 1000, seed: int = 7,
                  lease_duration: float = 0.0, clock=None):
         self.router = MemoryTransport()
         self.nodes: dict[int, RaftNode] = {}
+        # one SHARED fake clock, advanced by tick_all: every clock-based
+        # deadline in the node (snapshot resend, lease anchors) is then
+        # seed-deterministic — no wall-time dependence in the harness
+        self.clock = clock if clock is not None else FakeClock()
         peers = [Peer(i, f"node-{i}", f"mem://{i}") for i in range(1, n + 1)]
         for i in range(1, n + 1):
             node = RaftNode(
@@ -93,7 +103,7 @@ class RaftCluster:
                 snapshot_interval=snapshot_interval,
                 rng=random.Random(seed + i),
                 lease_duration=lease_duration,
-                clock=clock,
+                clock=self.clock,
             )
             node.bootstrap(peers)
             self.router.register(node)
@@ -117,6 +127,13 @@ class RaftCluster:
 
     def tick_all(self, n: int = 1):
         for _ in range(n):
+            # advance the shared fake clock in step with the tick so
+            # clock-deadline expiries (snapshot resends) stay aligned
+            # with tick counts; an externally supplied clock without
+            # advance() (e.g. REAL_CLOCK) is left alone
+            advance = getattr(self.clock, "advance", None)
+            if advance is not None:
+                advance(self.TICK_SECONDS)
             for node in self.nodes.values():
                 node.tick()
             self.settle()

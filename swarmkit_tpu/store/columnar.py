@@ -61,6 +61,45 @@ _GROW = 1024
 COLUMNS = ("state", "desired", "version", "node_idx", "service_idx", "slot",
            "spec_version")
 
+# version tag of the optional dense-column snapshot section (ISSUE 18):
+# adopt() refuses anything else and the restore path falls back to
+# rebuild(), so old snapshots (no section) and future formats both load
+COLUMNAR_SECTION_VERSION = 1
+
+
+def _enc(arr: np.ndarray) -> dict:
+    """Codec-safe dense array: dtype string + raw bytes (the rpc codec
+    has no numpy handler, and raw bytes round-trip cheaper anyway)."""
+    return {"d": arr.dtype.str, "b": arr.tobytes()}
+
+
+def _dec(obj, want_dtype, want_len: int):
+    """Decode an _enc payload; None unless it is exactly the dtype and
+    length the adopting mirror requires (adopt() treats None as a
+    malformed section and falls back to rebuild)."""
+    if not isinstance(obj, dict) or "d" not in obj or "b" not in obj:
+        return None
+    try:
+        arr = np.frombuffer(obj["b"], dtype=np.dtype(obj["d"]))
+    except (TypeError, ValueError):
+        return None
+    if arr.dtype != np.dtype(want_dtype) or arr.shape[0] != want_len:
+        return None
+    return arr.copy()  # frombuffer is read-only; columns must be writable
+
+
+def _revocab(names) -> "IdVocab | None":
+    """Rebuild an IdVocab from its serialized name list (id 0 must be
+    the reserved empty string; duplicates would corrupt lookups)."""
+    if not isinstance(names, list) or not names or names[0] != "":
+        return None
+    v = IdVocab()
+    for s in names[1:]:
+        v.intern(s)
+    if len(v) != len(names):
+        return None  # duplicate names collapsed: section is corrupt
+    return v
+
 
 def _grow_columns(owner, cols, need: int) -> None:
     """Shared capacity growth for every column mirror: double (or step
@@ -486,6 +525,135 @@ class ColumnarTasks:
             col.secret_cols.upsert(s)
         for c in sorted(configs, key=lambda c: c.id):
             col.config_cols.upsert(c)
+        return col
+
+    # ------------------------------------------- snapshot section (ISSUE 18)
+    def to_snapshot_section(self) -> dict:
+        """Serialize the LIVE column layout (row order, free rows, vocab
+        ids intact) as a versioned, codec-safe dict — the optional
+        `__columnar__` section MemoryStore.save() embeds so restore()
+        can rebuild the hot mirrors by array ADOPTION instead of
+        rebuild()'s O(objects) upsert walk. Must be called under the
+        store lock (the commit path is the only other column writer);
+        tobytes() copies, so the section is immune to later commits."""
+        n = len(self.ids)
+        sc, nc = self.service_cols, self.node_cols
+        n_s, n_n = len(self.services), len(self.nodes)
+        sec = {
+            "v": COLUMNAR_SECTION_VERSION,
+            "ids": list(self.ids),                 # None = freed row
+            "nodes_vocab": list(self.nodes.names),
+            "services_vocab": list(self.services.names),
+            "tasks": {name: _enc(getattr(self, name)[:n])
+                      for name in self._COLS},
+            "service_cols": {name: _enc(getattr(sc, name)[:n_s])
+                             for name in ColumnarServices._COLS},
+            "node_cols": {name: _enc(getattr(nc, name)[:n_n])
+                          for name in ColumnarNodes._COLS},
+        }
+        for key, dep in (("secret_cols", self.secret_cols),
+                         ("config_cols", self.config_cols)):
+            n_d = len(dep.vocab)
+            sec[key] = {
+                "vocab": list(dep.vocab.names),
+                "cols": {name: _enc(getattr(dep, name)[:n_d])
+                         for name in ColumnarDeps._COLS},
+            }
+        return sec
+
+    @classmethod
+    def adopt(cls, section, tasks: list, services: list = (),
+              nodes: list = (), secrets: list = (),
+              configs: list = ()) -> "ColumnarTasks | None":
+        """Reconstruct a mirror from a to_snapshot_section() payload by
+        array adoption, validated against the freshly restored object
+        tables. Returns None on ANY inconsistency — unknown version,
+        dtype/length drift, id-set mismatch vs the task table, version
+        column disagreeing with the objects, vocab not covering an index
+        — and the caller falls back to rebuild(). The parity bar: an
+        adopted mirror's snapshot() is bit-equal to rebuild()'s."""
+        if not isinstance(section, dict) \
+                or section.get("v") != COLUMNAR_SECTION_VERSION:
+            return None
+        ids = section.get("ids")
+        if not isinstance(ids, list) or not all(
+                tid is None or isinstance(tid, str) for tid in ids):
+            return None
+        live = [tid for tid in ids if tid is not None]
+        by_id = {t.id: t for t in tasks}
+        if len(live) != len(set(live)) or set(live) != set(by_id):
+            return None
+        nv = _revocab(section.get("nodes_vocab"))
+        sv = _revocab(section.get("services_vocab"))
+        if nv is None or sv is None:
+            return None
+        n = len(ids)
+        col = cls(cap=max(n, 1))
+        tcols = section.get("tasks")
+        if not isinstance(tcols, dict):
+            return None
+        for name in cls._COLS:
+            arr = _dec(tcols.get(name), getattr(col, name).dtype, n)
+            if arr is None:
+                return None
+            if n == 0:
+                continue  # keep the constructor's 1-row zero capacity
+            setattr(col, name, arr)
+        col.ids = list(ids)
+        col._row = {tid: r for r, tid in enumerate(ids) if tid is not None}
+        col._free = [r for r, tid in enumerate(ids) if tid is None]
+        col.nodes, col.services = nv, sv
+        # cross-checks against the restored object tables: the live rows
+        # must be valid, reference in-vocab ids, and carry each object's
+        # exact version — a stale or torn section must never adopt
+        rows = np.fromiter(col._row.values(), np.int64, len(col._row))
+        if rows.size:
+            if not col.valid[rows].all():
+                return None
+            if int(col.node_idx[rows].max(initial=0)) >= len(nv) \
+                    or int(col.service_idx[rows].max(initial=0)) >= len(sv):
+                return None
+            versions = np.fromiter(
+                (by_id[tid].meta.version.index for tid in col._row),
+                np.int64, len(col._row))
+            if not np.array_equal(col.version[rows], versions):
+                return None
+        freed = np.fromiter(col._free, np.int64, len(col._free))
+        if freed.size and col.valid[freed].any():
+            return None
+        # sub-mirrors: columns sized exactly to their vocab
+        col.service_cols = ColumnarServices(sv, cap=len(sv))
+        col.node_cols = ColumnarNodes(nv, cap=len(nv))
+        for owner, key, n_rows in (
+                (col.service_cols, "service_cols", len(sv)),
+                (col.node_cols, "node_cols", len(nv))):
+            cols = section.get(key)
+            if not isinstance(cols, dict):
+                return None
+            for name in owner._COLS:
+                arr = _dec(cols.get(name), getattr(owner, name).dtype,
+                           n_rows)
+                if arr is None:
+                    return None
+                setattr(owner, name, arr)
+        for key, attr in (("secret_cols", "secret_cols"),
+                          ("config_cols", "config_cols")):
+            payload = section.get(key)
+            if not isinstance(payload, dict):
+                return None
+            dv = _revocab(payload.get("vocab"))
+            cols = payload.get("cols")
+            if dv is None or not isinstance(cols, dict):
+                return None
+            dep = ColumnarDeps(cap=len(dv))
+            dep.vocab = dv
+            for name in ColumnarDeps._COLS:
+                arr = _dec(cols.get(name), getattr(dep, name).dtype,
+                           len(dv))
+                if arr is None:
+                    return None
+                setattr(dep, name, arr)
+            setattr(col, attr, dep)
         return col
 
     @staticmethod

@@ -561,7 +561,15 @@ class MemoryStore:
 
     # -------------------------------------------------------------- snapshots
     def save(self) -> dict[str, list[StoreObject]]:
-        """Marshal the whole store (memory.go:857-879 / api/snapshot.proto)."""
+        """Marshal the whole store (memory.go:857-879 / api/snapshot.proto).
+
+        When the columnar plane is on, the snapshot additionally carries
+        a versioned `__columnar__` dense-column section (ISSUE 18) so a
+        restoring store can rebuild the hot mirrors by array adoption
+        instead of the O(objects) rebuild walk. The section is advisory:
+        restore() validates it against the object tables and silently
+        falls back to rebuild() on any mismatch, and loaders without the
+        plane (SWARMKIT_TPU_NO_COLUMNAR=1, older builds) skip the key."""
         with self._lock:
             # heal UNDER the lock: save reads the tables directly (no
             # heal-aware accessor), so a lazy wave landing between an
@@ -569,15 +577,26 @@ class MemoryStore:
             # missing from the snapshot
             if self._stale_tasks:
                 self._heal_stale_locked(False)
-            return {t: [o.copy() for o in objs.values()] for t, objs in self._tables.items()}
+            snap = {t: [o.copy() for o in objs.values()]
+                    for t, objs in self._tables.items()}
+            if self.columnar is not None:
+                snap["__columnar__"] = self.columnar.to_snapshot_section()
+                self.op_counts["save_columnar_section"] += 1
+            return snap
 
     def restore(self, snapshot: dict[str, list[StoreObject]]) -> None:
+        # NEVER mutate the caller's snapshot dict: raft holds it (the
+        # leader's _snap_blob cache / recovered snapshot_data) and may
+        # restore it again
+        section = snapshot.get("__columnar__")
         with self._update_lock, self._lock:
             for t in self._tables:
                 self._tables[t].clear()
                 self._indexes[t].clear()
             max_index = 0
             for t, objs in snapshot.items():
+                if t == "__columnar__":
+                    continue
                 for o in objs:
                     o = o.copy()
                     self._tables[t][o.id] = o
@@ -586,12 +605,27 @@ class MemoryStore:
             self._version.index = max(self._version.index, max_index)
             self._stale_tasks.clear()
             if self.columnar is not None:
-                self.columnar = ColumnarTasks.rebuild(
-                    list(self._tables["task"].values()),
-                    services=list(self._tables["service"].values()),
-                    nodes=list(self._tables["node"].values()),
-                    secrets=list(self._tables["secret"].values()),
-                    configs=list(self._tables["config"].values()))
+                tables = self._tables
+                adopted = None
+                if section is not None:
+                    adopted = ColumnarTasks.adopt(
+                        section,
+                        list(tables["task"].values()),
+                        services=list(tables["service"].values()),
+                        nodes=list(tables["node"].values()),
+                        secrets=list(tables["secret"].values()),
+                        configs=list(tables["config"].values()))
+                if adopted is not None:
+                    self.columnar = adopted
+                    self.op_counts["restore_columnar_adopted"] += 1
+                else:
+                    self.columnar = ColumnarTasks.rebuild(
+                        list(tables["task"].values()),
+                        services=list(tables["service"].values()),
+                        nodes=list(tables["node"].values()),
+                        secrets=list(tables["secret"].values()),
+                        configs=list(tables["config"].values()))
+                    self.op_counts["restore_columnar_rebuilt"] += 1
 
     # ------------------------------------------------- columnar wave plane
     def assign_wave(self, assignments: list[tuple[str, str]], *,

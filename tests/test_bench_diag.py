@@ -195,6 +195,21 @@ def test_telemetry_plane_row_cpu_smoke():
     assert row["merge_nodes_per_s"] > 0
 
 
+def test_recovery_plane_row_cpu_smoke():
+    """ISSUE 18 parity check at a CPU-smoke size: the recovery bench
+    row's correctness gates hold — adoption really ran (op-count path
+    markers), the adopted mirror is bit-equal to the rebuild oracle,
+    and the stream framing is multi-chunk. Timings are judged by the
+    bench `recovery_restore_100k` row where bench owns the machine."""
+    import numpy as np
+
+    row = bench.bench_recovery_plane(np, n_tasks=3000)
+    assert row["parity"] is True
+    assert row["tasks"] == 3000
+    assert row["stream_chunks"] >= 2, row
+    assert row["restore_adopt_s"] > 0 and row["restore_rebuild_s"] > 0
+
+
 def test_store_plane_row_cpu_smoke():
     """ISSUE 11 parity check at a CPU-smoke size: the bench row's own
     correctness gates hold (object/columnar end-state equality + columns

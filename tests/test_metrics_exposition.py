@@ -120,6 +120,37 @@ def test_raft_storage_fsync_counters_exposed_with_help(tmp_path):
         assert f"{name} {getattr(storage, attr)}" in text
 
 
+def test_raft_recovery_counters_exposed_with_help(tmp_path):
+    """ISSUE 18 exposition pin: every recovery counter the raft node
+    maintains (the snap_* surface — chunks sent/resent/rejected, suffix
+    resumes, installs, cumulative install seconds) appears in /metrics
+    with a HELP line. Walked from the LIVE node attributes, so a new
+    recovery counter added without exposition wiring fails here."""
+    from swarmkit_tpu.raft.node import RaftNode
+
+    mod = _load_debugserver()
+    raft = RaftNode(raft_id=1, transport=None,
+                    storage=RaftStorage(str(tmp_path)))
+    text = mod.component_metrics_text(_StubNode(raft=raft))
+    helps = _help_names(text)
+    assert "swarm_raft_recovery_total" in helps
+    assert "swarm_raft_recovery_seconds" in helps
+    snap_attrs = [a for a in vars(raft) if a.startswith("snap_")
+                  and a != "snap_stream_max_bytes"  # config, not a counter
+                  and isinstance(getattr(raft, a), (int, float))
+                  and not isinstance(getattr(raft, a), bool)]
+    assert len(snap_attrs) >= 6, "raft node lost its recovery counters?"
+    for attr in snap_attrs:
+        assert f'"{attr}"' in text, \
+            f"recovery counter {attr!r} missing from /metrics"
+    # and they ride status() too (the rollup/telemetry surface)
+    st = raft.status()
+    for attr in ("snap_chunks_sent", "snap_chunks_resent",
+                 "snap_resume_suffix", "snap_chunks_rejected",
+                 "snap_installs", "snap_install_seconds"):
+        assert attr in st, f"{attr} missing from raft status()"
+
+
 def test_every_help_line_precedes_its_samples():
     """promtool ordering: HELP → TYPE → samples per family (the
     content-negotiation fix from ISSUE 5 depends on it)."""

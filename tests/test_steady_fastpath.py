@@ -519,6 +519,17 @@ def test_columnar_bit_equal_after_50_waves_with_unclean_heal(async_commit):
         rebuilt = ColumnarTasks.rebuild(tasks)
         assert ColumnarTasks.snapshots_equal(snap, rebuilt.snapshot()), \
             "columns diverged from the object table"
+        # ISSUE 18 extension: the snapshot's columnar section restores a
+        # FRESH store by array adoption, bit-equal to the same rebuild
+        from swarmkit_tpu.store.memory import MemoryStore
+
+        fresh = MemoryStore()
+        fresh.restore(store.save())
+        assert fresh.op_counts.get("restore_columnar_adopted") == 1, \
+            fresh.op_counts
+        assert ColumnarTasks.snapshots_equal(fresh.columnar.snapshot(),
+                                             rebuilt.snapshot()), \
+            "adopted columns diverged from the rebuild oracle"
     finally:
         failpoints.disarm_all()
         sched.store.queue.stop_watch(ch)

@@ -392,6 +392,34 @@ def test_control_api_surface_and_aggregator_registration():
         d._hb_wheel.stop()
 
 
+def test_rollup_carries_raft_recovery_counters():
+    """ISSUE 18: the manager block of the rollup surfaces the raft
+    recovery plane (snapshot chunks sent/resent, suffix resumes,
+    installs) whenever the wired raft object maintains it — the
+    swarmbench `recovery_plane` block reads exactly these keys."""
+    from swarmkit_tpu.raft.node import RaftNode
+
+    clock = FakeClock()
+    d = Dispatcher(MemoryStore(), heartbeat_period=5.0, clock=clock,
+                   shards=1)
+    try:
+        raft = RaftNode(raft_id=1, transport=None)
+        raft.snap_chunks_sent = 7
+        raft.snap_chunks_resent = 3
+        raft.snap_resume_suffix = 1
+        agg = TelemetryAggregator(MemoryStore(), d, raft=raft,
+                                  clock=clock)
+        rec = agg.rollup()["manager"]["raft"]["recovery"]
+        assert rec["snap_chunks_sent"] == 7
+        assert rec["snap_chunks_resent"] == 3
+        assert rec["snap_resume_suffix"] == 1
+        for key in ("snap_chunks_rejected", "snap_installs",
+                    "snap_install_seconds"):
+            assert key in rec
+    finally:
+        d._hb_wheel.stop()
+
+
 def test_time_series_ring_windows_and_quantiles():
     clock = FakeClock()
     ring = TimeSeriesRing(width_s=1.0, slots=10, clock=clock)
