@@ -1600,6 +1600,96 @@ def bench_mesh_cluster_step(np, n_nodes=None, total_tasks=1_000_000):
     }
 
 
+def bench_strategy_grid(np, n_nodes=2_000, n_tasks=20_000, n_services=50,
+                        scaleout_nodes=None, scaleout_tasks=262_144,
+                        steady_waves=3):
+    """ISSUE 19: strategy diversity — spread vs binpack vs topology-aware
+    scoring through the SAME water-fill kernel, parity gated at two
+    shapes. Steady-tick: a fresh tracked encoder + resident state per
+    strategy, cold tick then steady waves via the classic tick
+    decomposition, kernel vs CPU-oracle bit-parity every wave
+    (binpack rides the heap/closed-form oracle pair, topology the
+    prepended outermost spread level). Scale-out: the shard-partitioned
+    synth grid per strategy — oracle-infeasible sizing rides the
+    sampled-shard oracle + the invariant ladder, including the
+    topology-balance water check (parallel/shard_parity.py). The
+    scale-out shape here is a mid-size grid (16k × devices nodes) — the
+    131k flagship shape stays owned by mesh_cluster_step; this row
+    measures STRATEGY deltas, not the ceiling."""
+    import jax
+    from swarmkit_tpu.models.cluster_step import synth_shard_cluster
+    from swarmkit_tpu.ops.resident import ResidentPlacement
+    from swarmkit_tpu.parallel.mesh import make_mesh, sharded_schedule
+    from swarmkit_tpu.parallel.shard_parity import (
+        check_fill_invariants,
+        sampled_shard_parity,
+    )
+    from swarmkit_tpu.scheduler import batch
+    from swarmkit_tpu.scheduler.encode import IncrementalEncoder
+
+    n_dev = 1 << (max(len(jax.devices()), 1).bit_length() - 1)
+    mesh = make_mesh(n_dev)
+    if scaleout_nodes is None:
+        scaleout_nodes = 16_384 * n_dev
+    gps = 2
+    tpg = -(-scaleout_tasks // (gps * n_dev))
+
+    parity = True
+    strategies = {}
+    for strat in ("spread", "binpack", "topology"):
+        rng = random.Random(19)
+        infos = _mk_nodes(rng, n_nodes)
+        topo = "node.labels.zone" if strat == "topology" else None
+        enc = IncrementalEncoder(tracked=True, strategy=strat, topology=topo)
+        rp = ResidentPlacement(enc)
+        cold = _tick(enc, rp, infos,
+                     _mk_groups(rng, n_tasks, n_services, wave=0), batch, np)
+        parity &= cold["parity"]
+        _apply_wave(enc, rp, infos, cold["problem"], cold["counts"], batch)
+        steady = []
+        for w in range(steady_waves):
+            r = _tick(enc, rp, infos,
+                      _mk_groups(rng, n_tasks, n_services, wave=1 + w),
+                      batch, np)
+            parity &= r["parity"]
+            _apply_wave(enc, rp, infos, r["problem"], r["counts"], batch)
+            steady.append(r)
+        best = min(steady, key=lambda r: r["tpu_tick_s"])
+
+        p, gshard = synth_shard_cluster(scaleout_nodes, n_dev,
+                                        groups_per_shard=gps,
+                                        tasks_per_group=tpg, lmax=2,
+                                        strategy=strat)
+        t0 = time.perf_counter()
+        counts = sharded_schedule(p, mesh)
+        scaleout_s = time.perf_counter() - t0
+        inv = {}
+        try:
+            inv = check_fill_invariants(p, counts)
+            sampled_shard_parity(p, counts, gshard, n_dev, 1)
+        except AssertionError as exc:
+            parity = False
+            inv = {"violation": str(exc).splitlines()[0]}
+        strategies[strat] = {
+            "steady_tick_s": round(best["tpu_tick_s"], 4),
+            "steady_device_s": round(best["device_s"], 4),
+            "steady_cpu_tick_s": round(best["cpu_tick_s"], 4),
+            "steady_placed": best["placed"],
+            "scaleout_e2e_s": round(scaleout_s, 3),
+            "scaleout_placed": inv.get("placed"),
+            **({"violation": inv["violation"]} if "violation" in inv else {}),
+        }
+    return {
+        "parity": parity,
+        "devices": n_dev,
+        "nodes": n_nodes,
+        "tasks": n_tasks,
+        "scaleout_nodes": scaleout_nodes,
+        "scaleout_tasks": scaleout_tasks,
+        "strategies": strategies,
+    }
+
+
 def bench_trace_plane(np):
     """Trace-plane acceptance row (ISSUE 5): (a) DISARMED overhead — a
     pipelined steady wave with tracing off must allocate zero spans
@@ -2850,6 +2940,9 @@ def main():
         # round 7 (ISSUE 7): the fused flagship on the device mesh at the
         # scale-out grid — 131k+ nodes × 1M tasks, sampled-shard parity
         ("mesh_cluster_step", lambda: bench_mesh_cluster_step(np)),
+        # ISSUE 19: spread vs binpack vs topology through the same kernel,
+        # parity gated at steady-tick + mid-size scale-out shapes
+        ("strategy_grid", lambda: bench_strategy_grid(np)),
         # waves=7 -> three fully-pipelined periods in the e2e sample
         # (depth+1..waves-1); with one sample the min-estimator was a
         # lottery against heap/tunnel noise on the commit-heavy wall

@@ -369,7 +369,8 @@ class SessionStorm:
 
 # -------------------------------------------------------------- load shapes
 def _service_spec(name: str, replicas: int, command: str,
-                  auto_rollback: bool = False):
+                  auto_rollback: bool = False,
+                  strategy: str | None = None):
     import shlex
 
     from ..api.specs import (Annotations, ContainerSpec, ServiceSpec,
@@ -381,6 +382,13 @@ def _service_spec(name: str, replicas: int, command: str,
         task=TaskSpec(runtime=ContainerSpec(
             command=shlex.split(command))),
     )
+    if strategy == "binpack":
+        # fullest-first scoring needs capacity to consume: one CPU
+        # quantum per task makes the pile-up observable without
+        # starving a real node (ISSUE 19)
+        from ..scheduler.encode import CPU_QUANTUM
+
+        spec.task.resources.reservations.nano_cpus = CPU_QUANTUM
     if auto_rollback:
         # fail-storm services must recover WITHOUT operator action: a
         # broken rollout trips max_failure_ratio and rolls back
@@ -428,6 +436,7 @@ def run_churn(ctl, *, duration: float, replicas: int, rng: random.Random,
               command: str = "sleep 3600",
               fail_storm_every: int = 0,
               name_prefix: str | None = None,
+              strategy: str | None = None,
               progress=None, on_service=None) -> dict:
     """The continuous-churn load generator: every `interval` one service
     gets either a ROLLOUT STORM (env bump → every task replaced through
@@ -446,7 +455,8 @@ def run_churn(ctl, *, duration: float, replicas: int, rng: random.Random,
         for i in range(services):
             svc = ctl.create_service(
                 _service_spec(f"{name_prefix}-{i}", replicas, command,
-                              auto_rollback=bool(fail_storm_every)))
+                              auto_rollback=bool(fail_storm_every),
+                              strategy=strategy))
             if on_service is not None:
                 on_service(svc)        # e.g. collector.allow(svc.id)
             svcs.append(svc)
@@ -613,6 +623,14 @@ def main(argv=None) -> int:
                          "manager's sharded dispatcher plane during the "
                          "run; simulated nodes are drained so they "
                          "never receive real placements")
+    ap.add_argument("--strategy", default=None,
+                    choices=["spread", "binpack", "topology"],
+                    help="scheduler strategy the target manager runs "
+                         "(swarmd --scheduler-strategy); recorded in "
+                         "the report for attribution, and binpack "
+                         "gives created services a one-CPU-quantum "
+                         "reservation so fullest-first scoring has "
+                         "capacity to consume (ISSUE 19)")
     ap.add_argument("--shards", type=int, default=None, metavar="P",
                     help="dispatcher shard count the target manager was "
                          "started with (swarmd --dispatcher-shards); "
@@ -662,6 +680,7 @@ def main(argv=None) -> int:
                 scale_step=args.scale_step, storm_every=args.storm_every,
                 interval=args.interval, command=args.command,
                 fail_storm_every=args.fail_storm_every,
+                strategy=args.strategy,
                 on_service=lambda s: collector.allow(s.id))
             created_ids = churn_stats["service_ids"]
             # SETTLE before evaluating: the churn cutoff right-censors
@@ -710,7 +729,8 @@ def main(argv=None) -> int:
                 report["slo"]["ok"] = False
         else:
             svc = ctl.create_service(_service_spec(
-                f"bench-{int(time.time())}", args.replicas, args.command))
+                f"bench-{int(time.time())}", args.replicas, args.command,
+                strategy=args.strategy))
             collector.allow(svc.id)
             created_ids = [svc.id]
             if args.poll:
@@ -723,6 +743,8 @@ def main(argv=None) -> int:
                                   slo_specs=slo_specs)
             report["service"] = svc.id
 
+        if args.strategy is not None:
+            report["strategy"] = args.strategy
         if storm is not None:
             report["session_storm"] = dict(storm.metrics)
             report["session_storm"]["sessions"] = args.sessions

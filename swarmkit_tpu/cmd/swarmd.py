@@ -78,6 +78,19 @@ def main(argv=None) -> int:
                          "accelerator (default 200000; tune ~100x lower "
                          "for PCIe/on-host devices than for a tunneled "
                          "dev link — see BASELINE.md)")
+    ap.add_argument("--scheduler-strategy",
+                    choices=["spread", "binpack", "topology"],
+                    default="spread",
+                    help="placement scoring engine (ISSUE 19): spread "
+                         "balances, binpack fills the fullest feasible "
+                         "node first (preferences ignored), topology "
+                         "spreads with --scheduler-topology as the "
+                         "outermost balance axis")
+    ap.add_argument("--scheduler-topology", default=None,
+                    metavar="DESCRIPTOR",
+                    help="topology descriptor for "
+                         "--scheduler-strategy topology, e.g. "
+                         "node.labels.zone")
     ap.add_argument("--scheduler-pipeline", action="store_true",
                     help="pipeline scheduler ticks on the jax backend: "
                          "commit wave k under wave k+1's device transfer "
@@ -197,6 +210,8 @@ def main(argv=None) -> int:
         jax_threshold=args.jax_threshold,
         scheduler_pipeline=args.scheduler_pipeline,
         scheduler_async_commit=args.scheduler_async_commit,
+        scheduler_strategy=args.scheduler_strategy,
+        scheduler_topology=args.scheduler_topology,
         dispatcher_shards=args.dispatcher_shards,
     )
     try:

@@ -68,6 +68,8 @@ class Manager:
         jax_threshold: int | None = None,
         scheduler_pipeline: bool = False,
         scheduler_async_commit: bool = False,
+        scheduler_strategy: str = "spread",
+        scheduler_topology: str | None = None,
         dispatcher_shards: int | None = None,
         clock=None,
     ):
@@ -86,6 +88,8 @@ class Manager:
         self.jax_threshold = jax_threshold
         self.scheduler_pipeline = scheduler_pipeline
         self.scheduler_async_commit = scheduler_async_commit
+        self.scheduler_strategy = scheduler_strategy
+        self.scheduler_topology = scheduler_topology
         self._lock = make_lock('manager.manager.lock')
         self._is_leader = False
         self._started = False
@@ -284,7 +288,9 @@ class Manager:
             Scheduler(self.store, backend=self.scheduler_backend,
                       jax_threshold=self.jax_threshold,
                       pipeline=self.scheduler_pipeline,
-                      async_commit=self.scheduler_async_commit),
+                      async_commit=self.scheduler_async_commit,
+                      strategy=self.scheduler_strategy,
+                      topology=self.scheduler_topology),
             ReplicatedOrchestrator(self.store),
             GlobalOrchestrator(self.store),
             JobsOrchestrator(self.store),

@@ -19,14 +19,16 @@ from __future__ import annotations
 import random
 
 
-def cluster_step(acks, quorum, *placement_args):
+def cluster_step(acks, quorum, *placement_args, strategy: int = 0):
     """One cluster step: place all pending groups, advance the commit
-    frontier. Jittable as a whole; inputs follow
+    frontier. Jittable as a whole (strategy is static: 0 = spread/
+    topology tree fill, 1 = binpack); inputs follow
     `scheduler.encode.KERNEL_ARG_FIELDS` for the placement side."""
     from ..ops.placement import schedule_groups
     from ..ops.raft_replay import replay_commit
 
-    counts, totals, svc_counts = schedule_groups(*placement_args)
+    counts, totals, svc_counts = schedule_groups(*placement_args,
+                                                 strategy=strategy)
     commit_index, _committed = replay_commit(acks, quorum)
     return counts, totals, commit_index
 
@@ -132,7 +134,9 @@ def synth_shard_cluster(n_nodes: int, n_shards: int,
                         groups_per_shard: int = 4,
                         tasks_per_group: int = 31_250,
                         seed: int = 0, lmax: int = 2,
-                        with_ports: bool = True):
+                        with_ports: bool = True,
+                        with_voltopo: bool = True,
+                        strategy: str = "spread"):
     """Array-native synthetic cluster at oracle-infeasible scale.
 
     Builds an EncodedProblem DIRECTLY as numpy arrays — no Node/Task/
@@ -153,11 +157,27 @@ def synth_shard_cluster(n_nodes: int, n_shards: int,
     which is what `parallel.shard_parity.sampled_shard_parity` checks at
     sizes where the full Python oracle cannot run.
 
+    with_voltopo adds the ISSUE 19 CSI volume-topology mask leg: a
+    second node_val column carries a shard-prefixed "csi zone" id and
+    every 4th group requires mount 0 to match one of two zone values of
+    ITS OWN shard. The leg is node-local (a pure static-mask AND), so
+    slicing is trivially sound — but shard-prefixed values keep the
+    synthetic honest: a group's rows can never match outside its slice.
+
+    strategy stamps the problem's scoring engine ("spread" | "binpack" |
+    "topology" — topology is spread with the axis already folded into
+    the level-0 ranks here, so it shares the spread code path).
+
     Returns (EncodedProblem, group_shard int32[G]).
     """
     import numpy as np
 
-    from ..scheduler.encode import OP_EQ, EncodedProblem
+    from ..scheduler.encode import (
+        OP_EQ,
+        VOL_TOPO_SEGS,
+        EncodedProblem,
+        _empty_vol_topo,
+    )
 
     assert n_nodes % n_shards == 0, "shards are contiguous equal slices"
     per = n_nodes // n_shards
@@ -176,7 +196,17 @@ def synth_shard_cluster(n_nodes: int, n_shards: int,
         groups=[],
     )
     p.ready = rng.rand(N) > 0.01
-    p.node_val = (shard_of_node + 1).reshape(N, 1).astype(np.int32)
+    p.strategy = strategy
+    if with_voltopo:
+        # csi zone column (node_val col 1): shard-prefixed ids so a
+        # group's vol-topo rows can only ever match inside its slice
+        ZV = 3
+        zone = (shard_of_node * ZV
+                + rng.randint(0, ZV, N) + 1).astype(np.int32)
+        p.node_val = np.stack(
+            [(shard_of_node + 1).astype(np.int32), zone], axis=1)
+    else:
+        p.node_val = (shard_of_node + 1).reshape(N, 1).astype(np.int32)
     p.node_plat = np.zeros((N, 2), np.int32)
     p.node_plugins = np.zeros((N, 1), bool)
     PV = 4
@@ -225,6 +255,21 @@ def synth_shard_cluster(n_nodes: int, n_shards: int,
     p.penalty_nonzero = False
     p.extra_mask = np.ones((G, N), bool)
     p.extra_mask_all = True
+    if with_voltopo:
+        # every 4th group: mount 0 accepts either of two zone values of
+        # the group's OWN shard (two alternative rows — the ∃-candidate
+        # OR the kernel leg evaluates)
+        ZV = 3
+        W = 1 + 2 * VOL_TOPO_SEGS
+        p.vol_topo = np.full((G, 2, W), -1, np.int32)
+        for gi in range(3, G, 4):
+            s = int(group_shard[gi])
+            p.vol_topo[gi, 0, :3] = (0, 1, s * ZV + 1 + (gi % ZV))
+            p.vol_topo[gi, 1, :3] = (0, 1, s * ZV + 1 + ((gi + 1) % ZV))
+        p.vol_topo_any = True
+    else:
+        p.vol_topo = _empty_vol_topo(G)
+        p.vol_topo_any = False
     # spread tree nested within shards: level-0 branch id encodes the
     # shard (branches never span a slice); level l+1 refines level l with
     # a contiguous child-id range per parent — the encoder's prefix-rank

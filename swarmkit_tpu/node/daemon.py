@@ -238,6 +238,8 @@ class SwarmNode:
         jax_threshold: int | None = None,
         scheduler_pipeline: bool = False,
         scheduler_async_commit: bool = False,
+        scheduler_strategy: str = "spread",
+        scheduler_topology: str | None = None,
         dispatcher_shards: int | None = None,
         clock=None,
     ):
@@ -267,6 +269,8 @@ class SwarmNode:
         self.jax_threshold = jax_threshold
         self.scheduler_pipeline = scheduler_pipeline
         self.scheduler_async_commit = scheduler_async_commit
+        self.scheduler_strategy = scheduler_strategy
+        self.scheduler_topology = scheduler_topology
         self.dispatcher_shards = dispatcher_shards
         from ..utils.clock import REAL_CLOCK
         self.clock = clock or REAL_CLOCK
@@ -762,6 +766,8 @@ class SwarmNode:
             jax_threshold=self.jax_threshold,
             scheduler_pipeline=self.scheduler_pipeline,
             scheduler_async_commit=self.scheduler_async_commit,
+            scheduler_strategy=self.scheduler_strategy,
+            scheduler_topology=self.scheduler_topology,
             dispatcher_shards=self.dispatcher_shards,
             clock=self.clock,
         )

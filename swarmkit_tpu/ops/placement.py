@@ -29,9 +29,39 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..scheduler.encode import VOL_TOPO_MOUNTS
 from ..scheduler.spread import PENALTY_BASE
 
 UNLIMITED = 1 << 30  # plain int: keep module import free of backend init
+
+
+def _vol_topo_ok(node_val, vol_topo):
+    """Volume/topology feasibility[G, N] (SURVEY volumes.go/topology.go).
+
+    vol_topo: int32[G, VA, 1+2*SEGS] rows of (mount_id, k0, v0, k1, v1,
+    ...), -1 padded. Each row is one candidate-volume × accessible-
+    topology alternative of one mount; it passes on a node when EVERY
+    present (key, value) segment matches the node's interned column
+    value (the encoder emits csi pseudo-keys into node_val, so this is
+    the same gather shape as the constraint check). Feasibility = AND
+    over mounts present in the group of (OR over that mount's rows).
+    """
+    G, VA, W = vol_topo.shape
+    mount = vol_topo[:, :, 0]                                # [G, VA]
+    row_ok = jnp.ones((G, VA, node_val.shape[0]), bool)
+    for s in range((W - 1) // 2):
+        k = vol_topo[:, :, 1 + 2 * s]                        # [G, VA]
+        v = vol_topo[:, :, 2 + 2 * s]
+        nv = node_val[:, jnp.clip(k, 0)]                     # [N, G, VA]
+        ok = (k < 0)[None] | (nv == v[None])                 # [N, G, VA]
+        row_ok = row_ok & jnp.transpose(ok, (1, 2, 0))
+    vol_ok = jnp.ones((G, node_val.shape[0]), bool)
+    for m in range(VOL_TOPO_MOUNTS):
+        is_m = mount == m                                    # [G, VA]
+        has_m = jnp.any(is_m, axis=1)                        # [G]
+        m_ok = jnp.any(row_ok & is_m[:, :, None], axis=1)    # [G, N]
+        vol_ok = vol_ok & jnp.where(has_m[:, None], m_ok, True)
+    return vol_ok
 
 
 def build_static_mask(
@@ -43,6 +73,7 @@ def build_static_mask(
     plat_req,     # int32[G, P, 2]
     req_plugins,  # bool[G, PL]
     extra_mask,   # bool[G, N]
+    vol_topo=None,  # int32[G, VA, 1+2*SEGS] or None
 ):
     """Fused eligibility mask[G, N]. Pure elementwise/gather work — XLA fuses
     this into a handful of kernels; the matmul-shaped plugin check rides the
@@ -79,7 +110,12 @@ def build_static_mask(
         preferred_element_type=jnp.float32) > 0.5
     plug_ok = ~missing
 
-    return ready[None, :] & cons_ok & plat_ok & plug_ok & extra_mask
+    out = ready[None, :] & cons_ok & plat_ok & plug_ok & extra_mask
+    # VA == 0 is the common case (no CSI volumes): the shape is static
+    # under jit, so the whole leg compiles away
+    if vol_topo is not None and vol_topo.shape[1] > 0:
+        out = out & _vol_topo_ok(node_val, vol_topo)
+    return out
 
 
 def _segment_sum(data, seg, n):
@@ -246,6 +282,30 @@ def _tree_water_fill(eligible, capacity, penalty, svc, total, n_tasks,
     return counts + extra.astype(jnp.int32)
 
 
+def _binpack_fill(eligible, capacity, penalty, svc, total, n_tasks):
+    """Binpack fill of one group: prefer the FULLEST feasible node.
+
+    Canonical order (penalty, -svc, -total, node_idx) — see
+    spread.binpack_fill. Because each assignment strictly improves the
+    assigned node's key, greedy equals sequential capacity consumption
+    in INITIAL-key order, which is the closed form here: stable lexsort
+    by the initial key, then prefix-sum the sorted capacities against
+    the quota. Bit-identical to spread.binpack_fill/binpack_reference.
+    """
+    N = eligible.shape[0]
+    idx = jnp.arange(N, dtype=jnp.int32)
+    cap = jnp.minimum(jnp.where(eligible, capacity, 0), n_tasks) \
+        .astype(jnp.int32)
+    pen = jnp.where(penalty, 1, 0).astype(jnp.int32)
+    order = jnp.lexsort((idx, -total.astype(jnp.int32),
+                         -svc.astype(jnp.int32), pen))
+    cap_sorted = cap[order]
+    prefix = jnp.cumsum(cap_sorted)
+    q = jnp.minimum(n_tasks, jnp.sum(cap)).astype(jnp.int32)
+    counts_sorted = jnp.clip(q - (prefix - cap_sorted), 0, cap_sorted)
+    return jnp.zeros(N, jnp.int32).at[order].set(counts_sorted)
+
+
 def _schedule_core(
     ready, node_val, node_plat, node_plugins, extra_mask,
     constraints, plat_req, req_plugins,
@@ -261,7 +321,9 @@ def _schedule_core(
     group_ports,    # bool[G, PV]
     port_used0,     # bool[N, PV]
     spread_rank,    # int32[G, LMAX, N]; LMAX may be 0 (no preferences)
+    vol_topo=None,  # int32[G, VA, 1+2*SEGS]; VA may be 0 (no CSI volumes)
     unroll: int = 1,
+    strategy: int = 0,   # static: 0 = spread/topology (tree), 1 = binpack
 ):
     """Traced core shared by the one-shot and device-resident entry points.
     Schedules every group sequentially (groups interact through node
@@ -269,7 +331,7 @@ def _schedule_core(
     AND the full post-placement node state carry."""
     static_mask = build_static_mask(
         ready, node_val, node_plat, node_plugins,
-        constraints, plat_req, req_plugins, extra_mask)
+        constraints, plat_req, req_plugins, extra_mask, vol_topo)
 
     def step(carry, xs):
         totals, svc_counts, avail, port_used = carry
@@ -292,8 +354,12 @@ def _schedule_core(
         cap = jnp.clip(jnp.minimum(jnp.minimum(cap_res, cap_mr), cap_port),
                        0, UNLIMITED)
 
-        counts = _tree_water_fill(g_mask, cap, g_pen, svc, totals, g_ntasks,
-                                  g_spread)
+        if strategy == 1:     # static: binpack ignores spread preferences
+            counts = _binpack_fill(g_mask, cap, g_pen, svc, totals,
+                                   g_ntasks)
+        else:                 # spread / topology (topology = encoder-
+            counts = _tree_water_fill(g_mask, cap, g_pen, svc, totals,
+                                      g_ntasks, g_spread)  # prepended level
 
         totals = totals + counts
         # audited vs the axon flat-1D rule (ISSUE 8): g_svc is a SCALAR
@@ -317,21 +383,22 @@ def _schedule_core(
     return counts, totals, svc_counts, avail, port_used
 
 
-@functools.partial(jax.jit, static_argnames=("unroll",))
-def schedule_groups(*args, unroll: int = 1):
+@functools.partial(jax.jit, static_argnames=("unroll", "strategy"))
+def schedule_groups(*args, unroll: int = 1, strategy: int = 0):
     """One-shot entry: (counts[G, N], totals[N], svc_counts[S, N])."""
-    counts, totals, svc_counts, _, _ = _schedule_core(*args, unroll=unroll)
+    counts, totals, svc_counts, _, _ = _schedule_core(
+        *args, unroll=unroll, strategy=strategy)
     return counts, totals, svc_counts
 
 
-@functools.partial(jax.jit, static_argnames=("compact",))
-def schedule_groups_compact(*args, compact: bool = True):
+@functools.partial(jax.jit, static_argnames=("compact", "strategy"))
+def schedule_groups_compact(*args, compact: bool = True, strategy: int = 0):
     """schedule_groups + an int16 downcast when counts provably fit — the
     result crosses the host↔device link (a high-latency tunnel in dev; PCIe
     in prod), so halving the bytes matters. The real [G, N] window is sliced
     HOST-side: making it static here would re-trace the whole kernel per
     exact shape, defeating pad_buckets' bucket-and-pad."""
-    counts, totals, svc_counts = schedule_groups(*args)
+    counts, totals, svc_counts = schedule_groups(*args, strategy=strategy)
     if compact:
         return counts.astype(jnp.int16)
     return counts
@@ -352,5 +419,7 @@ def schedule_encoded(p, backend=None):
     G, N = p.extra_mask.shape
     args = jax.device_put(list(kernel_args(pad_buckets(p))))
     compact = bool(p.n_tasks.size == 0 or int(p.n_tasks.max()) < (1 << 15))
-    counts = schedule_groups_compact(*args, compact=compact)
+    strategy = 1 if getattr(p, "strategy", "spread") == "binpack" else 0
+    counts = schedule_groups_compact(*args, compact=compact,
+                                     strategy=strategy)
     return np.asarray(counts)[:G, :N].astype(np.int32)

@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 
 from ..scheduler.encode import (
+    VOL_TOPO_SEGS,
     EncodedProblem,
     IncrementalEncoder,
     _bucket,
@@ -62,6 +63,9 @@ DONATE_STATE_ARGNUMS = tuple(range(len(STATE_FIELDS)))
 # tables: a FRESH (1, 1) array per tick would defeat the group-table
 # cache's identity gate and re-ship two (tiny) arrays every steady tick
 _PLACEHOLDER_FALSE = np.zeros((1, 1), bool)
+# disabled vol-topo table (ISSUE 19): same identity-gate rationale — a
+# cluster with no CSI volumes must keep hitting the cache on this slot
+_PLACEHOLDER_VOLTOPO = np.full((1, 1, 1 + 2 * VOL_TOPO_SEGS), -1, np.int32)
 
 
 def _resident_tick_impl(
@@ -73,7 +77,9 @@ def _resident_tick_impl(
     # ---- per-tick group tables -----------------------------------------
     constraints, plat_req, req_plugins, n_tasks, svc_idx, need_res,
     max_replicas, penalty, has_ports, group_ports, spread_rank, extra_mask,
-    *, use_penalty: bool, use_extra: bool, has_deltas: bool, compact: bool,
+    vol_topo,
+    *, use_penalty: bool, use_extra: bool, use_voltopo: bool,
+    has_deltas: bool, compact: bool, strategy: int,
 ):
     if has_deltas:
         ready = ready.at[d_idx].set(d_ready)
@@ -93,14 +99,16 @@ def _resident_tick_impl(
         constraints, plat_req, req_plugins,
         avail_res, total0, svc_mat,
         n_tasks, svc_idx, need_res, max_replicas,
-        pen, has_ports, group_ports, port_used, spread_rank)
+        pen, has_ports, group_ports, port_used, spread_rank,
+        vol_topo=vol_topo if use_voltopo else None, strategy=strategy)
     if compact:
         counts = counts.astype(jnp.int16)
     return (counts, ready, node_val, node_plat, node_plugins, port_out,
             avail_out, totals, svc_out)
 
 
-_STATICS = ("use_penalty", "use_extra", "has_deltas", "compact")
+_STATICS = ("use_penalty", "use_extra", "use_voltopo", "has_deltas",
+            "compact", "strategy")
 # donated state buffers update in place on accelerators; the CPU test
 # backend can't always honor donation and warns per call, so it gets the
 # plain variant
@@ -469,11 +477,19 @@ class ResidentPlacement:
         use_extra = ((not p.extra_mask_all)
                      if p.extra_mask_all is not None
                      else not bool(p.extra_mask.all()))
+        # vol-topo dispatch flag (ISSUE 19): the builder-stamped
+        # vol_topo_any is exact; None = unknown → inspect the table shape
+        vt = getattr(p, "vol_topo", None)
+        vt_any = getattr(p, "vol_topo_any", None)
+        use_voltopo = (bool(vt_any) if vt_any is not None
+                       else vt is not None and vt.shape[1] > 0)
+        strategy = 1 if getattr(p, "strategy", "spread") == "binpack" else 0
         gp = _bucket(G)
         pad2 = self._pad2
         lmax = p.spread_rank.shape[1]
         lp = _bucket(lmax) if lmax else 0
-        dims = (gp, np_b, kp, plp, pvp, rp, lp, N)
+        vp = _bucket(vt.shape[1]) if use_voltopo else 0
+        dims = (gp, np_b, kp, plp, pvp, rp, lp, vp, N)
 
         def build_slot(i):
             if i == 0:
@@ -507,7 +523,9 @@ class ResidentPlacement:
                         spread[:G, lmax:, :N] = \
                             p.spread_rank[:, lmax - 1:lmax, :]
                 return spread
-            return pad2(p.extra_mask, gp, np_b, fill=False)      # 11
+            if i == 11:
+                return pad2(p.extra_mask, gp, np_b, fill=False)
+            return pad2(vt, gp, vp, fill=-1)                     # 12
 
         compact = bool(p.n_tasks.size == 0 or int(p.n_tasks.max()) < (1 << 15))
 
@@ -529,7 +547,8 @@ class ResidentPlacement:
                 p.svc_idx_persistent, p.need_res, p.max_replicas,
                 p.penalty if use_penalty else _PLACEHOLDER_FALSE,
                 p.has_ports, p.group_ports, p.spread_rank,
-                p.extra_mask if use_extra else _PLACEHOLDER_FALSE]
+                p.extra_mask if use_extra else _PLACEHOLDER_FALSE,
+                vt if use_voltopo else _PLACEHOLDER_VOLTOPO]
         n_slots = len(srcs)
         cache = self._gcache
         prev_src = self._gsrc
@@ -545,7 +564,8 @@ class ResidentPlacement:
             if c is not None and prev_src[i] is src:
                 group_host[i], group_dev[i] = c          # identity hit
                 continue
-            h = src if src is _PLACEHOLDER_FALSE else build_slot(i)
+            h = (src if src is _PLACEHOLDER_FALSE
+                 or src is _PLACEHOLDER_VOLTOPO else build_slot(i))
             group_host[i] = h
             if c is not None and c[0].shape == h.shape \
                     and c[0].dtype == h.dtype and np.array_equal(c[0], h):
@@ -587,7 +607,8 @@ class ResidentPlacement:
         out = tick(
             *self._state, *dev[:9], *group_dev,
             use_penalty=use_penalty, use_extra=use_extra,
-            has_deltas=has_deltas, compact=compact)
+            use_voltopo=use_voltopo, has_deltas=has_deltas,
+            compact=compact, strategy=strategy)
         counts_dev, self._state = out[0], tuple(out[1:])
         # pull form: dense [G, N] window vs sparse (idx, val) — pick by
         # wire bytes. k bounds the nonzero count by the tick's total tasks

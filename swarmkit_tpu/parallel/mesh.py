@@ -67,6 +67,9 @@ _FIELD_SHARDING: dict[str, tuple[int | None, object]] = {
     # phantom pad nodes fall into segment 0 with zero capacity and zero
     # service counts — invisible to every pour
     "spread_rank": (2, 0),
+    # per-group CSI rows — group-side, replicated (the kernel gathers
+    # node_val columns by row key, so the node axis never appears here)
+    "vol_topo": (None, -1),
 }
 
 
@@ -211,8 +214,10 @@ def sharded_schedule(p, mesh: Mesh):
     """Run the placement kernel with per-node arrays sharded over the mesh.
     Returns counts[G, N] (numpy, truncated back to the real node count)."""
     args, N = shard_problem(p, mesh)
+    strategy = 1 if getattr(p, "strategy", "spread") == "binpack" else 0
     with mesh_context(mesh):
-        counts, totals, svc_counts = placement_ops.schedule_groups(*args)
+        counts, totals, svc_counts = placement_ops.schedule_groups(
+            *args, strategy=strategy)
     return np.asarray(counts)[:, :N]
 
 
@@ -246,8 +251,10 @@ def sharded_cluster_step(p, acks, quorum, mesh: Mesh,
             + np.asarray(acks).nbytes
         stats["upload_s"] = _time.perf_counter() - t0
     t1 = _time.perf_counter()
+    strategy = 1 if getattr(p, "strategy", "spread") == "binpack" else 0
     with mesh_context(mesh):
-        counts, totals, commit = _fused_step()(acks_dev, quorum, *args)
+        counts, totals, commit = _fused_step()(acks_dev, quorum, *args,
+                                               strategy=strategy)
     # the scalar commit pull is the TRUE device sync (CLAUDE.md tunnel
     # rule: block_until_ready lies through the tunnel; only a real value
     # pull syncs) — it delimits fill_s honestly on the platform the
@@ -274,5 +281,5 @@ def _fused_step():
     if _FUSED_JIT is None:
         from ..models.cluster_step import cluster_step
 
-        _FUSED_JIT = jax.jit(cluster_step)
+        _FUSED_JIT = jax.jit(cluster_step, static_argnames=("strategy",))
     return _FUSED_JIT

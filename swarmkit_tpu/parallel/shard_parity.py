@@ -22,9 +22,20 @@ scale-out verification ladder (ISSUE 7 / docs/mesh.md):
      tie-break) and the relative branch-rank order (the pour's
      tie-break), so the restricted fill IS the slice's fill.
   3. invariant checks on the FULL output: non-negativity, per-group task
-     conservation, static-mask eligibility, resource capacity,
-     max-replicas caps, host-port exclusivity — each a vectorized numpy
-     pass, feasible at any size the arrays fit in memory.
+     conservation, static-mask eligibility (which since ISSUE 19 folds
+     the CSI volume-topology leg — `cpu_static_mask` carries it, so a
+     placement on a vol-topo-infeasible node fails here), resource
+     capacity, max-replicas caps, ORDER-AWARE host-port claims (the
+     oracle's incremental batch-internal conflict semantics), and the
+     topology-balance water property of the outermost preference level
+     — each a vectorized numpy pass, feasible at any size the arrays
+     fit in memory.
+
+The sampled-shard oracle is STRATEGY-AWARE: `slice_shard_problem`
+carries `strategy` and the group-side `vol_topo` rows, and
+`cpu_schedule_encoded` dispatches on them — so binpack and topology
+fills at the scale-out grid are held to the same sliced bit-parity bar
+as spread.
 
 A violation raises AssertionError (bench rows translate that into
 parity=False and join failed_rows).
@@ -71,6 +82,15 @@ def slice_shard_problem(p, group_idx: np.ndarray, node_lo: int,
     q.extra_mask = np.ascontiguousarray(p.extra_mask[gsel][:, sl])
     q.spread_rank = np.ascontiguousarray(
         np.asarray(p.spread_rank)[gsel][:, :, sl])
+    vt = getattr(p, "vol_topo", None)
+    if vt is not None:
+        # group-side CSI topology rows: the group axis slices, the node
+        # axis never appears (the mask leg gathers node_val columns by
+        # row key, and node_val keeps its columns under node slicing)
+        q.vol_topo = np.ascontiguousarray(np.asarray(vt)[gsel])
+        q.vol_topo_any = bool(q.vol_topo.shape[1])
+    # the slice oracle must score with the SAME strategy as the kernel
+    q.strategy = getattr(p, "strategy", "spread")
     return q
 
 
@@ -144,20 +164,32 @@ def check_fill_invariants(p, counts: np.ndarray) -> dict:
                 <= int(p.max_replicas[gi])).all(), \
             f"group {gi}: max_replicas cap exceeded"
 
-    # host ports: ≤1 task of a port group per node, never on a node whose
-    # port was already in use, and no two groups sharing a port id on the
-    # same node
-    port_claims = np.zeros(p.port_used0.shape, np.int64)  # [N, PV]
+    # host ports, ORDER-AWARE: claims fold in canonical group order, so
+    # group gi may never claim a port occupied by the initial state OR by
+    # any earlier group's claim — the oracle's incremental-claim
+    # semantics, which the kernel's in-scan port fold must mirror. (Also
+    # subsumes the pairwise "no two groups share a port on one node".)
+    port_occ = p.port_used0.copy()                        # [N, PV]
     for gi in np.flatnonzero(p.has_ports):
         assert (c[gi] <= 1).all(), \
             f"port group {gi}: >1 task on one node"
         pids = np.flatnonzero(p.group_ports[gi])
-        conflict = p.port_used0[:, pids].any(axis=1)
+        conflict = port_occ[:, pids].any(axis=1)
         assert not (c[gi][conflict] > 0).any(), \
-            f"port group {gi}: placed on a node with the port in use"
-        port_claims[np.ix_(c[gi] > 0, pids)] += 1
-    assert (port_claims <= 1).all(), \
-        "two groups claimed the same host port on one node"
+            f"port group {gi}: placed on a node whose port was already " \
+            f"claimed (initial state or an earlier group in batch order)"
+        port_occ[np.ix_(c[gi] > 0, pids)] = True
+
+    # topology balance: the outermost preference level pours by the water
+    # principle, so a branch with END-state slack (a fortiori slack at
+    # fill time — capacity only depletes as groups fold) bounds every
+    # poured branch's final service total to within one unit. Binpack
+    # ignores preferences (flat consolidation fill) so the check applies
+    # to the spread/topology strategies only; binpack's fill itself is
+    # covered by the strategy-aware sampled oracle above.
+    sr = np.asarray(p.spread_rank)
+    if sr.shape[1] > 0 and getattr(p, "strategy", "spread") != "binpack":
+        _check_topology_balance(p, c, mask, used, svc_final, port_occ)
 
     return {
         "placed": int(c.sum()),
@@ -165,3 +197,76 @@ def check_fill_invariants(p, counts: np.ndarray) -> dict:
         "groups": int(len(p.n_tasks)),
         "nodes": len(p.node_ids),
     }
+
+
+def _check_topology_balance(p, c, mask, used, svc_final, port_occ):
+    """Water property of the outermost preference level (ISSUE 19).
+
+    The level-0 pour gives each unit to the branch with the smallest
+    (service total, rank), where a branch's total counts ALL its nodes
+    (nodeset.go:88-104) and its cap sums eligible nodes' capacity. Hence
+    at completion, for any poured branch a and any branch b that still
+    had capacity: k_a + y_a <= k_b + y_b + 1 (b was in the pour heap the
+    whole time, so a's last unit went to a total no higher than b's).
+    Fill-time caps are unobservable post-hoc, but capacity is MONOTONE
+    non-increasing across the batch fold — so end-state slack implies
+    fill-time slack, and the end-state check is sound (conservative:
+    branches saturated only late escape it). Fill-time service totals
+    are exact: unique service rows (the synth builder's shape) read
+    svc_count0 directly; shared rows replay the canonical fold order.
+    """
+    G, N = c.shape
+    r0 = np.asarray(p.spread_rank)[:, 0, :]
+    B = int(r0.max()) + 1
+    avail_end = p.avail_res.astype(np.int64) - used            # [N, R]
+    svc_idx = np.asarray(p.svc_idx)
+    unique_rows = len(np.unique(svc_idx)) == len(svc_idx)
+    if not unique_rows:
+        run: dict[int, np.ndarray] = {}
+        before = []
+        for gi in range(G):
+            s = int(svc_idx[gi])
+            b = run.get(s)
+            if b is None:
+                b = p.svc_count0[s].astype(np.int64)
+            before.append(b)
+            run[s] = b + c[gi]
+
+    # chunked over groups: each chunk is a handful of O(chunk·N) C-speed
+    # passes (bincount on flattened (group, branch) keys), so the sweep
+    # stays feasible at the scale-out grid without a [G, N] staging copy
+    CH = 128
+    big = np.int64(1) << 40
+    for g0 in range(0, G, CH):
+        gs = slice(g0, min(g0 + CH, G))
+        ch = gs.stop - g0
+        r = np.ascontiguousarray(r0[gs]).astype(np.int64)
+        flat = (np.arange(ch, dtype=np.int64)[:, None] * B + r).ravel()
+        y = np.bincount(flat, weights=c[gs].ravel(),
+                        minlength=ch * B).reshape(ch, B).astype(np.int64)
+        if unique_rows:
+            sb = p.svc_count0[svc_idx[gs]]
+        else:
+            sb = np.stack(before[g0:gs.stop])
+        k = np.bincount(flat, weights=np.asarray(sb, np.float64).ravel(),
+                        minlength=ch * B).reshape(ch, B).astype(np.int64)
+        slack = mask[gs] & (avail_end[None, :, :]
+                            >= p.need_res[gs][:, None, :]).all(axis=2)
+        for j in np.flatnonzero(p.max_replicas[gs] > 0):
+            slack[j] &= (svc_final[svc_idx[g0 + j]]
+                         < int(p.max_replicas[g0 + j]))
+        for j in np.flatnonzero(p.has_ports[gs]):
+            pids = np.flatnonzero(p.group_ports[g0 + j])
+            slack[j] &= ~port_occ[:, pids].any(axis=1)
+        b_slack = np.bincount(flat, weights=slack.ravel(),
+                              minlength=ch * B).reshape(ch, B) > 0
+        ky = k + y
+        poured = y > 0
+        hi = np.where(poured, ky, -big).max(axis=1)
+        lo = np.where(b_slack, ky, big).min(axis=1)
+        valid = poured.any(axis=1) & b_slack.any(axis=1)
+        bad = valid & (hi > lo + 1)
+        assert not bad.any(), (
+            f"group {g0 + int(np.flatnonzero(bad)[0])}: topology "
+            f"imbalance — a poured branch's service total exceeds a "
+            f"slack branch's by more than one")

@@ -19,9 +19,9 @@ import numpy as np
 
 from ..native import hostops as _hostops
 from ..utils import failpoints
-from .encode import UNLIMITED, EncodedProblem
+from .encode import UNLIMITED, VOL_TOPO_MOUNTS, EncodedProblem
 from .nodeinfo import NodeInfo, task_reservations
-from .spread import GroupFill, greedy_fill, tree_fill
+from .spread import GroupFill, binpack_fill, greedy_fill, tree_fill
 
 
 def _group_caps(p: EncodedProblem, gi: int, avail: np.ndarray,
@@ -42,9 +42,9 @@ def _group_caps(p: EncodedProblem, gi: int, avail: np.ndarray,
     return np.clip(caps, 0, UNLIMITED)
 
 
-def cpu_static_mask(p: EncodedProblem) -> np.ndarray:
-    """numpy mirror of ops.placement.build_static_mask."""
-    G, N = p.extra_mask.shape
+def _static_legs(p: EncodedProblem) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """The static mask's component legs — (cons_ok, plat_ok, missing),
+    each [G, N] — shared by cpu_static_mask and cpu_filter_explain."""
     cols = np.clip(p.constraints[:, :, 0], 0, None)
     ops_ = p.constraints[:, :, 1]
     vals = p.constraints[:, :, 2]
@@ -65,7 +65,90 @@ def cpu_static_mask(p: EncodedProblem) -> np.ndarray:
     plat_ok = np.where(has_plat[:, None], plat_hit, True)
 
     missing = (p.req_plugins[:, None, :] & ~p.node_plugins[None, :, :]).any(axis=2)
-    return p.ready[None, :] & cons_ok & plat_ok & ~missing & p.extra_mask
+    return cons_ok, plat_ok, missing
+
+
+def cpu_static_mask(p: EncodedProblem) -> np.ndarray:
+    """numpy mirror of ops.placement.build_static_mask."""
+    cons_ok, plat_ok, missing = _static_legs(p)
+    out = p.ready[None, :] & cons_ok & plat_ok & ~missing & p.extra_mask
+    vt = getattr(p, "vol_topo", None)
+    if vt is not None and vt.shape[1] > 0:
+        out = out & _cpu_vol_topo_ok(p.node_val, vt)
+    return out
+
+
+# reference Pipeline order: DEFAULT_FILTERS + the appended VolumesFilter
+FILTER_LEGS = ("ready", "resource", "plugin", "constraint", "platform",
+               "hostport", "max_replicas", "volumes")
+
+
+def cpu_filter_explain(p: EncodedProblem) -> np.ndarray:
+    """Per-filter failure counts from the ENCODED arrays:
+    int64[G, len(FILTER_LEGS)].
+
+    Mirror of the reference Pipeline's short-circuit `_failures` tally
+    (scheduler/filters.py): each ineligible node is charged to the FIRST
+    failing leg in DEFAULT_FILTERS (+ Volumes) order, evaluated at the
+    PRE-FILL state the Pipeline sees (avail_res / svc_count0 /
+    port_used0). Enablement needs no side channel — a group that never
+    enabled a filter has an empty leg (zero need → zero resource fails,
+    no host ports → no conflicts, cap 0 → unlimited). extra_mask residue
+    is charged to `volumes` (the encoder's zero-candidate blanking and
+    host-side volume fallback both land there); clusters routing
+    NON-volume residue through extra_mask (node.ip constraints) would
+    misattribute those rows to it.
+    """
+    G, N = p.extra_mask.shape
+    cons_ok, plat_ok, missing = _static_legs(p)
+    vt = getattr(p, "vol_topo", None)
+    vol_ok = (_cpu_vol_topo_ok(p.node_val, vt)
+              if vt is not None and vt.shape[1] > 0 else np.ones((G, N), bool))
+    vol_ok = vol_ok & p.extra_mask
+    fails = (
+        np.broadcast_to(~p.ready[None, :], (G, N)),
+        (p.avail_res[None, :, :] < p.need_res[:, None, :]).any(axis=2),
+        missing,
+        ~cons_ok,
+        ~plat_ok,
+        (p.group_ports[:, None, :] & p.port_used0[None, :, :]).any(axis=2),
+        (p.max_replicas[:, None] > 0)
+        & (p.svc_count0[p.svc_idx] >= p.max_replicas[:, None]),
+        ~vol_ok,
+    )
+    counts = np.zeros((G, len(FILTER_LEGS)), np.int64)
+    alive = np.ones((G, N), bool)
+    for li, f in enumerate(fails):
+        hit = alive & f
+        counts[:, li] = hit.sum(axis=1)
+        alive &= ~hit
+    return counts
+
+
+def _cpu_vol_topo_ok(node_val: np.ndarray, vol_topo: np.ndarray) -> np.ndarray:
+    """numpy mirror of ops.placement._vol_topo_ok: a node passes a group's
+    volume leg when EVERY mount has SOME candidate row all of whose
+    (key, value) pairs match the node's columns. Padded keys (-1) match
+    anything; a looked-up value id of -1 matches nothing (no node carries
+    that value). Mount ids beyond the group's rows impose no constraint —
+    zero-candidate mounts were blanked via extra_mask at encode time."""
+    G, VA, W = vol_topo.shape
+    N = node_val.shape[0]
+    mount = vol_topo[:, :, 0]
+    row_ok = np.ones((G, VA, N), bool)
+    for s in range((W - 1) // 2):
+        k = vol_topo[:, :, 1 + 2 * s]
+        v = vol_topo[:, :, 2 + 2 * s]
+        nv = node_val[:, np.clip(k, 0, None)]          # [N, G, VA]
+        ok = (k < 0)[None] | (nv == v[None])
+        row_ok &= np.transpose(ok, (1, 2, 0))
+    vol_ok = np.ones((G, N), bool)
+    for m in range(VOL_TOPO_MOUNTS):
+        is_m = mount == m
+        has_m = is_m.any(axis=1)
+        m_ok = (row_ok & is_m[:, :, None]).any(axis=1)
+        vol_ok &= np.where(has_m[:, None], m_ok, True)
+    return vol_ok
 
 
 def cpu_schedule_encoded(p: EncodedProblem) -> np.ndarray:
@@ -88,13 +171,17 @@ def cpu_schedule_encoded(p: EncodedProblem) -> np.ndarray:
             svc_count=svc.tolist(),
             total_count=totals.tolist(),
         )
-        lmax = 0 if p.spread_rank is None else p.spread_rank.shape[1]
-        if lmax:
-            level_ranks = [p.spread_rank[gi, li].tolist()
-                           for li in range(lmax)]
-            counts = np.array(tree_fill(g, level_ranks), np.int32)
+        if getattr(p, "strategy", "spread") == "binpack":
+            # binpack ignores spread preferences: flat fullest-first fill
+            counts = np.array(binpack_fill(g), np.int32)
         else:
-            counts = np.array(greedy_fill(g), np.int32)
+            lmax = 0 if p.spread_rank is None else p.spread_rank.shape[1]
+            if lmax:
+                level_ranks = [p.spread_rank[gi, li].tolist()
+                               for li in range(lmax)]
+                counts = np.array(tree_fill(g, level_ranks), np.int32)
+            else:
+                counts = np.array(greedy_fill(g), np.int32)
         out[gi] = counts
         totals += counts
         svc_counts[p.svc_idx[gi]] += counts
@@ -134,6 +221,18 @@ def materialize_orders(p: EncodedProblem, counts: np.ndarray) -> list:
         placed = int(c.sum())
         if placed:
             svc = svc_counts[p.svc_idx[gi]]
+            if getattr(p, "strategy", "spread") == "binpack":
+                # binpack slot order = nodes in INITIAL key order
+                # (penalty, -svc, -total, idx), each repeated counts[i]
+                # times — every slot on a node sorts before any slot on
+                # the next node (spread.binpack_slot_order)
+                pen = np.where(p.penalty[gi], 1, 0)
+                order_nodes = np.lexsort(
+                    (node_arange, -totals, -svc, pen))
+                orders.append(np.repeat(order_nodes, c[order_nodes]))
+                totals += c
+                svc_counts[p.svc_idx[gi]] += c
+                continue
             base_k = np.where(p.penalty[gi], PENALTY_BASE, 0) + svc
             idx = np.repeat(node_arange, c)                       # [placed]
             j = np.arange(placed) - np.repeat(np.cumsum(c) - c, c)

@@ -49,6 +49,15 @@ OP_NEQ = 1
 CPU_QUANTUM = 1_000_000      # nano-cpus per milli-core
 MEM_QUANTUM = 4096           # bytes per page
 
+# CSI volume/topology kernel rows (ISSUE 19): a row is one candidate-
+# volume × accessible-topology alternative of one mount — (mount_idx,
+# key_col, val_id, ...) over csi pseudo-key node columns. The static
+# bounds cap the kernel's [G, VA, N] working set; groups past them take
+# the host-side check_volumes_on_node fallback walk.
+VOL_TOPO_SEGS = 4      # segment pairs per row: driver presence + ≤3 topo
+VOL_TOPO_MOUNTS = 4    # distinct CSI mounts encodable per group
+VOL_TOPO_MAX_ALT = 8   # total rows per group before host fallback
+
 
 class Vocab:
     """String interner. id 0 is reserved for the empty string."""
@@ -161,6 +170,19 @@ class EncodedProblem:
     # the placeholder, never change results.
     penalty_nonzero: bool | None = None
     extra_mask_all: bool | None = None
+    # strategy seam (ISSUE 19): which scoring kernel consumers dispatch —
+    # "spread" (default), "binpack" (prefer-fullest, flat), or "topology"
+    # (spread with the encoder-prepended topology level; kernels treat it
+    # as spread). pad_buckets MUST copy it — it changes dispatch.
+    strategy: str = "spread"
+    # CSI volume/topology feasibility rows (ops/placement._vol_topo_ok):
+    # int32[G, VA, 1 + 2*VOL_TOPO_SEGS] of (mount, k0, v0, ...), -1 pad;
+    # VA == 0 when no group mounts CSI volumes (the leg compiles away)
+    vol_topo: np.ndarray = None
+    # O(1) dispatch gate like penalty_nonzero: True = some group has
+    # vol-topo rows, False = provably none, None = unknown (consumer
+    # checks the array shape)
+    vol_topo_any: bool | None = None
 
 
 _INT32_MAX = (1 << 31) - 1
@@ -174,12 +196,25 @@ KERNEL_ARG_FIELDS = (
     "constraints", "plat_req", "req_plugins", "avail_res", "total0",
     "svc_count0", "n_tasks", "svc_idx", "need_res", "max_replicas",
     "penalty", "has_ports", "group_ports", "port_used0", "spread_rank",
+    "vol_topo",
 )
 
 
+def _empty_vol_topo(G: int) -> np.ndarray:
+    return np.full((G, 0, 1 + 2 * VOL_TOPO_SEGS), -1, np.int32)
+
+
 def kernel_args(p: "EncodedProblem") -> tuple:
-    """The problem's arrays in schedule_groups' positional order (numpy)."""
-    return tuple(np.asarray(getattr(p, f)) for f in KERNEL_ARG_FIELDS)
+    """The problem's arrays in schedule_groups' positional order (numpy).
+    A hand-built problem may predate the vol_topo field (None): that is
+    the empty table (no CSI mounts anywhere)."""
+    out = []
+    for f in KERNEL_ARG_FIELDS:
+        v = getattr(p, f, None)
+        if v is None and f == "vol_topo":
+            v = _empty_vol_topo(p.extra_mask.shape[0])
+        out.append(np.asarray(v))
+    return tuple(out)
 
 
 def _bucket(n: int, floor: int = 1) -> int:
@@ -207,10 +242,16 @@ def pad_buckets(p: "EncodedProblem") -> "EncodedProblem":
     C = p.constraints.shape[1]
     P = p.plat_req.shape[1]
     LMAX = p.spread_rank.shape[1]
+    vt = p.vol_topo if p.vol_topo is not None else _empty_vol_topo(G)
+    VA = vt.shape[1]
     Gp, Np, Sp = _bucket(G), _bucket(N), _bucket(S)
     Kp, PLp, PVp, Rp = _bucket(K), _bucket(PL), _bucket(PV), _bucket(R)
     Lp = _bucket(LMAX) if LMAX else 0
-    if (Gp, Np, Sp, Kp, PLp, PVp, Rp, Lp) == (G, N, S, K, PL, PV, R, LMAX):
+    Vp = _bucket(VA) if VA else 0
+    if (Gp, Np, Sp, Kp, PLp, PVp, Rp, Lp, Vp) == (
+            G, N, S, K, PL, PV, R, LMAX, VA):
+        if p.vol_topo is None:
+            p.vol_topo = vt     # normalize for positional consumers
         return p
 
     def pad(a: np.ndarray, shape: tuple, fill=0) -> np.ndarray:
@@ -221,6 +262,8 @@ def pad_buckets(p: "EncodedProblem") -> "EncodedProblem":
     q = EncodedProblem(node_ids=p.node_ids, group_keys=p.group_keys,
                        service_ids=p.service_ids, groups=p.groups,
                        row_infos=p.row_infos, infos_seq=p.infos_seq)
+    q.strategy = p.strategy             # changes dispatch: must survive
+    q.vol_topo_any = p.vol_topo_any     # pad rows are -1 (no mount): safe
     q.ready = pad(p.ready, (Np,), False)
     q.total0 = pad(p.total0, (Np,))
     q.avail_res = pad(p.avail_res, (Np, Rp))
@@ -247,6 +290,11 @@ def pad_buckets(p: "EncodedProblem") -> "EncodedProblem":
             # replicate each group's deepest real level into padded levels
             sr[:G, LMAX:, :N] = p.spread_rank[:, LMAX - 1:LMAX, :]
     q.spread_rank = sr
+    # phantom vol-topo rows are all -1: mount -1 belongs to no real
+    # mount, so they never tighten any group's feasibility
+    q.vol_topo = np.full((Gp, Vp, vt.shape[2]), -1, np.int32)
+    if VA:
+        q.vol_topo[:G, :VA] = vt
     return q
 
 
@@ -296,6 +344,46 @@ def _node_attr_value(node, ck: str) -> str:
     return cands[0] if cands else ""
 
 
+# csi pseudo-keys (ISSUE 19 vol-topo kernel rows): node columns carrying
+# per-driver presence and accessible-topology segments. `_canon_key`
+# never emits a "csi." prefix (predefined keys + label prefixes only),
+# so these can't collide with constraint key columns. Driver names with
+# "/" would alias topo keys — CSI driver names are reverse-DNS, no "/".
+def _csi_presence_key(driver: str) -> str:
+    return "csi.node/" + driver
+
+
+def _csi_topo_key(driver: str, seg: str) -> str:
+    return "csi.topo/" + driver + "/" + seg
+
+
+def _node_key_value(node, ck: str) -> str:
+    """Comparable (vocab) value of node key column `ck`. csi.* pseudo-key
+    values carry an '=' prefix so a node missing the driver/segment
+    (empty string, vocab id 0) can never equal a real required value;
+    topology segment values stay case-SENSITIVE (volumes.go compares
+    exactly). Everything else is a constraint attribute, case-folded per
+    `_canon_value`."""
+    if ck.startswith("csi.node/"):
+        driver = ck[len("csi.node/"):]
+        desc = node.description
+        if desc is None:
+            return ""
+        if (desc.csi_info or {}).get(driver) is not None \
+                or driver in (desc.csi_plugins or ()):
+            return "=1"
+        return ""
+    if ck.startswith("csi.topo/"):
+        driver, _, seg = ck[len("csi.topo/"):].partition("/")
+        desc = node.description
+        ninfo = ((desc.csi_info or {}) if desc else {}).get(driver)
+        if ninfo is None:
+            return ""
+        val = (ninfo.accessible_topology or {}).get(seg)
+        return "" if val is None else "=" + val
+    return _canon_value(ck, _node_attr_value(node, ck))
+
+
 def _node_label(node, kind: str, label: str) -> str:
     if kind == "node":
         labels = node.spec.annotations.labels or {}
@@ -340,10 +428,33 @@ class IncrementalEncoder:
     """
 
     def __init__(self, max_constraints: int = 8, max_platforms: int = 4,
-                 tracked: bool = False):
+                 tracked: bool = False, strategy: str = "spread",
+                 topology: str | None = None):
         self.max_constraints = max_constraints
         self.max_platforms = max_platforms
         self.tracked = tracked
+        # strategy seam (ISSUE 19): stamped onto every emitted problem.
+        # "topology" is spread with the configured axis as the OUTERMOST
+        # spread level of EVERY group — the existing prefix-rank tree and
+        # _tree_water_fill handle it unchanged (and nesting stays sound:
+        # prepending a level keeps one parent per child segment).
+        self.strategy = strategy
+        self._topo_pair: tuple[str, str] | None = None
+        if strategy == "topology":
+            d = topology or ""
+            dl = d.lower()
+            for prefix, kind in ((constraint_mod.NODE_LABEL_PREFIX, "node"),
+                                 (constraint_mod.ENGINE_LABEL_PREFIX,
+                                  "engine")):
+                if dl.startswith(prefix) and len(d) > len(prefix):
+                    self._topo_pair = (kind, d[len(prefix):])
+                    break
+            if self._topo_pair is None:
+                raise ValueError(
+                    "strategy='topology' needs a label topology axis, "
+                    "e.g. topology='node.labels.zone'")
+        elif strategy not in ("spread", "binpack"):
+            raise ValueError(f"unknown placement strategy: {strategy!r}")
         # tracked-mode dirty feed: node id -> NodeInfo (the CURRENT
         # object — a replaced node's mark carries the replacement)
         self._mark_full: dict[str, NodeInfo] = {}
@@ -368,6 +479,13 @@ class IncrementalEncoder:
         # resident group-table cache turns into an O(1) identity hit.
         self._spread_cache: tuple | None = None
         self._label_gen = 0
+        # vol-topo table cache (ISSUE 19): mirrors _spread_cache — a
+        # steady tick re-emits the SAME array object so the resident
+        # group-table cache gets an O(1) identity hit. Keyed by the row
+        # CONTENT (column ids + vocab value ids), so vocab growth or
+        # usage churn rebuilds; the empty table is cached per G.
+        self._voltopo_cache: tuple | None = None
+        self._voltopo_empty: dict[int, np.ndarray] = {}
 
         self.key_cols: dict[str, int] = {}   # canonical constraint key -> col
         self.val_vocab = Vocab()
@@ -573,7 +691,7 @@ class IncrementalEncoder:
             [self.node_val, np.zeros((n, 1), np.int32)], axis=1)
         for i, info in enumerate(self._infos):
             self.node_val[i, col] = self.val_vocab.id(
-                _canon_value(ck, _node_attr_value(info.node, ck)))
+                _node_key_value(info.node, ck))
 
     def _ensure_kind(self, kind: str) -> None:
         if kind in self.kinds:
@@ -729,7 +847,7 @@ class IncrementalEncoder:
         self.ready[i] = self._rf.check(info)
         for ck, col in self.key_cols.items():
             self.node_val[i, col] = self.val_vocab.id(
-                _canon_value(ck, _node_attr_value(node, ck)))
+                _node_key_value(node, ck))
         desc = node.description
         if desc and desc.platform:
             self.node_plat[i, 0] = self.os_vocab.id(desc.platform.os.lower())
@@ -765,6 +883,117 @@ class IncrementalEncoder:
                 col = np.full(len(self._infos), "", object)
             self._label_cols[(kind, label)] = col
         return col
+
+    # ------------------------------------------------------ vol-topo tables
+    def _voltopo_tables(self, groups, volume_set):
+        """Resolve csi-mounting groups to kernel vol-topo rows (ISSUE 19).
+
+        Returns (rows_per_group, fallback_groups, infeasible_groups). A
+        row is (mount_idx, key_col, val_id, ...) over csi pseudo-key
+        columns: the driver-presence pair plus the sorted topology
+        segments of ONE (candidate volume, accessible-topology
+        alternative). Node-independent candidate legs — availability,
+        pending delete, sharing=="none" in use — filter host-side here
+        (volumes.go isVolumeAvailableOnNode order); segment values LOOK
+        UP (encoder contract: a value no node carries resolves to -1,
+        matching nothing). What rows can't express sends the group to
+        the check_volumes_on_node fallback walk: pinned single-scope
+        volumes (usable only on its current nodes), > VOL_TOPO_MOUNTS
+        mounts, a topology with > VOL_TOPO_SEGS-1 segments, or
+        > VOL_TOPO_MAX_ALT total rows. A mount with NO usable candidate
+        at all makes the group infeasible outright (extra_mask blank).
+        """
+        rows_per_group: list[list[tuple[int, ...]]] = [[] for _ in groups]
+        fallback: set[int] = set()
+        infeasible: set[int] = set()
+        if volume_set is None:
+            return rows_per_group, fallback, infeasible
+        from ..csi.volumes import task_csi_mounts
+
+        with volume_set._lock:
+            for gi, g in enumerate(groups):
+                mounts = task_csi_mounts(g.tasks[0])
+                if not mounts:
+                    continue
+                if len(mounts) > VOL_TOPO_MOUNTS:
+                    fallback.add(gi)
+                    continue
+                rows: list[tuple[int, ...]] = []
+                bail = done = False
+                for mi, m in enumerate(mounts):
+                    m_rows: list[tuple[int, ...]] = []
+                    for v in volume_set._candidates(m.source):
+                        if v.spec.availability != "active" \
+                                or v.pending_delete:
+                            continue
+                        u = volume_set.usage.get(v.id)
+                        mode = v.spec.access_mode
+                        if mode.sharing == "none" and u is not None \
+                                and u.tasks:
+                            continue
+                        if mode.scope == "single" and u is not None \
+                                and u.nodes:
+                            bail = True     # pinned to node IDS, not a
+                            break           # (driver, topology) predicate
+                        driver = v.spec.driver
+                        pname = _csi_presence_key(driver)
+                        self._ensure_key(pname)
+                        ppair = (self.key_cols[pname],
+                                 self.val_vocab.lookup("=1"))
+                        info = v.volume_info
+                        topos = (info.accessible_topology
+                                 if info is not None else [])
+                        if not topos:
+                            m_rows.append((mi,) + ppair)
+                            continue
+                        for topo in topos:
+                            if len(topo) > VOL_TOPO_SEGS - 1:
+                                bail = True
+                                break
+                            row = [mi, *ppair]
+                            for k in sorted(topo):
+                                kname = _csi_topo_key(driver, k)
+                                self._ensure_key(kname)
+                                row.append(self.key_cols[kname])
+                                row.append(self.val_vocab.lookup(
+                                    "=" + topo[k]))
+                            m_rows.append(tuple(row))
+                        if bail:
+                            break
+                    if bail:
+                        break
+                    if not m_rows:
+                        # no usable candidate for this mount: no node can
+                        # ever satisfy the group (check_volumes_on_node
+                        # would answer False everywhere)
+                        infeasible.add(gi)
+                        done = True
+                        break
+                    rows.extend(m_rows)
+                if bail or (not done and len(rows) > VOL_TOPO_MAX_ALT):
+                    fallback.add(gi)
+                elif not done:
+                    rows_per_group[gi] = rows
+        return rows_per_group, fallback, infeasible
+
+    def _voltopo_emit(self, rows_per_group, G: int) -> np.ndarray:
+        VA = max((len(r) for r in rows_per_group), default=0)
+        if VA == 0:
+            arr = self._voltopo_empty.get(G)
+            if arr is None:
+                arr = _empty_vol_topo(G)
+                self._voltopo_empty[G] = arr
+            return arr
+        key = tuple(tuple(rs) for rs in rows_per_group)
+        cached = self._voltopo_cache
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        arr = np.full((G, VA, 1 + 2 * VOL_TOPO_SEGS), -1, np.int32)
+        for gi, rs in enumerate(rows_per_group):
+            for ri, row in enumerate(rs):
+                arr[gi, ri, :len(row)] = row
+        self._voltopo_cache = (key, arr)
+        return arr
 
     # --------------------------------------------------------- placement fold
     def apply_counts(self, p: EncodedProblem, counts: np.ndarray) -> bool:
@@ -959,6 +1188,12 @@ class IncrementalEncoder:
                             for k in g.spec.resources.reservations.generic}):
             self._ensure_kind(kind)
 
+        # CSI vol-topo kernel rows (ISSUE 19): resolved EARLY — the csi
+        # pseudo-key columns they intern must exist before the node_val
+        # copy below picks up K
+        vt_rows, vt_fallback, vt_infeasible = self._voltopo_tables(
+            groups, volume_set)
+
         plugin_filter = PluginFilter()
         group_plugin_reqs: list[list[int]] = []
         for g in groups:
@@ -1015,6 +1250,7 @@ class IncrementalEncoder:
             row_infos=list(self._infos),
             infos_seq=self.infos_seq,
         )
+        p.strategy = self.strategy
         svc_row = {s: i for i, s in enumerate(p.service_ids)}
         S = max(len(p.service_ids), 1)
 
@@ -1163,6 +1399,11 @@ class IncrementalEncoder:
             return out
 
         group_spread = [_spread_labels(g) for g in groups]
+        if self._topo_pair is not None:
+            # topology strategy (ISSUE 19): the configured axis becomes
+            # the OUTERMOST level of every group — prefix ranks stay
+            # properly nested, and the tree kernel/oracle are unchanged
+            group_spread = [[self._topo_pair] + s for s in group_spread]
         LMAX = max((len(s) for s in group_spread), default=0)
         skey = (tuple(tuple(s) for s in group_spread), N, LMAX,
                 self._label_gen)
@@ -1210,21 +1451,25 @@ class IncrementalEncoder:
                     p.penalty[gi, i] = True
                     pen_any = True
 
-        # CSI volume feasibility: host-side extra_mask correction, like
-        # node.ip (scheduler/volumes.go isVolumeAvailableOnNode is string/set
-        # logic on small cardinalities — not worth a kernel column)
-        if volume_set is not None:
-            from ..csi.volumes import task_csi_mounts
-
-            for gi, g in enumerate(groups):
-                probe = g.tasks[0]
-                if not task_csi_mounts(probe):
-                    continue
+        # CSI volume feasibility (ISSUE 19): the common shape — driver
+        # presence + accessible-topology match — rides the kernel's
+        # vol_topo rows (built above; ops/placement._vol_topo_ok). What
+        # rows can't express (see _voltopo_tables) keeps the host-side
+        # check_volumes_on_node extra_mask walk, still the oracle; a
+        # mount with NO usable candidate blanks the group outright.
+        for gi in vt_infeasible:
+            p.extra_mask[gi, :] = False
+            extra_all = False
+        if vt_fallback:
+            for gi in sorted(vt_fallback):
+                probe = groups[gi].tasks[0]
                 extra_all = False               # conservative: may write
                 for n, info in enumerate(node_infos):
                     if p.extra_mask[gi, n] and \
                             not volume_set.check_volumes_on_node(info, probe):
                         p.extra_mask[gi, n] = False
+        p.vol_topo = self._voltopo_emit(vt_rows, G)
+        p.vol_topo_any = bool(p.vol_topo.shape[1])
 
         p.penalty_nonzero = pen_any
         p.extra_mask_all = extra_all
@@ -1238,10 +1483,13 @@ def encode(
     max_constraints: int = 8,
     max_platforms: int = 4,
     volume_set=None,
+    strategy: str = "spread",
+    topology: str | None = None,
 ) -> EncodedProblem:
     """One-shot encode: a fresh IncrementalEncoder over the full cluster."""
     enc = IncrementalEncoder(max_constraints=max_constraints,
-                             max_platforms=max_platforms)
+                             max_platforms=max_platforms,
+                             strategy=strategy, topology=topology)
     return enc.encode(node_infos, groups, now=now, volume_set=volume_set)
 
 

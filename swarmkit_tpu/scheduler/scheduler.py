@@ -80,7 +80,8 @@ class Scheduler:
     def __init__(self, store: MemoryStore, backend: str = "auto",
                  jax_threshold: int | None = None, pipeline: bool = False,
                  mesh=None, async_commit: bool = False,
-                 columnar_writeback: bool = True):
+                 columnar_writeback: bool = True,
+                 strategy: str = "spread", topology: str | None = None):
         """backend: "auto" picks per tick by task×node product against
         `jax_threshold` (default JAX_THRESHOLD); "cpu"/"jax" pin the path;
         "mesh" pins the jax path AND shards the device-resident node state
@@ -110,7 +111,16 @@ class Scheduler:
         wave's device dispatch and D2H pull. Every reader of scheduler
         host state (the event handler, the serial tick path, stop)
         takes a worker barrier first; a worker exception re-raises into
-        the next tick, whose existing failure handler owns the heal."""
+        the next tick, whose existing failure handler owns the heal.
+
+        strategy selects the scoring engine for EVERY group (ISSUE 19):
+        "spread" (default water-fill), "binpack" (fullest feasible node
+        first, flat — spread preferences ignored), or "topology"
+        (spread with `topology` — a node.labels.*/engine.labels.*
+        descriptor — prepended as the outermost balance axis of every
+        group). Both new strategies keep the kernel↔CPU-oracle
+        bit-parity bar (scheduler/spread.py binpack_reference /
+        topology_fill are the oracles)."""
         self.store = store
         self.backend = backend
         self.mesh = mesh
@@ -177,7 +187,8 @@ class Scheduler:
         # steady tick's encode skips the O(N) fingerprint scan entirely
         # and nodes_clean degrades to a flag check — the zero-scan fast
         # path AND the encode/commit overlap's gate.
-        self.encoder = IncrementalEncoder(tracked=True)
+        self.encoder = IncrementalEncoder(tracked=True, strategy=strategy,
+                                          topology=topology)
         # device-resident node tables (ops.resident): created on first jax
         # tick; deltas ride the encoder's dirty-row bookkeeping
         self._resident = None

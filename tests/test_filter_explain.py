@@ -1,0 +1,216 @@
+"""Explain parity (ISSUE 19 satellite): per-filter failure counts derived
+from the kernel-side encoded arrays (`batch.cpu_filter_explain`) must equal
+the CPU filter chain's short-circuit `Pipeline._failures` tally, over mixed
+clusters exercising every filter leg — readiness, resources, plugins,
+constraints, platforms, host ports, max-replicas, and CSI volume topology.
+
+CHAOS_SEED discipline: every test derives ALL randomness from its seed and
+prints `CHAOS_SEED=<n>` on failure so the exact cluster is replayable.
+
+The fuzz deliberately avoids `node.ip` constraints: those ride extra_mask
+(host-side residue), which cpu_filter_explain attributes to the volumes
+leg — the one documented misattribution."""
+import random
+
+import numpy as np
+import pytest
+
+from swarmkit_tpu.api.objects import Node, Task, Volume
+from swarmkit_tpu.api.specs import (
+    Annotations,
+    ContainerSpec,
+    EndpointSpec,
+    NodeCSIInfo,
+    NodeDescription,
+    Placement,
+    Platform,
+    PortConfig,
+    Resources,
+    TaskSpec,
+    VolumeAccessMode,
+    VolumeMount,
+    VolumeSpec,
+)
+from swarmkit_tpu.api.types import NodeAvailability, NodeStatusState, TaskState
+from swarmkit_tpu.csi import VolumeSet
+from swarmkit_tpu.csi.plugin import VolumeInfo
+from swarmkit_tpu.scheduler import batch
+from swarmkit_tpu.scheduler.batch import FILTER_LEGS, cpu_filter_explain
+from swarmkit_tpu.scheduler.encode import (
+    CPU_QUANTUM,
+    MEM_QUANTUM,
+    TaskGroup,
+    encode,
+)
+from swarmkit_tpu.scheduler.filters import Pipeline
+from swarmkit_tpu.scheduler.nodeinfo import NodeInfo
+
+LEG_BY_FILTER = {
+    "ReadyFilter": "ready",
+    "ResourceFilter": "resource",
+    "PluginFilter": "plugin",
+    "ConstraintFilter": "constraint",
+    "PlatformFilter": "platform",
+    "HostPortFilter": "hostport",
+    "MaxReplicasFilter": "max_replicas",
+    "VolumesFilter": "volumes",
+}
+
+ZONES = ["z0", "z1", "z2"]
+LABEL_VALS = ["a", "b", "c"]
+
+
+def _mixed_cluster(rng, n_nodes=16, n_groups=8):
+    """A cluster where every filter leg has a chance to fire: DOWN/DRAIN
+    nodes, quantum-multiple reservations vs small nodes, optional nfs
+    volume plugin, label constraints (incl. values no node carries),
+    platform mixes, pre-used host ports colliding with group publishes,
+    preloaded per-service counts vs max-replicas caps, and CSI volumes
+    with topology subsets (incl. a zone no node reports)."""
+    infos = []
+    for i in range(n_nodes):
+        n = Node(id=f"node-{i:04d}")
+        n.status.state = (NodeStatusState.READY if rng.random() < 0.85
+                          else NodeStatusState.DOWN)
+        n.spec.availability = (NodeAvailability.ACTIVE if rng.random() < 0.9
+                               else NodeAvailability.DRAIN)
+        n.spec.annotations = Annotations(name=f"node-{i}", labels=(
+            {"zone": rng.choice(LABEL_VALS)} if rng.random() < 0.8 else {}))
+        n.description = NodeDescription(
+            hostname=f"host-{i}",
+            platform=Platform(os=rng.choice(["linux", "windows"]),
+                              architecture=rng.choice(["x86_64", "arm64"])),
+            resources=Resources(
+                nano_cpus=rng.randint(1, 8) * CPU_QUANTUM * 1000,
+                memory_bytes=rng.randint(1, 8) * MEM_QUANTUM * 1024,
+            ),
+            plugins=[("Volume", "local"), ("Network", "overlay")]
+            + ([("Volume", "nfs")] if rng.random() < 0.5 else []),
+        )
+        if rng.random() < 0.7:
+            n.description.csi_info["fake-csi"] = NodeCSIInfo(
+                plugin_name="fake-csi", node_id=f"csi-{i}",
+                accessible_topology={"zone": rng.choice(ZONES)})
+        info = NodeInfo.new(n, {}, n.description.resources.copy())
+        for gi in range(n_groups):
+            if rng.random() < 0.35:
+                info.active_tasks_count_by_service[f"svc-{gi:03d}"] = \
+                    rng.randint(1, 4)
+        if rng.random() < 0.4:
+            info.used_host_ports.add(("tcp", 8000 + rng.randint(0, 3)))
+        infos.append(info)
+
+    vs = VolumeSet()
+    vol_names = []
+    for vi in range(3):
+        name = f"vol-{vi}"
+        v = Volume(id=f"v{vi}")
+        v.spec = VolumeSpec(
+            annotations=Annotations(name=name),
+            driver="fake-csi",
+            access_mode=VolumeAccessMode(scope="multi", sharing="all"),
+            availability="active",
+        )
+        topo = ([{"zone": "z9"}] if rng.random() < 0.25 else
+                [{"zone": z} for z in rng.sample(ZONES, rng.randint(1, 2))])
+        v.volume_info = VolumeInfo(volume_id=f"csi-v{vi}",
+                                   accessible_topology=topo)
+        vs.add_or_update_volume(v)
+        vol_names.append(name)
+
+    groups = []
+    for gi in range(n_groups):
+        svc = f"svc-{gi:03d}"
+        tasks = []
+        for ti in range(rng.randint(1, 6)):
+            t = Task(id=f"task-{gi:03d}-{ti:05d}", service_id=svc, slot=ti + 1)
+            t.desired_state = TaskState.RUNNING
+            tasks.append(t)
+        mounts = []
+        if rng.random() < 0.4:
+            for j, s in enumerate(rng.sample(vol_names, rng.randint(1, 2))):
+                mounts.append(
+                    VolumeMount(source=s, target=f"/data{j}", type="csi"))
+        if rng.random() < 0.3:
+            mounts.append(
+                VolumeMount(source="nfs/share", target="/nfs", type="volume"))
+        if mounts:
+            tasks[0].spec = TaskSpec(runtime=ContainerSpec(mounts=mounts))
+        spec = tasks[0].spec
+        # node-scale quantum multiples so the resource leg can actually
+        # exceed the smaller nodes (they hold 1-8 of these units)
+        spec.resources.reservations.nano_cpus = \
+            rng.randint(0, 6) * CPU_QUANTUM * 1000
+        spec.resources.reservations.memory_bytes = \
+            rng.randint(0, 6) * MEM_QUANTUM * 1024
+        cons = []
+        if rng.random() < 0.5:
+            cons.append(f"node.labels.zone "
+                        f"{'==' if rng.random() < 0.7 else '!='} "
+                        f"{rng.choice(LABEL_VALS + ['q'])}")
+        spec.placement = Placement(constraints=cons)
+        if rng.random() < 0.3:
+            spec.placement.platforms = [Platform(
+                os=rng.choice(["linux", "windows"]), architecture="x86_64")]
+        if rng.random() < 0.35:
+            spec.placement.max_replicas = rng.randint(1, 3)
+        if rng.random() < 0.4:
+            for t in tasks:
+                t.endpoint = EndpointSpec(ports=[PortConfig(
+                    protocol="tcp", target_port=80,
+                    published_port=8000 + rng.randint(0, 3),
+                    publish_mode="host")])
+        for t in tasks[1:]:
+            t.spec = tasks[0].spec
+        groups.append(TaskGroup(service_id=svc, spec_version=1, tasks=tasks))
+    return infos, groups, vs
+
+
+@pytest.mark.parametrize("seed", range(24))
+def test_explain_matches_pipeline(seed):
+    """Kernel-side per-filter failure counts == the string Pipeline's
+    short-circuit tally, for every group of a mixed cluster."""
+    rng = random.Random(9100 + seed)
+    try:
+        infos, groups, vs = _mixed_cluster(rng)
+        p = encode(infos, groups, volume_set=vs)
+        counts = cpu_filter_explain(p)
+        infos_sorted = sorted(infos, key=lambda i: i.node.id)
+        pipe = Pipeline(volume_set=vs)
+        for gi, g in enumerate(sorted(groups, key=lambda g: g.key)):
+            pipe.set_task(g.tasks[0])
+            survivors = sum(pipe.process(info) for info in infos_sorted)
+            expect = {LEG_BY_FILTER[type(f).__name__]: c
+                      for f, c in pipe._failures.items() if c}
+            got = {leg: int(c)
+                   for leg, c in zip(FILTER_LEGS, counts[gi]) if c}
+            assert got == expect, (
+                f"group {g.key}: kernel {got} != pipeline {expect}")
+            assert int(counts[gi].sum()) == len(infos_sorted) - survivors
+    except AssertionError:
+        print(f"CHAOS_SEED={seed}")
+        raise
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_explain_residual_matches_eligibility(seed):
+    """Nodes NOT charged to any leg are exactly the statically eligible
+    nodes with positive pre-fill dynamic capacity — the population both
+    fill engines start from."""
+    rng = random.Random(9400 + seed)
+    try:
+        infos, groups, vs = _mixed_cluster(rng)
+        p = encode(infos, groups, volume_set=vs)
+        counts = cpu_filter_explain(p)
+        eligible = batch.cpu_static_mask(p)
+        avail = p.avail_res.astype(np.int64)
+        port_used = p.port_used0
+        N = eligible.shape[1]
+        for gi in range(counts.shape[0]):
+            svc = p.svc_count0[p.svc_idx[gi]].astype(np.int64)
+            caps = batch._group_caps(p, gi, avail, svc, port_used)
+            ok = int((eligible[gi] & (caps > 0)).sum())
+            assert int(counts[gi].sum()) == N - ok
+    except AssertionError:
+        print(f"CHAOS_SEED={seed}")
+        raise

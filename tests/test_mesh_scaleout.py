@@ -195,6 +195,7 @@ def test_placeholder_singletons_are_identity_stable():
     p0 = enc.encode(infos, w0)
     counts0 = rp.schedule(p0)
     assert rp._gsrc[7] is res_mod._PLACEHOLDER_FALSE      # penalty off
+    assert rp._gsrc[12] is res_mod._PLACEHOLDER_VOLTOPO   # no CSI volumes
     _commit_wave(enc, rp, infos, p0, counts0)
     p1 = enc.encode(infos, w1)
     gt0 = rp.uploads_group_tables
@@ -202,6 +203,133 @@ def test_placeholder_singletons_are_identity_stable():
     assert rp.uploads_group_tables == gt0, \
         "placeholder slots must identity-hit, not re-ship"
     _commit_wave(enc, rp, infos, p1, counts1)
+
+
+def _csi_cluster(n_nodes=24, n_groups=3, tasks_per_group=8):
+    """CSI cluster for the vol-topo mask leg: nodes in 3 zones, one
+    volume accessible from z0/z2, every group mounting it."""
+    import sys
+    sys.path.insert(0, "tests")
+    from test_placement_parity import _plain_node
+
+    from swarmkit_tpu.api.objects import Task, Volume
+    from swarmkit_tpu.api.specs import (
+        Annotations,
+        ContainerSpec,
+        NodeCSIInfo,
+        TaskSpec,
+        VolumeAccessMode,
+        VolumeMount,
+        VolumeSpec,
+    )
+    from swarmkit_tpu.api.types import TaskState
+    from swarmkit_tpu.csi import VolumeSet
+    from swarmkit_tpu.csi.plugin import VolumeInfo
+    from swarmkit_tpu.scheduler.encode import TaskGroup
+
+    infos = []
+    for i in range(n_nodes):
+        info = _plain_node(i, {})
+        info.node.description.csi_info["fake-csi"] = NodeCSIInfo(
+            plugin_name="fake-csi", node_id=f"csi-{i}",
+            accessible_topology={"zone": f"z{i % 3}"})
+        infos.append(info)
+    vs = VolumeSet()
+    v = Volume(id="v0")
+    v.spec = VolumeSpec(
+        annotations=Annotations(name="vol-0"), driver="fake-csi",
+        access_mode=VolumeAccessMode(scope="multi", sharing="all"),
+        availability="active")
+    v.volume_info = VolumeInfo(
+        volume_id="csi-v0",
+        accessible_topology=[{"zone": "z0"}, {"zone": "z2"}])
+    vs.add_or_update_volume(v)
+
+    def wave(tag):
+        groups = []
+        for gi in range(n_groups):
+            tasks = []
+            for ti in range(tasks_per_group):
+                t = Task(id=f"{tag}-task-{gi:03d}-{ti:05d}",
+                         service_id=f"svc-{gi:03d}", slot=ti + 1)
+                t.desired_state = TaskState.RUNNING
+                tasks.append(t)
+            tasks[0].spec = TaskSpec(runtime=ContainerSpec(
+                mounts=[VolumeMount(source="vol-0", target="/data",
+                                    type="csi")]))
+            for t in tasks[1:]:
+                t.spec = tasks[0].spec
+            groups.append(TaskGroup(service_id=f"svc-{gi:03d}",
+                                    spec_version=1, tasks=tasks))
+        return groups
+
+    return infos, vs, wave("w0"), wave("w1")
+
+
+def test_voltopo_tables_identity_stable_and_cached():
+    """ISSUE 19: the encoder re-emits unchanged CSI vol-topo rows as the
+    SAME object, the resident group cache identity-hits them (0 ships on
+    the steady wave), and kernel/oracle parity holds with the leg live."""
+    mesh = make_mesh(8)
+    infos, vs, w0, w1 = _csi_cluster()
+    enc = IncrementalEncoder()
+    rp = res_mod.ResidentPlacement(enc, mesh=mesh)
+    p0 = enc.encode(infos, w0, volume_set=vs)
+    assert p0.vol_topo_any is True and p0.vol_topo.shape[1] > 0
+    counts0 = rp.schedule(p0)
+    _commit_wave(enc, rp, infos, p0, counts0)
+    assert rp._gsrc[12] is p0.vol_topo
+
+    p1 = enc.encode(infos, w1, volume_set=vs)
+    assert p1.vol_topo is p0.vol_topo, \
+        "steady encode rebuilt the vol-topo table"
+    full0, gt0 = rp.uploads_full, rp.uploads_group_tables
+    counts1 = rp.schedule(p1)
+    assert rp.uploads_full == full0, "steady tick re-uploaded the carry"
+    assert rp.uploads_group_tables == gt0, \
+        "identity-stable vol-topo rows re-shipped"
+    _commit_wave(enc, rp, infos, p1, counts1)
+    # placements confined to the volume's zones (z0/z2 — node i is z{i%3})
+    placed = np.flatnonzero(counts1.sum(axis=0) > 0)
+    assert placed.size > 0 and not (placed % 3 == 1).any(), \
+        "a task placed in a zone the volume cannot reach"
+
+
+def test_binpack_mesh_steady_tick_opcount():
+    """ISSUE 19: the binpack strategy rides the same steady-tick
+    machinery — identity-stable group tables, zero re-uploads on the
+    steady wave, oracle parity via the strategy-aware dispatch."""
+    mesh = make_mesh(8)
+    enc = IncrementalEncoder(strategy="binpack")
+    rp = res_mod.ResidentPlacement(enc, mesh=mesh)
+    infos, w0, w1 = _two_waves()
+    p0 = enc.encode(infos, w0)
+    assert p0.strategy == "binpack"
+    counts0 = rp.schedule(p0)
+    _commit_wave(enc, rp, infos, p0, counts0)
+    p1 = enc.encode(infos, w1)
+    full0, gt0 = rp.uploads_full, rp.uploads_group_tables
+    counts1 = rp.schedule(p1)
+    assert (rp.uploads_full, rp.uploads_group_tables) == (full0, gt0), \
+        "binpack steady wave broke the zero-ship contract"
+    _commit_wave(enc, rp, infos, p1, counts1)
+
+
+@pytest.mark.parametrize("strategy", ["binpack", "topology"])
+def test_synth_strategy_sampled_parity(strategy):
+    """The sampled-shard oracle is strategy-aware: binpack and topology
+    fills on the shard-partitioned grid match the sliced CPU oracle on
+    every shard, and the grown invariant sweep stays green."""
+    p, gshard = synth_shard_cluster(8 * 32, 8, groups_per_shard=2,
+                                    tasks_per_group=100, seed=3, lmax=2,
+                                    strategy=strategy)
+    assert p.strategy == strategy
+    mesh = make_mesh(8)
+    counts = sharded_schedule(p, mesh)
+    np.testing.assert_array_equal(counts, batch.cpu_schedule_encoded(p))
+    checked = sampled_shard_parity(p, counts, gshard, 8, list(range(8)))
+    assert checked == list(range(8))
+    check_fill_invariants(p, counts)
 
 
 def test_chunked_shard_problem_matches_plain():

@@ -39,4 +39,4 @@ def test_graft_entry_uses_model():
 
     fn, args = __graft_entry__.entry()
     assert fn is cluster_step
-    assert len(args) == 2 + 20  # acks, quorum + KERNEL_ARG_FIELDS
+    assert len(args) == 2 + 21  # acks, quorum + KERNEL_ARG_FIELDS

@@ -25,3 +25,4 @@ def test_dryrun_multichip_8(capsys):
     out = capsys.readouterr().out
     assert "placement parity ok" in out
     assert "SCALE-OUT fused step ok" in out
+    assert "strategies ok (binpack=" in out

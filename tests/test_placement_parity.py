@@ -220,6 +220,214 @@ def test_max_replicas_respected():
     np.testing.assert_array_equal(counts, batch.cpu_schedule_encoded(p))
 
 
+# ---------------------------------------------------------------------------
+# ISSUE 19: binpack + topology-aware strategies and the CSI vol-topo mask leg
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_binpack_kernel_matches_cpu_oracle(seed):
+    """Binpack fills must be bit-identical kernel vs CPU greedy oracle,
+    over the same randomized clusters as the spread fuzz."""
+    rng = random.Random(7000 + seed)
+    infos, groups = random_cluster(rng)
+    p = encode(infos, groups, strategy="binpack")
+    assert p.strategy == "binpack"
+    cpu_counts = batch.cpu_schedule_encoded(p)
+    tpu_counts = batch.tpu_schedule_encoded(p)
+    np.testing.assert_array_equal(cpu_counts, tpu_counts)
+    for gi in range(len(groups)):
+        assert cpu_counts[gi].sum() <= p.n_tasks[gi]
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_binpack_greedy_equals_closed_form(seed):
+    """binpack_fill (heap greedy) == binpack_reference (sequential
+    consumption in initial-key order) — the equivalence the kernel's
+    closed form rests on: an assignment strictly improves the assigned
+    node's key, so greedy never switches nodes before capacity exhausts."""
+    from swarmkit_tpu.scheduler.spread import (
+        GroupFill,
+        binpack_fill,
+        binpack_reference,
+    )
+
+    rng = random.Random(7100 + seed)
+    n = 24
+    for _ in range(25):
+        g = GroupFill(
+            n_tasks=rng.randint(0, 60),
+            eligible=[rng.random() < 0.8 for _ in range(n)],
+            capacity=[rng.randint(0, 5) for _ in range(n)],
+            penalty=[rng.random() < 0.15 for _ in range(n)],
+            svc_count=[rng.randint(0, 4) for _ in range(n)],
+            total_count=[rng.randint(0, 6) for _ in range(n)],
+        )
+        assert binpack_fill(g) == binpack_reference(g)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_binpack_materialize_matches_slot_order(seed):
+    """Binpack materialization must reproduce the per-slot oracle
+    (spread.binpack_slot_order): nodes consumed in initial-key order,
+    each node's slots consecutive, with sequential svc/total carry-over
+    between groups."""
+    from swarmkit_tpu.scheduler.spread import GroupFill, binpack_slot_order
+
+    rng = random.Random(7200 + seed)
+    infos, groups = random_cluster(rng)
+    p = encode(infos, groups, strategy="binpack")
+    counts = batch.cpu_schedule_encoded(p)
+
+    expected = {}
+    totals = p.total0.astype(np.int64).copy()
+    svc_counts = p.svc_count0.astype(np.int64).copy()
+    for gi, group in enumerate(p.groups):
+        c = counts[gi]
+        g = GroupFill(
+            n_tasks=int(p.n_tasks[gi]),
+            eligible=[True] * len(p.node_ids),
+            capacity=c.tolist(),
+            penalty=p.penalty[gi].tolist(),
+            svc_count=svc_counts[p.svc_idx[gi]].tolist(),
+            total_count=totals.tolist(),
+        )
+        for task, node_i in zip(group.tasks, binpack_slot_order(g, c.tolist())):
+            expected[task.id] = p.node_ids[node_i]
+        totals += c
+        svc_counts[p.svc_idx[gi]] += c
+
+    assert batch.materialize(p, counts) == expected
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_topology_strategy_matches_oracle(seed):
+    """Topology-aware spread: the configured axis rides as the OUTERMOST
+    spread level of every group; kernel and CPU tree oracle must stay
+    bit-identical with it active."""
+    rng = random.Random(7300 + seed)
+    infos, groups = random_cluster(rng)
+    p = encode(infos, groups, strategy="topology",
+               topology="node.labels.zone")
+    # every group carries the topology axis as level 0
+    assert p.spread_rank.shape[1] >= 1
+    cpu_counts = batch.cpu_schedule_encoded(p)
+    tpu_counts = batch.tpu_schedule_encoded(p)
+    np.testing.assert_array_equal(cpu_counts, tpu_counts)
+
+
+def _plain_node(i, labels):
+    n = Node(id=f"node-{i:04d}")
+    n.status.state = NodeStatusState.READY
+    n.spec.availability = NodeAvailability.ACTIVE
+    n.spec.annotations = Annotations(name=f"node-{i}", labels=labels)
+    n.description = NodeDescription(
+        hostname=f"host-{i}",
+        platform=Platform(os="linux", architecture="x86_64"),
+        resources=Resources(nano_cpus=64 * CPU_QUANTUM * 1000,
+                            memory_bytes=256 * MEM_QUANTUM * 1024),
+        plugins=[("Volume", "local")],
+    )
+    return NodeInfo.new(n, {}, n.description.resources.copy())
+
+
+def test_topology_balances_zones():
+    """Semantic pin: with uniform capacity and empty initial load, the
+    topology strategy splits a group's replicas evenly across the axis."""
+    infos = [_plain_node(i, {"zone": "abc"[i % 3]}) for i in range(9)]
+    g = random_group(random.Random(0), 0, 9)
+    g.spec.placement = Placement()
+    for t in g.tasks:
+        t.endpoint = None
+    g.spec.resources.reservations.nano_cpus = 0
+    g.spec.resources.reservations.memory_bytes = 0
+    p = encode(infos, [g], strategy="topology", topology="node.labels.zone")
+    counts = batch.tpu_schedule_encoded(p)
+    np.testing.assert_array_equal(counts, batch.cpu_schedule_encoded(p))
+    per_zone = {}
+    for i, c in enumerate(counts[0]):
+        per_zone[i % 3] = per_zone.get(i % 3, 0) + int(c)
+    assert per_zone == {0: 3, 1: 3, 2: 3}
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_vol_topo_mask_matches_volume_walk(seed):
+    """The kernel's vol-topo mask leg must agree with the CPU
+    check_volumes_on_node walk for every (group, node) pair, and kernel
+    vs CPU fills must stay bit-identical with CSI volumes active."""
+    from swarmkit_tpu.api.objects import Volume
+    from swarmkit_tpu.api.specs import (
+        ContainerSpec,
+        NodeCSIInfo,
+        TaskSpec,
+        VolumeAccessMode,
+        VolumeMount,
+        VolumeSpec,
+    )
+    from swarmkit_tpu.csi import VolumeSet
+    from swarmkit_tpu.csi.plugin import VolumeInfo
+
+    rng = random.Random(7400 + seed)
+    zones = ["z0", "z1", "z2"]
+    infos = []
+    for i in range(12):
+        info = _plain_node(i, {})
+        info.node.description.csi_info["fake-csi"] = NodeCSIInfo(
+            plugin_name="fake-csi", node_id=f"csi-{i}",
+            accessible_topology={"zone": rng.choice(zones)},
+        )
+        infos.append(info)
+
+    vs = VolumeSet()
+    vol_names = []
+    for vi in range(4):
+        name = f"vol-{vi}"
+        v = Volume(id=f"v{vi}")
+        v.spec = VolumeSpec(
+            annotations=Annotations(name=name),
+            driver="fake-csi",
+            access_mode=VolumeAccessMode(scope="multi", sharing="all"),
+            availability="active",
+        )
+        v.volume_info = VolumeInfo(
+            volume_id=f"csi-v{vi}",
+            accessible_topology=[
+                {"zone": z} for z in rng.sample(zones, rng.randint(1, 2))
+            ],
+        )
+        vs.add_or_update_volume(v)
+        vol_names.append(name)
+
+    groups = []
+    for gi in range(4):
+        tasks = []
+        srcs = rng.sample(vol_names, rng.randint(1, 2))
+        for ti in range(rng.randint(1, 8)):
+            t = Task(id=f"task-{gi:03d}-{ti:05d}", service_id=f"svc-{gi:03d}",
+                     slot=ti + 1)
+            t.desired_state = TaskState.RUNNING
+            tasks.append(t)
+        tasks[0].spec = TaskSpec(runtime=ContainerSpec(
+            mounts=[VolumeMount(source=s, target=f"/data{j}", type="csi")
+                    for j, s in enumerate(srcs)]))
+        for t in tasks[1:]:
+            t.spec = tasks[0].spec
+        groups.append(TaskGroup(service_id=f"svc-{gi:03d}", spec_version=1,
+                                tasks=tasks))
+
+    p = encode(infos, groups, volume_set=vs)
+    assert p.vol_topo_any in (True, False)
+    mask = batch.cpu_static_mask(p)
+    infos_sorted = sorted(infos, key=lambda i: i.node.id)
+    for gi, g in enumerate(sorted(groups, key=lambda g: g.key)):
+        for ni, info in enumerate(infos_sorted):
+            expected = vs.check_volumes_on_node(info.node, g.tasks[0])
+            assert mask[gi, ni] == expected, (
+                f"group {g.key} node {info.node.id}: "
+                f"mask={bool(mask[gi, ni])} walk={expected}")
+    np.testing.assert_array_equal(batch.cpu_schedule_encoded(p),
+                                  batch.tpu_schedule_encoded(p))
+
+
 def test_host_ports_exclusive():
     rng = random.Random(6)
     infos, groups = random_cluster(rng, n_nodes=6, n_groups=2, max_tasks=10)

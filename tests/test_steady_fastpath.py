@@ -161,6 +161,69 @@ def test_steady_encode_is_zero_scan_and_clean_is_o1():
     assert enc_s.nodes_clean(infos) and enc_s.fp_scans == s0 + 1
 
 
+def test_voltopo_and_strategy_keep_zero_scan_and_o1_flags():
+    """ISSUE 19: CSI vol-topo groups and a non-spread strategy ride the
+    steady zero-scan path unchanged, and the encoder stamps the O(1)
+    dispatch flags exactly — `vol_topo_any` like `penalty_nonzero`
+    (None = unknown → the resident dispatch falls back to inspecting
+    the table shape)."""
+    from swarmkit_tpu.api.objects import Volume
+    from swarmkit_tpu.api.specs import (
+        Annotations,
+        ContainerSpec,
+        NodeCSIInfo,
+        TaskSpec,
+        VolumeAccessMode,
+        VolumeMount,
+        VolumeSpec,
+    )
+    from swarmkit_tpu.csi import VolumeSet
+    from swarmkit_tpu.csi.plugin import VolumeInfo
+
+    rng = random.Random(3)
+    infos = [make_info(rng, i) for i in range(20)]
+    for i, info in enumerate(infos):
+        info.node.description.csi_info["fake-csi"] = NodeCSIInfo(
+            plugin_name="fake-csi", node_id=f"csi-{i}",
+            accessible_topology={"zone": f"z{i % 3}"})
+    vs = VolumeSet()
+    v = Volume(id="v0")
+    v.spec = VolumeSpec(annotations=Annotations(name="vol-0"),
+                        driver="fake-csi",
+                        access_mode=VolumeAccessMode(scope="multi",
+                                                     sharing="all"),
+                        availability="active")
+    v.volume_info = VolumeInfo(
+        volume_id="csi-v0",
+        accessible_topology=[{"zone": "z0"}, {"zone": "z2"}])
+    vs.add_or_update_volume(v)
+
+    groups = make_groups(rng, 2)
+    groups[0].tasks[0].spec = TaskSpec(runtime=ContainerSpec(
+        mounts=[VolumeMount(source="vol-0", target="/data", type="csi")]))
+    for t in groups[0].tasks[1:]:
+        t.spec = groups[0].tasks[0].spec
+
+    enc = IncrementalEncoder(tracked=True, strategy="binpack")
+    p = enc.encode(infos, groups, now=NOW, volume_set=vs)
+    assert p.vol_topo_any is True and p.vol_topo.shape[1] > 0
+    assert p.strategy == "binpack"
+    cold_scans = enc.fp_scans
+    for _ in range(5):
+        p = enc.encode(infos, groups, now=NOW, volume_set=vs)
+        assert enc.last_dirty == 0
+        assert p.vol_topo_any is True          # exact, re-stamped per encode
+    assert enc.fp_scans == cold_scans, \
+        "vol-topo/strategy steady encode paid a fingerprint scan"
+    # kernel ≡ oracle with both active on the steady problem
+    np.testing.assert_array_equal(batch.cpu_schedule_encoded(p),
+                                  batch.tpu_schedule_encoded(p))
+    # no CSI mounts anywhere → the leg compiles away and the flag says so
+    enc2 = IncrementalEncoder(tracked=True)
+    p2 = enc2.encode(infos, make_groups(rng, 2), now=NOW)
+    assert p2.vol_topo_any is False and p2.vol_topo.shape[1] == 0
+
+
 def test_marked_rows_reencode_without_scan():
     rng = random.Random(2)
     infos = [make_info(rng, i) for i in range(16)]
