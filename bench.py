@@ -2667,6 +2667,248 @@ def bench_recovery_plane(np, n_tasks=100_000):
     }
 
 
+def bench_log_fanout_storm(np, n_subs=100_000, rounds=3, batch=32,
+                           slow_frac=0.01, slow_limit=8,
+                           permsg_subs=10_000, parity_subs=64,
+                           parity_seed=7):
+    """Log fan-out plane acceptance row (ISSUE 20): an `n_subs`-
+    subscriber publish storm against the sharded broker (driven —
+    offers inline, so throughput numbers measure the fan-out path, not
+    thread scheduling). Gates:
+
+    * ZERO-LOSS for in-limit subscribers (default client bound, drained
+      each round): delivered == published, shed == 0;
+    * EXACT shed accounting on the slow cohort (tiny bound, never
+      drained): delivered + shed == published per subscriber, and the
+      in-stream LogShedRecord window matches the shed count with the
+      stream resuming after it;
+    * batched delivery >= 10x the per-message fan-out on the same
+      shapes (one publish_logs burst of `batch` vs `batch` single-
+      message calls);
+    * `disarmed_publish_allocs == 0` — the telemetry-off storm never
+      calls the armed recorder (spy on _record_publish + the registry
+      snapshot builder, the telemetry_plane discipline);
+    * a seeded sharded ≡ single-plane wire-parity mini-run (order-
+      normalized streams + completion records; the 20-seed fuzz lives
+      in tests/test_logbroker_sharded.py).
+    Lag p99 (publish-call completion minus batch build stamp) is
+    reported for the bounded-lag acceptance."""
+    from swarmkit_tpu.api.objects import Task as _Task
+    from swarmkit_tpu.logbroker.broker import (
+        LogBroker,
+        LogMessage,
+        LogSelector,
+        LogShedRecord,
+        SubscriptionComplete,
+        make_log_message,
+    )
+    from swarmkit_tpu.logbroker.sharded import ShardedLogBroker, ShedChannel
+    from swarmkit_tpu.store.memory import MemoryStore
+    from swarmkit_tpu.utils import telemetry
+    from swarmkit_tpu.utils.metrics import snapshot_counter_value
+    from swarmkit_tpu.utils.slo import quantile_nearest_rank
+
+    store = MemoryStore()
+    task = _Task(id="t-log", service_id="svc-log", slot=1)
+    task.node_id = "n-log"
+    store.update(lambda tx: tx.create(task))
+    sel = LogSelector(service_ids=["svc-log"])
+
+    broker = ShardedLogBroker(store)
+    broker.listen_subscriptions("n-log")
+    n_slow = max(1, int(n_subs * slow_frac))
+    t0 = time.perf_counter()
+    subs = [broker.subscribe_logs(sel, follow=True,
+                                  limit=(slow_limit if i < n_slow else -1))
+            for i in range(n_subs)]
+    subscribe_s = time.perf_counter() - t0
+
+    # disarmed-cost spies (telemetry_plane discipline): the storm below
+    # runs with the plane off; one armed-recorder call is a failure
+    spy = {"records": 0, "snaps": 0}
+    orig_record = broker._record_publish
+    broker._record_publish = (
+        lambda *a, **k: spy.__setitem__("records", spy["records"] + 1))
+    from swarmkit_tpu.utils import metrics as metrics_mod
+    orig_snap_builder = metrics_mod.registry_snapshot
+    metrics_mod.registry_snapshot = (
+        lambda *a, **k: (spy.__setitem__("snaps", spy["snaps"] + 1),
+                         orig_snap_builder(*a, **k))[1])
+
+    lag_samples = []
+    lag_every = max(1, n_subs // 64)
+    t0 = time.perf_counter()
+    try:
+        for r in range(rounds):
+            msgs = [make_log_message(task, "stdout", b"x" * 16)
+                    for _ in range(batch)]
+            stamp = msgs[-1].timestamp
+            for i, (sid, ch) in enumerate(subs):
+                broker.publish_logs(sid, msgs)
+                if i % lag_every == 0:
+                    lag_samples.append(max(0.0, time.time() - stamp))
+            # in-limit subscribers drain between rounds (the consumer
+            # half of "in-limit"); the slow cohort never does
+            for _sid, ch in subs[n_slow:]:
+                ch.drain()
+    finally:
+        broker._record_publish = orig_record
+        metrics_mod.registry_snapshot = orig_snap_builder
+    batched_s = time.perf_counter() - t0
+    batched_msgs = n_subs * batch * rounds
+
+    # per-message fan-out on the same shapes, a subsample scaled up
+    pm_subs = subs[n_slow:n_slow + min(permsg_subs, n_subs - n_slow)]
+    pm_msgs = [make_log_message(task, "stdout", b"x" * 16)
+               for _ in range(batch)]
+    t0 = time.perf_counter()
+    for sid, ch in pm_subs:
+        for m in pm_msgs:
+            broker.publish_logs(sid, [m])
+    permsg_s = time.perf_counter() - t0
+    for _sid, ch in pm_subs:
+        ch.drain()
+    batched_rate = batched_msgs / max(batched_s, 1e-9)
+    permsg_rate = (len(pm_subs) * batch) / max(permsg_s, 1e-9)
+
+    # accounting gates
+    zero_loss = all(ch.shed == 0 and ch.delivered == ch.published
+                    for _sid, ch in subs[n_slow:])
+    acct_exact = all(ch.delivered + ch.shed == ch.published
+                     for _sid, ch in subs)
+    shed_total = sum(ch.shed for _sid, ch in subs)
+    # shed-and-resume on one slow subscriber: the drained stream must
+    # carry ONE pending window marker with the exact count, then resume
+    slow_sid, slow_ch = subs[0]
+    pre_shed = slow_ch.shed
+    drained = slow_ch.drain()
+    markers = [m for m in drained if isinstance(m, LogShedRecord)]
+    resume_ok = (len(markers) == 1 and markers[0].count == pre_shed
+                 and pre_shed > 0)
+    broker.publish_logs(slow_sid, [make_log_message(task, "stdout", b"r")])
+    resumed = slow_ch.drain()
+    resume_ok = resume_ok and len(resumed) == 1 and isinstance(
+        resumed[0], LogMessage)
+    snap = broker.metrics_snapshot()
+    snap_exact = snap["published"] == snap["delivered"] + snap["shed"]
+
+    # armed leg: the families populate and the disarmed spies were cold
+    with telemetry.armed():
+        broker.publish_logs(subs[-1][0],
+                            [make_log_message(task, "stdout", b"a")])
+    armed_published = snapshot_counter_value(
+        metrics_mod.registry_snapshot(),
+        "swarm_logbroker_published_total",
+        (str(stable_shard_for_bench("n-log", broker.shards)),))
+
+    # sharded ≡ single-plane wire parity, one seeded mini-run (the
+    # 20-seed fuzz is tier-1); order-normalized per-subscription streams
+    wire_parity = _log_wire_parity_run(np, parity_subs, parity_seed)
+
+    parity = bool(zero_loss and acct_exact and resume_ok and snap_exact
+                  and wire_parity and spy["records"] == 0
+                  and spy["snaps"] == 0 and armed_published >= 1)
+    return {
+        "parity": parity,
+        "subs": n_subs,
+        "slow_subs": n_slow,
+        "rounds": rounds,
+        "batch": batch,
+        "shards": broker.shards,
+        "subscribe_s": round(subscribe_s, 4),
+        "published_total": snap["published"],
+        "delivered_total": snap["delivered"],
+        "shed_total": shed_total,
+        "zero_loss_in_limit": zero_loss,
+        "shed_accounting_exact": acct_exact,
+        "shed_resume_ok": resume_ok,
+        "snapshot_accounting_exact": snap_exact,
+        "wire_parity": wire_parity,
+        "batched_msgs_per_s": round(batched_rate, 1),
+        "per_message_msgs_per_s": round(permsg_rate, 1),
+        "batched_speedup_x": round(batched_rate / max(permsg_rate, 1e-9),
+                                   2),
+        "lag_p99_s": round(quantile_nearest_rank(lag_samples, 99) or 0.0,
+                           6),
+        "disarmed_publish_allocs": spy["records"] + spy["snaps"],
+        "armed_publish_records": armed_published,
+    }
+
+
+def stable_shard_for_bench(node_id, shards):
+    from swarmkit_tpu.dispatcher.heartbeat import stable_shard
+
+    return stable_shard(node_id, shards)
+
+
+def _log_wire_parity_run(np, n_subs, seed):
+    """One seeded op sequence driven against BOTH broker planes
+    (un-started — deterministic), comparing per-subscription client
+    streams (message payload sequences — publish order is program
+    order, so exact equality) and completion records (error fragments
+    order-normalized: the two planes may iterate notify sets
+    differently)."""
+    from swarmkit_tpu.api.objects import Task as _Task
+    from swarmkit_tpu.logbroker.broker import (
+        LogBroker,
+        LogMessage,
+        LogSelector,
+        SubscriptionComplete,
+        make_log_message,
+    )
+    from swarmkit_tpu.logbroker.sharded import ShardedLogBroker
+    from swarmkit_tpu.store.memory import MemoryStore
+
+    def run(make_broker):
+        rng = np.random.default_rng(seed)
+        store = MemoryStore()
+        tasks = []
+        for i in range(8):
+            t = _Task(id=f"pt{i}", service_id=f"psvc{i % 4}", slot=i + 1)
+            t.node_id = f"pn{i % 4}"
+            tasks.append(t)
+        store.update(lambda tx: [tx.create(t) for t in tasks])
+        broker = make_broker(store)
+        for i in range(3):          # pn3 never listens
+            broker.listen_subscriptions(f"pn{i}")
+        streams = {}
+        subs = []
+        for i in range(n_subs):
+            follow = bool(rng.integers(0, 2))
+            svc = f"psvc{int(rng.integers(0, 4))}"
+            sid, ch = broker.subscribe_logs(
+                LogSelector(service_ids=[svc]), follow=follow, limit=None)
+            subs.append((i, sid, ch, svc))
+        for i, sid, ch, svc in subs:
+            t = tasks[int(rng.integers(0, 8))]
+            k = int(rng.integers(1, 5))
+            broker.publish_logs(sid, [
+                make_log_message(t, "stdout", bytes([i % 251, j]))
+                for j in range(k)])
+            if rng.integers(0, 3) == 0:
+                broker.publish_logs(sid, [], node_id=t.node_id, close=True,
+                                    error=("pump died"
+                                           if rng.integers(0, 2) else ""))
+        prefix = ("warning: incomplete log stream. some logs could not "
+                  "be retrieved for the following reasons: ")
+        for i, sid, ch, svc in subs:
+            out = ch.drain()
+            data = tuple(m.data for m in out if isinstance(m, LogMessage))
+            comp = [m for m in out if isinstance(m, SubscriptionComplete)]
+            err = None
+            if comp:
+                text = comp[0].error
+                if text.startswith(prefix):
+                    text = text[len(prefix):]
+                # order-normalized: the planes may iterate notify sets
+                # (and therefore record warnings) in different orders
+                err = tuple(sorted(text.split(", "))) if text else ()
+            streams[i] = (data, err, ch.closed)
+        return streams
+
+    return run(lambda s: LogBroker(s)) == run(lambda s: ShardedLogBroker(s))
+
+
 def bench_host_micro(np):
     """The BASELINE.md harness rows the reference ships benchmarks for
     but no numbers (store ops memory_test.go:2028-2120, watch queue at
@@ -3022,6 +3264,11 @@ def main():
         # object-walk rebuild at 100k tasks, plus the stream framing
         # the resumable snapshot catch-up ships the same blob with
         ("recovery_restore_100k", lambda: bench_recovery_plane(np)),
+        # ISSUE 20: log fan-out plane — 100k-subscriber publish storm
+        # (zero-loss for in-limit subscribers, exact shed accounting,
+        # batched delivery vs per-message fan-out, disarmed publish
+        # allocs == 0, sharded ≡ scalar wire parity)
+        ("log_fanout_storm_100k", lambda: bench_log_fanout_storm(np)),
     ]
     configs = {name: _run_row(name, thunk) for name, thunk in rows}
     ns = configs["grid_100k_x_10k"]   # the north star IS this grid config

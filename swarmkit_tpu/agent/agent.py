@@ -25,6 +25,10 @@ BACKOFF_MAX = 8.0
 
 
 class Agent:
+    # log-pump batching: messages buffered per subscription event and
+    # shipped in chunks of this many via ONE publish_logs each (ISSUE 20)
+    LOG_PUBLISH_CHUNK = 256
+
     def __init__(self, node_id: str, dispatcher, executor,
                  state_path: str | None = None, log_broker=None,
                  csi_plugins=None, generic_resources=None,
@@ -111,10 +115,17 @@ class Agent:
                 continue
             sub_id = msg.id
 
-            def publish(task, stream, data, sub_id=sub_id):
-                self.log_broker.publish_logs(
-                    sub_id, [make_log_message(task, stream, data)]
-                )
+            # batched pump (ISSUE 20): the broker's publish path is one
+            # offer burst per call, so the agent buffers and ships chunks
+            # instead of one RPC + one channel offer per log line
+            buf: list = []
+
+            def publish(task, stream, data, sub_id=sub_id, buf=buf):
+                buf.append(make_log_message(task, stream, data))
+                if len(buf) >= self.LOG_PUBLISH_CHUNK:
+                    chunk = buf[:]
+                    buf.clear()
+                    self.log_broker.publish_logs(sub_id, chunk)
 
             err = ""
             try:
@@ -124,6 +135,14 @@ class Agent:
                 )
             except Exception as exc:
                 err = f"log pump failed on {self.node_id}: {exc}"
+            if buf:
+                # tail flush — also after a pump failure: these messages
+                # were produced before the fault
+                try:
+                    self.log_broker.publish_logs(sub_id, buf)
+                except Exception as exc:
+                    if not err:
+                        err = f"log pump failed on {self.node_id}: {exc}"
             if not msg.follow:
                 # publisher EOF: this node pumped everything it has — the
                 # broker's completion accounting ends the client stream

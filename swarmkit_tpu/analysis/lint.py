@@ -276,6 +276,9 @@ class SpanInLoopRule(Rule):
         "swarmkit_tpu/rpc/server.py",
         "swarmkit_tpu/rpc/client.py",
         "swarmkit_tpu/agent/agent.py",
+        "swarmkit_tpu/logbroker/broker.py",
+        "swarmkit_tpu/logbroker/sharded.py",
+        "swarmkit_tpu/watchapi/watch.py",
     )
     TRACE_CALLS = frozenset({"span", "start", "rec", "event", "wrap"})
     FP_CALLS = frozenset({"fp", "fp_value", "fp_transform"})

@@ -60,8 +60,9 @@ DEFAULT_HAZARD_NAMES = frozenset({"dispatcher.lock",
 # Name PREFIXES with the same hazard semantics: the sharded fan-out
 # plane's locks are indexed ("dispatcher.shard0.lock", ...), so the
 # detector keys on the prefix instead of enumerating every shard
-# (ISSUE 13). Extend via arm(hazard_prefixes=).
-DEFAULT_HAZARD_PREFIXES = ("dispatcher.shard",)
+# (ISSUE 13). The log fan-out plane's shard locks (ISSUE 20) share
+# the inversion class. Extend via arm(hazard_prefixes=).
+DEFAULT_HAZARD_PREFIXES = ("dispatcher.shard", "logbroker.shard")
 
 
 @dataclass

@@ -367,6 +367,141 @@ class SessionStorm:
                 pass
 
 
+# ----------------------------------------------------------------- log storm
+class LogStorm:
+    """--log-subscribers: N follow-mode log subscription streams against
+    the manager's sharded log fan-out plane (ISSUE 20), held open for
+    the whole run and drained at a bounded per-subscriber budget
+    (--log-rate msgs/s; 0 = drain as fast as they arrive). A budget
+    below the cluster's publish rate backs the stream up until the
+    broker's bounded client channel SHEDS — the report shows every
+    dropped window arriving as a counted, resumable LogShedRecord
+    (shed_messages) instead of the stall/OOM the unbounded plane risked.
+
+    The storm rides its own RPCClient (stream back-pressure must not
+    stall the churn driver) and selects only the services THIS run
+    created, so a busy cluster's foreign log traffic stays out of the
+    counts. With `--command "sleep ..."` tasks emit nothing and the
+    storm measures pure subscription fan-out (open/dispatch/complete);
+    point --command at something chatty to drive real shed load."""
+
+    PUMP_WORKERS = 4
+
+    def __init__(self, client, n: int, rate: float = 0.0):
+        self.client = client
+        self.n = n
+        self.rate = rate
+        self.metrics = {"subscribers": 0, "subscribe_errors": 0,
+                        "received": 0, "shed_records": 0,
+                        "shed_messages": 0, "completed": 0,
+                        "stream_deaths": 0, "subscribe_s": 0.0}
+        self._chans: list = []
+        self._threads: list[threading.Thread] = []
+        self._stripe_counts: list[dict] = []
+        self._stop: threading.Event | None = None
+
+    def start(self, stop: threading.Event, service_ids):
+        """Open the streams. Called AFTER the run's services exist — an
+        empty LogSelector matches nothing, so the selector must carry
+        the created ids (churn mode starts the storm post-churn and
+        holds it through the settle window)."""
+        from ..logbroker.broker import LogSelector
+
+        self._stop = stop
+        service_ids = list(service_ids)
+        t0 = time.monotonic()
+        for _ in range(self.n):
+            if stop.is_set():
+                break
+            sel = LogSelector(service_ids=service_ids)
+            try:
+                # limit=-1 = the broker's default bounded client channel
+                # (shed-don't-stall); the CLIENT side stays unbounded —
+                # the server's ShedChannel is the accounting point
+                ch = self.client.stream("logs.subscribe", sel,
+                                        follow=True, limit=-1)
+            except Exception:
+                self.metrics["subscribe_errors"] += 1
+                continue
+            self._chans.append(ch)
+        self.metrics["subscribers"] = len(self._chans)
+        self.metrics["subscribe_s"] = round(time.monotonic() - t0, 3)
+        workers = max(1, min(self.PUMP_WORKERS, len(self._chans)))
+        for i in range(workers):
+            stripe = self._chans[i::workers]
+            counts = {"received": 0, "shed_records": 0,
+                      "shed_messages": 0, "completed": 0,
+                      "stream_deaths": 0}
+            self._stripe_counts.append(counts)
+            th = threading.Thread(target=self._pump,
+                                  args=(stripe, counts, stop),
+                                  name=f"swarmbench-logs-{i}", daemon=True)
+            th.start()
+            self._threads.append(th)
+
+    def _pump(self, chans, counts, stop: threading.Event):
+        from ..logbroker.broker import (LogMessage, LogShedRecord,
+                                        SubscriptionComplete)
+
+        # token bucket: the per-subscriber budget aggregates over the
+        # stripe (rate * len); refilled from wall time, capped at one
+        # second's worth so an idle stretch can't bank an unbounded burst
+        budget = self.rate * len(chans)
+        tokens, last = budget, time.monotonic()
+        live = list(chans)
+        while not stop.is_set() and live:
+            if budget:
+                now = time.monotonic()
+                tokens = min(budget, tokens + (now - last) * budget)
+                last = now
+            drained = 0
+            for ch in list(live):
+                if budget and tokens < 1.0:
+                    break
+                try:
+                    ev = ch.try_get()
+                except Exception:
+                    live.remove(ch)
+                    counts["stream_deaths"] += 1
+                    continue
+                if ev is None:
+                    if ch.closed:
+                        live.remove(ch)
+                    continue
+                drained += 1
+                if budget:
+                    tokens -= 1.0
+                if isinstance(ev, LogMessage):
+                    counts["received"] += 1
+                elif isinstance(ev, LogShedRecord):
+                    counts["shed_records"] += 1
+                    counts["shed_messages"] += ev.count
+                elif isinstance(ev, SubscriptionComplete):
+                    counts["completed"] += 1
+            if not drained:
+                stop.wait(0.05)
+            elif budget and tokens < 1.0:
+                stop.wait(max(0.01, (1.0 - tokens) / budget))
+
+    def snapshot(self) -> dict:
+        """Merged live counters. Each pump stripe owns its dict (one
+        writer); a mid-run read is approximate but never torn."""
+        out = dict(self.metrics)
+        for counts in self._stripe_counts:
+            for k, v in counts.items():
+                out[k] += v
+        return out
+
+    def finish(self):
+        for th in self._threads:
+            th.join(timeout=5)
+        for ch in self._chans:
+            try:
+                ch.close()
+            except Exception:
+                pass
+
+
 # -------------------------------------------------------------- load shapes
 def _service_spec(name: str, replicas: int, command: str,
                   auto_rollback: bool = False,
@@ -636,6 +771,19 @@ def main(argv=None) -> int:
                          "started with (swarmd --dispatcher-shards); "
                          "recorded in the report so a storm run is "
                          "attributable to its plane configuration")
+    ap.add_argument("--log-subscribers", type=int, default=0, metavar="N",
+                    help="hold N follow-mode log subscription streams "
+                         "on this run's services against the manager's "
+                         "sharded log fan-out plane (ISSUE 20); the "
+                         "report gains a log_plane block: client-side "
+                         "received/shed/completed counts plus the "
+                         "manager's logbroker telemetry")
+    ap.add_argument("--log-rate", type=float, default=0.0, metavar="R",
+                    help="per-subscriber drain budget in msgs/s for "
+                         "--log-subscribers (0 = unbounded); a budget "
+                         "below the publish rate backs streams up until "
+                         "the broker's bounded channels SHED — a "
+                         "counted, resumable window, never a stall")
     args = ap.parse_args(argv)
 
     from ..rpc.client import RPCClient
@@ -653,7 +801,19 @@ def main(argv=None) -> int:
     stop = threading.Event()
     watch_client = None
     storm = storm_client = None
+    log_storm = log_client = None
     created_ids: list[str] = []
+
+    def start_log_storm(service_ids):
+        # the log storm rides its OWN connection too: N held-open
+        # subscription streams back up under a low --log-rate budget,
+        # and that TCP back-pressure must not stall the driver's RPCs
+        nonlocal log_storm, log_client
+        if args.log_subscribers > 0 and log_storm is None:
+            log_client = RPCClient(args.addr, security=sec)
+            log_storm = LogStorm(log_client, args.log_subscribers,
+                                 rate=args.log_rate)
+            log_storm.start(stop, service_ids)
     try:
         if not args.poll:
             watch_client = RPCClient(args.addr, security=sec)
@@ -683,6 +843,10 @@ def main(argv=None) -> int:
                 strategy=args.strategy,
                 on_service=lambda s: collector.allow(s.id))
             created_ids = churn_stats["service_ids"]
+            # the log storm starts POST-churn (an empty LogSelector
+            # matches nothing — the ids must exist) and rides the
+            # settle window below
+            start_log_storm(created_ids)
             # SETTLE before evaluating: the churn cutoff right-censors
             # in-flight startups — without this window, tasks still
             # starting (or stuck) at the end contribute no sample and
@@ -733,6 +897,7 @@ def main(argv=None) -> int:
                 strategy=args.strategy))
             collector.allow(svc.id)
             created_ids = [svc.id]
+            start_log_storm(created_ids)
             if args.poll:
                 start_poll_collector(ctl, created_ids, collector, stop)
             deadline = time.monotonic() + args.timeout
@@ -776,6 +941,23 @@ def main(argv=None) -> int:
                 }
             except Exception as exc:     # pre-16 manager / no telemetry
                 report["diff_plane"] = {"error": repr(exc)}
+        if log_storm is not None:
+            # log fan-out plane (ISSUE 20): client-side stream counts
+            # plus the manager broker's own accounting — its
+            # delivered + shed == published invariant is checkable
+            # straight from the artifact
+            lp = log_storm.snapshot()
+            lp["rate"] = args.log_rate
+            try:
+                lb = ctl.get_cluster_telemetry().get(
+                    "manager", {}).get("logbroker", {})
+                lp["broker"] = {k: lb.get(k, 0) for k in (
+                    "published", "delivered", "shed", "shed_windows",
+                    "subscriptions_opened", "subscriptions_completed",
+                    "dispatch_offers", "listeners")}
+            except Exception as exc:     # pre-20 manager / no telemetry
+                lp["broker"] = {"error": repr(exc)}
+            report["log_plane"] = lp
         if args.telemetry:
             # embed the cluster rollup so the SLO gate and the
             # telemetry artifact come from ONE report (ISSUE 15);
@@ -806,6 +988,13 @@ def main(argv=None) -> int:
         if storm_client is not None:
             try:
                 storm_client.close()
+            except Exception:
+                pass
+        if log_storm is not None:
+            log_storm.finish()
+        if log_client is not None:
+            try:
+                log_client.close()
             except Exception:
                 pass
         if not args.keep:

@@ -801,6 +801,14 @@ def cmd_top(args):
                      f"flushes={disp.get('flushes', 0)} "
                      f"ships={disp.get('ships', 0)} "
                      f"last_flush={disp.get('last_flush_s', 0.0):.4f}s"])
+    lb = manager.get("logbroker", {})
+    if lb:
+        rows.append(["logbroker",
+                     f"published={lb.get('published', 0)} "
+                     f"delivered={lb.get('delivered', 0)} "
+                     f"shed={lb.get('shed', 0)} "
+                     f"subs={lb.get('pending_subscriptions', 0)} "
+                     f"listeners={lb.get('listeners', 0)}"])
     for name, qs in sorted(t.get("windows", {}).items()):
         rows.append([f"window {name}",
                      " ".join(f"{k}={v:g}" for k, v in qs.items()
@@ -809,7 +817,8 @@ def cmd_top(args):
 
 
 def cmd_logs(args):
-    from ..logbroker.broker import LogSelector, SubscriptionComplete
+    from ..logbroker.broker import (LogSelector, LogShedRecord,
+                                    SubscriptionComplete)
     from ..rpc.client import RPCClient
     from ..store.watch import ChannelClosed
 
@@ -837,6 +846,13 @@ def cmd_logs(args):
                 if msg.error:
                     print(msg.error, file=sys.stderr)
                 break
+            if isinstance(msg, LogShedRecord):
+                # bounded-lag plane (ISSUE 20): a counted, resumable
+                # loss window — announce it and keep streaming
+                print(f"... {msg.count} log message(s) shed "
+                      f"(seq {msg.first_seq}..{msg.last_seq}); "
+                      f"stream resumes", file=sys.stderr)
+                continue
             data = msg.data.decode(errors="replace") if msg.data else ""
             task = msg.context.task_id[:8] if msg.context else "?"
             print(f"{task} | {data}")

@@ -1,11 +1,25 @@
-"""Brokered task-log streaming (reference: manager/logbroker/, SURVEY.md §2.7)."""
+"""Brokered task-log streaming (reference: manager/logbroker/, SURVEY.md §2.7).
+
+Two planes (ISSUE 20): the scalar `LogBroker` is the single-plane
+oracle; `ShardedLogBroker` (logbroker/sharded.py) is the production
+bounded-lag fan-out. `make_log_broker` picks the sharded plane unless
+SWARMKIT_TPU_NO_SHARDED_LOGS=1.
+"""
 from .broker import (
     LogBroker,
     LogContext,
     LogMessage,
     LogSelector,
+    LogShedRecord,
+    SubscriptionComplete,
     SubscriptionMessage,
     make_log_message,
+)
+from .sharded import (
+    ShardedLogBroker,
+    ShedChannel,
+    default_logbroker_shards,
+    make_log_broker,
 )
 
 __all__ = [
@@ -13,6 +27,12 @@ __all__ = [
     "LogContext",
     "LogMessage",
     "LogSelector",
+    "LogShedRecord",
+    "ShardedLogBroker",
+    "ShedChannel",
+    "SubscriptionComplete",
     "SubscriptionMessage",
+    "default_logbroker_shards",
+    "make_log_broker",
     "make_log_message",
 ]

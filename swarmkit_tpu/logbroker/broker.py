@@ -12,13 +12,13 @@ subscription (broker.go subscription.Run watchers).
 from __future__ import annotations
 
 import threading
-import time
 from dataclasses import dataclass, field
 
 from ..analysis.lockgraph import make_lock
 from ..api.objects import EventCreate, EventUpdate, Task
 from ..store import by
 from ..store.watch import Channel, ChannelClosed
+from ..utils.clock import REAL_CLOCK
 from ..utils.identity import new_id
 
 
@@ -62,6 +62,20 @@ class SubscriptionMessage:
 
 
 @dataclass
+class LogShedRecord:
+    """In-stream marker for a counted, resumable loss window (ISSUE 20):
+    a bounded client channel that overflowed dropped `count` messages —
+    publish-sequence numbers `first_seq..last_seq` of THIS subscription —
+    and the stream resumes right after the marker. Clients that need the
+    window can re-subscribe non-follow to backfill; the accounting
+    invariant is exact: delivered + shed == published per subscriber."""
+
+    count: int = 0
+    first_seq: int = 0
+    last_seq: int = 0
+
+
+@dataclass
 class SubscriptionComplete:
     """Terminal record of a log stream (broker.go SubscribeLogs's
     `completed` publish): offered once every publisher finished, carrying
@@ -72,11 +86,12 @@ class SubscriptionComplete:
 
 
 class _Subscription:
-    def __init__(self, sub_id: str, selector: LogSelector, follow: bool):
+    def __init__(self, sub_id: str, selector: LogSelector, follow: bool,
+                 limit: int | None = None):
         self.id = sub_id
         self.selector = selector
         self.follow = follow
-        self.client = Channel(matcher=None, limit=None)
+        self.client = Channel(matcher=None, limit=limit)
         self.nodes: set[str] = set()  # nodes the subscription was sent to
         self.known_tasks: set[str] = set()  # tasks seen when last dispatched
         self.done = False
@@ -99,8 +114,13 @@ class _Subscription:
 
 
 class LogBroker:
-    def __init__(self, store):
+    # broken-stream sweep cadence in _run (clock-relative, so a FakeClock
+    # drives sweeps deterministically)
+    SWEEP_INTERVAL = 0.5
+
+    def __init__(self, store, clock=None):
         self.store = store
+        self.clock = clock or REAL_CLOCK
         self._lock = make_lock('logbroker.broker.lock')
         self._subs: dict[str, _Subscription] = {}
         # node_id -> channel of SubscriptionMessage (agent listeners)
@@ -129,13 +149,19 @@ class LogBroker:
 
     # -- client side (Logs.SubscribeLogs, logbroker.proto:103-125) ---------
 
-    def subscribe_logs(self, selector: LogSelector, follow: bool = True) -> tuple[str, Channel]:
+    def subscribe_logs(self, selector: LogSelector, follow: bool = True,
+                       limit: int | None = None) -> tuple[str, Channel]:
         """Returns (subscription_id, channel of LogMessage). A non-follow
         stream ends with a SubscriptionComplete record once every
-        publisher closed (broker.go SubscribeLogs:255-283)."""
+        publisher closed (broker.go SubscribeLogs:255-283). `limit`
+        bounds the client channel (None keeps the historical unbounded
+        oracle behavior; the sharded plane defaults to bounded+shed).
+        -1 selects the plane's default bound — unbounded here."""
         if selector.empty():
             raise ValueError("empty log selector")
-        sub = _Subscription(new_id(), selector, follow)
+        if limit == -1:
+            limit = None
+        sub = _Subscription(new_id(), selector, follow, limit=limit)
         with self._lock:
             self._subs[sub.id] = sub
         self._dispatch_to_nodes(sub)
@@ -153,10 +179,13 @@ class LogBroker:
         sub.client.close()
         close_msg = SubscriptionMessage(id=sub.id, selector=sub.selector, close=True)
         with self._lock:
-            for node_id in sub.nodes:
-                ch = self._listeners.get(node_id)
-                if ch is not None:
-                    ch._offer(close_msg)
+            offers = [ch for node_id in sub.nodes
+                      if (ch := self._listeners.get(node_id)) is not None]
+        # offer outside the broker lock (the dispatcher's offer-outside-
+        # lock rule, ISSUE 20): a listener channel's own cond is the only
+        # lock the close fan-out may hold
+        for ch in offers:
+            ch._offer(close_msg)
 
     # -- agent side (LogBroker.ListenSubscriptions / PublishLogs) ----------
 
@@ -170,9 +199,12 @@ class LogBroker:
             subs = [s for s in self._subs.values() if node_id in s.nodes and not s.done]
         if old is not None:
             old.close()
-        # replay active subscriptions relevant to this node
-        for s in subs:
-            ch._offer(SubscriptionMessage(id=s.id, selector=s.selector, follow=s.follow))
+        # replay active subscriptions relevant to this node — one batched
+        # offer, outside any broker-lock hold (offer-outside-lock rule)
+        replay = [SubscriptionMessage(id=s.id, selector=s.selector,
+                                      follow=s.follow) for s in subs]
+        if replay:
+            ch._offer_many(replay)
         return ch
 
     def stop_listening(self, node_id: str):
@@ -197,12 +229,17 @@ class LogBroker:
         completion accounting (broker.go:379-440 markDone)."""
         with self._lock:
             sub = self._subs.get(sub_id)
-            if sub is None or sub.done:
-                return
-            for m in messages:
-                sub.client._offer(m)
-            if close:
-                self._mark_done(sub, node_id, error)
+        if sub is None or sub.done:
+            return
+        # batched offer OUTSIDE the broker lock: one matcher pass, one
+        # cond acquisition, one notify for the whole batch — messages are
+        # never offered one-at-a-time under the broker lock (ISSUE 20)
+        if messages:
+            sub.client._offer_many(list(messages))
+        if close:
+            with self._lock:
+                if self._subs.get(sub_id) is sub and not sub.done:
+                    self._mark_done(sub, node_id, error)
 
     def _mark_done(self, sub: _Subscription, node_id: str, error: str = ""):
         """Lock held. subscription.go Done: record the publisher's end;
@@ -312,17 +349,17 @@ class LogBroker:
         Also sweeps for broken client/agent streams."""
         queue = self.store.watch_queue()
         ch = queue.watch()
-        last_sweep = time.monotonic()
+        last_sweep = self.clock.monotonic()
         try:
             while not self._stop.is_set():
-                if time.monotonic() - last_sweep > 0.5:
-                    last_sweep = time.monotonic()
+                if self.clock.monotonic() - last_sweep > self.SWEEP_INTERVAL:
+                    last_sweep = self.clock.monotonic()
                     self._sweep()
                 try:
                     ev = ch.get(timeout=0.2)
                 except TimeoutError:
                     self._sweep()
-                    last_sweep = time.monotonic()
+                    last_sweep = self.clock.monotonic()
                     continue
                 except ChannelClosed:
                     queue.stop_watch(ch)
@@ -349,12 +386,15 @@ class LogBroker:
             queue.stop_watch(ch)
 
 
-def make_log_message(task: Task, stream: str, data: bytes) -> LogMessage:
+def make_log_message(task: Task, stream: str, data: bytes,
+                     clock=None) -> LogMessage:
+    """Timestamps ride the injectable clock seam (utils/clock) so tests
+    pin them under FakeClock; callers without one get wall time."""
     return LogMessage(
         context=LogContext(
             service_id=task.service_id, node_id=task.node_id, task_id=task.id
         ),
-        timestamp=time.time(),
+        timestamp=(clock or REAL_CLOCK).time(),
         stream=stream,
         data=data,
     )

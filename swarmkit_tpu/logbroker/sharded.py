@@ -1,0 +1,613 @@
+"""Sharded log fan-out plane (ISSUE 20): the production LogBroker.
+
+Rebuild of the scalar broker on the PR 13 dispatcher pattern:
+
+* **Shards.** Agent listeners partition by ``stable_shard(node_id, P)``
+  (the dispatcher's crc32 hash) into shards with leaf locks on the
+  ``logbroker.shard<i>.lock`` naming scheme — the lockgraph hazard
+  detector keys on the ``logbroker.shard`` prefix, like the
+  dispatcher's. Pinned order: ``logbroker.lock`` (the global
+  subscription registry) → shard lock, never the reverse.
+* **Pumps.** Each shard owns a pump thread that serves the listener
+  fan-out (subscription open/close offers) and sweeps its own
+  listeners, so 100k agents never serialize on one broker loop.
+  Offers always happen OUTSIDE broker locks; an unstarted broker
+  drains jobs inline so driven tests stay synchronous.
+* **Bounded channels + shed.** Client and listener channels are
+  bounded (the ``Channel(limit=None)`` queued-wire-copy OOM shape
+  ISSUE 16 fixed). A slow log client does not close and does not
+  stall publishers: the overflow is SHED — counted per subscriber,
+  announced in-stream by a resumable :class:`LogShedRecord` window —
+  and the stream resumes as soon as the consumer drains. Invariant:
+  ``delivered + shed == published`` per subscriber, exactly.
+* **Batched publish.** ``publish_logs`` is one lock-free registry read
+  plus ONE burst into the client channel's own cond (``offer_batch``)
+  — zero broker/shard lock holds on the publish hot path, messages
+  never offered one-at-a-time under any broker lock.
+* **Telemetry.** ``swarm_logbroker_*`` families (per-shard published /
+  delivered / shed counters + delivery-lag histogram) are built
+  through the utils/metrics factories and populate ONLY while the
+  telemetry plane is armed — the disarmed publish path pays one
+  module-global truthiness test and allocates nothing. The always-on
+  accounting lives on the channels (plain ints under their cond) and
+  is aggregated on demand by :meth:`ShardedLogBroker.metrics_snapshot`
+  for /metrics, /debug/cluster and the PR 15 rollup.
+
+``SWARMKIT_TPU_NO_SHARDED_LOGS=1`` reverts to the single-plane broker
+(`broker.LogBroker`), which stays the wire-parity oracle.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from collections import deque
+
+from ..analysis.lockgraph import make_lock
+from ..dispatcher.heartbeat import stable_shard
+from ..store.watch import Channel
+from ..utils import telemetry
+from ..utils.metrics import CounterDict, counter_family, histogram_family
+from ..utils.identity import new_id
+from .broker import (
+    LogBroker,
+    LogSelector,
+    LogShedRecord,
+    SubscriptionComplete,
+    SubscriptionMessage,
+    _Subscription,
+)
+
+log = logging.getLogger("swarmkit_tpu.logbroker")
+
+CLIENT_CHANNEL_LIMIT = 4096     # default bound on a log client's stream
+LISTENER_CHANNEL_LIMIT = 1024   # bound on an agent's subscription stream
+
+# armed-only families (utils/metrics factories → they ride
+# registry_snapshot into the PR 15 rollup as swarm_cluster_* lifts)
+_PUBLISHED = counter_family(
+    "swarm_logbroker_published_total",
+    "log messages published into the broker, by publisher shard",
+    ("shard",))
+_DELIVERED = counter_family(
+    "swarm_logbroker_delivered_total",
+    "log messages delivered into client channels, by publisher shard",
+    ("shard",))
+_SHED = counter_family(
+    "swarm_logbroker_shed_total",
+    "log messages shed at bounded client channels, by publisher shard",
+    ("shard",))
+_LAG = histogram_family(
+    "swarm_logbroker_lag_seconds",
+    "publish-to-delivery lag of the last message in each publish batch",
+    ("shard",))
+
+
+def default_logbroker_shards() -> int:
+    """Shard count for the log fan-out plane: the dispatcher's shape
+    (min(4, cores)), overridable via SWARMKIT_TPU_LOGBROKER_SHARDS."""
+    env = os.environ.get("SWARMKIT_TPU_LOGBROKER_SHARDS", "")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            log.warning("ignoring bad SWARMKIT_TPU_LOGBROKER_SHARDS=%r", env)
+    return max(1, min(4, os.cpu_count() or 1))
+
+
+class ShedChannel(Channel):
+    """Bounded client stream with shed-don't-stall overflow.
+
+    The base Channel CLOSES a slow subscriber at its limit (the store
+    watch-queue contract). A log client instead loses a counted window:
+    overflowing messages are dropped, the loss is announced in-stream by
+    one LogShedRecord (emitted the moment a slot frees — on the next
+    offer or the next consumer pop), and delivery resumes. Cursors are
+    delivery-gated: ``delivered``/``shed`` advance only by what actually
+    entered or missed the deque, and ``published`` is the per-subscriber
+    sequence the shed window's first/last_seq refer to."""
+
+    def __init__(self, limit: int | None = CLIENT_CHANNEL_LIMIT):
+        super().__init__(None, limit)
+        # accounting, all under the inherited cond: exact per-subscriber
+        # invariant published == delivered + shed
+        self.published = 0
+        self.delivered = 0
+        self.shed = 0
+        self.shed_windows = 0
+        self._pending_shed = 0     # current un-announced window
+        self._window_first = 0
+        self._window_last = 0
+
+    # -- internals (cond held) --------------------------------------------
+
+    def _emit_marker_locked(self, force: bool = False):
+        """Announce a pending shed window once a slot is free (`force`
+        skips the room check — control records ride past the data bound
+        and their marker must precede them regardless). Everything
+        queued predates the window, so appending at the tail keeps the
+        marker at the exact stream position of the loss."""
+        if self._pending_shed and (
+                force or self._limit is None
+                or len(self._events) < self._limit):
+            self._events.append(LogShedRecord(
+                count=self._pending_shed,
+                first_seq=self._window_first,
+                last_seq=self._window_last))
+            self._pending_shed = 0
+
+    # -- publisher side ----------------------------------------------------
+
+    def offer_batch(self, msgs: list) -> tuple[int, int]:
+        """ONE cond hold and one notify for the whole batch; never blocks
+        and never closes the stream. Returns (delivered, shed)."""
+        with self._cond:
+            n = len(msgs)
+            first = self.published + 1
+            self.published += n
+            if self._closed:
+                # still window-tracked: a consumer draining the closed
+                # stream's tail sees one marker covering the loss, so
+                # marker counts stay exactly equal to `shed`
+                if not self._pending_shed:
+                    self.shed_windows += 1
+                    self._window_first = first
+                self._pending_shed += n
+                self._window_last = self.published
+                self.shed += n
+                return 0, n
+            self._emit_marker_locked()
+            if self._limit is None:
+                take = n
+            else:
+                take = max(0, min(self._limit - len(self._events), n))
+            if take:
+                self._events.extend(msgs[:take])
+                self.delivered += take
+                self._cond.notify_all()
+            dropped = n - take
+            if dropped:
+                if not self._pending_shed:
+                    self.shed_windows += 1
+                    self._window_first = first + take
+                self._pending_shed += dropped
+                self._window_last = self.published
+                self.shed += dropped
+            return take, dropped
+
+    def offer_control(self, record) -> bool:
+        """Control records (SubscriptionComplete) bypass the data limit —
+        they are one-shot and must not be shed — but still follow any
+        pending shed marker so the loss window is announced first."""
+        with self._cond:
+            if self._closed:
+                return False
+            self._emit_marker_locked(force=True)
+            self._events.append(record)
+            self._cond.notify_all()
+            return True
+
+    # -- consumer side (marker emission on drain) -------------------------
+
+    def get(self, timeout: float | None = None):
+        with self._cond:
+            if not self._cond.wait_for(
+                    lambda: self._events or self._closed, timeout):
+                raise TimeoutError("no event within timeout")
+            if self._events:
+                ev = self._events.popleft()
+                self._emit_marker_locked()
+                return ev
+            self._raise_closed()
+
+    def try_get(self):
+        with self._cond:
+            if self._events:
+                ev = self._events.popleft()
+                self._emit_marker_locked()
+                return ev
+            if self._closed:
+                self._raise_closed()
+            return None
+
+    def drain(self) -> list:
+        with self._cond:
+            out = list(self._events)
+            self._events.clear()
+            self._emit_marker_locked()
+            if self._events:        # the freshly-emitted marker
+                out.extend(self._events)
+                self._events.clear()
+            return out
+
+
+class _ShardedSubscription(_Subscription):
+    def __init__(self, sub_id: str, selector: LogSelector, follow: bool,
+                 limit: int | None):
+        super().__init__(sub_id, selector, follow)
+        self.client = ShedChannel(limit)
+
+
+class _Shard:
+    """One slice of the listener plane: its agents, its pump inbox."""
+
+    __slots__ = ("index", "lock", "listeners", "pending", "wake")
+
+    def __init__(self, index: int):
+        self.index = index
+        # leaf lock on the hazard-keyed naming scheme (lockgraph's
+        # DEFAULT_HAZARD_PREFIXES includes "logbroker.shard")
+        self.lock = make_lock(f"logbroker.shard{index}.lock")
+        self.listeners: dict[str, Channel] = {}
+        # lock-free pump inbox (deque appends are GIL-atomic, the
+        # dispatcher event-pump shape); jobs: (msg, [(node_id, ch), ...])
+        self.pending: deque = deque()
+        self.wake = threading.Event()
+
+
+class ShardedLogBroker(LogBroker):
+    """Sharded, bounded, telemetry-instrumented LogBroker (see module
+    docstring). Drop-in for the scalar broker's full surface."""
+
+    def __init__(self, store, shards: int | None = None, clock=None,
+                 client_limit: int | None = CLIENT_CHANNEL_LIMIT,
+                 listener_limit: int | None = LISTENER_CHANNEL_LIMIT):
+        super().__init__(store, clock=clock)
+        # the inherited lock is the GLOBAL subscription-registry lock;
+        # rename it on the graph so the pinned order reads
+        # logbroker.lock → logbroker.shard<i>.lock
+        self._lock = make_lock("logbroker.lock")
+        self.shards = max(1, int(shards if shards is not None
+                                 else default_logbroker_shards()))
+        self.client_limit = client_limit
+        self.listener_limit = listener_limit
+        self._shards = [_Shard(i) for i in range(self.shards)]
+        self._pumps: list[threading.Thread] = []
+        self._running = False
+        # structural counters (never touched on the publish hot path) +
+        # totals folded in from retired subscriptions
+        self._bag = CounterDict({
+            "subscriptions_opened": 0,
+            "subscriptions_completed": 0,
+            "listener_disconnects": 0,
+            "dispatch_offers": 0,
+            "pump_jobs": 0,
+            "published": 0,
+            "delivered": 0,
+            "shed": 0,
+            "shed_windows": 0,
+        })
+        for i in range(self.shards):
+            self._bag[f"pump_depth_shard{i}"] = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        self._stop = threading.Event()  # restartable across leaderships
+        self._running = True
+        self._pumps = []
+        for sh in self._shards:
+            t = threading.Thread(target=self._pump_loop, args=(sh,),
+                                 name=f"logbroker-pump-{sh.index}",
+                                 daemon=True)
+            t.start()
+            self._pumps.append(t)
+        self._thread = threading.Thread(target=self._run, name="logbroker",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._running = False
+        for sh in self._shards:
+            sh.wake.set()
+        for t in self._pumps:
+            t.join(timeout=5)
+        self._pumps = []
+        if self._thread:
+            self._thread.join(timeout=5)
+        # drain leftover pump jobs inline so close fan-outs aren't lost
+        for sh in self._shards:
+            self._drain_shard(sh)
+        with self._lock:
+            subs = list(self._subs.values())
+            self._subs.clear()
+        for sub in subs:
+            self._retire(sub)
+            sub.client.close()
+        for sh in self._shards:
+            with sh.lock:
+                listeners = list(sh.listeners.values())
+                sh.listeners.clear()
+            for ch in listeners:
+                ch.close()
+
+    # -- client side -------------------------------------------------------
+
+    def subscribe_logs(self, selector: LogSelector, follow: bool = True,
+                       limit: int | None = -1) -> tuple[str, Channel]:
+        """`limit=-1` takes the broker's default client bound; None means
+        unbounded (the oracle shape — parity tests use it)."""
+        if selector.empty():
+            raise ValueError("empty log selector")
+        if limit == -1:
+            limit = self.client_limit
+        sub = _ShardedSubscription(new_id(), selector, follow, limit)
+        with self._lock:
+            self._subs[sub.id] = sub
+        self._bag.inc("subscriptions_opened")
+        self._dispatch_to_nodes(sub)
+        if not follow:
+            with self._lock:
+                self._maybe_complete(sub)
+        return sub.id, sub.client
+
+    def unsubscribe(self, sub_id: str):
+        with self._lock:
+            sub = self._subs.pop(sub_id, None)
+        if sub is None:
+            return
+        sub.done = True
+        self._retire(sub)
+        sub.client.close()
+        close_msg = SubscriptionMessage(id=sub.id, selector=sub.selector,
+                                        close=True)
+        offers = []
+        for n in sub.nodes:
+            sh = self._shards[stable_shard(n, self.shards)]
+            with sh.lock:
+                ch = sh.listeners.get(n)
+            if ch is not None:
+                offers.append((n, ch))
+        self._submit_offers(offers, close_msg)
+
+    # -- agent side --------------------------------------------------------
+
+    def listen_subscriptions(self, node_id: str) -> Channel:
+        ch = Channel(matcher=None, limit=self.listener_limit)
+        sh = self._shards[stable_shard(node_id, self.shards)]
+        with sh.lock:
+            old = sh.listeners.get(node_id)
+            sh.listeners[node_id] = ch
+        with self._lock:
+            subs = [s for s in self._subs.values()
+                    if node_id in s.nodes and not s.done]
+        if old is not None:
+            old.close()
+        # batched replay outside every broker lock
+        replay = [SubscriptionMessage(id=s.id, selector=s.selector,
+                                      follow=s.follow) for s in subs]
+        if replay:
+            ch._offer_many(replay)
+        return ch
+
+    def stop_listening(self, node_id: str):
+        sh = self._shards[stable_shard(node_id, self.shards)]
+        with sh.lock:
+            ch = sh.listeners.pop(node_id, None)
+        with self._lock:
+            for sub in list(self._subs.values()):
+                if node_id in sub.pending_nodes:
+                    self._mark_done(
+                        sub, node_id,
+                        f"node {node_id} disconnected unexpectedly")
+        if ch is not None:
+            ch.close()
+
+    def publish_logs(self, sub_id: str, messages, node_id: str = "",
+                     close: bool = False, error: str = ""):
+        """The publish HOT PATH: a lock-free registry read (GIL-atomic
+        dict get — no broker or shard lock is ever held here) and ONE
+        offer burst into the client channel's own cond. Disarmed
+        telemetry costs exactly one truthiness test; the armed recorder
+        is the only allocation site."""
+        sub = self._subs.get(sub_id)
+        if sub is None or sub.done:
+            return
+        if messages:
+            delivered, shed = sub.client.offer_batch(list(messages))
+            if telemetry.enabled():
+                self._record_publish(messages, delivered, shed)
+        if close:
+            with self._lock:
+                if self._subs.get(sub_id) is sub and not sub.done:
+                    self._mark_done(sub, node_id, error)
+
+    def _record_publish(self, messages, delivered: int, shed: int):
+        """Armed-only (telemetry.enabled() guarded at every call site):
+        fold the batch into the swarm_logbroker_* families, attributed
+        to the publishing node's shard."""
+        nid = messages[0].context.node_id if messages else ""
+        lbl = (str(stable_shard(nid, self.shards)),)
+        _PUBLISHED.inc(lbl, len(messages))
+        if delivered:
+            _DELIVERED.inc(lbl, delivered)
+        if shed:
+            _SHED.inc(lbl, shed)
+        _LAG.observe(lbl, max(0.0,
+                              self.clock.time() - messages[-1].timestamp))
+
+    # -- completion plane (global lock held by callers) --------------------
+
+    def _maybe_complete(self, sub: _Subscription):
+        if sub.follow or sub.done or sub.pending_nodes:
+            return
+        sub.done = True
+        self._subs.pop(sub.id, None)
+        self._retire(sub)
+        # control record bypasses the data bound (and never sheds); it
+        # still trails any pending loss marker
+        sub.client.offer_control(SubscriptionComplete(error=sub.err_text()))
+        sub.client.close()
+        self._bag.inc("subscriptions_completed")
+
+    def _retire(self, sub: _Subscription):
+        """Fold a finished subscription's channel accounting into the
+        broker totals so metrics survive the subscription."""
+        ch = sub.client
+        if getattr(sub, "_retired", False) or not isinstance(ch, ShedChannel):
+            return
+        sub._retired = True
+        with ch._cond:
+            pub, dlv, shd, win = (ch.published, ch.delivered, ch.shed,
+                                  ch.shed_windows)
+        self._bag.inc("published", pub)
+        self._bag.inc("delivered", dlv)
+        self._bag.inc("shed", shd)
+        self._bag.inc("shed_windows", win)
+
+    # -- dispatch fan-out (shard pumps) ------------------------------------
+
+    def _dispatch_to_nodes(self, sub: _Subscription,
+                           force_nodes: set[str] = frozenset()):
+        """Same match + accounting as the oracle (synchronous, under the
+        global lock), but the listener offers ride the owning shards'
+        pumps — the fan-out never runs under the registry lock."""
+        tasks = self.store.view(
+            lambda tx: self._match_tasks(tx, sub.selector))
+        msg = SubscriptionMessage(id=sub.id, selector=sub.selector,
+                                  follow=sub.follow)
+        offers = []
+        with self._lock:
+            notify: set[str] = set(force_nodes)
+            for t in tasks:
+                if not t.node_id:
+                    continue
+                if t.node_id not in sub.nodes \
+                        or t.id not in sub.known_tasks:
+                    notify.add(t.node_id)
+            sub.nodes |= notify
+            sub.known_tasks = {t.id for t in tasks if t.node_id}
+            sub.pending_tasks = {t.id for t in tasks if not t.node_id}
+            for n in notify:
+                # pinned order: logbroker.lock → logbroker.shard<i>.lock
+                sh = self._shards[stable_shard(n, self.shards)]
+                with sh.lock:
+                    ch = sh.listeners.get(n)
+                alive = ch is not None and not ch.closed
+                if alive:
+                    offers.append((n, ch))
+                    if not sub.follow and n not in sub.done_nodes:
+                        sub.pending_nodes.add(n)
+                elif not sub.follow and n not in sub.done_nodes:
+                    sub.errors.append(f"node {n} is not available")
+                    sub.done_nodes.add(n)
+        self._submit_offers(offers, msg)
+
+    def _submit_offers(self, offers, msg):
+        """Route (node, channel) offers to the owning shards' pumps; an
+        unstarted/stopped broker serves them inline (driven tests)."""
+        if not offers:
+            return
+        if not self._running:
+            self._do_offers(offers, msg)
+            return
+        by_shard: dict[int, list] = {}
+        for n, ch in offers:
+            by_shard.setdefault(stable_shard(n, self.shards),
+                                []).append((n, ch))
+        for idx, items in by_shard.items():
+            sh = self._shards[idx]
+            sh.pending.append((msg, items))   # lock-free inbox append
+            sh.wake.set()
+
+    def _do_offers(self, items, msg):
+        """Offer OUTSIDE every broker lock. A refused offer (closed or
+        overflowed listener channel) is a dead agent stream: account the
+        disconnect like the sweep would — the agent's re-listen replay
+        heals the subscription (dup closes are ignored by _mark_done)."""
+        for n, ch in items:
+            if ch._offer(msg):
+                self._bag.inc("dispatch_offers")
+            else:
+                self._note_listener_dead(n, ch)
+
+    def _note_listener_dead(self, node_id: str, ch: Channel):
+        sh = self._shards[stable_shard(node_id, self.shards)]
+        with sh.lock:
+            if sh.listeners.get(node_id) is ch:
+                del sh.listeners[node_id]
+        with self._lock:
+            for sub in list(self._subs.values()):
+                if node_id in sub.pending_nodes:
+                    self._mark_done(
+                        sub, node_id,
+                        f"node {node_id} disconnected unexpectedly")
+        self._bag.inc("listener_disconnects")
+        ch.close()
+
+    # -- pumps + sweeps ----------------------------------------------------
+
+    def _pump_loop(self, sh: _Shard):
+        while not self._stop.is_set():
+            self.clock.wait(sh.wake, timeout=self.SWEEP_INTERVAL)
+            sh.wake.clear()
+            self._drain_shard(sh)
+            self._sweep_shard(sh)
+
+    def _drain_shard(self, sh: _Shard):
+        """FIFO drain of the shard's inbox; offers run outside all broker
+        locks (the channels' own conds are leaves)."""
+        n = 0
+        while sh.pending:
+            try:
+                msg, items = sh.pending.popleft()
+            except IndexError:
+                break
+            self._do_offers(items, msg)
+            n += 1
+        if n:
+            self._bag.inc("pump_jobs", n)
+        self._bag[f"pump_depth_shard{sh.index}"] = len(sh.pending)
+
+    def _sweep_shard(self, sh: _Shard):
+        """A shard sweeps ITS listeners; dead ones feed the same
+        disconnect accounting as stop_listening. Collected under the
+        shard lock, accounted after it is released (never nest shard →
+        global)."""
+        with sh.lock:
+            dead = [(n, ch) for n, ch in sh.listeners.items() if ch.closed]
+        for n, ch in dead:
+            self._note_listener_dead(n, ch)
+
+    def _sweep(self):
+        """The watcher thread's sweep: gone log CLIENTS unsubscribe
+        (listener sweeps live on the shard pumps)."""
+        with self._lock:
+            gone = [s.id for s in self._subs.values()
+                    if s.client.closed and not s.done]
+        for sid in gone:
+            self.unsubscribe(sid)
+
+    # -- observability -----------------------------------------------------
+
+    def metrics_snapshot(self) -> dict:
+        """Always-on counter surface for /metrics, /debug/cluster and the
+        telemetry rollup's `logbroker` block: retired totals + live
+        subscription accounting + plane gauges. Never touched by the
+        publish hot path."""
+        out = {k: v for k, v in self._bag.items()}
+        with self._lock:
+            live = list(self._subs.values())
+            pending = len(live)
+        for sub in live:
+            ch = sub.client
+            if not isinstance(ch, ShedChannel):
+                continue
+            with ch._cond:
+                out["published"] += ch.published
+                out["delivered"] += ch.delivered
+                out["shed"] += ch.shed
+                out["shed_windows"] += ch.shed_windows
+        out["pending_subscriptions"] = pending
+        out["listeners"] = sum(len(sh.listeners) for sh in self._shards)
+        return out
+
+
+def make_log_broker(store, shards: int | None = None, clock=None):
+    """The production constructor: the sharded plane unless the kill
+    switch (SWARMKIT_TPU_NO_SHARDED_LOGS=1) selects the single-plane
+    oracle."""
+    if os.environ.get("SWARMKIT_TPU_NO_SHARDED_LOGS", ""):
+        return LogBroker(store, clock=clock)
+    return ShardedLogBroker(store, shards=shards, clock=clock)

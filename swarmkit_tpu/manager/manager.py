@@ -23,7 +23,7 @@ from ..api.specs import Annotations, ClusterSpec, NetworkSpec
 from ..ca import CAServer, RootCA, SecurityConfig, generate_join_token
 from ..controlapi.control import ControlAPI
 from ..dispatcher.dispatcher import Dispatcher
-from ..logbroker.broker import LogBroker
+from ..logbroker.sharded import make_log_broker
 from ..orchestrator.enforcers import ConstraintEnforcer, VolumeEnforcer
 from ..orchestrator.global_ import GlobalOrchestrator
 from ..orchestrator.jobs import JobsOrchestrator
@@ -108,7 +108,9 @@ class Manager:
                                      secret_drivers=secret_drivers,
                                      shards=dispatcher_shards,
                                      clock=clock)
-        self.log_broker = LogBroker(self.store)
+        # sharded bounded-lag fan-out plane by default; the kill switch
+        # (SWARMKIT_TPU_NO_SHARDED_LOGS=1) reverts to the scalar oracle
+        self.log_broker = make_log_broker(self.store)
         self.resource_api = ResourceAllocator(self.store)
         self.health = HealthServer()
 
@@ -312,7 +314,10 @@ class Manager:
                 # piggybacked report supersedes the local-registry merge
                 # (same process, same registry — see manager/telemetry.py)
                 local_node_id=(self.security.node_id()
-                               if self.security is not None else None)),
+                               if self.security is not None else None),
+                # log fan-out plane (ISSUE 20): its delivered/shed
+                # accounting joins the rollup's manager families
+                log_broker=self.log_broker),
         ]
         if self.raft is not None:
             from .wedge import WedgeMonitor

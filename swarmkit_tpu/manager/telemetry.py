@@ -123,10 +123,12 @@ class TelemetryAggregator:
 
     def __init__(self, store, dispatcher, raft=None, clock=None,
                  local_node_id: str | None = None,
-                 ring_width_s: float = 5.0, ring_slots: int = 240):
+                 ring_width_s: float = 5.0, ring_slots: int = 240,
+                 log_broker=None):
         self.store = store
         self.dispatcher = dispatcher
         self.raft = raft
+        self.log_broker = log_broker
         # the manager's OWN node id (swarmd managers co-run an agent in
         # this process): when that agent's fresh report is in the shard
         # store, it already IS this process's registry — merging the
@@ -275,6 +277,12 @@ class TelemetryAggregator:
         metrics = getattr(self.dispatcher, "metrics", None)
         if metrics is not None:
             out["dispatcher"] = dict(metrics)
+        snap = getattr(self.log_broker, "metrics_snapshot", None)
+        if snap is not None:
+            # log fan-out plane (ISSUE 20): delivered/shed accounting +
+            # plane gauges, the same surface /metrics exposes as
+            # swarm_logbroker_*
+            out["logbroker"] = snap()
         return out
 
     # ------------------------------------------------------------- renders

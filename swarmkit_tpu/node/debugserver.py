@@ -236,6 +236,30 @@ def component_metrics_text(node) -> str:
         fam("swarm_dispatcher_plane",
             "dispatcher fan-out plane stats (last_flush_s, ...)",
             "gauge", floats)
+    broker = _find(node, "log_broker")
+    broker_snap = getattr(broker, "metrics_snapshot", None)
+    if broker_snap is not None:
+        # log fan-out plane (ISSUE 20): the broker's always-on counter
+        # surface, exposed generically off the live snapshot so a new
+        # key appears here WITHOUT a hand edit (the exposition drift
+        # guard walks the live dict the same way)
+        ints, floats = [], []
+        for key, v in sorted(broker_snap().items()):
+            lbl = _escape_label_value(key)
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            if isinstance(v, int):
+                ints.append(f'swarm_logbroker_plane_total'
+                            f'{{counter="{lbl}"}} {v}')
+            else:
+                floats.append(f'swarm_logbroker_plane'
+                              f'{{stat="{lbl}"}} {v}')
+        fam("swarm_logbroker_plane_total",
+            "log fan-out plane counters (published, delivered, shed, "
+            "shed_windows, pump_jobs, listener_disconnects, ...)",
+            "counter", ints)
+        fam("swarm_logbroker_plane",
+            "log fan-out plane stats", "gauge", floats)
     wheel = getattr(disp, "_hb_wheel", None)
     if wheel is not None:
         fam("swarm_heartbeat_wheel_entries",

@@ -118,7 +118,8 @@ class _Registry:
                 self.add(cls)
             for cls in (broker_mod.LogSelector, broker_mod.LogContext,
                         broker_mod.LogMessage, broker_mod.SubscriptionMessage,
-                        broker_mod.SubscriptionComplete):
+                        broker_mod.SubscriptionComplete,
+                        broker_mod.LogShedRecord):
                 self.add(cls)
 
             try:

@@ -450,8 +450,12 @@ def build_manager_registry(manager, raft_node=None,
     # ---------------------------------------------------------------- logs
     broker = manager.log_broker
 
-    def logs_subscribe(caller, selector, follow=True):
-        _sub_id, ch = broker.subscribe_logs(selector, follow=follow)
+    def logs_subscribe(caller, selector, follow=True, limit=-1):
+        # limit=-1 takes the broker's default client bound (sharded
+        # plane: CLIENT_CHANNEL_LIMIT with shed-don't-stall overflow);
+        # None keeps the unbounded oracle stream
+        _sub_id, ch = broker.subscribe_logs(selector, follow=follow,
+                                            limit=limit)
         return ch
 
     def logs_listen_subscriptions(caller, node_id):
@@ -705,8 +709,9 @@ class RemoteLogBroker:
         return self._conn().call("logs.publish", sub_id, messages,
                                  close=close, error=error)
 
-    def subscribe_logs(self, selector, follow=True):
-        ch = self._conn().stream("logs.subscribe", selector, follow=follow)
+    def subscribe_logs(self, selector, follow=True, limit=-1):
+        ch = self._conn().stream("logs.subscribe", selector, follow=follow,
+                                 limit=limit)
         return None, ch  # (sub_id, channel) — matches LogBroker surface
 
     def close(self):

@@ -47,7 +47,7 @@ from ..api.types import NodeStatusState, TaskState
 from . import by as by_mod
 from ..utils.metrics import histogram
 from .columnar import ColumnarTasks
-from .watch import Channel, WatchQueue
+from .watch import Channel, WatchQueue, make_watch_queue
 
 # store tx latency + lock-hold timers (memory.go:99-112)
 _read_tx_latency = histogram(
@@ -312,7 +312,7 @@ class MemoryStore:
         self._update_lock_held_since: float | None = None
         self.wedge_timeout = WEDGE_TIMEOUT      # per-store override for tests
         self.proposer = proposer
-        self.queue = WatchQueue()
+        self.queue = make_watch_queue()
         self._version = Version(0)  # commit version when no proposer drives it
         # Operation counters (test/bench observability — the dispatcher's
         # op-count regression guard asserts transactions-per-flush and

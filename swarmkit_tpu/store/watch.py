@@ -7,6 +7,7 @@ exceeds the limit is closed rather than blocking publishers.
 """
 from __future__ import annotations
 
+import os
 import threading
 from ..analysis.lockgraph import make_lock, make_rlock
 from collections import deque
@@ -173,6 +174,9 @@ class WatchQueue:
                     self._offer(event)
 
         ch = _CallbackChannel(None, None)
+        # synchronous-callback contract: the cb runs on the PUBLISHING
+        # thread — the sharded queue must never move it onto a pump
+        ch._inline = True
         with self._lock:
             self._subs = self._subs + (ch,)
         return ch
@@ -208,6 +212,110 @@ class WatchQueue:
             self._subs = ()
         for ch in subs:
             ch.close()
+
+
+# -- sharded fan-out (ISSUE 20) ---------------------------------------------
+#
+# One publish loop serializes 100k watchers: the queue walks every
+# subscriber channel on the publishing (store-commit) thread. The sharded
+# queue stripes the copy-on-write subscriber tuple across a small shared
+# pump pool — per-subscriber delivery order is preserved because each
+# publish barriers on its stripes before returning and store commits
+# already serialize publishes. Callback channels (synchronous-cb
+# contract) and small fan-outs stay on the caller thread, so the plain
+# queue remains the exact behavioral oracle.
+
+_PUMP_POOL = None
+_PUMP_POOL_LOCK = make_lock("store.watch.pump_pool")
+
+
+def default_watch_shards() -> int:
+    """Stripe count for sharded watch fan-out (the log plane's shape):
+    min(4, cores), overridable via SWARMKIT_TPU_LOGBROKER_SHARDS."""
+    env = os.environ.get("SWARMKIT_TPU_LOGBROKER_SHARDS", "")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return max(1, min(4, os.cpu_count() or 1))
+
+
+def _pump_pool():
+    """Lazy PROCESS-GLOBAL pool: stores have no stop lifecycle, so
+    per-queue pump threads would leak one set per store (the test suite
+    builds thousands). Daemon workers, shared by every sharded queue."""
+    global _PUMP_POOL
+    with _PUMP_POOL_LOCK:
+        if _PUMP_POOL is None:
+            from concurrent.futures import ThreadPoolExecutor
+            _PUMP_POOL = ThreadPoolExecutor(
+                max_workers=max(2, default_watch_shards()),
+                thread_name_prefix="watch-pump")
+        return _PUMP_POOL
+
+
+class ShardedWatchQueue(WatchQueue):
+    """WatchQueue with striped parallel fan-out (ISSUE 20).
+
+    Observable behavior is identical to the serial queue — same channels,
+    same per-subscriber event order, same slow-subscriber close — only
+    the fan-out walk is partitioned. Publishes below MIN_PARALLEL
+    subscribers take the serial oracle path (pool dispatch costs more
+    than the walk)."""
+
+    MIN_PARALLEL = 64
+
+    def __init__(self, default_limit: int | None = 10000,
+                 shards: int | None = None):
+        super().__init__(default_limit)
+        self.shards = max(1, int(shards if shards is not None
+                                 else default_watch_shards()))
+
+    def publish(self, event: Any) -> None:
+        self.publish_all([event])
+
+    def publish_all(self, events: Iterable[Any]) -> None:
+        events = events if isinstance(events, list) else list(events)
+        if not events:
+            return
+        subs = self._subs          # immutable snapshot (copy-on-write)
+        if self.shards <= 1 or len(subs) < self.MIN_PARALLEL:
+            for ch in subs:
+                ch._offer_many(events)
+            return
+        work = []
+        for ch in subs:
+            if getattr(ch, "_inline", False):
+                ch._offer_many(events)   # callback cbs stay on this thread
+            else:
+                work.append(ch)
+        if len(work) < self.MIN_PARALLEL:
+            for ch in work:
+                ch._offer_many(events)
+            return
+        pool = _pump_pool()
+        futs = [pool.submit(self._offer_stripe, work[i::self.shards], events)
+                for i in range(self.shards)]
+        # synchronous barrier: per-subscriber ordering depends on this
+        # publish finishing before the store's next commit publishes;
+        # result() also re-raises a stripe's failure on the publish path
+        # exactly where the serial walk would have raised
+        for f in futs:
+            f.result()
+
+    @staticmethod
+    def _offer_stripe(chans, events):
+        for ch in chans:
+            ch._offer_many(events)
+
+
+def make_watch_queue(default_limit: int | None = 10000) -> WatchQueue:
+    """The store's constructor: sharded fan-out unless the log-plane kill
+    switch (SWARMKIT_TPU_NO_SHARDED_LOGS=1) selects the serial oracle."""
+    if os.environ.get("SWARMKIT_TPU_NO_SHARDED_LOGS", ""):
+        return WatchQueue(default_limit)
+    return ShardedWatchQueue(default_limit)
 
 
 def match_events(*predicates: Matcher) -> Matcher:

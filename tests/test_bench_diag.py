@@ -252,3 +252,30 @@ def test_strategy_grid_row_cpu_smoke():
     # the three strategies really placed differently-shaped fills at
     # the steady shape (binpack piles, spread balances)
     assert len({s["steady_placed"] for s in row["strategies"].values()}) >= 1
+
+
+def test_log_fanout_storm_cpu_smoke():
+    """ISSUE 20 contracts of the log_fanout_storm row at a CPU-smoke
+    shape (correctness gates + op counts, never wall clock — contended
+    1-core host; the 100k-subscriber throughput/lag numbers are judged
+    by the bench row, where bench owns the machine): zero loss for
+    in-limit subscribers, delivered + shed == published for EVERY
+    subscriber, the shed window resuming as exactly one counted marker,
+    snapshot accounting exact, the armed-telemetry leg recording, the
+    disarmed publish path allocation-free, and sharded ≡ single-plane
+    wire parity."""
+    import numpy as np
+
+    row = bench.bench_log_fanout_storm(np, n_subs=1500, rounds=2,
+                                       permsg_subs=300, parity_subs=48)
+    assert row["parity"] is True, row
+    assert row["zero_loss_in_limit"] is True
+    assert row["shed_accounting_exact"] is True
+    assert row["shed_resume_ok"] is True
+    assert row["snapshot_accounting_exact"] is True
+    assert row["wire_parity"] is True
+    assert row["disarmed_publish_allocs"] == 0
+    assert row["armed_publish_records"] >= 1
+    # loose on the contended host; the >=10x acceptance bar is the
+    # bench row's (store_plane precedent)
+    assert row["batched_speedup_x"] > 1

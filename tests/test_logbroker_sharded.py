@@ -1,0 +1,596 @@
+"""Sharded log fan-out plane (ISSUE 20): wire parity vs the scalar
+oracle, shed-and-resume channel semantics, bounded listener streams,
+kill switches, the sharded watch queue, and the CHAOS_SEED-replayable
+churn soak (fast seeds tier-1; the long soak runs under `-m chaos`).
+"""
+import os
+import random
+import threading
+from contextlib import contextmanager
+
+import pytest
+
+from swarmkit_tpu.api.objects import Task
+from swarmkit_tpu.api.types import TaskState
+from swarmkit_tpu.dispatcher.heartbeat import stable_shard
+from swarmkit_tpu.logbroker import make_log_message
+from swarmkit_tpu.logbroker.broker import (
+    LogBroker,
+    LogMessage,
+    LogSelector,
+    LogShedRecord,
+    SubscriptionComplete,
+)
+from swarmkit_tpu.logbroker.sharded import (
+    CLIENT_CHANNEL_LIMIT,
+    ShardedLogBroker,
+    ShedChannel,
+    make_log_broker,
+)
+from swarmkit_tpu.store.memory import MemoryStore
+from swarmkit_tpu.store.watch import (
+    ChannelClosed,
+    ShardedWatchQueue,
+    WatchQueue,
+    make_watch_queue,
+)
+from swarmkit_tpu.utils.clock import FakeClock
+
+FAST_SEEDS = list(range(2))
+SOAK_SEEDS = list(range(2, 12))
+
+_ERR_PREFIX = ("warning: incomplete log stream. some logs could not be "
+               "retrieved for the following reasons: ")
+
+
+@contextmanager
+def chaos_seed(seed):
+    try:
+        yield
+    except BaseException:
+        print(f"\nCHAOS_SEED={seed}")
+        raise
+
+
+def _task(tid, service_id="", node_id=""):
+    t = Task(id=tid, service_id=service_id, node_id=node_id)
+    t.status.state = TaskState.RUNNING
+    t.desired_state = TaskState.RUNNING
+    return t
+
+
+# ----------------------------------------------------- ShedChannel semantics
+def test_shed_channel_basic_shed_and_resume():
+    ch = ShedChannel(limit=3)
+    delivered, shed = ch.offer_batch([f"m{i}" for i in range(5)])
+    assert (delivered, shed) == (3, 2)
+    assert (ch.published, ch.delivered, ch.shed, ch.shed_windows) \
+        == (5, 3, 2, 1)
+    out = ch.drain()
+    # the queued window, then the loss marker at its exact position
+    assert out[:3] == ["m0", "m1", "m2"]
+    marker = out[3]
+    assert isinstance(marker, LogShedRecord)
+    assert (marker.count, marker.first_seq, marker.last_seq) == (2, 4, 5)
+    # the stream RESUMES: post-drain offers deliver again
+    delivered, shed = ch.offer_batch(["m5"])
+    assert (delivered, shed) == (1, 0)
+    assert ch.try_get() == "m5"
+    assert ch.published == ch.delivered + ch.shed == 6
+
+
+def test_shed_marker_emitted_by_consumer_pop():
+    """A full channel holds the marker back until a slot frees — the
+    next consumer pop must surface it without any further publish."""
+    ch = ShedChannel(limit=2)
+    ch.offer_batch(["a", "b", "c"])          # c shed, marker pending
+    assert ch.try_get() == "a"               # pop frees a slot → marker lands
+    assert ch.try_get() == "b"
+    marker = ch.try_get()
+    assert isinstance(marker, LogShedRecord)
+    assert (marker.count, marker.first_seq, marker.last_seq) == (1, 3, 3)
+
+
+def test_shed_window_coalesces_and_reopens():
+    """Consecutive overflowing publishes extend ONE window (one
+    shed_windows bump); a delivery in between starts a fresh window."""
+    ch = ShedChannel(limit=1)
+    ch.offer_batch(["a"])                    # fills
+    ch.offer_batch(["b"])                    # window 1: seq 2
+    ch.offer_batch(["c"])                    # window 1 extends: seq 2..3
+    assert ch.shed_windows == 1
+    assert ch.try_get() == "a"
+    m1 = ch.try_get()
+    assert (m1.count, m1.first_seq, m1.last_seq) == (2, 2, 3)
+    ch.offer_batch(["d"])                    # delivered (room after pops)
+    ch.offer_batch(["e"])                    # window 2: seq 5
+    assert ch.shed_windows == 2
+    assert ch.try_get() == "d"
+    m2 = ch.try_get()
+    assert (m2.count, m2.first_seq, m2.last_seq) == (1, 5, 5)
+    assert ch.published == ch.delivered + ch.shed == 5
+
+
+def test_offer_control_bypasses_limit_and_trails_marker():
+    ch = ShedChannel(limit=2)
+    ch.offer_batch(["a", "b", "c"])          # full + pending marker
+    assert ch.offer_control(SubscriptionComplete(error="")) is True
+    out = ch.drain()
+    # data, marker announcing the loss, THEN the control record
+    assert out[0:2] == ["a", "b"]
+    assert isinstance(out[2], LogShedRecord) and out[2].count == 1
+    assert isinstance(out[3], SubscriptionComplete)
+    assert len(out) == 4
+
+
+def test_offer_batch_after_close_counts_shed():
+    ch = ShedChannel(limit=4)
+    ch.close()
+    delivered, shed = ch.offer_batch(["a", "b"])
+    assert (delivered, shed) == (0, 2)
+    assert ch.published == ch.delivered + ch.shed == 2
+
+
+def test_default_client_limit_applies():
+    store = MemoryStore()
+    store.update(lambda tx: tx.create(_task("t1", "svc1", "n1")))
+    broker = ShardedLogBroker(store, shards=2)
+    _sid, client = broker.subscribe_logs(LogSelector(service_ids=["svc1"]))
+    assert isinstance(client, ShedChannel)
+    assert client._limit == CLIENT_CHANNEL_LIMIT
+    _sid2, unbounded = broker.subscribe_logs(
+        LogSelector(service_ids=["svc1"]), limit=None)
+    assert unbounded._limit is None
+
+
+# ------------------------------------------------------- wire parity (fuzz)
+def _drive_broker(make_broker, seed, ops=120):
+    """Deterministically drive one broker (UN-started: dispatch and
+    offers run inline) and return per-subscription observable streams:
+    (data tuple, normalized completion errors, closed)."""
+    rng = random.Random(seed)
+    store = MemoryStore()
+    services = [f"svc{i}" for i in range(4)]
+    nodes = [f"pn{i}" for i in range(4)]
+    tasks = []
+
+    def seed_tx(tx):
+        for i in range(10):
+            svc = services[i % len(services)]
+            node = nodes[i % len(nodes)] if i != 7 else ""  # one unscheduled
+            t = _task(f"t{i}", svc, node)
+            tx.create(t)
+            tasks.append(t)
+
+    store.update(seed_tx)
+    broker = make_broker(store)
+    listeners = {}
+    for n in nodes[:3]:                      # pn3 never listens
+        listeners[n] = broker.listen_subscriptions(n)
+    subs = []                                # (idx, sub_id, client, svc)
+    for step in range(ops):
+        op = rng.randrange(10)
+        if op < 3 or not subs:
+            svc = rng.choice(services)
+            follow = rng.random() < 0.5
+            sid, ch = broker.subscribe_logs(
+                LogSelector(service_ids=[svc]), follow=follow, limit=None)
+            subs.append((len(subs), sid, ch, svc))
+        elif op < 8:
+            _i, sid, _ch, svc = rng.choice(subs)
+            cands = [t for t in tasks if t.service_id == svc and t.node_id]
+            if not cands:
+                continue
+            t = rng.choice(cands)
+            msgs = [make_log_message(t, "stdout",
+                                     f"s{seed}-{step}-{k}".encode())
+                    for k in range(rng.randrange(1, 4))]
+            broker.publish_logs(sid, msgs)
+        elif op < 9:
+            _i, sid, _ch, svc = rng.choice(subs)
+            cands = [t.node_id for t in tasks
+                     if t.service_id == svc and t.node_id]
+            if not cands:
+                continue
+            n = rng.choice(cands)
+            err = "" if rng.random() < 0.7 else f"pump died on {n}"
+            broker.publish_logs(sid, [], node_id=n, close=True, error=err)
+        else:
+            _i, sid, _ch, _svc = rng.choice(subs)
+            broker.unsubscribe(sid)
+    streams = {}
+    for i, _sid, ch, _svc in subs:
+        out = ch.drain()
+        data = tuple(m.data for m in out if isinstance(m, LogMessage))
+        comp = [m for m in out if isinstance(m, SubscriptionComplete)]
+        err = None
+        if comp:
+            text = comp[0].error
+            if text.startswith(_ERR_PREFIX):
+                text = text[len(_ERR_PREFIX):]
+            # order-normalized: the planes may record warnings in
+            # different notify-set iteration orders
+            err = tuple(sorted(text.split(", "))) if text else ()
+        streams[i] = (data, err, ch.closed)
+    return streams
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_wire_parity_sharded_vs_single_plane(seed):
+    """The judged property: sharded(P) ≡ single-plane per-subscriber wire
+    streams — exact data order, same completion records, same closes."""
+    shards = 1 + seed % 4
+    oracle = _drive_broker(lambda s: LogBroker(s), seed)
+    plane = _drive_broker(
+        lambda s: ShardedLogBroker(s, shards=shards), seed)
+    assert plane == oracle
+
+
+# ------------------------------------------------- sharded broker behaviors
+def test_sharded_routing_publish_and_unsubscribe_close():
+    store = MemoryStore()
+    store.update(lambda tx: (tx.create(_task("t1", "svc1", "n1")),
+                             tx.create(_task("t2", "svc2", "n2"))))
+    broker = ShardedLogBroker(store, shards=4)
+    n1_ch = broker.listen_subscriptions("n1")
+    n2_ch = broker.listen_subscriptions("n2")
+    sub_id, client = broker.subscribe_logs(LogSelector(service_ids=["svc1"]))
+    msg = n1_ch.get(timeout=2)
+    assert msg.id == sub_id and not msg.close
+    assert n2_ch.try_get() is None           # svc2's node must not hear it
+    t1 = store.view(lambda tx: tx.get_task("t1"))
+    broker.publish_logs(sub_id, [make_log_message(t1, "stdout", b"hello")])
+    assert client.get(timeout=2).data == b"hello"
+    broker.unsubscribe(sub_id)
+    assert n1_ch.get(timeout=2).close
+    snap = broker.metrics_snapshot()
+    assert snap["published"] == snap["delivered"] + snap["shed"] == 1
+    assert snap["subscriptions_opened"] == 1
+
+
+def test_listener_channel_bounded_sheds_dead_agent():
+    """An agent stream that stops draining hits its bound, closes, and is
+    accounted as a disconnect — it never queues unboundedly (the ISSUE 16
+    OOM shape) and never stalls dispatch."""
+    store = MemoryStore()
+    store.update(lambda tx: tx.create(_task("t1", "svc1", "n1")))
+    broker = ShardedLogBroker(store, shards=2, listener_limit=3)
+    ch = broker.listen_subscriptions("n1")
+    assert ch._limit == 3
+    for _ in range(4):                        # 4th open overflows the bound
+        broker.subscribe_logs(LogSelector(service_ids=["svc1"]))
+    assert ch.closed
+    assert broker._bag["listener_disconnects"] == 1
+    sh = broker._shards[stable_shard("n1", 2)]
+    assert "n1" not in sh.listeners
+
+
+def test_nonfollow_completion_and_unavailable_nodes_sharded():
+    """The oracle's completion lifecycle holds on the sharded plane,
+    including the control record riding past a full client channel."""
+    store = MemoryStore()
+    store.update(lambda tx: (tx.create(_task("t1", "svc1", "n1")),
+                             tx.create(_task("t2", "svc1", "n-gone")),
+                             tx.create(_task("t3", "svc1", ""))))
+    broker = ShardedLogBroker(store, shards=3, client_limit=1)
+    broker.listen_subscriptions("n1")
+    sub_id, client = broker.subscribe_logs(
+        LogSelector(service_ids=["svc1"]), follow=False)
+    t1 = store.view(lambda tx: tx.get_task("t1"))
+    broker.publish_logs(
+        sub_id, [make_log_message(t1, "stdout", b"a"),
+                 make_log_message(t1, "stdout", b"b")],   # b sheds (limit 1)
+        node_id="n1", close=True)
+    out = client.drain()
+    assert [type(x) for x in out] == [LogMessage, LogShedRecord,
+                                      SubscriptionComplete]
+    assert out[0].data == b"a" and out[1].count == 1
+    assert "n-gone is not available" in out[2].error
+    assert "t3 has not been scheduled" in out[2].error
+    assert client.closed
+    snap = broker.metrics_snapshot()
+    assert snap["subscriptions_completed"] == 1
+    assert snap["published"] == snap["delivered"] + snap["shed"] == 2
+
+
+def test_client_disconnect_sweeps_and_notifies_publishers():
+    store = MemoryStore()
+    store.update(lambda tx: tx.create(_task("t1", "svc1", "n1")))
+    broker = ShardedLogBroker(store, shards=2)
+    broker.start()
+    try:
+        n1_ch = broker.listen_subscriptions("n1")
+        sub_id, client = broker.subscribe_logs(
+            LogSelector(service_ids=["svc1"]), follow=True)
+        assert n1_ch.get(timeout=2).id == sub_id
+        client.close()
+        close_msg = n1_ch.get(timeout=5)
+        assert close_msg.id == sub_id and close_msg.close
+        deadline = threading.Event()
+        for _ in range(100):
+            if sub_id not in broker._subs:
+                break
+            deadline.wait(0.05)
+        assert sub_id not in broker._subs
+    finally:
+        broker.stop()
+
+
+def test_follow_extends_to_new_nodes_sharded():
+    """Task movement mid-follow: the watcher dispatches through the shard
+    pumps to the node that gained the task."""
+    store = MemoryStore()
+    store.update(lambda tx: tx.create(_task("t1", "svc1", "n1")))
+    broker = ShardedLogBroker(store, shards=4)
+    broker.start()
+    try:
+        broker.listen_subscriptions("n1")
+        sub_id, _client = broker.subscribe_logs(
+            LogSelector(service_ids=["svc1"]))
+        n3_ch = broker.listen_subscriptions("n3")
+        store.update(lambda tx: tx.create(_task("t3", "svc1", "n3")))
+        msg = n3_ch.get(timeout=3)
+        assert msg.id == sub_id
+    finally:
+        broker.stop()
+
+
+def test_fakeclock_timestamps_and_lag():
+    clk = FakeClock(start=5000.0)
+    store = MemoryStore()
+    store.update(lambda tx: tx.create(_task("t1", "svc1", "n1")))
+    broker = ShardedLogBroker(store, shards=2, clock=clk)
+    t1 = store.view(lambda tx: tx.get_task("t1"))
+    msg = make_log_message(t1, "stdout", b"x", clock=clk)
+    assert msg.timestamp == 5000.0
+    sub_id, _client = broker.subscribe_logs(
+        LogSelector(service_ids=["svc1"]))
+    clk.advance(2.5)
+    from swarmkit_tpu.utils import telemetry
+    from swarmkit_tpu.utils.metrics import registry_snapshot
+    with telemetry.armed():
+        broker.publish_logs(sub_id, [msg])
+        snap = registry_snapshot()
+    hist = snap["histograms"]["swarm_logbroker_lag_seconds"]
+    shard = str(stable_shard("n1", broker.shards))
+    series = [s for s in hist["series"] if s[0] == [shard]]
+    # series entry: [labels, bucket counts, total seconds, n]; the
+    # family is process-global, so pin >= (other tests may observe ~0s)
+    assert series and series[0][3] >= 1
+    assert series[0][2] >= 2.4               # the FakeClock 2.5s lag
+
+
+def test_disarmed_publish_is_alloc_free_and_armed_records():
+    store = MemoryStore()
+    store.update(lambda tx: tx.create(_task("t1", "svc1", "n1")))
+    broker = ShardedLogBroker(store, shards=2)
+    sub_id, _client = broker.subscribe_logs(
+        LogSelector(service_ids=["svc1"]))
+    t1 = store.view(lambda tx: tx.get_task("t1"))
+    calls = {"n": 0}
+    orig = broker._record_publish
+    broker._record_publish = lambda *a, **k: calls.__setitem__(
+        "n", calls["n"] + 1)
+    try:
+        broker.publish_logs(sub_id, [make_log_message(t1, "stdout", b"x")])
+        assert calls["n"] == 0               # disarmed: recorder never runs
+        from swarmkit_tpu.utils import telemetry
+        with telemetry.armed():
+            broker.publish_logs(
+                sub_id, [make_log_message(t1, "stdout", b"y")])
+        assert calls["n"] == 1
+    finally:
+        broker._record_publish = orig
+    from swarmkit_tpu.utils import telemetry
+    from swarmkit_tpu.utils.metrics import (registry_snapshot,
+                                            snapshot_counter_value)
+    with telemetry.armed():
+        broker.publish_logs(sub_id, [make_log_message(t1, "stdout", b"z")])
+        snap = registry_snapshot()
+    from swarmkit_tpu.dispatcher.heartbeat import stable_shard
+    shard = str(stable_shard("n1", broker.shards))
+    assert snapshot_counter_value(
+        snap, "swarm_logbroker_published_total", (shard,)) >= 1
+    assert snapshot_counter_value(
+        snap, "swarm_logbroker_delivered_total", (shard,)) >= 1
+
+
+# ------------------------------------------------------------- kill switches
+def test_kill_switch_selects_scalar_planes(monkeypatch):
+    store = MemoryStore()
+    monkeypatch.setenv("SWARMKIT_TPU_NO_SHARDED_LOGS", "1")
+    b = make_log_broker(store)
+    assert type(b) is LogBroker
+    q = make_watch_queue()
+    assert type(q) is WatchQueue
+    monkeypatch.delenv("SWARMKIT_TPU_NO_SHARDED_LOGS")
+    b2 = make_log_broker(store)
+    assert isinstance(b2, ShardedLogBroker)
+    assert isinstance(make_watch_queue(), ShardedWatchQueue)
+
+
+def test_scalar_broker_maps_minus_one_limit_to_unbounded():
+    """The RPC surface passes limit=-1 through; under the kill switch the
+    scalar broker must read it as its default (unbounded), never as a
+    Channel(limit=-1) that closes on the first offer."""
+    store = MemoryStore()
+    store.update(lambda tx: tx.create(_task("t1", "svc1", "n1")))
+    broker = LogBroker(store)
+    sub_id, client = broker.subscribe_logs(
+        LogSelector(service_ids=["svc1"]), limit=-1)
+    assert client._limit is None
+    t1 = store.view(lambda tx: tx.get_task("t1"))
+    broker.publish_logs(sub_id, [make_log_message(t1, "stdout", b"x")])
+    assert client.get(timeout=2).data == b"x"
+
+
+# ------------------------------------------------------- sharded watch queue
+def test_sharded_watch_queue_parity_and_order():
+    events = [("ev", i) for i in range(200)]
+    serial, sharded = WatchQueue(), ShardedWatchQueue(shards=4)
+    sharded.MIN_PARALLEL = 1                 # force the striped path
+    s_chans = [serial.watch(limit=None) for _ in range(40)]
+    p_chans = [sharded.watch(limit=None) for _ in range(40)]
+    for chunk in (events[:50], events[50:]):
+        serial.publish_all(chunk)
+        sharded.publish_all(chunk)
+    for sc, pc in zip(s_chans, p_chans):
+        assert pc.drain() == sc.drain() == events
+
+
+def test_sharded_watch_queue_slow_subscriber_close_parity():
+    q = ShardedWatchQueue(shards=2)
+    q.MIN_PARALLEL = 1
+    chans = [q.watch(limit=3) for _ in range(70)]
+    q.publish_all(list(range(5)))            # over the limit → closes
+    for ch in chans:
+        assert ch.closed
+        assert ch.drain() == [0, 1, 2]       # exactly limit queued
+
+
+def test_sharded_watch_queue_callbacks_stay_on_publisher_thread():
+    q = ShardedWatchQueue(shards=4)
+    q.MIN_PARALLEL = 1
+    seen = []
+    q.callback_watch(lambda ev: seen.append(
+        (ev, threading.get_ident())))
+    # enough plain watchers to trip the parallel path
+    chans = [q.watch(limit=None) for _ in range(80)]
+    q.publish_all(["a", "b"])
+    me = threading.get_ident()
+    assert [(e, t == me) for e, t in seen] == [("a", True), ("b", True)]
+    assert chans[0].drain() == ["a", "b"]
+
+
+def test_memory_store_uses_production_watch_queue():
+    store = MemoryStore()
+    if os.environ.get("SWARMKIT_TPU_NO_SHARDED_LOGS"):
+        assert type(store.queue) is WatchQueue
+    else:
+        assert isinstance(store.queue, ShardedWatchQueue)
+
+
+# ------------------------------------------------------------- churn soak
+def _churn_round(rng, broker, store, state, clients):
+    """One seeded churn op against a LIVE broker: listener kill, client
+    disconnect, task movement mid-follow, shed-and-resume publishes."""
+    op = rng.randrange(12)
+    nodes, subs = state["nodes"], state["subs"]
+    if op < 2:                                # (re)listen a node
+        n = rng.choice(nodes)
+        state["listeners"][n] = broker.listen_subscriptions(n)
+    elif op < 3 and state["listeners"]:       # agent listener dies
+        n = rng.choice(list(state["listeners"]))
+        state["listeners"].pop(n).close()
+    elif op < 4 and state["listeners"]:       # graceful stop_listening
+        n = rng.choice(list(state["listeners"]))
+        state["listeners"].pop(n)
+        broker.stop_listening(n)
+    elif op < 6:                              # open a subscription
+        svc = rng.choice(state["services"])
+        sid, ch = broker.subscribe_logs(
+            LogSelector(service_ids=[svc]), follow=True,
+            limit=rng.choice([2, 4, -1]))
+        subs.append((sid, ch, svc))
+        clients.append(ch)
+    elif op < 9 and subs:                     # publish (often over-limit)
+        sid, _ch, svc = rng.choice(subs)
+        cands = [t for t in state["tasks"]
+                 if t.service_id == svc and t.node_id]
+        if cands:
+            t = rng.choice(cands)
+            msgs = [make_log_message(t, "stdout", b"x" * 8)
+                    for _ in range(rng.randrange(1, 8))]
+            broker.publish_logs(sid, msgs)
+    elif op < 10 and subs:                    # client disconnect
+        i = rng.randrange(len(subs))
+        _sid, ch, _svc = subs.pop(i)
+        ch.close()
+    elif op < 11 and subs:                    # partial drain (resume)
+        _sid, ch, _svc = rng.choice(subs)
+        seen = state["consumed"].setdefault(id(ch), [0, 0])
+        for _ in range(rng.randrange(1, 4)):
+            try:
+                got = ch.try_get()
+            except ChannelClosed:
+                break
+            if got is None:
+                break
+            if isinstance(got, LogMessage):
+                seen[0] += 1
+            elif isinstance(got, LogShedRecord):
+                seen[1] += got.count
+    else:                                     # task movement mid-follow
+        i = state["next_task"]
+        state["next_task"] += 1
+        svc = rng.choice(state["services"])
+        node = rng.choice(nodes)
+        t = _task(f"mv{i}", svc, node)
+        store.update(lambda tx: tx.create(t))
+        state["tasks"].append(t)
+
+
+def _run_churn_soak(seed, rounds):
+    rng = random.Random(seed)
+    store = MemoryStore()
+    services = [f"svc{i}" for i in range(3)]
+    nodes = [f"cn{i}" for i in range(6)]
+    tasks = []
+
+    def seed_tx(tx):
+        for i in range(12):
+            t = _task(f"t{i}", services[i % 3], nodes[i % 6])
+            tx.create(t)
+            tasks.append(t)
+
+    store.update(seed_tx)
+    broker = ShardedLogBroker(store, shards=1 + seed % 4, client_limit=4)
+    broker.start()
+    clients = []
+    state = {"services": services, "nodes": nodes, "tasks": tasks,
+             "listeners": {}, "subs": [], "next_task": 0, "consumed": {}}
+    try:
+        for n in nodes[:3]:
+            state["listeners"][n] = broker.listen_subscriptions(n)
+        for _ in range(rounds):
+            _churn_round(rng, broker, store, state, clients)
+    finally:
+        broker.stop()
+    # the judged invariant, per channel AND in aggregate: every published
+    # message is either delivered or counted shed, and every shed run is
+    # announced by markers whose counts sum exactly
+    total_pub = total_dlv = total_shed = 0
+    for ch in clients:
+        got = ch.drain()
+        pre_msgs, pre_marker = state["consumed"].get(id(ch), (0, 0))
+        n_msgs = pre_msgs + sum(
+            1 for m in got if isinstance(m, LogMessage))
+        marker_sum = pre_marker + sum(
+            m.count for m in got if isinstance(m, LogShedRecord))
+        with ch._cond:
+            pub, dlv, shd = ch.published, ch.delivered, ch.shed
+        assert pub == dlv + shd, (pub, dlv, shd)
+        assert marker_sum == shd, (marker_sum, shd)
+        assert n_msgs <= dlv
+        total_pub += pub
+        total_dlv += dlv
+        total_shed += shd
+    snap = broker.metrics_snapshot()
+    assert snap["published"] == total_pub
+    assert snap["delivered"] == total_dlv
+    assert snap["shed"] == total_shed
+    assert snap["pending_subscriptions"] == 0      # stop retired them all
+    return total_pub, total_shed
+
+
+@pytest.mark.parametrize("seed", FAST_SEEDS)
+def test_churn_soak_fast(seed):
+    with chaos_seed(seed):
+        _run_churn_soak(seed, rounds=150)
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", SOAK_SEEDS)
+def test_churn_soak(seed):
+    with chaos_seed(seed):
+        _run_churn_soak(seed, rounds=900)

@@ -166,3 +166,65 @@ def test_every_help_line_precedes_its_samples():
                     f"HELP for {name} not followed by its TYPE"
     finally:
         d._hb_wheel.stop()
+
+
+def test_logbroker_plane_counters_exposed_with_help():
+    """ISSUE 20 exposition pin: every key of the sharded broker's live
+    metrics_snapshot() renders under swarm_logbroker_plane{,_total}
+    with a HELP line — the generic walk keeps a new bag key exposed
+    without a hand edit, and this guard fails on a rename/drop."""
+    from swarmkit_tpu.api.objects import Task
+    from swarmkit_tpu.api.types import TaskState
+    from swarmkit_tpu.logbroker.broker import LogSelector
+    from swarmkit_tpu.logbroker.sharded import ShardedLogBroker
+
+    mod = _load_debugserver()
+    store = MemoryStore()
+
+    def seed(tx):
+        t = Task(id="t-expo", service_id="svc-expo", node_id="n-expo")
+        t.status.state = TaskState.RUNNING
+        tx.create(t)
+
+    store.update(seed)
+    broker = ShardedLogBroker(store, shards=2, client_limit=1)
+    broker.listen_subscriptions("n-expo")
+    sub_id, _client = broker.subscribe_logs(
+        LogSelector(service_ids=["svc-expo"]))
+    t = store.view(lambda tx: tx.get_task("t-expo"))
+    from swarmkit_tpu.logbroker import make_log_message
+    broker.publish_logs(
+        sub_id, [make_log_message(t, "stdout", b"a"),
+                 make_log_message(t, "stdout", b"b")])   # b sheds
+
+    node = _StubNode()
+    node.log_broker = broker
+    text = mod.component_metrics_text(node)
+    helps = _help_names(text)
+    assert "swarm_logbroker_plane_total" in helps
+    # (the float/gauge family renders only when a float stat exists;
+    # the snapshot is currently all-int)
+    snap = broker.metrics_snapshot()
+    assert snap["shed"] == 1 and snap["delivered"] == 1
+    for key in snap:
+        assert f'"{key}"' in text, \
+            f"logbroker counter {key!r} missing from /metrics"
+
+
+def test_logbroker_armed_families_registered_with_help():
+    """The armed swarm_logbroker_* counter/histogram families are built
+    through the utils/metrics factories, so the /metrics registry walk
+    renders them with HELP lines (the ISSUE 15 rollup rides the same
+    registration)."""
+    import swarmkit_tpu.logbroker.sharded  # noqa: F401  (registers)
+    from swarmkit_tpu.utils.metrics import all_families, all_histograms
+
+    text = "\n".join(
+        [f.prometheus_text() for f in all_families()]
+        + [h.prometheus_text() for h in all_histograms()])
+    helps = _help_names(text)
+    for name in ("swarm_logbroker_published_total",
+                 "swarm_logbroker_delivered_total",
+                 "swarm_logbroker_shed_total",
+                 "swarm_logbroker_lag_seconds"):
+        assert name in helps, f"{name} family not registered"

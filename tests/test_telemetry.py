@@ -499,3 +499,50 @@ def test_agent_piggyback_cadence_in_heartbeat_loop():
         finally:
             a.leave()
             d.stop()
+
+
+def test_rollup_carries_logbroker_block():
+    """ISSUE 20: the manager block of the rollup surfaces the log
+    fan-out plane's accounting (published/delivered/shed + plane
+    gauges) whenever a broker with a metrics_snapshot surface is wired
+    in — `swarmctl top`, /debug/cluster and the swarmbench `log_plane`
+    block read exactly this dict."""
+    from swarmkit_tpu.api.objects import Task
+    from swarmkit_tpu.api.types import TaskState
+    from swarmkit_tpu.logbroker import make_log_message
+    from swarmkit_tpu.logbroker.broker import LogSelector
+    from swarmkit_tpu.logbroker.sharded import ShardedLogBroker
+
+    clock = FakeClock()
+    store = MemoryStore()
+
+    def seed(tx):
+        t = Task(id="t-roll", service_id="svc-roll", node_id="n-roll")
+        t.status.state = TaskState.RUNNING
+        tx.create(t)
+
+    store.update(seed)
+    d = Dispatcher(MemoryStore(), heartbeat_period=5.0, clock=clock,
+                   shards=1)
+    try:
+        broker = ShardedLogBroker(store, shards=2, client_limit=1)
+        sub_id, _client = broker.subscribe_logs(
+            LogSelector(service_ids=["svc-roll"]))
+        t = store.view(lambda tx: tx.get_task("t-roll"))
+        broker.publish_logs(
+            sub_id, [make_log_message(t, "stdout", b"a"),
+                     make_log_message(t, "stdout", b"b")])   # b sheds
+        agg = TelemetryAggregator(MemoryStore(), d, clock=clock,
+                                  log_broker=broker)
+        lb = agg.rollup()["manager"]["logbroker"]
+        assert lb["published"] == 2
+        assert lb["delivered"] == 1
+        assert lb["shed"] == 1 and lb["shed_windows"] == 1
+        assert lb["published"] == lb["delivered"] + lb["shed"]
+        assert lb["pending_subscriptions"] == 1
+        assert lb["subscriptions_opened"] == 1
+        # no broker wired → no block (worker-side aggregators)
+        agg2 = TelemetryAggregator(MemoryStore(), d, clock=clock)
+        assert "logbroker" not in agg2.rollup()["manager"]
+    finally:
+        d._hb_wheel.stop()
